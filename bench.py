@@ -1,0 +1,85 @@
+"""Headline benchmark: training throughput on one TPU chip.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": ...}
+
+vs_baseline is null: the reference repo is empty (SURVEY.md §0) and
+publishes no numbers to compare against, so the value stands alone.
+
+Runs on whatever backend jax selects (the real TPU under the driver); a
+small model is substituted automatically on CPU so the script stays
+runnable anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from shellac_tpu import get_model_config
+    from shellac_tpu.config import TrainConfig
+    from shellac_tpu.training import init_train_state, make_train_step
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    if on_tpu:
+        cfg = get_model_config("shellac-1b")
+        batch, seq, steps = 4, 2048, 10
+    else:
+        cfg = get_model_config("tiny")
+        batch, seq, steps = 4, 128, 3
+
+    tcfg = TrainConfig(warmup_steps=10, total_steps=1000)
+    key = jax.random.PRNGKey(0)
+    state = init_train_state(cfg, tcfg, key)
+    step = make_train_step(cfg, tcfg)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+    batch_data = {"inputs": tokens, "targets": tokens}
+
+    # Warmup (compile + first step). float() forces a device-to-host
+    # transfer: on the axon relay platform block_until_ready alone does
+    # not actually synchronize.
+    state, metrics = step(state, batch_data)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_data)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * steps / dt
+
+    from shellac_tpu.models.transformer import num_params
+
+    n_params = num_params(state.params)
+    # Rough model FLOPs: 6 * params * tokens (fwd+bwd), + attention term.
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
+    mfu_denom = 197e12 if on_tpu else None  # v5e bf16 peak ~197 TFLOP/s
+
+    result = {
+        "metric": f"train_throughput_{cfg.d_model}d{cfg.n_layers}L_seq{seq}_{backend}",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+    }
+    extra = {
+        "params": n_params,
+        "step_time_s": round(dt / steps, 4),
+        "loss": round(final_loss, 4),
+    }
+    if mfu_denom:
+        extra["mfu"] = round(tok_s * flops_per_token / mfu_denom, 4)
+    result["detail"] = extra
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""shellac_tpu — a TPU-native training & inference framework.
+
+Compute path: JAX/XLA with Pallas TPU kernels for the hot ops.
+Parallelism: GSPMD over a named device mesh (dp/fsdp/pp/sp/tp) — XLA
+inserts the collectives; ring attention rides ICI for long context.
+
+The reference project this repo was allocated against (kmacrow/Shellac,
+mounted at /root/reference) is empty — see SURVEY.md §0 — so this is an
+original design with no upstream file:line citations.
+"""
+
+from shellac_tpu.version import __version__
+from shellac_tpu.config import (
+    ModelConfig,
+    MoEConfig,
+    ParallelConfig,
+    TrainConfig,
+)
+from shellac_tpu.models.registry import PRESETS, get_model_config
+from shellac_tpu.parallel.mesh import make_mesh
+
+__all__ = [
+    "__version__",
+    "ModelConfig",
+    "MoEConfig",
+    "ParallelConfig",
+    "TrainConfig",
+    "PRESETS",
+    "get_model_config",
+    "make_mesh",
+]

@@ -1,0 +1,165 @@
+"""Configuration dataclasses for models, parallelism, and training.
+
+Design note: the reference repo mounted at /root/reference is empty (see
+SURVEY.md §0), so there is no reference config system to cite. This is an
+original, TPU-first design: configs are frozen dataclasses so they can be
+closed over by jitted functions as static data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "int8": jnp.int8,
+}
+
+
+def resolve_dtype(name):
+    """Map a dtype name (or dtype) to the jnp dtype object."""
+    if isinstance(name, str):
+        return _DTYPES[name]
+    return name
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    """Mixture-of-experts layer configuration."""
+
+    num_experts: int = 8
+    num_experts_per_token: int = 2
+    # Per-expert capacity = capacity_factor * tokens / num_experts.
+    capacity_factor: float = 1.25
+    router_aux_loss_weight: float = 0.01
+    router_z_loss_weight: float = 1e-3
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Decoder-only transformer configuration (LLaMA-style)."""
+
+    vocab_size: int = 32768
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    # Grouped-query attention: n_kv_heads <= n_heads, n_heads % n_kv_heads == 0.
+    n_kv_heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    d_ff: Optional[int] = None
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    # Compute dtype; parameters are kept in param_dtype (fp32 master copy).
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+    tie_embeddings: bool = True
+    # Rematerialize each block in the backward pass (memory for FLOPs).
+    remat: bool = True
+    # Optional sliding-window attention (None = full causal).
+    attn_window: Optional[int] = None
+    # If set, every `moe_every`-th layer is a MoE layer (1 = all layers).
+    moe: Optional[MoEConfig] = None
+    moe_every: int = 1
+    logit_softcap: Optional[float] = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+
+    @property
+    def dim_per_head(self) -> int:
+        return (
+            self.head_dim if self.head_dim is not None else self.d_model // self.n_heads
+        )
+
+    @property
+    def ff_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        # SwiGLU sizing: 2/3 * 4 * d_model, rounded up to a multiple of 128
+        # so the MXU tiles cleanly (128 lanes).
+        raw = int(8 * self.d_model / 3)
+        return ((raw + 127) // 128) * 128
+
+    @property
+    def compute_dtype(self):
+        return resolve_dtype(self.dtype)
+
+    @property
+    def params_dtype(self):
+        return resolve_dtype(self.param_dtype)
+
+    def validate(self) -> "ModelConfig":
+        if self.n_heads % self.kv_heads != 0:
+            raise ValueError(
+                f"n_heads={self.n_heads} must be divisible by n_kv_heads={self.kv_heads}"
+            )
+        if self.head_dim is None and self.d_model % self.n_heads != 0:
+            raise ValueError(
+                f"d_model={self.d_model} must be divisible by n_heads={self.n_heads}"
+            )
+        if self.moe is not None and self.moe_every < 1:
+            raise ValueError("moe_every must be >= 1")
+        return self
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Sizes of the device-mesh axes.
+
+    The mesh is laid out (dp, fsdp, pp, sp, tp) from outermost
+    (DCN-friendly) to innermost (ICI-friendly): tensor parallelism
+    generates the most traffic per step so it rides the fastest links.
+
+    - dp:   pure data parallelism (gradients all-reduced)
+    - fsdp: data parallelism with parameter/optimizer sharding (ZeRO-3)
+    - pp:   pipeline-stage axis (reserved by the mesh; pipelined
+            execution itself is a planned module)
+    - sp:   sequence/context parallelism (ring attention)
+    - tp:   tensor (megatron-style) parallelism within a layer
+    """
+
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+    pp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.sp * self.tp * self.pp
+
+    def replace(self, **kw) -> "ParallelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Optimizer / schedule / loop configuration."""
+
+    learning_rate: float = 3e-4
+    min_lr_ratio: float = 0.1
+    warmup_steps: int = 100
+    total_steps: int = 1000
+    weight_decay: float = 0.1
+    b1: float = 0.9
+    b2: float = 0.95
+    eps: float = 1e-8
+    grad_clip_norm: float = 1.0
+    # Number of microbatches accumulated per optimizer step (1 = no accum).
+    grad_accum: int = 1
+    z_loss_weight: float = 0.0
+    seed: int = 0
+
+    def replace(self, **kw) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,10 @@
+from shellac_tpu.inference.engine import Engine, GenerationResult
+from shellac_tpu.inference.kvcache import KVCache, cache_logical_axes, init_cache
+
+__all__ = [
+    "Engine",
+    "GenerationResult",
+    "KVCache",
+    "init_cache",
+    "cache_logical_axes",
+]

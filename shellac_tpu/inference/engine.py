@@ -1,0 +1,109 @@
+"""Autoregressive generation engine.
+
+Prefill and decode are two jitted programs over the same cached forward:
+prefill consumes the whole (padded) prompt in one MXU-friendly pass;
+decode runs a `lax.scan` of single-token steps, keeping the loop on
+device — no host round-trip per token.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference.kvcache import KVCache, init_cache
+from shellac_tpu.models import transformer
+from shellac_tpu.ops.sampling import sample
+
+
+@flax.struct.dataclass
+class GenerationResult:
+    tokens: jax.Array  # (B, max_new_tokens) int32
+    logprobs: jax.Array  # (B, max_new_tokens) fp32 — logprob of each sampled token
+
+
+class Engine:
+    """Holds jitted prefill/decode for one (config, shapes) pair."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params,
+        *,
+        max_len: Optional[int] = None,
+        temperature: float = 1.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.max_len = max_len or cfg.max_seq_len
+        self._sampler = functools.partial(
+            sample, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        self._prefill = jax.jit(self._prefill_impl)
+        self._decode = jax.jit(self._decode_impl, static_argnums=(3,))
+
+    def _prefill_impl(self, params, tokens, prompt_len):
+        """tokens: (B, S_pad) right-padded; prompt_len: (B,) real lengths."""
+        b, s = tokens.shape
+        cache = init_cache(self.cfg, b, self.max_len)
+        logits, cache = transformer.forward_with_cache(
+            self.cfg, params, tokens, cache, new_tokens_len=prompt_len
+        )
+        # Logits at the last *real* prompt position seed the first sample.
+        last = jnp.take_along_axis(
+            logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+        return last, cache
+
+    def _decode_impl(self, params, first_token_logits, cache, steps, key):
+        def step(carry, _):
+            cache, tok, key = carry
+            logits, cache = transformer.forward_with_cache(
+                self.cfg, params, tok[:, None], cache
+            )
+            logits = logits[:, 0]
+            key, sub = jax.random.split(key)
+            nxt = self._sampler(sub, logits)
+            lp = jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=-1
+            )[:, 0]
+            return (cache, nxt, key), (nxt, lp)
+
+        key, sub = jax.random.split(key)
+        first = self._sampler(sub, first_token_logits)
+        first_lp = jnp.take_along_axis(
+            jax.nn.log_softmax(first_token_logits, axis=-1), first[:, None], axis=-1
+        )[:, 0]
+        # The first token comes from prefill logits; the scan samples the
+        # remaining steps-1 (no discarded trailing forward pass).
+        _, (toks, lps) = jax.lax.scan(
+            step, (cache, first, key), None, length=steps - 1
+        )
+        tokens = jnp.concatenate([first[None], toks], axis=0)
+        logprobs = jnp.concatenate([first_lp[None], lps], axis=0)
+        return GenerationResult(
+            tokens=jnp.moveaxis(tokens, 0, 1), logprobs=jnp.moveaxis(logprobs, 0, 1)
+        )
+
+    def generate(
+        self,
+        prompt_tokens: jax.Array,  # (B, S) int32, right-padded
+        prompt_len: Optional[jax.Array] = None,  # (B,) int32
+        *,
+        max_new_tokens: int = 32,
+        key: Optional[jax.Array] = None,
+    ) -> GenerationResult:
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        b, s = prompt_tokens.shape
+        if prompt_len is None:
+            prompt_len = jnp.full((b,), s, jnp.int32)
+        first_logits, cache = self._prefill(self.params, prompt_tokens, prompt_len)
+        return self._decode(self.params, first_logits, cache, max_new_tokens, key)

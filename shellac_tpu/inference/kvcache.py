@@ -1,0 +1,71 @@
+"""KV cache for autoregressive decoding.
+
+Layout: stacked over layers, (L, B, max_len, Hkv, Dh), matching the
+stacked-layer parameter layout so the decode forward remains a single
+`lax.scan`. The cache lives in compute dtype (bf16): it is read-only
+bandwidth, and attention logits accumulate in fp32 regardless.
+
+Ragged batches are handled with per-sequence `lengths`: prompts are
+right-padded and written from offset 0; `lengths` records how many slots
+are real. Decode writes each sequence's next token at its own length
+(vmapped dynamic_update_slice), overwriting stale pad slots, so position
+ids stay continuous per sequence and pads are never attended.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shellac_tpu.config import ModelConfig
+
+
+@flax.struct.dataclass
+class KVCache:
+    k: Any  # (L, B, max_len, Hkv, Dh)
+    v: Any  # (L, B, max_len, Hkv, Dh)
+    lengths: Any  # (B,) int32 — valid positions per sequence
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.dim_per_head)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.compute_dtype),
+        v=jnp.zeros(shape, cfg.compute_dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def cache_logical_axes():
+    """Logical axes for sharding the cache over a mesh."""
+    return KVCache(
+        k=("layers", "batch", None, "kv_heads", None),
+        v=("layers", "batch", None, "kv_heads", None),
+        lengths=("batch",),
+    )
+
+
+def update_layer(
+    cache_k: jax.Array,  # (B, max_len, Hkv, Dh) — one layer's cache
+    cache_v: jax.Array,
+    k_new: jax.Array,  # (B, S, Hkv, Dh)
+    v_new: jax.Array,
+    index: jax.Array,  # (B,) int32 — per-sequence write offset
+):
+    """Write S new positions at per-sequence offsets; returns (k, v)."""
+    k_new = k_new.astype(cache_k.dtype)
+    v_new = v_new.astype(cache_v.dtype)
+
+    def upd(c, n, i):
+        return jax.lax.dynamic_update_slice(c, n, (i, 0, 0))
+
+    ck = jax.vmap(upd)(cache_k, k_new, index)
+    cv = jax.vmap(upd)(cache_v, v_new, index)
+    return ck, cv

@@ -1,0 +1,4 @@
+from shellac_tpu.models import transformer
+from shellac_tpu.models.registry import PRESETS, get_model_config
+
+__all__ = ["transformer", "PRESETS", "get_model_config"]

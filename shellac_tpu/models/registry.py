@@ -1,0 +1,35 @@
+"""Named model presets."""
+
+from __future__ import annotations
+
+from shellac_tpu.config import ModelConfig, MoEConfig
+
+# fmt: off
+PRESETS = {
+    # test-scale configs (CPU-friendly)
+    "tiny": ModelConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                        max_seq_len=128, remat=False),
+    "tiny-gqa": ModelConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                            n_kv_heads=2, max_seq_len=128, remat=False),
+    "tiny-moe": ModelConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                            max_seq_len=128, remat=False,
+                            moe=MoEConfig(num_experts=4, num_experts_per_token=2)),
+    # single-chip bench scale (v5e: 16 GiB HBM)
+    "shellac-270m": ModelConfig(vocab_size=32768, d_model=1024, n_layers=12,
+                                n_heads=8, n_kv_heads=8, head_dim=128,
+                                max_seq_len=2048),
+    "shellac-1b": ModelConfig(vocab_size=32768, d_model=2048, n_layers=16,
+                              n_heads=16, n_kv_heads=8, head_dim=128,
+                              max_seq_len=2048),
+    # multi-chip flagship shape (sharded over a mesh)
+    "shellac-7b": ModelConfig(vocab_size=32768, d_model=4096, n_layers=32,
+                              n_heads=32, n_kv_heads=8, head_dim=128,
+                              max_seq_len=4096),
+}
+# fmt: on
+
+
+def get_model_config(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]

@@ -1,0 +1,262 @@
+"""Decoder-only transformer (LLaMA-style), functional and TPU-first.
+
+Design choices, all driven by how XLA compiles for TPU:
+  - Parameters are a plain pytree of arrays with a parallel pytree of
+    *logical axis names* (see parallel/sharding.py). No module framework:
+    pjit sees exactly the arrays and shardings we declare.
+  - Layers are **stacked** along a leading axis and the forward pass is a
+    `lax.scan` over them: one compiled block body regardless of depth
+    (fast compiles), and the same stacked layout pipeline parallelism
+    wants.
+  - Each block is wrapped in `jax.checkpoint` when cfg.remat is set:
+    activations are recomputed in backward, trading MXU FLOPs (cheap) for
+    HBM (the scarce resource).
+  - Compute in bf16, master params and softmax/norm accumulation in fp32.
+
+The reference repo for this project is empty (SURVEY.md §0), so there is
+no upstream architecture to cite; this is the standard pre-norm rotary
+GQA decoder.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.ops.activations import softcap, swiglu
+from shellac_tpu.ops.attention import attention
+from shellac_tpu.ops.norms import rms_norm
+from shellac_tpu.ops.rope import apply_rope, rope_angles
+from shellac_tpu.parallel.sharding import constrain
+
+Params = Dict[str, Any]
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Initialize a parameter pytree (master copy, cfg.param_dtype)."""
+    cfg.validate()
+    if cfg.moe is not None:
+        raise NotImplementedError(
+            "MoE layers are not implemented yet; use models/moe once it lands"
+        )
+    pdt = cfg.params_dtype
+    d, h, hkv, dh, f = (
+        cfg.d_model, cfg.n_heads, cfg.kv_heads, cfg.dim_per_head, cfg.ff_dim,
+    )
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(key, shape, fan_in, scale=1.0):
+        std = scale * fan_in ** -0.5
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(pdt)
+
+    def layer(key):
+        ks = jax.random.split(key, 7)
+        # Residual-output projections scaled down GPT-2 style so the
+        # residual stream variance stays O(1) at depth.
+        out_scale = (2 * cfg.n_layers) ** -0.5
+        return {
+            "attn_norm": jnp.zeros((d,), pdt),
+            "wq": dense(ks[0], (d, h * dh), d),
+            "wk": dense(ks[1], (d, hkv * dh), d),
+            "wv": dense(ks[2], (d, hkv * dh), d),
+            "wo": dense(ks[3], (h * dh, d), h * dh, out_scale),
+            "mlp_norm": jnp.zeros((d,), pdt),
+            "w_gate": dense(ks[4], (d, f), d),
+            "w_up": dense(ks[5], (d, f), d),
+            "w_down": dense(ks[6], (f, d), f, out_scale),
+        }
+
+    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    params: Params = {
+        "embed": (jax.random.normal(k_embed, (cfg.vocab_size, d), jnp.float32)
+                  * 0.02).astype(pdt),
+        "layers": jax.vmap(layer)(layer_keys),
+        "final_norm": jnp.zeros((d,), pdt),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(k_head, (d, cfg.vocab_size), d)
+    return params
+
+
+def logical_axes(cfg: ModelConfig) -> Params:
+    """Pytree of logical axis names matching init_params' structure."""
+    la: Params = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", None),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", None),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": (None,),
+    }
+    if not cfg.tie_embeddings:
+        la["lm_head"] = ("embed", "vocab")
+    return la
+
+
+def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
+    """One pre-norm transformer block. x: (B, S, D) in compute dtype.
+
+    With `cache=(cache_k, cache_v, index, q_positions)` the block runs in
+    decode mode: new k/v are written at `index` and attention reads the
+    whole cache; returns (x, (new_cache_k, new_cache_v)). Without cache
+    it returns (x, None).
+    """
+    cdt = cfg.compute_dtype
+    b, s, d = x.shape
+    h, hkv, dh = cfg.n_heads, cfg.kv_heads, cfg.dim_per_head
+
+    # --- attention ---
+    hx = rms_norm(x, lp["attn_norm"], cfg.norm_eps).astype(cdt)
+    q = (hx @ lp["wq"].astype(cdt)).reshape(b, s, h, dh)
+    k = (hx @ lp["wk"].astype(cdt)).reshape(b, s, hkv, dh)
+    v = (hx @ lp["wv"].astype(cdt)).reshape(b, s, hkv, dh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    new_cache = None
+    if cache is None:
+        q = constrain(q, mesh, ("batch", "seq", "heads", None))
+        k = constrain(k, mesh, ("batch", "seq", "kv_heads", None))
+        v = constrain(v, mesh, ("batch", "seq", "kv_heads", None))
+        o = attention(q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl)
+    else:
+        from shellac_tpu.inference.kvcache import update_layer
+
+        cache_k, cache_v, index, q_positions = cache  # index: (B,)
+        cache_k, cache_v = update_layer(cache_k, cache_v, k, v, index)
+        new_cache = (cache_k, cache_v)
+        max_len = cache_k.shape[1]
+        kv_positions = jnp.broadcast_to(
+            jnp.arange(max_len, dtype=jnp.int32), (b, max_len)
+        )
+        kv_mask = kv_positions < (index[:, None] + s)
+        o = attention(
+            q, cache_k.astype(cdt), cache_v.astype(cdt),
+            causal=True, window=cfg.attn_window,
+            q_positions=q_positions, kv_positions=kv_positions,
+            kv_mask=kv_mask, impl="ref",
+        )
+    o = o.reshape(b, s, h * dh) @ lp["wo"].astype(cdt)
+    x = x + constrain(o, mesh, ("batch", "seq", None))
+
+    # --- mlp ---
+    hx = rms_norm(x, lp["mlp_norm"], cfg.norm_eps).astype(cdt)
+    gate = hx @ lp["w_gate"].astype(cdt)
+    up = hx @ lp["w_up"].astype(cdt)
+    gate = constrain(gate, mesh, ("batch", "seq", "mlp"))
+    up = constrain(up, mesh, ("batch", "seq", "mlp"))
+    down = swiglu(gate, up) @ lp["w_down"].astype(cdt)
+    x = x + constrain(down, mesh, ("batch", "seq", None))
+    return x, new_cache
+
+
+def forward(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # (B, S) int32
+    *,
+    positions: Optional[jax.Array] = None,  # (B, S) int32
+    mesh=None,
+    attn_impl: str = "auto",
+) -> jax.Array:
+    """Full forward pass; returns fp32 logits (B, S, V)."""
+    cdt = cfg.compute_dtype
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    cos, sin = rope_angles(positions, cfg.dim_per_head, cfg.rope_theta)
+
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+    x = constrain(x, mesh, ("batch", "seq", None))
+
+    block = functools.partial(_block, cfg, mesh, attn_impl)
+    if cfg.remat:
+        block = jax.checkpoint(block)
+
+    def scan_body(x, lp):
+        x, _ = block(x, lp, cos, sin)
+        return x, None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
+    if cfg.tie_embeddings:
+        w_out = params["embed"].astype(cdt).T
+    else:
+        w_out = params["lm_head"].astype(cdt)
+    logits = jnp.einsum("bsd,dv->bsv", x, w_out, preferred_element_type=jnp.float32)
+    if cfg.logit_softcap is not None:
+        logits = softcap(logits, cfg.logit_softcap)
+    logits = constrain(logits, mesh, ("batch", "seq", "vocab"))
+    return logits
+
+
+def forward_with_cache(
+    cfg: ModelConfig,
+    params: Params,
+    tokens: jax.Array,  # (B, S) int32 — new tokens only
+    cache,  # KVCache
+    *,
+    new_tokens_len: Optional[jax.Array] = None,  # (B,) — valid count in `tokens`
+    mesh=None,
+):
+    """Incremental forward: consumes `tokens` starting at cache.lengths.
+
+    Returns (logits (B, S, V) fp32, updated KVCache). Used for both
+    prefill (S = padded prompt length, empty cache, new_tokens_len =
+    actual prompt lengths) and decode (S = 1). Writes land at each
+    sequence's own length, so ragged batches decode with continuous
+    positions and pads never pollute later steps.
+    """
+    from shellac_tpu.inference.kvcache import KVCache
+
+    cdt = cfg.compute_dtype
+    b, s = tokens.shape
+    index = cache.lengths  # (B,)
+    positions = index[:, None] + jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32), (b, s)
+    )
+    cos, sin = rope_angles(positions, cfg.dim_per_head, cfg.rope_theta)
+
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+    x = constrain(x, mesh, ("batch", "seq", None))
+
+    def scan_body(x, layer_in):
+        lp, ck, cv = layer_in
+        x, new_cache = _block(
+            cfg, mesh, "ref", x, lp, cos, sin, cache=(ck, cv, index, positions)
+        )
+        return x, new_cache
+
+    x, (new_k, new_v) = jax.lax.scan(
+        scan_body, x, (params["layers"], cache.k, cache.v)
+    )
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
+    if cfg.tie_embeddings:
+        w_out = params["embed"].astype(cdt).T
+    else:
+        w_out = params["lm_head"].astype(cdt)
+    logits = jnp.einsum("bsd,dv->bsv", x, w_out, preferred_element_type=jnp.float32)
+    if cfg.logit_softcap is not None:
+        logits = softcap(logits, cfg.logit_softcap)
+    if new_tokens_len is None:
+        new_lengths = index + s
+    else:
+        new_lengths = index + new_tokens_len.astype(jnp.int32)
+    new_cache = KVCache(k=new_k, v=new_v, lengths=new_lengths)
+    return logits, new_cache
+
+
+def num_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))

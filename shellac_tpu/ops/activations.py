@@ -1,0 +1,21 @@
+"""Gated activations used by the MLP blocks."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu(gate: jax.Array, up: jax.Array) -> jax.Array:
+    """SwiGLU combine: silu(gate) * up. XLA fuses this into the matmul."""
+    return jax.nn.silu(gate) * up
+
+
+def geglu(gate: jax.Array, up: jax.Array) -> jax.Array:
+    return jax.nn.gelu(gate) * up
+
+
+def softcap(x: jax.Array, cap: float) -> jax.Array:
+    """Soft logit cap: cap * tanh(x / cap), computed in fp32."""
+    x32 = x.astype(jnp.float32)
+    return (cap * jnp.tanh(x32 / cap)).astype(x.dtype)

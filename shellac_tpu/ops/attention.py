@@ -1,0 +1,130 @@
+"""Attention ops.
+
+`attention(...)` is the single entry point used by every model. It
+dispatches between:
+  - `attention_ref`: einsum + fp32 softmax. XLA already maps this onto the
+    MXU and fuses the mask/softmax; it is the correctness reference and
+    the CPU path.
+  - `flash_attention` (ops/flash_attention.py): blocked Pallas TPU kernel
+    with online softmax, used on TPU for long sequences.
+
+Layout convention everywhere: q (B, Sq, H, D); k, v (B, Sk, Hkv, D) with
+grouped-query attention when Hkv < H. Softmax/logits are always fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0e38
+
+
+def _build_mask(
+    q_positions: jax.Array,  # (B, Sq) int32
+    kv_positions: jax.Array,  # (B, Sk) int32
+    causal: bool,
+    window: Optional[int],
+    kv_mask: Optional[jax.Array],  # (B, Sk) bool — valid kv slots
+) -> Optional[jax.Array]:
+    """Boolean (B, 1, Sq, Sk) mask; True = attend."""
+    parts = []
+    qp = q_positions[:, :, None]  # (B, Sq, 1)
+    kp = kv_positions[:, None, :]  # (B, 1, Sk)
+    if causal:
+        parts.append(kp <= qp)
+    if window is not None:
+        parts.append(qp - kp < window)
+    if kv_mask is not None:
+        parts.append(kv_mask[:, None, :])
+    if not parts:
+        return None
+    mask = parts[0]
+    for p in parts[1:]:
+        mask = jnp.logical_and(mask, p)
+    return mask[:, None, :, :]  # add heads axis
+
+
+def attention_ref(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+    kv_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reference scaled-dot-product attention with GQA."""
+    b, sq, h, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if h % hkv != 0:
+        raise ValueError(f"n_heads={h} not divisible by n_kv_heads={hkv}")
+    g = h // hkv
+    if scale is None:
+        scale = d ** -0.5
+    if q_positions is None:
+        # Assume q is the tail of the kv sequence (prefill: sq == sk).
+        q_positions = jnp.broadcast_to(jnp.arange(sk - sq, sk, dtype=jnp.int32), (b, sq))
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
+
+    qg = q.reshape(b, sq, hkv, g, d)
+    # (B, Hkv, G, Sq, Sk) logits in fp32.
+    logits = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    mask = _build_mask(q_positions, kv_positions, causal, window, kv_mask)
+    if mask is not None:
+        logits = jnp.where(mask[:, :, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, sq, h, d).astype(q.dtype)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+    kv_mask: Optional[jax.Array] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatching attention. impl: "auto" | "flash" | "ref"."""
+    if impl == "ref":
+        return attention_ref(
+            q, k, v, causal=causal, window=window, scale=scale,
+            q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
+        )
+    from shellac_tpu.ops.flash_attention import flash_attention, flash_supported
+
+    if impl == "flash":
+        if window is not None or q_positions is not None or kv_positions is not None \
+                or kv_mask is not None:
+            raise ValueError(
+                "impl='flash' does not support window/q_positions/kv_positions/"
+                "kv_mask; use impl='auto' or 'ref'"
+            )
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "auto" and flash_supported(
+        q, k, v, window=window, q_positions=q_positions,
+        kv_positions=kv_positions, kv_mask=kv_mask, causal=causal,
+    ):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return attention_ref(
+        q, k, v, causal=causal, window=window, scale=scale,
+        q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
+    )

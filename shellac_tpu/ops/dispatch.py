@@ -1,0 +1,17 @@
+"""Backend dispatch helpers for ops with both Pallas and XLA paths."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.cache
+def default_backend() -> str:
+    return jax.default_backend()
+
+
+def pallas_supported() -> bool:
+    """True when compiled (non-interpret) Pallas TPU kernels can run."""
+    return default_backend() == "tpu"

@@ -1,0 +1,212 @@
+"""Blocked (flash) attention as a Pallas TPU kernel.
+
+Forward: classic online-softmax tiling. Grid is (batch*heads, q_blocks,
+kv_blocks); the kv axis is innermost, so fp32 accumulators live in VMEM
+scratch across kv steps. Causal upper-triangle blocks are skipped
+entirely (no compute), which halves the work for causal prefill. GQA is
+handled in the index map: the kv block for q-head h is head h // group,
+so kv tiles are never replicated in HBM.
+
+Backward: custom VJP that recomputes through the einsum reference. This
+is correct and rematerialization-friendly (the model already wraps blocks
+in jax.checkpoint); a blocked Pallas backward is a planned optimization.
+
+The compiled kernel wants lane-aligned head_dim (multiple of 128) and
+block-divisible sequence lengths; `flash_supported` gates dispatch and
+everything else falls back to the reference implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from shellac_tpu.ops.dispatch import pallas_supported
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+NEG_INF = -2.0e38
+
+
+def flash_supported(
+    q, k, v, *, causal, window=None, q_positions=None, kv_positions=None,
+    kv_mask=None, block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+) -> bool:
+    """Can the compiled Pallas kernel handle this call?"""
+    if not pallas_supported():
+        return False
+    if window is not None or q_positions is not None or kv_positions is not None:
+        return False
+    if kv_mask is not None:
+        return False
+    b, sq, h, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if sq != sk or not causal:
+        # The kernel itself supports non-causal; restrict dispatch to the
+        # training prefill shape we have test coverage for.
+        return False
+    if d % 128 != 0:
+        return False
+    if sq % min(block_q, sq) != 0 or sk % min(block_k, sk) != 0:
+        return False
+    if h % hkv != 0:
+        return False
+    return True
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale: float, causal: bool, block_q: int, block_k: int, num_kv: int,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    if causal:
+        # Last kv block this q block attends to (where the output write
+        # happens); later blocks are skipped entirely.
+        last_ki = jnp.minimum(num_kv - 1, (q_start + block_q - 1) // block_k)
+        live = k_start <= q_start + block_q - 1
+    else:
+        last_ki = num_kv - 1
+        live = True
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(cols <= rows, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]  # (block_q, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        l = l_ref[:, :1]
+        # Guard fully-masked rows (can't happen for causal, cheap anyway).
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    _, sk, hkv, _ = k.shape
+    g = h // hkv
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    num_q = sq // block_q
+    num_kv = sk // block_k
+
+    # (B, S, H, D) -> (B*H, S, D)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+
+    def kv_index(bh, qi, ki):
+        kv_bh = (bh // h) * hkv + (bh % h) // g
+        if causal:
+            # Clamp dead upper-triangle blocks to the diagonal block: the
+            # Mosaic pipeline only issues a DMA when the block index
+            # changes, so compute-skipped blocks cost no HBM bandwidth.
+            ki = jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k)
+        return kv_bh, ki, 0
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            scale=scale,
+            causal=causal,
+            block_q=block_q,
+            block_k=block_k,
+            num_kv=num_kv,
+        ),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g_out):
+    from shellac_tpu.ops.attention import attention_ref
+
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_ref(q_, k_, v_, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g_out)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q, k, v, *, causal: bool = True, scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Flash attention. q (B,S,H,D); k,v (B,S,Hkv,D)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = not pallas_supported()
+    return _flash(q, k, v, causal, float(scale), block_q, block_k, interpret)

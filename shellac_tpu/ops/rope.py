@@ -1,0 +1,43 @@
+"""Rotary position embeddings (non-interleaved / NeoX half-rotation form).
+
+Frequencies are computed in fp32 regardless of compute dtype: bf16 loses
+precision at long positions, which shows up as attention drift past ~8k
+tokens.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jax.Array, head_dim: int, theta: float = 10000.0):
+    """cos/sin tables for given absolute positions.
+
+    positions: int32 array, any shape (typically (B, S) or (S,)).
+    Returns (cos, sin) with shape positions.shape + (head_dim // 2,), fp32.
+    """
+    half = head_dim // 2
+    freq = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    ang = positions.astype(jnp.float32)[..., None] * freq
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate the head dimension of x.
+
+    x: (..., S, H, D). cos/sin: broadcastable to (..., S, 1, D/2) — e.g.
+    shape (B, S, D/2) or (S, D/2).
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    # Insert the heads axis for broadcasting.
+    c = cos[..., None, :]
+    s = sin[..., None, :]
+    x1f = x1.astype(jnp.float32)
+    x2f = x2.astype(jnp.float32)
+    out1 = x1f * c - x2f * s
+    out2 = x2f * c + x1f * s
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

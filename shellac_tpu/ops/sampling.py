@@ -1,0 +1,54 @@
+"""Token sampling: temperature, top-k, nucleus (top-p), greedy.
+
+All filtering happens in fp32 logit space with jnp.where masks — no
+data-dependent shapes, so the whole sampler jits into the decode loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def top_k_mask(logits: jax.Array, k: int) -> jax.Array:
+    """Mask all but the k largest logits per row."""
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def top_p_mask(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filtering: keep the smallest set with cumulative prob >= p."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep entries whose *previous* cumulative mass is < p (always keeps top-1).
+    keep_sorted = (cum - probs) < p
+    # Threshold logit = smallest kept logit.
+    kth = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def sample(
+    key: jax.Array,
+    logits: jax.Array,  # (..., V)
+    *,
+    temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+) -> jax.Array:
+    """Sample token ids. temperature == 0 means greedy."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        logits = top_k_mask(logits, top_k)
+    if top_p is not None:
+        logits = top_p_mask(logits, top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

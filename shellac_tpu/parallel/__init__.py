@@ -1,0 +1,33 @@
+from shellac_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    MESH_AXES,
+    factor_devices,
+    make_mesh,
+)
+from shellac_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    constrain,
+    logical_to_spec,
+    make_shardings,
+    shard_pytree,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_FSDP",
+    "AXIS_PIPE",
+    "AXIS_SEQ",
+    "AXIS_TENSOR",
+    "MESH_AXES",
+    "make_mesh",
+    "factor_devices",
+    "DEFAULT_RULES",
+    "logical_to_spec",
+    "make_shardings",
+    "shard_pytree",
+    "constrain",
+]

@@ -1,0 +1,79 @@
+"""Device-mesh construction.
+
+TPU-first: parallelism is expressed as a `jax.sharding.Mesh` with named
+axes; XLA's GSPMD partitioner inserts the collectives (all-reduce,
+all-gather, reduce-scatter, collective-permute) that ride ICI. Nothing in
+this module moves data itself.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from shellac_tpu.config import ParallelConfig
+
+# Canonical mesh-axis names, outermost first. dp/fsdp tolerate the slower
+# (DCN) links; sp/tp want the fastest (ICI) links, so they are innermost.
+AXIS_DATA = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_SEQ = "sp"
+AXIS_TENSOR = "tp"
+AXIS_PIPE = "pp"
+
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR)
+
+
+def make_mesh(
+    parallel: Optional[ParallelConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the global device mesh for a ParallelConfig.
+
+    If `parallel` is None, all devices are assigned to the fsdp axis (a
+    sensible single-slice default: ZeRO-3 with no extra communication
+    tuning needed).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if parallel is None:
+        parallel = ParallelConfig(fsdp=n)
+    if parallel.num_devices != n:
+        raise ValueError(
+            f"ParallelConfig asks for {parallel.num_devices} devices "
+            f"(dp={parallel.dp} fsdp={parallel.fsdp} pp={parallel.pp} "
+            f"sp={parallel.sp} tp={parallel.tp}) but {n} are available"
+        )
+    shape = (parallel.dp, parallel.fsdp, parallel.pp, parallel.sp, parallel.tp)
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
+    except Exception:
+        # mesh_utils optimizes for physical topology; fall back to a plain
+        # reshape when it cannot (e.g. virtual CPU devices).
+        dev_array = np.asarray(list(devices)).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def factor_devices(n: int) -> ParallelConfig:
+    """Pick a reasonable multi-axis factorization of `n` devices.
+
+    Used by dry-run tooling to exercise real dp/fsdp/sp/tp shardings on a
+    virtual mesh: spread powers of two across tp, sp, fsdp, dp in that
+    order; any odd remainder lands on dp.
+    """
+    sizes = {"tp": 1, "sp": 1, "fsdp": 1, "dp": 1}
+    remaining = n
+    for axis in ("tp", "sp", "fsdp"):
+        if remaining % 2 == 0 and remaining > 1:
+            sizes[axis] = 2
+            remaining //= 2
+    sizes["dp"] = remaining
+    return ParallelConfig(
+        dp=sizes["dp"], fsdp=sizes["fsdp"], sp=sizes["sp"], tp=sizes["tp"]
+    )

@@ -1,0 +1,102 @@
+"""Logical-axis sharding rules.
+
+Every parameter and activation carries *logical* axis names (e.g.
+("layers", "embed", "mlp")); a rule table maps logical names to mesh axes.
+This is the GSPMD idiom: annotate shardings, let XLA insert collectives.
+Changing the parallelism strategy is a rule-table edit, not a model edit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shellac_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+)
+
+LogicalAxes = Tuple[Optional[str], ...]
+
+# logical axis name -> mesh axis (or tuple of mesh axes, or None=replicated)
+DEFAULT_RULES: Tuple[Tuple[str, Union[None, str, Tuple[str, ...]]], ...] = (
+    # activations
+    ("batch", (AXIS_DATA, AXIS_FSDP)),
+    ("seq", AXIS_SEQ),
+    ("kv_seq", AXIS_SEQ),
+    # parameters
+    ("vocab", AXIS_TENSOR),
+    ("embed", AXIS_FSDP),
+    ("heads", AXIS_TENSOR),
+    ("kv_heads", AXIS_TENSOR),
+    ("head_dim", None),
+    ("mlp", AXIS_TENSOR),
+    ("experts", AXIS_FSDP),
+    ("layers", None),
+    ("stages", None),
+)
+
+
+def rules_dict(rules=DEFAULT_RULES):
+    return dict(rules)
+
+
+def logical_to_spec(axes: Sequence[Optional[str]], rules=DEFAULT_RULES) -> P:
+    """Translate logical axis names into a PartitionSpec via the rule table."""
+    table = dict(rules)
+    spec = []
+    used = set()
+    for name in axes:
+        if name is None:
+            spec.append(None)
+            continue
+        mesh_axes = table.get(name)
+        if mesh_axes is None:
+            spec.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        # A mesh axis may appear at most once in a PartitionSpec; drop
+        # repeats (e.g. both "embed" and "mlp" map to axes already used).
+        fresh = tuple(a for a in mesh_axes if a not in used)
+        used.update(fresh)
+        if not fresh:
+            spec.append(None)
+        elif len(fresh) == 1:
+            spec.append(fresh[0])
+        else:
+            spec.append(fresh)
+    return P(*spec)
+
+
+def make_shardings(mesh: Mesh, logical_tree, rules=DEFAULT_RULES):
+    """Map a pytree of logical-axes tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(a is None or isinstance(a, str) for a in x),
+    )
+
+
+def constrain(x, mesh: Optional[Mesh], axes: Sequence[Optional[str]], rules=DEFAULT_RULES):
+    """`with_sharding_constraint` by logical axis names; no-op without a mesh.
+
+    Keeping this a no-op when mesh is None lets the same model code run
+    un-sharded (unit tests, single chip) and sharded (pjit over a mesh).
+    """
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, logical_to_spec(axes, rules))
+    )
+
+
+def shard_pytree(tree, mesh: Mesh, logical_tree, rules=DEFAULT_RULES):
+    """Device-put a pytree according to its logical axes."""
+    shardings = make_shardings(mesh, logical_tree, rules)
+    return jax.device_put(tree, shardings)

@@ -1,0 +1,20 @@
+from shellac_tpu.training.losses import cross_entropy
+from shellac_tpu.training.optimizer import make_optimizer, make_schedule
+from shellac_tpu.training.train_state import TrainState, state_shardings, state_specs
+from shellac_tpu.training.trainer import (
+    batch_shardings,
+    init_train_state,
+    make_train_step,
+)
+
+__all__ = [
+    "cross_entropy",
+    "make_optimizer",
+    "make_schedule",
+    "TrainState",
+    "state_shardings",
+    "state_specs",
+    "init_train_state",
+    "make_train_step",
+    "batch_shardings",
+]

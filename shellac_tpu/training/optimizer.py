@@ -1,0 +1,52 @@
+"""Optimizer and LR schedule construction (optax).
+
+Optimizer state pytrees mirror the parameter pytree, so the same logical
+axis rules shard first/second moments ZeRO-style for free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+from shellac_tpu.config import TrainConfig
+
+
+def make_schedule(cfg: TrainConfig) -> optax.Schedule:
+    """Linear warmup then cosine decay to min_lr_ratio * peak."""
+    warmup = optax.linear_schedule(
+        init_value=0.0, end_value=cfg.learning_rate,
+        transition_steps=max(cfg.warmup_steps, 1),
+    )
+    decay = optax.cosine_decay_schedule(
+        init_value=cfg.learning_rate,
+        decay_steps=max(cfg.total_steps - cfg.warmup_steps, 1),
+        alpha=cfg.min_lr_ratio,
+    )
+    return optax.join_schedules([warmup, decay], [cfg.warmup_steps])
+
+
+def _decay_mask(params):
+    """Weight decay only on matrices; norm scales and biases exempt.
+
+    Stacked per-layer norm scales have shape (n_layers, d), so ndim alone
+    cannot distinguish them — exempt anything whose path names a norm.
+    """
+    import jax
+
+    def mask(path, p):
+        names = [str(getattr(e, "key", e)) for e in path]
+        if any("norm" in n for n in names):
+            return False
+        return p.ndim >= 2
+
+    return jax.tree_util.tree_map_with_path(mask, params)
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        optax.scale_by_adam(b1=cfg.b1, b2=cfg.b2, eps=cfg.eps),
+        optax.add_decayed_weights(cfg.weight_decay, mask=_decay_mask),
+        optax.scale_by_learning_rate(make_schedule(cfg)),
+    )

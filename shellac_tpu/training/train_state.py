@@ -1,0 +1,73 @@
+"""TrainState pytree and sharding inference for the full optimizer state.
+
+Optimizer moments (adam mu/nu) mirror the parameter pytree, so their
+shardings are derived by *path-suffix matching* against the parameter
+logical-axes tree: any state leaf whose tree path ends with a parameter's
+path inherits that parameter's PartitionSpec; everything else (step
+counters, scalars) is replicated. This keeps ZeRO-style optimizer
+sharding automatic for any optax chain.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shellac_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: Any
+    params: Any
+    opt_state: Any
+
+
+def _key_str(entry) -> str:
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def _is_axes_leaf(x) -> bool:
+    return isinstance(x, tuple) and all(a is None or isinstance(a, str) for a in x)
+
+
+def state_specs(abstract_state, param_axes, rules=DEFAULT_RULES):
+    """PartitionSpec pytree for a TrainState (or any state pytree).
+
+    abstract_state: jax.eval_shape of the state.
+    param_axes: logical-axes pytree for the *params* subtree.
+    """
+    flat_axes = jax.tree_util.tree_flatten_with_path(
+        param_axes, is_leaf=_is_axes_leaf
+    )[0]
+    by_path = {
+        tuple(_key_str(e) for e in path): axes for path, axes in flat_axes
+    }
+
+    def spec_for(path, leaf):
+        names = tuple(_key_str(e) for e in path)
+        for plen in range(len(names), 0, -1):
+            suffix = names[-plen:]
+            if suffix in by_path:
+                axes = by_path[suffix]
+                if len(axes) == getattr(leaf, "ndim", len(axes)):
+                    return logical_to_spec(axes, rules)
+        return P()
+
+    flat_state, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
+    specs = [spec_for(path, leaf) for path, leaf in flat_state]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def state_shardings(mesh: Mesh, abstract_state, param_axes, rules=DEFAULT_RULES):
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        state_specs(abstract_state, param_axes, rules),
+        is_leaf=lambda x: isinstance(x, P),
+    )

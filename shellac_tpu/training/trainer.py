@@ -1,0 +1,163 @@
+"""Training step construction.
+
+`make_train_step` returns a jitted step with donated state; under a mesh
+the state/batch shardings are attached so XLA partitions the whole step
+(forward, backward, optimizer) and inserts collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shellac_tpu.config import ModelConfig, TrainConfig
+from shellac_tpu.models import transformer
+from shellac_tpu.training.losses import cross_entropy
+from shellac_tpu.training.optimizer import make_optimizer
+from shellac_tpu.training.train_state import TrainState, state_shardings
+from shellac_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+
+def batch_shardings(mesh: Mesh, rules=DEFAULT_RULES):
+    """Sharding for {"inputs","targets","mask"}: batch over dp/fsdp, seq over sp."""
+    spec = logical_to_spec(("batch", "seq"), rules)
+    return NamedSharding(mesh, spec)
+
+
+def init_train_state(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    key: jax.Array,
+    mesh: Optional[Mesh] = None,
+) -> TrainState:
+    optimizer = make_optimizer(train_cfg)
+
+    def init_fn(key):
+        params = transformer.init_params(model_cfg, key)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+        )
+
+    if mesh is None:
+        return jax.jit(init_fn)(key)
+    abstract = jax.eval_shape(init_fn, key)
+    shardings = state_shardings(mesh, abstract, transformer.logical_axes(model_cfg))
+    return jax.jit(init_fn, out_shardings=shardings)(key)
+
+
+def make_train_step(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    mesh: Optional[Mesh] = None,
+    attn_impl: str = "auto",
+    jit: bool = True,
+):
+    """Build `train_step(state, batch) -> (state, metrics)`.
+
+    batch: {"inputs": (B,S) i32, "targets": (B,S) i32, "mask": (B,S) f32?}.
+    With grad_accum > 1 the leading batch dim is split into microbatches
+    scanned sequentially, accumulating grads in fp32.
+    """
+    optimizer = make_optimizer(train_cfg)
+    accum = train_cfg.grad_accum
+
+    def loss_fn(params, batch):
+        logits = transformer.forward(
+            model_cfg, params, batch["inputs"], mesh=mesh, attn_impl=attn_impl
+        )
+        return cross_entropy(
+            logits, batch["targets"], batch.get("mask"), train_cfg.z_loss_weight
+        )
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def compute_grads(params, batch):
+        if accum <= 1:
+            (_, metrics), grads = grad_fn(params, batch)
+            return grads, metrics
+
+        def micro(carry, mb):
+            grads_acc, metrics_acc = carry
+            (_, metrics), grads = grad_fn(params, mb)
+            grads_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
+            )
+            metrics_acc = jax.tree.map(lambda a, m: a + m, metrics_acc, metrics)
+            return (grads_acc, metrics_acc), None
+
+        mbs = jax.tree.map(
+            lambda x: x.reshape(accum, x.shape[0] // accum, *x.shape[1:]), batch
+        )
+        zero_grads = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        zero_metrics = {
+            "loss": jnp.zeros((), jnp.float32),
+            "perplexity": jnp.zeros((), jnp.float32),
+            "tokens": jnp.zeros((), jnp.float32),
+        }
+        (grads, metrics), _ = jax.lax.scan(micro, (zero_grads, zero_metrics), mbs)
+        inv = 1.0 / accum
+        grads = jax.tree.map(lambda g: g * inv, grads)
+        metrics = jax.tree.map(lambda m: m * inv, metrics)
+        metrics["tokens"] = metrics["tokens"] * accum
+        return grads, metrics
+
+    def train_step(state: TrainState, batch):
+        grads, metrics = compute_grads(state.params, batch)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        return new_state, metrics
+
+    if not jit:
+        return train_step
+
+    if mesh is None:
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    # Attach explicit shardings so the compiled step is fully partitioned.
+    def jit_with_shardings(state, batch):
+        abstract_state = jax.eval_shape(lambda s: s, state)
+        param_axes = transformer.logical_axes(model_cfg)
+        st_sh = state_shardings(mesh, abstract_state, param_axes)
+        b_sh = batch_shardings(mesh)
+        batch_in = jax.tree.map(lambda _: b_sh, batch)
+        return jax.jit(
+            train_step,
+            in_shardings=(st_sh, batch_in),
+            out_shardings=(st_sh, None),
+            donate_argnums=(0,),
+        )
+
+    return _LazyShardedStep(jit_with_shardings)
+
+
+class _LazyShardedStep:
+    """Defers jit-with-shardings until the first call, when the concrete
+    state/batch structure (which depends on the optax chain) is known."""
+
+    def __init__(self, build):
+        self._build = build
+        self._jitted = None
+
+    def __call__(self, state, batch):
+        if self._jitted is None:
+            self._jitted = self._build(state, batch)
+        return self._jitted(state, batch)
+
+    def lower(self, state, batch):
+        return self._build(state, batch).lower(state, batch)

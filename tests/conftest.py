@@ -1,0 +1,35 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The sandbox's sitecustomize registers the axon TPU plugin and imports jax
+at interpreter startup, so env vars (JAX_PLATFORMS / XLA_FLAGS) are too
+late — the platform must be overridden through jax.config before any
+backend is initialized. conftest runs before test modules import
+anything, which is early enough.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from shellac_tpu import ParallelConfig, make_mesh
+
+    return make_mesh(ParallelConfig(dp=2, fsdp=1, sp=2, tp=2))
+
+
+@pytest.fixture(scope="session")
+def mesh_fsdp8():
+    from shellac_tpu import ParallelConfig, make_mesh
+
+    return make_mesh(ParallelConfig(fsdp=8))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,145 @@
+"""Inference-path tests: cache parity with full forward, sampling, engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference import Engine, init_cache
+from shellac_tpu.models import transformer
+from shellac_tpu.ops.sampling import sample, top_k_mask, top_p_mask
+
+
+def _cfg():
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+class TestCachedForward:
+    def test_prefill_matches_full_forward(self):
+        cfg = _cfg()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size)
+        full = transformer.forward(cfg, params, tokens)
+        cache = init_cache(cfg, 2, 32)
+        cached, cache = transformer.forward_with_cache(cfg, params, tokens, cache)
+        np.testing.assert_allclose(
+            np.asarray(full), np.asarray(cached), rtol=1e-4, atol=1e-5
+        )
+        assert np.all(np.asarray(cache.lengths) == 12)
+
+    def test_incremental_decode_matches_full(self):
+        """Prefill + token-by-token decode == one full forward pass."""
+        cfg = _cfg()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 10), 0, cfg.vocab_size)
+        full = transformer.forward(cfg, params, tokens)
+
+        cache = init_cache(cfg, 1, 16)
+        _, cache = transformer.forward_with_cache(cfg, params, tokens[:, :4], cache)
+        outs = []
+        for i in range(4, 10):
+            logits, cache = transformer.forward_with_cache(
+                cfg, params, tokens[:, i : i + 1], cache
+            )
+            outs.append(logits[:, 0])
+        got = jnp.stack(outs, axis=1)
+        np.testing.assert_allclose(
+            np.asarray(full[:, 4:]), np.asarray(got), rtol=1e-4, atol=1e-4
+        )
+
+    def test_ragged_prefill_matches_per_sequence(self):
+        """Right-padded ragged batch decodes like each sequence alone."""
+        cfg = _cfg()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        t_short = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0, cfg.vocab_size)
+        pad = jnp.zeros((1, 3), jnp.int32)
+        batch_tokens = jnp.concatenate(
+            [jnp.concatenate([t_short, pad], 1), jnp.zeros((1, 8), jnp.int32)], 0
+        )
+        batch_tokens = batch_tokens.at[1].set(
+            jax.random.randint(jax.random.PRNGKey(2), (8,), 0, cfg.vocab_size)
+        )
+        lengths = jnp.array([5, 8], jnp.int32)
+
+        cache = init_cache(cfg, 2, 16)
+        logits, cache = transformer.forward_with_cache(
+            cfg, params, batch_tokens, cache, new_tokens_len=lengths
+        )
+        # Sequence 0's logits at its last real position must match the
+        # unbatched forward of just its 5 tokens.
+        solo = transformer.forward(cfg, params, t_short)
+        np.testing.assert_allclose(
+            np.asarray(logits[0, 4]), np.asarray(solo[0, 4]), rtol=1e-4, atol=1e-4
+        )
+        # Decode one step for both: seq 0 writes at slot 5 (over pad).
+        nxt = jnp.array([[3], [7]], jnp.int32)
+        logits2, cache = transformer.forward_with_cache(cfg, params, nxt, cache)
+        solo2 = transformer.forward(
+            cfg, params, jnp.concatenate([t_short, nxt[:1]], 1)
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits2[0, 0]), np.asarray(solo2[0, 5]), rtol=1e-4, atol=1e-4
+        )
+        assert np.asarray(cache.lengths).tolist() == [6, 9]
+
+
+class TestSampling:
+    def test_greedy(self):
+        logits = jnp.array([[1.0, 3.0, 2.0]])
+        tok = sample(jax.random.PRNGKey(0), logits, temperature=0.0)
+        assert int(tok[0]) == 1
+
+    def test_top_k_masks_rest(self):
+        logits = jnp.array([[1.0, 5.0, 3.0, 2.0]])
+        masked = top_k_mask(logits, 2)
+        assert np.asarray(masked[0, [0, 3]] < -1e29).all()
+        np.testing.assert_allclose(np.asarray(masked[0, [1, 2]]), [5.0, 3.0])
+
+    def test_top_p_keeps_top1(self):
+        logits = jnp.array([[0.0, 10.0, 0.0]])
+        masked = top_p_mask(logits, 0.1)
+        assert float(masked[0, 1]) == 10.0
+        assert np.asarray(masked[0, [0, 2]] < -1e29).all()
+
+    def test_top_p_keeps_mass(self):
+        logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]]))
+        masked = top_p_mask(logits, 0.8)
+        keep = np.asarray(masked[0] > -1e29)
+        assert keep.tolist() == [True, True, False, False]
+
+    def test_sampling_distribution(self):
+        logits = jnp.log(jnp.array([0.7, 0.2, 0.1]))
+        keys = jax.random.split(jax.random.PRNGKey(0), 2000)
+        toks = jax.vmap(lambda k: sample(k, logits))(keys)
+        freq = np.bincount(np.asarray(toks), minlength=3) / 2000
+        np.testing.assert_allclose(freq, [0.7, 0.2, 0.1], atol=0.05)
+
+
+class TestEngine:
+    def test_generate_shapes(self):
+        cfg = _cfg()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = Engine(cfg, params, max_len=64, temperature=1.0, top_k=50)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+        res = eng.generate(prompt, max_new_tokens=5, key=jax.random.PRNGKey(2))
+        assert res.tokens.shape == (2, 5)
+        assert res.logprobs.shape == (2, 5)
+        assert np.all(np.asarray(res.logprobs) <= 0)
+
+    def test_greedy_matches_argmax_forward(self):
+        """Greedy engine output == repeated argmax over full forwards."""
+        cfg = _cfg()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = Engine(cfg, params, max_len=32, temperature=0.0)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0, cfg.vocab_size)
+        res = eng.generate(prompt, max_new_tokens=4, key=jax.random.PRNGKey(2))
+
+        toks = prompt
+        want = []
+        for _ in range(4):
+            logits = transformer.forward(cfg, params, toks)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            want.append(int(nxt[0]))
+            toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        assert np.asarray(res.tokens)[0].tolist() == want

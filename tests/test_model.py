@@ -1,0 +1,81 @@
+"""Model-level tests: shapes, causality, dtype policy, determinism."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shellac_tpu import get_model_config
+from shellac_tpu.models import transformer
+
+
+def _tiny(**kw):
+    return get_model_config("tiny").replace(dtype="float32", **kw)
+
+
+class TestTransformer:
+    def test_shapes_and_dtype(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = transformer.forward(cfg, params, tokens)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self):
+        """Changing a future token must not change past logits."""
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(1)
+        tokens = jax.random.randint(key, (1, 16), 0, cfg.vocab_size)
+        logits1 = transformer.forward(cfg, params, tokens)
+        tokens2 = tokens.at[0, 10].set((tokens[0, 10] + 1) % cfg.vocab_size)
+        logits2 = transformer.forward(cfg, params, tokens2)
+        np.testing.assert_allclose(
+            np.asarray(logits1[0, :10]), np.asarray(logits2[0, :10]), atol=1e-5
+        )
+        assert not np.allclose(np.asarray(logits1[0, 10:]), np.asarray(logits2[0, 10:]))
+
+    def test_deterministic(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.ones((1, 8), jnp.int32)
+        l1 = transformer.forward(cfg, params, tokens)
+        l2 = transformer.forward(cfg, params, tokens)
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+    def test_gqa_config(self):
+        cfg = get_model_config("tiny-gqa").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        assert params["layers"]["wk"].shape == (
+            cfg.n_layers, cfg.d_model, cfg.kv_heads * cfg.dim_per_head
+        )
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        logits = transformer.forward(cfg, params, tokens)
+        assert logits.shape == (1, 8, cfg.vocab_size)
+
+    def test_remat_same_output(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.ones((1, 8), jnp.int32)
+        l1 = transformer.forward(cfg, params, tokens)
+        l2 = transformer.forward(cfg.replace(remat=True), params, tokens)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-6)
+
+    def test_untied_head(self):
+        cfg = _tiny(tie_embeddings=False)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        assert "lm_head" in params
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        assert transformer.forward(cfg, params, tokens).shape == (1, 8, cfg.vocab_size)
+
+    def test_logical_axes_match_params(self):
+        cfg = _tiny(tie_embeddings=False)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        axes = transformer.logical_axes(cfg)
+        flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+        flat_a = jax.tree_util.tree_flatten_with_path(
+            axes, is_leaf=lambda x: isinstance(x, tuple)
+        )[0]
+        paths_p = {tuple(str(k) for k in path): leaf.ndim for path, leaf in flat_p}
+        paths_a = {tuple(str(k) for k in path): len(leaf) for path, leaf in flat_a}
+        assert paths_p == paths_a

@@ -1,0 +1,197 @@
+"""Unit tests for core ops against numpy/reference implementations."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu.ops.activations import softcap, swiglu
+from shellac_tpu.ops.attention import attention, attention_ref
+from shellac_tpu.ops.flash_attention import flash_attention
+from shellac_tpu.ops.norms import rms_norm_pallas, rms_norm_ref
+from shellac_tpu.ops.rope import apply_rope, rope_angles
+
+
+class TestRMSNorm:
+    def test_matches_numpy(self):
+        x = np.random.default_rng(0).normal(size=(4, 8, 64)).astype(np.float32)
+        scale = np.random.default_rng(1).normal(size=(64,)).astype(np.float32) * 0.1
+        got = rms_norm_ref(jnp.asarray(x), jnp.asarray(scale), 1e-5)
+        want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-5) * (1 + scale)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+    def test_pallas_matches_ref(self):
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(3, 7, 128)).astype(np.float32)
+        )
+        scale = jnp.asarray(
+            np.random.default_rng(1).normal(size=(128,)).astype(np.float32) * 0.1
+        )
+        got = rms_norm_pallas(x, scale, 1e-5, True)  # interpret mode
+        want = rms_norm_ref(x, scale, 1e-5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    def test_pallas_grad_matches_ref(self):
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(2, 4, 128)).astype(np.float32)
+        )
+        scale = jnp.zeros((128,), jnp.float32)
+
+        g1 = jax.grad(lambda x_, s: rms_norm_pallas(x_, s, 1e-5, True).sum(), argnums=(0, 1))(x, scale)
+        g2 = jax.grad(lambda x_, s: rms_norm_ref(x_, s, 1e-5).sum(), argnums=(0, 1))(x, scale)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(2, 8, 4, 32)).astype(np.float32)
+        )
+        pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (2, 8))
+        cos, sin = rope_angles(pos, 32)
+        y = apply_rope(x, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-4,
+        )
+
+    def test_position_zero_is_identity(self):
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(size=(1, 1, 2, 16)).astype(np.float32)
+        )
+        pos = jnp.zeros((1, 1), jnp.int32)
+        cos, sin = rope_angles(pos, 16)
+        np.testing.assert_allclose(np.asarray(apply_rope(x, cos, sin)), np.asarray(x), rtol=1e-6)
+
+    def test_relative_property(self):
+        # <rope(q,m), rope(k,n)> depends only on m-n.
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(1, 1, 1, 32)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 1, 1, 32)).astype(np.float32))
+
+        def dot_at(m, n):
+            cm, sm = rope_angles(jnp.array([[m]], jnp.int32), 32)
+            cn, sn = rope_angles(jnp.array([[n]], jnp.int32), 32)
+            return float(jnp.sum(apply_rope(q, cm, sm) * apply_rope(k, cn, sn)))
+
+        assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-4)
+
+
+class TestAttention:
+    def _naive(self, q, k, v, causal=True):
+        b, s, h, d = q.shape
+        out = np.zeros_like(q)
+        for bi in range(b):
+            for hi in range(h):
+                logits = q[bi, :, hi] @ k[bi, :, hi].T / np.sqrt(d)
+                if causal:
+                    mask = np.tril(np.ones((s, s), bool))
+                    logits = np.where(mask, logits, -1e30)
+                p = np.exp(logits - logits.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                out[bi, :, hi] = p @ v[bi, :, hi]
+        return out
+
+    def test_ref_matches_naive(self):
+        rng = np.random.default_rng(0)
+        q, k, v = (rng.normal(size=(2, 16, 4, 32)).astype(np.float32) for _ in range(3))
+        got = attention_ref(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True)
+        np.testing.assert_allclose(np.asarray(got), self._naive(q, k, v), rtol=1e-4, atol=1e-5)
+
+    def test_gqa_matches_repeated_kv(self):
+        rng = np.random.default_rng(0)
+        q = rng.normal(size=(2, 16, 8, 32)).astype(np.float32)
+        k = rng.normal(size=(2, 16, 2, 32)).astype(np.float32)
+        v = rng.normal(size=(2, 16, 2, 32)).astype(np.float32)
+        got = attention_ref(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True)
+        krep = np.repeat(k, 4, axis=2)
+        vrep = np.repeat(v, 4, axis=2)
+        np.testing.assert_allclose(
+            np.asarray(got), self._naive(q, krep, vrep), rtol=1e-4, atol=1e-5
+        )
+
+    def test_window_masking(self):
+        rng = np.random.default_rng(0)
+        q, k, v = (rng.normal(size=(1, 8, 2, 16)).astype(np.float32) for _ in range(3))
+        got = attention_ref(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True, window=1
+        )
+        # window=1: each token attends only to itself.
+        want = jnp.asarray(v)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+    def test_decode_positions(self):
+        # Single-query decode against a cache must equal the last row of
+        # full prefill attention.
+        rng = np.random.default_rng(0)
+        s = 12
+        q = rng.normal(size=(1, s, 2, 16)).astype(np.float32)
+        k = rng.normal(size=(1, s, 2, 16)).astype(np.float32)
+        v = rng.normal(size=(1, s, 2, 16)).astype(np.float32)
+        full = attention_ref(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True)
+        last = attention_ref(
+            jnp.asarray(q[:, -1:]),
+            jnp.asarray(k),
+            jnp.asarray(v),
+            causal=True,
+            q_positions=jnp.array([[s - 1]], jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(last[:, 0]), np.asarray(full[:, -1]), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("seq,heads,kv_heads", [(128, 4, 4), (256, 8, 2)])
+    def test_matches_ref(self, seq, heads, kv_heads):
+        rng = np.random.default_rng(0)
+        d = 128
+        q = jnp.asarray(rng.normal(size=(2, seq, heads, d)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, seq, kv_heads, d)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, seq, kv_heads, d)).astype(np.float32))
+        got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64, interpret=True)
+        want = attention_ref(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+    def test_noncausal_matches_ref(self):
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(1, 128, 2, 128)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 128, 2, 128)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 128, 2, 128)).astype(np.float32))
+        got = flash_attention(q, k, v, causal=False, block_q=64, block_k=64, interpret=True)
+        want = attention_ref(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+    def test_grad_matches_ref(self):
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.normal(size=(1, 64, 2, 128)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 64, 2, 128)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 64, 2, 128)).astype(np.float32))
+
+        def f_flash(q, k, v):
+            return flash_attention(q, k, v, causal=True, block_q=32, block_k=32,
+                                   interpret=True).sum()
+
+        def f_ref(q, k, v):
+            return attention_ref(q, k, v, causal=True).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
+
+
+class TestActivations:
+    def test_swiglu(self):
+        g = jnp.array([1.0, -1.0])
+        u = jnp.array([2.0, 3.0])
+        got = swiglu(g, u)
+        want = (1.0 / (1 + np.exp(-np.array([1.0, -1.0])))) * np.array([1.0, -1.0]) * np.array([2.0, 3.0])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+    def test_softcap_bounded(self):
+        x = jnp.linspace(-1000, 1000, 101)
+        y = softcap(x, 30.0)
+        assert float(jnp.max(jnp.abs(y))) <= 30.0

@@ -1,0 +1,108 @@
+"""Sharding rules and mesh tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from shellac_tpu import ParallelConfig, get_model_config, make_mesh
+from shellac_tpu.config import TrainConfig
+from shellac_tpu.models import transformer
+from shellac_tpu.parallel.mesh import factor_devices
+from shellac_tpu.parallel.sharding import logical_to_spec
+from shellac_tpu.training import (
+    batch_shardings,
+    init_train_state,
+    make_train_step,
+)
+
+
+class TestRules:
+    def test_param_specs(self):
+        assert logical_to_spec(("vocab", "embed")) == P("tp", "fsdp")
+        assert logical_to_spec(("layers", "embed", "mlp")) == P(None, "fsdp", "tp")
+        assert logical_to_spec(("batch", "seq")) == P(("dp", "fsdp"), "sp")
+
+    def test_duplicate_mesh_axes_dropped(self):
+        # embed->fsdp twice: second occurrence must not reuse the axis.
+        spec = logical_to_spec(("embed", "embed"))
+        assert spec == P("fsdp", None)
+
+    def test_factor_devices(self):
+        pc = factor_devices(8)
+        assert pc.num_devices == 8
+        assert pc.tp == 2 and pc.sp == 2
+        assert factor_devices(1).num_devices == 1
+        assert factor_devices(6).num_devices == 6
+
+
+class TestShardedTraining:
+    def test_init_shardings(self, mesh8):
+        cfg = get_model_config("tiny").replace(d_model=128, vocab_size=512)
+        tcfg = TrainConfig()
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh8)
+        wq = state.params["layers"]["wq"]
+        assert wq.sharding.spec == P(None, "fsdp", "tp")
+        # adam moments follow the params
+        mu = state.opt_state[1].mu
+        assert mu["layers"]["wq"].sharding.spec == P(None, "fsdp", "tp")
+
+    def test_sharded_step_matches_unsharded(self, mesh8):
+        cfg = get_model_config("tiny").replace(
+            d_model=128, vocab_size=512, dtype="float32"
+        )
+        tcfg = TrainConfig(warmup_steps=0, total_steps=100, learning_rate=1e-3)
+        key = jax.random.PRNGKey(0)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)
+        batch = {"inputs": tokens, "targets": tokens}
+
+        state_u = init_train_state(cfg, tcfg, key)
+        step_u = make_train_step(cfg, tcfg)
+        losses_u = []
+        for _ in range(3):
+            state_u, m = step_u(state_u, batch)
+            losses_u.append(float(m["loss"]))
+
+        bs = batch_shardings(mesh8)
+        sharded_batch = jax.tree.map(lambda x: jax.device_put(x, bs), batch)
+        state_s = init_train_state(cfg, tcfg, key, mesh=mesh8)
+        step_s = make_train_step(cfg, tcfg, mesh=mesh8)
+        losses_s = []
+        for _ in range(3):
+            state_s, m = step_s(state_s, sharded_batch)
+            losses_s.append(float(m["loss"]))
+
+        np.testing.assert_allclose(losses_u, losses_s, rtol=1e-4)
+
+    def test_fsdp_only_mesh(self, mesh_fsdp8):
+        cfg = get_model_config("tiny").replace(d_model=128, vocab_size=512)
+        tcfg = TrainConfig()
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh_fsdp8)
+        step = make_train_step(cfg, tcfg, mesh=mesh_fsdp8)
+        tokens = jnp.zeros((8, 16), jnp.int32)
+        bs = batch_shardings(mesh_fsdp8)
+        batch = {
+            "inputs": jax.device_put(tokens, bs),
+            "targets": jax.device_put(tokens, bs),
+        }
+        state, m = step(state, batch)
+        assert np.isfinite(float(m["loss"]))
+
+    def test_grad_accum_matches(self):
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        key = jax.random.PRNGKey(0)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size)
+        batch = {"inputs": tokens, "targets": tokens}
+
+        tcfg1 = TrainConfig(warmup_steps=0, learning_rate=1e-3, grad_accum=1)
+        tcfg2 = tcfg1.replace(grad_accum=2)
+        s1 = init_train_state(cfg, tcfg1, key)
+        s2 = init_train_state(cfg, tcfg2, key)
+        s1, m1 = make_train_step(cfg, tcfg1)(s1, batch)
+        s2, m2 = make_train_step(cfg, tcfg2)(s2, batch)
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(s1.params["embed"]),
+            np.asarray(s2.params["embed"]),
+            rtol=1e-4, atol=1e-6,
+        )
