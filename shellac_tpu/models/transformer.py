@@ -39,9 +39,10 @@ Params = Dict[str, Any]
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     """Initialize a parameter pytree (master copy, cfg.param_dtype)."""
     cfg.validate()
-    if cfg.moe is not None:
+    if cfg.moe is not None and cfg.moe_every != 1:
         raise NotImplementedError(
-            "MoE layers are not implemented yet; use models/moe once it lands"
+            "moe_every > 1 breaks the uniform scan-over-layers layout; "
+            "only moe_every=1 (all layers MoE) is supported"
         )
     pdt = cfg.params_dtype
     d, h, hkv, dh, f = (
@@ -54,21 +55,33 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(pdt)
 
     def layer(key):
-        ks = jax.random.split(key, 7)
+        ks = jax.random.split(key, 8)
         # Residual-output projections scaled down GPT-2 style so the
         # residual stream variance stays O(1) at depth.
         out_scale = (2 * cfg.n_layers) ** -0.5
-        return {
+        p = {
             "attn_norm": jnp.zeros((d,), pdt),
             "wq": dense(ks[0], (d, h * dh), d),
             "wk": dense(ks[1], (d, hkv * dh), d),
             "wv": dense(ks[2], (d, hkv * dh), d),
             "wo": dense(ks[3], (h * dh, d), h * dh, out_scale),
             "mlp_norm": jnp.zeros((d,), pdt),
-            "w_gate": dense(ks[4], (d, f), d),
-            "w_up": dense(ks[5], (d, f), d),
-            "w_down": dense(ks[6], (f, d), f, out_scale),
         }
+        if cfg.moe is None:
+            p.update({
+                "w_gate": dense(ks[4], (d, f), d),
+                "w_up": dense(ks[5], (d, f), d),
+                "w_down": dense(ks[6], (f, d), f, out_scale),
+            })
+        else:
+            e = cfg.moe.num_experts
+            p.update({
+                "w_router": dense(ks[7], (d, e), d),
+                "w_gate": dense(ks[4], (e, d, f), d),
+                "w_up": dense(ks[5], (e, d, f), d),
+                "w_down": dense(ks[6], (e, f, d), f, out_scale),
+            })
+        return p
 
     layer_keys = jax.random.split(k_layers, cfg.n_layers)
     params: Params = {
@@ -84,6 +97,19 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
 
 def logical_axes(cfg: ModelConfig) -> Params:
     """Pytree of logical axis names matching init_params' structure."""
+    if cfg.moe is None:
+        mlp_axes = {
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
+    else:
+        mlp_axes = {
+            "w_router": ("layers", "embed", None),
+            "w_gate": ("layers", "experts", "embed", "mlp"),
+            "w_up": ("layers", "experts", "embed", "mlp"),
+            "w_down": ("layers", "experts", "mlp", "embed"),
+        }
     la: Params = {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -93,9 +119,7 @@ def logical_axes(cfg: ModelConfig) -> Params:
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
             "mlp_norm": ("layers", None),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
+            **mlp_axes,
         },
         "final_norm": (None,),
     }
@@ -128,7 +152,33 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
         q = constrain(q, mesh, ("batch", "seq", "heads", None))
         k = constrain(k, mesh, ("batch", "seq", "kv_heads", None))
         v = constrain(v, mesh, ("batch", "seq", "kv_heads", None))
-        o = attention(q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl)
+        from shellac_tpu.parallel.mesh import AXIS_SEQ
+
+        use_ring = (
+            mesh is not None
+            and attn_impl in ("auto", "ring")
+            and mesh.shape.get(AXIS_SEQ, 1) > 1
+        )
+        if attn_impl == "ring" and not use_ring:
+            raise ValueError(
+                "attn_impl='ring' requires a mesh with sp > 1; got "
+                f"mesh={'None' if mesh is None else dict(mesh.shape)}"
+            )
+        if use_ring:
+            # Sequence is sharded over sp: ring attention keeps kv local
+            # (O(S/sp) memory) and rotates chunks over ICI instead of
+            # letting GSPMD all-gather the whole sequence.
+            from shellac_tpu.parallel.ring_attention import ring_attention
+
+            if cfg.attn_window is not None:
+                raise NotImplementedError(
+                    "sliding-window attention is not supported with sp > 1"
+                )
+            o = ring_attention(q, k, v, mesh, causal=True)
+        else:
+            o = attention(
+                q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
+            )
     else:
         from shellac_tpu.inference.kvcache import update_layer
 
@@ -151,13 +201,32 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
 
     # --- mlp ---
     hx = rms_norm(x, lp["mlp_norm"], cfg.norm_eps).astype(cdt)
-    gate = hx @ lp["w_gate"].astype(cdt)
-    up = hx @ lp["w_up"].astype(cdt)
-    gate = constrain(gate, mesh, ("batch", "seq", "mlp"))
-    up = constrain(up, mesh, ("batch", "seq", "mlp"))
-    down = swiglu(gate, up) @ lp["w_down"].astype(cdt)
+    zero = jnp.zeros((), jnp.float32)
+    moe_out = {"aux": zero, "balance_loss": zero, "router_z_loss": zero,
+               "dropped_frac": zero}
+    if cfg.moe is not None:
+        from shellac_tpu.ops.moe import moe_ffn
+
+        # Decode must never capacity-drop: a dropped token's FFN output
+        # would silently become zero and diverge from prefill.
+        down, aux, metrics = moe_ffn(
+            hx, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
+            cfg.moe, drop_tokens=cache is None,
+        )
+        moe_out = {
+            "aux": aux,
+            "balance_loss": metrics["moe_balance_loss"],
+            "router_z_loss": metrics["moe_router_z_loss"],
+            "dropped_frac": metrics["moe_dropped_frac"],
+        }
+    else:
+        gate = hx @ lp["w_gate"].astype(cdt)
+        up = hx @ lp["w_up"].astype(cdt)
+        gate = constrain(gate, mesh, ("batch", "seq", "mlp"))
+        up = constrain(up, mesh, ("batch", "seq", "mlp"))
+        down = swiglu(gate, up) @ lp["w_down"].astype(cdt)
     x = x + constrain(down, mesh, ("batch", "seq", None))
-    return x, new_cache
+    return x, new_cache, moe_out
 
 
 def forward(
@@ -168,13 +237,23 @@ def forward(
     positions: Optional[jax.Array] = None,  # (B, S) int32
     mesh=None,
     attn_impl: str = "auto",
+    pipeline_microbatches: Optional[int] = None,
+    return_aux: bool = False,
 ) -> jax.Array:
-    """Full forward pass; returns fp32 logits (B, S, V)."""
+    """Full forward pass; returns fp32 logits (B, S, V).
+
+    With a mesh whose pp axis > 1, the layer stack runs as a GPipe
+    pipeline with `pipeline_microbatches` microbatches (default pp).
+    With return_aux=True, returns (logits, aux) where aux is a dict:
+    "aux" (summed MoE auxiliary loss, 0 for dense) plus per-layer-mean
+    router diagnostics (balance_loss, router_z_loss, dropped_frac).
+    """
     cdt = cfg.compute_dtype
     b, s = tokens.shape
-    if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    cos, sin = rope_angles(positions, cfg.dim_per_head, cfg.rope_theta)
+    pos = positions
+    if pos is None:
+        pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    cos, sin = rope_angles(pos, cfg.dim_per_head, cfg.rope_theta)
 
     x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
     x = constrain(x, mesh, ("batch", "seq", None))
@@ -183,11 +262,70 @@ def forward(
     if cfg.remat:
         block = jax.checkpoint(block)
 
-    def scan_body(x, lp):
-        x, _ = block(x, lp, cos, sin)
-        return x, None
+    from shellac_tpu.parallel.mesh import AXIS_PIPE
 
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    pp = mesh.shape.get(AXIS_PIPE, 1) if mesh is not None else 1
+    if pp > 1:
+        from shellac_tpu.parallel.pipeline import pipeline_apply
+
+        if cfg.n_layers % pp:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} not divisible by pp={pp}"
+            )
+        # Microbatches see a slice of the batch; RoPE tables must
+        # broadcast across that slice, so positions must be uniform.
+        if positions is not None:
+            raise NotImplementedError(
+                "custom positions are not supported with pp > 1"
+            )
+        cos, sin = cos[:1], sin[:1]  # (1, S, half) broadcasts over B_m
+        lps = cfg.n_layers // pp
+        stage_params = jax.tree.map(
+            lambda p: p.reshape(pp, lps, *p.shape[1:]), params["layers"]
+        )
+
+        if cfg.moe is not None:
+            raise NotImplementedError(
+                "MoE aux-loss plumbing through the pipeline is not wired; "
+                "use pp=1 with MoE"
+            )
+
+        def stage_fn(sp_lp, x):
+            def body(x, lp):
+                x, _, _ = block(x, lp, cos, sin)
+                return x, None
+
+            x, _ = jax.lax.scan(body, x, sp_lp)
+            return x
+
+        n_micro = pipeline_microbatches or pp
+        x = pipeline_apply(
+            stage_fn, stage_params, x,
+            n_stages=pp, n_micro=n_micro, mesh=mesh,
+        )
+        zero = jnp.zeros((), jnp.float32)
+        aux = {"aux": zero, "balance_loss": zero, "router_z_loss": zero,
+               "dropped_frac": zero}
+    else:
+        zero = jnp.zeros((), jnp.float32)
+        aux0 = {"aux": zero, "balance_loss": zero, "router_z_loss": zero,
+                "dropped_frac": zero}
+
+        def scan_body(carry, lp):
+            x, acc = carry
+            x, _, moe_out = block(x, lp, cos, sin)
+            acc = jax.tree.map(lambda a, b: a + b, acc, moe_out)
+            return (x, acc), None
+
+        (x, aux_acc), _ = jax.lax.scan(scan_body, (x, aux0), params["layers"])
+        # Aux loss sums over layers; diagnostics average.
+        inv_l = 1.0 / cfg.n_layers
+        aux = {
+            "aux": aux_acc["aux"],
+            "balance_loss": aux_acc["balance_loss"] * inv_l,
+            "router_z_loss": aux_acc["router_z_loss"] * inv_l,
+            "dropped_frac": aux_acc["dropped_frac"] * inv_l,
+        }
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
     if cfg.tie_embeddings:
@@ -198,6 +336,8 @@ def forward(
     if cfg.logit_softcap is not None:
         logits = softcap(logits, cfg.logit_softcap)
     logits = constrain(logits, mesh, ("batch", "seq", "vocab"))
+    if return_aux:
+        return logits, aux
     return logits
 
 
@@ -233,7 +373,7 @@ def forward_with_cache(
 
     def scan_body(x, layer_in):
         lp, ck, cv = layer_in
-        x, new_cache = _block(
+        x, new_cache, _ = _block(
             cfg, mesh, "ref", x, lp, cos, sin, cache=(ck, cv, index, positions)
         )
         return x, new_cache

@@ -104,6 +104,8 @@ def attention(
     impl: str = "auto",
 ) -> jax.Array:
     """Dispatching attention. impl: "auto" | "flash" | "ref"."""
+    if impl not in ("auto", "flash", "ref"):
+        raise ValueError(f"unknown attention impl {impl!r}")
     if impl == "ref":
         return attention_ref(
             q, k, v, causal=causal, window=window, scale=scale,

@@ -1,0 +1,132 @@
+"""Mixture-of-experts routing and expert FFN.
+
+TPU-first choices:
+  - Static shapes everywhere: per-expert capacity buckets (tokens over
+    capacity are dropped, standard Switch/GShard semantics), so the
+    whole layer jits with no data-dependent shapes.
+  - Scatter/gather dispatch (`.at[slot].add`, `take`): O(T·D) HBM
+    traffic, instead of the classic one-hot dispatch einsum whose
+    T·E·C·D MXU cost dwarfs the expert matmuls at long sequence.
+  - Expert FFNs run as one batched einsum over the expert axis, sharded
+    over the mesh's expert (fsdp) axis; GSPMD inserts the all-to-alls.
+  - Router math in fp32, with load-balance and router-z auxiliary losses.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from shellac_tpu.config import MoEConfig
+
+
+def expert_capacity(cfg: MoEConfig, num_tokens: int) -> int:
+    cap = int(cfg.capacity_factor * num_tokens * cfg.num_experts_per_token
+              / cfg.num_experts)
+    return max(cap, 1)
+
+
+def route(
+    x: jax.Array,  # (T, D) — flattened tokens
+    w_router: jax.Array,  # (D, E)
+    cfg: MoEConfig,
+    capacity: int | None = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, dict]:
+    """Top-k routing with capacity buckets.
+
+    Returns (slot (T, k) int32 — flat index into E*C, or E*C when
+    dropped/overflow; weight (T, k) fp32 combine weights; aux_loss
+    scalar; metrics dict).
+    """
+    t, _ = x.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_token
+    c = expert_capacity(cfg, t) if capacity is None else capacity
+
+    logits = jnp.einsum(
+        "td,de->te", x.astype(jnp.float32), w_router.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+    weight, expert_idx = jax.lax.top_k(probs, k)  # (T, k)
+    # Renormalize the kept probabilities so combine weights sum to 1.
+    weight = weight / jnp.maximum(jnp.sum(weight, -1, keepdims=True), 1e-9)
+
+    # Position of each assignment within its expert, in token order:
+    # cumsum over the one-hot assignment matrix (T*k, E).
+    flat_expert = expert_idx.reshape(-1)  # (T*k,)
+    onehot = jax.nn.one_hot(flat_expert, e, dtype=jnp.int32)  # (T*k, E)
+    pos = jnp.cumsum(onehot, axis=0) - 1  # position per expert
+    pos_in_expert = jnp.take_along_axis(pos, flat_expert[:, None], axis=1)[:, 0]
+    ok = pos_in_expert < c
+    slot = jnp.where(ok, flat_expert * c + pos_in_expert, e * c)  # overflow -> E*C
+    slot = slot.reshape(t, k).astype(jnp.int32)
+
+    # Load-balance loss (Switch §2.2 form): E * sum_e f_e * p_e where
+    # f_e = fraction of tokens whose top-1 is e, p_e = mean router prob.
+    top1 = jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32)
+    f = jnp.mean(top1, axis=0)
+    p = jnp.mean(probs, axis=0)
+    balance_loss = e * jnp.sum(f * p)
+    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    aux = (cfg.router_aux_loss_weight * balance_loss
+           + cfg.router_z_loss_weight * z_loss)
+
+    dropped = jnp.mean(1.0 - ok.reshape(t, k).astype(jnp.float32))
+    metrics = {
+        "moe_balance_loss": balance_loss,
+        "moe_router_z_loss": z_loss,
+        "moe_dropped_frac": dropped,
+    }
+    return slot, weight, aux, metrics
+
+
+def moe_ffn(
+    x: jax.Array,  # (B, S, D) compute dtype
+    w_router: jax.Array,  # (D, E)
+    w_gate: jax.Array,  # (E, D, F)
+    w_up: jax.Array,  # (E, D, F)
+    w_down: jax.Array,  # (E, F, D)
+    cfg: MoEConfig,
+    *,
+    drop_tokens: bool = True,
+) -> Tuple[jax.Array, jax.Array, dict]:
+    """Returns (out (B, S, D), aux_loss scalar, metrics).
+
+    drop_tokens=False sizes capacity at T (worst case: every token's
+    top-1 on one expert) so nothing ever drops — required for decode,
+    where a capacity drop would silently zero a token's FFN output and
+    make generation diverge from prefill. Only safe for small T.
+    """
+    b, s, d = x.shape
+    e = cfg.num_experts
+    t = b * s
+    c = expert_capacity(cfg, t) if drop_tokens else t
+    cdt = x.dtype
+
+    x2 = x.reshape(t, d)
+    slot, weight, aux, metrics = route(x2, w_router, cfg, capacity=c)
+    k = slot.shape[1]
+
+    # Scatter tokens into capacity buckets; one extra slot absorbs drops.
+    buckets = jnp.zeros((e * c + 1, d), cdt)
+    flat_slot = slot.reshape(-1)  # (T*k,)
+    x_rep = jnp.repeat(x2, k, axis=0)  # (T*k, D) — token for each assignment
+    buckets = buckets.at[flat_slot].add(x_rep, mode="drop")
+    dispatched = buckets[: e * c].reshape(e, c, d)
+
+    # Expert FFNs: batched over the expert axis (sharded over 'fsdp').
+    gate = jnp.einsum("ecd,edf->ecf", dispatched, w_gate.astype(cdt),
+                      preferred_element_type=jnp.float32).astype(cdt)
+    up = jnp.einsum("ecd,edf->ecf", dispatched, w_up.astype(cdt),
+                    preferred_element_type=jnp.float32).astype(cdt)
+    act = jax.nn.silu(gate) * up
+    out_e = jnp.einsum("ecf,efd->ecd", act, w_down.astype(cdt),
+                       preferred_element_type=jnp.float32).astype(cdt)
+
+    # Gather back and combine with router weights (dropped -> zeros row).
+    out_flat = jnp.concatenate([out_e.reshape(e * c, d),
+                                jnp.zeros((1, d), cdt)], axis=0)
+    gathered = jnp.take(out_flat, flat_slot, axis=0).reshape(t, k, d)
+    combined = jnp.sum(gathered * weight[..., None].astype(cdt), axis=1)
+    return combined.reshape(b, s, d), aux, metrics

@@ -1,0 +1,77 @@
+"""GPipe-style pipeline parallelism, the GSPMD way.
+
+No per-stage programs: the layer stack is sharded over the `pp` mesh
+axis (rule "layers" -> "pp"), activations for all stages live in one
+(pp, micro_batch, ...) array sharded the same way, and one `lax.scan`
+over pipeline ticks does, per tick:
+
+    shift   — jnp.roll along the stage axis (XLA: collective-permute
+              over ICI) + insert the next microbatch at stage 0
+    compute — vmap(stage_fn) over the stage axis; since both weights
+              and activations are sharded on that axis, each device
+              computes exactly its own stage
+    collect — the last stage's output lands in the results buffer
+
+Bubble fraction is (pp-1)/(n_micro+pp-1); raise n_micro to amortize.
+Everything composes with dp/fsdp/sp/tp sharding inside stage_fn because
+it is all still one GSPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from shellac_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_SEQ
+
+
+def _constrain(x, mesh, spec):
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def pipeline_apply(
+    stage_fn: Callable,  # (stage_params, x (B_m, S, D)) -> (B_m, S, D)
+    stage_params,  # pytree, leaves (pp, ...) sharded over "pp"
+    x: jax.Array,  # (B, S, D)
+    *,
+    n_stages: int,
+    n_micro: int,
+    mesh: Mesh,
+) -> jax.Array:
+    b, s, d = x.shape
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+    bm = b // n_micro
+
+    micro_spec = P(None, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+    stage_spec = P(AXIS_PIPE, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+
+    micro = _constrain(x.reshape(n_micro, bm, s, d), mesh, micro_spec)
+
+    def tick(carry, t):
+        stages_x, outputs = carry
+        inp0 = jax.lax.dynamic_index_in_dim(
+            micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        shifted = jnp.roll(stages_x, 1, axis=0).at[0].set(inp0)
+        shifted = _constrain(shifted, mesh, stage_spec)
+        y = jax.vmap(stage_fn)(stage_params, shifted)
+        y = _constrain(y, mesh, stage_spec)
+
+        out_idx = t - (n_stages - 1)
+        safe = jnp.clip(out_idx, 0, n_micro - 1)
+        prev = jax.lax.dynamic_index_in_dim(outputs, safe, 0, keepdims=False)
+        val = jnp.where(out_idx >= 0, y[-1], prev)
+        outputs = jax.lax.dynamic_update_index_in_dim(outputs, val, safe, 0)
+        return (y, outputs), None
+
+    stages0 = _constrain(
+        jnp.zeros((n_stages, bm, s, d), x.dtype), mesh, stage_spec
+    )
+    out0 = _constrain(jnp.zeros((n_micro, bm, s, d), x.dtype), mesh, micro_spec)
+    ticks = jnp.arange(n_micro + n_stages - 1)
+    (_, outputs), _ = jax.lax.scan(tick, (stages0, out0), ticks)
+    return outputs.reshape(b, s, d)

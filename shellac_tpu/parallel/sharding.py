@@ -16,6 +16,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from shellac_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_FSDP,
+    AXIS_PIPE,
     AXIS_SEQ,
     AXIS_TENSOR,
 )
@@ -36,8 +37,9 @@ DEFAULT_RULES: Tuple[Tuple[str, Union[None, str, Tuple[str, ...]]], ...] = (
     ("head_dim", None),
     ("mlp", AXIS_TENSOR),
     ("experts", AXIS_FSDP),
-    ("layers", None),
-    ("stages", None),
+    # Stacked layers shard over the pipeline axis: with pp=1 this is a
+    # no-op; with pp>1 each device holds its own pipeline stage's layers.
+    ("layers", AXIS_PIPE),
 )
 
 

@@ -58,6 +58,7 @@ def make_train_step(
     mesh: Optional[Mesh] = None,
     attn_impl: str = "auto",
     jit: bool = True,
+    pipeline_microbatches: Optional[int] = None,
 ):
     """Build `train_step(state, batch) -> (state, metrics)`.
 
@@ -69,12 +70,20 @@ def make_train_step(
     accum = train_cfg.grad_accum
 
     def loss_fn(params, batch):
-        logits = transformer.forward(
-            model_cfg, params, batch["inputs"], mesh=mesh, attn_impl=attn_impl
+        logits, aux = transformer.forward(
+            model_cfg, params, batch["inputs"], mesh=mesh, attn_impl=attn_impl,
+            pipeline_microbatches=pipeline_microbatches, return_aux=True,
         )
-        return cross_entropy(
+        loss, metrics = cross_entropy(
             logits, batch["targets"], batch.get("mask"), train_cfg.z_loss_weight
         )
+        if model_cfg.moe is not None:
+            metrics["moe_aux_loss"] = aux["aux"]
+            metrics["moe_balance_loss"] = aux["balance_loss"]
+            metrics["moe_router_z_loss"] = aux["router_z_loss"]
+            metrics["moe_dropped_frac"] = aux["dropped_frac"]
+            loss = loss + aux["aux"]
+        return loss, metrics
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
@@ -83,14 +92,12 @@ def make_train_step(
             (_, metrics), grads = grad_fn(params, batch)
             return grads, metrics
 
-        def micro(carry, mb):
-            grads_acc, metrics_acc = carry
+        def micro(grads_acc, mb):
             (_, metrics), grads = grad_fn(params, mb)
             grads_acc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), grads_acc, grads
             )
-            metrics_acc = jax.tree.map(lambda a, m: a + m, metrics_acc, metrics)
-            return (grads_acc, metrics_acc), None
+            return grads_acc, metrics
 
         mbs = jax.tree.map(
             lambda x: x.reshape(accum, x.shape[0] // accum, *x.shape[1:]), batch
@@ -98,15 +105,9 @@ def make_train_step(
         zero_grads = jax.tree.map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params
         )
-        zero_metrics = {
-            "loss": jnp.zeros((), jnp.float32),
-            "perplexity": jnp.zeros((), jnp.float32),
-            "tokens": jnp.zeros((), jnp.float32),
-        }
-        (grads, metrics), _ = jax.lax.scan(micro, (zero_grads, zero_metrics), mbs)
-        inv = 1.0 / accum
-        grads = jax.tree.map(lambda g: g * inv, grads)
-        metrics = jax.tree.map(lambda m: m * inv, metrics)
+        grads, metrics_stack = jax.lax.scan(micro, zero_grads, mbs)
+        grads = jax.tree.map(lambda g: g / accum, grads)
+        metrics = jax.tree.map(lambda m: jnp.mean(m, axis=0), metrics_stack)
         metrics["tokens"] = metrics["tokens"] * accum
         return grads, metrics
 

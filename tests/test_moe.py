@@ -1,0 +1,147 @@
+"""MoE routing and model tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import MoEConfig, ParallelConfig, get_model_config, make_mesh
+from shellac_tpu.config import TrainConfig
+from shellac_tpu.models import transformer
+from shellac_tpu.ops.moe import expert_capacity, moe_ffn, route
+from shellac_tpu.training import batch_shardings, init_train_state, make_train_step
+
+
+class TestRouting:
+    def test_slots_unique_and_capped(self):
+        cfg = MoEConfig(num_experts=4, num_experts_per_token=2, capacity_factor=1.0)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(32, 16)), jnp.float32)
+        w = jnp.asarray(np.random.default_rng(1).normal(size=(16, 4)), jnp.float32)
+        slot, weight, aux, metrics = route(x, w, cfg)
+        c = expert_capacity(cfg, 32)
+        s = np.asarray(slot).reshape(-1)
+        valid = s[s < 4 * c]
+        # No two assignments share a capacity slot.
+        assert len(valid) == len(set(valid.tolist()))
+        # Combine weights are normalized over kept experts.
+        np.testing.assert_allclose(np.asarray(weight).sum(-1), 1.0, rtol=1e-5)
+
+    def test_capacity_drops_overflow(self):
+        # Router forced to send everything to expert 0 -> all but C dropped.
+        cfg = MoEConfig(num_experts=4, num_experts_per_token=1, capacity_factor=1.0)
+        x = jnp.ones((16, 8), jnp.float32)
+        w = jnp.zeros((8, 4), jnp.float32).at[:, 0].set(10.0)
+        slot, _, _, metrics = route(x, w, cfg)
+        c = expert_capacity(cfg, 16)  # = 4
+        kept = int((np.asarray(slot) < 4 * c).sum())
+        assert kept == c
+        assert float(metrics["moe_dropped_frac"]) == pytest.approx(1 - c / 16)
+
+    def test_uniform_router_balance_loss_is_one(self):
+        # With a uniform router, balance loss == num_experts * E[f*p] == 1.
+        cfg = MoEConfig(num_experts=8, num_experts_per_token=2)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 16)), jnp.float32)
+        w = jnp.zeros((16, 8), jnp.float32)
+        _, _, _, metrics = route(x, w, cfg)
+        assert float(metrics["moe_balance_loss"]) == pytest.approx(1.0, rel=1e-3)
+
+
+class TestMoEFFN:
+    def test_identity_experts_equal_dense(self):
+        """With all experts identical and capacity ample, MoE == dense SwiGLU."""
+        rng = np.random.default_rng(0)
+        d, f, e = 16, 32, 4
+        x = jnp.asarray(rng.normal(size=(2, 8, d)), jnp.float32)
+        wg1 = jnp.asarray(rng.normal(size=(d, f)) * 0.1, jnp.float32)
+        wu1 = jnp.asarray(rng.normal(size=(d, f)) * 0.1, jnp.float32)
+        wd1 = jnp.asarray(rng.normal(size=(f, d)) * 0.1, jnp.float32)
+        cfg = MoEConfig(num_experts=e, num_experts_per_token=2, capacity_factor=8.0)
+        out, aux, _ = moe_ffn(
+            x,
+            jnp.zeros((d, e), jnp.float32),
+            jnp.broadcast_to(wg1, (e, d, f)),
+            jnp.broadcast_to(wu1, (e, d, f)),
+            jnp.broadcast_to(wd1, (e, f, d)),
+            cfg,
+        )
+        want = (jax.nn.silu(x @ wg1) * (x @ wu1)) @ wd1
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestMoEModel:
+    def _cfg(self):
+        return get_model_config("tiny-moe").replace(dtype="float32")
+
+    def test_forward_and_aux(self):
+        cfg = self._cfg()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        logits, aux = transformer.forward(cfg, params, tokens, return_aux=True)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert float(aux["aux"]) > 0
+        assert float(aux["balance_loss"]) > 0
+
+    def test_training_decreases_loss(self):
+        cfg = self._cfg()
+        tcfg = TrainConfig(warmup_steps=0, learning_rate=3e-3)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, tcfg)
+        batch = {"inputs": tokens, "targets": tokens}
+        state, m0 = step(state, batch)
+        for _ in range(9):
+            state, m = step(state, batch)
+        assert float(m["loss"]) < float(m0["loss"]) - 0.5
+        assert "moe_aux_loss" in m
+
+    def test_sharded_matches_unsharded(self):
+        cfg = self._cfg()
+        tcfg = TrainConfig(warmup_steps=0, learning_rate=1e-3)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)
+        batch = {"inputs": tokens, "targets": tokens}
+
+        state_u = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+        step_u = make_train_step(cfg, tcfg)
+        state_u, mu = step_u(state_u, batch)
+
+        mesh = make_mesh(ParallelConfig(fsdp=4, tp=2))
+        state_s = init_train_state(cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh)
+        assert state_s.params["layers"]["w_gate"].sharding.spec[1] == "fsdp"
+        step_s = make_train_step(cfg, tcfg, mesh=mesh)
+        bs = batch_shardings(mesh)
+        batch_s = jax.tree.map(lambda x: jax.device_put(x, bs), batch)
+        state_s, ms = step_s(state_s, batch_s)
+        np.testing.assert_allclose(
+            float(mu["loss"]), float(ms["loss"]), rtol=1e-4
+        )
+
+    def test_cached_decode_matches_full(self):
+        from shellac_tpu.inference import init_cache
+
+        # Capacity must be ample: C scales with dispatch size T, so a
+        # token dropped at prefill-T but kept at decode-T (or vice versa)
+        # would legitimately change outputs. cf=8 => no drops either way.
+        cfg = self._cfg().replace(
+            moe=MoEConfig(num_experts=4, num_experts_per_token=2,
+                          capacity_factor=8.0)
+        )
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, cfg.vocab_size)
+        full = transformer.forward(cfg, params, tokens)
+        cache = init_cache(cfg, 1, 16)
+        _, cache = transformer.forward_with_cache(cfg, params, tokens[:, :4], cache)
+        outs = []
+        for i in range(4, 8):
+            logits, cache = transformer.forward_with_cache(
+                cfg, params, tokens[:, i : i + 1], cache
+            )
+            outs.append(logits[:, 0])
+        got = jnp.stack(outs, axis=1)
+        # NOTE: routing capacity differs between prefill (T=8) and
+        # decode (T=1) only when tokens are dropped; with the default
+        # capacity_factor and tiny T, capacity is ample so results match.
+        np.testing.assert_allclose(
+            np.asarray(full[:, 4:]), np.asarray(got), rtol=1e-4, atol=1e-4
+        )

@@ -20,7 +20,7 @@ from shellac_tpu.training import (
 class TestRules:
     def test_param_specs(self):
         assert logical_to_spec(("vocab", "embed")) == P("tp", "fsdp")
-        assert logical_to_spec(("layers", "embed", "mlp")) == P(None, "fsdp", "tp")
+        assert logical_to_spec(("layers", "embed", "mlp")) == P("pp", "fsdp", "tp")
         assert logical_to_spec(("batch", "seq")) == P(("dp", "fsdp"), "sp")
 
     def test_duplicate_mesh_axes_dropped(self):
@@ -42,10 +42,10 @@ class TestShardedTraining:
         tcfg = TrainConfig()
         state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh8)
         wq = state.params["layers"]["wq"]
-        assert wq.sharding.spec == P(None, "fsdp", "tp")
+        assert wq.sharding.spec == P("pp", "fsdp", "tp")
         # adam moments follow the params
         mu = state.opt_state[1].mu
-        assert mu["layers"]["wq"].sharding.spec == P(None, "fsdp", "tp")
+        assert mu["layers"]["wq"].sharding.spec == P("pp", "fsdp", "tp")
 
     def test_sharded_step_matches_unsharded(self, mesh8):
         cfg = get_model_config("tiny").replace(
