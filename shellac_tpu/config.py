@@ -159,6 +159,8 @@ class TrainConfig:
     # Number of microbatches accumulated per optimizer step (1 = no accum).
     grad_accum: int = 1
     z_loss_weight: float = 0.0
+    # Skip the whole param/opt update when any gradient is non-finite.
+    skip_nonfinite_updates: bool = True
     seed: int = 0
 
     def replace(self, **kw) -> "TrainConfig":

@@ -154,26 +154,29 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
         v = constrain(v, mesh, ("batch", "seq", "kv_heads", None))
         from shellac_tpu.parallel.mesh import AXIS_SEQ
 
-        use_ring = (
-            mesh is not None
-            and attn_impl in ("auto", "ring")
-            and mesh.shape.get(AXIS_SEQ, 1) > 1
+        sp_active = mesh is not None and mesh.shape.get(AXIS_SEQ, 1) > 1
+        if attn_impl == "ring":
+            if not sp_active:
+                raise ValueError(
+                    "attn_impl='ring' requires a mesh with sp > 1; got "
+                    f"mesh={'None' if mesh is None else dict(mesh.shape)}"
+                )
+            if cfg.attn_window is not None:
+                raise NotImplementedError(
+                    "ring attention does not support sliding windows"
+                )
+        # 'auto' on an sp mesh uses ring only when it can express the
+        # config; a window falls back to dense attention (GSPMD gathers
+        # the sequence — slower, but the config keeps working).
+        use_ring = attn_impl == "ring" or (
+            attn_impl == "auto" and sp_active and cfg.attn_window is None
         )
-        if attn_impl == "ring" and not use_ring:
-            raise ValueError(
-                "attn_impl='ring' requires a mesh with sp > 1; got "
-                f"mesh={'None' if mesh is None else dict(mesh.shape)}"
-            )
         if use_ring:
             # Sequence is sharded over sp: ring attention keeps kv local
             # (O(S/sp) memory) and rotates chunks over ICI instead of
             # letting GSPMD all-gather the whole sequence.
             from shellac_tpu.parallel.ring_attention import ring_attention
 
-            if cfg.attn_window is not None:
-                raise NotImplementedError(
-                    "sliding-window attention is not supported with sp > 1"
-                )
             o = ring_attention(q, k, v, mesh, causal=True)
         else:
             o = attention(
@@ -207,11 +210,13 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
     if cfg.moe is not None:
         from shellac_tpu.ops.moe import moe_ffn
 
-        # Decode must never capacity-drop: a dropped token's FFN output
-        # would silently become zero and diverge from prefill.
+        # Single-token decode must never capacity-drop: a dropped token's
+        # FFN output would silently become zero. Prefill keeps routed
+        # capacity — dropless there would cost O(E*T*D) dispatch buffers.
+        is_decode = cache is not None and s == 1
         down, aux, metrics = moe_ffn(
             hx, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            cfg.moe, drop_tokens=cache is None,
+            cfg.moe, drop_tokens=not is_decode,
         )
         moe_out = {
             "aux": aux,

@@ -23,13 +23,15 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from shellac_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_SEQ
+from shellac_tpu.parallel.sharding import constrain
 
-
-def _constrain(x, mesh, spec):
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+# Logical axes for the pipeline buffers, resolved through the shared
+# rule table ("batch" -> (dp, fsdp), "seq" -> sp, "layers" -> pp), so a
+# rule-table edit re-lays-out the pipeline with the rest of the model.
+_MICRO_AXES = (None, "batch", "seq", None)
+_STAGE_AXES = ("layers", "batch", "seq", None)
 
 
 def pipeline_apply(
@@ -46,10 +48,7 @@ def pipeline_apply(
         raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
     bm = b // n_micro
 
-    micro_spec = P(None, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
-    stage_spec = P(AXIS_PIPE, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
-
-    micro = _constrain(x.reshape(n_micro, bm, s, d), mesh, micro_spec)
+    micro = constrain(x.reshape(n_micro, bm, s, d), mesh, _MICRO_AXES)
 
     def tick(carry, t):
         stages_x, outputs = carry
@@ -57,9 +56,9 @@ def pipeline_apply(
             micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
         )
         shifted = jnp.roll(stages_x, 1, axis=0).at[0].set(inp0)
-        shifted = _constrain(shifted, mesh, stage_spec)
+        shifted = constrain(shifted, mesh, _STAGE_AXES)
         y = jax.vmap(stage_fn)(stage_params, shifted)
-        y = _constrain(y, mesh, stage_spec)
+        y = constrain(y, mesh, _STAGE_AXES)
 
         out_idx = t - (n_stages - 1)
         safe = jnp.clip(out_idx, 0, n_micro - 1)
@@ -68,10 +67,10 @@ def pipeline_apply(
         outputs = jax.lax.dynamic_update_index_in_dim(outputs, val, safe, 0)
         return (y, outputs), None
 
-    stages0 = _constrain(
-        jnp.zeros((n_stages, bm, s, d), x.dtype), mesh, stage_spec
+    stages0 = constrain(
+        jnp.zeros((n_stages, bm, s, d), x.dtype), mesh, _STAGE_AXES
     )
-    out0 = _constrain(jnp.zeros((n_micro, bm, s, d), x.dtype), mesh, micro_spec)
+    out0 = constrain(jnp.zeros((n_micro, bm, s, d), x.dtype), mesh, _MICRO_AXES)
     ticks = jnp.arange(n_micro + n_stages - 1)
     (_, outputs), _ = jax.lax.scan(tick, (stages0, out0), ticks)
     return outputs.reshape(b, s, d)

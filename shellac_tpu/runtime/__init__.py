@@ -1,0 +1,1 @@
+"""Native (C++) runtime components: prefetching shard loader."""

@@ -1,0 +1,248 @@
+// Native data loader: mmap'd token shards -> prefetched training batches.
+//
+// The hot path of host-side data work is (1) page-cache reads of token
+// windows and (2) the int32 copies into batch buffers. Python threads
+// serialize on the GIL; this loader runs N worker threads that sample
+// random windows from mmap'd shards and push ready batches into a
+// bounded ring buffer, so the training loop's next() is a single
+// condvar pop + memcpy, independent of Python.
+//
+// Shard format (matches shellac_tpu/training/data.py):
+//   header: magic "STSH" (4 bytes) | u32 version (=1) | u64 num_tokens
+//   payload: num_tokens little-endian int32
+//
+// C ABI for ctypes; no exceptions cross the boundary.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[4] = {'S', 'T', 'S', 'H'};
+constexpr uint32_t kVersion = 1;
+constexpr size_t kHeaderSize = 16;  // 4 magic + 4 version + 8 count
+
+struct Shard {
+  const int32_t* tokens = nullptr;  // into the mmap, past the header
+  uint64_t num_tokens = 0;
+  void* map_base = nullptr;
+  size_t map_len = 0;
+};
+
+struct Batch {
+  std::vector<int32_t> inputs;
+  std::vector<int32_t> targets;
+};
+
+// xorshift128+ — fast, per-thread, deterministic from seed.
+struct Rng {
+  uint64_t s0, s1;
+  explicit Rng(uint64_t seed) {
+    s0 = seed ^ 0x9E3779B97F4A7C15ULL;
+    s1 = (seed << 1) | 1;
+    for (int i = 0; i < 8; ++i) next();
+  }
+  uint64_t next() {
+    uint64_t x = s0, y = s1;
+    s0 = y;
+    x ^= x << 23;
+    s1 = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return s1 + y;
+  }
+  uint64_t below(uint64_t n) { return next() % n; }
+};
+
+class Loader {
+ public:
+  Loader(uint64_t seed) : seed_(seed) {}
+
+  ~Loader() { stop_and_join(); unmap_all(); }
+
+  // Returns empty string on success, else an error message.
+  std::string open_shard(const std::string& path) {
+    int fd = ::open(path.c_str(), O_RDONLY);
+    if (fd < 0) return "cannot open " + path;
+    struct stat st;
+    if (fstat(fd, &st) != 0) { ::close(fd); return "cannot stat " + path; }
+    if ((size_t)st.st_size < kHeaderSize) {
+      ::close(fd);
+      return path + ": too small for header";
+    }
+    void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+    ::close(fd);
+    if (base == MAP_FAILED) return "mmap failed for " + path;
+
+    const unsigned char* p = static_cast<const unsigned char*>(base);
+    if (memcmp(p, kMagic, 4) != 0) {
+      munmap(base, st.st_size);
+      return path + ": bad magic";
+    }
+    uint32_t version;
+    uint64_t count;
+    memcpy(&version, p + 4, 4);
+    memcpy(&count, p + 8, 8);
+    if (version != kVersion) {
+      munmap(base, st.st_size);
+      return path + ": unsupported version";
+    }
+    if (kHeaderSize + count * sizeof(int32_t) > (uint64_t)st.st_size) {
+      munmap(base, st.st_size);
+      return path + ": truncated payload";
+    }
+    Shard sh;
+    sh.tokens = reinterpret_cast<const int32_t*>(p + kHeaderSize);
+    sh.num_tokens = count;
+    sh.map_base = base;
+    sh.map_len = st.st_size;
+    shards_.push_back(sh);
+    total_tokens_ += count;
+    return "";
+  }
+
+  std::string start(int batch_size, int seq_len, int queue_depth,
+                    int n_threads) {
+    if (shards_.empty()) return "no shards opened";
+    for (const Shard& s : shards_) {
+      if (s.num_tokens < (uint64_t)seq_len + 1) {
+        return "a shard is smaller than seq_len+1";
+      }
+    }
+    batch_size_ = batch_size;
+    seq_len_ = seq_len;
+    depth_ = queue_depth > 0 ? queue_depth : 4;
+    stop_.store(false);
+    for (int i = 0; i < n_threads; ++i) {
+      workers_.emplace_back([this, i] { worker(i); });
+    }
+    return "";
+  }
+
+  // Blocking; fills caller buffers of batch_size*seq_len each.
+  bool next(int32_t* inputs, int32_t* targets) {
+    Batch b;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      not_empty_.wait(lk, [this] { return !queue_.empty() || stop_.load(); });
+      if (queue_.empty()) return false;
+      b = std::move(queue_.front());
+      queue_.pop_front();
+    }
+    not_full_.notify_one();
+    memcpy(inputs, b.inputs.data(), b.inputs.size() * sizeof(int32_t));
+    memcpy(targets, b.targets.data(), b.targets.size() * sizeof(int32_t));
+    return true;
+  }
+
+  uint64_t total_tokens() const { return total_tokens_; }
+
+ private:
+  void worker(int tid) {
+    Rng rng(seed_ * 0x5DEECE66DULL + tid + 1);
+    const size_t n = (size_t)batch_size_ * seq_len_;
+    while (!stop_.load()) {
+      Batch b;
+      b.inputs.resize(n);
+      b.targets.resize(n);
+      for (int row = 0; row < batch_size_; ++row) {
+        // Sample a shard proportionally to its token count, then a
+        // window within it.
+        uint64_t pick = rng.below(total_tokens_);
+        size_t si = 0;
+        while (si + 1 < shards_.size() && pick >= shards_[si].num_tokens) {
+          pick -= shards_[si].num_tokens;
+          ++si;
+        }
+        const Shard& sh = shards_[si];
+        uint64_t start = rng.below(sh.num_tokens - seq_len_ - 1);
+        const int32_t* w = sh.tokens + start;
+        memcpy(&b.inputs[(size_t)row * seq_len_], w,
+               seq_len_ * sizeof(int32_t));
+        memcpy(&b.targets[(size_t)row * seq_len_], w + 1,
+               seq_len_ * sizeof(int32_t));
+      }
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        not_full_.wait(lk, [this] {
+          return queue_.size() < (size_t)depth_ || stop_.load();
+        });
+        if (stop_.load()) return;
+        queue_.push_back(std::move(b));
+      }
+      not_empty_.notify_one();
+    }
+  }
+
+  void stop_and_join() {
+    stop_.store(true);
+    not_empty_.notify_all();
+    not_full_.notify_all();
+    for (auto& t : workers_) {
+      if (t.joinable()) t.join();
+    }
+    workers_.clear();
+  }
+
+  void unmap_all() {
+    for (Shard& s : shards_) munmap(s.map_base, s.map_len);
+    shards_.clear();
+  }
+
+  uint64_t seed_;
+  std::vector<Shard> shards_;
+  uint64_t total_tokens_ = 0;
+  int batch_size_ = 0, seq_len_ = 0, depth_ = 4;
+  std::deque<Batch> queue_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::atomic<bool> stop_{false};
+  std::vector<std::thread> workers_;
+};
+
+thread_local std::string g_error;
+
+}  // namespace
+
+extern "C" {
+
+void* stsh_open(uint64_t seed) { return new Loader(seed); }
+
+// Returns 0 on success; on failure sets the error retrievable below.
+int stsh_add_shard(void* h, const char* path) {
+  std::string err = static_cast<Loader*>(h)->open_shard(path);
+  if (!err.empty()) { g_error = err; return 1; }
+  return 0;
+}
+
+int stsh_start(void* h, int batch_size, int seq_len, int queue_depth,
+               int n_threads) {
+  std::string err = static_cast<Loader*>(h)->start(batch_size, seq_len,
+                                                   queue_depth, n_threads);
+  if (!err.empty()) { g_error = err; return 1; }
+  return 0;
+}
+
+int stsh_next(void* h, int32_t* inputs, int32_t* targets) {
+  return static_cast<Loader*>(h)->next(inputs, targets) ? 0 : 1;
+}
+
+uint64_t stsh_total_tokens(void* h) {
+  return static_cast<Loader*>(h)->total_tokens();
+}
+
+const char* stsh_last_error() { return g_error.c_str(); }
+
+void stsh_close(void* h) { delete static_cast<Loader*>(h); }
+
+}  // extern "C"

@@ -6,6 +6,7 @@ from shellac_tpu.training.trainer import (
     init_train_state,
     make_train_step,
 )
+from shellac_tpu.training.loop import fit
 
 __all__ = [
     "cross_entropy",
@@ -17,4 +18,5 @@ __all__ = [
     "init_train_state",
     "make_train_step",
     "batch_shardings",
+    "fit",
 ]

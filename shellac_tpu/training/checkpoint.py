@@ -1,0 +1,97 @@
+"""Distributed checkpoint / resume on orbax.
+
+Orbax writes each array shard from the device that owns it (OCDBT
+format), so saving a ZeRO-sharded TrainState never gathers parameters to
+one host, and restore places shards directly onto the target mesh via
+abstract arrays carrying NamedShardings.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from shellac_tpu.models import transformer
+from shellac_tpu.training.train_state import state_shardings
+
+
+class Checkpointer:
+    """Thin wrapper over ocp.CheckpointManager for TrainState pytrees."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ):
+        self._mngr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+            ),
+        )
+
+    @property
+    def directory(self) -> str:
+        return str(self._mngr.directory)
+
+    def save(self, step: int, state: Any, *, force: bool = False, wait: bool = False) -> bool:
+        """Save (async by default). Returns True if a save was started."""
+        if step in self._mngr.all_steps():
+            if wait:
+                self._mngr.wait_until_finished()
+            return False
+        saved = self._mngr.save(
+            step, args=ocp.args.StandardSave(state), force=force
+        )
+        if wait:
+            self._mngr.wait_until_finished()
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(
+        self,
+        step: Optional[int] = None,
+        *,
+        abstract_state: Any = None,
+        mesh=None,
+        model_cfg=None,
+    ) -> Any:
+        """Restore a TrainState.
+
+        With `mesh` + `model_cfg` (or an `abstract_state` of
+        jax.ShapeDtypeStructs carrying shardings), arrays are restored
+        directly sharded; otherwise fully addressable on host.
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        if abstract_state is None:
+            return self._mngr.restore(step)
+        if mesh is not None and model_cfg is not None:
+            shardings = state_shardings(
+                mesh, abstract_state, transformer.logical_axes(model_cfg)
+            )
+            abstract_state = jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+                abstract_state,
+                shardings,
+            )
+        return self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()

@@ -1,0 +1,138 @@
+"""Host-side data pipeline.
+
+The framework consumes batches of {"inputs", "targets"} int32 arrays.
+Sources:
+  - `token_batches`: random contiguous windows from one in-memory token
+    array (tests, small corpora).
+  - `shard_batches`: streaming reader over binary token shards written
+    by `write_token_shard` — the pure-Python counterpart of the native
+    (C++) loader in shellac_tpu/runtime, which it transparently uses
+    when the compiled library is available.
+
+Every iterator yields numpy on host; `device_prefetch` moves batches to
+device (with the right sharding) one step ahead of consumption so the
+TPU never waits on the host.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+from typing import Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+_MAGIC = b"STSH"  # shellac tpu shard
+_HEADER = struct.Struct("<4sIQ")  # magic, version, num_tokens
+
+
+def write_token_shard(path: str, tokens: np.ndarray) -> None:
+    """Write int32 tokens as a binary shard (header + raw little-endian)."""
+    tokens = np.ascontiguousarray(tokens, dtype=np.int32)
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(_MAGIC, 1, tokens.size))
+        f.write(tokens.tobytes())
+
+
+def read_token_shard(path: str) -> np.ndarray:
+    with open(path, "rb") as f:
+        magic, version, n = _HEADER.unpack(f.read(_HEADER.size))
+        if magic != _MAGIC:
+            raise ValueError(f"{path}: not a token shard (bad magic {magic!r})")
+        if version != 1:
+            raise ValueError(f"{path}: unsupported shard version {version}")
+        data = np.frombuffer(f.read(n * 4), dtype=np.int32)
+        if data.size != n:
+            raise ValueError(f"{path}: truncated shard ({data.size} != {n})")
+        return data
+
+
+def token_batches(
+    tokens: np.ndarray,
+    *,
+    batch_size: int,
+    seq_len: int,
+    seed: int = 0,
+    num_batches: Optional[int] = None,
+) -> Iterator[dict]:
+    """Random contiguous windows: inputs = w[:-1], targets = w[1:]."""
+    tokens = np.asarray(tokens, dtype=np.int32)
+    if tokens.size < seq_len + 1:
+        raise ValueError(f"corpus of {tokens.size} tokens < seq_len+1")
+    rng = np.random.default_rng(seed)
+    produced = 0
+    while num_batches is None or produced < num_batches:
+        starts = rng.integers(0, tokens.size - seq_len - 1, size=batch_size)
+        window = np.stack([tokens[s : s + seq_len + 1] for s in starts])
+        yield {"inputs": window[:, :-1], "targets": window[:, 1:]}
+        produced += 1
+
+
+def shard_batches(
+    paths: Sequence[str],
+    *,
+    batch_size: int,
+    seq_len: int,
+    seed: int = 0,
+    num_batches: Optional[int] = None,
+    use_native: bool = True,
+) -> Iterator[dict]:
+    """Batches drawn from a set of token shards (round-robin by epoch).
+
+    Uses the native C++ loader when built (mmap + prefetch threads);
+    falls back to the pure-Python reader transparently.
+    """
+    if use_native:
+        try:
+            from shellac_tpu.runtime.loader import NativeShardReader
+
+            reader = NativeShardReader(paths, seed=seed)
+            yield from reader.batches(
+                batch_size=batch_size, seq_len=seq_len, num_batches=num_batches
+            )
+            return
+        except (ImportError, OSError):
+            pass
+    corpus = np.concatenate([read_token_shard(p) for p in paths])
+    yield from token_batches(
+        corpus, batch_size=batch_size, seq_len=seq_len, seed=seed,
+        num_batches=num_batches,
+    )
+
+
+def device_prefetch(
+    it: Iterator[dict],
+    *,
+    sharding=None,
+    depth: int = 2,
+) -> Iterator[dict]:
+    """Move batches to device ahead of consumption (double buffering).
+
+    A small background thread keeps `depth` device-resident batches
+    queued so the host-to-HBM copy overlaps the previous step's compute.
+    """
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = object()
+
+    def put(batch):
+        if sharding is not None:
+            return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    def worker():
+        try:
+            for batch in it:
+                q.put(put(batch))
+        finally:
+            q.put(stop)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is stop:
+            return
+        yield item

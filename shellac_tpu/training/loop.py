@@ -1,0 +1,104 @@
+"""The training loop: steps + checkpoints + metrics + failure recovery.
+
+Host-device sync discipline: the loop only fetches scalars every
+`log_every` steps, so the device queue stays full between syncs; the
+failure detector therefore reacts within one log interval, which is the
+standard tradeoff (tighten log_every for faster tripping).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+
+from shellac_tpu.config import ModelConfig, TrainConfig
+from shellac_tpu.training.trainer import init_train_state, make_train_step
+from shellac_tpu.utils.failure import FailureDetector, Heartbeat
+from shellac_tpu.utils.metrics import MetricsLogger
+from shellac_tpu.utils.tracing import StepTimer
+
+
+def fit(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    data_iter: Iterator[dict],
+    *,
+    mesh=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 500,
+    log_path: Optional[str] = None,
+    log_every: int = 10,
+    resume: bool = True,
+    heartbeat_path: Optional[str] = None,
+    max_restores: int = 2,
+    pipeline_microbatches: Optional[int] = None,
+):
+    """Train until train_cfg.total_steps; returns the final TrainState."""
+    ckpt = None
+    if checkpoint_dir is not None:
+        from shellac_tpu.training.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir)
+
+    key = jax.random.PRNGKey(train_cfg.seed)
+    state = init_train_state(model_cfg, train_cfg, key, mesh=mesh)
+    if ckpt is not None and resume and ckpt.latest_step() is not None:
+        abstract = jax.eval_shape(lambda s: s, state)
+        state = ckpt.restore(
+            abstract_state=abstract, mesh=mesh, model_cfg=model_cfg
+        )
+
+    step_fn = make_train_step(
+        model_cfg, train_cfg, mesh=mesh,
+        pipeline_microbatches=pipeline_microbatches,
+    )
+    logger = MetricsLogger(log_path, every=1)
+    detector = FailureDetector()
+    heartbeat = Heartbeat(heartbeat_path) if heartbeat_path else None
+    timer = StepTimer()
+    restores = 0
+
+    step = int(jax.device_get(state.step))
+    while step < train_cfg.total_steps:
+        try:
+            batch = next(data_iter)
+        except StopIteration:
+            break
+        state, metrics = step_fn(state, batch)
+        step += 1
+
+        if step % log_every == 0 or step >= train_cfg.total_steps:
+            loss = float(jax.device_get(metrics["loss"]))  # sync point
+            dt = timer.tick()
+            host_metrics = {k: jax.device_get(v) for k, v in metrics.items()}
+            if dt is not None:
+                host_metrics["steps_per_sec"] = log_every / dt
+            logger.log(step, host_metrics)
+            if heartbeat is not None:
+                heartbeat.beat(step)
+
+            reason = detector.check(loss)
+            if reason is not None:
+                if ckpt is None or ckpt.latest_step() is None or restores >= max_restores:
+                    raise RuntimeError(
+                        f"training failure at step {step}: {reason}; "
+                        "no checkpoint to restore (or restore budget spent)"
+                    )
+                restores += 1
+                abstract = jax.eval_shape(lambda s: s, state)
+                state = ckpt.restore(
+                    abstract_state=abstract, mesh=mesh, model_cfg=model_cfg
+                )
+                step = int(jax.device_get(state.step))
+                detector.reset()
+                logger.log(step, {"restored_after": reason, "restores": restores})
+                continue
+
+        if ckpt is not None and step % checkpoint_every == 0:
+            ckpt.save(step, state)
+
+    if ckpt is not None:
+        ckpt.save(int(jax.device_get(state.step)), state, force=True, wait=True)
+    logger.close()
+    return state

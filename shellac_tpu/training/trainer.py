@@ -112,6 +112,8 @@ def make_train_step(
         return grads, metrics
 
     def train_step(state: TrainState, batch):
+        from shellac_tpu.utils.failure import all_finite, guard_update
+
         grads, metrics = compute_grads(state.params, batch)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
@@ -119,6 +121,11 @@ def make_train_step(
         new_params = optax.apply_updates(state.params, updates)
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
+        if train_cfg.skip_nonfinite_updates:
+            ok = all_finite(grads)
+            new_params = guard_update(state.params, new_params, ok)
+            new_opt_state = guard_update(state.opt_state, new_opt_state, ok)
+            metrics["update_skipped"] = 1.0 - ok.astype(jnp.float32)
         new_state = TrainState(
             step=state.step + 1, params=new_params, opt_state=new_opt_state
         )

@@ -1,0 +1,117 @@
+"""Failure detection and recovery hooks.
+
+Three layers of defense, cheapest first:
+  1. `guard_update` (inside the jitted step): if any gradient is
+     non-finite, the parameter/optimizer update is skipped wholesale —
+     one bad batch cannot poison the state. Costs one fused all-reduce
+     of isfinite flags.
+  2. `FailureDetector` (host side): watches the loss stream for
+     NaN/Inf/explosion and trips after `patience` consecutive bad
+     steps, signalling the loop to restore from the last checkpoint.
+  3. `Heartbeat` (process level): a file touched every step; an
+     external watchdog (or another host) treats a stale heartbeat as a
+     hung/dead worker and can restart it. This is the single-host
+     analogue of a multi-host liveness protocol over DCN.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def all_finite(tree) -> jax.Array:
+    """Scalar bool: every element of every leaf is finite."""
+    leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(tree)
+              if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.array(True)
+    return jnp.stack(leaves).all()
+
+
+def guard_update(old_tree, new_tree, ok: jax.Array):
+    """Select new_tree where ok else old_tree (jit-friendly)."""
+    return jax.tree.map(
+        lambda o, n: jnp.where(ok, n, o), old_tree, new_tree
+    )
+
+
+class FailureDetector:
+    """Host-side monitor over scalar training metrics."""
+
+    def __init__(
+        self,
+        *,
+        patience: int = 3,
+        loss_explosion_factor: float = 10.0,
+        window: int = 50,
+    ):
+        self.patience = patience
+        self.factor = loss_explosion_factor
+        self.window = window
+        self._bad_streak = 0
+        self._history: list[float] = []
+
+    def check(self, loss: float) -> Optional[str]:
+        """Feed one loss value; returns a failure reason or None."""
+        bad = None
+        if not (loss == loss) or loss in (float("inf"), float("-inf")):
+            bad = f"non-finite loss {loss}"
+        elif self._history:
+            ref = sum(self._history) / len(self._history)
+            if loss > self.factor * max(ref, 1e-6):
+                bad = f"loss explosion {loss:.4g} vs recent mean {ref:.4g}"
+        if bad is None:
+            self._bad_streak = 0
+            self._history.append(loss)
+            if len(self._history) > self.window:
+                self._history.pop(0)
+            return None
+        self._bad_streak += 1
+        if self._bad_streak >= self.patience:
+            return bad
+        return None
+
+    def reset(self) -> None:
+        self._bad_streak = 0
+        self._history.clear()
+
+
+class Heartbeat:
+    """Liveness file for external watchdogs."""
+
+    def __init__(self, path: str, *, process_index: Optional[int] = None):
+        self.path = path
+        self.process_index = (
+            process_index if process_index is not None else jax.process_index()
+        )
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def beat(self, step: int) -> None:
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"step": int(step), "time": time.time(),
+                 "process": self.process_index}, f
+            )
+        os.replace(tmp, self.path)
+
+    def age(self) -> Optional[float]:
+        """Seconds since the last beat, or None if never beaten."""
+        try:
+            with open(self.path) as f:
+                return time.time() - json.load(f)["time"]
+        except (FileNotFoundError, json.JSONDecodeError, KeyError):
+            return None
+
+    @staticmethod
+    def is_stale(path: str, timeout: float) -> bool:
+        hb = Heartbeat.__new__(Heartbeat)
+        hb.path = path
+        age = hb.age()
+        return age is None or age > timeout

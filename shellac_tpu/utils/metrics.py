@@ -1,0 +1,50 @@
+"""Scalar metrics logging: JSONL file + stdout, host-side only."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import IO, Optional
+
+import jax
+import numpy as np
+
+
+def _to_python(tree):
+    return jax.tree.map(
+        lambda x: float(np.asarray(x)) if hasattr(x, "dtype") else x, tree
+    )
+
+
+class MetricsLogger:
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        *,
+        stdout: bool = True,
+        every: int = 1,
+    ):
+        self._file: Optional[IO] = open(path, "a") if path else None
+        self._stdout = stdout
+        self._every = max(every, 1)
+
+    def log(self, step: int, metrics: dict) -> None:
+        if step % self._every:
+            return
+        record = {"step": int(step), "time": time.time(), **_to_python(metrics)}
+        line = json.dumps(record)
+        if self._file:
+            self._file.write(line + "\n")
+            self._file.flush()
+        if self._stdout:
+            shown = " ".join(
+                f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in record.items()
+                if k != "time"
+            )
+            print(shown, file=sys.stderr)
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()

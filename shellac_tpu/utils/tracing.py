@@ -1,0 +1,69 @@
+"""Profiling / tracing helpers around jax.profiler.
+
+Traces are viewable in TensorBoard or Perfetto; `annotate` scopes show
+up on the TPU timeline so step phases (data, step, checkpoint) are
+attributable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Capture a profiler trace (TPU timeline + host) into log_dir."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Context manager labelling a region on the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Wall-clock step timing with warmup discard and EMA throughput.
+
+    Synchronization is the caller's job (fetch a scalar from the step
+    output before calling tick(); on some platforms block_until_ready
+    does not synchronize).
+    """
+
+    def __init__(self, tokens_per_step: Optional[int] = None, warmup: int = 2):
+        self.tokens_per_step = tokens_per_step
+        self.warmup = warmup
+        self._count = 0
+        self._last: Optional[float] = None
+        self._ema: Optional[float] = None
+
+    def tick(self) -> Optional[float]:
+        """Mark a step boundary; returns the step time (or None in warmup)."""
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return None
+        dt = now - self._last
+        self._last = now
+        self._count += 1
+        if self._count <= self.warmup:
+            return None
+        self._ema = dt if self._ema is None else 0.9 * self._ema + 0.1 * dt
+        return dt
+
+    @property
+    def step_time(self) -> Optional[float]:
+        return self._ema
+
+    @property
+    def tokens_per_sec(self) -> Optional[float]:
+        if self._ema is None or not self.tokens_per_step:
+            return None
+        return self.tokens_per_step / self._ema

@@ -1,0 +1,227 @@
+"""Checkpoint/resume, failure detection, data pipeline, training loop."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import ParallelConfig, get_model_config, make_mesh
+from shellac_tpu.config import TrainConfig
+from shellac_tpu.training import (
+    batch_shardings,
+    fit,
+    init_train_state,
+    make_train_step,
+)
+from shellac_tpu.training.checkpoint import Checkpointer
+from shellac_tpu.training.data import (
+    device_prefetch,
+    read_token_shard,
+    shard_batches,
+    token_batches,
+    write_token_shard,
+)
+from shellac_tpu.utils.failure import (
+    FailureDetector,
+    Heartbeat,
+    all_finite,
+    guard_update,
+)
+
+
+def _cfg():
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+class TestCheckpoint:
+    def test_roundtrip(self, tmp_path):
+        cfg = _cfg()
+        tcfg = TrainConfig(warmup_steps=0, learning_rate=1e-3)
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+        ckpt = Checkpointer(str(tmp_path / "ckpt"))
+        ckpt.save(0, state, wait=True)
+        restored = ckpt.restore(abstract_state=jax.eval_shape(lambda s: s, state))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            state.params, restored.params,
+        )
+        ckpt.close()
+
+    def test_sharded_roundtrip(self, tmp_path, mesh8):
+        cfg = _cfg().replace(d_model=128, vocab_size=512)
+        tcfg = TrainConfig()
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh8)
+        ckpt = Checkpointer(str(tmp_path / "ckpt"))
+        ckpt.save(3, state, wait=True)
+        abstract = jax.eval_shape(lambda s: s, state)
+        restored = ckpt.restore(
+            abstract_state=abstract, mesh=mesh8, model_cfg=cfg
+        )
+        # Restored arrays carry the mesh shardings and equal values.
+        assert (
+            restored.params["layers"]["wq"].sharding
+            == state.params["layers"]["wq"].sharding
+        )
+        np.testing.assert_array_equal(
+            np.asarray(state.params["embed"]), np.asarray(restored.params["embed"])
+        )
+        assert ckpt.latest_step() == 3
+        ckpt.close()
+
+    def test_restore_missing_raises(self, tmp_path):
+        ckpt = Checkpointer(str(tmp_path / "empty"))
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore()
+        ckpt.close()
+
+
+class TestFailureTools:
+    def test_all_finite(self):
+        assert bool(all_finite({"a": jnp.ones(3), "b": jnp.zeros(2)}))
+        assert not bool(all_finite({"a": jnp.array([1.0, jnp.nan])}))
+        assert not bool(all_finite({"a": jnp.array([jnp.inf])}))
+        # int leaves are ignored
+        assert bool(all_finite({"a": jnp.array([1, 2, 3])}))
+
+    def test_guard_update(self):
+        old = {"w": jnp.zeros(2), "n": jnp.array(0)}
+        new = {"w": jnp.ones(2), "n": jnp.array(1)}
+        kept = guard_update(old, new, jnp.array(False))
+        np.testing.assert_array_equal(np.asarray(kept["w"]), [0.0, 0.0])
+        assert int(kept["n"]) == 0
+        taken = guard_update(old, new, jnp.array(True))
+        np.testing.assert_array_equal(np.asarray(taken["w"]), [1.0, 1.0])
+
+    def test_nan_batch_skips_update(self):
+        """A poisoned batch must leave params bit-identical."""
+        cfg = _cfg()
+        tcfg = TrainConfig(warmup_steps=0, learning_rate=1e-3)
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+        step = make_train_step(cfg, tcfg)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        bad = {
+            "inputs": tokens,
+            "targets": tokens,
+            "mask": jnp.full((2, 16), jnp.nan, jnp.float32),
+        }
+        before = jax.device_get(state.params["embed"])
+        state, metrics = step(state, bad)
+        assert float(metrics["update_skipped"]) == 1.0
+        np.testing.assert_array_equal(before, jax.device_get(state.params["embed"]))
+
+    def test_failure_detector(self):
+        det = FailureDetector(patience=2)
+        for _ in range(10):
+            assert det.check(1.0) is None
+        assert det.check(float("nan")) is None  # first strike
+        reason = det.check(float("nan"))  # second strike trips
+        assert reason is not None and "non-finite" in reason
+        det.reset()
+        assert det.check(1.0) is None
+        # explosion detection
+        det2 = FailureDetector(patience=1, loss_explosion_factor=5.0)
+        for _ in range(5):
+            det2.check(2.0)
+        assert det2.check(100.0) is not None
+
+    def test_heartbeat(self, tmp_path):
+        path = str(tmp_path / "hb" / "heart.json")
+        hb = Heartbeat(path, process_index=0)
+        assert hb.age() is None
+        hb.beat(7)
+        assert hb.age() < 5.0
+        assert not Heartbeat.is_stale(path, timeout=60.0)
+        assert Heartbeat.is_stale(str(tmp_path / "nope.json"), timeout=1.0)
+
+
+class TestData:
+    def test_shard_roundtrip(self, tmp_path):
+        toks = np.arange(1000, dtype=np.int32)
+        p = str(tmp_path / "shard0.bin")
+        write_token_shard(p, toks)
+        np.testing.assert_array_equal(read_token_shard(p), toks)
+
+    def test_bad_magic_raises(self, tmp_path):
+        p = str(tmp_path / "junk.bin")
+        with open(p, "wb") as f:
+            f.write(b"JUNKJUNKJUNKJUNKJUNK")
+        with pytest.raises(ValueError, match="bad magic"):
+            read_token_shard(p)
+
+    def test_token_batches_shapes(self):
+        it = token_batches(
+            np.arange(500, dtype=np.int32), batch_size=4, seq_len=16, num_batches=3
+        )
+        batches = list(it)
+        assert len(batches) == 3
+        for b in batches:
+            assert b["inputs"].shape == (4, 16)
+            np.testing.assert_array_equal(b["inputs"][:, 1:], b["targets"][:, :-1])
+
+    def test_shard_batches_python_fallback(self, tmp_path):
+        paths = []
+        for i in range(2):
+            p = str(tmp_path / f"s{i}.bin")
+            write_token_shard(p, np.arange(300, dtype=np.int32) + 300 * i)
+            paths.append(p)
+        batches = list(
+            shard_batches(paths, batch_size=2, seq_len=8, num_batches=2)
+        )
+        assert len(batches) == 2
+        assert batches[0]["inputs"].dtype == np.int32
+
+    def test_device_prefetch(self):
+        it = token_batches(
+            np.arange(200, dtype=np.int32), batch_size=2, seq_len=8, num_batches=4
+        )
+        out = list(device_prefetch(it))
+        assert len(out) == 4
+        assert isinstance(out[0]["inputs"], jax.Array)
+
+
+class TestFit:
+    def test_fit_end_to_end_with_resume(self, tmp_path):
+        cfg = _cfg()
+        tcfg = TrainConfig(
+            warmup_steps=0, learning_rate=3e-3, total_steps=6
+        )
+        data = token_batches(
+            np.tile(np.arange(32, dtype=np.int32), 50),
+            batch_size=2, seq_len=16, num_batches=100,
+        )
+        ckdir = str(tmp_path / "run")
+        state = fit(
+            cfg, tcfg, data,
+            checkpoint_dir=ckdir, checkpoint_every=3, log_every=2,
+            log_path=str(tmp_path / "log.jsonl"),
+            heartbeat_path=str(tmp_path / "hb.json"),
+        )
+        assert int(jax.device_get(state.step)) == 6
+        assert os.path.exists(str(tmp_path / "log.jsonl"))
+
+        # Resume: raise total_steps and continue from the saved step 6.
+        tcfg2 = tcfg.replace(total_steps=8)
+        data2 = token_batches(
+            np.tile(np.arange(32, dtype=np.int32), 50),
+            batch_size=2, seq_len=16, num_batches=100,
+        )
+        state2 = fit(cfg, tcfg2, data2, checkpoint_dir=ckdir, log_every=2)
+        assert int(jax.device_get(state2.step)) == 8
+
+    def test_fit_sharded(self, mesh_fsdp8):
+        cfg = _cfg().replace(d_model=128, vocab_size=512)
+        tcfg = TrainConfig(warmup_steps=0, total_steps=3)
+        bs = batch_shardings(mesh_fsdp8)
+        from shellac_tpu.training.data import device_prefetch, token_batches
+
+        data = device_prefetch(
+            token_batches(
+                np.arange(5000, dtype=np.int32) % 512,
+                batch_size=8, seq_len=16, num_batches=10,
+            ),
+            sharding=bs,
+        )
+        state = fit(cfg, tcfg, data, mesh=mesh_fsdp8, log_every=1)
+        assert int(jax.device_get(state.step)) == 3

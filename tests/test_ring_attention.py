@@ -67,9 +67,27 @@ class TestRingAttention:
             np.asarray(dense), np.asarray(ringed), rtol=1e-4, atol=1e-4
         )
 
-    def test_window_with_sp_raises(self, mesh_sp4):
+    def test_window_with_sp_falls_back_to_dense(self, mesh_sp4):
+        """auto + sliding window on an sp mesh must still work (dense path)."""
         cfg = get_model_config("tiny").replace(attn_window=8, dtype="float32")
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-        tokens = jnp.zeros((1, 32), jnp.int32)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+        dense = transformer.forward(cfg, params, tokens)
+        sharded = jax.jit(
+            lambda p, t: transformer.forward(cfg, p, t, mesh=mesh_sp4)
+        )(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(sharded), rtol=1e-4, atol=1e-4
+        )
+        # Explicit ring with a window is a contradiction -> error.
         with pytest.raises(NotImplementedError):
-            transformer.forward(cfg, params, tokens, mesh=mesh_sp4)
+            transformer.forward(
+                cfg, params, tokens, mesh=mesh_sp4, attn_impl="ring"
+            )
+
+    def test_ring_without_sp_raises(self):
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((1, 16), jnp.int32)
+        with pytest.raises(ValueError, match="requires a mesh with sp"):
+            transformer.forward(cfg, params, tokens, attn_impl="ring")
