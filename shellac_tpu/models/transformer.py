@@ -155,21 +155,37 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
         from shellac_tpu.parallel.mesh import AXIS_SEQ
 
         sp_active = mesh is not None and mesh.shape.get(AXIS_SEQ, 1) > 1
-        if attn_impl == "ring":
+        if attn_impl in ("ring", "ulysses"):
             if not sp_active:
                 raise ValueError(
-                    "attn_impl='ring' requires a mesh with sp > 1; got "
+                    f"attn_impl={attn_impl!r} requires a mesh with sp > 1; got "
                     f"mesh={'None' if mesh is None else dict(mesh.shape)}"
                 )
-            if cfg.attn_window is not None:
+            if attn_impl == "ring" and cfg.attn_window is not None:
                 raise NotImplementedError(
-                    "ring attention does not support sliding windows"
+                    "ring attention does not support sliding windows; "
+                    "use attn_impl='ulysses'"
                 )
-        # 'auto' on an sp mesh uses ring only when it can express the
-        # config; a window falls back to dense attention (GSPMD gathers
-        # the sequence — slower, but the config keeps working).
+        from shellac_tpu.parallel.ulysses import ulysses_supported
+
+        ulysses_ok = sp_active and ulysses_supported(h, hkv, mesh)
+        if attn_impl == "ulysses" and not ulysses_ok:
+            raise ValueError(
+                f"attn_impl='ulysses' needs per-device head counts divisible "
+                f"by sp: n_heads={h}, n_kv_heads={hkv}, "
+                f"mesh={dict(mesh.shape)}"
+            )
+        # 'auto' on an sp mesh: ring for plain causal (O(S/sp) kv memory),
+        # ulysses for windowed attention (full local sequence, so the
+        # window mask applies directly); dense fallback only when neither
+        # can express the config (GSPMD gathers the sequence — slower,
+        # but the config keeps working).
         use_ring = attn_impl == "ring" or (
             attn_impl == "auto" and sp_active and cfg.attn_window is None
+        )
+        use_ulysses = attn_impl == "ulysses" or (
+            attn_impl == "auto" and sp_active and cfg.attn_window is not None
+            and ulysses_ok
         )
         if use_ring:
             # Sequence is sharded over sp: ring attention keeps kv local
@@ -178,6 +194,12 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
             from shellac_tpu.parallel.ring_attention import ring_attention
 
             o = ring_attention(q, k, v, mesh, causal=True)
+        elif use_ulysses:
+            from shellac_tpu.parallel.ulysses import ulysses_attention
+
+            o = ulysses_attention(
+                q, k, v, mesh, causal=True, window=cfg.attn_window
+            )
         else:
             o = attention(
                 q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl

@@ -8,6 +8,7 @@ from shellac_tpu.parallel.mesh import (
     factor_devices,
     make_mesh,
 )
+from shellac_tpu.parallel.ulysses import ulysses_attention, ulysses_supported
 from shellac_tpu.parallel.sharding import (
     DEFAULT_RULES,
     constrain,
@@ -30,4 +31,6 @@ __all__ = [
     "make_shardings",
     "shard_pytree",
     "constrain",
+    "ulysses_attention",
+    "ulysses_supported",
 ]

@@ -1,0 +1,137 @@
+"""Ulysses-style (all-to-all) sequence parallelism over the 'sp' mesh axis.
+
+The complement to ring attention (parallel/ring_attention.py): instead of
+rotating kv chunks around a ring, two `all_to_all` collectives reshard
+the activations so attention itself is embarrassingly parallel.
+
+Each sp rank enters holding a contiguous sequence chunk of q/k/v
+(B, S/n, H, D). The first all-to-all trades the sequence sharding for a
+head sharding: every rank ends up with the FULL sequence for H/n heads.
+Local attention then needs no communication at all — so it supports
+sliding windows and arbitrary masks, and it can use the Pallas flash
+kernel as-is (both things the ring cannot do without extra machinery).
+A second all-to-all restores the sequence sharding for the residual
+stream.
+
+Cost model: 2 all-to-alls moving O(B·S·H·D / n) per device over ICI,
+independent of sequence length per hop, vs the ring's n ppermutes of kv.
+Ulysses wins when H is large relative to n and masks are irregular; ring
+wins on kv memory (O(S/n) holds throughout) and when H/n would round
+badly. Both are exposed; `auto` in the model picks ring for plain causal
+and ulysses for windowed attention on an sp mesh.
+
+GQA: kv heads are split over sp like q heads when divisible; otherwise
+kv is broadcast to full multi-head (a memory cost, never a correctness
+change). Backward is jax autodiff through the collectives (all_to_all is
+its own transpose up to permutation).
+
+No reference citation is possible: the reference mount is empty
+(SURVEY.md §0). The design follows the public DeepSpeed-Ulysses idea,
+re-expressed as shard_map + lax.all_to_all so GSPMD sees static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from shellac_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
+
+
+def ulysses_supported(
+    n_heads: int, n_kv_heads: int, mesh: Mesh, *, axis_name: str = AXIS_SEQ
+) -> bool:
+    """Can ulysses run for these head counts on this mesh?
+
+    Heads are already sharded over tp before the sp all-to-all, so the
+    per-device head count (H / tp) must split evenly over sp.
+    """
+    n = mesh.shape.get(axis_name, 1)
+    tp = mesh.shape.get(AXIS_TENSOR, 1)
+    if n_heads % tp or n_kv_heads % tp:
+        return False
+    return (n_heads // tp) % n == 0
+
+
+def _ulysses_local(
+    q, k, v, *, axis_name: str, causal: bool, window: Optional[int],
+    scale: float, impl: str,
+):
+    """Runs on one device inside shard_map.
+
+    q: (B, S_loc, H_loc, D); k, v: (B, S_loc, Hkv_loc, D) — local shapes.
+    """
+    from shellac_tpu.ops.attention import attention
+
+    n = jax.lax.axis_size(axis_name)
+    b, s_loc, h_loc, dh = q.shape
+    hkv_loc = k.shape[2]
+    if h_loc % n:
+        raise ValueError(
+            f"ulysses: local head count {h_loc} not divisible by sp={n}"
+        )
+    if hkv_loc % n:
+        # Repeat kv heads to the smallest count that splits evenly over
+        # sp: lcm(hkv_loc, n). It divides h_loc (hkv_loc and n both do),
+        # so GQA grouping downstream stays valid, and it beats
+        # broadcasting to the full q head count on kv memory/bandwidth.
+        import math
+
+        hkv_new = math.lcm(hkv_loc, n)
+        rep = hkv_new // hkv_loc
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    # seq-sharded -> head-sharded: (B, S_loc, H_loc, D) -> (B, S, H_loc/n, D)
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name,
+        split_axis=2, concat_axis=1, tiled=True,
+    )
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)
+
+    o = attention(qh, kh, vh, causal=causal, window=window, scale=scale, impl=impl)
+
+    # head-sharded -> seq-sharded
+    return jax.lax.all_to_all(
+        o, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    axis_name: str = AXIS_SEQ,
+    impl: str = "auto",
+) -> jax.Array:
+    """All-to-all sequence-parallel attention. q (B,S,H,D); k,v (B,S,Hkv,D).
+
+    S is globally sharded over `axis_name`; batch over dp/fsdp; heads over
+    tp. Returns (B,S,H,D) with the same sharding as q. `impl` is forwarded
+    to the local attention dispatch ("auto" uses the flash kernel on TPU).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    q_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
+    kv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
+    fn = shard_map(
+        functools.partial(
+            _ulysses_local, axis_name=axis_name, causal=causal,
+            window=window, scale=float(scale), impl=impl,
+        ),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -97,7 +97,8 @@ class Loader {
       munmap(base, st.st_size);
       return path + ": unsupported version";
     }
-    if (kHeaderSize + count * sizeof(int32_t) > (uint64_t)st.st_size) {
+    // Divide instead of multiplying: count * 4 can wrap uint64.
+    if (count > ((uint64_t)st.st_size - kHeaderSize) / sizeof(int32_t)) {
       munmap(base, st.st_size);
       return path + ": truncated payload";
     }
@@ -165,7 +166,10 @@ class Loader {
           ++si;
         }
         const Shard& sh = shards_[si];
-        uint64_t start = rng.below(sh.num_tokens - seq_len_ - 1);
+        // Valid starts: [0, num_tokens - seq_len - 1], i.e. num_tokens -
+        // seq_len choices (start() guarantees num_tokens >= seq_len + 1,
+        // so the bound is >= 1 and below() never sees 0).
+        uint64_t start = rng.below(sh.num_tokens - seq_len_);
         const int32_t* w = sh.tokens + start;
         memcpy(&b.inputs[(size_t)row * seq_len_], w,
                seq_len_ * sizeof(int32_t));

@@ -65,7 +65,9 @@ def token_batches(
     rng = np.random.default_rng(seed)
     produced = 0
     while num_batches is None or produced < num_batches:
-        starts = rng.integers(0, tokens.size - seq_len - 1, size=batch_size)
+        # Valid starts are [0, size - seq_len - 1] inclusive: the window
+        # takes seq_len + 1 tokens. integers() has an exclusive high.
+        starts = rng.integers(0, tokens.size - seq_len, size=batch_size)
         window = np.stack([tokens[s : s + seq_len + 1] for s in starts])
         yield {"inputs": window[:, :-1], "targets": window[:, 1:]}
         produced += 1
@@ -126,7 +128,9 @@ def device_prefetch(
         try:
             for batch in it:
                 q.put(put(batch))
-        finally:
+        except BaseException as e:  # re-raised in the consumer
+            q.put(e)
+        else:
             q.put(stop)
 
     t = threading.Thread(target=worker, daemon=True)
@@ -135,4 +139,6 @@ def device_prefetch(
         item = q.get()
         if item is stop:
             return
+        if isinstance(item, BaseException):
+            raise item
         yield item

@@ -42,12 +42,17 @@ def fit(
         ckpt = Checkpointer(checkpoint_dir)
 
     key = jax.random.PRNGKey(train_cfg.seed)
-    state = init_train_state(model_cfg, train_cfg, key, mesh=mesh)
     if ckpt is not None and resume and ckpt.latest_step() is not None:
-        abstract = jax.eval_shape(lambda s: s, state)
+        # Never materialize the random init just to throw it away: trace
+        # it abstractly for the state structure, restore into that.
+        abstract = jax.eval_shape(
+            lambda: init_train_state(model_cfg, train_cfg, key, mesh=mesh)
+        )
         state = ckpt.restore(
             abstract_state=abstract, mesh=mesh, model_cfg=model_cfg
         )
+    else:
+        state = init_train_state(model_cfg, train_cfg, key, mesh=mesh)
 
     step_fn = make_train_step(
         model_cfg, train_cfg, mesh=mesh,
@@ -87,6 +92,7 @@ def fit(
                     )
                 restores += 1
                 abstract = jax.eval_shape(lambda s: s, state)
+                state = None  # free the diverged state before restoring
                 state = ckpt.restore(
                     abstract_state=abstract, mesh=mesh, model_cfg=model_cfg
                 )

@@ -1,0 +1,140 @@
+"""Ulysses (all-to-all sequence parallelism) vs the dense reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import ParallelConfig, get_model_config, make_mesh
+from shellac_tpu.models import transformer
+from shellac_tpu.ops.attention import attention_ref
+from shellac_tpu.parallel.ulysses import ulysses_attention, ulysses_supported
+
+
+@pytest.fixture(scope="module")
+def mesh_sp4():
+    return make_mesh(ParallelConfig(sp=4, tp=2))
+
+
+class TestUlyssesAttention:
+    def test_causal_matches_ref(self, mesh_sp4):
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(2, 64, 8, 32)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 64, 8, 32)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 64, 8, 32)).astype(np.float32))
+        got = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh_sp4))(q, k, v)
+        want = attention_ref(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    def test_window_matches_ref(self, mesh_sp4):
+        """Sliding windows work (the thing ring attention cannot do)."""
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.normal(size=(1, 64, 8, 16)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 64, 8, 16)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 64, 8, 16)).astype(np.float32))
+        got = jax.jit(
+            lambda q, k, v: ulysses_attention(q, k, v, mesh_sp4, window=16)
+        )(q, k, v)
+        want = attention_ref(q, k, v, causal=True, window=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    def test_gqa_broadcast_path(self, mesh_sp4):
+        """kv heads not divisible by sp: broadcast fallback stays correct."""
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.normal(size=(2, 32, 8, 16)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 32, 2, 16)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 32, 2, 16)).astype(np.float32))
+        got = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh_sp4))(q, k, v)
+        want = attention_ref(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    def test_gqa_lcm_repeat_path(self, mesh_sp4):
+        """hkv repeats only to lcm(hkv_loc, sp), not full broadcast: h=16
+        hkv=4 on tp=2/sp=4 gives hkv_loc=2 -> 4 repeated heads vs 8."""
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.normal(size=(2, 32, 16, 16)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 32, 4, 16)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 32, 4, 16)).astype(np.float32))
+        got = jax.jit(lambda q, k, v: ulysses_attention(q, k, v, mesh_sp4))(q, k, v)
+        want = attention_ref(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    def test_explicit_ulysses_unsupported_heads_raises(self, mesh_sp4):
+        """Explicit attn_impl='ulysses' with indivisible heads -> clear error."""
+        cfg = get_model_config("tiny").replace(
+            d_model=64, n_heads=4, vocab_size=512, dtype="float32"
+        )
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, 32), jnp.int32)
+        with pytest.raises(ValueError, match="divisible by sp"):
+            transformer.forward(
+                cfg, params, tokens, mesh=mesh_sp4, attn_impl="ulysses"
+            )
+
+    def test_grads_match_ref(self, mesh_sp4):
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(1, 32, 8, 16)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 32, 8, 16)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 32, 8, 16)).astype(np.float32))
+        g1 = jax.grad(
+            lambda q, k, v: ulysses_attention(q, k, v, mesh_sp4).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda q, k, v: attention_ref(q, k, v, causal=True).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
+
+    def test_supported_predicate(self, mesh_sp4):
+        assert ulysses_supported(8, 8, mesh_sp4)  # 8/tp2 = 4, % sp4 == 0
+        assert not ulysses_supported(4, 4, mesh_sp4)  # 4/tp2 = 2, % sp4 != 0
+        assert not ulysses_supported(6, 6, mesh_sp4)  # 6 % tp2 == 0, 3 % 4 != 0
+
+    def test_model_forward_ulysses_matches_dense(self, mesh_sp4):
+        cfg = get_model_config("tiny").replace(
+            d_model=64, n_heads=8, vocab_size=512, dtype="float32"
+        )
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+        dense = transformer.forward(cfg, params, tokens)
+        sharded = jax.jit(
+            lambda p, t: transformer.forward(cfg, p, t, mesh=mesh_sp4, attn_impl="ulysses")
+        )(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(sharded), rtol=1e-4, atol=1e-4
+        )
+
+    def test_model_auto_uses_ulysses_for_window(self, mesh_sp4, monkeypatch):
+        """auto + window + sp routes to ulysses (not dense) and stays correct."""
+        import shellac_tpu.parallel.ulysses as ulysses_mod
+
+        calls = []
+        real = ulysses_mod.ulysses_attention
+
+        def spy(*args, **kw):
+            calls.append(1)
+            return real(*args, **kw)
+
+        monkeypatch.setattr(ulysses_mod, "ulysses_attention", spy)
+        cfg = get_model_config("tiny").replace(
+            d_model=64, n_heads=8, vocab_size=512, attn_window=8, dtype="float32"
+        )
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+        dense = transformer.forward(cfg, params, tokens)
+        sharded = jax.jit(
+            lambda p, t: transformer.forward(cfg, p, t, mesh=mesh_sp4)
+        )(params, tokens)
+        assert calls, "auto+window+sp did not route through ulysses_attention"
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(sharded), rtol=1e-4, atol=1e-4
+        )
+
+    def test_ulysses_without_sp_raises(self):
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((1, 16), jnp.int32)
+        with pytest.raises(ValueError, match="requires a mesh with sp"):
+            transformer.forward(cfg, params, tokens, attn_impl="ulysses")
