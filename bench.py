@@ -28,10 +28,10 @@ def main():
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
     if on_tpu:
-        # batch 4 sits ~50M over the 15.75G HBM line on current libtpu
-        # (grad_accum costs more: the fp32 grad carry adds ~4G).
+        # Batch 6 is the single-chip sweet spot with bf16 adam mu and the
+        # Pallas flash backward (batch 8 fits but is marginally slower).
         cfg = get_model_config("shellac-1b")
-        batch, seq, steps = 2, 2048, 10
+        batch, seq, steps = 6, 2048, 10
     else:
         cfg = get_model_config("tiny")
         batch, seq, steps = 4, 128, 3

@@ -1,0 +1,53 @@
+"""Perf sweep for the single-chip training bench.
+
+Usage: python scripts/bench_sweep.py batch=2 remat=1 [steps=10]
+Prints one JSON line per run; OOM exits nonzero.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def run(batch, remat, steps=10, seq=2048):
+    from shellac_tpu import get_model_config
+    from shellac_tpu.config import TrainConfig
+    from shellac_tpu.training import init_train_state, make_train_step
+
+    cfg = get_model_config("shellac-1b").replace(remat=bool(remat))
+    tcfg = TrainConfig(warmup_steps=10, total_steps=1000)
+    state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tcfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size
+    )
+    data = {"inputs": tokens, "targets": tokens}
+
+    state, metrics = step(state, data)
+    float(metrics["loss"])  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, data)
+    loss = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    from shellac_tpu.models.transformer import num_params
+
+    n = num_params(state.params)
+    flops_tok = 6 * n + 12 * cfg.n_layers * cfg.d_model * seq
+    tok_s = batch * seq / dt
+    print(json.dumps({
+        "batch": batch, "remat": bool(remat),
+        "tok_s": round(tok_s, 1), "step_s": round(dt, 4),
+        "mfu": round(tok_s * flops_tok / 197e12, 4), "loss": round(loss, 3),
+    }))
+
+
+if __name__ == "__main__":
+    kw = dict(kv.split("=") for kv in sys.argv[1:])
+    run(int(kw.get("batch", 2)), int(kw.get("remat", 1)),
+        int(kw.get("steps", 10)))

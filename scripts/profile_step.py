@@ -1,0 +1,69 @@
+"""Break a train step into fwd / fwd+bwd / full-step timings."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(f, *args, n=10):
+    out = f(*args)
+    jax.tree.map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
+        out,
+    )
+    # Force a host sync (block_until_ready alone is unreliable on the relay).
+    float(jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = f(*args)
+    float(jnp.asarray(jax.tree.leaves(out)[0]).ravel()[0])
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    from shellac_tpu import get_model_config
+    from shellac_tpu.config import TrainConfig
+    from shellac_tpu.models import transformer
+    from shellac_tpu.training import init_train_state, make_train_step
+    from shellac_tpu.training.losses import cross_entropy
+
+    cfg = get_model_config("shellac-1b")
+    tcfg = TrainConfig(warmup_steps=10, total_steps=1000)
+    batch, seq = 4, 2048
+    params = jax.jit(transformer.init_params, static_argnums=0)(
+        cfg, jax.random.PRNGKey(0)
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
+    data = {"inputs": tokens, "targets": tokens}
+
+    def loss_fn(params, batch):
+        logits = transformer.forward(cfg, params, batch["inputs"])
+        loss, _ = cross_entropy(logits, batch["targets"], None, 0.0)
+        return loss
+
+    fwd = jax.jit(loss_fn)
+    grad = jax.jit(lambda p, b: jax.grad(loss_fn)(p, b))
+    step = make_train_step(cfg, tcfg)
+
+    t_fwd = timeit(fwd, params, data)
+    print(f"fwd only:      {t_fwd*1e3:8.1f} ms")
+    t_grad = timeit(grad, params, data)
+    print(f"fwd+bwd:       {t_grad*1e3:8.1f} ms")
+    del params
+
+    state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+    s2, m = step(state, data)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        s2, m = step(s2, data)
+    float(m["loss"])
+    t_step = (time.perf_counter() - t0) / n
+    print(f"full step:     {t_step*1e3:8.1f} ms")
+    print(f"optimizer+etc: {(t_step-t_grad)*1e3:8.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

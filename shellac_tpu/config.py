@@ -156,6 +156,10 @@ class TrainConfig:
     b2: float = 0.95
     eps: float = 1e-8
     grad_clip_norm: float = 1.0
+    # Dtype for adam's first moment. bf16 halves its HBM footprint with
+    # negligible quality impact (the update is still computed in fp32);
+    # the second moment stays fp32 for dynamic range.
+    mu_dtype: str = "bfloat16"
     # Number of microbatches accumulated per optimizer step (1 = no accum).
     grad_accum: int = 1
     z_loss_weight: float = 0.0

@@ -7,9 +7,11 @@ entirely (no compute), which halves the work for causal prefill. GQA is
 handled in the index map: the kv block for q-head h is head h // group,
 so kv tiles are never replicated in HBM.
 
-Backward: custom VJP that recomputes through the einsum reference. This
-is correct and rematerialization-friendly (the model already wraps blocks
-in jax.checkpoint); a blocked Pallas backward is a planned optimization.
+Backward: blocked Pallas kernels as well. The forward additionally
+writes the logsumexp rows; backward recomputes tile probabilities from
+(q, k, lse) — never materializing the S×S matrix — in two passes:
+one over kv blocks producing dk/dv (GQA group summed in-kernel), one
+over q blocks producing dq. Causal dead blocks are skipped in both.
 
 The compiled kernel wants lane-aligned head_dim (multiple of 128) and
 block-divisible sequence lengths; `flash_supported` gates dispatch and
@@ -27,9 +29,22 @@ from jax.experimental import pallas as pl
 
 from shellac_tpu.ops.dispatch import pallas_supported
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# Tuned on v5e at (B=4, S=2048, H=16, Hkv=8, D=128): 512/1024 beats
+# 256/256 by ~30% forward and ~2x on the backward pass.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -2.0e38
+
+
+def _fit_block(seq: int, block: int) -> int:
+    """Largest divisor of `seq` that is <= `block` and a multiple of 8
+    (TPU sublane tiling); 0 if none exists."""
+    b = min(block, seq)
+    while b >= 8:
+        if seq % b == 0 and b % 8 == 0:
+            return b
+        b -= 8
+    return 0
 
 
 def flash_supported(
@@ -51,15 +66,50 @@ def flash_supported(
         return False
     if d % 128 != 0:
         return False
-    if sq % min(block_q, sq) != 0 or sk % min(block_k, sk) != 0:
+    if _fit_block(sq, block_q) == 0 or _fit_block(sk, block_k) == 0:
         return False
     if h % hkv != 0:
         return False
     return True
 
 
+def _scores(q_blk, k_blk, q_start, k_start, scale, causal):
+    """Scaled (block_q, block_k) fp32 logits with the causal mask applied."""
+    q = q_blk.astype(jnp.float32) * scale
+    k = k_blk.astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if causal:
+        shape = s.shape
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+        s = jnp.where(cols <= rows, s, NEG_INF)
+    return s
+
+
+def _tile_p_ds(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    q_start, k_start, scale, causal,
+):
+    """Recompute a probability tile and its score gradient from saved lse.
+
+    Shared by both backward kernels so the masking/lse handling cannot
+    drift between dq and dk/dv. Returns (p, ds), both (block_q, block_k)
+    fp32; ds carries the softmax scale factor.
+    """
+    s = _scores(q_ref[0], k_ref[0], q_start, k_start, scale, causal)
+    p = jnp.exp(s - lse_ref[0, 0, :][:, None])  # exact softmax rows
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0, 0, :][:, None]) * scale
+    return p, ds
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
     *, scale: float, causal: bool, block_q: int, block_k: int, num_kv: int,
 ):
     qi = pl.program_id(1)
@@ -85,21 +135,8 @@ def _flash_kernel(
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
         v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (block_q, block_k)
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            cols = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(cols <= rows, s, NEG_INF)
-
+        s = _scores(q_ref[0], k_ref[0], q_start, k_start, scale, causal)
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -121,6 +158,7 @@ def _flash_kernel(
         # Guard fully-masked rows (can't happen for causal, cheap anyway).
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = (m_ref[:, :1] + jnp.log(l))[:, 0]
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -129,8 +167,8 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
     g = h // hkv
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _fit_block(sq, block_q) or min(block_q, sq)
+    block_k = _fit_block(sk, block_k) or min(block_k, sk)
     num_q = sq // block_q
     num_kv = sk // block_k
 
@@ -148,7 +186,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             ki = jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k)
         return kv_bh, ki, 0
 
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel,
             scale=scale,
@@ -157,14 +195,22 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             block_k=block_k,
             num_kv=num_kv,
         ),
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            # (B*H, 1, S): the unit middle dim keeps the block's trailing
+            # two dims TPU-tileable ((1, block_q) alone is not).
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ],
         grid=(b * h, num_q, num_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_k, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -172,28 +218,212 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse[:, 0, :]
+
+
+def _flash_bwd_dkdv_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+    num_q: int, inner: int,
+):
+    """Grid (B*Hkv, kv_blocks, G*q_blocks): one (dk, dv) tile per kv block,
+    accumulated over every q block of every q-head in the GQA group."""
+    ki = pl.program_id(1)
+    j = pl.program_id(2)
+    qi = j % num_q
+
+    k_start = ki * block_k
+    q_start = qi * block_q
+    live = (not causal) or (q_start + block_q - 1 >= k_start)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(live)
+    def _compute():
+        p, ds = _tile_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            q_start, k_start, scale, causal,
+        )
+        do = do_ref[0]
+        # dv += p^T do
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dk += ds^T q_raw  (ds carries the softmax scale)
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0],
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == inner - 1)
+    def _write():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(
+    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int, num_kv: int,
+):
+    """Grid (B*H, q_blocks, kv_blocks): one dq tile per q block."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    if causal:
+        last_ki = jnp.minimum(num_kv - 1, (q_start + block_q - 1) // block_k)
+        live = k_start <= q_start + block_q - 1
+    else:
+        last_ki = num_kv - 1
+        live = True
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    @pl.when(live)
+    def _compute():
+        _, ds = _tile_p_ds(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            q_start, k_start, scale, causal,
+        )
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == last_ki)
+    def _write():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, o, lse, g_out, causal, scale, block_q, block_k, interpret
+):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, h, d = q.shape
+    _, sk, hkv, _ = k.shape
+    g = h // hkv
+    block_q = _fit_block(sq, block_q) or min(block_q, sq)
+    block_k = _fit_block(sk, block_k) or min(block_k, sk)
+    num_q = sq // block_q
+    num_kv = sk // block_k
+
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    dof = g_out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # delta_i = sum_d dO_id * O_id, per (head, row) — fp32. Shaped with a
+    # unit middle dim (like lse) so blocks stay TPU-tileable.
+    delta = jnp.einsum(
+        "bshd,bshd->bhs", g_out.astype(jnp.float32), o.astype(jnp.float32)
+    ).reshape(b * h, 1, sq)
+    lse = lse.reshape(b * h, 1, sq)
+
+    # --- pass 1: dk, dv (GQA group summed in-kernel) ---
+    inner = g * num_q
+
+    def q_row(bkv, ki, j):
+        # q-head row for this (kv head, group member) pair.
+        return (bkv // hkv) * h + (bkv % hkv) * g + j // num_q
+
+    def q_index(bkv, ki, j):
+        qi = j % num_q
+        if causal:
+            # Clamp dead pre-diagonal q blocks to the first live one so
+            # the pipeline issues no DMA for skipped blocks.
+            qi = jnp.maximum(qi, (ki * block_k) // block_q)
+        return q_row(bkv, ki, j), qi, 0
+
+    def row_index(bkv, ki, j):
+        qi = j % num_q
+        if causal:
+            qi = jnp.maximum(qi, (ki * block_k) // block_q)
+        return q_row(bkv, ki, j), 0, qi
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkdv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q=num_q, inner=inner,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(kf.shape, k.dtype),
+            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+        ],
+        grid=(b * hkv, num_kv, inner),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, block_q, d), q_index),
+            pl.BlockSpec((1, 1, block_q), row_index),
+            pl.BlockSpec((1, 1, block_q), row_index),
+            pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, dof, lse, delta, kf, vf)
+
+    # --- pass 2: dq ---
+    def kv_index(bh, qi, ki):
+        kv_bh = (bh // h) * hkv + (bh % h) // g
+        if causal:
+            ki = jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k)
+        return kv_bh, ki, 0
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_kv=num_kv,
+        ),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, dof, lse, delta, kf, vf)
+
+    unflat = lambda x, hh: x.reshape(b, hh, -1, d).transpose(0, 2, 1, 3)
+    return unflat(dq, h), unflat(dk, hkv), unflat(dv, hkv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g_out):
-    from shellac_tpu.ops.attention import attention_ref
-
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_ref(q_, k_, v_, causal=causal, scale=scale),
-        q, k, v,
+    q, k, v, o, lse = res
+    return _flash_backward(
+        q, k, v, o, lse, g_out, causal, scale, block_q, block_k, interpret
     )
-    return vjp(g_out)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

@@ -86,8 +86,32 @@ class Checkpointer:
                 abstract_state,
                 shardings,
             )
+        try:
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state)
+            )
+        except Exception:
+            # Dtype drift (e.g. a checkpoint written with fp32 adam mu
+            # restored under a bf16-mu config): re-read each leaf in its
+            # saved dtype, then cast to the requested one.
+            restored = self._restore_saved_dtypes(step, abstract_state)
+            return jax.tree.map(
+                lambda x, a: x.astype(a.dtype) if x.dtype != a.dtype else x,
+                restored,
+                abstract_state,
+            )
+
+    def _restore_saved_dtypes(self, step: int, abstract_state: Any) -> Any:
+        meta = self._mngr.item_metadata(step)
+        as_saved = jax.tree.map(
+            lambda a, m: jax.ShapeDtypeStruct(
+                a.shape, m.dtype, sharding=getattr(a, "sharding", None)
+            ),
+            abstract_state,
+            meta,
+        )
         return self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state)
+            step, args=ocp.args.StandardRestore(as_saved)
         )
 
     def wait(self) -> None:

@@ -9,7 +9,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import optax
 
-from shellac_tpu.config import TrainConfig
+from shellac_tpu.config import TrainConfig, resolve_dtype
 
 
 def make_schedule(cfg: TrainConfig) -> optax.Schedule:
@@ -46,7 +46,10 @@ def _decay_mask(params):
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip_norm),
-        optax.scale_by_adam(b1=cfg.b1, b2=cfg.b2, eps=cfg.eps),
+        optax.scale_by_adam(
+            b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+            mu_dtype=resolve_dtype(cfg.mu_dtype),
+        ),
         optax.add_decayed_weights(cfg.weight_decay, mask=_decay_mask),
         optax.scale_by_learning_rate(make_schedule(cfg)),
     )

@@ -49,6 +49,32 @@ class TestCheckpoint:
         )
         ckpt.close()
 
+    def test_restore_across_mu_dtype_change(self, tmp_path):
+        """A checkpoint written with fp32 adam mu restores under a bf16-mu
+        config (and vice versa): saved dtypes are cast to the requested."""
+        cfg = _cfg()
+        old = TrainConfig(warmup_steps=0, mu_dtype="float32")
+        state = init_train_state(cfg, old, jax.random.PRNGKey(0))
+        ckpt = Checkpointer(str(tmp_path / "ckpt"))
+        ckpt.save(0, state, wait=True)
+
+        new = TrainConfig(warmup_steps=0, mu_dtype="bfloat16")
+        template = init_train_state(cfg, new, jax.random.PRNGKey(1))
+        restored = ckpt.restore(
+            abstract_state=jax.eval_shape(lambda s: s, template)
+        )
+        for want, got in zip(
+            jax.tree.leaves(jax.eval_shape(lambda s: s, template)),
+            jax.tree.leaves(restored),
+        ):
+            assert want.dtype == got.dtype
+        # Params (dtype-stable leaves) survive the fallback path intact.
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            state.params, restored.params,
+        )
+        ckpt.close()
+
     def test_sharded_roundtrip(self, tmp_path, mesh8):
         cfg = _cfg().replace(d_model=128, vocab_size=512)
         tcfg = TrainConfig()

@@ -182,6 +182,31 @@ class TestFlashAttention:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grad_matches_ref_gqa(self, causal):
+        """Backward sums dk/dv over the GQA group in-kernel; check it."""
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.normal(size=(2, 64, 4, 128)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 64, 2, 128)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 64, 2, 128)).astype(np.float32))
+
+        def f_flash(q, k, v):
+            # Non-uniform cotangent so dv/dk aren't trivially symmetric.
+            out = flash_attention(q, k, v, causal=causal, block_q=32,
+                                  block_k=32, interpret=True)
+            return (out * jnp.arange(out.shape[1])[None, :, None, None]).sum()
+
+        def f_ref(q, k, v):
+            out = attention_ref(q, k, v, causal=causal)
+            return (out * jnp.arange(out.shape[1])[None, :, None, None]).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3
+            )
+
 
 class TestActivations:
     def test_swiglu(self):
