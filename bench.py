@@ -60,11 +60,14 @@ def main():
     tok_s = tokens_per_step * steps / dt
 
     from shellac_tpu.models.transformer import num_params
+    from shellac_tpu.utils.metrics import (
+        TPU_V5E_BF16_PEAK_FLOPS,
+        train_flops_per_token,
+    )
 
     n_params = num_params(state.params)
-    # Rough model FLOPs: 6 * params * tokens (fwd+bwd), + attention term.
-    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq
-    mfu_denom = 197e12 if on_tpu else None  # v5e bf16 peak ~197 TFLOP/s
+    flops_per_token = train_flops_per_token(n_params, cfg.n_layers, cfg.d_model, seq)
+    mfu_denom = TPU_V5E_BF16_PEAK_FLOPS if on_tpu else None
 
     result = {
         "metric": f"train_throughput_{cfg.d_model}d{cfg.n_layers}L_seq{seq}_{backend}",

@@ -36,14 +36,19 @@ def run(batch, remat, steps=10, seq=2048):
     dt = (time.perf_counter() - t0) / steps
 
     from shellac_tpu.models.transformer import num_params
+    from shellac_tpu.utils.metrics import (
+        TPU_V5E_BF16_PEAK_FLOPS,
+        train_flops_per_token,
+    )
 
     n = num_params(state.params)
-    flops_tok = 6 * n + 12 * cfg.n_layers * cfg.d_model * seq
+    flops_tok = train_flops_per_token(n, cfg.n_layers, cfg.d_model, seq)
     tok_s = batch * seq / dt
     print(json.dumps({
         "batch": batch, "remat": bool(remat),
         "tok_s": round(tok_s, 1), "step_s": round(dt, 4),
-        "mfu": round(tok_s * flops_tok / 197e12, 4), "loss": round(loss, 3),
+        "mfu": round(tok_s * flops_tok / TPU_V5E_BF16_PEAK_FLOPS, 4),
+        "loss": round(loss, 3),
     }))
 
 

@@ -7,8 +7,22 @@ from shellac_tpu.training.trainer import (
     make_train_step,
 )
 from shellac_tpu.training.loop import fit
+from shellac_tpu.training.lora import (
+    LoRAConfig,
+    LoRAState,
+    init_lora,
+    init_lora_state,
+    make_lora_train_step,
+    merge_lora,
+)
 
 __all__ = [
+    "LoRAConfig",
+    "LoRAState",
+    "init_lora",
+    "init_lora_state",
+    "make_lora_train_step",
+    "merge_lora",
     "cross_entropy",
     "make_optimizer",
     "make_schedule",

@@ -92,17 +92,27 @@ class Checkpointer:
             )
         except Exception:
             # Dtype drift (e.g. a checkpoint written with fp32 adam mu
-            # restored under a bf16-mu config): re-read each leaf in its
-            # saved dtype, then cast to the requested one.
-            restored = self._restore_saved_dtypes(step, abstract_state)
+            # restored under a bf16-mu config) is the one recoverable
+            # failure: confirm the saved dtypes actually differ from the
+            # requested ones before retrying, so corrupt/partial steps
+            # surface their original error instead.
+            meta = self._mngr.item_metadata(step)
+            drifted = any(
+                a.dtype != m.dtype
+                for a, m in zip(
+                    jax.tree.leaves(abstract_state), jax.tree.leaves(meta)
+                )
+            )
+            if not drifted:
+                raise
+            restored = self._restore_saved_dtypes(step, abstract_state, meta)
             return jax.tree.map(
                 lambda x, a: x.astype(a.dtype) if x.dtype != a.dtype else x,
                 restored,
                 abstract_state,
             )
 
-    def _restore_saved_dtypes(self, step: int, abstract_state: Any) -> Any:
-        meta = self._mngr.item_metadata(step)
+    def _restore_saved_dtypes(self, step: int, abstract_state: Any, meta: Any) -> Any:
         as_saved = jax.tree.map(
             lambda a, m: jax.ShapeDtypeStruct(
                 a.shape, m.dtype, sharding=getattr(a, "sharding", None)

@@ -1,0 +1,260 @@
+"""LoRA parameter-efficient fine-tuning.
+
+TPU-first design: instead of patching matmuls with per-call low-rank
+side-paths (the torch idiom of wrapping `nn.Linear`), the adapters are
+**merged into the weight pytree once per step** — `W + (alpha/r) A·B` is
+a single batched einsum over the stacked layer axis, and the merged
+weights then flow through the unmodified `transformer.forward`. XLA sees
+the exact same program it already compiles well; the merge itself is
+O(L·d·r·f) — negligible next to one forward pass — and under `remat` it
+is recomputed rather than stored.
+
+Only the adapter pytree is differentiated: the base params enter the
+jitted step as a frozen (non-donated) argument, so the optimizer state
+is rank-r small and the base weights can stay in bf16 on device.
+
+The reference repo for this project is empty (SURVEY.md §0); there is no
+upstream PEFT implementation to cite. This follows the public LoRA
+formulation (Hu et al., 2021): A ~ N(0, 1/fan_in), B = 0, scaled by
+alpha/rank.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from shellac_tpu.config import ModelConfig, TrainConfig
+from shellac_tpu.models import transformer
+from shellac_tpu.training.losses import cross_entropy
+from shellac_tpu.training.optimizer import make_optimizer
+from shellac_tpu.training.train_state import state_shardings
+
+# Dense 2-D per-layer weights LoRA can target, with their (in, out)
+# logical axis names (the "layers" axis is implicit — all are stacked).
+_TARGET_AXES: Dict[str, Tuple[Optional[str], Optional[str]]] = {
+    "wq": ("embed", "heads"),
+    "wk": ("embed", "kv_heads"),
+    "wv": ("embed", "kv_heads"),
+    "wo": ("heads", "embed"),
+    "w_gate": ("embed", "mlp"),
+    "w_up": ("embed", "mlp"),
+    "w_down": ("mlp", "embed"),
+}
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+@dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Tuple[str, ...] = DEFAULT_TARGETS
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+    def validate(self, model_cfg: ModelConfig) -> "LoRAConfig":
+        unknown = set(self.targets) - set(_TARGET_AXES)
+        if unknown:
+            raise ValueError(
+                f"unknown LoRA targets {sorted(unknown)}; "
+                f"have {sorted(_TARGET_AXES)}"
+            )
+        mlp_targets = {"w_gate", "w_up", "w_down"} & set(self.targets)
+        if model_cfg.moe is not None and mlp_targets:
+            raise NotImplementedError(
+                f"LoRA on MoE expert weights ({sorted(mlp_targets)}) is not "
+                "supported; target attention projections instead"
+            )
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        return self
+
+    def replace(self, **kw) -> "LoRAConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def init_lora(
+    model_cfg: ModelConfig, lora_cfg: LoRAConfig, key: jax.Array
+) -> Dict[str, Any]:
+    """Adapter pytree: {"layers": {target: {"a": (L,in,r), "b": (L,r,out)}}}.
+
+    B starts at zero so the adapted model is exactly the base model at
+    step 0 (standard LoRA init).
+    """
+    lora_cfg.validate(model_cfg)
+    base_shapes = jax.eval_shape(
+        lambda k: transformer.init_params(model_cfg, k), key
+    )["layers"]
+    r = lora_cfg.rank
+    pdt = model_cfg.params_dtype
+    out: Dict[str, Any] = {}
+    keys = jax.random.split(key, len(lora_cfg.targets))
+    for t, k in zip(lora_cfg.targets, keys):
+        L, fan_in, fan_out = base_shapes[t].shape
+        a = (jax.random.normal(k, (L, fan_in, r), jnp.float32)
+             * fan_in ** -0.5).astype(pdt)
+        out[t] = {"a": a, "b": jnp.zeros((L, r, fan_out), pdt)}
+    return {"layers": out}
+
+
+def lora_logical_axes(lora_cfg: LoRAConfig) -> Dict[str, Any]:
+    """Logical axes matching init_lora's structure.
+
+    The rank axis is replicated; in/out axes inherit the base weight's
+    sharding so the merge einsum is local on each device.
+    """
+    out: Dict[str, Any] = {}
+    for t in lora_cfg.targets:
+        in_ax, out_ax = _TARGET_AXES[t]
+        out[t] = {
+            "a": ("layers", in_ax, None),
+            "b": ("layers", None, out_ax),
+        }
+    return {"layers": out}
+
+
+def merge_lora(params, lora, lora_cfg: LoRAConfig):
+    """Return params with `W + scale * A @ B` for each targeted weight.
+
+    The einsum is batched over the stacked layer axis; computed in fp32
+    then cast back to the base weight dtype.
+    """
+    merged_layers = dict(params["layers"])
+    for t, ab in lora["layers"].items():
+        w = merged_layers[t]
+        delta = jnp.einsum(
+            "lir,lro->lio",
+            ab["a"].astype(jnp.float32),
+            ab["b"].astype(jnp.float32),
+        )
+        merged_layers[t] = (w.astype(jnp.float32)
+                            + lora_cfg.scale * delta).astype(w.dtype)
+    out = dict(params)
+    out["layers"] = merged_layers
+    return out
+
+
+@flax.struct.dataclass
+class LoRAState:
+    """Trainable state for a LoRA run: adapters + their optimizer state.
+
+    The frozen base params are deliberately *not* part of the state — they
+    are passed to the step separately and never donated or updated.
+    """
+
+    step: Any
+    lora: Any
+    opt_state: Any
+
+
+def init_lora_state(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    lora_cfg: LoRAConfig,
+    key: jax.Array,
+    mesh=None,
+) -> LoRAState:
+    optimizer = make_optimizer(train_cfg)
+
+    def init_fn(key):
+        lora = init_lora(model_cfg, lora_cfg, key)
+        return LoRAState(
+            step=jnp.zeros((), jnp.int32),
+            lora=lora,
+            opt_state=optimizer.init(lora),
+        )
+
+    if mesh is None:
+        return jax.jit(init_fn)(key)
+    abstract = jax.eval_shape(init_fn, key)
+    shardings = state_shardings(mesh, abstract, lora_logical_axes(lora_cfg))
+    return jax.jit(init_fn, out_shardings=shardings)(key)
+
+
+def make_lora_train_step(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    lora_cfg: LoRAConfig,
+    mesh=None,
+    attn_impl: str = "auto",
+):
+    """Build `step(state, base_params, batch) -> (state, metrics)`.
+
+    Gradients flow only into the adapters; base_params are a frozen
+    input. Under a mesh, shardings are attached lazily on first call
+    (same pattern as make_train_step).
+    """
+    lora_cfg.validate(model_cfg)
+    optimizer = make_optimizer(train_cfg)
+
+    def loss_fn(lora, base_params, batch):
+        merged = merge_lora(base_params, lora, lora_cfg)
+        logits, aux = transformer.forward(
+            model_cfg, merged, batch["inputs"], mesh=mesh,
+            attn_impl=attn_impl, return_aux=True,
+        )
+        loss, metrics = cross_entropy(
+            logits, batch["targets"], batch.get("mask"),
+            train_cfg.z_loss_weight,
+        )
+        if model_cfg.moe is not None:
+            loss = loss + aux["aux"]
+        return loss, metrics
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def step(state: LoRAState, base_params, batch):
+        from shellac_tpu.utils.failure import all_finite, guard_update
+
+        (_, metrics), grads = grad_fn(state.lora, base_params, batch)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.lora
+        )
+        new_lora = optax.apply_updates(state.lora, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        if train_cfg.skip_nonfinite_updates:
+            ok = all_finite(grads)
+            new_lora = guard_update(state.lora, new_lora, ok)
+            new_opt_state = guard_update(state.opt_state, new_opt_state, ok)
+            metrics["update_skipped"] = 1.0 - ok.astype(jnp.float32)
+        return (
+            LoRAState(
+                step=state.step + 1, lora=new_lora, opt_state=new_opt_state
+            ),
+            metrics,
+        )
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+
+    def jit_with_shardings(state, base_params, batch):
+        from shellac_tpu.training.trainer import batch_shardings
+
+        abstract_state = jax.eval_shape(lambda s: s, state)
+        st_sh = state_shardings(mesh, abstract_state, lora_logical_axes(lora_cfg))
+        abstract_p = jax.eval_shape(lambda p: p, base_params)
+        p_sh = state_shardings(
+            mesh, abstract_p, transformer.logical_axes(model_cfg)
+        )
+        b_sh = batch_shardings(mesh)
+        batch_in = jax.tree.map(lambda _: b_sh, batch)
+        return jax.jit(
+            step,
+            in_shardings=(st_sh, p_sh, batch_in),
+            out_shardings=(st_sh, None),
+            donate_argnums=(0,),
+        )
+
+    from shellac_tpu.training.trainer import _LazyShardedStep
+
+    return _LazyShardedStep(jit_with_shardings)

@@ -156,16 +156,17 @@ def make_train_step(
 
 class _LazyShardedStep:
     """Defers jit-with-shardings until the first call, when the concrete
-    state/batch structure (which depends on the optax chain) is known."""
+    state/batch structure (which depends on the optax chain) is known.
+    Generic over the step arity (also reused by the LoRA step)."""
 
     def __init__(self, build):
         self._build = build
         self._jitted = None
 
-    def __call__(self, state, batch):
+    def __call__(self, *args):
         if self._jitted is None:
-            self._jitted = self._build(state, batch)
-        return self._jitted(state, batch)
+            self._jitted = self._build(*args)
+        return self._jitted(*args)
 
-    def lower(self, state, batch):
-        return self._build(state, batch).lower(state, batch)
+    def lower(self, *args):
+        return self._build(*args).lower(*args)

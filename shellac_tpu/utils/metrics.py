@@ -1,4 +1,4 @@
-"""Scalar metrics logging: JSONL file + stdout, host-side only."""
+"""Scalar metrics logging (JSONL file + stdout) and perf accounting."""
 
 from __future__ import annotations
 
@@ -9,6 +9,15 @@ from typing import IO, Optional
 
 import jax
 import numpy as np
+
+# v5e bf16 peak; single source of truth for MFU across bench scripts.
+TPU_V5E_BF16_PEAK_FLOPS = 197e12
+
+
+def train_flops_per_token(n_params: int, n_layers: int, d_model: int, seq: int) -> int:
+    """Rough model FLOPs per trained token: 6*params (fwd+bwd matmuls)
+    plus the causal-attention term."""
+    return 6 * n_params + 12 * n_layers * d_model * seq
 
 
 def _to_python(tree):

@@ -1,0 +1,127 @@
+"""LoRA tests: identity at init, adapter-only training, sharded step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.config import TrainConfig
+from shellac_tpu.models import transformer
+from shellac_tpu.training.lora import (
+    LoRAConfig,
+    init_lora,
+    init_lora_state,
+    lora_logical_axes,
+    make_lora_train_step,
+    merge_lora,
+)
+
+
+def _tiny(**kw):
+    return get_model_config("tiny").replace(dtype="float32", **kw)
+
+
+def _batch(cfg, b=2, s=16, seed=1):
+    toks = jax.random.randint(jax.random.PRNGKey(seed), (b, s), 0, cfg.vocab_size)
+    return {"inputs": toks, "targets": toks}
+
+
+class TestLoRAMerge:
+    def test_identity_at_init(self):
+        """B=0 at init, so the merged model equals the base model exactly."""
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        lora = init_lora(cfg, LoRAConfig(rank=4), jax.random.PRNGKey(1))
+        merged = merge_lora(params, lora, LoRAConfig(rank=4))
+        tokens = _batch(cfg)["inputs"]
+        l1 = transformer.forward(cfg, params, tokens)
+        l2 = transformer.forward(cfg, merged, tokens)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), atol=1e-6)
+
+    def test_merge_changes_targets_only(self):
+        cfg = _tiny()
+        lcfg = LoRAConfig(rank=2, targets=("wq", "wo"))
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        lora = init_lora(cfg, lcfg, jax.random.PRNGKey(1))
+        # Push B away from zero so the delta is nonzero.
+        lora = jax.tree.map(lambda x: x + 0.1, lora)
+        merged = merge_lora(params, lora, lcfg)
+        assert not np.allclose(
+            np.asarray(merged["layers"]["wq"]), np.asarray(params["layers"]["wq"])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(merged["layers"]["wk"]), np.asarray(params["layers"]["wk"])
+        )
+
+    def test_axes_match_adapters(self):
+        cfg = _tiny()
+        lcfg = LoRAConfig(rank=4, targets=("wq", "wk", "wv", "wo", "w_down"))
+        lora = init_lora(cfg, lcfg, jax.random.PRNGKey(0))
+        axes = lora_logical_axes(lcfg)
+        flat_p = jax.tree_util.tree_flatten_with_path(lora)[0]
+        flat_a = jax.tree_util.tree_flatten_with_path(
+            axes, is_leaf=lambda x: isinstance(x, tuple)
+        )[0]
+        paths_p = {tuple(str(k) for k in p): leaf.ndim for p, leaf in flat_p}
+        paths_a = {tuple(str(k) for k in p): len(leaf) for p, leaf in flat_a}
+        assert paths_p == paths_a
+
+    def test_validation(self):
+        cfg = _tiny()
+        with pytest.raises(ValueError, match="unknown LoRA targets"):
+            LoRAConfig(targets=("nope",)).validate(cfg)
+        moe_cfg = get_model_config("tiny-moe")
+        with pytest.raises(NotImplementedError, match="MoE"):
+            LoRAConfig(targets=("w_gate",)).validate(moe_cfg)
+
+
+class TestLoRATraining:
+    def test_loss_decreases_base_frozen(self):
+        cfg = _tiny()
+        tcfg = TrainConfig(warmup_steps=1, total_steps=50, learning_rate=1e-2)
+        lcfg = LoRAConfig(rank=4)
+        base = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        state = init_lora_state(cfg, tcfg, lcfg, jax.random.PRNGKey(1))
+        step = make_lora_train_step(cfg, tcfg, lcfg)
+        batch = _batch(cfg)
+        state, m0 = step(state, base, batch)
+        first = float(m0["loss"])
+        for _ in range(10):
+            state, m = step(state, base, batch)
+        assert float(m["loss"]) < first
+        assert int(state.step) == 11
+        # Adapter B must have moved away from zero.
+        b = state.lora["layers"]["wq"]["b"]
+        assert float(jnp.abs(b).max()) > 0
+
+    def test_sharded_step(self, mesh_fsdp8):
+        cfg = _tiny()
+        tcfg = TrainConfig(warmup_steps=1, total_steps=10)
+        lcfg = LoRAConfig(rank=4)
+        base = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        state = init_lora_state(cfg, tcfg, lcfg, jax.random.PRNGKey(1),
+                                mesh=mesh_fsdp8)
+        step = make_lora_train_step(cfg, tcfg, lcfg, mesh=mesh_fsdp8)
+        batch = _batch(cfg, b=8)
+        state, metrics = step(state, base, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_sharded_matches_unsharded(self, mesh_fsdp8):
+        cfg = _tiny()
+        tcfg = TrainConfig(warmup_steps=1, total_steps=10)
+        lcfg = LoRAConfig(rank=4)
+        base = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        batch = _batch(cfg, b=8)
+
+        s1 = init_lora_state(cfg, tcfg, lcfg, jax.random.PRNGKey(1))
+        st1 = make_lora_train_step(cfg, tcfg, lcfg)
+        s1, m1 = st1(s1, base, batch)
+
+        s2 = init_lora_state(cfg, tcfg, lcfg, jax.random.PRNGKey(1),
+                             mesh=mesh_fsdp8)
+        st2 = make_lora_train_step(cfg, tcfg, lcfg, mesh=mesh_fsdp8)
+        s2, m2 = st2(s2, base, batch)
+        np.testing.assert_allclose(
+            float(m1["loss"]), float(m2["loss"]), rtol=1e-5
+        )
