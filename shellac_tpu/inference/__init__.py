@@ -1,5 +1,6 @@
 from shellac_tpu.inference.engine import Engine, GenerationResult
 from shellac_tpu.inference.kvcache import KVCache, cache_logical_axes, init_cache
+from shellac_tpu.inference.speculative import SpecResult, SpeculativeEngine
 
 __all__ = [
     "Engine",
@@ -7,4 +8,6 @@ __all__ = [
     "KVCache",
     "init_cache",
     "cache_logical_axes",
+    "SpecResult",
+    "SpeculativeEngine",
 ]

@@ -30,6 +30,7 @@ from shellac_tpu.config import ModelConfig
 from shellac_tpu.ops.activations import softcap, swiglu
 from shellac_tpu.ops.attention import attention
 from shellac_tpu.ops.norms import rms_norm
+from shellac_tpu.ops.quant import materialize
 from shellac_tpu.ops.rope import apply_rope, rope_angles
 from shellac_tpu.parallel.sharding import constrain
 
@@ -142,9 +143,9 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
 
     # --- attention ---
     hx = rms_norm(x, lp["attn_norm"], cfg.norm_eps).astype(cdt)
-    q = (hx @ lp["wq"].astype(cdt)).reshape(b, s, h, dh)
-    k = (hx @ lp["wk"].astype(cdt)).reshape(b, s, hkv, dh)
-    v = (hx @ lp["wv"].astype(cdt)).reshape(b, s, hkv, dh)
+    q = (hx @ materialize(lp["wq"], cdt)).reshape(b, s, h, dh)
+    k = (hx @ materialize(lp["wk"], cdt)).reshape(b, s, hkv, dh)
+    v = (hx @ materialize(lp["wv"], cdt)).reshape(b, s, hkv, dh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     new_cache = None
@@ -221,7 +222,7 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
             q_positions=q_positions, kv_positions=kv_positions,
             kv_mask=kv_mask, impl="ref",
         )
-    o = o.reshape(b, s, h * dh) @ lp["wo"].astype(cdt)
+    o = o.reshape(b, s, h * dh) @ materialize(lp["wo"], cdt)
     x = x + constrain(o, mesh, ("batch", "seq", None))
 
     # --- mlp ---
@@ -247,11 +248,11 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
             "dropped_frac": metrics["moe_dropped_frac"],
         }
     else:
-        gate = hx @ lp["w_gate"].astype(cdt)
-        up = hx @ lp["w_up"].astype(cdt)
+        gate = hx @ materialize(lp["w_gate"], cdt)
+        up = hx @ materialize(lp["w_up"], cdt)
         gate = constrain(gate, mesh, ("batch", "seq", "mlp"))
         up = constrain(up, mesh, ("batch", "seq", "mlp"))
-        down = swiglu(gate, up) @ lp["w_down"].astype(cdt)
+        down = swiglu(gate, up) @ materialize(lp["w_down"], cdt)
     x = x + constrain(down, mesh, ("batch", "seq", None))
     return x, new_cache, moe_out
 

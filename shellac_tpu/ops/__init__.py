@@ -2,9 +2,23 @@ from shellac_tpu.ops.activations import geglu, softcap, swiglu
 from shellac_tpu.ops.attention import attention, attention_ref
 from shellac_tpu.ops.flash_attention import flash_attention
 from shellac_tpu.ops.norms import layer_norm_ref, rms_norm, rms_norm_ref
+from shellac_tpu.ops.quant import (
+    QTensor,
+    dequantize,
+    materialize,
+    quantize,
+    quantize_logical_axes,
+    quantize_params,
+)
 from shellac_tpu.ops.rope import apply_rope, rope_angles
 
 __all__ = [
+    "QTensor",
+    "dequantize",
+    "materialize",
+    "quantize",
+    "quantize_logical_axes",
+    "quantize_params",
     "attention",
     "attention_ref",
     "flash_attention",

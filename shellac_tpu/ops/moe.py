@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from shellac_tpu.config import MoEConfig
+from shellac_tpu.ops.quant import materialize
 
 
 def expert_capacity(cfg: MoEConfig, num_tokens: int) -> int:
@@ -116,12 +117,12 @@ def moe_ffn(
     dispatched = buckets[: e * c].reshape(e, c, d)
 
     # Expert FFNs: batched over the expert axis (sharded over 'fsdp').
-    gate = jnp.einsum("ecd,edf->ecf", dispatched, w_gate.astype(cdt),
+    gate = jnp.einsum("ecd,edf->ecf", dispatched, materialize(w_gate, cdt),
                       preferred_element_type=jnp.float32).astype(cdt)
-    up = jnp.einsum("ecd,edf->ecf", dispatched, w_up.astype(cdt),
+    up = jnp.einsum("ecd,edf->ecf", dispatched, materialize(w_up, cdt),
                     preferred_element_type=jnp.float32).astype(cdt)
     act = jax.nn.silu(gate) * up
-    out_e = jnp.einsum("ecf,efd->ecd", act, w_down.astype(cdt),
+    out_e = jnp.einsum("ecf,efd->ecd", act, materialize(w_down, cdt),
                        preferred_element_type=jnp.float32).astype(cdt)
 
     # Gather back and combine with router weights (dropped -> zeros row).

@@ -1,0 +1,138 @@
+"""Int8 weight-only quantization for inference.
+
+Decode is HBM-bandwidth bound: every generated token re-reads the whole
+weight set, so halving (bf16) or quartering (fp32) the bytes per weight
+is a direct decode-throughput win. Weights are stored as a `QTensor`
+pytree node — int8 values plus a per-output-channel fp32 scale — and
+dequantized on the fly right at the matmul: XLA fuses the
+`convert + multiply` into the dot's operand read, so no full-size fp
+copy of the weight ever lands in HBM.
+
+Symmetric per-channel scheme: for a stacked weight (L, in, out), the
+scale is max|W| / 127 over the `in` (reduction) axis, shape (L, 1, out).
+Per-channel (not per-tensor) keeps the quantization error of any one
+output feature independent of outlier magnitudes elsewhere.
+
+`QTensor` is registered as a pytree node, so quantized layer stacks flow
+through `lax.scan` exactly like plain arrays, and the model code only
+changes at one choke point: `materialize(w, dtype)` replaces
+`w.astype(dtype)` and handles both plain and quantized weights.
+
+The reference repo for this project is empty (SURVEY.md §0); there is no
+upstream quantization scheme to cite.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from shellac_tpu.config import ModelConfig
+
+# Per-layer stacked matrices eligible for weight-only quantization.
+DENSE_TARGETS: Tuple[str, ...] = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+)
+
+
+@flax.struct.dataclass
+class QTensor:
+    """Int8 weight + fp32 per-output-channel scale (reduction axis static)."""
+
+    q: jax.Array  # int8, same shape as the original weight
+    scale: jax.Array  # fp32, 1 on the reduction axis, broadcastable to q
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def size(self):
+        return self.q.size
+
+
+def materialize(w, dtype):
+    """Dequantize a QTensor (or cast a plain array) to `dtype`.
+
+    The single choke point model code calls instead of `.astype`; XLA
+    fuses the convert+scale into the consuming matmul's operand read.
+    """
+    if isinstance(w, QTensor):
+        return (w.q.astype(dtype) * w.scale.astype(dtype))
+    return w.astype(dtype)
+
+
+def quantize(w: jax.Array, reduction_axis: int = -2) -> QTensor:
+    """Symmetric int8 quantization with per-channel scales.
+
+    reduction_axis: the matmul contraction axis of `w` (for a stacked
+    (L, in, out) weight that is -2); the scale is constant along it.
+    """
+    w = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=reduction_axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
+    return materialize(qt, dtype)
+
+
+def quantize_params(
+    cfg: ModelConfig, params, targets: Tuple[str, ...] = DENSE_TARGETS
+) -> Any:
+    """Quantize the per-layer matrices of a parameter pytree.
+
+    Embeddings (and the tied LM head) stay in their original dtype: the
+    embedding is read by gather (no matmul to fuse dequant into) and the
+    final projection's fp32 accumulation dominates its cost. MoE expert
+    weights (E, in, out)-stacked are quantized along their contraction
+    axis too.
+    """
+    unknown = set(targets) - set(DENSE_TARGETS)
+    if unknown:
+        raise ValueError(
+            f"unknown quantization targets {sorted(unknown)}; "
+            f"have {sorted(DENSE_TARGETS)}"
+        )
+    layers = dict(params["layers"])
+    for t in targets:
+        if t not in layers:
+            continue
+        # Stacked dense: (L, in, out) → axis -2. Stacked MoE experts:
+        # (L, E, in, out) → also axis -2. Router stays fp (tiny, and its
+        # logits feed a top-k where small errors flip routing).
+        layers[t] = quantize(layers[t], reduction_axis=-2)
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+def quantize_logical_axes(axes, targets: Tuple[str, ...] = DENSE_TARGETS):
+    """Mirror `quantize_params` on a logical-axes pytree.
+
+    Each targeted weight's axes tuple becomes a QTensor of axes: `q`
+    keeps the weight's axes; `scale` (1 on the reduction axis) keeps the
+    leading/output axes so it shards with the channels it scales.
+    """
+    layers = dict(axes["layers"])
+    for t in targets:
+        if t not in layers:
+            continue
+        wa = layers[t]
+        layers[t] = QTensor(q=wa, scale=(*wa[:-2], None, wa[-1]))
+    out = dict(axes)
+    out["layers"] = layers
+    return out

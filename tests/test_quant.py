@@ -1,0 +1,119 @@
+"""Int8 weight-only quantization tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.engine import Engine
+from shellac_tpu.models import transformer
+from shellac_tpu.ops.quant import (
+    QTensor,
+    dequantize,
+    quantize,
+    quantize_logical_axes,
+    quantize_params,
+)
+
+
+def _tiny(**kw):
+    return get_model_config("tiny").replace(dtype="float32", **kw)
+
+
+class TestQuantize:
+    def test_roundtrip_error_bounded(self, rng):
+        w = jnp.asarray(rng.normal(size=(4, 64, 128)).astype(np.float32))
+        qt = quantize(w)
+        assert qt.q.dtype == jnp.int8
+        assert qt.scale.shape == (4, 1, 128)
+        back = dequantize(qt)
+        # Per-channel symmetric int8: error <= scale/2 per element.
+        err = np.abs(np.asarray(back - w))
+        bound = np.asarray(qt.scale) / 2 + 1e-8
+        assert (err <= np.broadcast_to(bound, err.shape)).all()
+
+    def test_zero_channel_safe(self):
+        w = jnp.zeros((2, 8, 4))
+        qt = quantize(w)
+        np.testing.assert_array_equal(np.asarray(dequantize(qt)), 0.0)
+
+    def test_scan_compatible(self):
+        """QTensor flows through lax.scan like a plain array stack."""
+        w = jnp.asarray(np.random.default_rng(0).normal(size=(3, 8, 8)),
+                        jnp.float32)
+        qt = quantize(w)
+
+        def body(c, layer):
+            return c @ dequantize(layer), None
+
+        out, _ = jax.lax.scan(body, jnp.eye(8), qt)
+        assert out.shape == (8, 8)
+
+    def test_unknown_target_raises(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="unknown quantization targets"):
+            quantize_params(cfg, params, targets=("nope",))
+
+
+class TestQuantizedForward:
+    def test_logits_close_to_fp(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = quantize_params(cfg, params)
+        assert isinstance(qparams["layers"]["wq"], QTensor)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        l_fp = transformer.forward(cfg, params, tokens)
+        l_q = transformer.forward(cfg, qparams, tokens)
+        # Int8 noise is small relative to the logit scale.
+        scale = float(jnp.std(l_fp)) + 1e-6
+        rel = float(jnp.max(jnp.abs(l_q - l_fp))) / scale
+        assert rel < 0.15, f"relative logit error {rel}"
+
+    def test_moe_forward_runs(self):
+        cfg = get_model_config("tiny-moe").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = quantize_params(cfg, params)
+        tokens = jnp.zeros((1, 16), jnp.int32)
+        logits = transformer.forward(cfg, qparams, tokens)
+        assert logits.shape == (1, 16, cfg.vocab_size)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_engine_generate(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = quantize_params(cfg, params)
+        eng = Engine(cfg, qparams, temperature=0.0)
+        prompt = jnp.ones((1, 4), jnp.int32)
+        out = eng.generate(prompt, max_new_tokens=8)
+        assert out.tokens.shape == (1, 8)
+        assert np.isfinite(np.asarray(out.logprobs)).all()
+
+    def test_quantized_axes_match_params(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = quantize_params(cfg, params)
+        qaxes = quantize_logical_axes(transformer.logical_axes(cfg))
+        flat_p = jax.tree_util.tree_flatten_with_path(qparams)[0]
+        flat_a = jax.tree_util.tree_flatten_with_path(
+            qaxes, is_leaf=lambda x: isinstance(x, tuple)
+        )[0]
+        paths_p = {tuple(str(k) for k in p): leaf.ndim for p, leaf in flat_p}
+        paths_a = {tuple(str(k) for k in p): len(leaf) for p, leaf in flat_a}
+        assert paths_p == paths_a
+
+    def test_sharded_quantized_forward(self, mesh_fsdp8):
+        from shellac_tpu.parallel.sharding import shard_pytree
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = quantize_params(cfg, params)
+        qaxes = quantize_logical_axes(transformer.logical_axes(cfg))
+        sharded = shard_pytree(qparams, mesh_fsdp8, qaxes)
+        tokens = jnp.zeros((8, 16), jnp.int32)
+        logits = jax.jit(
+            lambda p, t: transformer.forward(cfg, p, t, mesh=mesh_fsdp8)
+        )(sharded, tokens)
+        assert logits.shape == (8, 16, cfg.vocab_size)
