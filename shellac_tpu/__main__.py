@@ -1,0 +1,5 @@
+import sys
+
+from shellac_tpu.cli import main
+
+sys.exit(main())

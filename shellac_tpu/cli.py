@@ -1,0 +1,286 @@
+"""Command-line interface: `python -m shellac_tpu <command>`.
+
+Commands:
+  train     train a preset (or JSON-configured) model on token shards or
+            synthetic data, with checkpoints/resume and metrics logging
+  eval      token-weighted NLL / perplexity of a checkpoint over shards
+  generate  autoregressive sampling from a checkpoint (or random init),
+            optionally speculative with a smaller draft preset
+  info      show presets, a config's derived dims, and parameter counts
+
+Token ids go in and out as comma-separated integers; plug a tokenizer in
+front as needed. Everything here is a thin shell over the library — each
+command body is the same code a user would write in a script.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+import numpy as np
+
+
+def _model_config(args):
+    from shellac_tpu.config import ModelConfig
+    from shellac_tpu.models.registry import PRESETS
+
+    if getattr(args, "config", None):
+        with open(args.config) as f:
+            raw = json.load(f)
+        base = PRESETS[raw.pop("preset")] if "preset" in raw else ModelConfig()
+        return base.replace(**raw).validate()
+    return PRESETS[args.model].validate()
+
+
+def _parallel_config(spec: str):
+    from shellac_tpu.config import ParallelConfig
+
+    if not spec:
+        return None
+    kw = {}
+    for part in spec.split(","):
+        k, v = part.split("=")
+        kw[k.strip()] = int(v)
+    return ParallelConfig(**kw)
+
+
+def _mesh_from(args):
+    pcfg = _parallel_config(getattr(args, "mesh", "") or "")
+    if pcfg is None:
+        return None
+    from shellac_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(pcfg)
+
+
+def _data_iter(args, cfg, batch_size, seq_len, num_batches=None):
+    from shellac_tpu.training.data import shard_batches, token_batches
+
+    if args.data:
+        return shard_batches(
+            args.data, batch_size=batch_size, seq_len=seq_len,
+            seed=args.seed, num_batches=num_batches,
+        )
+    # Synthetic corpus: a noisy periodic token stream, so the loss has
+    # structure to fall on (unlike uniform random tokens).
+    rng = np.random.default_rng(args.seed)
+    n = max(seq_len * 64, 1 << 16)
+    base = np.arange(n, dtype=np.int32) % min(97, cfg.vocab_size)
+    noise = rng.integers(0, cfg.vocab_size, size=n)
+    corpus = np.where(rng.random(n) < 0.1, noise, base).astype(np.int32)
+    return token_batches(
+        corpus, batch_size=batch_size, seq_len=seq_len, seed=args.seed,
+        num_batches=num_batches,
+    )
+
+
+def _restore_params(args, cfg, train_cfg=None):
+    """Params from --ckpt-dir (latest step), or a fresh random init."""
+    import jax
+
+    from shellac_tpu.models import transformer
+
+    if getattr(args, "ckpt_dir", None):
+        from shellac_tpu.config import TrainConfig
+        from shellac_tpu.training.checkpoint import Checkpointer
+        from shellac_tpu.training.trainer import init_train_state
+
+        tcfg = train_cfg or TrainConfig()
+        ckpt = Checkpointer(args.ckpt_dir)
+        abstract = jax.eval_shape(
+            lambda: init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+        )
+        state = ckpt.restore(abstract_state=abstract)
+        return state.params
+    return transformer.init_params(cfg, jax.random.PRNGKey(args.seed))
+
+
+def _train_config(args):
+    from shellac_tpu.config import TrainConfig
+
+    kw = {}
+    for field in ("learning_rate", "warmup_steps", "weight_decay",
+                  "grad_accum", "seed"):
+        v = getattr(args, field, None)
+        if v is not None:
+            kw[field] = v
+    kw["total_steps"] = args.steps
+    return TrainConfig(**kw)
+
+
+def cmd_train(args):
+    from shellac_tpu.training.loop import fit
+
+    cfg = _model_config(args)
+    tcfg = _train_config(args)
+    mesh = _mesh_from(args)
+    data = _data_iter(args, cfg, args.batch, args.seq)
+    state = fit(
+        cfg, tcfg, data,
+        mesh=mesh,
+        checkpoint_dir=args.ckpt_dir,
+        checkpoint_every=args.ckpt_every,
+        log_path=args.log_path,
+        log_every=args.log_every,
+    )
+    import jax
+
+    print(json.dumps({"final_step": int(jax.device_get(state.step))}))
+    return 0
+
+
+def cmd_eval(args):
+    from shellac_tpu.training.evaluate import evaluate
+
+    cfg = _model_config(args)
+    params = _restore_params(args, cfg)
+    data = _data_iter(args, cfg, args.batch, args.seq,
+                      num_batches=args.batches)
+    out = evaluate(cfg, params, data, max_batches=args.batches)
+    print(json.dumps({k: round(v, 4) if isinstance(v, float) else v
+                      for k, v in out.items()}))
+    return 0
+
+
+def cmd_generate(args):
+    import jax.numpy as jnp
+
+    cfg = _model_config(args)
+    params = _restore_params(args, cfg)
+    prompt = np.array([[int(t) for t in args.prompt.split(",")]], np.int32)
+    if prompt.size == 0:
+        raise SystemExit("empty --prompt")
+
+    if args.draft_model:
+        from shellac_tpu.inference.speculative import SpeculativeEngine
+        from shellac_tpu.models.registry import PRESETS
+
+        dcfg = PRESETS[args.draft_model]
+        import jax
+
+        from shellac_tpu.models import transformer
+
+        dparams = transformer.init_params(dcfg, jax.random.PRNGKey(args.seed))
+        eng = SpeculativeEngine(
+            cfg, params, dcfg, dparams,
+            gamma=args.gamma, temperature=args.temperature,
+        )
+        out = eng.generate(jnp.asarray(prompt), max_new_tokens=args.max_new)
+        print(json.dumps({
+            "tokens": np.asarray(out.tokens)[0].tolist(),
+            "accept_rate": round(float(out.accept_rate), 4),
+            "rounds": int(out.rounds),
+        }))
+        return 0
+
+    from shellac_tpu.inference.engine import Engine
+
+    if args.quantize:
+        from shellac_tpu.ops.quant import quantize_params
+
+        params = quantize_params(cfg, params)
+    eng = Engine(
+        cfg, params,
+        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+    )
+    out = eng.generate(jnp.asarray(prompt), max_new_tokens=args.max_new)
+    print(json.dumps({"tokens": np.asarray(out.tokens)[0].tolist()}))
+    return 0
+
+
+def cmd_info(args):
+    import jax
+
+    from shellac_tpu.models import transformer
+    from shellac_tpu.models.registry import PRESETS
+
+    if args.model or args.config:
+        cfg = _model_config(args)
+        shapes = jax.eval_shape(
+            lambda: transformer.init_params(cfg, jax.random.PRNGKey(0))
+        )
+        n = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+        print(json.dumps({
+            "config": dataclasses.asdict(cfg),
+            "params": n,
+            "ff_dim": cfg.ff_dim,
+            "head_dim": cfg.dim_per_head,
+            "kv_heads": cfg.kv_heads,
+        }, indent=2))
+    else:
+        print(json.dumps(sorted(PRESETS), indent=2))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="shellac_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp):
+        sp.add_argument("--model", default="tiny",
+                        help="preset name (see `info`)")
+        sp.add_argument("--config", help="JSON file of ModelConfig overrides "
+                        '(may include {"preset": name})')
+        sp.add_argument("--seed", type=int, default=0)
+
+    t = sub.add_parser("train", help="train a model")
+    common(t)
+    t.add_argument("--steps", type=int, default=100)
+    t.add_argument("--batch", type=int, default=8)
+    t.add_argument("--seq", type=int, default=128)
+    t.add_argument("--data", nargs="*", default=None,
+                   help="token shard files (default: synthetic stream)")
+    t.add_argument("--mesh", default="",
+                   help="mesh axes, e.g. dp=2,fsdp=2,tp=2")
+    t.add_argument("--ckpt-dir")
+    t.add_argument("--ckpt-every", type=int, default=500)
+    t.add_argument("--log-path")
+    t.add_argument("--log-every", type=int, default=10)
+    t.add_argument("--learning-rate", type=float, dest="learning_rate")
+    t.add_argument("--warmup-steps", type=int, dest="warmup_steps")
+    t.add_argument("--weight-decay", type=float, dest="weight_decay")
+    t.add_argument("--grad-accum", type=int, dest="grad_accum")
+    t.set_defaults(fn=cmd_train)
+
+    e = sub.add_parser("eval", help="perplexity of a checkpoint")
+    common(e)
+    e.add_argument("--batch", type=int, default=8)
+    e.add_argument("--seq", type=int, default=128)
+    e.add_argument("--batches", type=int, default=16)
+    e.add_argument("--data", nargs="*", default=None)
+    e.add_argument("--ckpt-dir")
+    e.set_defaults(fn=cmd_eval)
+
+    g = sub.add_parser("generate", help="sample tokens")
+    common(g)
+    g.add_argument("--prompt", required=True,
+                   help="comma-separated token ids, e.g. 1,5,42")
+    g.add_argument("--max-new", type=int, default=32)
+    g.add_argument("--temperature", type=float, default=1.0)
+    g.add_argument("--top-k", type=int, default=None)
+    g.add_argument("--top-p", type=float, default=None)
+    g.add_argument("--ckpt-dir")
+    g.add_argument("--quantize", action="store_true",
+                   help="int8 weight-only quantization")
+    g.add_argument("--draft-model", default=None,
+                   help="draft preset for speculative decoding")
+    g.add_argument("--gamma", type=int, default=4)
+    g.set_defaults(fn=cmd_generate)
+
+    i = sub.add_parser("info", help="presets and config details")
+    i.add_argument("--model")
+    i.add_argument("--config")
+    i.set_defaults(fn=cmd_info)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

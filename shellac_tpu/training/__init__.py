@@ -6,6 +6,7 @@ from shellac_tpu.training.trainer import (
     init_train_state,
     make_train_step,
 )
+from shellac_tpu.training.evaluate import evaluate, make_eval_step
 from shellac_tpu.training.loop import fit
 from shellac_tpu.training.lora import (
     LoRAConfig,
@@ -17,6 +18,8 @@ from shellac_tpu.training.lora import (
 )
 
 __all__ = [
+    "evaluate",
+    "make_eval_step",
     "LoRAConfig",
     "LoRAState",
     "init_lora",

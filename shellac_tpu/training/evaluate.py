@@ -1,0 +1,68 @@
+"""Evaluation: token-weighted NLL / perplexity over a data stream.
+
+One jitted eval step returns *summed* negative log-likelihood and token
+count (not per-batch means), so the stream-level aggregate is exact even
+with ragged masks or a final short batch.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.models import transformer
+
+
+def make_eval_step(model_cfg: ModelConfig, mesh=None, attn_impl: str = "auto"):
+    """Build `eval_step(params, batch) -> (nll_sum fp32, token_count fp32)`."""
+
+    def eval_step(params, batch):
+        logits = transformer.forward(
+            model_cfg, params, batch["inputs"], mesh=mesh, attn_impl=attn_impl
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, batch["targets"][..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones_like(nll)
+        mask = mask.astype(jnp.float32)
+        return jnp.sum(nll * mask), jnp.sum(mask)
+
+    return jax.jit(eval_step)
+
+
+def evaluate(
+    model_cfg: ModelConfig,
+    params,
+    data_iter: Iterator[dict],
+    *,
+    mesh=None,
+    max_batches: Optional[int] = None,
+) -> dict:
+    """Returns {"loss", "perplexity", "tokens", "batches"} over the stream."""
+    step = make_eval_step(model_cfg, mesh=mesh)
+    total_nll = 0.0
+    total_tok = 0.0
+    batches = 0
+    for batch in data_iter:
+        nll, tok = step(params, batch)
+        total_nll += float(nll)
+        total_tok += float(tok)
+        batches += 1
+        if max_batches is not None and batches >= max_batches:
+            break
+    if total_tok == 0:
+        raise ValueError("evaluate: empty data stream")
+    loss = total_nll / total_tok
+    return {
+        "loss": loss,
+        "perplexity": math.exp(min(loss, 30.0)),
+        "tokens": int(total_tok),
+        "batches": batches,
+    }
