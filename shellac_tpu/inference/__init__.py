@@ -1,4 +1,4 @@
-from shellac_tpu.inference.engine import Engine, GenerationResult
+from shellac_tpu.inference.engine import Engine, GenerationResult, shard_params
 from shellac_tpu.inference.kvcache import KVCache, cache_logical_axes, init_cache
 from shellac_tpu.inference.speculative import SpecResult, SpeculativeEngine
 
@@ -10,4 +10,5 @@ __all__ = [
     "cache_logical_axes",
     "SpecResult",
     "SpeculativeEngine",
+    "shard_params",
 ]

@@ -4,6 +4,12 @@ Prefill and decode are two jitted programs over the same cached forward:
 prefill consumes the whole (padded) prompt in one MXU-friendly pass;
 decode runs a `lax.scan` of single-token steps, keeping the loop on
 device — no host round-trip per token.
+
+With a `mesh`, the engine runs sharded (tensor-parallel weights, KV
+cache sharded over kv_heads, batch over dp/fsdp): pass params already
+placed with `shard_params`, and prefill pins the cache's shardings so
+the decode scan stays partitioned instead of letting GSPMD re-derive a
+layout per step.
 """
 
 from __future__ import annotations
@@ -16,15 +22,36 @@ import jax
 import jax.numpy as jnp
 
 from shellac_tpu.config import ModelConfig
-from shellac_tpu.inference.kvcache import KVCache, init_cache
+from shellac_tpu.inference.kvcache import (
+    KVCache,
+    cache_logical_axes,
+    init_cache,
+)
 from shellac_tpu.models import transformer
 from shellac_tpu.ops.sampling import sample
+from shellac_tpu.parallel.sharding import make_shardings, shard_pytree
 
 
 @flax.struct.dataclass
 class GenerationResult:
     tokens: jax.Array  # (B, max_new_tokens) int32
     logprobs: jax.Array  # (B, max_new_tokens) fp32 — logprob of each sampled token
+
+
+def shard_params(cfg: ModelConfig, params, mesh):
+    """Place inference params onto a mesh by their logical axes.
+
+    Handles both plain and int8-quantized (QTensor) parameter trees.
+    """
+    from shellac_tpu.ops.quant import QTensor, quantize_logical_axes
+
+    axes = transformer.logical_axes(cfg)
+    q_targets = tuple(
+        k for k, v in params["layers"].items() if isinstance(v, QTensor)
+    )
+    if q_targets:
+        axes = quantize_logical_axes(axes, q_targets)
+    return shard_pytree(params, mesh, axes)
 
 
 class Engine:
@@ -39,14 +66,24 @@ class Engine:
         temperature: float = 1.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        mesh=None,
     ):
         self.cfg = cfg
         self.params = params
+        self.mesh = mesh
         self.max_len = max_len or cfg.max_seq_len
         self._sampler = functools.partial(
             sample, temperature=temperature, top_k=top_k, top_p=top_p
         )
-        self._prefill = jax.jit(self._prefill_impl)
+        if mesh is None:
+            self._prefill = jax.jit(self._prefill_impl)
+        else:
+            # Pin the cache layout at the prefill boundary; decode then
+            # inherits it from its (committed) cache argument.
+            cache_sh = make_shardings(mesh, cache_logical_axes())
+            self._prefill = jax.jit(
+                self._prefill_impl, out_shardings=(None, cache_sh)
+            )
         self._decode = jax.jit(self._decode_impl, static_argnums=(3,))
 
     def _prefill_impl(self, params, tokens, prompt_len):
@@ -54,7 +91,8 @@ class Engine:
         b, s = tokens.shape
         cache = init_cache(self.cfg, b, self.max_len)
         logits, cache = transformer.forward_with_cache(
-            self.cfg, params, tokens, cache, new_tokens_len=prompt_len
+            self.cfg, params, tokens, cache, new_tokens_len=prompt_len,
+            mesh=self.mesh,
         )
         # Logits at the last *real* prompt position seed the first sample.
         last = jnp.take_along_axis(
@@ -66,7 +104,7 @@ class Engine:
         def step(carry, _):
             cache, tok, key = carry
             logits, cache = transformer.forward_with_cache(
-                self.cfg, params, tok[:, None], cache
+                self.cfg, params, tok[:, None], cache, mesh=self.mesh
             )
             logits = logits[:, 0]
             key, sub = jax.random.split(key)
