@@ -145,14 +145,43 @@ def cmd_eval(args):
     return 0
 
 
+def cmd_tokenize(args):
+    from shellac_tpu.training.data import write_token_shard
+    from shellac_tpu.training.tokenizer import get_tokenizer
+
+    tok = get_tokenizer(args.tokenizer)
+    docs = []
+    for path in args.input:
+        with open(path, encoding="utf-8") as f:
+            docs.append(f.read())
+    tokens = tok.encode_documents(docs)
+    write_token_shard(args.output, tokens)
+    print(json.dumps({
+        "output": args.output,
+        "tokens": int(tokens.size),
+        "vocab_size": tok.vocab_size,
+    }))
+    return 0
+
+
 def cmd_generate(args):
     import jax.numpy as jnp
 
     cfg = _model_config(args)
     params = _restore_params(args, cfg)
-    prompt = np.array([[int(t) for t in args.prompt.split(",")]], np.int32)
+    tok = None
+    if args.text is not None:
+        from shellac_tpu.training.tokenizer import get_tokenizer
+
+        tok = get_tokenizer(args.tokenizer)
+        ids = tok.encode(args.text, bos=False)
+        prompt = ids[None, :].astype(np.int32)
+    else:
+        if args.prompt is None:
+            raise SystemExit("need --prompt or --text")
+        prompt = np.array([[int(t) for t in args.prompt.split(",")]], np.int32)
     if prompt.size == 0:
-        raise SystemExit("empty --prompt")
+        raise SystemExit("empty prompt")
 
     if args.draft_model:
         from shellac_tpu.inference.speculative import SpeculativeEngine
@@ -187,7 +216,11 @@ def cmd_generate(args):
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
     )
     out = eng.generate(jnp.asarray(prompt), max_new_tokens=args.max_new)
-    print(json.dumps({"tokens": np.asarray(out.tokens)[0].tolist()}))
+    ids = np.asarray(out.tokens)[0]
+    result = {"tokens": ids.tolist()}
+    if tok is not None:
+        result["text"] = tok.decode(ids)
+    print(json.dumps(result))
     return 0
 
 
@@ -256,8 +289,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     g = sub.add_parser("generate", help="sample tokens")
     common(g)
-    g.add_argument("--prompt", required=True,
+    g.add_argument("--prompt",
                    help="comma-separated token ids, e.g. 1,5,42")
+    g.add_argument("--text", help="text prompt (encoded with --tokenizer)")
+    g.add_argument("--tokenizer", default="byte",
+                   help='"byte" or a local HF tokenizer dir')
     g.add_argument("--max-new", type=int, default=32)
     g.add_argument("--temperature", type=float, default=1.0)
     g.add_argument("--top-k", type=int, default=None)
@@ -269,6 +305,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="draft preset for speculative decoding")
     g.add_argument("--gamma", type=int, default=4)
     g.set_defaults(fn=cmd_generate)
+
+    k = sub.add_parser("tokenize", help="encode text files into a token shard")
+    k.add_argument("--input", nargs="+", required=True, help="text files")
+    k.add_argument("--output", required=True, help="shard path to write")
+    k.add_argument("--tokenizer", default="byte",
+                   help='"byte" or a local HF tokenizer dir')
+    k.set_defaults(fn=cmd_tokenize)
 
     i = sub.add_parser("info", help="presets and config details")
     i.add_argument("--model")

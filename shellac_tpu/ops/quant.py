@@ -73,16 +73,36 @@ def materialize(w, dtype):
     return w.astype(dtype)
 
 
-def quantize(w: jax.Array, reduction_axis: int = -2) -> QTensor:
-    """Symmetric int8 quantization with per-channel scales.
+# Max representable magnitude per storage dtype (fp8-e4m3 tops out at 448).
+_QMAX = {
+    jnp.dtype(jnp.int8): 127.0,
+    jnp.dtype(jnp.float8_e4m3fn): 448.0,
+}
 
+
+def quantize(w: jax.Array, reduction_axis: int = -2, dtype=jnp.int8) -> QTensor:
+    """Symmetric quantization with per-channel scales.
+
+    dtype: jnp.int8 (rounded) or jnp.float8_e4m3fn (cast; keeps relative
+    precision for small weights at the same byte width).
     reduction_axis: the matmul contraction axis of `w` (for a stacked
     (L, in, out) weight that is -2); the scale is constant along it.
     """
+    qdt = jnp.dtype(dtype)
+    if qdt not in _QMAX:
+        raise ValueError(
+            f"unsupported quantization dtype {qdt}; "
+            f"have {sorted(str(d) for d in _QMAX)}"
+        )
+    qmax = _QMAX[qdt]
     w = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(w), axis=reduction_axis, keepdims=True)
-    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    scale = jnp.where(amax > 0, amax / qmax, 1.0)
+    scaled = w / scale
+    if qdt == jnp.dtype(jnp.int8):
+        q = jnp.clip(jnp.round(scaled), -qmax, qmax).astype(qdt)
+    else:
+        q = scaled.astype(qdt)
     return QTensor(q=q, scale=scale)
 
 
@@ -91,7 +111,10 @@ def dequantize(qt: QTensor, dtype=jnp.float32) -> jax.Array:
 
 
 def quantize_params(
-    cfg: ModelConfig, params, targets: Tuple[str, ...] = DENSE_TARGETS
+    cfg: ModelConfig,
+    params,
+    targets: Tuple[str, ...] = DENSE_TARGETS,
+    dtype=jnp.int8,
 ) -> Any:
     """Quantize the per-layer matrices of a parameter pytree.
 
@@ -114,7 +137,7 @@ def quantize_params(
         # Stacked dense: (L, in, out) → axis -2. Stacked MoE experts:
         # (L, E, in, out) → also axis -2. Router stays fp (tiny, and its
         # logits feed a top-k where small errors flip routing).
-        layers[t] = quantize(layers[t], reduction_axis=-2)
+        layers[t] = quantize(layers[t], reduction_axis=-2, dtype=dtype)
     out = dict(params)
     out["layers"] = layers
     return out

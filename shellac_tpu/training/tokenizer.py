@@ -1,0 +1,99 @@
+"""Tokenizers: dependency-free byte-level, plus an optional HF wrapper.
+
+The byte tokenizer is the zero-infrastructure path: UTF-8 bytes are the
+ids (0..255), with BOS/EOS/PAD appended above. It needs no vocabulary
+file, no network, and round-trips any text exactly — the right default
+for tests, smoke corpora, and byte-level models.
+
+`HFTokenizer` adapts a HuggingFace `transformers` tokenizer (loaded from
+a local path — this environment has no egress) to the same interface.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids; specials above the byte range."""
+
+    BOS = 256
+    EOS = 257
+    PAD = 258
+
+    vocab_size = 259
+
+    def encode(self, text: str, *, bos: bool = False, eos: bool = False) -> np.ndarray:
+        ids: List[int] = list(text.encode("utf-8"))
+        if bos:
+            ids.insert(0, self.BOS)
+        if eos:
+            ids.append(self.EOS)
+        return np.asarray(ids, dtype=np.int32)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(int(i) for i in np.asarray(ids).reshape(-1) if int(i) < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def encode_documents(
+        self, docs: Iterable[str], *, eos_between: bool = True
+    ) -> np.ndarray:
+        """Concatenate documents into one token stream (EOS-separated)."""
+        parts = []
+        for d in docs:
+            parts.append(self.encode(d))
+            if eos_between:
+                parts.append(np.asarray([self.EOS], np.int32))
+        if not parts:
+            return np.zeros((0,), np.int32)
+        return np.concatenate(parts)
+
+
+class HFTokenizer:
+    """Adapter over a local HuggingFace tokenizer directory."""
+
+    def __init__(self, path: str):
+        try:
+            from transformers import AutoTokenizer
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "HFTokenizer needs the `transformers` package"
+            ) from e
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, *, bos: bool = False, eos: bool = False) -> np.ndarray:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if bos and self._tok.bos_token_id is not None:
+            ids = [self._tok.bos_token_id] + ids
+        if eos and self._tok.eos_token_id is not None:
+            ids = ids + [self._tok.eos_token_id]
+        return np.asarray(ids, dtype=np.int32)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(
+            [int(i) for i in np.asarray(ids).reshape(-1)],
+            skip_special_tokens=True,
+        )
+
+    def encode_documents(
+        self, docs: Iterable[str], *, eos_between: bool = True
+    ) -> np.ndarray:
+        parts = []
+        eos_id = self._tok.eos_token_id
+        for d in docs:
+            parts.append(self.encode(d))
+            if eos_between and eos_id is not None:
+                parts.append(np.asarray([eos_id], np.int32))
+        if not parts:
+            return np.zeros((0,), np.int32)
+        return np.concatenate(parts)
+
+
+def get_tokenizer(spec: str = "byte"):
+    """"byte" or a local HF tokenizer directory path."""
+    if spec == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(spec)

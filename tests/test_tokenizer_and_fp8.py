@@ -1,0 +1,98 @@
+"""Byte tokenizer, tokenize CLI, and fp8 weight quantization tests."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.cli import main
+from shellac_tpu.models import transformer
+from shellac_tpu.ops.quant import dequantize, quantize, quantize_params
+from shellac_tpu.training.data import read_token_shard
+from shellac_tpu.training.tokenizer import ByteTokenizer, get_tokenizer
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tok = ByteTokenizer()
+        text = "héllo, wörld! \U0001f680"
+        ids = tok.encode(text)
+        assert ids.dtype == np.int32
+        assert tok.decode(ids) == text
+
+    def test_specials(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("ab", bos=True, eos=True)
+        assert ids[0] == ByteTokenizer.BOS and ids[-1] == ByteTokenizer.EOS
+        assert tok.decode(ids) == "ab"  # specials dropped on decode
+        assert tok.vocab_size == 259
+
+    def test_documents_eos_separated(self):
+        tok = ByteTokenizer()
+        stream = tok.encode_documents(["a", "b"])
+        assert list(stream) == [ord("a"), tok.EOS, ord("b"), tok.EOS]
+
+    def test_get_tokenizer(self):
+        assert isinstance(get_tokenizer("byte"), ByteTokenizer)
+
+
+class TestTokenizeCLI:
+    def test_tokenize_then_train(self, tmp_path, capsys):
+        text = tmp_path / "corpus.txt"
+        text.write_text("the quick brown fox jumps over the lazy dog. " * 200)
+        shard = tmp_path / "corpus.bin"
+        rc = main(["tokenize", "--input", str(text), "--output", str(shard)])
+        assert rc == 0
+        meta = json.loads(capsys.readouterr().out)
+        assert meta["tokens"] > 1000
+        tokens = read_token_shard(str(shard))
+        assert tokens.size == meta["tokens"]
+
+        rc = main([
+            "train", "--model", "tiny", "--steps", "3", "--batch", "2",
+            "--seq", "32", "--data", str(shard),
+        ])
+        assert rc == 0
+
+    def test_generate_text(self, capsys):
+        rc = main([
+            "generate", "--model", "tiny", "--text", "ab",
+            "--max-new", "4", "--temperature", "0",
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert len(out["tokens"]) == 4
+        assert isinstance(out["text"], str)
+
+
+class TestFP8:
+    def test_fp8_roundtrip_better_than_int8_for_smalls(self, rng):
+        # Log-normal weights span decades; fp8's relative precision
+        # should beat int8's absolute grid on the small entries.
+        w = jnp.asarray(
+            np.exp(rng.normal(size=(2, 32, 64)) * 2.0).astype(np.float32)
+        )
+        q8 = dequantize(quantize(w, dtype=jnp.int8))
+        f8 = dequantize(quantize(w, dtype=jnp.float8_e4m3fn))
+        small = np.asarray(w) < np.median(np.asarray(w))
+        rel8 = np.abs(np.asarray(q8 - w))[small] / np.asarray(w)[small]
+        relf = np.abs(np.asarray(f8 - w))[small] / np.asarray(w)[small]
+        assert relf.mean() < rel8.mean()
+
+    def test_fp8_forward(self):
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = quantize_params(cfg, params, dtype=jnp.float8_e4m3fn)
+        assert qparams["layers"]["wq"].q.dtype == jnp.float8_e4m3fn
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        l_fp = transformer.forward(cfg, params, tokens)
+        l_q = transformer.forward(cfg, qparams, tokens)
+        scale = float(jnp.std(l_fp)) + 1e-6
+        assert float(jnp.max(jnp.abs(l_q - l_fp))) / scale < 0.2
+
+    def test_bad_dtype_raises(self):
+        with pytest.raises(ValueError, match="unsupported quantization"):
+            quantize(jnp.ones((2, 4, 4)), dtype=jnp.float16)
