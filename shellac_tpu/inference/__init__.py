@@ -1,8 +1,10 @@
+from shellac_tpu.inference.batching import BatchingEngine
 from shellac_tpu.inference.engine import Engine, GenerationResult, shard_params
 from shellac_tpu.inference.kvcache import KVCache, cache_logical_axes, init_cache
 from shellac_tpu.inference.speculative import SpecResult, SpeculativeEngine
 
 __all__ = [
+    "BatchingEngine",
     "Engine",
     "GenerationResult",
     "KVCache",

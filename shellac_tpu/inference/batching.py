@@ -1,0 +1,214 @@
+"""Continuous batching: many requests share one fixed device batch.
+
+A serving engine cannot wait for a whole batch to finish: requests
+arrive at different times with different prompt and output lengths. This
+engine keeps a **fixed-shape** slot batch on device — (n_slots,
+max_len) KV cache — and multiplexes requests onto it:
+
+  - a free slot is filled by prefilling one request's prompt into a
+    single-sequence mini-cache and scattering it into the slot (two
+    jitted programs; prompt lengths bucket to powers of two to bound
+    recompiles);
+  - every tick runs ONE jitted decode step over all slots; inactive
+    slots compute garbage that is masked on host and their cache
+    lengths are frozen, so shapes never change;
+  - a request leaves its slot on EOS or at its max_new budget, and the
+    slot is immediately refillable — no head-of-line blocking.
+
+This is the TPU analogue of GPU continuous batching: instead of paging,
+the cache is a dense per-slot ring the scheduler rolls back by writing
+`lengths` (kvcache.py's write-at-own-length contract makes stale slots
+self-healing). The per-tick host sync is one (n_slots,) int32 fetch.
+
+Greedy output for any request is exactly what the single-request Engine
+produces — the scheduling is invisible to the math (tested).
+
+The reference repo for this project is empty (SURVEY.md §0); there is no
+upstream serving engine to cite.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference.kvcache import KVCache, init_cache
+from shellac_tpu.models import transformer
+from shellac_tpu.ops.sampling import sample
+
+
+@dataclass
+class _Request:
+    rid: Any
+    tokens: np.ndarray  # (S,) int32 prompt
+    max_new: int
+    out: List[int] = field(default_factory=list)
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class BatchingEngine:
+    """Fixed-slot continuous batching over one model."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params,
+        *,
+        n_slots: int = 8,
+        max_len: Optional[int] = None,
+        temperature: float = 0.0,
+        top_k: Optional[int] = None,
+        top_p: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.n_slots = n_slots
+        self.max_len = max_len or cfg.max_seq_len
+        self.eos_id = eos_id
+        self._sampler = functools.partial(
+            sample, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        self._key = jax.random.PRNGKey(seed)
+
+        self._cache = init_cache(cfg, n_slots, self.max_len)
+        self._cur = jnp.zeros((n_slots,), jnp.int32)  # next input token
+        self._queue: deque[_Request] = deque()
+        self._slots: List[Optional[_Request]] = [None] * n_slots
+        self._prefill_jit: Dict[int, Any] = {}  # bucketed by padded S
+        self._decode = jax.jit(self._decode_impl)
+
+    # ---- jitted programs --------------------------------------------
+
+    def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key):
+        """Prefill one request and scatter it into `slot` of `cache`."""
+        mini = init_cache(self.cfg, 1, self.max_len)
+        logits, mini = transformer.forward_with_cache(
+            self.cfg, params, tokens, mini, new_tokens_len=prompt_len
+        )
+        last = jnp.take_along_axis(
+            logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
+        )[0, 0]
+        first = self._sampler(key, last)
+        cache = KVCache(
+            k=jax.lax.dynamic_update_slice_in_dim(
+                cache.k, mini.k, slot, axis=1
+            ),
+            v=jax.lax.dynamic_update_slice_in_dim(
+                cache.v, mini.v, slot, axis=1
+            ),
+            lengths=jax.lax.dynamic_update_slice(
+                cache.lengths, mini.lengths, (slot,)
+            ),
+        )
+        return cache, first
+
+    def _decode_impl(self, params, cache, cur, active, key):
+        """One decode tick over every slot; inactive slots frozen."""
+        old_lengths = cache.lengths
+        logits, cache = transformer.forward_with_cache(
+            self.cfg, params, cur[:, None], cache
+        )
+        nxt = self._sampler(key, logits[:, 0])
+        lengths = jnp.where(active, cache.lengths, old_lengths)
+        cache = KVCache(k=cache.k, v=cache.v, lengths=lengths)
+        nxt = jnp.where(active, nxt, cur)
+        return cache, nxt
+
+    # ---- scheduling --------------------------------------------------
+
+    def submit(self, rid, tokens, max_new: int) -> None:
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size == 0:
+            raise ValueError(f"request {rid!r}: empty prompt")
+        if tokens.size + max_new + 1 > self.max_len:
+            raise ValueError(
+                f"request {rid!r}: prompt {tokens.size} + max_new {max_new} "
+                f"exceeds max_len {self.max_len}"
+            )
+        self._queue.append(_Request(rid, tokens, max_new))
+
+    def _fill_slots(self):
+        for i in range(self.n_slots):
+            if self._slots[i] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            s = req.tokens.size
+            pad = _bucket(s)
+            if pad not in self._prefill_jit:
+                self._prefill_jit[pad] = jax.jit(
+                    self._prefill_impl, static_argnums=()
+                )
+            padded = np.zeros((1, pad), np.int32)
+            padded[0, :s] = req.tokens
+            self._key, sub = jax.random.split(self._key)
+            cache, first = self._prefill_jit[pad](
+                self.params, self._cache, jnp.asarray(padded),
+                jnp.asarray([s], jnp.int32), i, sub,
+            )
+            self._cache = cache
+            first_tok = int(first)
+            self._cur = self._cur.at[i].set(first_tok)
+            self._slots[i] = req
+            req.out.append(first_tok)
+
+    def _finish_check(self, finished):
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            last = req.out[-1]
+            if (self.eos_id is not None and last == self.eos_id) or (
+                len(req.out) >= req.max_new
+            ):
+                finished.append((req.rid, req.out))
+                self._slots[i] = None
+
+    def step(self) -> List[Tuple[Any, List[int]]]:
+        """Fill free slots, run one decode tick; returns finished requests."""
+        finished: List[Tuple[Any, List[int]]] = []
+        self._fill_slots()
+        # Requests satisfied by prefill alone (max_new=1 or instant EOS).
+        self._finish_check(finished)
+        self._fill_slots()
+        active_rows = [r is not None for r in self._slots]
+        if any(active_rows):
+            active = jnp.asarray(active_rows)
+            self._key, sub = jax.random.split(self._key)
+            self._cache, nxt = self._decode(
+                self.params, self._cache, self._cur, active, sub
+            )
+            self._cur = nxt
+            host_next = np.asarray(nxt)
+            for i, req in enumerate(self._slots):
+                if req is not None:
+                    req.out.append(int(host_next[i]))
+            self._finish_check(finished)
+        return finished
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + sum(r is not None for r in self._slots)
+
+    def run(self, requests=None) -> Dict[Any, List[int]]:
+        """Drain: submit (rid, tokens, max_new) triples, step to empty."""
+        for r in requests or ():
+            self.submit(*r)
+        results: Dict[Any, List[int]] = {}
+        while self.pending:
+            for rid, out in self.step():
+                results[rid] = out
+        return results
