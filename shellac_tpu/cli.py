@@ -224,6 +224,26 @@ def cmd_generate(args):
     return 0
 
 
+def cmd_serve(args):
+    from shellac_tpu.inference.server import serve
+    from shellac_tpu.training.tokenizer import get_tokenizer
+
+    cfg = _model_config(args)
+    params = _restore_params(args, cfg)
+    if args.quantize:
+        from shellac_tpu.ops.quant import quantize_params
+
+        params = quantize_params(cfg, params)
+    serve(
+        cfg, params,
+        host=args.host, port=args.port,
+        tokenizer=get_tokenizer(args.tokenizer),
+        n_slots=args.slots, max_len=args.max_len,
+        temperature=args.temperature, eos_id=args.eos_id,
+    )
+    return 0
+
+
 def cmd_info(args):
     import jax
 
@@ -305,6 +325,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="draft preset for speculative decoding")
     g.add_argument("--gamma", type=int, default=4)
     g.set_defaults(fn=cmd_generate)
+
+    s = sub.add_parser("serve", help="HTTP server with continuous batching")
+    common(s)
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=8000)
+    s.add_argument("--slots", type=int, default=8)
+    s.add_argument("--max-len", type=int, default=None, dest="max_len")
+    s.add_argument("--temperature", type=float, default=0.0)
+    s.add_argument("--eos-id", type=int, default=None, dest="eos_id")
+    s.add_argument("--ckpt-dir")
+    s.add_argument("--quantize", action="store_true")
+    s.add_argument("--tokenizer", default="byte")
+    s.set_defaults(fn=cmd_serve)
 
     k = sub.add_parser("tokenize", help="encode text files into a token shard")
     k.add_argument("--input", nargs="+", required=True, help="text files")

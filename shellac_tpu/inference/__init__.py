@@ -1,11 +1,13 @@
 from shellac_tpu.inference.batching import BatchingEngine
 from shellac_tpu.inference.engine import Engine, GenerationResult, shard_params
 from shellac_tpu.inference.kvcache import KVCache, cache_logical_axes, init_cache
+from shellac_tpu.inference.server import InferenceServer
 from shellac_tpu.inference.speculative import SpecResult, SpeculativeEngine
 
 __all__ = [
     "BatchingEngine",
     "Engine",
+    "InferenceServer",
     "GenerationResult",
     "KVCache",
     "init_cache",

@@ -1,0 +1,168 @@
+"""Minimal HTTP serving on top of the continuous-batching engine.
+
+Stdlib-only (`http.server`): one scheduler thread owns the
+BatchingEngine and is the ONLY thing touching JAX; request handler
+threads just enqueue work and wait on per-request events. POSTs block
+until their request completes — the concurrency lives in the slot
+batch, not in the HTTP layer.
+
+API:
+  POST /generate  {"tokens": [1,2,3] | "text": "...", "max_new": 32}
+                  -> {"id", "tokens", "text"?}
+  GET  /health    -> {"ok": true, "pending": N}
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference.batching import BatchingEngine
+
+
+class _Pending:
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[str] = None
+
+
+class InferenceServer:
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params,
+        *,
+        tokenizer=None,
+        engine: Optional[BatchingEngine] = None,
+        **engine_kw,
+    ):
+        self.engine = engine or BatchingEngine(cfg, params, **engine_kw)
+        self.tokenizer = tokenizer
+        self._submit_q: queue.Queue = queue.Queue()
+        self._pending: Dict[int, _Pending] = {}
+        self._ids = itertools.count()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ---- scheduler thread (sole owner of the engine) ----------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            drained = False
+            while True:
+                try:
+                    rid, tokens, max_new = self._submit_q.get_nowait()
+                except queue.Empty:
+                    break
+                drained = True
+                try:
+                    self.engine.submit(rid, tokens, max_new)
+                except ValueError as e:
+                    p = self._pending.pop(rid)
+                    p.error = str(e)
+                    p.event.set()
+            if self.engine.pending:
+                for rid, out in self.engine.step():
+                    p = self._pending.pop(rid, None)
+                    if p is not None:
+                        p.result = out
+                        p.event.set()
+            elif not drained:
+                # Idle: block briefly on the queue instead of spinning.
+                try:
+                    item = self._submit_q.get(timeout=0.05)
+                    self._submit_q.put(item)
+                except queue.Empty:
+                    pass
+
+    # ---- client surface ---------------------------------------------
+
+    def generate(self, tokens, max_new: int, timeout: Optional[float] = None):
+        rid = next(self._ids)
+        p = _Pending()
+        self._pending[rid] = p
+        self._submit_q.put((rid, np.asarray(tokens, np.int32), max_new))
+        if not p.event.wait(timeout):
+            raise TimeoutError(f"request {rid} timed out")
+        if p.error is not None:
+            raise ValueError(p.error)
+        return p.result
+
+    def handle(self, payload: dict) -> dict:
+        if "tokens" in payload:
+            tokens = np.asarray(payload["tokens"], np.int32)
+        elif "text" in payload:
+            if self.tokenizer is None:
+                raise ValueError('"text" needs a server-side tokenizer')
+            tokens = self.tokenizer.encode(payload["text"])
+        else:
+            raise ValueError('need "tokens" or "text"')
+        max_new = int(payload.get("max_new", 32))
+        out = self.generate(tokens, max_new, timeout=payload.get("timeout"))
+        result: Dict[str, Any] = {"tokens": out}
+        if self.tokenizer is not None:
+            result["text"] = self.tokenizer.decode(out)
+        return result
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
+                     port: int = 0) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, obj: dict):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._send(200, {"ok": True,
+                                 "pending": server.engine.pending})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                self._send(200, server.handle(payload))
+            except (ValueError, TimeoutError) as e:
+                self._send(400, {"error": str(e)})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve(cfg: ModelConfig, params, *, host="127.0.0.1", port=8000,
+          tokenizer=None, **engine_kw):
+    """Blocking entry point used by the CLI."""
+    srv = InferenceServer(cfg, params, tokenizer=tokenizer, **engine_kw)
+    httpd = make_http_server(srv, host, port)
+    print(json.dumps({"serving": f"http://{host}:{httpd.server_address[1]}"}),
+          flush=True)
+    try:
+        httpd.serve_forever()
+    finally:
+        srv.close()

@@ -1,0 +1,93 @@
+"""HTTP inference server tests (stdlib client, ephemeral port)."""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.engine import Engine
+from shellac_tpu.inference.server import InferenceServer, make_http_server
+from shellac_tpu.models import transformer
+from shellac_tpu.training.tokenizer import ByteTokenizer
+
+
+def _tiny():
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def http_srv():
+    cfg = _tiny()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    srv = InferenceServer(
+        cfg, params, tokenizer=ByteTokenizer(),
+        n_slots=2, max_len=64, temperature=0.0,
+    )
+    httpd = make_http_server(srv)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield base, cfg, params
+    httpd.shutdown()
+    srv.close()
+
+
+def _post(base, payload, timeout=120):
+    req = urllib.request.Request(
+        f"{base}/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class TestHTTPServer:
+    def test_health(self, http_srv):
+        base, _, _ = http_srv
+        with urllib.request.urlopen(f"{base}/health", timeout=30) as r:
+            out = json.loads(r.read())
+        assert out["ok"] is True
+
+    def test_generate_matches_engine(self, http_srv):
+        base, cfg, params = http_srv
+        prompt = [3, 7, 11]
+        out = _post(base, {"tokens": prompt, "max_new": 6})
+        ref = Engine(cfg, params, temperature=0.0).generate(
+            np.asarray([prompt], np.int32), max_new_tokens=6
+        )
+        assert out["tokens"] == np.asarray(ref.tokens)[0].tolist()
+
+    def test_text_roundtrip(self, http_srv):
+        base, _, _ = http_srv
+        out = _post(base, {"text": "hi", "max_new": 4})
+        assert len(out["tokens"]) == 4
+        assert isinstance(out["text"], str)
+
+    def test_concurrent_requests(self, http_srv):
+        base, cfg, params = http_srv
+        prompts = [[1, 2], [3, 4, 5], [6], [7, 8, 9, 10]]
+        results = [None] * len(prompts)
+
+        def hit(i):
+            results[i] = _post(base, {"tokens": prompts[i], "max_new": 5})
+
+        threads = [threading.Thread(target=hit, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        eng = Engine(cfg, params, temperature=0.0)
+        for i, p in enumerate(prompts):
+            ref = eng.generate(np.asarray([p], np.int32), max_new_tokens=5)
+            assert results[i]["tokens"] == np.asarray(ref.tokens)[0].tolist()
+
+    def test_bad_request(self, http_srv):
+        base, _, _ = http_srv
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {"max_new": 4})
+        assert ei.value.code == 400
