@@ -1,0 +1,126 @@
+"""HuggingFace Llama-family checkpoint conversion.
+
+Lets a user bring existing torch weights (Llama/Mistral-style decoders:
+GQA + SwiGLU + RMSNorm + NeoX-form RoPE) into shellac_tpu's stacked
+pytree layout:
+
+  - torch `nn.Linear` stores (out, in); we store (in, out) → transpose.
+  - HF RMSNorm weight `W` multiplies directly; ours applies `(1 + s)` →
+    s = W - 1 (so a zero-init tree is the identity scale).
+  - per-layer tensors stack along a leading `layers` axis to match the
+    `lax.scan` forward.
+
+Conversion is numerics-exact: the parity test compares our forward
+against `transformers`' LlamaForCausalLM logits on the same weights.
+
+Works from a live HF model, a state_dict, or a directory saved with
+`save_pretrained` (loaded locally — no network).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from shellac_tpu.config import ModelConfig
+
+
+def config_from_hf(hf_cfg) -> ModelConfig:
+    """ModelConfig from a transformers LlamaConfig-like object."""
+    n_heads = hf_cfg.num_attention_heads
+    head_dim = getattr(hf_cfg, "head_dim", None) or (
+        hf_cfg.hidden_size // n_heads
+    )
+    return ModelConfig(
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=n_heads,
+        n_kv_heads=getattr(hf_cfg, "num_key_value_heads", None) or n_heads,
+        head_dim=head_dim,
+        d_ff=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        norm_eps=hf_cfg.rms_norm_eps,
+        tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+    ).validate()
+
+
+def _to_np(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().to("cpu").float().numpy()
+    return np.asarray(t, np.float32)
+
+
+_LAYER_MAP = {
+    # ours: (hf suffix, transpose?)
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "w_gate": ("mlp.gate_proj.weight", True),
+    "w_up": ("mlp.up_proj.weight", True),
+    "w_down": ("mlp.down_proj.weight", True),
+}
+
+
+def params_from_state_dict(
+    state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=None
+) -> Dict[str, Any]:
+    """Convert an HF Llama state_dict to a shellac_tpu param pytree."""
+    sd = dict(state_dict)
+    # Accept both bare and "model."-prefixed keys.
+    prefix = "model." if any(k.startswith("model.") for k in sd) else ""
+    pdt = dtype or cfg.params_dtype
+
+    def get(name):
+        key = f"{prefix}{name}"
+        if key not in sd:
+            raise KeyError(
+                f"missing weight {key!r}; is this a Llama-family checkpoint?"
+            )
+        return _to_np(sd[key])
+
+    layers: Dict[str, list] = {k: [] for k in _LAYER_MAP}
+    layers["attn_norm"] = []
+    layers["mlp_norm"] = []
+    for i in range(cfg.n_layers):
+        base = f"layers.{i}."
+        for ours, (theirs, transpose) in _LAYER_MAP.items():
+            w = get(base + theirs)
+            layers[ours].append(w.T if transpose else w)
+        layers["attn_norm"].append(get(base + "input_layernorm.weight") - 1.0)
+        layers["mlp_norm"].append(
+            get(base + "post_attention_layernorm.weight") - 1.0
+        )
+
+    params: Dict[str, Any] = {
+        "embed": jnp.asarray(get("embed_tokens.weight"), pdt),
+        "layers": {
+            k: jnp.asarray(np.stack(v), pdt) for k, v in layers.items()
+        },
+        "final_norm": jnp.asarray(get("norm.weight") - 1.0, pdt),
+    }
+    if not cfg.tie_embeddings:
+        lm_head = sd.get("lm_head.weight")
+        if lm_head is None:
+            raise KeyError("untied config but no lm_head.weight in state_dict")
+        params["lm_head"] = jnp.asarray(_to_np(lm_head).T, pdt)
+    return params
+
+
+def from_hf(model_or_path, dtype=None):
+    """(cfg, params) from a transformers model instance or local directory."""
+    if isinstance(model_or_path, str):
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            model_or_path, local_files_only=True
+        )
+    else:
+        model = model_or_path
+    cfg = config_from_hf(model.config)
+    params = params_from_state_dict(model.state_dict(), cfg, dtype=dtype)
+    return cfg, params
