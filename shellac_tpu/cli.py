@@ -103,7 +103,7 @@ def _train_config(args):
 
     kw = {}
     for field in ("learning_rate", "warmup_steps", "weight_decay",
-                  "grad_accum", "seed"):
+                  "grad_accum", "seed", "optimizer"):
         v = getattr(args, field, None)
         if v is not None:
             kw[field] = v
@@ -296,6 +296,7 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--warmup-steps", type=int, dest="warmup_steps")
     t.add_argument("--weight-decay", type=float, dest="weight_decay")
     t.add_argument("--grad-accum", type=int, dest="grad_accum")
+    t.add_argument("--optimizer", choices=["adamw", "lion", "adafactor"])
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="perplexity of a checkpoint")

@@ -151,6 +151,8 @@ class TrainConfig:
     min_lr_ratio: float = 0.1
     warmup_steps: int = 100
     total_steps: int = 1000
+    # "adamw" (default), "lion", or "adafactor" (factored second moment).
+    optimizer: str = "adamw"
     weight_decay: float = 0.1
     b1: float = 0.9
     b2: float = 0.95

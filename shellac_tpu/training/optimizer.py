@@ -44,12 +44,32 @@ def _decay_mask(params):
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    return optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip_norm),
-        optax.scale_by_adam(
+    """adamw (default), lion, or adafactor, per cfg.optimizer.
+
+    All share the clip → scale → decoupled weight decay → schedule
+    chain, so state sharding and the train step are optimizer-agnostic.
+    adafactor's factored second moment cuts optimizer HBM from 2x params
+    to ~1x (+ O(rows+cols)); lion keeps only a bf16 momentum.
+    """
+    if cfg.optimizer == "adamw":
+        scaler = optax.scale_by_adam(
             b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
             mu_dtype=resolve_dtype(cfg.mu_dtype),
-        ),
+        )
+    elif cfg.optimizer == "lion":
+        scaler = optax.scale_by_lion(
+            b1=cfg.b1, b2=cfg.b2, mu_dtype=resolve_dtype(cfg.mu_dtype)
+        )
+    elif cfg.optimizer == "adafactor":
+        scaler = optax.scale_by_factored_rms(decay_rate=cfg.b2)
+    else:
+        raise ValueError(
+            f"unknown optimizer {cfg.optimizer!r}; "
+            "have adamw, lion, adafactor"
+        )
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip_norm),
+        scaler,
         optax.add_decayed_weights(cfg.weight_decay, mask=_decay_mask),
         optax.scale_by_learning_rate(make_schedule(cfg)),
     )
