@@ -39,6 +39,11 @@ class MoEConfig:
     capacity_factor: float = 1.25
     router_aux_loss_weight: float = 0.01
     router_z_loss_weight: float = 1e-3
+    # Never drop tokens: capacity is sized to the worst case (T per
+    # expert), costing O(E*T*D) dispatch buffers. Exact Mixtral-style
+    # computation — use for inference/conversion parity, not large-T
+    # training.
+    dropless: bool = False
 
 
 @dataclass(frozen=True)

@@ -28,11 +28,28 @@ from shellac_tpu.config import ModelConfig
 
 
 def config_from_hf(hf_cfg) -> ModelConfig:
-    """ModelConfig from a transformers LlamaConfig-like object."""
+    """ModelConfig from a Llama/Mistral/Mixtral transformers config.
+
+    Mistral's sliding window maps to attn_window; Mixtral's experts map
+    to a dropless MoEConfig (exact top-k computation, no capacity drops)
+    with every layer MoE.
+    """
+    from shellac_tpu.config import MoEConfig
+
     n_heads = hf_cfg.num_attention_heads
     head_dim = getattr(hf_cfg, "head_dim", None) or (
         hf_cfg.hidden_size // n_heads
     )
+    moe = None
+    if getattr(hf_cfg, "num_local_experts", None):
+        moe = MoEConfig(
+            num_experts=hf_cfg.num_local_experts,
+            num_experts_per_token=hf_cfg.num_experts_per_tok,
+            router_aux_loss_weight=getattr(
+                hf_cfg, "router_aux_loss_coef", 0.01
+            ),
+            dropless=True,
+        )
     return ModelConfig(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,
@@ -45,6 +62,8 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
         norm_eps=hf_cfg.rms_norm_eps,
         tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+        attn_window=getattr(hf_cfg, "sliding_window", None),
+        moe=moe,
     ).validate()
 
 
@@ -54,15 +73,25 @@ def _to_np(t) -> np.ndarray:
     return np.asarray(t, np.float32)
 
 
-_LAYER_MAP = {
+_ATTN_MAP = {
     # ours: (hf suffix, transpose?)
     "wq": ("self_attn.q_proj.weight", True),
     "wk": ("self_attn.k_proj.weight", True),
     "wv": ("self_attn.v_proj.weight", True),
     "wo": ("self_attn.o_proj.weight", True),
+}
+
+_DENSE_MLP_MAP = {
     "w_gate": ("mlp.gate_proj.weight", True),
     "w_up": ("mlp.up_proj.weight", True),
     "w_down": ("mlp.down_proj.weight", True),
+}
+
+# Mixtral experts: w1 = gate, w3 = up, w2 = down.
+_EXPERT_MAP = {
+    "w_gate": "w1",
+    "w_up": "w3",
+    "w_down": "w2",
 }
 
 
@@ -83,14 +112,34 @@ def params_from_state_dict(
             )
         return _to_np(sd[key])
 
-    layers: Dict[str, list] = {k: [] for k in _LAYER_MAP}
-    layers["attn_norm"] = []
-    layers["mlp_norm"] = []
+    moe = cfg.moe is not None
+    mlp_keys = (["w_router"] + list(_EXPERT_MAP) if moe
+                else list(_DENSE_MLP_MAP))
+    layers: Dict[str, list] = {
+        k: [] for k in [*_ATTN_MAP, *mlp_keys, "attn_norm", "mlp_norm"]
+    }
     for i in range(cfg.n_layers):
         base = f"layers.{i}."
-        for ours, (theirs, transpose) in _LAYER_MAP.items():
+        for ours, (theirs, transpose) in _ATTN_MAP.items():
             w = get(base + theirs)
             layers[ours].append(w.T if transpose else w)
+        if moe:
+            layers["w_router"].append(
+                get(base + "block_sparse_moe.gate.weight").T
+            )
+            for ours, theirs in _EXPERT_MAP.items():
+                experts = [
+                    get(
+                        base
+                        + f"block_sparse_moe.experts.{j}.{theirs}.weight"
+                    ).T
+                    for j in range(cfg.moe.num_experts)
+                ]
+                layers[ours].append(np.stack(experts))
+        else:
+            for ours, (theirs, transpose) in _DENSE_MLP_MAP.items():
+                w = get(base + theirs)
+                layers[ours].append(w.T if transpose else w)
         layers["attn_norm"].append(get(base + "input_layernorm.weight") - 1.0)
         layers["mlp_norm"].append(
             get(base + "post_attention_layernorm.weight") - 1.0

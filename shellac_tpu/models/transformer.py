@@ -235,11 +235,11 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
 
         # Single-token decode must never capacity-drop: a dropped token's
         # FFN output would silently become zero. Prefill keeps routed
-        # capacity — dropless there would cost O(E*T*D) dispatch buffers.
+        # capacity unless cfg.moe.dropless asks for exact computation.
         is_decode = cache is not None and s == 1
         down, aux, metrics = moe_ffn(
             hx, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            cfg.moe, drop_tokens=not is_decode,
+            cfg.moe, drop_tokens=not (is_decode or cfg.moe.dropless),
         )
         moe_out = {
             "aux": aux,

@@ -75,6 +75,60 @@ def test_missing_key_message():
         params_from_state_dict(sd, cfg)
 
 
+def _tiny_mixtral(vocab=128):
+    cfg = transformers.MixtralConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=112,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        sliding_window=None,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(1)
+    return transformers.MixtralForCausalLM(cfg).eval()
+
+
+def test_mixtral_logits_parity():
+    model = _tiny_mixtral()
+    cfg, params = from_hf(model)
+    assert cfg.moe is not None and cfg.moe.dropless
+    cfg = cfg.replace(dtype="float32")
+    tokens = np.array([[5, 9, 33, 77, 2, 41]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
+
+
+def test_mistral_sliding_window_parity():
+    cfg_hf = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, sliding_window=4,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(2)
+    model = transformers.MistralForCausalLM(cfg_hf).eval()
+    cfg, params = from_hf(model)
+    assert cfg.attn_window == 4
+    cfg = cfg.replace(dtype="float32")
+    tokens = np.arange(12, dtype=np.int64)[None] % 128
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
 def test_generation_runs_on_converted():
     from shellac_tpu.inference.engine import Engine
 
