@@ -98,7 +98,8 @@ class BatchingEngine:
         """Prefill one request and scatter it into `slot` of `cache`."""
         mini = init_cache(self.cfg, 1, self.max_len)
         logits, mini = transformer.forward_with_cache(
-            self.cfg, params, tokens, mini, new_tokens_len=prompt_len
+            self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
+            fresh_cache=True, attn_impl="auto",
         )
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1

@@ -92,7 +92,7 @@ class Engine:
         cache = init_cache(self.cfg, b, self.max_len)
         logits, cache = transformer.forward_with_cache(
             self.cfg, params, tokens, cache, new_tokens_len=prompt_len,
-            mesh=self.mesh,
+            mesh=self.mesh, fresh_cache=True, attn_impl="auto",
         )
         # Logits at the last *real* prompt position seed the first sample.
         last = jnp.take_along_axis(

@@ -201,11 +201,12 @@ class SpeculativeEngine:
         tcache = init_cache(self.cfg, b, self.max_len)
         dcache = init_cache(self.draft_cfg, b, self.max_len)
         tlogits, tcache = transformer.forward_with_cache(
-            self.cfg, params, tokens, tcache, new_tokens_len=prompt_len
+            self.cfg, params, tokens, tcache, new_tokens_len=prompt_len,
+            fresh_cache=True, attn_impl="auto",
         )
         _, dcache = transformer.forward_with_cache(
             self.draft_cfg, draft_params, tokens, dcache,
-            new_tokens_len=prompt_len,
+            new_tokens_len=prompt_len, fresh_cache=True, attn_impl="auto",
         )
         last = jnp.take_along_axis(
             tlogits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1

@@ -129,13 +129,21 @@ def logical_axes(cfg: ModelConfig) -> Params:
     return la
 
 
-def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
+def _block(
+    cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None,
+    fresh_cache: bool = False,
+):
     """One pre-norm transformer block. x: (B, S, D) in compute dtype.
 
     With `cache=(cache_k, cache_v, index, q_positions)` the block runs in
     decode mode: new k/v are written at `index` and attention reads the
     whole cache; returns (x, (new_cache_k, new_cache_v)). Without cache
     it returns (x, None).
+
+    fresh_cache=True asserts every sequence starts at index 0 (prefill
+    into an empty cache): attention then runs causally over the new
+    chunk itself — O(S^2/2) and flash-eligible — instead of scanning the
+    whole max_len buffer, while k/v still land in the cache.
     """
     cdt = cfg.compute_dtype
     b, s, d = x.shape
@@ -211,17 +219,25 @@ def _block(cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None):
         cache_k, cache_v, index, q_positions = cache  # index: (B,)
         cache_k, cache_v = update_layer(cache_k, cache_v, k, v, index)
         new_cache = (cache_k, cache_v)
-        max_len = cache_k.shape[1]
-        kv_positions = jnp.broadcast_to(
-            jnp.arange(max_len, dtype=jnp.int32), (b, max_len)
-        )
-        kv_mask = kv_positions < (index[:, None] + s)
-        o = attention(
-            q, cache_k.astype(cdt), cache_v.astype(cdt),
-            causal=True, window=cfg.attn_window,
-            q_positions=q_positions, kv_positions=kv_positions,
-            kv_mask=kv_mask, impl="ref",
-        )
+        if fresh_cache:
+            # Empty-cache prefill: attend within the new chunk only.
+            # Every row's positions start at 0, so plain causal masking
+            # already excludes the right-pad tail of shorter prompts.
+            o = attention(
+                q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
+            )
+        else:
+            max_len = cache_k.shape[1]
+            kv_positions = jnp.broadcast_to(
+                jnp.arange(max_len, dtype=jnp.int32), (b, max_len)
+            )
+            kv_mask = kv_positions < (index[:, None] + s)
+            o = attention(
+                q, cache_k.astype(cdt), cache_v.astype(cdt),
+                causal=True, window=cfg.attn_window,
+                q_positions=q_positions, kv_positions=kv_positions,
+                kv_mask=kv_mask, impl="ref",
+            )
     o = o.reshape(b, s, h * dh) @ materialize(lp["wo"], cdt)
     x = x + constrain(o, mesh, ("batch", "seq", None))
 
@@ -377,6 +393,8 @@ def forward_with_cache(
     *,
     new_tokens_len: Optional[jax.Array] = None,  # (B,) — valid count in `tokens`
     mesh=None,
+    fresh_cache: bool = False,
+    attn_impl: str = "ref",
 ):
     """Incremental forward: consumes `tokens` starting at cache.lengths.
 
@@ -385,6 +403,10 @@ def forward_with_cache(
     actual prompt lengths) and decode (S = 1). Writes land at each
     sequence's own length, so ragged batches decode with continuous
     positions and pads never pollute later steps.
+
+    fresh_cache=True (prefill into an all-empty cache) attends within
+    the incoming chunk instead of over the max_len buffer — quadratic
+    not rectangular, and flash-eligible via attn_impl="auto".
     """
     from shellac_tpu.inference.kvcache import KVCache
 
@@ -402,7 +424,8 @@ def forward_with_cache(
     def scan_body(x, layer_in):
         lp, ck, cv = layer_in
         x, new_cache, _ = _block(
-            cfg, mesh, "ref", x, lp, cos, sin, cache=(ck, cv, index, positions)
+            cfg, mesh, attn_impl, x, lp, cos, sin,
+            cache=(ck, cv, index, positions), fresh_cache=fresh_cache,
         )
         return x, new_cache
 
