@@ -131,7 +131,7 @@ def logical_axes(cfg: ModelConfig) -> Params:
 
 def _block(
     cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None,
-    fresh_cache: bool = False,
+    fresh_cache: bool = False, segments=None,
 ):
     """One pre-norm transformer block. x: (B, S, D) in compute dtype.
 
@@ -196,6 +196,11 @@ def _block(
             attn_impl == "auto" and sp_active and cfg.attn_window is not None
             and ulysses_ok
         )
+        if segments is not None and (use_ring or use_ulysses):
+            raise NotImplementedError(
+                "packed sequences (segment_ids) are not supported with "
+                "ring/ulysses sequence parallelism; use sp=1"
+            )
         if use_ring:
             # Sequence is sharded over sp: ring attention keeps kv local
             # (O(S/sp) memory) and rotates chunks over ICI instead of
@@ -211,7 +216,8 @@ def _block(
             )
         else:
             o = attention(
-                q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
+                q, k, v, causal=True, window=cfg.attn_window,
+                q_segments=segments, kv_segments=segments, impl=attn_impl,
             )
     else:
         from shellac_tpu.inference.kvcache import update_layer
@@ -273,12 +279,28 @@ def _block(
     return x, new_cache, moe_out
 
 
+def segment_positions(segment_ids: jax.Array) -> jax.Array:
+    """Per-segment position ids: restart at 0 on every segment change.
+
+    segment_ids: (B, S) int32, non-decreasing along S within a row.
+    """
+    b, s = segment_ids.shape
+    ar = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    changed = jnp.concatenate(
+        [jnp.ones((b, 1), bool), segment_ids[:, 1:] != segment_ids[:, :-1]],
+        axis=1,
+    )
+    start = jax.lax.cummax(jnp.where(changed, ar, 0), axis=1)
+    return ar - start
+
+
 def forward(
     cfg: ModelConfig,
     params: Params,
     tokens: jax.Array,  # (B, S) int32
     *,
     positions: Optional[jax.Array] = None,  # (B, S) int32
+    segment_ids: Optional[jax.Array] = None,  # (B, S) int32 — packed docs
     mesh=None,
     attn_impl: str = "auto",
     pipeline_microbatches: Optional[int] = None,
@@ -288,6 +310,9 @@ def forward(
 
     With a mesh whose pp axis > 1, the layer stack runs as a GPipe
     pipeline with `pipeline_microbatches` microbatches (default pp).
+    With segment_ids, rows hold multiple packed documents: attention is
+    block-diagonal over segments and RoPE positions restart per segment,
+    so each document computes exactly as if it were alone in the row.
     With return_aux=True, returns (logits, aux) where aux is a dict:
     "aux" (summed MoE auxiliary loss, 0 for dense) plus per-layer-mean
     router diagnostics (balance_loss, router_z_loss, dropped_frac).
@@ -296,13 +321,18 @@ def forward(
     b, s = tokens.shape
     pos = positions
     if pos is None:
-        pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        if segment_ids is not None:
+            pos = segment_positions(segment_ids)
+        else:
+            pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     cos, sin = rope_angles(pos, cfg.dim_per_head, cfg.rope_theta)
 
     x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
     x = constrain(x, mesh, ("batch", "seq", None))
 
-    block = functools.partial(_block, cfg, mesh, attn_impl)
+    block = functools.partial(
+        _block, cfg, mesh, attn_impl, segments=segment_ids
+    )
     if cfg.remat:
         block = jax.checkpoint(block)
 
@@ -318,9 +348,10 @@ def forward(
             )
         # Microbatches see a slice of the batch; RoPE tables must
         # broadcast across that slice, so positions must be uniform.
-        if positions is not None:
+        if positions is not None or segment_ids is not None:
             raise NotImplementedError(
-                "custom positions are not supported with pp > 1"
+                "custom positions / packed segments are not supported "
+                "with pp > 1"
             )
         cos, sin = cos[:1], sin[:1]  # (1, S, half) broadcasts over B_m
         lps = cfg.n_layers // pp

@@ -28,6 +28,8 @@ def _build_mask(
     causal: bool,
     window: Optional[int],
     kv_mask: Optional[jax.Array],  # (B, Sk) bool — valid kv slots
+    q_segments: Optional[jax.Array] = None,  # (B, Sq) int32
+    kv_segments: Optional[jax.Array] = None,  # (B, Sk) int32
 ) -> Optional[jax.Array]:
     """Boolean (B, 1, Sq, Sk) mask; True = attend."""
     parts = []
@@ -39,6 +41,9 @@ def _build_mask(
         parts.append(qp - kp < window)
     if kv_mask is not None:
         parts.append(kv_mask[:, None, :])
+    if q_segments is not None:
+        # Packed sequences: attend only within the same document.
+        parts.append(q_segments[:, :, None] == kv_segments[:, None, :])
     if not parts:
         return None
     mask = parts[0]
@@ -58,6 +63,8 @@ def attention_ref(
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
     kv_mask: Optional[jax.Array] = None,
+    q_segments: Optional[jax.Array] = None,
+    kv_segments: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference scaled-dot-product attention with GQA."""
     b, sq, h, d = q.shape
@@ -79,7 +86,10 @@ def attention_ref(
         "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
     )
     logits = logits * scale
-    mask = _build_mask(q_positions, kv_positions, causal, window, kv_mask)
+    mask = _build_mask(
+        q_positions, kv_positions, causal, window, kv_mask,
+        q_segments, kv_segments,
+    )
     if mask is not None:
         logits = jnp.where(mask[:, :, None, :, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
@@ -101,6 +111,8 @@ def attention(
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
     kv_mask: Optional[jax.Array] = None,
+    q_segments: Optional[jax.Array] = None,
+    kv_segments: Optional[jax.Array] = None,
     impl: str = "auto",
 ) -> jax.Array:
     """Dispatching attention. impl: "auto" | "flash" | "ref"."""
@@ -110,18 +122,19 @@ def attention(
         return attention_ref(
             q, k, v, causal=causal, window=window, scale=scale,
             q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
+            q_segments=q_segments, kv_segments=kv_segments,
         )
     from shellac_tpu.ops.flash_attention import flash_attention, flash_supported
 
     if impl == "flash":
         if window is not None or q_positions is not None or kv_positions is not None \
-                or kv_mask is not None:
+                or kv_mask is not None or q_segments is not None:
             raise ValueError(
                 "impl='flash' does not support window/q_positions/kv_positions/"
                 "kv_mask; use impl='auto' or 'ref'"
             )
         return flash_attention(q, k, v, causal=causal, scale=scale)
-    if impl == "auto" and flash_supported(
+    if impl == "auto" and q_segments is None and flash_supported(
         q, k, v, window=window, q_positions=q_positions,
         kv_positions=kv_positions, kv_mask=kv_mask, causal=causal,
     ):
@@ -129,4 +142,5 @@ def attention(
     return attention_ref(
         q, k, v, causal=causal, window=window, scale=scale,
         q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
+        q_segments=q_segments, kv_segments=kv_segments,
     )

@@ -105,6 +105,68 @@ def shard_batches(
     )
 
 
+def pack_documents(
+    docs,
+    *,
+    seq_len: int,
+    pad_id: int = 0,
+) -> Iterator[dict]:
+    """Greedy first-fit packing of documents into fixed-length rows.
+
+    Yields one row at a time: {"inputs", "targets" (seq_len,),
+    "segment_ids" (seq_len,) int32 — 0 marks padding, and "mask"
+    (seq_len,) fp32 — 1 only where the target stays inside the same
+    document}. Feed through `batch_rows` to group into batches. Combined
+    with forward(segment_ids=...), each packed document trains exactly
+    as if it were alone in the row (block-diagonal attention, restarted
+    positions) — no cross-document leakage, no padding waste beyond the
+    final row tail.
+
+    Documents longer than seq_len + 1 are truncated.
+    """
+    row_tok: list = []
+    row_seg: list = []
+    seg = 1
+
+    def emit():
+        t = np.full((seq_len + 1,), pad_id, np.int32)
+        g = np.zeros((seq_len + 1,), np.int32)
+        t[: len(row_tok)] = row_tok
+        g[: len(row_seg)] = row_seg
+        same = (g[1:] == g[:-1]) & (g[:-1] > 0)
+        return {
+            "inputs": t[:-1],
+            "targets": t[1:],
+            "segment_ids": g[:-1],
+            "mask": same.astype(np.float32),
+        }
+
+    for doc in docs:
+        d = np.asarray(doc, np.int32).reshape(-1)[: seq_len + 1]
+        if d.size < 2:
+            continue
+        if row_tok and len(row_tok) + d.size > seq_len + 1:
+            yield emit()
+            row_tok, row_seg = [], []
+        row_tok.extend(d.tolist())
+        row_seg.extend([seg] * d.size)
+        seg += 1
+    if row_tok:
+        yield emit()
+
+
+def batch_rows(rows: Iterator[dict], batch_size: int) -> Iterator[dict]:
+    """Group per-row dicts into stacked batches (drops a partial tail)."""
+    buf: list = []
+    for r in rows:
+        buf.append(r)
+        if len(buf) == batch_size:
+            yield {
+                k: np.stack([x[k] for x in buf]) for k in buf[0]
+            }
+            buf = []
+
+
 def device_prefetch(
     it: Iterator[dict],
     *,

@@ -72,6 +72,7 @@ def make_train_step(
     def loss_fn(params, batch):
         logits, aux = transformer.forward(
             model_cfg, params, batch["inputs"], mesh=mesh, attn_impl=attn_impl,
+            segment_ids=batch.get("segment_ids"),
             pipeline_microbatches=pipeline_microbatches, return_aux=True,
         )
         loss, metrics = cross_entropy(
