@@ -1,0 +1,100 @@
+"""Multi-host initialization and cross-host mesh construction.
+
+On multi-host TPU pods every host runs the same program; JAX needs a
+coordinator rendezvous before any collective compiles. This wraps
+`jax.distributed.initialize` with the standard environment conventions
+so launchers (GKE, ray, mpirun, manual ssh) all funnel through one
+entry point, and builds meshes over the *global* device set with the
+DCN-crossing axes outermost.
+
+Typical use, identical on every host:
+
+    from shellac_tpu.parallel.distributed import initialize, global_mesh
+    initialize()                       # no-op on single host
+    mesh = global_mesh(ParallelConfig(dp=n_hosts, fsdp=8))
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from shellac_tpu.config import ParallelConfig
+from shellac_tpu.parallel.mesh import make_mesh
+
+_ENV_ALIASES = {
+    "coordinator_address": ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS"),
+    "num_processes": ("JAX_NUM_PROCESSES", "NUM_PROCESSES", "WORLD_SIZE"),
+    "process_id": ("JAX_PROCESS_ID", "PROCESS_ID", "RANK"),
+}
+
+
+def _from_env(name: str) -> Optional[str]:
+    for var in _ENV_ALIASES[name]:
+        v = os.environ.get(var)
+        if v:
+            return v
+    return None
+
+
+def env_config() -> Optional[dict]:
+    """Distributed settings from the environment, or None if single-host."""
+    addr = _from_env("coordinator_address")
+    nproc = _from_env("num_processes")
+    pid = _from_env("process_id")
+    if addr is None and nproc is None and pid is None:
+        return None
+    if addr is None or nproc is None or pid is None:
+        missing = [
+            k for k, v in (
+                ("coordinator_address", addr),
+                ("num_processes", nproc),
+                ("process_id", pid),
+            ) if v is None
+        ]
+        raise ValueError(
+            f"partial distributed environment: missing {missing} "
+            f"(aliases: {[_ENV_ALIASES[m] for m in missing]})"
+        )
+    return {
+        "coordinator_address": addr,
+        "num_processes": int(nproc),
+        "process_id": int(pid),
+    }
+
+
+def initialize(**overrides) -> bool:
+    """Join the distributed runtime if the environment asks for it.
+
+    Returns True when multi-host init ran, False for single-host. Safe
+    to call unconditionally at program start (before first jax use).
+    Explicit kwargs override the environment.
+    """
+    cfg = env_config() or {}
+    cfg.update(overrides)
+    if not cfg:
+        return False
+    if int(cfg.get("num_processes", 1)) <= 1:
+        return False
+    jax.distributed.initialize(**cfg)
+    return True
+
+
+def global_mesh(parallel: ParallelConfig):
+    """Mesh over every device in the job (all hosts).
+
+    The ParallelConfig must multiply out to the global device count;
+    axis order already puts dp/fsdp outermost, which is where the
+    DCN boundary belongs (see docs/parallelism.md).
+    """
+    devices = jax.devices()
+    if parallel.num_devices != len(devices):
+        raise ValueError(
+            f"ParallelConfig wants {parallel.num_devices} devices but the "
+            f"job has {len(devices)} "
+            f"({jax.process_count()} processes x "
+            f"{jax.local_device_count()} local)"
+        )
+    return make_mesh(parallel, devices=devices)

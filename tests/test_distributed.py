@@ -1,0 +1,59 @@
+"""Distributed bootstrap helpers (single-process behaviors only)."""
+
+import pytest
+
+from shellac_tpu import ParallelConfig
+from shellac_tpu.parallel.distributed import env_config, global_mesh, initialize
+
+
+class TestEnvConfig:
+    def test_empty_env(self, monkeypatch):
+        for var in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                    "JAX_NUM_PROCESSES", "NUM_PROCESSES", "WORLD_SIZE",
+                    "JAX_PROCESS_ID", "PROCESS_ID", "RANK"):
+            monkeypatch.delenv(var, raising=False)
+        assert env_config() is None
+        assert initialize() is False
+
+    def test_full_env(self, monkeypatch):
+        monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.1:1234")
+        monkeypatch.setenv("WORLD_SIZE", "4")
+        monkeypatch.setenv("RANK", "2")
+        cfg = env_config()
+        assert cfg == {
+            "coordinator_address": "10.0.0.1:1234",
+            "num_processes": 4,
+            "process_id": 2,
+        }
+
+    def test_jax_prefixed_wins(self, monkeypatch):
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "a:1")
+        monkeypatch.setenv("COORDINATOR_ADDRESS", "b:2")
+        monkeypatch.setenv("WORLD_SIZE", "2")
+        monkeypatch.setenv("RANK", "0")
+        assert env_config()["coordinator_address"] == "a:1"
+
+    def test_partial_env_raises(self, monkeypatch):
+        for var in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+                    "JAX_NUM_PROCESSES", "NUM_PROCESSES", "WORLD_SIZE",
+                    "JAX_PROCESS_ID", "PROCESS_ID", "RANK"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("WORLD_SIZE", "4")
+        with pytest.raises(ValueError, match="partial distributed"):
+            env_config()
+
+    def test_single_process_noop(self, monkeypatch):
+        monkeypatch.setenv("COORDINATOR_ADDRESS", "x:1")
+        monkeypatch.setenv("WORLD_SIZE", "1")
+        monkeypatch.setenv("RANK", "0")
+        assert initialize() is False  # nothing to rendezvous
+
+
+class TestGlobalMesh:
+    def test_device_count_mismatch(self):
+        with pytest.raises(ValueError, match="wants 16 devices"):
+            global_mesh(ParallelConfig(dp=16))
+
+    def test_builds_over_all_devices(self):
+        mesh = global_mesh(ParallelConfig(fsdp=8))
+        assert mesh.devices.size == 8
