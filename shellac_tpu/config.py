@@ -67,6 +67,9 @@ class ModelConfig:
     tie_embeddings: bool = True
     # Rematerialize each block in the backward pass (memory for FLOPs).
     remat: bool = True
+    # What the remat may keep: "none" (recompute everything), "dots"
+    # (save matmul outputs — less recompute, more HBM), "dots_no_batch".
+    remat_policy: str = "none"
     # Optional sliding-window attention (None = full causal).
     attn_window: Optional[int] = None
     # If set, every `moe_every`-th layer is a MoE layer (1 = all layers).

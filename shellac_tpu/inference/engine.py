@@ -66,14 +66,18 @@ class Engine:
         temperature: float = 1.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        min_p: Optional[float] = None,
+        repetition_penalty: float = 1.0,
         mesh=None,
     ):
         self.cfg = cfg
         self.params = params
         self.mesh = mesh
         self.max_len = max_len or cfg.max_seq_len
+        self.repetition_penalty = repetition_penalty
         self._sampler = functools.partial(
-            sample, temperature=temperature, top_k=top_k, top_p=top_p
+            sample, temperature=temperature, top_k=top_k, top_p=top_p,
+            min_p=min_p,
         )
         if mesh is None:
             self._prefill = jax.jit(self._prefill_impl)
@@ -82,7 +86,7 @@ class Engine:
             # inherits it from its (committed) cache argument.
             cache_sh = make_shardings(mesh, cache_logical_axes())
             self._prefill = jax.jit(
-                self._prefill_impl, out_shardings=(None, cache_sh)
+                self._prefill_impl, out_shardings=(None, cache_sh, None)
             )
         self._decode = jax.jit(self._decode_impl, static_argnums=(3,))
 
@@ -98,31 +102,46 @@ class Engine:
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[:, 0]
-        return last, cache
+        # Token-presence mask over the valid prompt (repetition penalty).
+        valid = (
+            jnp.arange(s, dtype=jnp.int32)[None, :] < prompt_len[:, None]
+        )
+        seen = jnp.zeros((b, self.cfg.vocab_size), bool)
+        seen = seen.at[jnp.arange(b)[:, None], tokens].max(valid)
+        return last, cache, seen
 
-    def _decode_impl(self, params, first_token_logits, cache, steps, key):
+    def _decode_impl(self, params, first_token_logits, cache, steps, key, seen):
+        from shellac_tpu.ops.sampling import repetition_penalty
+
+        rp = self.repetition_penalty
+        b = first_token_logits.shape[0]
+        rows = jnp.arange(b)
+
         def step(carry, _):
-            cache, tok, key = carry
+            cache, tok, key, seen = carry
             logits, cache = transformer.forward_with_cache(
                 self.cfg, params, tok[:, None], cache, mesh=self.mesh
             )
-            logits = logits[:, 0]
+            logits = repetition_penalty(logits[:, 0], seen, rp)
             key, sub = jax.random.split(key)
             nxt = self._sampler(sub, logits)
+            seen = seen.at[rows, nxt].set(True)
             lp = jnp.take_along_axis(
                 jax.nn.log_softmax(logits, axis=-1), nxt[:, None], axis=-1
             )[:, 0]
-            return (cache, nxt, key), (nxt, lp)
+            return (cache, nxt, key, seen), (nxt, lp)
 
         key, sub = jax.random.split(key)
+        first_token_logits = repetition_penalty(first_token_logits, seen, rp)
         first = self._sampler(sub, first_token_logits)
+        seen = seen.at[rows, first].set(True)
         first_lp = jnp.take_along_axis(
             jax.nn.log_softmax(first_token_logits, axis=-1), first[:, None], axis=-1
         )[:, 0]
         # The first token comes from prefill logits; the scan samples the
         # remaining steps-1 (no discarded trailing forward pass).
         _, (toks, lps) = jax.lax.scan(
-            step, (cache, first, key), None, length=steps - 1
+            step, (cache, first, key, seen), None, length=steps - 1
         )
         tokens = jnp.concatenate([first[None], toks], axis=0)
         logprobs = jnp.concatenate([first_lp[None], lps], axis=0)
@@ -143,5 +162,9 @@ class Engine:
         b, s = prompt_tokens.shape
         if prompt_len is None:
             prompt_len = jnp.full((b,), s, jnp.int32)
-        first_logits, cache = self._prefill(self.params, prompt_tokens, prompt_len)
-        return self._decode(self.params, first_logits, cache, max_new_tokens, key)
+        first_logits, cache, seen = self._prefill(
+            self.params, prompt_tokens, prompt_len
+        )
+        return self._decode(
+            self.params, first_logits, cache, max_new_tokens, key, seen
+        )

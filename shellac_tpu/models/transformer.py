@@ -129,6 +129,21 @@ def logical_axes(cfg: ModelConfig) -> Params:
     return la
 
 
+def _remat_policy(name: str):
+    """Map ModelConfig.remat_policy to a jax.checkpoint saveable policy."""
+    if name == "none":
+        return None  # recompute everything (max memory savings)
+    policies = {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }
+    if name not in policies:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; have none, {sorted(policies)}"
+        )
+    return policies[name]
+
+
 def _block(
     cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None,
     fresh_cache: bool = False, segments=None,
@@ -334,7 +349,7 @@ def forward(
         _block, cfg, mesh, attn_impl, segments=segment_ids
     )
     if cfg.remat:
-        block = jax.checkpoint(block)
+        block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))
 
     from shellac_tpu.parallel.mesh import AXIS_PIPE
 

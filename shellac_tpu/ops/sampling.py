@@ -34,6 +34,25 @@ def top_p_mask(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < kth, NEG_INF, logits)
 
 
+def min_p_mask(logits: jax.Array, p: float) -> jax.Array:
+    """Keep tokens whose prob >= p * max prob (scale-adaptive cutoff)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    cutoff = p * jnp.max(probs, axis=-1, keepdims=True)
+    return jnp.where(probs < cutoff, NEG_INF, logits)
+
+
+def repetition_penalty(
+    logits: jax.Array,  # (..., V)
+    seen: jax.Array,  # (..., V) bool — tokens already in the context
+    penalty: float,
+) -> jax.Array:
+    """HF-convention penalty: seen tokens' logits /p if >0 else *p."""
+    if penalty == 1.0:
+        return logits
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
 def sample(
     key: jax.Array,
     logits: jax.Array,  # (..., V)
@@ -41,6 +60,7 @@ def sample(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    min_p: Optional[float] = None,
 ) -> jax.Array:
     """Sample token ids. temperature == 0 means greedy."""
     logits = logits.astype(jnp.float32)
@@ -51,4 +71,6 @@ def sample(
         logits = top_k_mask(logits, top_k)
     if top_p is not None:
         logits = top_p_mask(logits, top_p)
+    if min_p is not None:
+        logits = min_p_mask(logits, min_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,84 @@
+"""min-p sampling, repetition penalty, and remat policies."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.engine import Engine
+from shellac_tpu.models import transformer
+from shellac_tpu.ops.sampling import min_p_mask, repetition_penalty, sample
+
+
+class TestMinP:
+    def test_mask_keeps_relative_threshold(self):
+        logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+        masked = min_p_mask(logits, 0.5)  # cutoff = 0.25
+        kept = np.asarray(masked > -1e29)
+        assert kept.tolist() == [[True, True, False, False]]
+
+    def test_sample_respects_min_p(self):
+        logits = jnp.log(jnp.asarray([0.6, 0.3, 0.1]))
+        keys = jax.random.split(jax.random.PRNGKey(0), 200)
+        toks = jax.vmap(
+            lambda k: sample(k, logits, temperature=1.0, min_p=0.4)
+        )(keys)
+        assert set(np.asarray(toks).tolist()) == {0, 1}  # 0.1 < 0.4*0.6
+
+
+class TestRepetitionPenalty:
+    def test_hf_convention(self):
+        logits = jnp.asarray([2.0, -2.0, 1.0])
+        seen = jnp.asarray([True, True, False])
+        out = np.asarray(repetition_penalty(logits, seen, 2.0))
+        np.testing.assert_allclose(out, [1.0, -4.0, 1.0])
+
+    def test_engine_suppresses_loops(self):
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jnp.ones((1, 4), jnp.int32)
+        plain = Engine(cfg, params, temperature=0.0).generate(
+            prompt, max_new_tokens=12
+        )
+        heavy = Engine(
+            cfg, params, temperature=0.0, repetition_penalty=1e6
+        ).generate(prompt, max_new_tokens=12)
+        plain_t = np.asarray(plain.tokens)[0]
+        heavy_t = np.asarray(heavy.tokens)[0]
+        # Untuned tiny models loop; an extreme penalty must kill repeats
+        # entirely (every emitted token distinct, and != the prompt id).
+        assert len(set(heavy_t.tolist())) == 12
+        assert 1 not in heavy_t
+        # Sanity: the plain engine did loop, so the test discriminates.
+        assert len(set(plain_t.tolist())) < 12
+
+
+class TestRematPolicy:
+    @pytest.mark.parametrize("policy", ["dots", "dots_no_batch"])
+    def test_same_outputs_and_grads(self, policy):
+        cfg = get_model_config("tiny").replace(dtype="float32", remat=True)
+        cfg2 = cfg.replace(remat_policy=policy)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.ones((2, 16), jnp.int32)
+
+        def loss(c):
+            return lambda p: jnp.sum(
+                transformer.forward(c, p, tokens) ** 2
+            ) * 1e-6
+
+        l1, g1 = jax.value_and_grad(loss(cfg))(params)
+        l2, g2 = jax.value_and_grad(loss(cfg2))(params)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            )
+
+    def test_unknown_policy(self):
+        cfg = get_model_config("tiny").replace(
+            dtype="float32", remat=True, remat_policy="everything"
+        )
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="unknown remat_policy"):
+            transformer.forward(cfg, params, jnp.ones((1, 8), jnp.int32))
