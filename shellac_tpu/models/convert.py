@@ -160,6 +160,40 @@ def params_from_state_dict(
     return params
 
 
+def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
+    """Inverse of params_from_state_dict (dense Llama-style only).
+
+    Returns HF-named numpy arrays ("model."-prefixed), so trained or
+    LoRA-merged weights can go back into the torch/transformers world
+    (build a LlamaForCausalLM and `load_state_dict`).
+    """
+    if cfg.moe is not None:
+        raise NotImplementedError("to_state_dict supports dense models only")
+
+    def np_(x):
+        return np.asarray(x, np.float32)
+
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np_(params["embed"]),
+        "model.norm.weight": np_(params["final_norm"]) + 1.0,
+    }
+    layers = params["layers"]
+    for i in range(cfg.n_layers):
+        base = f"model.layers.{i}."
+        for ours, (theirs, transpose) in {**_ATTN_MAP, **_DENSE_MLP_MAP}.items():
+            w = np_(layers[ours][i])
+            sd[base + theirs] = w.T if transpose else w
+        sd[base + "input_layernorm.weight"] = np_(layers["attn_norm"][i]) + 1.0
+        sd[base + "post_attention_layernorm.weight"] = (
+            np_(layers["mlp_norm"][i]) + 1.0
+        )
+    if cfg.tie_embeddings:
+        sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
+    else:
+        sd["lm_head.weight"] = np_(params["lm_head"]).T
+    return sd
+
+
 def from_hf(model_or_path, dtype=None):
     """(cfg, params) from a transformers model instance or local directory."""
     if isinstance(model_or_path, str):

@@ -8,6 +8,8 @@ standard tradeoff (tighten log_every for faster tripping).
 
 from __future__ import annotations
 
+import signal
+import threading
 from typing import Iterator, Optional
 
 import jax
@@ -33,8 +35,16 @@ def fit(
     heartbeat_path: Optional[str] = None,
     max_restores: int = 2,
     pipeline_microbatches: Optional[int] = None,
+    handle_preemption: bool = True,
 ):
-    """Train until train_cfg.total_steps; returns the final TrainState."""
+    """Train until train_cfg.total_steps; returns the final TrainState.
+
+    With handle_preemption (and a checkpoint_dir), SIGTERM — the TPU-VM
+    maintenance/preemption signal — stops the loop at the next step
+    boundary and writes a final checkpoint, so `resume=True` restarts
+    where the preempted run left off instead of at the last periodic
+    save.
+    """
     ckpt = None
     if checkpoint_dir is not None:
         from shellac_tpu.training.checkpoint import Checkpointer
@@ -64,8 +74,20 @@ def fit(
     timer = StepTimer()
     restores = 0
 
+    preempted = threading.Event()
+    old_handler = None
+    install_handler = (
+        handle_preemption
+        and threading.current_thread() is threading.main_thread()
+    )
+    if install_handler:
+        def _on_term(signum, frame):
+            preempted.set()
+
+        old_handler = signal.signal(signal.SIGTERM, _on_term)
+
     step = int(jax.device_get(state.step))
-    while step < train_cfg.total_steps:
+    while step < train_cfg.total_steps and not preempted.is_set():
         try:
             batch = next(data_iter)
         except StopIteration:
@@ -106,5 +128,9 @@ def fit(
 
     if ckpt is not None:
         ckpt.save(int(jax.device_get(state.step)), state, force=True, wait=True)
+    if preempted.is_set():
+        logger.log(step, {"preempted": 1})
+    if install_handler:
+        signal.signal(signal.SIGTERM, old_handler)
     logger.close()
     return state

@@ -129,6 +129,60 @@ def test_mistral_sliding_window_parity():
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
 
 
+def test_export_roundtrip():
+    """ours -> HF state_dict -> torch model -> logits parity."""
+    from shellac_tpu.models.convert import to_state_dict
+
+    model = _tiny_llama(n_kv_heads=2, tie=False)
+    cfg, params = from_hf(model)
+    sd = to_state_dict(cfg, params)
+    model2 = _tiny_llama(n_kv_heads=2, tie=False)
+    model2.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})
+    tokens = torch.randint(0, cfg.vocab_size, (1, 10))
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            model2(tokens).logits.numpy(), model(tokens).logits.numpy(),
+            atol=1e-5,
+        )
+
+
+def test_preemption_checkpoint(tmp_path):
+    """SIGTERM mid-fit writes a resumable checkpoint."""
+    import os
+    import signal
+    import threading
+
+    from shellac_tpu import get_model_config
+    from shellac_tpu.config import TrainConfig
+    from shellac_tpu.training.checkpoint import Checkpointer
+    from shellac_tpu.training.data import token_batches
+    from shellac_tpu.training.loop import fit
+
+    cfg = get_model_config("tiny").replace(dtype="float32")
+    tcfg = TrainConfig(warmup_steps=1, total_steps=10_000)
+    corpus = np.arange(1 << 13, dtype=np.int32) % cfg.vocab_size
+
+    def fire():
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    # Fire after a few steps' worth of wall clock.
+    timer = threading.Timer(6.0, fire)
+    timer.start()
+    try:
+        state = fit(
+            cfg, tcfg,
+            token_batches(corpus, batch_size=2, seq_len=32),
+            checkpoint_dir=str(tmp_path / "ck"),
+            checkpoint_every=100_000, log_every=1,
+        )
+    finally:
+        timer.cancel()
+    stopped_at = int(np.asarray(state.step))
+    assert 0 < stopped_at < 10_000  # preempted, not finished
+    ck = Checkpointer(str(tmp_path / "ck"))
+    assert ck.latest_step() == stopped_at
+
+
 def test_generation_runs_on_converted():
     from shellac_tpu.inference.engine import Engine
 
