@@ -1,0 +1,69 @@
+// ThreadSanitizer stress driver for the native data loader.
+//
+// Compiled together with dataloader.cpp under -fsanitize=thread by
+// tests/test_native_tsan.py. Exercises the racy surfaces on purpose:
+//   - many producer threads against a shallow queue (condvar contention)
+//   - teardown while producers are mid-batch (stop/join path)
+//   - rapid open/start/consume/close cycles (lifetime races)
+//
+// Exits 0 on success; TSan reports (if any) land on stderr and fail
+// the calling test.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+void* stsh_open(uint64_t seed);
+int stsh_add_shard(void* h, const char* path);
+int stsh_start(void* h, int batch_size, int seq_len, int queue_depth,
+               int n_threads);
+int stsh_next(void* h, int32_t* inputs, int32_t* targets);
+uint64_t stsh_total_tokens(void* h);
+const char* stsh_last_error();
+void stsh_close(void* h);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s shard.bin [shard2.bin ...]\n", argv[0]);
+    return 2;
+  }
+  const int batch = 4, seq = 64;
+  std::vector<int32_t> inputs((size_t)batch * seq), targets((size_t)batch * seq);
+
+  for (int cycle = 0; cycle < 30; ++cycle) {
+    void* h = stsh_open(cycle);
+    for (int i = 1; i < argc; ++i) {
+      if (stsh_add_shard(h, argv[i])) {
+        std::fprintf(stderr, "add_shard: %s\n", stsh_last_error());
+        return 1;
+      }
+    }
+    // Shallow queue + more threads than depth maximizes blocking on the
+    // not_full condvar; odd cycles tear down while producers are stuck
+    // there (the historic double-free / missed-wakeup spot).
+    if (stsh_start(h, batch, seq, /*queue_depth=*/2, /*n_threads=*/4)) {
+      std::fprintf(stderr, "start: %s\n", stsh_last_error());
+      return 1;
+    }
+    const int consume = (cycle % 2 == 0) ? 8 : 1;
+    for (int b = 0; b < consume; ++b) {
+      if (stsh_next(h, inputs.data(), targets.data())) {
+        std::fprintf(stderr, "next failed\n");
+        return 1;
+      }
+      // Shifted-window invariant: targets are inputs advanced by one.
+      for (int i = 0; i < seq - 1; ++i) {
+        if (inputs[i + 1] != targets[i]) {
+          std::fprintf(stderr, "window invariant broken at %d\n", i);
+          return 1;
+        }
+      }
+    }
+    stsh_close(h);  // producers may be mid-batch or blocked right now
+  }
+  std::puts("stress ok");
+  return 0;
+}
