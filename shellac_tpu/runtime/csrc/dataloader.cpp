@@ -189,7 +189,14 @@ class Loader {
   }
 
   void stop_and_join() {
-    stop_.store(true);
+    {
+      // The store must happen under mu_: a worker that has evaluated
+      // the not_full_ predicate (stop_ false, queue full) but not yet
+      // entered the wait queue would otherwise miss the notify and
+      // sleep forever, hanging the join below.
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_.store(true);
+    }
     not_empty_.notify_all();
     not_full_.notify_all();
     for (auto& t : workers_) {

@@ -54,11 +54,16 @@ int main(int argc, char** argv) {
         std::fprintf(stderr, "next failed\n");
         return 1;
       }
-      // Shifted-window invariant: targets are inputs advanced by one.
-      for (int i = 0; i < seq - 1; ++i) {
-        if (inputs[i + 1] != targets[i]) {
-          std::fprintf(stderr, "window invariant broken at %d\n", i);
-          return 1;
+      // Shifted-window invariant per row: targets advance inputs by one.
+      for (int row = 0; row < batch; ++row) {
+        const int32_t* in = &inputs[(size_t)row * seq];
+        const int32_t* tg = &targets[(size_t)row * seq];
+        for (int i = 0; i < seq - 1; ++i) {
+          if (in[i + 1] != tg[i]) {
+            std::fprintf(stderr, "window invariant broken row %d pos %d\n",
+                         row, i);
+            return 1;
+          }
         }
       }
     }

@@ -22,7 +22,22 @@ _CSRC = os.path.join(
 _CXX = os.environ.get("CXX", "g++")
 
 
+def _tsan_toolchain_works(tmp_path) -> bool:
+    """Probe with a trivial TSan compile, so a broken dataloader.cpp
+    FAILS the test while a toolchain without -fsanitize=thread skips."""
+    probe_src = tmp_path / "probe.cpp"
+    probe_src.write_text("int main() { return 0; }\n")
+    proc = subprocess.run(
+        [_CXX, "-fsanitize=thread", "-pthread", str(probe_src),
+         "-o", str(tmp_path / "probe")],
+        capture_output=True, text=True,
+    )
+    return proc.returncode == 0
+
+
 def _build_stress(tmp_path):
+    if not _tsan_toolchain_works(tmp_path):
+        pytest.skip("toolchain lacks -fsanitize=thread")
     binary = str(tmp_path / "stress_loader")
     cmd = [
         _CXX, "-fsanitize=thread", "-O1", "-g", "-std=c++17", "-pthread",
@@ -31,8 +46,7 @@ def _build_stress(tmp_path):
         "-o", binary,
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True)
-    if proc.returncode != 0:
-        pytest.skip(f"tsan build unavailable: {proc.stderr[:200]}")
+    assert proc.returncode == 0, f"stress build failed:\n{proc.stderr[:2000]}"
     return binary
 
 
