@@ -44,6 +44,9 @@ class MoEConfig:
     # computation — use for inference/conversion parity, not large-T
     # training.
     dropless: bool = False
+    # DeepSeek-style always-active shared experts: one fused FFN of
+    # hidden size num_shared_experts * ff_dim added to the routed output.
+    num_shared_experts: int = 0
 
 
 @dataclass(frozen=True)
@@ -72,6 +75,9 @@ class ModelConfig:
     remat_policy: str = "none"
     # Optional sliding-window attention (None = full causal).
     attn_window: Optional[int] = None
+    # False = bidirectional (encoder) attention. Decoder-only features
+    # (KV-cache generation) require causal=True.
+    causal: bool = True
     # If set, every `moe_every`-th layer is a MoE layer (1 = all layers).
     moe: Optional[MoEConfig] = None
     moe_every: int = 1

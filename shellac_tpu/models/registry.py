@@ -14,6 +14,14 @@ PRESETS = {
     "tiny-moe": ModelConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
                             max_seq_len=128, remat=False,
                             moe=MoEConfig(num_experts=4, num_experts_per_token=2)),
+    "tiny-moe-shared": ModelConfig(vocab_size=256, d_model=64, n_layers=2,
+                                   n_heads=4, max_seq_len=128, remat=False,
+                                   moe=MoEConfig(num_experts=4,
+                                                 num_experts_per_token=2,
+                                                 num_shared_experts=1)),
+    "tiny-encoder": ModelConfig(vocab_size=256, d_model=64, n_layers=2,
+                                n_heads=4, max_seq_len=128, remat=False,
+                                causal=False),
     # single-chip bench scale (v5e: 16 GiB HBM)
     "shellac-270m": ModelConfig(vocab_size=32768, d_model=1024, n_layers=12,
                                 n_heads=8, n_kv_heads=8, head_dim=128,

@@ -82,6 +82,14 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 "w_up": dense(ks[5], (e, d, f), d),
                 "w_down": dense(ks[6], (e, f, d), f, out_scale),
             })
+            if cfg.moe.num_shared_experts > 0:
+                sf = cfg.moe.num_shared_experts * f
+                ks2 = jax.random.split(ks[7], 4)
+                p.update({
+                    "w_gate_shared": dense(ks2[1], (d, sf), d),
+                    "w_up_shared": dense(ks2[2], (d, sf), d),
+                    "w_down_shared": dense(ks2[3], (sf, d), sf, out_scale),
+                })
         return p
 
     layer_keys = jax.random.split(k_layers, cfg.n_layers)
@@ -111,6 +119,12 @@ def logical_axes(cfg: ModelConfig) -> Params:
             "w_up": ("layers", "experts", "embed", "mlp"),
             "w_down": ("layers", "experts", "mlp", "embed"),
         }
+        if cfg.moe.num_shared_experts > 0:
+            mlp_axes.update({
+                "w_gate_shared": ("layers", "embed", "mlp"),
+                "w_up_shared": ("layers", "embed", "mlp"),
+                "w_down_shared": ("layers", "mlp", "embed"),
+            })
     la: Params = {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -216,6 +230,11 @@ def _block(
                 "packed sequences (segment_ids) are not supported with "
                 "ring/ulysses sequence parallelism; use sp=1"
             )
+        if not cfg.causal and (use_ring or use_ulysses):
+            raise NotImplementedError(
+                "bidirectional attention is not supported with "
+                "ring/ulysses sequence parallelism; use sp=1"
+            )
         if use_ring:
             # Sequence is sharded over sp: ring attention keeps kv local
             # (O(S/sp) memory) and rotates chunks over ICI instead of
@@ -231,7 +250,7 @@ def _block(
             )
         else:
             o = attention(
-                q, k, v, causal=True, window=cfg.attn_window,
+                q, k, v, causal=cfg.causal, window=cfg.attn_window,
                 q_segments=segments, kv_segments=segments, impl=attn_impl,
             )
     else:
@@ -278,6 +297,12 @@ def _block(
             hx, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             cfg.moe, drop_tokens=not (is_decode or cfg.moe.dropless),
         )
+        if cfg.moe.num_shared_experts > 0:
+            sg = hx @ materialize(lp["w_gate_shared"], cdt)
+            su = hx @ materialize(lp["w_up_shared"], cdt)
+            down = down + swiglu(sg, su) @ materialize(
+                lp["w_down_shared"], cdt
+            )
         moe_out = {
             "aux": aux,
             "balance_loss": metrics["moe_balance_loss"],
@@ -456,6 +481,10 @@ def forward_with_cache(
     """
     from shellac_tpu.inference.kvcache import KVCache
 
+    if not cfg.causal:
+        raise ValueError(
+            "KV-cache generation requires a causal model (cfg.causal=True)"
+        )
     cdt = cfg.compute_dtype
     b, s = tokens.shape
     index = cache.lengths  # (B,)

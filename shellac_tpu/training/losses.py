@@ -42,3 +42,37 @@ def cross_entropy(
         "tokens": denom,
     }
     return loss, metrics
+
+
+def mlm_mask_tokens(
+    key: jax.Array,
+    tokens: jax.Array,  # (B, S) int32
+    *,
+    mask_id: int,
+    vocab_size: int,
+    mask_prob: float = 0.15,
+    random_frac: float = 0.1,
+    keep_frac: float = 0.1,
+) -> Tuple[jax.Array, jax.Array]:
+    """BERT-style corruption for masked-LM training (encoder family).
+
+    Selects mask_prob of positions; of those, 80% become mask_id, 10%
+    a random token, 10% stay unchanged. Returns (corrupted_tokens,
+    loss_mask) — pair with cross_entropy(logits, tokens, loss_mask) on
+    a cfg.causal=False model.
+    """
+    k_sel, k_kind, k_rand = jax.random.split(key, 3)
+    selected = jax.random.uniform(k_sel, tokens.shape) < mask_prob
+    kind = jax.random.uniform(k_kind, tokens.shape)
+    random_tok = jax.random.randint(
+        k_rand, tokens.shape, 0, vocab_size, jnp.int32
+    )
+    corrupted = jnp.where(kind < 1.0 - random_frac - keep_frac,
+                          mask_id, tokens)
+    corrupted = jnp.where(
+        (kind >= 1.0 - random_frac - keep_frac)
+        & (kind < 1.0 - keep_frac),
+        random_tok, corrupted,
+    )
+    corrupted = jnp.where(selected, corrupted, tokens)
+    return corrupted.astype(jnp.int32), selected.astype(jnp.float32)

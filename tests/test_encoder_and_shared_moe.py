@@ -1,0 +1,141 @@
+"""Encoder (bidirectional) family and shared-expert MoE."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.config import TrainConfig
+from shellac_tpu.models import transformer
+from shellac_tpu.training import init_train_state, make_train_step
+from shellac_tpu.training.losses import cross_entropy, mlm_mask_tokens
+
+
+def _enc(**kw):
+    return get_model_config("tiny-encoder").replace(dtype="float32", **kw)
+
+
+class TestEncoder:
+    def test_bidirectional_information_flow(self):
+        """Changing a FUTURE token must change PAST logits (no causality)."""
+        cfg = _enc()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        toks = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                                  cfg.vocab_size)
+        l1 = transformer.forward(cfg, params, toks)
+        toks2 = toks.at[0, 12].set((toks[0, 12] + 1) % cfg.vocab_size)
+        l2 = transformer.forward(cfg, params, toks2)
+        assert not np.allclose(np.asarray(l1[0, :12]), np.asarray(l2[0, :12]))
+
+    def test_cache_generation_rejected(self):
+        cfg = _enc()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        from shellac_tpu.inference.kvcache import init_cache
+
+        cache = init_cache(cfg, 1, 32)
+        with pytest.raises(ValueError, match="causal"):
+            transformer.forward_with_cache(
+                cfg, params, jnp.ones((1, 4), jnp.int32), cache
+            )
+
+    def test_mlm_training_loss_decreases(self):
+        cfg = _enc()
+        tcfg = TrainConfig(warmup_steps=1, total_steps=100, learning_rate=3e-3)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        from shellac_tpu.training.optimizer import make_optimizer
+        import optax
+
+        opt = make_optimizer(tcfg)
+        opt_state = opt.init(params)
+        toks = jnp.asarray(
+            np.tile(np.arange(64, dtype=np.int32) % 97, (4, 1))
+        )
+        mask_id = cfg.vocab_size - 1
+
+        @jax.jit
+        def step(params, opt_state, key):
+            corrupted, lmask = mlm_mask_tokens(
+                key, toks, mask_id=mask_id, vocab_size=cfg.vocab_size
+            )
+
+            def loss_fn(p):
+                logits = transformer.forward(cfg, p, corrupted)
+                loss, _ = cross_entropy(logits, toks, lmask)
+                return loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        key = jax.random.PRNGKey(0)
+        losses = []
+        for i in range(30):
+            key, sub = jax.random.split(key)
+            params, opt_state, loss = step(params, opt_state, sub)
+            losses.append(float(loss))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5])
+
+    def test_mlm_mask_fractions(self):
+        toks = jnp.zeros((64, 64), jnp.int32) + 7
+        corrupted, mask = mlm_mask_tokens(
+            jax.random.PRNGKey(0), toks, mask_id=255, vocab_size=256
+        )
+        frac = float(mask.mean())
+        assert 0.10 < frac < 0.20
+        # Of selected positions, ~80% should be the mask id.
+        sel = np.asarray(mask) > 0
+        masked_frac = (np.asarray(corrupted)[sel] == 255).mean()
+        assert 0.7 < masked_frac < 0.9
+
+
+class TestSharedExperts:
+    def test_params_and_forward(self):
+        cfg = get_model_config("tiny-moe-shared").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        assert "w_gate_shared" in params["layers"]
+        assert params["layers"]["w_gate_shared"].shape == (
+            cfg.n_layers, cfg.d_model, cfg.ff_dim
+        )
+        toks = jnp.ones((2, 16), jnp.int32)
+        logits = transformer.forward(cfg, params, toks)
+        assert np.isfinite(np.asarray(logits)).all()
+
+    def test_shared_path_contributes(self):
+        cfg = get_model_config("tiny-moe-shared").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        toks = jnp.ones((1, 8), jnp.int32)
+        l1 = transformer.forward(cfg, params, toks)
+        zeroed = dict(params)
+        zeroed["layers"] = dict(params["layers"])
+        zeroed["layers"]["w_down_shared"] = jnp.zeros_like(
+            params["layers"]["w_down_shared"]
+        )
+        l2 = transformer.forward(cfg, zeroed, toks)
+        assert not np.allclose(np.asarray(l1), np.asarray(l2))
+
+    def test_axes_match(self):
+        cfg = get_model_config("tiny-moe-shared")
+        params = jax.eval_shape(
+            lambda: transformer.init_params(cfg, jax.random.PRNGKey(0))
+        )
+        axes = transformer.logical_axes(cfg)
+        flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+        flat_a = jax.tree_util.tree_flatten_with_path(
+            axes, is_leaf=lambda x: isinstance(x, tuple)
+        )[0]
+        paths_p = {tuple(str(k) for k in p): leaf.ndim for p, leaf in flat_p}
+        paths_a = {tuple(str(k) for k in p): len(leaf) for p, leaf in flat_a}
+        assert paths_p == paths_a
+
+    def test_train_step(self, mesh8):
+        # fsdp=1 in this mesh: the experts axis (4) must divide the mesh
+        # axis it shards over.
+        cfg = get_model_config("tiny-moe-shared").replace(dtype="float32")
+        tcfg = TrainConfig(warmup_steps=1, total_steps=10)
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0),
+                                 mesh=mesh8)
+        step = make_train_step(cfg, tcfg, mesh=mesh8)
+        toks = np.ones((8, 32), np.int32)
+        state, metrics = step(state, {"inputs": toks, "targets": toks})
+        assert np.isfinite(float(metrics["loss"]))
