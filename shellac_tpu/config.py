@@ -68,6 +68,10 @@ class ModelConfig:
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
     tie_embeddings: bool = True
+    # MLP gate activation: "swiglu" (silu) or "geglu" (tanh-gelu, Gemma).
+    activation: str = "swiglu"
+    # Scale token embeddings by sqrt(d_model) at input (Gemma-style).
+    embed_scale: bool = False
     # Rematerialize each block in the backward pass (memory for FLOPs).
     remat: bool = True
     # What the remat may keep: "none" (recompute everything), "dots"

@@ -40,6 +40,7 @@ def config_from_hf(hf_cfg) -> ModelConfig:
     head_dim = getattr(hf_cfg, "head_dim", None) or (
         hf_cfg.hidden_size // n_heads
     )
+    is_gemma = getattr(hf_cfg, "model_type", "") == "gemma"
     moe = None
     if getattr(hf_cfg, "num_local_experts", None):
         moe = MoEConfig(
@@ -64,7 +65,20 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
         attn_window=getattr(hf_cfg, "sliding_window", None),
         moe=moe,
+        # Gemma: tanh-GeGLU MLP, sqrt(d)-scaled embeddings, and its
+        # RMSNorm is already the (1+w) form ours uses.
+        activation="geglu" if is_gemma else "swiglu",
+        embed_scale=is_gemma,
     ).validate()
+
+
+def _norm_offset(hf_cfg) -> float:
+    """What to add to HF norm weights to get our (1+s) convention.
+
+    Llama/Mistral/Mixtral RMSNorm multiplies by w directly -> s = w - 1.
+    Gemma stores (1 + w) semantics natively -> s = w.
+    """
+    return 0.0 if getattr(hf_cfg, "model_type", "") == "gemma" else -1.0
 
 
 def _to_np(t) -> np.ndarray:
@@ -96,9 +110,14 @@ _EXPERT_MAP = {
 
 
 def params_from_state_dict(
-    state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=None
+    state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=None,
+    norm_offset: float = -1.0,
 ) -> Dict[str, Any]:
-    """Convert an HF Llama state_dict to a shellac_tpu param pytree."""
+    """Convert an HF Llama-family state_dict to a shellac_tpu pytree.
+
+    norm_offset is added to HF norm weights (-1.0 for Llama-convention
+    RMSNorm, 0.0 for Gemma; see _norm_offset).
+    """
     sd = dict(state_dict)
     # Accept both bare and "model."-prefixed keys.
     prefix = "model." if any(k.startswith("model.") for k in sd) else ""
@@ -140,9 +159,11 @@ def params_from_state_dict(
             for ours, (theirs, transpose) in _DENSE_MLP_MAP.items():
                 w = get(base + theirs)
                 layers[ours].append(w.T if transpose else w)
-        layers["attn_norm"].append(get(base + "input_layernorm.weight") - 1.0)
+        layers["attn_norm"].append(
+            get(base + "input_layernorm.weight") + norm_offset
+        )
         layers["mlp_norm"].append(
-            get(base + "post_attention_layernorm.weight") - 1.0
+            get(base + "post_attention_layernorm.weight") + norm_offset
         )
 
     params: Dict[str, Any] = {
@@ -150,7 +171,7 @@ def params_from_state_dict(
         "layers": {
             k: jnp.asarray(np.stack(v), pdt) for k, v in layers.items()
         },
-        "final_norm": jnp.asarray(get("norm.weight") - 1.0, pdt),
+        "final_norm": jnp.asarray(get("norm.weight") + norm_offset, pdt),
     }
     if not cfg.tie_embeddings:
         lm_head = sd.get("lm_head.weight")
@@ -205,5 +226,8 @@ def from_hf(model_or_path, dtype=None):
     else:
         model = model_or_path
     cfg = config_from_hf(model.config)
-    params = params_from_state_dict(model.state_dict(), cfg, dtype=dtype)
+    params = params_from_state_dict(
+        model.state_dict(), cfg, dtype=dtype,
+        norm_offset=_norm_offset(model.config),
+    )
     return cfg, params

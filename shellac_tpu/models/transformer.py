@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from shellac_tpu.config import ModelConfig
-from shellac_tpu.ops.activations import softcap, swiglu
+from shellac_tpu.ops.activations import geglu, softcap, swiglu
 from shellac_tpu.ops.attention import attention
 from shellac_tpu.ops.norms import rms_norm
 from shellac_tpu.ops.quant import materialize
@@ -141,6 +141,25 @@ def logical_axes(cfg: ModelConfig) -> Params:
     if not cfg.tie_embeddings:
         la["lm_head"] = ("embed", "vocab")
     return la
+
+
+def _gated_act(cfg: ModelConfig):
+    if cfg.activation == "swiglu":
+        return swiglu
+    if cfg.activation == "geglu":
+        return geglu
+    raise ValueError(
+        f"unknown activation {cfg.activation!r}; have swiglu, geglu"
+    )
+
+
+def _embed_tokens(cfg: ModelConfig, params: Params, tokens, cdt):
+    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+    if cfg.embed_scale:
+        # Gemma convention; the scale is computed in the compute dtype
+        # (HF casts the normalizer to the embedding dtype too).
+        x = x * jnp.asarray(cfg.d_model ** 0.5, cdt)
+    return x
 
 
 def _remat_policy(name: str):
@@ -300,7 +319,7 @@ def _block(
         if cfg.moe.num_shared_experts > 0:
             sg = hx @ materialize(lp["w_gate_shared"], cdt)
             su = hx @ materialize(lp["w_up_shared"], cdt)
-            down = down + swiglu(sg, su) @ materialize(
+            down = down + _gated_act(cfg)(sg, su) @ materialize(
                 lp["w_down_shared"], cdt
             )
         moe_out = {
@@ -314,7 +333,7 @@ def _block(
         up = hx @ materialize(lp["w_up"], cdt)
         gate = constrain(gate, mesh, ("batch", "seq", "mlp"))
         up = constrain(up, mesh, ("batch", "seq", "mlp"))
-        down = swiglu(gate, up) @ materialize(lp["w_down"], cdt)
+        down = _gated_act(cfg)(gate, up) @ materialize(lp["w_down"], cdt)
     x = x + constrain(down, mesh, ("batch", "seq", None))
     return x, new_cache, moe_out
 
@@ -367,7 +386,7 @@ def forward(
             pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     cos, sin = rope_angles(pos, cfg.dim_per_head, cfg.rope_theta)
 
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+    x = _embed_tokens(cfg, params, tokens, cdt)
     x = constrain(x, mesh, ("batch", "seq", None))
 
     block = functools.partial(
@@ -493,7 +512,7 @@ def forward_with_cache(
     )
     cos, sin = rope_angles(positions, cfg.dim_per_head, cfg.rope_theta)
 
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+    x = _embed_tokens(cfg, params, tokens, cdt)
     x = constrain(x, mesh, ("batch", "seq", None))
 
     def scan_body(x, layer_in):

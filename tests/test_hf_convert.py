@@ -129,6 +129,29 @@ def test_mistral_sliding_window_parity():
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
 
 
+def test_gemma_logits_parity():
+    cfg_hf = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=128, rms_norm_eps=1e-6,
+        hidden_act="gelu_pytorch_tanh", hidden_activation="gelu_pytorch_tanh",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    model = transformers.GemmaForCausalLM(cfg_hf).eval()
+    cfg, params = from_hf(model)
+    assert cfg.activation == "geglu" and cfg.embed_scale
+    assert cfg.tie_embeddings  # Gemma ties by default
+    cfg = cfg.replace(dtype="float32")
+    tokens = np.array([[3, 9, 27, 81, 11, 33]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
+
+
 def test_export_roundtrip():
     """ours -> HF state_dict -> torch model -> logits parity."""
     from shellac_tpu.models.convert import to_state_dict
