@@ -82,6 +82,8 @@ class ModelConfig:
     # False = bidirectional (encoder) attention. Decoder-only features
     # (KV-cache generation) require causal=True.
     causal: bool = True
+    # Biases on the q/k/v projections (Qwen2-style); o_proj stays biasless.
+    attn_bias: bool = False
     # If set, every `moe_every`-th layer is a MoE layer (1 = all layers).
     moe: Optional[MoEConfig] = None
     moe_every: int = 1

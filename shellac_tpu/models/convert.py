@@ -69,6 +69,12 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         # RMSNorm is already the (1+w) form ours uses.
         activation="geglu" if is_gemma else "swiglu",
         embed_scale=is_gemma,
+        # Qwen2 puts biases on q/k/v (detected from the config flag
+        # where present, else model type).
+        attn_bias=bool(
+            getattr(hf_cfg, "attention_bias", False)
+            or getattr(hf_cfg, "model_type", "") == "qwen2"
+        ),
     ).validate()
 
 
@@ -108,6 +114,13 @@ _EXPERT_MAP = {
     "w_down": "w2",
 }
 
+# Qwen2-style attention biases (vectors, no transpose).
+_BIAS_MAP = {
+    "bq": "self_attn.q_proj.bias",
+    "bk": "self_attn.k_proj.bias",
+    "bv": "self_attn.v_proj.bias",
+}
+
 
 def params_from_state_dict(
     state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=None,
@@ -134,14 +147,18 @@ def params_from_state_dict(
     moe = cfg.moe is not None
     mlp_keys = (["w_router"] + list(_EXPERT_MAP) if moe
                 else list(_DENSE_MLP_MAP))
+    bias_keys = list(_BIAS_MAP) if cfg.attn_bias else []
     layers: Dict[str, list] = {
-        k: [] for k in [*_ATTN_MAP, *mlp_keys, "attn_norm", "mlp_norm"]
+        k: []
+        for k in [*_ATTN_MAP, *bias_keys, *mlp_keys, "attn_norm", "mlp_norm"]
     }
     for i in range(cfg.n_layers):
         base = f"layers.{i}."
         for ours, (theirs, transpose) in _ATTN_MAP.items():
             w = get(base + theirs)
             layers[ours].append(w.T if transpose else w)
+        for ours, theirs in (_BIAS_MAP.items() if cfg.attn_bias else ()):
+            layers[ours].append(get(base + theirs))
         if moe:
             layers["w_router"].append(
                 get(base + "block_sparse_moe.gate.weight").T

@@ -68,6 +68,12 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             "wo": dense(ks[3], (h * dh, d), h * dh, out_scale),
             "mlp_norm": jnp.zeros((d,), pdt),
         }
+        if cfg.attn_bias:
+            p.update({
+                "bq": jnp.zeros((h * dh,), pdt),
+                "bk": jnp.zeros((hkv * dh,), pdt),
+                "bv": jnp.zeros((hkv * dh,), pdt),
+            })
         if cfg.moe is None:
             p.update({
                 "w_gate": dense(ks[4], (d, f), d),
@@ -125,6 +131,13 @@ def logical_axes(cfg: ModelConfig) -> Params:
                 "w_up_shared": ("layers", "embed", "mlp"),
                 "w_down_shared": ("layers", "mlp", "embed"),
             })
+    bias_axes = {}
+    if cfg.attn_bias:
+        bias_axes = {
+            "bq": ("layers", "heads"),
+            "bk": ("layers", "kv_heads"),
+            "bv": ("layers", "kv_heads"),
+        }
     la: Params = {
         "embed": ("vocab", "embed"),
         "layers": {
@@ -134,6 +147,7 @@ def logical_axes(cfg: ModelConfig) -> Params:
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
             "mlp_norm": ("layers", None),
+            **bias_axes,
             **mlp_axes,
         },
         "final_norm": (None,),
@@ -199,9 +213,16 @@ def _block(
 
     # --- attention ---
     hx = rms_norm(x, lp["attn_norm"], cfg.norm_eps).astype(cdt)
-    q = (hx @ materialize(lp["wq"], cdt)).reshape(b, s, h, dh)
-    k = (hx @ materialize(lp["wk"], cdt)).reshape(b, s, hkv, dh)
-    v = (hx @ materialize(lp["wv"], cdt)).reshape(b, s, hkv, dh)
+    q = hx @ materialize(lp["wq"], cdt)
+    k = hx @ materialize(lp["wk"], cdt)
+    v = hx @ materialize(lp["wv"], cdt)
+    if cfg.attn_bias:
+        q = q + lp["bq"].astype(cdt)
+        k = k + lp["bk"].astype(cdt)
+        v = v + lp["bv"].astype(cdt)
+    q = q.reshape(b, s, h, dh)
+    k = k.reshape(b, s, hkv, dh)
+    v = v.reshape(b, s, hkv, dh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     new_cache = None

@@ -129,6 +129,35 @@ def test_mistral_sliding_window_parity():
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
 
 
+def test_qwen2_logits_parity():
+    cfg_hf = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-6,
+        sliding_window=None, use_sliding_window=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    model = transformers.Qwen2ForCausalLM(cfg_hf).eval()
+    # Qwen2 inits biases to zero; give them real values so the parity
+    # test actually exercises the bias path.
+    with torch.no_grad():
+        for layer in model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0.0, 0.5)
+    cfg, params = from_hf(model)
+    assert cfg.attn_bias
+    cfg = cfg.replace(dtype="float32")
+    tokens = np.array([[7, 21, 63, 3, 9, 27]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
+
+
 def test_gemma_logits_parity():
     cfg_hf = transformers.GemmaConfig(
         vocab_size=128, hidden_size=64, intermediate_size=128,
