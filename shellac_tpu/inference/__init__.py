@@ -1,4 +1,4 @@
-from shellac_tpu.inference.batching import BatchingEngine
+from shellac_tpu.inference.batching import BatchingEngine, PagedBatchingEngine
 from shellac_tpu.inference.engine import Engine, GenerationResult, shard_params
 from shellac_tpu.inference.kvcache import KVCache, cache_logical_axes, init_cache
 from shellac_tpu.inference.server import InferenceServer
@@ -8,6 +8,7 @@ __all__ = [
     "BatchingEngine",
     "Engine",
     "InferenceServer",
+    "PagedBatchingEngine",
     "GenerationResult",
     "KVCache",
     "init_cache",

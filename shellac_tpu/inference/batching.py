@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from shellac_tpu.config import ModelConfig
-from shellac_tpu.inference.kvcache import KVCache, init_cache
+from shellac_tpu.inference.kvcache import KVCache, init_cache, init_paged_cache
 from shellac_tpu.models import transformer
 from shellac_tpu.ops.sampling import sample
 
@@ -126,7 +126,7 @@ class BatchingEngine:
         )
         nxt = self._sampler(key, logits[:, 0])
         lengths = jnp.where(active, cache.lengths, old_lengths)
-        cache = KVCache(k=cache.k, v=cache.v, lengths=lengths)
+        cache = cache.replace(lengths=lengths)
         nxt = jnp.where(active, nxt, cur)
         return cache, nxt
 
@@ -143,11 +143,18 @@ class BatchingEngine:
             )
         self._queue.append(_Request(rid, tokens, max_new))
 
+    def _prepare_slot(self, slot: int, req: _Request) -> None:
+        """Hook before prefilling `req` into `slot` (paged: alloc blocks)."""
+
+    def _release_slot(self, slot: int) -> None:
+        """Hook after a request leaves `slot` (paged: free its blocks)."""
+
     def _fill_slots(self):
         for i in range(self.n_slots):
             if self._slots[i] is not None or not self._queue:
                 continue
             req = self._queue.popleft()
+            self._prepare_slot(i, req)
             s = req.tokens.size
             pad = _bucket(s)
             if pad not in self._prefill_jit:
@@ -177,6 +184,7 @@ class BatchingEngine:
             ):
                 finished.append((req.rid, req.out))
                 self._slots[i] = None
+                self._release_slot(i)
 
     def step(self) -> List[Tuple[Any, List[int]]]:
         """Fill free slots, run one decode tick; returns finished requests."""
@@ -187,6 +195,7 @@ class BatchingEngine:
         self._fill_slots()
         active_rows = [r is not None for r in self._slots]
         if any(active_rows):
+            self._pre_decode(active_rows)
             active = jnp.asarray(active_rows)
             self._key, sub = jax.random.split(self._key)
             self._cache, nxt = self._decode(
@@ -199,6 +208,9 @@ class BatchingEngine:
                     req.out.append(int(host_next[i]))
             self._finish_check(finished)
         return finished
+
+    def _pre_decode(self, active_rows) -> None:
+        """Hook before each decode tick (paged: grow block tables)."""
 
     @property
     def pending(self) -> int:
@@ -213,3 +225,124 @@ class BatchingEngine:
             for rid, out in self.step():
                 results[rid] = out
         return results
+
+
+class PagedBatchingEngine(BatchingEngine):
+    """Continuous batching over a shared block pool (paged KV cache).
+
+    Dense slots reserve n_slots*max_len tokens of KV whether used or
+    not; here slots borrow fixed-size blocks from one pool as they grow
+    and return them on completion, so resident KV memory tracks the
+    tokens actually alive. `pool_tokens` (default: half the dense
+    footprint) is the capacity knob; admission blocks — requests wait in
+    queue — when the pool can't cover a prompt.
+
+    Block 0 is reserved scratch: unallocated table entries point at it,
+    so out-of-range reads/writes land there and are masked downstream.
+    """
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params,
+        *,
+        n_slots: int = 8,
+        max_len: Optional[int] = None,
+        block_size: int = 16,
+        pool_tokens: Optional[int] = None,
+        **kw,
+    ):
+        super().__init__(cfg, params, n_slots=n_slots, max_len=max_len, **kw)
+        self.block_size = block_size
+        max_blocks_per_slot = -(-self.max_len // block_size)
+        if pool_tokens is None:
+            pool_tokens = n_slots * self.max_len // 2
+        n_blocks = max(-(-pool_tokens // block_size), max_blocks_per_slot) + 1
+        self._cache = init_paged_cache(
+            cfg, n_slots, n_blocks, block_size, max_blocks_per_slot
+        )
+        self._free: List[int] = list(range(n_blocks - 1, 0, -1))  # 0 = scratch
+        self._slot_blocks: List[List[int]] = [[] for _ in range(n_slots)]
+
+    # ---- allocator ---------------------------------------------------
+
+    def _ensure_blocks(self, slot: int, total_tokens: int) -> bool:
+        """Grow slot's table to cover total_tokens; False if pool empty."""
+        need = -(-total_tokens // self.block_size)
+        have = len(self._slot_blocks[slot])
+        if need <= have:
+            return True
+        if need - have > len(self._free):
+            return False
+        new_ids = [self._free.pop() for _ in range(need - have)]
+        self._slot_blocks[slot].extend(new_ids)
+        idx = jnp.arange(have, need, dtype=jnp.int32)
+        tables = self._cache.tables.at[slot, idx].set(
+            jnp.asarray(new_ids, jnp.int32)
+        )
+        self._cache = self._cache.replace(tables=tables)
+        return True
+
+    def _prepare_slot(self, slot: int, req) -> None:
+        if not self._ensure_blocks(slot, req.tokens.size + 1):
+            # Pool exhausted: put the request back and let it wait.
+            self._queue.appendleft(req)
+            raise _PoolExhausted()
+
+    def _release_slot(self, slot: int) -> None:
+        self._free.extend(reversed(self._slot_blocks[slot]))
+        self._slot_blocks[slot] = []
+        row = jnp.zeros((self._cache.max_blocks,), jnp.int32)
+        self._cache = self._cache.replace(
+            tables=self._cache.tables.at[slot].set(row)
+        )
+
+    def _pre_decode(self, active_rows) -> None:
+        lengths = np.asarray(self._cache.lengths)
+        for i, active in enumerate(active_rows):
+            if active and not self._ensure_blocks(i, int(lengths[i]) + 1):
+                raise RuntimeError(
+                    "paged KV pool exhausted mid-decode; size pool_tokens "
+                    "for n_slots concurrent worst-case lengths"
+                )
+
+    def _fill_slots(self):
+        try:
+            super()._fill_slots()
+        except _PoolExhausted:
+            pass  # request re-queued; retry after a slot frees blocks
+
+    # ---- jitted programs --------------------------------------------
+
+    def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key):
+        """Dense mini-prefill, then scatter through the slot's table."""
+        s = tokens.shape[1]
+        mini = init_cache(self.cfg, 1, s)
+        logits, mini = transformer.forward_with_cache(
+            self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
+            fresh_cache=True, attn_impl="auto",
+        )
+        last = jnp.take_along_axis(
+            logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
+        )[0, 0]
+        first = self._sampler(key, last)
+
+        bs = self.block_size
+        table_row = jax.lax.dynamic_slice_in_dim(cache.tables, slot, 1, 0)[0]
+        pos = jnp.arange(s, dtype=jnp.int32)
+        blocks = jnp.take(table_row, pos // bs)
+        offs = pos % bs
+        k_src = mini.k[:, 0].astype(cache.k.dtype)  # (L, S, Hkv, Dh)
+        v_src = mini.v[:, 0].astype(cache.v.dtype)
+        cache = cache.replace(
+            k=cache.k.at[:, blocks, offs].set(k_src),
+            v=cache.v.at[:, blocks, offs].set(v_src),
+            lengths=jax.lax.dynamic_update_slice(
+                cache.lengths, mini.lengths, (slot,)
+            ),
+        )
+        return cache, first
+
+
+class _PoolExhausted(Exception):
+    pass

@@ -69,3 +69,102 @@ def update_layer(
     ck = jax.vmap(upd)(cache_k, k_new, index)
     cv = jax.vmap(upd)(cache_v, v_new, index)
     return ck, cv
+
+
+# ---------------------------------------------------------------------------
+# Paged cache (block pool + per-sequence block tables)
+# ---------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class PagedKVCache:
+    """Block-pool KV cache: slots map to pool blocks via tables.
+
+    A dense slot cache reserves max_len for every slot; the pool is
+    sized to the *total* tokens actually resident, so many short
+    requests and a few long ones share memory. Block allocation is a
+    host-side free list (see PagedBatchingEngine); the device side only
+    ever sees the tables.
+
+    k, v: (L, n_blocks, block_size, Hkv, Dh)
+    tables: (n_slots, max_blocks) int32 — pool block id per logical
+        block; unallocated entries MUST point at block 0 (reserved as
+        scratch: it is never handed to a slot, so stray writes and reads
+        through unallocated table entries land there harmlessly).
+    lengths: (n_slots,) int32 — valid tokens per slot.
+    """
+
+    k: Any
+    v: Any
+    tables: Any
+    lengths: Any
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def max_blocks(self) -> int:
+        return self.tables.shape[1]
+
+
+def init_paged_cache(
+    cfg: ModelConfig,
+    n_slots: int,
+    n_blocks: int,
+    block_size: int,
+    max_blocks_per_slot: int,
+) -> PagedKVCache:
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.kv_heads, cfg.dim_per_head)
+    return PagedKVCache(
+        k=jnp.zeros(shape, cfg.compute_dtype),
+        v=jnp.zeros(shape, cfg.compute_dtype),
+        tables=jnp.zeros((n_slots, max_blocks_per_slot), jnp.int32),
+        lengths=jnp.zeros((n_slots,), jnp.int32),
+    )
+
+
+def paged_update_layer(
+    pool_k: jax.Array,  # (n_blocks, bs, Hkv, Dh) — one layer's pool
+    pool_v: jax.Array,
+    k_new: jax.Array,  # (B, S, Hkv, Dh)
+    v_new: jax.Array,
+    index: jax.Array,  # (B,) — per-slot write offsets (token positions)
+    tables: jax.Array,  # (B, max_blocks) int32
+):
+    """Scatter S new positions through the block tables; returns pools.
+
+    Positions index[b] + i map to pool coords
+    (tables[b, p // bs], p % bs). Slots must have blocks allocated for
+    every written position (the scheduler guarantees it); writes through
+    unallocated entries land in scratch block 0.
+    """
+    bs = pool_k.shape[1]
+    b, s = k_new.shape[:2]
+    pos = index[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # (B, S)
+    block_ids = jnp.take_along_axis(tables, pos // bs, axis=1)  # (B, S)
+    offs = pos % bs
+    flat_blocks = block_ids.reshape(-1)
+    flat_offs = offs.reshape(-1)
+    pk = pool_k.at[flat_blocks, flat_offs].set(
+        k_new.astype(pool_k.dtype).reshape(b * s, *k_new.shape[2:])
+    )
+    pv = pool_v.at[flat_blocks, flat_offs].set(
+        v_new.astype(pool_v.dtype).reshape(b * s, *v_new.shape[2:])
+    )
+    return pk, pv
+
+
+def paged_gather_layer(
+    pool_k: jax.Array,  # (n_blocks, bs, Hkv, Dh)
+    pool_v: jax.Array,
+    tables: jax.Array,  # (B, max_blocks)
+):
+    """Materialize each slot's logical KV view: (B, max_blocks*bs, H, D)."""
+    b, mb = tables.shape
+    bs = pool_k.shape[1]
+    k = jnp.take(pool_k, tables.reshape(-1), axis=0)
+    v = jnp.take(pool_v, tables.reshape(-1), axis=0)
+    k = k.reshape(b, mb * bs, *pool_k.shape[2:])
+    v = v.reshape(b, mb * bs, *pool_v.shape[2:])
+    return k, v

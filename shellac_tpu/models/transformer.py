@@ -193,7 +193,7 @@ def _remat_policy(name: str):
 
 def _block(
     cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None,
-    fresh_cache: bool = False, segments=None,
+    fresh_cache: bool = False, segments=None, page_tables=None,
 ):
     """One pre-norm transformer block. x: (B, S, D) in compute dtype.
 
@@ -292,6 +292,34 @@ def _block(
             o = attention(
                 q, k, v, causal=cfg.causal, window=cfg.attn_window,
                 q_segments=segments, kv_segments=segments, impl=attn_impl,
+            )
+    elif page_tables is not None:
+        from shellac_tpu.inference.kvcache import (
+            paged_gather_layer,
+            paged_update_layer,
+        )
+
+        pool_k, pool_v, index, q_positions = cache  # pool: (nb, bs, H, D)
+        pool_k, pool_v = paged_update_layer(
+            pool_k, pool_v, k, v, index, page_tables
+        )
+        new_cache = (pool_k, pool_v)
+        if fresh_cache:
+            o = attention(
+                q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
+            )
+        else:
+            k_all, v_all = paged_gather_layer(pool_k, pool_v, page_tables)
+            view = k_all.shape[1]
+            kv_positions = jnp.broadcast_to(
+                jnp.arange(view, dtype=jnp.int32), (b, view)
+            )
+            kv_mask = kv_positions < (index[:, None] + s)
+            o = attention(
+                q, k_all.astype(cdt), v_all.astype(cdt),
+                causal=True, window=cfg.attn_window,
+                q_positions=q_positions, kv_positions=kv_positions,
+                kv_mask=kv_mask, impl="ref",
             )
     else:
         from shellac_tpu.inference.kvcache import update_layer
@@ -519,12 +547,13 @@ def forward_with_cache(
     the incoming chunk instead of over the max_len buffer — quadratic
     not rectangular, and flash-eligible via attn_impl="auto".
     """
-    from shellac_tpu.inference.kvcache import KVCache
+    from shellac_tpu.inference.kvcache import PagedKVCache
 
     if not cfg.causal:
         raise ValueError(
             "KV-cache generation requires a causal model (cfg.causal=True)"
         )
+    paged = isinstance(cache, PagedKVCache)
     cdt = cfg.compute_dtype
     b, s = tokens.shape
     index = cache.lengths  # (B,)
@@ -541,6 +570,7 @@ def forward_with_cache(
         x, new_cache, _ = _block(
             cfg, mesh, attn_impl, x, lp, cos, sin,
             cache=(ck, cv, index, positions), fresh_cache=fresh_cache,
+            page_tables=cache.tables if paged else None,
         )
         return x, new_cache
 
@@ -560,7 +590,7 @@ def forward_with_cache(
         new_lengths = index + s
     else:
         new_lengths = index + new_tokens_len.astype(jnp.int32)
-    new_cache = KVCache(k=new_k, v=new_v, lengths=new_lengths)
+    new_cache = cache.replace(k=new_k, v=new_v, lengths=new_lengths)
     return logits, new_cache
 
 
