@@ -156,7 +156,10 @@ class BatchingEngine:
             req = self._queue.popleft()
             self._prepare_slot(i, req)
             s = req.tokens.size
-            pad = _bucket(s)
+            # Cap the bucket at max_len: a pad larger than the cache
+            # (dense) or the block table (paged) would write out of
+            # range — loudly for dense, silently-clamped for paged.
+            pad = min(_bucket(s), self.max_len)
             if pad not in self._prefill_jit:
                 self._prefill_jit[pad] = jax.jit(
                     self._prefill_impl, static_argnums=()
@@ -284,7 +287,11 @@ class PagedBatchingEngine(BatchingEngine):
         return True
 
     def _prepare_slot(self, slot: int, req) -> None:
-        if not self._ensure_blocks(slot, req.tokens.size + 1):
+        # Reserve the FULL footprint (prompt + generation budget) at
+        # admission: growth mid-decode could exhaust the pool and there
+        # is no good victim to evict at that point.
+        need = req.tokens.size + req.max_new + 1
+        if not self._ensure_blocks(slot, need):
             # Pool exhausted: put the request back and let it wait.
             self._queue.appendleft(req)
             raise _PoolExhausted()
@@ -298,9 +305,15 @@ class PagedBatchingEngine(BatchingEngine):
         )
 
     def _pre_decode(self, active_rows) -> None:
-        lengths = np.asarray(self._cache.lengths)
+        # Backstop only — admission already reserved the full footprint.
+        # Lengths are tracked on host (prompt + generated so far): no
+        # device sync in the serving hot loop.
         for i, active in enumerate(active_rows):
-            if active and not self._ensure_blocks(i, int(lengths[i]) + 1):
+            if not active:
+                continue
+            req = self._slots[i]
+            length = req.tokens.size + len(req.out)
+            if not self._ensure_blocks(i, length + 1):
                 raise RuntimeError(
                     "paged KV pool exhausted mid-decode; size pool_tokens "
                     "for n_slots concurrent worst-case lengths"

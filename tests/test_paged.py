@@ -125,6 +125,35 @@ class TestPagedEngine:
         for rid, toks, max_new in reqs:
             assert results[rid] == _ref(cfg, params, toks, max_new), rid
 
+    def test_non_power_of_two_max_len(self, setup):
+        """Prompt whose pad bucket exceeds max_len must not corrupt KV.
+
+        Regression: pad=64 > max_len=48 used to clamp pad positions onto
+        the slot's last real block, overwriting prompt K/V.
+        """
+        cfg, params = setup
+        rng = np.random.default_rng(3)
+        toks = rng.integers(0, cfg.vocab_size, 44)
+        srv = PagedBatchingEngine(cfg, params, n_slots=1, max_len=48,
+                                  block_size=8)
+        results = srv.run([("x", toks, 3)])
+        assert results["x"] == _ref(cfg, params, toks, 3)
+
+    def test_full_footprint_reserved_at_admission(self, setup):
+        """Concurrent requests that would exhaust the pool mid-decode
+        must serialize at admission instead of crashing the engine."""
+        cfg, params = setup
+        rng = np.random.default_rng(4)
+        srv = PagedBatchingEngine(
+            cfg, params, n_slots=2, max_len=64, block_size=8,
+            pool_tokens=64,  # 8 usable blocks; each request needs 6
+        )
+        reqs = [(i, rng.integers(0, cfg.vocab_size, 20), 20)
+                for i in range(2)]
+        results = srv.run(reqs)
+        for rid, toks, max_new in reqs:
+            assert results[rid] == _ref(cfg, params, toks, max_new), rid
+
     def test_memory_is_actually_smaller(self, setup):
         cfg, params = setup
         dense_tokens = 8 * 512
