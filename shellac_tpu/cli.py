@@ -234,10 +234,20 @@ def cmd_serve(args):
         from shellac_tpu.ops.quant import quantize_params
 
         params = quantize_params(cfg, params)
+    engine = None
+    if args.paged:
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        engine = PagedBatchingEngine(
+            cfg, params, n_slots=args.slots,
+            max_len=args.max_len or cfg.max_seq_len,
+            temperature=args.temperature, eos_id=args.eos_id,
+        )
     serve(
         cfg, params,
         host=args.host, port=args.port,
         tokenizer=get_tokenizer(args.tokenizer),
+        engine=engine,
         n_slots=args.slots, max_len=args.max_len,
         temperature=args.temperature, eos_id=args.eos_id,
     )
@@ -335,6 +345,8 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max-len", type=int, default=None, dest="max_len")
     s.add_argument("--temperature", type=float, default=0.0)
     s.add_argument("--eos-id", type=int, default=None, dest="eos_id")
+    s.add_argument("--paged", action="store_true",
+                   help="paged (block-pool) KV cache")
     s.add_argument("--ckpt-dir")
     s.add_argument("--quantize", action="store_true")
     s.add_argument("--tokenizer", default="byte")
