@@ -77,6 +77,24 @@ def _data_iter(args, cfg, batch_size, seq_len, num_batches=None):
     )
 
 
+def _load_native(native_dir):
+    """(cfg, params) from a directory written by `convert`."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    from shellac_tpu.config import ModelConfig, MoEConfig
+
+    with open(os.path.join(native_dir, "config.json")) as f:
+        cfg_d = json.load(f)
+    moe = cfg_d.pop("moe", None)
+    cfg = ModelConfig(**cfg_d, moe=MoEConfig(**moe) if moe else None)
+    params = ocp.StandardCheckpointer().restore(
+        os.path.join(os.path.abspath(native_dir), "params")
+    )
+    return cfg.validate(), params
+
+
 def _restore_params(args, cfg, train_cfg=None):
     """Params from --ckpt-dir (latest step), or a fresh random init."""
     import jax
@@ -167,8 +185,11 @@ def cmd_tokenize(args):
 def cmd_generate(args):
     import jax.numpy as jnp
 
-    cfg = _model_config(args)
-    params = _restore_params(args, cfg)
+    if getattr(args, "native_dir", None):
+        cfg, params = _load_native(args.native_dir)
+    else:
+        cfg = _model_config(args)
+        params = _restore_params(args, cfg)
     tok = None
     if args.text is not None:
         from shellac_tpu.training.tokenizer import get_tokenizer
@@ -254,6 +275,30 @@ def cmd_serve(args):
     return 0
 
 
+def cmd_convert(args):
+    """HF checkpoint directory -> native orbax params + config JSON."""
+    import dataclasses as dc
+    import os
+
+    import orbax.checkpoint as ocp
+
+    from shellac_tpu.models.convert import from_hf
+
+    cfg, params = from_hf(args.hf_dir)
+    out = os.path.abspath(args.out)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(out, "params"), params, force=True)
+    ckptr.wait_until_finished()
+    cfg_dict = dc.asdict(cfg)
+    with open(os.path.join(out, "config.json"), "w") as f:
+        json.dump(cfg_dict, f, indent=2)
+    n = sum(int(np.prod(x.shape)) for x in
+            __import__("jax").tree.leaves(params))
+    print(json.dumps({"out": out, "params": n,
+                      "model_type": "moe" if cfg.moe else "dense"}))
+    return 0
+
+
 def cmd_info(args):
     import jax
 
@@ -330,6 +375,8 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--top-k", type=int, default=None)
     g.add_argument("--top-p", type=float, default=None)
     g.add_argument("--ckpt-dir")
+    g.add_argument("--native-dir", dest="native_dir",
+                   help="directory written by `convert`")
     g.add_argument("--quantize", action="store_true",
                    help="int8 weight-only quantization")
     g.add_argument("--draft-model", default=None,
@@ -358,6 +405,12 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--tokenizer", default="byte",
                    help='"byte" or a local HF tokenizer dir')
     k.set_defaults(fn=cmd_tokenize)
+
+    c = sub.add_parser("convert",
+                       help="HF checkpoint dir -> native params + config")
+    c.add_argument("--hf-dir", required=True, dest="hf_dir")
+    c.add_argument("--out", required=True)
+    c.set_defaults(fn=cmd_convert)
 
     i = sub.add_parser("info", help="presets and config details")
     i.add_argument("--model")

@@ -6,6 +6,8 @@ tolerance (rope form, GQA expansion, rms eps placement, swiglu, tied
 head all have to line up).
 """
 
+import json
+
 import numpy as np
 import pytest
 
@@ -196,6 +198,36 @@ def test_export_roundtrip():
             model2(tokens).logits.numpy(), model(tokens).logits.numpy(),
             atol=1e-5,
         )
+
+
+def test_convert_cli_roundtrip(tmp_path, capsys):
+    """save_pretrained dir -> convert -> generate --native-dir."""
+    from shellac_tpu.cli import main
+    from shellac_tpu.inference.engine import Engine
+
+    model = _tiny_llama(n_kv_heads=2, tie=False)
+    hf_dir = tmp_path / "hf"
+    model.save_pretrained(str(hf_dir))
+    out_dir = tmp_path / "native"
+
+    rc = main(["convert", "--hf-dir", str(hf_dir), "--out", str(out_dir)])
+    assert rc == 0
+    meta = json.loads(capsys.readouterr().out)
+    assert meta["model_type"] == "dense" and meta["params"] > 0
+
+    rc = main([
+        "generate", "--native-dir", str(out_dir),
+        "--prompt", "1,2,3,4", "--max-new", "6", "--temperature", "0",
+    ])
+    assert rc == 0
+    gen = json.loads(capsys.readouterr().out)
+
+    # Same cfg (incl. compute dtype) as the native path uses.
+    cfg, params = from_hf(model)
+    ref = Engine(cfg, params, temperature=0.0).generate(
+        np.asarray([[1, 2, 3, 4]], np.int32), max_new_tokens=6
+    )
+    assert gen["tokens"] == np.asarray(ref.tokens)[0].tolist()
 
 
 def test_preemption_checkpoint(tmp_path):
