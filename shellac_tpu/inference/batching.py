@@ -136,6 +136,10 @@ class BatchingEngine:
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
             raise ValueError(f"request {rid!r}: empty prompt")
+        if max_new < 1:
+            # The engine always emits the prefill-sampled token, so
+            # max_new=0 would still return one token; reject it.
+            raise ValueError(f"request {rid!r}: max_new must be >= 1")
         if tokens.size + max_new + 1 > self.max_len:
             raise ValueError(
                 f"request {rid!r}: prompt {tokens.size} + max_new {max_new} "

@@ -52,12 +52,36 @@ class InferenceServer:
         self._pending: Dict[int, _Pending] = {}
         self._ids = itertools.count()
         self._stop = threading.Event()
+        self._fatal: Optional[str] = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     # ---- scheduler thread (sole owner of the engine) ----------------
 
     def _loop(self):
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001
+            # The scheduler thread is the only consumer; if it dies
+            # silently every pending and future request blocks forever.
+            # Fail everything loudly instead.
+            self._fatal = f"scheduler died: {type(e).__name__}: {e}"
+            self._stop.set()
+            for p in list(self._pending.values()):
+                p.error = self._fatal
+                p.event.set()
+            self._pending.clear()
+            while True:
+                try:
+                    rid, _, _ = self._submit_q.get_nowait()
+                except queue.Empty:
+                    break
+                p = self._pending.pop(rid, None)
+                if p is not None:
+                    p.error = self._fatal
+                    p.event.set()
+
+    def _run(self):
         while not self._stop.is_set():
             drained = False
             while True:
@@ -89,13 +113,24 @@ class InferenceServer:
     # ---- client surface ---------------------------------------------
 
     def generate(self, tokens, max_new: int, timeout: Optional[float] = None):
+        if self._fatal is not None:
+            raise RuntimeError(self._fatal)
         rid = next(self._ids)
         p = _Pending()
         self._pending[rid] = p
         self._submit_q.put((rid, np.asarray(tokens, np.int32), max_new))
+        if self._fatal is not None and not p.event.is_set():
+            # Scheduler died while we enqueued; its sweep may have
+            # missed this request — fail it ourselves.
+            self._pending.pop(rid, None)
+            raise RuntimeError(self._fatal)
         if not p.event.wait(timeout):
             raise TimeoutError(f"request {rid} timed out")
         if p.error is not None:
+            # Scheduler death is a server fault (HTTP 500), not a bad
+            # request (400): keep the error classes distinct.
+            if self._fatal is not None and p.error == self._fatal:
+                raise RuntimeError(p.error)
             raise ValueError(p.error)
         return p.result
 
@@ -151,6 +186,8 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 self._send(200, server.handle(payload))
             except (ValueError, TimeoutError) as e:
                 self._send(400, {"error": str(e)})
+            except RuntimeError as e:
+                self._send(500, {"error": str(e)})
 
     return ThreadingHTTPServer((host, port), Handler)
 

@@ -19,7 +19,7 @@ Works from a live HF model, a state_dict, or a directory saved with
 
 from __future__ import annotations
 
-from typing import Any, Dict, Mapping
+from typing import Any, Dict, Mapping, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -63,7 +63,7 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
         norm_eps=hf_cfg.rms_norm_eps,
         tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
-        attn_window=getattr(hf_cfg, "sliding_window", None),
+        attn_window=_hf_attn_window(hf_cfg),
         moe=moe,
         # Gemma: tanh-GeGLU MLP, sqrt(d)-scaled embeddings, and its
         # RMSNorm is already the (1+w) form ours uses.
@@ -76,6 +76,34 @@ def config_from_hf(hf_cfg) -> ModelConfig:
             or getattr(hf_cfg, "model_type", "") == "qwen2"
         ),
     ).validate()
+
+
+def _hf_attn_window(hf_cfg) -> Optional[int]:
+    """Sliding-window size, honoring the flags HF actually checks.
+
+    Qwen2 configs routinely ship sliding_window set but
+    use_sliding_window=False — HF ignores the window there, so we must
+    too. Per-layer windowing (max_window_layers < n_layers with SWA
+    enabled) has no uniform-window equivalent; refuse rather than
+    silently diverge.
+    """
+    window = getattr(hf_cfg, "sliding_window", None)
+    if window is None or not getattr(hf_cfg, "use_sliding_window", True):
+        return None
+    # HF semantics: the first max_window_layers layers run FULL
+    # attention; only layers beyond them use SWA. So mwl >= n_layers
+    # means no layer is windowed, mwl == 0 means all are, and anything
+    # in between is per-layer mixing we cannot represent uniformly.
+    mwl = getattr(hf_cfg, "max_window_layers", None)
+    if mwl is None or mwl == 0:
+        return int(window)
+    if mwl >= hf_cfg.num_hidden_layers:
+        return None
+    raise ValueError(
+        f"per-layer sliding window (first max_window_layers={mwl} of "
+        f"n_layers={hf_cfg.num_hidden_layers} full, rest windowed) is "
+        "not representable as a uniform attn_window; refusing to convert"
+    )
 
 
 def _norm_offset(hf_cfg) -> float:
