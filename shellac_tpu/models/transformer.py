@@ -167,8 +167,23 @@ def _gated_act(cfg: ModelConfig):
     )
 
 
-def _embed_tokens(cfg: ModelConfig, params: Params, tokens, cdt):
-    x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
+def _embed_tokens(cfg: ModelConfig, params: Params, tokens, cdt, mesh=None):
+    from shellac_tpu.parallel.mesh import AXIS_TENSOR
+
+    if mesh is not None and mesh.shape.get(AXIS_TENSOR, 1) > 1:
+        # The table's vocab axis is tp-sharded. A plain gather makes the
+        # SPMD partitioner replicate the whole table every step
+        # ("involuntary full rematerialization" warning); a one-hot
+        # contraction keeps it sharded — the one-hot is built locally on
+        # each shard, the contraction rides the MXU, and XLA inserts a
+        # single psum over tp. Exact: one row of 1.0 per token, so the
+        # bf16 sum adds zeros.
+        one_hot = jax.nn.one_hot(tokens, cfg.vocab_size, dtype=cdt)
+        x = jnp.einsum(
+            "bsv,vd->bsd", one_hot, params["embed"].astype(cdt)
+        )
+    else:
+        x = jnp.take(params["embed"], tokens, axis=0).astype(cdt)
     if cfg.embed_scale:
         # Gemma convention; the scale is computed in the compute dtype
         # (HF casts the normalizer to the embedding dtype too).
@@ -309,17 +324,13 @@ def _block(
                 q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
             )
         else:
-            k_all, v_all = paged_gather_layer(pool_k, pool_v, page_tables)
-            view = k_all.shape[1]
-            kv_positions = jnp.broadcast_to(
-                jnp.arange(view, dtype=jnp.int32), (b, view)
+            from shellac_tpu.ops.decode_attention import (
+                paged_decode_attention,
             )
-            kv_mask = kv_positions < (index[:, None] + s)
-            o = attention(
-                q, k_all.astype(cdt), v_all.astype(cdt),
-                causal=True, window=cfg.attn_window,
-                q_positions=q_positions, kv_positions=kv_positions,
-                kv_mask=kv_mask, impl="ref",
+
+            o = paged_decode_attention(
+                q, pool_k, pool_v, page_tables, index,
+                window=cfg.attn_window, impl=attn_impl,
             )
     else:
         from shellac_tpu.inference.kvcache import update_layer
@@ -335,16 +346,11 @@ def _block(
                 q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
             )
         else:
-            max_len = cache_k.shape[1]
-            kv_positions = jnp.broadcast_to(
-                jnp.arange(max_len, dtype=jnp.int32), (b, max_len)
-            )
-            kv_mask = kv_positions < (index[:, None] + s)
-            o = attention(
-                q, cache_k.astype(cdt), cache_v.astype(cdt),
-                causal=True, window=cfg.attn_window,
-                q_positions=q_positions, kv_positions=kv_positions,
-                kv_mask=kv_mask, impl="ref",
+            from shellac_tpu.ops.decode_attention import decode_attention
+
+            o = decode_attention(
+                q, cache_k, cache_v, index,
+                window=cfg.attn_window, impl=attn_impl,
             )
     o = o.reshape(b, s, h * dh) @ materialize(lp["wo"], cdt)
     x = x + constrain(o, mesh, ("batch", "seq", None))
@@ -435,7 +441,7 @@ def forward(
             pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     cos, sin = rope_angles(pos, cfg.dim_per_head, cfg.rope_theta)
 
-    x = _embed_tokens(cfg, params, tokens, cdt)
+    x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))
 
     block = functools.partial(
@@ -533,7 +539,7 @@ def forward_with_cache(
     new_tokens_len: Optional[jax.Array] = None,  # (B,) — valid count in `tokens`
     mesh=None,
     fresh_cache: bool = False,
-    attn_impl: str = "ref",
+    attn_impl: str = "auto",
 ):
     """Incremental forward: consumes `tokens` starting at cache.lengths.
 
@@ -562,7 +568,7 @@ def forward_with_cache(
     )
     cos, sin = rope_angles(positions, cfg.dim_per_head, cfg.rope_theta)
 
-    x = _embed_tokens(cfg, params, tokens, cdt)
+    x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))
 
     def scan_body(x, layer_in):

@@ -1,0 +1,406 @@
+"""Flash-decode attention over KV caches as Pallas TPU kernels.
+
+The decode hot loop reads a (B, max_len, Hkv, D) cache (or a paged block
+pool) with a tiny q (B, s, H, D). The XLA ref path computes logits over
+the whole max_len buffer every tick; these kernels instead stream the
+cache in blocks with online softmax and — the actual win — *skip the
+blocks beyond each sequence's own length entirely*: per-sequence lengths
+are scalar-prefetched into SMEM and both the DMA index map and the
+compute are clamped to the live range. A slot at position 130 of a
+4096-token buffer touches one or two KV blocks, not 4096 rows.
+
+Two entry points:
+  - `decode_attention`: dense cache (B, L, Hkv, D). Grid (B, Hkv,
+    kv_blocks); GQA q rows for one kv head are flattened into a single
+    (G*s, D) tile so kv is loaded once per group, never replicated.
+  - `paged_decode_attention`: block-pool cache (n_blocks, bs, Hkv, D)
+    with per-slot tables. Same kernel body; the kv DMA indirects
+    through the scalar-prefetched block table, so the dense (B,
+    view, H, D) gather the ref path materializes never exists.
+
+Both positions contracts follow forward_with_cache: q row si of batch b
+sits at position lengths[b] + si, kv slot p is valid iff p <= that
+(causal), optionally windowed. Rows whose scores are all masked in a
+block self-correct in the online softmax once a valid block arrives
+(alpha underflows to 0), and every real row attends at least its own
+token.
+
+The reference repo is empty (SURVEY.md §0); the blocked-decode idea is
+the public flash-decoding / PagedAttention pattern, reimplemented for
+the TPU memory hierarchy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from shellac_tpu.ops.attention import attention_ref
+from shellac_tpu.ops.dispatch import pallas_supported
+from shellac_tpu.ops.flash_attention import _fit_block
+
+DEFAULT_BLOCK_K = 512
+NEG_INF = -2.0e38
+
+
+# ---------------------------------------------------------------------------
+# shared kernel body
+# ---------------------------------------------------------------------------
+
+
+def _decode_tile(
+    idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale, s, block_k, window, k_start, ki, last_ki, first_ki,
+):
+    """One (G*s rows) x (block_k kv) online-softmax step.
+
+    idx: scalar — this sequence's pre-write length (q row si sits at
+    position idx + si). k_ref/v_ref hold a (block_k, D) kv tile whose
+    first row is global position k_start.
+    """
+    live = (ki >= first_ki) & (k_start <= idx + s - 1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32) * scale
+        k = k_ref[...].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (rows, block_k)
+        rows = logits.shape[0]
+        r = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+        qpos = idx + r % s  # row r is (g, si=r%s) → position idx + si
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, block_k), 1
+        )
+        mask = kpos <= qpos
+        if window is not None:
+            mask &= qpos - kpos < window
+        logits = jnp.where(mask, logits, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(logits - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = jnp.broadcast_to(
+            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+        )
+        v = v_ref[...]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _live_range(idx, s, block_k, window, num_kv):
+    """(first_ki, last_ki) of kv blocks any q row can attend."""
+    last_ki = jnp.minimum((idx + s - 1) // block_k, num_kv - 1)
+    if window is None:
+        first_ki = jnp.int32(0)
+    else:
+        first_ki = jnp.maximum(idx - window + 1, 0) // block_k
+    return first_ki, last_ki
+
+
+# ---------------------------------------------------------------------------
+# dense cache
+# ---------------------------------------------------------------------------
+
+
+def _dense_kernel(
+    idx_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale, s, block_k, window, num_kv,
+):
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    idx = idx_ref[b]
+    first_ki, last_ki = _live_range(idx, s, block_k, window, num_kv)
+    _decode_tile(
+        idx, q_ref.at[0, 0], k_ref.at[0, :, 0], v_ref.at[0, :, 0],
+        o_ref.at[0, 0], acc_ref, m_ref, l_ref,
+        scale=scale, s=s, block_k=block_k, window=window,
+        k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
+    )
+
+
+def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    _, max_len, hkv, _ = cache_k.shape
+    g = h // hkv
+    rows = g * s
+    num_kv = max_len // block_k
+
+    # (B, s, H, D) -> (B, Hkv, G*s, D): row r = g*s + si.
+    qf = q.transpose(0, 2, 1, 3).reshape(b, hkv, g, s, d)
+    qf = qf.reshape(b, hkv, rows, d)
+
+    def kv_map(bi, hi, ki, idx_ref):
+        first_ki, last_ki = _live_range(
+            idx_ref[bi], s, block_k, window, num_kv
+        )
+        # Clamp dead blocks onto the live range: Mosaic only issues a
+        # DMA when the block index changes, so skipped blocks cost no
+        # HBM bandwidth.
+        return bi, jnp.clip(ki, first_ki, last_ki), hi, 0
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, hkv, num_kv),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, rows, d), lambda bi, hi, ki, idx_ref: (bi, hi, 0, 0)
+            ),
+            pl.BlockSpec((1, block_k, 1, d), kv_map),
+            pl.BlockSpec((1, block_k, 1, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, rows, d), lambda bi, hi, ki, idx_ref: (bi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _dense_kernel, scale=scale, s=s, block_k=block_k,
+            window=window, num_kv=num_kv,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        interpret=interpret,
+    )(index.astype(jnp.int32), qf, cache_k, cache_v)
+    out = out.reshape(b, hkv, g, s, d).reshape(b, h, s, d)
+    return out.transpose(0, 2, 1, 3)
+
+
+def decode_supported(
+    q, cache_k, *, block_k: Optional[int] = None
+) -> bool:
+    """Can the compiled dense decode kernel handle these shapes?"""
+    b, s, h, d = q.shape
+    hkv, dk = cache_k.shape[2], cache_k.shape[3]
+    if d % 128 != 0 or dk != d:
+        return False
+    if h % hkv != 0:
+        return False
+    rows = (h // hkv) * s
+    if rows > 1024:  # VMEM accumulator budget
+        return False
+    max_len = cache_k.shape[1]
+    return _fit_block(max_len, block_k or DEFAULT_BLOCK_K) != 0
+
+
+def decode_attention(
+    q, cache_k, cache_v, index, *,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Attention of q (B, s, H, D) against a dense cache (B, L, Hkv, D).
+
+    index: (B,) int32 — per-sequence pre-write length; q row si sits at
+    position index + si and attends kv positions <= its own (optionally
+    windowed). Dispatches to the Pallas kernel when supported, else the
+    masked reference path (bit-identical semantics).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = not pallas_supported()
+    shapes_ok = decode_supported(q, cache_k, block_k=block_k)
+    if impl == "flash":
+        if not shapes_ok:
+            raise ValueError(
+                f"impl='flash' unsupported for q={q.shape} "
+                f"cache={cache_k.shape}"
+            )
+        use_kernel = True
+    else:
+        # 'auto' only takes the kernel when compiled Pallas is live —
+        # interpret mode exists for tests, not as a dispatch target.
+        use_kernel = impl == "auto" and pallas_supported() and shapes_ok
+    if use_kernel:
+        bk = _fit_block(cache_k.shape[1], block_k)
+        return _dense_flash(
+            q, cache_k, cache_v, index, float(scale), window, bk, interpret
+        )
+    return _decode_ref(q, cache_k, cache_v, index, window, scale)
+
+
+def _decode_ref(q, cache_k, cache_v, index, window, scale):
+    b, s = q.shape[:2]
+    max_len = cache_k.shape[1]
+    cdt = q.dtype
+    q_positions = index[:, None] + jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32), (b, s)
+    )
+    kv_positions = jnp.broadcast_to(
+        jnp.arange(max_len, dtype=jnp.int32), (b, max_len)
+    )
+    kv_mask = kv_positions < (index[:, None] + s)
+    return attention_ref(
+        q, cache_k.astype(cdt), cache_v.astype(cdt),
+        causal=True, window=window, scale=scale,
+        q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
+    )
+
+
+# ---------------------------------------------------------------------------
+# paged cache
+# ---------------------------------------------------------------------------
+
+
+def _paged_kernel(
+    len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale, s, block_k, window, num_kv,
+):
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    idx = len_ref[b]
+    first_ki, last_ki = _live_range(idx, s, block_k, window, num_kv)
+    _decode_tile(
+        idx, q_ref.at[0, 0], k_ref.at[0, :, 0], v_ref.at[0, :, 0],
+        o_ref.at[0, 0], acc_ref, m_ref, l_ref,
+        scale=scale, s=s, block_k=block_k, window=window,
+        k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
+    )
+
+
+def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    bs = pool_k.shape[1]
+    hkv = pool_k.shape[2]
+    g = h // hkv
+    rows = g * s
+    num_kv = tables.shape[1]  # logical blocks per slot
+
+    qf = q.transpose(0, 2, 1, 3).reshape(b, hkv, rows, d)
+
+    def kv_map(bi, hi, ki, len_ref, tab_ref):
+        first_ki, last_ki = _live_range(len_ref[bi], s, bs, window, num_kv)
+        ki = jnp.clip(ki, first_ki, last_ki)
+        # Indirect through the block table: logical block ki of slot bi
+        # lives at pool block tables[bi, ki]. Unallocated entries point
+        # at scratch block 0 and are never live.
+        return tab_ref[bi, ki], 0, hi, 0
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, num_kv),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, rows, d), lambda bi, hi, ki, lr, tr: (bi, hi, 0, 0)
+            ),
+            pl.BlockSpec((1, bs, 1, d), kv_map),
+            pl.BlockSpec((1, bs, 1, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, rows, d), lambda bi, hi, ki, lr, tr: (bi, hi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_kernel, scale=scale, s=s, block_k=bs,
+            window=window, num_kv=num_kv,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        interpret=interpret,
+    )(index.astype(jnp.int32), tables.astype(jnp.int32), qf, pool_k, pool_v)
+    out = out.reshape(b, hkv, g, s, d).reshape(b, h, s, d)
+    return out.transpose(0, 2, 1, 3)
+
+
+def paged_decode_supported(q, pool_k) -> bool:
+    b, s, h, d = q.shape
+    bs, hkv, dk = pool_k.shape[1], pool_k.shape[2], pool_k.shape[3]
+    if d % 128 != 0 or dk != d:
+        return False
+    if h % hkv != 0 or bs % 8 != 0:
+        return False
+    return (h // hkv) * s <= 1024
+
+
+def paged_decode_attention(
+    q, pool_k, pool_v, tables, index, *,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+    interpret: Optional[bool] = None,
+):
+    """Attention of q (B, s, H, D) against a paged pool via block tables.
+
+    pool_k/v: (n_blocks, bs, Hkv, D); tables: (B, max_blocks) int32;
+    index: (B,) pre-write lengths. The kernel walks each slot's table —
+    the dense per-slot view is never materialized. Falls back to
+    gather + masked reference attention when unsupported.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = not pallas_supported()
+    shapes_ok = paged_decode_supported(q, pool_k)
+    if impl == "flash":
+        if not shapes_ok:
+            raise ValueError(
+                f"impl='flash' unsupported for q={q.shape} "
+                f"pool={pool_k.shape}"
+            )
+        use_kernel = True
+    else:
+        use_kernel = impl == "auto" and pallas_supported() and shapes_ok
+    if use_kernel:
+        return _paged_flash(
+            q, pool_k, pool_v, tables, index, float(scale), window, interpret
+        )
+    from shellac_tpu.inference.kvcache import paged_gather_layer
+
+    b, s = q.shape[:2]
+    cdt = q.dtype
+    k_all, v_all = paged_gather_layer(pool_k, pool_v, tables)
+    view = k_all.shape[1]
+    q_positions = index[:, None] + jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32), (b, s)
+    )
+    kv_positions = jnp.broadcast_to(
+        jnp.arange(view, dtype=jnp.int32), (b, view)
+    )
+    kv_mask = kv_positions < (index[:, None] + s)
+    return attention_ref(
+        q, k_all.astype(cdt), v_all.astype(cdt),
+        causal=True, window=window, scale=scale,
+        q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
+    )
