@@ -206,6 +206,14 @@ def _remat_policy(name: str):
     return policies[name]
 
 
+def _zero_aux():
+    """Zero-valued MoE aux dict; the single source of its tree structure
+    (the pipeline's aux accumulation requires every producer to match)."""
+    zero = jnp.zeros((), jnp.float32)
+    return {"aux": zero, "balance_loss": zero, "router_z_loss": zero,
+            "dropped_frac": zero}
+
+
 def _block(
     cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None,
     fresh_cache: bool = False, segments=None, page_tables=None,
@@ -280,28 +288,22 @@ def _block(
             attn_impl == "auto" and sp_active and cfg.attn_window is not None
             and ulysses_ok
         )
-        if segments is not None and (use_ring or use_ulysses):
-            raise NotImplementedError(
-                "packed sequences (segment_ids) are not supported with "
-                "ring/ulysses sequence parallelism; use sp=1"
-            )
-        if not cfg.causal and (use_ring or use_ulysses):
-            raise NotImplementedError(
-                "bidirectional attention is not supported with "
-                "ring/ulysses sequence parallelism; use sp=1"
-            )
         if use_ring:
             # Sequence is sharded over sp: ring attention keeps kv local
             # (O(S/sp) memory) and rotates chunks over ICI instead of
-            # letting GSPMD all-gather the whole sequence.
+            # letting GSPMD all-gather the whole sequence. Packed
+            # segment ids rotate with their kv chunks.
             from shellac_tpu.parallel.ring_attention import ring_attention
 
-            o = ring_attention(q, k, v, mesh, causal=True)
+            o = ring_attention(
+                q, k, v, mesh, causal=cfg.causal, segments=segments
+            )
         elif use_ulysses:
             from shellac_tpu.parallel.ulysses import ulysses_attention
 
             o = ulysses_attention(
-                q, k, v, mesh, causal=True, window=cfg.attn_window
+                q, k, v, mesh, causal=cfg.causal, window=cfg.attn_window,
+                segments=segments,
             )
         else:
             o = attention(
@@ -357,9 +359,7 @@ def _block(
 
     # --- mlp ---
     hx = rms_norm(x, lp["mlp_norm"], cfg.norm_eps).astype(cdt)
-    zero = jnp.zeros((), jnp.float32)
-    moe_out = {"aux": zero, "balance_loss": zero, "router_z_loss": zero,
-               "dropped_frac": zero}
+    moe_out = _zero_aux()
     if cfg.moe is not None:
         from shellac_tpu.ops.moe import moe_ffn
 
@@ -473,32 +473,38 @@ def forward(
             lambda p: p.reshape(pp, lps, *p.shape[1:]), params["layers"]
         )
 
-        if cfg.moe is not None:
-            raise NotImplementedError(
-                "MoE aux-loss plumbing through the pipeline is not wired; "
-                "use pp=1 with MoE"
-            )
+        aux0 = _zero_aux()
 
         def stage_fn(sp_lp, x):
-            def body(x, lp):
-                x, _, _ = block(x, lp, cos, sin)
-                return x, None
+            def body(carry, lp):
+                x, acc = carry
+                x, _, moe_out = block(x, lp, cos, sin)
+                acc = jax.tree.map(lambda a, b: a + b, acc, moe_out)
+                return (x, acc), None
 
-            x, _ = jax.lax.scan(body, x, sp_lp)
-            return x
+            (x, acc), _ = jax.lax.scan(body, (x, aux0), sp_lp)
+            return x, acc
 
         n_micro = pipeline_microbatches or pp
-        x = pipeline_apply(
+        x, aux_sum = pipeline_apply(
             stage_fn, stage_params, x,
-            n_stages=pp, n_micro=n_micro, mesh=mesh,
+            n_stages=pp, n_micro=n_micro, mesh=mesh, aux_init=aux0,
         )
-        zero = jnp.zeros((), jnp.float32)
-        aux = {"aux": zero, "balance_loss": zero, "router_z_loss": zero,
-               "dropped_frac": zero}
+        # aux_sum holds every (layer, microbatch) contribution once.
+        # The aux loss is the per-microbatch estimate averaged over
+        # microbatches (each micro's balance loss is computed on its own
+        # token population — the standard grad-accum estimator);
+        # diagnostics additionally average over layers.
+        inv_m = 1.0 / n_micro
+        inv_lm = inv_m / cfg.n_layers
+        aux = {
+            "aux": aux_sum["aux"] * inv_m,
+            "balance_loss": aux_sum["balance_loss"] * inv_lm,
+            "router_z_loss": aux_sum["router_z_loss"] * inv_lm,
+            "dropped_frac": aux_sum["dropped_frac"] * inv_lm,
+        }
     else:
-        zero = jnp.zeros((), jnp.float32)
-        aux0 = {"aux": zero, "balance_loss": zero, "router_z_loss": zero,
-                "dropped_frac": zero}
+        aux0 = _zero_aux()
 
         def scan_body(carry, lp):
             x, acc = carry

@@ -33,20 +33,23 @@ NEG_INF = -2.0e38
 def _block_stats(q, k, v, scale, mask):
     """Unnormalized block attention: returns (acc, m, l).
 
-    q (B,Sq,Hkv,G,D); k,v (B,Sk,Hkv,D); mask (Sq,Sk) or None, True=attend.
-    acc (B,Sq,Hkv,G,D) fp32; m,l (B,Sq,Hkv,G,1) fp32.
+    q (B,Sq,Hkv,G,D); k,v (B,Sk,Hkv,D); mask (Sq,Sk) or (B,Sq,Sk) or
+    None, True=attend. acc (B,Sq,Hkv,G,D) fp32; m,l (B,Sq,Hkv,G,1) fp32.
     """
     s = jnp.einsum(
         "bqhgd,bkhd->bhgqk", q, k, preferred_element_type=jnp.float32
     ) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None, None], s, NEG_INF)
+        if mask.ndim == 2:
+            mask = mask[None]
+        mask = mask[:, None, None]  # (B,1,1,Sq,Sk)
+        s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)  # (B,Hkv,G,Sq,1)
     # Guard all-masked blocks: exp(NEG_INF - NEG_INF) would be exp(0)=1.
     m_safe = jnp.maximum(m, -1e37)
     p = jnp.exp(s - m_safe)
     if mask is not None:
-        p = jnp.where(mask[None, None, None], p, 0.0)
+        p = jnp.where(mask, p, 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
     acc = jnp.einsum(
         "bhgqk,bkhd->bhgqd", p.astype(v.dtype), v,
@@ -57,8 +60,13 @@ def _block_stats(q, k, v, scale, mask):
     return acc.transpose(perm), m_safe.transpose(perm), l.transpose(perm)
 
 
-def _ring_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
-    """Runs on one device inside shard_map. q (B,S_loc,H,D); k,v (B,S_loc,Hkv,D)."""
+def _ring_local(
+    q, k, v, seg, *, axis_name: str, causal: bool, scale: float,
+    has_segments: bool,
+):
+    """Runs on one device inside shard_map. q (B,S_loc,H,D); k,v
+    (B,S_loc,Hkv,D); seg (B,S_loc) int32 (packed document ids; a dummy
+    when has_segments=False — shard_map needs a uniform signature)."""
     b, s_loc, h, d = q.shape
     hkv = k.shape[2]
     g = h // hkv
@@ -75,7 +83,7 @@ def _ring_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
 
     def step(carry, i):
         acc, m, l, kv = carry
-        k_cur, v_cur = kv
+        k_cur, v_cur, seg_cur = kv
         src = (my - i) % n  # which chunk of the sequence we hold now
         if causal:
             # src < my: fully visible. src == my: triangular. src > my: hidden.
@@ -86,6 +94,17 @@ def _ring_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
             )
         else:
             block_mask = None
+        if has_segments:
+            # Packed documents: attend only within the same segment. The
+            # segment ids rotate with their kv chunk, so the pairing is
+            # always (my q chunk) x (visiting kv chunk) — global-order
+            # causality plus segment equality is exactly within-document
+            # causal attention for contiguous packing.
+            seg_mask = seg[:, :, None] == seg_cur[:, None, :]  # (B,Sq,Sk)
+            block_mask = (
+                seg_mask if block_mask is None
+                else block_mask[None] & seg_mask
+            )
         acc_c, m_c, l_c = _block_stats(qg, k_cur, v_cur, scale, block_mask)
         m_new = jnp.maximum(m, m_c)
         a1 = jnp.exp(m - m_new)
@@ -94,14 +113,14 @@ def _ring_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
         l = l * a1 + l_c * a2
         # Rotate kv to the next rank; the last iteration's rotate returns
         # chunks home (kept for a uniform loop; XLA overlaps it).
-        kv = jax.lax.ppermute((k_cur, v_cur), axis_name, perm)
+        kv = jax.lax.ppermute((k_cur, v_cur, seg_cur), axis_name, perm)
         return (acc, m_new, l, kv), None
 
     acc0 = jnp.zeros((b, s_loc, hkv, g, d), jnp.float32)
     m0 = jnp.full((b, s_loc, hkv, g, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, s_loc, hkv, g, 1), jnp.float32)
     (acc, m, l, _), _ = jax.lax.scan(
-        step, (acc0, m0, l0, (k, v)), jnp.arange(n)
+        step, (acc0, m0, l0, (k, v, seg)), jnp.arange(n)
     )
     l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l).reshape(b, s_loc, h, d)
@@ -116,24 +135,32 @@ def ring_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
+    segments: Optional[jax.Array] = None,  # (B, S) packed document ids
     axis_name: str = AXIS_SEQ,
 ) -> jax.Array:
     """Sequence-parallel attention. q (B,S,H,D); k,v (B,S,Hkv,D).
 
     S is globally sharded over `axis_name`; batch over dp/fsdp; heads
-    over tp. Returns (B,S,H,D) with the same sharding as q.
+    over tp. Returns (B,S,H,D) with the same sharding as q. With
+    `segments`, attention is block-diagonal over packed documents (the
+    ids rotate around the ring with their kv chunk).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     q_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
     kv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
+    seg_spec = P((AXIS_DATA, AXIS_FSDP), axis_name)
+    has_segments = segments is not None
+    if not has_segments:
+        segments = jnp.zeros(q.shape[:2], jnp.int32)
     fn = shard_map(
         functools.partial(
-            _ring_local, axis_name=axis_name, causal=causal, scale=float(scale)
+            _ring_local, axis_name=axis_name, causal=causal,
+            scale=float(scale), has_segments=has_segments,
         ),
         mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec),
+        in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
         out_specs=q_spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, segments)

@@ -59,12 +59,14 @@ def ulysses_supported(
 
 
 def _ulysses_local(
-    q, k, v, *, axis_name: str, causal: bool, window: Optional[int],
-    scale: float, impl: str,
+    q, k, v, seg, *, axis_name: str, causal: bool, window: Optional[int],
+    scale: float, impl: str, has_segments: bool,
 ):
     """Runs on one device inside shard_map.
 
-    q: (B, S_loc, H_loc, D); k, v: (B, S_loc, Hkv_loc, D) — local shapes.
+    q: (B, S_loc, H_loc, D); k, v: (B, S_loc, Hkv_loc, D) — local
+    shapes. seg: (B, S_loc) packed document ids (dummy when
+    has_segments=False).
     """
     from shellac_tpu.ops.attention import attention
 
@@ -94,7 +96,19 @@ def _ulysses_local(
     )
     qh, kh, vh = a2a(q), a2a(k), a2a(v)
 
-    o = attention(qh, kh, vh, causal=causal, window=window, scale=scale, impl=impl)
+    seg_full = None
+    if has_segments:
+        # After the a2a every rank holds the FULL sequence for its
+        # heads, so the block-diagonal mask needs the full segment row:
+        # an all_gather of int32 ids, trivial next to the kv a2a.
+        seg_full = jax.lax.all_gather(
+            seg, axis_name, axis=1, tiled=True
+        )  # (B, S)
+
+    o = attention(
+        qh, kh, vh, causal=causal, window=window, scale=scale, impl=impl,
+        q_segments=seg_full, kv_segments=seg_full,
+    )
 
     # head-sharded -> seq-sharded
     return jax.lax.all_to_all(
@@ -111,6 +125,7 @@ def ulysses_attention(
     causal: bool = True,
     window: Optional[int] = None,
     scale: Optional[float] = None,
+    segments: Optional[jax.Array] = None,  # (B, S) packed document ids
     axis_name: str = AXIS_SEQ,
     impl: str = "auto",
 ) -> jax.Array:
@@ -119,19 +134,25 @@ def ulysses_attention(
     S is globally sharded over `axis_name`; batch over dp/fsdp; heads over
     tp. Returns (B,S,H,D) with the same sharding as q. `impl` is forwarded
     to the local attention dispatch ("auto" uses the flash kernel on TPU).
+    With `segments`, attention is block-diagonal over packed documents.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     q_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
     kv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
+    seg_spec = P((AXIS_DATA, AXIS_FSDP), axis_name)
+    has_segments = segments is not None
+    if not has_segments:
+        segments = jnp.zeros(q.shape[:2], jnp.int32)
     fn = shard_map(
         functools.partial(
             _ulysses_local, axis_name=axis_name, causal=causal,
             window=window, scale=float(scale), impl=impl,
+            has_segments=has_segments,
         ),
         mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec),
+        in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
         out_specs=q_spec,
         check_vma=False,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, segments)

@@ -56,15 +56,25 @@ class TestPackedForward:
         np.testing.assert_allclose(out[0, :6], alone1[0], atol=1e-5)
         np.testing.assert_allclose(out[0, 6:], alone2[0], atol=1e-5)
 
-    def test_sp_mesh_rejects_segments(self, mesh8):
+    def test_packed_segments_on_sp_mesh_match_dense(self, mesh8):
+        """Packed rows forwarded under sp (ring path) == unsharded forward."""
         cfg = _tiny()
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-        toks = jnp.zeros((4, 16), jnp.int32)
-        segs = jnp.ones((4, 16), jnp.int32)
-        with pytest.raises(NotImplementedError, match="segment"):
-            transformer.forward(
-                cfg, params, toks, segment_ids=segs, mesh=mesh8
+        toks = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab_size
+        )
+        segs = jnp.asarray(
+            np.repeat(np.array([[1, 1, 2, 2]] * 4), 4, axis=1), jnp.int32
+        )
+        dense = transformer.forward(cfg, params, toks, segment_ids=segs)
+        sharded = jax.jit(
+            lambda p, t, s: transformer.forward(
+                cfg, p, t, segment_ids=s, mesh=mesh8
             )
+        )(params, toks, segs)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(sharded), rtol=2e-4, atol=2e-4
+        )
 
 
 class TestPackDocuments:
