@@ -42,22 +42,43 @@ def pipeline_apply(
     n_stages: int,
     n_micro: int,
     mesh: Mesh,
-) -> jax.Array:
+    aux_init=None,  # pytree of scalar zeros; stage_fn then returns (y, aux)
+):
+    """Run the stage pipeline; returns outputs, or (outputs, aux_sum).
+
+    With `aux_init`, stage_fn must return (y, aux) where aux matches
+    aux_init's structure (fp32 scalars). Contributions from bubble
+    ticks — stages holding no live microbatch during warmup/drain —
+    are masked out; the result sums every (stage, microbatch) pair's
+    aux exactly once.
+    """
     b, s, d = x.shape
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
     bm = b // n_micro
 
     micro = constrain(x.reshape(n_micro, bm, s, d), mesh, _MICRO_AXES)
+    stage_ids = jnp.arange(n_stages)
 
     def tick(carry, t):
-        stages_x, outputs = carry
+        stages_x, outputs, aux_acc = carry
         inp0 = jax.lax.dynamic_index_in_dim(
             micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
         )
         shifted = jnp.roll(stages_x, 1, axis=0).at[0].set(inp0)
         shifted = constrain(shifted, mesh, _STAGE_AXES)
-        y = jax.vmap(stage_fn)(stage_params, shifted)
+        if aux_init is None:
+            y = jax.vmap(stage_fn)(stage_params, shifted)
+        else:
+            y, aux = jax.vmap(stage_fn)(stage_params, shifted)  # aux: (pp,)
+            # Stage s processes microbatch t - s; outside [0, n_micro)
+            # it is chewing on bubble zeros and its aux is garbage.
+            m = t - stage_ids
+            live = (m >= 0) & (m < n_micro)
+            aux_acc = jax.tree.map(
+                lambda acc, v: acc + jnp.sum(jnp.where(live, v, 0.0)),
+                aux_acc, aux,
+            )
         y = constrain(y, mesh, _STAGE_AXES)
 
         out_idx = t - (n_stages - 1)
@@ -65,12 +86,16 @@ def pipeline_apply(
         prev = jax.lax.dynamic_index_in_dim(outputs, safe, 0, keepdims=False)
         val = jnp.where(out_idx >= 0, y[-1], prev)
         outputs = jax.lax.dynamic_update_index_in_dim(outputs, val, safe, 0)
-        return (y, outputs), None
+        return (y, outputs, aux_acc), None
 
     stages0 = constrain(
         jnp.zeros((n_stages, bm, s, d), x.dtype), mesh, _STAGE_AXES
     )
     out0 = constrain(jnp.zeros((n_micro, bm, s, d), x.dtype), mesh, _MICRO_AXES)
+    aux0 = jax.tree.map(jnp.asarray, aux_init) if aux_init is not None else 0.0
     ticks = jnp.arange(n_micro + n_stages - 1)
-    (_, outputs), _ = jax.lax.scan(tick, (stages0, out0), ticks)
-    return outputs.reshape(b, s, d)
+    (_, outputs, aux_sum), _ = jax.lax.scan(tick, (stages0, out0, aux0), ticks)
+    outputs = outputs.reshape(b, s, d)
+    if aux_init is None:
+        return outputs
+    return outputs, aux_sum
