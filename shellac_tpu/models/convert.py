@@ -227,14 +227,19 @@ def params_from_state_dict(
 
 
 def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
-    """Inverse of params_from_state_dict (dense Llama-style only).
+    """Inverse of params_from_state_dict (Llama/Mistral/Mixtral-style).
 
     Returns HF-named numpy arrays ("model."-prefixed), so trained or
     LoRA-merged weights can go back into the torch/transformers world
-    (build a LlamaForCausalLM and `load_state_dict`).
+    (build a Llama/Mixtral ForCausalLM and `load_state_dict`). MoE
+    models export to the Mixtral naming (block_sparse_moe); shared
+    experts have no HF counterpart and are refused.
     """
-    if cfg.moe is not None:
-        raise NotImplementedError("to_state_dict supports dense models only")
+    moe = cfg.moe is not None
+    if moe and cfg.moe.num_shared_experts > 0:
+        raise NotImplementedError(
+            "shared experts have no HF (Mixtral) state_dict equivalent"
+        )
 
     def np_(x):
         return np.asarray(x, np.float32)
@@ -246,9 +251,26 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
     layers = params["layers"]
     for i in range(cfg.n_layers):
         base = f"model.layers.{i}."
-        for ours, (theirs, transpose) in {**_ATTN_MAP, **_DENSE_MLP_MAP}.items():
+        for ours, (theirs, transpose) in _ATTN_MAP.items():
             w = np_(layers[ours][i])
             sd[base + theirs] = w.T if transpose else w
+        if cfg.attn_bias:
+            for ours, theirs in _BIAS_MAP.items():
+                sd[base + theirs] = np_(layers[ours][i])
+        if moe:
+            sd[base + "block_sparse_moe.gate.weight"] = np_(
+                layers["w_router"][i]
+            ).T
+            for ours, theirs in _EXPERT_MAP.items():
+                stacked = np_(layers[ours][i])  # (E, in, out)
+                for j in range(cfg.moe.num_experts):
+                    sd[
+                        base + f"block_sparse_moe.experts.{j}.{theirs}.weight"
+                    ] = stacked[j].T
+        else:
+            for ours, (theirs, transpose) in _DENSE_MLP_MAP.items():
+                w = np_(layers[ours][i])
+                sd[base + theirs] = w.T if transpose else w
         sd[base + "input_layernorm.weight"] = np_(layers["attn_norm"][i]) + 1.0
         sd[base + "post_attention_layernorm.weight"] = (
             np_(layers["mlp_norm"][i]) + 1.0

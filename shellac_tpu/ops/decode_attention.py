@@ -388,19 +388,5 @@ def paged_decode_attention(
         )
     from shellac_tpu.inference.kvcache import paged_gather_layer
 
-    b, s = q.shape[:2]
-    cdt = q.dtype
     k_all, v_all = paged_gather_layer(pool_k, pool_v, tables)
-    view = k_all.shape[1]
-    q_positions = index[:, None] + jnp.broadcast_to(
-        jnp.arange(s, dtype=jnp.int32), (b, s)
-    )
-    kv_positions = jnp.broadcast_to(
-        jnp.arange(view, dtype=jnp.int32), (b, view)
-    )
-    kv_mask = kv_positions < (index[:, None] + s)
-    return attention_ref(
-        q, k_all.astype(cdt), v_all.astype(cdt),
-        causal=True, window=window, scale=scale,
-        q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
-    )
+    return _decode_ref(q, k_all, v_all, index, window, scale)

@@ -17,9 +17,11 @@ NEG_INF = -1e30
 def top_k_mask(logits: jax.Array, k: int) -> jax.Array:
     """Mask all but the k largest logits per row.
 
-    k >= vocab degrades to a no-op rather than indexing out of bounds
-    (k is user-supplied via the CLI/engine).
+    k >= vocab degrades to a no-op rather than indexing out of bounds;
+    k < 1 is rejected (k is user-supplied via the CLI/engine).
     """
+    if k < 1:
+        raise ValueError(f"top_k must be >= 1, got {k}")
     k = min(k, logits.shape[-1])
     kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
     return jnp.where(logits < kth, NEG_INF, logits)

@@ -286,3 +286,20 @@ def test_generation_runs_on_converted():
     np.testing.assert_array_equal(
         np.asarray(out.tokens)[0], ref.numpy()[0, 4:]
     )
+
+
+def test_moe_export_roundtrip():
+    """MoE params -> Mixtral state_dict -> torch model -> logits parity."""
+    from shellac_tpu.models.convert import to_state_dict
+
+    model = _tiny_mixtral()
+    cfg, params = from_hf(model)
+    sd = to_state_dict(cfg, params)
+    model2 = _tiny_mixtral()
+    model2.load_state_dict({k: torch.from_numpy(v) for k, v in sd.items()})
+    tokens = torch.randint(0, cfg.vocab_size, (1, 10))
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            model2(tokens).logits.numpy(), model(tokens).logits.numpy(),
+            atol=1e-5,
+        )
