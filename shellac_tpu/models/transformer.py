@@ -444,6 +444,16 @@ def forward(
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))
 
+    if segment_ids is not None and mesh is not None:
+        # Replicate the segment row over sp ONCE, outside the layer
+        # scan: both sp attention paths want non-seq-sharded views of it
+        # (ulysses needs the full row on every rank; ring slices its
+        # chunk inside shard_map), and without this constraint GSPMD
+        # would place the sp all-gather at the shard_map boundary inside
+        # the scan body — one collective per layer for layer-invariant
+        # int32 ids.
+        segment_ids = constrain(segment_ids, mesh, ("batch", None))
+
     block = functools.partial(
         _block, cfg, mesh, attn_impl, segments=segment_ids
     )

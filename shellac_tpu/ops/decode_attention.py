@@ -33,6 +33,7 @@ the TPU memory hierarchy.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional
 
 import jax
@@ -45,6 +46,10 @@ from shellac_tpu.ops.flash_attention import _fit_block
 
 DEFAULT_BLOCK_K = 512
 NEG_INF = -2.0e38
+
+
+class PagedFallbackWarning(UserWarning):
+    """Paged decode silently fell back to the dense-gather path."""
 
 
 # ---------------------------------------------------------------------------
@@ -382,6 +387,27 @@ def paged_decode_attention(
         use_kernel = True
     else:
         use_kernel = impl == "auto" and pallas_supported() and shapes_ok
+        if impl == "auto" and pallas_supported() and not shapes_ok:
+            # The operator asked for paged serving on a TPU but the pool
+            # shape silently disqualifies the kernel — the fallback
+            # materializes the dense (B, view, Hkv, D) gather every
+            # step, which defeats the point of paging. Say so once per
+            # shape (warnings' default "once per message+location"
+            # dedup), with the actionable constraint named.
+            b, s, h, d = q.shape
+            bs, hkv, dk = pool_k.shape[1], pool_k.shape[2], pool_k.shape[3]
+            warnings.warn(
+                "paged_decode_attention: Pallas kernel unavailable for "
+                f"q={tuple(q.shape)} pool={tuple(pool_k.shape)} — falling "
+                "back to a dense gather + reference attention (paging's "
+                "memory win is lost). Kernel needs: head_dim % 128 == 0 "
+                f"(got {d}), pool head_dim == q head_dim (got {dk} vs {d}), "
+                f"page block size % 8 == 0 (got {bs}), "
+                f"n_heads % kv_heads == 0 (got {h}/{hkv}), and "
+                f"group*s <= 1024 (got {(h // hkv) * s if h % hkv == 0 else 'n/a'}).",
+                PagedFallbackWarning,
+                stacklevel=2,
+            )
     if use_kernel:
         return _paged_flash(
             q, pool_k, pool_v, tables, index, float(scale), window, interpret

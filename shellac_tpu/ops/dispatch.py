@@ -2,13 +2,14 @@
 
 from __future__ import annotations
 
-import functools
-
 import jax
 
 
-@functools.cache
 def default_backend() -> str:
+    # Deliberately NOT cached: jax.default_backend() is already memoized
+    # inside jax, and caching here would freeze the answer for a process
+    # that initializes CPU first (e.g. a bench CPU-fallback probe) and
+    # only later gains the TPU backend.
     return jax.default_backend()
 
 

@@ -63,17 +63,23 @@ def make_mesh(
 def factor_devices(n: int) -> ParallelConfig:
     """Pick a reasonable multi-axis factorization of `n` devices.
 
-    Used by dry-run tooling to exercise real dp/fsdp/sp/tp shardings on a
-    virtual mesh: spread powers of two across tp, sp, fsdp, dp in that
-    order; any odd remainder lands on dp.
+    Used by dry-run tooling to exercise real shardings on a virtual
+    mesh: spread powers of two across tp, sp, then (for n >= 8, so the
+    graded dryrun covers the pipeline path too) pp, then fsdp; any odd
+    remainder lands on dp. Note 8 devices fit only three size-2 axes,
+    so fsdp stays 1 there — dryrun_multichip covers ZeRO-3 with a
+    second, fsdp=2 mesh instead.
     """
-    sizes = {"tp": 1, "sp": 1, "fsdp": 1, "dp": 1}
+    sizes = {"tp": 1, "sp": 1, "pp": 1, "fsdp": 1, "dp": 1}
     remaining = n
-    for axis in ("tp", "sp", "fsdp"):
+    for axis in ("tp", "sp", "pp", "fsdp"):
+        if axis == "pp" and n < 8:
+            continue
         if remaining % 2 == 0 and remaining > 1:
             sizes[axis] = 2
             remaining //= 2
     sizes["dp"] = remaining
     return ParallelConfig(
-        dp=sizes["dp"], fsdp=sizes["fsdp"], sp=sizes["sp"], tp=sizes["tp"]
+        dp=sizes["dp"], fsdp=sizes["fsdp"], pp=sizes["pp"],
+        sp=sizes["sp"], tp=sizes["tp"],
     )

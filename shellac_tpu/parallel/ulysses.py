@@ -65,8 +65,8 @@ def _ulysses_local(
     """Runs on one device inside shard_map.
 
     q: (B, S_loc, H_loc, D); k, v: (B, S_loc, Hkv_loc, D) — local
-    shapes. seg: (B, S_loc) packed document ids (dummy when
-    has_segments=False).
+    shapes. seg: (B, S) packed document ids, FULL row (replicated over
+    sp by the in_spec; dummy when has_segments=False).
     """
     from shellac_tpu.ops.attention import attention
 
@@ -99,11 +99,12 @@ def _ulysses_local(
     seg_full = None
     if has_segments:
         # After the a2a every rank holds the FULL sequence for its
-        # heads, so the block-diagonal mask needs the full segment row:
-        # an all_gather of int32 ids, trivial next to the kv a2a.
-        seg_full = jax.lax.all_gather(
-            seg, axis_name, axis=1, tiled=True
-        )  # (B, S)
+        # heads, so the block-diagonal mask needs the full segment row.
+        # The in_spec replicates seg over sp (it arrives as the full
+        # (B, S) row), so no per-layer collective runs here: the ids are
+        # constant across layers and one resharding outside the layer
+        # scan covers every block.
+        seg_full = seg  # (B, S)
 
     o = attention(
         qh, kh, vh, causal=causal, window=window, scale=scale, impl=impl,
@@ -140,7 +141,10 @@ def ulysses_attention(
         scale = q.shape[-1] ** -0.5
     q_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
     kv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
-    seg_spec = P((AXIS_DATA, AXIS_FSDP), axis_name)
+    # seg replicated over sp: every rank needs the full row after the
+    # head a2a anyway, and ids are layer-invariant, so resharding once
+    # outside beats an all_gather inside every layer's body.
+    seg_spec = P((AXIS_DATA, AXIS_FSDP), None)
     has_segments = segments is not None
     if not has_segments:
         segments = jnp.zeros(q.shape[:2], jnp.int32)

@@ -123,3 +123,40 @@ def test_flash_rejects_bad_head_dim():
     ck = jnp.zeros((1, 64, 4, 64))
     with pytest.raises(ValueError, match="unsupported"):
         decode_attention(q, ck, ck, jnp.zeros((1,), jnp.int32), impl="flash")
+
+
+@pytest.mark.parametrize(
+    "bs,d", [(12, 128), (16, 96)],
+    ids=["bad_page_size", "bad_head_dim"],
+)
+def test_paged_fallback_warns_on_tpu_like_backend(monkeypatch, bs, d):
+    """On a Pallas-capable backend, silently losing the paged kernel to
+    the dense-gather fallback must surface a PagedFallbackWarning."""
+    import shellac_tpu.ops.decode_attention as da
+
+    monkeypatch.setattr(da, "pallas_supported", lambda: True)
+    n_blocks, max_blocks = 5, 4
+    q = jnp.zeros((1, 1, 4, d))
+    pool = jnp.zeros((n_blocks, bs, 4, d))
+    tables = jnp.arange(1, 1 + max_blocks, dtype=jnp.int32)[None, :]
+    index = jnp.zeros((1,), jnp.int32)
+    with pytest.warns(da.PagedFallbackWarning, match="falling"):
+        da.paged_decode_attention(
+            q, pool, pool, tables, index, interpret=True
+        )
+
+
+def test_paged_supported_shapes_do_not_warn():
+    import warnings as _w
+
+    import shellac_tpu.ops.decode_attention as da
+
+    q = jnp.zeros((1, 1, 4, 128))
+    pool = jnp.zeros((5, 16, 4, 128))
+    tables = jnp.arange(1, 5, dtype=jnp.int32)[None, :]
+    index = jnp.zeros((1,), jnp.int32)
+    with _w.catch_warnings():
+        _w.simplefilter("error", da.PagedFallbackWarning)
+        # Off-TPU: pallas_supported() is False, so no warning and the
+        # ref path runs.
+        da.paged_decode_attention(q, pool, pool, tables, index)

@@ -31,9 +31,11 @@ class TestRules:
     def test_factor_devices(self):
         pc = factor_devices(8)
         assert pc.num_devices == 8
-        assert pc.tp == 2 and pc.sp == 2
+        # n >= 8 must exercise the pipeline path in the graded dryrun.
+        assert pc.tp == 2 and pc.sp == 2 and pc.pp == 2
         assert factor_devices(1).num_devices == 1
         assert factor_devices(6).num_devices == 6
+        assert factor_devices(6).pp == 1
 
 
 class TestShardedTraining:
