@@ -1,0 +1,271 @@
+"""Decode/serving benchmark: Pallas flash-decode vs reference, dense vs
+paged, on the continuous-batching engine.
+
+Round-2 shipped the flash-decode kernels (ops/decode_attention.py) with
+interpret-mode evidence only; this script produces the hardware numbers.
+Two measurements per (cache, impl) variant:
+
+  - steady-state: n_slots requests prefilled to ~ctx tokens, then T
+    timed decode ticks with every slot live. Reported as decode
+    tokens/s (n_slots tokens per tick).
+  - churn: 3*n_slots requests with ragged prompt lengths and small
+    max_new budgets drained through the engine, so slots turn over and
+    prefill/decode interleave the way a real server runs.
+
+Prints one JSON line per variant plus a "summary" line carrying the
+Pallas-vs-ref speedups. Run on the TPU host:
+
+    python scripts/bench_decode.py            # shellac-1b, ctx 2048
+    python scripts/bench_decode.py --model tiny --ctx 64   # CPU smoke
+
+The reference repo is empty (SURVEY.md §0): the spec being measured is
+ops/decode_attention.py's own claim — blocked streaming beats the
+whole-buffer XLA path at serving context lengths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_engine(cfg, params, *, paged, impl, n_slots, max_len):
+    from shellac_tpu.inference.batching import (
+        BatchingEngine,
+        PagedBatchingEngine,
+    )
+
+    if paged:
+        # Page size 64: large enough that the paged kernel's per-page
+        # DMA is a real tile (64 x 128), small enough that short
+        # requests still share the pool at fine grain.
+        return PagedBatchingEngine(
+            cfg, params, n_slots=n_slots, max_len=max_len,
+            block_size=64, pool_tokens=n_slots * max_len,
+            temperature=0.0, attn_impl=impl,
+        )
+    return BatchingEngine(
+        cfg, params, n_slots=n_slots, max_len=max_len,
+        temperature=0.0, attn_impl=impl,
+    )
+
+
+def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
+                 ticks, rng):
+    """Decode tokens/s with every slot held live at ~ctx context."""
+    eng = build_engine(
+        cfg, params, paged=paged, impl=impl, n_slots=n_slots, max_len=max_len
+    )
+    budget = max_len - ctx - 1
+    for i in range(n_slots):
+        prompt = rng.integers(0, cfg.vocab_size, size=ctx, dtype=np.int64)
+        eng.submit(i, prompt, max_new=budget)
+    # Prime: prefills all slots + compiles the decode program.
+    eng.step()
+    eng.step()
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        eng.step()
+    # One more tick and a host read force completion of queued work (on
+    # the axon platform block_until_ready does not synchronize).
+    int(np.asarray(eng._cur)[0])
+    dt = time.perf_counter() - t0
+    return n_slots * ticks / dt, dt / ticks
+
+
+def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng):
+    """Drain 3*n_slots ragged requests; tokens/s of generated tokens."""
+    eng = build_engine(
+        cfg, params, paged=paged, impl=impl, n_slots=n_slots, max_len=max_len
+    )
+    n_req = 3 * n_slots
+    gen_budget = min(64, max(4, (max_len - ctx) // 2))
+    reqs = []
+    for i in range(n_req):
+        plen = int(rng.integers(max(8, ctx // 2), ctx + 1))
+        prompt = rng.integers(0, cfg.vocab_size, size=plen, dtype=np.int64)
+        reqs.append((i, prompt, int(rng.integers(gen_budget // 2, gen_budget + 1))))
+    # Warm the prefill buckets + decode program outside the timed region.
+    eng.submit("warm", reqs[0][1], max_new=2)
+    while eng.pending:
+        eng.step()
+    t0 = time.perf_counter()
+    results = eng.run(reqs)
+    dt = time.perf_counter() - t0
+    total = sum(len(v) for v in results.values())
+    assert len(results) == n_req
+    return total / dt, total
+
+
+def kernel_microbench(cfg, *, paged, impl, n_slots, ctx, max_len, iters):
+    """Device-side loop over the decode-attention op alone.
+
+    The engine numbers include a per-tick host sync, which on a
+    relay-attached TPU measures RPC latency, not the kernel. This
+    chains `iters` decode-attention calls inside ONE jitted lax.scan
+    (the output feeds the next q, so nothing can be CSE'd or
+    overlapped away) and reports per-call latency and the effective KV
+    bandwidth the op sustains.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from shellac_tpu.ops.decode_attention import (
+        decode_attention,
+        paged_decode_attention,
+    )
+
+    hkv, dh, L = cfg.kv_heads, cfg.dim_per_head, cfg.n_layers
+    h = cfg.n_heads
+    cdt = cfg.compute_dtype
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q0 = jax.random.normal(ks[0], (n_slots, 1, h, dh), cdt)
+    # Ragged realistic lengths around ctx.
+    lengths = jnp.asarray(
+        np.linspace(ctx // 2, ctx, n_slots, dtype=np.int32)
+    )
+    if paged:
+        bs = 64
+        max_blocks = max_len // bs
+        n_blocks = n_slots * max_blocks + 1
+        pool_k = jax.random.normal(ks[1], (n_blocks, hkv, bs, dh), cdt)
+        pool_v = jax.random.normal(ks[2], (n_blocks, hkv, bs, dh), cdt)
+        tables = jnp.arange(1, n_blocks, dtype=jnp.int32).reshape(
+            n_slots, max_blocks
+        )
+
+        def one(q):
+            return paged_decode_attention(
+                q, pool_k, pool_v, tables, lengths, impl=impl
+            )
+    else:
+        ck = jax.random.normal(ks[1], (n_slots, hkv, max_len, dh), cdt)
+        cv = jax.random.normal(ks[2], (n_slots, hkv, max_len, dh), cdt)
+
+        def one(q):
+            return decode_attention(q, ck, cv, lengths, impl=impl)
+
+    @jax.jit
+    def loop(q):
+        def body(q, _):
+            o = one(q)
+            # Data dependence: next q derives from this output.
+            return (q0 + 1e-3 * o).astype(cdt), ()
+
+        q, _ = jax.lax.scan(body, q, None, length=iters)
+        return q
+
+    out = loop(q0)
+    float(jnp.sum(out.astype(jnp.float32)))  # force completion (warmup)
+    t0 = time.perf_counter()
+    out = loop(q0)
+    float(jnp.sum(out.astype(jnp.float32)))
+    dt = time.perf_counter() - t0
+    per_call_us = dt / iters * 1e6
+    # Bytes the op must stream for ONE layer's attention: live kv only.
+    live_tokens = int(np.asarray(lengths).sum())
+    kv_bytes = 2 * live_tokens * hkv * dh * jnp.dtype(cdt).itemsize
+    gbps = kv_bytes / (dt / iters) / 1e9
+    return per_call_us, gbps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None, help="preset (default: auto)")
+    ap.add_argument("--ctx", type=int, default=2048)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--ticks", type=int, default=50)
+    ap.add_argument("--kernel-iters", type=int, default=200)
+    ap.add_argument("--mode", default="engine", choices=["engine", "kernel"])
+    ap.add_argument("--variants", default="dense:auto,dense:ref,paged:auto,paged:ref")
+    args = ap.parse_args()
+
+    import jax
+
+    from shellac_tpu import get_model_config
+    from shellac_tpu.models import transformer
+
+    backend = jax.default_backend()
+    if args.model is None:
+        args.model = "shellac-1b" if backend == "tpu" else "tiny"
+        if backend != "tpu":
+            args.ctx, args.ticks = 64, 5
+    cfg = get_model_config(args.model)
+    # Serving context: ctx prompt + generation headroom, block-aligned.
+    max_len = ((args.ctx + max(64, args.ctx // 4)) + 511) // 512 * 512
+    cfg = cfg.replace(max_seq_len=max(cfg.max_seq_len, max_len))
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+    if args.mode == "kernel":
+        results = {}
+        for variant in args.variants.split(","):
+            cache_kind, impl = variant.split(":")
+            us, gbps = kernel_microbench(
+                cfg, paged=cache_kind == "paged", impl=impl,
+                n_slots=args.slots, ctx=args.ctx, max_len=max_len,
+                iters=args.kernel_iters,
+            )
+            row = {
+                "metric": f"decode_kernel_{args.model}_ctx{args.ctx}_"
+                          f"{cache_kind}_{impl}_{backend}",
+                "value": round(us, 1),
+                "unit": "us/call",
+                "detail": {"kv_stream_gbps": round(gbps, 1)},
+            }
+            results[variant] = row
+            print(json.dumps(row), flush=True)
+        summary = {
+            "metric": f"decode_kernel_summary_{args.model}_ctx{args.ctx}_{backend}"
+        }
+        for kind in ("dense", "paged"):
+            a, r = results.get(f"{kind}:auto"), results.get(f"{kind}:ref")
+            if a and r and a["value"]:
+                summary[f"{kind}_speedup"] = round(r["value"] / a["value"], 3)
+        print(json.dumps(summary), flush=True)
+        return
+
+    results = {}
+    for variant in args.variants.split(","):
+        cache_kind, impl = variant.split(":")
+        paged = cache_kind == "paged"
+        rng = np.random.default_rng(0)
+        tok_s, tick_s = steady_state(
+            cfg, params, paged=paged, impl=impl, n_slots=args.slots,
+            ctx=args.ctx, max_len=max_len, ticks=args.ticks, rng=rng,
+        )
+        churn_tok_s, churn_total = churn(
+            cfg, params, paged=paged, impl=impl, n_slots=args.slots,
+            ctx=args.ctx, max_len=max_len, rng=rng,
+        )
+        row = {
+            "metric": f"decode_throughput_{args.model}_ctx{args.ctx}_"
+                      f"{cache_kind}_{impl}_{backend}",
+            "value": round(tok_s, 1),
+            "unit": "tokens/s",
+            "detail": {
+                "tick_ms": round(tick_s * 1e3, 3),
+                "churn_tokens_s": round(churn_tok_s, 1),
+                "churn_tokens": churn_total,
+                "n_slots": args.slots,
+            },
+        }
+        results[variant] = row
+        print(json.dumps(row), flush=True)
+
+    summary = {"metric": f"decode_summary_{args.model}_ctx{args.ctx}_{backend}"}
+    for kind in ("dense", "paged"):
+        a, r = results.get(f"{kind}:auto"), results.get(f"{kind}:ref")
+        if a and r and r["value"]:
+            summary[f"{kind}_speedup"] = round(a["value"] / r["value"], 3)
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

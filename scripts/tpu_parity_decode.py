@@ -1,0 +1,183 @@
+"""TPU-compiled parity gate for the Pallas kernels.
+
+The pytest suite pins the CPU platform and runs every Pallas kernel in
+interpret mode; a bug that only manifests under compiled Mosaic
+layout/DMA semantics (index-map clamping, scalar prefetch, VMEM
+accumulator tiling) would pass CI and ship. This script runs the SAME
+parity assertions with interpret=False on the real chip:
+
+  - dense decode: GQA, sliding window, ragged lengths (incl. 0 and
+    max_len-s), s=1 and s=4
+  - paged decode: shuffled block table, window, ragged lengths
+  - training flash attention: forward + backward grads vs reference
+
+Exits 0 and prints one JSON line {"ok": true, ...} on success; any
+mismatch raises. Driven by tests/test_tpu_parity.py (subprocess, skipped
+off-TPU) and by the verify skill.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check(name, got, want, atol, checks, rtol=None):
+    got, want = np.asarray(got), np.asarray(want)
+    np.testing.assert_allclose(
+        got, want, atol=atol, rtol=rtol if rtol is not None else atol,
+        err_msg=name,
+    )
+    checks.append(name)
+
+
+def dense_decode_cases(checks):
+    from shellac_tpu.ops.decode_attention import _decode_ref, decode_attention
+
+    B, L, H, HKV, D = 4, 1024, 16, 8, 128
+    for s, window in [(1, None), (1, 200), (4, None), (4, 200)]:
+        ks = jax.random.split(jax.random.PRNGKey(s * 13 + (window or 1)), 3)
+        q = jax.random.normal(ks[0], (B, s, H, D), jnp.bfloat16)
+        ck = jax.random.normal(ks[1], (B, HKV, L, D), jnp.bfloat16)
+        cv = jax.random.normal(ks[2], (B, HKV, L, D), jnp.bfloat16)
+        index = jnp.array([0, 37, 519, L - s], jnp.int32)
+        out = decode_attention(
+            q, ck, cv, index, window=window, impl="flash", interpret=False
+        )
+        ref = _decode_ref(q, ck, cv, index, window, D ** -0.5)
+        check(
+            f"dense s={s} window={window}",
+            out.astype(jnp.float32), ref.astype(jnp.float32),
+            atol=2e-2, checks=checks,
+        )
+
+
+def paged_decode_cases(checks):
+    from shellac_tpu.ops.decode_attention import (
+        _decode_ref,
+        paged_decode_attention,
+    )
+
+    B, L, H, HKV, D, bs = 4, 1024, 16, 8, 128, 64
+    max_blocks = L // bs
+    n_blocks = B * max_blocks + 1
+    for s, window in [(1, None), (1, 200), (2, None)]:
+        ks = jax.random.split(jax.random.PRNGKey(s * 11 + (window or 1)), 3)
+        q = jax.random.normal(ks[0], (B, s, H, D), jnp.bfloat16)
+        dense_k = jax.random.normal(ks[1], (B, L, HKV, D), jnp.bfloat16)
+        dense_v = jax.random.normal(ks[2], (B, L, HKV, D), jnp.bfloat16)
+        index = jnp.array([0, 37, 519, L - s], jnp.int32)
+
+        rng = np.random.default_rng(s)
+        ids = rng.permutation(np.arange(1, n_blocks))
+        tables = ids.reshape(B, max_blocks)
+        pool_k = np.zeros((n_blocks, HKV, bs, D), np.float32)
+        pool_v = np.zeros((n_blocks, HKV, bs, D), np.float32)
+        dk = np.asarray(dense_k, np.float32).transpose(0, 2, 1, 3)
+        dv = np.asarray(dense_v, np.float32).transpose(0, 2, 1, 3)
+        for b in range(B):
+            for j in range(max_blocks):
+                pool_k[tables[b, j]] = dk[b, :, j * bs:(j + 1) * bs]
+                pool_v[tables[b, j]] = dv[b, :, j * bs:(j + 1) * bs]
+
+        out = paged_decode_attention(
+            q, jnp.asarray(pool_k, jnp.bfloat16),
+            jnp.asarray(pool_v, jnp.bfloat16),
+            jnp.asarray(tables, jnp.int32), index,
+            window=window, impl="flash", interpret=False,
+        )
+        ref = _decode_ref(
+            q, dense_k.transpose(0, 2, 1, 3), dense_v.transpose(0, 2, 1, 3),
+            index, window, D ** -0.5,
+        )
+        check(
+            f"paged s={s} window={window} shuffled-table",
+            out.astype(jnp.float32), ref.astype(jnp.float32),
+            atol=2e-2, checks=checks,
+        )
+
+
+def flash_train_cases(checks):
+    from shellac_tpu.ops.attention import attention_ref
+    from shellac_tpu.ops.flash_attention import flash_attention
+
+    B, S, H, HKV, D = 2, 2048, 8, 4, 128
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, HKV, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, HKV, D), jnp.bfloat16)
+    # Ragged packed documents, boundaries off block edges.
+    seg = jnp.asarray(
+        np.concatenate([
+            np.repeat([0, 1, 2], [700, 900, 448])[None],
+            np.repeat([0, 1], [1500, 548])[None],
+        ]), jnp.int32,
+    )
+
+    for label, window, segments in [
+        ("causal GQA", None, None),
+        ("window=600", 600, None),
+        ("packed", None, seg),
+        ("window=600 packed", 600, seg),
+    ]:
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(
+                    q, k, v, causal=True, window=window, segments=segments,
+                    interpret=False,
+                ) ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                attention_ref(
+                    q, k, v, causal=True, window=window,
+                    q_segments=segments, kv_segments=segments,
+                ) ** 2
+            )
+
+        out = flash_attention(
+            q, k, v, causal=True, window=window, segments=segments,
+            interpret=False,
+        )
+        ref = attention_ref(
+            q, k, v, causal=True, window=window,
+            q_segments=segments, kv_segments=segments,
+        )
+        check(
+            f"flash fwd {label}",
+            out.astype(jnp.float32), ref.astype(jnp.float32),
+            atol=2e-2, checks=checks,
+        )
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), gf, gr):
+            scale = max(1.0, float(jnp.max(jnp.abs(b.astype(jnp.float32)))))
+            check(
+                f"flash bwd {label} {name}",
+                a.astype(jnp.float32) / scale, b.astype(jnp.float32) / scale,
+                atol=3e-2, checks=checks,
+            )
+
+
+def main():
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print(json.dumps({"ok": False, "error": f"backend={backend}, need tpu"}))
+        sys.exit(2)
+    checks = []
+    dense_decode_cases(checks)
+    paged_decode_cases(checks)
+    flash_train_cases(checks)
+    print(json.dumps({"ok": True, "backend": backend, "checks": checks}))
+
+
+if __name__ == "__main__":
+    main()

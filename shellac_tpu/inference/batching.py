@@ -74,12 +74,14 @@ class BatchingEngine:
         top_p: Optional[float] = None,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        attn_impl: str = "auto",
     ):
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
         self.max_len = max_len or cfg.max_seq_len
         self.eos_id = eos_id
+        self.attn_impl = attn_impl
         self._sampler = functools.partial(
             sample, temperature=temperature, top_k=top_k, top_p=top_p
         )
@@ -99,7 +101,7 @@ class BatchingEngine:
         mini = init_cache(self.cfg, 1, self.max_len)
         logits, mini = transformer.forward_with_cache(
             self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
-            fresh_cache=True, attn_impl="auto",
+            fresh_cache=True, attn_impl=self.attn_impl,
         )
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
@@ -122,7 +124,7 @@ class BatchingEngine:
         """One decode tick over every slot; inactive slots frozen."""
         old_lengths = cache.lengths
         logits, cache = transformer.forward_with_cache(
-            self.cfg, params, cur[:, None], cache
+            self.cfg, params, cur[:, None], cache, attn_impl=self.attn_impl
         )
         nxt = self._sampler(key, logits[:, 0])
         lengths = jnp.where(active, cache.lengths, old_lengths)
@@ -337,7 +339,7 @@ class PagedBatchingEngine(BatchingEngine):
         mini = init_cache(self.cfg, 1, s)
         logits, mini = transformer.forward_with_cache(
             self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
-            fresh_cache=True, attn_impl="auto",
+            fresh_cache=True, attn_impl=self.attn_impl,
         )
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
@@ -349,11 +351,14 @@ class PagedBatchingEngine(BatchingEngine):
         pos = jnp.arange(s, dtype=jnp.int32)
         blocks = jnp.take(table_row, pos // bs)
         offs = pos % bs
-        k_src = mini.k[:, 0].astype(cache.k.dtype)  # (L, S, Hkv, Dh)
-        v_src = mini.v[:, 0].astype(cache.v.dtype)
+        # mini.k[:, 0] is (L, Hkv, S, Dh); the pool write below indexes
+        # (block, off) at dims 1 and 3 with slices at 0 and 2, so the
+        # value wants token rows leading: (S, L, Hkv, Dh).
+        k_src = mini.k[:, 0].astype(cache.k.dtype).transpose(2, 0, 1, 3)
+        v_src = mini.v[:, 0].astype(cache.v.dtype).transpose(2, 0, 1, 3)
         cache = cache.replace(
-            k=cache.k.at[:, blocks, offs].set(k_src),
-            v=cache.v.at[:, blocks, offs].set(v_src),
+            k=cache.k.at[:, blocks, :, offs].set(k_src),
+            v=cache.v.at[:, blocks, :, offs].set(v_src),
             lengths=jax.lax.dynamic_update_slice(
                 cache.lengths, mini.lengths, (slot,)
             ),

@@ -1,8 +1,15 @@
 """KV cache for autoregressive decoding.
 
-Layout: stacked over layers, (L, B, max_len, Hkv, Dh), matching the
-stacked-layer parameter layout so the decode forward remains a single
-`lax.scan`. The cache lives in compute dtype (bf16): it is read-only
+Layout: stacked over layers and HEAD-MAJOR, (L, B, Hkv, max_len, Dh).
+Stacking over layers matches the stacked-layer parameter layout so the
+decode forward remains a single `lax.scan`. Head-major (head before
+sequence) is a hard requirement of the compiled Pallas decode kernels:
+Mosaic block shapes must keep the last two dims tileable, so the kv
+stream a kernel DMAs has to be a contiguous (seq_block, head_dim) tile
+per head — with seq-major layout the head axis lands second-to-last
+with block size 1, which the TPU lowering rejects (and a relayout copy
+of a multi-GiB cache every tick is exactly what the kernel exists to
+avoid). The cache lives in compute dtype (bf16): it is read-only
 bandwidth, and attention logits accumulate in fp32 regardless.
 
 Ragged batches are handled with per-sequence `lengths`: prompts are
@@ -25,17 +32,17 @@ from shellac_tpu.config import ModelConfig
 
 @flax.struct.dataclass
 class KVCache:
-    k: Any  # (L, B, max_len, Hkv, Dh)
-    v: Any  # (L, B, max_len, Hkv, Dh)
+    k: Any  # (L, B, Hkv, max_len, Dh)
+    v: Any  # (L, B, Hkv, max_len, Dh)
     lengths: Any  # (B,) int32 — valid positions per sequence
 
     @property
     def max_len(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
-    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.dim_per_head)
+    shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.dim_per_head)
     return KVCache(
         k=jnp.zeros(shape, cfg.compute_dtype),
         v=jnp.zeros(shape, cfg.compute_dtype),
@@ -46,25 +53,25 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
 def cache_logical_axes():
     """Logical axes for sharding the cache over a mesh."""
     return KVCache(
-        k=("layers", "batch", None, "kv_heads", None),
-        v=("layers", "batch", None, "kv_heads", None),
+        k=("layers", "batch", "kv_heads", None, None),
+        v=("layers", "batch", "kv_heads", None, None),
         lengths=("batch",),
     )
 
 
 def update_layer(
-    cache_k: jax.Array,  # (B, max_len, Hkv, Dh) — one layer's cache
+    cache_k: jax.Array,  # (B, Hkv, max_len, Dh) — one layer's cache
     cache_v: jax.Array,
     k_new: jax.Array,  # (B, S, Hkv, Dh)
     v_new: jax.Array,
     index: jax.Array,  # (B,) int32 — per-sequence write offset
 ):
     """Write S new positions at per-sequence offsets; returns (k, v)."""
-    k_new = k_new.astype(cache_k.dtype)
-    v_new = v_new.astype(cache_v.dtype)
+    k_new = k_new.astype(cache_k.dtype).transpose(0, 2, 1, 3)  # (B,Hkv,S,Dh)
+    v_new = v_new.astype(cache_v.dtype).transpose(0, 2, 1, 3)
 
     def upd(c, n, i):
-        return jax.lax.dynamic_update_slice(c, n, (i, 0, 0))
+        return jax.lax.dynamic_update_slice(c, n, (0, i, 0))
 
     ck = jax.vmap(upd)(cache_k, k_new, index)
     cv = jax.vmap(upd)(cache_v, v_new, index)
@@ -86,7 +93,8 @@ class PagedKVCache:
     host-side free list (see PagedBatchingEngine); the device side only
     ever sees the tables.
 
-    k, v: (L, n_blocks, block_size, Hkv, Dh)
+    k, v: (L, n_blocks, Hkv, block_size, Dh) — head-major inside each
+        block, same Pallas tiling requirement as the dense cache.
     tables: (n_slots, max_blocks) int32 — pool block id per logical
         block; unallocated entries MUST point at block 0 (reserved as
         scratch: it is never handed to a slot, so stray writes and reads
@@ -101,7 +109,7 @@ class PagedKVCache:
 
     @property
     def block_size(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
     @property
     def max_blocks(self) -> int:
@@ -115,7 +123,9 @@ def init_paged_cache(
     block_size: int,
     max_blocks_per_slot: int,
 ) -> PagedKVCache:
-    shape = (cfg.n_layers, n_blocks, block_size, cfg.kv_heads, cfg.dim_per_head)
+    shape = (
+        cfg.n_layers, n_blocks, cfg.kv_heads, block_size, cfg.dim_per_head,
+    )
     return PagedKVCache(
         k=jnp.zeros(shape, cfg.compute_dtype),
         v=jnp.zeros(shape, cfg.compute_dtype),
@@ -125,7 +135,7 @@ def init_paged_cache(
 
 
 def paged_update_layer(
-    pool_k: jax.Array,  # (n_blocks, bs, Hkv, Dh) — one layer's pool
+    pool_k: jax.Array,  # (n_blocks, Hkv, bs, Dh) — one layer's pool
     pool_v: jax.Array,
     k_new: jax.Array,  # (B, S, Hkv, Dh)
     v_new: jax.Array,
@@ -135,36 +145,42 @@ def paged_update_layer(
     """Scatter S new positions through the block tables; returns pools.
 
     Positions index[b] + i map to pool coords
-    (tables[b, p // bs], p % bs). Slots must have blocks allocated for
-    every written position (the scheduler guarantees it); writes through
-    unallocated entries land in scratch block 0.
+    (tables[b, p // bs], :, p % bs). Slots must have blocks allocated
+    for every written position (the scheduler guarantees it); writes
+    through unallocated entries land in scratch block 0.
     """
-    bs = pool_k.shape[1]
+    bs = pool_k.shape[2]
     b, s = k_new.shape[:2]
     pos = index[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # (B, S)
     block_ids = jnp.take_along_axis(tables, pos // bs, axis=1)  # (B, S)
     offs = pos % bs
     flat_blocks = block_ids.reshape(-1)
     flat_offs = offs.reshape(-1)
-    pk = pool_k.at[flat_blocks, flat_offs].set(
+    # Advanced indices at dims 0 and 2 (separated by the head slice):
+    # the indexed result is (B*S, Hkv, Dh), matching k_new's token rows.
+    pk = pool_k.at[flat_blocks, :, flat_offs].set(
         k_new.astype(pool_k.dtype).reshape(b * s, *k_new.shape[2:])
     )
-    pv = pool_v.at[flat_blocks, flat_offs].set(
+    pv = pool_v.at[flat_blocks, :, flat_offs].set(
         v_new.astype(pool_v.dtype).reshape(b * s, *v_new.shape[2:])
     )
     return pk, pv
 
 
 def paged_gather_layer(
-    pool_k: jax.Array,  # (n_blocks, bs, Hkv, Dh)
+    pool_k: jax.Array,  # (n_blocks, Hkv, bs, Dh)
     pool_v: jax.Array,
     tables: jax.Array,  # (B, max_blocks)
 ):
-    """Materialize each slot's logical KV view: (B, max_blocks*bs, H, D)."""
+    """Materialize each slot's logical KV view, head-major:
+    (B, Hkv, max_blocks*bs, D) — the same layout as a dense cache layer,
+    so the decode fallback consumes it directly."""
     b, mb = tables.shape
-    bs = pool_k.shape[1]
-    k = jnp.take(pool_k, tables.reshape(-1), axis=0)
-    v = jnp.take(pool_v, tables.reshape(-1), axis=0)
-    k = k.reshape(b, mb * bs, *pool_k.shape[2:])
-    v = v.reshape(b, mb * bs, *pool_v.shape[2:])
-    return k, v
+    hkv, bs, dh = pool_k.shape[1:]
+
+    def gather(pool):
+        x = jnp.take(pool, tables.reshape(-1), axis=0)  # (B*mb, Hkv, bs, Dh)
+        x = x.reshape(b, mb, hkv, bs, dh).transpose(0, 2, 1, 3, 4)
+        return x.reshape(b, hkv, mb * bs, dh)
+
+    return gather(pool_k), gather(pool_v)

@@ -127,18 +127,32 @@ def attention(
     from shellac_tpu.ops.flash_attention import flash_attention, flash_supported
 
     if impl == "flash":
-        if window is not None or q_positions is not None or kv_positions is not None \
-                or kv_mask is not None or q_segments is not None:
+        if q_positions is not None or kv_positions is not None \
+                or kv_mask is not None:
             raise ValueError(
-                "impl='flash' does not support window/q_positions/kv_positions/"
+                "impl='flash' does not support q_positions/kv_positions/"
                 "kv_mask; use impl='auto' or 'ref'"
             )
-        return flash_attention(q, k, v, causal=causal, scale=scale)
-    if impl == "auto" and q_segments is None and flash_supported(
+        if (q_segments is None) != (kv_segments is None) or (
+            q_segments is not None and q_segments is not kv_segments
+        ):
+            raise ValueError(
+                "impl='flash' needs q_segments and kv_segments to be the "
+                "same packed-segment array"
+            )
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale, window=window,
+            segments=q_segments,
+        )
+    if impl == "auto" and flash_supported(
         q, k, v, window=window, q_positions=q_positions,
         kv_positions=kv_positions, kv_mask=kv_mask, causal=causal,
+        q_segments=q_segments, kv_segments=kv_segments,
     ):
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale, window=window,
+            segments=q_segments,
+        )
     return attention_ref(
         q, k, v, causal=causal, window=window, scale=scale,
         q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,

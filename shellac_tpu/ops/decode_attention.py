@@ -1,19 +1,33 @@
 """Flash-decode attention over KV caches as Pallas TPU kernels.
 
-The decode hot loop reads a (B, max_len, Hkv, D) cache (or a paged block
-pool) with a tiny q (B, s, H, D). The XLA ref path computes logits over
-the whole max_len buffer every tick; these kernels instead stream the
-cache in blocks with online softmax and — the actual win — *skip the
-blocks beyond each sequence's own length entirely*: per-sequence lengths
-are scalar-prefetched into SMEM and both the DMA index map and the
-compute are clamped to the live range. A slot at position 130 of a
-4096-token buffer touches one or two KV blocks, not 4096 rows.
+The decode hot loop reads a head-major (B, Hkv, max_len, D) cache (or a
+paged block pool) with a tiny q (B, s, H, D). The XLA ref path computes
+logits over the whole max_len buffer every tick; these kernels instead
+stream the cache in blocks with online softmax and — the actual win —
+*skip the blocks beyond each sequence's own length entirely*:
+per-sequence lengths are scalar-prefetched into SMEM and both the DMA
+index map and the compute are clamped to the live range. A slot at
+position 130 of a 4096-token buffer touches one or two KV blocks, not
+4096 rows.
+
+Two decode-specific grid decisions, both measured on a v5e (see
+BENCH_DECODE.json):
+  - Head-major cache layout is load-bearing: Mosaic requires a block's
+    trailing two dims to be tileable, so the per-head kv stream must be
+    a contiguous (seq_block, head_dim) tile — the kvcache module stores
+    caches this way precisely so these kernels never relayout them.
+  - The grid iterates (batch, kv_blocks) with ALL kv heads processed
+    per step (a static in-kernel loop), not (batch, head, kv_blocks):
+    decode tiles are tiny (G*s rows), so a per-head grid drowns in
+    per-step DMA/pipeline overhead — the first cut of this kernel ran
+    2x SLOWER than the XLA ref exactly this way. Batching heads per
+    step makes each DMA hkv times larger and cuts grid steps hkv-fold.
 
 Two entry points:
-  - `decode_attention`: dense cache (B, L, Hkv, D). Grid (B, Hkv,
-    kv_blocks); GQA q rows for one kv head are flattened into a single
-    (G*s, D) tile so kv is loaded once per group, never replicated.
-  - `paged_decode_attention`: block-pool cache (n_blocks, bs, Hkv, D)
+  - `decode_attention`: dense cache (B, Hkv, L, D). GQA q rows are
+    flattened to (H*s, D), kv-head-major, so each head's group shares
+    one kv tile and kv is never replicated in HBM.
+  - `paged_decode_attention`: block-pool cache (n_blocks, Hkv, bs, D)
     with per-slot tables. Same kernel body; the kv DMA indirects
     through the scalar-prefetched block table, so the dense (B,
     view, H, D) gather the ref path materializes never exists.
@@ -59,15 +73,20 @@ class PagedFallbackWarning(UserWarning):
 
 def _decode_tile(
     idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale, s, block_k, window, k_start, ki, last_ki, first_ki,
+    *, scale, s, hkv, block_k, window, k_start, ki, last_ki, first_ki,
 ):
-    """One (G*s rows) x (block_k kv) online-softmax step.
+    """One online-softmax step over every kv head of one sequence.
 
     idx: scalar — this sequence's pre-write length (q row si sits at
-    position idx + si). k_ref/v_ref hold a (block_k, D) kv tile whose
-    first row is global position k_start.
+    position idx + si). q_ref/o_ref: (hkv*G*s, D) rows, kv-head-major.
+    k_ref/v_ref: (hkv, block_k, D) kv tile whose first row is global
+    position k_start. acc/m/l scratch span all rows; the per-head work
+    is a static python loop — tiny decode matmuls cannot amortize a
+    per-head grid dimension (see module docstring).
     """
     live = (ki >= first_ki) & (k_start <= idx + s - 1)
+    rows = q_ref.shape[0]
+    rph = rows // hkv  # G*s rows per kv head
 
     @pl.when(ki == 0)
     def _init():
@@ -77,38 +96,42 @@ def _decode_tile(
 
     @pl.when(live)
     def _compute():
-        q = q_ref[...].astype(jnp.float32) * scale
-        k = k_ref[...].astype(jnp.float32)
-        logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (rows, block_k)
-        rows = logits.shape[0]
-        r = jax.lax.broadcasted_iota(jnp.int32, (rows, block_k), 0)
+        r = jax.lax.broadcasted_iota(jnp.int32, (rph, block_k), 0)
         qpos = idx + r % s  # row r is (g, si=r%s) → position idx + si
         kpos = k_start + jax.lax.broadcasted_iota(
-            jnp.int32, (rows, block_k), 1
+            jnp.int32, (rph, block_k), 1
         )
         mask = kpos <= qpos
         if window is not None:
             mask &= qpos - kpos < window
-        logits = jnp.where(mask, logits, NEG_INF)
 
-        m_prev = m_ref[:, :1]
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(logits, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(logits - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[...] = jnp.broadcast_to(
-            alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
-        )
-        v = v_ref[...]
-        pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[...] = acc_ref[...] * alpha + pv
-        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        for kh in range(hkv):
+            sl = pl.dslice(kh * rph, rph)
+            q = q_ref[sl, :].astype(jnp.float32) * scale
+            k = k_ref[kh].astype(jnp.float32)
+            logits = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (rph, block_k)
+            logits = jnp.where(mask, logits, NEG_INF)
+
+            m_prev = m_ref[sl, :1]
+            l_prev = l_ref[sl, :1]
+            m_cur = jnp.max(logits, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(logits - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[sl, :] = jnp.broadcast_to(
+                alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True),
+                (rph, l_ref.shape[1]),
+            )
+            v = v_ref[kh]
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_ref[sl, :] = acc_ref[sl, :] * alpha + pv
+            m_ref[sl, :] = jnp.broadcast_to(m_new, (rph, m_ref.shape[1]))
 
     @pl.when(ki == last_ki)
     def _finalize():
@@ -127,6 +150,17 @@ def _live_range(idx, s, block_k, window, num_kv):
     return first_ki, last_ki
 
 
+def _flatten_q(q, hkv):
+    """(B, s, H, D) -> (B, H*s, D), rows kv-head-major (GQA groups are
+    contiguous because q head h belongs to kv head h // G)."""
+    b, s, h, d = q.shape
+    return q.transpose(0, 2, 1, 3).reshape(b, h * s, d)
+
+
+def _unflatten_o(o, b, s, h, d):
+    return o.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
 # ---------------------------------------------------------------------------
 # dense cache
 # ---------------------------------------------------------------------------
@@ -134,16 +168,16 @@ def _live_range(idx, s, block_k, window, num_kv):
 
 def _dense_kernel(
     idx_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale, s, block_k, window, num_kv,
+    *, scale, s, hkv, block_k, window, num_kv,
 ):
     b = pl.program_id(0)
-    ki = pl.program_id(2)
+    ki = pl.program_id(1)
     idx = idx_ref[b]
     first_ki, last_ki = _live_range(idx, s, block_k, window, num_kv)
     _decode_tile(
-        idx, q_ref.at[0, 0], k_ref.at[0, :, 0], v_ref.at[0, :, 0],
-        o_ref.at[0, 0], acc_ref, m_ref, l_ref,
-        scale=scale, s=s, block_k=block_k, window=window,
+        idx, q_ref.at[0], k_ref.at[0], v_ref.at[0],
+        o_ref.at[0], acc_ref, m_ref, l_ref,
+        scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
     )
 
@@ -152,36 +186,31 @@ def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
-    _, max_len, hkv, _ = cache_k.shape
-    g = h // hkv
-    rows = g * s
+    _, hkv, max_len, _ = cache_k.shape
+    rows = h * s
     num_kv = max_len // block_k
 
-    # (B, s, H, D) -> (B, Hkv, G*s, D): row r = g*s + si.
-    qf = q.transpose(0, 2, 1, 3).reshape(b, hkv, g, s, d)
-    qf = qf.reshape(b, hkv, rows, d)
+    qf = _flatten_q(q, hkv)
 
-    def kv_map(bi, hi, ki, idx_ref):
+    def kv_map(bi, ki, idx_ref):
         first_ki, last_ki = _live_range(
             idx_ref[bi], s, block_k, window, num_kv
         )
         # Clamp dead blocks onto the live range: Mosaic only issues a
         # DMA when the block index changes, so skipped blocks cost no
         # HBM bandwidth.
-        return bi, jnp.clip(ki, first_ki, last_ki), hi, 0
+        return bi, 0, jnp.clip(ki, first_ki, last_ki), 0
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, hkv, num_kv),
+        grid=(b, num_kv),
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, rows, d), lambda bi, hi, ki, idx_ref: (bi, hi, 0, 0)
-            ),
-            pl.BlockSpec((1, block_k, 1, d), kv_map),
-            pl.BlockSpec((1, block_k, 1, d), kv_map),
+            pl.BlockSpec((1, rows, d), lambda bi, ki, idx_ref: (bi, 0, 0)),
+            pl.BlockSpec((1, hkv, block_k, d), kv_map),
+            pl.BlockSpec((1, hkv, block_k, d), kv_map),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, rows, d), lambda bi, hi, ki, idx_ref: (bi, hi, 0, 0)
+            (1, rows, d), lambda bi, ki, idx_ref: (bi, 0, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((rows, d), jnp.float32),
@@ -191,15 +220,22 @@ def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k, interpret):
     )
     out = pl.pallas_call(
         functools.partial(
-            _dense_kernel, scale=scale, s=s, block_k=block_k,
+            _dense_kernel, scale=scale, s=s, hkv=hkv, block_k=block_k,
             window=window, num_kv=num_kv,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
         interpret=interpret,
     )(index.astype(jnp.int32), qf, cache_k, cache_v)
-    out = out.reshape(b, hkv, g, s, d).reshape(b, h, s, d)
-    return out.transpose(0, 2, 1, 3)
+    return _unflatten_o(out, b, s, h, d)
+
+
+def _pick_block_k(max_len: int, hkv: int, block_k: int) -> int:
+    """Largest workable kv block: divides max_len, and the (hkv,
+    block_k, d) k+v tiles stay within a double-buffered VMEM budget."""
+    # ~4 MiB for k+v at bf16 with double buffering: hkv*block_k <= 8192.
+    cap = max(8, 8192 // max(hkv, 1))
+    return _fit_block(max_len, min(block_k, cap))
 
 
 def decode_supported(
@@ -207,16 +243,14 @@ def decode_supported(
 ) -> bool:
     """Can the compiled dense decode kernel handle these shapes?"""
     b, s, h, d = q.shape
-    hkv, dk = cache_k.shape[2], cache_k.shape[3]
-    if d % 128 != 0 or dk != d:
+    hkv, max_len, dk = cache_k.shape[1], cache_k.shape[2], cache_k.shape[3]
+    if d % 64 != 0 or dk != d:
         return False
     if h % hkv != 0:
         return False
-    rows = (h // hkv) * s
-    if rows > 1024:  # VMEM accumulator budget
+    if h * s > 1024:  # VMEM accumulator budget
         return False
-    max_len = cache_k.shape[1]
-    return _fit_block(max_len, block_k or DEFAULT_BLOCK_K) != 0
+    return _pick_block_k(max_len, hkv, block_k or DEFAULT_BLOCK_K) != 0
 
 
 def decode_attention(
@@ -227,7 +261,7 @@ def decode_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ):
-    """Attention of q (B, s, H, D) against a dense cache (B, L, Hkv, D).
+    """Attention of q (B, s, H, D) against a dense cache (B, Hkv, L, D).
 
     index: (B,) int32 — per-sequence pre-write length; q row si sits at
     position index + si and attends kv positions <= its own (optionally
@@ -251,7 +285,7 @@ def decode_attention(
         # interpret mode exists for tests, not as a dispatch target.
         use_kernel = impl == "auto" and pallas_supported() and shapes_ok
     if use_kernel:
-        bk = _fit_block(cache_k.shape[1], block_k)
+        bk = _pick_block_k(cache_k.shape[2], cache_k.shape[1], block_k)
         return _dense_flash(
             q, cache_k, cache_v, index, float(scale), window, bk, interpret
         )
@@ -259,6 +293,9 @@ def decode_attention(
 
 
 def _decode_ref(q, cache_k, cache_v, index, window, scale):
+    # cache: (B, Hkv, L, D) head-major -> (B, L, Hkv, D) for the ref.
+    cache_k = cache_k.transpose(0, 2, 1, 3)
+    cache_v = cache_v.transpose(0, 2, 1, 3)
     b, s = q.shape[:2]
     max_len = cache_k.shape[1]
     cdt = q.dtype
@@ -283,16 +320,16 @@ def _decode_ref(q, cache_k, cache_v, index, window, scale):
 
 def _paged_kernel(
     len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale, s, block_k, window, num_kv,
+    *, scale, s, hkv, block_k, window, num_kv,
 ):
     b = pl.program_id(0)
-    ki = pl.program_id(2)
+    ki = pl.program_id(1)
     idx = len_ref[b]
     first_ki, last_ki = _live_range(idx, s, block_k, window, num_kv)
     _decode_tile(
-        idx, q_ref.at[0, 0], k_ref.at[0, :, 0], v_ref.at[0, :, 0],
-        o_ref.at[0, 0], acc_ref, m_ref, l_ref,
-        scale=scale, s=s, block_k=block_k, window=window,
+        idx, q_ref.at[0], k_ref.at[0], v_ref.at[0],
+        o_ref.at[0], acc_ref, m_ref, l_ref,
+        scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
     )
 
@@ -301,34 +338,31 @@ def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret):
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
-    bs = pool_k.shape[1]
-    hkv = pool_k.shape[2]
-    g = h // hkv
-    rows = g * s
+    hkv = pool_k.shape[1]
+    bs = pool_k.shape[2]
+    rows = h * s
     num_kv = tables.shape[1]  # logical blocks per slot
 
-    qf = q.transpose(0, 2, 1, 3).reshape(b, hkv, rows, d)
+    qf = _flatten_q(q, hkv)
 
-    def kv_map(bi, hi, ki, len_ref, tab_ref):
+    def kv_map(bi, ki, len_ref, tab_ref):
         first_ki, last_ki = _live_range(len_ref[bi], s, bs, window, num_kv)
         ki = jnp.clip(ki, first_ki, last_ki)
         # Indirect through the block table: logical block ki of slot bi
         # lives at pool block tables[bi, ki]. Unallocated entries point
         # at scratch block 0 and are never live.
-        return tab_ref[bi, ki], 0, hi, 0
+        return tab_ref[bi, ki], 0, 0, 0
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(b, hkv, num_kv),
+        grid=(b, num_kv),
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, rows, d), lambda bi, hi, ki, lr, tr: (bi, hi, 0, 0)
-            ),
-            pl.BlockSpec((1, bs, 1, d), kv_map),
-            pl.BlockSpec((1, bs, 1, d), kv_map),
+            pl.BlockSpec((1, rows, d), lambda bi, ki, lr, tr: (bi, 0, 0)),
+            pl.BlockSpec((1, hkv, bs, d), kv_map),
+            pl.BlockSpec((1, hkv, bs, d), kv_map),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, rows, d), lambda bi, hi, ki, lr, tr: (bi, hi, 0, 0)
+            (1, rows, d), lambda bi, ki, lr, tr: (bi, 0, 0)
         ),
         scratch_shapes=[
             pltpu.VMEM((rows, d), jnp.float32),
@@ -338,25 +372,30 @@ def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret):
     )
     out = pl.pallas_call(
         functools.partial(
-            _paged_kernel, scale=scale, s=s, block_k=bs,
+            _paged_kernel, scale=scale, s=s, hkv=hkv, block_k=bs,
             window=window, num_kv=num_kv,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, rows, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
         interpret=interpret,
     )(index.astype(jnp.int32), tables.astype(jnp.int32), qf, pool_k, pool_v)
-    out = out.reshape(b, hkv, g, s, d).reshape(b, h, s, d)
-    return out.transpose(0, 2, 1, 3)
+    return _unflatten_o(out, b, s, h, d)
 
 
 def paged_decode_supported(q, pool_k) -> bool:
     b, s, h, d = q.shape
-    bs, hkv, dk = pool_k.shape[1], pool_k.shape[2], pool_k.shape[3]
-    if d % 128 != 0 or dk != d:
+    hkv, bs, dk = pool_k.shape[1], pool_k.shape[2], pool_k.shape[3]
+    if d % 64 != 0 or dk != d:
         return False
     if h % hkv != 0 or bs % 8 != 0:
         return False
-    return (h // hkv) * s <= 1024
+    if hkv * bs > 8192:
+        # Same double-buffered VMEM budget the dense path enforces via
+        # _pick_block_k; the paged tile is fixed by the pool's page
+        # size, so oversized pages must fall back rather than fail to
+        # compile.
+        return False
+    return h * s <= 1024
 
 
 def paged_decode_attention(
@@ -368,7 +407,7 @@ def paged_decode_attention(
 ):
     """Attention of q (B, s, H, D) against a paged pool via block tables.
 
-    pool_k/v: (n_blocks, bs, Hkv, D); tables: (B, max_blocks) int32;
+    pool_k/v: (n_blocks, Hkv, bs, D); tables: (B, max_blocks) int32;
     index: (B,) pre-write lengths. The kernel walks each slot's table —
     the dense per-slot view is never materialized. Falls back to
     gather + masked reference attention when unsupported.
@@ -395,16 +434,16 @@ def paged_decode_attention(
             # shape (warnings' default "once per message+location"
             # dedup), with the actionable constraint named.
             b, s, h, d = q.shape
-            bs, hkv, dk = pool_k.shape[1], pool_k.shape[2], pool_k.shape[3]
+            hkv, bs, dk = pool_k.shape[1], pool_k.shape[2], pool_k.shape[3]
             warnings.warn(
                 "paged_decode_attention: Pallas kernel unavailable for "
                 f"q={tuple(q.shape)} pool={tuple(pool_k.shape)} — falling "
                 "back to a dense gather + reference attention (paging's "
-                "memory win is lost). Kernel needs: head_dim % 128 == 0 "
+                "memory win is lost). Kernel needs: head_dim % 64 == 0 "
                 f"(got {d}), pool head_dim == q head_dim (got {dk} vs {d}), "
                 f"page block size % 8 == 0 (got {bs}), "
                 f"n_heads % kv_heads == 0 (got {h}/{hkv}), and "
-                f"group*s <= 1024 (got {(h // hkv) * s if h % hkv == 0 else 'n/a'}).",
+                f"H*s <= 1024 (got {h * s}).",
                 PagedFallbackWarning,
                 stacklevel=2,
             )

@@ -7,15 +7,27 @@ entirely (no compute), which halves the work for causal prefill. GQA is
 handled in the index map: the kv block for q-head h is head h // group,
 so kv tiles are never replicated in HBM.
 
+Sliding windows and packed segments are first-class (they are the
+pretraining default, not an exotic): a window additionally skips kv
+blocks entirely below the window's reach — compute AND the DMA, via the
+same index-map clamping trick as the causal skip — so windowed training
+cost scales with O(S*W) not O(S^2). Packed segment ids ride along as
+(1, 1, block) int32 tiles and contribute a block-diagonal mask; a tile
+whose every entry is masked is handled exactly (the online softmax
+update is gated so the accumulator passes through unchanged).
+
 Backward: blocked Pallas kernels as well. The forward additionally
 writes the logsumexp rows; backward recomputes tile probabilities from
 (q, k, lse) — never materializing the S×S matrix — in two passes:
 one over kv blocks producing dk/dv (GQA group summed in-kernel), one
-over q blocks producing dq. Causal dead blocks are skipped in both.
+over q blocks producing dq. Causal/window dead blocks are skipped in
+both. Segment ids need no gradient (they are an integer mask).
 
-The compiled kernel wants lane-aligned head_dim (multiple of 128) and
-block-divisible sequence lengths; `flash_supported` gates dispatch and
-everything else falls back to the reference implementation.
+The compiled kernel wants head_dim a multiple of 64 (blocks span the
+full head_dim, which Mosaic accepts; dh=64 pays ~2x lane padding but
+still beats the O(S^2) reference) and block-divisible sequence lengths;
+`flash_supported` gates dispatch and everything else falls back to the
+reference implementation.
 """
 
 from __future__ import annotations
@@ -49,14 +61,24 @@ def _fit_block(seq: int, block: int) -> int:
 
 def flash_supported(
     q, k, v, *, causal, window=None, q_positions=None, kv_positions=None,
-    kv_mask=None, block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+    kv_mask=None, q_segments=None, kv_segments=None,
+    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
 ) -> bool:
     """Can the compiled Pallas kernel handle this call?"""
     if not pallas_supported():
         return False
-    if window is not None or q_positions is not None or kv_positions is not None:
+    if q_positions is not None or kv_positions is not None:
         return False
     if kv_mask is not None:
+        return False
+    if (q_segments is None) != (kv_segments is None):
+        return False
+    if q_segments is not None and q_segments is not kv_segments:
+        # The kernel masks with ONE packed-segment row per batch entry
+        # (training packing always has q and kv sharing it); distinct
+        # q/kv segment arrays fall back to the reference path.
+        return False
+    if window is not None and window < 1:
         return False
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -64,7 +86,10 @@ def flash_supported(
         # The kernel itself supports non-causal; restrict dispatch to the
         # training prefill shape we have test coverage for.
         return False
-    if d % 128 != 0:
+    if d % 64 != 0:
+        # Blocks span the full head_dim, so Mosaic accepts any d equal
+        # to the array dim; d % 64 keeps the VPU lane padding bounded
+        # (dh=64 models pay ~2x lane waste but still beat ref O(S^2)).
         return False
     if _fit_block(sq, block_q) == 0 or _fit_block(sk, block_k) == 0:
         return False
@@ -73,24 +98,37 @@ def flash_supported(
     return True
 
 
-def _scores(q_blk, k_blk, q_start, k_start, scale, causal):
-    """Scaled (block_q, block_k) fp32 logits with the causal mask applied."""
+def _scores(
+    q_blk, k_blk, q_start, k_start, scale, causal, window=None,
+    q_seg=None, k_seg=None,
+):
+    """Scaled (block_q, block_k) fp32 logits with all masks applied.
+
+    q_seg/k_seg: (block_q,), (block_k,) int32 packed document ids, or
+    None for unpacked.
+    """
     q = q_blk.astype(jnp.float32) * scale
     k = k_blk.astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    if causal:
-        shape = s.shape
+    shape = s.shape
+    if causal or window is not None:
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
         cols = k_start + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
-        s = jnp.where(cols <= rows, s, NEG_INF)
+        if causal:
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        if window is not None:
+            # Valid iff qpos - kpos < window.
+            s = jnp.where(rows - cols < window, s, NEG_INF)
+    if q_seg is not None:
+        s = jnp.where(q_seg[:, None] == k_seg[None, :], s, NEG_INF)
     return s
 
 
 def _tile_p_ds(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    q_start, k_start, scale, causal,
+    q_start, k_start, scale, causal, window, q_seg, k_seg,
 ):
     """Recompute a probability tile and its score gradient from saved lse.
 
@@ -98,8 +136,16 @@ def _tile_p_ds(
     drift between dq and dk/dv. Returns (p, ds), both (block_q, block_k)
     fp32; ds carries the softmax scale factor.
     """
-    s = _scores(q_ref[0], k_ref[0], q_start, k_start, scale, causal)
-    p = jnp.exp(s - lse_ref[0, 0, :][:, None])  # exact softmax rows
+    s = _scores(
+        q_ref[0], k_ref[0], q_start, k_start, scale, causal, window,
+        q_seg, k_seg,
+    )
+    # Masked entries carry s = NEG_INF (finite): exp(s - lse) underflows
+    # to 0 for any real lse, but a fully-masked row would hit
+    # exp(NEG_INF - NEG_INF) = 1, so gate on s itself.
+    p = jnp.where(
+        s > 0.5 * NEG_INF, jnp.exp(s - lse_ref[0, 0, :][:, None]), 0.0
+    )
     dp = jax.lax.dot_general(
         do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -108,10 +154,54 @@ def _tile_p_ds(
     return p, ds
 
 
+def _first_live_ki(q_start, window, block_k):
+    """First kv block any row of this q block can attend (window only)."""
+    return jnp.maximum(q_start - window + 1, 0) // block_k
+
+
+def _make_clamp_ki(causal, window, block_q, block_k):
+    """kv-block DMA clamp shared by the forward and dq index maps.
+
+    Clamps dead kv blocks (above the causal diagonal, or wholly below
+    the window's reach) onto the live range: the Mosaic pipeline only
+    issues a DMA when the block index changes, so skipped blocks cost
+    no HBM bandwidth.
+    """
+
+    def clamp_ki(qi, ki):
+        if causal:
+            last = (qi * block_q + block_q - 1) // block_k
+            if window is not None:
+                ki = jnp.clip(
+                    ki, _first_live_ki(qi * block_q, window, block_k), last
+                )
+            else:
+                ki = jnp.minimum(ki, last)
+        return ki
+
+    return clamp_ki
+
+
+def _unpack_refs(refs, has_segments, n_out_scratch):
+    """Split a kernel's positional refs into (main_inputs, segs, rest)."""
+    if has_segments:
+        ins = refs[: -2 - n_out_scratch]
+        segs = refs[-2 - n_out_scratch: -n_out_scratch]
+        rest = refs[-n_out_scratch:]
+    else:
+        ins = refs[: -n_out_scratch]
+        segs = (None, None)
+        rest = refs[-n_out_scratch:]
+    return ins, segs, rest
+
+
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, scale: float, causal: bool, block_q: int, block_k: int, num_kv: int,
+    *refs, scale: float, causal: bool, window: Optional[int],
+    block_q: int, block_k: int, num_kv: int, has_segments: bool,
 ):
+    (q_ref, k_ref, v_ref), (qs_ref, ks_ref), (
+        o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    ) = _unpack_refs(refs, has_segments, 5)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -126,6 +216,9 @@ def _flash_kernel(
     else:
         last_ki = num_kv - 1
         live = True
+    if window is not None:
+        # Blocks wholly below the window's reach are skipped too.
+        live &= k_start + block_k - 1 >= q_start - window + 1
 
     @pl.when(ki == 0)
     def _init():
@@ -136,12 +229,21 @@ def _flash_kernel(
     @pl.when(live)
     def _compute():
         v = v_ref[0]
-        s = _scores(q_ref[0], k_ref[0], q_start, k_start, scale, causal)
+        q_seg = qs_ref[0, 0, :] if has_segments else None
+        k_seg = ks_ref[0, 0, :] if has_segments else None
+        s = _scores(
+            q_ref[0], k_ref[0], q_start, k_start, scale, causal, window,
+            q_seg, k_seg,
+        )
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
+        # A fully-masked tile leaves m_new at NEG_INF; exp(s - m_new)
+        # would then be exp(0) = 1 for every masked entry. Gate on s so
+        # the tile contributes nothing (alpha = exp(m_prev - m_new) = 1
+        # keeps the accumulator intact).
+        p = jnp.where(s > 0.5 * NEG_INF, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
@@ -161,7 +263,9 @@ def _flash_kernel(
         lse_ref[0, 0, :] = (m_ref[:, :1] + jnp.log(l))[:, 0]
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(
+    q, k, v, seg, causal, scale, window, block_q, block_k, interpret
+):
     from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
@@ -171,29 +275,48 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     block_k = _fit_block(sk, block_k) or min(block_k, sk)
     num_q = sq // block_q
     num_kv = sk // block_k
+    has_segments = seg is not None
 
     # (B, S, H, D) -> (B*H, S, D)
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
 
+    clamp_ki = _make_clamp_ki(causal, window, block_q, block_k)
+
     def kv_index(bh, qi, ki):
         kv_bh = (bh // h) * hkv + (bh % h) // g
-        if causal:
-            # Clamp dead upper-triangle blocks to the diagonal block: the
-            # Mosaic pipeline only issues a DMA when the block index
-            # changes, so compute-skipped blocks cost no HBM bandwidth.
-            ki = jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k)
-        return kv_bh, ki, 0
+        return kv_bh, clamp_ki(qi, ki), 0
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    inputs = [qf, kf, vf]
+    if has_segments:
+        segr = seg.astype(jnp.int32).reshape(b, 1, sq)
+        in_specs += [
+            pl.BlockSpec(
+                (1, 1, block_q), lambda bh, qi, ki: (bh // h, 0, qi)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k),
+                lambda bh, qi, ki: (bh // h, 0, clamp_ki(qi, ki)),
+            ),
+        ]
+        inputs += [segr, segr]
 
     out, lse = pl.pallas_call(
         functools.partial(
             _flash_kernel,
             scale=scale,
             causal=causal,
+            window=window,
             block_q=block_q,
             block_k=block_k,
             num_kv=num_kv,
+            has_segments=has_segments,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(qf.shape, q.dtype),
@@ -202,11 +325,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
         ],
         grid=(b * h, num_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
             pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
@@ -217,18 +336,19 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*inputs)
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse[:, 0, :]
 
 
 def _flash_bwd_dkdv_kernel(
-    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc,
-    *, scale: float, causal: bool, block_q: int, block_k: int,
-    num_q: int, inner: int,
+    *refs, scale: float, causal: bool, window: Optional[int],
+    block_q: int, block_k: int, num_q: int, inner: int, has_segments: bool,
 ):
     """Grid (B*Hkv, kv_blocks, G*q_blocks): one (dk, dv) tile per kv block,
     accumulated over every q block of every q-head in the GQA group."""
+    (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref), (qs_ref, ks_ref), (
+        dk_ref, dv_ref, dk_acc, dv_acc,
+    ) = _unpack_refs(refs, has_segments, 4)
     ki = pl.program_id(1)
     j = pl.program_id(2)
     qi = j % num_q
@@ -236,6 +356,10 @@ def _flash_bwd_dkdv_kernel(
     k_start = ki * block_k
     q_start = qi * block_q
     live = (not causal) or (q_start + block_q - 1 >= k_start)
+    if window is not None:
+        # q rows beyond k_start + block_k - 1 + window - 1 can't reach
+        # this kv block.
+        live &= q_start <= k_start + block_k + window - 2
 
     @pl.when(j == 0)
     def _init():
@@ -244,9 +368,11 @@ def _flash_bwd_dkdv_kernel(
 
     @pl.when(live)
     def _compute():
+        q_seg = qs_ref[0, 0, :] if has_segments else None
+        k_seg = ks_ref[0, 0, :] if has_segments else None
         p, ds = _tile_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            q_start, k_start, scale, causal,
+            q_start, k_start, scale, causal, window, q_seg, k_seg,
         )
         do = do_ref[0]
         # dv += p^T do
@@ -267,10 +393,13 @@ def _flash_bwd_dkdv_kernel(
 
 
 def _flash_bwd_dq_kernel(
-    q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref, dq_ref, dq_acc,
-    *, scale: float, causal: bool, block_q: int, block_k: int, num_kv: int,
+    *refs, scale: float, causal: bool, window: Optional[int],
+    block_q: int, block_k: int, num_kv: int, has_segments: bool,
 ):
     """Grid (B*H, q_blocks, kv_blocks): one dq tile per q block."""
+    (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref), (qs_ref, ks_ref), (
+        dq_ref, dq_acc,
+    ) = _unpack_refs(refs, has_segments, 2)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     q_start = qi * block_q
@@ -282,6 +411,8 @@ def _flash_bwd_dq_kernel(
     else:
         last_ki = num_kv - 1
         live = True
+    if window is not None:
+        live &= k_start + block_k - 1 >= q_start - window + 1
 
     @pl.when(ki == 0)
     def _init():
@@ -289,9 +420,11 @@ def _flash_bwd_dq_kernel(
 
     @pl.when(live)
     def _compute():
+        q_seg = qs_ref[0, 0, :] if has_segments else None
+        k_seg = ks_ref[0, 0, :] if has_segments else None
         _, ds = _tile_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            q_start, k_start, scale, causal,
+            q_start, k_start, scale, causal, window, q_seg, k_seg,
         )
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0],
@@ -304,7 +437,8 @@ def _flash_bwd_dq_kernel(
 
 
 def _flash_backward(
-    q, k, v, o, lse, g_out, causal, scale, block_q, block_k, interpret
+    q, k, v, seg, o, lse, g_out, causal, scale, window, block_q, block_k,
+    interpret,
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -315,6 +449,7 @@ def _flash_backward(
     block_k = _fit_block(sk, block_k) or min(block_k, sk)
     num_q = sq // block_q
     num_kv = sk // block_k
+    has_segments = seg is not None
 
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
@@ -326,46 +461,68 @@ def _flash_backward(
         "bshd,bshd->bhs", g_out.astype(jnp.float32), o.astype(jnp.float32)
     ).reshape(b * h, 1, sq)
     lse = lse.reshape(b * h, 1, sq)
+    segr = (
+        seg.astype(jnp.int32).reshape(b, 1, sq) if has_segments else None
+    )
 
     # --- pass 1: dk, dv (GQA group summed in-kernel) ---
     inner = g * num_q
+
+    def clamp_qi(ki, qi):
+        if causal:
+            # Clamp dead pre-diagonal q blocks to the first live one so
+            # the pipeline issues no DMA for skipped blocks.
+            qi = jnp.maximum(qi, (ki * block_k) // block_q)
+        if window is not None:
+            last_qi = jnp.minimum(
+                (ki * block_k + block_k + window - 2) // block_q, num_q - 1
+            )
+            qi = jnp.minimum(qi, last_qi)
+        return qi
 
     def q_row(bkv, ki, j):
         # q-head row for this (kv head, group member) pair.
         return (bkv // hkv) * h + (bkv % hkv) * g + j // num_q
 
     def q_index(bkv, ki, j):
-        qi = j % num_q
-        if causal:
-            # Clamp dead pre-diagonal q blocks to the first live one so
-            # the pipeline issues no DMA for skipped blocks.
-            qi = jnp.maximum(qi, (ki * block_k) // block_q)
-        return q_row(bkv, ki, j), qi, 0
+        return q_row(bkv, ki, j), clamp_qi(ki, j % num_q), 0
 
     def row_index(bkv, ki, j):
-        qi = j % num_q
-        if causal:
-            qi = jnp.maximum(qi, (ki * block_k) // block_q)
-        return q_row(bkv, ki, j), 0, qi
+        return q_row(bkv, ki, j), 0, clamp_qi(ki, j % num_q)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), q_index),
+        pl.BlockSpec((1, block_q, d), q_index),
+        pl.BlockSpec((1, 1, block_q), row_index),
+        pl.BlockSpec((1, 1, block_q), row_index),
+        pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
+    ]
+    inputs = [qf, dof, lse, delta, kf, vf]
+    if has_segments:
+        in_specs += [
+            pl.BlockSpec(
+                (1, 1, block_q),
+                lambda bkv, ki, j: (bkv // hkv, 0, clamp_qi(ki, j % num_q)),
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k), lambda bkv, ki, j: (bkv // hkv, 0, ki)
+            ),
+        ]
+        inputs += [segr, segr]
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkdv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_q=num_q, inner=inner,
+            window=window, block_q=block_q, block_k=block_k, num_q=num_q,
+            inner=inner, has_segments=has_segments,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(kf.shape, k.dtype),
             jax.ShapeDtypeStruct(vf.shape, v.dtype),
         ],
         grid=(b * hkv, num_kv, inner),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, block_q, d), q_index),
-            pl.BlockSpec((1, 1, block_q), row_index),
-            pl.BlockSpec((1, 1, block_q), row_index),
-            pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bkv, ki, j: (bkv, ki, 0)),
@@ -375,55 +532,76 @@ def _flash_backward(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, dof, lse, delta, kf, vf)
+    )(*inputs)
 
     # --- pass 2: dq ---
+    clamp_ki = _make_clamp_ki(causal, window, block_q, block_k)
+
     def kv_index(bh, qi, ki):
         kv_bh = (bh // h) * hkv + (bh % h) // g
-        if causal:
-            ki = jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k)
-        return kv_bh, ki, 0
+        return kv_bh, clamp_ki(qi, ki), 0
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
+    ]
+    inputs = [qf, dof, lse, delta, kf, vf]
+    if has_segments:
+        in_specs += [
+            pl.BlockSpec(
+                (1, 1, block_q), lambda bh, qi, ki: (bh // h, 0, qi)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k),
+                lambda bh, qi, ki: (bh // h, 0, clamp_ki(qi, ki)),
+            ),
+        ]
+        inputs += [segr, segr]
 
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, scale=scale, causal=causal,
+            _flash_bwd_dq_kernel, scale=scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, num_kv=num_kv,
+            has_segments=has_segments,
         ),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         grid=(b * h, num_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi)),
-            pl.BlockSpec((1, block_k, d), kv_index),
-            pl.BlockSpec((1, block_k, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qf, dof, lse, delta, kf, vf)
+    )(*inputs)
 
     unflat = lambda x, hh: x.reshape(b, hh, -1, d).transpose(0, 2, 1, 3)
     return unflat(dq, h), unflat(dk, hkv), unflat(dv, hkv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, seg, causal, scale, window, block_q, block_k, interpret):
+    out, _ = _flash_forward(
+        q, k, v, seg, causal, scale, window, block_q, block_k, interpret
+    )
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
-
-
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g_out):
-    q, k, v, o, lse = res
-    return _flash_backward(
-        q, k, v, o, lse, g_out, causal, scale, block_q, block_k, interpret
+def _flash_fwd(q, k, v, seg, causal, scale, window, block_q, block_k, interpret):
+    out, lse = _flash_forward(
+        q, k, v, seg, causal, scale, window, block_q, block_k, interpret
     )
+    return out, (q, k, v, seg, out, lse)
+
+
+def _flash_bwd(causal, scale, window, block_q, block_k, interpret, res, g_out):
+    q, k, v, seg, o, lse = res
+    dq, dk, dv = _flash_backward(
+        q, k, v, seg, o, lse, g_out, causal, scale, window, block_q, block_k,
+        interpret,
+    )
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -431,12 +609,21 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(
     q, k, v, *, causal: bool = True, scale: Optional[float] = None,
+    window: Optional[int] = None, segments: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ):
-    """Flash attention. q (B,S,H,D); k,v (B,S,Hkv,D)."""
+    """Flash attention. q (B,S,H,D); k,v (B,S,Hkv,D).
+
+    `window`: sliding-window size (qpos - kpos < window). `segments`:
+    (B, S) int32 packed document ids shared by q and kv; attention is
+    block-diagonal over them.
+    """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = not pallas_supported()
-    return _flash(q, k, v, causal, float(scale), block_q, block_k, interpret)
+    return _flash(
+        q, k, v, segments, causal, float(scale), window, block_q, block_k,
+        interpret,
+    )

@@ -4,7 +4,10 @@ The kernels are graded against the masked reference path that the
 engines used before: identical semantics (causal vs per-row positions
 derived from cache lengths, optional sliding window, garbage beyond the
 valid length ignored) across GQA, ragged lengths, s=1 and small-s
-decode. Paged variants walk a shuffled block table.
+decode. Paged variants walk a shuffled block table. Caches are
+head-major: dense (B, Hkv, L, D), pools (nb, Hkv, bs, D) — see
+kvcache.py. Compiled-mode parity runs on the chip via
+scripts/tpu_parity_decode.py (driven by tests/test_tpu_parity.py).
 """
 
 import jax
@@ -30,8 +33,8 @@ def _rand(key, shape):
 def test_dense_decode_matches_ref(s, window):
     ks = jax.random.split(jax.random.PRNGKey(s * 7 + (window or 0)), 3)
     q = _rand(ks[0], (B, s, H, D))
-    ck = _rand(ks[1], (B, L, HKV, D))
-    cv = _rand(ks[2], (B, L, HKV, D))
+    ck = _rand(ks[1], (B, HKV, L, D))
+    cv = _rand(ks[2], (B, HKV, L, D))
     index = jnp.array([0, 37, L - s], jnp.int32)  # empty, mid, full
 
     ref = _decode_ref(q, ck, cv, index, window, D ** -0.5)
@@ -45,8 +48,8 @@ def test_dense_decode_matches_ref(s, window):
 def test_dense_decode_mha_no_gqa():
     ks = jax.random.split(jax.random.PRNGKey(9), 3)
     q = _rand(ks[0], (2, 1, 4, D))
-    ck = _rand(ks[1], (2, L, 4, D))
-    cv = _rand(ks[2], (2, L, 4, D))
+    ck = _rand(ks[1], (2, 4, L, D))
+    cv = _rand(ks[2], (2, 4, L, D))
     index = jnp.array([5, 99], jnp.int32)
     ref = _decode_ref(q, ck, cv, index, None, D ** -0.5)
     out = decode_attention(
@@ -59,15 +62,15 @@ def test_dense_decode_ignores_garbage_tail():
     """Slots beyond index+s must not leak into the output."""
     ks = jax.random.split(jax.random.PRNGKey(3), 3)
     q = _rand(ks[0], (1, 1, H, D))
-    ck = _rand(ks[1], (1, L, HKV, D))
-    cv = _rand(ks[2], (1, L, HKV, D))
+    ck = _rand(ks[1], (1, HKV, L, D))
+    cv = _rand(ks[2], (1, HKV, L, D))
     index = jnp.array([10], jnp.int32)
     out1 = decode_attention(
         q, ck, cv, index, impl="flash", block_k=64, interpret=True
     )
-    poison = jnp.full_like(ck[:, 11:], 1e4)
-    ck2 = ck.at[:, 11:].set(poison)
-    cv2 = cv.at[:, 11:].set(poison)
+    poison = jnp.full_like(ck[:, :, 11:], 1e4)
+    ck2 = ck.at[:, :, 11:].set(poison)
+    cv2 = cv.at[:, :, 11:].set(poison)
     out2 = decode_attention(
         q, ck2, cv2, index, impl="flash", block_k=64, interpret=True
     )
@@ -91,14 +94,19 @@ def test_paged_decode_matches_dense(s, window):
     rng = np.random.default_rng(0)
     ids = rng.permutation(np.arange(1, n_blocks))
     tables = ids.reshape(B, max_blocks)
-    pool_k = np.zeros((n_blocks, bs, HKV, D), np.float32)
-    pool_v = np.zeros((n_blocks, bs, HKV, D), np.float32)
+    pool_k = np.zeros((n_blocks, HKV, bs, D), np.float32)
+    pool_v = np.zeros((n_blocks, HKV, bs, D), np.float32)
+    dkn = np.asarray(dense_k).transpose(0, 2, 1, 3)  # (B, HKV, L, D)
+    dvn = np.asarray(dense_v).transpose(0, 2, 1, 3)
     for b in range(B):
         for j in range(max_blocks):
-            pool_k[tables[b, j]] = dense_k[b, j * bs:(j + 1) * bs]
-            pool_v[tables[b, j]] = dense_v[b, j * bs:(j + 1) * bs]
+            pool_k[tables[b, j]] = dkn[b, :, j * bs:(j + 1) * bs]
+            pool_v[tables[b, j]] = dvn[b, :, j * bs:(j + 1) * bs]
 
-    ref = _decode_ref(q, dense_k, dense_v, index, window, D ** -0.5)
+    ref = _decode_ref(
+        q, dense_k.transpose(0, 2, 1, 3), dense_v.transpose(0, 2, 1, 3),
+        index, window, D ** -0.5,
+    )
     out = paged_decode_attention(
         q, jnp.asarray(pool_k), jnp.asarray(pool_v), jnp.asarray(tables),
         index, window=window, impl="flash", interpret=True,
@@ -110,8 +118,8 @@ def test_auto_falls_back_to_ref_off_tpu():
     """impl='auto' off-TPU must take the ref path bit-for-bit."""
     ks = jax.random.split(jax.random.PRNGKey(1), 3)
     q = _rand(ks[0], (B, 1, H, D))
-    ck = _rand(ks[1], (B, L, HKV, D))
-    cv = _rand(ks[2], (B, L, HKV, D))
+    ck = _rand(ks[1], (B, HKV, L, D))
+    cv = _rand(ks[2], (B, HKV, L, D))
     index = jnp.array([4, 9, 50], jnp.int32)
     auto = decode_attention(q, ck, cv, index, impl="auto")
     ref = _decode_ref(q, ck, cv, index, None, D ** -0.5)
@@ -119,10 +127,48 @@ def test_auto_falls_back_to_ref_off_tpu():
 
 
 def test_flash_rejects_bad_head_dim():
-    q = jnp.zeros((1, 1, 4, 64))
-    ck = jnp.zeros((1, 64, 4, 64))
+    # dh must be a multiple of 64 (dh=64 itself IS supported).
+    q = jnp.zeros((1, 1, 4, 96))
+    ck = jnp.zeros((1, 4, 64, 96))
     with pytest.raises(ValueError, match="unsupported"):
         decode_attention(q, ck, ck, jnp.zeros((1,), jnp.int32), impl="flash")
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_head_dim_64_matches_ref(paged):
+    """dh=64 models (Qwen2-0.5B class) run the kernels natively."""
+    d64 = 64
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    q = _rand(ks[0], (2, 1, H, d64))
+    if not paged:
+        ck = _rand(ks[1], (2, HKV, L, d64))
+        cv = _rand(ks[2], (2, HKV, L, d64))
+        index = jnp.array([9, 77], jnp.int32)
+        ref = _decode_ref(q, ck, cv, index, None, d64 ** -0.5)
+        out = decode_attention(
+            q, ck, cv, index, impl="flash", block_k=64, interpret=True
+        )
+    else:
+        bs = 16
+        max_blocks = L // bs
+        n_blocks = 2 * max_blocks + 1
+        dense_k = _rand(ks[1], (2, HKV, L, d64))
+        dense_v = _rand(ks[2], (2, HKV, L, d64))
+        index = jnp.array([9, 77], jnp.int32)
+        tables = np.arange(1, n_blocks).reshape(2, max_blocks)
+        pool_k = np.zeros((n_blocks, HKV, bs, d64), np.float32)
+        pool_v = np.zeros((n_blocks, HKV, bs, d64), np.float32)
+        for b in range(2):
+            for j in range(max_blocks):
+                pool_k[tables[b, j]] = np.asarray(dense_k)[b, :, j*bs:(j+1)*bs]
+                pool_v[tables[b, j]] = np.asarray(dense_v)[b, :, j*bs:(j+1)*bs]
+        ref = _decode_ref(q, dense_k, dense_v, index, None, d64 ** -0.5)
+        out = paged_decode_attention(
+            q, jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(tables, jnp.int32), index, impl="flash",
+            interpret=True,
+        )
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
 @pytest.mark.parametrize(
@@ -137,7 +183,7 @@ def test_paged_fallback_warns_on_tpu_like_backend(monkeypatch, bs, d):
     monkeypatch.setattr(da, "pallas_supported", lambda: True)
     n_blocks, max_blocks = 5, 4
     q = jnp.zeros((1, 1, 4, d))
-    pool = jnp.zeros((n_blocks, bs, 4, d))
+    pool = jnp.zeros((n_blocks, 4, bs, d))
     tables = jnp.arange(1, 1 + max_blocks, dtype=jnp.int32)[None, :]
     index = jnp.zeros((1,), jnp.int32)
     with pytest.warns(da.PagedFallbackWarning, match="falling"):
@@ -152,7 +198,7 @@ def test_paged_supported_shapes_do_not_warn():
     import shellac_tpu.ops.decode_attention as da
 
     q = jnp.zeros((1, 1, 4, 128))
-    pool = jnp.zeros((5, 16, 4, 128))
+    pool = jnp.zeros((5, 4, 16, 128))
     tables = jnp.arange(1, 5, dtype=jnp.int32)[None, :]
     index = jnp.zeros((1,), jnp.int32)
     with _w.catch_warnings():

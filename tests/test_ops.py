@@ -182,6 +182,111 @@ class TestFlashAttention:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4)
 
+    def test_head_dim_64_matches_ref(self):
+        """dh=64: blocks span the full head_dim, Mosaic-legal."""
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.normal(size=(2, 128, 4, 64)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 128, 2, 64)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 128, 2, 64)).astype(np.float32))
+
+        def f_flash(q, k, v):
+            return flash_attention(
+                q, k, v, causal=True, block_q=32, block_k=32, interpret=True
+            ).sum()
+
+        def f_ref(q, k, v):
+            return attention_ref(q, k, v, causal=True).sum()
+
+        got = flash_attention(
+            q, k, v, causal=True, block_q=32, block_k=32, interpret=True
+        )
+        want = attention_ref(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4
+            )
+
+    @pytest.mark.parametrize("window", [1, 20, 48, 200])
+    def test_window_matches_ref(self, window):
+        """Sliding windows smaller than, spanning, and exceeding blocks."""
+        rng = np.random.default_rng(4)
+        q = jnp.asarray(rng.normal(size=(2, 128, 4, 128)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 128, 2, 128)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 128, 2, 128)).astype(np.float32))
+        got = flash_attention(
+            q, k, v, causal=True, window=window, block_q=32, block_k=32,
+            interpret=True,
+        )
+        want = attention_ref(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+    def test_segments_matches_ref(self):
+        """Packed documents: block-diagonal masking, incl. a doc boundary
+        inside a block and a whole block belonging to one document."""
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.normal(size=(2, 128, 4, 128)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 128, 2, 128)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 128, 2, 128)).astype(np.float32))
+        seg = jnp.asarray(
+            np.concatenate([
+                np.repeat([0, 1, 2], [50, 14, 64])[None],
+                np.repeat([0, 1], [96, 32])[None],
+            ]), jnp.int32,
+        )
+        got = flash_attention(
+            q, k, v, causal=True, segments=seg, block_q=32, block_k=32,
+            interpret=True,
+        )
+        want = attention_ref(
+            q, k, v, causal=True, q_segments=seg, kv_segments=seg
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
+
+    @pytest.mark.parametrize("window,seg_spec", [
+        (20, None), (None, "packed"), (24, "packed"),
+    ])
+    def test_window_segments_grads_match_ref(self, window, seg_spec):
+        rng = np.random.default_rng(6)
+        q = jnp.asarray(rng.normal(size=(1, 96, 4, 128)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(1, 96, 2, 128)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(1, 96, 2, 128)).astype(np.float32))
+        seg = None
+        if seg_spec:
+            seg = jnp.asarray(
+                np.repeat([0, 1, 2], [40, 9, 47])[None], jnp.int32
+            )
+
+        def f_flash(q, k, v):
+            out = flash_attention(
+                q, k, v, causal=True, window=window, segments=seg,
+                block_q=32, block_k=32, interpret=True,
+            )
+            return (out * jnp.arange(out.shape[1])[None, :, None, None]).sum()
+
+        def f_ref(q, k, v):
+            out = attention_ref(
+                q, k, v, causal=True, window=window,
+                q_segments=seg, kv_segments=seg,
+            )
+            return (out * jnp.arange(out.shape[1])[None, :, None, None]).sum()
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("dq dk dv".split(), g1, g2):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3,
+                err_msg=name,
+            )
+
     @pytest.mark.parametrize("causal", [True, False])
     def test_grad_matches_ref_gqa(self, causal):
         """Backward sums dk/dv over the GQA group in-kernel; check it."""

@@ -43,14 +43,15 @@ def _ref(cfg, params, tokens, max_new):
 
 class TestPagedOps:
     def test_update_then_gather_roundtrip(self, rng):
-        pool_k = jnp.zeros((5, 4, 2, 8))  # (nb, bs=4, H=2, D=8)
-        pool_v = jnp.zeros((5, 4, 2, 8))
+        pool_k = jnp.zeros((5, 2, 4, 8))  # (nb, H=2, bs=4, D=8)
+        pool_v = jnp.zeros((5, 2, 4, 8))
         tables = jnp.asarray([[1, 3], [2, 4]], jnp.int32)  # 2 slots
         k_new = jnp.asarray(rng.normal(size=(2, 3, 2, 8)), jnp.float32)
         v_new = jnp.asarray(rng.normal(size=(2, 3, 2, 8)), jnp.float32)
         index = jnp.asarray([2, 0], jnp.int32)  # slot0 writes pos 2..4
         pk, pv = paged_update_layer(pool_k, pool_v, k_new, v_new, index, tables)
-        k_all, _ = paged_gather_layer(pk, pv, tables)
+        k_all, _ = paged_gather_layer(pk, pv, tables)  # (B, H, mb*bs, D)
+        k_all = jnp.transpose(k_all, (0, 2, 1, 3))  # token-major for asserts
         # Slot 0 positions 2,3 -> block 1 offsets 2,3; pos 4 -> block 3 off 0.
         np.testing.assert_allclose(np.asarray(k_all[0, 2:5]), np.asarray(k_new[0]))
         # Slot 1 positions 0..2 -> block 2.
@@ -159,5 +160,5 @@ class TestPagedEngine:
         dense_tokens = 8 * 512
         srv = PagedBatchingEngine(cfg, params, n_slots=8, max_len=512,
                                   block_size=16)
-        pool_positions = srv._cache.k.shape[1] * srv._cache.k.shape[2]
+        pool_positions = srv._cache.k.shape[1] * srv._cache.k.shape[3]
         assert pool_positions < dense_tokens * 0.6

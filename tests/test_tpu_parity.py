@@ -1,0 +1,54 @@
+"""Drive the TPU-compiled Pallas parity gate when a chip is reachable.
+
+The suite itself pins the CPU platform (conftest.py), so the compiled
+kernels are exercised in a subprocess that initializes the TPU backend
+fresh. Off-TPU (or with a wedged relay) the test skips rather than
+fails: the gate's job is to stop compiled-only regressions from
+landing silently when hardware IS available — interpret-mode tests
+cover the math everywhere else.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "tpu_parity_decode.py")
+
+
+def _tpu_usable(timeout_s: float = 45.0) -> bool:
+    code = "import jax; assert jax.default_backend() == 'tpu'"
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout_s,
+            capture_output=True, env=env,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+@pytest.mark.skipif(
+    os.environ.get("SHELLAC_SKIP_TPU_PARITY") == "1",
+    reason="explicitly disabled",
+)
+def test_compiled_kernels_match_ref_on_tpu():
+    if not _tpu_usable():
+        pytest.skip("no TPU backend reachable from a fresh subprocess")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    r = subprocess.run(
+        [sys.executable, SCRIPT], timeout=560, capture_output=True,
+        text=True, env=env,
+    )
+    assert r.returncode == 0, f"parity gate failed:\n{r.stdout}\n{r.stderr}"
+    line = r.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["ok"], result
+    # Every case family must have run.
+    joined = " ".join(result["checks"])
+    for family in ("dense", "paged", "flash fwd", "flash bwd"):
+        assert family in joined, f"missing {family}: {result['checks']}"
