@@ -88,6 +88,10 @@ class ModelConfig:
     moe: Optional[MoEConfig] = None
     moe_every: int = 1
     logit_softcap: Optional[float] = None
+    # Quantized training compute: "int8" runs the dense projections as
+    # int8 MXU dots (fwd only; fp32 master params untouched). Usually
+    # set via TrainConfig.quant rather than directly. See ops/qtrain.py.
+    quant_training: Optional[str] = None
 
     @property
     def kv_heads(self) -> int:
@@ -127,6 +131,10 @@ class ModelConfig:
             )
         if self.moe is not None and self.moe_every < 1:
             raise ValueError("moe_every must be >= 1")
+        if self.quant_training not in (None, "int8"):
+            raise ValueError(
+                f"quant_training={self.quant_training!r}; have None, 'int8'"
+            )
         return self
 
     def replace(self, **kw) -> "ModelConfig":
@@ -187,6 +195,9 @@ class TrainConfig:
     z_loss_weight: float = 0.0
     # Skip the whole param/opt update when any gradient is non-finite.
     skip_nonfinite_updates: bool = True
+    # Quantized training compute: None (bf16) or "int8" (dense
+    # projections as int8 MXU dots, fwd only; fp32 master params).
+    quant: Optional[str] = None
     seed: int = 0
 
     def replace(self, **kw) -> "TrainConfig":

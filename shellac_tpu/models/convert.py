@@ -173,6 +173,11 @@ def params_from_state_dict(
         return _to_np(sd[key])
 
     moe = cfg.moe is not None
+    if moe and cfg.moe_every > 1:
+        raise NotImplementedError(
+            "interleaved dense/MoE stacks (moe_every > 1) have no HF "
+            "(Mixtral) checkpoint layout to convert from"
+        )
     mlp_keys = (["w_router"] + list(_EXPERT_MAP) if moe
                 else list(_DENSE_MLP_MAP))
     bias_keys = list(_BIAS_MAP) if cfg.attn_bias else []
@@ -239,6 +244,11 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
     if moe and cfg.moe.num_shared_experts > 0:
         raise NotImplementedError(
             "shared experts have no HF (Mixtral) state_dict equivalent"
+        )
+    if moe and cfg.moe_every > 1:
+        raise NotImplementedError(
+            "interleaved dense/MoE stacks (moe_every > 1) have no HF "
+            "(Mixtral) state_dict equivalent"
         )
 
     def np_(x):

@@ -19,6 +19,12 @@ PRESETS = {
                                    moe=MoEConfig(num_experts=4,
                                                  num_experts_per_token=2,
                                                  num_shared_experts=1)),
+    "tiny-moe-interleaved": ModelConfig(vocab_size=256, d_model=64,
+                                        n_layers=4, n_heads=4,
+                                        max_seq_len=128, remat=False,
+                                        moe=MoEConfig(num_experts=4,
+                                                      num_experts_per_token=2),
+                                        moe_every=2),
     "tiny-encoder": ModelConfig(vocab_size=256, d_model=64, n_layers=2,
                                 n_heads=4, max_seq_len=128, remat=False,
                                 causal=False),

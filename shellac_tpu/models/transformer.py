@@ -30,6 +30,7 @@ from shellac_tpu.config import ModelConfig
 from shellac_tpu.ops.activations import geglu, softcap, swiglu
 from shellac_tpu.ops.attention import attention
 from shellac_tpu.ops.norms import rms_norm
+from shellac_tpu.ops.qtrain import quant_dot
 from shellac_tpu.ops.quant import materialize
 from shellac_tpu.ops.rope import apply_rope, rope_angles
 from shellac_tpu.parallel.sharding import constrain
@@ -37,13 +38,26 @@ from shellac_tpu.parallel.sharding import constrain
 Params = Dict[str, Any]
 
 
+def grouped_moe(cfg: ModelConfig) -> bool:
+    """True for interleaved dense/MoE stacks (moe_every > 1).
+
+    Layout: layers are grouped into n_layers // moe_every super-blocks
+    of (moe_every - 1) dense layers followed by one MoE layer (the
+    DeepSeek/Mixtral-hybrid pattern, dense-first). Params hold two
+    uniform stacks — {"dense": (ng, every-1, ...), "moe": (ng, ...)} —
+    so the forward stays a scan over groups with a scan over the dense
+    sub-stack inside: still one compiled block body per kind.
+    """
+    return cfg.moe is not None and cfg.moe_every > 1
+
+
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     """Initialize a parameter pytree (master copy, cfg.param_dtype)."""
     cfg.validate()
-    if cfg.moe is not None and cfg.moe_every != 1:
-        raise NotImplementedError(
-            "moe_every > 1 breaks the uniform scan-over-layers layout; "
-            "only moe_every=1 (all layers MoE) is supported"
+    if grouped_moe(cfg) and cfg.n_layers % cfg.moe_every != 0:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} must divide into groups of "
+            f"moe_every={cfg.moe_every}"
         )
     pdt = cfg.params_dtype
     d, h, hkv, dh, f = (
@@ -55,7 +69,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         std = scale * fan_in ** -0.5
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(pdt)
 
-    def layer(key):
+    def layer(key, moe_layer):
         ks = jax.random.split(key, 8)
         # Residual-output projections scaled down GPT-2 style so the
         # residual stream variance stays O(1) at depth.
@@ -74,7 +88,7 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 "bk": jnp.zeros((hkv * dh,), pdt),
                 "bv": jnp.zeros((hkv * dh,), pdt),
             })
-        if cfg.moe is None:
+        if not moe_layer:
             p.update({
                 "w_gate": dense(ks[4], (d, f), d),
                 "w_up": dense(ks[5], (d, f), d),
@@ -98,11 +112,25 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 })
         return p
 
-    layer_keys = jax.random.split(k_layers, cfg.n_layers)
+    if grouped_moe(cfg):
+        every = cfg.moe_every
+        ng = cfg.n_layers // every
+        keys = jax.random.split(k_layers, cfg.n_layers).reshape(
+            ng, every, -1
+        )
+        layers = {
+            "dense": jax.vmap(jax.vmap(lambda k: layer(k, False)))(
+                keys[:, : every - 1]
+            ),
+            "moe": jax.vmap(lambda k: layer(k, True))(keys[:, every - 1]),
+        }
+    else:
+        layer_keys = jax.random.split(k_layers, cfg.n_layers)
+        layers = jax.vmap(lambda k: layer(k, cfg.moe is not None))(layer_keys)
     params: Params = {
         "embed": (jax.random.normal(k_embed, (cfg.vocab_size, d), jnp.float32)
                   * 0.02).astype(pdt),
-        "layers": jax.vmap(layer)(layer_keys),
+        "layers": layers,
         "final_norm": jnp.zeros((d,), pdt),
     }
     if not cfg.tie_embeddings:
@@ -110,46 +138,60 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     return params
 
 
-def logical_axes(cfg: ModelConfig) -> Params:
-    """Pytree of logical axis names matching init_params' structure."""
-    if cfg.moe is None:
+def _layer_axes(cfg: ModelConfig, moe_layer: bool, lead=("layers",)) -> dict:
+    """Axes for one layer stack; `lead` is the stacking prefix."""
+    if not moe_layer:
         mlp_axes = {
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
+            "w_gate": (*lead, "embed", "mlp"),
+            "w_up": (*lead, "embed", "mlp"),
+            "w_down": (*lead, "mlp", "embed"),
         }
     else:
         mlp_axes = {
-            "w_router": ("layers", "embed", None),
-            "w_gate": ("layers", "experts", "embed", "mlp"),
-            "w_up": ("layers", "experts", "embed", "mlp"),
-            "w_down": ("layers", "experts", "mlp", "embed"),
+            "w_router": (*lead, "embed", None),
+            "w_gate": (*lead, "experts", "embed", "mlp"),
+            "w_up": (*lead, "experts", "embed", "mlp"),
+            "w_down": (*lead, "experts", "mlp", "embed"),
         }
         if cfg.moe.num_shared_experts > 0:
             mlp_axes.update({
-                "w_gate_shared": ("layers", "embed", "mlp"),
-                "w_up_shared": ("layers", "embed", "mlp"),
-                "w_down_shared": ("layers", "mlp", "embed"),
+                "w_gate_shared": (*lead, "embed", "mlp"),
+                "w_up_shared": (*lead, "embed", "mlp"),
+                "w_down_shared": (*lead, "mlp", "embed"),
             })
     bias_axes = {}
     if cfg.attn_bias:
         bias_axes = {
-            "bq": ("layers", "heads"),
-            "bk": ("layers", "kv_heads"),
-            "bv": ("layers", "kv_heads"),
+            "bq": (*lead, "heads"),
+            "bk": (*lead, "kv_heads"),
+            "bv": (*lead, "kv_heads"),
         }
+    return {
+        "attn_norm": (*lead, None),
+        "wq": (*lead, "embed", "heads"),
+        "wk": (*lead, "embed", "kv_heads"),
+        "wv": (*lead, "embed", "kv_heads"),
+        "wo": (*lead, "heads", "embed"),
+        "mlp_norm": (*lead, None),
+        **bias_axes,
+        **mlp_axes,
+    }
+
+
+def logical_axes(cfg: ModelConfig) -> Params:
+    """Pytree of logical axis names matching init_params' structure."""
+    if grouped_moe(cfg):
+        layers = {
+            # Group axis maps like "layers" (pp shards it); the dense
+            # sub-layer axis inside a group is unsharded.
+            "dense": _layer_axes(cfg, False, lead=("layers", None)),
+            "moe": _layer_axes(cfg, True),
+        }
+    else:
+        layers = _layer_axes(cfg, cfg.moe is not None)
     la: Params = {
         "embed": ("vocab", "embed"),
-        "layers": {
-            "attn_norm": ("layers", None),
-            "wq": ("layers", "embed", "heads"),
-            "wk": ("layers", "embed", "kv_heads"),
-            "wv": ("layers", "embed", "kv_heads"),
-            "wo": ("layers", "heads", "embed"),
-            "mlp_norm": ("layers", None),
-            **bias_axes,
-            **mlp_axes,
-        },
+        "layers": layers,
         "final_norm": (None,),
     }
     if not cfg.tie_embeddings:
@@ -217,6 +259,7 @@ def _zero_aux():
 def _block(
     cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None,
     fresh_cache: bool = False, segments=None, page_tables=None,
+    moe_layer=None,
 ):
     """One pre-norm transformer block. x: (B, S, D) in compute dtype.
 
@@ -234,11 +277,16 @@ def _block(
     b, s, d = x.shape
     h, hkv, dh = cfg.n_heads, cfg.kv_heads, cfg.dim_per_head
 
+    def pdot(xin, w):
+        # Dense projection: bf16 matmul, or an int8 MXU dot when the
+        # training step opted in (cfg.quant_training, ops/qtrain.py).
+        return quant_dot(xin, materialize(w, cdt), cfg.quant_training)
+
     # --- attention ---
     hx = rms_norm(x, lp["attn_norm"], cfg.norm_eps).astype(cdt)
-    q = hx @ materialize(lp["wq"], cdt)
-    k = hx @ materialize(lp["wk"], cdt)
-    v = hx @ materialize(lp["wv"], cdt)
+    q = pdot(hx, lp["wq"])
+    k = pdot(hx, lp["wk"])
+    v = pdot(hx, lp["wv"])
     if cfg.attn_bias:
         q = q + lp["bq"].astype(cdt)
         k = k + lp["bk"].astype(cdt)
@@ -316,7 +364,7 @@ def _block(
             paged_update_layer,
         )
 
-        pool_k, pool_v, index, q_positions = cache  # pool: (nb, bs, H, D)
+        pool_k, pool_v, index, q_positions = cache  # pool: (nb, Hkv, bs, D)
         pool_k, pool_v = paged_update_layer(
             pool_k, pool_v, k, v, index, page_tables
         )
@@ -354,13 +402,16 @@ def _block(
                 q, cache_k, cache_v, index,
                 window=cfg.attn_window, impl=attn_impl,
             )
-    o = o.reshape(b, s, h * dh) @ materialize(lp["wo"], cdt)
+    o = pdot(o.reshape(b, s, h * dh), lp["wo"])
     x = x + constrain(o, mesh, ("batch", "seq", None))
 
     # --- mlp ---
     hx = rms_norm(x, lp["mlp_norm"], cfg.norm_eps).astype(cdt)
     moe_out = _zero_aux()
-    if cfg.moe is not None:
+    # moe_layer overrides the config for interleaved stacks (grouped_moe):
+    # dense sub-layers of a MoE model run the plain gated MLP.
+    use_moe = cfg.moe is not None if moe_layer is None else moe_layer
+    if use_moe:
         from shellac_tpu.ops.moe import moe_ffn
 
         # Single-token decode must never capacity-drop: a dropped token's
@@ -384,11 +435,11 @@ def _block(
             "dropped_frac": metrics["moe_dropped_frac"],
         }
     else:
-        gate = hx @ materialize(lp["w_gate"], cdt)
-        up = hx @ materialize(lp["w_up"], cdt)
+        gate = pdot(hx, lp["w_gate"])
+        up = pdot(hx, lp["w_up"])
         gate = constrain(gate, mesh, ("batch", "seq", "mlp"))
         up = constrain(up, mesh, ("batch", "seq", "mlp"))
-        down = _gated_act(cfg)(gate, up) @ materialize(lp["w_down"], cdt)
+        down = pdot(_gated_act(cfg)(gate, up), lp["w_down"])
     x = x + constrain(down, mesh, ("batch", "seq", None))
     return x, new_cache, moe_out
 
@@ -454,11 +505,14 @@ def forward(
         # int32 ids.
         segment_ids = constrain(segment_ids, mesh, ("batch", None))
 
-    block = functools.partial(
-        _block, cfg, mesh, attn_impl, segments=segment_ids
-    )
-    if cfg.remat:
-        block = jax.checkpoint(block, policy=_remat_policy(cfg.remat_policy))
+    def make_block(moe_flag):
+        blk = functools.partial(
+            _block, cfg, mesh, attn_impl, segments=segment_ids,
+            moe_layer=moe_flag,
+        )
+        if cfg.remat:
+            blk = jax.checkpoint(blk, policy=_remat_policy(cfg.remat_policy))
+        return blk
 
     from shellac_tpu.parallel.mesh import AXIS_PIPE
 
@@ -466,18 +520,16 @@ def forward(
     if pp > 1:
         from shellac_tpu.parallel.pipeline import pipeline_apply
 
+        if grouped_moe(cfg):
+            raise NotImplementedError(
+                "pipeline parallelism over interleaved dense/MoE stacks "
+                "(moe_every > 1) is not supported yet; use fsdp/tp/sp "
+                "axes, or moe_every=1"
+            )
         if cfg.n_layers % pp:
             raise ValueError(
                 f"n_layers={cfg.n_layers} not divisible by pp={pp}"
             )
-        # Microbatches see a slice of the batch; RoPE tables must
-        # broadcast across that slice, so positions must be uniform.
-        if positions is not None or segment_ids is not None:
-            raise NotImplementedError(
-                "custom positions / packed segments are not supported "
-                "with pp > 1"
-            )
-        cos, sin = cos[:1], sin[:1]  # (1, S, half) broadcasts over B_m
         lps = cfg.n_layers // pp
         stage_params = jax.tree.map(
             lambda p: p.reshape(pp, lps, *p.shape[1:]), params["layers"]
@@ -485,20 +537,64 @@ def forward(
 
         aux0 = _zero_aux()
 
-        def stage_fn(sp_lp, x):
+        # The block partial above binds the whole-batch segment row;
+        # microbatches see a slice of the batch, so the pipeline needs
+        # an unbound block whose RoPE tables / segment ids ride WITH
+        # each microbatch through the stage shift register.
+        def pp_block_raw(x, lp, cos_m, sin_m, seg_m):
+            return _block(
+                cfg, mesh, attn_impl, x, lp, cos_m, sin_m, segments=seg_m
+            )
+
+        pp_block = (
+            jax.checkpoint(pp_block_raw, policy=_remat_policy(cfg.remat_policy))
+            if cfg.remat
+            else pp_block_raw
+        )
+
+        ragged = positions is not None or segment_ids is not None
+        if ragged:
+            extras = {"cos": cos, "sin": sin}
+            extras_axes = {
+                "cos": ("batch", "seq", None),
+                "sin": ("batch", "seq", None),
+            }
+            if segment_ids is not None:
+                # Keep the sp replication set up above: sharding seg
+                # over "seq" here would reintroduce the per-layer sp
+                # all-gather inside every pipeline tick.
+                extras["seg"] = segment_ids
+                extras_axes["seg"] = ("batch", None)
+        else:
+            extras = extras_axes = None
+            # Uniform positions: a (1, S, half) table broadcasts over
+            # every microbatch — cheaper than shifting per-row tables.
+            cos, sin = cos[:1], sin[:1]
+
+        def run_stack(sp_lp, x, cos_m, sin_m, seg_m):
             def body(carry, lp):
                 x, acc = carry
-                x, _, moe_out = block(x, lp, cos, sin)
+                x, _, moe_out = pp_block(x, lp, cos_m, sin_m, seg_m)
                 acc = jax.tree.map(lambda a, b: a + b, acc, moe_out)
                 return (x, acc), None
 
             (x, acc), _ = jax.lax.scan(body, (x, aux0), sp_lp)
             return x, acc
 
+        if ragged:
+            def stage_fn(sp_lp, x, ex):
+                return run_stack(
+                    sp_lp, x, ex["cos"], ex["sin"], ex.get("seg")
+                )
+        else:
+            def stage_fn(sp_lp, x):
+                return run_stack(sp_lp, x, cos, sin, None)
+
         n_micro = pipeline_microbatches or pp
         x, aux_sum = pipeline_apply(
             stage_fn, stage_params, x,
             n_stages=pp, n_micro=n_micro, mesh=mesh, aux_init=aux0,
+            extras=extras, extras_axes=extras_axes,
         )
         # aux_sum holds every (layer, microbatch) contribution once.
         # The aux loss is the per-microbatch estimate averaged over
@@ -513,8 +609,36 @@ def forward(
             "router_z_loss": aux_sum["router_z_loss"] * inv_lm,
             "dropped_frac": aux_sum["dropped_frac"] * inv_lm,
         }
+    elif grouped_moe(cfg):
+        aux0 = _zero_aux()
+        blk_d, blk_m = make_block(False), make_block(True)
+        add = lambda a, b: jax.tree.map(lambda u, v: u + v, a, b)
+
+        def group_body(carry, glp):
+            x, acc = carry
+
+            def dense_body(c2, lp):
+                x2, acc2 = c2
+                x2, _, mo = blk_d(x2, lp, cos, sin)
+                return (x2, add(acc2, mo)), None
+
+            (x, acc), _ = jax.lax.scan(dense_body, (x, acc), glp["dense"])
+            x, _, mo = blk_m(x, glp["moe"], cos, sin)
+            return (x, add(acc, mo)), None
+
+        (x, aux_acc), _ = jax.lax.scan(group_body, (x, aux0), params["layers"])
+        # Aux loss sums over MoE layers; diagnostics average over the
+        # layers that actually have routers (one per group).
+        inv_l = cfg.moe_every / cfg.n_layers
+        aux = {
+            "aux": aux_acc["aux"],
+            "balance_loss": aux_acc["balance_loss"] * inv_l,
+            "router_z_loss": aux_acc["router_z_loss"] * inv_l,
+            "dropped_frac": aux_acc["dropped_frac"] * inv_l,
+        }
     else:
         aux0 = _zero_aux()
+        block = make_block(None)
 
         def scan_body(carry, lp):
             x, acc = carry
@@ -587,18 +711,54 @@ def forward_with_cache(
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))
 
-    def scan_body(x, layer_in):
-        lp, ck, cv = layer_in
-        x, new_cache, _ = _block(
+    tables = cache.tables if paged else None
+
+    def run_block(x, lp, ck, cv, moe_flag):
+        return _block(
             cfg, mesh, attn_impl, x, lp, cos, sin,
             cache=(ck, cv, index, positions), fresh_cache=fresh_cache,
-            page_tables=cache.tables if paged else None,
+            page_tables=tables, moe_layer=moe_flag,
         )
-        return x, new_cache
 
-    x, (new_k, new_v) = jax.lax.scan(
-        scan_body, x, (params["layers"], cache.k, cache.v)
-    )
+    if grouped_moe(cfg):
+        every = cfg.moe_every
+        ng = cfg.n_layers // every
+        ckr = cache.k.reshape(ng, every, *cache.k.shape[1:])
+        cvr = cache.v.reshape(ng, every, *cache.v.shape[1:])
+
+        def group_body(x, inp):
+            glp, ckg, cvg = inp
+
+            def dense_body(x2, li):
+                lp, ck, cv = li
+                x2, nc, _ = run_block(x2, lp, ck, cv, False)
+                return x2, nc
+
+            x, (nk_d, nv_d) = jax.lax.scan(
+                dense_body, x,
+                (glp["dense"], ckg[: every - 1], cvg[: every - 1]),
+            )
+            x, (nk_m, nv_m), _ = run_block(
+                x, glp["moe"], ckg[every - 1], cvg[every - 1], True
+            )
+            nk = jnp.concatenate([nk_d, nk_m[None]], axis=0)
+            nv = jnp.concatenate([nv_d, nv_m[None]], axis=0)
+            return x, (nk, nv)
+
+        x, (nk, nv) = jax.lax.scan(
+            group_body, x, (params["layers"], ckr, cvr)
+        )
+        new_k = nk.reshape(cfg.n_layers, *cache.k.shape[1:])
+        new_v = nv.reshape(cfg.n_layers, *cache.v.shape[1:])
+    else:
+        def scan_body(x, layer_in):
+            lp, ck, cv = layer_in
+            x, new_cache, _ = run_block(x, lp, ck, cv, None)
+            return x, new_cache
+
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_body, x, (params["layers"], cache.k, cache.v)
+        )
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
     if cfg.tie_embeddings:

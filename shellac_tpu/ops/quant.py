@@ -130,6 +130,11 @@ def quantize_params(
             f"unknown quantization targets {sorted(unknown)}; "
             f"have {sorted(DENSE_TARGETS)}"
         )
+    if cfg.moe is not None and cfg.moe_every > 1:
+        raise NotImplementedError(
+            "weight-only quantization of interleaved dense/MoE stacks "
+            "(moe_every > 1) is not supported yet"
+        )
     layers = dict(params["layers"])
     for t in targets:
         if t not in layers:

@@ -34,8 +34,20 @@ _MICRO_AXES = (None, "batch", "seq", None)
 _STAGE_AXES = ("layers", "batch", "seq", None)
 
 
+def _micro_extra_axes(r, leaf_axes=None):
+    """Logical axes for a microbatched extras leaf (n_micro, bm, ...).
+
+    Default: batch/seq shard like the activations, trailing dims
+    unsharded. `leaf_axes` overrides the per-row axes (everything after
+    the microbatch dim) — e.g. packed segment ids want ("batch", None)
+    so the sp replication the model set up survives microbatching."""
+    if leaf_axes is not None:
+        return (None, *leaf_axes)
+    return (None, "batch", "seq") + (None,) * (r.ndim - 3)
+
+
 def pipeline_apply(
-    stage_fn: Callable,  # (stage_params, x (B_m, S, D)) -> (B_m, S, D)
+    stage_fn: Callable,  # (stage_params, x (B_m, S, D)[, extras]) -> ...
     stage_params,  # pytree, leaves (pp, ...) sharded over "pp"
     x: jax.Array,  # (B, S, D)
     *,
@@ -43,6 +55,8 @@ def pipeline_apply(
     n_micro: int,
     mesh: Mesh,
     aux_init=None,  # pytree of scalar zeros; stage_fn then returns (y, aux)
+    extras=None,  # pytree of per-token arrays (B, S, ...) riding with x
+    extras_axes=None,  # optional pytree of logical axes per extras leaf
 ):
     """Run the stage pipeline; returns outputs, or (outputs, aux_sum).
 
@@ -51,6 +65,11 @@ def pipeline_apply(
     ticks — stages holding no live microbatch during warmup/drain —
     are masked out; the result sums every (stage, microbatch) pair's
     aux exactly once.
+
+    With `extras`, each leaf (B, S, ...) is microbatched alongside x
+    and shifted through the same stage register, so stage_fn(sp, x, ex)
+    sees exactly the rows it is processing — this is how packed
+    segment ids and per-row RoPE tables ride the pipeline.
     """
     b, s, d = x.shape
     if b % n_micro:
@@ -60,17 +79,42 @@ def pipeline_apply(
     micro = constrain(x.reshape(n_micro, bm, s, d), mesh, _MICRO_AXES)
     stage_ids = jnp.arange(n_stages)
 
-    def tick(carry, t):
-        stages_x, outputs, aux_acc = carry
-        inp0 = jax.lax.dynamic_index_in_dim(
-            micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+    def micro_extras_leaf(a, la=None):
+        r = a.reshape(n_micro, bm, *a.shape[1:])
+        return constrain(r, mesh, _micro_extra_axes(r, la))
+
+    if extras is None:
+        micro_ex = None
+    elif extras_axes is not None:
+        micro_ex = jax.tree.map(
+            micro_extras_leaf, extras, extras_axes,
+            is_leaf=lambda x: isinstance(x, tuple),
         )
+    else:
+        micro_ex = jax.tree.map(micro_extras_leaf, extras)
+
+    def tick(carry, t):
+        stages_x, stages_ex, outputs, aux_acc = carry
+        ti = jnp.clip(t, 0, n_micro - 1)
+        inp0 = jax.lax.dynamic_index_in_dim(micro, ti, 0, keepdims=False)
         shifted = jnp.roll(stages_x, 1, axis=0).at[0].set(inp0)
         shifted = constrain(shifted, mesh, _STAGE_AXES)
-        if aux_init is None:
-            y = jax.vmap(stage_fn)(stage_params, shifted)
+        if stages_ex is not None:
+            shifted_ex = jax.tree.map(
+                lambda buf, m: jnp.roll(buf, 1, axis=0).at[0].set(
+                    jax.lax.dynamic_index_in_dim(m, ti, 0, keepdims=False)
+                ),
+                stages_ex, micro_ex,
+            )
+            call = lambda sp, xx, ex: stage_fn(sp, xx, ex)
+            res = jax.vmap(call)(stage_params, shifted, shifted_ex)
         else:
-            y, aux = jax.vmap(stage_fn)(stage_params, shifted)  # aux: (pp,)
+            shifted_ex = None
+            res = jax.vmap(stage_fn)(stage_params, shifted)
+        if aux_init is None:
+            y = res
+        else:
+            y, aux = res  # aux: (pp,)
             # Stage s processes microbatch t - s; outside [0, n_micro)
             # it is chewing on bubble zeros and its aux is garbage.
             m = t - stage_ids
@@ -86,15 +130,24 @@ def pipeline_apply(
         prev = jax.lax.dynamic_index_in_dim(outputs, safe, 0, keepdims=False)
         val = jnp.where(out_idx >= 0, y[-1], prev)
         outputs = jax.lax.dynamic_update_index_in_dim(outputs, val, safe, 0)
-        return (y, outputs, aux_acc), None
+        return (y, shifted_ex, outputs, aux_acc), None
 
     stages0 = constrain(
         jnp.zeros((n_stages, bm, s, d), x.dtype), mesh, _STAGE_AXES
     )
+    stages_ex0 = (
+        jax.tree.map(
+            lambda m: jnp.zeros((n_stages, *m.shape[1:]), m.dtype), micro_ex
+        )
+        if micro_ex is not None
+        else None
+    )
     out0 = constrain(jnp.zeros((n_micro, bm, s, d), x.dtype), mesh, _MICRO_AXES)
     aux0 = jax.tree.map(jnp.asarray, aux_init) if aux_init is not None else 0.0
     ticks = jnp.arange(n_micro + n_stages - 1)
-    (_, outputs, aux_sum), _ = jax.lax.scan(tick, (stages0, out0, aux0), ticks)
+    (_, _, outputs, aux_sum), _ = jax.lax.scan(
+        tick, (stages0, stages_ex0, out0, aux0), ticks
+    )
     outputs = outputs.reshape(b, s, d)
     if aux_init is None:
         return outputs

@@ -74,6 +74,11 @@ class LoRAConfig:
                 f"LoRA on MoE expert weights ({sorted(mlp_targets)}) is not "
                 "supported; target attention projections instead"
             )
+        if model_cfg.moe is not None and model_cfg.moe_every > 1:
+            raise NotImplementedError(
+                "LoRA over interleaved dense/MoE stacks (moe_every > 1) "
+                "is not supported; use moe_every=1"
+            )
         if self.rank < 1:
             raise ValueError(f"rank must be >= 1, got {self.rank}")
         return self

@@ -66,6 +66,11 @@ def make_train_step(
     With grad_accum > 1 the leading batch dim is split into microbatches
     scanned sequentially, accumulating grads in fp32.
     """
+    if train_cfg.quant is not None:
+        # Opt into quantized compute for this step's forward only; the
+        # model config itself (and any checkpoint metadata derived from
+        # it) stays unquantized.
+        model_cfg = model_cfg.replace(quant_training=train_cfg.quant).validate()
     optimizer = make_optimizer(train_cfg)
     accum = train_cfg.grad_accum
 
