@@ -167,6 +167,56 @@ def flash_train_cases(checks):
             )
 
 
+def head_dim_64_cases(checks):
+    """dh=64 (Qwen2-0.5B class) through both kernel families compiled."""
+    from shellac_tpu.ops.attention import attention_ref
+    from shellac_tpu.ops.decode_attention import _decode_ref, decode_attention
+    from shellac_tpu.ops.flash_attention import flash_attention
+
+    B, L, H, HKV, D = 2, 512, 8, 4, 64
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.bfloat16)
+    ck = jax.random.normal(ks[1], (B, HKV, L, D), jnp.bfloat16)
+    cv = jax.random.normal(ks[2], (B, HKV, L, D), jnp.bfloat16)
+    index = jnp.array([33, L - 1], jnp.int32)
+    out = decode_attention(q, ck, cv, index, impl="flash", interpret=False)
+    ref = _decode_ref(q, ck, cv, index, None, D ** -0.5)
+    check(
+        "dense dh=64",
+        out.astype(jnp.float32), ref.astype(jnp.float32),
+        atol=2e-2, checks=checks,
+    )
+
+    S = 1024
+    qf = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    kf = jax.random.normal(ks[1], (B, S, HKV, D), jnp.bfloat16)
+    vf = jax.random.normal(ks[2], (B, S, HKV, D), jnp.bfloat16)
+    out = flash_attention(qf, kf, vf, causal=True, interpret=False)
+    ref = attention_ref(qf, kf, vf, causal=True)
+    check(
+        "flash fwd dh=64",
+        out.astype(jnp.float32), ref.astype(jnp.float32),
+        atol=2e-2, checks=checks,
+    )
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True, interpret=False) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(qf, kf, vf)
+    gr = jax.grad(
+        lambda q, k, v: jnp.sum(attention_ref(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2),
+    )(qf, kf, vf)
+    for name, a, b in zip("dq dk dv".split(), gf, gr):
+        scale = max(1.0, float(jnp.max(jnp.abs(b.astype(jnp.float32)))))
+        check(
+            f"flash bwd dh=64 {name}",
+            a.astype(jnp.float32) / scale, b.astype(jnp.float32) / scale,
+            atol=3e-2, checks=checks,
+        )
+
+
 def main():
     backend = jax.default_backend()
     if backend != "tpu":
@@ -176,6 +226,7 @@ def main():
     dense_decode_cases(checks)
     paged_decode_cases(checks)
     flash_train_cases(checks)
+    head_dim_64_cases(checks)
     print(json.dumps({"ok": True, "backend": backend, "checks": checks}))
 
 

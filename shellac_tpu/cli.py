@@ -121,7 +121,7 @@ def _train_config(args):
 
     kw = {}
     for field in ("learning_rate", "warmup_steps", "weight_decay",
-                  "grad_accum", "seed", "optimizer"):
+                  "grad_accum", "seed", "optimizer", "quant"):
         v = getattr(args, field, None)
         if v is not None:
             kw[field] = v
@@ -352,6 +352,8 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--weight-decay", type=float, dest="weight_decay")
     t.add_argument("--grad-accum", type=int, dest="grad_accum")
     t.add_argument("--optimizer", choices=["adamw", "lion", "adafactor"])
+    t.add_argument("--quant", choices=["int8"], default=None,
+                   help="quantized training compute (int8 MXU dots)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="perplexity of a checkpoint")

@@ -325,3 +325,58 @@ class TestActivations:
         x = jnp.linspace(-1000, 1000, 101)
         y = softcap(x, 30.0)
         assert float(jnp.max(jnp.abs(y))) <= 30.0
+
+
+class TestFlashDispatch:
+    """flash_supported gating logic on a Pallas-capable backend
+    (monkeypatched): what reaches the kernel vs falls back to ref."""
+
+    def _sup(self, monkeypatch, **kw):
+        import importlib
+
+        # ops/__init__ re-exports a same-named function, which shadows
+        # the submodule on attribute-style imports.
+        fa = importlib.import_module("shellac_tpu.ops.flash_attention")
+        monkeypatch.setattr(fa, "pallas_supported", lambda: True)
+        q = jnp.zeros(kw.pop("q_shape", (2, 256, 8, 128)))
+        k = jnp.zeros(kw.pop("kv_shape", (2, 256, 4, 128)))
+        return fa.flash_supported(q, k, k, causal=kw.pop("causal", True), **kw)
+
+    def test_plain_causal(self, monkeypatch):
+        assert self._sup(monkeypatch)
+
+    def test_window_ok(self, monkeypatch):
+        assert self._sup(monkeypatch, window=128)
+
+    def test_segments_ok(self, monkeypatch):
+        seg = jnp.zeros((2, 256), jnp.int32)
+        assert self._sup(monkeypatch, q_segments=seg, kv_segments=seg)
+
+    def test_window_and_segments_ok(self, monkeypatch):
+        seg = jnp.zeros((2, 256), jnp.int32)
+        assert self._sup(
+            monkeypatch, window=64, q_segments=seg, kv_segments=seg
+        )
+
+    def test_distinct_seg_arrays_fall_back(self, monkeypatch):
+        a = jnp.zeros((2, 256), jnp.int32)
+        b = jnp.zeros((2, 256), jnp.int32)
+        assert not self._sup(monkeypatch, q_segments=a, kv_segments=b)
+
+    def test_head_dim_64_ok(self, monkeypatch):
+        assert self._sup(
+            monkeypatch, q_shape=(2, 256, 8, 64), kv_shape=(2, 256, 4, 64)
+        )
+
+    def test_head_dim_96_falls_back(self, monkeypatch):
+        assert not self._sup(
+            monkeypatch, q_shape=(2, 256, 8, 96), kv_shape=(2, 256, 4, 96)
+        )
+
+    def test_positions_fall_back(self, monkeypatch):
+        assert not self._sup(
+            monkeypatch, q_positions=jnp.zeros((2, 256), jnp.int32)
+        )
+
+    def test_noncausal_falls_back(self, monkeypatch):
+        assert not self._sup(monkeypatch, causal=False)
