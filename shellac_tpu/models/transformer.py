@@ -304,17 +304,11 @@ def _block(
         from shellac_tpu.parallel.mesh import AXIS_SEQ
 
         sp_active = mesh is not None and mesh.shape.get(AXIS_SEQ, 1) > 1
-        if attn_impl in ("ring", "ulysses"):
-            if not sp_active:
-                raise ValueError(
-                    f"attn_impl={attn_impl!r} requires a mesh with sp > 1; got "
-                    f"mesh={'None' if mesh is None else dict(mesh.shape)}"
-                )
-            if attn_impl == "ring" and cfg.attn_window is not None:
-                raise NotImplementedError(
-                    "ring attention does not support sliding windows; "
-                    "use attn_impl='ulysses'"
-                )
+        if attn_impl in ("ring", "ulysses") and not sp_active:
+            raise ValueError(
+                f"attn_impl={attn_impl!r} requires a mesh with sp > 1; got "
+                f"mesh={'None' if mesh is None else dict(mesh.shape)}"
+            )
         from shellac_tpu.parallel.ulysses import ulysses_supported
 
         ulysses_ok = sp_active and ulysses_supported(h, hkv, mesh)
@@ -324,17 +318,18 @@ def _block(
                 f"by sp: n_heads={h}, n_kv_heads={hkv}, "
                 f"mesh={dict(mesh.shape)}"
             )
-        # 'auto' on an sp mesh: ring for plain causal (O(S/sp) kv memory),
-        # ulysses for windowed attention (full local sequence, so the
-        # window mask applies directly); dense fallback only when neither
-        # can express the config (GSPMD gathers the sequence — slower,
-        # but the config keeps working).
-        use_ring = attn_impl == "ring" or (
-            attn_impl == "auto" and sp_active and cfg.attn_window is None
-        )
+        # 'auto' on an sp mesh: ring for plain causal (O(S/sp) kv
+        # memory), ulysses for windowed attention when head counts
+        # permit (full local sequence -> the flash kernel's window
+        # block-skipping applies); ring handles windows too (banded
+        # mask on global positions), so it is the windowed fallback
+        # when ulysses can't split the heads.
         use_ulysses = attn_impl == "ulysses" or (
             attn_impl == "auto" and sp_active and cfg.attn_window is not None
             and ulysses_ok
+        )
+        use_ring = attn_impl == "ring" or (
+            attn_impl == "auto" and sp_active and not use_ulysses
         )
         if use_ring:
             # Sequence is sharded over sp: ring attention keeps kv local
@@ -344,7 +339,8 @@ def _block(
             from shellac_tpu.parallel.ring_attention import ring_attention
 
             o = ring_attention(
-                q, k, v, mesh, causal=cfg.causal, segments=segments
+                q, k, v, mesh, causal=cfg.causal, segments=segments,
+                window=cfg.attn_window,
             )
         elif use_ulysses:
             from shellac_tpu.parallel.ulysses import ulysses_attention

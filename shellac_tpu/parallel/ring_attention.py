@@ -10,9 +10,12 @@ neighbor links.
 
 Causality is enforced at two granularities: whole visiting chunks from
 the future are masked out, and the diagonal (own) chunk gets the usual
-triangular mask. Backward is jax autodiff through the scan; wrap the
-caller in jax.checkpoint (the model's remat does) to keep residuals per
-layer instead of per ring step.
+triangular mask. Sliding windows add a global-position band mask per
+visiting chunk (chunks wholly outside the window contribute nothing via
+the mask; the rotation itself stays uniform, which is what lax.scan
+wants). Backward is jax autodiff through the scan; wrap the caller in
+jax.checkpoint (the model's remat does) to keep residuals per layer
+instead of per ring step.
 """
 
 from __future__ import annotations
@@ -62,7 +65,7 @@ def _block_stats(q, k, v, scale, mask):
 
 def _ring_local(
     q, k, v, seg, *, axis_name: str, causal: bool, scale: float,
-    has_segments: bool,
+    has_segments: bool, window=None,
 ):
     """Runs on one device inside shard_map. q (B,S_loc,H,D); k,v
     (B,S_loc,Hkv,D); seg (B,S_loc) int32 (packed document ids; a dummy
@@ -94,6 +97,12 @@ def _ring_local(
             )
         else:
             block_mask = None
+        if window is not None:
+            # Global positions: rank r's rows sit at r*s_loc + i.
+            qpos = my * s_loc + jnp.arange(s_loc)
+            kpos = src * s_loc + jnp.arange(s_loc)
+            wmask = qpos[:, None] - kpos[None, :] < window  # (Sq, Sk)
+            block_mask = wmask if block_mask is None else block_mask & wmask
         if has_segments:
             # Packed documents: attend only within the same segment. The
             # segment ids rotate with their kv chunk, so the pairing is
@@ -136,6 +145,7 @@ def ring_attention(
     causal: bool = True,
     scale: Optional[float] = None,
     segments: Optional[jax.Array] = None,  # (B, S) packed document ids
+    window: Optional[int] = None,
     axis_name: str = AXIS_SEQ,
 ) -> jax.Array:
     """Sequence-parallel attention. q (B,S,H,D); k,v (B,S,Hkv,D).
@@ -143,7 +153,8 @@ def ring_attention(
     S is globally sharded over `axis_name`; batch over dp/fsdp; heads
     over tp. Returns (B,S,H,D) with the same sharding as q. With
     `segments`, attention is block-diagonal over packed documents (the
-    ids rotate around the ring with their kv chunk).
+    ids rotate around the ring with their kv chunk). With `window`,
+    attention is banded on global positions (qpos - kpos < window).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -156,7 +167,7 @@ def ring_attention(
     fn = shard_map(
         functools.partial(
             _ring_local, axis_name=axis_name, causal=causal,
-            scale=float(scale), has_segments=has_segments,
+            scale=float(scale), has_segments=has_segments, window=window,
         ),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, seg_spec),

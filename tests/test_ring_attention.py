@@ -67,8 +67,24 @@ class TestRingAttention:
             np.asarray(dense), np.asarray(ringed), rtol=1e-4, atol=1e-4
         )
 
-    def test_window_with_sp_falls_back_to_dense(self, mesh_sp4):
-        """auto + sliding window on an sp mesh must still work (dense path)."""
+    @pytest.mark.parametrize("window", [1, 5, 16, 40])
+    def test_window_matches_ref(self, mesh_sp4, window):
+        """Banded (sliding-window) masking across rotating chunks."""
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.normal(size=(2, 64, 4, 32)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 64, 2, 32)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 64, 2, 32)).astype(np.float32))
+        got = jax.jit(
+            lambda q, k, v: ring_attention(q, k, v, mesh_sp4, window=window)
+        )(q, k, v)
+        want = attention_ref(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+
+    def test_window_with_sp_uses_ring(self, mesh_sp4):
+        """auto + sliding window on an sp mesh: ulysses can't split 4
+        heads over sp=4 after tp=2, so ring (banded) carries it."""
         cfg = get_model_config("tiny").replace(attn_window=8, dtype="float32")
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
@@ -79,11 +95,15 @@ class TestRingAttention:
         np.testing.assert_allclose(
             np.asarray(dense), np.asarray(sharded), rtol=1e-4, atol=1e-4
         )
-        # Explicit ring with a window is a contradiction -> error.
-        with pytest.raises(NotImplementedError):
-            transformer.forward(
-                cfg, params, tokens, mesh=mesh_sp4, attn_impl="ring"
+        # Explicit ring with a window now also works.
+        ringed = jax.jit(
+            lambda p, t: transformer.forward(
+                cfg, p, t, mesh=mesh_sp4, attn_impl="ring"
             )
+        )(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(ringed), rtol=1e-4, atol=1e-4
+        )
 
     def test_ring_without_sp_raises(self):
         cfg = get_model_config("tiny").replace(dtype="float32")
