@@ -46,7 +46,18 @@ def tpu_usable(timeout_s: float = 90.0, retries: int = 1) -> bool:
     return False
 
 
-def main():
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    # Variant flags for perf investigation; the driver runs plain
+    # `python bench.py`, which keeps the headline recipe unchanged.
+    ap.add_argument("--packed", action="store_true",
+                    help="packed-sequence batch (segment_ids set)")
+    ap.add_argument("--quant", choices=["int8"], default=None)
+    ap.add_argument("--batch", type=int, default=None)
+    args = ap.parse_args(argv)
+
     if not tpu_usable():
         # Relay down or no TPU attached: pin CPU before backend init so
         # the main process cannot hang where the probe did.
@@ -70,13 +81,26 @@ def main():
         cfg = get_model_config("tiny")
         batch, seq, steps = 4, 128, 3
 
-    tcfg = TrainConfig(warmup_steps=10, total_steps=1000)
+    if args.batch is not None:
+        batch = args.batch
+    tcfg = TrainConfig(warmup_steps=10, total_steps=1000, quant=args.quant)
     key = jax.random.PRNGKey(0)
     state = init_train_state(cfg, tcfg, key)
     step = make_train_step(cfg, tcfg)
 
     tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 0, cfg.vocab_size)
     batch_data = {"inputs": tokens, "targets": tokens}
+    if args.packed:
+        # Four packed documents per row, boundaries off block edges —
+        # the pretraining-default shape; exercises the segment-masked
+        # flash kernel path.
+        import numpy as _np
+
+        bounds = [0, seq // 4 + 37, seq // 2 + 11, 3 * seq // 4 + 5, seq]
+        seg = _np.zeros((batch, seq), _np.int32)
+        for i in range(4):
+            seg[:, bounds[i]:bounds[i + 1]] = i
+        batch_data["segment_ids"] = jax.numpy.asarray(seg)
 
     # Warmup (compile + first step). float() forces a device-to-host
     # transfer: on the axon relay platform block_until_ready alone does
@@ -103,8 +127,12 @@ def main():
     flops_per_token = train_flops_per_token(n_params, cfg.n_layers, cfg.d_model, seq)
     mfu_denom = TPU_V5E_BF16_PEAK_FLOPS if on_tpu else None
 
+    variant = ("_packed" if args.packed else "") + (
+        f"_{args.quant}" if args.quant else ""
+    )
     result = {
-        "metric": f"train_throughput_{cfg.d_model}d{cfg.n_layers}L_seq{seq}_{backend}",
+        "metric": f"train_throughput_{cfg.d_model}d{cfg.n_layers}L_seq{seq}"
+                  f"{variant}_{backend}",
         "value": round(tok_s, 1),
         "unit": "tokens/s",
         "vs_baseline": None,

@@ -191,3 +191,30 @@ class TestQuantizedTraining:
 
         with pytest.raises(ValueError, match="quant_training"):
             get_model_config("tiny").replace(quant_training="fp4").validate()
+
+    def test_quant_train_on_mesh(self, mesh_fsdp8):
+        """int8 training composes with GSPMD sharding (fsdp mesh)."""
+        from shellac_tpu import get_model_config
+        from shellac_tpu.config import TrainConfig
+        from shellac_tpu.training import (
+            batch_shardings,
+            init_train_state,
+            make_train_step,
+        )
+
+        cfg = get_model_config("tiny")
+        tcfg = TrainConfig(quant="int8", warmup_steps=1, total_steps=5)
+        state = init_train_state(
+            cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh_fsdp8
+        )
+        step = make_train_step(cfg, tcfg, mesh=mesh_fsdp8)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size
+        )
+        bs = batch_shardings(mesh_fsdp8)
+        batch = {
+            "inputs": jax.device_put(tokens, bs),
+            "targets": jax.device_put(tokens, bs),
+        }
+        state, m = step(state, batch)
+        assert np.isfinite(float(m["loss"]))
