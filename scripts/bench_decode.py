@@ -36,7 +36,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def build_engine(cfg, params, *, paged, impl, n_slots, max_len):
+def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
+                 decode_ticks=1):
     from shellac_tpu.inference.batching import (
         BatchingEngine,
         PagedBatchingEngine,
@@ -49,19 +50,20 @@ def build_engine(cfg, params, *, paged, impl, n_slots, max_len):
         return PagedBatchingEngine(
             cfg, params, n_slots=n_slots, max_len=max_len,
             block_size=64, pool_tokens=n_slots * max_len,
-            temperature=0.0, attn_impl=impl,
+            temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
         )
     return BatchingEngine(
         cfg, params, n_slots=n_slots, max_len=max_len,
-        temperature=0.0, attn_impl=impl,
+        temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
     )
 
 
 def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
-                 ticks, rng):
+                 ticks, rng, decode_ticks=1):
     """Decode tokens/s with every slot held live at ~ctx context."""
     eng = build_engine(
-        cfg, params, paged=paged, impl=impl, n_slots=n_slots, max_len=max_len
+        cfg, params, paged=paged, impl=impl, n_slots=n_slots,
+        max_len=max_len, decode_ticks=decode_ticks,
     )
     budget = max_len - ctx - 1
     for i in range(n_slots):
@@ -77,13 +79,16 @@ def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
     # the axon platform block_until_ready does not synchronize).
     int(np.asarray(eng._cur)[0])
     dt = time.perf_counter() - t0
-    return n_slots * ticks / dt, dt / ticks
+    tokens = n_slots * ticks * decode_ticks
+    return tokens / dt, dt / ticks
 
 
-def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng):
+def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
+          decode_ticks=1):
     """Drain 3*n_slots ragged requests; tokens/s of generated tokens."""
     eng = build_engine(
-        cfg, params, paged=paged, impl=impl, n_slots=n_slots, max_len=max_len
+        cfg, params, paged=paged, impl=impl, n_slots=n_slots,
+        max_len=max_len, decode_ticks=decode_ticks,
     )
     n_req = 3 * n_slots
     gen_budget = min(64, max(4, (max_len - ctx) // 2))
@@ -183,6 +188,8 @@ def main():
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--ticks", type=int, default=50)
     ap.add_argument("--kernel-iters", type=int, default=200)
+    ap.add_argument("--decode-ticks", type=int, default=1,
+                    help="engine mode: decode steps per host sync")
     ap.add_argument("--mode", default="engine", choices=["engine", "kernel"])
     ap.add_argument("--variants", default="dense:auto,dense:ref,paged:auto,paged:ref")
     args = ap.parse_args()
@@ -239,10 +246,12 @@ def main():
         tok_s, tick_s = steady_state(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, ticks=args.ticks, rng=rng,
+            decode_ticks=args.decode_ticks,
         )
         churn_tok_s, churn_total = churn(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, rng=rng,
+            decode_ticks=args.decode_ticks,
         )
         row = {
             "metric": f"decode_throughput_{args.model}_ctx{args.ctx}_"
@@ -254,6 +263,7 @@ def main():
                 "churn_tokens_s": round(churn_tok_s, 1),
                 "churn_tokens": churn_total,
                 "n_slots": args.slots,
+                "decode_ticks": args.decode_ticks,
             },
         }
         results[variant] = row

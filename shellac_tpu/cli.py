@@ -263,6 +263,7 @@ def cmd_serve(args):
             cfg, params, n_slots=args.slots,
             max_len=args.max_len or cfg.max_seq_len,
             temperature=args.temperature, eos_id=args.eos_id,
+            decode_ticks=args.decode_ticks,
         )
     serve(
         cfg, params,
@@ -271,6 +272,7 @@ def cmd_serve(args):
         engine=engine,
         n_slots=args.slots, max_len=args.max_len,
         temperature=args.temperature, eos_id=args.eos_id,
+        decode_ticks=args.decode_ticks,
     )
     return 0
 
@@ -396,6 +398,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--eos-id", type=int, default=None, dest="eos_id")
     s.add_argument("--paged", action="store_true",
                    help="paged (block-pool) KV cache")
+    s.add_argument("--decode-ticks", type=int, default=1, dest="decode_ticks",
+                   help="decode steps per host sync (throughput vs "
+                        "per-token latency)")
     s.add_argument("--ckpt-dir")
     s.add_argument("--quantize", action="store_true")
     s.add_argument("--tokenizer", default="byte")

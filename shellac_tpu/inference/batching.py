@@ -75,13 +75,17 @@ class BatchingEngine:
         eos_id: Optional[int] = None,
         seed: int = 0,
         attn_impl: str = "auto",
+        decode_ticks: int = 1,
     ):
+        if decode_ticks < 1:
+            raise ValueError(f"decode_ticks must be >= 1, got {decode_ticks}")
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
         self.max_len = max_len or cfg.max_seq_len
         self.eos_id = eos_id
         self.attn_impl = attn_impl
+        self.decode_ticks = decode_ticks
         self._sampler = functools.partial(
             sample, temperature=temperature, top_k=top_k, top_p=top_p
         )
@@ -121,16 +125,34 @@ class BatchingEngine:
         return cache, first
 
     def _decode_impl(self, params, cache, cur, active, key):
-        """One decode tick over every slot; inactive slots frozen."""
-        old_lengths = cache.lengths
-        logits, cache = transformer.forward_with_cache(
-            self.cfg, params, cur[:, None], cache, attn_impl=self.attn_impl
-        )
-        nxt = self._sampler(key, logits[:, 0])
-        lengths = jnp.where(active, cache.lengths, old_lengths)
-        cache = cache.replace(lengths=lengths)
-        nxt = jnp.where(active, nxt, cur)
-        return cache, nxt
+        """decode_ticks decode steps over every slot, ONE host sync.
+
+        Per-tick host reads dominate serving latency when the device is
+        remote (each tick would pay a full RPC round trip); scanning K
+        ticks on device amortizes that K-fold. Slots whose request
+        finishes mid-window keep decoding — the host discards the
+        overshoot tokens, and the slot is released/rewritten afterwards,
+        so the math each request sees is unchanged (tested greedy
+        bit-parity vs the single-request engine). Inactive slots stay
+        frozen. Returns (cache, tokens (K, n_slots)).
+        """
+
+        def tick(carry, key):
+            cache, cur = carry
+            old_lengths = cache.lengths
+            logits, cache = transformer.forward_with_cache(
+                self.cfg, params, cur[:, None], cache,
+                attn_impl=self.attn_impl,
+            )
+            nxt = self._sampler(key, logits[:, 0])
+            lengths = jnp.where(active, cache.lengths, old_lengths)
+            cache = cache.replace(lengths=lengths)
+            nxt = jnp.where(active, nxt, cur)
+            return (cache, nxt), nxt
+
+        keys = jax.random.split(key, self.decode_ticks)
+        (cache, _), toks = jax.lax.scan(tick, (cache, cur), keys)
+        return cache, toks
 
     # ---- scheduling --------------------------------------------------
 
@@ -196,7 +218,8 @@ class BatchingEngine:
                 self._release_slot(i)
 
     def step(self) -> List[Tuple[Any, List[int]]]:
-        """Fill free slots, run one decode tick; returns finished requests."""
+        """Fill free slots, run decode_ticks ticks; returns finished
+        requests. One host sync per call regardless of decode_ticks."""
         finished: List[Tuple[Any, List[int]]] = []
         self._fill_slots()
         # Requests satisfied by prefill alone (max_new=1 or instant EOS).
@@ -207,14 +230,24 @@ class BatchingEngine:
             self._pre_decode(active_rows)
             active = jnp.asarray(active_rows)
             self._key, sub = jax.random.split(self._key)
-            self._cache, nxt = self._decode(
+            self._cache, toks = self._decode(
                 self.params, self._cache, self._cur, active, sub
             )
-            self._cur = nxt
-            host_next = np.asarray(nxt)
+            self._cur = toks[-1]
+            host_toks = np.asarray(toks)  # (K, n_slots) — the one sync
             for i, req in enumerate(self._slots):
-                if req is not None:
-                    req.out.append(int(host_next[i]))
+                if req is None:
+                    continue
+                for t in range(host_toks.shape[0]):
+                    req.out.append(int(host_toks[t, i]))
+                    last = req.out[-1]
+                    if (self.eos_id is not None and last == self.eos_id) or (
+                        len(req.out) >= req.max_new
+                    ):
+                        # Later window tokens are post-EOS/budget
+                        # overshoot; the device kept decoding but the
+                        # request never sees them.
+                        break
             self._finish_check(finished)
         return finished
 
@@ -313,13 +346,21 @@ class PagedBatchingEngine(BatchingEngine):
     def _pre_decode(self, active_rows) -> None:
         # Backstop only — admission already reserved the full footprint.
         # Lengths are tracked on host (prompt + generated so far): no
-        # device sync in the serving hot loop.
+        # device sync in the serving hot loop. A multi-tick window can
+        # write up to decode_ticks positions before the host intervenes;
+        # anything past the request's own footprint lands in scratch
+        # block 0 (post-finish overshoot), so the reservation is capped
+        # at the footprint.
         for i, active in enumerate(active_rows):
             if not active:
                 continue
             req = self._slots[i]
             length = req.tokens.size + len(req.out)
-            if not self._ensure_blocks(i, length + 1):
+            need = min(
+                length + self.decode_ticks,
+                req.tokens.size + req.max_new + 1,
+            )
+            if not self._ensure_blocks(i, need):
                 raise RuntimeError(
                     "paged KV pool exhausted mid-decode; size pool_tokens "
                     "for n_slots concurrent worst-case lengths"

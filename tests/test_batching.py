@@ -95,3 +95,62 @@ class TestContinuousBatching:
             srv.submit("e", np.zeros((0,), np.int32), 4)
         with pytest.raises(ValueError, match="exceeds max_len"):
             srv.submit("big", np.ones((20,), np.int32), 20)
+
+
+class TestMultiTickDecode:
+    """decode_ticks > 1: K decode steps per host sync must be invisible
+    to the math — greedy per-request output identical to the
+    single-request engine, including EOS/budget finishing mid-window."""
+
+    @pytest.mark.parametrize("ticks", [2, 4, 7])
+    def test_matches_engine_through_churn(self, setup, ticks):
+        cfg, params = setup
+        rng = np.random.default_rng(5)
+        reqs = [
+            ("a", rng.integers(0, cfg.vocab_size, 5), 7),
+            ("b", rng.integers(0, cfg.vocab_size, 12), 3),
+            ("c", rng.integers(0, cfg.vocab_size, 3), 10),
+            ("d", rng.integers(0, cfg.vocab_size, 9), 1),
+        ]
+        srv = BatchingEngine(
+            cfg, params, n_slots=2, max_len=64, decode_ticks=ticks
+        )
+        results = srv.run(reqs)
+        for rid, toks, max_new in reqs:
+            assert results[rid] == _ref_generate(cfg, params, toks, max_new), rid
+
+    def test_eos_mid_window_discards_overshoot(self, setup):
+        cfg, params = setup
+        rng = np.random.default_rng(6)
+        toks = rng.integers(0, cfg.vocab_size, 6)
+        want = _ref_generate(cfg, params, toks, 12)
+        eos = want[2]  # force an EOS two tokens in
+        srv = BatchingEngine(
+            cfg, params, n_slots=1, max_len=64, eos_id=eos, decode_ticks=5
+        )
+        got = srv.run([("x", toks, 12)])["x"]
+        ref = BatchingEngine(
+            cfg, params, n_slots=1, max_len=64, eos_id=eos
+        ).run([("x", toks, 12)])["x"]
+        assert got == ref
+        assert got[-1] == eos or len(got) == 12
+
+    def test_paged_multi_tick(self, setup):
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg, params = setup
+        rng = np.random.default_rng(7)
+        reqs = [(i, rng.integers(0, cfg.vocab_size, 20), 6) for i in range(5)]
+        srv = PagedBatchingEngine(
+            cfg, params, n_slots=2, max_len=64, block_size=8,
+            pool_tokens=96, decode_ticks=3,
+        )
+        results = srv.run(reqs)
+        assert len(results) == 5
+        for rid, toks, max_new in reqs:
+            assert results[rid] == _ref_generate(cfg, params, toks, max_new), rid
+
+    def test_bad_decode_ticks_rejected(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="decode_ticks"):
+            BatchingEngine(cfg, params, decode_ticks=0)
