@@ -66,12 +66,27 @@ def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
         max_len=max_len, decode_ticks=decode_ticks,
     )
     budget = max_len - ctx - 1
+    need = (2 + ticks) * decode_ticks
+    if budget < need:
+        raise SystemExit(
+            f"steady_state: per-slot budget {budget} < "
+            f"(2+ticks)*decode_ticks = {need}; slots would drain "
+            "mid-measurement and inflate tokens/s — lower --ticks/"
+            "--decode-ticks or raise headroom"
+        )
     for i in range(n_slots):
         prompt = rng.integers(0, cfg.vocab_size, size=ctx, dtype=np.int64)
         eng.submit(i, prompt, max_new=budget)
+
+    def tokens_seen():
+        return eng.stats["tokens_generated"] + sum(
+            len(r.out) for r in eng._slots if r is not None
+        )
+
     # Prime: prefills all slots + compiles the decode program.
     eng.step()
     eng.step()
+    before = tokens_seen()
     t0 = time.perf_counter()
     for _ in range(ticks):
         eng.step()
@@ -79,7 +94,7 @@ def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
     # the axon platform block_until_ready does not synchronize).
     int(np.asarray(eng._cur)[0])
     dt = time.perf_counter() - t0
-    tokens = n_slots * ticks * decode_ticks
+    tokens = tokens_seen() - before
     return tokens / dt, dt / ticks
 
 

@@ -49,7 +49,16 @@ class _Request:
     rid: Any
     tokens: np.ndarray  # (S,) int32 prompt
     max_new: int
+    stop: Optional[List[List[int]]] = None  # token-id stop sequences
     out: List[int] = field(default_factory=list)
+
+    def hit_stop(self) -> Optional[int]:
+        """Length of the matched stop suffix of `out`, or None."""
+        for seq in self.stop or ():
+            n = len(seq)
+            if n and len(self.out) >= n and self.out[-n:] == seq:
+                return n
+        return None
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -97,6 +106,15 @@ class BatchingEngine:
         self._slots: List[Optional[_Request]] = [None] * n_slots
         self._prefill_jit: Dict[int, Any] = {}  # bucketed by padded S
         self._decode = jax.jit(self._decode_impl)
+        # Serving observability (read by the HTTP /stats endpoint).
+        # Written only by the engine-owning thread; plain ints so
+        # cross-thread reads are merely possibly-stale, never torn.
+        self.stats: Dict[str, int] = {
+            "requests_completed": 0,
+            "tokens_generated": 0,
+            "engine_steps": 0,
+            "prefills": 0,
+        }
 
     # ---- jitted programs --------------------------------------------
 
@@ -156,7 +174,10 @@ class BatchingEngine:
 
     # ---- scheduling --------------------------------------------------
 
-    def submit(self, rid, tokens, max_new: int) -> None:
+    def submit(self, rid, tokens, max_new: int, stop=None) -> None:
+        """Queue a request. `stop`: optional list of token-id sequences;
+        generation ends when the output ends with any of them, and the
+        matched sequence is removed from the returned tokens."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
             raise ValueError(f"request {rid!r}: empty prompt")
@@ -169,7 +190,11 @@ class BatchingEngine:
                 f"request {rid!r}: prompt {tokens.size} + max_new {max_new} "
                 f"exceeds max_len {self.max_len}"
             )
-        self._queue.append(_Request(rid, tokens, max_new))
+        if stop is not None:
+            stop = [list(map(int, s)) for s in stop]
+            if any(len(s) == 0 for s in stop):
+                raise ValueError(f"request {rid!r}: empty stop sequence")
+        self._queue.append(_Request(rid, tokens, max_new, stop=stop))
 
     def _prepare_slot(self, slot: int, req: _Request) -> None:
         """Hook before prefilling `req` into `slot` (paged: alloc blocks)."""
@@ -204,16 +229,22 @@ class BatchingEngine:
             self._cur = self._cur.at[i].set(first_tok)
             self._slots[i] = req
             req.out.append(first_tok)
+            self.stats["prefills"] += 1
 
     def _finish_check(self, finished):
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
             last = req.out[-1]
-            if (self.eos_id is not None and last == self.eos_id) or (
-                len(req.out) >= req.max_new
-            ):
+            nstop = req.hit_stop()
+            if nstop is not None:
+                req.out = req.out[:-nstop]
+            if nstop is not None or (
+                self.eos_id is not None and last == self.eos_id
+            ) or len(req.out) >= req.max_new:
                 finished.append((req.rid, req.out))
+                self.stats["requests_completed"] += 1
+                self.stats["tokens_generated"] += len(req.out)
                 self._slots[i] = None
                 self._release_slot(i)
 
@@ -221,10 +252,19 @@ class BatchingEngine:
         """Fill free slots, run decode_ticks ticks; returns finished
         requests. One host sync per call regardless of decode_ticks."""
         finished: List[Tuple[Any, List[int]]] = []
-        self._fill_slots()
-        # Requests satisfied by prefill alone (max_new=1 or instant EOS).
-        self._finish_check(finished)
-        self._fill_slots()
+        self.stats["engine_steps"] += 1
+        # Fill/check until stable: a request satisfied by its prefill
+        # alone (max_new=1, instant EOS, or a stop sequence completed by
+        # the prefill token) frees its slot for the next queued request,
+        # which may itself finish at prefill — every admitted request
+        # must pass a finish check BEFORE the decode window, or its
+        # one-shot finish condition is missed forever.
+        while True:
+            self._fill_slots()
+            n_done = len(finished)
+            self._finish_check(finished)
+            if len(finished) == n_done:
+                break
         active_rows = [r is not None for r in self._slots]
         if any(active_rows):
             self._pre_decode(active_rows)
@@ -243,8 +283,8 @@ class BatchingEngine:
                     last = req.out[-1]
                     if (self.eos_id is not None and last == self.eos_id) or (
                         len(req.out) >= req.max_new
-                    ):
-                        # Later window tokens are post-EOS/budget
+                    ) or req.hit_stop() is not None:
+                        # Later window tokens are post-EOS/budget/stop
                         # overshoot; the device kept decoding but the
                         # request never sees them.
                         break

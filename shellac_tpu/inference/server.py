@@ -7,9 +7,12 @@ until their request completes — the concurrency lives in the slot
 batch, not in the HTTP layer.
 
 API:
-  POST /generate  {"tokens": [1,2,3] | "text": "...", "max_new": 32}
+  POST /generate  {"tokens": [1,2,3] | "text": "...", "max_new": 32,
+                   "stop": [[7,8], "..."]?}
                   -> {"id", "tokens", "text"?}
   GET  /health    -> {"ok": true, "pending": N}
+  GET  /stats     -> engine counters (requests/tokens/steps/prefills,
+                     slots busy, decode_ticks)
 """
 
 from __future__ import annotations
@@ -73,7 +76,7 @@ class InferenceServer:
             self._pending.clear()
             while True:
                 try:
-                    rid, _, _ = self._submit_q.get_nowait()
+                    rid, *_ = self._submit_q.get_nowait()
                 except queue.Empty:
                     break
                 p = self._pending.pop(rid, None)
@@ -86,12 +89,12 @@ class InferenceServer:
             drained = False
             while True:
                 try:
-                    rid, tokens, max_new = self._submit_q.get_nowait()
+                    rid, tokens, max_new, stop = self._submit_q.get_nowait()
                 except queue.Empty:
                     break
                 drained = True
                 try:
-                    self.engine.submit(rid, tokens, max_new)
+                    self.engine.submit(rid, tokens, max_new, stop=stop)
                 except ValueError as e:
                     p = self._pending.pop(rid)
                     p.error = str(e)
@@ -112,13 +115,14 @@ class InferenceServer:
 
     # ---- client surface ---------------------------------------------
 
-    def generate(self, tokens, max_new: int, timeout: Optional[float] = None):
+    def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
+                 stop=None):
         if self._fatal is not None:
             raise RuntimeError(self._fatal)
         rid = next(self._ids)
         p = _Pending()
         self._pending[rid] = p
-        self._submit_q.put((rid, np.asarray(tokens, np.int32), max_new))
+        self._submit_q.put((rid, np.asarray(tokens, np.int32), max_new, stop))
         if self._fatal is not None and not p.event.is_set():
             # Scheduler died while we enqueued; its sweep may have
             # missed this request — fail it ourselves.
@@ -144,7 +148,30 @@ class InferenceServer:
         else:
             raise ValueError('need "tokens" or "text"')
         max_new = int(payload.get("max_new", 32))
-        out = self.generate(tokens, max_new, timeout=payload.get("timeout"))
+        stop = payload.get("stop")
+        if stop is not None:
+            try:
+                parsed = []
+                for s in stop:
+                    if isinstance(s, str):
+                        if self.tokenizer is None:
+                            raise ValueError(
+                                "string stop sequences need a server-side "
+                                "tokenizer"
+                            )
+                        parsed.append(
+                            list(map(int, self.tokenizer.encode(s)))
+                        )
+                    else:
+                        parsed.append(list(map(int, s)))
+            except (TypeError, ValueError) as e:
+                # Malformed payloads must surface as HTTP 400, not a
+                # dropped connection.
+                raise ValueError(f"bad stop sequences: {e}")
+            stop = parsed
+        out = self.generate(
+            tokens, max_new, timeout=payload.get("timeout"), stop=stop
+        )
         result: Dict[str, Any] = {"tokens": out}
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(out)
@@ -173,6 +200,15 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             if self.path == "/health":
                 self._send(200, {"ok": True,
                                  "pending": server.engine.pending})
+            elif self.path == "/stats":
+                eng = server.engine
+                self._send(200, {
+                    **eng.stats,
+                    "pending": eng.pending,
+                    "slots_busy": sum(r is not None for r in eng._slots),
+                    "n_slots": eng.n_slots,
+                    "decode_ticks": eng.decode_ticks,
+                })
             else:
                 self._send(404, {"error": "not found"})
 

@@ -154,3 +154,68 @@ class TestMultiTickDecode:
         cfg, params = setup
         with pytest.raises(ValueError, match="decode_ticks"):
             BatchingEngine(cfg, params, decode_ticks=0)
+
+
+class TestStopSequences:
+    def test_stop_truncates_and_frees(self, setup):
+        cfg, params = setup
+        rng = np.random.default_rng(8)
+        toks = rng.integers(0, cfg.vocab_size, 6)
+        full = _ref_generate(cfg, params, toks, 12)
+        # Use the 3rd-4th generated tokens as a 2-token stop sequence.
+        stop = [full[2], full[3]]
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        srv.submit("x", toks, 12, stop=[stop])
+        out = srv.run()["x"]
+        assert out == full[:2]
+        # The slot must be free for the next request.
+        srv.submit("y", toks, 3)
+        assert srv.run()["y"] == full[:3]
+
+    def test_stop_with_multi_tick(self, setup):
+        cfg, params = setup
+        rng = np.random.default_rng(9)
+        toks = rng.integers(0, cfg.vocab_size, 5)
+        full = _ref_generate(cfg, params, toks, 10)
+        stop = [full[4]]
+        for ticks in (1, 4):
+            srv = BatchingEngine(
+                cfg, params, n_slots=2, max_len=64, decode_ticks=ticks
+            )
+            srv.submit("x", toks, 10, stop=[stop])
+            assert srv.run()["x"] == full[:4], ticks
+
+    def test_no_match_runs_to_budget(self, setup):
+        cfg, params = setup
+        rng = np.random.default_rng(10)
+        toks = rng.integers(0, cfg.vocab_size, 5)
+        full = _ref_generate(cfg, params, toks, 6)
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        srv.submit("x", toks, 6, stop=[[cfg.vocab_size - 1] * 3])
+        assert srv.run()["x"] == full
+
+    def test_empty_stop_rejected(self, setup):
+        cfg, params = setup
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        with pytest.raises(ValueError, match="empty stop"):
+            srv.submit("x", [1, 2], 4, stop=[[]])
+
+
+def test_prefill_finish_conditions_checked_for_refilled_slots(setup):
+    """A request admitted after another finishes at prefill must get its
+    own prefill-phase finish check (stop hit by the prefill token,
+    max_new=1) before any decode window runs."""
+    cfg, params = setup
+    rng = np.random.default_rng(11)
+    toks = rng.integers(0, cfg.vocab_size, 5)
+    first = _ref_generate(cfg, params, toks, 1)  # the prefill token
+    srv = BatchingEngine(cfg, params, n_slots=1, max_len=64, decode_ticks=4)
+    # A finishes at prefill (max_new=1), freeing the slot; B's stop
+    # sequence is exactly its prefill token.
+    srv.submit("a", toks, 1)
+    srv.submit("b", toks, 8, stop=[[first[0]]])
+    srv.submit("c", toks, 1)
+    results = srv.run()
+    assert results["a"] == first
+    assert results["b"] == []  # stop matched at prefill, truncated
+    assert results["c"] == first

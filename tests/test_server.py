@@ -91,3 +91,37 @@ class TestHTTPServer:
         with pytest.raises(urllib.error.HTTPError) as ei:
             _post(base, {"max_new": 4})
         assert ei.value.code == 400
+
+
+def test_stats_endpoint():
+    import threading
+    import urllib.request
+
+    from shellac_tpu import get_model_config
+    from shellac_tpu.inference.server import InferenceServer, make_http_server
+    from shellac_tpu.models import transformer
+
+    # A fresh, fixture-free server: the exact counter assertions below
+    # need an engine no other test has driven.
+    cfg = get_model_config("tiny").replace(dtype="float32")
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    srv = InferenceServer(cfg, params, n_slots=2, max_len=64)
+    httpd = make_http_server(srv, "127.0.0.1", 0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        port = httpd.server_address[1]
+        out = srv.generate([1, 2, 3], max_new=4)
+        assert len(out) == 4
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=10
+        ) as r:
+            stats = json.loads(r.read())
+        assert stats["requests_completed"] == 1
+        assert stats["tokens_generated"] == 4
+        assert stats["prefills"] == 1
+        assert stats["engine_steps"] >= 1
+        assert stats["n_slots"] == 2
+    finally:
+        httpd.shutdown()
+        srv.close()
