@@ -56,13 +56,13 @@ def _mesh_from(args):
     return make_mesh(pcfg)
 
 
-def _data_iter(args, cfg, batch_size, seq_len, num_batches=None):
+def _data_iter(args, cfg, batch_size, seq_len, num_batches=None, skip=0):
     from shellac_tpu.training.data import shard_batches, token_batches
 
     if args.data:
         return shard_batches(
             args.data, batch_size=batch_size, seq_len=seq_len,
-            seed=args.seed, num_batches=num_batches,
+            seed=args.seed, num_batches=num_batches, skip=skip,
         )
     # Synthetic corpus: a noisy periodic token stream, so the loss has
     # structure to fall on (unlike uniform random tokens).
@@ -73,7 +73,7 @@ def _data_iter(args, cfg, batch_size, seq_len, num_batches=None):
     corpus = np.where(rng.random(n) < 0.1, noise, base).astype(np.int32)
     return token_batches(
         corpus, batch_size=batch_size, seq_len=seq_len, seed=args.seed,
-        num_batches=num_batches,
+        num_batches=num_batches, skip=skip,
     )
 
 
@@ -135,7 +135,16 @@ def cmd_train(args):
     cfg = _model_config(args)
     tcfg = _train_config(args)
     mesh = _mesh_from(args)
-    data = _data_iter(args, cfg, args.batch, args.seq)
+    # Resume continues the data stream where the checkpoint left it
+    # rather than replaying (and re-training on) the earliest batches.
+    skip = 0
+    if args.ckpt_dir:
+        from shellac_tpu.training.checkpoint import Checkpointer
+
+        latest = Checkpointer(args.ckpt_dir).latest_step()
+        if latest is not None:
+            skip = int(latest)
+    data = _data_iter(args, cfg, args.batch, args.seq, skip=skip)
     state = fit(
         cfg, tcfg, data,
         mesh=mesh,

@@ -57,12 +57,21 @@ def token_batches(
     seq_len: int,
     seed: int = 0,
     num_batches: Optional[int] = None,
+    skip: int = 0,
 ) -> Iterator[dict]:
-    """Random contiguous windows: inputs = w[:-1], targets = w[1:]."""
+    """Random contiguous windows: inputs = w[:-1], targets = w[1:].
+
+    `skip` fast-forwards the sampler past that many batches without
+    materializing them, so a resumed run (checkpoint at step N ->
+    skip=N) continues the SAME deterministic stream instead of
+    replaying batches it already trained on.
+    """
     tokens = np.asarray(tokens, dtype=np.int32)
     if tokens.size < seq_len + 1:
         raise ValueError(f"corpus of {tokens.size} tokens < seq_len+1")
     rng = np.random.default_rng(seed)
+    for _ in range(skip):
+        rng.integers(0, tokens.size - seq_len, size=batch_size)
     produced = 0
     while num_batches is None or produced < num_batches:
         # Valid starts are [0, size - seq_len - 1] inclusive: the window
@@ -81,27 +90,38 @@ def shard_batches(
     seed: int = 0,
     num_batches: Optional[int] = None,
     use_native: bool = True,
+    skip: int = 0,
 ) -> Iterator[dict]:
     """Batches drawn from a set of token shards (round-robin by epoch).
 
     Uses the native C++ loader when built (mmap + prefetch threads);
-    falls back to the pure-Python reader transparently.
+    falls back to the pure-Python reader transparently. `skip` resumes
+    the stream past already-trained batches (see token_batches); the
+    native reader's prefetch threads make its order non-reproducible
+    across run shapes, so there skipping discards real batches — cheap
+    (host memcpy), and it preserves the don't-retrain-the-head
+    property.
     """
     if use_native:
         try:
             from shellac_tpu.runtime.loader import NativeShardReader
 
             reader = NativeShardReader(paths, seed=seed)
-            yield from reader.batches(
-                batch_size=batch_size, seq_len=seq_len, num_batches=num_batches
+            it = reader.batches(
+                batch_size=batch_size, seq_len=seq_len,
+                num_batches=num_batches + skip
+                if num_batches is not None else None,
             )
+            for _ in range(skip):
+                next(it, None)
+            yield from it
             return
         except (ImportError, OSError):
             pass
     corpus = np.concatenate([read_token_shard(p) for p in paths])
     yield from token_batches(
         corpus, batch_size=batch_size, seq_len=seq_len, seed=seed,
-        num_batches=num_batches,
+        num_batches=num_batches, skip=skip,
     )
 
 

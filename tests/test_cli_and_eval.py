@@ -120,3 +120,21 @@ class TestCLI:
             "--batch", "8", "--seq", "32", "--mesh", "dp=4,tp=2",
         ])
         assert out["final_step"] == 3
+
+
+def test_data_skip_resumes_stream():
+    """skip=N must continue the same deterministic stream at batch N."""
+    import numpy as np
+
+    from shellac_tpu.training.data import token_batches
+
+    corpus = np.arange(10_000, dtype=np.int32) % 251
+    full = list(token_batches(
+        corpus, batch_size=2, seq_len=32, seed=7, num_batches=6
+    ))
+    tail = list(token_batches(
+        corpus, batch_size=2, seq_len=32, seed=7, num_batches=3, skip=3
+    ))
+    for a, b in zip(full[3:], tail):
+        np.testing.assert_array_equal(a["inputs"], b["inputs"])
+        np.testing.assert_array_equal(a["targets"], b["targets"])
