@@ -121,34 +121,35 @@ def flash_train_cases(checks):
         ]), jnp.int32,
     )
 
-    for label, window, segments in [
-        ("causal GQA", None, None),
-        ("window=600", 600, None),
-        ("packed", None, seg),
-        ("window=600 packed", 600, seg),
+    for label, window, segments, causal in [
+        ("causal GQA", None, None, True),
+        ("window=600", 600, None, True),
+        ("packed", None, seg, True),
+        ("window=600 packed", 600, seg, True),
+        ("noncausal", None, None, False),
     ]:
         def loss_flash(q, k, v):
             return jnp.sum(
                 flash_attention(
-                    q, k, v, causal=True, window=window, segments=segments,
-                    interpret=False,
+                    q, k, v, causal=causal, window=window,
+                    segments=segments, interpret=False,
                 ) ** 2
             )
 
         def loss_ref(q, k, v):
             return jnp.sum(
                 attention_ref(
-                    q, k, v, causal=True, window=window,
+                    q, k, v, causal=causal, window=window,
                     q_segments=segments, kv_segments=segments,
                 ) ** 2
             )
 
         out = flash_attention(
-            q, k, v, causal=True, window=window, segments=segments,
+            q, k, v, causal=causal, window=window, segments=segments,
             interpret=False,
         )
         ref = attention_ref(
-            q, k, v, causal=True, window=window,
+            q, k, v, causal=causal, window=window,
             q_segments=segments, kv_segments=segments,
         )
         check(

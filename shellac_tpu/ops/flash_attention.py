@@ -82,9 +82,11 @@ def flash_supported(
         return False
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
-    if sq != sk or not causal:
-        # The kernel itself supports non-causal; restrict dispatch to the
-        # training prefill shape we have test coverage for.
+    if sq != sk:
+        return False
+    if not causal and window is not None:
+        # One-sided windows without causality are ambiguous; only the
+        # reference path defines them.
         return False
     if d % 64 != 0:
         # Blocks span the full head_dim, so Mosaic accepts any d equal

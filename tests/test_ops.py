@@ -378,5 +378,32 @@ class TestFlashDispatch:
             monkeypatch, q_positions=jnp.zeros((2, 256), jnp.int32)
         )
 
-    def test_noncausal_falls_back(self, monkeypatch):
-        assert not self._sup(monkeypatch, causal=False)
+
+    def test_noncausal_dispatch_and_segments(self, monkeypatch):
+        """Encoder (bidirectional) attention reaches the kernel; packed
+        segments compose with it."""
+        assert self._sup(monkeypatch, causal=False)
+        seg = jnp.zeros((2, 256), jnp.int32)
+        assert self._sup(
+            monkeypatch, causal=False, q_segments=seg, kv_segments=seg
+        )
+        assert not self._sup(monkeypatch, causal=False, window=16)
+
+    def test_noncausal_segments_matches_ref(self, monkeypatch):
+        rng = np.random.default_rng(12)
+        q = jnp.asarray(rng.normal(size=(2, 96, 4, 64)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 96, 2, 64)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 96, 2, 64)).astype(np.float32))
+        seg = jnp.asarray(
+            np.repeat([0, 1, 2], [40, 9, 47])[None].repeat(2, 0), jnp.int32
+        )
+        got = flash_attention(
+            q, k, v, causal=False, segments=seg, block_q=32, block_k=32,
+            interpret=True,
+        )
+        want = attention_ref(
+            q, k, v, causal=False, q_segments=seg, kv_segments=seg
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+        )
