@@ -55,6 +55,9 @@ def main(argv=None):
     ap.add_argument("--packed", action="store_true",
                     help="packed-sequence batch (segment_ids set)")
     ap.add_argument("--quant", choices=["int8"], default=None)
+    ap.add_argument("--fused-loss", type=int, default=None,
+                    dest="fused_loss", metavar="CHUNK",
+                    help="vocab-chunked fused cross-entropy")
     ap.add_argument("--batch", type=int, default=None)
     args = ap.parse_args(argv)
 
@@ -83,7 +86,8 @@ def main(argv=None):
 
     if args.batch is not None:
         batch = args.batch
-    tcfg = TrainConfig(warmup_steps=10, total_steps=1000, quant=args.quant)
+    tcfg = TrainConfig(warmup_steps=10, total_steps=1000, quant=args.quant,
+                       fused_loss_chunk=args.fused_loss)
     key = jax.random.PRNGKey(0)
     state = init_train_state(cfg, tcfg, key)
     step = make_train_step(cfg, tcfg)
@@ -129,7 +133,7 @@ def main(argv=None):
 
     variant = ("_packed" if args.packed else "") + (
         f"_{args.quant}" if args.quant else ""
-    )
+    ) + (f"_fused{args.fused_loss}" if args.fused_loss else "")
     result = {
         "metric": f"train_throughput_{cfg.d_model}d{cfg.n_layers}L_seq{seq}"
                   f"{variant}_{backend}",

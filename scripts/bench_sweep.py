@@ -27,7 +27,7 @@ import numpy as np
 
 
 def run(batch, remat, steps=10, seq=2048, policy="none", quant=None,
-        packed=False):
+        packed=False, fused=None):
     from shellac_tpu import get_model_config
     from shellac_tpu.config import TrainConfig
     from shellac_tpu.training import init_train_state, make_train_step
@@ -35,7 +35,8 @@ def run(batch, remat, steps=10, seq=2048, policy="none", quant=None,
     cfg = get_model_config("shellac-1b").replace(
         remat=bool(remat), remat_policy=policy
     )
-    tcfg = TrainConfig(warmup_steps=10, total_steps=1000, quant=quant)
+    tcfg = TrainConfig(warmup_steps=10, total_steps=1000, quant=quant,
+                       fused_loss_chunk=fused)
     state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
     step = make_train_step(cfg, tcfg)
     tokens = jax.random.randint(
@@ -69,7 +70,7 @@ def run(batch, remat, steps=10, seq=2048, policy="none", quant=None,
     tok_s = batch * seq / dt
     print(json.dumps({
         "batch": batch, "remat": bool(remat), "policy": policy,
-        "quant": quant, "packed": bool(packed),
+        "quant": quant, "packed": bool(packed), "fused": fused,
         "tok_s": round(tok_s, 1), "step_s": round(dt, 4),
         "mfu": round(tok_s * flops_tok / TPU_V5E_BF16_PEAK_FLOPS, 4),
         "loss": round(loss, 3),
@@ -85,4 +86,5 @@ if __name__ == "__main__":
         policy=kw.get("policy", "none"),
         quant=kw.get("quant") or None,
         packed=bool(int(kw.get("packed", 0))),
+        fused=int(kw["fused"]) if kw.get("fused") else None,
     )

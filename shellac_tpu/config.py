@@ -198,6 +198,11 @@ class TrainConfig:
     # Quantized training compute: None (bf16) or "int8" (dense
     # projections as int8 MXU dots, fwd only; fp32 master params).
     quant: Optional[str] = None
+    # Vocab-chunked fused cross-entropy: the (B, S, V) fp32 logits —
+    # the train step's largest residual — never materialize. Set to a
+    # chunk size dividing the vocab (e.g. 2048); None = unfused.
+    # Ignored (with the unfused path) for models with logit_softcap.
+    fused_loss_chunk: Optional[int] = None
     seed: int = 0
 
     def replace(self, **kw) -> "TrainConfig":

@@ -466,8 +466,14 @@ def forward(
     attn_impl: str = "auto",
     pipeline_microbatches: Optional[int] = None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Full forward pass; returns fp32 logits (B, S, V).
+
+    With return_hidden=True, skips the LM head and returns the
+    post-final-norm hidden states (B, S, D) in compute dtype instead of
+    logits — the seam the fused (vocab-chunked) loss uses so the full
+    logits tensor never materializes.
 
     With a mesh whose pp axis > 1, the layer stack runs as a GPipe
     pipeline with `pipeline_microbatches` microbatches (default pp).
@@ -653,6 +659,11 @@ def forward(
         }
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
+    if return_hidden:
+        x = constrain(x, mesh, ("batch", "seq", None))
+        if return_aux:
+            return x, aux
+        return x
     if cfg.tie_embeddings:
         w_out = params["embed"].astype(cdt).T
     else:
@@ -664,6 +675,13 @@ def forward(
     if return_aux:
         return logits, aux
     return logits
+
+
+def output_weights(cfg: ModelConfig, params: Params, cdt) -> jax.Array:
+    """The LM-head matrix (D, V) in compute dtype (tied or untied)."""
+    if cfg.tie_embeddings:
+        return params["embed"].astype(cdt).T
+    return params["lm_head"].astype(cdt)
 
 
 def forward_with_cache(

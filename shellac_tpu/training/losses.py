@@ -76,3 +76,156 @@ def mlm_mask_tokens(
     )
     corrupted = jnp.where(selected, corrupted, tokens)
     return corrupted.astype(jnp.int32), selected.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Fused (vocab-chunked) cross-entropy
+# ---------------------------------------------------------------------------
+
+
+def _fused_fwd_impl(x, w, targets, vocab_chunk):
+    """Online-softmax over vocab chunks; never materializes (N, V).
+
+    x: (N, D) compute dtype; w: (D, V); targets: (N,) int32.
+    Returns (nll, lse): nll_i = lse_i - logit_{t_i}.
+    """
+    d, v = w.shape
+    n = x.shape[0]
+    nc = v // vocab_chunk
+    wr = w.reshape(d, nc, vocab_chunk).transpose(1, 0, 2)  # (nc, D, chunk)
+
+    def body(carry, inp):
+        m, s, tgt = carry
+        ci, wc = inp
+        logits = jax.lax.dot_general(
+            x, wc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (N, chunk)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        idx = targets - ci * vocab_chunk
+        in_range = (idx >= 0) & (idx < vocab_chunk)
+        got = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, vocab_chunk - 1)[:, None], axis=1
+        )[:, 0]
+        tgt = jnp.where(in_range, got, tgt)
+        return (m_new, s, tgt), None
+
+    m0 = jnp.full((n,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((n,), jnp.float32)
+    t0 = jnp.zeros((n,), jnp.float32)
+    (m, s, tgt), _ = jax.lax.scan(body, (m0, s0, t0), (jnp.arange(nc), wr))
+    lse = m + jnp.log(s)
+    return lse - tgt, lse
+
+
+_FUSED_CACHE = {}
+
+
+def _fused_for_chunk(vocab_chunk: int):
+    """A custom_vjp instance specialized to one (static) chunk size.
+
+    Returns f(x, w, targets) -> (nll, lse); the backward recomputes the
+    chunk logits from the saved lse rows instead of keeping (N, V)
+    probabilities: dlogits_c = a*p_c - b*onehot_c with a = g_nll+g_lse,
+    b = g_nll.
+    """
+    if vocab_chunk in _FUSED_CACHE:
+        return _FUSED_CACHE[vocab_chunk]
+
+    @jax.custom_vjp
+    def f(x, w, targets):
+        return _fused_fwd_impl(x, w, targets, vocab_chunk)
+
+    def fwd(x, w, targets):
+        nll, lse = _fused_fwd_impl(x, w, targets, vocab_chunk)
+        return (nll, lse), (x, w, targets, lse)
+
+    def bwd(res, g):
+        x, w, targets, lse = res
+        g_nll, g_lse = g
+        d, v = w.shape
+        nc = v // vocab_chunk
+        wr = w.reshape(d, nc, vocab_chunk).transpose(1, 0, 2)
+        a = (g_nll + g_lse).astype(jnp.float32)
+        b = g_nll.astype(jnp.float32)
+
+        def body(dx, inp):
+            ci, wc = inp
+            logits = jax.lax.dot_general(
+                x, wc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            p = jnp.exp(logits - lse[:, None])
+            idx = targets - ci * vocab_chunk
+            cols = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            onehot = cols == idx[:, None]
+            dlog = (a[:, None] * p - jnp.where(onehot, b[:, None], 0.0))
+            dlog = dlog.astype(x.dtype)
+            dx = dx + jax.lax.dot_general(
+                dlog, wc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dwc = jax.lax.dot_general(
+                x, dlog, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (D, chunk)
+            return dx, dwc
+
+        dx0 = jnp.zeros(x.shape, jnp.float32)
+        dx, dws = jax.lax.scan(body, dx0, (jnp.arange(nc), wr))
+        dw = dws.transpose(1, 0, 2).reshape(d, v)
+        return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+    f.defvjp(fwd, bwd)
+    _FUSED_CACHE[vocab_chunk] = f
+    return f
+
+
+def fused_cross_entropy(
+    hidden: jax.Array,  # (..., D) compute dtype — post-final-norm
+    w_out: jax.Array,  # (D, V)
+    targets: jax.Array,  # (...) int32
+    mask: Optional[jax.Array] = None,
+    z_loss_weight: float = 0.0,
+    vocab_chunk: int = 2048,
+) -> Tuple[jax.Array, dict]:
+    """cross_entropy without materializing the (N, V) logits.
+
+    The lm-head matmul, log-softmax, and target gather run chunked over
+    the vocab with an online logsumexp (forward) and a recomputing
+    backward — the full fp32 logits tensor (the largest single residual
+    of the train step: batch*seq*V*4 bytes) never exists. Numerics
+    match `cross_entropy` to fp32 tolerance (tested, incl. grads).
+
+    V must divide by vocab_chunk; callers fall back to the unfused path
+    otherwise. Not meaningful at decode time (S=1).
+    """
+    d = hidden.shape[-1]
+    v = w_out.shape[-1]
+    if v % vocab_chunk:
+        raise ValueError(f"vocab {v} not divisible by chunk {vocab_chunk}")
+    lead = hidden.shape[:-1]
+    x = hidden.reshape(-1, d)
+    t = targets.reshape(-1).astype(jnp.int32)
+    nll, lse = _fused_for_chunk(vocab_chunk)(x, w_out, t)
+    nll = nll.reshape(lead)
+    lse = lse.reshape(lead)
+    if z_loss_weight:
+        nll = nll + z_loss_weight * jnp.square(lse)
+    if mask is None:
+        denom = jnp.array(nll.size, jnp.float32)
+        total = jnp.sum(nll)
+    else:
+        mask = mask.astype(jnp.float32)
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        total = jnp.sum(nll * mask)
+    loss = total / denom
+    metrics = {
+        "loss": loss,
+        "perplexity": jnp.exp(jnp.clip(loss, max=30.0)),
+        "tokens": denom,
+    }
+    return loss, metrics

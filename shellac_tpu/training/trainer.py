@@ -74,7 +74,39 @@ def make_train_step(
     optimizer = make_optimizer(train_cfg)
     accum = train_cfg.grad_accum
 
+    fused_chunk = train_cfg.fused_loss_chunk
+    if fused_chunk is not None and (
+        model_cfg.logit_softcap is not None
+        or model_cfg.vocab_size % fused_chunk
+    ):
+        # Softcap changes the logit function itself; indivisible vocabs
+        # have no even chunking. Both fall back to the unfused path.
+        fused_chunk = None
+
     def loss_fn(params, batch):
+        if fused_chunk is not None:
+            from shellac_tpu.training.losses import fused_cross_entropy
+
+            hidden, aux = transformer.forward(
+                model_cfg, params, batch["inputs"], mesh=mesh,
+                attn_impl=attn_impl, segment_ids=batch.get("segment_ids"),
+                pipeline_microbatches=pipeline_microbatches,
+                return_aux=True, return_hidden=True,
+            )
+            w_out = transformer.output_weights(
+                model_cfg, params, model_cfg.compute_dtype
+            )
+            loss, metrics = fused_cross_entropy(
+                hidden, w_out, batch["targets"], batch.get("mask"),
+                train_cfg.z_loss_weight, vocab_chunk=fused_chunk,
+            )
+            if model_cfg.moe is not None:
+                metrics["moe_aux_loss"] = aux["aux"]
+                metrics["moe_balance_loss"] = aux["balance_loss"]
+                metrics["moe_router_z_loss"] = aux["router_z_loss"]
+                metrics["moe_dropped_frac"] = aux["dropped_frac"]
+                loss = loss + aux["aux"]
+            return loss, metrics
         logits, aux = transformer.forward(
             model_cfg, params, batch["inputs"], mesh=mesh, attn_impl=attn_impl,
             segment_ids=batch.get("segment_ids"),
