@@ -1,0 +1,71 @@
+#!/bin/bash
+# Unattended TPU measurement queue. Run when the relay recovers:
+#     bash scripts/run_tpu_queue.sh [results_file]
+# Probes first; exits 3 immediately if the relay is still wedged.
+# Appends one JSON line per measurement; safe to re-run (idempotent
+# measurements, append-only log). Runs everything SEQUENTIALLY — two
+# TPU processes at once deadlock the relay.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/tpu_queue_results.jsonl}"
+
+probe() {
+  timeout 45 python -u -c "import jax; assert jax.default_backend()=='tpu'" \
+    >/dev/null 2>&1
+}
+
+note() { echo "{\"queue_note\": \"$1\"}" >> "$OUT"; }
+
+if ! probe; then
+  echo "relay still wedged" >&2
+  exit 3
+fi
+note "relay up $(date -u +%FT%TZ)"
+
+run() {  # run <label> <timeout_s> <cmd...>
+  local label="$1" t="$2"; shift 2
+  echo "=== $label" >&2
+  note "start $label"
+  timeout "$t" "$@" 2>/dev/null >> "$OUT"
+  local rc=$?
+  note "done $label rc=$rc"
+  # A hang mid-queue usually means the relay wedged again: stop early
+  # so we do not stack more claims on it.
+  if [ $rc -eq 124 ]; then
+    note "timeout on $label - aborting queue (relay likely wedged)"
+    exit 4
+  fi
+}
+
+# 1. Parity gate first: everything else is meaningless if kernels are
+#    wrong (includes restructured decode, dh=64, non-causal cases).
+run parity 580 python scripts/tpu_parity_decode.py
+
+# 2. Decode kernel microbench (restructured head-batched grid).
+run kern2048 580 python scripts/bench_decode.py --mode kernel
+run kern4096 580 python scripts/bench_decode.py --mode kernel --ctx 4096
+
+# 3. Engine-level serving with multi-tick decode.
+run engine_dense 580 python scripts/bench_decode.py \
+  --variants dense:auto,dense:ref --decode-ticks 8
+run engine_paged 580 python scripts/bench_decode.py \
+  --variants paged:auto,paged:ref --decode-ticks 8
+
+# 4. Training bench variants (headline recipe + packed + quant + fused).
+run train_plain 580 python bench.py
+run train_packed 580 python bench.py --packed
+run train_int8 580 python bench.py --quant int8
+run train_fused 580 python bench.py --fused-loss 4096
+run train_fused_b8 580 python bench.py --fused-loss 4096 --batch 8
+
+# 5. Remat-policy sweep (each config its own process; OOM is informative).
+for b in 4 6 8; do
+  for p in none dots; do
+    run "sweep_b${b}_${p}" 580 python scripts/bench_sweep.py \
+      batch=$b policy=$p
+  done
+done
+run sweep_b6_dots_fused 580 python scripts/bench_sweep.py \
+  batch=6 policy=dots fused=4096
+
+echo "queue complete -> $OUT" >&2
