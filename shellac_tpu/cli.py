@@ -247,6 +247,24 @@ def cmd_generate(args):
     )
     out = eng.generate(jnp.asarray(prompt), max_new_tokens=args.max_new)
     ids = np.asarray(out.tokens)[0]
+    stop_seqs = []
+    if args.stop:
+        stop_seqs += [
+            [int(t) for t in part.split(",")]
+            for part in args.stop.split(";") if part
+        ]
+    if args.stop_text:
+        if tok is None:
+            from shellac_tpu.training.tokenizer import get_tokenizer
+
+            tok = get_tokenizer(args.tokenizer)
+        stop_seqs += [
+            list(map(int, tok.encode(s, bos=False))) for s in args.stop_text
+        ]
+    if stop_seqs:
+        from shellac_tpu.inference.engine import truncate_at_stop
+
+        ids = np.asarray(truncate_at_stop(ids[None], stop_seqs)[0], np.int64)
     result = {"tokens": ids.tolist()}
     if tok is not None:
         result["text"] = tok.decode(ids)
@@ -392,6 +410,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory written by `convert`")
     g.add_argument("--quantize", action="store_true",
                    help="int8 weight-only quantization")
+    g.add_argument("--stop", default=None,
+                   help='token-id stop sequences, e.g. "13,10;0"')
+    g.add_argument("--stop-text", default=None, nargs="*",
+                   help="string stop sequences (encoded with --tokenizer)")
     g.add_argument("--draft-model", default=None,
                    help="draft preset for speculative decoding")
     g.add_argument("--gamma", type=int, default=4)

@@ -168,3 +168,34 @@ class Engine:
         return self._decode(
             self.params, first_logits, cache, max_new_tokens, key, seen
         )
+
+
+def truncate_at_stop(tokens, stop, prompt_outputs=None):
+    """Host-side stop-sequence post-processing for Engine outputs.
+
+    The Engine's decode loop runs entirely on device (a lax.scan with a
+    fixed budget), so stop sequences are applied after the fact: each
+    row of `tokens` (B, max_new) is cut at the FIRST occurrence of any
+    stop sequence, excluding the match. Returns a list of per-row
+    python lists (ragged). The continuous-batching engine implements
+    the same contract with true early exit (its submit(..., stop=...));
+    this helper keeps the single-request API consistent.
+    """
+    import numpy as np
+
+    rows = np.asarray(tokens)
+    seqs = [list(map(int, s)) for s in stop]
+    if any(len(s) == 0 for s in seqs):
+        raise ValueError("empty stop sequence")
+    out = []
+    for row in rows:
+        row = row.tolist()
+        cut = len(row)
+        for s in seqs:
+            n = len(s)
+            for i in range(0, len(row) - n + 1):
+                if row[i:i + n] == s:
+                    cut = min(cut, i)
+                    break
+        out.append(row[:cut])
+    return out

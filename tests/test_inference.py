@@ -143,3 +143,19 @@ class TestEngine:
             want.append(int(nxt[0]))
             toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
         assert np.asarray(res.tokens)[0].tolist() == want
+
+
+def test_truncate_at_stop():
+    import numpy as np
+
+    from shellac_tpu.inference.engine import truncate_at_stop
+
+    toks = np.array([[5, 7, 9, 11, 13], [1, 2, 3, 2, 3]])
+    out = truncate_at_stop(toks, [[9, 11], [2, 3]])
+    assert out == [[5, 7], [1]]
+    # No match: untouched.
+    assert truncate_at_stop(toks, [[99]]) == [toks[0].tolist(), toks[1].tolist()]
+    import pytest
+
+    with pytest.raises(ValueError, match="empty"):
+        truncate_at_stop(toks, [[]])
