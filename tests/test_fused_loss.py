@@ -118,3 +118,30 @@ class TestFusedTrainStep:
         state, m = step(state, {"inputs": jnp.zeros((2, 16), jnp.int32),
                                 "targets": jnp.zeros((2, 16), jnp.int32)})
         assert np.isfinite(float(m["loss"]))
+
+    def test_fused_on_mesh(self, mesh_fsdp8):
+        """Fused loss composes with GSPMD sharding (fsdp mesh)."""
+        from shellac_tpu.training import (
+            batch_shardings,
+            init_train_state,
+            make_train_step,
+        )
+
+        cfg = get_model_config("tiny")
+        tcfg = TrainConfig(
+            warmup_steps=1, total_steps=5, fused_loss_chunk=64
+        )
+        state = init_train_state(
+            cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh_fsdp8
+        )
+        step = make_train_step(cfg, tcfg, mesh=mesh_fsdp8)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size
+        )
+        bs = batch_shardings(mesh_fsdp8)
+        batch = {
+            "inputs": jax.device_put(tokens, bs),
+            "targets": jax.device_put(tokens, bs),
+        }
+        state, m = step(state, batch)
+        assert np.isfinite(float(m["loss"]))
