@@ -96,22 +96,38 @@ def _load_native(native_dir):
 
 
 def _restore_params(args, cfg, train_cfg=None):
-    """Params from --ckpt-dir (latest step), or a fresh random init."""
+    """Params from --ckpt-dir (latest step), or a fresh random init.
+
+    With --ema (eval/generate on a checkpoint trained with
+    TrainConfig.ema_decay), returns the averaged weights instead."""
     import jax
 
     from shellac_tpu.models import transformer
 
+    use_ema = bool(getattr(args, "ema", False))
     if getattr(args, "ckpt_dir", None):
         from shellac_tpu.config import TrainConfig
         from shellac_tpu.training.checkpoint import Checkpointer
         from shellac_tpu.training.trainer import init_train_state
 
-        tcfg = train_cfg or TrainConfig()
+        tcfg = train_cfg or TrainConfig(
+            # Any non-None decay makes the abstract state carry
+            # ema_params so the restore's structure matches a
+            # checkpoint that has them.
+            ema_decay=0.999 if use_ema else None,
+        )
         ckpt = Checkpointer(args.ckpt_dir)
         abstract = jax.eval_shape(
             lambda: init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
         )
         state = ckpt.restore(abstract_state=abstract)
+        if use_ema:
+            if state.ema_params is None:
+                raise SystemExit(
+                    "--ema: checkpoint has no EMA parameters (train with "
+                    "TrainConfig.ema_decay)"
+                )
+            return state.ema_params
         return state.params
     return transformer.init_params(cfg, jax.random.PRNGKey(args.seed))
 
@@ -121,7 +137,8 @@ def _train_config(args):
 
     kw = {}
     for field in ("learning_rate", "warmup_steps", "weight_decay",
-                  "grad_accum", "seed", "optimizer", "quant"):
+                  "grad_accum", "seed", "optimizer", "quant",
+                  "ema_decay"):
         v = getattr(args, field, None)
         if v is not None:
             kw[field] = v
@@ -383,10 +400,14 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--optimizer", choices=["adamw", "lion", "adafactor"])
     t.add_argument("--quant", choices=["int8"], default=None,
                    help="quantized training compute (int8 MXU dots)")
+    t.add_argument("--ema-decay", type=float, default=None, dest="ema_decay",
+                   help="keep an EMA of the weights (e.g. 0.999)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="perplexity of a checkpoint")
     common(e)
+    e.add_argument("--ema", action="store_true",
+                   help="evaluate the EMA-averaged weights")
     e.add_argument("--batch", type=int, default=8)
     e.add_argument("--seq", type=int, default=128)
     e.add_argument("--batches", type=int, default=16)
@@ -410,6 +431,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory written by `convert`")
     g.add_argument("--quantize", action="store_true",
                    help="int8 weight-only quantization")
+    g.add_argument("--ema", action="store_true",
+                   help="generate with the EMA-averaged weights")
     g.add_argument("--stop", default=None,
                    help='token-id stop sequences, e.g. "13,10;0"')
     g.add_argument("--stop-text", default=None, nargs="*",

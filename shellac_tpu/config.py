@@ -203,6 +203,10 @@ class TrainConfig:
     # chunk size dividing the vocab (e.g. 2048); None = unfused.
     # Ignored (with the unfused path) for models with logit_softcap.
     fused_loss_chunk: Optional[int] = None
+    # Exponential moving average of parameters (e.g. 0.999): kept in
+    # TrainState.ema_params, updated every step, checkpointed; eval can
+    # read the averaged weights. None disables (no memory cost).
+    ema_decay: Optional[float] = None
     seed: int = 0
 
     def replace(self, **kw) -> "TrainConfig":

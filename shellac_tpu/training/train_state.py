@@ -24,6 +24,10 @@ class TrainState:
     step: Any
     params: Any
     opt_state: Any
+    # Exponential moving average of params (TrainConfig.ema_decay);
+    # None when disabled. Leaves mirror params, so sharding inference
+    # (path-suffix matching below) covers them automatically.
+    ema_params: Any = None
 
 
 def _key_str(entry) -> str:

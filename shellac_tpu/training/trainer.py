@@ -43,6 +43,10 @@ def init_train_state(
             step=jnp.zeros((), jnp.int32),
             params=params,
             opt_state=optimizer.init(params),
+            ema_params=(
+                jax.tree.map(lambda p: p, params)
+                if train_cfg.ema_decay is not None else None
+            ),
         )
 
     if mesh is None:
@@ -157,15 +161,27 @@ def make_train_step(
             grads, state.opt_state, state.params
         )
         new_params = optax.apply_updates(state.params, updates)
+        new_ema = state.ema_params
+        if train_cfg.ema_decay is not None:
+            d = train_cfg.ema_decay
+            new_ema = jax.tree.map(
+                lambda e, p: (e * d + p.astype(e.dtype) * (1.0 - d)).astype(
+                    e.dtype
+                ),
+                state.ema_params, new_params,
+            )
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
         if train_cfg.skip_nonfinite_updates:
             ok = all_finite(grads)
             new_params = guard_update(state.params, new_params, ok)
             new_opt_state = guard_update(state.opt_state, new_opt_state, ok)
+            if new_ema is not None:
+                new_ema = guard_update(state.ema_params, new_ema, ok)
             metrics["update_skipped"] = 1.0 - ok.astype(jnp.float32)
         new_state = TrainState(
-            step=state.step + 1, params=new_params, opt_state=new_opt_state
+            step=state.step + 1, params=new_params, opt_state=new_opt_state,
+            ema_params=new_ema,
         )
         return new_state, metrics
 

@@ -1,0 +1,92 @@
+"""EMA parameter averaging (TrainConfig.ema_decay)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.config import TrainConfig
+from shellac_tpu.training import init_train_state, make_train_step
+
+
+def _run(decay, steps=5):
+    cfg = get_model_config("tiny")
+    tcfg = TrainConfig(
+        learning_rate=3e-3, warmup_steps=1, total_steps=50, ema_decay=decay
+    )
+    state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+    step = make_train_step(cfg, tcfg)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size
+    )
+    batch = {"inputs": tokens, "targets": tokens}
+    for _ in range(steps):
+        state, _ = step(state, batch)
+    return state
+
+
+def _dist(a, b):
+    return float(
+        sum(jnp.sum((x.astype(jnp.float32) - y.astype(jnp.float32)) ** 2)
+            for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))) ** 0.5
+    )
+
+
+class TestEMA:
+    def test_disabled_by_default(self):
+        state = _run(None)
+        assert state.ema_params is None
+
+    def test_ema_lags_params(self):
+        """High decay tracks slowly; low decay hugs the live params."""
+        slow = _run(0.99)
+        fast = _run(0.5)
+        d_slow = _dist(slow.ema_params, slow.params)
+        d_fast = _dist(fast.ema_params, fast.params)
+        assert d_slow > d_fast > 0
+
+    def test_ema_structure_matches_params(self):
+        state = _run(0.9)
+        jax.tree.map(
+            lambda e, p: None if e.shape == p.shape else pytest.fail("shape"),
+            state.ema_params, state.params,
+        )
+
+    def test_checkpoint_roundtrip(self, tmp_path):
+        from shellac_tpu.training.checkpoint import Checkpointer
+
+        state = _run(0.9)
+        ckpt = Checkpointer(str(tmp_path / "ck"))
+        ckpt.save(5, state, force=True, wait=True)
+        abstract = jax.eval_shape(lambda s: s, state)
+        restored = ckpt.restore(abstract_state=abstract)
+        np.testing.assert_allclose(
+            np.asarray(jax.tree.leaves(restored.ema_params)[0]),
+            np.asarray(jax.tree.leaves(state.ema_params)[0]),
+        )
+
+    def test_ema_on_mesh(self, mesh_fsdp8):
+        """EMA leaves inherit param shardings via path-suffix matching."""
+        from shellac_tpu.training import batch_shardings
+
+        cfg = get_model_config("tiny")
+        tcfg = TrainConfig(warmup_steps=1, total_steps=5, ema_decay=0.9)
+        state = init_train_state(
+            cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh_fsdp8
+        )
+        step = make_train_step(cfg, tcfg, mesh=mesh_fsdp8)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size
+        )
+        bs = batch_shardings(mesh_fsdp8)
+        batch = {
+            "inputs": jax.device_put(tokens, bs),
+            "targets": jax.device_put(tokens, bs),
+        }
+        state, m = step(state, batch)
+        assert np.isfinite(float(m["loss"]))
+        # EMA embed must be sharded like the live embed.
+        assert (
+            state.ema_params["embed"].sharding == state.params["embed"].sharding
+        )
