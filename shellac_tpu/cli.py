@@ -230,6 +230,28 @@ def cmd_generate(args):
     if prompt.size == 0:
         raise SystemExit("empty prompt")
 
+    stop_seqs = []
+    if args.stop:
+        stop_seqs += [
+            [int(t) for t in part.split(",")]
+            for part in args.stop.split(";") if part
+        ]
+    if args.stop_text:
+        if tok is None:
+            from shellac_tpu.training.tokenizer import get_tokenizer
+
+            tok = get_tokenizer(args.tokenizer)
+        stop_seqs += [
+            list(map(int, tok.encode(s, bos=False))) for s in args.stop_text
+        ]
+
+    def apply_stop(ids):
+        if not stop_seqs:
+            return ids
+        from shellac_tpu.inference.engine import truncate_at_stop
+
+        return np.asarray(truncate_at_stop(ids[None], stop_seqs)[0], np.int64)
+
     if args.draft_model:
         from shellac_tpu.inference.speculative import SpeculativeEngine
         from shellac_tpu.models.registry import PRESETS
@@ -246,7 +268,7 @@ def cmd_generate(args):
         )
         out = eng.generate(jnp.asarray(prompt), max_new_tokens=args.max_new)
         print(json.dumps({
-            "tokens": np.asarray(out.tokens)[0].tolist(),
+            "tokens": apply_stop(np.asarray(out.tokens)[0]).tolist(),
             "accept_rate": round(float(out.accept_rate), 4),
             "rounds": int(out.rounds),
         }))
@@ -263,25 +285,7 @@ def cmd_generate(args):
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
     )
     out = eng.generate(jnp.asarray(prompt), max_new_tokens=args.max_new)
-    ids = np.asarray(out.tokens)[0]
-    stop_seqs = []
-    if args.stop:
-        stop_seqs += [
-            [int(t) for t in part.split(",")]
-            for part in args.stop.split(";") if part
-        ]
-    if args.stop_text:
-        if tok is None:
-            from shellac_tpu.training.tokenizer import get_tokenizer
-
-            tok = get_tokenizer(args.tokenizer)
-        stop_seqs += [
-            list(map(int, tok.encode(s, bos=False))) for s in args.stop_text
-        ]
-    if stop_seqs:
-        from shellac_tpu.inference.engine import truncate_at_stop
-
-        ids = np.asarray(truncate_at_stop(ids[None], stop_seqs)[0], np.int64)
+    ids = apply_stop(np.asarray(out.tokens)[0])
     result = {"tokens": ids.tolist()}
     if tok is not None:
         result["text"] = tok.decode(ids)
