@@ -232,18 +232,31 @@ def cmd_generate(args):
 
     stop_seqs = []
     if args.stop:
-        stop_seqs += [
-            [int(t) for t in part.split(",")]
-            for part in args.stop.split(";") if part
-        ]
+        for part in args.stop.split(";"):
+            if not part:
+                continue
+            try:
+                seq = [int(t) for t in part.split(",")]
+            except ValueError:
+                raise SystemExit(
+                    f'--stop: bad token-id sequence {part!r} '
+                    '(expected e.g. "13,10;0")'
+                )
+            if not seq:
+                raise SystemExit("--stop: empty stop sequence")
+            stop_seqs.append(seq)
     if args.stop_text:
         if tok is None:
             from shellac_tpu.training.tokenizer import get_tokenizer
 
             tok = get_tokenizer(args.tokenizer)
-        stop_seqs += [
-            list(map(int, tok.encode(s, bos=False))) for s in args.stop_text
-        ]
+        for s in args.stop_text:
+            seq = list(map(int, tok.encode(s, bos=False)))
+            if not seq:
+                raise SystemExit(
+                    f"--stop-text: {s!r} encodes to zero tokens"
+                )
+            stop_seqs.append(seq)
 
     def apply_stop(ids):
         if not stop_seqs:
@@ -267,11 +280,15 @@ def cmd_generate(args):
             gamma=args.gamma, temperature=args.temperature,
         )
         out = eng.generate(jnp.asarray(prompt), max_new_tokens=args.max_new)
-        print(json.dumps({
-            "tokens": apply_stop(np.asarray(out.tokens)[0]).tolist(),
+        ids = apply_stop(np.asarray(out.tokens)[0])
+        result = {
+            "tokens": ids.tolist(),
             "accept_rate": round(float(out.accept_rate), 4),
             "rounds": int(out.rounds),
-        }))
+        }
+        if tok is not None:
+            result["text"] = tok.decode(ids)
+        print(json.dumps(result))
         return 0
 
     from shellac_tpu.inference.engine import Engine

@@ -138,3 +138,33 @@ def test_data_skip_resumes_stream():
     for a, b in zip(full[3:], tail):
         np.testing.assert_array_equal(a["inputs"], b["inputs"])
         np.testing.assert_array_equal(a["targets"], b["targets"])
+
+
+def test_generate_cli_stop_sequences(capsys):
+    """--stop truncates on both the plain and speculative paths."""
+    import json
+
+    from shellac_tpu.cli import main
+
+    def run(argv):
+        main(["generate", "--model", "tiny", "--prompt", "1,2,3",
+              "--max-new", "6", "--seed", "0"] + argv)
+        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    full = run([])["tokens"]
+    assert len(full) == 6
+    # Stop on the first generated token: everything truncated.
+    got = run(["--stop", str(full[0])])["tokens"]
+    assert got == []
+    # Stop on a 2-token sequence mid-output.
+    got = run(["--stop", f"{full[2]},{full[3]}"])["tokens"]
+    assert got == full[:2]
+    # Speculative path honors the same flag.
+    spec = run(["--draft-model", "tiny", "--gamma", "2",
+                "--stop", str(full[0])])
+    assert spec["tokens"] == [] or spec["tokens"][0] != full[0]
+
+    import pytest
+
+    with pytest.raises(SystemExit, match="bad token-id"):
+        run(["--stop", "13,,10"])
