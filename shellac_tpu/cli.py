@@ -329,6 +329,7 @@ def cmd_serve(args):
             max_len=args.max_len or cfg.max_seq_len,
             temperature=args.temperature, eos_id=args.eos_id,
             decode_ticks=args.decode_ticks,
+            max_prefills_per_step=args.max_prefills_per_step,
         )
     serve(
         cfg, params,
@@ -338,6 +339,7 @@ def cmd_serve(args):
         n_slots=args.slots, max_len=args.max_len,
         temperature=args.temperature, eos_id=args.eos_id,
         decode_ticks=args.decode_ticks,
+        max_prefills_per_step=args.max_prefills_per_step,
     )
     return 0
 
@@ -476,6 +478,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--decode-ticks", type=int, default=1, dest="decode_ticks",
                    help="decode steps per host sync (throughput vs "
                         "per-token latency)")
+    s.add_argument("--max-prefills-per-step", type=int, default=1,
+                   dest="max_prefills_per_step",
+                   help="cap prefills per engine step so prompt bursts "
+                        "don't stall active decodes")
     s.add_argument("--ckpt-dir")
     s.add_argument("--quantize", action="store_true")
     s.add_argument("--tokenizer", default="byte")

@@ -85,9 +85,12 @@ class BatchingEngine:
         seed: int = 0,
         attn_impl: str = "auto",
         decode_ticks: int = 1,
+        max_prefills_per_step: Optional[int] = None,
     ):
         if decode_ticks < 1:
             raise ValueError(f"decode_ticks must be >= 1, got {decode_ticks}")
+        if max_prefills_per_step is not None and max_prefills_per_step < 1:
+            raise ValueError("max_prefills_per_step must be >= 1")
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
@@ -95,6 +98,12 @@ class BatchingEngine:
         self.eos_id = eos_id
         self.attn_impl = attn_impl
         self.decode_ticks = decode_ticks
+        # Cap prefills per engine step: a burst of queued prompts would
+        # otherwise run n_slots sequential prefill programs before the
+        # next decode tick, stalling every active request's output for
+        # the whole burst. None = no cap (drain-oriented batch use);
+        # servers should set 1-2 to bound decode latency jitter.
+        self.max_prefills_per_step = max_prefills_per_step
         self._sampler = functools.partial(
             sample, temperature=temperature, top_k=top_k, top_p=top_p
         )
@@ -202,10 +211,14 @@ class BatchingEngine:
     def _release_slot(self, slot: int) -> None:
         """Hook after a request leaves `slot` (paged: free its blocks)."""
 
-    def _fill_slots(self):
+    def _fill_slots(self, budget: Optional[int] = None):
+        done = 0
         for i in range(self.n_slots):
             if self._slots[i] is not None or not self._queue:
                 continue
+            if budget is not None and done >= budget:
+                break
+            done += 1
             req = self._queue.popleft()
             self._prepare_slot(i, req)
             s = req.tokens.size
@@ -258,12 +271,19 @@ class BatchingEngine:
         # the prefill token) frees its slot for the next queued request,
         # which may itself finish at prefill — every admitted request
         # must pass a finish check BEFORE the decode window, or its
-        # one-shot finish condition is missed forever.
+        # one-shot finish condition is missed forever. The prefill
+        # budget is shared across the loop's iterations (per step).
+        remaining = self.max_prefills_per_step
         while True:
-            self._fill_slots()
+            before = self.stats["prefills"]
+            self._fill_slots(remaining)
+            if remaining is not None:
+                remaining -= self.stats["prefills"] - before
             n_done = len(finished)
             self._finish_check(finished)
-            if len(finished) == n_done:
+            if len(finished) == n_done or (
+                remaining is not None and remaining <= 0
+            ):
                 break
         active_rows = [r is not None for r in self._slots]
         if any(active_rows):
@@ -406,9 +426,9 @@ class PagedBatchingEngine(BatchingEngine):
                     "for n_slots concurrent worst-case lengths"
                 )
 
-    def _fill_slots(self):
+    def _fill_slots(self, budget=None):
         try:
-            super()._fill_slots()
+            super()._fill_slots(budget)
         except _PoolExhausted:
             pass  # request re-queued; retry after a slot frees blocks
 

@@ -219,3 +219,42 @@ def test_prefill_finish_conditions_checked_for_refilled_slots(setup):
     assert results["a"] == first
     assert results["b"] == []  # stop matched at prefill, truncated
     assert results["c"] == first
+
+
+class TestPrefillBudget:
+    def test_results_unchanged_with_budget(self, setup):
+        cfg, params = setup
+        rng = np.random.default_rng(12)
+        reqs = [
+            (i, rng.integers(0, cfg.vocab_size, int(rng.integers(3, 15))),
+             int(rng.integers(2, 8)))
+            for i in range(6)
+        ]
+        want = {
+            rid: _ref_generate(cfg, params, toks, mx)
+            for rid, toks, mx in reqs
+        }
+        srv = BatchingEngine(
+            cfg, params, n_slots=4, max_len=64, max_prefills_per_step=1
+        )
+        results = srv.run(reqs)
+        assert results == want
+
+    def test_at_most_budget_prefills_per_step(self, setup):
+        cfg, params = setup
+        rng = np.random.default_rng(13)
+        srv = BatchingEngine(
+            cfg, params, n_slots=4, max_len=64, max_prefills_per_step=2
+        )
+        for i in range(4):
+            srv.submit(i, rng.integers(0, cfg.vocab_size, 5), 6)
+        before = srv.stats["prefills"]
+        srv.step()
+        assert srv.stats["prefills"] - before == 2
+        srv.step()
+        assert srv.stats["prefills"] == 4
+
+    def test_bad_budget_rejected(self, setup):
+        cfg, params = setup
+        with pytest.raises(ValueError, match="max_prefills"):
+            BatchingEngine(cfg, params, max_prefills_per_step=0)
