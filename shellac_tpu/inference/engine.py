@@ -46,9 +46,12 @@ def shard_params(cfg: ModelConfig, params, mesh):
     from shellac_tpu.ops.quant import QTensor, quantize_logical_axes
 
     axes = transformer.logical_axes(cfg)
-    q_targets = tuple(
-        k for k, v in params["layers"].items() if isinstance(v, QTensor)
-    )
+    layers = params["layers"]
+    stacks = (list(layers.values())
+              if transformer.is_grouped_layers(layers) else [layers])
+    q_targets = tuple(sorted({
+        k for st in stacks for k, v in st.items() if isinstance(v, QTensor)
+    }))
     if q_targets:
         axes = quantize_logical_axes(axes, q_targets)
     return shard_pytree(params, mesh, axes)

@@ -51,6 +51,25 @@ def grouped_moe(cfg: ModelConfig) -> bool:
     return cfg.moe is not None and cfg.moe_every > 1
 
 
+def is_grouped_layers(layers) -> bool:
+    """Structural twin of grouped_moe for code holding a params/axes
+    layer tree but no config (merge helpers, axes mirrors)."""
+    return set(layers.keys()) == {"dense", "moe"}
+
+
+def map_layer_stacks(layers, fn):
+    """Apply `fn(stack, name)` to each per-layer stack of a layers tree.
+
+    The single place that knows a layers tree is either one flat stack
+    (name=None) or the {"dense", "moe"} sub-stacks of an interleaved
+    layout — consumers (quantization, LoRA, sharding) use this instead
+    of re-implementing the grouped branch.
+    """
+    if is_grouped_layers(layers):
+        return {k: fn(layers[k], k) for k in ("dense", "moe")}
+    return fn(layers, None)
+
+
 def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
     """Initialize a parameter pytree (master copy, cfg.param_dtype)."""
     cfg.validate()

@@ -130,21 +130,22 @@ def quantize_params(
             f"unknown quantization targets {sorted(unknown)}; "
             f"have {sorted(DENSE_TARGETS)}"
         )
-    if cfg.moe is not None and cfg.moe_every > 1:
-        raise NotImplementedError(
-            "weight-only quantization of interleaved dense/MoE stacks "
-            "(moe_every > 1) is not supported yet"
-        )
-    layers = dict(params["layers"])
-    for t in targets:
-        if t not in layers:
-            continue
-        # Stacked dense: (L, in, out) → axis -2. Stacked MoE experts:
-        # (L, E, in, out) → also axis -2. Router stays fp (tiny, and its
-        # logits feed a top-k where small errors flip routing).
-        layers[t] = quantize(layers[t], reduction_axis=-2, dtype=dtype)
+
+    def quantize_stack(stack, _name):
+        out = dict(stack)
+        for t in targets:
+            if t not in out:
+                continue
+            # Stacked dense: (L, in, out) → axis -2. Stacked MoE experts:
+            # (L, E, in, out) → also axis -2. Router stays fp (tiny, and
+            # its logits feed a top-k where small errors flip routing).
+            out[t] = quantize(out[t], reduction_axis=-2, dtype=dtype)
+        return out
+
+    from shellac_tpu.models.transformer import map_layer_stacks
+
     out = dict(params)
-    out["layers"] = layers
+    out["layers"] = map_layer_stacks(params["layers"], quantize_stack)
     return out
 
 
@@ -155,12 +156,17 @@ def quantize_logical_axes(axes, targets: Tuple[str, ...] = DENSE_TARGETS):
     keeps the weight's axes; `scale` (1 on the reduction axis) keeps the
     leading/output axes so it shards with the channels it scales.
     """
-    layers = dict(axes["layers"])
-    for t in targets:
-        if t not in layers:
-            continue
-        wa = layers[t]
-        layers[t] = QTensor(q=wa, scale=(*wa[:-2], None, wa[-1]))
+    def axes_stack(stack, _name):
+        out = dict(stack)
+        for t in targets:
+            if t not in out:
+                continue
+            wa = out[t]
+            out[t] = QTensor(q=wa, scale=(*wa[:-2], None, wa[-1]))
+        return out
+
+    from shellac_tpu.models.transformer import map_layer_stacks
+
     out = dict(axes)
-    out["layers"] = layers
+    out["layers"] = map_layer_stacks(axes["layers"], axes_stack)
     return out

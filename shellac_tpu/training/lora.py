@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import flax.struct
 import jax
@@ -36,17 +36,13 @@ from shellac_tpu.training.losses import cross_entropy
 from shellac_tpu.training.optimizer import make_optimizer
 from shellac_tpu.training.train_state import state_shardings
 
-# Dense 2-D per-layer weights LoRA can target, with their (in, out)
-# logical axis names (the "layers" axis is implicit — all are stacked).
-_TARGET_AXES: Dict[str, Tuple[Optional[str], Optional[str]]] = {
-    "wq": ("embed", "heads"),
-    "wk": ("embed", "kv_heads"),
-    "wv": ("embed", "kv_heads"),
-    "wo": ("heads", "embed"),
-    "w_gate": ("embed", "mlp"),
-    "w_up": ("embed", "mlp"),
-    "w_down": ("mlp", "embed"),
-}
+# Per-layer matmul weights LoRA can target. Shapes are taken from the
+# base parameter tree, so the same names cover dense stacks (L, in, out),
+# MoE expert stacks (L, E, in, out — one adapter pair per expert), and
+# interleaved dense/MoE layouts (grouped under "dense"/"moe" sub-stacks).
+_TARGETS: Tuple[str, ...] = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+)
 
 DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
 
@@ -62,22 +58,11 @@ class LoRAConfig:
         return self.alpha / self.rank
 
     def validate(self, model_cfg: ModelConfig) -> "LoRAConfig":
-        unknown = set(self.targets) - set(_TARGET_AXES)
+        unknown = set(self.targets) - set(_TARGETS)
         if unknown:
             raise ValueError(
                 f"unknown LoRA targets {sorted(unknown)}; "
-                f"have {sorted(_TARGET_AXES)}"
-            )
-        mlp_targets = {"w_gate", "w_up", "w_down"} & set(self.targets)
-        if model_cfg.moe is not None and mlp_targets:
-            raise NotImplementedError(
-                f"LoRA on MoE expert weights ({sorted(mlp_targets)}) is not "
-                "supported; target attention projections instead"
-            )
-        if model_cfg.moe is not None and model_cfg.moe_every > 1:
-            raise NotImplementedError(
-                "LoRA over interleaved dense/MoE stacks (moe_every > 1) "
-                "is not supported; use moe_every=1"
+                f"have {sorted(_TARGETS)}"
             )
         if self.rank < 1:
             raise ValueError(f"rank must be >= 1, got {self.rank}")
@@ -90,7 +75,12 @@ class LoRAConfig:
 def init_lora(
     model_cfg: ModelConfig, lora_cfg: LoRAConfig, key: jax.Array
 ) -> Dict[str, Any]:
-    """Adapter pytree: {"layers": {target: {"a": (L,in,r), "b": (L,r,out)}}}.
+    """Adapter pytree mirroring the base layer layout.
+
+    Flat stacks: {"layers": {target: {"a": (L,in,r), "b": (L,r,out)}}}.
+    MoE expert weights gain an expert axis ((L,E,in,r) / (L,E,r,out) —
+    an independent adapter pair per expert); interleaved stacks mirror
+    the {"dense": ..., "moe": ...} grouping.
 
     B starts at zero so the adapted model is exactly the base model at
     step 0 (standard LoRA init).
@@ -101,50 +91,71 @@ def init_lora(
     )["layers"]
     r = lora_cfg.rank
     pdt = model_cfg.params_dtype
-    out: Dict[str, Any] = {}
-    keys = jax.random.split(key, len(lora_cfg.targets))
-    for t, k in zip(lora_cfg.targets, keys):
-        L, fan_in, fan_out = base_shapes[t].shape
-        a = (jax.random.normal(k, (L, fan_in, r), jnp.float32)
-             * fan_in ** -0.5).astype(pdt)
-        out[t] = {"a": a, "b": jnp.zeros((L, r, fan_out), pdt)}
-    return {"layers": out}
+
+    kd, km = jax.random.split(key)
+    stack_keys = {"dense": kd, "moe": km, None: key}
+
+    def init_stack(stack, name):
+        out: Dict[str, Any] = {}
+        keys = jax.random.split(stack_keys[name], len(lora_cfg.targets))
+        for t, k in zip(lora_cfg.targets, keys):
+            *lead, fan_in, fan_out = stack[t].shape
+            a = (jax.random.normal(k, (*lead, fan_in, r), jnp.float32)
+                 * fan_in ** -0.5).astype(pdt)
+            out[t] = {"a": a, "b": jnp.zeros((*lead, r, fan_out), pdt)}
+        return out
+
+    return {"layers": transformer.map_layer_stacks(base_shapes, init_stack)}
 
 
-def lora_logical_axes(lora_cfg: LoRAConfig) -> Dict[str, Any]:
+def lora_logical_axes(
+    model_cfg: ModelConfig, lora_cfg: LoRAConfig
+) -> Dict[str, Any]:
     """Logical axes matching init_lora's structure.
 
-    The rank axis is replicated; in/out axes inherit the base weight's
-    sharding so the merge einsum is local on each device.
+    Derived from the base weight's own axes: the rank axis is
+    replicated; leading/in/out axes inherit the base sharding (incl.
+    the experts axis for MoE targets) so the merge einsum is local on
+    each device.
     """
-    out: Dict[str, Any] = {}
-    for t in lora_cfg.targets:
-        in_ax, out_ax = _TARGET_AXES[t]
-        out[t] = {
-            "a": ("layers", in_ax, None),
-            "b": ("layers", None, out_ax),
-        }
-    return {"layers": out}
+    base_axes = transformer.logical_axes(model_cfg)["layers"]
+
+    def axes_stack(stack, _name):
+        out: Dict[str, Any] = {}
+        for t in lora_cfg.targets:
+            wa = stack[t]
+            out[t] = {
+                "a": (*wa[:-1], None),
+                "b": (*wa[:-2], None, wa[-1]),
+            }
+        return out
+
+    return {"layers": transformer.map_layer_stacks(base_axes, axes_stack)}
 
 
 def merge_lora(params, lora, lora_cfg: LoRAConfig):
     """Return params with `W + scale * A @ B` for each targeted weight.
 
-    The einsum is batched over the stacked layer axis; computed in fp32
-    then cast back to the base weight dtype.
+    One batched einsum per target over all leading axes (stacked layers,
+    groups, experts); computed in fp32 then cast back to the base weight
+    dtype.
     """
-    merged_layers = dict(params["layers"])
-    for t, ab in lora["layers"].items():
-        w = merged_layers[t]
-        delta = jnp.einsum(
-            "lir,lro->lio",
-            ab["a"].astype(jnp.float32),
-            ab["b"].astype(jnp.float32),
-        )
-        merged_layers[t] = (w.astype(jnp.float32)
-                            + lora_cfg.scale * delta).astype(w.dtype)
+    def merge_stack(stack, name):
+        lstack = lora["layers"][name] if name else lora["layers"]
+        merged = dict(stack)
+        for t, ab in lstack.items():
+            w = merged[t]
+            delta = jnp.einsum(
+                "...ir,...ro->...io",
+                ab["a"].astype(jnp.float32),
+                ab["b"].astype(jnp.float32),
+            )
+            merged[t] = (w.astype(jnp.float32)
+                         + lora_cfg.scale * delta).astype(w.dtype)
+        return merged
+
     out = dict(params)
-    out["layers"] = merged_layers
+    out["layers"] = transformer.map_layer_stacks(params["layers"], merge_stack)
     return out
 
 
@@ -181,7 +192,9 @@ def init_lora_state(
     if mesh is None:
         return jax.jit(init_fn)(key)
     abstract = jax.eval_shape(init_fn, key)
-    shardings = state_shardings(mesh, abstract, lora_logical_axes(lora_cfg))
+    shardings = state_shardings(
+        mesh, abstract, lora_logical_axes(model_cfg, lora_cfg)
+    )
     return jax.jit(init_fn, out_shardings=shardings)(key)
 
 
@@ -246,7 +259,9 @@ def make_lora_train_step(
         from shellac_tpu.training.trainer import batch_shardings
 
         abstract_state = jax.eval_shape(lambda s: s, state)
-        st_sh = state_shardings(mesh, abstract_state, lora_logical_axes(lora_cfg))
+        st_sh = state_shardings(
+            mesh, abstract_state, lora_logical_axes(model_cfg, lora_cfg)
+        )
         abstract_p = jax.eval_shape(lambda p: p, base_params)
         p_sh = state_shardings(
             mesh, abstract_p, transformer.logical_axes(model_cfg)

@@ -104,6 +104,34 @@ class TestQuantizedForward:
         paths_a = {tuple(str(k) for k in p): len(leaf) for p, leaf in flat_a}
         assert paths_p == paths_a
 
+    def test_interleaved_moe_forward(self):
+        """moe_every > 1: both the dense and moe sub-stacks quantize."""
+        cfg = get_model_config("tiny-moe-interleaved").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = quantize_params(cfg, params)
+        assert isinstance(qparams["layers"]["dense"]["wq"], QTensor)
+        assert isinstance(qparams["layers"]["moe"]["w_gate"], QTensor)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab_size)
+        l_fp = transformer.forward(cfg, params, tokens)
+        l_q = transformer.forward(cfg, qparams, tokens)
+        scale = float(jnp.std(l_fp)) + 1e-6
+        rel = float(jnp.max(jnp.abs(l_q - l_fp))) / scale
+        assert rel < 0.15, f"relative logit error {rel}"
+
+    def test_interleaved_axes_match_params(self):
+        cfg = get_model_config("tiny-moe-interleaved").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = quantize_params(cfg, params)
+        qaxes = quantize_logical_axes(transformer.logical_axes(cfg))
+        flat_p = jax.tree_util.tree_flatten_with_path(qparams)[0]
+        flat_a = jax.tree_util.tree_flatten_with_path(
+            qaxes, is_leaf=lambda x: isinstance(x, tuple)
+        )[0]
+        paths_p = {tuple(str(k) for k in p): leaf.ndim for p, leaf in flat_p}
+        paths_a = {tuple(str(k) for k in p): len(leaf) for p, leaf in flat_a}
+        assert paths_p == paths_a
+
     def test_sharded_quantized_forward(self, mesh_fsdp8):
         from shellac_tpu.parallel.sharding import shard_pytree
 

@@ -58,6 +58,23 @@ class TestShardedEngine:
         assert out.tokens.shape == (2, 8)
         assert np.isfinite(np.asarray(out.logprobs)).all()
 
+    def test_quantized_interleaved_sharded_generate(self):
+        """Grouped (moe_every > 1) quantized trees shard and decode."""
+        cfg = get_model_config("tiny-moe-interleaved").replace(dtype="float32")
+        # fsdp=4 divides num_experts=4 (the MoE mesh convention).
+        mesh = make_mesh(ParallelConfig(fsdp=4, tp=2))
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        qparams = quantize_params(cfg, params)
+        sharded = shard_params(cfg, qparams, mesh)
+        assert isinstance(sharded["layers"]["dense"]["wq"], QTensor)
+        assert isinstance(sharded["layers"]["moe"]["w_gate"], QTensor)
+        # Batch divides the dp*fsdp axes (KV cache batch dim shards there).
+        out = Engine(cfg, sharded, temperature=0.0, mesh=mesh).generate(
+            jnp.ones((4, 4), jnp.int32), max_new_tokens=8
+        )
+        assert out.tokens.shape == (4, 8)
+        assert np.isfinite(np.asarray(out.logprobs)).all()
+
     def test_ragged_prompts_sharded(self, mesh_tp):
         cfg = _tiny()
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
