@@ -314,6 +314,8 @@ def cmd_serve(args):
     from shellac_tpu.inference.server import serve
     from shellac_tpu.training.tokenizer import get_tokenizer
 
+    if args.prefix_cache and not args.paged:
+        raise SystemExit("--prefix-cache requires --paged")
     cfg = _model_config(args)
     params = _restore_params(args, cfg)
     if args.quantize:
@@ -330,6 +332,7 @@ def cmd_serve(args):
             temperature=args.temperature, eos_id=args.eos_id,
             decode_ticks=args.decode_ticks,
             max_prefills_per_step=args.max_prefills_per_step,
+            prefix_cache=args.prefix_cache,
         )
     serve(
         cfg, params,
@@ -475,6 +478,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--eos-id", type=int, default=None, dest="eos_id")
     s.add_argument("--paged", action="store_true",
                    help="paged (block-pool) KV cache")
+    s.add_argument("--prefix-cache", action="store_true", dest="prefix_cache",
+                   help="reuse cached KV blocks across prompts sharing a "
+                        "prefix (requires --paged)")
     s.add_argument("--decode-ticks", type=int, default=1, dest="decode_ticks",
                    help="decode steps per host sync (throughput vs "
                         "per-token latency)")

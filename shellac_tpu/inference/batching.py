@@ -30,7 +30,8 @@ upstream serving engine to cite.
 from __future__ import annotations
 
 import functools
-from collections import deque
+import hashlib
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -211,6 +212,28 @@ class BatchingEngine:
     def _release_slot(self, slot: int) -> None:
         """Hook after a request leaves `slot` (paged: free its blocks)."""
 
+    def _run_prefill(self, slot: int, req: _Request) -> jax.Array:
+        """Run the (bucketed, jitted) prefill for `req`; returns the
+        first sampled token as a device scalar."""
+        s = req.tokens.size
+        # Cap the bucket at max_len: a pad larger than the cache
+        # (dense) or the block table (paged) would write out of
+        # range — loudly for dense, silently-clamped for paged.
+        pad = min(_bucket(s), self.max_len)
+        if pad not in self._prefill_jit:
+            self._prefill_jit[pad] = jax.jit(
+                self._prefill_impl, static_argnums=()
+            )
+        padded = np.zeros((1, pad), np.int32)
+        padded[0, :s] = req.tokens
+        self._key, sub = jax.random.split(self._key)
+        cache, first = self._prefill_jit[pad](
+            self.params, self._cache, jnp.asarray(padded),
+            jnp.asarray([s], jnp.int32), slot, sub,
+        )
+        self._cache = cache
+        return first
+
     def _fill_slots(self, budget: Optional[int] = None):
         done = 0
         for i in range(self.n_slots):
@@ -221,23 +244,7 @@ class BatchingEngine:
             done += 1
             req = self._queue.popleft()
             self._prepare_slot(i, req)
-            s = req.tokens.size
-            # Cap the bucket at max_len: a pad larger than the cache
-            # (dense) or the block table (paged) would write out of
-            # range — loudly for dense, silently-clamped for paged.
-            pad = min(_bucket(s), self.max_len)
-            if pad not in self._prefill_jit:
-                self._prefill_jit[pad] = jax.jit(
-                    self._prefill_impl, static_argnums=()
-                )
-            padded = np.zeros((1, pad), np.int32)
-            padded[0, :s] = req.tokens
-            self._key, sub = jax.random.split(self._key)
-            cache, first = self._prefill_jit[pad](
-                self.params, self._cache, jnp.asarray(padded),
-                jnp.asarray([s], jnp.int32), i, sub,
-            )
-            self._cache = cache
+            first = self._run_prefill(i, req)
             first_tok = int(first)
             self._cur = self._cur.at[i].set(first_tok)
             self._slots[i] = req
@@ -341,6 +348,17 @@ class PagedBatchingEngine(BatchingEngine):
 
     Block 0 is reserved scratch: unallocated table entries point at it,
     so out-of-range reads/writes land there and are masked downstream.
+
+    prefix_cache=True adds automatic prefix caching (the public
+    PagedAttention/vLLM idea, re-built for this pool): full prompt
+    blocks are content-hashed with a position-dependent chain, kept in
+    the pool after release (refcounted, LRU-evicted only when the free
+    list runs dry), and new prompts attach the longest matching block
+    chain read-only — prefill then computes only the unmatched suffix,
+    attending over the cached prefix KV through the block table. Shared
+    blocks are never rewritten: a slot's writes start at its first
+    owned block (the match is capped so at least one prompt token is
+    computed, which also yields the last-token logits sampling needs).
     """
 
     def __init__(
@@ -352,10 +370,12 @@ class PagedBatchingEngine(BatchingEngine):
         max_len: Optional[int] = None,
         block_size: int = 16,
         pool_tokens: Optional[int] = None,
+        prefix_cache: bool = False,
         **kw,
     ):
         super().__init__(cfg, params, n_slots=n_slots, max_len=max_len, **kw)
         self.block_size = block_size
+        self.prefix_cache = prefix_cache
         max_blocks_per_slot = -(-self.max_len // block_size)
         if pool_tokens is None:
             pool_tokens = n_slots * self.max_len // 2
@@ -365,8 +385,39 @@ class PagedBatchingEngine(BatchingEngine):
         )
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))  # 0 = scratch
         self._slot_blocks: List[List[int]] = [[] for _ in range(n_slots)]
+        # Prefix cache state (all host-side; empty when disabled):
+        # hash -> block id, insertion/touch-ordered so the front is LRU;
+        # _block_ref counts slots currently attached to a cached block
+        # (membership also marks "cached": release keeps these pooled
+        # instead of freeing them); ref == 0 means evictable.
+        self._hash_to_block: "OrderedDict[bytes, int]" = OrderedDict()
+        self._block_ref: Dict[int, int] = {}
+        self._slot_prefix_len: List[int] = [0] * n_slots
+        self._prefix_prefill_jit: Dict[int, Any] = {}
+        if prefix_cache:
+            self.stats.update({
+                "prefix_hit_tokens": 0,
+                "prefix_query_tokens": 0,
+                "prefix_evictions": 0,
+            })
 
     # ---- allocator ---------------------------------------------------
+
+    def _evictable(self) -> int:
+        return sum(1 for r in self._block_ref.values() if r == 0)
+
+    def _alloc_block(self) -> int:
+        """Pop a free block, evicting the LRU unreferenced cached block
+        when the free list is dry. Caller checks capacity first."""
+        if self._free:
+            return self._free.pop()
+        for h, blk in self._hash_to_block.items():  # front = LRU
+            if self._block_ref[blk] == 0:
+                del self._hash_to_block[h]
+                del self._block_ref[blk]
+                self.stats["prefix_evictions"] += 1
+                return blk
+        raise RuntimeError("_alloc_block called with no capacity")
 
     def _ensure_blocks(self, slot: int, total_tokens: int) -> bool:
         """Grow slot's table to cover total_tokens; False if pool empty."""
@@ -374,9 +425,9 @@ class PagedBatchingEngine(BatchingEngine):
         have = len(self._slot_blocks[slot])
         if need <= have:
             return True
-        if need - have > len(self._free):
+        if need - have > len(self._free) + self._evictable():
             return False
-        new_ids = [self._free.pop() for _ in range(need - have)]
+        new_ids = [self._alloc_block() for _ in range(need - have)]
         self._slot_blocks[slot].extend(new_ids)
         idx = jnp.arange(have, need, dtype=jnp.int32)
         tables = self._cache.tables.at[slot, idx].set(
@@ -385,19 +436,95 @@ class PagedBatchingEngine(BatchingEngine):
         self._cache = self._cache.replace(tables=tables)
         return True
 
+    # ---- prefix cache ------------------------------------------------
+
+    def _chain_hashes(self, tokens: np.ndarray) -> List[bytes]:
+        """Position-dependent content hashes of the full token blocks:
+        h_j = H(h_{j-1} || block_j), so a block only matches when its
+        entire prefix matches too (and therefore occupies the same
+        absolute positions — required for RoPE'd cached K)."""
+        bs = self.block_size
+        out: List[bytes] = []
+        h = b""
+        for j in range(tokens.size // bs):
+            h = hashlib.blake2b(
+                h + tokens[j * bs:(j + 1) * bs].tobytes(), digest_size=16
+            ).digest()
+            out.append(h)
+        return out
+
+    def _match_prefix(self, req) -> Tuple[List[bytes], int]:
+        """Longest cached block chain covering a strict prompt prefix."""
+        hashes = self._chain_hashes(req.tokens)
+        # Cap: at least one prompt token must be computed (its logits
+        # seed sampling; full-match reuse would leave none).
+        cap = (req.tokens.size - 1) // self.block_size
+        m = 0
+        for h in hashes[:cap]:
+            if h not in self._hash_to_block:
+                break
+            m += 1
+        return hashes, m
+
     def _prepare_slot(self, slot: int, req) -> None:
         # Reserve the FULL footprint (prompt + generation budget) at
         # admission: growth mid-decode could exhaust the pool and there
         # is no good victim to evict at that point.
         need = req.tokens.size + req.max_new + 1
+        if not self.prefix_cache:
+            if not self._ensure_blocks(slot, need):
+                # Pool exhausted: put the request back and let it wait.
+                self._queue.appendleft(req)
+                raise _PoolExhausted()
+            return
+
+        hashes, m = self._match_prefix(req)
+        matched = [self._hash_to_block[h] for h in hashes[:m]]
+        for h, blk in zip(hashes[:m], matched):
+            self._block_ref[blk] += 1
+            self._hash_to_block.move_to_end(h)  # LRU touch
+        if matched:
+            self._slot_blocks[slot] = list(matched)
+            tables = self._cache.tables.at[
+                slot, jnp.arange(m, dtype=jnp.int32)
+            ].set(jnp.asarray(matched, jnp.int32))
+            self._cache = self._cache.replace(tables=tables)
         if not self._ensure_blocks(slot, need):
-            # Pool exhausted: put the request back and let it wait.
+            # Roll back the attach (blocks stay cached) and requeue.
+            for blk in matched:
+                self._block_ref[blk] -= 1
+            self._slot_blocks[slot] = []
+            row = jnp.zeros((self._cache.max_blocks,), jnp.int32)
+            self._cache = self._cache.replace(
+                tables=self._cache.tables.at[slot].set(row)
+            )
             self._queue.appendleft(req)
             raise _PoolExhausted()
+        # Register the slot's own full prompt blocks: prefill fills
+        # them deterministically before any later admission can match
+        # them (_fill_slots runs prepare+prefill per request, in order).
+        for j in range(m, req.tokens.size // self.block_size):
+            h = hashes[j]
+            if h in self._hash_to_block:
+                continue  # identical chain already cached elsewhere
+            blk = self._slot_blocks[slot][j]
+            self._hash_to_block[h] = blk
+            self._block_ref[blk] = 1
+        self._slot_prefix_len[slot] = m * self.block_size
+        self.stats["prefix_hit_tokens"] += m * self.block_size
+        self.stats["prefix_query_tokens"] += req.tokens.size
 
     def _release_slot(self, slot: int) -> None:
-        self._free.extend(reversed(self._slot_blocks[slot]))
+        if self.prefix_cache:
+            for blk in self._slot_blocks[slot]:
+                if blk in self._block_ref:
+                    self._block_ref[blk] -= 1  # stays cached, evictable at 0
+                else:
+                    self._free.append(blk)
+        else:
+            self._free.extend(reversed(self._slot_blocks[slot]))
         self._slot_blocks[slot] = []
+        self._slot_prefix_len[slot] = 0
         row = jnp.zeros((self._cache.max_blocks,), jnp.int32)
         self._cache = self._cache.replace(
             tables=self._cache.tables.at[slot].set(row)
@@ -433,6 +560,70 @@ class PagedBatchingEngine(BatchingEngine):
             pass  # request re-queued; retry after a slot frees blocks
 
     # ---- jitted programs --------------------------------------------
+
+    def _run_prefill(self, slot: int, req) -> jax.Array:
+        """Prefix-cached prefill: compute only the unmatched suffix."""
+        p = self._slot_prefix_len[slot] if self.prefix_cache else 0
+        if p == 0:
+            return super()._run_prefill(slot, req)
+        suffix = req.tokens[p:]
+        s = suffix.size  # >= 1 by the match cap
+        # Cap the pad at the table space REMAINING past the prefix:
+        # writes start at offset p, and padded positions beyond the
+        # table would gather-clamp onto the slot's last real block,
+        # corrupting just-written suffix KV (s <= max_len - p always,
+        # so the cap never cuts real tokens).
+        pad = min(_bucket(s), self.max_len - p)
+        if pad not in self._prefix_prefill_jit:
+            self._prefix_prefill_jit[pad] = jax.jit(self._prefix_prefill_impl)
+        padded = np.zeros((1, pad), np.int32)
+        padded[0, :s] = suffix
+        self._key, sub = jax.random.split(self._key)
+        cache, first = self._prefix_prefill_jit[pad](
+            self.params, self._cache, jnp.asarray(padded),
+            jnp.asarray([s], jnp.int32), jnp.asarray([p], jnp.int32),
+            slot, sub,
+        )
+        self._cache = cache
+        return first
+
+    def _prefix_prefill_impl(
+        self, params, cache, tokens, suffix_len, prefix_len, slot, key
+    ):
+        """Continue from `prefix_len` cached tokens: a batch-1 view of
+        the slot's table row over the shared pool, forwarded with
+        fresh_cache=False so the suffix attends to the cached prefix KV
+        (and itself) through the table. Suffix K/V writes land in the
+        slot's own blocks — shared prefix blocks are upstream of every
+        written position, so they stay read-only.
+
+        attn_impl is pinned to "ref": the chunked continuation attends
+        over the gathered block view once per request; the flash decode
+        kernel targets s<=8 steady-state decode and would only fall
+        back (warning) on a prefill-sized s.
+        """
+        from shellac_tpu.inference.kvcache import PagedKVCache
+
+        row = jax.lax.dynamic_slice_in_dim(cache.tables, slot, 1, 0)
+        view = PagedKVCache(
+            k=cache.k, v=cache.v, tables=row,
+            lengths=prefix_len.astype(jnp.int32),
+        )
+        logits, view = transformer.forward_with_cache(
+            self.cfg, params, tokens, view, new_tokens_len=suffix_len,
+            fresh_cache=False, attn_impl="ref",
+        )
+        last = jnp.take_along_axis(
+            logits, (suffix_len - 1)[:, None, None].astype(jnp.int32), axis=1
+        )[0, 0]
+        first = self._sampler(key, last)
+        cache = cache.replace(
+            k=view.k, v=view.v,
+            lengths=jax.lax.dynamic_update_slice(
+                cache.lengths, view.lengths, (slot,)
+            ),
+        )
+        return cache, first
 
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key):
         """Dense mini-prefill, then scatter through the slot's table."""

@@ -1,0 +1,234 @@
+"""Automatic prefix caching over the paged block pool.
+
+Invariants:
+  - caching is invisible to the math: greedy output for every request
+    is bit-identical to the single-request Engine, whether its prefix
+    was computed or reused, shared blocks live or released;
+  - full prompt blocks persist after release and later prompts attach
+    the longest chain (stats prove blocks were actually reused);
+  - refcounted sharing: concurrent requests on the same prefix never
+    rewrite a shared block;
+  - LRU eviction reclaims unreferenced cached blocks when the free
+    list runs dry, and evicted content simply misses (recompute, same
+    output).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import jax
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.batching import PagedBatchingEngine
+from shellac_tpu.inference.engine import Engine
+from shellac_tpu.models import transformer
+
+
+def _tiny(**kw):
+    return get_model_config("tiny").replace(dtype="float32", **kw)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _tiny()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _ref(cfg, params, tokens, max_new):
+    eng = Engine(cfg, params, temperature=0.0)
+    out = eng.generate(
+        jnp.asarray(np.asarray(tokens, np.int32)[None]), max_new_tokens=max_new
+    )
+    return np.asarray(out.tokens)[0].tolist()
+
+
+def _engine(cfg, params, **kw):
+    kw.setdefault("n_slots", 4)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prefix_cache", True)
+    return PagedBatchingEngine(cfg, params, temperature=0.0, **kw)
+
+
+def _prompts(shared_len=40, n=4, tail=6, seed=3):
+    """Prompts sharing a long common prefix with distinct tails."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(0, 256, size=shared_len)
+    return [
+        np.concatenate([shared, rng.integers(0, 256, size=tail)]).astype(
+            np.int32
+        )
+        for _ in range(n)
+    ]
+
+
+class TestPrefixReuse:
+    def test_sequential_same_prompt_bit_match(self, setup):
+        """Second submission of a prompt hits the cache and still
+        matches the single-request engine exactly."""
+        cfg, params = setup
+        eng = _engine(cfg, params)
+        prompt = _prompts(n=1)[0]
+        want = _ref(cfg, params, prompt, 12)
+        r1 = eng.run([("a", prompt, 12)])
+        assert eng.stats["prefix_hit_tokens"] == 0
+        r2 = eng.run([("b", prompt, 12)])
+        # Full blocks minus the last (>=1 computed token rule): the
+        # prompt has 46 tokens, bs=8 -> 5 full blocks, all matchable.
+        assert eng.stats["prefix_hit_tokens"] == 40
+        assert r1["a"] == want
+        assert r2["b"] == want
+
+    def test_shared_prefix_across_tails(self, setup):
+        """Different tails on one system prefix: all bit-match, later
+        requests reuse the shared chain."""
+        cfg, params = setup
+        eng = _engine(cfg, params)
+        prompts = _prompts(shared_len=40, n=4)
+        for i, p in enumerate(prompts):
+            got = eng.run([(i, p, 10)])[i]
+            assert got == _ref(cfg, params, p, 10), f"prompt {i}"
+        # Requests 1..3 each matched the 40-token shared chain.
+        assert eng.stats["prefix_hit_tokens"] == 3 * 40
+
+    def test_concurrent_shared_prefix(self, setup):
+        """All requests in flight at once: shared blocks are attached
+        read-only to several slots simultaneously."""
+        cfg, params = setup
+        eng = _engine(cfg, params)
+        prompts = _prompts(shared_len=32, n=4)
+        # Warm the cache so the concurrent batch all hits.
+        eng.run([("warm", prompts[0], 4)])
+        results = eng.run([(i, p, 10) for i, p in enumerate(prompts)])
+        for i, p in enumerate(prompts):
+            assert results[i] == _ref(cfg, params, p, 10), f"prompt {i}"
+        assert eng.stats["prefix_hit_tokens"] >= 4 * 32
+
+    def test_exact_multiple_of_block_size(self, setup):
+        """Prompt length a multiple of bs: the last full block is NOT
+        matched (one token must be computed for its logits)."""
+        cfg, params = setup
+        eng = _engine(cfg, params)
+        prompt = _prompts(shared_len=32, n=1, tail=0)[0]
+        assert prompt.size == 32
+        want = _ref(cfg, params, prompt, 8)
+        assert eng.run([("a", prompt, 8)])["a"] == want
+        assert eng.run([("b", prompt, 8)])["b"] == want
+        # 4 full blocks, cap at 3: 24 tokens reused, 8 computed.
+        assert eng.stats["prefix_hit_tokens"] == 24
+
+    def test_short_prompt_never_matches(self, setup):
+        """Prompts shorter than bs+1 can't reuse (no full block leaves
+        a computable token) but must still work."""
+        cfg, params = setup
+        eng = _engine(cfg, params)
+        prompt = np.arange(5, dtype=np.int32) + 1
+        want = _ref(cfg, params, prompt, 6)
+        assert eng.run([("a", prompt, 6)])["a"] == want
+        assert eng.run([("b", prompt, 6)])["b"] == want
+        assert eng.stats["prefix_hit_tokens"] == 0
+
+    def test_disabled_by_default(self, setup):
+        cfg, params = setup
+        eng = PagedBatchingEngine(
+            cfg, params, temperature=0.0, n_slots=2, max_len=64,
+            block_size=8,
+        )
+        prompt = _prompts(n=1)[0]
+        eng.run([("a", prompt, 4)])
+        eng.run([("b", prompt, 4)])
+        assert "prefix_hit_tokens" not in eng.stats
+
+
+class TestBlockAccounting:
+    def test_release_keeps_cached_blocks_pooled(self, setup):
+        """After drain, every block is either free or cached with
+        refcount 0; the pool never leaks."""
+        cfg, params = setup
+        eng = _engine(cfg, params)
+        prompts = _prompts(shared_len=24, n=3)
+        eng.run([(i, p, 6) for i, p in enumerate(prompts)])
+        n_blocks = eng._cache.k.shape[1]
+        cached = set(eng._hash_to_block.values())
+        assert all(r == 0 for r in eng._block_ref.values())
+        assert len(set(eng._free) | cached) == n_blocks - 1  # minus scratch
+        assert not (set(eng._free) & cached)
+
+    def test_eviction_reclaims_lru(self, setup):
+        """A pool too small to cache everything evicts LRU chains; old
+        prompts then miss but still produce exact output."""
+        cfg, params = setup
+        # Tiny pool: 2 slots' worth of tokens.
+        eng = _engine(cfg, params, n_slots=2, max_len=64,
+                      pool_tokens=128)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 256, size=33).astype(np.int32)
+                   for _ in range(6)]
+        for i, p in enumerate(prompts):
+            assert eng.run([(i, p, 6)])[i] == _ref(cfg, params, p, 6), i
+        assert eng.stats["prefix_evictions"] > 0
+        # The first prompt's chain was evicted: re-running it misses
+        # (no new hits) yet still matches.
+        hits = eng.stats["prefix_hit_tokens"]
+        assert eng.run([("re", prompts[0], 6)])["re"] == _ref(
+            cfg, params, prompts[0], 6
+        )
+        assert eng.stats["prefix_hit_tokens"] == hits
+
+    def test_deep_hit_near_max_len(self, setup):
+        """Suffix pad must not run past the block table: a 120-token
+        cached prefix of a 126-token prompt at max_len=128 once wrote
+        padded positions through gather-clamp onto the last real
+        block, corrupting live suffix KV."""
+        cfg, params = setup
+        rng = np.random.default_rng(7)
+        base = rng.integers(0, 256, size=121).astype(np.int32)
+        long = np.concatenate(
+            [base[:120], rng.integers(0, 256, size=6)]
+        ).astype(np.int32)
+        eng = _engine(cfg, params, n_slots=2, max_len=128)
+        assert eng.run([("w", base, 1)])["w"] == _ref(cfg, params, base, 1)
+        hits = eng.stats["prefix_hit_tokens"]
+        got = eng.run([("x", long, 1)])["x"]
+        assert eng.stats["prefix_hit_tokens"] - hits == 120
+        assert got == _ref(cfg, params, long, 1)
+
+    def test_pool_exhaustion_requeues_with_prefix(self, setup):
+        """Admission rolls back a matched prefix cleanly when the pool
+        can't cover the rest, and the request completes later."""
+        cfg, params = setup
+        eng = _engine(cfg, params, n_slots=2, max_len=64, pool_tokens=96)
+        rng = np.random.default_rng(1)
+        shared = rng.integers(0, 256, size=24)
+        prompts = [
+            np.concatenate([shared, rng.integers(0, 256, size=4)]).astype(
+                np.int32
+            )
+            for _ in range(4)
+        ]
+        results = eng.run([(i, p, 24) for i, p in enumerate(prompts)])
+        for i, p in enumerate(prompts):
+            assert results[i] == _ref(cfg, params, p, 24), f"prompt {i}"
+
+
+class TestPrefixVariants:
+    def test_gqa_model(self):
+        cfg = get_model_config("tiny-gqa").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = _engine(cfg, params)
+        prompt = _prompts(n=1)[0]
+        want = _ref(cfg, params, prompt, 8)
+        assert eng.run([("a", prompt, 8)])["a"] == want
+        assert eng.run([("b", prompt, 8)])["b"] == want
+        assert eng.stats["prefix_hit_tokens"] > 0
+
+    def test_windowed_model(self):
+        cfg = _tiny(attn_window=16)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = _engine(cfg, params)
+        prompt = _prompts(n=1)[0]
+        want = _ref(cfg, params, prompt, 8)
+        assert eng.run([("a", prompt, 8)])["a"] == want
+        assert eng.run([("b", prompt, 8)])["b"] == want
+        assert eng.stats["prefix_hit_tokens"] > 0
