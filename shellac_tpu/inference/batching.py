@@ -51,6 +51,11 @@ class _Request:
     tokens: np.ndarray  # (S,) int32 prompt
     max_new: int
     stop: Optional[List[List[int]]] = None  # token-id stop sequences
+    # Generated tokens so far. INVARIANT (the server's streaming path
+    # reads this between engine steps): `out` only ever grows, except
+    # that a stop-sequence match removes exactly the matched suffix
+    # (<= the longest stop length) once, at completion. Streaming holds
+    # back that many tokens so an emitted token can never be retracted.
     out: List[int] = field(default_factory=list)
 
     def hit_stop(self) -> Optional[int]:

@@ -10,6 +10,13 @@ API:
   POST /generate  {"tokens": [1,2,3] | "text": "...", "max_new": 32,
                    "stop": [[7,8], "..."]?}
                   -> {"id", "tokens", "text"?}
+                  With "stream": true the response is newline-delimited
+                  JSON written as tokens are generated: zero or more
+                  {"tokens": [...]} delta lines, then one
+                  {"done": true, "tokens": all, "text"?} line. With stop
+                  sequences, the longest stop length is held back from
+                  deltas so a token that a later match would truncate is
+                  never streamed.
   GET  /health    -> {"ok": true, "pending": N}
   GET  /stats     -> engine counters (requests/tokens/steps/prefills,
                      slots busy, decode_ticks)
@@ -31,12 +38,25 @@ from shellac_tpu.inference.batching import BatchingEngine
 
 
 class _Pending:
-    __slots__ = ("event", "result", "error")
+    __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback")
 
-    def __init__(self):
+    def __init__(self, stream: bool = False, holdback: int = 0):
         self.event = threading.Event()
         self.result = None
         self.error: Optional[str] = None
+        # Streaming requests also get a chunk queue: lists of newly
+        # generated token ids, then a None sentinel at completion.
+        self.chunks: Optional[queue.Queue] = queue.Queue() if stream else None
+        self.emitted = 0
+        # Tokens withheld from deltas: a stop-sequence match truncates
+        # up to max(len(stop)) tokens at the end, so anything closer to
+        # the tail than that may still disappear.
+        self.holdback = holdback
+
+    def finish(self):
+        if self.chunks is not None:
+            self.chunks.put(None)
+        self.event.set()
 
 
 class InferenceServer:
@@ -72,7 +92,7 @@ class InferenceServer:
             self._stop.set()
             for p in list(self._pending.values()):
                 p.error = self._fatal
-                p.event.set()
+                p.finish()
             self._pending.clear()
             while True:
                 try:
@@ -82,7 +102,7 @@ class InferenceServer:
                 p = self._pending.pop(rid, None)
                 if p is not None:
                     p.error = self._fatal
-                    p.event.set()
+                    p.finish()
 
     def _run(self):
         while not self._stop.is_set():
@@ -98,13 +118,31 @@ class InferenceServer:
                 except ValueError as e:
                     p = self._pending.pop(rid)
                     p.error = str(e)
-                    p.event.set()
+                    p.finish()
             if self.engine.pending:
-                for rid, out in self.engine.step():
+                finished = self.engine.step()
+                fin = {rid for rid, _ in finished}
+                # Stream deltas for requests still in flight. holdback
+                # trails the tail by the longest stop length, so a
+                # token a later stop match would truncate is never
+                # emitted (out only ever shrinks by a matched stop).
+                for req in self.engine._slots:
+                    if req is None or req.rid in fin:
+                        continue
+                    p = self._pending.get(req.rid)
+                    if p is None or p.chunks is None:
+                        continue
+                    upto = max(p.emitted, len(req.out) - p.holdback)
+                    if upto > p.emitted:
+                        p.chunks.put(list(req.out[p.emitted:upto]))
+                        p.emitted = upto
+                for rid, out in finished:
                     p = self._pending.pop(rid, None)
                     if p is not None:
                         p.result = out
-                        p.event.set()
+                        if p.chunks is not None and len(out) > p.emitted:
+                            p.chunks.put(list(out[p.emitted:]))
+                        p.finish()
             elif not drained:
                 # Idle: block briefly on the queue instead of spinning.
                 try:
@@ -115,12 +153,12 @@ class InferenceServer:
 
     # ---- client surface ---------------------------------------------
 
-    def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
-                 stop=None):
+    def _submit(self, tokens, max_new: int, stop, *, stream: bool) -> _Pending:
         if self._fatal is not None:
             raise RuntimeError(self._fatal)
         rid = next(self._ids)
-        p = _Pending()
+        holdback = max((len(s) for s in stop), default=0) if stop else 0
+        p = _Pending(stream=stream, holdback=holdback)
         self._pending[rid] = p
         self._submit_q.put((rid, np.asarray(tokens, np.int32), max_new, stop))
         if self._fatal is not None and not p.event.is_set():
@@ -128,17 +166,42 @@ class InferenceServer:
             # missed this request — fail it ourselves.
             self._pending.pop(rid, None)
             raise RuntimeError(self._fatal)
+        return p
+
+    def _raise(self, p: _Pending):
+        # Scheduler death is a server fault (HTTP 500), not a bad
+        # request (400): keep the error classes distinct.
+        if self._fatal is not None and p.error == self._fatal:
+            raise RuntimeError(p.error)
+        raise ValueError(p.error)
+
+    def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
+                 stop=None):
+        p = self._submit(tokens, max_new, stop, stream=False)
         if not p.event.wait(timeout):
-            raise TimeoutError(f"request {rid} timed out")
+            raise TimeoutError("request timed out")
         if p.error is not None:
-            # Scheduler death is a server fault (HTTP 500), not a bad
-            # request (400): keep the error classes distinct.
-            if self._fatal is not None and p.error == self._fatal:
-                raise RuntimeError(p.error)
-            raise ValueError(p.error)
+            self._raise(p)
         return p.result
 
-    def handle(self, payload: dict) -> dict:
+    def generate_stream(self, tokens, max_new: int,
+                        timeout: Optional[float] = None, stop=None):
+        """Yield ("delta", [token ids]) as generation progresses, then
+        ("done", full output). `timeout` bounds the wait per chunk."""
+        p = self._submit(tokens, max_new, stop, stream=True)
+        while True:
+            try:
+                chunk = p.chunks.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError("request timed out mid-stream")
+            if chunk is None:
+                break
+            yield ("delta", chunk)
+        if p.error is not None:
+            self._raise(p)
+        yield ("done", p.result)
+
+    def _parse(self, payload: dict):
         if "tokens" in payload:
             tokens = np.asarray(payload["tokens"], np.int32)
         elif "text" in payload:
@@ -169,6 +232,10 @@ class InferenceServer:
                 # dropped connection.
                 raise ValueError(f"bad stop sequences: {e}")
             stop = parsed
+        return tokens, max_new, stop
+
+    def handle(self, payload: dict) -> dict:
+        tokens, max_new, stop = self._parse(payload)
         out = self.generate(
             tokens, max_new, timeout=payload.get("timeout"), stop=stop
         )
@@ -176,6 +243,23 @@ class InferenceServer:
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(out)
         return result
+
+    def handle_stream(self, payload: dict):
+        """Yield response dicts for a streaming request: delta lines
+        {"tokens": [...]}, then {"done": true, "tokens", "text"?}.
+        Parse errors raise before the first yield (clean HTTP 400)."""
+        tokens, max_new, stop = self._parse(payload)
+        stream = self.generate_stream(
+            tokens, max_new, timeout=payload.get("timeout"), stop=stop
+        )
+        for kind, val in stream:
+            if kind == "delta":
+                yield {"tokens": val}
+            else:
+                final: Dict[str, Any] = {"done": True, "tokens": val}
+                if self.tokenizer is not None:
+                    final["text"] = self.tokenizer.decode(val)
+                yield final
 
     def close(self):
         self._stop.set()
@@ -212,6 +296,38 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             else:
                 self._send(404, {"error": "not found"})
 
+        def _stream(self, payload: dict):
+            # Newline-delimited JSON, no Content-Length: the connection
+            # closes at the end of the stream (HTTP/1.0 semantics of
+            # BaseHTTPRequestHandler — no keep-alive to preserve).
+            lines = server.handle_stream(payload)
+            try:
+                first = next(lines)  # parse errors surface before 200
+            except StopIteration:
+                first = None
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.end_headers()
+            rest = (
+                itertools.chain([first], lines) if first is not None else lines
+            )
+            try:
+                for obj in rest:
+                    self.wfile.write((json.dumps(obj) + "\n").encode())
+                    self.wfile.flush()
+            except OSError:
+                # Client hung up mid-stream (the normal cancel path);
+                # nothing to report and nobody left to report it to.
+                pass
+            except (ValueError, TimeoutError, RuntimeError) as e:
+                # Headers are gone; report in-band and close.
+                try:
+                    self.wfile.write(
+                        (json.dumps({"error": str(e)}) + "\n").encode()
+                    )
+                except OSError:
+                    pass
+
         def do_POST(self):
             if self.path != "/generate":
                 self._send(404, {"error": "not found"})
@@ -219,7 +335,10 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(n) or b"{}")
-                self._send(200, server.handle(payload))
+                if payload.get("stream"):
+                    self._stream(payload)
+                else:
+                    self._send(200, server.handle(payload))
             except (ValueError, TimeoutError) as e:
                 self._send(400, {"error": str(e)})
             except RuntimeError as e:

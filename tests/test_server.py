@@ -93,6 +93,109 @@ class TestHTTPServer:
         assert ei.value.code == 400
 
 
+class TestStreaming:
+    def test_stream_matches_blocking(self, http_srv):
+        """Concatenated deltas + final record equal the blocking path."""
+        _, cfg, params = http_srv
+        srv = InferenceServer(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0)
+        try:
+            prompt = [3, 7, 11]
+            want = srv.generate(prompt, max_new=8)
+            got, final = [], None
+            n_deltas = 0
+            for kind, val in srv.generate_stream(prompt, max_new=8,
+                                                 timeout=120):
+                if kind == "delta":
+                    got.extend(val)
+                    n_deltas += 1
+                else:
+                    final = val
+            assert final == want
+            # Deltas cover the full output except possibly the chunk
+            # flushed at completion.
+            assert got == final[:len(got)]
+            assert n_deltas >= 2  # tokens actually arrived incrementally
+        finally:
+            srv.close()
+
+    def test_stream_stop_holdback(self, http_srv):
+        """Stop-truncated tokens are never streamed: every delta token
+        is part of the final (truncated) output."""
+        _, cfg, params = http_srv
+        srv = InferenceServer(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0)
+        try:
+            prompt = [5, 6]
+            ref = srv.generate(prompt, max_new=12)
+            stop = [ref[3:5]]  # force a mid-stream stop match
+            want = srv.generate(prompt, max_new=12, stop=stop)
+            assert want == ref[:3]
+            got, final = [], None
+            for kind, val in srv.generate_stream(prompt, max_new=12,
+                                                 stop=stop, timeout=120):
+                if kind == "delta":
+                    got.extend(val)
+                else:
+                    final = val
+            assert final == want
+            assert got == final[:len(got)]
+        finally:
+            srv.close()
+
+    def test_http_stream_endpoint(self, http_srv):
+        base, _, _ = http_srv
+        blocking = _post(base, {"tokens": [2, 4, 6], "max_new": 6})
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=json.dumps({"tokens": [2, 4, 6], "max_new": 6,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        lines = []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers["Content-Type"] == "application/x-ndjson"
+            for raw in r:
+                lines.append(json.loads(raw))
+        assert lines[-1]["done"] is True
+        assert lines[-1]["tokens"] == blocking["tokens"]
+        assert "text" in lines[-1]
+        streamed = [t for ln in lines[:-1] for t in ln["tokens"]]
+        assert streamed == blocking["tokens"][:len(streamed)]
+
+    def test_client_disconnect_mid_stream(self, http_srv):
+        """Closing the connection mid-stream must not wedge or crash
+        the server; the next request still works."""
+        import socket
+        from urllib.parse import urlparse
+
+        base, _, _ = http_srv
+        u = urlparse(base)
+        body = json.dumps({"tokens": [1, 2], "max_new": 16,
+                           "stream": True}).encode()
+        s = socket.create_connection((u.hostname, u.port), timeout=30)
+        s.sendall(
+            b"POST /generate HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+        s.recv(1)  # wait for the response to start, then hang up
+        s.close()
+        out = _post(base, {"tokens": [9, 9], "max_new": 4})
+        assert len(out["tokens"]) == 4
+
+    def test_http_stream_bad_request_is_400(self, http_srv):
+        base, _, _ = http_srv
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=json.dumps({"stream": True, "max_new": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+
+
 def test_stats_endpoint():
     import threading
     import urllib.request
