@@ -29,7 +29,6 @@ upstream serving engine to cite.
 
 from __future__ import annotations
 
-import functools
 import hashlib
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -42,7 +41,7 @@ import numpy as np
 from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.kvcache import KVCache, init_cache, init_paged_cache
 from shellac_tpu.models import transformer
-from shellac_tpu.ops.sampling import sample
+from shellac_tpu.ops.sampling import sample_batched
 
 
 @dataclass
@@ -51,6 +50,12 @@ class _Request:
     tokens: np.ndarray  # (S,) int32 prompt
     max_new: int
     stop: Optional[List[List[int]]] = None  # token-id stop sequences
+    # Per-request sampling settings, resolved to concrete values at
+    # submit time (top_k is always >= 1; vocab size = disabled).
+    temperature: float = 0.0
+    top_k: int = 1
+    top_p: float = 1.0
+    min_p: float = 0.0
     # Generated tokens so far. INVARIANT (the server's streaming path
     # reads this between engine steps): `out` only ever grows, except
     # that a stop-sequence match removes exactly the matched suffix
@@ -87,6 +92,7 @@ class BatchingEngine:
         temperature: float = 0.0,
         top_k: Optional[int] = None,
         top_p: Optional[float] = None,
+        min_p: Optional[float] = None,
         eos_id: Optional[int] = None,
         seed: int = 0,
         attn_impl: str = "auto",
@@ -110,9 +116,26 @@ class BatchingEngine:
         # the whole burst. None = no cap (drain-oriented batch use);
         # servers should set 1-2 to bound decode latency jitter.
         self.max_prefills_per_step = max_prefills_per_step
-        self._sampler = functools.partial(
-            sample, temperature=temperature, top_k=top_k, top_p=top_p
-        )
+        # Engine-level sampling defaults; submit() can override any of
+        # them per request. Each slot's effective settings live in
+        # device vectors fed to the jitted programs, so one decode tick
+        # serves greedy and sampled requests side by side.
+        self._defaults = {
+            "temperature": float(temperature),
+            # top_k resolves once, here: None (disabled) = full vocab.
+            "top_k": int(top_k) if top_k is not None else cfg.vocab_size,
+            "top_p": float(top_p) if top_p is not None else 1.0,
+            "min_p": float(min_p) if min_p is not None else 0.0,
+        }
+        self._validate_sampling(self._defaults, "engine defaults")
+        self._stemp = jnp.full((n_slots,), self._defaults["temperature"],
+                               jnp.float32)
+        self._stopk = jnp.full((n_slots,), self._defaults["top_k"],
+                               jnp.int32)
+        self._stopp = jnp.full((n_slots,), self._defaults["top_p"],
+                               jnp.float32)
+        self._sminp = jnp.full((n_slots,), self._defaults["min_p"],
+                               jnp.float32)
         self._key = jax.random.PRNGKey(seed)
 
         self._cache = init_cache(cfg, n_slots, self.max_len)
@@ -120,7 +143,12 @@ class BatchingEngine:
         self._queue: deque[_Request] = deque()
         self._slots: List[Optional[_Request]] = [None] * n_slots
         self._prefill_jit: Dict[int, Any] = {}  # bucketed by padded S
-        self._decode = jax.jit(self._decode_impl)
+        # Two decode variants (one trace each): greedy_only skips the
+        # batched sampler's full-vocab sorts when every active request
+        # is greedy — the common serving default.
+        self._decode = jax.jit(
+            self._decode_impl, static_argnames=("greedy_only",)
+        )
         # Serving observability (read by the HTTP /stats endpoint).
         # Written only by the engine-owning thread; plain ints so
         # cross-thread reads are merely possibly-stale, never torn.
@@ -133,7 +161,8 @@ class BatchingEngine:
 
     # ---- jitted programs --------------------------------------------
 
-    def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key):
+    def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
+                      samp):
         """Prefill one request and scatter it into `slot` of `cache`."""
         mini = init_cache(self.cfg, 1, self.max_len)
         logits, mini = transformer.forward_with_cache(
@@ -143,7 +172,7 @@ class BatchingEngine:
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
-        first = self._sampler(key, last)
+        first = sample_batched(key, last[None], *samp)[0]
         cache = KVCache(
             k=jax.lax.dynamic_update_slice_in_dim(
                 cache.k, mini.k, slot, axis=1
@@ -157,7 +186,8 @@ class BatchingEngine:
         )
         return cache, first
 
-    def _decode_impl(self, params, cache, cur, active, key):
+    def _decode_impl(self, params, cache, cur, active, key, samp,
+                     greedy_only: bool = False):
         """decode_ticks decode steps over every slot, ONE host sync.
 
         Per-tick host reads dominate serving latency when the device is
@@ -177,7 +207,12 @@ class BatchingEngine:
                 self.cfg, params, cur[:, None], cache,
                 attn_impl=self.attn_impl,
             )
-            nxt = self._sampler(key, logits[:, 0])
+            if greedy_only:
+                nxt = jnp.argmax(
+                    logits[:, 0].astype(jnp.float32), axis=-1
+                ).astype(jnp.int32)
+            else:
+                nxt = sample_batched(key, logits[:, 0], *samp)
             lengths = jnp.where(active, cache.lengths, old_lengths)
             cache = cache.replace(lengths=lengths)
             nxt = jnp.where(active, nxt, cur)
@@ -189,10 +224,26 @@ class BatchingEngine:
 
     # ---- scheduling --------------------------------------------------
 
-    def submit(self, rid, tokens, max_new: int, stop=None) -> None:
+    @staticmethod
+    def _validate_sampling(d: Dict[str, Any], label) -> None:
+        if d["temperature"] < 0:
+            raise ValueError(f"{label}: temperature must be >= 0")
+        if d["top_k"] < 1:
+            raise ValueError(f"{label}: top_k must be >= 1 (or None)")
+        if not 0 < d["top_p"] <= 1:
+            raise ValueError(f"{label}: top_p must be in (0, 1]")
+        if not 0 <= d["min_p"] < 1:
+            raise ValueError(f"{label}: min_p must be in [0, 1)")
+
+    def submit(self, rid, tokens, max_new: int, stop=None, *,
+               temperature=None, top_k=None, top_p=None,
+               min_p=None) -> None:
         """Queue a request. `stop`: optional list of token-id sequences;
         generation ends when the output ends with any of them, and the
-        matched sequence is removed from the returned tokens."""
+        matched sequence is removed from the returned tokens.
+        temperature/top_k/top_p/min_p override the engine defaults for
+        this request only — requests with different sampling settings
+        share one device batch."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
             raise ValueError(f"request {rid!r}: empty prompt")
@@ -209,13 +260,40 @@ class BatchingEngine:
             stop = [list(map(int, s)) for s in stop]
             if any(len(s) == 0 for s in stop):
                 raise ValueError(f"request {rid!r}: empty stop sequence")
-        self._queue.append(_Request(rid, tokens, max_new, stop=stop))
+        d = self._defaults
+        samp = {
+            "temperature": float(
+                temperature if temperature is not None else d["temperature"]
+            ),
+            "top_k": int(top_k) if top_k is not None else d["top_k"],
+            "top_p": float(top_p) if top_p is not None else d["top_p"],
+            "min_p": float(min_p) if min_p is not None else d["min_p"],
+        }
+        self._validate_sampling(samp, f"request {rid!r}")
+        self._queue.append(_Request(rid, tokens, max_new, stop=stop, **samp))
 
     def _prepare_slot(self, slot: int, req: _Request) -> None:
         """Hook before prefilling `req` into `slot` (paged: alloc blocks)."""
 
     def _release_slot(self, slot: int) -> None:
         """Hook after a request leaves `slot` (paged: free its blocks)."""
+
+    def _slot_samp(self, req: _Request):
+        """This request's sampling settings as (1,)-vectors for jit."""
+        return (
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.min_p], jnp.float32),
+        )
+
+    def _set_slot_sampling(self, slot: int, req: _Request) -> None:
+        """Write the request's settings into the per-slot vectors the
+        decode program samples with."""
+        self._stemp = self._stemp.at[slot].set(req.temperature)
+        self._stopk = self._stopk.at[slot].set(req.top_k)
+        self._stopp = self._stopp.at[slot].set(req.top_p)
+        self._sminp = self._sminp.at[slot].set(req.min_p)
 
     def _run_prefill(self, slot: int, req: _Request) -> jax.Array:
         """Run the (bucketed, jitted) prefill for `req`; returns the
@@ -234,7 +312,7 @@ class BatchingEngine:
         self._key, sub = jax.random.split(self._key)
         cache, first = self._prefill_jit[pad](
             self.params, self._cache, jnp.asarray(padded),
-            jnp.asarray([s], jnp.int32), slot, sub,
+            jnp.asarray([s], jnp.int32), slot, sub, self._slot_samp(req),
         )
         self._cache = cache
         return first
@@ -249,6 +327,7 @@ class BatchingEngine:
             done += 1
             req = self._queue.popleft()
             self._prepare_slot(i, req)
+            self._set_slot_sampling(i, req)
             first = self._run_prefill(i, req)
             first_tok = int(first)
             self._cur = self._cur.at[i].set(first_tok)
@@ -302,8 +381,13 @@ class BatchingEngine:
             self._pre_decode(active_rows)
             active = jnp.asarray(active_rows)
             self._key, sub = jax.random.split(self._key)
+            greedy_only = all(
+                r is None or r.temperature == 0.0 for r in self._slots
+            )
             self._cache, toks = self._decode(
-                self.params, self._cache, self._cur, active, sub
+                self.params, self._cache, self._cur, active, sub,
+                (self._stemp, self._stopk, self._stopp, self._sminp),
+                greedy_only=greedy_only,
             )
             self._cur = toks[-1]
             host_toks = np.asarray(toks)  # (K, n_slots) — the one sync
@@ -587,13 +671,13 @@ class PagedBatchingEngine(BatchingEngine):
         cache, first = self._prefix_prefill_jit[pad](
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray([s], jnp.int32), jnp.asarray([p], jnp.int32),
-            slot, sub,
+            slot, sub, self._slot_samp(req),
         )
         self._cache = cache
         return first
 
     def _prefix_prefill_impl(
-        self, params, cache, tokens, suffix_len, prefix_len, slot, key
+        self, params, cache, tokens, suffix_len, prefix_len, slot, key, samp
     ):
         """Continue from `prefix_len` cached tokens: a batch-1 view of
         the slot's table row over the shared pool, forwarded with
@@ -621,7 +705,7 @@ class PagedBatchingEngine(BatchingEngine):
         last = jnp.take_along_axis(
             logits, (suffix_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
-        first = self._sampler(key, last)
+        first = sample_batched(key, last[None], *samp)[0]
         cache = cache.replace(
             k=view.k, v=view.v,
             lengths=jax.lax.dynamic_update_slice(
@@ -630,7 +714,8 @@ class PagedBatchingEngine(BatchingEngine):
         )
         return cache, first
 
-    def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key):
+    def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
+                      samp):
         """Dense mini-prefill, then scatter through the slot's table."""
         s = tokens.shape[1]
         mini = init_cache(self.cfg, 1, s)
@@ -641,7 +726,7 @@ class PagedBatchingEngine(BatchingEngine):
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
-        first = self._sampler(key, last)
+        first = sample_batched(key, last[None], *samp)[0]
 
         bs = self.block_size
         table_row = jax.lax.dynamic_slice_in_dim(cache.tables, slot, 1, 0)[0]

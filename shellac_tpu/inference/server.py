@@ -8,7 +8,9 @@ batch, not in the HTTP layer.
 
 API:
   POST /generate  {"tokens": [1,2,3] | "text": "...", "max_new": 32,
-                   "stop": [[7,8], "..."]?}
+                   "stop": [[7,8], "..."]?,
+                   "temperature"/"top_k"/"top_p"/"min_p": per-request
+                   sampling overrides (engine defaults otherwise)}
                   -> {"id", "tokens", "text"?}
                   With "stream": true the response is newline-delimited
                   JSON written as tokens are generated: zero or more
@@ -109,13 +111,18 @@ class InferenceServer:
             drained = False
             while True:
                 try:
-                    rid, tokens, max_new, stop = self._submit_q.get_nowait()
+                    (rid, tokens, max_new, stop,
+                     samp) = self._submit_q.get_nowait()
                 except queue.Empty:
                     break
                 drained = True
                 try:
-                    self.engine.submit(rid, tokens, max_new, stop=stop)
-                except ValueError as e:
+                    self.engine.submit(rid, tokens, max_new, stop=stop,
+                                       **samp)
+                except (ValueError, TypeError) as e:
+                    # TypeError: unknown sampling kwarg from a
+                    # programmatic caller — a bad request, not a
+                    # scheduler-killing fault.
                     p = self._pending.pop(rid)
                     p.error = str(e)
                     p.finish()
@@ -153,14 +160,17 @@ class InferenceServer:
 
     # ---- client surface ---------------------------------------------
 
-    def _submit(self, tokens, max_new: int, stop, *, stream: bool) -> _Pending:
+    def _submit(self, tokens, max_new: int, stop, samp,
+                *, stream: bool) -> _Pending:
         if self._fatal is not None:
             raise RuntimeError(self._fatal)
         rid = next(self._ids)
         holdback = max((len(s) for s in stop), default=0) if stop else 0
         p = _Pending(stream=stream, holdback=holdback)
         self._pending[rid] = p
-        self._submit_q.put((rid, np.asarray(tokens, np.int32), max_new, stop))
+        self._submit_q.put(
+            (rid, np.asarray(tokens, np.int32), max_new, stop, samp or {})
+        )
         if self._fatal is not None and not p.event.is_set():
             # Scheduler died while we enqueued; its sweep may have
             # missed this request — fail it ourselves.
@@ -176,8 +186,8 @@ class InferenceServer:
         raise ValueError(p.error)
 
     def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
-                 stop=None):
-        p = self._submit(tokens, max_new, stop, stream=False)
+                 stop=None, **samp):
+        p = self._submit(tokens, max_new, stop, samp, stream=False)
         if not p.event.wait(timeout):
             raise TimeoutError("request timed out")
         if p.error is not None:
@@ -185,10 +195,10 @@ class InferenceServer:
         return p.result
 
     def generate_stream(self, tokens, max_new: int,
-                        timeout: Optional[float] = None, stop=None):
+                        timeout: Optional[float] = None, stop=None, **samp):
         """Yield ("delta", [token ids]) as generation progresses, then
         ("done", full output). `timeout` bounds the wait per chunk."""
-        p = self._submit(tokens, max_new, stop, stream=True)
+        p = self._submit(tokens, max_new, stop, samp, stream=True)
         while True:
             try:
                 chunk = p.chunks.get(timeout=timeout)
@@ -232,12 +242,28 @@ class InferenceServer:
                 # dropped connection.
                 raise ValueError(f"bad stop sequences: {e}")
             stop = parsed
-        return tokens, max_new, stop
+        # Per-request sampling overrides (validated by engine.submit;
+        # whitelisted so unknown payload keys can't reach **kwargs).
+        try:
+            samp = {
+                k: float(payload[k])
+                for k in ("temperature", "top_p", "min_p")
+                if payload.get(k) is not None
+            }
+            if payload.get("top_k") is not None:
+                v = float(payload["top_k"])
+                if not v.is_integer():
+                    raise ValueError(f"top_k must be an integer, got {v}")
+                samp["top_k"] = int(v)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"bad sampling parameters: {e}")
+        return tokens, max_new, stop, samp
 
     def handle(self, payload: dict) -> dict:
-        tokens, max_new, stop = self._parse(payload)
+        tokens, max_new, stop, samp = self._parse(payload)
         out = self.generate(
-            tokens, max_new, timeout=payload.get("timeout"), stop=stop
+            tokens, max_new, timeout=payload.get("timeout"), stop=stop,
+            **samp,
         )
         result: Dict[str, Any] = {"tokens": out}
         if self.tokenizer is not None:
@@ -248,9 +274,10 @@ class InferenceServer:
         """Yield response dicts for a streaming request: delta lines
         {"tokens": [...]}, then {"done": true, "tokens", "text"?}.
         Parse errors raise before the first yield (clean HTTP 400)."""
-        tokens, max_new, stop = self._parse(payload)
+        tokens, max_new, stop, samp = self._parse(payload)
         stream = self.generate_stream(
-            tokens, max_new, timeout=payload.get("timeout"), stop=stop
+            tokens, max_new, timeout=payload.get("timeout"), stop=stop,
+            **samp,
         )
         for kind, val in stream:
             if kind == "delta":

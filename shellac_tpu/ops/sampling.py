@@ -81,3 +81,50 @@ def sample(
     if min_p is not None:
         logits = min_p_mask(logits, min_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_batched(
+    key: jax.Array,
+    logits: jax.Array,  # (B, V)
+    temperature: jax.Array,  # (B,) fp32; 0 = greedy
+    top_k: jax.Array,  # (B,) int32; >= V disables
+    top_p: jax.Array,  # (B,) fp32; 1.0 disables
+    min_p: jax.Array,  # (B,) fp32; 0.0 disables
+) -> jax.Array:
+    """`sample` with PER-ROW parameters, for serving engines that mix
+    requests with different sampling settings in one device batch.
+
+    Same filter semantics as the scalar path (verified token-exact in
+    tests when all rows share one setting): disabled values are the
+    no-op sentinels above rather than None, so the whole thing stays
+    one jittable program with fixed shapes.
+    """
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    greedy = temperature <= 0.0
+    t = jnp.where(greedy, 1.0, temperature)[:, None]
+    x = logits / t
+    # top-k: per-row kth-largest threshold (ties at the boundary are
+    # kept, matching top_k_mask).
+    k = jnp.clip(top_k, 1, v)
+    asc = jnp.sort(x, axis=-1)
+    kth = jnp.take_along_axis(asc, (v - k)[:, None], axis=-1)
+    x = jnp.where(x < kth, NEG_INF, x)
+    # top-p on the top-k-filtered rows (same order as the scalar path);
+    # re-sort so boundary ties behave exactly like top_p_mask.
+    desc = jnp.sort(x, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]
+    kth_p = jnp.min(
+        jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
+    )
+    x = jnp.where(x < kth_p, NEG_INF, x)
+    # min-p relative to each row's current max.
+    probs_x = jax.nn.softmax(x, axis=-1)
+    cutoff = min_p[:, None] * jnp.max(probs_x, axis=-1, keepdims=True)
+    x = jnp.where(probs_x < cutoff, NEG_INF, x)
+    sampled = jax.random.categorical(key, x, axis=-1)
+    return jnp.where(
+        greedy, jnp.argmax(logits, axis=-1), sampled
+    ).astype(jnp.int32)

@@ -62,6 +62,52 @@ class TestContinuousBatching:
         for rid, toks, max_new in reqs:
             assert results[rid] == _ref_generate(cfg, params, toks, max_new)
 
+    def test_per_request_sampling_isolated(self, setup):
+        """A greedy request sharing the batch with high-temperature
+        requests is unaffected by them (per-slot sampling vectors)."""
+        cfg, params = setup
+        rng = np.random.default_rng(3)
+        greedy_prompt = rng.integers(0, cfg.vocab_size, 6)
+        want = _ref_generate(cfg, params, greedy_prompt, 8)
+        srv = BatchingEngine(cfg, params, n_slots=3, max_len=64,
+                             temperature=1.5)
+        srv.submit("hot1", rng.integers(0, cfg.vocab_size, 4), 8)
+        srv.submit("greedy", greedy_prompt, 8, temperature=0.0)
+        srv.submit("hot2", rng.integers(0, cfg.vocab_size, 5), 8,
+                   temperature=1.2, top_k=8)
+        results = {}
+        while srv.pending:
+            results.update(srv.step())
+        assert results["greedy"] == want
+        assert len(results["hot1"]) == 8 and len(results["hot2"]) == 8
+
+    def test_sampling_params_reset_on_slot_reuse(self, setup):
+        """A slot freed by a sampled request must not leak its settings
+        into the next (default-greedy) request."""
+        cfg, params = setup
+        rng = np.random.default_rng(4)
+        p1 = rng.integers(0, cfg.vocab_size, 4)
+        p2 = rng.integers(0, cfg.vocab_size, 7)
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        srv.submit("hot", p1, 4, temperature=2.0)
+        srv.submit("greedy", p2, 6)  # engine default: greedy
+        results = {}
+        while srv.pending:
+            results.update(srv.step())
+        assert results["greedy"] == _ref_generate(cfg, params, p2, 6)
+
+    def test_bad_sampling_params_rejected(self, setup):
+        cfg, params = setup
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        with pytest.raises(ValueError, match="top_p"):
+            srv.submit("x", np.array([1], np.int32), 2, top_p=0.0)
+        with pytest.raises(ValueError, match="temperature"):
+            srv.submit("x", np.array([1], np.int32), 2, temperature=-1.0)
+        with pytest.raises(ValueError, match="min_p"):
+            srv.submit("x", np.array([1], np.int32), 2, min_p=1.0)
+        with pytest.raises(ValueError, match="top_k"):
+            srv.submit("x", np.array([1], np.int32), 2, top_k=0)
+
     def test_eos_frees_slot_early(self, setup):
         cfg, params = setup
         prompt = np.array([1, 2, 3], np.int32)

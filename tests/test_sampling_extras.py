@@ -8,7 +8,68 @@ import pytest
 from shellac_tpu import get_model_config
 from shellac_tpu.inference.engine import Engine
 from shellac_tpu.models import transformer
-from shellac_tpu.ops.sampling import min_p_mask, repetition_penalty, sample
+from shellac_tpu.ops.sampling import (
+    min_p_mask,
+    repetition_penalty,
+    sample,
+    sample_batched,
+)
+
+
+class TestSampleBatched:
+    """Per-row-parameter sampler: token-exact vs the scalar path when
+    all rows share one setting (same key, same masked logits)."""
+
+    V = 64
+
+    def _logits(self, b=4, seed=0):
+        return jax.random.normal(
+            jax.random.PRNGKey(seed), (b, self.V)
+        ) * 3.0
+
+    def _vecs(self, b, temperature, top_k, top_p, min_p):
+        return (
+            jnp.full((b,), temperature, jnp.float32),
+            jnp.full((b,), top_k if top_k is not None else self.V, jnp.int32),
+            jnp.full((b,), top_p if top_p is not None else 1.0, jnp.float32),
+            jnp.full((b,), min_p if min_p is not None else 0.0, jnp.float32),
+        )
+
+    @pytest.mark.parametrize("kw", [
+        dict(temperature=0.0),
+        dict(temperature=1.0),
+        dict(temperature=0.7, top_k=8),
+        dict(temperature=1.3, top_p=0.8),
+        dict(temperature=1.0, min_p=0.1),
+        dict(temperature=0.9, top_k=16, top_p=0.9, min_p=0.05),
+    ])
+    def test_matches_scalar(self, kw):
+        logits = self._logits()
+        key = jax.random.PRNGKey(42)
+        want = sample(key, logits, **kw)
+        got = sample_batched(
+            key, logits,
+            *self._vecs(logits.shape[0], kw.get("temperature", 1.0),
+                        kw.get("top_k"), kw.get("top_p"), kw.get("min_p")),
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_mixed_rows(self):
+        """Greedy and sampled rows coexist: the greedy row equals
+        argmax; a top-k=1 row equals argmax too; others stay in-mask."""
+        logits = self._logits(b=3, seed=1)
+        temp = jnp.asarray([0.0, 1.0, 1.0], jnp.float32)
+        topk = jnp.asarray([self.V, 1, 4], jnp.int32)
+        topp = jnp.ones((3,), jnp.float32)
+        minp = jnp.zeros((3,), jnp.float32)
+        toks = np.asarray(sample_batched(
+            jax.random.PRNGKey(0), logits, temp, topk, topp, minp
+        ))
+        am = np.asarray(jnp.argmax(logits, axis=-1))
+        assert toks[0] == am[0]
+        assert toks[1] == am[1]
+        top4 = np.asarray(jnp.argsort(logits[2])[-4:])
+        assert toks[2] in top4
 
 
 class TestMinP:

@@ -92,6 +92,31 @@ class TestHTTPServer:
             _post(base, {"max_new": 4})
         assert ei.value.code == 400
 
+    def test_per_request_sampling(self, http_srv):
+        """Payload sampling overrides: explicit greedy matches the
+        default-greedy server; bad values are a 400."""
+        base, _, _ = http_srv
+        prompt = [3, 7, 11]
+        want = _post(base, {"tokens": prompt, "max_new": 6})
+        got = _post(base, {"tokens": prompt, "max_new": 6,
+                           "temperature": 0.0})
+        assert got["tokens"] == want["tokens"]
+        hot = _post(base, {"tokens": prompt, "max_new": 6,
+                           "temperature": 1.3, "top_k": 8, "top_p": 0.9})
+        assert len(hot["tokens"]) == 6
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {"tokens": prompt, "max_new": 4, "top_p": 0.0})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {"tokens": prompt, "max_new": 4,
+                         "temperature": "warm"})
+        assert ei.value.code == 400
+        # Fractional top_k (a swapped top_p, typically) is a 400, not
+        # a silent truncation.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {"tokens": prompt, "max_new": 4, "top_k": 0.9})
+        assert ei.value.code == 400
+
 
 class TestStreaming:
     def test_stream_matches_blocking(self, http_srv):
