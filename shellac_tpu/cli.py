@@ -316,6 +316,12 @@ def cmd_serve(args):
 
     if args.prefix_cache and not args.paged:
         raise SystemExit("--prefix-cache requires --paged")
+    if args.draft_model and args.paged:
+        raise SystemExit("--draft-model (speculative) requires a dense "
+                         "cache; drop --paged")
+    if args.draft_model and args.decode_ticks != 1:
+        raise SystemExit("--draft-model already emits up to gamma+1 tokens "
+                         "per step; --decode-ticks must stay 1")
     cfg = _model_config(args)
     params = _restore_params(args, cfg)
     if args.quantize:
@@ -323,6 +329,24 @@ def cmd_serve(args):
 
         params = quantize_params(cfg, params)
     engine = None
+    if args.draft_model:
+        import jax
+
+        from shellac_tpu.inference.spec_batching import (
+            SpeculativeBatchingEngine,
+        )
+        from shellac_tpu.models import transformer
+        from shellac_tpu.models.registry import PRESETS
+
+        dcfg = PRESETS[args.draft_model]
+        dparams = transformer.init_params(dcfg, jax.random.PRNGKey(args.seed))
+        engine = SpeculativeBatchingEngine(
+            cfg, params, dcfg, dparams, gamma=args.gamma,
+            n_slots=args.slots, max_len=args.max_len or cfg.max_seq_len,
+            temperature=args.temperature, eos_id=args.eos_id,
+            seed=args.seed,
+            max_prefills_per_step=args.max_prefills_per_step,
+        )
     if args.paged:
         from shellac_tpu.inference.batching import PagedBatchingEngine
 
@@ -488,6 +512,11 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="max_prefills_per_step",
                    help="cap prefills per engine step so prompt bursts "
                         "don't stall active decodes")
+    s.add_argument("--draft-model", default=None,
+                   help="draft preset: serve with speculative decoding "
+                        "(dense cache only)")
+    s.add_argument("--gamma", type=int, default=4,
+                   help="draft tokens proposed per verification round")
     s.add_argument("--ckpt-dir")
     s.add_argument("--quantize", action="store_true")
     s.add_argument("--tokenizer", default="byte")

@@ -2,6 +2,7 @@ from shellac_tpu.inference.batching import BatchingEngine, PagedBatchingEngine
 from shellac_tpu.inference.engine import Engine, GenerationResult, shard_params
 from shellac_tpu.inference.kvcache import KVCache, cache_logical_axes, init_cache
 from shellac_tpu.inference.server import InferenceServer
+from shellac_tpu.inference.spec_batching import SpeculativeBatchingEngine
 from shellac_tpu.inference.speculative import SpecResult, SpeculativeEngine
 
 __all__ = [
@@ -14,6 +15,7 @@ __all__ = [
     "init_cache",
     "cache_logical_axes",
     "SpecResult",
+    "SpeculativeBatchingEngine",
     "SpeculativeEngine",
     "shard_params",
 ]

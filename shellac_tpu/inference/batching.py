@@ -379,23 +379,12 @@ class BatchingEngine:
         active_rows = [r is not None for r in self._slots]
         if any(active_rows):
             self._pre_decode(active_rows)
-            active = jnp.asarray(active_rows)
-            self._key, sub = jax.random.split(self._key)
-            greedy_only = all(
-                r is None or r.temperature == 0.0 for r in self._slots
-            )
-            self._cache, toks = self._decode(
-                self.params, self._cache, self._cur, active, sub,
-                (self._stemp, self._stopk, self._stopp, self._sminp),
-                greedy_only=greedy_only,
-            )
-            self._cur = toks[-1]
-            host_toks = np.asarray(toks)  # (K, n_slots) — the one sync
+            per_slot = self._decode_tokens(active_rows)
             for i, req in enumerate(self._slots):
                 if req is None:
                     continue
-                for t in range(host_toks.shape[0]):
-                    req.out.append(int(host_toks[t, i]))
+                for tok in per_slot[i]:
+                    req.out.append(int(tok))
                     last = req.out[-1]
                     if (self.eos_id is not None and last == self.eos_id) or (
                         len(req.out) >= req.max_new
@@ -406,6 +395,23 @@ class BatchingEngine:
                         break
             self._finish_check(finished)
         return finished
+
+    def _decode_tokens(self, active_rows) -> List[List[int]]:
+        """Advance every active slot; returns new tokens per slot (one
+        host sync). Overridden by the speculative engine."""
+        active = jnp.asarray(active_rows)
+        self._key, sub = jax.random.split(self._key)
+        greedy_only = all(
+            r is None or r.temperature == 0.0 for r in self._slots
+        )
+        self._cache, toks = self._decode(
+            self.params, self._cache, self._cur, active, sub,
+            (self._stemp, self._stopk, self._stopp, self._sminp),
+            greedy_only=greedy_only,
+        )
+        self._cur = toks[-1]
+        host_toks = np.asarray(toks)  # (K, n_slots) — the one sync
+        return [host_toks[:, i].tolist() for i in range(self.n_slots)]
 
     def _pre_decode(self, active_rows) -> None:
         """Hook before each decode tick (paged: grow block tables)."""

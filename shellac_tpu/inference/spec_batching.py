@@ -1,0 +1,258 @@
+"""Speculative decoding inside the continuous-batching engine.
+
+The single-request SpeculativeEngine (speculative.py) amortizes the
+target model's HBM read over gamma draft proposals; this class brings
+the same trick to the serving engine: every engine step runs ONE
+verification round over all slots — the draft proposes gamma tokens per
+slot, the target scores the gamma+1 window in one forward, and each
+slot independently accepts a prefix by rejection sampling (exact-match
+accept for greedy slots). A round emits 1..gamma+1 tokens per slot per
+host sync, against the base engine's decode_ticks=1 emitting exactly 1.
+
+Slot mechanics reuse the base engine wholesale (admission, stop
+sequences, streaming, per-request temperature): only `_decode_tokens`
+and prefill change. The draft keeps its own (L_d, n_slots, ...) cache,
+prefilled alongside the target's; rejected proposals roll back by
+clamping per-slot cache `lengths` (kvcache.py's write-at-own-length
+contract makes the stale tail self-healing), exactly like the
+single-request engine.
+
+Greedy output is bit-identical to the plain BatchingEngine and to the
+single-request Engine (tested) — speculation, like scheduling, is
+invisible to the math. Per-request temperature is supported (the
+accept rule vectorizes per row); top_k/top_p/min_p are rejected at
+submit because filtering the proposal and target distributions breaks
+the rejection-sampling identity.
+
+The reference repo for this project is empty (SURVEY.md §0); there is
+no upstream speculative serving engine to cite.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference.batching import BatchingEngine, _bucket
+from shellac_tpu.inference.kvcache import KVCache, init_cache
+from shellac_tpu.models import transformer
+
+
+class SpeculativeBatchingEngine(BatchingEngine):
+    """Continuous batching with a draft model proposing gamma tokens."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: Any,
+        draft_cfg: ModelConfig,
+        draft_params: Any,
+        *,
+        gamma: int = 4,
+        **kw,
+    ):
+        if cfg.vocab_size != draft_cfg.vocab_size:
+            raise ValueError(
+                f"target/draft vocab mismatch: {cfg.vocab_size} vs "
+                f"{draft_cfg.vocab_size}"
+            )
+        if gamma < 1:
+            raise ValueError(f"gamma must be >= 1, got {gamma}")
+        if kw.get("decode_ticks", 1) != 1:
+            raise ValueError(
+                "speculative batching emits up to gamma+1 tokens per step "
+                "already; decode_ticks must stay 1"
+            )
+        super().__init__(cfg, params, **kw)
+        self.draft_cfg = draft_cfg
+        self.draft_params = draft_params
+        self.gamma = gamma
+        self._dcache = init_cache(draft_cfg, self.n_slots, self.max_len)
+        self._draft_prefill_jit = {}
+        self._spec_round = jax.jit(self._spec_round_impl)
+        self.stats.update({
+            "spec_rounds": 0,
+            "spec_proposed": 0,
+            "spec_accepted": 0,
+        })
+
+    # ---- admission ---------------------------------------------------
+
+    def submit(self, rid, tokens, max_new: int, stop=None, *,
+               temperature=None, top_k=None, top_p=None,
+               min_p=None) -> None:
+        if top_k is not None or top_p is not None or min_p is not None:
+            raise ValueError(
+                f"request {rid!r}: speculative decoding supports "
+                "temperature only (top_k/top_p/min_p filtering breaks "
+                "the rejection-sampling identity)"
+            )
+        size = np.asarray(tokens, np.int32).reshape(-1).size
+        # A slot finishing mid-round keeps writing the round's window at
+        # its frozen tail; reserve gamma+1 slack past the usual budget
+        # so those writes stay off other valid positions.
+        need = size + max_new + self.gamma + 2
+        if need > self.max_len:
+            raise ValueError(
+                f"request {rid!r}: prompt {size} + max_new {max_new} + "
+                f"speculative slack (gamma+2) exceeds max_len {self.max_len}"
+            )
+        super().submit(rid, tokens, max_new, stop=stop,
+                       temperature=temperature)
+
+    # ---- prefill (target via base, plus the draft cache) ------------
+
+    def _run_prefill(self, slot: int, req) -> jax.Array:
+        first = super()._run_prefill(slot, req)
+        s = req.tokens.size
+        pad = min(_bucket(s), self.max_len)
+        if pad not in self._draft_prefill_jit:
+            self._draft_prefill_jit[pad] = jax.jit(self._draft_prefill_impl)
+        padded = np.zeros((1, pad), np.int32)
+        padded[0, :s] = req.tokens
+        self._dcache = self._draft_prefill_jit[pad](
+            self.draft_params, self._dcache, jnp.asarray(padded),
+            jnp.asarray([s], jnp.int32), slot,
+        )
+        return first
+
+    def _draft_prefill_impl(self, dparams, dcache, tokens, prompt_len, slot):
+        mini = init_cache(self.draft_cfg, 1, self.max_len)
+        _, mini = transformer.forward_with_cache(
+            self.draft_cfg, dparams, tokens, mini, new_tokens_len=prompt_len,
+            fresh_cache=True, attn_impl=self.attn_impl,
+        )
+        return KVCache(
+            k=jax.lax.dynamic_update_slice_in_dim(
+                dcache.k, mini.k, slot, axis=1
+            ),
+            v=jax.lax.dynamic_update_slice_in_dim(
+                dcache.v, mini.v, slot, axis=1
+            ),
+            lengths=jax.lax.dynamic_update_slice(
+                dcache.lengths, mini.lengths, (slot,)
+            ),
+        )
+
+    # ---- one verification round over all slots ----------------------
+
+    def _spec_round_impl(self, params, dparams, tcache, dcache, cur,
+                         active, temp, key):
+        """Returns (tcache, dcache, emitted (B, g+1), counts (B,), cur).
+
+        counts[b] tokens of emitted[b] are real (0 for inactive rows).
+        Per-row temperature: greedy rows use the exact-match degenerate
+        form; sampled rows use standard rejection sampling. Inactive
+        rows compute garbage that is frozen (lengths, cur) and dropped
+        (counts=0).
+        """
+        g = self.gamma
+        b = cur.shape[0]
+        key, kd, kacc, kres, kbonus = jax.random.split(key, 5)
+        greedy = temp <= 0.0
+        t = jnp.where(greedy, 1.0, temp)[:, None]
+        lt0, ld0 = tcache.lengths, dcache.lengths
+
+        def dstep(carry, k):
+            dc, tok = carry
+            logits, dc = transformer.forward_with_cache(
+                self.draft_cfg, dparams, tok[:, None], dc,
+                attn_impl=self.attn_impl,
+            )
+            logits = logits[:, 0].astype(jnp.float32)
+            q = jax.nn.softmax(logits / t, axis=-1)
+            nxt = jnp.where(
+                greedy,
+                jnp.argmax(logits, axis=-1),
+                jax.random.categorical(k, logits / t, axis=-1),
+            ).astype(jnp.int32)
+            return (dc, nxt), (nxt, q)
+
+        (dcache, _), (drafts, qs) = jax.lax.scan(
+            dstep, (dcache, cur), jax.random.split(kd, g)
+        )
+        # Backfill the last proposal's kv so the all-accepted case
+        # leaves the draft cache complete for the next round.
+        _, dcache = transformer.forward_with_cache(
+            self.draft_cfg, dparams, drafts[-1][:, None], dcache,
+            attn_impl=self.attn_impl,
+        )
+        drafts = drafts.T  # (B, g)
+        qs = jnp.moveaxis(qs, 0, 1)  # (B, g, V)
+
+        # Target scores [cur, d_0..d_{g-1}] in one forward.
+        tin = jnp.concatenate([cur[:, None], drafts], axis=1)  # (B, g+1)
+        tlogits, tcache = transformer.forward_with_cache(
+            self.cfg, params, tin, tcache, attn_impl=self.attn_impl,
+        )
+        ps = jax.nn.softmax(
+            tlogits.astype(jnp.float32) / t[..., None], axis=-1
+        )  # (B, g+1, V)
+
+        p_d = jnp.take_along_axis(
+            ps[:, :g], drafts[..., None], axis=-1
+        )[..., 0]
+        q_d = jnp.take_along_axis(qs, drafts[..., None], axis=-1)[..., 0]
+        u = jax.random.uniform(kacc, (b, g))
+        accept = jnp.where(
+            greedy[:, None],
+            drafts == jnp.argmax(ps[:, :g], axis=-1),
+            u * q_d < p_d,
+        )
+        n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+        # Token after the accepted prefix: residual resample on
+        # rejection, bonus sample from the g+1'th target dist otherwise
+        # (argmax degenerate forms for greedy rows).
+        idx = jnp.minimum(n, g - 1)
+        p_n = jnp.take_along_axis(ps, idx[:, None, None], axis=1)[:, 0]
+        q_n = jnp.take_along_axis(qs, idx[:, None, None], axis=1)[:, 0]
+        res = jnp.maximum(p_n - q_n, 0.0)
+        res_mass = jnp.sum(res, axis=-1, keepdims=True)
+        res = jnp.where(res_mass > 1e-9, res, p_n)
+        r = jnp.where(
+            greedy,
+            jnp.argmax(p_n, axis=-1),
+            jax.random.categorical(kres, jnp.log(res + 1e-30), axis=-1),
+        ).astype(jnp.int32)
+        bonus = jnp.where(
+            greedy,
+            jnp.argmax(ps[:, g], axis=-1),
+            jax.random.categorical(kbonus, jnp.log(ps[:, g] + 1e-30),
+                                   axis=-1),
+        ).astype(jnp.int32)
+        extra = jnp.where(n < g, r, bonus)
+
+        cols = jnp.arange(g + 1, dtype=jnp.int32)[None, :]
+        padded = jnp.concatenate([drafts, extra[:, None]], axis=1)
+        emitted = jnp.where(cols == n[:, None], extra[:, None], padded)
+
+        # Roll back: valid history = old length + 1 (cur) + n accepted;
+        # inactive rows freeze entirely.
+        tcache = tcache.replace(
+            lengths=jnp.where(active, lt0 + 1 + n, lt0)
+        )
+        dcache = dcache.replace(
+            lengths=jnp.where(active, ld0 + 1 + n, ld0)
+        )
+        cur = jnp.where(active, extra, cur)
+        counts = jnp.where(active, n + 1, 0)
+        return tcache, dcache, emitted, counts, cur
+
+    def _decode_tokens(self, active_rows) -> List[List[int]]:
+        active = jnp.asarray(active_rows)
+        self._key, sub = jax.random.split(self._key)
+        (self._cache, self._dcache, emitted, counts,
+         self._cur) = self._spec_round(
+            self.params, self.draft_params, self._cache, self._dcache,
+            self._cur, active, self._stemp, sub,
+        )
+        em, cnt = jax.device_get((emitted, counts))  # the one host sync
+        self.stats["spec_rounds"] += 1
+        self.stats["spec_proposed"] += int((cnt > 0).sum()) * self.gamma
+        self.stats["spec_accepted"] += int(np.maximum(cnt - 1, 0).sum())
+        return [em[i, :cnt[i]].tolist() for i in range(self.n_slots)]

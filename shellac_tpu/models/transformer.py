@@ -429,10 +429,13 @@ def _block(
     if use_moe:
         from shellac_tpu.ops.moe import moe_ffn
 
-        # Single-token decode must never capacity-drop: a dropped token's
-        # FFN output would silently become zero. Prefill keeps routed
-        # capacity unless cfg.moe.dropless asks for exact computation.
-        is_decode = cache is not None and s == 1
+        # Cached continuation (decode s=1, speculative verify windows,
+        # prefix-cached suffix prefill) must never capacity-drop: a
+        # dropped token's FFN output would silently become zero, and
+        # decode-path exactness is the serving contract. Only fresh
+        # prefill keeps routed capacity (unless cfg.moe.dropless asks
+        # for exact computation everywhere).
+        is_decode = cache is not None and not fresh_cache
         down, aux, metrics = moe_ffn(
             hx, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             cfg.moe, drop_tokens=not (is_decode or cfg.moe.dropless),

@@ -1,0 +1,200 @@
+"""Speculative decoding in the continuous-batching engine.
+
+Core invariant (inherited from both parents): speculation AND
+scheduling are invisible to the math — greedy output per request is
+bit-identical to the single-request Engine, through slot churn, stop
+sequences, and mixed batches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.engine import Engine
+from shellac_tpu.inference.spec_batching import SpeculativeBatchingEngine
+from shellac_tpu.models import transformer
+
+
+def _tiny(**kw):
+    return get_model_config("tiny").replace(dtype="float32", **kw)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _tiny()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    # Draft: same tiny family, different weights (realistic mismatch).
+    dcfg = _tiny()
+    dparams = transformer.init_params(dcfg, jax.random.PRNGKey(7))
+    return cfg, params, dcfg, dparams
+
+
+def _ref(cfg, params, tokens, max_new):
+    eng = Engine(cfg, params, temperature=0.0)
+    out = eng.generate(
+        jnp.asarray(np.asarray(tokens, np.int32)[None]), max_new_tokens=max_new
+    )
+    return np.asarray(out.tokens)[0].tolist()
+
+
+def _engine(setup, **kw):
+    cfg, params, dcfg, dparams = setup
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("gamma", 3)
+    return SpeculativeBatchingEngine(cfg, params, dcfg, dparams, **kw)
+
+
+class TestGreedyParity:
+    def test_matches_engine_ragged(self, setup):
+        cfg, params = setup[:2]
+        rng = np.random.default_rng(0)
+        reqs = [
+            ("a", rng.integers(0, cfg.vocab_size, 5), 9),
+            ("b", rng.integers(0, cfg.vocab_size, 12), 4),
+            ("c", rng.integers(0, cfg.vocab_size, 3), 12),
+        ]
+        srv = _engine(setup)
+        results = srv.run(reqs)
+        for rid, toks, max_new in reqs:
+            assert results[rid] == _ref(cfg, params, toks, max_new), rid
+        assert srv.stats["spec_rounds"] > 0
+        assert srv.stats["spec_accepted"] <= srv.stats["spec_proposed"]
+
+    def test_more_requests_than_slots(self, setup):
+        cfg, params = setup[:2]
+        rng = np.random.default_rng(1)
+        reqs = [(i, rng.integers(0, cfg.vocab_size, 4 + i % 3), 6)
+                for i in range(6)]
+        srv = _engine(setup)
+        results = srv.run(reqs)
+        assert len(results) == 6
+        for rid, toks, max_new in reqs:
+            assert results[rid] == _ref(cfg, params, toks, max_new), rid
+
+    def test_self_draft_accepts_everything(self, setup):
+        """Draft == target: every greedy proposal must be accepted."""
+        cfg, params = setup[:2]
+        srv = SpeculativeBatchingEngine(
+            cfg, params, cfg, params, gamma=3, n_slots=1, max_len=96
+        )
+        prompt = np.array([1, 2, 3], np.int32)
+        assert srv.run([("x", prompt, 12)])["x"] == _ref(
+            cfg, params, prompt, 12
+        )
+        assert srv.stats["spec_accepted"] == srv.stats["spec_proposed"]
+
+    def test_moe_verify_window_exact(self):
+        """MoE targets: the g+1-token verification forward must not
+        capacity-drop (a dropped token zeroes its FFN output and broke
+        bit-parity with the plain engine). Self-draft greedy must
+        accept every proposal on tiny-moe."""
+        from shellac_tpu.inference.batching import BatchingEngine
+
+        cfg = get_model_config("tiny-moe").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, cfg.vocab_size, 6)
+        ref = BatchingEngine(cfg, params, n_slots=1, max_len=96).run(
+            [("x", prompt, 12)]
+        )["x"]
+        srv = SpeculativeBatchingEngine(
+            cfg, params, cfg, params, gamma=3, n_slots=1, max_len=96
+        )
+        assert srv.run([("x", prompt, 12)])["x"] == ref
+        assert srv.stats["spec_accepted"] == srv.stats["spec_proposed"]
+
+    def test_stop_sequences(self, setup):
+        cfg, params = setup[:2]
+        prompt = np.array([4, 8], np.int32)
+        full = _ref(cfg, params, prompt, 12)
+        stop = [full[4:6]]
+        srv = _engine(setup)
+        assert srv.run([("x", prompt, 12, stop)])["x"] == full[:4]
+
+    def test_eos_frees_slot_early(self, setup):
+        cfg, params = setup[:2]
+        prompt = np.array([1, 2, 3], np.int32)
+        full = _ref(cfg, params, prompt, 12)
+        eos = full[3]
+        srv = _engine(setup, eos_id=eos, n_slots=1)
+        assert srv.run([("x", prompt, 12)])["x"] == full[:4]
+
+
+class TestSampledAndMixed:
+    def test_mixed_greedy_and_sampled(self, setup):
+        """A greedy request mixed with a sampled one stays exact."""
+        cfg, params = setup[:2]
+        rng = np.random.default_rng(2)
+        gp = rng.integers(0, cfg.vocab_size, 6)
+        want = _ref(cfg, params, gp, 8)
+        srv = _engine(setup)
+        srv.submit("hot", rng.integers(0, cfg.vocab_size, 4), 8,
+                   temperature=1.3)
+        srv.submit("greedy", gp, 8, temperature=0.0)
+        results = {}
+        while srv.pending:
+            results.update(srv.step())
+        assert results["greedy"] == want
+        assert len(results["hot"]) == 8
+
+    def test_sampled_lengths_and_finiteness(self, setup):
+        srv = _engine(setup, temperature=1.0)
+        rng = np.random.default_rng(3)
+        cfg = setup[0]
+        reqs = [(i, rng.integers(0, cfg.vocab_size, 5), 10)
+                for i in range(4)]
+        results = srv.run(reqs)
+        for i, _, max_new in reqs:
+            assert len(results[i]) <= max_new
+            assert all(0 <= t < cfg.vocab_size for t in results[i])
+
+
+class TestValidation:
+    def test_filter_params_rejected(self, setup):
+        srv = _engine(setup)
+        with pytest.raises(ValueError, match="temperature only"):
+            srv.submit("x", np.array([1], np.int32), 4, top_k=8)
+
+    def test_slack_budget_enforced(self, setup):
+        srv = _engine(setup, max_len=32, gamma=4)
+        with pytest.raises(ValueError, match="slack"):
+            srv.submit("x", np.ones(10, np.int32), 20)
+
+    def test_decode_ticks_rejected(self, setup):
+        with pytest.raises(ValueError, match="decode_ticks"):
+            _engine(setup, decode_ticks=2)
+
+    def test_vocab_mismatch(self, setup):
+        cfg, params = setup[:2]
+        dcfg = _tiny(vocab_size=128)
+        dparams = transformer.init_params(dcfg, jax.random.PRNGKey(1))
+        with pytest.raises(ValueError, match="vocab"):
+            SpeculativeBatchingEngine(cfg, params, dcfg, dparams)
+
+
+class TestServerIntegration:
+    def test_streaming_over_spec_engine(self, setup):
+        """The server's streaming path composes with multi-token
+        speculative chunks (holdback logic is length-based)."""
+        from shellac_tpu.inference.server import InferenceServer
+
+        cfg, params = setup[:2]
+        eng = _engine(setup)
+        srv = InferenceServer(cfg, params, engine=eng)
+        try:
+            prompt = [3, 7, 11]
+            want = _ref(cfg, params, prompt, 10)
+            got, final = [], None
+            for kind, val in srv.generate_stream(prompt, max_new=10,
+                                                 timeout=120):
+                if kind == "delta":
+                    got.extend(val)
+                else:
+                    final = val
+            assert final == want
+            assert got == final[:len(got)]
+        finally:
+            srv.close()
