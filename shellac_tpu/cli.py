@@ -322,6 +322,8 @@ def cmd_serve(args):
     if args.draft_model and args.decode_ticks != 1:
         raise SystemExit("--draft-model already emits up to gamma+1 tokens "
                          "per step; --decode-ticks must stay 1")
+    if args.draft_model and args.prefill_chunk is not None:
+        raise SystemExit("--draft-model does not support --prefill-chunk")
     cfg = _model_config(args)
     params = _restore_params(args, cfg)
     if args.quantize:
@@ -357,6 +359,7 @@ def cmd_serve(args):
             decode_ticks=args.decode_ticks,
             max_prefills_per_step=args.max_prefills_per_step,
             prefix_cache=args.prefix_cache,
+            prefill_chunk=args.prefill_chunk,
         )
     serve(
         cfg, params,
@@ -367,6 +370,7 @@ def cmd_serve(args):
         temperature=args.temperature, eos_id=args.eos_id,
         decode_ticks=args.decode_ticks,
         max_prefills_per_step=args.max_prefills_per_step,
+        prefill_chunk=args.prefill_chunk,
     )
     return 0
 
@@ -517,6 +521,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(dense cache only)")
     s.add_argument("--gamma", type=int, default=4,
                    help="draft tokens proposed per verification round")
+    s.add_argument("--prefill-chunk", type=int, default=None,
+                   dest="prefill_chunk",
+                   help="prefill prompts longer than this incrementally "
+                        "(one chunk per step) so a long prompt cannot "
+                        "stall active decodes")
     s.add_argument("--ckpt-dir")
     s.add_argument("--quantize", action="store_true")
     s.add_argument("--tokenizer", default="byte")

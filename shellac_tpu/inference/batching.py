@@ -29,6 +29,7 @@ upstream serving engine to cite.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -98,11 +99,14 @@ class BatchingEngine:
         attn_impl: str = "auto",
         decode_ticks: int = 1,
         max_prefills_per_step: Optional[int] = None,
+        prefill_chunk: Optional[int] = None,
     ):
         if decode_ticks < 1:
             raise ValueError(f"decode_ticks must be >= 1, got {decode_ticks}")
         if max_prefills_per_step is not None and max_prefills_per_step < 1:
             raise ValueError("max_prefills_per_step must be >= 1")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         self.cfg = cfg
         self.params = params
         self.n_slots = n_slots
@@ -116,6 +120,15 @@ class BatchingEngine:
         # the whole burst. None = no cap (drain-oriented batch use);
         # servers should set 1-2 to bound decode latency jitter.
         self.max_prefills_per_step = max_prefills_per_step
+        # Chunked prefill: prompts longer than this many tokens prefill
+        # incrementally, one chunk program per step (each chunk counts
+        # against max_prefills_per_step), so ONE long prompt can no
+        # longer stall every active request for its whole prefill the
+        # way the admission cap alone cannot prevent. None = whole
+        # prompts in one program (the drain-oriented default).
+        self.prefill_chunk = prefill_chunk
+        self._prefilling: Dict[int, int] = {}  # slot -> tokens written
+        self._chunk_jit: Dict[Any, Any] = {}  # keyed (pad, fresh)
         # Engine-level sampling defaults; submit() can override any of
         # them per request. Each slot's effective settings live in
         # device vectors fed to the jitted programs, so one decode tick
@@ -157,6 +170,7 @@ class BatchingEngine:
             "tokens_generated": 0,
             "engine_steps": 0,
             "prefills": 0,
+            "prefill_chunks": 0,
         }
 
     # ---- jitted programs --------------------------------------------
@@ -317,6 +331,11 @@ class BatchingEngine:
         self._cache = cache
         return first
 
+    def _prefill_start_offset(self, slot: int) -> int:
+        """Tokens already resident when prefill starts (paged prefix
+        caching overrides this with the matched prefix length)."""
+        return 0
+
     def _fill_slots(self, budget: Optional[int] = None):
         done = 0
         for i in range(self.n_slots):
@@ -328,16 +347,108 @@ class BatchingEngine:
             req = self._queue.popleft()
             self._prepare_slot(i, req)
             self._set_slot_sampling(i, req)
+            off = self._prefill_start_offset(i)
+            if (self.prefill_chunk is not None
+                    and req.tokens.size - off > self.prefill_chunk):
+                # Long prompt: admit now, prefill incrementally in
+                # step() (the slot stays out of decode until done).
+                self._slots[i] = req
+                self._prefilling[i] = off
+                continue
             first = self._run_prefill(i, req)
-            first_tok = int(first)
-            self._cur = self._cur.at[i].set(first_tok)
-            self._slots[i] = req
-            req.out.append(first_tok)
-            self.stats["prefills"] += 1
+            self._finish_prefill(i, req, first)
+
+    def _finish_prefill(self, slot: int, req: _Request, first) -> None:
+        first_tok = int(first)
+        self._cur = self._cur.at[slot].set(first_tok)
+        self._slots[slot] = req
+        req.out.append(first_tok)
+        self.stats["prefills"] += 1
+
+    # ---- chunked prefill --------------------------------------------
+
+    def _advance_prefills(self, budget: Optional[int]) -> int:
+        """Run up to `budget` prefill-chunk programs (all of them when
+        budget is None); returns the number launched. Lowest slot
+        first, drained depth-first — chunk N+1 reuses chunk N's cache
+        row while it is hot."""
+        used = 0
+        while self._prefilling and (budget is None or used < budget):
+            slot = min(self._prefilling)
+            used += 1
+            self.stats["prefill_chunks"] += 1
+            req = self._slots[slot]
+            off = self._prefilling[slot]
+            chunk = req.tokens[off:off + self.prefill_chunk]
+            s = chunk.size
+            pad = min(_bucket(s), self.max_len - off)
+            self._key, sub = jax.random.split(self._key)
+            cache, first = self._chunk_prefill(
+                pad, off == 0, jnp.asarray(
+                    np.pad(chunk, (0, pad - s))[None]
+                ),
+                jnp.asarray([s], jnp.int32), jnp.asarray([off], jnp.int32),
+                slot, sub, self._slot_samp(req),
+            )
+            self._cache = cache
+            if off + s >= req.tokens.size:
+                del self._prefilling[slot]
+                self._finish_prefill(slot, req, first)
+            else:
+                self._prefilling[slot] = off + s
+        return used
+
+    def _chunk_prefill(self, pad, fresh, tokens, chunk_len, offset, slot,
+                       key, samp):
+        """Dispatch one (bucketed, jitted) chunk-continuation program."""
+        if (pad, fresh) not in self._chunk_jit:
+            self._chunk_jit[(pad, fresh)] = jax.jit(
+                functools.partial(self._chunk_prefill_impl, fresh=fresh)
+            )
+        return self._chunk_jit[(pad, fresh)](
+            self.params, self._cache, tokens, chunk_len, offset, slot, key,
+            samp,
+        )
+
+    def _chunk_prefill_impl(self, params, cache, tokens, chunk_len, offset,
+                            slot, key, samp, *, fresh: bool):
+        """Write one prompt chunk at `offset` into `slot`'s cache row.
+
+        A batch-1 view of the row continues from `offset` tokens
+        (fresh_cache only for the first chunk — later chunks attend to
+        the buffered prefix via the masked decode path). The sampled
+        token is only meaningful for the final chunk; earlier chunks
+        compute and discard it (cheaper than a second program variant).
+        """
+        row_k = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
+        row_v = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
+        view = KVCache(k=row_k, v=row_v, lengths=offset.astype(jnp.int32))
+        logits, view = transformer.forward_with_cache(
+            self.cfg, params, tokens, view, new_tokens_len=chunk_len,
+            fresh_cache=fresh,
+            attn_impl=self.attn_impl if fresh else "ref",
+        )
+        last = jnp.take_along_axis(
+            logits, (chunk_len - 1)[:, None, None].astype(jnp.int32), axis=1
+        )[0, 0]
+        first = sample_batched(key, last[None], *samp)[0]
+        cache = KVCache(
+            k=jax.lax.dynamic_update_slice_in_dim(
+                cache.k, view.k, slot, axis=1
+            ),
+            v=jax.lax.dynamic_update_slice_in_dim(
+                cache.v, view.v, slot, axis=1
+            ),
+            lengths=jax.lax.dynamic_update_slice(
+                cache.lengths, view.lengths, (slot,)
+            ),
+        )
+        return cache, first
 
     def _finish_check(self, finished):
         for i, req in enumerate(self._slots):
-            if req is None:
+            if req is None or not req.out:
+                # Slots mid-chunked-prefill have no output yet.
                 continue
             last = req.out[-1]
             nstop = req.hit_stop()
@@ -365,6 +476,17 @@ class BatchingEngine:
         # one-shot finish condition is missed forever. The prefill
         # budget is shared across the loop's iterations (per step).
         remaining = self.max_prefills_per_step
+        # In-flight chunked prefills advance FIRST: they are older than
+        # anything still queued, and giving admissions priority would
+        # let a sustained stream of short prompts starve an admitted
+        # long prompt's chunks out of the per-step budget forever.
+        if self._prefilling:
+            used = self._advance_prefills(remaining)
+            if remaining is not None:
+                remaining -= used
+            # A request satisfied by its final chunk alone (max_new=1,
+            # instant EOS) must be noticed before admission/decode.
+            self._finish_check(finished)
         while True:
             before = self.stats["prefills"]
             self._fill_slots(remaining)
@@ -376,12 +498,15 @@ class BatchingEngine:
                 remaining is not None and remaining <= 0
             ):
                 break
-        active_rows = [r is not None for r in self._slots]
+        active_rows = [
+            r is not None and i not in self._prefilling
+            for i, r in enumerate(self._slots)
+        ]
         if any(active_rows):
             self._pre_decode(active_rows)
             per_slot = self._decode_tokens(active_rows)
             for i, req in enumerate(self._slots):
-                if req is None:
+                if req is None or i in self._prefilling:
                     continue
                 for tok in per_slot[i]:
                     req.out.append(int(tok))
@@ -488,6 +613,9 @@ class PagedBatchingEngine(BatchingEngine):
         self._hash_to_block: "OrderedDict[bytes, int]" = OrderedDict()
         self._block_ref: Dict[int, int] = {}
         self._slot_prefix_len: List[int] = [0] * n_slots
+        # Registrations deferred until the slot's prefill completes
+        # (the blocks hold garbage until then): slot -> [(idx, hash)].
+        self._pending_reg: Dict[int, List] = {}
         self._prefix_prefill_jit: Dict[int, Any] = {}
         if prefix_cache:
             self.stats.update({
@@ -595,21 +723,31 @@ class PagedBatchingEngine(BatchingEngine):
             )
             self._queue.appendleft(req)
             raise _PoolExhausted()
-        # Register the slot's own full prompt blocks: prefill fills
-        # them deterministically before any later admission can match
-        # them (_fill_slots runs prepare+prefill per request, in order).
-        for j in range(m, req.tokens.size // self.block_size):
-            h = hashes[j]
-            if h in self._hash_to_block:
-                continue  # identical chain already cached elsewhere
-            blk = self._slot_blocks[slot][j]
-            self._hash_to_block[h] = blk
-            self._block_ref[blk] = 1
+        # The slot's own full prompt blocks become matchable only once
+        # prefill has actually written them — with chunked prefill that
+        # is several steps away, and registering early would let a
+        # concurrent same-prefix admission attend over unwritten KV.
+        # Stash the registrations; _finish_prefill flushes them.
+        self._pending_reg[slot] = [
+            (j, hashes[j])
+            for j in range(m, req.tokens.size // self.block_size)
+        ]
         self._slot_prefix_len[slot] = m * self.block_size
         self.stats["prefix_hit_tokens"] += m * self.block_size
         self.stats["prefix_query_tokens"] += req.tokens.size
 
+    def _finish_prefill(self, slot: int, req, first) -> None:
+        # The prompt blocks now hold real KV: make them matchable.
+        for j, h in self._pending_reg.pop(slot, ()):
+            if h in self._hash_to_block:
+                continue  # identical chain cached by an earlier finisher
+            blk = self._slot_blocks[slot][j]
+            self._hash_to_block[h] = blk
+            self._block_ref[blk] = 1
+        super()._finish_prefill(slot, req, first)
+
     def _release_slot(self, slot: int) -> None:
+        self._pending_reg.pop(slot, None)
         if self.prefix_cache:
             for blk in self._slot_blocks[slot]:
                 if blk in self._block_ref:
@@ -655,6 +793,20 @@ class PagedBatchingEngine(BatchingEngine):
             pass  # request re-queued; retry after a slot frees blocks
 
     # ---- jitted programs --------------------------------------------
+
+    def _prefill_start_offset(self, slot: int) -> int:
+        return self._slot_prefix_len[slot] if self.prefix_cache else 0
+
+    def _chunk_prefill(self, pad, fresh, tokens, chunk_len, offset, slot,
+                       key, samp):
+        """Paged chunks reuse the continuation program (a chunk is a
+        'suffix' past `offset` resident tokens; offset 0 included)."""
+        if pad not in self._prefix_prefill_jit:
+            self._prefix_prefill_jit[pad] = jax.jit(self._prefix_prefill_impl)
+        return self._prefix_prefill_jit[pad](
+            self.params, self._cache, tokens, chunk_len, offset, slot, key,
+            samp,
+        )
 
     def _run_prefill(self, slot: int, req) -> jax.Array:
         """Prefix-cached prefill: compute only the unmatched suffix."""

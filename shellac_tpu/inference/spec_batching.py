@@ -67,6 +67,11 @@ class SpeculativeBatchingEngine(BatchingEngine):
                 "speculative batching emits up to gamma+1 tokens per step "
                 "already; decode_ticks must stay 1"
             )
+        if kw.get("prefill_chunk") is not None:
+            raise ValueError(
+                "speculative batching does not support chunked prefill "
+                "(the draft cache prefills whole prompts)"
+            )
         super().__init__(cfg, params, **kw)
         self.draft_cfg = draft_cfg
         self.draft_params = draft_params
