@@ -346,7 +346,7 @@ def cmd_serve(args):
             cfg, params, dcfg, dparams, gamma=args.gamma,
             n_slots=args.slots, max_len=args.max_len or cfg.max_seq_len,
             temperature=args.temperature, eos_id=args.eos_id,
-            seed=args.seed,
+            seed=args.seed, logprobs=args.logprobs,
             max_prefills_per_step=args.max_prefills_per_step,
         )
     if args.paged:
@@ -360,6 +360,7 @@ def cmd_serve(args):
             max_prefills_per_step=args.max_prefills_per_step,
             prefix_cache=args.prefix_cache,
             prefill_chunk=args.prefill_chunk,
+            logprobs=args.logprobs,
         )
     serve(
         cfg, params,
@@ -371,6 +372,7 @@ def cmd_serve(args):
         decode_ticks=args.decode_ticks,
         max_prefills_per_step=args.max_prefills_per_step,
         prefill_chunk=args.prefill_chunk,
+        logprobs=args.logprobs,
     )
     return 0
 
@@ -521,6 +523,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(dense cache only)")
     s.add_argument("--gamma", type=int, default=4,
                    help="draft tokens proposed per verification round")
+    s.add_argument("--logprobs", action="store_true",
+                   help="track per-token logprobs so requests may ask "
+                        "for them")
     s.add_argument("--prefill-chunk", type=int, default=None,
                    dest="prefill_chunk",
                    help="prefill prompts longer than this incrementally "

@@ -63,6 +63,11 @@ class _Request:
     # (<= the longest stop length) once, at completion. Streaming holds
     # back that many tokens so an emitted token can never be retracted.
     out: List[int] = field(default_factory=list)
+    # Logprob of each emitted token under the raw (unfiltered,
+    # untempered) model distribution — same convention as the
+    # single-request Engine. Populated only when the engine was built
+    # with logprobs=True; kept in lockstep with `out`.
+    lps: List[float] = field(default_factory=list)
 
     def hit_stop(self) -> Optional[int]:
         """Length of the matched stop suffix of `out`, or None."""
@@ -100,6 +105,7 @@ class BatchingEngine:
         decode_ticks: int = 1,
         max_prefills_per_step: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
+        logprobs: bool = False,
     ):
         if decode_ticks < 1:
             raise ValueError(f"decode_ticks must be >= 1, got {decode_ticks}")
@@ -129,6 +135,12 @@ class BatchingEngine:
         self.prefill_chunk = prefill_chunk
         self._prefilling: Dict[int, int] = {}  # slot -> tokens written
         self._chunk_jit: Dict[Any, Any] = {}  # keyed (pad, fresh)
+        # logprobs=True: every emitted token's logprob (raw-logit
+        # log_softmax, the Engine convention) is tracked; finished
+        # requests deposit theirs here, keyed by rid, for the server
+        # (or any caller) to pop.
+        self.logprobs = logprobs
+        self.finished_logprobs: Dict[Any, List[float]] = {}
         # Engine-level sampling defaults; submit() can override any of
         # them per request. Each slot's effective settings live in
         # device vectors fed to the jitted programs, so one decode tick
@@ -187,6 +199,7 @@ class BatchingEngine:
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first = sample_batched(key, last[None], *samp)[0]
+        first_lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
         cache = KVCache(
             k=jax.lax.dynamic_update_slice_in_dim(
                 cache.k, mini.k, slot, axis=1
@@ -198,7 +211,7 @@ class BatchingEngine:
                 cache.lengths, mini.lengths, (slot,)
             ),
         )
-        return cache, first
+        return cache, first, first_lp
 
     def _decode_impl(self, params, cache, cur, active, key, samp,
                      greedy_only: bool = False):
@@ -211,7 +224,8 @@ class BatchingEngine:
         overshoot tokens, and the slot is released/rewritten afterwards,
         so the math each request sees is unchanged (tested greedy
         bit-parity vs the single-request engine). Inactive slots stay
-        frozen. Returns (cache, tokens (K, n_slots)).
+        frozen. Returns (cache, tokens (K, n_slots), logprobs (K,
+        n_slots) -- zeros unless self.logprobs).
         """
 
         def tick(carry, key):
@@ -230,11 +244,18 @@ class BatchingEngine:
             lengths = jnp.where(active, cache.lengths, old_lengths)
             cache = cache.replace(lengths=lengths)
             nxt = jnp.where(active, nxt, cur)
-            return (cache, nxt), nxt
+            if self.logprobs:
+                lp = jnp.take_along_axis(
+                    jax.nn.log_softmax(logits[:, 0].astype(jnp.float32)),
+                    nxt[:, None], axis=-1,
+                )[:, 0]
+            else:
+                lp = jnp.zeros(nxt.shape, jnp.float32)
+            return (cache, nxt), (nxt, lp)
 
         keys = jax.random.split(key, self.decode_ticks)
-        (cache, _), toks = jax.lax.scan(tick, (cache, cur), keys)
-        return cache, toks
+        (cache, _), (toks, lps) = jax.lax.scan(tick, (cache, cur), keys)
+        return cache, toks, lps
 
     # ---- scheduling --------------------------------------------------
 
@@ -309,9 +330,9 @@ class BatchingEngine:
         self._stopp = self._stopp.at[slot].set(req.top_p)
         self._sminp = self._sminp.at[slot].set(req.min_p)
 
-    def _run_prefill(self, slot: int, req: _Request) -> jax.Array:
-        """Run the (bucketed, jitted) prefill for `req`; returns the
-        first sampled token as a device scalar."""
+    def _run_prefill(self, slot: int, req: _Request):
+        """Run the (bucketed, jitted) prefill for `req`; returns
+        (first sampled token, its raw logprob), both device scalars."""
         s = req.tokens.size
         # Cap the bucket at max_len: a pad larger than the cache
         # (dense) or the block table (paged) would write out of
@@ -324,12 +345,12 @@ class BatchingEngine:
         padded = np.zeros((1, pad), np.int32)
         padded[0, :s] = req.tokens
         self._key, sub = jax.random.split(self._key)
-        cache, first = self._prefill_jit[pad](
+        cache, first, lp = self._prefill_jit[pad](
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray([s], jnp.int32), slot, sub, self._slot_samp(req),
         )
         self._cache = cache
-        return first
+        return first, lp
 
     def _prefill_start_offset(self, slot: int) -> int:
         """Tokens already resident when prefill starts (paged prefix
@@ -355,14 +376,17 @@ class BatchingEngine:
                 self._slots[i] = req
                 self._prefilling[i] = off
                 continue
-            first = self._run_prefill(i, req)
-            self._finish_prefill(i, req, first)
+            first, lp = self._run_prefill(i, req)
+            self._finish_prefill(i, req, first, lp)
 
-    def _finish_prefill(self, slot: int, req: _Request, first) -> None:
+    def _finish_prefill(self, slot: int, req: _Request, first,
+                        lp=None) -> None:
         first_tok = int(first)
         self._cur = self._cur.at[slot].set(first_tok)
         self._slots[slot] = req
         req.out.append(first_tok)
+        if self.logprobs and lp is not None:
+            req.lps.append(float(lp))
         self.stats["prefills"] += 1
 
     # ---- chunked prefill --------------------------------------------
@@ -383,7 +407,7 @@ class BatchingEngine:
             s = chunk.size
             pad = min(_bucket(s), self.max_len - off)
             self._key, sub = jax.random.split(self._key)
-            cache, first = self._chunk_prefill(
+            cache, first, lp = self._chunk_prefill(
                 pad, off == 0, jnp.asarray(
                     np.pad(chunk, (0, pad - s))[None]
                 ),
@@ -393,7 +417,7 @@ class BatchingEngine:
             self._cache = cache
             if off + s >= req.tokens.size:
                 del self._prefilling[slot]
-                self._finish_prefill(slot, req, first)
+                self._finish_prefill(slot, req, first, lp)
             else:
                 self._prefilling[slot] = off + s
         return used
@@ -432,6 +456,7 @@ class BatchingEngine:
             logits, (chunk_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first = sample_batched(key, last[None], *samp)[0]
+        first_lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
         cache = KVCache(
             k=jax.lax.dynamic_update_slice_in_dim(
                 cache.k, view.k, slot, axis=1
@@ -443,7 +468,7 @@ class BatchingEngine:
                 cache.lengths, view.lengths, (slot,)
             ),
         )
-        return cache, first
+        return cache, first, first_lp
 
     def _finish_check(self, finished):
         for i, req in enumerate(self._slots):
@@ -454,10 +479,13 @@ class BatchingEngine:
             nstop = req.hit_stop()
             if nstop is not None:
                 req.out = req.out[:-nstop]
+                req.lps = req.lps[:len(req.out)]
             if nstop is not None or (
                 self.eos_id is not None and last == self.eos_id
             ) or len(req.out) >= req.max_new:
                 finished.append((req.rid, req.out))
+                if self.logprobs:
+                    self.finished_logprobs[req.rid] = req.lps[:len(req.out)]
                 self.stats["requests_completed"] += 1
                 self.stats["tokens_generated"] += len(req.out)
                 self._slots[i] = None
@@ -498,18 +526,25 @@ class BatchingEngine:
                 remaining is not None and remaining <= 0
             ):
                 break
+        if self._prefilling and (remaining is None or remaining > 0):
+            # Chunked prompts admitted THIS step start their first
+            # chunk immediately instead of idling a full decode window.
+            self._advance_prefills(remaining)
+            self._finish_check(finished)
         active_rows = [
             r is not None and i not in self._prefilling
             for i, r in enumerate(self._slots)
         ]
         if any(active_rows):
             self._pre_decode(active_rows)
-            per_slot = self._decode_tokens(active_rows)
+            per_slot, per_lps = self._decode_tokens(active_rows)
             for i, req in enumerate(self._slots):
                 if req is None or i in self._prefilling:
                     continue
-                for tok in per_slot[i]:
+                for j, tok in enumerate(per_slot[i]):
                     req.out.append(int(tok))
+                    if per_lps is not None:
+                        req.lps.append(float(per_lps[i][j]))
                     last = req.out[-1]
                     if (self.eos_id is not None and last == self.eos_id) or (
                         len(req.out) >= req.max_new
@@ -521,22 +556,28 @@ class BatchingEngine:
             self._finish_check(finished)
         return finished
 
-    def _decode_tokens(self, active_rows) -> List[List[int]]:
-        """Advance every active slot; returns new tokens per slot (one
-        host sync). Overridden by the speculative engine."""
+    def _decode_tokens(self, active_rows):
+        """Advance every active slot; returns (tokens_per_slot,
+        logprobs_per_slot or None) in one host sync. Overridden by the
+        speculative engine."""
         active = jnp.asarray(active_rows)
         self._key, sub = jax.random.split(self._key)
         greedy_only = all(
             r is None or r.temperature == 0.0 for r in self._slots
         )
-        self._cache, toks = self._decode(
+        self._cache, toks, lps = self._decode(
             self.params, self._cache, self._cur, active, sub,
             (self._stemp, self._stopk, self._stopp, self._sminp),
             greedy_only=greedy_only,
         )
         self._cur = toks[-1]
-        host_toks = np.asarray(toks)  # (K, n_slots) — the one sync
-        return [host_toks[:, i].tolist() for i in range(self.n_slots)]
+        # (K, n_slots) each — the one host sync.
+        host_toks, host_lps = jax.device_get((toks, lps))
+        per_slot = [host_toks[:, i].tolist() for i in range(self.n_slots)]
+        if not self.logprobs:
+            return per_slot, None
+        return per_slot, [host_lps[:, i].tolist()
+                          for i in range(self.n_slots)]
 
     def _pre_decode(self, active_rows) -> None:
         """Hook before each decode tick (paged: grow block tables)."""
@@ -736,7 +777,7 @@ class PagedBatchingEngine(BatchingEngine):
         self.stats["prefix_hit_tokens"] += m * self.block_size
         self.stats["prefix_query_tokens"] += req.tokens.size
 
-    def _finish_prefill(self, slot: int, req, first) -> None:
+    def _finish_prefill(self, slot: int, req, first, lp=None) -> None:
         # The prompt blocks now hold real KV: make them matchable.
         for j, h in self._pending_reg.pop(slot, ()):
             if h in self._hash_to_block:
@@ -744,7 +785,7 @@ class PagedBatchingEngine(BatchingEngine):
             blk = self._slot_blocks[slot][j]
             self._hash_to_block[h] = blk
             self._block_ref[blk] = 1
-        super()._finish_prefill(slot, req, first)
+        super()._finish_prefill(slot, req, first, lp)
 
     def _release_slot(self, slot: int) -> None:
         self._pending_reg.pop(slot, None)
@@ -808,8 +849,9 @@ class PagedBatchingEngine(BatchingEngine):
             samp,
         )
 
-    def _run_prefill(self, slot: int, req) -> jax.Array:
-        """Prefix-cached prefill: compute only the unmatched suffix."""
+    def _run_prefill(self, slot: int, req):
+        """Prefix-cached prefill: compute only the unmatched suffix;
+        returns (first sampled token, its raw logprob)."""
         p = self._slot_prefix_len[slot] if self.prefix_cache else 0
         if p == 0:
             return super()._run_prefill(slot, req)
@@ -826,13 +868,13 @@ class PagedBatchingEngine(BatchingEngine):
         padded = np.zeros((1, pad), np.int32)
         padded[0, :s] = suffix
         self._key, sub = jax.random.split(self._key)
-        cache, first = self._prefix_prefill_jit[pad](
+        cache, first, lp = self._prefix_prefill_jit[pad](
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray([s], jnp.int32), jnp.asarray([p], jnp.int32),
             slot, sub, self._slot_samp(req),
         )
         self._cache = cache
-        return first
+        return first, lp
 
     def _prefix_prefill_impl(
         self, params, cache, tokens, suffix_len, prefix_len, slot, key, samp
@@ -864,13 +906,14 @@ class PagedBatchingEngine(BatchingEngine):
             logits, (suffix_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first = sample_batched(key, last[None], *samp)[0]
+        first_lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
         cache = cache.replace(
             k=view.k, v=view.v,
             lengths=jax.lax.dynamic_update_slice(
                 cache.lengths, view.lengths, (slot,)
             ),
         )
-        return cache, first
+        return cache, first, first_lp
 
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
                       samp):
@@ -885,6 +928,7 @@ class PagedBatchingEngine(BatchingEngine):
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first = sample_batched(key, last[None], *samp)[0]
+        first_lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
 
         bs = self.block_size
         table_row = jax.lax.dynamic_slice_in_dim(cache.tables, slot, 1, 0)[0]
@@ -903,7 +947,7 @@ class PagedBatchingEngine(BatchingEngine):
                 cache.lengths, mini.lengths, (slot,)
             ),
         )
-        return cache, first
+        return cache, first, first_lp
 
 
 class _PoolExhausted(Exception):

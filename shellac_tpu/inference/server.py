@@ -10,8 +10,10 @@ API:
   POST /generate  {"tokens": [1,2,3] | "text": "...", "max_new": 32,
                    "stop": [[7,8], "..."]?,
                    "temperature"/"top_k"/"top_p"/"min_p": per-request
-                   sampling overrides (engine defaults otherwise)}
-                  -> {"id", "tokens", "text"?}
+                   sampling overrides (engine defaults otherwise),
+                   "logprobs": true? (needs an engine built with
+                   logprobs=True / serve --logprobs)}
+                  -> {"id", "tokens", "text"?, "logprobs"?}
                   With "stream": true the response is newline-delimited
                   JSON written as tokens are generated: zero or more
                   {"tokens": [...]} delta lines, then one
@@ -40,7 +42,8 @@ from shellac_tpu.inference.batching import BatchingEngine
 
 
 class _Pending:
-    __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback")
+    __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback",
+                 "lps")
 
     def __init__(self, stream: bool = False, holdback: int = 0):
         self.event = threading.Event()
@@ -54,6 +57,9 @@ class _Pending:
         # up to max(len(stop)) tokens at the end, so anything closer to
         # the tail than that may still disappear.
         self.holdback = holdback
+        # Per-token logprobs of the final result (engines built with
+        # logprobs=True deposit them at completion).
+        self.lps = None
 
     def finish(self):
         if self.chunks is not None:
@@ -143,13 +149,17 @@ class InferenceServer:
                     if upto > p.emitted:
                         p.chunks.put(list(req.out[p.emitted:upto]))
                         p.emitted = upto
+                lp_store = getattr(self.engine, "finished_logprobs", {})
                 for rid, out in finished:
                     p = self._pending.pop(rid, None)
                     if p is not None:
                         p.result = out
+                        p.lps = lp_store.pop(rid, None)
                         if p.chunks is not None and len(out) > p.emitted:
                             p.chunks.put(list(out[p.emitted:]))
                         p.finish()
+                    else:
+                        lp_store.pop(rid, None)
             elif not drained:
                 # Idle: block briefly on the queue instead of spinning.
                 try:
@@ -186,18 +196,22 @@ class InferenceServer:
         raise ValueError(p.error)
 
     def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
-                 stop=None, **samp):
+                 stop=None, return_logprobs: bool = False, **samp):
         p = self._submit(tokens, max_new, stop, samp, stream=False)
         if not p.event.wait(timeout):
             raise TimeoutError("request timed out")
         if p.error is not None:
             self._raise(p)
+        if return_logprobs:
+            return p.result, p.lps
         return p.result
 
     def generate_stream(self, tokens, max_new: int,
-                        timeout: Optional[float] = None, stop=None, **samp):
+                        timeout: Optional[float] = None, stop=None,
+                        return_logprobs: bool = False, **samp):
         """Yield ("delta", [token ids]) as generation progresses, then
-        ("done", full output). `timeout` bounds the wait per chunk."""
+        ("done", full output) — or ("done", (output, logprobs)) with
+        return_logprobs=True. `timeout` bounds the wait per chunk."""
         p = self._submit(tokens, max_new, stop, samp, stream=True)
         while True:
             try:
@@ -209,7 +223,7 @@ class InferenceServer:
             yield ("delta", chunk)
         if p.error is not None:
             self._raise(p)
-        yield ("done", p.result)
+        yield ("done", (p.result, p.lps) if return_logprobs else p.result)
 
     def _parse(self, payload: dict):
         if "tokens" in payload:
@@ -259,33 +273,51 @@ class InferenceServer:
             raise ValueError(f"bad sampling parameters: {e}")
         return tokens, max_new, stop, samp
 
+    def _check_logprobs(self, payload) -> bool:
+        want = bool(payload.get("logprobs"))
+        if want and not getattr(self.engine, "logprobs", False):
+            raise ValueError(
+                "logprobs requested but the server engine was not built "
+                "with logprobs=True (serve --logprobs)"
+            )
+        return want
+
     def handle(self, payload: dict) -> dict:
         tokens, max_new, stop, samp = self._parse(payload)
-        out = self.generate(
+        want_lps = self._check_logprobs(payload)
+        out, lps = self.generate(
             tokens, max_new, timeout=payload.get("timeout"), stop=stop,
-            **samp,
+            return_logprobs=True, **samp,
         )
         result: Dict[str, Any] = {"tokens": out}
+        if want_lps:
+            result["logprobs"] = lps
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(out)
         return result
 
     def handle_stream(self, payload: dict):
         """Yield response dicts for a streaming request: delta lines
-        {"tokens": [...]}, then {"done": true, "tokens", "text"?}.
-        Parse errors raise before the first yield (clean HTTP 400)."""
+        {"tokens": [...]}, then {"done": true, "tokens", "text"?,
+        "logprobs"?}. Logprobs (when requested) arrive on the final
+        record only. Parse errors raise before the first yield (clean
+        HTTP 400)."""
         tokens, max_new, stop, samp = self._parse(payload)
+        want_lps = self._check_logprobs(payload)
         stream = self.generate_stream(
             tokens, max_new, timeout=payload.get("timeout"), stop=stop,
-            **samp,
+            return_logprobs=True, **samp,
         )
         for kind, val in stream:
             if kind == "delta":
                 yield {"tokens": val}
             else:
-                final: Dict[str, Any] = {"done": True, "tokens": val}
+                out, lps = val
+                final: Dict[str, Any] = {"done": True, "tokens": out}
+                if want_lps:
+                    final["logprobs"] = lps
                 if self.tokenizer is not None:
-                    final["text"] = self.tokenizer.decode(val)
+                    final["text"] = self.tokenizer.decode(out)
                 yield final
 
     def close(self):

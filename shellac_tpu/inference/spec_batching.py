@@ -111,8 +111,8 @@ class SpeculativeBatchingEngine(BatchingEngine):
 
     # ---- prefill (target via base, plus the draft cache) ------------
 
-    def _run_prefill(self, slot: int, req) -> jax.Array:
-        first = super()._run_prefill(slot, req)
+    def _run_prefill(self, slot: int, req):
+        first_and_lp = super()._run_prefill(slot, req)
         s = req.tokens.size
         pad = min(_bucket(s), self.max_len)
         if pad not in self._draft_prefill_jit:
@@ -123,7 +123,7 @@ class SpeculativeBatchingEngine(BatchingEngine):
             self.draft_params, self._dcache, jnp.asarray(padded),
             jnp.asarray([s], jnp.int32), slot,
         )
-        return first
+        return first_and_lp
 
     def _draft_prefill_impl(self, dparams, dcache, tokens, prompt_len, slot):
         mini = init_cache(self.draft_cfg, 1, self.max_len)
@@ -147,7 +147,8 @@ class SpeculativeBatchingEngine(BatchingEngine):
 
     def _spec_round_impl(self, params, dparams, tcache, dcache, cur,
                          active, temp, key):
-        """Returns (tcache, dcache, emitted (B, g+1), counts (B,), cur).
+        """Returns (tcache, dcache, emitted (B, g+1), counts (B,), cur,
+        lps (B, g+1) — zeros unless self.logprobs).
 
         counts[b] tokens of emitted[b] are real (0 for inactive rows).
         Per-row temperature: greedy rows use the exact-match degenerate
@@ -246,18 +247,32 @@ class SpeculativeBatchingEngine(BatchingEngine):
         )
         cur = jnp.where(active, extra, cur)
         counts = jnp.where(active, n + 1, 0)
-        return tcache, dcache, emitted, counts, cur
+        if self.logprobs:
+            # Raw-logit log_softmax of each emitted token (cols past
+            # counts are garbage the host drops) — Engine convention.
+            lps = jnp.take_along_axis(
+                jax.nn.log_softmax(tlogits.astype(jnp.float32), axis=-1),
+                emitted[..., None], axis=-1,
+            )[..., 0]
+        else:
+            lps = jnp.zeros(emitted.shape, jnp.float32)
+        return tcache, dcache, emitted, counts, cur, lps
 
-    def _decode_tokens(self, active_rows) -> List[List[int]]:
+    def _decode_tokens(self, active_rows):
         active = jnp.asarray(active_rows)
         self._key, sub = jax.random.split(self._key)
-        (self._cache, self._dcache, emitted, counts,
-         self._cur) = self._spec_round(
+        (self._cache, self._dcache, emitted, counts, self._cur,
+         lps) = self._spec_round(
             self.params, self.draft_params, self._cache, self._dcache,
             self._cur, active, self._stemp, sub,
         )
-        em, cnt = jax.device_get((emitted, counts))  # the one host sync
+        # The one host sync.
+        em, cnt, host_lps = jax.device_get((emitted, counts, lps))
         self.stats["spec_rounds"] += 1
         self.stats["spec_proposed"] += int((cnt > 0).sum()) * self.gamma
         self.stats["spec_accepted"] += int(np.maximum(cnt - 1, 0).sum())
-        return [em[i, :cnt[i]].tolist() for i in range(self.n_slots)]
+        per_slot = [em[i, :cnt[i]].tolist() for i in range(self.n_slots)]
+        if not self.logprobs:
+            return per_slot, None
+        return per_slot, [host_lps[i, :cnt[i]].tolist()
+                          for i in range(self.n_slots)]

@@ -1,0 +1,173 @@
+"""Per-token logprobs in the serving engines and HTTP API.
+
+Convention (shared with the single-request Engine): logprob of each
+emitted token under the raw — unfiltered, untempered — model
+distribution.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.batching import BatchingEngine, PagedBatchingEngine
+from shellac_tpu.inference.engine import Engine
+from shellac_tpu.inference.server import InferenceServer, make_http_server
+from shellac_tpu.models import transformer
+
+
+def _tiny(**kw):
+    return get_model_config("tiny").replace(dtype="float32", **kw)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _tiny()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _ref(cfg, params, tokens, max_new):
+    eng = Engine(cfg, params, temperature=0.0)
+    out = eng.generate(
+        jnp.asarray(np.asarray(tokens, np.int32)[None]), max_new_tokens=max_new
+    )
+    return (np.asarray(out.tokens)[0].tolist(),
+            np.asarray(out.logprobs)[0].tolist())
+
+
+class TestEngineLogprobs:
+    def test_matches_single_request_engine(self, setup):
+        cfg, params = setup
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(0, cfg.vocab_size, 6).astype(np.int32)
+        want_toks, want_lps = _ref(cfg, params, prompt, 8)
+        srv = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             logprobs=True)
+        got = srv.run([("x", prompt, 8)])["x"]
+        assert got == want_toks
+        lps = srv.finished_logprobs.pop("x")
+        assert len(lps) == len(got)
+        np.testing.assert_allclose(lps, want_lps, rtol=1e-4, atol=1e-5)
+        assert not srv.finished_logprobs
+
+    def test_paged_and_chunked(self, setup):
+        cfg, params = setup
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(0, cfg.vocab_size, 40).astype(np.int32)
+        want_toks, want_lps = _ref(cfg, params, prompt, 6)
+        srv = PagedBatchingEngine(cfg, params, n_slots=2, max_len=96,
+                                  block_size=8, prefix_cache=True,
+                                  prefill_chunk=16, logprobs=True)
+        for rid in ("cold", "warm"):  # second run hits the prefix cache
+            assert srv.run([(rid, prompt, 6)])[rid] == want_toks
+            lps = srv.finished_logprobs.pop(rid)
+            np.testing.assert_allclose(lps, want_lps, rtol=1e-4,
+                                       atol=1e-5, err_msg=rid)
+
+    def test_stop_truncation_keeps_lockstep(self, setup):
+        cfg, params = setup
+        prompt = np.array([5, 6], np.int32)
+        full, _ = _ref(cfg, params, prompt, 12)
+        stop = [full[3:5]]
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64,
+                             logprobs=True)
+        got = srv.run([("x", prompt, 12, stop)])["x"]
+        assert got == full[:3]
+        assert len(srv.finished_logprobs.pop("x")) == 3
+
+    def test_disabled_by_default(self, setup):
+        cfg, params = setup
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        srv.run([("x", np.array([1, 2], np.int32), 4)])
+        assert srv.finished_logprobs == {}
+
+    def test_speculative_engine(self, setup):
+        from shellac_tpu.inference.spec_batching import (
+            SpeculativeBatchingEngine,
+        )
+
+        cfg, params = setup
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(0, cfg.vocab_size, 5).astype(np.int32)
+        want_toks, want_lps = _ref(cfg, params, prompt, 10)
+        srv = SpeculativeBatchingEngine(cfg, params, cfg, params, gamma=3,
+                                        n_slots=1, max_len=96,
+                                        logprobs=True)
+        assert srv.run([("x", prompt, 10)])["x"] == want_toks
+        lps = srv.finished_logprobs.pop("x")
+        np.testing.assert_allclose(lps, want_lps, rtol=1e-4, atol=1e-5)
+
+
+class TestHTTPLogprobs:
+    @pytest.fixture(scope="class")
+    def http(self, setup):
+        cfg, params = setup
+        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0, logprobs=True)
+        srv = InferenceServer(cfg, params, engine=eng)
+        httpd = make_http_server(srv)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        yield f"http://127.0.0.1:{httpd.server_address[1]}"
+        httpd.shutdown()
+        srv.close()
+
+    def _post(self, base, payload):
+        req = urllib.request.Request(
+            f"{base}/generate", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return json.loads(r.read())
+
+    def test_blocking(self, http, setup):
+        cfg, params = setup
+        want_toks, want_lps = _ref(cfg, params, [3, 7, 11], 6)
+        out = self._post(http, {"tokens": [3, 7, 11], "max_new": 6,
+                                "logprobs": True})
+        assert out["tokens"] == want_toks
+        np.testing.assert_allclose(out["logprobs"], want_lps, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_not_requested_not_returned(self, http):
+        out = self._post(http, {"tokens": [1, 2], "max_new": 4})
+        assert "logprobs" not in out
+
+    def test_streaming_final_record(self, http):
+        blocking = self._post(http, {"tokens": [2, 4], "max_new": 6,
+                                     "logprobs": True})
+        req = urllib.request.Request(
+            f"{http}/generate",
+            data=json.dumps({"tokens": [2, 4], "max_new": 6,
+                             "stream": True, "logprobs": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        lines = []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for raw in r:
+                lines.append(json.loads(raw))
+        assert lines[-1]["done"] is True
+        assert lines[-1]["logprobs"] == blocking["logprobs"]
+
+    def test_engine_without_flag_is_400(self, setup):
+        cfg, params = setup
+        srv = InferenceServer(cfg, params, n_slots=1, max_len=64)
+        httpd = make_http_server(srv)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(base, {"tokens": [1], "max_new": 2,
+                                  "logprobs": True})
+            assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+            srv.close()
