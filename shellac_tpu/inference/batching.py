@@ -582,6 +582,23 @@ class BatchingEngine:
     def _pre_decode(self, active_rows) -> None:
         """Hook before each decode tick (paged: grow block tables)."""
 
+    def cancel(self, rid) -> bool:
+        """Drop a queued or in-flight request (caller must be the
+        engine-owning thread). Frees its slot immediately; device
+        state needs no repair (stale cache rows are self-healing)."""
+        for i, req in enumerate(self._slots):
+            if req is not None and req.rid == rid:
+                self._slots[i] = None
+                self._prefilling.pop(i, None)
+                self._release_slot(i)
+                self.finished_logprobs.pop(rid, None)
+                return True
+        for req in list(self._queue):
+            if req.rid == rid:
+                self._queue.remove(req)
+                return True
+        return False
+
     @property
     def pending(self) -> int:
         return len(self._queue) + sum(r is not None for r in self._slots)

@@ -12,7 +12,12 @@ API:
                    "temperature"/"top_k"/"top_p"/"min_p": per-request
                    sampling overrides (engine defaults otherwise),
                    "logprobs": true? (needs an engine built with
-                   logprobs=True / serve --logprobs)}
+                   logprobs=True / serve --logprobs),
+                   "n"/"best_of": parallel sampling — best_of
+                   completions are generated concurrently (sharing the
+                   slot batch) and the n best by mean logprob return as
+                   {"choices": [{"tokens", "text"?, "logprobs"?}, ...]}
+                   (best_of > n needs --logprobs; greedy rejects n>1)}
                   -> {"id", "tokens", "text"?, "logprobs"?}
                   With "stream": true the response is newline-delimited
                   JSON written as tokens are generated: zero or more
@@ -32,6 +37,7 @@ import itertools
 import json
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -43,9 +49,10 @@ from shellac_tpu.inference.batching import BatchingEngine
 
 class _Pending:
     __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback",
-                 "lps")
+                 "lps", "rid")
 
-    def __init__(self, stream: bool = False, holdback: int = 0):
+    def __init__(self, rid, stream: bool = False, holdback: int = 0):
+        self.rid = rid
         self.event = threading.Event()
         self.result = None
         self.error: Optional[str] = None
@@ -112,26 +119,36 @@ class InferenceServer:
                     p.error = self._fatal
                     p.finish()
 
+    def _process_item(self, item) -> None:
+        rid, tokens, max_new, stop, samp = item
+        if tokens is None:
+            # Cancellation marker: drop queued/in-flight work for an
+            # abandoned client request.
+            self.engine.cancel(rid)
+            p = self._pending.pop(rid, None)
+            if p is not None:
+                p.error = "cancelled"
+                p.finish()
+            return
+        try:
+            self.engine.submit(rid, tokens, max_new, stop=stop, **samp)
+        except (ValueError, TypeError) as e:
+            # TypeError: unknown sampling kwarg from a programmatic
+            # caller — a bad request, not a scheduler-killing fault.
+            p = self._pending.pop(rid)
+            p.error = str(e)
+            p.finish()
+
     def _run(self):
         while not self._stop.is_set():
             drained = False
             while True:
                 try:
-                    (rid, tokens, max_new, stop,
-                     samp) = self._submit_q.get_nowait()
+                    item = self._submit_q.get_nowait()
                 except queue.Empty:
                     break
                 drained = True
-                try:
-                    self.engine.submit(rid, tokens, max_new, stop=stop,
-                                       **samp)
-                except (ValueError, TypeError) as e:
-                    # TypeError: unknown sampling kwarg from a
-                    # programmatic caller — a bad request, not a
-                    # scheduler-killing fault.
-                    p = self._pending.pop(rid)
-                    p.error = str(e)
-                    p.finish()
+                self._process_item(item)
             if self.engine.pending:
                 finished = self.engine.step()
                 fin = {rid for rid, _ in finished}
@@ -162,9 +179,10 @@ class InferenceServer:
                         lp_store.pop(rid, None)
             elif not drained:
                 # Idle: block briefly on the queue instead of spinning.
+                # Process in place — re-enqueueing could reorder a
+                # submit behind its own cancellation marker.
                 try:
-                    item = self._submit_q.get(timeout=0.05)
-                    self._submit_q.put(item)
+                    self._process_item(self._submit_q.get(timeout=0.05))
                 except queue.Empty:
                     pass
 
@@ -176,7 +194,7 @@ class InferenceServer:
             raise RuntimeError(self._fatal)
         rid = next(self._ids)
         holdback = max((len(s) for s in stop), default=0) if stop else 0
-        p = _Pending(stream=stream, holdback=holdback)
+        p = _Pending(rid, stream=stream, holdback=holdback)
         self._pending[rid] = p
         self._submit_q.put(
             (rid, np.asarray(tokens, np.int32), max_new, stop, samp or {})
@@ -195,13 +213,35 @@ class InferenceServer:
             raise RuntimeError(p.error)
         raise ValueError(p.error)
 
-    def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
-                 stop=None, return_logprobs: bool = False, **samp):
-        p = self._submit(tokens, max_new, stop, samp, stream=False)
-        if not p.event.wait(timeout):
+    def _await(self, p: _Pending, deadline: Optional[float]) -> _Pending:
+        remaining = (None if deadline is None
+                     else max(deadline - time.monotonic(), 0.0))
+        if not p.event.wait(remaining):
             raise TimeoutError("request timed out")
         if p.error is not None:
             self._raise(p)
+        return p
+
+    def _cancel(self, p: _Pending) -> None:
+        """Ask the scheduler to drop an unfinished request (tokens=None
+        marker); its engine slot frees instead of generating unread
+        tokens."""
+        if not p.event.is_set():
+            self._submit_q.put((p.rid, None, 0, None, None))
+
+    @staticmethod
+    def _deadline(timeout) -> Optional[float]:
+        return None if timeout is None else time.monotonic() + timeout
+
+    def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
+                 stop=None, return_logprobs: bool = False, **samp):
+        p = self._submit(tokens, max_new, stop, samp, stream=False)
+        try:
+            self._await(p, self._deadline(timeout))
+        except TimeoutError:
+            # Don't strand the slot generating tokens nobody will read.
+            self._cancel(p)
+            raise
         if return_logprobs:
             return p.result, p.lps
         return p.result
@@ -285,16 +325,89 @@ class InferenceServer:
     def handle(self, payload: dict) -> dict:
         tokens, max_new, stop, samp = self._parse(payload)
         want_lps = self._check_logprobs(payload)
-        out, lps = self.generate(
-            tokens, max_new, timeout=payload.get("timeout"), stop=stop,
-            return_logprobs=True, **samp,
-        )
+        n, best_of = self._parse_n(payload, samp)
+        if n == 1 and best_of == 1:
+            out, lps = self.generate(
+                tokens, max_new, timeout=payload.get("timeout"), stop=stop,
+                return_logprobs=True, **samp,
+            )
+            return self._format_completion(out, lps, want_lps)
+        # Parallel sampling: best_of independent completions share the
+        # slot batch (and, on a paged+prefix engine, their prompt KV);
+        # the n best by mean token logprob come back as "choices".
+        pendings = [
+            self._submit(tokens, max_new, stop, samp, stream=False)
+            for _ in range(best_of)
+        ]
+        # One overall deadline for the whole fan-out — not a fresh
+        # clock per completion.
+        deadline = self._deadline(payload.get("timeout"))
+        choices = []
+        try:
+            for p in pendings:
+                self._await(p, deadline)
+                choices.append((p.result, p.lps))
+        except (TimeoutError, ValueError, RuntimeError):
+            # Don't strand the rest: unfinished siblings would keep
+            # occupying slots generating tokens nobody will read.
+            for p in pendings:
+                self._cancel(p)
+            raise
+        if best_of > n:
+            # Rank by mean logprob (length-normalized); engine logprobs
+            # are guaranteed on because _parse_n requires the flag. A
+            # completion emptied by a stop match ranks last, not first
+            # (an empty mean would otherwise score a perfect 0.0).
+            def score(c):
+                return (sum(c[1]) / len(c[1])) if c[1] else float("-inf")
+
+            choices.sort(key=score, reverse=True)
+        return {"choices": [
+            self._format_completion(out, lps, want_lps)
+            for out, lps in choices[:n]
+        ]}
+
+    def _format_completion(self, out, lps, want_lps) -> Dict[str, Any]:
         result: Dict[str, Any] = {"tokens": out}
         if want_lps:
             result["logprobs"] = lps
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(out)
         return result
+
+    def _parse_n(self, payload: dict, samp: dict):
+        """Validate n (completions returned) and best_of (sampled)."""
+        try:
+            n = int(payload.get("n", 1))
+            best_of = int(payload.get("best_of", n))
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"bad n/best_of: {e}")
+        if n < 1 or best_of < n:
+            raise ValueError(f"need best_of >= n >= 1, got n={n}, "
+                             f"best_of={best_of}")
+        cap = max(4 * getattr(self.engine, "n_slots", 8), 16)
+        if best_of > cap:
+            raise ValueError(
+                f"best_of={best_of} exceeds this server's cap of {cap} "
+                "(4x slot count): one request would monopolize the "
+                "engine for every other client"
+            )
+        if best_of == 1:
+            return n, best_of
+        temp = samp.get("temperature",
+                        getattr(self.engine, "_defaults", {}).get(
+                            "temperature", 0.0))
+        if temp == 0.0:
+            raise ValueError(
+                "n/best_of > 1 with greedy sampling would return "
+                "identical completions; set a temperature"
+            )
+        if best_of > n and not getattr(self.engine, "logprobs", False):
+            raise ValueError(
+                "best_of > n ranks completions by logprob; start the "
+                "server with logprobs enabled (serve --logprobs)"
+            )
+        return n, best_of
 
     def handle_stream(self, payload: dict):
         """Yield response dicts for a streaming request: delta lines
@@ -304,6 +417,9 @@ class InferenceServer:
         HTTP 400)."""
         tokens, max_new, stop, samp = self._parse(payload)
         want_lps = self._check_logprobs(payload)
+        n, best_of = self._parse_n(payload, samp)
+        if n != 1 or best_of != 1:
+            raise ValueError("streaming does not support n/best_of > 1")
         stream = self.generate_stream(
             tokens, max_new, timeout=payload.get("timeout"), stop=stop,
             return_logprobs=True, **samp,

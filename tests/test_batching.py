@@ -108,6 +108,28 @@ class TestContinuousBatching:
         with pytest.raises(ValueError, match="top_k"):
             srv.submit("x", np.array([1], np.int32), 2, top_k=0)
 
+    def test_cancel_frees_slot_and_queue(self, setup):
+        """cancel() drops in-flight work (slot reusable at once) and
+        queued work; surviving requests stay exact."""
+        cfg, params = setup
+        rng = np.random.default_rng(9)
+        p1 = rng.integers(0, cfg.vocab_size, 4)
+        p2 = rng.integers(0, cfg.vocab_size, 6)
+        p3 = rng.integers(0, cfg.vocab_size, 5)
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        srv.submit("doomed", p1, 30)
+        srv.submit("queued_doomed", p3, 30)
+        srv.submit("keeper", p2, 6)
+        srv.step()  # "doomed" occupies the only slot
+        assert srv.cancel("doomed") is True
+        assert srv.cancel("queued_doomed") is True
+        assert srv.cancel("nope") is False
+        results = {}
+        while srv.pending:
+            results.update(srv.step())
+        assert list(results) == ["keeper"]
+        assert results["keeper"] == _ref_generate(cfg, params, p2, 6)
+
     def test_eos_frees_slot_early(self, setup):
         cfg, params = setup
         prompt = np.array([1, 2, 3], np.int32)

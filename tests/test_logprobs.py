@@ -156,6 +156,63 @@ class TestHTTPLogprobs:
         assert lines[-1]["done"] is True
         assert lines[-1]["logprobs"] == blocking["logprobs"]
 
+    def test_parallel_sampling_choices(self, http):
+        out = self._post(http, {"tokens": [1, 2, 3], "max_new": 6, "n": 2,
+                                "best_of": 2, "temperature": 1.2})
+        assert len(out["choices"]) == 2
+        for c in out["choices"]:
+            assert len(c["tokens"]) == 6
+
+    def test_best_of_ranks_by_mean_logprob(self, http):
+        out = self._post(http, {"tokens": [4, 5], "max_new": 6, "n": 2,
+                                "best_of": 4, "temperature": 1.3,
+                                "logprobs": True})
+        assert len(out["choices"]) == 2
+        means = [sum(c["logprobs"]) / len(c["logprobs"])
+                 for c in out["choices"]]
+        assert means[0] >= means[1]
+
+    def test_greedy_n_rejected(self, http):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(http, {"tokens": [1], "max_new": 2, "n": 2,
+                              "best_of": 2})
+        assert ei.value.code == 400
+
+    def test_stream_n_rejected(self, http):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(http, {"tokens": [1], "max_new": 2, "n": 2,
+                              "best_of": 2, "temperature": 1.0,
+                              "stream": True})
+        assert ei.value.code == 400
+
+    def test_best_of_cap_is_400(self, http):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(http, {"tokens": [1], "max_new": 2, "n": 1,
+                              "best_of": 1000, "temperature": 1.0})
+        assert ei.value.code == 400
+
+    def test_bad_n_types_are_400(self, http):
+        for bad in (None, [2], "two"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(http, {"tokens": [1], "max_new": 2, "n": bad})
+            assert ei.value.code == 400, bad
+
+    def test_best_of_without_flag_is_400(self, setup):
+        cfg, params = setup
+        srv = InferenceServer(cfg, params, n_slots=2, max_len=64,
+                              temperature=1.0)
+        httpd = make_http_server(srv)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._post(base, {"tokens": [1], "max_new": 2, "n": 1,
+                                  "best_of": 3})
+            assert ei.value.code == 400
+        finally:
+            httpd.shutdown()
+            srv.close()
+
     def test_engine_without_flag_is_400(self, setup):
         cfg, params = setup
         srv = InferenceServer(cfg, params, n_slots=1, max_len=64)
