@@ -42,7 +42,7 @@ import numpy as np
 from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.kvcache import KVCache, init_cache, init_paged_cache
 from shellac_tpu.models import transformer
-from shellac_tpu.ops.sampling import sample_batched
+from shellac_tpu.ops.sampling import NEG_INF, sample_batched
 
 
 @dataclass
@@ -57,6 +57,12 @@ class _Request:
     top_k: int = 1
     top_p: float = 1.0
     min_p: float = 0.0
+    # EOS is banned from sampling until this many tokens are emitted
+    # (0 = off; stop sequences still end generation regardless).
+    min_tokens: int = 0
+    # Additive per-token logit biases applied before sampling (OpenAI
+    # semantics); logprobs still report the raw distribution.
+    logit_bias: Optional[Dict[int, float]] = None
     # Generated tokens so far. INVARIANT (the server's streaming path
     # reads this between engine steps): `out` only ever grows, except
     # that a stop-sequence match removes exactly the matched suffix
@@ -141,6 +147,11 @@ class BatchingEngine:
         # (or any caller) to pop.
         self.logprobs = logprobs
         self.finished_logprobs: Dict[Any, List[float]] = {}
+        # Per-slot additive logit biases and remaining min_tokens (EOS
+        # ban countdown, decremented on device inside the decode scan).
+        self._sbias = jnp.zeros((n_slots, cfg.vocab_size), jnp.float32)
+        self._slot_bias: List[Optional[Dict[int, float]]] = [None] * n_slots
+        self._smin = jnp.zeros((n_slots,), jnp.int32)
         # Engine-level sampling defaults; submit() can override any of
         # them per request. Each slot's effective settings live in
         # device vectors fed to the jitted programs, so one decode tick
@@ -172,7 +183,7 @@ class BatchingEngine:
         # batched sampler's full-vocab sorts when every active request
         # is greedy — the common serving default.
         self._decode = jax.jit(
-            self._decode_impl, static_argnames=("greedy_only",)
+            self._decode_impl, static_argnames=("greedy_only", "use_bias"),
         )
         # Serving observability (read by the HTTP /stats endpoint).
         # Written only by the engine-owning thread; plain ints so
@@ -198,8 +209,7 @@ class BatchingEngine:
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
-        first = sample_batched(key, last[None], *samp)[0]
-        first_lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
+        first, first_lp = self._sample_first(key, last, samp)
         cache = KVCache(
             k=jax.lax.dynamic_update_slice_in_dim(
                 cache.k, mini.k, slot, axis=1
@@ -214,7 +224,7 @@ class BatchingEngine:
         return cache, first, first_lp
 
     def _decode_impl(self, params, cache, cur, active, key, samp,
-                     greedy_only: bool = False):
+                     greedy_only: bool = False, use_bias: bool = False):
         """decode_ticks decode steps over every slot, ONE host sync.
 
         Per-tick host reads dominate serving latency when the device is
@@ -228,22 +238,27 @@ class BatchingEngine:
         n_slots) -- zeros unless self.logprobs).
         """
 
+        bias = samp[4] if use_bias else None
+        min_rem0 = samp[5]
+
         def tick(carry, key):
-            cache, cur = carry
+            cache, cur, min_rem = carry
             old_lengths = cache.lengths
             logits, cache = transformer.forward_with_cache(
                 self.cfg, params, cur[:, None], cache,
                 attn_impl=self.attn_impl,
             )
+            adj = self._adjust_logits(logits[:, 0], bias, min_rem)
             if greedy_only:
-                nxt = jnp.argmax(
-                    logits[:, 0].astype(jnp.float32), axis=-1
-                ).astype(jnp.int32)
+                nxt = jnp.argmax(adj, axis=-1).astype(jnp.int32)
             else:
-                nxt = sample_batched(key, logits[:, 0], *samp)
+                nxt = sample_batched(key, adj, *samp[:4])
             lengths = jnp.where(active, cache.lengths, old_lengths)
             cache = cache.replace(lengths=lengths)
             nxt = jnp.where(active, nxt, cur)
+            min_rem = jnp.where(
+                active, jnp.maximum(min_rem - 1, 0), min_rem
+            )
             if self.logprobs:
                 lp = jnp.take_along_axis(
                     jax.nn.log_softmax(logits[:, 0].astype(jnp.float32)),
@@ -251,11 +266,13 @@ class BatchingEngine:
                 )[:, 0]
             else:
                 lp = jnp.zeros(nxt.shape, jnp.float32)
-            return (cache, nxt), (nxt, lp)
+            return (cache, nxt, min_rem), (nxt, lp)
 
         keys = jax.random.split(key, self.decode_ticks)
-        (cache, _), (toks, lps) = jax.lax.scan(tick, (cache, cur), keys)
-        return cache, toks, lps
+        (cache, _, min_rem), (toks, lps) = jax.lax.scan(
+            tick, (cache, cur, min_rem0), keys
+        )
+        return cache, toks, lps, min_rem
 
     # ---- scheduling --------------------------------------------------
 
@@ -270,9 +287,30 @@ class BatchingEngine:
         if not 0 <= d["min_p"] < 1:
             raise ValueError(f"{label}: min_p must be in [0, 1)")
 
+    def _adjust_logits(self, logits, bias, min_rem):
+        """Apply per-row logit biases and the min_tokens EOS ban to a
+        (B, V) fp32 logit block; sampling consumes the result while
+        logprobs keep reporting the raw distribution."""
+        x = logits.astype(jnp.float32)
+        if bias is not None:
+            x = x + bias
+        if self.eos_id is not None:
+            col = jnp.where(min_rem > 0, NEG_INF, x[:, self.eos_id])
+            x = x.at[:, self.eos_id].set(col)
+        return x
+
+    def _sample_first(self, key, last, samp):
+        """Sample a prefill's first output token from the adjusted
+        (biased, EOS-banned) logits; the logprob stays on the raw
+        ones."""
+        adjusted = self._adjust_logits(last[None], samp[4], samp[5])
+        first = sample_batched(key, adjusted, *samp[:4])[0]
+        lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
+        return first, lp
+
     def submit(self, rid, tokens, max_new: int, stop=None, *,
                temperature=None, top_k=None, top_p=None,
-               min_p=None) -> None:
+               min_p=None, min_tokens=None, logit_bias=None) -> None:
         """Queue a request. `stop`: optional list of token-id sequences;
         generation ends when the output ends with any of them, and the
         matched sequence is removed from the returned tokens.
@@ -305,7 +343,30 @@ class BatchingEngine:
             "min_p": float(min_p) if min_p is not None else d["min_p"],
         }
         self._validate_sampling(samp, f"request {rid!r}")
-        self._queue.append(_Request(rid, tokens, max_new, stop=stop, **samp))
+        min_tokens = int(min_tokens) if min_tokens is not None else 0
+        if min_tokens < 0:
+            raise ValueError(f"request {rid!r}: min_tokens must be >= 0")
+        if min_tokens > 0 and self.eos_id is None:
+            raise ValueError(
+                f"request {rid!r}: min_tokens needs the engine's eos_id "
+                "(there is no EOS to suppress otherwise)"
+            )
+        if logit_bias is not None:
+            try:
+                logit_bias = {int(k): float(v)
+                              for k, v in dict(logit_bias).items()}
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"request {rid!r}: bad logit_bias: {e}")
+            oob = [k for k in logit_bias if not 0 <= k < self.cfg.vocab_size]
+            if oob:
+                raise ValueError(
+                    f"request {rid!r}: logit_bias token ids {oob} outside "
+                    f"vocab [0, {self.cfg.vocab_size})"
+                )
+        self._queue.append(_Request(
+            rid, tokens, max_new, stop=stop, min_tokens=min_tokens,
+            logit_bias=logit_bias, **samp,
+        ))
 
     def _prepare_slot(self, slot: int, req: _Request) -> None:
         """Hook before prefilling `req` into `slot` (paged: alloc blocks)."""
@@ -313,13 +374,25 @@ class BatchingEngine:
     def _release_slot(self, slot: int) -> None:
         """Hook after a request leaves `slot` (paged: free its blocks)."""
 
-    def _slot_samp(self, req: _Request):
-        """This request's sampling settings as (1,)-vectors for jit."""
+    def _bias_row(self, req: _Request) -> np.ndarray:
+        row = np.zeros((self.cfg.vocab_size,), np.float32)
+        for k, v in (req.logit_bias or {}).items():
+            row[k] = v
+        return row
+
+    def _slot_samp(self, slot: int, req: _Request):
+        """This request's sampling settings as (1, ...)-vectors for
+        jit: (temperature, top_k, top_p, min_p, logit bias row,
+        remaining min_tokens). The bias row is a device slice of the
+        matrix _set_slot_sampling already wrote (None = no bias)."""
+        bias = self._sbias[slot][None] if req.logit_bias else None
         return (
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_k], jnp.int32),
             jnp.asarray([req.top_p], jnp.float32),
             jnp.asarray([req.min_p], jnp.float32),
+            bias,
+            jnp.asarray([req.min_tokens], jnp.int32),
         )
 
     def _set_slot_sampling(self, slot: int, req: _Request) -> None:
@@ -329,6 +402,15 @@ class BatchingEngine:
         self._stopk = self._stopk.at[slot].set(req.top_k)
         self._stopp = self._stopp.at[slot].set(req.top_p)
         self._sminp = self._sminp.at[slot].set(req.min_p)
+        new_bias = req.logit_bias or None
+        if new_bias != self._slot_bias[slot]:
+            # O(n_slots x vocab) device copy — only when this slot's
+            # bias actually changes (never on the bias-free path).
+            self._sbias = self._sbias.at[slot].set(
+                jnp.asarray(self._bias_row(req))
+            )
+            self._slot_bias[slot] = new_bias
+        self._smin = self._smin.at[slot].set(req.min_tokens)
 
     def _run_prefill(self, slot: int, req: _Request):
         """Run the (bucketed, jitted) prefill for `req`; returns
@@ -347,7 +429,7 @@ class BatchingEngine:
         self._key, sub = jax.random.split(self._key)
         cache, first, lp = self._prefill_jit[pad](
             self.params, self._cache, jnp.asarray(padded),
-            jnp.asarray([s], jnp.int32), slot, sub, self._slot_samp(req),
+            jnp.asarray([s], jnp.int32), slot, sub, self._slot_samp(slot, req),
         )
         self._cache = cache
         return first, lp
@@ -384,6 +466,9 @@ class BatchingEngine:
         first_tok = int(first)
         self._cur = self._cur.at[slot].set(first_tok)
         self._slots[slot] = req
+        # The prefill-sampled token consumed one unit of the EOS ban.
+        if req.min_tokens > 0:
+            self._smin = self._smin.at[slot].set(req.min_tokens - 1)
         req.out.append(first_tok)
         if self.logprobs and lp is not None:
             req.lps.append(float(lp))
@@ -412,7 +497,7 @@ class BatchingEngine:
                     np.pad(chunk, (0, pad - s))[None]
                 ),
                 jnp.asarray([s], jnp.int32), jnp.asarray([off], jnp.int32),
-                slot, sub, self._slot_samp(req),
+                slot, sub, self._slot_samp(slot, req),
             )
             self._cache = cache
             if off + s >= req.tokens.size:
@@ -455,8 +540,7 @@ class BatchingEngine:
         last = jnp.take_along_axis(
             logits, (chunk_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
-        first = sample_batched(key, last[None], *samp)[0]
-        first_lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
+        first, first_lp = self._sample_first(key, last, samp)
         cache = KVCache(
             k=jax.lax.dynamic_update_slice_in_dim(
                 cache.k, view.k, slot, axis=1
@@ -565,10 +649,12 @@ class BatchingEngine:
         greedy_only = all(
             r is None or r.temperature == 0.0 for r in self._slots
         )
-        self._cache, toks, lps = self._decode(
+        self._cache, toks, lps, self._smin = self._decode(
             self.params, self._cache, self._cur, active, sub,
-            (self._stemp, self._stopk, self._stopp, self._sminp),
+            (self._stemp, self._stopk, self._stopp, self._sminp,
+             self._sbias, self._smin),
             greedy_only=greedy_only,
+            use_bias=any(b is not None for b in self._slot_bias),
         )
         self._cur = toks[-1]
         # (K, n_slots) each — the one host sync.
@@ -888,7 +974,7 @@ class PagedBatchingEngine(BatchingEngine):
         cache, first, lp = self._prefix_prefill_jit[pad](
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray([s], jnp.int32), jnp.asarray([p], jnp.int32),
-            slot, sub, self._slot_samp(req),
+            slot, sub, self._slot_samp(slot, req),
         )
         self._cache = cache
         return first, lp
@@ -922,8 +1008,7 @@ class PagedBatchingEngine(BatchingEngine):
         last = jnp.take_along_axis(
             logits, (suffix_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
-        first = sample_batched(key, last[None], *samp)[0]
-        first_lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
+        first, first_lp = self._sample_first(key, last, samp)
         cache = cache.replace(
             k=view.k, v=view.v,
             lengths=jax.lax.dynamic_update_slice(
@@ -944,8 +1029,7 @@ class PagedBatchingEngine(BatchingEngine):
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
-        first = sample_batched(key, last[None], *samp)[0]
-        first_lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
+        first, first_lp = self._sample_first(key, last, samp)
 
         bs = self.block_size
         table_row = jax.lax.dynamic_slice_in_dim(cache.tables, slot, 1, 0)[0]

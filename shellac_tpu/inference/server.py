@@ -11,6 +11,8 @@ API:
                    "stop": [[7,8], "..."]?,
                    "temperature"/"top_k"/"top_p"/"min_p": per-request
                    sampling overrides (engine defaults otherwise),
+                   "min_tokens": ban EOS until N tokens are emitted,
+                   "logit_bias": {token id: additive bias},
                    "logprobs": true? (needs an engine built with
                    logprobs=True / serve --logprobs),
                    "n"/"best_of": parallel sampling — best_of
@@ -304,11 +306,21 @@ class InferenceServer:
                 for k in ("temperature", "top_p", "min_p")
                 if payload.get(k) is not None
             }
-            if payload.get("top_k") is not None:
-                v = float(payload["top_k"])
-                if not v.is_integer():
-                    raise ValueError(f"top_k must be an integer, got {v}")
-                samp["top_k"] = int(v)
+            for key in ("top_k", "min_tokens"):
+                if payload.get(key) is not None:
+                    v = float(payload[key])
+                    if not v.is_integer():
+                        raise ValueError(
+                            f"{key} must be an integer, got {v}"
+                        )
+                    samp[key] = int(v)
+            if payload.get("logit_bias") is not None:
+                lb = payload["logit_bias"]
+                if not isinstance(lb, dict):
+                    raise ValueError(
+                        "logit_bias must be a {token id: bias} object"
+                    )
+                samp["logit_bias"] = lb  # entries validated by submit
         except (TypeError, ValueError) as e:
             raise ValueError(f"bad sampling parameters: {e}")
         return tokens, max_new, stop, samp

@@ -88,12 +88,13 @@ class SpeculativeBatchingEngine(BatchingEngine):
     # ---- admission ---------------------------------------------------
 
     def submit(self, rid, tokens, max_new: int, stop=None, *,
-               temperature=None, top_k=None, top_p=None,
-               min_p=None) -> None:
-        if top_k is not None or top_p is not None or min_p is not None:
+               temperature=None, top_k=None, top_p=None, min_p=None,
+               min_tokens=None, logit_bias=None) -> None:
+        if any(v is not None for v in
+               (top_k, top_p, min_p, min_tokens, logit_bias)):
             raise ValueError(
                 f"request {rid!r}: speculative decoding supports "
-                "temperature only (top_k/top_p/min_p filtering breaks "
+                "temperature only (distribution filtering/biasing breaks "
                 "the rejection-sampling identity)"
             )
         size = np.asarray(tokens, np.int32).reshape(-1).size

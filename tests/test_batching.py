@@ -108,6 +108,53 @@ class TestContinuousBatching:
         with pytest.raises(ValueError, match="top_k"):
             srv.submit("x", np.array([1], np.int32), 2, top_k=0)
 
+    def test_min_tokens_suppresses_eos(self, setup):
+        """EOS is banned from sampling until min_tokens are emitted;
+        without the ban the same request stops early."""
+        cfg, params = setup
+        prompt = np.array([1, 2, 3], np.int32)
+        full = _ref_generate(cfg, params, prompt, 12)
+        eos = full[3]  # greedy emits this as token 4
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64, eos_id=eos)
+        assert srv.run([("early", prompt, 12)])["early"] == full[:4]
+        srv.submit("late", prompt, 12, min_tokens=8)
+        results = {}
+        while srv.pending:
+            results.update(srv.step())
+        out = results["late"]
+        # The first 8 tokens can never be EOS; generation may still end
+        # later (budget or a genuine post-ban EOS).
+        assert len(out) >= 8
+        assert all(t != eos for t in out[:8])
+
+    def test_min_tokens_needs_eos_id(self, setup):
+        cfg, params = setup
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        with pytest.raises(ValueError, match="eos_id"):
+            srv.submit("x", np.array([1], np.int32), 4, min_tokens=2)
+
+    def test_logit_bias_forces_and_bans_tokens(self, setup):
+        """A huge positive bias forces a token; a huge negative bias on
+        the greedy choice bans it."""
+        cfg, params = setup
+        prompt = np.array([4, 5, 6], np.int32)
+        base = _ref_generate(cfg, params, prompt, 4)
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        srv.submit("forced", prompt, 4, logit_bias={42: 1e9})
+        srv.submit("banned", prompt, 1, logit_bias={base[0]: -1e9})
+        results = {}
+        while srv.pending:
+            results.update(srv.step())
+        assert results["forced"] == [42, 42, 42, 42]
+        assert results["banned"][0] != base[0]
+
+    def test_logit_bias_out_of_vocab_rejected(self, setup):
+        cfg, params = setup
+        srv = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        with pytest.raises(ValueError, match="vocab"):
+            srv.submit("x", np.array([1], np.int32), 2,
+                       logit_bias={cfg.vocab_size: 1.0})
+
     def test_cancel_frees_slot_and_queue(self, setup):
         """cancel() drops in-flight work (slot reusable at once) and
         queued work; surviving requests stay exact."""

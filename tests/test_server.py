@@ -92,6 +92,19 @@ class TestHTTPServer:
             _post(base, {"max_new": 4})
         assert ei.value.code == 400
 
+    def test_logit_bias_and_min_tokens_payload(self, http_srv):
+        base, _, _ = http_srv
+        out = _post(base, {"tokens": [1, 2], "max_new": 3,
+                           "logit_bias": {"7": 1e9}})
+        assert out["tokens"] == [7, 7, 7]
+        # min_tokens without a server eos_id is a 400, not a crash.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {"tokens": [1], "max_new": 4, "min_tokens": 2})
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(base, {"tokens": [1], "max_new": 2, "logit_bias": [1, 2]})
+        assert ei.value.code == 400
+
     def test_per_request_sampling(self, http_srv):
         """Payload sampling overrides: explicit greedy matches the
         default-greedy server; bad values are a 400."""
