@@ -149,7 +149,11 @@ class BatchingEngine:
         self.finished_logprobs: Dict[Any, List[float]] = {}
         # Per-slot additive logit biases and remaining min_tokens (EOS
         # ban countdown, decremented on device inside the decode scan).
-        self._sbias = jnp.zeros((n_slots, cfg.vocab_size), jnp.float32)
+        # The (n_slots, vocab) bias matrix is allocated lazily on the
+        # first biased request — most deployments never pay for it; the
+        # shared zero row keeps prefill jit signatures stable.
+        self._sbias: Optional[jax.Array] = None
+        self._zero_bias_row = jnp.zeros((1, cfg.vocab_size), jnp.float32)
         self._slot_bias: List[Optional[Dict[int, float]]] = [None] * n_slots
         self._smin = jnp.zeros((n_slots,), jnp.int32)
         # Engine-level sampling defaults; submit() can override any of
@@ -372,7 +376,14 @@ class BatchingEngine:
         """Hook before prefilling `req` into `slot` (paged: alloc blocks)."""
 
     def _release_slot(self, slot: int) -> None:
-        """Hook after a request leaves `slot` (paged: free its blocks)."""
+        """Hook after a request leaves `slot` (paged: free its blocks
+        via super()). Clears the slot's logit bias so the engine drops
+        back to the cheap no-bias decode variant — zeroing the row too,
+        or a later unbiased request on this slot would silently inherit
+        the stale biases."""
+        if self._slot_bias[slot] is not None:
+            self._sbias = self._sbias.at[slot].set(0.0)
+            self._slot_bias[slot] = None
 
     def _bias_row(self, req: _Request) -> np.ndarray:
         row = np.zeros((self.cfg.vocab_size,), np.float32)
@@ -385,7 +396,8 @@ class BatchingEngine:
         jit: (temperature, top_k, top_p, min_p, logit bias row,
         remaining min_tokens). The bias row is a device slice of the
         matrix _set_slot_sampling already wrote (None = no bias)."""
-        bias = self._sbias[slot][None] if req.logit_bias else None
+        bias = (self._sbias[slot][None] if req.logit_bias
+                else self._zero_bias_row)
         return (
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_k], jnp.int32),
@@ -406,6 +418,10 @@ class BatchingEngine:
         if new_bias != self._slot_bias[slot]:
             # O(n_slots x vocab) device copy — only when this slot's
             # bias actually changes (never on the bias-free path).
+            if self._sbias is None:
+                self._sbias = jnp.zeros(
+                    (self.n_slots, self.cfg.vocab_size), jnp.float32
+                )
             self._sbias = self._sbias.at[slot].set(
                 jnp.asarray(self._bias_row(req))
             )
@@ -652,9 +668,12 @@ class BatchingEngine:
         self._cache, toks, lps, self._smin = self._decode(
             self.params, self._cache, self._cur, active, sub,
             (self._stemp, self._stopk, self._stopp, self._sminp,
-             self._sbias, self._smin),
+             self._sbias if self._sbias is not None
+             else self._zero_bias_row, self._smin),
             greedy_only=greedy_only,
-            use_bias=any(b is not None for b in self._slot_bias),
+            use_bias=self._sbias is not None and any(
+                b is not None for b in self._slot_bias
+            ),
         )
         self._cur = toks[-1]
         # (K, n_slots) each — the one host sync.
@@ -891,6 +910,7 @@ class PagedBatchingEngine(BatchingEngine):
         super()._finish_prefill(slot, req, first, lp)
 
     def _release_slot(self, slot: int) -> None:
+        super()._release_slot(slot)  # clears the slot's logit bias
         self._pending_reg.pop(slot, None)
         if self.prefix_cache:
             for blk in self._slot_blocks[slot]:
