@@ -57,6 +57,31 @@ def is_grouped_layers(layers) -> bool:
     return set(layers.keys()) == {"dense", "moe"}
 
 
+def _add_aux(a, b):
+    return jax.tree.map(lambda u, v: u + v, a, b)
+
+
+def _grouped_scan(blk_d, blk_m, x, aux0, glp_stack):
+    """Scan an interleaved layout: per group, (every-1) dense blocks
+    then one MoE block, accumulating aux. Shared by the plain forward
+    and each pipeline stage (blk_* close over their RoPE/segment
+    bindings)."""
+    def group_body(carry, glp):
+        x, acc = carry
+
+        def dense_body(c2, lp):
+            x2, acc2 = c2
+            x2, _, mo = blk_d(x2, lp)
+            return (x2, _add_aux(acc2, mo)), None
+
+        (x, acc), _ = jax.lax.scan(dense_body, (x, acc), glp["dense"])
+        x, _, mo = blk_m(x, glp["moe"])
+        return (x, _add_aux(acc, mo)), None
+
+    (x, acc), _ = jax.lax.scan(group_body, (x, aux0), glp_stack)
+    return x, acc
+
+
 def map_layer_stacks(layers, fn):
     """Apply `fn(stack, name)` to each per-layer stack of a layers tree.
 
@@ -545,36 +570,48 @@ def forward(
         from shellac_tpu.parallel.pipeline import pipeline_apply
 
         if grouped_moe(cfg):
-            raise NotImplementedError(
-                "pipeline parallelism over interleaved dense/MoE stacks "
-                "(moe_every > 1) is not supported yet; use fsdp/tp/sp "
-                "axes, or moe_every=1"
-            )
-        if cfg.n_layers % pp:
-            raise ValueError(
-                f"n_layers={cfg.n_layers} not divisible by pp={pp}"
-            )
-        lps = cfg.n_layers // pp
+            # Interleaved stacks pipeline at GROUP granularity: each
+            # stage holds whole (dense^(every-1), moe) super-blocks, so
+            # stage compute stays uniform and the group axis shards
+            # over pp exactly like the layer axis does for flat stacks.
+            ng = cfg.n_layers // cfg.moe_every
+            if ng % pp:
+                raise ValueError(
+                    f"n_layers/moe_every = {ng} groups not divisible "
+                    f"by pp={pp}"
+                )
+            per_stage = ng // pp
+        else:
+            if cfg.n_layers % pp:
+                raise ValueError(
+                    f"n_layers={cfg.n_layers} not divisible by pp={pp}"
+                )
+            per_stage = cfg.n_layers // pp
         stage_params = jax.tree.map(
-            lambda p: p.reshape(pp, lps, *p.shape[1:]), params["layers"]
+            lambda p: p.reshape(pp, per_stage, *p.shape[1:]),
+            params["layers"],
         )
 
         aux0 = _zero_aux()
 
         # The block partial above binds the whole-batch segment row;
         # microbatches see a slice of the batch, so the pipeline needs
-        # an unbound block whose RoPE tables / segment ids ride WITH
+        # unbound blocks whose RoPE tables / segment ids ride WITH
         # each microbatch through the stage shift register.
-        def pp_block_raw(x, lp, cos_m, sin_m, seg_m):
-            return _block(
-                cfg, mesh, attn_impl, x, lp, cos_m, sin_m, segments=seg_m
-            )
+        def make_pp_block(moe_flag):
+            def raw(x, lp, cos_m, sin_m, seg_m):
+                return _block(
+                    cfg, mesh, attn_impl, x, lp, cos_m, sin_m,
+                    segments=seg_m, moe_layer=moe_flag,
+                )
 
-        pp_block = (
-            jax.checkpoint(pp_block_raw, policy=_remat_policy(cfg.remat_policy))
-            if cfg.remat
-            else pp_block_raw
-        )
+            if cfg.remat:
+                return jax.checkpoint(
+                    raw, policy=_remat_policy(cfg.remat_policy)
+                )
+            return raw
+
+
 
         ragged = positions is not None or segment_ids is not None
         if ragged:
@@ -595,15 +632,31 @@ def forward(
             # every microbatch — cheaper than shifting per-row tables.
             cos, sin = cos[:1], sin[:1]
 
-        def run_stack(sp_lp, x, cos_m, sin_m, seg_m):
-            def body(carry, lp):
-                x, acc = carry
-                x, _, moe_out = pp_block(x, lp, cos_m, sin_m, seg_m)
-                acc = jax.tree.map(lambda a, b: a + b, acc, moe_out)
-                return (x, acc), None
+        if grouped_moe(cfg):
+            pp_blk_d = make_pp_block(False)
+            pp_blk_m = make_pp_block(True)
 
-            (x, acc), _ = jax.lax.scan(body, (x, aux0), sp_lp)
-            return x, acc
+            def run_stack(sp_glp, x, cos_m, sin_m, seg_m):
+                # sp_glp: this stage's groups — {"dense": (Gs, every-1,
+                # ...), "moe": (Gs, ...)}.
+                def blk_d(x, lp):
+                    return pp_blk_d(x, lp, cos_m, sin_m, seg_m)
+
+                def blk_m(x, lp):
+                    return pp_blk_m(x, lp, cos_m, sin_m, seg_m)
+
+                return _grouped_scan(blk_d, blk_m, x, aux0, sp_glp)
+        else:
+            pp_block = make_pp_block(None)
+
+            def run_stack(sp_lp, x, cos_m, sin_m, seg_m):
+                def body(carry, lp):
+                    x, acc = carry
+                    x, _, moe_out = pp_block(x, lp, cos_m, sin_m, seg_m)
+                    return (x, _add_aux(acc, moe_out)), None
+
+                (x, acc), _ = jax.lax.scan(body, (x, aux0), sp_lp)
+                return x, acc
 
         if ragged:
             def stage_fn(sp_lp, x, ex):
@@ -626,7 +679,12 @@ def forward(
         # token population — the standard grad-accum estimator);
         # diagnostics additionally average over layers.
         inv_m = 1.0 / n_micro
-        inv_lm = inv_m / cfg.n_layers
+        # Diagnostics average over the layers that actually have
+        # routers: every layer for uniform MoE, one per group for
+        # interleaved stacks.
+        routers = (cfg.n_layers // cfg.moe_every if grouped_moe(cfg)
+                   else cfg.n_layers)
+        inv_lm = inv_m / routers
         aux = {
             "aux": aux_sum["aux"] * inv_m,
             "balance_loss": aux_sum["balance_loss"] * inv_lm,
@@ -635,22 +693,12 @@ def forward(
         }
     elif grouped_moe(cfg):
         aux0 = _zero_aux()
-        blk_d, blk_m = make_block(False), make_block(True)
-        add = lambda a, b: jax.tree.map(lambda u, v: u + v, a, b)
-
-        def group_body(carry, glp):
-            x, acc = carry
-
-            def dense_body(c2, lp):
-                x2, acc2 = c2
-                x2, _, mo = blk_d(x2, lp, cos, sin)
-                return (x2, add(acc2, mo)), None
-
-            (x, acc), _ = jax.lax.scan(dense_body, (x, acc), glp["dense"])
-            x, _, mo = blk_m(x, glp["moe"], cos, sin)
-            return (x, add(acc, mo)), None
-
-        (x, aux_acc), _ = jax.lax.scan(group_body, (x, aux0), params["layers"])
+        bd, bm = make_block(False), make_block(True)
+        x, aux_acc = _grouped_scan(
+            lambda x, lp: bd(x, lp, cos, sin),
+            lambda x, lp: bm(x, lp, cos, sin),
+            x, aux0, params["layers"],
+        )
         # Aux loss sums over MoE layers; diagnostics average over the
         # layers that actually have routers (one per group).
         inv_l = cfg.moe_every / cfg.n_layers

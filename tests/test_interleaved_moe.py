@@ -133,10 +133,14 @@ class TestInterleavedSharded:
             float(md["loss"]), float(ms["loss"]), rtol=2e-4
         )
 
-    def test_pp_raises_clearly(self):
+    def test_pp_runs(self):
+        """pp over interleaved stacks is supported (group-granular
+        stages; parity tested in test_pipeline.py)."""
         cfg = _cfg()
         mesh = make_mesh(ParallelConfig(pp=2, tp=2, sp=2))
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
         tokens = jnp.zeros((4, 16), jnp.int32)
-        with pytest.raises(NotImplementedError, match="moe_every"):
-            transformer.forward(cfg, params, tokens, mesh=mesh)
+        logits = jax.jit(
+            lambda p, t: transformer.forward(cfg, p, t, mesh=mesh)
+        )(params, tokens)
+        assert np.isfinite(np.asarray(logits)).all()

@@ -161,6 +161,75 @@ class TestPipelineMoE:
         assert np.isfinite(float(metrics["loss"]))
 
 
+class TestPipelineInterleaved:
+    """pp over interleaved dense/MoE stacks: the pipeline unit is a
+    whole (dense^(every-1), moe) group, sharded over pp."""
+
+    @pytest.fixture(scope="class")
+    def mesh_pp2(self):
+        return make_mesh(ParallelConfig(dp=2, pp=2, tp=2))
+
+    def _icfg(self, **kw):
+        from shellac_tpu.config import MoEConfig
+
+        # dropless: capacity dropping is population-dependent, so a
+        # microbatched pipeline would legitimately diverge from the
+        # full-batch reference (same reason TestPipelineMoE uses it).
+        return get_model_config("tiny-moe-interleaved").replace(
+            dtype="float32",
+            moe=MoEConfig(num_experts=4, num_experts_per_token=2,
+                          dropless=True),
+            **kw,
+        )
+
+    def test_forward_and_aux_match_dense(self, mesh_pp2):
+        cfg = self._icfg()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size
+        )
+        dense, aux_d = transformer.forward(
+            cfg, params, tokens, return_aux=True
+        )
+        piped, aux_p = jax.jit(
+            lambda p, t: transformer.forward(
+                cfg, p, t, mesh=mesh_pp2, return_aux=True
+            )
+        )(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(piped), rtol=1e-4, atol=1e-4
+        )
+        for k in ("aux", "balance_loss", "router_z_loss"):
+            b = float(aux_p[k])
+            assert np.isfinite(b) and b > 0.0, k
+            np.testing.assert_allclose(float(aux_d[k]), b, rtol=0.5)
+
+    def test_training_step(self, mesh_pp2):
+        cfg = self._icfg(remat=True)
+        tcfg = TrainConfig(warmup_steps=1, total_steps=10)
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0),
+                                 mesh=mesh_pp2)
+        step = make_train_step(cfg, tcfg, mesh=mesh_pp2)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size
+        )
+        bs = batch_shardings(mesh_pp2)
+        batch = {
+            "inputs": jax.device_put(tokens, bs),
+            "targets": jax.device_put(tokens, bs),
+        }
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_indivisible_groups_raises(self):
+        mesh = make_mesh(ParallelConfig(pp=4, dp=2))
+        cfg = self._icfg()  # 2 groups, pp=4
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((8, 16), jnp.int32)
+        with pytest.raises(ValueError, match="groups not divisible"):
+            transformer.forward(cfg, params, tokens, mesh=mesh)
+
+
 class TestPipelinePacked:
     """pp composes with packed segments and custom positions: the RoPE
     tables and segment ids ride the stage shift register per
