@@ -50,6 +50,7 @@ run engine_dense 580 python scripts/bench_decode.py \
   --variants dense:auto,dense:ref --decode-ticks 8
 run engine_paged 580 python scripts/bench_decode.py \
   --variants paged:auto,paged:ref --decode-ticks 8
+run engine_prefix 580 python scripts/bench_decode.py --mode prefix
 
 # 4. Training bench variants (headline recipe + packed + quant + fused).
 run train_plain 580 python bench.py
