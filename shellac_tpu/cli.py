@@ -162,6 +162,8 @@ def cmd_train(args):
         if latest is not None:
             skip = int(latest)
     data = _data_iter(args, cfg, args.batch, args.seq, skip=skip)
+    if args.lora_rank is not None:
+        return _train_lora(args, cfg, tcfg, mesh, data)
     state = fit(
         cfg, tcfg, data,
         mesh=mesh,
@@ -176,11 +178,150 @@ def cmd_train(args):
     return 0
 
 
+def _train_lora(args, cfg, tcfg, mesh, data):
+    """train --lora-rank: adapter-only fine-tuning over a frozen base.
+
+    Base weights come from --base-ckpt (a regular train checkpoint) or
+    a seeded random init; --ckpt-dir holds ONLY the (tiny) adapter
+    state plus a lora_config.json that eval/generate --lora-dir read
+    back, so the adapter checkpoint is self-describing.
+    """
+    import os
+
+    import jax
+
+    from shellac_tpu.training.loop import fit_lora
+    from shellac_tpu.training.lora import LoRAConfig
+
+    for knob in ("grad_accum", "quant", "ema_decay"):
+        if getattr(args, knob, None):
+            raise SystemExit(
+                f"--lora-rank does not support --{knob.replace('_', '-')} "
+                "(the adapter train step has no accumulation/quant/EMA)"
+            )
+    lcfg = LoRAConfig(
+        rank=args.lora_rank,
+        alpha=args.lora_alpha,
+        targets=tuple(t.strip() for t in args.lora_targets.split(",")),
+    ).validate(cfg)
+    base_params = _restore_base_params(args, cfg, mesh)
+    if args.ckpt_dir:
+        os.makedirs(args.ckpt_dir, exist_ok=True)
+        meta = {
+            "rank": lcfg.rank,
+            "alpha": lcfg.alpha,
+            "targets": list(lcfg.targets),
+            "optimizer": tcfg.optimizer,
+            "mu_dtype": tcfg.mu_dtype,
+        }
+        meta_path = os.path.join(args.ckpt_dir, "lora_config.json")
+        if os.path.exists(meta_path):
+            # Resuming: the flags must match the checkpoint — silently
+            # rewriting the metadata would brick a valid adapter dir
+            # the moment the restore failed on structure mismatch.
+            with open(meta_path) as f:
+                saved = json.load(f)
+            if saved != meta:
+                raise SystemExit(
+                    f"--ckpt-dir {args.ckpt_dir} holds adapters trained "
+                    f"with {saved}; current flags give {meta}. Match the "
+                    "original --lora-* / --optimizer flags or use a "
+                    "fresh --ckpt-dir."
+                )
+        else:
+            with open(meta_path, "w") as f:
+                json.dump(meta, f)
+    state = fit_lora(
+        cfg, tcfg, lcfg, base_params, data,
+        mesh=mesh,
+        checkpoint_dir=args.ckpt_dir,
+        checkpoint_every=args.ckpt_every,
+        log_path=args.log_path,
+        log_every=args.log_every,
+    )
+    print(json.dumps({
+        "final_step": int(jax.device_get(state.step)),
+        "lora_rank": lcfg.rank,
+        "adapter_params": int(sum(
+            x.size for x in jax.tree.leaves(state.lora)
+        )),
+    }))
+    return 0
+
+
+def _restore_base_params(args, cfg, mesh):
+    """Frozen base weights for adapter training: sharded restore when a
+    mesh is given (materializing a large base unsharded would OOM), a
+    seeded random init otherwise."""
+    import jax
+
+    from shellac_tpu.models import transformer
+
+    if not args.base_ckpt:
+        params = transformer.init_params(cfg, jax.random.PRNGKey(args.seed))
+        if mesh is not None:
+            from shellac_tpu.parallel.sharding import shard_pytree
+
+            params = shard_pytree(
+                params, mesh, transformer.logical_axes(cfg)
+            )
+        return params
+    if mesh is None:
+        return _restore_params(
+            argparse.Namespace(ckpt_dir=args.base_ckpt, ema=False,
+                               seed=args.seed), cfg,
+        )
+    from shellac_tpu.config import TrainConfig
+    from shellac_tpu.training.checkpoint import Checkpointer
+    from shellac_tpu.training.trainer import init_train_state
+
+    abstract = jax.eval_shape(
+        lambda: init_train_state(cfg, TrainConfig(), jax.random.PRNGKey(0))
+    )
+    state = Checkpointer(args.base_ckpt).restore(
+        abstract_state=abstract, mesh=mesh, model_cfg=cfg
+    )
+    return state.params
+
+
+def _apply_lora(args, cfg, params):
+    """Merge adapters from --lora-dir (written by train --lora-rank)
+    into base params; no-op without the flag."""
+    if not getattr(args, "lora_dir", None):
+        return params
+    import os
+
+    import jax
+
+    from shellac_tpu.config import TrainConfig
+    from shellac_tpu.training.checkpoint import Checkpointer
+    from shellac_tpu.training.lora import (
+        LoRAConfig,
+        init_lora_state,
+        merge_lora,
+    )
+
+    with open(os.path.join(args.lora_dir, "lora_config.json")) as f:
+        d = json.load(f)
+    lcfg = LoRAConfig(rank=d["rank"], alpha=d["alpha"],
+                      targets=tuple(d["targets"]))
+    # Only optimizer/mu_dtype shape the state structure for restore.
+    tcfg = TrainConfig(optimizer=d["optimizer"], mu_dtype=d["mu_dtype"])
+    abstract = jax.eval_shape(
+        lambda: init_lora_state(cfg, tcfg, lcfg, jax.random.PRNGKey(0))
+    )
+    state = Checkpointer(args.lora_dir).restore(abstract_state=abstract)
+    # Adapters trained on a mesh restore with their saved sharding;
+    # the eager merge below must not mix committed placements with the
+    # host-restored base, so pull the (tiny) adapters to host first.
+    return merge_lora(params, jax.device_get(state.lora), lcfg)
+
+
 def cmd_eval(args):
     from shellac_tpu.training.evaluate import evaluate
 
     cfg = _model_config(args)
-    params = _restore_params(args, cfg)
+    params = _apply_lora(args, cfg, _restore_params(args, cfg))
     data = _data_iter(args, cfg, args.batch, args.seq,
                       num_batches=args.batches)
     out = evaluate(cfg, params, data, max_batches=args.batches)
@@ -216,6 +357,7 @@ def cmd_generate(args):
     else:
         cfg = _model_config(args)
         params = _restore_params(args, cfg)
+    params = _apply_lora(args, cfg, params)
     tok = None
     if args.text is not None:
         from shellac_tpu.training.tokenizer import get_tokenizer
@@ -325,7 +467,7 @@ def cmd_serve(args):
     if args.draft_model and args.prefill_chunk is not None:
         raise SystemExit("--draft-model does not support --prefill-chunk")
     cfg = _model_config(args)
-    params = _restore_params(args, cfg)
+    params = _apply_lora(args, cfg, _restore_params(args, cfg))
     if args.quantize:
         from shellac_tpu.ops.quant import quantize_params
 
@@ -458,6 +600,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="quantized training compute (int8 MXU dots)")
     t.add_argument("--ema-decay", type=float, default=None, dest="ema_decay",
                    help="keep an EMA of the weights (e.g. 0.999)")
+    t.add_argument("--lora-rank", type=int, default=None, dest="lora_rank",
+                   help="LoRA fine-tuning: adapter rank (enables adapter-"
+                        "only training; --ckpt-dir then stores adapters)")
+    t.add_argument("--lora-alpha", type=float, default=16.0,
+                   dest="lora_alpha")
+    t.add_argument("--lora-targets", default="wq,wk,wv,wo",
+                   dest="lora_targets",
+                   help="comma list of wq,wk,wv,wo,w_gate,w_up,w_down")
+    t.add_argument("--base-ckpt", default=None, dest="base_ckpt",
+                   help="frozen base weights for --lora-rank (a regular "
+                        "train checkpoint dir; default: random init)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("eval", help="perplexity of a checkpoint")
@@ -469,6 +622,8 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--batches", type=int, default=16)
     e.add_argument("--data", nargs="*", default=None)
     e.add_argument("--ckpt-dir")
+    e.add_argument("--lora-dir", default=None, dest="lora_dir",
+                   help="merge adapters from a train --lora-rank dir")
     e.set_defaults(fn=cmd_eval)
 
     g = sub.add_parser("generate", help="sample tokens")
@@ -496,6 +651,8 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--draft-model", default=None,
                    help="draft preset for speculative decoding")
     g.add_argument("--gamma", type=int, default=4)
+    g.add_argument("--lora-dir", default=None, dest="lora_dir",
+                   help="merge adapters from a train --lora-rank dir")
     g.set_defaults(fn=cmd_generate)
 
     s = sub.add_parser("serve", help="HTTP server with continuous batching")
@@ -532,6 +689,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(one chunk per step) so a long prompt cannot "
                         "stall active decodes")
     s.add_argument("--ckpt-dir")
+    s.add_argument("--lora-dir", default=None, dest="lora_dir",
+                   help="merge adapters from a train --lora-rank dir")
     s.add_argument("--quantize", action="store_true")
     s.add_argument("--tokenizer", default="byte")
     s.set_defaults(fn=cmd_serve)

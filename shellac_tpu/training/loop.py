@@ -134,3 +134,74 @@ def fit(
         signal.signal(signal.SIGTERM, old_handler)
     logger.close()
     return state
+
+
+def fit_lora(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    lora_cfg,
+    base_params,
+    data_iter: Iterator[dict],
+    *,
+    mesh=None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 500,
+    log_path: Optional[str] = None,
+    log_every: int = 10,
+    resume: bool = True,
+):
+    """Adapter-only fine-tuning: train a LoRAState over frozen
+    base_params until train_cfg.total_steps; returns the final
+    LoRAState.
+
+    Checkpoints hold ONLY the adapters and their optimizer state (rank-r
+    small), so saves are near-free and the base checkpoint is never
+    rewritten. Resume restores from checkpoint_dir like fit(); the
+    divergence-restore and preemption machinery is deliberately omitted
+    — LoRA runs are short and rerunnable.
+    """
+    from shellac_tpu.training.lora import init_lora_state, make_lora_train_step
+
+    ckpt = None
+    if checkpoint_dir is not None:
+        from shellac_tpu.training.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir)
+
+    key = jax.random.PRNGKey(train_cfg.seed)
+    if ckpt is not None and resume and ckpt.latest_step() is not None:
+        abstract = jax.eval_shape(
+            lambda: init_lora_state(
+                model_cfg, train_cfg, lora_cfg, key, mesh=mesh
+            )
+        )
+        state = ckpt.restore(abstract_state=abstract)
+    else:
+        state = init_lora_state(model_cfg, train_cfg, lora_cfg, key, mesh=mesh)
+
+    step_fn = make_lora_train_step(model_cfg, train_cfg, lora_cfg, mesh=mesh)
+    logger = MetricsLogger(log_path, every=1)
+    timer = StepTimer()
+
+    step = int(jax.device_get(state.step))
+    while step < train_cfg.total_steps:
+        try:
+            batch = next(data_iter)
+        except StopIteration:
+            break
+        state, metrics = step_fn(state, base_params, batch)
+        step += 1
+        if step % log_every == 0 or step >= train_cfg.total_steps:
+            host_metrics = {k: jax.device_get(v) for k, v in metrics.items()}
+            dt = timer.tick()
+            if dt is not None:
+                host_metrics["steps_per_sec"] = log_every / dt
+            logger.log(step, host_metrics)
+        if ckpt is not None and step % checkpoint_every == 0:
+            ckpt.save(step, state)
+
+    if ckpt is not None:
+        ckpt.save(int(jax.device_get(state.step)), state, force=True,
+                  wait=True)
+    logger.close()
+    return state

@@ -218,7 +218,9 @@ def make_lora_train_step(
         merged = merge_lora(base_params, lora, lora_cfg)
         logits, aux = transformer.forward(
             model_cfg, merged, batch["inputs"], mesh=mesh,
-            attn_impl=attn_impl, return_aux=True,
+            attn_impl=attn_impl,
+            segment_ids=batch.get("segment_ids"),  # packed-data contract
+            return_aux=True,
         )
         loss, metrics = cross_entropy(
             logits, batch["targets"], batch.get("mask"),

@@ -92,6 +92,89 @@ class TestCLI:
         ])
         assert len(gen["tokens"]) == 8
 
+    def test_lora_finetune_roundtrip(self, tmp_path, capsys):
+        """train --lora-rank over a frozen base, then eval/generate
+        --lora-dir merge the adapters; adapters must actually help."""
+        corpus = (np.arange(1 << 14) % 97).astype(np.int32)
+        shard = tmp_path / "shard0.bin"
+        write_token_shard(str(shard), corpus)
+        base = tmp_path / "base"
+        lora = tmp_path / "lora"
+
+        # A briefly-trained base the adapters will specialize.
+        _run(capsys, [
+            "train", "--model", "tiny", "--steps", "10",
+            "--batch", "4", "--seq", "64",
+            "--data", str(shard), "--ckpt-dir", str(base),
+            "--learning-rate", "3e-3",
+        ])
+        base_ev = _run(capsys, [
+            "eval", "--model", "tiny", "--ckpt-dir", str(base),
+            "--data", str(shard), "--batches", "4",
+            "--batch", "4", "--seq", "64",
+        ])
+
+        out = _run(capsys, [
+            "train", "--model", "tiny", "--steps", "40",
+            "--batch", "4", "--seq", "64",
+            "--data", str(shard),
+            "--lora-rank", "4", "--lora-targets", "wq,wv,w_down",
+            "--base-ckpt", str(base), "--ckpt-dir", str(lora),
+            "--learning-rate", "1e-2",
+        ])
+        assert out["final_step"] == 40
+        assert out["adapter_params"] > 0
+
+        ev = _run(capsys, [
+            "eval", "--model", "tiny", "--ckpt-dir", str(base),
+            "--lora-dir", str(lora),
+            "--data", str(shard), "--batches", "4",
+            "--batch", "4", "--seq", "64",
+        ])
+        assert ev["loss"] < base_ev["loss"]
+
+        gen = _run(capsys, [
+            "generate", "--model", "tiny", "--ckpt-dir", str(base),
+            "--lora-dir", str(lora),
+            "--prompt", "1,2,3,4,5", "--max-new", "8",
+            "--temperature", "0",
+        ])
+        assert len(gen["tokens"]) == 8
+
+        # Adapters trained on a MESH must merge into a host-restored
+        # base (sharded-save -> unsharded-merge crossed placements
+        # before being pulled to host).
+        lora_mesh = tmp_path / "lora_mesh"
+        _run(capsys, [
+            "train", "--model", "tiny", "--steps", "10",
+            "--batch", "8", "--seq", "64", "--data", str(shard),
+            "--lora-rank", "4", "--mesh", "fsdp=4,tp=2",
+            "--base-ckpt", str(base), "--ckpt-dir", str(lora_mesh),
+            "--learning-rate", "1e-2",
+        ])
+        gen = _run(capsys, [
+            "generate", "--model", "tiny", "--ckpt-dir", str(base),
+            "--lora-dir", str(lora_mesh),
+            "--prompt", "1,2,3", "--max-new", "4", "--temperature", "0",
+        ])
+        assert len(gen["tokens"]) == 4
+
+        # Resuming with mismatched flags must refuse rather than
+        # clobber the adapter dir's metadata.
+        with pytest.raises(SystemExit, match="adapters trained with"):
+            main([
+                "train", "--model", "tiny", "--steps", "50",
+                "--batch", "4", "--seq", "64", "--data", str(shard),
+                "--lora-rank", "4", "--base-ckpt", str(base),
+                "--ckpt-dir", str(lora),  # default targets != original
+            ])
+        # And unsupported knobs are rejected loudly.
+        with pytest.raises(SystemExit, match="grad-accum"):
+            main([
+                "train", "--model", "tiny", "--steps", "5",
+                "--lora-rank", "4", "--grad-accum", "4",
+            ])
+
     def test_generate_quantized(self, capsys):
         gen = _run(capsys, [
             "generate", "--model", "tiny", "--prompt", "1,2,3",
