@@ -54,7 +54,7 @@ def main(argv=None):
     # `python bench.py`, which keeps the headline recipe unchanged.
     ap.add_argument("--packed", action="store_true",
                     help="packed-sequence batch (segment_ids set)")
-    ap.add_argument("--quant", choices=["int8"], default=None)
+    ap.add_argument("--quant", choices=["int8", "int8_bwd"], default=None)
     ap.add_argument("--fused-loss", type=int, default=None,
                     dest="fused_loss", metavar="CHUNK",
                     help="vocab-chunked fused cross-entropy")

@@ -596,8 +596,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--weight-decay", type=float, dest="weight_decay")
     t.add_argument("--grad-accum", type=int, dest="grad_accum")
     t.add_argument("--optimizer", choices=["adamw", "lion", "adafactor"])
-    t.add_argument("--quant", choices=["int8"], default=None,
-                   help="quantized training compute (int8 MXU dots)")
+    t.add_argument("--quant", choices=["int8", "int8_bwd"], default=None,
+                   help="quantized training compute (int8 MXU dots; "
+                        "int8_bwd quantizes the backward matmuls too)")
     t.add_argument("--ema-decay", type=float, default=None, dest="ema_decay",
                    help="keep an EMA of the weights (e.g. 0.999)")
     t.add_argument("--lora-rank", type=int, default=None, dest="lora_rank",

@@ -131,9 +131,10 @@ class ModelConfig:
             )
         if self.moe is not None and self.moe_every < 1:
             raise ValueError("moe_every must be >= 1")
-        if self.quant_training not in (None, "int8"):
+        if self.quant_training not in (None, "int8", "int8_bwd"):
             raise ValueError(
-                f"quant_training={self.quant_training!r}; have None, 'int8'"
+                f"quant_training={self.quant_training!r}; "
+                "have None, 'int8', 'int8_bwd'"
             )
         return self
 
@@ -195,8 +196,9 @@ class TrainConfig:
     z_loss_weight: float = 0.0
     # Skip the whole param/opt update when any gradient is non-finite.
     skip_nonfinite_updates: bool = True
-    # Quantized training compute: None (bf16) or "int8" (dense
-    # projections as int8 MXU dots, fwd only; fp32 master params).
+    # Quantized training compute: None (bf16), "int8" (dense projections
+    # as int8 MXU dots, fwd only), or "int8_bwd" (backward matmuls too);
+    # fp32 master params either way. See ops/qtrain.py.
     quant: Optional[str] = None
     # Vocab-chunked fused cross-entropy: the (B, S, V) fp32 logits —
     # the train step's largest residual — never materialize. Set to a

@@ -15,6 +15,22 @@ TPU MXUs run int8 x int8 -> int32 at twice the bf16 rate (v5e: ~394 vs
             forward-only is the standard first rung (the public AQT
             recipe) and keeps the loss-parity budget tight.
 
+`int8_dot_full` is the second rung ("int8_bwd"): both backward matmuls
+also run on the int8 MXU path. dx = g @ W^T contracts over the feature
+axis, so both operands keep per-row/per-channel scales along the
+contraction — the benign case. dW = x^T @ g contracts over the *batch*
+axis: both operands get one scale per output channel computed over the
+whole batch, so a single outlier token saturates its channel's scale
+and the per-element rounding errors sum over the N contraction terms.
+That is where gradient-quantization error concentrates, and this
+deterministic scheme does NOT mitigate it (the standard mitigation,
+stochastic rounding, needs an RNG threaded into the backward pass —
+deliberately not done here). The int32 accumulation itself is exact;
+all error comes from the two quantization roundings.
+The tiny-model parity test bounds the end-to-end effect; real runs
+should treat "int8_bwd" the way the AQT recipe does: fine for
+pretraining throughput experiments, validate loss before committing.
+
 Master parameters stay fp32 (the optimizer never sees int8); this is a
 *compute* quantization, re-derived from the live weights every step, so
 it composes with FSDP sharding, remat, and LoRA without checkpoint
@@ -84,12 +100,63 @@ def _int8_dot_bwd(res, g):
 int8_dot.defvjp(_int8_dot_fwd, _int8_dot_bwd)
 
 
+def _int8_contract(a, b, a_axis, b_axis, out_shape):
+    """int8 a x b contracting (a_axis, b_axis), per-slice dequant scales.
+
+    Each operand is quantized with one scale per slice along its
+    contraction axis, so the int32 accumulator is exact and the scale
+    product factors out of the sum.
+    """
+    qa, sa = _quantize_rows(a, axis=a_axis)
+    qb, sb = _quantize_rows(b, axis=b_axis)
+    acc = jax.lax.dot_general(
+        qa, qb, (((a_axis,), (b_axis,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    # dot_general output is (a's free axes, b's free axes); both scales
+    # are keepdims over the contraction so squeeze to the free axis.
+    sa_free = jnp.squeeze(sa, axis=a_axis).reshape(-1, 1)
+    sb_free = jnp.squeeze(sb, axis=b_axis).reshape(1, -1)
+    return (acc.astype(jnp.float32) * sa_free * sb_free).reshape(out_shape)
+
+
+@jax.custom_vjp
+def int8_dot_full(x: jax.Array, w: jax.Array) -> jax.Array:
+    """x (..., D) @ w (D, F): int8 forward AND int8 backward matmuls."""
+    return _int8_dot_fwd_impl(x, w)
+
+
+def _int8_full_fwd(x, w):
+    return _int8_dot_fwd_impl(x, w), (x, w)
+
+
+def _int8_full_bwd(res, g):
+    x, w = res
+    *lead, d = x.shape
+    f = w.shape[1]
+    gf = g.reshape(-1, f).astype(jnp.float32)
+    xf = x.reshape(-1, d).astype(jnp.float32)
+    wf = w.astype(jnp.float32)
+    # dx[n, d] = sum_f g[n, f] w[d, f]: g per-row, w per-d-row (one
+    # scale per input channel, amax over the F contraction axis).
+    dx = _int8_contract(gf, wf, 1, 1, (len(gf), d))
+    # dW[d, f] = sum_n x[n, d] g[n, f]: both per-channel over the batch.
+    dw = _int8_contract(xf, gf, 0, 0, (d, f))
+    return dx.reshape(x.shape).astype(x.dtype), dw.astype(w.dtype)
+
+
+int8_dot_full.defvjp(_int8_full_fwd, _int8_full_bwd)
+
+
 def quant_dot(x: jax.Array, w: jax.Array, quant_training) -> jax.Array:
     """The transformer's dense-projection dot: quantized when asked."""
     if quant_training == "int8":
         return int8_dot(x, w)
+    if quant_training == "int8_bwd":
+        return int8_dot_full(x, w)
     if quant_training is not None:
         raise ValueError(
-            f"unknown quant_training {quant_training!r}; have 'int8'"
+            f"unknown quant_training {quant_training!r}; "
+            "have 'int8', 'int8_bwd'"
         )
     return x @ w

@@ -71,7 +71,7 @@ def make_train_step(
     scanned sequentially, accumulating grads in fp32.
     """
     if train_cfg.quant is not None:
-        # Opt into quantized compute for this step's forward only; the
+        # Opt into quantized compute for this train step only; the
         # model config itself (and any checkpoint metadata derived from
         # it) stays unquantized.
         model_cfg = model_cfg.replace(quant_training=train_cfg.quant).validate()

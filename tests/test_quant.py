@@ -175,7 +175,7 @@ class TestQuantizedTraining:
             assert float(err) < 0.05, float(err)
 
     def test_loss_parity_vs_bf16(self):
-        """Short tiny-model run: int8 loss curve tracks bf16 closely."""
+        """Short tiny-model run: int8 loss curves track bf16 closely."""
         from shellac_tpu import get_model_config
         from shellac_tpu.config import TrainConfig
         from shellac_tpu.training import init_train_state, make_train_step
@@ -187,7 +187,7 @@ class TestQuantizedTraining:
         )
         batch = {"inputs": tokens, "targets": tokens}
         losses = {}
-        for quant in (None, "int8"):
+        for quant in (None, "int8", "int8_bwd"):
             tcfg = TrainConfig(
                 learning_rate=1e-3, warmup_steps=2, total_steps=30,
                 quant=quant,
@@ -198,6 +198,31 @@ class TestQuantizedTraining:
                 state, m = step(state, batch)
             losses[quant] = float(m["loss"])
         assert losses["int8"] == pytest.approx(losses[None], rel=0.05), losses
+        # Quantized backward adds gradient rounding noise on top; the
+        # curve still has to land in the same neighbourhood.
+        assert losses["int8_bwd"] == pytest.approx(
+            losses[None], rel=0.10
+        ), losses
+
+    def test_int8_full_grads_close_to_exact(self, rng):
+        """int8_dot_full: both backward matmuls quantized, small error."""
+        from shellac_tpu.ops.qtrain import int8_dot_full
+
+        x = jnp.asarray(rng.normal(size=(4, 12, 64)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(64, 96)), jnp.float32)
+        got = int8_dot_full(x, w)
+        want = x @ w
+        err = jnp.linalg.norm(got - want) / jnp.linalg.norm(want)
+        assert float(err) < 0.02, float(err)
+
+        def loss(f):
+            return lambda x, w: (f(x, w) ** 2).sum()
+
+        g1 = jax.grad(loss(int8_dot_full), (0, 1))(x, w)
+        g2 = jax.grad(loss(jnp.matmul), (0, 1))(x, w)
+        for a, b in zip(g1, g2):
+            e = jnp.linalg.norm(a - b) / jnp.linalg.norm(b)
+            assert float(e) < 0.06, float(e)
 
     def test_params_stay_fp32(self):
         from shellac_tpu import get_model_config
