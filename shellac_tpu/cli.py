@@ -466,12 +466,45 @@ def cmd_serve(args):
                          "per step; --decode-ticks must stay 1")
     if args.draft_model and args.prefill_chunk is not None:
         raise SystemExit("--draft-model does not support --prefill-chunk")
+
+    from shellac_tpu.parallel.distributed import initialize
+
+    multihost = initialize()  # joins the cluster iff the env asks
+    if multihost and not args.mesh:
+        raise SystemExit(
+            "multi-host serve needs an explicit --mesh (e.g. tp=8) "
+            "multiplying out to the GLOBAL device count"
+        )
+    if args.draft_model and (args.mesh or multihost):
+        raise SystemExit("--draft-model serving is single-device; drop "
+                         "--mesh / the distributed environment")
     cfg = _model_config(args)
     params = _apply_lora(args, cfg, _restore_params(args, cfg))
     if args.quantize:
         from shellac_tpu.ops.quant import quantize_params
 
         params = quantize_params(cfg, params)
+    mesh = None
+    if args.mesh:
+        from shellac_tpu.inference.engine import shard_params
+        from shellac_tpu.parallel.distributed import global_mesh
+
+        pcfg = _parallel_config(args.mesh)
+        if pcfg.pp > 1 or pcfg.sp > 1:
+            raise SystemExit(
+                "serve --mesh supports tp (and single-host dp/fsdp) "
+                "only; pipeline/sequence axes are training-side"
+            )
+        if multihost and (pcfg.dp > 1 or pcfg.fsdp > 1):
+            # dp/fsdp shard the KV cache's slot axis; across hosts that
+            # puts decode outputs on non-addressable devices and breaks
+            # the engine's replicated-host-state contract.
+            raise SystemExit(
+                "multi-host serve shards with tp only (e.g. --mesh "
+                "tp=8); dp/fsdp would split the slot batch across hosts"
+            )
+        mesh = global_mesh(pcfg)
+        params = shard_params(cfg, params, mesh)
     engine = None
     if args.draft_model:
         import jax
@@ -491,19 +524,34 @@ def cmd_serve(args):
             seed=args.seed, logprobs=args.logprobs,
             max_prefills_per_step=args.max_prefills_per_step,
         )
-    if args.paged:
-        from shellac_tpu.inference.batching import PagedBatchingEngine
+    if args.paged or (engine is None and mesh is not None):
+        from shellac_tpu.inference.batching import (
+            BatchingEngine,
+            PagedBatchingEngine,
+        )
 
-        engine = PagedBatchingEngine(
+        kind = PagedBatchingEngine if args.paged else BatchingEngine
+        extra = ({"prefix_cache": args.prefix_cache} if args.paged else {})
+        engine = kind(
             cfg, params, n_slots=args.slots,
             max_len=args.max_len or cfg.max_seq_len,
             temperature=args.temperature, eos_id=args.eos_id,
             decode_ticks=args.decode_ticks,
             max_prefills_per_step=args.max_prefills_per_step,
-            prefix_cache=args.prefix_cache,
             prefill_chunk=args.prefill_chunk,
             logprobs=args.logprobs,
+            mesh=mesh,
+            **extra,
         )
+    if multihost:
+        from shellac_tpu.inference.multihost import MultihostEngine
+
+        engine = MultihostEngine(engine)
+        if not engine.is_primary:
+            # Followers never open a port: they mirror the primary's
+            # command stream until it broadcasts shutdown.
+            engine.serve_forever()
+            return 0
     serve(
         cfg, params,
         host=args.host, port=args.port,
@@ -666,6 +714,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--eos-id", type=int, default=None, dest="eos_id")
     s.add_argument("--paged", action="store_true",
                    help="paged (block-pool) KV cache")
+    s.add_argument("--mesh", default="",
+                   help="serve sharded, e.g. tp=4 (multi-host: multiply "
+                        "out to the global device count and set the "
+                        "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/"
+                        "JAX_PROCESS_ID env on every process)")
     s.add_argument("--prefix-cache", action="store_true", dest="prefix_cache",
                    help="reuse cached KV blocks across prompts sharing a "
                         "prefix (requires --paged)")

@@ -40,9 +40,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from shellac_tpu.config import ModelConfig
-from shellac_tpu.inference.kvcache import KVCache, init_cache, init_paged_cache
+from shellac_tpu.inference.kvcache import (
+    KVCache,
+    PagedKVCache,
+    cache_logical_axes,
+    init_cache,
+    init_paged_cache,
+    paged_cache_logical_axes,
+)
 from shellac_tpu.models import transformer
 from shellac_tpu.ops.sampling import NEG_INF, sample_batched
+from shellac_tpu.parallel.sharding import make_shardings
 
 
 @dataclass
@@ -94,6 +102,10 @@ def _bucket(n: int, lo: int = 16) -> int:
 class BatchingEngine:
     """Fixed-slot continuous batching over one model."""
 
+    # Subclasses that replace self._cache after this ctor set this True
+    # so mesh sharding is pinned once, on the final cache pytree.
+    _swaps_cache = False
+
     def __init__(
         self,
         cfg: ModelConfig,
@@ -112,6 +124,7 @@ class BatchingEngine:
         max_prefills_per_step: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
         logprobs: bool = False,
+        mesh=None,
     ):
         if decode_ticks < 1:
             raise ValueError(f"decode_ticks must be >= 1, got {decode_ticks}")
@@ -125,6 +138,12 @@ class BatchingEngine:
         self.max_len = max_len or cfg.max_seq_len
         self.eos_id = eos_id
         self.attn_impl = attn_impl
+        # With a mesh the engine runs sharded, same contract as the
+        # single-request Engine: params already placed (shard_params),
+        # KV cache sharded over kv_heads, slot batch replicated (the
+        # scheduler owns it). Shardings are pinned at the jit
+        # boundaries so GSPMD keeps one layout across every program.
+        self.mesh = mesh
         self.decode_ticks = decode_ticks
         # Cap prefills per engine step: a burst of queued prompts would
         # otherwise run n_slots sequential prefill programs before the
@@ -183,12 +202,19 @@ class BatchingEngine:
         self._queue: deque[_Request] = deque()
         self._slots: List[Optional[_Request]] = [None] * n_slots
         self._prefill_jit: Dict[int, Any] = {}  # bucketed by padded S
-        # Two decode variants (one trace each): greedy_only skips the
-        # batched sampler's full-vocab sorts when every active request
-        # is greedy — the common serving default.
-        self._decode = jax.jit(
-            self._decode_impl, static_argnames=("greedy_only", "use_bias"),
-        )
+        # The decode jit is built lazily (first _decode_tokens): with a
+        # mesh its out_shardings pin the cache layout, and the paged
+        # subclass swaps in its own cache (different pytree) after this
+        # constructor runs. Two decode variants (one trace each):
+        # greedy_only skips the batched sampler's full-vocab sorts when
+        # every active request is greedy — the common serving default.
+        self._decode = None
+        if not self._swaps_cache:
+            # Subclasses that replace self._cache (paged) pin shardings
+            # themselves AFTER the swap; device_putting the dense cache
+            # here would burn a transient multi-GiB HBM allocation on a
+            # tree about to be discarded.
+            self._mesh_setup()
         # Serving observability (read by the HTTP /stats endpoint).
         # Written only by the engine-owning thread; plain ints so
         # cross-thread reads are merely possibly-stale, never torn.
@@ -200,6 +226,35 @@ class BatchingEngine:
             "prefill_chunks": 0,
         }
 
+    # ---- sharding ----------------------------------------------------
+
+    def _mesh_setup(self) -> None:
+        """Pin the (dense or paged) cache's shardings on the mesh.
+
+        Called once self._cache holds its final pytree — at the end of
+        this class's constructor and again by the paged subclass after
+        it swaps the cache. Re-called, it just recomputes the sharding
+        tree and invalidates the lazily-built decode jit.
+        """
+        if self.mesh is None:
+            self._cache_sh = None
+            return
+        axes = (
+            paged_cache_logical_axes()
+            if isinstance(self._cache, PagedKVCache)
+            else cache_logical_axes()
+        )
+        self._cache_sh = make_shardings(self.mesh, axes)
+        self._cache = jax.device_put(self._cache, self._cache_sh)
+        self._decode = None
+
+    def _jit_cache_program(self, fn, n_tail: int, **jit_kw):
+        """jit a program returning (cache, <n_tail others>), pinning the
+        cache's shardings on the mesh (no-op unsharded)."""
+        if self._cache_sh is not None:
+            jit_kw["out_shardings"] = (self._cache_sh,) + (None,) * n_tail
+        return jax.jit(fn, **jit_kw)
+
     # ---- jitted programs --------------------------------------------
 
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
@@ -208,7 +263,7 @@ class BatchingEngine:
         mini = init_cache(self.cfg, 1, self.max_len)
         logits, mini = transformer.forward_with_cache(
             self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
-            fresh_cache=True, attn_impl=self.attn_impl,
+            fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
         )
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
@@ -250,7 +305,7 @@ class BatchingEngine:
             old_lengths = cache.lengths
             logits, cache = transformer.forward_with_cache(
                 self.cfg, params, cur[:, None], cache,
-                attn_impl=self.attn_impl,
+                attn_impl=self.attn_impl, mesh=self.mesh,
             )
             adj = self._adjust_logits(logits[:, 0], bias, min_rem)
             if greedy_only:
@@ -437,8 +492,8 @@ class BatchingEngine:
         # range — loudly for dense, silently-clamped for paged.
         pad = min(_bucket(s), self.max_len)
         if pad not in self._prefill_jit:
-            self._prefill_jit[pad] = jax.jit(
-                self._prefill_impl, static_argnums=()
+            self._prefill_jit[pad] = self._jit_cache_program(
+                self._prefill_impl, 2
             )
         padded = np.zeros((1, pad), np.int32)
         padded[0, :s] = req.tokens
@@ -527,8 +582,8 @@ class BatchingEngine:
                        key, samp):
         """Dispatch one (bucketed, jitted) chunk-continuation program."""
         if (pad, fresh) not in self._chunk_jit:
-            self._chunk_jit[(pad, fresh)] = jax.jit(
-                functools.partial(self._chunk_prefill_impl, fresh=fresh)
+            self._chunk_jit[(pad, fresh)] = self._jit_cache_program(
+                functools.partial(self._chunk_prefill_impl, fresh=fresh), 2
             )
         return self._chunk_jit[(pad, fresh)](
             self.params, self._cache, tokens, chunk_len, offset, slot, key,
@@ -551,7 +606,7 @@ class BatchingEngine:
         logits, view = transformer.forward_with_cache(
             self.cfg, params, tokens, view, new_tokens_len=chunk_len,
             fresh_cache=fresh,
-            attn_impl=self.attn_impl if fresh else "ref",
+            attn_impl=self.attn_impl if fresh else "ref", mesh=self.mesh,
         )
         last = jnp.take_along_axis(
             logits, (chunk_len - 1)[:, None, None].astype(jnp.int32), axis=1
@@ -660,6 +715,11 @@ class BatchingEngine:
         """Advance every active slot; returns (tokens_per_slot,
         logprobs_per_slot or None) in one host sync. Overridden by the
         speculative engine."""
+        if self._decode is None:
+            self._decode = self._jit_cache_program(
+                self._decode_impl, 3,
+                static_argnames=("greedy_only", "use_bias"),
+            )
         active = jnp.asarray(active_rows)
         self._key, sub = jax.random.split(self._key)
         greedy_only = all(
@@ -744,6 +804,8 @@ class PagedBatchingEngine(BatchingEngine):
     computed, which also yields the last-token logits sampling needs).
     """
 
+    _swaps_cache = True  # shardings pin on the paged pool, not the dense cache
+
     def __init__(
         self,
         cfg: ModelConfig,
@@ -766,6 +828,7 @@ class PagedBatchingEngine(BatchingEngine):
         self._cache = init_paged_cache(
             cfg, n_slots, n_blocks, block_size, max_blocks_per_slot
         )
+        self._mesh_setup()  # re-pin shardings for the paged pytree
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))  # 0 = scratch
         self._slot_blocks: List[List[int]] = [[] for _ in range(n_slots)]
         # Prefix cache state (all host-side; empty when disabled):
@@ -966,7 +1029,9 @@ class PagedBatchingEngine(BatchingEngine):
         """Paged chunks reuse the continuation program (a chunk is a
         'suffix' past `offset` resident tokens; offset 0 included)."""
         if pad not in self._prefix_prefill_jit:
-            self._prefix_prefill_jit[pad] = jax.jit(self._prefix_prefill_impl)
+            self._prefix_prefill_jit[pad] = self._jit_cache_program(
+                self._prefix_prefill_impl, 2
+            )
         return self._prefix_prefill_jit[pad](
             self.params, self._cache, tokens, chunk_len, offset, slot, key,
             samp,
@@ -987,7 +1052,9 @@ class PagedBatchingEngine(BatchingEngine):
         # so the cap never cuts real tokens).
         pad = min(_bucket(s), self.max_len - p)
         if pad not in self._prefix_prefill_jit:
-            self._prefix_prefill_jit[pad] = jax.jit(self._prefix_prefill_impl)
+            self._prefix_prefill_jit[pad] = self._jit_cache_program(
+                self._prefix_prefill_impl, 2
+            )
         padded = np.zeros((1, pad), np.int32)
         padded[0, :s] = suffix
         self._key, sub = jax.random.split(self._key)
@@ -1014,8 +1081,6 @@ class PagedBatchingEngine(BatchingEngine):
         kernel targets s<=8 steady-state decode and would only fall
         back (warning) on a prefill-sized s.
         """
-        from shellac_tpu.inference.kvcache import PagedKVCache
-
         row = jax.lax.dynamic_slice_in_dim(cache.tables, slot, 1, 0)
         view = PagedKVCache(
             k=cache.k, v=cache.v, tables=row,
@@ -1023,7 +1088,7 @@ class PagedBatchingEngine(BatchingEngine):
         )
         logits, view = transformer.forward_with_cache(
             self.cfg, params, tokens, view, new_tokens_len=suffix_len,
-            fresh_cache=False, attn_impl="ref",
+            fresh_cache=False, attn_impl="ref", mesh=self.mesh,
         )
         last = jnp.take_along_axis(
             logits, (suffix_len - 1)[:, None, None].astype(jnp.int32), axis=1
@@ -1044,7 +1109,7 @@ class PagedBatchingEngine(BatchingEngine):
         mini = init_cache(self.cfg, 1, s)
         logits, mini = transformer.forward_with_cache(
             self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
-            fresh_cache=True, attn_impl=self.attn_impl,
+            fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
         )
         last = jnp.take_along_axis(
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1

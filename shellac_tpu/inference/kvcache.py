@@ -59,6 +59,22 @@ def cache_logical_axes():
     )
 
 
+def paged_cache_logical_axes():
+    """Logical axes for sharding a paged cache over a mesh.
+
+    The KV pools shard over kv_heads (tensor parallelism), same as the
+    dense cache; the block axis is scheduler-addressed (host-side free
+    list picks arbitrary block ids) so it stays unsharded, and the
+    tables/lengths are tiny scheduler metadata, replicated.
+    """
+    return PagedKVCache(
+        k=("layers", None, "kv_heads", None, None),
+        v=("layers", None, "kv_heads", None, None),
+        tables=(None, None),
+        lengths=(None,),
+    )
+
+
 def update_layer(
     cache_k: jax.Array,  # (B, Hkv, max_len, Dh) — one layer's cache
     cache_v: jax.Array,

@@ -1,0 +1,191 @@
+"""Multi-host (multi-process) serving: one engine, SPMD across hosts.
+
+On a TPU pod each host owns a slice of the devices; a program that
+touches a globally-sharded array must run the SAME jitted computations
+in the SAME order on every host, or the runtime deadlocks. A serving
+engine is host-driven — admissions, slot scheduling, stop checks — so
+the host decisions themselves must be replicated, not just the math.
+
+This wrapper makes the engine's host side deterministic-by-broadcast:
+
+  - every process builds the same engine over the same global mesh
+    (same config, same sharded params, same seed);
+  - process 0 is the PRIMARY: it owns the public submit/cancel surface
+    and buffers them as commands;
+  - each step() first broadcasts the buffered command list (device
+    collective via multihost_utils — it rides the same interconnect as
+    the model, no side channel to configure), then every process
+    applies the commands to its local engine replica and runs
+    engine.step() in lockstep.
+
+Everything downstream is already deterministic given the command
+stream: prompt hashes, the paged free list, jax PRNG keys from the
+shared seed, and the decoded tokens (each process device_gets the same
+replicated values). So the engines stay bit-identical without any
+further synchronization — proven by the two-process test, which runs
+real cross-process collectives on the CPU backend
+(tests/test_multihost_serving.py).
+
+Follower processes never see requests; they sit in serve_forever(),
+which steps until the primary broadcasts shutdown. The primary's
+typical loop is the HTTP server's scheduler thread, with submissions
+flowing through this wrapper instead of straight into the engine.
+
+The reference repo for this project is empty (SURVEY.md §0); there is
+no upstream multi-host serving stack to cite.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+_STOP = "stop"
+
+
+class MultihostEngine:
+    """Lockstep driver for a BatchingEngine replicated across processes.
+
+    Single-process jobs degenerate cleanly: broadcasts are identity and
+    the wrapper is a thin pass-through, so the same serving code runs
+    on one host or many.
+    """
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.process_index = jax.process_index()
+        self.is_primary = self.process_index == 0
+        # Tells the HTTP server's scheduler to step every loop even
+        # when idle, so followers are never parked in a broadcast
+        # longer than the transport tolerates.
+        self.needs_heartbeat = jax.process_count() > 1
+        self._pending: List[Tuple[str, tuple, dict]] = []
+        self._stopped = False
+
+    # ---- primary-side surface (mirrors BatchingEngine) ---------------
+
+    def submit(self, rid, tokens, max_new: int, **kw) -> None:
+        """Queue a request (primary only; followers get it by broadcast).
+
+        Arguments are validated HERE, on the primary, by a dry
+        validation pass against the local engine, so a bad request
+        raises at submit time instead of poisoning every process's
+        command stream mid-step.
+        """
+        self._require_primary("submit")
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        self.engine.submit(rid, tokens, max_new, **kw)
+        # The local submit doubles as validation AND the primary's own
+        # application of the command; followers replay it at step().
+        self._pending.append(("submit", (rid, tokens.tolist(), max_new), kw))
+
+    def cancel(self, rid) -> bool:
+        self._require_primary("cancel")
+        hit = self.engine.cancel(rid)
+        if hit:
+            self._pending.append(("cancel", (rid,), {}))
+        return hit
+
+    def shutdown(self) -> None:
+        """Release the followers (their serve_forever returns); the
+        primary's own engine is left as-is. Idempotent: a second call
+        must not broadcast at followers that already exited."""
+        self._require_primary("shutdown")
+        if self._stopped:
+            return
+        self._pending.append((_STOP, (), {}))
+        self._exchange()
+        self._stopped = True
+
+    @property
+    def pending(self) -> int:
+        return self.engine.pending
+
+    @property
+    def stats(self):
+        return self.engine.stats
+
+    def __getattr__(self, name):
+        # Read-only pass-through for the surfaces the HTTP server
+        # inspects on a bare engine (n_slots, logprobs,
+        # finished_logprobs, _slots, _defaults, ...). Only fires for
+        # names not defined on the wrapper itself.
+        return getattr(self.engine, name)
+
+    def _require_primary(self, what: str) -> None:
+        if not self.is_primary:
+            raise RuntimeError(
+                f"{what}() is primary-only (process 0); this is process "
+                f"{self.process_index} — followers call serve_forever()"
+            )
+
+    # ---- lockstep step ----------------------------------------------
+
+    def step(self) -> Optional[List[Tuple[Any, List[int]]]]:
+        """Broadcast buffered commands, apply, advance every engine one
+        step. Returns finished requests, or None once shut down."""
+        if self._stopped:
+            return None
+        for op, args, kw in self._exchange():
+            if op == _STOP:
+                self._stopped = True
+                return None
+            if self.is_primary:
+                continue  # already applied at submit/cancel time
+            if op == "submit":
+                rid, tokens, max_new = args
+                self.engine.submit(rid, tokens, max_new, **kw)
+            elif op == "cancel":
+                self.engine.cancel(*args)
+        return self.engine.step()
+
+    def serve_forever(self) -> None:
+        """Follower loop: step in lockstep until the primary shuts down."""
+        while self.step() is not None:
+            pass
+
+    def run(self, requests=None):
+        """Drain helper, same contract as BatchingEngine.run. On the
+        primary, submits and steps to empty then shuts the job down;
+        followers must be in serve_forever()."""
+        self._require_primary("run")
+        for r in requests or ():
+            self.submit(*r)
+        results = {}
+        while self.pending:
+            for rid, out in self.step():
+                results[rid] = out
+        self.shutdown()
+        return results
+
+    # ---- transport ---------------------------------------------------
+
+    def _exchange(self) -> List[Tuple[str, tuple, dict]]:
+        """Ship the primary's command buffer to every process.
+
+        Two broadcasts: a fixed-shape length, then the pickled payload
+        (skipped when empty — the overwhelmingly common decode tick).
+        multihost_utils routes these through a jitted device collective,
+        so no extra transport needs to exist or be configured.
+        """
+        from jax.experimental import multihost_utils as mhu
+
+        if jax.process_count() == 1:
+            cmds, self._pending = self._pending, []
+            return cmds
+        payload = (pickle.dumps(self._pending)
+                   if self.is_primary and self._pending else b"")
+        self._pending = []
+        size = int(mhu.broadcast_one_to_all(
+            np.asarray([len(payload)], np.int32)
+        )[0])
+        if size == 0:
+            return []
+        buf = np.zeros((size,), np.uint8)
+        if self.is_primary:
+            buf[:] = np.frombuffer(payload, np.uint8)
+        buf = np.asarray(mhu.broadcast_one_to_all(buf))
+        return pickle.loads(buf.tobytes())

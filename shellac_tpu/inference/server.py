@@ -87,6 +87,11 @@ class InferenceServer:
         **engine_kw,
     ):
         self.engine = engine or BatchingEngine(cfg, params, **engine_kw)
+        # Multi-host engines need a step per loop iteration even when
+        # idle: follower processes wait inside the command broadcast,
+        # and an un-stepped primary would leave them parked in a device
+        # collective until its transport times out.
+        self._heartbeat = bool(getattr(self.engine, "needs_heartbeat", False))
         self.tokenizer = tokenizer
         self._submit_q: queue.Queue = queue.Queue()
         self._pending: Dict[int, _Pending] = {}
@@ -151,8 +156,8 @@ class InferenceServer:
                     break
                 drained = True
                 self._process_item(item)
-            if self.engine.pending:
-                finished = self.engine.step()
+            if self.engine.pending or self._heartbeat:
+                finished = self.engine.step() or []
                 fin = {rid for rid, _ in finished}
                 # Stream deltas for requests still in flight. holdback
                 # trails the tail by the longest stop length, so a
@@ -179,6 +184,10 @@ class InferenceServer:
                         p.finish()
                     else:
                         lp_store.pop(rid, None)
+                if self._heartbeat and not drained and not self.engine.pending:
+                    # Idle heartbeat tick: pace the broadcast instead of
+                    # spinning the interconnect at full rate.
+                    self._stop.wait(0.01)
             elif not drained:
                 # Idle: block briefly on the queue instead of spinning.
                 # Process in place — re-enqueueing could reorder a
@@ -451,6 +460,20 @@ class InferenceServer:
     def close(self):
         self._stop.set()
         self._thread.join(timeout=2)
+        if getattr(self.engine, "is_primary", False):
+            # Multi-host: the followers must be released with a STOP
+            # broadcast, and only after the scheduler thread (the
+            # broadcast's other participant on this process) has truly
+            # exited — two threads must not broadcast at once, and a
+            # slow step can easily outlive the 2s fast path above. Only
+            # a thread wedged WELL beyond a step (dead transport) may
+            # leave shutdown unsent; at that point the followers'
+            # collectives are failing on their own.
+            deadline = time.monotonic() + 300
+            while self._thread.is_alive() and time.monotonic() < deadline:
+                self._thread.join(timeout=5)
+            if not self._thread.is_alive():
+                self.engine.shutdown()
 
 
 def make_http_server(server: InferenceServer, host: str = "127.0.0.1",

@@ -72,6 +72,13 @@ class SpeculativeBatchingEngine(BatchingEngine):
                 "speculative batching does not support chunked prefill "
                 "(the draft cache prefills whole prompts)"
             )
+        if kw.get("mesh") is not None:
+            raise NotImplementedError(
+                "speculative batching is single-device for now: the "
+                "draft/verify programs do not thread the mesh; use "
+                "BatchingEngine/PagedBatchingEngine(mesh=...) for "
+                "sharded serving"
+            )
         super().__init__(cfg, params, **kw)
         self.draft_cfg = draft_cfg
         self.draft_params = draft_params

@@ -75,6 +75,64 @@ class TestShardedEngine:
         assert out.tokens.shape == (4, 8)
         assert np.isfinite(np.asarray(out.logprobs)).all()
 
+    def test_sharded_batching_engine_bit_matches(self, mesh_tp):
+        """tp-sharded continuous batching == unsharded engine, with slot
+        churn (more requests than slots)."""
+        from shellac_tpu.inference.batching import BatchingEngine
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(1, cfg.vocab_size, size=n).tolist()
+                   for n in (3, 7, 5, 9, 4, 6)]
+
+        ref_eng = BatchingEngine(cfg, params, n_slots=2, max_len=64)
+        want = ref_eng.run([(i, p, 8) for i, p in enumerate(prompts)])
+
+        sharded = shard_params(cfg, params, mesh_tp)
+        eng = BatchingEngine(cfg, sharded, n_slots=2, max_len=64,
+                             mesh=mesh_tp)
+        got = eng.run([(i, p, 8) for i, p in enumerate(prompts)])
+        assert got == want
+
+    def test_sharded_paged_engine_bit_matches(self, mesh_tp):
+        """tp-sharded paged serving (with prefix cache) == unsharded."""
+        from shellac_tpu.inference.batching import (
+            BatchingEngine,
+            PagedBatchingEngine,
+        )
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(4)
+        common = rng.integers(1, cfg.vocab_size, size=20).tolist()
+        prompts = [common + rng.integers(1, cfg.vocab_size, size=4).tolist()
+                   for _ in range(4)]
+
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64).run(
+            [(i, p, 6) for i, p in enumerate(prompts)]
+        )
+        sharded = shard_params(cfg, params, mesh_tp)
+        eng = PagedBatchingEngine(
+            cfg, sharded, n_slots=2, max_len=64, prefix_cache=True,
+            mesh=mesh_tp,
+        )
+        got = eng.run([(i, p, 6) for i, p in enumerate(prompts)])
+        assert got == want
+        assert eng.stats["prefix_hit_tokens"] > 0
+
+    def test_speculative_engine_rejects_mesh(self, mesh_tp):
+        from shellac_tpu.inference.spec_batching import (
+            SpeculativeBatchingEngine,
+        )
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(NotImplementedError, match="single-device"):
+            SpeculativeBatchingEngine(
+                cfg, params, cfg, params, mesh=mesh_tp
+            )
+
     def test_ragged_prompts_sharded(self, mesh_tp):
         cfg = _tiny()
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
