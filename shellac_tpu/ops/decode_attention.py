@@ -140,6 +140,101 @@ def _decode_tile(
         o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
+def _decode_tile_values(
+    idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+    *, scale, s, hkv, block_k, window, k_start, ki, last_ki, first_ki,
+):
+    """_decode_tile for head dims whose lane count is not 128-aligned.
+
+    Mosaic rejects ANY memref_slice on a ref whose last dim is not a
+    multiple of the 128-lane tiling ("Slice shape along dimension 2
+    must be aligned to tiling (128), but is 64" — found compiling the
+    dh=64 parity case; interpret mode does not catch it). So this
+    variant takes the RAW (1, ...) refs, reads each one whole (full
+    loads of padded refs are legal), slices VALUES per kv head, and
+    stores whole refs back. Same math as _decode_tile to the last op.
+    """
+    live = (ki >= first_ki) & (k_start <= idx + s - 1)
+    rows = q_ref.shape[1]
+    rph = rows // hkv
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(live)
+    def _compute():
+        r = jax.lax.broadcasted_iota(jnp.int32, (rph, block_k), 0)
+        qpos = idx + r % s
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (rph, block_k), 1
+        )
+        mask = kpos <= qpos
+        if window is not None:
+            mask &= qpos - kpos < window
+
+        qall = q_ref[...][0].astype(jnp.float32) * scale  # (rows, d)
+        kall = k_ref[...][0]  # (hkv, block_k, d)
+        vall = v_ref[...][0]
+        acc_all = acc_ref[...]
+        m_all = m_ref[...]
+        l_all = l_ref[...]
+        lanes = m_all.shape[1]
+        accs, ms, ls = [], [], []
+        for kh in range(hkv):
+            lo, hi = kh * rph, (kh + 1) * rph
+            q = jax.lax.slice_in_dim(qall, lo, hi, axis=0)
+            k = jax.lax.slice_in_dim(kall, kh, kh + 1, axis=0)[0]
+            logits = jax.lax.dot_general(
+                q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            logits = jnp.where(mask, logits, NEG_INF)
+
+            m_prev = jax.lax.slice(m_all, (lo, 0), (hi, 1))
+            l_prev = jax.lax.slice(l_all, (lo, 0), (hi, 1))
+            m_cur = jnp.max(logits, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(logits - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            v = jax.lax.slice_in_dim(vall, kh, kh + 1, axis=0)[0]
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc_prev = jax.lax.slice_in_dim(acc_all, lo, hi, axis=0)
+            accs.append(acc_prev * alpha + pv)
+            ms.append(jnp.broadcast_to(m_new, (rph, lanes)))
+            ls.append(jnp.broadcast_to(l_new, (rph, lanes)))
+        acc_ref[...] = jnp.concatenate(accs, axis=0)
+        m_ref[...] = jnp.concatenate(ms, axis=0)
+        l_ref[...] = jnp.concatenate(ls, axis=0)
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        l = jax.lax.slice(l_ref[...], (0, 0), (rows, 1))
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = ((acc_ref[...] / l).astype(o_ref.dtype))[None]
+
+
+def _decode_tile_any(
+    idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, **kw
+):
+    """Dispatch on head-dim lane alignment (see _decode_tile_values)."""
+    if q_ref.shape[-1] % 128 == 0:
+        _decode_tile(
+            idx, q_ref.at[0], k_ref.at[0], v_ref.at[0], o_ref.at[0],
+            acc_ref, m_ref, l_ref, **kw,
+        )
+    else:
+        _decode_tile_values(
+            idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, **kw
+        )
+
+
 def _live_range(idx, s, block_k, window, num_kv):
     """(first_ki, last_ki) of kv blocks any q row can attend."""
     last_ki = jnp.minimum((idx + s - 1) // block_k, num_kv - 1)
@@ -174,9 +269,8 @@ def _dense_kernel(
     ki = pl.program_id(1)
     idx = idx_ref[b]
     first_ki, last_ki = _live_range(idx, s, block_k, window, num_kv)
-    _decode_tile(
-        idx, q_ref.at[0], k_ref.at[0], v_ref.at[0],
-        o_ref.at[0], acc_ref, m_ref, l_ref,
+    _decode_tile_any(
+        idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
     )
@@ -326,9 +420,8 @@ def _paged_kernel(
     ki = pl.program_id(1)
     idx = len_ref[b]
     first_ki, last_ki = _live_range(idx, s, block_k, window, num_kv)
-    _decode_tile(
-        idx, q_ref.at[0], k_ref.at[0], v_ref.at[0],
-        o_ref.at[0], acc_ref, m_ref, l_ref,
+    _decode_tile_any(
+        idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
     )

@@ -89,9 +89,9 @@ def flash_supported(
         # reference path defines them.
         return False
     if d % 64 != 0:
-        # Blocks span the full head_dim, so Mosaic accepts any d equal
-        # to the array dim; d % 64 keeps the VPU lane padding bounded
-        # (dh=64 models pay ~2x lane waste but still beat ref O(S^2)).
+        # Head dims below a 128-lane tile are zero-padded up to one at
+        # the flash_attention entry (Mosaic rejects block selects on
+        # unaligned lane dims); d % 64 bounds that lane waste at ~2x.
         return False
     if _fit_block(sq, block_q) == 0 or _fit_block(sk, block_k) == 0:
         return False
@@ -621,11 +621,24 @@ def flash_attention(
     (B, S) int32 packed document ids shared by q and kv; attention is
     block-diagonal over them.
     """
+    d = q.shape[-1]
     if scale is None:
-        scale = q.shape[-1] ** -0.5
+        scale = d ** -0.5
     if interpret is None:
         interpret = not pallas_supported()
-    return _flash(
+    pad = (-d) % 128
+    if pad:
+        # Mosaic rejects memref slices (every `ref[0]` block select in
+        # the kernels) on refs whose lane dim is not 128-aligned, so
+        # dh=64-class models zero-pad the head dim up to a tile. Zero
+        # k/v lanes leave the logits and the real output lanes exact;
+        # the padded output lanes are sliced off (and autodiff of
+        # pad/slice keeps the gradients exact too). ~2x lane waste,
+        # still far ahead of the O(S^2) reference path.
+        widths = [(0, 0)] * 3 + [(0, pad)]
+        q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
+    out = _flash(
         q, k, v, segments, causal, float(scale), window, block_q, block_k,
         interpret,
     )
+    return out[..., :d] if pad else out
