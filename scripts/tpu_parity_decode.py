@@ -65,10 +65,16 @@ def paged_decode_cases(checks):
         paged_decode_attention,
     )
 
-    B, L, H, HKV, D, bs = 4, 1024, 16, 8, 128, 64
-    max_blocks = L // bs
-    n_blocks = B * max_blocks + 1
-    for s, window in [(1, None), (1, 200), (2, None)]:
+    B, L, H, HKV, D = 4, 1024, 16, 8, 128
+    # bs=64 runs the grouped gather with 2 groups; bs=16 is the serving
+    # default page size (group=32, the shape the one-page kernel lost
+    # to the XLA ref on — BENCH_DECODE.json).
+    for s, window, bs in [
+        (1, None, 64), (1, 200, 64), (2, None, 64),
+        (1, None, 16), (1, 200, 16),
+    ]:
+        max_blocks = L // bs
+        n_blocks = B * max_blocks + 1
         ks = jax.random.split(jax.random.PRNGKey(s * 11 + (window or 1)), 3)
         q = jax.random.normal(ks[0], (B, s, H, D), jnp.bfloat16)
         dense_k = jax.random.normal(ks[1], (B, L, HKV, D), jnp.bfloat16)
@@ -98,7 +104,7 @@ def paged_decode_cases(checks):
             index, window, D ** -0.5,
         )
         check(
-            f"paged s={s} window={window} shuffled-table",
+            f"paged s={s} window={window} bs={bs} shuffled-table",
             out.astype(jnp.float32), ref.astype(jnp.float32),
             atol=2e-2, checks=checks,
         )

@@ -412,6 +412,152 @@ def _decode_ref(q, cache_k, cache_v, index, window, scale):
 # ---------------------------------------------------------------------------
 
 
+def _paged_group_kernel(
+    len_ref, tab_ref, q_ref, k_hbm, v_hbm, o_ref,
+    acc_ref, m_ref, l_ref, k_buf, v_buf, sems,
+    *, scale, s, hkv, bs, group, window, num_kv,
+):
+    """Grouped paged decode: `group` pages gathered per grid step.
+
+    The one-page-per-grid-step kernel loses to the XLA dense-gather ref
+    at serving page sizes (block_size 16 measured 0.61x on v5e,
+    BENCH_DECODE.json): each step pays full grid/pipeline overhead to
+    DMA a (hkv, 16, d) sliver and feed the MXU a 16-wide dot. Here the
+    pool stays in HBM (memory_space=ANY) and the kernel gathers `group`
+    pages itself with parallel async copies into one contiguous VMEM
+    tile, so per-step overhead amortizes `group`-fold and the dot runs
+    group*bs wide. Skipping is page-granular: dead groups issue no DMAs
+    at all, and a live boundary group only fetches its live pages —
+    dead page slots are ZEROED in VMEM instead (cheaper than HBM
+    traffic, and required: unfetched scratch is uninitialized, and a
+    stray Inf/NaN bit pattern would poison the accumulator through the
+    masked-out p=0 rows as 0*Inf).
+    """
+    b = pl.program_id(0)
+    gi = pl.program_id(1)
+    idx = len_ref[b]
+    block_k = group * bs
+    num_groups = num_kv // group
+    first_gi, last_gi = _live_range(idx, s, block_k, window, num_groups)
+    live = (gi >= first_gi) & (gi * block_k <= idx + s - 1)
+    # Per-page live range (page granularity, not group granularity).
+    last_pg = jnp.minimum((idx + s - 1) // bs, num_kv - 1)
+    if window is None:
+        first_pg = jnp.int32(0)
+    else:
+        first_pg = jnp.maximum(idx - window + 1, 0) // bs
+
+    def _pg_live(g):
+        pg = gi * group + g
+        return (pg >= first_pg) & (pg <= last_pg)
+
+    @pl.when(live)
+    def _gather():
+        from jax.experimental.pallas import tpu as pltpu
+
+        for g in range(group):
+            dst = pl.dslice(g * bs, bs)
+
+            @pl.when(_pg_live(g))
+            def _fetch(g=g, dst=dst):
+                page = tab_ref[b, gi * group + g]
+                pltpu.make_async_copy(
+                    k_hbm.at[page], k_buf.at[:, dst, :], sems.at[0, g]
+                ).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[page], v_buf.at[:, dst, :], sems.at[1, g]
+                ).start()
+
+            @pl.when(~_pg_live(g))
+            def _zero(dst=dst):
+                k_buf[:, dst, :] = jnp.zeros_like(k_buf[:, dst, :])
+                v_buf[:, dst, :] = jnp.zeros_like(v_buf[:, dst, :])
+
+        for g in range(group):
+            dst = pl.dslice(g * bs, bs)
+
+            @pl.when(_pg_live(g))
+            def _await(g=g, dst=dst):
+                pltpu.make_async_copy(
+                    k_hbm.at[0], k_buf.at[:, dst, :], sems.at[0, g]
+                ).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[0], v_buf.at[:, dst, :], sems.at[1, g]
+                ).wait()
+
+    _decode_tile(
+        idx, q_ref.at[0], k_buf, v_buf, o_ref.at[0],
+        acc_ref, m_ref, l_ref,
+        scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
+        k_start=gi * block_k, ki=gi, last_ki=last_gi, first_ki=first_gi,
+    )
+
+
+def _paged_group_flash(
+    q, pool_k, pool_v, tables, index, scale, window, group, interpret
+):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s, h, d = q.shape
+    hkv, bs = pool_k.shape[1], pool_k.shape[2]
+    rows = h * s
+    num_kv = tables.shape[1]
+    num_groups = num_kv // group
+    block_k = group * bs
+
+    qf = _flatten_q(q, hkv)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, num_groups),
+        in_specs=[
+            pl.BlockSpec((1, rows, d), lambda bi, gi, lr, tr: (bi, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # k pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # v pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec(
+            (1, rows, d), lambda bi, gi, lr, tr: (bi, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((rows, 128), jnp.float32),
+            pltpu.VMEM((hkv, block_k, d), pool_k.dtype),
+            pltpu.VMEM((hkv, block_k, d), pool_v.dtype),
+            pltpu.SemaphoreType.DMA((2, group)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_group_kernel, scale=scale, s=s, hkv=hkv, bs=bs,
+            group=group, window=window, num_kv=num_kv,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
+        interpret=interpret,
+    )(index.astype(jnp.int32), tables.astype(jnp.int32), qf, pool_k, pool_v)
+    return _unflatten_o(out, b, s, h, d)
+
+
+def _paged_group(tables, pool_k) -> int:
+    """Pages per grid step: aim for a ~512-row kv tile, divide the
+    table, and respect the VMEM budget the one-page kernel enforces.
+    Returns 1 (one-page kernel) when grouping cannot work: the gather
+    lands each page at sublane offset g*bs of the VMEM tile, so bs
+    must be a multiple of the dtype's sublane tile (fp32 8, bf16 16,
+    int8 32) or Mosaic rejects the slice."""
+    num_kv = tables.shape[1]
+    hkv, bs = pool_k.shape[1], pool_k.shape[2]
+    sublane = 8 * max(1, 4 // jnp.dtype(pool_k.dtype).itemsize)
+    if bs % sublane:
+        return 1
+    cap = max(1, 8192 // max(hkv * bs, 1))  # hkv*group*bs <= 8192
+    g = min(max(512 // bs, 1), cap, num_kv)
+    while g > 1 and num_kv % g:
+        g -= 1
+    return g
+
+
 def _paged_kernel(
     len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     *, scale, s, hkv, block_k, window, num_kv,
@@ -541,6 +687,15 @@ def paged_decode_attention(
                 stacklevel=2,
             )
     if use_kernel:
+        # Grouped gather kernel when the head dim keeps full-lane tiles
+        # (its tile body is the ref-slicing fast path) and grouping
+        # actually amortizes anything; one-page kernel otherwise.
+        group = _paged_group(tables, pool_k) if q.shape[-1] % 128 == 0 else 1
+        if group > 1:
+            return _paged_group_flash(
+                q, pool_k, pool_v, tables, index, float(scale), window,
+                group, interpret,
+            )
         return _paged_flash(
             q, pool_k, pool_v, tables, index, float(scale), window, interpret
         )

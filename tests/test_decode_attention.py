@@ -114,6 +114,84 @@ def test_paged_decode_matches_dense(s, window):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+def _scatter_pool(dense_k, dense_v, bs, shuffle_seed=0):
+    """Scatter head-major dense caches (B, HKV, L, D) into a shuffled
+    pool + tables (block 0 reserved scratch)."""
+    b, hkv, l, d = dense_k.shape
+    max_blocks = l // bs
+    n_blocks = b * max_blocks + 1
+    rng = np.random.default_rng(shuffle_seed)
+    ids = rng.permutation(np.arange(1, n_blocks))
+    tables = ids.reshape(b, max_blocks)
+    pool_k = np.zeros((n_blocks, hkv, bs, d), np.float32)
+    pool_v = np.zeros((n_blocks, hkv, bs, d), np.float32)
+    dkn, dvn = np.asarray(dense_k), np.asarray(dense_v)
+    for bi in range(b):
+        for j in range(max_blocks):
+            pool_k[tables[bi, j]] = dkn[bi, :, j * bs:(j + 1) * bs]
+            pool_v[tables[bi, j]] = dvn[bi, :, j * bs:(j + 1) * bs]
+    return (jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(tables, jnp.int32))
+
+
+@pytest.mark.parametrize("window", [None, 100])
+def test_paged_grouped_multi_group(window):
+    """Grouped gather across num_groups > 1: the cross-group online-
+    softmax carry, per-page liveness (zeroed dead pages), and windowed
+    first-page skipping must all match the dense ref. The small-table
+    tests only ever hit num_groups == 1."""
+    from shellac_tpu.ops.decode_attention import _paged_group
+
+    big_l, bs = 2048, 16
+    ks = jax.random.split(jax.random.PRNGKey(31), 3)
+    q = _rand(ks[0], (2, 1, H, D))
+    dense_k = _rand(ks[1], (2, HKV, big_l, D))
+    dense_v = _rand(ks[2], (2, HKV, big_l, D))
+    # One short slot (first group boundary) and one near the end.
+    index = jnp.array([7, big_l - 1], jnp.int32)
+    pool_k, pool_v, tables = _scatter_pool(dense_k, dense_v, bs)
+    assert tables.shape[1] // _paged_group(tables, pool_k) > 1
+
+    ref = _decode_ref(q, dense_k, dense_v, index, window, D ** -0.5)
+    out = paged_decode_attention(
+        q, pool_k, pool_v, tables, index, window=window, impl="flash",
+        interpret=True,
+    )
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_one_page_kernel_pinned():
+    """The one-page kernel stays correct for 128-aligned head dims
+    (it is the fallback when grouping cannot divide the table)."""
+    from shellac_tpu.ops.decode_attention import _paged_flash
+
+    bs = 16
+    ks = jax.random.split(jax.random.PRNGKey(33), 3)
+    q = _rand(ks[0], (2, 1, H, D))
+    dense_k = _rand(ks[1], (2, HKV, L, D))
+    dense_v = _rand(ks[2], (2, HKV, L, D))
+    index = jnp.array([5, L - 1], jnp.int32)
+    pool_k, pool_v, tables = _scatter_pool(dense_k, dense_v, bs)
+    ref = _decode_ref(q, dense_k, dense_v, index, None, D ** -0.5)
+    out = _paged_flash(
+        q, pool_k, pool_v, tables, index, D ** -0.5, None, True
+    )
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_paged_group_respects_sublane_tiling():
+    """bs=8 bf16 pools must take the one-page kernel (a grouped gather
+    would land pages at sublane offset 8 of a 16-tiled bf16 VMEM tile,
+    which Mosaic rejects compiled)."""
+    from shellac_tpu.ops.decode_attention import _paged_group
+
+    tables = jnp.zeros((2, 64), jnp.int32)
+    assert _paged_group(tables, jnp.zeros((9, 4, 8, 128), jnp.bfloat16)) == 1
+    assert _paged_group(tables, jnp.zeros((9, 4, 16, 128), jnp.bfloat16)) > 1
+    assert _paged_group(tables, jnp.zeros((9, 4, 8, 128), jnp.float32)) > 1
+    assert _paged_group(tables, jnp.zeros((9, 4, 16, 128), jnp.int8)) == 1
+
+
 def test_auto_falls_back_to_ref_off_tpu():
     """impl='auto' off-TPU must take the ref path bit-for-bit."""
     ks = jax.random.split(jax.random.PRNGKey(1), 3)
