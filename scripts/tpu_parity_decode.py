@@ -110,6 +110,37 @@ def paged_decode_cases(checks):
         )
 
 
+def quant_cache_cases(checks):
+    """int8 KV cache decode kernel (per-token dequant scales) compiled."""
+    from shellac_tpu.inference.kvcache import quantize_kv
+    from shellac_tpu.ops.decode_attention import _decode_ref, decode_attention
+
+    B, L, H, HKV, D = 4, 1024, 16, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.bfloat16)
+    kf = jax.random.normal(ks[1], (B, L, HKV, D), jnp.float32)
+    vf = jax.random.normal(ks[2], (B, L, HKV, D), jnp.float32)
+    kq, ksc = quantize_kv(kf)
+    vq, vsc = quantize_kv(vf)
+    ck, cv = kq.transpose(0, 2, 1, 3), vq.transpose(0, 2, 1, 3)
+    kscale, vscale = ksc.transpose(0, 2, 1), vsc.transpose(0, 2, 1)
+    index = jnp.array([0, 37, 519, L - 1], jnp.int32)
+    for window in (None, 200):
+        out = decode_attention(
+            q, ck, cv, index, window=window, impl="flash", interpret=False,
+            k_scale=kscale, v_scale=vscale,
+        )
+        ref = _decode_ref(
+            q, ck, cv, index, window, D ** -0.5,
+            k_scale=kscale, v_scale=vscale,
+        )
+        check(
+            f"dense int8-kv window={window}",
+            out.astype(jnp.float32), ref.astype(jnp.float32),
+            atol=2e-2, checks=checks,
+        )
+
+
 def flash_train_cases(checks):
     from shellac_tpu.ops.attention import attention_ref
     from shellac_tpu.ops.flash_attention import flash_attention
@@ -232,6 +263,7 @@ def main():
     checks = []
     dense_decode_cases(checks)
     paged_decode_cases(checks)
+    quant_cache_cases(checks)
     flash_train_cases(checks)
     head_dim_64_cases(checks)
     print(json.dumps({"ok": True, "backend": backend, "checks": checks}))

@@ -466,6 +466,10 @@ def cmd_serve(args):
                          "per step; --decode-ticks must stay 1")
     if args.draft_model and args.prefill_chunk is not None:
         raise SystemExit("--draft-model does not support --prefill-chunk")
+    if args.kv_quant and args.paged:
+        raise SystemExit("--kv-quant is dense-cache only; drop --paged")
+    if args.kv_quant and args.draft_model:
+        raise SystemExit("--kv-quant does not compose with --draft-model")
 
     from shellac_tpu.parallel.distributed import initialize
 
@@ -541,6 +545,7 @@ def cmd_serve(args):
             prefill_chunk=args.prefill_chunk,
             logprobs=args.logprobs,
             mesh=mesh,
+            kv_quant=args.kv_quant,
             **extra,
         )
     if multihost:
@@ -563,6 +568,7 @@ def cmd_serve(args):
         max_prefills_per_step=args.max_prefills_per_step,
         prefill_chunk=args.prefill_chunk,
         logprobs=args.logprobs,
+        kv_quant=args.kv_quant,
     )
     return 0
 
@@ -719,6 +725,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "out to the global device count and set the "
                         "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/"
                         "JAX_PROCESS_ID env on every process)")
+    s.add_argument("--kv-quant", choices=["int8"], default=None,
+                   dest="kv_quant",
+                   help="int8 KV cache: half the cache memory and HBM "
+                        "stream per decode tick (dense cache only)")
     s.add_argument("--prefix-cache", action="store_true", dest="prefix_cache",
                    help="reuse cached KV blocks across prompts sharing a "
                         "prefix (requires --paged)")

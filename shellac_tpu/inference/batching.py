@@ -43,10 +43,15 @@ from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.kvcache import (
     KVCache,
     PagedKVCache,
+    QuantKVCache,
     cache_logical_axes,
     init_cache,
+    init_cache_for,
     init_paged_cache,
     paged_cache_logical_axes,
+    quant_cache_logical_axes,
+    scatter_slot,
+    slot_view,
 )
 from shellac_tpu.models import transformer
 from shellac_tpu.ops.sampling import NEG_INF, sample_batched
@@ -125,7 +130,10 @@ class BatchingEngine:
         prefill_chunk: Optional[int] = None,
         logprobs: bool = False,
         mesh=None,
+        kv_quant: Optional[str] = None,
     ):
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
         if decode_ticks < 1:
             raise ValueError(f"decode_ticks must be >= 1, got {decode_ticks}")
         if max_prefills_per_step is not None and max_prefills_per_step < 1:
@@ -197,7 +205,13 @@ class BatchingEngine:
                                jnp.float32)
         self._key = jax.random.PRNGKey(seed)
 
-        self._cache = init_cache(cfg, n_slots, self.max_len)
+        # kv_quant="int8": the slot cache stores int8 KV + per-token
+        # scales — half the resident footprint and half the HBM stream
+        # every decode tick. Prefill still computes on exact values;
+        # greedy outputs may differ from the bf16 cache by the int8
+        # rounding (~1e-3 relative on logits).
+        self.kv_quant = kv_quant
+        self._cache = init_cache_for(cfg, n_slots, self.max_len, kv_quant)
         self._cur = jnp.zeros((n_slots,), jnp.int32)  # next input token
         self._queue: deque[_Request] = deque()
         self._slots: List[Optional[_Request]] = [None] * n_slots
@@ -239,11 +253,12 @@ class BatchingEngine:
         if self.mesh is None:
             self._cache_sh = None
             return
-        axes = (
-            paged_cache_logical_axes()
-            if isinstance(self._cache, PagedKVCache)
-            else cache_logical_axes()
-        )
+        if isinstance(self._cache, PagedKVCache):
+            axes = paged_cache_logical_axes()
+        elif isinstance(self._cache, QuantKVCache):
+            axes = quant_cache_logical_axes()
+        else:
+            axes = cache_logical_axes()
         self._cache_sh = make_shardings(self.mesh, axes)
         self._cache = jax.device_put(self._cache, self._cache_sh)
         self._decode = None
@@ -257,10 +272,14 @@ class BatchingEngine:
 
     # ---- jitted programs --------------------------------------------
 
+    def _fresh_mini(self, length: int):
+        """Batch-1 cache of the engine's cache type (prefill scratch)."""
+        return init_cache_for(self.cfg, 1, length, self.kv_quant)
+
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
                       samp):
         """Prefill one request and scatter it into `slot` of `cache`."""
-        mini = init_cache(self.cfg, 1, self.max_len)
+        mini = self._fresh_mini(self.max_len)
         logits, mini = transformer.forward_with_cache(
             self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
             fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
@@ -269,18 +288,7 @@ class BatchingEngine:
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first, first_lp = self._sample_first(key, last, samp)
-        cache = KVCache(
-            k=jax.lax.dynamic_update_slice_in_dim(
-                cache.k, mini.k, slot, axis=1
-            ),
-            v=jax.lax.dynamic_update_slice_in_dim(
-                cache.v, mini.v, slot, axis=1
-            ),
-            lengths=jax.lax.dynamic_update_slice(
-                cache.lengths, mini.lengths, (slot,)
-            ),
-        )
-        return cache, first, first_lp
+        return scatter_slot(cache, mini, slot), first, first_lp
 
     def _decode_impl(self, params, cache, cur, active, key, samp,
                      greedy_only: bool = False, use_bias: bool = False):
@@ -600,9 +608,7 @@ class BatchingEngine:
         token is only meaningful for the final chunk; earlier chunks
         compute and discard it (cheaper than a second program variant).
         """
-        row_k = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
-        row_v = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
-        view = KVCache(k=row_k, v=row_v, lengths=offset.astype(jnp.int32))
+        view = slot_view(cache, slot, offset)
         logits, view = transformer.forward_with_cache(
             self.cfg, params, tokens, view, new_tokens_len=chunk_len,
             fresh_cache=fresh,
@@ -612,18 +618,7 @@ class BatchingEngine:
             logits, (chunk_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first, first_lp = self._sample_first(key, last, samp)
-        cache = KVCache(
-            k=jax.lax.dynamic_update_slice_in_dim(
-                cache.k, view.k, slot, axis=1
-            ),
-            v=jax.lax.dynamic_update_slice_in_dim(
-                cache.v, view.v, slot, axis=1
-            ),
-            lengths=jax.lax.dynamic_update_slice(
-                cache.lengths, view.lengths, (slot,)
-            ),
-        )
-        return cache, first, first_lp
+        return scatter_slot(cache, view, slot), first, first_lp
 
     def _finish_check(self, finished):
         for i, req in enumerate(self._slots):
@@ -818,6 +813,11 @@ class PagedBatchingEngine(BatchingEngine):
         prefix_cache: bool = False,
         **kw,
     ):
+        if kw.get("kv_quant") is not None:
+            raise NotImplementedError(
+                "kv_quant is dense-cache only for now (the paged pool "
+                "kernels and gather path do not carry scales yet)"
+            )
         super().__init__(cfg, params, n_slots=n_slots, max_len=max_len, **kw)
         self.block_size = block_size
         self.prefix_cache = prefix_cache

@@ -25,7 +25,8 @@ from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.kvcache import (
     KVCache,
     cache_logical_axes,
-    init_cache,
+    init_cache_for,
+    quant_cache_logical_axes,
 )
 from shellac_tpu.models import transformer
 from shellac_tpu.ops.sampling import sample
@@ -72,10 +73,14 @@ class Engine:
         min_p: Optional[float] = None,
         repetition_penalty: float = 1.0,
         mesh=None,
+        kv_quant: Optional[str] = None,
     ):
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
         self.cfg = cfg
         self.params = params
         self.mesh = mesh
+        self.kv_quant = kv_quant
         self.max_len = max_len or cfg.max_seq_len
         self.repetition_penalty = repetition_penalty
         self._sampler = functools.partial(
@@ -87,7 +92,9 @@ class Engine:
         else:
             # Pin the cache layout at the prefill boundary; decode then
             # inherits it from its (committed) cache argument.
-            cache_sh = make_shardings(mesh, cache_logical_axes())
+            axes = (quant_cache_logical_axes() if kv_quant
+                    else cache_logical_axes())
+            cache_sh = make_shardings(mesh, axes)
             self._prefill = jax.jit(
                 self._prefill_impl, out_shardings=(None, cache_sh, None)
             )
@@ -96,7 +103,7 @@ class Engine:
     def _prefill_impl(self, params, tokens, prompt_len):
         """tokens: (B, S_pad) right-padded; prompt_len: (B,) real lengths."""
         b, s = tokens.shape
-        cache = init_cache(self.cfg, b, self.max_len)
+        cache = init_cache_for(self.cfg, b, self.max_len, self.kv_quant)
         logits, cache = transformer.forward_with_cache(
             self.cfg, params, tokens, cache, new_tokens_len=prompt_len,
             mesh=self.mesh, fresh_cache=True, attn_impl="auto",

@@ -59,6 +59,96 @@ def cache_logical_axes():
     )
 
 
+# ---------------------------------------------------------------------------
+# Int8-quantized cache (serving memory/bandwidth: half of bf16)
+# ---------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class QuantKVCache:
+    """KV cache stored int8 with one fp32 scale per written token/head.
+
+    Same head-major layout and write-at-own-length contract as KVCache;
+    k/v hold symmetric int8 (scale = amax/127 over the head_dim axis,
+    computed at write time — K is quantized AFTER RoPE so dequantized
+    reads reproduce the rotated values directly). Decode is HBM-bound
+    on cache reads, so int8 halves both the resident footprint (double
+    the servable slots*context) and the stream the attention pays per
+    tick; the logits dot runs fp32 with the per-token scale folded in
+    after (exact algebra: sum_d q*k_int*s == s * sum_d q*k_int).
+    """
+
+    k: Any  # (L, B, Hkv, max_len, Dh) int8
+    v: Any  # (L, B, Hkv, max_len, Dh) int8
+    ks: Any  # (L, B, Hkv, max_len) fp32 — k dequant scale per token
+    vs: Any  # (L, B, Hkv, max_len) fp32
+    lengths: Any  # (B,) int32
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[3]
+
+
+def init_quant_cache(cfg: ModelConfig, batch: int, max_len: int) -> QuantKVCache:
+    shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.dim_per_head)
+    return QuantKVCache(
+        k=jnp.zeros(shape, jnp.int8),
+        v=jnp.zeros(shape, jnp.int8),
+        ks=jnp.zeros(shape[:-1], jnp.float32),
+        vs=jnp.zeros(shape[:-1], jnp.float32),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def quant_cache_logical_axes():
+    return QuantKVCache(
+        k=("layers", "batch", "kv_heads", None, None),
+        v=("layers", "batch", "kv_heads", None, None),
+        ks=("layers", "batch", "kv_heads", None),
+        vs=("layers", "batch", "kv_heads", None),
+        lengths=("batch",),
+    )
+
+
+def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
+                   kv_quant=None):
+    """The engines' cache constructor: dense bf16 or int8 by kv_quant."""
+    if kv_quant == "int8":
+        return init_quant_cache(cfg, batch, max_len)
+    if kv_quant is not None:
+        raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
+    return init_cache(cfg, batch, max_len)
+
+
+def quantize_kv(x: jax.Array):
+    """(B, S, Hkv, Dh) -> int8 values + (B, S, Hkv) fp32 scales."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(xf / scale[..., None]), -127.0, 127.0
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def quant_update_layer(
+    cache_k, cache_v, cache_ks, cache_vs,  # one layer's (B, Hkv, len[, Dh])
+    k_new, v_new,  # (B, S, Hkv, Dh) unquantized
+    index,  # (B,) int32
+):
+    """Quantize S new positions and write them at per-sequence offsets."""
+    kq, ks = quantize_kv(k_new)
+    vq, vs = quantize_kv(v_new)
+    ck, cv = update_layer(cache_k, cache_v, kq, vq, index)
+
+    def upd(c, n, i):
+        return jax.lax.dynamic_update_slice(c, n, (0, i))
+
+    cks = jax.vmap(upd)(cache_ks, ks.transpose(0, 2, 1), index)
+    cvs = jax.vmap(upd)(cache_vs, vs.transpose(0, 2, 1), index)
+    return ck, cv, cks, cvs
+
+
 def paged_cache_logical_axes():
     """Logical axes for sharding a paged cache over a mesh.
 
@@ -92,6 +182,39 @@ def update_layer(
     ck = jax.vmap(upd)(cache_k, k_new, index)
     cv = jax.vmap(upd)(cache_v, v_new, index)
     return ck, cv
+
+
+def scatter_slot(cache, mini, slot):
+    """Write a batch-1 mini-cache into `slot` of a slot cache.
+
+    Works for KVCache and QuantKVCache alike (the serving engines use
+    it so their prefill programs stay cache-type-agnostic).
+    """
+
+    def upd(c, n):
+        return jax.lax.dynamic_update_slice_in_dim(c, n, slot, axis=1)
+
+    fields = {"k": upd(cache.k, mini.k), "v": upd(cache.v, mini.v),
+              "lengths": jax.lax.dynamic_update_slice(
+                  cache.lengths, mini.lengths, (slot,))}
+    if isinstance(cache, QuantKVCache):
+        fields.update(ks=upd(cache.ks, mini.ks), vs=upd(cache.vs, mini.vs))
+    return cache.replace(**fields)
+
+
+def slot_view(cache, slot, lengths):
+    """Batch-1 view of one slot's rows, with `lengths` (1,) overriding
+    the stored per-slot lengths (chunked-prefill continuations resume
+    from an explicit offset)."""
+
+    def sl(c):
+        return jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
+
+    fields = {"k": sl(cache.k), "v": sl(cache.v),
+              "lengths": lengths.astype(jnp.int32)}
+    if isinstance(cache, QuantKVCache):
+        fields.update(ks=sl(cache.ks), vs=sl(cache.vs))
+    return cache.replace(**fields)
 
 
 # ---------------------------------------------------------------------------

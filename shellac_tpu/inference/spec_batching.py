@@ -72,6 +72,12 @@ class SpeculativeBatchingEngine(BatchingEngine):
                 "speculative batching does not support chunked prefill "
                 "(the draft cache prefills whole prompts)"
             )
+        if kw.get("kv_quant") is not None:
+            raise NotImplementedError(
+                "speculative batching keeps bf16 caches (verify windows "
+                "re-read fresh positions where int8 rounding would break "
+                "the acceptance identity)"
+            )
         if kw.get("mesh") is not None:
             raise NotImplementedError(
                 "speculative batching is single-device for now: the "

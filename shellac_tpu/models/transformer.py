@@ -303,7 +303,7 @@ def _zero_aux():
 def _block(
     cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None,
     fresh_cache: bool = False, segments=None, page_tables=None,
-    moe_layer=None,
+    moe_layer=None, kv_scales=None,
 ):
     """One pre-norm transformer block. x: (B, S, D) in compute dtype.
 
@@ -421,6 +421,28 @@ def _block(
             o = paged_decode_attention(
                 q, pool_k, pool_v, page_tables, index,
                 window=cfg.attn_window, impl=attn_impl,
+            )
+    elif kv_scales is not None:
+        from shellac_tpu.inference.kvcache import quant_update_layer
+        from shellac_tpu.ops.decode_attention import decode_attention
+
+        cache_k, cache_v, index, q_positions = cache  # int8 cache layer
+        ks_l, vs_l = kv_scales
+        cache_k, cache_v, ks_l, vs_l = quant_update_layer(
+            cache_k, cache_v, ks_l, vs_l, k, v, index
+        )
+        new_cache = (cache_k, cache_v, ks_l, vs_l)
+        if fresh_cache:
+            # Prefill computes on the exact (unquantized) chunk; only
+            # later reads see the int8 rounding.
+            o = attention(
+                q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
+            )
+        else:
+            o = decode_attention(
+                q, cache_k, cache_v, index,
+                window=cfg.attn_window, impl=attn_impl,
+                k_scale=ks_l, v_scale=vs_l,
             )
     else:
         from shellac_tpu.inference.kvcache import update_layer
@@ -777,13 +799,14 @@ def forward_with_cache(
     the incoming chunk instead of over the max_len buffer — quadratic
     not rectangular, and flash-eligible via attn_impl="auto".
     """
-    from shellac_tpu.inference.kvcache import PagedKVCache
+    from shellac_tpu.inference.kvcache import PagedKVCache, QuantKVCache
 
     if not cfg.causal:
         raise ValueError(
             "KV-cache generation requires a causal model (cfg.causal=True)"
         )
     paged = isinstance(cache, PagedKVCache)
+    quant = isinstance(cache, QuantKVCache)
     cdt = cfg.compute_dtype
     b, s = tokens.shape
     index = cache.lengths  # (B,)
@@ -797,14 +820,31 @@ def forward_with_cache(
 
     tables = cache.tables if paged else None
 
-    def run_block(x, lp, ck, cv, moe_flag):
+    def run_block(x, lp, ck, cv, moe_flag, scales=None):
         return _block(
             cfg, mesh, attn_impl, x, lp, cos, sin,
             cache=(ck, cv, index, positions), fresh_cache=fresh_cache,
-            page_tables=tables, moe_layer=moe_flag,
+            page_tables=tables, moe_layer=moe_flag, kv_scales=scales,
         )
 
-    if grouped_moe(cfg):
+    if quant:
+        if grouped_moe(cfg):
+            raise NotImplementedError(
+                "int8 KV cache with interleaved dense/MoE stacks "
+                "(moe_every > 1) is not wired yet; use a uniform stack "
+                "or a bf16 cache"
+            )
+
+        def quant_body(x, layer_in):
+            lp, ck, cv, cks, cvs = layer_in
+            x, new_cache, _ = run_block(x, lp, ck, cv, None, (cks, cvs))
+            return x, new_cache
+
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            quant_body, x,
+            (params["layers"], cache.k, cache.v, cache.ks, cache.vs),
+        )
+    elif grouped_moe(cfg):
         every = cfg.moe_every
         ng = cfg.n_layers // every
         ckr = cache.k.reshape(ng, every, *cache.k.shape[1:])
@@ -856,7 +896,12 @@ def forward_with_cache(
         new_lengths = index + s
     else:
         new_lengths = index + new_tokens_len.astype(jnp.int32)
-    new_cache = cache.replace(k=new_k, v=new_v, lengths=new_lengths)
+    if quant:
+        new_cache = cache.replace(
+            k=new_k, v=new_v, ks=new_ks, vs=new_vs, lengths=new_lengths
+        )
+    else:
+        new_cache = cache.replace(k=new_k, v=new_v, lengths=new_lengths)
     return logits, new_cache
 
 

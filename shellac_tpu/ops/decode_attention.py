@@ -66,6 +66,10 @@ class PagedFallbackWarning(UserWarning):
     """Paged decode silently fell back to the dense-gather path."""
 
 
+class QuantFallbackWarning(UserWarning):
+    """Int8-cache decode fell back to the full-dequant reference path."""
+
+
 # ---------------------------------------------------------------------------
 # shared kernel body
 # ---------------------------------------------------------------------------
@@ -74,6 +78,7 @@ class PagedFallbackWarning(UserWarning):
 def _decode_tile(
     idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     *, scale, s, hkv, block_k, window, k_start, ki, last_ki, first_ki,
+    ks_ref=None, vs_ref=None,
 ):
     """One online-softmax step over every kv head of one sequence.
 
@@ -83,6 +88,11 @@ def _decode_tile(
     position k_start. acc/m/l scratch span all rows; the per-head work
     is a static python loop — tiny decode matmuls cannot amortize a
     per-head grid dimension (see module docstring).
+
+    ks_ref/vs_ref: (hkv, block_k) per-token dequant scales for int8
+    caches. The scale folds in AFTER the integer-valued dot (exact:
+    sum_d q*k_int*s == s * sum_d q*k_int) and, for v, onto p before the
+    pv dot; the int8 stream itself is the bandwidth win.
     """
     live = (ki >= first_ki) & (k_start <= idx + s - 1)
     rows = q_ref.shape[0]
@@ -113,6 +123,8 @@ def _decode_tile(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )  # (rph, block_k)
+            if ks_ref is not None:
+                logits = logits * ks_ref[kh][None, :]
             logits = jnp.where(mask, logits, NEG_INF)
 
             m_prev = m_ref[sl, :1]
@@ -126,6 +138,9 @@ def _decode_tile(
                 (rph, l_ref.shape[1]),
             )
             v = v_ref[kh]
+            if vs_ref is not None:
+                p = p * vs_ref[kh][None, :]
+                v = v.astype(jnp.float32)
             pv = jax.lax.dot_general(
                 p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -276,13 +291,35 @@ def _dense_kernel(
     )
 
 
-def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k, interpret):
+def _dense_kernel_quant(
+    idx_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    acc_ref, m_ref, l_ref,
+    *, scale, s, hkv, block_k, window, num_kv,
+):
+    """Dense kernel over an int8 cache with per-token dequant scales
+    (d % 128 == 0 only; the dispatch gate guarantees it)."""
+    b = pl.program_id(0)
+    ki = pl.program_id(1)
+    idx = idx_ref[b]
+    first_ki, last_ki = _live_range(idx, s, block_k, window, num_kv)
+    _decode_tile(
+        idx, q_ref.at[0], k_ref.at[0], v_ref.at[0], o_ref.at[0],
+        acc_ref, m_ref, l_ref,
+        scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
+        k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
+        ks_ref=ks_ref.at[0], vs_ref=vs_ref.at[0],
+    )
+
+
+def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k,
+                 interpret, k_scale=None, v_scale=None):
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
     _, hkv, max_len, _ = cache_k.shape
     rows = h * s
     num_kv = max_len // block_k
+    quant = k_scale is not None
 
     qf = _flatten_q(q, hkv)
 
@@ -295,14 +332,28 @@ def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k, interpret):
         # HBM bandwidth.
         return bi, 0, jnp.clip(ki, first_ki, last_ki), 0
 
+    def scale_map(bi, ki, idx_ref):
+        first_ki, last_ki = _live_range(
+            idx_ref[bi], s, block_k, window, num_kv
+        )
+        return bi, 0, jnp.clip(ki, first_ki, last_ki)
+
+    in_specs = [
+        pl.BlockSpec((1, rows, d), lambda bi, ki, idx_ref: (bi, 0, 0)),
+        pl.BlockSpec((1, hkv, block_k, d), kv_map),
+        pl.BlockSpec((1, hkv, block_k, d), kv_map),
+    ]
+    operands = [qf, cache_k, cache_v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, hkv, block_k), scale_map),
+            pl.BlockSpec((1, hkv, block_k), scale_map),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, rows, d), lambda bi, ki, idx_ref: (bi, 0, 0)),
-            pl.BlockSpec((1, hkv, block_k, d), kv_map),
-            pl.BlockSpec((1, hkv, block_k, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, rows, d), lambda bi, ki, idx_ref: (bi, 0, 0)
         ),
@@ -314,13 +365,14 @@ def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k, interpret):
     )
     out = pl.pallas_call(
         functools.partial(
-            _dense_kernel, scale=scale, s=s, hkv=hkv, block_k=block_k,
+            _dense_kernel_quant if quant else _dense_kernel,
+            scale=scale, s=s, hkv=hkv, block_k=block_k,
             window=window, num_kv=num_kv,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
         interpret=interpret,
-    )(index.astype(jnp.int32), qf, cache_k, cache_v)
+    )(index.astype(jnp.int32), *operands)
     return _unflatten_o(out, b, s, h, d)
 
 
@@ -333,12 +385,16 @@ def _pick_block_k(max_len: int, hkv: int, block_k: int) -> int:
 
 
 def decode_supported(
-    q, cache_k, *, block_k: Optional[int] = None
+    q, cache_k, *, block_k: Optional[int] = None, quant: bool = False
 ) -> bool:
     """Can the compiled dense decode kernel handle these shapes?"""
     b, s, h, d = q.shape
     hkv, max_len, dk = cache_k.shape[1], cache_k.shape[2], cache_k.shape[3]
     if d % 64 != 0 or dk != d:
+        return False
+    if quant and d % 128 != 0:
+        # The int8-cache kernel reuses the ref-slicing fast tile, which
+        # needs full-lane head dims; dh=64 int8 takes the ref path.
         return False
     if h % hkv != 0:
         return False
@@ -354,6 +410,7 @@ def decode_attention(
     impl: str = "auto",
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
+    k_scale=None, v_scale=None,
 ):
     """Attention of q (B, s, H, D) against a dense cache (B, Hkv, L, D).
 
@@ -361,32 +418,66 @@ def decode_attention(
     position index + si and attends kv positions <= its own (optionally
     windowed). Dispatches to the Pallas kernel when supported, else the
     masked reference path (bit-identical semantics).
+
+    k_scale/v_scale: (B, Hkv, L) per-token dequant scales for an int8
+    cache (see kvcache.QuantKVCache); both or neither.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale come together")
+    quant = k_scale is not None
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = not pallas_supported()
-    shapes_ok = decode_supported(q, cache_k, block_k=block_k)
+    shapes_ok = decode_supported(q, cache_k, block_k=block_k, quant=quant)
     if impl == "flash":
         if not shapes_ok:
             raise ValueError(
                 f"impl='flash' unsupported for q={q.shape} "
-                f"cache={cache_k.shape}"
+                f"cache={cache_k.shape} quant={quant}"
             )
         use_kernel = True
     else:
         # 'auto' only takes the kernel when compiled Pallas is live —
         # interpret mode exists for tests, not as a dispatch target.
         use_kernel = impl == "auto" and pallas_supported() and shapes_ok
+        if (impl == "auto" and pallas_supported() and not shapes_ok
+                and quant):
+            # An int8 cache whose shape disqualifies the kernel takes a
+            # ref path that dequantizes the WHOLE buffer every tick —
+            # more HBM traffic than the bf16 cache the operator was
+            # trying to halve. Same say-it-once policy as the paged
+            # fallback warning.
+            warnings.warn(
+                "decode_attention: int8-cache Pallas kernel unavailable "
+                f"for q={tuple(q.shape)} cache={tuple(cache_k.shape)} — "
+                "the reference fallback dequantizes the full cache every "
+                "tick (the kv_quant bandwidth win inverts). Kernel "
+                "needs head_dim % 128 == 0 for int8 caches.",
+                QuantFallbackWarning,
+                stacklevel=2,
+            )
     if use_kernel:
         bk = _pick_block_k(cache_k.shape[2], cache_k.shape[1], block_k)
         return _dense_flash(
-            q, cache_k, cache_v, index, float(scale), window, bk, interpret
+            q, cache_k, cache_v, index, float(scale), window, bk, interpret,
+            k_scale=k_scale, v_scale=v_scale,
         )
-    return _decode_ref(q, cache_k, cache_v, index, window, scale)
+    return _decode_ref(
+        q, cache_k, cache_v, index, window, scale,
+        k_scale=k_scale, v_scale=v_scale,
+    )
 
 
-def _decode_ref(q, cache_k, cache_v, index, window, scale):
+def _decode_ref(q, cache_k, cache_v, index, window, scale,
+                k_scale=None, v_scale=None):
+    if k_scale is not None:
+        # Dequantize the int8 cache at read; XLA fuses the multiply
+        # into the attention contraction's operand read.
+        cache_k = cache_k.astype(jnp.float32) * k_scale[..., None]
+        cache_v = cache_v.astype(jnp.float32) * v_scale[..., None]
+        cache_k = cache_k.astype(q.dtype)
+        cache_v = cache_v.astype(q.dtype)
     # cache: (B, Hkv, L, D) head-major -> (B, L, Hkv, D) for the ref.
     cache_k = cache_k.transpose(0, 2, 1, 3)
     cache_v = cache_v.transpose(0, 2, 1, 3)
