@@ -408,6 +408,9 @@ def cmd_generate(args):
         return np.asarray(truncate_at_stop(ids[None], stop_seqs)[0], np.int64)
 
     if args.draft_model:
+        if args.kv_quant:
+            raise SystemExit("--kv-quant does not compose with "
+                             "--draft-model")
         from shellac_tpu.inference.speculative import SpeculativeEngine
         from shellac_tpu.models.registry import PRESETS
 
@@ -442,6 +445,7 @@ def cmd_generate(args):
     eng = Engine(
         cfg, params,
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+        kv_quant=args.kv_quant,
     )
     out = eng.generate(jnp.asarray(prompt), max_new_tokens=args.max_new)
     ids = apply_stop(np.asarray(out.tokens)[0])
@@ -479,9 +483,9 @@ def cmd_serve(args):
             "multi-host serve needs an explicit --mesh (e.g. tp=8) "
             "multiplying out to the GLOBAL device count"
         )
-    if args.draft_model and (args.mesh or multihost):
-        raise SystemExit("--draft-model serving is single-device; drop "
-                         "--mesh / the distributed environment")
+    if args.draft_model and multihost:
+        raise SystemExit("--draft-model serving is single-host (tp via "
+                         "--mesh works); drop the distributed environment")
     cfg = _model_config(args)
     params = _apply_lora(args, cfg, _restore_params(args, cfg))
     if args.quantize:
@@ -521,12 +525,15 @@ def cmd_serve(args):
 
         dcfg = PRESETS[args.draft_model]
         dparams = transformer.init_params(dcfg, jax.random.PRNGKey(args.seed))
+        if mesh is not None:
+            dparams = shard_params(dcfg, dparams, mesh)
         engine = SpeculativeBatchingEngine(
             cfg, params, dcfg, dparams, gamma=args.gamma,
             n_slots=args.slots, max_len=args.max_len or cfg.max_seq_len,
             temperature=args.temperature, eos_id=args.eos_id,
             seed=args.seed, logprobs=args.logprobs,
             max_prefills_per_step=args.max_prefills_per_step,
+            mesh=mesh,
         )
     if args.paged or (engine is None and mesh is not None):
         from shellac_tpu.inference.batching import (
@@ -697,6 +704,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory written by `convert`")
     g.add_argument("--quantize", action="store_true",
                    help="int8 weight-only quantization")
+    g.add_argument("--kv-quant", choices=["int8"], default=None,
+                   dest="kv_quant",
+                   help="int8 KV cache (not with --draft-model)")
     g.add_argument("--ema", action="store_true",
                    help="generate with the EMA-averaged weights")
     g.add_argument("--stop", default=None,

@@ -78,20 +78,36 @@ class SpeculativeBatchingEngine(BatchingEngine):
                 "re-read fresh positions where int8 rounding would break "
                 "the acceptance identity)"
             )
-        if kw.get("mesh") is not None:
-            raise NotImplementedError(
-                "speculative batching is single-device for now: the "
-                "draft/verify programs do not thread the mesh; use "
-                "BatchingEngine/PagedBatchingEngine(mesh=...) for "
-                "sharded serving"
-            )
         super().__init__(cfg, params, **kw)
+        if kw.get("mesh") is not None:
+            tp = kw["mesh"].shape.get("tp", 1)
+            if draft_cfg.kv_heads % tp or draft_cfg.n_heads % tp:
+                # Fails later anyway, but deep inside device_put with a
+                # PartitionSpec message that never names the draft; the
+                # draft being smaller than the target makes this the
+                # common misconfiguration.
+                raise ValueError(
+                    f"draft model heads (n_heads={draft_cfg.n_heads}, "
+                    f"kv_heads={draft_cfg.kv_heads}) must divide tp={tp} "
+                    "— pick a draft with more heads or a smaller tp"
+                )
         self.draft_cfg = draft_cfg
         self.draft_params = draft_params
         self.gamma = gamma
         self._dcache = init_cache(draft_cfg, self.n_slots, self.max_len)
+        # The draft cache pins the same sharding tree as the target's
+        # (identical logical axes; this engine is dense-cache only) and
+        # draft params must arrive pre-sharded, same contract as the
+        # target's.
+        if self._cache_sh is not None:
+            self._dcache = jax.device_put(self._dcache, self._cache_sh)
         self._draft_prefill_jit = {}
-        self._spec_round = jax.jit(self._spec_round_impl)
+        round_kw = (
+            {"out_shardings": (self._cache_sh, self._cache_sh,
+                               None, None, None, None)}
+            if self._cache_sh is not None else {}
+        )
+        self._spec_round = jax.jit(self._spec_round_impl, **round_kw)
         self.stats.update({
             "spec_rounds": 0,
             "spec_proposed": 0,
@@ -130,7 +146,11 @@ class SpeculativeBatchingEngine(BatchingEngine):
         s = req.tokens.size
         pad = min(_bucket(s), self.max_len)
         if pad not in self._draft_prefill_jit:
-            self._draft_prefill_jit[pad] = jax.jit(self._draft_prefill_impl)
+            kw = ({"out_shardings": self._cache_sh}
+                  if self._cache_sh is not None else {})
+            self._draft_prefill_jit[pad] = jax.jit(
+                self._draft_prefill_impl, **kw
+            )
         padded = np.zeros((1, pad), np.int32)
         padded[0, :s] = req.tokens
         self._dcache = self._draft_prefill_jit[pad](
@@ -140,22 +160,14 @@ class SpeculativeBatchingEngine(BatchingEngine):
         return first_and_lp
 
     def _draft_prefill_impl(self, dparams, dcache, tokens, prompt_len, slot):
+        from shellac_tpu.inference.kvcache import scatter_slot
+
         mini = init_cache(self.draft_cfg, 1, self.max_len)
         _, mini = transformer.forward_with_cache(
             self.draft_cfg, dparams, tokens, mini, new_tokens_len=prompt_len,
-            fresh_cache=True, attn_impl=self.attn_impl,
+            fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
         )
-        return KVCache(
-            k=jax.lax.dynamic_update_slice_in_dim(
-                dcache.k, mini.k, slot, axis=1
-            ),
-            v=jax.lax.dynamic_update_slice_in_dim(
-                dcache.v, mini.v, slot, axis=1
-            ),
-            lengths=jax.lax.dynamic_update_slice(
-                dcache.lengths, mini.lengths, (slot,)
-            ),
-        )
+        return scatter_slot(dcache, mini, slot)
 
     # ---- one verification round over all slots ----------------------
 
@@ -181,7 +193,7 @@ class SpeculativeBatchingEngine(BatchingEngine):
             dc, tok = carry
             logits, dc = transformer.forward_with_cache(
                 self.draft_cfg, dparams, tok[:, None], dc,
-                attn_impl=self.attn_impl,
+                attn_impl=self.attn_impl, mesh=self.mesh,
             )
             logits = logits[:, 0].astype(jnp.float32)
             q = jax.nn.softmax(logits / t, axis=-1)
@@ -199,7 +211,7 @@ class SpeculativeBatchingEngine(BatchingEngine):
         # leaves the draft cache complete for the next round.
         _, dcache = transformer.forward_with_cache(
             self.draft_cfg, dparams, drafts[-1][:, None], dcache,
-            attn_impl=self.attn_impl,
+            attn_impl=self.attn_impl, mesh=self.mesh,
         )
         drafts = drafts.T  # (B, g)
         qs = jnp.moveaxis(qs, 0, 1)  # (B, g, V)
@@ -208,6 +220,7 @@ class SpeculativeBatchingEngine(BatchingEngine):
         tin = jnp.concatenate([cur[:, None], drafts], axis=1)  # (B, g+1)
         tlogits, tcache = transformer.forward_with_cache(
             self.cfg, params, tin, tcache, attn_impl=self.attn_impl,
+            mesh=self.mesh,
         )
         ps = jax.nn.softmax(
             tlogits.astype(jnp.float32) / t[..., None], axis=-1

@@ -121,16 +121,47 @@ class TestShardedEngine:
         assert got == want
         assert eng.stats["prefix_hit_tokens"] > 0
 
-    def test_speculative_engine_rejects_mesh(self, mesh_tp):
+    def test_sharded_speculative_engine_bit_matches(self, mesh_tp):
+        """tp-sharded speculative serving (self-draft) == unsharded
+        plain engine, greedy — speculation AND sharding both invisible."""
+        from shellac_tpu.inference.batching import BatchingEngine
         from shellac_tpu.inference.spec_batching import (
             SpeculativeBatchingEngine,
         )
 
         cfg = _tiny()
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-        with pytest.raises(NotImplementedError, match="single-device"):
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(1, cfg.vocab_size, size=n).tolist()
+                   for n in (3, 6, 4)]
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64).run(
+            [(i, p, 8) for i, p in enumerate(prompts)]
+        )
+        sharded = shard_params(cfg, params, mesh_tp)
+        eng = SpeculativeBatchingEngine(
+            cfg, sharded, cfg, sharded, gamma=3,
+            n_slots=2, max_len=64, mesh=mesh_tp,
+        )
+        got = eng.run([(i, p, 8) for i, p in enumerate(prompts)])
+        assert got == want
+        # Self-draft greedy accepts every proposal.
+        assert eng.stats["spec_accepted"] == eng.stats["spec_proposed"]
+
+    def test_speculative_draft_heads_must_divide_tp(self, mesh_tp):
+        """A too-small draft fails with a clear message, not a
+        device_put PartitionSpec error."""
+        from shellac_tpu.inference.spec_batching import (
+            SpeculativeBatchingEngine,
+        )
+
+        cfg = _tiny()
+        draft = cfg.replace(n_heads=2, n_kv_heads=1, d_model=64)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="draft model heads"):
             SpeculativeBatchingEngine(
-                cfg, params, cfg, params, mesh=mesh_tp
+                cfg, params, draft,
+                transformer.init_params(draft, jax.random.PRNGKey(1)),
+                mesh=mesh_tp,
             )
 
     def test_ragged_prompts_sharded(self, mesh_tp):
