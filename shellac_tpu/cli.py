@@ -151,7 +151,51 @@ def cmd_train(args):
 
     cfg = _model_config(args)
     tcfg = _train_config(args)
-    mesh = _mesh_from(args)
+
+    from shellac_tpu.parallel.distributed import initialize
+
+    multihost = initialize()
+    if multihost:
+        import jax
+
+        from shellac_tpu.parallel.distributed import global_mesh
+
+        if not args.mesh:
+            raise SystemExit(
+                "multi-host train needs an explicit --mesh multiplying "
+                "out to the GLOBAL device count (e.g. fsdp=32)"
+            )
+        if args.lora_rank is not None:
+            raise SystemExit("--lora-rank training is single-host")
+        pcfg = _parallel_config(args.mesh)
+        mesh = global_mesh(pcfg)
+        nbatch = pcfg.dp * pcfg.fsdp
+        nproc = jax.process_count()
+        if nbatch > 1:
+            # The batch axes span processes: --batch is the GLOBAL batch
+            # size; each process loads its share from a distinct stream.
+            # The shards must align with process boundaries, or two
+            # processes would contribute DIFFERENT rows to the same
+            # shard region (undefined data, or a rejected local shape).
+            if nbatch % nproc:
+                raise SystemExit(
+                    f"dp*fsdp={nbatch} must be a multiple of the "
+                    f"{nproc} processes (batch shards must align with "
+                    "process boundaries); use dp/fsdp >= processes or "
+                    "a tp/pp-only mesh"
+                )
+            if args.batch % nproc:
+                raise SystemExit(
+                    f"--batch {args.batch} must divide evenly over "
+                    f"{nproc} processes"
+                )
+            args.batch //= nproc
+            args.seed = args.seed + jax.process_index()
+        # else (tp/pp-only mesh): the batch is replicated across
+        # processes — every process must feed IDENTICAL data, so the
+        # seed stays shared.
+    else:
+        mesh = _mesh_from(args)
     # Resume continues the data stream where the checkpoint left it
     # rather than replaying (and re-training on) the earliest batches.
     skip = 0

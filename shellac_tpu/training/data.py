@@ -82,6 +82,45 @@ def token_batches(
         produced += 1
 
 
+def distribute_batches(it: Iterator[dict], mesh) -> Iterator[dict]:
+    """Per-process local batches -> global jax.Arrays on a multi-host
+    mesh.
+
+    On a pod, jit with non-addressable batch shardings cannot consume
+    host numpy; every process instead contributes its LOCAL slice of
+    the global batch and the runtime assembles the global array
+    (jax.make_array_from_process_local_data). The iterator on each
+    process must therefore yield that process's share: distinct streams
+    (seed offset by process_index) when the mesh's batch axes span
+    processes, or IDENTICAL streams when the batch is replicated across
+    processes (tp-only meshes) — the CLI picks the seed accordingly.
+
+    Single-process meshes pass batches through untouched (jit places
+    host numpy directly).
+    """
+    if jax.process_count() == 1:
+        yield from it
+        return
+    from shellac_tpu.parallel.sharding import logical_to_spec
+    from jax.sharding import NamedSharding
+
+    nbatch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    nproc = jax.process_count()
+    if nbatch > 1 and nbatch % nproc:
+        raise ValueError(
+            f"batch axes (dp*fsdp={nbatch}) must be a multiple of the "
+            f"{nproc} processes: with shards spanning process "
+            "boundaries, two processes would contribute different rows "
+            "to the same shard region"
+        )
+    sh = NamedSharding(mesh, logical_to_spec(("batch", "seq")))
+    for batch in it:
+        yield {
+            k: jax.make_array_from_process_local_data(sh, np.asarray(v))
+            for k, v in batch.items()
+        }
+
+
 def shard_batches(
     paths: Sequence[str],
     *,

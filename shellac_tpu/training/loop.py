@@ -45,6 +45,19 @@ def fit(
     where the preempted run left off instead of at the last periodic
     save.
     """
+    multi = mesh is not None and jax.process_count() > 1
+    if multi:
+        # Multi-host: every process runs this same loop in SPMD. Local
+        # batches assemble into global arrays; only process 0 writes
+        # the metrics file and heartbeat (checkpoint saves are
+        # collective — every process participates).
+        from shellac_tpu.training.data import distribute_batches
+
+        data_iter = distribute_batches(data_iter, mesh)
+        if jax.process_index() != 0:
+            log_path = None
+            heartbeat_path = None
+
     ckpt = None
     if checkpoint_dir is not None:
         from shellac_tpu.training.checkpoint import Checkpointer
@@ -87,7 +100,8 @@ def fit(
         old_handler = signal.signal(signal.SIGTERM, _on_term)
 
     step = int(jax.device_get(state.step))
-    while step < train_cfg.total_steps and not preempted.is_set():
+    stop = False
+    while step < train_cfg.total_steps and not stop:
         try:
             batch = next(data_iter)
         except StopIteration:
@@ -95,7 +109,25 @@ def fit(
         state, metrics = step_fn(state, batch)
         step += 1
 
+        if not multi and preempted.is_set():
+            stop = True
         if step % log_every == 0 or step >= train_cfg.total_steps:
+            if multi:
+                # Preemption signals land per-VM at different times; a
+                # process acting on its local flag alone would enter the
+                # final collective save while the others still run step
+                # collectives, deadlocking the job. Agree at the log
+                # boundary (the existing sync point) — maintenance grace
+                # periods are much longer than a log interval.
+                from jax.experimental import multihost_utils as mhu
+
+                import numpy as _np
+
+                if bool(mhu.process_allgather(
+                    _np.asarray([preempted.is_set()])
+                ).any()):
+                    preempted.set()
+                    stop = True
             loss = float(jax.device_get(metrics["loss"]))  # sync point
             dt = timer.tick()
             host_metrics = {k: jax.device_get(v) for k, v in metrics.items()}

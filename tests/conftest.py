@@ -33,3 +33,51 @@ def mesh_fsdp8():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def run_two_process(tmp_path, source, timeout=300):
+    """Launch `source` as 2 rendezvousing jax.distributed processes.
+
+    Shared by the multi-host serving/training tests. Asserts both ranks
+    exit 0 and printed "WORKER_OK <rank>"; returns their outputs.
+    """
+    import os
+    import pathlib
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(source)
+    env_base = {
+        **os.environ,
+        "PYTHONPATH": str(pathlib.Path(__file__).parents[1]),
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_NUM_PROCESSES": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script)],
+            env={**env_base, "JAX_PROCESS_ID": str(r)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"WORKER_OK {r}" in out, out
+    return outs

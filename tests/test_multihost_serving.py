@@ -8,12 +8,6 @@ sits in serve_forever(). Rank 0 asserts the multi-host outputs are
 bit-identical to a local single-process unsharded engine.
 """
 
-import os
-import pathlib
-import socket
-import subprocess
-import sys
-
 import jax
 import numpy as np
 import pytest
@@ -118,39 +112,9 @@ print("WORKER_OK", jax.process_index(), flush=True)
 
 class TestMultihostServing:
     def _run_pair(self, tmp_path, source):
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        script = tmp_path / "worker.py"
-        script.write_text(source)
-        env_base = {
-            **os.environ,
-            "PYTHONPATH": str(pathlib.Path(__file__).parents[1]),
-            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "JAX_NUM_PROCESSES": "2",
-        }
-        procs = [
-            subprocess.Popen(
-                [sys.executable, str(script)],
-                env={**env_base, "JAX_PROCESS_ID": str(r)},
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-            )
-            for r in range(2)
-        ]
-        outs = []
-        try:
-            for p in procs:
-                out, _ = p.communicate(timeout=300)
-                outs.append(out)
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-        for r, (p, out) in enumerate(zip(procs, outs)):
-            assert p.returncode == 0, f"rank {r} failed:\n{out}"
-            assert f"WORKER_OK {r}" in out, out
+        from conftest import run_two_process
+
+        run_two_process(tmp_path, source)
 
     def test_two_process_http_serving(self, tmp_path):
         """Full HTTP path on rank 0, follower mirroring on rank 1."""
