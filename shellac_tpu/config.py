@@ -50,6 +50,36 @@ class MoEConfig:
 
 
 @dataclass(frozen=True)
+class MLAConfig:
+    """Multi-head latent attention (DeepSeek-V2/V3 style).
+
+    K and V are generated from a shared low-rank latent: kv_a projects
+    the hidden state to `kv_lora_rank` (+ a `qk_rope_head_dim` slice
+    that carries position, shared by all heads, MQA-style), and kv_b
+    expands the normed latent to per-head no-position keys and values.
+    Queries split the same way (optionally low-rank via q_lora_rank).
+    The decode cache stores ONLY the latent + roped key slice —
+    `kv_lora_rank + qk_rope_head_dim` numbers per token, independent of
+    the head count (see models/transformer.py for the absorbed-matrix
+    decode that makes this exact).
+    """
+
+    kv_lora_rank: int = 512
+    q_lora_rank: Optional[int] = None
+    qk_nope_head_dim: int = 128
+    qk_rope_head_dim: int = 64
+    v_head_dim: int = 128
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def cache_dim(self) -> int:
+        return self.kv_lora_rank + self.qk_rope_head_dim
+
+
+@dataclass(frozen=True)
 class ModelConfig:
     """Decoder-only transformer configuration (LLaMA-style)."""
 
@@ -92,6 +122,11 @@ class ModelConfig:
     # int8 MXU dots (fwd only; fp32 master params untouched). Usually
     # set via TrainConfig.quant rather than directly. See ops/qtrain.py.
     quant_training: Optional[str] = None
+    # Multi-head latent attention (DeepSeek-style). Replaces the
+    # standard q/k/v projections; n_kv_heads must be unset (the latent
+    # is shared MQA-style) and head_dim is ignored in favour of the
+    # MLA dims.
+    mla: Optional[MLAConfig] = None
 
     @property
     def kv_heads(self) -> int:
@@ -111,6 +146,28 @@ class ModelConfig:
         # so the MXU tiles cleanly (128 lanes).
         raw = int(8 * self.d_model / 3)
         return ((raw + 127) // 128) * 128
+
+    @property
+    def rope_dim(self) -> int:
+        """Width of the rotary tables: MLA ropes only its qk_rope slice."""
+        return (self.mla.qk_rope_head_dim if self.mla is not None
+                else self.dim_per_head)
+
+    @property
+    def cache_kv_heads(self) -> int:
+        """KV-cache head count: MLA caches ONE shared latent row."""
+        return 1 if self.mla is not None else self.kv_heads
+
+    @property
+    def cache_head_dim(self) -> int:
+        """Per-token cache width: latent + roped key slice under MLA."""
+        return self.mla.cache_dim if self.mla is not None else self.dim_per_head
+
+    @property
+    def cache_v_head_dim(self) -> int:
+        """V-cache width: 0 under MLA (values re-expand from the SAME
+        latent the key cache stores — no second copy exists)."""
+        return 0 if self.mla is not None else self.dim_per_head
 
     @property
     def compute_dtype(self):
@@ -136,6 +193,20 @@ class ModelConfig:
                 f"quant_training={self.quant_training!r}; "
                 "have None, 'int8', 'int8_bwd'"
             )
+        if self.mla is not None:
+            if self.n_kv_heads is not None:
+                raise ValueError(
+                    "MLA shares one latent across heads (MQA-style); "
+                    "leave n_kv_heads unset"
+                )
+            if self.attn_window is not None:
+                raise ValueError("MLA with sliding windows is not defined")
+            if self.attn_bias:
+                raise ValueError("MLA attn_bias is not supported yet")
+            if not self.causal:
+                raise ValueError("MLA is decoder-only (causal=True)")
+            if self.mla.qk_rope_head_dim % 2:
+                raise ValueError("qk_rope_head_dim must be even (rope pairs)")
         return self
 
     def replace(self, **kw) -> "ModelConfig":

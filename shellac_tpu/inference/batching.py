@@ -258,7 +258,7 @@ class BatchingEngine:
         elif isinstance(self._cache, QuantKVCache):
             axes = quant_cache_logical_axes()
         else:
-            axes = cache_logical_axes()
+            axes = cache_logical_axes(self.cfg)
         self._cache_sh = make_shardings(self.mesh, axes)
         self._cache = jax.device_put(self._cache, self._cache_sh)
         self._decode = None
@@ -817,6 +817,13 @@ class PagedBatchingEngine(BatchingEngine):
             raise NotImplementedError(
                 "kv_quant is dense-cache only for now (the paged pool "
                 "kernels and gather path do not carry scales yet)"
+            )
+        if cfg.mla is not None:
+            raise NotImplementedError(
+                "MLA with the paged engine is not wired yet (the latent "
+                "cache needs its own pool layout); use the dense "
+                "BatchingEngine — MLA's cache is already ~n_heads-fold "
+                "smaller than expanded KV"
             )
         super().__init__(cfg, params, n_slots=n_slots, max_len=max_len, **kw)
         self.block_size = block_size

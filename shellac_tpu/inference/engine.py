@@ -93,7 +93,7 @@ class Engine:
             # Pin the cache layout at the prefill boundary; decode then
             # inherits it from its (committed) cache argument.
             axes = (quant_cache_logical_axes() if kv_quant
-                    else cache_logical_axes())
+                    else cache_logical_axes(cfg))
             cache_sh = make_shardings(mesh, axes)
             self._prefill = jax.jit(
                 self._prefill_impl, out_shardings=(None, cache_sh, None)

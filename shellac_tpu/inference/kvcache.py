@@ -21,7 +21,7 @@ ids stay continuous per sequence and pads are never attended.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.struct
 import jax
@@ -42,19 +42,28 @@ class KVCache:
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int) -> KVCache:
-    shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.dim_per_head)
+    head = (cfg.n_layers, batch, cfg.cache_kv_heads, max_len)
     return KVCache(
-        k=jnp.zeros(shape, cfg.compute_dtype),
-        v=jnp.zeros(shape, cfg.compute_dtype),
+        k=jnp.zeros((*head, cfg.cache_head_dim), cfg.compute_dtype),
+        # MLA: v is a zero-width placeholder — values re-expand from the
+        # latent the k cache already stores (transformer._block).
+        v=jnp.zeros((*head, cfg.cache_v_head_dim), cfg.compute_dtype),
         lengths=jnp.zeros((batch,), jnp.int32),
     )
 
 
-def cache_logical_axes():
-    """Logical axes for sharding the cache over a mesh."""
+def cache_logical_axes(cfg: Optional[ModelConfig] = None):
+    """Logical axes for sharding the cache over a mesh.
+
+    Under MLA the cache is one shared latent row per token (head axis
+    of size 1) — it replicates over tp instead of sharding; the
+    per-head work stays tp-sharded through the q/o projections. Pass
+    the cfg to get that right; None keeps the standard kv_heads axes.
+    """
+    heads = "kv_heads" if cfg is None or cfg.mla is None else None
     return KVCache(
-        k=("layers", "batch", "kv_heads", None, None),
-        v=("layers", "batch", "kv_heads", None, None),
+        k=("layers", "batch", heads, None, None),
+        v=("layers", "batch", heads, None, None),
         lengths=("batch",),
     )
 
@@ -114,6 +123,12 @@ def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
                    kv_quant=None):
     """The engines' cache constructor: dense bf16 or int8 by kv_quant."""
     if kv_quant == "int8":
+        if cfg.mla is not None:
+            raise NotImplementedError(
+                "kv_quant with MLA is not wired yet (the latent cache "
+                "needs its own scale layout); MLA's cache is already "
+                "~n_heads-fold smaller than expanded KV"
+            )
         return init_quant_cache(cfg, batch, max_len)
     if kv_quant is not None:
         raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")

@@ -41,6 +41,8 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         hf_cfg.hidden_size // n_heads
     )
     is_gemma = getattr(hf_cfg, "model_type", "") == "gemma"
+    if getattr(hf_cfg, "model_type", "") in ("deepseek_v2", "deepseek_v3"):
+        return _deepseek_config(hf_cfg)
     moe = None
     if getattr(hf_cfg, "num_local_experts", None):
         moe = MoEConfig(
@@ -74,6 +76,50 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         attn_bias=bool(
             getattr(hf_cfg, "attention_bias", False)
             or getattr(hf_cfg, "model_type", "") == "qwen2"
+        ),
+    ).validate()
+
+
+def _deepseek_config(hf_cfg) -> ModelConfig:
+    """DeepSeek-V2/V3 (MLA) config mapping.
+
+    Supported today: dense-MLP stacks (first_k_dense_replace covering
+    every layer) with default rope. The MoE side of DeepSeek uses
+    grouped/limited routing our router does not reproduce bit-exactly
+    yet, and yarn rope scaling is not implemented — both fail loudly
+    rather than converting approximately.
+    """
+    from shellac_tpu.config import MLAConfig
+
+    if getattr(hf_cfg, "first_k_dense_replace", 0) < hf_cfg.num_hidden_layers:
+        raise NotImplementedError(
+            "DeepSeek MoE layers (first_k_dense_replace < num layers) "
+            "use group-limited routing; only dense-MLP DeepSeek configs "
+            "convert exactly today"
+        )
+    if getattr(hf_cfg, "rope_scaling", None):
+        raise NotImplementedError("DeepSeek yarn rope scaling not supported")
+    if getattr(hf_cfg, "attention_bias", False):
+        raise NotImplementedError(
+            "DeepSeek attention_bias=True is not supported; converting "
+            "would silently drop the bias tensors"
+        )
+    return ModelConfig(
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_layers=hf_cfg.num_hidden_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        d_ff=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        norm_eps=hf_cfg.rms_norm_eps,
+        tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+        mla=MLAConfig(
+            kv_lora_rank=hf_cfg.kv_lora_rank,
+            q_lora_rank=getattr(hf_cfg, "q_lora_rank", None),
+            qk_nope_head_dim=hf_cfg.qk_nope_head_dim,
+            qk_rope_head_dim=hf_cfg.qk_rope_head_dim,
+            v_head_dim=hf_cfg.v_head_dim,
         ),
     ).validate()
 
@@ -150,6 +196,36 @@ _BIAS_MAP = {
 }
 
 
+def _collect_mla_layer(layers, m, get, base, norm_offset) -> None:
+    """One DeepSeek (MLA) layer's attention weights into the stacks.
+
+    kv_b_proj is one (H*(nope+v), kv_rank) matrix in HF; we split it
+    into the key expansion `wkv_b_k` (kv_rank, H, nope) and value
+    expansion `wkv_b_v` (kv_rank, H, v) that the absorbed decode
+    contracts separately (models/transformer._mla_attention).
+    """
+    a = base + "self_attn."
+    layers["wkv_a"].append(get(a + "kv_a_proj_with_mqa.weight").T)
+    layers["kv_a_norm"].append(
+        get(a + "kv_a_layernorm.weight") + norm_offset
+    )
+    kv_b = get(a + "kv_b_proj.weight").T  # (kv_rank, H*(nope+v))
+    kv_b = kv_b.reshape(
+        m.kv_lora_rank, -1, m.qk_nope_head_dim + m.v_head_dim
+    )
+    layers["wkv_b_k"].append(kv_b[..., : m.qk_nope_head_dim])
+    layers["wkv_b_v"].append(kv_b[..., m.qk_nope_head_dim:])
+    layers["wo"].append(get(a + "o_proj.weight").T)
+    if m.q_lora_rank is None:
+        layers["wq"].append(get(a + "q_proj.weight").T)
+    else:
+        layers["wq_a"].append(get(a + "q_a_proj.weight").T)
+        layers["q_a_norm"].append(
+            get(a + "q_a_layernorm.weight") + norm_offset
+        )
+        layers["wq_b"].append(get(a + "q_b_proj.weight").T)
+
+
 def params_from_state_dict(
     state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=None,
     norm_offset: float = -1.0,
@@ -181,15 +257,24 @@ def params_from_state_dict(
     mlp_keys = (["w_router"] + list(_EXPERT_MAP) if moe
                 else list(_DENSE_MLP_MAP))
     bias_keys = list(_BIAS_MAP) if cfg.attn_bias else []
+    if cfg.mla is not None:
+        attn_keys = ["wkv_a", "kv_a_norm", "wkv_b_k", "wkv_b_v", "wo"]
+        attn_keys += (["wq"] if cfg.mla.q_lora_rank is None
+                      else ["wq_a", "q_a_norm", "wq_b"])
+    else:
+        attn_keys = list(_ATTN_MAP)
     layers: Dict[str, list] = {
         k: []
-        for k in [*_ATTN_MAP, *bias_keys, *mlp_keys, "attn_norm", "mlp_norm"]
+        for k in [*attn_keys, *bias_keys, *mlp_keys, "attn_norm", "mlp_norm"]
     }
     for i in range(cfg.n_layers):
         base = f"layers.{i}."
-        for ours, (theirs, transpose) in _ATTN_MAP.items():
-            w = get(base + theirs)
-            layers[ours].append(w.T if transpose else w)
+        if cfg.mla is not None:
+            _collect_mla_layer(layers, cfg.mla, get, base, norm_offset)
+        else:
+            for ours, (theirs, transpose) in _ATTN_MAP.items():
+                w = get(base + theirs)
+                layers[ours].append(w.T if transpose else w)
         for ours, theirs in (_BIAS_MAP.items() if cfg.attn_bias else ()):
             layers[ours].append(get(base + theirs))
         if moe:
@@ -240,6 +325,11 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
     models export to the Mixtral naming (block_sparse_moe); shared
     experts have no HF counterpart and are refused.
     """
+    if cfg.mla is not None:
+        raise NotImplementedError(
+            "MLA export to the DeepSeek state_dict is not wired yet "
+            "(kv_b_proj re-fusion); import direction is supported"
+        )
     moe = cfg.moe is not None
     if moe and cfg.moe.num_shared_experts > 0:
         raise NotImplementedError(

@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from shellac_tpu.config import ModelConfig, MoEConfig
+from shellac_tpu.config import MLAConfig, ModelConfig, MoEConfig
 
 # fmt: off
 PRESETS = {
@@ -28,6 +28,21 @@ PRESETS = {
     "tiny-encoder": ModelConfig(vocab_size=256, d_model=64, n_layers=2,
                                 n_heads=4, max_seq_len=128, remat=False,
                                 causal=False),
+    "tiny-mla": ModelConfig(vocab_size=256, d_model=64, n_layers=2,
+                            n_heads=4, max_seq_len=128, remat=False,
+                            mla=MLAConfig(kv_lora_rank=32, q_lora_rank=24,
+                                          qk_nope_head_dim=16,
+                                          qk_rope_head_dim=8, v_head_dim=16)),
+    # DeepSeek-V2-Lite shape, dense-MLP variant (MLA decode cache:
+    # 576 per token vs 16*(192+128) = 5120 expanded — an 8.9x shrink).
+    "shellac-mla-2b": ModelConfig(vocab_size=32768, d_model=2048,
+                                  n_layers=20, n_heads=16,
+                                  max_seq_len=4096,
+                                  mla=MLAConfig(kv_lora_rank=512,
+                                                q_lora_rank=None,
+                                                qk_nope_head_dim=128,
+                                                qk_rope_head_dim=64,
+                                                v_head_dim=128)),
     # single-chip bench scale (v5e: 16 GiB HBM)
     "shellac-270m": ModelConfig(vocab_size=32768, d_model=1024, n_layers=12,
                                 n_heads=8, n_kv_heads=8, head_dim=128,

@@ -118,14 +118,44 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         # Residual-output projections scaled down GPT-2 style so the
         # residual stream variance stays O(1) at depth.
         out_scale = (2 * cfg.n_layers) ** -0.5
-        p = {
-            "attn_norm": jnp.zeros((d,), pdt),
-            "wq": dense(ks[0], (d, h * dh), d),
-            "wk": dense(ks[1], (d, hkv * dh), d),
-            "wv": dense(ks[2], (d, hkv * dh), d),
-            "wo": dense(ks[3], (h * dh, d), h * dh, out_scale),
-            "mlp_norm": jnp.zeros((d,), pdt),
-        }
+        if cfg.mla is not None:
+            m = cfg.mla
+            kq = jax.random.split(ks[0], 2)
+            kkv = jax.random.split(ks[1], 3)
+            p = {
+                "attn_norm": jnp.zeros((d,), pdt),
+                "wkv_a": dense(kkv[0], (d, m.cache_dim), d),
+                "kv_a_norm": jnp.zeros((m.kv_lora_rank,), pdt),
+                "wkv_b_k": dense(
+                    kkv[1], (m.kv_lora_rank, h, m.qk_nope_head_dim),
+                    m.kv_lora_rank,
+                ),
+                "wkv_b_v": dense(
+                    kkv[2], (m.kv_lora_rank, h, m.v_head_dim),
+                    m.kv_lora_rank,
+                ),
+                "wo": dense(ks[3], (h * m.v_head_dim, d), h * m.v_head_dim,
+                            out_scale),
+                "mlp_norm": jnp.zeros((d,), pdt),
+            }
+            if m.q_lora_rank is None:
+                p["wq"] = dense(kq[0], (d, h * m.qk_head_dim), d)
+            else:
+                p.update({
+                    "wq_a": dense(kq[0], (d, m.q_lora_rank), d),
+                    "q_a_norm": jnp.zeros((m.q_lora_rank,), pdt),
+                    "wq_b": dense(kq[1], (m.q_lora_rank, h * m.qk_head_dim),
+                                  m.q_lora_rank),
+                })
+        else:
+            p = {
+                "attn_norm": jnp.zeros((d,), pdt),
+                "wq": dense(ks[0], (d, h * dh), d),
+                "wk": dense(ks[1], (d, hkv * dh), d),
+                "wv": dense(ks[2], (d, hkv * dh), d),
+                "wo": dense(ks[3], (h * dh, d), h * dh, out_scale),
+                "mlp_norm": jnp.zeros((d,), pdt),
+            }
         if cfg.attn_bias:
             p.update({
                 "bq": jnp.zeros((h * dh,), pdt),
@@ -210,12 +240,35 @@ def _layer_axes(cfg: ModelConfig, moe_layer: bool, lead=("layers",)) -> dict:
             "bk": (*lead, "kv_heads"),
             "bv": (*lead, "kv_heads"),
         }
+    if cfg.mla is not None:
+        attn_axes = {
+            # The latent projections are rank-bottlenecked, not
+            # head-structured; only the per-head expansions and the
+            # output projection shard over tp.
+            "wkv_a": (*lead, "embed", None),
+            "kv_a_norm": (*lead, None),
+            "wkv_b_k": (*lead, None, "heads", None),
+            "wkv_b_v": (*lead, None, "heads", None),
+            "wo": (*lead, "heads", "embed"),
+        }
+        if cfg.mla.q_lora_rank is None:
+            attn_axes["wq"] = (*lead, "embed", "heads")
+        else:
+            attn_axes.update({
+                "wq_a": (*lead, "embed", None),
+                "q_a_norm": (*lead, None),
+                "wq_b": (*lead, None, "heads"),
+            })
+    else:
+        attn_axes = {
+            "wq": (*lead, "embed", "heads"),
+            "wk": (*lead, "embed", "kv_heads"),
+            "wv": (*lead, "embed", "kv_heads"),
+            "wo": (*lead, "heads", "embed"),
+        }
     return {
         "attn_norm": (*lead, None),
-        "wq": (*lead, "embed", "heads"),
-        "wk": (*lead, "embed", "kv_heads"),
-        "wv": (*lead, "embed", "kv_heads"),
-        "wo": (*lead, "heads", "embed"),
+        **attn_axes,
         "mlp_norm": (*lead, None),
         **bias_axes,
         **mlp_axes,
@@ -328,6 +381,22 @@ def _block(
 
     # --- attention ---
     hx = rms_norm(x, lp["attn_norm"], cfg.norm_eps).astype(cdt)
+    if cfg.mla is not None:
+        if page_tables is not None:
+            raise NotImplementedError(
+                "MLA with the paged engine is not wired yet (the latent "
+                "cache needs its own pool layout); use the dense cache"
+            )
+        if kv_scales is not None:
+            raise NotImplementedError("MLA with kv_quant is not wired yet")
+        o, new_cache = _mla_attention(
+            cfg, mesh, attn_impl, hx, lp, cos, sin, cache,
+            fresh_cache, segments, pdot,
+        )
+        o = pdot(o, lp["wo"])
+        x = x + constrain(o, mesh, ("batch", "seq", None))
+        return _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache,
+                          moe_layer, new_cache)
     q = pdot(hx, lp["wq"])
     k = pdot(hx, lp["wk"])
     v = pdot(hx, lp["wv"])
@@ -466,8 +535,14 @@ def _block(
             )
     o = pdot(o.reshape(b, s, h * dh), lp["wo"])
     x = x + constrain(o, mesh, ("batch", "seq", None))
+    return _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache,
+                      moe_layer, new_cache)
 
-    # --- mlp ---
+
+def _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache, moe_layer,
+               new_cache):
+    """The MLP half of a block (shared by the MHA/GQA and MLA paths)."""
+    cdt = cfg.compute_dtype
     hx = rms_norm(x, lp["mlp_norm"], cfg.norm_eps).astype(cdt)
     moe_out = _zero_aux()
     # moe_layer overrides the config for interleaved stacks (grouped_moe):
@@ -507,6 +582,105 @@ def _block(
         down = pdot(_gated_act(cfg)(gate, up), lp["w_down"])
     x = x + constrain(down, mesh, ("batch", "seq", None))
     return x, new_cache, moe_out
+
+
+def _mla_attention(
+    cfg: ModelConfig, mesh, attn_impl, hx, lp, cos, sin, cache,
+    fresh_cache, segments, pdot,
+):
+    """Multi-head latent attention (DeepSeek-style). Returns
+    (o (B, S, H*v_head_dim), new_cache-or-None).
+
+    Numerics follow HF DeepseekV2Attention exactly (interleaved rope on
+    the qk_rope slice, shared single-head roped key, softmax scale
+    qk_head_dim**-0.5). The cached path is the TPU-first part: the
+    cache holds ONE row per token — concat(normed latent, roped k_pe),
+    `kv_lora_rank + qk_rope_head_dim` wide, no head axis — and decode
+    uses matrix absorption: scores contract the latent against
+    per-head-projected queries (q_nope @ W_bk), and values re-expand
+    AFTER the weighted sum (attn @ latent, then W_bv). That is exact
+    algebra, not an approximation, and shrinks the cache ~n_heads-fold
+    vs materializing K/V (HF's cache stores the expanded tensors).
+    """
+    from shellac_tpu.ops.rope import apply_rope_interleaved
+
+    m = cfg.mla
+    cdt = cfg.compute_dtype
+    b, s, _ = hx.shape
+    h = cfg.n_heads
+    scale = m.qk_head_dim ** -0.5
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        raise NotImplementedError(
+            "MLA with sequence parallelism (sp > 1) is not wired yet"
+        )
+
+    if m.q_lora_rank is None:
+        q = pdot(hx, lp["wq"])
+    else:
+        qa = rms_norm(
+            pdot(hx, lp["wq_a"]), lp["q_a_norm"], cfg.norm_eps
+        ).astype(cdt)
+        q = pdot(qa, lp["wq_b"])
+    q = q.reshape(b, s, h, m.qk_head_dim)
+    q = constrain(q, mesh, ("batch", "seq", "heads", None))
+    q_nope = q[..., : m.qk_nope_head_dim]
+    q_pe = apply_rope_interleaved(q[..., m.qk_nope_head_dim:], cos, sin)
+
+    ckv = pdot(hx, lp["wkv_a"])  # (b, s, kv_rank + rope)
+    c = rms_norm(
+        ckv[..., : m.kv_lora_rank], lp["kv_a_norm"], cfg.norm_eps
+    ).astype(cdt)
+    k_pe = apply_rope_interleaved(
+        ckv[..., None, m.kv_lora_rank:], cos, sin
+    )  # (b, s, 1, rope)
+
+    w_bk = materialize(lp["wkv_b_k"], cdt)  # (kv_rank, h, nope)
+    w_bv = materialize(lp["wkv_b_v"], cdt)  # (kv_rank, h, v_dim)
+
+    def expanded_attention():
+        """Full-K/V form (training and fresh prefill): expand the
+        latent per head, pad v up to the qk width so the flash kernel
+        applies, slice the pad back off."""
+        k_nope = jnp.einsum("bsr,rhn->bshn", c, w_bk)
+        v = jnp.einsum("bsr,rhv->bshv", c, w_bv)
+        k = jnp.concatenate(
+            [k_nope, jnp.broadcast_to(k_pe, (b, s, h, m.qk_rope_head_dim))],
+            axis=-1,
+        )
+        qf = jnp.concatenate([q_nope, q_pe], axis=-1)
+        pad = m.qk_head_dim - m.v_head_dim
+        vp = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad)))
+        o = attention(
+            qf, k, vp, causal=True, scale=scale,
+            q_segments=segments, kv_segments=segments, impl=attn_impl,
+        )
+        return o[..., : m.v_head_dim]
+
+    if cache is None:
+        o = expanded_attention()
+        return o.reshape(b, s, h * m.v_head_dim), None
+
+    from shellac_tpu.inference.kvcache import update_layer
+    from shellac_tpu.ops.decode_attention import decode_attention
+
+    cache_k, cache_v, index, _ = cache
+    latent = jnp.concatenate([c[:, :, None, :], k_pe], axis=-1)  # (b,s,1,·)
+    v_stub = jnp.zeros((b, s, 1, 0), cache_v.dtype)
+    cache_k, cache_v = update_layer(cache_k, cache_v, latent, v_stub, index)
+    new_cache = (cache_k, cache_v)
+    if fresh_cache:
+        o = expanded_attention()
+    else:
+        # Absorbed decode: MQA over the latent rows. The same cache
+        # array serves as k AND v (values are its first kv_rank lanes
+        # after the weighted sum), so no second copy is ever stored.
+        q_eff = jnp.einsum("bshn,rhn->bshr", q_nope, w_bk)
+        q_cat = jnp.concatenate([q_eff, q_pe], axis=-1)
+        o_lat = decode_attention(
+            q_cat, cache_k, cache_k, index, scale=scale, impl=attn_impl,
+        )[..., : m.kv_lora_rank]
+        o = jnp.einsum("bshr,rhv->bshv", o_lat, w_bv)
+    return o.reshape(b, s, h * m.v_head_dim), new_cache
 
 
 def segment_positions(segment_ids: jax.Array) -> jax.Array:
@@ -561,7 +735,7 @@ def forward(
             pos = segment_positions(segment_ids)
         else:
             pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    cos, sin = rope_angles(pos, cfg.dim_per_head, cfg.rope_theta)
+    cos, sin = rope_angles(pos, cfg.rope_dim, cfg.rope_theta)
 
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))
@@ -813,7 +987,7 @@ def forward_with_cache(
     positions = index[:, None] + jnp.broadcast_to(
         jnp.arange(s, dtype=jnp.int32), (b, s)
     )
-    cos, sin = rope_angles(positions, cfg.dim_per_head, cfg.rope_theta)
+    cos, sin = rope_angles(positions, cfg.rope_dim, cfg.rope_theta)
 
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))

@@ -25,6 +25,28 @@ def rope_angles(positions: jax.Array, head_dim: int, theta: float = 10000.0):
     return jnp.cos(ang), jnp.sin(ang)
 
 
+def apply_rope_interleaved(
+    x: jax.Array, cos: jax.Array, sin: jax.Array
+) -> jax.Array:
+    """Rotate ADJACENT pairs of the head dim (complex/GPT-J form).
+
+    DeepSeek's MLA rope treats (x[2i], x[2i+1]) as one complex number
+    (torch.view_as_complex), unlike the half-rotation above; converted
+    checkpoints only reproduce with matching pairing. Shapes as
+    apply_rope: x (..., S, H, D), cos/sin broadcastable to
+    (..., S, 1, D/2).
+    """
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    c = cos[..., None, :]
+    s = sin[..., None, :]
+    out1 = x1 * c - x2 * s
+    out2 = x2 * c + x1 * s
+    # Re-interleave: (..., D/2, 2) -> (..., D)
+    out = jnp.stack([out1, out2], axis=-1).reshape(*x.shape)
+    return out.astype(x.dtype)
+
+
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     """Rotate the head dimension of x.
 

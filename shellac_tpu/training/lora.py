@@ -58,6 +58,13 @@ class LoRAConfig:
         return self.alpha / self.rank
 
     def validate(self, model_cfg: ModelConfig) -> "LoRAConfig":
+        if model_cfg.mla is not None:
+            raise NotImplementedError(
+                "LoRA on MLA models is not wired yet: the latent "
+                "projections (wkv_a/wkv_b_k/wkv_b_v) need their own "
+                "adapter shapes; the standard wq/wk/wv targets do not "
+                "exist in an MLA parameter tree"
+            )
         unknown = set(self.targets) - set(_TARGETS)
         if unknown:
             raise ValueError(

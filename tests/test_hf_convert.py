@@ -59,6 +59,81 @@ def test_logits_parity(n_kv, tie):
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
 
 
+def _tiny_deepseek(q_lora_rank=None, vocab=128):
+    cfg = transformers.DeepseekV2Config(
+        vocab_size=vocab,
+        hidden_size=64,
+        intermediate_size=176,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=4,
+        kv_lora_rank=32,
+        q_lora_rank=q_lora_rank,
+        qk_nope_head_dim=16,
+        qk_rope_head_dim=8,
+        v_head_dim=16,
+        head_dim=8,
+        first_k_dense_replace=2,  # every layer dense-MLP
+        n_routed_experts=None,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+        attention_bias=False,
+    )
+    torch.manual_seed(1)
+    return transformers.DeepseekV2ForCausalLM(cfg).eval()
+
+
+@pytest.mark.parametrize("q_lora_rank", [None, 24])
+def test_deepseek_mla_logits_parity(q_lora_rank):
+    """DeepSeek-V2 (multi-head latent attention) exact logits parity."""
+    model = _tiny_deepseek(q_lora_rank=q_lora_rank)
+    cfg, params = from_hf(model)
+    cfg = cfg.replace(dtype="float32")
+    assert cfg.mla is not None and cfg.mla.kv_lora_rank == 32
+    assert cfg.mla.q_lora_rank == q_lora_rank
+
+    tokens = np.array([[3, 17, 42, 99, 7, 23, 56, 1]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_deepseek_greedy_generation_parity():
+    """Token-exact greedy generation vs HF through the LATENT cache —
+    the absorbed-matrix decode must match HF's expanded-KV cache."""
+    from shellac_tpu.inference.engine import Engine
+
+    model = _tiny_deepseek(q_lora_rank=24)
+    cfg, params = from_hf(model)
+    cfg = cfg.replace(dtype="float32")
+    prompt = np.array([[5, 9, 2, 31, 77]], np.int64)
+    with torch.no_grad():
+        ref = model.generate(
+            torch.from_numpy(prompt), max_new_tokens=12, do_sample=False,
+        ).numpy()[:, prompt.shape[1]:]
+    out = Engine(cfg, params, temperature=0.0, max_len=64).generate(
+        jnp.asarray(prompt, jnp.int32), max_new_tokens=12
+    )
+    np.testing.assert_array_equal(np.asarray(out.tokens), ref)
+
+
+def test_deepseek_moe_conversion_rejected():
+    cfg = transformers.DeepseekV2Config(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, kv_lora_rank=16, q_lora_rank=None,
+        qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
+        first_k_dense_replace=1, n_routed_experts=4,
+    )
+    with pytest.raises(NotImplementedError, match="group-limited"):
+        config_from_hf(cfg)
+
+
 def test_config_mapping():
     model = _tiny_llama()
     cfg = config_from_hf(model.config)
