@@ -255,6 +255,45 @@ def head_dim_64_cases(checks):
         )
 
 
+def mla_shape_cases(checks):
+    """The kernel shapes MLA routes through, compiled: decode over the
+    576-wide latent (d % 128 == 64 -> whole-ref-load tile) as MQA, and
+    flash fwd/bwd at qk width 192 (entry pads to 256)."""
+    from shellac_tpu.ops.attention import attention_ref
+    from shellac_tpu.ops.decode_attention import _decode_ref, decode_attention
+    from shellac_tpu.ops.flash_attention import flash_attention
+
+    B, L, H, D = 2, 1024, 16, 576  # latent width kv_rank 512 + rope 64
+    ks = jax.random.split(jax.random.PRNGKey(13), 2)
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.bfloat16)
+    lat = jax.random.normal(ks[1], (B, 1, L, D), jnp.bfloat16)
+    index = jnp.array([43, L - 1], jnp.int32)
+    out = decode_attention(q, lat, lat, index, impl="flash",
+                           scale=192 ** -0.5, interpret=False)
+    ref = _decode_ref(q, lat, lat, index, None, 192 ** -0.5)
+    check("mla latent decode d=576", out.astype(jnp.float32),
+          ref.astype(jnp.float32), atol=2e-2, checks=checks)
+
+    S, HKV, DQ = 1024, 8, 192
+    ks = jax.random.split(jax.random.PRNGKey(14), 3)
+    qf = jax.random.normal(ks[0], (B, S, HKV, DQ), jnp.bfloat16)
+    kf = jax.random.normal(ks[1], (B, S, HKV, DQ), jnp.bfloat16)
+    vf = jax.random.normal(ks[2], (B, S, HKV, DQ), jnp.bfloat16)
+    out = flash_attention(qf, kf, vf, causal=True, interpret=False)
+    ref = attention_ref(qf, kf, vf, causal=True)
+    check("mla flash fwd d=192", out.astype(jnp.float32),
+          ref.astype(jnp.float32), atol=2e-2, checks=checks)
+    gf = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, causal=True, interpret=False) ** 2), (0, 1, 2))(qf, kf, vf)
+    gr = jax.grad(lambda a, b, c: jnp.sum(attention_ref(
+        a, b, c, causal=True) ** 2), (0, 1, 2))(qf, kf, vf)
+    for name, a, b in zip("dq dk dv".split(), gf, gr):
+        sc = max(1.0, float(jnp.max(jnp.abs(b.astype(jnp.float32)))))
+        check(f"mla flash bwd d=192 {name}",
+              a.astype(jnp.float32) / sc, b.astype(jnp.float32) / sc,
+              atol=3e-2, checks=checks)
+
+
 def main():
     backend = jax.default_backend()
     if backend != "tpu":
@@ -266,6 +305,7 @@ def main():
     quant_cache_cases(checks)
     flash_train_cases(checks)
     head_dim_64_cases(checks)
+    mla_shape_cases(checks)
     print(json.dumps({"ok": True, "backend": backend, "checks": checks}))
 
 

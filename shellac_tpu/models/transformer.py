@@ -411,62 +411,7 @@ def _block(
     k = apply_rope(k, cos, sin)
     new_cache = None
     if cache is None:
-        q = constrain(q, mesh, ("batch", "seq", "heads", None))
-        k = constrain(k, mesh, ("batch", "seq", "kv_heads", None))
-        v = constrain(v, mesh, ("batch", "seq", "kv_heads", None))
-        from shellac_tpu.parallel.mesh import AXIS_SEQ
-
-        sp_active = mesh is not None and mesh.shape.get(AXIS_SEQ, 1) > 1
-        if attn_impl in ("ring", "ulysses") and not sp_active:
-            raise ValueError(
-                f"attn_impl={attn_impl!r} requires a mesh with sp > 1; got "
-                f"mesh={'None' if mesh is None else dict(mesh.shape)}"
-            )
-        from shellac_tpu.parallel.ulysses import ulysses_supported
-
-        ulysses_ok = sp_active and ulysses_supported(h, hkv, mesh)
-        if attn_impl == "ulysses" and not ulysses_ok:
-            raise ValueError(
-                f"attn_impl='ulysses' needs per-device head counts divisible "
-                f"by sp: n_heads={h}, n_kv_heads={hkv}, "
-                f"mesh={dict(mesh.shape)}"
-            )
-        # 'auto' on an sp mesh: ring for plain causal (O(S/sp) kv
-        # memory), ulysses for windowed attention when head counts
-        # permit (full local sequence -> the flash kernel's window
-        # block-skipping applies); ring handles windows too (banded
-        # mask on global positions), so it is the windowed fallback
-        # when ulysses can't split the heads.
-        use_ulysses = attn_impl == "ulysses" or (
-            attn_impl == "auto" and sp_active and cfg.attn_window is not None
-            and ulysses_ok
-        )
-        use_ring = attn_impl == "ring" or (
-            attn_impl == "auto" and sp_active and not use_ulysses
-        )
-        if use_ring:
-            # Sequence is sharded over sp: ring attention keeps kv local
-            # (O(S/sp) memory) and rotates chunks over ICI instead of
-            # letting GSPMD all-gather the whole sequence. Packed
-            # segment ids rotate with their kv chunks.
-            from shellac_tpu.parallel.ring_attention import ring_attention
-
-            o = ring_attention(
-                q, k, v, mesh, causal=cfg.causal, segments=segments,
-                window=cfg.attn_window,
-            )
-        elif use_ulysses:
-            from shellac_tpu.parallel.ulysses import ulysses_attention
-
-            o = ulysses_attention(
-                q, k, v, mesh, causal=cfg.causal, window=cfg.attn_window,
-                segments=segments,
-            )
-        else:
-            o = attention(
-                q, k, v, causal=cfg.causal, window=cfg.attn_window,
-                q_segments=segments, kv_segments=segments, impl=attn_impl,
-            )
+        o = _training_attention(cfg, mesh, attn_impl, q, k, v, segments)
     elif page_tables is not None:
         from shellac_tpu.inference.kvcache import (
             paged_gather_layer,
@@ -584,6 +529,71 @@ def _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache, moe_layer,
     return x, new_cache, moe_out
 
 
+def _training_attention(cfg, mesh, attn_impl, q, k, v, segments):
+    """Full-sequence attention with sequence-parallel dispatch.
+
+    q (B, S, H, D); k/v (B, S, Hkv, D'). Shared by the standard GQA
+    path and MLA's expanded form (there Hkv == H and v is padded to
+    q's width, so the default d**-0.5 scale is already the MLA scale).
+    """
+    h, hkv = q.shape[2], k.shape[2]
+    q = constrain(q, mesh, ("batch", "seq", "heads", None))
+    k = constrain(k, mesh, ("batch", "seq", "kv_heads", None))
+    v = constrain(v, mesh, ("batch", "seq", "kv_heads", None))
+    from shellac_tpu.parallel.mesh import AXIS_SEQ
+
+    sp_active = mesh is not None and mesh.shape.get(AXIS_SEQ, 1) > 1
+    if attn_impl in ("ring", "ulysses") and not sp_active:
+        raise ValueError(
+            f"attn_impl={attn_impl!r} requires a mesh with sp > 1; got "
+            f"mesh={'None' if mesh is None else dict(mesh.shape)}"
+        )
+    from shellac_tpu.parallel.ulysses import ulysses_supported
+
+    ulysses_ok = sp_active and ulysses_supported(h, hkv, mesh)
+    if attn_impl == "ulysses" and not ulysses_ok:
+        raise ValueError(
+            f"attn_impl='ulysses' needs per-device head counts divisible "
+            f"by sp: n_heads={h}, n_kv_heads={hkv}, "
+            f"mesh={dict(mesh.shape)}"
+        )
+    # 'auto' on an sp mesh: ring for plain causal (O(S/sp) kv
+    # memory), ulysses for windowed attention when head counts
+    # permit (full local sequence -> the flash kernel's window
+    # block-skipping applies); ring handles windows too (banded
+    # mask on global positions), so it is the windowed fallback
+    # when ulysses can't split the heads.
+    use_ulysses = attn_impl == "ulysses" or (
+        attn_impl == "auto" and sp_active and cfg.attn_window is not None
+        and ulysses_ok
+    )
+    use_ring = attn_impl == "ring" or (
+        attn_impl == "auto" and sp_active and not use_ulysses
+    )
+    if use_ring:
+        # Sequence is sharded over sp: ring attention keeps kv local
+        # (O(S/sp) memory) and rotates chunks over ICI instead of
+        # letting GSPMD all-gather the whole sequence. Packed
+        # segment ids rotate with their kv chunks.
+        from shellac_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(
+            q, k, v, mesh, causal=cfg.causal, segments=segments,
+            window=cfg.attn_window,
+        )
+    if use_ulysses:
+        from shellac_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, mesh, causal=cfg.causal, window=cfg.attn_window,
+            segments=segments,
+        )
+    return attention(
+        q, k, v, causal=cfg.causal, window=cfg.attn_window,
+        q_segments=segments, kv_segments=segments, impl=attn_impl,
+    )
+
+
 def _mla_attention(
     cfg: ModelConfig, mesh, attn_impl, hx, lp, cos, sin, cache,
     fresh_cache, segments, pdot,
@@ -609,10 +619,6 @@ def _mla_attention(
     b, s, _ = hx.shape
     h = cfg.n_heads
     scale = m.qk_head_dim ** -0.5
-    if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        raise NotImplementedError(
-            "MLA with sequence parallelism (sp > 1) is not wired yet"
-        )
 
     if m.q_lora_rank is None:
         q = pdot(hx, lp["wq"])
@@ -640,7 +646,9 @@ def _mla_attention(
     def expanded_attention():
         """Full-K/V form (training and fresh prefill): expand the
         latent per head, pad v up to the qk width so the flash kernel
-        applies, slice the pad back off."""
+        applies, slice the pad back off. Dispatches through the shared
+        sequence-parallel selection (ring/ulysses on sp meshes), where
+        the default q-width scale IS the MLA scale."""
         k_nope = jnp.einsum("bsr,rhn->bshn", c, w_bk)
         v = jnp.einsum("bsr,rhv->bshv", c, w_bv)
         k = jnp.concatenate(
@@ -650,10 +658,7 @@ def _mla_attention(
         qf = jnp.concatenate([q_nope, q_pe], axis=-1)
         pad = m.qk_head_dim - m.v_head_dim
         vp = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, pad)))
-        o = attention(
-            qf, k, vp, causal=True, scale=scale,
-            q_segments=segments, kv_segments=segments, impl=attn_impl,
-        )
+        o = _training_attention(cfg, mesh, attn_impl, qf, k, vp, segments)
         return o[..., : m.v_head_dim]
 
     if cache is None:

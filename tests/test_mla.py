@@ -49,6 +49,23 @@ class TestTraining:
             last = float(m["loss"])
         assert last < 0.1 * first, (first, last)
 
+    def test_ring_attention_parity(self, mesh8, model):
+        """MLA long-context training: the expanded attention dispatches
+        through ring attention on sp meshes and matches unsharded."""
+        cfg, params = model
+        toks = jax.random.randint(jax.random.PRNGKey(3), (2, 64), 0,
+                                  cfg.vocab_size)
+        want = transformer.forward(cfg, params, toks)
+        sharded = shard_params(cfg, params, mesh8)
+        got = jax.jit(
+            lambda p, t: transformer.forward(
+                cfg, p, t, mesh=mesh8, attn_impl="ring"
+            )
+        )(sharded, toks)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+        )
+
     def test_trains_on_fsdp_mesh(self, mesh_fsdp8, model):
         from shellac_tpu.training import (
             batch_shardings,
