@@ -700,7 +700,7 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--warmup-steps", type=int, dest="warmup_steps")
     t.add_argument("--weight-decay", type=float, dest="weight_decay")
     t.add_argument("--grad-accum", type=int, dest="grad_accum")
-    t.add_argument("--optimizer", choices=["adamw", "lion", "adafactor"])
+    t.add_argument("--optimizer", choices=["adamw", "lion", "adafactor", "muon"])
     t.add_argument("--quant", choices=["int8", "int8_bwd"], default=None,
                    help="quantized training compute (int8 MXU dots; "
                         "int8_bwd quantizes the backward matmuls too)")

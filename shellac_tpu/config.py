@@ -251,7 +251,9 @@ class TrainConfig:
     min_lr_ratio: float = 0.1
     warmup_steps: int = 100
     total_steps: int = 1000
-    # "adamw" (default), "lion", or "adafactor" (factored second moment).
+    # "adamw" (default), "lion", "adafactor" (factored second moment),
+    # or "muon" (Newton-Schulz-orthogonalized momentum on the stacked
+    # matrices, adamw for embeddings/head/norms; b1 is its momentum).
     optimizer: str = "adamw"
     weight_decay: float = 0.1
     b1: float = 0.9

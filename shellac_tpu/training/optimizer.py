@@ -6,6 +6,7 @@ axis rules shard first/second moments ZeRO-style for free.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -32,8 +33,6 @@ def _decay_mask(params):
     Stacked per-layer norm scales have shape (n_layers, d), so ndim alone
     cannot distinguish them — exempt anything whose path names a norm.
     """
-    import jax
-
     def mask(path, p):
         names = [str(getattr(e, "key", e)) for e in path]
         if any("norm" in n for n in names):
@@ -43,13 +42,54 @@ def _decay_mask(params):
     return jax.tree_util.tree_map_with_path(mask, params)
 
 
+def _muon_mask(params):
+    """muon for the stacked matrix parameters, adamw for the rest.
+
+    Stacking makes the rule crisp: per-layer matrices are ndim >= 3
+    ((L, in, out) / (L, E, in, out)), while norms/biases stack to (L, d)
+    and the embedding/lm_head are plain 2D — all excluded, matching the
+    Muon recipe (embeddings and head stay on adamw).
+    """
+    def label(path, p):
+        return "muon" if getattr(p, "ndim", 0) >= 3 else "adamw"
+
+    return jax.tree_util.tree_map_with_path(label, params)
+
+
+def _muon_dims(params):
+    """MuonDimensionNumbers per parameter: which axes form the matrix.
+
+    Stacked layouts orthogonalize the trailing two dims with everything
+    leading as vmapped batch axes — (L, in, out) and expert
+    (L, E, in, out) both fall out of `ndim-2 / ndim-1`. MLA's
+    wkv_b_k/wkv_b_v (L, kv_rank, heads, dh) are special: the REAL
+    matrix is kv_rank -> heads*dh, so the output axis is the (heads,
+    dh) pair, not the trailing dim alone.
+    """
+    from optax.contrib import MuonDimensionNumbers
+
+    def dims(path, p):
+        if getattr(p, "ndim", 0) < 3:
+            return None  # adamw-labelled; never reaches the muon branch
+        names = [str(getattr(e, "key", e)) for e in path]
+        if any(n in ("wkv_b_k", "wkv_b_v") for n in names):
+            return MuonDimensionNumbers(reduction_axis=1, output_axis=(2, 3))
+        return MuonDimensionNumbers(
+            reduction_axis=p.ndim - 2, output_axis=p.ndim - 1
+        )
+
+    return jax.tree_util.tree_map_with_path(dims, params)
+
+
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    """adamw (default), lion, or adafactor, per cfg.optimizer.
+    """adamw (default), lion, adafactor, or muon, per cfg.optimizer.
 
     All share the clip → scale → decoupled weight decay → schedule
     chain, so state sharding and the train step are optimizer-agnostic.
     adafactor's factored second moment cuts optimizer HBM from 2x params
-    to ~1x (+ O(rows+cols)); lion keeps only a bf16 momentum.
+    to ~1x (+ O(rows+cols)); lion keeps only a bf16 momentum; muon
+    orthogonalizes momentum for the stacked matrices (b1 is its
+    momentum) with adamw handling embeddings/head/norms.
     """
     if cfg.optimizer == "adamw":
         scaler = optax.scale_by_adam(
@@ -62,10 +102,32 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         )
     elif cfg.optimizer == "adafactor":
         scaler = optax.scale_by_factored_rms(decay_rate=cfg.b2)
+    elif cfg.optimizer == "muon":
+        # optax.contrib's Muon: EMA momentum + quintic Newton-Schulz
+        # orthogonalization + sqrt(max(1, m/n)) shape factor, with
+        # dimension numbers vmapping our stacked layer/expert axes.
+        # b1 is the momentum; embeddings/head/norms ride adamw.
+        from optax.contrib import scale_by_muon
+
+        scaler = optax.multi_transform(
+            {
+                "muon": scale_by_muon(
+                    beta=cfg.b1,
+                    mu_dtype=resolve_dtype(cfg.mu_dtype),
+                    nesterov=True,
+                    weight_dimension_numbers=_muon_dims,
+                ),
+                "adamw": optax.scale_by_adam(
+                    b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                    mu_dtype=resolve_dtype(cfg.mu_dtype),
+                ),
+            },
+            _muon_mask,
+        )
     else:
         raise ValueError(
             f"unknown optimizer {cfg.optimizer!r}; "
-            "have adamw, lion, adafactor"
+            "have adamw, lion, adafactor, muon"
         )
     return optax.chain(
         optax.clip_by_global_norm(cfg.grad_clip_norm),
