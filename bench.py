@@ -59,6 +59,9 @@ def main(argv=None):
                     dest="fused_loss", metavar="CHUNK",
                     help="vocab-chunked fused cross-entropy")
     ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--preset", default=None,
+                    help="model preset override (default: shellac-1b on "
+                         "TPU; e.g. shellac-mla-2b for the MLA bench)")
     args = ap.parse_args(argv)
 
     if not tpu_usable():
@@ -78,10 +81,13 @@ def main(argv=None):
     if on_tpu:
         # Batch 6 is the single-chip sweet spot with bf16 adam mu and the
         # Pallas flash backward (batch 8 fits but is marginally slower).
-        cfg = get_model_config("shellac-1b")
+        cfg = get_model_config(args.preset or "shellac-1b")
         batch, seq, steps = 6, 2048, 10
+        if args.preset == "shellac-mla-2b":
+            # 2.4B params at seq 2048: batch 4 fits comfortably.
+            batch = 4
     else:
-        cfg = get_model_config("tiny")
+        cfg = get_model_config(args.preset or "tiny")
         batch, seq, steps = 4, 128, 3
 
     if args.batch is not None:

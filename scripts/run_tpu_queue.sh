@@ -56,8 +56,10 @@ run engine_prefix 580 python scripts/bench_decode.py --mode prefix
 run train_plain 580 python bench.py
 run train_packed 580 python bench.py --packed
 run train_int8 580 python bench.py --quant int8
+run train_int8_bwd 580 python bench.py --quant int8_bwd
 run train_fused 580 python bench.py --fused-loss 4096
 run train_fused_b8 580 python bench.py --fused-loss 4096 --batch 8
+run train_mla 580 python bench.py --preset shellac-mla-2b
 
 # 5. Remat-policy sweep (each config its own process; OOM is informative).
 for b in 4 6 8; do
