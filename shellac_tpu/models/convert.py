@@ -325,12 +325,13 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
     models export to the Mixtral naming (block_sparse_moe); shared
     experts have no HF counterpart and are refused.
     """
-    if cfg.mla is not None:
-        raise NotImplementedError(
-            "MLA export to the DeepSeek state_dict is not wired yet "
-            "(kv_b_proj re-fusion); import direction is supported"
-        )
     moe = cfg.moe is not None
+    if cfg.mla is not None and moe:
+        raise NotImplementedError(
+            "MLA + MoE export would mix DeepSeek attention names with "
+            "Mixtral MLP names — no HF architecture loads that; "
+            "dense-MLP MLA models export fine"
+        )
     if moe and cfg.moe.num_shared_experts > 0:
         raise NotImplementedError(
             "shared experts have no HF (Mixtral) state_dict equivalent"
@@ -351,9 +352,35 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
     layers = params["layers"]
     for i in range(cfg.n_layers):
         base = f"model.layers.{i}."
-        for ours, (theirs, transpose) in _ATTN_MAP.items():
-            w = np_(layers[ours][i])
-            sd[base + theirs] = w.T if transpose else w
+        if cfg.mla is not None:
+            # Re-fuse the split expansions into HF's single kv_b_proj:
+            # (kv_rank, H, nope) ++ (kv_rank, H, v) -> (H*(nope+v), rank).
+            m = cfg.mla
+            a = base + "self_attn."
+            sd[a + "kv_a_proj_with_mqa.weight"] = np_(layers["wkv_a"][i]).T
+            sd[a + "kv_a_layernorm.weight"] = (
+                np_(layers["kv_a_norm"][i]) + 1.0
+            )
+            kv_b = np.concatenate(
+                [np_(layers["wkv_b_k"][i]), np_(layers["wkv_b_v"][i])],
+                axis=-1,
+            )  # (kv_rank, H, nope + v)
+            sd[a + "kv_b_proj.weight"] = kv_b.reshape(
+                m.kv_lora_rank, -1
+            ).T
+            sd[a + "o_proj.weight"] = np_(layers["wo"][i]).T
+            if m.q_lora_rank is None:
+                sd[a + "q_proj.weight"] = np_(layers["wq"][i]).T
+            else:
+                sd[a + "q_a_proj.weight"] = np_(layers["wq_a"][i]).T
+                sd[a + "q_a_layernorm.weight"] = (
+                    np_(layers["q_a_norm"][i]) + 1.0
+                )
+                sd[a + "q_b_proj.weight"] = np_(layers["wq_b"][i]).T
+        else:
+            for ours, (theirs, transpose) in _ATTN_MAP.items():
+                w = np_(layers[ours][i])
+                sd[base + theirs] = w.T if transpose else w
         if cfg.attn_bias:
             for ours, theirs in _BIAS_MAP.items():
                 sd[base + theirs] = np_(layers[ours][i])

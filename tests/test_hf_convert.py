@@ -123,6 +123,27 @@ def test_deepseek_greedy_generation_parity():
     np.testing.assert_array_equal(np.asarray(out.tokens), ref)
 
 
+@pytest.mark.parametrize("q_lora_rank", [None, 24])
+def test_deepseek_export_roundtrip(q_lora_rank):
+    """jax -> DeepSeek state_dict -> torch logits match ours exactly
+    (the kv_b_proj re-fusion must invert the import split)."""
+    from shellac_tpu.models.convert import to_state_dict
+
+    model = _tiny_deepseek(q_lora_rank=q_lora_rank)
+    cfg, params = from_hf(model)
+    cfg = cfg.replace(dtype="float32")
+    sd = {k: torch.from_numpy(v) for k, v in to_state_dict(cfg, params).items()}
+    model2 = _tiny_deepseek(q_lora_rank=q_lora_rank)
+    model2.load_state_dict(sd)
+    tokens = np.array([[4, 9, 77, 23, 5]], np.int64)
+    with torch.no_grad():
+        ref = model2(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
 def test_deepseek_moe_conversion_rejected():
     cfg = transformers.DeepseekV2Config(
         vocab_size=64, hidden_size=32, num_hidden_layers=2,
