@@ -50,6 +50,28 @@ class MoEConfig:
 
 
 @dataclass(frozen=True)
+class YarnConfig:
+    """Yarn rope scaling (NTK-by-parts context extension).
+
+    Matches the HF `rope_scaling: {"rope_type": "yarn", ...}` semantics
+    exactly (transformers._compute_yarn_parameters): low frequencies
+    interpolate by `factor`, high frequencies extrapolate, a linear ramp
+    between the beta_fast/beta_slow rotation bounds blends them, and the
+    cos/sin tables are multiplied by an attention factor (mscale).
+    DeepSeek's long-context checkpoints ship with this.
+    """
+
+    factor: float
+    original_max_position_embeddings: int
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    mscale: Optional[float] = None
+    mscale_all_dim: Optional[float] = None
+    attention_factor: Optional[float] = None
+    truncate: bool = True
+
+
+@dataclass(frozen=True)
 class MLAConfig:
     """Multi-head latent attention (DeepSeek-V2/V3 style).
 
@@ -127,6 +149,11 @@ class ModelConfig:
     # is shared MQA-style) and head_dim is ignored in favour of the
     # MLA dims.
     mla: Optional[MLAConfig] = None
+    # Yarn rope scaling for long-context checkpoints (applies to the
+    # rope_dim — MLA's qk_rope slice or the full head_dim).
+    rope_yarn: Optional[YarnConfig] = None
+    # Per-head-dim RMSNorm on q and k before rope (Qwen3-style).
+    qk_norm: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -207,6 +234,8 @@ class ModelConfig:
                 raise ValueError("MLA is decoder-only (causal=True)")
             if self.mla.qk_rope_head_dim % 2:
                 raise ValueError("qk_rope_head_dim must be even (rope pairs)")
+            if self.qk_norm:
+                raise ValueError("qk_norm does not apply to MLA models")
         return self
 
     def replace(self, **kw) -> "ModelConfig":

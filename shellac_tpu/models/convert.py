@@ -53,6 +53,7 @@ def config_from_hf(hf_cfg) -> ModelConfig:
             ),
             dropless=True,
         )
+    is_qwen3 = getattr(hf_cfg, "model_type", "") == "qwen3"
     return ModelConfig(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,
@@ -72,10 +73,18 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         activation="geglu" if is_gemma else "swiglu",
         embed_scale=is_gemma,
         # Qwen2 puts biases on q/k/v (detected from the config flag
-        # where present, else model type).
+        # where present, else model type); Qwen3 dropped the biases in
+        # favour of per-head-dim q/k RMSNorm.
         attn_bias=bool(
             getattr(hf_cfg, "attention_bias", False)
             or getattr(hf_cfg, "model_type", "") == "qwen2"
+        ),
+        qk_norm=is_qwen3,
+        # Long-context checkpoints: yarn converts exactly; any other
+        # rope_scaling type fails loudly instead of silently diverging.
+        rope_yarn=_yarn_from_hf(
+            getattr(hf_cfg, "rope_scaling", None),
+            hf_cfg.max_position_embeddings,
         ),
     ).validate()
 
@@ -84,10 +93,10 @@ def _deepseek_config(hf_cfg) -> ModelConfig:
     """DeepSeek-V2/V3 (MLA) config mapping.
 
     Supported today: dense-MLP stacks (first_k_dense_replace covering
-    every layer) with default rope. The MoE side of DeepSeek uses
-    grouped/limited routing our router does not reproduce bit-exactly
-    yet, and yarn rope scaling is not implemented — both fail loudly
-    rather than converting approximately.
+    every layer), with default or yarn rope (the long-context configs).
+    The MoE side of DeepSeek uses grouped/limited routing our router
+    does not reproduce bit-exactly yet — it fails loudly rather than
+    converting approximately, as do non-yarn rope_scaling types.
     """
     from shellac_tpu.config import MLAConfig
 
@@ -97,8 +106,6 @@ def _deepseek_config(hf_cfg) -> ModelConfig:
             "use group-limited routing; only dense-MLP DeepSeek configs "
             "convert exactly today"
         )
-    if getattr(hf_cfg, "rope_scaling", None):
-        raise NotImplementedError("DeepSeek yarn rope scaling not supported")
     if getattr(hf_cfg, "attention_bias", False):
         raise NotImplementedError(
             "DeepSeek attention_bias=True is not supported; converting "
@@ -121,7 +128,41 @@ def _deepseek_config(hf_cfg) -> ModelConfig:
             qk_rope_head_dim=hf_cfg.qk_rope_head_dim,
             v_head_dim=hf_cfg.v_head_dim,
         ),
+        rope_yarn=_yarn_from_hf(
+            getattr(hf_cfg, "rope_scaling", None),
+            hf_cfg.max_position_embeddings,
+        ),
     ).validate()
+
+
+def _yarn_from_hf(rs, max_pos) -> "Optional[object]":
+    """YarnConfig from an HF rope_scaling dict (None passes through).
+
+    DeepSeek's long-context checkpoints ship
+    {"rope_type": "yarn", factor, original_max_position_embeddings,
+    mscale, mscale_all_dim, ...}; other scaling types fail loudly.
+    """
+    if not rs:
+        return None
+    from shellac_tpu.config import YarnConfig
+
+    kind = rs.get("rope_type", rs.get("type"))
+    if kind != "yarn":
+        raise NotImplementedError(
+            f"rope_scaling type {kind!r} is not supported (have: yarn)"
+        )
+    return YarnConfig(
+        factor=rs["factor"],
+        original_max_position_embeddings=rs.get(
+            "original_max_position_embeddings"
+        ) or max_pos,
+        beta_fast=rs.get("beta_fast") or 32.0,
+        beta_slow=rs.get("beta_slow") or 1.0,
+        mscale=rs.get("mscale"),
+        mscale_all_dim=rs.get("mscale_all_dim"),
+        attention_factor=rs.get("attention_factor"),
+        truncate=rs.get("truncate", True),
+    )
 
 
 def _hf_attn_window(hf_cfg) -> Optional[int]:
@@ -263,6 +304,8 @@ def params_from_state_dict(
                       else ["wq_a", "q_a_norm", "wq_b"])
     else:
         attn_keys = list(_ATTN_MAP)
+        if cfg.qk_norm:
+            attn_keys += ["q_norm", "k_norm"]
     layers: Dict[str, list] = {
         k: []
         for k in [*attn_keys, *bias_keys, *mlp_keys, "attn_norm", "mlp_norm"]
@@ -275,6 +318,13 @@ def params_from_state_dict(
             for ours, (theirs, transpose) in _ATTN_MAP.items():
                 w = get(base + theirs)
                 layers[ours].append(w.T if transpose else w)
+            if cfg.qk_norm:
+                layers["q_norm"].append(
+                    get(base + "self_attn.q_norm.weight") + norm_offset
+                )
+                layers["k_norm"].append(
+                    get(base + "self_attn.k_norm.weight") + norm_offset
+                )
         for ours, theirs in (_BIAS_MAP.items() if cfg.attn_bias else ()):
             layers[ours].append(get(base + theirs))
         if moe:
@@ -381,6 +431,13 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
             for ours, (theirs, transpose) in _ATTN_MAP.items():
                 w = np_(layers[ours][i])
                 sd[base + theirs] = w.T if transpose else w
+            if cfg.qk_norm:
+                sd[base + "self_attn.q_norm.weight"] = (
+                    np_(layers["q_norm"][i]) + 1.0
+                )
+                sd[base + "self_attn.k_norm.weight"] = (
+                    np_(layers["k_norm"][i]) + 1.0
+                )
         if cfg.attn_bias:
             for ours, theirs in _BIAS_MAP.items():
                 sd[base + theirs] = np_(layers[ours][i])

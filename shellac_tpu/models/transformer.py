@@ -156,6 +156,11 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 "wo": dense(ks[3], (h * dh, d), h * dh, out_scale),
                 "mlp_norm": jnp.zeros((d,), pdt),
             }
+            if cfg.qk_norm:
+                p.update({
+                    "q_norm": jnp.zeros((dh,), pdt),
+                    "k_norm": jnp.zeros((dh,), pdt),
+                })
         if cfg.attn_bias:
             p.update({
                 "bq": jnp.zeros((h * dh,), pdt),
@@ -266,6 +271,11 @@ def _layer_axes(cfg: ModelConfig, moe_layer: bool, lead=("layers",)) -> dict:
             "wv": (*lead, "embed", "kv_heads"),
             "wo": (*lead, "heads", "embed"),
         }
+        if cfg.qk_norm:
+            attn_axes.update({
+                "q_norm": (*lead, None),
+                "k_norm": (*lead, None),
+            })
     return {
         "attn_norm": (*lead, None),
         **attn_axes,
@@ -407,6 +417,10 @@ def _block(
     q = q.reshape(b, s, h, dh)
     k = k.reshape(b, s, hkv, dh)
     v = v.reshape(b, s, hkv, dh)
+    if cfg.qk_norm:
+        # Qwen3-style per-head-dim RMSNorm on q/k, applied before rope.
+        q = rms_norm(q, lp["q_norm"], cfg.norm_eps).astype(cdt)
+        k = rms_norm(k, lp["k_norm"], cfg.norm_eps).astype(cdt)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     new_cache = None
@@ -740,7 +754,8 @@ def forward(
             pos = segment_positions(segment_ids)
         else:
             pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    cos, sin = rope_angles(pos, cfg.rope_dim, cfg.rope_theta)
+    cos, sin = rope_angles(pos, cfg.rope_dim, cfg.rope_theta,
+                           yarn=cfg.rope_yarn)
 
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))
@@ -992,7 +1007,8 @@ def forward_with_cache(
     positions = index[:, None] + jnp.broadcast_to(
         jnp.arange(s, dtype=jnp.int32), (b, s)
     )
-    cos, sin = rope_angles(positions, cfg.rope_dim, cfg.rope_theta)
+    cos, sin = rope_angles(positions, cfg.rope_dim, cfg.rope_theta,
+                           yarn=cfg.rope_yarn)
 
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))

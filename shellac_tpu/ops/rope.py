@@ -11,18 +11,78 @@ import jax
 import jax.numpy as jnp
 
 
-def rope_angles(positions: jax.Array, head_dim: int, theta: float = 10000.0):
+def rope_angles(positions: jax.Array, head_dim: int, theta: float = 10000.0,
+                yarn=None):
     """cos/sin tables for given absolute positions.
 
     positions: int32 array, any shape (typically (B, S) or (S,)).
     Returns (cos, sin) with shape positions.shape + (head_dim // 2,), fp32.
+    With a YarnConfig the inverse frequencies blend interpolation and
+    extrapolation per the NTK-by-parts recipe and the tables carry the
+    attention (mscale) factor — numerics match HF's yarn rope exactly.
     """
     half = head_dim // 2
-    freq = 1.0 / (
-        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
-    )
+    if yarn is None:
+        freq = 1.0 / (
+            theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+        )
+        scale = 1.0
+    else:
+        freq, scale = _yarn_inv_freq(head_dim, theta, yarn)
     ang = positions.astype(jnp.float32)[..., None] * freq
-    return jnp.cos(ang), jnp.sin(ang)
+    return jnp.cos(ang) * scale, jnp.sin(ang) * scale
+
+
+def _yarn_inv_freq(dim: int, base: float, yarn):
+    """Yarn inverse frequencies + attention factor (static, numpy).
+
+    Mirrors transformers' _compute_yarn_parameters step for step so
+    converted long-context checkpoints (e.g. DeepSeek) reproduce HF
+    logits exactly.
+    """
+    import math
+
+    import numpy as np
+
+    factor = yarn.factor
+    attention_factor = yarn.attention_factor
+
+    def get_mscale(scale, mscale=1.0):
+        if scale <= 1:
+            return 1.0
+        return 0.1 * mscale * math.log(scale) + 1.0
+
+    if attention_factor is None:
+        if yarn.mscale and yarn.mscale_all_dim:
+            attention_factor = float(
+                get_mscale(factor, yarn.mscale)
+                / get_mscale(factor, yarn.mscale_all_dim)
+            )
+        else:
+            attention_factor = get_mscale(factor)
+
+    def correction_dim(num_rot):
+        return (dim * math.log(
+            yarn.original_max_position_embeddings / (num_rot * 2 * math.pi)
+        )) / (2 * math.log(base))
+
+    low = correction_dim(yarn.beta_fast)
+    high = correction_dim(yarn.beta_slow)
+    if yarn.truncate:
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
+    if low == high:
+        high += 0.001
+
+    pos_freqs = base ** (np.arange(0, dim, 2, dtype=np.float32) / dim)
+    extrap = 1.0 / pos_freqs
+    interp = 1.0 / (factor * pos_freqs)
+    ramp = np.clip(
+        (np.arange(dim // 2, dtype=np.float32) - low) / (high - low), 0, 1
+    )
+    extrap_factor = 1.0 - ramp
+    inv_freq = interp * (1 - extrap_factor) + extrap * extrap_factor
+    return jnp.asarray(inv_freq, jnp.float32), float(attention_factor)
 
 
 def apply_rope_interleaved(

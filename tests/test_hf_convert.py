@@ -144,6 +144,140 @@ def test_deepseek_export_roundtrip(q_lora_rank):
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
 
 
+def test_deepseek_yarn_logits_parity():
+    """Yarn rope scaling (the long-context DeepSeek config) converts
+    with exact logits parity — inv_freq blending AND the mscale
+    attention factor both have to match HF."""
+    cfg = transformers.DeepseekV2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4,
+        kv_lora_rank=32, q_lora_rank=None, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, head_dim=8,
+        first_k_dense_replace=2, n_routed_experts=None,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        attn_implementation="eager", attention_bias=False,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 4.0,
+            "original_max_position_embeddings": 64,
+            "mscale": 0.707, "mscale_all_dim": 0.707,
+            "beta_fast": 32, "beta_slow": 1,
+        },
+    )
+    torch.manual_seed(2)
+    model = transformers.DeepseekV2ForCausalLM(cfg).eval()
+    ours_cfg, params = from_hf(model)
+    ours_cfg = ours_cfg.replace(dtype="float32")
+    assert ours_cfg.rope_yarn is not None
+    assert ours_cfg.rope_yarn.factor == 4.0
+
+    tokens = np.array([[3, 17, 42, 99, 7, 23, 56, 1, 88, 4]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(ours_cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def _tiny_qwen3(tie=False):
+    cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=tie,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    return transformers.Qwen3ForCausalLM(cfg).eval()
+
+
+def test_qwen3_logits_parity():
+    """Qwen3 (GQA + per-head-dim q/k RMSNorm before rope) exact parity."""
+    model = _tiny_qwen3()
+    cfg, params = from_hf(model)
+    cfg = cfg.replace(dtype="float32")
+    assert cfg.qk_norm and not cfg.attn_bias
+
+    tokens = np.array([[3, 17, 42, 99, 7, 23, 56, 1]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_qwen3_yarn_logits_parity():
+    """Yarn flows through the GENERIC conversion path too (long-context
+    Qwen3 checkpoints ship it), not just DeepSeek's."""
+    cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        rope_theta=10000.0, attn_implementation="eager",
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 64},
+    )
+    torch.manual_seed(4)
+    model = transformers.Qwen3ForCausalLM(cfg).eval()
+    ours_cfg, params = from_hf(model)
+    ours_cfg = ours_cfg.replace(dtype="float32")
+    assert ours_cfg.rope_yarn is not None
+    tokens = np.array([[3, 17, 42, 99, 7, 23]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(ours_cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_unsupported_rope_scaling_rejected():
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2,
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+    )
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        config_from_hf(cfg)
+
+
+def test_qwen3_generation_and_export():
+    """Token-exact greedy generation through the cache, and the export
+    round-trips (q_norm/k_norm included)."""
+    from shellac_tpu.inference.engine import Engine
+    from shellac_tpu.models.convert import to_state_dict
+
+    model = _tiny_qwen3()
+    cfg, params = from_hf(model)
+    cfg = cfg.replace(dtype="float32")
+    prompt = np.array([[5, 9, 2, 31]], np.int64)
+    with torch.no_grad():
+        ref = model.generate(
+            torch.from_numpy(prompt), max_new_tokens=10, do_sample=False,
+        ).numpy()[:, prompt.shape[1]:]
+    out = Engine(cfg, params, temperature=0.0, max_len=64).generate(
+        jnp.asarray(prompt, jnp.int32), max_new_tokens=10
+    )
+    np.testing.assert_array_equal(np.asarray(out.tokens), ref)
+
+    sd = {k: torch.from_numpy(v)
+          for k, v in to_state_dict(cfg, params).items()}
+    model2 = _tiny_qwen3()
+    model2.load_state_dict(sd)
+    toks = np.array([[4, 9, 77]], np.int64)
+    with torch.no_grad():
+        ref2 = model2(torch.from_numpy(toks)).logits.numpy()
+    ours2 = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(toks, jnp.int32))
+    )
+    np.testing.assert_allclose(ours2, ref2, atol=2e-4, rtol=2e-3)
+
+
 def test_deepseek_moe_conversion_rejected():
     cfg = transformers.DeepseekV2Config(
         vocab_size=64, hidden_size=32, num_hidden_layers=2,
