@@ -45,8 +45,24 @@ class MoEConfig:
     # training.
     dropless: bool = False
     # DeepSeek-style always-active shared experts: one fused FFN of
-    # hidden size num_shared_experts * ff_dim added to the routed output.
+    # hidden size num_shared_experts * expert ff width added to the
+    # routed output.
     num_shared_experts: int = 0
+    # Expert FFN hidden width; None = the model's ff_dim. DeepSeek MoE
+    # layers use a much narrower per-expert width than dense layers
+    # (moe_intermediate_size).
+    d_ff_expert: Optional[int] = None
+    # Renormalize the kept top-k probabilities to sum to 1. DeepSeek-V2
+    # ships norm_topk_prob=False: raw softmax probabilities are used,
+    # scaled by routed_scaling_factor.
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
+    # Group-limited routing (DeepSeek-V2/V3 big variants): experts are
+    # split into n_group groups, the top `topk_group` groups by max
+    # score stay live, and top-k selects within them. n_group=1
+    # disables.
+    n_group: int = 1
+    topk_group: int = 1
 
 
 @dataclass(frozen=True)
@@ -139,6 +155,9 @@ class ModelConfig:
     # If set, every `moe_every`-th layer is a MoE layer (1 = all layers).
     moe: Optional[MoEConfig] = None
     moe_every: int = 1
+    # DeepSeek layout: the first k layers run dense MLPs, every later
+    # layer is MoE. Mutually exclusive with moe_every > 1.
+    first_k_dense: int = 0
     logit_softcap: Optional[float] = None
     # Quantized training compute: "int8" runs the dense projections as
     # int8 MXU dots (fwd only; fp32 master params untouched). Usually
@@ -215,6 +234,30 @@ class ModelConfig:
             )
         if self.moe is not None and self.moe_every < 1:
             raise ValueError("moe_every must be >= 1")
+        if self.first_k_dense:
+            if self.moe is None:
+                raise ValueError("first_k_dense needs a MoEConfig")
+            if self.moe_every > 1:
+                raise ValueError(
+                    "first_k_dense and moe_every > 1 are different "
+                    "layouts; pick one"
+                )
+            if not 0 < self.first_k_dense < self.n_layers:
+                raise ValueError(
+                    f"first_k_dense={self.first_k_dense} must be in "
+                    f"(0, n_layers={self.n_layers})"
+                )
+        if self.moe is not None and self.moe.n_group > 1:
+            if self.moe.num_experts % self.moe.n_group:
+                raise ValueError(
+                    f"num_experts={self.moe.num_experts} must divide "
+                    f"into n_group={self.moe.n_group} groups"
+                )
+            if not 1 <= self.moe.topk_group <= self.moe.n_group:
+                raise ValueError(
+                    f"topk_group={self.moe.topk_group} must be in "
+                    f"[1, n_group={self.moe.n_group}]"
+                )
         if self.quant_training not in (None, "int8", "int8_bwd"):
             raise ValueError(
                 f"quant_training={self.quant_training!r}; "

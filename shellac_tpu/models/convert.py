@@ -92,19 +92,65 @@ def config_from_hf(hf_cfg) -> ModelConfig:
 def _deepseek_config(hf_cfg) -> ModelConfig:
     """DeepSeek-V2/V3 (MLA) config mapping.
 
-    Supported today: dense-MLP stacks (first_k_dense_replace covering
-    every layer), with default or yarn rope (the long-context configs).
-    The MoE side of DeepSeek uses grouped/limited routing our router
-    does not reproduce bit-exactly yet — it fails loudly rather than
-    converting approximately, as do non-yarn rope_scaling types.
+    Supports the full architecture: MLA attention (with optional yarn
+    rope), the first-k-dense layer layout, and the MoE variants —
+    softmax scoring with greedy or group-limited top-k, un-normalized
+    top-k probabilities scaled by routed_scaling_factor, narrow
+    per-expert FFNs (moe_intermediate_size), and shared experts.
+    Unrepresentable knobs (sigmoid scoring, per-layer MoE frequency,
+    non-yarn rope scaling, attention biases) fail loudly rather than
+    converting approximately.
     """
-    from shellac_tpu.config import MLAConfig
+    from shellac_tpu.config import MLAConfig, MoEConfig
 
-    if getattr(hf_cfg, "first_k_dense_replace", 0) < hf_cfg.num_hidden_layers:
+    n_layers = hf_cfg.num_hidden_layers
+    first_k = getattr(hf_cfg, "first_k_dense_replace", n_layers)
+    moe = None
+    if first_k < n_layers and getattr(hf_cfg, "n_routed_experts", None):
+        if getattr(hf_cfg, "scoring_func", "softmax") != "softmax":
+            raise NotImplementedError(
+                f"DeepSeek scoring_func="
+                f"{hf_cfg.scoring_func!r} (have: softmax)"
+            )
+        if getattr(hf_cfg, "topk_method", "greedy") not in (
+            "greedy", "group_limited_greedy",
+        ):
+            raise NotImplementedError(
+                f"DeepSeek topk_method={hf_cfg.topk_method!r}"
+            )
+        if getattr(hf_cfg, "moe_layer_freq", 1) != 1:
+            raise NotImplementedError(
+                "moe_layer_freq != 1 is not representable by the "
+                "first_k_dense layout"
+            )
+        if first_k == 0:
+            raise NotImplementedError(
+                "all-MoE DeepSeek (first_k_dense_replace=0) conversion "
+                "is not wired; every published checkpoint keeps >= 1 "
+                "dense layer"
+            )
+        grouped = hf_cfg.topk_method == "group_limited_greedy"
+        moe = MoEConfig(
+            num_experts=hf_cfg.n_routed_experts,
+            num_experts_per_token=hf_cfg.num_experts_per_tok,
+            d_ff_expert=hf_cfg.moe_intermediate_size,
+            num_shared_experts=getattr(hf_cfg, "n_shared_experts", 0) or 0,
+            # HF's DeepseekV2 gate NEVER renormalizes the kept top-k
+            # probabilities (the config flag is unused in its forward),
+            # so matching HF's actual compute means False regardless of
+            # what the checkpoint's config claims.
+            norm_topk_prob=False,
+            routed_scaling_factor=float(
+                getattr(hf_cfg, "routed_scaling_factor", 1.0)
+            ),
+            n_group=(getattr(hf_cfg, "n_group", 1) or 1) if grouped else 1,
+            topk_group=(getattr(hf_cfg, "topk_group", 1) or 1)
+            if grouped else 1,
+            dropless=True,
+        )
+    elif first_k < n_layers:
         raise NotImplementedError(
-            "DeepSeek MoE layers (first_k_dense_replace < num layers) "
-            "use group-limited routing; only dense-MLP DeepSeek configs "
-            "convert exactly today"
+            "first_k_dense_replace set but n_routed_experts missing"
         )
     if getattr(hf_cfg, "attention_bias", False):
         raise NotImplementedError(
@@ -128,6 +174,8 @@ def _deepseek_config(hf_cfg) -> ModelConfig:
             qk_rope_head_dim=hf_cfg.qk_rope_head_dim,
             v_head_dim=hf_cfg.v_head_dim,
         ),
+        moe=moe,
+        first_k_dense=first_k if moe is not None else 0,
         rope_yarn=_yarn_from_hf(
             getattr(hf_cfg, "rope_scaling", None),
             hf_cfg.max_position_embeddings,
@@ -289,6 +337,8 @@ def params_from_state_dict(
             )
         return _to_np(sd[key])
 
+    if cfg.first_k_dense:
+        return _first_k_params(cfg, get, sd, pdt, norm_offset)
     moe = cfg.moe is not None
     if moe and cfg.moe_every > 1:
         raise NotImplementedError(
@@ -366,6 +416,74 @@ def params_from_state_dict(
     return params
 
 
+def _first_k_params(cfg, get, sd, pdt, norm_offset):
+    """DeepSeek first-k-dense checkpoint -> two-stack layer tree.
+
+    Dense prefix layers carry plain MLPs (mlp.gate_proj...); MoE layers
+    carry the router (mlp.gate.weight, stored (E, D) in HF), narrow
+    per-expert FFNs (mlp.experts.{j}...), and optional shared experts
+    (mlp.shared_experts...). Attention is MLA on every layer.
+    """
+    m = cfg.mla
+    if m is None:
+        raise NotImplementedError(
+            "first_k_dense conversion is wired for MLA (DeepSeek) "
+            "checkpoints only"
+        )
+
+    def collect(layer_range, moe_layer):
+        from collections import defaultdict
+
+        stacks: Dict[str, list] = defaultdict(list)
+        put = lambda key, val: stacks[key].append(val)  # noqa: E731
+
+        for i in layer_range:
+            base = f"layers.{i}."
+            _collect_mla_layer(stacks, m, get, base, norm_offset)
+            put("attn_norm",
+                get(base + "input_layernorm.weight") + norm_offset)
+            put("mlp_norm",
+                get(base + "post_attention_layernorm.weight") + norm_offset)
+            if not moe_layer:
+                for ours, (theirs, _) in _DENSE_MLP_MAP.items():
+                    put(ours, get(base + theirs).T)
+            else:
+                put("w_router", get(base + "mlp.gate.weight").T)  # (D, E)
+                for ours, proj in (("w_gate", "gate_proj"),
+                                   ("w_up", "up_proj"),
+                                   ("w_down", "down_proj")):
+                    put(ours, np.stack([
+                        get(base + f"mlp.experts.{j}.{proj}.weight").T
+                        for j in range(cfg.moe.num_experts)
+                    ]))
+                if cfg.moe.num_shared_experts > 0:
+                    for ours, proj in (
+                        ("w_gate_shared", "gate_proj"),
+                        ("w_up_shared", "up_proj"),
+                        ("w_down_shared", "down_proj"),
+                    ):
+                        put(ours, get(
+                            base + f"mlp.shared_experts.{proj}.weight"
+                        ).T)
+        return {k: jnp.asarray(np.stack(v), pdt) for k, v in stacks.items()}
+
+    kk = cfg.first_k_dense
+    params: Dict[str, Any] = {
+        "embed": jnp.asarray(get("embed_tokens.weight"), pdt),
+        "layers": {
+            "dense": collect(range(kk), False),
+            "moe": collect(range(kk, cfg.n_layers), True),
+        },
+        "final_norm": jnp.asarray(get("norm.weight") + norm_offset, pdt),
+    }
+    if not cfg.tie_embeddings:
+        lm_head = sd.get("lm_head.weight")
+        if lm_head is None:
+            raise KeyError("untied config but no lm_head.weight in state_dict")
+        params["lm_head"] = jnp.asarray(_to_np(lm_head).T, pdt)
+    return params
+
+
 def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
     """Inverse of params_from_state_dict (Llama/Mistral/Mixtral-style).
 
@@ -390,6 +508,11 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
         raise NotImplementedError(
             "interleaved dense/MoE stacks (moe_every > 1) have no HF "
             "(Mixtral) state_dict equivalent"
+        )
+    if cfg.first_k_dense:
+        raise NotImplementedError(
+            "first_k_dense export is not wired yet (two-stack tree); "
+            "import direction is supported"
         )
 
     def np_(x):

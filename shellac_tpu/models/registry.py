@@ -33,6 +33,29 @@ PRESETS = {
                             mla=MLAConfig(kv_lora_rank=32, q_lora_rank=24,
                                           qk_nope_head_dim=16,
                                           qk_rope_head_dim=8, v_head_dim=16)),
+    # The full DeepSeek-V2 shape in miniature: MLA + first-k-dense +
+    # narrow routed experts + a shared expert, un-normalized scaled
+    # top-k routing.
+    "tiny-deepseek": ModelConfig(vocab_size=256, d_model=64, n_layers=3,
+                                 n_heads=4, max_seq_len=128, remat=False,
+                                 mla=MLAConfig(kv_lora_rank=32,
+                                               q_lora_rank=24,
+                                               qk_nope_head_dim=16,
+                                               qk_rope_head_dim=8,
+                                               v_head_dim=16),
+                                 first_k_dense=1,
+                                 moe=MoEConfig(num_experts=4,
+                                               num_experts_per_token=2,
+                                               d_ff_expert=48,
+                                               num_shared_experts=1,
+                                               norm_topk_prob=False,
+                                               routed_scaling_factor=1.0,
+                                               # DeepSeek computes every
+                                               # routed token (and only
+                                               # dropless MoE keeps the
+                                               # serving parity invariant
+                                               # under prompt padding).
+                                               dropless=True)),
     # DeepSeek-V2-Lite shape, dense-MLP variant (MLA decode cache:
     # 576 per token vs 16*(192+128) = 5120 expanded — an 8.9x shrink).
     "shellac-mla-2b": ModelConfig(vocab_size=32768, d_model=2048,

@@ -57,6 +57,18 @@ def is_grouped_layers(layers) -> bool:
     return set(layers.keys()) == {"dense", "moe"}
 
 
+def first_k_layout(cfg: ModelConfig) -> bool:
+    """True for the DeepSeek layout: a dense prefix then all-MoE.
+
+    Params hold the same {"dense", "moe"} two-stack tree as the
+    interleaved layout (so quantization/LoRA/sharding reuse applies
+    unchanged), but the stacks are (first_k_dense, ...) and
+    (n_layers - first_k_dense, ...) flat layer axes scanned back to
+    back, not per-group sub-stacks.
+    """
+    return cfg.moe is not None and cfg.first_k_dense > 0
+
+
 def _add_aux(a, b):
     return jax.tree.map(lambda u, v: u + v, a, b)
 
@@ -175,14 +187,15 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             })
         else:
             e = cfg.moe.num_experts
+            fe = cfg.moe.d_ff_expert or f
             p.update({
                 "w_router": dense(ks[7], (d, e), d),
-                "w_gate": dense(ks[4], (e, d, f), d),
-                "w_up": dense(ks[5], (e, d, f), d),
-                "w_down": dense(ks[6], (e, f, d), f, out_scale),
+                "w_gate": dense(ks[4], (e, d, fe), d),
+                "w_up": dense(ks[5], (e, d, fe), d),
+                "w_down": dense(ks[6], (e, fe, d), fe, out_scale),
             })
             if cfg.moe.num_shared_experts > 0:
-                sf = cfg.moe.num_shared_experts * f
+                sf = cfg.moe.num_shared_experts * fe
                 ks2 = jax.random.split(ks[7], 4)
                 p.update({
                     "w_gate_shared": dense(ks2[1], (d, sf), d),
@@ -202,6 +215,13 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 keys[:, : every - 1]
             ),
             "moe": jax.vmap(lambda k: layer(k, True))(keys[:, every - 1]),
+        }
+    elif first_k_layout(cfg):
+        kk = cfg.first_k_dense
+        keys = jax.random.split(k_layers, cfg.n_layers)
+        layers = {
+            "dense": jax.vmap(lambda k: layer(k, False))(keys[:kk]),
+            "moe": jax.vmap(lambda k: layer(k, True))(keys[kk:]),
         }
     else:
         layer_keys = jax.random.split(k_layers, cfg.n_layers)
@@ -292,6 +312,11 @@ def logical_axes(cfg: ModelConfig) -> Params:
             # Group axis maps like "layers" (pp shards it); the dense
             # sub-layer axis inside a group is unsharded.
             "dense": _layer_axes(cfg, False, lead=("layers", None)),
+            "moe": _layer_axes(cfg, True),
+        }
+    elif first_k_layout(cfg):
+        layers = {
+            "dense": _layer_axes(cfg, False),
             "moe": _layer_axes(cfg, True),
         }
     else:
@@ -785,6 +810,12 @@ def forward(
     if pp > 1:
         from shellac_tpu.parallel.pipeline import pipeline_apply
 
+        if first_k_layout(cfg):
+            raise NotImplementedError(
+                "pp over a first_k_dense layout is not wired yet (the "
+                "two stacks are unequal; stage balancing needs its own "
+                "schedule) — use pp=1 or the moe_every layout"
+            )
         if grouped_moe(cfg):
             # Interleaved stacks pipeline at GROUP granularity: each
             # stage holds whole (dense^(every-1), moe) super-blocks, so
@@ -924,6 +955,31 @@ def forward(
             "router_z_loss": aux_acc["router_z_loss"] * inv_l,
             "dropped_frac": aux_acc["dropped_frac"] * inv_l,
         }
+    elif first_k_layout(cfg):
+        aux0 = _zero_aux()
+        bd, bm = make_block(False), make_block(True)
+
+        def stack_body(blk):
+            def body(carry, lp):
+                x, acc = carry
+                x, _, mo = blk(x, lp, cos, sin)
+                return (x, _add_aux(acc, mo)), None
+
+            return body
+
+        (x, acc), _ = jax.lax.scan(
+            stack_body(bd), (x, aux0), params["layers"]["dense"]
+        )
+        (x, aux_acc), _ = jax.lax.scan(
+            stack_body(bm), (x, acc), params["layers"]["moe"]
+        )
+        routers = cfg.n_layers - cfg.first_k_dense
+        aux = {
+            "aux": aux_acc["aux"],
+            "balance_loss": aux_acc["balance_loss"] / routers,
+            "router_z_loss": aux_acc["router_z_loss"] / routers,
+            "dropped_frac": aux_acc["dropped_frac"] / routers,
+        }
     else:
         aux0 = _zero_aux()
         block = make_block(None)
@@ -1023,11 +1079,11 @@ def forward_with_cache(
         )
 
     if quant:
-        if grouped_moe(cfg):
+        if grouped_moe(cfg) or first_k_layout(cfg):
             raise NotImplementedError(
-                "int8 KV cache with interleaved dense/MoE stacks "
-                "(moe_every > 1) is not wired yet; use a uniform stack "
-                "or a bf16 cache"
+                "int8 KV cache with two-stack layouts (moe_every > 1 "
+                "or first_k_dense) is not wired yet; use a uniform "
+                "stack or a bf16 cache"
             )
 
         def quant_body(x, layer_in):
@@ -1039,6 +1095,27 @@ def forward_with_cache(
             quant_body, x,
             (params["layers"], cache.k, cache.v, cache.ks, cache.vs),
         )
+    elif first_k_layout(cfg):
+        kk = cfg.first_k_dense
+
+        def stack_body(moe_flag):
+            def body(x, layer_in):
+                lp, ck, cv = layer_in
+                x, nc, _ = run_block(x, lp, ck, cv, moe_flag)
+                return x, nc
+
+            return body
+
+        x, (nk_d, nv_d) = jax.lax.scan(
+            stack_body(False), x,
+            (params["layers"]["dense"], cache.k[:kk], cache.v[:kk]),
+        )
+        x, (nk_m, nv_m) = jax.lax.scan(
+            stack_body(True), x,
+            (params["layers"]["moe"], cache.k[kk:], cache.v[kk:]),
+        )
+        new_k = jnp.concatenate([nk_d, nk_m], axis=0)
+        new_v = jnp.concatenate([nv_d, nv_m], axis=0)
     elif grouped_moe(cfg):
         every = cfg.moe_every
         ng = cfg.n_layers // every

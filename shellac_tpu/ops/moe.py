@@ -49,9 +49,24 @@ def route(
         "td,de->te", x.astype(jnp.float32), w_router.astype(jnp.float32)
     )
     probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
-    weight, expert_idx = jax.lax.top_k(probs, k)  # (T, k)
-    # Renormalize the kept probabilities so combine weights sum to 1.
-    weight = weight / jnp.maximum(jnp.sum(weight, -1, keepdims=True), 1e-9)
+    if cfg.n_group > 1:
+        # Group-limited routing (DeepSeek): keep only the top
+        # `topk_group` groups by max member score, zero the rest, then
+        # top-k within the survivors — exactly HF's masked_fill form.
+        g = cfg.n_group
+        group_scores = jnp.max(probs.reshape(t, g, e // g), axis=-1)
+        _, gidx = jax.lax.top_k(group_scores, cfg.topk_group)
+        gmask = jnp.zeros((t, g), probs.dtype).at[
+            jnp.arange(t)[:, None], gidx
+        ].set(1.0)
+        probs_sel = probs * jnp.repeat(gmask, e // g, axis=1)
+    else:
+        probs_sel = probs
+    weight, expert_idx = jax.lax.top_k(probs_sel, k)  # (T, k)
+    if cfg.norm_topk_prob:
+        # Renormalize the kept probabilities so combine weights sum to 1.
+        weight = weight / jnp.maximum(jnp.sum(weight, -1, keepdims=True), 1e-9)
+    weight = weight * cfg.routed_scaling_factor
 
     # Position of each assignment within its expert, in token order:
     # cumsum over the one-hot assignment matrix (T*k, E).

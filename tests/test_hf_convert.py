@@ -278,15 +278,75 @@ def test_qwen3_generation_and_export():
     np.testing.assert_allclose(ours2, ref2, atol=2e-4, rtol=2e-3)
 
 
-def test_deepseek_moe_conversion_rejected():
+def _tiny_deepseek_moe(topk_method="greedy", n_group=1, topk_group=1,
+                       routed_scaling_factor=1.0):
     cfg = transformers.DeepseekV2Config(
-        vocab_size=64, hidden_size=32, num_hidden_layers=2,
-        num_attention_heads=2, kv_lora_rank=16, q_lora_rank=None,
-        qk_nope_head_dim=8, qk_rope_head_dim=4, v_head_dim=8,
-        first_k_dense_replace=1, n_routed_experts=4,
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        moe_intermediate_size=48,
+        num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=4,
+        kv_lora_rank=32, q_lora_rank=None, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, head_dim=8,
+        first_k_dense_replace=1,
+        n_routed_experts=4, num_experts_per_tok=2, n_shared_experts=1,
+        norm_topk_prob=False, routed_scaling_factor=routed_scaling_factor,
+        topk_method=topk_method, n_group=n_group, topk_group=topk_group,
+        scoring_func="softmax", moe_layer_freq=1,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        attn_implementation="eager", attention_bias=False,
     )
-    with pytest.raises(NotImplementedError, match="group-limited"):
-        config_from_hf(cfg)
+    torch.manual_seed(5)
+    return transformers.DeepseekV2ForCausalLM(cfg).eval()
+
+
+@pytest.mark.parametrize(
+    "topk_method, n_group, topk_group, scale",
+    [("greedy", 1, 1, 1.0), ("greedy", 1, 1, 2.5),
+     ("group_limited_greedy", 2, 1, 1.0)],
+)
+def test_deepseek_moe_logits_parity(topk_method, n_group, topk_group, scale):
+    """The FULL DeepSeek-V2 architecture — MLA + first-k-dense layout +
+    MoE with shared experts, un-normalized scaled top-k, and (for the
+    big variants) group-limited routing — converts with exact parity."""
+    model = _tiny_deepseek_moe(
+        topk_method=topk_method, n_group=n_group, topk_group=topk_group,
+        routed_scaling_factor=scale,
+    )
+    cfg, params = from_hf(model)
+    cfg = cfg.replace(dtype="float32")
+    assert cfg.first_k_dense == 1 and cfg.moe is not None
+    assert cfg.moe.d_ff_expert == 48
+    assert cfg.moe.norm_topk_prob is False
+    assert cfg.moe.routed_scaling_factor == scale
+    assert cfg.moe.n_group == n_group
+
+    tokens = np.array([[3, 17, 42, 99, 7, 23, 56, 1]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
+
+
+def test_deepseek_moe_greedy_generation():
+    """Token-exact greedy generation for the full MoE architecture
+    through the latent cache (dropless decode included)."""
+    from shellac_tpu.inference.engine import Engine
+
+    model = _tiny_deepseek_moe()
+    cfg, params = from_hf(model)
+    cfg = cfg.replace(dtype="float32")
+    prompt = np.array([[5, 9, 2, 31, 77]], np.int64)
+    with torch.no_grad():
+        ref = model.generate(
+            torch.from_numpy(prompt), max_new_tokens=10, do_sample=False,
+        ).numpy()[:, prompt.shape[1]:]
+    out = Engine(cfg, params, temperature=0.0, max_len=64).generate(
+        jnp.asarray(prompt, jnp.int32), max_new_tokens=10
+    )
+    np.testing.assert_array_equal(np.asarray(out.tokens), ref)
 
 
 def test_config_mapping():

@@ -137,6 +137,46 @@ class TestServing:
         )
         assert got == want
 
+    def test_deepseek_layout_trains_and_serves(self):
+        """tiny-deepseek (MLA + first-k-dense + MoE + shared expert):
+        the native stack trains on a mesh and the serving parity
+        invariant holds through the latent cache."""
+        from shellac_tpu import ParallelConfig, make_mesh
+        from shellac_tpu.training import (
+            batch_shardings,
+            init_train_state,
+            make_train_step,
+        )
+
+        cfg = get_model_config("tiny-deepseek").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+        # Training on an fsdp mesh (experts shard over fsdp).
+        mesh = make_mesh(ParallelConfig(fsdp=4, tp=2))
+        tcfg = TrainConfig(warmup_steps=1, total_steps=4)
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0),
+                                 mesh=mesh)
+        step = make_train_step(cfg, tcfg, mesh=mesh)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                  cfg.vocab_size)
+        bs = batch_shardings(mesh)
+        batch = {"inputs": jax.device_put(toks, bs),
+                 "targets": jax.device_put(toks, bs)}
+        state, met = step(state, batch)
+        assert np.isfinite(float(met["loss"]))
+
+        # Serving: batching == single-request, greedy.
+        rng = np.random.default_rng(13)
+        prompts = [rng.integers(1, cfg.vocab_size, size=n).tolist()
+                   for n in (3, 6)]
+        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64)
+        got = eng.run([(i, p, 6) for i, p in enumerate(prompts)])
+        single = Engine(cfg, params, temperature=0.0, max_len=64)
+        for i, p in enumerate(prompts):
+            res = single.generate(jnp.asarray([p], jnp.int32),
+                                  max_new_tokens=6)
+            assert got[i] == np.asarray(res.tokens)[0].tolist(), i
+
     def test_guards(self, model):
         cfg, params = model
         with pytest.raises(NotImplementedError, match="paged"):
