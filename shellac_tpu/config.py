@@ -58,11 +58,16 @@ class MoEConfig:
     norm_topk_prob: bool = True
     routed_scaling_factor: float = 1.0
     # Group-limited routing (DeepSeek-V2/V3 big variants): experts are
-    # split into n_group groups, the top `topk_group` groups by max
-    # score stay live, and top-k selects within them. n_group=1
-    # disables.
+    # split into n_group groups, the top `topk_group` groups stay live
+    # (ranked by max member score under softmax scoring, by top-2-sum
+    # under sigmoid scoring — each matching its HF reference), and
+    # top-k selects within them. n_group=1 disables.
     n_group: int = 1
     topk_group: int = 1
+    # "softmax" (V2) or "sigmoid" (V3): sigmoid scores with an additive
+    # per-expert selection bias (e_score_correction_bias; the bias
+    # influences WHICH experts are picked, never the combine weights).
+    scoring: str = "softmax"
 
 
 @dataclass(frozen=True)
@@ -247,6 +252,19 @@ class ModelConfig:
                     f"first_k_dense={self.first_k_dense} must be in "
                     f"(0, n_layers={self.n_layers})"
                 )
+        if self.moe is not None and self.moe.scoring not in (
+            "softmax", "sigmoid",
+        ):
+            raise ValueError(
+                f"moe.scoring={self.moe.scoring!r}; have softmax, sigmoid"
+            )
+        if (self.moe is not None and self.moe.scoring == "sigmoid"
+                and self.moe.n_group > 1
+                and self.moe.num_experts // self.moe.n_group < 2):
+            raise ValueError(
+                "sigmoid scoring ranks groups by top-2 sum; groups need "
+                ">= 2 experts"
+            )
         if self.moe is not None and self.moe.n_group > 1:
             if self.moe.num_experts % self.moe.n_group:
                 raise ValueError(

@@ -93,31 +93,23 @@ def _deepseek_config(hf_cfg) -> ModelConfig:
     """DeepSeek-V2/V3 (MLA) config mapping.
 
     Supports the full architecture: MLA attention (with optional yarn
-    rope), the first-k-dense layer layout, and the MoE variants —
-    softmax scoring with greedy or group-limited top-k, un-normalized
-    top-k probabilities scaled by routed_scaling_factor, narrow
-    per-expert FFNs (moe_intermediate_size), and shared experts.
-    Unrepresentable knobs (sigmoid scoring, per-layer MoE frequency,
-    non-yarn rope scaling, attention biases) fail loudly rather than
-    converting approximately.
+    rope), the first-k-dense layer layout, and both MoE gates — V2's
+    softmax scoring with greedy or group-limited top-k and
+    un-normalized scaled probabilities, and V3's sigmoid scoring with
+    selection-only correction biases, top-2-sum group ranking, and
+    normalized weights — plus narrow per-expert FFNs
+    (moe_intermediate_size) and shared experts. Unrepresentable knobs
+    (per-layer MoE frequency, non-yarn rope scaling, attention biases,
+    gating declared different from each HF reference) fail loudly
+    rather than converting approximately.
     """
     from shellac_tpu.config import MLAConfig, MoEConfig
 
     n_layers = hf_cfg.num_hidden_layers
     first_k = getattr(hf_cfg, "first_k_dense_replace", n_layers)
+    is_v3 = getattr(hf_cfg, "model_type", "") == "deepseek_v3"
     moe = None
     if first_k < n_layers and getattr(hf_cfg, "n_routed_experts", None):
-        if getattr(hf_cfg, "scoring_func", "softmax") != "softmax":
-            raise NotImplementedError(
-                f"DeepSeek scoring_func="
-                f"{hf_cfg.scoring_func!r} (have: softmax)"
-            )
-        if getattr(hf_cfg, "topk_method", "greedy") not in (
-            "greedy", "group_limited_greedy",
-        ):
-            raise NotImplementedError(
-                f"DeepSeek topk_method={hf_cfg.topk_method!r}"
-            )
         if getattr(hf_cfg, "moe_layer_freq", 1) != 1:
             raise NotImplementedError(
                 "moe_layer_freq != 1 is not representable by the "
@@ -129,25 +121,65 @@ def _deepseek_config(hf_cfg) -> ModelConfig:
                 "is not wired; every published checkpoint keeps >= 1 "
                 "dense layer"
             )
-        grouped = hf_cfg.topk_method == "group_limited_greedy"
-        moe = MoEConfig(
+        common = dict(
             num_experts=hf_cfg.n_routed_experts,
             num_experts_per_token=hf_cfg.num_experts_per_tok,
             d_ff_expert=hf_cfg.moe_intermediate_size,
             num_shared_experts=getattr(hf_cfg, "n_shared_experts", 0) or 0,
-            # HF's DeepseekV2 gate NEVER renormalizes the kept top-k
-            # probabilities (the config flag is unused in its forward),
-            # so matching HF's actual compute means False regardless of
-            # what the checkpoint's config claims.
-            norm_topk_prob=False,
             routed_scaling_factor=float(
                 getattr(hf_cfg, "routed_scaling_factor", 1.0)
             ),
-            n_group=(getattr(hf_cfg, "n_group", 1) or 1) if grouped else 1,
-            topk_group=(getattr(hf_cfg, "topk_group", 1) or 1)
-            if grouped else 1,
             dropless=True,
         )
+        if is_v3:
+            # V3 gate: sigmoid scores, bias-corrected top-2-sum group
+            # selection, normalized combine weights. If the checkpoint's
+            # config DECLARES different gating (remote-code variants
+            # carry these fields), refuse rather than convert wrong.
+            declared = getattr(hf_cfg, "scoring_func", "sigmoid")
+            if declared != "sigmoid":
+                raise NotImplementedError(
+                    f"deepseek_v3 with scoring_func={declared!r} "
+                    "(the HF reference gate is sigmoid)"
+                )
+            declared_tm = getattr(hf_cfg, "topk_method", "noaux_tc")
+            if declared_tm != "noaux_tc":
+                raise NotImplementedError(
+                    f"deepseek_v3 with topk_method={declared_tm!r} "
+                    "(the HF reference gate is noaux_tc)"
+                )
+            moe = MoEConfig(
+                scoring="sigmoid",
+                norm_topk_prob=bool(getattr(hf_cfg, "norm_topk_prob", True)),
+                n_group=getattr(hf_cfg, "n_group", 1) or 1,
+                topk_group=getattr(hf_cfg, "topk_group", 1) or 1,
+                **common,
+            )
+        else:
+            if getattr(hf_cfg, "scoring_func", "softmax") != "softmax":
+                raise NotImplementedError(
+                    f"DeepSeek-V2 scoring_func="
+                    f"{hf_cfg.scoring_func!r} (have: softmax)"
+                )
+            if getattr(hf_cfg, "topk_method", "greedy") not in (
+                "greedy", "group_limited_greedy",
+            ):
+                raise NotImplementedError(
+                    f"DeepSeek topk_method={hf_cfg.topk_method!r}"
+                )
+            grouped = hf_cfg.topk_method == "group_limited_greedy"
+            moe = MoEConfig(
+                # HF's DeepseekV2 gate NEVER renormalizes the kept top-k
+                # probabilities (the config flag is unused in its
+                # forward), so matching HF's actual compute means False
+                # regardless of what the checkpoint's config claims.
+                norm_topk_prob=False,
+                n_group=(getattr(hf_cfg, "n_group", 1) or 1)
+                if grouped else 1,
+                topk_group=(getattr(hf_cfg, "topk_group", 1) or 1)
+                if grouped else 1,
+                **common,
+            )
     elif first_k < n_layers:
         raise NotImplementedError(
             "first_k_dense_replace set but n_routed_experts missing"
@@ -449,6 +481,9 @@ def _first_k_params(cfg, get, sd, pdt, norm_offset):
                     put(ours, get(base + theirs).T)
             else:
                 put("w_router", get(base + "mlp.gate.weight").T)  # (D, E)
+                if cfg.moe.scoring == "sigmoid":
+                    put("b_router",
+                        get(base + "mlp.gate.e_score_correction_bias"))
                 for ours, proj in (("w_gate", "gate_proj"),
                                    ("w_up", "up_proj"),
                                    ("w_down", "down_proj")):

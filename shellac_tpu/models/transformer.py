@@ -194,6 +194,8 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 "w_up": dense(ks[5], (e, d, fe), d),
                 "w_down": dense(ks[6], (e, fe, d), fe, out_scale),
             })
+            if cfg.moe.scoring == "sigmoid":
+                p["b_router"] = jnp.zeros((e,), pdt)
             if cfg.moe.num_shared_experts > 0:
                 sf = cfg.moe.num_shared_experts * fe
                 ks2 = jax.random.split(ks[7], 4)
@@ -252,6 +254,8 @@ def _layer_axes(cfg: ModelConfig, moe_layer: bool, lead=("layers",)) -> dict:
             "w_up": (*lead, "experts", "embed", "mlp"),
             "w_down": (*lead, "experts", "mlp", "embed"),
         }
+        if cfg.moe.scoring == "sigmoid":
+            mlp_axes["b_router"] = (*lead, None)
         if cfg.moe.num_shared_experts > 0:
             mlp_axes.update({
                 "w_gate_shared": (*lead, "embed", "mlp"),
@@ -545,6 +549,11 @@ def _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache, moe_layer,
         down, aux, metrics = moe_ffn(
             hx, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             cfg.moe, drop_tokens=not (is_decode or cfg.moe.dropless),
+            # Strict lookup under sigmoid scoring: a missing bias must
+            # be a loud KeyError, not a silent zero (it changes which
+            # experts are selected).
+            b_router=(lp["b_router"] if cfg.moe.scoring == "sigmoid"
+                      else None),
         )
         if cfg.moe.num_shared_experts > 0:
             sg = hx @ materialize(lp["w_gate_shared"], cdt)

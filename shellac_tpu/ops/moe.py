@@ -29,11 +29,28 @@ def expert_capacity(cfg: MoEConfig, num_tokens: int) -> int:
     return max(cap, 1)
 
 
+def _group_mask(choice: jax.Array, cfg: MoEConfig, group_rank) -> jax.Array:
+    """Zero out experts outside the top `topk_group` groups.
+
+    `group_rank` ranks each group from its members' scores — max for
+    softmax (V2), top-2 sum for sigmoid (V3) — matching each HF gate.
+    """
+    t, e = choice.shape
+    g = cfg.n_group
+    group_scores = group_rank(choice.reshape(t, g, e // g))
+    _, gidx = jax.lax.top_k(group_scores, cfg.topk_group)
+    gmask = jnp.zeros((t, g), choice.dtype).at[
+        jnp.arange(t)[:, None], gidx
+    ].set(1.0)
+    return choice * jnp.repeat(gmask, e // g, axis=1)
+
+
 def route(
     x: jax.Array,  # (T, D) — flattened tokens
     w_router: jax.Array,  # (D, E)
     cfg: MoEConfig,
     capacity: int | None = None,
+    b_router: jax.Array | None = None,  # (E,) sigmoid selection bias
 ) -> Tuple[jax.Array, jax.Array, jax.Array, dict]:
     """Top-k routing with capacity buckets.
 
@@ -48,24 +65,36 @@ def route(
     logits = jnp.einsum(
         "td,de->te", x.astype(jnp.float32), w_router.astype(jnp.float32)
     )
-    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
-    if cfg.n_group > 1:
-        # Group-limited routing (DeepSeek): keep only the top
-        # `topk_group` groups by max member score, zero the rest, then
-        # top-k within the survivors — exactly HF's masked_fill form.
-        g = cfg.n_group
-        group_scores = jnp.max(probs.reshape(t, g, e // g), axis=-1)
-        _, gidx = jax.lax.top_k(group_scores, cfg.topk_group)
-        gmask = jnp.zeros((t, g), probs.dtype).at[
-            jnp.arange(t)[:, None], gidx
-        ].set(1.0)
-        probs_sel = probs * jnp.repeat(gmask, e // g, axis=1)
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E) — also feeds aux
+    if cfg.scoring == "sigmoid":
+        # DeepSeek-V3 gate: sigmoid scores; an additive per-expert bias
+        # steers SELECTION only (load balancing knob trained outside
+        # the gradient), combine weights come from the raw scores.
+        scores = jax.nn.sigmoid(logits)
+        choice = scores + (b_router.astype(jnp.float32)[None]
+                           if b_router is not None else 0.0)
+        if cfg.n_group > 1:
+            choice = _group_mask(
+                choice, cfg,
+                lambda gsc: jnp.sum(jax.lax.top_k(gsc, 2)[0], axis=-1),
+            )
+        _, expert_idx = jax.lax.top_k(choice, k)
+        weight = jnp.take_along_axis(scores, expert_idx, axis=-1)
+        if cfg.norm_topk_prob:
+            weight = weight / (jnp.sum(weight, -1, keepdims=True) + 1e-20)
     else:
         probs_sel = probs
-    weight, expert_idx = jax.lax.top_k(probs_sel, k)  # (T, k)
-    if cfg.norm_topk_prob:
-        # Renormalize the kept probabilities so combine weights sum to 1.
-        weight = weight / jnp.maximum(jnp.sum(weight, -1, keepdims=True), 1e-9)
+        if cfg.n_group > 1:
+            # V2's group rank is the max member probability.
+            probs_sel = _group_mask(
+                probs, cfg, lambda gsc: jnp.max(gsc, axis=-1)
+            )
+        weight, expert_idx = jax.lax.top_k(probs_sel, k)  # (T, k)
+        if cfg.norm_topk_prob:
+            # Renormalize the kept probabilities to sum to 1.
+            weight = weight / jnp.maximum(
+                jnp.sum(weight, -1, keepdims=True), 1e-9
+            )
     weight = weight * cfg.routed_scaling_factor
 
     # Position of each assignment within its expert, in token order:
@@ -106,6 +135,7 @@ def moe_ffn(
     cfg: MoEConfig,
     *,
     drop_tokens: bool = True,
+    b_router: jax.Array | None = None,
 ) -> Tuple[jax.Array, jax.Array, dict]:
     """Returns (out (B, S, D), aux_loss scalar, metrics).
 
@@ -121,7 +151,9 @@ def moe_ffn(
     cdt = x.dtype
 
     x2 = x.reshape(t, d)
-    slot, weight, aux, metrics = route(x2, w_router, cfg, capacity=c)
+    slot, weight, aux, metrics = route(
+        x2, w_router, cfg, capacity=c, b_router=b_router
+    )
     k = slot.shape[1]
 
     # Scatter tokens into capacity buckets; one extra slot absorbs drops.

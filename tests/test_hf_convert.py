@@ -330,6 +330,47 @@ def test_deepseek_moe_logits_parity(topk_method, n_group, topk_group, scale):
     np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
 
 
+def test_deepseek_v3_logits_parity():
+    """DeepSeek-V3 routing — sigmoid scores, e_score_correction_bias
+    steering selection only, top-2-sum group ranking, normalized
+    weights — converts with exact parity."""
+    cfg = transformers.DeepseekV3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        moe_intermediate_size=48,
+        num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=4,
+        kv_lora_rank=32, q_lora_rank=24, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, head_dim=8,
+        first_k_dense_replace=1,
+        n_routed_experts=8, num_experts_per_tok=2, n_shared_experts=1,
+        norm_topk_prob=True, routed_scaling_factor=2.5,
+        n_group=2, topk_group=1,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        attn_implementation="eager", attention_bias=False,
+    )
+    torch.manual_seed(6)
+    model = transformers.DeepseekV3ForCausalLM(cfg).eval()
+    # Random (nonzero) correction biases so the selection-vs-weight
+    # distinction is actually exercised.
+    with torch.no_grad():
+        for layer in model.model.layers[1:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.2, 0.2)
+    ours_cfg, params = from_hf(model)
+    ours_cfg = ours_cfg.replace(dtype="float32")
+    assert ours_cfg.moe.scoring == "sigmoid"
+    assert ours_cfg.moe.norm_topk_prob is True
+    assert ours_cfg.moe.n_group == 2
+
+    tokens = np.array([[3, 17, 42, 99, 7, 23, 56, 1]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(ours_cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
+
+
 def test_deepseek_moe_greedy_generation():
     """Token-exact greedy generation for the full MoE architecture
     through the latent cache (dropless decode included)."""
