@@ -53,7 +53,28 @@ def config_from_hf(hf_cfg) -> ModelConfig:
             ),
             dropless=True,
         )
-    is_qwen3 = getattr(hf_cfg, "model_type", "") == "qwen3"
+    is_qwen3 = getattr(hf_cfg, "model_type", "") in ("qwen3", "qwen3_moe")
+    if getattr(hf_cfg, "model_type", "") == "qwen3_moe":
+        if getattr(hf_cfg, "mlp_only_layers", None):
+            raise NotImplementedError(
+                "qwen3_moe with mlp_only_layers is a mixed layout we "
+                "cannot represent uniformly"
+            )
+        if getattr(hf_cfg, "decoder_sparse_step", 1) != 1:
+            raise NotImplementedError(
+                "qwen3_moe decoder_sparse_step != 1 is not representable"
+            )
+        moe = MoEConfig(
+            num_experts=hf_cfg.num_experts,
+            num_experts_per_token=hf_cfg.num_experts_per_tok,
+            d_ff_expert=hf_cfg.moe_intermediate_size,
+            # HF Qwen3MoeConfig defaults norm_topk_prob to False.
+            norm_topk_prob=bool(getattr(hf_cfg, "norm_topk_prob", False)),
+            router_aux_loss_weight=getattr(
+                hf_cfg, "router_aux_loss_coef", 0.01
+            ),
+            dropless=True,
+        )
     return ModelConfig(
         vocab_size=hf_cfg.vocab_size,
         d_model=hf_cfg.hidden_size,
@@ -309,6 +330,13 @@ _EXPERT_MAP = {
     "w_down": "w2",
 }
 
+# Qwen3-MoE (and DeepSeek) experts keep the dense projection names.
+_QWEN3_EXPERT_MAP = {
+    "w_gate": "gate_proj",
+    "w_up": "up_proj",
+    "w_down": "down_proj",
+}
+
 # Qwen2-style attention biases (vectors, no transpose).
 _BIAS_MAP = {
     "bq": "self_attn.q_proj.bias",
@@ -349,7 +377,7 @@ def _collect_mla_layer(layers, m, get, base, norm_offset) -> None:
 
 def params_from_state_dict(
     state_dict: Mapping[str, Any], cfg: ModelConfig, dtype=None,
-    norm_offset: float = -1.0,
+    norm_offset: float = -1.0, moe_naming: str = "auto",
 ) -> Dict[str, Any]:
     """Convert an HF Llama-family state_dict to a shellac_tpu pytree.
 
@@ -372,6 +400,14 @@ def params_from_state_dict(
     if cfg.first_k_dense:
         return _first_k_params(cfg, get, sd, pdt, norm_offset)
     moe = cfg.moe is not None
+    if moe and moe_naming == "auto":
+        # Probe the keys: Mixtral ships block_sparse_moe.*, Qwen3-MoE
+        # keeps the dense projection names under mlp.experts.*.
+        moe_naming = (
+            "qwen3_moe"
+            if f"{prefix}layers.0.mlp.experts.0.gate_proj.weight" in sd
+            else "mixtral"
+        )
     if moe and cfg.moe_every > 1:
         raise NotImplementedError(
             "interleaved dense/MoE stacks (moe_every > 1) have no HF "
@@ -410,18 +446,26 @@ def params_from_state_dict(
         for ours, theirs in (_BIAS_MAP.items() if cfg.attn_bias else ()):
             layers[ours].append(get(base + theirs))
         if moe:
-            layers["w_router"].append(
-                get(base + "block_sparse_moe.gate.weight").T
-            )
-            for ours, theirs in _EXPERT_MAP.items():
-                experts = [
-                    get(
-                        base
-                        + f"block_sparse_moe.experts.{j}.{theirs}.weight"
-                    ).T
-                    for j in range(cfg.moe.num_experts)
-                ]
-                layers[ours].append(np.stack(experts))
+            if moe_naming == "qwen3_moe":
+                layers["w_router"].append(get(base + "mlp.gate.weight").T)
+                for ours, proj in _QWEN3_EXPERT_MAP.items():
+                    layers[ours].append(np.stack([
+                        get(base + f"mlp.experts.{j}.{proj}.weight").T
+                        for j in range(cfg.moe.num_experts)
+                    ]))
+            else:
+                layers["w_router"].append(
+                    get(base + "block_sparse_moe.gate.weight").T
+                )
+                for ours, theirs in _EXPERT_MAP.items():
+                    experts = [
+                        get(
+                            base
+                            + f"block_sparse_moe.experts.{j}.{theirs}.weight"
+                        ).T
+                        for j in range(cfg.moe.num_experts)
+                    ]
+                    layers[ours].append(np.stack(experts))
         else:
             for ours, (theirs, transpose) in _DENSE_MLP_MAP.items():
                 w = get(base + theirs)
@@ -484,9 +528,7 @@ def _first_k_params(cfg, get, sd, pdt, norm_offset):
                 if cfg.moe.scoring == "sigmoid":
                     put("b_router",
                         get(base + "mlp.gate.e_score_correction_bias"))
-                for ours, proj in (("w_gate", "gate_proj"),
-                                   ("w_up", "up_proj"),
-                                   ("w_down", "down_proj")):
+                for ours, proj in _QWEN3_EXPERT_MAP.items():
                     put(ours, np.stack([
                         get(base + f"mlp.experts.{j}.{proj}.weight").T
                         for j in range(cfg.moe.num_experts)
@@ -599,7 +641,16 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
         if cfg.attn_bias:
             for ours, theirs in _BIAS_MAP.items():
                 sd[base + theirs] = np_(layers[ours][i])
-        if moe:
+        if moe and cfg.qk_norm:
+            # qk_norm + MoE is the Qwen3-MoE shape: export its naming.
+            sd[base + "mlp.gate.weight"] = np_(layers["w_router"][i]).T
+            for ours, proj in _QWEN3_EXPERT_MAP.items():
+                stacked = np_(layers[ours][i])
+                for j in range(cfg.moe.num_experts):
+                    sd[base + f"mlp.experts.{j}.{proj}.weight"] = (
+                        stacked[j].T
+                    )
+        elif moe:
             sd[base + "block_sparse_moe.gate.weight"] = np_(
                 layers["w_router"][i]
             ).T

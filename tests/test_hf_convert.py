@@ -330,6 +330,43 @@ def test_deepseek_moe_logits_parity(topk_method, n_group, topk_group, scale):
     np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
 
 
+def test_qwen3_moe_logits_parity():
+    """Qwen3-MoE: qk-norm attention + uniform softmax top-k MoE with
+    narrow experts, HF's mlp.* naming — exact parity."""
+    cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        moe_intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        mlp_only_layers=[], decoder_sparse_step=1,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    model = transformers.Qwen3MoeForCausalLM(cfg).eval()
+    ours_cfg, params = from_hf(model)
+    ours_cfg = ours_cfg.replace(dtype="float32")
+    assert ours_cfg.qk_norm and ours_cfg.moe is not None
+    assert ours_cfg.moe.d_ff_expert == 48
+
+    tokens = np.array([[3, 17, 42, 99, 7, 23, 56, 1]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(ours_cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
+
+    # Export round-trips with the Qwen3-MoE naming (strict reload).
+    from shellac_tpu.models.convert import to_state_dict
+
+    sd = {k: torch.from_numpy(v)
+          for k, v in to_state_dict(ours_cfg, params).items()}
+    model.load_state_dict(sd)
+
+
 def test_deepseek_v3_logits_parity():
     """DeepSeek-V3 routing — sigmoid scores, e_score_correction_bias
     steering selection only, top-2-sum group ranking, normalized

@@ -137,6 +137,26 @@ class TestServing:
         )
         assert got == want
 
+    def test_speculative_bit_matches(self, model):
+        """Speculative batching over MLA latent caches (self-draft):
+        rollback-by-lengths works on the latent rows too."""
+        from shellac_tpu.inference.spec_batching import (
+            SpeculativeBatchingEngine,
+        )
+
+        cfg, params = model
+        rng = np.random.default_rng(17)
+        prompts = [rng.integers(1, cfg.vocab_size, size=n).tolist()
+                   for n in (3, 6, 4)]
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64).run(
+            [(i, p, 8) for i, p in enumerate(prompts)]
+        )
+        eng = SpeculativeBatchingEngine(cfg, params, cfg, params, gamma=3,
+                                        n_slots=2, max_len=64)
+        got = eng.run([(i, p, 8) for i, p in enumerate(prompts)])
+        assert got == want
+        assert eng.stats["spec_accepted"] == eng.stats["spec_proposed"]
+
     def test_deepseek_layout_trains_and_serves(self):
         """tiny-deepseek (MLA + first-k-dense + MoE + shared expert):
         the native stack trains on a mesh and the serving parity
