@@ -44,7 +44,22 @@ _TARGETS: Tuple[str, ...] = (
     "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
 )
 
+# MLA parameter trees replace wq/wk/wv with the latent projections.
+# wkv_b_k/wkv_b_v are stored (L, kv_rank, heads, dh) but are really the
+# (kv_rank -> heads*dh) expansion matrices: their adapters fold the
+# trailing head dims (see _folded).
+_MLA_TARGETS: Tuple[str, ...] = (
+    "wq", "wq_a", "wq_b", "wkv_a", "wkv_b_k", "wkv_b_v", "wo",
+    "w_gate", "w_up", "w_down",
+)
+_FOLDED: Tuple[str, ...] = ("wkv_b_k", "wkv_b_v")
+
 DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+DEFAULT_MLA_TARGETS = ("wkv_a", "wkv_b_k", "wkv_b_v", "wo")
+
+
+def _folded(name: str) -> bool:
+    return name in _FOLDED
 
 
 @dataclass(frozen=True)
@@ -58,22 +73,31 @@ class LoRAConfig:
         return self.alpha / self.rank
 
     def validate(self, model_cfg: ModelConfig) -> "LoRAConfig":
+        """Check targets against the model family; returns the resolved
+        config (the generic wq/wk/wv/wo default maps onto the MLA
+        projections for MLA models — callers must use the result)."""
+        cfg = self
         if model_cfg.mla is not None:
-            raise NotImplementedError(
-                "LoRA on MLA models is not wired yet: the latent "
-                "projections (wkv_a/wkv_b_k/wkv_b_v) need their own "
-                "adapter shapes; the standard wq/wk/wv targets do not "
-                "exist in an MLA parameter tree"
-            )
-        unknown = set(self.targets) - set(_TARGETS)
+            if cfg.targets == DEFAULT_TARGETS:
+                q = (("wq",) if model_cfg.mla.q_lora_rank is None
+                     else ("wq_a", "wq_b"))
+                cfg = cfg.replace(targets=q + DEFAULT_MLA_TARGETS)
+            allowed = set(_MLA_TARGETS)
+            if model_cfg.mla.q_lora_rank is None:
+                allowed -= {"wq_a", "wq_b"}
+            else:
+                allowed -= {"wq"}
+        else:
+            allowed = set(_TARGETS)
+        unknown = set(cfg.targets) - allowed
         if unknown:
             raise ValueError(
                 f"unknown LoRA targets {sorted(unknown)}; "
-                f"have {sorted(_TARGETS)}"
+                f"have {sorted(allowed)}"
             )
-        if self.rank < 1:
-            raise ValueError(f"rank must be >= 1, got {self.rank}")
-        return self
+        if cfg.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {cfg.rank}")
+        return cfg
 
     def replace(self, **kw) -> "LoRAConfig":
         return dataclasses.replace(self, **kw)
@@ -90,9 +114,11 @@ def init_lora(
     the {"dense": ..., "moe": ...} grouping.
 
     B starts at zero so the adapted model is exactly the base model at
-    step 0 (standard LoRA init).
+    step 0 (standard LoRA init). MLA's wkv_b_k/wkv_b_v fold their
+    trailing (heads, dh) dims: a is (L, kv_rank, r), b is
+    (L, r, heads, dh) — the adapter of the REAL expansion matrix.
     """
-    lora_cfg.validate(model_cfg)
+    lora_cfg = lora_cfg.validate(model_cfg)
     base_shapes = jax.eval_shape(
         lambda k: transformer.init_params(model_cfg, k), key
     )["layers"]
@@ -106,10 +132,19 @@ def init_lora(
         out: Dict[str, Any] = {}
         keys = jax.random.split(stack_keys[name], len(lora_cfg.targets))
         for t, k in zip(lora_cfg.targets, keys):
-            *lead, fan_in, fan_out = stack[t].shape
+            if t not in stack:
+                # Two-stack layouts: MoE-only targets are absent from
+                # the dense stack and vice versa.
+                continue
+            if _folded(t):
+                *lead, fan_in, h, dh = stack[t].shape
+                tail = (h, dh)
+            else:
+                *lead, fan_in, fan_out = stack[t].shape
+                tail = (fan_out,)
             a = (jax.random.normal(k, (*lead, fan_in, r), jnp.float32)
                  * fan_in ** -0.5).astype(pdt)
-            out[t] = {"a": a, "b": jnp.zeros((*lead, r, fan_out), pdt)}
+            out[t] = {"a": a, "b": jnp.zeros((*lead, r, *tail), pdt)}
         return out
 
     return {"layers": transformer.map_layer_stacks(base_shapes, init_stack)}
@@ -125,16 +160,28 @@ def lora_logical_axes(
     the experts axis for MoE targets) so the merge einsum is local on
     each device.
     """
+    lora_cfg = lora_cfg.validate(model_cfg)
     base_axes = transformer.logical_axes(model_cfg)["layers"]
 
     def axes_stack(stack, _name):
         out: Dict[str, Any] = {}
         for t in lora_cfg.targets:
+            if t not in stack:
+                continue
             wa = stack[t]
-            out[t] = {
-                "a": (*wa[:-1], None),
-                "b": (*wa[:-2], None, wa[-1]),
-            }
+            if _folded(t):
+                # base: (..., None, heads, None) -> a drops the head
+                # tail, b keeps it (rank axis replicated). Works for
+                # flat (L, ...) and grouped (ng, every-1, ...) leads.
+                out[t] = {
+                    "a": (*wa[:-2], None),
+                    "b": (*wa[:-3], None, *wa[-2:]),
+                }
+            else:
+                out[t] = {
+                    "a": (*wa[:-1], None),
+                    "b": (*wa[:-2], None, wa[-1]),
+                }
         return out
 
     return {"layers": transformer.map_layer_stacks(base_axes, axes_stack)}
@@ -152,9 +199,10 @@ def merge_lora(params, lora, lora_cfg: LoRAConfig):
         merged = dict(stack)
         for t, ab in lstack.items():
             w = merged[t]
+            sub = ("...ir,...rhd->...ihd" if _folded(t)
+                   else "...ir,...ro->...io")
             delta = jnp.einsum(
-                "...ir,...ro->...io",
-                ab["a"].astype(jnp.float32),
+                sub, ab["a"].astype(jnp.float32),
                 ab["b"].astype(jnp.float32),
             )
             merged[t] = (w.astype(jnp.float32)
@@ -218,7 +266,7 @@ def make_lora_train_step(
     input. Under a mesh, shardings are attached lazily on first call
     (same pattern as make_train_step).
     """
-    lora_cfg.validate(model_cfg)
+    lora_cfg = lora_cfg.validate(model_cfg)
     optimizer = make_optimizer(train_cfg)
 
     def loss_fn(lora, base_params, batch):

@@ -203,3 +203,56 @@ class TestServing:
             PagedBatchingEngine(cfg, params)
         with pytest.raises(NotImplementedError, match="kv_quant"):
             BatchingEngine(cfg, params, kv_quant="int8")
+
+
+class TestLoRA:
+    def test_mla_lora_trains_and_merges(self, model):
+        """LoRA on MLA: the generic default resolves to the latent
+        projections (wkv_b_* folded as their real matrices), adapters
+        start as the identity, and a short run moves the loss."""
+        from shellac_tpu.training.lora import (
+            LoRAConfig,
+            init_lora,
+            init_lora_state,
+            make_lora_train_step,
+            merge_lora,
+        )
+
+        cfg, params = model
+        lcfg = LoRAConfig(rank=4).validate(cfg)
+        assert "wkv_b_k" in lcfg.targets and "wq_a" in lcfg.targets
+        # q_lora_rank=None models resolve to the plain wq instead.
+        cfg_noq = cfg.replace(
+            mla=cfg.mla.__class__(**{
+                **cfg.mla.__dict__, "q_lora_rank": None,
+            })
+        ).validate()
+        lcfg_noq = LoRAConfig(rank=4).validate(cfg_noq)
+        assert "wq" in lcfg_noq.targets
+        assert "wq_a" not in lcfg_noq.targets
+        import pytest as _pt
+        with _pt.raises(ValueError, match="unknown LoRA targets"):
+            LoRAConfig(rank=4, targets=("wq_a",)).validate(cfg_noq)
+
+        lora = init_lora(cfg, lcfg, jax.random.PRNGKey(1))
+        assert lora["layers"]["wkv_b_k"]["a"].shape == (2, 32, 4)
+        assert lora["layers"]["wkv_b_k"]["b"].shape == (2, 4, 4, 16)
+        # B = 0 -> merge is the identity.
+        merged = merge_lora(params, lora, lcfg)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                                  cfg.vocab_size)
+        np.testing.assert_allclose(
+            np.asarray(transformer.forward(cfg, merged, toks)),
+            np.asarray(transformer.forward(cfg, params, toks)),
+            atol=1e-6,
+        )
+
+        tcfg = TrainConfig(learning_rate=3e-3, warmup_steps=2,
+                           total_steps=30)
+        state = init_lora_state(cfg, tcfg, lcfg, jax.random.PRNGKey(3))
+        step = make_lora_train_step(cfg, tcfg, lcfg)
+        batch = {"inputs": toks, "targets": toks}
+        state, m0 = step(state, params, batch)
+        for _ in range(15):
+            state, m = step(state, params, batch)
+        assert float(m["loss"]) < float(m0["loss"])
