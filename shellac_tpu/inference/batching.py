@@ -254,7 +254,7 @@ class BatchingEngine:
             self._cache_sh = None
             return
         if isinstance(self._cache, PagedKVCache):
-            axes = paged_cache_logical_axes()
+            axes = paged_cache_logical_axes(self.cfg)
         elif isinstance(self._cache, QuantKVCache):
             axes = quant_cache_logical_axes()
         else:
@@ -817,13 +817,6 @@ class PagedBatchingEngine(BatchingEngine):
             raise NotImplementedError(
                 "kv_quant is dense-cache only for now (the paged pool "
                 "kernels and gather path do not carry scales yet)"
-            )
-        if cfg.mla is not None:
-            raise NotImplementedError(
-                "MLA with the paged engine is not wired yet (the latent "
-                "cache needs its own pool layout); use the dense "
-                "BatchingEngine — MLA's cache is already ~n_heads-fold "
-                "smaller than expanded KV"
             )
         super().__init__(cfg, params, n_slots=n_slots, max_len=max_len, **kw)
         self.block_size = block_size

@@ -164,17 +164,19 @@ def quant_update_layer(
     return ck, cv, cks, cvs
 
 
-def paged_cache_logical_axes():
+def paged_cache_logical_axes(cfg: Optional[ModelConfig] = None):
     """Logical axes for sharding a paged cache over a mesh.
 
     The KV pools shard over kv_heads (tensor parallelism), same as the
-    dense cache; the block axis is scheduler-addressed (host-side free
-    list picks arbitrary block ids) so it stays unsharded, and the
-    tables/lengths are tiny scheduler metadata, replicated.
+    dense cache (replicated under MLA — one shared latent row); the
+    block axis is scheduler-addressed (host-side free list picks
+    arbitrary block ids) so it stays unsharded, and the tables/lengths
+    are tiny scheduler metadata, replicated.
     """
+    heads = "kv_heads" if cfg is None or cfg.mla is None else None
     return PagedKVCache(
-        k=("layers", None, "kv_heads", None, None),
-        v=("layers", None, "kv_heads", None, None),
+        k=("layers", None, heads, None, None),
+        v=("layers", None, heads, None, None),
         tables=(None, None),
         lengths=(None,),
     )
@@ -277,12 +279,12 @@ def init_paged_cache(
     block_size: int,
     max_blocks_per_slot: int,
 ) -> PagedKVCache:
-    shape = (
-        cfg.n_layers, n_blocks, cfg.kv_heads, block_size, cfg.dim_per_head,
-    )
+    head = (cfg.n_layers, n_blocks, cfg.cache_kv_heads, block_size)
     return PagedKVCache(
-        k=jnp.zeros(shape, cfg.compute_dtype),
-        v=jnp.zeros(shape, cfg.compute_dtype),
+        k=jnp.zeros((*head, cfg.cache_head_dim), cfg.compute_dtype),
+        # MLA: zero-width v pool (values re-expand from the latent the
+        # k pool stores), same convention as the dense cache.
+        v=jnp.zeros((*head, cfg.cache_v_head_dim), cfg.compute_dtype),
         tables=jnp.zeros((n_slots, max_blocks_per_slot), jnp.int32),
         lengths=jnp.zeros((n_slots,), jnp.int32),
     )

@@ -421,16 +421,11 @@ def _block(
     # --- attention ---
     hx = rms_norm(x, lp["attn_norm"], cfg.norm_eps).astype(cdt)
     if cfg.mla is not None:
-        if page_tables is not None:
-            raise NotImplementedError(
-                "MLA with the paged engine is not wired yet (the latent "
-                "cache needs its own pool layout); use the dense cache"
-            )
         if kv_scales is not None:
             raise NotImplementedError("MLA with kv_quant is not wired yet")
         o, new_cache = _mla_attention(
             cfg, mesh, attn_impl, hx, lp, cos, sin, cache,
-            fresh_cache, segments, pdot,
+            fresh_cache, segments, pdot, page_tables=page_tables,
         )
         o = pdot(o, lp["wo"])
         x = x + constrain(o, mesh, ("batch", "seq", None))
@@ -644,7 +639,7 @@ def _training_attention(cfg, mesh, attn_impl, q, k, v, segments):
 
 def _mla_attention(
     cfg: ModelConfig, mesh, attn_impl, hx, lp, cos, sin, cache,
-    fresh_cache, segments, pdot,
+    fresh_cache, segments, pdot, page_tables=None,
 ):
     """Multi-head latent attention (DeepSeek-style). Returns
     (o (B, S, H*v_head_dim), new_cache-or-None).
@@ -713,12 +708,40 @@ def _mla_attention(
         o = expanded_attention()
         return o.reshape(b, s, h * m.v_head_dim), None
 
+    def absorbed_q():
+        """Per-head queries projected into latent space + the roped
+        slice: MQA rows against the latent cache."""
+        q_eff = jnp.einsum("bshn,rhn->bshr", q_nope, w_bk)
+        return jnp.concatenate([q_eff, q_pe], axis=-1)
+
+    latent = jnp.concatenate([c[:, :, None, :], k_pe], axis=-1)  # (b,s,1,·)
+    v_stub = jnp.zeros((b, s, 1, 0), cdt)
+
+    if page_tables is not None:
+        from shellac_tpu.inference.kvcache import paged_update_layer
+        from shellac_tpu.ops.decode_attention import paged_decode_attention
+
+        pool_k, pool_v, index, _ = cache
+        pool_k, pool_v = paged_update_layer(
+            pool_k, pool_v, latent, v_stub, index, page_tables
+        )
+        new_cache = (pool_k, pool_v)
+        if fresh_cache:
+            o = expanded_attention()
+        else:
+            # Same k-as-v trick as the dense path: the latent pool
+            # serves both roles, values are its first kv_rank lanes.
+            o_lat = paged_decode_attention(
+                absorbed_q(), pool_k, pool_k, page_tables, index,
+                scale=scale, impl=attn_impl,
+            )[..., : m.kv_lora_rank]
+            o = jnp.einsum("bshr,rhv->bshv", o_lat, w_bv)
+        return o.reshape(b, s, h * m.v_head_dim), new_cache
+
     from shellac_tpu.inference.kvcache import update_layer
     from shellac_tpu.ops.decode_attention import decode_attention
 
     cache_k, cache_v, index, _ = cache
-    latent = jnp.concatenate([c[:, :, None, :], k_pe], axis=-1)  # (b,s,1,·)
-    v_stub = jnp.zeros((b, s, 1, 0), cache_v.dtype)
     cache_k, cache_v = update_layer(cache_k, cache_v, latent, v_stub, index)
     new_cache = (cache_k, cache_v)
     if fresh_cache:
@@ -727,10 +750,9 @@ def _mla_attention(
         # Absorbed decode: MQA over the latent rows. The same cache
         # array serves as k AND v (values are its first kv_rank lanes
         # after the weighted sum), so no second copy is ever stored.
-        q_eff = jnp.einsum("bshn,rhn->bshr", q_nope, w_bk)
-        q_cat = jnp.concatenate([q_eff, q_pe], axis=-1)
         o_lat = decode_attention(
-            q_cat, cache_k, cache_k, index, scale=scale, impl=attn_impl,
+            absorbed_q(), cache_k, cache_k, index, scale=scale,
+            impl=attn_impl,
         )[..., : m.kv_lora_rank]
         o = jnp.einsum("bshr,rhv->bshv", o_lat, w_bv)
     return o.reshape(b, s, h * m.v_head_dim), new_cache

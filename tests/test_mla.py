@@ -197,10 +197,27 @@ class TestServing:
                                   max_new_tokens=6)
             assert got[i] == np.asarray(res.tokens)[0].tolist(), i
 
+    def test_paged_bit_matches(self, model):
+        """Paged serving over latent-row pools == the dense engine,
+        greedy, with prefix caching reusing latent blocks."""
+        cfg, params = model
+        rng = np.random.default_rng(19)
+        common = rng.integers(1, cfg.vocab_size, size=16).tolist()
+        prompts = [common + rng.integers(1, cfg.vocab_size, size=4).tolist()
+                   for _ in range(4)]
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64).run(
+            [(i, p, 6) for i, p in enumerate(prompts)]
+        )
+        eng = PagedBatchingEngine(
+            cfg, params, n_slots=2, max_len=64, block_size=16,
+            prefix_cache=True,
+        )
+        got = eng.run([(i, p, 6) for i, p in enumerate(prompts)])
+        assert got == want
+        assert eng.stats["prefix_hit_tokens"] > 0
+
     def test_guards(self, model):
         cfg, params = model
-        with pytest.raises(NotImplementedError, match="paged"):
-            PagedBatchingEngine(cfg, params)
         with pytest.raises(NotImplementedError, match="kv_quant"):
             BatchingEngine(cfg, params, kv_quant="int8")
 
