@@ -256,7 +256,7 @@ class BatchingEngine:
         if isinstance(self._cache, PagedKVCache):
             axes = paged_cache_logical_axes(self.cfg)
         elif isinstance(self._cache, QuantKVCache):
-            axes = quant_cache_logical_axes()
+            axes = quant_cache_logical_axes(self.cfg)
         else:
             axes = cache_logical_axes(self.cfg)
         self._cache_sh = make_shardings(self.mesh, axes)

@@ -92,7 +92,7 @@ class Engine:
         else:
             # Pin the cache layout at the prefill boundary; decode then
             # inherits it from its (committed) cache argument.
-            axes = (quant_cache_logical_axes() if kv_quant
+            axes = (quant_cache_logical_axes(cfg) if kv_quant
                     else cache_logical_axes(cfg))
             cache_sh = make_shardings(mesh, axes)
             self._prefill = jax.jit(

@@ -99,22 +99,23 @@ class QuantKVCache:
 
 
 def init_quant_cache(cfg: ModelConfig, batch: int, max_len: int) -> QuantKVCache:
-    shape = (cfg.n_layers, batch, cfg.kv_heads, max_len, cfg.dim_per_head)
+    head = (cfg.n_layers, batch, cfg.cache_kv_heads, max_len)
     return QuantKVCache(
-        k=jnp.zeros(shape, jnp.int8),
-        v=jnp.zeros(shape, jnp.int8),
-        ks=jnp.zeros(shape[:-1], jnp.float32),
-        vs=jnp.zeros(shape[:-1], jnp.float32),
+        k=jnp.zeros((*head, cfg.cache_head_dim), jnp.int8),
+        v=jnp.zeros((*head, cfg.cache_v_head_dim), jnp.int8),
+        ks=jnp.zeros(head, jnp.float32),
+        vs=jnp.zeros(head, jnp.float32),
         lengths=jnp.zeros((batch,), jnp.int32),
     )
 
 
-def quant_cache_logical_axes():
+def quant_cache_logical_axes(cfg: Optional[ModelConfig] = None):
+    heads = "kv_heads" if cfg is None or cfg.mla is None else None
     return QuantKVCache(
-        k=("layers", "batch", "kv_heads", None, None),
-        v=("layers", "batch", "kv_heads", None, None),
-        ks=("layers", "batch", "kv_heads", None),
-        vs=("layers", "batch", "kv_heads", None),
+        k=("layers", "batch", heads, None, None),
+        v=("layers", "batch", heads, None, None),
+        ks=("layers", "batch", heads, None),
+        vs=("layers", "batch", heads, None),
         lengths=("batch",),
     )
 
@@ -123,12 +124,6 @@ def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
                    kv_quant=None):
     """The engines' cache constructor: dense bf16 or int8 by kv_quant."""
     if kv_quant == "int8":
-        if cfg.mla is not None:
-            raise NotImplementedError(
-                "kv_quant with MLA is not wired yet (the latent cache "
-                "needs its own scale layout); MLA's cache is already "
-                "~n_heads-fold smaller than expanded KV"
-            )
         return init_quant_cache(cfg, batch, max_len)
     if kv_quant is not None:
         raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
@@ -136,7 +131,14 @@ def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
 
 
 def quantize_kv(x: jax.Array):
-    """(B, S, Hkv, Dh) -> int8 values + (B, S, Hkv) fp32 scales."""
+    """(B, S, Hkv, Dh) -> int8 values + (B, S, Hkv) fp32 scales.
+
+    Zero-width inputs (MLA's v placeholder) quantize to a zero-width
+    int8 array with unit scales — an empty-axis amax would be -inf.
+    """
+    if x.shape[-1] == 0:
+        return (jnp.zeros(x.shape, jnp.int8),
+                jnp.ones(x.shape[:-1], jnp.float32))
     xf = x.astype(jnp.float32)
     amax = jnp.max(jnp.abs(xf), axis=-1)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)

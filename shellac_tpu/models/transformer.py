@@ -421,11 +421,10 @@ def _block(
     # --- attention ---
     hx = rms_norm(x, lp["attn_norm"], cfg.norm_eps).astype(cdt)
     if cfg.mla is not None:
-        if kv_scales is not None:
-            raise NotImplementedError("MLA with kv_quant is not wired yet")
         o, new_cache = _mla_attention(
             cfg, mesh, attn_impl, hx, lp, cos, sin, cache,
             fresh_cache, segments, pdot, page_tables=page_tables,
+            kv_scales=kv_scales,
         )
         o = pdot(o, lp["wo"])
         x = x + constrain(o, mesh, ("batch", "seq", None))
@@ -639,7 +638,7 @@ def _training_attention(cfg, mesh, attn_impl, q, k, v, segments):
 
 def _mla_attention(
     cfg: ModelConfig, mesh, attn_impl, hx, lp, cos, sin, cache,
-    fresh_cache, segments, pdot, page_tables=None,
+    fresh_cache, segments, pdot, page_tables=None, kv_scales=None,
 ):
     """Multi-head latent attention (DeepSeek-style). Returns
     (o (B, S, H*v_head_dim), new_cache-or-None).
@@ -738,10 +737,31 @@ def _mla_attention(
             o = jnp.einsum("bshr,rhv->bshv", o_lat, w_bv)
         return o.reshape(b, s, h * m.v_head_dim), new_cache
 
-    from shellac_tpu.inference.kvcache import update_layer
     from shellac_tpu.ops.decode_attention import decode_attention
 
     cache_k, cache_v, index, _ = cache
+    if kv_scales is not None:
+        # Int8 latent cache: one scale per latent row; the k array (and
+        # its scale) serves both attention roles, like the bf16 path.
+        from shellac_tpu.inference.kvcache import quant_update_layer
+
+        ks_l, vs_l = kv_scales
+        cache_k, cache_v, ks_l, vs_l = quant_update_layer(
+            cache_k, cache_v, ks_l, vs_l, latent, v_stub, index
+        )
+        new_cache = (cache_k, cache_v, ks_l, vs_l)
+        if fresh_cache:
+            o = expanded_attention()
+        else:
+            o_lat = decode_attention(
+                absorbed_q(), cache_k, cache_k, index, scale=scale,
+                impl=attn_impl, k_scale=ks_l, v_scale=ks_l,
+            )[..., : m.kv_lora_rank]
+            o = jnp.einsum("bshr,rhv->bshv", o_lat, w_bv)
+        return o.reshape(b, s, h * m.v_head_dim), new_cache
+
+    from shellac_tpu.inference.kvcache import update_layer
+
     cache_k, cache_v = update_layer(cache_k, cache_v, latent, v_stub, index)
     new_cache = (cache_k, cache_v)
     if fresh_cache:

@@ -216,10 +216,29 @@ class TestServing:
         assert got == want
         assert eng.stats["prefix_hit_tokens"] > 0
 
-    def test_guards(self, model):
+    def test_int8_latent_cache(self, model):
+        """kv_quant='int8' quantizes the latent rows (one scale per
+        row); batching stays bit-identical to the single-request
+        engine, and greedy typically matches the bf16 cache."""
         cfg, params = model
-        with pytest.raises(NotImplementedError, match="kv_quant"):
-            BatchingEngine(cfg, params, kv_quant="int8")
+        rng = np.random.default_rng(23)
+        prompts = [rng.integers(1, cfg.vocab_size, size=n).tolist()
+                   for n in (3, 7, 5)]
+        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             kv_quant="int8")
+        got = eng.run([(i, p, 8) for i, p in enumerate(prompts)])
+        single = Engine(cfg, params, temperature=0.0, max_len=64,
+                        kv_quant="int8")
+        for i, p in enumerate(prompts):
+            res = single.generate(jnp.asarray([p], jnp.int32),
+                                  max_new_tokens=8)
+            assert got[i] == np.asarray(res.tokens)[0].tolist(), i
+        from shellac_tpu.inference.kvcache import init_cache_for
+
+        cache = init_cache_for(cfg, 2, 32, "int8")
+        assert cache.k.dtype == jnp.int8
+        assert cache.k.shape == (cfg.n_layers, 2, 1, 32, 40)
+        assert cache.v.shape == (cfg.n_layers, 2, 1, 32, 0)
 
 
 class TestLoRA:
