@@ -37,7 +37,7 @@ import numpy as np
 
 
 def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
-                 decode_ticks=1):
+                 decode_ticks=1, kv_quant=None):
     from shellac_tpu.inference.batching import (
         BatchingEngine,
         PagedBatchingEngine,
@@ -55,15 +55,16 @@ def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
     return BatchingEngine(
         cfg, params, n_slots=n_slots, max_len=max_len,
         temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
+        kv_quant=kv_quant,
     )
 
 
 def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
-                 ticks, rng, decode_ticks=1):
+                 ticks, rng, decode_ticks=1, kv_quant=None):
     """Decode tokens/s with every slot held live at ~ctx context."""
     eng = build_engine(
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
-        max_len=max_len, decode_ticks=decode_ticks,
+        max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
     )
     budget = max_len - ctx - 1
     need = (2 + ticks) * decode_ticks
@@ -99,11 +100,11 @@ def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
 
 
 def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
-          decode_ticks=1):
+          decode_ticks=1, kv_quant=None):
     """Drain 3*n_slots ragged requests; tokens/s of generated tokens."""
     eng = build_engine(
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
-        max_len=max_len, decode_ticks=decode_ticks,
+        max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
     )
     n_req = 3 * n_slots
     gen_budget = min(64, max(4, (max_len - ctx) // 2))
@@ -244,6 +245,8 @@ def main():
     ap.add_argument("--mode", default="engine",
                     choices=["engine", "kernel", "prefix"])
     ap.add_argument("--variants", default="dense:auto,dense:ref,paged:auto,paged:ref")
+    ap.add_argument("--kv-quant", choices=["int8"],
+                    help="int8 KV cache on the dense engine variants")
     args = ap.parse_args()
 
     import jax
@@ -315,19 +318,24 @@ def main():
         cache_kind, impl = variant.split(":")
         paged = cache_kind == "paged"
         rng = np.random.default_rng(0)
+        kvq = None if paged else args.kv_quant
+        if paged and args.kv_quant:
+            print(f"note: --kv-quant skipped for {variant} "
+                  "(paged pools are bf16-only)", file=sys.stderr)
         tok_s, tick_s = steady_state(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, ticks=args.ticks, rng=rng,
-            decode_ticks=args.decode_ticks,
+            decode_ticks=args.decode_ticks, kv_quant=kvq,
         )
         churn_tok_s, churn_total = churn(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, rng=rng,
-            decode_ticks=args.decode_ticks,
+            decode_ticks=args.decode_ticks, kv_quant=kvq,
         )
         row = {
             "metric": f"decode_throughput_{args.model}_ctx{args.ctx}_"
-                      f"{cache_kind}_{impl}_{backend}",
+                      f"{cache_kind}_{impl}"
+                      f"{'_kvq' + args.kv_quant if kvq else ''}_{backend}",
             "value": round(tok_s, 1),
             "unit": "tokens/s",
             "detail": {

@@ -51,6 +51,10 @@ run engine_dense 580 python scripts/bench_decode.py \
 run engine_paged 580 python scripts/bench_decode.py \
   --variants paged:auto,paged:ref --decode-ticks 8
 run engine_prefix 580 python scripts/bench_decode.py --mode prefix
+run engine_mla 580 python scripts/bench_decode.py \
+  --model shellac-mla-2b --variants dense:auto,dense:ref --decode-ticks 8
+run engine_kvq 580 python scripts/bench_decode.py \
+  --variants dense:auto --decode-ticks 8 --kv-quant int8
 
 # 4. Training bench variants (headline recipe + packed + quant + fused).
 run train_plain 580 python bench.py
