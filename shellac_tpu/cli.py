@@ -527,9 +527,6 @@ def cmd_serve(args):
             "multi-host serve needs an explicit --mesh (e.g. tp=8) "
             "multiplying out to the GLOBAL device count"
         )
-    if args.draft_model and multihost:
-        raise SystemExit("--draft-model serving is single-host (tp via "
-                         "--mesh works); drop the distributed environment")
     cfg = _model_config(args)
     params = _apply_lora(args, cfg, _restore_params(args, cfg))
     if args.quantize:

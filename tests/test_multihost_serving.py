@@ -110,6 +110,40 @@ print("WORKER_OK", jax.process_index(), flush=True)
 """
 
 
+_SPEC_WORKER = """import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+import numpy as np
+from shellac_tpu import ParallelConfig, get_model_config
+from shellac_tpu.inference.batching import BatchingEngine
+from shellac_tpu.inference.engine import shard_params
+from shellac_tpu.inference.multihost import MultihostEngine
+from shellac_tpu.inference.spec_batching import SpeculativeBatchingEngine
+from shellac_tpu.models import transformer
+from shellac_tpu.parallel.distributed import global_mesh, initialize
+
+assert initialize()
+cfg = get_model_config("tiny").replace(dtype="float32")
+params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+mesh = global_mesh(ParallelConfig(tp=4))
+sharded = shard_params(cfg, params, mesh)
+eng = MultihostEngine(SpeculativeBatchingEngine(
+    cfg, sharded, cfg, sharded, gamma=3, n_slots=2, max_len=64, mesh=mesh,
+))
+rng = np.random.default_rng(29)
+prompts = [rng.integers(1, cfg.vocab_size, size=n).tolist() for n in (3, 6, 4)]
+if eng.is_primary:
+    got = eng.run([(i, p, 8) for i, p in enumerate(prompts)])
+    want = BatchingEngine(cfg, params, n_slots=2, max_len=64).run(
+        [(i, p, 8) for i, p in enumerate(prompts)])
+    assert got == want, (got, want)
+else:
+    eng.serve_forever()
+    assert eng.stats["requests_completed"] == len(prompts)
+print("WORKER_OK", jax.process_index(), flush=True)
+"""
+
+
 class TestMultihostServing:
     def _run_pair(self, tmp_path, source):
         from conftest import run_two_process
@@ -123,6 +157,12 @@ class TestMultihostServing:
     def test_two_process_lockstep_serving(self, tmp_path):
         """Engine-level drive: rank 0 run()s, rank 1 mirrors."""
         self._run_pair(tmp_path, _WORKER)
+
+    def test_two_process_speculative_serving(self, tmp_path):
+        """Speculative batching under the lockstep wrapper: the
+        draft/verify rounds are deterministic given the command stream,
+        so the replicas stay bit-identical too."""
+        self._run_pair(tmp_path, _SPEC_WORKER)
 
 
 class TestSingleProcessDegenerate:
