@@ -53,6 +53,11 @@ def config_from_hf(hf_cfg) -> ModelConfig:
             ),
             dropless=True,
         )
+    if getattr(hf_cfg, "model_type", "") == "phi3":
+        if getattr(hf_cfg, "partial_rotary_factor", 1.0) != 1.0:
+            raise NotImplementedError(
+                "phi3 partial_rotary_factor != 1 is not supported"
+            )
     is_qwen3 = getattr(hf_cfg, "model_type", "") in ("qwen3", "qwen3_moe")
     if getattr(hf_cfg, "model_type", "") == "qwen3_moe":
         if getattr(hf_cfg, "mlp_only_layers", None):
@@ -428,10 +433,21 @@ def params_from_state_dict(
         k: []
         for k in [*attn_keys, *bias_keys, *mlp_keys, "attn_norm", "mlp_norm"]
     }
+    # Phi3 fuses q/k/v into one qkv_proj and gate/up into gate_up_proj;
+    # detect from the keys and split on conversion.
+    fused_qkv = f"{prefix}layers.0.self_attn.qkv_proj.weight" in sd
     for i in range(cfg.n_layers):
         base = f"layers.{i}."
         if cfg.mla is not None:
             _collect_mla_layer(layers, cfg.mla, get, base, norm_offset)
+        elif fused_qkv:
+            w = get(base + "self_attn.qkv_proj.weight").T  # (d, q+2kv)
+            qd = cfg.n_heads * cfg.dim_per_head
+            kvd = cfg.kv_heads * cfg.dim_per_head
+            layers["wq"].append(w[:, :qd])
+            layers["wk"].append(w[:, qd:qd + kvd])
+            layers["wv"].append(w[:, qd + kvd:])
+            layers["wo"].append(get(base + "self_attn.o_proj.weight").T)
         else:
             for ours, (theirs, transpose) in _ATTN_MAP.items():
                 w = get(base + theirs)
@@ -466,6 +482,12 @@ def params_from_state_dict(
                         for j in range(cfg.moe.num_experts)
                     ]
                     layers[ours].append(np.stack(experts))
+        elif fused_qkv:
+            gu = get(base + "mlp.gate_up_proj.weight").T  # (d, 2f)
+            f = gu.shape[1] // 2
+            layers["w_gate"].append(gu[:, :f])
+            layers["w_up"].append(gu[:, f:])
+            layers["w_down"].append(get(base + "mlp.down_proj.weight").T)
         else:
             for ours, (theirs, transpose) in _DENSE_MLP_MAP.items():
                 w = get(base + theirs)

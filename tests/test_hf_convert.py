@@ -330,6 +330,45 @@ def test_deepseek_moe_logits_parity(topk_method, n_group, topk_group, scale):
     np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=2e-3)
 
 
+def test_phi3_logits_and_generation_parity():
+    """Phi-3 (fused qkv_proj / gate_up_proj) converts exactly; greedy
+    generation through the cache is token-exact."""
+    from shellac_tpu.inference.engine import Engine
+
+    cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        attn_implementation="eager", sliding_window=None,
+        pad_token_id=0,  # default 32000 overflows the tiny vocab
+    )
+    torch.manual_seed(8)
+    model = transformers.Phi3ForCausalLM(cfg).eval()
+    ours_cfg, params = from_hf(model)
+    ours_cfg = ours_cfg.replace(dtype="float32")
+    assert ours_cfg.kv_heads == 2
+
+    tokens = np.array([[3, 17, 42, 99, 7, 23, 56, 1]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(ours_cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+    prompt = np.array([[5, 9, 2, 31]], np.int64)
+    with torch.no_grad():
+        gref = model.generate(
+            torch.from_numpy(prompt), max_new_tokens=10, do_sample=False,
+        ).numpy()[:, prompt.shape[1]:]
+    out = Engine(ours_cfg, params, temperature=0.0, max_len=64).generate(
+        jnp.asarray(prompt, jnp.int32), max_new_tokens=10
+    )
+    np.testing.assert_array_equal(np.asarray(out.tokens), gref)
+
+
 def test_qwen3_moe_logits_parity():
     """Qwen3-MoE: qk-norm attention + uniform softmax top-k MoE with
     narrow experts, HF's mlp.* naming — exact parity."""
