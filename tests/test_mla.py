@@ -66,6 +66,31 @@ class TestTraining:
             np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
         )
 
+    def test_packed_segments(self, model):
+        """Packed pretraining rows: each document computes as if alone
+        (block-diagonal attention + per-segment rope restart)."""
+        cfg, params = model
+        a = jax.random.randint(jax.random.PRNGKey(5), (1, 12), 1,
+                               cfg.vocab_size)
+        bq = jax.random.randint(jax.random.PRNGKey(6), (1, 20), 1,
+                                cfg.vocab_size)
+        packed = jnp.concatenate([a, bq], axis=1)
+        seg = jnp.concatenate(
+            [jnp.zeros((1, 12), jnp.int32), jnp.ones((1, 20), jnp.int32)],
+            axis=1,
+        )
+        out = transformer.forward(cfg, params, packed, segment_ids=seg)
+        ref_a = transformer.forward(cfg, params, a)
+        ref_b = transformer.forward(cfg, params, bq)
+        np.testing.assert_allclose(
+            np.asarray(out[:, :12]), np.asarray(ref_a), atol=2e-5,
+            rtol=2e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out[:, 12:]), np.asarray(ref_b), atol=2e-5,
+            rtol=2e-5,
+        )
+
     def test_trains_on_fsdp_mesh(self, mesh_fsdp8, model):
         from shellac_tpu.training import (
             batch_shardings,
@@ -290,5 +315,44 @@ class TestLoRA:
         batch = {"inputs": toks, "targets": toks}
         state, m0 = step(state, params, batch)
         for _ in range(15):
+            state, m = step(state, params, batch)
+        assert float(m["loss"]) < float(m0["loss"])
+
+    def test_first_k_dense_lora(self):
+        """LoRA over the two-stack first-k layout: per-stack adapters
+        (dense MLP in the prefix, experts in the MoE suffix), identity
+        at B=0, and a step that moves the loss."""
+        from shellac_tpu.training.lora import (
+            LoRAConfig,
+            init_lora,
+            init_lora_state,
+            make_lora_train_step,
+            merge_lora,
+        )
+
+        cfg = get_model_config("tiny-deepseek").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        lcfg = LoRAConfig(
+            rank=4, targets=("wkv_a", "wo", "w_gate", "w_up", "w_down"),
+        ).validate(cfg)
+        lora = init_lora(cfg, lcfg, jax.random.PRNGKey(1))
+        # Dense prefix: plain MLP adapters; MoE suffix: per-expert.
+        assert lora["layers"]["dense"]["w_gate"]["a"].shape[:2] == (1, 64)
+        assert lora["layers"]["moe"]["w_gate"]["a"].shape[:2] == (2, 4)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                                  cfg.vocab_size)
+        merged = merge_lora(params, lora, lcfg)
+        np.testing.assert_allclose(
+            np.asarray(transformer.forward(cfg, merged, toks)),
+            np.asarray(transformer.forward(cfg, params, toks)),
+            atol=1e-6,
+        )
+        tcfg = TrainConfig(learning_rate=3e-3, warmup_steps=2,
+                           total_steps=20)
+        state = init_lora_state(cfg, tcfg, lcfg, jax.random.PRNGKey(3))
+        step = make_lora_train_step(cfg, tcfg, lcfg)
+        batch = {"inputs": toks, "targets": toks}
+        state, m0 = step(state, params, batch)
+        for _ in range(10):
             state, m = step(state, params, batch)
         assert float(m["loss"]) < float(m0["loss"])
