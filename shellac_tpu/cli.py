@@ -83,12 +83,27 @@ def _load_native(native_dir):
 
     import orbax.checkpoint as ocp
 
-    from shellac_tpu.config import ModelConfig, MoEConfig
+    from shellac_tpu.config import (
+        Llama3RopeConfig,
+        MLAConfig,
+        ModelConfig,
+        MoEConfig,
+        YarnConfig,
+    )
 
     with open(os.path.join(native_dir, "config.json")) as f:
         cfg_d = json.load(f)
-    moe = cfg_d.pop("moe", None)
-    cfg = ModelConfig(**cfg_d, moe=MoEConfig(**moe) if moe else None)
+    # Rehydrate every nested config dataclass (dataclasses.asdict wrote
+    # them as plain dicts).
+    nested = {
+        "moe": MoEConfig, "mla": MLAConfig,
+        "rope_yarn": YarnConfig, "rope_llama3": Llama3RopeConfig,
+    }
+    kw = {}
+    for name, cls in nested.items():
+        d = cfg_d.pop(name, None)
+        kw[name] = cls(**d) if d else None
+    cfg = ModelConfig(**cfg_d, **kw)
     params = ocp.StandardCheckpointer().restore(
         os.path.join(os.path.abspath(native_dir), "params")
     )

@@ -93,6 +93,22 @@ class YarnConfig:
 
 
 @dataclass(frozen=True)
+class Llama3RopeConfig:
+    """Llama-3.1 rope scaling (wavelength-banded frequency division).
+
+    Matches HF's `rope_scaling: {"rope_type": "llama3", ...}` exactly:
+    wavelengths longer than old_context/low_freq_factor divide by
+    `factor`, shorter than old_context/high_freq_factor stay put, and
+    the band between interpolates smoothly. No attention factor.
+    """
+
+    factor: float
+    low_freq_factor: float
+    high_freq_factor: float
+    original_max_position_embeddings: int
+
+
+@dataclass(frozen=True)
 class MLAConfig:
     """Multi-head latent attention (DeepSeek-V2/V3 style).
 
@@ -173,9 +189,11 @@ class ModelConfig:
     # is shared MQA-style) and head_dim is ignored in favour of the
     # MLA dims.
     mla: Optional[MLAConfig] = None
-    # Yarn rope scaling for long-context checkpoints (applies to the
-    # rope_dim — MLA's qk_rope slice or the full head_dim).
+    # Rope scaling for long-context checkpoints (applies to the
+    # rope_dim — MLA's qk_rope slice or the full head_dim). At most one
+    # of yarn (DeepSeek/Qwen long-context) / llama3 (Llama-3.1 family).
     rope_yarn: Optional[YarnConfig] = None
+    rope_llama3: Optional[Llama3RopeConfig] = None
     # Per-head-dim RMSNorm on q and k before rope (Qwen3-style).
     qk_norm: bool = False
 
@@ -281,6 +299,8 @@ class ModelConfig:
                 f"quant_training={self.quant_training!r}; "
                 "have None, 'int8', 'int8_bwd'"
             )
+        if self.rope_yarn is not None and self.rope_llama3 is not None:
+            raise ValueError("rope_yarn and rope_llama3 are exclusive")
         if self.mla is not None:
             if self.n_kv_heads is not None:
                 raise ValueError(

@@ -106,9 +106,9 @@ def config_from_hf(hf_cfg) -> ModelConfig:
             or getattr(hf_cfg, "model_type", "") == "qwen2"
         ),
         qk_norm=is_qwen3,
-        # Long-context checkpoints: yarn converts exactly; any other
-        # rope_scaling type fails loudly instead of silently diverging.
-        rope_yarn=_yarn_from_hf(
+        # Long-context checkpoints: yarn/llama3 convert exactly; any
+        # other rope_scaling type fails loudly.
+        **_rope_from_hf(
             getattr(hf_cfg, "rope_scaling", None),
             hf_cfg.max_position_embeddings,
         ),
@@ -234,30 +234,46 @@ def _deepseek_config(hf_cfg) -> ModelConfig:
         ),
         moe=moe,
         first_k_dense=first_k if moe is not None else 0,
-        rope_yarn=_yarn_from_hf(
+        **_rope_from_hf(
             getattr(hf_cfg, "rope_scaling", None),
             hf_cfg.max_position_embeddings,
         ),
     ).validate()
 
 
-def _yarn_from_hf(rs, max_pos) -> "Optional[object]":
-    """YarnConfig from an HF rope_scaling dict (None passes through).
+def _rope_from_hf(rs, max_pos) -> dict:
+    """ModelConfig rope-scaling kwargs from an HF rope_scaling dict.
 
-    DeepSeek's long-context checkpoints ship
-    {"rope_type": "yarn", factor, original_max_position_embeddings,
-    mscale, mscale_all_dim, ...}; other scaling types fail loudly.
+    yarn (DeepSeek/Qwen long-context) and llama3 (Llama-3.1 family)
+    convert exactly; other scaling types fail loudly.
     """
     if not rs:
-        return None
-    from shellac_tpu.config import YarnConfig
+        return {}
+    from shellac_tpu.config import Llama3RopeConfig, YarnConfig
 
     kind = rs.get("rope_type", rs.get("type"))
+    if kind == "llama3":
+        if not rs.get("original_max_position_embeddings"):
+            # Required: falling back to the post-scaling max would shift
+            # both wavelength bands by the factor — silent divergence.
+            raise ValueError(
+                "llama3 rope_scaling requires "
+                "original_max_position_embeddings"
+            )
+        return {"rope_llama3": Llama3RopeConfig(
+            factor=rs["factor"],
+            low_freq_factor=rs["low_freq_factor"],
+            high_freq_factor=rs["high_freq_factor"],
+            original_max_position_embeddings=rs[
+                "original_max_position_embeddings"
+            ],
+        )}
     if kind != "yarn":
         raise NotImplementedError(
-            f"rope_scaling type {kind!r} is not supported (have: yarn)"
+            f"rope_scaling type {kind!r} is not supported "
+            "(have: yarn, llama3)"
         )
-    return YarnConfig(
+    return {"rope_yarn": YarnConfig(
         factor=rs["factor"],
         original_max_position_embeddings=rs.get(
             "original_max_position_embeddings"
@@ -268,7 +284,7 @@ def _yarn_from_hf(rs, max_pos) -> "Optional[object]":
         mscale_all_dim=rs.get("mscale_all_dim"),
         attention_factor=rs.get("attention_factor"),
         truncate=rs.get("truncate", True),
-    )
+    )}
 
 
 def _hf_attn_window(hf_cfg) -> Optional[int]:

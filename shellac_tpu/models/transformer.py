@@ -831,7 +831,7 @@ def forward(
         else:
             pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     cos, sin = rope_angles(pos, cfg.rope_dim, cfg.rope_theta,
-                           yarn=cfg.rope_yarn)
+                           yarn=cfg.rope_yarn, llama3=cfg.rope_llama3)
 
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))
@@ -1115,7 +1115,7 @@ def forward_with_cache(
         jnp.arange(s, dtype=jnp.int32), (b, s)
     )
     cos, sin = rope_angles(positions, cfg.rope_dim, cfg.rope_theta,
-                           yarn=cfg.rope_yarn)
+                           yarn=cfg.rope_yarn, llama3=cfg.rope_llama3)
 
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))

@@ -12,25 +12,49 @@ import jax.numpy as jnp
 
 
 def rope_angles(positions: jax.Array, head_dim: int, theta: float = 10000.0,
-                yarn=None):
+                yarn=None, llama3=None):
     """cos/sin tables for given absolute positions.
 
     positions: int32 array, any shape (typically (B, S) or (S,)).
     Returns (cos, sin) with shape positions.shape + (head_dim // 2,), fp32.
     With a YarnConfig the inverse frequencies blend interpolation and
     extrapolation per the NTK-by-parts recipe and the tables carry the
-    attention (mscale) factor — numerics match HF's yarn rope exactly.
+    attention (mscale) factor; with a Llama3RopeConfig the frequencies
+    scale by wavelength band — both numerics match HF exactly.
     """
     half = head_dim // 2
-    if yarn is None:
+    scale = 1.0
+    if yarn is not None:
+        freq, scale = _yarn_inv_freq(head_dim, theta, yarn)
+    else:
         freq = 1.0 / (
             theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
         )
-        scale = 1.0
-    else:
-        freq, scale = _yarn_inv_freq(head_dim, theta, yarn)
+        if llama3 is not None:
+            freq = _llama3_inv_freq(freq, llama3)
     ang = positions.astype(jnp.float32)[..., None] * freq
     return jnp.cos(ang) * scale, jnp.sin(ang) * scale
+
+
+def _llama3_inv_freq(inv_freq: jax.Array, l3):
+    """Llama-3.1 banded frequency scaling (HF _compute_llama3_parameters).
+
+    Long wavelengths divide by `factor`, short ones stay, the middle
+    band interpolates by a smooth factor in old-context rotations.
+    """
+    import math
+
+    old = l3.original_max_position_embeddings
+    low_wl = old / l3.low_freq_factor
+    high_wl = old / l3.high_freq_factor
+    wavelen = 2 * math.pi / inv_freq
+    scaled = jnp.where(wavelen > low_wl, inv_freq / l3.factor, inv_freq)
+    smooth = (old / wavelen - l3.low_freq_factor) / (
+        l3.high_freq_factor - l3.low_freq_factor
+    )
+    smoothed = (1 - smooth) * scaled / l3.factor + smooth * scaled
+    medium = (~(wavelen < high_wl)) & (~(wavelen > low_wl))
+    return jnp.where(medium, smoothed, scaled)
 
 
 def _yarn_inv_freq(dim: int, base: float, yarn):

@@ -236,6 +236,78 @@ def test_qwen3_yarn_logits_parity():
     np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
 
 
+def test_llama3_rope_scaling_parity():
+    """Llama-3.1 family rope scaling (banded frequency division)
+    converts with exact logits parity."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        rms_norm_eps=1e-5, rope_theta=10000.0,
+        attn_implementation="eager",
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64},
+    )
+    torch.manual_seed(9)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    ours_cfg, params = from_hf(model)
+    ours_cfg = ours_cfg.replace(dtype="float32")
+    assert ours_cfg.rope_llama3 is not None
+    assert ours_cfg.rope_llama3.factor == 8.0
+
+    tokens = np.array([[3, 17, 42, 99, 7, 23, 56, 1, 88, 4]], np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(ours_cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-3)
+
+
+def test_native_roundtrip_rehydrates_nested_configs(tmp_path):
+    """convert -> _load_native must rebuild every nested config
+    dataclass (rope_llama3/mla/moe), not leave raw dicts that crash at
+    first forward."""
+    import dataclasses as dc
+    import json as _json
+
+    import orbax.checkpoint as ocp
+
+    from shellac_tpu.cli import _load_native
+    from shellac_tpu.config import Llama3RopeConfig
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=176,
+        num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256,
+        attn_implementation="eager",
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64},
+    )
+    torch.manual_seed(10)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    ours_cfg, params = from_hf(model)
+    out = str(tmp_path / "native")
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(out + "/params", params, force=True)
+    ckptr.wait_until_finished()
+    with open(tmp_path / "native" / "config.json", "w") as f:
+        _json.dump(dc.asdict(ours_cfg), f)
+
+    cfg2, params2 = _load_native(out)
+    assert isinstance(cfg2.rope_llama3, Llama3RopeConfig)
+    toks = jnp.asarray([[3, 9, 42, 7]], jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(transformer.forward(
+            cfg2.replace(dtype="float32"), params2, toks)),
+        np.asarray(transformer.forward(
+            ours_cfg.replace(dtype="float32"), params, toks)),
+        atol=1e-6,
+    )
+
+
 def test_unsupported_rope_scaling_rejected():
     cfg = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
