@@ -168,6 +168,21 @@ class ModelConfig:
     remat_policy: str = "none"
     # Optional sliding-window attention (None = full causal).
     attn_window: Optional[int] = None
+    # Per-layer attention kinds, cycled over the depth (Gemma-2/3
+    # style): entries are "window" (uses attn_window) or "full".
+    # n_layers must divide into whole pattern periods. None = every
+    # layer uses attn_window as-is.
+    attn_pattern: Optional[tuple] = None
+    # Gemma-2 tanh soft-capping of the SCALED attention scores
+    # (cap * tanh(s / cap), applied before masking).
+    attn_softcap: Optional[float] = None
+    # Score scale override (Gemma-2's query_pre_attn_scalar**-0.5);
+    # None = head_dim**-0.5.
+    attn_scale: Optional[float] = None
+    # Sandwich norms (Gemma-2/3): an extra RMSNorm on each residual
+    # branch's OUTPUT (post-attention and post-MLP), alongside the usual
+    # pre-norms.
+    post_norms: bool = False
     # False = bidirectional (encoder) attention. Decoder-only features
     # (KV-cache generation) require causal=True.
     causal: bool = True
@@ -196,6 +211,14 @@ class ModelConfig:
     rope_llama3: Optional[Llama3RopeConfig] = None
     # Per-head-dim RMSNorm on q and k before rope (Qwen3-style).
     qk_norm: bool = False
+
+    def __post_init__(self):
+        # JSON configs arrive with attn_pattern as a list; the frozen
+        # dataclass stores the hashable tuple every consumer expects.
+        if self.attn_pattern is not None and not isinstance(
+            self.attn_pattern, tuple
+        ):
+            object.__setattr__(self, "attn_pattern", tuple(self.attn_pattern))
 
     @property
     def kv_heads(self) -> int:
@@ -257,6 +280,32 @@ class ModelConfig:
             )
         if self.moe is not None and self.moe_every < 1:
             raise ValueError("moe_every must be >= 1")
+        if self.attn_pattern is not None:
+            if not self.attn_pattern:
+                raise ValueError(
+                    "attn_pattern must be a non-empty tuple or None"
+                )
+            bad = set(self.attn_pattern) - {"window", "full"}
+            if bad:
+                raise ValueError(
+                    f"attn_pattern entries must be 'window' or 'full'; "
+                    f"got {sorted(bad)}"
+                )
+            if "window" in self.attn_pattern and self.attn_window is None:
+                raise ValueError(
+                    "attn_pattern uses 'window' layers but attn_window "
+                    "is unset"
+                )
+            if self.n_layers % len(self.attn_pattern):
+                raise ValueError(
+                    f"n_layers={self.n_layers} must divide into whole "
+                    f"attn_pattern periods (len {len(self.attn_pattern)})"
+                )
+            if self.moe_every > 1 or self.first_k_dense:
+                raise ValueError(
+                    "attn_pattern with interleaved dense/MoE layouts is "
+                    "not supported yet (uniform layers or full MoE only)"
+                )
         if self.first_k_dense:
             if self.moe is None:
                 raise ValueError("first_k_dense needs a MoEConfig")
@@ -309,6 +358,14 @@ class ModelConfig:
                 )
             if self.attn_window is not None:
                 raise ValueError("MLA with sliding windows is not defined")
+            if self.attn_softcap is not None or self.attn_scale is not None:
+                # The absorbed latent decode uses its own exact algebra
+                # and scale; capping/rescaling would silently diverge
+                # between the training forward and cached decode.
+                raise ValueError(
+                    "attn_softcap/attn_scale are not defined for MLA "
+                    "models (the absorbed decode fixes the score scale)"
+                )
             if self.attn_bias:
                 raise ValueError("MLA attn_bias is not supported yet")
             if not self.causal:

@@ -43,6 +43,8 @@ def config_from_hf(hf_cfg) -> ModelConfig:
     is_gemma = getattr(hf_cfg, "model_type", "") == "gemma"
     if getattr(hf_cfg, "model_type", "") in ("deepseek_v2", "deepseek_v3"):
         return _deepseek_config(hf_cfg)
+    if getattr(hf_cfg, "model_type", "") == "gemma2":
+        return _gemma2_config(hf_cfg)
     moe = None
     if getattr(hf_cfg, "num_local_experts", None):
         moe = MoEConfig(
@@ -112,6 +114,71 @@ def config_from_hf(hf_cfg) -> ModelConfig:
             getattr(hf_cfg, "rope_scaling", None),
             hf_cfg.max_position_embeddings,
         ),
+    ).validate()
+
+
+def _pattern_from_layer_types(layer_types) -> tuple:
+    """Minimal-period attn_pattern from an HF layer_types list.
+
+    HF stores one entry per layer ("sliding_attention"/"full_attention");
+    our config stores the repeating period. Unknown kinds fail loudly.
+    """
+    kinds = []
+    for t in layer_types:
+        if t == "sliding_attention":
+            kinds.append("window")
+        elif t == "full_attention":
+            kinds.append("full")
+        else:
+            raise NotImplementedError(f"unknown layer_type {t!r}")
+    n = len(kinds)
+    for p in range(1, n + 1):
+        if n % p == 0 and all(kinds[i] == kinds[i % p] for i in range(n)):
+            return tuple(kinds[:p])
+    return tuple(kinds)
+
+
+def _gemma2_config(hf_cfg) -> ModelConfig:
+    """Gemma-2 config mapping: alternating local/global attention
+    (layer_types -> attn_pattern), tanh soft-capping on attention scores
+    and final logits, sandwich norms (post_norms), a query_pre_attn_scalar
+    score scale, GeGLU, and sqrt(d)-scaled embeddings."""
+    n_layers = hf_cfg.num_hidden_layers
+    layer_types = getattr(hf_cfg, "layer_types", None) or [
+        # Older configs predate layer_types; HF's fallback is sliding
+        # attention on even layer indices.
+        "sliding_attention" if i % 2 == 0 else "full_attention"
+        for i in range(n_layers)
+    ]
+    pattern = _pattern_from_layer_types(layer_types)
+    windowed = "window" in pattern
+    if set(pattern) == {"window"}:
+        pattern = None  # uniform window: the plain attn_window covers it
+    elif set(pattern) == {"full"}:
+        pattern, windowed = None, False
+    qpas = getattr(hf_cfg, "query_pre_attn_scalar", None)
+    return ModelConfig(
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_layers=n_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=getattr(hf_cfg, "num_key_value_heads", None)
+        or hf_cfg.num_attention_heads,
+        head_dim=getattr(hf_cfg, "head_dim", None)
+        or hf_cfg.hidden_size // hf_cfg.num_attention_heads,
+        d_ff=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=getattr(hf_cfg, "rope_theta", 10000.0),
+        norm_eps=hf_cfg.rms_norm_eps,
+        tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", True)),
+        attn_window=int(hf_cfg.sliding_window) if windowed else None,
+        attn_pattern=pattern,
+        attn_softcap=getattr(hf_cfg, "attn_logit_softcapping", None),
+        logit_softcap=getattr(hf_cfg, "final_logit_softcapping", None),
+        attn_scale=None if qpas is None else float(qpas) ** -0.5,
+        post_norms=True,
+        activation="geglu",
+        embed_scale=True,
     ).validate()
 
 
@@ -319,9 +386,11 @@ def _norm_offset(hf_cfg) -> float:
     """What to add to HF norm weights to get our (1+s) convention.
 
     Llama/Mistral/Mixtral RMSNorm multiplies by w directly -> s = w - 1.
-    Gemma stores (1 + w) semantics natively -> s = w.
+    The Gemma family stores (1 + w) semantics natively -> s = w.
     """
-    return 0.0 if getattr(hf_cfg, "model_type", "") == "gemma" else -1.0
+    gemma_family = ("gemma", "gemma2", "gemma3", "gemma3_text")
+    return (0.0 if getattr(hf_cfg, "model_type", "") in gemma_family
+            else -1.0)
 
 
 def _to_np(t) -> np.ndarray:
@@ -445,9 +514,12 @@ def params_from_state_dict(
         attn_keys = list(_ATTN_MAP)
         if cfg.qk_norm:
             attn_keys += ["q_norm", "k_norm"]
+    norm_keys = ["attn_norm", "mlp_norm"]
+    if cfg.post_norms:
+        norm_keys += ["post_attn_norm", "post_mlp_norm"]
     layers: Dict[str, list] = {
         k: []
-        for k in [*attn_keys, *bias_keys, *mlp_keys, "attn_norm", "mlp_norm"]
+        for k in [*attn_keys, *bias_keys, *mlp_keys, *norm_keys]
     }
     # Phi3 fuses q/k/v into one qkv_proj and gate/up into gate_up_proj;
     # detect from the keys and split on conversion.
@@ -511,9 +583,23 @@ def params_from_state_dict(
         layers["attn_norm"].append(
             get(base + "input_layernorm.weight") + norm_offset
         )
-        layers["mlp_norm"].append(
-            get(base + "post_attention_layernorm.weight") + norm_offset
-        )
+        if cfg.post_norms:
+            # Gemma-2 sandwich norms: HF's post_attention_layernorm is
+            # the attention OUTPUT norm (our post_attn_norm); the MLP
+            # pre-norm is pre_feedforward_layernorm.
+            layers["post_attn_norm"].append(
+                get(base + "post_attention_layernorm.weight") + norm_offset
+            )
+            layers["mlp_norm"].append(
+                get(base + "pre_feedforward_layernorm.weight") + norm_offset
+            )
+            layers["post_mlp_norm"].append(
+                get(base + "post_feedforward_layernorm.weight") + norm_offset
+            )
+        else:
+            layers["mlp_norm"].append(
+                get(base + "post_attention_layernorm.weight") + norm_offset
+            )
 
     params: Dict[str, Any] = {
         "embed": jnp.asarray(get("embed_tokens.weight"), pdt),
@@ -633,9 +719,16 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
     def np_(x):
         return np.asarray(x, np.float32)
 
+    # Export norm offset mirrors the import side's _norm_offset: the
+    # Gemma family (detected the same way the import config mapping
+    # sets it up: GeGLU + scaled embeddings) stores (1 + w) natively,
+    # so our internal s exports unchanged; Llama-convention targets
+    # store w directly, so s exports as s + 1.
+    gemma_family = cfg.activation == "geglu" and cfg.embed_scale
+    noff = 0.0 if gemma_family else 1.0
     sd: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": np_(params["embed"]),
-        "model.norm.weight": np_(params["final_norm"]) + 1.0,
+        "model.norm.weight": np_(params["final_norm"]) + noff,
     }
     layers = params["layers"]
     for i in range(cfg.n_layers):
@@ -702,10 +795,24 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
             for ours, (theirs, transpose) in _DENSE_MLP_MAP.items():
                 w = np_(layers[ours][i])
                 sd[base + theirs] = w.T if transpose else w
-        sd[base + "input_layernorm.weight"] = np_(layers["attn_norm"][i]) + 1.0
-        sd[base + "post_attention_layernorm.weight"] = (
-            np_(layers["mlp_norm"][i]) + 1.0
+        sd[base + "input_layernorm.weight"] = (
+            np_(layers["attn_norm"][i]) + noff
         )
+        if cfg.post_norms:
+            # Gemma-2 sandwich-norm naming.
+            sd[base + "post_attention_layernorm.weight"] = (
+                np_(layers["post_attn_norm"][i]) + noff
+            )
+            sd[base + "pre_feedforward_layernorm.weight"] = (
+                np_(layers["mlp_norm"][i]) + noff
+            )
+            sd[base + "post_feedforward_layernorm.weight"] = (
+                np_(layers["post_mlp_norm"][i]) + noff
+            )
+        else:
+            sd[base + "post_attention_layernorm.weight"] = (
+                np_(layers["mlp_norm"][i]) + noff
+            )
     if cfg.tie_embeddings:
         sd["lm_head.weight"] = sd["model.embed_tokens.weight"]
     else:

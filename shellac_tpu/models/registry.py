@@ -28,6 +28,16 @@ PRESETS = {
     "tiny-encoder": ModelConfig(vocab_size=256, d_model=64, n_layers=2,
                                 n_heads=4, max_seq_len=128, remat=False,
                                 causal=False),
+    # The full Gemma-2 shape in miniature: alternating local/global
+    # attention, score + final-logit tanh capping, sandwich norms, a
+    # query_pre_attn_scalar score scale, GeGLU, scaled embeddings.
+    "tiny-gemma2": ModelConfig(vocab_size=256, d_model=64, n_layers=4,
+                               n_heads=4, n_kv_heads=2, max_seq_len=128,
+                               remat=False, attn_window=16,
+                               attn_pattern=("window", "full"),
+                               attn_softcap=50.0, logit_softcap=30.0,
+                               attn_scale=16 ** -0.5, post_norms=True,
+                               activation="geglu", embed_scale=True),
     "tiny-mla": ModelConfig(vocab_size=256, d_model=64, n_layers=2,
                             n_heads=4, max_seq_len=128, remat=False,
                             mla=MLAConfig(kv_lora_rank=32, q_lora_rank=24,

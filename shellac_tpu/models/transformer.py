@@ -179,6 +179,11 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 "bk": jnp.zeros((hkv * dh,), pdt),
                 "bv": jnp.zeros((hkv * dh,), pdt),
             })
+        if cfg.post_norms:
+            p.update({
+                "post_attn_norm": jnp.zeros((d,), pdt),
+                "post_mlp_norm": jnp.zeros((d,), pdt),
+            })
         if not moe_layer:
             p.update({
                 "w_gate": dense(ks[4], (d, f), d),
@@ -300,11 +305,18 @@ def _layer_axes(cfg: ModelConfig, moe_layer: bool, lead=("layers",)) -> dict:
                 "q_norm": (*lead, None),
                 "k_norm": (*lead, None),
             })
+    post_axes = {}
+    if cfg.post_norms:
+        post_axes = {
+            "post_attn_norm": (*lead, None),
+            "post_mlp_norm": (*lead, None),
+        }
     return {
         "attn_norm": (*lead, None),
         **attn_axes,
         "mlp_norm": (*lead, None),
         **bias_axes,
+        **post_axes,
         **mlp_axes,
     }
 
@@ -395,7 +407,7 @@ def _zero_aux():
 def _block(
     cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None,
     fresh_cache: bool = False, segments=None, page_tables=None,
-    moe_layer=None, kv_scales=None,
+    moe_layer=None, kv_scales=None, attn_kind=None,
 ):
     """One pre-norm transformer block. x: (B, S, D) in compute dtype.
 
@@ -408,7 +420,11 @@ def _block(
     into an empty cache): attention then runs causally over the new
     chunk itself — O(S^2/2) and flash-eligible — instead of scanning the
     whole max_len buffer, while k/v still land in the cache.
+
+    attn_kind overrides cfg.attn_window per layer for patterned stacks
+    (cfg.attn_pattern): "full" drops the window, "window"/None keep it.
     """
+    window = None if attn_kind == "full" else cfg.attn_window
     cdt = cfg.compute_dtype
     b, s, d = x.shape
     h, hkv, dh = cfg.n_heads, cfg.kv_heads, cfg.dim_per_head
@@ -427,6 +443,8 @@ def _block(
             kv_scales=kv_scales,
         )
         o = pdot(o, lp["wo"])
+        if cfg.post_norms:
+            o = rms_norm(o, lp["post_attn_norm"], cfg.norm_eps).astype(cdt)
         x = x + constrain(o, mesh, ("batch", "seq", None))
         return _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache,
                           moe_layer, new_cache)
@@ -448,7 +466,8 @@ def _block(
     k = apply_rope(k, cos, sin)
     new_cache = None
     if cache is None:
-        o = _training_attention(cfg, mesh, attn_impl, q, k, v, segments)
+        o = _training_attention(cfg, mesh, attn_impl, q, k, v, segments,
+                                window=window)
     elif page_tables is not None:
         from shellac_tpu.inference.kvcache import (
             paged_gather_layer,
@@ -462,7 +481,8 @@ def _block(
         new_cache = (pool_k, pool_v)
         if fresh_cache:
             o = attention(
-                q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
+                q, k, v, causal=True, window=window, impl=attn_impl,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
             )
         else:
             from shellac_tpu.ops.decode_attention import (
@@ -471,7 +491,8 @@ def _block(
 
             o = paged_decode_attention(
                 q, pool_k, pool_v, page_tables, index,
-                window=cfg.attn_window, impl=attn_impl,
+                window=window, impl=attn_impl,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
             )
     elif kv_scales is not None:
         from shellac_tpu.inference.kvcache import quant_update_layer
@@ -487,12 +508,14 @@ def _block(
             # Prefill computes on the exact (unquantized) chunk; only
             # later reads see the int8 rounding.
             o = attention(
-                q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
+                q, k, v, causal=True, window=window, impl=attn_impl,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
             )
         else:
             o = decode_attention(
                 q, cache_k, cache_v, index,
-                window=cfg.attn_window, impl=attn_impl,
+                window=window, impl=attn_impl,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
                 k_scale=ks_l, v_scale=vs_l,
             )
     else:
@@ -506,16 +529,22 @@ def _block(
             # Every row's positions start at 0, so plain causal masking
             # already excludes the right-pad tail of shorter prompts.
             o = attention(
-                q, k, v, causal=True, window=cfg.attn_window, impl=attn_impl
+                q, k, v, causal=True, window=window, impl=attn_impl,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
             )
         else:
             from shellac_tpu.ops.decode_attention import decode_attention
 
             o = decode_attention(
                 q, cache_k, cache_v, index,
-                window=cfg.attn_window, impl=attn_impl,
+                window=window, impl=attn_impl,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
             )
     o = pdot(o.reshape(b, s, h * dh), lp["wo"])
+    if cfg.post_norms:
+        # Gemma-2 sandwich norm: the branch OUTPUT is normed before the
+        # residual add (HF post_attention_layernorm placement).
+        o = rms_norm(o, lp["post_attn_norm"], cfg.norm_eps).astype(cdt)
     x = x + constrain(o, mesh, ("batch", "seq", None))
     return _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache,
                       moe_layer, new_cache)
@@ -567,17 +596,24 @@ def _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache, moe_layer,
         gate = constrain(gate, mesh, ("batch", "seq", "mlp"))
         up = constrain(up, mesh, ("batch", "seq", "mlp"))
         down = pdot(_gated_act(cfg)(gate, up), lp["w_down"])
+    if cfg.post_norms:
+        down = rms_norm(down, lp["post_mlp_norm"], cfg.norm_eps).astype(cdt)
     x = x + constrain(down, mesh, ("batch", "seq", None))
     return x, new_cache, moe_out
 
 
-def _training_attention(cfg, mesh, attn_impl, q, k, v, segments):
+def _training_attention(cfg, mesh, attn_impl, q, k, v, segments,
+                        window="cfg"):
     """Full-sequence attention with sequence-parallel dispatch.
 
     q (B, S, H, D); k/v (B, S, Hkv, D'). Shared by the standard GQA
     path and MLA's expanded form (there Hkv == H and v is padded to
     q's width, so the default d**-0.5 scale is already the MLA scale).
+    `window` overrides cfg.attn_window for patterned stacks (the "cfg"
+    sentinel keeps MLA's call sites untouched).
     """
+    if window == "cfg":
+        window = cfg.attn_window
     h, hkv = q.shape[2], k.shape[2]
     q = constrain(q, mesh, ("batch", "seq", "heads", None))
     k = constrain(k, mesh, ("batch", "seq", "kv_heads", None))
@@ -606,7 +642,7 @@ def _training_attention(cfg, mesh, attn_impl, q, k, v, segments):
     # mask on global positions), so it is the windowed fallback
     # when ulysses can't split the heads.
     use_ulysses = attn_impl == "ulysses" or (
-        attn_impl == "auto" and sp_active and cfg.attn_window is not None
+        attn_impl == "auto" and sp_active and window is not None
         and ulysses_ok
     )
     use_ring = attn_impl == "ring" or (
@@ -621,17 +657,19 @@ def _training_attention(cfg, mesh, attn_impl, q, k, v, segments):
 
         return ring_attention(
             q, k, v, mesh, causal=cfg.causal, segments=segments,
-            window=cfg.attn_window,
+            window=window, scale=cfg.attn_scale, softcap=cfg.attn_softcap,
         )
     if use_ulysses:
         from shellac_tpu.parallel.ulysses import ulysses_attention
 
         return ulysses_attention(
-            q, k, v, mesh, causal=cfg.causal, window=cfg.attn_window,
+            q, k, v, mesh, causal=cfg.causal, window=window,
+            scale=cfg.attn_scale, softcap=cfg.attn_softcap,
             segments=segments,
         )
     return attention(
-        q, k, v, causal=cfg.causal, window=cfg.attn_window,
+        q, k, v, causal=cfg.causal, window=window,
+        scale=cfg.attn_scale, softcap=cfg.attn_softcap,
         q_segments=segments, kv_segments=segments, impl=attn_impl,
     )
 
@@ -846,10 +884,10 @@ def forward(
         # int32 ids.
         segment_ids = constrain(segment_ids, mesh, ("batch", None))
 
-    def make_block(moe_flag):
+    def make_block(moe_flag, attn_kind=None):
         blk = functools.partial(
             _block, cfg, mesh, attn_impl, segments=segment_ids,
-            moe_layer=moe_flag,
+            moe_layer=moe_flag, attn_kind=attn_kind,
         )
         if cfg.remat:
             blk = jax.checkpoint(blk, policy=_remat_policy(cfg.remat_policy))
@@ -885,6 +923,13 @@ def forward(
                     f"n_layers={cfg.n_layers} not divisible by pp={pp}"
                 )
             per_stage = cfg.n_layers // pp
+            if cfg.attn_pattern is not None and \
+                    per_stage % len(cfg.attn_pattern):
+                raise ValueError(
+                    f"pp={pp} stages hold {per_stage} layers each, not a "
+                    f"whole number of attn_pattern periods "
+                    f"(len {len(cfg.attn_pattern)})"
+                )
         stage_params = jax.tree.map(
             lambda p: p.reshape(pp, per_stage, *p.shape[1:]),
             params["layers"],
@@ -896,11 +941,11 @@ def forward(
         # microbatches see a slice of the batch, so the pipeline needs
         # unbound blocks whose RoPE tables / segment ids ride WITH
         # each microbatch through the stage shift register.
-        def make_pp_block(moe_flag):
+        def make_pp_block(moe_flag, attn_kind=None):
             def raw(x, lp, cos_m, sin_m, seg_m):
                 return _block(
                     cfg, mesh, attn_impl, x, lp, cos_m, sin_m,
-                    segments=seg_m, moe_layer=moe_flag,
+                    segments=seg_m, moe_layer=moe_flag, attn_kind=attn_kind,
                 )
 
             if cfg.remat:
@@ -944,6 +989,33 @@ def forward(
                     return pp_blk_m(x, lp, cos_m, sin_m, seg_m)
 
                 return _grouped_scan(blk_d, blk_m, x, aux0, sp_glp)
+        elif cfg.attn_pattern is not None:
+            period = len(cfg.attn_pattern)
+            pp_blocks = [make_pp_block(None, kind)
+                         for kind in cfg.attn_pattern]
+
+            def run_stack(sp_lp, x, cos_m, sin_m, seg_m):
+                # sp_lp: (per_stage, ...) -> (groups, period, ...);
+                # the scan walks groups, the pattern unrolls inside (a
+                # window is a static kernel argument, so each kind
+                # compiles its own block body).
+                glp = jax.tree.map(
+                    lambda a: a.reshape(
+                        a.shape[0] // period, period, *a.shape[1:]
+                    ),
+                    sp_lp,
+                )
+
+                def body(carry, gl):
+                    x, acc = carry
+                    for i, blk in enumerate(pp_blocks):
+                        lp_i = jax.tree.map(lambda a, i=i: a[i], gl)
+                        x, _, moe_out = blk(x, lp_i, cos_m, sin_m, seg_m)
+                        acc = _add_aux(acc, moe_out)
+                    return (x, acc), None
+
+                (x, acc), _ = jax.lax.scan(body, (x, aux0), glp)
+                return x, acc
         else:
             pp_block = make_pp_block(None)
 
@@ -1030,6 +1102,39 @@ def forward(
             "balance_loss": aux_acc["balance_loss"] / routers,
             "router_z_loss": aux_acc["router_z_loss"] / routers,
             "dropped_frac": aux_acc["dropped_frac"] / routers,
+        }
+    elif cfg.attn_pattern is not None:
+        # Patterned attention (Gemma-2/3 alternating local/global): the
+        # flat (L, ...) stack reshapes to (L/period, period, ...) and the
+        # scan walks whole periods, unrolling the kinds inside — window
+        # size is a static kernel argument, so each kind needs its own
+        # compiled block body, but params/checkpoints keep the flat
+        # layers axis (sharding, LoRA, conversion are unchanged).
+        aux0 = _zero_aux()
+        period = len(cfg.attn_pattern)
+        blocks = [make_block(None, kind) for kind in cfg.attn_pattern]
+        glp = jax.tree.map(
+            lambda a: a.reshape(
+                a.shape[0] // period, period, *a.shape[1:]
+            ),
+            params["layers"],
+        )
+
+        def group_body(carry, gl):
+            x, acc = carry
+            for i, blk in enumerate(blocks):
+                lp_i = jax.tree.map(lambda a, i=i: a[i], gl)
+                x, _, moe_out = blk(x, lp_i, cos, sin)
+                acc = _add_aux(acc, moe_out)
+            return (x, acc), None
+
+        (x, aux_acc), _ = jax.lax.scan(group_body, (x, aux0), glp)
+        inv_l = 1.0 / cfg.n_layers
+        aux = {
+            "aux": aux_acc["aux"],
+            "balance_loss": aux_acc["balance_loss"] * inv_l,
+            "router_z_loss": aux_acc["router_z_loss"] * inv_l,
+            "dropped_frac": aux_acc["dropped_frac"] * inv_l,
         }
     else:
         aux0 = _zero_aux()
@@ -1122,11 +1227,45 @@ def forward_with_cache(
 
     tables = cache.tables if paged else None
 
-    def run_block(x, lp, ck, cv, moe_flag, scales=None):
+    def run_block(x, lp, ck, cv, moe_flag, scales=None, attn_kind=None):
         return _block(
             cfg, mesh, attn_impl, x, lp, cos, sin,
             cache=(ck, cv, index, positions), fresh_cache=fresh_cache,
             page_tables=tables, moe_layer=moe_flag, kv_scales=scales,
+            attn_kind=attn_kind,
+        )
+
+    def pattern_scan(x, layer_stack, caches, body_one):
+        """Scan whole attn_pattern periods: stacked leaves (L, ...)
+        reshape to (L/period, period, ...) and the kinds unroll inside
+        the scan body (window sizes are static kernel arguments).
+        caches: tuple of (L, ...) arrays riding with the layers;
+        body_one(x, lp, cache_slices, kind) -> (x, new_cache_tuple).
+        Returns (x, tuple of restacked (L, ...) caches)."""
+        period = len(cfg.attn_pattern)
+        ng = cfg.n_layers // period
+        greshape = lambda a: a.reshape(ng, period, *a.shape[1:])
+        glp = jax.tree.map(greshape, layer_stack)
+        gcaches = tuple(greshape(c) for c in caches)
+
+        def group_body(x, inp):
+            gl = inp[0]
+            outs = []
+            for i, kind in enumerate(cfg.attn_pattern):
+                lp_i = jax.tree.map(lambda a, i=i: a[i], gl)
+                x, nc = body_one(
+                    x, lp_i, tuple(c[i] for c in inp[1:]), kind
+                )
+                outs.append(nc)
+            stacked = tuple(
+                jnp.stack([o[j] for o in outs], axis=0)
+                for j in range(len(outs[0]))
+            )
+            return x, stacked
+
+        x, gnew = jax.lax.scan(group_body, x, (glp,) + gcaches)
+        return x, tuple(
+            c.reshape(cfg.n_layers, *c.shape[2:]) for c in gnew
         )
 
     if quant:
@@ -1137,15 +1276,28 @@ def forward_with_cache(
                 "stack or a bf16 cache"
             )
 
-        def quant_body(x, layer_in):
-            lp, ck, cv, cks, cvs = layer_in
-            x, new_cache, _ = run_block(x, lp, ck, cv, None, (cks, cvs))
-            return x, new_cache
+        if cfg.attn_pattern is not None:
+            def body_one(x, lp, cs, kind):
+                ck, cv, cks, cvs = cs
+                x, nc, _ = run_block(
+                    x, lp, ck, cv, None, (cks, cvs), attn_kind=kind
+                )
+                return x, nc
 
-        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
-            quant_body, x,
-            (params["layers"], cache.k, cache.v, cache.ks, cache.vs),
-        )
+            x, (new_k, new_v, new_ks, new_vs) = pattern_scan(
+                x, params["layers"],
+                (cache.k, cache.v, cache.ks, cache.vs), body_one,
+            )
+        else:
+            def quant_body(x, layer_in):
+                lp, ck, cv, cks, cvs = layer_in
+                x, new_cache, _ = run_block(x, lp, ck, cv, None, (cks, cvs))
+                return x, new_cache
+
+            x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+                quant_body, x,
+                (params["layers"], cache.k, cache.v, cache.ks, cache.vs),
+            )
     elif first_k_layout(cfg):
         kk = cfg.first_k_dense
 
@@ -1197,6 +1349,15 @@ def forward_with_cache(
         )
         new_k = nk.reshape(cfg.n_layers, *cache.k.shape[1:])
         new_v = nv.reshape(cfg.n_layers, *cache.v.shape[1:])
+    elif cfg.attn_pattern is not None:
+        def body_one(x, lp, cs, kind):
+            ck, cv = cs
+            x, nc, _ = run_block(x, lp, ck, cv, None, attn_kind=kind)
+            return x, nc
+
+        x, (new_k, new_v) = pattern_scan(
+            x, params["layers"], (cache.k, cache.v), body_one
+        )
     else:
         def scan_body(x, layer_in):
             lp, ck, cv = layer_in

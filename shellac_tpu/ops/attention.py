@@ -60,13 +60,19 @@ def attention_ref(
     causal: bool = True,
     window: Optional[int] = None,
     scale: Optional[float] = None,
+    softcap: Optional[float] = None,
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
     kv_mask: Optional[jax.Array] = None,
     q_segments: Optional[jax.Array] = None,
     kv_segments: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Reference scaled-dot-product attention with GQA."""
+    """Reference scaled-dot-product attention with GQA.
+
+    softcap: Gemma-2-style logit soft-capping — scaled scores pass
+    through cap*tanh(s/cap) BEFORE masking (masked slots stay NEG_INF,
+    matching the HF eager path which caps, then adds the mask).
+    """
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
     if h % hkv != 0:
@@ -86,6 +92,8 @@ def attention_ref(
         "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
     )
     logits = logits * scale
+    if softcap is not None:
+        logits = softcap * jnp.tanh(logits / softcap)
     mask = _build_mask(
         q_positions, kv_positions, causal, window, kv_mask,
         q_segments, kv_segments,
@@ -108,6 +116,7 @@ def attention(
     causal: bool = True,
     window: Optional[int] = None,
     scale: Optional[float] = None,
+    softcap: Optional[float] = None,
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
     kv_mask: Optional[jax.Array] = None,
@@ -121,6 +130,7 @@ def attention(
     if impl == "ref":
         return attention_ref(
             q, k, v, causal=causal, window=window, scale=scale,
+            softcap=softcap,
             q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
             q_segments=q_segments, kv_segments=kv_segments,
         )
@@ -142,7 +152,7 @@ def attention(
             )
         return flash_attention(
             q, k, v, causal=causal, scale=scale, window=window,
-            segments=q_segments,
+            softcap=softcap, segments=q_segments,
         )
     if impl == "auto" and flash_supported(
         q, k, v, window=window, q_positions=q_positions,
@@ -151,10 +161,11 @@ def attention(
     ):
         return flash_attention(
             q, k, v, causal=causal, scale=scale, window=window,
-            segments=q_segments,
+            softcap=softcap, segments=q_segments,
         )
     return attention_ref(
         q, k, v, causal=causal, window=window, scale=scale,
+        softcap=softcap,
         q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
         q_segments=q_segments, kv_segments=kv_segments,
     )

@@ -78,7 +78,7 @@ class QuantFallbackWarning(UserWarning):
 def _decode_tile(
     idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     *, scale, s, hkv, block_k, window, k_start, ki, last_ki, first_ki,
-    ks_ref=None, vs_ref=None,
+    ks_ref=None, vs_ref=None, softcap=None,
 ):
     """One online-softmax step over every kv head of one sequence.
 
@@ -125,6 +125,10 @@ def _decode_tile(
             )  # (rph, block_k)
             if ks_ref is not None:
                 logits = logits * ks_ref[kh][None, :]
+            if softcap is not None:
+                # Gemma-2 capping, after dequant (the dequantized value
+                # IS the real scaled logit), before masking.
+                logits = softcap * jnp.tanh(logits / softcap)
             logits = jnp.where(mask, logits, NEG_INF)
 
             m_prev = m_ref[sl, :1]
@@ -158,6 +162,7 @@ def _decode_tile(
 def _decode_tile_values(
     idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     *, scale, s, hkv, block_k, window, k_start, ki, last_ki, first_ki,
+    softcap=None,
 ):
     """_decode_tile for head dims whose lane count is not 128-aligned.
 
@@ -206,6 +211,8 @@ def _decode_tile_values(
                 q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if softcap is not None:
+                logits = softcap * jnp.tanh(logits / softcap)
             logits = jnp.where(mask, logits, NEG_INF)
 
             m_prev = jax.lax.slice(m_all, (lo, 0), (hi, 1))
@@ -278,7 +285,7 @@ def _unflatten_o(o, b, s, h, d):
 
 def _dense_kernel(
     idx_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale, s, hkv, block_k, window, num_kv,
+    *, scale, s, hkv, block_k, window, num_kv, softcap=None,
 ):
     b = pl.program_id(0)
     ki = pl.program_id(1)
@@ -288,13 +295,14 @@ def _dense_kernel(
         idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
+        softcap=softcap,
     )
 
 
 def _dense_kernel_quant(
     idx_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
     acc_ref, m_ref, l_ref,
-    *, scale, s, hkv, block_k, window, num_kv,
+    *, scale, s, hkv, block_k, window, num_kv, softcap=None,
 ):
     """Dense kernel over an int8 cache with per-token dequant scales
     (d % 128 == 0 only; the dispatch gate guarantees it)."""
@@ -307,12 +315,12 @@ def _dense_kernel_quant(
         acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
-        ks_ref=ks_ref.at[0], vs_ref=vs_ref.at[0],
+        ks_ref=ks_ref.at[0], vs_ref=vs_ref.at[0], softcap=softcap,
     )
 
 
 def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k,
-                 interpret, k_scale=None, v_scale=None):
+                 interpret, k_scale=None, v_scale=None, softcap=None):
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
@@ -367,7 +375,7 @@ def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k,
         functools.partial(
             _dense_kernel_quant if quant else _dense_kernel,
             scale=scale, s=s, hkv=hkv, block_k=block_k,
-            window=window, num_kv=num_kv,
+            window=window, num_kv=num_kv, softcap=softcap,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
@@ -407,6 +415,7 @@ def decode_attention(
     q, cache_k, cache_v, index, *,
     window: Optional[int] = None,
     scale: Optional[float] = None,
+    softcap: Optional[float] = None,
     impl: str = "auto",
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
@@ -462,14 +471,15 @@ def decode_attention(
         return _dense_flash(
             q, cache_k, cache_v, index, float(scale), window, bk, interpret,
             k_scale=k_scale, v_scale=v_scale,
+            softcap=None if softcap is None else float(softcap),
         )
     return _decode_ref(
-        q, cache_k, cache_v, index, window, scale,
+        q, cache_k, cache_v, index, window, scale, softcap=softcap,
         k_scale=k_scale, v_scale=v_scale,
     )
 
 
-def _decode_ref(q, cache_k, cache_v, index, window, scale,
+def _decode_ref(q, cache_k, cache_v, index, window, scale, softcap=None,
                 k_scale=None, v_scale=None):
     if k_scale is not None:
         # Dequantize the int8 cache at read; XLA fuses the multiply
@@ -493,7 +503,7 @@ def _decode_ref(q, cache_k, cache_v, index, window, scale,
     kv_mask = kv_positions < (index[:, None] + s)
     return attention_ref(
         q, cache_k.astype(cdt), cache_v.astype(cdt),
-        causal=True, window=window, scale=scale,
+        causal=True, window=window, scale=scale, softcap=softcap,
         q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
     )
 
@@ -506,7 +516,7 @@ def _decode_ref(q, cache_k, cache_v, index, window, scale,
 def _paged_group_kernel(
     len_ref, tab_ref, q_ref, k_hbm, v_hbm, o_ref,
     acc_ref, m_ref, l_ref, k_buf, v_buf, sems,
-    *, scale, s, hkv, bs, group, window, num_kv,
+    *, scale, s, hkv, bs, group, window, num_kv, softcap=None,
 ):
     """Grouped paged decode: `group` pages gathered per grid step.
 
@@ -581,11 +591,13 @@ def _paged_group_kernel(
         acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=gi * block_k, ki=gi, last_ki=last_gi, first_ki=first_gi,
+        softcap=softcap,
     )
 
 
 def _paged_group_flash(
-    q, pool_k, pool_v, tables, index, scale, window, group, interpret
+    q, pool_k, pool_v, tables, index, scale, window, group, interpret,
+    softcap=None,
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -621,7 +633,7 @@ def _paged_group_flash(
     out = pl.pallas_call(
         functools.partial(
             _paged_group_kernel, scale=scale, s=s, hkv=hkv, bs=bs,
-            group=group, window=window, num_kv=num_kv,
+            group=group, window=window, num_kv=num_kv, softcap=softcap,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
@@ -651,7 +663,7 @@ def _paged_group(tables, pool_k) -> int:
 
 def _paged_kernel(
     len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale, s, hkv, block_k, window, num_kv,
+    *, scale, s, hkv, block_k, window, num_kv, softcap=None,
 ):
     b = pl.program_id(0)
     ki = pl.program_id(1)
@@ -661,10 +673,12 @@ def _paged_kernel(
         idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
+        softcap=softcap,
     )
 
 
-def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret):
+def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret,
+                 softcap=None):
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
@@ -703,7 +717,7 @@ def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret):
     out = pl.pallas_call(
         functools.partial(
             _paged_kernel, scale=scale, s=s, hkv=hkv, block_k=bs,
-            window=window, num_kv=num_kv,
+            window=window, num_kv=num_kv, softcap=softcap,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
@@ -732,6 +746,7 @@ def paged_decode_attention(
     q, pool_k, pool_v, tables, index, *,
     window: Optional[int] = None,
     scale: Optional[float] = None,
+    softcap: Optional[float] = None,
     impl: str = "auto",
     interpret: Optional[bool] = None,
 ):
@@ -782,15 +797,17 @@ def paged_decode_attention(
         # (its tile body is the ref-slicing fast path) and grouping
         # actually amortizes anything; one-page kernel otherwise.
         group = _paged_group(tables, pool_k) if q.shape[-1] % 128 == 0 else 1
+        sc = None if softcap is None else float(softcap)
         if group > 1:
             return _paged_group_flash(
                 q, pool_k, pool_v, tables, index, float(scale), window,
-                group, interpret,
+                group, interpret, softcap=sc,
             )
         return _paged_flash(
-            q, pool_k, pool_v, tables, index, float(scale), window, interpret
+            q, pool_k, pool_v, tables, index, float(scale), window, interpret,
+            softcap=sc,
         )
     from shellac_tpu.inference.kvcache import paged_gather_layer
 
     k_all, v_all = paged_gather_layer(pool_k, pool_v, tables)
-    return _decode_ref(q, k_all, v_all, index, window, scale)
+    return _decode_ref(q, k_all, v_all, index, window, scale, softcap=softcap)

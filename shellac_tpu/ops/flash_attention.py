@@ -102,18 +102,22 @@ def flash_supported(
 
 def _scores(
     q_blk, k_blk, q_start, k_start, scale, causal, window=None,
-    q_seg=None, k_seg=None,
+    q_seg=None, k_seg=None, softcap=None,
 ):
     """Scaled (block_q, block_k) fp32 logits with all masks applied.
 
     q_seg/k_seg: (block_q,), (block_k,) int32 packed document ids, or
-    None for unpacked.
+    None for unpacked. softcap: Gemma-2 logit capping — the scaled
+    scores pass through cap*tanh(s/cap) BEFORE the masks, so masked
+    slots keep the NEG_INF sentinel the online softmax gates on.
     """
     q = q_blk.astype(jnp.float32) * scale
     k = k_blk.astype(jnp.float32)
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
     shape = s.shape
     if causal or window is not None:
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
@@ -130,17 +134,18 @@ def _scores(
 
 def _tile_p_ds(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    q_start, k_start, scale, causal, window, q_seg, k_seg,
+    q_start, k_start, scale, causal, window, q_seg, k_seg, softcap=None,
 ):
     """Recompute a probability tile and its score gradient from saved lse.
 
     Shared by both backward kernels so the masking/lse handling cannot
     drift between dq and dk/dv. Returns (p, ds), both (block_q, block_k)
-    fp32; ds carries the softmax scale factor.
+    fp32; ds carries the softmax scale factor (and, with softcap, the
+    tanh derivative 1 - (s_cap/cap)^2 of the capping).
     """
     s = _scores(
         q_ref[0], k_ref[0], q_start, k_start, scale, causal, window,
-        q_seg, k_seg,
+        q_seg, k_seg, softcap,
     )
     # Masked entries carry s = NEG_INF (finite): exp(s - lse) underflows
     # to 0 for any real lse, but a fully-masked row would hit
@@ -153,6 +158,14 @@ def _tile_p_ds(
         preferred_element_type=jnp.float32,
     )
     ds = p * (dp - delta_ref[0, 0, :][:, None]) * scale
+    if softcap is not None:
+        # s holds the CAPPED score where live, so tanh(raw/cap) = s/cap
+        # and d(cap)/d(raw) = 1 - (s/cap)^2. Masked slots hold NEG_INF;
+        # (NEG_INF/cap)^2 overflows fp32 to inf and 0*inf = NaN, so gate
+        # the factor on the same sentinel as p (ds is 0 there anyway).
+        ds = ds * jnp.where(
+            s > 0.5 * NEG_INF, 1.0 - jnp.square(s / softcap), 0.0
+        )
     return p, ds
 
 
@@ -200,6 +213,7 @@ def _unpack_refs(refs, has_segments, n_out_scratch):
 def _flash_kernel(
     *refs, scale: float, causal: bool, window: Optional[int],
     block_q: int, block_k: int, num_kv: int, has_segments: bool,
+    softcap: Optional[float],
 ):
     (q_ref, k_ref, v_ref), (qs_ref, ks_ref), (
         o_ref, lse_ref, acc_ref, m_ref, l_ref,
@@ -235,7 +249,7 @@ def _flash_kernel(
         k_seg = ks_ref[0, 0, :] if has_segments else None
         s = _scores(
             q_ref[0], k_ref[0], q_start, k_start, scale, causal, window,
-            q_seg, k_seg,
+            q_seg, k_seg, softcap,
         )
         m_prev = m_ref[:, :1]  # (block_q, 1)
         l_prev = l_ref[:, :1]
@@ -266,7 +280,8 @@ def _flash_kernel(
 
 
 def _flash_forward(
-    q, k, v, seg, causal, scale, window, block_q, block_k, interpret
+    q, k, v, seg, causal, scale, window, block_q, block_k, interpret,
+    softcap=None,
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -319,6 +334,7 @@ def _flash_forward(
             block_k=block_k,
             num_kv=num_kv,
             has_segments=has_segments,
+            softcap=softcap,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(qf.shape, q.dtype),
@@ -345,6 +361,7 @@ def _flash_forward(
 def _flash_bwd_dkdv_kernel(
     *refs, scale: float, causal: bool, window: Optional[int],
     block_q: int, block_k: int, num_q: int, inner: int, has_segments: bool,
+    softcap: Optional[float],
 ):
     """Grid (B*Hkv, kv_blocks, G*q_blocks): one (dk, dv) tile per kv block,
     accumulated over every q block of every q-head in the GQA group."""
@@ -374,7 +391,7 @@ def _flash_bwd_dkdv_kernel(
         k_seg = ks_ref[0, 0, :] if has_segments else None
         p, ds = _tile_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            q_start, k_start, scale, causal, window, q_seg, k_seg,
+            q_start, k_start, scale, causal, window, q_seg, k_seg, softcap,
         )
         do = do_ref[0]
         # dv += p^T do
@@ -397,6 +414,7 @@ def _flash_bwd_dkdv_kernel(
 def _flash_bwd_dq_kernel(
     *refs, scale: float, causal: bool, window: Optional[int],
     block_q: int, block_k: int, num_kv: int, has_segments: bool,
+    softcap: Optional[float],
 ):
     """Grid (B*H, q_blocks, kv_blocks): one dq tile per q block."""
     (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref), (qs_ref, ks_ref), (
@@ -426,7 +444,7 @@ def _flash_bwd_dq_kernel(
         k_seg = ks_ref[0, 0, :] if has_segments else None
         _, ds = _tile_p_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-            q_start, k_start, scale, causal, window, q_seg, k_seg,
+            q_start, k_start, scale, causal, window, q_seg, k_seg, softcap,
         )
         dq_acc[...] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0],
@@ -440,7 +458,7 @@ def _flash_bwd_dq_kernel(
 
 def _flash_backward(
     q, k, v, seg, o, lse, g_out, causal, scale, window, block_q, block_k,
-    interpret,
+    interpret, softcap=None,
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -517,7 +535,7 @@ def _flash_backward(
         functools.partial(
             _flash_bwd_dkdv_kernel, scale=scale, causal=causal,
             window=window, block_q=block_q, block_k=block_k, num_q=num_q,
-            inner=inner, has_segments=has_segments,
+            inner=inner, has_segments=has_segments, softcap=softcap,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(kf.shape, k.dtype),
@@ -568,7 +586,7 @@ def _flash_backward(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, num_kv=num_kv,
-            has_segments=has_segments,
+            has_segments=has_segments, softcap=softcap,
         ),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
         grid=(b * h, num_q, num_kv),
@@ -582,26 +600,31 @@ def _flash_backward(
     return unflat(dq, h), unflat(dk, hkv), unflat(dv, hkv)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, seg, causal, scale, window, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, seg, causal, scale, window, block_q, block_k, interpret,
+           softcap):
     out, _ = _flash_forward(
-        q, k, v, seg, causal, scale, window, block_q, block_k, interpret
+        q, k, v, seg, causal, scale, window, block_q, block_k, interpret,
+        softcap,
     )
     return out
 
 
-def _flash_fwd(q, k, v, seg, causal, scale, window, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, seg, causal, scale, window, block_q, block_k,
+               interpret, softcap):
     out, lse = _flash_forward(
-        q, k, v, seg, causal, scale, window, block_q, block_k, interpret
+        q, k, v, seg, causal, scale, window, block_q, block_k, interpret,
+        softcap,
     )
     return out, (q, k, v, seg, out, lse)
 
 
-def _flash_bwd(causal, scale, window, block_q, block_k, interpret, res, g_out):
+def _flash_bwd(causal, scale, window, block_q, block_k, interpret, softcap,
+               res, g_out):
     q, k, v, seg, o, lse = res
     dq, dk, dv = _flash_backward(
         q, k, v, seg, o, lse, g_out, causal, scale, window, block_q, block_k,
-        interpret,
+        interpret, softcap,
     )
     return dq, dk, dv, None
 
@@ -612,6 +635,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(
     q, k, v, *, causal: bool = True, scale: Optional[float] = None,
     window: Optional[int] = None, segments: Optional[jax.Array] = None,
+    softcap: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ):
@@ -619,7 +643,8 @@ def flash_attention(
 
     `window`: sliding-window size (qpos - kpos < window). `segments`:
     (B, S) int32 packed document ids shared by q and kv; attention is
-    block-diagonal over them.
+    block-diagonal over them. `softcap`: Gemma-2-style tanh capping of
+    the scaled scores (fwd and both bwd passes chain the derivative).
     """
     d = q.shape[-1]
     if scale is None:
@@ -639,6 +664,6 @@ def flash_attention(
         q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
     out = _flash(
         q, k, v, segments, causal, float(scale), window, block_q, block_k,
-        interpret,
+        interpret, None if softcap is None else float(softcap),
     )
     return out[..., :d] if pad else out

@@ -33,7 +33,7 @@ from shellac_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSO
 NEG_INF = -2.0e38
 
 
-def _block_stats(q, k, v, scale, mask):
+def _block_stats(q, k, v, scale, mask, softcap=None):
     """Unnormalized block attention: returns (acc, m, l).
 
     q (B,Sq,Hkv,G,D); k,v (B,Sk,Hkv,D); mask (Sq,Sk) or (B,Sq,Sk) or
@@ -42,6 +42,8 @@ def _block_stats(q, k, v, scale, mask):
     s = jnp.einsum(
         "bqhgd,bkhd->bhgqk", q, k, preferred_element_type=jnp.float32
     ) * scale
+    if softcap is not None:
+        s = softcap * jnp.tanh(s / softcap)
     if mask is not None:
         if mask.ndim == 2:
             mask = mask[None]
@@ -65,7 +67,7 @@ def _block_stats(q, k, v, scale, mask):
 
 def _ring_local(
     q, k, v, seg, *, axis_name: str, causal: bool, scale: float,
-    has_segments: bool, window=None,
+    has_segments: bool, window=None, softcap=None,
 ):
     """Runs on one device inside shard_map. q (B,S_loc,H,D); k,v
     (B,S_loc,Hkv,D); seg (B,S_loc) int32 (packed document ids; a dummy
@@ -114,7 +116,8 @@ def _ring_local(
                 seg_mask if block_mask is None
                 else block_mask[None] & seg_mask
             )
-        acc_c, m_c, l_c = _block_stats(qg, k_cur, v_cur, scale, block_mask)
+        acc_c, m_c, l_c = _block_stats(qg, k_cur, v_cur, scale,
+                                       block_mask, softcap)
         m_new = jnp.maximum(m, m_c)
         a1 = jnp.exp(m - m_new)
         a2 = jnp.exp(m_c - m_new)
@@ -146,6 +149,7 @@ def ring_attention(
     scale: Optional[float] = None,
     segments: Optional[jax.Array] = None,  # (B, S) packed document ids
     window: Optional[int] = None,
+    softcap: Optional[float] = None,
     axis_name: str = AXIS_SEQ,
 ) -> jax.Array:
     """Sequence-parallel attention. q (B,S,H,D); k,v (B,S,Hkv,D).
@@ -168,6 +172,7 @@ def ring_attention(
         functools.partial(
             _ring_local, axis_name=axis_name, causal=causal,
             scale=float(scale), has_segments=has_segments, window=window,
+            softcap=None if softcap is None else float(softcap),
         ),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, seg_spec),

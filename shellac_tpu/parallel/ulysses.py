@@ -60,7 +60,7 @@ def ulysses_supported(
 
 def _ulysses_local(
     q, k, v, seg, *, axis_name: str, causal: bool, window: Optional[int],
-    scale: float, impl: str, has_segments: bool,
+    scale: float, impl: str, has_segments: bool, softcap=None,
 ):
     """Runs on one device inside shard_map.
 
@@ -108,7 +108,7 @@ def _ulysses_local(
 
     o = attention(
         qh, kh, vh, causal=causal, window=window, scale=scale, impl=impl,
-        q_segments=seg_full, kv_segments=seg_full,
+        softcap=softcap, q_segments=seg_full, kv_segments=seg_full,
     )
 
     # head-sharded -> seq-sharded
@@ -126,6 +126,7 @@ def ulysses_attention(
     causal: bool = True,
     window: Optional[int] = None,
     scale: Optional[float] = None,
+    softcap: Optional[float] = None,
     segments: Optional[jax.Array] = None,  # (B, S) packed document ids
     axis_name: str = AXIS_SEQ,
     impl: str = "auto",
@@ -153,6 +154,7 @@ def ulysses_attention(
             _ulysses_local, axis_name=axis_name, causal=causal,
             window=window, scale=float(scale), impl=impl,
             has_segments=has_segments,
+            softcap=None if softcap is None else float(softcap),
         ),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, seg_spec),

@@ -1,0 +1,198 @@
+"""Per-layer attention patterns (Gemma-2/3 style) and score softcap.
+
+The pattern machinery reshapes the flat (L, ...) layer stack into
+(L/period, period, ...) groups inside forward — these tests pin the
+invariants: a uniform pattern equals the flat path bit-for-bit, the
+cached decode matches the training forward, and the softcap kernels
+(flash fwd/bwd, dense + paged decode) match the reference math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.models.transformer import (
+    forward,
+    forward_with_cache,
+    init_params,
+)
+from shellac_tpu.ops.attention import attention_ref
+from shellac_tpu.ops.flash_attention import flash_attention
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=128, d_model=64, n_layers=4, n_heads=4, n_kv_heads=2,
+        max_seq_len=64, dtype="float32",
+    )
+    base.update(kw)
+    return ModelConfig(**base).validate()
+
+
+def test_pattern_validation():
+    with pytest.raises(ValueError, match="entries"):
+        _cfg(attn_pattern=("window", "banana"), attn_window=8)
+    with pytest.raises(ValueError, match="attn_window"):
+        _cfg(attn_pattern=("window", "full"))
+    with pytest.raises(ValueError, match="whole"):
+        _cfg(attn_pattern=("window", "full", "full"), attn_window=8)
+
+
+def test_uniform_pattern_equals_flat():
+    """("window",)*k patterns must reproduce the flat windowed scan
+    exactly — same params, same math, only the scan grouping differs."""
+    cfg_flat = _cfg(attn_window=8)
+    cfg_pat = cfg_flat.replace(attn_pattern=("window", "window"))
+    params = init_params(cfg_flat, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+    a = forward(cfg_pat, params, toks, attn_impl="ref")
+    b = forward(cfg_flat, params, toks, attn_impl="ref")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_alternating_pattern_differs_from_uniform():
+    """Sanity: the "full" layers really drop the window."""
+    cfg_pat = _cfg(attn_window=4, attn_pattern=("window", "full"))
+    params = init_params(cfg_pat, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 32), 0, 128)
+    mixed = forward(cfg_pat, params, toks, attn_impl="ref")
+    allwin = forward(
+        cfg_pat.replace(attn_pattern=None), params, toks, attn_impl="ref"
+    )
+    assert float(jnp.abs(mixed - allwin).max()) > 1e-4
+
+
+def test_patterned_decode_matches_forward():
+    """Prefill + per-token decode through the grouped cache scan must
+    reproduce the training forward's logits position by position."""
+    from shellac_tpu.inference.kvcache import init_cache
+
+    cfg = _cfg(
+        attn_window=8, attn_pattern=("window", "full"), attn_softcap=30.0,
+        attn_scale=0.2, post_norms=True,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0, 128)
+    full = forward(cfg, params, toks, attn_impl="ref")
+
+    cache = init_cache(cfg, batch=2, max_len=64)
+    got, cache = forward_with_cache(
+        cfg, params, toks[:, :12], cache, fresh_cache=True, attn_impl="ref"
+    )
+    np.testing.assert_allclose(
+        np.asarray(got[:, :12]), np.asarray(full[:, :12]), atol=1e-5
+    )
+    for t in range(12, 24):
+        got, cache = forward_with_cache(
+            cfg, params, toks[:, t:t + 1], cache, attn_impl="ref"
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[:, 0]), np.asarray(full[:, t]), atol=1e-5
+        )
+
+
+@pytest.mark.parametrize("window", [None, 64])
+def test_flash_softcap_parity(window):
+    key = jax.random.PRNGKey(0)
+    b, s, h, hkv, d = 2, 256, 4, 2, 64
+    q = jax.random.normal(key, (b, s, h, d), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d))
+    kw = dict(causal=True, window=window, scale=0.11, softcap=30.0)
+    ref = attention_ref(q, k, v, **kw)
+    got = flash_attention(
+        q, k, v, **kw, interpret=True, block_q=64, block_k=64
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_softcap_grads():
+    """The backward kernels chain the tanh derivative; grads must match
+    autodiff through the reference to fp32 tolerance."""
+    b, s, h, hkv, d = 1, 128, 2, 1, 64
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+    k = jax.random.normal(jax.random.PRNGKey(1), (b, s, hkv, d))
+    v = jax.random.normal(jax.random.PRNGKey(2), (b, s, hkv, d))
+    kw = dict(causal=True, scale=0.13, softcap=25.0)
+
+    def f_ref(q, k, v):
+        return (attention_ref(q, k, v, **kw) ** 2).sum()
+
+    def f_fl(q, k, v):
+        return (flash_attention(
+            q, k, v, **kw, interpret=True, block_q=64, block_k=64
+        ) ** 2).sum()
+
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(f_fl, argnums=(0, 1, 2))(q, k, v)
+    for a, bb in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(bb), np.asarray(a), atol=1e-4)
+
+
+def test_decode_softcap_parity():
+    from shellac_tpu.ops.decode_attention import _decode_ref, decode_attention
+
+    b, s, h, hkv, d, max_len = 4, 1, 8, 4, 128, 512
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+    ck = jax.random.normal(jax.random.PRNGKey(1), (b, hkv, max_len, d))
+    cv = jax.random.normal(jax.random.PRNGKey(2), (b, hkv, max_len, d))
+    idx = jnp.array([37, 100, 250, 511], jnp.int32)
+    for cap, win in [(30.0, None), (25.0, 128)]:
+        got = decode_attention(
+            q, ck, cv, idx, window=win, softcap=cap, impl="flash",
+            interpret=True,
+        )
+        ref = _decode_ref(q, ck, cv, idx, win, d ** -0.5, softcap=cap)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_softcap_parity():
+    from shellac_tpu.inference.kvcache import paged_gather_layer
+    from shellac_tpu.ops.decode_attention import (
+        _decode_ref,
+        paged_decode_attention,
+    )
+
+    b, s, h, hkv, d = 4, 1, 8, 4, 128
+    bs_pg, nb, npool = 16, 64, 300
+    q = jax.random.normal(jax.random.PRNGKey(0), (b, s, h, d))
+    pk = jax.random.normal(jax.random.PRNGKey(1), (npool, hkv, bs_pg, d))
+    pv = jax.random.normal(jax.random.PRNGKey(2), (npool, hkv, bs_pg, d))
+    tab = jax.random.permutation(
+        jax.random.PRNGKey(3), npool
+    )[: b * nb].reshape(b, nb).astype(jnp.int32)
+    idx = jnp.array([17, 300, 600, 1023], jnp.int32)
+    got = paged_decode_attention(
+        q, pk, pv, tab, idx, softcap=40.0, impl="flash", interpret=True
+    )
+    ka, va = paged_gather_layer(pk, pv, tab)
+    ref = _decode_ref(q, ka, va, idx, None, d ** -0.5, softcap=40.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+
+def test_patterned_training_on_mesh():
+    """Patterned stacks train under fsdp/tp sharding: the grouped scan's
+    reshaped leaves must keep valid shardings end to end."""
+    from shellac_tpu.parallel.mesh import make_mesh
+    from shellac_tpu.config import ParallelConfig, TrainConfig
+    from shellac_tpu.training.trainer import init_train_state, make_train_step
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = _cfg(
+        attn_window=8, attn_pattern=("window", "full"), attn_softcap=30.0,
+        post_norms=True, dtype="float32",
+    )
+    mesh = make_mesh(
+        ParallelConfig(fsdp=2, tp=2), devices=jax.devices()[:4]
+    )
+    tcfg = TrainConfig(learning_rate=1e-3, warmup_steps=2, total_steps=10)
+    state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh)
+    step = make_train_step(cfg, tcfg, mesh=mesh)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
+    batch = {"inputs": tokens, "targets": tokens}
+    state2, m1 = step(state, batch)
+    _, m2 = step(state2, batch)
+    assert np.isfinite(m1["loss"]) and m2["loss"] < m1["loss"] * 1.5
