@@ -206,9 +206,15 @@ class ModelConfig:
     mla: Optional[MLAConfig] = None
     # Rope scaling for long-context checkpoints (applies to the
     # rope_dim — MLA's qk_rope slice or the full head_dim). At most one
-    # of yarn (DeepSeek/Qwen long-context) / llama3 (Llama-3.1 family).
+    # of yarn (DeepSeek/Qwen long-context) / llama3 (Llama-3.1 family) /
+    # linear (classic position interpolation; Gemma-3 global layers).
     rope_yarn: Optional[YarnConfig] = None
     rope_llama3: Optional[Llama3RopeConfig] = None
+    rope_linear: Optional[float] = None
+    # Gemma-3 dual rope: "window" layers of an attn_pattern rope with
+    # this theta and NO scaling, while "full" layers use rope_theta plus
+    # whatever scaling config is set. Requires attn_pattern.
+    rope_local_theta: Optional[float] = None
     # Per-head-dim RMSNorm on q and k before rope (Qwen3-style).
     qk_norm: bool = False
 
@@ -348,8 +354,19 @@ class ModelConfig:
                 f"quant_training={self.quant_training!r}; "
                 "have None, 'int8', 'int8_bwd'"
             )
-        if self.rope_yarn is not None and self.rope_llama3 is not None:
-            raise ValueError("rope_yarn and rope_llama3 are exclusive")
+        if sum(x is not None for x in (
+            self.rope_yarn, self.rope_llama3, self.rope_linear,
+        )) > 1:
+            raise ValueError(
+                "rope_yarn / rope_llama3 / rope_linear are exclusive"
+            )
+        if self.rope_local_theta is not None and (
+            self.attn_pattern is None or "window" not in self.attn_pattern
+        ):
+            raise ValueError(
+                "rope_local_theta needs an attn_pattern with 'window' "
+                "layers (a uniform model just sets rope_theta)"
+            )
         if self.mla is not None:
             if self.n_kv_heads is not None:
                 raise ValueError(

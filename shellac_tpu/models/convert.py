@@ -45,6 +45,8 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         return _deepseek_config(hf_cfg)
     if getattr(hf_cfg, "model_type", "") == "gemma2":
         return _gemma2_config(hf_cfg)
+    if getattr(hf_cfg, "model_type", "") in ("gemma3_text", "gemma3"):
+        return _gemma3_config(hf_cfg)
     moe = None
     if getattr(hf_cfg, "num_local_experts", None):
         moe = MoEConfig(
@@ -176,6 +178,79 @@ def _gemma2_config(hf_cfg) -> ModelConfig:
         attn_softcap=getattr(hf_cfg, "attn_logit_softcapping", None),
         logit_softcap=getattr(hf_cfg, "final_logit_softcapping", None),
         attn_scale=None if qpas is None else float(qpas) ** -0.5,
+        post_norms=True,
+        activation="geglu",
+        embed_scale=True,
+    ).validate()
+
+
+def _gemma3_config(hf_cfg) -> ModelConfig:
+    """Gemma-3 (text) config mapping: the Gemma-2 block (sandwich norms,
+    GeGLU, scaled embeddings, patterned local/global attention) minus
+    the softcaps, plus Qwen3-style per-head-dim q/k RMSNorm and DUAL
+    rope — local layers rope with rope_local_base_freq unscaled, global
+    layers with rope_theta and the checkpoint's (linear) rope scaling.
+    """
+    if getattr(hf_cfg, "model_type", "") == "gemma3":
+        # Multimodal wrapper config: the text tower's config nests under
+        # text_config; vision conversion is out of scope.
+        inner = getattr(hf_cfg, "text_config", None)
+        if inner is None:
+            raise NotImplementedError(
+                "gemma3 config without a text_config (vision-only?)"
+            )
+        hf_cfg = inner
+    n_layers = hf_cfg.num_hidden_layers
+    swp = getattr(hf_cfg, "sliding_window_pattern", None) or 6
+    layer_types = getattr(hf_cfg, "layer_types", None) or [
+        # Older configs predate layer_types: every swp-th layer is
+        # global (sliding_window_pattern, default 6).
+        "full_attention" if (i + 1) % swp == 0 else "sliding_attention"
+        for i in range(n_layers)
+    ]
+    pattern = _pattern_from_layer_types(layer_types)
+    windowed = "window" in pattern
+    uniform = len(set(pattern)) == 1
+    if uniform:
+        pattern = None
+    rope_kw = _rope_from_hf(
+        getattr(hf_cfg, "rope_scaling", None),
+        hf_cfg.max_position_embeddings,
+    )
+    rope_linear = rope_kw.pop("rope_linear", None)
+    if rope_kw:
+        raise NotImplementedError(
+            f"gemma3 with {sorted(rope_kw)} rope scaling (have: linear)"
+        )
+    qpas = getattr(hf_cfg, "query_pre_attn_scalar", None)
+    local_theta = getattr(hf_cfg, "rope_local_base_freq", None)
+    rope_theta = getattr(hf_cfg, "rope_theta", 1000000.0)
+    if uniform and windowed and local_theta is not None:
+        # Every layer is sliding: the local frequency base IS the rope,
+        # and the global-layer scaling never applies.
+        rope_theta, rope_linear = float(local_theta), None
+    return ModelConfig(
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_layers=n_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=getattr(hf_cfg, "num_key_value_heads", None)
+        or hf_cfg.num_attention_heads,
+        head_dim=getattr(hf_cfg, "head_dim", None)
+        or hf_cfg.hidden_size // hf_cfg.num_attention_heads,
+        d_ff=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=rope_theta,
+        rope_linear=rope_linear,
+        rope_local_theta=(float(local_theta)
+                          if windowed and not uniform
+                          and local_theta is not None else None),
+        norm_eps=hf_cfg.rms_norm_eps,
+        tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", True)),
+        attn_window=int(hf_cfg.sliding_window) if windowed else None,
+        attn_pattern=pattern,
+        attn_scale=None if qpas is None else float(qpas) ** -0.5,
+        qk_norm=True,
         post_norms=True,
         activation="geglu",
         embed_scale=True,
@@ -319,6 +394,12 @@ def _rope_from_hf(rs, max_pos) -> dict:
     from shellac_tpu.config import Llama3RopeConfig, YarnConfig
 
     kind = rs.get("rope_type", rs.get("type"))
+    if kind in ("linear", "default"):
+        # Classic position interpolation: every inverse frequency
+        # divides by the factor ("default" means no change).
+        if kind == "default" or float(rs.get("factor", 1.0)) == 1.0:
+            return {}
+        return {"rope_linear": float(rs["factor"])}
     if kind == "llama3":
         if not rs.get("original_max_position_embeddings"):
             # Required: falling back to the post-scaling max would shift
@@ -338,7 +419,7 @@ def _rope_from_hf(rs, max_pos) -> dict:
     if kind != "yarn":
         raise NotImplementedError(
             f"rope_scaling type {kind!r} is not supported "
-            "(have: yarn, llama3)"
+            "(have: linear, yarn, llama3)"
         )
     return {"rope_yarn": YarnConfig(
         factor=rs["factor"],
@@ -764,10 +845,10 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
                 sd[base + theirs] = w.T if transpose else w
             if cfg.qk_norm:
                 sd[base + "self_attn.q_norm.weight"] = (
-                    np_(layers["q_norm"][i]) + 1.0
+                    np_(layers["q_norm"][i]) + noff
                 )
                 sd[base + "self_attn.k_norm.weight"] = (
-                    np_(layers["k_norm"][i]) + 1.0
+                    np_(layers["k_norm"][i]) + noff
                 )
         if cfg.attn_bias:
             for ours, theirs in _BIAS_MAP.items():

@@ -28,6 +28,18 @@ PRESETS = {
     "tiny-encoder": ModelConfig(vocab_size=256, d_model=64, n_layers=2,
                                 n_heads=4, max_seq_len=128, remat=False,
                                 causal=False),
+    # The full Gemma-3 (text) shape in miniature: 5:1 local/global
+    # pattern, dual rope (unscaled local theta / linear-scaled global),
+    # qk-norm, sandwich norms, no softcaps.
+    "tiny-gemma3": ModelConfig(vocab_size=256, d_model=64, n_layers=6,
+                               n_heads=4, n_kv_heads=2, max_seq_len=128,
+                               remat=False, attn_window=16,
+                               attn_pattern=("window",) * 5 + ("full",),
+                               rope_theta=1_000_000.0,
+                               rope_local_theta=10_000.0, rope_linear=8.0,
+                               attn_scale=16 ** -0.5, qk_norm=True,
+                               post_norms=True, activation="geglu",
+                               embed_scale=True),
     # The full Gemma-2 shape in miniature: alternating local/global
     # attention, score + final-logit tanh capping, sandwich norms, a
     # query_pre_attn_scalar score scale, GeGLU, scaled embeddings.

@@ -869,7 +869,14 @@ def forward(
         else:
             pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
     cos, sin = rope_angles(pos, cfg.rope_dim, cfg.rope_theta,
-                           yarn=cfg.rope_yarn, llama3=cfg.rope_llama3)
+                           yarn=cfg.rope_yarn, llama3=cfg.rope_llama3,
+                           linear=cfg.rope_linear)
+    if cfg.rope_local_theta is not None:
+        # Gemma-3 dual rope: "window" layers use their own unscaled
+        # frequency base; rope scaling applies to global layers only.
+        cos_l, sin_l = rope_angles(pos, cfg.rope_dim, cfg.rope_local_theta)
+    else:
+        cos_l = sin_l = None
 
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))
@@ -963,6 +970,12 @@ def forward(
                 "cos": ("batch", "seq", None),
                 "sin": ("batch", "seq", None),
             }
+            if cos_l is not None:
+                extras.update({"cos_l": cos_l, "sin_l": sin_l})
+                extras_axes.update({
+                    "cos_l": ("batch", "seq", None),
+                    "sin_l": ("batch", "seq", None),
+                })
             if segment_ids is not None:
                 # Keep the sp replication set up above: sharding seg
                 # over "seq" here would reintroduce the per-layer sp
@@ -974,12 +987,15 @@ def forward(
             # Uniform positions: a (1, S, half) table broadcasts over
             # every microbatch — cheaper than shifting per-row tables.
             cos, sin = cos[:1], sin[:1]
+            if cos_l is not None:
+                cos_l, sin_l = cos_l[:1], sin_l[:1]
 
         if grouped_moe(cfg):
             pp_blk_d = make_pp_block(False)
             pp_blk_m = make_pp_block(True)
 
-            def run_stack(sp_glp, x, cos_m, sin_m, seg_m):
+            def run_stack(sp_glp, x, cos_m, sin_m, seg_m,
+                          cos_lm=None, sin_lm=None):
                 # sp_glp: this stage's groups — {"dense": (Gs, every-1,
                 # ...), "moe": (Gs, ...)}.
                 def blk_d(x, lp):
@@ -994,7 +1010,8 @@ def forward(
             pp_blocks = [make_pp_block(None, kind)
                          for kind in cfg.attn_pattern]
 
-            def run_stack(sp_lp, x, cos_m, sin_m, seg_m):
+            def run_stack(sp_lp, x, cos_m, sin_m, seg_m,
+                          cos_lm=None, sin_lm=None):
                 # sp_lp: (per_stage, ...) -> (groups, period, ...);
                 # the scan walks groups, the pattern unrolls inside (a
                 # window is a static kernel argument, so each kind
@@ -1010,7 +1027,12 @@ def forward(
                     x, acc = carry
                     for i, blk in enumerate(pp_blocks):
                         lp_i = jax.tree.map(lambda a, i=i: a[i], gl)
-                        x, _, moe_out = blk(x, lp_i, cos_m, sin_m, seg_m)
+                        local = (cos_lm is not None
+                                 and cfg.attn_pattern[i] == "window")
+                        x, _, moe_out = blk(
+                            x, lp_i, cos_lm if local else cos_m,
+                            sin_lm if local else sin_m, seg_m,
+                        )
                         acc = _add_aux(acc, moe_out)
                     return (x, acc), None
 
@@ -1019,7 +1041,8 @@ def forward(
         else:
             pp_block = make_pp_block(None)
 
-            def run_stack(sp_lp, x, cos_m, sin_m, seg_m):
+            def run_stack(sp_lp, x, cos_m, sin_m, seg_m,
+                          cos_lm=None, sin_lm=None):
                 def body(carry, lp):
                     x, acc = carry
                     x, _, moe_out = pp_block(x, lp, cos_m, sin_m, seg_m)
@@ -1031,11 +1054,12 @@ def forward(
         if ragged:
             def stage_fn(sp_lp, x, ex):
                 return run_stack(
-                    sp_lp, x, ex["cos"], ex["sin"], ex.get("seg")
+                    sp_lp, x, ex["cos"], ex["sin"], ex.get("seg"),
+                    ex.get("cos_l"), ex.get("sin_l"),
                 )
         else:
             def stage_fn(sp_lp, x):
-                return run_stack(sp_lp, x, cos, sin, None)
+                return run_stack(sp_lp, x, cos, sin, None, cos_l, sin_l)
 
         n_micro = pipeline_microbatches or pp
         x, aux_sum = pipeline_apply(
@@ -1124,7 +1148,12 @@ def forward(
             x, acc = carry
             for i, blk in enumerate(blocks):
                 lp_i = jax.tree.map(lambda a, i=i: a[i], gl)
-                x, _, moe_out = blk(x, lp_i, cos, sin)
+                local = (cos_l is not None
+                         and cfg.attn_pattern[i] == "window")
+                x, _, moe_out = blk(
+                    x, lp_i, cos_l if local else cos,
+                    sin_l if local else sin,
+                )
                 acc = _add_aux(acc, moe_out)
             return (x, acc), None
 
@@ -1220,7 +1249,14 @@ def forward_with_cache(
         jnp.arange(s, dtype=jnp.int32), (b, s)
     )
     cos, sin = rope_angles(positions, cfg.rope_dim, cfg.rope_theta,
-                           yarn=cfg.rope_yarn, llama3=cfg.rope_llama3)
+                           yarn=cfg.rope_yarn, llama3=cfg.rope_llama3,
+                           linear=cfg.rope_linear)
+    if cfg.rope_local_theta is not None:
+        cos_l, sin_l = rope_angles(
+            positions, cfg.rope_dim, cfg.rope_local_theta
+        )
+    else:
+        cos_l = sin_l = None
 
     x = _embed_tokens(cfg, params, tokens, cdt, mesh=mesh)
     x = constrain(x, mesh, ("batch", "seq", None))
@@ -1228,8 +1264,10 @@ def forward_with_cache(
     tables = cache.tables if paged else None
 
     def run_block(x, lp, ck, cv, moe_flag, scales=None, attn_kind=None):
+        local = cos_l is not None and attn_kind == "window"
         return _block(
-            cfg, mesh, attn_impl, x, lp, cos, sin,
+            cfg, mesh, attn_impl, x, lp,
+            cos_l if local else cos, sin_l if local else sin,
             cache=(ck, cv, index, positions), fresh_cache=fresh_cache,
             page_tables=tables, moe_layer=moe_flag, kv_scales=scales,
             attn_kind=attn_kind,

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 
 def rope_angles(positions: jax.Array, head_dim: int, theta: float = 10000.0,
-                yarn=None, llama3=None):
+                yarn=None, llama3=None, linear=None):
     """cos/sin tables for given absolute positions.
 
     positions: int32 array, any shape (typically (B, S) or (S,)).
@@ -20,7 +20,9 @@ def rope_angles(positions: jax.Array, head_dim: int, theta: float = 10000.0,
     With a YarnConfig the inverse frequencies blend interpolation and
     extrapolation per the NTK-by-parts recipe and the tables carry the
     attention (mscale) factor; with a Llama3RopeConfig the frequencies
-    scale by wavelength band — both numerics match HF exactly.
+    scale by wavelength band — both numerics match HF exactly. `linear`
+    is classic position interpolation (HF "linear": every inverse
+    frequency divides by the factor; Gemma-3 global layers).
     """
     half = head_dim // 2
     scale = 1.0
@@ -32,6 +34,8 @@ def rope_angles(positions: jax.Array, head_dim: int, theta: float = 10000.0,
         )
         if llama3 is not None:
             freq = _llama3_inv_freq(freq, llama3)
+        if linear is not None:
+            freq = freq / linear
     ang = positions.astype(jnp.float32)[..., None] * freq
     return jnp.cos(ang) * scale, jnp.sin(ang) * scale
 

@@ -312,10 +312,34 @@ def test_unsupported_rope_scaling_rejected():
     cfg = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64,
         num_hidden_layers=2, num_attention_heads=2,
-        rope_scaling={"rope_type": "linear", "factor": 2.0},
+        rope_scaling={"rope_type": "dynamic", "factor": 2.0},
     )
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         config_from_hf(cfg)
+
+
+def test_linear_rope_scaling_parity():
+    """Classic position-interpolation (linear) rope scaling converts
+    with exact logits parity for Llama-family checkpoints."""
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_scaling={"rope_type": "linear", "factor": 4.0},
+        attn_implementation="eager",
+    )
+    torch.manual_seed(11)
+    model = transformers.LlamaForCausalLM(cfg_hf).eval()
+    cfg, params = from_hf(model)
+    assert cfg.rope_linear == 4.0
+    cfg = cfg.replace(dtype="float32")
+    tokens = np.random.RandomState(5).randint(0, 128, (1, 48))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32))
+    )
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
 
 
 def test_qwen3_generation_and_export():
@@ -849,6 +873,76 @@ def test_gemma2_export_roundtrip():
     cfg, params = from_hf(model)
     sd = {k: torch.from_numpy(v) for k, v in to_state_dict(cfg, params).items()}
     model2 = _tiny_gemma2()
+    model2.load_state_dict(sd)
+    tokens = torch.randint(0, cfg.vocab_size, (1, 10))
+    with torch.no_grad():
+        np.testing.assert_allclose(
+            model2(tokens).logits.numpy(), model(tokens).logits.numpy(),
+            atol=1e-5,
+        )
+
+
+def _tiny_gemma3(n_layers=6, rope_scaling={"rope_type": "linear", "factor": 8.0}):
+    cfg_hf = transformers.Gemma3TextConfig(
+        vocab_size=151, hidden_size=48, intermediate_size=96,
+        num_hidden_layers=n_layers, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=16, max_position_embeddings=256,
+        sliding_window=8, rope_theta=1_000_000.0,
+        rope_local_base_freq=10_000.0, query_pre_attn_scalar=24,
+        rope_scaling=rope_scaling, attn_implementation="eager",
+    )
+    torch.manual_seed(9)
+    return transformers.Gemma3ForCausalLM(cfg_hf).eval()
+
+
+def test_gemma3_logits_parity():
+    """Gemma-3 converts exactly: 5:1 local/global pattern, DUAL rope
+    (local theta unscaled on window layers, linear-scaled global theta
+    on full layers), qk-norm with the gemma (1+w) convention, sandwich
+    norms, no softcaps."""
+    model = _tiny_gemma3()
+    cfg, params = from_hf(model)
+    assert cfg.attn_pattern == ("window",) * 5 + ("full",)
+    assert cfg.rope_local_theta == 10_000.0 and cfg.rope_theta == 1_000_000.0
+    assert cfg.rope_linear == 8.0 and cfg.qk_norm and cfg.post_norms
+    assert cfg.attn_softcap is None
+    cfg = cfg.replace(dtype="float32")
+    tokens = np.random.RandomState(3).randint(0, 151, (2, 24))
+    with torch.no_grad():
+        ref = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(
+        transformer.forward(cfg, params, jnp.asarray(tokens, jnp.int32),
+                            attn_impl="ref")
+    )
+    np.testing.assert_allclose(ours, ref, atol=3e-4, rtol=3e-3)
+
+
+def test_gemma3_greedy_generation_parity():
+    """Token-exact greedy decode — the cached decode must pick the
+    local/global rope table per layer kind exactly as the forward."""
+    from shellac_tpu.inference.engine import Engine
+
+    model = _tiny_gemma3()
+    cfg, params = from_hf(model)
+    cfg = cfg.replace(dtype="float32")
+    prompt = np.array([[5, 9, 2, 31, 77, 12, 88]], np.int64)
+    with torch.no_grad():
+        ref = model.generate(
+            torch.from_numpy(prompt), max_new_tokens=12, do_sample=False,
+        ).numpy()[:, prompt.shape[1]:]
+    out = Engine(cfg, params, temperature=0.0, max_len=64).generate(
+        jnp.asarray(prompt, jnp.int32), max_new_tokens=12
+    )
+    np.testing.assert_array_equal(np.asarray(out.tokens), ref)
+
+
+def test_gemma3_export_roundtrip():
+    from shellac_tpu.models.convert import to_state_dict
+
+    model = _tiny_gemma3()
+    cfg, params = from_hf(model)
+    sd = {k: torch.from_numpy(v) for k, v in to_state_dict(cfg, params).items()}
+    model2 = _tiny_gemma3()
     model2.load_state_dict(sd)
     tokens = torch.randint(0, cfg.vocab_size, (1, 10))
     with torch.no_grad():
