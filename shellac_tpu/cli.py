@@ -376,6 +376,54 @@ def _apply_lora(args, cfg, params):
     return merge_lora(params, jax.device_get(state.lora), lcfg)
 
 
+def cmd_dpo(args):
+    """Preference fine-tuning (DPO) from a JSONL of pairs.
+
+    The policy starts from --base-ckpt (or random); the frozen
+    reference defaults to a copy of the starting policy. Data rows:
+    {"prompt": ..., "chosen": ..., "rejected": ...} with token-id
+    lists, or strings when --tokenizer is given.
+    """
+    from shellac_tpu.training.dpo import (
+        DPOConfig,
+        fit_dpo,
+        preference_batches,
+    )
+
+    cfg = _model_config(args)
+    tcfg = _train_config(args)
+    dcfg = DPOConfig(
+        beta=args.beta,
+        loss_type=args.loss_type,
+        label_smoothing=args.label_smoothing,
+        reference_free=args.reference_free,
+    ).validate()
+    mesh = _mesh_from(args)
+    tokenizer = None
+    if args.tokenizer:
+        from shellac_tpu.training.tokenizer import ByteTokenizer
+
+        tokenizer = ByteTokenizer()
+    data = preference_batches(
+        args.data, args.batch, args.max_len,
+        tokenizer=tokenizer, seed=args.seed,
+    )
+    init_params = _restore_base_params(args, cfg, mesh)
+    state = fit_dpo(
+        cfg, tcfg, dcfg, data,
+        init_params=init_params,
+        mesh=mesh,
+        checkpoint_dir=args.ckpt_dir,
+        checkpoint_every=args.ckpt_every,
+        log_path=args.log_path,
+        log_every=args.log_every,
+    )
+    import jax
+
+    print(json.dumps({"final_step": int(jax.device_get(state.step))}))
+    return 0
+
+
 def cmd_eval(args):
     from shellac_tpu.training.evaluate import evaluate
 
@@ -730,6 +778,39 @@ def build_parser() -> argparse.ArgumentParser:
                    help="frozen base weights for --lora-rank (a regular "
                         "train checkpoint dir; default: random init)")
     t.set_defaults(fn=cmd_train)
+
+    d = sub.add_parser("dpo", help="preference fine-tuning (DPO)")
+    common(d)
+    d.add_argument("--data", required=True,
+                   help='JSONL of {"prompt","chosen","rejected"} pairs '
+                        "(token-id lists, or text with --tokenizer)")
+    d.add_argument("--tokenizer", action="store_true",
+                   help="rows hold text; encode with the byte tokenizer")
+    d.add_argument("--steps", type=int, default=100)
+    d.add_argument("--batch", type=int, default=8)
+    d.add_argument("--max-len", type=int, default=128, dest="max_len")
+    d.add_argument("--beta", type=float, default=0.1)
+    d.add_argument("--loss-type", default="sigmoid", dest="loss_type",
+                   choices=["sigmoid", "ipo", "hinge"])
+    d.add_argument("--label-smoothing", type=float, default=0.0,
+                   dest="label_smoothing")
+    d.add_argument("--reference-free", action="store_true",
+                   dest="reference_free")
+    d.add_argument("--mesh", default="",
+                   help="mesh axes, e.g. dp=2,fsdp=2,tp=2")
+    d.add_argument("--base-ckpt", default=None, dest="base_ckpt",
+                   help="starting policy weights (a train checkpoint "
+                        "dir; also the frozen reference)")
+    d.add_argument("--ckpt-dir")
+    d.add_argument("--ckpt-every", type=int, default=500)
+    d.add_argument("--log-path")
+    d.add_argument("--log-every", type=int, default=10)
+    d.add_argument("--learning-rate", type=float, dest="learning_rate")
+    d.add_argument("--warmup-steps", type=int, dest="warmup_steps")
+    d.add_argument("--weight-decay", type=float, dest="weight_decay")
+    d.add_argument("--optimizer",
+                   choices=["adamw", "lion", "adafactor", "muon"])
+    d.set_defaults(fn=cmd_dpo)
 
     e = sub.add_parser("eval", help="perplexity of a checkpoint")
     common(e)

@@ -1,0 +1,424 @@
+"""Direct Preference Optimization (DPO) fine-tuning.
+
+Preference alignment without a reward model or RL loop: given paired
+(chosen, rejected) completions, the policy is trained so that its
+log-ratio against a frozen reference model ranks chosen above rejected
+(Rafailov et al., 2023 — public method; the reference repo for this
+project is empty, SURVEY.md §0).
+
+TPU-first shape decisions:
+  - Chosen and rejected rows CONCATENATE along batch for one forward
+    (2B, S): one MXU-friendly batched pass instead of two half-size
+    ones, and XLA shards it like any other batch.
+  - The reference forward runs inside the same jitted step under
+    `stop_gradient` — no separate eval step, no host round-trip; the
+    reference params ride as a step argument (donating/closing over
+    them would bake ~2x param constants into the executable).
+  - Sequence log-probs reduce in fp32 over completion-masked targets.
+
+Batch format (all (B, S)):
+  {"chosen": i32 tokens, "rejected": i32 tokens,
+   "chosen_mask": f32 — 1.0 on COMPLETION tokens (the targets being
+   scored; prompt and pad positions 0.0), "rejected_mask": f32}
+Rows are prompt + completion concatenated; masks select which target
+positions count toward the sequence log-prob.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from shellac_tpu.config import ModelConfig, TrainConfig
+from shellac_tpu.models import transformer
+from shellac_tpu.training.optimizer import make_optimizer
+from shellac_tpu.training.train_state import TrainState, state_shardings
+from shellac_tpu.training.trainer import _LazyShardedStep, batch_shardings
+
+
+@dataclass(frozen=True)
+class DPOConfig:
+    """DPO objective configuration.
+
+    beta: inverse-temperature on the implicit reward (log-ratio scale).
+    loss_type: "sigmoid" (DPO), "ipo" (Azar et al. squared objective on
+      the raw log-ratio difference), or "hinge" (SLiC-style max-margin).
+    label_smoothing: cDPO — probability the preference label is flipped
+      (sigmoid loss only).
+    reference_free: score against a uniform reference (log-ratios become
+      plain policy log-probs); no ref_params forward runs.
+    """
+
+    beta: float = 0.1
+    loss_type: str = "sigmoid"
+    label_smoothing: float = 0.0
+    reference_free: bool = False
+
+    def validate(self) -> "DPOConfig":
+        if self.loss_type not in ("sigmoid", "ipo", "hinge"):
+            raise ValueError(
+                f"loss_type={self.loss_type!r}; have sigmoid, ipo, hinge"
+            )
+        if not 0.0 <= self.label_smoothing < 0.5:
+            raise ValueError(
+                f"label_smoothing={self.label_smoothing} must be in [0, 0.5)"
+            )
+        if self.label_smoothing and self.loss_type != "sigmoid":
+            raise ValueError(
+                "label_smoothing is defined for the sigmoid loss only"
+            )
+        if self.beta <= 0:
+            raise ValueError(f"beta={self.beta} must be positive")
+        return self
+
+    def replace(self, **kw) -> "DPOConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def sequence_logprobs(
+    model_cfg: ModelConfig, params, tokens, mask, *,
+    mesh=None, attn_impl: str = "auto",
+):
+    """Summed next-token log-probs over masked target positions.
+
+    tokens (B, S) i32; mask (B, S) f32 where mask[:, t] == 1.0 means the
+    TARGET at position t (i.e. predicting tokens[:, t] from the prefix)
+    counts. Position 0 can never be a target. Returns (B,) fp32.
+    """
+    logits = transformer.forward(
+        model_cfg, params, tokens[:, :-1], mesh=mesh, attn_impl=attn_impl
+    )  # (B, S-1, V) fp32
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tgt = tokens[:, 1:]
+    token_lp = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.sum(token_lp * mask[:, 1:].astype(jnp.float32), axis=-1)
+
+
+def dpo_loss(
+    policy_chosen, policy_rejected, ref_chosen, ref_rejected,
+    dpo_cfg: DPOConfig,
+):
+    """(loss (scalar), metrics dict) from per-sequence log-probs."""
+    beta = dpo_cfg.beta
+    chosen_ratio = policy_chosen - ref_chosen
+    rejected_ratio = policy_rejected - ref_rejected
+    h = chosen_ratio - rejected_ratio  # log-ratio difference
+    if dpo_cfg.loss_type == "sigmoid":
+        ls = dpo_cfg.label_smoothing
+        losses = (
+            -(1.0 - ls) * jax.nn.log_sigmoid(beta * h)
+            - ls * jax.nn.log_sigmoid(-beta * h)
+        )
+    elif dpo_cfg.loss_type == "ipo":
+        # Squared distance of the raw log-ratio difference from the
+        # 1/(2*beta) target margin.
+        losses = jnp.square(h - 1.0 / (2.0 * beta))
+    else:  # hinge
+        losses = jax.nn.relu(1.0 - beta * h)
+    loss = jnp.mean(losses)
+    metrics = {
+        "loss": loss,
+        "reward_chosen": jnp.mean(beta * chosen_ratio),
+        "reward_rejected": jnp.mean(beta * rejected_ratio),
+        "reward_margin": jnp.mean(beta * h),
+        "accuracy": jnp.mean((h > 0).astype(jnp.float32)),
+        "policy_chosen_logprob": jnp.mean(policy_chosen),
+    }
+    return loss, metrics
+
+
+def make_dpo_step(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    dpo_cfg: DPOConfig,
+    mesh: Optional[Mesh] = None,
+    attn_impl: str = "auto",
+    jit: bool = True,
+):
+    """Build `dpo_step(state, ref_params, batch) -> (state, metrics)`.
+
+    ref_params is the frozen reference pytree (typically the SFT
+    checkpoint the policy was initialized from); pass params with the
+    same sharding as the trainable ones. With reference_free=True pass
+    None.
+
+    The state is DONATED: ref_params must not alias state.params'
+    buffers (when starting DPO from the same checkpoint, copy one side,
+    e.g. `jax.tree.map(jnp.copy, params)` — XLA rejects
+    `f(donate(a), a)` at call time otherwise).
+    """
+    dpo_cfg = dpo_cfg.validate()
+    optimizer = make_optimizer(train_cfg)
+
+    def both_logprobs(params, batch):
+        # One (2B, S) forward scores chosen and rejected together.
+        tokens = jnp.concatenate([batch["chosen"], batch["rejected"]], 0)
+        mask = jnp.concatenate(
+            [batch["chosen_mask"], batch["rejected_mask"]], 0
+        )
+        lp = sequence_logprobs(
+            model_cfg, params, tokens, mask, mesh=mesh, attn_impl=attn_impl
+        )
+        b = batch["chosen"].shape[0]
+        return lp[:b], lp[b:]
+
+    def loss_fn(params, ref_params, batch):
+        pc, pr = both_logprobs(params, batch)
+        if dpo_cfg.reference_free:
+            rc = jnp.zeros_like(pc)
+            rr = jnp.zeros_like(pr)
+        else:
+            rc, rr = jax.tree.map(
+                jax.lax.stop_gradient, both_logprobs(ref_params, batch)
+            )
+        return dpo_loss(pc, pr, rc, rr, dpo_cfg)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def dpo_step(state: TrainState, ref_params, batch):
+        (_, metrics), grads = grad_fn(state.params, ref_params, batch)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_ema = state.ema_params
+        if train_cfg.ema_decay is not None:
+            d = train_cfg.ema_decay
+            new_ema = jax.tree.map(
+                lambda e, p: (e * d + p.astype(e.dtype) * (1.0 - d)).astype(
+                    e.dtype
+                ),
+                state.ema_params, new_params,
+            )
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params,
+            opt_state=new_opt_state, ema_params=new_ema,
+        )
+        return new_state, metrics
+
+    if not jit:
+        return dpo_step
+
+    if mesh is None:
+        return jax.jit(dpo_step, donate_argnums=(0,))
+
+    def jit_with_shardings(state, ref_params, batch):
+        abstract_state = jax.eval_shape(lambda s: s, state)
+        param_axes = transformer.logical_axes(model_cfg)
+        st_sh = state_shardings(mesh, abstract_state, param_axes)
+        ref_sh = None if ref_params is None else st_sh.params
+        b_sh = batch_shardings(mesh)
+        batch_in = jax.tree.map(lambda _: b_sh, batch)
+        return jax.jit(
+            dpo_step,
+            in_shardings=(st_sh, ref_sh, batch_in),
+            out_shardings=(st_sh, None),
+            donate_argnums=(0,),
+        )
+
+    return _LazyShardedStep(jit_with_shardings)
+
+
+def preference_batches(
+    path: str,
+    batch_size: int,
+    max_len: int,
+    *,
+    tokenizer=None,
+    loop: bool = True,
+    seed: int = 0,
+):
+    """Iterator of DPO batches from a JSONL file of preference pairs.
+
+    Each line holds {"prompt": ..., "chosen": ..., "rejected": ...}
+    where the fields are either token-id lists or strings (strings need
+    `tokenizer`). Rows become prompt+completion sequences right-padded
+    to max_len with completion-target masks; over-long rows keep the
+    full completion and truncate the prompt's LEFT (the completion is
+    what is being scored).
+    """
+    import json as _json
+
+    import numpy as np
+
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            r = _json.loads(line)
+
+            def ids(v):
+                if isinstance(v, str):
+                    if tokenizer is None:
+                        raise ValueError(
+                            "text fields need a tokenizer; pre-tokenized "
+                            "rows hold token-id lists"
+                        )
+                    return list(tokenizer.encode(v))
+                return list(v)
+
+            rows.append((ids(r["prompt"]), ids(r["chosen"]),
+                         ids(r["rejected"])))
+    if not rows:
+        raise ValueError(f"no preference pairs in {path}")
+    if len(rows) < batch_size:
+        raise ValueError(
+            f"{path} holds {len(rows)} pairs < batch_size={batch_size}; "
+            "the batcher drops ragged tails, so this would yield nothing"
+        )
+
+    def render(prompt, completion):
+        comp = completion[:max_len - 1]  # >= 1 prompt token must remain
+        keep = max_len - len(comp)
+        p = prompt[-keep:] if len(prompt) > keep else prompt
+        toks = p + comp
+        mask = [0.0] * len(p) + [1.0] * len(comp)
+        pad = max_len - len(toks)
+        return toks + [0] * pad, mask + [0.0] * pad
+
+    rng = np.random.RandomState(seed)
+    order = np.arange(len(rows))
+    while True:
+        rng.shuffle(order)
+        for start in range(0, len(order) - batch_size + 1, batch_size):
+            idx = order[start:start + batch_size]
+            c_t, c_m, r_t, r_m = [], [], [], []
+            for i in idx:
+                prompt, chosen, rejected = rows[i]
+                t, m = render(prompt, chosen)
+                c_t.append(t)
+                c_m.append(m)
+                t, m = render(prompt, rejected)
+                r_t.append(t)
+                r_m.append(m)
+            yield {
+                "chosen": jnp.asarray(np.asarray(c_t, np.int32)),
+                "chosen_mask": jnp.asarray(np.asarray(c_m, np.float32)),
+                "rejected": jnp.asarray(np.asarray(r_t, np.int32)),
+                "rejected_mask": jnp.asarray(np.asarray(r_m, np.float32)),
+            }
+        if not loop:
+            return
+
+
+def fit_dpo(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    dpo_cfg: DPOConfig,
+    data_iter,
+    *,
+    init_params=None,
+    ref_params=None,
+    mesh: Optional[Mesh] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 500,
+    log_path: Optional[str] = None,
+    log_every: int = 10,
+    resume: bool = True,
+):
+    """DPO training loop; returns the final TrainState.
+
+    init_params: starting policy weights (typically a restored SFT
+    checkpoint); random init when None. ref_params: frozen reference;
+    defaults to a COPY of the starting policy (the standard DPO setup).
+    Checkpoints hold the full TrainState under checkpoint_dir and
+    resume like fit().
+    """
+    from shellac_tpu.training.optimizer import make_optimizer as _mk_opt
+    from shellac_tpu.training.trainer import init_train_state
+    from shellac_tpu.utils.metrics import MetricsLogger
+    from shellac_tpu.utils.tracing import StepTimer
+
+    dpo_cfg = dpo_cfg.validate()
+    key = jax.random.PRNGKey(train_cfg.seed)
+
+    def init_from(params):
+        # Optimizer state around PROVIDED weights — never materializes
+        # the random init just to throw it away.
+        opt = _mk_opt(train_cfg)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=opt.init(params),
+            ema_params=(jax.tree.map(lambda p: p, params)
+                        if train_cfg.ema_decay is not None else None),
+        )
+
+    ckpt = None
+    if checkpoint_dir is not None:
+        from shellac_tpu.training.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir)
+    resuming = ckpt is not None and resume and ckpt.latest_step() is not None
+
+    if resuming:
+        abstract = jax.eval_shape(
+            lambda: init_train_state(model_cfg, train_cfg, key, mesh=mesh)
+        )
+        state = ckpt.restore(
+            abstract_state=abstract, mesh=mesh, model_cfg=model_cfg
+        )
+    elif init_params is not None:
+        if mesh is None:
+            state = jax.jit(init_from)(init_params)
+        else:
+            abstract = jax.eval_shape(init_from, init_params)
+            shardings = state_shardings(
+                mesh, abstract, transformer.logical_axes(model_cfg)
+            )
+            state = jax.jit(init_from, out_shardings=shardings)(init_params)
+    else:
+        state = init_train_state(model_cfg, train_cfg, key, mesh=mesh)
+
+    if ref_params is None and not dpo_cfg.reference_free:
+        # The reference anchors to the ORIGINAL starting policy — on
+        # resume it must NOT be rebuilt from the half-trained restored
+        # weights (the KL anchor would move every restart). Copies
+        # throughout: the step donates the state, and XLA rejects a
+        # donated buffer aliased by another argument.
+        if init_params is not None:
+            ref_params = jax.tree.map(jnp.copy, init_params)
+        elif resuming:
+            # Random-init base: regenerate it from the run's seed — the
+            # same weights the original invocation started from.
+            ref_params = init_train_state(
+                model_cfg, train_cfg, key, mesh=mesh
+            ).params
+        else:
+            ref_params = jax.tree.map(jnp.copy, state.params)
+
+    step_fn = make_dpo_step(model_cfg, train_cfg, dpo_cfg, mesh=mesh)
+    logger = MetricsLogger(log_path, every=1)
+    timer = StepTimer()
+
+    step = int(jax.device_get(state.step))
+    while step < train_cfg.total_steps:
+        try:
+            batch = next(data_iter)
+        except StopIteration:
+            break
+        state, metrics = step_fn(state, ref_params, batch)
+        step += 1
+        if step % log_every == 0 or step >= train_cfg.total_steps:
+            host_metrics = {k: jax.device_get(v) for k, v in metrics.items()}
+            dt = timer.tick()
+            if dt is not None:
+                host_metrics["steps_per_sec"] = log_every / dt
+            logger.log(step, host_metrics)
+        if ckpt is not None and step % checkpoint_every == 0:
+            ckpt.save(step, state)
+
+    if ckpt is not None:
+        ckpt.save(int(jax.device_get(state.step)), state, force=True,
+                  wait=True)
+    logger.close()
+    return state

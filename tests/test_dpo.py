@@ -1,0 +1,285 @@
+"""DPO preference fine-tuning.
+
+Synthetic task: prompts of the form [P, a, b] with chosen completion
+[a, a] and rejected [b, b]. After a few DPO steps the policy must rank
+chosen above rejected (accuracy -> 1, positive reward margin) and — the
+end-to-end check — greedy generation from the prompt must emit the
+chosen continuation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu.config import ModelConfig, TrainConfig
+from shellac_tpu.models.transformer import init_params
+from shellac_tpu.training.dpo import (
+    DPOConfig,
+    dpo_loss,
+    make_dpo_step,
+    sequence_logprobs,
+)
+from shellac_tpu.training.trainer import init_train_state
+
+
+def _cfg():
+    return ModelConfig(
+        vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+        max_seq_len=32, dtype="float32", remat=False,
+    ).validate()
+
+
+def _pref_batch(b=8, seed=0):
+    """[P, x, y | x, x] chosen vs [P, x, y | y, y] rejected."""
+    rng = np.random.RandomState(seed)
+    prompts = rng.randint(0, 64, (b, 3))
+    chosen = np.concatenate(
+        [prompts, prompts[:, 1:2], prompts[:, 1:2]], axis=1
+    )
+    rejected = np.concatenate(
+        [prompts, prompts[:, 2:3], prompts[:, 2:3]], axis=1
+    )
+    mask = np.zeros((b, 5), np.float32)
+    mask[:, 3:] = 1.0  # completion targets only
+    return {
+        "chosen": jnp.asarray(chosen, jnp.int32),
+        "rejected": jnp.asarray(rejected, jnp.int32),
+        "chosen_mask": jnp.asarray(mask),
+        "rejected_mask": jnp.asarray(mask),
+    }
+
+
+def test_dpo_config_validation():
+    with pytest.raises(ValueError, match="loss_type"):
+        DPOConfig(loss_type="banana").validate()
+    with pytest.raises(ValueError, match="label_smoothing"):
+        DPOConfig(label_smoothing=0.7).validate()
+    with pytest.raises(ValueError, match="sigmoid"):
+        DPOConfig(loss_type="ipo", label_smoothing=0.1).validate()
+    with pytest.raises(ValueError, match="beta"):
+        DPOConfig(beta=0.0).validate()
+
+
+def test_dpo_loss_values():
+    """Hand-computed sigmoid loss on scalars."""
+    pc = jnp.array([1.0])
+    pr = jnp.array([0.0])
+    rc = jnp.array([0.5])
+    rr = jnp.array([0.2])
+    cfg = DPOConfig(beta=2.0)
+    loss, metrics = dpo_loss(pc, pr, rc, rr, cfg)
+    h = (1.0 - 0.5) - (0.0 - 0.2)  # 0.7
+    expect = -np.log(1.0 / (1.0 + np.exp(-2.0 * h)))
+    np.testing.assert_allclose(float(loss), expect, rtol=1e-6)
+    np.testing.assert_allclose(float(metrics["reward_margin"]), 2.0 * h,
+                               rtol=1e-6)
+    assert float(metrics["accuracy"]) == 1.0
+    # ipo: squared distance from the 1/(2 beta) margin
+    loss_ipo, _ = dpo_loss(pc, pr, rc, rr, DPOConfig(beta=2.0,
+                                                     loss_type="ipo"))
+    np.testing.assert_allclose(float(loss_ipo), (h - 0.25) ** 2, rtol=1e-6)
+
+
+def test_sequence_logprobs_mask():
+    """Masked positions contribute exactly their token log-prob."""
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.asarray([[5, 9, 2, 31, 7]], jnp.int32)
+    full_mask = jnp.ones((1, 5), jnp.float32)
+    tail_mask = jnp.asarray([[0, 0, 0, 1, 1]], jnp.float32)
+    lp_full = sequence_logprobs(cfg, params, toks, full_mask)
+    lp_tail = sequence_logprobs(cfg, params, toks, tail_mask)
+    head_mask = jnp.asarray([[0, 1, 1, 0, 0]], jnp.float32)
+    lp_head = sequence_logprobs(cfg, params, toks, head_mask)
+    np.testing.assert_allclose(
+        np.asarray(lp_full), np.asarray(lp_tail) + np.asarray(lp_head),
+        rtol=1e-5,
+    )
+    assert float(lp_full[0]) < 0.0
+
+
+@pytest.mark.parametrize("loss_type", ["sigmoid", "ipo", "hinge"])
+def test_dpo_training_learns_preference(loss_type):
+    cfg = _cfg()
+    tcfg = TrainConfig(learning_rate=5e-4, warmup_steps=0, total_steps=60)
+    state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+    ref_params = jax.tree.map(jnp.copy, state.params)
+    step = make_dpo_step(cfg, tcfg, DPOConfig(beta=0.5,
+                                              loss_type=loss_type))
+    batch = _pref_batch()
+    state, m0 = step(state, ref_params, batch)
+    for _ in range(40):
+        state, m = step(state, ref_params, batch)
+    assert float(m["loss"]) < float(m0["loss"])
+    assert float(m["accuracy"]) == 1.0
+    assert float(m["reward_margin"]) > 0.0
+    assert float(m["reward_chosen"]) > float(m["reward_rejected"])
+
+
+def test_dpo_reference_free():
+    cfg = _cfg()
+    tcfg = TrainConfig(learning_rate=5e-4, warmup_steps=0, total_steps=60)
+    state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+    step = make_dpo_step(
+        cfg, tcfg, DPOConfig(beta=0.5, reference_free=True)
+    )
+    batch = _pref_batch()
+    for _ in range(30):
+        state, m = step(state, None, batch)
+    assert float(m["accuracy"]) == 1.0
+
+
+def test_dpo_generation_prefers_chosen():
+    """End-to-end: after DPO the greedy decode emits the chosen
+    continuation for every training prompt."""
+    from shellac_tpu.inference.engine import Engine
+
+    cfg = _cfg()
+    tcfg = TrainConfig(learning_rate=1e-3, warmup_steps=0, total_steps=80)
+    state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+    ref_params = jax.tree.map(jnp.copy, state.params)
+    step = make_dpo_step(cfg, tcfg, DPOConfig(beta=0.5))
+    batch = _pref_batch(b=4, seed=3)
+    for _ in range(70):
+        state, m = step(state, ref_params, batch)
+    eng = Engine(cfg, state.params, temperature=0.0, max_len=16)
+    out = eng.generate(batch["chosen"][:, :3], max_new_tokens=2)
+    np.testing.assert_array_equal(
+        np.asarray(out.tokens), np.asarray(batch["chosen"][:, 3:])
+    )
+
+
+def test_dpo_sharded_matches_unsharded():
+    from shellac_tpu.config import ParallelConfig
+    from shellac_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = _cfg()
+    tcfg = TrainConfig(learning_rate=5e-4, warmup_steps=0, total_steps=60)
+    key = jax.random.PRNGKey(0)
+    batch = _pref_batch()
+    dcfg = DPOConfig(beta=0.5)
+
+    state_u = init_train_state(cfg, tcfg, key)
+    ref_u = jax.tree.map(jnp.copy, state_u.params)
+    step_u = make_dpo_step(cfg, tcfg, dcfg)
+    for _ in range(3):
+        state_u, mu = step_u(state_u, ref_u, batch)
+
+    mesh = make_mesh(ParallelConfig(fsdp=2, tp=2),
+                     devices=jax.devices()[:4])
+    state_s = init_train_state(cfg, tcfg, key, mesh=mesh)
+    ref_s = jax.tree.map(jnp.copy, state_s.params)
+    step_s = make_dpo_step(cfg, tcfg, dcfg, mesh=mesh)
+    for _ in range(3):
+        state_s, ms = step_s(state_s, ref_s, batch)
+
+    np.testing.assert_allclose(
+        float(ms["loss"]), float(mu["loss"]), rtol=2e-4, atol=2e-5
+    )
+    np.testing.assert_allclose(
+        float(ms["reward_margin"]), float(mu["reward_margin"]),
+        rtol=2e-3, atol=2e-4,
+    )
+
+
+def test_preference_batches(tmp_path):
+    import json
+
+    from shellac_tpu.training.dpo import preference_batches
+
+    path = tmp_path / "pairs.jsonl"
+    rows = [
+        {"prompt": [1, 2, 3], "chosen": [4, 4], "rejected": [5, 5]},
+        {"prompt": [9] * 20, "chosen": [7, 7, 7], "rejected": [8]},
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in rows))
+    it = preference_batches(str(path), batch_size=2, max_len=8, loop=False)
+    b = next(it)
+    assert b["chosen"].shape == (2, 8)
+    # each row's mask marks exactly its completion tokens
+    for i in range(2):
+        row = np.asarray(b["chosen"][i])
+        mask = np.asarray(b["chosen_mask"][i])
+        n_comp = int(mask.sum())
+        assert n_comp in (2, 3)
+        comp = row[mask == 1.0]
+        assert set(comp.tolist()) <= {4, 7}
+    # over-long prompt was LEFT-truncated: the [9]*20 prompt row keeps
+    # its full completion
+    lens = [int(np.asarray(b["rejected_mask"][i]).sum()) for i in range(2)]
+    assert sorted(lens) == [1, 2]
+
+
+def test_dpo_cli_roundtrip(tmp_path, capsys):
+    import json
+
+    from shellac_tpu.cli import main
+
+    pairs = tmp_path / "pairs.jsonl"
+    rows = [
+        {"prompt": [1, 2], "chosen": [3, 3], "rejected": [4, 4]},
+        {"prompt": [5, 6], "chosen": [7, 7], "rejected": [8, 8]},
+    ]
+    pairs.write_text("\n".join(json.dumps(r) for r in rows))
+    ckpt = tmp_path / "ckpt"
+    rc = main([
+        "dpo", "--model", "tiny", "--data", str(pairs), "--steps", "5",
+        "--batch", "2", "--max-len", "8", "--learning-rate", "1e-4",
+        "--ckpt-dir", str(ckpt), "--log-every", "1",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1])["final_step"] == 5
+    # the checkpoint restores for generation
+    rc = main([
+        "generate", "--model", "tiny", "--ckpt-dir", str(ckpt),
+        "--prompt", "1,2", "--max-new", "4", "--temperature", "0",
+    ])
+    assert rc == 0
+
+
+def test_fit_dpo_resume_keeps_reference_anchor(tmp_path):
+    """On resume the frozen reference must be the ORIGINAL base policy,
+    not the restored half-trained one: the chosen reward (beta * policy
+    vs reference log-ratio) must continue from where the first run left
+    off, not reset toward 0. Also: ema_params must actually track the
+    policy when ema_decay is set."""
+    import json
+
+    from shellac_tpu.training.dpo import fit_dpo
+
+    cfg = _cfg()
+    dcfg = DPOConfig(beta=0.5)
+    batch = _pref_batch(b=4, seed=1)
+    data = lambda: iter([batch] * 100)  # noqa: E731
+    log1 = tmp_path / "m1.jsonl"
+    tcfg1 = TrainConfig(learning_rate=1e-3, warmup_steps=0, total_steps=6,
+                        ema_decay=0.5)
+    state1 = fit_dpo(
+        cfg, tcfg1, dcfg, data(), checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=3, log_path=str(log1), log_every=1,
+    )
+    # EMA tracked the policy (not stuck at init)
+    d = jax.tree.map(
+        lambda e, p: float(jnp.abs(e - p).max()),
+        state1.ema_params, state1.params,
+    )
+    moved = max(jax.tree.leaves(d))
+    ref_step1 = [json.loads(l) for l in log1.read_text().splitlines()]
+    m6 = next(r for r in ref_step1 if r["step"] == 6)
+    assert moved < 1.0  # ema followed along
+
+    log2 = tmp_path / "m2.jsonl"
+    tcfg2 = tcfg1.replace(total_steps=8)
+    fit_dpo(
+        cfg, tcfg2, dcfg, data(), checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_every=100, log_path=str(log2), log_every=1,
+    )
+    rows2 = [json.loads(l) for l in log2.read_text().splitlines()]
+    m7 = next(r for r in rows2 if r["step"] == 7)
+    # With the anchor preserved, step 7's margin continues from step
+    # 6's; a re-anchored reference would snap the margin back to ~0.
+    assert m7["reward_margin"] > 0.5 * m6["reward_margin"] > 0.0
