@@ -672,6 +672,7 @@ def cmd_serve(args):
         cfg, params,
         host=args.host, port=args.port,
         tokenizer=get_tokenizer(args.tokenizer),
+        model_name=(args.model or "shellac_tpu"),
         engine=engine,
         n_slots=args.slots, max_len=args.max_len,
         temperature=args.temperature, eos_id=args.eos_id,

@@ -238,6 +238,7 @@ class BatchingEngine:
             "engine_steps": 0,
             "prefills": 0,
             "prefill_chunks": 0,
+            "requests_cancelled": 0,
         }
 
     # ---- sharding ----------------------------------------------------
@@ -752,10 +753,12 @@ class BatchingEngine:
                 self._prefilling.pop(i, None)
                 self._release_slot(i)
                 self.finished_logprobs.pop(rid, None)
+                self.stats["requests_cancelled"] += 1
                 return True
         for req in list(self._queue):
             if req.rid == rid:
                 self._queue.remove(req)
+                self.stats["requests_cancelled"] += 1
                 return True
         return False
 

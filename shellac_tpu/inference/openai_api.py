@@ -1,0 +1,315 @@
+"""OpenAI-compatible API translation for the inference server.
+
+Pure request/response translators: OpenAI `/v1/completions` and
+`/v1/chat/completions` payloads map onto the native `/generate` payload
+schema (inference/server.py), and native results map back into OpenAI
+response shapes — so the whole battle-tested native path (continuous
+batching, stop sequences, per-request sampling, n/best_of fan-out,
+logprobs, streaming cancel) is reused rather than reimplemented.
+
+Scope honesty: knobs the engine genuinely implements translate;
+accepted-but-ignored knobs are limited to no-op values (e.g.
+`presence_penalty: 0`) — a NONZERO unsupported knob is a loud 400, not
+a silently different sampling distribution.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+def _bad(msg: str):
+    raise ValueError(msg)
+
+
+def _check_unsupported(payload: dict):
+    for key, neutral in (
+        ("presence_penalty", (0, 0.0, None)),
+        ("frequency_penalty", (0, 0.0, None)),
+        ("logit_bias", None),  # supported, validated downstream
+        ("suffix", (None, "")),
+        ("echo", (False, None)),
+    ):
+        if neutral is None:
+            continue
+        if key in payload and payload[key] not in neutral:
+            _bad(
+                f"{key}={payload[key]!r} is not supported by this server "
+                "(only the neutral value is accepted)"
+            )
+
+
+def _common_sampling(payload: dict, native: dict):
+    if payload.get("temperature") is not None:
+        native["temperature"] = float(payload["temperature"])
+    if payload.get("top_p") is not None:
+        native["top_p"] = float(payload["top_p"])
+    if payload.get("top_k") is not None:  # OpenAI-adjacent extension
+        native["top_k"] = payload["top_k"]
+    if payload.get("seed") is not None:
+        # The engine draws from its own counter-based stream; per-request
+        # seeds are not implemented. Refuse rather than pretend.
+        _bad("per-request seed is not supported")
+    stop = payload.get("stop")
+    if stop is not None:
+        native["stop"] = [stop] if isinstance(stop, str) else list(stop)
+    if payload.get("max_tokens") is not None:
+        native["max_new"] = int(payload["max_tokens"])
+    if payload.get("max_completion_tokens") is not None:
+        native["max_new"] = int(payload["max_completion_tokens"])
+    n = payload.get("n")
+    if n is not None:
+        native["n"] = int(n)
+    if payload.get("best_of") is not None:
+        native["best_of"] = int(payload["best_of"])
+    if payload.get("logit_bias") is not None:
+        native["logit_bias"] = payload["logit_bias"]
+    if payload.get("stream"):
+        native["stream"] = True
+
+
+def completion_to_native(payload: dict, tokenizer) -> dict:
+    """/v1/completions -> native /generate payload."""
+    _check_unsupported(payload)
+    prompt = payload.get("prompt")
+    if prompt is None:
+        _bad('"prompt" is required')
+    native: Dict[str, Any] = {}
+    if isinstance(prompt, str):
+        if tokenizer is None:
+            _bad("string prompts need a server-side tokenizer")
+        native["text"] = prompt
+    elif isinstance(prompt, list) and all(
+        isinstance(t, int) for t in prompt
+    ):
+        native["tokens"] = prompt
+    else:
+        _bad(
+            "prompt must be a string or a flat token-id list "
+            "(batched prompts are not supported)"
+        )
+    lp = payload.get("logprobs")
+    if lp is not None and lp is not False:
+        # OpenAI's int-valued logprobs asks for top-k alternatives; the
+        # engine records the CHOSEN token's logprob. 0/1/true map onto
+        # that; deeper k is refused.
+        if lp in (True, 0, 1):
+            native["logprobs"] = True
+        else:
+            _bad(
+                f"logprobs={lp!r}: only the chosen token's logprob is "
+                "recorded (use logprobs <= 1)"
+            )
+    _common_sampling(payload, native)
+    return native
+
+
+# Minimal readable chat rendering for tokenizers without a template
+# (the byte tokenizer): stable markers, trailing generation prompt.
+_FALLBACK_TEMPLATE_ROLES = ("system", "user", "assistant", "tool")
+
+
+def render_chat(messages: List[dict], tokenizer) -> str:
+    """Messages -> prompt text, via the tokenizer's chat template when
+    it has one (HF tokenizers), else a plain fallback format."""
+    if not messages:
+        _bad('"messages" must be non-empty')
+    def content_text(m):
+        c = m["content"]
+        if isinstance(c, str):
+            return c
+        if isinstance(c, list):
+            # OpenAI content-parts form: text parts concatenate;
+            # anything else (images, audio) is refused, not repr()'d
+            # into the prompt.
+            texts = []
+            for part in c:
+                if not isinstance(part, dict) or part.get("type") != "text":
+                    _bad(
+                        "only text content parts are supported; got "
+                        f"{part.get('type') if isinstance(part, dict) else part!r}"
+                    )
+                texts.append(part["text"])
+            return "".join(texts)
+        _bad(f"message content must be a string or parts list, got {c!r}")
+
+    for m in messages:
+        if not isinstance(m, dict) or "role" not in m or "content" not in m:
+            _bad('each message needs "role" and "content"')
+        if m["role"] not in _FALLBACK_TEMPLATE_ROLES:
+            _bad(f"unknown role {m['role']!r}")
+    messages = [
+        {**m, "content": content_text(m)} for m in messages
+    ]
+    hf_tok = getattr(tokenizer, "_tok", None)
+    if hf_tok is not None and getattr(hf_tok, "chat_template", None):
+        return hf_tok.apply_chat_template(
+            messages, tokenize=False, add_generation_prompt=True
+        )
+    parts = [f"<|{m['role']}|>\n{m['content']}\n" for m in messages]
+    return "".join(parts) + "<|assistant|>\n"
+
+
+def chat_to_native(payload: dict, tokenizer) -> dict:
+    """/v1/chat/completions -> native /generate payload."""
+    _check_unsupported(payload)
+    if tokenizer is None:
+        _bad("chat completions need a server-side tokenizer")
+    native: Dict[str, Any] = {
+        "text": render_chat(payload.get("messages"), tokenizer)
+    }
+    if payload.get("logprobs"):
+        native["logprobs"] = True
+    if payload.get("top_logprobs") not in (None, 0):
+        _bad(
+            f"top_logprobs={payload['top_logprobs']!r}: only the chosen "
+            "token's logprob is recorded"
+        )
+    if payload.get("best_of") is not None:
+        _bad("best_of is a completions-API parameter")
+    _common_sampling(payload, native)
+    return native
+
+
+def _finish_reason(tokens: list, max_new: int) -> str:
+    return "length" if len(tokens) >= max_new else "stop"
+
+
+def _usage(prompt_tokens: int, completions: List[list]) -> dict:
+    out = sum(len(c) for c in completions)
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": out,
+        "total_tokens": prompt_tokens + out,
+    }
+
+
+def _lp_block(tokens, lps, tokenizer):
+    return {
+        "tokens": [tokenizer.decode([t]) if tokenizer else str(t)
+                   for t in tokens],
+        "token_logprobs": list(lps),
+        "top_logprobs": None,
+        "text_offset": None,
+    }
+
+
+def completion_response(
+    native_result: dict, *, model: str, prompt_tokens: int, max_new: int,
+    tokenizer, chat: bool,
+) -> dict:
+    """Native handle() result -> OpenAI response object."""
+    raw_choices = native_result.get("choices") or [native_result]
+    choices = []
+    for i, c in enumerate(raw_choices):
+        toks = c["tokens"]
+        text = c.get("text")
+        if text is None:
+            text = tokenizer.decode(toks) if tokenizer else str(toks)
+        entry: Dict[str, Any] = {
+            "index": i,
+            "finish_reason": _finish_reason(toks, max_new),
+        }
+        if chat:
+            entry["message"] = {"role": "assistant", "content": text}
+        else:
+            entry["text"] = text
+        if c.get("logprobs") is not None:
+            lp = _lp_block(toks, c["logprobs"], tokenizer)
+            entry["logprobs"] = (
+                {"content": [
+                    {"token": t, "logprob": l}
+                    for t, l in zip(lp["tokens"], lp["token_logprobs"])
+                ]} if chat else lp
+            )
+        choices.append(entry)
+    return {
+        "id": ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24],
+        "object": "chat.completion" if chat else "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": choices,
+        "usage": _usage(prompt_tokens, [c["tokens"] for c in raw_choices]),
+    }
+
+
+class StreamTranslator:
+    """Accumulates native stream records into OpenAI SSE chunk objects.
+
+    Text deltas come from cumulative decode (decode(all) minus what was
+    already emitted) so multi-token characters never split mid-byte.
+    """
+
+    def __init__(self, *, model: str, tokenizer, chat: bool):
+        self.model = model
+        self.tokenizer = tokenizer
+        self.chat = chat
+        self.id = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        self.created = int(time.time())
+        self._tokens: List[int] = []
+        self._emitted = ""
+        self.first = True
+
+    def _chunk(self, delta_text: Optional[str], finish: Optional[str]):
+        if self.chat:
+            delta: Dict[str, Any] = {}
+            if self.first and delta_text is not None:
+                delta["role"] = "assistant"
+            if delta_text:
+                delta["content"] = delta_text
+            choice = {"index": 0, "delta": delta, "finish_reason": finish}
+        else:
+            choice = {
+                "index": 0, "text": delta_text or "", "finish_reason": finish,
+            }
+        self.first = False
+        return {
+            "id": self.id,
+            "object": ("chat.completion.chunk" if self.chat
+                       else "text_completion"),
+            "created": self.created,
+            "model": self.model,
+            "choices": [choice],
+        }
+
+    def feed(self, record: dict, max_new: int):
+        """Native stream record -> list of SSE chunk objects."""
+        if record.get("done"):
+            # The engine's final record carries the authoritative token
+            # list (stop-sequence holdback may have trimmed the tail).
+            self._tokens = list(record["tokens"])
+            out = []
+            if self.tokenizer is not None:
+                text = self.tokenizer.decode(self._tokens)
+                if len(text) > len(self._emitted):
+                    out.append(self._chunk(text[len(self._emitted):], None))
+                    self._emitted = text
+            # else: per-delta debug strings are not prefix-additive, so
+            # there is no reconcilable tail to emit.
+            finish = self._chunk(
+                None, _finish_reason(self._tokens, max_new)
+            )
+            if record.get("logprobs") is not None:
+                # Requested logprobs ride the finish chunk (the engine
+                # delivers them once, on the final record).
+                lp = _lp_block(self._tokens, record["logprobs"],
+                               self.tokenizer)
+                finish["choices"][0]["logprobs"] = (
+                    {"content": [
+                        {"token": t, "logprob": l}
+                        for t, l in zip(lp["tokens"],
+                                        lp["token_logprobs"])
+                    ]} if self.chat else lp
+                )
+            out.append(finish)
+            return out
+        self._tokens.extend(record["tokens"])
+        if self.tokenizer is None:
+            return [self._chunk(str(record["tokens"]), None)]
+        text = self.tokenizer.decode(self._tokens)
+        if len(text) <= len(self._emitted):
+            return []
+        delta, self._emitted = text[len(self._emitted):], text
+        return [self._chunk(delta, None)]

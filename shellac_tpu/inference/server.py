@@ -84,9 +84,11 @@ class InferenceServer:
         *,
         tokenizer=None,
         engine: Optional[BatchingEngine] = None,
+        model_name: str = "shellac_tpu",
         **engine_kw,
     ):
         self.engine = engine or BatchingEngine(cfg, params, **engine_kw)
+        self.model_name = model_name
         # Multi-host engines need a step per loop iteration even when
         # idle: follower processes wait inside the command broadcast,
         # and an un-stepped primary would leave them parked in a device
@@ -264,17 +266,26 @@ class InferenceServer:
         ("done", full output) — or ("done", (output, logprobs)) with
         return_logprobs=True. `timeout` bounds the wait per chunk."""
         p = self._submit(tokens, max_new, stop, samp, stream=True)
-        while True:
-            try:
-                chunk = p.chunks.get(timeout=timeout)
-            except queue.Empty:
-                raise TimeoutError("request timed out mid-stream")
-            if chunk is None:
-                break
-            yield ("delta", chunk)
-        if p.error is not None:
-            self._raise(p)
-        yield ("done", (p.result, p.lps) if return_logprobs else p.result)
+        finished = False
+        try:
+            while True:
+                try:
+                    chunk = p.chunks.get(timeout=timeout)
+                except queue.Empty:
+                    raise TimeoutError("request timed out mid-stream")
+                if chunk is None:
+                    break
+                yield ("delta", chunk)
+            if p.error is not None:
+                self._raise(p)
+            finished = True
+            yield ("done", (p.result, p.lps) if return_logprobs else p.result)
+        finally:
+            if not finished:
+                # Consumer abandoned the stream (client disconnect tears
+                # the generator down via GeneratorExit) or it errored:
+                # free the slot instead of generating unread tokens.
+                self._cancel(p)
 
     def _parse(self, payload: dict):
         if "tokens" in payload:
@@ -457,6 +468,47 @@ class InferenceServer:
                     final["text"] = self.tokenizer.decode(out)
                 yield final
 
+    # ---- OpenAI-compatible façade -----------------------------------
+
+    def handle_openai(self, payload: dict, chat: bool) -> dict:
+        from shellac_tpu.inference.openai_api import (
+            chat_to_native,
+            completion_response,
+            completion_to_native,
+        )
+
+        native = (chat_to_native(payload, self.tokenizer) if chat
+                  else completion_to_native(payload, self.tokenizer))
+        tokens = self._parse(native)[0]
+        # Hand handle() the ids so the prompt is not tokenized twice.
+        native.pop("text", None)
+        native["tokens"] = [int(t) for t in tokens]
+        prompt_tokens = len(tokens)
+        max_new = int(native.get("max_new", 32))
+        result = self.handle(native)
+        return completion_response(
+            result, model=self.model_name, prompt_tokens=prompt_tokens,
+            max_new=max_new, tokenizer=self.tokenizer, chat=chat,
+        )
+
+    def handle_openai_stream(self, payload: dict, chat: bool):
+        """Yield OpenAI SSE chunk objects (the HTTP layer frames them
+        as `data:` lines and appends [DONE])."""
+        from shellac_tpu.inference.openai_api import (
+            StreamTranslator,
+            chat_to_native,
+            completion_to_native,
+        )
+
+        native = (chat_to_native(payload, self.tokenizer) if chat
+                  else completion_to_native(payload, self.tokenizer))
+        max_new = int(native.get("max_new", 32))
+        translator = StreamTranslator(
+            model=self.model_name, tokenizer=self.tokenizer, chat=chat,
+        )
+        for record in self.handle_stream(native):
+            yield from translator.feed(record, max_new)
+
     def close(self):
         self._stop.set()
         self._thread.join(timeout=2)
@@ -491,7 +543,15 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path == "/health":
+            if self.path == "/v1/models":
+                self._send(200, {
+                    "object": "list",
+                    "data": [{
+                        "id": server.model_name, "object": "model",
+                        "owned_by": "shellac_tpu",
+                    }],
+                })
+            elif self.path == "/health":
                 self._send(200, {"ok": True,
                                  "pending": server.engine.pending})
             elif self.path == "/stats":
@@ -538,19 +598,74 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 except OSError:
                     pass
 
+        def _stream_sse(self, payload: dict, chat: bool):
+            # OpenAI Server-Sent Events framing: one `data: <json>` line
+            # per chunk, blank-line separated, closed by `data: [DONE]`.
+            chunks = server.handle_openai_stream(payload, chat)
+            try:
+                first = next(chunks, None)  # errors surface before 200
+            except (ValueError, TimeoutError) as e:
+                self._send(400, {"error": {"message": str(e),
+                                           "type": "invalid_request_error"}})
+                return
+            except RuntimeError as e:
+                # Scheduler death is a server fault, not a bad request.
+                self._send(500, {"error": {"message": str(e),
+                                           "type": "server_error"}})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            rest = (
+                itertools.chain([first], chunks) if first is not None
+                else chunks
+            )
+            try:
+                for obj in rest:
+                    self.wfile.write(
+                        f"data: {json.dumps(obj)}\n\n".encode()
+                    )
+                    self.wfile.flush()
+                self.wfile.write(b"data: [DONE]\n\n")
+            except OSError:
+                pass  # client hung up: the engine-side cancel fires
+            except (ValueError, TimeoutError, RuntimeError) as e:
+                try:
+                    self.wfile.write(
+                        f"data: {json.dumps({'error': str(e)})}\n\n".encode()
+                    )
+                except OSError:
+                    pass
+
         def do_POST(self):
-            if self.path != "/generate":
+            openai_routes = {
+                "/v1/completions": False,
+                "/v1/chat/completions": True,
+            }
+            if self.path not in ("/generate", *openai_routes):
                 self._send(404, {"error": "not found"})
                 return
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(n) or b"{}")
-                if payload.get("stream"):
+                if self.path in openai_routes:
+                    chat = openai_routes[self.path]
+                    if payload.get("stream"):
+                        self._stream_sse(payload, chat)
+                    else:
+                        self._send(200, server.handle_openai(payload, chat))
+                elif payload.get("stream"):
                     self._stream(payload)
                 else:
                     self._send(200, server.handle(payload))
             except (ValueError, TimeoutError) as e:
-                self._send(400, {"error": str(e)})
+                err = {"error": str(e)}
+                if self.path in openai_routes:
+                    # OpenAI clients expect the nested error shape.
+                    err = {"error": {"message": str(e),
+                                     "type": "invalid_request_error"}}
+                self._send(400, err)
             except RuntimeError as e:
                 self._send(500, {"error": str(e)})
 
