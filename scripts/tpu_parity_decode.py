@@ -294,6 +294,62 @@ def mla_shape_cases(checks):
               atol=3e-2, checks=checks)
 
 
+
+
+def sink_cases(checks):
+    """GPT-OSS attention sinks, compiled: the (H,128)/(rows,128) sink
+    operand tiles must satisfy Mosaic's layout rules, and the finalize
+    rebase must hold on the real softmax/exp units."""
+    from shellac_tpu.ops.attention import attention_ref
+    from shellac_tpu.ops.decode_attention import _decode_ref, decode_attention
+    from shellac_tpu.ops.flash_attention import flash_attention
+
+    B, L, H, HKV, D = 4, 1024, 16, 8, 128
+    ks = jax.random.split(jax.random.PRNGKey(99), 4)
+    sinks = jax.random.normal(ks[3], (H,), jnp.float32) * 2.0
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.bfloat16)
+    ck = jax.random.normal(ks[1], (B, HKV, L, D), jnp.bfloat16)
+    cv = jax.random.normal(ks[2], (B, HKV, L, D), jnp.bfloat16)
+    index = jnp.array([0, 37, 519, L - 1], jnp.int32)
+    for window in (None, 200):
+        out = decode_attention(
+            q, ck, cv, index, window=window, sinks=sinks, impl="flash",
+            interpret=False,
+        )
+        ref = _decode_ref(q, ck, cv, index, window, D ** -0.5, sinks=sinks)
+        check(
+            f"dense sinks window={window}",
+            out.astype(jnp.float32), ref.astype(jnp.float32),
+            atol=2e-2, checks=checks,
+        )
+
+    S = 512
+    qf = jax.random.normal(ks[0], (2, S, H, D), jnp.bfloat16)
+    kf = jax.random.normal(ks[1], (2, S, HKV, D), jnp.bfloat16)
+    vf = jax.random.normal(ks[2], (2, S, HKV, D), jnp.bfloat16)
+    out = flash_attention(qf, kf, vf, causal=True, sinks=sinks,
+                          interpret=False)
+    ref = attention_ref(qf, kf, vf, causal=True, sinks=sinks)
+    check("flash fwd sinks", out.astype(jnp.float32),
+          ref.astype(jnp.float32), atol=2e-2, checks=checks)
+
+    def loss_flash(q, k, v, s):
+        return (flash_attention(
+            q, k, v, causal=True, sinks=s, interpret=False
+        ).astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q, k, v, s):
+        return (attention_ref(
+            q, k, v, causal=True, sinks=s
+        ).astype(jnp.float32) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(qf, kf, vf, sinks)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(qf, kf, vf, sinks)
+    for name, a, b in zip(("dq", "dk", "dv", "dsink"), gf, gr):
+        check(f"flash bwd sinks {name}", a.astype(jnp.float32),
+              b.astype(jnp.float32), atol=1.5e-1, checks=checks)
+
+
 def main():
     backend = jax.default_backend()
     if backend != "tpu":
@@ -306,6 +362,7 @@ def main():
     flash_train_cases(checks)
     head_dim_64_cases(checks)
     mla_shape_cases(checks)
+    sink_cases(checks)
     print(json.dumps({"ok": True, "backend": backend, "checks": checks}))
 
 

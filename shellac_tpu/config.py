@@ -64,10 +64,21 @@ class MoEConfig:
     # top-k selects within them. n_group=1 disables.
     n_group: int = 1
     topk_group: int = 1
-    # "softmax" (V2) or "sigmoid" (V3): sigmoid scores with an additive
-    # per-expert selection bias (e_score_correction_bias; the bias
-    # influences WHICH experts are picked, never the combine weights).
+    # "softmax" (V2), "sigmoid" (V3: sigmoid scores with an additive
+    # per-expert selection bias — e_score_correction_bias — that
+    # influences WHICH experts are picked, never the combine weights),
+    # or "softmax_topk" (GPT-OSS: top-k over raw biased logits, softmax
+    # over just the kept values).
     scoring: str = "softmax"
+    # Biases on the expert projections (GPT-OSS): b_gate/b_up (E, F)
+    # and b_down (E, D) ride alongside the weights.
+    expert_bias: bool = False
+    # GPT-OSS activation clamp: gate clamps to (-inf, limit], up to
+    # [-limit, limit] before the gated product.
+    gate_limit: Optional[float] = None
+    # Expert FFN activation: "silu" (standard swiglu) or "gptoss"
+    # ((up + 1) * gate * sigmoid(1.702 * gate), after the clamp).
+    expert_act: str = "silu"
 
 
 @dataclass(frozen=True)
@@ -183,6 +194,13 @@ class ModelConfig:
     # branch's OUTPUT (post-attention and post-MLP), alongside the usual
     # pre-norms.
     post_norms: bool = False
+    # Learned per-head attention-sink logits (GPT-OSS): each row's
+    # softmax denominator gains exp(sink_h) so attention mass can drain
+    # off the real tokens. Adds a per-layer "sinks" (H,) parameter.
+    attn_sink: bool = False
+    # Bias on the attention OUTPUT projection (GPT-OSS puts biases on
+    # o_proj too; attn_bias alone covers q/k/v).
+    attn_out_bias: bool = False
     # False = bidirectional (encoder) attention. Decoder-only features
     # (KV-cache generation) require causal=True.
     causal: bool = True
@@ -326,10 +344,22 @@ class ModelConfig:
                     f"(0, n_layers={self.n_layers})"
                 )
         if self.moe is not None and self.moe.scoring not in (
-            "softmax", "sigmoid",
+            "softmax", "sigmoid", "softmax_topk",
         ):
             raise ValueError(
-                f"moe.scoring={self.moe.scoring!r}; have softmax, sigmoid"
+                f"moe.scoring={self.moe.scoring!r}; have softmax, "
+                "sigmoid, softmax_topk"
+            )
+        if self.moe is not None and self.moe.expert_act not in (
+            "silu", "gptoss",
+        ):
+            raise ValueError(
+                f"moe.expert_act={self.moe.expert_act!r}; have silu, gptoss"
+            )
+        if (self.moe is not None and self.moe.scoring == "softmax_topk"
+                and self.moe.n_group > 1):
+            raise ValueError(
+                "softmax_topk scoring has no group-limited variant"
             )
         if (self.moe is not None and self.moe.scoring == "sigmoid"
                 and self.moe.n_group > 1
@@ -382,6 +412,11 @@ class ModelConfig:
                 raise ValueError(
                     "attn_softcap/attn_scale are not defined for MLA "
                     "models (the absorbed decode fixes the score scale)"
+                )
+            if self.attn_sink or self.attn_out_bias:
+                raise ValueError(
+                    "attn_sink/attn_out_bias are not defined for MLA "
+                    "models"
                 )
             if self.attn_bias:
                 raise ValueError("MLA attn_bias is not supported yet")

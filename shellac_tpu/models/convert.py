@@ -47,6 +47,8 @@ def config_from_hf(hf_cfg) -> ModelConfig:
         return _gemma2_config(hf_cfg)
     if getattr(hf_cfg, "model_type", "") in ("gemma3_text", "gemma3"):
         return _gemma3_config(hf_cfg)
+    if getattr(hf_cfg, "model_type", "") == "gpt_oss":
+        return _gptoss_config(hf_cfg)
     moe = None
     if getattr(hf_cfg, "num_local_experts", None):
         moe = MoEConfig(
@@ -254,6 +256,65 @@ def _gemma3_config(hf_cfg) -> ModelConfig:
         post_norms=True,
         activation="geglu",
         embed_scale=True,
+    ).validate()
+
+
+def _gptoss_config(hf_cfg) -> ModelConfig:
+    """GPT-OSS config mapping: alternating sliding/full attention with
+    learned per-head SINK logits, q/k/v/o biases, yarn rope (truncate
+    False), and an all-MoE stack with the softmax-after-top-k gate,
+    biased experts, the clamped (up+1)*glu activation, and narrow
+    per-expert FFNs."""
+    from shellac_tpu.config import MoEConfig
+
+    n_layers = hf_cfg.num_hidden_layers
+    layer_types = getattr(hf_cfg, "layer_types", None) or [
+        "sliding_attention" if i % 2 == 0 else "full_attention"
+        for i in range(n_layers)
+    ]
+    pattern = _pattern_from_layer_types(layer_types)
+    windowed = "window" in pattern
+    if set(pattern) == {"window"}:
+        pattern = None
+    elif set(pattern) == {"full"}:
+        pattern, windowed = None, False
+    moe = MoEConfig(
+        num_experts=hf_cfg.num_local_experts,
+        num_experts_per_token=hf_cfg.num_experts_per_tok,
+        d_ff_expert=hf_cfg.intermediate_size,
+        scoring="softmax_topk",
+        expert_bias=True,
+        # HF hardcodes these in GptOssExperts (no config fields).
+        gate_limit=7.0,
+        expert_act="gptoss",
+        router_aux_loss_weight=getattr(hf_cfg, "router_aux_loss_coef",
+                                       0.9),
+        dropless=True,
+    )
+    return ModelConfig(
+        vocab_size=hf_cfg.vocab_size,
+        d_model=hf_cfg.hidden_size,
+        n_layers=n_layers,
+        n_heads=hf_cfg.num_attention_heads,
+        n_kv_heads=getattr(hf_cfg, "num_key_value_heads", None)
+        or hf_cfg.num_attention_heads,
+        head_dim=getattr(hf_cfg, "head_dim", None)
+        or hf_cfg.hidden_size // hf_cfg.num_attention_heads,
+        d_ff=hf_cfg.intermediate_size,
+        max_seq_len=hf_cfg.max_position_embeddings,
+        rope_theta=getattr(hf_cfg, "rope_theta", 150000.0),
+        norm_eps=hf_cfg.rms_norm_eps,
+        tie_embeddings=bool(getattr(hf_cfg, "tie_word_embeddings", False)),
+        attn_window=int(hf_cfg.sliding_window) if windowed else None,
+        attn_pattern=pattern,
+        attn_bias=bool(getattr(hf_cfg, "attention_bias", True)),
+        attn_out_bias=bool(getattr(hf_cfg, "attention_bias", True)),
+        attn_sink=True,
+        moe=moe,
+        **_rope_from_hf(
+            getattr(hf_cfg, "rope_scaling", None),
+            hf_cfg.max_position_embeddings,
+        ),
     ).validate()
 
 
@@ -573,12 +634,14 @@ def params_from_state_dict(
     moe = cfg.moe is not None
     if moe and moe_naming == "auto":
         # Probe the keys: Mixtral ships block_sparse_moe.*, Qwen3-MoE
-        # keeps the dense projection names under mlp.experts.*.
-        moe_naming = (
-            "qwen3_moe"
-            if f"{prefix}layers.0.mlp.experts.0.gate_proj.weight" in sd
-            else "mixtral"
-        )
+        # keeps the dense projection names under mlp.experts.*, GPT-OSS
+        # fuses all experts into single stacked tensors.
+        if f"{prefix}layers.0.mlp.experts.gate_up_proj" in sd:
+            moe_naming = "gpt_oss"
+        elif f"{prefix}layers.0.mlp.experts.0.gate_proj.weight" in sd:
+            moe_naming = "qwen3_moe"
+        else:
+            moe_naming = "mixtral"
     if moe and cfg.moe_every > 1:
         raise NotImplementedError(
             "interleaved dense/MoE stacks (moe_every > 1) have no HF "
@@ -586,7 +649,15 @@ def params_from_state_dict(
         )
     mlp_keys = (["w_router"] + list(_EXPERT_MAP) if moe
                 else list(_DENSE_MLP_MAP))
+    if moe and cfg.moe.scoring in ("sigmoid", "softmax_topk"):
+        mlp_keys += ["b_router"]
+    if moe and cfg.moe.expert_bias:
+        mlp_keys += ["b_gate", "b_up", "b_down"]
     bias_keys = list(_BIAS_MAP) if cfg.attn_bias else []
+    if cfg.attn_out_bias:
+        bias_keys += ["bo"]
+    if cfg.attn_sink:
+        bias_keys += ["sinks"]
     if cfg.mla is not None:
         attn_keys = ["wkv_a", "kv_a_norm", "wkv_b_k", "wkv_b_v", "wo"]
         attn_keys += (["wq"] if cfg.mla.q_lora_rank is None
@@ -630,8 +701,29 @@ def params_from_state_dict(
                 )
         for ours, theirs in (_BIAS_MAP.items() if cfg.attn_bias else ()):
             layers[ours].append(get(base + theirs))
+        if cfg.attn_out_bias:
+            layers["bo"].append(get(base + "self_attn.o_proj.bias"))
+        if cfg.attn_sink:
+            layers["sinks"].append(get(base + "self_attn.sinks"))
         if moe:
-            if moe_naming == "qwen3_moe":
+            if moe_naming == "gpt_oss":
+                layers["w_router"].append(
+                    get(base + "mlp.router.weight").T
+                )
+                layers["b_router"].append(get(base + "mlp.router.bias"))
+                # Fused stacked experts: gate_up (E, D, 2F) INTERLEAVES
+                # gate and up on the last dim; down is (E, F, D).
+                gu = get(base + "mlp.experts.gate_up_proj")
+                gub = get(base + "mlp.experts.gate_up_proj_bias")
+                layers["w_gate"].append(gu[..., 0::2])
+                layers["w_up"].append(gu[..., 1::2])
+                layers["b_gate"].append(gub[..., 0::2])
+                layers["b_up"].append(gub[..., 1::2])
+                layers["w_down"].append(get(base + "mlp.experts.down_proj"))
+                layers["b_down"].append(
+                    get(base + "mlp.experts.down_proj_bias")
+                )
+            elif moe_naming == "qwen3_moe":
                 layers["w_router"].append(get(base + "mlp.gate.weight").T)
                 for ours, proj in _QWEN3_EXPERT_MAP.items():
                     layers[ours].append(np.stack([
@@ -853,7 +945,29 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
         if cfg.attn_bias:
             for ours, theirs in _BIAS_MAP.items():
                 sd[base + theirs] = np_(layers[ours][i])
-        if moe and cfg.qk_norm:
+        if cfg.attn_out_bias:
+            sd[base + "self_attn.o_proj.bias"] = np_(layers["bo"][i])
+        if cfg.attn_sink:
+            sd[base + "self_attn.sinks"] = np_(layers["sinks"][i])
+        if moe and cfg.moe.scoring == "softmax_topk":
+            # GPT-OSS fused-expert export: re-interleave gate/up.
+            sd[base + "mlp.router.weight"] = np_(layers["w_router"][i]).T
+            sd[base + "mlp.router.bias"] = np_(layers["b_router"][i])
+            wg = np_(layers["w_gate"][i])  # (E, D, F)
+            wu = np_(layers["w_up"][i])
+            gu = np.empty((*wg.shape[:-1], 2 * wg.shape[-1]), np.float32)
+            gu[..., 0::2], gu[..., 1::2] = wg, wu
+            sd[base + "mlp.experts.gate_up_proj"] = gu
+            bg = np_(layers["b_gate"][i])
+            bu = np_(layers["b_up"][i])
+            gub = np.empty((*bg.shape[:-1], 2 * bg.shape[-1]), np.float32)
+            gub[..., 0::2], gub[..., 1::2] = bg, bu
+            sd[base + "mlp.experts.gate_up_proj_bias"] = gub
+            sd[base + "mlp.experts.down_proj"] = np_(layers["w_down"][i])
+            sd[base + "mlp.experts.down_proj_bias"] = np_(
+                layers["b_down"][i]
+            )
+        elif moe and cfg.qk_norm:
             # qk_norm + MoE is the Qwen3-MoE shape: export its naming.
             sd[base + "mlp.gate.weight"] = np_(layers["w_router"][i]).T
             for ours, proj in _QWEN3_EXPERT_MAP.items():

@@ -28,6 +28,23 @@ PRESETS = {
     "tiny-encoder": ModelConfig(vocab_size=256, d_model=64, n_layers=2,
                                 n_heads=4, max_seq_len=128, remat=False,
                                 causal=False),
+    # The full GPT-OSS shape in miniature: attention sinks, q/k/v/o
+    # biases, alternating sliding/full layers, softmax-after-top-k MoE
+    # with biased experts and the clamped (up+1)*glu activation.
+    "tiny-gptoss": ModelConfig(vocab_size=256, d_model=64, n_layers=4,
+                               n_heads=4, n_kv_heads=2, max_seq_len=128,
+                               remat=False, attn_window=16,
+                               attn_pattern=("window", "full"),
+                               attn_sink=True, attn_bias=True,
+                               attn_out_bias=True, tie_embeddings=False,
+                               moe=MoEConfig(num_experts=4,
+                                             num_experts_per_token=2,
+                                             d_ff_expert=96,
+                                             scoring="softmax_topk",
+                                             expert_bias=True,
+                                             gate_limit=7.0,
+                                             expert_act="gptoss",
+                                             dropless=True)),
     # The full Gemma-3 (text) shape in miniature: 5:1 local/global
     # pattern, dual rope (unscaled local theta / linear-scaled global),
     # qk-norm, sandwich norms, no softcaps.

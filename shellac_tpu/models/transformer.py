@@ -184,6 +184,10 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 "post_attn_norm": jnp.zeros((d,), pdt),
                 "post_mlp_norm": jnp.zeros((d,), pdt),
             })
+        if cfg.attn_sink:
+            p["sinks"] = jnp.zeros((h,), pdt)
+        if cfg.attn_out_bias:
+            p["bo"] = jnp.zeros((d,), pdt)
         if not moe_layer:
             p.update({
                 "w_gate": dense(ks[4], (d, f), d),
@@ -199,8 +203,14 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
                 "w_up": dense(ks[5], (e, d, fe), d),
                 "w_down": dense(ks[6], (e, fe, d), fe, out_scale),
             })
-            if cfg.moe.scoring == "sigmoid":
+            if cfg.moe.scoring in ("sigmoid", "softmax_topk"):
                 p["b_router"] = jnp.zeros((e,), pdt)
+            if cfg.moe.expert_bias:
+                p.update({
+                    "b_gate": jnp.zeros((e, fe), pdt),
+                    "b_up": jnp.zeros((e, fe), pdt),
+                    "b_down": jnp.zeros((e, d), pdt),
+                })
             if cfg.moe.num_shared_experts > 0:
                 sf = cfg.moe.num_shared_experts * fe
                 ks2 = jax.random.split(ks[7], 4)
@@ -259,8 +269,14 @@ def _layer_axes(cfg: ModelConfig, moe_layer: bool, lead=("layers",)) -> dict:
             "w_up": (*lead, "experts", "embed", "mlp"),
             "w_down": (*lead, "experts", "mlp", "embed"),
         }
-        if cfg.moe.scoring == "sigmoid":
+        if cfg.moe.scoring in ("sigmoid", "softmax_topk"):
             mlp_axes["b_router"] = (*lead, None)
+        if cfg.moe.expert_bias:
+            mlp_axes.update({
+                "b_gate": (*lead, "experts", "mlp"),
+                "b_up": (*lead, "experts", "mlp"),
+                "b_down": (*lead, "experts", "embed"),
+            })
         if cfg.moe.num_shared_experts > 0:
             mlp_axes.update({
                 "w_gate_shared": (*lead, "embed", "mlp"),
@@ -311,6 +327,10 @@ def _layer_axes(cfg: ModelConfig, moe_layer: bool, lead=("layers",)) -> dict:
             "post_attn_norm": (*lead, None),
             "post_mlp_norm": (*lead, None),
         }
+    if cfg.attn_sink:
+        post_axes["sinks"] = (*lead, "heads")
+    if cfg.attn_out_bias:
+        post_axes["bo"] = (*lead, None)
     return {
         "attn_norm": (*lead, None),
         **attn_axes,
@@ -464,10 +484,11 @@ def _block(
         k = rms_norm(k, lp["k_norm"], cfg.norm_eps).astype(cdt)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    sinks = lp["sinks"] if cfg.attn_sink else None
     new_cache = None
     if cache is None:
         o = _training_attention(cfg, mesh, attn_impl, q, k, v, segments,
-                                window=window)
+                                window=window, sinks=sinks)
     elif page_tables is not None:
         from shellac_tpu.inference.kvcache import (
             paged_gather_layer,
@@ -483,6 +504,7 @@ def _block(
             o = attention(
                 q, k, v, causal=True, window=window, impl=attn_impl,
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+                sinks=sinks,
             )
         else:
             from shellac_tpu.ops.decode_attention import (
@@ -493,6 +515,7 @@ def _block(
                 q, pool_k, pool_v, page_tables, index,
                 window=window, impl=attn_impl,
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+                sinks=sinks,
             )
     elif kv_scales is not None:
         from shellac_tpu.inference.kvcache import quant_update_layer
@@ -510,13 +533,14 @@ def _block(
             o = attention(
                 q, k, v, causal=True, window=window, impl=attn_impl,
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+                sinks=sinks,
             )
         else:
             o = decode_attention(
                 q, cache_k, cache_v, index,
                 window=window, impl=attn_impl,
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
-                k_scale=ks_l, v_scale=vs_l,
+                sinks=sinks, k_scale=ks_l, v_scale=vs_l,
             )
     else:
         from shellac_tpu.inference.kvcache import update_layer
@@ -531,6 +555,7 @@ def _block(
             o = attention(
                 q, k, v, causal=True, window=window, impl=attn_impl,
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+                sinks=sinks,
             )
         else:
             from shellac_tpu.ops.decode_attention import decode_attention
@@ -539,8 +564,11 @@ def _block(
                 q, cache_k, cache_v, index,
                 window=window, impl=attn_impl,
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+                sinks=sinks,
             )
     o = pdot(o.reshape(b, s, h * dh), lp["wo"])
+    if cfg.attn_out_bias:
+        o = o + lp["bo"].astype(cdt)
     if cfg.post_norms:
         # Gemma-2 sandwich norm: the branch OUTPUT is normed before the
         # residual add (HF post_attention_layernorm placement).
@@ -572,11 +600,15 @@ def _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache, moe_layer,
         down, aux, metrics = moe_ffn(
             hx, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             cfg.moe, drop_tokens=not (is_decode or cfg.moe.dropless),
-            # Strict lookup under sigmoid scoring: a missing bias must
-            # be a loud KeyError, not a silent zero (it changes which
-            # experts are selected).
-            b_router=(lp["b_router"] if cfg.moe.scoring == "sigmoid"
+            # Strict lookups for biased gates: a missing bias must be a
+            # loud KeyError, not a silent zero (it changes which experts
+            # are selected / what they compute).
+            b_router=(lp["b_router"]
+                      if cfg.moe.scoring in ("sigmoid", "softmax_topk")
                       else None),
+            b_gate=lp["b_gate"] if cfg.moe.expert_bias else None,
+            b_up=lp["b_up"] if cfg.moe.expert_bias else None,
+            b_down=lp["b_down"] if cfg.moe.expert_bias else None,
         )
         if cfg.moe.num_shared_experts > 0:
             sg = hx @ materialize(lp["w_gate_shared"], cdt)
@@ -603,7 +635,7 @@ def _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache, moe_layer,
 
 
 def _training_attention(cfg, mesh, attn_impl, q, k, v, segments,
-                        window="cfg"):
+                        window="cfg", sinks=None):
     """Full-sequence attention with sequence-parallel dispatch.
 
     q (B, S, H, D); k/v (B, S, Hkv, D'). Shared by the standard GQA
@@ -658,6 +690,7 @@ def _training_attention(cfg, mesh, attn_impl, q, k, v, segments,
         return ring_attention(
             q, k, v, mesh, causal=cfg.causal, segments=segments,
             window=window, scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+            sinks=sinks,
         )
     if use_ulysses:
         from shellac_tpu.parallel.ulysses import ulysses_attention
@@ -665,11 +698,11 @@ def _training_attention(cfg, mesh, attn_impl, q, k, v, segments,
         return ulysses_attention(
             q, k, v, mesh, causal=cfg.causal, window=window,
             scale=cfg.attn_scale, softcap=cfg.attn_softcap,
-            segments=segments,
+            sinks=sinks, segments=segments,
         )
     return attention(
         q, k, v, causal=cfg.causal, window=window,
-        scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+        scale=cfg.attn_scale, softcap=cfg.attn_softcap, sinks=sinks,
         q_segments=segments, kv_segments=segments, impl=attn_impl,
     )
 

@@ -61,6 +61,7 @@ def attention_ref(
     window: Optional[int] = None,
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
+    sinks: Optional[jax.Array] = None,
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
     kv_mask: Optional[jax.Array] = None,
@@ -72,6 +73,11 @@ def attention_ref(
     softcap: Gemma-2-style logit soft-capping — scaled scores pass
     through cap*tanh(s/cap) BEFORE masking (masked slots stay NEG_INF,
     matching the HF eager path which caps, then adds the mask).
+
+    sinks: (H,) per-head learned sink logits (GPT-OSS): each row's
+    softmax denominator gains exp(sink_h) — a virtual column attending
+    a zero value — so real attention mass can drain somewhere. Exactly
+    HF's concat-softmax-drop formulation.
     """
     b, sq, h, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -100,7 +106,16 @@ def attention_ref(
     )
     if mask is not None:
         logits = jnp.where(mask[:, :, None, :, :], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
+    if sinks is not None:
+        sink_col = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(1, hkv, g, 1, 1),
+            (b, hkv, g, sq, 1),
+        )
+        probs = jax.nn.softmax(
+            jnp.concatenate([logits, sink_col], axis=-1), axis=-1
+        )[..., :-1]
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
         "bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
@@ -117,6 +132,7 @@ def attention(
     window: Optional[int] = None,
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
+    sinks: Optional[jax.Array] = None,
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
     kv_mask: Optional[jax.Array] = None,
@@ -130,7 +146,7 @@ def attention(
     if impl == "ref":
         return attention_ref(
             q, k, v, causal=causal, window=window, scale=scale,
-            softcap=softcap,
+            softcap=softcap, sinks=sinks,
             q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
             q_segments=q_segments, kv_segments=kv_segments,
         )
@@ -152,7 +168,7 @@ def attention(
             )
         return flash_attention(
             q, k, v, causal=causal, scale=scale, window=window,
-            softcap=softcap, segments=q_segments,
+            softcap=softcap, sinks=sinks, segments=q_segments,
         )
     if impl == "auto" and flash_supported(
         q, k, v, window=window, q_positions=q_positions,
@@ -161,11 +177,11 @@ def attention(
     ):
         return flash_attention(
             q, k, v, causal=causal, scale=scale, window=window,
-            softcap=softcap, segments=q_segments,
+            softcap=softcap, sinks=sinks, segments=q_segments,
         )
     return attention_ref(
         q, k, v, causal=causal, window=window, scale=scale,
-        softcap=softcap,
+        softcap=softcap, sinks=sinks,
         q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
         q_segments=q_segments, kv_segments=kv_segments,
     )

@@ -56,7 +56,7 @@ from jax.experimental import pallas as pl
 
 from shellac_tpu.ops.attention import attention_ref
 from shellac_tpu.ops.dispatch import pallas_supported
-from shellac_tpu.ops.flash_attention import _fit_block
+from shellac_tpu.ops.flash_attention import _fit_block, sink_rebase
 
 DEFAULT_BLOCK_K = 512
 NEG_INF = -2.0e38
@@ -78,7 +78,7 @@ class QuantFallbackWarning(UserWarning):
 def _decode_tile(
     idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     *, scale, s, hkv, block_k, window, k_start, ki, last_ki, first_ki,
-    ks_ref=None, vs_ref=None, softcap=None,
+    ks_ref=None, vs_ref=None, softcap=None, sink_ref=None,
 ):
     """One online-softmax step over every kv head of one sequence.
 
@@ -155,14 +155,21 @@ def _decode_tile(
     @pl.when(ki == last_ki)
     def _finalize():
         l = l_ref[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
+        m = m_ref[:, :1]
+        if sink_ref is not None:
+            # GPT-OSS sink: the denominator gains exp(sink_row) (a
+            # virtual zero-valued column).
+            r, l2, _ = sink_rebase(m, l, sink_ref[...][:, :1])
+            o_ref[...] = (acc_ref[...] * r / l2).astype(o_ref.dtype)
+        else:
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[...] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
 def _decode_tile_values(
     idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     *, scale, s, hkv, block_k, window, k_start, ki, last_ki, first_ki,
-    softcap=None,
+    softcap=None, sink_ref=None,
 ):
     """_decode_tile for head dims whose lane count is not 128-aligned.
 
@@ -238,8 +245,14 @@ def _decode_tile_values(
     @pl.when(ki == last_ki)
     def _finalize():
         l = jax.lax.slice(l_ref[...], (0, 0), (rows, 1))
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[...] = ((acc_ref[...] / l).astype(o_ref.dtype))[None]
+        if sink_ref is not None:
+            m = jax.lax.slice(m_ref[...], (0, 0), (rows, 1))
+            sink = jax.lax.slice(sink_ref[...], (0, 0), (rows, 1))
+            r, l2, _ = sink_rebase(m, l, sink)
+            o_ref[...] = ((acc_ref[...] * r / l2).astype(o_ref.dtype))[None]
+        else:
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[...] = ((acc_ref[...] / l).astype(o_ref.dtype))[None]
 
 
 def _decode_tile_any(
@@ -267,6 +280,22 @@ def _live_range(idx, s, block_k, window, num_kv):
     return first_ki, last_ki
 
 
+def _split_sink_rest(rest, has_sinks):
+    """Split a kernel's trailing refs into (sink_ref, remaining): the
+    optional sink operand sits between the inputs and the outputs."""
+    if has_sinks:
+        return rest[0], rest[1:]
+    return None, rest
+
+
+def _row_sinks(sinks, s):
+    """Per-ROW sink tile for the decode kernels: rows are kv-head-major
+    q heads x s (matching _flatten_q), tiled to a 128-lane block."""
+    return jnp.tile(
+        jnp.repeat(sinks.astype(jnp.float32), s)[:, None], (1, 128)
+    )
+
+
 def _flatten_q(q, hkv):
     """(B, s, H, D) -> (B, H*s, D), rows kv-head-major (GQA groups are
     contiguous because q head h belongs to kv head h // G)."""
@@ -284,9 +313,12 @@ def _unflatten_o(o, b, s, h, d):
 
 
 def _dense_kernel(
-    idx_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale, s, hkv, block_k, window, num_kv, softcap=None,
+    idx_ref, q_ref, k_ref, v_ref, *rest,
+    scale, s, hkv, block_k, window, num_kv, softcap=None, has_sinks=False,
 ):
+    sink_ref, (o_ref, acc_ref, m_ref, l_ref) = _split_sink_rest(
+        rest, has_sinks
+    )
     b = pl.program_id(0)
     ki = pl.program_id(1)
     idx = idx_ref[b]
@@ -295,17 +327,19 @@ def _dense_kernel(
         idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
-        softcap=softcap,
+        softcap=softcap, sink_ref=sink_ref,
     )
 
 
 def _dense_kernel_quant(
-    idx_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
-    acc_ref, m_ref, l_ref,
-    *, scale, s, hkv, block_k, window, num_kv, softcap=None,
+    idx_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, *rest,
+    scale, s, hkv, block_k, window, num_kv, softcap=None, has_sinks=False,
 ):
     """Dense kernel over an int8 cache with per-token dequant scales
     (d % 128 == 0 only; the dispatch gate guarantees it)."""
+    sink_ref, (o_ref, acc_ref, m_ref, l_ref) = _split_sink_rest(
+        rest, has_sinks
+    )
     b = pl.program_id(0)
     ki = pl.program_id(1)
     idx = idx_ref[b]
@@ -316,11 +350,13 @@ def _dense_kernel_quant(
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
         ks_ref=ks_ref.at[0], vs_ref=vs_ref.at[0], softcap=softcap,
+        sink_ref=sink_ref,
     )
 
 
 def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k,
-                 interpret, k_scale=None, v_scale=None, softcap=None):
+                 interpret, k_scale=None, v_scale=None, softcap=None,
+                 sinks=None):
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
@@ -358,6 +394,12 @@ def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k,
             pl.BlockSpec((1, hkv, block_k), scale_map),
         ]
         operands += [k_scale, v_scale]
+    has_sinks = sinks is not None
+    if has_sinks:
+        in_specs += [
+            pl.BlockSpec((rows, 128), lambda bi, ki, idx_ref: (0, 0)),
+        ]
+        operands += [_row_sinks(sinks, s)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, num_kv),
@@ -376,6 +418,7 @@ def _dense_flash(q, cache_k, cache_v, index, scale, window, block_k,
             _dense_kernel_quant if quant else _dense_kernel,
             scale=scale, s=s, hkv=hkv, block_k=block_k,
             window=window, num_kv=num_kv, softcap=softcap,
+            has_sinks=has_sinks,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
@@ -416,6 +459,7 @@ def decode_attention(
     window: Optional[int] = None,
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
+    sinks=None,
     impl: str = "auto",
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
@@ -472,15 +516,16 @@ def decode_attention(
             q, cache_k, cache_v, index, float(scale), window, bk, interpret,
             k_scale=k_scale, v_scale=v_scale,
             softcap=None if softcap is None else float(softcap),
+            sinks=sinks,
         )
     return _decode_ref(
         q, cache_k, cache_v, index, window, scale, softcap=softcap,
-        k_scale=k_scale, v_scale=v_scale,
+        sinks=sinks, k_scale=k_scale, v_scale=v_scale,
     )
 
 
 def _decode_ref(q, cache_k, cache_v, index, window, scale, softcap=None,
-                k_scale=None, v_scale=None):
+                sinks=None, k_scale=None, v_scale=None):
     if k_scale is not None:
         # Dequantize the int8 cache at read; XLA fuses the multiply
         # into the attention contraction's operand read.
@@ -504,6 +549,7 @@ def _decode_ref(q, cache_k, cache_v, index, window, scale, softcap=None,
     return attention_ref(
         q, cache_k.astype(cdt), cache_v.astype(cdt),
         causal=True, window=window, scale=scale, softcap=softcap,
+        sinks=sinks,
         q_positions=q_positions, kv_positions=kv_positions, kv_mask=kv_mask,
     )
 
@@ -514,9 +560,9 @@ def _decode_ref(q, cache_k, cache_v, index, window, scale, softcap=None,
 
 
 def _paged_group_kernel(
-    len_ref, tab_ref, q_ref, k_hbm, v_hbm, o_ref,
-    acc_ref, m_ref, l_ref, k_buf, v_buf, sems,
-    *, scale, s, hkv, bs, group, window, num_kv, softcap=None,
+    len_ref, tab_ref, q_ref, k_hbm, v_hbm, *rest,
+    scale, s, hkv, bs, group, window, num_kv, softcap=None,
+    has_sinks=False,
 ):
     """Grouped paged decode: `group` pages gathered per grid step.
 
@@ -534,6 +580,9 @@ def _paged_group_kernel(
     stray Inf/NaN bit pattern would poison the accumulator through the
     masked-out p=0 rows as 0*Inf).
     """
+    sink_ref, (o_ref, acc_ref, m_ref, l_ref, k_buf, v_buf, sems) = (
+        _split_sink_rest(rest, has_sinks)
+    )
     b = pl.program_id(0)
     gi = pl.program_id(1)
     idx = len_ref[b]
@@ -591,13 +640,13 @@ def _paged_group_kernel(
         acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=gi * block_k, ki=gi, last_ki=last_gi, first_ki=first_gi,
-        softcap=softcap,
+        softcap=softcap, sink_ref=sink_ref,
     )
 
 
 def _paged_group_flash(
     q, pool_k, pool_v, tables, index, scale, window, group, interpret,
-    softcap=None,
+    softcap=None, sinks=None,
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -610,14 +659,22 @@ def _paged_group_flash(
 
     qf = _flatten_q(q, hkv)
 
+    in_specs = [
+        pl.BlockSpec((1, rows, d), lambda bi, gi, lr, tr: (bi, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),  # k pool stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),  # v pool stays in HBM
+    ]
+    operands = [qf, pool_k, pool_v]
+    has_sinks = sinks is not None
+    if has_sinks:
+        in_specs += [
+            pl.BlockSpec((rows, 128), lambda bi, gi, lr, tr: (0, 0)),
+        ]
+        operands += [_row_sinks(sinks, s)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, num_groups),
-        in_specs=[
-            pl.BlockSpec((1, rows, d), lambda bi, gi, lr, tr: (bi, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),  # k pool stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),  # v pool stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, rows, d), lambda bi, gi, lr, tr: (bi, 0, 0)
         ),
@@ -634,11 +691,12 @@ def _paged_group_flash(
         functools.partial(
             _paged_group_kernel, scale=scale, s=s, hkv=hkv, bs=bs,
             group=group, window=window, num_kv=num_kv, softcap=softcap,
+            has_sinks=has_sinks,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
         interpret=interpret,
-    )(index.astype(jnp.int32), tables.astype(jnp.int32), qf, pool_k, pool_v)
+    )(index.astype(jnp.int32), tables.astype(jnp.int32), *operands)
     return _unflatten_o(out, b, s, h, d)
 
 
@@ -662,9 +720,12 @@ def _paged_group(tables, pool_k) -> int:
 
 
 def _paged_kernel(
-    len_ref, tab_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-    *, scale, s, hkv, block_k, window, num_kv, softcap=None,
+    len_ref, tab_ref, q_ref, k_ref, v_ref, *rest,
+    scale, s, hkv, block_k, window, num_kv, softcap=None, has_sinks=False,
 ):
+    sink_ref, (o_ref, acc_ref, m_ref, l_ref) = _split_sink_rest(
+        rest, has_sinks
+    )
     b = pl.program_id(0)
     ki = pl.program_id(1)
     idx = len_ref[b]
@@ -673,12 +734,12 @@ def _paged_kernel(
         idx, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=ki * block_k, ki=ki, last_ki=last_ki, first_ki=first_ki,
-        softcap=softcap,
+        softcap=softcap, sink_ref=sink_ref,
     )
 
 
 def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret,
-                 softcap=None):
+                 softcap=None, sinks=None):
     from jax.experimental.pallas import tpu as pltpu
 
     b, s, h, d = q.shape
@@ -697,14 +758,22 @@ def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret,
         # at scratch block 0 and are never live.
         return tab_ref[bi, ki], 0, 0, 0
 
+    in_specs = [
+        pl.BlockSpec((1, rows, d), lambda bi, ki, lr, tr: (bi, 0, 0)),
+        pl.BlockSpec((1, hkv, bs, d), kv_map),
+        pl.BlockSpec((1, hkv, bs, d), kv_map),
+    ]
+    operands = [qf, pool_k, pool_v]
+    has_sinks = sinks is not None
+    if has_sinks:
+        in_specs += [
+            pl.BlockSpec((rows, 128), lambda bi, ki, lr, tr: (0, 0)),
+        ]
+        operands += [_row_sinks(sinks, s)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, rows, d), lambda bi, ki, lr, tr: (bi, 0, 0)),
-            pl.BlockSpec((1, hkv, bs, d), kv_map),
-            pl.BlockSpec((1, hkv, bs, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, rows, d), lambda bi, ki, lr, tr: (bi, 0, 0)
         ),
@@ -718,11 +787,12 @@ def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret,
         functools.partial(
             _paged_kernel, scale=scale, s=s, hkv=hkv, block_k=bs,
             window=window, num_kv=num_kv, softcap=softcap,
+            has_sinks=has_sinks,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
         interpret=interpret,
-    )(index.astype(jnp.int32), tables.astype(jnp.int32), qf, pool_k, pool_v)
+    )(index.astype(jnp.int32), tables.astype(jnp.int32), *operands)
     return _unflatten_o(out, b, s, h, d)
 
 
@@ -747,6 +817,7 @@ def paged_decode_attention(
     window: Optional[int] = None,
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
+    sinks=None,
     impl: str = "auto",
     interpret: Optional[bool] = None,
 ):
@@ -801,13 +872,14 @@ def paged_decode_attention(
         if group > 1:
             return _paged_group_flash(
                 q, pool_k, pool_v, tables, index, float(scale), window,
-                group, interpret, softcap=sc,
+                group, interpret, softcap=sc, sinks=sinks,
             )
         return _paged_flash(
             q, pool_k, pool_v, tables, index, float(scale), window, interpret,
-            softcap=sc,
+            softcap=sc, sinks=sinks,
         )
     from shellac_tpu.inference.kvcache import paged_gather_layer
 
     k_all, v_all = paged_gather_layer(pool_k, pool_v, tables)
-    return _decode_ref(q, k_all, v_all, index, window, scale, softcap=softcap)
+    return _decode_ref(q, k_all, v_all, index, window, scale, softcap=softcap,
+                       sinks=sinks)

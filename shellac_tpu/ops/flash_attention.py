@@ -48,6 +48,19 @@ DEFAULT_BLOCK_K = 1024
 NEG_INF = -2.0e38
 
 
+def sink_rebase(m, l, sink):
+    """Fold a sink logit into an online-softmax (m, l) pair.
+
+    Returns (r, l2, m2): rescale the accumulator by r, divide by l2,
+    and m2 + log(l2) is the sink-inclusive logsumexp. Shared by the
+    flash/decode/ring finalizers so the rebase math cannot drift.
+    l2 >= exp(sink - m2) > 0, so fully-masked rows need no zero guard.
+    """
+    m2 = jnp.maximum(m, sink)
+    r = jnp.exp(m - m2)
+    return r, l * r + jnp.exp(sink - m2), m2
+
+
 def _fit_block(seq: int, block: int) -> int:
     """Largest divisor of `seq` that is <= `block` and a multiple of 8
     (TPU sublane tiling); 0 if none exists."""
@@ -197,27 +210,27 @@ def _make_clamp_ki(causal, window, block_q, block_k):
     return clamp_ki
 
 
-def _unpack_refs(refs, has_segments, n_out_scratch):
-    """Split a kernel's positional refs into (main_inputs, segs, rest)."""
-    if has_segments:
-        ins = refs[: -2 - n_out_scratch]
-        segs = refs[-2 - n_out_scratch: -n_out_scratch]
-        rest = refs[-n_out_scratch:]
-    else:
-        ins = refs[: -n_out_scratch]
-        segs = (None, None)
-        rest = refs[-n_out_scratch:]
-    return ins, segs, rest
+def _unpack_refs(refs, has_segments, n_out_scratch, has_sinks=False):
+    """Split a kernel's positional refs into
+    (main_inputs, segs, sinks, rest)."""
+    n_extra = (2 if has_segments else 0) + (1 if has_sinks else 0)
+    ins = refs[: len(refs) - n_out_scratch - n_extra]
+    extra = refs[len(refs) - n_out_scratch - n_extra:
+                 len(refs) - n_out_scratch]
+    rest = refs[len(refs) - n_out_scratch:]
+    segs = (extra[0], extra[1]) if has_segments else (None, None)
+    sinks = extra[-1] if has_sinks else None
+    return ins, segs, sinks, rest
 
 
 def _flash_kernel(
     *refs, scale: float, causal: bool, window: Optional[int],
     block_q: int, block_k: int, num_kv: int, has_segments: bool,
-    softcap: Optional[float],
+    softcap: Optional[float], has_sinks: bool,
 ):
-    (q_ref, k_ref, v_ref), (qs_ref, ks_ref), (
+    (q_ref, k_ref, v_ref), (qs_ref, ks_ref), sinks_ref, (
         o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    ) = _unpack_refs(refs, has_segments, 5)
+    ) = _unpack_refs(refs, has_segments, 5, has_sinks)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -273,15 +286,29 @@ def _flash_kernel(
     @pl.when(ki == last_ki)
     def _finalize():
         l = l_ref[:, :1]
-        # Guard fully-masked rows (can't happen for causal, cheap anyway).
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0, 0, :] = (m_ref[:, :1] + jnp.log(l))[:, 0]
+        m = m_ref[:, :1]
+        if has_sinks:
+            # GPT-OSS attention sink: the softmax denominator gains
+            # exp(sink_h) — a virtual column over a zero value. The
+            # saved lse then INCLUDES the sink, which is exactly what
+            # makes the backward kernels correct unchanged (p =
+            # exp(s - lse) are the true probabilities, delta =
+            # sum(dO*O) still sums only real columns because the
+            # sink's value is 0).
+            r, l2, m2 = sink_rebase(m, l, sinks_ref[0, 0])
+            o_ref[0] = (acc_ref[...] * r / l2).astype(o_ref.dtype)
+            lse_ref[0, 0, :] = (m2 + jnp.log(l2))[:, 0]
+        else:
+            # Guard fully-masked rows (can't happen for causal, cheap
+            # anyway).
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+            lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
 
 
 def _flash_forward(
     q, k, v, seg, causal, scale, window, block_q, block_k, interpret,
-    softcap=None,
+    softcap=None, sinks=None,
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -323,6 +350,17 @@ def _flash_forward(
             ),
         ]
         inputs += [segr, segr]
+    has_sinks = sinks is not None
+    if has_sinks:
+        # One scalar per q-head, tiled across a lane row (Mosaic wants
+        # a 128-lane trailing dim).
+        sinks_arr = jnp.tile(
+            sinks.astype(jnp.float32)[:, None], (1, 128)
+        )
+        in_specs += [
+            pl.BlockSpec((1, 128), lambda bh, qi, ki: (bh % h, 0)),
+        ]
+        inputs += [sinks_arr]
 
     out, lse = pl.pallas_call(
         functools.partial(
@@ -335,6 +373,7 @@ def _flash_forward(
             num_kv=num_kv,
             has_segments=has_segments,
             softcap=softcap,
+            has_sinks=has_sinks,
         ),
         out_shape=[
             jax.ShapeDtypeStruct(qf.shape, q.dtype),
@@ -358,6 +397,7 @@ def _flash_forward(
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse[:, 0, :]
 
 
+
 def _flash_bwd_dkdv_kernel(
     *refs, scale: float, causal: bool, window: Optional[int],
     block_q: int, block_k: int, num_q: int, inner: int, has_segments: bool,
@@ -365,7 +405,7 @@ def _flash_bwd_dkdv_kernel(
 ):
     """Grid (B*Hkv, kv_blocks, G*q_blocks): one (dk, dv) tile per kv block,
     accumulated over every q block of every q-head in the GQA group."""
-    (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref), (qs_ref, ks_ref), (
+    (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref), (qs_ref, ks_ref), _, (
         dk_ref, dv_ref, dk_acc, dv_acc,
     ) = _unpack_refs(refs, has_segments, 4)
     ki = pl.program_id(1)
@@ -417,7 +457,7 @@ def _flash_bwd_dq_kernel(
     softcap: Optional[float],
 ):
     """Grid (B*H, q_blocks, kv_blocks): one dq tile per q block."""
-    (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref), (qs_ref, ks_ref), (
+    (q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref), (qs_ref, ks_ref), _, (
         dq_ref, dq_acc,
     ) = _unpack_refs(refs, has_segments, 2)
     qi = pl.program_id(1)
@@ -458,7 +498,7 @@ def _flash_bwd_dq_kernel(
 
 def _flash_backward(
     q, k, v, seg, o, lse, g_out, causal, scale, window, block_q, block_k,
-    interpret, softcap=None,
+    interpret, softcap=None, sinks=None,
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -597,36 +637,49 @@ def _flash_backward(
     )(*inputs)
 
     unflat = lambda x, hh: x.reshape(b, hh, -1, d).transpose(0, 2, 1, 3)
-    return unflat(dq, h), unflat(dk, hkv), unflat(dv, hkv)
+    d_sinks = None
+    if sinks is not None:
+        # The sink column's value is zero, so its only gradient path is
+        # the softmax denominator: dL/dsink_h = -sum_{b,rows}
+        # p_sink * delta_row, with p_sink = exp(sink - lse) (lse already
+        # includes the sink) and delta = sum(dO * O).
+        lse_r = lse.reshape(b, h, sq)
+        delta_r = delta.reshape(b, h, sq)
+        d_sinks = -jnp.sum(
+            jnp.exp(sinks.astype(jnp.float32)[None, :, None] - lse_r)
+            * delta_r,
+            axis=(0, 2),
+        ).astype(sinks.dtype)
+    return unflat(dq, h), unflat(dk, hkv), unflat(dv, hkv), d_sinks
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, seg, causal, scale, window, block_q, block_k, interpret,
-           softcap):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, seg, sinks, causal, scale, window, block_q, block_k,
+           interpret, softcap):
     out, _ = _flash_forward(
         q, k, v, seg, causal, scale, window, block_q, block_k, interpret,
-        softcap,
+        softcap, sinks,
     )
     return out
 
 
-def _flash_fwd(q, k, v, seg, causal, scale, window, block_q, block_k,
+def _flash_fwd(q, k, v, seg, sinks, causal, scale, window, block_q, block_k,
                interpret, softcap):
     out, lse = _flash_forward(
         q, k, v, seg, causal, scale, window, block_q, block_k, interpret,
-        softcap,
+        softcap, sinks,
     )
-    return out, (q, k, v, seg, out, lse)
+    return out, (q, k, v, seg, sinks, out, lse)
 
 
 def _flash_bwd(causal, scale, window, block_q, block_k, interpret, softcap,
                res, g_out):
-    q, k, v, seg, o, lse = res
-    dq, dk, dv = _flash_backward(
+    q, k, v, seg, sinks, o, lse = res
+    dq, dk, dv, d_sinks = _flash_backward(
         q, k, v, seg, o, lse, g_out, causal, scale, window, block_q, block_k,
-        interpret, softcap,
+        interpret, softcap, sinks,
     )
-    return dq, dk, dv, None
+    return dq, dk, dv, None, d_sinks
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -635,7 +688,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(
     q, k, v, *, causal: bool = True, scale: Optional[float] = None,
     window: Optional[int] = None, segments: Optional[jax.Array] = None,
-    softcap: Optional[float] = None,
+    softcap: Optional[float] = None, sinks: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ):
@@ -663,7 +716,7 @@ def flash_attention(
         widths = [(0, 0)] * 3 + [(0, pad)]
         q, k, v = (jnp.pad(x, widths) for x in (q, k, v))
     out = _flash(
-        q, k, v, segments, causal, float(scale), window, block_q, block_k,
-        interpret, None if softcap is None else float(softcap),
+        q, k, v, segments, sinks, causal, float(scale), window, block_q,
+        block_k, interpret, None if softcap is None else float(softcap),
     )
     return out[..., :d] if pad else out

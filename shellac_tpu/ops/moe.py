@@ -65,8 +65,18 @@ def route(
     logits = jnp.einsum(
         "td,de->te", x.astype(jnp.float32), w_router.astype(jnp.float32)
     )
+    if cfg.scoring == "softmax_topk" and b_router is not None:
+        # GPT-OSS router bias is part of the logits proper (selection
+        # AND weights AND the aux losses see it).
+        logits = logits + b_router.astype(jnp.float32)[None]
     probs = jax.nn.softmax(logits, axis=-1)  # (T, E) — also feeds aux
-    if cfg.scoring == "sigmoid":
+    if cfg.scoring == "softmax_topk":
+        # GPT-OSS gate: top-k over RAW logits, softmax over just the
+        # kept values (not a renormalized slice of the full softmax —
+        # the dropped logits never enter the denominator).
+        top_vals, expert_idx = jax.lax.top_k(logits, k)
+        weight = jax.nn.softmax(top_vals, axis=-1)
+    elif cfg.scoring == "sigmoid":
         # DeepSeek-V3 gate: sigmoid scores; an additive per-expert bias
         # steers SELECTION only (load balancing knob trained outside
         # the gradient), combine weights come from the raw scores.
@@ -136,6 +146,9 @@ def moe_ffn(
     *,
     drop_tokens: bool = True,
     b_router: jax.Array | None = None,
+    b_gate: jax.Array | None = None,  # (E, F)
+    b_up: jax.Array | None = None,  # (E, F)
+    b_down: jax.Array | None = None,  # (E, D)
 ) -> Tuple[jax.Array, jax.Array, dict]:
     """Returns (out (B, S, D), aux_loss scalar, metrics).
 
@@ -168,9 +181,27 @@ def moe_ffn(
                       preferred_element_type=jnp.float32).astype(cdt)
     up = jnp.einsum("ecd,edf->ecf", dispatched, materialize(w_up, cdt),
                     preferred_element_type=jnp.float32).astype(cdt)
-    act = jax.nn.silu(gate) * up
+    if b_gate is not None:
+        gate = gate + b_gate.astype(cdt)[:, None, :]
+    if b_up is not None:
+        up = up + b_up.astype(cdt)[:, None, :]
+    if cfg.gate_limit is not None:
+        # GPT-OSS clamps pre-activation: gate one-sided to limit, up
+        # symmetric.
+        lim = cfg.gate_limit
+        gate = jnp.clip(gate, None, lim)
+        up = jnp.clip(up, -lim, lim)
+    if cfg.expert_act == "gptoss":
+        # glu = gate * sigmoid(1.702 * gate); output (up + 1) * glu.
+        act = (up + 1.0) * (gate * jax.nn.sigmoid(1.702 * gate))
+    else:
+        act = jax.nn.silu(gate) * up
     out_e = jnp.einsum("ecf,efd->ecd", act, materialize(w_down, cdt),
                        preferred_element_type=jnp.float32).astype(cdt)
+    if b_down is not None:
+        # The per-expert output bias applies to every ROUTED token's
+        # expert output (dropped tokens still get zeros downstream).
+        out_e = out_e + b_down.astype(cdt)[:, None, :]
 
     # Gather back and combine with router weights (dropped -> zeros row).
     out_flat = jnp.concatenate([out_e.reshape(e * c, d),

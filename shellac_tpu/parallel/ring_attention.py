@@ -66,8 +66,8 @@ def _block_stats(q, k, v, scale, mask, softcap=None):
 
 
 def _ring_local(
-    q, k, v, seg, *, axis_name: str, causal: bool, scale: float,
-    has_segments: bool, window=None, softcap=None,
+    q, k, v, seg, sinks, *, axis_name: str, causal: bool, scale: float,
+    has_segments: bool, window=None, softcap=None, has_sinks=False,
 ):
     """Runs on one device inside shard_map. q (B,S_loc,H,D); k,v
     (B,S_loc,Hkv,D); seg (B,S_loc) int32 (packed document ids; a dummy
@@ -134,6 +134,14 @@ def _ring_local(
     (acc, m, l, _), _ = jax.lax.scan(
         step, (acc0, m0, l0, (k, v, seg)), jnp.arange(n)
     )
+    if has_sinks:
+        # Sink denominator: per-head exp(sink) joins l. sinks is
+        # (H_loc,) ordered (kv_head, group) like qg.
+        from shellac_tpu.ops.flash_attention import sink_rebase
+
+        sk = sinks.astype(jnp.float32).reshape(1, 1, hkv, g, 1)
+        r, l, _ = sink_rebase(m, l, sk)
+        acc = acc * r
     l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l).reshape(b, s_loc, h, d)
     return out.astype(q.dtype)
@@ -150,6 +158,7 @@ def ring_attention(
     segments: Optional[jax.Array] = None,  # (B, S) packed document ids
     window: Optional[int] = None,
     softcap: Optional[float] = None,
+    sinks: Optional[jax.Array] = None,
     axis_name: str = AXIS_SEQ,
 ) -> jax.Array:
     """Sequence-parallel attention. q (B,S,H,D); k,v (B,S,Hkv,D).
@@ -165,18 +174,24 @@ def ring_attention(
     q_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
     kv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_TENSOR, None)
     seg_spec = P((AXIS_DATA, AXIS_FSDP), axis_name)
+    # Sink logits shard with the heads (tp axis).
+    sink_spec = P(AXIS_TENSOR)
     has_segments = segments is not None
     if not has_segments:
         segments = jnp.zeros(q.shape[:2], jnp.int32)
+    has_sinks = sinks is not None
+    if not has_sinks:
+        sinks = jnp.zeros((q.shape[2],), jnp.float32)
     fn = shard_map(
         functools.partial(
             _ring_local, axis_name=axis_name, causal=causal,
             scale=float(scale), has_segments=has_segments, window=window,
             softcap=None if softcap is None else float(softcap),
+            has_sinks=has_sinks,
         ),
         mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
+        in_specs=(q_spec, kv_spec, kv_spec, seg_spec, sink_spec),
         out_specs=q_spec,
         check_vma=False,
     )
-    return fn(q, k, v, segments)
+    return fn(q, k, v, segments, sinks)

@@ -59,8 +59,9 @@ def ulysses_supported(
 
 
 def _ulysses_local(
-    q, k, v, seg, *, axis_name: str, causal: bool, window: Optional[int],
-    scale: float, impl: str, has_segments: bool, softcap=None,
+    q, k, v, seg, sinks, *, axis_name: str, causal: bool,
+    window: Optional[int], scale: float, impl: str, has_segments: bool,
+    softcap=None, has_sinks=False,
 ):
     """Runs on one device inside shard_map.
 
@@ -106,9 +107,18 @@ def _ulysses_local(
         # scan covers every block.
         seg_full = seg  # (B, S)
 
+    sinks_h = None
+    if has_sinks:
+        # After the a2a this rank computes heads
+        # [my * h_loc/n, (my+1) * h_loc/n) of the LOCAL (tp-sharded)
+        # head axis; slice the matching sink logits.
+        my = jax.lax.axis_index(axis_name)
+        per = h_loc // n
+        sinks_h = jax.lax.dynamic_slice_in_dim(sinks, my * per, per)
     o = attention(
         qh, kh, vh, causal=causal, window=window, scale=scale, impl=impl,
-        softcap=softcap, q_segments=seg_full, kv_segments=seg_full,
+        softcap=softcap, sinks=sinks_h,
+        q_segments=seg_full, kv_segments=seg_full,
     )
 
     # head-sharded -> seq-sharded
@@ -127,6 +137,7 @@ def ulysses_attention(
     window: Optional[int] = None,
     scale: Optional[float] = None,
     softcap: Optional[float] = None,
+    sinks: Optional[jax.Array] = None,
     segments: Optional[jax.Array] = None,  # (B, S) packed document ids
     axis_name: str = AXIS_SEQ,
     impl: str = "auto",
@@ -146,19 +157,24 @@ def ulysses_attention(
     # head a2a anyway, and ids are layer-invariant, so resharding once
     # outside beats an all_gather inside every layer's body.
     seg_spec = P((AXIS_DATA, AXIS_FSDP), None)
+    sink_spec = P(AXIS_TENSOR)
     has_segments = segments is not None
     if not has_segments:
         segments = jnp.zeros(q.shape[:2], jnp.int32)
+    has_sinks = sinks is not None
+    if not has_sinks:
+        sinks = jnp.zeros((q.shape[2],), jnp.float32)
     fn = shard_map(
         functools.partial(
             _ulysses_local, axis_name=axis_name, causal=causal,
             window=window, scale=float(scale), impl=impl,
             has_segments=has_segments,
             softcap=None if softcap is None else float(softcap),
+            has_sinks=has_sinks,
         ),
         mesh=mesh,
-        in_specs=(q_spec, kv_spec, kv_spec, seg_spec),
+        in_specs=(q_spec, kv_spec, kv_spec, seg_spec, sink_spec),
         out_specs=q_spec,
         check_vma=False,
     )
-    return fn(q, k, v, segments)
+    return fn(q, k, v, segments, sinks)
