@@ -949,7 +949,15 @@ def to_state_dict(cfg: ModelConfig, params) -> Dict[str, np.ndarray]:
             sd[base + "self_attn.o_proj.bias"] = np_(layers["bo"][i])
         if cfg.attn_sink:
             sd[base + "self_attn.sinks"] = np_(layers["sinks"][i])
-        if moe and cfg.moe.scoring == "softmax_topk":
+        if moe and (cfg.moe.scoring == "softmax_topk"
+                    or cfg.moe.expert_bias):
+            if not (cfg.moe.scoring == "softmax_topk"
+                    and cfg.moe.expert_bias):
+                raise NotImplementedError(
+                    "softmax_topk scoring and expert_bias only export "
+                    "TOGETHER (the GPT-OSS layout); no HF architecture "
+                    "matches the partial combination"
+                )
             # GPT-OSS fused-expert export: re-interleave gate/up.
             sd[base + "mlp.router.weight"] = np_(layers["w_router"][i]).T
             sd[base + "mlp.router.bias"] = np_(layers["b_router"][i])

@@ -1,0 +1,222 @@
+"""Knowledge distillation: train a student against a frozen teacher.
+
+The classic Hinton recipe (public method; the reference repo for this
+project is empty, SURVEY.md §0): the student matches the teacher's
+temperature-softened token distribution via KL divergence, optionally
+mixed with the ordinary next-token cross-entropy on hard targets.
+
+TPU-first shape decisions mirror training/dpo.py: the teacher forward
+runs inside the same jitted step under stop_gradient (no separate eval
+step or host round-trip), teacher params ride as a step argument so
+they are never baked into the executable as constants, and the KL
+reduces in fp32 over the full vocab — one fused softmax/logsumexp pair
+per model, no materialized probability tensors beyond the logits XLA
+already holds.
+
+The teacher may be a DIFFERENT architecture (teacher_cfg): any model
+this framework can run — including a converted HF checkpoint — can
+teach, as long as the vocabularies match.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from shellac_tpu.config import ModelConfig, TrainConfig
+from shellac_tpu.models import transformer
+from shellac_tpu.training.losses import cross_entropy
+from shellac_tpu.training.optimizer import make_optimizer
+from shellac_tpu.training.train_state import TrainState, state_shardings
+from shellac_tpu.training.trainer import _LazyShardedStep, batch_shardings
+
+
+@dataclass(frozen=True)
+class DistillConfig:
+    """Distillation objective configuration.
+
+    temperature: softening applied to BOTH distributions; the KL term
+      carries the standard T^2 factor so gradients keep their scale.
+    alpha: weight on the KD term; (1 - alpha) goes to the hard-target
+      cross-entropy. alpha=1 is pure distillation.
+    kind: "forward" (KL(teacher || student) — mass-covering, the
+      standard choice) or "reverse" (KL(student || teacher) —
+      mode-seeking, the on-policy/generation-flavored variant).
+    """
+
+    temperature: float = 2.0
+    alpha: float = 0.5
+    kind: str = "forward"
+
+    def validate(self) -> "DistillConfig":
+        if self.temperature <= 0:
+            raise ValueError(
+                f"temperature={self.temperature} must be positive"
+            )
+        if not 0.0 <= self.alpha <= 1.0:
+            raise ValueError(f"alpha={self.alpha} must be in [0, 1]")
+        if self.kind not in ("forward", "reverse"):
+            raise ValueError(
+                f"kind={self.kind!r}; have forward, reverse"
+            )
+        return self
+
+    def replace(self, **kw) -> "DistillConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def distill_loss(
+    student_logits,  # (B, S, V) fp32
+    teacher_logits,  # (B, S, V) fp32, already stop-gradient
+    dcfg: DistillConfig,
+    mask=None,  # (B, S) f32 — 1.0 on positions that count
+):
+    """Temperature-softened KL between teacher and student, meaned over
+    unmasked positions. Returns (loss, metrics)."""
+    t = dcfg.temperature
+    s_lp = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, -1)
+    t_lp = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / t, -1)
+    if dcfg.kind == "forward":
+        # KL(T || S) = sum p_T (log p_T - log p_S)
+        kl = jnp.sum(jnp.exp(t_lp) * (t_lp - s_lp), axis=-1)
+    else:
+        kl = jnp.sum(jnp.exp(s_lp) * (s_lp - t_lp), axis=-1)
+    if mask is None:
+        denom = kl.size
+        kl_mean = jnp.sum(kl) / denom
+    else:
+        m = mask.astype(jnp.float32)
+        kl_mean = jnp.sum(kl * m) / jnp.maximum(jnp.sum(m), 1.0)
+    # T^2 keeps soft-target gradient magnitudes comparable to the hard
+    # CE as the temperature changes (Hinton et al.).
+    loss = (t * t) * kl_mean
+    match = (
+        jnp.argmax(student_logits, -1) == jnp.argmax(teacher_logits, -1)
+    ).astype(jnp.float32)
+    if mask is None:
+        agreement = jnp.mean(match)
+    else:
+        # Same positions as the loss: padding must not dilute the
+        # convergence metric.
+        m = mask.astype(jnp.float32)
+        agreement = jnp.sum(match * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return loss, {"kd_loss": loss, "teacher_agreement": agreement}
+
+
+def make_distill_step(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    distill_cfg: DistillConfig,
+    teacher_cfg: Optional[ModelConfig] = None,
+    mesh: Optional[Mesh] = None,
+    attn_impl: str = "auto",
+    jit: bool = True,
+):
+    """Build `distill_step(state, teacher_params, batch) -> (state, metrics)`.
+
+    batch: {"inputs" (B,S) i32, "targets" (B,S) i32, "mask" (B,S) f32?}.
+    teacher_cfg defaults to the student's config (self-distillation /
+    same-shape teacher); pass the teacher's own ModelConfig otherwise.
+    The state is DONATED: teacher params must not alias state.params.
+    """
+    distill_cfg = distill_cfg.validate()
+    teacher_cfg = teacher_cfg or model_cfg
+    if teacher_cfg.vocab_size != model_cfg.vocab_size:
+        raise ValueError(
+            f"teacher vocab {teacher_cfg.vocab_size} != student vocab "
+            f"{model_cfg.vocab_size}: distillation matches token "
+            "distributions, the vocabularies must be identical"
+        )
+    optimizer = make_optimizer(train_cfg)
+    alpha = distill_cfg.alpha
+
+    def loss_fn(params, teacher_params, batch):
+        student_logits = transformer.forward(
+            model_cfg, params, batch["inputs"], mesh=mesh,
+            attn_impl=attn_impl,
+        )
+        teacher_logits = jax.lax.stop_gradient(
+            transformer.forward(
+                teacher_cfg, teacher_params, batch["inputs"], mesh=mesh,
+                attn_impl=attn_impl,
+            )
+        )
+        kd, metrics = distill_loss(
+            student_logits, teacher_logits, distill_cfg,
+            mask=batch.get("mask"),
+        )
+        loss = alpha * kd
+        if alpha < 1.0:
+            ce, ce_metrics = cross_entropy(
+                student_logits, batch["targets"], batch.get("mask"),
+                train_cfg.z_loss_weight,
+            )
+            loss = loss + (1.0 - alpha) * ce
+            metrics["ce_loss"] = ce_metrics["loss"]
+        metrics["loss"] = loss
+        return loss, metrics
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def distill_step(state: TrainState, teacher_params, batch):
+        from shellac_tpu.utils.failure import all_finite, guard_update
+
+        (_, metrics), grads = grad_fn(state.params, teacher_params, batch)
+        updates, new_opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_ema = state.ema_params
+        if train_cfg.ema_decay is not None:
+            d = train_cfg.ema_decay
+            new_ema = jax.tree.map(
+                lambda e, p: (e * d + p.astype(e.dtype) * (1.0 - d)).astype(
+                    e.dtype
+                ),
+                state.ema_params, new_params,
+            )
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        if train_cfg.skip_nonfinite_updates:
+            ok = all_finite(grads)
+            new_params = guard_update(state.params, new_params, ok)
+            new_opt_state = guard_update(state.opt_state, new_opt_state, ok)
+            if new_ema is not None:
+                new_ema = guard_update(state.ema_params, new_ema, ok)
+            metrics["update_skipped"] = 1.0 - ok.astype(jnp.float32)
+        return TrainState(
+            step=state.step + 1, params=new_params,
+            opt_state=new_opt_state, ema_params=new_ema,
+        ), metrics
+
+    if not jit:
+        return distill_step
+
+    if mesh is None:
+        return jax.jit(distill_step, donate_argnums=(0,))
+
+    def jit_with_shardings(state, teacher_params, batch):
+        abstract_state = jax.eval_shape(lambda s: s, state)
+        st_sh = state_shardings(
+            mesh, abstract_state, transformer.logical_axes(model_cfg)
+        )
+        t_abstract = jax.eval_shape(lambda p: p, teacher_params)
+        t_sh = state_shardings(
+            mesh, t_abstract, transformer.logical_axes(teacher_cfg)
+        )
+        b_sh = batch_shardings(mesh)
+        batch_in = jax.tree.map(lambda _: b_sh, batch)
+        return jax.jit(
+            distill_step,
+            in_shardings=(st_sh, t_sh, batch_in),
+            out_shardings=(st_sh, None),
+            donate_argnums=(0,),
+        )
+
+    return _LazyShardedStep(jit_with_shardings)

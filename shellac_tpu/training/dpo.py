@@ -182,6 +182,8 @@ def make_dpo_step(
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def dpo_step(state: TrainState, ref_params, batch):
+        from shellac_tpu.utils.failure import all_finite, guard_update
+
         (_, metrics), grads = grad_fn(state.params, ref_params, batch)
         updates, new_opt_state = optimizer.update(
             grads, state.opt_state, state.params
@@ -198,6 +200,13 @@ def make_dpo_step(
             )
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
+        if train_cfg.skip_nonfinite_updates:
+            ok = all_finite(grads)
+            new_params = guard_update(state.params, new_params, ok)
+            new_opt_state = guard_update(state.opt_state, new_opt_state, ok)
+            if new_ema is not None:
+                new_ema = guard_update(state.ema_params, new_ema, ok)
+            metrics["update_skipped"] = 1.0 - ok.astype(jnp.float32)
         new_state = TrainState(
             step=state.step + 1, params=new_params,
             opt_state=new_opt_state, ema_params=new_ema,
