@@ -73,6 +73,8 @@ class _Request:
     # EOS is banned from sampling until this many tokens are emitted
     # (0 = off; stop sequences still end generation regardless).
     min_tokens: int = 0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
     # Additive per-token logit biases applied before sampling (OpenAI
     # semantics); logprobs still report the raw distribution.
     logit_bias: Optional[Dict[int, float]] = None
@@ -183,6 +185,14 @@ class BatchingEngine:
         self._zero_bias_row = jnp.zeros((1, cfg.vocab_size), jnp.float32)
         self._slot_bias: List[Optional[Dict[int, float]]] = [None] * n_slots
         self._smin = jnp.zeros((n_slots,), jnp.int32)
+        # OpenAI-style repetition penalties over GENERATED tokens:
+        # per-slot token-count matrix (lazily allocated, like the bias
+        # matrix) plus presence/frequency coefficient vectors. Counts
+        # update on device inside the decode scan.
+        self._scounts: Optional[jax.Array] = None
+        self._spres = jnp.zeros((n_slots,), jnp.float32)
+        self._sfreq = jnp.zeros((n_slots,), jnp.float32)
+        self._slot_pen: List[bool] = [False] * n_slots
         # Engine-level sampling defaults; submit() can override any of
         # them per request. Each slot's effective settings live in
         # device vectors fed to the jitted programs, so one decode tick
@@ -292,7 +302,8 @@ class BatchingEngine:
         return scatter_slot(cache, mini, slot), first, first_lp
 
     def _decode_impl(self, params, cache, cur, active, key, samp,
-                     greedy_only: bool = False, use_bias: bool = False):
+                     greedy_only: bool = False, use_bias: bool = False,
+                     use_pen: bool = False):
         """decode_ticks decode steps over every slot, ONE host sync.
 
         Per-tick host reads dominate serving latency when the device is
@@ -308,15 +319,21 @@ class BatchingEngine:
 
         bias = samp[4] if use_bias else None
         min_rem0 = samp[5]
+        pres, freq, counts0 = samp[6], samp[7], samp[8]
 
         def tick(carry, key):
-            cache, cur, min_rem = carry
+            cache, cur, min_rem, counts = carry
             old_lengths = cache.lengths
             logits, cache = transformer.forward_with_cache(
                 self.cfg, params, cur[:, None], cache,
                 attn_impl=self.attn_impl, mesh=self.mesh,
             )
             adj = self._adjust_logits(logits[:, 0], bias, min_rem)
+            if use_pen:
+                # OpenAI semantics over generated tokens: presence
+                # subtracts once per seen token, frequency per count.
+                adj = adj - (pres[:, None] * (counts > 0.0)
+                             + freq[:, None] * counts)
             if greedy_only:
                 nxt = jnp.argmax(adj, axis=-1).astype(jnp.int32)
             else:
@@ -327,6 +344,10 @@ class BatchingEngine:
             min_rem = jnp.where(
                 active, jnp.maximum(min_rem - 1, 0), min_rem
             )
+            if use_pen:
+                counts = counts.at[
+                    jnp.arange(counts.shape[0]), nxt
+                ].add(active.astype(jnp.float32))
             if self.logprobs:
                 lp = jnp.take_along_axis(
                     jax.nn.log_softmax(logits[:, 0].astype(jnp.float32)),
@@ -334,13 +355,13 @@ class BatchingEngine:
                 )[:, 0]
             else:
                 lp = jnp.zeros(nxt.shape, jnp.float32)
-            return (cache, nxt, min_rem), (nxt, lp)
+            return (cache, nxt, min_rem, counts), (nxt, lp)
 
         keys = jax.random.split(key, self.decode_ticks)
-        (cache, _, min_rem), (toks, lps) = jax.lax.scan(
-            tick, (cache, cur, min_rem0), keys
+        (cache, _, min_rem, counts), (toks, lps) = jax.lax.scan(
+            tick, (cache, cur, min_rem0, counts0), keys
         )
-        return cache, toks, lps, min_rem
+        return cache, toks, lps, min_rem, counts
 
     # ---- scheduling --------------------------------------------------
 
@@ -378,7 +399,8 @@ class BatchingEngine:
 
     def submit(self, rid, tokens, max_new: int, stop=None, *,
                temperature=None, top_k=None, top_p=None,
-               min_p=None, min_tokens=None, logit_bias=None) -> None:
+               min_p=None, min_tokens=None, logit_bias=None,
+               presence_penalty=None, frequency_penalty=None) -> None:
         """Queue a request. `stop`: optional list of token-id sequences;
         generation ends when the output ends with any of them, and the
         matched sequence is removed from the returned tokens.
@@ -431,9 +453,18 @@ class BatchingEngine:
                     f"request {rid!r}: logit_bias token ids {oob} outside "
                     f"vocab [0, {self.cfg.vocab_size})"
                 )
+        pres = float(presence_penalty) if presence_penalty is not None \
+            else 0.0
+        freq = float(frequency_penalty) if frequency_penalty is not None \
+            else 0.0
+        for nm, v in (("presence_penalty", pres),
+                      ("frequency_penalty", freq)):
+            if not np.isfinite(v):
+                raise ValueError(f"request {rid!r}: {nm} must be finite")
         self._queue.append(_Request(
             rid, tokens, max_new, stop=stop, min_tokens=min_tokens,
-            logit_bias=logit_bias, **samp,
+            logit_bias=logit_bias, presence_penalty=pres,
+            frequency_penalty=freq, **samp,
         ))
 
     def _prepare_slot(self, slot: int, req: _Request) -> None:
@@ -448,6 +479,13 @@ class BatchingEngine:
         if self._slot_bias[slot] is not None:
             self._sbias = self._sbias.at[slot].set(0.0)
             self._slot_bias[slot] = None
+        if self._slot_pen[slot]:
+            # Clear the coefficient AND the counts, or the next request
+            # on this slot would inherit a stale repetition history.
+            self._spres = self._spres.at[slot].set(0.0)
+            self._sfreq = self._sfreq.at[slot].set(0.0)
+            self._scounts = self._scounts.at[slot].set(0.0)
+            self._slot_pen[slot] = False
 
     def _bias_row(self, req: _Request) -> np.ndarray:
         row = np.zeros((self.cfg.vocab_size,), np.float32)
@@ -491,6 +529,17 @@ class BatchingEngine:
             )
             self._slot_bias[slot] = new_bias
         self._smin = self._smin.at[slot].set(req.min_tokens)
+        penalized = (req.presence_penalty != 0.0
+                     or req.frequency_penalty != 0.0)
+        if penalized or self._slot_pen[slot]:
+            if self._scounts is None:
+                self._scounts = jnp.zeros(
+                    (self.n_slots, self.cfg.vocab_size), jnp.float32
+                )
+            self._spres = self._spres.at[slot].set(req.presence_penalty)
+            self._sfreq = self._sfreq.at[slot].set(req.frequency_penalty)
+            self._scounts = self._scounts.at[slot].set(0.0)
+        self._slot_pen[slot] = penalized
 
     def _run_prefill(self, slot: int, req: _Request):
         """Run the (bucketed, jitted) prefill for `req`; returns
@@ -546,6 +595,10 @@ class BatchingEngine:
         first_tok = int(first)
         self._cur = self._cur.at[slot].set(first_tok)
         self._slots[slot] = req
+        if self._slot_pen[slot]:
+            # The prefill-sampled token is generated output: it joins
+            # the slot's repetition counts.
+            self._scounts = self._scounts.at[slot, first_tok].add(1.0)
         # The prefill-sampled token consumed one unit of the EOS ban.
         if req.min_tokens > 0:
             self._smin = self._smin.at[slot].set(req.min_tokens - 1)
@@ -713,24 +766,30 @@ class BatchingEngine:
         speculative engine."""
         if self._decode is None:
             self._decode = self._jit_cache_program(
-                self._decode_impl, 3,
-                static_argnames=("greedy_only", "use_bias"),
+                self._decode_impl, 4,
+                static_argnames=("greedy_only", "use_bias", "use_pen"),
             )
         active = jnp.asarray(active_rows)
         self._key, sub = jax.random.split(self._key)
         greedy_only = all(
             r is None or r.temperature == 0.0 for r in self._slots
         )
-        self._cache, toks, lps, self._smin = self._decode(
+        use_pen = any(self._slot_pen)
+        counts = (self._scounts if use_pen else self._zero_bias_row)
+        self._cache, toks, lps, self._smin, counts = self._decode(
             self.params, self._cache, self._cur, active, sub,
             (self._stemp, self._stopk, self._stopp, self._sminp,
              self._sbias if self._sbias is not None
-             else self._zero_bias_row, self._smin),
+             else self._zero_bias_row, self._smin,
+             self._spres, self._sfreq, counts),
             greedy_only=greedy_only,
             use_bias=self._sbias is not None and any(
                 b is not None for b in self._slot_bias
             ),
+            use_pen=use_pen,
         )
+        if use_pen:
+            self._scounts = counts
         self._cur = toks[-1]
         # (K, n_slots) each — the one host sync.
         host_toks, host_lps = jax.device_get((toks, lps))

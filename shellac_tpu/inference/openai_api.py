@@ -5,12 +5,13 @@ Pure request/response translators: OpenAI `/v1/completions` and
 schema (inference/server.py), and native results map back into OpenAI
 response shapes — so the whole battle-tested native path (continuous
 batching, stop sequences, per-request sampling, n/best_of fan-out,
-logprobs, streaming cancel) is reused rather than reimplemented.
+logprobs, streaming cancel, presence/frequency penalties) is reused
+rather than reimplemented.
 
 Scope honesty: knobs the engine genuinely implements translate;
-accepted-but-ignored knobs are limited to no-op values (e.g.
-`presence_penalty: 0`) — a NONZERO unsupported knob is a loud 400, not
-a silently different sampling distribution.
+accepted-but-ignored knobs are limited to no-op values (e.g. an empty
+`suffix`) — a non-neutral unsupported knob is a loud 400, not a
+silently different sampling distribution.
 """
 
 from __future__ import annotations
@@ -26,14 +27,9 @@ def _bad(msg: str):
 
 def _check_unsupported(payload: dict):
     for key, neutral in (
-        ("presence_penalty", (0, 0.0, None)),
-        ("frequency_penalty", (0, 0.0, None)),
-        ("logit_bias", None),  # supported, validated downstream
         ("suffix", (None, "")),
         ("echo", (False, None)),
     ):
-        if neutral is None:
-            continue
         if key in payload and payload[key] not in neutral:
             _bad(
                 f"{key}={payload[key]!r} is not supported by this server "
@@ -66,6 +62,9 @@ def _common_sampling(payload: dict, native: dict):
         native["best_of"] = int(payload["best_of"])
     if payload.get("logit_bias") is not None:
         native["logit_bias"] = payload["logit_bias"]
+    for key in ("presence_penalty", "frequency_penalty"):
+        if payload.get(key) is not None:
+            native[key] = float(payload[key])
     if payload.get("stream"):
         native["stream"] = True
 

@@ -323,7 +323,8 @@ class InferenceServer:
         try:
             samp = {
                 k: float(payload[k])
-                for k in ("temperature", "top_p", "min_p")
+                for k in ("temperature", "top_p", "min_p",
+                          "presence_penalty", "frequency_penalty")
                 if payload.get(k) is not None
             }
             for key in ("top_k", "min_tokens"):

@@ -118,9 +118,11 @@ class SpeculativeBatchingEngine(BatchingEngine):
 
     def submit(self, rid, tokens, max_new: int, stop=None, *,
                temperature=None, top_k=None, top_p=None, min_p=None,
-               min_tokens=None, logit_bias=None) -> None:
+               min_tokens=None, logit_bias=None,
+               presence_penalty=None, frequency_penalty=None) -> None:
         if any(v is not None for v in
-               (top_k, top_p, min_p, min_tokens, logit_bias)):
+               (top_k, top_p, min_p, min_tokens, logit_bias,
+                presence_penalty, frequency_penalty)):
             raise ValueError(
                 f"request {rid!r}: speculative decoding supports "
                 "temperature only (distribution filtering/biasing breaks "

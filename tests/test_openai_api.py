@@ -155,15 +155,15 @@ class TestCompletions:
         base, _, _ = oai_srv
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(base, "/v1/completions", {
-                "prompt": "a", "presence_penalty": 0.5,
+                "prompt": "a", "echo": True,
             })
         assert e.value.code == 400
         body = json.loads(e.value.read())
         assert body["error"]["type"] == "invalid_request_error"
-        # neutral value passes
+        # neutral value passes, and penalties are now SUPPORTED knobs
         out = _post(base, "/v1/completions", {
-            "prompt": "a", "max_tokens": 2, "presence_penalty": 0,
-            "temperature": 0,
+            "prompt": "a", "max_tokens": 2, "echo": False,
+            "presence_penalty": 0.5, "temperature": 0,
         })
         assert out["choices"][0]["text"]
 
