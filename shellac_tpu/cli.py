@@ -404,9 +404,18 @@ def cmd_dpo(args):
         from shellac_tpu.training.tokenizer import ByteTokenizer
 
         tokenizer = ByteTokenizer()
+    # Resume continues the (seed-deterministic) pair stream where the
+    # checkpoint left it.
+    skip = 0
+    if args.ckpt_dir:
+        from shellac_tpu.training.checkpoint import Checkpointer
+
+        latest = Checkpointer(args.ckpt_dir).latest_step()
+        if latest is not None:
+            skip = int(latest)
     data = preference_batches(
         args.data, args.batch, args.max_len,
-        tokenizer=tokenizer, seed=args.seed,
+        tokenizer=tokenizer, seed=args.seed, skip=skip,
     )
     init_params = _restore_base_params(args, cfg, mesh)
     state = fit_dpo(
@@ -420,6 +429,53 @@ def cmd_dpo(args):
     )
     import jax
 
+    print(json.dumps({"final_step": int(jax.device_get(state.step))}))
+    return 0
+
+
+def cmd_distill(args):
+    """Distill a frozen teacher checkpoint into a (usually smaller)
+    student. The teacher is any checkpoint this framework can run; only
+    the vocabularies must match."""
+    import jax
+
+    from shellac_tpu.training.distill import (
+        DistillConfig,
+        fit_distill,
+    )
+
+    cfg = _model_config(args)
+    tcfg = _train_config(args)
+    dcfg = DistillConfig(
+        temperature=args.kd_temperature, alpha=args.alpha, kind=args.kind,
+    ).validate()
+    mesh = _mesh_from(args)
+    if args.teacher_model:
+        from shellac_tpu.models.registry import get_model_config
+
+        teacher_cfg = get_model_config(args.teacher_model)
+    else:
+        teacher_cfg = cfg
+    teacher_params = _restore_base_params(
+        argparse.Namespace(base_ckpt=args.teacher_ckpt, seed=args.seed),
+        teacher_cfg, mesh,
+    )
+    # Resume continues the data stream where the checkpoint left it
+    # rather than replaying (and re-training on) the earliest batches.
+    skip = 0
+    if args.ckpt_dir:
+        from shellac_tpu.training.checkpoint import Checkpointer
+
+        latest = Checkpointer(args.ckpt_dir).latest_step()
+        if latest is not None:
+            skip = int(latest)
+    data = _data_iter(args, cfg, args.batch, args.seq, skip=skip)
+    state = fit_distill(
+        cfg, tcfg, dcfg, teacher_params, data,
+        teacher_cfg=teacher_cfg, mesh=mesh,
+        checkpoint_dir=args.ckpt_dir, checkpoint_every=args.ckpt_every,
+        log_path=args.log_path, log_every=args.log_every,
+    )
     print(json.dumps({"final_step": int(jax.device_get(state.step))}))
     return 0
 
@@ -812,6 +868,38 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--optimizer",
                    choices=["adamw", "lion", "adafactor", "muon"])
     d.set_defaults(fn=cmd_dpo)
+
+    kd = sub.add_parser("distill",
+                        help="distill a teacher checkpoint into a student")
+    common(kd)
+    kd.add_argument("--teacher-model", default=None, dest="teacher_model",
+                    help="teacher preset (default: same config as the "
+                         "student)")
+    kd.add_argument("--teacher-ckpt", default=None, dest="teacher_ckpt",
+                    help="teacher train checkpoint dir (default: seeded "
+                         "random weights — useful only for smoke tests)")
+    kd.add_argument("--kd-temperature", type=float, default=2.0,
+                    dest="kd_temperature")
+    kd.add_argument("--alpha", type=float, default=0.5,
+                    help="KD weight; (1-alpha) goes to hard-target CE")
+    kd.add_argument("--kind", choices=["forward", "reverse"],
+                    default="forward")
+    kd.add_argument("--steps", type=int, default=100)
+    kd.add_argument("--batch", type=int, default=8)
+    kd.add_argument("--seq", type=int, default=128)
+    kd.add_argument("--data", nargs="*", default=None,
+                    help="token shard files (default: synthetic stream)")
+    kd.add_argument("--mesh", default="")
+    kd.add_argument("--ckpt-dir")
+    kd.add_argument("--ckpt-every", type=int, default=500)
+    kd.add_argument("--log-path")
+    kd.add_argument("--log-every", type=int, default=10)
+    kd.add_argument("--learning-rate", type=float, dest="learning_rate")
+    kd.add_argument("--warmup-steps", type=int, dest="warmup_steps")
+    kd.add_argument("--weight-decay", type=float, dest="weight_decay")
+    kd.add_argument("--optimizer",
+                    choices=["adamw", "lion", "adafactor", "muon"])
+    kd.set_defaults(fn=cmd_distill)
 
     e = sub.add_parser("eval", help="perplexity of a checkpoint")
     common(e)

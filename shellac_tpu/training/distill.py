@@ -220,3 +220,76 @@ def make_distill_step(
         )
 
     return _LazyShardedStep(jit_with_shardings)
+
+
+def fit_distill(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    distill_cfg: DistillConfig,
+    teacher_params,
+    data_iter,
+    *,
+    teacher_cfg: Optional[ModelConfig] = None,
+    mesh: Optional[Mesh] = None,
+    checkpoint_dir: Optional[str] = None,
+    checkpoint_every: int = 500,
+    log_path: Optional[str] = None,
+    log_every: int = 10,
+    resume: bool = True,
+):
+    """Distillation loop; returns the final (student) TrainState.
+
+    Mirrors fit(): checkpoints the full student TrainState under
+    checkpoint_dir with sharded resume. The teacher is frozen — it is
+    never checkpointed.
+    """
+    from shellac_tpu.training.trainer import init_train_state
+    from shellac_tpu.utils.metrics import MetricsLogger
+    from shellac_tpu.utils.tracing import StepTimer
+
+    distill_cfg = distill_cfg.validate()
+    key = jax.random.PRNGKey(train_cfg.seed)
+    ckpt = None
+    if checkpoint_dir is not None:
+        from shellac_tpu.training.checkpoint import Checkpointer
+
+        ckpt = Checkpointer(checkpoint_dir)
+    if ckpt is not None and resume and ckpt.latest_step() is not None:
+        abstract = jax.eval_shape(
+            lambda: init_train_state(model_cfg, train_cfg, key, mesh=mesh)
+        )
+        state = ckpt.restore(
+            abstract_state=abstract, mesh=mesh, model_cfg=model_cfg
+        )
+    else:
+        state = init_train_state(model_cfg, train_cfg, key, mesh=mesh)
+
+    step_fn = make_distill_step(
+        model_cfg, train_cfg, distill_cfg, teacher_cfg=teacher_cfg,
+        mesh=mesh,
+    )
+    logger = MetricsLogger(log_path, every=1)
+    timer = StepTimer()
+
+    step = int(jax.device_get(state.step))
+    while step < train_cfg.total_steps:
+        try:
+            batch = next(data_iter)
+        except StopIteration:
+            break
+        state, metrics = step_fn(state, teacher_params, batch)
+        step += 1
+        if step % log_every == 0 or step >= train_cfg.total_steps:
+            host_metrics = {k: jax.device_get(v) for k, v in metrics.items()}
+            dt = timer.tick()
+            if dt is not None:
+                host_metrics["steps_per_sec"] = log_every / dt
+            logger.log(step, host_metrics)
+        if ckpt is not None and step % checkpoint_every == 0:
+            ckpt.save(step, state)
+
+    if ckpt is not None:
+        ckpt.save(int(jax.device_get(state.step)), state, force=True,
+                  wait=True)
+    logger.close()
+    return state

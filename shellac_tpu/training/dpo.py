@@ -244,8 +244,13 @@ def preference_batches(
     tokenizer=None,
     loop: bool = True,
     seed: int = 0,
+    skip: int = 0,
 ):
     """Iterator of DPO batches from a JSONL file of preference pairs.
+
+    skip: number of leading batches to drop — the deterministic
+    per-epoch shuffle makes this reproduce the stream position a
+    resumed run left off at.
 
     Each line holds {"prompt": ..., "chosen": ..., "rejected": ...}
     where the fields are either token-id lists or strings (strings need
@@ -300,6 +305,9 @@ def preference_batches(
     while True:
         rng.shuffle(order)
         for start in range(0, len(order) - batch_size + 1, batch_size):
+            if skip > 0:
+                skip -= 1
+                continue
             idx = order[start:start + batch_size]
             c_t, c_m, r_t, r_m = [], [], [], []
             for i in idx:

@@ -158,3 +158,30 @@ def test_distill_sharded_matches_unsharded():
     np.testing.assert_allclose(
         float(ms["loss"]), float(mu["loss"]), rtol=2e-4, atol=2e-5
     )
+
+
+def test_distill_cli_roundtrip(tmp_path, capsys):
+    import json
+
+    from shellac_tpu.cli import main
+
+    teacher_dir = tmp_path / "teacher"
+    rc = main([
+        "train", "--model", "tiny", "--steps", "5", "--batch", "2",
+        "--seq", "32", "--ckpt-dir", str(teacher_dir),
+    ])
+    assert rc == 0
+    student_dir = tmp_path / "student"
+    rc = main([
+        "distill", "--model", "tiny", "--teacher-ckpt", str(teacher_dir),
+        "--steps", "4", "--batch", "2", "--seq", "32", "--alpha", "1.0",
+        "--ckpt-dir", str(student_dir), "--log-every", "1",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert json.loads(out[-1])["final_step"] == 4
+    rc = main([
+        "generate", "--model", "tiny", "--ckpt-dir", str(student_dir),
+        "--prompt", "1,2", "--max-new", "4", "--temperature", "0",
+    ])
+    assert rc == 0
