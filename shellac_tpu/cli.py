@@ -147,6 +147,18 @@ def _restore_params(args, cfg, train_cfg=None):
     return transformer.init_params(cfg, jax.random.PRNGKey(args.seed))
 
 
+def _resume_skip(args) -> int:
+    """Batches already consumed by a checkpointed run: resume continues
+    the data stream where it left off rather than replaying (and
+    re-training on) the earliest batches."""
+    if not getattr(args, "ckpt_dir", None):
+        return 0
+    from shellac_tpu.training.checkpoint import Checkpointer
+
+    latest = Checkpointer(args.ckpt_dir).latest_step()
+    return int(latest) if latest is not None else 0
+
+
 def _train_config(args):
     from shellac_tpu.config import TrainConfig
 
@@ -211,16 +223,8 @@ def cmd_train(args):
         # seed stays shared.
     else:
         mesh = _mesh_from(args)
-    # Resume continues the data stream where the checkpoint left it
-    # rather than replaying (and re-training on) the earliest batches.
-    skip = 0
-    if args.ckpt_dir:
-        from shellac_tpu.training.checkpoint import Checkpointer
-
-        latest = Checkpointer(args.ckpt_dir).latest_step()
-        if latest is not None:
-            skip = int(latest)
-    data = _data_iter(args, cfg, args.batch, args.seq, skip=skip)
+    data = _data_iter(args, cfg, args.batch, args.seq,
+                      skip=_resume_skip(args))
     if args.lora_rank is not None:
         return _train_lora(args, cfg, tcfg, mesh, data)
     state = fit(
@@ -404,18 +408,9 @@ def cmd_dpo(args):
         from shellac_tpu.training.tokenizer import ByteTokenizer
 
         tokenizer = ByteTokenizer()
-    # Resume continues the (seed-deterministic) pair stream where the
-    # checkpoint left it.
-    skip = 0
-    if args.ckpt_dir:
-        from shellac_tpu.training.checkpoint import Checkpointer
-
-        latest = Checkpointer(args.ckpt_dir).latest_step()
-        if latest is not None:
-            skip = int(latest)
     data = preference_batches(
         args.data, args.batch, args.max_len,
-        tokenizer=tokenizer, seed=args.seed, skip=skip,
+        tokenizer=tokenizer, seed=args.seed, skip=_resume_skip(args),
     )
     init_params = _restore_base_params(args, cfg, mesh)
     state = fit_dpo(
@@ -460,16 +455,8 @@ def cmd_distill(args):
         argparse.Namespace(base_ckpt=args.teacher_ckpt, seed=args.seed),
         teacher_cfg, mesh,
     )
-    # Resume continues the data stream where the checkpoint left it
-    # rather than replaying (and re-training on) the earliest batches.
-    skip = 0
-    if args.ckpt_dir:
-        from shellac_tpu.training.checkpoint import Checkpointer
-
-        latest = Checkpointer(args.ckpt_dir).latest_step()
-        if latest is not None:
-            skip = int(latest)
-    data = _data_iter(args, cfg, args.batch, args.seq, skip=skip)
+    data = _data_iter(args, cfg, args.batch, args.seq,
+                      skip=_resume_skip(args))
     state = fit_distill(
         cfg, tcfg, dcfg, teacher_params, data,
         teacher_cfg=teacher_cfg, mesh=mesh,
@@ -495,9 +482,19 @@ def cmd_eval(args):
 
 def cmd_tokenize(args):
     from shellac_tpu.training.data import write_token_shard
-    from shellac_tpu.training.tokenizer import get_tokenizer
+    from shellac_tpu.training.tokenizer import BPETokenizer, get_tokenizer
 
-    tok = get_tokenizer(args.tokenizer)
+    if args.train_bpe is not None:
+        if not args.tokenizer.endswith(".json"):
+            raise SystemExit(
+                "--train-bpe writes a .json tokenizer file; point "
+                "--tokenizer at the output path (e.g. tok.json)"
+            )
+        tok = BPETokenizer.train(
+            args.input, vocab_size=args.train_bpe, out_path=args.tokenizer
+        )
+    else:
+        tok = get_tokenizer(args.tokenizer)
     docs = []
     for path in args.input:
         with open(path, encoding="utf-8") as f:
@@ -999,7 +996,12 @@ def build_parser() -> argparse.ArgumentParser:
     k.add_argument("--input", nargs="+", required=True, help="text files")
     k.add_argument("--output", required=True, help="shard path to write")
     k.add_argument("--tokenizer", default="byte",
-                   help='"byte" or a local HF tokenizer dir')
+                   help='"byte", a trained BPE .json, or a local HF '
+                        "tokenizer dir")
+    k.add_argument("--train-bpe", type=int, default=None, dest="train_bpe",
+                   metavar="VOCAB_SIZE",
+                   help="train a byte-level BPE on the inputs first, "
+                        "saving it to the --tokenizer path")
     k.set_defaults(fn=cmd_tokenize)
 
     c = sub.add_parser("convert",

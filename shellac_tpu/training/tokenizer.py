@@ -93,7 +93,91 @@ class HFTokenizer:
 
 
 def get_tokenizer(spec: str = "byte"):
-    """"byte" or a local HF tokenizer directory path."""
+    """"byte", a trained BPE .json file, or a local HF tokenizer dir."""
     if spec == "byte":
         return ByteTokenizer()
+    if spec.endswith(".json"):
+        return BPETokenizer(spec)
     return HFTokenizer(spec)
+
+
+class BPETokenizer:
+    """Byte-level BPE trained on YOUR corpus (the `tokenizers` library
+    does the heavy lifting; this wraps it in the framework interface).
+
+    Train once with `BPETokenizer.train(files, vocab_size)`, save to a
+    single JSON file, reload anywhere with `BPETokenizer(path)`. The
+    byte-level pre-tokenizer guarantees lossless round-trips for
+    arbitrary text (no unknown tokens).
+    """
+
+    BOS_TOKEN = "<|bos|>"
+    EOS_TOKEN = "<|eos|>"
+    PAD_TOKEN = "<|pad|>"
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer
+
+        self._tok = Tokenizer.from_file(path)
+        self.vocab_size = self._tok.get_vocab_size()
+        self.bos_id = self._tok.token_to_id(self.BOS_TOKEN)
+        self.eos_id = self._tok.token_to_id(self.EOS_TOKEN)
+        self.pad_id = self._tok.token_to_id(self.PAD_TOKEN)
+        missing = [t for t, i in (
+            (self.BOS_TOKEN, self.bos_id), (self.EOS_TOKEN, self.eos_id),
+            (self.PAD_TOKEN, self.pad_id),
+        ) if i is None]
+        if missing:
+            raise ValueError(
+                f"{path} lacks the specials {missing} — not a tokenizer "
+                "trained by BPETokenizer.train (for HF tokenizer.json "
+                "files, pass the tokenizer DIRECTORY instead)"
+            )
+
+    @classmethod
+    def train(
+        cls, files: Sequence[str], vocab_size: int, out_path: str,
+    ) -> "BPETokenizer":
+        """Train byte-level BPE on text files; writes out_path (JSON)."""
+        from tokenizers import Tokenizer, decoders, models, pre_tokenizers
+        from tokenizers.trainers import BpeTrainer
+
+        tok = Tokenizer(models.BPE())
+        tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        tok.decoder = decoders.ByteLevel()
+        trainer = BpeTrainer(
+            vocab_size=vocab_size,
+            special_tokens=[cls.BOS_TOKEN, cls.EOS_TOKEN, cls.PAD_TOKEN],
+            initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        )
+        tok.train(list(files), trainer)
+        tok.save(out_path)
+        return cls(out_path)
+
+    def encode(self, text: str, *, bos: bool = False,
+               eos: bool = False) -> np.ndarray:
+        ids = self._tok.encode(text).ids
+        if bos:
+            ids = [self.bos_id] + ids
+        if eos:
+            ids = ids + [self.eos_id]
+        return np.asarray(ids, dtype=np.int32)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        specials = {self.bos_id, self.eos_id, self.pad_id}
+        return self._tok.decode(
+            [int(i) for i in np.asarray(ids).reshape(-1)
+             if int(i) not in specials]
+        )
+
+    def encode_documents(
+        self, docs: Iterable[str], *, eos_between: bool = True
+    ) -> np.ndarray:
+        parts = []
+        for d in docs:
+            parts.append(self.encode(d))
+            if eos_between:
+                parts.append(np.asarray([self.eos_id], np.int32))
+        if not parts:
+            return np.zeros((0,), np.int32)
+        return np.concatenate(parts)

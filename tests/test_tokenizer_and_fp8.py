@@ -96,3 +96,52 @@ class TestFP8:
     def test_bad_dtype_raises(self):
         with pytest.raises(ValueError, match="unsupported quantization"):
             quantize(jnp.ones((2, 4, 4)), dtype=jnp.float16)
+
+
+class TestBPETokenizer:
+    def test_train_roundtrip(self, tmp_path):
+        from shellac_tpu.training.tokenizer import BPETokenizer, get_tokenizer
+
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_text(
+            "the quick brown fox jumps over the lazy dog\n" * 50
+            + "pack my box with five dozen liquor jugs\n" * 50
+        )
+        path = str(tmp_path / "tok.json")
+        tok = BPETokenizer.train([str(corpus)], vocab_size=512,
+                                 out_path=path)
+        text = "the quick liquor fox"
+        ids = tok.encode(text)
+        assert tok.decode(ids) == text
+        # trained merges actually compress vs raw bytes
+        assert ids.size < len(text.encode())
+        # bos/eos specials resolve and strip on decode
+        ids2 = tok.encode(text, bos=True, eos=True)
+        assert ids2[0] == tok.bos_id and ids2[-1] == tok.eos_id
+        assert tok.decode(ids2) == text
+        # reload from file via the spec dispatcher
+        tok2 = get_tokenizer(path)
+        np.testing.assert_array_equal(tok2.encode(text), ids)
+
+    def test_cli_train_and_shard(self, tmp_path, capsys):
+        import json as _json
+
+        from shellac_tpu.cli import main
+
+        corpus = tmp_path / "c.txt"
+        corpus.write_text("hello world, hello tokenizer\n" * 40)
+        tokp = str(tmp_path / "tok.json")
+        shard = str(tmp_path / "s.bin")
+        rc = main([
+            "tokenize", "--input", str(corpus), "--output", shard,
+            "--tokenizer", tokp, "--train-bpe", "400",
+        ])
+        assert rc == 0
+        out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["vocab_size"] <= 400 and out["tokens"] > 0
+        # the trained tokenizer file reloads for a second encode run
+        rc = main([
+            "tokenize", "--input", str(corpus), "--output", shard,
+            "--tokenizer", tokp,
+        ])
+        assert rc == 0
