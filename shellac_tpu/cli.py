@@ -634,6 +634,12 @@ def cmd_serve(args):
         raise SystemExit("--kv-quant is dense-cache only; drop --paged")
     if args.kv_quant and args.draft_model:
         raise SystemExit("--kv-quant does not compose with --draft-model")
+    if args.rolling_window and (args.paged or args.kv_quant
+                                or args.draft_model):
+        raise SystemExit(
+            "--rolling-window is a dense-cache feature (no --paged, "
+            "--kv-quant, or --draft-model)"
+        )
 
     from shellac_tpu.parallel.distributed import initialize
 
@@ -734,6 +740,7 @@ def cmd_serve(args):
         prefill_chunk=args.prefill_chunk,
         logprobs=args.logprobs,
         kv_quant=args.kv_quant,
+        rolling_window=args.rolling_window,
     )
     return 0
 
@@ -958,6 +965,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "out to the global device count and set the "
                         "JAX_COORDINATOR_ADDRESS/JAX_NUM_PROCESSES/"
                         "JAX_PROCESS_ID env on every process)")
+    s.add_argument("--rolling-window", action="store_true",
+                   dest="rolling_window",
+                   help="ring-buffer KV cache for sliding-window models: "
+                        "cache memory scales with the window, not "
+                        "max-len")
     s.add_argument("--kv-quant", choices=["int8"], default=None,
                    dest="kv_quant",
                    help="int8 KV cache: half the cache memory and HBM "

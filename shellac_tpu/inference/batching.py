@@ -133,9 +133,25 @@ class BatchingEngine:
         logprobs: bool = False,
         mesh=None,
         kv_quant: Optional[str] = None,
+        rolling_window: bool = False,
     ):
         if kv_quant not in (None, "int8"):
             raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
+        if rolling_window:
+            if kv_quant is not None:
+                raise ValueError(
+                    "rolling_window does not compose with kv_quant yet"
+                )
+            if self._swaps_cache:
+                raise ValueError(
+                    "rolling_window is a dense-cache feature; the paged "
+                    "engine sizes memory via its block pool instead"
+                )
+            if cfg.attn_window is None:
+                raise ValueError(
+                    "rolling_window needs a sliding-window model "
+                    "(attn_window)"
+                )
         if decode_ticks < 1:
             raise ValueError(f"decode_ticks must be >= 1, got {decode_ticks}")
         if max_prefills_per_step is not None and max_prefills_per_step < 1:
@@ -221,7 +237,14 @@ class BatchingEngine:
         # greedy outputs may differ from the bf16 cache by the int8
         # rounding (~1e-3 relative on logits).
         self.kv_quant = kv_quant
-        self._cache = init_cache_for(cfg, n_slots, self.max_len, kv_quant)
+        self.rolling_window = rolling_window
+        # Chunked-prefill continuations READ the ring before their own
+        # rows age out; the ring carries that chunk as slack.
+        self._chunk_slack = prefill_chunk or 1
+        self._cache = init_cache_for(
+            cfg, n_slots, self.max_len, kv_quant,
+            rolling=rolling_window, chunk_slack=self._chunk_slack,
+        )
         self._cur = jnp.zeros((n_slots,), jnp.int32)  # next input token
         self._queue: deque[_Request] = deque()
         self._slots: List[Optional[_Request]] = [None] * n_slots
@@ -264,10 +287,17 @@ class BatchingEngine:
         if self.mesh is None:
             self._cache_sh = None
             return
+        from shellac_tpu.inference.kvcache import (
+            RollingKVCache,
+            rolling_cache_logical_axes,
+        )
+
         if isinstance(self._cache, PagedKVCache):
             axes = paged_cache_logical_axes(self.cfg)
         elif isinstance(self._cache, QuantKVCache):
             axes = quant_cache_logical_axes(self.cfg)
+        elif isinstance(self._cache, RollingKVCache):
+            axes = rolling_cache_logical_axes(self.cfg)
         else:
             axes = cache_logical_axes(self.cfg)
         self._cache_sh = make_shardings(self.mesh, axes)
@@ -285,7 +315,10 @@ class BatchingEngine:
 
     def _fresh_mini(self, length: int):
         """Batch-1 cache of the engine's cache type (prefill scratch)."""
-        return init_cache_for(self.cfg, 1, length, self.kv_quant)
+        return init_cache_for(
+            self.cfg, 1, length, self.kv_quant,
+            rolling=self.rolling_window, chunk_slack=self._chunk_slack,
+        )
 
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
                       samp):

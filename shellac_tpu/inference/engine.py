@@ -74,13 +74,23 @@ class Engine:
         repetition_penalty: float = 1.0,
         mesh=None,
         kv_quant: Optional[str] = None,
+        rolling_window: bool = False,
     ):
         if kv_quant not in (None, "int8"):
             raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
+        if rolling_window and kv_quant is not None:
+            raise ValueError(
+                "rolling_window does not compose with kv_quant yet"
+            )
+        if rolling_window and cfg.attn_window is None:
+            raise ValueError(
+                "rolling_window needs a sliding-window model (attn_window)"
+            )
         self.cfg = cfg
         self.params = params
         self.mesh = mesh
         self.kv_quant = kv_quant
+        self.rolling_window = rolling_window
         self.max_len = max_len or cfg.max_seq_len
         self.repetition_penalty = repetition_penalty
         self._sampler = functools.partial(
@@ -92,8 +102,15 @@ class Engine:
         else:
             # Pin the cache layout at the prefill boundary; decode then
             # inherits it from its (committed) cache argument.
-            axes = (quant_cache_logical_axes(cfg) if kv_quant
-                    else cache_logical_axes(cfg))
+            if rolling_window:
+                from shellac_tpu.inference.kvcache import (
+                    rolling_cache_logical_axes,
+                )
+
+                axes = rolling_cache_logical_axes(cfg)
+            else:
+                axes = (quant_cache_logical_axes(cfg) if kv_quant
+                        else cache_logical_axes(cfg))
             cache_sh = make_shardings(mesh, axes)
             self._prefill = jax.jit(
                 self._prefill_impl, out_shardings=(None, cache_sh, None)
@@ -103,7 +120,8 @@ class Engine:
     def _prefill_impl(self, params, tokens, prompt_len):
         """tokens: (B, S_pad) right-padded; prompt_len: (B,) real lengths."""
         b, s = tokens.shape
-        cache = init_cache_for(self.cfg, b, self.max_len, self.kv_quant)
+        cache = init_cache_for(self.cfg, b, self.max_len, self.kv_quant,
+                               rolling=self.rolling_window)
         logits, cache = transformer.forward_with_cache(
             self.cfg, params, tokens, cache, new_tokens_len=prompt_len,
             mesh=self.mesh, fresh_cache=True, attn_impl="auto",

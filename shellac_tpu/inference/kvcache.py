@@ -121,8 +121,17 @@ def quant_cache_logical_axes(cfg: Optional[ModelConfig] = None):
 
 
 def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
-                   kv_quant=None):
-    """The engines' cache constructor: dense bf16 or int8 by kv_quant."""
+                   kv_quant=None, rolling: bool = False,
+                   chunk_slack: int = 1):
+    """The engines' cache constructor: dense bf16, int8, or a rolling
+    ring buffer (sliding-window models) by flags."""
+    if rolling:
+        if kv_quant is not None:
+            raise ValueError(
+                "rolling cache does not compose with kv_quant yet"
+            )
+        return init_rolling_cache(cfg, batch, max_len,
+                                  chunk_slack=chunk_slack)
     if kv_quant == "int8":
         return init_quant_cache(cfg, batch, max_len)
     if kv_quant is not None:
@@ -342,3 +351,141 @@ def paged_gather_layer(
         return x.reshape(b, hkv, mb * bs, dh)
 
     return gather(pool_k), gather(pool_v)
+
+
+# ---------------------------------------------------------------------------
+# Rolling (ring-buffer) cache for sliding-window attention
+# ---------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class RollingKVCache:
+    """Ring-buffer KV cache: storage scales with the WINDOW, not the
+    context.
+
+    A sliding-window layer only ever attends the last `window`
+    positions, so position p lives at ring slot p % ring and old
+    positions are overwritten in place. `lengths` still counts TOTAL
+    positions seen (the position arithmetic is identical to the dense
+    cache); only the storage wraps. ring must be >= window + the
+    largest cache-READING write chunk (decode writes 1; chunked-prefill
+    continuations write up to prefill_chunk) — the extra slack keeps a
+    chunk's EARLIEST query row's window intact while the chunk's own
+    writes land. Fresh prefill attends the incoming chunk directly
+    (never the buffer), so whole-prompt prefill needs no slack.
+
+    Same head-major (L, B, Hkv, ring, Dh) layout as KVCache. Reads go
+    through the reference attention with reconstructed per-slot
+    positions — the ring is window-sized, so the Pallas decode kernel's
+    dead-block skipping (its reason to exist on a max_len buffer) has
+    nothing left to skip.
+    """
+
+    k: Any  # (L, B, Hkv, ring, Dh)
+    v: Any  # (L, B, Hkv, ring, Dh)
+    lengths: Any  # (B,) int32 — TOTAL positions seen
+
+    @property
+    def ring(self) -> int:
+        return self.k.shape[3]
+
+
+def rolling_ring(cfg: ModelConfig, max_len: int, chunk_slack: int) -> int:
+    """Ring size for a config: window + slack, sublane-rounded, capped
+    at max_len (a ring bigger than the context is just a dense cache)."""
+    if cfg.attn_window is None:
+        raise ValueError("rolling cache needs cfg.attn_window")
+    ring = cfg.attn_window + max(int(chunk_slack), 1)
+    ring = ((ring + 7) // 8) * 8
+    return min(ring, max_len)
+
+
+def init_rolling_cache(
+    cfg: ModelConfig, batch: int, max_len: int, chunk_slack: int = 1,
+) -> RollingKVCache:
+    if cfg.mla is not None:
+        raise ValueError("MLA models have no sliding window to roll")
+    if cfg.attn_window is None:
+        raise ValueError(
+            "rolling cache needs a sliding-window model (attn_window)"
+        )
+    if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
+        raise NotImplementedError(
+            "rolling cache currently covers uniformly-windowed models; "
+            "patterned local/global stacks still use the dense cache "
+            "for every layer"
+        )
+    ring = rolling_ring(cfg, max_len, chunk_slack)
+    head = (cfg.n_layers, batch, cfg.cache_kv_heads, ring)
+    return RollingKVCache(
+        k=jnp.zeros((*head, cfg.cache_head_dim), cfg.compute_dtype),
+        v=jnp.zeros((*head, cfg.cache_head_dim), cfg.compute_dtype),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def rolling_cache_logical_axes(cfg: Optional[ModelConfig] = None):
+    return RollingKVCache(
+        k=("layers", "batch", "kv_heads", None, None),
+        v=("layers", "batch", "kv_heads", None, None),
+        lengths=("batch",),
+    )
+
+
+def roll_update_layer(
+    cache_k: jax.Array,  # (B, Hkv, ring, Dh) — one layer's ring
+    cache_v: jax.Array,
+    k_new: jax.Array,  # (B, S, Hkv, Dh)
+    v_new: jax.Array,
+    index: jax.Array,  # (B,) int32 — first new position (total count)
+    valid_len=None,  # (B,) int32 — REAL rows in the chunk (None = S)
+):
+    """Write the chunk's REAL positions into the ring at
+    (index + i) % ring.
+
+    valid_len masks right-padding: the dense cache can write pad rows
+    harmlessly (reads mask by lengths), but a ring write WRAPS — a pad
+    row landing at (index + i) % ring would clobber an in-window
+    position, so pad rows must never touch the buffer.
+
+    S == 1 (decode) is a plain per-row scatter. For larger chunks the
+    write is LAST-WINS per slot, computed by gather-select (a naive
+    scatter with duplicate ring indices has unspecified order): ring
+    slot j's newest VALID chunk element is c_j = (cm - (cm - j) % ring)
+    - index with cm the final real position; slots no valid element
+    maps to keep their current rows.
+    """
+    ring = cache_k.shape[2]
+    b, s = k_new.shape[:2]
+    kn = k_new.astype(cache_k.dtype).transpose(0, 2, 1, 3)  # (B,Hkv,S,Dh)
+    vn = v_new.astype(cache_v.dtype).transpose(0, 2, 1, 3)
+    if s == 1 and valid_len is None:
+        slot = (index % ring).astype(jnp.int32)
+        barange = jnp.arange(b)
+        ck = cache_k.at[barange, :, slot].set(kn[:, :, 0])
+        cv = cache_v.at[barange, :, slot].set(vn[:, :, 0])
+        return ck, cv
+    vl = (jnp.full((b,), s, jnp.int32) if valid_len is None
+          else jnp.minimum(valid_len.astype(jnp.int32), s))
+    cm = index + vl - 1  # (B,) — final REAL position
+    j = jnp.arange(ring, dtype=jnp.int32)[None, :]  # (1, ring)
+    p = cm[:, None] - ((cm[:, None] - j) % ring)  # newest position per slot
+    c = p - index[:, None]  # chunk element index
+    valid = (c >= 0) & (c < vl[:, None])
+    c_clamped = jnp.clip(c, 0, s - 1)
+    take = jnp.take_along_axis(
+        kn, c_clamped[:, None, :, None], axis=2
+    )  # (B, Hkv, ring, Dh)
+    ck = jnp.where(valid[:, None, :, None], take, cache_k)
+    take_v = jnp.take_along_axis(vn, c_clamped[:, None, :, None], axis=2)
+    cv = jnp.where(valid[:, None, :, None], take_v, cache_v)
+    return ck, cv
+
+
+def rolled_kv_positions(lengths: jax.Array, ring: int):
+    """(kv_positions (B, ring) int32, kv_mask (B, ring) bool) for a ring
+    whose newest written position is lengths - 1 (post-write)."""
+    cm = lengths.astype(jnp.int32)[:, None] - 1  # (B, 1)
+    j = jnp.arange(ring, dtype=jnp.int32)[None, :]
+    p = cm - ((cm - j) % ring)
+    return p, p >= 0

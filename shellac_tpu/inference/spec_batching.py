@@ -55,6 +55,12 @@ class SpeculativeBatchingEngine(BatchingEngine):
         gamma: int = 4,
         **kw,
     ):
+        if kw.get("rolling_window"):
+            raise ValueError(
+                "speculative batching does not support rolling_window: "
+                "the verify round re-reads positions a ring may have "
+                "already evicted mid-round"
+            )
         if cfg.vocab_size != draft_cfg.vocab_size:
             raise ValueError(
                 f"target/draft vocab mismatch: {cfg.vocab_size} vs "

@@ -427,7 +427,8 @@ def _zero_aux():
 def _block(
     cfg: ModelConfig, mesh, attn_impl: str, x, lp, cos, sin, cache=None,
     fresh_cache: bool = False, segments=None, page_tables=None,
-    moe_layer=None, kv_scales=None, attn_kind=None,
+    moe_layer=None, kv_scales=None, attn_kind=None, rolled=False,
+    new_len=None,
 ):
     """One pre-norm transformer block. x: (B, S, D) in compute dtype.
 
@@ -541,6 +542,33 @@ def _block(
                 window=window, impl=attn_impl,
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
                 sinks=sinks, k_scale=ks_l, v_scale=vs_l,
+            )
+    elif rolled:
+        from shellac_tpu.inference.kvcache import roll_update_layer
+        from shellac_tpu.ops.decode_attention import (
+            rolled_decode_attention,
+        )
+
+        cache_k, cache_v, index, q_positions = cache  # ring buffers
+        cache_k, cache_v = roll_update_layer(
+            cache_k, cache_v, k, v, index, valid_len=new_len
+        )
+        new_cache = (cache_k, cache_v)
+        if fresh_cache:
+            # Whole-prompt prefill attends the incoming chunk itself
+            # (identical to the dense path); the ring only matters for
+            # later reads.
+            o = attention(
+                q, k, v, causal=True, window=window, impl=attn_impl,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+                sinks=sinks,
+            )
+        else:
+            vl = s if new_len is None else new_len
+            o = rolled_decode_attention(
+                q, cache_k, cache_v, index, index + vl, window=window,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+                sinks=sinks,
             )
     else:
         from shellac_tpu.inference.kvcache import update_layer
@@ -1267,7 +1295,11 @@ def forward_with_cache(
     the incoming chunk instead of over the max_len buffer — quadratic
     not rectangular, and flash-eligible via attn_impl="auto".
     """
-    from shellac_tpu.inference.kvcache import PagedKVCache, QuantKVCache
+    from shellac_tpu.inference.kvcache import (
+        PagedKVCache,
+        QuantKVCache,
+        RollingKVCache,
+    )
 
     if not cfg.causal:
         raise ValueError(
@@ -1275,6 +1307,9 @@ def forward_with_cache(
         )
     paged = isinstance(cache, PagedKVCache)
     quant = isinstance(cache, QuantKVCache)
+    rolled = isinstance(cache, RollingKVCache)
+    if rolled and cfg.attn_window is None:
+        raise ValueError("rolling cache on a model without attn_window")
     cdt = cfg.compute_dtype
     b, s = tokens.shape
     index = cache.lengths  # (B,)
@@ -1303,7 +1338,7 @@ def forward_with_cache(
             cos_l if local else cos, sin_l if local else sin,
             cache=(ck, cv, index, positions), fresh_cache=fresh_cache,
             page_tables=tables, moe_layer=moe_flag, kv_scales=scales,
-            attn_kind=attn_kind,
+            attn_kind=attn_kind, rolled=rolled, new_len=new_tokens_len,
         )
 
     def pattern_scan(x, layer_stack, caches, body_one):

@@ -883,3 +883,41 @@ def paged_decode_attention(
     k_all, v_all = paged_gather_layer(pool_k, pool_v, tables)
     return _decode_ref(q, k_all, v_all, index, window, scale, softcap=softcap,
                        sinks=sinks)
+
+
+def rolled_decode_attention(
+    q, cache_k, cache_v, start, lengths_after, *,
+    window: int,
+    scale: Optional[float] = None,
+    softcap: Optional[float] = None,
+    sinks=None,
+):
+    """Attention of q (B, s, H, D) against a RING buffer
+    (B, Hkv, ring, D) whose newest position is lengths_after - 1 (the
+    chunk was already written). q row j sits at position start + j —
+    padded chunks put their REAL rows first, so the start anchors the
+    q positions (rows past lengths_after - start are padding whose
+    outputs the caller discards).
+
+    Per-slot positions are reconstructed from the ring arithmetic and
+    fed to the reference attention — the ring is window-sized, so the
+    Pallas decode kernels' dead-block skipping has nothing to win here
+    and the masked reference over O(window) keys IS the fast path.
+    """
+    from shellac_tpu.inference.kvcache import rolled_kv_positions
+
+    b, s = q.shape[:2]
+    ring = cache_k.shape[2]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    kv_pos, kv_mask = rolled_kv_positions(lengths_after, ring)
+    q_pos = start.astype(jnp.int32)[:, None] + jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.int32), (b, s)
+    )
+    return attention_ref(
+        q, cache_k.transpose(0, 2, 1, 3).astype(q.dtype),
+        cache_v.transpose(0, 2, 1, 3).astype(q.dtype),
+        causal=True, window=window, scale=scale, softcap=softcap,
+        sinks=sinks,
+        q_positions=q_pos, kv_positions=kv_pos, kv_mask=kv_mask,
+    )

@@ -1,0 +1,191 @@
+"""Rolling (ring-buffer) KV cache for sliding-window models.
+
+The serving contract: a ring of window + chunk-slack rows must produce
+BIT-IDENTICAL greedy output to the dense max_len cache — the ring is a
+storage optimization, never a numerics change. Tests run well past the
+ring-wrap point so eviction actually happens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference.batching import BatchingEngine
+from shellac_tpu.inference.engine import Engine
+from shellac_tpu.inference.kvcache import (
+    init_cache,
+    init_rolling_cache,
+    roll_update_layer,
+    rolled_kv_positions,
+)
+from shellac_tpu.models.transformer import forward_with_cache, init_params
+
+
+def _cfg(**kw):
+    base = dict(
+        vocab_size=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        max_seq_len=256, attn_window=8, dtype="float32", remat=False,
+    )
+    base.update(kw)
+    return ModelConfig(**base).validate()
+
+
+def test_ring_math():
+    """rolled_kv_positions reconstructs the newest occupant of every
+    slot; unwritten slots are masked."""
+    pos, mask = rolled_kv_positions(jnp.asarray([3, 20]), ring=8)
+    pos, mask = np.asarray(pos), np.asarray(mask)
+    # lengths=3: positions 0,1,2 live at slots 0,1,2; rest unwritten.
+    assert pos[0, :3].tolist() == [0, 1, 2]
+    assert mask[0].tolist() == [True] * 3 + [False] * 5
+    # lengths=20 (newest 19): slot j holds the largest p<=19, p%8==j.
+    assert pos[1].tolist() == [16, 17, 18, 19, 12, 13, 14, 15]
+    assert mask[1].all()
+
+
+def test_roll_update_last_wins():
+    """A chunk longer than the ring leaves exactly the newest occupant
+    in every slot (scatter order must not matter)."""
+    b, hkv, ring, d, s = 1, 2, 8, 4, 20
+    ck = jnp.zeros((b, hkv, ring, d))
+    cv = jnp.zeros((b, hkv, ring, d))
+    k_new = jnp.broadcast_to(
+        jnp.arange(s, dtype=jnp.float32)[None, :, None, None],
+        (b, s, hkv, d),
+    )
+    ck2, _ = roll_update_layer(ck, cv, k_new, k_new, jnp.asarray([0]))
+    got = np.asarray(ck2[0, 0, :, 0])
+    # position p lands at p % 8; newest occupant of slot j is the
+    # largest p < 20 with p % 8 == j.
+    expect = [16, 17, 18, 19, 12, 13, 14, 15]
+    assert got.tolist() == expect
+
+
+def test_forward_with_cache_parity_through_wrap():
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 48), 0, 128)
+    dense = init_cache(cfg, 2, 128)
+    roll = init_rolling_cache(cfg, 2, 128)
+    assert roll.ring < 128
+    ld, dense = forward_with_cache(
+        cfg, params, toks[:, :16], dense, fresh_cache=True, attn_impl="ref"
+    )
+    lr, roll = forward_with_cache(
+        cfg, params, toks[:, :16], roll, fresh_cache=True, attn_impl="ref"
+    )
+    np.testing.assert_array_equal(np.asarray(ld), np.asarray(lr))
+    for t in range(16, 48):
+        ld, dense = forward_with_cache(
+            cfg, params, toks[:, t:t + 1], dense, attn_impl="ref"
+        )
+        lr, roll = forward_with_cache(
+            cfg, params, toks[:, t:t + 1], roll, attn_impl="ref"
+        )
+        np.testing.assert_allclose(
+            np.asarray(ld), np.asarray(lr), atol=1e-5
+        )
+
+
+def test_engine_greedy_bit_parity():
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 128, (2, 12)), jnp.int32
+    )
+    dense = Engine(cfg, params, temperature=0.0, max_len=128).generate(
+        prompt, max_new_tokens=40
+    )
+    roll = Engine(
+        cfg, params, temperature=0.0, max_len=128, rolling_window=True
+    ).generate(prompt, max_new_tokens=40)
+    np.testing.assert_array_equal(
+        np.asarray(dense.tokens), np.asarray(roll.tokens)
+    )
+
+
+def test_batching_bit_parity_with_churn():
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def run(**kw):
+        eng = BatchingEngine(
+            cfg, params, n_slots=2, max_len=128, temperature=0.0, **kw
+        )
+        # Sizes 17-19 bucket to 32 > ring(16): the padded prefill
+        # write WRAPS, the regime where unmasked pad rows would clobber
+        # in-window positions.
+        for i, size in enumerate([17, 19, 7, 18, 4]):
+            rng = np.random.RandomState(i)
+            eng.submit(i, rng.randint(0, 128, size), 40)
+        done = {}
+        while len(done) < 5:
+            done.update(eng.step())
+        return done
+
+    assert run() == run(rolling_window=True)
+
+
+def test_chunked_prefill_parity():
+    """Continuation chunks READ the ring; the prefill_chunk slack must
+    keep the earliest chunk row's window intact."""
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def run(**kw):
+        # prefill_chunk=12 buckets to 16 > the chunk itself: padded
+        # continuation writes must mask their pad tail too.
+        eng = BatchingEngine(
+            cfg, params, n_slots=2, max_len=160, temperature=0.0,
+            prefill_chunk=12, **kw
+        )
+        rng = np.random.RandomState(3)
+        for i in range(3):
+            eng.submit(i, rng.randint(0, 128, 50), 20)
+        done = {}
+        while len(done) < 3:
+            done.update(eng.step())
+        return done
+
+    assert run() == run(rolling_window=True)
+
+
+def test_gptoss_sinks_on_rolling():
+    """Sinks + softmax_topk MoE + uniform window on the ring: the
+    rolled read path must apply sink logits identically."""
+    from shellac_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-gptoss").replace(
+        dtype="float32", attn_pattern=None,  # uniform window
+    )
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params["layers"]["sinks"] = jax.random.normal(
+        jax.random.PRNGKey(7), params["layers"]["sinks"].shape
+    ) * 2.0
+    prompt = jnp.asarray([[5, 9, 2, 31]], jnp.int32)
+    dense = Engine(cfg, params, temperature=0.0, max_len=96).generate(
+        prompt, max_new_tokens=30
+    )
+    roll = Engine(
+        cfg, params, temperature=0.0, max_len=96, rolling_window=True
+    ).generate(prompt, max_new_tokens=30)
+    np.testing.assert_array_equal(
+        np.asarray(dense.tokens), np.asarray(roll.tokens)
+    )
+
+
+def test_guards():
+    cfg = _cfg(attn_window=None)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="attn_window"):
+        Engine(cfg, params, rolling_window=True)
+    cfg_w = _cfg()
+    params_w = init_params(cfg_w, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="kv_quant"):
+        Engine(cfg_w, params_w, rolling_window=True, kv_quant="int8")
+    with pytest.raises(NotImplementedError, match="patterned"):
+        init_rolling_cache(
+            _cfg(attn_pattern=("window", "full"), n_layers=2), 1, 64
+        )
