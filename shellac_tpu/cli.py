@@ -705,7 +705,8 @@ def cmd_serve(args):
         )
 
         kind = PagedBatchingEngine if args.paged else BatchingEngine
-        extra = ({"prefix_cache": args.prefix_cache} if args.paged else {})
+        extra = ({"prefix_cache": args.prefix_cache} if args.paged
+                 else {"rolling_window": args.rolling_window})
         engine = kind(
             cfg, params, n_slots=args.slots,
             max_len=args.max_len or cfg.max_seq_len,

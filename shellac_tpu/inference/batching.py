@@ -288,7 +288,9 @@ class BatchingEngine:
             self._cache_sh = None
             return
         from shellac_tpu.inference.kvcache import (
+            PatternedKVCache,
             RollingKVCache,
+            patterned_cache_logical_axes,
             rolling_cache_logical_axes,
         )
 
@@ -298,6 +300,8 @@ class BatchingEngine:
             axes = quant_cache_logical_axes(self.cfg)
         elif isinstance(self._cache, RollingKVCache):
             axes = rolling_cache_logical_axes(self.cfg)
+        elif isinstance(self._cache, PatternedKVCache):
+            axes = patterned_cache_logical_axes(self.cfg)
         else:
             axes = cache_logical_axes(self.cfg)
         self._cache_sh = make_shardings(self.mesh, axes)

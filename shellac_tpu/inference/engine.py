@@ -102,15 +102,13 @@ class Engine:
         else:
             # Pin the cache layout at the prefill boundary; decode then
             # inherits it from its (committed) cache argument.
-            if rolling_window:
-                from shellac_tpu.inference.kvcache import (
-                    rolling_cache_logical_axes,
-                )
+            from shellac_tpu.inference.kvcache import (
+                cache_logical_axes_for,
+            )
 
-                axes = rolling_cache_logical_axes(cfg)
-            else:
-                axes = (quant_cache_logical_axes(cfg) if kv_quant
-                        else cache_logical_axes(cfg))
+            axes = cache_logical_axes_for(
+                cfg, kv_quant, rolling=rolling_window
+            )
             cache_sh = make_shardings(mesh, axes)
             self._prefill = jax.jit(
                 self._prefill_impl, out_shardings=(None, cache_sh, None)

@@ -130,6 +130,9 @@ def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
             raise ValueError(
                 "rolling cache does not compose with kv_quant yet"
             )
+        if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
+            return init_patterned_cache(cfg, batch, max_len,
+                                        chunk_slack=chunk_slack)
         return init_rolling_cache(cfg, batch, max_len,
                                   chunk_slack=chunk_slack)
     if kv_quant == "int8":
@@ -137,6 +140,20 @@ def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
     if kv_quant is not None:
         raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
     return init_cache(cfg, batch, max_len)
+
+
+def cache_logical_axes_for(cfg: ModelConfig, kv_quant=None,
+                           rolling: bool = False):
+    """Logical axes matching what init_cache_for builds for the same
+    flags — the single place the cache-kind dispatch lives, so jit
+    out_shardings can never desync from the cache pytree."""
+    if rolling:
+        if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
+            return patterned_cache_logical_axes(cfg)
+        return rolling_cache_logical_axes(cfg)
+    if kv_quant == "int8":
+        return quant_cache_logical_axes(cfg)
+    return cache_logical_axes(cfg)
 
 
 def quantize_kv(x: jax.Array):
@@ -222,11 +239,16 @@ def scatter_slot(cache, mini, slot):
     def upd(c, n):
         return jax.lax.dynamic_update_slice_in_dim(c, n, slot, axis=1)
 
-    fields = {"k": upd(cache.k, mini.k), "v": upd(cache.v, mini.v),
-              "lengths": jax.lax.dynamic_update_slice(
-                  cache.lengths, mini.lengths, (slot,))}
-    if isinstance(cache, QuantKVCache):
-        fields.update(ks=upd(cache.ks, mini.ks), vs=upd(cache.vs, mini.vs))
+    if isinstance(cache, PatternedKVCache):
+        fields = {n: upd(getattr(cache, n), getattr(mini, n))
+                  for n in ("kw", "vw", "kf", "vf")}
+    else:
+        fields = {"k": upd(cache.k, mini.k), "v": upd(cache.v, mini.v)}
+        if isinstance(cache, QuantKVCache):
+            fields.update(ks=upd(cache.ks, mini.ks),
+                          vs=upd(cache.vs, mini.vs))
+    fields["lengths"] = jax.lax.dynamic_update_slice(
+        cache.lengths, mini.lengths, (slot,))
     return cache.replace(**fields)
 
 
@@ -238,10 +260,14 @@ def slot_view(cache, slot, lengths):
     def sl(c):
         return jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
 
-    fields = {"k": sl(cache.k), "v": sl(cache.v),
-              "lengths": lengths.astype(jnp.int32)}
-    if isinstance(cache, QuantKVCache):
-        fields.update(ks=sl(cache.ks), vs=sl(cache.vs))
+    if isinstance(cache, PatternedKVCache):
+        fields = {n: sl(getattr(cache, n))
+                  for n in ("kw", "vw", "kf", "vf")}
+    else:
+        fields = {"k": sl(cache.k), "v": sl(cache.v)}
+        if isinstance(cache, QuantKVCache):
+            fields.update(ks=sl(cache.ks), vs=sl(cache.vs))
+    fields["lengths"] = lengths.astype(jnp.int32)
     return cache.replace(**fields)
 
 
@@ -489,3 +515,77 @@ def rolled_kv_positions(lengths: jax.Array, ring: int):
     j = jnp.arange(ring, dtype=jnp.int32)[None, :]
     p = cm - ((cm - j) % ring)
     return p, p >= 0
+
+
+# ---------------------------------------------------------------------------
+# Patterned cache: ring buffers for window layers, dense for full layers
+# ---------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class PatternedKVCache:
+    """Mixed cache for attn_pattern models: the "window" layers roll in
+    ring buffers while the "full" layers keep the dense max_len stack —
+    so a Gemma-2/GPT-OSS-style half-local stack cuts its cache memory
+    roughly in half at long context (and far more as max_len grows).
+
+    Layer i of kind "window" is row (number of window layers before i)
+    of the kw/vw stacks; "full" layers index kf/vf the same way. The
+    stacking order inside each kind follows layer order, so the
+    pattern-period reshape in forward_with_cache stays a pure
+    view + static in-group indexing.
+    """
+
+    kw: Any  # (Lw, B, Hkv, ring, Dh)
+    vw: Any
+    kf: Any  # (Lf, B, Hkv, max_len, Dh)
+    vf: Any
+    lengths: Any  # (B,) int32 — TOTAL positions (shared by both kinds)
+
+    @property
+    def ring(self) -> int:
+        return self.kw.shape[3]
+
+    @property
+    def dense_len(self) -> int:
+        return self.kf.shape[3]
+
+
+def pattern_kind_counts(cfg: ModelConfig):
+    """(n_window, n_full) per pattern period."""
+    pat = cfg.attn_pattern
+    nw = sum(1 for k in pat if k == "window")
+    return nw, len(pat) - nw
+
+
+def init_patterned_cache(
+    cfg: ModelConfig, batch: int, max_len: int, chunk_slack: int = 1,
+) -> PatternedKVCache:
+    if cfg.attn_pattern is None or "window" not in cfg.attn_pattern:
+        raise ValueError(
+            "patterned cache needs an attn_pattern with 'window' layers"
+        )
+    if "full" not in cfg.attn_pattern:
+        raise ValueError(
+            "uniformly-windowed patterns use the plain rolling cache"
+        )
+    ring = rolling_ring(cfg, max_len, chunk_slack)
+    nw, nf = pattern_kind_counts(cfg)
+    groups = cfg.n_layers // len(cfg.attn_pattern)
+    cdt = cfg.compute_dtype
+    dh = cfg.cache_head_dim
+    hkv = cfg.cache_kv_heads
+    return PatternedKVCache(
+        kw=jnp.zeros((groups * nw, batch, hkv, ring, dh), cdt),
+        vw=jnp.zeros((groups * nw, batch, hkv, ring, dh), cdt),
+        kf=jnp.zeros((groups * nf, batch, hkv, max_len, dh), cdt),
+        vf=jnp.zeros((groups * nf, batch, hkv, max_len, dh), cdt),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def patterned_cache_logical_axes(cfg: Optional[ModelConfig] = None):
+    ax = ("layers", "batch", "kv_heads", None, None)
+    return PatternedKVCache(
+        kw=ax, vw=ax, kf=ax, vf=ax, lengths=("batch",),
+    )

@@ -1297,6 +1297,7 @@ def forward_with_cache(
     """
     from shellac_tpu.inference.kvcache import (
         PagedKVCache,
+        PatternedKVCache,
         QuantKVCache,
         RollingKVCache,
     )
@@ -1308,8 +1309,11 @@ def forward_with_cache(
     paged = isinstance(cache, PagedKVCache)
     quant = isinstance(cache, QuantKVCache)
     rolled = isinstance(cache, RollingKVCache)
-    if rolled and cfg.attn_window is None:
+    mixed = isinstance(cache, PatternedKVCache)
+    if (rolled or mixed) and cfg.attn_window is None:
         raise ValueError("rolling cache on a model without attn_window")
+    if mixed and cfg.attn_pattern is None:
+        raise ValueError("patterned cache on a model without attn_pattern")
     cdt = cfg.compute_dtype
     b, s = tokens.shape
     index = cache.lengths  # (B,)
@@ -1331,14 +1335,17 @@ def forward_with_cache(
 
     tables = cache.tables if paged else None
 
-    def run_block(x, lp, ck, cv, moe_flag, scales=None, attn_kind=None):
+    def run_block(x, lp, ck, cv, moe_flag, scales=None, attn_kind=None,
+                  block_rolled=None):
         local = cos_l is not None and attn_kind == "window"
         return _block(
             cfg, mesh, attn_impl, x, lp,
             cos_l if local else cos, sin_l if local else sin,
             cache=(ck, cv, index, positions), fresh_cache=fresh_cache,
             page_tables=tables, moe_layer=moe_flag, kv_scales=scales,
-            attn_kind=attn_kind, rolled=rolled, new_len=new_tokens_len,
+            attn_kind=attn_kind,
+            rolled=rolled if block_rolled is None else block_rolled,
+            new_len=new_tokens_len,
         )
 
     def pattern_scan(x, layer_stack, caches, body_one):
@@ -1455,6 +1462,57 @@ def forward_with_cache(
         )
         new_k = nk.reshape(cfg.n_layers, *cache.k.shape[1:])
         new_v = nv.reshape(cfg.n_layers, *cache.v.shape[1:])
+    elif mixed:
+        # Mixed ring/dense stacks: the scan walks pattern periods with
+        # per-kind cursors — "window" blocks consume ring rows (rolled
+        # update + rolled read), "full" blocks consume dense rows (the
+        # Pallas decode kernel path, unchanged).
+        from shellac_tpu.inference.kvcache import pattern_kind_counts
+
+        period = len(cfg.attn_pattern)
+        ng = cfg.n_layers // period
+        nw, nf = pattern_kind_counts(cfg)
+        greshape = lambda a, n: a.reshape(ng, n, *a.shape[1:])  # noqa: E731
+        glp = jax.tree.map(
+            lambda a: a.reshape(ng, period, *a.shape[1:]),
+            params["layers"],
+        )
+        gkw = greshape(cache.kw, nw)
+        gvw = greshape(cache.vw, nw)
+        gkf = greshape(cache.kf, nf)
+        gvf = greshape(cache.vf, nf)
+
+        def group_body(x, inp):
+            gl, kw_g, vw_g, kf_g, vf_g = inp
+            nkw, nvw, nkf, nvf = [], [], [], []
+            iw = iff = 0
+            for i, kind in enumerate(cfg.attn_pattern):
+                lp_i = jax.tree.map(lambda a, i=i: a[i], gl)
+                if kind == "window":
+                    x, (nk, nv), _ = run_block(
+                        x, lp_i, kw_g[iw], vw_g[iw], None,
+                        attn_kind=kind, block_rolled=True,
+                    )
+                    nkw.append(nk)
+                    nvw.append(nv)
+                    iw += 1
+                else:
+                    x, (nk, nv), _ = run_block(
+                        x, lp_i, kf_g[iff], vf_g[iff], None,
+                        attn_kind=kind, block_rolled=False,
+                    )
+                    nkf.append(nk)
+                    nvf.append(nv)
+                    iff += 1
+            return x, (jnp.stack(nkw), jnp.stack(nvw),
+                       jnp.stack(nkf), jnp.stack(nvf))
+
+        x, (nkw, nvw, nkf, nvf) = jax.lax.scan(
+            group_body, x, (glp, gkw, gvw, gkf, gvf)
+        )
+        backflat = lambda a: a.reshape(-1, *a.shape[2:])  # noqa: E731
+        new_kw, new_vw = backflat(nkw), backflat(nvw)
+        new_kf, new_vf = backflat(nkf), backflat(nvf)
     elif cfg.attn_pattern is not None:
         def body_one(x, lp, cs, kind):
             ck, cv = cs
@@ -1489,6 +1547,11 @@ def forward_with_cache(
     if quant:
         new_cache = cache.replace(
             k=new_k, v=new_v, ks=new_ks, vs=new_vs, lengths=new_lengths
+        )
+    elif mixed:
+        new_cache = cache.replace(
+            kw=new_kw, vw=new_vw, kf=new_kf, vf=new_vf,
+            lengths=new_lengths,
         )
     else:
         new_cache = cache.replace(k=new_k, v=new_v, lengths=new_lengths)

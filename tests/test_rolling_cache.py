@@ -189,3 +189,122 @@ def test_guards():
         init_rolling_cache(
             _cfg(attn_pattern=("window", "full"), n_layers=2), 1, 64
         )
+
+
+def test_patterned_mixed_cache_parity():
+    """Gemma-2-style pattern: window layers roll in rings, full layers
+    keep the dense stack — bit-parity with the all-dense cache through
+    ring wrap, via the Engine."""
+    from shellac_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-gemma2").replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(0, 256, (2, 19)), jnp.int32
+    )
+    dense = Engine(cfg, params, temperature=0.0, max_len=128).generate(
+        prompt, max_new_tokens=40
+    )
+    roll = Engine(
+        cfg, params, temperature=0.0, max_len=128, rolling_window=True
+    ).generate(prompt, max_new_tokens=40)
+    np.testing.assert_array_equal(
+        np.asarray(dense.tokens), np.asarray(roll.tokens)
+    )
+
+
+def test_patterned_gptoss_batching_parity():
+    """GPT-OSS default (patterned, sinks, softmax_topk MoE) through the
+    batching engine with slot churn and pad buckets wider than the
+    ring."""
+    from shellac_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-gptoss").replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params["layers"]["sinks"] = jax.random.normal(
+        jax.random.PRNGKey(7), params["layers"]["sinks"].shape
+    ) * 2.0
+
+    def run(**kw):
+        eng = BatchingEngine(
+            cfg, params, n_slots=2, max_len=128, temperature=0.0, **kw
+        )
+        for i, size in enumerate([18, 7, 19, 4]):
+            rng = np.random.RandomState(i)
+            eng.submit(i, rng.randint(0, 256, size), 35)
+        done = {}
+        while len(done) < 4:
+            done.update(eng.step())
+        return done
+
+    assert run() == run(rolling_window=True)
+
+
+def test_patterned_chunked_prefill_parity():
+    from shellac_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-gemma2").replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def run(**kw):
+        eng = BatchingEngine(
+            cfg, params, n_slots=2, max_len=160, temperature=0.0,
+            prefill_chunk=12, **kw
+        )
+        rng = np.random.RandomState(5)
+        for i in range(3):
+            eng.submit(i, rng.randint(0, 256, 50), 20)
+        done = {}
+        while len(done) < 3:
+            done.update(eng.step())
+        return done
+
+    assert run() == run(rolling_window=True)
+
+
+def test_patterned_gemma3_dual_rope_parity():
+    """Gemma-3: 5:1 pattern + DUAL rope — the ring layers rope with the
+    local theta, the dense layer with the scaled global theta."""
+    from shellac_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-gemma3").replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.asarray(
+        np.random.RandomState(2).randint(0, 256, (1, 17)), jnp.int32
+    )
+    dense = Engine(cfg, params, temperature=0.0, max_len=128).generate(
+        prompt, max_new_tokens=40
+    )
+    roll = Engine(
+        cfg, params, temperature=0.0, max_len=128, rolling_window=True
+    ).generate(prompt, max_new_tokens=40)
+    np.testing.assert_array_equal(
+        np.asarray(dense.tokens), np.asarray(roll.tokens)
+    )
+
+
+def test_rolling_sharded_parity():
+    """tp-sharded engine with the ring cache == unsharded greedy."""
+    from shellac_tpu.config import ParallelConfig
+    from shellac_tpu.inference.engine import shard_params
+    from shellac_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the CPU mesh")
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jnp.asarray(
+        np.random.RandomState(4).randint(0, 128, (2, 17)), jnp.int32
+    )
+    base = Engine(
+        cfg, params, temperature=0.0, max_len=128, rolling_window=True
+    ).generate(prompt, max_new_tokens=30)
+    mesh = make_mesh(ParallelConfig(tp=2), devices=jax.devices()[:2])
+    sp = shard_params(cfg, params, mesh)
+    sharded = Engine(
+        cfg, sp, temperature=0.0, max_len=128, rolling_window=True,
+        mesh=mesh,
+    ).generate(prompt, max_new_tokens=30)
+    np.testing.assert_array_equal(
+        np.asarray(base.tokens), np.asarray(sharded.tokens)
+    )
