@@ -37,7 +37,7 @@ import numpy as np
 
 
 def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
-                 decode_ticks=1, kv_quant=None):
+                 decode_ticks=1, kv_quant=None, rolling=False):
     from shellac_tpu.inference.batching import (
         BatchingEngine,
         PagedBatchingEngine,
@@ -55,16 +55,18 @@ def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
     return BatchingEngine(
         cfg, params, n_slots=n_slots, max_len=max_len,
         temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
-        kv_quant=kv_quant,
+        kv_quant=kv_quant, rolling_window=rolling,
     )
 
 
 def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
-                 ticks, rng, decode_ticks=1, kv_quant=None):
+                 ticks, rng, decode_ticks=1, kv_quant=None,
+                 rolling=False):
     """Decode tokens/s with every slot held live at ~ctx context."""
     eng = build_engine(
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
         max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
+        rolling=rolling,
     )
     budget = max_len - ctx - 1
     need = (2 + ticks) * decode_ticks
@@ -100,11 +102,12 @@ def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
 
 
 def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
-          decode_ticks=1, kv_quant=None):
+          rolling=False, decode_ticks=1, kv_quant=None):
     """Drain 3*n_slots ragged requests; tokens/s of generated tokens."""
     eng = build_engine(
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
         max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
+        rolling=rolling,
     )
     n_req = 3 * n_slots
     gen_budget = min(64, max(4, (max_len - ctx) // 2))
@@ -247,6 +250,10 @@ def main():
     ap.add_argument("--variants", default="dense:auto,dense:ref,paged:auto,paged:ref")
     ap.add_argument("--kv-quant", choices=["int8"],
                     help="int8 KV cache on the dense engine variants")
+    ap.add_argument("--window", type=int, default=None,
+                    help="apply a sliding window to the model (enables "
+                         "the rolling:* variants — dense-vs-rolling at "
+                         "identical math)")
     args = ap.parse_args()
 
     import jax
@@ -260,6 +267,8 @@ def main():
         if backend != "tpu":
             args.ctx, args.ticks = 64, 5
     cfg = get_model_config(args.model)
+    if args.window is not None:
+        cfg = cfg.replace(attn_window=args.window).validate()
     # Serving context: ctx prompt + generation headroom, block-aligned.
     max_len = ((args.ctx + max(64, args.ctx // 4)) + 511) // 512 * 512
     cfg = cfg.replace(max_seq_len=max(cfg.max_seq_len, max_len))
@@ -317,20 +326,26 @@ def main():
     for variant in args.variants.split(","):
         cache_kind, impl = variant.split(":")
         paged = cache_kind == "paged"
+        rolling = cache_kind == "rolling"
+        if rolling and cfg.attn_window is None:
+            raise SystemExit(
+                "rolling:* variants need a windowed model (--window or "
+                "a windowed preset)"
+            )
         rng = np.random.default_rng(0)
-        kvq = None if paged else args.kv_quant
+        kvq = None if (paged or rolling) else args.kv_quant
         if paged and args.kv_quant:
             print(f"note: --kv-quant skipped for {variant} "
                   "(paged pools are bf16-only)", file=sys.stderr)
         tok_s, tick_s = steady_state(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, ticks=args.ticks, rng=rng,
-            decode_ticks=args.decode_ticks, kv_quant=kvq,
+            decode_ticks=args.decode_ticks, kv_quant=kvq, rolling=rolling,
         )
         churn_tok_s, churn_total = churn(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, rng=rng,
-            decode_ticks=args.decode_ticks, kv_quant=kvq,
+            decode_ticks=args.decode_ticks, kv_quant=kvq, rolling=rolling,
         )
         row = {
             "metric": f"decode_throughput_{args.model}_ctx{args.ctx}_"
@@ -354,6 +369,12 @@ def main():
         a, r = results.get(f"{kind}:auto"), results.get(f"{kind}:ref")
         if a and r and r["value"]:
             summary[f"{kind}_speedup"] = round(a["value"] / r["value"], 3)
+    roll = results.get("rolling:ref")
+    dense_best = results.get("dense:auto") or results.get("dense:ref")
+    if roll and dense_best and dense_best["value"]:
+        summary["rolling_vs_dense"] = round(
+            roll["value"] / dense_best["value"], 3
+        )
     print(json.dumps(summary), flush=True)
 
 

@@ -55,6 +55,8 @@ run engine_mla 580 python scripts/bench_decode.py \
   --model shellac-mla-2b --variants dense:auto,dense:ref --decode-ticks 8
 run engine_kvq 580 python scripts/bench_decode.py \
   --variants dense:auto --decode-ticks 8 --kv-quant int8
+run engine_rolling 580 python scripts/bench_decode.py \
+  --variants dense:auto,rolling:ref --window 1024 --decode-ticks 8
 
 # 4. Training bench variants (headline recipe + packed + quant + fused).
 run train_plain 580 python bench.py
