@@ -75,6 +75,8 @@ class _Request:
     min_tokens: int = 0
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    prompt_logprobs: bool = False
+    plp: Optional[List[float]] = None
     # Additive per-token logit biases applied before sampling (OpenAI
     # semantics); logprobs still report the raw distribution.
     logit_bias: Optional[Dict[int, float]] = None
@@ -192,6 +194,10 @@ class BatchingEngine:
         # (or any caller) to pop.
         self.logprobs = logprobs
         self.finished_logprobs: Dict[Any, List[float]] = {}
+        # prompt_logprobs=True requests deposit the prompt's per-token
+        # logprobs here on completion (keyed by rid), like
+        # finished_logprobs.
+        self.finished_prompt_logprobs: Dict[Any, List[float]] = {}
         # Per-slot additive logit biases and remaining min_tokens (EOS
         # ban countdown, decremented on device inside the decode scan).
         # The (n_slots, vocab) bias matrix is allocated lazily on the
@@ -325,8 +331,12 @@ class BatchingEngine:
         )
 
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
-                      samp):
-        """Prefill one request and scatter it into `slot` of `cache`."""
+                      samp, want_plp: bool = False):
+        """Prefill one request and scatter it into `slot` of `cache`.
+
+        want_plp additionally returns the PROMPT's per-token logprobs
+        (token t given tokens[:t]; position 0 has no predictor and
+        reports 0.0 — the server renders it as null)."""
         mini = self._fresh_mini(self.max_len)
         logits, mini = transformer.forward_with_cache(
             self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
@@ -336,7 +346,14 @@ class BatchingEngine:
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first, first_lp = self._sample_first(key, last, samp)
-        return scatter_slot(cache, mini, slot), first, first_lp
+        plp = jnp.zeros((tokens.shape[1],), jnp.float32)
+        if want_plp:
+            lps = jax.nn.log_softmax(logits[0, :-1].astype(jnp.float32))
+            tok_lp = jnp.take_along_axis(
+                lps, tokens[0, 1:][:, None], axis=-1
+            )[:, 0]
+            plp = plp.at[1:].set(tok_lp)
+        return scatter_slot(cache, mini, slot), first, first_lp, plp
 
     def _decode_impl(self, params, cache, cur, active, key, samp,
                      greedy_only: bool = False, use_bias: bool = False,
@@ -437,7 +454,8 @@ class BatchingEngine:
     def submit(self, rid, tokens, max_new: int, stop=None, *,
                temperature=None, top_k=None, top_p=None,
                min_p=None, min_tokens=None, logit_bias=None,
-               presence_penalty=None, frequency_penalty=None) -> None:
+               presence_penalty=None, frequency_penalty=None,
+               prompt_logprobs=False) -> None:
         """Queue a request. `stop`: optional list of token-id sequences;
         generation ends when the output ends with any of them, and the
         matched sequence is removed from the returned tokens.
@@ -490,6 +508,16 @@ class BatchingEngine:
                     f"request {rid!r}: logit_bias token ids {oob} outside "
                     f"vocab [0, {self.cfg.vocab_size})"
                 )
+        if prompt_logprobs and self.prefill_chunk is not None:
+            raise ValueError(
+                f"request {rid!r}: prompt_logprobs needs whole-prompt "
+                "prefill (drop prefill_chunk)"
+            )
+        if prompt_logprobs and self._swaps_cache:
+            raise ValueError(
+                f"request {rid!r}: prompt_logprobs is not wired for the "
+                "paged engine yet"
+            )
         pres = float(presence_penalty) if presence_penalty is not None \
             else 0.0
         freq = float(frequency_penalty) if frequency_penalty is not None \
@@ -501,7 +529,8 @@ class BatchingEngine:
         self._queue.append(_Request(
             rid, tokens, max_new, stop=stop, min_tokens=min_tokens,
             logit_bias=logit_bias, presence_penalty=pres,
-            frequency_penalty=freq, **samp,
+            frequency_penalty=freq,
+            prompt_logprobs=bool(prompt_logprobs), **samp,
         ))
 
     def _prepare_slot(self, slot: int, req: _Request) -> None:
@@ -586,18 +615,23 @@ class BatchingEngine:
         # (dense) or the block table (paged) would write out of
         # range — loudly for dense, silently-clamped for paged.
         pad = min(_bucket(s), self.max_len)
-        if pad not in self._prefill_jit:
-            self._prefill_jit[pad] = self._jit_cache_program(
-                self._prefill_impl, 2
+        key = (pad, req.prompt_logprobs)
+        if key not in self._prefill_jit:
+            self._prefill_jit[key] = self._jit_cache_program(
+                self._prefill_impl, 3, static_argnames=("want_plp",)
             )
         padded = np.zeros((1, pad), np.int32)
         padded[0, :s] = req.tokens
         self._key, sub = jax.random.split(self._key)
-        cache, first, lp = self._prefill_jit[pad](
+        cache, first, lp, plp = self._prefill_jit[key](
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray([s], jnp.int32), slot, sub, self._slot_samp(slot, req),
+            want_plp=req.prompt_logprobs,
         )
         self._cache = cache
+        if req.prompt_logprobs:
+            req.plp = [float(x) for x in
+                       np.asarray(jax.device_get(plp))[:s]]
         return first, lp
 
     def _prefill_start_offset(self, slot: int) -> int:
@@ -727,6 +761,8 @@ class BatchingEngine:
                 finished.append((req.rid, req.out))
                 if self.logprobs:
                     self.finished_logprobs[req.rid] = req.lps[:len(req.out)]
+                if req.plp is not None:
+                    self.finished_prompt_logprobs[req.rid] = req.plp
                 self.stats["requests_completed"] += 1
                 self.stats["tokens_generated"] += len(req.out)
                 self._slots[i] = None
@@ -849,6 +885,7 @@ class BatchingEngine:
                 self._prefilling.pop(i, None)
                 self._release_slot(i)
                 self.finished_logprobs.pop(rid, None)
+                self.finished_prompt_logprobs.pop(rid, None)
                 self.stats["requests_cancelled"] += 1
                 return True
         for req in list(self._queue):
@@ -1202,8 +1239,10 @@ class PagedBatchingEngine(BatchingEngine):
         return cache, first, first_lp
 
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
-                      samp):
-        """Dense mini-prefill, then scatter through the slot's table."""
+                      samp, want_plp: bool = False):
+        """Dense mini-prefill, then scatter through the slot's table.
+        (want_plp is rejected at submit for paged engines; the dummy
+        return keeps the base _run_prefill's 4-output contract.)"""
         s = tokens.shape[1]
         mini = init_cache(self.cfg, 1, s)
         logits, mini = transformer.forward_with_cache(
@@ -1232,7 +1271,9 @@ class PagedBatchingEngine(BatchingEngine):
                 cache.lengths, mini.lengths, (slot,)
             ),
         )
-        return cache, first, first_lp
+        return cache, first, first_lp, jnp.zeros(
+            (tokens.shape[1],), jnp.float32
+        )
 
 
 class _PoolExhausted(Exception):

@@ -28,7 +28,6 @@ def _bad(msg: str):
 def _check_unsupported(payload: dict):
     for key, neutral in (
         ("suffix", (None, "")),
-        ("echo", (False, None)),
     ):
         if key in payload and payload[key] not in neutral:
             _bad(
@@ -89,6 +88,13 @@ def completion_to_native(payload: dict, tokenizer) -> dict:
             "prompt must be a string or a flat token-id list "
             "(batched prompts are not supported)"
         )
+    if payload.get("echo"):
+        # Echo returns the prompt in the completion text; with logprobs
+        # it additionally scores every prompt token (the engine's
+        # prompt_logprobs path).
+        native["echo"] = True
+        if payload.get("logprobs") not in (None, False):
+            native["prompt_logprobs"] = True
     lp = payload.get("logprobs")
     if lp is not None and lp is not False:
         # OpenAI's int-valued logprobs asks for top-k alternatives; the
@@ -166,6 +172,8 @@ def chat_to_native(payload: dict, tokenizer) -> dict:
             f"top_logprobs={payload['top_logprobs']!r}: only the chosen "
             "token's logprob is recorded"
         )
+    if payload.get("echo"):
+        _bad("echo is a completions-API parameter")
     if payload.get("best_of") is not None:
         _bad("best_of is a completions-API parameter")
     _common_sampling(payload, native)
@@ -197,11 +205,20 @@ def _lp_block(tokens, lps, tokenizer):
 
 def completion_response(
     native_result: dict, *, model: str, prompt_tokens: int, max_new: int,
-    tokenizer, chat: bool,
+    tokenizer, chat: bool, echo: bool = False, prompt_ids=None,
 ) -> dict:
-    """Native handle() result -> OpenAI response object."""
+    """Native handle() result -> OpenAI response object.
+
+    echo (completions only): the prompt text prepends each choice's
+    text, and — when the native result carries prompt_logprobs — the
+    logprobs block covers prompt tokens too (first token null, the
+    OpenAI convention)."""
     raw_choices = native_result.get("choices") or [native_result]
     choices = []
+    prompt_text = ""
+    if echo and prompt_ids is not None:
+        prompt_text = (tokenizer.decode(prompt_ids) if tokenizer
+                       else str(prompt_ids))
     for i, c in enumerate(raw_choices):
         toks = c["tokens"]
         text = c.get("text")
@@ -214,9 +231,19 @@ def completion_response(
         if chat:
             entry["message"] = {"role": "assistant", "content": text}
         else:
-            entry["text"] = text
+            entry["text"] = (prompt_text + text) if echo else text
         if c.get("logprobs") is not None:
             lp = _lp_block(toks, c["logprobs"], tokenizer)
+            if echo and native_result.get("prompt_logprobs") is not None:
+                plp = native_result["prompt_logprobs"]
+                pl = _lp_block(prompt_ids or [], plp, tokenizer)
+                lp = {
+                    "tokens": pl["tokens"] + lp["tokens"],
+                    "token_logprobs": (pl["token_logprobs"]
+                                       + lp["token_logprobs"]),
+                    "top_logprobs": None,
+                    "text_offset": None,
+                }
             entry["logprobs"] = (
                 {"content": [
                     {"token": t, "logprob": l}

@@ -51,7 +51,7 @@ from shellac_tpu.inference.batching import BatchingEngine
 
 class _Pending:
     __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback",
-                 "lps", "rid")
+                 "lps", "plp", "rid")
 
     def __init__(self, rid, stream: bool = False, holdback: int = 0):
         self.rid = rid
@@ -69,6 +69,7 @@ class _Pending:
         # Per-token logprobs of the final result (engines built with
         # logprobs=True deposit them at completion).
         self.lps = None
+        self.plp = None  # prompt per-token logprobs (prompt_logprobs)
 
     def finish(self):
         if self.chunks is not None:
@@ -176,16 +177,21 @@ class InferenceServer:
                         p.chunks.put(list(req.out[p.emitted:upto]))
                         p.emitted = upto
                 lp_store = getattr(self.engine, "finished_logprobs", {})
+                plp_store = getattr(
+                    self.engine, "finished_prompt_logprobs", {}
+                )
                 for rid, out in finished:
                     p = self._pending.pop(rid, None)
                     if p is not None:
                         p.result = out
                         p.lps = lp_store.pop(rid, None)
+                        p.plp = plp_store.pop(rid, None)
                         if p.chunks is not None and len(out) > p.emitted:
                             p.chunks.put(list(out[p.emitted:]))
                         p.finish()
                     else:
                         lp_store.pop(rid, None)
+                        plp_store.pop(rid, None)
                 if self._heartbeat and not drained and not self.engine.pending:
                     # Idle heartbeat tick: pace the broadcast instead of
                     # spinning the interconnect at full rate.
@@ -256,7 +262,7 @@ class InferenceServer:
             self._cancel(p)
             raise
         if return_logprobs:
-            return p.result, p.lps
+            return p.result, p.lps, p.plp
         return p.result
 
     def generate_stream(self, tokens, max_new: int,
@@ -279,7 +285,9 @@ class InferenceServer:
             if p.error is not None:
                 self._raise(p)
             finished = True
-            yield ("done", (p.result, p.lps) if return_logprobs else p.result)
+            yield ("done",
+                   (p.result, p.lps, p.plp) if return_logprobs
+                   else p.result)
         finally:
             if not finished:
                 # Consumer abandoned the stream (client disconnect tears
@@ -335,6 +343,8 @@ class InferenceServer:
                             f"{key} must be an integer, got {v}"
                         )
                     samp[key] = int(v)
+            if payload.get("prompt_logprobs"):
+                samp["prompt_logprobs"] = True
             if payload.get("logit_bias") is not None:
                 lb = payload["logit_bias"]
                 if not isinstance(lb, dict):
@@ -360,26 +370,34 @@ class InferenceServer:
         want_lps = self._check_logprobs(payload)
         n, best_of = self._parse_n(payload, samp)
         if n == 1 and best_of == 1:
-            out, lps = self.generate(
+            out, lps, plp = self.generate(
                 tokens, max_new, timeout=payload.get("timeout"), stop=stop,
                 return_logprobs=True, **samp,
             )
-            return self._format_completion(out, lps, want_lps)
+            return self._format_completion(out, lps, want_lps, plp=plp)
         # Parallel sampling: best_of independent completions share the
         # slot batch (and, on a paged+prefix engine, their prompt KV);
-        # the n best by mean token logprob come back as "choices".
+        # the n best by mean token logprob come back as "choices". The
+        # prompt is identical across the fan-out, so prompt logprobs
+        # (echo) are computed ONCE, on the first sub-request only.
+        rest_samp = {k: v for k, v in samp.items()
+                     if k != "prompt_logprobs"}
         pendings = [
-            self._submit(tokens, max_new, stop, samp, stream=False)
-            for _ in range(best_of)
+            self._submit(tokens, max_new, stop,
+                         samp if i == 0 else rest_samp, stream=False)
+            for i in range(best_of)
         ]
         # One overall deadline for the whole fan-out — not a fresh
         # clock per completion.
         deadline = self._deadline(payload.get("timeout"))
         choices = []
+        plp = None
         try:
             for p in pendings:
                 self._await(p, deadline)
                 choices.append((p.result, p.lps))
+                if p.plp is not None:
+                    plp = p.plp
         except (TimeoutError, ValueError, RuntimeError):
             # Don't strand the rest: unfinished siblings would keep
             # occupying slots generating tokens nobody will read.
@@ -395,15 +413,23 @@ class InferenceServer:
                 return (sum(c[1]) / len(c[1])) if c[1] else float("-inf")
 
             choices.sort(key=score, reverse=True)
-        return {"choices": [
+        result: Dict[str, Any] = {"choices": [
             self._format_completion(out, lps, want_lps)
             for out, lps in choices[:n]
         ]}
+        if plp is not None:
+            result["prompt_logprobs"] = [None] + plp[1:]
+        return result
 
-    def _format_completion(self, out, lps, want_lps) -> Dict[str, Any]:
+    def _format_completion(self, out, lps, want_lps,
+                           plp=None) -> Dict[str, Any]:
         result: Dict[str, Any] = {"tokens": out}
         if want_lps:
             result["logprobs"] = lps
+        if plp is not None:
+            # Per-prompt-token logprobs; position 0 has no predictor
+            # and reports null.
+            result["prompt_logprobs"] = [None] + plp[1:]
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(out)
         return result
@@ -461,13 +487,21 @@ class InferenceServer:
             if kind == "delta":
                 yield {"tokens": val}
             else:
-                out, lps = val
+                out, lps, plp = val
                 final: Dict[str, Any] = {"done": True, "tokens": out}
                 if want_lps:
                     final["logprobs"] = lps
+                if plp is not None:
+                    final["prompt_logprobs"] = [None] + plp[1:]
                 if self.tokenizer is not None:
                     final["text"] = self.tokenizer.decode(out)
                 yield final
+
+    def _prompt_lp_capable(self) -> bool:
+        eng = self.engine
+        return not (getattr(eng, "prefill_chunk", None)
+                    or getattr(eng, "_swaps_cache", False)
+                    or not hasattr(eng, "finished_prompt_logprobs"))
 
     # ---- OpenAI-compatible façade -----------------------------------
 
@@ -480,6 +514,13 @@ class InferenceServer:
 
         native = (chat_to_native(payload, self.tokenizer) if chat
                   else completion_to_native(payload, self.tokenizer))
+        echo = bool(native.pop("echo", False))
+        if native.get("prompt_logprobs") and not self._prompt_lp_capable():
+            raise ValueError(
+                "echo with logprobs is unavailable on this server: prompt "
+                "scoring needs whole-prompt prefill on the dense engine "
+                "(the server runs chunked, paged, or speculative prefill)"
+            )
         tokens = self._parse(native)[0]
         # Hand handle() the ids so the prompt is not tokenized twice.
         native.pop("text", None)
@@ -490,6 +531,7 @@ class InferenceServer:
         return completion_response(
             result, model=self.model_name, prompt_tokens=prompt_tokens,
             max_new=max_new, tokenizer=self.tokenizer, chat=chat,
+            echo=echo, prompt_ids=[int(t) for t in tokens],
         )
 
     def handle_openai_stream(self, payload: dict, chat: bool):
@@ -503,6 +545,12 @@ class InferenceServer:
 
         native = (chat_to_native(payload, self.tokenizer) if chat
                   else completion_to_native(payload, self.tokenizer))
+        if native.pop("echo", False):
+            raise ValueError(
+                "echo does not compose with streaming (the prompt is "
+                "known to the client; request it unstreamed)"
+            )
+        native.pop("prompt_logprobs", None)
         max_new = int(native.get("max_new", 32))
         translator = StreamTranslator(
             model=self.model_name, tokenizer=self.tokenizer, chat=chat,

@@ -125,7 +125,13 @@ class SpeculativeBatchingEngine(BatchingEngine):
     def submit(self, rid, tokens, max_new: int, stop=None, *,
                temperature=None, top_k=None, top_p=None, min_p=None,
                min_tokens=None, logit_bias=None,
-               presence_penalty=None, frequency_penalty=None) -> None:
+               presence_penalty=None, frequency_penalty=None,
+               prompt_logprobs=False) -> None:
+        if prompt_logprobs:
+            raise ValueError(
+                f"request {rid!r}: prompt_logprobs is not wired for the "
+                "speculative engine"
+            )
         if any(v is not None for v in
                (top_k, top_p, min_p, min_tokens, logit_bias,
                 presence_penalty, frequency_penalty)):

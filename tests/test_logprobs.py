@@ -228,3 +228,85 @@ class TestHTTPLogprobs:
         finally:
             httpd.shutdown()
             srv.close()
+
+
+class TestPromptLogprobs:
+    def test_prompt_logprobs_match_forward(self):
+        """Engine prompt logprobs == log_softmax of the training
+        forward at each prompt position."""
+        from shellac_tpu.inference.batching import BatchingEngine
+
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = [5, 9, 2, 31, 7, 12]
+        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0)
+        eng.submit("r", prompt, 4, prompt_logprobs=True)
+        done = {}
+        while len(done) < 1:
+            done.update(eng.step())
+        plp = eng.finished_prompt_logprobs.pop("r")
+        assert len(plp) == len(prompt) and plp[0] == 0.0
+
+        logits = transformer.forward(
+            cfg, params, jnp.asarray([prompt], jnp.int32)
+        )
+        lps = jax.nn.log_softmax(logits[0].astype(jnp.float32))
+        expect = [
+            float(lps[t - 1, prompt[t]]) for t in range(1, len(prompt))
+        ]
+        np.testing.assert_allclose(plp[1:], expect, atol=1e-5)
+
+    def test_guards(self):
+        from shellac_tpu.inference.batching import BatchingEngine
+
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             prefill_chunk=8)
+        with pytest.raises(ValueError, match="prompt_logprobs"):
+            eng.submit("r", [1, 2, 3], 4, prompt_logprobs=True)
+
+    def test_openai_echo_logprobs(self):
+        """completions echo=true + logprobs: text = prompt + completion,
+        logprobs cover prompt tokens (first null) then completion."""
+        import json as _json
+        import threading
+        import urllib.request
+
+        from shellac_tpu.inference.server import (
+            InferenceServer,
+            make_http_server,
+        )
+        from shellac_tpu.training.tokenizer import ByteTokenizer
+
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        srv = InferenceServer(
+            cfg, params, tokenizer=ByteTokenizer(), model_name="tiny",
+            n_slots=2, max_len=64, temperature=0.0, logprobs=True,
+        )
+        httpd = make_http_server(srv)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            req = urllib.request.Request(
+                f"{base}/v1/completions",
+                data=_json.dumps({
+                    "prompt": "hello", "max_tokens": 4, "temperature": 0,
+                    "echo": True, "logprobs": 1,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                out = _json.loads(r.read())
+            choice = out["choices"][0]
+            assert choice["text"].startswith("hello")
+            lp = choice["logprobs"]
+            # 5 prompt tokens (first null) + 4 completion tokens
+            assert len(lp["token_logprobs"]) == 9
+            assert lp["token_logprobs"][0] is None
+            assert all(v <= 0.0 for v in lp["token_logprobs"][1:])
+        finally:
+            httpd.shutdown()
+            srv.close()

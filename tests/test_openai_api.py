@@ -155,17 +155,17 @@ class TestCompletions:
         base, _, _ = oai_srv
         with pytest.raises(urllib.error.HTTPError) as e:
             _post(base, "/v1/completions", {
-                "prompt": "a", "echo": True,
+                "prompt": "a", "suffix": "tail",
             })
         assert e.value.code == 400
         body = json.loads(e.value.read())
         assert body["error"]["type"] == "invalid_request_error"
-        # neutral value passes, and penalties are now SUPPORTED knobs
+        # neutral value passes; penalties and echo are SUPPORTED knobs
         out = _post(base, "/v1/completions", {
-            "prompt": "a", "max_tokens": 2, "echo": False,
-            "presence_penalty": 0.5, "temperature": 0,
+            "prompt": "a", "max_tokens": 2, "suffix": "",
+            "echo": True, "presence_penalty": 0.5, "temperature": 0,
         })
-        assert out["choices"][0]["text"]
+        assert out["choices"][0]["text"].startswith("a")
 
 
 class TestChat:
