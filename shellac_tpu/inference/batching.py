@@ -77,6 +77,7 @@ class _Request:
     frequency_penalty: float = 0.0
     prompt_logprobs: bool = False
     plp: Optional[List[float]] = None
+    seed: Optional[int] = None
     # Additive per-token logit biases applied before sampling (OpenAI
     # semantics); logprobs still report the raw distribution.
     logit_bias: Optional[Dict[int, float]] = None
@@ -215,6 +216,10 @@ class BatchingEngine:
         self._spres = jnp.zeros((n_slots,), jnp.float32)
         self._sfreq = jnp.zeros((n_slots,), jnp.float32)
         self._slot_pen: List[bool] = [False] * n_slots
+        # Per-request deterministic sampling: seed (-1 = unseeded, use
+        # the shared stream) + the slot's generated-token count at the
+        # start of each decode window (host-known: len(req.out)).
+        self._sseed = jnp.full((n_slots,), -1, jnp.int32)
         # Engine-level sampling defaults; submit() can override any of
         # them per request. Each slot's effective settings live in
         # device vectors fed to the jitted programs, so one decode tick
@@ -357,7 +362,7 @@ class BatchingEngine:
 
     def _decode_impl(self, params, cache, cur, active, key, samp,
                      greedy_only: bool = False, use_bias: bool = False,
-                     use_pen: bool = False):
+                     use_pen: bool = False, use_seed: bool = False):
         """decode_ticks decode steps over every slot, ONE host sync.
 
         Per-tick host reads dominate serving latency when the device is
@@ -374,8 +379,10 @@ class BatchingEngine:
         bias = samp[4] if use_bias else None
         min_rem0 = samp[5]
         pres, freq, counts0 = samp[6], samp[7], samp[8]
+        seed_vec, gen0 = samp[9], samp[10]
 
-        def tick(carry, key):
+        def tick(carry, key_i):
+            key, i = key_i
             cache, cur, min_rem, counts = carry
             old_lengths = cache.lengths
             logits, cache = transformer.forward_with_cache(
@@ -390,6 +397,10 @@ class BatchingEngine:
                              + freq[:, None] * counts)
             if greedy_only:
                 nxt = jnp.argmax(adj, axis=-1).astype(jnp.int32)
+            elif use_seed:
+                nxt = sample_batched(
+                    key, adj, *samp[:4], seed=seed_vec, gen_idx=gen0 + i,
+                )
             else:
                 nxt = sample_batched(key, adj, *samp[:4])
             lengths = jnp.where(active, cache.lengths, old_lengths)
@@ -412,8 +423,9 @@ class BatchingEngine:
             return (cache, nxt, min_rem, counts), (nxt, lp)
 
         keys = jax.random.split(key, self.decode_ticks)
+        ticks_i = jnp.arange(self.decode_ticks, dtype=jnp.int32)
         (cache, _, min_rem, counts), (toks, lps) = jax.lax.scan(
-            tick, (cache, cur, min_rem0, counts0), keys
+            tick, (cache, cur, min_rem0, counts0), (keys, ticks_i)
         )
         return cache, toks, lps, min_rem, counts
 
@@ -445,9 +457,13 @@ class BatchingEngine:
     def _sample_first(self, key, last, samp):
         """Sample a prefill's first output token from the adjusted
         (biased, EOS-banned) logits; the logprob stays on the raw
-        ones."""
+        ones. A seeded request's first token is draw gen_idx=0 of its
+        own deterministic stream."""
         adjusted = self._adjust_logits(last[None], samp[4], samp[5])
-        first = sample_batched(key, adjusted, *samp[:4])[0]
+        first = sample_batched(
+            key, adjusted, *samp[:4],
+            seed=samp[6], gen_idx=jnp.zeros((1,), jnp.int32),
+        )[0]
         lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
         return first, lp
 
@@ -455,7 +471,7 @@ class BatchingEngine:
                temperature=None, top_k=None, top_p=None,
                min_p=None, min_tokens=None, logit_bias=None,
                presence_penalty=None, frequency_penalty=None,
-               prompt_logprobs=False) -> None:
+               prompt_logprobs=False, seed=None) -> None:
         """Queue a request. `stop`: optional list of token-id sequences;
         generation ends when the output ends with any of them, and the
         matched sequence is removed from the returned tokens.
@@ -526,11 +542,22 @@ class BatchingEngine:
                       ("frequency_penalty", freq)):
             if not np.isfinite(v):
                 raise ValueError(f"request {rid!r}: {nm} must be finite")
+        if seed is not None:
+            seed = int(seed)
+            if seed < 0:
+                raise ValueError(
+                    f"request {rid!r}: seed must be >= 0 (negative is "
+                    "the unseeded sentinel)"
+                )
+            # OpenAI clients send 63-bit seeds; the device vector is
+            # int32. Fold deterministically instead of overflowing in
+            # the scheduler thread.
+            seed &= 0x7FFFFFFF
         self._queue.append(_Request(
             rid, tokens, max_new, stop=stop, min_tokens=min_tokens,
             logit_bias=logit_bias, presence_penalty=pres,
             frequency_penalty=freq,
-            prompt_logprobs=bool(prompt_logprobs), **samp,
+            prompt_logprobs=bool(prompt_logprobs), seed=seed, **samp,
         ))
 
     def _prepare_slot(self, slot: int, req: _Request) -> None:
@@ -573,6 +600,9 @@ class BatchingEngine:
             jnp.asarray([req.min_p], jnp.float32),
             bias,
             jnp.asarray([req.min_tokens], jnp.int32),
+            jnp.asarray(
+                [req.seed if req.seed is not None else -1], jnp.int32
+            ),
         )
 
     def _set_slot_sampling(self, slot: int, req: _Request) -> None:
@@ -595,6 +625,9 @@ class BatchingEngine:
             )
             self._slot_bias[slot] = new_bias
         self._smin = self._smin.at[slot].set(req.min_tokens)
+        self._sseed = self._sseed.at[slot].set(
+            req.seed if req.seed is not None else -1
+        )
         penalized = (req.presence_penalty != 0.0
                      or req.frequency_penalty != 0.0)
         if penalized or self._slot_pen[slot]:
@@ -840,7 +873,8 @@ class BatchingEngine:
         if self._decode is None:
             self._decode = self._jit_cache_program(
                 self._decode_impl, 4,
-                static_argnames=("greedy_only", "use_bias", "use_pen"),
+                static_argnames=("greedy_only", "use_bias", "use_pen",
+                                 "use_seed"),
             )
         active = jnp.asarray(active_rows)
         self._key, sub = jax.random.split(self._key)
@@ -849,17 +883,25 @@ class BatchingEngine:
         )
         use_pen = any(self._slot_pen)
         counts = (self._scounts if use_pen else self._zero_bias_row)
+        gen0 = jnp.asarray(
+            [len(r.out) if r is not None else 0 for r in self._slots],
+            jnp.int32,
+        )
         self._cache, toks, lps, self._smin, counts = self._decode(
             self.params, self._cache, self._cur, active, sub,
             (self._stemp, self._stopk, self._stopp, self._sminp,
              self._sbias if self._sbias is not None
              else self._zero_bias_row, self._smin,
-             self._spres, self._sfreq, counts),
+             self._spres, self._sfreq, counts,
+             self._sseed, gen0),
             greedy_only=greedy_only,
             use_bias=self._sbias is not None and any(
                 b is not None for b in self._slot_bias
             ),
             use_pen=use_pen,
+            use_seed=any(
+                r is not None and r.seed is not None for r in self._slots
+            ),
         )
         if use_pen:
             self._scounts = counts

@@ -44,9 +44,7 @@ def _common_sampling(payload: dict, native: dict):
     if payload.get("top_k") is not None:  # OpenAI-adjacent extension
         native["top_k"] = payload["top_k"]
     if payload.get("seed") is not None:
-        # The engine draws from its own counter-based stream; per-request
-        # seeds are not implemented. Refuse rather than pretend.
-        _bad("per-request seed is not supported")
+        native["seed"] = int(payload["seed"])
     stop = payload.get("stop")
     if stop is not None:
         native["stop"] = [stop] if isinstance(stop, str) else list(stop)
@@ -91,9 +89,11 @@ def completion_to_native(payload: dict, tokenizer) -> dict:
     if payload.get("echo"):
         # Echo returns the prompt in the completion text; with logprobs
         # it additionally scores every prompt token (the engine's
-        # prompt_logprobs path).
+        # prompt_logprobs path). Identity checks: logprobs=0 is a valid
+        # OpenAI value (0 == False would silently skip it).
         native["echo"] = True
-        if payload.get("logprobs") not in (None, False):
+        _lp = payload.get("logprobs")
+        if _lp is not None and _lp is not False:
             native["prompt_logprobs"] = True
     lp = payload.get("logprobs")
     if lp is not None and lp is not False:

@@ -49,6 +49,13 @@ from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.batching import BatchingEngine
 
 
+def _render_plp(plp):
+    """Prompt logprobs for a response: position 0 has no predictor and
+    renders as null (the OpenAI convention); one definition so the
+    n==1, best_of, and streaming shapes cannot drift."""
+    return [None] + plp[1:]
+
+
 class _Pending:
     __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback",
                  "lps", "plp", "rid")
@@ -335,7 +342,7 @@ class InferenceServer:
                           "presence_penalty", "frequency_penalty")
                 if payload.get(k) is not None
             }
-            for key in ("top_k", "min_tokens"):
+            for key in ("top_k", "min_tokens", "seed"):
                 if payload.get(key) is not None:
                     v = float(payload[key])
                     if not v.is_integer():
@@ -418,7 +425,7 @@ class InferenceServer:
             for out, lps in choices[:n]
         ]}
         if plp is not None:
-            result["prompt_logprobs"] = [None] + plp[1:]
+            result["prompt_logprobs"] = _render_plp(plp)
         return result
 
     def _format_completion(self, out, lps, want_lps,
@@ -427,9 +434,7 @@ class InferenceServer:
         if want_lps:
             result["logprobs"] = lps
         if plp is not None:
-            # Per-prompt-token logprobs; position 0 has no predictor
-            # and reports null.
-            result["prompt_logprobs"] = [None] + plp[1:]
+            result["prompt_logprobs"] = _render_plp(plp)
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(out)
         return result
@@ -492,7 +497,7 @@ class InferenceServer:
                 if want_lps:
                     final["logprobs"] = lps
                 if plp is not None:
-                    final["prompt_logprobs"] = [None] + plp[1:]
+                    final["prompt_logprobs"] = _render_plp(plp)
                 if self.tokenizer is not None:
                     final["text"] = self.tokenizer.decode(out)
                 yield final

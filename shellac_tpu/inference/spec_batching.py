@@ -126,7 +126,13 @@ class SpeculativeBatchingEngine(BatchingEngine):
                temperature=None, top_k=None, top_p=None, min_p=None,
                min_tokens=None, logit_bias=None,
                presence_penalty=None, frequency_penalty=None,
-               prompt_logprobs=False) -> None:
+               prompt_logprobs=False, seed=None) -> None:
+        if seed is not None:
+            raise ValueError(
+                f"request {rid!r}: per-request seed is not wired for "
+                "the speculative engine (the draft/verify round has its "
+                "own acceptance randomness)"
+            )
         if prompt_logprobs:
             raise ValueError(
                 f"request {rid!r}: prompt_logprobs is not wired for the "

@@ -90,6 +90,8 @@ def sample_batched(
     top_k: jax.Array,  # (B,) int32; >= V disables
     top_p: jax.Array,  # (B,) fp32; 1.0 disables
     min_p: jax.Array,  # (B,) fp32; 0.0 disables
+    seed: Optional[jax.Array] = None,  # (B,) int32; -1 = unseeded
+    gen_idx: Optional[jax.Array] = None,  # (B,) int32 — tokens generated
 ) -> jax.Array:
     """`sample` with PER-ROW parameters, for serving engines that mix
     requests with different sampling settings in one device batch.
@@ -98,6 +100,12 @@ def sample_batched(
     tests when all rows share one setting): disabled values are the
     no-op sentinels above rather than None, so the whole thing stays
     one jittable program with fixed shapes.
+
+    seed/gen_idx: per-request DETERMINISTIC sampling — a seeded row
+    draws from fold_in(PRNGKey(seed), gen_idx), so its tokens depend
+    only on (seed, logits, position in its own generation), never on
+    slot placement, co-tenant requests, or the engine's key state.
+    Rows with seed < 0 keep the shared stream.
     """
     logits = logits.astype(jnp.float32)
     v = logits.shape[-1]
@@ -125,6 +133,15 @@ def sample_batched(
     cutoff = min_p[:, None] * jnp.max(probs_x, axis=-1, keepdims=True)
     x = jnp.where(probs_x < cutoff, NEG_INF, x)
     sampled = jax.random.categorical(key, x, axis=-1)
+    if seed is not None:
+        def row_draw(s, g, row):
+            k = jax.random.fold_in(
+                jax.random.PRNGKey(jnp.maximum(s, 0)), g
+            )
+            return jax.random.categorical(k, row)
+
+        per_row = jax.vmap(row_draw)(seed, gen_idx, x)
+        sampled = jnp.where(seed >= 0, per_row, sampled)
     return jnp.where(
         greedy, jnp.argmax(logits, axis=-1), sampled
     ).astype(jnp.int32)
