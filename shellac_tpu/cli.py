@@ -616,6 +616,93 @@ def cmd_generate(args):
     return 0
 
 
+def cmd_batch(args):
+    """Offline batch generation: JSONL prompts in, JSONL completions
+    out, through the continuous-batching engine (slots stay saturated
+    across requests — the high-throughput path, no HTTP in the way)."""
+    from shellac_tpu.inference.batching import BatchingEngine
+    from shellac_tpu.training.tokenizer import get_tokenizer
+
+    cfg = _model_config(args)
+    params = _apply_lora(args, cfg, _restore_params(args, cfg))
+    mesh = _mesh_from(args)
+    if mesh is not None:
+        from shellac_tpu.inference.engine import shard_params
+
+        params = shard_params(cfg, params, mesh)
+    tok = get_tokenizer(args.tokenizer)
+    eng = BatchingEngine(
+        cfg, params, n_slots=args.slots,
+        max_len=args.max_len or cfg.max_seq_len,
+        temperature=args.temperature, eos_id=args.eos_id,
+        decode_ticks=args.decode_ticks, mesh=mesh, seed=args.seed,
+        kv_quant=args.kv_quant, rolling_window=args.rolling_window,
+        logprobs=args.logprobs,
+    )
+
+    rows = []
+    with open(args.input) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append(json.loads(line))
+    if not rows:
+        raise SystemExit(f"no prompts in {args.input}")
+
+    per_req = ("max_tokens", "temperature", "top_k", "top_p", "min_p",
+               "seed", "presence_penalty", "frequency_penalty")
+    for i, row in enumerate(rows):
+        prompt = row.get("prompt")
+        if isinstance(prompt, str):
+            ids = tok.encode(prompt)
+        elif isinstance(prompt, list):
+            ids = np.asarray(prompt, np.int32)
+        else:
+            raise SystemExit(f"row {i}: prompt must be text or id list")
+        kw = {k: row[k] for k in per_req if row.get(k) is not None}
+        max_new = int(kw.pop("max_tokens", args.max_new))
+        stop = row.get("stop")
+        if stop is not None:
+            if isinstance(stop, str):
+                # OpenAI scalar form: ONE sequence, not per-character.
+                stop = [stop]
+            try:
+                stop = [list(map(int, tok.encode(s)))
+                        if isinstance(s, str) else list(map(int, s))
+                        for s in stop]
+            except TypeError:
+                raise SystemExit(
+                    f"row {i}: stop must be a string or a list of "
+                    "strings / token-id lists"
+                )
+        try:
+            eng.submit(i, ids, max_new, stop=stop, **kw)
+        except ValueError as e:
+            # One malformed row must fail the job BEFORE any compute,
+            # with the row named — not a traceback after checkpoint
+            # load and half a batch of generation.
+            raise SystemExit(f"row {i}: {e}")
+
+    results = dict(eng.run())
+
+    with open(args.output, "w") as f:
+        for i in range(len(rows)):
+            out = results[i]
+            rec = {"index": i, "tokens": out, "text": tok.decode(out)}
+            if args.logprobs:
+                lps = eng.finished_logprobs.pop(i, None)
+                if lps is not None:
+                    rec["logprobs"] = lps
+            f.write(json.dumps(rec) + "\n")
+    print(json.dumps({
+        "output": args.output,
+        "requests": len(rows),
+        "tokens_generated": int(eng.stats["tokens_generated"]),
+        "engine_steps": int(eng.stats["engine_steps"]),
+    }))
+    return 0
+
+
 def cmd_serve(args):
     from shellac_tpu.inference.server import serve
     from shellac_tpu.training.tokenizer import get_tokenizer
@@ -950,6 +1037,33 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--lora-dir", default=None, dest="lora_dir",
                    help="merge adapters from a train --lora-rank dir")
     g.set_defaults(fn=cmd_generate)
+
+    b = sub.add_parser("batch",
+                       help="offline batch generation (JSONL in/out)")
+    common(b)
+    b.add_argument("--input", required=True,
+                   help='JSONL rows: {"prompt": text-or-ids, '
+                        '"max_tokens"?, "temperature"?, "seed"?, '
+                        '"stop"?, ...}')
+    b.add_argument("--output", required=True, help="JSONL results path")
+    b.add_argument("--max-new", type=int, default=64,
+                   help="default max tokens when a row has none")
+    b.add_argument("--slots", type=int, default=8)
+    b.add_argument("--max-len", type=int, default=None, dest="max_len")
+    b.add_argument("--temperature", type=float, default=0.0)
+    b.add_argument("--eos-id", type=int, default=None, dest="eos_id")
+    b.add_argument("--decode-ticks", type=int, default=4,
+                   dest="decode_ticks")
+    b.add_argument("--mesh", default="", help="e.g. tp=4")
+    b.add_argument("--kv-quant", choices=["int8"], default=None,
+                   dest="kv_quant")
+    b.add_argument("--rolling-window", action="store_true",
+                   dest="rolling_window")
+    b.add_argument("--logprobs", action="store_true")
+    b.add_argument("--tokenizer", default="byte")
+    b.add_argument("--ckpt-dir")
+    b.add_argument("--lora-dir", default=None, dest="lora_dir")
+    b.set_defaults(fn=cmd_batch)
 
     s = sub.add_parser("serve", help="HTTP server with continuous batching")
     common(s)
