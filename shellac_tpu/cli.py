@@ -721,11 +721,11 @@ def cmd_serve(args):
         raise SystemExit("--kv-quant is dense-cache only; drop --paged")
     if args.kv_quant and args.draft_model:
         raise SystemExit("--kv-quant does not compose with --draft-model")
-    if args.rolling_window and (args.paged or args.kv_quant
-                                or args.draft_model):
+    if args.rolling_window and (args.paged or args.draft_model):
         raise SystemExit(
-            "--rolling-window is a dense-cache feature (no --paged, "
-            "--kv-quant, or --draft-model)"
+            "--rolling-window is a dense-cache feature (no --paged or "
+            "--draft-model; --kv-quant composes on uniformly-windowed "
+            "models)"
         )
 
     from shellac_tpu.parallel.distributed import initialize

@@ -141,10 +141,6 @@ class BatchingEngine:
         if kv_quant not in (None, "int8"):
             raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
         if rolling_window:
-            if kv_quant is not None:
-                raise ValueError(
-                    "rolling_window does not compose with kv_quant yet"
-                )
             if self._swaps_cache:
                 raise ValueError(
                     "rolling_window is a dense-cache feature; the paged "
@@ -298,23 +294,16 @@ class BatchingEngine:
         if self.mesh is None:
             self._cache_sh = None
             return
-        from shellac_tpu.inference.kvcache import (
-            PatternedKVCache,
-            RollingKVCache,
-            patterned_cache_logical_axes,
-            rolling_cache_logical_axes,
-        )
+        from shellac_tpu.inference.kvcache import cache_logical_axes_for
 
         if isinstance(self._cache, PagedKVCache):
             axes = paged_cache_logical_axes(self.cfg)
-        elif isinstance(self._cache, QuantKVCache):
-            axes = quant_cache_logical_axes(self.cfg)
-        elif isinstance(self._cache, RollingKVCache):
-            axes = rolling_cache_logical_axes(self.cfg)
-        elif isinstance(self._cache, PatternedKVCache):
-            axes = patterned_cache_logical_axes(self.cfg)
         else:
-            axes = cache_logical_axes(self.cfg)
+            # The single cache-kind dispatch (kvcache) — the axes tree
+            # can never desync from what init_cache_for built.
+            axes = cache_logical_axes_for(
+                self.cfg, self.kv_quant, rolling=self.rolling_window
+            )
         self._cache_sh = make_shardings(self.mesh, axes)
         self._cache = jax.device_put(self._cache, self._cache_sh)
         self._decode = None

@@ -78,10 +78,6 @@ class Engine:
     ):
         if kv_quant not in (None, "int8"):
             raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
-        if rolling_window and kv_quant is not None:
-            raise ValueError(
-                "rolling_window does not compose with kv_quant yet"
-            )
         if rolling_window and cfg.attn_window is None:
             raise ValueError(
                 "rolling_window needs a sliding-window model (attn_window)"

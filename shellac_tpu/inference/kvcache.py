@@ -126,10 +126,11 @@ def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
     """The engines' cache constructor: dense bf16, int8, or a rolling
     ring buffer (sliding-window models) by flags."""
     if rolling:
+        if kv_quant == "int8":
+            return init_quant_rolling_cache(cfg, batch, max_len,
+                                            chunk_slack=chunk_slack)
         if kv_quant is not None:
-            raise ValueError(
-                "rolling cache does not compose with kv_quant yet"
-            )
+            raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
         if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
             return init_patterned_cache(cfg, batch, max_len,
                                         chunk_slack=chunk_slack)
@@ -148,6 +149,8 @@ def cache_logical_axes_for(cfg: ModelConfig, kv_quant=None,
     flags — the single place the cache-kind dispatch lives, so jit
     out_shardings can never desync from the cache pytree."""
     if rolling:
+        if kv_quant == "int8":
+            return quant_rolling_cache_logical_axes(cfg)
         if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
             return patterned_cache_logical_axes(cfg)
         return rolling_cache_logical_axes(cfg)
@@ -244,7 +247,7 @@ def scatter_slot(cache, mini, slot):
                   for n in ("kw", "vw", "kf", "vf")}
     else:
         fields = {"k": upd(cache.k, mini.k), "v": upd(cache.v, mini.v)}
-        if isinstance(cache, QuantKVCache):
+        if isinstance(cache, (QuantKVCache, QuantRollingKVCache)):
             fields.update(ks=upd(cache.ks, mini.ks),
                           vs=upd(cache.vs, mini.vs))
     fields["lengths"] = jax.lax.dynamic_update_slice(
@@ -265,7 +268,7 @@ def slot_view(cache, slot, lengths):
                   for n in ("kw", "vw", "kf", "vf")}
     else:
         fields = {"k": sl(cache.k), "v": sl(cache.v)}
-        if isinstance(cache, QuantKVCache):
+        if isinstance(cache, (QuantKVCache, QuantRollingKVCache)):
             fields.update(ks=sl(cache.ks), vs=sl(cache.vs))
     fields["lengths"] = lengths.astype(jnp.int32)
     return cache.replace(**fields)
@@ -589,3 +592,79 @@ def patterned_cache_logical_axes(cfg: Optional[ModelConfig] = None):
     return PatternedKVCache(
         kw=ax, vw=ax, kf=ax, vf=ax, lengths=("batch",),
     )
+
+
+@flax.struct.dataclass
+class QuantRollingKVCache:
+    """Int8 ring buffer: the rolling cache's window-sized storage AND
+    the int8 cache's halved bytes/bandwidth, composed. Same write-time
+    symmetric quantization contract as QuantKVCache (K quantized after
+    RoPE); same ring position arithmetic as RollingKVCache. Reads
+    dequantize the ring (it is window-sized — the dequant is O(window),
+    not O(context)) and run the masked reference attention.
+    """
+
+    k: Any  # (L, B, Hkv, ring, Dh) int8
+    v: Any  # (L, B, Hkv, ring, Dh) int8
+    ks: Any  # (L, B, Hkv, ring) fp32
+    vs: Any  # (L, B, Hkv, ring) fp32
+    lengths: Any  # (B,) int32 — TOTAL positions seen
+
+    @property
+    def ring(self) -> int:
+        return self.k.shape[3]
+
+
+def init_quant_rolling_cache(
+    cfg: ModelConfig, batch: int, max_len: int, chunk_slack: int = 1,
+) -> QuantRollingKVCache:
+    if cfg.attn_window is None:
+        raise ValueError(
+            "rolling cache needs a sliding-window model (attn_window)"
+        )
+    if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
+        raise NotImplementedError(
+            "int8 x rolling covers uniformly-windowed models; patterned "
+            "stacks use the bf16 mixed cache or the dense int8 cache"
+        )
+    ring = rolling_ring(cfg, max_len, chunk_slack)
+    head = (cfg.n_layers, batch, cfg.cache_kv_heads, ring)
+    return QuantRollingKVCache(
+        k=jnp.zeros((*head, cfg.cache_head_dim), jnp.int8),
+        v=jnp.zeros((*head, cfg.cache_head_dim), jnp.int8),
+        ks=jnp.zeros(head, jnp.float32),
+        vs=jnp.zeros(head, jnp.float32),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def quant_rolling_cache_logical_axes(cfg: Optional[ModelConfig] = None):
+    return QuantRollingKVCache(
+        k=("layers", "batch", "kv_heads", None, None),
+        v=("layers", "batch", "kv_heads", None, None),
+        ks=("layers", "batch", "kv_heads", None),
+        vs=("layers", "batch", "kv_heads", None),
+        lengths=("batch",),
+    )
+
+
+def quant_roll_update_layer(
+    cache_k, cache_v, cache_ks, cache_vs,  # one layer's ring (+ scales)
+    k_new, v_new,  # (B, S, Hkv, Dh) unquantized
+    index,  # (B,) int32
+    valid_len=None,
+):
+    """Quantize the chunk, then ring-write values AND scales with the
+    same last-wins/pad-mask semantics as roll_update_layer."""
+    kq, ks = quantize_kv(k_new)
+    vq, vs = quantize_kv(v_new)
+    ck, cv = roll_update_layer(cache_k, cache_v, kq, vq, index,
+                               valid_len=valid_len)
+    # Scales are (B, S, Hkv) -> ring scatter on a 3D buffer: reuse the
+    # 4D path with a width-1 head dim (the k and v slots of
+    # roll_update_layer are independent, so one call does both rings).
+    cks, cvs = roll_update_layer(
+        cache_ks[..., None], cache_vs[..., None],
+        ks[..., None], vs[..., None], index, valid_len=valid_len,
+    )
+    return ck, cv, cks[..., 0], cvs[..., 0]

@@ -518,6 +518,54 @@ def _block(
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
                 sinks=sinks,
             )
+    elif rolled:
+        from shellac_tpu.inference.kvcache import (
+            quant_roll_update_layer,
+            roll_update_layer,
+        )
+        from shellac_tpu.ops.decode_attention import (
+            rolled_decode_attention,
+        )
+
+        cache_k, cache_v, index, q_positions = cache  # ring buffers
+        if kv_scales is not None:
+            # Int8 ring: quantize at write (K post-rope, the QuantKVCache
+            # contract); reads dequantize the window-sized ring.
+            ks_l, vs_l = kv_scales
+            cache_k, cache_v, ks_l, vs_l = quant_roll_update_layer(
+                cache_k, cache_v, ks_l, vs_l, k, v, index,
+                valid_len=new_len,
+            )
+            new_cache = (cache_k, cache_v, ks_l, vs_l)
+        else:
+            cache_k, cache_v = roll_update_layer(
+                cache_k, cache_v, k, v, index, valid_len=new_len
+            )
+            new_cache = (cache_k, cache_v)
+        if fresh_cache:
+            # Whole-prompt prefill attends the incoming chunk itself
+            # (exact values — identical to the dense path); the ring
+            # only matters for later reads.
+            o = attention(
+                q, k, v, causal=True, window=window, impl=attn_impl,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+                sinks=sinks,
+            )
+        else:
+            rk, rv = cache_k, cache_v
+            if kv_scales is not None:
+                # Dequantize IN fp32 and stay there: a cast to the
+                # compute dtype would add a rounding the dense int8
+                # path never pays (its kernel folds the fp32 scale
+                # after the integer dot).
+                rk = rk.astype(jnp.float32) * ks_l[..., None]
+                rv = rv.astype(jnp.float32) * vs_l[..., None]
+            vl = s if new_len is None else new_len
+            o = rolled_decode_attention(
+                q, rk, rv, index, index + vl, window=window,
+                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
+                sinks=sinks,
+            )
     elif kv_scales is not None:
         from shellac_tpu.inference.kvcache import quant_update_layer
         from shellac_tpu.ops.decode_attention import decode_attention
@@ -542,33 +590,6 @@ def _block(
                 window=window, impl=attn_impl,
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
                 sinks=sinks, k_scale=ks_l, v_scale=vs_l,
-            )
-    elif rolled:
-        from shellac_tpu.inference.kvcache import roll_update_layer
-        from shellac_tpu.ops.decode_attention import (
-            rolled_decode_attention,
-        )
-
-        cache_k, cache_v, index, q_positions = cache  # ring buffers
-        cache_k, cache_v = roll_update_layer(
-            cache_k, cache_v, k, v, index, valid_len=new_len
-        )
-        new_cache = (cache_k, cache_v)
-        if fresh_cache:
-            # Whole-prompt prefill attends the incoming chunk itself
-            # (identical to the dense path); the ring only matters for
-            # later reads.
-            o = attention(
-                q, k, v, causal=True, window=window, impl=attn_impl,
-                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
-                sinks=sinks,
-            )
-        else:
-            vl = s if new_len is None else new_len
-            o = rolled_decode_attention(
-                q, cache_k, cache_v, index, index + vl, window=window,
-                scale=cfg.attn_scale, softcap=cfg.attn_softcap,
-                sinks=sinks,
             )
     else:
         from shellac_tpu.inference.kvcache import update_layer
@@ -1299,6 +1320,7 @@ def forward_with_cache(
         PagedKVCache,
         PatternedKVCache,
         QuantKVCache,
+        QuantRollingKVCache,
         RollingKVCache,
     )
 
@@ -1307,8 +1329,8 @@ def forward_with_cache(
             "KV-cache generation requires a causal model (cfg.causal=True)"
         )
     paged = isinstance(cache, PagedKVCache)
-    quant = isinstance(cache, QuantKVCache)
-    rolled = isinstance(cache, RollingKVCache)
+    quant = isinstance(cache, (QuantKVCache, QuantRollingKVCache))
+    rolled = isinstance(cache, (RollingKVCache, QuantRollingKVCache))
     mixed = isinstance(cache, PatternedKVCache)
     if (rolled or mixed) and cfg.attn_window is None:
         raise ValueError("rolling cache on a model without attn_window")

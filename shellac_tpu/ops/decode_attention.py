@@ -914,9 +914,16 @@ def rolled_decode_attention(
     q_pos = start.astype(jnp.int32)[:, None] + jnp.broadcast_to(
         jnp.arange(s, dtype=jnp.int32), (b, s)
     )
+
+    def ref_dtype(x):
+        # fp32 rings (int8 dequant) keep their precision — the fp32
+        # logit einsum upcasts the other operand anyway; only widen
+        # narrower inputs to the q dtype.
+        return x if x.dtype == jnp.float32 else x.astype(q.dtype)
+
     return attention_ref(
-        q, cache_k.transpose(0, 2, 1, 3).astype(q.dtype),
-        cache_v.transpose(0, 2, 1, 3).astype(q.dtype),
+        q, ref_dtype(cache_k.transpose(0, 2, 1, 3)),
+        ref_dtype(cache_v.transpose(0, 2, 1, 3)),
         causal=True, window=window, scale=scale, softcap=softcap,
         sinks=sinks,
         q_positions=q_pos, kv_positions=kv_pos, kv_mask=kv_mask,

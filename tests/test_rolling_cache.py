@@ -181,10 +181,6 @@ def test_guards():
     params = init_params(cfg, jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="attn_window"):
         Engine(cfg, params, rolling_window=True)
-    cfg_w = _cfg()
-    params_w = init_params(cfg_w, jax.random.PRNGKey(0))
-    with pytest.raises(ValueError, match="kv_quant"):
-        Engine(cfg_w, params_w, rolling_window=True, kv_quant="int8")
     with pytest.raises(NotImplementedError, match="patterned"):
         init_rolling_cache(
             _cfg(attn_pattern=("window", "full"), n_layers=2), 1, 64
@@ -308,3 +304,69 @@ def test_rolling_sharded_parity():
     np.testing.assert_array_equal(
         np.asarray(base.tokens), np.asarray(sharded.tokens)
     )
+
+
+def test_int8_rolling_matches_int8_dense():
+    """kv_quant="int8" x rolling_window: the int8 ring must reproduce
+    the int8 DENSE cache (both quantize at the same write points, so
+    the stored values are identical; the ring read dequantizes in fp32
+    with no extra rounding)."""
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def run(**kw):
+        eng = BatchingEngine(
+            cfg, params, n_slots=2, max_len=128, temperature=0.0,
+            kv_quant="int8", **kw
+        )
+        for i, size in enumerate([17, 7, 19, 4]):
+            rng = np.random.RandomState(i)
+            eng.submit(i, rng.randint(0, 128, size), 40)
+        done = {}
+        while len(done) < 4:
+            done.update(eng.step())
+        return done
+
+    assert run() == run(rolling_window=True)
+
+
+def test_int8_rolling_sharded():
+    """The sharded engine must pin QuantRollingKVCache axes (the
+    cache-kind dispatch is shared with init_cache_for)."""
+    from shellac_tpu.config import ParallelConfig
+    from shellac_tpu.inference.engine import shard_params
+    from shellac_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the CPU mesh")
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(ParallelConfig(tp=2), devices=jax.devices()[:2])
+    sp = shard_params(cfg, params, mesh)
+    eng = BatchingEngine(
+        cfg, sp, n_slots=2, max_len=128, temperature=0.0,
+        kv_quant="int8", rolling_window=True, mesh=mesh,
+    )
+    eng.submit("r", [5, 9, 2, 31], 20)
+    done = {}
+    while len(done) < 1:
+        done.update(eng.step())
+    base = BatchingEngine(
+        cfg, params, n_slots=2, max_len=128, temperature=0.0,
+        kv_quant="int8", rolling_window=True,
+    )
+    base.submit("r", [5, 9, 2, 31], 20)
+    ref = {}
+    while len(ref) < 1:
+        ref.update(base.step())
+    assert done == ref
+
+
+def test_int8_rolling_patterned_refused():
+    from shellac_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-gemma2").replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="int8 x rolling"):
+        BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                       kv_quant="int8", rolling_window=True)
