@@ -324,6 +324,18 @@ class BatchingEngine:
             rolling=self.rolling_window, chunk_slack=self._chunk_slack,
         )
 
+    @staticmethod
+    def _plp_within(logits, tokens):
+        """Each token's logprob given its IN-ROW predecessor: position
+        t scores from logits row t-1; position 0 (no predictor in this
+        row) reports 0.0. The single definition the whole-prompt AND
+        chunked paths share, so their scoring cannot drift."""
+        lps = jax.nn.log_softmax(logits[0, :-1].astype(jnp.float32))
+        tok_lp = jnp.take_along_axis(
+            lps, tokens[0, 1:][:, None], axis=-1
+        )[:, 0]
+        return jnp.zeros((tokens.shape[1],), jnp.float32).at[1:].set(tok_lp)
+
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
                       samp, want_plp: bool = False):
         """Prefill one request and scatter it into `slot` of `cache`.
@@ -340,13 +352,8 @@ class BatchingEngine:
             logits, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first, first_lp = self._sample_first(key, last, samp)
-        plp = jnp.zeros((tokens.shape[1],), jnp.float32)
-        if want_plp:
-            lps = jax.nn.log_softmax(logits[0, :-1].astype(jnp.float32))
-            tok_lp = jnp.take_along_axis(
-                lps, tokens[0, 1:][:, None], axis=-1
-            )[:, 0]
-            plp = plp.at[1:].set(tok_lp)
+        plp = (self._plp_within(logits, tokens) if want_plp
+               else jnp.zeros((tokens.shape[1],), jnp.float32))
         return scatter_slot(cache, mini, slot), first, first_lp, plp
 
     def _decode_impl(self, params, cache, cur, active, key, samp,
@@ -513,11 +520,6 @@ class BatchingEngine:
                     f"request {rid!r}: logit_bias token ids {oob} outside "
                     f"vocab [0, {self.cfg.vocab_size})"
                 )
-        if prompt_logprobs and self.prefill_chunk is not None:
-            raise ValueError(
-                f"request {rid!r}: prompt_logprobs needs whole-prompt "
-                "prefill (drop prefill_chunk)"
-            )
         if prompt_logprobs and self._swaps_cache:
             raise ValueError(
                 f"request {rid!r}: prompt_logprobs is not wired for the "
@@ -718,35 +720,62 @@ class BatchingEngine:
             s = chunk.size
             pad = min(_bucket(s), self.max_len - off)
             self._key, sub = jax.random.split(self._key)
-            cache, first, lp = self._chunk_prefill(
+            final = off + s >= req.tokens.size
+            boundary = (jnp.asarray(0, jnp.int32) if final
+                        else jnp.asarray(int(req.tokens[off + s]),
+                                         jnp.int32))
+            cache, first, lp, plp_w, blp = self._chunk_prefill(
                 pad, off == 0, jnp.asarray(
                     np.pad(chunk, (0, pad - s))[None]
                 ),
                 jnp.asarray([s], jnp.int32), jnp.asarray([off], jnp.int32),
                 slot, sub, self._slot_samp(slot, req),
+                boundary_next=boundary, want_plp=req.prompt_logprobs,
             )
             self._cache = cache
-            if off + s >= req.tokens.size:
+            if req.prompt_logprobs:
+                # Collect DEVICE arrays; the one blocking transfer
+                # happens at the final chunk, so scoring does not
+                # serialize the chunk pipeline with per-chunk syncs.
+                if req.plp is None:
+                    req.plp = []
+                req.plp.append((plp_w, s, None if final else blp))
+            if final:
                 del self._prefilling[slot]
+                if req.prompt_logprobs:
+                    pieces = req.plp
+                    host = jax.device_get(pieces)
+                    flat = [0.0]
+                    for plp_host, sz, blp_host in host:
+                        flat.extend(float(x)
+                                    for x in np.asarray(plp_host)[1:sz])
+                        if blp_host is not None:
+                            flat.append(float(blp_host))
+                    req.plp = flat
                 self._finish_prefill(slot, req, first, lp)
             else:
                 self._prefilling[slot] = off + s
         return used
 
     def _chunk_prefill(self, pad, fresh, tokens, chunk_len, offset, slot,
-                       key, samp):
+                       key, samp, boundary_next=None, want_plp=False):
         """Dispatch one (bucketed, jitted) chunk-continuation program."""
-        if (pad, fresh) not in self._chunk_jit:
-            self._chunk_jit[(pad, fresh)] = self._jit_cache_program(
-                functools.partial(self._chunk_prefill_impl, fresh=fresh), 2
+        jkey = (pad, fresh, want_plp)
+        if jkey not in self._chunk_jit:
+            self._chunk_jit[jkey] = self._jit_cache_program(
+                functools.partial(self._chunk_prefill_impl, fresh=fresh,
+                                  want_plp=want_plp), 4
             )
-        return self._chunk_jit[(pad, fresh)](
+        if boundary_next is None:
+            boundary_next = jnp.zeros((), jnp.int32)
+        return self._chunk_jit[jkey](
             self.params, self._cache, tokens, chunk_len, offset, slot, key,
-            samp,
+            samp, boundary_next,
         )
 
     def _chunk_prefill_impl(self, params, cache, tokens, chunk_len, offset,
-                            slot, key, samp, *, fresh: bool):
+                            slot, key, samp, boundary_next, *, fresh: bool,
+                            want_plp: bool = False):
         """Write one prompt chunk at `offset` into `slot`'s cache row.
 
         A batch-1 view of the row continues from `offset` tokens
@@ -754,6 +783,13 @@ class BatchingEngine:
         the buffered prefix via the masked decode path). The sampled
         token is only meaningful for the final chunk; earlier chunks
         compute and discard it (cheaper than a second program variant).
+
+        want_plp additionally returns (a) each chunk token's logprob
+        given its IN-CHUNK predecessor (rows 1..s-1; row 0's predictor
+        lives in the previous chunk) and (b) the boundary logprob of
+        `boundary_next` — the NEXT chunk's first token — from this
+        chunk's final position, so the host can stitch the full prompt
+        scoring across chunks.
         """
         view = slot_view(cache, slot, offset)
         logits, view = transformer.forward_with_cache(
@@ -765,7 +801,15 @@ class BatchingEngine:
             logits, (chunk_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first, first_lp = self._sample_first(key, last, samp)
-        return scatter_slot(cache, view, slot), first, first_lp
+        plp_within = jnp.zeros((tokens.shape[1],), jnp.float32)
+        boundary_lp = jnp.zeros((), jnp.float32)
+        if want_plp:
+            plp_within = self._plp_within(logits, tokens)
+            boundary_lp = jax.nn.log_softmax(
+                last.astype(jnp.float32)
+            )[boundary_next]
+        return (scatter_slot(cache, view, slot), first, first_lp,
+                plp_within, boundary_lp)
 
     def _finish_check(self, finished):
         for i, req in enumerate(self._slots):
@@ -1192,17 +1236,21 @@ class PagedBatchingEngine(BatchingEngine):
         return self._slot_prefix_len[slot] if self.prefix_cache else 0
 
     def _chunk_prefill(self, pad, fresh, tokens, chunk_len, offset, slot,
-                       key, samp):
+                       key, samp, boundary_next=None, want_plp=False):
         """Paged chunks reuse the continuation program (a chunk is a
-        'suffix' past `offset` resident tokens; offset 0 included)."""
+        'suffix' past `offset` resident tokens; offset 0 included).
+        want_plp is rejected at submit for paged engines; the dummy
+        tail keeps the base _advance_prefills' 5-output contract."""
         if pad not in self._prefix_prefill_jit:
             self._prefix_prefill_jit[pad] = self._jit_cache_program(
                 self._prefix_prefill_impl, 2
             )
-        return self._prefix_prefill_jit[pad](
+        cache, first, lp = self._prefix_prefill_jit[pad](
             self.params, self._cache, tokens, chunk_len, offset, slot, key,
             samp,
         )
+        return (cache, first, lp, jnp.zeros((pad,), jnp.float32),
+                jnp.zeros((), jnp.float32))
 
     def _run_prefill(self, slot: int, req):
         """Prefix-cached prefill: compute only the unmatched suffix;

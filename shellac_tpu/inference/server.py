@@ -504,8 +504,7 @@ class InferenceServer:
 
     def _prompt_lp_capable(self) -> bool:
         eng = self.engine
-        return not (getattr(eng, "prefill_chunk", None)
-                    or getattr(eng, "_swaps_cache", False)
+        return not (getattr(eng, "_swaps_cache", False)
                     or not hasattr(eng, "finished_prompt_logprobs"))
 
     # ---- OpenAI-compatible façade -----------------------------------
@@ -523,8 +522,8 @@ class InferenceServer:
         if native.get("prompt_logprobs") and not self._prompt_lp_capable():
             raise ValueError(
                 "echo with logprobs is unavailable on this server: prompt "
-                "scoring needs whole-prompt prefill on the dense engine "
-                "(the server runs chunked, paged, or speculative prefill)"
+                "scoring runs on the dense engine (the server runs paged "
+                "or speculative prefill)"
             )
         tokens = self._parse(native)[0]
         # Hand handle() the ids so the prompt is not tokenized twice.

@@ -257,13 +257,37 @@ class TestPromptLogprobs:
         ]
         np.testing.assert_allclose(plp[1:], expect, atol=1e-5)
 
-    def test_guards(self):
+    def test_chunked_prefill_matches_whole_prompt(self):
+        """Prompt logprobs stitched across prefill chunks (in-chunk rows
+        + boundary values) must equal the whole-prompt scoring
+        exactly."""
         from shellac_tpu.inference.batching import BatchingEngine
 
         cfg = get_model_config("tiny").replace(dtype="float32")
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
-        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
-                             prefill_chunk=8)
+        prompt = list(np.random.RandomState(0).randint(0, 256, 27))
+
+        def run(**kw):
+            eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                 temperature=0.0, **kw)
+            eng.submit("r", prompt, 4, prompt_logprobs=True)
+            done = {}
+            while len(done) < 1:
+                done.update(eng.step())
+            return eng.finished_prompt_logprobs.pop("r")
+
+        whole = run()
+        chunked = run(prefill_chunk=10)  # 3 ragged chunks
+        assert len(chunked) == len(prompt)
+        np.testing.assert_allclose(chunked, whole, atol=1e-5)
+
+    def test_guards(self):
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  block_size=16, pool_tokens=256)
         with pytest.raises(ValueError, match="prompt_logprobs"):
             eng.submit("r", [1, 2, 3], 4, prompt_logprobs=True)
 
