@@ -1,6 +1,16 @@
 from shellac_tpu.inference.batching import BatchingEngine, PagedBatchingEngine
 from shellac_tpu.inference.engine import Engine, GenerationResult, shard_params
-from shellac_tpu.inference.kvcache import KVCache, cache_logical_axes, init_cache
+from shellac_tpu.inference.kvcache import (
+    KVCache,
+    PatternedKVCache,
+    QuantKVCache,
+    QuantRollingKVCache,
+    RollingKVCache,
+    cache_logical_axes,
+    cache_logical_axes_for,
+    init_cache,
+    init_cache_for,
+)
 from shellac_tpu.inference.server import InferenceServer
 from shellac_tpu.inference.spec_batching import SpeculativeBatchingEngine
 from shellac_tpu.inference.speculative import SpecResult, SpeculativeEngine
@@ -12,8 +22,14 @@ __all__ = [
     "PagedBatchingEngine",
     "GenerationResult",
     "KVCache",
+    "PatternedKVCache",
+    "QuantKVCache",
+    "QuantRollingKVCache",
+    "RollingKVCache",
     "init_cache",
+    "init_cache_for",
     "cache_logical_axes",
+    "cache_logical_axes_for",
     "SpecResult",
     "SpeculativeBatchingEngine",
     "SpeculativeEngine",
