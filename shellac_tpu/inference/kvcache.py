@@ -440,9 +440,9 @@ def init_rolling_cache(
         )
     if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
         raise NotImplementedError(
-            "rolling cache currently covers uniformly-windowed models; "
-            "patterned local/global stacks still use the dense cache "
-            "for every layer"
+            "patterned local/global stacks roll via the MIXED cache — "
+            "use init_patterned_cache (init_cache_for routes there "
+            "automatically); this constructor builds the uniform ring"
         )
     ring = rolling_ring(cfg, max_len, chunk_slack)
     head = (cfg.n_layers, batch, cfg.cache_kv_heads, ring)
