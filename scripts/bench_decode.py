@@ -128,16 +128,14 @@ def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
     return total / dt, total
 
 
-def kernel_microbench(cfg, *, paged, impl, n_slots, ctx, max_len, iters):
-    """Device-side loop over the decode-attention op alone.
+def _build_kernel_loop(cfg, *, paged, impl, n_slots, ctx, max_len, iters):
+    """Build one jitted scan of `iters` chained decode-attention calls.
 
     The engine numbers include a per-tick host sync, which on a
-    relay-attached TPU measures RPC latency, not the kernel. This
-    chains `iters` decode-attention calls inside ONE jitted lax.scan
-    (the output feeds the next q, so nothing can be CSE'd or
-    overlapped away) and reports per-call latency and the effective KV
-    bandwidth the op sustains.
-    """
+    relay-attached TPU measures RPC latency, not the kernel. Chaining
+    the calls inside ONE jitted lax.scan (the output feeds the next q,
+    so nothing can be CSE'd or overlapped away) measures the op itself.
+    Returns (loop_fn, q0, kv_bytes_per_call)."""
     import jax
     import jax.numpy as jnp
 
@@ -146,7 +144,7 @@ def kernel_microbench(cfg, *, paged, impl, n_slots, ctx, max_len, iters):
         paged_decode_attention,
     )
 
-    hkv, dh, L = cfg.kv_heads, cfg.dim_per_head, cfg.n_layers
+    hkv, dh = cfg.kv_heads, cfg.dim_per_head
     h = cfg.n_heads
     cdt = cfg.compute_dtype
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -186,18 +184,55 @@ def kernel_microbench(cfg, *, paged, impl, n_slots, ctx, max_len, iters):
         q, _ = jax.lax.scan(body, q, None, length=iters)
         return q
 
-    out = loop(q0)
-    float(jnp.sum(out.astype(jnp.float32)))  # force completion (warmup)
-    t0 = time.perf_counter()
-    out = loop(q0)
-    float(jnp.sum(out.astype(jnp.float32)))
-    dt = time.perf_counter() - t0
-    per_call_us = dt / iters * 1e6
-    # Bytes the op must stream for ONE layer's attention: live kv only.
     live_tokens = int(np.asarray(lengths).sum())
     kv_bytes = 2 * live_tokens * hkv * dh * jnp.dtype(cdt).itemsize
-    gbps = kv_bytes / (dt / iters) / 1e9
-    return per_call_us, gbps
+    return loop, q0, kv_bytes
+
+
+def kernel_microbench_interleaved(cfg, variants, *, n_slots, ctx, max_len,
+                                  iters, rounds):
+    """Time all variants in interleaved A/B/A/B rounds, min per variant.
+
+    Measuring each variant in its own multi-minute pass lets slow drift
+    in relay RPC latency masquerade as kernel speed (round 3 recorded
+    the SAME dense kernel at 1.04x and 0.603x vs ref in two windows —
+    docs/perf.md:65). Interleaving puts every variant in every drift
+    regime; the per-variant MIN over rounds is robust to latency
+    spikes, and the recorded spread shows whether drift occurred.
+
+    Returns {variant: (min_us, gbps_at_min, spread)} where spread =
+    max_round_us / min_round_us."""
+    import jax.numpy as jnp
+
+    built = {}
+    for variant in variants:
+        cache_kind, impl = variant.split(":")
+        loop, q0, kv_bytes = _build_kernel_loop(
+            cfg, paged=cache_kind == "paged", impl=impl,
+            n_slots=n_slots, ctx=ctx, max_len=max_len, iters=iters,
+        )
+        # Warm (compile + first run) outside every timed region.
+        float(jnp.sum(loop(q0).astype(jnp.float32)))
+        built[variant] = (loop, q0, kv_bytes)
+
+    times = {v: [] for v in variants}
+    for _ in range(rounds):
+        for variant in variants:
+            loop, q0, _ = built[variant]
+            t0 = time.perf_counter()
+            out = loop(q0)
+            # Host read forces completion (on the axon platform
+            # block_until_ready does not synchronize).
+            float(jnp.sum(out.astype(jnp.float32)))
+            times[variant].append(time.perf_counter() - t0)
+
+    results = {}
+    for variant in variants:
+        best, worst = min(times[variant]), max(times[variant])
+        kv_bytes = built[variant][2]
+        gbps = kv_bytes / (best / iters) / 1e9
+        results[variant] = (best / iters * 1e6, gbps, worst / best)
+    return results
 
 
 def prefix_bench(cfg, params, *, n_slots, ctx, max_len, rng):
@@ -242,7 +277,11 @@ def main():
     ap.add_argument("--ctx", type=int, default=2048)
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--ticks", type=int, default=50)
-    ap.add_argument("--kernel-iters", type=int, default=200)
+    ap.add_argument("--kernel-iters", type=int, default=50,
+                    help="decode-attention calls per timed scan segment")
+    ap.add_argument("--kernel-rounds", type=int, default=8,
+                    help="interleaved A/B timing rounds per variant "
+                         "(result = per-variant min)")
     ap.add_argument("--decode-ticks", type=int, default=1,
                     help="engine mode: decode steps per host sync")
     ap.add_argument("--mode", default="engine",
@@ -257,6 +296,27 @@ def main():
     args = ap.parse_args()
 
     import jax
+
+    if os.environ.get("SHELLAC_FORCE_CPU"):
+        # The sandbox sitecustomize registers the axon TPU plugin at
+        # interpreter startup; when the relay is wedged, initializing
+        # that backend hangs even under JAX_PLATFORMS=cpu. Overriding
+        # through jax.config before the first backend touch (the
+        # conftest.py recipe) is the reliable CPU path.
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 8)
+        except RuntimeError:
+            # Backend already initialized. If it initialized as CPU
+            # (in-process caller set the config first) that's fine;
+            # anything else would silently proceed onto the possibly
+            # wedged TPU relay — fail loudly instead.
+            if jax.default_backend() != "cpu":
+                raise SystemExit(
+                    "SHELLAC_FORCE_CPU is set but the jax backend was "
+                    f"already initialized as {jax.default_backend()!r}; "
+                    "run in a fresh process"
+                )
 
     from shellac_tpu import get_model_config
     from shellac_tpu.models import transformer
@@ -295,20 +355,25 @@ def main():
         return
 
     if args.mode == "kernel":
+        variants = args.variants.split(",")
+        measured = kernel_microbench_interleaved(
+            cfg, variants, n_slots=args.slots, ctx=args.ctx,
+            max_len=max_len, iters=args.kernel_iters,
+            rounds=args.kernel_rounds,
+        )
         results = {}
-        for variant in args.variants.split(","):
+        for variant, (us, gbps, spread) in measured.items():
             cache_kind, impl = variant.split(":")
-            us, gbps = kernel_microbench(
-                cfg, paged=cache_kind == "paged", impl=impl,
-                n_slots=args.slots, ctx=args.ctx, max_len=max_len,
-                iters=args.kernel_iters,
-            )
             row = {
                 "metric": f"decode_kernel_{args.model}_ctx{args.ctx}_"
                           f"{cache_kind}_{impl}_{backend}",
                 "value": round(us, 1),
-                "unit": "us/call",
-                "detail": {"kv_stream_gbps": round(gbps, 1)},
+                "unit": "us/call (min of interleaved rounds)",
+                "detail": {
+                    "kv_stream_gbps": round(gbps, 1),
+                    "round_spread": round(spread, 3),
+                    "rounds": args.kernel_rounds,
+                },
             }
             results[variant] = row
             print(json.dumps(row), flush=True)

@@ -1,45 +1,44 @@
 #!/bin/bash
 # Poll the wedged axon relay; when it recovers, capture the blocked TPU
-# evidence in priority order. Hard deadline (UTC hour:minute) keeps the
-# chip free for the driver's end-of-round bench run.
-#     bash scripts/relay_watchdog.sh [deadline_full_queue] [deadline_any]
-# Before deadline_full_queue (default 15:00Z): run parity + full queue.
-# Before deadline_any (default 15:40Z): run parity + one bench.py only.
+# evidence in priority order. Epoch-based deadline (survives midnight
+# wrap, unlike the round-3 HHMM comparison) keeps the chip free for the
+# driver's end-of-round bench run.
+#     bash scripts/relay_watchdog.sh [deadline_epoch] [results_file]
+# Re-arms after a mid-queue wedge: the queue is resumable (skips items
+# already recorded rc=0 in the results file), so each relay window
+# continues where the last one aborted.
 set -u
 cd "$(dirname "$0")/.."
-FULL_BY="${1:-1500}"
-ANY_BY="${2:-1540}"
+DEADLINE="${1:-$(( $(date +%s) + 10*3600 ))}"
+OUT="${2:-/root/repo/tpu_queue_r4.jsonl}"
 LOG=/root/repo/relay_watchdog.log
 
-now() { date -u +%H%M; }
 probe() {
   timeout 45 python -u -c \
     "import jax; assert jax.default_backend()=='tpu'" >/dev/null 2>&1
 }
 
-echo "watchdog start $(date -u +%FT%TZ)" >> "$LOG"
+echo "watchdog start $(date -u +%FT%TZ) deadline epoch $DEADLINE" >> "$LOG"
 while true; do
-  t=$(now)
-  if [ "$t" -ge "$ANY_BY" ]; then
-    echo "deadline passed ($t >= $ANY_BY); giving up" >> "$LOG"
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "deadline passed; giving up $(date -u +%FT%TZ)" >> "$LOG"
     exit 0
   fi
   if probe; then
     echo "relay UP at $(date -u +%FT%TZ)" >> "$LOG"
-    # 1. Parity first, stderr captured this time.
-    timeout 580 python scripts/tpu_parity_decode.py \
-      > /root/repo/parity_out.json 2> /root/repo/parity_err.txt
-    echo "parity rc=$?" >> "$LOG"
-    if [ "$(now)" -lt "$FULL_BY" ]; then
-      bash scripts/run_tpu_queue.sh /root/repo/tpu_queue_results.jsonl \
-        >> "$LOG" 2>&1
-      echo "queue rc=$?" >> "$LOG"
-    else
-      timeout 570 python bench.py \
-        > /root/repo/bench_tpu_late.json 2>> "$LOG"
-      echo "late bench rc=$?" >> "$LOG"
+    # The queue enforces the deadline itself (exit 5), so a window
+    # opening just before the deadline cannot hold the chip past it.
+    bash scripts/run_tpu_queue.sh "$OUT" "$DEADLINE" >> "$LOG" 2>&1
+    rc=$?
+    echo "queue rc=$rc at $(date -u +%FT%TZ)" >> "$LOG"
+    if [ $rc -eq 0 ] || [ $rc -eq 5 ]; then
+      echo "watchdog done (queue rc=$rc)" >> "$LOG"
+      exit 0
     fi
-    exit 0
+    # rc=3 relay wedged before start, rc=4 wedged mid-queue: keep
+    # polling, the queue resumes from the last completed item.
+    sleep 120
+  else
+    sleep 180
   fi
-  sleep 240
 done
