@@ -436,14 +436,17 @@ class ModelConfig:
 class ParallelConfig:
     """Sizes of the device-mesh axes.
 
-    The mesh is laid out (dp, fsdp, pp, sp, tp) from outermost
+    The mesh is laid out (dp, fsdp, pp, ep, sp, tp) from outermost
     (DCN-friendly) to innermost (ICI-friendly): tensor parallelism
     generates the most traffic per step so it rides the fastest links.
 
     - dp:   pure data parallelism (gradients all-reduced)
     - fsdp: data parallelism with parameter/optimizer sharding (ZeRO-3)
-    - pp:   pipeline-stage axis (reserved by the mesh; pipelined
-            execution itself is a planned module)
+    - pp:   pipeline-stage axis (GPipe-style microbatched execution,
+            parallel/pipeline.py)
+    - ep:   expert parallelism — MoE expert weights and capacity
+            buckets shard over ep; XLA inserts the token all-to-all at
+            the dispatch/combine resharding boundaries (ops/moe.py)
     - sp:   sequence/context parallelism (ring attention)
     - tp:   tensor (megatron-style) parallelism within a layer
     """
@@ -453,10 +456,11 @@ class ParallelConfig:
     sp: int = 1
     tp: int = 1
     pp: int = 1
+    ep: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp * self.pp
+        return self.dp * self.fsdp * self.sp * self.tp * self.pp * self.ep
 
     def replace(self, **kw) -> "ParallelConfig":
         return dataclasses.replace(self, **kw)

@@ -649,6 +649,7 @@ def _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache, moe_layer,
         down, aux, metrics = moe_ffn(
             hx, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
             cfg.moe, drop_tokens=not (is_decode or cfg.moe.dropless),
+            mesh=mesh,
             # Strict lookups for biased gates: a missing bias must be a
             # loud KeyError, not a silent zero (it changes which experts
             # are selected / what they compute).

@@ -8,7 +8,14 @@ TPU-first choices:
     traffic, instead of the classic one-hot dispatch einsum whose
     T·E·C·D MXU cost dwarfs the expert matmuls at long sequence.
   - Expert FFNs run as one batched einsum over the expert axis, sharded
-    over the mesh's expert (fsdp) axis; GSPMD inserts the all-to-alls.
+    over the mesh's (ep, fsdp) axes; GSPMD inserts the collectives.
+  - Expert parallelism is pure sharding: the dispatched capacity
+    buckets (E, C, D) are constrained to shard E over the ep axis, so
+    the scatter that builds them reshards token-sharded activations to
+    expert-sharded buckets — that resharding IS the all-to-all, chosen
+    by XLA (an explicit shard_map ppermute would hand-schedule what
+    GSPMD already lays out). The expert FFN einsums are then local to
+    each ep group, and the combine gather reshards back.
   - Router math in fp32, with load-balance and router-z auxiliary losses.
 """
 
@@ -21,6 +28,7 @@ import jax.numpy as jnp
 
 from shellac_tpu.config import MoEConfig
 from shellac_tpu.ops.quant import materialize
+from shellac_tpu.parallel.sharding import constrain
 
 
 def expert_capacity(cfg: MoEConfig, num_tokens: int) -> int:
@@ -145,6 +153,7 @@ def moe_ffn(
     cfg: MoEConfig,
     *,
     drop_tokens: bool = True,
+    mesh=None,
     b_router: jax.Array | None = None,
     b_gate: jax.Array | None = None,  # (E, F)
     b_up: jax.Array | None = None,  # (E, F)
@@ -162,6 +171,16 @@ def moe_ffn(
     t = b * s
     c = expert_capacity(cfg, t) if drop_tokens else t
     cdt = x.dtype
+    if mesh is not None:
+        from shellac_tpu.parallel.mesh import AXIS_EXPERT, AXIS_FSDP
+
+        shards = mesh.shape.get(AXIS_EXPERT, 1) * mesh.shape.get(AXIS_FSDP, 1)
+        if e % shards:
+            raise ValueError(
+                f"num_experts={e} must divide evenly over the expert "
+                f"shards (ep*fsdp={shards}); uneven splits silently "
+                "pad and waste MXU time"
+            )
 
     x2 = x.reshape(t, d)
     slot, weight, aux, metrics = route(
@@ -174,7 +193,13 @@ def moe_ffn(
     flat_slot = slot.reshape(-1)  # (T*k,)
     x_rep = jnp.repeat(x2, k, axis=0)  # (T*k, D) — token for each assignment
     buckets = buckets.at[flat_slot].add(x_rep, mode="drop")
-    dispatched = buckets[: e * c].reshape(e, c, d)
+    # Dispatch boundary: constrain the buckets to expert sharding. The
+    # scatter's input is token-sharded (batch over dp/fsdp, seq over
+    # sp); forcing its output onto the ep axis here is what makes XLA
+    # emit the token all-to-all instead of replicating the buckets.
+    dispatched = constrain(
+        buckets[: e * c].reshape(e, c, d), mesh, ("experts", None, None)
+    )
 
     # Expert FFNs: batched over the expert axis (sharded over 'fsdp').
     gate = jnp.einsum("ecd,edf->ecf", dispatched, materialize(w_gate, cdt),
@@ -196,8 +221,10 @@ def moe_ffn(
         act = (up + 1.0) * (gate * jax.nn.sigmoid(1.702 * gate))
     else:
         act = jax.nn.silu(gate) * up
+    act = constrain(act, mesh, ("experts", None, "mlp"))
     out_e = jnp.einsum("ecf,efd->ecd", act, materialize(w_down, cdt),
                        preferred_element_type=jnp.float32).astype(cdt)
+    out_e = constrain(out_e, mesh, ("experts", None, None))
     if b_down is not None:
         # The per-expert output bias applies to every ROUTED token's
         # expert output (dropped tokens still get zeros downstream).

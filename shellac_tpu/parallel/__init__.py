@@ -1,5 +1,6 @@
 from shellac_tpu.parallel.mesh import (
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_PIPE,
     AXIS_SEQ,
@@ -19,6 +20,7 @@ from shellac_tpu.parallel.sharding import (
 
 __all__ = [
     "AXIS_DATA",
+    "AXIS_EXPERT",
     "AXIS_FSDP",
     "AXIS_PIPE",
     "AXIS_SEQ",

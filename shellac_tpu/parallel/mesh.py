@@ -19,13 +19,18 @@ from shellac_tpu.config import ParallelConfig
 
 # Canonical mesh-axis names, outermost first. dp/fsdp tolerate the slower
 # (DCN) links; sp/tp want the fastest (ICI) links, so they are innermost.
+# ep sits between pp and sp: the MoE all-to-all moves one activation's
+# worth of tokens per layer — more traffic than a pipeline bubble, less
+# than tp's per-matmul collectives.
 AXIS_DATA = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_SEQ = "sp"
 AXIS_TENSOR = "tp"
 AXIS_PIPE = "pp"
+AXIS_EXPERT = "ep"
 
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_SEQ, AXIS_TENSOR)
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_EXPERT, AXIS_SEQ,
+             AXIS_TENSOR)
 
 
 def make_mesh(
@@ -48,9 +53,11 @@ def make_mesh(
         raise ValueError(
             f"ParallelConfig asks for {parallel.num_devices} devices "
             f"(dp={parallel.dp} fsdp={parallel.fsdp} pp={parallel.pp} "
-            f"sp={parallel.sp} tp={parallel.tp}) but {n} are available"
+            f"ep={parallel.ep} sp={parallel.sp} tp={parallel.tp}) but "
+            f"{n} are available"
         )
-    shape = (parallel.dp, parallel.fsdp, parallel.pp, parallel.sp, parallel.tp)
+    shape = (parallel.dp, parallel.fsdp, parallel.pp, parallel.ep,
+             parallel.sp, parallel.tp)
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=list(devices))
     except Exception:

@@ -15,6 +15,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from shellac_tpu.parallel.mesh import (
     AXIS_DATA,
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_PIPE,
     AXIS_SEQ,
@@ -36,7 +37,13 @@ DEFAULT_RULES: Tuple[Tuple[str, Union[None, str, Tuple[str, ...]]], ...] = (
     ("kv_heads", AXIS_TENSOR),
     ("head_dim", None),
     ("mlp", AXIS_TENSOR),
-    ("experts", AXIS_FSDP),
+    # Expert weights AND the dispatched capacity buckets shard the E
+    # dim over (ep, fsdp): with ep=1 this is round-3's ZeRO-style
+    # memory sharding; with ep>1 each ep group owns E/ep experts and
+    # the expert FFN einsums are fully local — XLA inserts the token
+    # all-to-all at the scatter (dispatch) / gather (combine)
+    # resharding boundaries in ops/moe.py.
+    ("experts", (AXIS_EXPERT, AXIS_FSDP)),
     # Stacked layers shard over the pipeline axis: with pp=1 this is a
     # no-op; with pp>1 each device holds its own pipeline stage's layers.
     ("layers", AXIS_PIPE),

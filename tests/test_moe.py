@@ -108,7 +108,10 @@ class TestMoEModel:
 
         mesh = make_mesh(ParallelConfig(fsdp=4, tp=2))
         state_s = init_train_state(cfg, tcfg, jax.random.PRNGKey(0), mesh=mesh)
-        assert state_s.params["layers"]["w_gate"].sharding.spec[1] == "fsdp"
+        # Experts shard over (ep, fsdp); with ep=1 that is fsdp sharding.
+        assert state_s.params["layers"]["w_gate"].sharding.spec[1] == (
+            "ep", "fsdp",
+        )
         step_s = make_train_step(cfg, tcfg, mesh=mesh)
         bs = batch_shardings(mesh)
         batch_s = jax.tree.map(lambda x: jax.device_put(x, bs), batch)
