@@ -46,11 +46,13 @@ def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
     if paged:
         # Page size 64: large enough that the paged kernel's per-page
         # DMA is a real tile (64 x 128), small enough that short
-        # requests still share the pool at fine grain.
+        # requests still share the pool at fine grain (and 32-aligned,
+        # as int8 pools require).
         return PagedBatchingEngine(
             cfg, params, n_slots=n_slots, max_len=max_len,
             block_size=64, pool_tokens=n_slots * max_len,
             temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
+            kv_quant=kv_quant,
         )
     return BatchingEngine(
         cfg, params, n_slots=n_slots, max_len=max_len,
@@ -398,10 +400,7 @@ def main():
                 "a windowed preset)"
             )
         rng = np.random.default_rng(0)
-        kvq = None if (paged or rolling) else args.kv_quant
-        if paged and args.kv_quant:
-            print(f"note: --kv-quant skipped for {variant} "
-                  "(paged pools are bf16-only)", file=sys.stderr)
+        kvq = args.kv_quant
         tok_s, tick_s = steady_state(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, ticks=args.ticks, rng=rng,

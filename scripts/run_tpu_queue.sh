@@ -13,7 +13,10 @@
 # the relay.
 set -u
 cd "$(dirname "$0")/.."
-OUT="${1:-/tmp/tpu_queue_results.jsonl}"
+# Default matches bench.py's latest_queue_tpu_line() replay path, so a
+# manually-run queue's captured TPU headline is visible to the
+# wedged-relay fallback too.
+OUT="${1:-/root/repo/tpu_queue_r4.jsonl}"
 DEADLINE="${2:-}"   # optional epoch seconds; stop (exit 5) when reached
 
 probe() {
@@ -114,6 +117,8 @@ run engine_mla 580 python scripts/bench_decode.py \
   --model shellac-mla-2b --variants dense:auto,dense:ref --decode-ticks 8
 run engine_kvq 580 python scripts/bench_decode.py \
   --variants dense:auto --decode-ticks 8 --kv-quant int8
+run engine_kvq_paged 580 python scripts/bench_decode.py \
+  --variants paged:auto --decode-ticks 8 --kv-quant int8
 run engine_rolling 580 python scripts/bench_decode.py \
   --variants dense:auto,rolling:ref --window 1024 --decode-ticks 8
 

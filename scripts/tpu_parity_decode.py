@@ -141,6 +141,55 @@ def quant_cache_cases(checks):
         )
 
 
+def quant_paged_cases(checks):
+    """int8 paged pool: grouped-gather kernel with scale pages, compiled."""
+    from shellac_tpu.inference.kvcache import (
+        paged_gather_layer,
+        paged_gather_scales,
+        quantize_kv,
+    )
+    from shellac_tpu.ops.decode_attention import (
+        _decode_ref,
+        paged_decode_attention,
+    )
+
+    B, H, HKV, D = 4, 16, 8, 128
+    for s, window, bs, mb in [(1, None, 32, 32), (1, 200, 32, 32),
+                              (1, None, 64, 16), (2, None, 64, 16)]:
+        n_blocks = B * mb + 1
+        ks = jax.random.split(jax.random.PRNGKey(s * 7 + (window or 1)), 3)
+        q = jax.random.normal(ks[0], (B, s, H, D), jnp.bfloat16)
+        kf = jax.random.normal(ks[1], (n_blocks, bs, HKV, D), jnp.float32)
+        vf = jax.random.normal(ks[2], (n_blocks, bs, HKV, D), jnp.float32)
+        kq, ksc = quantize_kv(kf)
+        vq, vsc = quantize_kv(vf)
+        pool_k = kq.transpose(0, 2, 1, 3)
+        pool_v = vq.transpose(0, 2, 1, 3)
+        pks = ksc.transpose(0, 2, 1)
+        pvs = vsc.transpose(0, 2, 1)
+        rng = np.random.default_rng(s)
+        tables = jnp.asarray(
+            (rng.permutation(n_blocks - 1) + 1).reshape(B, mb), jnp.int32
+        )
+        L = mb * bs
+        index = jnp.array([0, 37, 519, L - s], jnp.int32)
+        out = paged_decode_attention(
+            q, pool_k, pool_v, tables, index, window=window,
+            impl="flash", interpret=False, k_scale=pks, v_scale=pvs,
+        )
+        k_all, v_all = paged_gather_layer(pool_k, pool_v, tables)
+        ref = _decode_ref(
+            q, k_all, v_all, index, window, D ** -0.5,
+            k_scale=paged_gather_scales(pks, tables),
+            v_scale=paged_gather_scales(pvs, tables),
+        )
+        check(
+            f"paged int8 s={s} window={window} bs={bs} shuffled-table",
+            out.astype(jnp.float32), ref.astype(jnp.float32),
+            atol=2e-2, checks=checks,
+        )
+
+
 def flash_train_cases(checks):
     from shellac_tpu.ops.attention import attention_ref
     from shellac_tpu.ops.flash_attention import flash_attention
@@ -359,6 +408,7 @@ def main():
     dense_decode_cases(checks)
     paged_decode_cases(checks)
     quant_cache_cases(checks)
+    quant_paged_cases(checks)
     flash_train_cases(checks)
     head_dim_64_cases(checks)
     mla_shape_cases(checks)

@@ -717,15 +717,13 @@ def cmd_serve(args):
                          "per step; --decode-ticks must stay 1")
     if args.draft_model and args.prefill_chunk is not None:
         raise SystemExit("--draft-model does not support --prefill-chunk")
-    if args.kv_quant and args.paged:
-        raise SystemExit("--kv-quant is dense-cache only; drop --paged")
     if args.kv_quant and args.draft_model:
         raise SystemExit("--kv-quant does not compose with --draft-model")
     if args.rolling_window and (args.paged or args.draft_model):
         raise SystemExit(
             "--rolling-window is a dense-cache feature (no --paged or "
-            "--draft-model; --kv-quant composes on uniformly-windowed "
-            "models)"
+            "--draft-model; --kv-quant composes on both uniform-window "
+            "and patterned models)"
         )
 
     from shellac_tpu.parallel.distributed import initialize
@@ -792,8 +790,12 @@ def cmd_serve(args):
         )
 
         kind = PagedBatchingEngine if args.paged else BatchingEngine
-        extra = ({"prefix_cache": args.prefix_cache} if args.paged
-                 else {"rolling_window": args.rolling_window})
+        if args.paged:
+            extra = {"prefix_cache": args.prefix_cache}
+            bs = args.block_size or (64 if args.kv_quant else 16)
+            extra["block_size"] = bs
+        else:
+            extra = {"rolling_window": args.rolling_window}
         engine = kind(
             cfg, params, n_slots=args.slots,
             max_len=args.max_len or cfg.max_seq_len,
@@ -1088,7 +1090,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--kv-quant", choices=["int8"], default=None,
                    dest="kv_quant",
                    help="int8 KV cache: half the cache memory and HBM "
-                        "stream per decode tick (dense cache only)")
+                        "stream per decode tick (dense, rolling on "
+                        "uniform windows, and paged pools)")
+    s.add_argument("--block-size", type=int, default=None, dest="block_size",
+                   help="paged pool page size (default 16; int8 pools "
+                        "need a multiple of 32 and default to 64)")
     s.add_argument("--prefix-cache", action="store_true", dest="prefix_cache",
                    help="reuse cached KV blocks across prompts sharing a "
                         "prefix (requires --paged)")

@@ -4,6 +4,8 @@ from shellac_tpu.inference.kvcache import (
     KVCache,
     PatternedKVCache,
     QuantKVCache,
+    QuantPagedKVCache,
+    QuantPatternedKVCache,
     QuantRollingKVCache,
     RollingKVCache,
     cache_logical_axes,
@@ -24,6 +26,8 @@ __all__ = [
     "KVCache",
     "PatternedKVCache",
     "QuantKVCache",
+    "QuantPagedKVCache",
+    "QuantPatternedKVCache",
     "QuantRollingKVCache",
     "RollingKVCache",
     "init_cache",

@@ -44,12 +44,15 @@ from shellac_tpu.inference.kvcache import (
     KVCache,
     PagedKVCache,
     QuantKVCache,
+    QuantPagedKVCache,
     cache_logical_axes,
     init_cache,
     init_cache_for,
     init_paged_cache,
+    init_quant_paged_cache,
     paged_cache_logical_axes,
     quant_cache_logical_axes,
+    quant_paged_cache_logical_axes,
     scatter_slot,
     slot_view,
 )
@@ -296,7 +299,9 @@ class BatchingEngine:
             return
         from shellac_tpu.inference.kvcache import cache_logical_axes_for
 
-        if isinstance(self._cache, PagedKVCache):
+        if isinstance(self._cache, QuantPagedKVCache):
+            axes = quant_paged_cache_logical_axes(self.cfg)
+        elif isinstance(self._cache, PagedKVCache):
             axes = paged_cache_logical_axes(self.cfg)
         else:
             # The single cache-kind dispatch (kvcache) — the axes tree
@@ -1024,10 +1029,14 @@ class PagedBatchingEngine(BatchingEngine):
         prefix_cache: bool = False,
         **kw,
     ):
-        if kw.get("kv_quant") is not None:
-            raise NotImplementedError(
-                "kv_quant is dense-cache only for now (the paged pool "
-                "kernels and gather path do not carry scales yet)"
+        if kw.get("kv_quant") == "int8" and block_size % 32:
+            # The int8 grouped-gather kernel lands each page at sublane
+            # offset g*bs of its VMEM tile; int8's native (32, 128)
+            # tiling makes 32 the alignment unit. An engine knob, so an
+            # error beats a per-tick fallback warning.
+            raise ValueError(
+                f"kv_quant='int8' paged pools need block_size % 32 == 0 "
+                f"(got {block_size}); use 32 or 64"
             )
         super().__init__(cfg, params, n_slots=n_slots, max_len=max_len, **kw)
         self.block_size = block_size
@@ -1036,7 +1045,9 @@ class PagedBatchingEngine(BatchingEngine):
         if pool_tokens is None:
             pool_tokens = n_slots * self.max_len // 2
         n_blocks = max(-(-pool_tokens // block_size), max_blocks_per_slot) + 1
-        self._cache = init_paged_cache(
+        init_pool = (init_quant_paged_cache if self.kv_quant == "int8"
+                     else init_paged_cache)
+        self._cache = init_pool(
             cfg, n_slots, n_blocks, block_size, max_blocks_per_slot
         )
         self._mesh_setup()  # re-pin shardings for the paged pytree
@@ -1297,10 +1308,16 @@ class PagedBatchingEngine(BatchingEngine):
         back (warning) on a prefill-sized s.
         """
         row = jax.lax.dynamic_slice_in_dim(cache.tables, slot, 1, 0)
-        view = PagedKVCache(
-            k=cache.k, v=cache.v, tables=row,
-            lengths=prefix_len.astype(jnp.int32),
-        )
+        if self.kv_quant == "int8":
+            view = QuantPagedKVCache(
+                k=cache.k, v=cache.v, ks=cache.ks, vs=cache.vs,
+                tables=row, lengths=prefix_len.astype(jnp.int32),
+            )
+        else:
+            view = PagedKVCache(
+                k=cache.k, v=cache.v, tables=row,
+                lengths=prefix_len.astype(jnp.int32),
+            )
         logits, view = transformer.forward_with_cache(
             self.cfg, params, tokens, view, new_tokens_len=suffix_len,
             fresh_cache=False, attn_impl="ref", mesh=self.mesh,
@@ -1309,21 +1326,25 @@ class PagedBatchingEngine(BatchingEngine):
             logits, (suffix_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first, first_lp = self._sample_first(key, last, samp)
-        cache = cache.replace(
+        fields = dict(
             k=view.k, v=view.v,
             lengths=jax.lax.dynamic_update_slice(
                 cache.lengths, view.lengths, (slot,)
             ),
         )
+        if self.kv_quant == "int8":
+            fields.update(ks=view.ks, vs=view.vs)
+        cache = cache.replace(**fields)
         return cache, first, first_lp
 
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
                       samp, want_plp: bool = False):
-        """Dense mini-prefill, then scatter through the slot's table.
-        (want_plp is rejected at submit for paged engines; the dummy
-        return keeps the base _run_prefill's 4-output contract.)"""
+        """Mini-prefill (dense bf16 or int8+scales, matching the pool's
+        kind), then scatter through the slot's table. (want_plp is
+        rejected at submit for paged engines; the dummy return keeps
+        the base _run_prefill's 4-output contract.)"""
         s = tokens.shape[1]
-        mini = init_cache(self.cfg, 1, s)
+        mini = init_cache_for(self.cfg, 1, s, self.kv_quant)
         logits, mini = transformer.forward_with_cache(
             self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
             fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
@@ -1343,13 +1364,24 @@ class PagedBatchingEngine(BatchingEngine):
         # value wants token rows leading: (S, L, Hkv, Dh).
         k_src = mini.k[:, 0].astype(cache.k.dtype).transpose(2, 0, 1, 3)
         v_src = mini.v[:, 0].astype(cache.v.dtype).transpose(2, 0, 1, 3)
-        cache = cache.replace(
+        fields = dict(
             k=cache.k.at[:, blocks, :, offs].set(k_src),
             v=cache.v.at[:, blocks, :, offs].set(v_src),
             lengths=jax.lax.dynamic_update_slice(
                 cache.lengths, mini.lengths, (slot,)
             ),
         )
+        if self.kv_quant == "int8":
+            # The quant mini already quantized at write (K post-rope);
+            # its scales scatter through the same (block, off) coords —
+            # scale pools are (L, nb, Hkv, bs), value rows (S, L, Hkv).
+            fields["ks"] = cache.ks.at[:, blocks, :, offs].set(
+                mini.ks[:, 0].transpose(2, 0, 1)
+            )
+            fields["vs"] = cache.vs.at[:, blocks, :, offs].set(
+                mini.vs[:, 0].transpose(2, 0, 1)
+            )
+        cache = cache.replace(**fields)
         return cache, first, first_lp, jnp.zeros(
             (tokens.shape[1],), jnp.float32
         )

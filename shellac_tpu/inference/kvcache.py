@@ -126,12 +126,18 @@ def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
     """The engines' cache constructor: dense bf16, int8, or a rolling
     ring buffer (sliding-window models) by flags."""
     if rolling:
+        if kv_quant is not None and kv_quant != "int8":
+            raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
+        patterned = (cfg.attn_pattern is not None
+                     and "full" in cfg.attn_pattern)
         if kv_quant == "int8":
+            if patterned:
+                return init_quant_patterned_cache(
+                    cfg, batch, max_len, chunk_slack=chunk_slack
+                )
             return init_quant_rolling_cache(cfg, batch, max_len,
                                             chunk_slack=chunk_slack)
-        if kv_quant is not None:
-            raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
-        if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
+        if patterned:
             return init_patterned_cache(cfg, batch, max_len,
                                         chunk_slack=chunk_slack)
         return init_rolling_cache(cfg, batch, max_len,
@@ -149,9 +155,13 @@ def cache_logical_axes_for(cfg: ModelConfig, kv_quant=None,
     flags — the single place the cache-kind dispatch lives, so jit
     out_shardings can never desync from the cache pytree."""
     if rolling:
+        patterned = (cfg.attn_pattern is not None
+                     and "full" in cfg.attn_pattern)
         if kv_quant == "int8":
+            if patterned:
+                return quant_patterned_cache_logical_axes(cfg)
             return quant_rolling_cache_logical_axes(cfg)
-        if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
+        if patterned:
             return patterned_cache_logical_axes(cfg)
         return rolling_cache_logical_axes(cfg)
     if kv_quant == "int8":
@@ -242,7 +252,11 @@ def scatter_slot(cache, mini, slot):
     def upd(c, n):
         return jax.lax.dynamic_update_slice_in_dim(c, n, slot, axis=1)
 
-    if isinstance(cache, PatternedKVCache):
+    if isinstance(cache, QuantPatternedKVCache):
+        fields = {n: upd(getattr(cache, n), getattr(mini, n))
+                  for n in ("kw", "vw", "kws", "vws",
+                            "kf", "vf", "kfs", "vfs")}
+    elif isinstance(cache, PatternedKVCache):
         fields = {n: upd(getattr(cache, n), getattr(mini, n))
                   for n in ("kw", "vw", "kf", "vf")}
     else:
@@ -263,7 +277,11 @@ def slot_view(cache, slot, lengths):
     def sl(c):
         return jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
 
-    if isinstance(cache, PatternedKVCache):
+    if isinstance(cache, QuantPatternedKVCache):
+        fields = {n: sl(getattr(cache, n))
+                  for n in ("kw", "vw", "kws", "vws",
+                            "kf", "vf", "kfs", "vfs")}
+    elif isinstance(cache, PatternedKVCache):
         fields = {n: sl(getattr(cache, n))
                   for n in ("kw", "vw", "kf", "vf")}
     else:
@@ -380,6 +398,114 @@ def paged_gather_layer(
         return x.reshape(b, hkv, mb * bs, dh)
 
     return gather(pool_k), gather(pool_v)
+
+
+# ---------------------------------------------------------------------------
+# Int8-quantized paged cache (pool memory/bandwidth: half of bf16)
+# ---------------------------------------------------------------------------
+
+
+@flax.struct.dataclass
+class QuantPagedKVCache:
+    """Paged block pool stored int8 with per-token/head dequant scales.
+
+    Same block-table indirection, scratch-block-0 convention, and
+    host-side allocator contract as PagedKVCache; same write-time
+    symmetric quantization contract as QuantKVCache (K quantized after
+    RoPE). Scale pools mirror the value pools block-for-block — one
+    allocator run covers both, so the free list and prefix-cache
+    refcounts need no changes.
+
+    k, v: (L, n_blocks, Hkv, block_size, Dh) int8
+    ks, vs: (L, n_blocks, Hkv, block_size) fp32
+    tables: (n_slots, max_blocks) int32
+    lengths: (n_slots,) int32
+    """
+
+    k: Any
+    v: Any
+    ks: Any
+    vs: Any
+    tables: Any
+    lengths: Any
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def max_blocks(self) -> int:
+        return self.tables.shape[1]
+
+
+def init_quant_paged_cache(
+    cfg: ModelConfig,
+    n_slots: int,
+    n_blocks: int,
+    block_size: int,
+    max_blocks_per_slot: int,
+) -> QuantPagedKVCache:
+    head = (cfg.n_layers, n_blocks, cfg.cache_kv_heads, block_size)
+    return QuantPagedKVCache(
+        k=jnp.zeros((*head, cfg.cache_head_dim), jnp.int8),
+        v=jnp.zeros((*head, cfg.cache_v_head_dim), jnp.int8),
+        ks=jnp.zeros(head, jnp.float32),
+        vs=jnp.zeros(head, jnp.float32),
+        tables=jnp.zeros((n_slots, max_blocks_per_slot), jnp.int32),
+        lengths=jnp.zeros((n_slots,), jnp.int32),
+    )
+
+
+def quant_paged_cache_logical_axes(cfg: Optional[ModelConfig] = None):
+    heads = "kv_heads" if cfg is None or cfg.mla is None else None
+    return QuantPagedKVCache(
+        k=("layers", None, heads, None, None),
+        v=("layers", None, heads, None, None),
+        ks=("layers", None, heads, None),
+        vs=("layers", None, heads, None),
+        tables=(None, None),
+        lengths=(None,),
+    )
+
+
+def quant_paged_update_layer(
+    pool_k, pool_v, pool_ks, pool_vs,  # one layer's int8 pools + scales
+    k_new, v_new,  # (B, S, Hkv, Dh) unquantized
+    index,  # (B,) int32 — per-slot write offsets (token positions)
+    tables,  # (B, max_blocks) int32
+):
+    """Quantize S new positions, scatter values and scales through the
+    block tables (same position->block arithmetic as the bf16 pool)."""
+    kq, ks = quantize_kv(k_new)
+    vq, vs = quantize_kv(v_new)
+    pk, pv = paged_update_layer(pool_k, pool_v, kq, vq, index, tables)
+    bs = pool_k.shape[2]
+    b, s = k_new.shape[:2]
+    pos = index[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+    block_ids = jnp.take_along_axis(tables, pos // bs, axis=1)
+    flat_blocks = block_ids.reshape(-1)
+    flat_offs = (pos % bs).reshape(-1)
+    pks = pool_ks.at[flat_blocks, :, flat_offs].set(
+        ks.reshape(b * s, -1)
+    )
+    pvs = pool_vs.at[flat_blocks, :, flat_offs].set(
+        vs.reshape(b * s, -1)
+    )
+    return pk, pv, pks, pvs
+
+
+def paged_gather_scales(
+    pool_s: jax.Array,  # (n_blocks, Hkv, bs)
+    tables: jax.Array,  # (B, max_blocks)
+):
+    """Materialize each slot's logical scale view: (B, Hkv, max_blocks*bs)
+    — the dense QuantKVCache scale layout, so the dequant fallback
+    consumes it directly."""
+    b, mb = tables.shape
+    hkv, bs = pool_s.shape[1:]
+    x = jnp.take(pool_s, tables.reshape(-1), axis=0)  # (B*mb, Hkv, bs)
+    x = x.reshape(b, mb, hkv, bs).transpose(0, 2, 1, 3)
+    return x.reshape(b, hkv, mb * bs)
 
 
 # ---------------------------------------------------------------------------
@@ -623,9 +749,11 @@ def init_quant_rolling_cache(
             "rolling cache needs a sliding-window model (attn_window)"
         )
     if cfg.attn_pattern is not None and "full" in cfg.attn_pattern:
-        raise NotImplementedError(
-            "int8 x rolling covers uniformly-windowed models; patterned "
-            "stacks use the bf16 mixed cache or the dense int8 cache"
+        raise ValueError(
+            "patterned local/global stacks roll int8 via the quant "
+            "MIXED cache — use init_quant_patterned_cache "
+            "(init_cache_for routes there automatically); this "
+            "constructor builds the uniform int8 ring"
         )
     ring = rolling_ring(cfg, max_len, chunk_slack)
     head = (cfg.n_layers, batch, cfg.cache_kv_heads, ring)
@@ -645,6 +773,74 @@ def quant_rolling_cache_logical_axes(cfg: Optional[ModelConfig] = None):
         ks=("layers", "batch", "kv_heads", None),
         vs=("layers", "batch", "kv_heads", None),
         lengths=("batch",),
+    )
+
+
+@flax.struct.dataclass
+class QuantPatternedKVCache:
+    """Int8 mixed cache: the patterned cache's window-sized rings for
+    "window" layers and dense max_len stacks for "full" layers, all
+    stored int8 with per-token/head scales. Same layer->row mapping as
+    PatternedKVCache, same write-time quantization contract as
+    QuantKVCache (K post-rope). Window layers ring-write values AND
+    scales (quant_roll_update_layer); full layers take the dense int8
+    decode path (scales carried by the kernel or dequant reference).
+    """
+
+    kw: Any  # (Lw, B, Hkv, ring, Dh) int8
+    vw: Any
+    kws: Any  # (Lw, B, Hkv, ring) fp32
+    vws: Any
+    kf: Any  # (Lf, B, Hkv, max_len, Dh) int8
+    vf: Any
+    kfs: Any  # (Lf, B, Hkv, max_len) fp32
+    vfs: Any
+    lengths: Any  # (B,) int32 — TOTAL positions (shared by both kinds)
+
+    @property
+    def ring(self) -> int:
+        return self.kw.shape[3]
+
+    @property
+    def dense_len(self) -> int:
+        return self.kf.shape[3]
+
+
+def init_quant_patterned_cache(
+    cfg: ModelConfig, batch: int, max_len: int, chunk_slack: int = 1,
+) -> QuantPatternedKVCache:
+    if cfg.attn_pattern is None or "window" not in cfg.attn_pattern:
+        raise ValueError(
+            "patterned cache needs an attn_pattern with 'window' layers"
+        )
+    if "full" not in cfg.attn_pattern:
+        raise ValueError(
+            "uniformly-windowed patterns use the plain rolling cache"
+        )
+    ring = rolling_ring(cfg, max_len, chunk_slack)
+    nw, nf = pattern_kind_counts(cfg)
+    groups = cfg.n_layers // len(cfg.attn_pattern)
+    dh = cfg.cache_head_dim
+    hkv = cfg.cache_kv_heads
+    return QuantPatternedKVCache(
+        kw=jnp.zeros((groups * nw, batch, hkv, ring, dh), jnp.int8),
+        vw=jnp.zeros((groups * nw, batch, hkv, ring, dh), jnp.int8),
+        kws=jnp.zeros((groups * nw, batch, hkv, ring), jnp.float32),
+        vws=jnp.zeros((groups * nw, batch, hkv, ring), jnp.float32),
+        kf=jnp.zeros((groups * nf, batch, hkv, max_len, dh), jnp.int8),
+        vf=jnp.zeros((groups * nf, batch, hkv, max_len, dh), jnp.int8),
+        kfs=jnp.zeros((groups * nf, batch, hkv, max_len), jnp.float32),
+        vfs=jnp.zeros((groups * nf, batch, hkv, max_len), jnp.float32),
+        lengths=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def quant_patterned_cache_logical_axes(cfg: Optional[ModelConfig] = None):
+    val = ("layers", "batch", "kv_heads", None, None)
+    sc = ("layers", "batch", "kv_heads", None)
+    return QuantPatternedKVCache(
+        kw=val, vw=val, kws=sc, vws=sc,
+        kf=val, vf=val, kfs=sc, vfs=sc, lengths=("batch",),
     )
 
 

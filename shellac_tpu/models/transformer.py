@@ -492,15 +492,26 @@ def _block(
                                 window=window, sinks=sinks)
     elif page_tables is not None:
         from shellac_tpu.inference.kvcache import (
-            paged_gather_layer,
             paged_update_layer,
+            quant_paged_update_layer,
         )
 
         pool_k, pool_v, index, q_positions = cache  # pool: (nb, Hkv, bs, D)
-        pool_k, pool_v = paged_update_layer(
-            pool_k, pool_v, k, v, index, page_tables
-        )
-        new_cache = (pool_k, pool_v)
+        if kv_scales is not None:
+            # Int8 pool: quantize at write (K post-rope, the
+            # QuantKVCache contract); scale pools scatter through the
+            # same block tables.
+            ks_l, vs_l = kv_scales
+            pool_k, pool_v, ks_l, vs_l = quant_paged_update_layer(
+                pool_k, pool_v, ks_l, vs_l, k, v, index, page_tables
+            )
+            new_cache = (pool_k, pool_v, ks_l, vs_l)
+        else:
+            ks_l = vs_l = None
+            pool_k, pool_v = paged_update_layer(
+                pool_k, pool_v, k, v, index, page_tables
+            )
+            new_cache = (pool_k, pool_v)
         if fresh_cache:
             o = attention(
                 q, k, v, causal=True, window=window, impl=attn_impl,
@@ -516,7 +527,7 @@ def _block(
                 q, pool_k, pool_v, page_tables, index,
                 window=window, impl=attn_impl,
                 scale=cfg.attn_scale, softcap=cfg.attn_softcap,
-                sinks=sinks,
+                sinks=sinks, k_scale=ks_l, v_scale=vs_l,
             )
     elif rolled:
         from shellac_tpu.inference.kvcache import (
@@ -838,14 +849,31 @@ def _mla_attention(
     v_stub = jnp.zeros((b, s, 1, 0), cdt)
 
     if page_tables is not None:
-        from shellac_tpu.inference.kvcache import paged_update_layer
+        from shellac_tpu.inference.kvcache import (
+            paged_update_layer,
+            quant_paged_update_layer,
+        )
         from shellac_tpu.ops.decode_attention import paged_decode_attention
 
         pool_k, pool_v, index, _ = cache
-        pool_k, pool_v = paged_update_layer(
-            pool_k, pool_v, latent, v_stub, index, page_tables
-        )
-        new_cache = (pool_k, pool_v)
+        if kv_scales is not None:
+            # Int8 latent pool: one scale per latent row, serving both
+            # attention roles like the dense int8 latent cache. (The
+            # latent width is not 128-aligned, so reads take the
+            # gather + dequant reference path — correct, with the
+            # paged-fallback warning naming the constraint.)
+            ks_l, vs_l = kv_scales
+            pool_k, pool_v, ks_l, vs_l = quant_paged_update_layer(
+                pool_k, pool_v, ks_l, vs_l, latent, v_stub, index,
+                page_tables,
+            )
+            new_cache = (pool_k, pool_v, ks_l, vs_l)
+        else:
+            ks_l = None
+            pool_k, pool_v = paged_update_layer(
+                pool_k, pool_v, latent, v_stub, index, page_tables
+            )
+            new_cache = (pool_k, pool_v)
         if fresh_cache:
             o = expanded_attention()
         else:
@@ -854,6 +882,7 @@ def _mla_attention(
             o_lat = paged_decode_attention(
                 absorbed_q(), pool_k, pool_k, page_tables, index,
                 scale=scale, impl=attn_impl,
+                k_scale=ks_l, v_scale=ks_l,
             )[..., : m.kv_lora_rank]
             o = jnp.einsum("bshr,rhv->bshv", o_lat, w_bv)
         return o.reshape(b, s, h * m.v_head_dim), new_cache
@@ -1321,6 +1350,8 @@ def forward_with_cache(
         PagedKVCache,
         PatternedKVCache,
         QuantKVCache,
+        QuantPagedKVCache,
+        QuantPatternedKVCache,
         QuantRollingKVCache,
         RollingKVCache,
     )
@@ -1329,13 +1360,16 @@ def forward_with_cache(
         raise ValueError(
             "KV-cache generation requires a causal model (cfg.causal=True)"
         )
-    paged = isinstance(cache, PagedKVCache)
-    quant = isinstance(cache, (QuantKVCache, QuantRollingKVCache))
+    paged = isinstance(cache, (PagedKVCache, QuantPagedKVCache))
+    quant = isinstance(
+        cache, (QuantKVCache, QuantPagedKVCache, QuantRollingKVCache)
+    )
     rolled = isinstance(cache, (RollingKVCache, QuantRollingKVCache))
     mixed = isinstance(cache, PatternedKVCache)
-    if (rolled or mixed) and cfg.attn_window is None:
+    quant_mixed = isinstance(cache, QuantPatternedKVCache)
+    if (rolled or mixed or quant_mixed) and cfg.attn_window is None:
         raise ValueError("rolling cache on a model without attn_window")
-    if mixed and cfg.attn_pattern is None:
+    if (mixed or quant_mixed) and cfg.attn_pattern is None:
         raise ValueError("patterned cache on a model without attn_pattern")
     cdt = cfg.compute_dtype
     b, s = tokens.shape
@@ -1485,13 +1519,21 @@ def forward_with_cache(
         )
         new_k = nk.reshape(cfg.n_layers, *cache.k.shape[1:])
         new_v = nv.reshape(cfg.n_layers, *cache.v.shape[1:])
-    elif mixed:
+    elif mixed or quant_mixed:
         # Mixed ring/dense stacks: the scan walks pattern periods with
         # per-kind cursors — "window" blocks consume ring rows (rolled
         # update + rolled read), "full" blocks consume dense rows (the
-        # Pallas decode kernel path, unchanged).
+        # Pallas decode kernel path). One body covers bf16 (2 fields
+        # per kind) and int8 (4: values + scale stacks, threading the
+        # scales to run_block so window blocks take the quantized ring
+        # and full blocks the dense int8 path).
         from shellac_tpu.inference.kvcache import pattern_kind_counts
 
+        w_names = (("kw", "vw", "kws", "vws") if quant_mixed
+                   else ("kw", "vw"))
+        f_names = (("kf", "vf", "kfs", "vfs") if quant_mixed
+                   else ("kf", "vf"))
+        nfields = len(w_names)
         period = len(cfg.attn_pattern)
         ng = cfg.n_layers // period
         nw, nf = pattern_kind_counts(cfg)
@@ -1500,42 +1542,44 @@ def forward_with_cache(
             lambda a: a.reshape(ng, period, *a.shape[1:]),
             params["layers"],
         )
-        gkw = greshape(cache.kw, nw)
-        gvw = greshape(cache.vw, nw)
-        gkf = greshape(cache.kf, nf)
-        gvf = greshape(cache.vf, nf)
+        gw = tuple(greshape(getattr(cache, n), nw) for n in w_names)
+        gf = tuple(greshape(getattr(cache, n), nf) for n in f_names)
 
         def group_body(x, inp):
-            gl, kw_g, vw_g, kf_g, vf_g = inp
-            nkw, nvw, nkf, nvf = [], [], [], []
-            iw = iff = 0
+            gl = inp[0]
+            w_in = inp[1:1 + nfields]
+            f_in = inp[1 + nfields:]
+            w_out, f_out = [], []
+            cursors = {"window": 0, "full": 0}
             for i, kind in enumerate(cfg.attn_pattern):
                 lp_i = jax.tree.map(lambda a, i=i: a[i], gl)
-                if kind == "window":
-                    x, (nk, nv), _ = run_block(
-                        x, lp_i, kw_g[iw], vw_g[iw], None,
-                        attn_kind=kind, block_rolled=True,
-                    )
-                    nkw.append(nk)
-                    nvw.append(nv)
-                    iw += 1
-                else:
-                    x, (nk, nv), _ = run_block(
-                        x, lp_i, kf_g[iff], vf_g[iff], None,
-                        attn_kind=kind, block_rolled=False,
-                    )
-                    nkf.append(nk)
-                    nvf.append(nv)
-                    iff += 1
-            return x, (jnp.stack(nkw), jnp.stack(nvw),
-                       jnp.stack(nkf), jnp.stack(nvf))
+                is_w = kind == "window"
+                src, outs = (w_in, w_out) if is_w else (f_in, f_out)
+                cur = cursors[kind]
+                scales = ((src[2][cur], src[3][cur]) if nfields == 4
+                          else None)
+                x, nc, _ = run_block(
+                    x, lp_i, src[0][cur], src[1][cur], None, scales,
+                    attn_kind=kind, block_rolled=is_w,
+                )
+                outs.append(nc)
+                cursors[kind] = cur + 1
+            stack = lambda outs, j: jnp.stack(  # noqa: E731
+                [o[j] for o in outs], axis=0
+            )
+            return x, tuple(
+                stack(outs, j)
+                for outs in (w_out, f_out) for j in range(nfields)
+            )
 
-        x, (nkw, nvw, nkf, nvf) = jax.lax.scan(
-            group_body, x, (glp, gkw, gvw, gkf, gvf)
-        )
+        x, news = jax.lax.scan(group_body, x, (glp,) + gw + gf)
         backflat = lambda a: a.reshape(-1, *a.shape[2:])  # noqa: E731
-        new_kw, new_vw = backflat(nkw), backflat(nvw)
-        new_kf, new_vf = backflat(nkf), backflat(nvf)
+        news = [backflat(a) for a in news]
+        if quant_mixed:
+            (new_kw, new_vw, new_kws, new_vws,
+             new_kf, new_vf, new_kfs, new_vfs) = news
+        else:
+            new_kw, new_vw, new_kf, new_vf = news
     elif cfg.attn_pattern is not None:
         def body_one(x, lp, cs, kind):
             ck, cv = cs
@@ -1570,6 +1614,12 @@ def forward_with_cache(
     if quant:
         new_cache = cache.replace(
             k=new_k, v=new_v, ks=new_ks, vs=new_vs, lengths=new_lengths
+        )
+    elif quant_mixed:
+        new_cache = cache.replace(
+            kw=new_kw, vw=new_vw, kws=new_kws, vws=new_vws,
+            kf=new_kf, vf=new_vf, kfs=new_kfs, vfs=new_vfs,
+            lengths=new_lengths,
         )
     elif mixed:
         new_cache = cache.replace(

@@ -562,7 +562,7 @@ def _decode_ref(q, cache_k, cache_v, index, window, scale, softcap=None,
 def _paged_group_kernel(
     len_ref, tab_ref, q_ref, k_hbm, v_hbm, *rest,
     scale, s, hkv, bs, group, window, num_kv, softcap=None,
-    has_sinks=False,
+    has_sinks=False, quant=False,
 ):
     """Grouped paged decode: `group` pages gathered per grid step.
 
@@ -580,9 +580,18 @@ def _paged_group_kernel(
     stray Inf/NaN bit pattern would poison the accumulator through the
     masked-out p=0 rows as 0*Inf).
     """
-    sink_ref, (o_ref, acc_ref, m_ref, l_ref, k_buf, v_buf, sems) = (
-        _split_sink_rest(rest, has_sinks)
-    )
+    if quant:
+        # Int8 pools travel with fp32 scale pools, gathered page-for-
+        # page into their own VMEM tiles (sem rows 2/3).
+        ks_hbm, vs_hbm = rest[0], rest[1]
+        rest = rest[2:]
+    sink_ref, rest = _split_sink_rest(rest, has_sinks)
+    if quant:
+        (o_ref, acc_ref, m_ref, l_ref, k_buf, v_buf, ks_buf, vs_buf,
+         sems) = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref, k_buf, v_buf, sems = rest
+        ks_buf = vs_buf = None
     b = pl.program_id(0)
     gi = pl.program_id(1)
     idx = len_ref[b]
@@ -617,11 +626,24 @@ def _paged_group_kernel(
                 pltpu.make_async_copy(
                     v_hbm.at[page], v_buf.at[:, dst, :], sems.at[1, g]
                 ).start()
+                if quant:
+                    pltpu.make_async_copy(
+                        ks_hbm.at[page], ks_buf.at[:, dst], sems.at[2, g]
+                    ).start()
+                    pltpu.make_async_copy(
+                        vs_hbm.at[page], vs_buf.at[:, dst], sems.at[3, g]
+                    ).start()
 
             @pl.when(~_pg_live(g))
             def _zero(dst=dst):
                 k_buf[:, dst, :] = jnp.zeros_like(k_buf[:, dst, :])
                 v_buf[:, dst, :] = jnp.zeros_like(v_buf[:, dst, :])
+                if quant:
+                    # Zero scales keep dead columns exactly zero through
+                    # the dequant multiplies (masked anyway; belt and
+                    # braces against uninitialized-scratch Inf/NaN).
+                    ks_buf[:, dst] = jnp.zeros_like(ks_buf[:, dst])
+                    vs_buf[:, dst] = jnp.zeros_like(vs_buf[:, dst])
 
         for g in range(group):
             dst = pl.dslice(g * bs, bs)
@@ -634,19 +656,26 @@ def _paged_group_kernel(
                 pltpu.make_async_copy(
                     v_hbm.at[0], v_buf.at[:, dst, :], sems.at[1, g]
                 ).wait()
+                if quant:
+                    pltpu.make_async_copy(
+                        ks_hbm.at[0], ks_buf.at[:, dst], sems.at[2, g]
+                    ).wait()
+                    pltpu.make_async_copy(
+                        vs_hbm.at[0], vs_buf.at[:, dst], sems.at[3, g]
+                    ).wait()
 
     _decode_tile(
         idx, q_ref.at[0], k_buf, v_buf, o_ref.at[0],
         acc_ref, m_ref, l_ref,
         scale=scale, s=s, hkv=hkv, block_k=block_k, window=window,
         k_start=gi * block_k, ki=gi, last_ki=last_gi, first_ki=first_gi,
-        softcap=softcap, sink_ref=sink_ref,
+        ks_ref=ks_buf, vs_ref=vs_buf, softcap=softcap, sink_ref=sink_ref,
     )
 
 
 def _paged_group_flash(
     q, pool_k, pool_v, tables, index, scale, window, group, interpret,
-    softcap=None, sinks=None,
+    softcap=None, sinks=None, k_scale=None, v_scale=None,
 ):
     from jax.experimental.pallas import tpu as pltpu
 
@@ -656,6 +685,7 @@ def _paged_group_flash(
     num_kv = tables.shape[1]
     num_groups = num_kv // group
     block_k = group * bs
+    quant = k_scale is not None
 
     qf = _flatten_q(q, hkv)
 
@@ -665,12 +695,31 @@ def _paged_group_flash(
         pl.BlockSpec(memory_space=pl.ANY),  # v pool stays in HBM
     ]
     operands = [qf, pool_k, pool_v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),  # scale pools too
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        operands += [k_scale, v_scale]
     has_sinks = sinks is not None
     if has_sinks:
         in_specs += [
             pl.BlockSpec((rows, 128), lambda bi, gi, lr, tr: (0, 0)),
         ]
         operands += [_row_sinks(sinks, s)]
+    scratch = [
+        pltpu.VMEM((rows, d), jnp.float32),
+        pltpu.VMEM((rows, 128), jnp.float32),
+        pltpu.VMEM((rows, 128), jnp.float32),
+        pltpu.VMEM((hkv, block_k, d), pool_k.dtype),
+        pltpu.VMEM((hkv, block_k, d), pool_v.dtype),
+    ]
+    if quant:
+        scratch += [
+            pltpu.VMEM((hkv, block_k), jnp.float32),
+            pltpu.VMEM((hkv, block_k), jnp.float32),
+        ]
+    scratch += [pltpu.SemaphoreType.DMA((4 if quant else 2, group))]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, num_groups),
@@ -678,20 +727,13 @@ def _paged_group_flash(
         out_specs=pl.BlockSpec(
             (1, rows, d), lambda bi, gi, lr, tr: (bi, 0, 0)
         ),
-        scratch_shapes=[
-            pltpu.VMEM((rows, d), jnp.float32),
-            pltpu.VMEM((rows, 128), jnp.float32),
-            pltpu.VMEM((rows, 128), jnp.float32),
-            pltpu.VMEM((hkv, block_k, d), pool_k.dtype),
-            pltpu.VMEM((hkv, block_k, d), pool_v.dtype),
-            pltpu.SemaphoreType.DMA((2, group)),
-        ],
+        scratch_shapes=scratch,
     )
     out = pl.pallas_call(
         functools.partial(
             _paged_group_kernel, scale=scale, s=s, hkv=hkv, bs=bs,
             group=group, window=window, num_kv=num_kv, softcap=softcap,
-            has_sinks=has_sinks,
+            has_sinks=has_sinks, quant=quant,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
@@ -796,10 +838,16 @@ def _paged_flash(q, pool_k, pool_v, tables, index, scale, window, interpret,
     return _unflatten_o(out, b, s, h, d)
 
 
-def paged_decode_supported(q, pool_k) -> bool:
+def paged_decode_supported(q, pool_k, *, quant: bool = False) -> bool:
     b, s, h, d = q.shape
     hkv, bs, dk = pool_k.shape[1], pool_k.shape[2], pool_k.shape[3]
     if d % 64 != 0 or dk != d:
+        return False
+    if quant and (d % 128 != 0 or bs % 32 != 0):
+        # Int8 runs through the grouped-gather kernel only: its tile
+        # body is the ref-slicing fast path (full-lane head dims) and
+        # the page gather lands each page at sublane offset g*bs, which
+        # int8's (32, 128) native tile requires to be 32-aligned.
         return False
     if h % hkv != 0 or bs % 8 != 0:
         return False
@@ -820,6 +868,7 @@ def paged_decode_attention(
     sinks=None,
     impl: str = "auto",
     interpret: Optional[bool] = None,
+    k_scale=None, v_scale=None,
 ):
     """Attention of q (B, s, H, D) against a paged pool via block tables.
 
@@ -827,17 +876,26 @@ def paged_decode_attention(
     index: (B,) pre-write lengths. The kernel walks each slot's table —
     the dense per-slot view is never materialized. Falls back to
     gather + masked reference attention when unsupported.
+
+    k_scale/v_scale: (n_blocks, Hkv, bs) fp32 per-token dequant scale
+    pools for an int8 pool (see kvcache.QuantPagedKVCache); both or
+    neither. The grouped kernel gathers scale pages alongside value
+    pages and folds them in after the integer dots (same exact algebra
+    as the dense int8 kernel).
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale come together")
+    quant = k_scale is not None
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = not pallas_supported()
-    shapes_ok = paged_decode_supported(q, pool_k)
+    shapes_ok = paged_decode_supported(q, pool_k, quant=quant)
     if impl == "flash":
         if not shapes_ok:
             raise ValueError(
                 f"impl='flash' unsupported for q={q.shape} "
-                f"pool={pool_k.shape}"
+                f"pool={pool_k.shape} quant={quant}"
             )
         use_kernel = True
     else:
@@ -853,36 +911,50 @@ def paged_decode_attention(
             hkv, bs, dk = pool_k.shape[1], pool_k.shape[2], pool_k.shape[3]
             warnings.warn(
                 "paged_decode_attention: Pallas kernel unavailable for "
-                f"q={tuple(q.shape)} pool={tuple(pool_k.shape)} — falling "
-                "back to a dense gather + reference attention (paging's "
-                "memory win is lost). Kernel needs: head_dim % 64 == 0 "
+                f"q={tuple(q.shape)} pool={tuple(pool_k.shape)} "
+                f"quant={quant} — falling back to a dense gather + "
+                "reference attention (paging's memory win is lost). "
+                "Kernel needs: head_dim % 64 == 0 "
                 f"(got {d}), pool head_dim == q head_dim (got {dk} vs {d}), "
                 f"page block size % 8 == 0 (got {bs}), "
-                f"n_heads % kv_heads == 0 (got {h}/{hkv}), and "
-                f"H*s <= 1024 (got {h * s}).",
+                f"n_heads % kv_heads == 0 (got {h}/{hkv}), "
+                f"H*s <= 1024 (got {h * s})"
+                + (", and for int8 pools head_dim % 128 == 0 with "
+                   "block size % 32 == 0." if quant else "."),
                 PagedFallbackWarning,
                 stacklevel=2,
             )
     if use_kernel:
         # Grouped gather kernel when the head dim keeps full-lane tiles
         # (its tile body is the ref-slicing fast path) and grouping
-        # actually amortizes anything; one-page kernel otherwise.
+        # actually amortizes anything; one-page kernel otherwise. Int8
+        # pools always take the grouped kernel (the support gate
+        # guarantees its constraints): the one-page kernel's BlockSpec
+        # body has no scale plumbing.
         group = _paged_group(tables, pool_k) if q.shape[-1] % 128 == 0 else 1
         sc = None if softcap is None else float(softcap)
-        if group > 1:
+        if group > 1 or quant:
             return _paged_group_flash(
                 q, pool_k, pool_v, tables, index, float(scale), window,
-                group, interpret, softcap=sc, sinks=sinks,
+                max(group, 1), interpret, softcap=sc, sinks=sinks,
+                k_scale=k_scale, v_scale=v_scale,
             )
         return _paged_flash(
             q, pool_k, pool_v, tables, index, float(scale), window, interpret,
             softcap=sc, sinks=sinks,
         )
-    from shellac_tpu.inference.kvcache import paged_gather_layer
+    from shellac_tpu.inference.kvcache import (
+        paged_gather_layer,
+        paged_gather_scales,
+    )
 
     k_all, v_all = paged_gather_layer(pool_k, pool_v, tables)
+    ks_all = vs_all = None
+    if quant:
+        ks_all = paged_gather_scales(k_scale, tables)
+        vs_all = paged_gather_scales(v_scale, tables)
     return _decode_ref(q, k_all, v_all, index, window, scale, softcap=softcap,
-                       sinks=sinks)
+                       sinks=sinks, k_scale=ks_all, v_scale=vs_all)
 
 
 def rolled_decode_attention(

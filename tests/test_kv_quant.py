@@ -162,8 +162,10 @@ class TestEngines:
 
     def test_guards(self, model):
         cfg, params = model
-        with pytest.raises(NotImplementedError, match="dense-cache only"):
-            PagedBatchingEngine(cfg, params, kv_quant="int8")
+        # Int8 paged pools exist now; the remaining guard is the page
+        # alignment (int8 sublane tiling), an actionable config error.
+        with pytest.raises(ValueError, match="block_size % 32"):
+            PagedBatchingEngine(cfg, params, kv_quant="int8")  # bs=16
         from shellac_tpu.inference.spec_batching import (
             SpeculativeBatchingEngine,
         )
@@ -172,3 +174,104 @@ class TestEngines:
                                       kv_quant="int8")
         with pytest.raises(ValueError, match="kv_quant"):
             BatchingEngine(cfg, params, kv_quant="fp4")
+
+
+class TestPagedInt8:
+    def test_paged_matches_single_request(self, model):
+        """The serving parity invariant under the int8 pool: greedy
+        outputs bit-identical to the single-request engine with the
+        SAME cache quantization (both quantize at write, both
+        dequantize the read path)."""
+        cfg, params = model
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(1, cfg.vocab_size, size=n).tolist()
+                   for n in (3, 37, 5, 61)]
+        eng = PagedBatchingEngine(
+            cfg, params, n_slots=2, max_len=96, block_size=32,
+            kv_quant="int8",
+        )
+        got = eng.run([(i, p, 8) for i, p in enumerate(prompts)])
+        single = Engine(cfg, params, temperature=0.0, max_len=96,
+                        kv_quant="int8")
+        for i, p in enumerate(prompts):
+            res = single.generate(
+                jnp.asarray([p], jnp.int32), max_new_tokens=8
+            )
+            assert got[i] == np.asarray(res.tokens)[0].tolist(), i
+
+    def test_prefix_cache_composes(self, model):
+        """Prefix-cached int8 pool: bit-identical outputs with real
+        block reuse (scales ride with their blocks)."""
+        cfg, params = model
+        rng = np.random.default_rng(8)
+        shared = rng.integers(1, cfg.vocab_size, size=64).tolist()
+        reqs = [(i, shared + rng.integers(1, cfg.vocab_size, size=5).tolist(), 6)
+                for i in range(4)]
+        plain = PagedBatchingEngine(
+            cfg, params, n_slots=2, max_len=128, block_size=32,
+            kv_quant="int8",
+        ).run(reqs)
+        cached_eng = PagedBatchingEngine(
+            cfg, params, n_slots=2, max_len=128, block_size=32,
+            kv_quant="int8", prefix_cache=True,
+        )
+        cached = cached_eng.run(reqs)
+        assert cached == plain
+        assert cached_eng.stats["prefix_hit_tokens"] > 0
+
+    def test_grouped_kernel_parity_interpret(self, rng):
+        """Interpret-mode int8 grouped-gather kernel == gathered
+        dequantized reference."""
+        from shellac_tpu.inference.kvcache import (
+            paged_gather_layer,
+            paged_gather_scales,
+        )
+        from shellac_tpu.ops.decode_attention import (
+            _decode_ref,
+            paged_decode_attention,
+        )
+
+        B, H, HKV, D, bs, mb = 2, 8, 4, 128, 32, 8
+        n_blocks = B * mb + 1
+        ks = jax.random.split(jax.random.PRNGKey(9), 3)
+        q = jax.random.normal(ks[0], (B, 1, H, D), jnp.float32)
+        kf = jax.random.normal(ks[1], (n_blocks, bs, HKV, D), jnp.float32)
+        vf = jax.random.normal(ks[2], (n_blocks, bs, HKV, D), jnp.float32)
+        kq, ksc = quantize_kv(kf)
+        vq, vsc = quantize_kv(vf)
+        pool_k = kq.transpose(0, 2, 1, 3)  # (nb, HKV, bs, D) int8
+        pool_v = vq.transpose(0, 2, 1, 3)
+        pks = ksc.transpose(0, 2, 1)  # (nb, HKV, bs)
+        pvs = vsc.transpose(0, 2, 1)
+        perm = np.random.default_rng(0).permutation(n_blocks - 1) + 1
+        tables = jnp.asarray(perm.reshape(B, mb), jnp.int32)
+        index = jnp.array([45, mb * bs - 1], jnp.int32)
+        for window in (None, 70):
+            out = paged_decode_attention(
+                q, pool_k, pool_v, tables, index, window=window,
+                impl="flash", interpret=True, k_scale=pks, v_scale=pvs,
+            )
+            k_all, v_all = paged_gather_layer(pool_k, pool_v, tables)
+            ref = _decode_ref(
+                q, k_all, v_all, index, window, D ** -0.5,
+                k_scale=paged_gather_scales(pks, tables),
+                v_scale=paged_gather_scales(pvs, tables),
+            )
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+            )
+
+    def test_chunked_prefill_parity(self, model):
+        cfg, params = model
+        rng = np.random.default_rng(10)
+        prompts = [rng.integers(1, cfg.vocab_size, size=40).tolist(),
+                   rng.integers(1, cfg.vocab_size, size=23).tolist()]
+        want = PagedBatchingEngine(
+            cfg, params, n_slots=2, max_len=96, block_size=32,
+            kv_quant="int8",
+        ).run([(i, p, 6) for i, p in enumerate(prompts)])
+        got = PagedBatchingEngine(
+            cfg, params, n_slots=2, max_len=96, block_size=32,
+            kv_quant="int8", prefill_chunk=16,
+        ).run([(i, p, 6) for i, p in enumerate(prompts)])
+        assert got == want

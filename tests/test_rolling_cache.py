@@ -362,11 +362,75 @@ def test_int8_rolling_sharded():
     assert done == ref
 
 
-def test_int8_rolling_patterned_refused():
+def _run_patterned_int8(cfg, params, sizes, budget, **kw):
+    eng = BatchingEngine(
+        cfg, params, n_slots=2, max_len=128, temperature=0.0,
+        kv_quant="int8", **kw
+    )
+    for i, size in enumerate(sizes):
+        rng = np.random.RandomState(i)
+        eng.submit(i, rng.randint(0, cfg.vocab_size, size), budget)
+    done = {}
+    while len(done) < len(sizes):
+        done.update(eng.step())
+    return done
+
+
+def test_int8_patterned_matches_int8_dense():
+    """kv_quant x patterned rolling (the quant MIXED cache): window
+    layers ring int8, full layers dense int8 — outputs must reproduce
+    the all-dense int8 cache bit-for-bit (same write-point
+    quantization, ring reads dequantize in fp32 like the uniform ring),
+    well past the ring wrap."""
     from shellac_tpu.models.registry import get_model_config
 
     cfg = get_model_config("tiny-gemma2").replace(dtype="float32")
     params = init_params(cfg, jax.random.PRNGKey(0))
-    with pytest.raises(NotImplementedError, match="int8 x rolling"):
-        BatchingEngine(cfg, params, n_slots=2, max_len=64,
-                       kv_quant="int8", rolling_window=True)
+    sizes = [17, 7, 19, 4]  # window=16: wraps during decode
+    dense = _run_patterned_int8(cfg, params, sizes, 40)
+    mixed = _run_patterned_int8(cfg, params, sizes, 40,
+                                rolling_window=True)
+    assert dense == mixed
+
+
+def test_int8_patterned_gptoss_sinks():
+    """GPT-OSS shape: sinks + biased MoE + pattern + int8 mixed cache."""
+    from shellac_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-gptoss").replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    sizes = [21, 9]
+    dense = _run_patterned_int8(cfg, params, sizes, 30)
+    mixed = _run_patterned_int8(cfg, params, sizes, 30,
+                                rolling_window=True)
+    assert dense == mixed
+
+
+def test_int8_patterned_chunked_prefill():
+    from shellac_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-gemma2").replace(dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    sizes = [40, 23]
+    whole = _run_patterned_int8(cfg, params, sizes, 12,
+                                rolling_window=True)
+    chunked = _run_patterned_int8(cfg, params, sizes, 12,
+                                  rolling_window=True, prefill_chunk=16)
+    assert whole == chunked
+
+
+def test_int8_patterned_memory_is_smaller():
+    from shellac_tpu.inference.kvcache import (
+        init_quant_cache,
+        init_quant_patterned_cache,
+    )
+    from shellac_tpu.models.registry import get_model_config
+
+    cfg = get_model_config("tiny-gemma2")
+    dense = init_quant_cache(cfg, 2, 4096)
+    mixed = init_quant_patterned_cache(cfg, 2, 4096)
+    size = lambda c: sum(  # noqa: E731
+        x.size * x.dtype.itemsize for x in jax.tree.leaves(c)
+    )
+    # Half the layers ring at window+slack (~24 rows) instead of 4096.
+    assert size(mixed) < 0.6 * size(dense)
