@@ -84,6 +84,9 @@ class _Request:
     # Additive per-token logit biases applied before sampling (OpenAI
     # semantics); logprobs still report the raw distribution.
     logit_bias: Optional[Dict[int, float]] = None
+    # Structured decoding: a compiled constraints.TokenDFA whose
+    # transition table masks the logits each step (None = free).
+    constraint: Optional[Any] = None
     # Generated tokens so far. INVARIANT (the server's streaming path
     # reads this between engine steps): `out` only ever grows, except
     # that a stop-sequence match removes exactly the matched suffix
@@ -219,6 +222,21 @@ class BatchingEngine:
         # the shared stream) + the slot's generated-token count at the
         # start of each decode window (host-known: len(req.out)).
         self._sseed = jnp.full((n_slots,), -1, jnp.int32)
+        # Structured decoding: active constrained slots' TokenDFA
+        # tables stacked into one device table (rows bucketed so the
+        # decode trace is reused across request churn), a per-slot row
+        # offset (-1 = unconstrained), and per-slot DFA state that
+        # advances on device inside the decode scan.
+        self._slot_dfa: List[Optional[Any]] = [None] * n_slots
+        self._ctrans: Optional[jax.Array] = None
+        self._con_dirty = False
+        self._coff = jnp.full((n_slots,), -1, jnp.int32)
+        self._cstate = jnp.zeros((n_slots,), jnp.int32)
+        # Shared dummy table for unconstrained decode steps (the hot
+        # path): allocated once, like _zero_bias_row.
+        self._dummy_ctrans = jnp.full(
+            (1, cfg.vocab_size + 1), -1, jnp.int32
+        )
         # Engine-level sampling defaults; submit() can override any of
         # them per request. Each slot's effective settings live in
         # device vectors fed to the jitted programs, so one decode tick
@@ -363,7 +381,8 @@ class BatchingEngine:
 
     def _decode_impl(self, params, cache, cur, active, key, samp,
                      greedy_only: bool = False, use_bias: bool = False,
-                     use_pen: bool = False, use_seed: bool = False):
+                     use_pen: bool = False, use_seed: bool = False,
+                     use_con: bool = False):
         """decode_ticks decode steps over every slot, ONE host sync.
 
         Per-tick host reads dominate serving latency when the device is
@@ -374,17 +393,24 @@ class BatchingEngine:
         so the math each request sees is unchanged (tested greedy
         bit-parity vs the single-request engine). Inactive slots stay
         frozen. Returns (cache, tokens (K, n_slots), logprobs (K,
-        n_slots) -- zeros unless self.logprobs).
+        n_slots) -- zeros unless self.logprobs, min_rem, counts,
+        cstate).
+
+        use_con: constrained slots mask logits through their DFA row
+        and advance their state per sampled token — two gathers per
+        tick, no host sync, so structured decoding rides the same
+        multi-tick scan.
         """
 
         bias = samp[4] if use_bias else None
         min_rem0 = samp[5]
         pres, freq, counts0 = samp[6], samp[7], samp[8]
         seed_vec, gen0 = samp[9], samp[10]
+        ctrans, coff, cstate0 = samp[11], samp[12], samp[13]
 
         def tick(carry, key_i):
             key, i = key_i
-            cache, cur, min_rem, counts = carry
+            cache, cur, min_rem, counts, cstate = carry
             old_lengths = cache.lengths
             logits, cache = transformer.forward_with_cache(
                 self.cfg, params, cur[:, None], cache,
@@ -396,6 +422,19 @@ class BatchingEngine:
                 # subtracts once per seen token, frequency per count.
                 adj = adj - (pres[:, None] * (counts > 0.0)
                              + freq[:, None] * counts)
+            if use_con:
+                con = coff >= 0
+                row = ctrans[jnp.clip(coff, 0, None) + cstate]
+                allowed = row[:, :-1] >= 0  # (n_slots, V)
+                if self.eos_id is not None:
+                    # EOS legality comes from the dedicated last column
+                    # (allowed exactly in accepting states).
+                    allowed = allowed.at[:, self.eos_id].set(
+                        row[:, -1] >= 0
+                    )
+                # Constraint wins over any user bias: disallowed stays
+                # -inf regardless of logit_bias.
+                adj = jnp.where(con[:, None] & ~allowed, NEG_INF, adj)
             if greedy_only:
                 nxt = jnp.argmax(adj, axis=-1).astype(jnp.int32)
             elif use_seed:
@@ -410,6 +449,18 @@ class BatchingEngine:
             min_rem = jnp.where(
                 active, jnp.maximum(min_rem - 1, 0), min_rem
             )
+            if use_con:
+                col = nxt
+                if self.eos_id is not None:
+                    col = jnp.where(
+                        nxt == self.eos_id, row.shape[1] - 1, nxt
+                    )
+                new_st = jnp.take_along_axis(
+                    row, col[:, None], axis=1
+                )[:, 0]
+                cstate = jnp.where(
+                    con & active, jnp.maximum(new_st, 0), cstate
+                )
             if use_pen:
                 counts = counts.at[
                     jnp.arange(counts.shape[0]), nxt
@@ -421,14 +472,14 @@ class BatchingEngine:
                 )[:, 0]
             else:
                 lp = jnp.zeros(nxt.shape, jnp.float32)
-            return (cache, nxt, min_rem, counts), (nxt, lp)
+            return (cache, nxt, min_rem, counts, cstate), (nxt, lp)
 
         keys = jax.random.split(key, self.decode_ticks)
         ticks_i = jnp.arange(self.decode_ticks, dtype=jnp.int32)
-        (cache, _, min_rem, counts), (toks, lps) = jax.lax.scan(
-            tick, (cache, cur, min_rem0, counts0), (keys, ticks_i)
+        (cache, _, min_rem, counts, cstate), (toks, lps) = jax.lax.scan(
+            tick, (cache, cur, min_rem0, counts0, cstate0), (keys, ticks_i)
         )
-        return cache, toks, lps, min_rem, counts
+        return cache, toks, lps, min_rem, counts, cstate
 
     # ---- scheduling --------------------------------------------------
 
@@ -457,10 +508,13 @@ class BatchingEngine:
 
     def _sample_first(self, key, last, samp):
         """Sample a prefill's first output token from the adjusted
-        (biased, EOS-banned) logits; the logprob stays on the raw
-        ones. A seeded request's first token is draw gen_idx=0 of its
-        own deterministic stream."""
+        (biased, EOS-banned, constraint-masked) logits; the logprob
+        stays on the raw ones. A seeded request's first token is draw
+        gen_idx=0 of its own deterministic stream."""
         adjusted = self._adjust_logits(last[None], samp[4], samp[5])
+        # Constraint mask LAST: a grammar-disallowed token must stay
+        # disallowed no matter what the user's logit_bias says.
+        adjusted = adjusted + samp[7]
         first = sample_batched(
             key, adjusted, *samp[:4],
             seed=samp[6], gen_idx=jnp.zeros((1,), jnp.int32),
@@ -472,7 +526,8 @@ class BatchingEngine:
                temperature=None, top_k=None, top_p=None,
                min_p=None, min_tokens=None, logit_bias=None,
                presence_penalty=None, frequency_penalty=None,
-               prompt_logprobs=False, seed=None) -> None:
+               prompt_logprobs=False, seed=None,
+               constraint=None) -> None:
         """Queue a request. `stop`: optional list of token-id sequences;
         generation ends when the output ends with any of them, and the
         matched sequence is removed from the returned tokens.
@@ -549,11 +604,40 @@ class BatchingEngine:
             # int32. Fold deterministically instead of overflowing in
             # the scheduler thread.
             seed &= 0x7FFFFFFF
+        if constraint is not None:
+            from shellac_tpu.inference.constraints import TokenDFA
+
+            if not isinstance(constraint, TokenDFA):
+                raise ValueError(
+                    f"request {rid!r}: constraint must be a compiled "
+                    "constraints.TokenDFA (the server compiles specs; "
+                    "library users call compile_token_dfa)"
+                )
+            if constraint.trans.shape[1] != self.cfg.vocab_size + 1:
+                raise ValueError(
+                    f"request {rid!r}: constraint table covers "
+                    f"{constraint.trans.shape[1] - 1} tokens, model "
+                    f"vocab is {self.cfg.vocab_size}"
+                )
+            if self.eos_id is None or constraint.eos_id != self.eos_id:
+                raise ValueError(
+                    f"request {rid!r}: constraint eos_id "
+                    f"{constraint.eos_id} must equal the engine's "
+                    f"eos_id {self.eos_id} (termination and EOS "
+                    "masking must agree)"
+                )
+            if min_tokens > 0:
+                raise ValueError(
+                    f"request {rid!r}: min_tokens does not compose "
+                    "with constraint (the EOS ban can contradict a "
+                    "state where only EOS is legal)"
+                )
         self._queue.append(_Request(
             rid, tokens, max_new, stop=stop, min_tokens=min_tokens,
             logit_bias=logit_bias, presence_penalty=pres,
             frequency_penalty=freq,
-            prompt_logprobs=bool(prompt_logprobs), seed=seed, **samp,
+            prompt_logprobs=bool(prompt_logprobs), seed=seed,
+            constraint=constraint, **samp,
         ))
 
     def _prepare_slot(self, slot: int, req: _Request) -> None:
@@ -575,6 +659,10 @@ class BatchingEngine:
             self._sfreq = self._sfreq.at[slot].set(0.0)
             self._scounts = self._scounts.at[slot].set(0.0)
             self._slot_pen[slot] = False
+        if self._slot_dfa[slot] is not None:
+            self._slot_dfa[slot] = None
+            self._cstate = self._cstate.at[slot].set(0)
+            self._con_dirty = True
 
     def _bias_row(self, req: _Request) -> np.ndarray:
         row = np.zeros((self.cfg.vocab_size,), np.float32)
@@ -585,10 +673,21 @@ class BatchingEngine:
     def _slot_samp(self, slot: int, req: _Request):
         """This request's sampling settings as (1, ...)-vectors for
         jit: (temperature, top_k, top_p, min_p, logit bias row,
-        remaining min_tokens). The bias row is a device slice of the
-        matrix _set_slot_sampling already wrote (None = no bias)."""
+        remaining min_tokens, seed, first-token constraint mask). The
+        bias row is a device slice of the matrix _set_slot_sampling
+        already wrote (None = no bias). The constraint mask is the
+        DFA's state-0 row as an additive -inf mask — the prefill's
+        sampled token must obey the grammar too; later tokens mask
+        inside the decode scan."""
         bias = (self._sbias[slot][None] if req.logit_bias
                 else self._zero_bias_row)
+        if req.constraint is not None:
+            row = req.constraint.trans[0]
+            mask = np.where(row[:-1] >= 0, 0.0, NEG_INF).astype(np.float32)
+            mask[req.constraint.eos_id] = 0.0 if row[-1] >= 0 else NEG_INF
+            cmask = jnp.asarray(mask)[None]
+        else:
+            cmask = self._zero_bias_row
         return (
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_k], jnp.int32),
@@ -599,6 +698,7 @@ class BatchingEngine:
             jnp.asarray(
                 [req.seed if req.seed is not None else -1], jnp.int32
             ),
+            cmask,
         )
 
     def _set_slot_sampling(self, slot: int, req: _Request) -> None:
@@ -635,6 +735,39 @@ class BatchingEngine:
             self._sfreq = self._sfreq.at[slot].set(req.frequency_penalty)
             self._scounts = self._scounts.at[slot].set(0.0)
         self._slot_pen[slot] = penalized
+        if req.constraint is not None or self._slot_dfa[slot] is not None:
+            self._slot_dfa[slot] = req.constraint
+            self._cstate = self._cstate.at[slot].set(0)
+            # Lazy: admissions and releases in one engine step coalesce
+            # into a single restack right before the next decode.
+            self._con_dirty = True
+
+    def _rebuild_constraints(self) -> None:
+        """Restack active constrained slots' DFA tables into one device
+        table with per-slot row offsets. Rows are bucketed to powers of
+        two so the decode program's trace survives request churn."""
+        self._con_dirty = False
+        tables, offs, off = [], [], 0
+        for dfa in self._slot_dfa:
+            if dfa is None:
+                offs.append(-1)
+                continue
+            offs.append(off)
+            tables.append(dfa.trans)
+            off += dfa.trans.shape[0]
+        self._coff = jnp.asarray(offs, jnp.int32)
+        if not tables:
+            self._ctrans = None
+            return
+        rows = _bucket(off)
+        stacked = np.concatenate(tables, axis=0)
+        if rows > off:
+            # Pad rows are unreachable (offsets only point at real
+            # rows); -1 everywhere keeps them inert if that ever
+            # changes.
+            pad = np.full((rows - off, stacked.shape[1]), -1, np.int32)
+            stacked = np.concatenate([stacked, pad], axis=0)
+        self._ctrans = jnp.asarray(stacked)
 
     def _run_prefill(self, slot: int, req: _Request):
         """Run the (bucketed, jitted) prefill for `req`; returns
@@ -695,6 +828,15 @@ class BatchingEngine:
         first_tok = int(first)
         self._cur = self._cur.at[slot].set(first_tok)
         self._slots[slot] = req
+        if req.constraint is not None:
+            # Advance the DFA past the prefill-sampled token (host-side:
+            # the token is already a host int here). Decode-time tokens
+            # advance on device inside the scan.
+            trans = req.constraint.trans
+            col = (trans.shape[1] - 1 if first_tok == req.constraint.eos_id
+                   else first_tok)
+            nxt = int(trans[0, col])
+            self._cstate = self._cstate.at[slot].set(max(nxt, 0))
         if self._slot_pen[slot]:
             # The prefill-sampled token is generated output: it joins
             # the slot's repetition counts.
@@ -910,9 +1052,9 @@ class BatchingEngine:
         speculative engine."""
         if self._decode is None:
             self._decode = self._jit_cache_program(
-                self._decode_impl, 4,
+                self._decode_impl, 5,
                 static_argnames=("greedy_only", "use_bias", "use_pen",
-                                 "use_seed"),
+                                 "use_seed", "use_con"),
             )
         active = jnp.asarray(active_rows)
         self._key, sub = jax.random.split(self._key)
@@ -920,18 +1062,25 @@ class BatchingEngine:
             r is None or r.temperature == 0.0 for r in self._slots
         )
         use_pen = any(self._slot_pen)
+        if self._con_dirty:
+            self._rebuild_constraints()
+        use_con = self._ctrans is not None
         counts = (self._scounts if use_pen else self._zero_bias_row)
         gen0 = jnp.asarray(
             [len(r.out) if r is not None else 0 for r in self._slots],
             jnp.int32,
         )
-        self._cache, toks, lps, self._smin, counts = self._decode(
+        # Unconstrained steps pass the shared dummy table so the arg
+        # tree keeps its structure without holding a real table alive.
+        ctrans = self._ctrans if use_con else self._dummy_ctrans
+        (self._cache, toks, lps, self._smin, counts,
+         cstate) = self._decode(
             self.params, self._cache, self._cur, active, sub,
             (self._stemp, self._stopk, self._stopp, self._sminp,
              self._sbias if self._sbias is not None
              else self._zero_bias_row, self._smin,
              self._spres, self._sfreq, counts,
-             self._sseed, gen0),
+             self._sseed, gen0, ctrans, self._coff, self._cstate),
             greedy_only=greedy_only,
             use_bias=self._sbias is not None and any(
                 b is not None for b in self._slot_bias
@@ -940,9 +1089,12 @@ class BatchingEngine:
             use_seed=any(
                 r is not None and r.seed is not None for r in self._slots
             ),
+            use_con=use_con,
         )
         if use_pen:
             self._scounts = counts
+        if use_con:
+            self._cstate = cstate
         self._cur = toks[-1]
         # (K, n_slots) each — the one host sync.
         host_toks, host_lps = jax.device_get((toks, lps))

@@ -1,0 +1,485 @@
+"""Structured (grammar-constrained) decoding.
+
+The public Outlines/JSONformer idea — compile a grammar to a finite
+automaton over the TOKEN alphabet, then mask logits each step — rebuilt
+for this engine's jitted multi-tick decode scan (the reference repo is
+empty, SURVEY.md §0; no code is derived from it):
+
+  1. A small regex engine compiles a pattern to a character-level NFA
+     (Thompson construction) and determinizes it lazily.
+  2. The DFA is lifted to the token alphabet: walking every vocab
+     token's string through the character DFA yields one token-level
+     transition table `trans (S, V+1) int32` (-1 = disallowed; the
+     last column is EOS, allowed exactly in accepting states).
+  3. The engine keeps the table on device. Each decode tick does two
+     O(1) gathers: `row = trans[state]` masks the logits, and
+     `state = row[sampled]` advances — no host sync, so constrained
+     decoding rides the same `decode_ticks` scan as everything else
+     (inference/batching.py).
+
+JSON-schema support generates a regex for a schema subset (fixed
+property order, compact separators) and reuses the same pipeline —
+one compiler, one device representation, one masking path.
+
+TPU-first consequences of this design: the per-step work is a gather
++ select (no data-dependent shapes, no host round trip), the table is
+built once per (pattern, tokenizer) and cached, and multiple
+concurrent constrained requests just stack their tables into one
+row-offset table.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+# Compilation guards: a pathological pattern must fail loudly at
+# submit time, not hang the scheduler.
+MAX_DFA_STATES = 4096
+
+
+# ---------------------------------------------------------------------------
+# regex -> character-level NFA (Thompson construction)
+# ---------------------------------------------------------------------------
+
+
+class _Regex:
+    """Recursive-descent parser for a practical regex subset:
+    literals, '.', escapes (\\d \\w \\s \\n \\t \\r + punctuation),
+    [...] classes with ranges/negation, (...) groups, '|', and the
+    postfix operators * + ? {m} {m,} {m,n}. Anchored implicitly (the
+    whole output must match the whole pattern)."""
+
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+        # NFA: transitions[state] = list of (charset | None, target);
+        # None = epsilon. charset is a frozenset of single chars.
+        self.eps: List[List[int]] = []
+        self.edges: List[List[Tuple[FrozenSet[str], int]]] = []
+
+    # -- NFA building blocks --
+
+    def _state(self) -> int:
+        self.eps.append([])
+        self.edges.append([])
+        return len(self.eps) - 1
+
+    def _frag_char(self, chars: FrozenSet[str]) -> Tuple[int, int]:
+        a, b = self._state(), self._state()
+        self.edges[a].append((chars, b))
+        return a, b
+
+    def _frag_concat(self, f1, f2) -> Tuple[int, int]:
+        self.eps[f1[1]].append(f2[0])
+        return f1[0], f2[1]
+
+    def _frag_alt(self, frags) -> Tuple[int, int]:
+        a, b = self._state(), self._state()
+        for f in frags:
+            self.eps[a].append(f[0])
+            self.eps[f[1]].append(b)
+        return a, b
+
+    def _frag_star(self, f) -> Tuple[int, int]:
+        a, b = self._state(), self._state()
+        self.eps[a] += [f[0], b]
+        self.eps[f[1]] += [f[0], b]
+        return a, b
+
+    def _frag_eps(self) -> Tuple[int, int]:
+        a, b = self._state(), self._state()
+        self.eps[a].append(b)
+        return a, b
+
+    # -- parsing --
+
+    _CLASSES = {
+        "d": frozenset("0123456789"),
+        "w": frozenset(
+            "abcdefghijklmnopqrstuvwxyz"
+            "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_"
+        ),
+        "s": frozenset(" \t\n\r\f\v"),
+    }
+    # '.' excludes newline, standard default.
+    _PRINTABLE = frozenset(
+        chr(c) for c in range(32, 127)
+    ) | frozenset("\t")
+    _DOT = _PRINTABLE | frozenset(
+        chr(c) for c in range(160, 0x250)
+    )  # latin-ish; byte-level tokenizers only ever probe ASCII anyway
+
+    def _peek(self) -> Optional[str]:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def _next(self) -> str:
+        ch = self.p[self.i]
+        self.i += 1
+        return ch
+
+    def _escape(self) -> FrozenSet[str]:
+        ch = self._next()
+        if ch in self._CLASSES:
+            return self._CLASSES[ch]
+        if ch in ("D", "W", "S"):
+            return frozenset(self._DOT - self._CLASSES[ch.lower()])
+        return frozenset({"n": "\n", "t": "\t", "r": "\r",
+                          "f": "\f", "v": "\v"}.get(ch, ch))
+
+    def _charclass(self) -> FrozenSet[str]:
+        neg = False
+        if self._peek() == "^":
+            self._next()
+            neg = True
+        chars: set = set()
+        while True:
+            ch = self._peek()
+            if ch is None:
+                raise ValueError(f"unterminated [ in {self.p!r}")
+            if ch == "]":
+                self._next()
+                break
+            self._next()
+            if ch == "\\":
+                sub = self._escape()
+                chars |= sub
+                continue
+            if self._peek() == "-" and self.i + 1 < len(self.p) \
+                    and self.p[self.i + 1] != "]":
+                self._next()
+                hi = self._next()
+                if hi == "\\":
+                    hi = next(iter(self._escape()))
+                chars |= {chr(c) for c in range(ord(ch), ord(hi) + 1)}
+            else:
+                chars.add(ch)
+        return frozenset(self._DOT - chars) if neg else frozenset(chars)
+
+    def _repeat(self, frag, lo: int, hi: Optional[int], atom_src):
+        """Expand {lo,hi} by cloning the atom (re-parsing the source
+        slice — simple and correct for this subset's sizes)."""
+        out = self._frag_eps()
+        for _ in range(lo):
+            out = self._frag_concat(out, self._clone(atom_src))
+        if hi is None:
+            out = self._frag_concat(out, self._frag_star(self._clone(atom_src)))
+        else:
+            for _ in range(hi - lo):
+                opt = self._clone(atom_src)
+                a, b = self._frag_eps()
+                self.eps[a].append(opt[0])
+                self.eps[opt[1]].append(b)
+                out = self._frag_concat(out, (a, b))
+        return out
+
+    def _clone(self, src: str):
+        save_p, save_i = self.p, self.i
+        self.p, self.i = src, 0
+        frag = self._parse_alt()
+        self.p, self.i = save_p, save_i
+        return frag
+
+    def _parse_atom(self):
+        start_i = self.i
+        ch = self._next()
+        if ch == "(":
+            frag = self._parse_alt()
+            if self._peek() != ")":
+                raise ValueError(f"unbalanced ( in {self.p!r}")
+            self._next()
+        elif ch == "[":
+            frag = self._frag_char(self._charclass())
+        elif ch == ".":
+            frag = self._frag_char(frozenset(self._DOT))
+        elif ch == "\\":
+            frag = self._frag_char(self._escape())
+        elif ch in ")|*+?{":
+            raise ValueError(f"unexpected {ch!r} at {self.i} in {self.p!r}")
+        else:
+            frag = self._frag_char(frozenset(ch))
+        return frag, self.p[start_i:self.i]
+
+    def _parse_concat(self):
+        frag = self._frag_eps()
+        while self._peek() not in (None, "|", ")"):
+            atom, src = self._parse_atom()
+            ch = self._peek()
+            if ch == "*":
+                self._next()
+                atom = self._frag_star(atom)
+            elif ch == "+":
+                self._next()
+                atom = self._frag_concat(atom, self._frag_star(self._clone(src)))
+            elif ch == "?":
+                self._next()
+                a, b = self._frag_eps()
+                self.eps[a].append(atom[0])
+                self.eps[atom[1]].append(b)
+                atom = (a, b)
+            elif ch == "{":
+                self._next()
+                spec = ""
+                while self._peek() not in (None, "}"):
+                    spec += self._next()
+                if self._peek() != "}":
+                    raise ValueError(f"unterminated {{ in {self.p!r}")
+                self._next()
+                if "," in spec:
+                    lo_s, hi_s = spec.split(",", 1)
+                    lo = int(lo_s)
+                    hi = int(hi_s) if hi_s else None
+                else:
+                    lo = hi = int(spec)
+                atom = self._repeat(None, lo, hi, src)
+            frag = self._frag_concat(frag, atom)
+        return frag
+
+    def _parse_alt(self):
+        frags = [self._parse_concat()]
+        while self._peek() == "|":
+            self._next()
+            frags.append(self._parse_concat())
+        return frags[0] if len(frags) == 1 else self._frag_alt(frags)
+
+    def compile(self):
+        frag = self._parse_alt()
+        if self.i != len(self.p):
+            raise ValueError(f"trailing {self.p[self.i:]!r} in {self.p!r}")
+        return frag
+
+
+class CharDFA:
+    """Lazily-determinized character automaton over the NFA."""
+
+    def __init__(self, pattern: str):
+        rx = _Regex(pattern)
+        start, accept = rx.compile()
+        self._eps = rx.eps
+        self._edges = rx.edges
+        self._accept_nfa = accept
+        self.start = self._closure(frozenset({start}))
+        self._memo: Dict[Tuple[FrozenSet[int], str], Optional[FrozenSet[int]]] = {}
+
+    def _closure(self, states: FrozenSet[int]) -> FrozenSet[int]:
+        out, stack = set(states), list(states)
+        while stack:
+            s = stack.pop()
+            for t in self._eps[s]:
+                if t not in out:
+                    out.add(t)
+                    stack.append(t)
+        return frozenset(out)
+
+    def step(self, state: FrozenSet[int], ch: str) -> Optional[FrozenSet[int]]:
+        key = (state, ch)
+        if key in self._memo:
+            return self._memo[key]
+        nxt = set()
+        for s in state:
+            for chars, t in self._edges[s]:
+                if ch in chars:
+                    nxt.add(t)
+        res = self._closure(frozenset(nxt)) if nxt else None
+        self._memo[key] = res
+        return res
+
+    def accepting(self, state: FrozenSet[int]) -> bool:
+        return self._accept_nfa in state
+
+
+# ---------------------------------------------------------------------------
+# token-level lifting
+# ---------------------------------------------------------------------------
+
+
+class TokenDFA:
+    """Token-level automaton: trans (S, V+1) int32, -1 = disallowed.
+
+    Column V (the last) is the EOS column: allowed exactly in
+    accepting states (its target is the state itself; the engine
+    finishes the request on EOS as usual). Built by BFS over the
+    character DFA — each discovered state walks every token's string.
+    """
+
+    def __init__(self, trans: np.ndarray, eos_id: int):
+        self.trans = trans
+        self.eos_id = eos_id
+
+    @property
+    def n_states(self) -> int:
+        return self.trans.shape[0]
+
+
+def _token_strings(tokenizer, vocab_size: int,
+                   eos_id: int) -> List[Optional[str]]:
+    """Decode each id to its surface string; None disables the token
+    (specials, undecodable, and EOS itself — EOS is the dedicated
+    last column)."""
+    out: List[Optional[str]] = []
+    for tid in range(vocab_size):
+        if tid == eos_id:
+            out.append(None)
+            continue
+        try:
+            s = tokenizer.decode([tid])
+        except Exception:
+            out.append(None)
+            continue
+        out.append(s if s else None)
+    return out
+
+
+def compile_token_dfa(pattern: str, tokenizer, vocab_size: int,
+                      eos_id: int) -> TokenDFA:
+    """pattern -> TokenDFA over this tokenizer's vocab.
+
+    eos_id comes from the caller (the engine's configured EOS), not
+    sniffed off the tokenizer — the two must agree or EOS masking
+    would silently diverge from request termination.
+
+    Cache externally on (pattern, id(tokenizer)) — the engine does.
+    """
+    cdfa = CharDFA(pattern)
+    toks = _token_strings(tokenizer, vocab_size, eos_id)
+
+    states: Dict[FrozenSet[int], int] = {cdfa.start: 0}
+    order: List[FrozenSet[int]] = [cdfa.start]
+    rows: List[np.ndarray] = []
+    qi = 0
+    while qi < len(order):
+        st = order[qi]
+        qi += 1
+        row = np.full((vocab_size + 1,), -1, np.int32)
+        for tid, s in enumerate(toks):
+            if s is None:
+                continue
+            cur = st
+            for ch in s:
+                cur = cdfa.step(cur, ch)
+                if cur is None:
+                    break
+            if cur is None:
+                continue
+            if cur not in states:
+                if len(states) >= MAX_DFA_STATES:
+                    raise ValueError(
+                        f"constraint DFA exceeds {MAX_DFA_STATES} "
+                        f"states; simplify the pattern"
+                    )
+                states[cur] = len(order)
+                order.append(cur)
+            row[tid] = states[cur]
+        if cdfa.accepting(st):
+            row[vocab_size] = states[st]  # EOS allowed, self-loop
+        rows.append(row)
+    trans = np.stack(rows, axis=0)
+    # A state from which nothing (not even EOS) is allowed would wedge
+    # a slot; they are unreachable in well-formed patterns but guard
+    # anyway.
+    dead = ~(trans >= 0).any(axis=1)
+    if dead.any():
+        raise ValueError("constraint DFA contains dead states")
+    return TokenDFA(trans, eos_id)
+
+
+# ---------------------------------------------------------------------------
+# JSON schema -> regex
+# ---------------------------------------------------------------------------
+
+_STR = r'"[^"\\]*"'  # compact strings, no escape sequences
+_INT = r"-?(0|[1-9][0-9]*)"
+_NUM = _INT + r"(\.[0-9]+)?([eE][-+]?[0-9]+)?"
+_BOOL = r"(true|false)"
+_NULL = r"null"
+
+
+def _schema_regex(schema: dict, depth: int = 3) -> str:
+    t = schema.get("type")
+    if "enum" in schema:
+        alts = []
+        for v in schema["enum"]:
+            alts.append(_escape_literal(json.dumps(v)))
+        return "(" + "|".join(alts) + ")"
+    if t == "string":
+        if "pattern" in schema:
+            # Group the user pattern: a top-level '|' must stay scoped
+            # to the string body, not split the whole grammar.
+            return '"(' + schema["pattern"] + ')"'
+        return _STR
+    if t == "integer":
+        return _INT
+    if t == "number":
+        return _NUM
+    if t == "boolean":
+        return _BOOL
+    if t == "null":
+        return _NULL
+    if t == "array":
+        if depth <= 0:
+            raise ValueError("schema nests deeper than supported")
+        item = _schema_regex(schema.get("items", {}), depth - 1)
+        return r"\[(" + item + r"(," + item + r")*)?\]"
+    if t == "object" or "properties" in schema:
+        if depth <= 0:
+            raise ValueError("schema nests deeper than supported")
+        props = schema.get("properties", {})
+        if not props:
+            # Free-form object: depth-limited generic JSON.
+            return _generic_json_regex(depth - 1, kind="object")
+        parts = []
+        for name, sub in props.items():
+            key = _escape_literal(json.dumps(name))
+            parts.append(key + ":" + _schema_regex(sub, depth - 1))
+        # Fixed property order (the public structured-output norm for
+        # regex-compiled schemas), compact separators, all properties
+        # present.
+        return r"\{" + ",".join(parts) + r"\}"
+    if t is None and not schema:
+        return _generic_json_regex(depth - 1, kind="value")
+    raise ValueError(f"unsupported schema fragment: {schema!r}")
+
+
+def _escape_literal(s: str) -> str:
+    return "".join(
+        "\\" + c if c in r"\.[]{}()*+?|^$" else c for c in s
+    )
+
+
+def _generic_json_regex(depth: int, kind: str = "value") -> str:
+    """Depth-limited generic JSON value (regular approximation of the
+    recursive grammar; depth levels of nesting)."""
+    scalar = f"({_STR}|{_NUM}|{_BOOL}|{_NULL})"
+    value = scalar
+    for _ in range(max(depth, 0)):
+        obj = r"\{(" + _STR + ":" + value + "(," + _STR + ":" + value + r")*)?\}"
+        arr = r"\[(" + value + "(," + value + r")*)?\]"
+        value = f"({scalar}|{obj}|{arr})"
+    if kind == "object":
+        return r"\{(" + _STR + ":" + value + "(," + _STR + ":" + value + r")*)?\}"
+    return value
+
+
+def constraint_pattern(spec: dict) -> str:
+    """Normalize a user constraint spec into one regex pattern.
+
+    spec: {"regex": ...} | {"json_schema": {...}} | {"json_object": true}
+    (the native API shape; the OpenAI response_format translates onto
+    this in the server).
+    """
+    if not isinstance(spec, dict):
+        raise ValueError("constraint must be an object")
+    keys = [k for k in ("regex", "json_schema", "json_object") if k in spec]
+    if len(keys) != 1:
+        raise ValueError(
+            "constraint needs exactly one of regex/json_schema/json_object"
+        )
+    if keys[0] == "regex":
+        if not isinstance(spec["regex"], str):
+            raise ValueError("constraint.regex must be a string")
+        return spec["regex"]
+    if keys[0] == "json_schema":
+        return _schema_regex(spec["json_schema"])
+    return _generic_json_regex(2, kind="object")

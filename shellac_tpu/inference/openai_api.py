@@ -62,6 +62,23 @@ def _common_sampling(payload: dict, native: dict):
     for key in ("presence_penalty", "frequency_penalty"):
         if payload.get(key) is not None:
             native[key] = float(payload[key])
+    rf = payload.get("response_format")
+    if rf is not None:
+        t = rf.get("type") if isinstance(rf, dict) else None
+        if t == "json_object":
+            native["constraint"] = {"json_object": True}
+        elif t == "json_schema":
+            js = (rf.get("json_schema") or {})
+            schema = js.get("schema") if isinstance(js, dict) else None
+            if schema is None:
+                _bad(
+                    'response_format.json_schema needs a "json_schema": '
+                    '{"schema": {...}} block'
+                )
+            native["constraint"] = {"json_schema": schema}
+        elif t not in (None, "text"):
+            _bad(f"response_format type {t!r} not supported "
+                 "(text, json_object, json_schema)")
     if payload.get("stream"):
         native["stream"] = True
 

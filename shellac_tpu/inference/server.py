@@ -40,6 +40,7 @@ import json
 import queue
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
@@ -93,6 +94,7 @@ class InferenceServer:
         tokenizer=None,
         engine: Optional[BatchingEngine] = None,
         model_name: str = "shellac_tpu",
+        step_timeout: Optional[float] = None,
         **engine_kw,
     ):
         self.engine = engine or BatchingEngine(cfg, params, **engine_kw)
@@ -103,13 +105,33 @@ class InferenceServer:
         # collective until its transport times out.
         self._heartbeat = bool(getattr(self.engine, "needs_heartbeat", False))
         self.tokenizer = tokenizer
+        self._constraint_cache: "OrderedDict[str, Any]" = OrderedDict()
         self._submit_q: queue.Queue = queue.Queue()
         self._pending: Dict[int, _Pending] = {}
         self._ids = itertools.count()
         self._stop = threading.Event()
         self._fatal: Optional[str] = None
+        # Failure detection for hung engine steps. A follower process
+        # dying mid-collective leaves the primary's step() WEDGED in
+        # native code — no exception ever surfaces, so the scheduler-
+        # death path alone cannot save pending requests. The watchdog
+        # detects the stall from outside, marks the server failed, and
+        # fails everything loudly; the stuck scheduler thread itself is
+        # unrecoverable (daemon — it cannot be interrupted from Python)
+        # and the operator restarts the pod. serve --step-timeout wires
+        # this; single-host deployments usually leave it off (a long
+        # prefill compile would trip a short timeout).
+        if step_timeout is not None and step_timeout <= 0:
+            # Validate BEFORE starting the scheduler thread: raising
+            # after start() would orphan an engine-owning daemon thread
+            # the caller can never close().
+            raise ValueError("step_timeout must be > 0 seconds")
+        self.step_timeout = step_timeout
+        self._step_started: Optional[float] = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        if step_timeout is not None:
+            threading.Thread(target=self._watchdog, daemon=True).start()
 
     # ---- scheduler thread (sole owner of the engine) ----------------
 
@@ -120,21 +142,44 @@ class InferenceServer:
             # The scheduler thread is the only consumer; if it dies
             # silently every pending and future request blocks forever.
             # Fail everything loudly instead.
-            self._fatal = f"scheduler died: {type(e).__name__}: {e}"
-            self._stop.set()
-            for p in list(self._pending.values()):
-                p.error = self._fatal
+            self._fail_everything(f"scheduler died: {type(e).__name__}: {e}")
+
+    def _fail_everything(self, msg: str) -> None:
+        """Mark the server failed: error out every pending and queued
+        request and refuse new ones. Called from the scheduler thread
+        (on an exception) or the step watchdog (on a wedge) — a benign
+        race: whichever runs second finds _pending empty."""
+        self._fatal = msg
+        self._stop.set()
+        for p in list(self._pending.values()):
+            p.error = msg
+            p.finish()
+        self._pending.clear()
+        while True:
+            try:
+                rid, *_ = self._submit_q.get_nowait()
+            except queue.Empty:
+                break
+            p = self._pending.pop(rid, None)
+            if p is not None:
+                p.error = msg
                 p.finish()
-            self._pending.clear()
-            while True:
-                try:
-                    rid, *_ = self._submit_q.get_nowait()
-                except queue.Empty:
-                    break
-                p = self._pending.pop(rid, None)
-                if p is not None:
-                    p.error = self._fatal
-                    p.finish()
+
+    def _watchdog(self) -> None:
+        """Detect a wedged engine step (lost follower, dead relay) from
+        outside the scheduler thread."""
+        poll = min(self.step_timeout / 4, 1.0)
+        while not self._stop.is_set():
+            started = self._step_started
+            if (started is not None
+                    and time.monotonic() - started > self.step_timeout):
+                self._fail_everything(
+                    f"engine step exceeded step_timeout="
+                    f"{self.step_timeout}s (wedged collective or lost "
+                    "follower); server marked failed — restart the pod"
+                )
+                return
+            self._stop.wait(poll)
 
     def _process_item(self, item) -> None:
         rid, tokens, max_new, stop, samp = item
@@ -167,7 +212,9 @@ class InferenceServer:
                 drained = True
                 self._process_item(item)
             if self.engine.pending or self._heartbeat:
+                self._step_started = time.monotonic()
                 finished = self.engine.step() or []
+                self._step_started = None
                 fin = {rid for rid, _ in finished}
                 # Stream deltas for requests still in flight. holdback
                 # trails the tail by the longest stop length, so a
@@ -359,9 +406,53 @@ class InferenceServer:
                         "logit_bias must be a {token id: bias} object"
                     )
                 samp["logit_bias"] = lb  # entries validated by submit
+            if payload.get("constraint") is not None:
+                samp["constraint"] = self._compile_constraint(
+                    payload["constraint"]
+                )
         except (TypeError, ValueError) as e:
             raise ValueError(f"bad sampling parameters: {e}")
         return tokens, max_new, stop, samp
+
+    def _compile_constraint(self, spec):
+        """Compile a constraint spec ({"regex"|"json_schema"|
+        "json_object"}) to a TokenDFA over this server's tokenizer,
+        cached per pattern — the compile walks the whole vocab, so a
+        repeated schema must not pay it twice."""
+        from shellac_tpu.inference.constraints import (
+            compile_token_dfa,
+            constraint_pattern,
+        )
+
+        if self.tokenizer is None:
+            raise ValueError(
+                "constrained decoding needs a server-side tokenizer "
+                "(the grammar compiles against token strings)"
+            )
+        eos_id = getattr(self.engine, "eos_id", None)
+        if eos_id is None:
+            raise ValueError(
+                "constrained decoding needs the engine's eos_id (serve "
+                "--eos-id or a tokenizer that defines one)"
+            )
+        pattern = constraint_pattern(spec)
+        cached = self._constraint_cache.get(pattern)
+        if cached is None:
+            cached = compile_token_dfa(
+                pattern, self.tokenizer, self.engine.cfg.vocab_size,
+                eos_id,
+            )
+            self._constraint_cache[pattern] = cached
+            # Client-supplied patterns key this cache: bound it (LRU)
+            # so sustained novel schemas cannot grow host memory
+            # without limit — each table is O(states x vocab) int32.
+            while len(self._constraint_cache) > 32:
+                self._constraint_cache.pop(
+                    next(iter(self._constraint_cache))
+                )
+        else:
+            self._constraint_cache.move_to_end(pattern)
+        return cached
 
     def _check_logprobs(self, payload) -> bool:
         want = bool(payload.get("logprobs"))

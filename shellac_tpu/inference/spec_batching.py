@@ -126,7 +126,14 @@ class SpeculativeBatchingEngine(BatchingEngine):
                temperature=None, top_k=None, top_p=None, min_p=None,
                min_tokens=None, logit_bias=None,
                presence_penalty=None, frequency_penalty=None,
-               prompt_logprobs=False, seed=None) -> None:
+               prompt_logprobs=False, seed=None, constraint=None) -> None:
+        if constraint is not None:
+            raise ValueError(
+                f"request {rid!r}: structured decoding is not wired "
+                "for the speculative engine (the draft proposes "
+                "unconstrained tokens, so the verify round would "
+                "reject almost everything); use a non-draft engine"
+            )
         if seed is not None:
             raise ValueError(
                 f"request {rid!r}: per-request seed is not wired for "
