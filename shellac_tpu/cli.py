@@ -831,6 +831,7 @@ def cmd_serve(args):
         logprobs=args.logprobs,
         kv_quant=args.kv_quant,
         rolling_window=args.rolling_window,
+        step_timeout=args.step_timeout,
     )
     return 0
 
@@ -1101,6 +1102,14 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--decode-ticks", type=int, default=1, dest="decode_ticks",
                    help="decode steps per host sync (throughput vs "
                         "per-token latency)")
+    s.add_argument("--step-timeout", type=float, default=None,
+                   dest="step_timeout",
+                   help="fail the server loudly if one engine step "
+                        "exceeds this many seconds (wedged collective / "
+                        "lost follower detection for multi-host serving; "
+                        "size it above the worst compile, including "
+                        "late retraces — see docs/inference.md failure "
+                        "semantics)")
     s.add_argument("--max-prefills-per-step", type=int, default=1,
                    dest="max_prefills_per_step",
                    help="cap prefills per engine step so prompt bursts "
