@@ -1,0 +1,114 @@
+"""Pipeline-parallel (pp-mesh) serving.
+
+Engines run on pp meshes through the same GSPMD idiom as training: the
+`layers` rule shards the stacked params AND the stacked KV cache over
+pp (per-stage residency — each stage holds its own layers' weights and
+cache rows), and the decode scan's per-layer slices resolve through
+the partitioner. The serving contract is the usual one: greedy output
+BIT-IDENTICAL to the unsharded engine. See docs/inference.md
+("Pipeline-parallel serving") for why tp remains the latency answer
+and pp is the capacity play.
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from shellac_tpu import ParallelConfig, get_model_config, make_mesh
+from shellac_tpu.inference.batching import BatchingEngine, PagedBatchingEngine
+from shellac_tpu.inference.engine import shard_params
+from shellac_tpu.models import transformer
+
+
+def _cfg():
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _cfg()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(ParallelConfig(pp=2, tp=2, dp=2))
+    return cfg, params, shard_params(cfg, params, mesh), mesh
+
+
+def _reqs(cfg, n=4):
+    rng = np.random.default_rng(3)
+    return [(i, rng.integers(1, cfg.vocab_size, size=s).tolist(), 8)
+            for i, s in enumerate((3, 7, 5, 9))][:n]
+
+
+class TestPpServing:
+    def test_dense_engine_token_exact(self, setup):
+        cfg, params, sharded, mesh = setup
+        reqs = _reqs(cfg)
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0).run(reqs)
+        got = BatchingEngine(cfg, sharded, n_slots=2, max_len=64,
+                             temperature=0.0, mesh=mesh).run(reqs)
+        assert got == want
+
+    def test_paged_engine_token_exact(self, setup):
+        cfg, params, sharded, mesh = setup
+        reqs = _reqs(cfg)
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0).run(reqs)
+        got = PagedBatchingEngine(
+            cfg, sharded, n_slots=2, max_len=64, block_size=32,
+            temperature=0.0, mesh=mesh,
+        ).run(reqs)
+        assert got == want
+
+    def test_per_stage_cache_residency(self, setup):
+        """The KV cache's layer axis must shard over pp — each stage
+        holds its OWN layers' cache rows, not a replicated copy (the
+        memory-capacity point of pp serving)."""
+        cfg, params, sharded, mesh = setup
+        eng = BatchingEngine(cfg, sharded, n_slots=2, max_len=64,
+                             temperature=0.0, mesh=mesh)
+        spec = eng._cache.k.sharding.spec
+        assert spec[0] == "pp", spec
+        # Params too: stacked layer weights shard over pp.
+        wq_spec = sharded["layers"]["wq"].sharding.spec
+        assert wq_spec[0] == "pp", wq_spec
+
+    def test_http_server_on_pp_mesh(self, setup):
+        cfg, params, sharded, mesh = setup
+        from shellac_tpu.inference.server import (
+            InferenceServer,
+            make_http_server,
+        )
+
+        eng = BatchingEngine(cfg, sharded, n_slots=2, max_len=64,
+                             temperature=0.0, mesh=mesh)
+        srv = InferenceServer(cfg, sharded, engine=eng)
+        httpd = make_http_server(srv)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        req = urllib.request.Request(
+            base + "/generate",
+            json.dumps({"tokens": [3, 5, 7], "max_new": 6}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            got = json.loads(r.read())["tokens"]
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0).run([(0, [3, 5, 7], 6)])[0]
+        assert got == want
+        httpd.shutdown()
+        srv.close()
+
+    def test_int8_cache_on_pp_mesh(self, setup):
+        cfg, params, sharded, mesh = setup
+        reqs = _reqs(cfg, n=2)
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0, kv_quant="int8").run(reqs)
+        got = BatchingEngine(cfg, sharded, n_slots=2, max_len=64,
+                             temperature=0.0, kv_quant="int8",
+                             mesh=mesh).run(reqs)
+        assert got == want
