@@ -69,7 +69,10 @@ class Checkpointer:
 
         With `mesh` + `model_cfg` (or an `abstract_state` of
         jax.ShapeDtypeStructs carrying shardings), arrays are restored
-        directly sharded; otherwise fully addressable on host.
+        directly sharded; otherwise each leaf lands on the first local
+        device — which also lets checkpoints SAVED sharded restore
+        without any mesh (pod checkpoint → single-chip eval/generate,
+        elastic down-scale).
         """
         if step is None:
             step = self.latest_step()
@@ -85,6 +88,23 @@ class Checkpointer:
                 lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
                 abstract_state,
                 shardings,
+            )
+        else:
+            # Restoring WITHOUT a target mesh must still work for
+            # checkpoints SAVED sharded (train on a pod, eval/generate
+            # on one chip, or elastic down-scale): orbax requires
+            # concrete target shardings for deserialization, so pin
+            # leaves that carry none to the first LOCAL device (a
+            # global jax.devices()[0] is non-addressable from other
+            # processes). Leaves already carrying a sharding keep it —
+            # the documented sharded-abstract_state path.
+            one = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+            abstract_state = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(
+                    a.shape, a.dtype,
+                    sharding=getattr(a, "sharding", None) or one,
+                ),
+                abstract_state,
             )
         try:
             return self._mngr.restore(

@@ -26,8 +26,12 @@ SEQ = 32
 
 def _local_batches(proc: int, vocab: int):
     """Process `proc`'s deterministic local stream."""
+    yield from _local_batches_n(proc, vocab, STEPS)
+
+
+def _local_batches_n(proc: int, vocab: int, n: int):
     rng = np.random.default_rng(100 + proc)
-    for _ in range(STEPS):
+    for _ in range(n):
         w = rng.integers(0, vocab, size=(LOCAL_BATCH, SEQ + 1), dtype=np.int32)
         yield {"inputs": w[:, :-1], "targets": w[:, 1:]}
 
@@ -110,6 +114,42 @@ print("WORKER_OK", proc, flush=True)
 """
 
 
+_ELASTIC_WORKER = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+import numpy as np
+from shellac_tpu import ParallelConfig, get_model_config
+from shellac_tpu.config import TrainConfig
+from shellac_tpu.parallel.distributed import global_mesh, initialize
+from shellac_tpu.training.loop import fit
+
+assert initialize()
+proc = jax.process_index()
+cfg = get_model_config("tiny").replace(dtype="float32")
+mesh = global_mesh(ParallelConfig(fsdp=4))
+
+
+def local_batches():
+    rng = np.random.default_rng(100 + proc)
+    for _ in range({steps}):
+        w = rng.integers(0, cfg.vocab_size, size=({local_batch}, {seq} + 1),
+                         dtype=np.int32)
+        yield {{"inputs": w[:, :-1], "targets": w[:, 1:]}}
+
+
+# total_steps=6 — the SAME schedule as the continuation runs (the LR
+# at each step depends on total_steps, so a shorter horizon here would
+# checkpoint a genuinely different trajectory). The 4-batch stream
+# stops the loop at step 4 via StopIteration; fit force-saves there.
+tcfg = TrainConfig(learning_rate=1e-3, warmup_steps=2, total_steps=6)
+state = fit(cfg, tcfg, local_batches(), mesh=mesh,
+            checkpoint_dir={ckpt!r}, checkpoint_every=100)
+assert int(jax.device_get(state.step)) == 4
+print("WORKER_OK", proc, flush=True)
+"""
+
+
 from conftest import run_two_process as _run_pair
 
 
@@ -152,3 +192,74 @@ class TestMultihostTraining:
             state, m = step(state, batch)
             ref_loss = float(jax.device_get(m["loss"]))
         assert abs(losses[0] - ref_loss) < 1e-4, (losses[0], ref_loss)
+
+    def test_elastic_rescale_resume(self, tmp_path):
+        """Elastic recovery: a checkpoint written by a 2-process fsdp=4
+        job restores onto a SINGLE-process fsdp=2 mesh (different
+        process count AND topology — orbax reshards onto the target
+        shardings) and continues with losses EQUAL to an uninterrupted
+        single-process run over the same global batch stream. This is
+        the down-scale-after-losing-a-host story, loss-exact."""
+        ckpt = tmp_path / "ckpt"
+        steps_total = 6
+        # The worker's stream carries only the first 4 batches: fit
+        # stops on StopIteration at step 4 and force-saves there.
+        _run_pair(tmp_path, _ELASTIC_WORKER.format(
+            steps=4, local_batch=LOCAL_BATCH, seq=SEQ,
+            ckpt=str(ckpt),
+        ))
+
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        streams = [list(_local_batches_n(p, cfg.vocab_size, steps_total))
+                   for p in range(2)]
+        global_batches = [
+            {k: np.concatenate([b0[k], b1[k]]) for k in b0}
+            for b0, b1 in zip(*streams)
+        ]
+
+        # Uninterrupted single-process run over all 6 batches — the
+        # trajectory anchor (loose: phase A ran fsdp=4 across 2 procs,
+        # so cross-mesh reduction-order float noise is already in the
+        # handoff state).
+        tcfg = TrainConfig(learning_rate=1e-3, warmup_steps=2,
+                           total_steps=steps_total)
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(tcfg.seed))
+        step = make_train_step(cfg, tcfg)
+        full = []
+        for batch in global_batches:
+            state, m = step(state, batch)
+            full.append(float(jax.device_get(m["loss"])))
+
+        # Two continuations from the SAME checkpoint: unsharded, and
+        # re-scaled onto an fsdp=2 mesh. They start from bit-identical
+        # state, so they must agree tightly — THE elastic-resume
+        # equivalence (restore-onto-new-topology changes nothing).
+        import json as _json
+
+        from shellac_tpu.training.loop import fit
+
+        def continue_from_ckpt(mesh, tag):
+            # Private copy: fit writes a final save, which would bleed
+            # a later step into the next continuation's restore.
+            import shutil
+
+            my_ckpt = tmp_path / f"ckpt_{tag}"
+            shutil.copytree(ckpt, my_ckpt)
+            log = tmp_path / f"resumed_{tag}.jsonl"
+            final = fit(cfg, tcfg, iter(global_batches[4:]), mesh=mesh,
+                        checkpoint_dir=str(my_ckpt), checkpoint_every=100,
+                        log_path=str(log), log_every=1)
+            assert int(jax.device_get(final.step)) == steps_total
+            rows = [_json.loads(x) for x in log.read_text().splitlines()]
+            return {r["step"]: r["loss"] for r in rows if "loss" in r}
+
+        mesh2 = make_mesh(ParallelConfig(fsdp=2),
+                          devices=jax.devices()[:2])
+        flat = continue_from_ckpt(None, "flat")
+        rescaled = continue_from_ckpt(mesh2, "fsdp2")
+        for s in (5, 6):
+            assert abs(rescaled[s] - flat[s]) < 2e-4, (s, rescaled, flat)
+            # Loose anchor against the uninterrupted trajectory.
+            assert abs(rescaled[s] - full[s - 1]) < 5e-3, (
+                s, rescaled, full
+            )
