@@ -571,6 +571,11 @@ def cmd_generate(args):
         if args.kv_quant:
             raise SystemExit("--kv-quant does not compose with "
                              "--draft-model")
+        if args.num_beams and args.num_beams > 1:
+            raise SystemExit("--num-beams does not compose with "
+                             "--draft-model (beam search is "
+                             "deterministic; speculative decoding "
+                             "samples)")
         from shellac_tpu.inference.speculative import SpeculativeEngine
         from shellac_tpu.models.registry import PRESETS
 
@@ -607,6 +612,21 @@ def cmd_generate(args):
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         kv_quant=args.kv_quant,
     )
+    if args.num_beams and args.num_beams > 1:
+        seqs, scores = eng.beam_search(
+            jnp.asarray(prompt)[0], num_beams=args.num_beams,
+            max_new_tokens=args.max_new, eos_id=args.eos_id,
+            length_penalty=args.length_penalty,
+        )
+        ids = np.asarray(apply_stop(np.asarray(seqs[0], np.int64)))
+        result = {
+            "tokens": ids.tolist(),
+            "beam_scores": [round(s, 4) for s in scores],
+        }
+        if tok is not None:
+            result["text"] = tok.decode(ids)
+        print(json.dumps(result))
+        return 0
     out = eng.generate(jnp.asarray(prompt), max_new_tokens=args.max_new)
     ids = apply_stop(np.asarray(out.tokens)[0])
     result = {"tokens": ids.tolist()}
@@ -1020,6 +1040,15 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--temperature", type=float, default=1.0)
     g.add_argument("--top-k", type=int, default=None)
     g.add_argument("--top-p", type=float, default=None)
+    g.add_argument("--num-beams", type=int, default=None, dest="num_beams",
+                   help="beam search with N beams (deterministic; "
+                        "ignores temperature/top-k/top-p)")
+    g.add_argument("--length-penalty", type=float, default=1.0,
+                   dest="length_penalty",
+                   help="beam ranking divides scores by len^alpha "
+                        "(0 = raw sum, 1 = mean logprob)")
+    g.add_argument("--eos-id", type=int, default=None, dest="eos_id",
+                   help="EOS token id for beam finishing")
     g.add_argument("--ckpt-dir")
     g.add_argument("--native-dir", dest="native_dir",
                    help="directory written by `convert`")

@@ -110,6 +110,7 @@ class Engine:
                 self._prefill_impl, out_shardings=(None, cache_sh, None)
             )
         self._decode = jax.jit(self._decode_impl, static_argnums=(3,))
+        self._beam = jax.jit(self._beam_impl, static_argnums=(3, 4, 5))
 
     def _prefill_impl(self, params, tokens, prompt_len):
         """tokens: (B, S_pad) right-padded; prompt_len: (B,) real lengths."""
@@ -190,6 +191,134 @@ class Engine:
         return self._decode(
             self.params, first_logits, cache, max_new_tokens, key, seen
         )
+
+    # ---- beam search -------------------------------------------------
+
+    @staticmethod
+    def _reorder_cache(cache, idx):
+        """Gather cache rows by beam index. Every cache field is
+        stacked (L, B, ...) except the per-sequence lengths (B,) — so
+        the gather axis is a field-name rule, valid for the dense,
+        int8, and rolling cache types alike."""
+        fields = {
+            name: jnp.take(getattr(cache, name), idx, axis=1)
+            for name in cache.__dataclass_fields__
+            if name != "lengths"
+        }
+        return cache.replace(lengths=cache.lengths[idx], **fields)
+
+    def _beam_impl(self, params, first_logits, cache, steps, eos_id,
+                   length_penalty):
+        """Device-side beam loop: one forward per step for all beams,
+        flat top-k over (K, V) candidates, cache rows gathered by the
+        winning beams (the standard public algorithm, built on the same
+        scanned cached forward as sampling)."""
+        k, v = first_logits.shape
+        neg = jnp.float32(-1e30)
+
+        # First expansion comes from ONE distribution (all beams hold
+        # the same prefill): masking all but beam 0 keeps the top-k
+        # from picking duplicate (beam, token) pairs.
+        lp0 = jax.nn.log_softmax(first_logits.astype(jnp.float32))
+        scores0 = jnp.where(jnp.arange(k) == 0, 0.0, neg)
+        cand = (scores0[:, None] + lp0).reshape(-1)
+        scores, flat = jax.lax.top_k(cand, k)
+        beam0, tok0 = flat // v, (flat % v).astype(jnp.int32)
+        cache = self._reorder_cache(cache, beam0)
+        finished0 = (tok0 == eos_id) if eos_id is not None else (
+            jnp.zeros((k,), bool)
+        )
+        out0 = jnp.zeros((k, steps), jnp.int32).at[:, 0].set(tok0)
+        lens0 = jnp.ones((k,), jnp.int32)
+
+        def step(carry, _):
+            cache, cur, scores, finished, out, lens, i = carry
+            logits, cache = transformer.forward_with_cache(
+                self.cfg, params, cur[:, None], cache, mesh=self.mesh
+            )
+            lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32))
+            if eos_id is not None:
+                # Finished beams persist unchanged: their only legal
+                # continuation is a zero-cost EOS self-loop.
+                frozen = jnp.full((v,), neg).at[eos_id].set(0.0)
+                lp = jnp.where(finished[:, None], frozen[None], lp)
+            cand = (scores[:, None] + lp).reshape(-1)
+            scores, flat = jax.lax.top_k(cand, k)
+            beam, tok = flat // v, (flat % v).astype(jnp.int32)
+            cache = self._reorder_cache(cache, beam)
+            out = out[beam].at[:, i].set(tok)
+            was_done = finished[beam]
+            lens = jnp.where(was_done, lens[beam], lens[beam] + 1)
+            if eos_id is not None:
+                finished = was_done | (tok == eos_id)
+            else:
+                finished = was_done
+            # A frozen beam must not grow its cache: re-feeding EOS
+            # writes a row, but lengths were already advanced by the
+            # forward — roll them back for finished beams.
+            cache = cache.replace(
+                lengths=jnp.where(
+                    was_done, cache.lengths - 1, cache.lengths
+                )
+            )
+            return (cache, tok, scores, finished, out, lens, i + 1), None
+
+        carry = (cache, tok0, scores, finished0, out0, lens0,
+                 jnp.int32(1))
+        (cache, _, scores, finished, out, lens, _), _ = jax.lax.scan(
+            step, carry, None, length=steps - 1
+        )
+        # Length-penalized final ranking (HF/GNMT convention: divide by
+        # len^alpha; alpha=0 is raw sum-logprob, alpha=1 is mean).
+        norm = scores / jnp.power(lens.astype(jnp.float32),
+                                  jnp.float32(length_penalty))
+        order = jnp.argsort(-norm)
+        return out[order], norm[order], lens[order]
+
+    def beam_search(
+        self,
+        prompt_tokens,  # (S,) or (1, S) int32
+        *,
+        num_beams: int = 4,
+        max_new_tokens: int = 32,
+        eos_id: Optional[int] = None,
+        length_penalty: float = 1.0,
+    ):
+        """Deterministic beam decode of ONE prompt.
+
+        Returns (sequences, scores): sequences is a list of num_beams
+        token lists (EOS included when hit, best first), scores their
+        length-penalized log-probabilities. Paged pools are not
+        supported (beam reordering would need copy-on-write block
+        tables); the dense/int8/rolling caches gather rows directly.
+        """
+        if num_beams < 1:
+            raise ValueError("num_beams must be >= 1")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        tokens = jnp.asarray(prompt_tokens, jnp.int32).reshape(1, -1)
+        s = tokens.shape[1]
+        if s + max_new_tokens + 1 > self.max_len:
+            raise ValueError(
+                f"prompt {s} + max_new {max_new_tokens} exceeds "
+                f"max_len {self.max_len}"
+            )
+        # Prefill ONCE (B=1): every beam starts from the same prompt,
+        # so the K-way cache is a broadcast of one row, not K prefills.
+        first_logits, cache, _ = self._prefill(
+            self.params, tokens, jnp.full((1,), s, jnp.int32)
+        )
+        first_logits = jnp.tile(first_logits, (num_beams, 1))
+        cache = self._reorder_cache(
+            cache, jnp.zeros((num_beams,), jnp.int32)
+        )
+        out, norm, lens = self._beam(
+            self.params, first_logits, cache, int(max_new_tokens),
+            eos_id, float(length_penalty),
+        )
+        out, norm, lens = jax.device_get((out, norm, lens))
+        seqs = [row[:n].tolist() for row, n in zip(out, lens)]
+        return seqs, [float(x) for x in norm]
 
 
 def truncate_at_stop(tokens, stop, prompt_outputs=None):

@@ -1,0 +1,162 @@
+"""Beam search on the single-request Engine.
+
+The correctness bar is an exact reference: a host-side beam loop over
+the full (uncached) forward must produce the same sequences and scores
+as the device implementation (cached forward + flat top-k + cache-row
+reordering inside a lax.scan).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.engine import Engine
+from shellac_tpu.models import transformer
+
+
+def _cfg(**kw):
+    return get_model_config("tiny").replace(dtype="float32", **kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return cfg, transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _ref_beam(cfg, params, prompt, k, steps, eos_id=None,
+              length_penalty=1.0):
+    """Host beam search over the full forward (no cache): the oracle."""
+    beams = [(list(map(int, prompt)), 0.0, False)]  # (tokens, score, done)
+    neg = -1e30
+    for _ in range(steps):
+        cand = []
+        for toks, score, done in beams:
+            if done:
+                cand.append((toks, score, True, None))
+                continue
+            logits = transformer.forward(
+                cfg, params, jnp.asarray([toks], jnp.int32)
+            )[0, -1]
+            lp = np.asarray(jax.nn.log_softmax(logits.astype(jnp.float32)))
+            for t in np.argsort(-lp)[: 2 * k]:
+                cand.append((toks, score + float(lp[t]), False, int(t)))
+        cand.sort(key=lambda c: c[1], reverse=True)
+        new = []
+        for toks, score, done, t in cand[:k] if len(beams) > 1 else cand:
+            if len(new) == k:
+                break
+            if done:
+                new.append((toks, score, True))
+            else:
+                nt = toks + [t]
+                new.append((nt, score,
+                            eos_id is not None and t == eos_id))
+        beams = new
+        if all(d for _, _, d in beams):
+            break
+    out = []
+    plen = len(prompt)
+    for toks, score, _ in beams:
+        gen = toks[plen:]
+        out.append((gen, score / (len(gen) ** length_penalty)))
+    out.sort(key=lambda c: c[1], reverse=True)
+    return out
+
+
+class TestBeamSearch:
+    def test_matches_reference(self, model):
+        cfg, params = model
+        eng = Engine(cfg, params, temperature=0.0, max_len=64)
+        prompt = [7, 23, 5]
+        k, steps = 3, 5
+        got_seqs, got_scores = eng.beam_search(
+            prompt, num_beams=k, max_new_tokens=steps, length_penalty=1.0
+        )
+        ref = _ref_beam(cfg, params, prompt, k, steps)
+        # The TOP beam must match exactly (lower beams can differ by
+        # tie-breaks between equal-score candidates).
+        assert got_seqs[0] == ref[0][0], (got_seqs[0], ref[0][0])
+        np.testing.assert_allclose(got_scores[0], ref[0][1], rtol=1e-4)
+        # Scores must be sorted best-first.
+        assert got_scores == sorted(got_scores, reverse=True)
+
+    def test_beam1_equals_greedy(self, model):
+        cfg, params = model
+        eng = Engine(cfg, params, temperature=0.0, max_len=64)
+        prompt = jnp.asarray([[3, 9, 17]], jnp.int32)
+        greedy = np.asarray(
+            eng.generate(prompt, max_new_tokens=6).tokens
+        )[0].tolist()
+        seqs, _ = eng.beam_search([3, 9, 17], num_beams=1,
+                                  max_new_tokens=6)
+        assert seqs[0] == greedy
+
+    def test_eos_finishes_and_freezes(self, model):
+        """Declare the model's own top first token to be EOS: that beam
+        finishes at length 1, and with raw-sum scoring
+        (length_penalty=0) no longer sequence can beat it — every
+        continuation only ADDS negative log-probs to a start that was
+        already <= the best single step."""
+        cfg, params = model
+        eng = Engine(cfg, params, temperature=0.0, max_len=64)
+        prompt = [1, 2]
+        greedy = np.asarray(
+            eng.generate(jnp.asarray([prompt], jnp.int32),
+                         max_new_tokens=1).tokens
+        )[0, 0]
+        eos = int(greedy)
+        seqs, scores = eng.beam_search(
+            prompt, num_beams=3, max_new_tokens=8, eos_id=eos,
+            length_penalty=0.0,
+        )
+        assert seqs[0] == [eos]
+        # The frozen beam's score is exactly the single-step logprob —
+        # it must not have accumulated anything while frozen.
+        logits = transformer.forward(
+            cfg, params, jnp.asarray([prompt], jnp.int32)
+        )[0, -1]
+        lp0 = float(jax.nn.log_softmax(logits.astype(jnp.float32))[eos])
+        np.testing.assert_allclose(scores[0], lp0, rtol=1e-4)
+
+    def test_length_penalty_changes_ranking(self, model):
+        cfg, params = model
+        eng = Engine(cfg, params, temperature=0.0, max_len=64)
+        raw_seqs, raw = eng.beam_search([4, 8], num_beams=4,
+                                        max_new_tokens=6,
+                                        length_penalty=0.0)
+        mean_seqs, mean = eng.beam_search([4, 8], num_beams=4,
+                                          max_new_tokens=6,
+                                          length_penalty=1.0)
+        # Same candidate set; alpha=1 divides by length (all beams run
+        # the full budget without EOS, so scores scale by 1/6).
+        np.testing.assert_allclose(
+            sorted(np.asarray(raw) / 6.0), sorted(mean), rtol=1e-5
+        )
+
+    def test_int8_cache_composes(self, model):
+        """Beam search over the int8 cache: correct shape/ordering and
+        a top score within the int8 rounding envelope of bf16 (near-tie
+        beams may legitimately swap — cache rounding shifts scores by
+        ~1e-2 on this model, so sequence equality is NOT the contract)."""
+        cfg, params = model
+        a, sa = Engine(cfg, params, temperature=0.0,
+                       max_len=64).beam_search([6, 6, 2], num_beams=3,
+                                               max_new_tokens=5)
+        b, sb = Engine(cfg, params, temperature=0.0, max_len=64,
+                       kv_quant="int8").beam_search([6, 6, 2],
+                                                    num_beams=3,
+                                                    max_new_tokens=5)
+        assert len(b) == 3 and sb == sorted(sb, reverse=True)
+        np.testing.assert_allclose(sa[0], sb[0], atol=0.05)
+
+    def test_guards(self, model):
+        cfg, params = model
+        eng = Engine(cfg, params, max_len=32)
+        with pytest.raises(ValueError, match="num_beams"):
+            eng.beam_search([1], num_beams=0, max_new_tokens=4)
+        with pytest.raises(ValueError, match="max_len"):
+            eng.beam_search(list(range(30)), num_beams=2,
+                            max_new_tokens=8)
