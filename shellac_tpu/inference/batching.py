@@ -121,6 +121,9 @@ class BatchingEngine:
     # Subclasses that replace self._cache after this ctor set this True
     # so mesh sharding is pinned once, on the final cache pytree.
     _swaps_cache = False
+    # Can this engine score prompts (prompt_logprobs)? Subclasses whose
+    # prefill skips scoring forwards (speculative drafts) set False.
+    _scores_prompts = True
 
     def __init__(
         self,
@@ -580,10 +583,12 @@ class BatchingEngine:
                     f"request {rid!r}: logit_bias token ids {oob} outside "
                     f"vocab [0, {self.cfg.vocab_size})"
                 )
-        if prompt_logprobs and self._swaps_cache:
+        if prompt_logprobs and getattr(self, "prefix_cache", False):
             raise ValueError(
-                f"request {rid!r}: prompt_logprobs is not wired for the "
-                "paged engine yet"
+                f"request {rid!r}: prompt_logprobs does not compose "
+                "with the prefix cache (a cache hit skips exactly the "
+                "forward passes that would score the prefix); use a "
+                "non-prefix-cached engine for scoring"
             )
         pres = float(presence_penalty) if presence_penalty is not None \
             else 0.0
@@ -1216,7 +1221,8 @@ class PagedBatchingEngine(BatchingEngine):
         # Registrations deferred until the slot's prefill completes
         # (the blocks hold garbage until then): slot -> [(idx, hash)].
         self._pending_reg: Dict[int, List] = {}
-        self._prefix_prefill_jit: Dict[int, Any] = {}
+        # Keyed (pad_bucket, want_plp), like the dense _chunk_jit.
+        self._prefix_prefill_jit: Dict[Any, Any] = {}
         if prefix_cache:
             self.stats.update({
                 "prefix_hit_tokens": 0,
@@ -1402,18 +1408,22 @@ class PagedBatchingEngine(BatchingEngine):
                        key, samp, boundary_next=None, want_plp=False):
         """Paged chunks reuse the continuation program (a chunk is a
         'suffix' past `offset` resident tokens; offset 0 included).
-        want_plp is rejected at submit for paged engines; the dummy
-        tail keeps the base _advance_prefills' 5-output contract."""
-        if pad not in self._prefix_prefill_jit:
-            self._prefix_prefill_jit[pad] = self._jit_cache_program(
-                self._prefix_prefill_impl, 2
+        Prompt logprobs ride the same stitching contract as the dense
+        chunked path: per-chunk in-row scores plus the boundary score
+        of the next chunk's first token."""
+        jkey = (pad, want_plp)
+        if jkey not in self._prefix_prefill_jit:
+            self._prefix_prefill_jit[jkey] = self._jit_cache_program(
+                functools.partial(
+                    self._prefix_prefill_impl, want_plp=want_plp
+                ), 4,
             )
-        cache, first, lp = self._prefix_prefill_jit[pad](
+        if boundary_next is None:
+            boundary_next = jnp.zeros((), jnp.int32)
+        return self._prefix_prefill_jit[jkey](
             self.params, self._cache, tokens, chunk_len, offset, slot, key,
-            samp,
+            samp, boundary_next,
         )
-        return (cache, first, lp, jnp.zeros((pad,), jnp.float32),
-                jnp.zeros((), jnp.float32))
 
     def _run_prefill(self, slot: int, req):
         """Prefix-cached prefill: compute only the unmatched suffix;
@@ -1429,15 +1439,13 @@ class PagedBatchingEngine(BatchingEngine):
         # corrupting just-written suffix KV (s <= max_len - p always,
         # so the cap never cuts real tokens).
         pad = min(_bucket(s), self.max_len - p)
-        if pad not in self._prefix_prefill_jit:
-            self._prefix_prefill_jit[pad] = self._jit_cache_program(
-                self._prefix_prefill_impl, 2
-            )
         padded = np.zeros((1, pad), np.int32)
         padded[0, :s] = suffix
         self._key, sub = jax.random.split(self._key)
-        cache, first, lp = self._prefix_prefill_jit[pad](
-            self.params, self._cache, jnp.asarray(padded),
+        # One dispatch path: the chunk-continuation program IS the
+        # suffix prefill (a suffix is a chunk past `p` resident tokens).
+        cache, first, lp, _, _ = self._chunk_prefill(
+            pad, False, jnp.asarray(padded),
             jnp.asarray([s], jnp.int32), jnp.asarray([p], jnp.int32),
             slot, sub, self._slot_samp(slot, req),
         )
@@ -1445,7 +1453,8 @@ class PagedBatchingEngine(BatchingEngine):
         return first, lp
 
     def _prefix_prefill_impl(
-        self, params, cache, tokens, suffix_len, prefix_len, slot, key, samp
+        self, params, cache, tokens, suffix_len, prefix_len, slot, key,
+        samp, boundary_next, *, want_plp: bool = False,
     ):
         """Continue from `prefix_len` cached tokens: a batch-1 view of
         the slot's table row over the shared pool, forwarded with
@@ -1453,6 +1462,10 @@ class PagedBatchingEngine(BatchingEngine):
         (and itself) through the table. Suffix K/V writes land in the
         slot's own blocks — shared prefix blocks are upstream of every
         written position, so they stay read-only.
+
+        want_plp returns the same (in-chunk scores, boundary score)
+        pair as the dense chunked program, so the base class's
+        cross-chunk stitching applies unchanged.
 
         attn_impl is pinned to "ref": the chunked continuation attends
         over the gathered block view once per request; the flash decode
@@ -1478,6 +1491,13 @@ class PagedBatchingEngine(BatchingEngine):
             logits, (suffix_len - 1)[:, None, None].astype(jnp.int32), axis=1
         )[0, 0]
         first, first_lp = self._sample_first(key, last, samp)
+        plp_within = jnp.zeros((tokens.shape[1],), jnp.float32)
+        boundary_lp = jnp.zeros((), jnp.float32)
+        if want_plp:
+            plp_within = self._plp_within(logits, tokens)
+            boundary_lp = jax.nn.log_softmax(
+                last.astype(jnp.float32)
+            )[boundary_next]
         fields = dict(
             k=view.k, v=view.v,
             lengths=jax.lax.dynamic_update_slice(
@@ -1487,14 +1507,14 @@ class PagedBatchingEngine(BatchingEngine):
         if self.kv_quant == "int8":
             fields.update(ks=view.ks, vs=view.vs)
         cache = cache.replace(**fields)
-        return cache, first, first_lp
+        return cache, first, first_lp, plp_within, boundary_lp
 
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
                       samp, want_plp: bool = False):
         """Mini-prefill (dense bf16 or int8+scales, matching the pool's
-        kind), then scatter through the slot's table. (want_plp is
-        rejected at submit for paged engines; the dummy return keeps
-        the base _run_prefill's 4-output contract.)"""
+        kind), then scatter through the slot's table. want_plp scores
+        the prompt from the mini-prefill's own logits — identical math
+        to the dense engine's whole-prompt scoring."""
         s = tokens.shape[1]
         mini = init_cache_for(self.cfg, 1, s, self.kv_quant)
         logits, mini = transformer.forward_with_cache(
@@ -1534,9 +1554,9 @@ class PagedBatchingEngine(BatchingEngine):
                 mini.vs[:, 0].transpose(2, 0, 1)
             )
         cache = cache.replace(**fields)
-        return cache, first, first_lp, jnp.zeros(
-            (tokens.shape[1],), jnp.float32
-        )
+        plp = (self._plp_within(logits, tokens) if want_plp
+               else jnp.zeros((tokens.shape[1],), jnp.float32))
+        return cache, first, first_lp, plp
 
 
 class _PoolExhausted(Exception):

@@ -595,8 +595,13 @@ class InferenceServer:
 
     def _prompt_lp_capable(self) -> bool:
         eng = self.engine
-        return not (getattr(eng, "_swaps_cache", False)
-                    or not hasattr(eng, "finished_prompt_logprobs"))
+        if not hasattr(eng, "finished_prompt_logprobs"):
+            return False
+        # Paged engines score prompts now; out are the prefix cache (a
+        # cache hit skips exactly the scoring forward passes) and
+        # speculative engines (draft/verify prefill does not score).
+        return (getattr(eng, "_scores_prompts", True)
+                and not getattr(eng, "prefix_cache", False))
 
     # ---- OpenAI-compatible façade -----------------------------------
 
@@ -612,9 +617,9 @@ class InferenceServer:
         echo = bool(native.pop("echo", False))
         if native.get("prompt_logprobs") and not self._prompt_lp_capable():
             raise ValueError(
-                "echo with logprobs is unavailable on this server: prompt "
-                "scoring runs on the dense engine (the server runs paged "
-                "or speculative prefill)"
+                "echo with logprobs is unavailable on this server: the "
+                "engine cannot score prompts (prefix-cached or "
+                "speculative prefill skips the scoring forwards)"
             )
         tokens = self._parse(native)[0]
         # Hand handle() the ids so the prompt is not tokenized twice.

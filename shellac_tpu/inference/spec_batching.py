@@ -43,6 +43,8 @@ from shellac_tpu.models import transformer
 
 
 class SpeculativeBatchingEngine(BatchingEngine):
+    _scores_prompts = False  # draft/verify prefill skips prompt scoring
+
     """Continuous batching with a draft model proposing gamma tokens."""
 
     def __init__(

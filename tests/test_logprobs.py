@@ -281,14 +281,43 @@ class TestPromptLogprobs:
         assert len(chunked) == len(prompt)
         np.testing.assert_allclose(chunked, whole, atol=1e-5)
 
+    def test_paged_matches_dense(self):
+        """Prompt scoring over the paged pool — whole-prompt AND
+        chunked — equals the dense engine's exactly."""
+        from shellac_tpu.inference.batching import (
+            BatchingEngine,
+            PagedBatchingEngine,
+        )
+
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = list(np.random.RandomState(1).randint(0, 256, 27))
+
+        def run(kind, **kw):
+            eng = kind(cfg, params, n_slots=2, max_len=64,
+                       temperature=0.0, **kw)
+            eng.submit("r", prompt, 4, prompt_logprobs=True)
+            done = {}
+            while len(done) < 1:
+                done.update(eng.step())
+            return eng.finished_prompt_logprobs.pop("r")
+
+        dense = run(BatchingEngine)
+        paged = run(PagedBatchingEngine, block_size=16, pool_tokens=256)
+        np.testing.assert_allclose(paged, dense, atol=1e-5)
+        chunked = run(PagedBatchingEngine, block_size=16,
+                      pool_tokens=256, prefill_chunk=10)
+        np.testing.assert_allclose(chunked, dense, atol=1e-5)
+
     def test_guards(self):
         from shellac_tpu.inference.batching import PagedBatchingEngine
 
         cfg = get_model_config("tiny").replace(dtype="float32")
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
         eng = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
-                                  block_size=16, pool_tokens=256)
-        with pytest.raises(ValueError, match="prompt_logprobs"):
+                                  block_size=16, pool_tokens=256,
+                                  prefix_cache=True)
+        with pytest.raises(ValueError, match="prefix cache"):
             eng.submit("r", [1, 2, 3], 4, prompt_logprobs=True)
 
     def test_openai_echo_logprobs(self):
