@@ -17,6 +17,7 @@ an error JSON line. Never a bare traceback.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -48,22 +49,56 @@ def tpu_usable(timeout_s: float = 90.0, retries: int = 1) -> bool:
 
 def args_nonheadline(args) -> bool:
     """True when variant flags change the recipe — cached-headline
-    replay only applies to the driver's plain `python bench.py`."""
+    replay and recipe adoption only apply to the driver's plain
+    `python bench.py`."""
     return bool(args.packed or args.quant or args.fused_loss
                 or args.batch or args.preset)
 
 
-def latest_queue_tpu_line(path="/root/repo/tpu_queue_r4.jsonl"):
-    """Newest train-throughput *_tpu row the watchdog queue captured
-    this round (scripts/run_tpu_queue.sh appends bench.py stdout on
-    success). Returns the row with provenance, or None."""
-    import os
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
 
+
+def load_recipe(path=None):
+    """The measured recipe scripts/adopt_recipe.py wrote, or None.
+    Both sides derive the path from their own file location so any
+    checkout works."""
+    if path is None:
+        path = os.path.join(_REPO_DIR, "bench_recipe.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def latest_queue_tpu_line(path=None):
+    """Newest HEADLINE-config row the watchdog queue captured this
+    round (scripts/run_tpu_queue.sh appends bench.py stdout on
+    success). Returns the row with provenance, or None.
+
+    A row qualifies only when the CONFIG it measured matches the
+    current headline recipe — the metric name alone is ambiguous (it
+    encodes fused_loss but not batch or remat policy, so e.g. the
+    --fused-loss --batch 8 variant shares a name with an adopted
+    fused recipe). bench.py rows record their full config in detail;
+    rows without it are trusted only for the plain name with no
+    recipe in effect.
+    """
+    if path is None:
+        path = os.path.join(_REPO_DIR, "tpu_queue_r4.jsonl")
     path = os.environ.get("SHELLAC_QUEUE_RESULTS", path)
-    # EXACT headline metric only (shellac-1b plain recipe): the queue
-    # also appends variant rows (_fused/_int8/_packed, the MLA preset's
-    # 2048d20L) that must never masquerade as the plain headline.
-    headline = "train_throughput_2048d16L_seq2048_tpu"
+    rec = load_recipe()
+    want = {
+        "batch": rec.get("batch", 6) if rec else 6,
+        "remat_policy": rec.get("remat_policy", "none") if rec else "none",
+        "fused_loss": rec.get("fused_loss") if rec else None,
+        "quant": None,
+        "packed": False,
+    }
+    fused = want["fused_loss"]
+    name = (f"train_throughput_2048d16L_seq2048"
+            f"{f'_fused{fused}' if fused else ''}_tpu")
+    plain_name = "train_throughput_2048d16L_seq2048_tpu"
     best = None
     try:
         with open(path) as f:
@@ -72,9 +107,15 @@ def latest_queue_tpu_line(path="/root/repo/tpu_queue_r4.jsonl"):
                     row = json.loads(line)
                 except ValueError:
                     continue
-                if (row.get("metric") == headline
-                        and isinstance(row.get("value"), (int, float))):
-                    best = row  # last one wins: newest capture
+                if not isinstance(row.get("value"), (int, float)):
+                    continue
+                detail = row.get("detail") or {}
+                if row.get("metric") == name and "batch" in detail:
+                    if all(detail.get(k) == v for k, v in want.items()):
+                        best = row  # last one wins: newest capture
+                elif (row.get("metric") == plain_name
+                      and "batch" not in detail and rec is None):
+                    best = row  # legacy row without config detail
     except OSError:
         return None
     if best is not None:
@@ -130,6 +171,7 @@ def main(argv=None):
 
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
+    recipe = None
     if on_tpu:
         # Batch 6 is the single-chip sweet spot with bf16 adam mu and the
         # Pallas flash backward (batch 8 fits but is marginally slower).
@@ -138,6 +180,17 @@ def main(argv=None):
         if args.preset == "shellac-mla-2b":
             # 2.4B params at seq 2048: batch 4 fits comfortably.
             batch = 4
+        if not args_nonheadline(args):
+            # A measured sweep winner (scripts/adopt_recipe.py) becomes
+            # the plain headline recipe — exact-math configs only, and
+            # only when it beat the default by >1% on this hardware.
+            recipe = load_recipe()
+            if recipe is not None:
+                batch = recipe.get("batch", batch)
+                args.fused_loss = recipe.get("fused_loss")
+                pol = recipe.get("remat_policy", "none")
+                if pol and pol != "none":
+                    cfg = cfg.replace(remat_policy=pol)
     else:
         cfg = get_model_config(args.preset or "tiny")
         batch, seq, steps = 4, 128, 3
@@ -203,9 +256,22 @@ def main(argv=None):
         "params": n_params,
         "step_time_s": round(dt / steps, 4),
         "loss": round(final_loss, 4),
+        # Full config, so consumers (adopt_recipe, the replay filter)
+        # match rows on what was MEASURED, not on name parsing — the
+        # metric name does not encode batch or remat policy.
+        "batch": batch,
+        "remat_policy": cfg.remat_policy,
+        "fused_loss": args.fused_loss,
+        "quant": args.quant,
+        "packed": bool(args.packed),
     }
     if mfu_denom:
         extra["mfu"] = round(tok_s * flops_per_token / mfu_denom, 4)
+    if recipe is not None:
+        extra["recipe"] = {
+            k: recipe.get(k)
+            for k in ("batch", "fused_loss", "remat_policy", "source")
+        }
     result["detail"] = extra
     print(json.dumps(result))
 

@@ -1,0 +1,109 @@
+"""Adopt the measured best training recipe as bench.py's TPU default.
+
+Reads the watchdog queue's results (bench.py variant rows + bench_sweep
+rows), picks the fastest EXACT-MATH configuration for the shellac-1b
+headline shape (quantized and packed variants change the numerics or
+the data shape, so they stay labeled variants, never the headline), and
+writes bench_recipe.json at the repo root when it beats the plain
+recipe by >1%. bench.py applies the recipe to plain TPU invocations and
+labels the metric accordingly.
+
+    python scripts/adopt_recipe.py [queue.jsonl]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_QUEUE = os.path.join(REPO, "tpu_queue_r4.jsonl")
+RECIPE_PATH = os.path.join(REPO, "bench_recipe.json")
+
+# bench.py's current plain recipe (the baseline to beat).
+PLAIN = {"batch": 6, "fused_loss": None, "remat_policy": "none"}
+HEADLINE_PREFIX = "train_throughput_2048d16L_seq2048"
+
+
+def candidates(path):
+    """Measured (config, tok_s) rows. bench.py rows are matched on the
+    FULL config recorded in detail — never on metric-name parsing,
+    which cannot distinguish e.g. `--fused-loss --batch 8` from an
+    adopted fused recipe; rows without config detail are skipped (they
+    predate the detail fields and their config is unknowable)."""
+    with open(path) as f:
+        for line in f:
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            metric = row.get("metric", "")
+            detail = row.get("detail") or {}
+            if (metric.startswith(HEADLINE_PREFIX)
+                    and metric.endswith("_tpu")
+                    and "batch" in detail):
+                # Exact-math configs only.
+                if detail.get("quant") or detail.get("packed"):
+                    continue
+                cfg = {
+                    "batch": int(detail["batch"]),
+                    "fused_loss": detail.get("fused_loss"),
+                    "remat_policy": detail.get("remat_policy", "none"),
+                }
+                yield dict(
+                    cfg, tok_s=row["value"], mfu=detail.get("mfu"),
+                    kind="plain" if cfg == PLAIN else "bench_variant",
+                )
+            elif "tok_s" in row and "batch" in row and "policy" in row:
+                # bench_sweep row; exact-math configs only.
+                if row.get("quant") or row.get("packed"):
+                    continue
+                if not row.get("remat", True):
+                    continue  # remat off rarely fits the 1b shape
+                yield {
+                    "batch": int(row["batch"]),
+                    "fused_loss": (int(row["fused"])
+                                   if row.get("fused") else None),
+                    "remat_policy": row.get("policy", "none"),
+                    "tok_s": row["tok_s"],
+                    "mfu": row.get("mfu"),
+                    "kind": "sweep",
+                }
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_QUEUE
+    rows = list(candidates(path))
+    if not rows:
+        print(json.dumps({"adopt": "no candidates", "queue": path}))
+        return 0
+    plain = [r for r in rows if r["kind"] == "plain"]
+    best = max(rows, key=lambda r: r["tok_s"])
+    baseline = max((r["tok_s"] for r in plain), default=None)
+    if baseline is not None and best["tok_s"] < baseline * 1.01:
+        # Nothing beats plain by >1%: drop any stale recipe so the
+        # headline stays the simple, reproducible default.
+        if os.path.exists(RECIPE_PATH):
+            os.remove(RECIPE_PATH)
+        print(json.dumps({"adopt": "plain recipe stands",
+                          "plain_tok_s": baseline,
+                          "best_tok_s": best["tok_s"]}))
+        return 0
+    recipe = {
+        "batch": best["batch"],
+        "fused_loss": best["fused_loss"],
+        "remat_policy": best["remat_policy"],
+        "measured_tok_s": best["tok_s"],
+        "measured_mfu": best.get("mfu"),
+        "source": os.path.basename(path),
+        "beats_plain_tok_s": baseline,
+    }
+    with open(RECIPE_PATH, "w") as f:
+        json.dump(recipe, f, indent=1)
+    print(json.dumps({"adopt": "recipe written", **recipe}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,4 +138,10 @@ run sweep_b8_dots_fused 580 python scripts/bench_sweep.py \
 # 6. Training bench extras.
 run train_mla 580 python bench.py --preset shellac-mla-2b
 
+# 7. Adopt the measured sweep winner as the plain headline recipe and
+#    record one run under it (exact-math configs only; no-op when
+#    nothing beats the default by >1%).
+run adopt 60 python scripts/adopt_recipe.py "$OUT"
+run train_adopted 580 python bench.py
+
 echo "queue complete -> $OUT" >&2

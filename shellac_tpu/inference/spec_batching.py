@@ -43,9 +43,9 @@ from shellac_tpu.models import transformer
 
 
 class SpeculativeBatchingEngine(BatchingEngine):
-    _scores_prompts = False  # draft/verify prefill skips prompt scoring
-
     """Continuous batching with a draft model proposing gamma tokens."""
+
+    _scores_prompts = False  # draft/verify prefill skips prompt scoring
 
     def __init__(
         self,
