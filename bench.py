@@ -141,6 +141,10 @@ def main(argv=None):
     ap.add_argument("--preset", default=None,
                     help="model preset override (default: shellac-1b on "
                          "TPU; e.g. shellac-mla-2b for the MLA bench)")
+    ap.add_argument("--no-recipe", action="store_true", dest="no_recipe",
+                    help="ignore bench_recipe.json: measure the true "
+                         "plain recipe (the queue uses this so the "
+                         "adoption baseline stays honest every round)")
     args = ap.parse_args(argv)
 
     if not tpu_usable():
@@ -180,7 +184,7 @@ def main(argv=None):
         if args.preset == "shellac-mla-2b":
             # 2.4B params at seq 2048: batch 4 fits comfortably.
             batch = 4
-        if not args_nonheadline(args):
+        if not args_nonheadline(args) and not args.no_recipe:
             # A measured sweep winner (scripts/adopt_recipe.py) becomes
             # the plain headline recipe — exact-math configs only, and
             # only when it beat the default by >1% on this hardware.

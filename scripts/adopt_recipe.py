@@ -81,7 +81,17 @@ def main():
     plain = [r for r in rows if r["kind"] == "plain"]
     best = max(rows, key=lambda r: r["tok_s"])
     baseline = max((r["tok_s"] for r in plain), default=None)
-    if baseline is not None and best["tok_s"] < baseline * 1.01:
+    if baseline is None:
+        # Never adopt without a measured plain baseline from THIS
+        # queue (the queue's train_plain runs --no-recipe precisely so
+        # this row exists every round): an unconditional adoption
+        # could entrench a recipe that has become slower than plain.
+        print(json.dumps({
+            "adopt": "no plain baseline in queue; keeping recipe as-is",
+            "best_tok_s": best["tok_s"],
+        }))
+        return 0
+    if best["tok_s"] < baseline * 1.01:
         # Nothing beats plain by >1%: drop any stale recipe so the
         # headline stays the simple, reproducible default.
         if os.path.exists(RECIPE_PATH):

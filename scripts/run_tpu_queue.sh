@@ -97,7 +97,9 @@ run kern4096 580 python scripts/bench_decode.py --mode kernel --ctx 4096
 
 # 3. Training bench: headline first (the round needs a driver-visible
 #    TPU training number more than anything else), then variants.
-run train_plain 580 python bench.py
+#    --no-recipe keeps the plain baseline honest even after a recipe
+#    was adopted in an earlier round (adopt_recipe compares against it).
+run train_plain 580 python bench.py --no-recipe
 run train_fused 580 python bench.py --fused-loss 4096
 run train_fused_b8 580 python bench.py --fused-loss 4096 --batch 8
 run train_int8 580 python bench.py --quant int8

@@ -800,6 +800,7 @@ def cmd_serve(args):
             n_slots=args.slots, max_len=args.max_len or cfg.max_seq_len,
             temperature=args.temperature, eos_id=args.eos_id,
             seed=args.seed, logprobs=args.logprobs,
+            top_logprobs=args.top_logprobs,
             max_prefills_per_step=args.max_prefills_per_step,
             mesh=mesh,
         )
@@ -824,6 +825,7 @@ def cmd_serve(args):
             max_prefills_per_step=args.max_prefills_per_step,
             prefill_chunk=args.prefill_chunk,
             logprobs=args.logprobs,
+            top_logprobs=args.top_logprobs,
             mesh=mesh,
             kv_quant=args.kv_quant,
             **extra,
@@ -849,6 +851,7 @@ def cmd_serve(args):
         max_prefills_per_step=args.max_prefills_per_step,
         prefill_chunk=args.prefill_chunk,
         logprobs=args.logprobs,
+        top_logprobs=args.top_logprobs,
         kv_quant=args.kv_quant,
         rolling_window=args.rolling_window,
         step_timeout=args.step_timeout,
@@ -1151,6 +1154,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--logprobs", action="store_true",
                    help="track per-token logprobs so requests may ask "
                         "for them")
+    s.add_argument("--top-logprobs", type=int, default=0,
+                   dest="top_logprobs",
+                   help="record N alternative tokens per generated "
+                        "token (payload top_logprobs slices down; "
+                        "needs --logprobs)")
     s.add_argument("--prefill-chunk", type=int, default=None,
                    dest="prefill_chunk",
                    help="prefill prompts longer than this incrementally "

@@ -80,6 +80,9 @@ class _Request:
     frequency_penalty: float = 0.0
     prompt_logprobs: bool = False
     plp: Optional[List[float]] = None
+    # Per emitted token, the engine's top-K alternatives as
+    # ([ids], [logprobs]) pairs (engines built with top_logprobs > 0).
+    tlp: Optional[List] = None
     seed: Optional[int] = None
     # Additive per-token logit biases applied before sampling (OpenAI
     # semantics); logprobs still report the raw distribution.
@@ -143,12 +146,23 @@ class BatchingEngine:
         max_prefills_per_step: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
         logprobs: bool = False,
+        top_logprobs: int = 0,
         mesh=None,
         kv_quant: Optional[str] = None,
         rolling_window: bool = False,
     ):
         if kv_quant not in (None, "int8"):
             raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
+        top_logprobs = int(top_logprobs or 0)
+        if top_logprobs < 0 or top_logprobs > 32:
+            raise ValueError(
+                f"top_logprobs={top_logprobs}: must be in [0, 32]"
+            )
+        if top_logprobs and not logprobs:
+            raise ValueError(
+                "top_logprobs needs logprobs=True (the alternatives "
+                "ride the same scoring pass)"
+            )
         if rolling_window:
             if self._swaps_cache:
                 raise ValueError(
@@ -199,6 +213,11 @@ class BatchingEngine:
         # requests deposit theirs here, keyed by rid, for the server
         # (or any caller) to pop.
         self.logprobs = logprobs
+        # K alternatives recorded per generated token (0 = off). The
+        # engine computes its max for every request; per-request k is
+        # the renderer's slice.
+        self.top_logprobs = top_logprobs
+        self.finished_top_logprobs: Dict[Any, List] = {}
         self.finished_logprobs: Dict[Any, List[float]] = {}
         # prompt_logprobs=True requests deposit the prompt's per-token
         # logprobs here on completion (keyed by rid), like
@@ -380,7 +399,9 @@ class BatchingEngine:
         first, first_lp = self._sample_first(key, last, samp)
         plp = (self._plp_within(logits, tokens) if want_plp
                else jnp.zeros((tokens.shape[1],), jnp.float32))
-        return scatter_slot(cache, mini, slot), first, first_lp, plp
+        tlv, tli = self._first_tl(last)
+        return (scatter_slot(cache, mini, slot), first, first_lp, plp,
+                tlv, tli)
 
     def _decode_impl(self, params, cache, cur, active, key, samp,
                      greedy_only: bool = False, use_bias: bool = False,
@@ -468,21 +489,30 @@ class BatchingEngine:
                 counts = counts.at[
                     jnp.arange(counts.shape[0]), nxt
                 ].add(active.astype(jnp.float32))
+            k_tl = self.top_logprobs
             if self.logprobs:
-                lp = jnp.take_along_axis(
-                    jax.nn.log_softmax(logits[:, 0].astype(jnp.float32)),
-                    nxt[:, None], axis=-1,
-                )[:, 0]
+                lsm = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32))
+                lp = jnp.take_along_axis(lsm, nxt[:, None], axis=-1)[:, 0]
+                if k_tl:
+                    tlv, tli = jax.lax.top_k(lsm, k_tl)
+                    tli = tli.astype(jnp.int32)
+                else:
+                    tlv = jnp.zeros((nxt.shape[0], 0), jnp.float32)
+                    tli = jnp.zeros((nxt.shape[0], 0), jnp.int32)
             else:
                 lp = jnp.zeros(nxt.shape, jnp.float32)
-            return (cache, nxt, min_rem, counts, cstate), (nxt, lp)
+                tlv = jnp.zeros((nxt.shape[0], 0), jnp.float32)
+                tli = jnp.zeros((nxt.shape[0], 0), jnp.int32)
+            return ((cache, nxt, min_rem, counts, cstate),
+                    (nxt, lp, tlv, tli))
 
         keys = jax.random.split(key, self.decode_ticks)
         ticks_i = jnp.arange(self.decode_ticks, dtype=jnp.int32)
-        (cache, _, min_rem, counts, cstate), (toks, lps) = jax.lax.scan(
+        ((cache, _, min_rem, counts, cstate),
+         (toks, lps, tlvs, tlis)) = jax.lax.scan(
             tick, (cache, cur, min_rem0, counts0, cstate0), (keys, ticks_i)
         )
-        return cache, toks, lps, min_rem, counts, cstate
+        return cache, toks, lps, min_rem, counts, cstate, tlvs, tlis
 
     # ---- scheduling --------------------------------------------------
 
@@ -508,6 +538,18 @@ class BatchingEngine:
             col = jnp.where(min_rem > 0, NEG_INF, x[:, self.eos_id])
             x = x.at[:, self.eos_id].set(col)
         return x
+
+    def _first_tl(self, last):
+        """Top-K alternatives of a prefill's first sampled position
+        ((1, K) values, (1, K) ids) — zero-width when disabled, so
+        every prefill program keeps one output arity per engine."""
+        k = self.top_logprobs
+        if not k:
+            return (jnp.zeros((1, 0), jnp.float32),
+                    jnp.zeros((1, 0), jnp.int32))
+        lsm = jax.nn.log_softmax(last.astype(jnp.float32))[None]
+        vals, ids = jax.lax.top_k(lsm, k)
+        return vals, ids.astype(jnp.int32)
 
     def _sample_first(self, key, last, samp):
         """Sample a prefill's first output token from the adjusted
@@ -776,7 +818,8 @@ class BatchingEngine:
 
     def _run_prefill(self, slot: int, req: _Request):
         """Run the (bucketed, jitted) prefill for `req`; returns
-        (first sampled token, its raw logprob), both device scalars."""
+        (first sampled token, its raw logprob, top-K alternatives or
+        None)."""
         s = req.tokens.size
         # Cap the bucket at max_len: a pad larger than the cache
         # (dense) or the block table (paged) would write out of
@@ -785,12 +828,12 @@ class BatchingEngine:
         key = (pad, req.prompt_logprobs)
         if key not in self._prefill_jit:
             self._prefill_jit[key] = self._jit_cache_program(
-                self._prefill_impl, 3, static_argnames=("want_plp",)
+                self._prefill_impl, 5, static_argnames=("want_plp",)
             )
         padded = np.zeros((1, pad), np.int32)
         padded[0, :s] = req.tokens
         self._key, sub = jax.random.split(self._key)
-        cache, first, lp, plp = self._prefill_jit[key](
+        cache, first, lp, plp, tlv, tli = self._prefill_jit[key](
             self.params, self._cache, jnp.asarray(padded),
             jnp.asarray([s], jnp.int32), slot, sub, self._slot_samp(slot, req),
             want_plp=req.prompt_logprobs,
@@ -799,7 +842,7 @@ class BatchingEngine:
         if req.prompt_logprobs:
             req.plp = [float(x) for x in
                        np.asarray(jax.device_get(plp))[:s]]
-        return first, lp
+        return first, lp, ((tlv, tli) if self.top_logprobs else None)
 
     def _prefill_start_offset(self, slot: int) -> int:
         """Tokens already resident when prefill starts (paged prefix
@@ -825,11 +868,11 @@ class BatchingEngine:
                 self._slots[i] = req
                 self._prefilling[i] = off
                 continue
-            first, lp = self._run_prefill(i, req)
-            self._finish_prefill(i, req, first, lp)
+            first, lp, tl = self._run_prefill(i, req)
+            self._finish_prefill(i, req, first, lp, tl)
 
     def _finish_prefill(self, slot: int, req: _Request, first,
-                        lp=None) -> None:
+                        lp=None, tl=None) -> None:
         first_tok = int(first)
         self._cur = self._cur.at[slot].set(first_tok)
         self._slots[slot] = req
@@ -852,6 +895,10 @@ class BatchingEngine:
         req.out.append(first_tok)
         if self.logprobs and lp is not None:
             req.lps.append(float(lp))
+        if self.top_logprobs and tl is not None:
+            tlv, tli = jax.device_get(tl)
+            req.tlp = [(np.asarray(tli)[0].tolist(),
+                        np.asarray(tlv)[0].tolist())]
         self.stats["prefills"] += 1
 
     # ---- chunked prefill --------------------------------------------
@@ -876,7 +923,7 @@ class BatchingEngine:
             boundary = (jnp.asarray(0, jnp.int32) if final
                         else jnp.asarray(int(req.tokens[off + s]),
                                          jnp.int32))
-            cache, first, lp, plp_w, blp = self._chunk_prefill(
+            cache, first, lp, plp_w, blp, tlv, tli = self._chunk_prefill(
                 pad, off == 0, jnp.asarray(
                     np.pad(chunk, (0, pad - s))[None]
                 ),
@@ -904,7 +951,10 @@ class BatchingEngine:
                         if blp_host is not None:
                             flat.append(float(blp_host))
                     req.plp = flat
-                self._finish_prefill(slot, req, first, lp)
+                self._finish_prefill(
+                    slot, req, first, lp,
+                    ((tlv, tli) if self.top_logprobs else None),
+                )
             else:
                 self._prefilling[slot] = off + s
         return used
@@ -916,7 +966,7 @@ class BatchingEngine:
         if jkey not in self._chunk_jit:
             self._chunk_jit[jkey] = self._jit_cache_program(
                 functools.partial(self._chunk_prefill_impl, fresh=fresh,
-                                  want_plp=want_plp), 4
+                                  want_plp=want_plp), 6
             )
         if boundary_next is None:
             boundary_next = jnp.zeros((), jnp.int32)
@@ -960,8 +1010,9 @@ class BatchingEngine:
             boundary_lp = jax.nn.log_softmax(
                 last.astype(jnp.float32)
             )[boundary_next]
+        tlv, tli = self._first_tl(last)
         return (scatter_slot(cache, view, slot), first, first_lp,
-                plp_within, boundary_lp)
+                plp_within, boundary_lp, tlv, tli)
 
     def _finish_check(self, finished):
         for i, req in enumerate(self._slots):
@@ -973,12 +1024,18 @@ class BatchingEngine:
             if nstop is not None:
                 req.out = req.out[:-nstop]
                 req.lps = req.lps[:len(req.out)]
+                if req.tlp is not None:
+                    req.tlp = req.tlp[:len(req.out)]
             if nstop is not None or (
                 self.eos_id is not None and last == self.eos_id
             ) or len(req.out) >= req.max_new:
                 finished.append((req.rid, req.out))
                 if self.logprobs:
                     self.finished_logprobs[req.rid] = req.lps[:len(req.out)]
+                if self.top_logprobs and req.tlp is not None:
+                    self.finished_top_logprobs[req.rid] = (
+                        req.tlp[:len(req.out)]
+                    )
                 if req.plp is not None:
                     self.finished_prompt_logprobs[req.rid] = req.plp
                 self.stats["requests_completed"] += 1
@@ -1032,7 +1089,7 @@ class BatchingEngine:
         ]
         if any(active_rows):
             self._pre_decode(active_rows)
-            per_slot, per_lps = self._decode_tokens(active_rows)
+            per_slot, per_lps, per_tl = self._decode_tokens(active_rows)
             for i, req in enumerate(self._slots):
                 if req is None or i in self._prefilling:
                     continue
@@ -1040,6 +1097,10 @@ class BatchingEngine:
                     req.out.append(int(tok))
                     if per_lps is not None:
                         req.lps.append(float(per_lps[i][j]))
+                    if per_tl is not None:
+                        if req.tlp is None:
+                            req.tlp = []
+                        req.tlp.append(per_tl[i][j])
                     last = req.out[-1]
                     if (self.eos_id is not None and last == self.eos_id) or (
                         len(req.out) >= req.max_new
@@ -1057,7 +1118,7 @@ class BatchingEngine:
         speculative engine."""
         if self._decode is None:
             self._decode = self._jit_cache_program(
-                self._decode_impl, 5,
+                self._decode_impl, 7,
                 static_argnames=("greedy_only", "use_bias", "use_pen",
                                  "use_seed", "use_con"),
             )
@@ -1079,7 +1140,7 @@ class BatchingEngine:
         # tree keeps its structure without holding a real table alive.
         ctrans = self._ctrans if use_con else self._dummy_ctrans
         (self._cache, toks, lps, self._smin, counts,
-         cstate) = self._decode(
+         cstate, tlvs, tlis) = self._decode(
             self.params, self._cache, self._cur, active, sub,
             (self._stemp, self._stopk, self._stopp, self._sminp,
              self._sbias if self._sbias is not None
@@ -1102,12 +1163,22 @@ class BatchingEngine:
             self._cstate = cstate
         self._cur = toks[-1]
         # (K, n_slots) each — the one host sync.
-        host_toks, host_lps = jax.device_get((toks, lps))
+        host_toks, host_lps, host_tlv, host_tli = jax.device_get(
+            (toks, lps, tlvs, tlis)
+        )
         per_slot = [host_toks[:, i].tolist() for i in range(self.n_slots)]
         if not self.logprobs:
-            return per_slot, None
-        return per_slot, [host_lps[:, i].tolist()
-                          for i in range(self.n_slots)]
+            return per_slot, None, None
+        per_lps = [host_lps[:, i].tolist() for i in range(self.n_slots)]
+        if not self.top_logprobs:
+            return per_slot, per_lps, None
+        # (ticks, n_slots, K) -> per slot, per tick: (ids, lps).
+        per_tl = [
+            [(host_tli[j, i].tolist(), host_tlv[j, i].tolist())
+             for j in range(host_tli.shape[0])]
+            for i in range(self.n_slots)
+        ]
+        return per_slot, per_lps, per_tl
 
     def _pre_decode(self, active_rows) -> None:
         """Hook before each decode tick (paged: grow block tables)."""
@@ -1123,6 +1194,7 @@ class BatchingEngine:
                 self._release_slot(i)
                 self.finished_logprobs.pop(rid, None)
                 self.finished_prompt_logprobs.pop(rid, None)
+                self.finished_top_logprobs.pop(rid, None)
                 self.stats["requests_cancelled"] += 1
                 return True
         for req in list(self._queue):
@@ -1342,7 +1414,8 @@ class PagedBatchingEngine(BatchingEngine):
         self.stats["prefix_hit_tokens"] += m * self.block_size
         self.stats["prefix_query_tokens"] += req.tokens.size
 
-    def _finish_prefill(self, slot: int, req, first, lp=None) -> None:
+    def _finish_prefill(self, slot: int, req, first, lp=None,
+                        tl=None) -> None:
         # The prompt blocks now hold real KV: make them matchable.
         for j, h in self._pending_reg.pop(slot, ()):
             if h in self._hash_to_block:
@@ -1350,7 +1423,7 @@ class PagedBatchingEngine(BatchingEngine):
             blk = self._slot_blocks[slot][j]
             self._hash_to_block[h] = blk
             self._block_ref[blk] = 1
-        super()._finish_prefill(slot, req, first, lp)
+        super()._finish_prefill(slot, req, first, lp, tl)
 
     def _release_slot(self, slot: int) -> None:
         super()._release_slot(slot)  # clears the slot's logit bias
@@ -1416,7 +1489,7 @@ class PagedBatchingEngine(BatchingEngine):
             self._prefix_prefill_jit[jkey] = self._jit_cache_program(
                 functools.partial(
                     self._prefix_prefill_impl, want_plp=want_plp
-                ), 4,
+                ), 6,
             )
         if boundary_next is None:
             boundary_next = jnp.zeros((), jnp.int32)
@@ -1444,13 +1517,13 @@ class PagedBatchingEngine(BatchingEngine):
         self._key, sub = jax.random.split(self._key)
         # One dispatch path: the chunk-continuation program IS the
         # suffix prefill (a suffix is a chunk past `p` resident tokens).
-        cache, first, lp, _, _ = self._chunk_prefill(
+        cache, first, lp, _, _, tlv, tli = self._chunk_prefill(
             pad, False, jnp.asarray(padded),
             jnp.asarray([s], jnp.int32), jnp.asarray([p], jnp.int32),
             slot, sub, self._slot_samp(slot, req),
         )
         self._cache = cache
-        return first, lp
+        return first, lp, ((tlv, tli) if self.top_logprobs else None)
 
     def _prefix_prefill_impl(
         self, params, cache, tokens, suffix_len, prefix_len, slot, key,
@@ -1507,7 +1580,9 @@ class PagedBatchingEngine(BatchingEngine):
         if self.kv_quant == "int8":
             fields.update(ks=view.ks, vs=view.vs)
         cache = cache.replace(**fields)
-        return cache, first, first_lp, plp_within, boundary_lp
+        tlv, tli = self._first_tl(last)
+        return (cache, first, first_lp, plp_within, boundary_lp,
+                tlv, tli)
 
     def _prefill_impl(self, params, cache, tokens, prompt_len, slot, key,
                       samp, want_plp: bool = False):
@@ -1556,7 +1631,8 @@ class PagedBatchingEngine(BatchingEngine):
         cache = cache.replace(**fields)
         plp = (self._plp_within(logits, tokens) if want_plp
                else jnp.zeros((tokens.shape[1],), jnp.float32))
-        return cache, first, first_lp, plp
+        tlv, tli = self._first_tl(last)
+        return cache, first, first_lp, plp, tlv, tli
 
 
 class _PoolExhausted(Exception):

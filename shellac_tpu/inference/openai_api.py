@@ -114,15 +114,18 @@ def completion_to_native(payload: dict, tokenizer) -> dict:
             native["prompt_logprobs"] = True
     lp = payload.get("logprobs")
     if lp is not None and lp is not False:
-        # OpenAI's int-valued logprobs asks for top-k alternatives; the
-        # engine records the CHOSEN token's logprob. 0/1/true map onto
-        # that; deeper k is refused.
+        # OpenAI's int-valued logprobs asks for top-k alternatives per
+        # position; the engine records them when built with
+        # --top-logprobs (the server validates k against that cap).
         if lp in (True, 0, 1):
             native["logprobs"] = True
+        elif isinstance(lp, int) and 2 <= lp <= 5:
+            native["logprobs"] = True
+            native["top_logprobs"] = lp
         else:
             _bad(
-                f"logprobs={lp!r}: only the chosen token's logprob is "
-                "recorded (use logprobs <= 1)"
+                f"logprobs={lp!r}: use true/0..5 (k alternatives need "
+                "a server built with --top-logprobs >= k)"
             )
     _common_sampling(payload, native)
     return native
@@ -184,11 +187,11 @@ def chat_to_native(payload: dict, tokenizer) -> dict:
     }
     if payload.get("logprobs"):
         native["logprobs"] = True
-    if payload.get("top_logprobs") not in (None, 0):
-        _bad(
-            f"top_logprobs={payload['top_logprobs']!r}: only the chosen "
-            "token's logprob is recorded"
-        )
+    tl = payload.get("top_logprobs")
+    if tl not in (None, 0):
+        if not payload.get("logprobs"):
+            _bad("top_logprobs needs logprobs=true")
+        native["top_logprobs"] = int(tl)
     if payload.get("echo"):
         _bad("echo is a completions-API parameter")
     if payload.get("best_of") is not None:
@@ -210,12 +213,31 @@ def _usage(prompt_tokens: int, completions: List[list]) -> dict:
     }
 
 
-def _lp_block(tokens, lps, tokenizer):
+def _lp_block(tokens, lps, tokenizer, tlp=None):
+    def tok(t):
+        return tokenizer.decode([t]) if tokenizer else str(t)
+
+    top = None
+    if tlp is not None:
+        # Per position: {token_str: logprob} over the k alternatives
+        # (the classic completions-API shape). Distinct ids can decode
+        # to the same string (untrained specials, byte fragments) — a
+        # plain dict comprehension would silently drop entries, so
+        # collide onto an id-tagged key instead.
+        def entry_dict(entries):
+            d = {}
+            for e in entries:
+                key = tok(e["id"])
+                if not key or key in d:
+                    key = f"{key}<id:{e['id']}>"
+                d[key] = e["logprob"]
+            return d
+
+        top = [entry_dict(entries) for entries in tlp]
     return {
-        "tokens": [tokenizer.decode([t]) if tokenizer else str(t)
-                   for t in tokens],
+        "tokens": [tok(t) for t in tokens],
         "token_logprobs": list(lps),
-        "top_logprobs": None,
+        "top_logprobs": top,
         "text_offset": None,
     }
 
@@ -250,7 +272,8 @@ def completion_response(
         else:
             entry["text"] = (prompt_text + text) if echo else text
         if c.get("logprobs") is not None:
-            lp = _lp_block(toks, c["logprobs"], tokenizer)
+            tlp = c.get("top_logprobs")
+            lp = _lp_block(toks, c["logprobs"], tokenizer, tlp=tlp)
             if echo and native_result.get("prompt_logprobs") is not None:
                 plp = native_result["prompt_logprobs"]
                 pl = _lp_block(prompt_ids or [], plp, tokenizer)
@@ -258,15 +281,28 @@ def completion_response(
                     "tokens": pl["tokens"] + lp["tokens"],
                     "token_logprobs": (pl["token_logprobs"]
                                        + lp["token_logprobs"]),
-                    "top_logprobs": None,
+                    "top_logprobs": ([None] * len(pl["tokens"])
+                                     + lp["top_logprobs"]
+                                     if lp["top_logprobs"] else None),
                     "text_offset": None,
                 }
-            entry["logprobs"] = (
-                {"content": [
-                    {"token": t, "logprob": l}
-                    for t, l in zip(lp["tokens"], lp["token_logprobs"])
-                ]} if chat else lp
-            )
+            if chat:
+                content = []
+                for j, (t, l) in enumerate(
+                    zip(lp["tokens"], lp["token_logprobs"])
+                ):
+                    item = {"token": t, "logprob": l}
+                    if tlp is not None:
+                        item["top_logprobs"] = [
+                            {"token": (tokenizer.decode([e["id"]])
+                                       if tokenizer else str(e["id"])),
+                             "logprob": e["logprob"]}
+                            for e in tlp[j]
+                        ]
+                    content.append(item)
+                entry["logprobs"] = {"content": content}
+            else:
+                entry["logprobs"] = lp
         choices.append(entry)
     return {
         "id": ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24],
@@ -337,15 +373,27 @@ class StreamTranslator:
             if record.get("logprobs") is not None:
                 # Requested logprobs ride the finish chunk (the engine
                 # delivers them once, on the final record).
+                tlp = record.get("top_logprobs")
                 lp = _lp_block(self._tokens, record["logprobs"],
-                               self.tokenizer)
-                finish["choices"][0]["logprobs"] = (
-                    {"content": [
-                        {"token": t, "logprob": l}
-                        for t, l in zip(lp["tokens"],
-                                        lp["token_logprobs"])
-                    ]} if self.chat else lp
-                )
+                               self.tokenizer, tlp=tlp)
+                if self.chat:
+                    content = []
+                    for j, (t, l) in enumerate(
+                        zip(lp["tokens"], lp["token_logprobs"])
+                    ):
+                        item = {"token": t, "logprob": l}
+                        if tlp is not None:
+                            item["top_logprobs"] = [
+                                {"token": (self.tokenizer.decode([e["id"]])
+                                           if self.tokenizer
+                                           else str(e["id"])),
+                                 "logprob": e["logprob"]}
+                                for e in tlp[j]
+                            ]
+                        content.append(item)
+                    finish["choices"][0]["logprobs"] = {"content": content}
+                else:
+                    finish["choices"][0]["logprobs"] = lp
             out.append(finish)
             return out
         self._tokens.extend(record["tokens"])

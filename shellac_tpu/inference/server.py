@@ -59,7 +59,7 @@ def _render_plp(plp):
 
 class _Pending:
     __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback",
-                 "lps", "plp", "rid")
+                 "lps", "plp", "tlp", "rid")
 
     def __init__(self, rid, stream: bool = False, holdback: int = 0):
         self.rid = rid
@@ -78,6 +78,7 @@ class _Pending:
         # logprobs=True deposit them at completion).
         self.lps = None
         self.plp = None  # prompt per-token logprobs (prompt_logprobs)
+        self.tlp = None  # per-token top-K alternatives ((ids, lps) pairs)
 
     def finish(self):
         if self.chunks is not None:
@@ -234,18 +235,23 @@ class InferenceServer:
                 plp_store = getattr(
                     self.engine, "finished_prompt_logprobs", {}
                 )
+                tl_store = getattr(
+                    self.engine, "finished_top_logprobs", {}
+                )
                 for rid, out in finished:
                     p = self._pending.pop(rid, None)
                     if p is not None:
                         p.result = out
                         p.lps = lp_store.pop(rid, None)
                         p.plp = plp_store.pop(rid, None)
+                        p.tlp = tl_store.pop(rid, None)
                         if p.chunks is not None and len(out) > p.emitted:
                             p.chunks.put(list(out[p.emitted:]))
                         p.finish()
                     else:
                         lp_store.pop(rid, None)
                         plp_store.pop(rid, None)
+                        tl_store.pop(rid, None)
                 if self._heartbeat and not drained and not self.engine.pending:
                     # Idle heartbeat tick: pace the broadcast instead of
                     # spinning the interconnect at full rate.
@@ -316,7 +322,7 @@ class InferenceServer:
             self._cancel(p)
             raise
         if return_logprobs:
-            return p.result, p.lps, p.plp
+            return p.result, p.lps, p.plp, p.tlp
         return p.result
 
     def generate_stream(self, tokens, max_new: int,
@@ -340,7 +346,7 @@ class InferenceServer:
                 self._raise(p)
             finished = True
             yield ("done",
-                   (p.result, p.lps, p.plp) if return_logprobs
+                   (p.result, p.lps, p.plp, p.tlp) if return_logprobs
                    else p.result)
         finally:
             if not finished:
@@ -463,16 +469,46 @@ class InferenceServer:
             )
         return want
 
+    def _check_top_logprobs(self, payload, want_lps: bool) -> int:
+        """Per-request k of alternatives to RENDER (0 = none). The
+        engine records its configured max for every request; k only
+        slices."""
+        k = payload.get("top_logprobs")
+        if k in (None, 0, False):
+            return 0
+        k = int(k)
+        cap = getattr(self.engine, "top_logprobs", 0)
+        if k < 1 or k > cap:
+            raise ValueError(
+                f"top_logprobs={k}: this server records "
+                f"{cap or 'no'} alternatives (serve --top-logprobs N)"
+            )
+        if not want_lps:
+            raise ValueError("top_logprobs needs logprobs=true")
+        return k
+
+    @staticmethod
+    def _render_tlp(tlp, k):
+        """[(ids, lps)] per token -> [[{'id', 'logprob'}] * k]."""
+        return [
+            [{"id": int(i), "logprob": float(v)}
+             for i, v in zip(ids[:k], vals[:k])]
+            for ids, vals in tlp
+        ]
+
     def handle(self, payload: dict) -> dict:
         tokens, max_new, stop, samp = self._parse(payload)
         want_lps = self._check_logprobs(payload)
+        tlk = self._check_top_logprobs(payload, want_lps)
         n, best_of = self._parse_n(payload, samp)
         if n == 1 and best_of == 1:
-            out, lps, plp = self.generate(
+            out, lps, plp, tlp = self.generate(
                 tokens, max_new, timeout=payload.get("timeout"), stop=stop,
                 return_logprobs=True, **samp,
             )
-            return self._format_completion(out, lps, want_lps, plp=plp)
+            return self._format_completion(
+                out, lps, want_lps, plp=plp, tlp=tlp, tlk=tlk,
+            )
         # Parallel sampling: best_of independent completions share the
         # slot batch (and, on a paged+prefix engine, their prompt KV);
         # the n best by mean token logprob come back as "choices". The
@@ -493,7 +529,7 @@ class InferenceServer:
         try:
             for p in pendings:
                 self._await(p, deadline)
-                choices.append((p.result, p.lps))
+                choices.append((p.result, p.lps, p.tlp))
                 if p.plp is not None:
                     plp = p.plp
         except (TimeoutError, ValueError, RuntimeError):
@@ -512,18 +548,20 @@ class InferenceServer:
 
             choices.sort(key=score, reverse=True)
         result: Dict[str, Any] = {"choices": [
-            self._format_completion(out, lps, want_lps)
-            for out, lps in choices[:n]
+            self._format_completion(out, lps, want_lps, tlp=tlp, tlk=tlk)
+            for out, lps, tlp in choices[:n]
         ]}
         if plp is not None:
             result["prompt_logprobs"] = _render_plp(plp)
         return result
 
     def _format_completion(self, out, lps, want_lps,
-                           plp=None) -> Dict[str, Any]:
+                           plp=None, tlp=None, tlk=0) -> Dict[str, Any]:
         result: Dict[str, Any] = {"tokens": out}
         if want_lps:
             result["logprobs"] = lps
+        if tlk and tlp is not None:
+            result["top_logprobs"] = self._render_tlp(tlp, tlk)
         if plp is not None:
             result["prompt_logprobs"] = _render_plp(plp)
         if self.tokenizer is not None:
@@ -572,6 +610,7 @@ class InferenceServer:
         HTTP 400)."""
         tokens, max_new, stop, samp = self._parse(payload)
         want_lps = self._check_logprobs(payload)
+        tlk = self._check_top_logprobs(payload, want_lps)
         n, best_of = self._parse_n(payload, samp)
         if n != 1 or best_of != 1:
             raise ValueError("streaming does not support n/best_of > 1")
@@ -583,10 +622,12 @@ class InferenceServer:
             if kind == "delta":
                 yield {"tokens": val}
             else:
-                out, lps, plp = val
+                out, lps, plp, tlp = val
                 final: Dict[str, Any] = {"done": True, "tokens": out}
                 if want_lps:
                     final["logprobs"] = lps
+                if tlk and tlp is not None:
+                    final["top_logprobs"] = self._render_tlp(tlp, tlk)
                 if plp is not None:
                     final["prompt_logprobs"] = _render_plp(plp)
                 if self.tokenizer is not None:

@@ -86,6 +86,12 @@ class SpeculativeBatchingEngine(BatchingEngine):
                 "re-read fresh positions where int8 rounding would break "
                 "the acceptance identity)"
             )
+        if kw.get("top_logprobs"):
+            raise ValueError(
+                "top_logprobs is not wired for the speculative engine "
+                "(the verify round emits a variable number of tokens "
+                "per sync; use a non-draft engine for alternatives)"
+            )
         super().__init__(cfg, params, **kw)
         if kw.get("mesh") is not None:
             tp = kw["mesh"].shape.get("tp", 1)
@@ -329,6 +335,6 @@ class SpeculativeBatchingEngine(BatchingEngine):
         self.stats["spec_accepted"] += int(np.maximum(cnt - 1, 0).sum())
         per_slot = [em[i, :cnt[i]].tolist() for i in range(self.n_slots)]
         if not self.logprobs:
-            return per_slot, None
+            return per_slot, None, None
         return per_slot, [host_lps[i, :cnt[i]].tolist()
-                          for i in range(self.n_slots)]
+                          for i in range(self.n_slots)], None

@@ -320,6 +320,168 @@ class TestPromptLogprobs:
         with pytest.raises(ValueError, match="prefix cache"):
             eng.submit("r", [1, 2, 3], 4, prompt_logprobs=True)
 
+
+class TestTopLogprobs:
+    def _engine(self, **kw):
+        from shellac_tpu.inference.batching import BatchingEngine
+
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        return cfg, params, BatchingEngine(
+            cfg, params, n_slots=2, max_len=64, temperature=0.0,
+            logprobs=True, top_logprobs=3, **kw,
+        )
+
+    def test_topk_covers_every_token(self):
+        """One (ids, lps) entry per emitted token — including the
+        prefill-sampled first one and through a multi-tick window —
+        with greedy's choice as the top-1 alternative at its exact
+        logprob."""
+        cfg, params, eng = self._engine(decode_ticks=2)
+        eng.submit("r", [5, 9, 2], 5)
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        tl = eng.finished_top_logprobs.pop("r")
+        lps = eng.finished_logprobs.pop("r")
+        assert len(tl) == len(done["r"]) == 5
+        for (ids, vals), tok, lp in zip(tl, done["r"], lps):
+            assert len(ids) == 3 and vals == sorted(vals, reverse=True)
+            assert ids[0] == tok and abs(vals[0] - lp) < 1e-5
+
+    def test_chunked_prefill_first_token(self):
+        cfg, params, eng = self._engine(prefill_chunk=8)
+        prompt = list(np.random.RandomState(2).randint(0, 256, 20))
+        eng.submit("r", prompt, 3)
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        tl = eng.finished_top_logprobs.pop("r")
+        assert len(tl) == len(done["r"])
+        assert tl[0][0][0] == done["r"][0]  # top-1 == greedy first token
+
+    def test_stop_truncation_lockstep(self):
+        cfg, params, eng = self._engine()
+        ref = eng.run([("probe", [4, 4, 4], 8)])["probe"]
+        eng.finished_top_logprobs.clear()
+        eng.submit("r", [4, 4, 4], 8, stop=[ref[2:4]])
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        assert done["r"] == ref[:2]
+        assert len(eng.finished_top_logprobs.pop("r")) == 2
+
+    def test_guards(self):
+        from shellac_tpu.inference.batching import BatchingEngine
+        from shellac_tpu.inference.spec_batching import (
+            SpeculativeBatchingEngine,
+        )
+
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="logprobs=True"):
+            BatchingEngine(cfg, params, top_logprobs=3)
+        with pytest.raises(ValueError, match="top_logprobs"):
+            BatchingEngine(cfg, params, logprobs=True, top_logprobs=64)
+        with pytest.raises(ValueError, match="speculative"):
+            SpeculativeBatchingEngine(cfg, params, cfg, params,
+                                      logprobs=True, top_logprobs=2)
+
+    def test_http_and_openai(self):
+        import json as _json
+        import threading
+        import urllib.error
+        import urllib.request
+
+        from shellac_tpu.inference.server import (
+            InferenceServer,
+            make_http_server,
+        )
+        from shellac_tpu.training.tokenizer import ByteTokenizer
+
+        cfg, params, eng = self._engine()
+        srv = InferenceServer(cfg, params, tokenizer=ByteTokenizer(),
+                              engine=eng)
+        httpd = make_http_server(srv)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+        def post(path, payload, code=None):
+            req = urllib.request.Request(
+                base + path, _json.dumps(payload).encode(),
+                {"Content-Type": "application/json"},
+            )
+            if code is None:
+                return _json.loads(
+                    urllib.request.urlopen(req, timeout=300).read()
+                )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=300)
+            assert e.value.code == code
+            return _json.loads(e.value.read())
+
+        # Native: k slices the engine's recorded 3 down to 2.
+        r = post("/generate", {"tokens": [3, 7], "max_new": 4,
+                               "logprobs": True, "top_logprobs": 2})
+        assert len(r["top_logprobs"]) == len(r["tokens"])
+        for per_tok, tok in zip(r["top_logprobs"], r["tokens"]):
+            assert len(per_tok) == 2
+            assert per_tok[0]["id"] == tok  # greedy = top-1
+        # k beyond the engine cap is a 400, not silent truncation.
+        post("/generate", {"tokens": [3], "max_new": 2,
+                           "logprobs": True, "top_logprobs": 9}, code=400)
+        post("/generate", {"tokens": [3], "max_new": 2,
+                           "top_logprobs": 2}, code=400)  # needs logprobs
+        # OpenAI completions: int logprobs=3 -> per-position dicts.
+        r = post("/v1/completions", {"prompt": [3, 7], "max_tokens": 3,
+                                     "temperature": 0, "logprobs": 3})
+        lp = r["choices"][0]["logprobs"]
+        assert len(lp["top_logprobs"]) == 3
+        assert all(len(d) >= 1 for d in lp["top_logprobs"])
+        # OpenAI chat: logprobs + top_logprobs -> content alternatives.
+        r = post("/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 3, "temperature": 0,
+            "logprobs": True, "top_logprobs": 2,
+        })
+        content = r["choices"][0]["logprobs"]["content"]
+        assert all(len(c["top_logprobs"]) == 2 for c in content)
+        # Native ndjson streaming: alternatives ride the final record.
+        req = urllib.request.Request(
+            base + "/generate",
+            _json.dumps({"tokens": [3, 7], "max_new": 3, "stream": True,
+                         "logprobs": True, "top_logprobs": 2}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            records = [_json.loads(x) for x in resp.read().splitlines()]
+        final = records[-1]
+        assert final.get("done") and len(final["top_logprobs"]) == 3
+        # OpenAI SSE chat streaming: the finish chunk carries them too
+        # (the silent-drop regression).
+        req = urllib.request.Request(
+            base + "/v1/chat/completions",
+            _json.dumps({
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 3, "temperature": 0, "stream": True,
+                "logprobs": True, "top_logprobs": 2,
+            }).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            datas = [
+                _json.loads(line[len(b"data: "):])
+                for line in resp.read().splitlines()
+                if line.startswith(b"data: ") and line != b"data: [DONE]"
+            ]
+        with_lp = [d for d in datas
+                   if d["choices"][0].get("logprobs") is not None]
+        assert with_lp, datas
+        content = with_lp[-1]["choices"][0]["logprobs"]["content"]
+        assert all(len(c["top_logprobs"]) == 2 for c in content)
+        httpd.shutdown()
+        srv.close()
+
     def test_openai_echo_logprobs(self):
         """completions echo=true + logprobs: text = prompt + completion,
         logprobs cover prompt tokens (first null) then completion."""
