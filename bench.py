@@ -154,7 +154,11 @@ def main(argv=None):
         # bench on the real chip during a relay window — if so, replay
         # that line (clearly labeled) rather than measuring the wrong
         # hardware.
-        cached = None if args_nonheadline(args) else latest_queue_tpu_line()
+        # --no-recipe must never replay either: the replay filter keys
+        # on the ADOPTED recipe's config, which is exactly what a
+        # plain-baseline run is asked not to measure.
+        cached = (None if args_nonheadline(args) or args.no_recipe
+                  else latest_queue_tpu_line())
         if cached is not None:
             cached["note"] = (
                 "relay wedged at bench time; value is this round's "

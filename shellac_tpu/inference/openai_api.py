@@ -117,9 +117,11 @@ def completion_to_native(payload: dict, tokenizer) -> dict:
         # OpenAI's int-valued logprobs asks for top-k alternatives per
         # position; the engine records them when built with
         # --top-logprobs (the server validates k against that cap).
-        if lp in (True, 0, 1):
+        if lp in (True, 0):
             native["logprobs"] = True
-        elif isinstance(lp, int) and 2 <= lp <= 5:
+        elif isinstance(lp, int) and 1 <= lp <= 5:
+            # OpenAI semantics: integer N = the N most-likely tokens
+            # per position, N=1 included.
             native["logprobs"] = True
             native["top_logprobs"] = lp
         else:
@@ -242,6 +244,25 @@ def _lp_block(tokens, lps, tokenizer, tlp=None):
     }
 
 
+def _chat_content(tokens, lps, tlp, tokenizer):
+    """OpenAI chat logprobs content list: {token, logprob[,
+    top_logprobs]} per position — the ONE builder both the blocking
+    response and the SSE finish chunk use."""
+    def tok(t):
+        return tokenizer.decode([t]) if tokenizer else str(t)
+
+    content = []
+    for j, (t, l) in enumerate(zip(tokens, lps)):
+        item = {"token": t, "logprob": l}
+        if tlp is not None:
+            item["top_logprobs"] = [
+                {"token": tok(e["id"]), "logprob": e["logprob"]}
+                for e in tlp[j]
+            ]
+        content.append(item)
+    return content
+
+
 def completion_response(
     native_result: dict, *, model: str, prompt_tokens: int, max_new: int,
     tokenizer, chat: bool, echo: bool = False, prompt_ids=None,
@@ -287,20 +308,9 @@ def completion_response(
                     "text_offset": None,
                 }
             if chat:
-                content = []
-                for j, (t, l) in enumerate(
-                    zip(lp["tokens"], lp["token_logprobs"])
-                ):
-                    item = {"token": t, "logprob": l}
-                    if tlp is not None:
-                        item["top_logprobs"] = [
-                            {"token": (tokenizer.decode([e["id"]])
-                                       if tokenizer else str(e["id"])),
-                             "logprob": e["logprob"]}
-                            for e in tlp[j]
-                        ]
-                    content.append(item)
-                entry["logprobs"] = {"content": content}
+                entry["logprobs"] = {"content": _chat_content(
+                    lp["tokens"], lp["token_logprobs"], tlp, tokenizer
+                )}
             else:
                 entry["logprobs"] = lp
         choices.append(entry)
@@ -377,21 +387,12 @@ class StreamTranslator:
                 lp = _lp_block(self._tokens, record["logprobs"],
                                self.tokenizer, tlp=tlp)
                 if self.chat:
-                    content = []
-                    for j, (t, l) in enumerate(
-                        zip(lp["tokens"], lp["token_logprobs"])
-                    ):
-                        item = {"token": t, "logprob": l}
-                        if tlp is not None:
-                            item["top_logprobs"] = [
-                                {"token": (self.tokenizer.decode([e["id"]])
-                                           if self.tokenizer
-                                           else str(e["id"])),
-                                 "logprob": e["logprob"]}
-                                for e in tlp[j]
-                            ]
-                        content.append(item)
-                    finish["choices"][0]["logprobs"] = {"content": content}
+                    finish["choices"][0]["logprobs"] = {
+                        "content": _chat_content(
+                            lp["tokens"], lp["token_logprobs"], tlp,
+                            self.tokenizer,
+                        )
+                    }
                 else:
                     finish["choices"][0]["logprobs"] = lp
             out.append(finish)

@@ -478,6 +478,13 @@ class InferenceServer:
             return 0
         k = int(k)
         cap = getattr(self.engine, "top_logprobs", 0)
+        if k == 1 and cap == 0:
+            # OpenAI's completions `logprobs: 1` predates alternative
+            # recording here; on a server without --top-logprobs it
+            # keeps its long-standing meaning (the chosen token's
+            # logprob, no alternatives block) instead of breaking
+            # existing clients. k >= 2 stays a loud 400 below.
+            return 0
         if k < 1 or k > cap:
             raise ValueError(
                 f"top_logprobs={k}: this server records "

@@ -270,6 +270,45 @@ class TestConstrainedEngine:
             spec.submit("bad4", [1], 4, constraint=dfa)
 
 
+class TestBPETokenizerConstraints:
+    def test_compiles_over_trained_bpe(self, tmp_path, model):
+        """The token-DFA lift works over multi-character BPE tokens,
+        not just single bytes: conformance holds when tokens span
+        several pattern characters."""
+        corpus = tmp_path / "c.txt"
+        corpus.write_text(
+            "red green blue red green 123 456 red blue 789\n" * 50
+        )
+        from shellac_tpu.training.tokenizer import BPETokenizer
+
+        tok = BPETokenizer.train(
+            [str(corpus)], 300, str(tmp_path / "bpe.json")
+        )
+        pattern = r"(red|green|blue)"
+        dfa = compile_token_dfa(pattern, tok, tok.vocab_size,
+                                eos_id=tok.eos_id)
+        # Multi-char tokens must appear as legal moves somewhere (the
+        # trained vocab merges these words), or the lift degenerated to
+        # bytes only.
+        legal = set()
+        for row in dfa.trans:
+            for tid in np.nonzero(row[:-1] >= 0)[0]:
+                legal.add(tok.decode([int(tid)]))
+        assert any(len(s) > 1 for s in legal), legal
+        # Walk: any maximal-logprob-free greedy path conforms.
+        st, out = 0, []
+        for _ in range(10):
+            row = dfa.trans[st]
+            allowed = np.nonzero(row >= 0)[0]
+            tid = int(allowed[-1])
+            if tid == tok.vocab_size:
+                break
+            out.append(tid)
+            st = int(row[tid])
+        s = tok.decode(out)
+        assert _matcher(pattern)(s), s
+
+
 class TestServerAPI:
     @pytest.fixture(scope="class")
     def http_srv(self, model):

@@ -108,6 +108,24 @@ class TestEpTraining:
         ep = _losses(cfg, tcfg, batch, mesh)
         np.testing.assert_allclose(ref, ep, rtol=1e-4)
 
+    def test_ep_serving_bit_parity(self, mesh_ep8):
+        """MoE decode on an ep mesh: greedy bit-identical to the
+        unsharded engine (decode runs dropless, so expert sharding must
+        not change which experts compute or what they return)."""
+        from shellac_tpu.inference.batching import BatchingEngine
+        from shellac_tpu.inference.engine import shard_params
+        from shellac_tpu.models import transformer
+
+        cfg = get_model_config("tiny-moe").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        reqs = [(i, [3 + i, 9, 2, 31], 6) for i in range(3)]
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0).run(reqs)
+        sharded = shard_params(cfg, params, mesh_ep8)
+        got = BatchingEngine(cfg, sharded, n_slots=2, max_len=64,
+                             temperature=0.0, mesh=mesh_ep8).run(reqs)
+        assert got == want
+
     def test_indivisible_experts_raise(self):
         mesh = make_mesh(ParallelConfig(ep=8))
         cfg = get_model_config("tiny-moe")  # 4 experts, 8 ep shards
