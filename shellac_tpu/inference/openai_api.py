@@ -117,13 +117,20 @@ def completion_to_native(payload: dict, tokenizer) -> dict:
         # OpenAI's int-valued logprobs asks for top-k alternatives per
         # position; the engine records them when built with
         # --top-logprobs (the server validates k against that cap).
-        if lp in (True, 0):
+        # NOTE True == 1 in Python: test booleans FIRST or integer 1
+        # would never reach the alternatives branch.
+        if lp is True or (not isinstance(lp, bool) and lp == 0):
             native["logprobs"] = True
-        elif isinstance(lp, int) and 1 <= lp <= 5:
+        elif (not isinstance(lp, bool) and isinstance(lp, int)
+              and 1 <= lp <= 5):
             # OpenAI semantics: integer N = the N most-likely tokens
-            # per position, N=1 included.
+            # per position, N=1 included. "soft": a server that
+            # records no alternatives serves N=1 in the pre-top_k
+            # sense (chosen token only) instead of 400ing a request
+            # shape that always worked.
             native["logprobs"] = True
             native["top_logprobs"] = lp
+            native["top_logprobs_soft"] = True
         else:
             _bad(
                 f"logprobs={lp!r}: use true/0..5 (k alternatives need "

@@ -478,12 +478,14 @@ class InferenceServer:
             return 0
         k = int(k)
         cap = getattr(self.engine, "top_logprobs", 0)
-        if k == 1 and cap == 0:
+        if k == 1 and cap == 0 and payload.get("top_logprobs_soft"):
             # OpenAI's completions `logprobs: 1` predates alternative
-            # recording here; on a server without --top-logprobs it
-            # keeps its long-standing meaning (the chosen token's
-            # logprob, no alternatives block) instead of breaking
-            # existing clients. k >= 2 stays a loud 400 below.
+            # recording here; the completions translator marks it soft
+            # so servers without --top-logprobs keep its long-standing
+            # meaning (chosen token's logprob, no alternatives block).
+            # Explicit chat/native `top_logprobs: 1` stays a loud 400
+            # below — a misconfigured server must not silently degrade
+            # a request that asked for alternatives by name.
             return 0
         if k < 1 or k > cap:
             raise ValueError(

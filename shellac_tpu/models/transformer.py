@@ -1439,13 +1439,6 @@ def forward_with_cache(
         )
 
     if quant:
-        if grouped_moe(cfg) or first_k_layout(cfg):
-            raise NotImplementedError(
-                "int8 KV cache with two-stack layouts (moe_every > 1 "
-                "or first_k_dense) is not wired yet; use a uniform "
-                "stack or a bf16 cache"
-            )
-
         if cfg.attn_pattern is not None:
             def body_one(x, lp, cs, kind):
                 ck, cv, cks, cvs = cs
@@ -1457,6 +1450,74 @@ def forward_with_cache(
             x, (new_k, new_v, new_ks, new_vs) = pattern_scan(
                 x, params["layers"],
                 (cache.k, cache.v, cache.ks, cache.vs), body_one,
+            )
+        elif first_k_layout(cfg):
+            # DeepSeek layout with the int8 cache: same dense-prefix /
+            # MoE-tail split as the bf16 branch below, with the scale
+            # stacks riding each scan.
+            kk = cfg.first_k_dense
+
+            def qstack_body(moe_flag):
+                def body(x, layer_in):
+                    lp, ck, cv, cks, cvs = layer_in
+                    x, nc, _ = run_block(
+                        x, lp, ck, cv, moe_flag, (cks, cvs)
+                    )
+                    return x, nc
+
+                return body
+
+            def qslice(lo, hi):
+                return (cache.k[lo:hi], cache.v[lo:hi],
+                        cache.ks[lo:hi], cache.vs[lo:hi])
+
+            x, nd = jax.lax.scan(
+                qstack_body(False), x,
+                (params["layers"]["dense"],) + qslice(None, kk),
+            )
+            x, nm = jax.lax.scan(
+                qstack_body(True), x,
+                (params["layers"]["moe"],) + qslice(kk, None),
+            )
+            new_k, new_v, new_ks, new_vs = (
+                jnp.concatenate([d, m], axis=0) for d, m in zip(nd, nm)
+            )
+        elif grouped_moe(cfg):
+            every = cfg.moe_every
+            ng = cfg.n_layers // every
+            grs = lambda a: a.reshape(  # noqa: E731
+                ng, every, *a.shape[1:]
+            )
+            gc = tuple(grs(a) for a in
+                       (cache.k, cache.v, cache.ks, cache.vs))
+
+            def qgroup_body(x, inp):
+                glp = inp[0]
+                cg = inp[1:]
+
+                def dense_body(x2, li):
+                    lp = li[0]
+                    x2, nc, _ = run_block(
+                        x2, lp, li[1], li[2], False, (li[3], li[4])
+                    )
+                    return x2, nc
+
+                x, nd = jax.lax.scan(
+                    dense_body, x,
+                    (glp["dense"],) + tuple(c[: every - 1] for c in cg),
+                )
+                x, nm, _ = run_block(
+                    x, glp["moe"], cg[0][every - 1], cg[1][every - 1],
+                    True, (cg[2][every - 1], cg[3][every - 1]),
+                )
+                return x, tuple(
+                    jnp.concatenate([d, m[None]], axis=0)
+                    for d, m in zip(nd, nm)
+                )
+
+            x, gn = jax.lax.scan(qgroup_body, x, (params["layers"],) + gc)
+            new_k, new_v, new_ks, new_vs = (
+                a.reshape(cfg.n_layers, *a.shape[2:]) for a in gn
             )
         else:
             def quant_body(x, layer_in):
