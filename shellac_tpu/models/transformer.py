@@ -1438,148 +1438,86 @@ def forward_with_cache(
             c.reshape(cfg.n_layers, *c.shape[2:]) for c in gnew
         )
 
-    if quant:
-        if cfg.attn_pattern is not None:
-            def body_one(x, lp, cs, kind):
-                ck, cv, cks, cvs = cs
-                x, nc, _ = run_block(
-                    x, lp, ck, cv, None, (cks, cvs), attn_kind=kind
-                )
-                return x, nc
+    # Cache leaves riding the layer scans: values only (bf16) or values
+    # + scale stacks (int8). ONE set of stack-dispatch bodies serves
+    # both, threading the scales to run_block when present — the same
+    # field-count parameterization the mixed branch uses. new_ks/new_vs
+    # exist only in quant mode (the final replace checks).
+    if mixed or quant_mixed:
+        cleaves = ()  # mixed caches carry kw/vw/kf/vf, not k/v
+    elif quant:
+        cleaves = (cache.k, cache.v, cache.ks, cache.vs)
+    else:
+        cleaves = (cache.k, cache.v)
 
-            x, (new_k, new_v, new_ks, new_vs) = pattern_scan(
-                x, params["layers"],
-                (cache.k, cache.v, cache.ks, cache.vs), body_one,
-            )
-        elif first_k_layout(cfg):
-            # DeepSeek layout with the int8 cache: same dense-prefix /
-            # MoE-tail split as the bf16 branch below, with the scale
-            # stacks riding each scan.
-            kk = cfg.first_k_dense
+    def _scales_of(vals):
+        return (vals[2], vals[3]) if quant else None
 
-            def qstack_body(moe_flag):
-                def body(x, layer_in):
-                    lp, ck, cv, cks, cvs = layer_in
-                    x, nc, _ = run_block(
-                        x, lp, ck, cv, moe_flag, (cks, cvs)
-                    )
-                    return x, nc
-
-                return body
-
-            def qslice(lo, hi):
-                return (cache.k[lo:hi], cache.v[lo:hi],
-                        cache.ks[lo:hi], cache.vs[lo:hi])
-
-            x, nd = jax.lax.scan(
-                qstack_body(False), x,
-                (params["layers"]["dense"],) + qslice(None, kk),
-            )
-            x, nm = jax.lax.scan(
-                qstack_body(True), x,
-                (params["layers"]["moe"],) + qslice(kk, None),
-            )
-            new_k, new_v, new_ks, new_vs = (
-                jnp.concatenate([d, m], axis=0) for d, m in zip(nd, nm)
-            )
-        elif grouped_moe(cfg):
-            every = cfg.moe_every
-            ng = cfg.n_layers // every
-            grs = lambda a: a.reshape(  # noqa: E731
-                ng, every, *a.shape[1:]
-            )
-            gc = tuple(grs(a) for a in
-                       (cache.k, cache.v, cache.ks, cache.vs))
-
-            def qgroup_body(x, inp):
-                glp = inp[0]
-                cg = inp[1:]
-
-                def dense_body(x2, li):
-                    lp = li[0]
-                    x2, nc, _ = run_block(
-                        x2, lp, li[1], li[2], False, (li[3], li[4])
-                    )
-                    return x2, nc
-
-                x, nd = jax.lax.scan(
-                    dense_body, x,
-                    (glp["dense"],) + tuple(c[: every - 1] for c in cg),
-                )
-                x, nm, _ = run_block(
-                    x, glp["moe"], cg[0][every - 1], cg[1][every - 1],
-                    True, (cg[2][every - 1], cg[3][every - 1]),
-                )
-                return x, tuple(
-                    jnp.concatenate([d, m[None]], axis=0)
-                    for d, m in zip(nd, nm)
-                )
-
-            x, gn = jax.lax.scan(qgroup_body, x, (params["layers"],) + gc)
-            new_k, new_v, new_ks, new_vs = (
-                a.reshape(cfg.n_layers, *a.shape[2:]) for a in gn
-            )
-        else:
-            def quant_body(x, layer_in):
-                lp, ck, cv, cks, cvs = layer_in
-                x, new_cache, _ = run_block(x, lp, ck, cv, None, (cks, cvs))
-                return x, new_cache
-
-            x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
-                quant_body, x,
-                (params["layers"], cache.k, cache.v, cache.ks, cache.vs),
-            )
-    elif first_k_layout(cfg):
+    if first_k_layout(cfg):
+        # DeepSeek layout: dense prefix stack, then the all-MoE tail.
         kk = cfg.first_k_dense
 
         def stack_body(moe_flag):
             def body(x, layer_in):
-                lp, ck, cv = layer_in
-                x, nc, _ = run_block(x, lp, ck, cv, moe_flag)
+                lp, vals = layer_in[0], layer_in[1:]
+                x, nc, _ = run_block(
+                    x, lp, vals[0], vals[1], moe_flag, _scales_of(vals)
+                )
                 return x, nc
 
             return body
 
-        x, (nk_d, nv_d) = jax.lax.scan(
+        x, nd = jax.lax.scan(
             stack_body(False), x,
-            (params["layers"]["dense"], cache.k[:kk], cache.v[:kk]),
+            (params["layers"]["dense"],) + tuple(a[:kk] for a in cleaves),
         )
-        x, (nk_m, nv_m) = jax.lax.scan(
+        x, nm = jax.lax.scan(
             stack_body(True), x,
-            (params["layers"]["moe"], cache.k[kk:], cache.v[kk:]),
+            (params["layers"]["moe"],) + tuple(a[kk:] for a in cleaves),
         )
-        new_k = jnp.concatenate([nk_d, nk_m], axis=0)
-        new_v = jnp.concatenate([nv_d, nv_m], axis=0)
+        news = tuple(
+            jnp.concatenate([d, m], axis=0) for d, m in zip(nd, nm)
+        )
+        if quant:
+            new_k, new_v, new_ks, new_vs = news
+        else:
+            new_k, new_v = news
     elif grouped_moe(cfg):
+        # Interleaved stacks: scan whole (dense^(every-1), moe) groups.
         every = cfg.moe_every
         ng = cfg.n_layers // every
-        ckr = cache.k.reshape(ng, every, *cache.k.shape[1:])
-        cvr = cache.v.reshape(ng, every, *cache.v.shape[1:])
+        gc = tuple(a.reshape(ng, every, *a.shape[1:]) for a in cleaves)
 
         def group_body(x, inp):
-            glp, ckg, cvg = inp
+            glp, cg = inp[0], inp[1:]
 
             def dense_body(x2, li):
-                lp, ck, cv = li
-                x2, nc, _ = run_block(x2, lp, ck, cv, False)
+                lp, vals = li[0], li[1:]
+                x2, nc, _ = run_block(
+                    x2, lp, vals[0], vals[1], False, _scales_of(vals)
+                )
                 return x2, nc
 
-            x, (nk_d, nv_d) = jax.lax.scan(
+            x, nd = jax.lax.scan(
                 dense_body, x,
-                (glp["dense"], ckg[: every - 1], cvg[: every - 1]),
+                (glp["dense"],) + tuple(c[: every - 1] for c in cg),
             )
-            x, (nk_m, nv_m), _ = run_block(
-                x, glp["moe"], ckg[every - 1], cvg[every - 1], True
+            moe_vals = tuple(c[every - 1] for c in cg)
+            x, nm, _ = run_block(
+                x, glp["moe"], moe_vals[0], moe_vals[1], True,
+                _scales_of(moe_vals),
             )
-            nk = jnp.concatenate([nk_d, nk_m[None]], axis=0)
-            nv = jnp.concatenate([nv_d, nv_m[None]], axis=0)
-            return x, (nk, nv)
+            return x, tuple(
+                jnp.concatenate([d, m[None]], axis=0)
+                for d, m in zip(nd, nm)
+            )
 
-        x, (nk, nv) = jax.lax.scan(
-            group_body, x, (params["layers"], ckr, cvr)
-        )
-        new_k = nk.reshape(cfg.n_layers, *cache.k.shape[1:])
-        new_v = nv.reshape(cfg.n_layers, *cache.v.shape[1:])
+        x, gn = jax.lax.scan(group_body, x, (params["layers"],) + gc)
+        news = tuple(a.reshape(cfg.n_layers, *a.shape[2:]) for a in gn)
+        if quant:
+            new_k, new_v, new_ks, new_vs = news
+        else:
+            new_k, new_v = news
     elif mixed or quant_mixed:
         # Mixed ring/dense stacks: the scan walks pattern periods with
         # per-kind cursors — "window" blocks consume ring rows (rolled
@@ -1643,22 +1581,31 @@ def forward_with_cache(
             new_kw, new_vw, new_kf, new_vf = news
     elif cfg.attn_pattern is not None:
         def body_one(x, lp, cs, kind):
-            ck, cv = cs
-            x, nc, _ = run_block(x, lp, ck, cv, None, attn_kind=kind)
+            x, nc, _ = run_block(
+                x, lp, cs[0], cs[1], None, _scales_of(cs), attn_kind=kind
+            )
             return x, nc
 
-        x, (new_k, new_v) = pattern_scan(
-            x, params["layers"], (cache.k, cache.v), body_one
-        )
+        x, news = pattern_scan(x, params["layers"], cleaves, body_one)
+        if quant:
+            new_k, new_v, new_ks, new_vs = news
+        else:
+            new_k, new_v = news
     else:
         def scan_body(x, layer_in):
-            lp, ck, cv = layer_in
-            x, new_cache, _ = run_block(x, lp, ck, cv, None)
+            lp, vals = layer_in[0], layer_in[1:]
+            x, new_cache, _ = run_block(
+                x, lp, vals[0], vals[1], None, _scales_of(vals)
+            )
             return x, new_cache
 
-        x, (new_k, new_v) = jax.lax.scan(
-            scan_body, x, (params["layers"], cache.k, cache.v)
+        x, news = jax.lax.scan(
+            scan_body, x, (params["layers"],) + cleaves
         )
+        if quant:
+            new_k, new_v, new_ks, new_vs = news
+        else:
+            new_k, new_v = news
 
     x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
     if cfg.tie_embeddings:

@@ -360,6 +360,35 @@ class TestServerAPI:
         v = json.loads(content)
         assert isinstance(v["ok"], bool)
 
+    def test_streaming_conforms(self, http_srv):
+        """ndjson streaming with a constraint: the assembled stream
+        equals the final record and conforms to the pattern."""
+        req = urllib.request.Request(
+            http_srv + "/generate",
+            json.dumps({"text": "go: ", "max_new": 12, "stream": True,
+                        "constraint": {"regex": "[a-z]{2,6}"}}).encode(),
+            {"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            records = [json.loads(x) for x in resp.read().splitlines()]
+        final = records[-1]
+        assert final.get("done")
+        streamed = [t for r in records[:-1] for t in r["tokens"]]
+        assert final["tokens"][:len(streamed)] == streamed
+        _conforms(final["tokens"], "[a-z]{2,6}")
+
+    def test_best_of_all_conform(self, http_srv):
+        """Parallel sampling fan-out: every sampled candidate is
+        independently constrained."""
+        r = self._post(http_srv, "/generate", {
+            "text": "word: ", "max_new": 12, "temperature": 1.2,
+            "n": 2, "best_of": 2, "seed": 5,
+            "constraint": {"regex": "(yes|no|maybe)"},
+        })
+        assert len(r["choices"]) == 2
+        for c in r["choices"]:
+            _conforms(c["tokens"], "(yes|no|maybe)")
+
     def test_bad_constraint_is_http_400(self, http_srv):
         import urllib.error
 
