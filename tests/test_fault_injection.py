@@ -37,24 +37,36 @@ def _tiny():
 
 
 class _WedgingEngine(BatchingEngine):
-    """Engine whose step() wedges forever after `good_steps` steps —
-    the observable behavior of a primary whose follower died
-    mid-collective."""
+    """Engine whose step() wedges after `good_steps` steps — the
+    observable behavior of a primary whose follower died mid-
+    collective. The wedge is an Event wait so the test can RELEASE the
+    scheduler thread at teardown: a thread left sleeping inside
+    step() for the rest of the pytest process has crashed later XLA
+    compiles (both full-suite segfaults pointed here)."""
 
     def __init__(self, *a, good_steps=0, **kw):
         super().__init__(*a, **kw)
         self._good = good_steps
         self.wedged = threading.Event()
+        self.release = threading.Event()
 
     def step(self):
         if self._good <= 0:
             self.wedged.set()
-            # Simulate the native hang: nothing interruptible about a
-            # real one either, but the test must be able to end — wait
-            # on an event nobody sets for far longer than the timeout.
-            time.sleep(3600)
+            self.release.wait(3600)
+            return []
         self._good -= 1
         return super().step()
+
+
+def _teardown(srv, eng, httpd=None):
+    """Release the wedged scheduler thread and JOIN it before the test
+    returns — no engine thread may outlive its test."""
+    if httpd is not None:
+        httpd.shutdown()
+    eng.release.set()
+    srv.close()  # sets the stop flag and joins the scheduler thread
+    assert not srv._thread.is_alive(), "scheduler thread leaked"
 
 
 class TestStepWatchdog:
@@ -64,15 +76,18 @@ class TestStepWatchdog:
         eng = _WedgingEngine(cfg, params, n_slots=2, max_len=64,
                              temperature=0.0, good_steps=0)
         srv = InferenceServer(cfg, params, engine=eng, step_timeout=2.0)
-        t0 = time.monotonic()
-        with pytest.raises(RuntimeError, match="step_timeout"):
-            srv.generate([1, 2, 3], max_new=4, timeout=60)
-        # Detection must come from the watchdog (well under the
-        # pessimistic request timeout), and the server must now refuse
-        # new work with the same loud error instead of hanging.
-        assert time.monotonic() - t0 < 30
-        with pytest.raises(RuntimeError, match="step_timeout"):
-            srv.generate([4, 5], max_new=4, timeout=60)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([1, 2, 3], max_new=4, timeout=60)
+            # Detection must come from the watchdog (well under the
+            # pessimistic request timeout), and the server must now
+            # refuse new work with the same loud error, not hang.
+            assert time.monotonic() - t0 < 30
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([4, 5], max_new=4, timeout=60)
+        finally:
+            _teardown(srv, eng)
 
     def test_http_surface_returns_500(self):
         cfg = _tiny()
@@ -82,17 +97,19 @@ class TestStepWatchdog:
         srv = InferenceServer(cfg, params, engine=eng, step_timeout=2.0)
         httpd = make_http_server(srv)
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
-        base = f"http://127.0.0.1:{httpd.server_address[1]}"
-        req = urllib.request.Request(
-            base + "/generate",
-            json.dumps({"tokens": [3, 5, 7], "max_new": 4}).encode(),
-            {"Content-Type": "application/json"},
-        )
-        with pytest.raises(urllib.error.HTTPError) as e:
-            urllib.request.urlopen(req, timeout=60)
-        assert e.value.code == 500
-        assert "step_timeout" in e.value.read().decode()
-        httpd.shutdown()
+        try:
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            req = urllib.request.Request(
+                base + "/generate",
+                json.dumps({"tokens": [3, 5, 7], "max_new": 4}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=60)
+            assert e.value.code == 500
+            assert "step_timeout" in e.value.read().decode()
+        finally:
+            _teardown(srv, eng, httpd)
 
     def test_healthy_server_unaffected(self):
         """A generous timeout never fires on a healthy engine — the
