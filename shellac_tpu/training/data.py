@@ -239,27 +239,50 @@ def device_prefetch(
     """
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = object()
+    closed = threading.Event()
 
     def put(batch):
         if sharding is not None:
             return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
         return jax.tree.map(jax.device_put, batch)
 
+    def send(item) -> bool:
+        """Enqueue unless the consumer abandoned the generator — a
+        worker parked forever in q.put() outlives its test/run and
+        leaks a thread into the rest of the process."""
+        while not closed.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def worker():
         try:
             for batch in it:
-                q.put(put(batch))
+                if not send(put(batch)):
+                    return
         except BaseException as e:  # re-raised in the consumer
-            q.put(e)
+            send(e)
         else:
-            q.put(stop)
+            send(stop)
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is stop:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is stop:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # Runs on normal exhaustion AND on generator close/GC: release
+        # a worker mid-put and let it exit.
+        closed.set()
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            pass

@@ -206,6 +206,26 @@ class TestData:
         assert len(out) == 4
         assert isinstance(out[0]["inputs"], jax.Array)
 
+    def test_device_prefetch_abandoned_consumer_frees_worker(self):
+        """Closing the generator early must release the prefetch
+        thread — a worker parked in q.put() forever leaks into the
+        rest of the process (the full-suite segfaults showed one)."""
+        import threading
+        import time
+
+        before = threading.active_count()
+        it = token_batches(
+            np.arange(4000, dtype=np.int32), batch_size=2, seq_len=8,
+            num_batches=100,
+        )
+        gen = device_prefetch(it)
+        next(gen)  # start the worker, consume one batch
+        gen.close()  # abandon mid-stream
+        deadline = time.time() + 10
+        while threading.active_count() > before and time.time() < deadline:
+            time.sleep(0.05)
+        assert threading.active_count() <= before, "prefetch thread leaked"
+
 
 class TestFit:
     def test_fit_end_to_end_with_resume(self, tmp_path):
