@@ -85,7 +85,7 @@ def latest_queue_tpu_line(path=None):
     recipe in effect.
     """
     if path is None:
-        path = os.path.join(_REPO_DIR, "tpu_queue_r4.jsonl")
+        path = os.path.join(_REPO_DIR, "tpu_queue_r5.jsonl")
     path = os.environ.get("SHELLAC_QUEUE_RESULTS", path)
     rec = load_recipe()
     want = {

@@ -18,7 +18,7 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_QUEUE = os.path.join(REPO, "tpu_queue_r4.jsonl")
+DEFAULT_QUEUE = os.path.join(REPO, "tpu_queue_r5.jsonl")
 RECIPE_PATH = os.path.join(REPO, "bench_recipe.json")
 
 # bench.py's current plain recipe (the baseline to beat).

@@ -16,7 +16,7 @@ cd "$(dirname "$0")/.."
 # Default matches bench.py's latest_queue_tpu_line() replay path, so a
 # manually-run queue's captured TPU headline is visible to the
 # wedged-relay fallback too.
-OUT="${1:-/root/repo/tpu_queue_r4.jsonl}"
+OUT="${1:-/root/repo/tpu_queue_r5.jsonl}"
 DEADLINE="${2:-}"   # optional epoch seconds; stop (exit 5) when reached
 
 probe() {
