@@ -79,7 +79,6 @@ def main():
         print(json.dumps({"adopt": "no candidates", "queue": path}))
         return 0
     plain = [r for r in rows if r["kind"] == "plain"]
-    best = max(rows, key=lambda r: r["tok_s"])
     baseline = max((r["tok_s"] for r in plain), default=None)
     if baseline is None:
         # Never adopt without a measured plain baseline from THIS
@@ -88,24 +87,50 @@ def main():
         # could entrench a recipe that has become slower than plain.
         print(json.dumps({
             "adopt": "no plain baseline in queue; keeping recipe as-is",
-            "best_tok_s": best["tok_s"],
+            "best_tok_s": max(r["tok_s"] for r in rows),
         }))
         return 0
-    if best["tok_s"] < baseline * 1.01:
-        # Nothing beats plain by >1%: drop any stale recipe so the
-        # headline stays the simple, reproducible default.
+    # Group measurements by config. Adoption requires the win to
+    # PERSIST: the winning config needs >= 2 measurements (the queue
+    # runs the sweep twice for this), and its SLOWEST measurement must
+    # still beat the fastest plain baseline by >1% — a single lucky row
+    # during relay-latency drift can no longer set the headline recipe.
+    by_cfg = {}
+    for r in rows:
+        key = (r["batch"], r["fused_loss"], r["remat_policy"])
+        by_cfg.setdefault(key, []).append(r)
+    persistent = {k: v for k, v in by_cfg.items() if len(v) >= 2}
+    winner = None
+    for key, meas in persistent.items():
+        if all(m["kind"] == "plain" for m in meas):
+            continue
+        floor = min(m["tok_s"] for m in meas)
+        if floor > baseline * 1.01 and (
+                winner is None or floor > winner["floor_tok_s"]):
+            winner = dict(meas[0], floor_tok_s=floor,
+                          passes=len(meas),
+                          tok_s=max(m["tok_s"] for m in meas))
+    if winner is None:
+        # Nothing beats plain persistently: drop any stale recipe so
+        # the headline stays the simple, reproducible default.
+        one_off = max(rows, key=lambda r: r["tok_s"])
+        reason = ("plain recipe stands"
+                  if one_off["tok_s"] < baseline * 1.01
+                  else "win not persistent (needs 2 queue passes)")
         if os.path.exists(RECIPE_PATH):
             os.remove(RECIPE_PATH)
-        print(json.dumps({"adopt": "plain recipe stands",
+        print(json.dumps({"adopt": reason,
                           "plain_tok_s": baseline,
-                          "best_tok_s": best["tok_s"]}))
+                          "best_tok_s": one_off["tok_s"]}))
         return 0
     recipe = {
-        "batch": best["batch"],
-        "fused_loss": best["fused_loss"],
-        "remat_policy": best["remat_policy"],
-        "measured_tok_s": best["tok_s"],
-        "measured_mfu": best.get("mfu"),
+        "batch": winner["batch"],
+        "fused_loss": winner["fused_loss"],
+        "remat_policy": winner["remat_policy"],
+        "measured_tok_s": winner["tok_s"],
+        "measured_floor_tok_s": winner["floor_tok_s"],
+        "measured_passes": winner["passes"],
+        "measured_mfu": winner.get("mfu"),
         "source": os.path.basename(path),
         "beats_plain_tok_s": baseline,
     }

@@ -140,9 +140,25 @@ run sweep_b8_dots_fused 580 python scripts/bench_sweep.py \
 # 6. Training bench extras.
 run train_mla 580 python bench.py --preset shellac-mla-2b
 
+# 6b. SECOND sweep pass: adopt_recipe only trusts a winner whose gain
+#     persists across two measurements of the same config (min of the
+#     two must beat plain), so a one-off drift-lucky row cannot set the
+#     headline recipe. Same commands, distinct labels for resumability.
+run train_plain_p2 580 python bench.py --no-recipe
+for b in 4 6 8; do
+  for p in none dots; do
+    run "sweep_b${b}_${p}_p2" 580 python scripts/bench_sweep.py \
+      batch=$b policy=$p
+  done
+done
+run sweep_b6_dots_fused_p2 580 python scripts/bench_sweep.py \
+  batch=6 policy=dots fused=4096
+run sweep_b8_dots_fused_p2 580 python scripts/bench_sweep.py \
+  batch=8 policy=dots fused=4096
+
 # 7. Adopt the measured sweep winner as the plain headline recipe and
 #    record one run under it (exact-math configs only; no-op when
-#    nothing beats the default by >1%).
+#    nothing beats the default by >1% in BOTH passes).
 run adopt 60 python scripts/adopt_recipe.py "$OUT"
 run train_adopted 580 python bench.py
 

@@ -1,0 +1,109 @@
+"""Recipe adoption requires the win to persist across two queue passes.
+
+Pins VERDICT r4 item 9: a single drift-lucky sweep row must not set
+bench.py's TPU headline recipe; the winning config needs two
+measurements whose MINIMUM still beats the plain baseline by >1%.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "adopt_recipe.py")
+
+PLAIN_ROW = {
+    "metric": "train_throughput_2048d16L_seq2048_tpu",
+    "value": 19000.0,
+    "detail": {"batch": 6, "fused_loss": None, "remat_policy": "none",
+               "mfu": 0.55},
+}
+
+
+def sweep_row(tok_s, batch=8, policy="dots", fused=4096):
+    return {"tok_s": tok_s, "batch": batch, "policy": policy,
+            "fused": fused, "remat": True, "mfu": 0.6}
+
+
+def run_adopt(tmp_path, rows):
+    queue = tmp_path / "queue.jsonl"
+    queue.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, str(queue)],
+        capture_output=True, text=True, check=True,
+        cwd=str(tmp_path),  # recipe file still lands at REPO root
+    )
+    return json.loads(out.stdout)
+
+
+def recipe_path():
+    return os.path.join(REPO, "bench_recipe.json")
+
+
+def cleanup():
+    if os.path.exists(recipe_path()):
+        os.remove(recipe_path())
+
+
+def test_single_pass_win_is_not_adopted(tmp_path):
+    cleanup()
+    try:
+        result = run_adopt(tmp_path, [PLAIN_ROW, sweep_row(21000.0)])
+        assert "not persistent" in result["adopt"]
+        assert not os.path.exists(recipe_path())
+    finally:
+        cleanup()
+
+
+def test_two_pass_win_is_adopted_with_floor(tmp_path):
+    cleanup()
+    try:
+        result = run_adopt(
+            tmp_path,
+            [PLAIN_ROW, sweep_row(21000.0), sweep_row(20500.0)])
+        assert result["adopt"] == "recipe written"
+        assert result["measured_floor_tok_s"] == 20500.0
+        assert result["measured_passes"] == 2
+        with open(recipe_path()) as f:
+            recipe = json.load(f)
+        assert recipe["batch"] == 8
+        assert recipe["remat_policy"] == "dots"
+    finally:
+        cleanup()
+
+
+def test_regressing_second_pass_blocks_adoption(tmp_path):
+    cleanup()
+    try:
+        result = run_adopt(
+            tmp_path,
+            [PLAIN_ROW, sweep_row(21000.0), sweep_row(18000.0)])
+        assert "not persistent" in result["adopt"]
+        assert not os.path.exists(recipe_path())
+    finally:
+        cleanup()
+
+
+def test_no_plain_baseline_never_adopts(tmp_path):
+    cleanup()
+    try:
+        result = run_adopt(
+            tmp_path, [sweep_row(21000.0), sweep_row(21000.0)])
+        assert "no plain baseline" in result["adopt"]
+        assert not os.path.exists(recipe_path())
+    finally:
+        cleanup()
+
+
+def test_stale_recipe_dropped_when_nothing_persists(tmp_path):
+    cleanup()
+    try:
+        with open(recipe_path(), "w") as f:
+            json.dump({"batch": 8}, f)
+        result = run_adopt(tmp_path, [PLAIN_ROW, sweep_row(21000.0)])
+        assert "not persistent" in result["adopt"]
+        assert not os.path.exists(recipe_path())
+    finally:
+        cleanup()
