@@ -19,7 +19,9 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_QUEUE = os.path.join(REPO, "tpu_queue_r5.jsonl")
-RECIPE_PATH = os.path.join(REPO, "bench_recipe.json")
+# Overridable so tests never touch the live repo-root recipe.
+RECIPE_PATH = os.environ.get(
+    "SHELLAC_RECIPE_PATH", os.path.join(REPO, "bench_recipe.json"))
 
 # bench.py's current plain recipe (the baseline to beat).
 PLAIN = {"batch": 6, "fused_loss": None, "remat_policy": "none"}
@@ -99,26 +101,51 @@ def main():
     for r in rows:
         key = (r["batch"], r["fused_loss"], r["remat_policy"])
         by_cfg.setdefault(key, []).append(r)
-    persistent = {k: v for k, v in by_cfg.items() if len(v) >= 2}
+    # A config's measurement count only includes NON-plain rows: the
+    # plain baseline config (batch 6, no fuse, no remat) also appears
+    # as a sweep row, and mixing kinds would count pass 1 twice.
+    def variant_meas(meas):
+        return [m for m in meas if m["kind"] != "plain"]
+
     winner = None
-    for key, meas in persistent.items():
-        if all(m["kind"] == "plain" for m in meas):
+    for key, meas in by_cfg.items():
+        vm = variant_meas(meas)
+        if len(vm) < 2:
             continue
-        floor = min(m["tok_s"] for m in meas)
+        floor = min(m["tok_s"] for m in vm)
         if floor > baseline * 1.01 and (
                 winner is None or floor > winner["floor_tok_s"]):
-            winner = dict(meas[0], floor_tok_s=floor,
-                          passes=len(meas),
-                          tok_s=max(m["tok_s"] for m in meas))
+            top = max(vm, key=lambda m: m["tok_s"])
+            winner = dict(top, floor_tok_s=floor,
+                          passes=len(vm), tok_s=top["tok_s"])
     if winner is None:
-        # Nothing beats plain persistently: drop any stale recipe so
-        # the headline stays the simple, reproducible default.
         one_off = max(rows, key=lambda r: r["tok_s"])
-        reason = ("plain recipe stands"
-                  if one_off["tok_s"] < baseline * 1.01
-                  else "win not persistent (needs 2 queue passes)")
-        if os.path.exists(RECIPE_PATH):
-            os.remove(RECIPE_PATH)
+        one_off_key = (one_off["batch"], one_off["fused_loss"],
+                       one_off["remat_policy"])
+        # Conclusive only if the BEST config itself was re-measured;
+        # "other configs got pass 2 but this one was given up on" is
+        # still inconclusive for this config.
+        remeasured = len(variant_meas(by_cfg[one_off_key])) >= 2
+        if one_off["tok_s"] < baseline * 1.01:
+            # Nothing beats plain even once: drop any stale recipe so
+            # the headline stays the simple, reproducible default.
+            reason = "plain recipe stands"
+            if os.path.exists(RECIPE_PATH):
+                os.remove(RECIPE_PATH)
+        elif remeasured:
+            # Pass 2 measured this config and the win did not hold
+            # up: conclusive evidence against — drop any stale recipe.
+            reason = "win not persistent (failed second queue pass)"
+            if os.path.exists(RECIPE_PATH):
+                os.remove(RECIPE_PATH)
+        else:
+            # A one-off win whose config was never re-measured (relay
+            # wedged mid-queue, or the _p2 item was given up on):
+            # inconclusive — keep any previously adopted recipe rather
+            # than letting an infrastructure flake silently revert the
+            # headline.
+            reason = ("win unconfirmed (second measurement missing); "
+                      "keeping recipe as-is")
         print(json.dumps({"adopt": reason,
                           "plain_tok_s": baseline,
                           "best_tok_s": one_off["tok_s"]}))

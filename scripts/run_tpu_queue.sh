@@ -145,6 +145,8 @@ run train_mla 580 python bench.py --preset shellac-mla-2b
 #     two must beat plain), so a one-off drift-lucky row cannot set the
 #     headline recipe. Same commands, distinct labels for resumability.
 run train_plain_p2 580 python bench.py --no-recipe
+run train_fused_p2 580 python bench.py --fused-loss 4096
+run train_fused_b8_p2 580 python bench.py --fused-loss 4096 --batch 8
 for b in 4 6 8; do
   for p in none dots; do
     run "sweep_b${b}_${p}_p2" 580 python scripts/bench_sweep.py \

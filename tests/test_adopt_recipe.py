@@ -5,7 +5,6 @@ bench.py's TPU headline recipe; the winning config needs two
 measurements whose MINIMUM still beats the plain baseline by >1%.
 """
 
-import importlib.util
 import json
 import os
 import subprocess
@@ -30,80 +29,93 @@ def sweep_row(tok_s, batch=8, policy="dots", fused=4096):
 def run_adopt(tmp_path, rows):
     queue = tmp_path / "queue.jsonl"
     queue.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    env = dict(os.environ,
+               SHELLAC_RECIPE_PATH=str(tmp_path / "bench_recipe.json"))
     out = subprocess.run(
         [sys.executable, SCRIPT, str(queue)],
         capture_output=True, text=True, check=True,
-        cwd=str(tmp_path),  # recipe file still lands at REPO root
+        cwd=str(tmp_path), env=env,
     )
     return json.loads(out.stdout)
 
 
-def recipe_path():
-    return os.path.join(REPO, "bench_recipe.json")
-
-
-def cleanup():
-    if os.path.exists(recipe_path()):
-        os.remove(recipe_path())
-
-
-def test_single_pass_win_is_not_adopted(tmp_path):
-    cleanup()
-    try:
-        result = run_adopt(tmp_path, [PLAIN_ROW, sweep_row(21000.0)])
-        assert "not persistent" in result["adopt"]
-        assert not os.path.exists(recipe_path())
-    finally:
-        cleanup()
+def test_single_pass_win_keeps_existing_recipe(tmp_path):
+    # A one-off win with NO second-pass data is inconclusive: a relay
+    # wedge mid-queue must not silently revert an adopted recipe.
+    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    result = run_adopt(tmp_path, [PLAIN_ROW, sweep_row(21000.0)])
+    assert "unconfirmed" in result["adopt"]
+    assert (tmp_path / "bench_recipe.json").exists()
 
 
 def test_two_pass_win_is_adopted_with_floor(tmp_path):
-    cleanup()
-    try:
-        result = run_adopt(
-            tmp_path,
-            [PLAIN_ROW, sweep_row(21000.0), sweep_row(20500.0)])
-        assert result["adopt"] == "recipe written"
-        assert result["measured_floor_tok_s"] == 20500.0
-        assert result["measured_passes"] == 2
-        with open(recipe_path()) as f:
-            recipe = json.load(f)
-        assert recipe["batch"] == 8
-        assert recipe["remat_policy"] == "dots"
-    finally:
-        cleanup()
+    result = run_adopt(
+        tmp_path,
+        [PLAIN_ROW, sweep_row(21000.0), sweep_row(20500.0)])
+    assert result["adopt"] == "recipe written"
+    assert result["measured_floor_tok_s"] == 20500.0
+    assert result["measured_passes"] == 2
+    with open(tmp_path / "bench_recipe.json") as f:
+        recipe = json.load(f)
+    assert recipe["batch"] == 8
+    assert recipe["remat_policy"] == "dots"
 
 
-def test_regressing_second_pass_blocks_adoption(tmp_path):
-    cleanup()
-    try:
-        result = run_adopt(
-            tmp_path,
-            [PLAIN_ROW, sweep_row(21000.0), sweep_row(18000.0)])
-        assert "not persistent" in result["adopt"]
-        assert not os.path.exists(recipe_path())
-    finally:
-        cleanup()
+def test_mfu_comes_from_fastest_measurement(tmp_path):
+    slow = dict(sweep_row(20500.0), mfu=0.58)
+    fast = dict(sweep_row(21000.0), mfu=0.61)
+    result = run_adopt(tmp_path, [PLAIN_ROW, slow, fast])
+    assert result["adopt"] == "recipe written"
+    assert result["measured_tok_s"] == 21000.0
+    assert result["measured_mfu"] == 0.61
+
+
+def test_regressing_second_pass_drops_stale_recipe(tmp_path):
+    # Pass 2 DID run and the win did not hold: conclusive evidence
+    # against — any previously adopted recipe goes.
+    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    result = run_adopt(
+        tmp_path,
+        [PLAIN_ROW, sweep_row(21000.0), sweep_row(18000.0)])
+    assert "failed second queue pass" in result["adopt"]
+    assert not (tmp_path / "bench_recipe.json").exists()
 
 
 def test_no_plain_baseline_never_adopts(tmp_path):
-    cleanup()
-    try:
-        result = run_adopt(
-            tmp_path, [sweep_row(21000.0), sweep_row(21000.0)])
-        assert "no plain baseline" in result["adopt"]
-        assert not os.path.exists(recipe_path())
-    finally:
-        cleanup()
+    result = run_adopt(
+        tmp_path, [sweep_row(21000.0), sweep_row(21000.0)])
+    assert "no plain baseline" in result["adopt"]
+    assert not (tmp_path / "bench_recipe.json").exists()
 
 
-def test_stale_recipe_dropped_when_nothing_persists(tmp_path):
-    cleanup()
-    try:
-        with open(recipe_path(), "w") as f:
-            json.dump({"batch": 8}, f)
-        result = run_adopt(tmp_path, [PLAIN_ROW, sweep_row(21000.0)])
-        assert "not persistent" in result["adopt"]
-        assert not os.path.exists(recipe_path())
-    finally:
-        cleanup()
+def test_plain_config_sweep_row_is_not_pass2_evidence(tmp_path):
+    # The plain config also appears as a sweep row (sweep_b6_none);
+    # pairing it with the plain bench row must not count as "pass 2
+    # ran" for an unrelated one-off winner.
+    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    plain_as_sweep = sweep_row(19010.0, batch=6, policy="none",
+                               fused=None)
+    result = run_adopt(
+        tmp_path, [PLAIN_ROW, plain_as_sweep, sweep_row(21000.0)])
+    assert "unconfirmed" in result["adopt"]
+    assert (tmp_path / "bench_recipe.json").exists()
+
+
+def test_other_config_pass2_does_not_condemn_winner(tmp_path):
+    # Another config completed both passes (without winning); the
+    # one-off best was given up on after one measurement — still
+    # inconclusive for THAT config, keep the recipe.
+    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    loser1 = sweep_row(18000.0, batch=4)
+    loser2 = sweep_row(18100.0, batch=4)
+    result = run_adopt(
+        tmp_path, [PLAIN_ROW, loser1, loser2, sweep_row(21000.0)])
+    assert "unconfirmed" in result["adopt"]
+    assert (tmp_path / "bench_recipe.json").exists()
+
+
+def test_nothing_beats_plain_drops_stale_recipe(tmp_path):
+    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    result = run_adopt(tmp_path, [PLAIN_ROW, sweep_row(19050.0)])
+    assert result["adopt"] == "plain recipe stands"
+    assert not (tmp_path / "bench_recipe.json").exists()

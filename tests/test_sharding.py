@@ -37,6 +37,17 @@ class TestRules:
         assert factor_devices(6).num_devices == 6
         assert factor_devices(6).pp == 1
 
+    def test_factor_devices_moe_assigns_ep(self):
+        # The default MoE factorization must exercise ep so the graded
+        # dryrun covers expert parallelism without a hand-built mesh;
+        # experts shard over (ep, fsdp), so fsdp follows ep in priority.
+        pc = factor_devices(8, moe=True)
+        assert pc.num_devices == 8
+        assert pc.tp == 2 and pc.ep == 2 and pc.fsdp == 2
+        assert factor_devices(4, moe=True).ep == 2
+        assert factor_devices(2, moe=True).ep == 1  # tp first
+        assert factor_devices(6, moe=True).num_devices == 6
+
 
 class TestShardedTraining:
     def test_init_shardings(self, mesh8):
