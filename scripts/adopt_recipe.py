@@ -107,8 +107,16 @@ def main():
     def variant_meas(meas):
         return [m for m in meas if m["kind"] != "plain"]
 
+    plain_key = (PLAIN["batch"], PLAIN["fused_loss"],
+                 PLAIN["remat_policy"])
     winner = None
     for key, meas in by_cfg.items():
+        if key == plain_key:
+            # Never "adopt" the plain config itself: its sweep rows
+            # ride a different harness than the bench.py baseline, and
+            # cross-harness bias must not relabel the default headline
+            # as recipe-driven.
+            continue
         vm = variant_meas(meas)
         if len(vm) < 2:
             continue
@@ -126,16 +134,34 @@ def main():
         # "other configs got pass 2 but this one was given up on" is
         # still inconclusive for this config.
         remeasured = len(variant_meas(by_cfg[one_off_key])) >= 2
+        # Independently: if the CURRENTLY adopted recipe's own config
+        # was re-measured this round and did not persist a win (else
+        # it would be the winner), it is conclusively stale no matter
+        # what the round's fastest one-off row was.
+        recipe_stale = False
+        if os.path.exists(RECIPE_PATH):
+            try:
+                with open(RECIPE_PATH) as f:
+                    cur = json.load(f)
+                cur_key = (cur["batch"], cur["fused_loss"],
+                           cur["remat_policy"])
+                recipe_stale = len(
+                    variant_meas(by_cfg.get(cur_key, []))) >= 2
+            except (ValueError, KeyError, TypeError):
+                recipe_stale = True  # unreadable recipe: drop it
         if one_off["tok_s"] < baseline * 1.01:
             # Nothing beats plain even once: drop any stale recipe so
             # the headline stays the simple, reproducible default.
             reason = "plain recipe stands"
             if os.path.exists(RECIPE_PATH):
                 os.remove(RECIPE_PATH)
-        elif remeasured:
-            # Pass 2 measured this config and the win did not hold
-            # up: conclusive evidence against — drop any stale recipe.
-            reason = "win not persistent (failed second queue pass)"
+        elif remeasured or recipe_stale:
+            # Either the best config was re-measured and its win did
+            # not hold, or the adopted recipe itself was re-measured
+            # and lost: conclusive — drop any stale recipe.
+            reason = ("win not persistent (failed second queue pass)"
+                      if remeasured else
+                      "adopted recipe re-measured and no longer wins")
             if os.path.exists(RECIPE_PATH):
                 os.remove(RECIPE_PATH)
         else:

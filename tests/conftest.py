@@ -15,6 +15,25 @@ jax.config.update("jax_num_cpu_devices", 8)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+_last_module = [None]
+
+
+def pytest_runtest_setup(item):
+    """Clear XLA's compiled-executable caches at module boundaries.
+
+    A full serial run accumulates ~600 modules' worth of CPU
+    executables in one process and eventually crashes inside an XLA
+    compile (round-4 root cause analysis; every crash site passes in
+    isolation). Dropping the caches when the suite moves to a new test
+    module bounds the accumulation; within-module compile reuse — the
+    kind that matters for runtime — is preserved.
+    """
+    mod = getattr(item, "module", None)
+    name = getattr(mod, "__name__", None)
+    if _last_module[0] is not None and name != _last_module[0]:
+        jax.clear_caches()
+    _last_module[0] = name
+
 
 @pytest.fixture(scope="session")
 def mesh8():

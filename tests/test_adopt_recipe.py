@@ -42,7 +42,8 @@ def run_adopt(tmp_path, rows):
 def test_single_pass_win_keeps_existing_recipe(tmp_path):
     # A one-off win with NO second-pass data is inconclusive: a relay
     # wedge mid-queue must not silently revert an adopted recipe.
-    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    (tmp_path / "bench_recipe.json").write_text(json.dumps(
+        {"batch": 8, "fused_loss": None, "remat_policy": "none"}))
     result = run_adopt(tmp_path, [PLAIN_ROW, sweep_row(21000.0)])
     assert "unconfirmed" in result["adopt"]
     assert (tmp_path / "bench_recipe.json").exists()
@@ -73,7 +74,8 @@ def test_mfu_comes_from_fastest_measurement(tmp_path):
 def test_regressing_second_pass_drops_stale_recipe(tmp_path):
     # Pass 2 DID run and the win did not hold: conclusive evidence
     # against — any previously adopted recipe goes.
-    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    (tmp_path / "bench_recipe.json").write_text(json.dumps(
+        {"batch": 8, "fused_loss": None, "remat_policy": "none"}))
     result = run_adopt(
         tmp_path,
         [PLAIN_ROW, sweep_row(21000.0), sweep_row(18000.0)])
@@ -92,7 +94,8 @@ def test_plain_config_sweep_row_is_not_pass2_evidence(tmp_path):
     # The plain config also appears as a sweep row (sweep_b6_none);
     # pairing it with the plain bench row must not count as "pass 2
     # ran" for an unrelated one-off winner.
-    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    (tmp_path / "bench_recipe.json").write_text(json.dumps(
+        {"batch": 8, "fused_loss": None, "remat_policy": "none"}))
     plain_as_sweep = sweep_row(19010.0, batch=6, policy="none",
                                fused=None)
     result = run_adopt(
@@ -105,7 +108,8 @@ def test_other_config_pass2_does_not_condemn_winner(tmp_path):
     # Another config completed both passes (without winning); the
     # one-off best was given up on after one measurement — still
     # inconclusive for THAT config, keep the recipe.
-    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    (tmp_path / "bench_recipe.json").write_text(json.dumps(
+        {"batch": 8, "fused_loss": None, "remat_policy": "none"}))
     loser1 = sweep_row(18000.0, batch=4)
     loser2 = sweep_row(18100.0, batch=4)
     result = run_adopt(
@@ -114,8 +118,37 @@ def test_other_config_pass2_does_not_condemn_winner(tmp_path):
     assert (tmp_path / "bench_recipe.json").exists()
 
 
+def test_plain_config_itself_is_never_adopted(tmp_path):
+    # Two sweep rows of the PLAIN config riding above the bench.py
+    # baseline (cross-harness bias) must not produce a "recipe"
+    # identical to the default.
+    rows = [PLAIN_ROW,
+            sweep_row(19400.0, batch=6, policy="none", fused=None),
+            sweep_row(19400.0, batch=6, policy="none", fused=None)]
+    result = run_adopt(tmp_path, rows)
+    assert result["adopt"] != "recipe written"
+    assert not (tmp_path / "bench_recipe.json").exists()
+
+
+def test_remeasured_losing_recipe_dropped_despite_unconfirmed_one_off(
+        tmp_path):
+    # The adopted recipe's own config got both passes and lost to
+    # plain; an unrelated config posted an unconfirmed one-off win.
+    # The recipe is conclusively stale and must go.
+    (tmp_path / "bench_recipe.json").write_text(
+        json.dumps({"batch": 4, "fused_loss": 4096,
+                    "remat_policy": "dots"}))
+    recipe1 = sweep_row(18000.0, batch=4)
+    recipe2 = sweep_row(18100.0, batch=4)
+    result = run_adopt(
+        tmp_path, [PLAIN_ROW, recipe1, recipe2, sweep_row(21000.0)])
+    assert "no longer wins" in result["adopt"]
+    assert not (tmp_path / "bench_recipe.json").exists()
+
+
 def test_nothing_beats_plain_drops_stale_recipe(tmp_path):
-    (tmp_path / "bench_recipe.json").write_text('{"batch": 8}')
+    (tmp_path / "bench_recipe.json").write_text(json.dumps(
+        {"batch": 8, "fused_loss": None, "remat_policy": "none"}))
     result = run_adopt(tmp_path, [PLAIN_ROW, sweep_row(19050.0)])
     assert result["adopt"] == "plain recipe stands"
     assert not (tmp_path / "bench_recipe.json").exists()
