@@ -127,7 +127,23 @@ def main():
             winner = dict(top, floor_tok_s=floor,
                           passes=len(vm), tok_s=top["tok_s"])
     if winner is None:
-        one_off = max(rows, key=lambda r: r["tok_s"])
+        # The fastest NON-plain-config row: the plain config can never
+        # be adopted, so its rows (bench or sweep) must not drive the
+        # keep/drop decision either — two plain-config sweep rows
+        # riding cross-harness bias are not "remeasured" evidence
+        # against a recipe that got zero measurements this round.
+        non_plain = [
+            r for r in rows
+            if (r["batch"], r["fused_loss"], r["remat_policy"])
+            != plain_key
+        ]
+        if not non_plain:
+            print(json.dumps({
+                "adopt": "no variant measurements; keeping recipe as-is",
+                "plain_tok_s": baseline,
+            }))
+            return 0
+        one_off = max(non_plain, key=lambda r: r["tok_s"])
         one_off_key = (one_off["batch"], one_off["fused_loss"],
                        one_off["remat_policy"])
         # Conclusive only if the BEST config itself was re-measured;

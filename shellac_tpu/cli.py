@@ -745,6 +745,15 @@ def cmd_serve(args):
             "--draft-model; --kv-quant composes on both uniform-window "
             "and patterned models)"
         )
+    if args.pp_pipeline and (args.paged or args.draft_model
+                             or args.kv_quant or args.rolling_window):
+        raise SystemExit(
+            "--pp-pipeline composes with the dense bf16 cache only "
+            "(no --paged, --draft-model, --kv-quant, or "
+            "--rolling-window)"
+        )
+    if args.pp_pipeline and not args.mesh:
+        raise SystemExit("--pp-pipeline needs --mesh with pp>=2")
 
     from shellac_tpu.parallel.distributed import initialize
 
@@ -766,10 +775,20 @@ def cmd_serve(args):
         from shellac_tpu.parallel.distributed import global_mesh
 
         pcfg = _parallel_config(args.mesh)
-        if pcfg.pp > 1 or pcfg.sp > 1:
+        if pcfg.sp > 1:
             raise SystemExit(
-                "serve --mesh supports tp (and single-host dp/fsdp) "
-                "only; pipeline/sequence axes are training-side"
+                "serve --mesh supports tp/pp (and single-host dp/fsdp); "
+                "the sequence axis is training-side"
+            )
+        if multihost and pcfg.pp > 1:
+            raise SystemExit(
+                "multi-host serve shards with tp only; pp stages would "
+                "span hosts and put per-stage cache rows off-host"
+            )
+        if args.pp_pipeline and pcfg.pp < 2:
+            raise SystemExit(
+                "--pp-pipeline needs a pp axis in --mesh (e.g. "
+                "pp=2,tp=2); got " + args.mesh
             )
         if multihost and (pcfg.dp > 1 or pcfg.fsdp > 1):
             # dp/fsdp shard the KV cache's slot axis; across hosts that
@@ -816,7 +835,8 @@ def cmd_serve(args):
             bs = args.block_size or (64 if args.kv_quant else 16)
             extra["block_size"] = bs
         else:
-            extra = {"rolling_window": args.rolling_window}
+            extra = {"rolling_window": args.rolling_window,
+                     "pp_pipeline": args.pp_pipeline}
         engine = kind(
             cfg, params, n_slots=args.slots,
             max_len=args.max_len or cfg.max_seq_len,
@@ -1134,6 +1154,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--decode-ticks", type=int, default=1, dest="decode_ticks",
                    help="decode steps per host sync (throughput vs "
                         "per-token latency)")
+    s.add_argument("--pp-pipeline", action="store_true",
+                   dest="pp_pipeline",
+                   help="token-level pipelined decode on a pp mesh: "
+                        "slot groups stagger across stages so no stage "
+                        "idles (dense cache; n_slots divisible by pp)")
     s.add_argument("--step-timeout", type=float, default=None,
                    dest="step_timeout",
                    help="fail the server loudly if one engine step "

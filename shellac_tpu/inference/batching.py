@@ -150,6 +150,7 @@ class BatchingEngine:
         mesh=None,
         kv_quant: Optional[str] = None,
         rolling_window: bool = False,
+        pp_pipeline: bool = False,
     ):
         if kv_quant not in (None, "int8"):
             raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
@@ -192,6 +193,22 @@ class BatchingEngine:
         # scheduler owns it). Shardings are pinned at the jit
         # boundaries so GSPMD keeps one layout across every program.
         self.mesh = mesh
+        # Token-level pipelined decode on pp meshes: slots split into
+        # pp staggered groups so every pipeline stage computes a
+        # different group each microtick instead of idling pp-1 of the
+        # time (inference/pp_pipeline.py). Bit-exact per slot; greedy
+        # parity is tested against the unpipelined engine.
+        self.pp_pipeline = bool(pp_pipeline)
+        self._pp = 0
+        if self.pp_pipeline:
+            from shellac_tpu.inference.pp_pipeline import (
+                validate_pp_pipeline,
+            )
+
+            self._pp = validate_pp_pipeline(
+                cfg, mesh, n_slots, kv_quant, rolling_window,
+                self._swaps_cache,
+            )
         self.decode_ticks = decode_ticks
         # Cap prefills per engine step: a burst of queued prompts would
         # otherwise run n_slots sequential prefill programs before the
@@ -440,69 +457,23 @@ class BatchingEngine:
                 self.cfg, params, cur[:, None], cache,
                 attn_impl=self.attn_impl, mesh=self.mesh,
             )
-            adj = self._adjust_logits(logits[:, 0], bias, min_rem)
-            if use_pen:
-                # OpenAI semantics over generated tokens: presence
-                # subtracts once per seen token, frequency per count.
-                adj = adj - (pres[:, None] * (counts > 0.0)
-                             + freq[:, None] * counts)
-            if use_con:
-                con = coff >= 0
-                row = ctrans[jnp.clip(coff, 0, None) + cstate]
-                allowed = row[:, :-1] >= 0  # (n_slots, V)
-                if self.eos_id is not None:
-                    # EOS legality comes from the dedicated last column
-                    # (allowed exactly in accepting states).
-                    allowed = allowed.at[:, self.eos_id].set(
-                        row[:, -1] >= 0
-                    )
-                # Constraint wins over any user bias: disallowed stays
-                # -inf regardless of logit_bias.
-                adj = jnp.where(con[:, None] & ~allowed, NEG_INF, adj)
-            if greedy_only:
-                nxt = jnp.argmax(adj, axis=-1).astype(jnp.int32)
-            elif use_seed:
-                nxt = sample_batched(
-                    key, adj, *samp[:4], seed=seed_vec, gen_idx=gen0 + i,
-                )
-            else:
-                nxt = sample_batched(key, adj, *samp[:4])
             lengths = jnp.where(active, cache.lengths, old_lengths)
             cache = cache.replace(lengths=lengths)
-            nxt = jnp.where(active, nxt, cur)
-            min_rem = jnp.where(
-                active, jnp.maximum(min_rem - 1, 0), min_rem
+            nxt, min_rem, new_cstate, lp, tlv, tli = (
+                self._row_decode_step(
+                    key, logits[:, 0], cur, active, min_rem, bias,
+                    (pres, freq, counts) if use_pen else None,
+                    (coff, cstate, ctrans) if use_con else None,
+                    samp[:4], seed_vec if use_seed else None, gen0 + i,
+                    greedy_only, use_pen, use_con, use_seed,
+                )
             )
             if use_con:
-                col = nxt
-                if self.eos_id is not None:
-                    col = jnp.where(
-                        nxt == self.eos_id, row.shape[1] - 1, nxt
-                    )
-                new_st = jnp.take_along_axis(
-                    row, col[:, None], axis=1
-                )[:, 0]
-                cstate = jnp.where(
-                    con & active, jnp.maximum(new_st, 0), cstate
-                )
+                cstate = new_cstate
             if use_pen:
                 counts = counts.at[
                     jnp.arange(counts.shape[0]), nxt
                 ].add(active.astype(jnp.float32))
-            k_tl = self.top_logprobs
-            if self.logprobs:
-                lsm = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32))
-                lp = jnp.take_along_axis(lsm, nxt[:, None], axis=-1)[:, 0]
-                if k_tl:
-                    tlv, tli = jax.lax.top_k(lsm, k_tl)
-                    tli = tli.astype(jnp.int32)
-                else:
-                    tlv = jnp.zeros((nxt.shape[0], 0), jnp.float32)
-                    tli = jnp.zeros((nxt.shape[0], 0), jnp.int32)
-            else:
-                lp = jnp.zeros(nxt.shape, jnp.float32)
-                tlv = jnp.zeros((nxt.shape[0], 0), jnp.float32)
-                tli = jnp.zeros((nxt.shape[0], 0), jnp.int32)
             return ((cache, nxt, min_rem, counts, cstate),
                     (nxt, lp, tlv, tli))
 
@@ -513,6 +484,268 @@ class BatchingEngine:
             tick, (cache, cur, min_rem0, counts0, cstate0), (keys, ticks_i)
         )
         return cache, toks, lps, min_rem, counts, cstate, tlvs, tlis
+
+    def _row_decode_step(self, key, logits, cur_r, active_r, min_rem_r,
+                         bias_r, pen_r, con_r, samp_r, seed_r, gen_idx_r,
+                         greedy_only, use_pen, use_con, use_seed):
+        """The per-row exit math of ONE decode tick, shared by the
+        unpipelined scan (_decode_impl, rows = all slots) and the
+        pipelined scan (_decode_impl_pp, rows = the exiting group) so
+        the two paths cannot drift: logit adjust (bias + min_tokens),
+        OpenAI penalties, DFA constraint masking + state advance,
+        sampling, and logprob extraction are defined once, here.
+
+        logits: (R, V) raw fp32 rows. pen_r = (pres, freq, counts)
+        rows or None; con_r = (coff, cstate, ctrans) or None. Returns
+        (nxt, min_rem_new, cstate_new or None, lp, tlv, tli); callers
+        own the counts scatter (their layouts differ) and any validity
+        masking beyond active_r (the pipelined path folds its warmup
+        mask into it)."""
+        adj = self._adjust_logits(logits, bias_r, min_rem_r)
+        if use_pen:
+            # OpenAI semantics over generated tokens: presence
+            # subtracts once per seen token, frequency per count.
+            pres_r, freq_r, counts_r = pen_r
+            adj = adj - (pres_r[:, None] * (counts_r > 0.0)
+                         + freq_r[:, None] * counts_r)
+        row = None
+        if use_con:
+            coff_r, cstate_r, ctrans = con_r
+            con = coff_r >= 0
+            row = ctrans[jnp.clip(coff_r, 0, None) + cstate_r]
+            allowed = row[:, :-1] >= 0  # (R, V)
+            if self.eos_id is not None:
+                # EOS legality comes from the dedicated last column
+                # (allowed exactly in accepting states).
+                allowed = allowed.at[:, self.eos_id].set(
+                    row[:, -1] >= 0
+                )
+            # Constraint wins over any user bias: disallowed stays
+            # -inf regardless of logit_bias.
+            adj = jnp.where(con[:, None] & ~allowed, NEG_INF, adj)
+        if greedy_only:
+            nxt = jnp.argmax(adj, axis=-1).astype(jnp.int32)
+        elif use_seed:
+            nxt = sample_batched(
+                key, adj, *samp_r, seed=seed_r, gen_idx=gen_idx_r,
+            )
+        else:
+            nxt = sample_batched(key, adj, *samp_r)
+        nxt = jnp.where(active_r, nxt, cur_r)
+        min_rem_new = jnp.where(
+            active_r, jnp.maximum(min_rem_r - 1, 0), min_rem_r
+        )
+        cstate_new = None
+        if use_con:
+            col = nxt
+            if self.eos_id is not None:
+                col = jnp.where(
+                    nxt == self.eos_id, row.shape[1] - 1, nxt
+                )
+            new_st = jnp.take_along_axis(
+                row, col[:, None], axis=1
+            )[:, 0]
+            cstate_new = jnp.where(
+                con & active_r, jnp.maximum(new_st, 0), cstate_r
+            )
+        k_tl = self.top_logprobs
+        n_rows = nxt.shape[0]
+        if self.logprobs:
+            lsm = jax.nn.log_softmax(logits.astype(jnp.float32))
+            lp = jnp.take_along_axis(lsm, nxt[:, None], axis=-1)[:, 0]
+            if k_tl:
+                tlv, tli = jax.lax.top_k(lsm, k_tl)
+                tli = tli.astype(jnp.int32)
+            else:
+                tlv = jnp.zeros((n_rows, 0), jnp.float32)
+                tli = jnp.zeros((n_rows, 0), jnp.int32)
+        else:
+            lp = jnp.zeros((n_rows,), jnp.float32)
+            tlv = jnp.zeros((n_rows, 0), jnp.float32)
+            tli = jnp.zeros((n_rows, 0), jnp.int32)
+        return nxt, min_rem_new, cstate_new, lp, tlv, tli
+
+    def _decode_impl_pp(self, params, cache, cur, active, key, samp,
+                        greedy_only: bool = False, use_bias: bool = False,
+                        use_pen: bool = False, use_seed: bool = False,
+                        use_con: bool = False):
+        """Token-level pipelined decode window — same contract as
+        _decode_impl (decode_ticks tokens per slot, one host sync),
+        restructured so pp stages never idle.
+
+        Slots split into pp contiguous groups of G = n_slots/pp. A
+        stage register (pp, G, 1, D) rolls through pp*K + (pp-1)
+        microticks: each microtick vmaps every stage's layer block
+        over the group it holds (pp groups advance concurrently on
+        their own devices), the group leaving the last stage is
+        sampled, and it re-enters stage 0 next microtick with its
+        fresh token. The pp-1 tail microticks drain the register so
+        no pipeline state crosses the call boundary — slot churn
+        (prefills, releases) between windows needs no special casing.
+        Drain-tail entries never exit; their cache writes land at each
+        slot's NEXT position and are overwritten by that token's real
+        pass in the following window (same self-healing argument as
+        the engine's finished-slot overshoot).
+
+        Per-row math is identical to _decode_impl (same block, norm,
+        unembed, adjust, sample formulas on the same values), so
+        greedy output is bit-exact vs the unpipelined engine.
+        """
+        from shellac_tpu.inference import pp_pipeline as ppl
+
+        pp = self._pp
+        n_slots = self.n_slots
+        G = n_slots // pp
+        K = self.decode_ticks
+        total = pp * K + pp - 1
+        cdt = self.cfg.compute_dtype
+        d_model = self.cfg.d_model
+        vocab = self.cfg.vocab_size
+
+        bias = samp[4] if use_bias else None
+        min_rem0 = samp[5]
+        pres, freq, counts0 = samp[6], samp[7], samp[8]
+        seed_vec, gen0 = samp[9], samp[10]
+        ctrans, coff, cstate0 = samp[11], samp[12], samp[13]
+
+        ck_st = ppl.stage_split(cache.k, pp)
+        cv_st = ppl.stage_split(cache.v, pp)
+        sp = ppl.stage_split(params["layers"], pp)
+
+        def rows(vec, gstart):
+            return jax.lax.dynamic_slice_in_dim(vec, gstart, G, axis=0)
+
+        def put_rows(vec, val, gstart):
+            return jax.lax.dynamic_update_slice_in_dim(
+                vec, val, gstart, axis=0
+            )
+
+        def microtick(carry, inp):
+            key_t, t = inp
+            (ck_st, cv_st, lengths, cur, min_rem, counts, cstate,
+             stage_x, stage_pos, stage_gstart) = carry
+
+            # Entry: the group t mod pp embeds its latest token into
+            # stage 0. During the drain tail these entries are dead
+            # (they never exit; see docstring).
+            gstart_in = (t % pp) * G
+            cur_in = rows(cur, gstart_in)
+            len_in = rows(lengths, gstart_in)
+            x_in = ppl.embed_group(self.cfg, params, cur_in, self.mesh)
+            stage_x = jnp.roll(stage_x, 1, axis=0).at[0].set(x_in)
+            stage_pos = jnp.roll(stage_pos, 1, axis=0).at[0].set(len_in)
+            stage_gstart = (
+                jnp.roll(stage_gstart, 1, axis=0).at[0].set(gstart_in)
+            )
+            stage_x = ppl.constrain_register(stage_x, self.mesh)
+
+            outs, ck_st, cv_st = ppl.stage_apply(
+                self.cfg, self.mesh, self.attn_impl, sp,
+                ck_st, cv_st, stage_x, stage_pos, stage_gstart,
+            )
+            outs = ppl.constrain_register(outs, self.mesh)
+            stage_x = outs
+
+            # Exit: the group leaving stage pp-1 gets sampled. Before
+            # warmup completes (t < pp-1) the exit rows are garbage —
+            # every state update is masked off and the emitted tokens
+            # are dropped on the host side.
+            exit_valid = t >= (pp - 1)
+            gstart_out = stage_gstart[pp - 1]
+            pos_out = stage_pos[pp - 1]
+            logits_g = ppl.head_logits(self.cfg, params, outs[pp - 1])
+
+            # Warmup exits (t < pp-1) are garbage: fold the validity
+            # mask into the active rows so _row_decode_step's own
+            # masking freezes every state update, and the emitted
+            # tokens (cur echoes) are dropped on the host side.
+            active_eff = rows(active, gstart_out) & exit_valid
+            cur_out = rows(cur, gstart_out)
+            len_out = rows(lengths, gstart_out)
+            bias_g = (
+                jax.lax.dynamic_slice(bias, (gstart_out, 0), (G, vocab))
+                if use_bias else None
+            )
+            pen_r = None
+            if use_pen:
+                pen_r = (
+                    rows(pres, gstart_out), rows(freq, gstart_out),
+                    jax.lax.dynamic_slice(
+                        counts, (gstart_out, 0), (G, vocab)
+                    ),
+                )
+            con_r = None
+            if use_con:
+                con_r = (rows(coff, gstart_out),
+                         rows(cstate, gstart_out), ctrans)
+            # This exit is the group's ((t - (pp-1)) // pp)-th token of
+            # the window — the per-slot gen counter seeded sampling
+            # uses, so seeded streams match the unpipelined engine.
+            k_idx = jnp.maximum(t - (pp - 1), 0) // pp
+            nxt, min_rem_g, cstate_g, lp, tlv, tli = (
+                self._row_decode_step(
+                    key_t, logits_g, cur_out, active_eff,
+                    rows(min_rem, gstart_out), bias_g, pen_r, con_r,
+                    (rows(samp[0], gstart_out),
+                     rows(samp[1], gstart_out),
+                     rows(samp[2], gstart_out),
+                     rows(samp[3], gstart_out)),
+                    rows(seed_vec, gstart_out) if use_seed else None,
+                    rows(gen0, gstart_out) + k_idx,
+                    greedy_only, use_pen, use_con, use_seed,
+                )
+            )
+            lengths = put_rows(
+                lengths, jnp.where(active_eff, pos_out + 1, len_out),
+                gstart_out,
+            )
+            cur = put_rows(cur, nxt, gstart_out)
+            min_rem = put_rows(min_rem, min_rem_g, gstart_out)
+            if use_con:
+                cstate = put_rows(cstate, cstate_g, gstart_out)
+            if use_pen:
+                counts = counts.at[
+                    gstart_out + jnp.arange(G), nxt
+                ].add(active_eff.astype(jnp.float32))
+            new_carry = (ck_st, cv_st, lengths, cur, min_rem, counts,
+                         cstate, stage_x, stage_pos, stage_gstart)
+            return new_carry, (nxt, lp, tlv, tli)
+
+        stage_x0 = ppl.constrain_register(
+            jnp.zeros((pp, G, 1, d_model), cdt), self.mesh
+        )
+        # Warmup stages hold garbage (gstart 0); pin their write
+        # position to group 0's CURRENT lengths so the garbage K/V
+        # lands exactly where group 0's real token writes correct
+        # values before any read — never at position 0, which would
+        # corrupt live prefix rows.
+        stage_pos0 = jnp.broadcast_to(
+            cache.lengths[:G][None, :], (pp, G)
+        )
+        stage_gstart0 = jnp.zeros((pp,), jnp.int32)
+        keys = jax.random.split(key, total)
+        ts = jnp.arange(total, dtype=jnp.int32)
+        carry0 = (ck_st, cv_st, cache.lengths, cur, min_rem0, counts0,
+                  cstate0, stage_x0, stage_pos0, stage_gstart0)
+        ((ck_st, cv_st, lengths, _, min_rem, counts, cstate, _, _, _),
+         (nxts, lps, tlvs, tlis)) = jax.lax.scan(
+            microtick, carry0, (keys, ts)
+        )
+        cache = cache.replace(
+            k=ppl.stage_merge(ck_st), v=ppl.stage_merge(cv_st),
+            lengths=lengths,
+        )
+        # Exits come out round-robin: microtick pp-1+m emits group
+        # m mod pp's (m//pp)-th token. Groups are contiguous ascending
+        # slot ranges, so reshaping the valid tail gives (K, n_slots)
+        # in slot order — the same shape _decode_impl returns.
+        toks = nxts[pp - 1:].reshape(K, n_slots)
+        lps_out = lps[pp - 1:].reshape(K, n_slots)
+        k_tl = self.top_logprobs
+        tlvs_out = tlvs[pp - 1:].reshape(K, n_slots, k_tl)
+        tlis_out = tlis[pp - 1:].reshape(K, n_slots, k_tl)
+        return (cache, toks, lps_out, min_rem, counts, cstate,
+                tlvs_out, tlis_out)
 
     # ---- scheduling --------------------------------------------------
 
@@ -1117,8 +1350,10 @@ class BatchingEngine:
         logprobs_per_slot or None) in one host sync. Overridden by the
         speculative engine."""
         if self._decode is None:
+            impl = (self._decode_impl_pp if self.pp_pipeline
+                    else self._decode_impl)
             self._decode = self._jit_cache_program(
-                self._decode_impl, 7,
+                impl, 7,
                 static_argnames=("greedy_only", "use_bias", "use_pen",
                                  "use_seed", "use_con"),
             )
@@ -1295,6 +1530,9 @@ class PagedBatchingEngine(BatchingEngine):
         self._pending_reg: Dict[int, List] = {}
         # Keyed (pad_bucket, want_plp), like the dense _chunk_jit.
         self._prefix_prefill_jit: Dict[Any, Any] = {}
+        # Beam-search programs, keyed (s_pad, beams, steps, eos,
+        # length_penalty, n_gen) — see beam_search below.
+        self._beam_jit: Dict[Any, Any] = {}
         if prefix_cache:
             self.stats.update({
                 "prefix_hit_tokens": 0,
@@ -1633,6 +1871,244 @@ class PagedBatchingEngine(BatchingEngine):
                else jnp.zeros((tokens.shape[1],), jnp.float32))
         tlv, tli = self._first_tl(last)
         return cache, first, first_lp, plp, tlv, tli
+
+
+    # ---- beam search over the pool (copy-on-write tables) ------------
+
+    def beam_search(self, prompt_tokens, *, num_beams: int = 4,
+                    max_new_tokens: int = 32, eos_id=None,
+                    length_penalty: float = 1.0):
+        """Deterministic beam decode of ONE prompt over the block pool.
+
+        Returns (sequences, scores) — the same contract as
+        Engine.beam_search, and bit-identical beams to the dense-cache
+        implementation (tests/test_beam_search.py paged cases).
+
+        Copy-on-write mechanics (the public vLLM CoW idea, expressed
+        functionally so the whole search stays one jitted scan):
+
+          - the prompt prefills ONCE into ceil(s/bs) borrowed blocks
+            that every beam's table shares READ-ONLY — prompt blocks
+            are never written after prefill, so sharing them is free;
+          - each beam owns one statically-assigned pool block per
+            generated logical block (beams advance in lockstep, so
+            block boundaries are crossed together and the assignment
+            never collides);
+          - on beam reorder the adopting beam copies the winning
+            beam's PARTIAL tail block into its own block (one
+            block-sized copy per beam per step) and repoints its
+            table; SEALED full blocks stay shared through the
+            gathered tables — never copied.
+
+        Borrowed blocks come from the engine's allocator (evicting LRU
+        prefix-cache blocks when the free list is dry) and return on
+        completion, so beam searches and live requests share the pool;
+        engine slots' tables/lengths are untouched.
+        """
+        if self.kv_quant == "int8":
+            raise NotImplementedError(
+                "beam_search over int8 pools is not wired: the CoW "
+                "tail copy would need the scale pools copied in "
+                "lockstep with the value pools; use a bf16 pool or "
+                "the dense engine's beam search"
+            )
+        if self.cfg.mla is not None:
+            raise NotImplementedError(
+                "beam_search over paged MLA latent pools is not wired"
+            )
+        k_beams = int(num_beams)
+        steps = int(max_new_tokens)
+        if k_beams < 1:
+            raise ValueError("num_beams must be >= 1")
+        if steps < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        s = int(toks.size)
+        bs = self.block_size
+        if s + steps + 1 > self.max_len:
+            raise ValueError(
+                f"prompt {s} + max_new {steps} exceeds max_len "
+                f"{self.max_len}"
+            )
+        lb0 = s // bs
+        # Owned generated blocks must cover every CoW target: writes
+        # land at positions s .. s+steps-2, and the post-reorder CoW
+        # additionally targets the NEXT write position, up to
+        # s+steps-1.
+        n_gen = 0 if steps == 1 else ((s + steps - 1) // bs - lb0 + 1)
+        prompt_n = -(-s // bs)
+        need = prompt_n + k_beams * n_gen
+        if need > len(self._free) + self._evictable():
+            raise RuntimeError(
+                f"paged pool exhausted: beam search needs {need} "
+                f"blocks ({prompt_n} prompt + {k_beams}x{n_gen} "
+                f"owned tails); free {len(self._free)} + evictable "
+                f"{self._evictable()}"
+            )
+        borrowed = [self._alloc_block() for _ in range(need)]
+        try:
+            prompt_ids = borrowed[:prompt_n]
+            gen_ids = np.asarray(
+                borrowed[prompt_n:], np.int32
+            ).reshape(n_gen, k_beams)
+            mb = self._cache.max_blocks
+            row = np.zeros((mb,), np.int32)
+            row[:prompt_n] = prompt_ids
+            tables0 = np.tile(row, (k_beams, 1))
+            s_pad = _bucket(s)
+            tokens_pad = np.zeros((1, s_pad), np.int32)
+            tokens_pad[0, :s] = toks
+            jit_key = (s_pad, k_beams, steps, eos_id,
+                       float(length_penalty), n_gen)
+            fn = self._beam_jit.get(jit_key)
+            if fn is None:
+                impl = functools.partial(
+                    self._beam_paged_impl, steps=steps, eos_id=eos_id,
+                    length_penalty=float(length_penalty),
+                )
+                jit_kw = {}
+                if self._cache_sh is not None:
+                    jit_kw["out_shardings"] = (
+                        self._cache_sh.k, self._cache_sh.v,
+                        None, None, None,
+                    )
+                fn = jax.jit(impl, **jit_kw)
+                self._beam_jit[jit_key] = fn
+            pk, pv, out, norm, lens = fn(
+                self.params, self._cache.k, self._cache.v,
+                jnp.asarray(tokens_pad),
+                jnp.full((1,), s, jnp.int32),
+                jnp.asarray(tables0), jnp.asarray(gen_ids),
+                jnp.int32(lb0),
+            )
+            self._cache = self._cache.replace(k=pk, v=pv)
+            out, norm, lens = jax.device_get((out, norm, lens))
+        finally:
+            self._free.extend(borrowed)
+        seqs = [r[:n].tolist() for r, n in zip(out, lens)]
+        return seqs, [float(x) for x in norm]
+
+    def _beam_paged_impl(self, params, pk, pv, tokens, prompt_len,
+                         tables0, gen_ids, lb0, *, steps, eos_id,
+                         length_penalty):
+        """Device side of beam_search: prefill once through the shared
+        prompt table row, then the dense beam loop with table-gather
+        reordering + CoW tail copies instead of cache-row gathers."""
+        cfg = self.cfg
+        k_beams, _ = tables0.shape
+        bs = pk.shape[3]
+        neg = jnp.float32(-1e30)
+        ak = jnp.arange(k_beams)
+
+        # Prompt prefill: dense mini once, scattered through the shared
+        # prompt blocks (same math as the engine's paged prefill). Pad
+        # positions write garbage at tail offsets >= s%bs — overwritten
+        # by the beams' own tokens before any read reaches them.
+        s_pad = tokens.shape[1]
+        mini = init_cache_for(cfg, 1, s_pad, None)
+        logits, mini = transformer.forward_with_cache(
+            cfg, params, tokens, mini, new_tokens_len=prompt_len,
+            fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
+        )
+        last = jnp.take_along_axis(
+            logits, (prompt_len - 1)[:, None, None].astype(jnp.int32),
+            axis=1,
+        )[0, 0]
+        pos = jnp.arange(s_pad, dtype=jnp.int32)
+        blocks = jnp.take(tables0[0], pos // bs)
+        offs = pos % bs
+        pk = pk.at[:, blocks, :, offs].set(
+            mini.k[:, 0].astype(pk.dtype).transpose(2, 0, 1, 3)
+        )
+        pv = pv.at[:, blocks, :, offs].set(
+            mini.v[:, 0].astype(pv.dtype).transpose(2, 0, 1, 3)
+        )
+
+        from shellac_tpu.inference.engine import (
+            beam_expand,
+            beam_first_expand,
+            beam_rank,
+        )
+
+        scores, beam0, tok0 = beam_first_expand(last, k_beams)
+        tables = tables0[beam0]  # rows identical; kept for symmetry
+        finished0 = ((tok0 == eos_id) if eos_id is not None
+                     else jnp.zeros((k_beams,), bool))
+        out0 = jnp.zeros((k_beams, steps), jnp.int32).at[:, 0].set(tok0)
+        lens0 = jnp.ones((k_beams,), jnp.int32)
+        lengths0 = jnp.broadcast_to(
+            prompt_len.astype(jnp.int32), (k_beams,)
+        )
+
+        if steps == 1:
+            out, norm, lens = beam_rank(scores, out0, lens0,
+                                        length_penalty)
+            return pk, pv, out, norm, lens
+
+        def scratch_frozen(tables, finished):
+            # A frozen beam's cache is dead weight: its logits are
+            # replaced by the frozen EOS distribution and no live beam
+            # can ever adopt it (finished persists through adoption).
+            # Point its WHOLE table at scratch block 0 so its EOS
+            # refeed writes land there instead of in a real block —
+            # a frozen beam is parked at an old position, and writing
+            # through a sealed (shared) block would corrupt live
+            # lineages that still read it.
+            return jnp.where(finished[:, None], 0, tables)
+
+        def cow(pk, pv, tables, lengths, live):
+            # Own the tail block each LIVE beam is about to write: copy
+            # the (possibly shared) partial tail into the beam's
+            # statically assigned block and repoint its table entry.
+            # Live beams advance in lockstep, so `lb` is uniform across
+            # them and the (crossing, slot) assignment never reuses a
+            # block a sealed table still references; frozen beams are
+            # excluded (their lb is stale) and no-op via scratch.
+            lb = lengths // bs
+            j = jnp.clip(lb - lb0, 0, gen_ids.shape[0] - 1)
+            owned = jnp.where(live, gen_ids[j, ak], 0)
+            src = jnp.where(live, tables[ak, lb], 0)
+            pk = pk.at[:, owned].set(pk[:, src])
+            pv = pv.at[:, owned].set(pv[:, src])
+            tables = tables.at[ak, lb].set(
+                jnp.where(live, owned, tables[ak, lb])
+            )
+            return pk, pv, tables
+
+        tables = scratch_frozen(tables, finished0)
+        pk, pv, tables = cow(pk, pv, tables, lengths0, ~finished0)
+
+        def step(carry, _):
+            (pk, pv, tables, cur, scores, finished, out, lens,
+             lengths, i) = carry
+            cache = PagedKVCache(k=pk, v=pv, tables=tables,
+                                 lengths=lengths)
+            logits, cache = transformer.forward_with_cache(
+                cfg, params, cur[:, None], cache,
+                attn_impl=self.attn_impl, mesh=self.mesh,
+            )
+            pk, pv, lengths = cache.k, cache.v, cache.lengths
+            (scores, beam, tok, out, lens, finished,
+             was_done) = beam_expand(
+                logits[:, 0], scores, finished, out, lens, i, eos_id
+            )
+            tables = tables[beam]
+            lengths = lengths[beam]
+            # A frozen beam must not grow its cache: the forward wrote
+            # its EOS refeed — roll the length back (same as dense).
+            lengths = jnp.where(was_done, lengths - 1, lengths)
+            tables = scratch_frozen(tables, finished)
+            pk, pv, tables = cow(pk, pv, tables, lengths, ~finished)
+            return (pk, pv, tables, tok, scores, finished, out, lens,
+                    lengths, i + 1), None
+
+        carry = (pk, pv, tables, tok0, scores, finished0, out0, lens0,
+                 lengths0, jnp.int32(1))
+        (pk, pv, _, _, scores, _, out, lens, _, _), _ = jax.lax.scan(
+            step, carry, None, length=steps - 1
+        )
+        out, norm, lens = beam_rank(scores, out, lens, length_penalty)
+        return pk, pv, out, norm, lens
 
 
 class _PoolExhausted(Exception):

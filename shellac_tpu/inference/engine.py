@@ -212,18 +212,11 @@ class Engine:
         """Device-side beam loop: one forward per step for all beams,
         flat top-k over (K, V) candidates, cache rows gathered by the
         winning beams (the standard public algorithm, built on the same
-        scanned cached forward as sampling)."""
-        k, v = first_logits.shape
-        neg = jnp.float32(-1e30)
-
-        # First expansion comes from ONE distribution (all beams hold
-        # the same prefill): masking all but beam 0 keeps the top-k
-        # from picking duplicate (beam, token) pairs.
-        lp0 = jax.nn.log_softmax(first_logits.astype(jnp.float32))
-        scores0 = jnp.where(jnp.arange(k) == 0, 0.0, neg)
-        cand = (scores0[:, None] + lp0).reshape(-1)
-        scores, flat = jax.lax.top_k(cand, k)
-        beam0, tok0 = flat // v, (flat % v).astype(jnp.int32)
+        scanned cached forward as sampling). The expansion/bookkeeping
+        math lives in the shared beam_* helpers below so the paged
+        engine's CoW beam cannot drift from this one."""
+        k, _ = first_logits.shape
+        scores, beam0, tok0 = beam_first_expand(first_logits[0], k)
         cache = self._reorder_cache(cache, beam0)
         finished0 = (tok0 == eos_id) if eos_id is not None else (
             jnp.zeros((k,), bool)
@@ -236,23 +229,11 @@ class Engine:
             logits, cache = transformer.forward_with_cache(
                 self.cfg, params, cur[:, None], cache, mesh=self.mesh
             )
-            lp = jax.nn.log_softmax(logits[:, 0].astype(jnp.float32))
-            if eos_id is not None:
-                # Finished beams persist unchanged: their only legal
-                # continuation is a zero-cost EOS self-loop.
-                frozen = jnp.full((v,), neg).at[eos_id].set(0.0)
-                lp = jnp.where(finished[:, None], frozen[None], lp)
-            cand = (scores[:, None] + lp).reshape(-1)
-            scores, flat = jax.lax.top_k(cand, k)
-            beam, tok = flat // v, (flat % v).astype(jnp.int32)
+            (scores, beam, tok, out, lens, finished,
+             was_done) = beam_expand(
+                logits[:, 0], scores, finished, out, lens, i, eos_id
+            )
             cache = self._reorder_cache(cache, beam)
-            out = out[beam].at[:, i].set(tok)
-            was_done = finished[beam]
-            lens = jnp.where(was_done, lens[beam], lens[beam] + 1)
-            if eos_id is not None:
-                finished = was_done | (tok == eos_id)
-            else:
-                finished = was_done
             # A frozen beam must not grow its cache: re-feeding EOS
             # writes a row, but lengths were already advanced by the
             # forward — roll them back for finished beams.
@@ -268,12 +249,7 @@ class Engine:
         (cache, _, scores, finished, out, lens, _), _ = jax.lax.scan(
             step, carry, None, length=steps - 1
         )
-        # Length-penalized final ranking (HF/GNMT convention: divide by
-        # len^alpha; alpha=0 is raw sum-logprob, alpha=1 is mean).
-        norm = scores / jnp.power(lens.astype(jnp.float32),
-                                  jnp.float32(length_penalty))
-        order = jnp.argsort(-norm)
-        return out[order], norm[order], lens[order]
+        return beam_rank(scores, out, lens, length_penalty)
 
     def beam_search(
         self,
@@ -288,9 +264,10 @@ class Engine:
 
         Returns (sequences, scores): sequences is a list of num_beams
         token lists (EOS included when hit, best first), scores their
-        length-penalized log-probabilities. Paged pools are not
-        supported (beam reordering would need copy-on-write block
-        tables); the dense/int8/rolling caches gather rows directly.
+        length-penalized log-probabilities. The dense/int8/rolling
+        caches gather rows directly; for block pools use
+        PagedBatchingEngine.beam_search, which reorders via
+        copy-on-write block tables and returns bit-identical beams.
         """
         if num_beams < 1:
             raise ValueError("num_beams must be >= 1")
@@ -319,6 +296,57 @@ class Engine:
         out, norm, lens = jax.device_get((out, norm, lens))
         seqs = [row[:n].tolist() for row, n in zip(out, lens)]
         return seqs, [float(x) for x in norm]
+
+
+def beam_first_expand(last_logits, k):
+    """First beam expansion from ONE distribution (every beam holds the
+    same prefill): masking all but beam 0 keeps the flat top-k from
+    picking duplicate (beam, token) pairs. last_logits: (V,). Returns
+    (scores, beam0, tok0), each (k,)."""
+    lp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32))
+    v = lp0.shape[0]
+    scores0 = jnp.where(jnp.arange(k) == 0, 0.0, jnp.float32(-1e30))
+    cand = (scores0[:, None] + lp0[None, :]).reshape(-1)
+    scores, flat = jax.lax.top_k(cand, k)
+    return scores, flat // v, (flat % v).astype(jnp.int32)
+
+
+def beam_expand(logits, scores, finished, out, lens, i, eos_id):
+    """One beam-search expansion: frozen-EOS self-loop, flat top-k over
+    (K, V) candidates, and the out/lens/finished bookkeeping — SHARED
+    by the dense loop (Engine._beam_impl) and the paged CoW loop
+    (PagedBatchingEngine._beam_paged_impl) so their beams cannot
+    drift. Returns (scores, beam, tok, out, lens, finished, was_done);
+    the caller owns the cache reorder and length rollback."""
+    k = scores.shape[0]
+    v = logits.shape[-1]
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    if eos_id is not None:
+        # Finished beams persist unchanged: their only legal
+        # continuation is a zero-cost EOS self-loop.
+        frozen = jnp.full((v,), jnp.float32(-1e30)).at[eos_id].set(0.0)
+        lp = jnp.where(finished[:, None], frozen[None], lp)
+    cand = (scores[:, None] + lp).reshape(-1)
+    scores, flat = jax.lax.top_k(cand, k)
+    beam = flat // v
+    tok = (flat % v).astype(jnp.int32)
+    out = out[beam].at[:, i].set(tok)
+    was_done = finished[beam]
+    lens = jnp.where(was_done, lens[beam], lens[beam] + 1)
+    if eos_id is not None:
+        finished = was_done | (tok == eos_id)
+    else:
+        finished = was_done
+    return scores, beam, tok, out, lens, finished, was_done
+
+
+def beam_rank(scores, out, lens, length_penalty):
+    """Length-penalized final ranking (HF/GNMT convention: divide by
+    len^alpha; alpha=0 is raw sum-logprob, alpha=1 is mean)."""
+    norm = scores / jnp.power(lens.astype(jnp.float32),
+                              jnp.float32(length_penalty))
+    order = jnp.argsort(-norm)
+    return out[order], norm[order], lens[order]
 
 
 def truncate_at_stop(tokens, stop, prompt_outputs=None):

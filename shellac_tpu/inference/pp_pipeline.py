@@ -1,0 +1,210 @@
+"""Token-level pipelined decode for pp-mesh serving.
+
+Plain pp serving (GSPMD layer sharding, one batched tick in flight)
+leaves pp-1 stages idle at every instant: decode is strictly
+sequential through the stages, so pp buys KV/weight capacity while
+wasting the chips it adds. This module removes the idle time the same
+way the training pipeline does (parallel/pipeline.py) — not with
+per-stage programs, but with ONE scanned GSPMD program over a stage
+register:
+
+  - the n_slots slot batch splits into pp contiguous GROUPS of
+    G = n_slots/pp slots;
+  - a register holds per-stage activations (pp, G, 1, D), sharded over
+    the `pp` mesh axis like the (pp, L/pp, ...) reshaped layer stack
+    and KV cache;
+  - each MICROTICK, `jax.vmap` over the stage axis applies every
+    stage's layer block to the group it currently holds — pp different
+    groups advance one stage each, concurrently, on their own devices;
+  - the register then rolls one stage (XLA: collective-permute over
+    ICI): the group leaving stage pp-1 is sampled, and the group whose
+    token was just sampled re-enters at stage 0 next microtick.
+
+Steady-state stage utilization is 100%: at microtick t, stage s works
+on group (t - s) mod pp. A decode window of K tokens per slot costs
+pp*K + (pp-1) microticks (the pp-1 tail is the drain ramp), against
+pp*K stage-sequential units for the unpipelined tick — and each
+microtick runs all stages in parallel, so wall-clock per window
+approaches (K + 1) stage-times instead of pp*K.
+
+Scope: dense KVCache over uniform layer stacks (the
+forward_with_cache `else` branch — dense or uniformly-MoE models, no
+attn_pattern / first_k_dense / moe_every). Each slot's math is
+row-for-row identical to the unpipelined engine, so greedy output is
+bit-exact (tests/test_pp_pipeline.py).
+
+The reference repo for this project is empty (SURVEY.md §0); there is
+no upstream pipelined-decoding implementation to cite. The schedule is
+the classic round-robin token-level pipelining idea (public
+literature: PipeDream-style weight-stationary decode), rebuilt for the
+GSPMD/`lax.scan` compilation model.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.models.transformer import (
+    _block,
+    _embed_tokens,
+    rope_angles,
+    unembed,
+)
+from shellac_tpu.parallel.sharding import constrain
+
+# Logical axes for the stage-reshaped buffers: leading axis is the
+# stage ("layers" -> pp in the shared rule table); the slot batch is
+# replicated in serving (the scheduler owns it).
+_REG_AXES = ("layers", None, None, None)
+
+
+def pp_schedule(pp: int, ticks: int) -> List[dict]:
+    """The static microtick schedule, for tests and docs.
+
+    Returns one dict per microtick t of a K=`ticks` decode window:
+      enter: group entering stage 0 (None once entries stop),
+      exit:  group leaving stage pp-1 (None during warmup),
+      stages: {stage: group} for every stage holding a LIVE token.
+
+    Live means the token both entered at a real entry microtick and
+    will exit within the window (drain-tail entries never exit; their
+    cache writes land at each slot's next position and are overwritten
+    by that token's real pass in the following window).
+    """
+    total = pp * ticks + pp - 1
+    out = []
+    for t in range(total):
+        stages = {}
+        for s in range(pp):
+            entered_at = t - s
+            if 0 <= entered_at < pp * ticks:
+                stages[s] = entered_at % pp
+        out.append({
+            "enter": t % pp if t < pp * ticks else None,
+            "exit": (t - (pp - 1)) % pp if t >= pp - 1 else None,
+            "stages": stages,
+        })
+    return out
+
+
+def stage_split(tree, pp: int):
+    """Reshape every (L, ...) leaf to (pp, L/pp, ...)."""
+    return jax.tree.map(
+        lambda a: a.reshape(pp, a.shape[0] // pp, *a.shape[1:]), tree
+    )
+
+
+def stage_merge(tree):
+    """Inverse of stage_split: (pp, Lp, ...) -> (L, ...)."""
+    return jax.tree.map(
+        lambda a: a.reshape(a.shape[0] * a.shape[1], *a.shape[2:]), tree
+    )
+
+
+def embed_group(cfg: ModelConfig, params, tokens, mesh):
+    """Embed one group's next tokens: (G,) int32 -> (G, 1, D)."""
+    return _embed_tokens(
+        cfg, params, tokens[:, None], cfg.compute_dtype, mesh=mesh
+    )
+
+
+def head_logits(cfg: ModelConfig, params, y):
+    """Final norm + unembedding on one group's exit activations.
+
+    y: (G, 1, D) -> (G, V) fp32. Defers to the SHARED model tail
+    (transformer.unembed) so per-row logits are bit-identical to the
+    unpipelined tick by construction.
+    """
+    return unembed(cfg, params, y)[:, 0]
+
+
+def stage_apply(
+    cfg: ModelConfig,
+    mesh,
+    attn_impl: str,
+    stage_params,  # pytree, leaves (pp, Lp, ...)
+    ck_st,  # (pp, Lp, B, Hkv, len, Dh)
+    cv_st,
+    stage_x,  # (pp, G, 1, D)
+    stage_pos,  # (pp, G) int32 — this token's write position
+    stage_gstart,  # (pp,) int32 — first slot of the group each stage holds
+):
+    """One pipelined microtick: every stage runs its layer block on the
+    group it holds. Returns (outputs (pp, G, 1, D), ck_st, cv_st)."""
+    G = stage_x.shape[1]
+
+    def one_stage(sp, ck, cv, x, pos, gstart):
+        ck_g = jax.lax.dynamic_slice_in_dim(ck, gstart, G, axis=1)
+        cv_g = jax.lax.dynamic_slice_in_dim(cv, gstart, G, axis=1)
+        positions = pos[:, None]
+        cos, sin = rope_angles(
+            positions, cfg.rope_dim, cfg.rope_theta,
+            yarn=cfg.rope_yarn, llama3=cfg.rope_llama3,
+            linear=cfg.rope_linear,
+        )
+
+        def body(xx, layer_in):
+            lp, k1, v1 = layer_in
+            xx, nc, _ = _block(
+                cfg, mesh, attn_impl, xx, lp, cos, sin,
+                cache=(k1, v1, pos, positions),
+            )
+            return xx, nc
+
+        x, (nk, nv) = jax.lax.scan(body, x, (sp, ck_g, cv_g))
+        ck = jax.lax.dynamic_update_slice_in_dim(ck, nk, gstart, axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(cv, nv, gstart, axis=1)
+        return x, ck, cv
+
+    return jax.vmap(one_stage)(
+        stage_params, ck_st, cv_st, stage_x, stage_pos, stage_gstart
+    )
+
+
+def constrain_register(x, mesh):
+    return constrain(x, mesh, _REG_AXES)
+
+
+def validate_pp_pipeline(cfg: ModelConfig, mesh, n_slots: int,
+                         kv_quant: Optional[str], rolling: bool,
+                         swaps_cache: bool) -> int:
+    """Checks the pp_pipeline=True configuration; returns pp."""
+    from shellac_tpu.models.transformer import first_k_layout, grouped_moe
+
+    if mesh is None or dict(mesh.shape).get("pp", 1) < 2:
+        raise ValueError(
+            "pp_pipeline needs a mesh with pp >= 2 (token-level "
+            "pipelining staggers slot groups across pipeline stages)"
+        )
+    pp = dict(mesh.shape)["pp"]
+    if swaps_cache:
+        raise ValueError(
+            "pp_pipeline is a dense-cache feature; the paged engine's "
+            "block pools do not reshape into per-stage registers yet"
+        )
+    if kv_quant is not None or rolling:
+        raise ValueError(
+            "pp_pipeline composes with the dense bf16 cache only for "
+            "now (kv_quant/rolling_window must be off)"
+        )
+    if (cfg.attn_pattern is not None or first_k_layout(cfg)
+            or grouped_moe(cfg)):
+        raise ValueError(
+            "pp_pipeline needs a uniform layer stack (no attn_pattern, "
+            "first_k_dense, or moe_every layouts)"
+        )
+    if n_slots % pp:
+        raise ValueError(
+            f"pp_pipeline needs n_slots divisible by pp: {n_slots} % "
+            f"{pp} != 0 (slots split into pp staggered groups)"
+        )
+    if cfg.n_layers % pp:
+        raise ValueError(
+            f"pp_pipeline needs n_layers divisible by pp: "
+            f"{cfg.n_layers} % {pp} != 0"
+        )
+    return pp

@@ -92,6 +92,13 @@ class SpeculativeBatchingEngine(BatchingEngine):
                 "(the verify round emits a variable number of tokens "
                 "per sync; use a non-draft engine for alternatives)"
             )
+        if kw.get("pp_pipeline"):
+            raise ValueError(
+                "pp_pipeline is not wired for the speculative engine "
+                "(its verify round replaces the decode scan the stage "
+                "register pipelines; use a non-draft engine on pp "
+                "meshes)"
+            )
         super().__init__(cfg, params, **kw)
         if kw.get("mesh") is not None:
             tp = kw["mesh"].shape.get("tp", 1)

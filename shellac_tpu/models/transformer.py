@@ -1297,19 +1297,13 @@ def forward(
             "dropped_frac": aux_acc["dropped_frac"] * inv_l,
         }
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
     if return_hidden:
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
         x = constrain(x, mesh, ("batch", "seq", None))
         if return_aux:
             return x, aux
         return x
-    if cfg.tie_embeddings:
-        w_out = params["embed"].astype(cdt).T
-    else:
-        w_out = params["lm_head"].astype(cdt)
-    logits = jnp.einsum("bsd,dv->bsv", x, w_out, preferred_element_type=jnp.float32)
-    if cfg.logit_softcap is not None:
-        logits = softcap(logits, cfg.logit_softcap)
+    logits = unembed(cfg, params, x)
     logits = constrain(logits, mesh, ("batch", "seq", "vocab"))
     if return_aux:
         return logits, aux
@@ -1321,6 +1315,24 @@ def output_weights(cfg: ModelConfig, params: Params, cdt) -> jax.Array:
     if cfg.tie_embeddings:
         return params["embed"].astype(cdt).T
     return params["lm_head"].astype(cdt)
+
+
+def unembed(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
+    """Final RMSNorm + output projection (+ logit softcap): the model
+    tail shared by forward, forward_with_cache, and the pipelined
+    decode's per-group exit (inference/pp_pipeline.py), so a head
+    change cannot drift between them. x: (B, S, D) pre-final-norm
+    hidden; returns fp32 (B, S, V) logits. Callers own any mesh
+    constraint on the result."""
+    cdt = cfg.compute_dtype
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, output_weights(cfg, params, cdt),
+        preferred_element_type=jnp.float32,
+    )
+    if cfg.logit_softcap is not None:
+        logits = softcap(logits, cfg.logit_softcap)
+    return logits
 
 
 def forward_with_cache(
@@ -1607,14 +1619,7 @@ def forward_with_cache(
         else:
             new_k, new_v = news
 
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps).astype(cdt)
-    if cfg.tie_embeddings:
-        w_out = params["embed"].astype(cdt).T
-    else:
-        w_out = params["lm_head"].astype(cdt)
-    logits = jnp.einsum("bsd,dv->bsv", x, w_out, preferred_element_type=jnp.float32)
-    if cfg.logit_softcap is not None:
-        logits = softcap(logits, cfg.logit_softcap)
+    logits = unembed(cfg, params, x)
     if new_tokens_len is None:
         new_lengths = index + s
     else:

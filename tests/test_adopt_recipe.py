@@ -146,6 +146,20 @@ def test_remeasured_losing_recipe_dropped_despite_unconfirmed_one_off(
     assert not (tmp_path / "bench_recipe.json").exists()
 
 
+def test_plain_sweep_rows_alone_keep_existing_recipe(tmp_path):
+    # Two plain-config sweep rows riding cross-harness bias are the
+    # ONLY rows besides the baseline: the adopted recipe's config got
+    # zero measurements, so nothing may condemn it.
+    (tmp_path / "bench_recipe.json").write_text(json.dumps(
+        {"batch": 4, "fused_loss": None, "remat_policy": "dots"}))
+    plain_sweep = lambda v: sweep_row(v, batch=6, policy="none",  # noqa: E731
+                                      fused=None)
+    result = run_adopt(
+        tmp_path, [PLAIN_ROW, plain_sweep(19400.0), plain_sweep(19400.0)])
+    assert "keeping recipe" in result["adopt"]
+    assert (tmp_path / "bench_recipe.json").exists()
+
+
 def test_nothing_beats_plain_drops_stale_recipe(tmp_path):
     (tmp_path / "bench_recipe.json").write_text(json.dumps(
         {"batch": 8, "fused_loss": None, "remat_policy": "none"}))

@@ -136,6 +136,113 @@ class TestBeamSearch:
             sorted(np.asarray(raw) / 6.0), sorted(mean), rtol=1e-5
         )
 
+    def test_paged_matches_dense_bit_exact(self, model):
+        """CoW paged beams vs the dense-cache beam: identical
+        sequences AND scores — the block-table gather + partial-tail
+        copy is invisible to the math."""
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg, params = model
+        dense = Engine(cfg, params, temperature=0.0, max_len=64)
+        paged = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                    block_size=4, temperature=0.0)
+        for prompt, k, steps, eos, alpha in (
+            ([7, 23, 5], 3, 5, None, 1.0),        # partial prompt tail
+            ([7, 23, 5, 9], 4, 9, None, 0.0),     # block-aligned prompt
+            ([1, 2], 3, 12, None, 1.0),           # multi-crossing run
+            ([4, 8, 15, 16, 23], 2, 1, None, 1.0),  # no decode writes
+        ):
+            want = dense.beam_search(prompt, num_beams=k,
+                                     max_new_tokens=steps, eos_id=eos,
+                                     length_penalty=alpha)
+            got = paged.beam_search(prompt, num_beams=k,
+                                    max_new_tokens=steps, eos_id=eos,
+                                    length_penalty=alpha)
+            assert got[0] == want[0], (prompt, k, steps)
+            np.testing.assert_allclose(got[1], want[1], rtol=1e-5)
+
+    def test_paged_eos_freeze_matches_dense(self, model):
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg, params = model
+        dense = Engine(cfg, params, temperature=0.0, max_len=64)
+        paged = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                    block_size=4, temperature=0.0)
+        prompt = [1, 2]
+        greedy = np.asarray(
+            dense.generate(jnp.asarray([prompt], jnp.int32),
+                           max_new_tokens=1).tokens
+        )[0, 0]
+        eos = int(greedy)
+        want = dense.beam_search(prompt, num_beams=3, max_new_tokens=8,
+                                 eos_id=eos, length_penalty=0.0)
+        got = paged.beam_search(prompt, num_beams=3, max_new_tokens=8,
+                                eos_id=eos, length_penalty=0.0)
+        assert got[0] == want[0]
+        np.testing.assert_allclose(got[1], want[1], rtol=1e-5)
+
+    def test_paged_beam_churn_through_allocator(self, model):
+        """Beam searches interleaved with live paged requests: the
+        borrowed blocks come from (and return to) the same pool the
+        slots use, block accounting balances, and neither the beams
+        nor the requests' greedy outputs move."""
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg, params = model
+        dense = Engine(cfg, params, temperature=0.0, max_len=64)
+        eng = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  block_size=4, temperature=0.0,
+                                  prefix_cache=True)
+        rng = np.random.default_rng(11)
+        reqs = [(i, rng.integers(1, cfg.vocab_size, size=5 + i).tolist(), 6)
+                for i in range(4)]
+        ref_engine = PagedBatchingEngine(cfg, params, n_slots=2,
+                                         max_len=64, block_size=4,
+                                         temperature=0.0)
+        want_reqs = ref_engine.run(reqs)
+        want_beam = dense.beam_search([7, 23, 5], num_beams=3,
+                                      max_new_tokens=5)
+
+        for rid, toks, n in reqs:
+            eng.submit(rid, toks, n)
+        got_reqs = {}
+        beams = []
+        free_before = len(eng._free) + eng._evictable()
+        while eng.pending:
+            for rid, out in eng.step():
+                got_reqs[rid] = out
+            # A beam search between engine steps — mid-churn.
+            beams.append(eng.beam_search([7, 23, 5], num_beams=3,
+                                         max_new_tokens=5))
+        assert got_reqs == want_reqs
+        for got_beam in beams:
+            assert got_beam[0] == want_beam[0]
+            np.testing.assert_allclose(got_beam[1], want_beam[1],
+                                       rtol=1e-5)
+        # Everything borrowed came back (slots freed theirs on finish).
+        assert len(eng._free) + eng._evictable() == free_before
+
+    def test_paged_beam_pool_exhaustion_is_loud(self, model):
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg, params = model
+        eng = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  block_size=4, pool_tokens=32,
+                                  temperature=0.0)
+        with pytest.raises(RuntimeError, match="pool exhausted"):
+            eng.beam_search(list(range(1, 9)), num_beams=8,
+                            max_new_tokens=32)
+
+    def test_paged_beam_int8_guard(self, model):
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg, params = model
+        eng = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  block_size=32, kv_quant="int8",
+                                  temperature=0.0)
+        with pytest.raises(NotImplementedError, match="int8"):
+            eng.beam_search([1, 2, 3], num_beams=2, max_new_tokens=4)
+
     def test_int8_cache_composes(self, model):
         """Beam search over the int8 cache: correct shape/ordering and
         a top score within the int8 rounding envelope of bf16 (near-tie
