@@ -735,8 +735,6 @@ def cmd_serve(args):
     if args.draft_model and args.decode_ticks != 1:
         raise SystemExit("--draft-model already emits up to gamma+1 tokens "
                          "per step; --decode-ticks must stay 1")
-    if args.draft_model and args.prefill_chunk is not None:
-        raise SystemExit("--draft-model does not support --prefill-chunk")
     if args.kv_quant and args.draft_model:
         raise SystemExit("--kv-quant does not compose with --draft-model")
     if args.rolling_window and (args.paged or args.draft_model):
@@ -821,6 +819,7 @@ def cmd_serve(args):
             seed=args.seed, logprobs=args.logprobs,
             top_logprobs=args.top_logprobs,
             max_prefills_per_step=args.max_prefills_per_step,
+            prefill_chunk=args.prefill_chunk,
             mesh=mesh,
         )
     if args.paged or (engine is None and mesh is not None):

@@ -75,22 +75,14 @@ class SpeculativeBatchingEngine(BatchingEngine):
                 "speculative batching emits up to gamma+1 tokens per step "
                 "already; decode_ticks must stay 1"
             )
-        if kw.get("prefill_chunk") is not None:
-            raise ValueError(
-                "speculative batching does not support chunked prefill "
-                "(the draft cache prefills whole prompts)"
-            )
         if kw.get("kv_quant") is not None:
             raise NotImplementedError(
-                "speculative batching keeps bf16 caches (verify windows "
-                "re-read fresh positions where int8 rounding would break "
-                "the acceptance identity)"
-            )
-        if kw.get("top_logprobs"):
-            raise ValueError(
-                "top_logprobs is not wired for the speculative engine "
-                "(the verify round emits a variable number of tokens "
-                "per sync; use a non-draft engine for alternatives)"
+                "speculative batching keeps bf16 caches: the rejection-"
+                "sampling identity needs the verify forward's scores to "
+                "equal sequential decode's, but the window's in-chunk "
+                "attention reads EXACT just-written K/V while sequential "
+                "decode re-reads them int8-rounded — see the int8 "
+                "section of docs/inference.md for the full argument"
             )
         if kw.get("pp_pipeline"):
             raise ValueError(
@@ -123,9 +115,10 @@ class SpeculativeBatchingEngine(BatchingEngine):
         if self._cache_sh is not None:
             self._dcache = jax.device_put(self._dcache, self._cache_sh)
         self._draft_prefill_jit = {}
+        self._draft_chunk_jit = {}
         round_kw = (
             {"out_shardings": (self._cache_sh, self._cache_sh,
-                               None, None, None, None)}
+                               None, None, None, None, None, None)}
             if self._cache_sh is not None else {}
         )
         self._spec_round = jax.jit(self._spec_round_impl, **round_kw)
@@ -210,6 +203,47 @@ class SpeculativeBatchingEngine(BatchingEngine):
             fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
         )
         return scatter_slot(dcache, mini, slot)
+
+    # ---- chunked prefill (draft cache chunks alongside the target) ---
+
+    def _chunk_prefill(self, pad, fresh, tokens, chunk_len, offset, slot,
+                       key, samp, boundary_next=None, want_plp=False):
+        """The target chunk program runs via the base engine; the SAME
+        chunk then continues the draft cache's row, so by the final
+        chunk both caches hold the full prompt — identical state to
+        the whole-prompt path, which is why chunked spec serving stays
+        bit-exact (tests/test_spec_batching.py chunked cases)."""
+        out = super()._chunk_prefill(
+            pad, fresh, tokens, chunk_len, offset, slot, key, samp,
+            boundary_next=boundary_next, want_plp=want_plp,
+        )
+        jkey = (pad, fresh)
+        if jkey not in self._draft_chunk_jit:
+            jit_kw = ({"out_shardings": self._cache_sh}
+                      if self._cache_sh is not None else {})
+            import functools
+
+            self._draft_chunk_jit[jkey] = jax.jit(
+                functools.partial(self._draft_chunk_impl, fresh=fresh),
+                **jit_kw,
+            )
+        self._dcache = self._draft_chunk_jit[jkey](
+            self.draft_params, self._dcache, tokens, chunk_len, offset,
+            slot,
+        )
+        return out
+
+    def _draft_chunk_impl(self, dparams, dcache, tokens, chunk_len,
+                          offset, slot, *, fresh):
+        from shellac_tpu.inference.kvcache import scatter_slot, slot_view
+
+        view = slot_view(dcache, slot, offset)
+        _, view = transformer.forward_with_cache(
+            self.draft_cfg, dparams, tokens, view,
+            new_tokens_len=chunk_len, fresh_cache=fresh,
+            attn_impl=self.attn_impl if fresh else "ref", mesh=self.mesh,
+        )
+        return scatter_slot(dcache, view, slot)
 
     # ---- one verification round over all slots ----------------------
 
@@ -316,32 +350,54 @@ class SpeculativeBatchingEngine(BatchingEngine):
         )
         cur = jnp.where(active, extra, cur)
         counts = jnp.where(active, n + 1, 0)
+        k_tl = self.top_logprobs
         if self.logprobs:
             # Raw-logit log_softmax of each emitted token (cols past
             # counts are garbage the host drops) — Engine convention.
+            lsm = jax.nn.log_softmax(tlogits.astype(jnp.float32), axis=-1)
             lps = jnp.take_along_axis(
-                jax.nn.log_softmax(tlogits.astype(jnp.float32), axis=-1),
-                emitted[..., None], axis=-1,
+                lsm, emitted[..., None], axis=-1
             )[..., 0]
+            if k_tl:
+                # Alternatives per emitted position ride the same
+                # verify scoring pass; the host slices by counts like
+                # the tokens themselves.
+                tlv, tli = jax.lax.top_k(lsm, k_tl)
+                tli = tli.astype(jnp.int32)
+            else:
+                tlv = jnp.zeros((*emitted.shape, 0), jnp.float32)
+                tli = jnp.zeros((*emitted.shape, 0), jnp.int32)
         else:
             lps = jnp.zeros(emitted.shape, jnp.float32)
-        return tcache, dcache, emitted, counts, cur, lps
+            tlv = jnp.zeros((*emitted.shape, 0), jnp.float32)
+            tli = jnp.zeros((*emitted.shape, 0), jnp.int32)
+        return tcache, dcache, emitted, counts, cur, lps, tlv, tli
 
     def _decode_tokens(self, active_rows):
         active = jnp.asarray(active_rows)
         self._key, sub = jax.random.split(self._key)
         (self._cache, self._dcache, emitted, counts, self._cur,
-         lps) = self._spec_round(
+         lps, tlv, tli) = self._spec_round(
             self.params, self.draft_params, self._cache, self._dcache,
             self._cur, active, self._stemp, sub,
         )
         # The one host sync.
-        em, cnt, host_lps = jax.device_get((emitted, counts, lps))
+        em, cnt, host_lps, host_tlv, host_tli = jax.device_get(
+            (emitted, counts, lps, tlv, tli)
+        )
         self.stats["spec_rounds"] += 1
         self.stats["spec_proposed"] += int((cnt > 0).sum()) * self.gamma
         self.stats["spec_accepted"] += int(np.maximum(cnt - 1, 0).sum())
         per_slot = [em[i, :cnt[i]].tolist() for i in range(self.n_slots)]
         if not self.logprobs:
             return per_slot, None, None
-        return per_slot, [host_lps[i, :cnt[i]].tolist()
-                          for i in range(self.n_slots)], None
+        per_lps = [host_lps[i, :cnt[i]].tolist()
+                   for i in range(self.n_slots)]
+        if not self.top_logprobs:
+            return per_slot, per_lps, None
+        per_tl = [
+            [(host_tli[i, j].tolist(), host_tlv[i, j].tolist())
+             for j in range(cnt[i])]
+            for i in range(self.n_slots)
+        ]
+        return per_slot, per_lps, per_tl

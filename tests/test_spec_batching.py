@@ -152,7 +152,85 @@ class TestSampledAndMixed:
             assert all(0 <= t < cfg.vocab_size for t in results[i])
 
 
+class TestChunkedPrefill:
+    def test_chunked_greedy_bit_exact(self, setup):
+        """Chunked prompts through the speculative engine: the draft
+        cache chunks alongside the target's, so by the final chunk
+        both hold the full prompt — outputs identical to the
+        whole-prompt spec engine AND the plain Engine."""
+        cfg, params = setup[:2]
+        rng = np.random.default_rng(5)
+        reqs = [
+            ("long", rng.integers(0, cfg.vocab_size, 37), 8),
+            ("short", rng.integers(0, cfg.vocab_size, 4), 6),
+            ("mid", rng.integers(0, cfg.vocab_size, 19), 7),
+        ]
+        srv = _engine(setup, prefill_chunk=8)
+        results = srv.run(reqs)
+        for rid, toks, max_new in reqs:
+            assert results[rid] == _ref(cfg, params, toks, max_new), rid
+        assert srv.stats["prefill_chunks"] > 0
+
+    def test_chunked_matches_whole_prompt_spec(self, setup):
+        cfg, params = setup[:2]
+        rng = np.random.default_rng(6)
+        reqs = [(i, rng.integers(0, cfg.vocab_size, 21), 6)
+                for i in range(3)]
+        whole = _engine(setup).run(reqs)
+        chunked = _engine(setup, prefill_chunk=6).run(reqs)
+        assert chunked == whole
+
+
+class TestTopLogprobs:
+    def test_top_logprobs_over_verify_window(self, setup):
+        """Alternatives ride the verify pass: greedy invariant top-1 ==
+        the chosen token at its exact logprob, for EVERY emitted
+        position of every accepted window."""
+        cfg, params = setup[:2]
+        rng = np.random.default_rng(8)
+        reqs = [("x", rng.integers(0, cfg.vocab_size, 6), 7)]
+        srv = _engine(setup, logprobs=True, top_logprobs=3)
+        results = srv.run(reqs)
+        toks = results["x"]
+        lps = srv.finished_logprobs["x"]
+        tlp = srv.finished_top_logprobs["x"]
+        assert len(tlp) == len(toks) == len(lps)
+        for tok, lp, (ids, vals) in zip(toks, lps, tlp):
+            assert len(ids) == 3
+            assert ids[0] == tok  # greedy: best alternative IS chosen
+            np.testing.assert_allclose(vals[0], lp, atol=1e-5)
+            assert vals == sorted(vals, reverse=True)
+
+    def test_top_logprobs_matches_plain_engine(self, setup):
+        """The recorded alternatives equal the plain BatchingEngine's
+        for the same greedy request (same model, same positions)."""
+        from shellac_tpu.inference.batching import BatchingEngine
+
+        cfg, params = setup[:2]
+        rng = np.random.default_rng(9)
+        prompt = rng.integers(0, cfg.vocab_size, 5)
+        plain = BatchingEngine(cfg, params, n_slots=2, max_len=96,
+                               temperature=0.0, logprobs=True,
+                               top_logprobs=2)
+        plain.run([("r", prompt, 6)])
+        spec = _engine(setup, logprobs=True, top_logprobs=2)
+        spec.run([("r", prompt, 6)])
+        want = plain.finished_top_logprobs["r"]
+        got = spec.finished_top_logprobs["r"]
+        assert [ids for ids, _ in got] == [ids for ids, _ in want]
+        for (_, gv), (_, wv) in zip(got, want):
+            np.testing.assert_allclose(gv, wv, atol=1e-4)
+
+
 class TestValidation:
+    def test_int8_rejection_pinned(self, setup):
+        """int8 KV remains excluded BY ARGUMENT (docs/inference.md):
+        the verify window's in-chunk attention reads exact K/V where
+        sequential decode re-reads them rounded, breaking the
+        acceptance identity."""
+        with pytest.raises(NotImplementedError, match="int8"):
+            _engine(setup, kv_quant="int8")
+
     def test_filter_params_rejected(self, setup):
         srv = _engine(setup)
         with pytest.raises(ValueError, match="temperature only"):
