@@ -37,6 +37,15 @@ class ByteTokenizer:
         data = bytes(int(i) for i in np.asarray(ids).reshape(-1) if int(i) < 256)
         return data.decode("utf-8", errors="replace")
 
+    def token_bytes(self, tid: int):
+        """Exact surface bytes of one token (None for specials).
+
+        Constrained decoding's byte-level DFA needs this: a byte token
+        carrying part of a multi-byte UTF-8 character is NOT decodable
+        on its own (decode() would replace it with U+FFFD), but it
+        advances the byte automaton exactly."""
+        return bytes([tid]) if 0 <= tid < 256 else None
+
     def encode_documents(
         self, docs: Iterable[str], *, eos_between: bool = True
     ) -> np.ndarray:

@@ -135,6 +135,149 @@ def _conforms(tokens, pattern):
     return s
 
 
+class TestUnicodeByteLevel:
+    """The byte-level automaton: full Unicode classes and literals,
+    multi-byte characters split across byte tokens."""
+
+    def test_non_latin_literals_match(self):
+        for pat, yes, no in (
+            ("да|нет", ["да", "нет"], ["da", "д", "данет"]),
+            ("[א-ת]{2,4}", ["שלום", "אב"], ["ab", "א", "שלוםם"]),
+            ("日本語?", ["日本", "日本語"], ["日", "語"]),
+            ("[^a]b", ["xb", "яb", "語b"], ["ab", "b"]),
+            (".{2}", ["ab", "яз", "日本"], ["a", "abc"]),
+        ):
+            m = _matcher(pat)
+            for s in yes:
+                assert m(s), (pat, s)
+            for s in no:
+                assert not m(s), (pat, s)
+
+    def test_multibyte_chars_split_across_byte_tokens(self, model):
+        """ByteTokenizer emits one token per UTF-8 byte, so a Cyrillic
+        answer spans 2 tokens per character — the DFA must advance
+        mid-character. Conformance through the real engine."""
+        cfg, params = model
+        dfa = compile_token_dfa("(да|нет)", ByteTokenizer(),
+                               cfg.vocab_size, eos_id=EOS)
+        assert dfa.n_states > 1
+        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0, eos_id=EOS)
+        eng.submit(0, [3, 5, 7], 12, constraint=dfa)
+        done = {}
+        while eng.pending:
+            done.update(eng.step())
+        s = _conforms(done[0], "(да|нет)")
+        assert s in ("да", "нет")
+
+    def test_token_bytes_protocol_enables_partial_utf8(self):
+        # Without token_bytes, a lone continuation byte decodes to
+        # U+FFFD and would be disabled; with it, the byte advances the
+        # automaton exactly.
+        from shellac_tpu.inference.constraints import _token_bytes
+
+        tb = _token_bytes(ByteTokenizer(), 259, EOS)
+        assert tb[0xD0] == b"\xd0"  # first byte of 'д'
+        assert tb[0xB0] == b"\xb0"  # continuation byte
+        assert tb[EOS] is None
+
+    def test_walk_budget_fallback_identical_tables(self, monkeypatch):
+        """Over the walk-precompute budget, compilation switches to
+        per-state token walking — same tables, bounded memory."""
+        import shellac_tpu.inference.constraints as C
+
+        tok = ByteTokenizer()
+        for pat in (r'\{"x":[0-9]{1,4}\}', "(да|нет)", "[a-z]{2,8}"):
+            fast = compile_token_dfa(pat, tok, 259, eos_id=EOS)
+            monkeypatch.setattr(C, "MAX_WALK_ENTRIES", 1)
+            slow = compile_token_dfa(pat, tok, 259, eos_id=EOS)
+            monkeypatch.undo()
+            assert np.array_equal(fast.trans, slow.trans), pat
+
+    def test_minimization_shrinks_counting_patterns(self):
+        from shellac_tpu.inference.constraints import (
+            _byte_dfa,
+            _minimize,
+        )
+
+        trans, accept = _byte_dfa(CharDFA("[ab]{1,64}"))
+        mtrans, _ = _minimize(trans, accept)
+        assert mtrans.shape[0] <= trans.shape[0]
+        # Equivalence spot-check after minimization.
+        m = _matcher("[ab]{1,64}")
+        assert m("ab" * 30) and not m("ab" * 33)
+
+
+class TestSchemaV2:
+    def test_optional_properties(self):
+        schema = {"type": "object",
+                  "properties": {"a": {"type": "integer"},
+                                 "b": {"type": "boolean"},
+                                 "c": {"type": "string"}},
+                  "required": ["b"]}
+        m = _matcher(_schema_regex_public(schema))
+        assert m('{"b":true}')
+        assert m('{"a":1,"b":false}')
+        assert m('{"b":true,"c":"x"}')
+        assert m('{"a":2,"b":true,"c":"y"}')
+        assert not m('{"a":1}')          # missing required b
+        assert not m('{"a":1,"c":"y"}')  # missing required b
+        assert not m('{"b":true,}')      # trailing comma
+        assert not m('{"c":"y","b":true}')  # fixed order
+
+    def test_all_optional_object_can_be_empty(self):
+        schema = {"type": "object",
+                  "properties": {"a": {"type": "integer"}},
+                  "required": []}
+        m = _matcher(_schema_regex_public(schema))
+        assert m("{}")
+        assert m('{"a":3}')
+        assert not m('{"a":}')
+
+    def test_no_required_list_means_all_required(self):
+        # Back-compat + the OpenAI structured-output norm.
+        schema = {"type": "object",
+                  "properties": {"a": {"type": "integer"},
+                                 "b": {"type": "boolean"}}}
+        m = _matcher(_schema_regex_public(schema))
+        assert m('{"a":1,"b":true}')
+        assert not m('{"a":1}')
+
+    def test_anyof_and_const(self):
+        schema = {"anyOf": [{"type": "integer"},
+                            {"const": "miss"},
+                            {"type": "object",
+                             "properties": {"x": {"type": "null"}}}]}
+        m = _matcher(_schema_regex_public(schema))
+        assert m("42")
+        assert m('"miss"')
+        assert m('{"x":null}')
+        assert not m('"hit"')
+
+    def test_non_latin_enum_values(self):
+        schema = {"enum": ["да", "нет", "可能"]}
+        m = _matcher(_schema_regex_public(schema))
+        assert m('"да"') and m('"可能"')
+        assert not m('"da"')
+
+    def test_additional_properties_true_rejected(self):
+        schema = {"type": "object", "additionalProperties": True,
+                  "properties": {"a": {"type": "integer"}}}
+        with pytest.raises(ValueError, match="additionalProperties"):
+            _schema_regex_public(schema)
+
+    def test_unknown_required_name_rejected(self):
+        schema = {"type": "object",
+                  "properties": {"a": {"type": "integer"}},
+                  "required": ["zz"]}
+        with pytest.raises(ValueError, match="required"):
+            _schema_regex_public(schema)
+
+
+def _schema_regex_public(schema):
+    return constraint_pattern({"json_schema": schema})
+
+
 class TestConstrainedEngine:
     def _dfa(self, cfg, pattern):
         return compile_token_dfa(pattern, ByteTokenizer(), cfg.vocab_size,
@@ -359,6 +502,30 @@ class TestServerAPI:
         content = r["choices"][0]["message"]["content"]
         v = json.loads(content)
         assert isinstance(v["ok"], bool)
+
+    def test_openai_schema_optional_and_non_latin(self, http_srv):
+        """Structured-output v2 through the OpenAI endpoint: optional
+        properties + a non-Latin enum value, decoded from byte tokens
+        that split the Cyrillic characters."""
+        r = self._post(http_srv, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "ответ?"}],
+            "max_tokens": 48,
+            "temperature": 0,
+            "response_format": {"type": "json_schema", "json_schema": {
+                "name": "out",
+                "schema": {"type": "object", "properties": {
+                    "ok": {"type": "boolean"},
+                    "ответ": {"enum": ["да", "нет"]},
+                    "note": {"type": "string",
+                             "pattern": "[a-z]{1,4}"},
+                }, "required": ["ответ"]},
+            }},
+        })
+        content = r["choices"][0]["message"]["content"]
+        v = json.loads(content)
+        assert v["ответ"] in ("да", "нет")
+        for key in v:
+            assert key in ("ok", "ответ", "note")
 
     def test_streaming_conforms(self, http_srv):
         """ndjson streaming with a constraint: the assembled stream
