@@ -44,6 +44,12 @@ class MoEConfig:
     # computation — use for inference/conversion parity, not large-T
     # training.
     dropless: bool = False
+    # Dropless TRAINING: sorted-segment grouped expert matmuls
+    # (jax.lax.ragged_dot) — no capacity buckets, nothing drops
+    # (moe_dropped_frac == 0 by construction), O(T*k*F) memory like a
+    # dense MLP. The loss-sensitive fine-tuning option; decode keeps
+    # the capacity-at-T path (ops/moe.py:moe_ffn_grouped).
+    grouped_dropless: bool = False
     # DeepSeek-style always-active shared experts: one fused FFN of
     # hidden size num_shared_experts * expert ff width added to the
     # routed output.

@@ -681,7 +681,12 @@ def compile_token_dfa(pattern: str, tokenizer, vocab_size: int,
 # JSON schema -> regex
 # ---------------------------------------------------------------------------
 
-_STR = r'"[^"\\]*"'  # compact strings, no escape sequences
+# Compact strings, no escape sequences. Control bytes (0x00-0x1F) are
+# excluded explicitly: they live inside the regex engine's negated-
+# class universe, but JSON forbids them raw in strings — a constraint-
+# conforming output must stay json.loads-able.
+_CTRL = "".join(chr(c) for c in range(0x20))
+_STR = '"[^"\\\\' + _CTRL + ']*"'
 _INT = r"-?(0|[1-9][0-9]*)"
 _NUM = _INT + r"(\.[0-9]+)?([eE][-+]?[0-9]+)?"
 _BOOL = r"(true|false)"

@@ -655,15 +655,13 @@ def _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache, moe_layer,
         # dropped token's FFN output would silently become zero, and
         # decode-path exactness is the serving contract. Only fresh
         # prefill keeps routed capacity (unless cfg.moe.dropless asks
-        # for exact computation everywhere).
+        # for exact computation everywhere, or grouped_dropless picks
+        # the sorted-segment training path).
         is_decode = cache is not None and not fresh_cache
-        down, aux, metrics = moe_ffn(
-            hx, lp["w_router"], lp["w_gate"], lp["w_up"], lp["w_down"],
-            cfg.moe, drop_tokens=not (is_decode or cfg.moe.dropless),
-            mesh=mesh,
-            # Strict lookups for biased gates: a missing bias must be a
-            # loud KeyError, not a silent zero (it changes which experts
-            # are selected / what they compute).
+        # Strict lookups for biased gates: a missing bias must be a
+        # loud KeyError, not a silent zero (it changes which experts
+        # are selected / what they compute).
+        bias_kw = dict(
             b_router=(lp["b_router"]
                       if cfg.moe.scoring in ("sigmoid", "softmax_topk")
                       else None),
@@ -671,6 +669,20 @@ def _block_mlp(cfg, mesh, x, lp, pdot, cache, fresh_cache, moe_layer,
             b_up=lp["b_up"] if cfg.moe.expert_bias else None,
             b_down=lp["b_down"] if cfg.moe.expert_bias else None,
         )
+        if cfg.moe.grouped_dropless and not is_decode:
+            from shellac_tpu.ops.moe import moe_ffn_grouped
+
+            down, aux, metrics = moe_ffn_grouped(
+                hx, lp["w_router"], lp["w_gate"], lp["w_up"],
+                lp["w_down"], cfg.moe, mesh=mesh, **bias_kw,
+            )
+        else:
+            down, aux, metrics = moe_ffn(
+                hx, lp["w_router"], lp["w_gate"], lp["w_up"],
+                lp["w_down"], cfg.moe,
+                drop_tokens=not (is_decode or cfg.moe.dropless),
+                mesh=mesh, **bias_kw,
+            )
         if cfg.moe.num_shared_experts > 0:
             sg = hx @ materialize(lp["w_gate_shared"], cdt)
             su = hx @ materialize(lp["w_up_shared"], cdt)

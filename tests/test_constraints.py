@@ -260,6 +260,18 @@ class TestSchemaV2:
         assert m('"да"') and m('"可能"')
         assert not m('"da"')
 
+    def test_json_strings_reject_raw_control_chars(self):
+        # Constraint-conforming output must stay json.loads-able: raw
+        # C0 control bytes are legal for the regex engine's universe
+        # but forbidden inside JSON strings.
+        schema = {"type": "object",
+                  "properties": {"a": {"type": "string"}}}
+        m = _matcher(_schema_regex_public(schema))
+        assert m('{"a":"xy"}')
+        assert not m('{"a":"x\x01y"}')
+        assert not m('{"a":"x\ny"}')
+        assert not m('{"a":"x\x1fy"}')
+
     def test_additional_properties_true_rejected(self):
         schema = {"type": "object", "additionalProperties": True,
                   "properties": {"a": {"type": "integer"}}}
