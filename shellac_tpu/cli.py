@@ -744,11 +744,11 @@ def cmd_serve(args):
             "and patterned models)"
         )
     if args.pp_pipeline and (args.paged or args.draft_model
-                             or args.kv_quant or args.rolling_window):
+                             or args.rolling_window):
         raise SystemExit(
-            "--pp-pipeline composes with the dense bf16 cache only "
-            "(no --paged, --draft-model, --kv-quant, or "
-            "--rolling-window)"
+            "--pp-pipeline composes with the dense caches (bf16 or "
+            "--kv-quant int8) only — no --paged, --draft-model, or "
+            "--rolling-window"
         )
     if args.pp_pipeline and not args.mesh:
         raise SystemExit("--pp-pipeline needs --mesh with pp>=2")

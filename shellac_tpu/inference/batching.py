@@ -42,6 +42,7 @@ import numpy as np
 from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.kvcache import (
     KVCache,
+    kv_field_names,
     PagedKVCache,
     QuantKVCache,
     QuantPagedKVCache,
@@ -608,8 +609,10 @@ class BatchingEngine:
         seed_vec, gen0 = samp[9], samp[10]
         ctrans, coff, cstate0 = samp[11], samp[12], samp[13]
 
-        ck_st = ppl.stage_split(cache.k, pp)
-        cv_st = ppl.stage_split(cache.v, pp)
+        cache_fields = kv_field_names(self.kv_quant)
+        cache_st = tuple(
+            ppl.stage_split(getattr(cache, f), pp) for f in cache_fields
+        )
         sp = ppl.stage_split(params["layers"], pp)
 
         def rows(vec, gstart):
@@ -622,7 +625,7 @@ class BatchingEngine:
 
         def microtick(carry, inp):
             key_t, t = inp
-            (ck_st, cv_st, lengths, cur, min_rem, counts, cstate,
+            (cache_st, lengths, cur, min_rem, counts, cstate,
              stage_x, stage_pos, stage_gstart) = carry
 
             # Entry: the group t mod pp embeds its latest token into
@@ -639,9 +642,9 @@ class BatchingEngine:
             )
             stage_x = ppl.constrain_register(stage_x, self.mesh)
 
-            outs, ck_st, cv_st = ppl.stage_apply(
+            outs, cache_st = ppl.stage_apply(
                 self.cfg, self.mesh, self.attn_impl, sp,
-                ck_st, cv_st, stage_x, stage_pos, stage_gstart,
+                cache_st, stage_x, stage_pos, stage_gstart,
             )
             outs = ppl.constrain_register(outs, self.mesh)
             stage_x = outs
@@ -707,7 +710,7 @@ class BatchingEngine:
                 counts = counts.at[
                     gstart_out + jnp.arange(G), nxt
                 ].add(active_eff.astype(jnp.float32))
-            new_carry = (ck_st, cv_st, lengths, cur, min_rem, counts,
+            new_carry = (cache_st, lengths, cur, min_rem, counts,
                          cstate, stage_x, stage_pos, stage_gstart)
             return new_carry, (nxt, lp, tlv, tli)
 
@@ -725,15 +728,16 @@ class BatchingEngine:
         stage_gstart0 = jnp.zeros((pp,), jnp.int32)
         keys = jax.random.split(key, total)
         ts = jnp.arange(total, dtype=jnp.int32)
-        carry0 = (ck_st, cv_st, cache.lengths, cur, min_rem0, counts0,
+        carry0 = (cache_st, cache.lengths, cur, min_rem0, counts0,
                   cstate0, stage_x0, stage_pos0, stage_gstart0)
-        ((ck_st, cv_st, lengths, _, min_rem, counts, cstate, _, _, _),
+        ((cache_st, lengths, _, min_rem, counts, cstate, _, _, _),
          (nxts, lps, tlvs, tlis)) = jax.lax.scan(
             microtick, carry0, (keys, ts)
         )
         cache = cache.replace(
-            k=ppl.stage_merge(ck_st), v=ppl.stage_merge(cv_st),
             lengths=lengths,
+            **{f: ppl.stage_merge(c)
+               for f, c in zip(cache_fields, cache_st)},
         )
         # Exits come out round-robin: microtick pp-1+m emits group
         # m mod pp's (m//pp)-th token. Groups are contiguous ascending
@@ -1903,15 +1907,11 @@ class PagedBatchingEngine(BatchingEngine):
         Borrowed blocks come from the engine's allocator (evicting LRU
         prefix-cache blocks when the free list is dry) and return on
         completion, so beam searches and live requests share the pool;
-        engine slots' tables/lengths are untouched.
+        engine slots' tables/lengths are untouched. int8 pools
+        compose: the CoW copy moves the scale pools in lockstep with
+        the value pools (same block ids), so quantized beams equal
+        the dense int8-cache beam exactly.
         """
-        if self.kv_quant == "int8":
-            raise NotImplementedError(
-                "beam_search over int8 pools is not wired: the CoW "
-                "tail copy would need the scale pools copied in "
-                "lockstep with the value pools; use a bf16 pool or "
-                "the dense engine's beam search"
-            )
         if self.cfg.mla is not None:
             raise NotImplementedError(
                 "beam_search over paged MLA latent pools is not wired"
@@ -1960,6 +1960,7 @@ class PagedBatchingEngine(BatchingEngine):
             tokens_pad[0, :s] = toks
             jit_key = (s_pad, k_beams, steps, eos_id,
                        float(length_penalty), n_gen)
+            pool_fields = kv_field_names(self.kv_quant)
             fn = self._beam_jit.get(jit_key)
             if fn is None:
                 impl = functools.partial(
@@ -1969,43 +1970,62 @@ class PagedBatchingEngine(BatchingEngine):
                 jit_kw = {}
                 if self._cache_sh is not None:
                     jit_kw["out_shardings"] = (
-                        self._cache_sh.k, self._cache_sh.v,
+                        tuple(getattr(self._cache_sh, f)
+                              for f in pool_fields),
                         None, None, None,
                     )
                 fn = jax.jit(impl, **jit_kw)
                 self._beam_jit[jit_key] = fn
-            pk, pv, out, norm, lens = fn(
-                self.params, self._cache.k, self._cache.v,
+            pools, out, norm, lens = fn(
+                self.params,
+                tuple(getattr(self._cache, f) for f in pool_fields),
                 jnp.asarray(tokens_pad),
                 jnp.full((1,), s, jnp.int32),
                 jnp.asarray(tables0), jnp.asarray(gen_ids),
                 jnp.int32(lb0),
             )
-            self._cache = self._cache.replace(k=pk, v=pv)
+            self._cache = self._cache.replace(
+                **dict(zip(pool_fields, pools))
+            )
             out, norm, lens = jax.device_get((out, norm, lens))
         finally:
             self._free.extend(borrowed)
         seqs = [r[:n].tolist() for r, n in zip(out, lens)]
         return seqs, [float(x) for x in norm]
 
-    def _beam_paged_impl(self, params, pk, pv, tokens, prompt_len,
+    def _beam_paged_impl(self, params, pools, tokens, prompt_len,
                          tables0, gen_ids, lb0, *, steps, eos_id,
                          length_penalty):
         """Device side of beam_search: prefill once through the shared
         prompt table row, then the dense beam loop with table-gather
-        reordering + CoW tail copies instead of cache-row gathers."""
+        reordering + CoW tail copies instead of cache-row gathers.
+
+        `pools` is (k, v) for bf16 pools or (k, v, ks, vs) for int8
+        pools — every array has the block axis at dim 1, so the CoW
+        copy and prefill scatter treat them uniformly and the scale
+        pools stay in lockstep with the values by construction."""
         cfg = self.cfg
+        quant = len(pools) == 4
         k_beams, _ = tables0.shape
-        bs = pk.shape[3]
-        neg = jnp.float32(-1e30)
+        bs = pools[0].shape[3]
         ak = jnp.arange(k_beams)
 
-        # Prompt prefill: dense mini once, scattered through the shared
-        # prompt blocks (same math as the engine's paged prefill). Pad
-        # positions write garbage at tail offsets >= s%bs — overwritten
-        # by the beams' own tokens before any read reaches them.
+        def make_cache(pools, tables, lengths):
+            if quant:
+                return QuantPagedKVCache(
+                    k=pools[0], v=pools[1], ks=pools[2], vs=pools[3],
+                    tables=tables, lengths=lengths,
+                )
+            return PagedKVCache(k=pools[0], v=pools[1], tables=tables,
+                                lengths=lengths)
+
+        # Prompt prefill: mini of the pool's kind once, scattered
+        # through the shared prompt blocks (same math as the engine's
+        # paged prefill). Pad positions write garbage at tail offsets
+        # >= s%bs — overwritten by the beams' own tokens before any
+        # read reaches them.
         s_pad = tokens.shape[1]
-        mini = init_cache_for(cfg, 1, s_pad, None)
+        mini = init_cache_for(cfg, 1, s_pad, self.kv_quant)
         logits, mini = transformer.forward_with_cache(
             cfg, params, tokens, mini, new_tokens_len=prompt_len,
             fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
@@ -2017,12 +2037,16 @@ class PagedBatchingEngine(BatchingEngine):
         pos = jnp.arange(s_pad, dtype=jnp.int32)
         blocks = jnp.take(tables0[0], pos // bs)
         offs = pos % bs
-        pk = pk.at[:, blocks, :, offs].set(
-            mini.k[:, 0].astype(pk.dtype).transpose(2, 0, 1, 3)
-        )
-        pv = pv.at[:, blocks, :, offs].set(
-            mini.v[:, 0].astype(pv.dtype).transpose(2, 0, 1, 3)
-        )
+        mini_fields = kv_field_names(self.kv_quant)
+        scattered = []
+        for pool, f in zip(pools, mini_fields):
+            src = getattr(mini, f)[:, 0].astype(pool.dtype)
+            # Value pools are (L, nb, H, bs, Dh), scale pools
+            # (L, nb, H, bs): token rows lead after the transpose.
+            src = (src.transpose(2, 0, 1, 3) if src.ndim == 4
+                   else src.transpose(2, 0, 1))
+            scattered.append(pool.at[:, blocks, :, offs].set(src))
+        pools = tuple(scattered)
 
         from shellac_tpu.inference.engine import (
             beam_expand,
@@ -2043,7 +2067,7 @@ class PagedBatchingEngine(BatchingEngine):
         if steps == 1:
             out, norm, lens = beam_rank(scores, out0, lens0,
                                         length_penalty)
-            return pk, pv, out, norm, lens
+            return pools, out, norm, lens
 
         def scratch_frozen(tables, finished):
             # A frozen beam's cache is dead weight: its logits are
@@ -2056,7 +2080,7 @@ class PagedBatchingEngine(BatchingEngine):
             # lineages that still read it.
             return jnp.where(finished[:, None], 0, tables)
 
-        def cow(pk, pv, tables, lengths, live):
+        def cow(pools, tables, lengths, live):
             # Own the tail block each LIVE beam is about to write: copy
             # the (possibly shared) partial tail into the beam's
             # statically assigned block and repoint its table entry.
@@ -2068,26 +2092,25 @@ class PagedBatchingEngine(BatchingEngine):
             j = jnp.clip(lb - lb0, 0, gen_ids.shape[0] - 1)
             owned = jnp.where(live, gen_ids[j, ak], 0)
             src = jnp.where(live, tables[ak, lb], 0)
-            pk = pk.at[:, owned].set(pk[:, src])
-            pv = pv.at[:, owned].set(pv[:, src])
+            pools = tuple(p.at[:, owned].set(p[:, src]) for p in pools)
             tables = tables.at[ak, lb].set(
                 jnp.where(live, owned, tables[ak, lb])
             )
-            return pk, pv, tables
+            return pools, tables
 
         tables = scratch_frozen(tables, finished0)
-        pk, pv, tables = cow(pk, pv, tables, lengths0, ~finished0)
+        pools, tables = cow(pools, tables, lengths0, ~finished0)
 
         def step(carry, _):
-            (pk, pv, tables, cur, scores, finished, out, lens,
+            (pools, tables, cur, scores, finished, out, lens,
              lengths, i) = carry
-            cache = PagedKVCache(k=pk, v=pv, tables=tables,
-                                 lengths=lengths)
+            cache = make_cache(pools, tables, lengths)
             logits, cache = transformer.forward_with_cache(
                 cfg, params, cur[:, None], cache,
                 attn_impl=self.attn_impl, mesh=self.mesh,
             )
-            pk, pv, lengths = cache.k, cache.v, cache.lengths
+            pools = tuple(getattr(cache, f) for f in mini_fields)
+            lengths = cache.lengths
             (scores, beam, tok, out, lens, finished,
              was_done) = beam_expand(
                 logits[:, 0], scores, finished, out, lens, i, eos_id
@@ -2098,17 +2121,17 @@ class PagedBatchingEngine(BatchingEngine):
             # its EOS refeed — roll the length back (same as dense).
             lengths = jnp.where(was_done, lengths - 1, lengths)
             tables = scratch_frozen(tables, finished)
-            pk, pv, tables = cow(pk, pv, tables, lengths, ~finished)
-            return (pk, pv, tables, tok, scores, finished, out, lens,
+            pools, tables = cow(pools, tables, lengths, ~finished)
+            return (pools, tables, tok, scores, finished, out, lens,
                     lengths, i + 1), None
 
-        carry = (pk, pv, tables, tok0, scores, finished0, out0, lens0,
+        carry = (pools, tables, tok0, scores, finished0, out0, lens0,
                  lengths0, jnp.int32(1))
-        (pk, pv, _, _, scores, _, out, lens, _, _), _ = jax.lax.scan(
+        (pools, _, _, scores, _, out, lens, _, _), _ = jax.lax.scan(
             step, carry, None, length=steps - 1
         )
         out, norm, lens = beam_rank(scores, out, lens, length_penalty)
-        return pk, pv, out, norm, lens
+        return pools, out, norm, lens
 
 
 class _PoolExhausted(Exception):

@@ -120,6 +120,14 @@ def quant_cache_logical_axes(cfg: Optional[ModelConfig] = None):
     )
 
 
+def kv_field_names(kv_quant=None):
+    """The value/scale field names shared by the dense and paged cache
+    kinds — the ONE definition the engines' field-tuple plumbing
+    (pipelined stage splits, paged beam CoW, prefill scatters) keys
+    on, so a new cache field cannot silently miss a path."""
+    return ("k", "v", "ks", "vs") if kv_quant == "int8" else ("k", "v")
+
+
 def init_cache_for(cfg: ModelConfig, batch: int, max_len: int,
                    kv_quant=None, rolling: bool = False,
                    chunk_slack: int = 1):

@@ -27,11 +27,12 @@ pp*K stage-sequential units for the unpipelined tick — and each
 microtick runs all stages in parallel, so wall-clock per window
 approaches (K + 1) stage-times instead of pp*K.
 
-Scope: dense KVCache over uniform layer stacks (the
+Scope: dense bf16 and int8 caches over uniform layer stacks (the
 forward_with_cache `else` branch — dense or uniformly-MoE models, no
-attn_pattern / first_k_dense / moe_every). Each slot's math is
-row-for-row identical to the unpipelined engine, so greedy output is
-bit-exact (tests/test_pp_pipeline.py).
+attn_pattern / first_k_dense / moe_every; int8 scale stacks ride the
+same stage split). Each slot's math is row-for-row identical to the
+unpipelined engine, so greedy output is bit-exact
+(tests/test_pp_pipeline.py).
 
 The reference repo for this project is empty (SURVEY.md §0); there is
 no upstream pipelined-decoding implementation to cite. The schedule is
@@ -127,19 +128,26 @@ def stage_apply(
     mesh,
     attn_impl: str,
     stage_params,  # pytree, leaves (pp, Lp, ...)
-    ck_st,  # (pp, Lp, B, Hkv, len, Dh)
-    cv_st,
+    cache_st,  # tuple of stage-split cache stacks, batch at axis 2:
+               # (k, v) bf16 — (pp, Lp, B, Hkv, len, Dh) — or
+               # (k, v, ks, vs) int8, scale stacks (pp, Lp, B, Hkv, len)
     stage_x,  # (pp, G, 1, D)
     stage_pos,  # (pp, G) int32 — this token's write position
     stage_gstart,  # (pp,) int32 — first slot of the group each stage holds
 ):
     """One pipelined microtick: every stage runs its layer block on the
-    group it holds. Returns (outputs (pp, G, 1, D), ck_st, cv_st)."""
+    group it holds. Returns (outputs (pp, G, 1, D), cache_st). With
+    int8 stacks the per-layer scales thread into _block exactly as the
+    unpipelined quant scan does, so quantize-at-write stays per-row
+    identical."""
     G = stage_x.shape[1]
+    quant = len(cache_st) == 4
 
-    def one_stage(sp, ck, cv, x, pos, gstart):
-        ck_g = jax.lax.dynamic_slice_in_dim(ck, gstart, G, axis=1)
-        cv_g = jax.lax.dynamic_slice_in_dim(cv, gstart, G, axis=1)
+    def one_stage(sp, blocks, x, pos, gstart):
+        slices = tuple(
+            jax.lax.dynamic_slice_in_dim(b, gstart, G, axis=1)
+            for b in blocks
+        )
         positions = pos[:, None]
         cos, sin = rope_angles(
             positions, cfg.rope_dim, cfg.rope_theta,
@@ -148,20 +156,24 @@ def stage_apply(
         )
 
         def body(xx, layer_in):
-            lp, k1, v1 = layer_in
+            lp = layer_in[0]
+            vals = layer_in[1:]
             xx, nc, _ = _block(
                 cfg, mesh, attn_impl, xx, lp, cos, sin,
-                cache=(k1, v1, pos, positions),
+                cache=(vals[0], vals[1], pos, positions),
+                kv_scales=(vals[2], vals[3]) if quant else None,
             )
             return xx, nc
 
-        x, (nk, nv) = jax.lax.scan(body, x, (sp, ck_g, cv_g))
-        ck = jax.lax.dynamic_update_slice_in_dim(ck, nk, gstart, axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(cv, nv, gstart, axis=1)
-        return x, ck, cv
+        x, news = jax.lax.scan(body, x, (sp,) + slices)
+        blocks = tuple(
+            jax.lax.dynamic_update_slice_in_dim(b, n, gstart, axis=1)
+            for b, n in zip(blocks, news)
+        )
+        return x, blocks
 
     return jax.vmap(one_stage)(
-        stage_params, ck_st, cv_st, stage_x, stage_pos, stage_gstart
+        stage_params, cache_st, stage_x, stage_pos, stage_gstart
     )
 
 
@@ -186,10 +198,11 @@ def validate_pp_pipeline(cfg: ModelConfig, mesh, n_slots: int,
             "pp_pipeline is a dense-cache feature; the paged engine's "
             "block pools do not reshape into per-stage registers yet"
         )
-    if kv_quant is not None or rolling:
+    if rolling:
         raise ValueError(
-            "pp_pipeline composes with the dense bf16 cache only for "
-            "now (kv_quant/rolling_window must be off)"
+            "pp_pipeline does not compose with rolling_window yet "
+            "(ring wrap positions would need per-stage tracking); the "
+            "dense bf16 and int8 caches both work"
         )
     if (cfg.attn_pattern is not None or first_k_layout(cfg)
             or grouped_moe(cfg)):

@@ -233,15 +233,28 @@ class TestBeamSearch:
             eng.beam_search(list(range(1, 9)), num_beams=8,
                             max_new_tokens=32)
 
-    def test_paged_beam_int8_guard(self, model):
+    def test_paged_int8_matches_dense_int8_beam(self, model):
+        """int8 pools compose: the CoW copy moves the scale pools in
+        lockstep with the value pools, so paged int8 beams equal the
+        dense int8-cache beam exactly."""
         from shellac_tpu.inference.batching import PagedBatchingEngine
 
         cfg, params = model
-        eng = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
-                                  block_size=32, kv_quant="int8",
-                                  temperature=0.0)
-        with pytest.raises(NotImplementedError, match="int8"):
-            eng.beam_search([1, 2, 3], num_beams=2, max_new_tokens=4)
+        dense = Engine(cfg, params, temperature=0.0, max_len=64,
+                       kv_quant="int8")
+        paged = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                    block_size=32, kv_quant="int8",
+                                    pool_tokens=1024, temperature=0.0)
+        for prompt, k, steps in (
+            ([7, 23, 5], 3, 6),        # within one block
+            ([1, 2], 2, 34),           # crosses a block boundary
+        ):
+            want = dense.beam_search(prompt, num_beams=k,
+                                     max_new_tokens=steps)
+            got = paged.beam_search(prompt, num_beams=k,
+                                    max_new_tokens=steps)
+            assert got[0] == want[0], (prompt, k, steps)
+            np.testing.assert_allclose(got[1], want[1], rtol=1e-5)
 
     def test_int8_cache_composes(self, model):
         """Beam search over the int8 cache: correct shape/ordering and

@@ -209,6 +209,23 @@ class TestPipelinedParityExtras:
         assert digits.decode().isdigit()
 
 
+class TestInt8Pipelined:
+    def test_int8_greedy_bit_exact(self, setup):
+        """int8 KV composes with the pipelined decode: the scale
+        stacks stage-split with the value stacks, so quantize-at-write
+        is per-row identical to the unpipelined int8 engine."""
+        cfg, params, sharded, mesh = setup
+        reqs = _reqs(cfg, lens=(5, 9, 3, 7), max_new=7)
+        want = BatchingEngine(cfg, params, n_slots=4, max_len=64,
+                              temperature=0.0, kv_quant="int8",
+                              decode_ticks=2).run(reqs)
+        got = BatchingEngine(cfg, sharded, n_slots=4, max_len=64,
+                             temperature=0.0, kv_quant="int8",
+                             decode_ticks=2, mesh=mesh,
+                             pp_pipeline=True).run(reqs)
+        assert got == want
+
+
 class TestGuards:
     def test_requires_pp_mesh(self, setup):
         cfg, params, _, _ = setup
@@ -225,11 +242,15 @@ class TestGuards:
             BatchingEngine(cfg, sharded, n_slots=3, mesh=mesh,
                            pp_pipeline=True)
 
-    def test_rejects_quant_and_rolling(self, setup):
-        cfg, _, sharded, mesh = setup
-        with pytest.raises(ValueError, match="dense bf16"):
-            BatchingEngine(cfg, sharded, n_slots=4, mesh=mesh,
-                           pp_pipeline=True, kv_quant="int8")
+    def test_rejects_rolling(self, setup):
+        cfg, _, _, mesh = setup
+        wcfg = cfg.replace(attn_window=16)
+        from shellac_tpu.models import transformer as tr
+
+        params = tr.init_params(wcfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="rolling"):
+            BatchingEngine(wcfg, params, n_slots=4, mesh=mesh,
+                           pp_pipeline=True, rolling_window=True)
 
     def test_rejects_paged(self, setup):
         from shellac_tpu.inference.batching import PagedBatchingEngine
