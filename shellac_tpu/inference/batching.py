@@ -1908,14 +1908,12 @@ class PagedBatchingEngine(BatchingEngine):
         prefix-cache blocks when the free list is dry) and return on
         completion, so beam searches and live requests share the pool;
         engine slots' tables/lengths are untouched. int8 pools
-        compose: the CoW copy moves the scale pools in lockstep with
-        the value pools (same block ids), so quantized beams equal
-        the dense int8-cache beam exactly.
+        compose (the CoW copy moves the scale pools in lockstep with
+        the value pools — same block ids), and so do MLA latent-row
+        pools (the latent block copies like any value block; the v
+        pool is zero-width): both are bit-identical to their
+        dense-cache beams.
         """
-        if self.cfg.mla is not None:
-            raise NotImplementedError(
-                "beam_search over paged MLA latent pools is not wired"
-            )
         k_beams = int(num_beams)
         steps = int(max_new_tokens)
         if k_beams < 1:

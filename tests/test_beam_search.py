@@ -233,6 +233,26 @@ class TestBeamSearch:
             eng.beam_search(list(range(1, 9)), num_beams=8,
                             max_new_tokens=32)
 
+    def test_paged_mla_matches_dense_mla_beam(self):
+        """MLA latent-row pools compose: the CoW copy moves latent
+        blocks like any value block (v pool is zero-width), so paged
+        MLA beams equal the dense MLA beam exactly."""
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        mcfg = get_model_config("tiny-mla").replace(dtype="float32")
+        params = transformer.init_params(mcfg, jax.random.PRNGKey(0))
+        dense = Engine(mcfg, params, temperature=0.0, max_len=64)
+        paged = PagedBatchingEngine(mcfg, params, n_slots=2, max_len=64,
+                                    block_size=4, pool_tokens=1024,
+                                    temperature=0.0)
+        for prompt, k, steps in (([3, 5, 7], 3, 9), ([1, 2], 2, 12)):
+            want = dense.beam_search(prompt, num_beams=k,
+                                     max_new_tokens=steps)
+            got = paged.beam_search(prompt, num_beams=k,
+                                    max_new_tokens=steps)
+            assert got[0] == want[0], (prompt, k, steps)
+            np.testing.assert_allclose(got[1], want[1], rtol=1e-5)
+
     def test_paged_int8_matches_dense_int8_beam(self, model):
         """int8 pools compose: the CoW copy moves the scale pools in
         lockstep with the value pools, so paged int8 beams equal the
