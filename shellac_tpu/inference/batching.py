@@ -1596,12 +1596,13 @@ class PagedBatchingEngine(BatchingEngine):
             out.append(h)
         return out
 
-    def _match_prefix(self, req) -> Tuple[List[bytes], int]:
-        """Longest cached block chain covering a strict prompt prefix."""
-        hashes = self._chain_hashes(req.tokens)
+    def _match_prefix(self, tokens: np.ndarray) -> Tuple[List[bytes], int]:
+        """Longest cached block chain covering a strict prompt prefix
+        (shared by slot admission and beam search)."""
+        hashes = self._chain_hashes(tokens)
         # Cap: at least one prompt token must be computed (its logits
         # seed sampling; full-match reuse would leave none).
-        cap = (req.tokens.size - 1) // self.block_size
+        cap = (tokens.size - 1) // self.block_size
         m = 0
         for h in hashes[:cap]:
             if h not in self._hash_to_block:
@@ -1621,7 +1622,7 @@ class PagedBatchingEngine(BatchingEngine):
                 raise _PoolExhausted()
             return
 
-        hashes, m = self._match_prefix(req)
+        hashes, m = self._match_prefix(req.tokens)
         matched = [self._hash_to_block[h] for h in hashes[:m]]
         for h, blk in zip(hashes[:m], matched):
             self._block_ref[blk] += 1
@@ -1894,6 +1895,9 @@ class PagedBatchingEngine(BatchingEngine):
           - the prompt prefills ONCE into ceil(s/bs) borrowed blocks
             that every beam's table shares READ-ONLY — prompt blocks
             are never written after prefill, so sharing them is free;
+            with prefix_cache=True, a cached block chain covering a
+            prompt prefix attaches read-only instead (refcounted for
+            the search) and only the unmatched suffix is computed;
           - each beam owns one statically-assigned pool block per
             generated logical block (beams advance in lockstep, so
             block boundaries are crossed together and the assignment
@@ -1934,36 +1938,66 @@ class PagedBatchingEngine(BatchingEngine):
         # additionally targets the NEXT write position, up to
         # s+steps-1.
         n_gen = 0 if steps == 1 else ((s + steps - 1) // bs - lb0 + 1)
-        prompt_n = -(-s // bs)
+        # Prefix caching composes: a cached block chain covering a
+        # strict prompt prefix attaches READ-ONLY (refcounted for the
+        # search's duration, exactly like a slot attach) and only the
+        # unmatched suffix is computed. The match cap leaves >= 1
+        # suffix token so the last-token logits exist, which also
+        # keeps the beams' CoW tail block a borrowed one.
+        matched: List[int] = []
+        match_hashes: List[bytes] = []
+        if self.prefix_cache:
+            match_hashes, m = self._match_prefix(toks)
+            matched = [self._hash_to_block[h] for h in match_hashes[:m]]
+            for h, blk in zip(match_hashes[:m], matched):
+                self._block_ref[blk] += 1
+                self._hash_to_block.move_to_end(h)  # LRU touch
+        m_tokens = len(matched) * bs
+        prompt_n = -(-s // bs) - len(matched)
         need = prompt_n + k_beams * n_gen
         if need > len(self._free) + self._evictable():
+            for blk in matched:
+                self._block_ref[blk] -= 1
             raise RuntimeError(
                 f"paged pool exhausted: beam search needs {need} "
                 f"blocks ({prompt_n} prompt + {k_beams}x{n_gen} "
                 f"owned tails); free {len(self._free)} + evictable "
                 f"{self._evictable()}"
             )
+        if self.prefix_cache:
+            # Counted only once the attach is certain, matching the
+            # slot path's hit-rate accounting under pool pressure.
+            self.stats["prefix_hit_tokens"] += m_tokens
+            self.stats["prefix_query_tokens"] += s
         borrowed = [self._alloc_block() for _ in range(need)]
         try:
-            prompt_ids = borrowed[:prompt_n]
+            prompt_ids = matched + borrowed[:prompt_n]
             gen_ids = np.asarray(
                 borrowed[prompt_n:], np.int32
             ).reshape(n_gen, k_beams)
             mb = self._cache.max_blocks
             row = np.zeros((mb,), np.int32)
-            row[:prompt_n] = prompt_ids
+            row[:len(prompt_ids)] = prompt_ids
             tables0 = np.tile(row, (k_beams, 1))
-            s_pad = _bucket(s)
+            # Only the suffix past the matched prefix is computed. The
+            # pad caps at the table space past the prefix: unclamped
+            # pads would gather-clamp onto the row's LAST entry and,
+            # when the prompt fills the whole table, cycle garbage
+            # into real just-written positions (same hazard
+            # _run_prefill's cap guards).
+            s_suf = s - m_tokens
+            s_pad = min(_bucket(s_suf), self.max_len - m_tokens)
             tokens_pad = np.zeros((1, s_pad), np.int32)
-            tokens_pad[0, :s] = toks
+            tokens_pad[0, :s_suf] = toks[m_tokens:]
             jit_key = (s_pad, k_beams, steps, eos_id,
-                       float(length_penalty), n_gen)
+                       float(length_penalty), n_gen, m_tokens > 0)
             pool_fields = kv_field_names(self.kv_quant)
             fn = self._beam_jit.get(jit_key)
             if fn is None:
                 impl = functools.partial(
                     self._beam_paged_impl, steps=steps, eos_id=eos_id,
                     length_penalty=float(length_penalty),
+                    has_prefix=m_tokens > 0,
                 )
                 jit_kw = {}
                 if self._cache_sh is not None:
@@ -1979,6 +2013,8 @@ class PagedBatchingEngine(BatchingEngine):
                 tuple(getattr(self._cache, f) for f in pool_fields),
                 jnp.asarray(tokens_pad),
                 jnp.full((1,), s, jnp.int32),
+                jnp.full((1,), s_suf, jnp.int32),
+                jnp.full((1,), m_tokens, jnp.int32),
                 jnp.asarray(tables0), jnp.asarray(gen_ids),
                 jnp.int32(lb0),
             )
@@ -1988,12 +2024,15 @@ class PagedBatchingEngine(BatchingEngine):
             out, norm, lens = jax.device_get((out, norm, lens))
         finally:
             self._free.extend(borrowed)
+            for blk in matched:
+                self._block_ref[blk] -= 1
         seqs = [r[:n].tolist() for r, n in zip(out, lens)]
         return seqs, [float(x) for x in norm]
 
     def _beam_paged_impl(self, params, pools, tokens, prompt_len,
-                         tables0, gen_ids, lb0, *, steps, eos_id,
-                         length_penalty):
+                         suffix_len, prefix_len, tables0, gen_ids, lb0,
+                         *, steps, eos_id, length_penalty,
+                         has_prefix=False):
         """Device side of beam_search: prefill once through the shared
         prompt table row, then the dense beam loop with table-gather
         reordering + CoW tail copies instead of cache-row gathers.
@@ -2001,12 +2040,19 @@ class PagedBatchingEngine(BatchingEngine):
         `pools` is (k, v) for bf16 pools or (k, v, ks, vs) for int8
         pools — every array has the block axis at dim 1, so the CoW
         copy and prefill scatter treat them uniformly and the scale
-        pools stay in lockstep with the values by construction."""
+        pools stay in lockstep with the values by construction.
+
+        has_prefix: a cached block chain covers the first prefix_len
+        prompt tokens read-only; `tokens` holds only the suffix, which
+        forwards as a continuation through the table view (the same
+        idiom as _prefix_prefill_impl) and attends to the cached
+        prefix KV."""
         cfg = self.cfg
         quant = len(pools) == 4
         k_beams, _ = tables0.shape
         bs = pools[0].shape[3]
         ak = jnp.arange(k_beams)
+        mini_fields = kv_field_names(self.kv_quant)
 
         def make_cache(pools, tables, lengths):
             if quant:
@@ -2017,34 +2063,52 @@ class PagedBatchingEngine(BatchingEngine):
             return PagedKVCache(k=pools[0], v=pools[1], tables=tables,
                                 lengths=lengths)
 
-        # Prompt prefill: mini of the pool's kind once, scattered
-        # through the shared prompt blocks (same math as the engine's
-        # paged prefill). Pad positions write garbage at tail offsets
-        # >= s%bs — overwritten by the beams' own tokens before any
-        # read reaches them.
         s_pad = tokens.shape[1]
-        mini = init_cache_for(cfg, 1, s_pad, self.kv_quant)
-        logits, mini = transformer.forward_with_cache(
-            cfg, params, tokens, mini, new_tokens_len=prompt_len,
-            fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
-        )
-        last = jnp.take_along_axis(
-            logits, (prompt_len - 1)[:, None, None].astype(jnp.int32),
-            axis=1,
-        )[0, 0]
-        pos = jnp.arange(s_pad, dtype=jnp.int32)
-        blocks = jnp.take(tables0[0], pos // bs)
-        offs = pos % bs
-        mini_fields = kv_field_names(self.kv_quant)
-        scattered = []
-        for pool, f in zip(pools, mini_fields):
-            src = getattr(mini, f)[:, 0].astype(pool.dtype)
-            # Value pools are (L, nb, H, bs, Dh), scale pools
-            # (L, nb, H, bs): token rows lead after the transpose.
-            src = (src.transpose(2, 0, 1, 3) if src.ndim == 4
-                   else src.transpose(2, 0, 1))
-            scattered.append(pool.at[:, blocks, :, offs].set(src))
-        pools = tuple(scattered)
+        if has_prefix:
+            # Suffix continuation through the pool view: writes land
+            # in the borrowed prompt blocks past the cached prefix,
+            # which stays read-only upstream of every written position.
+            view = make_cache(pools, tables0[:1],
+                              prefix_len.astype(jnp.int32))
+            logits, view = transformer.forward_with_cache(
+                cfg, params, tokens, view, new_tokens_len=suffix_len,
+                fresh_cache=False, attn_impl="ref", mesh=self.mesh,
+            )
+            pools = tuple(getattr(view, f) for f in mini_fields)
+            last = jnp.take_along_axis(
+                logits,
+                (suffix_len - 1)[:, None, None].astype(jnp.int32),
+                axis=1,
+            )[0, 0]
+        else:
+            # Whole-prompt prefill: mini of the pool's kind once,
+            # scattered through the shared prompt blocks (same math as
+            # the engine's paged prefill). Pad positions write garbage
+            # at tail offsets >= s%bs — overwritten by the beams' own
+            # tokens before any read reaches them.
+            mini = init_cache_for(cfg, 1, s_pad, self.kv_quant)
+            logits, mini = transformer.forward_with_cache(
+                cfg, params, tokens, mini, new_tokens_len=prompt_len,
+                fresh_cache=True, attn_impl=self.attn_impl,
+                mesh=self.mesh,
+            )
+            last = jnp.take_along_axis(
+                logits,
+                (prompt_len - 1)[:, None, None].astype(jnp.int32),
+                axis=1,
+            )[0, 0]
+            pos = jnp.arange(s_pad, dtype=jnp.int32)
+            blocks = jnp.take(tables0[0], pos // bs)
+            offs = pos % bs
+            scattered = []
+            for pool, f in zip(pools, mini_fields):
+                src = getattr(mini, f)[:, 0].astype(pool.dtype)
+                # Value pools are (L, nb, H, bs, Dh), scale pools
+                # (L, nb, H, bs): token rows lead after the transpose.
+                src = (src.transpose(2, 0, 1, 3) if src.ndim == 4
+                       else src.transpose(2, 0, 1))
+                scattered.append(pool.at[:, blocks, :, offs].set(src))
+            pools = tuple(scattered)
 
         from shellac_tpu.inference.engine import (
             beam_expand,

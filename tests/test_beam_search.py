@@ -222,6 +222,54 @@ class TestBeamSearch:
         # Everything borrowed came back (slots freed theirs on finish).
         assert len(eng._free) + eng._evictable() == free_before
 
+    def test_paged_beam_reuses_prefix_cache(self, model):
+        """A beam prompt sharing a cached prefix attaches the cached
+        blocks read-only and computes only the suffix — beams stay
+        bit-identical to the dense beam, prefix_hit_tokens counts the
+        reuse, and the cached blocks' refcounts are restored."""
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg, params = model
+        dense = Engine(cfg, params, temperature=0.0, max_len=64)
+        eng = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  block_size=4, pool_tokens=1024,
+                                  temperature=0.0, prefix_cache=True)
+        rng = np.random.default_rng(21)
+        prefix = rng.integers(1, cfg.vocab_size, size=12).tolist()
+        # Seed the cache: one request whose prompt IS the prefix
+        # (plus a tail so full blocks register).
+        eng.run([("seed", prefix + [5, 7], 4)])
+        assert eng._hash_to_block, "prefix blocks should be registered"
+        refs_before = dict(eng._block_ref)
+
+        prompt = prefix + [9, 11, 13]
+        hits0 = eng.stats["prefix_hit_tokens"]
+        want = dense.beam_search(prompt, num_beams=3, max_new_tokens=6)
+        got = eng.beam_search(prompt, num_beams=3, max_new_tokens=6)
+        assert got[0] == want[0]
+        np.testing.assert_allclose(got[1], want[1], rtol=1e-5)
+        assert eng.stats["prefix_hit_tokens"] - hits0 >= 12 // 4 * 4
+        assert eng._block_ref == refs_before  # attach fully released
+
+    def test_paged_beam_prompt_fills_whole_table(self, model):
+        """Prompt long enough that its pad bucket exceeds max_len AND
+        its blocks fill the whole table row: unclamped pad writes
+        would gather-clamp onto the last real block and corrupt
+        just-written prompt KV (the pad cap guards this)."""
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg, params = model
+        dense = Engine(cfg, params, temperature=0.0, max_len=96)
+        paged = PagedBatchingEngine(cfg, params, n_slots=2, max_len=96,
+                                    block_size=4, pool_tokens=2048,
+                                    temperature=0.0)
+        rng = np.random.default_rng(31)
+        prompt = rng.integers(1, cfg.vocab_size, size=93).tolist()
+        want = dense.beam_search(prompt, num_beams=2, max_new_tokens=2)
+        got = paged.beam_search(prompt, num_beams=2, max_new_tokens=2)
+        assert got[0] == want[0]
+        np.testing.assert_allclose(got[1], want[1], rtol=1e-5)
+
     def test_paged_beam_pool_exhaustion_is_loud(self, model):
         from shellac_tpu.inference.batching import PagedBatchingEngine
 
