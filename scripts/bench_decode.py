@@ -273,6 +273,46 @@ def prefix_bench(cfg, params, *, n_slots, ctx, max_len, rng):
     return out
 
 
+def beam_bench(cfg, params, *, ctx, max_len, rng, num_beams=4,
+               steps=32):
+    """Dense row-gather beams vs paged CoW beams on ONE long prompt.
+
+    The dense beam gathers EVERY cache row per reorder (O(ctx) copies
+    per step at long context); the paged beam copies one partial tail
+    block per beam and shares everything sealed — the ratio is the
+    CoW payoff. Outputs must agree exactly (compiled parity evidence
+    rides the bench)."""
+    from shellac_tpu.inference.batching import PagedBatchingEngine
+    from shellac_tpu.inference.engine import Engine
+
+    prompt = rng.integers(
+        0, cfg.vocab_size, size=ctx, dtype=np.int64
+    ).tolist()
+    dense = Engine(cfg, params, temperature=0.0, max_len=max_len)
+    paged = PagedBatchingEngine(
+        cfg, params, n_slots=2, max_len=max_len, block_size=64,
+        pool_tokens=4 * max_len, temperature=0.0,
+    )
+    runs = {
+        "dense": lambda: dense.beam_search(
+            prompt, num_beams=num_beams, max_new_tokens=steps
+        ),
+        "paged": lambda: paged.beam_search(
+            prompt, num_beams=num_beams, max_new_tokens=steps
+        ),
+    }
+    out = {}
+    seqs = {}
+    for name, fn in runs.items():
+        fn()  # warm the compile cache outside the timed region
+        t0 = time.perf_counter()
+        s, _ = fn()
+        out[name] = time.perf_counter() - t0
+        seqs[name] = s
+    assert seqs["dense"] == seqs["paged"], "beam parity broke on-device"
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None, help="preset (default: auto)")
@@ -287,7 +327,7 @@ def main():
     ap.add_argument("--decode-ticks", type=int, default=1,
                     help="engine mode: decode steps per host sync")
     ap.add_argument("--mode", default="engine",
-                    choices=["engine", "kernel", "prefix"])
+                    choices=["engine", "kernel", "prefix", "beam"])
     ap.add_argument("--variants", default="dense:auto,dense:ref,paged:auto,paged:ref")
     ap.add_argument("--kv-quant", choices=["int8"],
                     help="int8 KV cache on the dense engine variants")
@@ -352,6 +392,26 @@ def main():
                 "drain_s_off": round(dt_off, 3),
                 "drain_s_on": round(dt_on, 3),
                 "prefix_hit_tokens": int(hits),
+            },
+        }), flush=True)
+        return
+
+    if args.mode == "beam":
+        rng = np.random.default_rng(0)
+        nb, st = 4, 32
+        res = beam_bench(
+            cfg, params, ctx=args.ctx, max_len=max_len, rng=rng,
+            num_beams=nb, steps=st,
+        )
+        print(json.dumps({
+            "metric": f"beam_paged_vs_dense_{args.model}_ctx{args.ctx}_"
+                      f"{backend}",
+            "value": round(res["dense"] / res["paged"], 3),
+            "unit": "x speedup (dense-gather beam / CoW paged beam)",
+            "detail": {
+                "dense_s": round(res["dense"], 3),
+                "paged_s": round(res["paged"], 3),
+                "num_beams": nb, "steps": st,
             },
         }), flush=True)
         return

@@ -123,6 +123,7 @@ run engine_kvq_paged 580 python scripts/bench_decode.py \
   --variants paged:auto --decode-ticks 8 --kv-quant int8
 run engine_rolling 580 python scripts/bench_decode.py \
   --variants dense:auto,rolling:ref --window 1024 --decode-ticks 8
+run engine_beam 580 python scripts/bench_decode.py --mode beam
 
 # 5. Remat-policy sweep (each config its own process; OOM is
 #    informative). bench.py adopts the winner as its TPU recipe.

@@ -1610,6 +1610,24 @@ class PagedBatchingEngine(BatchingEngine):
             m += 1
         return hashes, m
 
+    def _attach_prefix(self, tokens: np.ndarray):
+        """Match + attach the longest cached chain READ-ONLY: bumps
+        refcounts and touches LRU order. Returns (hashes, matched
+        block ids). Callers own the hit-rate stats (count them only
+        once the attach is certain) and roll back a failed attach via
+        _detach_prefix — shared by slot admission and beam search so
+        the attach protocol cannot drift between them."""
+        hashes, m = self._match_prefix(tokens)
+        matched = [self._hash_to_block[h] for h in hashes[:m]]
+        for h, blk in zip(hashes[:m], matched):
+            self._block_ref[blk] += 1
+            self._hash_to_block.move_to_end(h)  # LRU touch
+        return hashes, matched
+
+    def _detach_prefix(self, matched) -> None:
+        for blk in matched:
+            self._block_ref[blk] -= 1
+
     def _prepare_slot(self, slot: int, req) -> None:
         # Reserve the FULL footprint (prompt + generation budget) at
         # admission: growth mid-decode could exhaust the pool and there
@@ -1622,11 +1640,8 @@ class PagedBatchingEngine(BatchingEngine):
                 raise _PoolExhausted()
             return
 
-        hashes, m = self._match_prefix(req.tokens)
-        matched = [self._hash_to_block[h] for h in hashes[:m]]
-        for h, blk in zip(hashes[:m], matched):
-            self._block_ref[blk] += 1
-            self._hash_to_block.move_to_end(h)  # LRU touch
+        hashes, matched = self._attach_prefix(req.tokens)
+        m = len(matched)
         if matched:
             self._slot_blocks[slot] = list(matched)
             tables = self._cache.tables.at[
@@ -1635,8 +1650,7 @@ class PagedBatchingEngine(BatchingEngine):
             self._cache = self._cache.replace(tables=tables)
         if not self._ensure_blocks(slot, need):
             # Roll back the attach (blocks stay cached) and requeue.
-            for blk in matched:
-                self._block_ref[blk] -= 1
+            self._detach_prefix(matched)
             self._slot_blocks[slot] = []
             row = jnp.zeros((self._cache.max_blocks,), jnp.int32)
             self._cache = self._cache.replace(
@@ -1945,24 +1959,19 @@ class PagedBatchingEngine(BatchingEngine):
         # suffix token so the last-token logits exist, which also
         # keeps the beams' CoW tail block a borrowed one.
         matched: List[int] = []
-        match_hashes: List[bytes] = []
         if self.prefix_cache:
-            match_hashes, m = self._match_prefix(toks)
-            matched = [self._hash_to_block[h] for h in match_hashes[:m]]
-            for h, blk in zip(match_hashes[:m], matched):
-                self._block_ref[blk] += 1
-                self._hash_to_block.move_to_end(h)  # LRU touch
+            _, matched = self._attach_prefix(toks)
         m_tokens = len(matched) * bs
         prompt_n = -(-s // bs) - len(matched)
         need = prompt_n + k_beams * n_gen
         if need > len(self._free) + self._evictable():
-            for blk in matched:
-                self._block_ref[blk] -= 1
+            self._detach_prefix(matched)
             raise RuntimeError(
                 f"paged pool exhausted: beam search needs {need} "
-                f"blocks ({prompt_n} prompt + {k_beams}x{n_gen} "
-                f"owned tails); free {len(self._free)} + evictable "
-                f"{self._evictable()}"
+                f"blocks ({prompt_n} suffix-prompt past "
+                f"{len(matched)} cached prefix blocks + "
+                f"{k_beams}x{n_gen} owned tails); free "
+                f"{len(self._free)} + evictable {self._evictable()}"
             )
         if self.prefix_cache:
             # Counted only once the attach is certain, matching the
@@ -2024,8 +2033,7 @@ class PagedBatchingEngine(BatchingEngine):
             out, norm, lens = jax.device_get((out, norm, lens))
         finally:
             self._free.extend(borrowed)
-            for blk in matched:
-                self._block_ref[blk] -= 1
+            self._detach_prefix(matched)
         seqs = [r[:n].tolist() for r, n in zip(out, lens)]
         return seqs, [float(x) for x in norm]
 
