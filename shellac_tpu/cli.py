@@ -743,12 +743,11 @@ def cmd_serve(args):
             "--draft-model; --kv-quant composes on both uniform-window "
             "and patterned models)"
         )
-    if args.pp_pipeline and (args.paged or args.draft_model
-                             or args.rolling_window):
+    if args.pp_pipeline and (args.paged or args.draft_model):
         raise SystemExit(
-            "--pp-pipeline composes with the dense caches (bf16 or "
-            "--kv-quant int8) only — no --paged, --draft-model, or "
-            "--rolling-window"
+            "--pp-pipeline composes with the slot caches (bf16, "
+            "--kv-quant int8, --rolling-window rings) only — no "
+            "--paged or --draft-model"
         )
     if args.pp_pipeline and not args.mesh:
         raise SystemExit("--pp-pipeline needs --mesh with pp>=2")
@@ -1157,7 +1156,8 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="pp_pipeline",
                    help="token-level pipelined decode on a pp mesh: "
                         "slot groups stagger across stages so no stage "
-                        "idles (dense cache; n_slots divisible by pp)")
+                        "idles (slot caches: bf16/int8/rolling; "
+                        "n_slots divisible by pp)")
     s.add_argument("--step-timeout", type=float, default=None,
                    dest="step_timeout",
                    help="fail the server loudly if one engine step "

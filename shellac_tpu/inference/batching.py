@@ -207,8 +207,7 @@ class BatchingEngine:
             )
 
             self._pp = validate_pp_pipeline(
-                cfg, mesh, n_slots, kv_quant, rolling_window,
-                self._swaps_cache,
+                cfg, mesh, n_slots, kv_quant, self._swaps_cache,
             )
         self.decode_ticks = decode_ticks
         # Cap prefills per engine step: a burst of queued prompts would
@@ -645,6 +644,7 @@ class BatchingEngine:
             outs, cache_st = ppl.stage_apply(
                 self.cfg, self.mesh, self.attn_impl, sp,
                 cache_st, stage_x, stage_pos, stage_gstart,
+                rolled=self.rolling_window,
             )
             outs = ppl.constrain_register(outs, self.mesh)
             stage_x = outs

@@ -27,12 +27,13 @@ pp*K stage-sequential units for the unpipelined tick — and each
 microtick runs all stages in parallel, so wall-clock per window
 approaches (K + 1) stage-times instead of pp*K.
 
-Scope: dense bf16 and int8 caches over uniform layer stacks (the
-forward_with_cache `else` branch — dense or uniformly-MoE models, no
-attn_pattern / first_k_dense / moe_every; int8 scale stacks ride the
-same stage split). Each slot's math is row-for-row identical to the
-unpipelined engine, so greedy output is bit-exact
-(tests/test_pp_pipeline.py).
+Scope: dense bf16, int8, and rolling-ring caches over uniform layer
+stacks (the forward_with_cache `else` branch — dense or uniformly-MoE
+models, no attn_pattern / first_k_dense / moe_every; int8 scale
+stacks ride the same stage split; ring wrap stays bit-exact because
+stale one-ahead writes alias only positions outside every window).
+Each slot's math is row-for-row identical to the unpipelined engine,
+so greedy output is bit-exact (tests/test_pp_pipeline.py).
 
 The reference repo for this project is empty (SURVEY.md §0); there is
 no upstream pipelined-decoding implementation to cite. The schedule is
@@ -134,12 +135,18 @@ def stage_apply(
     stage_x,  # (pp, G, 1, D)
     stage_pos,  # (pp, G) int32 — this token's write position
     stage_gstart,  # (pp,) int32 — first slot of the group each stage holds
+    rolled: bool = False,
 ):
     """One pipelined microtick: every stage runs its layer block on the
     group it holds. Returns (outputs (pp, G, 1, D), cache_st). With
     int8 stacks the per-layer scales thread into _block exactly as the
     unpipelined quant scan does, so quantize-at-write stays per-row
-    identical."""
+    identical. rolled=True threads ring-buffer semantics (position p
+    writes slot p mod ring); the drain-tail and warmup stale writes
+    land one position AHEAD of the final lengths, whose ring slot
+    aliases a position already outside every attention window (ring
+    >= window + slack), so the dense self-healing argument holds on
+    the ring too."""
     G = stage_x.shape[1]
     quant = len(cache_st) == 4
 
@@ -162,6 +169,7 @@ def stage_apply(
                 cfg, mesh, attn_impl, xx, lp, cos, sin,
                 cache=(vals[0], vals[1], pos, positions),
                 kv_scales=(vals[2], vals[3]) if quant else None,
+                rolled=rolled,
             )
             return xx, nc
 
@@ -182,7 +190,7 @@ def constrain_register(x, mesh):
 
 
 def validate_pp_pipeline(cfg: ModelConfig, mesh, n_slots: int,
-                         kv_quant: Optional[str], rolling: bool,
+                         kv_quant: Optional[str],
                          swaps_cache: bool) -> int:
     """Checks the pp_pipeline=True configuration; returns pp."""
     from shellac_tpu.models.transformer import first_k_layout, grouped_moe
@@ -197,12 +205,6 @@ def validate_pp_pipeline(cfg: ModelConfig, mesh, n_slots: int,
         raise ValueError(
             "pp_pipeline is a dense-cache feature; the paged engine's "
             "block pools do not reshape into per-stage registers yet"
-        )
-    if rolling:
-        raise ValueError(
-            "pp_pipeline does not compose with rolling_window yet "
-            "(ring wrap positions would need per-stage tracking); the "
-            "dense bf16 and int8 caches both work"
         )
     if (cfg.attn_pattern is not None or first_k_layout(cfg)
             or grouped_moe(cfg)):

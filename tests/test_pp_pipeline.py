@@ -242,15 +242,27 @@ class TestGuards:
             BatchingEngine(cfg, sharded, n_slots=3, mesh=mesh,
                            pp_pipeline=True)
 
-    def test_rejects_rolling(self, setup):
-        cfg, _, _, mesh = setup
-        wcfg = cfg.replace(attn_window=16)
+    def test_rolling_ring_bit_exact_through_wrap(self, setup):
+        """Rolling ring caches compose: the pipelined drain's
+        one-ahead stale writes alias only positions already outside
+        every window (ring >= window + slack), so greedy output stays
+        bit-exact through ring wrap."""
         from shellac_tpu.models import transformer as tr
 
-        params = tr.init_params(wcfg, jax.random.PRNGKey(0))
-        with pytest.raises(ValueError, match="rolling"):
-            BatchingEngine(wcfg, params, n_slots=4, mesh=mesh,
-                           pp_pipeline=True, rolling_window=True)
+        _, _, _, mesh = setup
+        wcfg = _cfg().replace(attn_window=12)
+        params = tr.init_params(wcfg, jax.random.PRNGKey(2))
+        sharded = shard_params(wcfg, params, mesh)
+        # Long enough generations that positions wrap the ring.
+        reqs = _reqs(wcfg, lens=(5, 9, 3, 7), max_new=24)
+        want = BatchingEngine(wcfg, params, n_slots=4, max_len=64,
+                              temperature=0.0, rolling_window=True,
+                              decode_ticks=3).run(reqs)
+        got = BatchingEngine(wcfg, sharded, n_slots=4, max_len=64,
+                             temperature=0.0, rolling_window=True,
+                             decode_ticks=3, mesh=mesh,
+                             pp_pipeline=True).run(reqs)
+        assert got == want
 
     def test_rejects_paged(self, setup):
         from shellac_tpu.inference.batching import PagedBatchingEngine
