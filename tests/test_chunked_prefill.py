@@ -185,12 +185,16 @@ class TestValidation:
         with pytest.raises(ValueError, match="prefill_chunk"):
             BatchingEngine(cfg, params, prefill_chunk=0)
 
-    def test_spec_engine_rejects_chunking(self, setup):
+    def test_spec_engine_accepts_chunking(self, setup):
+        # Round 5 lifted the exclusion: the draft cache chunks
+        # alongside the target's. Full parity coverage lives in
+        # tests/test_spec_batching.py::TestChunkedPrefill; this pins
+        # the constructor accepting the flag.
         from shellac_tpu.inference.spec_batching import (
             SpeculativeBatchingEngine,
         )
 
         cfg, params = setup
-        with pytest.raises(ValueError, match="chunked prefill"):
-            SpeculativeBatchingEngine(cfg, params, cfg, params,
-                                      prefill_chunk=16)
+        eng = SpeculativeBatchingEngine(cfg, params, cfg, params,
+                                        prefill_chunk=16)
+        assert eng.prefill_chunk == 16

@@ -383,9 +383,11 @@ class TestTopLogprobs:
             BatchingEngine(cfg, params, top_logprobs=3)
         with pytest.raises(ValueError, match="top_logprobs"):
             BatchingEngine(cfg, params, logprobs=True, top_logprobs=64)
-        with pytest.raises(ValueError, match="speculative"):
-            SpeculativeBatchingEngine(cfg, params, cfg, params,
-                                      logprobs=True, top_logprobs=2)
+        # Round 5 lifted the speculative exclusion: alternatives ride
+        # the verify pass (parity coverage in test_spec_batching).
+        eng = SpeculativeBatchingEngine(cfg, params, cfg, params,
+                                        logprobs=True, top_logprobs=2)
+        assert eng.top_logprobs == 2
 
     def test_http_and_openai(self):
         import json as _json
