@@ -77,7 +77,8 @@ class TestPpServing:
         wq_spec = sharded["layers"]["wq"].sharding.spec
         assert wq_spec[0] == "pp", wq_spec
 
-    def test_http_server_on_pp_mesh(self, setup):
+    @pytest.mark.parametrize("pp_pipeline", [False, True])
+    def test_http_server_on_pp_mesh(self, setup, pp_pipeline):
         cfg, params, sharded, mesh = setup
         from shellac_tpu.inference.server import (
             InferenceServer,
@@ -85,7 +86,8 @@ class TestPpServing:
         )
 
         eng = BatchingEngine(cfg, sharded, n_slots=2, max_len=64,
-                             temperature=0.0, mesh=mesh)
+                             temperature=0.0, mesh=mesh,
+                             pp_pipeline=pp_pipeline)
         srv = InferenceServer(cfg, sharded, engine=eng)
         httpd = make_http_server(srv)
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
