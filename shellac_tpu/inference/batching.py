@@ -207,7 +207,8 @@ class BatchingEngine:
             )
 
             self._pp = validate_pp_pipeline(
-                cfg, mesh, n_slots, kv_quant, self._swaps_cache,
+                cfg, mesh, n_slots, kv_quant, rolling_window,
+                self._swaps_cache,
             )
         self.decode_ticks = decode_ticks
         # Cap prefills per engine step: a burst of queued prompts would

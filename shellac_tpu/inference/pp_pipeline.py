@@ -28,12 +28,15 @@ microtick runs all stages in parallel, so wall-clock per window
 approaches (K + 1) stage-times instead of pp*K.
 
 Scope: dense bf16, int8, and rolling-ring caches over uniform layer
-stacks (the forward_with_cache `else` branch — dense or uniformly-MoE
-models, no attn_pattern / first_k_dense / moe_every; int8 scale
-stacks ride the same stage split; ring wrap stays bit-exact because
-stale one-ahead writes alias only positions outside every window).
-Each slot's math is row-for-row identical to the unpipelined engine,
-so greedy output is bit-exact (tests/test_pp_pipeline.py).
+stacks, plus patterned stacks (Gemma-2/3, GPT-OSS) over the dense
+caches — each stage holds whole pattern periods and the kinds unroll
+inside the stage scan with dual rope. Excluded: first_k_dense /
+moe_every layouts, paged pools, and the mixed PatternedKVCache
+(patterned + rolling). int8 scale stacks ride the same stage split;
+ring wrap stays bit-exact because stale one-ahead writes alias only
+positions outside every window. Each slot's math is row-for-row
+identical to the unpipelined engine, so greedy output is bit-exact
+(tests/test_pp_pipeline.py).
 
 The reference repo for this project is empty (SURVEY.md §0); there is
 no upstream pipelined-decoding implementation to cite. The schedule is
@@ -53,6 +56,7 @@ from shellac_tpu.config import ModelConfig
 from shellac_tpu.models.transformer import (
     _block,
     _embed_tokens,
+    pattern_period_scan,
     rope_angles,
     unembed,
 )
@@ -149,6 +153,7 @@ def stage_apply(
     the ring too."""
     G = stage_x.shape[1]
     quant = len(cache_st) == 4
+    pattern = cfg.attn_pattern
 
     def one_stage(sp, blocks, x, pos, gstart):
         slices = tuple(
@@ -161,19 +166,39 @@ def stage_apply(
             yarn=cfg.rope_yarn, llama3=cfg.rope_llama3,
             linear=cfg.rope_linear,
         )
+        if cfg.rope_local_theta is not None:
+            # Dual rope (Gemma-3): window layers use the local theta.
+            cos_l, sin_l = rope_angles(
+                positions, cfg.rope_dim, cfg.rope_local_theta
+            )
+        else:
+            cos_l = sin_l = None
 
-        def body(xx, layer_in):
-            lp = layer_in[0]
-            vals = layer_in[1:]
+        def run_one(xx, lp, vals, kind):
+            local = cos_l is not None and kind == "window"
             xx, nc, _ = _block(
-                cfg, mesh, attn_impl, xx, lp, cos, sin,
+                cfg, mesh, attn_impl, xx, lp,
+                cos_l if local else cos, sin_l if local else sin,
                 cache=(vals[0], vals[1], pos, positions),
                 kv_scales=(vals[2], vals[3]) if quant else None,
-                rolled=rolled,
+                attn_kind=kind, rolled=rolled,
             )
             return xx, nc
 
-        x, news = jax.lax.scan(body, x, (sp,) + slices)
+        if pattern is None:
+            def body(xx, layer_in):
+                return run_one(xx, layer_in[0], layer_in[1:], None)
+
+            x, news = jax.lax.scan(body, x, (sp,) + slices)
+        else:
+            # Patterned stacks (Gemma-2/3, GPT-OSS over DENSE caches):
+            # each stage's layer chunk starts at pattern phase 0
+            # (validate_pp_pipeline enforces Lp % period == 0), so the
+            # SHARED period walk (transformer.pattern_period_scan)
+            # applies to the stage chunk exactly as it does to the
+            # full stack.
+            x, news = pattern_period_scan(pattern, x, sp, slices,
+                                          run_one)
         blocks = tuple(
             jax.lax.dynamic_update_slice_in_dim(b, n, gstart, axis=1)
             for b, n in zip(blocks, news)
@@ -190,7 +215,7 @@ def constrain_register(x, mesh):
 
 
 def validate_pp_pipeline(cfg: ModelConfig, mesh, n_slots: int,
-                         kv_quant: Optional[str],
+                         kv_quant: Optional[str], rolling: bool,
                          swaps_cache: bool) -> int:
     """Checks the pp_pipeline=True configuration; returns pp."""
     from shellac_tpu.models.transformer import first_k_layout, grouped_moe
@@ -206,11 +231,17 @@ def validate_pp_pipeline(cfg: ModelConfig, mesh, n_slots: int,
             "pp_pipeline is a dense-cache feature; the paged engine's "
             "block pools do not reshape into per-stage registers yet"
         )
-    if (cfg.attn_pattern is not None or first_k_layout(cfg)
-            or grouped_moe(cfg)):
+    if first_k_layout(cfg) or grouped_moe(cfg):
         raise ValueError(
-            "pp_pipeline needs a uniform layer stack (no attn_pattern, "
-            "first_k_dense, or moe_every layouts)"
+            "pp_pipeline needs a uniformly-stacked layer tree (no "
+            "first_k_dense or moe_every layouts)"
+        )
+    if cfg.attn_pattern is not None and rolling:
+        raise ValueError(
+            "pp_pipeline on patterned models needs the DENSE cache: "
+            "rolling_window would use the mixed ring/dense "
+            "PatternedKVCache, whose per-kind stacks do not stage-"
+            "split uniformly"
         )
     if n_slots % pp:
         raise ValueError(
@@ -222,4 +253,13 @@ def validate_pp_pipeline(cfg: ModelConfig, mesh, n_slots: int,
             f"pp_pipeline needs n_layers divisible by pp: "
             f"{cfg.n_layers} % {pp} != 0"
         )
+    if cfg.attn_pattern is not None:
+        period = len(cfg.attn_pattern)
+        if (cfg.n_layers // pp) % period:
+            raise ValueError(
+                f"pp_pipeline on a patterned model needs each stage's "
+                f"layer chunk to hold whole pattern periods: "
+                f"(n_layers/pp)={cfg.n_layers // pp} % "
+                f"period={period} != 0"
+            )
     return pp

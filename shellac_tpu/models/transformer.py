@@ -1347,6 +1347,45 @@ def unembed(cfg: ModelConfig, params: Params, x: jax.Array) -> jax.Array:
     return logits
 
 
+def pattern_period_scan(pattern, x, layer_stack, caches, body_one):
+    """Scan whole attn_pattern periods: stacked leaves (L, ...)
+    reshape to (L/period, period, ...) and the kinds unroll inside
+    the scan body (window sizes are static kernel arguments).
+    caches: tuple of (L, ...) arrays riding with the layers;
+    body_one(x, lp, cache_slices, kind) -> (x, new_cache_tuple).
+    Returns (x, tuple of restacked (L, ...) caches).
+
+    The ONE definition of the period walk, shared by
+    forward_with_cache's patterned branch and the pipelined decode's
+    per-stage scan (inference/pp_pipeline.py) so the layer order and
+    field stacking cannot drift between them."""
+    period = len(pattern)
+
+    def greshape(a):
+        return a.reshape(a.shape[0] // period, period, *a.shape[1:])
+
+    glp = jax.tree.map(greshape, layer_stack)
+    gcaches = tuple(greshape(c) for c in caches)
+
+    def group_body(x, inp):
+        gl = inp[0]
+        outs = []
+        for i, kind in enumerate(pattern):
+            lp_i = jax.tree.map(lambda a, i=i: a[i], gl)
+            x, nc = body_one(
+                x, lp_i, tuple(c[i] for c in inp[1:]), kind
+            )
+            outs.append(nc)
+        stacked = tuple(
+            jnp.stack([o[j] for o in outs], axis=0)
+            for j in range(len(outs[0]))
+        )
+        return x, stacked
+
+    x, gnew = jax.lax.scan(group_body, x, (glp,) + gcaches)
+    return x, tuple(c.reshape(-1, *c.shape[2:]) for c in gnew)
+
+
 def forward_with_cache(
     cfg: ModelConfig,
     params: Params,
@@ -1430,36 +1469,8 @@ def forward_with_cache(
         )
 
     def pattern_scan(x, layer_stack, caches, body_one):
-        """Scan whole attn_pattern periods: stacked leaves (L, ...)
-        reshape to (L/period, period, ...) and the kinds unroll inside
-        the scan body (window sizes are static kernel arguments).
-        caches: tuple of (L, ...) arrays riding with the layers;
-        body_one(x, lp, cache_slices, kind) -> (x, new_cache_tuple).
-        Returns (x, tuple of restacked (L, ...) caches)."""
-        period = len(cfg.attn_pattern)
-        ng = cfg.n_layers // period
-        greshape = lambda a: a.reshape(ng, period, *a.shape[1:])
-        glp = jax.tree.map(greshape, layer_stack)
-        gcaches = tuple(greshape(c) for c in caches)
-
-        def group_body(x, inp):
-            gl = inp[0]
-            outs = []
-            for i, kind in enumerate(cfg.attn_pattern):
-                lp_i = jax.tree.map(lambda a, i=i: a[i], gl)
-                x, nc = body_one(
-                    x, lp_i, tuple(c[i] for c in inp[1:]), kind
-                )
-                outs.append(nc)
-            stacked = tuple(
-                jnp.stack([o[j] for o in outs], axis=0)
-                for j in range(len(outs[0]))
-            )
-            return x, stacked
-
-        x, gnew = jax.lax.scan(group_body, x, (glp,) + gcaches)
-        return x, tuple(
-            c.reshape(cfg.n_layers, *c.shape[2:]) for c in gnew
+        return pattern_period_scan(
+            cfg.attn_pattern, x, layer_stack, caches, body_one
         )
 
     # Cache leaves riding the layer scans: values only (bf16) or values

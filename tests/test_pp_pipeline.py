@@ -226,6 +226,74 @@ class TestInt8Pipelined:
         assert got == want
 
 
+class TestPatternedPipelined:
+    @pytest.mark.parametrize("preset,layers", [
+        ("tiny-gemma2", None),    # (window, full) pattern + softcaps
+        ("tiny-gemma3", 12),      # 5:1 pattern + DUAL rope
+        ("tiny-gptoss", None),    # pattern + attention sinks
+    ])
+    def test_patterned_greedy_bit_exact(self, setup, preset, layers):
+        """Patterned stacks (dense cache) compose: each stage's layer
+        chunk holds whole pattern periods, kinds unroll inside the
+        stage scan exactly as forward_with_cache's pattern_scan, and
+        window layers take the local rope when the model has one."""
+        from shellac_tpu.models import transformer as tr
+
+        _, _, _, mesh = setup
+        cfg = get_model_config(preset).replace(dtype="float32")
+        if layers is not None:
+            cfg = cfg.replace(n_layers=layers)
+        params = tr.init_params(cfg, jax.random.PRNGKey(3))
+        sharded = shard_params(cfg, params, mesh)
+        reqs = _reqs(cfg, lens=(5, 9), max_new=7)
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0, decode_ticks=2).run(reqs)
+        got = BatchingEngine(cfg, sharded, n_slots=2, max_len=64,
+                             temperature=0.0, decode_ticks=2,
+                             mesh=mesh, pp_pipeline=True).run(reqs)
+        assert got == want
+
+    def test_patterned_int8_bit_exact(self, setup):
+        """Patterned stack x int8 cache x pipelined decode: the quant
+        field tuple threads through the shared period walk."""
+        from shellac_tpu.models import transformer as tr
+
+        _, _, _, mesh = setup
+        cfg = get_model_config("tiny-gemma2").replace(dtype="float32")
+        params = tr.init_params(cfg, jax.random.PRNGKey(4))
+        sharded = shard_params(cfg, params, mesh)
+        reqs = _reqs(cfg, lens=(4, 8), max_new=6)
+        want = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0, kv_quant="int8",
+                              decode_ticks=2).run(reqs)
+        got = BatchingEngine(cfg, sharded, n_slots=2, max_len=64,
+                             temperature=0.0, kv_quant="int8",
+                             decode_ticks=2, mesh=mesh,
+                             pp_pipeline=True).run(reqs)
+        assert got == want
+
+    def test_pattern_period_must_divide_stage_chunk(self, setup):
+        from shellac_tpu.models import transformer as tr
+
+        _, _, _, mesh = setup
+        cfg = get_model_config("tiny-gemma3").replace(dtype="float32")
+        # 6 layers / pp=2 -> 3 per stage, not a whole 6-layer period.
+        params = tr.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="whole pattern periods"):
+            BatchingEngine(cfg, params, n_slots=2, mesh=mesh,
+                           pp_pipeline=True)
+
+    def test_patterned_rolling_rejected(self, setup):
+        from shellac_tpu.models import transformer as tr
+
+        _, _, _, mesh = setup
+        cfg = get_model_config("tiny-gemma2").replace(dtype="float32")
+        params = tr.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="PatternedKVCache"):
+            BatchingEngine(cfg, params, n_slots=2, mesh=mesh,
+                           pp_pipeline=True, rolling_window=True)
+
+
 class TestGuards:
     def test_requires_pp_mesh(self, setup):
         cfg, params, _, _ = setup
