@@ -347,7 +347,15 @@ def main():
         # conftest.py recipe) is the reliable CPU path.
         try:
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 8)
+            try:
+                jax.config.update("jax_num_cpu_devices", 8)
+            except AttributeError:
+                # Older jax: the CPU client reads XLA_FLAGS at (lazy)
+                # backend init instead.
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=8"
+                )
         except RuntimeError:
             # Backend already initialized. If it initialized as CPU
             # (in-process caller set the config first) that's fine;

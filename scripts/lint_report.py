@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Diff two JSON lint reports from `python -m shellac_tpu.analysis`.
+
+CI "no new findings" gating and CHANGES.md summaries:
+
+    python -m shellac_tpu.analysis shellac_tpu --format json > new.json
+    python scripts/lint_report.py baseline.json new.json --fail-on-new
+
+Findings are keyed by (rule, path, message) — NOT by line number, so a
+finding that merely moves when unrelated lines shift is neither "new"
+nor "fixed". Exit status: 0 (no new findings), 1 (new findings and
+--fail-on-new), 2 (unreadable/invalid report).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+
+
+def load_report(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"error: cannot read report {path}: {e}")
+    if not isinstance(report, dict) or "findings" not in report:
+        raise SystemExit(
+            f"error: {path} is not a lint report (no 'findings' key)"
+        )
+    return report
+
+
+def finding_keys(report: dict) -> Counter:
+    """Multiset of (rule, path, message) keys — a Counter, so two
+    identical findings in one file (e.g. the same hazard pasted twice)
+    are tracked as two."""
+    return Counter(
+        (f["rule"], f["path"], f["message"]) for f in report["findings"]
+    )
+
+
+def diff(old: dict, new: dict):
+    old_keys, new_keys = finding_keys(old), finding_keys(new)
+    added = new_keys - old_keys
+    fixed = old_keys - new_keys
+    return added, fixed
+
+
+def _render(keys: Counter, lines_by_key: dict) -> list:
+    out = []
+    for key in sorted(keys):
+        rule, path, message = key
+        for line in _key_lines(lines_by_key, key, keys[key]):
+            out.append(f"  {path}:{line}: {rule} {message}")
+    return out
+
+
+def _key_lines(lines_by_key: dict, key: tuple, n: int) -> list:
+    """The first n line numbers recorded for a key, padded with "?" —
+    duplicate findings (same rule/path/message on different lines) each
+    keep their own location."""
+    lines = lines_by_key.get(key, [])
+    return (lines + ["?"] * n)[:n]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("baseline", help="older JSON report")
+    p.add_argument("current", help="newer JSON report")
+    p.add_argument("--fail-on-new", action="store_true",
+                   help="exit 1 when the current report has findings "
+                        "absent from the baseline")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the diff as JSON instead of text")
+    args = p.parse_args(argv)
+
+    old, new = load_report(args.baseline), load_report(args.current)
+    added, fixed = diff(old, new)
+
+    def lines_by_key(report: dict) -> dict:
+        out: dict = {}
+        for f in report["findings"]:
+            key = (f["rule"], f["path"], f["message"])
+            out.setdefault(key, []).append(f.get("line", "?"))
+        return out
+
+    new_lines, old_lines = lines_by_key(new), lines_by_key(old)
+
+    if args.as_json:
+        print(json.dumps({
+            "added": [
+                {"rule": r, "path": pth, "message": m, "line": line}
+                for (r, pth, m), n in sorted(added.items())
+                for line in _key_lines(new_lines, (r, pth, m), n)
+            ],
+            "fixed": [
+                {"rule": r, "path": pth, "message": m, "line": line}
+                for (r, pth, m), n in sorted(fixed.items())
+                for line in _key_lines(old_lines, (r, pth, m), n)
+            ],
+            "summary": {
+                "added": sum(added.values()),
+                "fixed": sum(fixed.values()),
+                "baseline_total": len(old["findings"]),
+                "current_total": len(new["findings"]),
+            },
+        }, indent=2))
+    else:
+        if added:
+            print(f"{sum(added.values())} new finding(s):")
+            print("\n".join(_render(added, new_lines)))
+        if fixed:
+            print(f"{sum(fixed.values())} fixed finding(s):")
+            print("\n".join(_render(fixed, old_lines)))
+        if not added and not fixed:
+            print("no lint changes "
+                  f"({len(new['findings'])} finding(s) in both)")
+
+    return 1 if (added and args.fail_on_new) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+"""shellac_tpu.analysis — a JAX/TPU-aware static lint engine.
+
+AST-level checks for the silent hazards an XLA-compiled codebase
+accumulates: missing buffer donation on state-threading jits (SH001),
+host syncs in jitted code or decode hot loops (SH002), trace-time
+nondeterminism (SH003), leftover debug aids (SH004), set-iteration
+order dependence (SH005), dead config flags (SH006), and sharding-
+constraint asymmetry between paired paths (SH007).
+
+Run it with `python -m shellac_tpu.analysis <paths>` or
+`python -m shellac_tpu lint <paths>`; see docs/static_analysis.md.
+"""
+
+from shellac_tpu.analysis.engine import (
+    Finding,
+    all_rules,
+    lint_files,
+    lint_paths,
+)
+
+__all__ = ["Finding", "all_rules", "lint_files", "lint_paths"]

@@ -1,0 +1,108 @@
+"""Command-line front end for the lint engine.
+
+Two equivalent entry points:
+
+    python -m shellac_tpu.analysis [paths...] [options]
+    python -m shellac_tpu lint [paths...] [options]
+
+Exit status: 0 when the tree is clean, 1 when findings (or parse
+errors) exist, 2 on bad usage. `--format json` emits a machine-readable
+report that `scripts/lint_report.py` can diff for "no new findings"
+CI gating.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+from shellac_tpu.analysis.engine import all_rules, lint_paths
+
+REPORT_VERSION = 1
+
+
+def _split_codes(value: Optional[str]):
+    if not value:
+        return None
+    return [c.strip() for c in value.split(",") if c.strip()]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m shellac_tpu.analysis", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument(
+        "paths", nargs="*", default=["shellac_tpu"],
+        help=".py files and/or directories to lint "
+             "(default: shellac_tpu)",
+    )
+    p.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="human text (default) or a JSON report",
+    )
+    p.add_argument(
+        "--select", metavar="CODES",
+        help="comma-separated rule codes to run (default: all)",
+    )
+    p.add_argument(
+        "--ignore", metavar="CODES",
+        help="comma-separated rule codes to skip",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return p
+
+
+def report_dict(findings, paths) -> dict:
+    by_rule: dict = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "version": REPORT_VERSION,
+        "paths": list(paths),
+        "findings": [f.to_dict() for f in findings],
+        "summary": {
+            "findings": len(findings),
+            "by_rule": dict(sorted(by_rule.items())),
+        },
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for code, cls in all_rules().items():
+            print(f"{code} {cls.name}: {cls.summary}")
+        return 0
+
+    try:
+        findings = lint_paths(
+            args.paths,
+            select=_split_codes(args.select),
+            ignore=_split_codes(args.ignore),
+        )
+    except (OSError, KeyError, UnicodeDecodeError) as e:
+        # Unreadable/mis-encoded targets and unknown rule codes are
+        # usage errors (2), distinct from "findings exist" (1).
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(report_dict(findings, args.paths), indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(f"{n} finding{'s' if n != 1 else ''}"
+              if n else "clean: no findings")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

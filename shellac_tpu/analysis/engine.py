@@ -1,0 +1,236 @@
+"""Core of the static lint engine: findings, suppressions, registry.
+
+The engine is deliberately jax-free: it parses Python source with `ast`
+and never imports the modules it checks, so `lint` runs in milliseconds
+on a CPU-only CI box before any test environment exists. Rules live in
+`shellac_tpu.analysis.rules`; this module provides the machinery they
+plug into:
+
+- `Finding`: one diagnostic, with a `file:line:col` span.
+- `Suppression` parsing: `# shellac: ignore[SH001]` trailing a code
+  line silences that line; the same comment standing alone at column 0
+  silences the named rules for the whole file. A comment may name
+  several rules: `# shellac: ignore[SH001,SH004]`.
+- `Rule` / `ProjectRule`: per-file AST rules and whole-tree rules
+  (SH006 needs every file to decide whether a config field is read).
+- `lint_paths` / `lint_files`: the entry points the CLI and the test
+  suite share.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence
+
+#: Rule code for files the engine cannot parse at all.
+PARSE_ERROR = "SH000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shellac:\s*ignore\[([A-Za-z0-9_,\s]+)\]"
+)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One diagnostic: rule code + location + human message."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Suppressions:
+    """Per-file map of `# shellac: ignore[...]` comments.
+
+    Two scopes, by comment placement:
+    - trailing a code line -> suppresses the named rules on that line;
+    - alone at column 0    -> suppresses the named rules file-wide.
+    """
+
+    def __init__(self, source: str):
+        self.file_level: set = set()
+        self.by_line: Dict[int, set] = {}
+        # Tokenize rather than regex-scan raw lines so a marker inside
+        # a string literal (e.g. worker source embedded in a test) can
+        # never suppress rules in the enclosing file.
+        try:
+            tokens = list(
+                tokenize.generate_tokens(io.StringIO(source).readline)
+            )
+        except (tokenize.TokenError, IndentationError):
+            return  # unparsable source surfaces as SH000, not here
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            lineno, col = tok.start
+            if col == 0:
+                self.file_level |= rules
+            else:
+                self.by_line.setdefault(lineno, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return rule in self.file_level or rule in self.by_line.get(line, ())
+
+
+class FileContext:
+    """One parsed file handed to rules: path, source, tree, test flag."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = Suppressions(source)
+        name = Path(path).name
+        parts = Path(path).parts
+        self.is_test = (
+            name.startswith("test_")
+            or name == "conftest.py"
+            or "tests" in parts
+        )
+
+
+class Rule:
+    """A per-file AST check. Subclasses set `code`/`name`/`summary` and
+    implement `check(ctx)` yielding Findings (suppressions are applied
+    by the engine, not the rule)."""
+
+    code: str = ""
+    name: str = ""
+    summary: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            path=ctx.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1,
+            rule=self.code,
+            message=message,
+        )
+
+
+class ProjectRule(Rule):
+    """A whole-tree check: sees every FileContext at once (SH006 must
+    know all read sites before calling a config field dead)."""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator adding a Rule subclass to the global registry."""
+    if not cls.code:
+        raise ValueError(f"rule class {cls.__name__} has no code")
+    if cls.code in _REGISTRY:
+        raise ValueError(f"duplicate rule code {cls.code}")
+    _REGISTRY[cls.code] = cls
+    return cls
+
+
+def all_rules() -> Dict[str, type]:
+    # Importing rules registers them; deferred so engine stays cheap to
+    # import and free of cycles.
+    from shellac_tpu.analysis import rules  # noqa: F401
+
+    return dict(sorted(_REGISTRY.items()))
+
+
+def iter_python_files(paths: Sequence[str]) -> List[Path]:
+    """Expand files/directories into a sorted, de-duplicated .py list."""
+    seen = []
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            found = sorted(
+                f for f in p.rglob("*.py") if "__pycache__" not in f.parts
+            )
+        elif p.suffix == ".py":
+            found = [p]
+        else:
+            raise FileNotFoundError(f"not a .py file or directory: {raw}")
+        for f in found:
+            if f not in seen:
+                seen.append(f)
+    return seen
+
+
+def _selected(codes: Dict[str, type], select: Optional[Sequence[str]],
+              ignore: Optional[Sequence[str]]) -> Dict[str, type]:
+    out = dict(codes)
+    if select:
+        unknown = set(select) - set(out)
+        if unknown:
+            raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+        out = {c: r for c, r in out.items() if c in set(select)}
+    if ignore:
+        unknown = set(ignore) - set(codes)
+        if unknown:
+            raise KeyError(f"unknown rule(s): {sorted(unknown)}")
+        out = {c: r for c, r in out.items() if c not in set(ignore)}
+    return out
+
+
+def lint_files(sources: Dict[str, str], select: Optional[Sequence[str]] = None,
+               ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint a {path: source} mapping (the testable core of the engine)."""
+    rule_classes = _selected(all_rules(), select, ignore)
+    rules = [cls() for cls in rule_classes.values()]
+
+    ctxs: List[FileContext] = []
+    findings: List[Finding] = []
+    for path, source in sources.items():
+        try:
+            ctxs.append(FileContext(path, source))
+        except SyntaxError as e:
+            findings.append(Finding(
+                path=path, line=e.lineno or 1, col=(e.offset or 0) + 1,
+                rule=PARSE_ERROR, message=f"cannot parse: {e.msg}",
+            ))
+
+    by_path = {c.path: c for c in ctxs}
+    for rule in rules:
+        if isinstance(rule, ProjectRule):
+            raw = rule.check_project(ctxs)
+        else:
+            raw = (f for ctx in ctxs for f in rule.check(ctx))
+        for f in raw:
+            ctx = by_path.get(f.path)
+            if ctx is not None and ctx.suppressions.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    return sorted(findings)
+
+
+def lint_paths(paths: Sequence[str], select: Optional[Sequence[str]] = None,
+               ignore: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint files and directory trees from disk."""
+    files = iter_python_files(paths)
+    sources = {}
+    for f in files:
+        sources[str(f)] = f.read_text(encoding="utf-8")
+    return lint_files(sources, select=select, ignore=ignore)

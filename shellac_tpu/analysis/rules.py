@@ -1,0 +1,523 @@
+"""The SH rule set: JAX/TPU pitfalls this codebase has actually hit.
+
+Each rule is a small AST check registered with the engine. They are
+heuristics tuned for THIS tree — favouring few, high-signal findings
+over exhaustive coverage — and every one can be silenced per line or
+per file with `# shellac: ignore[CODE]` (see docs/static_analysis.md).
+
+Shared machinery first: dotted-chain extraction and the "traced set" —
+functions the linter believes run under `jax.jit` or as a `lax.scan`
+body, resolved by decorator, by `jax.jit(f)` call sites, and through
+`functools.partial` wrappers, all within a single module (no imports
+are followed; the linter never executes the code).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from shellac_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    ProjectRule,
+    Rule,
+    register,
+)
+
+_JIT_CHAINS = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_SCAN_CHAINS = {"jax.lax.scan", "lax.scan"}
+_PARTIAL_CHAINS = {"functools.partial", "partial"}
+_CONSTRAINT_NAMES = {"with_sharding_constraint", "constrain"}
+
+
+def _chain(node: ast.AST) -> Optional[str]:
+    """Dotted name of a Name/Attribute expression ("jax.lax.scan")."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _callable_names(node: ast.AST) -> List[str]:
+    """Terminal def names a callable expression might resolve to:
+    `f` -> [f], `self._step_impl` -> [_step_impl],
+    `partial(f, x=1)` -> [f]."""
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Call):
+        if _chain(node.func) in _PARTIAL_CHAINS and node.args:
+            return _callable_names(node.args[0])
+    return []
+
+
+def _defs_by_name(tree: ast.AST) -> Dict[str, List[ast.FunctionDef]]:
+    out: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _jit_decorator_call(dec: ast.AST) -> Optional[ast.Call]:
+    """The Call carrying jit kwargs for `@jax.jit(...)` and
+    `@partial(jax.jit, ...)` decorators; None for other decorators."""
+    if isinstance(dec, ast.Call):
+        if _chain(dec.func) in _JIT_CHAINS:
+            return dec
+        if _chain(dec.func) in _PARTIAL_CHAINS and dec.args:
+            if _chain(dec.args[0]) in _JIT_CHAINS:
+                return dec
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    return _chain(dec) in _JIT_CHAINS or _jit_decorator_call(dec) is not None
+
+
+def traced_defs(tree: ast.AST) -> Set[ast.FunctionDef]:
+    """Functions that (per module-local evidence) run under a tracer:
+    jit-decorated, passed to jax.jit(...), or used as a scan body."""
+    defs = _defs_by_name(tree)
+    traced: Set[ast.FunctionDef] = set()
+    for dlist in defs.values():
+        for d in dlist:
+            if any(_is_jit_decorator(dec) for dec in d.decorator_list):
+                traced.add(d)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _chain(node.func) in (_JIT_CHAINS | _SCAN_CHAINS) and node.args:
+            for name in _callable_names(node.args[0]):
+                traced.update(defs.get(name, []))
+    return traced
+
+
+def _segments(name: str) -> List[str]:
+    return [s for s in name.lower().split("_") if s]
+
+
+_STATEFUL_SEGMENTS = {"train", "step", "decode", "prefill", "update"}
+
+
+def _iter_calls(node: ast.AST) -> Iterable[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+# ---------------------------------------------------------------------
+# SH001 — missing donation on jitted state-threading functions
+# ---------------------------------------------------------------------
+
+
+@register
+class MissingDonation(Rule):
+    code = "SH001"
+    name = "missing-donation"
+    summary = (
+        "jax.jit of a train/step/decode/prefill/update function without "
+        "donate_argnums: the threaded state or KV cache is copied every "
+        "call instead of updated in place"
+    )
+
+    _DONATE_KW = {"donate_argnums", "donate_argnames"}
+
+    def _has_donate(self, call: ast.Call) -> bool:
+        return any(kw.arg in self._DONATE_KW for kw in call.keywords)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _chain(node.func) in _JIT_CHAINS:
+                if not node.args or self._has_donate(node):
+                    continue
+                for name in _callable_names(node.args[0]):
+                    if set(_segments(name)) & _STATEFUL_SEGMENTS:
+                        yield self.finding(
+                            ctx, node,
+                            f"jit of {name!r} without donate_argnums/"
+                            "donate_argnames — its state/cache buffers "
+                            "are copied instead of reused in place",
+                        )
+                        break
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not (set(_segments(node.name)) & _STATEFUL_SEGMENTS):
+                    continue
+                for dec in node.decorator_list:
+                    call = _jit_decorator_call(dec)
+                    if call is not None and self._has_donate(call):
+                        continue
+                    if _is_jit_decorator(dec):
+                        yield self.finding(
+                            ctx, dec,
+                            f"jit-decorated {node.name!r} without "
+                            "donate_argnums/donate_argnames — its state/"
+                            "cache buffers are copied instead of reused "
+                            "in place",
+                        )
+                        break
+
+
+# ---------------------------------------------------------------------
+# SH002 — host-device sync in jitted code or per-token decode loops
+# ---------------------------------------------------------------------
+
+
+@register
+class HostSync(Rule):
+    code = "SH002"
+    name = "host-sync"
+    summary = (
+        "host-device synchronization (.item(), np.asarray, device_get, "
+        "block_until_ready) inside a jit-traced function or a per-token "
+        "decode loop"
+    )
+
+    _SYNC_METHODS = {"item", "block_until_ready"}
+    _SYNC_CHAINS = {
+        "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+        "jax.device_get",
+    }
+    _LOOP_SEGMENTS = {"decode", "tick"}
+
+    def _sync_call(self, call: ast.Call) -> Optional[str]:
+        chain = _chain(call.func)
+        if chain in self._SYNC_CHAINS:
+            return chain
+        if (isinstance(call.func, ast.Attribute)
+                and call.func.attr in self._SYNC_METHODS
+                and not call.args and not call.keywords):
+            return f".{call.func.attr}()"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        traced = traced_defs(ctx.tree)
+        seen: Set[Tuple[int, int]] = set()
+        for fn in traced:
+            for call in _iter_calls(fn):
+                what = self._sync_call(call)
+                key = (call.lineno, call.col_offset)
+                if what and key not in seen:
+                    seen.add(key)
+                    yield self.finding(
+                        ctx, call,
+                        f"{what} inside jit-traced {fn.name!r} forces a "
+                        "host round-trip at trace/run time",
+                    )
+        # Host-side decode/tick functions: a sync in their LOOP bodies
+        # serializes every iteration of the token hot loop.
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node in traced:
+                continue
+            if not (set(_segments(node.name)) & self._LOOP_SEGMENTS):
+                continue
+            for loop in ast.walk(node):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for call in _iter_calls(loop):
+                    what = self._sync_call(call)
+                    key = (call.lineno, call.col_offset)
+                    if what and key not in seen:
+                        seen.add(key)
+                        yield self.finding(
+                            ctx, call,
+                            f"{what} inside a loop of decode-path "
+                            f"{node.name!r} syncs the host every "
+                            "iteration of the token hot loop",
+                        )
+
+
+# ---------------------------------------------------------------------
+# SH003 — Python-side nondeterminism captured under jit/scan
+# ---------------------------------------------------------------------
+
+
+@register
+class TraceTimeNondeterminism(Rule):
+    code = "SH003"
+    name = "trace-nondeterminism"
+    summary = (
+        "Python RNG or wall-clock call inside a jit/scan-traced "
+        "function: the value is baked in at trace time, silently "
+        "constant across steps and different across retraces"
+    )
+
+    _CHAINS = {
+        "time.time", "time.time_ns", "time.perf_counter",
+        "time.monotonic", "datetime.now", "datetime.utcnow",
+        "datetime.datetime.now", "datetime.datetime.utcnow",
+    }
+    _PREFIXES = ("np.random.", "numpy.random.")
+    # stdlib `random` functions only: `jax.random` is the fix, not the
+    # hazard, and `from jax import random` must not trip this rule.
+    _PY_RANDOM = {
+        "random", "randint", "randrange", "uniform", "choice", "choices",
+        "shuffle", "sample", "gauss", "normalvariate", "seed",
+        "getrandbits", "betavariate", "expovariate", "triangular",
+    }
+
+    def _nondet(self, call: ast.Call) -> Optional[str]:
+        chain = _chain(call.func)
+        if chain is None:
+            return None
+        if chain in self._CHAINS:
+            return chain
+        if chain.startswith(self._PREFIXES):
+            return chain
+        parts = chain.split(".")
+        if (len(parts) == 2 and parts[0] == "random"
+                and parts[1] in self._PY_RANDOM):
+            return chain
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in traced_defs(ctx.tree):
+            seen: Set[Tuple[int, int]] = set()
+            for call in _iter_calls(fn):
+                what = self._nondet(call)
+                key = (call.lineno, call.col_offset)
+                if what and key not in seen:
+                    seen.add(key)
+                    yield self.finding(
+                        ctx, call,
+                        f"{what} inside jit/scan-traced {fn.name!r} is "
+                        "evaluated once at trace time — use jax.random "
+                        "keys / pass values as arguments",
+                    )
+
+
+# ---------------------------------------------------------------------
+# SH004 — debug aids left in non-test code
+# ---------------------------------------------------------------------
+
+
+@register
+class DebugLeftover(Rule):
+    code = "SH004"
+    name = "debug-leftover"
+    summary = (
+        "jax.debug.print/breakpoint, pdb, or breakpoint() left in "
+        "non-test code"
+    )
+
+    _CHAINS = {
+        "jax.debug.print", "jax.debug.breakpoint",
+        "pdb.set_trace", "pdb.post_mortem", "pdb.run",
+    }
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.is_test:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                chain = _chain(node.func)
+                if chain in self._CHAINS or chain == "breakpoint":
+                    yield self.finding(
+                        ctx, node,
+                        f"{chain}() left in non-test code",
+                    )
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "pdb":
+                        yield self.finding(
+                            ctx, node, "import pdb left in non-test code"
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "pdb":
+                    yield self.finding(
+                        ctx, node, "import from pdb left in non-test code"
+                    )
+
+
+# ---------------------------------------------------------------------
+# SH005 — set-iteration order dependence
+# ---------------------------------------------------------------------
+
+
+@register
+class SetIterationOrder(Rule):
+    code = "SH005"
+    name = "set-iteration-order"
+    summary = (
+        "iteration directly over a set: order varies with hash "
+        "randomization, so any pytree / argument list built from it "
+        "changes structure run to run (guaranteed retraces, shard "
+        "drift) — iterate sorted(...) instead"
+    )
+
+    def _is_set_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Set):
+            return True
+        if isinstance(node, ast.Call):
+            return _chain(node.func) in ("set", "frozenset")
+        if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+        ):
+            return (self._is_set_expr(node.left)
+                    or self._is_set_expr(node.right))
+        return False
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            iters: List[ast.AST] = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(gen.iter for gen in node.generators)
+            for it in iters:
+                if self._is_set_expr(it):
+                    yield self.finding(
+                        ctx, it,
+                        "iterating a set directly — order is not "
+                        "deterministic across processes; wrap in "
+                        "sorted(...)",
+                    )
+
+
+# ---------------------------------------------------------------------
+# SH006 — config fields defined but never read (dead flags)
+# ---------------------------------------------------------------------
+
+
+@register
+class DeadConfigField(ProjectRule):
+    code = "SH006"
+    name = "dead-config-field"
+    summary = (
+        "a dataclass field in config.py is never read anywhere in the "
+        "scanned tree (validation does not count): a dead flag that "
+        "silently does nothing"
+    )
+
+    def _is_dataclass(self, node: ast.ClassDef) -> bool:
+        for dec in node.decorator_list:
+            chain = _chain(dec.func if isinstance(dec, ast.Call) else dec)
+            if chain and chain.split(".")[-1] == "dataclass":
+                return True
+        return False
+
+    def check_project(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        cfg_ctxs = [c for c in ctxs if Path(c.path).name == "config.py"]
+        if not cfg_ctxs:
+            return
+
+        fields: List[Tuple[FileContext, str, str, ast.AnnAssign]] = []
+        for ctx in cfg_ctxs:
+            for node in ctx.tree.body:
+                if not (isinstance(node, ast.ClassDef)
+                        and self._is_dataclass(node)):
+                    continue
+                for st in node.body:
+                    if (isinstance(st, ast.AnnAssign)
+                            and isinstance(st.target, ast.Name)
+                            and not st.target.id.startswith("_")):
+                        fields.append((ctx, node.name, st.target.id, st))
+
+        reads: Set[str] = set()
+        for ctx in ctxs:
+            # Reads inside config.py validate() bodies don't make a
+            # flag live: a field only validated but never consumed is
+            # exactly the dead flag this rule hunts.
+            skip: List[Tuple[int, int]] = []
+            if ctx in cfg_ctxs:
+                for node in ast.walk(ctx.tree):
+                    if (isinstance(node, ast.FunctionDef)
+                            and node.name == "validate"):
+                        skip.append((node.lineno, node.end_lineno or 0))
+            for node in ast.walk(ctx.tree):
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)):
+                    if any(a <= node.lineno <= b for a, b in skip):
+                        continue
+                    reads.add(node.attr)
+                elif isinstance(node, ast.Call):
+                    chain = _chain(node.func)
+                    if chain in ("getattr", "hasattr") and len(node.args) >= 2:
+                        arg = node.args[1]
+                        if isinstance(arg, ast.Constant) and isinstance(
+                            arg.value, str
+                        ):
+                            reads.add(arg.value)
+
+        for ctx, cls, field, node in fields:
+            if field not in reads:
+                yield self.finding(
+                    ctx, node,
+                    f"config field {cls}.{field} is never read outside "
+                    "validation — dead flag (delete it or wire it up)",
+                )
+
+
+# ---------------------------------------------------------------------
+# SH007 — sharding-constraint asymmetry between paired paths
+# ---------------------------------------------------------------------
+
+
+@register
+class ConstraintAsymmetry(Rule):
+    code = "SH007"
+    name = "constraint-asymmetry"
+    summary = (
+        "one half of a paired path (prefill/decode, fwd/bwd, forward/"
+        "backward) applies with_sharding_constraint and the other half "
+        "applies none: the unconstrained side drifts to whatever layout "
+        "XLA picks"
+    )
+
+    _PAIRS = [("prefill", "decode"), ("fwd", "bwd"),
+              ("forward", "backward")]
+
+    def _constraint_count(self, fns: Sequence[ast.FunctionDef]) -> int:
+        n = 0
+        for fn in fns:
+            for call in _iter_calls(fn):
+                name = _chain(call.func)
+                if name and name.split(".")[-1] in _CONSTRAINT_NAMES:
+                    n += 1
+        return n
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        defs = _defs_by_name(ctx.tree)
+        reported: Set[frozenset] = set()
+        for name, fns in defs.items():
+            segs = _segments(name)
+            for a, b in self._PAIRS:
+                for tag, other_tag in ((a, b), (b, a)):
+                    if tag not in segs:
+                        continue
+                    other = "_".join(
+                        other_tag if s == tag else s
+                        for s in name.split("_")
+                    )
+                    if other not in defs or other == name:
+                        continue
+                    key = frozenset((name, other))
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    mine = self._constraint_count(fns)
+                    theirs = self._constraint_count(defs[other])
+                    if mine == 0 and theirs > 0:
+                        yield self.finding(
+                            ctx, fns[0],
+                            f"{name!r} applies no sharding constraints "
+                            f"but its pair {other!r} applies {theirs} — "
+                            "the two paths can shard differently",
+                        )
+                    elif theirs == 0 and mine > 0:
+                        yield self.finding(
+                            ctx, defs[other][0],
+                            f"{other!r} applies no sharding constraints "
+                            f"but its pair {name!r} applies {mine} — "
+                            "the two paths can shard differently",
+                        )

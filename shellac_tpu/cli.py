@@ -7,6 +7,8 @@ Commands:
   generate  autoregressive sampling from a checkpoint (or random init),
             optionally speculative with a smaller draft preset
   info      show presets, a config's derived dims, and parameter counts
+  lint      JAX/TPU-aware static analysis of the source tree (the SH
+            rule set; see docs/static_analysis.md)
 
 Token ids go in and out as comma-separated integers; plug a tokenizer in
 front as needed. Everything here is a thin shell over the library — each
@@ -1217,10 +1219,26 @@ def build_parser() -> argparse.ArgumentParser:
     i.add_argument("--model")
     i.add_argument("--config")
     i.set_defaults(fn=cmd_info)
+
+    # `lint` is dispatched before argparse (see main) so the analysis
+    # CLI's option surface is forwarded verbatim and can never drift;
+    # this stub only makes it show up in `--help`.
+    sub.add_parser(
+        "lint", add_help=False,
+        help="JAX/TPU-aware static analysis (SH rule set; options: "
+             "python -m shellac_tpu.analysis --help)",
+    )
     return p
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["lint"]:
+        # One lint engine, two spellings: hand the rest of the command
+        # line to the analysis CLI untouched.
+        from shellac_tpu.analysis.cli import main as lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

@@ -41,18 +41,13 @@ import numpy as np
 
 from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.kvcache import (
-    KVCache,
-    kv_field_names,
     PagedKVCache,
-    QuantKVCache,
     QuantPagedKVCache,
-    cache_logical_axes,
-    init_cache,
     init_cache_for,
     init_paged_cache,
     init_quant_paged_cache,
+    kv_field_names,
     paged_cache_logical_axes,
-    quant_cache_logical_axes,
     quant_paged_cache_logical_axes,
     scatter_slot,
     slot_view,
@@ -373,10 +368,14 @@ class BatchingEngine:
 
     def _jit_cache_program(self, fn, n_tail: int, **jit_kw):
         """jit a program returning (cache, <n_tail others>), pinning the
-        cache's shardings on the mesh (no-op unsharded)."""
+        cache's shardings on the mesh (no-op unsharded) and donating
+        the cache argument: every program threads cache-in -> cache-out
+        (arg index 1, after params) and the caller rebinds self._cache
+        from the result immediately, so XLA may write the update in
+        place instead of copying the whole pool each prefill/decode."""
         if self._cache_sh is not None:
             jit_kw["out_shardings"] = (self._cache_sh,) + (None,) * n_tail
-        return jax.jit(fn, **jit_kw)
+        return jax.jit(fn, donate_argnums=(1,), **jit_kw)
 
     # ---- jitted programs --------------------------------------------
 

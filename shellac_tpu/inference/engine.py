@@ -22,12 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from shellac_tpu.config import ModelConfig
-from shellac_tpu.inference.kvcache import (
-    KVCache,
-    cache_logical_axes,
-    init_cache_for,
-    quant_cache_logical_axes,
-)
+from shellac_tpu.inference.kvcache import init_cache_for
 from shellac_tpu.models import transformer
 from shellac_tpu.ops.sampling import sample
 from shellac_tpu.parallel.sharding import make_shardings, shard_pytree
@@ -94,7 +89,9 @@ class Engine:
             min_p=min_p,
         )
         if mesh is None:
-            self._prefill = jax.jit(self._prefill_impl)
+            # Nothing donatable: prefill allocates its cache internally
+            # and params must stay live for decode/beam afterwards.
+            self._prefill = jax.jit(self._prefill_impl)  # shellac: ignore[SH001]
         else:
             # Pin the cache layout at the prefill boundary; decode then
             # inherits it from its (committed) cache argument.
@@ -106,10 +103,18 @@ class Engine:
                 cfg, kv_quant, rolling=rolling_window
             )
             cache_sh = make_shardings(mesh, axes)
-            self._prefill = jax.jit(
+            # Nothing donatable here either (see the unsharded branch).
+            self._prefill = jax.jit(  # shellac: ignore[SH001]
                 self._prefill_impl, out_shardings=(None, cache_sh, None)
             )
-        self._decode = jax.jit(self._decode_impl, static_argnums=(3,))
+        # No donation: the scanned decode returns only tokens/logprobs
+        # (the final cache is a discarded scan carry), so there is no
+        # output to alias the cache into — donating would just emit
+        # XLA's "donated buffers were not usable" warning every compile
+        # while invalidating the caller's array for nothing.
+        self._decode = jax.jit(  # shellac: ignore[SH001]
+            self._decode_impl, static_argnums=(3,)
+        )
         self._beam = jax.jit(self._beam_impl, static_argnums=(3, 4, 5))
 
     def _prefill_impl(self, params, tokens, prompt_len):

@@ -50,7 +50,6 @@ from __future__ import annotations
 from typing import List, Optional
 
 import jax
-import jax.numpy as jnp
 
 from shellac_tpu.config import ModelConfig
 from shellac_tpu.models.transformer import (

@@ -30,7 +30,7 @@ no upstream speculative serving engine to cite.
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +38,7 @@ import numpy as np
 
 from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.batching import BatchingEngine, _bucket
-from shellac_tpu.inference.kvcache import KVCache, init_cache
+from shellac_tpu.inference.kvcache import init_cache
 from shellac_tpu.models import transformer
 
 
@@ -183,8 +183,11 @@ class SpeculativeBatchingEngine(BatchingEngine):
         if pad not in self._draft_prefill_jit:
             kw = ({"out_shardings": self._cache_sh}
                   if self._cache_sh is not None else {})
+            # Donate the draft cache (arg 1): the call below rebinds
+            # self._dcache from the result, so the slot scatter may
+            # write in place instead of copying the whole draft cache.
             self._draft_prefill_jit[pad] = jax.jit(
-                self._draft_prefill_impl, **kw
+                self._draft_prefill_impl, donate_argnums=(1,), **kw
             )
         padded = np.zeros((1, pad), np.int32)
         padded[0, :s] = req.tokens
@@ -223,9 +226,11 @@ class SpeculativeBatchingEngine(BatchingEngine):
                       if self._cache_sh is not None else {})
             import functools
 
+            # Same donation contract as the draft prefill: self._dcache
+            # is rebound from the result right below.
             self._draft_chunk_jit[jkey] = jax.jit(
                 functools.partial(self._draft_chunk_impl, fresh=fresh),
-                **jit_kw,
+                donate_argnums=(1,), **jit_kw,
             )
         self._dcache = self._draft_chunk_jit[jkey](
             self.draft_params, self._dcache, tokens, chunk_len, offset,

@@ -37,10 +37,23 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+
+    _NO_CHECK = {"check_vma": False}
+except ImportError:  # pre-promotion jax: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map
+
+    _NO_CHECK = {"check_rep": False}
 from jax.sharding import Mesh, PartitionSpec as P
 
 from shellac_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_SEQ, AXIS_TENSOR
+
+if hasattr(jax.lax, "axis_size"):
+    _axis_size = jax.lax.axis_size
+else:  # pre-0.5 jax: psum of a Python 1 folds to the static axis size
+    def _axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
 
 
 def ulysses_supported(
@@ -71,7 +84,7 @@ def _ulysses_local(
     """
     from shellac_tpu.ops.attention import attention
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     b, s_loc, h_loc, dh = q.shape
     hkv_loc = k.shape[2]
     if h_loc % n:
@@ -175,6 +188,6 @@ def ulysses_attention(
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, seg_spec, sink_spec),
         out_specs=q_spec,
-        check_vma=False,
+        **_NO_CHECK,
     )
     return fn(q, k, v, segments, sinks)

@@ -16,7 +16,6 @@ TPU never waits on the host.
 
 from __future__ import annotations
 
-import os
 import queue
 import struct
 import threading

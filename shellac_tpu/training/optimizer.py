@@ -7,7 +7,6 @@ axis rules shard first/second moments ZeRO-style for free.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import optax
 
 from shellac_tpu.config import TrainConfig, resolve_dtype

@@ -7,13 +7,12 @@ the state/batch shardings are attached so XLA partitions the whole step
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from shellac_tpu.config import ModelConfig, TrainConfig
 from shellac_tpu.models import transformer

@@ -10,10 +10,29 @@ anything, which is early enough.
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax has no jax_num_cpu_devices; the CPU client reads
+    # XLA_FLAGS at (lazy) backend init, which has not happened yet at
+    # conftest time even though jax is imported.
+    import os
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+#: Shared by the multi-host test modules: the jax<0.5 CPU backend
+#: cannot run multiprocess computations at all, so those suites skip
+#: wholesale rather than fail at rendezvous.
+needs_multiprocess_cpu = pytest.mark.skipif(
+    tuple(int(v) for v in jax.__version__.split(".")[:2]) < (0, 5),
+    reason="the jax<0.5 CPU backend has no multiprocess computations",
+)
 
 _last_module = [None]
 

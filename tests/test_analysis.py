@@ -1,0 +1,579 @@
+"""Tests for shellac_tpu.analysis: each SH rule triggers on a fixture,
+stays quiet on the fixed form, respects suppressions — and the live
+tree is lint-clean (the meta-test that keeps it that way)."""
+
+from pathlib import Path
+
+import pytest
+
+from shellac_tpu.analysis import lint_files, lint_paths
+from shellac_tpu.analysis.cli import main as lint_main
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def codes(findings):
+    return sorted({f.rule for f in findings})
+
+
+def lint_snippet(source, filename="mod.py", **kw):
+    return lint_files({filename: source}, **kw)
+
+
+# ---- SH001 missing donation ----------------------------------------
+
+
+SH001_CALL = """
+import jax
+
+def train_step(state, batch):
+    return state
+
+step = jax.jit(train_step)
+"""
+
+SH001_DECORATED = """
+import functools
+import jax
+
+@jax.jit
+def decode_step(cache, tok):
+    return cache
+"""
+
+
+def test_sh001_jit_call_without_donation():
+    assert codes(lint_snippet(SH001_CALL)) == ["SH001"]
+
+
+def test_sh001_decorator_without_donation():
+    assert codes(lint_snippet(SH001_DECORATED)) == ["SH001"]
+
+
+def test_sh001_donated_is_clean():
+    fixed = SH001_CALL.replace(
+        "jax.jit(train_step)", "jax.jit(train_step, donate_argnums=(0,))"
+    )
+    assert lint_snippet(fixed) == []
+
+
+def test_sh001_partial_decorator_donated_is_clean():
+    src = """
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    return state
+"""
+    assert lint_snippet(src) == []
+
+
+def test_sh001_resolves_through_partial_and_methods():
+    src = """
+import functools
+import jax
+
+class Engine:
+    def _prefill_impl(self, params, cache):
+        return cache
+
+    def build(self):
+        return jax.jit(functools.partial(self._prefill_impl, 0))
+"""
+    assert codes(lint_snippet(src)) == ["SH001"]
+
+
+def test_sh001_non_state_function_not_flagged():
+    src = """
+import jax
+
+def helper(x):
+    return x
+
+fn = jax.jit(helper)
+"""
+    assert lint_snippet(src) == []
+
+
+# ---- SH002 host sync ------------------------------------------------
+
+
+SH002_JIT = """
+import jax
+import numpy as np
+
+def decode_body(cache, tok):
+    n = int(cache.lengths.item())
+    host = np.asarray(tok)
+    return cache
+
+fn = jax.jit(decode_body, donate_argnums=(0,))
+"""
+
+
+def test_sh002_host_sync_in_jitted_body():
+    found = lint_snippet(SH002_JIT, select=["SH002"])
+    assert codes(found) == ["SH002"]
+    assert len(found) == 2  # .item() and np.asarray
+
+
+def test_sh002_host_side_sync_is_fine():
+    src = """
+import numpy as np
+
+def collect(out):
+    return np.asarray(out).tolist()
+"""
+    assert lint_snippet(src, select=["SH002"]) == []
+
+
+def test_sh002_sync_inside_decode_loop():
+    src = """
+import jax
+
+def run_decode(engine, steps):
+    out = []
+    for _ in range(steps):
+        tok = engine.step()
+        out.append(jax.device_get(tok))
+    return out
+"""
+    found = lint_snippet(src, select=["SH002"])
+    assert codes(found) == ["SH002"]
+
+
+def test_sh002_single_sync_outside_loop_is_fine():
+    # The engine's designed idiom: K ticks on device, ONE sync after.
+    src = """
+import jax
+
+def step_decode(engine):
+    toks = engine.ticks()
+    return jax.device_get(toks)
+"""
+    assert lint_snippet(src, select=["SH002"]) == []
+
+
+# ---- SH003 trace-time nondeterminism -------------------------------
+
+
+def test_sh003_np_random_in_scan_body():
+    src = """
+import jax
+import numpy as np
+
+def outer(xs):
+    def body(carry, x):
+        noise = np.random.uniform()
+        return carry + x + noise, x
+    return jax.lax.scan(body, 0.0, xs)
+"""
+    assert codes(lint_snippet(src, select=["SH003"])) == ["SH003"]
+
+
+def test_sh003_time_in_jitted_fn():
+    src = """
+import time
+import jax
+
+@jax.jit
+def train_step(state):
+    t = time.time()
+    return state
+"""
+    found = lint_snippet(src, select=["SH003"])
+    assert codes(found) == ["SH003"]
+
+
+def test_sh003_jax_random_is_the_fix_not_the_hazard():
+    src = """
+import jax
+from jax import random
+
+@jax.jit
+def train_step(state, key):
+    key, sub = random.split(key)
+    return state, jax.random.normal(sub, (4,))
+"""
+    assert lint_snippet(src, select=["SH003"]) == []
+
+
+def test_sh003_host_side_rng_is_fine():
+    src = """
+import numpy as np
+
+def make_batch(seed):
+    return np.random.default_rng(seed).integers(0, 10, (8,))
+"""
+    assert lint_snippet(src, select=["SH003"]) == []
+
+
+# ---- SH004 debug leftovers -----------------------------------------
+
+
+SH004 = """
+import jax
+
+def forward(x):
+    jax.debug.print("x = {}", x)
+    breakpoint()
+    return x
+"""
+
+
+def test_sh004_debug_aids_flagged():
+    found = lint_snippet(SH004, select=["SH004"])
+    assert codes(found) == ["SH004"]
+    assert len(found) == 2
+
+
+def test_sh004_allowed_in_tests():
+    assert lint_snippet(SH004, filename="tests/test_forward.py") == []
+    assert lint_snippet(SH004, filename="test_forward.py") == []
+
+
+def test_sh004_pdb_import():
+    found = lint_snippet("import pdb\n", select=["SH004"])
+    assert codes(found) == ["SH004"]
+
+
+# ---- SH005 set-iteration order -------------------------------------
+
+
+def test_sh005_set_literal_iteration():
+    src = """
+def build(tree):
+    return [tree[k] for k in {"a", "b"}]
+"""
+    assert codes(lint_snippet(src, select=["SH005"])) == ["SH005"]
+
+
+def test_sh005_set_call_iteration():
+    src = """
+def build(names):
+    out = {}
+    for n in set(names):
+        out[n] = 1
+    return out
+"""
+    assert codes(lint_snippet(src, select=["SH005"])) == ["SH005"]
+
+
+def test_sh005_sorted_set_is_clean():
+    src = """
+def build(names):
+    return {n: 1 for n in sorted(set(names))}
+"""
+    assert lint_snippet(src, select=["SH005"]) == []
+
+
+# ---- SH006 dead config fields --------------------------------------
+
+
+SH006_CONFIG = """
+from dataclasses import dataclass
+
+@dataclass(frozen=True)
+class ModelConfig:
+    d_model: int = 512
+    dead_flag: bool = False
+    validated_only: bool = False
+
+    def validate(self):
+        if self.validated_only:
+            raise ValueError("nope")
+        return self
+"""
+
+SH006_USER = """
+def width(cfg):
+    return cfg.d_model * 4
+"""
+
+
+def test_sh006_dead_and_validate_only_fields():
+    found = lint_files(
+        {"pkg/config.py": SH006_CONFIG, "pkg/model.py": SH006_USER},
+        select=["SH006"],
+    )
+    flagged = sorted(f.message.split()[2] for f in found)
+    assert codes(found) == ["SH006"]
+    assert flagged == [
+        "ModelConfig.dead_flag", "ModelConfig.validated_only",
+    ]
+
+
+def test_sh006_getattr_read_counts():
+    user = SH006_USER + """
+def flag(cfg):
+    return getattr(cfg, "dead_flag")
+
+def other(cfg):
+    return cfg.validated_only
+"""
+    found = lint_files(
+        {"pkg/config.py": SH006_CONFIG, "pkg/model.py": user},
+        select=["SH006"],
+    )
+    assert found == []
+
+
+def test_sh006_no_config_file_no_findings():
+    assert lint_snippet(SH006_USER, select=["SH006"]) == []
+
+
+# ---- SH007 sharding-constraint asymmetry ---------------------------
+
+
+SH007 = """
+from shellac_tpu.parallel.sharding import constrain
+
+def prefill_attn(x, mesh):
+    return constrain(x, mesh, ("batch", "seq", None))
+
+def decode_attn(x, mesh):
+    return x
+"""
+
+
+def test_sh007_asymmetric_pair_flagged():
+    found = lint_snippet(SH007, select=["SH007"])
+    assert codes(found) == ["SH007"]
+    assert len(found) == 1
+    assert "decode_attn" in found[0].message
+
+
+def test_sh007_symmetric_pair_clean():
+    fixed = SH007.replace(
+        "def decode_attn(x, mesh):\n    return x",
+        "def decode_attn(x, mesh):\n"
+        "    return constrain(x, mesh, (\"batch\", None, None))",
+    )
+    assert lint_snippet(fixed, select=["SH007"]) == []
+
+
+def test_sh007_fwd_bwd_pair():
+    src = """
+import jax
+
+def attn_fwd(x):
+    return jax.lax.with_sharding_constraint(x, None)
+
+def attn_bwd(g):
+    return g
+"""
+    found = lint_snippet(src, select=["SH007"])
+    assert codes(found) == ["SH007"]
+    assert "attn_bwd" in found[0].message
+
+
+# ---- suppressions ---------------------------------------------------
+
+
+def test_line_suppression():
+    src = SH001_CALL.replace(
+        "step = jax.jit(train_step)",
+        "step = jax.jit(train_step)  # shellac: ignore[SH001]",
+    )
+    assert lint_snippet(src) == []
+
+
+def test_line_suppression_is_rule_specific():
+    src = SH001_CALL.replace(
+        "step = jax.jit(train_step)",
+        "step = jax.jit(train_step)  # shellac: ignore[SH004]",
+    )
+    assert codes(lint_snippet(src)) == ["SH001"]
+
+
+def test_file_level_suppression():
+    src = "# shellac: ignore[SH001]\n" + SH001_CALL
+    assert lint_snippet(src) == []
+
+
+def test_file_level_suppression_multiple_rules():
+    src = "# shellac: ignore[SH001, SH004]\n" + SH001_CALL + SH004
+    assert lint_snippet(src) == []
+
+
+def test_marker_inside_string_literal_does_not_suppress():
+    # A suppression marker embedded in a string (e.g. worker source code
+    # built inside a test) must not silence rules in the enclosing file.
+    src = (
+        'WORKER_SRC = "# shellac: ignore[SH001]"\n'
+        + SH001_CALL
+    )
+    assert codes(lint_snippet(src)) == ["SH001"]
+
+
+def test_marker_at_column_zero_inside_multiline_string():
+    src = (
+        'WORKER_SRC = """\n'
+        "# shellac: ignore[SH001]\n"
+        '"""\n'
+        + SH001_CALL
+    )
+    assert codes(lint_snippet(src)) == ["SH001"]
+
+
+# ---- engine plumbing ------------------------------------------------
+
+
+def test_parse_error_is_reported():
+    found = lint_snippet("def broken(:\n")
+    assert codes(found) == ["SH000"]
+
+
+def test_unknown_rule_code_raises():
+    with pytest.raises(KeyError):
+        lint_snippet("x = 1\n", select=["SH999"])
+
+
+def test_select_and_ignore():
+    src = SH001_CALL + SH004
+    assert codes(lint_snippet(src)) == ["SH001", "SH004"]
+    assert codes(lint_snippet(src, select=["SH004"])) == ["SH004"]
+    assert codes(lint_snippet(src, ignore=["SH004"])) == ["SH001"]
+
+
+def test_findings_are_sorted_and_located():
+    found = lint_snippet(SH001_CALL)
+    assert found == sorted(found)
+    f = found[0]
+    assert f.path == "mod.py" and f.line > 1 and f.col >= 1
+
+
+# ---- CLI ------------------------------------------------------------
+
+
+ALL_RULE_FIXTURES = {
+    "sh001.py": SH001_CALL,
+    "sh002.py": SH002_JIT,
+    "sh003.py": """
+import time
+import jax
+
+@jax.jit
+def train_step(state):
+    return state, time.time()
+""",
+    "sh004.py": SH004,
+    "sh005.py": "vals = [k for k in {'a', 'b'}]\n",
+    "config.py": SH006_CONFIG,
+    "sh007.py": SH007,
+}
+
+
+@pytest.fixture(scope="module")
+def fixture_tree(tmp_path_factory):
+    root = tmp_path_factory.mktemp("lint_fixtures")
+    for name, src in ALL_RULE_FIXTURES.items():
+        (root / name).write_text(src)
+    return root
+
+
+def test_cli_exits_nonzero_on_each_rule(fixture_tree, capsys):
+    rc = lint_main([str(fixture_tree)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    for code in ["SH001", "SH002", "SH003", "SH004", "SH005", "SH006",
+                 "SH007"]:
+        assert code in out, f"{code} missing from CLI output"
+
+
+def test_cli_each_rule_fixture_fails_alone(fixture_tree):
+    # config.py rides along for SH006 (a project rule needs it), but
+    # every fixture must fail on its own rule via --select.
+    by_rule = {
+        "SH001": "sh001.py", "SH002": "sh002.py", "SH003": "sh003.py",
+        "SH004": "sh004.py", "SH005": "sh005.py", "SH007": "sh007.py",
+    }
+    for code, name in by_rule.items():
+        rc = lint_main([str(fixture_tree / name), "--select", code])
+        assert rc == 1, f"{code} fixture did not fail"
+    rc = lint_main([str(fixture_tree / "config.py"), "--select", "SH006"])
+    assert rc == 1, "SH006 fixture did not fail"
+
+
+def test_cli_json_report(fixture_tree, capsys):
+    import json
+
+    rc = lint_main([str(fixture_tree), "--format", "json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["version"] == 1
+    assert report["summary"]["findings"] == len(report["findings"])
+    assert set(report["summary"]["by_rule"]) >= {"SH001", "SH006"}
+    f = report["findings"][0]
+    assert {"rule", "path", "line", "col", "message"} <= set(f)
+
+
+def test_cli_clean_exit_zero(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    assert lint_main([str(tmp_path)]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_missing_path_exit_two(tmp_path):
+    assert lint_main([str(tmp_path / "nope.xyz")]) == 2
+
+
+def test_cli_list_rules(capsys):
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for code in ["SH001", "SH002", "SH003", "SH004", "SH005", "SH006",
+                 "SH007"]:
+        assert code in out
+
+
+# ---- lint_report.py diffing ----------------------------------------
+
+
+def test_lint_report_diff(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    base = {"version": 1, "findings": [
+        {"rule": "SH004", "path": "a.py", "line": 3, "col": 1,
+         "message": "old"},
+    ]}
+    cur = {"version": 1, "findings": [
+        {"rule": "SH004", "path": "a.py", "line": 9, "col": 1,
+         "message": "old"},
+        {"rule": "SH001", "path": "b.py", "line": 2, "col": 1,
+         "message": "fresh"},
+    ]}
+    bp, cp = tmp_path / "base.json", tmp_path / "cur.json"
+    bp.write_text(json.dumps(base))
+    cp.write_text(json.dumps(cur))
+    script = REPO / "scripts" / "lint_report.py"
+
+    r = subprocess.run(
+        [sys.executable, str(script), str(bp), str(cp), "--fail-on-new"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "fresh" in r.stdout
+    # A finding that only moved lines is not "new".
+    r = subprocess.run(
+        [sys.executable, str(script), str(cp), str(cp), "--fail-on-new"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0
+
+
+# ---- the meta-test: the live tree stays clean ----------------------
+
+
+def test_live_tree_is_lint_clean():
+    findings = lint_paths([str(REPO / "shellac_tpu")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_scripts_and_bench_are_lint_clean():
+    findings = lint_paths(
+        [str(REPO / "scripts"), str(REPO / "bench.py")]
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)

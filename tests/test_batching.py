@@ -293,12 +293,16 @@ class TestStopSequences:
         toks = rng.integers(0, cfg.vocab_size, 5)
         full = _ref_generate(cfg, params, toks, 10)
         stop = [full[4]]
+        # The stop token may also occur before index 4 (the sampled
+        # sequence is backend/version dependent); generation ends at its
+        # FIRST occurrence, wherever that is.
+        expect = full[: full.index(full[4])]
         for ticks in (1, 4):
             srv = BatchingEngine(
                 cfg, params, n_slots=2, max_len=64, decode_ticks=ticks
             )
             srv.submit("x", toks, 10, stop=[stop])
-            assert srv.run()["x"] == full[:4], ticks
+            assert srv.run()["x"] == expect, ticks
 
     def test_no_match_runs_to_budget(self, setup):
         cfg, params = setup

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from shellac_tpu import ParallelConfig, get_model_config, make_mesh
+from shellac_tpu import get_model_config
 from shellac_tpu.config import TrainConfig
 from shellac_tpu.training import (
     batch_shardings,

@@ -12,7 +12,6 @@ import threading
 import urllib.request
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

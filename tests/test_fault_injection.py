@@ -21,7 +21,6 @@ import urllib.error
 import urllib.request
 
 import jax
-import numpy as np
 import pytest
 
 from shellac_tpu import get_model_config
@@ -250,6 +249,10 @@ print("WORKER_OK", jax.process_index(), flush=True)
 """
 
 
+from conftest import needs_multiprocess_cpu as _needs_multiprocess_cpu
+
+
+@_needs_multiprocess_cpu
 class TestMultihostFaults:
     def test_follower_death_detected_loudly(self, tmp_path):
         run_two_process(tmp_path, _FOLLOWER_DEATH_WORKER, timeout=420,

@@ -9,7 +9,6 @@ bit-identical to a local single-process unsharded engine.
 """
 
 import jax
-import numpy as np
 import pytest
 
 from shellac_tpu import get_model_config
@@ -144,6 +143,10 @@ print("WORKER_OK", jax.process_index(), flush=True)
 """
 
 
+from conftest import needs_multiprocess_cpu as _needs_multiprocess_cpu
+
+
+@_needs_multiprocess_cpu
 class TestMultihostServing:
     def _run_pair(self, tmp_path, source):
         from conftest import run_two_process

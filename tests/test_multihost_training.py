@@ -12,7 +12,6 @@ batch semantics, not just "it ran".
 import re
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from shellac_tpu import ParallelConfig, get_model_config, make_mesh
@@ -153,6 +152,10 @@ print("WORKER_OK", proc, flush=True)
 from conftest import run_two_process as _run_pair
 
 
+from conftest import needs_multiprocess_cpu as _needs_multiprocess_cpu
+
+
+@_needs_multiprocess_cpu
 class TestMultihostTraining:
     def test_fit_checkpoint_resume(self, tmp_path):
         """fit() across 2 processes: collective orbax saves, proc-0-only

@@ -1,7 +1,5 @@
 """Native (C++) data loader tests. Builds the .so on first run."""
 
-import os
-
 import numpy as np
 import pytest
 

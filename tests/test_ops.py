@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from shellac_tpu.ops.activations import softcap, swiglu
-from shellac_tpu.ops.attention import attention, attention_ref
+from shellac_tpu.ops.attention import attention_ref
 from shellac_tpu.ops.flash_attention import flash_attention
 from shellac_tpu.ops.norms import rms_norm_pallas, rms_norm_ref
 from shellac_tpu.ops.rope import apply_rope, rope_angles

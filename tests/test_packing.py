@@ -8,7 +8,6 @@ cross-document leakage or position offset breaks the equality.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from shellac_tpu import get_model_config
 from shellac_tpu.config import TrainConfig

@@ -17,7 +17,6 @@ import urllib.request
 import jax
 import numpy as np
 import pytest
-from jax.sharding import PartitionSpec as P
 
 from shellac_tpu import ParallelConfig, get_model_config, make_mesh
 from shellac_tpu.inference.batching import BatchingEngine, PagedBatchingEngine

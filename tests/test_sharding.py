@@ -5,9 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from shellac_tpu import ParallelConfig, get_model_config, make_mesh
+from shellac_tpu import get_model_config
 from shellac_tpu.config import TrainConfig
-from shellac_tpu.models import transformer
 from shellac_tpu.parallel.mesh import factor_devices
 from shellac_tpu.parallel.sharding import logical_to_spec
 from shellac_tpu.training import (
