@@ -799,7 +799,12 @@ def cmd_serve(args):
             )
         mesh = global_mesh(pcfg)
         params = shard_params(cfg, params, mesh)
+    # Engine construction is wrapped in a zero-arg closure wherever an
+    # engine is built here: the serving supervisor's auto-recovery
+    # (serve --restart-budget) rebuilds a fresh engine from it after a
+    # wedge, so the factory must capture everything construction needs.
     engine = None
+    engine_factory = None
     if args.draft_model:
         import jax
 
@@ -813,16 +818,20 @@ def cmd_serve(args):
         dparams = transformer.init_params(dcfg, jax.random.PRNGKey(args.seed))
         if mesh is not None:
             dparams = shard_params(dcfg, dparams, mesh)
-        engine = SpeculativeBatchingEngine(
-            cfg, params, dcfg, dparams, gamma=args.gamma,
-            n_slots=args.slots, max_len=args.max_len or cfg.max_seq_len,
-            temperature=args.temperature, eos_id=args.eos_id,
-            seed=args.seed, logprobs=args.logprobs,
-            top_logprobs=args.top_logprobs,
-            max_prefills_per_step=args.max_prefills_per_step,
-            prefill_chunk=args.prefill_chunk,
-            mesh=mesh,
-        )
+
+        def engine_factory():
+            return SpeculativeBatchingEngine(
+                cfg, params, dcfg, dparams, gamma=args.gamma,
+                n_slots=args.slots, max_len=args.max_len or cfg.max_seq_len,
+                temperature=args.temperature, eos_id=args.eos_id,
+                seed=args.seed, logprobs=args.logprobs,
+                top_logprobs=args.top_logprobs,
+                max_prefills_per_step=args.max_prefills_per_step,
+                prefill_chunk=args.prefill_chunk,
+                mesh=mesh,
+            )
+
+        engine = engine_factory()
     if args.paged or (engine is None and mesh is not None):
         from shellac_tpu.inference.batching import (
             BatchingEngine,
@@ -837,27 +846,40 @@ def cmd_serve(args):
         else:
             extra = {"rolling_window": args.rolling_window,
                      "pp_pipeline": args.pp_pipeline}
-        engine = kind(
-            cfg, params, n_slots=args.slots,
-            max_len=args.max_len or cfg.max_seq_len,
-            temperature=args.temperature, eos_id=args.eos_id,
-            decode_ticks=args.decode_ticks,
-            max_prefills_per_step=args.max_prefills_per_step,
-            prefill_chunk=args.prefill_chunk,
-            logprobs=args.logprobs,
-            top_logprobs=args.top_logprobs,
-            mesh=mesh,
-            kv_quant=args.kv_quant,
-            **extra,
-        )
+
+        def engine_factory():
+            return kind(
+                cfg, params, n_slots=args.slots,
+                max_len=args.max_len or cfg.max_seq_len,
+                temperature=args.temperature, eos_id=args.eos_id,
+                decode_ticks=args.decode_ticks,
+                max_prefills_per_step=args.max_prefills_per_step,
+                prefill_chunk=args.prefill_chunk,
+                logprobs=args.logprobs,
+                top_logprobs=args.top_logprobs,
+                mesh=mesh,
+                kv_quant=args.kv_quant,
+                **extra,
+            )
+
+        engine = engine_factory()
     if multihost:
         from shellac_tpu.inference.multihost import MultihostEngine
 
         engine = MultihostEngine(engine)
+        # Recovery on a pod is an epoch resync, not a rebuild: the
+        # wrapper drops local work and broadcasts an epoch bump so
+        # followers resynchronize (scheduler-death faults only; a
+        # truly wedged native collective goes fatal immediately — the
+        # stuck thread still owns the engine — see docs/inference.md).
+        engine_factory = engine.resync
         if not engine.is_primary:
             # Followers never open a port: they mirror the primary's
-            # command stream until it broadcasts shutdown.
-            engine.serve_forever()
+            # command stream until it broadcasts shutdown. The fault
+            # budget mirrors the primary's restart budget — 0 keeps
+            # the loud crash-on-exception contract on both sides.
+            engine.serve_forever(fault_budget=args.restart_budget,
+                                 fault_window=args.restart_window)
             return 0
     serve(
         cfg, params,
@@ -865,6 +887,7 @@ def cmd_serve(args):
         tokenizer=get_tokenizer(args.tokenizer),
         model_name=(args.model or "shellac_tpu"),
         engine=engine,
+        engine_factory=engine_factory,
         n_slots=args.slots, max_len=args.max_len,
         temperature=args.temperature, eos_id=args.eos_id,
         decode_ticks=args.decode_ticks,
@@ -875,6 +898,10 @@ def cmd_serve(args):
         kv_quant=args.kv_quant,
         rolling_window=args.rolling_window,
         step_timeout=args.step_timeout,
+        max_pending=args.max_pending,
+        restart_budget=args.restart_budget,
+        restart_window=args.restart_window,
+        heartbeat_path=args.heartbeat_file,
     )
     return 0
 
@@ -1162,12 +1189,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "n_slots divisible by pp)")
     s.add_argument("--step-timeout", type=float, default=None,
                    dest="step_timeout",
-                   help="fail the server loudly if one engine step "
-                        "exceeds this many seconds (wedged collective / "
-                        "lost follower detection for multi-host serving; "
-                        "size it above the worst compile, including "
-                        "late retraces — see docs/inference.md failure "
-                        "semantics)")
+                   help="fail in-flight requests loudly if one engine "
+                        "step exceeds this many seconds (wedged "
+                        "collective / lost follower detection; with "
+                        "--restart-budget the supervisor then rebuilds "
+                        "the engine and resumes). Size it above the "
+                        "worst compile, including late retraces — see "
+                        "docs/inference.md failure semantics")
+    s.add_argument("--restart-budget", type=int, default=0,
+                   dest="restart_budget",
+                   help="auto-recovery: after a wedged step or dead "
+                        "scheduler, fail in-flight requests loudly and "
+                        "rebuild a fresh engine, up to N times per "
+                        "--restart-window before staying fatal "
+                        "(0 = fail terminally, the old contract)")
+    s.add_argument("--restart-window", type=float, default=300.0,
+                   dest="restart_window",
+                   help="sliding window (seconds) for --restart-budget; "
+                        "a crash-looping engine exhausts the budget "
+                        "inside it and the server goes fatal")
+    s.add_argument("--max-pending", type=int, default=None,
+                   dest="max_pending",
+                   help="admission control: reject new requests with "
+                        "HTTP 429 + Retry-After once this many are "
+                        "pending, instead of queueing unboundedly")
+    s.add_argument("--heartbeat-file", default=None, dest="heartbeat_file",
+                   help="liveness file the serving scheduler touches "
+                        "every second, for external watchdogs "
+                        "(utils.failure.Heartbeat.is_stale)")
     s.add_argument("--max-prefills-per-step", type=int, default=1,
                    dest="max_prefills_per_step",
                    help="cap prefills per engine step so prompt bursts "

@@ -292,6 +292,11 @@ class BatchingEngine:
                                jnp.float32)
         self._sminp = jnp.full((n_slots,), self._defaults["min_p"],
                                jnp.float32)
+        # The construction seed is retained (not just consumed into the
+        # key) so the multi-host epoch resync can re-key deterministically
+        # per (seed, epoch) instead of collapsing every job onto the
+        # same post-recovery stream.
+        self.seed = int(seed)
         self._key = jax.random.PRNGKey(seed)
 
         # kv_quant="int8": the slot cache stores int8 KV + per-token
@@ -1443,6 +1448,30 @@ class BatchingEngine:
                 return True
         return False
 
+    def abort_all(self) -> List[Any]:
+        """Drop EVERY queued and in-flight request (caller must be the
+        engine-owning thread); returns the dropped rids. The supervisor
+        rebuild / multi-host epoch-resync helper: slots release cleanly
+        (paged pools get their blocks back), per-slot sampling state
+        clears through _release_slot, and stale finished_* deposits are
+        swept so a rebuilt server cannot hand a new request an old
+        generation's logprobs. Device cache rows need no repair — stale
+        rows are self-healing (lengths roll back at the next admit)."""
+        dropped = [req.rid for req in self._queue]
+        self._queue.clear()
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            dropped.append(req.rid)
+            self._slots[i] = None
+            self._release_slot(i)
+        self._prefilling.clear()
+        self.finished_logprobs.clear()
+        self.finished_prompt_logprobs.clear()
+        self.finished_top_logprobs.clear()
+        self.stats["requests_cancelled"] += len(dropped)
+        return dropped
+
     @property
     def pending(self) -> int:
         return len(self._queue) + sum(r is not None for r in self._slots)
@@ -1519,6 +1548,7 @@ class PagedBatchingEngine(BatchingEngine):
             cfg, n_slots, n_blocks, block_size, max_blocks_per_slot
         )
         self._mesh_setup()  # re-pin shardings for the paged pytree
+        self._n_blocks = n_blocks
         self._free: List[int] = list(range(n_blocks - 1, 0, -1))  # 0 = scratch
         self._slot_blocks: List[List[int]] = [[] for _ in range(n_slots)]
         # Prefix cache state (all host-side; empty when disabled):
@@ -1699,6 +1729,22 @@ class PagedBatchingEngine(BatchingEngine):
         self._cache = self._cache.replace(
             tables=self._cache.tables.at[slot].set(row)
         )
+
+    def abort_all(self) -> List[Any]:
+        """Paged abort additionally resets the ALLOCATOR to its
+        canonical pristine state: prefix-cache registries purged and
+        the free list rebuilt in constructor order. Keeping cached
+        prefix blocks (the normal release behavior) would be a
+        correctness bug on the multi-host resync path — replicas abort
+        AFTER diverging, so their registries/free lists differ, and a
+        later prompt would prefix-hit on one host but miss on another:
+        different-shaped programs, wedged collective all over again."""
+        dropped = super().abort_all()
+        self._hash_to_block.clear()
+        self._block_ref.clear()
+        self._pending_reg.clear()
+        self._free = list(range(self._n_blocks - 1, 0, -1))
+        return dropped
 
     def _pre_decode(self, active_rows) -> None:
         # Backstop only — admission already reserved the full footprint.

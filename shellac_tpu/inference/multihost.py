@@ -64,6 +64,10 @@ class MultihostEngine:
         self.needs_heartbeat = jax.process_count() > 1
         self._pending: List[Tuple[str, tuple, dict]] = []
         self._stopped = False
+        # Serving epoch: bumped by the primary's supervisor on recovery
+        # (resync()); the bump rides the command broadcast so followers
+        # drop the same in-flight work the primary just dropped.
+        self.epoch = 0
 
     # ---- primary-side surface (mirrors BatchingEngine) ---------------
 
@@ -100,6 +104,48 @@ class MultihostEngine:
         self._exchange()
         self._stopped = True
 
+    def resync(self) -> "MultihostEngine":
+        """Supervisor recovery hook (primary only): bump the serving
+        epoch, drop every local queued/in-flight request, and buffer the
+        epoch command so followers drop the same work at the next
+        step's broadcast instead of wedging on a collective for a
+        request the primary no longer tracks. Returns self, so it slots
+        in as the server's engine_factory.
+
+        Scope: this recovers the SCHEDULER-DEATH class of faults — the
+        step raised (a follower was preempted and replaced, a transient
+        transport error) but the process group is still alive, so the
+        next broadcast goes through. A step wedged in native code (dead
+        follower mid-collective on a real pod) cannot be resynced
+        in-process: the old scheduler thread never returns and still
+        owns this engine, so the supervisor refuses the in-place
+        factory and goes fatal IMMEDIATELY on a wedge — no restart
+        budget is consumed ("restart the pod")."""
+        self._require_primary("resync")
+        if self._stopped:
+            raise RuntimeError("resync() after shutdown: followers are "
+                               "released and cannot rejoin this job")
+        self.epoch += 1
+        self._apply_epoch(self.epoch)
+        self._pending.append(("epoch", (self.epoch,), {}))
+        return self
+
+    def _apply_epoch(self, epoch: int) -> None:
+        """Reset the local replica to the epoch's canonical state:
+        no in-flight work, and the sampling PRNG re-keyed from
+        (construction seed, epoch). The re-key is what restores
+        bit-identity after a follower is REPLACED (its fresh engine
+        starts at the seed while survivors' keys were split once per
+        served decode step — without this, the first sampled request
+        after recovery would diverge across hosts and wedge the pod
+        all over again); folding the retained seed keeps post-recovery
+        sampling seed-dependent and reproducible."""
+        self.epoch = epoch
+        self.engine.abort_all()
+        self.engine._key = jax.random.fold_in(
+            jax.random.PRNGKey(getattr(self.engine, "seed", 0)), epoch
+        )
+
     @property
     def pending(self) -> int:
         return self.engine.pending
@@ -133,6 +179,15 @@ class MultihostEngine:
             if op == _STOP:
                 self._stopped = True
                 return None
+            if op == "epoch":
+                # Epoch bump: the primary's supervisor recovered and
+                # reset its replica; mirror that here (drop in-flight
+                # work, re-key the PRNG from the epoch) so the replicas
+                # re-enter lockstep on identical state. The primary
+                # already applied its side in resync().
+                if not self.is_primary:
+                    self._apply_epoch(args[0])
+                continue
             if self.is_primary:
                 continue  # already applied at submit/cancel time
             if op == "submit":
@@ -142,10 +197,37 @@ class MultihostEngine:
                 self.engine.cancel(*args)
         return self.engine.step()
 
-    def serve_forever(self) -> None:
-        """Follower loop: step in lockstep until the primary shuts down."""
-        while self.step() is not None:
-            pass
+    def serve_forever(self, *, fault_budget: int = 0,
+                      fault_window: float = 300.0) -> None:
+        """Follower loop: step in lockstep until the primary shuts
+        down.
+
+        fault_budget (default 0 = any exception re-raises, the loud
+        legacy contract) opts into the supervisor's recovery story —
+        wire it to the SAME value as the primary's restart budget. A
+        replicated engine-step exception (the deterministic
+        scheduler-death class — it raises on EVERY host, not just the
+        primary) is then survivable: the follower drops its local work
+        and keeps participating in the command stream, so the
+        primary's epoch bump can resynchronize it instead of finding
+        no peers left for the next broadcast. A fault local to THIS
+        follower cannot be absorbed that way — the other replicas kept
+        their state, the next collective wedges, and the primary's
+        step watchdog turns the pod fatal (which is why the docs
+        require --step-timeout alongside a multi-host restart budget);
+        a dead transport raising on every exchange exhausts the budget
+        in seconds and re-raises, keeping total-loss failures loud."""
+        from shellac_tpu.utils.failure import RestartBudget
+
+        budget = RestartBudget(fault_budget, fault_window)
+        while True:
+            try:
+                if self.step() is None:
+                    return
+            except Exception:
+                if not budget.allow():
+                    raise
+                self.engine.abort_all()
 
     def run(self, requests=None):
         """Drain helper, same contract as BatchingEngine.run. On the

@@ -6,6 +6,19 @@ threads just enqueue work and wait on per-request events. POSTs block
 until their request completes — the concurrency lives in the slot
 batch, not in the HTTP layer.
 
+A SUPERVISOR wraps the scheduler in engine *generations*: when the
+step watchdog trips (wedged collective) or the scheduler thread dies,
+every in-flight request fails loudly, the wedged thread is abandoned,
+and — within the restart budget — a fresh engine is rebuilt from the
+retained params/config under a new generation and serving resumes.
+Results a stale generation ever produces are discarded. Admission is
+bounded (`max_pending`): over-limit submissions are rejected
+immediately (HTTP 429 + Retry-After; `ServerUnavailable` for
+programmatic callers) instead of queueing without limit, and a
+request's client timeout rides its submit tuple as a deadline — the
+scheduler sheds requests whose deadline already expired before
+spending prefill compute on them.
+
 API:
   POST /generate  {"tokens": [1,2,3] | "text": "...", "max_new": 32,
                    "stop": [[7,8], "..."]?,
@@ -28,13 +41,21 @@ API:
                   sequences, the longest stop length is held back from
                   deltas so a token that a later match would truncate is
                   never streamed.
-  GET  /health    -> {"ok": true, "pending": N}
+  GET  /health    -> readiness: 200 {"status": "ok", ...} only while
+                  serving; 503 with "recovering" (supervisor mid-
+                  rebuild) or "failed" (fatal, message included).
+                  Always carries pending/queue depth, restart count,
+                  shed count, and the engine generation.
   GET  /stats     -> engine counters (requests/tokens/steps/prefills,
-                     slots busy, decode_ticks)
+                     slots busy, decode_ticks) plus supervisor state
+                     ("fatal", "status", "restarts", "generation",
+                     "shed") — stays 200 even when fatal, so scrapers
+                     keep collecting through an outage.
 """
 
 from __future__ import annotations
 
+import functools
 import itertools
 import json
 import queue
@@ -42,12 +63,13 @@ import threading
 import time
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
 from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.batching import BatchingEngine
+from shellac_tpu.utils.failure import Heartbeat, RestartBudget
 
 
 def _render_plp(plp):
@@ -57,12 +79,64 @@ def _render_plp(plp):
     return [None] + plp[1:]
 
 
+class ServerUnavailable(RuntimeError):
+    """The server pushed back instead of serving: over the pending cap
+    (HTTP 429), mid-recovery, or a request shed on an expired deadline
+    (both HTTP 503). A RuntimeError subclass so programmatic callers
+    that only know the old fatal contract still fail loudly; the HTTP
+    layer maps it to the right status plus a Retry-After header
+    instead of a generic 500."""
+
+    def __init__(self, msg: str, *, http_status: int = 503,
+                 retry_after: float = 1.0):
+        super().__init__(msg)
+        self.http_status = http_status
+        self.retry_after = retry_after
+
+
+class _Generation:
+    """One scheduler-thread + engine incarnation.
+
+    The supervisor replaces the WHOLE object on recovery: a wedged
+    scheduler thread keeps references to its own engine, submit queue,
+    and stop event, so it can never consume a successor's work — and
+    `dead` / the identity check against the server's current generation
+    make any results it produces after un-wedging discardable."""
+
+    __slots__ = ("gen", "engine", "submit_q", "stop", "step_started",
+                 "thread", "dead")
+
+    def __init__(self, gen: int, engine):
+        self.gen = gen
+        self.engine = engine
+        self.submit_q: queue.Queue = queue.Queue()
+        self.stop = threading.Event()
+        # Wall-clock (monotonic) start of the engine step in flight,
+        # None between steps; the watchdog reads it cross-thread.
+        self.step_started: Optional[float] = None
+        self.thread: Optional[threading.Thread] = None
+        # Set (under the server lock) the moment the supervisor starts
+        # replacing this generation; admission and the watchdog treat a
+        # dead generation as already gone.
+        self.dead = False
+
+
 class _Pending:
     __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback",
-                 "lps", "plp", "tlp", "rid")
+                 "lps", "plp", "tlp", "rid", "deadline", "kind")
 
-    def __init__(self, rid, stream: bool = False, holdback: int = 0):
+    def __init__(self, rid, stream: bool = False, holdback: int = 0,
+                 deadline: Optional[float] = None):
         self.rid = rid
+        # Absolute monotonic deadline mirroring the client's timeout;
+        # the scheduler sheds the request if this expires before its
+        # prefill ever runs (None = no deadline).
+        self.deadline = deadline
+        # How the error in `error` should surface: "error" (bad
+        # request, ValueError/400), "fault" (server fault,
+        # RuntimeError/500), "shed" (expired deadline under
+        # saturation, ServerUnavailable/503 — retryable, unlike 400).
+        self.kind = "error"
         self.event = threading.Event()
         self.result = None
         self.error: Optional[str] = None
@@ -96,132 +170,402 @@ class InferenceServer:
         engine: Optional[BatchingEngine] = None,
         model_name: str = "shellac_tpu",
         step_timeout: Optional[float] = None,
+        max_pending: Optional[int] = None,
+        restart_budget: int = 0,
+        restart_window: float = 300.0,
+        engine_factory: Optional[Callable[[], Any]] = None,
+        heartbeat_path: Optional[str] = None,
         **engine_kw,
     ):
-        self.engine = engine or BatchingEngine(cfg, params, **engine_kw)
-        self.model_name = model_name
-        # Multi-host engines need a step per loop iteration even when
-        # idle: follower processes wait inside the command broadcast,
-        # and an un-stepped primary would leave them parked in a device
-        # collective until its transport times out.
-        self._heartbeat = bool(getattr(self.engine, "needs_heartbeat", False))
-        self.tokenizer = tokenizer
-        self._constraint_cache: "OrderedDict[str, Any]" = OrderedDict()
-        self._submit_q: queue.Queue = queue.Queue()
-        self._pending: Dict[int, _Pending] = {}
-        self._ids = itertools.count()
-        self._stop = threading.Event()
-        self._fatal: Optional[str] = None
-        # Failure detection for hung engine steps. A follower process
+        # Validate BEFORE starting the scheduler thread: raising after
+        # start() would orphan an engine-owning daemon thread the
+        # caller can never close().
+        #
+        # step_timeout arms the wedge watchdog. A follower process
         # dying mid-collective leaves the primary's step() WEDGED in
         # native code — no exception ever surfaces, so the scheduler-
         # death path alone cannot save pending requests. The watchdog
-        # detects the stall from outside, marks the server failed, and
-        # fails everything loudly; the stuck scheduler thread itself is
-        # unrecoverable (daemon — it cannot be interrupted from Python)
-        # and the operator restarts the pod. serve --step-timeout wires
-        # this; single-host deployments usually leave it off (a long
-        # prefill compile would trip a short timeout).
+        # detects the stall from outside and hands the generation to
+        # the supervisor. serve --step-timeout wires this; single-host
+        # deployments usually leave it off (a long prefill compile
+        # would trip a short timeout).
         if step_timeout is not None and step_timeout <= 0:
-            # Validate BEFORE starting the scheduler thread: raising
-            # after start() would orphan an engine-owning daemon thread
-            # the caller can never close().
             raise ValueError("step_timeout must be > 0 seconds")
+        if max_pending is not None and max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
+        if restart_budget < 0:
+            raise ValueError("restart_budget must be >= 0")
+        if restart_budget > 0 and engine is not None and engine_factory is None:
+            raise ValueError(
+                "restart_budget > 0 with a prebuilt engine needs an "
+                "engine_factory: the server cannot rebuild an engine "
+                "it did not construct"
+            )
+        if engine is None:
+            engine = BatchingEngine(cfg, params, **engine_kw)
+            if engine_factory is None:
+                # Retained cfg/params/engine_kw rebuild an identical
+                # engine on recovery; params are shared with the dead
+                # engine, which is safe — jax arrays are immutable.
+                engine_factory = functools.partial(
+                    BatchingEngine, cfg, params, **engine_kw
+                )
+        self.model_name = model_name
+        self.tokenizer = tokenizer
+        self._constraint_cache: "OrderedDict[str, Any]" = OrderedDict()
+        self._pending: Dict[int, _Pending] = {}
+        self._ids = itertools.count()
+        # Serializes admission against the supervisor's generation swap
+        # and pending sweep: a request either lands in _pending before
+        # the sweep (and is failed loudly by it) or sees the post-swap
+        # state checks. Never held across an engine step.
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._fatal: Optional[str] = None
+        self._recovering = False
         self.step_timeout = step_timeout
-        self._step_started: Optional[float] = None
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        self.max_pending = max_pending
+        self._engine_factory = engine_factory
+        self._budget = (
+            RestartBudget(restart_budget, restart_window)
+            if restart_budget > 0 and engine_factory is not None else None
+        )
+        self.restarts = 0   # generations rebuilt by the supervisor
+        self.shed = 0       # requests shed on an expired deadline
+        # One-way flag letting the per-step shed sweep early-out in
+        # O(1) while NO request has ever carried a deadline (the
+        # common all-default-timeout deployment). Deliberately never
+        # reset — a stale True only costs the scan, a wrong False
+        # would stop shedding.
+        self._saw_deadline = False
+        # Liveness file beaten from the scheduler loop, so external
+        # watchdogs cover inference the same way they cover training.
+        # The step watchdog co-beats it while in-process recovery is
+        # still possible (and stops once fatal), so an external
+        # watchdog doesn't kill the pod mid-wedge-detection or
+        # mid-rebuild, defeating the supervisor. Two beaters need the
+        # lock: interleaved writes to the shared tmp file would
+        # publish a corrupt (= stale-looking) heartbeat.
+        self._hb = Heartbeat(heartbeat_path) if heartbeat_path else None
+        self._hb_last = 0.0
+        self._hb_lock = threading.Lock()
+        self._g = self._start_generation(0, engine)
+        self._g.thread.start()
         if step_timeout is not None:
             threading.Thread(target=self._watchdog, daemon=True).start()
 
-    # ---- scheduler thread (sole owner of the engine) ----------------
+    # The engine and scheduler thread of the CURRENT generation.
+    # Properties (not plain attributes) so every reader — /stats,
+    # tests, the OpenAI facade — always sees the live engine, never a
+    # wedged predecessor.
+    @property
+    def engine(self):
+        return self._g.engine
 
-    def _loop(self):
-        try:
-            self._run()
-        except BaseException as e:  # noqa: BLE001
-            # The scheduler thread is the only consumer; if it dies
-            # silently every pending and future request blocks forever.
-            # Fail everything loudly instead.
-            self._fail_everything(f"scheduler died: {type(e).__name__}: {e}")
+    @property
+    def _thread(self) -> threading.Thread:
+        return self._g.thread
 
-    def _fail_everything(self, msg: str) -> None:
-        """Mark the server failed: error out every pending and queued
-        request and refuse new ones. Called from the scheduler thread
-        (on an exception) or the step watchdog (on a wedge) — a benign
-        race: whichever runs second finds _pending empty."""
-        self._fatal = msg
-        self._stop.set()
-        for p in list(self._pending.values()):
+    @property
+    def status(self) -> str:
+        """Supervisor state: "ok" | "recovering" | "failed"."""
+        if self._fatal is not None:
+            return "failed"
+        if self._recovering or self._g.dead:
+            return "recovering"
+        return "ok"
+
+    def health(self) -> Dict[str, Any]:
+        """Readiness snapshot served at /health. All reads are plain
+        ints/strings — possibly stale, never torn."""
+        g = self._g
+        info: Dict[str, Any] = {
+            "status": self.status,
+            "ok": self.status == "ok",
+            "pending": len(self._pending),
+            "queue_depth": g.submit_q.qsize(),
+            "engine_pending": g.engine.pending,
+            "generation": g.gen,
+            "restarts": self.restarts,
+            "restart_budget_used": (self._budget.used
+                                    if self._budget is not None else None),
+            "shed": self.shed,
+            "max_pending": self.max_pending,
+        }
+        if self._fatal is not None:
+            info["error"] = self._fatal
+        return info
+
+    # ---- supervisor --------------------------------------------------
+
+    def _start_generation(self, gen: int, engine) -> _Generation:
+        g = _Generation(gen, engine)
+        g.thread = threading.Thread(
+            target=self._loop, args=(g,), daemon=True,
+            name=f"shellac-scheduler-gen{gen}",
+        )
+        return g
+
+    def _fail_pending_locked(self, msg: str) -> None:
+        """Fail every pending request loudly and drain the current
+        generation's submit queue (caller holds the lock, so no new
+        pending can land mid-sweep). Settlement is arbitrated by the
+        atomic dict pop: a scheduler racing this sweep (close() with a
+        step still finishing) pops each rid before delivering, so a
+        pending this loop can still pop is guaranteed unsettled — a
+        just-completed result is never clobbered with an error."""
+        while self._pending:
+            _, p = self._pending.popitem()
             p.error = msg
+            p.kind = "fault"
             p.finish()
-        self._pending.clear()
         while True:
             try:
-                rid, *_ = self._submit_q.get_nowait()
+                self._g.submit_q.get_nowait()
             except queue.Empty:
                 break
-            p = self._pending.pop(rid, None)
-            if p is not None:
-                p.error = msg
-                p.finish()
+
+    def _recover(self, g: _Generation, msg: str,
+                 wedged: bool = False) -> None:
+        """Supervisor transition out of a dead/wedged generation:
+        fail everything in flight loudly (the unchanged part of the
+        contract), then either rebuild a fresh engine under a new
+        generation and resume serving, or — restart budget exhausted,
+        no factory, in-place factory on a wedge, or server closing —
+        stay fatal. Called from the watchdog (wedge) or the dying
+        scheduler thread itself (exception); idempotent per generation.
+
+        Memory note: the abandoned thread's frames keep the old
+        engine's device allocations (KV cache, executables) alive for
+        as long as it stays wedged, so a REBUILD needs headroom for a
+        second engine. Size the cache/pool with that in mind, or leave
+        restart_budget=0 on memory-tight single-host deployments."""
+        with self._lock:
+            if g.dead or g is not self._g:
+                return  # this generation is already being replaced
+            g.dead = True
+            g.stop.set()  # a wedged thread that ever returns exits
+            self._fail_pending_locked(msg)
+            # An IN-PLACE factory (a bound method of the current
+            # engine, e.g. MultihostEngine.resync) mutates and reuses
+            # the engine the wedged thread is still stepping — two
+            # threads would then race one engine and its command
+            # broadcasts. Safe after scheduler DEATH (that thread has
+            # left the engine); terminal on a WEDGE.
+            in_place = (self._engine_factory is not None
+                        and getattr(self._engine_factory, "__self__",
+                                    None) is g.engine)
+            if wedged and in_place:
+                self._fatal = (
+                    f"{msg} [in-place resync cannot recover a wedged "
+                    "step: the stuck thread still owns the engine — "
+                    "restart the pod]"
+                )
+                return
+            recover = (self._budget is not None
+                       and not self._closed.is_set()
+                       and self._budget.allow())
+            if not recover:
+                if self._budget is not None and not self._closed.is_set():
+                    msg += (f" [restart budget exhausted: "
+                            f"{self._budget.max_restarts} restart(s) "
+                            f"per {self._budget.window:g}s]")
+                self._fatal = msg
+                return
+            self._recovering = True
+            self.restarts += 1
+        # Rebuild OUTSIDE the lock: engine construction allocates
+        # device memory and may compile, and /health + admission must
+        # stay responsive (reporting "recovering") meanwhile. Keep the
+        # liveness heartbeat fresh for the whole rebuild — without a
+        # step watchdog (no step_timeout) nothing else beats here, and
+        # an external watchdog restarting the pod mid-rebuild would
+        # defeat the supervisor.
+        stop_beat = threading.Event()
+        if self._hb is not None:
+            def _rebuild_beater():
+                while not stop_beat.wait(0.5):
+                    self._beat(g)
+
+            threading.Thread(target=_rebuild_beater, daemon=True).start()
+        try:
+            engine = self._engine_factory()
+        except Exception as e:  # noqa: BLE001 — any rebuild fault is fatal
+            with self._lock:
+                self._recovering = False
+                self._fatal = (f"{msg}; engine rebuild failed: "
+                               f"{type(e).__name__}: {e}")
+            return
+        finally:
+            stop_beat.set()
+        with self._lock:
+            self._recovering = False
+            if self._closed.is_set():
+                self._fatal = "server closed during recovery"
+                return
+            self._g = self._start_generation(g.gen + 1, engine)
+            self._g.thread.start()
 
     def _watchdog(self) -> None:
         """Detect a wedged engine step (lost follower, dead relay) from
-        outside the scheduler thread."""
+        outside the scheduler thread. One watchdog follows the
+        supervisor across generations for the server's lifetime; it
+        exits when the server closes or goes fatal."""
         poll = min(self.step_timeout / 4, 1.0)
-        while not self._stop.is_set():
-            started = self._step_started
-            if (started is not None
-                    and time.monotonic() - started > self.step_timeout):
-                self._fail_everything(
-                    f"engine step exceeded step_timeout="
-                    f"{self.step_timeout}s (wedged collective or lost "
-                    "follower); server marked failed — restart the pod"
-                )
+        while not self._closed.wait(poll):
+            if self._fatal is not None:
                 return
-            self._stop.wait(poll)
+            g = self._g
+            # Keep the liveness heartbeat fresh through wedge detection
+            # and rebuild: the scheduler loop cannot beat while its
+            # step is stuck, and an external watchdog restarting the
+            # pod mid-recovery would defeat the supervisor. Beats stop
+            # once fatal (above), handing the pod back to the external
+            # watchdog exactly when in-process recovery has given up.
+            self._beat(g)
+            started = g.step_started
+            if (g.dead or started is None
+                    or time.monotonic() - started <= self.step_timeout):
+                continue
+            self._recover(
+                g,
+                f"engine step exceeded step_timeout={self.step_timeout}s "
+                "(wedged collective or lost follower)",
+                wedged=True,
+            )
 
-    def _process_item(self, item) -> None:
-        rid, tokens, max_new, stop, samp = item
+    # ---- scheduler thread (sole owner of its generation's engine) ---
+
+    def _loop(self, g: _Generation) -> None:
+        try:
+            self._run(g)
+        except BaseException as e:  # noqa: BLE001
+            # The scheduler thread is the only consumer; if it dies
+            # silently every pending and future request blocks forever.
+            # Hand the generation to the supervisor: fail everything
+            # loudly, then rebuild within the restart budget.
+            self._recover(g, f"scheduler died: {type(e).__name__}: {e}")
+
+    def _beat(self, g: _Generation) -> None:
+        """Touch the liveness file at most once a second (from the
+        scheduler loop, and from the step watchdog while recovery is
+        possible); a full disk must degrade observability, not kill
+        serving."""
+        if self._hb is None:
+            return
+        with self._hb_lock:
+            now = time.monotonic()
+            if now - self._hb_last < 1.0:
+                return
+            self._hb_last = now
+            try:
+                self._hb.beat(g.engine.stats.get("engine_steps", 0))
+            except OSError:
+                pass
+
+    def _shed(self, rid, p: _Pending) -> None:
+        """Settle one request as shed (both shed paths share this so
+        the accounting and message cannot drift)."""
+        if self._pending.pop(rid, None) is None:
+            return
+        self.shed += 1
+        p.error = ("request shed: deadline expired before prefill "
+                   "(server saturated past the client timeout)")
+        p.kind = "shed"
+        p.finish()
+
+    def _shed_expired(self, g: _Generation) -> None:
+        """Deadline-aware load shedding: drop engine-QUEUED requests
+        whose client deadline already passed — the caller's wait timed
+        out, so prefilling the prompt would burn compute on an answer
+        nobody is waiting for. Requests already in a slot keep running
+        (their compute is sunk; the finish path reclaims the slot)."""
+        if not self._saw_deadline:
+            return
+        now = time.monotonic()
+        queued = None
+        for rid, p in list(self._pending.items()):
+            if p.deadline is None or now <= p.deadline:
+                continue
+            if queued is None:  # one snapshot per sweep, lazily
+                queued = {r.rid for r in g.engine._queue}
+            if rid not in queued:
+                continue
+            g.engine.cancel(rid)
+            self._shed(rid, p)
+
+    def _process_item(self, g: _Generation, item) -> None:
+        rid, tokens, max_new, stop, samp, deadline = item
         if tokens is None:
             # Cancellation marker: drop queued/in-flight work for an
             # abandoned client request.
-            self.engine.cancel(rid)
+            g.engine.cancel(rid)
             p = self._pending.pop(rid, None)
             if p is not None:
                 p.error = "cancelled"
                 p.finish()
             return
+        if deadline is not None and time.monotonic() > deadline:
+            # Expired before it ever reached the engine: shed without
+            # spending prefill compute.
+            p = self._pending.get(rid)
+            if p is not None:
+                self._shed(rid, p)
+            return
         try:
-            self.engine.submit(rid, tokens, max_new, stop=stop, **samp)
+            g.engine.submit(rid, tokens, max_new, stop=stop, **samp)
         except (ValueError, TypeError) as e:
             # TypeError: unknown sampling kwarg from a programmatic
             # caller — a bad request, not a scheduler-killing fault.
-            p = self._pending.pop(rid)
-            p.error = str(e)
-            p.finish()
+            # The pending may already be gone: close()'s sweep can
+            # clear _pending while this thread is still draining its
+            # last backlog items.
+            p = self._pending.pop(rid, None)
+            if p is not None:
+                p.error = str(e)
+                p.finish()
 
-    def _run(self):
-        while not self._stop.is_set():
+    def _run(self, g: _Generation) -> None:
+        engine = g.engine
+        # Multi-host engines need a step per loop iteration even when
+        # idle: follower processes wait inside the command broadcast,
+        # and an un-stepped primary would leave them parked in a device
+        # collective until its transport times out.
+        idle_steps = bool(getattr(engine, "needs_heartbeat", False))
+        while not g.stop.is_set():
             drained = False
             while True:
                 try:
-                    item = self._submit_q.get_nowait()
+                    item = g.submit_q.get_nowait()
                 except queue.Empty:
                     break
                 drained = True
-                self._process_item(item)
-            if self.engine.pending or self._heartbeat:
-                self._step_started = time.monotonic()
-                finished = self.engine.step() or []
-                self._step_started = None
+                self._process_item(g, item)
+            self._shed_expired(g)
+            self._beat(g)
+            if engine.pending or idle_steps:
+                g.step_started = time.monotonic()
+                try:
+                    finished = engine.step() or []
+                finally:
+                    # Clear the clock even when the step RAISES, so the
+                    # watchdog cannot misread a dying scheduler (whose
+                    # own _recover is about to run) as a wedge.
+                    g.step_started = None
+                if g.dead or g is not self._g:
+                    # Stale generation: the supervisor replaced this
+                    # engine while the step was wedged. Results the old
+                    # generation computed are DISCARDED — the pendings
+                    # they would resolve were already failed loudly,
+                    # and any same-numbered pendings now belong to the
+                    # replacement engine.
+                    return
                 fin = {rid for rid, _ in finished}
                 # Stream deltas for requests still in flight. holdback
                 # trails the tail by the longest stop length, so a
                 # token a later stop match would truncate is never
                 # emitted (out only ever shrinks by a matched stop).
-                for req in self.engine._slots:
+                for req in engine._slots:
                     if req is None or req.rid in fin:
                         continue
                     p = self._pending.get(req.rid)
@@ -231,12 +575,12 @@ class InferenceServer:
                     if upto > p.emitted:
                         p.chunks.put(list(req.out[p.emitted:upto]))
                         p.emitted = upto
-                lp_store = getattr(self.engine, "finished_logprobs", {})
+                lp_store = getattr(engine, "finished_logprobs", {})
                 plp_store = getattr(
-                    self.engine, "finished_prompt_logprobs", {}
+                    engine, "finished_prompt_logprobs", {}
                 )
                 tl_store = getattr(
-                    self.engine, "finished_top_logprobs", {}
+                    engine, "finished_top_logprobs", {}
                 )
                 for rid, out in finished:
                     p = self._pending.pop(rid, None)
@@ -252,44 +596,75 @@ class InferenceServer:
                         lp_store.pop(rid, None)
                         plp_store.pop(rid, None)
                         tl_store.pop(rid, None)
-                if self._heartbeat and not drained and not self.engine.pending:
+                if idle_steps and not drained and not engine.pending:
                     # Idle heartbeat tick: pace the broadcast instead of
                     # spinning the interconnect at full rate.
-                    self._stop.wait(0.01)
+                    g.stop.wait(0.01)
             elif not drained:
                 # Idle: block briefly on the queue instead of spinning.
                 # Process in place — re-enqueueing could reorder a
                 # submit behind its own cancellation marker.
                 try:
-                    self._process_item(self._submit_q.get(timeout=0.05))
+                    self._process_item(g, g.submit_q.get(timeout=0.05))
                 except queue.Empty:
                     pass
 
     # ---- client surface ---------------------------------------------
 
-    def _submit(self, tokens, max_new: int, stop, samp,
-                *, stream: bool) -> _Pending:
-        if self._fatal is not None:
-            raise RuntimeError(self._fatal)
-        rid = next(self._ids)
-        holdback = max((len(s) for s in stop), default=0) if stop else 0
-        p = _Pending(rid, stream=stream, holdback=holdback)
-        self._pending[rid] = p
-        self._submit_q.put(
-            (rid, np.asarray(tokens, np.int32), max_new, stop, samp or {})
-        )
-        if self._fatal is not None and not p.event.is_set():
-            # Scheduler died while we enqueued; its sweep may have
-            # missed this request — fail it ourselves.
-            self._pending.pop(rid, None)
-            raise RuntimeError(self._fatal)
+    def _submit(self, tokens, max_new: int, stop, samp, *, stream: bool,
+                deadline: Optional[float] = None) -> _Pending:
+        # Convert the prompt BEFORE taking the lock: the copy is O(S)
+        # and the lock serializes every admission and the supervisor.
+        tokens = np.asarray(tokens, np.int32)
+        with self._lock:
+            # Admission control. The lock pairs this with the
+            # supervisor's sweep: a request either registers before the
+            # sweep (and is failed loudly by it) or sees the post-swap
+            # state here — it can never strand in a dead generation's
+            # queue unobserved.
+            if self._fatal is not None:
+                raise RuntimeError(self._fatal)
+            if self._closed.is_set():
+                raise RuntimeError("server closed")
+            g = self._g
+            if self._recovering or g.dead:
+                raise ServerUnavailable(
+                    "server recovering from an engine fault; retry",
+                    http_status=503, retry_after=5.0,
+                )
+            if (self.max_pending is not None
+                    and len(self._pending) >= self.max_pending):
+                raise ServerUnavailable(
+                    f"server overloaded: {len(self._pending)} requests "
+                    f"pending (max_pending={self.max_pending})",
+                    http_status=429, retry_after=1.0,
+                )
+            rid = next(self._ids)
+            holdback = max((len(s) for s in stop), default=0) if stop else 0
+            if deadline is not None:
+                self._saw_deadline = True
+            p = _Pending(rid, stream=stream, holdback=holdback,
+                         deadline=deadline)
+            self._pending[rid] = p
+            g.submit_q.put(
+                (rid, tokens, max_new, stop, samp or {}, deadline)
+            )
         return p
 
     def _raise(self, p: _Pending):
-        # Scheduler death is a server fault (HTTP 500), not a bad
-        # request (400): keep the error classes distinct.
-        if self._fatal is not None and p.error == self._fatal:
+        # Server faults (scheduler death / wedge / close) are HTTP 500,
+        # shed deadlines are saturation — retryable 503 + Retry-After,
+        # NOT a 400 an OpenAI SDK would treat as permanent — and
+        # anything else is a bad request (400): keep the classes
+        # distinct. (A non-streaming caller usually races its own
+        # identical timeout and sees that instead; the 503 surfaces
+        # when the shed outcome reaches a still-waiting client, e.g.
+        # a stream whose per-chunk timeout outlives the deadline.)
+        if p.kind == "fault":
             raise RuntimeError(p.error)
+        if p.kind == "shed":
+            raise ServerUnavailable(p.error, http_status=503,
+                                    retry_after=1.0)
         raise ValueError(p.error)
 
     def _await(self, p: _Pending, deadline: Optional[float]) -> _Pending:
@@ -306,7 +681,7 @@ class InferenceServer:
         marker); its engine slot frees instead of generating unread
         tokens."""
         if not p.event.is_set():
-            self._submit_q.put((p.rid, None, 0, None, None))
+            self._g.submit_q.put((p.rid, None, 0, None, None, None))
 
     @staticmethod
     def _deadline(timeout) -> Optional[float]:
@@ -314,9 +689,14 @@ class InferenceServer:
 
     def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
                  stop=None, return_logprobs: bool = False, **samp):
-        p = self._submit(tokens, max_new, stop, samp, stream=False)
+        # The timeout doubles as the request's deadline: it rides the
+        # submit tuple so the scheduler can shed the request if it
+        # expires before prefill ever runs.
+        deadline = self._deadline(timeout)
+        p = self._submit(tokens, max_new, stop, samp, stream=False,
+                         deadline=deadline)
         try:
-            self._await(p, self._deadline(timeout))
+            self._await(p, deadline)
         except TimeoutError:
             # Don't strand the slot generating tokens nobody will read.
             self._cancel(p)
@@ -330,8 +710,11 @@ class InferenceServer:
                         return_logprobs: bool = False, **samp):
         """Yield ("delta", [token ids]) as generation progresses, then
         ("done", full output) — or ("done", (output, logprobs)) with
-        return_logprobs=True. `timeout` bounds the wait per chunk."""
-        p = self._submit(tokens, max_new, stop, samp, stream=True)
+        return_logprobs=True. `timeout` bounds the wait per chunk (and
+        doubles as the admission deadline: a stream that cannot start
+        before it elapses is shed instead of prefilled)."""
+        p = self._submit(tokens, max_new, stop, samp, stream=True,
+                         deadline=self._deadline(timeout))
         finished = False
         try:
             while True:
@@ -525,14 +908,24 @@ class InferenceServer:
         # (echo) are computed ONCE, on the first sub-request only.
         rest_samp = {k: v for k, v in samp.items()
                      if k != "prompt_logprobs"}
-        pendings = [
-            self._submit(tokens, max_new, stop,
-                         samp if i == 0 else rest_samp, stream=False)
-            for i in range(best_of)
-        ]
         # One overall deadline for the whole fan-out — not a fresh
-        # clock per completion.
+        # clock per completion — shared with the scheduler so unstarted
+        # siblings shed once it passes.
         deadline = self._deadline(payload.get("timeout"))
+        pendings = []
+        try:
+            for i in range(best_of):
+                pendings.append(self._submit(
+                    tokens, max_new, stop,
+                    samp if i == 0 else rest_samp, stream=False,
+                    deadline=deadline,
+                ))
+        except RuntimeError:
+            # Admission cap (or a fault) hit mid-fan-out: release the
+            # siblings already submitted before surfacing the refusal.
+            for p in pendings:
+                self._cancel(p)
+            raise
         choices = []
         plp = None
         try:
@@ -709,9 +1102,22 @@ class InferenceServer:
             yield from translator.feed(record, max_new)
 
     def close(self):
-        self._stop.set()
-        self._thread.join(timeout=2)
-        if getattr(self.engine, "is_primary", False):
+        with self._lock:
+            self._closed.set()
+            g = self._g
+            g.stop.set()
+        g.thread.join(timeout=2)
+        with self._lock:
+            # Whatever is still pending will never finish (the
+            # scheduler delivered its last results before exiting, or
+            # is wedged): fail the requests loudly NOW instead of
+            # leaving blocked generate() callers waiting out their
+            # full timeout. Racing a final in-flight delivery is
+            # benign — whoever pops the pending first settles it.
+            self._fail_pending_locked(
+                "server closed before the request completed"
+            )
+        if getattr(g.engine, "is_primary", False):
             # Multi-host: the followers must be released with a STOP
             # broadcast, and only after the scheduler thread (the
             # broadcast's other participant on this process) has truly
@@ -721,10 +1127,10 @@ class InferenceServer:
             # leave shutdown unsent; at that point the followers'
             # collectives are failing on their own.
             deadline = time.monotonic() + 300
-            while self._thread.is_alive() and time.monotonic() < deadline:
-                self._thread.join(timeout=5)
-            if not self._thread.is_alive():
-                self.engine.shutdown()
+            while g.thread.is_alive() and time.monotonic() < deadline:
+                g.thread.join(timeout=5)
+            if not g.thread.is_alive():
+                g.engine.shutdown()
 
 
 def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
@@ -733,13 +1139,24 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
         def log_message(self, *a):  # quiet
             pass
 
-        def _send(self, code: int, obj: dict):
+        def _send(self, code: int, obj: dict, headers: dict = None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+
+        def _send_unavailable(self, e: "ServerUnavailable",
+                              openai: bool = False):
+            err = ({"error": {"message": str(e),
+                              "type": "overloaded_error"}}
+                   if openai else {"error": str(e)})
+            self._send(e.http_status, err, headers={
+                "Retry-After": str(max(1, int(round(e.retry_after)))),
+            })
 
         def do_GET(self):
             if self.path == "/v1/models":
@@ -751,8 +1168,12 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     }],
                 })
             elif self.path == "/health":
-                self._send(200, {"ok": True,
-                                 "pending": server.engine.pending})
+                # A real readiness signal: 200 only while serving.
+                # Recovering and failed both 503 so load balancers pull
+                # the backend; the body says which (and why, when
+                # fatal).
+                h = server.health()
+                self._send(200 if h["ok"] else 503, h)
             elif self.path == "/stats":
                 eng = server.engine
                 self._send(200, {
@@ -761,6 +1182,14 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     "slots_busy": sum(r is not None for r in eng._slots),
                     "n_slots": eng.n_slots,
                     "decode_ticks": eng.decode_ticks,
+                    # Supervisor state: /stats stays 200 through an
+                    # outage (scrapers keep collecting); readiness
+                    # lives at /health.
+                    "status": server.status,
+                    "fatal": server._fatal,
+                    "restarts": server.restarts,
+                    "generation": server._g.gen,
+                    "shed": server.shed,
                 })
             else:
                 self._send(404, {"error": "not found"})
@@ -806,6 +1235,9 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             except (ValueError, TimeoutError) as e:
                 self._send(400, {"error": {"message": str(e),
                                            "type": "invalid_request_error"}})
+                return
+            except ServerUnavailable as e:
+                self._send_unavailable(e, openai=True)
                 return
             except RuntimeError as e:
                 # Scheduler death is a server fault, not a bad request.
@@ -865,6 +1297,12 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     err = {"error": {"message": str(e),
                                      "type": "invalid_request_error"}}
                 self._send(400, err)
+            except ServerUnavailable as e:
+                # Backpressure, not failure: 429 (over the pending cap)
+                # or 503 (recovering), each with Retry-After — before
+                # the RuntimeError arm, which would misreport it as an
+                # opaque 500.
+                self._send_unavailable(e, openai=self.path in openai_routes)
             except RuntimeError as e:
                 self._send(500, {"error": str(e)})
 

@@ -1,6 +1,6 @@
 """Failure detection and recovery hooks.
 
-Three layers of defense, cheapest first:
+Four layers of defense, cheapest first:
   1. `guard_update` (inside the jitted step): if any gradient is
      non-finite, the parameter/optimizer update is skipped wholesale —
      one bad batch cannot poison the state. Costs one fused all-reduce
@@ -8,10 +8,15 @@ Three layers of defense, cheapest first:
   2. `FailureDetector` (host side): watches the loss stream for
      NaN/Inf/explosion and trips after `patience` consecutive bad
      steps, signalling the loop to restore from the last checkpoint.
-  3. `Heartbeat` (process level): a file touched every step; an
+  3. `RestartBudget` (supervisor level): a sliding-window circuit
+     breaker over in-process restarts — recover from isolated faults,
+     but a component that keeps dying is declared fatal instead of
+     crash-looping (the serving supervisor's restart gate).
+  4. `Heartbeat` (process level): a file touched every step; an
      external watchdog (or another host) treats a stale heartbeat as a
      hung/dead worker and can restart it. This is the single-host
-     analogue of a multi-host liveness protocol over DCN.
+     analogue of a multi-host liveness protocol over DCN. Both the
+     training loop and the serving scheduler beat one.
 """
 
 from __future__ import annotations
@@ -82,6 +87,55 @@ class FailureDetector:
         self._history.clear()
 
 
+class RestartBudget:
+    """Sliding-window circuit breaker over restart attempts.
+
+    `allow()` records one restart attempt and returns whether it is
+    within budget: at most `max_restarts` attempts inside the trailing
+    `window` seconds. A crash-looping component exhausts the budget and
+    stays down (the caller declares it fatal) instead of burning the
+    machine rebuilding state it will immediately wedge again; isolated
+    faults spread further apart than the window recover forever.
+    """
+
+    def __init__(self, max_restarts: int, window: float = 300.0):
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        if window <= 0:
+            raise ValueError("window must be > 0 seconds")
+        self.max_restarts = max_restarts
+        self.window = window
+        self._attempts: list[float] = []
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        """Record a restart attempt; True iff it fits the budget."""
+        t = time.monotonic() if now is None else now
+        cutoff = t - self.window
+        self._attempts = [a for a in self._attempts if a > cutoff]
+        if len(self._attempts) >= self.max_restarts:
+            return False
+        self._attempts.append(t)
+        return True
+
+    @property
+    def used(self) -> int:
+        """Attempts currently inside the window (stale ones age out at
+        the next allow(); this is a monitoring read, not a gate)."""
+        cutoff = time.monotonic() - self.window
+        return sum(1 for a in self._attempts if a > cutoff)
+
+
+def heartbeat_age(path: str) -> Optional[float]:
+    """Seconds since the heartbeat file at `path` was last beaten, or
+    None when the file is missing/corrupt (callers treat None as
+    stale — a worker that never wrote its heartbeat is not live)."""
+    try:
+        with open(path) as f:
+            return time.time() - json.load(f)["time"]
+    except (FileNotFoundError, json.JSONDecodeError, KeyError):
+        return None
+
+
 class Heartbeat:
     """Liveness file for external watchdogs."""
 
@@ -103,15 +157,9 @@ class Heartbeat:
 
     def age(self) -> Optional[float]:
         """Seconds since the last beat, or None if never beaten."""
-        try:
-            with open(self.path) as f:
-                return time.time() - json.load(f)["time"]
-        except (FileNotFoundError, json.JSONDecodeError, KeyError):
-            return None
+        return heartbeat_age(self.path)
 
     @staticmethod
     def is_stale(path: str, timeout: float) -> bool:
-        hb = Heartbeat.__new__(Heartbeat)
-        hb.path = path
-        age = hb.age()
+        age = heartbeat_age(path)
         return age is None or age > timeout

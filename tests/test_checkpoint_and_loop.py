@@ -26,8 +26,10 @@ from shellac_tpu.training.data import (
 from shellac_tpu.utils.failure import (
     FailureDetector,
     Heartbeat,
+    RestartBudget,
     all_finite,
     guard_update,
+    heartbeat_age,
 )
 
 
@@ -160,6 +162,34 @@ class TestFailureTools:
         assert hb.age() < 5.0
         assert not Heartbeat.is_stale(path, timeout=60.0)
         assert Heartbeat.is_stale(str(tmp_path / "nope.json"), timeout=1.0)
+        # The path-based helper needs no instance at all (external
+        # watchdogs call it on files other processes own).
+        assert heartbeat_age(path) < 5.0
+        assert heartbeat_age(str(tmp_path / "nope.json")) is None
+        corrupt = str(tmp_path / "corrupt.json")
+        with open(corrupt, "w") as f:
+            f.write("{not json")
+        assert heartbeat_age(corrupt) is None
+        assert Heartbeat.is_stale(corrupt, timeout=60.0)
+
+    def test_restart_budget(self):
+        b = RestartBudget(2, window=100.0)
+        assert b.used == 0
+        assert b.allow(now=0.0)
+        assert b.allow(now=1.0)
+        assert not b.allow(now=2.0)  # 2 restarts already in window
+        assert not b.allow(now=50.0)
+        # Both early attempts age out of the sliding window; denied
+        # attempts were never recorded, so they don't extend it.
+        assert b.allow(now=101.0)
+        assert b.allow(now=101.5)
+        assert not b.allow(now=102.0)
+        # A zero budget never allows (recovery disabled, stay fatal).
+        assert not RestartBudget(0, window=10.0).allow(now=0.0)
+        with pytest.raises(ValueError):
+            RestartBudget(-1)
+        with pytest.raises(ValueError):
+            RestartBudget(1, window=0.0)
 
 
 class TestData:

@@ -6,9 +6,18 @@ Failure semantics under test (docs/inference.md, failure section):
     leaves the primary stuck in native code — no exception ever
     surfaces) is detected by the server's step watchdog
     (`step_timeout`): every pending request fails loudly with the
-    fatal message, new submissions are refused with HTTP 500, and the
-    process stays responsive. The stuck thread itself is
-    unrecoverable; the contract is LOUD failure, never a silent hang.
+    fatal message. Without a restart budget (the default) new
+    submissions are refused with HTTP 500 and the process stays
+    responsive — loud failure, never a silent hang.
+  - With `restart_budget > 0` the SUPERVISOR recovers in-process:
+    the wedged thread is abandoned under its old engine generation, a
+    fresh engine is rebuilt from the retained params/config, and
+    serving resumes; results a stale generation ever produces are
+    discarded; the budget (a sliding-window circuit breaker) turns a
+    crash-looping engine fatal instead of rebuilding forever.
+  - Admission is bounded (`max_pending` -> HTTP 429 + Retry-After),
+    expired-deadline requests shed before prefill, and /health is a
+    real readiness signal (ok | recovering | failed).
   - A client disconnecting mid-stream under the MULTIHOST engine
     cancels the generation on every rank (the cancel rides the
     command broadcast), freeing the slot pod-wide.
@@ -25,7 +34,11 @@ import pytest
 
 from shellac_tpu import get_model_config
 from shellac_tpu.inference.batching import BatchingEngine
-from shellac_tpu.inference.server import InferenceServer, make_http_server
+from shellac_tpu.inference.server import (
+    InferenceServer,
+    ServerUnavailable,
+    make_http_server,
+)
 from shellac_tpu.models import transformer
 
 from conftest import run_two_process
@@ -48,24 +61,33 @@ class _WedgingEngine(BatchingEngine):
         self._good = good_steps
         self.wedged = threading.Event()
         self.release = threading.Event()
+        # Optional forged (rid, tokens) the released step reports as
+        # finished — the stale-generation discard test plants a result
+        # colliding with a live rid of the REBUILT engine.
+        self.fake = None
 
     def step(self):
         if self._good <= 0:
             self.wedged.set()
             self.release.wait(3600)
-            return []
+            return [self.fake] if self.fake is not None else []
         self._good -= 1
         return super().step()
 
 
-def _teardown(srv, eng, httpd=None):
+def _teardown(srv, eng, httpd=None, old_threads=()):
     """Release the wedged scheduler thread and JOIN it before the test
-    returns — no engine thread may outlive its test."""
+    returns — no engine thread may outlive its test. Recovery tests
+    pass the ABANDONED generations' threads via old_threads: close()
+    only joins the current generation's."""
     if httpd is not None:
         httpd.shutdown()
     eng.release.set()
     srv.close()  # sets the stop flag and joins the scheduler thread
     assert not srv._thread.is_alive(), "scheduler thread leaked"
+    for t in old_threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "stale scheduler thread leaked"
 
 
 class TestStepWatchdog:
@@ -126,6 +148,572 @@ class TestStepWatchdog:
         params = transformer.init_params(cfg, jax.random.PRNGKey(0))
         with pytest.raises(ValueError, match="step_timeout"):
             InferenceServer(cfg, params, n_slots=2, step_timeout=0.0)
+
+
+class _GatedEngine(BatchingEngine):
+    """Engine whose step() waits for an explicit go-ahead each call —
+    a controllable slow engine (never wedged from the watchdog's view
+    unless the test wants it: the gate has a deadline)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.gate = threading.Event()
+
+    def step(self):
+        self.gate.wait(120)
+        return super().step()
+
+
+def _mk(engine_cls=_WedgingEngine, **kw):
+    cfg = _tiny()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    eng = engine_cls(cfg, params, n_slots=2, max_len=64, temperature=0.0,
+                     **kw)
+    return cfg, params, eng
+
+
+def _wait_status(srv, want, timeout=60):
+    deadline = time.monotonic() + timeout
+    while srv.status != want and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert srv.status == want, (srv.status, srv._fatal)
+
+
+class TestSupervisorRecovery:
+    def test_wedge_recovers_and_serves_again(self):
+        """The acceptance path: wedge -> watchdog fails every in-flight
+        request loudly -> supervisor rebuilds a fresh engine under a
+        new generation -> a subsequent generate() succeeds, all in one
+        server process."""
+        cfg, params, eng = _mk(good_steps=0)
+
+        def factory():
+            return BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  temperature=0.0)
+
+        # step_timeout must clear the rebuilt engine's first-step
+        # compile, or the watchdog trips on the recovery itself (the
+        # documented sizing rule).
+        srv = InferenceServer(cfg, params, engine=eng, step_timeout=10.0,
+                              restart_budget=2, engine_factory=factory)
+        gen0_thread = srv._thread
+        try:
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "ok")
+            assert srv.restarts == 1
+            assert srv._g.gen == 1
+            out = srv.generate([4, 5, 6], max_new=4, timeout=120)
+            assert len(out) == 4
+            h = srv.health()
+            assert h["ok"] and h["status"] == "ok" and h["restarts"] == 1
+        finally:
+            _teardown(srv, eng, old_threads=(gen0_thread,))
+
+    def test_circuit_breaker_exhausts_budget(self):
+        """A crash-looping engine (every rebuild wedges again) exhausts
+        the restart budget and the server stays fatal: generate raises,
+        /health returns 503 with status=failed."""
+        cfg, params, eng = _mk(good_steps=0)
+        engines = [eng]
+
+        def bad_factory():
+            e = _WedgingEngine(cfg, params, n_slots=2, max_len=64,
+                               temperature=0.0, good_steps=0)
+            engines.append(e)
+            return e
+
+        srv = InferenceServer(cfg, params, engine=eng, step_timeout=2.0,
+                              restart_budget=1, engine_factory=bad_factory)
+        gen0_thread = srv._thread
+        httpd = make_http_server(srv)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            # Poke the rebuilt generation so it steps (and wedges);
+            # the second wedge must exhaust the budget of 1.
+            deadline = time.monotonic() + 120
+            while srv.status != "failed" and time.monotonic() < deadline:
+                if srv.status == "ok":
+                    try:
+                        srv._submit([9], 2, None, {}, stream=False)
+                    except RuntimeError:
+                        pass
+                time.sleep(0.1)
+            assert srv.status == "failed"
+            assert "restart budget exhausted" in srv._fatal
+            with pytest.raises(RuntimeError, match="restart budget"):
+                srv.generate([7], max_new=2, timeout=10)
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/health", timeout=30)
+            assert e.value.code == 503
+            body = json.loads(e.value.read())
+            assert body["status"] == "failed" and not body["ok"]
+            assert "step_timeout" in body["error"]
+            # /stats stays 200 through the outage but names the fault.
+            with urllib.request.urlopen(base + "/stats", timeout=30) as r:
+                stats = json.loads(r.read())
+            assert "step_timeout" in stats["fatal"]
+            assert stats["status"] == "failed"
+        finally:
+            httpd.shutdown()
+            for e in engines:
+                e.release.set()
+            srv.close()
+            assert not srv._thread.is_alive()
+            gen0_thread.join(timeout=120)
+            assert not gen0_thread.is_alive(), "stale scheduler leaked"
+
+    def test_admission_while_recovering_is_503(self):
+        """While the supervisor is mid-rebuild, admission refuses with
+        a retryable 503 instead of queueing into a dead generation."""
+        cfg, params, eng = _mk(good_steps=0)
+        factory_gate = threading.Event()
+        built = []
+
+        def slow_factory():
+            factory_gate.wait(120)
+            e = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                               temperature=0.0)
+            built.append(e)
+            return e
+
+        # step_timeout must clear the rebuilt engine's first-step
+        # compile, or the final post-recovery generate() trips the
+        # watchdog again and exhausts the budget.
+        srv = InferenceServer(cfg, params, engine=eng, step_timeout=10.0,
+                              restart_budget=1, engine_factory=slow_factory)
+        gen0_thread = srv._thread
+        try:
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "recovering")
+            with pytest.raises(ServerUnavailable) as e:
+                srv.generate([4], max_new=2, timeout=10)
+            assert e.value.http_status == 503
+            factory_gate.set()
+            _wait_status(srv, "ok")
+            out = srv.generate([4, 5], max_new=3, timeout=120)
+            assert len(out) == 3
+        finally:
+            factory_gate.set()
+            _teardown(srv, eng, old_threads=(gen0_thread,))
+
+    def test_stale_generation_results_discarded(self):
+        """A wedged thread that eventually un-wedges and returns
+        results must NOT resolve the new generation's pendings — even
+        when the rids collide by construction."""
+        from shellac_tpu.inference.server import _Pending
+
+        cfg, params, eng = _mk(good_steps=0)
+
+        def factory():
+            return BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  temperature=0.0)
+
+        srv = InferenceServer(cfg, params, engine=eng, step_timeout=10.0,
+                              restart_budget=1, engine_factory=factory)
+        old_thread = srv._thread
+        try:
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "ok")
+            # Plant a live pending on the NEW generation, then have the
+            # OLD thread wake up claiming that very rid finished with a
+            # forged output. The generation check must discard it.
+            rid = 424242
+            p = _Pending(rid)
+            srv._pending[rid] = p
+            eng.fake = (rid, [999, 999])
+            eng.release.set()
+            old_thread.join(timeout=30)
+            assert not old_thread.is_alive(), "stale scheduler leaked"
+            assert not p.event.is_set(), \
+                "stale-generation result resolved a live request"
+            assert srv._pending.pop(rid, None) is p
+            # The new generation still serves normally.
+            out = srv.generate([5, 6], max_new=3, timeout=120)
+            assert len(out) == 3
+        finally:
+            _teardown(srv, eng)
+
+    def test_scheduler_death_recovers(self):
+        """An exception (not a wedge) in the engine step takes the
+        scheduler-death path into the same supervisor: loud failure,
+        then rebuild — no watchdog needed."""
+        cfg, params, _ = _mk(good_steps=0)
+
+        class _DyingEngine(BatchingEngine):
+            def step(self):
+                raise OSError("transport reset by peer")
+
+        def factory():
+            return BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  temperature=0.0)
+
+        srv = InferenceServer(
+            cfg, params,
+            engine=_DyingEngine(cfg, params, n_slots=2, max_len=64,
+                                temperature=0.0),
+            restart_budget=1, engine_factory=factory,
+        )
+        try:
+            with pytest.raises(RuntimeError, match="scheduler died"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "ok")
+            out = srv.generate([4, 5], max_new=3, timeout=120)
+            assert len(out) == 3
+        finally:
+            srv.close()
+            assert not srv._thread.is_alive()
+
+
+class TestMultihostResyncThroughSupervisor:
+    """engine_factory=MultihostEngine.resync (the cmd_serve wiring),
+    on a single-process (degenerate) wrapper."""
+
+    def test_scheduler_death_resync_recovers(self):
+        from shellac_tpu.inference.multihost import MultihostEngine
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+        class _DieOnce(BatchingEngine):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self._die = True
+
+            def step(self):
+                if self._die:
+                    self._die = False
+                    raise OSError("transport reset by peer")
+                return super().step()
+
+        mh = MultihostEngine(_DieOnce(cfg, params, n_slots=2, max_len=64,
+                                      temperature=0.0))
+        srv = InferenceServer(cfg, params, engine=mh, restart_budget=1,
+                              engine_factory=mh.resync)
+        try:
+            with pytest.raises(RuntimeError, match="scheduler died"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "ok")
+            # Recovery was an epoch resync of the SAME wrapper, not a
+            # rebuild: safe here because the dead scheduler thread has
+            # left the engine.
+            assert mh.epoch == 1
+            assert srv.engine is mh
+            out = srv.generate([4, 5], max_new=3, timeout=120)
+            assert len(out) == 3
+        finally:
+            srv.close()
+            assert not srv._thread.is_alive()
+
+    def test_wedge_with_inplace_resync_goes_fatal(self):
+        """A WEDGED step cannot be recovered by an in-place resync —
+        the stuck thread still owns the engine, and two threads must
+        not race one command broadcast. The supervisor must refuse and
+        go fatal instead of attempting it."""
+        from shellac_tpu.inference.multihost import MultihostEngine
+
+        cfg, params, eng = _mk(good_steps=0)
+        mh = MultihostEngine(eng)
+        srv = InferenceServer(cfg, params, engine=mh, step_timeout=2.0,
+                              restart_budget=3, engine_factory=mh.resync)
+        try:
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "failed")
+            assert "in-place resync" in srv._fatal
+            assert srv.restarts == 0  # no rebuild was attempted
+            assert mh.epoch == 0  # resync never ran against the engine
+        finally:
+            eng.release.set()
+            srv.close()
+            assert not srv._thread.is_alive()
+
+
+class TestAbortAll:
+    """BatchingEngine.abort_all — the supervisor-rebuild / multi-host
+    epoch-resync cleanup helper. (Exact post-abort output parity vs a
+    bare engine is pinned by test_multihost_serving's resync test.)"""
+
+    def test_clears_engine_for_rebuild(self):
+        import numpy as np
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        eng.submit("in_flight", np.array([1, 2, 3], np.int32), 30)
+        eng.submit("queued", np.array([4, 5], np.int32), 30)
+        eng.step()  # "in_flight" occupies the only slot
+        dropped = eng.abort_all()
+        assert sorted(dropped) == ["in_flight", "queued"]
+        assert eng.pending == 0
+        assert eng.stats["requests_cancelled"] == 2
+        results = eng.run([("fresh", np.array([7, 8], np.int32), 4)])
+        assert list(results) == ["fresh"] and len(results["fresh"]) == 4
+
+    def test_returns_paged_blocks(self):
+        import numpy as np
+
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  block_size=16)
+        n_free = len(eng._free)
+        eng.submit("a", np.array([1, 2, 3], np.int32), 20)
+        eng.submit("b", np.array([4, 5], np.int32), 20)
+        eng.step()
+        assert len(eng._free) < n_free
+        eng.abort_all()
+        assert len(eng._free) == n_free, "blocks leaked across abort"
+        results = eng.run([("fresh", np.array([1, 2, 3], np.int32), 5)])
+        assert list(results) == ["fresh"] and len(results["fresh"]) == 5
+
+    def test_abort_all_purges_prefix_cache(self):
+        """Paged abort must reset the allocator to its CANONICAL state
+        (prefix registries empty, free list in constructor order) —
+        the multi-host resync path aborts replicas AFTER they have
+        diverged, and surviving per-host prefix registries would make
+        a later prompt prefix-hit on one host but miss on another
+        (different-shaped programs, wedged collective again)."""
+        import numpy as np
+
+        from shellac_tpu.inference.batching import PagedBatchingEngine
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = PagedBatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  block_size=16, prefix_cache=True)
+        pristine = list(eng._free)
+        prompt = (np.arange(40) % cfg.vocab_size).astype(np.int32)
+        eng.run([("a", prompt, 4)])
+        assert eng._hash_to_block, "prefix blocks were never registered"
+        eng.abort_all()
+        assert not eng._hash_to_block and not eng._block_ref
+        assert eng._free == pristine, "free list not canonical"
+        results = eng.run([("b", prompt, 4)])
+        assert len(results["b"]) == 4
+
+
+class TestAdmissionControl:
+    def test_over_limit_rejected_429(self):
+        cfg, params, eng = _mk(good_steps=0)
+        srv = InferenceServer(cfg, params, engine=eng, max_pending=2)
+        httpd = make_http_server(srv)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            for _ in range(2):
+                srv._submit([1, 2], 4, None, {}, stream=False)
+            with pytest.raises(ServerUnavailable) as e:
+                srv._submit([1, 2], 4, None, {}, stream=False)
+            assert e.value.http_status == 429
+            assert "max_pending=2" in str(e.value)
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            req = urllib.request.Request(
+                base + "/generate",
+                json.dumps({"tokens": [1, 2], "max_new": 4}).encode(),
+                {"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as he:
+                urllib.request.urlopen(req, timeout=30)
+            assert he.value.code == 429
+            assert he.value.headers.get("Retry-After") is not None
+            assert "overloaded" in json.loads(he.value.read())["error"]
+            # /health keeps answering (the cap gates generate only) and
+            # reports the saturation.
+            with urllib.request.urlopen(base + "/health", timeout=30) as r:
+                h = json.loads(r.read())
+            assert h["pending"] == 2 and h["max_pending"] == 2
+        finally:
+            httpd.shutdown()
+            _teardown(srv, eng)
+
+    def test_bad_max_pending_rejected(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="max_pending"):
+            InferenceServer(cfg, params, n_slots=2, max_pending=0)
+
+    def test_prebuilt_engine_needs_factory_for_budget(self):
+        cfg, params, eng = _mk(good_steps=10)
+        try:
+            with pytest.raises(ValueError, match="engine_factory"):
+                InferenceServer(cfg, params, engine=eng, restart_budget=1)
+        finally:
+            eng.release.set()
+
+
+class TestDeadlineShedding:
+    def test_expired_deadline_never_reaches_prefill(self):
+        """A request whose client timeout expires while the scheduler
+        is busy is shed BEFORE prefill: the engine never sees it."""
+        cfg, params, _ = _mk(good_steps=0)
+        eng = _GatedEngine(cfg, params, n_slots=2, max_len=64,
+                           temperature=0.0)
+        srv = InferenceServer(cfg, params, engine=eng)
+        try:
+            results = []
+            t = threading.Thread(target=lambda: results.append(
+                srv.generate([1, 2, 3], max_new=4, timeout=120)))
+            t.start()
+            # Wait for A to be prefill-eligible: the scheduler is now
+            # blocked inside step() at the gate.
+            deadline = time.monotonic() + 60
+            while not srv._pending and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.2)  # let the scheduler enter the gated step
+            with pytest.raises(TimeoutError):
+                srv.generate([5, 6], max_new=4, timeout=0.2)
+            time.sleep(0.1)
+            eng.gate.set()
+            t.join(timeout=120)
+            assert results and len(results[0]) == 4
+            # B was shed at the scheduler: exactly one prefill (A's).
+            deadline = time.monotonic() + 60
+            while srv.shed < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert srv.shed == 1
+            assert eng.stats["prefills"] == 1
+        finally:
+            eng.gate.set()
+            srv.close()
+            assert not srv._thread.is_alive()
+
+
+class TestCloseAndHeartbeat:
+    def test_close_fails_pending_loudly(self):
+        """close() must fail still-pending requests immediately instead
+        of leaving blocked generate() callers waiting out their full
+        timeout."""
+        cfg, params, _ = _mk(good_steps=0)
+        eng = _GatedEngine(cfg, params, n_slots=2, max_len=64,
+                           temperature=0.0)
+        srv = InferenceServer(cfg, params, engine=eng)
+        errors = []
+
+        def hit():
+            t0 = time.monotonic()
+            try:
+                srv.generate([1, 2, 3], max_new=4, timeout=300)
+            except RuntimeError as e:
+                errors.append((time.monotonic() - t0, str(e)))
+
+        t = threading.Thread(target=hit)
+        t.start()
+        deadline = time.monotonic() + 60
+        while not srv._pending and time.monotonic() < deadline:
+            time.sleep(0.01)
+        srv.close()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert errors, "caller was not failed"
+        elapsed, msg = errors[0]
+        assert "closed" in msg
+        assert elapsed < 60, "caller waited out its timeout"
+        # Release the gated step and JOIN the scheduler before the test
+        # returns — no engine thread may outlive its test.
+        eng.gate.set()
+        srv._thread.join(timeout=120)
+        assert not srv._thread.is_alive(), "scheduler thread leaked"
+
+    def test_scheduler_beats_heartbeat(self, tmp_path):
+        from shellac_tpu.utils.failure import Heartbeat
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        path = str(tmp_path / "serve_hb.json")
+        srv = InferenceServer(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0, heartbeat_path=path)
+        try:
+            deadline = time.monotonic() + 30
+            while Heartbeat.is_stale(path, 3600) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert not Heartbeat.is_stale(path, 3600)
+        finally:
+            srv.close()
+
+    def test_rebuild_beats_heartbeat_without_watchdog(self, tmp_path):
+        """With no step watchdog armed (no step_timeout), the
+        supervisor itself must keep the heartbeat fresh through an
+        engine rebuild — otherwise an external watchdog restarts the
+        pod mid-recovery."""
+        from shellac_tpu.utils.failure import heartbeat_age
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        path = str(tmp_path / "rebuild_hb.json")
+        factory_gate = threading.Event()
+
+        def slow_factory():
+            factory_gate.wait(60)
+            return BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  temperature=0.0)
+
+        class _DyingEngine(BatchingEngine):
+            def step(self):
+                raise OSError("transport reset by peer")
+
+        srv = InferenceServer(
+            cfg, params,
+            engine=_DyingEngine(cfg, params, n_slots=2, max_len=64,
+                                temperature=0.0),
+            restart_budget=1, engine_factory=slow_factory,
+            heartbeat_path=path,
+        )
+        try:
+            with pytest.raises(RuntimeError, match="scheduler died"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "recovering")
+            time.sleep(2.0)  # deep in the rebuild window
+            deadline = time.monotonic() + 15
+            age = None
+            while time.monotonic() < deadline:
+                age = heartbeat_age(path)
+                if age is not None and age < 1.5:
+                    break
+                time.sleep(0.2)
+            assert age is not None and age < 1.5, age
+        finally:
+            factory_gate.set()
+            _wait_status(srv, "ok")
+            srv.close()
+            assert not srv._thread.is_alive()
+
+    def test_watchdog_cobeats_heartbeat_through_wedge(self, tmp_path):
+        """With the step watchdog armed, the heartbeat must stay fresh
+        WHILE a step is wedged (the scheduler loop can't beat) — an
+        external watchdog restarting the pod before the supervisor's
+        own detection window elapses would defeat in-process
+        recovery."""
+        from shellac_tpu.utils.failure import heartbeat_age
+
+        cfg, params, eng = _mk(good_steps=0)
+        path = str(tmp_path / "wedge_hb.json")
+        srv = InferenceServer(cfg, params, engine=eng, step_timeout=60.0,
+                              heartbeat_path=path)
+        try:
+            srv._submit([1, 2], 4, None, {}, stream=False)
+            assert eng.wedged.wait(60), "engine never wedged"
+            time.sleep(2.5)  # several watchdog polls with the step stuck
+            # The co-beat cadence is <= ~2s (1s poll x 1s throttle);
+            # poll for a fresh beat rather than asserting one instant,
+            # so a loaded CI runner can't flake the window.
+            deadline = time.monotonic() + 15
+            age = None
+            while time.monotonic() < deadline:
+                age = heartbeat_age(path)
+                if age is not None and age < 1.5:
+                    break
+                time.sleep(0.2)
+            assert age is not None and age < 1.5, age
+        finally:
+            _teardown(srv, eng)
 
 
 _FOLLOWER_DEATH_WORKER = """

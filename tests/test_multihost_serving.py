@@ -211,3 +211,88 @@ class TestSingleProcessDegenerate:
             for rid, toks in eng.step():
                 out[rid] = toks
         assert set(out) == {"a"}
+
+    def test_resync_bumps_epoch_and_drops_work(self):
+        """The supervisor's recovery hook: resync() aborts local work,
+        bumps the epoch, and the engine serves fresh requests after —
+        the epoch command rides the next step's command stream."""
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = MultihostEngine(
+            BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        )
+        eng.submit("in_flight", [1, 2, 3], 8)
+        eng.submit("queued", [4, 5], 8)
+        eng.step()  # "in_flight" takes the slot
+        assert eng.resync() is eng
+        assert eng.epoch == 1
+        assert eng.pending == 0
+        out = {}
+        eng.submit("fresh", [1, 2, 3], 4)
+        while eng.pending:
+            for rid, toks in eng.step():
+                out[rid] = toks
+        assert set(out) == {"fresh"}
+        want = BatchingEngine(cfg, params, n_slots=1, max_len=64).run(
+            [("fresh", [1, 2, 3], 4)]
+        )
+        assert out == want
+
+    def test_resync_rekeys_prng_from_seed_and_epoch(self):
+        """Post-recovery sampling must stay seed-dependent: the epoch
+        re-key folds the CONSTRUCTION seed, so two jobs with different
+        seeds do not collapse onto one stream after a resync."""
+        import numpy as np
+
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = BatchingEngine(cfg, params, n_slots=1, max_len=64, seed=5)
+        mh = MultihostEngine(eng)
+        mh.resync()
+        want = jax.random.fold_in(jax.random.PRNGKey(5), 1)
+        assert (np.asarray(eng._key) == np.asarray(want)).all()
+        mh.resync()
+        want2 = jax.random.fold_in(jax.random.PRNGKey(5), 2)
+        assert (np.asarray(eng._key) == np.asarray(want2)).all()
+
+    def test_follower_step_faults_tolerated_within_budget(self):
+        """A replicated step exception must not kill the follower loop
+        outright — it drops local work and keeps participating so the
+        primary's epoch bump can resynchronize it; a crash loop
+        exhausts the budget and re-raises loudly."""
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+        aborts = []
+
+        class _AlwaysDies(BatchingEngine):
+            def step(self):
+                raise OSError("transport reset by peer")
+
+            def abort_all(self):
+                aborts.append(1)
+                return super().abort_all()
+
+        mh = MultihostEngine(_AlwaysDies(cfg, params, n_slots=1,
+                                         max_len=64))
+        with pytest.raises(OSError, match="transport reset"):
+            mh.serve_forever(fault_budget=2)
+        assert len(aborts) == 2  # two tolerated faults, third re-raised
+        # Default budget 0: the loud legacy contract, first fault
+        # re-raises untouched.
+        aborts.clear()
+        mh2 = MultihostEngine(_AlwaysDies(cfg, params, n_slots=1,
+                                          max_len=64))
+        with pytest.raises(OSError, match="transport reset"):
+            mh2.serve_forever()
+        assert aborts == []
+
+    def test_resync_after_shutdown_refused(self):
+        cfg = get_model_config("tiny").replace(dtype="float32")
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = MultihostEngine(
+            BatchingEngine(cfg, params, n_slots=1, max_len=64)
+        )
+        eng.shutdown()
+        with pytest.raises(RuntimeError, match="shutdown"):
+            eng.resync()
