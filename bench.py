@@ -275,6 +275,21 @@ def main(argv=None):
     }
     if mfu_denom:
         extra["mfu"] = round(tok_s * flops_per_token / mfu_denom, 4)
+    # Deposit the headline into the shared obs registry and snapshot it
+    # into the output, so BENCH_* files carry the same series a live
+    # /metrics scrape (or train --metrics-file) would — one exposition
+    # path for bench, train, and serve numbers.
+    from shellac_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.gauge("shellac_bench_train_tokens_per_sec",
+              "Headline training-bench throughput").set(tok_s)
+    reg.gauge("shellac_bench_train_step_seconds",
+              "Headline training-bench mean step time").set(dt / steps)
+    if mfu_denom:
+        reg.gauge("shellac_bench_train_mfu",
+                  "Headline training-bench MFU").set(extra["mfu"])
+    extra["metrics"] = reg.snapshot()
     if recipe is not None:
         extra["recipe"] = {
             k: recipe.get(k)

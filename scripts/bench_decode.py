@@ -37,7 +37,8 @@ import numpy as np
 
 
 def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
-                 decode_ticks=1, kv_quant=None, rolling=False):
+                 decode_ticks=1, kv_quant=None, rolling=False,
+                 registry=None):
     from shellac_tpu.inference.batching import (
         BatchingEngine,
         PagedBatchingEngine,
@@ -52,23 +53,23 @@ def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
             cfg, params, n_slots=n_slots, max_len=max_len,
             block_size=64, pool_tokens=n_slots * max_len,
             temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
-            kv_quant=kv_quant,
+            kv_quant=kv_quant, registry=registry,
         )
     return BatchingEngine(
         cfg, params, n_slots=n_slots, max_len=max_len,
         temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
-        kv_quant=kv_quant, rolling_window=rolling,
+        kv_quant=kv_quant, rolling_window=rolling, registry=registry,
     )
 
 
 def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
                  ticks, rng, decode_ticks=1, kv_quant=None,
-                 rolling=False):
+                 rolling=False, registry=None):
     """Decode tokens/s with every slot held live at ~ctx context."""
     eng = build_engine(
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
         max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
-        rolling=rolling,
+        rolling=rolling, registry=registry,
     )
     budget = max_len - ctx - 1
     need = (2 + ticks) * decode_ticks
@@ -104,13 +105,21 @@ def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
 
 
 def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
-          rolling=False, decode_ticks=1, kv_quant=None):
-    """Drain 3*n_slots ragged requests; tokens/s of generated tokens."""
+          rolling=False, decode_ticks=1, kv_quant=None, registry=None):
+    """Drain 3*n_slots ragged requests; tokens/s of generated tokens.
+
+    Each request carries an obs RequestTrace, so the drain leaves
+    TTFT / TPOT / queue-wait DISTRIBUTIONS in `registry` for the
+    output JSON — a server-shaped workload measured the way the
+    server reports it, not just a mean."""
+    from shellac_tpu.obs import ServeMetrics, get_registry
+
     eng = build_engine(
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
         max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
-        rolling=rolling,
+        rolling=rolling, registry=registry,
     )
+    sm = ServeMetrics(registry if registry is not None else get_registry())
     n_req = 3 * n_slots
     gen_budget = min(64, max(4, (max_len - ctx) // 2))
     reqs = []
@@ -123,7 +132,15 @@ def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
     while eng.pending:
         eng.step()
     t0 = time.perf_counter()
-    results = eng.run(reqs)
+    traces = {}
+    for rid, prompt, max_new in reqs:
+        traces[rid] = sm.trace()
+        eng.submit(rid, prompt, max_new, trace=traces[rid])
+    results = {}
+    while eng.pending:
+        for rid, out in eng.step():
+            traces[rid].finish(len(out))
+            results[rid] = out
     dt = time.perf_counter() - t0
     total = sum(len(v) for v in results.values())
     assert len(results) == n_req
@@ -469,15 +486,24 @@ def main():
             )
         rng = np.random.default_rng(0)
         kvq = args.kv_quant
+        # One fresh registry per variant: the steady-state and churn
+        # engines (and the churn request spans) deposit their
+        # histograms here, so the output row carries TTFT/TPOT/
+        # queue-wait/decode-window DISTRIBUTIONS, not just the means.
+        from shellac_tpu.obs import Registry
+
+        reg = Registry()
         tok_s, tick_s = steady_state(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, ticks=args.ticks, rng=rng,
             decode_ticks=args.decode_ticks, kv_quant=kvq, rolling=rolling,
+            registry=reg,
         )
         churn_tok_s, churn_total = churn(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, rng=rng,
             decode_ticks=args.decode_ticks, kv_quant=kvq, rolling=rolling,
+            registry=reg,
         )
         row = {
             "metric": f"decode_throughput_{args.model}_ctx{args.ctx}_"
@@ -491,6 +517,7 @@ def main():
                 "churn_tokens": churn_total,
                 "n_slots": args.slots,
                 "decode_ticks": args.decode_ticks,
+                "metrics": reg.snapshot(),
             },
         }
         results[variant] = row

@@ -228,7 +228,9 @@ def cmd_train(args):
     data = _data_iter(args, cfg, args.batch, args.seq,
                       skip=_resume_skip(args))
     if args.lora_rank is not None:
-        return _train_lora(args, cfg, tcfg, mesh, data)
+        rc = _train_lora(args, cfg, tcfg, mesh, data)
+        _dump_metrics(args)
+        return rc
     state = fit(
         cfg, tcfg, data,
         mesh=mesh,
@@ -237,10 +239,26 @@ def cmd_train(args):
         log_path=args.log_path,
         log_every=args.log_every,
     )
+    _dump_metrics(args)
     import jax
 
     print(json.dumps({"final_step": int(jax.device_get(state.step))}))
     return 0
+
+
+def _dump_metrics(args):
+    """train --metrics-file: write the shared registry's snapshot (the
+    shellac_train_* gauges and the step-interval histogram the loop
+    deposited) as JSON, so a run's final throughput picture lands next
+    to its JSONL log in one scrape-equivalent file."""
+    path = getattr(args, "metrics_file", None)
+    if not path:
+        return
+    from shellac_tpu.obs import get_registry
+
+    with open(path, "w") as f:
+        json.dump(get_registry().snapshot(), f, indent=2)
+        f.write("\n")
 
 
 def _train_lora(args, cfg, tcfg, mesh, data):
@@ -729,6 +747,15 @@ def cmd_serve(args):
     from shellac_tpu.inference.server import serve
     from shellac_tpu.training.tokenizer import get_tokenizer
 
+    if not args.metrics:
+        # One switch for the whole process: engines, the server, and
+        # the request spans all deposit into the global registry, so
+        # disabling it here no-ops every write and /metrics answers
+        # 404. Metrics stay ON by default — the cost when nothing
+        # scrapes is a few host-side adds per engine STEP.
+        from shellac_tpu.obs import get_registry
+
+        get_registry().disable()
     if args.prefix_cache and not args.paged:
         raise SystemExit("--prefix-cache requires --paged")
     if args.draft_model and args.paged:
@@ -978,6 +1005,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--ckpt-every", type=int, default=500)
     t.add_argument("--log-path")
     t.add_argument("--log-every", type=int, default=10)
+    t.add_argument("--metrics-file", default=None, dest="metrics_file",
+                   help="write the shared metrics-registry snapshot "
+                        "(shellac_train_* gauges, step-interval "
+                        "histogram) as JSON when training finishes")
     t.add_argument("--learning-rate", type=float, dest="learning_rate")
     t.add_argument("--warmup-steps", type=int, dest="warmup_steps")
     t.add_argument("--weight-decay", type=float, dest="weight_decay")
@@ -1213,6 +1244,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="admission control: reject new requests with "
                         "HTTP 429 + Retry-After once this many are "
                         "pending, instead of queueing unboundedly")
+    s.add_argument("--metrics", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="Prometheus metrics + request tracing via "
+                        "GET /metrics (on by default; --no-metrics "
+                        "no-ops every instrument and the endpoint "
+                        "answers 404)")
     s.add_argument("--heartbeat-file", default=None, dest="heartbeat_file",
                    help="liveness file the serving scheduler touches "
                         "every second, for external watchdogs "

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -53,6 +54,7 @@ from shellac_tpu.inference.kvcache import (
     slot_view,
 )
 from shellac_tpu.models import transformer
+from shellac_tpu.obs import EngineMetrics, get_registry
 from shellac_tpu.ops.sampling import NEG_INF, sample_batched
 from shellac_tpu.parallel.sharding import make_shardings
 
@@ -86,6 +88,10 @@ class _Request:
     # Structured decoding: a compiled constraints.TokenDFA whose
     # transition table masks the logits each step (None = free).
     constraint: Optional[Any] = None
+    # Observability span (obs.RequestTrace) riding the request through
+    # the pipeline; the engine marks prefill-start and first-token on
+    # it. None when the caller doesn't trace (offline batch runs).
+    trace: Optional[Any] = None
     # Generated tokens so far. INVARIANT (the server's streaming path
     # reads this between engine steps): `out` only ever grows, except
     # that a stop-sequence match removes exactly the matched suffix
@@ -147,6 +153,7 @@ class BatchingEngine:
         kv_quant: Optional[str] = None,
         rolling_window: bool = False,
         pp_pipeline: bool = False,
+        registry=None,
     ):
         if kv_quant not in (None, "int8"):
             raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
@@ -341,6 +348,13 @@ class BatchingEngine:
             "prefill_chunks": 0,
             "requests_cancelled": 0,
         }
+        # Richer observability (histograms + gauges) over the shared
+        # registry — the Prometheus-facing counterpart of `stats`.
+        # Everything it records is host-side and per engine STEP, never
+        # per token and never inside a jitted program.
+        self.obs = EngineMetrics(
+            registry if registry is not None else get_registry()
+        )
 
     # ---- sharding ----------------------------------------------------
 
@@ -814,7 +828,7 @@ class BatchingEngine:
                min_p=None, min_tokens=None, logit_bias=None,
                presence_penalty=None, frequency_penalty=None,
                prompt_logprobs=False, seed=None,
-               constraint=None) -> None:
+               constraint=None, trace=None) -> None:
         """Queue a request. `stop`: optional list of token-id sequences;
         generation ends when the output ends with any of them, and the
         matched sequence is removed from the returned tokens.
@@ -926,7 +940,7 @@ class BatchingEngine:
             logit_bias=logit_bias, presence_penalty=pres,
             frequency_penalty=freq,
             prompt_logprobs=bool(prompt_logprobs), seed=seed,
-            constraint=constraint, **samp,
+            constraint=constraint, trace=trace, **samp,
         ))
 
     def _prepare_slot(self, slot: int, req: _Request) -> None:
@@ -1101,6 +1115,10 @@ class BatchingEngine:
             done += 1
             req = self._queue.popleft()
             self._prepare_slot(i, req)
+            if req.trace is not None:
+                # Queue wait ends here (after _prepare_slot: a paged
+                # pool miss requeues the request, so its wait goes on).
+                req.trace.prefill_start()
             self._set_slot_sampling(i, req)
             off = self._prefill_start_offset(i)
             if (self.prefill_chunk is not None
@@ -1135,6 +1153,10 @@ class BatchingEngine:
         if req.min_tokens > 0:
             self._smin = self._smin.at[slot].set(req.min_tokens - 1)
         req.out.append(first_tok)
+        if req.trace is not None:
+            # int(first) above already synced: the first token is a
+            # host value, so this is the request's TTFT point.
+            req.trace.first_token()
         if self.logprobs and lp is not None:
             req.lps.append(float(lp))
         if self.top_logprobs and tl is not None:
@@ -1290,6 +1312,8 @@ class BatchingEngine:
         requests. One host sync per call regardless of decode_ticks."""
         finished: List[Tuple[Any, List[int]]] = []
         self.stats["engine_steps"] += 1
+        t_fill0 = time.perf_counter()
+        prefills0 = self.stats["prefills"] + self.stats["prefill_chunks"]
         # Fill/check until stable: a request satisfied by its prefill
         # alone (max_new=1, instant EOS, or a stop sequence completed by
         # the prefill token) frees its slot for the next queued request,
@@ -1325,13 +1349,25 @@ class BatchingEngine:
             # chunk immediately instead of idling a full decode window.
             self._advance_prefills(remaining)
             self._finish_check(finished)
+        if self.stats["prefills"] + self.stats["prefill_chunks"] > prefills0:
+            # Prefill-section wall time (the prefill/chunk programs this
+            # step ran, including their host syncs) — observed only on
+            # steps that actually prefilled.
+            self.obs.prefill_seconds.observe(time.perf_counter() - t_fill0)
         active_rows = [
             r is not None and i not in self._prefilling
             for i, r in enumerate(self._slots)
         ]
         if any(active_rows):
+            self.obs.occupancy.observe(sum(active_rows) / self.n_slots)
+            t_dec0 = time.perf_counter()
             self._pre_decode(active_rows)
             per_slot, per_lps, per_tl = self._decode_tokens(active_rows)
+            # _decode_tokens ends in the window's one host sync, so this
+            # wall time covers the full decode window.
+            self.obs.decode_window_seconds.observe(
+                time.perf_counter() - t_dec0
+            )
             for i, req in enumerate(self._slots):
                 if req is None or i in self._prefilling:
                     continue
@@ -1352,7 +1388,24 @@ class BatchingEngine:
                         # request never sees them.
                         break
             self._finish_check(finished)
+        self._observe_cache_gauges()
         return finished
+
+    def _observe_cache_gauges(self) -> None:
+        """Per-step utilization gauges. Host-known values only (slot
+        list, host-tracked lengths) — no device reads."""
+        obs = self.obs
+        if not obs.registry.enabled:
+            return
+        obs.slots_busy.set(sum(r is not None for r in self._slots))
+        obs.queue_depth.set(len(self._queue))
+        obs.kv_util.set(self._kv_utilization())
+
+    def _kv_utilization(self) -> float:
+        """Live KV tokens / capacity (paged: pool blocks in use)."""
+        live = sum(r.tokens.size + len(r.out)
+                   for r in self._slots if r is not None)
+        return live / (self.n_slots * self.max_len)
 
     def _decode_tokens(self, active_rows):
         """Advance every active slot; returns (tokens_per_slot,
@@ -1440,11 +1493,15 @@ class BatchingEngine:
                 self.finished_prompt_logprobs.pop(rid, None)
                 self.finished_top_logprobs.pop(rid, None)
                 self.stats["requests_cancelled"] += 1
+                if req.trace is not None:
+                    req.trace.abort("cancelled")
                 return True
         for req in list(self._queue):
             if req.rid == rid:
                 self._queue.remove(req)
                 self.stats["requests_cancelled"] += 1
+                if req.trace is not None:
+                    req.trace.abort("cancelled")
                 return True
         return False
 
@@ -1457,12 +1514,18 @@ class BatchingEngine:
         swept so a rebuilt server cannot hand a new request an old
         generation's logprobs. Device cache rows need no repair — stale
         rows are self-healing (lengths roll back at the next admit)."""
-        dropped = [req.rid for req in self._queue]
+        dropped = []
+        for req in self._queue:
+            dropped.append(req.rid)
+            if req.trace is not None:
+                req.trace.abort("cancelled")
         self._queue.clear()
         for i, req in enumerate(self._slots):
             if req is None:
                 continue
             dropped.append(req.rid)
+            if req.trace is not None:
+                req.trace.abort("cancelled")
             self._slots[i] = None
             self._release_slot(i)
         self._prefilling.clear()
@@ -1774,6 +1837,17 @@ class PagedBatchingEngine(BatchingEngine):
             super()._fill_slots(budget)
         except _PoolExhausted:
             pass  # request re-queued; retry after a slot frees blocks
+
+    def _kv_utilization(self) -> float:
+        # Pool utilization replaces the dense token-count estimate:
+        # blocks out of the free list / pool size (block 0 is scratch).
+        pool = self._n_blocks - 1
+        return (pool - len(self._free)) / pool
+
+    def _observe_cache_gauges(self) -> None:
+        super()._observe_cache_gauges()
+        if self.prefix_cache and self.obs.registry.enabled:
+            self.obs.prefix_blocks.set(len(self._hash_to_block))
 
     # ---- jitted programs --------------------------------------------
 
@@ -2217,7 +2291,10 @@ class PagedBatchingEngine(BatchingEngine):
         tables = scratch_frozen(tables, finished0)
         pools, tables = cow(pools, tables, lengths0, ~finished0)
 
-        def step(carry, _):
+        # Named beam_step (not `step`): the module-local lint evidence
+        # for scan bodies keys on the NAME, and calling this `step`
+        # would mark the host-side engine step() as traced too.
+        def beam_step(carry, _):
             (pools, tables, cur, scores, finished, out, lens,
              lengths, i) = carry
             cache = make_cache(pools, tables, lengths)
@@ -2244,7 +2321,7 @@ class PagedBatchingEngine(BatchingEngine):
         carry = (pools, tables, tok0, scores, finished0, out0, lens0,
                  lengths0, jnp.int32(1))
         (pools, _, _, scores, _, out, lens, _, _), _ = jax.lax.scan(
-            step, carry, None, length=steps - 1
+            beam_step, carry, None, length=steps - 1
         )
         out, norm, lens = beam_rank(scores, out, lens, length_penalty)
         return pools, out, norm, lens

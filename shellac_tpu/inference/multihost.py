@@ -81,7 +81,12 @@ class MultihostEngine:
         """
         self._require_primary("submit")
         tokens = np.asarray(tokens, np.int32).reshape(-1)
-        self.engine.submit(rid, tokens, max_new, **kw)
+        # The trace span is host-local observability: apply it on the
+        # primary's engine but strip it from the broadcast — a
+        # RequestTrace neither pickles nor means anything on a
+        # follower, and followers' spans would double-count.
+        trace = kw.pop("trace", None)
+        self.engine.submit(rid, tokens, max_new, trace=trace, **kw)
         # The local submit doubles as validation AND the primary's own
         # application of the command; followers replay it at step().
         self._pending.append(("submit", (rid, tokens.tolist(), max_new), kw))

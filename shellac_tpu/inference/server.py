@@ -49,8 +49,16 @@ API:
   GET  /stats     -> engine counters (requests/tokens/steps/prefills,
                      slots busy, decode_ticks) plus supervisor state
                      ("fatal", "status", "restarts", "generation",
-                     "shed") — stays 200 even when fatal, so scrapers
-                     keep collecting through an outage.
+                     "shed"), uptime_s, and p50/p90/p99 TTFT /
+                     queue-wait / e2e latency digests — stays 200 even
+                     when fatal, so scrapers keep collecting through an
+                     outage.
+  GET  /metrics   -> Prometheus text exposition (shellac_ttft_seconds,
+                     shellac_tpot_seconds, shellac_queue_wait_seconds,
+                     engine occupancy/utilization, supervisor
+                     restart/shed/admission counters — the catalog is
+                     docs/observability.md). 404 with --no-metrics;
+                     otherwise stays 200 through an outage.
 """
 
 from __future__ import annotations
@@ -69,6 +77,7 @@ import numpy as np
 
 from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.batching import BatchingEngine
+from shellac_tpu.obs import Registry, ServeMetrics, get_registry
 from shellac_tpu.utils.failure import Heartbeat, RestartBudget
 
 
@@ -123,11 +132,15 @@ class _Generation:
 
 class _Pending:
     __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback",
-                 "lps", "plp", "tlp", "rid", "deadline", "kind")
+                 "lps", "plp", "tlp", "rid", "deadline", "kind", "trace")
 
     def __init__(self, rid, stream: bool = False, holdback: int = 0,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None, trace=None):
         self.rid = rid
+        # Observability span (obs.RequestTrace): created at admission,
+        # handed to the engine for the prefill/first-token marks, and
+        # settled wherever the request settles (finish/shed/abort).
+        self.trace = trace
         # Absolute monotonic deadline mirroring the client's timeout;
         # the scheduler sheds the request if this expires before its
         # prefill ever runs (None = no deadline).
@@ -175,8 +188,19 @@ class InferenceServer:
         restart_window: float = 300.0,
         engine_factory: Optional[Callable[[], Any]] = None,
         heartbeat_path: Optional[str] = None,
+        registry: Optional[Registry] = None,
+        metrics: bool = True,
         **engine_kw,
     ):
+        # Observability: every span/counter lands in `registry` — the
+        # process-global default unless the caller isolates one.
+        # metrics=False swaps in a disabled registry (all writes no-op,
+        # /metrics answers 404) without any call-site branching.
+        if registry is None:
+            registry = get_registry() if metrics else Registry(enabled=False)
+        self._registry = registry
+        self._m = ServeMetrics(registry)
+        self._t0 = time.monotonic()
         # Validate BEFORE starting the scheduler thread: raising after
         # start() would orphan an engine-owning daemon thread the
         # caller can never close().
@@ -202,6 +226,10 @@ class InferenceServer:
                 "it did not construct"
             )
         if engine is None:
+            # Engines this server builds share its registry, so engine
+            # gauges and request spans expose through one scrape (and a
+            # supervisor-rebuilt engine keeps depositing there too).
+            engine_kw.setdefault("registry", registry)
             engine = BatchingEngine(cfg, params, **engine_kw)
             if engine_factory is None:
                 # Retained cfg/params/engine_kw rebuild an identical
@@ -296,6 +324,43 @@ class InferenceServer:
             info["error"] = self._fatal
         return info
 
+    # ---- observability ----------------------------------------------
+
+    @property
+    def metrics_enabled(self) -> bool:
+        return self._registry.enabled
+
+    @property
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._t0
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the shared registry, refreshed
+        with scrape-time gauges (engine stats counters, supervisor
+        state, uptime). Event-driven series (spans, restart/shed/reject
+        counters) are already up to date; only mirrors of host ints are
+        set here, so an idle server pays nothing between scrapes. Keeps
+        answering through an outage, like /stats."""
+        m = self._m
+        g = self._g
+        for k, v in g.engine.stats.items():
+            if isinstance(v, (int, float)):
+                m.engine_stat(k).set(v)
+        m.generation.set(g.gen)
+        m.uptime.set(self.uptime_s)
+        m.pending.set(len(self._pending))
+        return self._registry.render()
+
+    def latency_summary(self) -> Dict[str, Any]:
+        """p50/p90/p99 digests (seconds) of the request-span histograms
+        for /stats — derived from the same series /metrics exposes, so
+        the two surfaces cannot disagree."""
+        return {
+            "ttft_s": self._m.ttft.summary(),
+            "e2e_s": self._m.e2e.summary(),
+            "queue_wait_s": self._m.queue_wait.summary(),
+        }
+
     # ---- supervisor --------------------------------------------------
 
     def _start_generation(self, gen: int, engine) -> _Generation:
@@ -318,6 +383,8 @@ class InferenceServer:
             _, p = self._pending.popitem()
             p.error = msg
             p.kind = "fault"
+            if p.trace is not None:
+                p.trace.abort("fault")
             p.finish()
         while True:
             try:
@@ -374,6 +441,7 @@ class InferenceServer:
                 return
             self._recovering = True
             self.restarts += 1
+            self._m.restarts.inc()
         # Rebuild OUTSIDE the lock: engine construction allocates
         # device memory and may compile, and /health + admission must
         # stay responsive (reporting "recovering") meanwhile. Keep the
@@ -469,6 +537,8 @@ class InferenceServer:
         if self._pending.pop(rid, None) is None:
             return
         self.shed += 1
+        if p.trace is not None:
+            p.trace.shed()
         p.error = ("request shed: deadline expired before prefill "
                    "(server saturated past the client timeout)")
         p.kind = "shed"
@@ -503,6 +573,8 @@ class InferenceServer:
             p = self._pending.pop(rid, None)
             if p is not None:
                 p.error = "cancelled"
+                if p.trace is not None:
+                    p.trace.abort("cancelled")
                 p.finish()
             return
         if deadline is not None and time.monotonic() > deadline:
@@ -512,8 +584,12 @@ class InferenceServer:
             if p is not None:
                 self._shed(rid, p)
             return
+        pend = self._pending.get(rid)
         try:
-            g.engine.submit(rid, tokens, max_new, stop=stop, **samp)
+            g.engine.submit(
+                rid, tokens, max_new, stop=stop,
+                trace=pend.trace if pend is not None else None, **samp,
+            )
         except (ValueError, TypeError) as e:
             # TypeError: unknown sampling kwarg from a programmatic
             # caller — a bad request, not a scheduler-killing fault.
@@ -523,6 +599,8 @@ class InferenceServer:
             p = self._pending.pop(rid, None)
             if p is not None:
                 p.error = str(e)
+                if p.trace is not None:
+                    p.trace.abort("error")
                 p.finish()
 
     def _run(self, g: _Generation) -> None:
@@ -586,6 +664,8 @@ class InferenceServer:
                     p = self._pending.pop(rid, None)
                     if p is not None:
                         p.result = out
+                        if p.trace is not None:
+                            p.trace.finish(len(out))
                         p.lps = lp_store.pop(rid, None)
                         p.plp = plp_store.pop(rid, None)
                         p.tlp = tl_store.pop(rid, None)
@@ -613,6 +693,10 @@ class InferenceServer:
 
     def _submit(self, tokens, max_new: int, stop, samp, *, stream: bool,
                 deadline: Optional[float] = None) -> _Pending:
+        # The span clock starts at admission, before any copying or
+        # queueing, so queue-wait covers everything the client waits
+        # through server-side.
+        trace = self._m.trace()
         # Convert the prompt BEFORE taking the lock: the copy is O(S)
         # and the lock serializes every admission and the supervisor.
         tokens = np.asarray(tokens, np.int32)
@@ -628,12 +712,14 @@ class InferenceServer:
                 raise RuntimeError("server closed")
             g = self._g
             if self._recovering or g.dead:
+                self._m.rejects.labels(reason="recovering").inc()
                 raise ServerUnavailable(
                     "server recovering from an engine fault; retry",
                     http_status=503, retry_after=5.0,
                 )
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
+                self._m.rejects.labels(reason="overloaded").inc()
                 raise ServerUnavailable(
                     f"server overloaded: {len(self._pending)} requests "
                     f"pending (max_pending={self.max_pending})",
@@ -644,7 +730,7 @@ class InferenceServer:
             if deadline is not None:
                 self._saw_deadline = True
             p = _Pending(rid, stream=stream, holdback=holdback,
-                         deadline=deadline)
+                         deadline=deadline, trace=trace)
             self._pending[rid] = p
             g.submit_q.put(
                 (rid, tokens, max_new, stop, samp or {}, deadline)
@@ -1190,7 +1276,28 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     "restarts": server.restarts,
                     "generation": server._g.gen,
                     "shed": server.shed,
+                    "uptime_s": round(server.uptime_s, 3),
+                    # p50/p90/p99 latency digests from the obs
+                    # histograms (null until requests have completed).
+                    **server.latency_summary(),
                 })
+            elif self.path == "/metrics":
+                if not server.metrics_enabled:
+                    self._send(404, {
+                        "error": "metrics disabled (serve --no-metrics)",
+                    })
+                    return
+                # Prometheus text exposition. Like /stats, this stays
+                # 200 through an outage so scrapers keep collecting.
+                body = server.metrics_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._send(404, {"error": "not found"})
 

@@ -134,7 +134,8 @@ class SpeculativeBatchingEngine(BatchingEngine):
                temperature=None, top_k=None, top_p=None, min_p=None,
                min_tokens=None, logit_bias=None,
                presence_penalty=None, frequency_penalty=None,
-               prompt_logprobs=False, seed=None, constraint=None) -> None:
+               prompt_logprobs=False, seed=None, constraint=None,
+               trace=None) -> None:
         if constraint is not None:
             raise ValueError(
                 f"request {rid!r}: structured decoding is not wired "
@@ -172,7 +173,7 @@ class SpeculativeBatchingEngine(BatchingEngine):
                 f"speculative slack (gamma+2) exceeds max_len {self.max_len}"
             )
         super().submit(rid, tokens, max_new, stop=stop,
-                       temperature=temperature)
+                       temperature=temperature, trace=trace)
 
     # ---- prefill (target via base, plus the draft cache) ------------
 

@@ -1,0 +1,42 @@
+"""shellac_tpu.obs — unified metrics & request tracing.
+
+A dependency-free metrics core (`Counter`, `Gauge`, `Histogram`,
+`Registry` with labeled series and Prometheus text exposition) plus the
+`RequestTrace` span recorder that rides each serving request from
+submit to settlement. Engines, the HTTP server, and the training loop
+all deposit into one process-global registry by default
+(`get_registry()`), so `GET /metrics` — or a bench snapshot — sees
+training throughput and serving latency through one exposition path.
+
+See docs/observability.md for the metric catalog and scrape examples.
+"""
+
+from shellac_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+    linear_buckets,
+    log_buckets,
+    set_default_registry,
+)
+from shellac_tpu.obs.trace import (
+    EngineMetrics,
+    RequestTrace,
+    ServeMetrics,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "get_registry",
+    "set_default_registry",
+    "log_buckets",
+    "linear_buckets",
+    "EngineMetrics",
+    "RequestTrace",
+    "ServeMetrics",
+]

@@ -1,0 +1,227 @@
+"""Request-trace spans and the serving/engine instrument bundles.
+
+A `RequestTrace` rides one request through the serving pipeline —
+submit -> queue wait -> prefill -> first token -> per-token decode ->
+finish/shed/abort — and deposits the derived latency histograms
+(queue-wait, TTFT, time-per-output-token, end-to-end) on settlement.
+Every timestamp is host-side `time.monotonic()` captured at an event
+the host already observes (queue pop, post-sync token arrival), so
+tracing adds no host-device syncs anywhere, let alone inside jitted
+code (the SH002 contract).
+
+`ServeMetrics` / `EngineMetrics` bundle the instruments each layer
+writes so the metric names and bucket layouts are defined exactly once;
+both are cheap to construct repeatedly over the same registry
+(registration is idempotent).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from shellac_tpu.obs.metrics import (
+    Registry,
+    linear_buckets,
+    log_buckets,
+)
+
+#: Latency buckets shared by the request-span histograms: ~1ms..60s.
+LATENCY_BUCKETS = log_buckets(0.001, 60.0, per_decade=4)
+#: Per-output-token pace is faster than request latency: ~0.1ms..10s.
+TPOT_BUCKETS = log_buckets(0.0001, 10.0, per_decade=4)
+#: Batch occupancy is a ratio; eighths resolve typical slot counts.
+OCCUPANCY_BUCKETS = linear_buckets(0.125, 0.125, 8)
+
+#: Request outcomes (the `outcome` label of shellac_requests_total).
+#: ok: completed; shed: deadline expired before prefill; cancelled:
+#: client abandoned it; error: bad request; fault: server-side failure
+#: (scheduler death, wedge, close) — the supervisor's loud-failure arm.
+OUTCOMES = ("ok", "shed", "cancelled", "error", "fault")
+
+
+class ServeMetrics:
+    """The serving-layer instruments over one registry."""
+
+    def __init__(self, registry: Registry):
+        self.registry = registry
+        h, c, g = registry.histogram, registry.counter, registry.gauge
+        self.ttft = h(
+            "shellac_ttft_seconds",
+            "Time from request submit to its first generated token",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.tpot = h(
+            "shellac_tpot_seconds",
+            "Mean time per output token after the first, per request",
+            buckets=TPOT_BUCKETS,
+        )
+        self.queue_wait = h(
+            "shellac_queue_wait_seconds",
+            "Time from request submit to the start of its prefill",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.e2e = h(
+            "shellac_e2e_seconds",
+            "End-to-end request latency (submit to completion)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.requests = c(
+            "shellac_requests_total",
+            "Requests settled, by outcome (ok|shed|cancelled|error|fault)",
+            labels=("outcome",),
+        )
+        self.sheds = c(
+            "shellac_requests_shed_total",
+            "Requests shed on an expired deadline before prefill",
+        )
+        self.rejects = c(
+            "shellac_admission_rejects_total",
+            "Submissions refused at admission, by reason "
+            "(overloaded|recovering)",
+            labels=("reason",),
+        )
+        self.restarts = c(
+            "shellac_supervisor_restarts_total",
+            "Engine generations rebuilt by the serving supervisor",
+        )
+        self.generation = g(
+            "shellac_engine_generation",
+            "Current engine generation (bumps on supervisor rebuild)",
+        )
+        self.uptime = g(
+            "shellac_uptime_seconds", "Seconds since the server started"
+        )
+        self.pending = g(
+            "shellac_pending_requests", "Requests currently pending"
+        )
+        self._engine_stats: Dict[str, object] = {}
+
+    def trace(self) -> "RequestTrace":
+        return RequestTrace(self)
+
+    def engine_stat(self, key: str):
+        """Scrape-time gauge mirroring one engine `stats` counter as
+        `shellac_engine_<key>` (keys are code-side identifiers, so the
+        name is exposition-safe by construction)."""
+        gauge = self._engine_stats.get(key)
+        if gauge is None:
+            gauge = self.registry.gauge(
+                f"shellac_engine_{key}", f"Engine stats counter {key!r}"
+            )
+            self._engine_stats[key] = gauge
+        return gauge
+
+
+class RequestTrace:
+    """Span recorder for ONE request. Event methods are idempotent (the
+    first call wins) and `finish`/`shed`/`abort` settle the trace
+    exactly once — late duplicate settlement from racing sweeps (close
+    vs a final delivery) is ignored, mirroring the server's own
+    pop-arbitrated settlement."""
+
+    __slots__ = ("_m", "t_submit", "t_prefill", "t_first", "t_done",
+                 "n_tokens", "outcome")
+
+    def __init__(self, metrics: ServeMetrics):
+        self._m = metrics
+        self.t_submit = time.monotonic()
+        self.t_prefill: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self.n_tokens = 0
+        self.outcome: Optional[str] = None
+
+    # ---- pipeline events (called by the engine-owning thread) --------
+
+    def prefill_start(self) -> None:
+        """Queue wait ends: the scheduler popped this request into a
+        slot and is about to prefill it."""
+        if self.t_prefill is not None:
+            return
+        self.t_prefill = time.monotonic()
+        self._m.queue_wait.observe(self.t_prefill - self.t_submit)
+
+    def first_token(self) -> None:
+        """The first generated token exists host-side (prefill sampled
+        it): the TTFT point."""
+        if self.t_first is not None:
+            return
+        self.t_first = time.monotonic()
+        self._m.ttft.observe(self.t_first - self.t_submit)
+
+    # ---- settlement --------------------------------------------------
+
+    def _settle(self, outcome: str) -> bool:
+        if self.outcome is not None:
+            return False
+        self.outcome = outcome
+        self.t_done = time.monotonic()
+        self._m.requests.labels(outcome=outcome).inc()
+        return True
+
+    def finish(self, n_tokens: int) -> None:
+        """Completed normally with `n_tokens` generated tokens."""
+        if not self._settle("ok"):
+            return
+        self.n_tokens = int(n_tokens)
+        self._m.e2e.observe(self.t_done - self.t_submit)
+        if self.t_first is not None and self.n_tokens > 1:
+            self._m.tpot.observe(
+                (self.t_done - self.t_first) / (self.n_tokens - 1)
+            )
+
+    def shed(self) -> None:
+        """Deadline expired before prefill; the scheduler dropped it."""
+        if self._settle("shed"):
+            self._m.sheds.inc()
+
+    def abort(self, outcome: str = "cancelled") -> None:
+        """Any non-ok, non-shed settlement: cancelled | error | fault."""
+        self._settle(outcome)
+
+
+class EngineMetrics:
+    """The engine-layer instruments: batch occupancy, prefill vs decode
+    section durations, and cache-utilization gauges. All writes happen
+    from the engine-owning thread, once per engine STEP (host code,
+    after the step's own host sync) — never per token and never inside
+    a jitted program."""
+
+    def __init__(self, registry: Registry):
+        self.registry = registry
+        h, g = registry.histogram, registry.gauge
+        self.prefill_seconds = h(
+            "shellac_prefill_seconds",
+            "Wall time of one engine step's prefill section (all "
+            "prefill/chunk programs it ran)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.decode_window_seconds = h(
+            "shellac_decode_window_seconds",
+            "Wall time of one decode window (decode_ticks ticks plus "
+            "the host sync)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.occupancy = h(
+            "shellac_batch_occupancy",
+            "Active slots / n_slots at each decode window",
+            buckets=OCCUPANCY_BUCKETS,
+        )
+        self.slots_busy = g(
+            "shellac_slots_busy", "Slots currently holding a request"
+        )
+        self.queue_depth = g(
+            "shellac_engine_queue_depth",
+            "Requests admitted but not yet in a slot",
+        )
+        self.kv_util = g(
+            "shellac_kv_utilization",
+            "Live KV tokens / capacity (dense) or pool blocks in use / "
+            "pool size (paged)",
+        )
+        self.prefix_blocks = g(
+            "shellac_prefix_cache_blocks",
+            "Blocks currently registered in the prefix cache (paged "
+            "engines with prefix_cache=True)",
+        )

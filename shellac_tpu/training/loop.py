@@ -15,10 +15,22 @@ from typing import Iterator, Optional
 import jax
 
 from shellac_tpu.config import ModelConfig, TrainConfig
+from shellac_tpu.obs import get_registry, log_buckets
 from shellac_tpu.training.trainer import init_train_state, make_train_step
 from shellac_tpu.utils.failure import FailureDetector, Heartbeat
 from shellac_tpu.utils.metrics import MetricsLogger
 from shellac_tpu.utils.tracing import StepTimer
+
+
+def _interval_histogram():
+    """Step-interval wall-time distribution in the shared registry, so
+    training pace is scrapable alongside serving latency (one series
+    per process; registration is idempotent)."""
+    return get_registry().histogram(
+        "shellac_train_log_interval_seconds",
+        "Wall time between metric log boundaries (log_every steps)",
+        buckets=log_buckets(0.001, 600.0),
+    )
 
 
 def fit(
@@ -84,7 +96,7 @@ def fit(
     logger = MetricsLogger(log_path, every=1)
     detector = FailureDetector()
     heartbeat = Heartbeat(heartbeat_path) if heartbeat_path else None
-    timer = StepTimer()
+    timer = StepTimer(histogram=_interval_histogram())
     restores = 0
 
     preempted = threading.Event()
@@ -101,70 +113,82 @@ def fit(
 
     step = int(jax.device_get(state.step))
     stop = False
-    while step < train_cfg.total_steps and not stop:
-        try:
-            batch = next(data_iter)
-        except StopIteration:
-            break
-        state, metrics = step_fn(state, batch)
-        step += 1
+    # Context-managed logger: the JSONL file is flushed and closed even
+    # when a step (or the final checkpoint save) raises.
+    with logger:
+        while step < train_cfg.total_steps and not stop:
+            try:
+                batch = next(data_iter)
+            except StopIteration:
+                break
+            state, metrics = step_fn(state, batch)
+            step += 1
 
-        if not multi and preempted.is_set():
-            stop = True
-        if step % log_every == 0 or step >= train_cfg.total_steps:
-            if multi:
-                # Preemption signals land per-VM at different times; a
-                # process acting on its local flag alone would enter the
-                # final collective save while the others still run step
-                # collectives, deadlocking the job. Agree at the log
-                # boundary (the existing sync point) — maintenance grace
-                # periods are much longer than a log interval.
-                from jax.experimental import multihost_utils as mhu
+            if not multi and preempted.is_set():
+                stop = True
+            if step % log_every == 0 or step >= train_cfg.total_steps:
+                if multi:
+                    # Preemption signals land per-VM at different
+                    # times; a process acting on its local flag alone
+                    # would enter the final collective save while the
+                    # others still run step collectives, deadlocking
+                    # the job. Agree at the log boundary (the existing
+                    # sync point) — maintenance grace periods are much
+                    # longer than a log interval.
+                    from jax.experimental import multihost_utils as mhu
 
-                import numpy as _np
+                    import numpy as _np
 
-                if bool(mhu.process_allgather(
-                    _np.asarray([preempted.is_set()])
-                ).any()):
-                    preempted.set()
-                    stop = True
-            loss = float(jax.device_get(metrics["loss"]))  # sync point
-            dt = timer.tick()
-            host_metrics = {k: jax.device_get(v) for k, v in metrics.items()}
-            if dt is not None:
-                host_metrics["steps_per_sec"] = log_every / dt
-            logger.log(step, host_metrics)
-            if heartbeat is not None:
-                heartbeat.beat(step)
+                    if bool(mhu.process_allgather(
+                        _np.asarray([preempted.is_set()])
+                    ).any()):
+                        preempted.set()
+                        stop = True
+                loss = float(jax.device_get(metrics["loss"]))  # sync point
+                dt = timer.tick()
+                host_metrics = {
+                    k: jax.device_get(v) for k, v in metrics.items()
+                }
+                if dt is not None:
+                    host_metrics["steps_per_sec"] = log_every / dt
+                logger.log(step, host_metrics)
+                if heartbeat is not None:
+                    heartbeat.beat(step)
 
-            reason = detector.check(loss)
-            if reason is not None:
-                if ckpt is None or ckpt.latest_step() is None or restores >= max_restores:
-                    raise RuntimeError(
-                        f"training failure at step {step}: {reason}; "
-                        "no checkpoint to restore (or restore budget spent)"
+                reason = detector.check(loss)
+                if reason is not None:
+                    if (ckpt is None or ckpt.latest_step() is None
+                            or restores >= max_restores):
+                        raise RuntimeError(
+                            f"training failure at step {step}: {reason}; "
+                            "no checkpoint to restore (or restore budget "
+                            "spent)"
+                        )
+                    restores += 1
+                    abstract = jax.eval_shape(lambda s: s, state)
+                    state = None  # free the diverged state before restoring
+                    state = ckpt.restore(
+                        abstract_state=abstract, mesh=mesh,
+                        model_cfg=model_cfg
                     )
-                restores += 1
-                abstract = jax.eval_shape(lambda s: s, state)
-                state = None  # free the diverged state before restoring
-                state = ckpt.restore(
-                    abstract_state=abstract, mesh=mesh, model_cfg=model_cfg
-                )
-                step = int(jax.device_get(state.step))
-                detector.reset()
-                logger.log(step, {"restored_after": reason, "restores": restores})
-                continue
+                    step = int(jax.device_get(state.step))
+                    detector.reset()
+                    logger.log(
+                        step,
+                        {"restored_after": reason, "restores": restores},
+                    )
+                    continue
 
-        if ckpt is not None and step % checkpoint_every == 0:
-            ckpt.save(step, state)
+            if ckpt is not None and step % checkpoint_every == 0:
+                ckpt.save(step, state)
 
-    if ckpt is not None:
-        ckpt.save(int(jax.device_get(state.step)), state, force=True, wait=True)
-    if preempted.is_set():
-        logger.log(step, {"preempted": 1})
+        if ckpt is not None:
+            ckpt.save(int(jax.device_get(state.step)), state, force=True,
+                      wait=True)
+        if preempted.is_set():
+            logger.log(step, {"preempted": 1})
     if install_handler:
         signal.signal(signal.SIGTERM, old_handler)
-    logger.close()
     return state
 
 
@@ -212,28 +236,29 @@ def fit_lora(
         state = init_lora_state(model_cfg, train_cfg, lora_cfg, key, mesh=mesh)
 
     step_fn = make_lora_train_step(model_cfg, train_cfg, lora_cfg, mesh=mesh)
-    logger = MetricsLogger(log_path, every=1)
-    timer = StepTimer()
+    timer = StepTimer(histogram=_interval_histogram())
 
     step = int(jax.device_get(state.step))
-    while step < train_cfg.total_steps:
-        try:
-            batch = next(data_iter)
-        except StopIteration:
-            break
-        state, metrics = step_fn(state, base_params, batch)
-        step += 1
-        if step % log_every == 0 or step >= train_cfg.total_steps:
-            host_metrics = {k: jax.device_get(v) for k, v in metrics.items()}
-            dt = timer.tick()
-            if dt is not None:
-                host_metrics["steps_per_sec"] = log_every / dt
-            logger.log(step, host_metrics)
-        if ckpt is not None and step % checkpoint_every == 0:
-            ckpt.save(step, state)
+    with MetricsLogger(log_path, every=1) as logger:
+        while step < train_cfg.total_steps:
+            try:
+                batch = next(data_iter)
+            except StopIteration:
+                break
+            state, metrics = step_fn(state, base_params, batch)
+            step += 1
+            if step % log_every == 0 or step >= train_cfg.total_steps:
+                host_metrics = {
+                    k: jax.device_get(v) for k, v in metrics.items()
+                }
+                dt = timer.tick()
+                if dt is not None:
+                    host_metrics["steps_per_sec"] = log_every / dt
+                logger.log(step, host_metrics)
+            if ckpt is not None and step % checkpoint_every == 0:
+                ckpt.save(step, state)
 
-    if ckpt is not None:
-        ckpt.save(int(jax.device_get(state.step)), state, force=True,
-                  wait=True)
-    logger.close()
+        if ckpt is not None:
+            ckpt.save(int(jax.device_get(state.step)), state, force=True,
+                      wait=True)
     return state

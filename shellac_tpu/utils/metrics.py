@@ -1,14 +1,25 @@
-"""Scalar metrics logging (JSONL file + stdout) and perf accounting."""
+"""Scalar metrics logging (JSONL file + stdout) and perf accounting.
+
+`MetricsLogger` sits on top of the shellac_tpu.obs core: every scalar
+it logs is also routed into the shared registry as a
+`shellac_train_<name>` gauge (latest value), so train throughput/MFU
+and serving latency share one Prometheus exposition path. The JSONL
+file remains the durable per-step record; the registry is the live
+scrape surface.
+"""
 
 from __future__ import annotations
 
 import json
+import re
 import sys
 import time
 from typing import IO, Optional
 
 import jax
 import numpy as np
+
+from shellac_tpu.obs import get_registry
 
 # v5e bf16 peak; single source of truth for MFU across bench scripts.
 TPU_V5E_BF16_PEAK_FLOPS = 197e12
@@ -26,22 +37,64 @@ def _to_python(tree):
     )
 
 
+def _metric_name(key: str) -> str:
+    """A logged dict key as a Prometheus-safe metric name suffix."""
+    return re.sub(r"[^a-zA-Z0-9_]", "_", key)
+
+
 class MetricsLogger:
+    """JSONL + stdout scalar logger, usable as a context manager so the
+    file is closed (and flushed) even when the training loop raises:
+
+        with MetricsLogger(path) as logger:
+            logger.log(step, metrics)
+
+    The legacy call pattern (construct, log, close) keeps working.
+    """
+
     def __init__(
         self,
         path: Optional[str] = None,
         *,
         stdout: bool = True,
         every: int = 1,
+        registry=None,
+        prefix: str = "shellac_train_",
     ):
         self._file: Optional[IO] = open(path, "a") if path else None
         self._stdout = stdout
         self._every = max(every, 1)
+        self._registry = registry if registry is not None else get_registry()
+        self._prefix = prefix
+        self._gauges: dict = {}
+        self._steps = self._registry.counter(
+            f"{prefix}log_steps_total",
+            "Training steps that reached the metrics logger",
+        )
+
+    def _route(self, record: dict) -> None:
+        """Mirror the record's scalars into the shared registry as
+        latest-value gauges (one exposition path with serving)."""
+        if not self._registry.enabled:
+            return
+        self._steps.inc()
+        for k, v in record.items():
+            if k == "time" or not isinstance(v, (int, float)):
+                continue
+            gauge = self._gauges.get(k)
+            if gauge is None:
+                gauge = self._registry.gauge(
+                    f"{self._prefix}{_metric_name(k)}",
+                    f"Latest logged training scalar {k!r}",
+                )
+                self._gauges[k] = gauge
+            gauge.set(float(v))
 
     def log(self, step: int, metrics: dict) -> None:
         if step % self._every:
             return
         record = {"step": int(step), "time": time.time(), **_to_python(metrics)}
+        self._route(record)
         line = json.dumps(record)
         if self._file:
             self._file.write(line + "\n")
@@ -56,4 +109,12 @@ class MetricsLogger:
 
     def close(self) -> None:
         if self._file:
+            self._file.flush()
             self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -37,12 +37,17 @@ class StepTimer:
     does not synchronize).
     """
 
-    def __init__(self, tokens_per_step: Optional[int] = None, warmup: int = 2):
+    def __init__(self, tokens_per_step: Optional[int] = None, warmup: int = 2,
+                 histogram=None):
         self.tokens_per_step = tokens_per_step
         self.warmup = warmup
         self._count = 0
         self._last: Optional[float] = None
         self._ema: Optional[float] = None
+        # Optional obs.Histogram: post-warmup step times are observed
+        # into it, so the step-time DISTRIBUTION (not just the EMA)
+        # reaches the shared registry / Prometheus exposition.
+        self._hist = histogram
 
     def tick(self) -> Optional[float]:
         """Mark a step boundary; returns the step time (or None in warmup)."""
@@ -56,6 +61,8 @@ class StepTimer:
         if self._count <= self.warmup:
             return None
         self._ema = dt if self._ema is None else 0.9 * self._ema + 0.1 * dt
+        if self._hist is not None:
+            self._hist.observe(dt)
         return dt
 
     @property

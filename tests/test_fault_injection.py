@@ -40,6 +40,7 @@ from shellac_tpu.inference.server import (
     make_http_server,
 )
 from shellac_tpu.models import transformer
+from shellac_tpu.obs import Registry
 
 from conftest import run_two_process
 
@@ -579,6 +580,91 @@ class TestDeadlineShedding:
                 time.sleep(0.05)
             assert srv.shed == 1
             assert eng.stats["prefills"] == 1
+        finally:
+            eng.gate.set()
+            srv.close()
+            assert not srv._thread.is_alive()
+
+
+class TestObservabilityCounters:
+    """The obs layer under faults: supervisor restarts and deadline
+    sheds must increment their counters (and settle the request spans)
+    across an engine rebuild — the /metrics view of PR 2's recovery
+    story."""
+
+    def test_restart_counter_increments_across_rebuild(self):
+        reg = Registry()
+        cfg, params, eng = _mk(good_steps=0, registry=reg)
+
+        def factory():
+            return BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  temperature=0.0, registry=reg)
+
+        srv = InferenceServer(cfg, params, engine=eng, step_timeout=10.0,
+                              restart_budget=2, engine_factory=factory,
+                              registry=reg)
+        gen0_thread = srv._thread
+        httpd = make_http_server(srv)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "ok")
+            # The wedged in-flight request settled as a fault span and
+            # the supervisor rebuild incremented the restart counter.
+            assert reg.value("shellac_supervisor_restarts_total") == 1
+            assert reg.value(
+                "shellac_requests_total", outcome="fault"
+            ) == 1
+            out = srv.generate([4, 5, 6], max_new=4, timeout=120)
+            assert len(out) == 4
+            assert reg.value("shellac_requests_total", outcome="ok") == 1
+            # The REBUILT engine deposits into the same registry, and a
+            # scrape shows the new generation + the restart.
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=30) as r:
+                text = r.read().decode()
+            assert "shellac_supervisor_restarts_total 1" in text
+            assert "shellac_engine_generation 1" in text
+            assert 'shellac_ttft_seconds_bucket{le="' in text
+        finally:
+            _teardown(srv, eng, httpd=httpd, old_threads=(gen0_thread,))
+
+    def test_shed_counter_increments(self):
+        """A deadline-shed request settles its span as shed and bumps
+        shellac_requests_shed_total (the scenario of
+        TestDeadlineShedding, observed through the registry)."""
+        reg = Registry()
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = _GatedEngine(cfg, params, n_slots=2, max_len=64,
+                           temperature=0.0, registry=reg)
+        srv = InferenceServer(cfg, params, engine=eng, registry=reg)
+        try:
+            results = []
+            t = threading.Thread(target=lambda: results.append(
+                srv.generate([1, 2, 3], max_new=4, timeout=120)))
+            t.start()
+            deadline = time.monotonic() + 60
+            while not srv._pending and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.2)  # let the scheduler enter the gated step
+            with pytest.raises(TimeoutError):
+                srv.generate([5, 6], max_new=4, timeout=0.2)
+            time.sleep(0.1)
+            eng.gate.set()
+            t.join(timeout=120)
+            assert results and len(results[0]) == 4
+            deadline = time.monotonic() + 60
+            while srv.shed < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert reg.value("shellac_requests_shed_total") == 1
+            assert reg.value(
+                "shellac_requests_total", outcome="shed"
+            ) == 1
+            # Only the served request's span reached prefill/TTFT.
+            assert reg.value("shellac_ttft_seconds") == 1
         finally:
             eng.gate.set()
             srv.close()
