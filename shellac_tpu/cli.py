@@ -152,12 +152,15 @@ def _restore_params(args, cfg, train_cfg=None):
 def _resume_skip(args) -> int:
     """Batches already consumed by a checkpointed run: resume continues
     the data stream where it left off rather than replaying (and
-    re-training on) the earliest batches."""
+    re-training on) the earliest batches. A cheap directory scan — the
+    real Checkpointer (sweeps, manager threads) is built once, inside
+    the loop, which also re-derives this skip via data_factory if the
+    restore lands on an older intact step."""
     if not getattr(args, "ckpt_dir", None):
         return 0
-    from shellac_tpu.training.checkpoint import Checkpointer
+    from shellac_tpu.training.checkpoint import latest_step_on_disk
 
-    latest = Checkpointer(args.ckpt_dir).latest_step()
+    latest = latest_step_on_disk(args.ckpt_dir)
     return int(latest) if latest is not None else 0
 
 
@@ -225,19 +228,33 @@ def cmd_train(args):
         # seed stays shared.
     else:
         mesh = _mesh_from(args)
-    data = _data_iter(args, cfg, args.batch, args.seq,
-                      skip=_resume_skip(args))
     if args.lora_rank is not None:
+        data = _data_iter(args, cfg, args.batch, args.seq,
+                          skip=_resume_skip(args))
         rc = _train_lora(args, cfg, tcfg, mesh, data)
         _dump_metrics(args)
         return rc
+
+    def data_factory(step):
+        # fit builds the stream from this exactly once, at the step the
+        # run actually starts from (resume restore included), and
+        # sentinel rollbacks re-derive it from the restored step: the
+        # deterministic skip path replays exactly the batches the
+        # rolled-back steps consumed, so a recovered run finishes
+        # identical to an unfaulted one.
+        return _data_iter(args, cfg, args.batch, args.seq, skip=step)
+
     state = fit(
-        cfg, tcfg, data,
+        cfg, tcfg, None,
         mesh=mesh,
         checkpoint_dir=args.ckpt_dir,
         checkpoint_every=args.ckpt_every,
         log_path=args.log_path,
         log_every=args.log_every,
+        heartbeat_path=args.heartbeat_file,
+        anomaly_action=args.anomaly_action,
+        max_restores=args.max_restores,
+        data_factory=data_factory,
     )
     _dump_metrics(args)
     import jax
@@ -1009,6 +1026,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="write the shared metrics-registry snapshot "
                         "(shellac_train_* gauges, step-interval "
                         "histogram) as JSON when training finishes")
+    t.add_argument("--heartbeat-file", default=None, dest="heartbeat_file",
+                   help="liveness file the training loop touches at "
+                        "1 Hz at step boundaries (forced beats bracket "
+                        "anomaly rollback/restore), for external "
+                        "watchdogs — matches serve --heartbeat-file")
+    t.add_argument("--anomaly-action", default="rollback",
+                   dest="anomaly_action",
+                   choices=["warn", "skip", "rollback", "fatal"],
+                   help="what the anomaly sentinel does about a "
+                        "non-finite/spiking loss: rollback (default) "
+                        "restores the last-good checkpoint and replays "
+                        "the data stream; see docs/training.md "
+                        "failure semantics")
+    t.add_argument("--max-restores", type=int, default=2,
+                   dest="max_restores",
+                   help="skip/rollback recoveries allowed per hour "
+                        "before the sentinel escalates to fatal "
+                        "(0 = first anomaly is fatal)")
     t.add_argument("--learning-rate", type=float, dest="learning_rate")
     t.add_argument("--warmup-steps", type=int, dest="warmup_steps")
     t.add_argument("--weight-decay", type=float, dest="weight_decay")

@@ -8,6 +8,11 @@ from shellac_tpu.training.trainer import (
 )
 from shellac_tpu.training.evaluate import evaluate, make_eval_step
 from shellac_tpu.training.loop import fit
+from shellac_tpu.training.resilience import (
+    Anomaly,
+    AnomalySentinel,
+    ResilienceMetrics,
+)
 from shellac_tpu.training.lora import (
     LoRAConfig,
     LoRAState,
@@ -18,6 +23,9 @@ from shellac_tpu.training.lora import (
 )
 
 __all__ = [
+    "Anomaly",
+    "AnomalySentinel",
+    "ResilienceMetrics",
     "evaluate",
     "make_eval_step",
     "LoRAConfig",

@@ -1,0 +1,157 @@
+"""Train-loop chaos harness: deterministic fault injection.
+
+Drives the end-to-end chaos tests (tests/test_fault_injection.py) and
+doubles as a drill kit against a real run directory: every injector
+reproduces a failure long training jobs actually hit —
+
+  - `poison_batches`: one batch's loss goes NaN at a chosen step (a
+    bad shard row, a bf16 overflow) — exercises the in-jit update
+    guard plus the sentinel's rollback path;
+  - `truncate_step` / `scramble_step` / `drop_item`: a checkpoint step
+    is partially written or bit-rotted on disk — exercises
+    `Checkpointer.verify` and the fallback-restore walk;
+  - `fake_interrupted_save`: the debris a kill mid-save leaves behind
+    (an uncommitted orbax tmp directory) — exercises the startup
+    sweep that keeps it from ever being restored as "latest".
+
+Injectors only touch the filesystem / the data stream; none of them
+reach into engine or loop internals, so what the chaos tests prove is
+the public failure contract (docs/training.md, "Failure semantics").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Iterator
+
+import numpy as np
+
+from shellac_tpu.training.checkpoint import TMP_DIR_MARKER
+
+
+def poison_batches(
+    it: Iterator[dict], *, at_step: int, start_step: int = 0
+) -> Iterator[dict]:
+    """Yield from `it`, NaN-poisoning the batch consumed by training
+    step `at_step` (1-indexed, matching the loop's step counter).
+
+    The poison rides the loss mask (added if absent), so inputs stay
+    valid token ids but the step's loss and gradients go non-finite —
+    the realistic shape of a corrupt shard row. Each wrapper poisons
+    its step at most once, so which SCENARIO you get is decided by who
+    builds iterators: wrap only the initial iterator and a rollback's
+    rebuilt stream is clean (transient fault); wrap inside the
+    `data_factory` and every replay re-poisons (poisoned corpus, which
+    must escalate to fatal).
+
+    `start_step` is the step count already consumed before `it` begins
+    (a resumed/rolled-back iterator built with `skip=start_step`), so
+    `at_step` always addresses the same global training step.
+    """
+    if at_step < 1:
+        raise ValueError("at_step is a 1-indexed training step")
+    for i, batch in enumerate(it, start=start_step + 1):
+        if i == at_step:
+            batch = dict(batch)
+            mask = batch.get("mask")
+            shape = np.asarray(batch["inputs"]).shape
+            if mask is None:
+                mask = np.ones(shape, np.float32)
+            batch["mask"] = np.asarray(mask, np.float32).copy()
+            batch["mask"][...] = np.nan
+        yield batch
+
+
+def _step_dir(directory: str, step: int) -> str:
+    d = os.path.join(os.path.abspath(directory), str(step))
+    if not os.path.isdir(d):
+        raise FileNotFoundError(f"no checkpoint step directory {d}")
+    return d
+
+
+def _payload_files(step_dir: str, min_bytes: int) -> list:
+    out = []
+    for root, _, files in os.walk(step_dir):
+        for name in files:
+            p = os.path.join(root, name)
+            if os.path.getsize(p) >= min_bytes:
+                out.append(p)
+    if not out:
+        raise FileNotFoundError(
+            f"no files >= {min_bytes} bytes under {step_dir} to corrupt"
+        )
+    return sorted(out)
+
+
+def truncate_step(directory: str, step: int, *, min_bytes: int = 64) -> int:
+    """Truncate every sizable file of a saved step to half its length —
+    the on-disk shape of a write that died partway. Returns the number
+    of files damaged."""
+    files = _payload_files(_step_dir(directory, step), min_bytes)
+    for p in files:
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+    return len(files)
+
+
+def scramble_step(directory: str, step: int, *, min_bytes: int = 64,
+                  seed: int = 0) -> int:
+    """Overwrite every sizable file of a saved step with deterministic
+    garbage of the same length — bit-rot / torn-write corruption that
+    preserves file sizes. Returns the number of files damaged."""
+    rng = np.random.default_rng(seed)
+    files = _payload_files(_step_dir(directory, step), min_bytes)
+    for p in files:
+        n = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.write(rng.integers(0, 256, size=n, dtype=np.uint8).tobytes())
+    return len(files)
+
+
+def drop_item(directory: str, step: int, item: str = "default") -> None:
+    """Delete a step's item payload wholesale — structural corruption:
+    the step directory exists (and is selected by `latest_step`) but
+    holds nothing restorable."""
+    d = os.path.join(_step_dir(directory, step), item)
+    if not os.path.isdir(d):
+        raise FileNotFoundError(f"step {step} has no item dir {d}")
+    shutil.rmtree(d)
+
+
+def fake_interrupted_save(directory: str, step: int,
+                          age_s: float = 2 * 3600.0) -> str:
+    """Fabricate the debris a kill mid-save leaves behind: an
+    uncommitted orbax tmp directory for `step` (atomic-rename commit
+    means a real mid-save kill leaves exactly this), backdated by
+    `age_s` so it reads as ABANDONED — the startup sweep deliberately
+    leaves young tmp dirs alone, since those may be another process's
+    live async save. Returns the debris path;
+    `Checkpointer.__init__`'s sweep must remove it."""
+    root = os.path.abspath(directory)
+    os.makedirs(root, exist_ok=True)
+    debris = os.path.join(root, f"{step}{TMP_DIR_MARKER}1234567890")
+    os.makedirs(os.path.join(debris, "default"), exist_ok=True)
+    with open(os.path.join(debris, "default", "_METADATA"), "w") as f:
+        f.write("{")  # truncated on purpose
+    old = time.time() - age_s
+    os.utime(debris, (old, old))
+    return debris
+
+
+def tamper_manifest(directory: str, step: int, **overrides) -> str:
+    """Rewrite fields of a step's integrity manifest (e.g.
+    `leaf_count=999`) so `Checkpointer.verify` must reject the step
+    even though the orbax payload itself is intact. Returns the
+    manifest path."""
+    path = os.path.join(
+        os.path.abspath(directory), "manifests", f"{step}.json"
+    )
+    with open(path) as f:
+        manifest = json.load(f)
+    manifest.update(overrides)
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    return path

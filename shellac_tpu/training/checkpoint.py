@@ -1,21 +1,104 @@
-"""Distributed checkpoint / resume on orbax.
+"""Distributed checkpoint / resume on orbax, with integrity tracking.
 
 Orbax writes each array shard from the device that owns it (OCDBT
 format), so saving a ZeRO-sharded TrainState never gathers parameters to
 one host, and restore places shards directly onto the target mesh via
 abstract arrays carrying NamedShardings.
+
+Integrity contract (docs/training.md, "Failure semantics"):
+
+  - every `save` also writes a per-step manifest (leaf count, tree-
+    structure digest, per-leaf shapes/dtypes) under `manifests/`;
+  - `verify(step)` checks a saved step against its manifest without
+    reading array data;
+  - `restore(..., fallback=True)` walks steps newest→oldest past
+    corrupt/partial ones, quarantining each bad step (directory
+    renamed `<step>.corrupt`, never re-selected by `latest_step`);
+  - construction sweeps interrupted-save debris (uncommitted orbax tmp
+    directories), so a kill mid-save can never be restored as
+    "latest" — the commit is an atomic rename, and anything left
+    un-renamed is garbage by definition.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+import shutil
+import time
+from typing import Any, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 from shellac_tpu.models import transformer
 from shellac_tpu.training.train_state import state_shardings
+
+# Orbax commits a step by renaming `<step><marker><ts>` to `<step>`;
+# anything still carrying the marker is an interrupted save.
+TMP_DIR_MARKER = ".orbax-checkpoint-tmp-"
+# Tmp debris younger than this may be ANOTHER process's live async
+# save (eval/serve opening a directory a trainer is writing) — leave
+# it; it is never selectable as a step either way. Older debris is an
+# abandoned interrupted save and is removed.
+DEBRIS_TTL_S = 3600.0
+CORRUPT_SUFFIX = ".corrupt"
+_MANIFEST_DIRNAME = "manifests"
+_MANIFEST_VERSION = 1
+_CORRUPT_MANIFEST = object()
+
+
+def _metrics():
+    """The shared shellac_train_* resilience instruments (idempotent
+    registration; imported lazily to keep this module importable
+    without the obs wiring in scope)."""
+    from shellac_tpu.training.resilience import ResilienceMetrics
+
+    return ResilienceMetrics()
+
+
+def _key_str(entry) -> str:
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def _leaf_rows(tree: Any) -> List[Tuple[str, Tuple[int, ...], str]]:
+    """Sorted (path, shape, dtype) rows for every leaf. Sorted because
+    orbax metadata comes back as nested dicts whose flattening order
+    (sorted keys) differs from a dataclass pytree's field order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return sorted(
+        (
+            "/".join(_key_str(e) for e in path),
+            tuple(int(s) for s in x.shape),
+            str(x.dtype),
+        )
+        for path, x in flat
+    )
+
+
+def _rows_digest(rows: List[Tuple[str, Tuple[int, ...], str]]) -> str:
+    canonical = json.dumps(
+        [[p, list(s), d] for p, s, d in rows], separators=(",", ":")
+    )
+    return hashlib.sha256(canonical.encode()).hexdigest()
+
+
+def latest_step_on_disk(directory: str) -> Optional[int]:
+    """Newest committed step in a checkpoint directory, by directory
+    scan alone — no CheckpointManager (with its background threads and
+    startup sweeps) is built. For read-only peeks like the CLI's
+    resume-skip computation; quarantined (`*.corrupt`) and uncommitted
+    tmp directories are never counted."""
+    root = os.path.abspath(directory)
+    if not os.path.isdir(root):
+        return None
+    steps = [int(name) for name in os.listdir(root)
+             if name.isdigit() and os.path.isdir(os.path.join(root, name))]
+    return max(steps) if steps else None
 
 
 class Checkpointer:
@@ -28,34 +111,258 @@ class Checkpointer:
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
     ):
+        self._root = os.path.abspath(directory)
+        self._manifest_dir = os.path.join(self._root, _MANIFEST_DIRNAME)
+        # Steps this process has seen fail verification/restore; kept
+        # alongside the on-disk rename so non-zero processes (which do
+        # not touch the shared directory) exclude them identically.
+        self._quarantined: set = set()
+        # Newest async-saved step not yet known committed (gauge defers
+        # to the next wait/save/close — a commit barrier).
+        self._pending_last_good: Optional[int] = None
+        self._sweep_interrupted_saves()
         self._mngr = ocp.CheckpointManager(
-            os.path.abspath(directory),
+            self._root,
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 save_interval_steps=save_interval_steps,
                 create=True,
             ),
+            # Registered up front so `item_metadata` (verify, the
+            # dtype-drift probe) works before any restore call.
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
+        self._sweep_orphan_manifests()
 
     @property
     def directory(self) -> str:
         return str(self._mngr.directory)
 
+    # ---- integrity: sweep / manifest / verify / quarantine -----------
+
+    def _sweep_interrupted_saves(self) -> List[str]:
+        """Remove uncommitted orbax tmp directories before the manager
+        scans for steps. A kill mid-save leaves exactly this debris
+        (commit is an atomic rename), and it must never shadow or be
+        mistaken for a real step. Only debris older than DEBRIS_TTL_S
+        is deleted: a fresh tmp dir may be a LIVE async save from a
+        concurrent process (eval/serve opening the directory mid-
+        train), and tmp names are unrestorable either way — hygiene
+        can wait, clobbering a live write cannot be undone."""
+        removed: List[str] = []
+        if jax.process_index() != 0 or not os.path.isdir(self._root):
+            return removed
+        now = time.time()
+        for name in sorted(os.listdir(self._root)):
+            if TMP_DIR_MARKER not in name:
+                continue
+            path = os.path.join(self._root, name)
+            try:
+                if now - os.path.getmtime(path) < DEBRIS_TTL_S:
+                    continue
+            except OSError:
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(path)
+        return removed
+
+    def _sweep_orphan_manifests(self) -> None:
+        """Drop manifests whose step no longer exists (garbage-
+        collected by max_to_keep, or a save that never committed).
+        Same freshness guard as the tmp-dir sweep: a manifest is
+        legitimately written BEFORE its async step directory commits,
+        so a young step-less manifest may belong to a concurrent
+        trainer's in-flight save — deleting it would silently strip
+        that step of integrity checking forever."""
+        if jax.process_index() != 0 or not os.path.isdir(self._manifest_dir):
+            return
+        now = time.time()
+        for name in sorted(os.listdir(self._manifest_dir)):
+            step = name[:-5] if name.endswith(".json") else None
+            if step is None or not step.isdigit():
+                continue
+            path = os.path.join(self._manifest_dir, name)
+            try:
+                if now - os.path.getmtime(path) < DEBRIS_TTL_S:
+                    continue
+            except OSError:
+                continue
+            if not os.path.isdir(os.path.join(self._root, step)):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._manifest_dir, f"{int(step)}.json")
+
+    def _write_manifest(self, step: int, state: Any) -> None:
+        """Per-step integrity record, written atomically by process 0.
+        Shapes/dtypes are host metadata — no device sync."""
+        if jax.process_index() != 0:
+            return
+        rows = _leaf_rows(state)
+        manifest = {
+            "format": _MANIFEST_VERSION,
+            "step": int(step),
+            "leaf_count": len(rows),
+            "tree_digest": _rows_digest(rows),
+            "leaves": [[p, list(s), d] for p, s, d in rows],
+        }
+        os.makedirs(self._manifest_dir, exist_ok=True)
+        tmp = self._manifest_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, self._manifest_path(step))
+        # NB: no orphan sweep here — the async save's step directory
+        # commits (atomic rename) after this write, so mid-run the
+        # manifest legitimately precedes its step. Stale manifests from
+        # max_to_keep GC are cleaned at the next construction.
+
+    def _read_manifest(self, step: int):
+        """The step's manifest dict, None when absent (pre-manifest
+        checkpoint), or `_CORRUPT_MANIFEST` when present but
+        unreadable (manifest writes are atomic, so that means rot)."""
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, OSError):
+            return _CORRUPT_MANIFEST
+
+    def verify(self, step: int) -> Optional[str]:
+        """Integrity-check a saved step: None if it passes, else the
+        failure reason.
+
+        Checks, cheapest first: the step is a finalized (committed)
+        checkpoint, its orbax item metadata is readable, and — when an
+        integrity manifest exists — leaf count, tree-structure digest,
+        and every leaf's shape/dtype match. Array data is not re-read;
+        data-level rot that survives these checks surfaces as a restore
+        error, which the fallback walk treats identically.
+        """
+        if step in self._quarantined:
+            return "step is quarantined"
+        if step not in self._mngr.all_steps():
+            return f"step {step} is not a finalized checkpoint"
+        try:
+            meta = self._mngr.item_metadata(step)
+        except Exception as e:  # truncated ocdbt/zarr metadata, etc.
+            return f"unreadable checkpoint metadata ({type(e).__name__}: {e})"
+        if meta is None:
+            return "checkpoint has no restorable item"
+        manifest = self._read_manifest(step)
+        if manifest is None:
+            # Pre-manifest checkpoint: metadata readability is the
+            # strongest check available.
+            return None
+        if manifest is _CORRUPT_MANIFEST:
+            return "unreadable integrity manifest"
+        rows = _leaf_rows(meta)
+        if len(rows) != manifest["leaf_count"]:
+            return (
+                f"leaf count {len(rows)} != manifest "
+                f"{manifest['leaf_count']}"
+            )
+        if _rows_digest(rows) != manifest["tree_digest"]:
+            want = {p: (tuple(s), d) for p, s, d in manifest["leaves"]}
+            for p, s, d in rows:
+                if p not in want:
+                    return f"unexpected leaf {p!r}"
+                if want[p] != (s, d):
+                    return (
+                        f"leaf {p!r} is {s}/{d}, manifest says "
+                        f"{want[p][0]}/{want[p][1]}"
+                    )
+            return "tree structure digest mismatch"
+        return None
+
+    def quarantine(self, step: int, reason: str = "") -> None:
+        """Take a bad step out of circulation: the directory is renamed
+        `<step>.corrupt` (kept for forensics, never re-selected by
+        `latest_step`) and its manifest dropped. Only process 0 touches
+        the shared directory; every process excludes the step locally.
+        """
+        self._quarantined.add(step)
+        if jax.process_index() == 0:
+            src = os.path.join(self._root, str(step))
+            # A step number can be quarantined more than once (rolled
+            # back past, re-saved, re-corrupted): each incident gets a
+            # unique destination, or the rename would fail silently and
+            # leave the bad step selectable as latest forever.
+            dst = src + CORRUPT_SUFFIX
+            n = 1
+            while os.path.exists(dst):
+                n += 1
+                dst = f"{src}{CORRUPT_SUFFIX}.{n}"
+            try:
+                if os.path.isdir(src):
+                    os.rename(src, dst)
+                    with open(os.path.join(dst, "QUARANTINE.json"), "w") as f:
+                        json.dump(
+                            {"step": int(step), "reason": reason,
+                             "time": time.time()}, f,
+                        )
+            except OSError:
+                pass  # the local exclusion above still holds
+            try:
+                os.remove(self._manifest_path(step))
+            except OSError:
+                pass
+        try:
+            self._mngr.reload()
+        except Exception:
+            pass
+        _metrics().quarantined.inc()
+
+    # ---- save / restore ----------------------------------------------
+
     def save(self, step: int, state: Any, *, force: bool = False, wait: bool = False) -> bool:
         """Save (async by default). Returns True if a save was started."""
-        if step in self._mngr.all_steps():
+        # Filtered view: a quarantined step number re-reached after a
+        # rollback must be RE-SAVED (and hosts whose stale listing
+        # still shows the renamed dir must not skip the collective).
+        if step in self.all_steps():
             if wait:
                 self._mngr.wait_until_finished()
+                self._flush_last_good()
             return False
         saved = self._mngr.save(
             step, args=ocp.args.StandardSave(state), force=force
         )
+        # _mngr.save waits out the PREVIOUS async save before starting
+        # this one, so only now is the pending step known committed.
+        self._flush_last_good()
+        if saved:
+            # A re-save of a once-quarantined step number is a fresh,
+            # healthy checkpoint — stop excluding it locally (the
+            # corrupt directory keeps its .corrupt name regardless).
+            self._quarantined.discard(step)
+            self._write_manifest(step, state)
+            # The last_good_step gauge moves only once the save COMMITS
+            # (next wait/save/close): advancing it while the async
+            # write is in flight would hide exactly the saves-are-
+            # failing condition the gauge exists to expose.
+            self._pending_last_good = int(step)
         if wait:
             self._mngr.wait_until_finished()
+            self._flush_last_good()
         return saved
 
+    def _flush_last_good(self) -> None:
+        """Report the newest step whose save is known committed."""
+        if self._pending_last_good is not None:
+            _metrics().last_good_step.set(self._pending_last_good)
+            self._pending_last_good = None
+
+    def all_steps(self) -> List[int]:
+        return [s for s in self._mngr.all_steps()
+                if s not in self._quarantined]
+
     def latest_step(self) -> Optional[int]:
-        return self._mngr.latest_step()
+        steps = self.all_steps()
+        return max(steps) if steps else None
 
     def restore(
         self,
@@ -64,6 +371,7 @@ class Checkpointer:
         abstract_state: Any = None,
         mesh=None,
         model_cfg=None,
+        fallback: bool = False,
     ) -> Any:
         """Restore a TrainState.
 
@@ -73,11 +381,101 @@ class Checkpointer:
         device — which also lets checkpoints SAVED sharded restore
         without any mesh (pod checkpoint → single-chip eval/generate,
         elastic down-scale).
+
+        With `fallback=True`, a step that fails verification or restore
+        is quarantined and the walk continues at the next-newest step,
+        so one corrupt/partial checkpoint cannot brick resume.
+
+        Multi-host: verification reads the shared checkpoint metadata,
+        so every process reaches the same verdict and the walk stays in
+        lockstep. A per-process I/O failure INSIDE a collective restore
+        is the one divergence this cannot absorb — but that already
+        stalls any collective orbax restore, walk or no walk; the
+        external watchdog (heartbeat staleness) is the backstop there.
         """
+        if fallback:
+            return self._restore_fallback(
+                step, abstract_state=abstract_state, mesh=mesh,
+                model_cfg=model_cfg,
+            )
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        return self._restore_step(
+            step, abstract_state=abstract_state, mesh=mesh,
+            model_cfg=model_cfg,
+        )
+
+    def _restore_fallback(
+        self, step: Optional[int], *, abstract_state, mesh, model_cfg
+    ) -> Any:
+        newest = True
+        last_err: Optional[Exception] = None
+        while True:
+            steps = [s for s in self.all_steps()
+                     if step is None or s <= step]
+            if not steps:
+                at = f" at or below step {step}" if step is not None else ""
+                raise FileNotFoundError(
+                    f"no intact checkpoints in {self.directory}{at}"
+                ) from last_err
+            s = max(steps)
+            reason = self.verify(s)
+            if reason is None:
+                if self._request_mismatch(s, abstract_state):
+                    # The CALLER asked for a different structure than
+                    # was saved (wrong preset/config/EMA flag). The
+                    # step is healthy — quarantining it (and then
+                    # every older step, which all mismatch the same
+                    # way) would rename a run's whole history .corrupt
+                    # over a config typo; and orbax would silently
+                    # restore wrong-shaped garbage rather than raise.
+                    raise ValueError(
+                        f"requested state structure does not match "
+                        f"checkpoint step {s} in {self.directory} "
+                        "(wrong preset/config/optimizer/EMA flags?); "
+                        "refusing to restore"
+                    )
+                try:
+                    out = self._restore_step(
+                        s, abstract_state=abstract_state, mesh=mesh,
+                        model_cfg=model_cfg,
+                    )
+                    if not newest:
+                        _metrics().fallback_restores.inc()
+                    _metrics().last_good_step.set(int(s))
+                    return out
+                except Exception as e:
+                    last_err = e
+                    reason = f"restore failed ({type(e).__name__}: {e})"
+            self.quarantine(s, reason)
+            newest = False
+
+    def _request_mismatch(self, step: int, abstract_state: Any) -> bool:
+        """True when a restore failure is the CALLER's fault: the
+        requested abstract structure (leaf paths/shapes) differs from
+        what the step verifiably holds. Dtypes are ignored — saved-vs-
+        requested dtype drift is legitimate and handled in
+        _restore_step. Unreadable saved-side records mean disk damage,
+        never a request mismatch."""
+        if abstract_state is None:
+            return False
+        manifest = self._read_manifest(step)
+        if isinstance(manifest, dict):
+            saved = [(p, tuple(sh)) for p, sh, _ in manifest["leaves"]]
+        else:
+            try:
+                meta = self._mngr.item_metadata(step)
+                saved = [(p, sh) for p, sh, _ in _leaf_rows(meta)]
+            except Exception:
+                return False
+        want = [(p, sh) for p, sh, _ in _leaf_rows(abstract_state)]
+        return sorted(saved) != sorted(want)
+
+    def _restore_step(
+        self, step: int, *, abstract_state, mesh, model_cfg
+    ) -> Any:
         if abstract_state is None:
             return self._mngr.restore(step)
         if mesh is not None and model_cfg is not None:
@@ -115,14 +513,20 @@ class Checkpointer:
             # restored under a bf16-mu config) is the one recoverable
             # failure: confirm the saved dtypes actually differ from the
             # requested ones before retrying, so corrupt/partial steps
-            # surface their original error instead.
-            meta = self._mngr.item_metadata(step)
-            drifted = any(
-                a.dtype != m.dtype
-                for a, m in zip(
-                    jax.tree.leaves(abstract_state), jax.tree.leaves(meta)
+            # surface their original error instead. The probe itself can
+            # raise on a structurally corrupt step (truncated ocdbt
+            # metadata) — guard it, so the ORIGINAL restore error
+            # surfaces and a fallback walk can take over.
+            try:
+                meta = self._mngr.item_metadata(step)
+                a_leaves = jax.tree.leaves(abstract_state)
+                m_leaves = jax.tree.leaves(meta)
+                drifted = len(a_leaves) == len(m_leaves) and any(
+                    a.dtype != m.dtype
+                    for a, m in zip(a_leaves, m_leaves)
                 )
-            )
+            except Exception:
+                drifted = False
             if not drifted:
                 raise
             restored = self._restore_saved_dtypes(step, abstract_state, meta)
@@ -146,6 +550,13 @@ class Checkpointer:
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()
+        self._flush_last_good()
 
     def close(self) -> None:
+        """Close the underlying manager, WAITING for any in-flight
+        async save first — closing mid-write would leave the newest
+        step truncated (and then only the startup sweep/fallback walk
+        would save the run)."""
+        self._mngr.wait_until_finished()
+        self._flush_last_good()
         self._mngr.close()

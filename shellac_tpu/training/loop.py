@@ -2,22 +2,35 @@
 
 Host-device sync discipline: the loop only fetches scalars every
 `log_every` steps, so the device queue stays full between syncs; the
-failure detector therefore reacts within one log interval, which is the
+anomaly sentinel therefore reacts within one log interval, which is the
 standard tradeoff (tighten log_every for faster tripping).
+
+Failure semantics (docs/training.md): every log-boundary loss/grad_norm
+feeds an `AnomalySentinel` (non-finite + EMA loss-spike detection).
+Its `rollback` action restores the last-good checkpoint — walking past
+corrupt steps via `Checkpointer.restore(fallback=True)` — re-derives
+the data stream from the restored step (`data_factory`), and resumes;
+repeated anomalies drain the sentinel's RestartBudget and escalate to
+fatal. Multi-host runs agree on the verdict at the log-boundary sync
+point (the same allgather as preemption agreement), so hosts never
+diverge on whether to roll back.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import signal
 import threading
-from typing import Iterator, Optional
+import time
+from typing import Callable, Iterator, Optional
 
 import jax
 
 from shellac_tpu.config import ModelConfig, TrainConfig
 from shellac_tpu.obs import get_registry, log_buckets
+from shellac_tpu.training.resilience import ACTIONS, AnomalySentinel
 from shellac_tpu.training.trainer import init_train_state, make_train_step
-from shellac_tpu.utils.failure import FailureDetector, Heartbeat
+from shellac_tpu.utils.failure import Heartbeat, RestartBudget
 from shellac_tpu.utils.metrics import MetricsLogger
 from shellac_tpu.utils.tracing import StepTimer
 
@@ -36,7 +49,7 @@ def _interval_histogram():
 def fit(
     model_cfg: ModelConfig,
     train_cfg: TrainConfig,
-    data_iter: Iterator[dict],
+    data_iter: Optional[Iterator[dict]],
     *,
     mesh=None,
     checkpoint_dir: Optional[str] = None,
@@ -48,6 +61,9 @@ def fit(
     max_restores: int = 2,
     pipeline_microbatches: Optional[int] = None,
     handle_preemption: bool = True,
+    sentinel: Optional[AnomalySentinel] = None,
+    anomaly_action: str = "rollback",
+    data_factory: Optional[Callable[[int], Iterator[dict]]] = None,
 ):
     """Train until train_cfg.total_steps; returns the final TrainState.
 
@@ -56,16 +72,43 @@ def fit(
     boundary and writes a final checkpoint, so `resume=True` restarts
     where the preempted run left off instead of at the last periodic
     save.
+
+    Anomaly handling: `sentinel` (or a default `AnomalySentinel` with
+    `anomaly_action` and a RestartBudget of `max_restores` recoveries
+    per hour) judges every log-boundary loss. Rollbacks restore the
+    last-good checkpoint via the fallback walk and, when `data_factory`
+    is given (step -> fresh iterator positioned past `step` batches),
+    replay the deterministic data stream — a transient fault then
+    finishes bit-identical to an unfaulted run. Without a factory the
+    loop keeps consuming `data_iter`, which recovers but replays no
+    data (the stream has already advanced past the rolled-back steps).
+
+    With `heartbeat_path`, the loop beats a liveness file at 1 Hz at
+    step boundaries, with forced beats bracketing every restore —
+    an external watchdog gets a full staleness window while a run is
+    busy recovering (size its timeout above the worst restore).
     """
     multi = mesh is not None and jax.process_count() > 1
     if multi:
         # Multi-host: every process runs this same loop in SPMD. Local
         # batches assemble into global arrays; only process 0 writes
         # the metrics file and heartbeat (checkpoint saves are
-        # collective — every process participates).
+        # collective — every process participates). Both log-boundary
+        # agreement sites below share these bindings.
+        import numpy as _np
+
+        from jax.experimental import multihost_utils as mhu
+
         from shellac_tpu.training.data import distribute_batches
 
-        data_iter = distribute_batches(data_iter, mesh)
+        if data_iter is not None:
+            data_iter = distribute_batches(data_iter, mesh)
+        if data_factory is not None:
+            host_factory = data_factory
+
+            def data_factory(s):
+                return distribute_batches(host_factory(s), mesh)
+
         if jax.process_index() != 0:
             log_path = None
             heartbeat_path = None
@@ -76,26 +119,64 @@ def fit(
 
         ckpt = Checkpointer(checkpoint_dir)
 
+    heartbeat = Heartbeat(heartbeat_path) if heartbeat_path else None
+    hb_last = [0.0]
+
+    def beat(at_step: int, force: bool = False) -> None:
+        # 1 Hz at the step boundary (same cadence as the serving
+        # scheduler), rate-limited so fast tiny-model steps don't turn
+        # into an fsync storm. Forced beats bracket every restore so an
+        # external watchdog gets a full staleness window while a (slow,
+        # possibly multi-step fallback) restore is in flight instead of
+        # killing the recovering run.
+        if heartbeat is None:
+            return
+        now = time.monotonic()
+        if force or now - hb_last[0] >= 1.0:
+            heartbeat.beat(at_step)
+            hb_last[0] = now
+
     key = jax.random.PRNGKey(train_cfg.seed)
     if ckpt is not None and resume and ckpt.latest_step() is not None:
         # Never materialize the random init just to throw it away: trace
-        # it abstractly for the state structure, restore into that.
+        # it abstractly for the state structure, restore into that. The
+        # fallback walk quarantines a corrupt latest step instead of
+        # bricking resume on it.
         abstract = jax.eval_shape(
             lambda: init_train_state(model_cfg, train_cfg, key, mesh=mesh)
         )
+        beat(ckpt.latest_step() or 0, force=True)
         state = ckpt.restore(
-            abstract_state=abstract, mesh=mesh, model_cfg=model_cfg
+            abstract_state=abstract, mesh=mesh, model_cfg=model_cfg,
+            fallback=True,
         )
+        if data_factory is not None:
+            # Re-derive the stream from the step actually restored: a
+            # fallback walk may have landed below the latest step the
+            # caller computed its skip from.
+            data_iter = data_factory(int(jax.device_get(state.step)))
     else:
         state = init_train_state(model_cfg, train_cfg, key, mesh=mesh)
+    if data_iter is None:
+        # data_iter=None + data_factory is the cheap calling convention
+        # (the CLI uses it): the stream is built exactly once, at the
+        # step that actually starts the run, instead of the caller
+        # paying a skip fast-forward that a resume restore immediately
+        # throws away and re-derives.
+        if data_factory is None:
+            raise ValueError("fit needs data_iter or data_factory")
+        data_iter = data_factory(int(jax.device_get(state.step)))
 
     step_fn = make_train_step(
         model_cfg, train_cfg, mesh=mesh,
         pipeline_microbatches=pipeline_microbatches,
     )
     logger = MetricsLogger(log_path, every=1)
-    detector = FailureDetector()
-    heartbeat = Heartbeat(heartbeat_path) if heartbeat_path else None
+    if sentinel is None:
+        sentinel = AnomalySentinel(
+            action=anomaly_action,
+            budget=RestartBudget(max_restores, window=3600.0),
+        )
     timer = StepTimer(histogram=_interval_histogram())
     restores = 0
 
@@ -113,82 +194,161 @@ def fit(
 
     step = int(jax.device_get(state.step))
     stop = False
-    # Context-managed logger: the JSONL file is flushed and closed even
-    # when a step (or the final checkpoint save) raises.
-    with logger:
-        while step < train_cfg.total_steps and not stop:
-            try:
-                batch = next(data_iter)
-            except StopIteration:
-                break
-            state, metrics = step_fn(state, batch)
-            step += 1
+    try:
+        # Context-managed logger: the JSONL file is flushed and closed
+        # even when a step (or the final checkpoint save) raises.
+        with logger:
+            while step < train_cfg.total_steps and not stop:
+                try:
+                    batch = next(data_iter)
+                except StopIteration:
+                    break
+                state, metrics = step_fn(state, batch)
+                step += 1
+                beat(step)
 
-            if not multi and preempted.is_set():
-                stop = True
-            if step % log_every == 0 or step >= train_cfg.total_steps:
-                if multi:
-                    # Preemption signals land per-VM at different
-                    # times; a process acting on its local flag alone
-                    # would enter the final collective save while the
-                    # others still run step collectives, deadlocking
-                    # the job. Agree at the log boundary (the existing
-                    # sync point) — maintenance grace periods are much
-                    # longer than a log interval.
-                    from jax.experimental import multihost_utils as mhu
-
-                    import numpy as _np
-
-                    if bool(mhu.process_allgather(
-                        _np.asarray([preempted.is_set()])
-                    ).any()):
-                        preempted.set()
-                        stop = True
-                loss = float(jax.device_get(metrics["loss"]))  # sync point
-                dt = timer.tick()
-                host_metrics = {
-                    k: jax.device_get(v) for k, v in metrics.items()
-                }
-                if dt is not None:
-                    host_metrics["steps_per_sec"] = log_every / dt
-                logger.log(step, host_metrics)
-                if heartbeat is not None:
-                    heartbeat.beat(step)
-
-                reason = detector.check(loss)
-                if reason is not None:
-                    if (ckpt is None or ckpt.latest_step() is None
-                            or restores >= max_restores):
-                        raise RuntimeError(
-                            f"training failure at step {step}: {reason}; "
-                            "no checkpoint to restore (or restore budget "
-                            "spent)"
-                        )
-                    restores += 1
-                    abstract = jax.eval_shape(lambda s: s, state)
-                    state = None  # free the diverged state before restoring
-                    state = ckpt.restore(
-                        abstract_state=abstract, mesh=mesh,
-                        model_cfg=model_cfg
+                if not multi and preempted.is_set():
+                    stop = True
+                if step % log_every == 0 or step >= train_cfg.total_steps:
+                    loss = float(jax.device_get(metrics["loss"]))  # sync point
+                    host_metrics = {
+                        k: jax.device_get(v) for k, v in metrics.items()
+                    }
+                    gn = host_metrics.get("grad_norm")
+                    pending = sentinel.detect(
+                        step, loss,
+                        grad_norm=None if gn is None else float(gn),
                     )
-                    step = int(jax.device_get(state.step))
-                    detector.reset()
-                    logger.log(
-                        step,
-                        {"restored_after": reason, "restores": restores},
-                    )
-                    continue
+                    if multi:
+                        # Preemption signals land per-VM at different
+                        # times, and an anomaly verdict acted on by one
+                        # host alone would desynchronize the step
+                        # collectives (one host enters the restore while
+                        # the others keep training), deadlocking the
+                        # job. Agree on BOTH verdicts at the log
+                        # boundary (the existing sync point) —
+                        # maintenance grace periods and anomaly blast
+                        # radii are both much longer than a log
+                        # interval.
+                        flags = mhu.process_allgather(_np.asarray(
+                            [preempted.is_set(), pending is not None]
+                        ))
+                        if bool(_np.asarray(flags)[..., 0].any()):
+                            preempted.set()
+                            stop = True
+                        if pending is None and bool(
+                            _np.asarray(flags)[..., 1].any()
+                        ):
+                            pending = (
+                                "peer", "anomaly flagged by another host"
+                            )
+                    dt = timer.tick()
+                    if dt is not None:
+                        host_metrics["steps_per_sec"] = log_every / dt
+                    logger.log(step, host_metrics)
+                    beat(step)
 
-            if ckpt is not None and step % checkpoint_every == 0:
-                ckpt.save(step, state)
+                    if pending is not None:
+                        # Multi-host defers the counter until after the
+                        # severity agreement below, so the action label
+                        # is the action actually taken.
+                        anomaly = sentinel.flag(step, *pending,
+                                                record=not multi)
+                        if multi:
+                            # The recovery budget's window is wall-
+                            # clock, so a window-edge race could
+                            # resolve DIFFERENT actions on different
+                            # hosts — and one host entering the
+                            # collective restore alone deadlocks the
+                            # pod. Agree by severity: every host takes
+                            # the most severe resolved action (a split
+                            # fatal/rollback becomes fatal everywhere —
+                            # loud, never wedged).
+                            sev = int(_np.asarray(mhu.process_allgather(
+                                _np.asarray(
+                                    [ACTIONS.index(anomaly.action)]
+                                )
+                            )).max())
+                            if ACTIONS[sev] != anomaly.action:
+                                anomaly = dataclasses.replace(
+                                    anomaly, action=ACTIONS[sev],
+                                    detail=anomaly.detail
+                                    + "; escalated to agree with peers",
+                                )
+                            sentinel.record(anomaly)
+                        # Logged BEFORE any raise: the terminal anomaly
+                        # must land in the runbook's primary artifact
+                        # (the JSONL log), not just the exception text.
+                        logger.log(step, {
+                            "anomaly_kind": anomaly.kind,
+                            "anomaly_action": anomaly.action,
+                        })
+                        if anomaly.action == "rollback" and ckpt is not None:
+                            # An async periodic save may still be in
+                            # flight — and orbax lists it in all_steps
+                            # already. Restoring (or even verifying) it
+                            # uncommitted would quarantine a healthy
+                            # checkpoint; wait for the commit first.
+                            ckpt.wait()
+                        if anomaly.action == "rollback" and (
+                            ckpt is None or ckpt.latest_step() is None
+                        ):
+                            raise RuntimeError(
+                                f"training anomaly: {anomaly}; rollback "
+                                "requested but there is no checkpoint "
+                                "to restore"
+                            )
+                        if anomaly.action == "fatal":
+                            raise RuntimeError(
+                                f"training anomaly: {anomaly}; "
+                                "action=fatal"
+                            )
+                        if anomaly.action == "rollback":
+                            restores += 1
+                            sentinel.metrics.rollbacks.inc()
+                            beat(step, force=True)  # entering recovery
+                            abstract = jax.eval_shape(lambda s: s, state)
+                            state = None  # free the diverged state first
+                            state = ckpt.restore(
+                                abstract_state=abstract, mesh=mesh,
+                                model_cfg=model_cfg, fallback=True,
+                            )
+                            step = int(jax.device_get(state.step))
+                            if data_factory is not None:
+                                # Re-derive the stream position from the
+                                # restored step: the deterministic skip
+                                # path replays exactly the batches the
+                                # rolled-back steps consumed.
+                                data_iter = data_factory(step)
+                            sentinel.reset()
+                            beat(step, force=True)
+                            logger.log(step, {
+                                "restored_after": str(anomaly),
+                                "restores": restores,
+                            })
+                            continue
+                        # warn/skip: keep training (skip already drew
+                        # from the budget inside flag()).
 
+                if ckpt is not None and step % checkpoint_every == 0:
+                    ckpt.save(step, state)
+
+            if ckpt is not None:
+                # Final save — including the preemption exit — always
+                # WAITS: returning (or dying) with the write still in
+                # flight is how truncated latest checkpoints are made.
+                ckpt.save(int(jax.device_get(state.step)), state,
+                          force=True, wait=True)
+            if preempted.is_set():
+                logger.log(step, {"preempted": 1})
+    finally:
+        if install_handler:
+            signal.signal(signal.SIGTERM, old_handler)
         if ckpt is not None:
-            ckpt.save(int(jax.device_get(state.step)), state, force=True,
-                      wait=True)
-        if preempted.is_set():
-            logger.log(step, {"preempted": 1})
-    if install_handler:
-        signal.signal(signal.SIGTERM, old_handler)
+            # Shutdown path: close() waits for any in-flight async
+            # save, so even an exception unwinding past a periodic
+            # save cannot truncate it.
+            ckpt.close()
     return state
 
 
@@ -213,7 +373,7 @@ def fit_lora(
     Checkpoints hold ONLY the adapters and their optimizer state (rank-r
     small), so saves are near-free and the base checkpoint is never
     rewritten. Resume restores from checkpoint_dir like fit(); the
-    divergence-restore and preemption machinery is deliberately omitted
+    anomaly-rollback and preemption machinery is deliberately omitted
     — LoRA runs are short and rerunnable.
     """
     from shellac_tpu.training.lora import init_lora_state, make_lora_train_step
@@ -231,6 +391,11 @@ def fit_lora(
                 model_cfg, train_cfg, lora_cfg, key, mesh=mesh
             )
         )
+        # No fallback walk here ON PURPOSE: fit_lora has no
+        # data_factory, so a restore landing below the latest step
+        # would silently train on a misaligned stream. A corrupt
+        # adapter checkpoint raises instead — LoRA runs are short and
+        # rerunnable (same reason the anomaly machinery is omitted).
         state = ckpt.restore(abstract_state=abstract)
     else:
         state = init_lora_state(model_cfg, train_cfg, lora_cfg, key, mesh=mesh)
@@ -239,26 +404,32 @@ def fit_lora(
     timer = StepTimer(histogram=_interval_histogram())
 
     step = int(jax.device_get(state.step))
-    with MetricsLogger(log_path, every=1) as logger:
-        while step < train_cfg.total_steps:
-            try:
-                batch = next(data_iter)
-            except StopIteration:
-                break
-            state, metrics = step_fn(state, base_params, batch)
-            step += 1
-            if step % log_every == 0 or step >= train_cfg.total_steps:
-                host_metrics = {
-                    k: jax.device_get(v) for k, v in metrics.items()
-                }
-                dt = timer.tick()
-                if dt is not None:
-                    host_metrics["steps_per_sec"] = log_every / dt
-                logger.log(step, host_metrics)
-            if ckpt is not None and step % checkpoint_every == 0:
-                ckpt.save(step, state)
+    try:
+        with MetricsLogger(log_path, every=1) as logger:
+            while step < train_cfg.total_steps:
+                try:
+                    batch = next(data_iter)
+                except StopIteration:
+                    break
+                state, metrics = step_fn(state, base_params, batch)
+                step += 1
+                if step % log_every == 0 or step >= train_cfg.total_steps:
+                    host_metrics = {
+                        k: jax.device_get(v) for k, v in metrics.items()
+                    }
+                    dt = timer.tick()
+                    if dt is not None:
+                        host_metrics["steps_per_sec"] = log_every / dt
+                    logger.log(step, host_metrics)
+                if ckpt is not None and step % checkpoint_every == 0:
+                    ckpt.save(step, state)
 
+            if ckpt is not None:
+                ckpt.save(int(jax.device_get(state.step)), state, force=True,
+                          wait=True)
+    finally:
         if ckpt is not None:
-            ckpt.save(int(jax.device_get(state.step)), state, force=True,
-                      wait=True)
+            # Same shutdown guarantee as fit(): close() waits for any
+            # in-flight async save before releasing the manager.
+            ckpt.close()
     return state

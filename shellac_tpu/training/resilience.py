@@ -1,0 +1,229 @@
+"""Fault-tolerant training: the anomaly sentinel and its rollback contract.
+
+PR 2's serving supervisor keeps a *process* alive; this module keeps a
+multi-day *training run* alive. The fit loop feeds every log-boundary
+loss (and grad norm) to an `AnomalySentinel`, which detects
+
+  - non-finite loss (NaN/Inf reached the host-visible metric),
+  - non-finite gradients (a non-finite `grad_norm` — the in-jit
+    `guard_update` already kept the bad update out of the state, but
+    the run still needs a verdict), and
+  - loss spikes: loss above `spike_factor` times a warmed-up
+    exponential moving average of the healthy loss stream,
+
+and resolves a configurable action per anomaly:
+
+  warn      log + count it, keep training (EMA is never polluted by
+            anomalous losses, so detection stays armed).
+  skip      tolerate the step, but draw one token from the rollback
+            budget — a stream of anomalies escalates to fatal.
+  rollback  restore the last-good checkpoint (`Checkpointer.restore`
+            with `fallback=True`, so a corrupt latest step is walked
+            past and quarantined), re-derive the data-iterator skip
+            from the restored step, and resume. Also budgeted.
+  fatal     raise immediately.
+
+Escalation reuses `utils.failure.RestartBudget`: each skip/rollback
+records one attempt, and once the sliding-window budget is spent the
+resolved action becomes `fatal` — a poisoned run (bad shard, LR spike
+that recurs at the same step every replay) terminates loudly instead of
+loop-rolling forever.
+
+Multi-host: detection (`detect`) is split from action resolution
+(`flag`) so the fit loop can agree on the verdict across hosts at the
+log-boundary sync point — the same allgather pattern as preemption
+agreement — and hosts never diverge on whether to roll back.
+
+Every event lands in the shared obs registry as `shellac_train_*`
+series (see docs/observability.md for the catalog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+from shellac_tpu.obs import get_registry
+from shellac_tpu.utils.failure import RestartBudget
+
+ACTIONS = ("warn", "skip", "rollback", "fatal")
+
+
+@dataclasses.dataclass(frozen=True)
+class Anomaly:
+    """One agreed training anomaly with its resolved action."""
+
+    step: int
+    kind: str  # nonfinite_loss | nonfinite_grad | loss_spike | peer
+    detail: str
+    action: str  # one of ACTIONS; escalation may turn skip/rollback fatal
+
+    def __str__(self) -> str:
+        return f"{self.kind} at step {self.step} ({self.detail})"
+
+
+class ResilienceMetrics:
+    """The `shellac_train_*` resilience series, registered once
+    (idempotently) against the shared registry so the fit loop, the
+    checkpointer, and tests all deposit into the same instruments."""
+
+    def __init__(self, registry=None):
+        reg = registry if registry is not None else get_registry()
+        self.anomalies = reg.counter(
+            "shellac_train_anomalies_total",
+            "Training anomalies by kind and resolved action",
+            labels=("kind", "action"),
+        )
+        self.rollbacks = reg.counter(
+            "shellac_train_rollbacks_total",
+            "Checkpoint rollbacks performed by the training loop",
+        )
+        self.quarantined = reg.counter(
+            "shellac_train_ckpt_quarantined_total",
+            "Checkpoint steps renamed *.corrupt after failing "
+            "verification or restore",
+        )
+        self.fallback_restores = reg.counter(
+            "shellac_train_ckpt_fallback_restores_total",
+            "Restores that had to walk past the newest step to an "
+            "older intact one",
+        )
+        self.last_good_step = reg.gauge(
+            "shellac_train_last_good_step",
+            "Newest checkpoint step believed intact (set on save and "
+            "on every restore)",
+        )
+
+
+def _nonfinite(x: float) -> bool:
+    try:
+        return not math.isfinite(float(x))
+    except (TypeError, ValueError):
+        return True
+
+
+class AnomalySentinel:
+    """Host-side anomaly verdict over the training loss stream.
+
+    `observe(step, loss, grad_norm)` is the single-host entry point:
+    it runs detection and, if an anomaly (sustained for `patience`
+    consecutive observations) is found, resolves and records it.
+    Multi-host loops call `detect` first, agree on `bool(pending)`
+    across hosts, then call `flag` with the agreed verdict.
+    """
+
+    def __init__(
+        self,
+        *,
+        action: str = "rollback",
+        patience: int = 1,
+        spike_factor: float = 10.0,
+        ema_decay: float = 0.98,
+        warmup: int = 5,
+        budget: Optional[RestartBudget] = None,
+        registry=None,
+    ):
+        if action not in ACTIONS:
+            raise ValueError(f"action must be one of {ACTIONS}, got {action!r}")
+        if spike_factor <= 1.0:
+            raise ValueError("spike_factor must be > 1")
+        if not 0.0 < ema_decay < 1.0:
+            raise ValueError("ema_decay must be in (0, 1)")
+        self.action = action
+        self.patience = max(1, patience)
+        self.spike_factor = spike_factor
+        self.ema_decay = ema_decay
+        self.warmup = max(0, warmup)
+        # Default budget: a handful of recoveries per hour. Anomalies
+        # spread wider than the window recover forever; a tight loop of
+        # them (poisoned data, a replay that re-diverges at the same
+        # step) exhausts it and goes fatal.
+        self.budget = budget if budget is not None else RestartBudget(
+            2, window=3600.0
+        )
+        self.metrics = ResilienceMetrics(registry)
+        self._ema: Optional[float] = None
+        self._healthy = 0
+        self._streak = 0
+
+    @property
+    def loss_ema(self) -> Optional[float]:
+        return self._ema
+
+    def detect(
+        self, step: int, loss: float, grad_norm: Optional[float] = None
+    ) -> Optional[Tuple[str, str]]:
+        """Detection only: (kind, detail) or None. Deterministic given
+        the same inputs, never consumes budget, never emits metrics —
+        safe to run independently on every host before agreement."""
+        kind = detail = None
+        if _nonfinite(loss):
+            kind, detail = "nonfinite_loss", f"loss={loss}"
+        elif grad_norm is not None and _nonfinite(grad_norm):
+            kind, detail = "nonfinite_grad", f"grad_norm={grad_norm}"
+        elif (
+            self._ema is not None
+            and self._healthy >= self.warmup
+            and loss > self.spike_factor * max(self._ema, 1e-6)
+        ):
+            kind = "loss_spike"
+            detail = (
+                f"loss {loss:.4g} > {self.spike_factor:g}x EMA "
+                f"{self._ema:.4g}"
+            )
+        if kind is None:
+            self._streak = 0
+            self._healthy += 1
+            d = self.ema_decay
+            self._ema = loss if self._ema is None else d * self._ema + (
+                1.0 - d
+            ) * loss
+            return None
+        # Anomalous losses never fold into the EMA — a slow ramp of
+        # bad values must not drag the reference up until the detector
+        # goes blind.
+        self._streak += 1
+        if self._streak < self.patience:
+            return None
+        return kind, detail
+
+    def flag(self, step: int, kind: str, detail: str,
+             record: bool = True) -> Anomaly:
+        """Record an (agreed) anomaly and resolve its action. Skip and
+        rollback draw from the budget; once spent, they escalate to
+        fatal. Multi-host loops pass record=False and call `record`
+        themselves AFTER the cross-host severity agreement, so the
+        counter's action label is the action actually taken."""
+        action = self.action
+        if action in ("skip", "rollback") and not self.budget.allow():
+            detail = f"{detail}; recovery budget spent"
+            action = "fatal"
+        self._streak = 0
+        anomaly = Anomaly(step=step, kind=kind, detail=detail, action=action)
+        if record:
+            self.record(anomaly)
+        return anomaly
+
+    def record(self, anomaly: Anomaly) -> None:
+        """Emit the anomaly counter with its final resolved action."""
+        self.metrics.anomalies.labels(
+            kind=anomaly.kind, action=anomaly.action
+        ).inc()
+
+    def observe(
+        self, step: int, loss: float, grad_norm: Optional[float] = None
+    ) -> Optional[Anomaly]:
+        """Single-host convenience: detect, then flag on detection."""
+        pending = self.detect(step, loss, grad_norm)
+        if pending is None:
+            return None
+        return self.flag(step, *pending)
+
+    def reset(self) -> None:
+        """Clear detection state (after a rollback the loss stream
+        restarts from the restored step). The budget is NOT reset —
+        escalation must survive rollbacks or it could never trip."""
+        self._ema = None
+        self._healthy = 0
+        self._streak = 0

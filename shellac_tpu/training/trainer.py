@@ -172,7 +172,12 @@ def make_train_step(
         metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
         if train_cfg.skip_nonfinite_updates:
-            ok = all_finite(grads)
+            # Guard on the loss too: an overflowed loss with grads that
+            # still came out finite (clipping, a masked-out NaN term)
+            # means the update direction is untrustworthy — skip it the
+            # same way, so the host-side sentinel sees the anomaly in
+            # `update_skipped` while the state stays clean.
+            ok = all_finite(grads) & jnp.isfinite(metrics["loss"])
             new_params = guard_update(state.params, new_params, ok)
             new_opt_state = guard_update(state.opt_state, new_opt_state, ok)
             if new_ema is not None:

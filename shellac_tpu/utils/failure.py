@@ -5,13 +5,16 @@ Four layers of defense, cheapest first:
      non-finite, the parameter/optimizer update is skipped wholesale —
      one bad batch cannot poison the state. Costs one fused all-reduce
      of isfinite flags.
-  2. `FailureDetector` (host side): watches the loss stream for
-     NaN/Inf/explosion and trips after `patience` consecutive bad
-     steps, signalling the loop to restore from the last checkpoint.
+  2. Host-side loss-stream monitoring: the fit loop runs
+     `training.resilience.AnomalySentinel` (non-finite + EMA-spike
+     detection with warn/skip/rollback/fatal actions); the simpler
+     `FailureDetector` here remains for custom loops that just want a
+     tripwire over a scalar stream.
   3. `RestartBudget` (supervisor level): a sliding-window circuit
      breaker over in-process restarts — recover from isolated faults,
      but a component that keeps dying is declared fatal instead of
-     crash-looping (the serving supervisor's restart gate).
+     crash-looping. Gates both the serving supervisor's engine
+     rebuilds and the training sentinel's skip/rollback escalation.
   4. `Heartbeat` (process level): a file touched every step; an
      external watchdog (or another host) treats a stale heartbeat as a
      hung/dead worker and can restart it. This is the single-host
@@ -47,7 +50,14 @@ def guard_update(old_tree, new_tree, ok: jax.Array):
 
 
 class FailureDetector:
-    """Host-side monitor over scalar training metrics."""
+    """Host-side monitor over scalar training metrics.
+
+    A plain tripwire: feed it a loss stream, get a reason string when
+    it looks broken. The fit loop itself uses the richer
+    `training.resilience.AnomalySentinel` (configurable actions,
+    budgeted escalation, multi-host verdict agreement); this stays for
+    custom loops and external monitors that only need detection.
+    """
 
     def __init__(
         self,

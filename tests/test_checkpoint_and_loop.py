@@ -9,8 +9,11 @@ import pytest
 
 from shellac_tpu import get_model_config
 from shellac_tpu.config import TrainConfig
+from shellac_tpu.obs import Registry, set_default_registry
 from shellac_tpu.training import (
+    AnomalySentinel,
     batch_shardings,
+    chaos,
     fit,
     init_train_state,
     make_train_step,
@@ -103,6 +106,293 @@ class TestCheckpoint:
         with pytest.raises(FileNotFoundError):
             ckpt.restore()
         ckpt.close()
+
+
+@pytest.fixture
+def fresh_registry():
+    """Swap the process-global obs registry so counter assertions see
+    only this test's events."""
+    reg = Registry()
+    old = set_default_registry(reg)
+    yield reg
+    set_default_registry(old)
+
+
+class TestCheckpointIntegrity:
+    """The manifest / verify / quarantine / fallback-restore contract
+    (docs/training.md, "Failure semantics")."""
+
+    def _saved(self, tmp_path, steps=(1, 2, 3)):
+        cfg = _cfg()
+        tcfg = TrainConfig(warmup_steps=0)
+        state = init_train_state(cfg, tcfg, jax.random.PRNGKey(0))
+        d = str(tmp_path / "ck")
+        ckpt = Checkpointer(d, max_to_keep=len(steps) + 2)
+        for s in steps:
+            ckpt.save(s, state, wait=True)
+        abstract = jax.eval_shape(lambda s: s, state)
+        return d, ckpt, state, abstract
+
+    def test_manifest_roundtrip_and_verify(self, tmp_path):
+        d, ckpt, state, _ = self._saved(tmp_path)
+        for s in (1, 2, 3):
+            assert os.path.exists(
+                os.path.join(d, "manifests", f"{s}.json")
+            )
+            assert ckpt.verify(s) is None
+        assert ckpt.verify(99) is not None  # absent step never passes
+        ckpt.close()
+
+    def test_verify_rejects_tampered_manifest(self, tmp_path):
+        d, ckpt, _, _ = self._saved(tmp_path)
+        chaos.tamper_manifest(d, 2, leaf_count=999)
+        assert "leaf count" in ckpt.verify(2)
+        chaos.tamper_manifest(d, 3, tree_digest="deadbeef")
+        assert ckpt.verify(3) is not None
+        assert ckpt.verify(1) is None  # untouched sibling still passes
+        ckpt.close()
+
+    def test_fallback_quarantines_corrupt_latest(self, tmp_path,
+                                                 fresh_registry):
+        d, ckpt, state, abstract = self._saved(tmp_path)
+        chaos.scramble_step(d, 3)
+        restored = ckpt.restore(abstract_state=abstract, fallback=True)
+        # Walked back to the newest intact step and got real data.
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            state.params, restored.params,
+        )
+        assert ckpt.latest_step() == 2
+        assert os.path.isdir(os.path.join(d, "3.corrupt"))
+        assert os.path.exists(
+            os.path.join(d, "3.corrupt", "QUARANTINE.json")
+        )
+        assert fresh_registry.value(
+            "shellac_train_ckpt_quarantined_total") == 1
+        assert fresh_registry.value(
+            "shellac_train_ckpt_fallback_restores_total") == 1
+        assert fresh_registry.value("shellac_train_last_good_step") == 2
+        ckpt.close()
+        # The rename is durable: a NEW Checkpointer (fresh process)
+        # never re-selects the quarantined step either.
+        ckpt2 = Checkpointer(d)
+        assert ckpt2.latest_step() == 2
+        assert ckpt2.verify(3) is not None
+        ckpt2.close()
+
+    def test_fallback_exhausted_raises(self, tmp_path, fresh_registry):
+        d, ckpt, _, abstract = self._saved(tmp_path, steps=(1, 2))
+        chaos.scramble_step(d, 1)
+        chaos.scramble_step(d, 2)
+        with pytest.raises(FileNotFoundError, match="no intact"):
+            ckpt.restore(abstract_state=abstract, fallback=True)
+        assert fresh_registry.value(
+            "shellac_train_ckpt_quarantined_total") == 2
+        ckpt.close()
+
+    def test_startup_sweep_removes_interrupted_save_debris(self, tmp_path):
+        d, ckpt, _, _ = self._saved(tmp_path)
+        ckpt.close()
+        debris = chaos.fake_interrupted_save(d, 9)
+        # An ABANDONED orphan manifest (its save never committed) goes
+        # too — backdated past the TTL; a young one could belong to a
+        # concurrent trainer's still-in-flight save and must survive.
+        import time as _time
+
+        orphan = os.path.join(d, "manifests", "7.json")
+        with open(orphan, "w") as f:
+            f.write("{}")
+        old = _time.time() - 2 * 3600
+        os.utime(orphan, (old, old))
+        # Young debris could be a CONCURRENT process's live async save
+        # (eval opening the dir mid-train) — the sweep leaves it alone.
+        live = chaos.fake_interrupted_save(d, 11, age_s=0.0)
+        ckpt2 = Checkpointer(d)
+        assert not os.path.exists(debris)
+        assert not os.path.exists(orphan)
+        assert os.path.exists(live)
+        assert ckpt2.latest_step() == 3  # intact steps untouched
+        assert ckpt2.verify(3) is None
+        ckpt2.close()
+
+    def test_request_mismatch_raises_instead_of_quarantining(
+            self, tmp_path, fresh_registry):
+        """Resuming with the WRONG config (different shapes) must raise
+        the restore error, not quarantine the healthy step — otherwise
+        a config typo walks the entire checkpoint history into
+        *.corrupt."""
+        d, ckpt, state, _ = self._saved(tmp_path, steps=(1, 2))
+        other = _cfg().replace(d_model=128, vocab_size=512)
+        bad_abstract = jax.eval_shape(
+            lambda: init_train_state(
+                other, TrainConfig(warmup_steps=0), jax.random.PRNGKey(0)
+            )
+        )
+        with pytest.raises(ValueError, match="does not match"):
+            ckpt.restore(abstract_state=bad_abstract, fallback=True)
+        # Nothing was quarantined; the run's history is intact.
+        assert ckpt.latest_step() == 2
+        assert ckpt.verify(2) is None
+        assert not os.path.isdir(os.path.join(d, "2.corrupt"))
+        assert not fresh_registry.value(
+            "shellac_train_ckpt_quarantined_total")
+
+    def test_requarantine_of_resaved_step_gets_unique_name(
+            self, tmp_path, fresh_registry):
+        """A step number quarantined, re-saved, and re-corrupted must be
+        quarantined AGAIN under a unique name — a silently failed rename
+        would leave the bad step selectable as latest forever."""
+        d, ckpt, state, abstract = self._saved(tmp_path, steps=(1, 2))
+        chaos.scramble_step(d, 2)
+        ckpt.restore(abstract_state=abstract, fallback=True)
+        assert os.path.isdir(os.path.join(d, "2.corrupt"))
+        # Re-save step 2 (healthy again), then corrupt and re-walk.
+        ckpt.save(2, state, wait=True)
+        assert ckpt.latest_step() == 2
+        chaos.scramble_step(d, 2)
+        ckpt.restore(abstract_state=abstract, fallback=True)
+        assert ckpt.latest_step() == 1
+        assert os.path.isdir(os.path.join(d, "2.corrupt.2"))
+        # A fresh process sees neither corrupt incarnation as a step.
+        ckpt.close()
+        ckpt2 = Checkpointer(d)
+        assert ckpt2.latest_step() == 1
+        ckpt2.close()
+
+    def test_latest_step_on_disk(self, tmp_path):
+        from shellac_tpu.training.checkpoint import latest_step_on_disk
+
+        assert latest_step_on_disk(str(tmp_path / "nope")) is None
+        d, ckpt, _, _ = self._saved(tmp_path)
+        ckpt.close()
+        assert latest_step_on_disk(d) == 3
+        # Quarantined and debris names never count.
+        os.rename(os.path.join(d, "3"), os.path.join(d, "3.corrupt"))
+        chaos.fake_interrupted_save(d, 9)
+        assert latest_step_on_disk(d) == 2
+
+    def test_structural_corruption_surfaces_original_error(self, tmp_path):
+        """The dtype-drift probe must not mask the real failure: a step
+        whose item payload is gone raises the ORIGINAL restore error
+        (orbax's missing-item KeyError), not an exception from the
+        probe's item_metadata call."""
+        d, ckpt, _, abstract = self._saved(tmp_path, steps=(1,))
+        chaos.drop_item(d, 1)
+        with pytest.raises(KeyError, match="default"):
+            ckpt.restore(1, abstract_state=abstract)
+        ckpt.close()
+
+
+class TestAnomalySentinel:
+    def test_nonfinite_loss_trips_immediately(self):
+        s = AnomalySentinel(action="rollback", registry=Registry())
+        assert s.observe(1, 1.0) is None
+        a = s.observe(2, float("nan"))
+        assert a is not None and a.kind == "nonfinite_loss"
+        assert a.action == "rollback"
+
+    def test_nonfinite_grad_trips(self):
+        s = AnomalySentinel(registry=Registry())
+        a = s.observe(1, 1.0, grad_norm=float("inf"))
+        assert a is not None and a.kind == "nonfinite_grad"
+
+    def test_spike_needs_warmup(self):
+        s = AnomalySentinel(spike_factor=10.0, warmup=5,
+                            registry=Registry())
+        # Spikes before the EMA warms up are NOT flagged (early
+        # training loss moves fast legitimately).
+        assert s.observe(1, 1.0) is None
+        assert s.observe(2, 50.0) is None
+        s2 = AnomalySentinel(spike_factor=10.0, warmup=5,
+                             registry=Registry())
+        for i in range(6):
+            assert s2.observe(i, 2.0) is None
+        a = s2.observe(7, 100.0)
+        assert a is not None and a.kind == "loss_spike"
+
+    def test_anomalous_losses_never_pollute_ema(self):
+        s = AnomalySentinel(action="warn", spike_factor=5.0, warmup=3,
+                            registry=Registry())
+        for i in range(5):
+            s.observe(i, 1.0)
+        ema = s.loss_ema
+        # A stream of spikes keeps flagging: the reference EMA must not
+        # ramp up toward the bad values and go blind.
+        for i in range(5, 10):
+            assert s.observe(i, 100.0) is not None
+        assert s.loss_ema == ema
+
+    def test_patience(self):
+        s = AnomalySentinel(patience=2, registry=Registry())
+        assert s.observe(1, float("nan")) is None  # first strike
+        assert s.observe(2, float("nan")) is not None  # second trips
+        # A healthy value in between resets the streak.
+        s2 = AnomalySentinel(patience=2, registry=Registry())
+        assert s2.observe(1, float("nan")) is None
+        assert s2.observe(2, 1.0) is None
+        assert s2.observe(3, float("nan")) is None
+
+    def test_budget_escalates_to_fatal(self):
+        reg = Registry()
+        s = AnomalySentinel(
+            action="rollback", budget=RestartBudget(1, window=1000.0),
+            registry=reg,
+        )
+        assert s.observe(1, float("nan")).action == "rollback"
+        second = s.observe(2, float("nan"))
+        assert second.action == "fatal"
+        assert "budget spent" in second.detail
+        assert reg.value("shellac_train_anomalies_total",
+                         kind="nonfinite_loss", action="rollback") == 1
+        assert reg.value("shellac_train_anomalies_total",
+                         kind="nonfinite_loss", action="fatal") == 1
+
+    def test_warn_never_escalates(self):
+        s = AnomalySentinel(action="warn",
+                            budget=RestartBudget(1, window=1000.0),
+                            registry=Registry())
+        for i in range(5):
+            assert s.observe(i, float("nan")).action == "warn"
+
+    def test_detect_flag_split_for_multihost(self):
+        """detect() is side-effect-free on anomalies (no budget draw,
+        no metrics) so hosts can agree before acting via flag()."""
+        reg = Registry()
+        s = AnomalySentinel(action="rollback",
+                            budget=RestartBudget(1, window=1000.0),
+                            registry=reg)
+        pending = s.detect(1, float("nan"))
+        assert pending is not None
+        assert reg.value("shellac_train_anomalies_total",
+                         kind="nonfinite_loss",
+                         action="rollback") is None
+        # A host whose local stream looked fine still acts on the
+        # agreed verdict.
+        a = s.flag(1, "peer", "anomaly flagged by another host")
+        assert a.action == "rollback"
+        assert reg.value("shellac_train_anomalies_total", kind="peer",
+                         action="rollback") == 1
+
+    def test_reset_clears_detection_not_budget(self):
+        s = AnomalySentinel(action="rollback",
+                            budget=RestartBudget(1, window=1000.0),
+                            registry=Registry())
+        assert s.observe(1, float("nan")).action == "rollback"
+        s.reset()
+        assert s.loss_ema is None
+        # The budget survives the reset — otherwise escalation could
+        # never trip across rollbacks.
+        assert s.observe(2, float("nan")).action == "fatal"
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            AnomalySentinel(action="explode")
+        with pytest.raises(ValueError):
+            AnomalySentinel(spike_factor=0.5)
+        with pytest.raises(ValueError):
+            AnomalySentinel(ema_decay=1.5)
 
 
 class TestFailureTools:
@@ -285,6 +575,77 @@ class TestFit:
         )
         state2 = fit(cfg, tcfg2, data2, checkpoint_dir=ckdir, log_every=2)
         assert int(jax.device_get(state2.step)) == 8
+
+    def test_fit_warn_action_continues(self, fresh_registry):
+        """anomaly_action='warn': the poisoned step is logged and
+        counted but training runs to completion (the in-jit guard
+        already kept the bad update out of the state)."""
+        cfg = _cfg()
+        tcfg = TrainConfig(warmup_steps=0, learning_rate=1e-3,
+                           total_steps=5)
+        data = chaos.poison_batches(
+            token_batches(
+                np.tile(np.arange(32, dtype=np.int32), 50),
+                batch_size=2, seq_len=16, num_batches=100,
+            ),
+            at_step=3,
+        )
+        state = fit(cfg, tcfg, data, log_every=1, anomaly_action="warn")
+        assert int(jax.device_get(state.step)) == 5
+        assert fresh_registry.value(
+            "shellac_train_anomalies_total",
+            kind="nonfinite_loss", action="warn",
+        ) == 1
+
+    def test_fit_fatal_action_raises(self, fresh_registry):
+        cfg = _cfg()
+        tcfg = TrainConfig(warmup_steps=0, learning_rate=1e-3,
+                           total_steps=5)
+        data = chaos.poison_batches(
+            token_batches(
+                np.tile(np.arange(32, dtype=np.int32), 50),
+                batch_size=2, seq_len=16, num_batches=100,
+            ),
+            at_step=3,
+        )
+        with pytest.raises(RuntimeError, match="action=fatal"):
+            fit(cfg, tcfg, data, log_every=1, anomaly_action="fatal")
+
+    def test_fit_rollback_without_checkpoint_is_fatal(self,
+                                                      fresh_registry):
+        cfg = _cfg()
+        tcfg = TrainConfig(warmup_steps=0, learning_rate=1e-3,
+                           total_steps=5)
+        data = chaos.poison_batches(
+            token_batches(
+                np.tile(np.arange(32, dtype=np.int32), 50),
+                batch_size=2, seq_len=16, num_batches=100,
+            ),
+            at_step=3,
+        )
+        with pytest.raises(RuntimeError, match="no checkpoint"):
+            fit(cfg, tcfg, data, log_every=1, anomaly_action="rollback")
+
+    def test_fit_heartbeat_beats_at_step_boundary(self, tmp_path):
+        """train --heartbeat-file semantics: the loop beats the file at
+        step boundaries (1 Hz rate-limited), not just at log
+        boundaries — log_every here is larger than the run, and the
+        beat still lands."""
+        import json as _json
+
+        cfg = _cfg()
+        tcfg = TrainConfig(warmup_steps=0, learning_rate=1e-3,
+                           total_steps=3)
+        data = token_batches(
+            np.tile(np.arange(32, dtype=np.int32), 50),
+            batch_size=2, seq_len=16, num_batches=100,
+        )
+        hb = str(tmp_path / "hb.json")
+        fit(cfg, tcfg, data, log_every=1000, heartbeat_path=hb)
+        with open(hb) as f:
+            beat = _json.load(f)
+        assert beat["step"] >= 1
+        assert heartbeat_age(hb) < 60.0
 
     def test_fit_sharded(self, mesh_fsdp8):
         cfg = _cfg().replace(d_model=128, vocab_size=512)
