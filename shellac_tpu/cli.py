@@ -950,6 +950,30 @@ def cmd_serve(args):
     return 0
 
 
+def cmd_serve_tier(args):
+    from shellac_tpu.inference.tier import TierRouter, serve_tier
+
+    if not args.metrics:
+        from shellac_tpu.obs import get_registry
+
+        get_registry().disable()
+    router = TierRouter(
+        args.replica,
+        health_interval=args.health_interval,
+        health_timeout=args.health_timeout,
+        breaker_failures=args.breaker_failures,
+        breaker_window=args.breaker_window,
+        breaker_cooldown=args.breaker_cooldown,
+        max_attempts=args.max_attempts,
+        backoff_base=args.backoff_base,
+        backoff_cap=args.backoff_cap,
+        default_timeout=args.default_timeout,
+        affinity_tolerance=args.affinity_tolerance,
+    )
+    serve_tier(router, host=args.host, port=args.port)
+    return 0
+
+
 def cmd_convert(args):
     """HF checkpoint directory -> native orbax params + config JSON."""
     import dataclasses as dc
@@ -1317,6 +1341,65 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--quantize", action="store_true")
     s.add_argument("--tokenizer", default="byte")
     s.set_defaults(fn=cmd_serve)
+
+    st = sub.add_parser(
+        "serve-tier",
+        help="failure-aware router over N serve replicas: health-"
+             "checked membership with per-replica circuit breakers, "
+             "prefix/session-affinity + load-weighted routing, retry "
+             "with backoff+jitter, graceful-drain observation "
+             "(docs/serving_tier.md)",
+    )
+    st.add_argument("--replica", action="append", required=True,
+                    metavar="URL",
+                    help="replica base URL (repeat per replica), e.g. "
+                         "--replica http://10.0.0.1:8000")
+    st.add_argument("--host", default="127.0.0.1")
+    st.add_argument("--port", type=int, default=8100)
+    st.add_argument("--health-interval", type=float, default=0.5,
+                    dest="health_interval",
+                    help="seconds between /health sweeps of the "
+                         "replica set")
+    st.add_argument("--health-timeout", type=float, default=2.0,
+                    dest="health_timeout",
+                    help="per-replica health/metrics request timeout")
+    st.add_argument("--breaker-failures", type=int, default=3,
+                    dest="breaker_failures",
+                    help="failures inside --breaker-window that eject "
+                         "a replica from routing")
+    st.add_argument("--breaker-window", type=float, default=30.0,
+                    dest="breaker_window",
+                    help="sliding window (seconds) for the per-replica "
+                         "circuit breaker")
+    st.add_argument("--breaker-cooldown", type=float, default=5.0,
+                    dest="breaker_cooldown",
+                    help="seconds an ejected replica waits before one "
+                         "half-open health probe may readmit it")
+    st.add_argument("--max-attempts", type=int, default=4,
+                    dest="max_attempts",
+                    help="total attempts per request (first + retries "
+                         "on other replicas)")
+    st.add_argument("--backoff-base", type=float, default=0.05,
+                    dest="backoff_base",
+                    help="base of the capped exponential retry backoff "
+                         "(full jitter; never outlives the request "
+                         "deadline)")
+    st.add_argument("--backoff-cap", type=float, default=2.0,
+                    dest="backoff_cap",
+                    help="ceiling (seconds) of one retry backoff draw")
+    st.add_argument("--default-timeout", type=float, default=60.0,
+                    dest="default_timeout",
+                    help="request deadline when the payload carries no "
+                         "timeout; retries stop at the deadline")
+    st.add_argument("--affinity-tolerance", type=float, default=4.0,
+                    dest="affinity_tolerance",
+                    help="load-score gap (roughly queued requests) an "
+                         "affinity hit may cost before spilling to the "
+                         "least-loaded replica")
+    st.add_argument("--metrics", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="Prometheus shellac_tier_* series at /metrics")
+    st.set_defaults(fn=cmd_serve_tier)
 
     k = sub.add_parser("tokenize", help="encode text files into a token shard")
     k.add_argument("--input", nargs="+", required=True, help="text files")

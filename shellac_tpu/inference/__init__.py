@@ -16,8 +16,10 @@ from shellac_tpu.inference.kvcache import (
 from shellac_tpu.inference.server import InferenceServer
 from shellac_tpu.inference.spec_batching import SpeculativeBatchingEngine
 from shellac_tpu.inference.speculative import SpecResult, SpeculativeEngine
+from shellac_tpu.inference.tier import TierRouter
 
 __all__ = [
+    "TierRouter",
     "BatchingEngine",
     "Engine",
     "InferenceServer",

@@ -1,0 +1,401 @@
+"""Serving-tier chaos harness: deterministic fault injection at the
+wire and process level.
+
+The training twin (`training/chaos.py`) injects faults into the data
+stream and the checkpoint directory; this one injects them between the
+router and its replicas, and into the replica processes themselves —
+the failure classes a multi-replica tier actually meets:
+
+  - `ChaosProxy`: a byte-level TCP proxy slotted between the router
+    and one replica, with switchable modes — `pass_through`, `refuse`
+    (connection reset: replica process gone), `unavailable` (canned
+    503 + Retry-After: replica recovering), `stall` (accept, read,
+    never answer: wedged replica), `cut_stream(n)` (forward the
+    response but sever it after n bytes: replica killed mid-stream).
+    Because the proxy sits on the wire, what the chaos tests prove is
+    the ROUTER's public failure contract — ejection, retry, loud
+    mid-stream failure — not anything about replica internals.
+  - `ReplicaProc`: a real `python -m shellac_tpu serve` subprocess
+    (the CLI path operators run), so a SIGKILL is a true process
+    death: sockets reset, no goodbye, exactly what a preempted node
+    looks like to the tier.
+  - `LoadGenerator`: sustained closed-loop non-streaming traffic with
+    per-request deadlines, counting outcomes — the background load the
+    acceptance scenarios (kill under load, drain under load) assert
+    "zero failures" against.
+
+Injectors never reach into `TierRouter` or `InferenceServer`
+internals; docs/serving_tier.md documents the contract they exercise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+
+class ChaosProxy:
+    """TCP proxy with switchable failure modes between a client (the
+    tier router) and one upstream replica.
+
+    Mode changes apply to NEW connections; `cut_stream` additionally
+    severs the connection that crosses the byte budget mid-flight.
+    Thread-safe; `url` is what you hand the router as the replica
+    address."""
+
+    PASS = "pass"
+    REFUSE = "refuse"
+    UNAVAILABLE = "unavailable"
+    STALL = "stall"
+    CUT = "cut"
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 host: str = "127.0.0.1"):
+        self.upstream = (upstream_host, int(upstream_port))
+        self._mode = self.PASS
+        self._cut_after = 0
+        self._retry_after = 1
+        self._lock = threading.Lock()
+        self._stall_release = threading.Event()
+        proxy = self
+
+        class _Conn(socketserver.BaseRequestHandler):
+            def handle(self):
+                proxy._handle(self.request)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = _Server((host, 0), _Conn)
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    # ---- mode switches ----------------------------------------------
+
+    def pass_through(self):
+        with self._lock:
+            self._mode = self.PASS
+
+    def refuse(self):
+        """New connections are reset immediately — the wire shape of a
+        dead process / closed port."""
+        with self._lock:
+            self._mode = self.REFUSE
+
+    def unavailable(self, retry_after: int = 1):
+        """Answer every request with a canned 503 + Retry-After — the
+        wire shape of a replica mid-recovery."""
+        with self._lock:
+            self._mode = self.UNAVAILABLE
+            self._retry_after = retry_after
+
+    def stall(self):
+        """Accept and read, never answer — the wire shape of a wedged
+        replica. `release_stalls()` unblocks held connections (tests
+        must release before teardown so no handler thread leaks)."""
+        with self._lock:
+            self._mode = self.STALL
+            self._stall_release.clear()
+
+    def cut_stream(self, after_bytes: int):
+        """Forward the response but sever the connection once
+        `after_bytes` response bytes have crossed — a replica killed
+        mid-stream, after the client already saw tokens."""
+        with self._lock:
+            self._mode = self.CUT
+            self._cut_after = int(after_bytes)
+
+    def release_stalls(self):
+        self._stall_release.set()
+
+    def close(self):
+        self._stall_release.set()
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ---- the wire ----------------------------------------------------
+
+    def _handle(self, client: socket.socket) -> None:
+        with self._lock:
+            mode = self._mode
+            cut_after = self._cut_after
+        try:
+            if mode == self.REFUSE:
+                # RST instead of FIN: a crash, not a polite close.
+                client.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_LINGER,
+                    b"\x01\x00\x00\x00\x00\x00\x00\x00",
+                )
+                client.close()
+                return
+            if mode == self.UNAVAILABLE:
+                client.settimeout(5.0)
+                try:
+                    client.recv(65536)  # drain the request politely
+                except OSError:
+                    pass
+                body = json.dumps(
+                    {"error": "chaos: replica unavailable"}
+                ).encode()
+                client.sendall(
+                    b"HTTP/1.0 503 Service Unavailable\r\n"
+                    b"Content-Type: application/json\r\n"
+                    + f"Retry-After: {self._retry_after}\r\n".encode()
+                    + f"Content-Length: {len(body)}\r\n\r\n".encode()
+                    + body
+                )
+                client.close()
+                return
+            if mode == self.STALL:
+                client.settimeout(1.0)
+                try:
+                    client.recv(65536)
+                except OSError:
+                    pass
+                # Hold the connection open, answering nothing, until
+                # released or the far side gives up.
+                self._stall_release.wait(120)
+                client.close()
+                return
+            # PASS / CUT: full duplex byte pump.
+            up = socket.create_connection(self.upstream, timeout=10)
+            budget = cut_after if mode == self.CUT else None
+            t = threading.Thread(
+                target=self._pump, args=(client, up, None), daemon=True
+            )
+            t.start()
+            self._pump(up, client, budget)
+            t.join(timeout=10)
+        except OSError:
+            pass
+        finally:
+            try:
+                client.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _pump(src: socket.socket, dst: socket.socket,
+              budget: Optional[int]) -> None:
+        """Copy src -> dst until EOF; with a byte budget, sever BOTH
+        sockets once it is spent (response direction only)."""
+        sent = 0
+        try:
+            while True:
+                data = src.recv(4096)
+                if not data:
+                    break
+                if budget is not None and sent + len(data) > budget:
+                    dst.sendall(data[: max(0, budget - sent)])
+                    raise ConnectionAbortedError("chaos cut")
+                dst.sendall(data)
+                sent += len(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+
+class ReplicaProc:
+    """One real replica: `python -m shellac_tpu serve` as a subprocess.
+
+    Binds port 0 and reports the actual address from the CLI's
+    `{"serving": ...}` startup line, so parallel replicas never
+    collide. `kill()` is SIGKILL — no drain, no goodbye — and
+    `drain()` posts the graceful path for contrast."""
+
+    def __init__(self, *, model: str = "tiny",
+                 config_path: Optional[str] = None, seed: int = 0,
+                 slots: int = 2, max_len: int = 96,
+                 extra_args: Optional[List[str]] = None,
+                 startup_timeout: float = 120.0):
+        cmd = [sys.executable, "-m", "shellac_tpu", "serve",
+               "--port", "0", "--slots", str(slots),
+               "--max-len", str(max_len), "--seed", str(seed),
+               "--temperature", "0.0", "--tokenizer", "byte"]
+        cmd += (["--config", config_path] if config_path
+                else ["--model", model])
+        cmd += list(extra_args or ())
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True,
+        )
+        self.url: Optional[str] = None
+        # Read stdout on a side thread: a subprocess that wedges
+        # during startup and prints NOTHING must hit startup_timeout,
+        # not park this constructor in a blocking readline forever.
+        lines: "queue.Queue[str]" = queue.Queue()
+        stdout = self.proc.stdout
+
+        def _reader():
+            for ln in stdout:
+                lines.put(ln)
+
+        threading.Thread(target=_reader, daemon=True).start()
+        deadline = time.monotonic() + startup_timeout
+        line = ""
+        while time.monotonic() < deadline:
+            try:
+                line = lines.get(timeout=0.5)
+            except queue.Empty:
+                if self.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica exited rc={self.proc.returncode} "
+                        "before serving"
+                    )
+                continue
+            try:
+                self.url = json.loads(line)["serving"]
+                break
+            except (ValueError, KeyError):
+                continue
+        if self.url is None:
+            self.kill()
+            raise TimeoutError(
+                f"replica never reported serving (last line {line!r})"
+            )
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        """Block until /health answers 200 (first request may compile)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        self.url + "/health", timeout=5) as r:
+                    if r.status == 200:
+                        return
+            except (OSError, urllib.error.URLError):
+                pass
+            time.sleep(0.2)
+        raise TimeoutError(f"replica {self.url} never became ready")
+
+    def drain(self, resume: bool = False) -> dict:
+        req = urllib.request.Request(
+            self.url + "/drain",
+            data=json.dumps({"resume": resume} if resume else {}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def kill(self) -> None:
+        """SIGKILL: the unplanned death. Idempotent."""
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+        if self.proc.stdout:
+            self.proc.stdout.close()
+
+    def terminate(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.kill()
+                return
+        if self.proc.stdout:
+            self.proc.stdout.close()
+
+
+class LoadGenerator:
+    """Closed-loop background load through the tier: `concurrency`
+    threads each issue non-streaming POSTs back-to-back until stopped,
+    tallying outcomes. The chaos scenarios run their injections under
+    this and then assert the tally (e.g. zero non-ok outcomes while a
+    replica was killed)."""
+
+    def __init__(self, base_url: str, *, path: str = "/generate",
+                 payloads: Optional[List[dict]] = None,
+                 concurrency: int = 4, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.path = path
+        # One payload per worker (cycled): distinct prompts give the
+        # workers distinct affinity keys, so load spreads across the
+        # tier instead of piling onto one replica's session.
+        self.payloads = payloads or [
+            {"tokens": [1 + i, 2 + i, 3 + i], "max_new": 4}
+            for i in range(max(1, concurrency))
+        ]
+        self.concurrency = concurrency
+        self.timeout = timeout
+        self.counts: Dict[str, int] = {}
+        self.errors: List[str] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def _tally(self, key: str, detail: str = "") -> None:
+        with self._lock:
+            self.counts[key] = self.counts.get(key, 0) + 1
+            if detail and len(self.errors) < 50:
+                self.errors.append(detail)
+
+    def _one(self, body: bytes) -> None:
+        req = urllib.request.Request(
+            self.base_url + self.path, data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            # Read timeout sits above the request deadline so the TIER
+            # classifies a blown deadline (504), not the client socket.
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout + 15) as r:
+                r.read()
+                self._tally("ok" if r.status == 200 else f"http_{r.status}")
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode(errors="replace")[:200]
+            except OSError:
+                pass
+            self._tally(f"http_{e.code}", f"{e.code}: {detail}")
+        except (OSError, urllib.error.URLError) as e:
+            self._tally("connect_error", repr(e))
+
+    def _loop(self, idx: int) -> None:
+        payload = self.payloads[idx % len(self.payloads)]
+        body = json.dumps({**payload, "timeout": self.timeout}).encode()
+        while not self._stop.is_set():
+            self._one(body)
+
+    def start(self) -> "LoadGenerator":
+        for i in range(self.concurrency):
+            t = threading.Thread(target=self._loop, args=(i,), daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> Dict[str, int]:
+        """Signal stop, join every worker (each finishes its in-flight
+        request), and return the final tally."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self.timeout + 30)
+        with self._lock:
+            return dict(self.counts)
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return sum(self.counts.values())

@@ -25,6 +25,32 @@ def _bad(msg: str):
     raise ValueError(msg)
 
 
+def stream_error_payload(exc: BaseException) -> dict:
+    """In-band error record for a stream that already sent its 200.
+
+    Once a stream's headers are gone the HTTP status can no longer
+    classify the failure, so the record itself must: `type` follows the
+    OpenAI error taxonomy, and `retryable` tells a fronting router
+    whether re-issuing the request on ANOTHER replica could succeed.
+    Only backpressure outcomes (`ServerUnavailable`: shed deadline,
+    draining, recovering — all of which fire before the first token)
+    are retryable; a fault or timeout after tokens flowed is not — the
+    client has a partial completion a retry would silently duplicate,
+    so it must fail loudly instead. ServerUnavailable is duck-typed by
+    its `http_status` attribute to keep this module import-free."""
+    retryable = hasattr(exc, "http_status")
+    if retryable:
+        etype = "overloaded_error"
+    elif isinstance(exc, ValueError):
+        etype = "invalid_request_error"
+    elif isinstance(exc, TimeoutError):
+        etype = "timeout_error"
+    else:
+        etype = "server_error"
+    return {"error": {"message": str(exc), "type": etype,
+                      "retryable": retryable}}
+
+
 def _check_unsupported(payload: dict):
     for key, neutral in (
         ("suffix", (None, "")),
@@ -62,6 +88,14 @@ def _common_sampling(payload: dict, native: dict):
     for key in ("presence_penalty", "frequency_penalty"):
         if payload.get(key) is not None:
             native[key] = float(payload[key])
+    if payload.get("timeout") is not None:
+        # Native extension: the request deadline. The serving tier
+        # forwards each attempt's REMAINING budget through this field
+        # so the replica's deadline shedder agrees with the tier on
+        # when the request stops being worth prefilling — dropping it
+        # here would leave OpenAI-route requests deadline-less on the
+        # replica while the tier has already given up and retried.
+        native["timeout"] = float(payload["timeout"])
     rf = payload.get("response_format")
     if rf is not None:
         t = rf.get("type") if isinstance(rf, dict) else None

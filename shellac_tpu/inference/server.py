@@ -43,9 +43,14 @@ API:
                   never streamed.
   GET  /health    -> readiness: 200 {"status": "ok", ...} only while
                   serving; 503 with "recovering" (supervisor mid-
-                  rebuild) or "failed" (fatal, message included).
-                  Always carries pending/queue depth, restart count,
-                  shed count, and the engine generation.
+                  rebuild), "draining" (graceful drain in progress),
+                  or "failed" (fatal, message included). Always
+                  carries pending/queue depth, restart count, shed
+                  count, and the engine generation.
+  POST /drain     -> admin: flip readiness, refuse new admissions
+                  (503 + Retry-After), complete in-flight requests.
+                  {"resume": true} cancels the drain. Poll /health
+                  until "pending" is 0, then stop the replica.
   GET  /stats     -> engine counters (requests/tokens/steps/prefills,
                      slots busy, decode_ticks) plus supervisor state
                      ("fatal", "status", "restarts", "generation",
@@ -67,6 +72,7 @@ import functools
 import itertools
 import json
 import queue
+import random
 import threading
 import time
 from collections import OrderedDict
@@ -86,6 +92,17 @@ def _render_plp(plp):
     renders as null (the OpenAI convention); one definition so the
     n==1, best_of, and streaming shapes cannot drift."""
     return [None] + plp[1:]
+
+
+def retry_after(lo: float, hi: float) -> float:
+    """A Retry-After value drawn uniformly from [lo, hi]. Every 503/429
+    this server emits goes through here: a fixed interval would tell
+    every rejected client to come back at the SAME instant, and a
+    recovering or draining replica would eat a synchronized thundering
+    herd exactly when it is least able to absorb one. The bounds span
+    multiple whole seconds because the HTTP header is rendered as
+    integer delta-seconds — sub-second jitter would round away."""
+    return random.uniform(lo, hi)
 
 
 class ServerUnavailable(RuntimeError):
@@ -251,6 +268,11 @@ class InferenceServer:
         self._closed = threading.Event()
         self._fatal: Optional[str] = None
         self._recovering = False
+        # Graceful drain: admission refused (503 + Retry-After),
+        # readiness flipped, in-flight requests run to completion. A
+        # router polling /health bleeds traffic off, and once
+        # `pending` reaches zero the replica can exit with zero drops.
+        self._draining = False
         self.step_timeout = step_timeout
         self.max_pending = max_pending
         self._engine_factory = engine_factory
@@ -296,11 +318,15 @@ class InferenceServer:
 
     @property
     def status(self) -> str:
-        """Supervisor state: "ok" | "recovering" | "failed"."""
+        """Supervisor state: "ok" | "recovering" | "draining" |
+        "failed". Failure states win over a drain: a drained-then-
+        wedged replica must report the wedge, not a clean drain."""
         if self._fatal is not None:
             return "failed"
         if self._recovering or self._g.dead:
             return "recovering"
+        if self._draining:
+            return "draining"
         return "ok"
 
     def health(self) -> Dict[str, Any]:
@@ -319,10 +345,37 @@ class InferenceServer:
                                     if self._budget is not None else None),
             "shed": self.shed,
             "max_pending": self.max_pending,
+            "draining": self._draining,
         }
         if self._fatal is not None:
             info["error"] = self._fatal
         return info
+
+    # ---- graceful drain ---------------------------------------------
+
+    def drain(self) -> Dict[str, Any]:
+        """Begin a graceful drain: flip readiness (/health answers 503
+        "draining"), refuse new admissions with 503 + Retry-After, and
+        let every in-flight request run to completion. Idempotent; the
+        returned health snapshot carries `pending`, which a caller (or
+        the tier router) polls to zero before stopping the replica —
+        that ordering is what makes a planned redeploy drop nothing."""
+        with self._lock:
+            self._draining = True
+            self._m.draining.set(1)
+        return self.health()
+
+    def resume_admission(self) -> Dict[str, Any]:
+        """Cancel a drain (planned redeploy aborted): readmit traffic.
+        A no-op on a fatal server — undraining cannot resurrect it."""
+        with self._lock:
+            self._draining = False
+            self._m.draining.set(0)
+        return self.health()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     # ---- observability ----------------------------------------------
 
@@ -715,7 +768,14 @@ class InferenceServer:
                 self._m.rejects.labels(reason="recovering").inc()
                 raise ServerUnavailable(
                     "server recovering from an engine fault; retry",
-                    http_status=503, retry_after=5.0,
+                    http_status=503, retry_after=retry_after(3.0, 8.0),
+                )
+            if self._draining:
+                self._m.rejects.labels(reason="draining").inc()
+                raise ServerUnavailable(
+                    "server draining: not admitting new requests "
+                    "(in-flight work is completing); retry elsewhere",
+                    http_status=503, retry_after=retry_after(1.0, 4.0),
                 )
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
@@ -723,7 +783,7 @@ class InferenceServer:
                 raise ServerUnavailable(
                     f"server overloaded: {len(self._pending)} requests "
                     f"pending (max_pending={self.max_pending})",
-                    http_status=429, retry_after=1.0,
+                    http_status=429, retry_after=retry_after(1.0, 3.0),
                 )
             rid = next(self._ids)
             holdback = max((len(s) for s in stop), default=0) if stop else 0
@@ -750,7 +810,7 @@ class InferenceServer:
             raise RuntimeError(p.error)
         if p.kind == "shed":
             raise ServerUnavailable(p.error, http_status=503,
-                                    retry_after=1.0)
+                                    retry_after=retry_after(1.0, 3.0))
         raise ValueError(p.error)
 
     def _await(self, p: _Pending, deadline: Optional[float]) -> _Pending:
@@ -1221,6 +1281,8 @@ class InferenceServer:
 
 def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                      port: int = 0) -> ThreadingHTTPServer:
+    from shellac_tpu.inference.openai_api import stream_error_payload
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
@@ -1325,10 +1387,13 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 # nothing to report and nobody left to report it to.
                 pass
             except (ValueError, TimeoutError, RuntimeError) as e:
-                # Headers are gone; report in-band and close.
+                # Headers are gone; report in-band and close. The
+                # record carries type + retryable so a fronting router
+                # that has not yet forwarded bytes can classify it.
                 try:
                     self.wfile.write(
-                        (json.dumps({"error": str(e)}) + "\n").encode()
+                        (json.dumps(stream_error_payload(e)) + "\n")
+                        .encode()
                     )
                 except OSError:
                     pass
@@ -1370,13 +1435,30 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 pass  # client hung up: the engine-side cancel fires
             except (ValueError, TimeoutError, RuntimeError) as e:
                 try:
+                    payload = stream_error_payload(e)
                     self.wfile.write(
-                        f"data: {json.dumps({'error': str(e)})}\n\n".encode()
+                        f"data: {json.dumps(payload)}\n\n".encode()
                     )
                 except OSError:
                     pass
 
         def do_POST(self):
+            if self.path == "/drain":
+                # Admin surface: begin (or with {"resume": true},
+                # cancel) a graceful drain. Returns the health
+                # snapshot; callers poll /health until `pending`
+                # reaches 0, then stop the replica — zero drops.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    payload = None
+                if not isinstance(payload, dict):
+                    self._send(400, {"error": "bad drain payload"})
+                    return
+                self._send(200, server.resume_admission()
+                           if payload.get("resume") else server.drain())
+                return
             openai_routes = {
                 "/v1/completions": False,
                 "/v1/chat/completions": True,

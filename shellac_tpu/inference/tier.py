@@ -1,0 +1,1096 @@
+"""Multi-replica serving tier: a failure-aware router over N
+`InferenceServer` replicas.
+
+One engine process is a single point of failure: a wedge, a restart-
+budget exhaustion, or a planned redeploy is a full outage for its
+users. This tier turns N independent replicas (each its own process,
+each already self-healing per docs/inference.md) into one service with
+four pillars:
+
+  membership & health — a poller hits every replica's `/health` (the
+    PR 2 readiness signal: 200 only while serving) on an interval;
+    503s, timeouts, and connect errors feed a per-replica
+    `utils.failure.CircuitBreaker` (sliding-window trip), and a
+    tripped replica is EJECTED from routing. After the breaker's
+    cooldown the poller sends a single half-open probe and readmits
+    the replica iff it answers healthy. An optional `replica_factory`
+    replaces a replica that stays dead past `respawn_after` seconds —
+    the supervisor's `engine_factory` pattern, one level up.
+
+  failure-aware requests — retryable outcomes (connect error/reset,
+    HTTP 503 + Retry-After, 429, a replica fault 500, and in-band
+    stream errors marked `retryable` — all of which fire before any
+    byte reached the client) are retried on a DIFFERENT replica with
+    capped exponential backoff and full jitter, never sleeping past
+    the request's absolute deadline. Non-retryable outcomes (4xx bad
+    requests, mid-stream loss after bytes were forwarded) fail loudly
+    — a retry would silently duplicate a partial completion.
+
+  routing policy — each request derives an affinity key (explicit
+    `session`, the OpenAI `user` field, or a hash of the prompt's
+    token/text prefix); rendezvous hashing maps the key onto the
+    routable replicas so a session keeps landing where its prefix KV
+    lives. Affinity yields to load: replicas are scored from their
+    live `/metrics` gauges (queue depth, pending, KV utilization, p99
+    TTFT from the histogram buckets), and when the affinity target's
+    score exceeds the least-loaded's by more than a tolerance — scaled
+    by the estimated prefix-hit value, and discounted when the target
+    reports no prefix-cache blocks to hit — the request spills to the
+    least-loaded replica instead of queueing behind a hot spot.
+
+  graceful drain — a replica put into drain (POST /drain, directly or
+    through this router's /admin/drain) flips readiness and refuses
+    admissions while completing in-flight work; the health poller
+    observes the flip and bleeds traffic off, so the replica can exit
+    after `pending` reaches zero with zero dropped requests.
+
+HTTP surface (make_tier_http_server):
+  POST /generate, /v1/completions, /v1/chat/completions — routed,
+       streaming and non-streaming, same payloads as a replica.
+  GET  /v1/models — forwarded from a routable replica.
+  GET  /health — 200 iff at least one replica is routable.
+  GET  /stats — per-replica state, load scores, breaker states.
+  GET  /metrics — Prometheus exposition of the shellac_tier_* series
+       (docs/observability.md; counters: routed/retried/ejected/
+       readmitted/drained/respawned per replica).
+  POST /admin/drain {"replica": url-or-index[, "resume": true]} —
+       forward a drain to one replica and stop routing to it now.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import hashlib
+import http.client
+import json
+import random
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from shellac_tpu.obs import Registry, TierMetrics, get_registry
+from shellac_tpu.utils.failure import CircuitBreaker
+
+#: Parsed-metrics keys the load score reads (PR 3 gauge names).
+_QUEUE_GAUGES = ("shellac_engine_queue_depth", "shellac_pending_requests")
+_KV_GAUGE = "shellac_kv_utilization"
+_TTFT_HIST = "shellac_ttft_seconds"
+_PREFIX_GAUGE = "shellac_prefix_cache_blocks"
+
+
+def parse_prometheus(text: str) -> Dict[str, Any]:
+    """Minimal Prometheus text-format parser: unlabeled samples map to
+    floats; `_bucket` samples collect into {name: [(le, cum), ...]}
+    (labels other than `le` are ignored — replica expositions are
+    single-process). Enough to read the PR 3 gauges and estimate
+    histogram quantiles; not a general client."""
+    out: Dict[str, Any] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value_part = line.rsplit(" ", 1)
+            value = float(value_part)
+        except ValueError:
+            continue
+        name, labels = name_part, ""
+        if "{" in name_part:
+            name, labels = name_part.split("{", 1)
+        if name.endswith("_bucket"):
+            le = None
+            for item in labels.rstrip("}").split(","):
+                if item.startswith("le="):
+                    le = float(item[4:-1].replace("+Inf", "inf"))
+            if le is not None:
+                out.setdefault(name[: -len("_bucket")] + "!buckets",
+                               []).append((le, value))
+        elif not labels:
+            out[name] = value
+    return out
+
+
+def histogram_quantile(buckets: List[Tuple[float, float]],
+                       q: float) -> Optional[float]:
+    """Estimated q-quantile from cumulative (le, count) pairs — the
+    scrape-side mirror of obs.Histogram.percentile, interpolating
+    inside the containing bucket. None when the histogram is empty."""
+    if not buckets:
+        return None
+    buckets = sorted(buckets)
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    lo, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= target:
+            if le == float("inf"):
+                return lo  # overflow bucket: the last finite edge
+            width = le - lo
+            in_bucket = cum - prev_cum
+            frac = (target - prev_cum) / in_bucket if in_bucket else 1.0
+            return lo + width * frac
+        lo, prev_cum = le, cum
+    return lo
+
+
+class Replica:
+    """Router-side record of one replica: URL, circuit breaker, last
+    observed health state, and the load snapshot the picker scores.
+    Mutated by the health poller and request threads under `lock`."""
+
+    __slots__ = ("url", "breaker", "lock", "state", "load",
+                 "last_ok", "added_at", "pending")
+
+    def __init__(self, url: str, breaker: CircuitBreaker):
+        self.url = url.rstrip("/")
+        self.breaker = breaker
+        self.lock = threading.Lock()
+        # "unknown" | "healthy" | "draining" | "ejected"
+        self.state = "unknown"
+        self.load: Dict[str, Any] = {}
+        self.last_ok: Optional[float] = None
+        self.added_at = time.monotonic()
+        self.pending = 0  # from the last health poll
+
+    @property
+    def routable(self) -> bool:
+        return self.state == "healthy"
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            return {
+                "url": self.url,
+                "state": self.state,
+                "breaker": self.breaker.state,
+                "pending": self.pending,
+                "load_score": self.load.get("score"),
+                "last_ok_age_s": (
+                    None if self.last_ok is None
+                    else round(time.monotonic() - self.last_ok, 3)
+                ),
+            }
+
+
+class _Retryable(Exception):
+    """One attempt failed in a way a DIFFERENT replica might serve:
+    nothing reached the client, so re-issuing is safe."""
+
+    def __init__(self, kind: str, msg: str, *, breaker: bool,
+                 retry_after: Optional[float] = None):
+        super().__init__(msg)
+        self.kind = kind          # connect|timeout|status_503|status_429|
+        #                           status_500|stream_pre_byte
+        self.breaker = breaker    # should this failure feed the breaker?
+        self.retry_after = retry_after
+
+
+class _Permanent(Exception):
+    """The replica answered definitively (4xx): relay, never retry."""
+
+    def __init__(self, status: int, body: bytes, content_type: str):
+        super().__init__(f"HTTP {status}")
+        self.status = status
+        self.body = body
+        self.content_type = content_type
+
+
+class TierRouter:
+    def __init__(
+        self,
+        replicas: List[str],
+        *,
+        replica_factory: Optional[Callable[[str], str]] = None,
+        health_interval: float = 0.5,
+        health_timeout: float = 2.0,
+        breaker_failures: int = 3,
+        breaker_window: float = 30.0,
+        breaker_cooldown: float = 5.0,
+        respawn_after: Optional[float] = None,
+        max_attempts: int = 4,
+        backoff_base: float = 0.05,
+        backoff_cap: float = 2.0,
+        default_timeout: float = 60.0,
+        affinity_tolerance: float = 4.0,
+        registry: Optional[Registry] = None,
+        metrics: bool = True,
+    ):
+        if not replicas:
+            raise ValueError("a tier needs at least one replica URL")
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        if health_interval <= 0 or health_timeout <= 0:
+            raise ValueError("health interval/timeout must be > 0")
+        if registry is None:
+            registry = get_registry() if metrics else Registry(enabled=False)
+        self._registry = registry
+        self._m = TierMetrics(registry)
+        self._t0 = time.monotonic()
+        self.health_interval = health_interval
+        self.health_timeout = health_timeout
+        self.max_attempts = max_attempts
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.default_timeout = default_timeout
+        self.affinity_tolerance = affinity_tolerance
+        self.respawn_after = respawn_after
+        self._factory = replica_factory
+        self._breaker_cfg = (breaker_failures, breaker_window,
+                             breaker_cooldown)
+        # Membership list: replaced wholesale under _lock on respawn;
+        # readers grab the reference once (plain-list reads are
+        # atomic) so a swap mid-request is benign.
+        self._lock = threading.Lock()
+        self._replicas: List[Replica] = [
+            Replica(u, CircuitBreaker(*self._breaker_cfg))
+            for u in replicas
+        ]
+        if len({r.url for r in self._replicas}) != len(self._replicas):
+            raise ValueError("duplicate replica URLs")
+        self._closed = threading.Event()
+        # Reused pool for the concurrent health sweep: a thread per
+        # replica per sweep would churn 2N threads/second forever.
+        self._poll_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(32, len(self._replicas)),
+            thread_name_prefix="shellac-tier-poll",
+        )
+        self._poller = threading.Thread(
+            target=self._poll_loop, daemon=True, name="shellac-tier-health"
+        )
+        self._poller.start()
+
+    # ---- membership & health ----------------------------------------
+
+    @property
+    def replicas(self) -> List[Replica]:
+        return list(self._replicas)
+
+    def _get(self, url: str, path: str,
+             timeout: float) -> Tuple[int, bytes]:
+        req = urllib.request.Request(url + path, method="GET")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def _poll_loop(self) -> None:
+        while not self._closed.wait(self.health_interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — the poller must survive
+                # A poll crash would silently freeze membership; keep
+                # polling (individual replica errors are handled per
+                # replica below, this catches router-side bugs).
+                pass
+
+    def poll_once(self) -> None:
+        """One health sweep over every replica (the poller thread calls
+        this on the interval; tests call it directly for determinism).
+        Replicas are polled CONCURRENTLY: a sequential sweep would let
+        one stalled replica (blocking its health GET to the timeout)
+        delay ejections, readmissions, and drain observation for the
+        whole fleet by N x health_timeout."""
+        list(self._poll_pool.map(self._poll_replica, self._replicas))
+        self._respawn_dead()
+        healthy = sum(r.routable for r in self._replicas)
+        self._m.healthy.set(healthy)
+        for rep in self._replicas:
+            self._m.replica_state.labels(replica=rep.url).set(
+                1 if rep.routable else 0
+            )
+
+    def _poll_replica(self, rep: Replica) -> None:
+        with rep.lock:
+            if rep.state == "ejected" and not rep.breaker.allow_probe():
+                return  # still cooling down; skip the network round-trip
+            probing = rep.state == "ejected"
+        try:
+            status, body = self._get(rep.url, "/health",
+                                     self.health_timeout)
+            health = json.loads(body or b"{}")
+        except (OSError, ValueError, http.client.HTTPException):
+            # HTTPException matters: a replica dying mid-health-body
+            # raises IncompleteRead, and letting it escape here would
+            # strand the breaker in half_open (probe never resolved) —
+            # a permanent silent ejection.
+            self._note_failure(rep, probing=probing)
+            return
+        if status == 200:
+            with rep.lock:
+                was = rep.state
+                rep.breaker.record_success()
+                rep.state = "healthy"
+                rep.last_ok = time.monotonic()
+                rep.pending = int(health.get("pending", 0))
+            if probing or was == "ejected":
+                self._m.readmissions.labels(replica=rep.url).inc()
+            self._scrape_load(rep)
+            return
+        if health.get("status") == "draining":
+            # A drain is DELIBERATE: readiness is down but the replica
+            # is healthy and completing work — bleed traffic off
+            # without charging the breaker.
+            with rep.lock:
+                was = rep.state
+                rep.breaker.record_success()
+                rep.state = "draining"
+                rep.last_ok = time.monotonic()
+                rep.pending = int(health.get("pending", 0))
+            if was != "draining":
+                self._m.drains.labels(replica=rep.url).inc()
+            return
+        self._note_failure(rep, probing=probing)
+
+    def _note_failure(self, rep: Replica, probing: bool = False) -> None:
+        del probing  # the breaker handles probe failures itself
+        with rep.lock:
+            tripped = rep.breaker.record_failure()
+            newly = tripped and rep.state != "ejected"
+            if tripped:
+                rep.state = "ejected"
+        if newly:
+            self._m.ejections.labels(replica=rep.url).inc()
+
+    def _scrape_load(self, rep: Replica) -> None:
+        """Refresh the load snapshot from the replica's /metrics (the
+        PR 3 gauges). A 404 (--no-metrics) or parse failure degrades to
+        the health poll's pending count — routing still works, just on
+        a coarser signal."""
+        load: Dict[str, Any] = {}
+        try:
+            status, body = self._get(rep.url, "/metrics",
+                                     self.health_timeout)
+            if status == 200:
+                parsed = parse_prometheus(body.decode())
+                for k in _QUEUE_GAUGES + (_KV_GAUGE, _PREFIX_GAUGE):
+                    if k in parsed:
+                        load[k] = parsed[k]
+                ttft = histogram_quantile(
+                    parsed.get(_TTFT_HIST + "!buckets", []), 0.99
+                )
+                if ttft is not None:
+                    load["ttft_p99"] = ttft
+        except (OSError, ValueError, http.client.HTTPException):
+            pass
+        load["score"] = self._score(rep, load)
+        with rep.lock:
+            rep.load = load
+
+    def _score(self, rep: Replica, load: Dict[str, Any]) -> float:
+        """Scalar load: requests queued + pending ahead of a newcomer,
+        a KV-pressure term (a near-full cache means imminent admission
+        stalls), and a latency term so a replica that is slow for any
+        unmodeled reason (noisy neighbor, thermal throttle) repels
+        traffic too. Units are roughly 'requests in front of you'."""
+        pending = load.get("shellac_pending_requests")
+        if pending is None:
+            pending = rep.pending
+        queue = load.get("shellac_engine_queue_depth", 0.0)
+        kv = load.get(_KV_GAUGE, 0.0)
+        ttft = load.get("ttft_p99", 0.0)
+        return float(pending) + float(queue) + 8.0 * float(kv) \
+            + 2.0 * float(ttft)
+
+    def _respawn_dead(self) -> None:
+        if self._factory is None or self.respawn_after is None:
+            return
+        now = time.monotonic()
+        for i, rep in enumerate(list(self._replicas)):
+            ref = rep.last_ok if rep.last_ok is not None else rep.added_at
+            if rep.state != "ejected" or now - ref < self.respawn_after:
+                continue
+            try:
+                new_url = self._factory(rep.url)
+            except Exception:  # noqa: BLE001 — factory faults must not
+                continue      # kill the poller; retried next sweep
+            with self._lock:
+                if self._replicas[i] is rep:
+                    self._replicas[i] = Replica(
+                        new_url, CircuitBreaker(*self._breaker_cfg)
+                    )
+                    self._m.respawns.inc()
+
+    # ---- routing policy ---------------------------------------------
+
+    @staticmethod
+    def affinity_key(path: str, payload: dict) -> Tuple[Optional[str], int]:
+        """(key, estimated shared-prefix tokens) for a request payload.
+
+        Explicit `session` (native extension) or `user` (the OpenAI
+        field) wins; otherwise the key hashes the prompt's leading
+        tokens/characters, so prompts sharing a long prefix (few-shot
+        headers, system prompts, agent scaffolds) co-locate on the
+        replica whose prefix-cache block registry already holds that
+        KV. The token estimate scales how much load imbalance an
+        affinity hit is worth."""
+        sess = payload.get("session") or payload.get("user")
+        if sess:
+            return f"s:{sess}", 256
+        prefix: Any = None
+        if payload.get("tokens") is not None:
+            prefix = payload["tokens"]
+        elif payload.get("prompt") is not None:
+            prefix = payload["prompt"]
+        elif payload.get("text") is not None:
+            prefix = payload["text"]
+        elif payload.get("messages"):
+            first = payload["messages"][0]
+            prefix = (first.get("content", "")
+                      if isinstance(first, dict) else "")
+        if prefix is None:
+            return None, 0
+        if isinstance(prefix, list):
+            est = len(prefix)
+            head = ",".join(str(t) for t in prefix[:64])
+        else:
+            s = str(prefix)
+            est = max(1, len(s) // 4)  # ~4 chars/token heuristic
+            head = s[:256]
+        return "p:" + hashlib.blake2b(
+            head.encode(), digest_size=8
+        ).hexdigest(), est
+
+    @staticmethod
+    def _rendezvous(key: str, url: str) -> int:
+        return int.from_bytes(
+            hashlib.blake2b(f"{key}|{url}".encode(), digest_size=8)
+            .digest(), "big",
+        )
+
+    def _pick(self, key: Optional[str], prefix_tokens: int,
+              exclude: set) -> Tuple[Optional[Replica], str]:
+        """Choose a replica: affinity target unless it is ejected,
+        draining, excluded (already failed this request), or hotter
+        than the least-loaded by more than the hit-value-scaled
+        tolerance — then least-loaded. Returns (None, reason) when
+        nothing is routable."""
+        routable = [r for r in self._replicas if r.routable]
+        cands = [r for r in routable if r.url not in exclude]
+        if not cands:
+            # Every routable replica already failed this request once:
+            # re-allow them rather than refusing outright (a replica
+            # can recover between attempts; the backoff paces us).
+            cands = routable
+        if not cands:
+            return None, "none"
+
+        def score(r: Replica) -> float:
+            with r.lock:
+                s = r.load.get("score")
+            return s if s is not None else float(r.pending)
+
+        best = min(cands, key=score)
+        if key is None:
+            return best, "least_loaded"
+        aff = max(cands, key=lambda r: self._rendezvous(key, r.url))
+        if aff is best:
+            return aff, "affinity"
+        # Spill decision: how much queueing is this prefix hit worth?
+        value = min(1.0, prefix_tokens / 256.0)
+        with aff.lock:
+            has_cache = aff.load.get(_PREFIX_GAUGE, 0.0) > 0
+        if not has_cache:
+            # No registered prefix blocks to hit: affinity is only
+            # session stickiness, worth far less queueing.
+            value *= 0.25
+        if score(aff) - score(best) <= self.affinity_tolerance * value:
+            return aff, "affinity"
+        return best, "least_loaded"
+
+    # ---- failure-aware request handling -----------------------------
+
+    def _classify_http_error(self, rep: Replica,
+                             e: urllib.error.HTTPError) -> Exception:
+        body = e.read()
+        ct = e.headers.get("Content-Type", "application/json")
+        ra = e.headers.get("Retry-After")
+        ra = float(ra) if ra and ra.replace(".", "", 1).isdigit() else None
+        if e.code == 503:
+            draining = b"draining" in body
+            if draining:
+                # Don't wait for the next poll to observe the flip.
+                with rep.lock:
+                    was = rep.state
+                    if rep.state == "healthy":
+                        rep.state = "draining"
+                if was == "healthy":
+                    self._m.drains.labels(replica=rep.url).inc()
+            return _Retryable("status_503", body.decode(errors="replace"),
+                              breaker=not draining, retry_after=ra)
+        if e.code == 429:
+            # Overload is backpressure, not breakage: retry elsewhere
+            # without charging the breaker.
+            return _Retryable("status_429", body.decode(errors="replace"),
+                              breaker=False, retry_after=ra)
+        if e.code >= 500:
+            return _Retryable("status_500", body.decode(errors="replace"),
+                              breaker=True)
+        return _Permanent(e.code, body, ct)
+
+    def _post(self, rep: Replica, path: str, payload: dict,
+              timeout: float):
+        """One POST attempt; returns the open response (caller reads).
+        Raises _Retryable/_Permanent with the failure classified."""
+        data = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            rep.url + path, data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            return urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            raise self._classify_http_error(rep, e) from e
+        except socket.timeout as e:
+            raise _Retryable("timeout", f"replica timed out: {e}",
+                             breaker=True) from e
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None), socket.timeout):
+                raise _Retryable("timeout", f"replica timed out: {e}",
+                                 breaker=True) from e
+            raise _Retryable("connect", f"replica unreachable: {e.reason}",
+                             breaker=True) from e
+        except (ConnectionError, OSError) as e:
+            raise _Retryable("connect", f"replica connection failed: {e}",
+                             breaker=True) from e
+
+    def _attempt_failed(self, rep: Replica, e: _Retryable) -> None:
+        self._m.retries.labels(replica=rep.url, kind=e.kind).inc()
+        if e.breaker:
+            self._note_failure(rep)
+
+    def _backoff(self, attempt: int, remaining: float) -> Optional[float]:
+        """Full-jitter capped exponential backoff, bounded by the
+        request's remaining deadline budget. None = no time left."""
+        ceiling = min(self.backoff_cap,
+                      self.backoff_base * (2.0 ** attempt))
+        delay = random.uniform(0.0, ceiling)
+        # Leave at least a sliver of budget for the attempt itself.
+        if delay >= remaining - 0.01:
+            return None
+        return delay
+
+    def _deadline(self, payload: dict) -> float:
+        timeout = float(payload.get("timeout") or self.default_timeout)
+        return time.monotonic() + timeout
+
+    def _route_attempts(self, path: str, payload: dict,
+                        deadline: float, stop: dict):
+        """Generator of (replica, reason, remaining, attempt_payload):
+        the shared retry loop. Callers `throw`-free: they report each
+        failure via _attempt_failed and ask for the next attempt by
+        iterating; the generator sleeps the backoff between attempts
+        and stops when attempts or the deadline run out — recording
+        WHICH in stop["why"] ("deadline" | "attempts"), because the
+        caller cannot infer it from the clock: a backoff that no
+        longer fits the remaining budget ends the loop with up to
+        backoff_cap seconds still on it."""
+        key, prefix_tokens = self.affinity_key(path, payload)
+        tried: set = set()
+        stop["why"] = "attempts"
+        for attempt in range(self.max_attempts):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                stop["why"] = "deadline"
+                return
+            if attempt > 0:
+                delay = self._backoff(attempt - 1, remaining)
+                if delay is None:
+                    stop["why"] = "deadline"
+                    return
+                self._m.backoff.observe(delay)
+                time.sleep(delay)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    stop["why"] = "deadline"
+                    return
+            rep, reason = self._pick(key, prefix_tokens, tried)
+            if rep is not None and attempt > 0:
+                # Relabel so the routed series distinguishes retry
+                # traffic from first attempts (the reason the metric
+                # documents); the failure class lives in the separate
+                # retries counter.
+                reason = "retry"
+            if rep is None:
+                # Nothing routable right now; wait out a poll interval
+                # within the deadline in case a probe readmits someone.
+                time.sleep(min(self.health_interval,
+                               max(remaining - 0.01, 0.0)))
+                continue
+            tried.add(rep.url)
+            # The replica sheds on ITS deadline too: hand it the
+            # remaining budget so tier and replica agree on when this
+            # request stops being worth prefilling.
+            att = dict(payload)
+            att["timeout"] = remaining
+            att.pop("session", None)  # tier-level extension, not a
+            #                           replica sampling knob
+            yield rep, reason, remaining, att
+
+    def forward_json(self, path: str,
+                     payload: dict) -> Tuple[int, bytes, str]:
+        """Route a non-streaming request. Returns (status, body bytes,
+        content type) — always; failures come back as error responses,
+        never exceptions."""
+        t0 = time.monotonic()
+        deadline = self._deadline(payload)
+        stop: Dict[str, str] = {}
+        last: Optional[_Retryable] = None
+        for rep, reason, remaining, att in self._route_attempts(
+                path, payload, deadline, stop):
+            self._m.routed.labels(replica=rep.url, reason=reason).inc()
+            a0 = time.monotonic()
+            try:
+                with self._post(rep, path, att, remaining) as resp:
+                    try:
+                        body = resp.read()
+                    except (OSError,
+                            http.client.HTTPException) as e:
+                        # Headers arrived but the body didn't (replica
+                        # killed mid-response: IncompleteRead / reset).
+                        # Nothing reached the client — retryable.
+                        raise _Retryable(
+                            "connect",
+                            f"replica died mid-response: {e}",
+                            breaker=True,
+                        ) from e
+                    ct = resp.headers.get("Content-Type",
+                                          "application/json")
+                self._m.attempt_latency.observe(time.monotonic() - a0)
+                self._m.outcomes.labels(outcome="ok").inc()
+                self._m.e2e.observe(time.monotonic() - t0)
+                return resp.status, body, ct
+            except _Retryable as e:
+                self._m.attempt_latency.observe(time.monotonic() - a0)
+                self._attempt_failed(rep, e)
+                last = e
+            except _Permanent as e:
+                # A definitive replica answer (bad request): relay it
+                # verbatim — the tier must not mask a 400 as transient.
+                self._m.attempt_latency.observe(time.monotonic() - a0)
+                self._m.outcomes.labels(outcome="failed").inc()
+                self._m.e2e.observe(time.monotonic() - t0)
+                return e.status, e.body, e.content_type
+        return self._exhausted(t0, path, last, stop)
+
+    def _exhausted(self, t0: float, path: str,
+                   last: Optional[_Retryable],
+                   stop: dict) -> Tuple[int, bytes, str]:
+        """Classify a request that ran out of road: no replica was
+        ever routable (503 rejected), the DEADLINE expired mid-retries
+        (504), or the attempt budget drained with deadline to spare —
+        an upstream availability problem, not client-deadline
+        pressure, so 502 with outcome "failed" (a 504 here would read
+        an outage as latency on every dashboard)."""
+        if last is None:
+            self._m.outcomes.labels(outcome="rejected").inc()
+            msg = "no routable replica in the tier"
+            status = 503
+        elif stop.get("why") == "deadline":
+            self._m.outcomes.labels(outcome="deadline").inc()
+            msg = (f"deadline exhausted after retries; last failure: "
+                   f"{last.kind}: {last}")
+            status = 504
+        else:
+            self._m.outcomes.labels(outcome="failed").inc()
+            msg = (f"replicas exhausted after {self.max_attempts} "
+                   f"attempts; last failure: {last.kind}: {last}")
+            status = 502
+        self._m.e2e.observe(time.monotonic() - t0)
+        err = {"error": {"message": msg, "type": "overloaded_error"}} \
+            if path.startswith("/v1/") else {"error": msg}
+        return status, json.dumps(err).encode(), "application/json"
+
+    # ---- streaming ---------------------------------------------------
+
+    @staticmethod
+    def _read_first_event(resp, sse: bool) -> bytes:
+        """The stream's first client-visible unit: one ndjson line, or
+        one SSE event (lines through the blank separator). Reading it
+        BEFORE committing a 200 to the client is what makes pre-byte
+        failures retryable."""
+        if not sse:
+            return resp.readline()
+        lines = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            lines.append(line)
+            if line in (b"\n", b"\r\n"):
+                break
+        return b"".join(lines)
+
+    @staticmethod
+    def _first_event_error(first: bytes, sse: bool) -> Optional[dict]:
+        """Parse an in-band error record out of the first event, if it
+        is one (server.py emits {"error": {..., "retryable": ...}})."""
+        data = first.strip()
+        if sse:
+            if not data.startswith(b"data: "):
+                return None
+            data = data[len(b"data: "):]
+        try:
+            obj = json.loads(data)
+        except ValueError:
+            return None
+        if isinstance(obj, dict) and isinstance(obj.get("error"), dict):
+            return obj["error"]
+        return None
+
+    def open_stream(self, path: str, payload: dict):
+        """Route a streaming request: retries attempts until one yields
+        a healthy first event, then hands (response, first_event_bytes,
+        content_type, replica_url, t0) to the HTTP layer to relay —
+        the relay settles the e2e histogram when the stream actually
+        ends, not here at the first event. On failure returns
+        (None, (status, body, content_type)) — an ordinary error
+        response, since nothing was committed to the client yet."""
+        t0 = time.monotonic()
+        deadline = self._deadline(payload)
+        stop: Dict[str, str] = {}
+        last: Optional[_Retryable] = None
+        sse = path.startswith("/v1/")
+        for rep, reason, remaining, att in self._route_attempts(
+                path, payload, deadline, stop):
+            self._m.routed.labels(replica=rep.url, reason=reason).inc()
+            a0 = time.monotonic()
+            try:
+                resp = self._post(rep, path, att, remaining)
+            except _Retryable as e:
+                self._m.attempt_latency.observe(time.monotonic() - a0)
+                self._attempt_failed(rep, e)
+                last = e
+                continue
+            except _Permanent as e:
+                self._m.attempt_latency.observe(time.monotonic() - a0)
+                self._m.outcomes.labels(outcome="failed").inc()
+                self._m.e2e.observe(time.monotonic() - t0)
+                return None, (e.status, e.body, e.content_type)
+            try:
+                first = self._read_first_event(resp, sse)
+            except (OSError, http.client.HTTPException) as e:
+                resp.close()
+                err = _Retryable("stream_pre_byte",
+                                 f"stream died before first event: {e}",
+                                 breaker=True)
+                self._attempt_failed(rep, err)
+                last = err
+                continue
+            if not first.strip():
+                # Clean FIN right after the upstream 200, zero bytes of
+                # stream: nothing reached (or will reach) the client,
+                # so this is a pre-byte failure — retry elsewhere, not
+                # a committed-then-severed stream.
+                resp.close()
+                err = _Retryable("stream_pre_byte",
+                                 "stream closed before first event",
+                                 breaker=True)
+                self._attempt_failed(rep, err)
+                last = err
+                continue
+            in_band = self._first_event_error(first, sse)
+            if in_band is not None and in_band.get("retryable"):
+                # The replica pushed back (shed/draining/recovering)
+                # after the 200 was already committed upstream — but
+                # NOTHING has reached our client, so retry elsewhere.
+                resp.close()
+                err = _Retryable("stream_pre_byte",
+                                 str(in_band.get("message", "")),
+                                 breaker=False)
+                self._attempt_failed(rep, err)
+                last = err
+                continue
+            self._m.attempt_latency.observe(time.monotonic() - a0)
+            self._m.outcomes.labels(outcome="ok").inc()
+            ct = resp.headers.get("Content-Type",
+                                  "text/event-stream" if sse
+                                  else "application/x-ndjson")
+            return (resp, first, ct, rep.url, t0), None
+        return None, self._exhausted(t0, path, last, stop)
+
+    # ---- admin / introspection --------------------------------------
+
+    def drain_replica(self, which, resume: bool = False) -> dict:
+        """Forward a drain (or resume) to one replica — `which` is its
+        URL or list index — and update routing state immediately
+        instead of waiting for the next health poll."""
+        reps = self._replicas
+        if isinstance(which, int) or (isinstance(which, str)
+                                      and which.isdigit()):
+            rep = reps[int(which)]
+        else:
+            matches = [r for r in reps
+                       if r.url == str(which).rstrip("/")]
+            if not matches:
+                raise ValueError(f"unknown replica {which!r}")
+            rep = matches[0]
+        data = json.dumps({"resume": True} if resume else {}).encode()
+        req = urllib.request.Request(
+            rep.url + "/drain", data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(
+                req, timeout=self.health_timeout) as r:
+            health = json.loads(r.read())
+        with rep.lock:
+            was = rep.state
+            if resume:
+                if rep.state == "draining":
+                    rep.state = "healthy"
+            elif rep.state == "healthy":
+                rep.state = "draining"
+        if not resume and was == "healthy":
+            self._m.drains.labels(replica=rep.url).inc()
+        return {"replica": rep.url, "state": rep.state, **health}
+
+    def health(self) -> Dict[str, Any]:
+        reps = [r.snapshot() for r in self._replicas]
+        healthy = sum(1 for r in reps if r["state"] == "healthy")
+        return {
+            "status": "ok" if healthy else "unavailable",
+            "ok": healthy > 0,
+            "replicas_healthy": healthy,
+            "replicas_total": len(reps),
+            "replicas": reps,
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        reg = self._registry
+
+        def total(name):
+            return int(reg.total(name) or 0)
+
+        return {
+            **self.health(),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "routed": total("shellac_tier_routed_total"),
+            "retried": total("shellac_tier_retries_total"),
+            "ejected": total("shellac_tier_ejections_total"),
+            "readmitted": total("shellac_tier_readmissions_total"),
+            "drains_observed": total("shellac_tier_drains_observed_total"),
+            "respawned": total("shellac_tier_respawns_total"),
+        }
+
+    @property
+    def metrics_enabled(self) -> bool:
+        return self._registry.enabled
+
+    def metrics_text(self) -> str:
+        return self._registry.render()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._poller.join(timeout=5)
+        self._poll_pool.shutdown(wait=False)
+
+
+def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
+                          port: int = 0) -> ThreadingHTTPServer:
+    route_paths = ("/generate", "/v1/completions", "/v1/chat/completions")
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # quiet
+            pass
+
+        def _send(self, code: int, obj) -> None:
+            if isinstance(obj, tuple):  # (status, body, content_type)
+                code, body, ct = obj
+            else:
+                body, ct = json.dumps(obj).encode(), "application/json"
+            self.send_response(code)
+            self.send_header("Content-Type", ct)
+            self.send_header("Content-Length", str(len(body)))
+            if code in (429, 502, 503, 504):
+                from shellac_tpu.inference.server import retry_after
+
+                self.send_header(
+                    "Retry-After",
+                    str(max(1, int(round(retry_after(1.0, 4.0))))),
+                )
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/health":
+                h = router.health()
+                self._send(200 if h["ok"] else 503, h)
+            elif self.path == "/stats":
+                self._send(200, router.stats())
+            elif self.path == "/metrics":
+                if not router.metrics_enabled:
+                    self._send(404, {"error": "metrics disabled"})
+                    return
+                body = router.metrics_text().encode()
+                self._send(200, (
+                    200, body, "text/plain; version=0.0.4; charset=utf-8",
+                ))
+            elif self.path == "/v1/models":
+                # Forward from any routable replica (the tier serves
+                # whatever its replicas serve).
+                for rep in router.replicas:
+                    if not rep.routable:
+                        continue
+                    try:
+                        status, body = router._get(
+                            rep.url, "/v1/models", router.health_timeout
+                        )
+                        if status == 200:
+                            self._send(200, (
+                                200, body, "application/json"))
+                            return
+                    except (OSError, http.client.HTTPException):
+                        continue
+                self._send(503, {"error": "no routable replica"})
+            else:
+                self._send(404, {"error": "not found"})
+
+        @staticmethod
+        def _stream_terminated(tail: bytes, sse: bool) -> bool:
+            """Did the stream END, or merely stop? A replica exiting
+            cleanly mid-stream delivers a polite FIN the byte pump
+            cannot tell from completion — so completion is checked
+            against the protocol's terminator: the `[DONE]` sentinel /
+            an error event (SSE), or a final record carrying `done` or
+            `error` (ndjson). Anything else is truncation and must be
+            reported loudly, never relayed as success."""
+            lines = [ln for ln in tail.strip().splitlines() if ln.strip()]
+            if not lines:
+                return False
+            last = lines[-1].strip()
+            if sse:
+                if not last.startswith(b"data: "):
+                    return False
+                last = last[len(b"data: "):]
+                if last == b"[DONE]":
+                    return True
+            try:
+                obj = json.loads(last)
+            except ValueError:
+                return False
+            return isinstance(obj, dict) and (
+                bool(obj.get("done")) or "error" in obj
+            )
+
+        def _relay_stream(self, path: str, payload: dict) -> None:
+            opened, err = router.open_stream(path, payload)
+            if opened is None:
+                self._send(err[0], err)
+                return
+            resp, first, ct, rep_url, t0 = opened
+            self.send_response(200)
+            self.send_header("Content-Type", ct)
+            if ct.startswith("text/event-stream"):
+                self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            sse = ct.startswith("text/event-stream")
+            upstream_lost = False
+            tail = first[-2048:]
+            try:
+                self.wfile.write(first)
+                self.wfile.flush()
+                while True:
+                    try:
+                        chunk = resp.read(4096)
+                    except (OSError, http.client.HTTPException):
+                        # The REPLICA died mid-stream (RST), after
+                        # bytes already reached the client: non-
+                        # retryable by contract (a retry would
+                        # silently duplicate the partial completion) —
+                        # fail LOUDLY with an in-band record instead.
+                        upstream_lost = True
+                        break
+                    if not chunk:
+                        # Clean EOF — which is only success if the
+                        # protocol terminator actually arrived.
+                        upstream_lost = not self._stream_terminated(
+                            tail, sse)
+                        break
+                    tail = (tail + chunk)[-2048:]
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+                if upstream_lost:
+                    router._m.stream_severed.labels(
+                        replica=rep_url).inc()
+                    msg = {"error": {
+                        "message": "upstream replica lost mid-stream",
+                        "type": "server_error", "retryable": False,
+                    }}
+                    data = json.dumps(msg)
+                    self.wfile.write(
+                        (f"data: {data}\n\n" if sse
+                         else data + "\n").encode()
+                    )
+            except OSError:
+                # OUR client hung up (the normal cancel path): closing
+                # the upstream response propagates the disconnect to
+                # the replica, whose engine-side cancel frees the slot.
+                pass
+            finally:
+                resp.close()
+                # The e2e histogram covers the WHOLE stream (its help
+                # text says admission to final byte), so it settles
+                # here, not at the first event.
+                router._m.e2e.observe(time.monotonic() - t0)
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except ValueError:
+                self._send(400, {"error": "bad JSON payload"})
+                return
+            if not isinstance(payload, dict):
+                # Valid JSON that isn't an object ('[1]', '5') must
+                # 400, not AttributeError the handler thread.
+                self._send(400, {"error": "payload must be a JSON "
+                                          "object"})
+                return
+            if self.path == "/admin/drain":
+                if "replica" not in payload:
+                    # No default: a typoed request must not silently
+                    # drain whichever replica happens to be first.
+                    self._send(400, {"error": 'need "replica": '
+                                              "url or index"})
+                    return
+                try:
+                    out = router.drain_replica(
+                        payload["replica"],
+                        resume=bool(payload.get("resume")),
+                    )
+                except (ValueError, IndexError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except OSError as e:
+                    self._send(502, {"error": f"drain forward failed: {e}"})
+                    return
+                self._send(200, out)
+                return
+            if self.path not in route_paths:
+                self._send(404, {"error": "not found"})
+                return
+            if payload.get("stream"):
+                self._relay_stream(self.path, payload)
+            else:
+                self._send(0, router.forward_json(self.path, payload))
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def serve_tier(router: TierRouter, host: str = "127.0.0.1",
+               port: int = 8100) -> None:
+    """Blocking entry point used by `python -m shellac_tpu serve-tier`."""
+    httpd = make_tier_http_server(router, host, port)
+    print(json.dumps(
+        {"serving_tier": f"http://{host}:{httpd.server_address[1]}",
+         "replicas": [r.url for r in router.replicas]}
+    ), flush=True)
+    try:
+        httpd.serve_forever()
+    finally:
+        router.close()

@@ -25,6 +25,7 @@ from shellac_tpu.obs.trace import (
     EngineMetrics,
     RequestTrace,
     ServeMetrics,
+    TierMetrics,
 )
 
 __all__ = [
@@ -39,4 +40,5 @@ __all__ = [
     "EngineMetrics",
     "RequestTrace",
     "ServeMetrics",
+    "TierMetrics",
 ]

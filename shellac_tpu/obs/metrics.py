@@ -333,6 +333,22 @@ class Registry:
             return None
         return inst.count if isinstance(inst, Histogram) else inst.value
 
+    def total(self, name: str) -> Optional[float]:
+        """Sum of a family's series values across ALL label
+        combinations (counters/gauges: value; histograms: observation
+        count). None when the family was never registered. The read
+        surface for "how many X happened, regardless of label" — the
+        tier's /stats uses it — so callers never walk internals."""
+        fam = self._families.get(name)
+        if fam is None:
+            return None
+        with fam._lock:
+            insts = list(fam.series.values())
+        return float(sum(
+            i.count if isinstance(i, Histogram) else i.value
+            for i in insts
+        ))
+
     # ---- exposition --------------------------------------------------
 
     @staticmethod

@@ -89,6 +89,11 @@ class ServeMetrics:
             "shellac_engine_generation",
             "Current engine generation (bumps on supervisor rebuild)",
         )
+        self.draining = g(
+            "shellac_draining",
+            "1 while a graceful drain is in progress (admission "
+            "refused, in-flight requests completing), else 0",
+        )
         self.uptime = g(
             "shellac_uptime_seconds", "Seconds since the server started"
         )
@@ -179,6 +184,93 @@ class RequestTrace:
     def abort(self, outcome: str = "cancelled") -> None:
         """Any non-ok, non-shed settlement: cancelled | error | fault."""
         self._settle(outcome)
+
+
+class TierMetrics:
+    """The router-tier instruments over one registry.
+
+    Per-replica series are labeled by the replica's base URL so a
+    scrape shows exactly where traffic went, what was retried away
+    from whom, and who is ejected — the counters the tier chaos tests
+    assert against. Written only from router threads (health poller +
+    request handlers); replicas keep their own ServeMetrics."""
+
+    def __init__(self, registry: Registry):
+        self.registry = registry
+        h, c, g = registry.histogram, registry.counter, registry.gauge
+        self.routed = c(
+            "shellac_tier_routed_total",
+            "Request attempts forwarded, by replica and routing reason "
+            "(affinity|least_loaded|retry)",
+            labels=("replica", "reason"),
+        )
+        self.outcomes = c(
+            "shellac_tier_requests_total",
+            "Tier-level request settlements, by outcome "
+            "(ok|failed|rejected|deadline)",
+            labels=("outcome",),
+        )
+        self.retries = c(
+            "shellac_tier_retries_total",
+            "Retryable attempt failures, by replica the attempt hit "
+            "and the failure class (connect|timeout|status_503|"
+            "status_429|status_500|stream_pre_byte)",
+            labels=("replica", "kind"),
+        )
+        self.ejections = c(
+            "shellac_tier_ejections_total",
+            "Circuit-breaker ejections, by replica",
+            labels=("replica",),
+        )
+        self.readmissions = c(
+            "shellac_tier_readmissions_total",
+            "Half-open probes that readmitted a replica",
+            labels=("replica",),
+        )
+        self.drains = c(
+            "shellac_tier_drains_observed_total",
+            "Health polls that found a replica newly draining",
+            labels=("replica",),
+        )
+        self.respawns = c(
+            "shellac_tier_respawns_total",
+            "Dead replicas replaced through the replica factory",
+        )
+        self.stream_severed = c(
+            "shellac_tier_stream_severed_total",
+            "Streams lost mid-relay AFTER bytes reached the client "
+            "(non-retryable by contract; reported in-band), by replica",
+            labels=("replica",),
+        )
+        self.healthy = g(
+            "shellac_tier_replicas_healthy",
+            "Replicas currently routable (healthy, not ejected or "
+            "draining)",
+        )
+        self.replica_state = g(
+            "shellac_tier_replica_state",
+            "Per-replica routability: 1 routable, 0 not (ejected, "
+            "draining, or dead)",
+            labels=("replica",),
+        )
+        self.attempt_latency = h(
+            "shellac_tier_attempt_seconds",
+            "Wall time of one forwarded attempt (connect to full "
+            "response, successful or not)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.e2e = h(
+            "shellac_tier_e2e_seconds",
+            "End-to-end tier latency (admission to final byte, "
+            "retries included)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.backoff = h(
+            "shellac_tier_backoff_seconds",
+            "Backoff slept between retry attempts (after jitter and "
+            "deadline capping)",
+            buckets=LATENCY_BUCKETS,
+        )
 
 
 class EngineMetrics:

@@ -15,6 +15,10 @@ Four layers of defense, cheapest first:
      but a component that keeps dying is declared fatal instead of
      crash-looping. Gates both the serving supervisor's engine
      rebuilds and the training sentinel's skip/rollback escalation.
+     `CircuitBreaker` is the same sliding-window idea pointed OUTWARD:
+     failures observed against a remote peer (a serving replica) trip
+     it open, and a half-open probe readmits the peer once it proves
+     healthy again — the serving tier keeps one per replica.
   4. `Heartbeat` (process level): a file touched every step; an
      external watchdog (or another host) treats a stale heartbeat as a
      hung/dead worker and can restart it. This is the single-host
@@ -133,6 +137,90 @@ class RestartBudget:
         the next allow(); this is a monitoring read, not a gate)."""
         cutoff = time.monotonic() - self.window
         return sum(1 for a in self._attempts if a > cutoff)
+
+
+class CircuitBreaker:
+    """Per-peer circuit breaker: sliding-window trip, half-open probe.
+
+    `RestartBudget` semantics turned outward. Record failures observed
+    against one remote peer (health-check 503s, connect errors,
+    timeouts); once `max_failures` land inside the trailing `window`
+    seconds the breaker OPENS and the caller stops sending the peer
+    work. After `cooldown` seconds open, exactly one caller is granted
+    a HALF-OPEN probe (`allow_probe()`); the probe's outcome decides —
+    `record_success()` closes the breaker (failure history cleared),
+    another `record_failure()` re-opens it for a fresh cooldown.
+
+    While CLOSED, successes do NOT clear the failure window — only
+    window expiry forgives. The tier's health poller reports a
+    success every sweep, and if that wiped the window, a replica
+    whose /health answers 200 while its data path times out (handler
+    exhaustion, a wedged accept loop) could never accumulate enough
+    request-path failures to eject. A slow trickle of isolated blips
+    still never trips: they age out of the window first.
+    """
+
+    def __init__(self, max_failures: int = 3, window: float = 30.0,
+                 cooldown: float = 5.0):
+        if max_failures < 1:
+            raise ValueError("max_failures must be >= 1")
+        if window <= 0 or cooldown <= 0:
+            raise ValueError("window and cooldown must be > 0 seconds")
+        self.max_failures = max_failures
+        self.window = window
+        self.cooldown = cooldown
+        self._failures: list[float] = []
+        self._opened_at: Optional[float] = None
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        """"closed" | "open" | "half_open" (probe in flight)."""
+        if self._opened_at is None:
+            return "closed"
+        return "half_open" if self._probing else "open"
+
+    def record_failure(self, now: Optional[float] = None) -> bool:
+        """One observed failure; True iff the breaker is now open
+        (including a failed half-open probe re-opening it)."""
+        t = time.monotonic() if now is None else now
+        if self._opened_at is not None:
+            # Open or probing: any failure (re-)starts the cooldown.
+            self._opened_at = t
+            self._probing = False
+            return True
+        cutoff = t - self.window
+        self._failures = [f for f in self._failures if f > cutoff]
+        self._failures.append(t)
+        if len(self._failures) >= self.max_failures:
+            self._opened_at = t
+            self._probing = False
+            return True
+        return False
+
+    def record_success(self, now: Optional[float] = None) -> None:
+        """A success while open/half-open (the probe passed) closes
+        the breaker and clears the failure window — the readmitted
+        peer starts fresh. A success while CLOSED is a no-op: routine
+        health-poll passes must not erase data-path failures
+        accumulating inside the window (see class docstring)."""
+        del now
+        if self._opened_at is not None:
+            self._failures.clear()
+            self._opened_at = None
+            self._probing = False
+
+    def allow_probe(self, now: Optional[float] = None) -> bool:
+        """True once per cooldown: the breaker is open, the cooldown
+        has elapsed, and no other probe is in flight — the caller may
+        send ONE trial request and report its outcome."""
+        if self._opened_at is None or self._probing:
+            return False
+        t = time.monotonic() if now is None else now
+        if t - self._opened_at < self.cooldown:
+            return False
+        self._probing = True
+        return True
 
 
 def heartbeat_age(path: str) -> Optional[float]:

@@ -1,0 +1,863 @@
+"""Serving-tier router (`inference/tier.py`) against scripted stub
+replicas — no engines, no JAX: these tests pin the ROUTER's contract
+(docs/serving_tier.md) at the HTTP boundary.
+
+  - membership: health polling, circuit-breaker ejection on repeated
+    failures, half-open probe readmission, drain observation, replica
+    respawn through the factory;
+  - requests: retryable failures (connect, 503, 429, retryable
+    in-band stream errors) land on a DIFFERENT replica within the
+    deadline; non-retryable outcomes (400, mid-stream loss after
+    bytes flowed) fail loudly;
+  - routing: affinity keys stick to one replica, spill to the
+    least-loaded when the target runs hot, and fall back when the
+    target is ejected.
+
+The heavyweight twin — real engines, real SIGKILL — is
+tests/test_tier_chaos.py (isolated fault-injection CI job).
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from shellac_tpu.inference.chaos import ChaosProxy
+from shellac_tpu.inference.server import retry_after
+from shellac_tpu.inference.tier import (
+    TierRouter,
+    histogram_quantile,
+    make_tier_http_server,
+    parse_prometheus,
+)
+from shellac_tpu.obs import Registry
+from shellac_tpu.utils.failure import CircuitBreaker
+
+
+def wait_until(cond, timeout=10.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class StubReplica:
+    """Scriptable replica: the InferenceServer HTTP surface (health,
+    metrics, generate incl. streaming, drain) driven by writable
+    attributes instead of an engine."""
+
+    def __init__(self, tag, *, pending=0, queue_depth=0, kv_util=0.0,
+                 prefix_blocks=0):
+        self.tag = tag
+        self.mode = "ok"        # ok | recovering | draining | err503 |
+        #                         err429 | err400 | err500
+        self.pending = pending
+        self.queue_depth = queue_depth
+        self.kv_util = kv_util
+        self.prefix_blocks = prefix_blocks
+        self.stream_first_error = None   # dict -> sole (retryable?) line
+        self.stream_cut_after = None     # int deltas, then abrupt close
+        self.requests = 0                # POSTs that reached generate
+        self.lock = threading.Lock()
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj, hdrs=None):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (hdrs or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    if stub.mode == "draining":
+                        self._send(503, {"status": "draining",
+                                         "ok": False,
+                                         "pending": stub.pending})
+                    elif stub.mode == "recovering":
+                        self._send(503, {"status": "recovering",
+                                         "ok": False})
+                    else:
+                        self._send(200, {"status": "ok", "ok": True,
+                                         "pending": stub.pending})
+                elif self.path == "/metrics":
+                    txt = (
+                        f"shellac_pending_requests {stub.pending}\n"
+                        f"shellac_engine_queue_depth {stub.queue_depth}\n"
+                        f"shellac_kv_utilization {stub.kv_util}\n"
+                        f"shellac_prefix_cache_blocks "
+                        f"{stub.prefix_blocks}\n"
+                    )
+                    b = txt.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(b)))
+                    self.end_headers()
+                    self.wfile.write(b)
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/drain":
+                    stub.mode = ("ok" if payload.get("resume")
+                                 else "draining")
+                    self._send(200, {"status": stub.mode,
+                                     "pending": stub.pending,
+                                     "draining": stub.mode == "draining"})
+                    return
+                with stub.lock:
+                    stub.requests += 1
+                if stub.mode in ("err503", "recovering", "draining"):
+                    msg = ("server draining: not admitting"
+                           if stub.mode == "draining"
+                           else "server recovering from an engine fault")
+                    self._send(503, {"error": msg},
+                               {"Retry-After": "1"})
+                    return
+                if stub.mode == "err429":
+                    self._send(429, {"error": "server overloaded"},
+                               {"Retry-After": "1"})
+                    return
+                if stub.mode == "err400":
+                    self._send(400, {"error": "bad stop sequences"})
+                    return
+                if stub.mode == "err500":
+                    self._send(500, {"error": "scheduler died"})
+                    return
+                if payload.get("stream"):
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.end_headers()
+                    if stub.stream_first_error is not None:
+                        self.wfile.write(
+                            (json.dumps(
+                                {"error": stub.stream_first_error}
+                            ) + "\n").encode()
+                        )
+                        return
+                    deltas = [[1], [2], [3]]
+                    for i, d in enumerate(deltas):
+                        if (stub.stream_cut_after is not None
+                                and i >= stub.stream_cut_after):
+                            # Abrupt close mid-stream: no done record.
+                            self.wfile.flush()
+                            self.connection.close()
+                            return
+                        self.wfile.write(
+                            (json.dumps({"tokens": d}) + "\n").encode()
+                        )
+                        self.wfile.flush()
+                    self.wfile.write((json.dumps(
+                        {"done": True, "tokens": [1, 2, 3],
+                         "replica": stub.tag}
+                    ) + "\n").encode())
+                    return
+                self._send(200, {"tokens": [7], "replica": stub.tag})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def _mk_router(stubs, **kw):
+    kw.setdefault("registry", Registry())
+    kw.setdefault("health_interval", 0.05)
+    kw.setdefault("backoff_base", 0.01)
+    kw.setdefault("backoff_cap", 0.05)
+    kw.setdefault("default_timeout", 10.0)
+    r = TierRouter([s.url for s in stubs], **kw)
+    wait_until(lambda: all(x.state != "unknown" for x in r.replicas),
+               msg="initial health sweep")
+    return r
+
+
+def _replica_of(body: bytes) -> str:
+    return json.loads(body)["replica"]
+
+
+class TestCircuitBreaker:
+    def test_trips_at_max_failures_in_window(self):
+        b = CircuitBreaker(3, window=10.0, cooldown=1.0)
+        assert not b.record_failure(now=0.0)
+        assert not b.record_failure(now=1.0)
+        assert b.record_failure(now=2.0)
+        assert b.state == "open"
+
+    def test_window_expiry_forgives(self):
+        b = CircuitBreaker(3, window=10.0, cooldown=1.0)
+        b.record_failure(now=0.0)
+        b.record_failure(now=1.0)
+        # The first two age out: this third failure is alone in its
+        # window and must NOT trip.
+        assert not b.record_failure(now=20.0)
+        assert b.state == "closed"
+
+    def test_closed_state_success_does_not_clear_window(self):
+        # A replica can answer /health 200 while its DATA path fails:
+        # routine successes must not erase the failures accumulating
+        # in the window, or such a replica could never be ejected.
+        b = CircuitBreaker(2, window=100.0, cooldown=1.0)
+        b.record_failure(now=0.0)
+        b.record_success()
+        assert b.record_failure(now=1.0)  # second failure trips
+        assert b.state == "open"
+
+    def test_probe_success_clears_failure_window(self):
+        b = CircuitBreaker(2, window=100.0, cooldown=1.0)
+        b.record_failure(now=0.0)
+        b.record_failure(now=1.0)
+        assert b.allow_probe(now=3.0)
+        b.record_success()  # readmitted: starts fresh
+        assert not b.record_failure(now=4.0)
+
+    def test_half_open_probe_and_readmit(self):
+        b = CircuitBreaker(1, window=10.0, cooldown=2.0)
+        assert b.record_failure(now=0.0)
+        assert not b.allow_probe(now=1.0)       # cooling down
+        assert b.allow_probe(now=3.0)
+        assert b.state == "half_open"
+        assert not b.allow_probe(now=3.1)       # one probe at a time
+        b.record_success()
+        assert b.state == "closed"
+
+    def test_failed_probe_reopens_with_fresh_cooldown(self):
+        b = CircuitBreaker(1, window=10.0, cooldown=2.0)
+        b.record_failure(now=0.0)
+        assert b.allow_probe(now=2.5)
+        assert b.record_failure(now=2.6)        # probe failed
+        assert b.state == "open"
+        assert not b.allow_probe(now=3.0)       # cooldown restarted
+        assert b.allow_probe(now=5.0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            CircuitBreaker(0)
+        with pytest.raises(ValueError):
+            CircuitBreaker(1, window=0)
+        with pytest.raises(ValueError):
+            CircuitBreaker(1, cooldown=0)
+
+
+class TestRetryAfterJitter:
+    def test_within_bounds_and_actually_jitters(self):
+        vals = {retry_after(1.0, 4.0) for _ in range(64)}
+        assert all(1.0 <= v <= 4.0 for v in vals)
+        # 64 draws collapsing to one value would mean the jitter is
+        # gone and clients re-synchronize on a recovering replica.
+        assert len(vals) > 8
+
+
+class TestPrometheusScrape:
+    def test_parse_and_quantile(self):
+        text = (
+            "# HELP shellac_ttft_seconds t\n"
+            "# TYPE shellac_ttft_seconds histogram\n"
+            'shellac_ttft_seconds_bucket{le="0.1"} 50\n'
+            'shellac_ttft_seconds_bucket{le="1"} 99\n'
+            'shellac_ttft_seconds_bucket{le="+Inf"} 100\n'
+            "shellac_ttft_seconds_sum 12.5\n"
+            "shellac_ttft_seconds_count 100\n"
+            "shellac_kv_utilization 0.75\n"
+        )
+        p = parse_prometheus(text)
+        assert p["shellac_kv_utilization"] == 0.75
+        buckets = p["shellac_ttft_seconds!buckets"]
+        p50 = histogram_quantile(buckets, 0.50)
+        assert p50 is not None and p50 <= 0.1
+        p999 = histogram_quantile(buckets, 0.999)
+        assert p999 == 1.0  # overflow bucket reports last finite edge
+
+    def test_empty_histogram_is_none(self):
+        assert histogram_quantile([], 0.99) is None
+        assert histogram_quantile([(0.1, 0.0), (float("inf"), 0.0)],
+                                  0.99) is None
+
+
+class TestRoutingPolicy:
+    def test_least_loaded_wins_without_affinity(self):
+        idle, busy = StubReplica("idle"), StubReplica("busy", pending=50)
+        r = _mk_router([idle, busy])
+        try:
+            wait_until(
+                lambda: any((x.load.get("score") or 0) > 10
+                            for x in r.replicas),
+                msg="load scrape")
+            # No prompt fields at all -> no affinity key -> pure
+            # least-loaded. (The stub ignores the missing tokens.)
+            hits = {
+                _replica_of(r.forward_json("/generate",
+                                           {"max_new": 2})[1])
+                for _ in range(6)
+            }
+            assert hits == {"idle"}
+        finally:
+            r.close()
+            idle.close()
+            busy.close()
+
+    def test_affinity_sticks_across_requests(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        try:
+            payload = {"tokens": [5, 6, 7, 8], "max_new": 2}
+            first = _replica_of(r.forward_json("/generate", payload)[1])
+            for _ in range(8):
+                assert _replica_of(
+                    r.forward_json("/generate", payload)[1]
+                ) == first
+            # A different prompt prefix is free to land elsewhere, and
+            # across many keys both replicas must see traffic.
+            seen = {
+                _replica_of(r.forward_json(
+                    "/generate",
+                    {"tokens": [i * 3 + 1, i * 7 + 2], "max_new": 2},
+                )[1])
+                for i in range(16)
+            }
+            assert seen == {"a", "b"}
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+    def test_session_key_overrides_prompt(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        try:
+            hits = {
+                _replica_of(r.forward_json(
+                    "/generate",
+                    {"tokens": [i, i + 1], "max_new": 2,
+                     "session": "user-42"},
+                )[1])
+                for i in range(8)
+            }
+            assert len(hits) == 1  # one session -> one replica
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+    def test_affinity_spills_when_target_overloaded(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b], affinity_tolerance=4.0)
+        try:
+            payload = {"tokens": [5, 6, 7, 8], "max_new": 2,
+                       "session": "sticky"}
+            target_tag = _replica_of(
+                r.forward_json("/generate", payload)[1])
+            target = a if target_tag == "a" else b
+            other_tag = "b" if target_tag == "a" else "a"
+            # Pile load far past the tolerance onto the affinity
+            # target; the router must spill to the least-loaded.
+            target.pending = 100
+            wait_until(
+                lambda: any((x.load.get("score") or 0) > 50
+                            for x in r.replicas),
+                msg="load scrape sees the hot spot")
+            assert _replica_of(
+                r.forward_json("/generate", payload)[1]) == other_tag
+            # Load drains -> affinity resumes.
+            target.pending = 0
+            wait_until(
+                lambda: all((x.load.get("score") or 0) < 1
+                            for x in r.replicas),
+                msg="load scrape sees the drain")
+            assert _replica_of(
+                r.forward_json("/generate", payload)[1]) == target_tag
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+    def test_affinity_falls_back_when_target_ejected(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        try:
+            payload = {"tokens": [9, 9, 9], "max_new": 2,
+                       "session": "s1"}
+            target_tag = _replica_of(
+                r.forward_json("/generate", payload)[1])
+            target = a if target_tag == "a" else b
+            other_tag = "b" if target_tag == "a" else "a"
+            target.mode = "recovering"
+            wait_until(lambda: [x for x in r.replicas
+                                if x.url == target.url][0].state
+                       == "ejected", msg="ejection")
+            assert _replica_of(
+                r.forward_json("/generate", payload)[1]) == other_tag
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+
+class TestFailureAwareRetry:
+    def test_retry_on_503_lands_on_other_replica_within_deadline(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        try:
+            payload = {"tokens": [4, 4, 4], "max_new": 2, "timeout": 8}
+            target_tag = _replica_of(
+                r.forward_json("/generate", payload)[1])
+            target = a if target_tag == "a" else b
+            other_tag = "b" if target_tag == "a" else "a"
+            target.mode = "err503"
+            t0 = time.monotonic()
+            status, body, _ = r.forward_json("/generate", payload)
+            assert status == 200
+            assert _replica_of(body) == other_tag
+            assert time.monotonic() - t0 < 8.0
+            reg = r._registry
+            assert reg.value("shellac_tier_retries_total",
+                             replica=target.url,
+                             kind="status_503") >= 1
+            assert reg.value("shellac_tier_requests_total",
+                             outcome="ok") >= 2
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+    def test_connect_error_retried(self):
+        a = StubReplica("a")
+        # A port with nothing listening: connect errors immediately.
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        dead_url = f"http://127.0.0.1:{sock.getsockname()[1]}"
+        sock.close()
+        r = TierRouter([dead_url, a.url], registry=Registry(),
+                       health_interval=0.05, backoff_base=0.01,
+                       default_timeout=10.0)
+        try:
+            wait_until(lambda: any(x.state == "healthy"
+                                   for x in r.replicas),
+                       msg="stub healthy")
+            ok = 0
+            for i in range(6):
+                status, body, _ = r.forward_json(
+                    "/generate", {"tokens": [i], "max_new": 2})
+                assert status == 200, body
+                assert _replica_of(body) == "a"
+                ok += 1
+            assert ok == 6
+        finally:
+            r.close()
+            a.close()
+
+    def test_429_retried_without_charging_breaker(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        try:
+            payload = {"tokens": [2, 2], "max_new": 2}
+            target_tag = _replica_of(
+                r.forward_json("/generate", payload)[1])
+            target = a if target_tag == "a" else b
+            target.mode = "err429"
+            status, body, _ = r.forward_json("/generate", payload)
+            assert status == 200
+            rep = [x for x in r.replicas if x.url == target.url][0]
+            assert rep.breaker.state == "closed"
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+    def test_replica_500_retried_elsewhere(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        try:
+            payload = {"tokens": [3, 1], "max_new": 2}
+            target_tag = _replica_of(
+                r.forward_json("/generate", payload)[1])
+            target = a if target_tag == "a" else b
+            other_tag = "b" if target_tag == "a" else "a"
+            target.mode = "err500"
+            status, body, _ = r.forward_json("/generate", payload)
+            assert status == 200
+            assert _replica_of(body) == other_tag
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+    def test_400_is_permanent_and_relayed(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        for s in (a, b):
+            s.mode = "err400"
+        r = _mk_router([a, b])
+        try:
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1], "max_new": 2})
+            assert status == 400
+            assert b"bad stop sequences" in body
+            # Exactly one attempt: a 400 must never fan out.
+            assert a.requests + b.requests == 1
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+    def test_attempts_exhausted_with_budget_left_is_502(self):
+        # Fast failures with most of the deadline remaining are an
+        # upstream availability problem (502 "failed"), not client-
+        # deadline pressure — a 504 here would read an outage as
+        # latency on every dashboard.
+        a = StubReplica("a")
+        a.mode = "err503"
+        r = _mk_router([a], max_attempts=3, default_timeout=30.0)
+        try:
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1], "max_new": 2,
+                              "timeout": 20})
+            assert status == 502
+            assert b"attempts" in body
+            assert r._registry.value("shellac_tier_requests_total",
+                                     outcome="failed") == 1
+        finally:
+            r.close()
+            a.close()
+
+    def test_deadline_exhaustion_is_504(self):
+        a = StubReplica("a")
+        a.mode = "err503"
+        # Backoffs large relative to the deadline: the clock, not the
+        # attempt budget, runs out.
+        r = _mk_router([a], max_attempts=50, backoff_base=0.2,
+                       backoff_cap=0.4, default_timeout=1.0)
+        try:
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1], "max_new": 2,
+                              "timeout": 0.8})
+            assert status == 504
+            assert b"deadline" in body
+            assert r._registry.value("shellac_tier_requests_total",
+                                     outcome="deadline") == 1
+        finally:
+            r.close()
+            a.close()
+
+    def test_no_routable_replica_is_503(self):
+        a = StubReplica("a")
+        a.mode = "recovering"
+        r = _mk_router([a], default_timeout=1.0)
+        try:
+            wait_until(lambda: not r.replicas[0].routable,
+                       msg="ejection")
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1], "max_new": 2,
+                              "timeout": 0.5})
+            assert status == 503
+            assert b"no routable replica" in body
+        finally:
+            r.close()
+            a.close()
+
+
+class TestMembership:
+    def test_breaker_ejects_flapping_replica_then_readmits(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b], breaker_cooldown=0.3)
+        try:
+            a.mode = "recovering"
+            wait_until(lambda: [x for x in r.replicas
+                                if x.url == a.url][0].state == "ejected",
+                       msg="ejection")
+            reg = r._registry
+            assert reg.value("shellac_tier_ejections_total",
+                             replica=a.url) >= 1
+            # While ejected, all traffic lands on b.
+            for i in range(4):
+                status, body, _ = r.forward_json(
+                    "/generate", {"tokens": [i], "max_new": 2})
+                assert _replica_of(body) == "b"
+            # Recovery: the half-open probe readmits it.
+            a.mode = "ok"
+            wait_until(lambda: [x for x in r.replicas
+                                if x.url == a.url][0].state == "healthy",
+                       msg="readmission")
+            assert reg.value("shellac_tier_readmissions_total",
+                             replica=a.url) >= 1
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+    def test_drain_observed_and_traffic_bled_off(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        try:
+            a.mode = "draining"
+            wait_until(lambda: [x for x in r.replicas
+                                if x.url == a.url][0].state
+                       == "draining", msg="drain observed")
+            # Draining is deliberate: the breaker must stay closed.
+            rep = [x for x in r.replicas if x.url == a.url][0]
+            assert rep.breaker.state == "closed"
+            assert r._registry.value(
+                "shellac_tier_drains_observed_total", replica=a.url) == 1
+            for i in range(4):
+                _, body, _ = r.forward_json(
+                    "/generate", {"tokens": [i], "max_new": 2})
+                assert _replica_of(body) == "b"
+            # Resume: traffic may come back.
+            a.mode = "ok"
+            wait_until(lambda: rep.state == "healthy", msg="resume")
+        finally:
+            r.close()
+            a.close()
+            b.close()
+
+    def test_respawn_replaces_dead_replica(self):
+        a, b, c = StubReplica("a"), StubReplica("b"), StubReplica("c")
+
+        def factory(dead_url):
+            assert dead_url == a.url
+            return c.url
+
+        r = _mk_router([a, b], replica_factory=factory,
+                       respawn_after=0.2, breaker_cooldown=30.0)
+        try:
+            a.mode = "recovering"
+            wait_until(lambda: any(x.url == c.url for x in r.replicas),
+                       msg="respawn")
+            urls = {x.url for x in r.replicas}
+            assert urls == {b.url, c.url}
+            assert r._registry.value("shellac_tier_respawns_total") == 1
+            wait_until(lambda: [x for x in r.replicas
+                                if x.url == c.url][0].state == "healthy",
+                       msg="replacement healthy")
+        finally:
+            r.close()
+            a.close()
+            b.close()
+            c.close()
+
+
+class TestStreaming:
+    def _stream_lines(self, base, payload, timeout=10):
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({**payload, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = urllib.request.urlopen(req, timeout=timeout)
+        return resp, [json.loads(l) for l in resp if l.strip()]
+
+    def test_retryable_first_event_error_retried_elsewhere(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        httpd = make_tier_http_server(r)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            payload = {"tokens": [8, 8], "max_new": 3}
+            _, lines = self._stream_lines(base, payload)
+            target = a if lines[-1]["replica"] == "a" else b
+            other_tag = "b" if target is a else "a"
+            # The affinity target now sheds every stream before the
+            # first token (the server's retryable in-band record).
+            target.stream_first_error = {
+                "message": "request shed: deadline expired",
+                "type": "overloaded_error", "retryable": True,
+            }
+            _, lines = self._stream_lines(base, payload)
+            assert lines[-1]["done"] is True
+            assert lines[-1]["replica"] == other_tag
+            assert r._registry.value(
+                "shellac_tier_retries_total", replica=target.url,
+                kind="stream_pre_byte") >= 1
+        finally:
+            httpd.shutdown()
+            r.close()
+            a.close()
+            b.close()
+
+    def test_mid_stream_cut_after_bytes_fails_loudly(self):
+        a = StubReplica("a")
+        a.stream_cut_after = 2  # two deltas, then the wire dies
+        r = _mk_router([a])
+        httpd = make_tier_http_server(r)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            resp, lines = self._stream_lines(
+                base, {"tokens": [1, 2], "max_new": 3})
+            # Deltas arrived, then a LOUD in-band non-retryable error —
+            # never a silent truncation that looks like completion.
+            assert any("tokens" in l for l in lines)
+            assert not any(l.get("done") for l in lines)
+            err = [l for l in lines if "error" in l]
+            assert err, lines
+            assert err[-1]["error"]["retryable"] is False
+        finally:
+            httpd.shutdown()
+            r.close()
+            a.close()
+
+
+class TestTierHTTPSurface:
+    def test_health_stats_metrics_and_routing(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        httpd = make_tier_http_server(r)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            with urllib.request.urlopen(base + "/health",
+                                        timeout=10) as resp:
+                h = json.loads(resp.read())
+            assert h["ok"] and h["replicas_healthy"] == 2
+
+            req = urllib.request.Request(
+                base + "/generate",
+                data=json.dumps({"tokens": [1], "max_new": 2}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert out["replica"] in ("a", "b")
+
+            with urllib.request.urlopen(base + "/stats",
+                                        timeout=10) as resp:
+                stats = json.loads(resp.read())
+            assert stats["routed"] >= 1
+            assert stats["replicas_total"] == 2
+
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            assert "shellac_tier_routed_total" in text
+            assert "shellac_tier_replicas_healthy 2" in text
+        finally:
+            httpd.shutdown()
+            r.close()
+            a.close()
+            b.close()
+
+    def test_admin_drain_forwards_and_bleeds(self):
+        a, b = StubReplica("a"), StubReplica("b")
+        r = _mk_router([a, b])
+        httpd = make_tier_http_server(r)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            req = urllib.request.Request(
+                base + "/admin/drain",
+                data=json.dumps({"replica": a.url}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert out["state"] == "draining"
+            assert a.mode == "draining"      # the replica got the POST
+            for i in range(4):
+                _, body, _ = r.forward_json(
+                    "/generate", {"tokens": [i], "max_new": 2})
+                assert _replica_of(body) == "b"
+            # Resume through the same admin surface.
+            req = urllib.request.Request(
+                base + "/admin/drain",
+                data=json.dumps({"replica": a.url,
+                                 "resume": True}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert a.mode == "ok"
+        finally:
+            httpd.shutdown()
+            r.close()
+            a.close()
+            b.close()
+
+    def test_unroutable_tier_health_is_503_with_retry_after(self):
+        a = StubReplica("a")
+        a.mode = "recovering"
+        r = _mk_router([a])
+        httpd = make_tier_http_server(r)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            wait_until(lambda: not r.replicas[0].routable,
+                       msg="ejection")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/health", timeout=10)
+            assert e.value.code == 503
+            assert e.value.headers.get("Retry-After") is not None
+        finally:
+            httpd.shutdown()
+            r.close()
+            a.close()
+
+
+class TestChaosProxyWire:
+    """The chaos injectors themselves, against a stub — so the tier
+    chaos suite can trust its instruments."""
+
+    def test_refuse_and_unavailable_and_passthrough(self):
+        a = StubReplica("a")
+        proxy = ChaosProxy("127.0.0.1", a.url.rsplit(":", 1)[1])
+        r = _mk_router([StubProxyHandle(proxy)], default_timeout=5.0,
+                       breaker_cooldown=0.3)
+        try:
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1], "max_new": 2})
+            assert status == 200 and _replica_of(body) == "a"
+            proxy.unavailable()
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1], "max_new": 2,
+                              "timeout": 1.0})
+            # 502 (attempts exhausted fast) or 503 (the poller ejected
+            # the only replica before the first attempt landed).
+            assert status in (502, 503), status
+            proxy.pass_through()
+            # The poller ejected the replica while it 503'd; wait for
+            # the half-open probe to readmit it.
+            wait_until(lambda: r.replicas[0].routable, msg="readmit")
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1], "max_new": 2})
+            assert status == 200
+            proxy.refuse()
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1], "max_new": 2,
+                              "timeout": 1.0})
+            assert status in (502, 503), status
+        finally:
+            r.close()
+            proxy.close()
+            a.close()
+
+
+class StubProxyHandle:
+    """Adapter so _mk_router can take a ChaosProxy where it expects an
+    object with .url."""
+
+    def __init__(self, proxy):
+        self.url = proxy.url

@@ -1,0 +1,440 @@
+"""Tier-level chaos: the multi-replica serving tier under real
+failures — real engines, real processes, real SIGKILL.
+
+The acceptance scenarios (ISSUE 6 / docs/serving_tier.md):
+
+  - With 3 replicas under sustained load, SIGKILL-ing one replica
+    mid-stream causes ZERO failed non-streaming requests — every
+    affected request is retried within its deadline on the survivors —
+    while the severed stream itself fails LOUDLY (in-band,
+    retryable=false), and the router's breaker ejects the dead
+    replica. Asserted via the router's /metrics counters.
+  - A /drain of a second replica under load completes every in-flight
+    request (pending reaches 0 with zero sheds/faults) before the
+    replica stops reporting ready-to-exit state, while the router
+    bleeds traffic off it.
+  - A wedged replica (wire-level stall) is ejected by the health
+    breaker and readmitted by the half-open probe once released.
+
+Runs in the isolated fault-injection CI job (these tests kill
+subprocesses and stall sockets on purpose); the fast stub-level twin
+is tests/test_tier.py.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.chaos import (
+    ChaosProxy,
+    LoadGenerator,
+    ReplicaProc,
+)
+from shellac_tpu.inference.server import InferenceServer, make_http_server
+from shellac_tpu.inference.tier import (
+    TierRouter,
+    make_tier_http_server,
+)
+from shellac_tpu.models import transformer
+from shellac_tpu.obs import Registry
+
+
+def _tiny():
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+def wait_until(cond, timeout=60.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class _LocalReplica:
+    """In-process replica: a real tiny engine behind a real HTTP
+    server, with its own registry so per-replica /metrics stay
+    distinct inside one test process."""
+
+    def __init__(self, cfg, params, **srv_kw):
+        self.registry = Registry()
+        self.srv = InferenceServer(
+            cfg, params, registry=self.registry, n_slots=2, max_len=64,
+            temperature=0.0, **srv_kw,
+        )
+        self.httpd = make_http_server(self.srv)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.srv.close()
+
+
+@pytest.fixture(scope="module")
+def local_trio():
+    cfg = _tiny()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    reps = [_LocalReplica(cfg, params) for _ in range(3)]
+    # Warm every engine's compile before any chaos clock starts.
+    for rep in reps:
+        _post(rep.url + "/generate",
+              {"tokens": [1, 2, 3], "max_new": 2, "timeout": 300},
+              timeout=300)
+    yield reps
+    for rep in reps:
+        rep.close()
+
+
+def _router_over(urls, **kw):
+    kw.setdefault("registry", Registry())
+    kw.setdefault("health_interval", 0.1)
+    kw.setdefault("backoff_base", 0.02)
+    kw.setdefault("default_timeout", 60.0)
+    r = TierRouter(list(urls), **kw)
+    wait_until(lambda: all(x.state == "healthy" for x in r.replicas),
+               timeout=30, msg="replicas healthy")
+    return r
+
+
+class TestDrainUnderLoad:
+    def test_drain_completes_in_flight_with_zero_drops(self, local_trio):
+        router = _router_over([r.url for r in local_trio])
+        httpd = make_tier_http_server(router)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        target = local_trio[1]
+        lg = LoadGenerator(base, concurrency=3, timeout=60).start()
+        try:
+            wait_until(lambda: lg.total >= 6, timeout=60,
+                       msg="load warmed up")
+            out = router.drain_replica(target.url)
+            assert out["state"] == "draining"
+            # The drain completes IN-FLIGHT work: pending hits zero
+            # while the replica still reports draining (not-ready), so
+            # an operator who respects /health drops nothing by
+            # stopping it now.
+            wait_until(lambda: len(target.srv._pending) == 0,
+                       timeout=60, msg="in-flight drained")
+            h = _post(target.url + "/drain", {})  # idempotent snapshot
+            assert h["status"] == "draining" and h["pending"] == 0
+            assert target.srv.shed == 0
+            assert target.srv._fatal is None
+            # Router has bled traffic off: routed counters for the
+            # drained replica freeze while load continues.
+            reg = router._registry
+
+            def routed_to_target():
+                fam = reg._families.get("shellac_tier_routed_total")
+                return sum(
+                    int(inst.value)
+                    for key, inst in fam.series.items()
+                    if key[0] == target.url
+                )
+
+            time.sleep(0.5)  # let already-picked attempts settle
+            before, total_before = routed_to_target(), lg.total
+            wait_until(lambda: lg.total >= total_before + 6,
+                       timeout=60, msg="load continued")
+            assert routed_to_target() == before
+            # Nothing in flight was dropped anywhere: the tally is
+            # pure ok.
+            counts = lg.stop()
+            assert set(counts) == {"ok"}, counts
+            # Zero drops asserted on the replica too: every request it
+            # ever settled, it settled ok.
+            assert target.registry.value(
+                "shellac_requests_total", outcome="fault") in (None, 0)
+            assert target.registry.value(
+                "shellac_requests_total", outcome="shed") in (None, 0)
+            # Resume for the next test: traffic returns.
+            router.drain_replica(target.url, resume=True)
+            wait_until(
+                lambda: [x for x in router.replicas
+                         if x.url == target.url][0].state == "healthy",
+                timeout=30, msg="resume observed")
+        finally:
+            lg.stop()
+            httpd.shutdown()
+            router.close()
+
+    def test_draining_replica_rejects_with_retry_after(self, local_trio):
+        target = local_trio[2]
+        target.srv.drain()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(target.url + "/generate",
+                      {"tokens": [1], "max_new": 2}, timeout=30)
+            assert e.value.code == 503
+            ra = e.value.headers.get("Retry-After")
+            assert ra is not None and int(ra) >= 1
+            assert b"draining" in e.value.read()
+        finally:
+            target.srv.resume_admission()
+
+
+class TestWedgedReplica:
+    def test_stalled_replica_ejected_then_readmitted(self, local_trio):
+        # Route one replica through a wire-level stall: health checks
+        # time out, the breaker trips, traffic fails over; releasing
+        # the stall lets the half-open probe readmit it.
+        victim = local_trio[0]
+        survivor = local_trio[1]
+        proxy = ChaosProxy("127.0.0.1", victim.url.rsplit(":", 1)[1])
+        router = _router_over(
+            [proxy.url, survivor.url],
+            health_timeout=0.5, breaker_cooldown=0.5,
+        )
+        httpd = make_tier_http_server(router)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            proxy.stall()
+            wait_until(
+                lambda: [x for x in router.replicas
+                         if x.url == proxy.url][0].state == "ejected",
+                timeout=30, msg="wedged replica ejected")
+            # Tier keeps serving from the survivor.
+            for i in range(4):
+                out = _post(base + "/generate",
+                            {"tokens": [i + 1], "max_new": 2,
+                             "timeout": 60})
+                assert out["tokens"]
+            reg = router._registry
+            assert reg.value("shellac_tier_ejections_total",
+                             replica=proxy.url) >= 1
+            proxy.release_stalls()
+            proxy.pass_through()
+            wait_until(
+                lambda: [x for x in router.replicas
+                         if x.url == proxy.url][0].state == "healthy",
+                timeout=30, msg="readmission")
+            assert reg.value("shellac_tier_readmissions_total",
+                             replica=proxy.url) >= 1
+        finally:
+            proxy.release_stalls()
+            httpd.shutdown()
+            router.close()
+            proxy.close()
+
+
+class TestKillReplicaAcceptance:
+    """The ISSUE acceptance scenario, end to end with real processes:
+    3 CLI-served replicas, sustained load, SIGKILL one mid-stream,
+    then drain a second under the same load."""
+
+    @pytest.fixture(scope="class")
+    def config_path(self, tmp_path_factory):
+        p = tmp_path_factory.mktemp("tier") / "tiny_f32.json"
+        p.write_text(json.dumps({"preset": "tiny", "dtype": "float32"}))
+        return str(p)
+
+    def test_sigkill_mid_stream_zero_failed_requests_then_drain(
+            self, config_path):
+        procs = [
+            ReplicaProc(config_path=config_path, seed=i, slots=4,
+                        max_len=96)
+            for i in range(3)
+        ]
+        router = None
+        httpd = None
+        lg = None
+        try:
+            for p in procs:
+                p.wait_ready(timeout=180)
+            # Warm each engine's compile directly, outside any clock.
+            for p in procs:
+                _post(p.url + "/generate",
+                      {"tokens": [1, 2, 3], "max_new": 2,
+                       "timeout": 300}, timeout=300)
+            registry = Registry()
+            router = TierRouter(
+                [p.url for p in procs], registry=registry,
+                health_interval=0.2, health_timeout=2.0,
+                breaker_cooldown=2.0, backoff_base=0.05,
+                default_timeout=30.0,
+                # Pin affinity hard so the chosen session's stream
+                # lands on the victim deterministically.
+                affinity_tolerance=100.0,
+            )
+            wait_until(lambda: all(x.state == "healthy"
+                                   for x in router.replicas),
+                       timeout=60, msg="all replicas healthy")
+            httpd = make_tier_http_server(router)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+            victim = procs[0]
+
+            # Session keys that rendezvous-hash onto chosen replicas:
+            # one load worker pinned per replica (so the kill lands on
+            # traffic actually in flight there), plus the stream's key
+            # on the victim.
+            def session_for(url):
+                return next(
+                    f"k{i}" for i in range(1000)
+                    if max((p.url for p in procs),
+                           key=lambda u: TierRouter._rendezvous(
+                               f"s:k{i}", u.rstrip("/"))) == url
+                )
+
+            session = session_for(victim.url)
+            lg = LoadGenerator(
+                base, concurrency=4, timeout=30,
+                payloads=[
+                    {"tokens": [1 + i, 2, 3], "max_new": 6,
+                     "session": session_for(p.url)}
+                    for i, p in enumerate(procs)
+                ],
+            ).start()
+            wait_until(lambda: lg.total >= 8, timeout=120,
+                       msg="sustained load flowing")
+
+            # --- kill mid-stream -------------------------------------
+            stream_lines = []
+            first_delta = threading.Event()
+            stream_done = threading.Event()
+
+            def stream_client():
+                req = urllib.request.Request(
+                    base + "/generate",
+                    data=json.dumps({
+                        "tokens": [5, 6, 7], "max_new": 80,
+                        "stream": True, "session": session,
+                        "timeout": 60,
+                    }).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=90) as r:
+                        for raw in r:
+                            if raw.strip():
+                                stream_lines.append(json.loads(raw))
+                                first_delta.set()
+                except OSError:
+                    pass  # severed sockets are acceptable shapes too
+                finally:
+                    first_delta.set()
+                    stream_done.set()
+
+            t = threading.Thread(target=stream_client, daemon=True)
+            t.start()
+            assert first_delta.wait(90), "stream never started"
+            assert not stream_done.is_set() or stream_lines, \
+                "stream ended before the kill could land"
+            victim.kill()  # SIGKILL: no drain, no goodbye
+            assert stream_done.wait(120), "stream never terminated"
+            # The severed stream fails LOUDLY: no done record, and
+            # when the relay could still write, an in-band
+            # non-retryable error.
+            assert not any(l.get("done") for l in stream_lines), \
+                stream_lines
+            errs = [l for l in stream_lines if "error" in l]
+            if errs:
+                assert errs[-1]["error"]["retryable"] is False
+
+            # Health breaker ejects the dead replica.
+            wait_until(
+                lambda: [x for x in router.replicas
+                         if x.url == victim.url][0].state == "ejected",
+                timeout=30, msg="dead replica ejected")
+
+            # Load keeps flowing on the survivors.
+            settled = lg.total
+            wait_until(lambda: lg.total >= settled + 8, timeout=120,
+                       msg="load flowing on survivors")
+
+            # --- drain a second replica under the same load ----------
+            drained = procs[1]
+            out = router.drain_replica(drained.url)
+            assert out["state"] == "draining"
+
+            def drained_health():
+                try:
+                    with urllib.request.urlopen(
+                            drained.url + "/health", timeout=5) as r:
+                        return None
+                except urllib.error.HTTPError as e:
+                    return json.loads(e.read())
+
+            # Every in-flight request completes (pending -> 0) while
+            # the replica still reports not-ready ("draining").
+            wait_until(
+                lambda: (lambda h: h is not None
+                         and h["status"] == "draining"
+                         and h["pending"] == 0)(drained_health()),
+                timeout=90, msg="drain completed in-flight work")
+            h = drained_health()
+            assert h["shed"] == 0, h
+
+            # Router bled traffic off: routed counters for the drained
+            # replica freeze while load continues.
+            def routed_to(url):
+                fam = registry._families.get("shellac_tier_routed_total")
+                return sum(int(inst.value)
+                           for key, inst in fam.series.items()
+                           if key[0] == url)
+
+            time.sleep(0.5)  # let already-picked attempts settle
+            before, total_before = routed_to(drained.url), lg.total
+            wait_until(lambda: lg.total >= total_before + 6,
+                       timeout=120, msg="load continued post-drain")
+            assert routed_to(drained.url) == before
+
+            counts = lg.stop()
+            lg = None
+            # THE acceptance bar: zero failed non-streaming requests —
+            # every request the kill or the drain touched was retried
+            # within its deadline on a surviving replica.
+            assert set(counts) == {"ok"}, counts
+
+            # And the same, asserted via the router's /metrics.
+            text = router.metrics_text()
+            assert 'shellac_tier_requests_total{outcome="ok"}' in text
+            for bad in ('outcome="failed"', 'outcome="deadline"',
+                        'outcome="rejected"'):
+                assert bad not in text, text
+            assert registry.value("shellac_tier_ejections_total",
+                                  replica=victim.url) >= 1
+            retries = sum(
+                int(i.value) for i in registry._families[
+                    "shellac_tier_retries_total"].series.values()
+            )
+            assert retries >= 1
+        finally:
+            if lg is not None:
+                lg.stop()
+            if httpd is not None:
+                httpd.shutdown()
+            if router is not None:
+                router.close()
+            for p in procs:
+                p.terminate()
+
+
+# The subprocess scenario needs a POSIX SIGKILL; everything above it
+# runs anywhere the stdlib HTTP stack does.
+pytestmark = pytest.mark.skipif(
+    os.name != "posix", reason="chaos harness needs POSIX signals"
+)
