@@ -38,7 +38,7 @@ import numpy as np
 
 def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
                  decode_ticks=1, kv_quant=None, rolling=False,
-                 registry=None):
+                 registry=None, overlap=False):
     from shellac_tpu.inference.batching import (
         BatchingEngine,
         PagedBatchingEngine,
@@ -53,23 +53,24 @@ def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
             cfg, params, n_slots=n_slots, max_len=max_len,
             block_size=64, pool_tokens=n_slots * max_len,
             temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
-            kv_quant=kv_quant, registry=registry,
+            kv_quant=kv_quant, registry=registry, overlap_decode=overlap,
         )
     return BatchingEngine(
         cfg, params, n_slots=n_slots, max_len=max_len,
         temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
         kv_quant=kv_quant, rolling_window=rolling, registry=registry,
+        overlap_decode=overlap,
     )
 
 
 def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
                  ticks, rng, decode_ticks=1, kv_quant=None,
-                 rolling=False, registry=None):
+                 rolling=False, registry=None, overlap=False):
     """Decode tokens/s with every slot held live at ~ctx context."""
     eng = build_engine(
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
         max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
-        rolling=rolling, registry=registry,
+        rolling=rolling, registry=registry, overlap=overlap,
     )
     budget = max_len - ctx - 1
     need = (2 + ticks) * decode_ticks
@@ -105,30 +106,56 @@ def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
 
 
 def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
-          rolling=False, decode_ticks=1, kv_quant=None, registry=None):
-    """Drain 3*n_slots ragged requests; tokens/s of generated tokens.
+          rolling=False, decode_ticks=1, kv_quant=None, registry=None,
+          overlap=False, device_latency=0.0, host_latency=0.0,
+          n_req=None, gen_budget=None):
+    """Drain ragged requests (default 3*n_slots); tokens/s generated.
 
     Each request carries an obs RequestTrace, so the drain leaves
     TTFT / TPOT / queue-wait DISTRIBUTIONS in `registry` for the
     output JSON — a server-shaped workload measured the way the
-    server reports it, not just a mean."""
+    server reports it, not just a mean.
+
+    device_latency/host_latency (seconds) arm the simulated-RPC
+    harness: the SimulatedHostLatency shim stretches each decode
+    window's availability clock by device_latency (a relay-attached
+    device), and host_latency is slept per drained step (stand-in for
+    the serving layer's detokenize/stream/HTTP work between windows).
+    With them a CPU box reproduces the host-RPC-bound regime
+    BENCH_DECODE measured on hardware — the regime overlapped
+    dispatch exists for."""
     from shellac_tpu.obs import ServeMetrics, get_registry
 
     eng = build_engine(
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
         max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
-        rolling=rolling, registry=registry,
+        rolling=rolling, registry=registry, overlap=overlap,
     )
+    shim = None
+    if device_latency > 0:
+        from shellac_tpu.inference.autotune import SimulatedHostLatency
+
+        shim = SimulatedHostLatency(eng, device_s=device_latency)
     sm = ServeMetrics(registry if registry is not None else get_registry())
-    n_req = 3 * n_slots
-    gen_budget = min(64, max(4, (max_len - ctx) // 2))
+    if n_req is None:
+        n_req = 3 * n_slots
+    if gen_budget is None:
+        gen_budget = min(64, max(4, (max_len - ctx) // 2))
     reqs = []
     for i in range(n_req):
         plen = int(rng.integers(max(8, ctx // 2), ctx + 1))
         prompt = rng.integers(0, cfg.vocab_size, size=plen, dtype=np.int64)
         reqs.append((i, prompt, int(rng.integers(gen_budget // 2, gen_budget + 1))))
-    # Warm the prefill buckets + decode program outside the timed region.
-    eng.submit("warm", reqs[0][1], max_new=2)
+    # Warm the prefill buckets + decode program outside the timed
+    # region. Prompt lengths span [ctx/2, ctx] — up to two power-of-two
+    # pad buckets — and an unwarmed bucket would put its prefill
+    # compile INSIDE the measurement (the gate's latency-dominated runs
+    # are short enough for one compile to swamp the ratio).
+    for wi, wlen in enumerate({max(8, ctx // 2), ctx}):
+        eng.submit(("warm", wi), reqs[0][1][:wlen] if wlen <= len(reqs[0][1])
+                   else rng.integers(0, cfg.vocab_size, size=wlen,
+                                     dtype=np.int64),
+                   max_new=2)
     while eng.pending:
         eng.step()
     t0 = time.perf_counter()
@@ -141,7 +168,11 @@ def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
         for rid, out in eng.step():
             traces[rid].finish(len(out))
             results[rid] = out
+        if host_latency > 0:
+            time.sleep(host_latency)
     dt = time.perf_counter() - t0
+    if shim is not None:
+        shim.uninstall()
     total = sum(len(v) for v in results.values())
     assert len(results) == n_req
     return total / dt, total
@@ -330,6 +361,144 @@ def beam_bench(cfg, params, *, ctx, max_len, rng, num_beams=4,
     return out
 
 
+def gate(cfg, params, args, backend):
+    """CI perf regression gate: the overlapped-decode churn benchmark
+    under the simulated dispatch-latency harness, judged against a
+    committed baseline.
+
+    The harness (sleep-injected RPC shim; see churn()) makes the run
+    latency-dominated, so absolute churn tokens/s is reproducible
+    across CI machines to well under the gate's 15% tolerance — model
+    compute is a small additive term. Two checks, both machine-
+    readable in the emitted summary:
+
+      1. overlapped churn tokens/s >= (1 - tolerance) * baseline —
+         perf can no longer silently rot between hardware windows
+         (pinning decode_ticks to a pessimal value, breaking the
+         auto-tuner, or breaking overlap all fail this);
+      2. overlap speedup vs the strict-ordering run of the SAME
+         invocation >= the committed floor (1.5x) — the pipeline must
+         actually hide the injected host/RPC time.
+
+    --write-gate-baseline re-baselines (run it when the gate workload
+    itself changes, and commit the JSON with the change that moved
+    it)."""
+    from shellac_tpu.inference.autotune import (
+        SimulatedHostLatency,
+        autotune_decode_ticks,
+    )
+
+    device_s = args.device_latency_ms / 1e3
+    host_s = args.host_latency_ms / 1e3
+    max_len = ((args.ctx + max(64, args.ctx // 4)) + 511) // 512 * 512
+
+    # decode_ticks: auto-tuned against the simulated environment
+    # (exactly what serve --decode-ticks auto does against the live
+    # mesh), unless pinned via --decode-ticks — the pessimal-pin CI
+    # check uses that to prove the gate actually fails.
+    if args.decode_ticks == "auto":
+        eng = build_engine(
+            cfg, params, paged=False, impl="ref", n_slots=args.slots,
+            max_len=max_len, decode_ticks="auto", overlap=True,
+        )
+        shim = SimulatedHostLatency(eng, device_s=device_s)
+        # Candidates stop at 4: on a CPU "device" the real model
+        # compute scales with K and is paid inline at dispatch, so an
+        # unbounded sweep walks into compute-bound windows that the
+        # injected latency no longer dominates — the opposite of the
+        # relay regime the gate simulates. Keeping real compute well
+        # under the injected latencies is also what makes the
+        # committed baseline transfer across CI machines.
+        tune = autotune_decode_ticks(eng, candidates=(1, 2, 4),
+                                     probe_windows=2)
+        shim.uninstall()
+        ticks = tune.best
+        tuned = {str(k): round(v, 1) for k, v in tune.measurements.items()}
+    else:
+        ticks = int(args.decode_ticks)
+        tuned = None
+
+    rates = {}
+    for overlap in (True, False):
+        rng = np.random.default_rng(0)
+        tok_s, total = churn(
+            cfg, params, paged=False, impl="ref", n_slots=args.slots,
+            ctx=args.ctx, max_len=max_len, rng=rng, decode_ticks=ticks,
+            overlap=overlap, device_latency=device_s,
+            host_latency=host_s, n_req=2 * args.slots,
+            # Requests live ~6 windows: the steady-serving regime
+            # overlap targets. Sub-2-window budgets make slot turnover
+            # (admissions join at window boundaries; a finished slot's
+            # stale window is garbage) dominate and under-measure the
+            # pipeline — that trade-off is documented in
+            # docs/decode_performance.md, not hidden in the gate.
+            gen_budget=max(12 * ticks, 32),
+        )
+        rates[overlap] = tok_s
+    speedup = rates[True] / max(rates[False], 1e-9)
+
+    summary = {
+        "metric": f"decode_gate_{args.model}_{backend}",
+        "churn_tokens_s": round(rates[True], 1),
+        "serial_tokens_s": round(rates[False], 1),
+        "overlap_speedup": round(speedup, 3),
+        "decode_ticks": ticks,
+        "autotune": tuned,
+        "params": {
+            "slots": args.slots, "ctx": args.ctx,
+            "device_latency_ms": args.device_latency_ms,
+            "host_latency_ms": args.host_latency_ms,
+        },
+    }
+
+    if args.write_gate_baseline:
+        baseline = {
+            "churn_tokens_s": summary["churn_tokens_s"],
+            "overlap_speedup_floor": 1.5,
+            "tolerance": 0.15,
+            "params": summary["params"],
+        }
+        with open(args.gate_baseline, "w") as f:
+            json.dump(baseline, f, indent=1)
+            f.write("\n")
+        summary["baseline_written"] = args.gate_baseline
+        print(json.dumps(summary), flush=True)
+        return 0
+
+    try:
+        with open(args.gate_baseline) as f:
+            baseline = json.load(f)
+    except FileNotFoundError:
+        print(json.dumps({**summary, "gate": "fail",
+                          "error": f"no baseline {args.gate_baseline}; "
+                                   "run --write-gate-baseline"}))
+        return 1
+    if baseline.get("params") != summary["params"]:
+        print(json.dumps({**summary, "gate": "fail",
+                          "error": "gate params drifted from baseline; "
+                                   "re-baseline with "
+                                   "--write-gate-baseline"}))
+        return 1
+    tol = float(baseline.get("tolerance", 0.15))
+    floor = float(baseline.get("overlap_speedup_floor", 1.5))
+    need = baseline["churn_tokens_s"] * (1.0 - tol)
+    failures = []
+    if rates[True] < need:
+        failures.append(
+            f"churn tokens/s {rates[True]:.1f} < {need:.1f} "
+            f"(baseline {baseline['churn_tokens_s']} - {tol:.0%})"
+        )
+    if speedup < floor:
+        failures.append(
+            f"overlap speedup {speedup:.2f}x < required {floor}x"
+        )
+    summary["gate"] = "fail" if failures else "pass"
+    if failures:
+        summary["failures"] = failures
+    print(json.dumps(summary), flush=True)
+    return 1 if failures else 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None, help="preset (default: auto)")
@@ -341,10 +510,35 @@ def main():
     ap.add_argument("--kernel-rounds", type=int, default=8,
                     help="interleaved A/B timing rounds per variant "
                          "(result = per-variant min)")
-    ap.add_argument("--decode-ticks", type=int, default=1,
-                    help="engine mode: decode steps per host sync")
+    ap.add_argument("--decode-ticks", default=None,
+                    help="engine mode: decode steps per host sync "
+                         "(int, default 1; gate mode also accepts "
+                         "'auto', its default, to run the startup "
+                         "sweep)")
     ap.add_argument("--mode", default="engine",
                     choices=["engine", "kernel", "prefix", "beam"])
+    ap.add_argument("--overlap", action="store_true",
+                    help="engine mode: overlapped window dispatch")
+    ap.add_argument("--device-latency-ms", type=float, default=0.0,
+                    dest="device_latency_ms",
+                    help="simulated per-window device/RPC latency "
+                         "(sleep-injected shim; gate default 80)")
+    ap.add_argument("--host-latency-ms", type=float, default=0.0,
+                    dest="host_latency_ms",
+                    help="simulated per-step host work "
+                         "(gate default 60)")
+    ap.add_argument("--gate", action="store_true",
+                    help="CI perf regression gate: overlapped churn "
+                         "under the simulated-latency harness vs the "
+                         "committed baseline (exit 1 on regression)")
+    ap.add_argument("--gate-baseline", default=None,
+                    dest="gate_baseline",
+                    help="baseline JSON path (default: BENCH_GATE.json "
+                         "next to the repo root)")
+    ap.add_argument("--write-gate-baseline", action="store_true",
+                    dest="write_gate_baseline",
+                    help="measure and (over)write the gate baseline "
+                         "instead of judging against it")
     ap.add_argument("--variants", default="dense:auto,dense:ref,paged:auto,paged:ref")
     ap.add_argument("--kv-quant", choices=["int8"],
                     help="int8 KV cache on the dense engine variants")
@@ -389,6 +583,40 @@ def main():
     from shellac_tpu.models import transformer
 
     backend = jax.default_backend()
+    if args.gate:
+        # Gate defaults: a fixed, latency-dominated workload so the
+        # committed baseline transfers across CI machines.
+        if args.model is None:
+            args.model = "tiny"
+        args.ctx = min(args.ctx, 64)
+        args.slots = min(args.slots, 4)
+        if args.decode_ticks is None:  # unset -> gate default: sweep.
+            # An explicit "--decode-ticks 1" stays pinned (the CI
+            # pessimal self-test depends on the distinction).
+            args.decode_ticks = "auto"
+        # Injected latencies are deliberately LARGE relative to the
+        # tiny model's real compute (~30-100 ms per 4-tick window,
+        # machine-dependent): the overlapped run's period then pins at
+        # the device latency — near-constant tokens/s across CI
+        # machines and load spikes — while the serial run pays
+        # device + host serially. Real compute only perturbs the
+        # serial number, well inside the 15% tolerance.
+        if not args.device_latency_ms:
+            args.device_latency_ms = 400.0
+        if not args.host_latency_ms:
+            args.host_latency_ms = 250.0
+        if args.gate_baseline is None:
+            args.gate_baseline = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "BENCH_GATE.json",
+            )
+        cfg = get_model_config(args.model)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        raise SystemExit(gate(cfg, params, args, backend))
+    if args.decode_ticks == "auto":
+        raise SystemExit("--decode-ticks auto is gate-mode only here; "
+                         "pass an int for engine mode")
+    args.decode_ticks = int(args.decode_ticks or 1)
     if args.model is None:
         args.model = "shellac-1b" if backend == "tpu" else "tiny"
         if backend != "tpu":
@@ -497,13 +725,15 @@ def main():
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, ticks=args.ticks, rng=rng,
             decode_ticks=args.decode_ticks, kv_quant=kvq, rolling=rolling,
-            registry=reg,
+            registry=reg, overlap=args.overlap,
         )
         churn_tok_s, churn_total = churn(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, rng=rng,
             decode_ticks=args.decode_ticks, kv_quant=kvq, rolling=rolling,
-            registry=reg,
+            registry=reg, overlap=args.overlap,
+            device_latency=args.device_latency_ms / 1e3,
+            host_latency=args.host_latency_ms / 1e3,
         )
         row = {
             "metric": f"decode_throughput_{args.model}_ctx{args.ctx}_"
@@ -517,6 +747,7 @@ def main():
                 "churn_tokens": churn_total,
                 "n_slots": args.slots,
                 "decode_ticks": args.decode_ticks,
+                "overlap_decode": args.overlap,
                 "metrics": reg.snapshot(),
             },
         }

@@ -176,8 +176,8 @@ class HostSync(Rule):
     name = "host-sync"
     summary = (
         "host-device synchronization (.item(), np.asarray, device_get, "
-        "block_until_ready) inside a jit-traced function or a per-token "
-        "decode loop"
+        "block_until_ready) inside a jit-traced function, a per-token "
+        "decode loop, or the serving engines' decode-window call-path"
     )
 
     _SYNC_METHODS = {"item", "block_until_ready"}
@@ -186,16 +186,79 @@ class HostSync(Rule):
         "jax.device_get",
     }
     _LOOP_SEGMENTS = {"decode", "tick"}
+    #: Entry points of an Engine class's host-side hot loop: every
+    #: method module-locally reachable from these (through self.* /
+    #: bare-name calls) is "the decode window call-path". Any sync
+    #: there — loop or not — is a per-window or per-admission host
+    #: round trip and must be the ONE designed sync or carry a
+    #: suppression with its rationale.
+    _PATH_ROOTS = {"step", "_decode_tokens"}
+    #: Unambiguous sync calls for the call-path scope. np.asarray/
+    #: np.array are deliberately excluded here: on the host side of an
+    #: engine they overwhelmingly wrap host data (prompt copies, bias
+    #: rows), and AST cannot see the operand's device-ness — keep the
+    #: call-path check high-signal.
+    _PATH_CHAINS = {"jax.device_get"}
 
     def _sync_call(self, call: ast.Call) -> Optional[str]:
         chain = _chain(call.func)
         if chain in self._SYNC_CHAINS:
             return chain
+        return self._method_sync(call)
+
+    def _method_sync(self, call: ast.Call) -> Optional[str]:
         if (isinstance(call.func, ast.Attribute)
                 and call.func.attr in self._SYNC_METHODS
                 and not call.args and not call.keywords):
             return f".{call.func.attr}()"
         return None
+
+    def _path_sync_call(self, call: ast.Call) -> Optional[str]:
+        chain = _chain(call.func)
+        if chain in self._PATH_CHAINS:
+            return chain
+        return self._method_sync(call)
+
+    def _engine_path_methods(self, tree: ast.AST, traced):
+        """Per Engine-named class: the set of its (module-locally
+        resolvable, MRO-merged) methods reachable from the hot-loop
+        roots. Base-class methods defined in the same module are
+        merged under the subclass pass, override-wins, so a subclass
+        hook called from an inherited step() is still on the path."""
+        classes = {
+            n.name: n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+        }
+
+        def merged_methods(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+            out: Dict[str, ast.FunctionDef] = {}
+            for base in cls.bases:
+                name = _chain(base)
+                if name in classes and classes[name] is not cls:
+                    out.update(merged_methods(classes[name]))
+            for node in cls.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[node.name] = node
+            return out
+
+        for cls in classes.values():
+            if "Engine" not in cls.name:
+                continue
+            methods = merged_methods(cls)
+            stack = [methods[r] for r in self._PATH_ROOTS if r in methods]
+            reach: Set[ast.FunctionDef] = set()
+            while stack:
+                fn = stack.pop()
+                if fn in reach or fn in traced:
+                    # Traced defs are the jitted programs — pass (a)
+                    # covers those.
+                    continue
+                reach.add(fn)
+                for call in _iter_calls(fn):
+                    for name in _callable_names(call.func):
+                        callee = methods.get(name)
+                        if callee is not None and callee not in reach:
+                            stack.append(callee)
+            yield cls, reach
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         traced = traced_defs(ctx.tree)
@@ -233,6 +296,26 @@ class HostSync(Rule):
                             f"{what} inside a loop of decode-path "
                             f"{node.name!r} syncs the host every "
                             "iteration of the token hot loop",
+                        )
+        # The serving decode-window call-path: any reachable sync —
+        # loop or not — is a per-window/per-admission round trip.
+        # History: the per-prefill top-logprobs pull hid here for two
+        # rounds because the loop heuristic above could not see it.
+        for cls, reach in self._engine_path_methods(ctx.tree, traced):
+            for fn in reach:
+                for call in _iter_calls(fn):
+                    what = self._path_sync_call(call)
+                    key = (call.lineno, call.col_offset)
+                    if what and key not in seen:
+                        seen.add(key)
+                        yield self.finding(
+                            ctx, call,
+                            f"{what} in {fn.name!r}, on "
+                            f"{cls.name}'s decode-window call-path — "
+                            "every occurrence is a host round trip "
+                            "per window/admission; batch it into the "
+                            "window's one packed sync or suppress "
+                            "with the design rationale",
                         )
 
 

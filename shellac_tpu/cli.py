@@ -25,6 +25,18 @@ import sys
 import numpy as np
 
 
+def _decode_ticks_arg(v: str):
+    """--decode-ticks parser: an int >= 1, or 'auto' (startup sweep)."""
+    if v == "auto":
+        return v
+    try:
+        return int(v)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--decode-ticks wants an integer or 'auto', got {v!r}"
+        )
+
+
 def _model_config(args):
     from shellac_tpu.config import ModelConfig
     from shellac_tpu.models.registry import PRESETS
@@ -692,10 +704,16 @@ def cmd_batch(args):
         cfg, params, n_slots=args.slots,
         max_len=args.max_len or cfg.max_seq_len,
         temperature=args.temperature, eos_id=args.eos_id,
-        decode_ticks=args.decode_ticks, mesh=mesh, seed=args.seed,
+        decode_ticks=args.decode_ticks,
+        overlap_decode=args.overlap_decode,
+        mesh=mesh, seed=args.seed,
         kv_quant=args.kv_quant, rolling_window=args.rolling_window,
         logprobs=args.logprobs,
     )
+    if args.decode_ticks == "auto":
+        from shellac_tpu.inference.autotune import maybe_autotune
+
+        maybe_autotune(eng, log=lambda m: print(m, file=sys.stderr))
 
     rows = []
     with open(args.input) as f:
@@ -778,9 +796,21 @@ def cmd_serve(args):
     if args.draft_model and args.paged:
         raise SystemExit("--draft-model (speculative) requires a dense "
                          "cache; drop --paged")
-    if args.draft_model and args.decode_ticks != 1:
+    if args.draft_model and args.decode_ticks not in (1, "auto"):
         raise SystemExit("--draft-model already emits up to gamma+1 tokens "
                          "per step; --decode-ticks must stay 1")
+    if args.overlap_decode is None:
+        # Default: overlap on — except speculative serving, where the
+        # verify round's acceptance counts gate the next round, so a
+        # draft-model serve silently keeps strict ordering instead of
+        # refusing a previously working invocation.
+        args.overlap_decode = not args.draft_model
+    elif args.draft_model and args.overlap_decode:
+        raise SystemExit(
+            "--overlap-decode does not compose with --draft-model (the "
+            "verify round's acceptance counts gate the next round); use "
+            "--no-overlap-decode"
+        )
     if args.kv_quant and args.draft_model:
         raise SystemExit("--kv-quant does not compose with --draft-model")
     if args.rolling_window and (args.paged or args.draft_model):
@@ -897,6 +927,7 @@ def cmd_serve(args):
                 max_len=args.max_len or cfg.max_seq_len,
                 temperature=args.temperature, eos_id=args.eos_id,
                 decode_ticks=args.decode_ticks,
+                overlap_decode=args.overlap_decode,
                 max_prefills_per_step=args.max_prefills_per_step,
                 prefill_chunk=args.prefill_chunk,
                 logprobs=args.logprobs,
@@ -935,6 +966,8 @@ def cmd_serve(args):
         n_slots=args.slots, max_len=args.max_len,
         temperature=args.temperature, eos_id=args.eos_id,
         decode_ticks=args.decode_ticks,
+        overlap_decode=args.overlap_decode,
+        autotune=True,
         max_prefills_per_step=args.max_prefills_per_step,
         prefill_chunk=args.prefill_chunk,
         logprobs=args.logprobs,
@@ -1224,8 +1257,13 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--max-len", type=int, default=None, dest="max_len")
     b.add_argument("--temperature", type=float, default=0.0)
     b.add_argument("--eos-id", type=int, default=None, dest="eos_id")
-    b.add_argument("--decode-ticks", type=int, default=4,
-                   dest="decode_ticks")
+    b.add_argument("--decode-ticks", type=_decode_ticks_arg, default=4,
+                   dest="decode_ticks",
+                   help="decode steps per host sync, or 'auto' to "
+                        "sweep before the drain")
+    b.add_argument("--overlap-decode", dest="overlap_decode",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="overlapped window dispatch during the drain")
     b.add_argument("--mesh", default="", help="e.g. tp=4")
     b.add_argument("--kv-quant", choices=["int8"], default=None,
                    dest="kv_quant")
@@ -1268,9 +1306,19 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--prefix-cache", action="store_true", dest="prefix_cache",
                    help="reuse cached KV blocks across prompts sharing a "
                         "prefix (requires --paged)")
-    s.add_argument("--decode-ticks", type=int, default=1, dest="decode_ticks",
+    s.add_argument("--decode-ticks", type=_decode_ticks_arg,
+                   default="auto", dest="decode_ticks",
                    help="decode steps per host sync (throughput vs "
-                        "per-token latency)")
+                        "per-token latency): an int, or 'auto' (the "
+                        "default) to sweep candidates against the live "
+                        "mesh at startup and keep the fastest")
+    s.add_argument("--overlap-decode", dest="overlap_decode",
+                   action=argparse.BooleanOptionalAction, default=None,
+                   help="two-deep decode pipeline: dispatch window k+1 "
+                        "while the host settles window k (greedy and "
+                        "seeded outputs are token-identical either "
+                        "way; --no-overlap-decode restores strict "
+                        "ordering; default on, off for --draft-model)")
     s.add_argument("--pp-pipeline", action="store_true",
                    dest="pp_pipeline",
                    help="token-level pipelined decode on a pp mesh: "

@@ -1,0 +1,238 @@
+"""Startup auto-tuning of the decode window length, and the simulated
+host-latency harness that lets CPU CI reproduce the relay-bound regime.
+
+BENCH_DECODE measured the serving engine at ~88 ms/tick with ~2 ms of
+device work: the tick is host-RPC-bound, so `decode_ticks` (K decode
+steps per host sync) is the highest-leverage knob — and its best value
+depends entirely on where the host sits relative to the device (local
+CPU: 1-2; a relay-attached TPU: 8+). TACCL's lesson (PAPERS.md) applies:
+treat the schedule parameter as a first-class searchable object, not a
+constant. `autotune_decode_ticks` runs the bench_decode sweep's core —
+probe requests through the LIVE engine at each candidate K, measured
+wall-clock — once at serving startup, writes the winner back, and
+restores the engine to its pre-probe state (PRNG key included) so a
+seeded deployment stays reproducible.
+
+`SimulatedHostLatency` is the sleep-injected RPC shim the perf
+regression gate runs on CPU: it models a remote device whose window
+results become available `device_s` after dispatch and whose dispatch
+RPC blocks the host for `dispatch_s`, using the engine's window hooks —
+the real pipeline runs underneath, only the clock is shaped. With it,
+overlapped dispatch shows the same ~max(host, device) vs host+device
+win on a laptop CPU that it shows against the relay.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: Candidate window lengths swept by default: the bench_decode sweep's
+#: range, capped where per-token latency jitter starts to hurt serving.
+DEFAULT_CANDIDATES: Tuple[int, ...] = (1, 2, 4, 8, 16)
+
+
+@dataclass
+class AutotuneResult:
+    """One decode_ticks sweep: the winner and the per-candidate
+    evidence (tokens/s as measured, wall seconds of the timed region)."""
+
+    best: int
+    measurements: Dict[int, float] = field(default_factory=dict)  # K -> tok/s
+    elapsed: Dict[int, float] = field(default_factory=dict)  # K -> seconds
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "decode_ticks": self.best,
+            "candidates": {
+                str(k): round(v, 1) for k, v in self.measurements.items()
+            },
+        }
+
+
+class SimulatedHostLatency:
+    """Shape an engine's decode-window clock like a remote device.
+
+    Installed via the engine's `_window_hooks` seam:
+
+      - `on_dispatch(window)`: sleeps `dispatch_s` (a host-blocking
+        submit RPC) and stamps when the window's results will be
+        "ready" (`device_s` after dispatch — the simulated device/fetch
+        round trip).
+      - `before_sync(window)`: sleeps out whatever of `device_s` the
+        host has not already spent elsewhere — exactly the wait a real
+        device_get would block for.
+
+    The real jitted programs still run (their CPU time happens inside
+    the window span, like real device time); only the availability
+    clock is stretched. Overlapped dispatch hides host work inside
+    `device_s`; strict ordering pays host + device serially — the
+    measurable contrast the perf gate asserts on.
+    """
+
+    def __init__(self, engine, *, device_s: float = 0.0,
+                 dispatch_s: float = 0.0):
+        self.engine = engine
+        self.device_s = float(device_s)
+        self.dispatch_s = float(dispatch_s)
+        self._ready: Dict[int, float] = {}
+        engine._window_hooks = self
+
+    def on_dispatch(self, window) -> None:
+        if self.dispatch_s:
+            time.sleep(self.dispatch_s)
+        self._ready[id(window)] = time.monotonic() + self.device_s
+
+    def before_sync(self, window) -> None:
+        ready = self._ready.pop(id(window), None)
+        if ready is not None:
+            delay = ready - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
+    def uninstall(self) -> None:
+        if self.engine._window_hooks is self:
+            self.engine._window_hooks = None
+        self._ready.clear()
+
+
+def autotune_decode_ticks(
+    engine,
+    *,
+    candidates: Sequence[int] = DEFAULT_CANDIDATES,
+    probe_windows: int = 3,
+    prompt_len: int = 32,
+    timer: Callable[[], float] = time.perf_counter,
+) -> AutotuneResult:
+    """Measure churn tokens/s at each candidate decode_ticks on the
+    LIVE engine (its mesh, its compiled model, its real dispatch path)
+    and write the winner back via `engine.set_decode_ticks`.
+
+    Per candidate: every slot gets a greedy probe request sized for
+    `probe_windows` full windows past a warm-up window (EOS banned via
+    min_tokens when the engine has one, so probes cannot end early),
+    one un-timed step absorbs the prefills plus the decode-program
+    compile, and the drain to completion is timed with `timer` (two
+    calls — injectable, so selection is unit-testable with a scripted
+    clock). Probes are aborted and the PRNG key restored afterwards:
+    a seeded engine leaves the tune exactly as reproducible as it
+    entered, and `abort_all` restores allocator state on paged pools.
+
+    Returns the AutotuneResult; `engine.decode_ticks` is the winner and
+    `engine.decode_ticks_source` is "auto-tuned".
+    """
+    if not getattr(engine, "_decode_ticks_tunable", True):
+        # Speculative engines pin decode_ticks=1 by contract.
+        return AutotuneResult(best=engine.decode_ticks)
+    if engine.pending:
+        raise RuntimeError(
+            "autotune_decode_ticks needs an idle engine (it runs probe "
+            "traffic and aborts it); tune before admitting requests"
+        )
+    candidates = sorted({int(k) for k in candidates})
+    if not candidates or candidates[0] < 1:
+        raise ValueError(f"bad candidates {candidates!r}: need ints >= 1")
+    # Probes must fit the cache (submit's prompt + max_new + 1 bound):
+    # shrink the probe prompt on tight caches and drop candidates that
+    # still cannot fit, rather than failing serving startup — a replica
+    # with a 96-token cache simply tunes over a smaller range.
+    prompt_len = min(prompt_len, max(8, engine.max_len // 4))
+    candidates = [
+        k for k in candidates
+        if prompt_len + (1 + probe_windows) * k + 2 <= engine.max_len
+    ]
+    if not candidates:
+        return AutotuneResult(best=engine.decode_ticks)
+    rng = np.random.default_rng(0)
+    key0 = engine._key
+    original = engine.decode_ticks
+    result = AutotuneResult(best=original)
+    best_rate = -1.0
+    # Probe traffic must not leak into serving observability: the tier
+    # scores replicas on the very shellac_engine_* gauges and decode-
+    # window histograms the sweep would otherwise pollute (a fresh
+    # replica would look loaded, with histogram samples taken at the
+    # REJECTED candidate K values). Point engine.obs at a disabled
+    # scratch registry for the sweep's duration and roll the stats
+    # counters back afterwards.
+    from shellac_tpu.obs import EngineMetrics, Registry
+
+    stats0 = dict(engine.stats)
+    obs0 = engine.obs
+    engine.obs = EngineMetrics(Registry(enabled=False))
+    try:
+        for k in candidates:
+            engine.set_decode_ticks(k)
+            max_new = (1 + probe_windows) * k + 1
+            # Bound re-checked against the submit rule (prompt +
+            # max_new + 1 <= max_len) by submit itself below.
+            kw = {}
+            if engine.eos_id is not None:
+                # A probe ending on a sampled EOS would under-measure
+                # the candidate; ban EOS for the probe's whole budget.
+                kw["min_tokens"] = max_new
+            for slot in range(engine.n_slots):
+                prompt = rng.integers(
+                    0, engine.cfg.vocab_size, size=prompt_len,
+                    dtype=np.int64,
+                )
+                engine.submit(("__autotune__", k, slot), prompt,
+                              max_new, **kw)
+            # Un-timed: prefills + decode-program compile + first
+            # window.
+            engine.step()
+            tokens0 = engine.stats["tokens_generated"] + sum(
+                len(r.out) for r in engine._slots if r is not None
+            )
+            t0 = timer()
+            while engine.pending:
+                engine.step()
+            t1 = timer()
+            tokens1 = engine.stats["tokens_generated"]
+            elapsed = max(t1 - t0, 1e-9)
+            rate = (tokens1 - tokens0) / elapsed
+            result.measurements[k] = rate
+            result.elapsed[k] = elapsed
+            if rate > best_rate:
+                best_rate, result.best = rate, k
+    finally:
+        engine.abort_all()
+        engine._key = key0
+        engine.obs = obs0
+        engine.stats.clear()
+        engine.stats.update(stats0)
+    engine.set_decode_ticks(result.best)
+    engine.decode_ticks_source = "auto-tuned"
+    return result
+
+
+def maybe_autotune(engine, log: Optional[Callable[[str], None]] = None,
+                   **kw) -> Optional[AutotuneResult]:
+    """Tune iff the engine was built with decode_ticks="auto" and is
+    tunable — the serving entry points' one-liner. Returns the result,
+    or None when nothing was tuned."""
+    if engine.decode_ticks_requested != "auto":
+        return None
+    if not getattr(engine, "_decode_ticks_tunable", True):
+        return None
+    if hasattr(engine, "is_primary"):
+        # Multi-host wrapper: probe traffic would have to ride the
+        # command broadcast in lockstep with followers that are not
+        # serving yet. Pods pin decode_ticks explicitly for now.
+        return None
+    res = autotune_decode_ticks(engine, **kw)
+    if log is not None:
+        log(f"decode_ticks auto-tune: {res.summary()}")
+    return res
+
+
+__all__: List[str] = [
+    "AutotuneResult",
+    "DEFAULT_CANDIDATES",
+    "SimulatedHostLatency",
+    "autotune_decode_ticks",
+    "maybe_autotune",
+]

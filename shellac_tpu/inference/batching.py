@@ -120,6 +120,27 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+class _DecodeWindow:
+    """Host-side record of ONE dispatched (not yet synced) decode
+    window.
+
+    `arrays` are the window's device outputs (dispatched async — jax
+    returns futures immediately); `pairs` captures which request owned
+    each active slot AT DISPATCH, so settlement can discard results for
+    slots whose request was cancelled/replaced while the window was in
+    flight (identity check, the same arbitration the supervisor uses
+    for stale generations). `ticks` is the window's decode_ticks at
+    dispatch (the auto-tuner may retune between windows)."""
+
+    __slots__ = ("pairs", "ticks", "arrays", "t_dispatch")
+
+    def __init__(self, pairs, ticks, arrays):
+        self.pairs = pairs      # [(slot, _Request)] active at dispatch
+        self.ticks = ticks
+        self.arrays = arrays    # (toks, lps, tlvs, tlis, acts) futures
+        self.t_dispatch = time.perf_counter()
+
+
 class BatchingEngine:
     """Fixed-slot continuous batching over one model."""
 
@@ -129,6 +150,10 @@ class BatchingEngine:
     # Can this engine score prompts (prompt_logprobs)? Subclasses whose
     # prefill skips scoring forwards (speculative drafts) set False.
     _scores_prompts = True
+    # Can decode_ticks be retuned post-construction? The speculative
+    # engine pins it to 1 (a verify round already emits up to gamma+1
+    # tokens per sync) and sets this False so the auto-tuner skips it.
+    _decode_ticks_tunable = True
 
     def __init__(
         self,
@@ -144,7 +169,8 @@ class BatchingEngine:
         eos_id: Optional[int] = None,
         seed: int = 0,
         attn_impl: str = "auto",
-        decode_ticks: int = 1,
+        decode_ticks="auto",
+        overlap_decode: bool = False,
         max_prefills_per_step: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
         logprobs: bool = False,
@@ -178,6 +204,19 @@ class BatchingEngine:
                     "rolling_window needs a sliding-window model "
                     "(attn_window)"
                 )
+        # decode_ticks: K decode steps per host sync, or "auto" — the
+        # serving entry points run inference.autotune against the live
+        # mesh at startup and write the winner back; until tuned,
+        # "auto" behaves exactly like 1 (bit-identical), so library
+        # construction stays cheap and deterministic.
+        self.decode_ticks_requested = decode_ticks
+        if decode_ticks == "auto":
+            decode_ticks = 1
+        elif isinstance(decode_ticks, str):
+            raise ValueError(
+                f"decode_ticks={decode_ticks!r}: need an int >= 1 or "
+                "'auto'"
+            )
         if decode_ticks < 1:
             raise ValueError(f"decode_ticks must be >= 1, got {decode_ticks}")
         if max_prefills_per_step is not None and max_prefills_per_step < 1:
@@ -213,6 +252,30 @@ class BatchingEngine:
                 self._swaps_cache,
             )
         self.decode_ticks = decode_ticks
+        # Overlapped dispatch: with overlap_decode=True, step() keeps a
+        # two-deep window pipeline — the NEXT decode window is
+        # dispatched (async) before the previous one's host sync is
+        # paid, so the device computes window k+1 while the host
+        # settles window k's requests and runs admissions. Requests
+        # admitted during a step join at the NEXT window boundary, and
+        # per-request outputs stay token-identical to the strict
+        # ordering (greedy and per-request-seeded sampling; the shared
+        # unseeded stream draws in a different order, like any
+        # scheduling change). False = strict ordering, bit-identical to
+        # the pre-overlap engine.
+        self.overlap_decode = bool(overlap_decode)
+        # Dispatched-but-unsynced decode windows, oldest first. Depth
+        # is bounded at 2 by step()'s structure (pre-dispatch exactly
+        # one window before settling exactly one).
+        self._windows: deque[_DecodeWindow] = deque()
+        # Test/bench seam (inference.autotune.SimulatedHostLatency):
+        # None, or an object with on_dispatch(window) / before_sync
+        # (window) — a sleep-injecting RPC shim that lets CPU CI
+        # reproduce the relay-bound regime BENCH_DECODE measured.
+        self._window_hooks = None
+        # Wall-clock the current step() spent blocked in decode-window
+        # syncs (read back out as the host-overhead histogram).
+        self._sync_block_s = 0.0
         # Cap prefills per engine step: a burst of queued prompts would
         # otherwise run n_slots sequential prefill programs before the
         # next decode tick, stalling every active request's output for
@@ -252,6 +315,17 @@ class BatchingEngine:
         self._zero_bias_row = jnp.zeros((1, cfg.vocab_size), jnp.float32)
         self._slot_bias: List[Optional[Dict[int, float]]] = [None] * n_slots
         self._smin = jnp.zeros((n_slots,), jnp.int32)
+        # Device-side stop/budget decisions: per-slot remaining max_new
+        # budget and a sticky done flag, threaded through the decode
+        # window so a slot that samples EOS (or exhausts its budget)
+        # mid-window FREEZES on device — no overshoot compute, no
+        # cache-length drift — and the window reports per-tick validity
+        # flags so the host slices instead of scanning. Stop SEQUENCES
+        # stay a host decision (arbitrary token lists); a stop-matched
+        # slot decodes to the end of its window like before, and the
+        # host discards the tail.
+        self._srem = jnp.zeros((n_slots,), jnp.int32)
+        self._sdone = jnp.zeros((n_slots,), bool)
         # OpenAI-style repetition penalties over GENERATED tokens:
         # per-slot token-count matrix (lazily allocated, like the bias
         # matrix) plus presence/frequency coefficient vectors. Counts
@@ -347,7 +421,19 @@ class BatchingEngine:
             "prefills": 0,
             "prefill_chunks": 0,
             "requests_cancelled": 0,
+            # Mirrored as shellac_engine_* gauges at /metrics scrape
+            # time: the live decode_ticks (the auto-tuner rewrites it)
+            # and the window pipeline depth (2 = overlapped dispatch,
+            # 1 = strict ordering) so the tier's load scoring can see
+            # how each replica runs its hot loop.
+            "decode_ticks": decode_ticks,
+            "overlap_depth": 2 if self.overlap_decode else 1,
         }
+        # How decode_ticks was chosen: "fixed" (explicit int) or
+        # "auto" (pending tune; autotune rewrites it to "auto-tuned").
+        self.decode_ticks_source = (
+            "auto" if self.decode_ticks_requested == "auto" else "fixed"
+        )
         # Richer observability (histograms + gauges) over the shared
         # registry — the Prometheus-facing counterpart of `stats`.
         # Everything it records is host-side and per engine STEP, never
@@ -447,14 +533,17 @@ class BatchingEngine:
 
         Per-tick host reads dominate serving latency when the device is
         remote (each tick would pay a full RPC round trip); scanning K
-        ticks on device amortizes that K-fold. Slots whose request
-        finishes mid-window keep decoding — the host discards the
-        overshoot tokens, and the slot is released/rewritten afterwards,
-        so the math each request sees is unchanged (tested greedy
-        bit-parity vs the single-request engine). Inactive slots stay
-        frozen. Returns (cache, tokens (K, n_slots), logprobs (K,
-        n_slots) -- zeros unless self.logprobs, min_rem, counts,
-        cstate).
+        ticks on device amortizes that K-fold. The per-slot stop
+        decisions the host used to make by scanning the window live
+        HERE now: a slot whose sampled token is EOS, or whose max_new
+        budget runs out, sets its sticky `done` flag and freezes
+        (lengths, sampling state, token stream) for the rest of the
+        window — the host receives per-tick validity flags and slices,
+        instead of re-deriving EOS/budget cuts from the raw token
+        matrix. Inactive slots stay frozen throughout. Returns (cache,
+        tokens (K, n_slots), logprobs (K, n_slots) — zeros unless
+        self.logprobs, min_rem, counts, cstate, top-K values/ids, rem,
+        done, acts (K, n_slots) validity flags).
 
         use_con: constrained slots mask logits through their DFA row
         and advance their state per sampled token — two gathers per
@@ -467,20 +556,24 @@ class BatchingEngine:
         pres, freq, counts0 = samp[6], samp[7], samp[8]
         seed_vec, gen0 = samp[9], samp[10]
         ctrans, coff, cstate0 = samp[11], samp[12], samp[13]
+        rem0, done0 = samp[14], samp[15]
 
         def tick(carry, key_i):
             key, i = key_i
-            cache, cur, min_rem, counts, cstate = carry
+            cache, cur, min_rem, counts, cstate, rem, done = carry
+            # A slot finished earlier in THIS window freezes exactly
+            # like an inactive one.
+            act = active & ~done
             old_lengths = cache.lengths
             logits, cache = transformer.forward_with_cache(
                 self.cfg, params, cur[:, None], cache,
                 attn_impl=self.attn_impl, mesh=self.mesh,
             )
-            lengths = jnp.where(active, cache.lengths, old_lengths)
+            lengths = jnp.where(act, cache.lengths, old_lengths)
             cache = cache.replace(lengths=lengths)
             nxt, min_rem, new_cstate, lp, tlv, tli = (
                 self._row_decode_step(
-                    key, logits[:, 0], cur, active, min_rem, bias,
+                    key, logits[:, 0], cur, act, min_rem, bias,
                     (pres, freq, counts) if use_pen else None,
                     (coff, cstate, ctrans) if use_con else None,
                     samp[:4], seed_vec if use_seed else None, gen0 + i,
@@ -492,17 +585,30 @@ class BatchingEngine:
             if use_pen:
                 counts = counts.at[
                     jnp.arange(counts.shape[0]), nxt
-                ].add(active.astype(jnp.float32))
-            return ((cache, nxt, min_rem, counts, cstate),
-                    (nxt, lp, tlv, tli))
+                ].add(act.astype(jnp.float32))
+            # Device-side stop decision: this emitted token ends the
+            # request when it is EOS (min_tokens already banned EOS
+            # from sampling while its countdown runs) or when it is the
+            # last of the max_new budget. rem <= 1 rather than == 1 so
+            # a slot that somehow enters with rem 0 freezes instead of
+            # wrapping.
+            fin = act & (rem <= 1)
+            if self.eos_id is not None:
+                fin = fin | (act & (nxt == self.eos_id))
+            rem = jnp.where(act, jnp.maximum(rem - 1, 0), rem)
+            done = done | fin
+            return ((cache, nxt, min_rem, counts, cstate, rem, done),
+                    (nxt, lp, tlv, tli, act))
 
         keys = jax.random.split(key, self.decode_ticks)
         ticks_i = jnp.arange(self.decode_ticks, dtype=jnp.int32)
-        ((cache, _, min_rem, counts, cstate),
-         (toks, lps, tlvs, tlis)) = jax.lax.scan(
-            tick, (cache, cur, min_rem0, counts0, cstate0), (keys, ticks_i)
+        ((cache, _, min_rem, counts, cstate, rem, done),
+         (toks, lps, tlvs, tlis, acts)) = jax.lax.scan(
+            tick, (cache, cur, min_rem0, counts0, cstate0, rem0, done0),
+            (keys, ticks_i),
         )
-        return cache, toks, lps, min_rem, counts, cstate, tlvs, tlis
+        return (cache, toks, lps, min_rem, counts, cstate, tlvs, tlis,
+                rem, done, acts)
 
     def _row_decode_step(self, key, logits, cur_r, active_r, min_rem_r,
                          bias_r, pen_r, con_r, samp_r, seed_r, gen_idx_r,
@@ -609,6 +715,14 @@ class BatchingEngine:
         Per-row math is identical to _decode_impl (same block, norm,
         unembed, adjust, sample formulas on the same values), so
         greedy output is bit-exact vs the unpipelined engine.
+
+        Device-side stop decisions are NOT wired here: freezing a
+        group mid-register would leave drain-tail bookkeeping per
+        stage for a path whose win is stage utilization, not host
+        syncs. rem/done pass through untouched, the validity flags
+        report every active exit, and the host keeps its historical
+        EOS/budget scan for pipelined engines — outputs are identical
+        either way (the flags only dropped tokens the host discarded).
         """
         from shellac_tpu.inference import pp_pipeline as ppl
 
@@ -626,6 +740,7 @@ class BatchingEngine:
         pres, freq, counts0 = samp[6], samp[7], samp[8]
         seed_vec, gen0 = samp[9], samp[10]
         ctrans, coff, cstate0 = samp[11], samp[12], samp[13]
+        rem0, done0 = samp[14], samp[15]
 
         cache_fields = kv_field_names(self.kv_quant)
         cache_st = tuple(
@@ -731,7 +846,7 @@ class BatchingEngine:
                 ].add(active_eff.astype(jnp.float32))
             new_carry = (cache_st, lengths, cur, min_rem, counts,
                          cstate, stage_x, stage_pos, stage_gstart)
-            return new_carry, (nxt, lp, tlv, tli)
+            return new_carry, (nxt, lp, tlv, tli, active_eff)
 
         stage_x0 = ppl.constrain_register(
             jnp.zeros((pp, G, 1, d_model), cdt), self.mesh
@@ -750,7 +865,7 @@ class BatchingEngine:
         carry0 = (cache_st, cache.lengths, cur, min_rem0, counts0,
                   cstate0, stage_x0, stage_pos0, stage_gstart0)
         ((cache_st, lengths, _, min_rem, counts, cstate, _, _, _),
-         (nxts, lps, tlvs, tlis)) = jax.lax.scan(
+         (nxts, lps, tlvs, tlis, acts)) = jax.lax.scan(
             microtick, carry0, (keys, ts)
         )
         cache = cache.replace(
@@ -767,8 +882,9 @@ class BatchingEngine:
         k_tl = self.top_logprobs
         tlvs_out = tlvs[pp - 1:].reshape(K, n_slots, k_tl)
         tlis_out = tlis[pp - 1:].reshape(K, n_slots, k_tl)
+        acts_out = acts[pp - 1:].reshape(K, n_slots)
         return (cache, toks, lps_out, min_rem, counts, cstate,
-                tlvs_out, tlis_out)
+                tlvs_out, tlis_out, rem0, done0, acts_out)
 
     # ---- scheduling --------------------------------------------------
 
@@ -807,18 +923,33 @@ class BatchingEngine:
         vals, ids = jax.lax.top_k(lsm, k)
         return vals, ids.astype(jnp.int32)
 
+    @staticmethod
+    def _unpack_samp(samp):
+        """Unpack a _slot_samp tuple: (temperature, top_k, top_p,
+        min_p, bias row, min_tokens, seed, constraint mask), each a
+        (1,)/(1, V) array. The scalars ride ONE packed int32 device
+        buffer (floats bitcast); this is the single place the layout
+        is decoded, shared by every prefill program."""
+        packed, bias, cmask = samp
+        fl = jax.lax.bitcast_convert_type(packed[:3], jnp.float32)
+        return (fl[0][None], packed[3][None], fl[1][None], fl[2][None],
+                bias, packed[4][None], packed[5][None], cmask)
+
     def _sample_first(self, key, last, samp):
         """Sample a prefill's first output token from the adjusted
         (biased, EOS-banned, constraint-masked) logits; the logprob
         stays on the raw ones. A seeded request's first token is draw
         gen_idx=0 of its own deterministic stream."""
-        adjusted = self._adjust_logits(last[None], samp[4], samp[5])
+        temp, topk, topp, minp, bias, min_rem, seed, cmask = (
+            self._unpack_samp(samp)
+        )
+        adjusted = self._adjust_logits(last[None], bias, min_rem)
         # Constraint mask LAST: a grammar-disallowed token must stay
         # disallowed no matter what the user's logit_bias says.
-        adjusted = adjusted + samp[7]
+        adjusted = adjusted + cmask
         first = sample_batched(
-            key, adjusted, *samp[:4],
-            seed=samp[6], gen_idx=jnp.zeros((1,), jnp.int32),
+            key, adjusted, temp, topk, topp, minp,
+            seed=seed, gen_idx=jnp.zeros((1,), jnp.int32),
         )[0]
         lp = jax.nn.log_softmax(last.astype(jnp.float32))[first]
         return first, lp
@@ -974,14 +1105,26 @@ class BatchingEngine:
         return row
 
     def _slot_samp(self, slot: int, req: _Request):
-        """This request's sampling settings as (1, ...)-vectors for
-        jit: (temperature, top_k, top_p, min_p, logit bias row,
-        remaining min_tokens, seed, first-token constraint mask). The
-        bias row is a device slice of the matrix _set_slot_sampling
-        already wrote (None = no bias). The constraint mask is the
-        DFA's state-0 row as an additive -inf mask — the prefill's
+        """This request's sampling settings for the prefill jits:
+        (packed scalars, logit bias row, first-token constraint mask).
+
+        The six scalars (temperature, top_p, min_p bitcast to int32;
+        top_k, remaining min_tokens, seed) are packed into ONE (6,)
+        int32 host buffer so admission pays a single host->device
+        upload instead of six round trips through the dispatch path —
+        _unpack_samp is the matching device-side decoder. The bias row
+        is a device slice of the matrix _set_slot_sampling already
+        wrote (shared zero row when unbiased). The constraint mask is
+        the DFA's state-0 row as an additive -inf mask — the prefill's
         sampled token must obey the grammar too; later tokens mask
         inside the decode scan."""
+        packed = np.empty((6,), np.int32)
+        packed[:3] = np.asarray(
+            [req.temperature, req.top_p, req.min_p], np.float32
+        ).view(np.int32)
+        packed[3] = req.top_k
+        packed[4] = req.min_tokens
+        packed[5] = req.seed if req.seed is not None else -1
         bias = (self._sbias[slot][None] if req.logit_bias
                 else self._zero_bias_row)
         if req.constraint is not None:
@@ -991,18 +1134,7 @@ class BatchingEngine:
             cmask = jnp.asarray(mask)[None]
         else:
             cmask = self._zero_bias_row
-        return (
-            jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32),
-            jnp.asarray([req.top_p], jnp.float32),
-            jnp.asarray([req.min_p], jnp.float32),
-            bias,
-            jnp.asarray([req.min_tokens], jnp.int32),
-            jnp.asarray(
-                [req.seed if req.seed is not None else -1], jnp.int32
-            ),
-            cmask,
-        )
+        return (jnp.asarray(packed), bias, cmask)
 
     def _set_slot_sampling(self, slot: int, req: _Request) -> None:
         """Write the request's settings into the per-slot vectors the
@@ -1097,7 +1229,7 @@ class BatchingEngine:
         self._cache = cache
         if req.prompt_logprobs:
             req.plp = [float(x) for x in
-                       np.asarray(jax.device_get(plp))[:s]]
+                       np.asarray(jax.device_get(plp))[:s]]  # shellac: ignore[SH002] — prompt scoring is an opt-in per-request pull; it rides the admission path, never the decode window
         return first, lp, ((tlv, tli) if self.top_logprobs else None)
 
     def _prefill_start_offset(self, slot: int) -> int:
@@ -1133,8 +1265,19 @@ class BatchingEngine:
 
     def _finish_prefill(self, slot: int, req: _Request, first,
                         lp=None, tl=None) -> None:
+        # ONE host pull for everything this admission needs host-side
+        # (first token, its logprob, the top-K alternatives): the
+        # separate int()/float()/device_get() calls this replaces each
+        # paid their own device round trip per prefill.
+        first, lp, tl = jax.device_get((first, lp, tl))  # shellac: ignore[SH002] — the single batched per-prefill pull; the first token MUST reach the host here (it is the TTFT point and the finish check needs it)
         first_tok = int(first)
         self._cur = self._cur.at[slot].set(first_tok)
+        # Arm the device-side stop decisions: the prefill-sampled token
+        # below is the first of max_new, so the decode window may emit
+        # max_new - 1 more before the budget freeze; done clears in
+        # case the slot's previous tenant froze it.
+        self._srem = self._srem.at[slot].set(req.max_new - 1)
+        self._sdone = self._sdone.at[slot].set(False)
         self._slots[slot] = req
         if req.constraint is not None:
             # Advance the DFA past the prefill-sampled token (host-side:
@@ -1154,13 +1297,13 @@ class BatchingEngine:
             self._smin = self._smin.at[slot].set(req.min_tokens - 1)
         req.out.append(first_tok)
         if req.trace is not None:
-            # int(first) above already synced: the first token is a
-            # host value, so this is the request's TTFT point.
+            # The batched pull above already synced: the first token is
+            # a host value, so this is the request's TTFT point.
             req.trace.first_token()
         if self.logprobs and lp is not None:
             req.lps.append(float(lp))
         if self.top_logprobs and tl is not None:
-            tlv, tli = jax.device_get(tl)
+            tlv, tli = tl  # host arrays — pulled with `first` above
             req.tlp = [(np.asarray(tli)[0].tolist(),
                         np.asarray(tlv)[0].tolist())]
         self.stats["prefills"] += 1
@@ -1207,7 +1350,7 @@ class BatchingEngine:
                 del self._prefilling[slot]
                 if req.prompt_logprobs:
                     pieces = req.plp
-                    host = jax.device_get(pieces)
+                    host = jax.device_get(pieces)  # shellac: ignore[SH002] — the ONE stitching pull per scored prompt, deferred to its final chunk by design (see the collection comment above)
                     flat = [0.0]
                     for plp_host, sz, blp_host in host:
                         flat.extend(float(x)
@@ -1308,10 +1451,38 @@ class BatchingEngine:
                 self._release_slot(i)
 
     def step(self) -> List[Tuple[Any, List[int]]]:
-        """Fill free slots, run decode_ticks ticks; returns finished
-        requests. One host sync per call regardless of decode_ticks."""
+        """Fill free slots, run one decode window (decode_ticks ticks);
+        returns finished requests. One host sync per call regardless of
+        decode_ticks.
+
+        overlap_decode=True turns this into a two-deep pipeline: the
+        next window is dispatched against the CURRENT slot view before
+        the previous window's sync is paid, so the device computes
+        window k+1 while the host settles window k (detokenize, finish
+        checks, slot release) and runs admissions. Consequences, all
+        tested: requests admitted in a step join at the NEXT window
+        boundary; a slot whose request finished in the un-synced window
+        decodes one more (frozen-by-done or discarded) window; settle
+        discards results for slots whose request was cancelled or
+        replaced in flight (identity check). Strict ordering
+        (overlap_decode=False) is bit-identical to the pre-overlap
+        engine."""
         finished: List[Tuple[Any, List[int]]] = []
         self.stats["engine_steps"] += 1
+        t_step0 = time.perf_counter()
+        self._sync_block_s = 0.0
+        synced = False
+        if self.overlap_decode and self._windows:
+            # Keep the device busy across the sync: dispatch the next
+            # window on the current (stale w.r.t. the un-synced window)
+            # slot view, THEN pay the previous window's sync. Slots
+            # whose request finished in the un-synced window carry a
+            # device-side done flag, so their extra window freezes.
+            rows = self._active_rows()
+            if any(rows):
+                self.obs.occupancy.observe(sum(rows) / self.n_slots)
+                self._dispatch_window(rows)
+            synced = self._settle_window(finished) or synced
         t_fill0 = time.perf_counter()
         prefills0 = self.stats["prefills"] + self.stats["prefill_chunks"]
         # Fill/check until stable: a request satisfied by its prefill
@@ -1354,42 +1525,205 @@ class BatchingEngine:
             # step ran, including their host syncs) — observed only on
             # steps that actually prefilled.
             self.obs.prefill_seconds.observe(time.perf_counter() - t_fill0)
-        active_rows = [
+        active_rows = self._active_rows()
+        if any(active_rows) and not self._windows:
+            self.obs.occupancy.observe(sum(active_rows) / self.n_slots)
+            if self.overlap_decode:
+                # Pipeline warm-up (or re-fill after an idle/abort
+                # gap): dispatch and leave in flight; the next step
+                # settles it.
+                self._dispatch_window(active_rows)
+            else:
+                # Strict ordering: dispatch and sync within the step.
+                pairs = [(i, self._slots[i])
+                         for i in range(self.n_slots) if active_rows[i]]
+                per_slot, per_lps, per_tl = (
+                    self._decode_tokens(active_rows)
+                )
+                self._apply_pairs(pairs, per_slot, per_lps, per_tl)
+                self._finish_check(finished)
+                synced = True
+        self._observe_cache_gauges()
+        if synced:
+            # Host overhead this step: wall time minus the time spent
+            # blocked awaiting decode-window results — the part of the
+            # tick the device cannot see and overlap exists to hide.
+            self.obs.host_overhead.observe(max(
+                0.0,
+                time.perf_counter() - t_step0 - self._sync_block_s,
+            ))
+        return finished
+
+    # ---- decode-window dispatch / settle ----------------------------
+
+    def _active_rows(self) -> List[bool]:
+        """Slots a decode window should advance right now (occupied,
+        not mid-chunked-prefill)."""
+        return [
             r is not None and i not in self._prefilling
             for i, r in enumerate(self._slots)
         ]
-        if any(active_rows):
-            self.obs.occupancy.observe(sum(active_rows) / self.n_slots)
-            t_dec0 = time.perf_counter()
-            self._pre_decode(active_rows)
-            per_slot, per_lps, per_tl = self._decode_tokens(active_rows)
-            # _decode_tokens ends in the window's one host sync, so this
-            # wall time covers the full decode window.
-            self.obs.decode_window_seconds.observe(
-                time.perf_counter() - t_dec0
+
+    def _inflight_advance(self) -> Dict[int, int]:
+        """Tokens the un-synced window(s) will have appended to each
+        still-current request by the time they settle: a continuing
+        request always accepts the full window (anything less means it
+        finished, and then the projection is discarded with the slot),
+        so the host can project len(out) forward WITHOUT syncing —
+        the fact that makes overlapped gen0/length bookkeeping exact."""
+        adv: Dict[int, int] = {}
+        for w in self._windows:
+            for slot, req in w.pairs:
+                if self._slots[slot] is req:
+                    adv[slot] = adv.get(slot, 0) + w.ticks
+        return adv
+
+    def _dispatch_window(self, active_rows) -> _DecodeWindow:
+        """Dispatch ONE jitted decode window asynchronously and record
+        it in the flight queue. No host sync happens here — jax returns
+        the outputs as futures, and every per-slot device vector is
+        rebound from them so admissions/releases that run before the
+        sync compose in dispatch order."""
+        if self._decode is None:
+            impl = (self._decode_impl_pp if self.pp_pipeline
+                    else self._decode_impl)
+            self._decode = self._jit_cache_program(
+                impl, 10,
+                static_argnames=("greedy_only", "use_bias", "use_pen",
+                                 "use_seed", "use_con"),
             )
-            for i, req in enumerate(self._slots):
-                if req is None or i in self._prefilling:
-                    continue
-                for j, tok in enumerate(per_slot[i]):
-                    req.out.append(int(tok))
-                    if per_lps is not None:
-                        req.lps.append(float(per_lps[i][j]))
-                    if per_tl is not None:
-                        if req.tlp is None:
-                            req.tlp = []
-                        req.tlp.append(per_tl[i][j])
-                    last = req.out[-1]
-                    if (self.eos_id is not None and last == self.eos_id) or (
-                        len(req.out) >= req.max_new
-                    ) or req.hit_stop() is not None:
-                        # Later window tokens are post-EOS/budget/stop
-                        # overshoot; the device kept decoding but the
-                        # request never sees them.
-                        break
-            self._finish_check(finished)
-        self._observe_cache_gauges()
-        return finished
+        adv = self._inflight_advance()
+        self._pre_decode(active_rows, adv)
+        active = jnp.asarray(active_rows)
+        self._key, sub = jax.random.split(self._key)
+        greedy_only = all(
+            r is None or r.temperature == 0.0 for r in self._slots
+        )
+        use_pen = any(self._slot_pen)
+        if self._con_dirty:
+            self._rebuild_constraints()
+        use_con = self._ctrans is not None
+        counts = (self._scounts if use_pen else self._zero_bias_row)
+        # Generated-token counts at the window's start: host-known
+        # len(out), projected past any window still in flight.
+        gen0 = jnp.asarray(
+            [len(r.out) + adv.get(i, 0) if r is not None else 0
+             for i, r in enumerate(self._slots)],
+            jnp.int32,
+        )
+        # Unconstrained steps pass the shared dummy table so the arg
+        # tree keeps its structure without holding a real table alive.
+        ctrans = self._ctrans if use_con else self._dummy_ctrans
+        (self._cache, toks, lps, self._smin, counts, cstate,
+         tlvs, tlis, self._srem, self._sdone, acts) = self._decode(
+            self.params, self._cache, self._cur, active, sub,
+            (self._stemp, self._stopk, self._stopp, self._sminp,
+             self._sbias if self._sbias is not None
+             else self._zero_bias_row, self._smin,
+             self._spres, self._sfreq, counts,
+             self._sseed, gen0, ctrans, self._coff, self._cstate,
+             self._srem, self._sdone),
+            greedy_only=greedy_only,
+            use_bias=self._sbias is not None and any(
+                b is not None for b in self._slot_bias
+            ),
+            use_pen=use_pen,
+            use_seed=any(
+                r is not None and r.seed is not None for r in self._slots
+            ),
+            use_con=use_con,
+        )
+        if use_pen:
+            self._scounts = counts
+        if use_con:
+            self._cstate = cstate
+        self._cur = toks[-1]
+        w = _DecodeWindow(
+            pairs=[(i, self._slots[i])
+                   for i in range(self.n_slots) if active_rows[i]],
+            ticks=self.decode_ticks,
+            arrays=(toks, lps, tlvs, tlis, acts),
+        )
+        self._windows.append(w)
+        if self._window_hooks is not None:
+            self._window_hooks.on_dispatch(w)
+        return w
+
+    def _sync_window(self, w: _DecodeWindow):
+        """THE host sync: pull a dispatched window's packed results
+        (tokens, validity flags, logprob sidecars — one transfer) and
+        slice each slot's valid prefix. Returns (tokens, logprobs,
+        top-K alternatives) keyed by slot."""
+        t0 = time.perf_counter()
+        if self._window_hooks is not None:
+            self._window_hooks.before_sync(w)
+        host_toks, host_lps, host_tlv, host_tli, host_acts = (
+            jax.device_get(w.arrays)  # shellac: ignore[SH002] — the decode window's ONE packed sync; everything the host needs arrives in this single transfer
+        )
+        t1 = time.perf_counter()
+        self._sync_block_s += t1 - t0
+        # Window wall time, dispatch to results-on-host: under
+        # overlapped dispatch this spans the host work interleaved with
+        # the window — the overlapped reality, not the serial span.
+        self.obs.decode_window_seconds.observe(t1 - w.t_dispatch)
+        # Device-side stop decisions arrive as per-tick validity flags;
+        # valid ticks are a prefix (done is sticky), so each slot's
+        # token list is a slice, not a scan.
+        n_valid = host_acts.sum(axis=0)
+        per_slot = [host_toks[:n_valid[i], i].tolist()
+                    for i in range(self.n_slots)]
+        if not self.logprobs:
+            return per_slot, None, None
+        per_lps = [host_lps[:n_valid[i], i].tolist()
+                   for i in range(self.n_slots)]
+        if not self.top_logprobs:
+            return per_slot, per_lps, None
+        # (ticks, n_slots, K) -> per slot, per valid tick: (ids, lps).
+        per_tl = [
+            [(host_tli[j, i].tolist(), host_tlv[j, i].tolist())
+             for j in range(n_valid[i])]
+            for i in range(self.n_slots)
+        ]
+        return per_slot, per_lps, per_tl
+
+    def _apply_pairs(self, pairs, per_slot, per_lps, per_tl) -> None:
+        """Append a window's valid tokens to the requests that owned
+        the slots at dispatch. The identity check discards results for
+        slots cancelled or re-admitted while the window was in flight
+        (overlap), and the per-token break re-checks the host-only
+        finish conditions (stop sequences; EOS/budget are pre-cut
+        device-side but re-checked as the single source of truth)."""
+        for slot, req in pairs:
+            if self._slots[slot] is not req or slot in self._prefilling:
+                continue
+            for j, tok in enumerate(per_slot[slot]):
+                req.out.append(int(tok))
+                if per_lps is not None:
+                    req.lps.append(float(per_lps[slot][j]))
+                if per_tl is not None:
+                    if req.tlp is None:
+                        req.tlp = []
+                    req.tlp.append(per_tl[slot][j])
+                last = req.out[-1]
+                if (self.eos_id is not None and last == self.eos_id) or (
+                    len(req.out) >= req.max_new
+                ) or req.hit_stop() is not None:
+                    # Later window tokens are post-EOS/budget/stop
+                    # overshoot; the device froze (EOS/budget) or kept
+                    # decoding (stop sequence), and the request never
+                    # sees them either way.
+                    break
+
+    def _settle_window(self, finished) -> bool:
+        """Sync and settle the OLDEST in-flight window; False if none
+        was in flight."""
+        if not self._windows:
+            return False
+        w = self._windows.popleft()
+        per_slot, per_lps, per_tl = self._sync_window(w)
+        self._apply_pairs(w.pairs, per_slot, per_lps, per_tl)
+        self._finish_check(finished)
+        return True
 
     def _observe_cache_gauges(self) -> None:
         """Per-step utilization gauges. Host-known values only (slot
@@ -1409,76 +1743,19 @@ class BatchingEngine:
 
     def _decode_tokens(self, active_rows):
         """Advance every active slot; returns (tokens_per_slot,
-        logprobs_per_slot or None) in one host sync. Overridden by the
-        speculative engine."""
-        if self._decode is None:
-            impl = (self._decode_impl_pp if self.pp_pipeline
-                    else self._decode_impl)
-            self._decode = self._jit_cache_program(
-                impl, 7,
-                static_argnames=("greedy_only", "use_bias", "use_pen",
-                                 "use_seed", "use_con"),
-            )
-        active = jnp.asarray(active_rows)
-        self._key, sub = jax.random.split(self._key)
-        greedy_only = all(
-            r is None or r.temperature == 0.0 for r in self._slots
-        )
-        use_pen = any(self._slot_pen)
-        if self._con_dirty:
-            self._rebuild_constraints()
-        use_con = self._ctrans is not None
-        counts = (self._scounts if use_pen else self._zero_bias_row)
-        gen0 = jnp.asarray(
-            [len(r.out) if r is not None else 0 for r in self._slots],
-            jnp.int32,
-        )
-        # Unconstrained steps pass the shared dummy table so the arg
-        # tree keeps its structure without holding a real table alive.
-        ctrans = self._ctrans if use_con else self._dummy_ctrans
-        (self._cache, toks, lps, self._smin, counts,
-         cstate, tlvs, tlis) = self._decode(
-            self.params, self._cache, self._cur, active, sub,
-            (self._stemp, self._stopk, self._stopp, self._sminp,
-             self._sbias if self._sbias is not None
-             else self._zero_bias_row, self._smin,
-             self._spres, self._sfreq, counts,
-             self._sseed, gen0, ctrans, self._coff, self._cstate),
-            greedy_only=greedy_only,
-            use_bias=self._sbias is not None and any(
-                b is not None for b in self._slot_bias
-            ),
-            use_pen=use_pen,
-            use_seed=any(
-                r is not None and r.seed is not None for r in self._slots
-            ),
-            use_con=use_con,
-        )
-        if use_pen:
-            self._scounts = counts
-        if use_con:
-            self._cstate = cstate
-        self._cur = toks[-1]
-        # (K, n_slots) each — the one host sync.
-        host_toks, host_lps, host_tlv, host_tli = jax.device_get(
-            (toks, lps, tlvs, tlis)
-        )
-        per_slot = [host_toks[:, i].tolist() for i in range(self.n_slots)]
-        if not self.logprobs:
-            return per_slot, None, None
-        per_lps = [host_lps[:, i].tolist() for i in range(self.n_slots)]
-        if not self.top_logprobs:
-            return per_slot, per_lps, None
-        # (ticks, n_slots, K) -> per slot, per tick: (ids, lps).
-        per_tl = [
-            [(host_tli[j, i].tolist(), host_tlv[j, i].tolist())
-             for j in range(host_tli.shape[0])]
-            for i in range(self.n_slots)
-        ]
-        return per_slot, per_lps, per_tl
+        logprobs_per_slot or None, top-K per slot or None), already cut
+        to each slot's valid count, in one host sync. The strict-
+        ordering path (dispatch + immediate sync); overridden wholesale
+        by the speculative engine."""
+        w = self._dispatch_window(active_rows)
+        self._windows.pop()  # settled inline, not via the flight queue
+        return self._sync_window(w)
 
-    def _pre_decode(self, active_rows) -> None:
-        """Hook before each decode tick (paged: grow block tables)."""
+    def _pre_decode(self, active_rows, advance=None) -> None:
+        """Hook before each decode window (paged: grow block tables).
+        `advance` maps slot -> tokens an un-synced in-flight window
+        will still append (overlapped dispatch), so length projections
+        stay exact without a host sync."""
 
     def cancel(self, rid) -> bool:
         """Drop a queued or in-flight request (caller must be the
@@ -1514,6 +1791,13 @@ class BatchingEngine:
         swept so a rebuilt server cannot hand a new request an old
         generation's logprobs. Device cache rows need no repair — stale
         rows are self-healing (lengths roll back at the next admit)."""
+        # Drain the in-flight decode window(s) first (overlapped
+        # dispatch): block until the device finishes and DISCARD the
+        # results, so a rebuilt/resynced engine can never mis-attribute
+        # a stale window's tokens to a new generation's requests, and
+        # the device is quiescent when the caller reuses it.
+        while self._windows:
+            jax.device_get(self._windows.popleft().arrays)
         dropped = []
         for req in self._queue:
             dropped.append(req.rid)
@@ -1534,6 +1818,19 @@ class BatchingEngine:
         self.finished_top_logprobs.clear()
         self.stats["requests_cancelled"] += len(dropped)
         return dropped
+
+    def set_decode_ticks(self, k: int) -> None:
+        """Rewrite decode_ticks between windows — the auto-tuner's
+        write-back. Invalidates the lazily built decode program (the
+        window length is baked into its trace); windows already in
+        flight keep the tick count they were dispatched with."""
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"decode_ticks must be >= 1, got {k}")
+        if k != self.decode_ticks:
+            self.decode_ticks = k
+            self._decode = None
+        self.stats["decode_ticks"] = k
 
     @property
     def pending(self) -> int:
@@ -1809,19 +2106,21 @@ class PagedBatchingEngine(BatchingEngine):
         self._free = list(range(self._n_blocks - 1, 0, -1))
         return dropped
 
-    def _pre_decode(self, active_rows) -> None:
+    def _pre_decode(self, active_rows, advance=None) -> None:
         # Backstop only — admission already reserved the full footprint.
-        # Lengths are tracked on host (prompt + generated so far): no
-        # device sync in the serving hot loop. A multi-tick window can
-        # write up to decode_ticks positions before the host intervenes;
-        # anything past the request's own footprint lands in scratch
-        # block 0 (post-finish overshoot), so the reservation is capped
-        # at the footprint.
+        # Lengths are tracked on host (prompt + generated so far,
+        # projected past any un-synced in-flight window via `advance`):
+        # no device sync in the serving hot loop. A multi-tick window
+        # can write up to decode_ticks positions before the host
+        # intervenes; anything past the request's own footprint lands
+        # in scratch block 0 (post-finish overshoot), so the
+        # reservation is capped at the footprint.
         for i, active in enumerate(active_rows):
             if not active:
                 continue
             req = self._slots[i]
-            length = req.tokens.size + len(req.out)
+            length = (req.tokens.size + len(req.out)
+                      + (advance.get(i, 0) if advance else 0))
             need = min(
                 length + self.decode_ticks,
                 req.tokens.size + req.max_new + 1,

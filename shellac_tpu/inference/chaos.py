@@ -227,9 +227,16 @@ class ReplicaProc:
                  slots: int = 2, max_len: int = 96,
                  extra_args: Optional[List[str]] = None,
                  startup_timeout: float = 120.0):
+        # decode_ticks pinned to 1: chaos replicas measure failure
+        # semantics, not throughput, and the serve default ("auto")
+        # would spend replica startup on a tuning sweep. Overlapped
+        # dispatch keeps its serve default, so the chaos scenarios
+        # exercise SIGKILL/drain against the overlapped pipeline.
+        # extra_args may override either (argparse: last flag wins).
         cmd = [sys.executable, "-m", "shellac_tpu", "serve",
                "--port", "0", "--slots", str(slots),
                "--max-len", str(max_len), "--seed", str(seed),
+               "--decode-ticks", "1",
                "--temperature", "0.0", "--tokenizer", "byte"]
         cmd += (["--config", config_path] if config_path
                 else ["--model", model])

@@ -207,6 +207,7 @@ class InferenceServer:
         heartbeat_path: Optional[str] = None,
         registry: Optional[Registry] = None,
         metrics: bool = True,
+        autotune: bool = False,
         **engine_kw,
     ):
         # Observability: every span/counter lands in `registry` — the
@@ -288,6 +289,21 @@ class InferenceServer:
         # reset — a stale True only costs the scan, a wrong False
         # would stop shedding.
         self._saw_deadline = False
+        # Startup auto-tune (serve --decode-ticks auto, the CLI
+        # default): sweep decode_ticks against the live engine BEFORE
+        # the scheduler thread exists (the engine is single-owner
+        # here), write the winner back, and remember it so supervisor-
+        # rebuilt generations inherit the tuned value instead of
+        # re-paying the sweep mid-recovery. Library-built servers keep
+        # autotune=False: tests and embedders want deterministic, cheap
+        # construction.
+        self._tuned_ticks: Optional[int] = None
+        if autotune:
+            from shellac_tpu.inference.autotune import maybe_autotune
+
+            res = maybe_autotune(engine)
+            if res is not None:
+                self._tuned_ticks = res.best
         # Liveness file beaten from the scheduler loop, so external
         # watchdogs cover inference the same way they cover training.
         # The step watchdog co-beats it while in-process recovery is
@@ -511,6 +527,14 @@ class InferenceServer:
             threading.Thread(target=_rebuild_beater, daemon=True).start()
         try:
             engine = self._engine_factory()
+            if (self._tuned_ticks is not None
+                    and getattr(engine, "decode_ticks_requested", None)
+                    == "auto"
+                    and getattr(engine, "_decode_ticks_tunable", True)):
+                # The rebuilt generation inherits the startup tune; a
+                # fresh sweep mid-recovery would stretch the outage.
+                engine.set_decode_ticks(self._tuned_ticks)
+                engine.decode_ticks_source = "auto-tuned"
         except Exception as e:  # noqa: BLE001 — any rebuild fault is fatal
             with self._lock:
                 self._recovering = False
@@ -1330,6 +1354,16 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     "slots_busy": sum(r is not None for r in eng._slots),
                     "n_slots": eng.n_slots,
                     "decode_ticks": eng.decode_ticks,
+                    # How the window length was chosen ("fixed" |
+                    # "auto" pending | "auto-tuned") and whether the
+                    # decode loop runs the two-deep overlapped
+                    # dispatch pipeline — the tier's load scoring
+                    # reads these alongside the host-overhead
+                    # histogram at /metrics.
+                    "decode_ticks_source": getattr(
+                        eng, "decode_ticks_source", "fixed"),
+                    "overlap_decode": bool(
+                        getattr(eng, "overlap_decode", False)),
                     # Supervisor state: /stats stays 200 through an
                     # outage (scrapers keep collecting); readiness
                     # lives at /health.

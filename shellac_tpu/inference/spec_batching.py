@@ -30,6 +30,7 @@ no upstream speculative serving engine to cite.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import jax
@@ -46,6 +47,7 @@ class SpeculativeBatchingEngine(BatchingEngine):
     """Continuous batching with a draft model proposing gamma tokens."""
 
     _scores_prompts = False  # draft/verify prefill skips prompt scoring
+    _decode_ticks_tunable = False  # rounds, not tick windows
 
     def __init__(
         self,
@@ -70,10 +72,23 @@ class SpeculativeBatchingEngine(BatchingEngine):
             )
         if gamma < 1:
             raise ValueError(f"gamma must be >= 1, got {gamma}")
-        if kw.get("decode_ticks", 1) != 1:
+        # decode_ticks is pinned: a verify round already emits up to
+        # gamma+1 tokens per sync. "auto" (the serving default) is
+        # accepted and resolves to 1 — _decode_ticks_tunable=False
+        # makes the startup auto-tuner skip this engine.
+        if kw.get("decode_ticks", 1) not in (1, "auto"):
             raise ValueError(
                 "speculative batching emits up to gamma+1 tokens per step "
                 "already; decode_ticks must stay 1"
+            )
+        kw["decode_ticks"] = 1
+        if kw.get("overlap_decode"):
+            raise ValueError(
+                "overlap_decode is not wired for the speculative engine: "
+                "the host must see each round's per-slot acceptance "
+                "counts before it can account the next round, so there "
+                "is no sync to defer; use a non-draft engine for "
+                "overlapped decode"
             )
         if kw.get("kv_quant") is not None:
             raise NotImplementedError(
@@ -380,6 +395,7 @@ class SpeculativeBatchingEngine(BatchingEngine):
         return tcache, dcache, emitted, counts, cur, lps, tlv, tli
 
     def _decode_tokens(self, active_rows):
+        t0 = time.perf_counter()
         active = jnp.asarray(active_rows)
         self._key, sub = jax.random.split(self._key)
         (self._cache, self._dcache, emitted, counts, self._cur,
@@ -388,9 +404,15 @@ class SpeculativeBatchingEngine(BatchingEngine):
             self._cur, active, self._stemp, sub,
         )
         # The one host sync.
-        em, cnt, host_lps, host_tlv, host_tli = jax.device_get(
+        em, cnt, host_lps, host_tlv, host_tli = jax.device_get(  # shellac: ignore[SH002] — the verify round's ONE packed sync (acceptance counts must reach the host before the next round)
             (emitted, counts, lps, tlv, tli)
         )
+        t1 = time.perf_counter()
+        # The base engine's window instruments live in _sync_window,
+        # which this override replaces: report the verify round as the
+        # decode window it is.
+        self._sync_block_s += t1 - t0
+        self.obs.decode_window_seconds.observe(t1 - t0)
         self.stats["spec_rounds"] += 1
         self.stats["spec_proposed"] += int((cnt > 0).sum()) * self.gamma
         self.stats["spec_accepted"] += int(np.maximum(cnt - 1, 0).sum())

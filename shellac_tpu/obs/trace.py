@@ -291,8 +291,18 @@ class EngineMetrics:
         )
         self.decode_window_seconds = h(
             "shellac_decode_window_seconds",
-            "Wall time of one decode window (decode_ticks ticks plus "
-            "the host sync)",
+            "Wall time of one decode window, dispatch to results-on-"
+            "host (under overlapped dispatch this spans the host work "
+            "interleaved with the window — the overlapped reality)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.host_overhead = h(
+            "shellac_decode_host_overhead_seconds",
+            "Per engine step that synced a decode window: step wall "
+            "time minus time blocked awaiting window results — the "
+            "host-side share of the tick (scheduling, settlement, "
+            "prefill dispatch). A replica whose overhead rivals its "
+            "window time is host-bound, not device-bound",
             buckets=LATENCY_BUCKETS,
         )
         self.occupancy = h(

@@ -899,8 +899,20 @@ def paged_decode_attention(
             )
         use_kernel = True
     else:
-        use_kernel = impl == "auto" and pallas_supported() and shapes_ok
-        if impl == "auto" and pallas_supported() and not shapes_ok:
+        # 'auto' defaults bf16 pools to the XLA reference path: across
+        # three measurement rounds the grouped-gather paged kernel has
+        # never beaten the reference on hardware (BENCH_DECODE
+        # 2026-07-31 chip run: 284.7 vs 261.9 us/call, 0.92x, at
+        # serving page sizes) — and the engine tick is host-bound
+        # anyway, so the kernel cannot pay its complexity tax.
+        # impl='flash' still forces it (parity tests, future re-
+        # measurement). Int8 pools KEEP the kernel under auto: their
+        # reference fallback dequantizes gathered pages every tick,
+        # inverting the kv_quant bandwidth win.
+        use_kernel = (impl == "auto" and pallas_supported() and shapes_ok
+                      and quant)
+        if (impl == "auto" and pallas_supported() and not shapes_ok
+                and quant):
             # The operator asked for paged serving on a TPU but the pool
             # shape silently disqualifies the kernel — the fallback
             # materializes the dense (B, view, Hkv, D) gather every

@@ -155,6 +155,74 @@ def step_decode(engine):
     assert lint_snippet(src, select=["SH002"]) == []
 
 
+SH002_ENGINE_PATH = """
+import jax
+
+class DemoEngine:
+    def step(self):
+        self._admit()
+        return self._decode_tokens()
+
+    def _admit(self):
+        first = self._prefill()
+        # Loop-free, helper-deep: invisible to the old loop heuristic.
+        return jax.device_get(first)
+
+    def _decode_tokens(self):
+        return jax.device_get(self._w)
+
+    def offline_report(self):
+        # NOT reachable from step/_decode_tokens: no finding.
+        return jax.device_get(self._w)
+"""
+
+
+def test_sh002_engine_call_path_flags_helper_syncs():
+    """A sync anywhere on an Engine class's step()-reachable call-path
+    is a per-window/per-admission round trip — flagged without needing
+    a loop around it (the per-prefill top-logprobs pull hid exactly
+    this way)."""
+    found = lint_snippet(SH002_ENGINE_PATH, select=["SH002"])
+    assert codes(found) == ["SH002"] and len(found) == 2
+    assert all("call-path" in f.message for f in found)
+
+
+def test_sh002_engine_call_path_subclass_override():
+    """A subclass hook reached through an inherited step() is on the
+    path too (module-local MRO merge)."""
+    src = SH002_ENGINE_PATH + """
+
+class PagedDemoEngine(DemoEngine):
+    def _prefill(self):
+        return jax.device_get(self._scratch)
+"""
+    found = lint_snippet(src, select=["SH002"])
+    assert len(found) == 3
+    assert any("PagedDemoEngine" in f.message for f in found)
+
+
+def test_sh002_engine_call_path_respects_suppression():
+    src = SH002_ENGINE_PATH.replace(
+        "return jax.device_get(self._w)\n\n    def offline_report",
+        "return jax.device_get(self._w)  "
+        "# shellac: ignore[SH002] — the one designed sync\n\n"
+        "    def offline_report",
+    )
+    found = lint_snippet(src, select=["SH002"])
+    assert len(found) == 1  # only the _admit pull remains
+
+
+def test_sh002_non_engine_class_step_not_flagged():
+    src = """
+import jax
+
+class Router:
+    def step(self):
+        return jax.device_get(self._x)
+"""
+    assert lint_snippet(src, select=["SH002"]) == []
+
+
 # ---- SH003 trace-time nondeterminism -------------------------------
 
 

@@ -250,24 +250,47 @@ def test_head_dim_64_matches_ref(paged):
 
 
 @pytest.mark.parametrize(
-    "bs,d", [(12, 128), (16, 96)],
+    "bs,d", [(12, 128), (32, 96)],
     ids=["bad_page_size", "bad_head_dim"],
 )
-def test_paged_fallback_warns_on_tpu_like_backend(monkeypatch, bs, d):
-    """On a Pallas-capable backend, silently losing the paged kernel to
-    the dense-gather fallback must surface a PagedFallbackWarning."""
+def test_paged_quant_fallback_warns_on_tpu_like_backend(monkeypatch, bs, d):
+    """Int8 pools still WANT the kernel under auto (the ref fallback
+    dequantizes gathered pages every tick), so silently losing it to a
+    disqualifying shape must surface a PagedFallbackWarning."""
     import shellac_tpu.ops.decode_attention as da
 
     monkeypatch.setattr(da, "pallas_supported", lambda: True)
     n_blocks, max_blocks = 5, 4
     q = jnp.zeros((1, 1, 4, d))
-    pool = jnp.zeros((n_blocks, 4, bs, d))
+    pool = jnp.zeros((n_blocks, 4, bs, d), jnp.int8)
+    scale = jnp.ones((n_blocks, 4, bs), jnp.float32)
     tables = jnp.arange(1, 1 + max_blocks, dtype=jnp.int32)[None, :]
     index = jnp.zeros((1,), jnp.int32)
     with pytest.warns(da.PagedFallbackWarning, match="falling"):
         da.paged_decode_attention(
-            q, pool, pool, tables, index, interpret=True
+            q, pool, pool, tables, index, interpret=True,
+            k_scale=scale, v_scale=scale,
         )
+
+
+def test_paged_bf16_auto_prefers_reference(monkeypatch):
+    """bf16 pools default to the XLA reference under auto even on a
+    Pallas-capable backend (the grouped-gather kernel has never beaten
+    it on hardware — BENCH_DECODE), and that is a decision, not a
+    fallback: no warning."""
+    import warnings as _w
+
+    import shellac_tpu.ops.decode_attention as da
+
+    monkeypatch.setattr(da, "pallas_supported", lambda: True)
+    q = jnp.zeros((1, 1, 4, 128))
+    pool = jnp.zeros((5, 4, 16, 128))
+    tables = jnp.arange(1, 5, dtype=jnp.int32)[None, :]
+    index = jnp.zeros((1,), jnp.int32)
+    with _w.catch_warnings():
+        _w.simplefilter("error", da.PagedFallbackWarning)
+        da.paged_decode_attention(q, pool, pool, tables, index,
+                                  interpret=True)
 
 
 def test_paged_supported_shapes_do_not_warn():

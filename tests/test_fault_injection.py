@@ -511,6 +511,130 @@ class TestAbortAll:
         assert len(results["b"]) == 4
 
 
+class TestOverlapFaults:
+    """Overlapped decode dispatch x the failure machinery: a window in
+    flight when the supervisor/watchdog/abort path fires must be
+    DRAINED (synced and discarded), never attributed to a successor
+    request or generation."""
+
+    def test_wedge_recovers_onto_fresh_overlap_engine(self):
+        """Wedge -> watchdog -> rebuild, with BOTH generations running
+        overlap_decode=True: the rebuilt generation serves correct,
+        strict-ordering-identical output."""
+        import numpy as np
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = _WedgingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0, good_steps=0,
+                             overlap_decode=True, decode_ticks=2)
+
+        def factory():
+            return BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  temperature=0.0, overlap_decode=True,
+                                  decode_ticks=2)
+
+        srv = InferenceServer(cfg, params, engine=eng, step_timeout=10.0,
+                              restart_budget=2, engine_factory=factory)
+        gen0_thread = srv._thread
+        try:
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "ok")
+            out = srv.generate([4, 5, 6], max_new=6, timeout=120)
+            ref = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                 temperature=0.0, decode_ticks=2)
+            want = ref.run([("r", np.array([4, 5, 6], np.int32), 6)])["r"]
+            assert list(out) == list(want)
+        finally:
+            _teardown(srv, eng, old_threads=(gen0_thread,))
+
+    def test_abort_all_mid_window_no_stale_leak(self):
+        """The resync/rebuild cleanup contract under overlap: windows
+        in flight at abort_all are synced-and-discarded, and the next
+        tenant of every slot produces exactly the strict-ordering
+        output (no stale-generation tokens leak)."""
+        import numpy as np
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0, overlap_decode=True,
+                             decode_ticks=3)
+        eng.submit("a", np.array([1, 2, 3], np.int32), 20)
+        eng.submit("b", np.array([4, 5], np.int32), 20)
+        eng.step()
+        eng.step()  # a window is in flight beyond the settled one
+        assert eng._windows, "pipeline never engaged"
+        dropped = eng.abort_all()
+        assert sorted(dropped) == ["a", "b"]
+        assert not eng._windows
+        results = eng.run([("fresh", np.array([7, 8], np.int32), 6)])
+        ref = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0, decode_ticks=3)
+        want = ref.run([("fresh", np.array([7, 8], np.int32), 6)])
+        assert {k: list(v) for k, v in results.items()} == {
+            k: list(v) for k, v in want.items()}
+
+    def test_streaming_deltas_under_overlap(self):
+        """The server's streaming invariant (out only ever grows;
+        holdback protects stop truncation) holds when deltas arrive in
+        overlapped window batches."""
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        srv = InferenceServer(cfg, params, n_slots=2, max_len=64,
+                              temperature=0.0, overlap_decode=True,
+                              decode_ticks=2)
+        try:
+            deltas, final = [], None
+            for kind, val in srv.generate_stream([1, 2, 3], max_new=8,
+                                                 timeout=120):
+                if kind == "delta":
+                    deltas.append(list(val))
+                else:
+                    final = list(val)
+            streamed = [t for d in deltas for t in d]
+            assert final is not None and len(final) == 8
+            assert streamed == final[:len(streamed)]
+        finally:
+            srv.close()
+
+    def test_deadline_shed_with_overlap_engine(self):
+        """Deadline shedding composes with the overlapped engine: a
+        request whose deadline expires while the scheduler is parked in
+        a gated step is shed before prefill (same contract as
+        TestDeadlineShedding, on the overlap pipeline)."""
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = _GatedEngine(cfg, params, n_slots=2, max_len=64,
+                           temperature=0.0, overlap_decode=True,
+                           decode_ticks=2)
+        srv = InferenceServer(cfg, params, engine=eng)
+        try:
+            results = []
+            t = threading.Thread(target=lambda: results.append(
+                srv.generate([1, 2, 3], max_new=4, timeout=120)))
+            t.start()
+            deadline = time.monotonic() + 60
+            while not srv._pending and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.2)  # scheduler is now inside the gated step
+            with pytest.raises(TimeoutError):
+                srv.generate([5, 6], max_new=4, timeout=0.2)
+            time.sleep(0.1)
+            eng.gate.set()
+            t.join(timeout=120)
+            assert results and len(results[0]) == 4
+            deadline = time.monotonic() + 60
+            while srv.shed < 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert srv.shed == 1
+            assert eng.stats["prefills"] == 1
+        finally:
+            eng.gate.set()
+            srv.close()
+
+
 class TestAdmissionControl:
     def test_over_limit_rejected_429(self):
         cfg, params, eng = _mk(good_steps=0)
