@@ -661,12 +661,65 @@ def cmd_generate(args):
         temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
         kv_quant=args.kv_quant,
     )
-    if args.num_beams and args.num_beams > 1:
-        seqs, scores = eng.beam_search(
-            jnp.asarray(prompt)[0], num_beams=args.num_beams,
-            max_new_tokens=args.max_new, eos_id=args.eos_id,
-            length_penalty=args.length_penalty,
+    constraint = None
+    if getattr(args, "json_schema", None):
+        # Schema-constrained beams: compile through the same
+        # schema->regex->token-DFA path the server uses, so the CLI
+        # surface and HTTP surface cannot drift (docs/
+        # structured_output.md).
+        if not args.num_beams or args.num_beams < 1:
+            raise SystemExit("--json-schema needs --num-beams >= 1 "
+                             "(constrained beam search)")
+        if args.eos_id is None:
+            raise SystemExit("--json-schema needs --eos-id (the DFA's "
+                             "EOS column and beam termination must "
+                             "agree)")
+        if args.stop_text:
+            # The HTTP surface refuses stop with num_beams for the
+            # same reason: truncating a schema-constrained beam can
+            # leave schema-INVALID output, contradicting the flag's
+            # promise.
+            raise SystemExit("--stop-text does not compose with "
+                             "--json-schema (truncation could break "
+                             "the schema)")
+        raw = args.json_schema
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        try:
+            schema = json.loads(raw)
+        except ValueError as e:
+            raise SystemExit(f"--json-schema is not valid JSON: {e}")
+        if tok is None:
+            from shellac_tpu.training.tokenizer import get_tokenizer
+
+            tok = get_tokenizer(args.tokenizer)
+        from shellac_tpu.inference.constraints import (
+            compile_token_dfa,
+            constraint_pattern,
         )
+
+        try:
+            constraint = compile_token_dfa(
+                constraint_pattern({"json_schema": schema}), tok,
+                cfg.vocab_size, args.eos_id,
+            )
+        except ValueError as e:
+            raise SystemExit(f"--json-schema: {e}")
+    if args.num_beams and (args.num_beams > 1 or constraint is not None):
+        try:
+            seqs, scores = eng.beam_search(
+                jnp.asarray(prompt)[0], num_beams=args.num_beams,
+                max_new_tokens=args.max_new, eos_id=args.eos_id,
+                length_penalty=args.length_penalty,
+                constraint=constraint,
+            )
+        except ValueError as e:
+            raise SystemExit(f"beam search: {e}")
+        if not seqs:
+            raise SystemExit("constrained beam search returned no "
+                             "valid beams (max-new too small for the "
+                             "schema?)")
         ids = np.asarray(apply_stop(np.asarray(seqs[0], np.int64)))
         result = {
             "tokens": ids.tolist(),
@@ -1222,6 +1275,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(0 = raw sum, 1 = mean logprob)")
     g.add_argument("--eos-id", type=int, default=None, dest="eos_id",
                    help="EOS token id for beam finishing")
+    g.add_argument("--json-schema", default=None, dest="json_schema",
+                   help="JSON schema (inline, or @file) compiled to a "
+                        "token-DFA constraint for beam search: every "
+                        "returned beam satisfies the schema. Needs "
+                        "--num-beams and --eos-id")
     g.add_argument("--ckpt-dir")
     g.add_argument("--native-dir", dest="native_dir",
                    help="directory written by `convert`")

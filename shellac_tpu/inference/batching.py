@@ -398,6 +398,10 @@ class BatchingEngine:
         self._queue: deque[_Request] = deque()
         self._slots: List[Optional[_Request]] = [None] * n_slots
         self._prefill_jit: Dict[int, Any] = {}  # bucketed by padded S
+        # Lazily built single-request Engine sharing these params:
+        # the dense beam_search() entry point (the paged subclass
+        # searches its own block pool instead).
+        self._beam_delegate = None
         # The decode jit is built lazily (first _decode_tokens): with a
         # mesh its out_shardings pin the cache layout, and the paged
         # subclass swaps in its own cache (different pytree) after this
@@ -1846,6 +1850,39 @@ class BatchingEngine:
                 results[rid] = out
         return results
 
+    # ---- beam search (dense caches) ----------------------------------
+
+    def beam_search(self, prompt_tokens, *, num_beams: int = 4,
+                    max_new_tokens: int = 32, eos_id=None,
+                    length_penalty: float = 1.0, constraint=None):
+        """Deterministic beam decode of ONE prompt on this engine's
+        params — the HTTP-facing entry point (server `num_beams`).
+
+        Dense/int8/rolling caches delegate to a lazily built
+        single-request Engine SHARING the params (jax arrays are
+        immutable, so no copy; the delegate allocates its own
+        (num_beams, max_len) cache per call and frees it on return —
+        the slot batch is untouched). The paged subclass overrides
+        this with its copy-on-write block-table search. Caller must be
+        the engine-owning thread, like step()/submit(). `constraint`
+        (a compiled constraints.TokenDFA) masks every beam through the
+        grammar; invalid beams are pruned."""
+        if eos_id is None:
+            eos_id = self.eos_id
+        if self._beam_delegate is None:
+            from shellac_tpu.inference.engine import Engine
+
+            self._beam_delegate = Engine(
+                self.cfg, self.params, max_len=self.max_len,
+                mesh=self.mesh, kv_quant=self.kv_quant,
+                rolling_window=self.rolling_window,
+            )
+        return self._beam_delegate.beam_search(
+            prompt_tokens, num_beams=num_beams,
+            max_new_tokens=max_new_tokens, eos_id=eos_id,
+            length_penalty=length_penalty, constraint=constraint,
+        )
+
 
 class PagedBatchingEngine(BatchingEngine):
     """Continuous batching over a shared block pool (paged KV cache).
@@ -2315,12 +2352,15 @@ class PagedBatchingEngine(BatchingEngine):
 
     def beam_search(self, prompt_tokens, *, num_beams: int = 4,
                     max_new_tokens: int = 32, eos_id=None,
-                    length_penalty: float = 1.0):
+                    length_penalty: float = 1.0, constraint=None):
         """Deterministic beam decode of ONE prompt over the block pool.
 
         Returns (sequences, scores) — the same contract as
         Engine.beam_search, and bit-identical beams to the dense-cache
-        implementation (tests/test_beam_search.py paged cases).
+        implementation (tests/test_beam_search.py paged cases). A
+        compiled `constraint` (constraints.TokenDFA) masks each beam
+        through its own DFA state exactly like the dense search — the
+        shared beam_expand helper owns the math for both.
 
         Copy-on-write mechanics (the public vLLM CoW idea, expressed
         functionally so the whole search stays one jitted scan):
@@ -2351,12 +2391,19 @@ class PagedBatchingEngine(BatchingEngine):
         pool is zero-width): both are bit-identical to their
         dense-cache beams.
         """
+        from shellac_tpu.inference.engine import check_beam_constraint
+
         k_beams = int(num_beams)
         steps = int(max_new_tokens)
         if k_beams < 1:
             raise ValueError("num_beams must be >= 1")
         if steps < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if eos_id is None and constraint is not None:
+            eos_id = self.eos_id
+        ctrans, eos_id = check_beam_constraint(
+            constraint, eos_id, self.cfg.vocab_size
+        )
         toks = np.asarray(prompt_tokens, np.int32).reshape(-1)
         s = int(toks.size)
         bs = self.block_size
@@ -2418,7 +2465,8 @@ class PagedBatchingEngine(BatchingEngine):
             tokens_pad = np.zeros((1, s_pad), np.int32)
             tokens_pad[0, :s_suf] = toks[m_tokens:]
             jit_key = (s_pad, k_beams, steps, eos_id,
-                       float(length_penalty), n_gen, m_tokens > 0)
+                       float(length_penalty), n_gen, m_tokens > 0,
+                       ctrans is not None)
             pool_fields = kv_field_names(self.kv_quant)
             fn = self._beam_jit.get(jit_key)
             if fn is None:
@@ -2444,7 +2492,7 @@ class PagedBatchingEngine(BatchingEngine):
                 jnp.full((1,), s_suf, jnp.int32),
                 jnp.full((1,), m_tokens, jnp.int32),
                 jnp.asarray(tables0), jnp.asarray(gen_ids),
-                jnp.int32(lb0),
+                jnp.int32(lb0), ctrans,
             )
             self._cache = self._cache.replace(
                 **dict(zip(pool_fields, pools))
@@ -2453,12 +2501,13 @@ class PagedBatchingEngine(BatchingEngine):
         finally:
             self._free.extend(borrowed)
             self._detach_prefix(matched)
-        seqs = [r[:n].tolist() for r, n in zip(out, lens)]
-        return seqs, [float(x) for x in norm]
+        from shellac_tpu.inference.engine import beam_filter_invalid
+
+        return beam_filter_invalid(out, norm, lens)
 
     def _beam_paged_impl(self, params, pools, tokens, prompt_len,
                          suffix_len, prefix_len, tables0, gen_ids, lb0,
-                         *, steps, eos_id, length_penalty,
+                         ctrans=None, *, steps, eos_id, length_penalty,
                          has_prefix=False):
         """Device side of beam_search: prefill once through the shared
         prompt table row, then the dense beam loop with table-gather
@@ -2543,7 +2592,9 @@ class PagedBatchingEngine(BatchingEngine):
             beam_rank,
         )
 
-        scores, beam0, tok0 = beam_first_expand(last, k_beams)
+        scores, beam0, tok0, cstate0 = beam_first_expand(
+            last, k_beams, ctrans, eos_id
+        )
         tables = tables0[beam0]  # rows identical; kept for symmetry
         finished0 = ((tok0 == eos_id) if eos_id is not None
                      else jnp.zeros((k_beams,), bool))
@@ -2595,7 +2646,7 @@ class PagedBatchingEngine(BatchingEngine):
         # would mark the host-side engine step() as traced too.
         def beam_step(carry, _):
             (pools, tables, cur, scores, finished, out, lens,
-             lengths, i) = carry
+             lengths, cstate, i) = carry
             cache = make_cache(pools, tables, lengths)
             logits, cache = transformer.forward_with_cache(
                 cfg, params, cur[:, None], cache,
@@ -2603,9 +2654,10 @@ class PagedBatchingEngine(BatchingEngine):
             )
             pools = tuple(getattr(cache, f) for f in mini_fields)
             lengths = cache.lengths
-            (scores, beam, tok, out, lens, finished,
-             was_done) = beam_expand(
-                logits[:, 0], scores, finished, out, lens, i, eos_id
+            (scores, beam, tok, out, lens, finished, was_done,
+             cstate) = beam_expand(
+                logits[:, 0], scores, finished, out, lens, i, eos_id,
+                ctrans, cstate,
             )
             tables = tables[beam]
             lengths = lengths[beam]
@@ -2615,11 +2667,11 @@ class PagedBatchingEngine(BatchingEngine):
             tables = scratch_frozen(tables, finished)
             pools, tables = cow(pools, tables, lengths, ~finished)
             return (pools, tables, tok, scores, finished, out, lens,
-                    lengths, i + 1), None
+                    lengths, cstate, i + 1), None
 
         carry = (pools, tables, tok0, scores, finished0, out0, lens0,
-                 lengths0, jnp.int32(1))
-        (pools, _, _, scores, _, out, lens, _, _), _ = jax.lax.scan(
+                 lengths0, cstate0, jnp.int32(1))
+        (pools, _, _, scores, _, out, lens, _, _, _), _ = jax.lax.scan(
             beam_step, carry, None, length=steps - 1
         )
         out, norm, lens = beam_rank(scores, out, lens, length_penalty)

@@ -30,11 +30,13 @@ empty, SURVEY.md §0; no code is derived from it):
 JSON-schema support generates a regex for a schema subset — optional
 properties (the `required` list is honored; undeclared = optional,
 per the JSON-Schema spec), anyOf/oneOf alternation, const/enum with
-any Unicode content, nested arrays/objects — and reuses the same
-pipeline: one compiler, one device representation, one masking path.
-Property ORDER stays fixed (the public structured-output norm for
-regex-compiled schemas) and additionalProperties must be false/absent
-(an open object cannot be bounded by a regex).
+any Unicode content, nested arrays/objects, local `$ref`
+(`#/$defs/...`, cycle-detected), common string `format`s (date-time,
+date, uuid, email), and `additionalProperties: true` (extra pairs
+append after the declared sequence via the depth-limited generic-JSON
+grammar) — and reuses the same pipeline: one compiler, one device
+representation, one masking path. Property ORDER stays fixed (the
+public structured-output norm for regex-compiled schemas).
 
 TPU-first consequences of this design: the per-step work is a gather
 + select (no data-dependent shapes, no host round trip), the table is
@@ -49,7 +51,7 @@ vocabularies.
 from __future__ import annotations
 
 import json
-from typing import Dict, FrozenSet, List, Optional, Tuple
+from typing import Any, Dict, FrozenSet, List, Optional, Tuple
 
 import numpy as np
 
@@ -65,9 +67,11 @@ MAX_WALK_ENTRIES = 32_000_000
 
 _MAX_CP = 0x10FFFF
 # '.' excludes newline (standard default); surrogates are not valid
-# codepoints. Negated classes complement within this same universe.
+# codepoints.
 _DOT_RANGES = ((0x00, 0x09), (0x0B, 0xD7FF), (0xE000, _MAX_CP))
-# Explicit characters may include newline.
+# The full universe, newline included. Negated classes ([^x]) and the
+# complemented escapes (\D \W \S) complement within THIS universe —
+# standard regex semantics, where only '.' excludes newline.
 _ANY_RANGES = ((0x00, 0xD7FF), (0xE000, _MAX_CP))
 
 Ranges = Tuple[Tuple[int, int], ...]
@@ -99,7 +103,7 @@ def _intersect(a: Ranges, b: Ranges) -> Ranges:
     return _norm_ranges(out)
 
 
-def _complement(a: Ranges, universe: Ranges = _DOT_RANGES) -> Ranges:
+def _complement(a: Ranges, universe: Ranges = _ANY_RANGES) -> Ranges:
     out = []
     for ulo, uhi in universe:
         cur = ulo
@@ -291,6 +295,14 @@ class _Regex:
                     hi_cp = sub[0][0]
                 else:
                     hi_cp = ord(hi)
+                if ord(ch) > hi_cp:
+                    # Standard engines reject [z-a]; silently narrowing
+                    # the class would change the constrained language
+                    # with no error at submit time.
+                    raise ValueError(
+                        f"bad character range {ch}-{chr(hi_cp)} in "
+                        f"{self.p!r} (reversed endpoints)"
+                    )
                 pairs.append((ord(ch), hi_cp))
             else:
                 pairs.append((ord(ch), ord(ch)))
@@ -692,8 +704,67 @@ _NUM = _INT + r"(\.[0-9]+)?([eE][-+]?[0-9]+)?"
 _BOOL = r"(true|false)"
 _NULL = r"null"
 
+# String `format`s lowered to regex fragments (the body between the
+# quotes). These are the high-traffic tool-schema formats; unknown
+# formats stay annotations (JSON-Schema's default vocabulary) and fall
+# back to the free string grammar.
+_TIME_BODY = (r"([01][0-9]|2[0-3]):[0-5][0-9]:[0-5][0-9](\.[0-9]+)?"
+              r"(Z|[+\-]([01][0-9]|2[0-3]):[0-5][0-9])")
+_DATE_BODY = r"[0-9]{4}-(0[1-9]|1[0-2])-(0[1-9]|[12][0-9]|3[01])"
+_FORMAT_BODIES = {
+    "date": _DATE_BODY,
+    "date-time": _DATE_BODY + "T" + _TIME_BODY,
+    "uuid": (r"[0-9a-fA-F]{8}-[0-9a-fA-F]{4}-[0-9a-fA-F]{4}-"
+             r"[0-9a-fA-F]{4}-[0-9a-fA-F]{12}"),
+    "email": (r"[A-Za-z0-9._%+\-]+@[A-Za-z0-9.\-]+\.[A-Za-z]{2,}"),
+}
 
-def _schema_regex(schema: dict, depth: int = 3) -> str:
+
+def _resolve_ref(root: dict, ref: str) -> dict:
+    """Resolve a LOCAL JSON-pointer reference ('#/$defs/name',
+    '#/definitions/name', or any '#/...' path) against the root
+    schema. Remote/URL refs are refused loudly — this compiler has no
+    retrieval layer, and silently treating them as free strings would
+    change the constrained language."""
+    if not isinstance(ref, str) or not ref.startswith("#"):
+        raise ValueError(
+            f"$ref {ref!r}: only local '#/...' references are supported"
+        )
+    node: Any = root
+    for part in ref[1:].split("/"):
+        if not part:
+            continue
+        part = part.replace("~1", "/").replace("~0", "~")
+        if isinstance(node, dict) and part in node:
+            node = node[part]
+        elif isinstance(node, list) and part.isdigit() \
+                and int(part) < len(node):
+            node = node[int(part)]
+        else:
+            raise ValueError(f"$ref {ref!r}: path not found in schema")
+    if not isinstance(node, dict):
+        raise ValueError(f"$ref {ref!r}: target is not a schema object")
+    return node
+
+
+def _schema_regex(schema: dict, depth: int = 3, root: Optional[dict] = None,
+                  seen: Tuple[str, ...] = ()) -> str:
+    # `root` anchors $ref resolution ('#/...' points at the top-level
+    # schema); `seen` is the ref chain of THIS path, so a reference
+    # cycle (A -> B -> A) fails loudly instead of recursing forever —
+    # a regex cannot express a recursive grammar.
+    if root is None:
+        root = schema
+    if "$ref" in schema:
+        ref = schema["$ref"]
+        if ref in seen:
+            raise ValueError(
+                f"cyclic $ref chain {' -> '.join(seen + (ref,))}: a "
+                "recursive schema cannot be regex-bounded"
+            )
+        return _schema_regex(
+            _resolve_ref(root, ref), depth, root, seen + (ref,)
+        )
     t = schema.get("type")
     for alt_key in ("anyOf", "oneOf"):
         if alt_key in schema:
@@ -704,7 +775,7 @@ def _schema_regex(schema: dict, depth: int = 3) -> str:
             if not isinstance(subs, list) or not subs:
                 raise ValueError(f"{alt_key} must be a non-empty list")
             return ("(" + "|".join(
-                _schema_regex(s, depth) for s in subs
+                _schema_regex(s, depth, root, seen) for s in subs
             ) + ")")
     if "const" in schema:
         return _escape_literal(
@@ -727,6 +798,9 @@ def _schema_regex(schema: dict, depth: int = 3) -> str:
             # Group the user pattern: a top-level '|' must stay scoped
             # to the string body, not split the whole grammar.
             return '"(' + schema["pattern"] + ')"'
+        fmt = schema.get("format")
+        if fmt in _FORMAT_BODIES:
+            return '"' + _FORMAT_BODIES[fmt] + '"'
         return _STR
     if t == "integer":
         return _INT
@@ -739,20 +813,34 @@ def _schema_regex(schema: dict, depth: int = 3) -> str:
     if t == "array":
         if depth <= 0:
             raise ValueError("schema nests deeper than supported")
-        item = _schema_regex(schema.get("items", {}), depth - 1)
+        item = _schema_regex(schema.get("items", {}), depth - 1, root,
+                             seen)
         return r"\[(" + item + r"(," + item + r")*)?\]"
     if t == "object" or "properties" in schema:
         if depth <= 0:
             raise ValueError("schema nests deeper than supported")
-        if schema.get("additionalProperties", False):
-            raise ValueError(
-                "additionalProperties: true cannot be regex-bounded; "
-                "declare the properties or drop the key (absent/false "
-                "both mean declared-only)"
-            )
+        ap = schema.get("additionalProperties", False)
+        extra_pair: Optional[str] = None
+        if ap is not False and ap is not None:
+            # Open object: undeclared pairs append AFTER the declared
+            # (fixed-order) sequence. additionalProperties: true values
+            # use the depth-limited generic-JSON grammar; a schema
+            # constrains them like any declared property. The regex
+            # cannot forbid an extra pair from re-using a declared
+            # name — json.loads keeps the LAST occurrence (documented
+            # in docs/structured_output.md).
+            val = (_generic_json_regex(depth - 1, kind="value")
+                   if ap is True
+                   else _schema_regex(ap, depth - 1, root, seen))
+            extra_pair = _STR + ":" + val
         props = schema.get("properties", {})
         if not props:
-            # Free-form object: depth-limited generic JSON.
+            # Free-form object: depth-limited generic JSON (with an
+            # additionalProperties SCHEMA, its grammar types the
+            # values).
+            if extra_pair is not None and ap is not True:
+                return (r"\{(" + extra_pair
+                        + "(," + extra_pair + r")*)?\}")
             return _generic_json_regex(depth - 1, kind="object")
         required = schema.get("required")
         if required is None:
@@ -772,23 +860,34 @@ def _schema_regex(schema: dict, depth: int = 3) -> str:
             key = _escape_literal(
                 json.dumps(name, ensure_ascii=False)
             )
-            parts.append((key + ":" + _schema_regex(sub, depth - 1),
+            parts.append((key + ":"
+                          + _schema_regex(sub, depth - 1, root, seen),
                           name in req))
         # Fixed property order (the public structured-output norm for
         # regex-compiled schemas), compact separators; optional
         # properties may be absent, commas only between present ones.
-        return r"\{" + _prop_sequence(parts) + r"\}"
+        nonempty, can_empty = _prop_core(parts)
+        if extra_pair is not None:
+            tail = "(," + extra_pair + ")*"
+            declared = "(" + nonempty + ")" + tail
+            alone = extra_pair + tail
+            if can_empty:
+                return r"\{(" + declared + "|" + alone + r")?\}"
+            return r"\{" + declared + r"\}"
+        return (r"\{(" + nonempty + r")?\}" if can_empty
+                else r"\{" + nonempty + r"\}")
     if t is None and not schema:
         return _generic_json_regex(depth - 1, kind="value")
     raise ValueError(f"unsupported schema fragment: {schema!r}")
 
 
-def _prop_sequence(parts: List[Tuple[str, bool]]) -> str:
+def _prop_core(parts: List[Tuple[str, bool]]) -> Tuple[str, bool]:
     """Regex for fixed-order, comma-separated properties where
-    optional ones may be absent.
+    optional ones may be absent: returns (regex of the NON-EMPTY
+    realizations, may-the-whole-sequence-be-empty).
 
     Built right-to-left: for each suffix of the property list, compose
-    (a) the regex of its NON-EMPTY realizations and (b) whether it may
+    (a) the regex of its non-empty realizations and (b) whether it may
     be empty. A required property anchors its suffix non-empty; an
     optional one alternates 'present (with correctly-placed comma)'
     against the rest."""
@@ -809,7 +908,7 @@ def _prop_sequence(parts: List[Tuple[str, bool]]) -> str:
                         if nonempty is not None else core)
             # can_empty unchanged: this property may be skipped.
     assert nonempty is not None
-    return "(" + nonempty + ")?" if can_empty else nonempty
+    return nonempty, can_empty
 
 
 def _escape_literal(s: str) -> str:

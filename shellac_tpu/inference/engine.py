@@ -213,15 +213,20 @@ class Engine:
         return cache.replace(lengths=cache.lengths[idx], **fields)
 
     def _beam_impl(self, params, first_logits, cache, steps, eos_id,
-                   length_penalty):
+                   length_penalty, ctrans=None):
         """Device-side beam loop: one forward per step for all beams,
         flat top-k over (K, V) candidates, cache rows gathered by the
         winning beams (the standard public algorithm, built on the same
         scanned cached forward as sampling). The expansion/bookkeeping
         math lives in the shared beam_* helpers below so the paged
-        engine's CoW beam cannot drift from this one."""
+        engine's CoW beam cannot drift from this one. `ctrans` (a
+        TokenDFA table) constrains the search: each beam's logprobs
+        are masked through its own DFA row before scoring and the
+        per-beam state rides the reorder with the beam."""
         k, _ = first_logits.shape
-        scores, beam0, tok0 = beam_first_expand(first_logits[0], k)
+        scores, beam0, tok0, cstate0 = beam_first_expand(
+            first_logits[0], k, ctrans, eos_id
+        )
         cache = self._reorder_cache(cache, beam0)
         finished0 = (tok0 == eos_id) if eos_id is not None else (
             jnp.zeros((k,), bool)
@@ -230,13 +235,14 @@ class Engine:
         lens0 = jnp.ones((k,), jnp.int32)
 
         def step(carry, _):
-            cache, cur, scores, finished, out, lens, i = carry
+            cache, cur, scores, finished, out, lens, cstate, i = carry
             logits, cache = transformer.forward_with_cache(
                 self.cfg, params, cur[:, None], cache, mesh=self.mesh
             )
-            (scores, beam, tok, out, lens, finished,
-             was_done) = beam_expand(
-                logits[:, 0], scores, finished, out, lens, i, eos_id
+            (scores, beam, tok, out, lens, finished, was_done,
+             cstate) = beam_expand(
+                logits[:, 0], scores, finished, out, lens, i, eos_id,
+                ctrans, cstate,
             )
             cache = self._reorder_cache(cache, beam)
             # A frozen beam must not grow its cache: re-feeding EOS
@@ -247,11 +253,12 @@ class Engine:
                     was_done, cache.lengths - 1, cache.lengths
                 )
             )
-            return (cache, tok, scores, finished, out, lens, i + 1), None
+            return (cache, tok, scores, finished, out, lens, cstate,
+                    i + 1), None
 
-        carry = (cache, tok0, scores, finished0, out0, lens0,
+        carry = (cache, tok0, scores, finished0, out0, lens0, cstate0,
                  jnp.int32(1))
-        (cache, _, scores, finished, out, lens, _), _ = jax.lax.scan(
+        (cache, _, scores, finished, out, lens, _, _), _ = jax.lax.scan(
             step, carry, None, length=steps - 1
         )
         return beam_rank(scores, out, lens, length_penalty)
@@ -264,13 +271,20 @@ class Engine:
         max_new_tokens: int = 32,
         eos_id: Optional[int] = None,
         length_penalty: float = 1.0,
+        constraint=None,
     ):
         """Deterministic beam decode of ONE prompt.
 
-        Returns (sequences, scores): sequences is a list of num_beams
-        token lists (EOS included when hit, best first), scores their
-        length-penalized log-probabilities. The dense/int8/rolling
-        caches gather rows directly; for block pools use
+        Returns (sequences, scores): sequences is a list of up to
+        num_beams token lists (EOS included when hit, best first),
+        scores their length-penalized log-probabilities. With a
+        compiled `constraint` (constraints.TokenDFA), every beam's
+        candidates are masked through its own DFA state before scoring
+        — each returned sequence satisfies the grammar — and beams
+        forced onto masked candidates (fewer legal continuations than
+        beams) are pruned from the result, so fewer than num_beams
+        sequences may return. The dense/int8/rolling caches gather
+        rows directly; for block pools use
         PagedBatchingEngine.beam_search, which reorders via
         copy-on-write block tables and returns bit-identical beams.
         """
@@ -278,6 +292,9 @@ class Engine:
             raise ValueError("num_beams must be >= 1")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        ctrans, eos_id = check_beam_constraint(
+            constraint, eos_id, self.cfg.vocab_size
+        )
         tokens = jnp.asarray(prompt_tokens, jnp.int32).reshape(1, -1)
         s = tokens.shape[1]
         if s + max_new_tokens + 1 > self.max_len:
@@ -296,40 +313,93 @@ class Engine:
         )
         out, norm, lens = self._beam(
             self.params, first_logits, cache, int(max_new_tokens),
-            eos_id, float(length_penalty),
+            eos_id, float(length_penalty), ctrans,
         )
         out, norm, lens = jax.device_get((out, norm, lens))
-        seqs = [row[:n].tolist() for row, n in zip(out, lens)]
-        return seqs, [float(x) for x in norm]
+        return beam_filter_invalid(out, norm, lens)
 
 
-def beam_first_expand(last_logits, k):
+#: Junk-beam score: a beam forced onto a constraint-masked candidate
+#: (fewer legal continuations than beams) carries this; the host-side
+#: BEAM_INVALID filter drops it from the returned set.
+BEAM_NEG = jnp.float32(-1e30)
+BEAM_INVALID = -1e20  # host-side validity threshold on final scores
+
+
+def _beam_mask(lp, row, eos_id):
+    """Mask a (K, V) logprob block by each beam's DFA row ((K, V+1);
+    -1 = disallowed, last column = EOS legality). Disallowed entries
+    drop to BEAM_NEG so a flat top-k can only pick them when fewer
+    than K legal candidates exist — those beams rank (and are pruned)
+    as invalid."""
+    allowed = row[:, :-1] >= 0
+    if eos_id is not None:
+        allowed = allowed.at[:, eos_id].set(row[:, -1] >= 0)
+    return jnp.where(allowed, lp, BEAM_NEG)
+
+
+def _beam_advance_state(row, cstate, tok, keep, eos_id):
+    """Advance each beam's DFA state past its selected token (`row` is
+    the pre-selection (K, V+1) table rows, already gathered by beam).
+    `keep` marks beams whose state must not move (frozen EOS
+    self-loops). Clipped at 0 so an invalid (masked-candidate) beam
+    stays traversable — its BEAM_NEG score already prunes it."""
+    col = tok
+    if eos_id is not None:
+        col = jnp.where(tok == eos_id, row.shape[1] - 1, tok)
+    nxt = jnp.take_along_axis(row, col[:, None], axis=1)[:, 0]
+    return jnp.where(keep, cstate, jnp.maximum(nxt, 0))
+
+
+def beam_first_expand(last_logits, k, ctrans=None, eos_id=None):
     """First beam expansion from ONE distribution (every beam holds the
     same prefill): masking all but beam 0 keeps the flat top-k from
-    picking duplicate (beam, token) pairs. last_logits: (V,). Returns
-    (scores, beam0, tok0), each (k,)."""
+    picking duplicate (beam, token) pairs. last_logits: (V,). With a
+    constraint table `ctrans`, the DFA's start row masks the
+    distribution and the returned per-beam states advance past each
+    selected token. Returns (scores, beam0, tok0, cstate0), each
+    (k,)."""
     lp0 = jax.nn.log_softmax(last_logits.astype(jnp.float32))
     v = lp0.shape[0]
-    scores0 = jnp.where(jnp.arange(k) == 0, 0.0, jnp.float32(-1e30))
+    if ctrans is not None:
+        lp0 = _beam_mask(lp0[None], ctrans[:1], eos_id)[0]
+    scores0 = jnp.where(jnp.arange(k) == 0, 0.0, BEAM_NEG)
     cand = (scores0[:, None] + lp0[None, :]).reshape(-1)
     scores, flat = jax.lax.top_k(cand, k)
-    return scores, flat // v, (flat % v).astype(jnp.int32)
+    tok0 = (flat % v).astype(jnp.int32)
+    cstate0 = jnp.zeros((k,), jnp.int32)
+    if ctrans is not None:
+        row = jnp.broadcast_to(ctrans[0][None], (k, ctrans.shape[1]))
+        cstate0 = _beam_advance_state(
+            row, cstate0, tok0, jnp.zeros((k,), bool), eos_id
+        )
+    return scores, flat // v, tok0, cstate0
 
 
-def beam_expand(logits, scores, finished, out, lens, i, eos_id):
+def beam_expand(logits, scores, finished, out, lens, i, eos_id,
+                ctrans=None, cstate=None):
     """One beam-search expansion: frozen-EOS self-loop, flat top-k over
     (K, V) candidates, and the out/lens/finished bookkeeping — SHARED
     by the dense loop (Engine._beam_impl) and the paged CoW loop
     (PagedBatchingEngine._beam_paged_impl) so their beams cannot
-    drift. Returns (scores, beam, tok, out, lens, finished, was_done);
-    the caller owns the cache reorder and length rollback."""
+    drift. With (ctrans, cstate) each live beam's logprobs are masked
+    by its own DFA row BEFORE scoring and the returned cstate advanced
+    with the beam reorder (frozen beams keep the EOS self-loop
+    regardless — they terminated in an accepting state). Returns
+    (scores, beam, tok, out, lens, finished, was_done, cstate); the
+    caller owns the cache reorder and length rollback."""
     k = scores.shape[0]
     v = logits.shape[-1]
     lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    row = None
+    if ctrans is not None:
+        row = ctrans[cstate]  # (K, V+1)
+        lp = _beam_mask(lp, row, eos_id)
     if eos_id is not None:
         # Finished beams persist unchanged: their only legal
-        # continuation is a zero-cost EOS self-loop.
-        frozen = jnp.full((v,), jnp.float32(-1e30)).at[eos_id].set(0.0)
+        # continuation is a zero-cost EOS self-loop (this wins over
+        # the constraint mask — the beam already terminated legally).
+        frozen = jnp.full((v,), BEAM_NEG).at[eos_id].set(0.0)
         lp = jnp.where(finished[:, None], frozen[None], lp)
     cand = (scores[:, None] + lp).reshape(-1)
     scores, flat = jax.lax.top_k(cand, k)
@@ -342,7 +412,13 @@ def beam_expand(logits, scores, finished, out, lens, i, eos_id):
         finished = was_done | (tok == eos_id)
     else:
         finished = was_done
-    return scores, beam, tok, out, lens, finished, was_done
+    if ctrans is not None:
+        cstate = _beam_advance_state(
+            row[beam], cstate[beam], tok, was_done, eos_id
+        )
+    elif cstate is not None:
+        cstate = cstate[beam]
+    return scores, beam, tok, out, lens, finished, was_done, cstate
 
 
 def beam_rank(scores, out, lens, length_penalty):
@@ -352,6 +428,63 @@ def beam_rank(scores, out, lens, length_penalty):
                               jnp.float32(length_penalty))
     order = jnp.argsort(-norm)
     return out[order], norm[order], lens[order]
+
+
+def check_beam_constraint(constraint, eos_id, vocab_size):
+    """Validate a beam-search constraint and resolve the EOS id the
+    search must use. Returns (ctrans device array or None, eos_id) —
+    the same submit-time contract the batching engine enforces:
+    termination (EOS finishing a beam) and the DFA's EOS column must
+    agree, or the mask would silently diverge from the search."""
+    if constraint is None:
+        return None, eos_id
+    from shellac_tpu.inference.constraints import TokenDFA
+
+    if not isinstance(constraint, TokenDFA):
+        raise ValueError(
+            "beam constraint must be a compiled constraints.TokenDFA "
+            "(the server compiles specs; library users call "
+            "compile_token_dfa)"
+        )
+    if constraint.trans.shape[1] != vocab_size + 1:
+        raise ValueError(
+            f"beam constraint table covers "
+            f"{constraint.trans.shape[1] - 1} tokens, model vocab is "
+            f"{vocab_size}"
+        )
+    if eos_id is None:
+        eos_id = constraint.eos_id
+    elif eos_id != constraint.eos_id:
+        raise ValueError(
+            f"beam constraint eos_id {constraint.eos_id} must equal "
+            f"the requested eos_id {eos_id} (termination and EOS "
+            "masking must agree)"
+        )
+    if not 0 <= eos_id < vocab_size:
+        # jnp .at[] clips an out-of-range index instead of raising, so
+        # an EOS the model cannot emit would silently corrupt another
+        # token's mask AND leave every beam unable to terminate-accept.
+        raise ValueError(
+            f"constraint eos_id {eos_id} is outside the model vocab "
+            f"({vocab_size}); the model cannot emit it"
+        )
+    return jnp.asarray(constraint.trans), eos_id
+
+
+def beam_filter_invalid(out, norm, lens):
+    """Host-side post-pass shared by the dense and paged searches:
+    drop beams whose score shows they were forced onto a masked
+    candidate (a constrained search with fewer legal continuations
+    than beams). The best beam always survives — the compiled DFA has
+    no dead states, so a legal path exists whenever the grammar is
+    non-empty."""
+    seqs, scores = [], []
+    for row, n, s in zip(out, lens, norm):
+        if float(s) <= BEAM_INVALID:
+            continue
+        seqs.append(row[:n].tolist())
+        scores.append(float(s))
+    return seqs, scores
 
 
 def truncate_at_stop(tokens, stop, prompt_outputs=None):

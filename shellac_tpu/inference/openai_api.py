@@ -88,6 +88,13 @@ def _common_sampling(payload: dict, native: dict):
     for key in ("presence_penalty", "frequency_penalty"):
         if payload.get(key) is not None:
             native[key] = float(payload[key])
+    if payload.get("num_beams") is not None:
+        # OpenAI-adjacent extension (like top_k): deterministic beam
+        # search; the ranked beams come back as the choices, each with
+        # a `beam_score`. Composes with response_format constraints.
+        native["num_beams"] = int(payload["num_beams"])
+        if payload.get("length_penalty") is not None:
+            native["length_penalty"] = float(payload["length_penalty"])
     if payload.get("timeout") is not None:
         # Native extension: the request deadline. The serving tier
         # forwards each attempt's REMAINING budget through this field
@@ -120,6 +127,9 @@ def _common_sampling(payload: dict, native: dict):
 def completion_to_native(payload: dict, tokenizer) -> dict:
     """/v1/completions -> native /generate payload."""
     _check_unsupported(payload)
+    for key in ("tools", "tool_choice", "parallel_tool_calls"):
+        if payload.get(key) is not None:
+            _bad(f"{key} is a chat-completions parameter")
     prompt = payload.get("prompt")
     if prompt is None:
         _bad('"prompt" is required')
@@ -179,9 +189,18 @@ def completion_to_native(payload: dict, tokenizer) -> dict:
 _FALLBACK_TEMPLATE_ROLES = ("system", "user", "assistant", "tool")
 
 
-def render_chat(messages: List[dict], tokenizer) -> str:
+def render_chat(messages: List[dict], tokenizer,
+                tools: Optional[List[dict]] = None) -> str:
     """Messages -> prompt text, via the tokenizer's chat template when
-    it has one (HF tokenizers), else a plain fallback format."""
+    it has one (HF tokenizers), else a plain fallback format.
+
+    Tool-aware: `tools` (validated function specs) render as a leading
+    system turn stating the wire contract (the sentinel + calls-array
+    surface the tool grammar enforces — stated explicitly even over an
+    HF template, whose own tool format the DFA cannot see). History
+    messages compose the other direction: an assistant turn carrying
+    `tool_calls` renders back into the exact surface the model emits,
+    and `tool` turns carry their `tool_call_id` inline."""
     if not messages:
         _bad('"messages" must be non-empty')
     def content_text(m):
@@ -203,20 +222,48 @@ def render_chat(messages: List[dict], tokenizer) -> str:
             return "".join(texts)
         _bad(f"message content must be a string or parts list, got {c!r}")
 
+    norm = []
     for m in messages:
-        if not isinstance(m, dict) or "role" not in m or "content" not in m:
+        if not isinstance(m, dict) or "role" not in m:
             _bad('each message needs "role" and "content"')
-        if m["role"] not in _FALLBACK_TEMPLATE_ROLES:
-            _bad(f"unknown role {m['role']!r}")
-    messages = [
-        {**m, "content": content_text(m)} for m in messages
-    ]
+        role = m["role"]
+        if role not in _FALLBACK_TEMPLATE_ROLES:
+            _bad(f"unknown role {role!r}")
+        if role == "assistant" and m.get("tool_calls"):
+            # Multi-turn agentic history: the model sees its own past
+            # calls in the format it produces (content, when present,
+            # precedes them — the "auto" text+call case).
+            from shellac_tpu.inference.tools import render_tool_calls
+
+            text = content_text(m) if m.get("content") is not None else ""
+            calls = render_tool_calls(m["tool_calls"])
+            norm.append({"role": role,
+                         "content": (text + "\n" + calls) if text
+                         else calls})
+            continue
+        if m.get("content") is None:
+            _bad('each message needs "role" and "content" (content may '
+                 'be omitted only on assistant turns with tool_calls)')
+        text = content_text(m)
+        if role == "tool" and m.get("tool_call_id"):
+            text = f"[{m['tool_call_id']}] {text}"
+        norm.append({"role": role, "content": text})
+    if tools:
+        from shellac_tpu.inference.tools import tools_prompt_block
+
+        norm.insert(0, {"role": "system",
+                        "content": tools_prompt_block(tools)})
     hf_tok = getattr(tokenizer, "_tok", None)
     if hf_tok is not None and getattr(hf_tok, "chat_template", None):
-        return hf_tok.apply_chat_template(
-            messages, tokenize=False, add_generation_prompt=True
-        )
-    parts = [f"<|{m['role']}|>\n{m['content']}\n" for m in messages]
+        try:
+            return hf_tok.apply_chat_template(
+                norm, tokenize=False, add_generation_prompt=True
+            )
+        except Exception as e:
+            # A template without a `tool` role (or other rendering
+            # fault) must surface as a 400, not a 500.
+            _bad(f"chat template failed to render: {e}")
+    parts = [f"<|{m['role']}|>\n{m['content']}\n" for m in norm]
     return "".join(parts) + "<|assistant|>\n"
 
 
@@ -225,9 +272,25 @@ def chat_to_native(payload: dict, tokenizer) -> dict:
     _check_unsupported(payload)
     if tokenizer is None:
         _bad("chat completions need a server-side tokenizer")
+    # Tool calling: validate the OpenAI shapes here (clean 400s),
+    # render the tool definitions into the prompt, and forward the
+    # keys verbatim — the server compiles the grammar through its DFA
+    # cache and parses the constrained output back into tool_calls.
+    from shellac_tpu.inference.tools import parse_payload_tools
+
+    tool_ctx = parse_payload_tools(payload)
     native: Dict[str, Any] = {
-        "text": render_chat(payload.get("messages"), tokenizer)
+        "text": render_chat(
+            payload.get("messages"), tokenizer,
+            tools=tool_ctx.functions if tool_ctx is not None else None,
+        )
     }
+    if tool_ctx is not None:
+        native["tools"] = payload["tools"]
+        if payload.get("tool_choice") is not None:
+            native["tool_choice"] = payload["tool_choice"]
+        if payload.get("parallel_tool_calls") is not None:
+            native["parallel_tool_calls"] = payload["parallel_tool_calls"]
     if payload.get("logprobs"):
         native["logprobs"] = True
     tl = payload.get("top_logprobs")
@@ -329,8 +392,26 @@ def completion_response(
             "index": i,
             "finish_reason": _finish_reason(toks, max_new),
         }
+        if "beam_score" in c:
+            # num_beams extension: the beam's length-penalized score
+            # rides its choice.
+            entry["beam_score"] = c["beam_score"]
         if chat:
-            entry["message"] = {"role": "assistant", "content": text}
+            if c.get("tool_calls") is not None:
+                # The DFA-constrained tool branch parsed back into
+                # calls: OpenAI shape is a null-content assistant
+                # message + finish_reason "tool_calls" (it wins over
+                # length/stop — the parse only succeeds on a COMPLETE
+                # calls array).
+                entry["message"] = {"role": "assistant", "content": None,
+                                    "tool_calls": c["tool_calls"]}
+                entry["finish_reason"] = "tool_calls"
+            else:
+                # Tool-enabled requests carry the decided free text in
+                # "content" (== the raw text; a truncated tool branch
+                # falls back here rather than fabricating a call).
+                entry["message"] = {"role": "assistant",
+                                    "content": c.get("content", text)}
         else:
             entry["text"] = (prompt_text + text) if echo else text
         if c.get("logprobs") is not None:
@@ -370,12 +451,21 @@ class StreamTranslator:
 
     Text deltas come from cumulative decode (decode(all) minus what was
     already emitted) so multi-token characters never split mid-byte.
+
+    tool_mode (chat with tools, tool_choice != "none"): the native
+    records' `tool_stream` field — produced by the server's ONE
+    incremental scanner — replaces the raw-text delta path entirely:
+    decided free text arrives as `delta.content`, call fragments as
+    OpenAI `delta.tool_calls` items, and a final record carrying the
+    complete `tool_calls` finishes with `finish_reason: "tool_calls"`.
     """
 
-    def __init__(self, *, model: str, tokenizer, chat: bool):
+    def __init__(self, *, model: str, tokenizer, chat: bool,
+                 tool_mode: bool = False):
         self.model = model
         self.tokenizer = tokenizer
         self.chat = chat
+        self.tool_mode = tool_mode
         self.id = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
         self.created = int(time.time())
         self._tokens: List[int] = []
@@ -404,8 +494,56 @@ class StreamTranslator:
             "choices": [choice],
         }
 
+    def _tool_chunk(self, tool_stream: Optional[dict],
+                    finish: Optional[str] = None):
+        delta: Dict[str, Any] = {}
+        if self.first and finish is None:
+            delta["role"] = "assistant"
+        if tool_stream:
+            if tool_stream.get("content"):
+                delta["content"] = tool_stream["content"]
+            if tool_stream.get("tool_calls"):
+                delta["tool_calls"] = tool_stream["tool_calls"]
+        choice = {"index": 0, "delta": delta, "finish_reason": finish}
+        self.first = False
+        return {
+            "id": self.id,
+            "object": "chat.completion.chunk",
+            "created": self.created,
+            "model": self.model,
+            "choices": [choice],
+        }
+
+    def _feed_tools(self, record: dict, max_new: int):
+        out = []
+        ts = record.get("tool_stream")
+        if ts:
+            out.append(self._tool_chunk(ts))
+        if not record.get("done"):
+            return out
+        self._tokens = list(record["tokens"])
+        finish = self._tool_chunk(
+            None,
+            ("tool_calls" if record.get("tool_calls") is not None
+             else _finish_reason(self._tokens, max_new)),
+        )
+        if record.get("logprobs") is not None:
+            tlp = record.get("top_logprobs")
+            lp = _lp_block(self._tokens, record["logprobs"],
+                           self.tokenizer, tlp=tlp)
+            finish["choices"][0]["logprobs"] = {
+                "content": _chat_content(
+                    lp["tokens"], lp["token_logprobs"], tlp,
+                    self.tokenizer,
+                )
+            }
+        out.append(finish)
+        return out
+
     def feed(self, record: dict, max_new: int):
         """Native stream record -> list of SSE chunk objects."""
+        if self.tool_mode:
+            return self._feed_tools(record, max_new)
         if record.get("done"):
             # The engine's final record carries the authoritative token
             # list (stop-sequence holdback may have trimmed the tail).

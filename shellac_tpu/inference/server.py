@@ -661,6 +661,13 @@ class InferenceServer:
             if p is not None:
                 self._shed(rid, p)
             return
+        if samp and "_beam" in samp:
+            # Beam request: runs synchronously on the scheduler thread
+            # (the engine owner), like a long prefill — the device
+            # program IS the whole request, so there is no slot to
+            # multiplex.
+            self._run_beam(g, rid, tokens, max_new, samp["_beam"])
+            return
         pend = self._pending.get(rid)
         try:
             g.engine.submit(
@@ -679,6 +686,55 @@ class InferenceServer:
                 if p.trace is not None:
                     p.trace.abort("error")
                 p.finish()
+
+    def _run_beam(self, g: _Generation, rid, tokens, max_new: int,
+                  beam: Dict[str, Any]) -> None:
+        """Run one beam-search request on the scheduler thread and
+        settle its pending. Engine faults stay request-scoped: a pool-
+        exhausted paged beam (RuntimeError) fails THIS request loudly
+        instead of killing the scheduler."""
+        p = self._pending.get(rid)
+        if p is not None and p.trace is not None:
+            p.trace.prefill_start()
+        try:
+            bs = getattr(g.engine, "beam_search", None)
+            if bs is None:
+                raise ValueError(
+                    "beam search is not supported by this engine "
+                    "(multi-host serving decodes through slots only)"
+                )
+            seqs, scores = bs(
+                tokens, num_beams=beam["num_beams"],
+                max_new_tokens=max_new,
+                eos_id=getattr(g.engine, "eos_id", None),
+                length_penalty=beam["length_penalty"],
+                constraint=beam.get("constraint"),
+            )
+        except (ValueError, TypeError) as e:
+            p = self._pending.pop(rid, None)
+            if p is not None:
+                p.error = str(e)
+                if p.trace is not None:
+                    p.trace.abort("error")
+                p.finish()
+            return
+        except Exception as e:  # noqa: BLE001 — request-scoped fault
+            p = self._pending.pop(rid, None)
+            if p is not None:
+                p.error = f"beam search failed: {type(e).__name__}: {e}"
+                p.kind = "fault"
+                if p.trace is not None:
+                    p.trace.abort("fault")
+                p.finish()
+            return
+        p = self._pending.pop(rid, None)
+        if p is None:
+            return  # cancelled or swept while the search ran
+        if p.trace is not None:
+            p.trace.first_token()
+            p.trace.finish(sum(len(s) for s in seqs))
+        p.result = {"beams": seqs, "scores": scores}
+        p.finish()
 
     def _run(self, g: _Generation) -> None:
         engine = g.engine
@@ -997,10 +1053,17 @@ class InferenceServer:
         pattern = constraint_pattern(spec)
         cached = self._constraint_cache.get(pattern)
         if cached is None:
+            self._m.constraint_cache.labels(result="miss").inc()
+            t0 = time.monotonic()
             cached = compile_token_dfa(
                 pattern, self.tokenizer, self.engine.cfg.vocab_size,
                 eos_id,
             )
+            # Compile latency is the cache-miss cost a novel schema
+            # pays at admission (the walk covers the whole vocab);
+            # the hit/miss counters say whether production traffic is
+            # actually amortizing it.
+            self._m.constraint_compile.observe(time.monotonic() - t0)
             self._constraint_cache[pattern] = cached
             # Client-supplied patterns key this cache: bound it (LRU)
             # so sustained novel schemas cannot grow host memory
@@ -1010,6 +1073,7 @@ class InferenceServer:
                     next(iter(self._constraint_cache))
                 )
         else:
+            self._m.constraint_cache.labels(result="hit").inc()
             self._constraint_cache.move_to_end(pattern)
         return cached
 
@@ -1058,8 +1122,119 @@ class InferenceServer:
             for ids, vals in tlp
         ]
 
+    # Knobs that do not compose with beam search, with their neutral
+    # values: beam decode is deterministic and returns whole ranked
+    # sequences, so a non-neutral sampling/streaming knob would be
+    # silently ignored — loud 400 instead, the scope-honesty rule the
+    # OpenAI facade already follows.
+    _BEAM_NEUTRAL = {
+        "stream": (None, False), "n": (None, 1), "best_of": (None, 1),
+        "logprobs": (None, False), "top_logprobs": (None, 0),
+        "min_tokens": (None, 0), "logit_bias": (None,),
+        "presence_penalty": (None, 0, 0.0),
+        "frequency_penalty": (None, 0, 0.0), "seed": (None,),
+        "prompt_logprobs": (None, False), "stop": (None,),
+        "temperature": (None, 0, 0.0), "top_p": (None, 1, 1.0),
+        "top_k": (None,), "min_p": (None, 0, 0.0),
+    }
+
+    def _handle_beam(self, payload: dict) -> dict:
+        """Native beam-search request: `num_beams` (+ optional
+        `length_penalty`, `constraint`) returns the ranked beams as
+        {"choices": [{"tokens", "beam_score", "text"?}]}."""
+        try:
+            nb = int(payload["num_beams"])
+            lp = float(payload.get("length_penalty", 1.0))
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"bad num_beams/length_penalty: {e}")
+        if nb < 1:
+            raise ValueError(f"num_beams must be >= 1, got {nb}")
+        cap = max(4 * getattr(self.engine, "n_slots", 8), 16)
+        if nb > cap:
+            raise ValueError(
+                f"num_beams={nb} exceeds this server's cap of {cap}"
+            )
+        for key, neutral in self._BEAM_NEUTRAL.items():
+            if key in payload and payload[key] not in neutral:
+                raise ValueError(
+                    f"{key}={payload[key]!r} does not compose with "
+                    "num_beams (beam search is deterministic and "
+                    "unstreamed)"
+                )
+        tokens, max_new, _, samp = self._parse(payload)
+        deadline = self._deadline(payload.get("timeout"))
+        p = self._submit(
+            tokens, max_new, None,
+            {"_beam": {"num_beams": nb, "length_penalty": lp,
+                       "constraint": samp.get("constraint")}},
+            stream=False, deadline=deadline,
+        )
+        try:
+            self._await(p, deadline)
+        except TimeoutError:
+            self._cancel(p)
+            raise
+        choices = []
+        for seq, score in zip(p.result["beams"], p.result["scores"]):
+            c: Dict[str, Any] = {"tokens": seq,
+                                 "beam_score": round(float(score), 6)}
+            if self.tokenizer is not None:
+                c["text"] = self.tokenizer.decode(seq)
+            choices.append(c)
+        return {"choices": choices, "num_beams": nb}
+
+    def _tool_context(self, payload: dict):
+        """Validate `tools`/`tool_choice` on a native payload and
+        return the ToolContext (None when the request declares no
+        tools). The caller compiles ctx.pattern through the DFA cache
+        and parses the finished output back into tool_calls. The
+        native surface takes the OpenAI tool shapes verbatim — the
+        chat facade forwards them here — but does NOT render tools
+        into the prompt: a native caller owns its prompt."""
+        from shellac_tpu.inference.tools import parse_payload_tools
+
+        ctx = parse_payload_tools(payload)
+        if ctx is None:
+            return None
+        if self.tokenizer is None:
+            raise ValueError(
+                "tools need a server-side tokenizer (the tool grammar "
+                "compiles against token strings)"
+            )
+        if payload.get("constraint") is not None:
+            raise ValueError(
+                "tools do not compose with an explicit constraint "
+                "(the tool grammar IS the request's constraint)"
+            )
+        return ctx
+
+    def _tool_constraint(self, samp: dict, tool_ctx) -> None:
+        if tool_ctx is not None and tool_ctx.pattern is not None:
+            samp["constraint"] = self._compile_constraint(
+                {"regex": tool_ctx.pattern}
+            )
+
+    def _tool_outcome(self, text: str, calls) -> None:
+        # The grammar's free-text branch can never START with '<'
+        # (entering the sentinel commits to the tool branch), so any
+        # unparsed '<'-prefixed output — including a budget cut inside
+        # the sentinel itself — is a truncated call, not free text.
+        outcome = ("call" if calls is not None
+                   else "truncated" if text.startswith("<")
+                   else "text")
+        self._m.tool_requests.labels(outcome=outcome).inc()
+
     def handle(self, payload: dict) -> dict:
+        tool_ctx = self._tool_context(payload)
+        if payload.get("num_beams") is not None:
+            if tool_ctx is not None:
+                raise ValueError(
+                    "tools do not compose with num_beams (a beam is a "
+                    "ranked whole sequence, not an assistant turn)"
+                )
+            return self._handle_beam(payload)
         tokens, max_new, stop, samp = self._parse(payload)
+        self._tool_constraint(samp, tool_ctx)
         want_lps = self._check_logprobs(payload)
         tlk = self._check_top_logprobs(payload, want_lps)
         n, best_of = self._parse_n(payload, samp)
@@ -1070,6 +1245,7 @@ class InferenceServer:
             )
             return self._format_completion(
                 out, lps, want_lps, plp=plp, tlp=tlp, tlk=tlk,
+                tool_ctx=tool_ctx,
             )
         # Parallel sampling: best_of independent completions share the
         # slot batch (and, on a paged+prefix engine, their prompt KV);
@@ -1120,7 +1296,8 @@ class InferenceServer:
 
             choices.sort(key=score, reverse=True)
         result: Dict[str, Any] = {"choices": [
-            self._format_completion(out, lps, want_lps, tlp=tlp, tlk=tlk)
+            self._format_completion(out, lps, want_lps, tlp=tlp, tlk=tlk,
+                                    tool_ctx=tool_ctx)
             for out, lps, tlp in choices[:n]
         ]}
         if plp is not None:
@@ -1128,7 +1305,8 @@ class InferenceServer:
         return result
 
     def _format_completion(self, out, lps, want_lps,
-                           plp=None, tlp=None, tlk=0) -> Dict[str, Any]:
+                           plp=None, tlp=None, tlk=0,
+                           tool_ctx=None) -> Dict[str, Any]:
         result: Dict[str, Any] = {"tokens": out}
         if want_lps:
             result["logprobs"] = lps
@@ -1138,6 +1316,19 @@ class InferenceServer:
             result["prompt_logprobs"] = _render_plp(plp)
         if self.tokenizer is not None:
             result["text"] = self.tokenizer.decode(out)
+            if tool_ctx is not None and tool_ctx.pattern is not None:
+                from shellac_tpu.inference.tools import parse_tool_calls
+
+                content, calls = parse_tool_calls(
+                    result["text"], tool_ctx.mode
+                )
+                self._tool_outcome(result["text"], calls)
+                if calls is not None:
+                    result["tool_calls"] = calls
+                else:
+                    # Free text (auto) or a length-truncated call:
+                    # honest content, never a fabricated call.
+                    result["content"] = content
         return result
 
     def _parse_n(self, payload: dict, samp: dict):
@@ -1180,19 +1371,50 @@ class InferenceServer:
         "logprobs"?}. Logprobs (when requested) arrive on the final
         record only. Parse errors raise before the first yield (clean
         HTTP 400)."""
+        if payload.get("num_beams") is not None:
+            raise ValueError(
+                "num_beams does not compose with streaming (beams are "
+                "ranked whole sequences; request them unstreamed)"
+            )
+        tool_ctx = self._tool_context(payload)
         tokens, max_new, stop, samp = self._parse(payload)
+        self._tool_constraint(samp, tool_ctx)
         want_lps = self._check_logprobs(payload)
         tlk = self._check_top_logprobs(payload, want_lps)
         n, best_of = self._parse_n(payload, samp)
         if n != 1 or best_of != 1:
             raise ValueError("streaming does not support n/best_of > 1")
+        # Tool-enabled streams carry, besides the raw token deltas, a
+        # `tool_stream` field with incremental OpenAI-shaped
+        # tool_calls deltas / decided free-text content — produced by
+        # ONE scanner so SSE, ndjson, and the non-streamed parse
+        # cannot disagree. Stop-sequence holdback already guarantees
+        # the deltas never overrun the final (trimmed) output.
+        scanner = None
+        streamed: list = []
+        if tool_ctx is not None and tool_ctx.pattern is not None:
+            from shellac_tpu.inference.tools import (
+                ToolCallStreamParser,
+                events_to_stream,
+                safe_stream_text,
+            )
+
+            scanner = ToolCallStreamParser(tool_ctx.mode)
         stream = self.generate_stream(
             tokens, max_new, timeout=payload.get("timeout"), stop=stop,
             return_logprobs=True, **samp,
         )
         for kind, val in stream:
             if kind == "delta":
-                yield {"tokens": val}
+                rec: Dict[str, Any] = {"tokens": val}
+                if scanner is not None:
+                    streamed.extend(val)
+                    ts = events_to_stream(scanner.feed(safe_stream_text(
+                        self.tokenizer.decode(streamed)
+                    )))
+                    if ts is not None:
+                        rec["tool_stream"] = ts
+                yield rec
             else:
                 out, lps, plp, tlp = val
                 final: Dict[str, Any] = {"done": True, "tokens": out}
@@ -1204,6 +1426,17 @@ class InferenceServer:
                     final["prompt_logprobs"] = _render_plp(plp)
                 if self.tokenizer is not None:
                     final["text"] = self.tokenizer.decode(out)
+                    if scanner is not None:
+                        # The authoritative text (stop-trimmed) settles
+                        # the scan: tail events ride the final record,
+                        # plus the COMPLETE parsed call list.
+                        ts = events_to_stream(scanner.feed(final["text"]))
+                        if ts is not None:
+                            final["tool_stream"] = ts
+                        calls = scanner.result()
+                        self._tool_outcome(final["text"], calls)
+                        if calls is not None:
+                            final["tool_calls"] = calls
                 yield final
 
     def _prompt_lp_capable(self) -> bool:
@@ -1267,6 +1500,11 @@ class InferenceServer:
         max_new = int(native.get("max_new", 32))
         translator = StreamTranslator(
             model=self.model_name, tokenizer=self.tokenizer, chat=chat,
+            # Tool-enabled chat streams translate the server's
+            # tool_stream scan, not the raw token text (the one
+            # scanner keeps SSE and ndjson surfaces in agreement).
+            tool_mode=bool(native.get("tools"))
+            and native.get("tool_choice") != "none",
         )
         for record in self.handle_stream(native):
             yield from translator.feed(record, max_new)

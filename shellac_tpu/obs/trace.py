@@ -100,6 +100,24 @@ class ServeMetrics:
         self.pending = g(
             "shellac_pending_requests", "Requests currently pending"
         )
+        self.constraint_compile = h(
+            "shellac_constraint_compile_seconds",
+            "Schema/regex -> token-DFA compile latency (paid on "
+            "constraint-cache misses only)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.constraint_cache = c(
+            "shellac_constraint_cache_total",
+            "Constraint DFA cache lookups, by result (hit|miss)",
+            labels=("result",),
+        )
+        self.tool_requests = c(
+            "shellac_tool_requests_total",
+            "Tool-enabled requests by resolution: call (tool_calls "
+            "parsed), text (auto chose free text), truncated (tool "
+            "branch cut by the token budget)",
+            labels=("outcome",),
+        )
         self._engine_stats: Dict[str, object] = {}
 
     def trace(self) -> "RequestTrace":

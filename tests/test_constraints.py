@@ -99,9 +99,19 @@ class TestRegexEngine:
         assert not m('{"color":"blue","tags":[]}')
 
     def test_bad_patterns_raise(self):
-        for pat in ("(ab", "a{2", "[abc", "*a"):
+        for pat in ("(ab", "a{2", "[abc", "*a", "[z-a]"):
             with pytest.raises(ValueError):
                 CharDFA(pat)
+
+    def test_negated_class_complements_full_universe(self):
+        """Standard semantics: only '.' excludes newline. [^x], \\D and
+        \\S complement within the full universe (ADVICE.md round 5)."""
+        assert _matcher("[^x]")("\n")
+        assert _matcher(r"\D")("\n")
+        assert _matcher(r"\S*")("")  # \S itself still excludes spaces
+        assert not _matcher(r"\S")(" ")
+        assert not _matcher(".")("\n")
+        assert _matcher(r"[\s\S]")("\n")  # the 'anything' class idiom
 
     def test_string_pattern_alternation_stays_scoped(self):
         """A '|' inside a schema string "pattern" must not escape into
@@ -271,11 +281,73 @@ class TestSchemaV2:
         assert not m('{"a":"x\ny"}')
         assert not m('{"a":"x\x1fy"}')
 
-    def test_additional_properties_true_rejected(self):
+    def test_additional_properties_true_appends_generic_pairs(self):
+        # v3: an open object is honored via the depth-limited generic-
+        # JSON grammar — extra pairs append AFTER the declared fixed-
+        # order sequence instead of being rejected.
         schema = {"type": "object", "additionalProperties": True,
                   "properties": {"a": {"type": "integer"}}}
-        with pytest.raises(ValueError, match="additionalProperties"):
+        m = _matcher(_schema_regex_public(schema))
+        assert m('{"a":1}')
+        assert m('{"a":1,"extra":"y"}')
+        assert m('{"a":1,"x":{"deep":[1,2]},"y":null}')
+        assert not m('{"x":1}')       # required a still required
+        assert not m('{"x":1,"a":1}')  # extras only after declared
+
+    def test_additional_properties_schema_types_extras(self):
+        schema = {"type": "object",
+                  "properties": {"a": {"type": "boolean"}},
+                  "required": [],
+                  "additionalProperties": {"type": "integer"}}
+        m = _matcher(_schema_regex_public(schema))
+        assert m("{}")
+        assert m('{"a":true}')
+        assert m('{"x":3}')
+        assert m('{"a":false,"x":3,"y":4}')
+        assert not m('{"x":"s"}')  # extras typed by the AP schema
+
+    def test_local_ref_resolution(self):
+        schema = {
+            "type": "object",
+            "properties": {"who": {"$ref": "#/$defs/person"},
+                           "n": {"$ref": "#/definitions/count"}},
+            "$defs": {"person": {"enum": ["ann", "bo"]}},
+            "definitions": {"count": {"type": "integer"}},
+        }
+        m = _matcher(_schema_regex_public(schema))
+        assert m('{"who":"ann","n":4}')
+        assert not m('{"who":"cy","n":4}')
+
+    def test_cyclic_ref_rejected(self):
+        schema = {"$ref": "#/$defs/node",
+                  "$defs": {"node": {"anyOf": [
+                      {"type": "null"},
+                      {"$ref": "#/$defs/node"},
+                  ]}}}
+        with pytest.raises(ValueError, match="cyclic"):
             _schema_regex_public(schema)
+        with pytest.raises(ValueError, match="not found"):
+            _schema_regex_public({"$ref": "#/$defs/missing"})
+        with pytest.raises(ValueError, match="local"):
+            _schema_regex_public({"$ref": "https://x/schema.json"})
+
+    def test_string_formats(self):
+        for fmt, yes, no in (
+            ("date", "2026-08-03", "2026-13-03"),
+            ("date-time", "2026-08-03T09:15:00Z", "2026-08-03 09:15"),
+            ("uuid", "123e4567-e89b-42d3-a456-426614174000", "123"),
+            ("email", "a.b+c@ex-ample.org", "not-an-email"),
+        ):
+            m = _matcher(_schema_regex_public(
+                {"type": "string", "format": fmt}
+            ))
+            assert m(json.dumps(yes)), (fmt, yes)
+            assert not m(json.dumps(no)), (fmt, no)
+        # Unknown formats stay annotations: free string grammar.
+        m = _matcher(_schema_regex_public(
+            {"type": "string", "format": "hostname"}
+        ))
+        assert m('"anything at all"')
 
     def test_unknown_required_name_rejected(self):
         schema = {"type": "object",

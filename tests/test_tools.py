@@ -1,0 +1,613 @@
+"""Tool calling & structured output over the byte-DFA engine.
+
+OpenAI-client-shaped conformance: `tools`/`tool_choice` on
+/v1/chat/completions produce `message.tool_calls` whose `arguments`
+parse as JSON and validate against the declared parameter schema —
+enforced by the token DFA (asserted via a logit-mask probe over the
+compiled transition table, not just output inspection). Streamed
+tool-call delta chunks must reassemble to byte-identical JSON with the
+non-streamed result, and the serving tier must relay tool-call streams
+unmodified.
+
+Schemas in the HTTP tests are fully BOUNDED (enums, not free strings):
+an untrained model under a grammar with an unbounded value (a free
+string, an integer) greedily never terminates it, which is the
+length-truncation case — tested separately, not a flake source.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.constraints import (
+    CharDFA,
+    compile_token_dfa,
+)
+from shellac_tpu.inference.tools import (
+    SENTINEL,
+    ToolCallStreamParser,
+    events_to_stream,
+    parse_payload_tools,
+    parse_tool_calls,
+    render_tool_calls,
+    safe_stream_text,
+    tool_grammar,
+    tools_prompt_block,
+)
+from shellac_tpu.models import transformer
+from shellac_tpu.training.tokenizer import ByteTokenizer
+
+EOS = ByteTokenizer.EOS  # 257
+
+
+def _cfg():
+    return get_model_config("tiny").replace(
+        dtype="float32", vocab_size=ByteTokenizer.vocab_size
+    )
+
+
+def _matcher(pattern):
+    d = CharDFA(pattern)
+
+    def m(s):
+        st = d.start
+        for ch in s:
+            st = d.step(st, ch)
+            if st is None:
+                return False
+        return d.accepting(st)
+
+    return m
+
+
+def _fn(name, params, description=""):
+    return {"type": "function", "function": {
+        "name": name, "description": description, "parameters": params,
+    }}
+
+
+WEATHER = _fn("get_weather", {
+    "type": "object",
+    "properties": {"city": {"enum": ["oslo", "rio"]},
+                   "days": {"enum": [1, 2, 3]}},
+    "required": ["city", "days"],
+}, description="weather lookup")
+
+CALC = _fn("calc", {
+    "type": "object",
+    "properties": {"op": {"enum": ["add", "mul"]}},
+    "required": ["op"],
+})
+
+CALL = '{"name":"get_weather","arguments":{"city":"oslo","days":2}}'
+
+
+class TestGrammar:
+    def test_required_forces_call(self):
+        m = _matcher(tool_grammar([dict(WEATHER["function"])],
+                                  "required"))
+        assert m(SENTINEL + "[" + CALL + "]")
+        assert m(SENTINEL + "[" + CALL + "," + CALL + "]")
+        assert not m("sure, it is sunny")          # free text forbidden
+        assert not m(SENTINEL + "[]")              # empty calls array
+        assert not m(SENTINEL + "[" + CALL)        # unterminated
+        assert not m(
+            SENTINEL + '[{"name":"get_weather","arguments":'
+            '{"city":"paris","days":2}}]'          # off-enum argument
+        )
+
+    def test_auto_allows_free_text_not_starting_sentinel(self):
+        m = _matcher(tool_grammar(
+            [dict(WEATHER["function"])], "auto"))
+        assert m("it is sunny in oslo")
+        assert m("")                               # empty output legal
+        assert m(SENTINEL + "[" + CALL + "]")
+        # Starting '<' commits to the sentinel: a '<'-prefixed non-call
+        # is out of grammar (later '<' is fine).
+        assert not m("<html>hello")
+        assert m("a <b> c")
+
+    def test_named_restricts_to_forced_tool(self):
+        fns = [dict(WEATHER["function"]), dict(CALC["function"])]
+        m = _matcher(tool_grammar(fns, "named", forced_name="calc"))
+        assert m(SENTINEL + '[{"name":"calc","arguments":{"op":"add"}}]')
+        assert not m(SENTINEL + "[" + CALL + "]")
+
+    def test_parallel_false_forbids_second_call(self):
+        m = _matcher(tool_grammar([dict(WEATHER["function"])],
+                                  "required", parallel=False))
+        assert m(SENTINEL + "[" + CALL + "]")
+        assert not m(SENTINEL + "[" + CALL + "," + CALL + "]")
+
+    def test_ref_in_parameters_resolves_against_parameters(self):
+        """A tool schema's local `$ref` must resolve against the
+        PARAMETERS document, not the synthesized {"name","arguments"}
+        wrapper the grammar embeds it in."""
+        fns = [{"name": "pick", "description": "", "parameters": {
+            "$defs": {"c": {"enum": ["oslo", "rio"]}},
+            "type": "object",
+            "properties": {"city": {"$ref": "#/$defs/c"}},
+            "required": ["city"],
+        }}]
+        m = _matcher(tool_grammar(fns, "required", parallel=False))
+        assert m(SENTINEL
+                 + '[{"name":"pick","arguments":{"city":"rio"}}]')
+        assert not m(SENTINEL
+                     + '[{"name":"pick","arguments":{"city":"ugh"}}]')
+
+    def test_cyclic_ref_in_parameters_fails_loudly(self):
+        fns = [{"name": "loopy", "description": "", "parameters": {
+            "$defs": {"a": {"$ref": "#/$defs/a"}},
+            "type": "object",
+            "properties": {"x": {"$ref": "#/$defs/a"}},
+            "required": ["x"],
+        }}]
+        with pytest.raises(ValueError, match="cyclic"):
+            tool_grammar(fns, "required", parallel=False)
+
+    def test_undeclared_parameters_accept_any_object(self):
+        fns = [{"name": "log", "description": "", "parameters": None}]
+        m = _matcher(tool_grammar(fns, "required", parallel=False))
+        assert m(SENTINEL + '[{"name":"log","arguments":{}}]')
+        assert m(SENTINEL
+                 + '[{"name":"log","arguments":{"x":[1,"a"],"y":null}}]')
+        assert not m(SENTINEL + '[{"name":"log","arguments":7}]')
+
+
+class TestPayloadValidation:
+    def test_no_tools_is_none(self):
+        assert parse_payload_tools({}) is None
+        assert parse_payload_tools({"tool_choice": "none"}) is None
+
+    def test_tool_choice_without_tools_rejected(self):
+        with pytest.raises(ValueError, match="tools"):
+            parse_payload_tools({"tool_choice": "required"})
+
+    def test_modes(self):
+        base = {"tools": [WEATHER, CALC]}
+        assert parse_payload_tools(base).mode == "auto"
+        assert parse_payload_tools(
+            base | {"tool_choice": "auto"}).mode == "auto"
+        none = parse_payload_tools(base | {"tool_choice": "none"})
+        assert none.mode == "none" and none.pattern is None
+        req = parse_payload_tools(base | {"tool_choice": "required"})
+        assert req.mode == "required" and req.pattern is not None
+        named = parse_payload_tools(base | {"tool_choice": {
+            "type": "function", "function": {"name": "calc"}}})
+        assert named.mode == "named" and named.forced_name == "calc"
+
+    @pytest.mark.parametrize("payload,msg", [
+        ({"tools": []}, "non-empty"),
+        ({"tools": [{"type": "retrieval"}]}, "not supported"),
+        ({"tools": [{"type": "function", "function": {}}]}, "name"),
+        ({"tools": [_fn("bad name!", None)]}, "bad tool name"),
+        ({"tools": [_fn("a", None), _fn("a", None)]}, "duplicate"),
+        ({"tools": [_fn("a", "not-a-schema")]}, "schema object"),
+        ({"tools": [WEATHER], "tool_choice": {
+            "type": "function", "function": {"name": "ghost"}}},
+         "unknown tool"),
+        ({"tools": [WEATHER], "tool_choice": "sometimes"},
+         "bad tool_choice"),
+        ({"tools": [WEATHER], "parallel_tool_calls": "yes"}, "boolean"),
+    ])
+    def test_malformed_shapes_rejected(self, payload, msg):
+        with pytest.raises(ValueError, match=msg):
+            parse_payload_tools(payload)
+
+
+class TestStreamParser:
+    SURFACE = SENTINEL + "[" + CALL + "," + \
+        '{"name":"calc","arguments":{"op":"mul"}}' + "]"
+
+    def _feed_in_pieces(self, text, size):
+        p = ToolCallStreamParser("required")
+        events = []
+        for i in range(size, len(text) + size, size):
+            events.extend(p.feed(text[:i]))
+        return p, events
+
+    @pytest.mark.parametrize("size", [1, 3, 7, 1000])
+    def test_incremental_reassembly(self, size):
+        p, events = self._feed_in_pieces(self.SURFACE, size)
+        calls = p.result()
+        assert [c["function"]["name"] for c in calls] == \
+            ["get_weather", "calc"]
+        # Fragments concatenate to byte-identical arguments JSON.
+        frags = ["", ""]
+        heads = 0
+        for kind, val in events:
+            assert kind == "tool_delta"
+            if "id" in val:
+                heads += 1
+                assert val["type"] == "function"
+                assert val["function"]["arguments"] == ""
+            else:
+                frags[val["index"]] += val["function"]["arguments"]
+        assert heads == 2
+        assert frags[0] == '{"city":"oslo","days":2}'
+        assert frags[1] == '{"op":"mul"}'
+        assert [c["function"]["arguments"] for c in calls] == frags
+        assert all(c["id"].startswith("call_") for c in calls)
+
+    def test_auto_free_text_streams_as_content(self):
+        p = ToolCallStreamParser("auto")
+        ev = p.feed("well")
+        ev += p.feed("well, hello")
+        assert [k for k, _ in ev] == ["content", "content"]
+        assert "".join(v for _, v in ev) == "well, hello"
+        assert p.result() is None
+
+    def test_sentinel_prefix_is_withheld_until_decided(self):
+        p = ToolCallStreamParser("auto")
+        assert p.feed("<too") == []        # could still become a call
+        ev = p.feed("<tool_call>[" + CALL + "]")
+        assert ev and ev[0][0] == "tool_delta"
+        assert p.result() is not None
+
+    def test_truncated_call_falls_back_to_content(self):
+        text = SENTINEL + "[" + CALL[:20]
+        content, calls = parse_tool_calls(text, "required")
+        assert calls is None
+        assert content == text            # raw text, never a fabrication
+        p = ToolCallStreamParser("required")
+        p.feed(text)
+        assert p.result() is None
+
+    def test_out_of_grammar_input_breaks_cleanly(self):
+        p = ToolCallStreamParser("required")
+        p.feed(SENTINEL + "[oops]")
+        assert p.broken and p.result() is None
+
+    def test_events_to_stream_shapes(self):
+        assert events_to_stream([]) is None
+        out = events_to_stream([("content", "hi"), ("content", "!"),
+                                ("tool_delta", {"index": 0})])
+        assert out == {"content": "hi!", "tool_calls": [{"index": 0}]}
+
+    def test_safe_stream_text_trims_partial_utf8(self):
+        assert safe_stream_text("ab�") == "ab"
+        assert safe_stream_text("ab") == "ab"
+
+    def test_render_round_trips_through_parser(self):
+        calls = [{"id": "call_1", "type": "function", "function": {
+            "name": "get_weather",
+            "arguments": '{"city":"oslo","days":2}'}}]
+        surface = render_tool_calls(calls)
+        _, parsed = parse_tool_calls(surface, "required")
+        assert parsed is not None
+        assert parsed[0]["function"]["name"] == "get_weather"
+        assert (json.loads(parsed[0]["function"]["arguments"])
+                == {"city": "oslo", "days": 2})
+
+    def test_prompt_block_is_deterministic(self):
+        fns = parse_payload_tools({"tools": [WEATHER, CALC]}).functions
+        assert tools_prompt_block(fns) == tools_prompt_block(fns)
+        assert "get_weather" in tools_prompt_block(fns)
+        assert SENTINEL in tools_prompt_block(fns)
+
+
+@pytest.fixture(scope="module")
+def http_srv():
+    from shellac_tpu.inference.server import (
+        InferenceServer,
+        make_http_server,
+    )
+
+    cfg = _cfg()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    srv = InferenceServer(
+        cfg, params, tokenizer=ByteTokenizer(), model_name="tiny",
+        n_slots=2, max_len=1024, temperature=0.0, eos_id=EOS,
+    )
+    httpd = make_http_server(srv)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield base
+    httpd.shutdown()
+    srv.close()
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=300).read())
+
+
+def _sse(base, path, payload):
+    req = urllib.request.Request(
+        base + path, json.dumps(payload).encode(),
+        {"Content-Type": "application/json"},
+    )
+    chunks, done = [], False
+    with urllib.request.urlopen(req, timeout=300) as r:
+        for raw in r:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            data = line[len("data: "):]
+            if data == "[DONE]":
+                done = True
+                break
+            chunks.append(json.loads(data))
+    return chunks, done
+
+
+def _chat(messages, **kw):
+    return {"messages": messages, "max_tokens": 120,
+            "tools": [WEATHER, CALC], "parallel_tool_calls": False, **kw}
+
+
+def _user(text):
+    return [{"role": "user", "content": text}]
+
+
+def _reassemble(chunks):
+    """OpenAI-client-shaped SSE reassembly: index-keyed calls, id/name
+    from the head delta, arguments concatenated across fragments."""
+    calls, content = {}, ""
+    finish = None
+    for c in chunks:
+        choice = c["choices"][0]
+        finish = choice["finish_reason"] or finish
+        d = choice["delta"]
+        content += d.get("content") or ""
+        for item in d.get("tool_calls", []):
+            slot = calls.setdefault(item["index"],
+                                    {"id": None, "name": None, "args": ""})
+            if "id" in item:
+                slot["id"] = item["id"]
+                slot["name"] = item["function"]["name"]
+            slot["args"] += item["function"].get("arguments", "")
+    return calls, content, finish
+
+
+def _assert_weather_args(args_json):
+    args = json.loads(args_json)
+    assert set(args) == {"city", "days"}
+    assert args["city"] in ("oslo", "rio")
+    assert args["days"] in (1, 2, 3)
+    return args
+
+
+class TestToolCallingHTTP:
+    def test_required_returns_schema_valid_call(self, http_srv):
+        r = _post(http_srv, "/v1/chat/completions",
+                  _chat(_user("weather in oslo?"),
+                        tool_choice="required"))
+        ch = r["choices"][0]
+        assert ch["finish_reason"] == "tool_calls"
+        msg = ch["message"]
+        assert msg["content"] is None
+        (tc,) = msg["tool_calls"]
+        assert tc["type"] == "function"
+        assert tc["id"].startswith("call_")
+        assert tc["function"]["name"] in ("get_weather", "calc")
+        if tc["function"]["name"] == "get_weather":
+            _assert_weather_args(tc["function"]["arguments"])
+
+    def test_named_tool_forcing(self, http_srv):
+        for name in ("get_weather", "calc"):
+            r = _post(http_srv, "/v1/chat/completions",
+                      _chat(_user("do something"),
+                            tool_choice={"type": "function",
+                                         "function": {"name": name}}))
+            (tc,) = r["choices"][0]["message"]["tool_calls"]
+            assert tc["function"]["name"] == name
+
+    def test_dfa_logit_mask_enforces_grammar(self, http_srv):
+        """The probe: walk the emitted token ids through the compiled
+        transition table. Every emitted token must be a legal move of
+        the advancing DFA state, the mask must be NON-trivial at every
+        step (some token forbidden — a trivial mask proves nothing),
+        and the same prompt unconstrained must not produce the
+        sentinel — i.e. the grammar came from the mask, not the
+        model."""
+        payload = {"text": "weather? ", "max_new": 120,
+                   "tools": [WEATHER], "tool_choice": "required",
+                   "parallel_tool_calls": False}
+        r = _post(http_srv, "/generate", payload)
+        assert r.get("tool_calls"), r
+        ctx = parse_payload_tools(payload)
+        dfa = compile_token_dfa(ctx.pattern, ByteTokenizer(),
+                                ByteTokenizer.vocab_size, EOS)
+        st = 0
+        for t in r["tokens"]:
+            row = dfa.trans[st]
+            col = row.shape[0] - 1 if t == EOS else t
+            assert row[col] >= 0, (st, t)
+            assert (row[:-1] < 0).any(), "mask trivial at state %d" % st
+            st = int(row[col])
+        bare = _post(http_srv, "/generate",
+                     {"text": payload["text"], "max_new": 120})
+        assert not bare["text"].startswith(SENTINEL)
+
+    def test_streamed_deltas_reassemble_to_valid_json(self, http_srv):
+        body = _chat(_user("weather in oslo?"), tool_choice="required")
+        plain = _post(http_srv, "/v1/chat/completions", body)
+        chunks, done = _sse(http_srv, "/v1/chat/completions",
+                            body | {"stream": True})
+        assert done
+        calls, content, finish = _reassemble(chunks)
+        assert finish == "tool_calls"
+        assert content == ""
+        (ptc,) = plain["choices"][0]["message"]["tool_calls"]
+        assert calls[0]["name"] == ptc["function"]["name"]
+        # Greedy + DFA-masked: the streamed arguments are byte-identical
+        # to the non-streamed request's.
+        assert calls[0]["args"] == ptc["function"]["arguments"]
+        json.loads(calls[0]["args"])
+
+    def test_multi_turn_with_tool_role(self, http_srv):
+        messages = [
+            {"role": "user", "content": "weather in oslo?"},
+            {"role": "assistant", "content": None, "tool_calls": [
+                {"id": "call_h1", "type": "function", "function": {
+                    "name": "get_weather",
+                    "arguments": '{"city":"oslo","days":1}'}}]},
+            {"role": "tool", "tool_call_id": "call_h1",
+             "content": "sunny, 21C"},
+        ]
+        r = _post(http_srv, "/v1/chat/completions",
+                  _chat(messages, tool_choice="auto"))
+        ch = r["choices"][0]
+        msg = ch["message"]
+        # auto: either a follow-up call or free text — both must be
+        # well-formed, never both at once.
+        if ch["finish_reason"] == "tool_calls":
+            assert msg["content"] is None and msg["tool_calls"]
+        else:
+            assert isinstance(msg["content"], str)
+            assert "tool_calls" not in msg
+
+    def test_tool_choice_none_renders_but_never_parses(self, http_srv):
+        r = _post(http_srv, "/v1/chat/completions",
+                  _chat(_user("hi"), tool_choice="none"))
+        msg = r["choices"][0]["message"]
+        assert isinstance(msg["content"], str)
+        assert "tool_calls" not in msg
+
+    @pytest.mark.parametrize("path,payload,msg", [
+        ("/v1/completions",
+         {"prompt": "x", "tools": [WEATHER]}, "chat-completions"),
+        ("/v1/chat/completions",
+         {"messages": [{"role": "user", "content": "x"}],
+          "tools": [WEATHER], "num_beams": 2}, "num_beams"),
+        ("/generate",
+         {"text": "x", "tools": [WEATHER],
+          "constraint": {"regex": "a+"}}, "constraint"),
+        ("/v1/chat/completions",
+         {"messages": [{"role": "user", "content": "x"}],
+          "tool_choice": "required"}, "tools"),
+    ])
+    def test_bad_compositions_are_http_400(self, http_srv, path,
+                                           payload, msg):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(http_srv, path, payload)
+        assert e.value.code == 400
+        assert msg in e.value.read().decode()
+
+    def test_tool_metrics_exported(self, http_srv):
+        body = urllib.request.urlopen(http_srv + "/metrics",
+                                      timeout=30).read().decode()
+        assert "shellac_tool_requests_total" in body
+        assert "shellac_constraint_cache_total" in body
+        assert "shellac_constraint_compile_seconds" in body
+
+
+class TestBeamOverHTTP:
+    def test_native_beams_compose_with_constraint(self, http_srv):
+        r = _post(http_srv, "/generate", {
+            "text": "choose: ", "max_new": 16, "num_beams": 4,
+            "constraint": {"regex": "(yes|no|maybe)"},
+        })
+        assert 1 <= len(r["choices"]) <= 4
+        m = _matcher("(yes|no|maybe)")
+        texts = [c["text"] for c in r["choices"]]
+        assert len(set(texts)) == len(texts)  # beams are distinct
+        for c in r["choices"]:
+            assert m(c["text"]), c
+            assert c["beam_score"] <= 0.0
+        scores = [c["beam_score"] for c in r["choices"]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_openai_num_beams_with_json_schema(self, http_srv):
+        r = _post(http_srv, "/v1/chat/completions", {
+            "messages": _user("pick"), "max_tokens": 24, "num_beams": 3,
+            "response_format": {"type": "json_schema", "json_schema": {
+                "name": "o", "schema": {
+                    "type": "object",
+                    "properties": {"ok": {"type": "boolean"}},
+                    "required": ["ok"]}}},
+        })
+        assert 1 <= len(r["choices"]) <= 3
+        for c in r["choices"]:
+            v = json.loads(c["message"]["content"])
+            assert isinstance(v["ok"], bool)
+            assert "beam_score" in c
+
+    def test_beam_rejects_non_neutral_sampling(self, http_srv):
+        for extra in ({"stream": True}, {"temperature": 0.7}, {"n": 2},
+                      {"logprobs": True}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(http_srv, "/generate",
+                      {"text": "x", "max_new": 8, "num_beams": 2,
+                       **extra})
+            assert e.value.code == 400
+
+    def test_beam_cap_is_loud(self, http_srv):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(http_srv, "/generate",
+                  {"text": "x", "max_new": 8, "num_beams": 4096})
+        assert e.value.code == 400
+        assert "cap" in e.value.read().decode()
+
+
+class TestTierPassThrough:
+    def test_router_relays_tool_call_stream_unmodified(self, http_srv):
+        """The serving tier forwards tool-call SSE streams verbatim:
+        same chunk structure, same reassembled call as a direct
+        replica request (ids are per-request random, so compare
+        everything but the ids)."""
+        from shellac_tpu.inference.tier import (
+            TierRouter,
+            make_tier_http_server,
+        )
+
+        router = TierRouter([http_srv], health_interval=0.1,
+                            metrics=False)
+        tier_httpd = make_tier_http_server(router)
+        t = threading.Thread(target=tier_httpd.serve_forever,
+                             daemon=True)
+        t.start()
+        tier_base = f"http://127.0.0.1:{tier_httpd.server_address[1]}"
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if any(x.state == "healthy" for x in router.replicas):
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("replica never became routable")
+            body = _chat(_user("weather in oslo?"),
+                         tool_choice="required", stream=True)
+            direct, ddone = _sse(http_srv, "/v1/chat/completions", body)
+            relayed, rdone = _sse(tier_base, "/v1/chat/completions",
+                                  body)
+            assert ddone and rdone
+            dc, dcontent, dfinish = _reassemble(direct)
+            rc, rcontent, rfinish = _reassemble(relayed)
+            assert rfinish == dfinish == "tool_calls"
+            assert rcontent == dcontent == ""
+            assert rc[0]["name"] == dc[0]["name"]
+            assert rc[0]["args"] == dc[0]["args"]
+            assert rc[0]["name"] in ("get_weather", "calc")
+            if rc[0]["name"] == "get_weather":
+                _assert_weather_args(rc[0]["args"])
+            else:
+                assert json.loads(rc[0]["args"])["op"] in ("add", "mul")
+            # Chunk-for-chunk relay: same count, same delta payloads
+            # (ids/created differ per request — strip them).
+            def strip(chunks):
+                out = []
+                for c in chunks:
+                    c = json.loads(json.dumps(c))
+                    c.pop("id", None)
+                    c.pop("created", None)
+                    for ch in c["choices"]:
+                        for item in ch["delta"].get("tool_calls", []):
+                            item.pop("id", None)
+                    out.append(c)
+                return out
+            assert strip(relayed) == strip(direct)
+        finally:
+            tier_httpd.shutdown()
+            router.close()
