@@ -38,12 +38,31 @@ import numpy as np
 
 def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
                  decode_ticks=1, kv_quant=None, rolling=False,
-                 registry=None, overlap=False):
+                 registry=None, overlap=False, spec_draft=None, gamma=3):
     from shellac_tpu.inference.batching import (
         BatchingEngine,
         PagedBatchingEngine,
     )
 
+    if spec_draft is not None:
+        # Speculative serving over the backend registry (spec-dense /
+        # spec-paged variants, int8 included): the verify round
+        # replaces the decode window, so decode_ticks stays pinned.
+        from shellac_tpu.inference.cache import (
+            engine_class,
+            resolve_backend_name,
+        )
+
+        name = resolve_backend_name(None, paged=paged, kv_quant=kv_quant,
+                                    rolling_window=rolling)
+        dcfg, dparams = spec_draft
+        extra = ({"block_size": 64, "pool_tokens": n_slots * max_len}
+                 if paged else {})
+        return engine_class(name, speculative=True)(
+            cfg, params, dcfg, dparams, gamma=gamma, n_slots=n_slots,
+            max_len=max_len, temperature=0.0, attn_impl=impl,
+            registry=registry, cache_backend=name, **extra,
+        )
     if paged:
         # Page size 64: large enough that the paged kernel's per-page
         # DMA is a real tile (64 x 128), small enough that short
@@ -65,15 +84,20 @@ def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
 
 def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
                  ticks, rng, decode_ticks=1, kv_quant=None,
-                 rolling=False, registry=None, overlap=False):
+                 rolling=False, registry=None, overlap=False,
+                 spec_draft=None, gamma=3):
     """Decode tokens/s with every slot held live at ~ctx context."""
     eng = build_engine(
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
         max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
         rolling=rolling, registry=registry, overlap=overlap,
+        spec_draft=spec_draft, gamma=gamma,
     )
     budget = max_len - ctx - 1
-    need = (2 + ticks) * decode_ticks
+    # Spec rounds emit up to gamma+1 tokens per step (and admission
+    # reserves gamma+2 slack past the budget).
+    per_step = (gamma + 1) if spec_draft is not None else decode_ticks
+    need = (2 + ticks) * per_step + (gamma + 2 if spec_draft else 0)
     if budget < need:
         raise SystemExit(
             f"steady_state: per-slot budget {budget} < "
@@ -83,7 +107,9 @@ def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
         )
     for i in range(n_slots):
         prompt = rng.integers(0, cfg.vocab_size, size=ctx, dtype=np.int64)
-        eng.submit(i, prompt, max_new=budget)
+        eng.submit(i, prompt, max_new=(
+            budget if spec_draft is None else budget - gamma - 1
+        ))
 
     def tokens_seen():
         return eng.stats["tokens_generated"] + sum(
@@ -108,7 +134,7 @@ def steady_state(cfg, params, *, paged, impl, n_slots, ctx, max_len,
 def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
           rolling=False, decode_ticks=1, kv_quant=None, registry=None,
           overlap=False, device_latency=0.0, host_latency=0.0,
-          n_req=None, gen_budget=None):
+          n_req=None, gen_budget=None, spec_draft=None, gamma=3):
     """Drain ragged requests (default 3*n_slots); tokens/s generated.
 
     Each request carries an obs RequestTrace, so the drain leaves
@@ -130,6 +156,7 @@ def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
         cfg, params, paged=paged, impl=impl, n_slots=n_slots,
         max_len=max_len, decode_ticks=decode_ticks, kv_quant=kv_quant,
         rolling=rolling, registry=registry, overlap=overlap,
+        spec_draft=spec_draft, gamma=gamma,
     )
     shim = None
     if device_latency > 0:
@@ -437,11 +464,28 @@ def gate(cfg, params, args, backend):
         rates[overlap] = tok_s
     speedup = rates[True] / max(rates[False], 1e-9)
 
+    # Spec-on-paged churn (PR 9's composition): self-draft over the
+    # paged pool, host-latency harness only — the window shim hooks
+    # the dispatch pipeline the verify round replaces, but the
+    # per-step host sleep still dominates tiny-model compute, so the
+    # number is sync-count-bound and transfers across CI machines
+    # like the others. Guards the new path against silent rot
+    # (a crash, a lost multi-token round, or a pathological
+    # round-count regression all move it far past tolerance).
+    rng = np.random.default_rng(1)
+    spec_tok_s, _ = churn(
+        cfg, params, paged=True, impl="ref", n_slots=args.slots,
+        ctx=args.ctx, max_len=max_len, rng=rng, decode_ticks=1,
+        host_latency=host_s, n_req=2 * args.slots, gen_budget=32,
+        spec_draft=(cfg, params), gamma=2,
+    )
+
     summary = {
         "metric": f"decode_gate_{args.model}_{backend}",
         "churn_tokens_s": round(rates[True], 1),
         "serial_tokens_s": round(rates[False], 1),
         "overlap_speedup": round(speedup, 3),
+        "spec_paged_tokens_s": round(spec_tok_s, 1),
         "decode_ticks": ticks,
         "autotune": tuned,
         "params": {
@@ -455,6 +499,7 @@ def gate(cfg, params, args, backend):
         baseline = {
             "churn_tokens_s": summary["churn_tokens_s"],
             "overlap_speedup_floor": 1.5,
+            "spec_paged_tokens_s": summary["spec_paged_tokens_s"],
             "tolerance": 0.15,
             "params": summary["params"],
         }
@@ -491,6 +536,13 @@ def gate(cfg, params, args, backend):
     if speedup < floor:
         failures.append(
             f"overlap speedup {speedup:.2f}x < required {floor}x"
+        )
+    spec_base = baseline.get("spec_paged_tokens_s")
+    if spec_base is not None and spec_tok_s < spec_base * (1.0 - tol):
+        failures.append(
+            f"spec-on-paged churn tokens/s {spec_tok_s:.1f} < "
+            f"{spec_base * (1.0 - tol):.1f} "
+            f"(baseline {spec_base} - {tol:.0%})"
         )
     summary["gate"] = "fail" if failures else "pass"
     if failures:
@@ -539,7 +591,12 @@ def main():
                     dest="write_gate_baseline",
                     help="measure and (over)write the gate baseline "
                          "instead of judging against it")
-    ap.add_argument("--variants", default="dense:auto,dense:ref,paged:auto,paged:ref")
+    ap.add_argument("--variants",
+                    default="dense:auto,dense:ref,paged:auto,paged:ref",
+                    help="comma list of cache:impl rows; cache in "
+                         "{dense, paged, rolling, spec-dense, "
+                         "spec-paged} (spec-* = speculative serving "
+                         "with a self-draft)")
     ap.add_argument("--kv-quant", choices=["int8"],
                     help="int8 KV cache on the dense engine variants")
     ap.add_argument("--window", type=int, default=None,
@@ -705,8 +762,17 @@ def main():
     results = {}
     for variant in args.variants.split(","):
         cache_kind, impl = variant.split(":")
+        # spec-dense / spec-paged: speculative serving (self-draft, so
+        # acceptance ~= 1 and the row measures the round machinery,
+        # not draft quality) over the named backend.
+        spec = cache_kind.startswith("spec-")
+        if spec:
+            cache_kind = cache_kind[len("spec-"):]
         paged = cache_kind == "paged"
         rolling = cache_kind == "rolling"
+        if spec and rolling:
+            raise SystemExit("spec composes with dense/paged backends "
+                             "only (rolling is excluded)")
         if rolling and cfg.attn_window is None:
             raise SystemExit(
                 "rolling:* variants need a windowed model (--window or "
@@ -714,6 +780,13 @@ def main():
             )
         rng = np.random.default_rng(0)
         kvq = args.kv_quant
+        # Spec variants: self-draft, pinned decode_ticks=1, no overlap
+        # (both excluded compositions).
+        spec_kw = dict(
+            spec_draft=(cfg, params) if spec else None,
+            decode_ticks=1 if spec else args.decode_ticks,
+            overlap=False if spec else args.overlap,
+        )
         # One fresh registry per variant: the steady-state and churn
         # engines (and the churn request spans) deposit their
         # histograms here, so the output row carries TTFT/TPOT/
@@ -724,20 +797,18 @@ def main():
         tok_s, tick_s = steady_state(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, ticks=args.ticks, rng=rng,
-            decode_ticks=args.decode_ticks, kv_quant=kvq, rolling=rolling,
-            registry=reg, overlap=args.overlap,
+            kv_quant=kvq, rolling=rolling, registry=reg, **spec_kw,
         )
         churn_tok_s, churn_total = churn(
             cfg, params, paged=paged, impl=impl, n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, rng=rng,
-            decode_ticks=args.decode_ticks, kv_quant=kvq, rolling=rolling,
-            registry=reg, overlap=args.overlap,
+            kv_quant=kvq, rolling=rolling, registry=reg,
             device_latency=args.device_latency_ms / 1e3,
-            host_latency=args.host_latency_ms / 1e3,
+            host_latency=args.host_latency_ms / 1e3, **spec_kw,
         )
         row = {
             "metric": f"decode_throughput_{args.model}_ctx{args.ctx}_"
-                      f"{cache_kind}_{impl}"
+                      f"{'spec_' if spec else ''}{cache_kind}_{impl}"
                       f"{'_kvq' + args.kv_quant if kvq else ''}_{backend}",
             "value": round(tok_s, 1),
             "unit": "tokens/s",
@@ -746,8 +817,8 @@ def main():
                 "churn_tokens_s": round(churn_tok_s, 1),
                 "churn_tokens": churn_total,
                 "n_slots": args.slots,
-                "decode_ticks": args.decode_ticks,
-                "overlap_decode": args.overlap,
+                "decode_ticks": spec_kw["decode_ticks"],
+                "overlap_decode": spec_kw["overlap"],
                 "metrics": reg.snapshot(),
             },
         }

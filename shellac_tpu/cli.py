@@ -742,9 +742,16 @@ def cmd_batch(args):
     """Offline batch generation: JSONL prompts in, JSONL completions
     out, through the continuous-batching engine (slots stay saturated
     across requests — the high-throughput path, no HTTP in the way)."""
-    from shellac_tpu.inference.batching import BatchingEngine
+    from shellac_tpu.inference.cache import engine_class, resolve_backend_name
     from shellac_tpu.training.tokenizer import get_tokenizer
 
+    try:
+        backend_name = resolve_backend_name(
+            args.cache_backend, kv_quant=args.kv_quant,
+            rolling_window=args.rolling_window,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
     cfg = _model_config(args)
     params = _apply_lora(args, cfg, _restore_params(args, cfg))
     mesh = _mesh_from(args)
@@ -753,14 +760,14 @@ def cmd_batch(args):
 
         params = shard_params(cfg, params, mesh)
     tok = get_tokenizer(args.tokenizer)
-    eng = BatchingEngine(
+    eng = engine_class(backend_name)(
         cfg, params, n_slots=args.slots,
         max_len=args.max_len or cfg.max_seq_len,
         temperature=args.temperature, eos_id=args.eos_id,
         decode_ticks=args.decode_ticks,
         overlap_decode=args.overlap_decode,
         mesh=mesh, seed=args.seed,
-        kv_quant=args.kv_quant, rolling_window=args.rolling_window,
+        cache_backend=backend_name,
         logprobs=args.logprobs,
     )
     if args.decode_ticks == "auto":
@@ -844,11 +851,32 @@ def cmd_serve(args):
         from shellac_tpu.obs import get_registry
 
         get_registry().disable()
-    if args.prefix_cache and not args.paged:
-        raise SystemExit("--prefix-cache requires --paged")
-    if args.draft_model and args.paged:
-        raise SystemExit("--draft-model (speculative) requires a dense "
-                         "cache; drop --paged")
+    # One resolution path for storage policy: the explicit
+    # --cache-backend name and the deprecated legacy aliases (--paged,
+    # --kv-quant, --rolling-window) all land on the same backend
+    # registry the engines use.
+    from shellac_tpu.inference.cache import (
+        backend_flags,
+        resolve_backend_name,
+    )
+
+    try:
+        backend_name = resolve_backend_name(
+            args.cache_backend, paged=args.paged, kv_quant=args.kv_quant,
+            rolling_window=args.rolling_window,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e))
+    paged, kvq, rolling = backend_flags(backend_name)
+    if args.prefix_cache and not paged:
+        raise SystemExit("--prefix-cache requires a paged cache backend "
+                         "(--cache-backend paged|paged-int8)")
+    if args.draft_model and rolling:
+        raise SystemExit(
+            "--draft-model (speculative) does not compose with rolling "
+            "backends: the verify round re-reads positions a ring may "
+            "have already evicted mid-round"
+        )
     if args.draft_model and args.decode_ticks not in (1, "auto"):
         raise SystemExit("--draft-model already emits up to gamma+1 tokens "
                          "per step; --decode-ticks must stay 1")
@@ -864,19 +892,11 @@ def cmd_serve(args):
             "verify round's acceptance counts gate the next round); use "
             "--no-overlap-decode"
         )
-    if args.kv_quant and args.draft_model:
-        raise SystemExit("--kv-quant does not compose with --draft-model")
-    if args.rolling_window and (args.paged or args.draft_model):
+    if args.pp_pipeline and (paged or args.draft_model):
         raise SystemExit(
-            "--rolling-window is a dense-cache feature (no --paged or "
-            "--draft-model; --kv-quant composes on both uniform-window "
-            "and patterned models)"
-        )
-    if args.pp_pipeline and (args.paged or args.draft_model):
-        raise SystemExit(
-            "--pp-pipeline composes with the slot caches (bf16, "
-            "--kv-quant int8, --rolling-window rings) only — no "
-            "--paged or --draft-model"
+            "--pp-pipeline composes with the slot caches (dense, "
+            "dense-int8, rolling backends) only — no paged backends or "
+            "--draft-model"
         )
     if args.pp_pipeline and not args.mesh:
         raise SystemExit("--pp-pipeline needs --mesh with pp>=2")
@@ -932,22 +952,33 @@ def cmd_serve(args):
     # wedge, so the factory must capture everything construction needs.
     engine = None
     engine_factory = None
+    from shellac_tpu.inference.cache import engine_class
+
+    # Paged policy knobs travel with the backend name wherever a paged
+    # engine (speculative or not) is constructed below.
+    paged_extra = {}
+    if paged:
+        # block_size=None lets the engine resolve the backend's own
+        # default (the 32-aligned 64 for int8 pools, 16 for bf16) —
+        # ONE source of truth for page geometry.
+        paged_extra = {
+            "prefix_cache": args.prefix_cache,
+            "block_size": args.block_size,
+        }
     if args.draft_model:
         import jax
 
-        from shellac_tpu.inference.spec_batching import (
-            SpeculativeBatchingEngine,
-        )
         from shellac_tpu.models import transformer
         from shellac_tpu.models.registry import PRESETS
 
+        kind = engine_class(backend_name, speculative=True)
         dcfg = PRESETS[args.draft_model]
         dparams = transformer.init_params(dcfg, jax.random.PRNGKey(args.seed))
         if mesh is not None:
             dparams = shard_params(dcfg, dparams, mesh)
 
         def engine_factory():
-            return SpeculativeBatchingEngine(
+            return kind(
                 cfg, params, dcfg, dparams, gamma=args.gamma,
                 n_slots=args.slots, max_len=args.max_len or cfg.max_seq_len,
                 temperature=args.temperature, eos_id=args.eos_id,
@@ -956,23 +987,16 @@ def cmd_serve(args):
                 max_prefills_per_step=args.max_prefills_per_step,
                 prefill_chunk=args.prefill_chunk,
                 mesh=mesh,
+                cache_backend=backend_name,
+                **paged_extra,
             )
 
         engine = engine_factory()
-    if args.paged or (engine is None and mesh is not None):
-        from shellac_tpu.inference.batching import (
-            BatchingEngine,
-            PagedBatchingEngine,
-        )
-
-        kind = PagedBatchingEngine if args.paged else BatchingEngine
-        if args.paged:
-            extra = {"prefix_cache": args.prefix_cache}
-            bs = args.block_size or (64 if args.kv_quant else 16)
-            extra["block_size"] = bs
-        else:
-            extra = {"rolling_window": args.rolling_window,
-                     "pp_pipeline": args.pp_pipeline}
+    if engine is None and (paged or mesh is not None):
+        kind = engine_class(backend_name)
+        extra = dict(paged_extra)
+        if not paged:
+            extra["pp_pipeline"] = args.pp_pipeline
 
         def engine_factory():
             return kind(
@@ -986,7 +1010,7 @@ def cmd_serve(args):
                 logprobs=args.logprobs,
                 top_logprobs=args.top_logprobs,
                 mesh=mesh,
-                kv_quant=args.kv_quant,
+                cache_backend=backend_name,
                 **extra,
             )
 
@@ -1025,8 +1049,7 @@ def cmd_serve(args):
         prefill_chunk=args.prefill_chunk,
         logprobs=args.logprobs,
         top_logprobs=args.top_logprobs,
-        kv_quant=args.kv_quant,
-        rolling_window=args.rolling_window,
+        cache_backend=backend_name,
         step_timeout=args.step_timeout,
         max_pending=args.max_pending,
         restart_budget=args.restart_budget,
@@ -1323,10 +1346,19 @@ def build_parser() -> argparse.ArgumentParser:
                    action=argparse.BooleanOptionalAction, default=True,
                    help="overlapped window dispatch during the drain")
     b.add_argument("--mesh", default="", help="e.g. tp=4")
+    b.add_argument("--cache-backend", default=None, dest="cache_backend",
+                   choices=["dense", "dense-int8", "paged", "paged-int8",
+                            "rolling", "rolling-int8"],
+                   help="KV-cache storage policy (the registry the "
+                        "engines resolve through; see docs/inference.md "
+                        "capability table)")
     b.add_argument("--kv-quant", choices=["int8"], default=None,
-                   dest="kv_quant")
+                   dest="kv_quant",
+                   help="deprecated alias for --cache-backend "
+                        "dense-int8 (composes with --rolling-window)")
     b.add_argument("--rolling-window", action="store_true",
-                   dest="rolling_window")
+                   dest="rolling_window",
+                   help="deprecated alias for --cache-backend rolling")
     b.add_argument("--logprobs", action="store_true")
     b.add_argument("--tokenizer", default="byte")
     b.add_argument("--ckpt-dir")
@@ -1341,8 +1373,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max-len", type=int, default=None, dest="max_len")
     s.add_argument("--temperature", type=float, default=0.0)
     s.add_argument("--eos-id", type=int, default=None, dest="eos_id")
+    s.add_argument("--cache-backend", default=None, dest="cache_backend",
+                   choices=["dense", "dense-int8", "paged", "paged-int8",
+                            "rolling", "rolling-int8"],
+                   help="KV-cache storage policy, resolved through the "
+                        "same backend registry the engines use (the "
+                        "legacy --paged/--kv-quant/--rolling-window "
+                        "flags are deprecated aliases onto these names; "
+                        "see docs/inference.md for the engine x backend "
+                        "capability table)")
     s.add_argument("--paged", action="store_true",
-                   help="paged (block-pool) KV cache")
+                   help="deprecated alias for --cache-backend paged "
+                        "(paged-int8 with --kv-quant)")
     s.add_argument("--mesh", default="",
                    help="serve sharded, e.g. tp=4 (multi-host: multiply "
                         "out to the global device count and set the "
@@ -1350,20 +1392,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "JAX_PROCESS_ID env on every process)")
     s.add_argument("--rolling-window", action="store_true",
                    dest="rolling_window",
-                   help="ring-buffer KV cache for sliding-window models: "
-                        "cache memory scales with the window, not "
-                        "max-len")
+                   help="deprecated alias for --cache-backend rolling: "
+                        "ring-buffer KV cache for sliding-window models "
+                        "(cache memory scales with the window, not "
+                        "max-len)")
     s.add_argument("--kv-quant", choices=["int8"], default=None,
                    dest="kv_quant",
-                   help="int8 KV cache: half the cache memory and HBM "
-                        "stream per decode tick (dense, rolling on "
-                        "uniform windows, and paged pools)")
+                   help="deprecated alias selecting the -int8 backend "
+                        "variant: half the cache memory and HBM stream "
+                        "per decode tick (dense, rolling on uniform "
+                        "windows, and paged pools)")
     s.add_argument("--block-size", type=int, default=None, dest="block_size",
                    help="paged pool page size (default 16; int8 pools "
                         "need a multiple of 32 and default to 64)")
     s.add_argument("--prefix-cache", action="store_true", dest="prefix_cache",
                    help="reuse cached KV blocks across prompts sharing a "
-                        "prefix (requires --paged)")
+                        "prefix (requires a paged backend)")
     s.add_argument("--decode-ticks", type=_decode_ticks_arg,
                    default="auto", dest="decode_ticks",
                    help="decode steps per host sync (throughput vs "
@@ -1425,7 +1469,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "don't stall active decodes")
     s.add_argument("--draft-model", default=None,
                    help="draft preset: serve with speculative decoding "
-                        "(dense cache only)")
+                        "(dense and paged backends, int8 included; "
+                        "not rolling)")
     s.add_argument("--gamma", type=int, default=4,
                    help="draft tokens proposed per verification round")
     s.add_argument("--logprobs", action="store_true",

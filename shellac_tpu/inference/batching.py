@@ -30,9 +30,8 @@ upstream serving engine to cite.
 from __future__ import annotations
 
 import functools
-import hashlib
 import time
-from collections import OrderedDict, deque
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -41,15 +40,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference.cache import PoolExhausted
 from shellac_tpu.inference.kvcache import (
     PagedKVCache,
     QuantPagedKVCache,
-    init_cache_for,
-    init_paged_cache,
-    init_quant_paged_cache,
     kv_field_names,
-    paged_cache_logical_axes,
-    quant_paged_cache_logical_axes,
     scatter_slot,
     slot_view,
 )
@@ -142,14 +137,29 @@ class _DecodeWindow:
 
 
 class BatchingEngine:
-    """Fixed-slot continuous batching over one model."""
+    """Fixed-slot continuous batching over one model.
 
-    # Subclasses that replace self._cache after this ctor set this True
-    # so mesh sharding is pinned once, on the final cache pytree.
-    _swaps_cache = False
+    Storage policy is delegated to a cache backend
+    (inference/cache): the engine holds the decode ALGORITHM — slot
+    scheduling, the jitted window programs, sampling state — and asks
+    `self.cache_backend` for construction, sharding axes, slot
+    residency, and capacity accounting. `cache_backend` accepts a
+    registry name ("dense", "dense-int8", "rolling", "rolling-int8";
+    the paged subclass takes "paged"/"paged-int8") or a constructed
+    CacheBackend; the legacy kv_quant / rolling_window kwargs remain
+    as aliases that resolve through the same registry.
+    """
+
+    # Backend families this engine class can drive (the paged subclass
+    # overrides — its jitted programs scatter through block tables).
+    _backend_family = ("dense", "dense-int8", "rolling", "rolling-int8")
     # Can this engine score prompts (prompt_logprobs)? Subclasses whose
     # prefill skips scoring forwards (speculative drafts) set False.
     _scores_prompts = True
+    # Extra per-slot residency past prompt + max_new + 1 the engine's
+    # window may write (the speculative mixin sets gamma + 1: a verify
+    # round writes g+1 positions before rolling back).
+    _footprint_slack = 0
     # Can decode_ticks be retuned post-construction? The speculative
     # engine pins it to 1 (a verify round already emits up to gamma+1
     # tokens per sync) and sets this False so the auto-tuner skips it.
@@ -179,10 +189,9 @@ class BatchingEngine:
         kv_quant: Optional[str] = None,
         rolling_window: bool = False,
         pp_pipeline: bool = False,
+        cache_backend=None,
         registry=None,
     ):
-        if kv_quant not in (None, "int8"):
-            raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
         top_logprobs = int(top_logprobs or 0)
         if top_logprobs < 0 or top_logprobs > 32:
             raise ValueError(
@@ -193,17 +202,6 @@ class BatchingEngine:
                 "top_logprobs needs logprobs=True (the alternatives "
                 "ride the same scoring pass)"
             )
-        if rolling_window:
-            if self._swaps_cache:
-                raise ValueError(
-                    "rolling_window is a dense-cache feature; the paged "
-                    "engine sizes memory via its block pool instead"
-                )
-            if cfg.attn_window is None:
-                raise ValueError(
-                    "rolling_window needs a sliding-window model "
-                    "(attn_window)"
-                )
         # decode_ticks: K decode steps per host sync, or "auto" — the
         # serving entry points run inference.autotune against the live
         # mesh at startup and write the winner back; until tuned,
@@ -235,6 +233,72 @@ class BatchingEngine:
         # scheduler owns it). Shardings are pinned at the jit
         # boundaries so GSPMD keeps one layout across every program.
         self.mesh = mesh
+        # Storage policy: resolve the cache backend (registry name,
+        # constructed instance, or the legacy kv_quant/rolling_window
+        # aliases — one resolution path, shared with the CLI).
+        from shellac_tpu.inference.cache import (
+            CacheBackend,
+            make_backend,
+            resolve_backend_name,
+        )
+
+        # Chunked-prefill continuations READ the ring before their own
+        # rows age out; the ring carries that chunk as slack.
+        self._chunk_slack = prefill_chunk or 1
+        wants_paged = any(n.startswith("paged")
+                          for n in self._backend_family)
+        if isinstance(cache_backend, CacheBackend):
+            # A constructed instance carries its own policy + geometry;
+            # engine kwargs that contradict it must refuse as loudly as
+            # the name path does — silently dropped knobs are exactly
+            # the capacity incidents the registry exists to prevent.
+            if kv_quant is not None and kv_quant != cache_backend.kv_quant:
+                raise ValueError(
+                    f"kv_quant={kv_quant!r} conflicts with the "
+                    f"{cache_backend.name!r} backend instance"
+                )
+            if rolling_window and not cache_backend.is_rolling:
+                raise ValueError(
+                    f"rolling_window={rolling_window!r} conflicts with "
+                    f"the {cache_backend.name!r} backend instance"
+                )
+            if (cache_backend.n_slots != n_slots
+                    or cache_backend.max_len != self.max_len):
+                raise ValueError(
+                    f"{cache_backend.name!r} backend instance geometry "
+                    f"(n_slots={cache_backend.n_slots}, "
+                    f"max_len={cache_backend.max_len}) does not match "
+                    f"the engine (n_slots={n_slots}, "
+                    f"max_len={self.max_len})"
+                )
+            backend = cache_backend
+        else:
+            name = resolve_backend_name(
+                cache_backend, kv_quant=kv_quant,
+                rolling_window=rolling_window,
+            )
+            if name not in self._backend_family:
+                raise ValueError(
+                    f"{type(self).__name__} drives cache backends "
+                    f"{self._backend_family}; {name!r} needs a "
+                    "different engine class — resolve it through "
+                    "inference.cache.engine_class"
+                )
+            backend = make_backend(
+                name, cfg, n_slots, self.max_len,
+                chunk_slack=self._chunk_slack,
+            )
+        if backend.is_paged != wants_paged:
+            raise ValueError(
+                f"{type(self).__name__} cannot drive the "
+                f"{backend.name!r} backend (paged={backend.is_paged})"
+            )
+        backend.bind(self)
+        self.cache_backend = backend
+        # Legacy attributes, derived from the backend — the jitted
+        # programs and external callers keep reading them.
+        self.kv_quant = backend.kv_quant
+        self.rolling_window = backend.is_rolling
         # Token-level pipelined decode on pp meshes: slots split into
         # pp staggered groups so every pipeline stage computes a
         # different group each microtick instead of idling pp-1 of the
@@ -248,8 +312,8 @@ class BatchingEngine:
             )
 
             self._pp = validate_pp_pipeline(
-                cfg, mesh, n_slots, kv_quant, rolling_window,
-                self._swaps_cache,
+                cfg, mesh, n_slots, self.kv_quant, self.rolling_window,
+                self.cache_backend.is_paged,
             )
         self.decode_ticks = decode_ticks
         # Overlapped dispatch: with overlap_decode=True, step() keeps a
@@ -380,20 +444,10 @@ class BatchingEngine:
         self.seed = int(seed)
         self._key = jax.random.PRNGKey(seed)
 
-        # kv_quant="int8": the slot cache stores int8 KV + per-token
-        # scales — half the resident footprint and half the HBM stream
-        # every decode tick. Prefill still computes on exact values;
-        # greedy outputs may differ from the bf16 cache by the int8
-        # rounding (~1e-3 relative on logits).
-        self.kv_quant = kv_quant
-        self.rolling_window = rolling_window
-        # Chunked-prefill continuations READ the ring before their own
-        # rows age out; the ring carries that chunk as slack.
-        self._chunk_slack = prefill_chunk or 1
-        self._cache = init_cache_for(
-            cfg, n_slots, self.max_len, kv_quant,
-            rolling=rolling_window, chunk_slack=self._chunk_slack,
-        )
+        # The backend builds the device cache (dense rows, int8 rows +
+        # scales, a rolling ring, or the paged block pool — the engine
+        # never branches on the kind).
+        self._cache = self.cache_backend.init_cache()
         self._cur = jnp.zeros((n_slots,), jnp.int32)  # next input token
         self._queue: deque[_Request] = deque()
         self._slots: List[Optional[_Request]] = [None] * n_slots
@@ -409,12 +463,10 @@ class BatchingEngine:
         # greedy_only skips the batched sampler's full-vocab sorts when
         # every active request is greedy — the common serving default.
         self._decode = None
-        if not self._swaps_cache:
-            # Subclasses that replace self._cache (paged) pin shardings
-            # themselves AFTER the swap; device_putting the dense cache
-            # here would burn a transient multi-GiB HBM allocation on a
-            # tree about to be discarded.
-            self._mesh_setup()
+        # The backend built the final cache pytree above, so shardings
+        # pin once, here, for every backend kind (the paged subclass no
+        # longer swaps a transient dense cache).
+        self._mesh_setup()
         # Serving observability (read by the HTTP /stats endpoint).
         # Written only by the engine-owning thread; plain ints so
         # cross-thread reads are merely possibly-stale, never torn.
@@ -432,7 +484,12 @@ class BatchingEngine:
             # how each replica runs its hot loop.
             "decode_ticks": decode_ticks,
             "overlap_depth": 2 if self.overlap_decode else 1,
+            # The active storage policy (registry name). Non-numeric,
+            # so the /metrics stat mirror skips it; the server exposes
+            # it as the shellac_engine_cache_backend_info gauge label.
+            "cache_backend": self.cache_backend.name,
         }
+        self.stats.update(self.cache_backend.initial_stats())
         # How decode_ticks was chosen: "fixed" (explicit int) or
         # "auto" (pending tune; autotune rewrites it to "auto-tuned").
         self.decode_ticks_source = (
@@ -449,29 +506,19 @@ class BatchingEngine:
     # ---- sharding ----------------------------------------------------
 
     def _mesh_setup(self) -> None:
-        """Pin the (dense or paged) cache's shardings on the mesh.
-
-        Called once self._cache holds its final pytree — at the end of
-        this class's constructor and again by the paged subclass after
-        it swaps the cache. Re-called, it just recomputes the sharding
+        """Pin the cache's shardings on the mesh, whatever its backend
+        kind. Called once self._cache holds its final pytree (end of
+        the constructor). Re-called, it just recomputes the sharding
         tree and invalidates the lazily-built decode jit.
         """
         if self.mesh is None:
             self._cache_sh = None
             return
-        from shellac_tpu.inference.kvcache import cache_logical_axes_for
-
-        if isinstance(self._cache, QuantPagedKVCache):
-            axes = quant_paged_cache_logical_axes(self.cfg)
-        elif isinstance(self._cache, PagedKVCache):
-            axes = paged_cache_logical_axes(self.cfg)
-        else:
-            # The single cache-kind dispatch (kvcache) — the axes tree
-            # can never desync from what init_cache_for built.
-            axes = cache_logical_axes_for(
-                self.cfg, self.kv_quant, rolling=self.rolling_window
-            )
-        self._cache_sh = make_shardings(self.mesh, axes)
+        # The backend that built the cache provides its axes — the
+        # sharding tree can never desync from the pytree.
+        self._cache_sh = make_shardings(
+            self.mesh, self.cache_backend.logical_axes()
+        )
         self._cache = jax.device_put(self._cache, self._cache_sh)
         self._decode = None
 
@@ -489,11 +536,9 @@ class BatchingEngine:
     # ---- jitted programs --------------------------------------------
 
     def _fresh_mini(self, length: int):
-        """Batch-1 cache of the engine's cache type (prefill scratch)."""
-        return init_cache_for(
-            self.cfg, 1, length, self.kv_quant,
-            rolling=self.rolling_window, chunk_slack=self._chunk_slack,
-        )
+        """Batch-1 cache of the engine's cache kind (prefill scratch),
+        built by the backend so it always matches the slot cache."""
+        return self.cache_backend.init_mini(length)
 
     @staticmethod
     def _plp_within(logits, tokens):
@@ -1078,15 +1123,34 @@ class BatchingEngine:
             constraint=constraint, trace=trace, **samp,
         ))
 
+    def _slot_footprint(self, req: _Request) -> int:
+        """Worst-case token residency of `req`: prompt + budget + 1,
+        plus the engine's window slack (speculative rounds overshoot
+        by gamma+1 before rolling back). The backend reserves this at
+        admission and caps mid-decode growth at it."""
+        return req.tokens.size + req.max_new + 1 + self._footprint_slack
+
+    def _window_write_span(self) -> int:
+        """Positions one decode window may write per slot — what the
+        backend must keep resident ahead of the live length. The
+        speculative mixin overrides (a verify round writes gamma+1)."""
+        return self.decode_ticks
+
     def _prepare_slot(self, slot: int, req: _Request) -> None:
-        """Hook before prefilling `req` into `slot` (paged: alloc blocks)."""
+        """Reserve storage for `req` before its prefill (backend hook;
+        paged allocates/attaches blocks). May raise PoolExhausted —
+        _fill_slots requeues the request and retries after a release."""
+        self.cache_backend.prepare_slot(slot, req,
+                                        self._slot_footprint(req))
 
     def _release_slot(self, slot: int) -> None:
-        """Hook after a request leaves `slot` (paged: free its blocks
-        via super()). Clears the slot's logit bias so the engine drops
-        back to the cheap no-bias decode variant — zeroing the row too,
-        or a later unbiased request on this slot would silently inherit
-        the stale biases."""
+        """A request left `slot`: release its storage (backend hook;
+        paged frees blocks) and clear the slot's SAMPLING state, which
+        is the engine's own. Clearing the logit bias drops the engine
+        back to the cheap no-bias decode variant — zeroing the row
+        too, or a later unbiased request on this slot would silently
+        inherit the stale biases."""
+        self.cache_backend.release_slot(slot)
         if self._slot_bias[slot] is not None:
             self._sbias = self._sbias.at[slot].set(0.0)
             self._slot_bias[slot] = None
@@ -1237,9 +1301,9 @@ class BatchingEngine:
         return first, lp, ((tlv, tli) if self.top_logprobs else None)
 
     def _prefill_start_offset(self, slot: int) -> int:
-        """Tokens already resident when prefill starts (paged prefix
-        caching overrides this with the matched prefix length)."""
-        return 0
+        """Tokens already resident when prefill starts (the paged
+        backend reports its matched prefix length)."""
+        return self.cache_backend.prefill_offset(slot)
 
     def _fill_slots(self, budget: Optional[int] = None):
         done = 0
@@ -1250,7 +1314,13 @@ class BatchingEngine:
                 break
             done += 1
             req = self._queue.popleft()
-            self._prepare_slot(i, req)
+            try:
+                self._prepare_slot(i, req)
+            except PoolExhausted:
+                # Backend capacity exhausted: put the request back and
+                # let it wait; retry after a slot frees its storage.
+                self._queue.appendleft(req)
+                break
             if req.trace is not None:
                 # Queue wait ends here (after _prepare_slot: a paged
                 # pool miss requeues the request, so its wait goes on).
@@ -1269,6 +1339,9 @@ class BatchingEngine:
 
     def _finish_prefill(self, slot: int, req: _Request, first,
                         lp=None, tl=None) -> None:
+        # The slot's prompt KV is now real: paged prefix caching
+        # registers the prompt blocks as matchable here.
+        self.cache_backend.on_prefill_complete(slot)
         # ONE host pull for everything this admission needs host-side
         # (first token, its logprob, the top-K alternatives): the
         # separate int()/float()/device_get() calls this replaces each
@@ -1740,10 +1813,9 @@ class BatchingEngine:
         obs.kv_util.set(self._kv_utilization())
 
     def _kv_utilization(self) -> float:
-        """Live KV tokens / capacity (paged: pool blocks in use)."""
-        live = sum(r.tokens.size + len(r.out)
-                   for r in self._slots if r is not None)
-        return live / (self.n_slots * self.max_len)
+        """Live residency / capacity, by the backend's own accounting
+        (dense: token counting; paged: pool blocks in use)."""
+        return self.cache_backend.utilization()
 
     def _decode_tokens(self, active_rows):
         """Advance every active slot; returns (tokens_per_slot,
@@ -1756,10 +1828,13 @@ class BatchingEngine:
         return self._sync_window(w)
 
     def _pre_decode(self, active_rows, advance=None) -> None:
-        """Hook before each decode window (paged: grow block tables).
-        `advance` maps slot -> tokens an un-synced in-flight window
-        will still append (overlapped dispatch), so length projections
-        stay exact without a host sync."""
+        """Backend hook before each decode window (paged: grow block
+        tables to cover the window's write span). `advance` maps slot
+        -> tokens an un-synced in-flight window will still append
+        (overlapped dispatch), so length projections stay exact
+        without a host sync."""
+        self.cache_backend.pre_window(active_rows, advance,
+                                      self._window_write_span())
 
     def cancel(self, rid) -> bool:
         """Drop a queued or in-flight request (caller must be the
@@ -1820,6 +1895,10 @@ class BatchingEngine:
         self.finished_logprobs.clear()
         self.finished_prompt_logprobs.clear()
         self.finished_top_logprobs.clear()
+        # Backend allocator to canonical pristine state (paged purges
+        # prefix registries and rebuilds the free list in constructor
+        # order — required for multi-host resync convergence).
+        self.cache_backend.reset()
         self.stats["requests_cancelled"] += len(dropped)
         return dropped
 
@@ -1909,7 +1988,7 @@ class PagedBatchingEngine(BatchingEngine):
     computed, which also yields the last-token logits sampling needs).
     """
 
-    _swaps_cache = True  # shardings pin on the paged pool, not the dense cache
+    _backend_family = ("paged", "paged-int8")
 
     def __init__(
         self,
@@ -1918,267 +1997,112 @@ class PagedBatchingEngine(BatchingEngine):
         *,
         n_slots: int = 8,
         max_len: Optional[int] = None,
-        block_size: int = 16,
+        block_size: Optional[int] = None,
         pool_tokens: Optional[int] = None,
         prefix_cache: bool = False,
+        cache_backend=None,
+        kv_quant: Optional[str] = None,
         **kw,
     ):
-        if kw.get("kv_quant") == "int8" and block_size % 32:
-            # The int8 grouped-gather kernel lands each page at sublane
-            # offset g*bs of its VMEM tile; int8's native (32, 128)
-            # tiling makes 32 the alignment unit. An engine knob, so an
-            # error beats a per-tick fallback warning.
-            raise ValueError(
-                f"kv_quant='int8' paged pools need block_size % 32 == 0 "
-                f"(got {block_size}); use 32 or 64"
-            )
-        super().__init__(cfg, params, n_slots=n_slots, max_len=max_len, **kw)
-        self.block_size = block_size
-        self.prefix_cache = prefix_cache
-        max_blocks_per_slot = -(-self.max_len // block_size)
-        if pool_tokens is None:
-            pool_tokens = n_slots * self.max_len // 2
-        n_blocks = max(-(-pool_tokens // block_size), max_blocks_per_slot) + 1
-        init_pool = (init_quant_paged_cache if self.kv_quant == "int8"
-                     else init_paged_cache)
-        self._cache = init_pool(
-            cfg, n_slots, n_blocks, block_size, max_blocks_per_slot
+        from shellac_tpu.inference.cache import (
+            CacheBackend,
+            make_backend,
+            resolve_backend_name,
         )
-        self._mesh_setup()  # re-pin shardings for the paged pytree
-        self._n_blocks = n_blocks
-        self._free: List[int] = list(range(n_blocks - 1, 0, -1))  # 0 = scratch
-        self._slot_blocks: List[List[int]] = [[] for _ in range(n_slots)]
-        # Prefix cache state (all host-side; empty when disabled):
-        # hash -> block id, insertion/touch-ordered so the front is LRU;
-        # _block_ref counts slots currently attached to a cached block
-        # (membership also marks "cached": release keeps these pooled
-        # instead of freeing them); ref == 0 means evictable.
-        self._hash_to_block: "OrderedDict[bytes, int]" = OrderedDict()
-        self._block_ref: Dict[int, int] = {}
-        self._slot_prefix_len: List[int] = [0] * n_slots
-        # Registrations deferred until the slot's prefill completes
-        # (the blocks hold garbage until then): slot -> [(idx, hash)].
-        self._pending_reg: Dict[int, List] = {}
+
+        if not isinstance(cache_backend, CacheBackend):
+            name = (resolve_backend_name(None, paged=True,
+                                         kv_quant=kv_quant)
+                    if cache_backend is None else
+                    resolve_backend_name(cache_backend,
+                                         kv_quant=kv_quant))
+            if name not in self._backend_family:
+                raise ValueError(
+                    f"{type(self).__name__} drives cache backends "
+                    f"{self._backend_family}; {name!r} needs a "
+                    "different engine class — resolve it through "
+                    "inference.cache.engine_class"
+                )
+            if block_size is None:
+                # int8 pools need 32-aligned pages (the grouped-gather
+                # kernel's sublane tiling); bf16 keeps the finer 16.
+                block_size = 64 if name == "paged-int8" else 16
+            cache_backend = make_backend(
+                name, cfg, n_slots, max_len or cfg.max_seq_len,
+                block_size=block_size, pool_tokens=pool_tokens,
+                prefix_cache=prefix_cache,
+                chunk_slack=kw.get("prefill_chunk") or 1,
+            )
+        else:
+            # A constructed pool carries its own geometry; engine
+            # kwargs that would have shaped a registry-built pool are
+            # refused instead of silently dropped (a dropped pool size
+            # is a capacity incident).
+            if block_size is not None \
+                    and block_size != cache_backend.block_size:
+                raise ValueError(
+                    f"block_size={block_size} conflicts with the "
+                    f"{cache_backend.name!r} backend instance "
+                    f"(block_size={cache_backend.block_size})"
+                )
+            if pool_tokens is not None:
+                raise ValueError(
+                    "pool_tokens cannot reshape a constructed backend "
+                    "instance; pass pool_tokens to the backend "
+                    "constructor instead"
+                )
+            if prefix_cache and not cache_backend.prefix_cache:
+                raise ValueError(
+                    f"prefix_cache=True conflicts with the "
+                    f"{cache_backend.name!r} backend instance "
+                    "(constructed without prefix_cache)"
+                )
+        super().__init__(cfg, params, n_slots=n_slots, max_len=max_len,
+                         cache_backend=cache_backend, **kw)
+        self.block_size = self.cache_backend.block_size
+        self.prefix_cache = self.cache_backend.prefix_cache
+        self._n_blocks = self.cache_backend.n_blocks
         # Keyed (pad_bucket, want_plp), like the dense _chunk_jit.
         self._prefix_prefill_jit: Dict[Any, Any] = {}
         # Beam-search programs, keyed (s_pad, beams, steps, eos,
         # length_penalty, n_gen) — see beam_search below.
         self._beam_jit: Dict[Any, Any] = {}
-        if prefix_cache:
-            self.stats.update({
-                "prefix_hit_tokens": 0,
-                "prefix_query_tokens": 0,
-                "prefix_evictions": 0,
-            })
 
-    # ---- allocator ---------------------------------------------------
+    # ---- allocator views --------------------------------------------
+    # The PagedBackend owns the allocator state; these forward the
+    # historical engine surface for the CoW beam search below, tests,
+    # and external callers.
+
+    @property
+    def _free(self):
+        return self.cache_backend._free
+
+    @property
+    def _slot_blocks(self):
+        return self.cache_backend._slot_blocks
+
+    @property
+    def _hash_to_block(self):
+        return self.cache_backend._hash_to_block
+
+    @property
+    def _block_ref(self):
+        return self.cache_backend._block_ref
 
     def _evictable(self) -> int:
-        return sum(1 for r in self._block_ref.values() if r == 0)
+        return self.cache_backend.evictable()
 
     def _alloc_block(self) -> int:
-        """Pop a free block, evicting the LRU unreferenced cached block
-        when the free list is dry. Caller checks capacity first."""
-        if self._free:
-            return self._free.pop()
-        for h, blk in self._hash_to_block.items():  # front = LRU
-            if self._block_ref[blk] == 0:
-                del self._hash_to_block[h]
-                del self._block_ref[blk]
-                self.stats["prefix_evictions"] += 1
-                return blk
-        raise RuntimeError("_alloc_block called with no capacity")
+        return self.cache_backend.alloc_block()
 
     def _ensure_blocks(self, slot: int, total_tokens: int) -> bool:
-        """Grow slot's table to cover total_tokens; False if pool empty."""
-        need = -(-total_tokens // self.block_size)
-        have = len(self._slot_blocks[slot])
-        if need <= have:
-            return True
-        if need - have > len(self._free) + self._evictable():
-            return False
-        new_ids = [self._alloc_block() for _ in range(need - have)]
-        self._slot_blocks[slot].extend(new_ids)
-        idx = jnp.arange(have, need, dtype=jnp.int32)
-        tables = self._cache.tables.at[slot, idx].set(
-            jnp.asarray(new_ids, jnp.int32)
-        )
-        self._cache = self._cache.replace(tables=tables)
-        return True
+        return self.cache_backend.ensure_blocks(slot, total_tokens)
 
-    # ---- prefix cache ------------------------------------------------
-
-    def _chain_hashes(self, tokens: np.ndarray) -> List[bytes]:
-        """Position-dependent content hashes of the full token blocks:
-        h_j = H(h_{j-1} || block_j), so a block only matches when its
-        entire prefix matches too (and therefore occupies the same
-        absolute positions — required for RoPE'd cached K)."""
-        bs = self.block_size
-        out: List[bytes] = []
-        h = b""
-        for j in range(tokens.size // bs):
-            h = hashlib.blake2b(
-                h + tokens[j * bs:(j + 1) * bs].tobytes(), digest_size=16
-            ).digest()
-            out.append(h)
-        return out
-
-    def _match_prefix(self, tokens: np.ndarray) -> Tuple[List[bytes], int]:
-        """Longest cached block chain covering a strict prompt prefix
-        (shared by slot admission and beam search)."""
-        hashes = self._chain_hashes(tokens)
-        # Cap: at least one prompt token must be computed (its logits
-        # seed sampling; full-match reuse would leave none).
-        cap = (tokens.size - 1) // self.block_size
-        m = 0
-        for h in hashes[:cap]:
-            if h not in self._hash_to_block:
-                break
-            m += 1
-        return hashes, m
-
-    def _attach_prefix(self, tokens: np.ndarray):
-        """Match + attach the longest cached chain READ-ONLY: bumps
-        refcounts and touches LRU order. Returns (hashes, matched
-        block ids). Callers own the hit-rate stats (count them only
-        once the attach is certain) and roll back a failed attach via
-        _detach_prefix — shared by slot admission and beam search so
-        the attach protocol cannot drift between them."""
-        hashes, m = self._match_prefix(tokens)
-        matched = [self._hash_to_block[h] for h in hashes[:m]]
-        for h, blk in zip(hashes[:m], matched):
-            self._block_ref[blk] += 1
-            self._hash_to_block.move_to_end(h)  # LRU touch
-        return hashes, matched
+    def _attach_prefix(self, tokens):
+        return self.cache_backend.attach_prefix(tokens)
 
     def _detach_prefix(self, matched) -> None:
-        for blk in matched:
-            self._block_ref[blk] -= 1
-
-    def _prepare_slot(self, slot: int, req) -> None:
-        # Reserve the FULL footprint (prompt + generation budget) at
-        # admission: growth mid-decode could exhaust the pool and there
-        # is no good victim to evict at that point.
-        need = req.tokens.size + req.max_new + 1
-        if not self.prefix_cache:
-            if not self._ensure_blocks(slot, need):
-                # Pool exhausted: put the request back and let it wait.
-                self._queue.appendleft(req)
-                raise _PoolExhausted()
-            return
-
-        hashes, matched = self._attach_prefix(req.tokens)
-        m = len(matched)
-        if matched:
-            self._slot_blocks[slot] = list(matched)
-            tables = self._cache.tables.at[
-                slot, jnp.arange(m, dtype=jnp.int32)
-            ].set(jnp.asarray(matched, jnp.int32))
-            self._cache = self._cache.replace(tables=tables)
-        if not self._ensure_blocks(slot, need):
-            # Roll back the attach (blocks stay cached) and requeue.
-            self._detach_prefix(matched)
-            self._slot_blocks[slot] = []
-            row = jnp.zeros((self._cache.max_blocks,), jnp.int32)
-            self._cache = self._cache.replace(
-                tables=self._cache.tables.at[slot].set(row)
-            )
-            self._queue.appendleft(req)
-            raise _PoolExhausted()
-        # The slot's own full prompt blocks become matchable only once
-        # prefill has actually written them — with chunked prefill that
-        # is several steps away, and registering early would let a
-        # concurrent same-prefix admission attend over unwritten KV.
-        # Stash the registrations; _finish_prefill flushes them.
-        self._pending_reg[slot] = [
-            (j, hashes[j])
-            for j in range(m, req.tokens.size // self.block_size)
-        ]
-        self._slot_prefix_len[slot] = m * self.block_size
-        self.stats["prefix_hit_tokens"] += m * self.block_size
-        self.stats["prefix_query_tokens"] += req.tokens.size
-
-    def _finish_prefill(self, slot: int, req, first, lp=None,
-                        tl=None) -> None:
-        # The prompt blocks now hold real KV: make them matchable.
-        for j, h in self._pending_reg.pop(slot, ()):
-            if h in self._hash_to_block:
-                continue  # identical chain cached by an earlier finisher
-            blk = self._slot_blocks[slot][j]
-            self._hash_to_block[h] = blk
-            self._block_ref[blk] = 1
-        super()._finish_prefill(slot, req, first, lp, tl)
-
-    def _release_slot(self, slot: int) -> None:
-        super()._release_slot(slot)  # clears the slot's logit bias
-        self._pending_reg.pop(slot, None)
-        if self.prefix_cache:
-            for blk in self._slot_blocks[slot]:
-                if blk in self._block_ref:
-                    self._block_ref[blk] -= 1  # stays cached, evictable at 0
-                else:
-                    self._free.append(blk)
-        else:
-            self._free.extend(reversed(self._slot_blocks[slot]))
-        self._slot_blocks[slot] = []
-        self._slot_prefix_len[slot] = 0
-        row = jnp.zeros((self._cache.max_blocks,), jnp.int32)
-        self._cache = self._cache.replace(
-            tables=self._cache.tables.at[slot].set(row)
-        )
-
-    def abort_all(self) -> List[Any]:
-        """Paged abort additionally resets the ALLOCATOR to its
-        canonical pristine state: prefix-cache registries purged and
-        the free list rebuilt in constructor order. Keeping cached
-        prefix blocks (the normal release behavior) would be a
-        correctness bug on the multi-host resync path — replicas abort
-        AFTER diverging, so their registries/free lists differ, and a
-        later prompt would prefix-hit on one host but miss on another:
-        different-shaped programs, wedged collective all over again."""
-        dropped = super().abort_all()
-        self._hash_to_block.clear()
-        self._block_ref.clear()
-        self._pending_reg.clear()
-        self._free = list(range(self._n_blocks - 1, 0, -1))
-        return dropped
-
-    def _pre_decode(self, active_rows, advance=None) -> None:
-        # Backstop only — admission already reserved the full footprint.
-        # Lengths are tracked on host (prompt + generated so far,
-        # projected past any un-synced in-flight window via `advance`):
-        # no device sync in the serving hot loop. A multi-tick window
-        # can write up to decode_ticks positions before the host
-        # intervenes; anything past the request's own footprint lands
-        # in scratch block 0 (post-finish overshoot), so the
-        # reservation is capped at the footprint.
-        for i, active in enumerate(active_rows):
-            if not active:
-                continue
-            req = self._slots[i]
-            length = (req.tokens.size + len(req.out)
-                      + (advance.get(i, 0) if advance else 0))
-            need = min(
-                length + self.decode_ticks,
-                req.tokens.size + req.max_new + 1,
-            )
-            if not self._ensure_blocks(i, need):
-                raise RuntimeError(
-                    "paged KV pool exhausted mid-decode; size pool_tokens "
-                    "for n_slots concurrent worst-case lengths"
-                )
-
-    def _fill_slots(self, budget=None):
-        try:
-            super()._fill_slots(budget)
-        except _PoolExhausted:
-            pass  # request re-queued; retry after a slot frees blocks
-
-    def _kv_utilization(self) -> float:
-        # Pool utilization replaces the dense token-count estimate:
-        # blocks out of the free list / pool size (block 0 is scratch).
-        pool = self._n_blocks - 1
-        return (pool - len(self._free)) / pool
+        self.cache_backend.detach_prefix(matched)
 
     def _observe_cache_gauges(self) -> None:
         super()._observe_cache_gauges()
@@ -2186,10 +2110,6 @@ class PagedBatchingEngine(BatchingEngine):
             self.obs.prefix_blocks.set(len(self._hash_to_block))
 
     # ---- jitted programs --------------------------------------------
-
-    def _prefill_start_offset(self, slot: int) -> int:
-        return self._slot_prefix_len[slot] if self.prefix_cache else 0
-
     def _chunk_prefill(self, pad, fresh, tokens, chunk_len, offset, slot,
                        key, samp, boundary_next=None, want_plp=False):
         """Paged chunks reuse the continuation program (a chunk is a
@@ -2214,7 +2134,7 @@ class PagedBatchingEngine(BatchingEngine):
     def _run_prefill(self, slot: int, req):
         """Prefix-cached prefill: compute only the unmatched suffix;
         returns (first sampled token, its raw logprob)."""
-        p = self._slot_prefix_len[slot] if self.prefix_cache else 0
+        p = self._prefill_start_offset(slot)
         if p == 0:
             return super()._run_prefill(slot, req)
         suffix = req.tokens[p:]
@@ -2304,7 +2224,7 @@ class PagedBatchingEngine(BatchingEngine):
         the prompt from the mini-prefill's own logits — identical math
         to the dense engine's whole-prompt scoring."""
         s = tokens.shape[1]
-        mini = init_cache_for(self.cfg, 1, s, self.kv_quant)
+        mini = self._fresh_mini(s)
         logits, mini = transformer.forward_with_cache(
             self.cfg, params, tokens, mini, new_tokens_len=prompt_len,
             fresh_cache=True, attn_impl=self.attn_impl, mesh=self.mesh,
@@ -2562,7 +2482,7 @@ class PagedBatchingEngine(BatchingEngine):
             # the engine's paged prefill). Pad positions write garbage
             # at tail offsets >= s%bs — overwritten by the beams' own
             # tokens before any read reaches them.
-            mini = init_cache_for(cfg, 1, s_pad, self.kv_quant)
+            mini = self._fresh_mini(s_pad)
             logits, mini = transformer.forward_with_cache(
                 cfg, params, tokens, mini, new_tokens_len=prompt_len,
                 fresh_cache=True, attn_impl=self.attn_impl,
@@ -2678,5 +2598,6 @@ class PagedBatchingEngine(BatchingEngine):
         return pools, out, norm, lens
 
 
-class _PoolExhausted(Exception):
-    pass
+# Backward-compatible alias: the exception moved to the cache
+# subsystem with the allocator that raises it.
+_PoolExhausted = PoolExhausted

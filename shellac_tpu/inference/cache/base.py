@@ -1,0 +1,153 @@
+"""CacheBackend: the storage-policy interface the serving engines hold.
+
+The separation is TVM's algorithm-vs-schedule split applied to KV
+storage: decode ALGORITHMS (the dense window, the speculative verify
+round, beam search, chunked prefill) are written once against this
+interface, while the STORAGE POLICY — dense slot rows, paged block
+pool, int8 quantization, rolling ring — is a pluggable backend behind
+it. An engine never branches on cache shape; it asks its backend.
+
+A backend owns two things:
+
+  1. the DEVICE cache construction contract: `init_cache()` builds the
+     engine's cache pytree, `init_mini(length)` the batch-1 prefill
+     scratch of the matching kind, and `logical_axes()` the sharding
+     axes tree — the single place jit `out_shardings` derive from, so
+     sharding can never desync from what the backend built;
+  2. the HOST-side slot residency policy: `prepare_slot` /
+     `release_slot` / `pre_window` / `reset` hooks (the paged block
+     allocator and prefix-cache registries live entirely here),
+     `utilization()` for the capacity gauge, and `residency()` — a
+     JSON-serializable report of what each slot holds, the piece the
+     disaggregated prefill/decode split will ship between hosts.
+
+Backends are bound to exactly one engine (`bind`); the engine keeps
+rebinding `engine._cache` from its jitted programs' donated outputs,
+and the backend reads/writes that attribute for table surgery (paged)
+rather than holding its own copy — one owner for the device tree, one
+for the host policy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from shellac_tpu.config import ModelConfig
+
+
+class PoolExhausted(Exception):
+    """Raised by `prepare_slot` when the backend cannot admit the
+    request right now (paged: pool has too few free/evictable blocks).
+    The engine requeues the request and retries after a release."""
+
+
+class CacheBackend:
+    """Base storage policy: one slot row per request, nothing to
+    allocate. Subclasses override the hooks that their policy needs;
+    every default below is the dense no-op."""
+
+    #: registry name ("dense", "paged-int8", ...) — exposed at /stats
+    #: and as the shellac_engine_cache_backend_info gauge label.
+    name: str = "dense"
+    #: True for block-pool backends (drives the pp-pipeline gate and
+    #: the engines' historical `_swaps_cache` contract).
+    is_paged: bool = False
+    #: True for ring-buffer backends (the engines' rolling_window
+    #: compatibility attribute derives from this).
+    is_rolling: bool = False
+
+    def __init__(self, cfg: ModelConfig, n_slots: int, max_len: int, *,
+                 kv_quant: Optional[str] = None, chunk_slack: int = 1):
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant={kv_quant!r}; have None, 'int8'")
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.kv_quant = kv_quant
+        self.chunk_slack = chunk_slack
+        self.engine: Any = None
+
+    # ---- engine binding ---------------------------------------------
+
+    def bind(self, engine) -> None:
+        """Attach to the owning engine. One backend, one engine: the
+        slot hooks read engine state (slots, stats, the live cache
+        pytree) and a shared backend would alias allocator state."""
+        if self.engine is not None and self.engine is not engine:
+            raise ValueError(
+                f"{self.name} backend is already bound to an engine; "
+                "construct one backend per engine"
+            )
+        self.engine = engine
+
+    # ---- device cache construction ----------------------------------
+
+    def init_cache(self):
+        raise NotImplementedError
+
+    def init_mini(self, length: int):
+        """Batch-1 prefill scratch of the kind the engine's prefill
+        program scatters into this backend's cache."""
+        raise NotImplementedError
+
+    def logical_axes(self):
+        """Sharding axes tree matching init_cache()'s pytree."""
+        raise NotImplementedError
+
+    # ---- slot lifecycle (host-side policy) --------------------------
+
+    def prepare_slot(self, slot: int, req, footprint: int) -> None:
+        """Reserve residency for `req` before its prefill. `footprint`
+        is the request's worst-case token residency (prompt + budget +
+        engine slack). May raise PoolExhausted; the engine requeues."""
+
+    def on_prefill_complete(self, slot: int) -> None:
+        """The slot's prompt KV is now real (prefill finished) —
+        paged prefix caching registers the prompt blocks here."""
+
+    def release_slot(self, slot: int) -> None:
+        """The request left `slot` (finish/cancel/abort)."""
+
+    def pre_window(self, active_rows, advance: Optional[Dict[int, int]],
+                   span: int) -> None:
+        """About to run one decode window writing up to `span` tokens
+        per active slot; `advance` maps slot -> tokens an un-synced
+        in-flight window will still append (overlapped dispatch)."""
+
+    def prefill_offset(self, slot: int) -> int:
+        """Tokens already resident when prefill starts (paged prefix
+        caching returns the matched prefix length)."""
+        return 0
+
+    def reset(self) -> None:
+        """abort_all: restore the allocator to its canonical pristine
+        state (multi-host resync depends on every replica converging
+        to identical post-abort state)."""
+
+    def initial_stats(self) -> Dict[str, int]:
+        """Backend-owned counters merged into engine.stats at
+        construction (paged prefix caching adds its hit counters)."""
+        return {}
+
+    # ---- accounting --------------------------------------------------
+
+    def utilization(self) -> float:
+        """Live residency / capacity, in [0, 1] (the kv_utilization
+        gauge the serving tier's load scoring reads)."""
+        raise NotImplementedError
+
+    def residency(self) -> Dict[str, Any]:
+        """JSON-serializable per-slot residency: what each slot holds
+        and the pool-level headroom. The engine adds request identity;
+        this is the storage view only."""
+        raise NotImplementedError
+
+    # ---- shared helpers ---------------------------------------------
+
+    def _slot_tokens(self) -> List[int]:
+        """Host-known live tokens per slot (prompt + generated)."""
+        eng = self.engine
+        return [
+            (r.tokens.size + len(r.out)) if r is not None else 0
+            for r in eng._slots
+        ]

@@ -1,0 +1,359 @@
+"""Paged block-pool backend: slots borrow fixed-size blocks as they
+grow and return them on completion, so resident KV memory tracks the
+tokens actually alive instead of n_slots x max_len worst case.
+
+All ALLOCATOR state lives here — free list, per-slot block lists, the
+prefix-cache hash registry and refcounts — while the device side only
+ever sees the block tables the backend writes into the engine's cache
+pytree. Block 0 is reserved scratch: unallocated table entries point at
+it, so stray writes/reads through them land harmlessly and are masked
+downstream.
+
+prefix_cache=True adds automatic prefix caching (the public
+PagedAttention/vLLM idea): full prompt blocks are content-hashed with a
+position-dependent chain, kept pooled after release (refcounted,
+LRU-evicted only when the free list runs dry), and new prompts attach
+the longest matching chain read-only — prefill then computes only the
+unmatched suffix.
+
+QuantPagedBackend stores the pool int8 with per-token fp32 scale pools
+that mirror the value pools block-for-block, so ONE allocator run
+covers both and nothing here changes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference.cache.base import CacheBackend, PoolExhausted
+from shellac_tpu.inference.cache.layout import (
+    init_cache_for,
+    init_paged_cache,
+    init_quant_paged_cache,
+    paged_cache_logical_axes,
+    quant_paged_cache_logical_axes,
+)
+
+
+class PagedBackend(CacheBackend):
+    name = "paged"
+    is_paged = True
+
+    def __init__(self, cfg: ModelConfig, n_slots: int, max_len: int, *,
+                 kv_quant: Optional[str] = None, block_size: int = 16,
+                 pool_tokens: Optional[int] = None,
+                 prefix_cache: bool = False, chunk_slack: int = 1):
+        super().__init__(cfg, n_slots, max_len, kv_quant=kv_quant,
+                         chunk_slack=chunk_slack)
+        if kv_quant == "int8":
+            if block_size % 32:
+                # The int8 grouped-gather kernel lands each page at
+                # sublane offset g*bs of its VMEM tile; int8's native
+                # (32, 128) tiling makes 32 the alignment unit. An
+                # engine knob, so an error beats a per-tick fallback
+                # warning.
+                raise ValueError(
+                    f"kv_quant='int8' paged pools need block_size % 32 "
+                    f"== 0 (got {block_size}); use 32 or 64"
+                )
+            self.name = "paged-int8"
+        self.block_size = block_size
+        self.prefix_cache = prefix_cache
+        self.max_blocks_per_slot = -(-max_len // block_size)
+        if pool_tokens is None:
+            pool_tokens = n_slots * max_len // 2
+        self.n_blocks = max(
+            -(-pool_tokens // block_size), self.max_blocks_per_slot
+        ) + 1
+        self._free: List[int] = list(range(self.n_blocks - 1, 0, -1))
+        self._slot_blocks: List[List[int]] = [[] for _ in range(n_slots)]
+        # Prefix cache state (all host-side; empty when disabled):
+        # hash -> block id, insertion/touch-ordered so the front is
+        # LRU; _block_ref counts slots currently attached to a cached
+        # block (membership also marks "cached": release keeps these
+        # pooled instead of freeing them); ref == 0 means evictable.
+        self._hash_to_block: "OrderedDict[bytes, int]" = OrderedDict()
+        self._block_ref: Dict[int, int] = {}
+        self._slot_prefix_len: List[int] = [0] * n_slots
+        # Registrations deferred until the slot's prefill completes
+        # (the blocks hold garbage until then): slot -> [(idx, hash)].
+        self._pending_reg: Dict[int, List] = {}
+
+    # ---- device cache construction ----------------------------------
+
+    def init_cache(self):
+        init_pool = (init_quant_paged_cache if self.kv_quant == "int8"
+                     else init_paged_cache)
+        return init_pool(self.cfg, self.n_slots, self.n_blocks,
+                         self.block_size, self.max_blocks_per_slot)
+
+    def init_mini(self, length: int):
+        # Prefill computes into a DENSE mini of the pool's kind, then
+        # the engine's prefill program scatters it through the slot's
+        # block table.
+        return init_cache_for(self.cfg, 1, length, self.kv_quant)
+
+    def logical_axes(self):
+        if self.kv_quant == "int8":
+            return quant_paged_cache_logical_axes(self.cfg)
+        return paged_cache_logical_axes(self.cfg)
+
+    # ---- allocator ---------------------------------------------------
+
+    def initial_stats(self) -> Dict[str, int]:
+        if not self.prefix_cache:
+            return {}
+        return {
+            "prefix_hit_tokens": 0,
+            "prefix_query_tokens": 0,
+            "prefix_evictions": 0,
+        }
+
+    def evictable(self) -> int:
+        return sum(1 for r in self._block_ref.values() if r == 0)
+
+    def alloc_block(self) -> int:
+        """Pop a free block, evicting the LRU unreferenced cached block
+        when the free list is dry. Caller checks capacity first."""
+        if self._free:
+            return self._free.pop()
+        for h, blk in self._hash_to_block.items():  # front = LRU
+            if self._block_ref[blk] == 0:
+                del self._hash_to_block[h]
+                del self._block_ref[blk]
+                self.engine.stats["prefix_evictions"] += 1
+                return blk
+        raise RuntimeError("alloc_block called with no capacity")
+
+    def ensure_blocks(self, slot: int, total_tokens: int) -> bool:
+        """Grow slot's table to cover total_tokens; False if pool
+        empty."""
+        eng = self.engine
+        need = -(-total_tokens // self.block_size)
+        have = len(self._slot_blocks[slot])
+        if need <= have:
+            return True
+        if need - have > len(self._free) + self.evictable():
+            return False
+        new_ids = [self.alloc_block() for _ in range(need - have)]
+        self._slot_blocks[slot].extend(new_ids)
+        idx = jnp.arange(have, need, dtype=jnp.int32)
+        tables = eng._cache.tables.at[slot, idx].set(
+            jnp.asarray(new_ids, jnp.int32)
+        )
+        eng._cache = eng._cache.replace(tables=tables)
+        return True
+
+    # ---- prefix cache ------------------------------------------------
+
+    def chain_hashes(self, tokens: np.ndarray) -> List[bytes]:
+        """Position-dependent content hashes of the full token blocks:
+        h_j = H(h_{j-1} || block_j), so a block only matches when its
+        entire prefix matches too (and therefore occupies the same
+        absolute positions — required for RoPE'd cached K)."""
+        bs = self.block_size
+        out: List[bytes] = []
+        h = b""
+        for j in range(tokens.size // bs):
+            h = hashlib.blake2b(
+                h + tokens[j * bs:(j + 1) * bs].tobytes(), digest_size=16
+            ).digest()
+            out.append(h)
+        return out
+
+    def _match_prefix(self, tokens: np.ndarray) -> Tuple[List[bytes], int]:
+        """Longest cached block chain covering a strict prompt prefix
+        (shared by slot admission and beam search)."""
+        hashes = self.chain_hashes(tokens)
+        # Cap: at least one prompt token must be computed (its logits
+        # seed sampling; full-match reuse would leave none).
+        cap = (tokens.size - 1) // self.block_size
+        m = 0
+        for h in hashes[:cap]:
+            if h not in self._hash_to_block:
+                break
+            m += 1
+        return hashes, m
+
+    def attach_prefix(self, tokens: np.ndarray):
+        """Match + attach the longest cached chain READ-ONLY: bumps
+        refcounts and touches LRU order. Returns (hashes, matched
+        block ids). Callers own the hit-rate stats (count them only
+        once the attach is certain) and roll back a failed attach via
+        detach_prefix — shared by slot admission and beam search so
+        the attach protocol cannot drift between them."""
+        hashes, m = self._match_prefix(tokens)
+        matched = [self._hash_to_block[h] for h in hashes[:m]]
+        for h, blk in zip(hashes[:m], matched):
+            self._block_ref[blk] += 1
+            self._hash_to_block.move_to_end(h)  # LRU touch
+        return hashes, matched
+
+    def detach_prefix(self, matched) -> None:
+        for blk in matched:
+            self._block_ref[blk] -= 1
+
+    # ---- slot lifecycle ---------------------------------------------
+
+    def prepare_slot(self, slot: int, req, footprint: int) -> None:
+        # Reserve the FULL footprint (prompt + generation budget +
+        # engine slack) at admission: growth mid-decode could exhaust
+        # the pool and there is no good victim to evict at that point.
+        eng = self.engine
+        if not self.prefix_cache:
+            if not self.ensure_blocks(slot, footprint):
+                raise PoolExhausted()
+            return
+
+        hashes, matched = self.attach_prefix(req.tokens)
+        m = len(matched)
+        if matched:
+            self._slot_blocks[slot] = list(matched)
+            tables = eng._cache.tables.at[
+                slot, jnp.arange(m, dtype=jnp.int32)
+            ].set(jnp.asarray(matched, jnp.int32))
+            eng._cache = eng._cache.replace(tables=tables)
+        if not self.ensure_blocks(slot, footprint):
+            # Roll back the attach (blocks stay cached) and requeue.
+            self.detach_prefix(matched)
+            self._slot_blocks[slot] = []
+            row = jnp.zeros((eng._cache.max_blocks,), jnp.int32)
+            eng._cache = eng._cache.replace(
+                tables=eng._cache.tables.at[slot].set(row)
+            )
+            raise PoolExhausted()
+        # The slot's own full prompt blocks become matchable only once
+        # prefill has actually written them — with chunked prefill that
+        # is several steps away, and registering early would let a
+        # concurrent same-prefix admission attend over unwritten KV.
+        # Stash the registrations; on_prefill_complete flushes them.
+        self._pending_reg[slot] = [
+            (j, hashes[j])
+            for j in range(m, req.tokens.size // self.block_size)
+        ]
+        self._slot_prefix_len[slot] = m * self.block_size
+        eng.stats["prefix_hit_tokens"] += m * self.block_size
+        eng.stats["prefix_query_tokens"] += req.tokens.size
+
+    def on_prefill_complete(self, slot: int) -> None:
+        # The prompt blocks now hold real KV: make them matchable.
+        for j, h in self._pending_reg.pop(slot, ()):
+            if h in self._hash_to_block:
+                continue  # identical chain cached by an earlier finisher
+            blk = self._slot_blocks[slot][j]
+            self._hash_to_block[h] = blk
+            self._block_ref[blk] = 1
+
+    def release_slot(self, slot: int) -> None:
+        eng = self.engine
+        self._pending_reg.pop(slot, None)
+        if self.prefix_cache:
+            for blk in self._slot_blocks[slot]:
+                if blk in self._block_ref:
+                    # Stays cached, evictable at refcount 0.
+                    self._block_ref[blk] -= 1
+                else:
+                    self._free.append(blk)
+        else:
+            self._free.extend(reversed(self._slot_blocks[slot]))
+        self._slot_blocks[slot] = []
+        self._slot_prefix_len[slot] = 0
+        row = jnp.zeros((eng._cache.max_blocks,), jnp.int32)
+        eng._cache = eng._cache.replace(
+            tables=eng._cache.tables.at[slot].set(row)
+        )
+
+    def pre_window(self, active_rows, advance, span: int) -> None:
+        # Backstop only — admission already reserved the full
+        # footprint. Lengths are tracked on host (prompt + generated so
+        # far, projected past any un-synced in-flight window via
+        # `advance`): no device sync in the serving hot loop. A window
+        # can write up to `span` positions before the host intervenes;
+        # anything past the request's own footprint lands in scratch
+        # block 0 (post-finish overshoot), so the reservation is capped
+        # at the footprint.
+        eng = self.engine
+        for i, active in enumerate(active_rows):
+            if not active:
+                continue
+            req = eng._slots[i]
+            length = (req.tokens.size + len(req.out)
+                      + (advance.get(i, 0) if advance else 0))
+            need = min(
+                length + span,
+                eng._slot_footprint(req),
+            )
+            if not self.ensure_blocks(i, need):
+                raise RuntimeError(
+                    "paged KV pool exhausted mid-decode; size "
+                    "pool_tokens for n_slots concurrent worst-case "
+                    "lengths"
+                )
+
+    def prefill_offset(self, slot: int) -> int:
+        return self._slot_prefix_len[slot] if self.prefix_cache else 0
+
+    def reset(self) -> None:
+        """abort_all: reset the allocator to its canonical pristine
+        state — prefix-cache registries purged and the free list
+        rebuilt in constructor order. Keeping cached prefix blocks
+        (the normal release behavior) would be a correctness bug on
+        the multi-host resync path: replicas abort AFTER diverging, so
+        their registries/free lists differ, and a later prompt would
+        prefix-hit on one host but miss on another — different-shaped
+        programs, wedged collective all over again."""
+        self._hash_to_block.clear()
+        self._block_ref.clear()
+        self._pending_reg.clear()
+        self._free = list(range(self.n_blocks - 1, 0, -1))
+        self._slot_blocks = [[] for _ in range(self.n_slots)]
+        self._slot_prefix_len = [0] * self.n_slots
+
+    # ---- accounting --------------------------------------------------
+
+    def utilization(self) -> float:
+        # Pool utilization replaces the dense token-count estimate:
+        # blocks out of the free list / pool size (block 0 is scratch).
+        pool = self.n_blocks - 1
+        return (pool - len(self._free)) / pool
+
+    def residency(self) -> Dict[str, Any]:
+        return {
+            "backend": self.name,
+            "slot_tokens": self._slot_tokens(),
+            "slot_blocks": [len(b) for b in self._slot_blocks],
+            "block_size": self.block_size,
+            "blocks_total": self.n_blocks - 1,  # minus scratch
+            "blocks_free": len(self._free),
+            "prefix_cached_blocks": len(self._hash_to_block),
+        }
+
+
+class QuantPagedBackend(PagedBackend):
+    """Int8 paged pool: PagedBackend's allocator over int8 value pools
+    + fp32 scale pools (layout.QuantPagedKVCache). Pure storage swap —
+    scale pools mirror the value pools block-for-block, so the free
+    list, prefix refcounts, and tables need no changes."""
+
+    name = "paged-int8"
+
+    def __init__(self, cfg: ModelConfig, n_slots: int, max_len: int, *,
+                 kv_quant: Optional[str] = "int8", block_size: int = 64,
+                 pool_tokens: Optional[int] = None,
+                 prefix_cache: bool = False, chunk_slack: int = 1):
+        if kv_quant != "int8":
+            raise ValueError(
+                f"QuantPagedBackend is the int8 pool; kv_quant="
+                f"{kv_quant!r} wants PagedBackend"
+            )
+        super().__init__(
+            cfg, n_slots, max_len, kv_quant="int8",
+            block_size=block_size, pool_tokens=pool_tokens,
+            prefix_cache=prefix_cache, chunk_slack=chunk_slack,
+        )

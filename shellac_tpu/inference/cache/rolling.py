@@ -1,0 +1,73 @@
+"""Rolling (ring-buffer) backend for sliding-window models.
+
+Storage scales with the WINDOW, not the context: each slot's row is a
+ring of window + chunk-slack positions and old positions overwrite in
+place (layout.RollingKVCache). Patterned local/global stacks get the
+mixed cache (rings for "window" layers, dense rows for "full" layers)
+automatically — init_cache_for routes by cfg.attn_pattern. kv_quant
+composes on both.
+
+Utilization stays token-based but capacity counts what a slot can
+actually HOLD resident — min(max_len, ring) per windowed layer does
+not change the engine-facing number because lengths still count total
+positions seen; the gauge reports live/|slots x max_len| like the
+dense backend so the serving tier's load scores stay comparable
+across backends.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference.cache.base import CacheBackend
+from shellac_tpu.inference.cache.layout import (
+    cache_logical_axes_for,
+    init_cache_for,
+    rolling_ring,
+)
+
+
+class RollingBackend(CacheBackend):
+    name = "rolling"
+    is_rolling = True
+
+    def __init__(self, cfg: ModelConfig, n_slots: int, max_len: int, *,
+                 kv_quant: Optional[str] = None, chunk_slack: int = 1):
+        super().__init__(cfg, n_slots, max_len, kv_quant=kv_quant,
+                         chunk_slack=chunk_slack)
+        if cfg.attn_window is None:
+            raise ValueError(
+                "rolling_window needs a sliding-window model "
+                "(attn_window)"
+            )
+        if kv_quant == "int8":
+            self.name = "rolling-int8"
+
+    def init_cache(self):
+        return init_cache_for(
+            self.cfg, self.n_slots, self.max_len, self.kv_quant,
+            rolling=True, chunk_slack=self.chunk_slack,
+        )
+
+    def init_mini(self, length: int):
+        return init_cache_for(
+            self.cfg, 1, length, self.kv_quant,
+            rolling=True, chunk_slack=self.chunk_slack,
+        )
+
+    def logical_axes(self):
+        return cache_logical_axes_for(self.cfg, self.kv_quant,
+                                      rolling=True)
+
+    def utilization(self) -> float:
+        return sum(self._slot_tokens()) / (self.n_slots * self.max_len)
+
+    def residency(self) -> Dict[str, Any]:
+        return {
+            "backend": self.name,
+            "slot_tokens": self._slot_tokens(),
+            "capacity_tokens": self.n_slots * self.max_len,
+            "ring": rolling_ring(self.cfg, self.max_len,
+                                 self.chunk_slack),
+        }

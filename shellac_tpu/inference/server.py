@@ -415,6 +415,9 @@ class InferenceServer:
         for k, v in g.engine.stats.items():
             if isinstance(v, (int, float)):
                 m.engine_stat(k).set(v)
+        m.cache_backend_info.labels(
+            backend=str(g.engine.stats.get("cache_backend", "dense"))
+        ).set(1)
         m.generation.set(g.gen)
         m.uptime.set(self.uptime_s)
         m.pending.set(len(self._pending))
@@ -1443,9 +1446,10 @@ class InferenceServer:
         eng = self.engine
         if not hasattr(eng, "finished_prompt_logprobs"):
             return False
-        # Paged engines score prompts now; out are the prefix cache (a
-        # cache hit skips exactly the scoring forward passes) and
-        # speculative engines (draft/verify prefill does not score).
+        # Paged AND speculative engines score prompts now (the spec
+        # engine's target prefill runs the same scoring forwards); the
+        # one remaining hole is the prefix cache — a cache hit skips
+        # exactly the scoring forward passes.
         return (getattr(eng, "_scores_prompts", True)
                 and not getattr(eng, "prefix_cache", False))
 
@@ -1464,8 +1468,8 @@ class InferenceServer:
         if native.get("prompt_logprobs") and not self._prompt_lp_capable():
             raise ValueError(
                 "echo with logprobs is unavailable on this server: the "
-                "engine cannot score prompts (prefix-cached or "
-                "speculative prefill skips the scoring forwards)"
+                "engine cannot score prompts (a prefix-cached prefill "
+                "skips the scoring forwards)"
             )
         tokens = self._parse(native)[0]
         # Hand handle() the ids so the prompt is not tokenized twice.

@@ -111,6 +111,14 @@ class ServeMetrics:
             "Constraint DFA cache lookups, by result (hit|miss)",
             labels=("result",),
         )
+        self.cache_backend_info = g(
+            "shellac_engine_cache_backend_info",
+            "Info gauge: always 1, labeled with the engine's active "
+            "KV-cache storage backend (registry name, e.g. dense, "
+            "paged-int8) so dashboards can group replicas by storage "
+            "policy",
+            labels=("backend",),
+        )
         self.tool_requests = c(
             "shellac_tool_requests_total",
             "Tool-enabled requests by resolution: call (tool_calls "

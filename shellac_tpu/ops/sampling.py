@@ -83,6 +83,51 @@ def sample(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def filter_logits_batched(
+    logits: jax.Array,  # (B, V)
+    temperature: jax.Array,  # (B,) fp32; <= 0 rows temper at 1.0
+    top_k: jax.Array,  # (B,) int32; >= V disables
+    top_p: jax.Array,  # (B,) fp32; 1.0 disables
+    min_p: jax.Array,  # (B,) fp32; 0.0 disables
+) -> jax.Array:
+    """The tempered, top-k/top-p/min-p-masked fp32 logits the batched
+    sampler draws from — THE truncation definition, factored out so
+    speculative decoding can apply the IDENTICAL filter to both the
+    draft and target distributions (rejection sampling then provably
+    reproduces the FILTERED target distribution, which is exactly what
+    sequential sampling draws from — the spec x top-k/top-p identity).
+
+    Greedy rows (temperature <= 0) are tempered at 1.0 and otherwise
+    filtered like any row; callers argmax those rows on their own
+    unfiltered logits, matching `sample_batched`.
+    """
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    t = jnp.where(temperature <= 0.0, 1.0, temperature)[:, None]
+    x = logits / t
+    # top-k: per-row kth-largest threshold (ties at the boundary are
+    # kept, matching top_k_mask).
+    k = jnp.clip(top_k, 1, v)
+    asc = jnp.sort(x, axis=-1)
+    kth = jnp.take_along_axis(asc, (v - k)[:, None], axis=-1)
+    x = jnp.where(x < kth, NEG_INF, x)
+    # top-p on the top-k-filtered rows (same order as the scalar path);
+    # re-sort so boundary ties behave exactly like top_p_mask.
+    desc = jnp.sort(x, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]
+    kth_p = jnp.min(
+        jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
+    )
+    x = jnp.where(x < kth_p, NEG_INF, x)
+    # min-p relative to each row's current max.
+    probs_x = jax.nn.softmax(x, axis=-1)
+    cutoff = min_p[:, None] * jnp.max(probs_x, axis=-1, keepdims=True)
+    x = jnp.where(probs_x < cutoff, NEG_INF, x)
+    return x
+
+
 def sample_batched(
     key: jax.Array,
     logits: jax.Array,  # (B, V)
@@ -108,30 +153,8 @@ def sample_batched(
     Rows with seed < 0 keep the shared stream.
     """
     logits = logits.astype(jnp.float32)
-    v = logits.shape[-1]
     greedy = temperature <= 0.0
-    t = jnp.where(greedy, 1.0, temperature)[:, None]
-    x = logits / t
-    # top-k: per-row kth-largest threshold (ties at the boundary are
-    # kept, matching top_k_mask).
-    k = jnp.clip(top_k, 1, v)
-    asc = jnp.sort(x, axis=-1)
-    kth = jnp.take_along_axis(asc, (v - k)[:, None], axis=-1)
-    x = jnp.where(x < kth, NEG_INF, x)
-    # top-p on the top-k-filtered rows (same order as the scalar path);
-    # re-sort so boundary ties behave exactly like top_p_mask.
-    desc = jnp.sort(x, axis=-1)[..., ::-1]
-    probs = jax.nn.softmax(desc, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = (cum - probs) < top_p[:, None]
-    kth_p = jnp.min(
-        jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
-    )
-    x = jnp.where(x < kth_p, NEG_INF, x)
-    # min-p relative to each row's current max.
-    probs_x = jax.nn.softmax(x, axis=-1)
-    cutoff = min_p[:, None] * jnp.max(probs_x, axis=-1, keepdims=True)
-    x = jnp.where(probs_x < cutoff, NEG_INF, x)
+    x = filter_logits_batched(logits, temperature, top_k, top_p, min_p)
     sampled = jax.random.categorical(key, x, axis=-1)
     if seed is not None:
         def row_draw(s, g, row):

@@ -223,18 +223,40 @@ class TestTopLogprobs:
 
 
 class TestValidation:
-    def test_int8_rejection_pinned(self, setup):
-        """int8 KV remains excluded BY ARGUMENT (docs/inference.md):
-        the verify window's in-chunk attention reads exact K/V where
-        sequential decode re-reads them rounded, breaking the
-        acceptance identity."""
-        with pytest.raises(NotImplementedError, match="int8"):
-            _engine(setup, kv_quant="int8")
+    def test_int8_composes(self, setup):
+        """PR 9 burned down the int8 exclusion: the verify forward
+        WRITES each position's K/V (quantizing at write) before its
+        in-window attention READS them back through the cache, so
+        draft scoring sees the same int8-rounded bits sequential
+        decode re-reads. Greedy parity vs the int8 sequential engine
+        is pinned in tests/test_cache_backends.py; this pins the
+        construction + self-draft acceptance identity."""
+        cfg, params = setup[:2]
+        srv = SpeculativeBatchingEngine(
+            cfg, params, cfg, params, gamma=3, n_slots=1, max_len=96,
+            kv_quant="int8",
+        )
+        assert srv.cache_backend.name == "dense-int8"
+        prompt = np.array([1, 2, 3], np.int32)
+        out = srv.run([("x", prompt, 10)])["x"]
+        assert len(out) == 10
+        # Self-draft greedy on one shared int8 cache path: every
+        # proposal must be accepted, or the write-then-read identity
+        # is broken somewhere.
+        assert srv.stats["spec_accepted"] == srv.stats["spec_proposed"]
 
-    def test_filter_params_rejected(self, setup):
-        srv = _engine(setup)
-        with pytest.raises(ValueError, match="temperature only"):
-            srv.submit("x", np.array([1], np.int32), 4, top_k=8)
+    def test_filter_params_compose(self, setup):
+        """top-k/top-p/min-p requests are admitted (burned down in
+        PR 9): the identical truncation is applied to draft and
+        target distributions before the acceptance test. Distribution
+        equivalence is pinned in tests/test_cache_backends.py."""
+        srv = _engine(setup, temperature=1.0)
+        srv.submit("x", np.array([1, 2], np.int32), 6,
+                   temperature=0.9, top_k=8, top_p=0.9, min_p=0.05)
+        results = {}
+        while srv.pending:
+            results.update(srv.step())
+        assert len(results["x"]) == 6
 
     def test_slack_budget_enforced(self, setup):
         srv = _engine(setup, max_len=32, gamma=4)
