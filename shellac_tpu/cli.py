@@ -1055,6 +1055,9 @@ def cmd_serve(args):
         restart_budget=args.restart_budget,
         restart_window=args.restart_window,
         heartbeat_path=args.heartbeat_file,
+        debug=args.debug,
+        debug_include_text=args.debug_include_text,
+        profile_dir=args.profile_dir,
     )
     return 0
 
@@ -1078,6 +1081,7 @@ def cmd_serve_tier(args):
         backoff_cap=args.backoff_cap,
         default_timeout=args.default_timeout,
         affinity_tolerance=args.affinity_tolerance,
+        debug=args.debug,
     )
     serve_tier(router, host=args.host, port=args.port)
     return 0
@@ -1459,6 +1463,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "GET /metrics (on by default; --no-metrics "
                         "no-ops every instrument and the endpoint "
                         "answers 404)")
+    s.add_argument("--debug", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="flight-recorder debug endpoints: GET "
+                        "/debug/requests (in-flight table, slot "
+                        "residency, histogram exemplars), GET "
+                        "/debug/request/<trace-id> (event timeline), "
+                        "POST /debug/profile (on-demand capture). "
+                        "--no-debug answers 404 and disables event "
+                        "recording (mirrors --no-metrics)")
+    s.add_argument("--debug-include-text", action="store_true",
+                   dest="debug_include_text",
+                   help="include prompt/generated text in /debug "
+                        "responses and recorder events (REDACTED by "
+                        "default: debug surfaces must not leak "
+                        "transcripts)")
+    s.add_argument("--profile-dir", default=None, dest="profile_dir",
+                   help="directory for POST /debug/profile?seconds=N "
+                        "jax.profiler captures of the live engine "
+                        "(unset = the endpoint answers 400)")
     s.add_argument("--heartbeat-file", default=None, dest="heartbeat_file",
                    help="liveness file the serving scheduler touches "
                         "every second, for external watchdogs "
@@ -1550,6 +1573,12 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--metrics", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="Prometheus shellac_tier_* series at /metrics")
+    st.add_argument("--debug", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="tier flight-recorder endpoints: GET "
+                         "/debug/requests (attempt log tail, e2e "
+                         "exemplars) and /debug/request/<trace-id>; "
+                         "--no-debug answers 404 and stops recording")
     st.set_defaults(fn=cmd_serve_tier)
 
     k = sub.add_parser("tokenize", help="encode text files into a token shard")

@@ -1122,6 +1122,12 @@ class BatchingEngine:
             prompt_logprobs=bool(prompt_logprobs), seed=seed,
             constraint=constraint, trace=trace, **samp,
         ))
+        if trace is not None:
+            # Flight-recorder timeline: the request entered the
+            # engine's admission queue (queue-wait ends at the span's
+            # prefill_start). No-op without a recorder on the trace.
+            trace.record("queue", src="engine", rid=rid,
+                         queue_depth=len(self._queue))
 
     def _slot_footprint(self, req: _Request) -> int:
         """Worst-case token residency of `req`: prompt + budget + 1,
@@ -1722,6 +1728,14 @@ class BatchingEngine:
             arrays=(toks, lps, tlvs, tlis, acts),
         )
         self._windows.append(w)
+        for slot, req in w.pairs:
+            if req.trace is not None:
+                # Dispatch half of the overlap pipeline: recorded per
+                # request so a timeline shows every window the request
+                # rode, with the in-flight depth at dispatch.
+                req.trace.record("window-dispatch", src="engine",
+                                 rid=req.rid, slot=slot, ticks=w.ticks,
+                                 depth=len(self._windows))
         if self._window_hooks is not None:
             self._window_hooks.on_dispatch(w)
         return w
@@ -1772,7 +1786,15 @@ class BatchingEngine:
         device-side but re-checked as the single source of truth)."""
         for slot, req in pairs:
             if self._slots[slot] is not req or slot in self._prefilling:
+                # Cancelled or replaced while the window was in flight:
+                # results discarded, and deliberately NO settle event —
+                # a cancelled request's timeline ends at its
+                # cancellation, never with a stale-slot settle.
                 continue
+            if req.trace is not None:
+                req.trace.record("window-settle", src="engine",
+                                 rid=req.rid, slot=slot,
+                                 n_tokens=len(per_slot[slot]))
             for j, tok in enumerate(per_slot[slot]):
                 req.out.append(int(tok))
                 if per_lps is not None:

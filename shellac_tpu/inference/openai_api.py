@@ -25,7 +25,8 @@ def _bad(msg: str):
     raise ValueError(msg)
 
 
-def stream_error_payload(exc: BaseException) -> dict:
+def stream_error_payload(exc: BaseException,
+                         trace_id: Optional[str] = None) -> dict:
     """In-band error record for a stream that already sent its 200.
 
     Once a stream's headers are gone the HTTP status can no longer
@@ -37,7 +38,12 @@ def stream_error_payload(exc: BaseException) -> dict:
     are retryable; a fault or timeout after tokens flowed is not — the
     client has a partial completion a retry would silently duplicate,
     so it must fail loudly instead. ServerUnavailable is duck-typed by
-    its `http_status` attribute to keep this module import-free."""
+    its `http_status` attribute to keep this module import-free.
+
+    `trace_id` rides the record so a post-200 failure is attributable
+    from the client's capture alone: the id resolves to the replica's
+    flight-recorder timeline (`GET /debug/request/<id>`) and the
+    tier's attempt log."""
     retryable = hasattr(exc, "http_status")
     if retryable:
         etype = "overloaded_error"
@@ -47,8 +53,11 @@ def stream_error_payload(exc: BaseException) -> dict:
         etype = "timeout_error"
     else:
         etype = "server_error"
-    return {"error": {"message": str(exc), "type": etype,
-                      "retryable": retryable}}
+    err: Dict[str, Any] = {"message": str(exc), "type": etype,
+                           "retryable": retryable}
+    if trace_id is not None:
+        err["trace_id"] = trace_id
+    return {"error": err}
 
 
 def _check_unsupported(payload: dict):

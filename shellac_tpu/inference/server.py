@@ -71,19 +71,30 @@ from __future__ import annotations
 import functools
 import itertools
 import json
+import os
 import queue
 import random
 import threading
 import time
+import urllib.parse
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference.batching import BatchingEngine
-from shellac_tpu.obs import Registry, ServeMetrics, get_registry
+from shellac_tpu.obs import (
+    REQUEST_ID_HEADER,
+    TRACE_HEADER,
+    FlightRecorder,
+    Registry,
+    ServeMetrics,
+    adopt_trace,
+    get_registry,
+    new_trace_id,
+)
 from shellac_tpu.utils.failure import Heartbeat, RestartBudget
 
 
@@ -103,6 +114,13 @@ def retry_after(lo: float, hi: float) -> float:
     multiple whole seconds because the HTTP header is rendered as
     integer delta-seconds — sub-second jitter would round away."""
     return random.uniform(lo, hi)
+
+
+class ProfileInProgress(RuntimeError):
+    """POST /debug/profile while a capture is already running: the
+    profiler is process-global state, so captures are strictly one at
+    a time (HTTP 409, not a queue — the second caller retries after
+    the first capture's window elapses)."""
 
 
 class ServerUnavailable(RuntimeError):
@@ -208,6 +226,10 @@ class InferenceServer:
         registry: Optional[Registry] = None,
         metrics: bool = True,
         autotune: bool = False,
+        debug: bool = True,
+        debug_include_text: bool = False,
+        profile_dir: Optional[str] = None,
+        recorder: Optional[FlightRecorder] = None,
         **engine_kw,
     ):
         # Observability: every span/counter lands in `registry` — the
@@ -218,6 +240,22 @@ class InferenceServer:
             registry = get_registry() if metrics else Registry(enabled=False)
         self._registry = registry
         self._m = ServeMetrics(registry)
+        # Introspection: the flight recorder feeds /debug/requests and
+        # /debug/request/<trace_id>. debug=False (serve --no-debug)
+        # 404s the endpoints AND disables recording; text redaction is
+        # separate — events and the in-flight table carry prompt or
+        # generated text only with debug_include_text (serve
+        # --debug-include-text).
+        self._debug = bool(debug)
+        self._debug_text = bool(debug_include_text)
+        self._recorder = (recorder if recorder is not None
+                          else FlightRecorder(registry=registry,
+                                              enabled=self._debug))
+        # On-demand profiling (POST /debug/profile?seconds=N): writes
+        # jax.profiler traces under profile_dir; the non-blocking lock
+        # guards the process-global profiler — one capture at a time.
+        self._profile_dir = profile_dir
+        self._profile_lock = threading.Lock()
         self._t0 = time.monotonic()
         # Validate BEFORE starting the scheduler thread: raising after
         # start() would orphan an engine-owning daemon thread the
@@ -432,6 +470,136 @@ class InferenceServer:
             "e2e_s": self._m.e2e.summary(),
             "queue_wait_s": self._m.queue_wait.summary(),
         }
+
+    # ---- debug introspection (flight recorder + profiler) -----------
+
+    @property
+    def debug_enabled(self) -> bool:
+        return self._debug
+
+    @property
+    def recorder(self) -> FlightRecorder:
+        return self._recorder
+
+    def debug_requests(self) -> Dict[str, Any]:
+        """The GET /debug/requests snapshot: the in-flight table (slot
+        assignments, per-request state), the overlap window depth, the
+        cache backend's per-slot residency(), histogram exemplars, and
+        the recorder's ring stats. All reads are cross-thread snapshots
+        of host state — possibly stale, never torn, never a device
+        sync. Prompt/generated text appears only under
+        --debug-include-text (redaction by default)."""
+        g = self._g
+        eng = g.engine
+        slots = list(getattr(eng, "_slots", ()) or ())
+        prefilling = set(getattr(eng, "_prefilling", ()) or ())
+        slot_of = {req.rid: i for i, req in enumerate(slots)
+                   if req is not None}
+        now = time.monotonic()
+        rows = []
+        for rid, p in list(self._pending.items()):
+            t = p.trace
+            slot = slot_of.get(rid)
+            row: Dict[str, Any] = {
+                "rid": rid,
+                "trace_id": getattr(t, "trace_id", None),
+                "slot": slot,
+                "state": ("queued" if slot is None
+                          else "prefilling" if slot in prefilling
+                          else "decoding"),
+                "stream": p.chunks is not None,
+                "age_s": (round(now - t.t_submit, 3)
+                          if t is not None else None),
+                "deadline_in_s": (round(p.deadline - now, 3)
+                                  if p.deadline is not None else None),
+            }
+            req = slots[slot] if slot is not None else None
+            if req is not None and req.rid == rid:
+                row["tokens_out"] = len(req.out)
+                if self._debug_text:
+                    row["prompt_text"] = (
+                        self.tokenizer.decode(
+                            [int(x) for x in req.tokens[:256]])
+                        if self.tokenizer is not None
+                        else [int(x) for x in req.tokens[:256]]
+                    )
+                    row["output_text"] = (
+                        self.tokenizer.decode(list(req.out))
+                        if self.tokenizer is not None else list(req.out)
+                    )
+            rows.append(row)
+        out: Dict[str, Any] = {
+            "in_flight": rows,
+            "pending": len(self._pending),
+            "overlap_window_depth": len(getattr(eng, "_windows", ())
+                                        or ()),
+            "generation": g.gen,
+            "recorder": self._recorder.stats(),
+            "exemplars": {
+                "ttft": self._m.ttft.bucket_exemplars(),
+                "e2e": self._m.e2e.bucket_exemplars(),
+                "queue_wait": self._m.queue_wait.bucket_exemplars(),
+                "tpot": self._m.tpot.bucket_exemplars(),
+            },
+        }
+        try:
+            out["slots"] = eng.cache_backend.residency()
+        except Exception:  # noqa: BLE001 — introspection must not 500
+            out["slots"] = None
+        return out
+
+    def debug_request(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """The GET /debug/request/<trace_id> timeline, or None for an
+        id the ring no longer (or never) holds."""
+        events = self._recorder.events_for(trace_id)
+        if not events:
+            return None
+        return {"trace_id": trace_id, "events": events}
+
+    def profile(self, seconds: float) -> Dict[str, Any]:
+        """POST /debug/profile?seconds=N: capture a jax.profiler device
+        trace of the LIVE engine for `seconds`, written under
+        --profile-dir. The handler thread sleeps through the window
+        (the scheduler keeps serving); the profiler is process-global,
+        so captures are strictly one at a time (ProfileInProgress ->
+        HTTP 409)."""
+        if self._profile_dir is None:
+            raise ValueError(
+                "profiling needs serve --profile-dir (no capture "
+                "directory configured)"
+            )
+        seconds = float(seconds)
+        if not 0 < seconds <= 120:
+            raise ValueError(
+                f"seconds={seconds:g} out of range (0, 120]"
+            )
+        if not self._profile_lock.acquire(blocking=False):
+            raise ProfileInProgress(
+                "a profiler capture is already running; retry after "
+                "its window elapses"
+            )
+        try:
+            import jax
+
+            path = os.path.join(
+                self._profile_dir,
+                f"trace-{int(time.time() * 1000)}",
+            )
+            jax.profiler.start_trace(path)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            n_files = sum(
+                len(files) for _, _, files in os.walk(path)
+            )
+            self._recorder.record(None, "profile-capture", src="server",
+                                  seconds=seconds, trace_dir=path,
+                                  files=n_files)
+            return {"trace_dir": path, "seconds": seconds,
+                    "files": n_files}
+        finally:
+            self._profile_lock.release()
 
     # ---- supervisor --------------------------------------------------
 
@@ -828,14 +996,33 @@ class InferenceServer:
     # ---- client surface ---------------------------------------------
 
     def _submit(self, tokens, max_new: int, stop, samp, *, stream: bool,
-                deadline: Optional[float] = None) -> _Pending:
+                deadline: Optional[float] = None,
+                trace_ctx: Optional[Tuple[str, int]] = None) -> _Pending:
+        # Distributed-trace identity: adopt the (trace_id, attempt) the
+        # HTTP layer pulled off x-shellac-trace, minting a fresh id for
+        # direct library callers — every admitted request has exactly
+        # one id, whoever it came from.
+        tid, attempt = (trace_ctx if trace_ctx is not None
+                        else (new_trace_id(), 0))
         # The span clock starts at admission, before any copying or
         # queueing, so queue-wait covers everything the client waits
         # through server-side.
-        trace = self._m.trace()
+        trace = self._m.trace(trace_id=tid, recorder=self._recorder)
         # Convert the prompt BEFORE taking the lock: the copy is O(S)
         # and the lock serializes every admission and the supervisor.
         tokens = np.asarray(tokens, np.int32)
+        # Admit-event fields built outside the lock too (the optional
+        # text decode is O(prompt)); text rides the event only under
+        # --debug-include-text.
+        admit_fields: Dict[str, Any] = {
+            "src": "server", "attempt": attempt,
+            "prompt_len": int(tokens.size), "max_new": int(max_new),
+            "stream": stream,
+        }
+        if self._debug_text and self.tokenizer is not None:
+            admit_fields["prompt_text"] = self.tokenizer.decode(
+                [int(t) for t in tokens[:256]]
+            )
         with self._lock:
             # Admission control. The lock pairs this with the
             # supervisor's sweep: a request either registers before the
@@ -875,6 +1062,12 @@ class InferenceServer:
             p = _Pending(rid, stream=stream, holdback=holdback,
                          deadline=deadline, trace=trace)
             self._pending[rid] = p
+            # Recorded BEFORE the scheduler can see the request: the
+            # enqueue below hands it to the engine thread, which
+            # records queue/prefill next — admit must already hold the
+            # timeline's first seq or a fast scheduler reorders it.
+            trace.record("admit", rid=rid, pending=len(self._pending),
+                         **admit_fields)
             g.submit_q.put(
                 (rid, tokens, max_new, stop, samp or {}, deadline)
             )
@@ -917,13 +1110,14 @@ class InferenceServer:
         return None if timeout is None else time.monotonic() + timeout
 
     def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
-                 stop=None, return_logprobs: bool = False, **samp):
+                 stop=None, return_logprobs: bool = False,
+                 trace_ctx: Optional[Tuple[str, int]] = None, **samp):
         # The timeout doubles as the request's deadline: it rides the
         # submit tuple so the scheduler can shed the request if it
         # expires before prefill ever runs.
         deadline = self._deadline(timeout)
         p = self._submit(tokens, max_new, stop, samp, stream=False,
-                         deadline=deadline)
+                         deadline=deadline, trace_ctx=trace_ctx)
         try:
             self._await(p, deadline)
         except TimeoutError:
@@ -936,14 +1130,17 @@ class InferenceServer:
 
     def generate_stream(self, tokens, max_new: int,
                         timeout: Optional[float] = None, stop=None,
-                        return_logprobs: bool = False, **samp):
+                        return_logprobs: bool = False,
+                        trace_ctx: Optional[Tuple[str, int]] = None,
+                        **samp):
         """Yield ("delta", [token ids]) as generation progresses, then
         ("done", full output) — or ("done", (output, logprobs)) with
         return_logprobs=True. `timeout` bounds the wait per chunk (and
         doubles as the admission deadline: a stream that cannot start
         before it elapses is shed instead of prefilled)."""
         p = self._submit(tokens, max_new, stop, samp, stream=True,
-                         deadline=self._deadline(timeout))
+                         deadline=self._deadline(timeout),
+                         trace_ctx=trace_ctx)
         finished = False
         try:
             while True:
@@ -1141,7 +1338,8 @@ class InferenceServer:
         "top_k": (None,), "min_p": (None, 0, 0.0),
     }
 
-    def _handle_beam(self, payload: dict) -> dict:
+    def _handle_beam(self, payload: dict,
+                     trace_ctx: Optional[Tuple[str, int]] = None) -> dict:
         """Native beam-search request: `num_beams` (+ optional
         `length_penalty`, `constraint`) returns the ranked beams as
         {"choices": [{"tokens", "beam_score", "text"?}]}."""
@@ -1170,7 +1368,7 @@ class InferenceServer:
             tokens, max_new, None,
             {"_beam": {"num_beams": nb, "length_penalty": lp,
                        "constraint": samp.get("constraint")}},
-            stream=False, deadline=deadline,
+            stream=False, deadline=deadline, trace_ctx=trace_ctx,
         )
         try:
             self._await(p, deadline)
@@ -1227,7 +1425,12 @@ class InferenceServer:
                    else "text")
         self._m.tool_requests.labels(outcome=outcome).inc()
 
-    def handle(self, payload: dict) -> dict:
+    def handle(self, payload: dict,
+               trace_ctx: Optional[Tuple[str, int]] = None) -> dict:
+        # One trace id for the whole request, fan-out included: resolve
+        # it here so every sub-submit (and the response echo) agrees.
+        if trace_ctx is None:
+            trace_ctx = (new_trace_id(), 0)
         tool_ctx = self._tool_context(payload)
         if payload.get("num_beams") is not None:
             if tool_ctx is not None:
@@ -1235,7 +1438,9 @@ class InferenceServer:
                     "tools do not compose with num_beams (a beam is a "
                     "ranked whole sequence, not an assistant turn)"
                 )
-            return self._handle_beam(payload)
+            result = self._handle_beam(payload, trace_ctx=trace_ctx)
+            result["trace_id"] = trace_ctx[0]
+            return result
         tokens, max_new, stop, samp = self._parse(payload)
         self._tool_constraint(samp, tool_ctx)
         want_lps = self._check_logprobs(payload)
@@ -1244,12 +1449,14 @@ class InferenceServer:
         if n == 1 and best_of == 1:
             out, lps, plp, tlp = self.generate(
                 tokens, max_new, timeout=payload.get("timeout"), stop=stop,
-                return_logprobs=True, **samp,
+                return_logprobs=True, trace_ctx=trace_ctx, **samp,
             )
-            return self._format_completion(
+            result = self._format_completion(
                 out, lps, want_lps, plp=plp, tlp=tlp, tlk=tlk,
                 tool_ctx=tool_ctx,
             )
+            result["trace_id"] = trace_ctx[0]
+            return result
         # Parallel sampling: best_of independent completions share the
         # slot batch (and, on a paged+prefix engine, their prompt KV);
         # the n best by mean token logprob come back as "choices". The
@@ -1267,7 +1474,7 @@ class InferenceServer:
                 pendings.append(self._submit(
                     tokens, max_new, stop,
                     samp if i == 0 else rest_samp, stream=False,
-                    deadline=deadline,
+                    deadline=deadline, trace_ctx=trace_ctx,
                 ))
         except RuntimeError:
             # Admission cap (or a fault) hit mid-fan-out: release the
@@ -1305,6 +1512,7 @@ class InferenceServer:
         ]}
         if plp is not None:
             result["prompt_logprobs"] = _render_plp(plp)
+        result["trace_id"] = trace_ctx[0]
         return result
 
     def _format_completion(self, out, lps, want_lps,
@@ -1368,12 +1576,17 @@ class InferenceServer:
             )
         return n, best_of
 
-    def handle_stream(self, payload: dict):
+    def handle_stream(self, payload: dict,
+                      trace_ctx: Optional[Tuple[str, int]] = None):
         """Yield response dicts for a streaming request: delta lines
         {"tokens": [...]}, then {"done": true, "tokens", "text"?,
-        "logprobs"?}. Logprobs (when requested) arrive on the final
-        record only. Parse errors raise before the first yield (clean
-        HTTP 400)."""
+        "logprobs"?}. Every record carries the request's `trace_id`,
+        so a stream that fails after its 200 is attributable from the
+        client's capture alone. Logprobs (when requested) arrive on
+        the final record only. Parse errors raise before the first
+        yield (clean HTTP 400)."""
+        if trace_ctx is None:
+            trace_ctx = (new_trace_id(), 0)
         if payload.get("num_beams") is not None:
             raise ValueError(
                 "num_beams does not compose with streaming (beams are "
@@ -1405,11 +1618,12 @@ class InferenceServer:
             scanner = ToolCallStreamParser(tool_ctx.mode)
         stream = self.generate_stream(
             tokens, max_new, timeout=payload.get("timeout"), stop=stop,
-            return_logprobs=True, **samp,
+            return_logprobs=True, trace_ctx=trace_ctx, **samp,
         )
+        tid = trace_ctx[0]
         for kind, val in stream:
             if kind == "delta":
-                rec: Dict[str, Any] = {"tokens": val}
+                rec: Dict[str, Any] = {"tokens": val, "trace_id": tid}
                 if scanner is not None:
                     streamed.extend(val)
                     ts = events_to_stream(scanner.feed(safe_stream_text(
@@ -1420,7 +1634,8 @@ class InferenceServer:
                 yield rec
             else:
                 out, lps, plp, tlp = val
-                final: Dict[str, Any] = {"done": True, "tokens": out}
+                final: Dict[str, Any] = {"done": True, "tokens": out,
+                                         "trace_id": tid}
                 if want_lps:
                     final["logprobs"] = lps
                 if tlk and tlp is not None:
@@ -1455,13 +1670,16 @@ class InferenceServer:
 
     # ---- OpenAI-compatible façade -----------------------------------
 
-    def handle_openai(self, payload: dict, chat: bool) -> dict:
+    def handle_openai(self, payload: dict, chat: bool,
+                      trace_ctx: Optional[Tuple[str, int]] = None) -> dict:
         from shellac_tpu.inference.openai_api import (
             chat_to_native,
             completion_response,
             completion_to_native,
         )
 
+        # trace_ctx passes straight through to handle(), which mints
+        # on None — no need to duplicate the fallback here.
         native = (chat_to_native(payload, self.tokenizer) if chat
                   else completion_to_native(payload, self.tokenizer))
         echo = bool(native.pop("echo", False))
@@ -1477,21 +1695,28 @@ class InferenceServer:
         native["tokens"] = [int(t) for t in tokens]
         prompt_tokens = len(tokens)
         max_new = int(native.get("max_new", 32))
-        result = self.handle(native)
+        result = self.handle(native, trace_ctx=trace_ctx)
         return completion_response(
             result, model=self.model_name, prompt_tokens=prompt_tokens,
             max_new=max_new, tokenizer=self.tokenizer, chat=chat,
             echo=echo, prompt_ids=[int(t) for t in tokens],
         )
 
-    def handle_openai_stream(self, payload: dict, chat: bool):
+    def handle_openai_stream(self, payload: dict, chat: bool,
+                             trace_ctx: Optional[Tuple[str, int]] = None):
         """Yield OpenAI SSE chunk objects (the HTTP layer frames them
-        as `data:` lines and appends [DONE])."""
+        as `data:` lines and appends [DONE]). Each chunk carries the
+        request's `trace_id` alongside the OpenAI fields — unknown
+        keys are ignored by SDKs, and a severed stream stays
+        attributable from the client's capture."""
         from shellac_tpu.inference.openai_api import (
             StreamTranslator,
             chat_to_native,
             completion_to_native,
         )
+
+        if trace_ctx is None:
+            trace_ctx = (new_trace_id(), 0)
 
         native = (chat_to_native(payload, self.tokenizer) if chat
                   else completion_to_native(payload, self.tokenizer))
@@ -1510,8 +1735,10 @@ class InferenceServer:
             tool_mode=bool(native.get("tools"))
             and native.get("tool_choice") != "none",
         )
-        for record in self.handle_stream(native):
-            yield from translator.feed(record, max_new)
+        for record in self.handle_stream(native, trace_ctx=trace_ctx):
+            for chunk in translator.feed(record, max_new):
+                chunk["trace_id"] = trace_ctx[0]
+                yield chunk
 
     def close(self):
         with self._lock:
@@ -1564,13 +1791,17 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             self.wfile.write(body)
 
         def _send_unavailable(self, e: "ServerUnavailable",
-                              openai: bool = False):
+                              openai: bool = False,
+                              trace_id: Optional[str] = None):
             err = ({"error": {"message": str(e),
                               "type": "overloaded_error"}}
                    if openai else {"error": str(e)})
-            self._send(e.http_status, err, headers={
+            headers = {
                 "Retry-After": str(max(1, int(round(e.retry_after)))),
-            })
+            }
+            if trace_id is not None:
+                headers[REQUEST_ID_HEADER] = trace_id
+            self._send(e.http_status, err, headers=headers)
 
         def do_GET(self):
             if self.path == "/v1/models":
@@ -1636,20 +1867,67 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.startswith("/debug/"):
+                # Introspection surface: the in-flight table and per-
+                # trace timelines. 404 wholesale under --no-debug (the
+                # --no-metrics pattern: absent, not forbidden).
+                if not server.debug_enabled:
+                    self._send(404, {
+                        "error": "debug endpoints disabled "
+                                 "(serve --no-debug)",
+                    })
+                elif self.path == "/debug/requests":
+                    self._send(200, server.debug_requests())
+                elif self.path.startswith("/debug/request/"):
+                    tid = self.path[len("/debug/request/"):]
+                    out = server.debug_request(tid)
+                    if out is None:
+                        self._send(404, {
+                            "error": f"no recorded events for trace id "
+                                     f"{tid!r} (finished long ago, "
+                                     "evicted from the ring, or never "
+                                     "seen)",
+                        })
+                    else:
+                        self._send(200, out)
+                else:
+                    self._send(404, {"error": "not found"})
             else:
                 self._send(404, {"error": "not found"})
 
-        def _stream(self, payload: dict):
+        def _handle_profile(self):
+            """POST /debug/profile?seconds=N — on-demand jax.profiler
+            capture on the live engine."""
+            if not server.debug_enabled:
+                self._send(404, {"error": "debug endpoints disabled "
+                                          "(serve --no-debug)"})
+                return
+            qs = urllib.parse.urlsplit(self.path).query
+            params = urllib.parse.parse_qs(qs)
+            try:
+                seconds = float(params.get("seconds", ["2"])[0])
+                self._send(200, server.profile(seconds))
+            except ProfileInProgress as e:
+                self._send(409, {"error": str(e)})
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
+            except RuntimeError as e:
+                # A profiler backend fault (another process-global
+                # trace active, unwritable dir) is a server error.
+                self._send(500, {"error": str(e)})
+
+        def _stream(self, payload: dict, tctx: Tuple[str, int]):
             # Newline-delimited JSON, no Content-Length: the connection
             # closes at the end of the stream (HTTP/1.0 semantics of
             # BaseHTTPRequestHandler — no keep-alive to preserve).
-            lines = server.handle_stream(payload)
+            lines = server.handle_stream(payload, trace_ctx=tctx)
             try:
                 first = next(lines)  # parse errors surface before 200
             except StopIteration:
                 first = None
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header(REQUEST_ID_HEADER, tctx[0])
             self.end_headers()
             rest = (
                 itertools.chain([first], lines) if first is not None else lines
@@ -1664,37 +1942,45 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 pass
             except (ValueError, TimeoutError, RuntimeError) as e:
                 # Headers are gone; report in-band and close. The
-                # record carries type + retryable so a fronting router
-                # that has not yet forwarded bytes can classify it.
+                # record carries type + retryable + the trace id so a
+                # fronting router that has not yet forwarded bytes can
+                # classify it, and the client's capture alone
+                # identifies the request server-side.
                 try:
                     self.wfile.write(
-                        (json.dumps(stream_error_payload(e)) + "\n")
+                        (json.dumps(stream_error_payload(
+                            e, trace_id=tctx[0])) + "\n")
                         .encode()
                     )
                 except OSError:
                     pass
 
-        def _stream_sse(self, payload: dict, chat: bool):
+        def _stream_sse(self, payload: dict, chat: bool,
+                        tctx: Tuple[str, int]):
             # OpenAI Server-Sent Events framing: one `data: <json>` line
             # per chunk, blank-line separated, closed by `data: [DONE]`.
-            chunks = server.handle_openai_stream(payload, chat)
+            chunks = server.handle_openai_stream(payload, chat,
+                                                 trace_ctx=tctx)
             try:
                 first = next(chunks, None)  # errors surface before 200
             except (ValueError, TimeoutError) as e:
                 self._send(400, {"error": {"message": str(e),
-                                           "type": "invalid_request_error"}})
+                                           "type": "invalid_request_error"}},
+                           headers={REQUEST_ID_HEADER: tctx[0]})
                 return
             except ServerUnavailable as e:
-                self._send_unavailable(e, openai=True)
+                self._send_unavailable(e, openai=True, trace_id=tctx[0])
                 return
             except RuntimeError as e:
                 # Scheduler death is a server fault, not a bad request.
                 self._send(500, {"error": {"message": str(e),
-                                           "type": "server_error"}})
+                                           "type": "server_error"}},
+                           headers={REQUEST_ID_HEADER: tctx[0]})
                 return
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
+            self.send_header(REQUEST_ID_HEADER, tctx[0])
             self.end_headers()
             rest = (
                 itertools.chain([first], chunks) if first is not None
@@ -1711,7 +1997,7 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 pass  # client hung up: the engine-side cancel fires
             except (ValueError, TimeoutError, RuntimeError) as e:
                 try:
-                    payload = stream_error_payload(e)
+                    payload = stream_error_payload(e, trace_id=tctx[0])
                     self.wfile.write(
                         f"data: {json.dumps(payload)}\n\n".encode()
                     )
@@ -1719,6 +2005,14 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     pass
 
         def do_POST(self):
+            # Trace adoption: the tier (or any front-end) hands the
+            # request its distributed trace id + attempt number via
+            # x-shellac-trace; direct callers get a freshly minted id.
+            # Every response echoes it as x-request-id.
+            tctx = adopt_trace(self.headers.get(TRACE_HEADER))
+            if self.path.startswith("/debug/profile"):
+                self._handle_profile()
+                return
             if self.path == "/drain":
                 # Admin surface: begin (or with {"resume": true},
                 # cancel) a graceful drain. Returns the health
@@ -1742,34 +2036,40 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             if self.path not in ("/generate", *openai_routes):
                 self._send(404, {"error": "not found"})
                 return
+            rid_hdr = {REQUEST_ID_HEADER: tctx[0]}
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(n) or b"{}")
                 if self.path in openai_routes:
                     chat = openai_routes[self.path]
                     if payload.get("stream"):
-                        self._stream_sse(payload, chat)
+                        self._stream_sse(payload, chat, tctx)
                     else:
-                        self._send(200, server.handle_openai(payload, chat))
+                        self._send(200,
+                                   server.handle_openai(
+                                       payload, chat, trace_ctx=tctx),
+                                   headers=rid_hdr)
                 elif payload.get("stream"):
-                    self._stream(payload)
+                    self._stream(payload, tctx)
                 else:
-                    self._send(200, server.handle(payload))
+                    self._send(200, server.handle(payload, trace_ctx=tctx),
+                               headers=rid_hdr)
             except (ValueError, TimeoutError) as e:
                 err = {"error": str(e)}
                 if self.path in openai_routes:
                     # OpenAI clients expect the nested error shape.
                     err = {"error": {"message": str(e),
                                      "type": "invalid_request_error"}}
-                self._send(400, err)
+                self._send(400, err, headers=rid_hdr)
             except ServerUnavailable as e:
                 # Backpressure, not failure: 429 (over the pending cap)
                 # or 503 (recovering), each with Retry-After — before
                 # the RuntimeError arm, which would misreport it as an
                 # opaque 500.
-                self._send_unavailable(e, openai=self.path in openai_routes)
+                self._send_unavailable(e, openai=self.path in openai_routes,
+                                       trace_id=tctx[0])
             except RuntimeError as e:
-                self._send(500, {"error": str(e)})
+                self._send(500, {"error": str(e)}, headers=rid_hdr)
 
     return ThreadingHTTPServer((host, port), Handler)
 

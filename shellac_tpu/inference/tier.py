@@ -72,7 +72,17 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from shellac_tpu.obs import Registry, TierMetrics, get_registry
+from shellac_tpu.obs import (
+    REQUEST_ID_HEADER,
+    TRACE_HEADER,
+    FlightRecorder,
+    Registry,
+    TierMetrics,
+    adopt_trace,
+    format_trace_header,
+    get_registry,
+    new_trace_id,
+)
 from shellac_tpu.utils.failure import CircuitBreaker
 
 #: Parsed-metrics keys the load score reads (PR 3 gauge names).
@@ -219,6 +229,7 @@ class TierRouter:
         affinity_tolerance: float = 4.0,
         registry: Optional[Registry] = None,
         metrics: bool = True,
+        debug: bool = True,
     ):
         if not replicas:
             raise ValueError("a tier needs at least one replica URL")
@@ -230,6 +241,15 @@ class TierRouter:
             registry = get_registry() if metrics else Registry(enabled=False)
         self._registry = registry
         self._m = TierMetrics(registry)
+        # Tier-side flight recorder: the per-request ATTEMPT log
+        # (tier-attempt / retry / tier-finish under the request's trace
+        # id) plus replica-scoped events (eject / readmit / severed).
+        # The same trace id indexes the replica's own recorder, so one
+        # id walks the whole path. debug=False 404s the tier's /debug
+        # endpoints and stops recording (mirrors --no-metrics).
+        self._debug = bool(debug)
+        self._recorder = FlightRecorder(registry=registry,
+                                        enabled=self._debug)
         self._t0 = time.monotonic()
         self.health_interval = health_interval
         self.health_timeout = health_timeout
@@ -330,6 +350,8 @@ class TierRouter:
                 rep.pending = int(health.get("pending", 0))
             if probing or was == "ejected":
                 self._m.readmissions.labels(replica=rep.url).inc()
+                self._recorder.record(None, "readmit", src="tier",
+                                      replica=rep.url)
             self._scrape_load(rep)
             return
         if health.get("status") == "draining":
@@ -356,6 +378,10 @@ class TierRouter:
                 rep.state = "ejected"
         if newly:
             self._m.ejections.labels(replica=rep.url).inc()
+            # Replica-scoped recorder event (no trace id: an ejection
+            # belongs to the fleet timeline, not one request).
+            self._recorder.record(None, "eject", src="tier",
+                                  replica=rep.url)
 
     def _scrape_load(self, rep: Replica) -> None:
         """Refresh the load snapshot from the replica's /metrics (the
@@ -534,13 +560,20 @@ class TierRouter:
         return _Permanent(e.code, body, ct)
 
     def _post(self, rep: Replica, path: str, payload: dict,
-              timeout: float):
+              timeout: float, trace_id: Optional[str] = None,
+              attempt: int = 0):
         """One POST attempt; returns the open response (caller reads).
-        Raises _Retryable/_Permanent with the failure classified."""
+        Raises _Retryable/_Permanent with the failure classified. The
+        request's trace id + THIS attempt's number ride the
+        x-shellac-trace header, so the replica's span, its flight
+        recorder, and the tier's attempt log all quote one id — and a
+        replica can tell a first attempt from a retry leg."""
         data = json.dumps(payload).encode()
+        headers = {"Content-Type": "application/json"}
+        if trace_id is not None:
+            headers[TRACE_HEADER] = format_trace_header(trace_id, attempt)
         req = urllib.request.Request(
-            rep.url + path, data=data,
-            headers={"Content-Type": "application/json"},
+            rep.url + path, data=data, headers=headers,
         )
         try:
             return urllib.request.urlopen(req, timeout=timeout)
@@ -559,10 +592,20 @@ class TierRouter:
             raise _Retryable("connect", f"replica connection failed: {e}",
                              breaker=True) from e
 
-    def _attempt_failed(self, rep: Replica, e: _Retryable) -> None:
+    def _attempt_failed(self, rep: Replica, e: _Retryable,
+                        trace_id: Optional[str] = None,
+                        attempt: int = 0) -> None:
+        """Account one retryable attempt failure: the retries counter,
+        the breaker (when the class charges it), and the request's
+        flight-recorder retry leg — recorded HERE so a failure path
+        can never charge the metrics without the timeline noticing."""
         self._m.retries.labels(replica=rep.url, kind=e.kind).inc()
         if e.breaker:
             self._note_failure(rep)
+        if trace_id is not None:
+            self._recorder.record(trace_id, "retry", src="tier",
+                                  replica=rep.url, kind=e.kind,
+                                  attempt=attempt)
 
     def _backoff(self, attempt: int, remaining: float) -> Optional[float]:
         """Full-jitter capped exponential backoff, bounded by the
@@ -581,8 +624,9 @@ class TierRouter:
 
     def _route_attempts(self, path: str, payload: dict,
                         deadline: float, stop: dict):
-        """Generator of (replica, reason, remaining, attempt_payload):
-        the shared retry loop. Callers `throw`-free: they report each
+        """Generator of (replica, reason, remaining, attempt_payload,
+        attempt): the shared retry loop. Callers `throw`-free: they
+        report each
         failure via _attempt_failed and ask for the next attempt by
         iterating; the generator sleeps the backoff between attempts
         and stops when attempts or the deadline run out — recording
@@ -593,6 +637,10 @@ class TierRouter:
         key, prefix_tokens = self.affinity_key(path, payload)
         tried: set = set()
         stop["why"] = "attempts"
+        # Attempt legs actually SENT — distinct from the loop index,
+        # which also advances while waiting out an unroutable fleet:
+        # the wire contract says attempt=0 is the first real leg.
+        legs = 0
         for attempt in range(self.max_attempts):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -610,7 +658,7 @@ class TierRouter:
                     stop["why"] = "deadline"
                     return
             rep, reason = self._pick(key, prefix_tokens, tried)
-            if rep is not None and attempt > 0:
+            if rep is not None and legs > 0:
                 # Relabel so the routed series distinguishes retry
                 # traffic from first attempts (the reason the metric
                 # documents); the failure class lives in the separate
@@ -630,23 +678,33 @@ class TierRouter:
             att["timeout"] = remaining
             att.pop("session", None)  # tier-level extension, not a
             #                           replica sampling knob
-            yield rep, reason, remaining, att
+            yield rep, reason, remaining, att, legs
+            legs += 1
 
-    def forward_json(self, path: str,
-                     payload: dict) -> Tuple[int, bytes, str]:
+    def forward_json(self, path: str, payload: dict,
+                     trace_id: Optional[str] = None
+                     ) -> Tuple[int, bytes, str]:
         """Route a non-streaming request. Returns (status, body bytes,
         content type) — always; failures come back as error responses,
-        never exceptions."""
+        never exceptions. `trace_id` is the request's distributed
+        trace id (minted here for programmatic callers); every attempt
+        forwards it with its attempt number, and the tier's flight
+        recorder logs the attempt/retry sequence under it."""
         t0 = time.monotonic()
+        tid = trace_id or new_trace_id()
         deadline = self._deadline(payload)
         stop: Dict[str, str] = {}
         last: Optional[_Retryable] = None
-        for rep, reason, remaining, att in self._route_attempts(
+        for rep, reason, remaining, att, attempt in self._route_attempts(
                 path, payload, deadline, stop):
             self._m.routed.labels(replica=rep.url, reason=reason).inc()
+            self._recorder.record(tid, "tier-attempt", src="tier",
+                                  replica=rep.url, reason=reason,
+                                  attempt=attempt)
             a0 = time.monotonic()
             try:
-                with self._post(rep, path, att, remaining) as resp:
+                with self._post(rep, path, att, remaining,
+                                trace_id=tid, attempt=attempt) as resp:
                     try:
                         body = resp.read()
                     except (OSError,
@@ -663,24 +721,33 @@ class TierRouter:
                                           "application/json")
                 self._m.attempt_latency.observe(time.monotonic() - a0)
                 self._m.outcomes.labels(outcome="ok").inc()
-                self._m.e2e.observe(time.monotonic() - t0)
+                self._m.e2e.observe(time.monotonic() - t0, exemplar=tid)
+                self._recorder.record(tid, "tier-finish", src="tier",
+                                      replica=rep.url,
+                                      status=resp.status,
+                                      attempts=attempt + 1)
                 return resp.status, body, ct
             except _Retryable as e:
                 self._m.attempt_latency.observe(time.monotonic() - a0)
-                self._attempt_failed(rep, e)
+                self._attempt_failed(rep, e, tid, attempt)
                 last = e
             except _Permanent as e:
                 # A definitive replica answer (bad request): relay it
                 # verbatim — the tier must not mask a 400 as transient.
                 self._m.attempt_latency.observe(time.monotonic() - a0)
                 self._m.outcomes.labels(outcome="failed").inc()
-                self._m.e2e.observe(time.monotonic() - t0)
+                self._m.e2e.observe(time.monotonic() - t0, exemplar=tid)
+                self._recorder.record(tid, "tier-finish", src="tier",
+                                      replica=rep.url, status=e.status,
+                                      attempts=attempt + 1)
                 return e.status, e.body, e.content_type
-        return self._exhausted(t0, path, last, stop)
+        return self._exhausted(t0, path, last, stop, tid)
 
     def _exhausted(self, t0: float, path: str,
                    last: Optional[_Retryable],
-                   stop: dict) -> Tuple[int, bytes, str]:
+                   stop: dict,
+                   trace_id: Optional[str] = None
+                   ) -> Tuple[int, bytes, str]:
         """Classify a request that ran out of road: no replica was
         ever routable (503 rejected), the DEADLINE expired mid-retries
         (504), or the attempt budget drained with deadline to spare —
@@ -701,9 +768,18 @@ class TierRouter:
             msg = (f"replicas exhausted after {self.max_attempts} "
                    f"attempts; last failure: {last.kind}: {last}")
             status = 502
-        self._m.e2e.observe(time.monotonic() - t0)
-        err = {"error": {"message": msg, "type": "overloaded_error"}} \
-            if path.startswith("/v1/") else {"error": msg}
+        self._m.e2e.observe(time.monotonic() - t0, exemplar=trace_id)
+        self._recorder.record(trace_id, "tier-exhausted", src="tier",
+                              status=status, why=stop.get("why"))
+        if path.startswith("/v1/"):
+            err: Dict[str, Any] = {"error": {"message": msg,
+                                             "type": "overloaded_error"}}
+            if trace_id is not None:
+                err["error"]["trace_id"] = trace_id
+        else:
+            err = {"error": msg}
+            if trace_id is not None:
+                err["trace_id"] = trace_id
         return status, json.dumps(err).encode(), "application/json"
 
     # ---- streaming ---------------------------------------------------
@@ -743,7 +819,8 @@ class TierRouter:
             return obj["error"]
         return None
 
-    def open_stream(self, path: str, payload: dict):
+    def open_stream(self, path: str, payload: dict,
+                    trace_id: Optional[str] = None):
         """Route a streaming request: retries attempts until one yields
         a healthy first event, then hands (response, first_event_bytes,
         content_type, replica_url, t0) to the HTTP layer to relay —
@@ -752,25 +829,33 @@ class TierRouter:
         (None, (status, body, content_type)) — an ordinary error
         response, since nothing was committed to the client yet."""
         t0 = time.monotonic()
+        tid = trace_id or new_trace_id()
         deadline = self._deadline(payload)
         stop: Dict[str, str] = {}
         last: Optional[_Retryable] = None
         sse = path.startswith("/v1/")
-        for rep, reason, remaining, att in self._route_attempts(
+        for rep, reason, remaining, att, attempt in self._route_attempts(
                 path, payload, deadline, stop):
             self._m.routed.labels(replica=rep.url, reason=reason).inc()
+            self._recorder.record(tid, "tier-attempt", src="tier",
+                                  replica=rep.url, reason=reason,
+                                  attempt=attempt, stream=True)
             a0 = time.monotonic()
             try:
-                resp = self._post(rep, path, att, remaining)
+                resp = self._post(rep, path, att, remaining,
+                                  trace_id=tid, attempt=attempt)
             except _Retryable as e:
                 self._m.attempt_latency.observe(time.monotonic() - a0)
-                self._attempt_failed(rep, e)
+                self._attempt_failed(rep, e, tid, attempt)
                 last = e
                 continue
             except _Permanent as e:
                 self._m.attempt_latency.observe(time.monotonic() - a0)
                 self._m.outcomes.labels(outcome="failed").inc()
-                self._m.e2e.observe(time.monotonic() - t0)
+                self._m.e2e.observe(time.monotonic() - t0, exemplar=tid)
+                self._recorder.record(tid, "tier-finish", src="tier",
+                                      replica=rep.url, status=e.status,
+                                      attempts=attempt + 1)
                 return None, (e.status, e.body, e.content_type)
             try:
                 first = self._read_first_event(resp, sse)
@@ -779,7 +864,7 @@ class TierRouter:
                 err = _Retryable("stream_pre_byte",
                                  f"stream died before first event: {e}",
                                  breaker=True)
-                self._attempt_failed(rep, err)
+                self._attempt_failed(rep, err, tid, attempt)
                 last = err
                 continue
             if not first.strip():
@@ -791,7 +876,7 @@ class TierRouter:
                 err = _Retryable("stream_pre_byte",
                                  "stream closed before first event",
                                  breaker=True)
-                self._attempt_failed(rep, err)
+                self._attempt_failed(rep, err, tid, attempt)
                 last = err
                 continue
             in_band = self._first_event_error(first, sse)
@@ -803,16 +888,19 @@ class TierRouter:
                 err = _Retryable("stream_pre_byte",
                                  str(in_band.get("message", "")),
                                  breaker=False)
-                self._attempt_failed(rep, err)
+                self._attempt_failed(rep, err, tid, attempt)
                 last = err
                 continue
             self._m.attempt_latency.observe(time.monotonic() - a0)
             self._m.outcomes.labels(outcome="ok").inc()
+            self._recorder.record(tid, "tier-finish", src="tier",
+                                  replica=rep.url, status=200,
+                                  attempts=attempt + 1, stream=True)
             ct = resp.headers.get("Content-Type",
                                   "text/event-stream" if sse
                                   else "application/x-ndjson")
             return (resp, first, ct, rep.url, t0), None
-        return None, self._exhausted(t0, path, last, stop)
+        return None, self._exhausted(t0, path, last, stop, tid)
 
     # ---- admin / introspection --------------------------------------
 
@@ -884,6 +972,33 @@ class TierRouter:
     def metrics_text(self) -> str:
         return self._registry.render()
 
+    @property
+    def debug_enabled(self) -> bool:
+        return self._debug
+
+    @property
+    def recorder(self) -> FlightRecorder:
+        return self._recorder
+
+    def debug_requests(self) -> Dict[str, Any]:
+        """GET /debug/requests on the tier: recent recorder events
+        (attempt log, ejections, severed streams), ring stats, and the
+        e2e histogram's exemplars — each exemplar trace id resolves to
+        a full timeline here (tier legs) and on the replica that
+        served it (engine legs)."""
+        return {
+            "recent_events": self._recorder.tail(256),
+            "recorder": self._recorder.stats(),
+            "exemplars": {"e2e": self._m.e2e.bucket_exemplars()},
+            "replicas": [r.snapshot() for r in self._replicas],
+        }
+
+    def debug_request(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        events = self._recorder.events_for(trace_id)
+        if not events:
+            return None
+        return {"trace_id": trace_id, "events": events}
+
     def close(self) -> None:
         self._closed.set()
         self._poller.join(timeout=5)
@@ -898,7 +1013,8 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
         def log_message(self, *a):  # quiet
             pass
 
-        def _send(self, code: int, obj) -> None:
+        def _send(self, code: int, obj,
+                  trace_id: Optional[str] = None) -> None:
             if isinstance(obj, tuple):  # (status, body, content_type)
                 code, body, ct = obj
             else:
@@ -906,6 +1022,8 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
             self.send_response(code)
             self.send_header("Content-Type", ct)
             self.send_header("Content-Length", str(len(body)))
+            if trace_id is not None:
+                self.send_header(REQUEST_ID_HEADER, trace_id)
             if code in (429, 502, 503, 504):
                 from shellac_tpu.inference.server import retry_after
 
@@ -947,6 +1065,24 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                     except (OSError, http.client.HTTPException):
                         continue
                 self._send(503, {"error": "no routable replica"})
+            elif self.path.startswith("/debug/"):
+                if not router.debug_enabled:
+                    self._send(404, {"error": "debug endpoints disabled "
+                                              "(serve-tier --no-debug)"})
+                elif self.path == "/debug/requests":
+                    self._send(200, router.debug_requests())
+                elif self.path.startswith("/debug/request/"):
+                    tid = self.path[len("/debug/request/"):]
+                    out = router.debug_request(tid)
+                    if out is None:
+                        self._send(404, {
+                            "error": f"no recorded events for trace "
+                                     f"id {tid!r}",
+                        })
+                    else:
+                        self._send(200, out)
+                else:
+                    self._send(404, {"error": "not found"})
             else:
                 self._send(404, {"error": "not found"})
 
@@ -977,14 +1113,17 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                 bool(obj.get("done")) or "error" in obj
             )
 
-        def _relay_stream(self, path: str, payload: dict) -> None:
-            opened, err = router.open_stream(path, payload)
+        def _relay_stream(self, path: str, payload: dict,
+                          trace_id: str) -> None:
+            opened, err = router.open_stream(path, payload,
+                                             trace_id=trace_id)
             if opened is None:
-                self._send(err[0], err)
+                self._send(err[0], err, trace_id=trace_id)
                 return
             resp, first, ct, rep_url, t0 = opened
             self.send_response(200)
             self.send_header("Content-Type", ct)
+            self.send_header(REQUEST_ID_HEADER, trace_id)
             if ct.startswith("text/event-stream"):
                 self.send_header("Cache-Control", "no-cache")
             self.end_headers()
@@ -1017,9 +1156,18 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                 if upstream_lost:
                     router._m.stream_severed.labels(
                         replica=rep_url).inc()
+                    router._recorder.record(
+                        trace_id, "stream-severed", src="tier",
+                        replica=rep_url,
+                    )
+                    # The loud in-band record carries the trace id, so
+                    # the client's capture alone identifies the severed
+                    # request in the tier's attempt log and the
+                    # replica's flight recorder.
                     msg = {"error": {
                         "message": "upstream replica lost mid-stream",
                         "type": "server_error", "retryable": False,
+                        "trace_id": trace_id,
                     }}
                     data = json.dumps(msg)
                     self.wfile.write(
@@ -1035,8 +1183,10 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                 resp.close()
                 # The e2e histogram covers the WHOLE stream (its help
                 # text says admission to final byte), so it settles
-                # here, not at the first event.
-                router._m.e2e.observe(time.monotonic() - t0)
+                # here, not at the first event — exemplar included,
+                # like every non-streamed settlement.
+                router._m.e2e.observe(time.monotonic() - t0,
+                                      exemplar=trace_id)
 
         def do_POST(self):
             try:
@@ -1074,10 +1224,16 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
             if self.path not in route_paths:
                 self._send(404, {"error": "not found"})
                 return
+            # Adopt the client's trace id (a W3C-shaped x-shellac-trace
+            # from an upstream proxy) or mint one: this id rides every
+            # replica attempt and comes back as x-request-id.
+            tid, _ = adopt_trace(self.headers.get(TRACE_HEADER))
             if payload.get("stream"):
-                self._relay_stream(self.path, payload)
+                self._relay_stream(self.path, payload, tid)
             else:
-                self._send(0, router.forward_json(self.path, payload))
+                self._send(0, router.forward_json(self.path, payload,
+                                                  trace_id=tid),
+                           trace_id=tid)
 
     return ThreadingHTTPServer((host, port), Handler)
 

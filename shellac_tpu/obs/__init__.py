@@ -1,16 +1,30 @@
-"""shellac_tpu.obs — unified metrics & request tracing.
+"""shellac_tpu.obs — unified metrics, request tracing & introspection.
 
-A dependency-free metrics core (`Counter`, `Gauge`, `Histogram`,
-`Registry` with labeled series and Prometheus text exposition) plus the
-`RequestTrace` span recorder that rides each serving request from
-submit to settlement. Engines, the HTTP server, and the training loop
-all deposit into one process-global registry by default
-(`get_registry()`), so `GET /metrics` — or a bench snapshot — sees
-training throughput and serving latency through one exposition path.
+A dependency-free metrics core (`Counter`, `Gauge`, `Histogram` with
+per-bucket trace-id exemplars, `Registry` with labeled series and
+Prometheus text exposition), the `RequestTrace` span recorder that
+rides each serving request from submit to settlement, and the
+distributed-tracing layer (`events.py`): W3C-shaped trace ids with the
+x-shellac-trace / x-request-id header contract, plus the
+`FlightRecorder` ring of lifecycle events behind the /debug endpoints.
+Engines, the HTTP server, and the training loop all deposit into one
+process-global registry by default (`get_registry()`), so
+`GET /metrics` — or a bench snapshot — sees training throughput and
+serving latency through one exposition path.
 
-See docs/observability.md for the metric catalog and scrape examples.
+See docs/observability.md for the metric catalog, the tracing/header
+contract, and the recorder event catalog.
 """
 
+from shellac_tpu.obs.events import (
+    REQUEST_ID_HEADER,
+    TRACE_HEADER,
+    FlightRecorder,
+    adopt_trace,
+    format_trace_header,
+    new_trace_id,
+    parse_trace_header,
+)
 from shellac_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -29,6 +43,13 @@ from shellac_tpu.obs.trace import (
 )
 
 __all__ = [
+    "FlightRecorder",
+    "TRACE_HEADER",
+    "REQUEST_ID_HEADER",
+    "new_trace_id",
+    "parse_trace_header",
+    "format_trace_header",
+    "adopt_trace",
     "Counter",
     "Gauge",
     "Histogram",

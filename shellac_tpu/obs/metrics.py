@@ -133,7 +133,7 @@ class Histogram(_Instrument):
     host-side percentile estimates (for /stats summaries)."""
 
     kind = "histogram"
-    __slots__ = ("uppers", "counts", "sum", "count", "_max")
+    __slots__ = ("uppers", "counts", "sum", "count", "_max", "exemplars")
 
     def __init__(self, registry: "Registry", buckets: Sequence[float]):
         super().__init__(registry)
@@ -149,8 +149,12 @@ class Histogram(_Instrument):
         self.sum = 0.0
         self.count = 0
         self._max = float("-inf")
+        # Last exemplar (an opaque label, in practice a trace id) per
+        # bucket — allocated lazily on the first exemplar'd observe, so
+        # histograms that never carry exemplars pay one None check.
+        self.exemplars: Optional[List[Optional[str]]] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         if not self._registry.enabled:
             return
         v = float(v)
@@ -161,6 +165,28 @@ class Histogram(_Instrument):
             self.count += 1
             if v > self._max:
                 self._max = v
+            if exemplar is not None:
+                if self.exemplars is None:
+                    self.exemplars = [None] * (len(self.uppers) + 1)
+                self.exemplars[idx] = exemplar
+
+    def bucket_exemplars(self) -> Dict[str, str]:
+        """{bucket le: last exemplar observed into that bucket} for
+        buckets that have one. The resolution path from a latency
+        outlier to a concrete request: the `+Inf`/top-bucket entry of a
+        TTFT histogram is a trace id whose flight-recorder timeline
+        (`GET /debug/request/<id>`) explains the outlier."""
+        with self._lock:
+            if self.exemplars is None:
+                return {}
+            out: Dict[str, str] = {}
+            for i, ex in enumerate(self.exemplars):
+                if ex is None:
+                    continue
+                le = ("+Inf" if i == len(self.uppers)
+                      else _fmt(self.uppers[i]))
+                out[le] = ex
+            return out
 
     def percentile(self, q: float) -> Optional[float]:
         """Estimated q-quantile (0 < q <= 1) by linear interpolation

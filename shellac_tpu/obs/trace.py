@@ -128,8 +128,14 @@ class ServeMetrics:
         )
         self._engine_stats: Dict[str, object] = {}
 
-    def trace(self) -> "RequestTrace":
-        return RequestTrace(self)
+    def trace(self, trace_id: Optional[str] = None,
+              recorder=None) -> "RequestTrace":
+        """A span for one request. `trace_id` links the span to the
+        distributed trace (the tier/header id); `recorder` is the
+        server's FlightRecorder — when both are set the span's event
+        methods also deposit timeline events, and the latency
+        histograms retain the id as a per-bucket exemplar."""
+        return RequestTrace(self, trace_id=trace_id, recorder=recorder)
 
     def engine_stat(self, key: str):
         """Scrape-time gauge mirroring one engine `stats` counter as
@@ -152,16 +158,30 @@ class RequestTrace:
     pop-arbitrated settlement."""
 
     __slots__ = ("_m", "t_submit", "t_prefill", "t_first", "t_done",
-                 "n_tokens", "outcome")
+                 "n_tokens", "outcome", "trace_id", "recorder")
 
-    def __init__(self, metrics: ServeMetrics):
+    def __init__(self, metrics: ServeMetrics,
+                 trace_id: Optional[str] = None, recorder=None):
         self._m = metrics
+        # Distributed-trace identity (obs.events.new_trace_id shape) and
+        # the flight recorder the span's events feed. Both optional:
+        # a bare trace()/RequestTrace() records spans only, exactly the
+        # pre-tracing behavior.
+        self.trace_id = trace_id
+        self.recorder = recorder
         self.t_submit = time.monotonic()
         self.t_prefill: Optional[float] = None
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
         self.n_tokens = 0
         self.outcome: Optional[str] = None
+
+    def record(self, event: str, **fields) -> None:
+        """Deposit one flight-recorder event under this span's trace
+        id. A no-op without a recorder, so engine/server call sites
+        need no branching."""
+        if self.recorder is not None:
+            self.recorder.record(self.trace_id, event, **fields)
 
     # ---- pipeline events (called by the engine-owning thread) --------
 
@@ -171,7 +191,9 @@ class RequestTrace:
         if self.t_prefill is not None:
             return
         self.t_prefill = time.monotonic()
-        self._m.queue_wait.observe(self.t_prefill - self.t_submit)
+        wait = self.t_prefill - self.t_submit
+        self._m.queue_wait.observe(wait, exemplar=self.trace_id)
+        self.record("prefill", src="engine", queue_wait_s=round(wait, 6))
 
     def first_token(self) -> None:
         """The first generated token exists host-side (prefill sampled
@@ -179,7 +201,9 @@ class RequestTrace:
         if self.t_first is not None:
             return
         self.t_first = time.monotonic()
-        self._m.ttft.observe(self.t_first - self.t_submit)
+        ttft = self.t_first - self.t_submit
+        self._m.ttft.observe(ttft, exemplar=self.trace_id)
+        self.record("first-token", src="engine", ttft_s=round(ttft, 6))
 
     # ---- settlement --------------------------------------------------
 
@@ -196,20 +220,26 @@ class RequestTrace:
         if not self._settle("ok"):
             return
         self.n_tokens = int(n_tokens)
-        self._m.e2e.observe(self.t_done - self.t_submit)
+        e2e = self.t_done - self.t_submit
+        self._m.e2e.observe(e2e, exemplar=self.trace_id)
         if self.t_first is not None and self.n_tokens > 1:
             self._m.tpot.observe(
-                (self.t_done - self.t_first) / (self.n_tokens - 1)
+                (self.t_done - self.t_first) / (self.n_tokens - 1),
+                exemplar=self.trace_id,
             )
+        self.record("finish", src="server", n_tokens=self.n_tokens,
+                    e2e_s=round(e2e, 6))
 
     def shed(self) -> None:
         """Deadline expired before prefill; the scheduler dropped it."""
         if self._settle("shed"):
             self._m.sheds.inc()
+            self.record("shed", src="server")
 
     def abort(self, outcome: str = "cancelled") -> None:
         """Any non-ok, non-shed settlement: cancelled | error | fault."""
-        self._settle(outcome)
+        if self._settle(outcome):
+            self.record(outcome, src="server")
 
 
 class TierMetrics:

@@ -595,13 +595,14 @@ class TestTierPassThrough:
             else:
                 assert json.loads(rc[0]["args"])["op"] in ("add", "mul")
             # Chunk-for-chunk relay: same count, same delta payloads
-            # (ids/created differ per request — strip them).
+            # (ids/created/trace ids differ per request — strip them).
             def strip(chunks):
                 out = []
                 for c in chunks:
                     c = json.loads(json.dumps(c))
                     c.pop("id", None)
                     c.pop("created", None)
+                    c.pop("trace_id", None)
                     for ch in c["choices"]:
                         for item in ch["delta"].get("tool_calls", []):
                             item.pop("id", None)

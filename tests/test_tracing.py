@@ -1,0 +1,523 @@
+"""Distributed request tracing, the flight recorder, and on-demand
+profiling (docs/observability.md §Tracing).
+
+  - trace-id plumbing: W3C-shaped ids, the x-shellac-trace /
+    x-request-id header contract, adoption vs minting;
+  - LIVE two-replica propagation: a request that retries after a
+    replica refuses carries ONE id verifiable in all four places —
+    the tier's attempt log, the replica's span (histogram exemplar),
+    the replica's /debug/request/<id> timeline, and the x-request-id
+    response header;
+  - flight-recorder correctness under overlap_decode=True:
+    dispatch/settle ordering, no stale-slot settle events after a
+    cancel;
+  - exemplar-to-timeline resolution, redaction defaults, --no-debug;
+  - POST /debug/profile smoke on a live engine (CPU jax.profiler).
+
+Runs in its own CI job (tier-1's wall-clock window never reaches
+late-alphabet files — the test_tools.py precedent).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.batching import BatchingEngine
+from shellac_tpu.inference.openai_api import stream_error_payload
+from shellac_tpu.inference.server import InferenceServer, make_http_server
+from shellac_tpu.inference.tier import TierRouter, make_tier_http_server
+from shellac_tpu.models import transformer
+from shellac_tpu.obs import (
+    FlightRecorder,
+    Registry,
+    ServeMetrics,
+    adopt_trace,
+    format_trace_header,
+    new_trace_id,
+    parse_trace_header,
+)
+from shellac_tpu.training.tokenizer import ByteTokenizer
+
+
+def _tiny():
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = _tiny()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _mk_server(cfg, params, **kw):
+    kw.setdefault("registry", Registry())
+    srv = InferenceServer(cfg, params, tokenizer=ByteTokenizer(),
+                          n_slots=2, max_len=64, temperature=0.0, **kw)
+    httpd = make_http_server(srv)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return srv, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post(base, path, payload, headers=None, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp, json.loads(resp.read())
+
+
+def _get(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# ---- units: ids, headers, recorder, exemplars -----------------------
+
+
+class TestTraceIds:
+    def test_mint_shape_and_uniqueness(self):
+        ids = {new_trace_id() for _ in range(64)}
+        assert len(ids) == 64
+        for tid in ids:
+            ver, trace, span, flags = tid.split("-")
+            assert (ver, flags) == ("00", "01")
+            assert len(trace) == 32 and len(span) == 16
+            int(trace, 16), int(span, 16)  # hex or ValueError
+
+    def test_header_roundtrip_with_attempt(self):
+        tid = new_trace_id()
+        assert parse_trace_header(format_trace_header(tid, 3)) == (tid, 3)
+        assert parse_trace_header(tid) == (tid, 0)
+
+    def test_malformed_header_mints_instead_of_rejecting(self):
+        for bad in (None, "", "not-a-trace", "00-zzzz-yy-01",
+                    "abc;attempt=2"):
+            tid, _ = adopt_trace(bad)
+            assert parse_trace_header(tid)[0] == tid
+        # A good id with a garbage attempt suffix keeps the id.
+        good = new_trace_id()
+        assert adopt_trace(good + ";attempt=x")[0] == good
+
+
+class TestFlightRecorder:
+    def test_ring_bounds_and_dropped_counter(self):
+        reg = Registry()
+        rec = FlightRecorder(capacity=4, registry=reg)
+        for i in range(10):
+            rec.record(f"t{i}", "admit", rid=i)
+        st = rec.stats()
+        assert st["events"] == 4 and st["dropped"] == 6
+        assert reg.value("shellac_flight_recorder_dropped_total") == 6
+        # The oldest events were forgotten, the newest retained.
+        assert rec.events_for("t0") == []
+        assert rec.events_for("t9")[0]["rid"] == 9
+
+    def test_timeline_filter_and_tail_order(self):
+        rec = FlightRecorder(capacity=64)
+        rec.record("a", "admit")
+        rec.record("b", "admit")
+        rec.record("a", "finish")
+        rec.record(None, "eject", replica="r1")  # system-scoped
+        evs = rec.events_for("a")
+        assert [e["event"] for e in evs] == ["admit", "finish"]
+        assert evs[0]["seq"] < evs[1]["seq"]
+        assert [e["event"] for e in rec.tail(2)] == ["finish", "eject"]
+        assert rec.events_for(None) == []
+
+    def test_disabled_recorder_is_noop(self):
+        rec = FlightRecorder(enabled=False)
+        rec.record("a", "admit")
+        assert rec.stats()["events"] == 0
+
+    def test_uppercase_lookup_finds_lowercased_timeline(self):
+        # Header adoption lowercases ids; a client querying with the
+        # uppercase hex it originally sent must still find them.
+        rec = FlightRecorder()
+        tid = new_trace_id()
+        rec.record(tid, "admit")
+        assert rec.events_for(tid.upper())[0]["event"] == "admit"
+
+
+class TestExemplars:
+    def test_histogram_retains_last_trace_per_bucket(self):
+        reg = Registry()
+        h = reg.histogram("x_seconds", buckets=[0.1, 1.0])
+        h.observe(0.05, exemplar="t-fast")
+        h.observe(0.5, exemplar="t-mid")
+        h.observe(50.0, exemplar="t-slow")  # overflow bucket
+        h.observe(0.06, exemplar="t-fast2")  # replaces t-fast
+        ex = h.bucket_exemplars()
+        assert ex == {"0.1": "t-fast2", "1": "t-mid", "+Inf": "t-slow"}
+
+    def test_no_exemplars_is_empty_and_plain_observe_unaffected(self):
+        reg = Registry()
+        h = reg.histogram("y_seconds", buckets=[1.0])
+        h.observe(0.5)
+        assert h.bucket_exemplars() == {}
+        assert h.count == 1
+
+
+class TestStreamErrorPayload:
+    def test_carries_trace_id(self):
+        out = stream_error_payload(TimeoutError("slow"), trace_id="00-x")
+        assert out["error"]["trace_id"] == "00-x"
+        assert out["error"]["type"] == "timeout_error"
+        # Without an id the record keeps its old shape.
+        assert "trace_id" not in stream_error_payload(ValueError("b"))["error"]
+
+
+# ---- flight recorder vs the overlapped decode pipeline --------------
+
+
+class TestRecorderUnderOverlap:
+    def _traced_engine(self, tiny_model):
+        cfg, params = tiny_model
+        reg = Registry()
+        rec = FlightRecorder(registry=reg)
+        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0, overlap_decode=True,
+                             registry=reg)
+        sm = ServeMetrics(reg)
+        return eng, sm, rec
+
+    def test_dispatch_settle_ordering(self, tiny_model):
+        eng, sm, rec = self._traced_engine(tiny_model)
+        tid = new_trace_id()
+        eng.submit(0, [1, 2, 3], 6,
+                   trace=sm.trace(trace_id=tid, recorder=rec))
+        for _ in range(64):
+            if eng.step():
+                break
+        while eng._windows:  # drain the in-flight window
+            eng.step()
+        evs = rec.events_for(tid)
+        kinds = [e["event"] for e in evs]
+        assert kinds[:4] == ["queue", "prefill", "first-token",
+                             "window-dispatch"]
+        dispatches = [e for e in evs if e["event"] == "window-dispatch"]
+        settles = [e for e in evs if e["event"] == "window-settle"]
+        assert dispatches and settles
+        # Two-deep pipeline: settles never outnumber dispatches, and
+        # each settle follows its window's dispatch (seq order).
+        assert len(settles) <= len(dispatches) <= len(settles) + 2
+        for d, s in zip(dispatches, settles):
+            assert d["seq"] < s["seq"]
+            assert d["slot"] == s["slot"]
+        assert any(d["depth"] >= 1 for d in dispatches)
+
+    def test_no_stale_slot_events_after_cancel(self, tiny_model):
+        eng, sm, rec = self._traced_engine(tiny_model)
+        tid_a, tid_b = new_trace_id(), new_trace_id()
+        eng.submit("a", [1, 2], 32,
+                   trace=sm.trace(trace_id=tid_a, recorder=rec))
+        eng.submit("b", [3, 4], 32,
+                   trace=sm.trace(trace_id=tid_b, recorder=rec))
+        eng.step()  # prefill both + dispatch a window (in flight)
+        assert eng._windows, "overlap pipeline should be in flight"
+        eng.cancel("a")
+        cancel_seq = rec.events_for(tid_a)[-1]["seq"]
+        assert rec.events_for(tid_a)[-1]["event"] == "cancelled"
+        finished = []
+        for _ in range(64):
+            if not eng.pending:
+                break
+            finished.extend(rid for rid, _ in eng.step())
+        evs_a = rec.events_for(tid_a)
+        # The in-flight window's results for the cancelled slot were
+        # discarded: the timeline ends at the cancellation — no settle
+        # (or any other) event after it.
+        assert evs_a[-1]["event"] == "cancelled"
+        assert all(e["seq"] <= cancel_seq for e in evs_a)
+        # The surviving request ran to completion with a clean tail
+        # (finish is the SERVER's span settlement; at engine level the
+        # timeline ends with its last settled window).
+        assert finished == ["b"]
+        kinds_b = [e["event"] for e in rec.events_for(tid_b)]
+        assert "window-settle" in kinds_b
+        assert "cancelled" not in kinds_b
+
+
+# ---- live single-server surfaces ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_srv(tiny_model, tmp_path_factory):
+    cfg, params = tiny_model
+    prof = tmp_path_factory.mktemp("prof")
+    srv, httpd, base = _mk_server(cfg, params, profile_dir=str(prof))
+    yield srv, base
+    httpd.shutdown()
+    srv.close()
+
+
+class TestServerTracing:
+    def test_adopts_header_and_echoes_request_id(self, traced_srv):
+        srv, base = traced_srv
+        tid = new_trace_id()
+        resp, out = _post(base, "/generate",
+                          {"tokens": [3, 7], "max_new": 4},
+                          headers={"x-shellac-trace":
+                                   format_trace_header(tid, 2)})
+        assert resp.headers.get("x-request-id") == tid
+        assert out["trace_id"] == tid
+        admit = [e for e in srv.debug_request(tid)["events"]
+                 if e["event"] == "admit"][0]
+        assert admit["attempt"] == 2
+
+    def test_exemplar_resolves_to_timeline(self, traced_srv):
+        srv, base = traced_srv
+        resp, out = _post(base, "/generate",
+                          {"tokens": [5, 9], "max_new": 4})
+        tid = resp.headers.get("x-request-id")
+        dbg = _get(base, "/debug/requests")
+        # The id is retained as an exemplar on the latency histograms…
+        assert tid in dbg["exemplars"]["ttft"].values()
+        assert tid in dbg["exemplars"]["e2e"].values()
+        # …and resolves to the full flight-recorder timeline.
+        tl = _get(base, f"/debug/request/{tid}")
+        kinds = [e["event"] for e in tl["events"]]
+        for want in ("admit", "queue", "prefill", "first-token",
+                     "window-dispatch", "window-settle", "finish"):
+            assert want in kinds, kinds
+        assert dbg["recorder"]["events"] > 0
+        assert "overlap_window_depth" in dbg
+        assert dbg["slots"]["backend"] == "dense"
+        assert len(dbg["slots"]["slot_tokens"]) == 2
+
+    def test_unknown_trace_is_404(self, traced_srv):
+        _, base = traced_srv
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base, f"/debug/request/{new_trace_id()}")
+        assert ei.value.code == 404
+
+    def test_stream_records_carry_trace_id(self, traced_srv):
+        _, base = traced_srv
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"tokens": [1, 2], "max_new": 4,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            tid = r.headers.get("x-request-id")
+            lines = [json.loads(ln) for ln in r if ln.strip()]
+        assert tid and all(ln["trace_id"] == tid for ln in lines)
+        assert lines[-1]["done"] is True
+
+    def test_sse_chunks_carry_trace_id(self, traced_srv):
+        _, base = traced_srv
+        req = urllib.request.Request(
+            base + "/v1/chat/completions",
+            data=json.dumps({
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            tid = r.headers.get("x-request-id")
+            chunks = [json.loads(ln[len(b"data: "):])
+                      for ln in r
+                      if ln.startswith(b"data: ")
+                      and b"[DONE]" not in ln]
+        assert tid and chunks
+        assert all(c["trace_id"] == tid for c in chunks)
+
+    def test_redaction_by_default(self, traced_srv):
+        srv, base = traced_srv
+        resp, _ = _post(base, "/generate",
+                        {"text": "secret prompt", "max_new": 3})
+        tid = resp.headers.get("x-request-id")
+        tl = _get(base, f"/debug/request/{tid}")
+        blob = json.dumps(tl) + json.dumps(_get(base, "/debug/requests"))
+        assert "secret prompt" not in blob
+        assert not any("prompt_text" in e for e in tl["events"])
+
+    def test_profile_smoke_and_single_capture_guard(self, traced_srv):
+        srv, base = traced_srv
+
+        def post_profile(seconds):
+            req = urllib.request.Request(
+                base + f"/debug/profile?seconds={seconds}", data=b"")
+            return urllib.request.urlopen(req, timeout=60)
+
+        # Concurrent second capture is refused with 409 while the
+        # first window is open.
+        results = {}
+
+        def first():
+            with post_profile(1.0) as r:
+                results["first"] = json.loads(r.read())
+
+        t = threading.Thread(target=first)
+        t.start()
+        # Deterministic overlap: wait until the first capture actually
+        # holds the profiler lock (a plain sleep races under CPU
+        # contention in CI).
+        deadline = time.monotonic() + 15
+        while not srv._profile_lock.locked():
+            assert time.monotonic() < deadline, "capture never started"
+            time.sleep(0.01)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post_profile(0.2)
+        assert ei.value.code == 409
+        t.join(timeout=30)
+        # The capture produced a non-empty trace directory.
+        out = results["first"]
+        assert out["files"] > 0
+        import os
+        assert os.path.isdir(out["trace_dir"])
+        # The lock released: a fresh capture succeeds.
+        with post_profile(0.1) as r:
+            assert json.loads(r.read())["files"] > 0
+
+
+class TestRedactionOptIn:
+    def test_include_text_flag_exposes_prompt(self, tiny_model):
+        cfg, params = tiny_model
+        srv, httpd, base = _mk_server(cfg, params,
+                                      debug_include_text=True)
+        try:
+            resp, _ = _post(base, "/generate",
+                            {"text": "visible prompt", "max_new": 3})
+            tid = resp.headers.get("x-request-id")
+            tl = _get(base, f"/debug/request/{tid}")
+            admit = [e for e in tl["events"]
+                     if e["event"] == "admit"][0]
+            assert "visible prompt" in admit["prompt_text"]
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+
+class TestNoDebugFlag:
+    def test_debug_endpoints_404_and_recording_stops(self, tiny_model):
+        cfg, params = tiny_model
+        srv, httpd, base = _mk_server(cfg, params, debug=False)
+        try:
+            for path in ("/debug/requests",
+                         f"/debug/request/{new_trace_id()}"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _get(base, path)
+                assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base, "/debug/profile?seconds=0.1", {})
+            assert ei.value.code == 404
+            assert srv.recorder.stats()["recorded"] == 0
+            # Non-debug surfaces still answer.
+            assert _get(base, "/health")["ok"] is True
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+
+# ---- live two-replica propagation (the acceptance path) -------------
+
+
+@pytest.fixture(scope="module")
+def live_tier(tiny_model):
+    cfg, params = tiny_model
+    replicas = [_mk_server(cfg, params) for _ in range(2)]
+    # A huge health interval: membership changes only via the explicit
+    # poll_once() calls below, so the drained replica stays routable
+    # and the request must discover the refusal — and retry — itself.
+    router = TierRouter(
+        [base for _, _, base in replicas],
+        registry=Registry(), health_interval=60.0,
+        backoff_base=0.01, backoff_cap=0.05, default_timeout=60.0,
+    )
+    router.poll_once()
+    assert all(r.state == "healthy" for r in router.replicas)
+    httpd_t = make_tier_http_server(router)
+    threading.Thread(target=httpd_t.serve_forever, daemon=True).start()
+    tbase = f"http://127.0.0.1:{httpd_t.server_address[1]}"
+    yield router, tbase, replicas
+    httpd_t.shutdown()
+    router.close()
+    for srv, httpd, _ in replicas:
+        httpd.shutdown()
+        srv.close()
+
+
+class TestLiveTierRetryPropagation:
+    def test_one_trace_id_in_all_four_places(self, live_tier):
+        router, tbase, replicas = live_tier
+        payload = {"tokens": [5, 6, 7], "max_new": 4, "session": "s-1"}
+        # Find the session's affinity target, then drain it so the
+        # next attempt is refused with a 503 and retried elsewhere.
+        status, _, _ = router.forward_json("/generate", dict(payload))
+        assert status == 200
+        target = next(s for s, _, _ in
+                      [r for r in replicas]
+                      if s.engine.stats["requests_completed"])
+        other = next(s for s, _, _ in replicas if s is not target)
+        target.drain()
+        try:
+            tid = new_trace_id()
+            resp, out = _post(tbase, "/generate", payload,
+                              headers={"x-shellac-trace": tid})
+            # (1) the x-request-id response header
+            assert resp.headers.get("x-request-id") == tid
+            assert out["trace_id"] == tid
+            # (2) the tier's attempt log: two attempts, one retry,
+            # a settled finish — all under the SAME id.
+            kinds = [e["event"] for e in router.recorder.events_for(tid)]
+            assert kinds.count("tier-attempt") >= 2, kinds
+            assert "retry" in kinds and "tier-finish" in kinds, kinds
+            # (3) the serving replica's flight-recorder timeline,
+            # carrying the tier's attempt number on its admit event.
+            tl = other.debug_request(tid)
+            ekinds = [e["event"] for e in tl["events"]]
+            assert "admit" in ekinds and "finish" in ekinds, ekinds
+            admit = [e for e in tl["events"] if e["event"] == "admit"][0]
+            assert admit["attempt"] == 1
+            # The drained replica never admitted it.
+            assert target.debug_request(tid) is None
+            # (4) the replica's RequestTrace span: the id survives as
+            # the exemplar on its latency histograms.
+            reg = other._registry
+            assert tid in (reg.get("shellac_ttft_seconds")
+                           .bucket_exemplars().values())
+            # …and the tier's own e2e histogram exemplar agrees.
+            assert tid in (router._registry
+                           .get("shellac_tier_e2e_seconds")
+                           .bucket_exemplars().values())
+            # The tier's debug surface serves the same timeline.
+            ttl = _get(tbase, f"/debug/request/{tid}")
+            assert [e["event"] for e in ttl["events"]] == kinds
+        finally:
+            target.resume_admission()
+            router.poll_once()
+
+    def test_tier_debug_requests_surface(self, live_tier):
+        router, tbase, _ = live_tier
+        dbg = _get(tbase, "/debug/requests")
+        assert dbg["recorder"]["events"] > 0
+        assert len(dbg["replicas"]) == 2
+        assert any(e["event"] == "tier-finish"
+                   for e in dbg["recent_events"])
+
+    def test_tier_no_debug_404(self, live_tier):
+        _, _, replicas = live_tier
+        router = TierRouter([replicas[0][2]], registry=Registry(),
+                            health_interval=60.0, debug=False)
+        try:
+            assert router.debug_requests is not None  # method exists
+            httpd = make_tier_http_server(router)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(base, "/debug/requests")
+            assert ei.value.code == 404
+            httpd.shutdown()
+        finally:
+            router.close()
