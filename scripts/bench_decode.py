@@ -388,6 +388,33 @@ def beam_bench(cfg, params, *, ctx, max_len, rng, num_beams=4,
     return out
 
 
+def step_phase_digest(registry):
+    """Condensed step-time phase attribution from a run's registry:
+    per phase (obs.STEP_PHASES) the total seconds, observation count,
+    p50, and share of the attributed step time — the committed
+    measurement of where the engine tick goes (docs/observability.md
+    §Step-time attribution). Embedded in gate summaries and bench
+    rows so BENCH_* files carry the attribution alongside tokens/s."""
+    from shellac_tpu.obs import STEP_PHASES
+
+    out = {}
+    total = 0.0
+    for phase in STEP_PHASES:
+        h = registry.get("shellac_step_phase_seconds", phase=phase)
+        if h is None or h.count == 0:
+            continue
+        total += h.sum
+        out[phase] = {
+            "sum_s": round(h.sum, 4),
+            "count": h.count,
+            "p50_ms": round((h.percentile(0.5) or 0.0) * 1e3, 3),
+        }
+    if total > 0:
+        for row in out.values():
+            row["share"] = round(row["sum_s"] / total, 3)
+    return out
+
+
 def gate(cfg, params, args, backend):
     """CI perf regression gate: the overlapped-decode churn benchmark
     under the simulated dispatch-latency harness, judged against a
@@ -445,14 +472,18 @@ def gate(cfg, params, args, backend):
         ticks = int(args.decode_ticks)
         tuned = None
 
+    from shellac_tpu.obs import Registry
+
     rates = {}
+    phase_digests = {}
     for overlap in (True, False):
         rng = np.random.default_rng(0)
+        reg = Registry()
         tok_s, total = churn(
             cfg, params, paged=False, impl="ref", n_slots=args.slots,
             ctx=args.ctx, max_len=max_len, rng=rng, decode_ticks=ticks,
             overlap=overlap, device_latency=device_s,
-            host_latency=host_s, n_req=2 * args.slots,
+            host_latency=host_s, n_req=2 * args.slots, registry=reg,
             # Requests live ~6 windows: the steady-serving regime
             # overlap targets. Sub-2-window budgets make slot turnover
             # (admissions join at window boundaries; a finished slot's
@@ -462,6 +493,9 @@ def gate(cfg, params, args, backend):
             gen_budget=max(12 * ticks, 32),
         )
         rates[overlap] = tok_s
+        phase_digests["overlap" if overlap else "serial"] = (
+            step_phase_digest(reg)
+        )
     speedup = rates[True] / max(rates[False], 1e-9)
 
     # Spec-on-paged churn (PR 9's composition): self-draft over the
@@ -473,12 +507,14 @@ def gate(cfg, params, args, backend):
     # (a crash, a lost multi-token round, or a pathological
     # round-count regression all move it far past tolerance).
     rng = np.random.default_rng(1)
+    spec_reg = Registry()
     spec_tok_s, _ = churn(
         cfg, params, paged=True, impl="ref", n_slots=args.slots,
         ctx=args.ctx, max_len=max_len, rng=rng, decode_ticks=1,
         host_latency=host_s, n_req=2 * args.slots, gen_budget=32,
-        spec_draft=(cfg, params), gamma=2,
+        spec_draft=(cfg, params), gamma=2, registry=spec_reg,
     )
+    phase_digests["spec_paged"] = step_phase_digest(spec_reg)
 
     summary = {
         "metric": f"decode_gate_{args.model}_{backend}",
@@ -488,6 +524,7 @@ def gate(cfg, params, args, backend):
         "spec_paged_tokens_s": round(spec_tok_s, 1),
         "decode_ticks": ticks,
         "autotune": tuned,
+        "step_phases": phase_digests,
         "params": {
             "slots": args.slots, "ctx": args.ctx,
             "device_latency_ms": args.device_latency_ms,

@@ -7,6 +7,9 @@ Commands:
   generate  autoregressive sampling from a checkpoint (or random init),
             optionally speculative with a smaller draft preset
   info      show presets, a config's derived dims, and parameter counts
+  top       live fleet dashboard over a serving tier URL (per-replica
+            load, SLO burn rates, step-phase attribution; --once for
+            scripts, --trace <id> for one request's timeline)
   lint      JAX/TPU-aware static analysis of the source tree (the SH
             rule set; see docs/static_analysis.md)
 
@@ -1062,6 +1065,38 @@ def cmd_serve(args):
     return 0
 
 
+def _load_slos(args):
+    """Collect SLO specs from repeated --slo flags and/or --slo-file
+    (a JSON list of spec strings, or {"slos": [...]}), parsed eagerly
+    so a typo dies at startup, not at the first alert."""
+    from shellac_tpu.obs import parse_slo_specs
+
+    specs = list(args.slo or [])
+    if args.slo_file:
+        try:
+            with open(args.slo_file) as f:
+                data = json.load(f)
+        except OSError as e:
+            raise SystemExit(f"--slo-file {args.slo_file}: {e}")
+        except ValueError as e:
+            raise SystemExit(
+                f"--slo-file {args.slo_file}: not valid JSON ({e}); "
+                'expected a list of spec strings or {"slos": [...]}'
+            )
+        if isinstance(data, dict):
+            data = data.get("slos", [])
+        if not isinstance(data, list):
+            raise SystemExit(
+                f"--slo-file {args.slo_file}: expected a JSON list of "
+                'spec strings or {"slos": [...]}'
+            )
+        specs.extend(str(s) for s in data)
+    try:
+        return parse_slo_specs(specs)
+    except ValueError as e:
+        raise SystemExit(f"--slo: {e}")
+
+
 def cmd_serve_tier(args):
     from shellac_tpu.inference.tier import TierRouter, serve_tier
 
@@ -1082,9 +1117,21 @@ def cmd_serve_tier(args):
         default_timeout=args.default_timeout,
         affinity_tolerance=args.affinity_tolerance,
         debug=args.debug,
+        federate=args.federate,
+        stale_after=args.stale_after,
+        slos=_load_slos(args),
     )
     serve_tier(router, host=args.host, port=args.port)
     return 0
+
+
+def cmd_top(args):
+    # Deliberately jax-free: `top` is an operator tool that must start
+    # instantly on any box with Python, not just an accelerator host.
+    from shellac_tpu.obs.top import run_top
+
+    return run_top(args.tier, once=args.once, interval=args.interval,
+                   trace=args.trace, timeout=args.timeout)
 
 
 def cmd_convert(args):
@@ -1579,7 +1626,47 @@ def build_parser() -> argparse.ArgumentParser:
                          "/debug/requests (attempt log tail, e2e "
                          "exemplars) and /debug/request/<trace-id>; "
                          "--no-debug answers 404 and stops recording")
+    st.add_argument("--federate", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="re-expose every replica /metrics series on "
+                         "the tier's /metrics with a replica label "
+                         "(last-known-good through outages, staleness-"
+                         "stamped) plus shellac_fleet_* aggregates")
+    st.add_argument("--stale-after", type=float, default=5.0,
+                    dest="stale_after",
+                    help="seconds without a successful replica scrape "
+                         "before its federated series are flagged "
+                         "stale (they keep serving last-known-good)")
+    st.add_argument("--slo", action="append", metavar="SPEC",
+                    help="declarative SLO evaluated by multi-window "
+                         "burn rate, e.g. 'ttft_p99<500ms@99.9' or "
+                         "'availability@99.9' (repeatable; "
+                         "docs/observability.md#fleet)")
+    st.add_argument("--slo-file", default=None, dest="slo_file",
+                    help="JSON file with SLO specs: a list of spec "
+                         'strings, or {"slos": [...]}')
     st.set_defaults(fn=cmd_serve_tier)
+
+    tp = sub.add_parser(
+        "top",
+        help="live fleet dashboard over a tier URL: per-replica "
+             "routability/pending/KV/p99, SLO burn rates, step-phase "
+             "attribution, recent recorder events (--once for a "
+             "single snapshot; --trace <id> for one request's "
+             "timeline)",
+    )
+    tp.add_argument("--tier", required=True,
+                    help="tier base URL, e.g. http://127.0.0.1:8100")
+    tp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (CI/scripts)")
+    tp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval in seconds")
+    tp.add_argument("--timeout", type=float, default=5.0,
+                    help="per-endpoint fetch timeout")
+    tp.add_argument("--trace", default=None, metavar="TRACE_ID",
+                    help="print this trace id's recorded timeline "
+                         "instead of the dashboard")
+    tp.set_defaults(fn=cmd_top)
 
     k = sub.add_parser("tokenize", help="encode text files into a token shard")
     k.add_argument("--input", nargs="+", required=True, help="text files")

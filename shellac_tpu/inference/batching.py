@@ -340,6 +340,10 @@ class BatchingEngine:
         # Wall-clock the current step() spent blocked in decode-window
         # syncs (read back out as the host-overhead histogram).
         self._sync_block_s = 0.0
+        # Per-step phase attribution accumulators (obs.STEP_PHASES):
+        # reset by step(), written by the fill/prefill/settle helpers,
+        # observed into shellac_step_phase_seconds at step end.
+        self._phase_s: Dict[str, float] = {}
         # Cap prefills per engine step: a burst of queued prompts would
         # otherwise run n_slots sequential prefill programs before the
         # next decode tick, stalling every active request's output for
@@ -1340,8 +1344,15 @@ class BatchingEngine:
                 self._slots[i] = req
                 self._prefilling[i] = off
                 continue
+            t_pf = time.perf_counter()
             first, lp, tl = self._run_prefill(i, req)
             self._finish_prefill(i, req, first, lp, tl)
+            # Phase attribution: the prefill program + its host sync,
+            # split out of the surrounding admission bookkeeping.
+            self._phase_s["prefill_dispatch"] = (
+                self._phase_s.get("prefill_dispatch", 0.0)
+                + time.perf_counter() - t_pf
+            )
 
     def _finish_prefill(self, slot: int, req: _Request, first,
                         lp=None, tl=None) -> None:
@@ -1399,6 +1410,7 @@ class BatchingEngine:
         first, drained depth-first — chunk N+1 reuses chunk N's cache
         row while it is hot."""
         used = 0
+        t_pf = time.perf_counter()
         while self._prefilling and (budget is None or used < budget):
             slot = min(self._prefilling)
             used += 1
@@ -1447,6 +1459,14 @@ class BatchingEngine:
                 )
             else:
                 self._prefilling[slot] = off + s
+        if used:
+            # The whole chunk loop is prefill work (dispatches + the
+            # final-chunk stitching syncs); its host-side glue is noise
+            # next to the programs.
+            self._phase_s["prefill_dispatch"] = (
+                self._phase_s.get("prefill_dispatch", 0.0)
+                + time.perf_counter() - t_pf
+            )
         return used
 
     def _chunk_prefill(self, pad, fresh, tokens, chunk_len, offset, slot,
@@ -1554,6 +1574,7 @@ class BatchingEngine:
         self.stats["engine_steps"] += 1
         t_step0 = time.perf_counter()
         self._sync_block_s = 0.0
+        self._phase_s = {}
         synced = False
         if self.overlap_decode and self._windows:
             # Keep the device busy across the sync: dispatch the next
@@ -1565,7 +1586,14 @@ class BatchingEngine:
             if any(rows):
                 self.obs.occupancy.observe(sum(rows) / self.n_slots)
                 self._dispatch_window(rows)
+            t_settle0 = time.perf_counter()
             synced = self._settle_window(finished) or synced
+            # Split the settle section into its blocked-on-device part
+            # (decode_sync) and the host-side application (settle).
+            self._phase_s["decode_sync"] = self._sync_block_s
+            self._phase_s["settle"] = max(
+                0.0, time.perf_counter() - t_settle0 - self._sync_block_s
+            )
         t_fill0 = time.perf_counter()
         prefills0 = self.stats["prefills"] + self.stats["prefill_chunks"]
         # Fill/check until stable: a request satisfied by its prefill
@@ -1608,6 +1636,13 @@ class BatchingEngine:
             # step ran, including their host syncs) — observed only on
             # steps that actually prefilled.
             self.obs.prefill_seconds.observe(time.perf_counter() - t_fill0)
+        # Admission phase: the fill section minus the prefill programs
+        # it ran (queue pops, slot prep, finish checks in the loop).
+        self._phase_s["admission"] = max(
+            0.0,
+            time.perf_counter() - t_fill0
+            - self._phase_s.get("prefill_dispatch", 0.0),
+        )
         active_rows = self._active_rows()
         if any(active_rows) and not self._windows:
             self.obs.occupancy.observe(sum(active_rows) / self.n_slots)
@@ -1620,11 +1655,21 @@ class BatchingEngine:
                 # Strict ordering: dispatch and sync within the step.
                 pairs = [(i, self._slots[i])
                          for i in range(self.n_slots) if active_rows[i]]
+                sync0 = self._sync_block_s
                 per_slot, per_lps, per_tl = (
                     self._decode_tokens(active_rows)
                 )
+                self._phase_s["decode_sync"] = (
+                    self._phase_s.get("decode_sync", 0.0)
+                    + self._sync_block_s - sync0
+                )
+                t_settle0 = time.perf_counter()
                 self._apply_pairs(pairs, per_slot, per_lps, per_tl)
                 self._finish_check(finished)
+                self._phase_s["settle"] = (
+                    self._phase_s.get("settle", 0.0)
+                    + time.perf_counter() - t_settle0
+                )
                 synced = True
         self._observe_cache_gauges()
         if synced:
@@ -1635,7 +1680,32 @@ class BatchingEngine:
                 0.0,
                 time.perf_counter() - t_step0 - self._sync_block_s,
             ))
+        self._observe_step_phases(t_step0, synced, finished, prefills0)
         return finished
+
+    def _observe_step_phases(self, t_step0: float, synced: bool,
+                             finished, prefills0: int) -> None:
+        """Deposit this step's phase attribution (obs.STEP_PHASES) —
+        only for steps that did work (synced a window, ran a prefill,
+        or finished a request): a server's idle polling steps would
+        otherwise drown the distributions in zeros. host_bookkeeping
+        is the remainder, so the five _sum series add up to the step
+        loop's non-idle wall time."""
+        did_work = synced or bool(finished) or (
+            self.stats["prefills"] + self.stats["prefill_chunks"]
+            > prefills0
+        )
+        if not did_work or not self.obs.registry.enabled:
+            return
+        attributed = 0.0
+        for phase in ("admission", "prefill_dispatch", "decode_sync",
+                      "settle"):
+            v = self._phase_s.get(phase, 0.0)
+            attributed += v
+            self.obs.step_phase.labels(phase=phase).observe(v)
+        self.obs.step_phase.labels(phase="host_bookkeeping").observe(
+            max(0.0, time.perf_counter() - t_step0 - attributed)
+        )
 
     # ---- decode-window dispatch / settle ----------------------------
 

@@ -1804,6 +1804,11 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             self._send(e.http_status, err, headers=headers)
 
         def do_GET(self):
+            # Error responses carry the trace id too (adopted from the
+            # header or minted): a rejected request is exactly the one
+            # its sender wants to look up in the recorder.
+            rid_hdr = {REQUEST_ID_HEADER:
+                       adopt_trace(self.headers.get(TRACE_HEADER))[0]}
             if self.path == "/v1/models":
                 self._send(200, {
                     "object": "list",
@@ -1818,7 +1823,8 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 # the backend; the body says which (and why, when
                 # fatal).
                 h = server.health()
-                self._send(200 if h["ok"] else 503, h)
+                self._send(200 if h["ok"] else 503, h,
+                           headers=rid_hdr)
             elif self.path == "/stats":
                 eng = server.engine
                 self._send(200, {
@@ -1854,7 +1860,7 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 if not server.metrics_enabled:
                     self._send(404, {
                         "error": "metrics disabled (serve --no-metrics)",
-                    })
+                    }, headers=rid_hdr)
                     return
                 # Prometheus text exposition. Like /stats, this stays
                 # 200 through an outage so scrapers keep collecting.
@@ -1875,7 +1881,7 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     self._send(404, {
                         "error": "debug endpoints disabled "
                                  "(serve --no-debug)",
-                    })
+                    }, headers=rid_hdr)
                 elif self.path == "/debug/requests":
                     self._send(200, server.debug_requests())
                 elif self.path.startswith("/debug/request/"):
@@ -1887,34 +1893,38 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                                      f"{tid!r} (finished long ago, "
                                      "evicted from the ring, or never "
                                      "seen)",
-                        })
+                        }, headers=rid_hdr)
                     else:
                         self._send(200, out)
                 else:
-                    self._send(404, {"error": "not found"})
+                    self._send(404, {"error": "not found"},
+                               headers=rid_hdr)
             else:
-                self._send(404, {"error": "not found"})
+                self._send(404, {"error": "not found"},
+                           headers=rid_hdr)
 
-        def _handle_profile(self):
+        def _handle_profile(self, rid_hdr: dict):
             """POST /debug/profile?seconds=N — on-demand jax.profiler
             capture on the live engine."""
             if not server.debug_enabled:
                 self._send(404, {"error": "debug endpoints disabled "
-                                          "(serve --no-debug)"})
+                                          "(serve --no-debug)"},
+                           headers=rid_hdr)
                 return
             qs = urllib.parse.urlsplit(self.path).query
             params = urllib.parse.parse_qs(qs)
             try:
                 seconds = float(params.get("seconds", ["2"])[0])
-                self._send(200, server.profile(seconds))
+                self._send(200, server.profile(seconds),
+                           headers=rid_hdr)
             except ProfileInProgress as e:
-                self._send(409, {"error": str(e)})
+                self._send(409, {"error": str(e)}, headers=rid_hdr)
             except ValueError as e:
-                self._send(400, {"error": str(e)})
+                self._send(400, {"error": str(e)}, headers=rid_hdr)
             except RuntimeError as e:
                 # A profiler backend fault (another process-global
                 # trace active, unwritable dir) is a server error.
-                self._send(500, {"error": str(e)})
+                self._send(500, {"error": str(e)}, headers=rid_hdr)
 
         def _stream(self, payload: dict, tctx: Tuple[str, int]):
             # Newline-delimited JSON, no Content-Length: the connection
@@ -2010,8 +2020,9 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             # x-shellac-trace; direct callers get a freshly minted id.
             # Every response echoes it as x-request-id.
             tctx = adopt_trace(self.headers.get(TRACE_HEADER))
+            rid_hdr = {REQUEST_ID_HEADER: tctx[0]}
             if self.path.startswith("/debug/profile"):
-                self._handle_profile()
+                self._handle_profile(rid_hdr)
                 return
             if self.path == "/drain":
                 # Admin surface: begin (or with {"resume": true},
@@ -2024,19 +2035,20 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 except ValueError:
                     payload = None
                 if not isinstance(payload, dict):
-                    self._send(400, {"error": "bad drain payload"})
+                    self._send(400, {"error": "bad drain payload"},
+                               headers=rid_hdr)
                     return
                 self._send(200, server.resume_admission()
-                           if payload.get("resume") else server.drain())
+                           if payload.get("resume") else server.drain(),
+                           headers=rid_hdr)
                 return
             openai_routes = {
                 "/v1/completions": False,
                 "/v1/chat/completions": True,
             }
             if self.path not in ("/generate", *openai_routes):
-                self._send(404, {"error": "not found"})
+                self._send(404, {"error": "not found"}, headers=rid_hdr)
                 return
-            rid_hdr = {REQUEST_ID_HEADER: tctx[0]}
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(n) or b"{}")

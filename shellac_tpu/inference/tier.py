@@ -52,7 +52,12 @@ HTTP surface (make_tier_http_server):
   GET  /stats — per-replica state, load scores, breaker states.
   GET  /metrics — Prometheus exposition of the shellac_tier_* series
        (docs/observability.md; counters: routed/retried/ejected/
-       readmitted/drained/respawned per replica).
+       readmitted/drained/respawned per replica), PLUS the federated
+       block: every replica series re-exposed with a `replica` label
+       (last-known-good through outages, staleness-stamped) and the
+       tier-computed shellac_fleet_* aggregates.
+  GET  /slo — burn rates, alert states, and objectives of the
+       configured SLOs (404 when serve-tier ran without --slo).
   POST /admin/drain {"replica": url-or-index[, "resume": true]} —
        forward a drain to one replica and stop routing to it now.
 """
@@ -75,13 +80,20 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from shellac_tpu.obs import (
     REQUEST_ID_HEADER,
     TRACE_HEADER,
+    FleetCollector,
     FlightRecorder,
     Registry,
+    SLOEngine,
+    SLOSpec,
     TierMetrics,
     adopt_trace,
+    cumulative_at,
     format_trace_header,
     get_registry,
+    histogram_quantile,
     new_trace_id,
+    parse_prometheus_text,
+    parse_slo_specs,
 )
 from shellac_tpu.utils.failure import CircuitBreaker
 
@@ -93,60 +105,25 @@ _PREFIX_GAUGE = "shellac_prefix_cache_blocks"
 
 
 def parse_prometheus(text: str) -> Dict[str, Any]:
-    """Minimal Prometheus text-format parser: unlabeled samples map to
-    floats; `_bucket` samples collect into {name: [(le, cum), ...]}
-    (labels other than `le` are ignored — replica expositions are
-    single-process). Enough to read the PR 3 gauges and estimate
-    histogram quantiles; not a general client."""
+    """Legacy flat view over the shared `obs.parse_prometheus_text`
+    parser: unlabeled samples map to floats; every histogram family
+    maps to `{name}!buckets` -> cumulative (le, count) pairs, summed
+    edge-wise across the family's label sets (the label-aware parser
+    is what fixed labeled histograms — the old splitter interleaved
+    e.g. the per-phase step-time series into one garbage bucket
+    list). Kept for the scorer and tests; new code should use
+    `parse_prometheus_text` directly."""
+    parsed = parse_prometheus_text(text)
     out: Dict[str, Any] = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line or line.startswith("#"):
-            continue
-        try:
-            name_part, value_part = line.rsplit(" ", 1)
-            value = float(value_part)
-        except ValueError:
-            continue
-        name, labels = name_part, ""
-        if "{" in name_part:
-            name, labels = name_part.split("{", 1)
-        if name.endswith("_bucket"):
-            le = None
-            for item in labels.rstrip("}").split(","):
-                if item.startswith("le="):
-                    le = float(item[4:-1].replace("+Inf", "inf"))
-            if le is not None:
-                out.setdefault(name[: -len("_bucket")] + "!buckets",
-                               []).append((le, value))
+    families = set()
+    for name, labels, value in parsed.samples:
+        if name.endswith("_bucket") and "le" in labels:
+            families.add(name[: -len("_bucket")])
         elif not labels:
             out[name] = value
+    for fam in families:
+        out[fam + "!buckets"] = parsed.buckets(fam)
     return out
-
-
-def histogram_quantile(buckets: List[Tuple[float, float]],
-                       q: float) -> Optional[float]:
-    """Estimated q-quantile from cumulative (le, count) pairs — the
-    scrape-side mirror of obs.Histogram.percentile, interpolating
-    inside the containing bucket. None when the histogram is empty."""
-    if not buckets:
-        return None
-    buckets = sorted(buckets)
-    total = buckets[-1][1]
-    if total <= 0:
-        return None
-    target = q * total
-    lo, prev_cum = 0.0, 0.0
-    for le, cum in buckets:
-        if cum >= target:
-            if le == float("inf"):
-                return lo  # overflow bucket: the last finite edge
-            width = le - lo
-            in_bucket = cum - prev_cum
-            frac = (target - prev_cum) / in_bucket if in_bucket else 1.0
-            return lo + width * frac
-        lo, prev_cum = le, cum
-    return lo
 
 
 class Replica:
@@ -230,6 +207,11 @@ class TierRouter:
         registry: Optional[Registry] = None,
         metrics: bool = True,
         debug: bool = True,
+        federate: bool = True,
+        stale_after: float = 5.0,
+        slos: Optional[List[Any]] = None,
+        slo_page_burn: float = 14.4,
+        slo_warn_burn: float = 1.0,
     ):
         if not replicas:
             raise ValueError("a tier needs at least one replica URL")
@@ -250,6 +232,25 @@ class TierRouter:
         self._debug = bool(debug)
         self._recorder = FlightRecorder(registry=registry,
                                         enabled=self._debug)
+        # Metrics federation: the health poller's /metrics pull feeds
+        # the collector, which re-exposes every replica series (with a
+        # `replica` label, last-known-good through outages) plus the
+        # shellac_fleet_* aggregates on THIS tier's /metrics.
+        self._fleet: Optional[FleetCollector] = (
+            FleetCollector(stale_after=stale_after) if federate else None
+        )
+        # SLO burn-rate engine over the federated counts + the tier's
+        # own outcome/latency series; evaluated on the poll cadence.
+        self._slo: Optional[SLOEngine] = None
+        if slos:
+            specs = [s if isinstance(s, SLOSpec) else SLOSpec.parse(s)
+                     for s in slos]
+            parse_slo_specs([s.name for s in specs])  # dup check
+            self._slo = SLOEngine(
+                specs, registry=registry, recorder=self._recorder,
+                exemplar_fn=self._slo_exemplar,
+                page_burn=slo_page_burn, warn_burn=slo_warn_burn,
+            )
         self._t0 = time.monotonic()
         self.health_interval = health_interval
         self.health_timeout = health_timeout
@@ -324,6 +325,8 @@ class TierRouter:
             self._m.replica_state.labels(replica=rep.url).set(
                 1 if rep.routable else 0
             )
+        if self._slo is not None:
+            self._slo.tick(self._slo_counts())
 
     def _poll_replica(self, rep: Replica) -> None:
         with rep.lock:
@@ -366,11 +369,21 @@ class TierRouter:
                 rep.pending = int(health.get("pending", 0))
             if was != "draining":
                 self._m.drains.labels(replica=rep.url).inc()
+            # A draining replica still serves /metrics, and the bleed-
+            # off is exactly when its numbers are interesting: keep the
+            # federation fresh.
+            self._scrape_load(rep)
             return
         self._note_failure(rep, probing=probing)
 
     def _note_failure(self, rep: Replica, probing: bool = False) -> None:
         del probing  # the breaker handles probe failures itself
+        if self._fleet is not None:
+            # The replica stopped answering: its federated series go
+            # last-known-good (served with a rising staleness stamp)
+            # rather than vanishing — a dying replica's final numbers
+            # are the ones an incident review needs.
+            self._fleet.mark_unreachable(rep.url)
         with rep.lock:
             tripped = rep.breaker.record_failure()
             newly = tripped and rep.state != "ejected"
@@ -385,25 +398,34 @@ class TierRouter:
 
     def _scrape_load(self, rep: Replica) -> None:
         """Refresh the load snapshot from the replica's /metrics (the
-        PR 3 gauges). A 404 (--no-metrics) or parse failure degrades to
-        the health poll's pending count — routing still works, just on
-        a coarser signal."""
+        PR 3 gauges) and feed the SAME scrape to the federation
+        collector — one pull, two consumers. A 404 (--no-metrics) or
+        parse failure degrades to the health poll's pending count —
+        routing still works, just on a coarser signal."""
         load: Dict[str, Any] = {}
         try:
             status, body = self._get(rep.url, "/metrics",
                                      self.health_timeout)
             if status == 200:
-                parsed = parse_prometheus(body.decode())
+                text = body.decode()
+                if self._fleet is not None:
+                    parsed = self._fleet.observe(rep.url, text)
+                else:
+                    parsed = parse_prometheus_text(text)
                 for k in _QUEUE_GAUGES + (_KV_GAUGE, _PREFIX_GAUGE):
-                    if k in parsed:
-                        load[k] = parsed[k]
+                    v = parsed.value(k)
+                    if v is not None:
+                        load[k] = v
                 ttft = histogram_quantile(
-                    parsed.get(_TTFT_HIST + "!buckets", []), 0.99
+                    parsed.buckets(_TTFT_HIST), 0.99
                 )
                 if ttft is not None:
                     load["ttft_p99"] = ttft
+            elif self._fleet is not None:
+                self._fleet.mark_unreachable(rep.url)
         except (OSError, ValueError, http.client.HTTPException):
-            pass
+            if self._fleet is not None:
+                self._fleet.mark_unreachable(rep.url)
         load["score"] = self._score(rep, load)
         with rep.lock:
             rep.load = load
@@ -441,6 +463,11 @@ class TierRouter:
                         new_url, CircuitBreaker(*self._breaker_cfg)
                     )
                     self._m.respawns.inc()
+                    if self._fleet is not None:
+                        # REPLACED, not merely down: the old replica's
+                        # last-known-good series stop being served
+                        # (the successor starts fresh ones).
+                        self._fleet.forget(rep.url)
 
     # ---- routing policy ---------------------------------------------
 
@@ -965,12 +992,135 @@ class TierRouter:
             "respawned": total("shellac_tier_respawns_total"),
         }
 
+    # ---- SLO engine wiring ------------------------------------------
+
+    def _slo_counts(self) -> Dict[str, Tuple[float, float]]:
+        """Cumulative (good, total) event counts per configured SLO —
+        the burn-rate engine's input, differenced per window there.
+
+        Latency SLIs read the FEDERATED fleet histograms (good =
+        estimated observations at-or-under the threshold), except
+        `e2e`, which reads the tier's own end-to-end histogram (it
+        includes retry legs — the user-experienced latency).
+        `availability` reads the tier's outcome counters (ok vs all
+        settlements)."""
+        counts: Dict[str, Tuple[float, float]] = {}
+        for spec in self._slo.specs:
+            if spec.sli == "availability":
+                ok = self._registry.value(
+                    "shellac_tier_requests_total", outcome="ok") or 0.0
+                total = self._registry.total(
+                    "shellac_tier_requests_total") or 0.0
+                counts[spec.name] = (float(ok), float(total))
+            elif spec.sli == "e2e":
+                pairs = self._m.e2e.cumulative_pairs()
+                total = pairs[-1][1] if pairs else 0.0
+                counts[spec.name] = (
+                    cumulative_at(pairs, spec.threshold_s), total
+                )
+            else:  # ttft / tpot / queue_wait: replica-side, federated
+                if self._fleet is None:
+                    counts[spec.name] = (0.0, 0.0)
+                    continue
+                fam = f"shellac_{spec.sli}_seconds"
+                buckets, _, count = self._fleet.merged_histogram(fam)
+                counts[spec.name] = (
+                    cumulative_at(buckets, spec.threshold_s),
+                    float(count),
+                )
+        return counts
+
+    def _slo_exemplar(self, spec: SLOSpec) -> Optional[str]:
+        """A violating request's trace id for an alert transition.
+
+        Replica-observed latency SLIs (ttft/tpot/queue_wait) ask the
+        replicas themselves: each replica's /debug/requests exposes
+        per-bucket trace-id exemplars for exactly these histograms,
+        so the id returned names a request whose OWN <sli> landed in
+        a bucket above the threshold. Transitions are rare, so the
+        few bounded GETs are cheap. Fallbacks, in order: the tier's
+        own e2e exemplars (best effort — the slowest recent request
+        end-to-end, the most likely violator a tier-side view alone
+        can name; e2e > T does NOT prove ttft > T), then the most
+        recent badly-settled recorder event (the availability path)."""
+        if spec.threshold_s is not None:
+            if spec.sli != "e2e":
+                tid = self._replica_exemplar(spec.sli, spec.threshold_s)
+                if tid is not None:
+                    return tid
+            best_le, best_tid = -1.0, None
+            for le, tid in self._m.e2e.bucket_exemplars().items():
+                v = float("inf") if le == "+Inf" else float(le)
+                if v > spec.threshold_s and v > best_le:
+                    best_le, best_tid = v, tid
+            if best_tid is not None:
+                return best_tid
+        for ev in reversed(self._recorder.tail(256)):
+            if ev.get("trace") and ev.get("event") in (
+                "tier-exhausted", "stream-severed", "retry"
+            ):
+                return ev["trace"]
+        return None
+
+    def _replica_exemplar(self, sli: str,
+                          threshold: float) -> Optional[str]:
+        """Highest-bucket exemplar above `threshold` for one replica
+        histogram family, scanned across routable replicas' /debug
+        exemplar maps. Failures skip the replica — an exemplar lookup
+        must never break alerting."""
+        best_le, best_tid = -1.0, None
+        for rep in self._replicas:
+            if not rep.routable:
+                continue
+            try:
+                status, body = self._get(rep.url, "/debug/requests",
+                                         self.health_timeout)
+                if status != 200:
+                    continue
+                exemplars = json.loads(body).get("exemplars", {})
+            except (OSError, ValueError,
+                    http.client.HTTPException):
+                continue
+            for le, tid in (exemplars.get(sli) or {}).items():
+                try:
+                    v = float("inf") if le == "+Inf" else float(le)
+                except ValueError:
+                    continue
+                if v > threshold and v > best_le:
+                    best_le, best_tid = v, tid
+        return best_tid
+
+    @property
+    def slo_enabled(self) -> bool:
+        return self._slo is not None
+
+    def slo_status(self) -> Dict[str, Any]:
+        """The GET /slo payload."""
+        return {
+            "slos": self._slo.status() if self._slo is not None else [],
+            "page_burn": (self._slo.page_burn
+                          if self._slo is not None else None),
+            "warn_burn": (self._slo.warn_burn
+                          if self._slo is not None else None),
+        }
+
     @property
     def metrics_enabled(self) -> bool:
         return self._registry.enabled
 
     def metrics_text(self) -> str:
-        return self._registry.render()
+        """The tier's full exposition: its own shellac_tier_* (and
+        shellac_slo_*) series, then the federated block — every
+        replica series re-labeled `replica="<url>"`, staleness stamps,
+        and the shellac_fleet_* aggregates."""
+        base = self._registry.render()
+        if self._fleet is None:
+            return base
+        fed = self._fleet.render(
+            routable_count=sum(r.routable for r in self._replicas),
+            skip_families=frozenset(self._registry.family_names()),
+        )
+        return base + fed
 
     @property
     def debug_enabled(self) -> bool:
@@ -1035,14 +1185,27 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
             self.wfile.write(body)
 
         def do_GET(self):
+            # Errors carry the trace id too (adopted or minted): a
+            # rejected request is exactly the one its sender wants to
+            # look up in the recorder.
+            tid, _ = adopt_trace(self.headers.get(TRACE_HEADER))
             if self.path == "/health":
                 h = router.health()
-                self._send(200 if h["ok"] else 503, h)
+                self._send(200 if h["ok"] else 503, h, trace_id=tid)
             elif self.path == "/stats":
                 self._send(200, router.stats())
+            elif self.path == "/slo":
+                if not router.slo_enabled:
+                    self._send(404, {
+                        "error": "no SLOs configured "
+                                 "(serve-tier --slo/--slo-file)",
+                    }, trace_id=tid)
+                else:
+                    self._send(200, router.slo_status())
             elif self.path == "/metrics":
                 if not router.metrics_enabled:
-                    self._send(404, {"error": "metrics disabled"})
+                    self._send(404, {"error": "metrics disabled"},
+                               trace_id=tid)
                     return
                 body = router.metrics_text().encode()
                 self._send(200, (
@@ -1064,27 +1227,30 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                             return
                     except (OSError, http.client.HTTPException):
                         continue
-                self._send(503, {"error": "no routable replica"})
+                self._send(503, {"error": "no routable replica"},
+                           trace_id=tid)
             elif self.path.startswith("/debug/"):
                 if not router.debug_enabled:
                     self._send(404, {"error": "debug endpoints disabled "
-                                              "(serve-tier --no-debug)"})
+                                              "(serve-tier --no-debug)"},
+                               trace_id=tid)
                 elif self.path == "/debug/requests":
                     self._send(200, router.debug_requests())
                 elif self.path.startswith("/debug/request/"):
-                    tid = self.path[len("/debug/request/"):]
-                    out = router.debug_request(tid)
+                    qid = self.path[len("/debug/request/"):]
+                    out = router.debug_request(qid)
                     if out is None:
                         self._send(404, {
                             "error": f"no recorded events for trace "
-                                     f"id {tid!r}",
-                        })
+                                     f"id {qid!r}",
+                        }, trace_id=tid)
                     else:
                         self._send(200, out)
                 else:
-                    self._send(404, {"error": "not found"})
+                    self._send(404, {"error": "not found"},
+                               trace_id=tid)
             else:
-                self._send(404, {"error": "not found"})
+                self._send(404, {"error": "not found"}, trace_id=tid)
 
         @staticmethod
         def _stream_terminated(tail: bytes, sse: bool) -> bool:
@@ -1189,24 +1355,32 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                                       exemplar=trace_id)
 
         def do_POST(self):
+            # Adopt the client's trace id (a W3C-shaped x-shellac-trace
+            # from an upstream proxy) or mint one BEFORE parsing the
+            # payload: this id rides every replica attempt, comes back
+            # as x-request-id — and a 400 for a malformed body is
+            # exactly the response its sender wants an id on.
+            tid, _ = adopt_trace(self.headers.get(TRACE_HEADER))
             try:
                 n = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(n) or b"{}")
             except ValueError:
-                self._send(400, {"error": "bad JSON payload"})
+                self._send(400, {"error": "bad JSON payload"},
+                           trace_id=tid)
                 return
             if not isinstance(payload, dict):
                 # Valid JSON that isn't an object ('[1]', '5') must
                 # 400, not AttributeError the handler thread.
                 self._send(400, {"error": "payload must be a JSON "
-                                          "object"})
+                                          "object"}, trace_id=tid)
                 return
             if self.path == "/admin/drain":
                 if "replica" not in payload:
                     # No default: a typoed request must not silently
                     # drain whichever replica happens to be first.
                     self._send(400, {"error": 'need "replica": '
-                                              "url or index"})
+                                              "url or index"},
+                               trace_id=tid)
                     return
                 try:
                     out = router.drain_replica(
@@ -1214,20 +1388,17 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                         resume=bool(payload.get("resume")),
                     )
                 except (ValueError, IndexError) as e:
-                    self._send(400, {"error": str(e)})
+                    self._send(400, {"error": str(e)}, trace_id=tid)
                     return
                 except OSError as e:
-                    self._send(502, {"error": f"drain forward failed: {e}"})
+                    self._send(502, {"error": f"drain forward failed: {e}"},
+                               trace_id=tid)
                     return
                 self._send(200, out)
                 return
             if self.path not in route_paths:
-                self._send(404, {"error": "not found"})
+                self._send(404, {"error": "not found"}, trace_id=tid)
                 return
-            # Adopt the client's trace id (a W3C-shaped x-shellac-trace
-            # from an upstream proxy) or mint one: this id rides every
-            # replica attempt and comes back as x-request-id.
-            tid, _ = adopt_trace(self.headers.get(TRACE_HEADER))
             if payload.get("stream"):
                 self._relay_stream(self.path, payload, tid)
             else:

@@ -12,8 +12,16 @@ process-global registry by default (`get_registry()`), so
 `GET /metrics` — or a bench snapshot — sees training throughput and
 serving latency through one exposition path.
 
+The fleet layer builds on those: `promtext.py` (the one scrape-side
+Prometheus text parser every consumer shares), `fleet.py` (the tier's
+federated collector — replica series re-exposed with a `replica`
+label, last-known-good through outages, `shellac_fleet_*` merged
+aggregates), and `slo.py` (declarative objectives evaluated by
+multi-window burn rate, with an ok→warning→page alert state machine
+that lands transitions in the flight recorder).
+
 See docs/observability.md for the metric catalog, the tracing/header
-contract, and the recorder event catalog.
+contract, the recorder event catalog, and §Fleet.
 """
 
 from shellac_tpu.obs.events import (
@@ -25,6 +33,10 @@ from shellac_tpu.obs.events import (
     new_trace_id,
     parse_trace_header,
 )
+from shellac_tpu.obs.fleet import (
+    MERGED_HISTOGRAMS,
+    FleetCollector,
+)
 from shellac_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -35,7 +47,20 @@ from shellac_tpu.obs.metrics import (
     log_buckets,
     set_default_registry,
 )
+from shellac_tpu.obs.promtext import (
+    ParsedMetrics,
+    cumulative_at,
+    histogram_quantile,
+    merge_buckets,
+    parse_prometheus_text,
+)
+from shellac_tpu.obs.slo import (
+    SLOEngine,
+    SLOSpec,
+    parse_slo_specs,
+)
 from shellac_tpu.obs.trace import (
+    STEP_PHASES,
     EngineMetrics,
     RequestTrace,
     ServeMetrics,
@@ -62,4 +87,15 @@ __all__ = [
     "RequestTrace",
     "ServeMetrics",
     "TierMetrics",
+    "STEP_PHASES",
+    "ParsedMetrics",
+    "parse_prometheus_text",
+    "histogram_quantile",
+    "cumulative_at",
+    "merge_buckets",
+    "FleetCollector",
+    "MERGED_HISTOGRAMS",
+    "SLOEngine",
+    "SLOSpec",
+    "parse_slo_specs",
 ]

@@ -1,0 +1,303 @@
+"""Fleet metrics federation: one scrape answers for N replicas.
+
+The serving tier already pulls every replica's `/metrics` on the
+health-poll interval — and, before this module, threw away everything
+but four load-score gauges. `FleetCollector` keeps the whole parsed
+exposition instead and re-exposes it on the TIER's `/metrics`:
+
+  federated series — every replica sample re-emitted with a
+    `replica="<url>"` label, so one Prometheus target (the tier)
+    yields the full per-replica picture without N scrape configs that
+    chase respawned replicas around.
+
+  last-known-good through outages — a replica that stops answering
+    keeps serving its LAST successful scrape (a dying replica's final
+    counters are exactly the numbers an incident review needs), with
+    staleness stamped next to it: `shellac_fleet_scrape_age_seconds`
+    (seconds since the last good scrape) and
+    `shellac_fleet_scrape_stale` (1 once the replica is unreachable
+    or the age exceeds the staleness bound). `forget()` drops a
+    replaced replica's series for good (tier respawn), and a scrape
+    from a restarted process simply overwrites the LKG with the fresh
+    (reset) series.
+
+  fleet aggregates — tier-computed `shellac_fleet_*` series: the
+    routable count, pending summed across live replicas, mean KV
+    utilization, and CROSS-REPLICA MERGED latency histograms
+    (`shellac_fleet_ttft_seconds`, `shellac_fleet_tpot_seconds`):
+    cumulative bucket counts summed edge-wise, which is exact
+    aggregation because every replica uses the same fixed bucket
+    layout (obs/trace.py). Merges include stale replicas — their
+    cumulative history is real traffic the fleet served.
+
+Everything here is host-side text processing on the tier's poll and
+scrape paths; replicas are untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from shellac_tpu.obs.metrics import _escape, _fmt
+from shellac_tpu.obs.promtext import (
+    ParsedMetrics,
+    merge_buckets,
+    parse_prometheus_text,
+)
+
+#: Replica histograms merged into shellac_fleet_* counterparts.
+MERGED_HISTOGRAMS = ("shellac_ttft_seconds", "shellac_tpot_seconds")
+
+
+class _Scrape:
+    __slots__ = ("parsed", "t_ok", "ok")
+
+    def __init__(self, parsed: ParsedMetrics, t_ok: float):
+        self.parsed = parsed
+        self.t_ok = t_ok
+        self.ok = True
+
+
+class FleetCollector:
+    """Per-replica last-known-good scrape store + federated renderer.
+
+    Writers: the tier's health poller (`observe` on a successful
+    /metrics pull, `mark_unreachable` on a failed one, `forget` on
+    respawn). Readers: the tier's `/metrics` handler (`render`), the
+    SLO engine (`merged_histogram` / `sum_gauge`), and `top`.
+    """
+
+    def __init__(self, stale_after: float = 5.0):
+        if stale_after <= 0:
+            raise ValueError("stale_after must be > 0")
+        self.stale_after = float(stale_after)
+        self._lock = threading.Lock()
+        self._scrapes: Dict[str, _Scrape] = {}
+
+    # ---- writes (health poller) -------------------------------------
+
+    def observe(self, replica_url: str, text: str) -> ParsedMetrics:
+        """Store one successful scrape; returns the parse so the load
+        scorer reads the same object instead of re-parsing."""
+        parsed = parse_prometheus_text(text)
+        with self._lock:
+            self._scrapes[replica_url] = _Scrape(parsed, time.monotonic())
+        return parsed
+
+    def mark_unreachable(self, replica_url: str) -> None:
+        """A scrape failed: keep the last-known-good series, flip the
+        staleness flag. Unknown replicas (never scraped) stay absent —
+        there is nothing to serve for them."""
+        with self._lock:
+            sc = self._scrapes.get(replica_url)
+            if sc is not None:
+                sc.ok = False
+
+    def forget(self, replica_url: str) -> None:
+        """Drop a replica's series entirely (it was REPLACED, not
+        merely down: tier respawn under a new URL)."""
+        with self._lock:
+            self._scrapes.pop(replica_url, None)
+
+    # ---- reads -------------------------------------------------------
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return list(self._scrapes)
+
+    def parsed(self, replica_url: str) -> Optional[ParsedMetrics]:
+        with self._lock:
+            sc = self._scrapes.get(replica_url)
+            return sc.parsed if sc is not None else None
+
+    def staleness(self) -> Dict[str, Tuple[float, bool]]:
+        """{replica: (age of last good scrape, stale?)}."""
+        now = time.monotonic()
+        out: Dict[str, Tuple[float, bool]] = {}
+        with self._lock:
+            for url, sc in self._scrapes.items():
+                age = now - sc.t_ok
+                out[url] = (age, (not sc.ok) or age > self.stale_after)
+        return out
+
+    def merged_histogram(self, family: str
+                         ) -> Tuple[List[Tuple[float, float]], float, float]:
+        """Cross-replica merged cumulative buckets + (_sum, _count)
+        for one histogram family, LKG included."""
+        with self._lock:
+            scrapes = list(self._scrapes.values())
+        series = []
+        total_sum = total_count = 0.0
+        for sc in scrapes:
+            b = sc.parsed.buckets(family)
+            if b:
+                series.append(b)
+            s, c = sc.parsed.histogram_sum_count(family)
+            total_sum += s
+            total_count += c
+        return merge_buckets(series), total_sum, total_count
+
+    def sum_gauge(self, name: str, fresh_only: bool = True) -> float:
+        """Sum one gauge across replicas (every labeling of it), by
+        default over FRESH scrapes only — a dead replica holds no
+        pending work, whatever its last exposition said."""
+        stale = self.staleness()
+        with self._lock:
+            items = list(self._scrapes.items())
+        total = 0.0
+        for url, sc in items:
+            if fresh_only and stale.get(url, (0, True))[1]:
+                continue
+            for _, v in sc.parsed.series(name):
+                total += v
+        return total
+
+    def mean_gauge(self, name: str, fresh_only: bool = True
+                   ) -> Optional[float]:
+        stale = self.staleness()
+        with self._lock:
+            items = list(self._scrapes.items())
+        vals: List[float] = []
+        for url, sc in items:
+            if fresh_only and stale.get(url, (0, True))[1]:
+                continue
+            v = sc.parsed.value(name)
+            if v is not None:
+                vals.append(v)
+        if not vals:
+            return None
+        return sum(vals) / len(vals)
+
+    # ---- exposition --------------------------------------------------
+
+    @staticmethod
+    def _labelstr(labels: Dict[str, str]) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels.items())
+        return "{" + inner + "}"
+
+    def render(self, *, routable_count: Optional[int] = None,
+               skip_families: FrozenSet[str] = frozenset()) -> str:
+        """The federated exposition block, appended after the tier's
+        own `Registry.render()` output.
+
+        `skip_families` carries the family names the tier's registry
+        already emitted `# TYPE` headers for (e.g. the tier's own
+        flight-recorder counters, which replicas also expose): their
+        federated samples are still emitted — same family, disjoint
+        `replica`-labeled series — but the duplicate header is not,
+        keeping the combined exposition format-valid.
+        """
+        now = time.monotonic()
+        with self._lock:
+            scrapes = sorted(self._scrapes.items())
+        lines: List[str] = []
+
+        # -- staleness stamps + aggregates -----------------------------
+        lines.append(
+            "# HELP shellac_fleet_scrape_age_seconds Seconds since the "
+            "last successful /metrics scrape of this replica (its "
+            "series below are last-known-good once this grows)"
+        )
+        lines.append("# TYPE shellac_fleet_scrape_age_seconds gauge")
+        for url, sc in scrapes:
+            ls = self._labelstr({"replica": url})
+            lines.append(
+                f"shellac_fleet_scrape_age_seconds{ls} "
+                f"{_fmt(round(now - sc.t_ok, 3))}"
+            )
+        lines.append(
+            "# HELP shellac_fleet_scrape_stale 1 when the replica's "
+            "series are last-known-good (scrape failing or older than "
+            "the staleness bound), else 0"
+        )
+        lines.append("# TYPE shellac_fleet_scrape_stale gauge")
+        for url, sc in scrapes:
+            ls = self._labelstr({"replica": url})
+            stale = (not sc.ok) or (now - sc.t_ok) > self.stale_after
+            lines.append(f"shellac_fleet_scrape_stale{ls} "
+                         f"{1 if stale else 0}")
+
+        if routable_count is not None:
+            lines.append(
+                "# HELP shellac_fleet_replicas_routable Replicas the "
+                "tier will currently route to"
+            )
+            lines.append("# TYPE shellac_fleet_replicas_routable gauge")
+            lines.append(
+                f"shellac_fleet_replicas_routable {routable_count}"
+            )
+        pending = self.sum_gauge("shellac_pending_requests")
+        lines.append(
+            "# HELP shellac_fleet_pending_requests Pending requests "
+            "summed across live (non-stale) replicas"
+        )
+        lines.append("# TYPE shellac_fleet_pending_requests gauge")
+        lines.append(f"shellac_fleet_pending_requests {_fmt(pending)}")
+        kv = self.mean_gauge("shellac_kv_utilization")
+        if kv is not None:
+            lines.append(
+                "# HELP shellac_fleet_kv_utilization Mean KV-cache "
+                "utilization across live (non-stale) replicas"
+            )
+            lines.append("# TYPE shellac_fleet_kv_utilization gauge")
+            lines.append(f"shellac_fleet_kv_utilization {_fmt(kv)}")
+
+        for family in MERGED_HISTOGRAMS:
+            buckets, h_sum, h_count = self.merged_histogram(family)
+            if not buckets:
+                continue
+            fleet = family.replace("shellac_", "shellac_fleet_", 1)
+            lines.append(
+                f"# HELP {fleet} Cross-replica merge of {family} "
+                "(cumulative buckets summed edge-wise; stale replicas' "
+                "history included)"
+            )
+            lines.append(f"# TYPE {fleet} histogram")
+            for le, cum in buckets:
+                lines.append(
+                    f'{fleet}_bucket{{le="{_fmt(le)}"}} {_fmt(cum)}'
+                )
+            lines.append(f"{fleet}_sum {_fmt(h_sum)}")
+            lines.append(f"{fleet}_count {_fmt(h_count)}")
+
+        # -- federated per-replica series ------------------------------
+        # Family-major order (the exposition format requires all of a
+        # family's samples in ONE group): for each family, one header,
+        # then every replica's samples of it with the replica label.
+        grouped: Dict[str, List[Tuple[str, str, Dict[str, str], float]]] = {}
+        order: List[str] = []
+        kinds: Dict[str, str] = {}
+        helps: Dict[str, str] = {}
+        for url, sc in scrapes:
+            for name, labels, value in sc.parsed.samples:
+                family = name
+                for suffix in ("_bucket", "_sum", "_count"):
+                    if name.endswith(suffix) and (
+                        name[: -len(suffix)] in sc.parsed.types
+                    ):
+                        family = name[: -len(suffix)]
+                        break
+                if family not in grouped:
+                    grouped[family] = []
+                    order.append(family)
+                kinds.setdefault(family, sc.parsed.types.get(family, ""))
+                helps.setdefault(family, sc.parsed.helps.get(family, ""))
+                grouped[family].append((url, name, labels, value))
+        for family in order:
+            if family not in skip_families:
+                if helps[family]:
+                    lines.append(f"# HELP {family} "
+                                 f"{_escape(helps[family])}")
+                if kinds[family]:
+                    lines.append(f"# TYPE {family} {kinds[family]}")
+            for url, name, labels, value in grouped[family]:
+                merged = dict(labels)
+                merged["replica"] = url  # flat federation: ours wins
+                lines.append(
+                    f"{name}{self._labelstr(merged)} {_fmt(value)}"
+                )
+        return "\n".join(lines) + ("\n" if lines else "")

@@ -188,6 +188,20 @@ class Histogram(_Instrument):
                 out[le] = ex
             return out
 
+    def cumulative_pairs(self) -> List[Tuple[float, float]]:
+        """Cumulative `(le, count)` pairs including the `+Inf` edge —
+        the same shape a scrape-side parser produces, so host-side
+        histograms and scraped ones feed one quantile/SLO code path."""
+        with self._lock:
+            counts = list(self.counts)
+        out: List[Tuple[float, float]] = []
+        cum = 0
+        for upper, c in zip(self.uppers, counts[:-1]):
+            cum += c
+            out.append((float(upper), float(cum)))
+        out.append((float("inf"), float(cum + counts[-1])))
+        return out
+
     def percentile(self, q: float) -> Optional[float]:
         """Estimated q-quantile (0 < q <= 1) by linear interpolation
         within the containing bucket; None when empty. Values in the
@@ -374,6 +388,13 @@ class Registry:
             i.count if isinstance(i, Histogram) else i.value
             for i in insts
         ))
+
+    def family_names(self) -> List[str]:
+        """Registered family names (the tier's federated exposition
+        uses this to avoid duplicate # TYPE headers for families both
+        the tier and its replicas expose)."""
+        with self._lock:
+            return list(self._families)
 
     # ---- exposition --------------------------------------------------
 

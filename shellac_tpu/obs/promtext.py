@@ -1,0 +1,268 @@
+"""Scrape-side Prometheus text parsing and histogram math.
+
+One parser for everyone who reads a replica's `/metrics` over the
+wire: the tier's load scorer, the fleet federation collector
+(`obs/fleet.py`), the `top` dashboard, and the tests that assert
+against expositions. Before this module each consumer grew its own
+ad-hoc line splitter (the tier's dropped every label but `le`, which
+silently merged the bucket series of *labeled* histograms — e.g. the
+per-phase step-time histogram — into one garbage quantile).
+
+Deliberately NOT a general Prometheus client:
+
+  - only the 0.0.4 text format our own `Registry.render()` emits
+    (plus anything shaped like it) — `# HELP`/`# TYPE` comments,
+    `name{labels} value` samples, optional trailing timestamps
+    ignored;
+  - malformed lines are skipped, never raised on: a scrape must
+    degrade to "fewer series", not take the scraper down;
+  - values parse as floats; label values un-escape the three escapes
+    the exposition format defines (backslash, quote, newline).
+
+`histogram_quantile` is the scrape-side mirror of
+`obs.Histogram.percentile`: it interpolates inside the containing
+bucket from cumulative `(le, count)` pairs, and treats the `+Inf`
+edge consistently — the TOTAL is the `+Inf` cumulative count (the
+family's `_count`), and a quantile landing in the overflow bucket
+reports the last finite edge, the honest upper bound a scrape can
+state (the host side reports its observed max; a scrape never sees
+one).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: A parsed sample: (metric name, labels, value). Labels keep their
+#: exposition order in the dict (insertion-ordered).
+Sample = Tuple[str, Dict[str, str], float]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(
+    r'\s*([a-zA-Z_][a-zA-Z0-9_]*)\s*=\s*"((?:[^"\\]|\\.)*)"\s*,?'
+)
+
+
+def _unescape(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+             .replace("\\\\", "\\"))
+
+
+def _parse_value(s: str) -> Optional[float]:
+    s = s.strip()
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    try:
+        return float(s)
+    except ValueError:
+        return None
+
+
+class ParsedMetrics:
+    """The result of one parsed exposition: every sample with its
+    labels intact, plus the `# TYPE` / `# HELP` metadata, behind the
+    read helpers the scrapers actually need."""
+
+    __slots__ = ("samples", "types", "helps")
+
+    def __init__(self) -> None:
+        self.samples: List[Sample] = []
+        self.types: Dict[str, str] = {}
+        self.helps: Dict[str, str] = {}
+
+    # ---- reads -------------------------------------------------------
+
+    def value(self, name: str, **labels: str) -> Optional[float]:
+        """First sample of `name` whose labels CONTAIN the given pairs
+        (an unlabeled lookup matches the first sample of any labeling);
+        None when absent."""
+        want = {k: str(v) for k, v in labels.items()}
+        for n, ls, v in self.samples:
+            if n != name:
+                continue
+            if all(ls.get(k) == v for k, v in want.items()):
+                return v
+        return None
+
+    def series(self, name: str) -> List[Tuple[Dict[str, str], float]]:
+        """Every (labels, value) sample of `name`."""
+        return [(ls, v) for n, ls, v in self.samples if n == name]
+
+    def names(self) -> List[str]:
+        seen: Dict[str, None] = {}
+        for n, _, _ in self.samples:
+            seen.setdefault(n, None)
+        return list(seen)
+
+    def buckets(self, family: str,
+                **labels: str) -> List[Tuple[float, float]]:
+        """Cumulative `(le, count)` pairs of `family`'s histogram,
+        SUMMED per edge over every label set matching the given pairs
+        (exclusive of `le`). Summing cumulative counts edge-wise is
+        exact histogram aggregation when the bucket layouts agree —
+        which ours do by construction (fixed log-spaced layouts). The
+        returned pairs are sorted by edge with `+Inf` last."""
+        want = {k: str(v) for k, v in labels.items()}
+        per_edge: Dict[float, float] = {}
+        for n, ls, v in self.samples:
+            if n != family + "_bucket" or "le" not in ls:
+                continue
+            if not all(ls.get(k) == val for k, val in want.items()):
+                continue
+            le = _parse_value(ls["le"])
+            if le is None:
+                continue
+            per_edge[le] = per_edge.get(le, 0.0) + v
+        return sorted(per_edge.items())
+
+    def histogram_sum_count(self, family: str, **labels: str
+                            ) -> Tuple[float, float]:
+        """(sum of `_sum`, sum of `_count`) over matching label sets."""
+        want = {k: str(v) for k, v in labels.items()}
+        s = c = 0.0
+        for n, ls, v in self.samples:
+            if not all(ls.get(k) == val for k, val in want.items()):
+                continue
+            if n == family + "_sum":
+                s += v
+            elif n == family + "_count":
+                c += v
+        return s, c
+
+    def label_values(self, name: str, label: str) -> List[str]:
+        """Distinct values `label` takes across `name`'s samples, in
+        first-seen order."""
+        seen: Dict[str, None] = {}
+        for n, ls, _ in self.samples:
+            if n == name and label in ls:
+                seen.setdefault(ls[label], None)
+        return list(seen)
+
+
+def parse_prometheus_text(text: str) -> ParsedMetrics:
+    """Parse a 0.0.4 text exposition. Lines that do not parse are
+    skipped (scrapers must degrade, not raise)."""
+    out = ParsedMetrics()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                out.types[parts[2]] = parts[3].strip()
+            elif len(parts) >= 4 and parts[1] == "HELP":
+                out.helps[parts[2]] = _unescape(parts[3])
+            continue
+        name, labels, rest = _split_sample(line)
+        if name is None:
+            continue
+        # `rest` may carry an optional timestamp after the value.
+        value = _parse_value(rest.split()[0]) if rest.split() else None
+        if value is None:
+            continue
+        out.samples.append((name, labels, value))
+    return out
+
+
+def _split_sample(line: str):
+    """-> (name, labels dict, value+timestamp remainder) or
+    (None, None, None) on malformed input."""
+    if "{" in line:
+        name, _, tail = line.partition("{")
+        name = name.strip()
+        if not _NAME_RE.match(name):
+            return None, None, None
+        labels: Dict[str, str] = {}
+        pos = 0
+        while pos < len(tail) and tail[pos] != "}":
+            m = _LABEL_RE.match(tail, pos)
+            if m is None:
+                return None, None, None
+            labels[m.group(1)] = _unescape(m.group(2))
+            pos = m.end()
+        if pos >= len(tail):
+            return None, None, None
+        return name, labels, tail[pos + 1:]
+    parts = line.split(None, 1)
+    if len(parts) != 2 or not _NAME_RE.match(parts[0]):
+        return None, None, None
+    return parts[0], {}, parts[1]
+
+
+def histogram_quantile(buckets: Iterable[Tuple[float, float]],
+                       q: float) -> Optional[float]:
+    """Estimated q-quantile (0 < q <= 1) from cumulative `(le, count)`
+    pairs; None when empty or count-free.
+
+    The `+Inf` edge is handled with the same cumulative counts as
+    every other edge: the TOTAL is the `+Inf` cumulative when present
+    (the family's true `_count` — the last finite bucket understates
+    it whenever observations overflowed), and a target landing past
+    the last finite cumulative reports the last finite edge — the
+    honest upper bound a scrape can state."""
+    pairs = sorted(buckets)
+    if not pairs:
+        return None
+    if not 0 < q <= 1:
+        raise ValueError(f"q must be in (0, 1], got {q}")
+    total = pairs[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    lo, prev_cum = 0.0, 0.0
+    for le, cum in pairs:
+        if cum >= target:
+            if not math.isfinite(le):
+                return lo  # overflow bucket: the last finite edge
+            in_bucket = cum - prev_cum
+            frac = (target - prev_cum) / in_bucket if in_bucket else 1.0
+            return lo + (le - lo) * frac
+        if math.isfinite(le):
+            lo, prev_cum = le, cum
+    return lo
+
+
+def cumulative_at(buckets: Iterable[Tuple[float, float]],
+                  threshold: float) -> float:
+    """Estimated cumulative COUNT of observations <= `threshold`,
+    interpolating inside the containing bucket — the good-event count
+    a latency SLO reads off a scraped histogram ("how many requests
+    beat 500ms"). Exact at bucket edges; a linear estimate inside."""
+    pairs = sorted(buckets)
+    if not pairs:
+        return 0.0
+    lo, prev_cum = 0.0, 0.0
+    for le, cum in pairs:
+        if threshold < le or not math.isfinite(le):
+            if not math.isfinite(le):
+                # Threshold beyond every finite edge: overflow
+                # observations cannot be split, so the defensible
+                # (lower-bound) good count is the last finite cum.
+                return prev_cum
+            width = le - lo
+            if width <= 0:
+                return cum
+            frac = (threshold - lo) / width
+            if frac <= 0:
+                return prev_cum
+            return prev_cum + (cum - prev_cum) * min(1.0, frac)
+        lo, prev_cum = le, cum
+    return prev_cum
+
+
+def merge_buckets(series: Iterable[Iterable[Tuple[float, float]]]
+                  ) -> List[Tuple[float, float]]:
+    """Merge cumulative bucket lists edge-wise (sum per `le`). Exact
+    when the layouts agree; with disagreeing layouts every edge is
+    kept and the merged curve is still monotone in the inputs, merely
+    coarser between foreign edges."""
+    per_edge: Dict[float, float] = {}
+    for pairs in series:
+        for le, cum in pairs:
+            per_edge[le] = per_edge.get(le, 0.0) + cum
+    return sorted(per_edge.items())

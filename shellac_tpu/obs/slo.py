@@ -1,0 +1,421 @@
+"""Declarative SLOs evaluated by multi-window burn rate.
+
+An SLO here is one line of operator intent — `ttft_p99<500ms@99.9` —
+turned into the Google SRE-workbook alerting shape: the SLI is a
+good-event fraction (requests whose TTFT beat 500ms), the objective
+is the target fraction (99.9%), and alerting is on the BURN RATE —
+how fast the error budget (the allowed 0.1% of bad events) is being
+spent — measured over paired windows so that neither a 30-second
+blip (fast window alone) nor a slow leak (long window alone) pages
+spuriously:
+
+  page    — fast pair:  burn(5m) and burn(1h) both >= 14.4
+            (a rate that exhausts a 30-day budget in ~2 days)
+  warning — slow pair:  burn(6h) and burn(3d) both >= 1.0
+            (budget being consumed faster than it accrues)
+
+Spec grammar (`SLOSpec.parse`):
+
+    <sli>[_p<NN>] <op> <value>[ms|us|s] @ <objective-percent>
+    availability @ <objective-percent>
+
+`sli` ∈ {ttft, tpot, e2e, queue_wait, availability}. The optional
+`_pNN` tag is operator-facing display — "the p99 target is 500ms" and
+"at most (100-objective)% of requests exceed 500ms" are the same
+statement, and the burn-rate math is event-based either way (the
+workbook's form). `availability` counts request outcomes instead of
+latencies, so it takes no threshold.
+
+`SLOEngine` is source-agnostic: each `tick()` hands it cumulative
+`(good, total)` event counts per SLO (the tier derives them from the
+federated fleet histograms and its own outcome counters) and it keeps
+the time-windowed snapshots needed to answer "what was the count at
+now-W" — a fine ring (per-tick, bounded to the 1h window) plus a
+coarse ring (one point a minute, bounded to 3d), so memory stays a
+few thousand tuples however long the tier runs. Windows the process
+has not lived through yet fall back to the oldest snapshot (partial
+window, reported as such) — a young tier alerts on what it has seen,
+not never.
+
+Alert transitions land in the flight recorder (`slo-transition`
+events) with a trace-id exemplar of a violating request when the
+caller can supply one — the PR 10 path from "the pager fired" to one
+concrete request timeline.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from bisect import bisect_right
+from collections import deque
+from dataclasses import dataclass
+from typing import (
+    Callable,
+    Deque,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+#: Evaluation windows, seconds: the workbook's fast pair + slow pair.
+FAST_WINDOWS: Tuple[Tuple[str, float], ...] = (("5m", 300.0),
+                                               ("1h", 3600.0))
+SLOW_WINDOWS: Tuple[Tuple[str, float], ...] = (("6h", 21600.0),
+                                               ("3d", 259200.0))
+ALL_WINDOWS: Tuple[Tuple[str, float], ...] = FAST_WINDOWS + SLOW_WINDOWS
+
+#: Default burn thresholds (SRE workbook: 14.4 = a 30-day budget gone
+#: in 2 days; 1.0 = spending exactly as fast as the budget accrues).
+PAGE_BURN = 14.4
+WARN_BURN = 1.0
+
+STATES = ("ok", "warning", "page")
+
+_SPEC_RE = re.compile(
+    r"^\s*([a-z][a-z0-9_]*?)(?:_p(\d+(?:\.\d+)?))?"
+    r"(?:\s*(<=|<)\s*(\d+(?:\.\d+)?)\s*(ms|us|s)?)?"
+    r"\s*@\s*(\d+(?:\.\d+)?)\s*$"
+)
+
+_UNIT_S = {"s": 1.0, "ms": 1e-3, "us": 1e-6, None: 1.0}
+
+#: SLIs with a latency threshold (histogram-backed good counts).
+LATENCY_SLIS = ("ttft", "tpot", "e2e", "queue_wait")
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """One parsed objective. `name` is the verbatim spec string — the
+    stable label value every shellac_slo_* series carries."""
+
+    name: str
+    sli: str                       # ttft|tpot|e2e|queue_wait|availability
+    threshold_s: Optional[float]   # None for availability
+    objective: float               # fraction in (0, 1), e.g. 0.999
+    percentile_tag: Optional[str]  # display-only "_pNN" tag, if given
+
+    @property
+    def budget(self) -> float:
+        """Allowed bad-event fraction (1 - objective)."""
+        return 1.0 - self.objective
+
+    @classmethod
+    def parse(cls, spec: str) -> "SLOSpec":
+        m = _SPEC_RE.match(spec)
+        if not m:
+            raise ValueError(
+                f"bad SLO spec {spec!r}: expected "
+                "'<sli>[_pNN]<threshold><ms|s>@<objective>' "
+                "(e.g. 'ttft_p99<500ms@99.9') or 'availability@99.9'"
+            )
+        sli, ptag, _op, value, unit, obj = m.groups()
+        if sli == "availability":
+            if value is not None or ptag is not None:
+                raise ValueError(
+                    f"bad SLO spec {spec!r}: availability takes no "
+                    "threshold or percentile tag"
+                )
+            threshold = None
+        elif sli in LATENCY_SLIS:
+            if value is None:
+                raise ValueError(
+                    f"bad SLO spec {spec!r}: latency SLI {sli!r} "
+                    "needs a threshold (e.g. <500ms)"
+                )
+            threshold = float(value) * _UNIT_S[unit]
+        else:
+            raise ValueError(
+                f"bad SLO spec {spec!r}: unknown SLI {sli!r} "
+                f"(known: {', '.join(LATENCY_SLIS)}, availability)"
+            )
+        objective = float(obj) / 100.0
+        if not 0.0 < objective < 1.0:
+            raise ValueError(
+                f"bad SLO spec {spec!r}: objective must be in (0, 100) "
+                "percent, exclusive"
+            )
+        return cls(name=spec.strip(), sli=sli, threshold_s=threshold,
+                   objective=objective,
+                   percentile_tag=f"p{ptag}" if ptag else None)
+
+
+def parse_slo_specs(specs: Sequence[str]) -> List[SLOSpec]:
+    out = [SLOSpec.parse(s) for s in specs]
+    names = [s.name for s in out]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate SLO specs: {names}")
+    return out
+
+
+class _Ring:
+    """Timestamped (t, good, total) snapshots with bounded memory:
+    drop-from-the-left once the oldest point is older than `horizon`
+    AND a second point also covers the horizon (the newest point at
+    or before now-W must survive)."""
+
+    __slots__ = ("horizon", "min_gap", "_pts", "_last_t")
+
+    def __init__(self, horizon: float, min_gap: float = 0.0):
+        self.horizon = horizon
+        self.min_gap = min_gap
+        self._pts: Deque[Tuple[float, float, float]] = deque()
+        self._last_t: Optional[float] = None
+
+    def append(self, t: float, good: float, total: float) -> None:
+        if self._last_t is not None and t - self._last_t < self.min_gap:
+            return
+        self._last_t = t
+        self._pts.append((t, good, total))
+        cutoff = t - self.horizon
+        while len(self._pts) >= 2 and self._pts[1][0] <= cutoff:
+            self._pts.popleft()
+
+    def at_or_before(self, t: float) -> Optional[Tuple[float, float, float]]:
+        """Newest snapshot with timestamp <= t, else None."""
+        pts = self._pts
+        if not pts or pts[0][0] > t:
+            return None
+        idx = bisect_right(pts, (t, float("inf"), float("inf"))) - 1
+        return pts[idx]
+
+    def oldest(self) -> Optional[Tuple[float, float, float]]:
+        return self._pts[0] if self._pts else None
+
+
+class _Track:
+    """Per-SLO mutable state: snapshot rings + alert state."""
+
+    __slots__ = ("spec", "fine", "coarse", "state", "last_transition",
+                 "last_counts")
+
+    def __init__(self, spec: SLOSpec):
+        self.spec = spec
+        # Fine ring answers the fast windows; coarse (1/min) answers
+        # the slow ones without holding 3 days of per-tick points.
+        self.fine = _Ring(horizon=FAST_WINDOWS[-1][1] + 60.0)
+        self.coarse = _Ring(horizon=SLOW_WINDOWS[-1][1] + 3600.0,
+                            min_gap=60.0)
+        self.state = "ok"
+        self.last_transition: Optional[Dict[str, object]] = None
+        self.last_counts: Tuple[float, float] = (0.0, 0.0)
+
+    def lookup(self, t: float) -> Optional[Tuple[float, float, float]]:
+        hit = self.fine.at_or_before(t)
+        if hit is not None:
+            return hit
+        return self.coarse.at_or_before(t)
+
+    def oldest(self) -> Optional[Tuple[float, float, float]]:
+        old_c = self.coarse.oldest()
+        old_f = self.fine.oldest()
+        if old_c is None:
+            return old_f
+        if old_f is None or old_c[0] <= old_f[0]:
+            return old_c
+        return old_f
+
+
+class SLOEngine:
+    """Evaluate a set of `SLOSpec`s from cumulative good/total counts.
+
+    `tick(counts)` is called on the tier's poll cadence with
+    `{spec.name: (good, total)}`; the engine snapshots, computes the
+    four window burn rates, runs the ok→warning→page state machine,
+    updates the shellac_slo_* gauges, and records transitions in the
+    flight recorder (with a violating-request exemplar from
+    `exemplar_fn` when one exists). `status()` is the `/slo` JSON.
+
+    Counter resets (a replica restart shrinking the federated
+    cumulative counts) clamp window deltas at zero — a reset must
+    read as "no data", never as negative errors.
+    """
+
+    def __init__(self, specs: Sequence[SLOSpec], *,
+                 registry=None, recorder=None,
+                 exemplar_fn: Optional[
+                     Callable[[SLOSpec], Optional[str]]] = None,
+                 page_burn: float = PAGE_BURN,
+                 warn_burn: float = WARN_BURN):
+        self.specs = list(specs)
+        self.page_burn = float(page_burn)
+        self.warn_burn = float(warn_burn)
+        self._recorder = recorder
+        self._exemplar_fn = exemplar_fn
+        self._lock = threading.Lock()
+        self._tracks = {s.name: _Track(s) for s in self.specs}
+        self._g_burn = self._g_state = self._g_good = None
+        self._g_objective = self._c_transitions = None
+        if registry is not None and self.specs:
+            self._g_burn = registry.gauge(
+                "shellac_slo_burn_rate",
+                "Error-budget burn rate per SLO and window (1.0 = "
+                "spending exactly as fast as the budget accrues; the "
+                "page pair trips at 14.4)",
+                labels=("slo", "window"),
+            )
+            self._g_state = registry.gauge(
+                "shellac_slo_state",
+                "Alert state per SLO: 0 ok, 1 warning, 2 page",
+                labels=("slo",),
+            )
+            self._g_good = registry.gauge(
+                "shellac_slo_good_fraction",
+                "Good-event fraction over the fast (5m) window",
+                labels=("slo",),
+            )
+            self._g_objective = registry.gauge(
+                "shellac_slo_objective",
+                "The SLO's objective as a fraction (info gauge)",
+                labels=("slo",),
+            )
+            self._c_transitions = registry.counter(
+                "shellac_slo_transitions_total",
+                "Alert state transitions per SLO, by destination state",
+                labels=("slo", "to"),
+            )
+            for s in self.specs:
+                self._g_objective.labels(slo=s.name).set(s.objective)
+                self._g_state.labels(slo=s.name).set(0)
+
+    # ---- evaluation --------------------------------------------------
+
+    def _window_burn(self, track: _Track, now: float, window_s: float,
+                     good: float, total: float
+                     ) -> Tuple[float, float, float]:
+        """(burn rate, bad fraction, actual window seconds) for one
+        window ending now. Falls back to the oldest snapshot when the
+        engine has not lived `window_s` yet."""
+        anchor = track.lookup(now - window_s)
+        if anchor is None:
+            anchor = track.oldest()
+        if anchor is None:
+            return 0.0, 0.0, 0.0
+        t0, g0, n0 = anchor
+        d_total = total - n0
+        d_good = good - g0
+        if d_total <= 0 or d_good < 0:
+            # No traffic in the window, or a counter reset mid-window.
+            return 0.0, 0.0, now - t0
+        d_bad = max(0.0, d_total - d_good)
+        bad_frac = min(1.0, d_bad / d_total)
+        burn = bad_frac / track.spec.budget
+        return burn, bad_frac, now - t0
+
+    def tick(self, counts: Dict[str, Tuple[float, float]],
+             now: Optional[float] = None) -> None:
+        """One evaluation pass. `counts[name] = (good, total)`,
+        cumulative since replica/tier start (the engine differences
+        them per window)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            for name, track in self._tracks.items():
+                good, total = counts.get(name, track.last_counts)
+                track.last_counts = (float(good), float(total))
+                track.fine.append(now, float(good), float(total))
+                track.coarse.append(now, float(good), float(total))
+                burns: Dict[str, float] = {}
+                fracs: Dict[str, float] = {}
+                for label, w in ALL_WINDOWS:
+                    b, f, _ = self._window_burn(track, now, w,
+                                                float(good), float(total))
+                    burns[label] = b
+                    fracs[label] = f
+                    if self._g_burn is not None:
+                        self._g_burn.labels(slo=name, window=label).set(b)
+                if self._g_good is not None:
+                    self._g_good.labels(slo=name).set(
+                        1.0 - fracs[FAST_WINDOWS[0][0]]
+                    )
+                new_state = self._classify(burns)
+                if new_state != track.state:
+                    self._transition(track, new_state, burns, now)
+
+    def _classify(self, burns: Dict[str, float]) -> str:
+        fast = [burns[label] for label, _ in FAST_WINDOWS]
+        slow = [burns[label] for label, _ in SLOW_WINDOWS]
+        if all(b >= self.page_burn for b in fast):
+            return "page"
+        if all(b >= self.warn_burn for b in slow):
+            return "warning"
+        return "ok"
+
+    def _transition(self, track: _Track, new_state: str,
+                    burns: Dict[str, float], now: float) -> None:
+        old = track.state
+        track.state = new_state
+        exemplar = None
+        if new_state != "ok" and self._exemplar_fn is not None:
+            try:
+                exemplar = self._exemplar_fn(track.spec)
+            except Exception:  # noqa: BLE001 — an exemplar lookup
+                exemplar = None  # must never break alerting
+        track.last_transition = {
+            "at": time.time(),
+            "from": old,
+            "to": new_state,
+            "burn": {k: round(v, 3) for k, v in burns.items()},
+            "exemplar": exemplar,
+        }
+        if self._g_state is not None:
+            self._g_state.labels(slo=track.spec.name).set(
+                STATES.index(new_state)
+            )
+        if self._c_transitions is not None:
+            self._c_transitions.labels(slo=track.spec.name,
+                                       to=new_state).inc()
+        if self._recorder is not None:
+            # The transition event is system-scoped (trace=None): the
+            # EXEMPLAR field carries the violating request's trace id,
+            # which /debug/request/<id> resolves to its timeline.
+            self._recorder.record(
+                None, "slo-transition", src="tier",
+                slo=track.spec.name, **{"from": old}, to=new_state,
+                burn={k: round(v, 3) for k, v in burns.items()},
+                exemplar=exemplar,
+            )
+
+    # ---- reads -------------------------------------------------------
+
+    def state(self, name: str) -> str:
+        with self._lock:
+            return self._tracks[name].state
+
+    def status(self, now: Optional[float] = None) -> List[Dict[str, object]]:
+        """The `/slo` JSON payload: one entry per SLO."""
+        now = time.monotonic() if now is None else now
+        out: List[Dict[str, object]] = []
+        with self._lock:
+            for name, track in self._tracks.items():
+                good, total = track.last_counts
+                windows: Dict[str, Dict[str, float]] = {}
+                for label, w in ALL_WINDOWS:
+                    b, f, actual = self._window_burn(track, now, w,
+                                                     good, total)
+                    windows[label] = {
+                        "burn_rate": round(b, 3),
+                        "bad_fraction": round(f, 6),
+                        "window_s": w,
+                        "covered_s": round(actual, 1),
+                    }
+                spec = track.spec
+                out.append({
+                    "slo": name,
+                    "sli": spec.sli,
+                    "threshold_s": spec.threshold_s,
+                    "objective": spec.objective,
+                    "state": track.state,
+                    "good_events": good,
+                    "total_events": total,
+                    "good_fraction": (
+                        round(good / total, 6) if total else None
+                    ),
+                    "windows": windows,
+                    "page_burn_threshold": self.page_burn,
+                    "warn_burn_threshold": self.warn_burn,
+                    "last_transition": track.last_transition,
+                })
+        return out

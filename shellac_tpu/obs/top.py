@@ -1,0 +1,303 @@
+"""`python -m shellac_tpu top` — a live fleet view over one tier URL.
+
+The terminal counterpart of the federation work: everything rendered
+here comes from the tier's public observability surface — `/metrics`
+(tier series + the federated per-replica block), `/slo`, `/stats`,
+and `/debug/requests` — so what the dashboard shows is exactly what a
+Prometheus + alerting stack would see, just without the stack:
+
+    $ python -m shellac_tpu top --tier http://tier:8100
+    $ python -m shellac_tpu top --tier http://tier:8100 --once   # CI
+    $ python -m shellac_tpu top --tier http://tier:8100 \
+          --trace 00-abc...-01        # one request's timeline
+
+Layout: a fleet header (routable count, outcomes, fleet p99s), the
+SLO block (state + the four window burn rates per objective), a
+per-replica table (routability, pending, KV utilization, p99 TTFT,
+staleness), the step-phase attribution bars (where each replica's
+engine tick actually goes — the measurement the prefill/decode
+disaggregation decision reads), and the recorder's recent events.
+
+Refresh is plain-text: ANSI clear + redraw on an interval (degrading
+to `--once` single-shot for scripts and CI assertions, and to
+best-effort partial renders when an endpoint 404s — a tier without
+SLOs configured still tops fine). Endpoint failures mark the section
+absent rather than crashing the loop: a dashboard must outlive the
+thing it watches.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from shellac_tpu.obs.promtext import (
+    ParsedMetrics,
+    histogram_quantile,
+    parse_prometheus_text,
+)
+from shellac_tpu.obs.trace import STEP_PHASES
+
+#: Compact per-phase tags for the attribution bars.
+_PHASE_TAGS = {
+    "admission": "adm",
+    "prefill_dispatch": "pf",
+    "decode_sync": "sync",
+    "settle": "settle",
+    "host_bookkeeping": "host",
+}
+
+_STATE_ICON = {"ok": "·", "warning": "!", "page": "!!"}
+
+
+def _get_json(base: str, path: str, timeout: float) -> Optional[Any]:
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return json.loads(r.read())
+    except (OSError, ValueError, urllib.error.HTTPError):
+        return None
+
+
+def _get_text(base: str, path: str, timeout: float) -> Optional[str]:
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.read().decode(errors="replace")
+    except (OSError, urllib.error.HTTPError):
+        return None
+
+
+def collect(tier_url: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """One snapshot of the tier's observability surface. Sections that
+    fail to fetch are None — render() degrades per section."""
+    base = tier_url.rstrip("/")
+    metrics_text = _get_text(base, "/metrics", timeout)
+    return {
+        "tier": base,
+        "stats": _get_json(base, "/stats", timeout),
+        "slo": _get_json(base, "/slo", timeout),
+        "debug": _get_json(base, "/debug/requests", timeout),
+        "metrics": (parse_prometheus_text(metrics_text)
+                    if metrics_text is not None else None),
+    }
+
+
+def _fmt_ms(seconds: Optional[float]) -> str:
+    if seconds is None:
+        return "-"
+    if seconds >= 10:
+        return f"{seconds:.1f}s"
+    return f"{seconds * 1e3:.0f}ms"
+
+
+def _short(url: str, width: int = 30) -> str:
+    u = url.replace("http://", "")
+    return u if len(u) <= width else "…" + u[-(width - 1):]
+
+
+def _replica_rows(parsed: Optional[ParsedMetrics],
+                  stats: Optional[dict]) -> List[Dict[str, Any]]:
+    """Join the /stats replica snapshots with the federated series."""
+    rows: List[Dict[str, Any]] = []
+    by_url: Dict[str, dict] = {}
+    if stats:
+        for rep in stats.get("replicas", []):
+            by_url[rep["url"]] = rep
+    urls = list(by_url)
+    if parsed is not None:
+        for u in parsed.label_values("shellac_fleet_scrape_age_seconds",
+                                     "replica"):
+            if u not in by_url:
+                urls.append(u)
+    for url in urls:
+        rep = by_url.get(url, {})
+        row: Dict[str, Any] = {
+            "url": url,
+            "state": rep.get("state", "?"),
+            "breaker": rep.get("breaker", "?"),
+            "pending": rep.get("pending"),
+            "kv": None,
+            "ttft_p99": None,
+            "stale_age": None,
+            "stale": None,
+            "phases": {},
+        }
+        if parsed is not None:
+            v = parsed.value("shellac_pending_requests", replica=url)
+            if v is not None:
+                row["pending"] = int(v)
+            row["kv"] = parsed.value("shellac_kv_utilization",
+                                     replica=url)
+            row["ttft_p99"] = histogram_quantile(
+                parsed.buckets("shellac_ttft_seconds", replica=url),
+                0.99,
+            )
+            row["stale_age"] = parsed.value(
+                "shellac_fleet_scrape_age_seconds", replica=url)
+            st = parsed.value("shellac_fleet_scrape_stale", replica=url)
+            row["stale"] = bool(st) if st is not None else None
+            for phase in STEP_PHASES:
+                s = parsed.value("shellac_step_phase_seconds_sum",
+                                 replica=url, phase=phase)
+                if s is not None:
+                    row["phases"][phase] = s
+        rows.append(row)
+    return rows
+
+
+def render(snapshot: Dict[str, Any], width: int = 100) -> str:
+    """Pure snapshot -> text (tested without a terminal)."""
+    out: List[str] = []
+    stats = snapshot.get("stats")
+    parsed: Optional[ParsedMetrics] = snapshot.get("metrics")
+    slo = snapshot.get("slo")
+    debug = snapshot.get("debug")
+
+    # -- fleet header --------------------------------------------------
+    head = f"shellac top · {snapshot.get('tier', '?')}"
+    out.append(head)
+    out.append("=" * min(width, max(len(head), 40)))
+    if stats is not None:
+        fleet_ttft = fleet_tpot = None
+        if parsed is not None:
+            fleet_ttft = histogram_quantile(
+                parsed.buckets("shellac_fleet_ttft_seconds"), 0.99)
+            fleet_tpot = histogram_quantile(
+                parsed.buckets("shellac_fleet_tpot_seconds"), 0.99)
+        out.append(
+            f"replicas {stats.get('replicas_healthy', '?')}/"
+            f"{stats.get('replicas_total', '?')} routable · "
+            f"routed {stats.get('routed', '?')} · "
+            f"retried {stats.get('retried', '?')} · "
+            f"ejected {stats.get('ejected', '?')} · "
+            f"uptime {stats.get('uptime_s', 0):.0f}s"
+        )
+        out.append(
+            f"fleet p99: ttft {_fmt_ms(fleet_ttft)} · "
+            f"tpot {_fmt_ms(fleet_tpot)}"
+        )
+    else:
+        out.append("tier /stats unreachable")
+
+    # -- SLO block -----------------------------------------------------
+    if slo and slo.get("slos"):
+        out.append("")
+        out.append("SLOs" + " " * 28 + "state    5m      1h      6h      3d")
+        for s in slo["slos"]:
+            burns = s.get("windows", {})
+
+            def b(label):
+                w = burns.get(label)
+                return f"{w['burn_rate']:7.2f}" if w else "      -"
+
+            icon = _STATE_ICON.get(s.get("state"), "?")
+            out.append(
+                f"  {s['slo']:<28.28} {icon:>2} {s.get('state', '?'):<7}"
+                f"{b('5m')} {b('1h')} {b('6h')} {b('3d')}"
+            )
+    elif slo is not None:
+        out.append("")
+        out.append("SLOs: none configured (serve-tier --slo ...)")
+
+    # -- replica table -------------------------------------------------
+    rows = _replica_rows(parsed, stats)
+    if rows:
+        out.append("")
+        out.append(
+            f"{'replica':<32}{'state':<10}{'pend':>5}{'kv%':>6}"
+            f"{'p99 ttft':>10}{'stale':>8}"
+        )
+        for r in rows:
+            kv = f"{100 * r['kv']:.0f}" if r["kv"] is not None else "-"
+            stale = ("-" if r["stale_age"] is None else
+                     (f"{r['stale_age']:.0f}s!" if r["stale"]
+                      else f"{r['stale_age']:.0f}s"))
+            pend = r["pending"] if r["pending"] is not None else "-"
+            out.append(
+                f"{_short(r['url'], 30):<32}{r['state']:<10}"
+                f"{pend:>5}{kv:>6}{_fmt_ms(r['ttft_p99']):>10}"
+                f"{stale:>8}"
+            )
+        # -- step-phase attribution bars -------------------------------
+        phased = [r for r in rows if r["phases"]]
+        if phased:
+            out.append("")
+            out.append("step-time attribution (share of engine tick)")
+            for r in phased:
+                total = sum(r["phases"].values())
+                if total <= 0:
+                    continue
+                parts = []
+                for phase in STEP_PHASES:
+                    v = r["phases"].get(phase)
+                    if v is None:
+                        continue
+                    parts.append(
+                        f"{_PHASE_TAGS[phase]} {100 * v / total:4.1f}%"
+                    )
+                out.append(f"  {_short(r['url'], 30):<32}"
+                           + "  ".join(parts))
+
+    # -- recent events -------------------------------------------------
+    if debug and debug.get("recent_events"):
+        out.append("")
+        out.append("recent events")
+        for ev in debug["recent_events"][-8:]:
+            trace = ev.get("trace")
+            tid = f" {trace[:18]}…" if trace else ""
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("seq", "ts", "trace", "event", "src")}
+            brief = ", ".join(f"{k}={v}" for k, v in list(extra.items())[:4])
+            out.append(f"  {ev.get('event', '?'):<16}{tid:<22} {brief}")
+    return "\n".join(out) + "\n"
+
+
+def render_trace(timeline: Dict[str, Any]) -> str:
+    """One request's flight-recorder timeline, relative-timestamped."""
+    events = timeline.get("events", [])
+    out = [f"trace {timeline.get('trace_id', '?')}"]
+    t0 = events[0]["ts"] if events else 0.0
+    for ev in events:
+        dt = ev.get("ts", t0) - t0
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("seq", "ts", "trace", "event", "src")}
+        brief = ", ".join(f"{k}={v}" for k, v in extra.items())
+        out.append(f"  +{dt * 1e3:9.1f}ms  {ev.get('src', '?'):<7}"
+                   f"{ev.get('event', '?'):<16}{brief}")
+    return "\n".join(out) + "\n"
+
+
+def run_top(tier: str, *, once: bool = False, interval: float = 2.0,
+            trace: Optional[str] = None, timeout: float = 5.0,
+            out=None) -> int:
+    out = sys.stdout if out is None else out
+    if trace is not None:
+        timeline = _get_json(tier.rstrip("/"),
+                             f"/debug/request/{trace}", timeout)
+        if timeline is None:
+            out.write(f"no recorded timeline for {trace!r} "
+                      "(evicted, never seen, or --no-debug)\n")
+            return 1
+        out.write(render_trace(timeline))
+        return 0
+    if once:
+        out.write(render(collect(tier, timeout)))
+        return 0
+    try:
+        while True:
+            text = render(collect(tier, timeout))
+            # ANSI clear + home: plain-text auto-refresh without a
+            # curses dependency (works in any VT-ish terminal; pipe
+            # consumers should use --once).
+            out.write("\x1b[2J\x1b[H" + text)
+            out.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+# The CLI entry point is `python -m shellac_tpu top` (cli.py owns the
+# single argparse surface); this module stays a jax-free library.

@@ -33,6 +33,25 @@ TPOT_BUCKETS = log_buckets(0.0001, 10.0, per_decade=4)
 #: Batch occupancy is a ratio; eighths resolve typical slot counts.
 OCCUPANCY_BUCKETS = linear_buckets(0.125, 0.125, 8)
 
+#: Step-time phases (the `phase` label of shellac_step_phase_seconds).
+#: Every engine step's wall time decomposes into exactly these, so
+#: sum-over-phases ≈ step wall time and "where does the tick go" is a
+#: committed measurement (the disaggregation question's input):
+#:   admission        — queue pops, slot prep, finish checks in the
+#:                      fill loop (everything admission-side that is
+#:                      NOT the prefill programs themselves)
+#:   prefill_dispatch — prefill/chunk program dispatches + their host
+#:                      syncs (the per-prefill round trips that stall
+#:                      decode windows — open item 1's premise)
+#:   decode_sync      — time blocked in the decode window's one
+#:                      packed device_get
+#:   settle           — applying synced window results: detokenize
+#:                      appends, finish checks, slot release
+#:   host_bookkeeping — the remainder (dispatch bookkeeping, gauge
+#:                      updates, scheduler glue)
+STEP_PHASES = ("admission", "prefill_dispatch", "decode_sync",
+               "settle", "host_bookkeeping")
+
 #: Request outcomes (the `outcome` label of shellac_requests_total).
 #: ok: completed; shed: deadline expired before prefill; cancelled:
 #: client abandoned it; error: bad request; fault: server-side failure
@@ -360,6 +379,18 @@ class EngineMetrics:
             "prefill dispatch). A replica whose overhead rivals its "
             "window time is host-bound, not device-bound",
             buckets=LATENCY_BUCKETS,
+        )
+        self.step_phase = h(
+            "shellac_step_phase_seconds",
+            "Per engine step: wall time attributed to one phase of "
+            "the tick (admission | prefill_dispatch | decode_sync | "
+            "settle | host_bookkeeping — see obs.STEP_PHASES). "
+            "Observed once per phase per non-idle step, so the "
+            "per-phase _sum series divide the step loop's wall time "
+            "exactly and 'prefill stalls decode windows' is a "
+            "measurement, not a claim",
+            labels=("phase",),
+            buckets=TPOT_BUCKETS,
         )
         self.occupancy = h(
             "shellac_batch_occupancy",

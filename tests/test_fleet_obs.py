@@ -1,0 +1,747 @@
+"""Fleet observability conformance (ISSUE 11).
+
+Unit level: the shared scrape-side Prometheus parser (labels intact,
+labeled histograms no longer garbled, +Inf handled with cumulative
+counts), histogram merge, the SLO spec grammar, and the multi-window
+burn-rate engine + alert state machine against a synthetic clock.
+
+Tier level (no engines): federation last-known-good through a dead
+fake replica, staleness stamps, fresh series on revival.
+
+Live level (tiny real engines): a two-replica tier whose /metrics
+federates both replicas' series (step-phase attribution included), a
+deliberately slowed replica driving an SLO page transition recorded
+in the flight recorder with a violating trace-id exemplar, `top
+--once` rendering per-replica rows with non-zero phase attribution,
+and the error-response trace-header satellite.
+
+CI: the fleet-obs job (tier-1's wall-clock window never reaches
+late-alphabet files); the SIGKILL/readmission twin with real
+subprocesses lives in tests/test_tier_chaos.py.
+"""
+
+import io
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference.batching import BatchingEngine
+from shellac_tpu.inference.server import InferenceServer, make_http_server
+from shellac_tpu.inference.tier import (
+    TierRouter,
+    make_tier_http_server,
+    parse_prometheus,
+)
+from shellac_tpu.models import transformer
+from shellac_tpu.obs import (
+    STEP_PHASES,
+    FleetCollector,
+    FlightRecorder,
+    Registry,
+    SLOEngine,
+    SLOSpec,
+    cumulative_at,
+    histogram_quantile,
+    merge_buckets,
+    parse_prometheus_text,
+    parse_slo_specs,
+)
+from shellac_tpu.obs.top import collect, render, run_top
+
+
+def wait_until(cond, timeout=60.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------
+# Shared parser
+# ---------------------------------------------------------------------
+
+EXPO = """\
+# HELP shellac_ttft_seconds Time to first token
+# TYPE shellac_ttft_seconds histogram
+shellac_ttft_seconds_bucket{le="0.1"} 6
+shellac_ttft_seconds_bucket{le="1"} 9
+shellac_ttft_seconds_bucket{le="+Inf"} 12
+shellac_ttft_seconds_sum 9.5
+shellac_ttft_seconds_count 12
+# TYPE shellac_step_phase_seconds histogram
+shellac_step_phase_seconds_bucket{phase="admission",le="0.01"} 5
+shellac_step_phase_seconds_bucket{phase="admission",le="+Inf"} 5
+shellac_step_phase_seconds_sum{phase="admission"} 0.01
+shellac_step_phase_seconds_count{phase="admission"} 5
+shellac_step_phase_seconds_bucket{phase="decode_sync",le="0.01"} 1
+shellac_step_phase_seconds_bucket{phase="decode_sync",le="+Inf"} 5
+shellac_step_phase_seconds_sum{phase="decode_sync"} 1.5
+shellac_step_phase_seconds_count{phase="decode_sync"} 5
+# TYPE shellac_pending_requests gauge
+shellac_pending_requests 3
+shellac_tier_routed_total{replica="http://r",reason="a b\\"c"} 7
+not a sample line
+bad{unclosed 1
+"""
+
+
+class TestPromTextParser:
+    def test_samples_labels_and_metadata(self):
+        p = parse_prometheus_text(EXPO)
+        assert p.value("shellac_pending_requests") == 3
+        assert p.types["shellac_ttft_seconds"] == "histogram"
+        assert "first token" in p.helps["shellac_ttft_seconds"]
+        # Labels survive intact, escapes decoded.
+        assert p.value("shellac_tier_routed_total",
+                       replica="http://r", reason='a b"c') == 7
+        # Malformed lines are skipped, not fatal.
+        assert p.value("bad") is None
+
+    def test_labeled_histograms_stay_separate(self):
+        p = parse_prometheus_text(EXPO)
+        adm = p.buckets("shellac_step_phase_seconds", phase="admission")
+        syn = p.buckets("shellac_step_phase_seconds", phase="decode_sync")
+        assert adm == [(0.01, 5.0), (math.inf, 5.0)]
+        assert syn == [(0.01, 1.0), (math.inf, 5.0)]
+        # Unfiltered: exact edge-wise sum, not interleaved garbage.
+        assert p.buckets("shellac_step_phase_seconds") == [
+            (0.01, 6.0), (math.inf, 10.0)
+        ]
+        s, c = p.histogram_sum_count("shellac_step_phase_seconds",
+                                     phase="decode_sync")
+        assert (s, c) == (1.5, 5.0)
+
+    def test_label_values(self):
+        p = parse_prometheus_text(EXPO)
+        assert p.label_values("shellac_step_phase_seconds_bucket",
+                              "phase") == ["admission", "decode_sync"]
+
+    def test_legacy_tier_wrapper(self):
+        out = parse_prometheus(EXPO)
+        assert out["shellac_pending_requests"] == 3
+        # The flat view's bucket list is the label-merged histogram —
+        # the old splitter produced duplicate edges here.
+        assert out["shellac_step_phase_seconds!buckets"] == [
+            (0.01, 6.0), (math.inf, 10.0)
+        ]
+
+
+class TestHistogramQuantile:
+    def test_empty_and_zero(self):
+        assert histogram_quantile([], 0.99) is None
+        assert histogram_quantile([(0.1, 0.0), (math.inf, 0.0)],
+                                  0.99) is None
+
+    def test_interpolation(self):
+        b = [(0.1, 6.0), (1.0, 9.0), (math.inf, 12.0)]
+        # p50: target 6 lands exactly at the 0.1 edge.
+        assert histogram_quantile(b, 0.5) == pytest.approx(0.1)
+        # p0.625: target 7.5 → halfway through (0.1, 1.0].
+        assert histogram_quantile(b, 0.625) == pytest.approx(0.55)
+
+    def test_inf_edge_uses_cumulative_total(self):
+        b = [(0.1, 6.0), (1.0, 9.0), (math.inf, 12.0)]
+        # The TOTAL is the +Inf cum (12), not the last finite cum (9):
+        # p90 (target 10.8) lands in the overflow bucket and reports
+        # the last finite edge — the honest upper bound.
+        assert histogram_quantile(b, 0.9) == 1.0
+        # p75 (target 9.0) still resolves inside the finite buckets.
+        assert histogram_quantile(b, 0.75) == pytest.approx(1.0)
+
+    def test_cumulative_at(self):
+        b = [(0.1, 6.0), (1.0, 9.0), (math.inf, 12.0)]
+        assert cumulative_at(b, 0.1) == pytest.approx(6.0)
+        assert cumulative_at(b, 0.55) == pytest.approx(7.5)
+        # Beyond the last finite edge: the defensible lower bound.
+        assert cumulative_at(b, 50.0) == pytest.approx(9.0)
+        assert cumulative_at(b, 0.01) == pytest.approx(0.6)
+
+    def test_merge_buckets(self):
+        a = [(0.1, 1.0), (math.inf, 2.0)]
+        b = [(0.1, 3.0), (math.inf, 4.0)]
+        assert merge_buckets([a, b]) == [(0.1, 4.0), (math.inf, 6.0)]
+
+
+# ---------------------------------------------------------------------
+# SLO grammar + burn-rate engine
+# ---------------------------------------------------------------------
+
+
+class TestSLOSpecGrammar:
+    def test_latency_forms(self):
+        s = SLOSpec.parse("ttft_p99<500ms@99.9")
+        assert (s.sli, s.threshold_s, s.percentile_tag) == (
+            "ttft", 0.5, "p99")
+        assert s.objective == pytest.approx(0.999)
+        assert s.budget == pytest.approx(0.001)
+        assert SLOSpec.parse("e2e<2s@95").threshold_s == 2.0
+        assert SLOSpec.parse("tpot<=50ms@99").threshold_s == 0.05
+        assert SLOSpec.parse("queue_wait<100us@90").threshold_s == (
+            pytest.approx(1e-4))
+
+    def test_availability(self):
+        s = SLOSpec.parse("availability@99.9")
+        assert s.sli == "availability" and s.threshold_s is None
+
+    @pytest.mark.parametrize("bad", [
+        "ttft@99",                 # latency without threshold
+        "availability<1ms@99",     # availability with threshold
+        "nope<1ms@99",             # unknown SLI
+        "ttft<500ms@100",          # objective must be < 100
+        "ttft<500ms@0",            # ... and > 0
+        "ttft<500ms",              # no objective
+        "",
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError):
+            SLOSpec.parse(bad)
+
+    def test_duplicate_specs_rejected(self):
+        with pytest.raises(ValueError):
+            parse_slo_specs(["availability@99", "availability@99"])
+
+
+EXEMPLAR = "00-" + "a" * 32 + "-" + "b" * 16 + "-01"
+
+
+def _engine(spec="availability@99", **kw):
+    reg = Registry()
+    rec = FlightRecorder(registry=reg)
+    eng = SLOEngine([SLOSpec.parse(spec)], registry=reg, recorder=rec,
+                    exemplar_fn=lambda s: EXEMPLAR, **kw)
+    return eng, reg, rec
+
+
+class TestBurnRateEngine:
+    def test_page_transition_and_recovery(self):
+        eng, reg, rec = _engine()
+        name = "availability@99"
+        eng.tick({name: (100, 100)}, now=0.0)
+        assert eng.state(name) == "ok"
+        # 100 bad events of 100 new: burn = 1.0/0.01 = 100 in BOTH
+        # fast windows (the 1h window anchors at the oldest snapshot).
+        eng.tick({name: (100, 200)}, now=10.0)
+        assert eng.state(name) == "page"
+        assert reg.value("shellac_slo_state", slo=name) == 2
+        assert reg.value("shellac_slo_transitions_total",
+                         slo=name, to="page") == 1
+        evs = [e for e in rec.tail(16) if e["event"] == "slo-transition"]
+        assert evs and evs[-1]["to"] == "page"
+        assert evs[-1]["from"] == "ok"
+        assert evs[-1]["exemplar"] == EXEMPLAR
+        # Good-only traffic later: the fast pair anchors past the
+        # incident and stops burning, but the SLOW pair still sees it
+        # — the workbook's de-escalation path: page -> warning.
+        eng.tick({name: (1100, 1200)}, now=3700.0)
+        eng.tick({name: (2100, 2200)}, now=4300.0)
+        assert eng.state(name) == "warning"
+        # Once the 3d window no longer covers the incident: ok.
+        eng.tick({name: (3100, 3200)}, now=400000.0)
+        assert eng.state(name) == "ok"
+        assert reg.value("shellac_slo_transitions_total",
+                         slo=name, to="ok") == 1
+
+    def test_warning_between_thresholds(self):
+        eng, reg, _ = _engine()
+        name = "availability@99"
+        eng.tick({name: (0, 0)}, now=0.0)
+        # bad_frac 0.05 → burn 5: >= 1 on the slow pair (warning),
+        # < 14.4 on the fast pair (no page).
+        eng.tick({name: (9500, 10000)}, now=10.0)
+        assert eng.state(name) == "warning"
+        assert reg.value("shellac_slo_state", slo=name) == 1
+
+    def test_counter_reset_reads_as_no_data(self):
+        eng, _, _ = _engine()
+        name = "availability@99"
+        eng.tick({name: (50, 100)}, now=0.0)
+        # A replica restart shrank the cumulative counts: clamp, don't
+        # page on negative arithmetic.
+        eng.tick({name: (10, 20)}, now=10.0)
+        assert eng.state(name) == "ok"
+
+    def test_no_traffic_no_burn(self):
+        eng, _, _ = _engine()
+        name = "availability@99"
+        eng.tick({name: (5, 5)}, now=0.0)
+        eng.tick({name: (5, 5)}, now=10.0)
+        assert eng.state(name) == "ok"
+
+    def test_status_shape(self):
+        eng, _, _ = _engine()
+        name = "availability@99"
+        eng.tick({name: (99, 100)}, now=0.0)
+        st = eng.status(now=1.0)
+        assert len(st) == 1
+        row = st[0]
+        assert row["slo"] == name and row["state"] == "ok"
+        assert set(row["windows"]) == {"5m", "1h", "6h", "3d"}
+        assert row["good_fraction"] == pytest.approx(0.99)
+
+
+# ---------------------------------------------------------------------
+# Federation: collector unit + tier LKG with fake replicas (no jax)
+# ---------------------------------------------------------------------
+
+FAKE_METRICS = """\
+# TYPE shellac_requests_total counter
+shellac_requests_total{outcome="ok"} %d
+# TYPE shellac_ttft_seconds histogram
+shellac_ttft_seconds_bucket{le="0.1"} 4
+shellac_ttft_seconds_bucket{le="+Inf"} 5
+shellac_ttft_seconds_sum 1.0
+shellac_ttft_seconds_count 5
+# TYPE shellac_pending_requests gauge
+shellac_pending_requests 2
+# TYPE shellac_kv_utilization gauge
+shellac_kv_utilization 0.5
+"""
+
+
+class TestFleetCollector:
+    def test_lkg_staleness_forget(self):
+        fc = FleetCollector(stale_after=60.0)
+        fc.observe("http://a", FAKE_METRICS % 7)
+        fc.observe("http://b", FAKE_METRICS % 3)
+        text = fc.render(routable_count=2)
+        assert 'shellac_requests_total{outcome="ok",replica="http://a"} 7' \
+            in text
+        # One family header however many replicas carry the family.
+        assert text.count("# TYPE shellac_requests_total counter") == 1
+        assert "shellac_fleet_replicas_routable 2" in text
+        assert "shellac_fleet_pending_requests 4" in text
+        assert "shellac_fleet_kv_utilization 0.5" in text
+        # Merged histogram: edge-wise sums over both replicas.
+        p = parse_prometheus_text(text)
+        assert p.buckets("shellac_fleet_ttft_seconds") == [
+            (0.1, 8.0), (math.inf, 10.0)
+        ]
+        assert 'shellac_fleet_scrape_stale{replica="http://a"} 0' in text
+
+        # Unreachable: series keep serving (LKG), staleness flips.
+        fc.mark_unreachable("http://a")
+        text = fc.render()
+        assert 'shellac_requests_total{outcome="ok",replica="http://a"} 7' \
+            in text
+        assert 'shellac_fleet_scrape_stale{replica="http://a"} 1' in text
+        # A dead replica holds no pending work.
+        assert "shellac_fleet_pending_requests 2" in text
+
+        # Fresh scrape (restarted process, reset counters): overwrites.
+        fc.observe("http://a", FAKE_METRICS % 1)
+        text = fc.render()
+        assert 'shellac_requests_total{outcome="ok",replica="http://a"} 1' \
+            in text
+        assert 'shellac_fleet_scrape_stale{replica="http://a"} 0' in text
+
+        fc.forget("http://a")
+        assert 'replica="http://a"' not in fc.render()
+
+    def test_skip_families_suppresses_header_not_samples(self):
+        fc = FleetCollector()
+        fc.observe("http://a", FAKE_METRICS % 2)
+        text = fc.render(
+            skip_families=frozenset({"shellac_requests_total"}))
+        assert "# TYPE shellac_requests_total counter" not in text
+        assert 'shellac_requests_total{outcome="ok",replica="http://a"}' \
+            in text
+
+
+class _FakeReplica:
+    """A metrics/health-only fake replica (no engine): lets the tier
+    LKG/staleness path run without jax, and can die and revive on the
+    SAME port (allow_reuse_address) like a restarted process."""
+
+    def __init__(self, port=0, ok_count=5):
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/health":
+                    body = json.dumps(
+                        {"status": "ok", "pending": 0}).encode()
+                elif self.path == "/metrics":
+                    body = (FAKE_METRICS % fake.ok_count).encode()
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.ok_count = ok_count
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+class TestTierFederationLKG:
+    def test_dead_replica_serves_lkg_until_revival(self):
+        rep = _FakeReplica(ok_count=9)
+        other = _FakeReplica(ok_count=1)
+        router = TierRouter(
+            [rep.url, other.url], registry=Registry(),
+            health_interval=0.05, health_timeout=1.0,
+            breaker_cooldown=0.2, stale_after=0.5,
+        )
+        try:
+            wait_until(lambda: all(r.state == "healthy"
+                                   for r in router.replicas),
+                       msg="fakes healthy")
+            wait_until(lambda: 'replica="' + rep.url + '"'
+                       in router.metrics_text(), msg="federated")
+            p = parse_prometheus_text(router.metrics_text())
+            assert p.value("shellac_requests_total",
+                           replica=rep.url, outcome="ok") == 9
+
+            rep.close()  # process death: scrapes start failing
+            wait_until(
+                lambda: [r for r in router.replicas
+                         if r.url == rep.url][0].state == "ejected",
+                msg="dead fake ejected")
+            wait_until(
+                lambda: parse_prometheus_text(router.metrics_text())
+                .value("shellac_fleet_scrape_stale",
+                       replica=rep.url) == 1,
+                msg="staleness stamped")
+            p = parse_prometheus_text(router.metrics_text())
+            # Last-known-good: the dead replica's final numbers stay
+            # visible, stamped stale with a rising age.
+            assert p.value("shellac_requests_total",
+                           replica=rep.url, outcome="ok") == 9
+            assert p.value("shellac_fleet_scrape_age_seconds",
+                           replica=rep.url) > 0
+
+            # Revival on the SAME port with reset counters: the
+            # half-open probe readmits it and fresh series replace LKG.
+            revived = _FakeReplica(port=rep.port, ok_count=2)
+            try:
+                wait_until(
+                    lambda: [r for r in router.replicas
+                             if r.url == rep.url][0].state == "healthy",
+                    msg="revived fake readmitted")
+                wait_until(
+                    lambda: parse_prometheus_text(router.metrics_text())
+                    .value("shellac_requests_total",
+                           replica=rep.url, outcome="ok") == 2,
+                    msg="fresh series after revival")
+                p = parse_prometheus_text(router.metrics_text())
+                assert p.value("shellac_fleet_scrape_stale",
+                               replica=rep.url) == 0
+            finally:
+                revived.close()
+        finally:
+            router.close()
+            other.close()
+
+
+# ---------------------------------------------------------------------
+# Step-phase attribution (tiny real engine)
+# ---------------------------------------------------------------------
+
+
+def _tiny():
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = _tiny()
+    return cfg, transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+
+@pytest.mark.slow
+class TestStepPhaseAttribution:
+    """Marked slow (like the other engine-backed conformance suites):
+    tier-1's 870s wall-clock window is dot-count-bound, and these
+    build real engines; the fleet-obs CI job runs them unfiltered."""
+
+    @pytest.mark.parametrize("overlap", [False, True])
+    def test_phases_observed_and_partition_step(self, tiny_model,
+                                                overlap):
+        cfg, params = tiny_model
+        reg = Registry()
+        eng = BatchingEngine(
+            cfg, params, n_slots=2, max_len=64, temperature=0.0,
+            registry=reg, overlap_decode=overlap,
+        )
+        for i in range(3):
+            eng.submit(i, [1 + i, 2, 3], max_new=4)
+        while eng.pending:
+            eng.step()
+        for phase in STEP_PHASES:
+            h = reg.get("shellac_step_phase_seconds", phase=phase)
+            assert h is not None and h.count > 0, phase
+        # The phases that must have real mass in any serving run.
+        for phase in ("prefill_dispatch", "decode_sync"):
+            assert reg.get("shellac_step_phase_seconds",
+                           phase=phase).sum > 0, phase
+        # Flush any window still in flight at drain time (overlap
+        # leaves one; settling it is real work and is observed).
+        for _ in range(2):
+            eng.step()
+        # Idle steps are not observed: counts stay put while the
+        # engine polls an empty queue.
+        before = reg.get("shellac_step_phase_seconds",
+                         phase="admission").count
+        for _ in range(5):
+            eng.step()
+        assert reg.get("shellac_step_phase_seconds",
+                       phase="admission").count == before
+
+
+# ---------------------------------------------------------------------
+# Live two-replica fleet
+# ---------------------------------------------------------------------
+
+
+class _LocalReplica:
+    """In-process replica: a real tiny engine behind a real HTTP
+    server, with its own registry so per-replica /metrics stay
+    distinct inside one test process."""
+
+    def __init__(self, cfg, params, **srv_kw):
+        self.registry = Registry()
+        self.srv = InferenceServer(
+            cfg, params, registry=self.registry, n_slots=2, max_len=64,
+            temperature=0.0, **srv_kw,
+        )
+        self.httpd = make_http_server(self.srv)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.srv.close()
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read()), dict(r.headers)
+
+
+@pytest.fixture(scope="module")
+def fleet(tiny_model):
+    cfg, params = tiny_model
+    reps = [_LocalReplica(cfg, params) for _ in range(2)]
+    for rep in reps:
+        _post(rep.url + "/generate",
+              {"tokens": [1, 2, 3], "max_new": 2, "timeout": 300})
+    yield reps
+    for rep in reps:
+        rep.close()
+
+
+@pytest.fixture(scope="module")
+def tier(fleet):
+    router = TierRouter(
+        [r.url for r in fleet], registry=Registry(),
+        health_interval=0.1, backoff_base=0.02, stale_after=5.0,
+        # Pin affinity hard (the chaos-test pattern): a cold-compile
+        # TTFT outlier would otherwise make load-aware spill unroute
+        # the session keys these tests pin per replica.
+        affinity_tolerance=4000.0,
+    )
+    httpd = make_tier_http_server(router)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    wait_until(lambda: all(r.state == "healthy"
+                           for r in router.replicas),
+               msg="fleet healthy")
+    yield router, base, fleet
+    httpd.shutdown()
+    router.close()
+
+
+def _session_for(url, urls):
+    """A session key whose rendezvous hash pins traffic onto `url`."""
+    return next(
+        f"k{i}" for i in range(1000)
+        if max(urls, key=lambda u: TierRouter._rendezvous(
+            f"s:k{i}", u.rstrip("/"))) == url
+    )
+
+
+@pytest.mark.slow
+class TestLiveFleet:
+    """Marked slow for the same reason as TestStepPhaseAttribution:
+    two live engines + a tier; the fleet-obs CI job runs it."""
+
+    def test_federated_metrics_with_step_phases(self, tier):
+        router, base, fleet = tier
+        urls = [r.url for r in fleet]
+        # Traffic pinned to EACH replica so both expose live series.
+        for u in urls:
+            sess = _session_for(u, urls)
+            for i in range(2):
+                out, _ = _post(base + "/generate",
+                               {"tokens": [1 + i, 2, 3], "max_new": 3,
+                                "session": sess, "timeout": 120})
+                assert out["tokens"]
+
+        def federated():
+            p = parse_prometheus_text(router.metrics_text())
+            return all(
+                (p.value("shellac_requests_total",
+                         replica=u, outcome="ok") or 0) >= 2
+                for u in urls
+            )
+
+        wait_until(federated, msg="both replicas federated")
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        p = parse_prometheus_text(text)
+        for u in urls:
+            # Replica-labeled series on the TIER's exposition.
+            assert p.value("shellac_fleet_scrape_stale",
+                           replica=u) == 0
+            assert p.buckets("shellac_ttft_seconds", replica=u)
+            # Step-phase attribution flows through federation.
+            assert (p.value("shellac_step_phase_seconds_count",
+                            replica=u, phase="decode_sync") or 0) > 0
+        # Fleet aggregates: merged TTFT histogram counts both replicas.
+        fleet_b = p.buckets("shellac_fleet_ttft_seconds")
+        assert fleet_b and fleet_b[-1][1] >= 4
+        # The exposition stays format-sane: one TYPE header per family.
+        assert text.count("# TYPE shellac_ttft_seconds histogram") == 1
+
+    def test_top_once_renders_fleet(self, tier):
+        router, base, fleet = tier
+        buf = io.StringIO()
+        assert run_top(base, once=True, out=buf) == 0
+        text = buf.getvalue()
+        assert "shellac top" in text
+        assert "2/2 routable" in text
+        for rep in fleet:
+            assert rep.url.replace("http://", "")[-20:] in text
+        # Per-replica rows render a non-zero step-phase attribution.
+        assert "step-time attribution" in text
+        assert any(
+            f"{tag} " in text for tag in ("sync", "pf")
+        )
+        snap = collect(base)
+        rendered = render(snap)
+        assert "p99" in rendered or "fleet p99" in rendered
+
+    def test_top_trace_drilldown(self, tier):
+        router, base, _ = tier
+        out, headers = _post(base + "/generate",
+                             {"tokens": [9, 9], "max_new": 2,
+                              "timeout": 120})
+        tid = headers.get("x-request-id")
+        assert tid
+        buf = io.StringIO()
+        assert run_top(base, trace=tid, out=buf) == 0
+        text = buf.getvalue()
+        assert tid in text and "tier-attempt" in text
+        # Unknown trace: graceful non-zero exit.
+        buf = io.StringIO()
+        assert run_top(base, trace="00-" + "0" * 32 + "-" + "0" * 16
+                       + "-01", out=buf) == 1
+
+    def test_error_responses_carry_request_id(self, tier):
+        router, base, fleet = tier
+        # Tier: malformed JSON 400, unknown route 404.
+        for url, data in ((base + "/generate", b"{nope"),
+                          (base + "/nowhere", b"{}")):
+            req = urllib.request.Request(
+                url, data=data,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.headers.get("x-request-id"), url
+        # Replica server: unknown POST route and GET debug miss.
+        rep = fleet[0]
+        req = urllib.request.Request(rep.url + "/nowhere", data=b"{}")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 404
+        assert e.value.headers.get("x-request-id")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                rep.url + "/debug/request/unknown-id", timeout=10)
+        assert e.value.code == 404
+        assert e.value.headers.get("x-request-id")
+
+    def test_slowed_replica_drives_slo_page_with_exemplar(self, fleet):
+        from shellac_tpu.inference.autotune import SimulatedHostLatency
+
+        a, b = fleet
+        urls = [a.url, b.url]
+        # Deliberately slow replica B's decode windows (the simulated-
+        # RPC shim PR 7 built): its requests blow the e2e objective.
+        shim = SimulatedHostLatency(b.srv.engine, device_s=0.4)
+        router = TierRouter(
+            urls, registry=Registry(), health_interval=0.1,
+            slos=["e2e<250ms@99", "availability@90"],
+            # Affinity pinned hard so traffic deterministically lands
+            # on the deliberately slowed replica (chaos-test pattern).
+            affinity_tolerance=4000.0,
+        )
+        try:
+            wait_until(lambda: all(r.state == "healthy"
+                                   for r in router.replicas),
+                       msg="fleet healthy")
+            sess = _session_for(b.url, urls)
+            for i in range(4):
+                status, body, _ = router.forward_json(
+                    "/generate",
+                    {"tokens": [2 + i, 3], "max_new": 2,
+                     "session": sess, "timeout": 120},
+                )
+                assert status == 200, body
+            wait_until(
+                lambda: router._slo.state("e2e<250ms@99") == "page",
+                timeout=30, msg="burn-rate page on the slowed replica")
+            # Availability stayed clean: every request succeeded.
+            assert router._slo.state("availability@90") == "ok"
+            # The transition landed in the flight recorder with a
+            # violating request's trace id as exemplar...
+            evs = [e for e in router.recorder.tail(512)
+                   if e["event"] == "slo-transition"
+                   and e.get("to") == "page"]
+            assert evs, "no slo-transition event recorded"
+            exemplar = evs[-1].get("exemplar")
+            assert exemplar, evs[-1]
+            # ... and the exemplar resolves to a real tier timeline.
+            timeline = router.debug_request(exemplar)
+            assert timeline is not None
+            assert any(e["event"] == "tier-attempt"
+                       for e in timeline["events"])
+            # Gauges + /slo agree.
+            assert router._registry.value(
+                "shellac_slo_state", slo="e2e<250ms@99") == 2
+            status = router.slo_status()
+            row = next(s for s in status["slos"]
+                       if s["slo"] == "e2e<250ms@99")
+            assert row["state"] == "page"
+            assert row["windows"]["5m"]["burn_rate"] > 14.4
+        finally:
+            shim.uninstall()
+            router.close()

@@ -433,6 +433,113 @@ class TestKillReplicaAcceptance:
                 p.terminate()
 
 
+class TestFederationUnderChaos:
+    """ISSUE 11's federation chaos scenario: SIGKILL a replica while
+    the tier is scraping it every poll — its federated series must
+    keep serving last-known-good with a staleness stamp, and a
+    revival on the SAME port must readmit it with FRESH (reset)
+    series replacing the LKG."""
+
+    @pytest.fixture(scope="class")
+    def config_path(self, tmp_path_factory):
+        p = tmp_path_factory.mktemp("fedchaos") / "tiny_f32.json"
+        p.write_text(json.dumps({"preset": "tiny", "dtype": "float32"}))
+        return str(p)
+
+    def test_sigkill_keeps_lkg_then_fresh_series_on_revival(
+            self, config_path):
+        from shellac_tpu.obs import parse_prometheus_text
+
+        procs = [
+            ReplicaProc(config_path=config_path, seed=i, slots=2,
+                        max_len=96)
+            for i in range(2)
+        ]
+        router = None
+        revived = None
+        try:
+            for p in procs:
+                p.wait_ready(timeout=180)
+            for p in procs:
+                _post(p.url + "/generate",
+                      {"tokens": [1, 2, 3], "max_new": 2,
+                       "timeout": 300}, timeout=300)
+            router = TierRouter(
+                [p.url for p in procs], registry=Registry(),
+                health_interval=0.2, health_timeout=2.0,
+                breaker_cooldown=1.0, default_timeout=60.0,
+                stale_after=1.0,
+            )
+            wait_until(lambda: all(x.state == "healthy"
+                                   for x in router.replicas),
+                       timeout=60, msg="replicas healthy")
+            victim = procs[1]
+            port = victim.url.rsplit(":", 1)[1]
+
+            # Traffic through the router so the victim's counters are
+            # non-trivial, then wait for its series to federate.
+            for i in range(4):
+                status, body, _ = router.forward_json(
+                    "/generate", {"tokens": [1 + i, 2], "max_new": 2,
+                                  "timeout": 60})
+                assert status == 200, body
+
+            def fed_ok(url):
+                p = parse_prometheus_text(router.metrics_text())
+                return p.value("shellac_requests_total",
+                               replica=url, outcome="ok")
+
+            wait_until(lambda: (fed_ok(victim.url) or 0) >= 1,
+                       timeout=30, msg="victim series federated")
+            lkg_ok = fed_ok(victim.url)
+
+            victim.kill()  # SIGKILL mid-scrape: no drain, no goodbye
+            wait_until(
+                lambda: [x for x in router.replicas
+                         if x.url == victim.url][0].state == "ejected",
+                timeout=30, msg="dead replica ejected")
+            wait_until(
+                lambda: parse_prometheus_text(router.metrics_text())
+                .value("shellac_fleet_scrape_stale",
+                       replica=victim.url) == 1,
+                timeout=30, msg="staleness stamped")
+            parsed = parse_prometheus_text(router.metrics_text())
+            # Last-known-good: the dead replica's FINAL numbers stay
+            # visible on the tier's exposition.
+            assert fed_ok(victim.url) == lkg_ok
+            assert parsed.value("shellac_fleet_scrape_age_seconds",
+                                replica=victim.url) > 0
+
+            # Revive on the SAME port (argparse: last --port wins):
+            # a restarted process with reset counters.
+            revived = ReplicaProc(config_path=config_path, seed=7,
+                                  slots=2, max_len=96,
+                                  extra_args=["--port", port])
+            revived.wait_ready(timeout=180)
+            wait_until(
+                lambda: [x for x in router.replicas
+                         if x.url == victim.url][0].state == "healthy",
+                timeout=60, msg="revived replica readmitted")
+            # Readmission resumes FRESH series: the reset counters
+            # replace the LKG snapshot (no requests settled yet, so
+            # the ok series is absent or below the LKG value).
+            wait_until(
+                lambda: (fed_ok(victim.url) or 0) < lkg_ok,
+                timeout=30, msg="fresh series replaced LKG")
+            wait_until(
+                lambda: parse_prometheus_text(router.metrics_text())
+                .value("shellac_fleet_scrape_stale",
+                       replica=victim.url) == 0,
+                timeout=30, msg="staleness cleared")
+        finally:
+            if router is not None:
+                router.close()
+            for p in procs:
+                p.terminate()
+            if revived is not None:
+                revived.terminate()
+
+
 # The subprocess scenario needs a POSIX SIGKILL; everything above it
 # runs anywhere the stdlib HTTP stack does.
 pytestmark = pytest.mark.skipif(
