@@ -1061,6 +1061,7 @@ def cmd_serve(args):
         debug=args.debug,
         debug_include_text=args.debug_include_text,
         profile_dir=args.profile_dir,
+        role=args.role,
     )
     return 0
 
@@ -1120,6 +1121,9 @@ def cmd_serve_tier(args):
         federate=args.federate,
         stale_after=args.stale_after,
         slos=_load_slos(args),
+        disagg=args.disagg,
+        kv_bandwidth=args.kv_bandwidth,
+        disagg_min_prompt=args.disagg_min_prompt,
     )
     serve_tier(router, host=args.host, port=args.port)
     return 0
@@ -1424,6 +1428,16 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max-len", type=int, default=None, dest="max_len")
     s.add_argument("--temperature", type=float, default=0.0)
     s.add_argument("--eos-id", type=int, default=None, dest="eos_id")
+    s.add_argument("--role", choices=["monolith", "prefill", "decode"],
+                   default="monolith",
+                   help="disaggregated-serving role, reflected in "
+                        "/health, /stats, /metrics "
+                        "(shellac_engine_role_info) and `top`: the "
+                        "tier pairs prefill replicas (run the prompt, "
+                        "export KV) with decode replicas (import KV, "
+                        "stream tokens). Advisory — every role still "
+                        "serves the full API, so monolithic fallback "
+                        "always has a target (docs/serving_tier.md)")
     s.add_argument("--cache-backend", default=None, dest="cache_backend",
                    choices=["dense", "dense-int8", "paged", "paged-int8",
                             "rolling", "rolling-int8"],
@@ -1645,6 +1659,29 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--slo-file", default=None, dest="slo_file",
                     help="JSON file with SLO specs: a list of spec "
                          'strings, or {"slos": [...]}')
+    st.add_argument("--disagg", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="disaggregated prefill/decode routing: pair "
+                         "a prefill-role replica (runs the prompt, "
+                         "exports KV) with a decode-role replica "
+                         "(imports KV, streams tokens) per request, "
+                         "falling back to monolithic serving when no "
+                         "pair exists, the request uses a feature "
+                         "that does not migrate, or the estimated "
+                         "transfer cost exceeds the measured prefill "
+                         "interference. Inert on a fleet without "
+                         "role-labeled replicas (serve --role)")
+    st.add_argument("--kv-bandwidth", type=float, default=1e9,
+                    dest="kv_bandwidth",
+                    help="assumed replica-to-replica transfer "
+                         "bandwidth in bytes/s for the migration "
+                         "cost estimate (est prompt tokens x the "
+                         "replica-reported kv_bytes_per_token / this)")
+    st.add_argument("--disagg-min-prompt", type=int, default=64,
+                    dest="disagg_min_prompt",
+                    help="prompts estimated shorter than this many "
+                         "tokens always serve monolithically (their "
+                         "prefill is cheaper than any migration)")
     st.set_defaults(fn=cmd_serve_tier)
 
     tp = sub.add_parser(

@@ -77,6 +77,11 @@ class _Request:
     # ([ids], [logprobs]) pairs (engines built with top_logprobs > 0).
     tlp: Optional[List] = None
     seed: Optional[int] = None
+    # Disaggregated serving: run the prompt, sample the first token,
+    # then FREEZE the slot instead of decoding — the KV-migration
+    # exporter (inference/disagg.py) ships the slot to a decode
+    # replica and releases it. Frozen slots never join decode windows.
+    prefill_only: bool = False
     # Additive per-token logit biases applied before sampling (OpenAI
     # semantics); logprobs still report the raw distribution.
     logit_bias: Optional[Dict[int, float]] = None
@@ -455,6 +460,10 @@ class BatchingEngine:
         self._cur = jnp.zeros((n_slots,), jnp.int32)  # next input token
         self._queue: deque[_Request] = deque()
         self._slots: List[Optional[_Request]] = [None] * n_slots
+        # Prefill-only requests whose prompt KV is resident and frozen,
+        # awaiting export (rid -> slot). The serving scheduler drains
+        # this after each step: export_slot -> release_frozen.
+        self.frozen_prefills: Dict[Any, int] = {}
         self._prefill_jit: Dict[int, Any] = {}  # bucketed by padded S
         # Lazily built single-request Engine sharing these params:
         # the dense beam_search() entry point (the paged subclass
@@ -492,6 +501,13 @@ class BatchingEngine:
             # so the /metrics stat mirror skips it; the server exposes
             # it as the shellac_engine_cache_backend_info gauge label.
             "cache_backend": self.cache_backend.name,
+            # Disaggregated serving: migration legs served by this
+            # engine, plus the backend's resident bytes per KV token —
+            # the tier's transfer-cost estimate reads the mirrored
+            # shellac_engine_kv_bytes_per_token gauge.
+            "kv_exports": 0,
+            "kv_imports": 0,
+            "kv_bytes_per_token": self.cache_backend.bytes_per_token(),
         }
         self.stats.update(self.cache_backend.initial_stats())
         # How decode_ticks was chosen: "fixed" (explicit int) or
@@ -1012,7 +1028,8 @@ class BatchingEngine:
                min_p=None, min_tokens=None, logit_bias=None,
                presence_penalty=None, frequency_penalty=None,
                prompt_logprobs=False, seed=None,
-               constraint=None, trace=None) -> None:
+               constraint=None, trace=None,
+               prefill_only: bool = False) -> None:
         """Queue a request. `stop`: optional list of token-id sequences;
         generation ends when the output ends with any of them, and the
         matched sequence is removed from the returned tokens.
@@ -1119,12 +1136,21 @@ class BatchingEngine:
                     "with constraint (the EOS ban can contradict a "
                     "state where only EOS is legal)"
                 )
+        if prefill_only and constraint is not None:
+            # A compiled TokenDFA is device-table state the wire
+            # format cannot ship; constrained requests serve
+            # monolithically (the tier's feature fallback).
+            raise ValueError(
+                f"request {rid!r}: prefill_only does not compose with "
+                "constraint (the DFA table does not migrate)"
+            )
         self._queue.append(_Request(
             rid, tokens, max_new, stop=stop, min_tokens=min_tokens,
             logit_bias=logit_bias, presence_penalty=pres,
             frequency_penalty=freq,
             prompt_logprobs=bool(prompt_logprobs), seed=seed,
-            constraint=constraint, trace=trace, **samp,
+            constraint=constraint, trace=trace,
+            prefill_only=bool(prefill_only), **samp,
         ))
         if trace is not None:
             # Flight-recorder timeline: the request entered the
@@ -1401,6 +1427,17 @@ class BatchingEngine:
             req.tlp = [(np.asarray(tli)[0].tolist(),
                         np.asarray(tlv)[0].tolist())]
         self.stats["prefills"] += 1
+        if req.prefill_only:
+            # Disaggregated freeze: the device-side done flag (PR 7's
+            # freeze mechanism) plus host-side exclusion keep the slot
+            # out of every decode window; the KV-migration exporter
+            # ships it and release_frozen() reclaims the slot.
+            self._sdone = self._sdone.at[slot].set(True)
+            self.frozen_prefills[req.rid] = slot
+            if req.trace is not None:
+                req.trace.record("prefill-frozen", src="engine",
+                                 rid=req.rid, slot=slot,
+                                 prompt_len=int(req.tokens.size))
 
     # ---- chunked prefill --------------------------------------------
 
@@ -1526,8 +1563,11 @@ class BatchingEngine:
 
     def _finish_check(self, finished):
         for i, req in enumerate(self._slots):
-            if req is None or not req.out:
-                # Slots mid-chunked-prefill have no output yet.
+            if req is None or not req.out or req.prefill_only:
+                # Slots mid-chunked-prefill have no output yet; frozen
+                # prefill-only slots settle through the export path
+                # (even when the prefill token alone completes them —
+                # the blob carries the completion).
                 continue
             last = req.out[-1]
             nstop = req.hit_stop()
@@ -1711,9 +1751,10 @@ class BatchingEngine:
 
     def _active_rows(self) -> List[bool]:
         """Slots a decode window should advance right now (occupied,
-        not mid-chunked-prefill)."""
+        not mid-chunked-prefill, not frozen awaiting migration)."""
         return [
             r is not None and i not in self._prefilling
+            and not r.prefill_only
             for i, r in enumerate(self._slots)
         ]
 
@@ -1928,6 +1969,20 @@ class BatchingEngine:
         self.cache_backend.pre_window(active_rows, advance,
                                       self._window_write_span())
 
+    def release_frozen(self, rid) -> Optional[_Request]:
+        """Release a frozen prefill-only slot after its export (caller
+        must be the engine-owning thread — the same thread that froze
+        it). Returns the request, or None for an unknown rid. Device
+        rows need no repair: stale rows are self-healing, exactly as
+        on cancel."""
+        slot = self.frozen_prefills.pop(rid, None)
+        if slot is None:
+            return None
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self._release_slot(slot)
+        return req
+
     def cancel(self, rid) -> bool:
         """Drop a queued or in-flight request (caller must be the
         engine-owning thread). Frees its slot immediately; device
@@ -1936,6 +1991,7 @@ class BatchingEngine:
             if req is not None and req.rid == rid:
                 self._slots[i] = None
                 self._prefilling.pop(i, None)
+                self.frozen_prefills.pop(rid, None)
                 self._release_slot(i)
                 self.finished_logprobs.pop(rid, None)
                 self.finished_prompt_logprobs.pop(rid, None)
@@ -1984,6 +2040,7 @@ class BatchingEngine:
             self._slots[i] = None
             self._release_slot(i)
         self._prefilling.clear()
+        self.frozen_prefills.clear()
         self.finished_logprobs.clear()
         self.finished_prompt_logprobs.clear()
         self.finished_top_logprobs.clear()

@@ -142,6 +142,23 @@ class CacheBackend:
         this is the storage view only."""
         raise NotImplementedError
 
+    def bytes_per_token(self) -> int:
+        """Resident KV bytes one token costs under this storage policy
+        (per-slot view; paged block rounding ignored). Exposed as the
+        shellac_engine_kv_bytes_per_token gauge — the tier's
+        KV-migration transfer-cost estimate reads it, so the cost
+        model tracks the backend (int8 halves it) instead of guessing
+        from the model name."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        width = cfg.cache_head_dim + cfg.cache_v_head_dim
+        if self.kv_quant == "int8":
+            # int8 values + one fp32 scale per token/head for k and v.
+            return cfg.n_layers * cfg.cache_kv_heads * (width + 2 * 4)
+        itemsize = jnp.dtype(cfg.compute_dtype).itemsize
+        return cfg.n_layers * cfg.cache_kv_heads * width * itemsize
+
     # ---- shared helpers ---------------------------------------------
 
     def _slot_tokens(self) -> List[int]:

@@ -68,6 +68,7 @@ API:
 
 from __future__ import annotations
 
+import concurrent.futures
 import functools
 import itertools
 import json
@@ -77,6 +78,7 @@ import random
 import threading
 import time
 import urllib.parse
+import urllib.request
 from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -84,7 +86,9 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference import disagg
 from shellac_tpu.inference.batching import BatchingEngine
+from shellac_tpu.inference.cache import PoolExhausted
 from shellac_tpu.obs import (
     REQUEST_ID_HEADER,
     TRACE_HEADER,
@@ -92,10 +96,18 @@ from shellac_tpu.obs import (
     Registry,
     ServeMetrics,
     adopt_trace,
+    format_trace_header,
     get_registry,
     new_trace_id,
 )
 from shellac_tpu.utils.failure import Heartbeat, RestartBudget
+
+#: Replica roles for disaggregated serving. The role is ADVISORY for
+#: the tier's pair scheduler — any role still serves the full API, so
+#: monolithic fallback always has somewhere to land — but it is
+#: surfaced everywhere (/health, /stats, /metrics, `top`) so routing
+#: decisions are inspectable.
+ROLES = ("monolith", "prefill", "decode")
 
 
 def _render_plp(plp):
@@ -165,6 +177,29 @@ class _Generation:
         self.dead = False
 
 
+class _ImportAck:
+    """Cross-thread ack for one POST /kv/import: the handler thread
+    blocks on `event` while the scheduler (the engine-owning thread)
+    performs the import."""
+
+    __slots__ = ("event", "slot", "error", "retryable")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.slot: Optional[int] = None
+        self.error: Optional[str] = None
+        self.retryable = False
+
+    def ok(self, slot: int) -> None:
+        self.slot = slot
+        self.event.set()
+
+    def fail(self, msg: str, retryable: bool) -> None:
+        self.error = msg
+        self.retryable = retryable
+        self.event.set()
+
+
 class _Pending:
     __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback",
                  "lps", "plp", "tlp", "rid", "deadline", "kind", "trace")
@@ -230,8 +265,17 @@ class InferenceServer:
         debug_include_text: bool = False,
         profile_dir: Optional[str] = None,
         recorder: Optional[FlightRecorder] = None,
+        role: str = "monolith",
+        adopt_ttl: float = 120.0,
         **engine_kw,
     ):
+        if role not in ROLES:
+            raise ValueError(f"role={role!r}; have {ROLES}")
+        #: Disaggregated-serving role (serve --role). Advisory: the
+        #: tier pairs prefill/decode replicas by it; the full API
+        #: stays served whatever the role, so monolithic fallback and
+        #: mixed fleets always work.
+        self.role = role
         # Observability: every span/counter lands in `registry` — the
         # process-global default unless the caller isolates one.
         # metrics=False swaps in a disabled registry (all writes no-op,
@@ -327,6 +371,19 @@ class InferenceServer:
         # reset — a stale True only costs the scan, a wrong False
         # would stop shedding.
         self._saw_deadline = False
+        # KV migration (disaggregated serving). Prefill side: rid ->
+        # decode-replica URL for in-flight prefill_only requests (the
+        # scheduler exports the frozen slot and a push worker ships
+        # it). Decode side: migration id -> (_Pending, import time) —
+        # imported requests decode immediately and the adopt request
+        # attaches to the pending; unadopted entries expire after
+        # adopt_ttl so an abandoned migration cannot pin results
+        # forever.
+        self._migrate_targets: Dict[int, str] = {}
+        self._adoptions: Dict[str, Tuple[_Pending, float]] = {}
+        self._adopt_ttl = float(adopt_ttl)
+        self._push_pool: Optional[
+            concurrent.futures.ThreadPoolExecutor] = None
         # Startup auto-tune (serve --decode-ticks auto, the CLI
         # default): sweep decode_ticks against the live engine BEFORE
         # the scheduler thread exists (the engine is single-owner
@@ -390,6 +447,7 @@ class InferenceServer:
         info: Dict[str, Any] = {
             "status": self.status,
             "ok": self.status == "ok",
+            "role": self.role,
             "pending": len(self._pending),
             "queue_depth": g.submit_q.qsize(),
             "engine_pending": g.engine.pending,
@@ -456,6 +514,7 @@ class InferenceServer:
         m.cache_backend_info.labels(
             backend=str(g.engine.stats.get("cache_backend", "dense"))
         ).set(1)
+        m.role_info.labels(role=self.role).set(1)
         m.generation.set(g.gen)
         m.uptime.set(self.uptime_s)
         m.pending.set(len(self._pending))
@@ -626,6 +685,10 @@ class InferenceServer:
             if p.trace is not None:
                 p.trace.abort("fault")
             p.finish()
+        # Every pending just failed; no prefill_only request can reach
+        # the export path anymore, so their targets must not outlive
+        # them (rids are never reused — a leak would be permanent).
+        self._migrate_targets.clear()
         while True:
             try:
                 self._g.submit_q.get_nowait()
@@ -784,6 +847,9 @@ class InferenceServer:
         the accounting and message cannot drift)."""
         if self._pending.pop(rid, None) is None:
             return
+        # A shed prefill_only request never reaches the export path:
+        # drop its migration target too.
+        self._migrate_targets.pop(rid, None)
         self.shed += 1
         if p.trace is not None:
             p.trace.shed()
@@ -818,6 +884,7 @@ class InferenceServer:
             # Cancellation marker: drop queued/in-flight work for an
             # abandoned client request.
             g.engine.cancel(rid)
+            self._migrate_targets.pop(rid, None)
             p = self._pending.pop(rid, None)
             if p is not None:
                 p.error = "cancelled"
@@ -839,11 +906,25 @@ class InferenceServer:
             # multiplex.
             self._run_beam(g, rid, tokens, max_new, samp["_beam"])
             return
+        if samp and "_kv_import" in samp:
+            # KV adoption (decode replica): imported on the scheduler
+            # thread — the only thread allowed to touch the engine.
+            self._import_item(g, rid, *samp["_kv_import"])
+            return
+        extra = {}
+        if samp and "_migrate" in samp:
+            # Prefill-only admission (prefill replica): the engine
+            # freezes the slot at prefill; _service_frozen exports it
+            # and the push worker ships it to the decode target.
+            samp = dict(samp)
+            self._migrate_targets[rid] = samp.pop("_migrate")
+            extra["prefill_only"] = True
         pend = self._pending.get(rid)
         try:
             g.engine.submit(
                 rid, tokens, max_new, stop=stop,
-                trace=pend.trace if pend is not None else None, **samp,
+                trace=pend.trace if pend is not None else None,
+                **extra, **samp,
             )
         except (ValueError, TypeError) as e:
             # TypeError: unknown sampling kwarg from a programmatic
@@ -851,6 +932,7 @@ class InferenceServer:
             # The pending may already be gone: close()'s sweep can
             # clear _pending while this thread is still draining its
             # last backlog items.
+            self._migrate_targets.pop(rid, None)
             p = self._pending.pop(rid, None)
             if p is not None:
                 p.error = str(e)
@@ -907,6 +989,187 @@ class InferenceServer:
         p.result = {"beams": seqs, "scores": scores}
         p.finish()
 
+    # ---- KV migration (disaggregated serving) -----------------------
+
+    def _import_item(self, g: _Generation, rid, blob, ack,
+                     tid) -> None:
+        """Adopt one migrated request into the engine (scheduler
+        thread). Failures settle the pending AND the handler's ack —
+        PoolExhausted is the retryable class (fresh pair can serve),
+        a refused blob (wrong backend/geometry) is a 400. `tid` is
+        the migration id import_kv REGISTERED (minted when the blob
+        carried none), so failure cleanup always finds the adoption
+        entry."""
+        pend = self._pending.get(rid)
+        try:
+            slot = disagg.import_blob(
+                g.engine, blob, rid,
+                trace=pend.trace if pend is not None else None,
+            )
+        except PoolExhausted:
+            self._fail_import(rid, tid, ack, retryable=True,
+                              msg="decode replica has no free slot or "
+                                  "pool capacity; retry elsewhere")
+            return
+        except (ValueError, TypeError) as e:
+            self._fail_import(rid, tid, ack, retryable=False, msg=str(e))
+            return
+        except Exception as e:  # noqa: BLE001 — request-scoped fault
+            self._fail_import(
+                rid, tid, ack, retryable=True,
+                msg=f"kv import failed: {type(e).__name__}: {e}",
+            )
+            return
+        self._m.migrations.labels(outcome="import").inc()
+        ack.ok(slot)
+
+    def _fail_import(self, rid, tid, ack, *, retryable: bool,
+                     msg: str) -> None:
+        self._m.migrations.labels(outcome="import_failed").inc()
+        if tid is not None:
+            self._adoptions.pop(tid, None)
+        p = self._pending.pop(rid, None)
+        if p is not None:
+            p.error = msg
+            if p.trace is not None:
+                p.trace.abort("error")
+            p.finish()
+        ack.fail(msg, retryable)
+
+    def _service_frozen(self, g: _Generation) -> None:
+        """Prefill-side migration driver, run on the scheduler thread
+        after each step: export every newly frozen prefill-only slot
+        (one batched device pull each), release the slot immediately
+        (the host copy exists), and hand the blob to a push worker —
+        the HTTP leg must never block the engine."""
+        eng = g.engine
+        if not getattr(eng, "frozen_prefills", None):
+            return
+        for rid in list(eng.frozen_prefills):
+            slot = eng.frozen_prefills[rid]
+            req = eng._slots[slot]
+            target = self._migrate_targets.pop(rid, None)
+            p = self._pending.get(rid)
+            tid = (p.trace.trace_id
+                   if p is not None and p.trace is not None else None)
+            try:
+                if target is None:
+                    raise ValueError(
+                        "prefill_only request lost its migrate_to "
+                        "target"
+                    )
+                blob = disagg.export_slot(eng, slot, req, trace_id=tid)
+            except Exception as e:  # noqa: BLE001 — request-scoped fault
+                eng.release_frozen(rid)
+                self._m.migrations.labels(outcome="export_failed").inc()
+                pp = self._pending.pop(rid, None)
+                if pp is not None:
+                    pp.error = (f"kv export failed: "
+                                f"{type(e).__name__}: {e}")
+                    pp.kind = "fault"
+                    if pp.trace is not None:
+                        pp.trace.abort("fault")
+                    pp.finish()
+                continue
+            eng.release_frozen(rid)
+            eng.stats["kv_exports"] += 1
+            if p is not None and p.trace is not None:
+                p.trace.record(
+                    "kv-export", src="server", rid=rid, slot=slot,
+                    tokens=blob.header["length"], target=target,
+                    complete=blob.header["complete"],
+                )
+            if self._push_pool is None:
+                self._push_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="shellac-kv-push",
+                )
+            self._push_pool.submit(
+                self._push_migration, rid, blob, target,
+                p.deadline if p is not None else None,
+            )
+
+    def _push_migration(self, rid, blob, target: str,
+                        deadline: Optional[float]) -> None:
+        """Push worker: serialize + POST the blob to the decode
+        replica's /kv/import, then settle the prefill client's pending
+        with the migration ack — or, on any failure, with a retryable
+        503 ("kv-push-failed" marker) so the tier re-runs the full
+        prefill->migrate path on a fresh pair."""
+        p = self._pending.get(rid)
+        tid = (p.trace.trace_id
+               if p is not None and p.trace is not None else None)
+        data = blob.serialize()
+        timeout = 30.0
+        if deadline is not None:
+            timeout = max(1.0, min(timeout,
+                                   deadline - time.monotonic()))
+        headers = {"Content-Type": "application/octet-stream"}
+        if tid is not None:
+            headers[TRACE_HEADER] = format_trace_header(tid, 0)
+        t0 = time.monotonic()
+        try:
+            req = urllib.request.Request(
+                target.rstrip("/") + "/kv/import", data=data,
+                headers=headers,
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                body = json.loads(resp.read() or b"{}")
+        except Exception as e:  # noqa: BLE001 — one retryable leg
+            self._m.migrations.labels(outcome="export_failed").inc()
+            pp = self._pending.pop(rid, None)
+            if pp is not None:
+                pp.error = (f"kv-push-failed: could not deliver KV to "
+                            f"{target}: {type(e).__name__}: {e}")
+                pp.kind = "unavailable"
+                if pp.trace is not None:
+                    pp.trace.abort("fault")
+                pp.finish()
+            return
+        dt = time.monotonic() - t0
+        self._m.kv_transfer_seconds.observe(dt, exemplar=tid)
+        self._m.kv_transfer_bytes.observe(float(len(data)),
+                                          exemplar=tid)
+        self._m.migrations.labels(outcome="export").inc()
+        pp = self._pending.pop(rid, None)
+        if pp is None:
+            return  # cancelled or swept while pushing
+        n_out = len(blob.header["request"]["out"])
+        pp.result = {
+            "migrated": True,
+            "migration_id": body.get("migration_id") or tid,
+            "decode": target.rstrip("/"),
+            "complete": bool(blob.header["complete"]),
+            "bytes": len(data),
+            "transfer_s": round(dt, 6),
+            "tokens_out": n_out,
+            "prompt_tokens": int(blob.header["length"]),
+        }
+        if pp.trace is not None:
+            pp.trace.finish(n_out)
+        pp.finish()
+
+    def _sweep_adoptions(self, g: _Generation) -> None:
+        """Expire un-adopted migrations (scheduler thread): a decode
+        replica must not pin slots or results for a client that never
+        arrived (tier died between the migrate and adopt legs)."""
+        if not self._adoptions:
+            return
+        now = time.monotonic()
+        for mid, (p, t) in list(self._adoptions.items()):
+            if now - t <= self._adopt_ttl:
+                continue
+            if self._adoptions.pop(mid, None) is None:
+                continue
+            if not p.event.is_set():
+                g.engine.cancel(p.rid)
+                pp = self._pending.pop(p.rid, None)
+                if pp is not None:
+                    pp.error = ("migration never adopted "
+                                "(adopt_ttl expired)")
+                    if pp.trace is not None:
+                        pp.trace.abort("cancelled")
+                    pp.finish()
+
     def _run(self, g: _Generation) -> None:
         engine = g.engine
         # Multi-host engines need a step per loop iteration even when
@@ -924,6 +1187,7 @@ class InferenceServer:
                 drained = True
                 self._process_item(g, item)
             self._shed_expired(g)
+            self._sweep_adoptions(g)
             self._beat(g)
             if engine.pending or idle_steps:
                 g.step_started = time.monotonic()
@@ -980,6 +1244,9 @@ class InferenceServer:
                         lp_store.pop(rid, None)
                         plp_store.pop(rid, None)
                         tl_store.pop(rid, None)
+                # Disaggregated prefill replica: export + ship every
+                # slot this step froze (no-op otherwise).
+                self._service_frozen(g)
                 if idle_steps and not drained and not engine.pending:
                     # Idle heartbeat tick: pace the broadcast instead of
                     # spinning the interconnect at full rate.
@@ -1084,7 +1351,10 @@ class InferenceServer:
         # a stream whose per-chunk timeout outlives the deadline.)
         if p.kind == "fault":
             raise RuntimeError(p.error)
-        if p.kind == "shed":
+        if p.kind in ("shed", "unavailable"):
+            # "unavailable": a migration leg failed in a way a fresh
+            # pair can serve (push failed, pool full) — retryable 503,
+            # exactly like a shed, so the tier re-runs the full path.
             raise ServerUnavailable(p.error, http_status=503,
                                     retry_after=retry_after(1.0, 3.0))
         raise ValueError(p.error)
@@ -1384,6 +1654,231 @@ class InferenceServer:
             choices.append(c)
         return {"choices": choices, "num_beams": nb}
 
+    # ---- KV migration client surface (disaggregated serving) --------
+
+    def import_kv(self, body: bytes,
+                  trace_ctx: Optional[Tuple[str, int]] = None
+                  ) -> Dict[str, Any]:
+        """POST /kv/import: adopt a migrated request. Deserializes +
+        integrity-checks the blob (400 on refusal), applies the same
+        admission gates as _submit, then hands the import to the
+        scheduler thread and waits for its ack. The imported request
+        starts decoding IMMEDIATELY — the adopt request that follows
+        attaches to it, so transfer and decode overlap with the tier's
+        second leg instead of serializing behind it."""
+        blob = disagg.MigrationBlob.deserialize(bytes(body))
+        tid = blob.header.get("trace_id") or (
+            trace_ctx[0] if trace_ctx is not None else new_trace_id()
+        )
+        r = blob.header.get("request") or {}
+        with self._lock:
+            if self._fatal is not None:
+                raise RuntimeError(self._fatal)
+            if self._closed.is_set():
+                raise RuntimeError("server closed")
+            g = self._g
+            if self._recovering or g.dead:
+                self._m.rejects.labels(reason="recovering").inc()
+                raise ServerUnavailable(
+                    "server recovering from an engine fault; retry",
+                    http_status=503, retry_after=retry_after(3.0, 8.0),
+                )
+            if self._draining:
+                self._m.rejects.labels(reason="draining").inc()
+                raise ServerUnavailable(
+                    "server draining: not admitting migrations; retry "
+                    "elsewhere",
+                    http_status=503, retry_after=retry_after(1.0, 4.0),
+                )
+            if (self.max_pending is not None
+                    and len(self._pending) >= self.max_pending):
+                self._m.rejects.labels(reason="overloaded").inc()
+                raise ServerUnavailable(
+                    f"server overloaded: {len(self._pending)} requests "
+                    f"pending (max_pending={self.max_pending})",
+                    http_status=429, retry_after=retry_after(1.0, 3.0),
+                )
+            stale = self._adoptions.pop(tid, None)
+            if stale is not None and not stale[0].event.is_set():
+                # A re-run of the same migration (the tier retried
+                # after a lost ack): the prior import is now orphaned
+                # — cancel it instead of letting it decode to
+                # completion unadopted, pinning pool blocks.
+                g.submit_q.put((stale[0].rid, None, 0, None, None,
+                                None))
+            rid = next(self._ids)
+            trace = self._m.trace(trace_id=tid, recorder=self._recorder)
+            stop = r.get("stop")
+            holdback = (max((len(s) for s in stop), default=0)
+                        if stop else 0)
+            p = _Pending(rid, stream=True, holdback=holdback,
+                         trace=trace)
+            trace.record("admit", rid=rid, src="server",
+                         kind="kv-import",
+                         prompt_len=len(r.get("tokens") or ()),
+                         pending=len(self._pending) + 1)
+            if blob.header.get("complete"):
+                # The request finished at its prefill (max_new=1,
+                # instant EOS, stop match): settle now — no engine, no
+                # pool, nothing to decode.
+                trace.prefill_start()
+                trace.first_token()
+                p.result = list(r.get("out") or ())
+                p.lps = r.get("lps") or None
+                p.plp = r.get("plp")
+                if r.get("tlp") is not None:
+                    p.tlp = [(list(ids), list(vals))
+                             for ids, vals in r["tlp"]]
+                trace.finish(len(p.result))
+                p.finish()
+                self._adoptions[tid] = (p, time.monotonic())
+                self._m.migrations.labels(outcome="import").inc()
+                return {"imported": True, "migration_id": tid,
+                        "complete": True, "trace_id": tid}
+            self._pending[rid] = p
+            self._adoptions[tid] = (p, time.monotonic())
+            ack = _ImportAck()
+            g.submit_q.put((
+                rid, np.asarray(r.get("tokens") or [], np.int32),
+                int(r.get("max_new") or 1), stop,
+                {"_kv_import": (blob, ack, tid)}, None,
+            ))
+        if not ack.event.wait(timeout=60.0):
+            raise ServerUnavailable(
+                "kv import not processed in time",
+                http_status=503, retry_after=retry_after(1.0, 3.0),
+            )
+        if ack.error is not None:
+            if ack.retryable:
+                raise ServerUnavailable(
+                    ack.error, http_status=503,
+                    retry_after=retry_after(1.0, 3.0),
+                )
+            raise ValueError(ack.error)
+        return {"imported": True, "migration_id": tid,
+                "slot": ack.slot, "complete": False, "trace_id": tid}
+
+    def _handle_migrate(self, payload: dict,
+                        trace_ctx: Optional[Tuple[str, int]] = None
+                        ) -> dict:
+        """Native prefill-only request ({"prefill_only": true,
+        "migrate_to": <decode URL>}): prefill, freeze, export, push —
+        answers with the migration ack once the decode replica holds
+        the KV. The tier's disaggregated path drives this as leg 1."""
+        target = payload.get("migrate_to")
+        if not isinstance(target, str) or "://" not in target:
+            raise ValueError(
+                'prefill_only needs "migrate_to": the decode replica '
+                "base URL"
+            )
+        for key in ("stream", "num_beams", "adopt"):
+            if payload.get(key):
+                raise ValueError(
+                    f"{key} does not compose with prefill_only"
+                )
+        try:
+            n = int(payload.get("n", 1) or 1)
+            best_of = int(payload.get("best_of", n) or n)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"bad n/best_of: {e}")
+        if n != 1 or best_of != 1:
+            raise ValueError(
+                "n/best_of > 1 do not compose with prefill_only "
+                "(fan-out is tier-side)"
+            )
+        if payload.get("constraint") is not None:
+            raise ValueError(
+                "constraint does not compose with prefill_only (the "
+                "compiled DFA table does not migrate)"
+            )
+        tokens, max_new, stop, samp = self._parse(payload)
+        deadline = self._deadline(payload.get("timeout"))
+        p = self._submit(tokens, max_new, stop,
+                         {**samp, "_migrate": target}, stream=False,
+                         deadline=deadline, trace_ctx=trace_ctx)
+        try:
+            self._await(p, deadline)
+        except TimeoutError:
+            self._cancel(p)
+            raise
+        return dict(p.result)
+
+    def _pop_adoption(self, payload: dict) -> _Pending:
+        mid = str(payload.get("adopt"))
+        with self._lock:
+            ent = self._adoptions.pop(mid, None)
+        if ent is None:
+            # Retryable by contract: the tier re-runs the full
+            # prefill->migrate path on a fresh pair (a 4xx here would
+            # read as permanent and fail the client).
+            raise ServerUnavailable(
+                f"unknown migration id {mid!r} (never imported, "
+                "expired, or already adopted); re-run the migration",
+                http_status=503, retry_after=retry_after(1.0, 3.0),
+            )
+        return ent[0]
+
+    def _handle_adopt(self, payload: dict,
+                      trace_ctx: Optional[Tuple[str, int]] = None
+                      ) -> dict:
+        """Native adopt request ({"adopt": <migration id>}): attach to
+        an imported request and answer exactly like a local /generate
+        would — the disaggregated path's leg 2, byte-identical to
+        monolithic serving."""
+        want_lps = self._check_logprobs(payload)
+        tlk = self._check_top_logprobs(payload, want_lps)
+        p = self._pop_adoption(payload)
+        deadline = self._deadline(payload.get("timeout"))
+        try:
+            self._await(p, deadline)
+        except TimeoutError:
+            self._cancel(p)
+            raise
+        result = self._format_completion(p.result, p.lps, want_lps,
+                                         plp=p.plp, tlp=p.tlp, tlk=tlk)
+        result["trace_id"] = (trace_ctx[0] if trace_ctx is not None
+                              else p.trace.trace_id)
+        return result
+
+    def _adopt_stream(self, payload: dict,
+                      trace_ctx: Tuple[str, int]):
+        """Streaming adopt: the imported request's chunk queue drains
+        as ndjson deltas, then the same final record a local stream
+        would end with."""
+        want_lps = self._check_logprobs(payload)
+        tlk = self._check_top_logprobs(payload, want_lps)
+        p = self._pop_adoption(payload)
+        timeout = payload.get("timeout")
+        tid = trace_ctx[0]
+        finished = False
+        try:
+            while True:
+                try:
+                    chunk = p.chunks.get(timeout=timeout)
+                except queue.Empty:
+                    raise TimeoutError("request timed out mid-stream")
+                if chunk is None:
+                    break
+                yield {"tokens": chunk, "trace_id": tid}
+            if p.error is not None:
+                self._raise(p)
+            finished = True
+            out = p.result
+            final: Dict[str, Any] = {"done": True, "tokens": out,
+                                     "trace_id": tid}
+            if want_lps:
+                final["logprobs"] = p.lps
+            if tlk and p.tlp is not None:
+                final["top_logprobs"] = self._render_tlp(p.tlp, tlk)
+            if p.plp is not None:
+                final["prompt_logprobs"] = _render_plp(p.plp)
+            if self.tokenizer is not None:
+                final["text"] = self.tokenizer.decode(out)
+            yield final
+        finally:
+            if not finished:
+                self._cancel(p)
+
     def _tool_context(self, payload: dict):
         """Validate `tools`/`tool_choice` on a native payload and
         return the ToolContext (None when the request declares no
@@ -1432,6 +1927,19 @@ class InferenceServer:
         if trace_ctx is None:
             trace_ctx = (new_trace_id(), 0)
         tool_ctx = self._tool_context(payload)
+        if payload.get("prefill_only"):
+            if tool_ctx is not None:
+                raise ValueError(
+                    "tools do not compose with prefill_only (tool "
+                    "grammar state does not migrate)"
+                )
+            result = self._handle_migrate(payload, trace_ctx=trace_ctx)
+            result["trace_id"] = trace_ctx[0]
+            return result
+        if payload.get("adopt") is not None:
+            if tool_ctx is not None:
+                raise ValueError("tools do not compose with adopt")
+            return self._handle_adopt(payload, trace_ctx=trace_ctx)
         if payload.get("num_beams") is not None:
             if tool_ctx is not None:
                 raise ValueError(
@@ -1592,6 +2100,16 @@ class InferenceServer:
                 "num_beams does not compose with streaming (beams are "
                 "ranked whole sequences; request them unstreamed)"
             )
+        if payload.get("prefill_only"):
+            raise ValueError(
+                "prefill_only does not compose with streaming (the "
+                "migration ack is a single JSON object)"
+            )
+        if payload.get("adopt") is not None:
+            if payload.get("tools"):
+                raise ValueError("tools do not compose with adopt")
+            yield from self._adopt_stream(payload, trace_ctx)
+            return
         tool_ctx = self._tool_context(payload)
         tokens, max_new, stop, samp = self._parse(payload)
         self._tool_constraint(samp, tool_ctx)
@@ -1741,6 +2259,10 @@ class InferenceServer:
                 yield chunk
 
     def close(self):
+        if self._push_pool is not None:
+            # In-flight pushes settle their pendings or are failed by
+            # the sweep below; new pushes cannot start (closed).
+            self._push_pool.shutdown(wait=False)
         with self._lock:
             self._closed.set()
             g = self._g
@@ -1846,6 +2368,7 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     # Supervisor state: /stats stays 200 through an
                     # outage (scrapers keep collecting); readiness
                     # lives at /health.
+                    "role": server.role,
                     "status": server.status,
                     "fatal": server._fatal,
                     "restarts": server.restarts,
@@ -2023,6 +2546,21 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             rid_hdr = {REQUEST_ID_HEADER: tctx[0]}
             if self.path.startswith("/debug/profile"):
                 self._handle_profile(rid_hdr)
+                return
+            if self.path == "/kv/import":
+                # Binary KV-migration blob from a prefill replica —
+                # handled before the JSON parse below.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    out = server.import_kv(self.rfile.read(n),
+                                           trace_ctx=tctx)
+                    self._send(200, out, headers=rid_hdr)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)}, headers=rid_hdr)
+                except ServerUnavailable as e:
+                    self._send_unavailable(e, trace_id=tctx[0])
+                except RuntimeError as e:
+                    self._send(500, {"error": str(e)}, headers=rid_hdr)
                 return
             if self.path == "/drain":
                 # Admin surface: begin (or with {"resume": true},

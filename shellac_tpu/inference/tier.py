@@ -102,6 +102,9 @@ _QUEUE_GAUGES = ("shellac_engine_queue_depth", "shellac_pending_requests")
 _KV_GAUGE = "shellac_kv_utilization"
 _TTFT_HIST = "shellac_ttft_seconds"
 _PREFIX_GAUGE = "shellac_prefix_cache_blocks"
+#: Resident KV bytes per token (backend-reported): the KV-migration
+#: transfer-cost estimate's scale factor.
+_KVBPT_GAUGE = "shellac_engine_kv_bytes_per_token"
 
 
 def parse_prometheus(text: str) -> Dict[str, Any]:
@@ -132,7 +135,7 @@ class Replica:
     Mutated by the health poller and request threads under `lock`."""
 
     __slots__ = ("url", "breaker", "lock", "state", "load",
-                 "last_ok", "added_at", "pending")
+                 "last_ok", "added_at", "pending", "role")
 
     def __init__(self, url: str, breaker: CircuitBreaker):
         self.url = url.rstrip("/")
@@ -144,6 +147,9 @@ class Replica:
         self.last_ok: Optional[float] = None
         self.added_at = time.monotonic()
         self.pending = 0  # from the last health poll
+        # Disaggregated-serving role from /health ("prefill" |
+        # "decode" | "monolith"); the pair scheduler groups by it.
+        self.role = "monolith"
 
     @property
     def routable(self) -> bool:
@@ -154,6 +160,7 @@ class Replica:
             return {
                 "url": self.url,
                 "state": self.state,
+                "role": self.role,
                 "breaker": self.breaker.state,
                 "pending": self.pending,
                 "load_score": self.load.get("score"),
@@ -212,6 +219,10 @@ class TierRouter:
         slos: Optional[List[Any]] = None,
         slo_page_burn: float = 14.4,
         slo_warn_burn: float = 1.0,
+        disagg: bool = True,
+        kv_bandwidth: float = 1e9,
+        disagg_min_prompt: int = 64,
+        disagg_attempts: int = 2,
     ):
         if not replicas:
             raise ValueError("a tier needs at least one replica URL")
@@ -260,6 +271,22 @@ class TierRouter:
         self.default_timeout = default_timeout
         self.affinity_tolerance = affinity_tolerance
         self.respawn_after = respawn_after
+        # Disaggregated prefill/decode routing: active only when the
+        # fleet actually advertises roles (a pure-monolith fleet pays
+        # nothing). kv_bandwidth (bytes/s) scales the transfer-cost
+        # estimate; prompts shorter than disagg_min_prompt — or whose
+        # estimated transfer cost exceeds the measured prefill
+        # interference (the federated step-phase digests) — serve
+        # monolithically; disagg_attempts bounds full-path re-runs
+        # before the monolithic fallback.
+        if kv_bandwidth <= 0:
+            raise ValueError("kv_bandwidth must be > 0 bytes/s")
+        if disagg_attempts < 1:
+            raise ValueError("disagg_attempts must be >= 1")
+        self.disagg = bool(disagg)
+        self.kv_bandwidth = float(kv_bandwidth)
+        self.disagg_min_prompt = int(disagg_min_prompt)
+        self.disagg_attempts = int(disagg_attempts)
         self._factory = replica_factory
         self._breaker_cfg = (breaker_failures, breaker_window,
                              breaker_cooldown)
@@ -351,6 +378,7 @@ class TierRouter:
                 rep.state = "healthy"
                 rep.last_ok = time.monotonic()
                 rep.pending = int(health.get("pending", 0))
+                rep.role = str(health.get("role") or "monolith")
             if probing or was == "ejected":
                 self._m.readmissions.labels(replica=rep.url).inc()
                 self._recorder.record(None, "readmit", src="tier",
@@ -412,7 +440,8 @@ class TierRouter:
                     parsed = self._fleet.observe(rep.url, text)
                 else:
                     parsed = parse_prometheus_text(text)
-                for k in _QUEUE_GAUGES + (_KV_GAUGE, _PREFIX_GAUGE):
+                for k in _QUEUE_GAUGES + (_KV_GAUGE, _PREFIX_GAUGE,
+                                          _KVBPT_GAUGE):
                     v = parsed.value(k)
                     if v is not None:
                         load[k] = v
@@ -708,6 +737,391 @@ class TierRouter:
             yield rep, reason, remaining, att, legs
             legs += 1
 
+    # ---- disaggregated prefill/decode routing -----------------------
+
+    @staticmethod
+    def _prompt_tokens_est(payload: dict) -> int:
+        """Prompt-size estimate for the transfer-cost model (exact for
+        token payloads, the ~4 chars/token heuristic otherwise)."""
+        if isinstance(payload.get("tokens"), list):
+            return len(payload["tokens"])
+        text = payload.get("text") or payload.get("prompt")
+        if isinstance(text, str):
+            return max(1, len(text) // 4)
+        return 0
+
+    def _phase_mean_s(self, phase: str) -> Optional[float]:
+        """Fleet-mean seconds one engine step spends in `phase`, from
+        the federated shellac_step_phase_seconds digests (PR 11). For
+        phase="prefill_dispatch" this is the measured interference a
+        co-located prefill inflicts on decode windows — the quantity
+        the migration decision compares transfer cost against. None
+        until the fleet has digests."""
+        if self._fleet is None:
+            return None
+        tot_s = tot_c = 0.0
+        for url in self._fleet.replicas():
+            parsed = self._fleet.parsed(url)
+            if parsed is None:
+                continue
+            s = parsed.value("shellac_step_phase_seconds_sum",
+                             phase=phase)
+            c = parsed.value("shellac_step_phase_seconds_count",
+                             phase=phase)
+            if s is not None and c:
+                tot_s += s
+                tot_c += c
+        return (tot_s / tot_c) if tot_c else None
+
+    def _roles_present(self) -> bool:
+        return any(r.role in ("prefill", "decode")
+                   for r in self._replicas)
+
+    def _disagg_pair(self, ex_pre: set,
+                     ex_dec: set) -> Optional[Tuple[Replica, Replica]]:
+        """Least-loaded (prefill, decode) pair, soft-excluding
+        replicas that already failed this request (re-allowed when the
+        exclusion would empty a role — a replica can recover between
+        attempts, like _pick's exclusion)."""
+
+        def pick(role: str, exclude: set) -> Optional[Replica]:
+            pool = [r for r in self._replicas
+                    if r.routable and r.role == role]
+            cands = [r for r in pool if r.url not in exclude] or pool
+            if not cands:
+                return None
+
+            def score(r: Replica) -> float:
+                with r.lock:
+                    s = r.load.get("score")
+                return s if s is not None else float(r.pending)
+
+            return min(cands, key=score)
+
+        pre = pick("prefill", ex_pre)
+        dec = pick("decode", ex_dec)
+        if pre is None or dec is None:
+            return None
+        return pre, dec
+
+    def _disagg_fallback(self, tid: Optional[str], reason: str,
+                         **fields) -> None:
+        self._m.migrations.labels(outcome=f"fallback_{reason}").inc()
+        self._recorder.record(tid, "migrate-fallback", src="tier",
+                              reason=reason, **fields)
+
+    def _disagg_applicable(self, payload: dict,
+                           tid: Optional[str]) -> bool:
+        """Should this request take the disaggregated path? False
+        falls back to monolithic routing — counting WHY, unless the
+        fleet has no roles at all (then disagg is simply inert)."""
+        if not self.disagg or not self._roles_present():
+            return False
+        for key in ("num_beams", "tools", "constraint", "adopt",
+                    "prefill_only", "echo"):
+            if payload.get(key):
+                self._disagg_fallback(tid, "feature", key=key)
+                return False
+        try:
+            n = int(payload.get("n", 1) or 1)
+            best_of = int(payload.get("best_of", n) or n)
+        except (TypeError, ValueError):
+            return False  # the replica will 400 it monolithically
+        if n != 1 or best_of != 1:
+            self._disagg_fallback(tid, "feature", key="n/best_of")
+            return False
+        est = self._prompt_tokens_est(payload)
+        if est < self.disagg_min_prompt:
+            self._disagg_fallback(tid, "cost", prompt_tokens=est)
+            return False
+        # Transfer-cost vs measured interference: migrate only when
+        # shipping the prompt KV costs less than the decode-window
+        # stall a co-located prefill measurably causes. Unknowns lean
+        # toward migrating — the operator split the fleet by role on
+        # purpose, and the first digests arrive within a poll or two.
+        interference = self._phase_mean_s("prefill_dispatch")
+        if interference is not None and interference > 0:
+            bpt = None
+            for r in self._replicas:
+                if r.role == "prefill" and r.routable:
+                    with r.lock:
+                        v = r.load.get(_KVBPT_GAUGE)
+                    if v:
+                        bpt = max(bpt or 0.0, float(v))
+            if bpt:
+                transfer_s = est * bpt / self.kv_bandwidth + 0.002
+                if transfer_s > interference:
+                    self._disagg_fallback(
+                        tid, "cost", prompt_tokens=est,
+                        transfer_s=round(transfer_s, 6),
+                        interference_s=round(interference, 6),
+                    )
+                    return False
+        return True
+
+    def _migrate_leg(self, pre: Replica, dec: Replica, path: str,
+                     payload: dict, tid: str, remaining: float,
+                     leg: int) -> str:
+        """Leg 1 of the disaggregated path: prefill_only on `pre`,
+        pushing KV to `dec`. Returns the migration id. Raises
+        _Retryable (push failures carry the kv-push-failed marker so
+        the caller excludes the DECODE side and spares the prefill
+        replica's breaker) or _Permanent (the replica refused the
+        payload — serve it monolithically for the honest 4xx)."""
+        att = {k: v for k, v in payload.items()
+               if k not in ("stream", "session")}
+        att["prefill_only"] = True
+        att["migrate_to"] = dec.url
+        att["timeout"] = remaining
+        self._m.routed.labels(replica=pre.url,
+                              reason="disagg_prefill").inc()
+        self._recorder.record(tid, "tier-attempt", src="tier",
+                              replica=pre.url, reason="disagg_prefill",
+                              attempt=leg, decode=dec.url)
+        try:
+            with self._post(pre, path, att, remaining, trace_id=tid,
+                            attempt=leg) as resp:
+                body = resp.read()
+        except _Retryable as e:
+            if "kv-push-failed" in str(e):
+                # The prefill ran fine; DELIVERY to the decode replica
+                # failed. Don't charge the prefill replica's breaker
+                # for its partner's death.
+                e.breaker = False
+                e.kind = "kv_push"
+            raise
+        except (OSError, http.client.HTTPException) as e:
+            raise _Retryable("connect",
+                             f"prefill replica died mid-ack: {e}",
+                             breaker=True) from e
+        try:
+            mig = json.loads(body)
+            mid = mig["migration_id"]
+        except (ValueError, KeyError) as e:
+            raise _Retryable(
+                "kv_push", f"malformed migration ack: {e}",
+                breaker=False,
+            ) from e
+        return str(mid)
+
+    def _disagg_attempts(self, path: str, payload: dict, tid: str,
+                         deadline: float, state: dict,
+                         stream: bool = False):
+        """The disaggregated path's shared attempt loop — pair
+        picking, the prefill+migrate leg, exclusion bookkeeping —
+        yielding (dec, adopt_payload, remaining, attempt) once leg 1
+        succeeded; the caller runs leg 2 (adopt) and, on a pre-byte
+        decode failure, records it in `state` and keeps iterating to
+        re-run the FULL path on a fresh pair (the retry contract).
+        Mirrors how forward_json/open_stream share _route_attempts, so
+        the two disagg surfaces cannot drift. `state` carries ex_pre/
+        ex_dec (mutated by both sides), `last` (last failure), and
+        `why` (fallback classification for _disagg_gave_up)."""
+        for attempt in range(self.disagg_attempts):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            pair = self._disagg_pair(state["ex_pre"], state["ex_dec"])
+            if pair is None:
+                if attempt == 0:
+                    state["why"] = "no_pair"
+                return
+            pre, dec = pair
+            try:
+                mid = self._migrate_leg(pre, dec, path, payload, tid,
+                                        remaining, attempt)
+            except _Permanent:
+                # The replica refused the payload for the disagg
+                # protocol (4xx): serve it monolithically for the
+                # honest answer instead of relaying a protocol leg's
+                # refusal.
+                state["why"] = "feature"
+                state["replica"] = pre.url
+                return
+            except _Retryable as e:
+                if e.kind == "kv_push":
+                    state["ex_dec"].add(dec.url)
+                else:
+                    state["ex_pre"].add(pre.url)
+                self._attempt_failed(pre, e, tid, attempt)
+                state["last"] = e
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            adopt = {k: v for k, v in payload.items()
+                     if k not in ("prefill_only", "migrate_to",
+                                  "session")}
+            adopt["adopt"] = mid
+            adopt["timeout"] = remaining
+            self._m.routed.labels(replica=dec.url,
+                                  reason="disagg_decode").inc()
+            self._recorder.record(tid, "tier-attempt", src="tier",
+                                  replica=dec.url,
+                                  reason="disagg_decode",
+                                  attempt=attempt, stream=stream)
+            yield dec, adopt, remaining, attempt
+
+    def _adopt_failed(self, dec: Replica, e: _Retryable, tid: str,
+                      attempt: int, state: dict) -> None:
+        """Account one failed adopt leg (strictly pre-byte): the
+        decode replica is excluded and the caller's next iteration
+        re-runs the full prefill->migrate path on a fresh pair."""
+        self._attempt_failed(dec, e, tid, attempt)
+        state["ex_dec"].add(dec.url)
+        state["last"] = e
+
+    def _disagg_gave_up(self, tid: str, state: dict) -> None:
+        """Classify + count why the disaggregated path stepped aside;
+        the caller then serves monolithically (returns None)."""
+        why = state.get("why")
+        if why == "no_pair":
+            self._disagg_fallback(tid, "no_pair")
+        elif why == "feature":
+            self._disagg_fallback(tid, "feature",
+                                  replica=state.get("replica"))
+        else:
+            last = state.get("last")
+            self._disagg_fallback(tid, "failed",
+                                  last=str(last) if last else None)
+
+    @staticmethod
+    def _disagg_state() -> dict:
+        return {"ex_pre": set(), "ex_dec": set(), "last": None,
+                "why": None, "replica": None}
+
+    def _disagg_forward(self, path: str, payload: dict, tid: str,
+                        deadline: float, t0: float
+                        ) -> Optional[Tuple[int, bytes, str]]:
+        """The disaggregated non-streaming path: (prefill+migrate,
+        adopt) legs with full-path re-runs on a fresh pair when either
+        leg fails strictly before the first client byte. Returns the
+        response to relay, or None to serve monolithically (the
+        fallback is counted)."""
+        if not self._disagg_applicable(payload, tid):
+            return None
+        state = self._disagg_state()
+        for dec, adopt, remaining, attempt in self._disagg_attempts(
+                path, payload, tid, deadline, state):
+            a0 = time.monotonic()
+            try:
+                with self._post(dec, path, adopt, remaining,
+                                trace_id=tid, attempt=attempt) as resp:
+                    try:
+                        body = resp.read()
+                    except (OSError,
+                            http.client.HTTPException) as e:
+                        raise _Retryable(
+                            "connect",
+                            f"decode replica died mid-response: {e}",
+                            breaker=True,
+                        ) from e
+                    ct = resp.headers.get("Content-Type",
+                                          "application/json")
+                self._m.attempt_latency.observe(time.monotonic() - a0)
+                self._m.outcomes.labels(outcome="ok").inc()
+                self._m.migrations.labels(outcome="ok").inc()
+                self._m.e2e.observe(time.monotonic() - t0,
+                                    exemplar=tid)
+                self._recorder.record(tid, "tier-finish", src="tier",
+                                      replica=dec.url,
+                                      status=resp.status,
+                                      attempts=attempt + 1,
+                                      migrated=True)
+                return resp.status, body, ct
+            except _Permanent:
+                # A 4xx on the ADOPT leg is a protocol refusal the
+                # client never asked for: serve the request
+                # monolithically for the honest answer (same rule as
+                # the streaming path — the two surfaces must agree).
+                self._m.attempt_latency.observe(time.monotonic() - a0)
+                state["why"] = "feature"
+                state["replica"] = dec.url
+                break
+            except _Retryable as e:
+                self._m.attempt_latency.observe(time.monotonic() - a0)
+                self._adopt_failed(dec, e, tid, attempt, state)
+                continue
+        self._disagg_gave_up(tid, state)
+        return None
+
+    def _disagg_stream(self, path: str, payload: dict, tid: str,
+                       deadline: float, t0: float):
+        """The disaggregated streaming path: the same shared attempt
+        loop, with the adopt leg's first event read BEFORE committing
+        a 200 — so a decode death pre-byte re-runs the full path on a
+        fresh pair, and a committed stream keeps the severed-stream
+        contract. Returns open_stream's `opened` tuple, or None to
+        serve monolithically."""
+        if not self._disagg_applicable(payload, tid):
+            return None
+        state = self._disagg_state()
+        sse = path.startswith("/v1/")
+        for dec, adopt, remaining, attempt in self._disagg_attempts(
+                path, payload, tid, deadline, state, stream=True):
+            a0 = time.monotonic()
+            try:
+                resp = self._post(dec, path, adopt, remaining,
+                                  trace_id=tid, attempt=attempt)
+            except _Permanent:
+                # Let monolithic routing give the client a live stream
+                # instead of relaying a 4xx for a protocol leg it
+                # never asked for.
+                state["why"] = "feature"
+                state["replica"] = dec.url
+                break
+            except _Retryable as e:
+                self._adopt_failed(dec, e, tid, attempt, state)
+                continue
+            try:
+                first = self._read_first_event(resp, sse)
+            except (OSError, http.client.HTTPException) as e:
+                resp.close()
+                self._adopt_failed(
+                    dec,
+                    _Retryable("stream_pre_byte",
+                               f"adopt stream died before first "
+                               f"event: {e}", breaker=True),
+                    tid, attempt, state,
+                )
+                continue
+            if not first.strip():
+                # Zero bytes then FIN: same breaker-charging class as
+                # the monolithic open_stream's pre-byte close.
+                resp.close()
+                self._adopt_failed(
+                    dec,
+                    _Retryable("stream_pre_byte",
+                               "adopt stream closed before first "
+                               "event", breaker=True),
+                    tid, attempt, state,
+                )
+                continue
+            in_band = self._first_event_error(first, sse)
+            if in_band is not None and in_band.get("retryable"):
+                resp.close()
+                self._adopt_failed(
+                    dec,
+                    _Retryable("stream_pre_byte",
+                               str(in_band.get("message", "")),
+                               breaker=False),
+                    tid, attempt, state,
+                )
+                continue
+            self._m.attempt_latency.observe(time.monotonic() - a0)
+            self._m.outcomes.labels(outcome="ok").inc()
+            self._m.migrations.labels(outcome="ok").inc()
+            self._recorder.record(tid, "tier-finish", src="tier",
+                                  replica=dec.url, status=200,
+                                  attempts=attempt + 1, stream=True,
+                                  migrated=True)
+            ct = resp.headers.get("Content-Type",
+                                  "text/event-stream" if sse
+                                  else "application/x-ndjson")
+            return resp, first, ct, dec.url, t0
+        self._disagg_gave_up(tid, state)
+        return None
+
     def forward_json(self, path: str, payload: dict,
                      trace_id: Optional[str] = None
                      ) -> Tuple[int, bytes, str]:
@@ -720,6 +1134,13 @@ class TierRouter:
         t0 = time.monotonic()
         tid = trace_id or new_trace_id()
         deadline = self._deadline(payload)
+        if self.disagg and path == "/generate":
+            # Disaggregated path first; None falls through to the
+            # monolithic routing below (the fallback rule).
+            routed = self._disagg_forward(path, payload, tid,
+                                          deadline, t0)
+            if routed is not None:
+                return routed
         stop: Dict[str, str] = {}
         last: Optional[_Retryable] = None
         for rep, reason, remaining, att, attempt in self._route_attempts(
@@ -858,6 +1279,11 @@ class TierRouter:
         t0 = time.monotonic()
         tid = trace_id or new_trace_id()
         deadline = self._deadline(payload)
+        if self.disagg and path == "/generate":
+            opened = self._disagg_stream(path, payload, tid,
+                                         deadline, t0)
+            if opened is not None:
+                return opened, None
         stop: Dict[str, str] = {}
         last: Optional[_Retryable] = None
         sse = path.startswith("/v1/")
@@ -990,6 +1416,15 @@ class TierRouter:
             "readmitted": total("shellac_tier_readmissions_total"),
             "drains_observed": total("shellac_tier_drains_observed_total"),
             "respawned": total("shellac_tier_respawns_total"),
+            # Disaggregated serving: full paths served vs monolithic
+            # fallbacks (by-reason splits live on /metrics).
+            "migrated": int(reg.value("shellac_migrations_total",
+                                      outcome="ok") or 0),
+            "migrate_fallbacks": int(sum(
+                reg.value("shellac_migrations_total",
+                          outcome=f"fallback_{r}") or 0
+                for r in ("no_pair", "cost", "feature", "failed")
+            )),
         }
 
     # ---- SLO engine wiring ------------------------------------------

@@ -117,6 +117,7 @@ def _replica_rows(parsed: Optional[ParsedMetrics],
         row: Dict[str, Any] = {
             "url": url,
             "state": rep.get("state", "?"),
+            "role": rep.get("role", "?"),
             "breaker": rep.get("breaker", "?"),
             "pending": rep.get("pending"),
             "kv": None,
@@ -207,8 +208,8 @@ def render(snapshot: Dict[str, Any], width: int = 100) -> str:
     if rows:
         out.append("")
         out.append(
-            f"{'replica':<32}{'state':<10}{'pend':>5}{'kv%':>6}"
-            f"{'p99 ttft':>10}{'stale':>8}"
+            f"{'replica':<32}{'state':<10}{'role':<9}{'pend':>5}"
+            f"{'kv%':>6}{'p99 ttft':>10}{'stale':>8}"
         )
         for r in rows:
             kv = f"{100 * r['kv']:.0f}" if r["kv"] is not None else "-"
@@ -218,8 +219,8 @@ def render(snapshot: Dict[str, Any], width: int = 100) -> str:
             pend = r["pending"] if r["pending"] is not None else "-"
             out.append(
                 f"{_short(r['url'], 30):<32}{r['state']:<10}"
-                f"{pend:>5}{kv:>6}{_fmt_ms(r['ttft_p99']):>10}"
-                f"{stale:>8}"
+                f"{r['role']:<9}{pend:>5}{kv:>6}"
+                f"{_fmt_ms(r['ttft_p99']):>10}{stale:>8}"
             )
         # -- step-phase attribution bars -------------------------------
         phased = [r for r in rows if r["phases"]]

@@ -145,6 +145,35 @@ class ServeMetrics:
             "branch cut by the token budget)",
             labels=("outcome",),
         )
+        self.role_info = g(
+            "shellac_engine_role_info",
+            "Info gauge: always 1, labeled with this replica's serving "
+            "role (prefill | decode | monolith) — the tier's "
+            "disaggregated pair scheduler groups replicas by it",
+            labels=("role",),
+        )
+        self.migrations = c(
+            "shellac_migrations_total",
+            "KV-migration legs by outcome. Replica-side: export / "
+            "export_failed (serialize+push from a prefill replica), "
+            "import / import_failed (adoption on a decode replica). "
+            "Tier-side: ok (full disaggregated path served), "
+            "fallback_* (served monolithically: no_pair | cost | "
+            "feature | failed)",
+            labels=("outcome",),
+        )
+        self.kv_transfer_seconds = h(
+            "shellac_kv_transfer_seconds",
+            "Wall time of one KV-migration push (serialize excluded: "
+            "POST /kv/import dispatch to the decode replica's ack)",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.kv_transfer_bytes = h(
+            "shellac_kv_transfer_bytes",
+            "Serialized size of one KV-migration blob (header + "
+            "chunked device-block payload)",
+            buckets=log_buckets(1e3, 1e9, per_decade=2),
+        )
         self._engine_stats: Dict[str, object] = {}
 
     def trace(self, trace_id: Optional[str] = None,
@@ -345,6 +374,19 @@ class TierMetrics:
             "Backoff slept between retry attempts (after jitter and "
             "deadline capping)",
             buckets=LATENCY_BUCKETS,
+        )
+        # Same family the replicas register (idempotent): tier-side
+        # outcomes (ok / fallback_*) and replica-side leg outcomes
+        # (export / import / *_failed) share one catalog entry.
+        self.migrations = c(
+            "shellac_migrations_total",
+            "KV-migration legs by outcome. Replica-side: export / "
+            "export_failed (serialize+push from a prefill replica), "
+            "import / import_failed (adoption on a decode replica). "
+            "Tier-side: ok (full disaggregated path served), "
+            "fallback_* (served monolithically: no_pair | cost | "
+            "feature | failed)",
+            labels=("outcome",),
         )
 
 

@@ -425,6 +425,61 @@ class TestBackendParity:
         assert a == b
 
 
+@pytest.mark.slow
+class TestMigrationConformance:
+    """The residency()/KV-migration round trip, for EVERY registered
+    backend: prefill-only on engine A -> export_slot -> serialize ->
+    deserialize -> import_blob onto a FRESH engine B -> B's
+    continuation is token-identical to the unmigrated run. This is
+    the conformance contract inference/disagg.py (the disaggregated
+    serving seam) holds against the registry — a new backend must
+    either migrate correctly or be added to disagg's loud refusals."""
+
+    @pytest.mark.parametrize("name", ALL_NAMES)
+    def test_round_trip_continuation_identity(self, name):
+        from shellac_tpu.inference import disagg
+
+        cfg = (_tiny(attn_window=16) if name.startswith("rolling")
+               else _tiny())
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        rng = np.random.default_rng(9)
+        reqs = [
+            ("g", rng.integers(0, cfg.vocab_size, 11), 6,
+             dict(temperature=0.0)),
+            ("s", rng.integers(0, cfg.vocab_size, 7), 6,
+             dict(temperature=1.1, top_k=12, top_p=0.9, seed=123)),
+        ]
+        kind = engine_class(name)
+        expected = _drive(kind(cfg, params, n_slots=2, max_len=96,
+                               cache_backend=name), reqs)
+
+        a = kind(cfg, params, n_slots=2, max_len=96,
+                 cache_backend=name)
+        for rid, toks, max_new, kw in reqs:
+            a.submit(rid, toks, max_new, prefill_only=True, **kw)
+        while len(a.frozen_prefills) < len(reqs):
+            a.step()
+        blobs = {}
+        for rid, slot in list(a.frozen_prefills.items()):
+            blob = disagg.export_slot(a, slot, a._slots[slot])
+            # residency() is the wire manifest: JSON round trip held.
+            assert blob.header["residency"]["backend"] == name
+            blobs[rid] = disagg.MigrationBlob.deserialize(
+                blob.serialize()
+            )
+            a.release_frozen(rid)
+        assert not a.pending  # every frozen slot released cleanly
+
+        b = kind(cfg, params, n_slots=2, max_len=96,
+                 cache_backend=name)
+        for rid, blob in blobs.items():
+            disagg.import_blob(b, blob, rid=rid)
+        got = {}
+        while b.pending:
+            got.update(b.step())
+        assert got == expected
+
+
 # ---------------------------------------------------------------------
 # 3. The exclusion matrix, meta-tested
 # ---------------------------------------------------------------------

@@ -1,0 +1,641 @@
+"""Disaggregated prefill/decode serving (inference/disagg.py +
+docs/serving_tier.md §Disaggregated serving).
+
+  - TestWireFormat — the versioned block-transfer format: round trip,
+    and loud refusal of truncation, corruption (per-chunk crc32), bad
+    magic, and foreign versions.
+  - TestEngineRoundTrip — export_slot -> serialize -> deserialize ->
+    import_blob onto a FRESH engine continues token-identically to
+    the unmigrated run (greedy and per-request-seeded), and the
+    refusal matrix (cross-backend, geometry, engine-contract, full
+    pool) is loud.
+  - TestLiveMigration — THE acceptance criterion: a request served
+    prefill-replica -> migrate -> decode-replica over real HTTP is
+    byte-identical (non-streamed response bodies; streamed delta
+    concatenation + final record) to the same request on a monolithic
+    replica, for paged AND paged-int8 backends, with the one trace id
+    verifiable in both replicas' /debug/request/<id> timelines
+    (kv-export on the prefill side, kv-import on the decode side).
+  - TestTierDisagg — the role-aware pair scheduler: a /generate
+    through the tier takes the two-leg path (migrations ok), answers
+    identically to monolithic serving, and falls back monolithically
+    on short prompts (cost), non-migratable features, and a dead
+    decode fleet (no_pair) — plus the retry contract: a decode
+    replica dying strictly before the first client byte re-runs the
+    FULL prefill->migrate path on a fresh pair.
+  - TestDisaggChaos — the acceptance chaos scenario: SIGKILL a decode
+    replica mid-migration under sustained load; zero failed
+    non-streaming requests.
+
+Everything but the wire-format units is marked `slow`: test_disagg.py
+is an EARLY-alphabet file, so unmarked engine builds here would eat
+the tier-1 wall-clock window; the dedicated `disagg` CI job runs the
+module unfiltered (the cache-backends precedent).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference import disagg
+from shellac_tpu.inference.cache import PoolExhausted, engine_class
+from shellac_tpu.inference.server import InferenceServer, make_http_server
+from shellac_tpu.inference.tier import TierRouter, make_tier_http_server
+from shellac_tpu.models import transformer
+from shellac_tpu.obs import Registry
+from shellac_tpu.training.tokenizer import ByteTokenizer
+
+PROMPT = [5, 9, 3, 7, 2, 8, 11, 4, 6, 1, 13, 20]
+TID = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+TRACE_HDR = {"x-shellac-trace": TID + ";attempt=0"}
+
+
+def _tiny():
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = _tiny()
+    return cfg, transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _engine(cfg, params, name, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 96)
+    return engine_class(name)(cfg, params, cache_backend=name, **kw)
+
+
+def _drain(eng):
+    out = {}
+    while eng.pending:
+        out.update(eng.step())
+    return out
+
+
+def _roundtrip(cfg, params, name, kw, wire=True):
+    """Monolithic control vs export->import continuation; returns
+    (control tokens, migrated tokens, blob)."""
+    ctrl = _engine(cfg, params, name)
+    ctrl.submit("c", PROMPT, 6, **kw)
+    expected = _drain(ctrl)["c"]
+
+    a = _engine(cfg, params, name)
+    a.submit("m", PROMPT, 6, prefill_only=True, **kw)
+    while not a.frozen_prefills:
+        a.step()
+    slot = a.frozen_prefills["m"]
+    blob = disagg.export_slot(a, slot, a._slots[slot], trace_id=TID)
+    assert a.release_frozen("m") is not None
+    if wire:
+        blob = disagg.MigrationBlob.deserialize(blob.serialize())
+
+    b = _engine(cfg, params, name)
+    disagg.import_blob(b, blob, rid="m")
+    got = _drain(b)["m"]
+    return expected, got, blob
+
+
+# ---------------------------------------------------------------------
+# Wire format (fast: no engines, stays in the tier-1 window)
+# ---------------------------------------------------------------------
+
+
+def _blob():
+    return disagg.MigrationBlob(
+        {"backend": "paged", "length": 8, "complete": False,
+         "request": {"out": [1]}, "trace_id": TID},
+        {"k": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+         "v": np.ones((5,), np.int8)},
+    )
+
+
+class TestWireFormat:
+    def test_round_trip_preserves_header_and_arrays(self):
+        blob = _blob()
+        for chunk in (7, 64, 1 << 20):
+            back = disagg.MigrationBlob.deserialize(
+                blob.serialize(chunk_bytes=chunk)
+            )
+            assert back.header["backend"] == "paged"
+            assert back.header["version"] == disagg.VERSION
+            assert back.header["trace_id"] == TID
+            for name, arr in blob.arrays.items():
+                np.testing.assert_array_equal(back.arrays[name], arr)
+                assert back.arrays[name].dtype == arr.dtype
+
+    def test_bad_magic_refused(self):
+        with pytest.raises(ValueError, match="magic"):
+            disagg.MigrationBlob.deserialize(b"NOTKV\x00" + b"x" * 64)
+
+    def test_truncation_refused(self):
+        data = _blob().serialize(chunk_bytes=16)
+        with pytest.raises(ValueError, match="truncated"):
+            disagg.MigrationBlob.deserialize(data[:-3])
+
+    def test_corruption_fails_chunk_crc(self):
+        data = bytearray(_blob().serialize(chunk_bytes=16))
+        data[-2] ^= 0xFF  # flip a payload byte in the last array
+        with pytest.raises(ValueError, match="crc32"):
+            disagg.MigrationBlob.deserialize(bytes(data))
+
+    def test_trailing_garbage_refused(self):
+        data = _blob().serialize()
+        with pytest.raises(ValueError, match="trailing"):
+            disagg.MigrationBlob.deserialize(data + b"xx")
+
+    def test_foreign_version_refused(self):
+        blob = _blob()
+        blob.header["version"] = disagg.VERSION  # serialize overwrites
+        data = blob.serialize()
+        # Rewrite the header's version field in place.
+        hlen = int.from_bytes(data[7:11], "big")
+        hdr = json.loads(data[11:11 + hlen])
+        hdr["version"] = 99
+        hj = json.dumps(hdr).encode()
+        forged = data[:7] + len(hj).to_bytes(4, "big") + hj \
+            + data[11 + hlen:]
+        with pytest.raises(ValueError, match="version"):
+            disagg.MigrationBlob.deserialize(forged)
+
+    def test_bad_chunk_bytes_refused(self):
+        with pytest.raises(ValueError, match="chunk_bytes"):
+            _blob().serialize(chunk_bytes=0)
+
+
+# ---------------------------------------------------------------------
+# Engine-level round trip + refusal matrix
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestEngineRoundTrip:
+    @pytest.mark.parametrize("name", ["paged", "paged-int8", "dense"])
+    def test_greedy_token_identity(self, tiny_model, name):
+        cfg, params = tiny_model
+        expected, got, blob = _roundtrip(cfg, params, name,
+                                         dict(temperature=0.0))
+        assert got == expected
+        # The wire header carries the residency manifest + identity.
+        assert blob.header["residency"]["backend"] == name
+        assert blob.header["trace_id"] == TID
+
+    @pytest.mark.parametrize("name", ["paged", "paged-int8"])
+    def test_seeded_sampling_token_identity(self, tiny_model, name):
+        cfg, params = tiny_model
+        expected, got, _ = _roundtrip(
+            cfg, params, name,
+            dict(temperature=1.1, top_k=12, top_p=0.9, seed=123),
+        )
+        assert got == expected
+
+    def test_complete_at_prefill_ships_no_arrays(self, tiny_model):
+        cfg, params = tiny_model
+        eng = _engine(cfg, params, "paged")
+        eng.submit("m", PROMPT, 1, prefill_only=True, temperature=0.0)
+        while not eng.frozen_prefills:
+            eng.step()
+        slot = eng.frozen_prefills["m"]
+        blob = disagg.export_slot(eng, slot, eng._slots[slot])
+        assert blob.header["complete"] is True
+        assert blob.arrays == {}
+        assert len(blob.header["request"]["out"]) == 1
+        eng.release_frozen("m")
+
+    def test_cross_backend_refused(self, tiny_model):
+        cfg, params = tiny_model
+        _, _, blob = _roundtrip(cfg, params, "paged",
+                                dict(temperature=0.0))
+        dense = _engine(cfg, params, "dense")
+        with pytest.raises(ValueError, match="cross-backend"):
+            disagg.import_blob(dense, blob, rid="x")
+
+    def test_geometry_mismatch_refused(self, tiny_model):
+        cfg, params = tiny_model
+        _, _, blob = _roundtrip(cfg, params, "paged",
+                                dict(temperature=0.0))
+        good = dict(blob.header["model"])
+        b = _engine(cfg, params, "paged")
+        # Layer-count and COMPUTE-DTYPE mismatches both refuse: a
+        # bf16->f32 pair would otherwise silently cast the KV.
+        for mutation in ({"n_layers": 99}, {"dtype": "bfloat16"}):
+            blob.header["model"] = {**good, **mutation}
+            with pytest.raises(ValueError, match="geometry"):
+                disagg.import_blob(b, blob, rid="x")
+
+    def test_engine_contract_mismatch_refused(self, tiny_model):
+        cfg, params = tiny_model
+        _, _, blob = _roundtrip(cfg, params, "paged",
+                                dict(temperature=0.0))
+        b = _engine(cfg, params, "paged", logprobs=True)
+        with pytest.raises(ValueError, match="contract"):
+            disagg.import_blob(b, blob, rid="x")
+
+    def test_full_engine_raises_pool_exhausted(self, tiny_model):
+        cfg, params = tiny_model
+        _, _, blob = _roundtrip(cfg, params, "paged",
+                                dict(temperature=0.0))
+        b = _engine(cfg, params, "paged")
+        b.submit("a", [1, 2, 3], 40)
+        b.submit("b", [4, 5, 6], 40)
+        b.step()  # both admitted into the 2 slots
+        with pytest.raises(PoolExhausted):
+            disagg.import_blob(b, blob, rid="x")
+
+    def test_speculative_engine_refused_both_sides(self, tiny_model):
+        cfg, params = tiny_model
+        _, _, blob = _roundtrip(cfg, params, "paged",
+                                dict(temperature=0.0))
+        spec = engine_class("paged", speculative=True)(
+            cfg, params, cfg, params, gamma=3, n_slots=2, max_len=96,
+            cache_backend="paged",
+        )
+        with pytest.raises(ValueError, match="speculative"):
+            disagg.import_blob(spec, blob, rid="x")
+        with pytest.raises(ValueError, match="speculative"):
+            disagg.export_slot(spec, 0, None)
+
+    def test_prefill_only_refuses_constraint(self, tiny_model):
+        cfg, params = tiny_model
+        from shellac_tpu.inference.constraints import compile_token_dfa
+
+        eng = _engine(cfg, params, "dense", eos_id=7)
+        dfa = compile_token_dfa("ab", ByteTokenizer(),
+                                cfg.vocab_size, 7)
+        with pytest.raises(ValueError, match="prefill_only"):
+            eng.submit("m", PROMPT, 4, prefill_only=True,
+                       constraint=dfa)
+
+
+# ---------------------------------------------------------------------
+# Live two-replica migration over HTTP (the acceptance criterion)
+# ---------------------------------------------------------------------
+
+
+def _mk_server(cfg, params, role, backend, **kw):
+    reg = Registry()
+    eng = engine_class(backend)(cfg, params, n_slots=2, max_len=96,
+                                cache_backend=backend, registry=reg)
+    srv = InferenceServer(cfg, params, tokenizer=ByteTokenizer(),
+                          role=role, registry=reg, engine=eng, **kw)
+    httpd = make_http_server(srv)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return srv, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post(base, path, payload, headers=None, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _stream(base, payload, headers=None, timeout=120):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return [json.loads(ln) for ln in r.read().splitlines()
+                if ln.strip()]
+
+
+def _get_json(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.slow
+class TestLiveMigration:
+    @pytest.fixture(scope="class", params=["paged", "paged-int8"])
+    def trio(self, request):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        servers = [
+            _mk_server(cfg, params, role, request.param)
+            for role in ("monolith", "prefill", "decode")
+        ]
+        yield servers
+        for srv, httpd, _ in servers:
+            httpd.shutdown()
+            srv.close()
+
+    def _migrate(self, trio, payload):
+        pre_u = trio[1][2]
+        dec_u = trio[2][2]
+        st, body = _post(pre_u, "/generate",
+                         {**payload, "prefill_only": True,
+                          "migrate_to": dec_u}, TRACE_HDR)
+        assert st == 200
+        mig = json.loads(body)
+        assert mig["migrated"] is True
+        return mig
+
+    def test_non_streamed_byte_identity(self, trio):
+        mono_u = trio[0][2]
+        dec_u = trio[2][2]
+        payload = {"tokens": PROMPT, "max_new": 6,
+                   "temperature": 0.0, "timeout": 120}
+        _, mono_body = _post(mono_u, "/generate", payload, TRACE_HDR)
+        mig = self._migrate(trio, payload)
+        _, dis_body = _post(dec_u, "/generate",
+                            {**payload, "adopt": mig["migration_id"]},
+                            TRACE_HDR)
+        # Byte-identical response bodies (same trace header on both).
+        assert dis_body == mono_body
+        # The prefill replica's migrate-target map drained (no leaks).
+        assert not trio[1][0]._migrate_targets
+
+    def test_streamed_identity_and_timelines(self, trio):
+        mono_u = trio[0][2]
+        pre_u = trio[1][2]
+        dec_u = trio[2][2]
+        payload = {"tokens": PROMPT, "max_new": 6,
+                   "temperature": 0.0, "timeout": 120}
+        mono = _stream(mono_u, {**payload, "stream": True}, TRACE_HDR)
+        mig = self._migrate(trio, payload)
+        dis = _stream(dec_u, {**payload, "stream": True,
+                              "adopt": mig["migration_id"]}, TRACE_HDR)
+
+        def cat(recs):
+            return [t for r in recs if not r.get("done")
+                    for t in r["tokens"]]
+
+        assert cat(dis) == cat(mono)
+        assert dis[-1] == mono[-1]  # identical final record
+        # The ONE trace id is verifiable across both replicas'
+        # /debug/request/<trace_id> timelines.
+        pre_tl = _get_json(pre_u, f"/debug/request/{TID}")
+        dec_tl = _get_json(dec_u, f"/debug/request/{TID}")
+        pre_events = [e["event"] for e in pre_tl["events"]]
+        dec_events = [e["event"] for e in dec_tl["events"]]
+        assert "prefill-frozen" in pre_events
+        assert "kv-export" in pre_events
+        assert "kv-import" in dec_events
+        assert "finish" in dec_events
+
+    def test_role_surfaces_and_migration_metrics(self, trio):
+        pre_srv, _, pre_u = trio[1]
+        dec_srv, _, dec_u = trio[2]
+        assert _get_json(pre_u, "/health")["role"] == "prefill"
+        assert _get_json(dec_u, "/stats")["role"] == "decode"
+        assert pre_srv.engine.stats["kv_exports"] >= 1
+        assert dec_srv.engine.stats["kv_imports"] >= 1
+        with urllib.request.urlopen(pre_u + "/metrics",
+                                    timeout=30) as r:
+            pre_m = r.read().decode()
+        assert 'shellac_engine_role_info{role="prefill"} 1' in pre_m
+        assert 'shellac_migrations_total{outcome="export"}' in pre_m
+        assert "shellac_kv_transfer_seconds_bucket" in pre_m
+        assert "shellac_kv_transfer_bytes_count" in pre_m
+        assert "shellac_engine_kv_bytes_per_token" in pre_m
+        with urllib.request.urlopen(dec_u + "/metrics",
+                                    timeout=30) as r:
+            dec_m = r.read().decode()
+        assert 'shellac_migrations_total{outcome="import"}' in dec_m
+
+    def test_unknown_migration_id_is_retryable_503(self, trio):
+        dec_u = trio[2][2]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(dec_u, "/generate",
+                  {"tokens": PROMPT, "max_new": 2,
+                   "adopt": "no-such-migration"})
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After")
+
+    def test_adopt_is_single_use(self, trio):
+        dec_u = trio[2][2]
+        payload = {"tokens": PROMPT, "max_new": 3,
+                   "temperature": 0.0, "timeout": 120}
+        mig = self._migrate(trio, payload)
+        st, _ = _post(dec_u, "/generate",
+                      {**payload, "adopt": mig["migration_id"]})
+        assert st == 200
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(dec_u, "/generate",
+                  {**payload, "adopt": mig["migration_id"]})
+        assert e.value.code == 503
+
+    def test_corrupt_import_is_400(self, trio):
+        dec_u = trio[2][2]
+        req = urllib.request.Request(
+            dec_u + "/kv/import", data=b"garbage-not-a-blob",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 400
+
+    def test_prefill_only_needs_target_and_no_stream(self, trio):
+        pre_u = trio[1][2]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(pre_u, "/generate",
+                  {"tokens": PROMPT, "max_new": 2,
+                   "prefill_only": True})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(pre_u, "/generate",
+                  {"tokens": PROMPT, "max_new": 2,
+                   "prefill_only": True, "stream": True,
+                   "migrate_to": "http://127.0.0.1:1"})
+        assert e.value.code == 400
+
+
+# ---------------------------------------------------------------------
+# Tier: role-aware pairing, fallbacks, retry contract
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestTierDisagg:
+    @pytest.fixture(scope="class")
+    def tier(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        servers = [
+            _mk_server(cfg, params, role, "paged")
+            for role in ("monolith", "prefill", "decode")
+        ]
+        reg = Registry()
+        router = TierRouter(
+            [u for _, _, u in servers], registry=reg,
+            disagg_min_prompt=4, health_interval=0.2,
+            default_timeout=120.0,
+        )
+        httpd = make_tier_http_server(router)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            router.poll_once()
+            if all(r.routable for r in router.replicas):
+                break
+            time.sleep(0.1)
+        yield router, reg, base, servers
+        httpd.shutdown()
+        router.close()
+        for srv, h, _ in servers:
+            h.shutdown()
+            srv.close()
+
+    def _mig(self, reg, outcome):
+        return reg.value("shellac_migrations_total",
+                         outcome=outcome) or 0
+
+    def test_disagg_path_matches_monolithic(self, tier):
+        router, reg, base, servers = tier
+        payload = {"tokens": PROMPT, "max_new": 6,
+                   "temperature": 0.0, "timeout": 120}
+        _, mono_body = _post(servers[0][2], "/generate", payload)
+        before = self._mig(reg, "ok")
+        st, body = _post(base, "/generate", payload)
+        assert st == 200
+        assert json.loads(body)["tokens"] \
+            == json.loads(mono_body)["tokens"]
+        assert self._mig(reg, "ok") == before + 1
+        # Tier /stats reflects roles + migration counts.
+        stats = _get_json(base, "/stats")
+        assert stats["migrated"] >= 1
+        roles = {r["url"]: r["role"] for r in stats["replicas"]}
+        assert set(roles.values()) == {"monolith", "prefill", "decode"}
+
+    def test_streamed_disagg_path(self, tier):
+        router, reg, base, servers = tier
+        payload = {"tokens": PROMPT, "max_new": 6,
+                   "temperature": 0.0, "timeout": 120,
+                   "stream": True}
+        before = self._mig(reg, "ok")
+        recs = _stream(base, payload)
+        toks = [t for r in recs if not r.get("done")
+                for t in r["tokens"]]
+        assert recs[-1]["done"] and recs[-1]["tokens"] == toks
+        assert self._mig(reg, "ok") == before + 1
+
+    def test_short_prompt_falls_back_on_cost(self, tier):
+        router, reg, base, _ = tier
+        before = self._mig(reg, "fallback_cost")
+        st, _ = _post(base, "/generate",
+                      {"tokens": [3, 1], "max_new": 2,
+                       "temperature": 0.0, "timeout": 120})
+        assert st == 200
+        assert self._mig(reg, "fallback_cost") == before + 1
+
+    def test_feature_falls_back(self, tier):
+        router, reg, base, _ = tier
+        before = self._mig(reg, "fallback_feature")
+        st, _ = _post(base, "/generate",
+                      {"tokens": PROMPT, "max_new": 2,
+                       "temperature": 0.9, "n": 2, "best_of": 2,
+                       "timeout": 120})
+        assert st == 200
+        assert self._mig(reg, "fallback_feature") == before + 1
+
+    def test_decode_death_pre_byte_reruns_full_path(self, tier):
+        """The retry contract: kill the only decode replica; the tier
+        re-runs the full prefill->migrate path, finds no pair, and
+        serves monolithically — the client sees success."""
+        router, reg, base, servers = tier
+        dec_srv, dec_httpd, dec_u = servers[2]
+        dec_httpd.shutdown()
+        dec_srv.close()
+        for _ in range(6):
+            router.poll_once()
+        payload = {"tokens": PROMPT, "max_new": 4,
+                   "temperature": 0.0, "timeout": 120}
+        st, body = _post(base, "/generate", payload)
+        assert st == 200
+        assert len(json.loads(body)["tokens"]) == 4
+
+
+# ---------------------------------------------------------------------
+# Chaos acceptance: SIGKILL a decode replica mid-migration under load
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestDisaggChaos:
+    def test_decode_sigkill_under_load_zero_failures(self):
+        """THE acceptance scenario: a prefill replica + two decode
+        replicas behind a disaggregated tier under sustained
+        non-streaming load; SIGKILL one decode replica mid-migration.
+        Every non-streaming request must succeed — decode deaths
+        before the first client byte re-run the full path on the
+        surviving pair (or fall back monolithically)."""
+        from shellac_tpu.inference.chaos import LoadGenerator, ReplicaProc
+
+        procs = []
+        router = None
+        httpd = None
+        load = None
+        try:
+            procs = [
+                ReplicaProc(extra_args=["--role", role], max_len=96)
+                for role in ("prefill", "decode", "decode")
+            ]
+            for p in procs:
+                p.wait_ready()
+            reg = Registry()
+            router = TierRouter(
+                [p.url for p in procs], registry=reg,
+                disagg_min_prompt=4, health_interval=0.2,
+                default_timeout=60.0,
+            )
+            httpd = make_tier_http_server(router)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                router.poll_once()
+                if all(r.routable for r in router.replicas):
+                    break
+                time.sleep(0.2)
+            rng = np.random.default_rng(0)
+            payloads = [
+                {"tokens": [int(t) for t in rng.integers(1, 200, 16)],
+                 "max_new": 4}
+                for _ in range(4)
+            ]
+            load = LoadGenerator(base, payloads=payloads,
+                                 concurrency=4, timeout=60.0)
+            load.start()
+            # Warm up until migrations are flowing, then SIGKILL one
+            # decode replica mid-migration.
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if (reg.value("shellac_migrations_total",
+                              outcome="ok") or 0) >= 3:
+                    break
+                time.sleep(0.25)
+            assert (reg.value("shellac_migrations_total",
+                              outcome="ok") or 0) >= 3, \
+                "disaggregated path never engaged under load"
+            procs[1].kill()
+            time.sleep(8.0)
+            counts = load.stop()
+            errors = list(load.errors)
+            load = None
+            assert counts, "load generator issued no requests"
+            bad = {k: v for k, v in counts.items() if k != "ok"}
+            assert not bad, (counts, errors)
+            # The kill produced retries/fallbacks, not client failures.
+            assert counts["ok"] == sum(counts.values())
+        finally:
+            if load is not None:
+                load.stop()
+            if httpd is not None:
+                httpd.shutdown()
+            if router is not None:
+                router.close()
+            for p in procs:
+                p.terminate()

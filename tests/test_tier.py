@@ -54,10 +54,11 @@ class StubReplica:
     attributes instead of an engine."""
 
     def __init__(self, tag, *, pending=0, queue_depth=0, kv_util=0.0,
-                 prefix_blocks=0):
+                 prefix_blocks=0, role="monolith"):
         self.tag = tag
         self.mode = "ok"        # ok | recovering | draining | err503 |
         #                         err429 | err400 | err500
+        self.role = role
         self.pending = pending
         self.queue_depth = queue_depth
         self.kv_util = kv_util
@@ -65,6 +66,11 @@ class StubReplica:
         self.stream_first_error = None   # dict -> sole (retryable?) line
         self.stream_cut_after = None     # int deltas, then abrupt close
         self.requests = 0                # POSTs that reached generate
+        # Disaggregated-protocol scripting: prefill_only POSTs ack a
+        # migration (counted in `prefills`); adopt POSTs answer per
+        # adopt_mode (ok | err500 | err503).
+        self.prefills = 0
+        self.adopt_mode = "ok"
         self.lock = threading.Lock()
         stub = self
 
@@ -93,6 +99,7 @@ class StubReplica:
                                          "ok": False})
                     else:
                         self._send(200, {"status": "ok", "ok": True,
+                                         "role": stub.role,
                                          "pending": stub.pending})
                 elif self.path == "/metrics":
                     txt = (
@@ -139,6 +146,27 @@ class StubReplica:
                     return
                 if stub.mode == "err500":
                     self._send(500, {"error": "scheduler died"})
+                    return
+                if payload.get("prefill_only"):
+                    with stub.lock:
+                        stub.prefills += 1
+                        mid = f"mig-{stub.tag}-{stub.prefills}"
+                    self._send(200, {"migrated": True,
+                                     "migration_id": mid,
+                                     "replica": stub.tag})
+                    return
+                if payload.get("adopt") is not None:
+                    if stub.adopt_mode == "err500":
+                        self._send(500, {"error": "scheduler died"})
+                        return
+                    if stub.adopt_mode == "err503":
+                        self._send(503, {"error": "unknown migration "
+                                                  "id; re-run"},
+                                   {"Retry-After": "1"})
+                        return
+                    self._send(200, {"tokens": [7],
+                                     "replica": stub.tag,
+                                     "adopted": payload["adopt"]})
                     return
                 if payload.get("stream"):
                     self.send_response(200)
@@ -573,6 +601,106 @@ class TestFailureAwareRetry:
         finally:
             r.close()
             a.close()
+
+
+class TestDisaggRetryContract:
+    """The KV-migration retry contract (docs/serving_tier.md
+    §Disaggregated serving): a decode-replica failure strictly before
+    the first client byte classifies RETRYABLE and re-runs the FULL
+    prefill->migrate path on a fresh pair; with no pair left, the
+    request serves monolithically — the client never sees the leg."""
+
+    def test_decode_failure_reruns_full_path_on_fresh_pair(self):
+        pre = StubReplica("P", role="prefill")
+        d1 = StubReplica("D1", role="decode")
+        d2 = StubReplica("D2", role="decode", pending=5)  # d1 first
+        d1.adopt_mode = "err500"  # decode dies before any client byte
+        reg = Registry()
+        r = _mk_router([pre, d1, d2], registry=reg,
+                       disagg_min_prompt=1)
+        try:
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1] * 8, "max_new": 2})
+            assert status == 200
+            out = json.loads(body)
+            # Served by the SECOND pair's decode replica.
+            assert out["replica"] == "D2" and "adopted" in out
+            # The full path re-ran: the prefill replica served TWO
+            # prefill_only legs, one per pair.
+            assert pre.prefills == 2
+            assert reg.value("shellac_migrations_total",
+                             outcome="ok") == 1
+            assert (reg.value("shellac_tier_retries_total",
+                              replica=d1.url, kind="status_500")
+                    or 0) >= 1
+        finally:
+            r.close()
+            for s in (pre, d1, d2):
+                s.close()
+
+    def test_streamed_decode_failure_reruns_full_path(self):
+        pre = StubReplica("P", role="prefill")
+        d1 = StubReplica("D1", role="decode")
+        d2 = StubReplica("D2", role="decode", pending=5)
+        d1.adopt_mode = "err503"
+        reg = Registry()
+        r = _mk_router([pre, d1, d2], registry=reg,
+                       disagg_min_prompt=1)
+        try:
+            opened, err = r.open_stream(
+                "/generate",
+                {"tokens": [1] * 8, "max_new": 2, "stream": True})
+            assert err is None
+            resp, first, ct, rep_url, _ = opened
+            assert rep_url == d2.url
+            assert json.loads(first)["adopted"]  # D2's adopt answered
+            resp.close()
+            assert pre.prefills == 2
+        finally:
+            r.close()
+            for s in (pre, d1, d2):
+                s.close()
+
+    def test_no_pair_left_falls_back_monolithic(self):
+        pre = StubReplica("P", role="prefill")
+        d1 = StubReplica("D1", role="decode")
+        mono = StubReplica("M")
+        d1.adopt_mode = "err500"
+        reg = Registry()
+        r = _mk_router([pre, d1, mono], registry=reg,
+                       disagg_min_prompt=1, disagg_attempts=2)
+        try:
+            status, body, _ = r.forward_json(
+                "/generate", {"tokens": [1] * 8, "max_new": 2})
+            assert status == 200
+            # Monolithic fallback answered (a plain generate, not an
+            # adoption), and the fallback was counted with its reason.
+            assert "adopted" not in json.loads(body)
+            assert reg.value("shellac_migrations_total",
+                             outcome="fallback_failed") == 1
+        finally:
+            r.close()
+            for s in (pre, d1, mono):
+                s.close()
+
+    def test_monolithic_fleet_keeps_disagg_inert(self):
+        stubs = [StubReplica(t) for t in ("a", "b")]
+        reg = Registry()
+        r = _mk_router(stubs, registry=reg)
+        try:
+            status, _, _ = r.forward_json(
+                "/generate", {"tokens": [1] * 64, "max_new": 2})
+            assert status == 200
+            # No role-labeled replica anywhere: no migration series.
+            assert reg.value("shellac_migrations_total",
+                             outcome="ok") is None
+            for reason in ("no_pair", "cost", "feature", "failed"):
+                assert reg.value("shellac_migrations_total",
+                                 outcome=f"fallback_{reason}") is None
+        finally:
+            r.close()
+            for s in stubs:
+                s.close()
 
 
 class TestMembership:
