@@ -1,0 +1,199 @@
+"""Consolidate the per-round BENCH_r*.json files into one trajectory.
+
+Each PR round leaves a `BENCH_rNN.json` behind (the driver's raw
+{n, cmd, rc, tail, parsed} capture), so the perf story is scattered
+across as many files as there were rounds, in two different `parsed`
+shapes (the train-bench shape and the decode-gate shape). This script
+folds them into ONE machine-readable `BENCH_LEDGER.json`:
+
+    {"schema": 1,
+     "gate": {... the committed BENCH_GATE.json thresholds ...},
+     "rounds": [
+       {"round": 2, "cmd": ..., "rc": 0, "status": "ok",
+        "rows": [{"variant": "train", "metric": ..., "value": ...,
+                  "unit": ..., "phase_shares": null, ...}]},
+       {"round": 6, ...,
+        "rows": [{"variant": "overlap", "tokens_s": 39.8,
+                  "phase_shares": {"admission": 0.02, ...}}, ...]}]}
+
+Schema drift FAILS LOUDLY: a round file missing the driver keys, or
+whose `parsed` payload matches neither known shape, exits non-zero
+with the offending file named — the ledger must never silently
+swallow a round, because a silently dropped round is exactly the
+data point a perf regression hides behind.
+
+    python scripts/bench_ledger.py            # rewrite BENCH_LEDGER.json
+    python scripts/bench_ledger.py --check    # verify it is current (CI)
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA = 1
+
+_DRIVER_KEYS = {"n", "cmd", "rc", "tail", "parsed"}
+_TRAIN_KEYS = {"metric", "value", "unit"}
+_GATE_VARIANTS = (
+    ("overlap", "churn_tokens_s"),
+    ("serial", "serial_tokens_s"),
+    ("spec_paged", "spec_paged_tokens_s"),
+)
+
+
+class SchemaDrift(RuntimeError):
+    pass
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _round_rows(path, parsed):
+    """parsed payload -> normalized rows, or SchemaDrift."""
+    if parsed is None:
+        return []
+    if not isinstance(parsed, dict):
+        raise SchemaDrift(f"{path}: parsed is {type(parsed).__name__}, "
+                          "expected object or null")
+    if _TRAIN_KEYS <= set(parsed):
+        # Train-bench shape (rounds 2-5): one scalar + detail.
+        detail = parsed.get("detail") or {}
+        if not isinstance(detail, dict):
+            raise SchemaDrift(f"{path}: train-shape detail must be an "
+                              "object")
+        return [{
+            "variant": "train",
+            "metric": parsed["metric"],
+            "value": parsed["value"],
+            "unit": parsed["unit"],
+            "step_time_s": detail.get("step_time_s"),
+            "loss": detail.get("loss"),
+            "phase_shares": None,
+        }]
+    if "step_phases" in parsed or "churn_tokens_s" in parsed:
+        # Decode-gate shape (round 6+): per-variant tokens/s + the
+        # five-phase step-time digests.
+        phases = parsed.get("step_phases") or {}
+        if not isinstance(phases, dict):
+            raise SchemaDrift(f"{path}: step_phases must be an object")
+        rows = []
+        for variant, key in _GATE_VARIANTS:
+            if key not in parsed and variant not in phases:
+                continue
+            if key not in parsed:
+                raise SchemaDrift(
+                    f"{path}: variant {variant!r} has step_phases but "
+                    f"no {key!r} throughput"
+                )
+            pdig = phases.get(variant) or {}
+            shares = {}
+            for phase, row in pdig.items():
+                if not isinstance(row, dict) or "share" not in row:
+                    raise SchemaDrift(
+                        f"{path}: step_phases[{variant!r}][{phase!r}] "
+                        "carries no 'share'"
+                    )
+                shares[phase] = row["share"]
+            rows.append({
+                "variant": variant,
+                "metric": parsed.get("metric"),
+                "tokens_s": parsed[key],
+                "phase_shares": shares or None,
+            })
+        if not rows:
+            raise SchemaDrift(f"{path}: decode-gate shape with no "
+                              "recognizable variants")
+        rows[0]["gate"] = parsed.get("gate")
+        return rows
+    raise SchemaDrift(
+        f"{path}: parsed payload matches neither the train-bench "
+        f"shape ({sorted(_TRAIN_KEYS)}) nor the decode-gate shape "
+        "(churn_tokens_s/step_phases) — teach scripts/bench_ledger.py "
+        "the new shape instead of letting the ledger rot"
+    )
+
+
+def build():
+    rounds = []
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    if not paths:
+        raise SchemaDrift("no BENCH_r*.json round files found")
+    for path in paths:
+        name = os.path.basename(path)
+        m = re.fullmatch(r"BENCH_r(\d+)\.json", name)
+        if not m:
+            raise SchemaDrift(f"{name}: round files must be named "
+                              "BENCH_rNN.json")
+        data = _load(path)
+        if not isinstance(data, dict) or not _DRIVER_KEYS <= set(data):
+            raise SchemaDrift(
+                f"{name}: missing driver keys "
+                f"{sorted(_DRIVER_KEYS - set(data or {}))}"
+            )
+        if int(data["n"]) != int(m.group(1)):
+            raise SchemaDrift(
+                f"{name}: embedded round n={data['n']} disagrees with "
+                "the file name"
+            )
+        rounds.append({
+            "round": int(data["n"]),
+            "cmd": data["cmd"],
+            "rc": int(data["rc"]),
+            "status": "ok" if int(data["rc"]) == 0 else "failed",
+            "rows": _round_rows(name, data["parsed"]),
+        })
+    rounds.sort(key=lambda r: r["round"])
+    gate_path = os.path.join(ROOT, "BENCH_GATE.json")
+    gate = _load(gate_path) if os.path.exists(gate_path) else None
+    return {
+        "schema": SCHEMA,
+        "generated_by": "scripts/bench_ledger.py",
+        "gate": gate,
+        "rounds": rounds,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fold BENCH_r*.json into BENCH_LEDGER.json")
+    ap.add_argument("--out",
+                    default=os.path.join(ROOT, "BENCH_LEDGER.json"))
+    ap.add_argument("--check", action="store_true",
+                    help="verify the committed ledger matches a fresh "
+                         "regeneration (no write); exit 3 on mismatch")
+    args = ap.parse_args(argv)
+    try:
+        ledger = build()
+    except (SchemaDrift, OSError, ValueError) as e:
+        print(f"bench_ledger: {e}", file=sys.stderr)
+        return 2
+    if args.check:
+        try:
+            committed = _load(args.out)
+        except (OSError, ValueError) as e:
+            print(f"bench_ledger: cannot read {args.out}: {e}",
+                  file=sys.stderr)
+            return 3
+        if committed != ledger:
+            print(f"bench_ledger: {args.out} is stale — rerun "
+                  "scripts/bench_ledger.py", file=sys.stderr)
+            return 3
+        print(f"{args.out}: current "
+              f"({len(ledger['rounds'])} rounds)")
+        return 0
+    with open(args.out, "w") as f:
+        json.dump(ledger, f, indent=1)
+        f.write("\n")
+    print(f"wrote {args.out}: {len(ledger['rounds'])} rounds, "
+          f"{sum(len(r['rows']) for r in ledger['rounds'])} rows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1062,6 +1062,13 @@ def cmd_serve(args):
         debug_include_text=args.debug_include_text,
         profile_dir=args.profile_dir,
         role=args.role,
+        spool_dir=args.spool_dir,
+        spool_max_bytes=args.spool_max_bytes,
+        incident_dir=args.incident_dir,
+        incident_rate=args.incident_rate,
+        incident_window=args.incident_window,
+        incident_retention=args.incident_retention,
+        incident_capture_seconds=args.incident_capture_seconds,
     )
     return 0
 
@@ -1124,6 +1131,12 @@ def cmd_serve_tier(args):
         disagg=args.disagg,
         kv_bandwidth=args.kv_bandwidth,
         disagg_min_prompt=args.disagg_min_prompt,
+        spool_dir=args.spool_dir,
+        spool_max_bytes=args.spool_max_bytes,
+        incident_dir=args.incident_dir,
+        incident_rate=args.incident_rate,
+        incident_window=args.incident_window,
+        incident_retention=args.incident_retention,
     )
     serve_tier(router, host=args.host, port=args.port)
     return 0
@@ -1134,8 +1147,47 @@ def cmd_top(args):
     # instantly on any box with Python, not just an accelerator host.
     from shellac_tpu.obs.top import run_top
 
+    if args.tier is None and not (args.trace and args.spool):
+        raise SystemExit(
+            "top needs --tier (live dashboard) or --trace with "
+            "--spool (recover a dead replica's timeline from disk)"
+        )
     return run_top(args.tier, once=args.once, interval=args.interval,
-                   trace=args.trace, timeout=args.timeout)
+                   trace=args.trace, timeout=args.timeout,
+                   spool=args.spool)
+
+
+def cmd_trace_report(args):
+    # jax-free like `top`: reading a capture must work anywhere.
+    from shellac_tpu.obs import tracereport
+
+    try:
+        if args.diff:
+            a, b = args.diff
+            result = tracereport.diff(
+                tracereport.analyze(a, top=args.top),
+                tracereport.analyze(b, top=args.top),
+                threshold=args.threshold, min_us=args.min_us,
+                phase_shift_points=args.phase_shift_points,
+            )
+            print(json.dumps(result, indent=1) if args.json
+                  else tracereport.render_diff(result), end="")
+            # Non-zero on flagged regressions so the diff gates (the
+            # ROADMAP item 3 re-measure campaign's comparison step).
+            return 0 if result["ok"] else 2
+        if not args.capture:
+            raise SystemExit(
+                "trace-report needs a capture path (or --diff A B)"
+            )
+        report = tracereport.analyze(args.capture, top=args.top)
+        print(json.dumps(report, indent=1) if args.json
+              else tracereport.render_report(report), end="")
+        return 0
+    except (OSError, EOFError, ValueError) as e:
+        # OSError covers missing files AND gzip.BadGzipFile; EOFError
+        # is a TRUNCATED gzip — exactly what a crash mid-capture
+        # leaves behind, so it must fail cleanly, not traceback.
+        raise SystemExit(f"trace-report: {e}")
 
 
 def cmd_convert(args):
@@ -1542,7 +1594,46 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--profile-dir", default=None, dest="profile_dir",
                    help="directory for POST /debug/profile?seconds=N "
                         "jax.profiler captures of the live engine "
-                        "(unset = the endpoint answers 400)")
+                        "(unset = the endpoint answers 400; responses "
+                        "carry a capture_id/trace_dir that `python -m "
+                        "shellac_tpu trace-report` accepts verbatim, "
+                        "and ?report=1 inlines the analysis)")
+    s.add_argument("--spool-dir", default=None, dest="spool_dir",
+                   help="durable event spool: every flight-recorder "
+                        "event also appends to a rotating size-capped "
+                        "JSONL file here, so a SIGKILL'd replica's "
+                        "in-flight timelines survive to disk (recover "
+                        "with `top --trace <id> --spool <dir>`; "
+                        "redaction rules apply on disk too)")
+    s.add_argument("--spool-max-bytes", type=int, default=8 << 20,
+                   dest="spool_max_bytes",
+                   help="on-disk footprint cap for the event spool "
+                        "(active + one rotated file; default 8 MiB)")
+    s.add_argument("--incident-dir", default=None, dest="incident_dir",
+                   help="incident black box: supervisor wedge/rebuild, "
+                        "restart-budget exhaustion, and POST "
+                        "/debug/incident each write an atomic evidence "
+                        "bundle here (recorder dump, metrics snapshot, "
+                        "in-flight table, step-phase digest, config "
+                        "fingerprint; docs/observability.md#incidents)")
+    s.add_argument("--incident-rate", type=int, default=6,
+                   dest="incident_rate",
+                   help="at most this many bundles per "
+                        "--incident-window seconds (sliding window; "
+                        "dropped triggers are counted, not silent)")
+    s.add_argument("--incident-window", type=float, default=600.0,
+                   dest="incident_window",
+                   help="sliding window (seconds) for --incident-rate")
+    s.add_argument("--incident-retention", type=int, default=24,
+                   dest="incident_retention",
+                   help="bundles kept on disk; oldest deleted beyond "
+                        "this")
+    s.add_argument("--incident-capture-seconds", type=float, default=0.0,
+                   dest="incident_capture_seconds",
+                   help="arm an automatic bounded jax.profiler capture "
+                        "(through the same one-at-a-time profile lock "
+                        "as /debug/profile) on wedge/rebuild incident "
+                        "triggers; needs --profile-dir (0 = off)")
     s.add_argument("--heartbeat-file", default=None, dest="heartbeat_file",
                    help="liveness file the serving scheduler touches "
                         "every second, for external watchdogs "
@@ -1682,6 +1773,32 @@ def build_parser() -> argparse.ArgumentParser:
                     help="prompts estimated shorter than this many "
                          "tokens always serve monolithically (their "
                          "prefill is cheaper than any migration)")
+    st.add_argument("--spool-dir", default=None, dest="spool_dir",
+                    help="durable event spool for the tier's attempt "
+                         "log (rotating size-capped JSONL; the "
+                         "replica-side serve --spool-dir twin)")
+    st.add_argument("--spool-max-bytes", type=int, default=8 << 20,
+                    dest="spool_max_bytes",
+                    help="on-disk footprint cap for the event spool")
+    st.add_argument("--incident-dir", default=None, dest="incident_dir",
+                    help="incident black box: SLO page transitions, "
+                         "severed streams, exhausted retries, failed "
+                         "migrations, and POST /debug/incident each "
+                         "write an atomic evidence bundle here — "
+                         "including a federated fetch of every "
+                         "replica's in-flight table and incident list "
+                         "(docs/observability.md#incidents)")
+    st.add_argument("--incident-rate", type=int, default=6,
+                    dest="incident_rate",
+                    help="at most this many bundles per "
+                         "--incident-window seconds")
+    st.add_argument("--incident-window", type=float, default=600.0,
+                    dest="incident_window",
+                    help="sliding window (seconds) for --incident-rate")
+    st.add_argument("--incident-retention", type=int, default=24,
+                    dest="incident_retention",
+                    help="bundles kept on disk; oldest deleted beyond "
+                         "this")
     st.set_defaults(fn=cmd_serve_tier)
 
     tp = sub.add_parser(
@@ -1692,8 +1809,10 @@ def build_parser() -> argparse.ArgumentParser:
              "single snapshot; --trace <id> for one request's "
              "timeline)",
     )
-    tp.add_argument("--tier", required=True,
-                    help="tier base URL, e.g. http://127.0.0.1:8100")
+    tp.add_argument("--tier", default=None,
+                    help="tier base URL, e.g. http://127.0.0.1:8100 "
+                         "(optional with --trace --spool: a dead "
+                         "replica's timeline reads from disk alone)")
     tp.add_argument("--once", action="store_true",
                     help="print one snapshot and exit (CI/scripts)")
     tp.add_argument("--interval", type=float, default=2.0,
@@ -1703,7 +1822,49 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("--trace", default=None, metavar="TRACE_ID",
                     help="print this trace id's recorded timeline "
                          "instead of the dashboard")
+    tp.add_argument("--spool", default=None, metavar="PATH",
+                    help="event-spool file or directory (the replica's "
+                         "serve --spool-dir): with --trace, recover "
+                         "the timeline from disk when the tier lookup "
+                         "404s or the replica is dead (no --tier "
+                         "needed)")
     tp.set_defaults(fn=cmd_top)
+
+    tr = sub.add_parser(
+        "trace-report",
+        help="analyze a jax.profiler capture (the *.trace.json.gz a "
+             "POST /debug/profile or scripts/profile_step.py "
+             "--capture writes): op-level time attribution aligned "
+             "with the shellac_step_phase_seconds phases, top-N ops, "
+             "fusion counts; --diff A B flags regressions between "
+             "two captures and exits non-zero on any "
+             "(docs/observability.md#trace-analysis)",
+    )
+    tr.add_argument("capture", nargs="?", default=None,
+                    help="capture directory (a /debug/profile "
+                         "trace_dir) or a *.trace.json(.gz) file")
+    tr.add_argument("--diff", nargs=2, metavar=("BEFORE", "AFTER"),
+                    default=None,
+                    help="compare two captures; exit 2 if AFTER "
+                         "regressed vs BEFORE")
+    tr.add_argument("--top", type=int, default=20,
+                    help="ops listed in the report (default 20)")
+    tr.add_argument("--threshold", type=float, default=0.15,
+                    help="relative regression threshold for --diff "
+                         "(default 0.15 = +15%%)")
+    tr.add_argument("--min-us", type=float, default=50.0,
+                    dest="min_us",
+                    help="absolute floor (microseconds) below which "
+                         "--diff ignores a change as noise")
+    tr.add_argument("--phase-shift-points", type=float, default=0.15,
+                    dest="phase_shift_points",
+                    help="ABSOLUTE device-share points a phase may "
+                         "grow before --diff flags a phase_shift "
+                         "(separate from --threshold: shares live on "
+                         "a 0..1 scale)")
+    tr.add_argument("--json", action="store_true",
+                    help="print the report/diff as JSON")
+    tr.set_defaults(fn=cmd_trace_report)
 
     k = sub.add_parser("tokenize", help="encode text files into a token shard")
     k.add_argument("--input", nargs="+", required=True, help="text files")

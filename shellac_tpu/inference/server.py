@@ -92,13 +92,16 @@ from shellac_tpu.inference.cache import PoolExhausted
 from shellac_tpu.obs import (
     REQUEST_ID_HEADER,
     TRACE_HEADER,
+    EventSpool,
     FlightRecorder,
+    IncidentManager,
     Registry,
     ServeMetrics,
     adopt_trace,
     format_trace_header,
     get_registry,
     new_trace_id,
+    spool_path,
 )
 from shellac_tpu.utils.failure import Heartbeat, RestartBudget
 
@@ -267,6 +270,13 @@ class InferenceServer:
         recorder: Optional[FlightRecorder] = None,
         role: str = "monolith",
         adopt_ttl: float = 120.0,
+        spool_dir: Optional[str] = None,
+        spool_max_bytes: int = 8 << 20,
+        incident_dir: Optional[str] = None,
+        incident_rate: int = 6,
+        incident_window: float = 600.0,
+        incident_retention: int = 24,
+        incident_capture_seconds: float = 0.0,
         **engine_kw,
     ):
         if role not in ROLES:
@@ -292,14 +302,57 @@ class InferenceServer:
         # --debug-include-text).
         self._debug = bool(debug)
         self._debug_text = bool(debug_include_text)
+        # Durable event spool (serve --spool-dir): the recorder's ring
+        # also spills to a rotating on-disk JSONL file, so a SIGKILL'd
+        # replica's in-flight timelines survive to disk (recovered via
+        # `top --trace <id> --spool <dir>` or read_spool). PR 10
+        # redaction applies on the way to disk unless
+        # --debug-include-text opted in.
+        self._spool = (
+            EventSpool(spool_path(spool_dir),
+                       max_bytes=spool_max_bytes,
+                       include_text=self._debug_text)
+            if spool_dir and self._debug else None
+        )
         self._recorder = (recorder if recorder is not None
                           else FlightRecorder(registry=registry,
-                                              enabled=self._debug))
+                                              enabled=self._debug,
+                                              spool=self._spool))
         # On-demand profiling (POST /debug/profile?seconds=N): writes
         # jax.profiler traces under profile_dir; the non-blocking lock
         # guards the process-global profiler — one capture at a time.
         self._profile_dir = profile_dir
         self._profile_lock = threading.Lock()
+        # Incident black box (serve --incident-dir): trigger-driven
+        # evidence bundles — supervisor wedge→rebuild / scheduler
+        # death / restart-budget exhaustion fire automatically, and
+        # POST /debug/incident fires manually. Sections are evaluated
+        # AT TRIGGER TIME; a page-style trigger may also arm a bounded
+        # jax.profiler capture through the same one-at-a-time profile
+        # lock the /debug/profile endpoint uses.
+        self._incidents: Optional[IncidentManager] = None
+        if incident_dir and self._debug:
+            self._incidents = IncidentManager(
+                incident_dir,
+                source="server",
+                registry=registry,
+                recorder=self._recorder,
+                sections={
+                    "flight_recorder": lambda: self._recorder.tail(
+                        self._recorder.capacity),
+                    "metrics": self._registry.snapshot,
+                    "requests": self.debug_requests,
+                    "latency": self.latency_summary,
+                    "step_phases": self._step_phase_digest,
+                    "config": self._config_fingerprint,
+                },
+                rate=incident_rate,
+                rate_window=incident_window,
+                retention=incident_retention,
+                capture_fn=(self.profile if profile_dir else None),
+                capture_seconds=incident_capture_seconds,
+                analyze_fn=self._analyze_capture,
+            )
         self._t0 = time.monotonic()
         # Validate BEFORE starting the scheduler thread: raising after
         # start() would orphan an engine-owning daemon thread the
@@ -605,15 +658,27 @@ class InferenceServer:
             out["slots"] = eng.cache_backend.residency()
         except Exception:  # noqa: BLE001 — introspection must not 500
             out["slots"] = None
+        if self._spool is not None:
+            out["spool"] = self._spool.stats()
+        if self._incidents is not None:
+            out["last_incident"] = self._incidents.last
         return out
 
     def debug_request(self, trace_id: str) -> Optional[Dict[str, Any]]:
         """The GET /debug/request/<trace_id> timeline, or None for an
-        id the ring no longer (or never) holds."""
+        id the ring no longer (or never) holds. When the ring has
+        evicted the id but a spool is configured, the on-disk copy
+        answers instead — the same recovery path `top --spool` uses
+        on a dead replica, available while the replica still lives."""
         events = self._recorder.events_for(trace_id)
+        source = "ring"
+        if not events and self._spool is not None:
+            events = self._spool.events_for(trace_id)
+            source = "spool"
         if not events:
             return None
-        return {"trace_id": trace_id, "events": events}
+        return {"trace_id": trace_id, "events": events,
+                "source": source}
 
     def profile(self, seconds: float) -> Dict[str, Any]:
         """POST /debug/profile?seconds=N: capture a jax.profiler device
@@ -655,10 +720,108 @@ class InferenceServer:
             self._recorder.record(None, "profile-capture", src="server",
                                   seconds=seconds, trace_dir=path,
                                   files=n_files)
-            return {"trace_dir": path, "seconds": seconds,
-                    "files": n_files}
+            # capture_id is the path component trace-report resolves:
+            # `python -m shellac_tpu trace-report <trace_dir>` works
+            # verbatim on the returned value.
+            return {"trace_dir": path,
+                    "capture_id": os.path.basename(path),
+                    "seconds": seconds, "files": n_files}
         finally:
             self._profile_lock.release()
+
+    @staticmethod
+    def _analyze_capture(trace_dir: str) -> Dict[str, Any]:
+        """trace-report analysis of one capture directory (the
+        ?report=1 inline payload and the bundle's trace_report.json)."""
+        from shellac_tpu.obs import tracereport
+
+        return tracereport.analyze(trace_dir)
+
+    # ---- incident black box ------------------------------------------
+
+    @property
+    def incidents(self) -> Optional[IncidentManager]:
+        return self._incidents
+
+    @property
+    def spool(self) -> Optional[EventSpool]:
+        return self._spool
+
+    def _step_phase_digest(self) -> Dict[str, Any]:
+        """Per-phase step-time digest (sum/count/share) from the
+        shellac_step_phase_seconds histograms — the bundle's answer to
+        'where was the engine tick going when this fired'."""
+        phases: Dict[str, Any] = {}
+        total = 0.0
+        from shellac_tpu.obs import STEP_PHASES
+
+        for phase in STEP_PHASES:
+            h = self._registry.get("shellac_step_phase_seconds",
+                                   phase=phase)
+            if h is None:
+                continue
+            phases[phase] = {"sum_s": round(h.sum, 6),
+                             "count": h.count,
+                             "p50_ms": (round(1e3 * (h.percentile(0.5)
+                                                     or 0.0), 3))}
+            total += h.sum
+        for row in phases.values():
+            row["share"] = (round(row["sum_s"] / total, 4)
+                            if total > 0 else 0.0)
+        return phases
+
+    def _config_fingerprint(self) -> Dict[str, Any]:
+        """Config + engine/mesh identity: enough to answer 'what
+        exactly was running' from the bundle alone."""
+        import dataclasses
+
+        g = self._g
+        eng = g.engine
+        cfg = getattr(eng, "cfg", None)
+        try:
+            cfg_d = dataclasses.asdict(cfg) if cfg is not None else None
+        except TypeError:
+            cfg_d = str(cfg)
+        mesh = getattr(eng, "mesh", None)
+        return {
+            "model": self.model_name,
+            "role": self.role,
+            "generation": g.gen,
+            "restarts": self.restarts,
+            "status": self.status,
+            "uptime_s": round(self.uptime_s, 3),
+            "config": cfg_d,
+            "engine": {
+                "class": type(eng).__name__,
+                "n_slots": getattr(eng, "n_slots", None),
+                "cache_backend": str(
+                    eng.stats.get("cache_backend", "dense")
+                    if hasattr(eng, "stats") else None),
+                "decode_ticks": getattr(eng, "decode_ticks", None),
+                "decode_ticks_source": getattr(
+                    eng, "decode_ticks_source", None),
+                "overlap_decode": bool(
+                    getattr(eng, "overlap_decode", False)),
+            },
+            "mesh": (str(dict(mesh.shape)) if mesh is not None
+                     else None),
+            "spool": (self._spool.stats()
+                      if self._spool is not None else None),
+        }
+
+    def trigger_incident(self, trigger: str, *,
+                         trace_id: Optional[str] = None,
+                         detail: Optional[Dict[str, Any]] = None,
+                         capture_seconds: Optional[float] = None,
+                         ) -> Optional[str]:
+        """Fire one incident trigger (no-op returning None when no
+        --incident-dir is configured; None also means rate-limited)."""
+        if self._incidents is None:
+            return None
+        return self._incidents.trigger(
+            trigger, trace_id=trace_id, detail=detail,
+            capture_seconds=capture_seconds,
+        )
 
     # ---- supervisor --------------------------------------------------
 
@@ -710,6 +873,11 @@ class InferenceServer:
         as long as it stays wedged, so a REBUILD needs headroom for a
         second engine. Size the cache/pool with that in mind, or leave
         restart_budget=0 on memory-tight single-host deployments."""
+        # Incident trigger decided under the lock, FIRED after it
+        # drops: the bundle write snapshots the recorder/metrics/
+        # in-flight state and must not extend the admission-serializing
+        # critical section.
+        incident: Optional[Tuple[str, Dict[str, Any]]] = None
         with self._lock:
             if g.dead or g is not self._g:
                 return  # this generation is already being replaced
@@ -731,20 +899,58 @@ class InferenceServer:
                     "step: the stuck thread still owns the engine — "
                     "restart the pod]"
                 )
-                return
-            recover = (self._budget is not None
-                       and not self._closed.is_set()
-                       and self._budget.allow())
-            if not recover:
-                if self._budget is not None and not self._closed.is_set():
-                    msg += (f" [restart budget exhausted: "
-                            f"{self._budget.max_restarts} restart(s) "
-                            f"per {self._budget.window:g}s]")
-                self._fatal = msg
-                return
-            self._recovering = True
-            self.restarts += 1
-            self._m.restarts.inc()
+                # Terminal AND the pod is about to be restarted by
+                # hand: if any fatal deserves a bundle (the in-memory
+                # evidence dies with the pod), this one does.
+                recover = False
+                incident = ("wedge-fatal",
+                            {"error": self._fatal,
+                             "generation": g.gen,
+                             "restarts": self.restarts})
+            else:
+                recover = (self._budget is not None
+                           and not self._closed.is_set()
+                           and self._budget.allow())
+                if not recover:
+                    if (self._budget is not None
+                            and not self._closed.is_set()):
+                        msg += (f" [restart budget exhausted: "
+                                f"{self._budget.max_restarts} "
+                                f"restart(s) per "
+                                f"{self._budget.window:g}s]")
+                        incident = ("restart-budget-exhausted",
+                                    {"error": msg,
+                                     "generation": g.gen,
+                                     "restarts": self.restarts})
+                    self._fatal = msg
+                else:
+                    self._recovering = True
+                    self.restarts += 1
+                    self._m.restarts.inc()
+                    incident = (
+                        "wedge-rebuild" if wedged
+                        else "scheduler-death",
+                        {"error": msg, "generation": g.gen,
+                         "restarts": self.restarts},
+                    )
+        if incident is not None:
+            # Evidence FIRST (the recorder still holds the fault's
+            # events; the rebuild below may take seconds), then the
+            # rebuild. Wedge-class and rebuild triggers arm the
+            # auto-capture if one was configured
+            # (--incident-capture-seconds) — the device state behind
+            # a wedge is exactly what a post-mortem wants, most of
+            # all on the terminal wedge-fatal arm where the pod
+            # restart is about to destroy it. Only budget exhaustion
+            # skips it: there is no engine left worth profiling.
+            self.trigger_incident(
+                incident[0], detail=incident[1],
+                capture_seconds=(
+                    0 if incident[0] == "restart-budget-exhausted"
+                    else None),
+            )
+        if not recover:
+            return
         # Rebuild OUTSIDE the lock: engine construction allocates
         # device memory and may compile, and /health + admission must
         # stay responsive (reporting "recovering") meanwhile. Keep the
@@ -2259,6 +2465,11 @@ class InferenceServer:
                 yield chunk
 
     def close(self):
+        if self._spool is not None:
+            # After the spool closes, late recorder events fall back
+            # to append-and-reopen inside EventSpool; closing here
+            # just releases the handle on the orderly path.
+            self._spool.close()
         if self._push_pool is not None:
             # In-flight pushes settle their pendings or are failed by
             # the sweep below; new pushes cannot start (closed).
@@ -2407,6 +2618,30 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     }, headers=rid_hdr)
                 elif self.path == "/debug/requests":
                     self._send(200, server.debug_requests())
+                elif self.path == "/debug/incidents":
+                    if server.incidents is None:
+                        self._send(400, {
+                            "error": "incident bundles need serve "
+                                     "--incident-dir",
+                        }, headers=rid_hdr)
+                    else:
+                        self._send(200, {
+                            "incidents": server.incidents.list(),
+                            "dir": server.incidents.incident_dir,
+                            "last": server.incidents.last,
+                        })
+                elif self.path.startswith("/debug/incident/"):
+                    bid = self.path[len("/debug/incident/"):]
+                    out = (server.incidents.load(bid)
+                           if server.incidents is not None else None)
+                    if out is None:
+                        self._send(404, {
+                            "error": f"no incident bundle {bid!r} "
+                                     "(unknown id, evicted by "
+                                     "retention, or no --incident-dir)",
+                        }, headers=rid_hdr)
+                    else:
+                        self._send(200, out)
                 elif self.path.startswith("/debug/request/"):
                     tid = self.path[len("/debug/request/"):]
                     out = server.debug_request(tid)
@@ -2438,8 +2673,19 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             params = urllib.parse.parse_qs(qs)
             try:
                 seconds = float(params.get("seconds", ["2"])[0])
-                self._send(200, server.profile(seconds),
-                           headers=rid_hdr)
+                out = server.profile(seconds)
+                if params.get("report", ["0"])[0] not in ("0", ""):
+                    # ?report=1: inline the trace-report summary of
+                    # the capture just taken — one round trip from
+                    # "profile it" to "where did the time go".
+                    try:
+                        out["report"] = server._analyze_capture(
+                            out["trace_dir"])
+                    except Exception as e:  # noqa: BLE001 — the
+                        # capture itself succeeded; report best-effort
+                        out["report"] = {
+                            "error": f"{type(e).__name__}: {e}"}
+                self._send(200, out, headers=rid_hdr)
             except ProfileInProgress as e:
                 self._send(409, {"error": str(e)}, headers=rid_hdr)
             except ValueError as e:
@@ -2448,6 +2694,72 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 # A profiler backend fault (another process-global
                 # trace active, unwritable dir) is a server error.
                 self._send(500, {"error": str(e)}, headers=rid_hdr)
+
+        def _handle_incident(self, tctx: Tuple[str, int],
+                             rid_hdr: dict):
+            """POST /debug/incident — manual evidence bundle."""
+            if not server.debug_enabled:
+                self._send(404, {"error": "debug endpoints disabled "
+                                          "(serve --no-debug)"},
+                           headers=rid_hdr)
+                return
+            if server.incidents is None:
+                self._send(400, {"error": "incident bundles need "
+                                          "serve --incident-dir"},
+                           headers=rid_hdr)
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("payload must be a JSON object")
+                seconds = payload.get("seconds")
+                if seconds is not None:
+                    seconds = float(seconds)
+            except (TypeError, ValueError) as e:
+                # TypeError: a list/dict "seconds" — a malformed
+                # payload must 400, not drop the connection.
+                self._send(400, {"error": f"bad incident payload: {e}"},
+                           headers=rid_hdr)
+                return
+            detail = {"via": "POST /debug/incident"}
+            if payload.get("note") is not None:
+                detail["note"] = str(payload["note"])[:1024]
+            errors_before = server.incidents.write_errors
+            bid = server.trigger_incident(
+                "manual", trace_id=tctx[0], detail=detail,
+                # Explicit opt-in only: a bare manual trigger must
+                # not inherit the wedge-path auto-capture default.
+                capture_seconds=seconds if seconds is not None else 0,
+            )
+            if bid is None:
+                if server.incidents.write_errors > errors_before:
+                    # The bundle write FAILED (full disk, bad
+                    # permissions): a server fault, not backpressure
+                    # — a 429 would tell the operator to wait for a
+                    # disk that will never empty itself.
+                    self._send(500, {
+                        "error": "incident bundle write failed "
+                                 "(check --incident-dir "
+                                 "permissions/space)",
+                    }, headers=rid_hdr)
+                    return
+                # The sliding-window limiter dropped it: backpressure,
+                # not failure — same contract as admission 429.
+                self._send(429, {
+                    "error": "incident trigger rate-limited "
+                             "(--incident-rate per --incident-window)",
+                }, headers={
+                    **rid_hdr,
+                    "Retry-After": str(max(1, int(round(
+                        retry_after(2.0, 6.0))))),
+                })
+                return
+            self._send(200, {
+                "incident": bid,
+                "manifest": (server.incidents.load(bid) or {}).get(
+                    "manifest"),
+            }, headers=rid_hdr)
 
         def _stream(self, payload: dict, tctx: Tuple[str, int]):
             # Newline-delimited JSON, no Content-Length: the connection
@@ -2546,6 +2858,12 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             rid_hdr = {REQUEST_ID_HEADER: tctx[0]}
             if self.path.startswith("/debug/profile"):
                 self._handle_profile(rid_hdr)
+                return
+            if self.path == "/debug/incident":
+                # Manual incident trigger: snapshot the evidence NOW.
+                # Body (optional): {"note": ..., "seconds": N} — N
+                # arms a bounded profiler capture into the bundle.
+                self._handle_incident(tctx, rid_hdr)
                 return
             if self.path == "/kv/import":
                 # Binary KV-migration blob from a prefill replica —

@@ -80,8 +80,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from shellac_tpu.obs import (
     REQUEST_ID_HEADER,
     TRACE_HEADER,
+    EventSpool,
     FleetCollector,
     FlightRecorder,
+    IncidentManager,
     Registry,
     SLOEngine,
     SLOSpec,
@@ -94,6 +96,7 @@ from shellac_tpu.obs import (
     new_trace_id,
     parse_prometheus_text,
     parse_slo_specs,
+    spool_path,
 )
 from shellac_tpu.utils.failure import CircuitBreaker
 
@@ -223,6 +226,12 @@ class TierRouter:
         kv_bandwidth: float = 1e9,
         disagg_min_prompt: int = 64,
         disagg_attempts: int = 2,
+        spool_dir: Optional[str] = None,
+        spool_max_bytes: int = 8 << 20,
+        incident_dir: Optional[str] = None,
+        incident_rate: int = 6,
+        incident_window: float = 600.0,
+        incident_retention: int = 24,
     ):
         if not replicas:
             raise ValueError("a tier needs at least one replica URL")
@@ -241,8 +250,43 @@ class TierRouter:
         # id walks the whole path. debug=False 404s the tier's /debug
         # endpoints and stops recording (mirrors --no-metrics).
         self._debug = bool(debug)
+        # Durable spool (serve-tier --spool-dir): the tier's attempt
+        # log survives a router kill the same way a replica's does.
+        # No text ever reaches the tier recorder, so include_text
+        # stays False unconditionally.
+        self._spool = (
+            EventSpool(spool_path(spool_dir),
+                       max_bytes=spool_max_bytes)
+            if spool_dir and self._debug else None
+        )
         self._recorder = FlightRecorder(registry=registry,
-                                        enabled=self._debug)
+                                        enabled=self._debug,
+                                        spool=self._spool)
+        # Incident black box (serve-tier --incident-dir): SLO page
+        # transitions, severed streams, exhausted retries, and failed
+        # migrations each snapshot the tier's whole evidence surface —
+        # including a federated fetch of every routable replica's
+        # in-flight table and incident list — into one atomic bundle.
+        self._incidents: Optional[IncidentManager] = None
+        if incident_dir and self._debug:
+            self._incidents = IncidentManager(
+                incident_dir,
+                source="tier",
+                registry=registry,
+                recorder=self._recorder,
+                sections={
+                    "flight_recorder": lambda: self._recorder.tail(
+                        self._recorder.capacity),
+                    "metrics": registry.snapshot,
+                    "requests": self.debug_requests,
+                    "slo": self.slo_status,
+                    "replicas": self.health,
+                    "fleet": self._fleet_evidence,
+                },
+                rate=incident_rate,
+                rate_window=incident_window,
+                retention=incident_retention,
+            )
         # Metrics federation: the health poller's /metrics pull feeds
         # the collector, which re-exposes every replica series (with a
         # `replica` label, last-known-good through outages) plus the
@@ -260,6 +304,7 @@ class TierRouter:
             self._slo = SLOEngine(
                 specs, registry=registry, recorder=self._recorder,
                 exemplar_fn=self._slo_exemplar,
+                on_transition=self._slo_transitioned,
                 page_burn=slo_page_burn, warn_burn=slo_warn_burn,
             )
         self._t0 = time.monotonic()
@@ -984,6 +1029,15 @@ class TierRouter:
             last = state.get("last")
             self._disagg_fallback(tid, "failed",
                                   last=str(last) if last else None)
+            # A migration that FAILED mid-path (vs stepping aside for
+            # a known reason) is incident-grade: the monolithic
+            # fallback saves the request, the bundle saves the why.
+            self._incident(
+                "migration-failed", trace_id=tid,
+                detail={"last": str(last) if last else None,
+                        "excluded_prefill": sorted(state["ex_pre"]),
+                        "excluded_decode": sorted(state["ex_dec"])},
+            )
 
     @staticmethod
     def _disagg_state() -> dict:
@@ -1219,6 +1273,15 @@ class TierRouter:
         self._m.e2e.observe(time.monotonic() - t0, exemplar=trace_id)
         self._recorder.record(trace_id, "tier-exhausted", src="tier",
                               status=status, why=stop.get("why"))
+        # Exhaustion is the tier admitting it could not serve: bundle
+        # the evidence (attempt log, breaker states, fleet snapshot).
+        # The rate limiter keeps an outage from writing one bundle
+        # per failed request.
+        self._incident(
+            "attempts-exhausted", trace_id=trace_id,
+            detail={"status": status, "why": stop.get("why"),
+                    "last": str(last) if last is not None else None},
+        )
         if path.startswith("/v1/"):
             err: Dict[str, Any] = {"error": {"message": msg,
                                              "type": "overloaded_error"}}
@@ -1525,6 +1588,81 @@ class TierRouter:
                     best_le, best_tid = v, tid
         return best_tid
 
+    # ---- incident black box ------------------------------------------
+
+    @property
+    def incidents(self) -> Optional[IncidentManager]:
+        return self._incidents
+
+    @property
+    def spool(self) -> Optional[EventSpool]:
+        return self._spool
+
+    def _incident(self, trigger: str, *,
+                  trace_id: Optional[str] = None,
+                  detail: Optional[Dict[str, Any]] = None) -> None:
+        """Fire one trigger ASYNCHRONOUSLY (no-op without
+        --incident-dir). Every automatic tier trigger sits on a
+        request-serving or polling thread, and the bundle's federated
+        evidence fetch pays up to 2 x health_timeout per replica — a
+        client waiting on its 502, or the health sweep, must not wait
+        for that. The manager's rate limiter (checked inside
+        trigger(), thread-safe) absorbs storms — a severed-stream
+        cascade yields a handful of bundles AND a handful of threads,
+        not thousands."""
+        if self._incidents is None:
+            return
+        if not self._incidents.would_allow():
+            # Storm path: count the drop synchronously (guaranteed
+            # cheap — no limiter re-check, no bundle, no thread)
+            # instead of spawning a thread per failed request just to
+            # have the limiter kill it.
+            self._incidents.record_drop(trigger, trace_id=trace_id)
+            return
+        threading.Thread(
+            target=self._incidents.trigger, args=(trigger,),
+            kwargs={"trace_id": trace_id, "detail": detail},
+            daemon=True, name="shellac-tier-incident",
+        ).start()
+
+    def _slo_transitioned(self, spec: SLOSpec, old: str, new: str,
+                          transition: Dict[str, Any]) -> None:
+        """SLOEngine transition hook: a PAGE landing auto-captures an
+        evidence bundle whose manifest carries the violating request's
+        trace-id exemplar — the committed counterpart of the pager
+        firing. Warnings and recoveries only alert; evidence is for
+        pages."""
+        if new != "page":
+            return
+        self._incident(
+            "slo-page",
+            trace_id=transition.get("exemplar"),
+            detail={"slo": spec.name, "from": old, "to": new,
+                    "burn": transition.get("burn")},
+        )
+
+    def _fleet_evidence(self) -> Dict[str, Any]:
+        """Federated evidence fetch: every replica's in-flight table
+        and incident list, pulled at trigger time (bounded by the
+        health timeout, best-effort per replica — a dead replica is
+        part of the story, not a reason to lose the bundle)."""
+        out: Dict[str, Any] = {}
+        for rep in self._replicas:
+            row: Dict[str, Any] = {"state": rep.state,
+                                   "role": rep.role}
+            for key, path in (("requests", "/debug/requests"),
+                              ("incidents", "/debug/incidents")):
+                try:
+                    status, body = self._get(rep.url, path,
+                                             self.health_timeout)
+                    row[key] = (json.loads(body) if status == 200
+                                else {"status": status})
+                except (OSError, ValueError,
+                        http.client.HTTPException) as e:
+                    row[key] = {"error": f"{type(e).__name__}: {e}"}
+            out[rep.url] = row
+        return out
+
     @property
     def slo_enabled(self) -> bool:
         return self._slo is not None
@@ -1571,23 +1709,35 @@ class TierRouter:
         e2e histogram's exemplars — each exemplar trace id resolves to
         a full timeline here (tier legs) and on the replica that
         served it (engine legs)."""
-        return {
+        out = {
             "recent_events": self._recorder.tail(256),
             "recorder": self._recorder.stats(),
             "exemplars": {"e2e": self._m.e2e.bucket_exemplars()},
             "replicas": [r.snapshot() for r in self._replicas],
         }
+        if self._spool is not None:
+            out["spool"] = self._spool.stats()
+        if self._incidents is not None:
+            out["last_incident"] = self._incidents.last
+        return out
 
     def debug_request(self, trace_id: str) -> Optional[Dict[str, Any]]:
         events = self._recorder.events_for(trace_id)
+        source = "ring"
+        if not events and self._spool is not None:
+            events = self._spool.events_for(trace_id)
+            source = "spool"
         if not events:
             return None
-        return {"trace_id": trace_id, "events": events}
+        return {"trace_id": trace_id, "events": events,
+                "source": source}
 
     def close(self) -> None:
         self._closed.set()
         self._poller.join(timeout=5)
         self._poll_pool.shutdown(wait=False)
+        if self._spool is not None:
+            self._spool.close()
 
 
 def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
@@ -1671,6 +1821,28 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                                trace_id=tid)
                 elif self.path == "/debug/requests":
                     self._send(200, router.debug_requests())
+                elif self.path == "/debug/incidents":
+                    if router.incidents is None:
+                        self._send(400, {
+                            "error": "incident bundles need "
+                                     "serve-tier --incident-dir",
+                        }, trace_id=tid)
+                    else:
+                        self._send(200, {
+                            "incidents": router.incidents.list(),
+                            "dir": router.incidents.incident_dir,
+                            "last": router.incidents.last,
+                        })
+                elif self.path.startswith("/debug/incident/"):
+                    bid = self.path[len("/debug/incident/"):]
+                    out = (router.incidents.load(bid)
+                           if router.incidents is not None else None)
+                    if out is None:
+                        self._send(404, {
+                            "error": f"no incident bundle {bid!r}",
+                        }, trace_id=tid)
+                    else:
+                        self._send(200, out)
                 elif self.path.startswith("/debug/request/"):
                     qid = self.path[len("/debug/request/"):]
                     out = router.debug_request(qid)
@@ -1761,6 +1933,13 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                         trace_id, "stream-severed", src="tier",
                         replica=rep_url,
                     )
+                    # A severed stream is a client-visible data loss:
+                    # capture the evidence while the dying replica's
+                    # last federated numbers are still fresh.
+                    router._incident(
+                        "stream-severed", trace_id=trace_id,
+                        detail={"replica": rep_url},
+                    )
                     # The loud in-band record carries the trace id, so
                     # the client's capture alone identifies the severed
                     # request in the tier's attempt log and the
@@ -1808,6 +1987,37 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
                 # 400, not AttributeError the handler thread.
                 self._send(400, {"error": "payload must be a JSON "
                                           "object"}, trace_id=tid)
+                return
+            if self.path == "/debug/incident":
+                # Manual tier-side evidence bundle.
+                if not router.debug_enabled:
+                    self._send(404, {"error": "debug endpoints "
+                                              "disabled"},
+                               trace_id=tid)
+                    return
+                if router.incidents is None:
+                    self._send(400, {"error": "incident bundles need "
+                                              "serve-tier "
+                                              "--incident-dir"},
+                               trace_id=tid)
+                    return
+                detail = {"via": "POST /debug/incident"}
+                if payload.get("note") is not None:
+                    detail["note"] = str(payload["note"])[:1024]
+                errors_before = router.incidents.write_errors
+                bid = router.incidents.trigger("manual", trace_id=tid,
+                                               detail=detail)
+                if bid is None:
+                    if router.incidents.write_errors > errors_before:
+                        self._send(500, {"error": "incident bundle "
+                                                  "write failed"},
+                                   trace_id=tid)
+                        return
+                    self._send(429, {"error": "incident trigger "
+                                              "rate-limited"},
+                               trace_id=tid)
+                    return
+                self._send(200, {"incident": bid}, trace_id=tid)
                 return
             if self.path == "/admin/drain":
                 if "replica" not in payload:

@@ -20,6 +20,17 @@ aggregates), and `slo.py` (declarative objectives evaluated by
 multi-window burn rate, with an ok→warning→page alert state machine
 that lands transitions in the flight recorder).
 
+The incident layer makes the evidence durable: `spool.py` (a
+rotating, size-capped JSONL spill sink the recorder writes through,
+so a SIGKILL'd replica's timelines survive to disk), `incident.py`
+(trigger-driven evidence bundles — SLO pages, supervisor rebuilds,
+severed/exhausted tier requests, manual POST /debug/incident — each
+an atomic on-disk snapshot of the recorder, metrics, in-flight table,
+SLO state, and config fingerprint), and `tracereport.py` (the
+trace-reading half of /debug/profile: op-level attribution, fusion
+counts, and phase alignment from a captured device trace, with a
+regression-flagging diff).
+
 See docs/observability.md for the metric catalog, the tracing/header
 contract, the recorder event catalog, and §Fleet.
 """
@@ -36,6 +47,10 @@ from shellac_tpu.obs.events import (
 from shellac_tpu.obs.fleet import (
     MERGED_HISTOGRAMS,
     FleetCollector,
+)
+from shellac_tpu.obs.incident import (
+    TRIGGERS,
+    IncidentManager,
 )
 from shellac_tpu.obs.metrics import (
     Counter,
@@ -58,6 +73,12 @@ from shellac_tpu.obs.slo import (
     SLOEngine,
     SLOSpec,
     parse_slo_specs,
+)
+from shellac_tpu.obs.spool import (
+    EventSpool,
+    read_spool,
+    spool_events_for,
+    spool_path,
 )
 from shellac_tpu.obs.trace import (
     STEP_PHASES,
@@ -98,4 +119,10 @@ __all__ = [
     "SLOEngine",
     "SLOSpec",
     "parse_slo_specs",
+    "IncidentManager",
+    "TRIGGERS",
+    "EventSpool",
+    "read_spool",
+    "spool_events_for",
+    "spool_path",
 ]

@@ -107,14 +107,22 @@ class FlightRecorder:
 
     `enabled=False` (serve --no-debug) turns every record() into a
     single attribute check, mirroring the disabled-Registry pattern.
+
+    `spool` (an obs.spool.EventSpool) is the durable half: every
+    recorded event is also appended to the on-disk JSONL spool, so a
+    SIGKILL'd replica's in-flight timelines survive to disk and can
+    be recovered (`top --trace <id> --spool <dir>`). The ring stays
+    authoritative for the live /debug endpoints; the spool is the
+    black-box recording an incident review reads after the crash.
     """
 
     def __init__(self, capacity: int = 2048, registry=None,
-                 enabled: bool = True):
+                 enabled: bool = True, spool=None):
         if capacity < 1:
             raise ValueError("recorder capacity must be >= 1")
         self.capacity = int(capacity)
         self.enabled = bool(enabled)
+        self.spool = spool
         self._lock = threading.Lock()
         self._events: deque = deque()
         self._seq = 0
@@ -160,6 +168,11 @@ class FlightRecorder:
             self._events.append(rec)
         if self._recorded_c is not None:
             self._recorded_c.inc()
+        if self.spool is not None:
+            # Outside the ring lock: the spool serializes itself, and
+            # file IO must not extend the ring's critical section. The
+            # `seq` field keeps global order recoverable either way.
+            self.spool.append(rec)
 
     def events_for(self, trace_id: str) -> List[Dict[str, Any]]:
         """Every retained event for one trace id, oldest first ([] for

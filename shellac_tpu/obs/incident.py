@@ -1,0 +1,466 @@
+"""Incident black box: trigger-driven, auto-captured evidence bundles.
+
+The observability stack can SEE a problem live — SLO burn rates page,
+the flight recorder holds per-request timelines — but until now every
+piece of evidence was volatile: the recorder ring dies with the
+process, /metrics is whatever the last scrape kept, and by the time a
+human opens `top` the interesting state is gone. The incident manager
+closes that gap: when a trigger fires (an SLO `page` transition, a
+supervisor wedge→rebuild, restart-budget exhaustion, a severed or
+exhausted tier request, a failed migration, or a manual
+`POST /debug/incident`), it snapshots the whole evidence surface into
+one atomic on-disk BUNDLE:
+
+    <incident-dir>/<bundle-id>/
+        manifest.json        id, trigger, time, trace-id exemplar,
+                             detail, section index, capture state
+        flight_recorder.json the full recorder ring at trigger time
+        metrics.json         registry snapshot (every series + buckets)
+        requests.json        the /debug/requests in-flight table
+        step_phases.json     per-phase step-time digest (sums/shares)
+        config.json          config + engine/mesh fingerprint
+        ...                  whatever sections the host layer wired
+        capture.json         (later) profiler-capture result, if armed
+        trace_report.json    (later) trace-report analysis of it
+
+Bundles are written to a temp dir and `os.rename`d into place, so a
+reader never sees a half-written bundle. Retention caps the bundle
+count (oldest deleted); triggering is rate-limited with the
+sliding-window RestartBudget semantics (at most `rate` bundles per
+`rate_window` seconds — a flapping SLO or a severed-stream storm
+yields a handful of bundles, not a full disk). Dropped triggers are
+counted (`shellac_incidents_dropped_total`), never silent.
+
+A trigger may also ARM a bounded `jax.profiler` capture: the host
+layer passes its own capture callable (the server's `profile()`,
+which already serializes captures through the one-at-a-time profile
+lock), the capture runs on a background thread so triggering never
+blocks the serving path, and when it completes the capture result —
+plus a `tracereport` analysis when an analyzer was wired — is written
+INTO the already-published bundle.
+
+This module is dependency-free (stdlib only) like the rest of
+`shellac_tpu.obs`: the server/tier wire their own section callables
+in, so the manager never imports the serving stack (or jax).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+#: The trigger catalog (docs/observability.md#incidents). Triggers are
+#: open-ended strings, but these are the ones the stack fires.
+TRIGGERS = (
+    "slo-page",                  # tier: fast-pair burn rate paged
+    "wedge-rebuild",             # server: watchdog wedge -> rebuild
+    "wedge-fatal",               # server: wedge, in-place factory ->
+    #                              terminal ("restart the pod")
+    "scheduler-death",           # server: scheduler died -> rebuild
+    "restart-budget-exhausted",  # server: supervisor went fatal
+    "stream-severed",            # tier: bytes lost after the client 200
+    "attempts-exhausted",        # tier: request ran out of road
+    "migration-failed",          # tier: disagg path gave up mid-flight
+    "manual",                    # POST /debug/incident
+)
+
+_MANIFEST = "manifest.json"
+
+
+class _SlidingWindow:
+    """At most `limit` events inside the trailing `window` seconds —
+    utils.failure.RestartBudget's semantics, restated here so the obs
+    package stays dependency-free (importing utils.failure would pull
+    jax into every obs consumer, including the deliberately jax-free
+    `top`)."""
+
+    def __init__(self, limit: int, window: float):
+        if limit < 1:
+            raise ValueError("rate limit must be >= 1")
+        if window <= 0:
+            raise ValueError("rate window must be > 0 seconds")
+        self.limit = int(limit)
+        self.window = float(window)
+        self._events: List[float] = []
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        t = time.monotonic() if now is None else now
+        cutoff = t - self.window
+        self._events = [e for e in self._events if e > cutoff]
+        if len(self._events) >= self.limit:
+            return False
+        self._events.append(t)
+        return True
+
+    def would_allow(self, now: Optional[float] = None) -> bool:
+        """Peek without consuming a slot (cheap pre-check for callers
+        that would otherwise spawn a thread per trigger)."""
+        t = time.monotonic() if now is None else now
+        cutoff = t - self.window
+        return sum(1 for e in self._events if e > cutoff) < self.limit
+
+    def refund(self, now: Optional[float] = None) -> None:
+        """Give back the most recent slot: a trigger whose bundle
+        write FAILED must not throttle later (possibly succeeding)
+        triggers — a full disk would otherwise convert every
+        subsequent incident into a misleading 'rate-limited' drop."""
+        del now
+        if self._events:
+            self._events.pop()
+
+
+def _bundle_id(trigger: str, at: float, seq: int) -> str:
+    """Sortable id: UTC timestamp first so lexicographic order IS
+    chronological order (retention and listing both lean on that),
+    then a per-process sequence for same-second triggers."""
+    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(at))
+    safe = "".join(c if c.isalnum() or c == "-" else "-"
+                   for c in trigger)[:32]
+    return f"inc-{stamp}-{seq:04d}-{safe}"
+
+
+class IncidentManager:
+    """Writes evidence bundles under `incident_dir`.
+
+    `sections` maps a section name to a zero-arg callable returning
+    JSON-serializable evidence; each is evaluated AT TRIGGER TIME and
+    failures are isolated per section (a broken collector yields an
+    `{"error": ...}` section, never a lost bundle). The host layer
+    (server or tier) owns the catalog; the manager owns atomicity,
+    rate limiting, retention, and the capture arm.
+    """
+
+    def __init__(
+        self,
+        incident_dir: str,
+        *,
+        source: str = "server",
+        sections: Optional[Dict[str, Callable[[], Any]]] = None,
+        registry=None,
+        recorder=None,
+        rate: int = 6,
+        rate_window: float = 600.0,
+        retention: int = 24,
+        capture_fn: Optional[Callable[[float], Dict[str, Any]]] = None,
+        capture_seconds: float = 0.0,
+        analyze_fn: Optional[Callable[[str], Dict[str, Any]]] = None,
+    ):
+        if retention < 1:
+            raise ValueError("incident retention must be >= 1")
+        if capture_seconds < 0:
+            raise ValueError("capture_seconds must be >= 0")
+        self.incident_dir = incident_dir
+        self.source = source
+        self.sections: Dict[str, Callable[[], Any]] = dict(sections or {})
+        self.retention = int(retention)
+        self._recorder = recorder
+        self._limiter = _SlidingWindow(rate, rate_window)
+        self._capture_fn = capture_fn
+        self.capture_seconds = float(capture_seconds)
+        self._analyze_fn = analyze_fn
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last: Optional[Dict[str, Any]] = None
+        #: Tmp dirs with a bundle write IN FLIGHT (triggers may run
+        #: concurrently on tier daemon threads): the retention sweep
+        #: must not mistake a live write for crash debris.
+        self._active_tmp: set = set()
+        #: Bundle writes that FAILED (disk full, permissions). Kept
+        #: distinct from rate-limiter drops so callers (the HTTP
+        #: handlers) can answer 500 instead of a misleading 429.
+        self.write_errors = 0
+        self._c_incidents = self._c_dropped = self._h_bundle = None
+        self._c_write_errors = None
+        if registry is not None:
+            self._c_incidents = registry.counter(
+                "shellac_incidents_total",
+                "Incident bundles written, by trigger",
+                labels=("trigger",),
+            )
+            self._c_dropped = registry.counter(
+                "shellac_incidents_dropped_total",
+                "Incident triggers dropped by the rate limiter "
+                "(a flapping trigger must not fill the disk)",
+                labels=("trigger",),
+            )
+            self._h_bundle = registry.histogram(
+                "shellac_incident_bundle_seconds",
+                "Wall time to collect + atomically write one bundle "
+                "(the cost an incident trigger adds to its code path)",
+            )
+            self._c_write_errors = registry.counter(
+                "shellac_incident_write_errors_total",
+                "Bundle writes that failed (disk full, permissions "
+                "on the incident dir) — evidence was LOST, by trigger",
+                labels=("trigger",),
+            )
+        os.makedirs(incident_dir, exist_ok=True)
+
+    # ---- trigger -----------------------------------------------------
+
+    def would_allow(self) -> bool:
+        """Cheap peek: would a trigger right now pass the rate
+        limiter? Advisory only (the authoritative check is inside
+        trigger()); callers that spawn a thread per trigger use it to
+        skip the spawn during a storm."""
+        with self._lock:
+            return self._limiter.would_allow()
+
+    def record_drop(self, trigger: str,
+                    trace_id: Optional[str] = None) -> None:
+        """Count one dropped trigger WITHOUT consulting the limiter
+        or attempting a write — the storm path's guaranteed-cheap
+        arm (the would_allow() peek is advisory, and re-running
+        trigger() after a False peek could race a freed slot into a
+        synchronous bundle write on a serving thread)."""
+        if self._c_dropped is not None:
+            self._c_dropped.labels(trigger=trigger).inc()
+        if self._recorder is not None:
+            self._recorder.record(trace_id, "incident-dropped",
+                                  src=self.source, trigger=trigger)
+
+    def trigger(self, trigger: str, *, trace_id: Optional[str] = None,
+                detail: Optional[Dict[str, Any]] = None,
+                capture_seconds: Optional[float] = None,
+                ) -> Optional[str]:
+        """Fire one trigger: collect every section, write the bundle
+        atomically, enforce retention, optionally arm a background
+        profiler capture. Returns the bundle id, or None when the
+        rate limiter dropped the trigger. Never raises — an incident
+        path must not add failures to the failure it is recording."""
+        with self._lock:
+            if not self._limiter.allow():
+                if self._c_dropped is not None:
+                    self._c_dropped.labels(trigger=trigger).inc()
+                if self._recorder is not None:
+                    self._recorder.record(trace_id, "incident-dropped",
+                                          src=self.source,
+                                          trigger=trigger)
+                return None
+            self._seq += 1
+            seq = self._seq
+        t0 = time.monotonic()
+        at = time.time()
+        bid = _bundle_id(trigger, at, seq)
+        want_capture = (capture_seconds
+                        if capture_seconds is not None
+                        else self.capture_seconds)
+        armed = bool(want_capture and self._capture_fn is not None)
+        manifest: Dict[str, Any] = {
+            "id": bid,
+            "trigger": trigger,
+            "at": at,
+            "at_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                    time.gmtime(at)),
+            "source": self.source,
+            "trace_id": trace_id,
+            "detail": detail or {},
+            "sections": sorted(self.sections),
+            "capture": ({"state": "armed", "seconds": want_capture}
+                        if armed else None),
+        }
+        try:
+            final = self._write_bundle(bid, manifest)
+        except Exception as e:  # noqa: BLE001 — see docstring
+            # A lost bundle is never silent: counted separately from
+            # rate-limiter drops (so an unwritable incident dir reads
+            # as a 500-class failure, not backpressure) and noted in
+            # the recorder, which at least survives in the spool.
+            self.write_errors += 1
+            with self._lock:
+                self._limiter.refund()
+            if self._c_write_errors is not None:
+                self._c_write_errors.labels(trigger=trigger).inc()
+            if self._recorder is not None:
+                self._recorder.record(
+                    trace_id, "incident-write-failed",
+                    src=self.source, trigger=trigger,
+                    error=f"{type(e).__name__}: {e}")
+            return None
+        if self._c_incidents is not None:
+            self._c_incidents.labels(trigger=trigger).inc()
+        if self._h_bundle is not None:
+            self._h_bundle.observe(time.monotonic() - t0)
+        with self._lock:
+            self._last = {"id": bid, "trigger": trigger, "at": at,
+                          "trace_id": trace_id}
+        if self._recorder is not None:
+            self._recorder.record(trace_id, "incident", src=self.source,
+                                  trigger=trigger, bundle=bid)
+        if armed:
+            threading.Thread(
+                target=self._run_capture,
+                args=(final, float(want_capture)),
+                daemon=True, name=f"shellac-incident-capture-{bid}",
+            ).start()
+        self._enforce_retention()
+        return bid
+
+    def _write_bundle(self, bid: str, manifest: Dict[str, Any]) -> str:
+        """Collect sections and publish the bundle directory with one
+        rename: a crash mid-write leaves only a .tmp- dir (swept on
+        the next trigger), never a half bundle."""
+        tmp = os.path.join(self.incident_dir, f".tmp-{bid}")
+        final = os.path.join(self.incident_dir, bid)
+        with self._lock:
+            self._active_tmp.add(tmp)
+        try:
+            os.makedirs(tmp, exist_ok=True)
+            for name, fn in sorted(self.sections.items()):
+                try:
+                    data = fn()
+                except Exception as e:  # noqa: BLE001 — per-section
+                    data = {"error": f"{type(e).__name__}: {e}"}
+                with open(os.path.join(tmp, f"{name}.json"),
+                          "w") as f:
+                    json.dump(data, f, default=str)
+            with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                json.dump(manifest, f, indent=1, default=str)
+            os.rename(tmp, final)
+        finally:
+            with self._lock:
+                self._active_tmp.discard(tmp)
+        return final
+
+    def _run_capture(self, bundle_dir: str, seconds: float) -> None:
+        """Background capture arm: run the host's profiler capture,
+        then (when an analyzer is wired) the trace-report analysis,
+        writing both into the published bundle. Additive writes into
+        a final directory — readers treat these files as optional."""
+        result: Dict[str, Any]
+        try:
+            result = dict(self._capture_fn(seconds) or {})
+            result["state"] = "done"
+        except Exception as e:  # noqa: BLE001 — capture is best-effort
+            result = {"state": "failed",
+                      "error": f"{type(e).__name__}: {e}"}
+        try:
+            with open(os.path.join(bundle_dir, "capture.json"),
+                      "w") as f:
+                json.dump(result, f, default=str)
+        except OSError:
+            return
+        # Reflect the settled state in the manifest too (atomically):
+        # GET /debug/incidents summarizes manifests only, and "armed"
+        # forever would hide a capture that silently died.
+        self._update_manifest_capture(bundle_dir, {
+            "state": result["state"],
+            "seconds": seconds,
+            "trace_dir": result.get("trace_dir"),
+            "error": result.get("error"),
+        })
+        trace_dir = result.get("trace_dir")
+        if result.get("state") != "done" or not trace_dir \
+                or self._analyze_fn is None:
+            return
+        try:
+            report = self._analyze_fn(str(trace_dir))
+        except Exception as e:  # noqa: BLE001
+            report = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            with open(os.path.join(bundle_dir, "trace_report.json"),
+                      "w") as f:
+                json.dump(report, f, default=str)
+        except OSError:
+            pass
+
+    def _update_manifest_capture(self, bundle_dir: str,
+                                 capture: Dict[str, Any]) -> None:
+        path = os.path.join(bundle_dir, _MANIFEST)
+        manifest = self._read_json(path)
+        if not isinstance(manifest, dict):
+            return  # bundle evicted by retention meanwhile
+        manifest["capture"] = {k: v for k, v in capture.items()
+                               if v is not None}
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def _enforce_retention(self) -> None:
+        """Delete the oldest bundles past `retention`, plus any
+        orphaned .tmp- debris from a crash mid-write."""
+        try:
+            entries = sorted(os.listdir(self.incident_dir))
+        except OSError:
+            return
+        with self._lock:
+            active = set(self._active_tmp)
+        for name in entries:
+            if name.startswith(".tmp-"):
+                path = os.path.join(self.incident_dir, name)
+                # A concurrent trigger (tier daemon threads) may still
+                # be writing its bundle here — only orphans (a crash's
+                # debris) are swept.
+                if path not in active:
+                    shutil.rmtree(path, ignore_errors=True)
+        bundles = [n for n in entries if n.startswith("inc-")]
+        for name in bundles[: max(0, len(bundles) - self.retention)]:
+            shutil.rmtree(os.path.join(self.incident_dir, name),
+                          ignore_errors=True)
+
+    # ---- reads -------------------------------------------------------
+
+    @property
+    def last(self) -> Optional[Dict[str, Any]]:
+        """The most recent bundle's {id, trigger, at, trace_id} (the
+        `top` dashboard's last-incident line), or None."""
+        with self._lock:
+            return dict(self._last) if self._last else None
+
+    def list(self) -> List[Dict[str, Any]]:
+        """Manifest summaries of every retained bundle, oldest first
+        (the GET /debug/incidents payload). Retention bounds the scan
+        to a couple dozen small files."""
+        out: List[Dict[str, Any]] = []
+        try:
+            names = sorted(os.listdir(self.incident_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.startswith("inc-"):
+                continue
+            m = self._read_json(os.path.join(self.incident_dir, name,
+                                             _MANIFEST))
+            if m is None:
+                continue
+            out.append({k: m.get(k)
+                        for k in ("id", "trigger", "at", "at_iso",
+                                  "trace_id", "source", "capture")})
+        return out
+
+    def load(self, bundle_id: str) -> Optional[Dict[str, Any]]:
+        """One full bundle — manifest plus every section file — or
+        None for an unknown/evicted id (GET /debug/incident/<id>)."""
+        if os.sep in bundle_id or not bundle_id.startswith("inc-"):
+            return None  # ids never contain path structure
+        bdir = os.path.join(self.incident_dir, bundle_id)
+        manifest = self._read_json(os.path.join(bdir, _MANIFEST))
+        if manifest is None:
+            return None
+        out: Dict[str, Any] = {"manifest": manifest}
+        try:
+            files = os.listdir(bdir)
+        except OSError:
+            return None
+        for name in sorted(files):
+            if name == _MANIFEST or not name.endswith(".json"):
+                continue
+            out[name[: -len(".json")]] = self._read_json(
+                os.path.join(bdir, name))
+        return out
+
+    @staticmethod
+    def _read_json(path: str) -> Optional[Any]:
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None

@@ -238,6 +238,9 @@ class SLOEngine:
                  registry=None, recorder=None,
                  exemplar_fn: Optional[
                      Callable[[SLOSpec], Optional[str]]] = None,
+                 on_transition: Optional[
+                     Callable[[SLOSpec, str, str,
+                               Dict[str, object]], None]] = None,
                  page_burn: float = PAGE_BURN,
                  warn_burn: float = WARN_BURN):
         self.specs = list(specs)
@@ -245,6 +248,12 @@ class SLOEngine:
         self.warn_burn = float(warn_burn)
         self._recorder = recorder
         self._exemplar_fn = exemplar_fn
+        # Transition hook: called AFTER the state/gauges/recorder are
+        # updated, with (spec, old, new, last_transition). The tier
+        # hangs the incident manager off this seam — a `page` landing
+        # auto-captures an evidence bundle. Exceptions are swallowed:
+        # a broken hook must never break alerting.
+        self._on_transition = on_transition
         self._lock = threading.Lock()
         self._tracks = {s.name: _Track(s) for s in self.specs}
         self._g_burn = self._g_state = self._g_good = None
@@ -311,6 +320,7 @@ class SLOEngine:
         cumulative since replica/tier start (the engine differences
         them per window)."""
         now = time.monotonic() if now is None else now
+        fired: List[Tuple[SLOSpec, str, str, Dict[str, object]]] = []
         with self._lock:
             for name, track in self._tracks.items():
                 good, total = counts.get(name, track.last_counts)
@@ -332,7 +342,20 @@ class SLOEngine:
                     )
                 new_state = self._classify(burns)
                 if new_state != track.state:
+                    old = track.state
                     self._transition(track, new_state, burns, now)
+                    fired.append((track.spec, old, new_state,
+                                  dict(track.last_transition)))
+        # Hooks fire AFTER the engine lock drops: a hook that reads
+        # back through status()/state() (the tier's incident trigger
+        # does, via its bundle sections) must not deadlock the tick.
+        if self._on_transition is not None:
+            for spec, old, new_state, transition in fired:
+                try:
+                    self._on_transition(spec, old, new_state,
+                                        transition)
+                except Exception:  # noqa: BLE001 — hooks must never
+                    pass           # break alerting
 
     def _classify(self, burns: Dict[str, float]) -> str:
         fast = [burns[label] for label, _ in FAST_WINDOWS]

@@ -1,0 +1,257 @@
+"""Durable event spool: a rotating, size-capped JSONL spill sink for
+the flight recorder.
+
+The recorder's ring is deliberately volatile — a bounded in-memory
+deque that dies with the process. That is the right cost model for a
+healthy replica, and exactly wrong for the replica that matters in an
+incident review: a SIGKILL'd pod takes every in-flight timeline with
+it. The spool is the durability half: when configured (`serve
+--spool-dir`), every recorder event is ALSO appended as one JSON line
+to an on-disk file, flushed per write, so `kill -9` mid-stream leaves
+the request's admit/prefill/first-token/delta history readable from
+disk (`top --trace <id> --spool <dir>`, or `read_spool()` directly).
+
+Durability model: `flush()` per event pushes the line into the OS
+page cache — that survives PROCESS death (the incident-review case),
+not machine power loss. No fsync: the spool rides the serving path
+and a per-event fsync would turn every lifecycle event into a disk
+round-trip.
+
+Size model: one active file plus one rotated predecessor, each capped
+at `max_bytes // 2` — total on-disk footprint <= max_bytes however
+long the replica runs, mirroring the ring's bounded-memory contract.
+Rotation is `os.replace` of the whole file, so a reader never sees a
+half-truncated file, and the torn LAST line a kill can leave behind
+is skipped (not fatal) at read time.
+
+Redaction: the PR 10 rule applies on the way to disk too. Unless the
+spool was built with `include_text=True` (the server wires its own
+`--debug-include-text` through), prompt/output text keys are stripped
+from every record — a crash dump must not become a transcript
+exfiltration path any more than the live /debug endpoints may.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+#: Event fields that may carry prompt/generated text (the PR 10
+#: redaction surface); stripped unless the spool opts into text.
+TEXT_FIELDS = ("prompt_text", "output_text", "text")
+
+#: Default on-disk footprint cap (active + rotated file together).
+DEFAULT_MAX_BYTES = 8 << 20
+
+#: Active spool file name under a spool directory.
+SPOOL_NAME = "events.jsonl"
+
+
+def spool_path(spool_dir: str) -> str:
+    return os.path.join(spool_dir, SPOOL_NAME)
+
+
+class EventSpool:
+    """Append-only JSONL sink with one-file rotation.
+
+    Thread-safe; writers pay one lock + one buffered write + flush per
+    event. A spool that hits an OSError (disk full, permissions)
+    disables itself and counts the failure rather than raising into
+    the serving path — durability is best-effort, serving is not.
+    """
+
+    def __init__(self, path: str, *, max_bytes: int = DEFAULT_MAX_BYTES,
+                 include_text: bool = False):
+        if max_bytes < 4096:
+            raise ValueError("spool max_bytes must be >= 4096")
+        self.path = path
+        self.max_bytes = int(max_bytes)
+        self.include_text = bool(include_text)
+        # Per-process run token stamped into every record (`_run`):
+        # the recorder's `seq` restarts at 1 with the process, so a
+        # spool spanning a restart (the SIGKILL-then-respawn scenario)
+        # needs run identity to order the two runs — readers order by
+        # (run first-appearance, seq) and then strip the field.
+        self.run_id = os.urandom(4).hex()
+        self._lock = threading.Lock()
+        self._fh = None
+        self._size = 0
+        self.write_errors = 0
+        self.rotations = 0
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    # ---- write side --------------------------------------------------
+
+    def _open_locked(self) -> None:
+        if self._fh is None:
+            # Binary append: the size cap is a BYTE budget, and a
+            # text-mode len(str) would undercount multibyte UTF-8
+            # (non-ASCII prompt text under include_text) ~3x.
+            self._fh = open(self.path, "ab")
+            self._size = self._fh.tell()
+
+    def _rotate_locked(self) -> None:
+        """Active file -> `<path>.1` (clobbering the previous rotation)
+        atomically; a fresh active file starts empty. Keeping exactly
+        one predecessor bounds the footprint at max_bytes while a
+        reader still sees up to a full cap of history."""
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        os.replace(self.path, self.path + ".1")
+        self.rotations += 1
+        self._open_locked()
+
+    def append(self, event: Dict[str, Any]) -> None:
+        """Write one recorder event as a JSON line (redacted unless
+        include_text). Errors disable the spool for the process — a
+        full disk degrades durability, never serving."""
+        if self._fh is None and self.write_errors:
+            return  # disabled after a write failure
+        if self.include_text:
+            event = dict(event)
+        else:
+            event = {k: v for k, v in event.items()
+                     if k not in TEXT_FIELDS}
+        event["_run"] = self.run_id
+        line = (json.dumps(event, default=str) + "\n").encode("utf-8")
+        if len(line) > self.max_bytes // 2:
+            # One record must never exceed a whole file's budget
+            # (rotation could not bound it). Keep the skeleton —
+            # losing the oversized payload honestly beats breaking
+            # the footprint contract.
+            event = {k: event[k] for k in
+                     ("seq", "ts", "trace", "event", "_run")
+                     if k in event}
+            event["truncated"] = True
+            line = (json.dumps(event, default=str) + "\n").encode(
+                "utf-8")
+        with self._lock:
+            try:
+                self._open_locked()
+                if self._size + len(line) > self.max_bytes // 2:
+                    self._rotate_locked()
+                self._fh.write(line)
+                # Per-event flush into the page cache: the line must
+                # survive a SIGKILL that lands between events.
+                self._fh.flush()
+                self._size += len(line)
+            except OSError:
+                self.write_errors += 1
+                try:
+                    if self._fh is not None:
+                        self._fh.close()
+                finally:
+                    self._fh = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "path": self.path,
+                "max_bytes": self.max_bytes,
+                "size": self._size,
+                "rotations": self.rotations,
+                "write_errors": self.write_errors,
+                "include_text": self.include_text,
+            }
+
+    # ---- read side ---------------------------------------------------
+
+    def events(self) -> List[Dict[str, Any]]:
+        return read_spool(self.path)
+
+    def events_for(self, trace_id: str) -> List[Dict[str, Any]]:
+        return spool_events_for(self.path, trace_id)
+
+
+def _read_lines(path: str) -> Iterable[Dict[str, Any]]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    # A SIGKILL between write() and flush() can leave a
+                    # torn final line; everything before it is intact.
+                    continue
+                if isinstance(rec, dict):
+                    yield rec
+    except OSError:
+        return
+
+
+def resolve_spool_path(path: str) -> str:
+    """Accept the active spool file OR the directory holding it (the
+    serve --spool-dir value an operator remembers)."""
+    if os.path.isdir(path):
+        return spool_path(path)
+    return path
+
+
+def _iter_spool(path: str) -> Iterator[Dict[str, Any]]:
+    path = resolve_spool_path(path)
+    yield from _read_lines(path + ".1")
+    yield from _read_lines(path)
+
+
+def _order(out: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Restore event order and strip the `_run` bookkeeping field.
+
+    `seq` is assigned under the recorder's ring lock but the spool
+    append happens outside it, so racing writers can land out of file
+    order — seq is the authority WITHIN one process run. Across runs
+    (a restarted replica reusing its --spool-dir) seq resets to 1, so
+    runs are ordered by first appearance in the file and seq sorts
+    within each."""
+    runs: Dict[str, int] = {}
+    for e in out:
+        r = str(e.get("_run", ""))
+        if r not in runs:
+            runs[r] = len(runs)
+    if all("seq" in e for e in out):
+        out.sort(key=lambda e: (runs[str(e.get("_run", ""))],
+                                e["seq"]))
+    for e in out:
+        e.pop("_run", None)
+    return out
+
+
+def read_spool(path: str) -> List[Dict[str, Any]]:
+    """Every retained event, oldest first: the rotated predecessor
+    (if any) then the active file. `path` is the active spool file or
+    its directory."""
+    return _order(list(_iter_spool(path)))
+
+
+def spool_events_for(path: str, trace_id: Optional[str]
+                     ) -> List[Dict[str, Any]]:
+    """One trace id's timeline recovered from disk (the dead-replica
+    path behind `top --trace <id> --spool <dir>`, and the live
+    server's ring-miss fallback). Filters WHILE parsing so a lookup
+    holds only the matching events, not the whole spool — though
+    every line is still scanned (the spool is an append log, not an
+    index); treat this as a debug path, not a hot one. Case-
+    normalizes like FlightRecorder.events_for."""
+    if not trace_id:
+        return []
+    low = trace_id.lower()
+    hits: List[Dict[str, Any]] = []
+    low_hits: List[Dict[str, Any]] = []
+    for e in _iter_spool(path):
+        t = e.get("trace")
+        if t == trace_id:
+            hits.append(e)
+        elif low != trace_id and t == low:
+            low_hits.append(e)
+    return _order(hits or low_hits)

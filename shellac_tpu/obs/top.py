@@ -80,6 +80,7 @@ def collect(tier_url: str, timeout: float = 5.0) -> Dict[str, Any]:
         "stats": _get_json(base, "/stats", timeout),
         "slo": _get_json(base, "/slo", timeout),
         "debug": _get_json(base, "/debug/requests", timeout),
+        "incidents": _get_json(base, "/debug/incidents", timeout),
         "metrics": (parse_prometheus_text(metrics_text)
                     if metrics_text is not None else None),
     }
@@ -242,6 +243,22 @@ def render(snapshot: Dict[str, Any], width: int = 100) -> str:
                 out.append(f"  {_short(r['url'], 30):<32}"
                            + "  ".join(parts))
 
+    # -- last incident -------------------------------------------------
+    # One line, always near the bottom: the most recent evidence
+    # bundle (tier-side --incident-dir), so "did the black box fire"
+    # is answered without leaving the dashboard.
+    incidents = snapshot.get("incidents")
+    last = incidents.get("last") if isinstance(incidents, dict) else None
+    if last:
+        age = time.time() - float(last.get("at") or time.time())
+        out.append("")
+        out.append(
+            f"last incident: {last.get('id')} "
+            f"[{last.get('trigger')}] {age:.0f}s ago"
+            + (f" trace {str(last.get('trace_id'))[:18]}…"
+               if last.get("trace_id") else "")
+        )
+
     # -- recent events -------------------------------------------------
     if debug and debug.get("recent_events"):
         out.append("")
@@ -271,16 +288,29 @@ def render_trace(timeline: Dict[str, Any]) -> str:
     return "\n".join(out) + "\n"
 
 
-def run_top(tier: str, *, once: bool = False, interval: float = 2.0,
-            trace: Optional[str] = None, timeout: float = 5.0,
+def run_top(tier: Optional[str], *, once: bool = False,
+            interval: float = 2.0, trace: Optional[str] = None,
+            timeout: float = 5.0, spool: Optional[str] = None,
             out=None) -> int:
     out = sys.stdout if out is None else out
     if trace is not None:
-        timeline = _get_json(tier.rstrip("/"),
-                             f"/debug/request/{trace}", timeout)
+        timeline = (_get_json(tier.rstrip("/"),
+                              f"/debug/request/{trace}", timeout)
+                    if tier else None)
+        if timeline is None and spool:
+            # Dead-replica path: the tier (or the replica) is gone,
+            # but the durable spool on disk still holds the timeline.
+            from shellac_tpu.obs.spool import spool_events_for
+
+            events = spool_events_for(spool, trace)
+            if events:
+                timeline = {"trace_id": trace, "events": events,
+                            "source": "spool"}
         if timeline is None:
             out.write(f"no recorded timeline for {trace!r} "
-                      "(evicted, never seen, or --no-debug)\n")
+                      "(evicted, never seen, --no-debug — or pass "
+                      "--spool <dir> to read a dead replica's "
+                      "on-disk spool)\n")
             return 1
         out.write(render_trace(timeline))
         return 0
