@@ -38,7 +38,8 @@ import numpy as np
 
 def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
                  decode_ticks=1, kv_quant=None, rolling=False,
-                 registry=None, overlap=False, spec_draft=None, gamma=3):
+                 registry=None, overlap=False, overlap_prefill=False,
+                 max_prefills_per_step=None, spec_draft=None, gamma=3):
     from shellac_tpu.inference.batching import (
         BatchingEngine,
         PagedBatchingEngine,
@@ -73,12 +74,15 @@ def build_engine(cfg, params, *, paged, impl, n_slots, max_len,
             block_size=64, pool_tokens=n_slots * max_len,
             temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
             kv_quant=kv_quant, registry=registry, overlap_decode=overlap,
+            overlap_prefill=overlap_prefill,
+            max_prefills_per_step=max_prefills_per_step,
         )
     return BatchingEngine(
         cfg, params, n_slots=n_slots, max_len=max_len,
         temperature=0.0, attn_impl=impl, decode_ticks=decode_ticks,
         kv_quant=kv_quant, rolling_window=rolling, registry=registry,
-        overlap_decode=overlap,
+        overlap_decode=overlap, overlap_prefill=overlap_prefill,
+        max_prefills_per_step=max_prefills_per_step,
     )
 
 
@@ -202,6 +206,91 @@ def churn(cfg, params, *, paged, impl, n_slots, ctx, max_len, rng,
         shim.uninstall()
     total = sum(len(v) for v in results.values())
     assert len(results) == n_req
+    return total / dt, total
+
+
+def mixed_prefill_churn(cfg, params, *, n_slots, ctx, max_len, rng,
+                        decode_ticks=1, overlap_prefill=False,
+                        device_latency=0.0, prefill_latency=0.0,
+                        host_latency=0.0, registry=None, n_long=None,
+                        gen_budget=None):
+    """Mixed prefill-heavy churn: steady decoders + a stream of
+    long-prompt admissions; tokens/s generated over the timed drain.
+
+    The admission-side twin of churn(): a few slots decode steadily
+    (long budgets) while a stream of long-prompt, ~2-window-budget
+    requests churns through the rest, capped at one prefill per step —
+    so nearly every step runs an admission, exactly the regime where a
+    synchronous per-prefill settle stalls the decode hot path. The
+    SimulatedHostLatency shim stretches BOTH clocks: each decode
+    window's results arrive device_latency after dispatch, each
+    prefill's prefill_latency after dispatch. Without overlap_prefill
+    the admission blocks for the whole prefill round trip inline; with
+    it the settle rides the next step boundary and the round trip
+    hides behind the window the device was computing anyway — the
+    contrast the perf gate's prefill rows assert."""
+    from shellac_tpu.obs import ServeMetrics, get_registry
+
+    eng = build_engine(
+        cfg, params, paged=False, impl="ref", n_slots=n_slots,
+        max_len=max_len, decode_ticks=decode_ticks, registry=registry,
+        overlap=True, overlap_prefill=overlap_prefill,
+        max_prefills_per_step=1,
+    )
+    shim = None
+    if device_latency > 0 or prefill_latency > 0:
+        from shellac_tpu.inference.autotune import SimulatedHostLatency
+
+        shim = SimulatedHostLatency(eng, device_s=device_latency,
+                                    prefill_s=prefill_latency)
+    sm = ServeMetrics(registry if registry is not None else get_registry())
+    if n_long is None:
+        n_long = 3 * n_slots
+    if gen_budget is None:
+        # ~2 windows per long request: the stream stays dense enough
+        # that nearly every step runs an admission (the cap is 1), so
+        # the off-arm pays the inline prefill round trip per step —
+        # the regime the pipeline exists for.
+        gen_budget = max(4, 2 * decode_ticks)
+    n_steady = max(1, n_slots // 4)
+    steady_budget = max(
+        8, (n_long // max(1, n_slots - n_steady) + 2) * gen_budget
+    )
+    reqs = []
+    # Steady decoders: short prompts, budgets long enough to live
+    # through the whole long-prompt stream.
+    for i in range(n_steady):
+        prompt = rng.integers(0, cfg.vocab_size, size=8, dtype=np.int64)
+        reqs.append((("steady", i), prompt, steady_budget))
+    # The prefill-heavy stream: full-ctx prompts, small budgets.
+    for i in range(n_long):
+        prompt = rng.integers(0, cfg.vocab_size, size=ctx, dtype=np.int64)
+        reqs.append((("long", i), prompt, gen_budget))
+    # Warm every prefill bucket + the decode program outside the timed
+    # region (same rationale as churn()).
+    for wi, wlen in enumerate((8, ctx)):
+        eng.submit(("warm", wi),
+                   rng.integers(0, cfg.vocab_size, size=wlen,
+                                dtype=np.int64), max_new=2)
+    while eng.pending:
+        eng.step()
+    t0 = time.perf_counter()
+    traces = {}
+    for rid, prompt, max_new in reqs:
+        traces[rid] = sm.trace()
+        eng.submit(rid, prompt, max_new, trace=traces[rid])
+    results = {}
+    while eng.pending:
+        for rid, out in eng.step():
+            traces[rid].finish(len(out))
+            results[rid] = out
+        if host_latency > 0:
+            time.sleep(host_latency)
+    dt = time.perf_counter() - t0
+    if shim is not None:
+        shim.uninstall()
+    total = sum(len(v) for v in results.values())
+    assert len(results) == len(reqs)
     return total / dt, total
 
 
@@ -432,7 +521,13 @@ def gate(cfg, params, args, backend):
          auto-tuner, or breaking overlap all fail this);
       2. overlap speedup vs the strict-ordering run of the SAME
          invocation >= the committed floor (1.5x) — the pipeline must
-         actually hide the injected host/RPC time.
+         actually hide the injected host/RPC time;
+      3. the mixed prefill-heavy rows: tokens/s vs baseline, prefill
+         overlap speedup (on vs off, same invocation) >= its floor
+         (1.3x), and the step-phase digest's prefill share
+         (prefill_dispatch + prefill_settle) must FALL under overlap —
+         the admission-side pipeline must actually hide the injected
+         prefill round trip, not just exist.
 
     --write-gate-baseline re-baselines (run it when the gate workload
     itself changes, and commit the JSON with the change that moved
@@ -516,12 +611,56 @@ def gate(cfg, params, args, backend):
     )
     phase_digests["spec_paged"] = step_phase_digest(spec_reg)
 
+    # Mixed prefill-heavy churn (the admission-side pipeline): long-
+    # prompt admissions interleaved with steady decode, with the
+    # prefill clock stretched like the window clock. overlap_prefill
+    # on vs off in the SAME invocation — the on-arm honors the
+    # --overlap-prefill pin so CI can prove the gate fails when the
+    # pipeline is disabled (the --decode-ticks 1 self-test's twin).
+    prefill_s = args.prefill_latency_ms / 1e3
+    mixed = {}
+    for opf in (True, False):
+        rng = np.random.default_rng(2)
+        reg = Registry()
+        tok_s, _ = mixed_prefill_churn(
+            cfg, params, n_slots=args.slots, ctx=args.ctx,
+            max_len=max_len, rng=rng, decode_ticks=ticks,
+            overlap_prefill=opf and args.overlap_prefill,
+            # A quarter of the decode rows' window latency: the mixed
+            # rows measure the ADMISSION-side pipeline, so the on-arm
+            # must not simply be window-bound — the contrast is the
+            # inline prefill round trip vs the batched settle.
+            device_latency=device_s / 4, prefill_latency=prefill_s,
+            host_latency=host_s, registry=reg,
+            n_long=2 * args.slots,
+        )
+        mixed[opf] = tok_s
+        phase_digests["mixed_prefill" if opf
+                      else "mixed_prefill_serial"] = (
+            step_phase_digest(reg)
+        )
+    prefill_speedup = mixed[True] / max(mixed[False], 1e-9)
+
+    def _prefill_share(digest):
+        """prefill_dispatch + prefill_settle share of the attributed
+        step time — the admission-side cost the pipeline exists to
+        hide (the pre-split metric was prefill_dispatch alone)."""
+        return sum(digest.get(p, {}).get("share", 0.0)
+                   for p in ("prefill_dispatch", "prefill_settle"))
+
     summary = {
         "metric": f"decode_gate_{args.model}_{backend}",
         "churn_tokens_s": round(rates[True], 1),
         "serial_tokens_s": round(rates[False], 1),
         "overlap_speedup": round(speedup, 3),
         "spec_paged_tokens_s": round(spec_tok_s, 1),
+        "mixed_prefill_tokens_s": round(mixed[True], 1),
+        "mixed_prefill_serial_tokens_s": round(mixed[False], 1),
+        "prefill_overlap_speedup": round(prefill_speedup, 3),
+        "prefill_share_overlap": round(
+            _prefill_share(phase_digests["mixed_prefill"]), 3),
+        "prefill_share_serial": round(
+            _prefill_share(phase_digests["mixed_prefill_serial"]), 3),
         "decode_ticks": ticks,
         "autotune": tuned,
         "step_phases": phase_digests,
@@ -529,6 +668,7 @@ def gate(cfg, params, args, backend):
             "slots": args.slots, "ctx": args.ctx,
             "device_latency_ms": args.device_latency_ms,
             "host_latency_ms": args.host_latency_ms,
+            "prefill_latency_ms": args.prefill_latency_ms,
         },
     }
 
@@ -537,6 +677,8 @@ def gate(cfg, params, args, backend):
             "churn_tokens_s": summary["churn_tokens_s"],
             "overlap_speedup_floor": 1.5,
             "spec_paged_tokens_s": summary["spec_paged_tokens_s"],
+            "mixed_prefill_tokens_s": summary["mixed_prefill_tokens_s"],
+            "prefill_overlap_speedup_floor": 1.3,
             "tolerance": 0.15,
             "params": summary["params"],
         }
@@ -581,6 +723,28 @@ def gate(cfg, params, args, backend):
             f"{spec_base * (1.0 - tol):.1f} "
             f"(baseline {spec_base} - {tol:.0%})"
         )
+    mixed_base = baseline.get("mixed_prefill_tokens_s")
+    if mixed_base is not None:
+        pfloor = float(baseline.get("prefill_overlap_speedup_floor",
+                                    1.3))
+        if mixed[True] < mixed_base * (1.0 - tol):
+            failures.append(
+                f"mixed prefill-heavy churn tokens/s "
+                f"{mixed[True]:.1f} < {mixed_base * (1.0 - tol):.1f} "
+                f"(baseline {mixed_base} - {tol:.0%})"
+            )
+        if prefill_speedup < pfloor:
+            failures.append(
+                f"prefill overlap speedup {prefill_speedup:.2f}x < "
+                f"required {pfloor}x"
+            )
+        if (summary["prefill_share_overlap"]
+                >= summary["prefill_share_serial"]):
+            failures.append(
+                "step-phase digest: prefill share did not fall under "
+                f"overlap ({summary['prefill_share_overlap']} >= "
+                f"{summary['prefill_share_serial']})"
+            )
     summary["gate"] = "fail" if failures else "pass"
     if failures:
         summary["failures"] = failures
@@ -616,6 +780,18 @@ def main():
                     dest="host_latency_ms",
                     help="simulated per-step host work "
                          "(gate default 60)")
+    ap.add_argument("--prefill-latency-ms", type=float, default=0.0,
+                    dest="prefill_latency_ms",
+                    help="simulated per-prefill device/RPC latency "
+                         "for the mixed prefill-heavy gate rows "
+                         "(gate default 250)")
+    ap.add_argument("--overlap-prefill", dest="overlap_prefill",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="gate mode: run the mixed prefill-heavy "
+                         "on-arm with the in-flight prefill pipeline "
+                         "(--no-overlap-prefill pins it off — the CI "
+                         "self-test proving the prefill gate rows can "
+                         "fail)")
     ap.add_argument("--gate", action="store_true",
                     help="CI perf regression gate: overlapped churn "
                          "under the simulated-latency harness vs the "
@@ -699,6 +875,13 @@ def main():
             args.device_latency_ms = 400.0
         if not args.host_latency_ms:
             args.host_latency_ms = 250.0
+        if not args.prefill_latency_ms:
+            # Large against real tiny-model prefill compute, but at
+            # most the hiding capacity of one step boundary (the host
+            # sleep + the mixed rows' smaller window clock): the
+            # on-arm then hides nearly all of it while the off-arm
+            # pays it inline per admission.
+            args.prefill_latency_ms = 250.0
         if args.gate_baseline is None:
             args.gate_baseline = os.path.join(
                 os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

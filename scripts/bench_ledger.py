@@ -42,6 +42,11 @@ _GATE_VARIANTS = (
     ("overlap", "churn_tokens_s"),
     ("serial", "serial_tokens_s"),
     ("spec_paged", "spec_paged_tokens_s"),
+    # Round 7+: the mixed prefill-heavy rows (overlapped prefill on
+    # vs off). Absent from earlier rounds — the loop skips variants a
+    # round's payload doesn't carry.
+    ("mixed_prefill", "mixed_prefill_tokens_s"),
+    ("mixed_prefill_serial", "mixed_prefill_serial_tokens_s"),
 )
 
 

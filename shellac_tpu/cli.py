@@ -40,6 +40,20 @@ def _decode_ticks_arg(v: str):
         )
 
 
+def _prefill_chunk_arg(v: str):
+    """--prefill-chunk parser: an int >= 1, or 'auto' (startup sweep
+    of chunk candidates on the live engine — the TTFT-vs-TPOT
+    fairness knob, measured instead of guessed)."""
+    if v == "auto":
+        return v
+    try:
+        return int(v)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--prefill-chunk wants an integer or 'auto', got {v!r}"
+        )
+
+
 def _model_config(args):
     from shellac_tpu.config import ModelConfig
     from shellac_tpu.models.registry import PRESETS
@@ -769,6 +783,7 @@ def cmd_batch(args):
         temperature=args.temperature, eos_id=args.eos_id,
         decode_ticks=args.decode_ticks,
         overlap_decode=args.overlap_decode,
+        overlap_prefill=args.overlap_prefill,
         mesh=mesh, seed=args.seed,
         cache_backend=backend_name,
         logprobs=args.logprobs,
@@ -895,6 +910,23 @@ def cmd_serve(args):
             "verify round's acceptance counts gate the next round); use "
             "--no-overlap-decode"
         )
+    if args.overlap_prefill is None:
+        # Same default policy as --overlap-decode: on, silently off
+        # for speculative serving (draft + target caches fill in
+        # lockstep at admission — nothing to defer).
+        args.overlap_prefill = not args.draft_model
+    elif args.draft_model and args.overlap_prefill:
+        raise SystemExit(
+            "--overlap-prefill does not compose with --draft-model "
+            "(admission fills the draft and target caches in "
+            "lockstep); use --no-overlap-prefill"
+        )
+    if args.draft_model and args.prefill_chunk == "auto":
+        raise SystemExit(
+            "--prefill-chunk auto does not tune speculative engines "
+            "(they pin their own prefill discipline); pass an "
+            "explicit chunk size"
+        )
     if args.pp_pipeline and (paged or args.draft_model):
         raise SystemExit(
             "--pp-pipeline composes with the slot caches (dense, "
@@ -1008,6 +1040,7 @@ def cmd_serve(args):
                 temperature=args.temperature, eos_id=args.eos_id,
                 decode_ticks=args.decode_ticks,
                 overlap_decode=args.overlap_decode,
+                overlap_prefill=args.overlap_prefill,
                 max_prefills_per_step=args.max_prefills_per_step,
                 prefill_chunk=args.prefill_chunk,
                 logprobs=args.logprobs,
@@ -1047,6 +1080,7 @@ def cmd_serve(args):
         temperature=args.temperature, eos_id=args.eos_id,
         decode_ticks=args.decode_ticks,
         overlap_decode=args.overlap_decode,
+        overlap_prefill=args.overlap_prefill,
         autotune=True,
         max_prefills_per_step=args.max_prefills_per_step,
         prefill_chunk=args.prefill_chunk,
@@ -1452,6 +1486,11 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--overlap-decode", dest="overlap_decode",
                    action=argparse.BooleanOptionalAction, default=True,
                    help="overlapped window dispatch during the drain")
+    b.add_argument("--overlap-prefill", dest="overlap_prefill",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="in-flight prefill pipeline during the drain "
+                        "(admissions dispatch without syncing; one "
+                        "batched settle per step boundary)")
     b.add_argument("--mesh", default="", help="e.g. tp=4")
     b.add_argument("--cache-backend", default=None, dest="cache_backend",
                    choices=["dense", "dense-int8", "paged", "paged-int8",
@@ -1538,6 +1577,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "seeded outputs are token-identical either "
                         "way; --no-overlap-decode restores strict "
                         "ordering; default on, off for --draft-model)")
+    s.add_argument("--overlap-prefill", dest="overlap_prefill",
+                   action=argparse.BooleanOptionalAction, default=None,
+                   help="in-flight prefill pipeline: admissions "
+                        "dispatch their prefill and return, and every "
+                        "in-flight prefill settles in one batched "
+                        "pull at the next step boundary, so a prompt "
+                        "burst no longer stalls the decode hot path "
+                        "(TTFT is recorded at settle; greedy and "
+                        "seeded outputs are token-identical either "
+                        "way; default on, off for --draft-model)")
     s.add_argument("--pp-pipeline", action="store_true",
                    dest="pp_pipeline",
                    help="token-level pipelined decode on a pp mesh: "
@@ -1656,11 +1705,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="record N alternative tokens per generated "
                         "token (payload top_logprobs slices down; "
                         "needs --logprobs)")
-    s.add_argument("--prefill-chunk", type=int, default=None,
-                   dest="prefill_chunk",
+    s.add_argument("--prefill-chunk", type=_prefill_chunk_arg,
+                   default=None, dest="prefill_chunk",
                    help="prefill prompts longer than this incrementally "
                         "(one chunk per step) so a long prompt cannot "
-                        "stall active decodes")
+                        "stall active decodes; 'auto' sweeps chunk "
+                        "candidates on the live engine at startup and "
+                        "keeps the fastest mixed-workload setting (the "
+                        "TTFT-vs-TPOT fairness knob, measured)")
     s.add_argument("--ckpt-dir")
     s.add_argument("--lora-dir", default=None, dest="lora_dir",
                    help="merge adapters from a train --lora-rank dir")

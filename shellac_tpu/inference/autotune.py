@@ -1,5 +1,6 @@
-"""Startup auto-tuning of the decode window length, and the simulated
-host-latency harness that lets CPU CI reproduce the relay-bound regime.
+"""Startup auto-tuning of the decode window length and the prefill
+chunk size, and the simulated host-latency harness that lets CPU CI
+reproduce the relay-bound regime.
 
 BENCH_DECODE measured the serving engine at ~88 ms/tick with ~2 ms of
 device work: the tick is host-RPC-bound, so `decode_ticks` (K decode
@@ -13,13 +14,22 @@ wall-clock — once at serving startup, writes the winner back, and
 restores the engine to its pre-probe state (PRNG key included) so a
 seeded deployment stays reproducible.
 
+`autotune_prefill_chunk` applies the same stance to the admission
+side: the chunked-prefill size is the TTFT-vs-TPOT fairness knob
+(whole prompts minimize the long request's TTFT but stall every
+decoder; small chunks invert it), and which side wins depends on the
+latency profile — so it is measured on a mixed workload per
+candidate, not guessed.
+
 `SimulatedHostLatency` is the sleep-injected RPC shim the perf
 regression gate runs on CPU: it models a remote device whose window
-results become available `device_s` after dispatch and whose dispatch
-RPC blocks the host for `dispatch_s`, using the engine's window hooks —
-the real pipeline runs underneath, only the clock is shaped. With it,
-overlapped dispatch shows the same ~max(host, device) vs host+device
-win on a laptop CPU that it shows against the relay.
+results become available `device_s` after dispatch, whose prefill
+results become available `prefill_s` after theirs, and whose dispatch
+RPC blocks the host for `dispatch_s`, using the engine's window and
+prefill hooks — the real pipeline runs underneath, only the clock is
+shaped. With it, overlapped dispatch (decode AND prefill) shows the
+same ~max(host, device) vs host+device win on a laptop CPU that it
+shows against the relay.
 """
 
 from __future__ import annotations
@@ -54,9 +64,11 @@ class AutotuneResult:
 
 
 class SimulatedHostLatency:
-    """Shape an engine's decode-window clock like a remote device.
+    """Shape an engine's decode-window AND prefill clocks like a
+    remote device.
 
-    Installed via the engine's `_window_hooks` seam:
+    Installed via the engine's `_window_hooks` seam (and, when
+    `prefill_s` is set, its `_prefill_hooks` twin):
 
       - `on_dispatch(window)`: sleeps `dispatch_s` (a host-blocking
         submit RPC) and stamps when the window's results will be
@@ -65,21 +77,30 @@ class SimulatedHostLatency:
       - `before_sync(window)`: sleeps out whatever of `device_s` the
         host has not already spent elsewhere — exactly the wait a real
         device_get would block for.
+      - `on_prefill_dispatch(flight)` / `before_prefill_sync(flights)`:
+        the same clock shaping for prefill programs — each flight's
+        results become available `prefill_s` after its dispatch, so an
+        inline (non-overlapped) settle blocks the admission for the
+        full round trip while the overlapped batched settle pays only
+        whatever of it the host has not already spent on other work.
 
     The real jitted programs still run (their CPU time happens inside
     the window span, like real device time); only the availability
     clock is stretched. Overlapped dispatch hides host work inside
-    `device_s`; strict ordering pays host + device serially — the
-    measurable contrast the perf gate asserts on.
+    `device_s`/`prefill_s`; strict ordering pays host + device
+    serially — the measurable contrast the perf gate asserts on.
     """
 
     def __init__(self, engine, *, device_s: float = 0.0,
-                 dispatch_s: float = 0.0):
+                 dispatch_s: float = 0.0, prefill_s: float = 0.0):
         self.engine = engine
         self.device_s = float(device_s)
         self.dispatch_s = float(dispatch_s)
+        self.prefill_s = float(prefill_s)
         self._ready: Dict[int, float] = {}
         engine._window_hooks = self
+        if self.prefill_s:
+            engine._prefill_hooks = self
 
     def on_dispatch(self, window) -> None:
         if self.dispatch_s:
@@ -93,9 +114,26 @@ class SimulatedHostLatency:
             if delay > 0:
                 time.sleep(delay)
 
+    def on_prefill_dispatch(self, flight) -> None:
+        if self.dispatch_s:
+            time.sleep(self.dispatch_s)
+        self._ready[id(flight)] = time.monotonic() + self.prefill_s
+
+    def before_prefill_sync(self, flights) -> None:
+        # The batched settle becomes available when the LAST of its
+        # flights does; already-elapsed host time is not re-paid.
+        ready = [r for r in (self._ready.pop(id(fl), None)
+                             for fl in flights) if r is not None]
+        if ready:
+            delay = max(ready) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+
     def uninstall(self) -> None:
         if self.engine._window_hooks is self:
             self.engine._window_hooks = None
+        if getattr(self.engine, "_prefill_hooks", None) is self:
+            self.engine._prefill_hooks = None
         self._ready.clear()
 
 
@@ -209,6 +247,180 @@ def autotune_decode_ticks(
     return result
 
 
+#: prefill_chunk candidates swept by default: whole prompts (None) vs
+#: the chunk sizes a production scheduler actually picks between. The
+#: sweep drops candidates larger than the engine's cache.
+PREFILL_CHUNK_CANDIDATES: Tuple[Optional[int], ...] = (None, 64, 128,
+                                                       256, 512)
+
+
+@dataclass
+class PrefillChunkResult:
+    """One prefill_chunk sweep: the winner plus per-candidate evidence
+    (mixed-workload tokens/s, and the long prompt's TTFT under each
+    candidate — the two sides of the TTFT-vs-TPOT fairness knob, both
+    measured rather than guessed)."""
+
+    best: Optional[int]
+    measurements: Dict[Optional[int], float] = field(
+        default_factory=dict)  # chunk -> tok/s
+    ttft: Dict[Optional[int], float] = field(default_factory=dict)
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "prefill_chunk": self.best,
+            "candidates": {
+                str(k): round(v, 1) for k, v in self.measurements.items()
+            },
+            "long_prompt_ttft_s": {
+                str(k): round(v, 4) for k, v in self.ttft.items()
+            },
+        }
+
+
+def autotune_prefill_chunk(
+    engine,
+    *,
+    candidates: Sequence[Optional[int]] = PREFILL_CHUNK_CANDIDATES,
+    probe_steps: int = 3,
+    timer: Callable[[], float] = time.perf_counter,
+) -> PrefillChunkResult:
+    """Measure a MIXED workload — steady decoders plus a long-prompt
+    admission — at each candidate prefill_chunk on the LIVE engine and
+    write the winner back via `engine.set_prefill_chunk`.
+
+    This is the TTFT-vs-TPOT fairness knob: whole-prompt prefill
+    (None) minimizes the long request's TTFT but stalls every active
+    decoder for the whole program; small chunks keep decoders ticking
+    but stretch the long prompt's admission. Which side wins depends
+    on the host/device latency profile, so — same TVM stance as the
+    decode_ticks sweep — it is searched, not guessed. Per candidate:
+    all but one slot decode steadily (EOS banned), one long prompt is
+    admitted mid-drain, and total generated tokens/s over the timed
+    drain decides. The long prompt's TTFT is recorded per candidate as
+    evidence. Probes are aborted and the PRNG key restored; a seeded
+    deployment stays exactly as reproducible as it entered.
+    """
+    if not getattr(engine, "_decode_ticks_tunable", True):
+        # Speculative engines pin their own prefill discipline (draft
+        # and target caches fill in lockstep); nothing to tune.
+        return PrefillChunkResult(best=engine.prefill_chunk)
+    if engine.pending:
+        raise RuntimeError(
+            "autotune_prefill_chunk needs an idle engine (it runs "
+            "probe traffic and aborts it); tune before admitting "
+            "requests"
+        )
+    if engine.n_slots < 2:
+        # The fairness question needs a decoder to stall.
+        return PrefillChunkResult(best=engine.prefill_chunk)
+    # A long prompt that spans several chunks of the largest surviving
+    # candidate, capped so prompt + budget fit the cache.
+    ticks = max(1, engine.decode_ticks)
+    budget = max(2 * ticks, 8)
+    long_len = min(engine.max_len - budget - 2,
+                   engine.max_len * 3 // 4)
+    if long_len < 32:
+        # A cache this tight has no long-prompt problem to tune.
+        return PrefillChunkResult(best=engine.prefill_chunk)
+    keep: List[Optional[int]] = []
+    for c in candidates:
+        if c is not None and (c < 1 or c >= long_len):
+            continue  # chunk >= prompt degenerates to whole-prompt
+        if c not in keep:
+            keep.append(c)
+    rng = np.random.default_rng(0)
+    key0 = engine._key
+    chunk0 = engine.prefill_chunk
+    result = PrefillChunkResult(best=chunk0)
+    best_rate = -1.0
+    from shellac_tpu.obs import EngineMetrics, Registry
+
+    stats0 = dict(engine.stats)
+    obs0 = engine.obs
+    engine.obs = EngineMetrics(Registry(enabled=False))
+    try:
+        for c in keep:
+            try:
+                engine.set_prefill_chunk(c)
+            except ValueError:
+                # Rolling rings cannot grow their chunk slack post-
+                # construction; degrade to the surviving range.
+                continue
+            kw = {}
+            if engine.eos_id is not None:
+                kw["min_tokens"] = budget + long_len
+            # Steady decoders on all but one slot.
+            for slot in range(engine.n_slots - 1):
+                prompt = rng.integers(0, engine.cfg.vocab_size, size=8,
+                                      dtype=np.int64)
+                engine.submit(("__chunktune__", str(c), slot), prompt,
+                              budget + probe_steps * ticks, **kw)
+            engine.step()  # un-timed: prefills + decode compile
+
+            def tokens_seen():
+                return engine.stats["tokens_generated"] + sum(
+                    len(r.out) for r in engine._slots if r is not None
+                )
+
+            rid_long = ("__chunktune__", str(c), "long")
+            prompt = rng.integers(0, engine.cfg.vocab_size,
+                                  size=long_len, dtype=np.int64)
+            tokens0 = tokens_seen()
+            t0 = timer()
+            engine.submit(rid_long, prompt, 2,
+                          **({"min_tokens": 2} if engine.eos_id
+                             is not None else {}))
+            t_first = None
+            while engine.pending:
+                done = engine.step()
+                if t_first is None:
+                    long_req = next(
+                        (r for r in engine._slots
+                         if r is not None and r.rid == rid_long), None)
+                    if ((long_req is not None and long_req.out)
+                            or any(rid == rid_long for rid, _ in done)):
+                        t_first = timer()
+            t1 = timer()
+            rate = (tokens_seen() - tokens0) / max(t1 - t0, 1e-9)
+            engine.abort_all()  # reset for the next candidate
+            result.measurements[c] = rate
+            if t_first is not None:
+                result.ttft[c] = max(t_first - t0, 0.0)
+            if rate > best_rate:
+                best_rate, result.best = rate, c
+    finally:
+        engine.abort_all()
+        engine._key = key0
+        engine.obs = obs0
+        engine.stats.clear()
+        engine.stats.update(stats0)
+    engine.set_prefill_chunk(result.best)
+    engine.prefill_chunk_source = "auto-tuned"
+    return result
+
+
+def maybe_autotune_prefill_chunk(
+    engine, log: Optional[Callable[[str], None]] = None, **kw
+) -> Optional[PrefillChunkResult]:
+    """Tune iff the engine was built with prefill_chunk="auto" and is
+    tunable — the serving entry points' one-liner, mirroring
+    maybe_autotune. Returns the result, or None when nothing was
+    tuned."""
+    if getattr(engine, "prefill_chunk_requested", None) != "auto":
+        return None
+    if not getattr(engine, "_decode_ticks_tunable", True):
+        return None
+    if hasattr(engine, "is_primary"):
+        # Multi-host wrapper: same lockstep constraint as the
+        # decode_ticks sweep — pods pin prefill_chunk explicitly.
+        return None
+    res = autotune_prefill_chunk(engine, **kw)
+    if log is not None:
+        log(f"prefill_chunk auto-tune: {res.summary()}")
+    return res
+
+
 def maybe_autotune(engine, log: Optional[Callable[[str], None]] = None,
                    **kw) -> Optional[AutotuneResult]:
     """Tune iff the engine was built with decode_ticks="auto" and is
@@ -232,7 +444,11 @@ def maybe_autotune(engine, log: Optional[Callable[[str], None]] = None,
 __all__: List[str] = [
     "AutotuneResult",
     "DEFAULT_CANDIDATES",
+    "PREFILL_CHUNK_CANDIDATES",
+    "PrefillChunkResult",
     "SimulatedHostLatency",
     "autotune_decode_ticks",
+    "autotune_prefill_chunk",
     "maybe_autotune",
+    "maybe_autotune_prefill_chunk",
 ]

@@ -120,6 +120,29 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+class _PrefillFlight:
+    """Host-side record of ONE dispatched (not yet settled) prefill.
+
+    `arrays` are the prefill program's device outputs — (first token,
+    its raw logprob, the top-K alternatives or None, and the
+    prompt-logprob payload or None) — held as futures: nothing is
+    synced at dispatch. `req` captures which request owned the slot AT
+    DISPATCH so settlement can discard results for slots whose request
+    was cancelled/replaced while the prefill was in flight (identity
+    check, the same arbitration _DecodeWindow settlement uses). The
+    prompt-logprob payload is either the whole-prompt (pad,) score
+    array or the chunked path's list of (in-chunk scores, size,
+    boundary score) pieces — both ride the ONE batched settle pull."""
+
+    __slots__ = ("slot", "req", "arrays", "t_dispatch")
+
+    def __init__(self, slot, req, arrays):
+        self.slot = slot
+        self.req = req
+        self.arrays = arrays  # (first, lp, tl, plp) futures
+        self.t_dispatch = time.perf_counter()
+
+
 class _DecodeWindow:
     """Host-side record of ONE dispatched (not yet synced) decode
     window.
@@ -186,6 +209,7 @@ class BatchingEngine:
         attn_impl: str = "auto",
         decode_ticks="auto",
         overlap_decode: bool = False,
+        overlap_prefill: bool = False,
         max_prefills_per_step: Optional[int] = None,
         prefill_chunk: Optional[int] = None,
         logprobs: bool = False,
@@ -224,6 +248,18 @@ class BatchingEngine:
             raise ValueError(f"decode_ticks must be >= 1, got {decode_ticks}")
         if max_prefills_per_step is not None and max_prefills_per_step < 1:
             raise ValueError("max_prefills_per_step must be >= 1")
+        # prefill_chunk: chunk size, None (whole prompts), or "auto" —
+        # the serving entry points sweep candidates on the live engine
+        # (inference.autotune.autotune_prefill_chunk) and write the
+        # winner back; until tuned, "auto" behaves exactly like None.
+        self.prefill_chunk_requested = prefill_chunk
+        if prefill_chunk == "auto":
+            prefill_chunk = None
+        elif isinstance(prefill_chunk, str):
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk!r}: need an int >= 1, "
+                "None, or 'auto'"
+            )
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         self.cfg = cfg
@@ -337,6 +373,23 @@ class BatchingEngine:
         # is bounded at 2 by step()'s structure (pre-dispatch exactly
         # one window before settling exactly one).
         self._windows: deque[_DecodeWindow] = deque()
+        # Overlapped prefill dispatch: with overlap_prefill=True, an
+        # admission dispatches its prefill program and returns — the
+        # slot is marked prefill-pending (excluded from decode windows
+        # until settled), the host immediately admits the next request
+        # or dispatches the next decode window, and every in-flight
+        # prefill settles in ONE batched device_get at the next step
+        # boundary (first tokens, logprobs, top-K, and the opt-in
+        # prompt-logprob payload all ride the same pull; TTFT is
+        # recorded at settle). False = each prefill settles inside its
+        # own admission, bit-identical to the pre-overlap engine.
+        self.overlap_prefill = bool(overlap_prefill)
+        # Dispatched-but-unsettled prefills, oldest first.
+        self._pflights: List[_PrefillFlight] = []
+        # Test/bench seam, the prefill-side twin of _window_hooks:
+        # None, or an object with on_prefill_dispatch(flight) /
+        # before_prefill_sync(flights).
+        self._prefill_hooks = None
         # Test/bench seam (inference.autotune.SimulatedHostLatency):
         # None, or an object with on_dispatch(window) / before_sync
         # (window) — a sleep-injecting RPC shim that lets CPU CI
@@ -497,6 +550,11 @@ class BatchingEngine:
             # how each replica runs its hot loop.
             "decode_ticks": decode_ticks,
             "overlap_depth": 2 if self.overlap_decode else 1,
+            # Admission-side pipeline knobs, mirrored like the decode
+            # ones: is prefill dispatch overlapped, and what chunk size
+            # is live (0 = whole prompts; the auto-tuner rewrites it).
+            "overlap_prefill": 1 if self.overlap_prefill else 0,
+            "prefill_chunk": prefill_chunk or 0,
             # The active storage policy (registry name). Non-numeric,
             # so the /metrics stat mirror skips it; the server exposes
             # it as the shellac_engine_cache_backend_info gauge label.
@@ -514,6 +572,12 @@ class BatchingEngine:
         # "auto" (pending tune; autotune rewrites it to "auto-tuned").
         self.decode_ticks_source = (
             "auto" if self.decode_ticks_requested == "auto" else "fixed"
+        )
+        # How prefill_chunk was chosen, mirroring decode_ticks_source:
+        # "fixed" (explicit int or None) or "auto" (pending tune;
+        # autotune_prefill_chunk rewrites it to "auto-tuned").
+        self.prefill_chunk_source = (
+            "auto" if self.prefill_chunk_requested == "auto" else "fixed"
         )
         # Richer observability (histograms + gauges) over the shared
         # registry — the Prometheus-facing counterpart of `stats`.
@@ -1311,7 +1375,10 @@ class BatchingEngine:
     def _run_prefill(self, slot: int, req: _Request):
         """Run the (bucketed, jitted) prefill for `req`; returns
         (first sampled token, its raw logprob, top-K alternatives or
-        None)."""
+        None, prompt-logprob scores or None) — all DEVICE values, so
+        dispatch pays no host sync; _settle_prefills (inline without
+        overlap, batched at the next step boundary with it) pulls
+        everything in one device_get."""
         s = req.tokens.size
         # Cap the bucket at max_len: a pad larger than the cache
         # (dense) or the block table (paged) would write out of
@@ -1331,10 +1398,12 @@ class BatchingEngine:
             want_plp=req.prompt_logprobs,
         )
         self._cache = cache
-        if req.prompt_logprobs:
-            req.plp = [float(x) for x in
-                       np.asarray(jax.device_get(plp))[:s]]  # shellac: ignore[SH002] — prompt scoring is an opt-in per-request pull; it rides the admission path, never the decode window
-        return first, lp, ((tlv, tli) if self.top_logprobs else None)
+        # Prompt scoring no longer pays its own per-admission pull: the
+        # device array rides the flight and lands in the ONE batched
+        # settle device_get alongside the first token (SH002 history:
+        # this line used to be a dedicated device_get).
+        return (first, lp, ((tlv, tli) if self.top_logprobs else None),
+                plp if req.prompt_logprobs else None)
 
     def _prefill_start_offset(self, slot: int) -> int:
         """Tokens already resident when prefill starts (the paged
@@ -1371,25 +1440,101 @@ class BatchingEngine:
                 self._prefilling[i] = off
                 continue
             t_pf = time.perf_counter()
-            first, lp, tl = self._run_prefill(i, req)
-            self._finish_prefill(i, req, first, lp, tl)
-            # Phase attribution: the prefill program + its host sync,
-            # split out of the surrounding admission bookkeeping.
+            arrays = self._run_prefill(i, req)
+            self._dispatch_prefill(i, req, arrays)
+            # Phase attribution: the prefill program dispatch, split
+            # out of the surrounding admission bookkeeping (the settle
+            # sync times itself into prefill_settle — immediately below
+            # without overlap, at the next step boundary with it).
             self._phase_s["prefill_dispatch"] = (
                 self._phase_s.get("prefill_dispatch", 0.0)
                 + time.perf_counter() - t_pf
             )
+            if not self.overlap_prefill:
+                self._settle_prefills()
 
-    def _finish_prefill(self, slot: int, req: _Request, first,
-                        lp=None, tl=None) -> None:
-        # The slot's prompt KV is now real: paged prefix caching
-        # registers the prompt blocks as matchable here.
+    def _dispatch_prefill(self, slot: int, req: _Request,
+                          arrays) -> None:
+        """A prefill (or final chunk) was just dispatched for `req`:
+        record it as an in-flight _PrefillFlight. No host sync — the
+        device outputs stay futures until _settle_prefills. The slot is
+        occupied from here (pending accounting, admission exclusion)
+        but prefill-pending: _active_rows keeps it out of decode
+        windows until the settle writes its host bookkeeping."""
+        self._slots[slot] = req
+        self.stats["prefills"] += 1
+        fl = _PrefillFlight(slot, req, arrays)
+        self._pflights.append(fl)
+        if self._prefill_hooks is not None:
+            self._prefill_hooks.on_prefill_dispatch(fl)
+        if self.overlap_prefill and req.trace is not None:
+            # Flight-recorder timeline: dispatch half of the prefill
+            # pipeline (settle lands as the span's first_token). Only
+            # recorded under overlap — without it dispatch and settle
+            # are one event, the span's existing prefill section.
+            req.trace.record("prefill-dispatch", src="engine",
+                             rid=req.rid, slot=slot,
+                             depth=len(self._pflights))
+
+    def _pending_prefill_slots(self):
+        """Slots whose prefill is dispatched but not yet settled (and
+        whose request still owns the slot) — excluded from decode
+        windows until the settle writes their host bookkeeping."""
+        return {fl.slot for fl in self._pflights
+                if self._slots[fl.slot] is fl.req}
+
+    def _settle_prefills(self) -> bool:
+        """Settle EVERY in-flight prefill in ONE batched device_get:
+        first tokens, logprobs, top-K alternatives, and the opt-in
+        prompt-logprob payloads all ride the same pull. TTFT
+        (trace.first_token) is recorded here — the settle point.
+        Results for slots whose request was cancelled or replaced while
+        the prefill was in flight are discarded (identity check, like
+        stale decode windows). False if nothing was in flight."""
+        if not self._pflights:
+            return False
+        flights, self._pflights = self._pflights, []
+        t0 = time.perf_counter()
+        if self._prefill_hooks is not None:
+            self._prefill_hooks.before_prefill_sync(flights)
+        host = jax.device_get([fl.arrays for fl in flights])  # shellac: ignore[SH002] — THE prefill settle: one batched pull for every in-flight prefill's first token / logprob / top-K / prompt scores (the per-admission pulls this replaces each paid their own round trip); the first tokens MUST reach the host here — settle is the TTFT point and the finish check needs them
+        for fl, (first, lp, tl, plp) in zip(flights, host):
+            if self._slots[fl.slot] is not fl.req:
+                continue
+            self._finish_prefill_host(fl.slot, fl.req, first, lp, tl,
+                                      plp)
+        self._phase_s["prefill_settle"] = (
+            self._phase_s.get("prefill_settle", 0.0)
+            + time.perf_counter() - t0
+        )
+        return True
+
+    @staticmethod
+    def _stitch_plp(plp_host, s: int) -> List[float]:
+        """Normalize a settled prompt-logprob payload to the flat
+        per-token list the server renders: either the whole-prompt
+        score array (sliced to the real prompt length) or the chunked
+        path's (in-chunk scores, size, boundary score) pieces stitched
+        across chunk boundaries. Position 0 has no predictor and
+        reports 0.0 (rendered as null)."""
+        if not isinstance(plp_host, list):
+            return [float(x) for x in np.asarray(plp_host)[:s]]
+        flat = [0.0]
+        for plp_w, sz, blp in plp_host:
+            flat.extend(float(x) for x in np.asarray(plp_w)[1:sz])
+            if blp is not None:
+                flat.append(float(blp))
+        return flat
+
+    def _finish_prefill_host(self, slot: int, req: _Request, first,
+                             lp=None, tl=None, plp=None) -> None:
+        """Host half of prefill completion: all arguments are settled
+        HOST values (pulled by _settle_prefills' one batched sync).
+        The slot's prompt KV is now certainly resident, so paged
+        prefix caching registers the prompt blocks as matchable here —
+        at settle, never at dispatch (an in-flight program's blocks
+        must not be matchable, and a cancelled flight's never are)."""
         self.cache_backend.on_prefill_complete(slot)
-        # ONE host pull for everything this admission needs host-side
-        # (first token, its logprob, the top-K alternatives): the
-        # separate int()/float()/device_get() calls this replaces each
-        # paid their own device round trip per prefill.
-        first, lp, tl = jax.device_get((first, lp, tl))  # shellac: ignore[SH002] — the single batched per-prefill pull; the first token MUST reach the host here (it is the TTFT point and the finish check needs it)
         first_tok = int(first)
         self._cur = self._cur.at[slot].set(first_tok)
         # Arm the device-side stop decisions: the prefill-sampled token
@@ -1417,8 +1562,10 @@ class BatchingEngine:
             self._smin = self._smin.at[slot].set(req.min_tokens - 1)
         req.out.append(first_tok)
         if req.trace is not None:
-            # The batched pull above already synced: the first token is
-            # a host value, so this is the request's TTFT point.
+            # The batched settle pull already synced: the first token
+            # is a host value, so this is the request's TTFT point —
+            # under overlap_prefill, the settle boundary, not the
+            # dispatch (docs/decode_performance.md "Prefill overlap").
             req.trace.first_token()
         if self.logprobs and lp is not None:
             req.lps.append(float(lp))
@@ -1426,7 +1573,8 @@ class BatchingEngine:
             tlv, tli = tl  # host arrays — pulled with `first` above
             req.tlp = [(np.asarray(tli)[0].tolist(),
                         np.asarray(tlv)[0].tolist())]
-        self.stats["prefills"] += 1
+        if req.prompt_logprobs and plp is not None:
+            req.plp = self._stitch_plp(plp, req.tokens.size)
         if req.prefill_only:
             # Disaggregated freeze: the device-side done flag (PR 7's
             # freeze mechanism) plus host-side exclusion keep the slot
@@ -1448,6 +1596,7 @@ class BatchingEngine:
         row while it is hot."""
         used = 0
         t_pf = time.perf_counter()
+        settle0 = self._phase_s.get("prefill_settle", 0.0)
         while self._prefilling and (budget is None or used < budget):
             slot = min(self._prefilling)
             used += 1
@@ -1480,29 +1629,31 @@ class BatchingEngine:
                 req.plp.append((plp_w, s, None if final else blp))
             if final:
                 del self._prefilling[slot]
-                if req.prompt_logprobs:
-                    pieces = req.plp
-                    host = jax.device_get(pieces)  # shellac: ignore[SH002] — the ONE stitching pull per scored prompt, deferred to its final chunk by design (see the collection comment above)
-                    flat = [0.0]
-                    for plp_host, sz, blp_host in host:
-                        flat.extend(float(x)
-                                    for x in np.asarray(plp_host)[1:sz])
-                        if blp_host is not None:
-                            flat.append(float(blp_host))
-                    req.plp = flat
-                self._finish_prefill(
-                    slot, req, first, lp,
-                    ((tlv, tli) if self.top_logprobs else None),
+                # The final chunk's stitching sync no longer happens
+                # here: the collected plp pieces (device arrays) ride
+                # the flight and settle in the ONE batched pull with
+                # the first token — _stitch_plp flattens them host-side
+                # at settle.
+                pieces = req.plp
+                req.plp = None
+                self._dispatch_prefill(
+                    slot, req,
+                    (first, lp,
+                     ((tlv, tli) if self.top_logprobs else None),
+                     pieces),
                 )
+                if not self.overlap_prefill:
+                    self._settle_prefills()
             else:
                 self._prefilling[slot] = off + s
         if used:
-            # The whole chunk loop is prefill work (dispatches + the
-            # final-chunk stitching syncs); its host-side glue is noise
-            # next to the programs.
+            # The chunk loop's dispatch work (program dispatches + host
+            # glue); any final-chunk settle inside the loop timed
+            # itself into prefill_settle and is subtracted out.
             self._phase_s["prefill_dispatch"] = (
                 self._phase_s.get("prefill_dispatch", 0.0)
-                + time.perf_counter() - t_pf
+                + (time.perf_counter() - t_pf)
+                - (self._phase_s.get("prefill_settle", 0.0) - settle0)
             )
         return used
 
@@ -1609,13 +1760,33 @@ class BatchingEngine:
         discards results for slots whose request was cancelled or
         replaced in flight (identity check). Strict ordering
         (overlap_decode=False) is bit-identical to the pre-overlap
-        engine."""
+        engine.
+
+        overlap_prefill=True pipelines the ADMISSION side the same
+        way: prefills dispatched in earlier steps settle first — one
+        batched pull for all of them, at the step boundary — and the
+        settled slots join this step's window; admissions later in
+        the step dispatch their prefill and leave it in flight.
+        overlap_prefill=False settles each prefill inline at its
+        admission, bit-identical to the pre-pipeline engine."""
         finished: List[Tuple[Any, List[int]]] = []
         self.stats["engine_steps"] += 1
         t_step0 = time.perf_counter()
         self._sync_block_s = 0.0
         self._phase_s = {}
         synced = False
+        settled_prefills = False
+        if self._pflights:
+            # Step boundary: every prefill dispatched in earlier steps
+            # settles NOW, in one batched pull, BEFORE the next decode
+            # window is dispatched — settled slots join this step's
+            # window instead of waiting another boundary. A request
+            # satisfied by its prefill alone (max_new=1, instant EOS,
+            # stop completed by the first token) must be noticed here,
+            # before admissions, or its slot stays occupied a step.
+            settled_prefills = self._settle_prefills()
+            if settled_prefills:
+                self._finish_check(finished)
         if self.overlap_decode and self._windows:
             # Keep the device busy across the sync: dispatch the next
             # window on the current (stale w.r.t. the un-synced window)
@@ -1635,6 +1806,7 @@ class BatchingEngine:
                 0.0, time.perf_counter() - t_settle0 - self._sync_block_s
             )
         t_fill0 = time.perf_counter()
+        settle_fill0 = self._phase_s.get("prefill_settle", 0.0)
         prefills0 = self.stats["prefills"] + self.stats["prefill_chunks"]
         # Fill/check until stable: a request satisfied by its prefill
         # alone (max_new=1, instant EOS, or a stop sequence completed by
@@ -1676,12 +1848,14 @@ class BatchingEngine:
             # step ran, including their host syncs) — observed only on
             # steps that actually prefilled.
             self.obs.prefill_seconds.observe(time.perf_counter() - t_fill0)
-        # Admission phase: the fill section minus the prefill programs
-        # it ran (queue pops, slot prep, finish checks in the loop).
+        # Admission phase: the fill section minus the prefill program
+        # dispatches and any inline (non-overlapped) settles it ran
+        # (queue pops, slot prep, finish checks in the loop).
         self._phase_s["admission"] = max(
             0.0,
             time.perf_counter() - t_fill0
-            - self._phase_s.get("prefill_dispatch", 0.0),
+            - self._phase_s.get("prefill_dispatch", 0.0)
+            - (self._phase_s.get("prefill_settle", 0.0) - settle_fill0),
         )
         active_rows = self._active_rows()
         if any(active_rows) and not self._windows:
@@ -1720,26 +1894,28 @@ class BatchingEngine:
                 0.0,
                 time.perf_counter() - t_step0 - self._sync_block_s,
             ))
-        self._observe_step_phases(t_step0, synced, finished, prefills0)
+        self._observe_step_phases(t_step0, synced, finished, prefills0,
+                                  settled_prefills)
         return finished
 
     def _observe_step_phases(self, t_step0: float, synced: bool,
-                             finished, prefills0: int) -> None:
+                             finished, prefills0: int,
+                             settled_prefills: bool = False) -> None:
         """Deposit this step's phase attribution (obs.STEP_PHASES) —
-        only for steps that did work (synced a window, ran a prefill,
-        or finished a request): a server's idle polling steps would
-        otherwise drown the distributions in zeros. host_bookkeeping
-        is the remainder, so the five _sum series add up to the step
-        loop's non-idle wall time."""
-        did_work = synced or bool(finished) or (
+        only for steps that did work (synced a window, ran or settled
+        a prefill, or finished a request): a server's idle polling
+        steps would otherwise drown the distributions in zeros.
+        host_bookkeeping is the remainder, so the six _sum series add
+        up to the step loop's non-idle wall time."""
+        did_work = synced or settled_prefills or bool(finished) or (
             self.stats["prefills"] + self.stats["prefill_chunks"]
             > prefills0
         )
         if not did_work or not self.obs.registry.enabled:
             return
         attributed = 0.0
-        for phase in ("admission", "prefill_dispatch", "decode_sync",
-                      "settle"):
+        for phase in ("admission", "prefill_dispatch", "prefill_settle",
+                      "decode_sync", "settle"):
             v = self._phase_s.get(phase, 0.0)
             attributed += v
             self.obs.step_phase.labels(phase=phase).observe(v)
@@ -1751,10 +1927,13 @@ class BatchingEngine:
 
     def _active_rows(self) -> List[bool]:
         """Slots a decode window should advance right now (occupied,
-        not mid-chunked-prefill, not frozen awaiting migration)."""
+        not mid-chunked-prefill, not awaiting an overlapped prefill
+        settle, not frozen awaiting migration)."""
+        pending = (self._pending_prefill_slots() if self._pflights
+                   else ())
         return [
             r is not None and i not in self._prefilling
-            and not r.prefill_only
+            and i not in pending and not r.prefill_only
             for i, r in enumerate(self._slots)
         ]
 
@@ -2018,13 +2197,18 @@ class BatchingEngine:
         swept so a rebuilt server cannot hand a new request an old
         generation's logprobs. Device cache rows need no repair — stale
         rows are self-healing (lengths roll back at the next admit)."""
-        # Drain the in-flight decode window(s) first (overlapped
-        # dispatch): block until the device finishes and DISCARD the
-        # results, so a rebuilt/resynced engine can never mis-attribute
-        # a stale window's tokens to a new generation's requests, and
-        # the device is quiescent when the caller reuses it.
+        # Drain the in-flight decode window(s) and prefill flight(s)
+        # first (overlapped dispatch): block until the device finishes
+        # and DISCARD the results, so a rebuilt/resynced engine can
+        # never mis-attribute a stale window's tokens (or a stale
+        # prefill's first token) to a new generation's requests, and
+        # the device is quiescent when the caller reuses it. The
+        # prefill hooks are deliberately NOT consulted — this is
+        # failure-path cleanup, not a measured settle.
         while self._windows:
             jax.device_get(self._windows.popleft().arrays)
+        while self._pflights:
+            jax.device_get(self._pflights.pop().arrays)
         dropped = []
         for req in self._queue:
             dropped.append(req.rid)
@@ -2063,6 +2247,30 @@ class BatchingEngine:
             self.decode_ticks = k
             self._decode = None
         self.stats["decode_ticks"] = k
+
+    def set_prefill_chunk(self, chunk: Optional[int]) -> None:
+        """Rewrite prefill_chunk between steps — the prefill
+        auto-tuner's write-back (None = whole prompts). The chunk jits
+        are keyed by pad bucket, so nothing invalidates; rolling
+        backends refuse (their ring slack was sized to the
+        construction-time chunk and cannot grow post-hoc)."""
+        if chunk is not None:
+            chunk = int(chunk)
+            if chunk < 1:
+                raise ValueError(
+                    f"prefill_chunk must be >= 1, got {chunk}"
+                )
+        if self.cache_backend.is_rolling and (
+            chunk or 1
+        ) > self.cache_backend.chunk_slack:
+            raise ValueError(
+                f"prefill_chunk={chunk} exceeds the rolling ring's "
+                f"construction-time chunk slack "
+                f"({self.cache_backend.chunk_slack}); pass "
+                "prefill_chunk at construction instead"
+            )
+        self.prefill_chunk = chunk
+        self.stats["prefill_chunk"] = chunk or 0
 
     @property
     def pending(self) -> int:
@@ -2176,11 +2384,14 @@ class PagedBatchingEngine(BatchingEngine):
                 # int8 pools need 32-aligned pages (the grouped-gather
                 # kernel's sublane tiling); bf16 keeps the finer 16.
                 block_size = 64 if name == "paged-int8" else 16
+            chunk = kw.get("prefill_chunk")
             cache_backend = make_backend(
                 name, cfg, n_slots, max_len or cfg.max_seq_len,
                 block_size=block_size, pool_tokens=pool_tokens,
                 prefix_cache=prefix_cache,
-                chunk_slack=kw.get("prefill_chunk") or 1,
+                # "auto" resolves to whole prompts until tuned — slack
+                # like the untuned case (paged slack is advisory).
+                chunk_slack=chunk if isinstance(chunk, int) else 1,
             )
         else:
             # A constructed pool carries its own geometry; engine
@@ -2305,7 +2516,10 @@ class PagedBatchingEngine(BatchingEngine):
             slot, sub, self._slot_samp(slot, req),
         )
         self._cache = cache
-        return first, lp, ((tlv, tli) if self.top_logprobs else None)
+        # No plp payload: submit() refuses prompt_logprobs on
+        # prefix-cached engines (the hit skips the scoring passes).
+        return (first, lp, ((tlv, tli) if self.top_logprobs else None),
+                None)
 
     def _prefix_prefill_impl(
         self, params, cache, tokens, suffix_len, prefix_len, slot, key,

@@ -112,6 +112,10 @@ from shellac_tpu.utils.failure import Heartbeat, RestartBudget
 #: decisions are inspectable.
 ROLES = ("monolith", "prefill", "decode")
 
+#: Sentinel distinguishing "prefill_chunk never tuned" from "tuned to
+#: None (whole prompts won the sweep)".
+_UNTUNED = object()
+
 
 def _render_plp(plp):
     """Prompt logprobs for a response: position 0 has no predictor and
@@ -446,12 +450,23 @@ class InferenceServer:
         # autotune=False: tests and embedders want deterministic, cheap
         # construction.
         self._tuned_ticks: Optional[int] = None
+        # prefill_chunk startup sweep (serve --prefill-chunk auto):
+        # same discipline — tuned pre-thread, remembered so rebuilt
+        # generations inherit it. The sentinel distinguishes "never
+        # tuned" from "tuned to None (whole prompts)".
+        self._tuned_chunk: Any = _UNTUNED
         if autotune:
-            from shellac_tpu.inference.autotune import maybe_autotune
+            from shellac_tpu.inference.autotune import (
+                maybe_autotune,
+                maybe_autotune_prefill_chunk,
+            )
 
             res = maybe_autotune(engine)
             if res is not None:
                 self._tuned_ticks = res.best
+            cres = maybe_autotune_prefill_chunk(engine)
+            if cres is not None:
+                self._tuned_chunk = cres.best
         # Liveness file beaten from the scheduler loop, so external
         # watchdogs cover inference the same way they cover training.
         # The step watchdog co-beats it while in-process recovery is
@@ -802,6 +817,11 @@ class InferenceServer:
                     eng, "decode_ticks_source", None),
                 "overlap_decode": bool(
                     getattr(eng, "overlap_decode", False)),
+                "overlap_prefill": bool(
+                    getattr(eng, "overlap_prefill", False)),
+                "prefill_chunk": getattr(eng, "prefill_chunk", None),
+                "prefill_chunk_source": getattr(
+                    eng, "prefill_chunk_source", None),
             },
             "mesh": (str(dict(mesh.shape)) if mesh is not None
                      else None),
@@ -975,6 +995,12 @@ class InferenceServer:
                 # fresh sweep mid-recovery would stretch the outage.
                 engine.set_decode_ticks(self._tuned_ticks)
                 engine.decode_ticks_source = "auto-tuned"
+            if (self._tuned_chunk is not _UNTUNED
+                    and getattr(engine, "prefill_chunk_requested", None)
+                    == "auto"
+                    and getattr(engine, "_decode_ticks_tunable", True)):
+                engine.set_prefill_chunk(self._tuned_chunk)
+                engine.prefill_chunk_source = "auto-tuned"
         except Exception as e:  # noqa: BLE001 — any rebuild fault is fatal
             with self._lock:
                 self._recovering = False
@@ -2576,6 +2602,16 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                         eng, "decode_ticks_source", "fixed"),
                     "overlap_decode": bool(
                         getattr(eng, "overlap_decode", False)),
+                    # The admission-side twins: is prefill dispatch
+                    # overlapped, what chunk size is live, and how it
+                    # was chosen ("fixed" | "auto" pending |
+                    # "auto-tuned") — the stats dict already mirrors
+                    # overlap_prefill/prefill_chunk numerically at
+                    # /metrics (shellac_engine_*).
+                    "overlap_prefill": bool(
+                        getattr(eng, "overlap_prefill", False)),
+                    "prefill_chunk_source": getattr(
+                        eng, "prefill_chunk_source", "fixed"),
                     # Supervisor state: /stats stays 200 through an
                     # outage (scrapers keep collecting); readiness
                     # lives at /health.

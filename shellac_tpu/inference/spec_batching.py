@@ -76,7 +76,9 @@ from shellac_tpu.parallel.sharding import make_shardings
 # tests/test_cache_backends.py::TestExclusionMatrix — the meta-test
 # asserts the three stay in lockstep. Burned down in PR 9 from nine
 # (rolling, decode_ticks, overlap, int8, pp, constraint, seed,
-# prompt_logprobs, all sampling extras) to the five below.
+# prompt_logprobs, all sampling extras) to five; overlap_prefill
+# joined when the admission pipeline shipped (same class of survivor
+# as overlap_decode — the round accounting leaves no sync to defer).
 EXCLUSIONS: Dict[str, str] = {
     "rolling_window": (
         "the verify round re-reads positions a ring may have already "
@@ -87,6 +89,13 @@ EXCLUSIONS: Dict[str, str] = {
         "the host must see each round's per-slot acceptance counts "
         "before it can account the next round, so there is no sync to "
         "defer behind a second in-flight window"
+    ),
+    "overlap_prefill": (
+        "admission fills the draft AND target caches in lockstep "
+        "(the draft prefill dispatches from inside _run_prefill), and "
+        "the next verify round is accounted against both — there is "
+        "no settle to defer without staging the draft cursor through "
+        "the flight too"
     ),
     "pp_pipeline": (
         "the verify round replaces the decode scan the pp stage "
@@ -177,6 +186,15 @@ class _SpecDecodeMixin:
                 "round's per-slot acceptance counts before it can "
                 "account the next round, so there is no sync to defer; "
                 "use a non-draft engine for overlapped decode"
+            )
+        if kw.get("overlap_prefill"):
+            raise ValueError(
+                "overlap_prefill is not wired for the speculative "
+                "engine [excluded: overlap_prefill]: admission fills "
+                "the draft and target caches in lockstep and the next "
+                "verify round is accounted against both, so there is "
+                "no settle to defer; use a non-draft engine for "
+                "overlapped prefill"
             )
         if kw.get("pp_pipeline"):
             raise ValueError(

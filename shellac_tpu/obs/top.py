@@ -46,6 +46,7 @@ from shellac_tpu.obs.trace import STEP_PHASES
 _PHASE_TAGS = {
     "admission": "adm",
     "prefill_dispatch": "pf",
+    "prefill_settle": "pfst",
     "decode_sync": "sync",
     "settle": "settle",
     "host_bookkeeping": "host",
@@ -125,6 +126,8 @@ def _replica_rows(parsed: Optional[ParsedMetrics],
             "ttft_p99": None,
             "stale_age": None,
             "stale": None,
+            "overlap": None,
+            "prefill_chunk": None,
             "phases": {},
         }
         if parsed is not None:
@@ -133,6 +136,21 @@ def _replica_rows(parsed: Optional[ParsedMetrics],
                 row["pending"] = int(v)
             row["kv"] = parsed.value("shellac_kv_utilization",
                                      replica=url)
+            # Pipeline mode flags from the engine-stat mirrors: "d" =
+            # overlapped decode (depth 2), "p" = overlapped prefill.
+            depth = parsed.value("shellac_engine_overlap_depth",
+                                 replica=url)
+            opf = parsed.value("shellac_engine_overlap_prefill",
+                               replica=url)
+            if depth is not None or opf is not None:
+                row["overlap"] = (
+                    ("d" if (depth or 0) >= 2 else "")
+                    + ("p" if opf else "")
+                ) or "-"
+            pfc = parsed.value("shellac_engine_prefill_chunk",
+                               replica=url)
+            if pfc is not None:
+                row["prefill_chunk"] = int(pfc)
             row["ttft_p99"] = histogram_quantile(
                 parsed.buckets("shellac_ttft_seconds", replica=url),
                 0.99,
@@ -210,7 +228,8 @@ def render(snapshot: Dict[str, Any], width: int = 100) -> str:
         out.append("")
         out.append(
             f"{'replica':<32}{'state':<10}{'role':<9}{'pend':>5}"
-            f"{'kv%':>6}{'p99 ttft':>10}{'stale':>8}"
+            f"{'kv%':>6}{'p99 ttft':>10}{'ovl':>5}{'pfc':>6}"
+            f"{'stale':>8}"
         )
         for r in rows:
             kv = f"{100 * r['kv']:.0f}" if r["kv"] is not None else "-"
@@ -218,10 +237,14 @@ def render(snapshot: Dict[str, Any], width: int = 100) -> str:
                      (f"{r['stale_age']:.0f}s!" if r["stale"]
                       else f"{r['stale_age']:.0f}s"))
             pend = r["pending"] if r["pending"] is not None else "-"
+            ovl = r["overlap"] or "-"
+            pfc = ("-" if not r["prefill_chunk"]
+                   else str(r["prefill_chunk"]))
             out.append(
                 f"{_short(r['url'], 30):<32}{r['state']:<10}"
                 f"{r['role']:<9}{pend:>5}{kv:>6}"
-                f"{_fmt_ms(r['ttft_p99']):>10}{stale:>8}"
+                f"{_fmt_ms(r['ttft_p99']):>10}{ovl:>5}{pfc:>6}"
+                f"{stale:>8}"
             )
         # -- step-phase attribution bars -------------------------------
         phased = [r for r in rows if r["phases"]]

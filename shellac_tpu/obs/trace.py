@@ -40,17 +40,21 @@ OCCUPANCY_BUCKETS = linear_buckets(0.125, 0.125, 8)
 #:   admission        — queue pops, slot prep, finish checks in the
 #:                      fill loop (everything admission-side that is
 #:                      NOT the prefill programs themselves)
-#:   prefill_dispatch — prefill/chunk program dispatches + their host
-#:                      syncs (the per-prefill round trips that stall
-#:                      decode windows — open item 1's premise)
+#:   prefill_dispatch — prefill/chunk program dispatches (host-side
+#:                      dispatch cost only; with overlapped prefill
+#:                      the sync moved to prefill_settle)
+#:   prefill_settle   — time blocked in the prefill settle's one
+#:                      batched device_get plus its host bookkeeping
+#:                      (inline per admission without overlap_prefill;
+#:                      one batched pull per step boundary with it)
 #:   decode_sync      — time blocked in the decode window's one
 #:                      packed device_get
 #:   settle           — applying synced window results: detokenize
 #:                      appends, finish checks, slot release
 #:   host_bookkeeping — the remainder (dispatch bookkeeping, gauge
 #:                      updates, scheduler glue)
-STEP_PHASES = ("admission", "prefill_dispatch", "decode_sync",
-               "settle", "host_bookkeeping")
+STEP_PHASES = ("admission", "prefill_dispatch", "prefill_settle",
+               "decode_sync", "settle", "host_bookkeeping")
 
 #: Request outcomes (the `outcome` label of shellac_requests_total).
 #: ok: completed; shed: deadline expired before prefill; cancelled:
@@ -425,8 +429,9 @@ class EngineMetrics:
         self.step_phase = h(
             "shellac_step_phase_seconds",
             "Per engine step: wall time attributed to one phase of "
-            "the tick (admission | prefill_dispatch | decode_sync | "
-            "settle | host_bookkeeping — see obs.STEP_PHASES). "
+            "the tick (admission | prefill_dispatch | prefill_settle "
+            "| decode_sync | settle | host_bookkeeping — see "
+            "obs.STEP_PHASES). "
             "Observed once per phase per non-idle step, so the "
             "per-phase _sum series divide the step loop's wall time "
             "exactly and 'prefill stalls decode windows' is a "

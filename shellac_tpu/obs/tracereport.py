@@ -11,16 +11,18 @@ parses the profiler's Chrome-trace event stream (the
     backend's shape — any event whose args carry an `hlo_op`/
     `hlo_module`) aggregated per op name: count, total time, share.
 
-  phase alignment — each device op is classified against the five
+  phase alignment — each device op is classified against the
     `shellac_step_phase_seconds` phases by the HLO module / op name
     it belongs to (the engine's jitted programs have recognizable
     names: prefill/chunk programs -> `prefill_dispatch`, decode
-    window/beam programs -> `decode_sync`). `admission`, `settle`,
-    and `host_bookkeeping` are host-side phases with no device ops;
-    their device share is structurally zero and the live histogram
-    stays the authority for them — the report says where the DEVICE
-    half of each phase goes, which is exactly the half the histogram
-    cannot see.
+    window/beam programs -> `decode_sync`). `admission`,
+    `prefill_settle`, `settle`, and `host_bookkeeping` are host-side
+    phases with no device ops of their own (the prefill COMPUTE the
+    settle waits on is attributed to `prefill_dispatch`, where its
+    programs run); their device share is structurally zero and the
+    live histogram stays the authority for them — the report says
+    where the DEVICE half of each phase goes, which is exactly the
+    half the histogram cannot see.
 
   fusion counts — events and distinct ops named `fusion*` (XLA's
     fused computations): how much of the device time runs fused, and

@@ -634,6 +634,66 @@ class TestOverlapFaults:
             eng.gate.set()
             srv.close()
 
+    def test_abort_all_mid_prefill_flight_no_stale_leak(self):
+        """Overlapped PREFILL x the failure machinery: prefills in
+        flight at abort_all (the supervisor rebuild / resync cleanup)
+        are synced-and-discarded like in-flight windows, and the next
+        tenant of every slot produces exactly the strict-ordering
+        output — no stale first token leaks."""
+        import numpy as np
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0, overlap_prefill=True,
+                             overlap_decode=True, decode_ticks=2)
+        eng.submit("a", np.array([1, 2, 3], np.int32), 8)
+        eng.submit("b", np.array([4, 5], np.int32), 8)
+        eng.step()  # prefills dispatched, NOT settled
+        assert eng._pflights, "no prefill in flight"
+        dropped = eng.abort_all()
+        assert sorted(dropped) == ["a", "b"]
+        assert not eng._pflights
+        results = eng.run([("fresh", np.array([7, 8], np.int32), 6)])
+        ref = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0, decode_ticks=2)
+        want = ref.run([("fresh", np.array([7, 8], np.int32), 6)])
+        assert {k: list(v) for k, v in results.items()} == {
+            k: list(v) for k, v in want.items()}
+
+    def test_wedge_recovers_onto_fresh_overlap_prefill_engine(self):
+        """Wedge -> watchdog -> rebuild with BOTH generations running
+        the full overlap pipeline (decode AND prefill): the rebuilt
+        generation serves strict-ordering-identical output."""
+        import numpy as np
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        eng = _WedgingEngine(cfg, params, n_slots=2, max_len=64,
+                             temperature=0.0, good_steps=0,
+                             overlap_decode=True, overlap_prefill=True,
+                             decode_ticks=2)
+
+        def factory():
+            return BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                  temperature=0.0, overlap_decode=True,
+                                  overlap_prefill=True, decode_ticks=2)
+
+        srv = InferenceServer(cfg, params, engine=eng, step_timeout=10.0,
+                              restart_budget=2, engine_factory=factory)
+        gen0_thread = srv._thread
+        try:
+            with pytest.raises(RuntimeError, match="step_timeout"):
+                srv.generate([1, 2, 3], max_new=4, timeout=120)
+            _wait_status(srv, "ok")
+            out = srv.generate([4, 5, 6], max_new=6, timeout=120)
+            ref = BatchingEngine(cfg, params, n_slots=2, max_len=64,
+                                 temperature=0.0, decode_ticks=2)
+            want = ref.run([("r", np.array([4, 5, 6], np.int32), 6)])["r"]
+            assert list(out) == list(want)
+        finally:
+            _teardown(srv, eng, old_threads=(gen0_thread,))
+
 
 class TestAdmissionControl:
     def test_over_limit_rejected_429(self):
