@@ -16,6 +16,11 @@ an error JSON line. Never a bare traceback.
 
 from __future__ import annotations
 
+# shellac: ignore[SH015] — the shellac_bench_* gauges are bench-local
+# headline series (set once per run, snapshotted into BENCH_* files),
+# deliberately outside the serving bundle layer; cataloged in
+# docs/observability.md §Bench.
+
 import json
 import os
 import subprocess

@@ -9,7 +9,13 @@ CI "no new findings" gating and CHANGES.md summaries:
 Findings are keyed by (rule, path, message) — NOT by line number, so a
 finding that merely moves when unrelated lines shift is neither "new"
 nor "fixed". Exit status: 0 (no new findings), 1 (new findings and
---fail-on-new), 2 (unreadable/invalid report).
+--fail-on-new), 2 (unreadable/invalid report — a deleted or corrupt
+baseline must fail the gate loudly, never green it).
+
+`--check-schema report.json` validates one report against the schema
+the CLI promises (version/paths/findings/summary, finding fields and
+types, summary consistency) and exits 0/2 — CI runs it so the JSON
+shape downstream tooling parses cannot drift silently.
 """
 
 from __future__ import annotations
@@ -19,18 +25,74 @@ import json
 import sys
 from collections import Counter
 
+#: The report shape `shellac_tpu.analysis.cli.report_dict` emits.
+SCHEMA_VERSION = 1
+_FINDING_FIELDS = {"path": str, "line": int, "col": int,
+                   "rule": str, "message": str}
+
 
 def load_report(path: str) -> dict:
+    # Exit 2 (not 1) on a missing/corrupt report: 1 means "new
+    # findings", and a deleted baseline must not be mistaken for it —
+    # or, without --fail-on-new, silently pass.
     try:
         with open(path, encoding="utf-8") as f:
             report = json.load(f)
     except (OSError, ValueError) as e:
-        raise SystemExit(f"error: cannot read report {path}: {e}")
+        print(f"error: cannot read report {path}: {e}", file=sys.stderr)
+        raise SystemExit(2)
     if not isinstance(report, dict) or "findings" not in report:
-        raise SystemExit(
-            f"error: {path} is not a lint report (no 'findings' key)"
-        )
+        print(f"error: {path} is not a lint report (no 'findings' key)",
+              file=sys.stderr)
+        raise SystemExit(2)
     return report
+
+
+def schema_errors(report: dict) -> list:
+    """Every way `report` deviates from the published schema (empty
+    list = valid). Checked strictly: downstream tooling indexes these
+    fields, so a drifted shape must fail CI, not a consumer."""
+    errs = []
+    if report.get("version") != SCHEMA_VERSION:
+        errs.append(f"version is {report.get('version')!r}, "
+                    f"expected {SCHEMA_VERSION}")
+    paths = report.get("paths")
+    if not (isinstance(paths, list)
+            and all(isinstance(p, str) for p in paths)):
+        errs.append("'paths' is not a list of strings")
+    findings = report.get("findings")
+    if not isinstance(findings, list):
+        errs.append("'findings' is not a list")
+        findings = []
+    for i, f in enumerate(findings):
+        if not isinstance(f, dict):
+            errs.append(f"findings[{i}] is not an object")
+            continue
+        for field, typ in _FINDING_FIELDS.items():
+            v = f.get(field)
+            # bool is an int subclass; a true/false line number is
+            # still a schema break.
+            if not isinstance(v, typ) or isinstance(v, bool):
+                errs.append(f"findings[{i}].{field} is "
+                            f"{type(v).__name__}, expected "
+                            f"{typ.__name__}")
+    summary = report.get("summary")
+    if not isinstance(summary, dict):
+        errs.append("'summary' is not an object")
+        return errs
+    if summary.get("findings") != len(findings):
+        errs.append(f"summary.findings is {summary.get('findings')!r} "
+                    f"but the report holds {len(findings)} finding(s)")
+    by_rule = summary.get("by_rule")
+    if not isinstance(by_rule, dict):
+        errs.append("summary.by_rule is not an object")
+    else:
+        actual = Counter(f["rule"] for f in findings
+                         if isinstance(f, dict) and "rule" in f)
+        if by_rule != dict(actual):
+            errs.append(f"summary.by_rule {by_rule!r} does not match "
+                        f"the findings ({dict(actual)!r})")
+    return errs
 
 
 def finding_keys(report: dict) -> Counter:
@@ -68,14 +130,34 @@ def _key_lines(lines_by_key: dict, key: tuple, n: int) -> list:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("baseline", help="older JSON report")
-    p.add_argument("current", help="newer JSON report")
+    p.add_argument("baseline", help="older JSON report (or the sole "
+                                    "report with --check-schema)")
+    p.add_argument("current", nargs="?", default=None,
+                   help="newer JSON report")
     p.add_argument("--fail-on-new", action="store_true",
                    help="exit 1 when the current report has findings "
                         "absent from the baseline")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit the diff as JSON instead of text")
+    p.add_argument("--check-schema", action="store_true",
+                   help="validate the report's JSON schema instead of "
+                        "diffing; exit 0 (valid) or 2")
     args = p.parse_args(argv)
+
+    if args.check_schema:
+        errs = []
+        for path in filter(None, (args.baseline, args.current)):
+            for e in schema_errors(load_report(path)):
+                errs.append(f"{path}: {e}")
+        if errs:
+            print("schema error(s):", file=sys.stderr)
+            for e in errs:
+                print(f"  {e}", file=sys.stderr)
+            return 2
+        print("schema ok")
+        return 0
+    if args.current is None:
+        p.error("current report required unless --check-schema")
 
     old, new = load_report(args.baseline), load_report(args.current)
     added, fixed = diff(old, new)

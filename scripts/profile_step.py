@@ -12,6 +12,11 @@ consumable VERBATIM by `python -m shellac_tpu trace-report <dir>`
 (add `--report` to run the analysis inline).
 """
 
+# shellac: ignore[SH015] — shellac_profile_section_seconds lives in a
+# script-local Registry (never the process-global one) and exists only
+# inside this script's JSON output; cataloged in docs/observability.md
+# §Bench.
+
 import argparse
 import json
 import time

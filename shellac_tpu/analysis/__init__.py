@@ -7,6 +7,17 @@ nondeterminism (SH003), leftover debug aids (SH004), set-iteration
 order dependence (SH005), dead config flags (SH006), and sharding-
 constraint asymmetry between paired paths (SH007).
 
+The concurrency pass (`concurrency.py`) covers the threaded serving
+stack: unguarded cross-thread state (SH010), callbacks invoked under a
+held lock (SH011), lock-order inversion (SH012), blocking calls under
+a lock (SH013), and non-daemon threads with no join-on-close path
+(SH014) — with `# shellac: guarded-by(<lock>)` annotations that both
+document and feed the held-lock model. The contract pass
+(`contracts.py`) checks cross-layer drift: every `shellac_*` metric
+name declared in an obs bundle and cataloged in docs/observability.md
+(SH015), every flight-recorder event kind in the docs' event catalog
+(SH016).
+
 Run it with `python -m shellac_tpu.analysis <paths>` or
 `python -m shellac_tpu lint <paths>`; see docs/static_analysis.md.
 """

@@ -1,0 +1,874 @@
+"""Concurrency-contract rules: lock discipline for the threaded stack.
+
+PRs 2-14 grew roughly ten threaded subsystems — the supervisor's
+scheduler generations, the tier's health poller and push pool, the obs
+layer's incident/spool/SLO/fleet/recorder locks — whose thread-safety
+rules existed only as comments and chaos tests. These rules make the
+discipline mechanical. Like the rest of the engine the analysis is
+pure `ast`: no code is imported or executed, and everything resolves
+module-locally (no imports are followed) except the cross-class lock
+graph, which SH012 assembles over the whole scanned tree.
+
+The model, built once per file:
+
+- a class's *locks* are its `self.X = threading.Lock()/RLock()/
+  Condition()` attributes (plus module-level lock globals);
+- its *spawn roots* are methods handed to `threading.Thread(target=`
+  or an executor's `.submit`/`.map`, and the reachability closure over
+  `self.*` calls from those roots is "runs on a spawned thread";
+- held-lock sets are propagated through `with self._lock:` regions and
+  into same-class `self.method()` calls (bounded by a visited set), so
+  a helper that only ever runs under its caller's lock is analyzed
+  with that lock held.
+
+`# shellac: guarded-by(<lock>)` is the annotation half: trailing a
+line it asserts the named lock is held for that line's accesses;
+trailing a `def` line it asserts the whole function runs with the
+lock held (the `*_locked` caller-holds-lock convention). It both
+documents the contract and feeds the held-set model — which means it
+can *surface* findings too (a blocking call inside a guarded-by
+function is now visibly under a lock). `# shellac: ignore[CODE]`
+works as everywhere else.
+
+Rules:
+
+- SH010 unguarded shared state across threads
+- SH011 user-supplied callback invoked while a lock is held
+- SH012 lock-order inversion (cross-class acquisition graph)
+- SH013 blocking call under a held lock
+- SH014 non-daemon thread with no join-on-close path
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from shellac_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    ProjectRule,
+    Rule,
+    register,
+)
+from shellac_tpu.analysis.rules import _callable_names, _chain, _iter_calls
+
+_GUARDED_RE = re.compile(
+    r"#\s*shellac:\s*guarded-by\(([A-Za-z0-9_.\s,]+)\)"
+)
+
+#: Constructors whose result is a mutex-like guard (Condition wraps a
+#: lock and is acquired the same way; Event is NOT a lock).
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_QUEUE_CTORS = {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "Queue", "SimpleQueue",
+}
+#: Dotted calls that block the calling thread (network, disk-scale, or
+#: device round trips) — SH013's subject when a lock is held.
+_BLOCKING_CHAINS = {
+    "time.sleep",
+    "urllib.request.urlopen",
+    "socket.create_connection",
+    "subprocess.run", "subprocess.check_output", "subprocess.check_call",
+    "subprocess.call",
+    "jax.device_get",
+}
+#: Zero-argument method calls that block indefinitely.
+_BLOCKING_METHODS = {"join", "wait", "result", "acquire"}
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class GuardedBy:
+    """Per-file `# shellac: guarded-by(<lock>)` annotation map.
+
+    Trailing a code line -> the named locks are held for that line.
+    Trailing a `def` line -> held throughout that function (the
+    `*_locked` caller-holds-the-lock convention).
+    """
+
+    def __init__(self, source: str, tree: ast.AST):
+        self.by_line: Dict[int, FrozenSet[str]] = {}
+        self._spans: List[Tuple[int, int, FrozenSet[str]]] = []
+        try:
+            tokens = list(
+                tokenize.generate_tokens(io.StringIO(source).readline)
+            )
+        except (tokenize.TokenError, IndentationError):
+            return
+        raw: Dict[int, Set[str]] = {}
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _GUARDED_RE.search(tok.string)
+            if not m:
+                continue
+            locks = {x.strip() for x in m.group(1).split(",") if x.strip()}
+            raw.setdefault(tok.start[0], set()).update(locks)
+        if not raw:
+            return
+        # A guarded-by trailing a `def` line scopes to the whole body.
+        def_lines: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, _FuncDef) and node.lineno in raw:
+                def_lines.add(node.lineno)
+                self._spans.append((
+                    node.lineno, node.end_lineno or node.lineno,
+                    frozenset(raw[node.lineno]),
+                ))
+        for line, locks in raw.items():
+            if line not in def_lines:
+                self.by_line[line] = frozenset(locks)
+
+    def line_locks(self, line: int) -> FrozenSet[str]:
+        out = self.by_line.get(line, frozenset())
+        for a, b, locks in self._spans:
+            if a <= line <= b:
+                out = out | locks
+        return out
+
+    def fn_locks(self, fn: ast.AST) -> FrozenSet[str]:
+        line = getattr(fn, "lineno", -1)
+        for a, _b, locks in self._spans:
+            if a == line:
+                return locks
+        return frozenset()
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.X` -> "X"."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _ClassModel:
+    """Module-local concurrency facts for one class."""
+
+    def __init__(self, name: str, node: ast.ClassDef,
+                 methods: Dict[str, ast.FunctionDef]):
+        self.name = name
+        self.node = node
+        self.methods = methods
+        self.locks: Set[str] = set()
+        self.queue_attrs: Set[str] = set()
+        self.callback_attrs: Set[str] = set()
+        #: attr -> class names it may be constructed from (SH012's
+        #: cross-class edges).
+        self.attr_classes: Dict[str, Set[str]] = {}
+        self.spawn_roots: Set[str] = set()
+        #: line spans of nested defs handed to Thread(target=...) —
+        #: closures that run on a spawned thread without being methods.
+        self.spawn_spans: List[Tuple[int, int]] = []
+        self.thread_methods: Set[str] = set()
+        self.internal_callees: Set[str] = set()
+        #: (lineno, col) of AugAssign targets — read-modify-write sites.
+        self.aug_targets: Set[Tuple[int, int]] = set()
+
+    def populate(self, module: "_ModuleModel") -> None:
+        nested_defs: Dict[str, ast.AST] = {}
+        for mname, fn in self.methods.items():
+            for node in ast.walk(fn):
+                if isinstance(node, _FuncDef) and node is not fn:
+                    nested_defs[node.name] = node
+                if isinstance(node, ast.AugAssign):
+                    t = node.target
+                    self.aug_targets.add((t.lineno, t.col_offset))
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        self._classify_attr(attr, node.value, module,
+                                            in_init=(mname == "__init__"),
+                                            fn=fn)
+                elif (isinstance(node, ast.AnnAssign)
+                        and node.value is not None):
+                    attr = _self_attr(node.target)
+                    if attr is not None:
+                        self._classify_attr(attr, node.value, module,
+                                            in_init=(mname == "__init__"),
+                                            fn=fn)
+            for call in _iter_calls(fn):
+                self._note_spawn(call, nested_defs)
+                for cname in _callable_names(call.func):
+                    if cname in self.methods:
+                        self.internal_callees.add(cname)
+        # Class-body lock attributes (rare, but cheap to honour).
+        for node in self.node.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                if _chain(node.value.func) in _LOCK_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.locks.add(t.id)
+        self.thread_methods = self._closure(self.spawn_roots)
+
+    def _classify_attr(self, attr: str, value: ast.AST,
+                       module: "_ModuleModel", in_init: bool,
+                       fn: ast.FunctionDef) -> None:
+        for call in ast.walk(value):
+            if not isinstance(call, ast.Call):
+                continue
+            chain = _chain(call.func)
+            if chain in _LOCK_CTORS:
+                self.locks.add(attr)
+            elif chain in _QUEUE_CTORS:
+                self.queue_attrs.add(attr)
+            elif chain is not None and chain in module.class_names:
+                self.attr_classes.setdefault(attr, set()).add(chain)
+        if in_init:
+            params = {
+                a.arg for a in (list(fn.args.posonlyargs)
+                                + list(fn.args.args)
+                                + list(fn.args.kwonlyargs))
+                if a.arg != "self"
+            }
+            for name in ast.walk(value):
+                if isinstance(name, ast.Name) and name.id in params:
+                    self.callback_attrs.add(attr)
+                    break
+
+    def _note_spawn(self, call: ast.Call,
+                    nested_defs: Dict[str, ast.AST]) -> None:
+        targets: List[ast.AST] = []
+        if _chain(call.func) in _THREAD_CTORS:
+            targets += [kw.value for kw in call.keywords
+                        if kw.arg == "target"]
+        elif (isinstance(call.func, ast.Attribute)
+                and call.func.attr in ("submit", "map") and call.args):
+            targets.append(call.args[0])
+        for t in targets:
+            for name in _callable_names(t):
+                if name in self.methods:
+                    self.spawn_roots.add(name)
+                elif name in nested_defs:
+                    d = nested_defs[name]
+                    self.spawn_spans.append(
+                        (d.lineno, d.end_lineno or d.lineno))
+
+    def _closure(self, roots: Set[str]) -> Set[str]:
+        out: Set[str] = set()
+        stack = [r for r in roots if r in self.methods]
+        while stack:
+            m = stack.pop()
+            if m in out:
+                continue
+            out.add(m)
+            for call in _iter_calls(self.methods[m]):
+                for name in _callable_names(call.func):
+                    if name in self.methods and name not in out:
+                        stack.append(name)
+        return out
+
+    def scan_roots(self) -> List[str]:
+        """Entry methods for held-set scans: methods no other method of
+        the class calls, plus the spawn roots. A helper only reachable
+        under its caller's lock is then analyzed with that lock held
+        instead of with a spurious empty set."""
+        roots = [m for m in self.methods
+                 if m not in self.internal_callees]
+        roots += [r for r in self.spawn_roots if r not in roots]
+        return roots or list(self.methods)
+
+    def in_spawn_span(self, line: int) -> bool:
+        return any(a <= line <= b for a, b in self.spawn_spans)
+
+
+class _Access:
+    """One `self.X` read/write site with its held-lock set."""
+
+    __slots__ = ("attr", "write", "aug", "method", "held", "node",
+                 "threaded")
+
+    def __init__(self, attr, write, aug, method, held, node, threaded):
+        self.attr = attr
+        self.write = write
+        self.aug = aug
+        self.method = method
+        self.held = held
+        self.node = node
+        self.threaded = threaded
+
+
+class _ScanResult:
+    """Everything one interprocedural held-set scan of a class found."""
+
+    def __init__(self) -> None:
+        self.accesses: List[_Access] = []
+        #: (method, call node, held) for every Call site.
+        self.calls: List[Tuple[str, ast.Call, FrozenSet[str]]] = []
+        #: (held-before, acquired tokens, node) for every lock `with`.
+        self.acquisitions: List[
+            Tuple[FrozenSet[str], FrozenSet[str], ast.AST]] = []
+
+
+class _ModuleModel:
+    """Per-file concurrency model, cached on the FileContext."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.guarded = GuardedBy(ctx.source, ctx.tree)
+        self.module_locks: Set[str] = set()
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                if _chain(node.value.func) in _LOCK_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks.add(t.id)
+        classes = {n.name: n for n in ast.walk(ctx.tree)
+                   if isinstance(n, ast.ClassDef)}
+        self.class_names = set(classes)
+        self.classes: Dict[str, _ClassModel] = {}
+        for name, node in classes.items():
+            cm = _ClassModel(name, node, _merged(classes, node))
+            self.classes[name] = cm
+        #: lock attr name -> owning classes, for `obj.lock` resolution.
+        self.lock_attr_owner: Dict[str, List[str]] = {}
+        for cm in self.classes.values():
+            cm.populate(self)
+        for cm in self.classes.values():
+            for lk in cm.locks:
+                self.lock_attr_owner.setdefault(lk, []).append(cm.name)
+        self._scans: Dict[str, _ScanResult] = {}
+
+    def lock_tokens(self, cm: Optional[_ClassModel],
+                    expr: ast.AST) -> List[str]:
+        """Lock tokens acquired by `with <expr>:` — a self lock attr
+        ("_lock"), a module-level lock global, or another object's
+        lock attr resolved by unique owner ("Replica.lock")."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            if cm is not None and attr in cm.locks:
+                return [attr]
+            return []
+        if isinstance(expr, ast.Attribute):
+            owners = self.lock_attr_owner.get(expr.attr, [])
+            if len(owners) == 1:
+                return [f"{owners[0]}.{expr.attr}"]
+            return []
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return [expr.id]
+        return []
+
+    def scan(self, cm: _ClassModel) -> _ScanResult:
+        """Interprocedural held-set walk over one class (cached)."""
+        cached = self._scans.get(cm.name)
+        if cached is not None:
+            return cached
+        res = _ScanResult()
+        seen: Set[Tuple[str, FrozenSet[str]]] = set()
+
+        def run(mname: str, held: FrozenSet[str]) -> None:
+            key = (mname, held)
+            if key in seen or len(seen) > 4000:
+                return
+            seen.add(key)
+            fn = cm.methods[mname]
+            held = held | self.guarded.fn_locks(fn)
+            for st in fn.body:
+                visit(mname, st, held)
+
+        def visit(mname: str, node: ast.AST,
+                  held: FrozenSet[str]) -> None:
+            line = getattr(node, "lineno", None)
+            eff = held if line is None else (
+                held | self.guarded.line_locks(line))
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr is not None:
+                    write = isinstance(node.ctx, (ast.Store, ast.Del))
+                    aug = (node.lineno, node.col_offset) in cm.aug_targets
+                    res.accesses.append(_Access(
+                        attr, write, aug, mname, eff, node,
+                        mname in cm.thread_methods
+                        or cm.in_spawn_span(node.lineno),
+                    ))
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                tokens: Set[str] = set()
+                for item in node.items:
+                    visit(mname, item.context_expr, held)
+                    tokens.update(
+                        self.lock_tokens(cm, item.context_expr))
+                if tokens:
+                    res.acquisitions.append(
+                        (eff, frozenset(tokens), node))
+                inner = held | frozenset(tokens)
+                for st in node.body:
+                    visit(mname, st, inner)
+                return
+            if isinstance(node, _FuncDef) or isinstance(node, ast.Lambda):
+                # A nested def's body runs when CALLED, not here: scan
+                # it with an empty held set rather than the enclosing
+                # region's (conservative for SH011/SH013; SH010 still
+                # sees its accesses via the spawn-span tagging).
+                body = node.body if isinstance(node, _FuncDef) \
+                    else [node.body]
+                for st in body:
+                    visit(mname, st, frozenset())
+                return
+            if isinstance(node, ast.Call):
+                res.calls.append((mname, node, eff))
+                callee = _self_attr(node.func)
+                if callee in cm.methods:
+                    run(callee, eff)
+            for child in ast.iter_child_nodes(node):
+                visit(mname, child, held)
+
+        for root in cm.scan_roots():
+            run(root, frozenset())
+        self._scans[cm.name] = res
+        return res
+
+    def method_acquires(self, cm: _ClassModel, mname: str,
+                        _seen: Optional[Set[str]] = None
+                        ) -> FrozenSet[str]:
+        """Lock tokens `mname` may acquire, including through same-
+        class calls (SH012's cross-class edge targets)."""
+        if _seen is None:
+            _seen = set()
+        if mname in _seen or mname not in cm.methods:
+            return frozenset()
+        _seen.add(mname)
+        out: Set[str] = set()
+        for node in ast.walk(cm.methods[mname]):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    out.update(self.lock_tokens(cm, item.context_expr))
+            elif isinstance(node, ast.Call):
+                callee = _self_attr(node.func)
+                if callee is not None:
+                    out.update(
+                        self.method_acquires(cm, callee, _seen))
+        return frozenset(out)
+
+
+def _merged(classes: Dict[str, ast.ClassDef],
+            cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    """Module-local MRO merge, override-wins (the SH002 pattern)."""
+    out: Dict[str, ast.FunctionDef] = {}
+    for base in cls.bases:
+        name = _chain(base)
+        if name in classes and classes[name] is not cls:
+            out.update(_merged(classes, classes[name]))
+    for node in cls.body:
+        if isinstance(node, _FuncDef):
+            out[node.name] = node
+    return out
+
+
+def _model(ctx: FileContext) -> _ModuleModel:
+    m = getattr(ctx, "_concurrency_model", None)
+    if m is None:
+        m = _ModuleModel(ctx)
+        ctx._concurrency_model = m  # type: ignore[attr-defined]
+    return m
+
+
+def _fmt_locks(held: FrozenSet[str]) -> str:
+    return "/".join(sorted(held)) if held else "no lock"
+
+
+# ---------------------------------------------------------------------
+# SH010 — unguarded shared state across threads
+# ---------------------------------------------------------------------
+
+
+@register
+class UnguardedSharedState(Rule):
+    code = "SH010"
+    name = "unguarded-shared-state"
+    summary = (
+        "an attribute written on a spawned-thread path and accessed "
+        "elsewhere with no common lock, or a read-modify-write "
+        "(`self.x += ...`) with no lock in a lock-owning class — "
+        "annotate deliberate lock-free designs with "
+        "`# shellac: guarded-by(...)` or ignore[SH010] + rationale"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        model = _model(ctx)
+        for cm in model.classes.values():
+            if not cm.locks and not cm.spawn_roots:
+                continue
+            scan = model.scan(cm)
+            by_attr: Dict[str, List[_Access]] = {}
+            for a in scan.accesses:
+                if a.attr in cm.locks or a.method == "__init__":
+                    continue
+                by_attr.setdefault(a.attr, []).append(a)
+            reported: Set[str] = set()
+            for attr, accs in sorted(by_attr.items()):
+                writes = [a for a in accs if a.write]
+                if not writes:
+                    continue
+                f = self._race(ctx, cm, attr, accs, writes)
+                if f is not None and attr not in reported:
+                    reported.add(attr)
+                    yield f
+                    continue
+                if cm.locks and attr not in reported:
+                    f = self._bare_rmw(ctx, cm, attr, writes)
+                    if f is not None:
+                        reported.add(attr)
+                        yield f
+
+    def _race(self, ctx, cm, attr, accs, writes) -> Optional[Finding]:
+        """A write on the spawned-thread side and an access on the
+        other side sharing no lock."""
+        if not cm.spawn_roots:
+            return None
+        for w in writes:
+            for a in accs:
+                if a is w or a.threaded == w.threaded:
+                    continue
+                if w.held & a.held:
+                    continue
+                return self.finding(
+                    ctx, w.node,
+                    f"self.{attr} is written in "
+                    f"{w.method!r} ({_fmt_locks(w.held)}) and "
+                    f"{'written' if a.write else 'read'} in "
+                    f"{a.method!r} ({_fmt_locks(a.held)}) with no "
+                    f"common lock, and {cm.name} runs "
+                    f"{'/'.join(sorted(cm.spawn_roots))} on a spawned "
+                    "thread — guard both sides with one lock or "
+                    "annotate the design",
+                )
+        return None
+
+    def _bare_rmw(self, ctx, cm, attr, writes) -> Optional[Finding]:
+        """`self.x += 1` with no lock held in a class that owns locks:
+        a read-modify-write is never atomic, and a lock-owning class
+        has declared itself cross-thread."""
+        for w in writes:
+            if w.aug and not w.held:
+                return self.finding(
+                    ctx, w.node,
+                    f"read-modify-write of self.{attr} in "
+                    f"{w.method!r} holds none of {cm.name}'s locks "
+                    f"({'/'.join(sorted(cm.locks))}) — increments "
+                    "are not atomic; move it under the lock or "
+                    "annotate with # shellac: guarded-by(...)",
+                )
+        return None
+
+
+# ---------------------------------------------------------------------
+# SH011 — user-supplied callback invoked while a lock is held
+# ---------------------------------------------------------------------
+
+
+@register
+class CallbackUnderLock(Rule):
+    code = "SH011"
+    name = "callback-under-lock"
+    summary = (
+        "a constructor-injected callback (or on_* hook) invoked while "
+        "a lock is held: a callback that re-enters the holder, or just "
+        "stalls, deadlocks every other thread — collect under the "
+        "lock, invoke after it drops (the SLOEngine on_transition "
+        "pattern)"
+    )
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        model = _model(ctx)
+        seen: Set[Tuple[int, int]] = set()
+        for cm in model.classes.values():
+            scan = model.scan(cm)
+            for _mname, call, held in scan.calls:
+                if not held:
+                    continue
+                attr = _self_attr(call.func)
+                if attr is None:
+                    continue
+                hook = (attr in cm.callback_attrs
+                        or ((attr.startswith("on_")
+                             or attr.startswith("_on_"))
+                            and attr not in cm.methods))
+                if not hook or attr in cm.methods:
+                    continue
+                key = (call.lineno, call.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield self.finding(
+                    ctx, call,
+                    f"user-supplied callback self.{attr} invoked while "
+                    f"holding {_fmt_locks(held)} — a re-entrant or "
+                    "slow callback deadlocks the holder; collect "
+                    "under the lock and fire after it drops",
+                )
+
+
+# ---------------------------------------------------------------------
+# SH012 — lock-order inversion
+# ---------------------------------------------------------------------
+
+
+@register
+class LockOrderInversion(ProjectRule):
+    code = "SH012"
+    name = "lock-order-inversion"
+    summary = (
+        "two locks are acquired in opposite orders on different paths "
+        "(nested `with` blocks and calls into other classes' "
+        "lock-taking methods build the acquisition graph; a cycle is "
+        "a potential deadlock)"
+    )
+
+    def check_project(self, ctxs: Sequence[FileContext]
+                      ) -> Iterable[Finding]:
+        # node -> {succ: (path, line)}; nodes are "Class.lock" /
+        # module-lock names, globally qualified.
+        edges: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        models = [(_model(ctx), ctx) for ctx in ctxs]
+        by_class: Dict[str, Tuple[_ModuleModel, _ClassModel]] = {}
+        for model, _ctx in models:
+            for cm in model.classes.values():
+                by_class.setdefault(cm.name, (model, cm))
+
+        def qual(cm: _ClassModel, token: str) -> str:
+            return token if "." in token else f"{cm.name}.{token}"
+
+        def add(a: str, b: str, path: str, line: int) -> None:
+            if a != b:
+                edges.setdefault(a, {}).setdefault(b, (path, line))
+
+        for model, ctx in models:
+            for cm in model.classes.values():
+                scan = model.scan(cm)
+                for held, acquired, node in scan.acquisitions:
+                    for h in held:
+                        for t in acquired:
+                            add(qual(cm, h), qual(cm, t),
+                                ctx.path, node.lineno)
+                for _m, call, held in scan.calls:
+                    if not held:
+                        continue
+                    self._cross_edges(cm, call, held, by_class,
+                                      qual, add, ctx)
+        yield from self._cycles(edges)
+
+    def _cross_edges(self, cm, call, held, by_class, qual, add, ctx):
+        """`self.attr.m()` under a lock -> edges into every lock the
+        attribute's (module-locally inferred) class may take in m."""
+        f = call.func
+        if not (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Attribute)):
+            return
+        attr = _self_attr(f.value)
+        if attr is None or attr not in cm.attr_classes:
+            return
+        for cls_name in sorted(cm.attr_classes[attr]):
+            entry = by_class.get(cls_name)
+            if entry is None:
+                continue
+            omodel, ocm = entry
+            for t in sorted(omodel.method_acquires(ocm, f.attr)):
+                for h in held:
+                    add(qual(cm, h), qual(ocm, t),
+                        ctx.path, call.lineno)
+
+    def _cycles(self, edges) -> Iterable[Finding]:
+        seen_cycles: Set[FrozenSet[str]] = set()
+        for start in sorted(edges):
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for succ in sorted(edges.get(node, ())):
+                    if succ == start:
+                        cyc = frozenset(path)
+                        if cyc in seen_cycles:
+                            continue
+                        seen_cycles.add(cyc)
+                        fpath, line = edges[node][succ]
+                        order = " -> ".join(path + [start])
+                        yield Finding(
+                            path=fpath, line=line, col=1,
+                            rule=self.code,
+                            message=(
+                                f"lock-order inversion: {order} — "
+                                "two threads taking these locks in "
+                                "opposite orders deadlock; pick one "
+                                "global order or drop the outer lock "
+                                "before crossing"
+                            ),
+                        )
+                    elif succ not in path and len(path) < 8:
+                        stack.append((succ, path + [succ]))
+
+
+# ---------------------------------------------------------------------
+# SH013 — blocking call under a held lock
+# ---------------------------------------------------------------------
+
+
+@register
+class BlockingUnderLock(Rule):
+    code = "SH013"
+    name = "blocking-under-lock"
+    summary = (
+        "a blocking call (HTTP/socket/sleep/device_get, untimed "
+        "queue.get/join/wait) while holding a lock: every other "
+        "thread needing that lock stalls for the full wait — do the "
+        "slow work outside the critical section"
+    )
+
+    def _blocking(self, cm: _ClassModel, call: ast.Call,
+                  held: FrozenSet[str]) -> Optional[str]:
+        chain = _chain(call.func)
+        if chain in _BLOCKING_CHAINS:
+            return chain
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        meth = call.func.attr
+        has_timeout = any(kw.arg == "timeout" for kw in call.keywords)
+        if (meth in _BLOCKING_METHODS and not call.args
+                and not call.keywords):
+            # x.join() / x.wait() / x.result() / x.acquire() untimed.
+            # Condition.wait while holding ITS OWN lock is the correct
+            # protocol — only flag when some OTHER lock is also held.
+            recv = _self_attr(call.func.value)
+            if meth == "wait" and recv is not None and recv in cm.locks:
+                others = held - {recv}
+                return f".{meth}() (while also holding " \
+                       f"{_fmt_locks(others)})" if others else None
+            return f".{meth}()"
+        if meth == "get" and not has_timeout and not call.args:
+            recv = _self_attr(call.func.value)
+            if recv is not None and recv in cm.queue_attrs:
+                return f"self.{recv}.get() with no timeout"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        model = _model(ctx)
+        seen: Set[Tuple[int, int]] = set()
+        for cm in model.classes.values():
+            if not cm.locks and not model.module_locks:
+                continue
+            scan = model.scan(cm)
+            for _mname, call, held in scan.calls:
+                if not held:
+                    continue
+                what = self._blocking(cm, call, held)
+                key = (call.lineno, call.col_offset)
+                if what and key not in seen:
+                    seen.add(key)
+                    yield self.finding(
+                        ctx, call,
+                        f"blocking call {what} while holding "
+                        f"{_fmt_locks(held)} — every thread needing "
+                        "the lock stalls for the full wait; move the "
+                        "slow work outside the critical section",
+                    )
+
+
+# ---------------------------------------------------------------------
+# SH014 — non-daemon thread with no join-on-close path
+# ---------------------------------------------------------------------
+
+
+@register
+class ThreadNoJoin(Rule):
+    code = "SH014"
+    name = "thread-no-join"
+    summary = (
+        "threading.Thread(...) that is neither daemon=True nor joined "
+        "anywhere: the thread outlives close() and hangs interpreter "
+        "shutdown (the conftest thread-leak detector's static twin)"
+    )
+
+    def _daemon_true(self, call: ast.Call) -> Optional[bool]:
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.is_test:
+            return
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        joined, daemonized = self._join_and_daemon_sites(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and _chain(node.func) in _THREAD_CTORS):
+                continue
+            d = self._daemon_true(node)
+            if d:
+                continue
+            bound = self._binding(node, parents)
+            if bound is not None and (bound in joined
+                                      or bound in daemonized):
+                continue
+            yield self.finding(
+                ctx, node,
+                ("thread bound to " + bound if bound is not None
+                 else "anonymous thread")
+                + " is neither daemon=True nor joined on any path — "
+                  "it outlives close() and hangs shutdown; pass "
+                  "daemon=True or join it in close()/stop()",
+            )
+
+    def _binding(self, call: ast.Call,
+                 parents: Dict[ast.AST, ast.AST]) -> Optional[str]:
+        """"self.X" / "x" the thread is assigned to, else None."""
+        node, parent = call, parents.get(call)
+        while parent is not None and isinstance(
+                parent, (ast.IfExp, ast.BoolOp)):
+            node, parent = parent, parents.get(parent)
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    return f"self.{attr}"
+                if isinstance(t, ast.Name):
+                    return t.id
+        if isinstance(parent, ast.AnnAssign):
+            attr = _self_attr(parent.target)
+            if attr is not None:
+                return f"self.{attr}"
+            if isinstance(parent.target, ast.Name):
+                return parent.target.id
+        return None
+
+    def _join_and_daemon_sites(self, tree: ast.AST
+                               ) -> Tuple[Set[str], Set[str]]:
+        joined: Set[str] = set()
+        daemonized: Set[str] = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                recv = node.func.value
+                attr = _self_attr(recv)
+                if attr is not None:
+                    joined.add(f"self.{attr}")
+                elif isinstance(recv, ast.Name):
+                    joined.add(recv.id)
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and t.attr == "daemon"
+                            and isinstance(node.value, ast.Constant)
+                            and node.value.value):
+                        attr = _self_attr(t.value)
+                        if attr is not None:
+                            daemonized.add(f"self.{attr}")
+                        elif isinstance(t.value, ast.Name):
+                            daemonized.add(t.value.id)
+        return joined, daemonized

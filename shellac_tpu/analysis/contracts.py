@@ -1,0 +1,184 @@
+"""Cross-layer drift contracts: metrics and recorder-event catalogs.
+
+The repo maintains two human-readable catalogs by hand:
+`docs/observability.md` lists every `shellac_*` metric family and the
+flight-recorder event catalog. Until these rules, nothing checked that
+the code and the catalogs agree — a new counter or event kind shipped
+in a PR quietly drifts out of the operator docs. These ProjectRules
+close the loop:
+
+- SH015: every literal `shellac_*` metric name passed to
+  `.counter(/.gauge(/.histogram(` in non-test code must (a) when
+  registered outside `obs/`, also appear in an `obs/` module — the
+  bundle layer owns the namespace — and (b) appear in
+  `docs/observability.md`.
+- SH016: every literal flight-recorder event kind (the second argument
+  of a `.record(trace_id, "kind", ...)` call) must appear backticked
+  in the docs' event catalog.
+
+Both halves gate on their contract source being present in the scanned
+tree: the docs file is located by walking up from the scanned paths
+(only paths that exist on disk are consulted, so in-memory test
+snippets never bind to the live repo's docs), and the obs-namespace
+half only runs when the scan includes `obs/` modules. `python -m
+shellac_tpu.analysis shellac_tpu` from the repo root therefore checks
+the real contract, while fixture trees built under tmp dirs carry
+their own miniature `docs/observability.md`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from shellac_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    ProjectRule,
+    register,
+)
+
+_INSTRUMENT_METHODS = {"counter", "gauge", "histogram"}
+_DOC_RELPATH = Path("docs") / "observability.md"
+#: Recorder event kinds are short kebab-case words; anything else as a
+#: second argument to `.record()` is some other API.
+_KIND_RE = re.compile(r"^[a-z][a-z0-9-]*$")
+
+
+def _find_doc(ctxs: Sequence[FileContext]) -> Optional[str]:
+    """docs/observability.md text, located by walking up from scanned
+    paths that actually exist on disk (in-memory snippets with fake
+    paths never resolve, so unit fixtures stay hermetic)."""
+    for ctx in ctxs:
+        p = Path(ctx.path)
+        if not p.exists():
+            continue
+        for parent in p.resolve().parents:
+            doc = parent / _DOC_RELPATH
+            if doc.is_file():
+                try:
+                    return doc.read_text(encoding="utf-8")
+                except OSError:
+                    return None
+    return None
+
+
+def _in_obs(ctx: FileContext) -> bool:
+    return "obs" in Path(ctx.path).parts
+
+
+# ---------------------------------------------------------------------
+# SH015 — metric name drift
+# ---------------------------------------------------------------------
+
+
+@register
+class MetricCatalogDrift(ProjectRule):
+    code = "SH015"
+    name = "metric-catalog-drift"
+    summary = (
+        "a literal shellac_* metric name registered in code is missing "
+        "from the obs namespace layer or from the "
+        "docs/observability.md catalog — the operator docs have "
+        "drifted from the code"
+    )
+
+    def _emits(self, ctx: FileContext
+               ) -> Iterable[Tuple[ast.Call, str]]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _INSTRUMENT_METHODS
+                    and node.args):
+                continue
+            a0 = node.args[0]
+            if (isinstance(a0, ast.Constant)
+                    and isinstance(a0.value, str)
+                    and a0.value.startswith("shellac_")):
+                yield node, a0.value
+
+    def check_project(self, ctxs: Sequence[FileContext]
+                      ) -> Iterable[Finding]:
+        obs_present = any(_in_obs(c) for c in ctxs)
+        obs_literals: Set[str] = set()
+        if obs_present:
+            for ctx in ctxs:
+                if not _in_obs(ctx):
+                    continue
+                for node in ast.walk(ctx.tree):
+                    if (isinstance(node, ast.Constant)
+                            and isinstance(node.value, str)
+                            and node.value.startswith("shellac_")):
+                        obs_literals.add(node.value)
+        doc = _find_doc(ctxs)
+        for ctx in ctxs:
+            if ctx.is_test:
+                continue
+            for node, name in self._emits(ctx):
+                if (obs_present and not _in_obs(ctx)
+                        and name not in obs_literals):
+                    yield self.finding(
+                        ctx, node,
+                        f"metric {name!r} is registered outside obs/ "
+                        "and declared in no obs module — the bundle "
+                        "layer owns the shellac_* namespace; move the "
+                        "registration (or mirror the name) into an "
+                        "obs bundle",
+                    )
+                if doc is not None and name not in doc:
+                    yield self.finding(
+                        ctx, node,
+                        f"metric {name!r} is not cataloged in "
+                        "docs/observability.md — add it to the metric "
+                        "catalog so the operator docs track the code",
+                    )
+
+
+# ---------------------------------------------------------------------
+# SH016 — flight-recorder event-kind drift
+# ---------------------------------------------------------------------
+
+
+@register
+class EventCatalogDrift(ProjectRule):
+    code = "SH016"
+    name = "event-catalog-drift"
+    summary = (
+        "a flight-recorder event kind recorded in code does not appear "
+        "in docs/observability.md's event catalog — /debug timelines "
+        "would carry events the runbook never names"
+    )
+
+    def _kinds(self, ctx: FileContext
+               ) -> Iterable[Tuple[ast.Call, str]]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "record"
+                    and len(node.args) >= 2):
+                continue
+            a1 = node.args[1]
+            if (isinstance(a1, ast.Constant)
+                    and isinstance(a1.value, str)
+                    and _KIND_RE.match(a1.value)):
+                yield node, a1.value
+
+    def check_project(self, ctxs: Sequence[FileContext]
+                      ) -> Iterable[Finding]:
+        doc = _find_doc(ctxs)
+        if doc is None:
+            return
+        for ctx in ctxs:
+            if ctx.is_test:
+                continue
+            for node, kind in self._kinds(ctx):
+                if f"`{kind}`" not in doc:
+                    yield self.finding(
+                        ctx, node,
+                        f"recorder event kind {kind!r} is not in "
+                        "docs/observability.md's event catalog — add "
+                        "a catalog row (event, src, recorded-at, "
+                        "fields) so timelines stay self-describing",
+                    )

@@ -153,9 +153,13 @@ def register(cls: type) -> type:
 
 
 def all_rules() -> Dict[str, type]:
-    # Importing rules registers them; deferred so engine stays cheap to
-    # import and free of cycles.
-    from shellac_tpu.analysis import rules  # noqa: F401
+    # Importing the rule modules registers them; deferred so engine
+    # stays cheap to import and free of cycles.
+    from shellac_tpu.analysis import (  # noqa: F401
+        concurrency,
+        contracts,
+        rules,
+    )
 
     return dict(sorted(_REGISTRY.items()))
 

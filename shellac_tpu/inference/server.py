@@ -914,7 +914,13 @@ class InferenceServer:
                         and getattr(self._engine_factory, "__self__",
                                     None) is g.engine)
             if wedged and in_place:
-                self._fatal = (
+                # Supervisor state (_fatal/_recovering/restarts/_g) is
+                # written under self._lock but read lock-free by the
+                # /health and status() snapshot paths — single
+                # reference/int swaps, "possibly stale, never torn"
+                # (see health()). Annotated rather than locked so the
+                # readiness probe never queues behind a recovery.
+                self._fatal = (  # shellac: ignore[SH010]
                     f"{msg} [in-place resync cannot recover a wedged "
                     "step: the stuck thread still owns the engine — "
                     "restart the pod]"
@@ -944,8 +950,10 @@ class InferenceServer:
                                      "restarts": self.restarts})
                     self._fatal = msg
                 else:
-                    self._recovering = True
-                    self.restarts += 1
+                    # Lock-free snapshot readers by design — see the
+                    # wedge-fatal arm above.
+                    self._recovering = True  # shellac: ignore[SH010]
+                    self.restarts += 1  # shellac: ignore[SH010]
                     self._m.restarts.inc()
                     incident = (
                         "wedge-rebuild" if wedged
@@ -1014,7 +1022,10 @@ class InferenceServer:
             if self._closed.is_set():
                 self._fatal = "server closed during recovery"
                 return
-            self._g = self._start_generation(g.gen + 1, engine)
+            # One reference swap; the engine/_thread properties read it
+            # lock-free so every reader sees the live generation
+            # without queueing behind recovery.
+            self._g = self._start_generation(g.gen + 1, engine)  # shellac: ignore[SH010]
             self._g.thread.start()
 
     def _watchdog(self) -> None:
@@ -1082,7 +1093,10 @@ class InferenceServer:
         # A shed prefill_only request never reaches the export path:
         # drop its migration target too.
         self._migrate_targets.pop(rid, None)
-        self.shed += 1
+        # Single-writer: both shed paths run on the scheduler thread,
+        # so the bare increment cannot lose updates; /health reads it
+        # lock-free ("possibly stale, never torn").
+        self.shed += 1  # shellac: ignore[SH010]
         if p.trace is not None:
             p.trace.shed()
         p.error = ("request shed: deadline expired before prefill "
@@ -1557,7 +1571,11 @@ class InferenceServer:
             rid = next(self._ids)
             holdback = max((len(s) for s in stop), default=0) if stop else 0
             if deadline is not None:
-                self._saw_deadline = True
+                # Monotonic False->True gate; the scheduler reads it
+                # lock-free in _shed_expired as a fast-path skip, and
+                # a stale False only delays the first shed sweep one
+                # loop iteration.
+                self._saw_deadline = True  # shellac: ignore[SH010]
             p = _Pending(rid, stream=stream, holdback=holdback,
                          deadline=deadline, trace=trace)
             self._pending[rid] = p

@@ -87,6 +87,10 @@ from shellac_tpu.obs.trace import (
     ServeMetrics,
     TierMetrics,
 )
+from shellac_tpu.obs.train import (
+    ResilienceMetrics,
+    train_interval_histogram,
+)
 
 __all__ = [
     "FlightRecorder",
@@ -108,6 +112,8 @@ __all__ = [
     "RequestTrace",
     "ServeMetrics",
     "TierMetrics",
+    "ResilienceMetrics",
+    "train_interval_histogram",
     "STEP_PHASES",
     "ParsedMetrics",
     "parse_prometheus_text",

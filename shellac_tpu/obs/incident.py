@@ -270,8 +270,8 @@ class IncidentManager:
             # rate-limiter drops (so an unwritable incident dir reads
             # as a 500-class failure, not backpressure) and noted in
             # the recorder, which at least survives in the spool.
-            self.write_errors += 1
             with self._lock:
+                self.write_errors += 1
                 self._limiter.refund()
             if self._c_write_errors is not None:
                 self._c_write_errors.labels(trigger=trigger).inc()

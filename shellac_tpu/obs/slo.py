@@ -320,7 +320,7 @@ class SLOEngine:
         cumulative since replica/tier start (the engine differences
         them per window)."""
         now = time.monotonic() if now is None else now
-        fired: List[Tuple[SLOSpec, str, str, Dict[str, object]]] = []
+        fired: List[Tuple[_Track, str, str, Dict[str, float]]] = []
         with self._lock:
             for name, track in self._tracks.items():
                 good, total = counts.get(name, track.last_counts)
@@ -343,16 +343,38 @@ class SLOEngine:
                 new_state = self._classify(burns)
                 if new_state != track.state:
                     old = track.state
-                    self._transition(track, new_state, burns, now)
-                    fired.append((track.spec, old, new_state,
-                                  dict(track.last_transition)))
-        # Hooks fire AFTER the engine lock drops: a hook that reads
-        # back through status()/state() (the tier's incident trigger
-        # does, via its bundle sections) must not deadlock the tick.
-        if self._on_transition is not None:
-            for spec, old, new_state, transition in fired:
+                    self._transition(track, new_state, burns)
+                    fired.append((track, old, new_state, burns))
+        # Everything that leaves the engine fires AFTER the lock
+        # drops: the exemplar callback walks histogram and recorder
+        # internals (their own locks), and a transition hook that
+        # reads back through status()/state() (the tier's incident
+        # trigger does, via its bundle sections) must not deadlock
+        # the tick.
+        for track, old, new_state, burns in fired:
+            exemplar = None
+            if new_state != "ok" and self._exemplar_fn is not None:
                 try:
-                    self._on_transition(spec, old, new_state,
+                    exemplar = self._exemplar_fn(track.spec)
+                except Exception:  # noqa: BLE001 — an exemplar lookup
+                    exemplar = None  # must never break alerting
+            with self._lock:
+                track.last_transition["exemplar"] = exemplar
+                transition = dict(track.last_transition)
+            if self._recorder is not None:
+                # The transition event is system-scoped (trace=None):
+                # the EXEMPLAR field carries the violating request's
+                # trace id, which /debug/request/<id> resolves to its
+                # timeline.
+                self._recorder.record(
+                    None, "slo-transition", src="tier",
+                    slo=track.spec.name, **{"from": old}, to=new_state,
+                    burn={k: round(v, 3) for k, v in burns.items()},
+                    exemplar=exemplar,
+                )
+            if self._on_transition is not None:
+                try:
+                    self._on_transition(track.spec, old, new_state,
                                         transition)
                 except Exception:  # noqa: BLE001 — hooks must never
                     pass           # break alerting
@@ -367,21 +389,23 @@ class SLOEngine:
         return "ok"
 
     def _transition(self, track: _Track, new_state: str,
-                    burns: Dict[str, float], now: float) -> None:
+                    burns: Dict[str, float]) -> None:
+        """Commit a state change (caller holds the engine lock).
+
+        Only lock-safe work happens here: the exemplar lookup, the
+        recorder event, and the user hook are all deferred to `tick`'s
+        post-lock loop, because each re-enters code with locks of its
+        own. `tick` patches the exemplar into `last_transition` once
+        it resolves.
+        """
         old = track.state
         track.state = new_state
-        exemplar = None
-        if new_state != "ok" and self._exemplar_fn is not None:
-            try:
-                exemplar = self._exemplar_fn(track.spec)
-            except Exception:  # noqa: BLE001 — an exemplar lookup
-                exemplar = None  # must never break alerting
         track.last_transition = {
             "at": time.time(),
             "from": old,
             "to": new_state,
             "burn": {k: round(v, 3) for k, v in burns.items()},
-            "exemplar": exemplar,
+            "exemplar": None,
         }
         if self._g_state is not None:
             self._g_state.labels(slo=track.spec.name).set(
@@ -390,16 +414,6 @@ class SLOEngine:
         if self._c_transitions is not None:
             self._c_transitions.labels(slo=track.spec.name,
                                        to=new_state).inc()
-        if self._recorder is not None:
-            # The transition event is system-scoped (trace=None): the
-            # EXEMPLAR field carries the violating request's trace id,
-            # which /debug/request/<id> resolves to its timeline.
-            self._recorder.record(
-                None, "slo-transition", src="tier",
-                slo=track.spec.name, **{"from": old}, to=new_state,
-                burn={k: round(v, 3) for k, v in burns.items()},
-                exemplar=exemplar,
-            )
 
     # ---- reads -------------------------------------------------------
 

@@ -1,0 +1,58 @@
+"""The `shellac_train_*` metric bundles, owned by the obs layer.
+
+The bundle layer owns the `shellac_*` namespace (SH015 enforces this):
+every metric family the training stack emits is declared here, next to
+the serving bundles in `trace.py`, so `docs/observability.md` and the
+code share one source of truth. `training.resilience` re-exports
+`ResilienceMetrics` for its existing callers; both register idempotently
+against the shared registry.
+"""
+
+from __future__ import annotations
+
+from shellac_tpu.obs.metrics import get_registry, log_buckets
+
+
+class ResilienceMetrics:
+    """The `shellac_train_*` resilience series, registered once
+    (idempotently) against the shared registry so the fit loop, the
+    checkpointer, and tests all deposit into the same instruments."""
+
+    def __init__(self, registry=None):
+        reg = registry if registry is not None else get_registry()
+        self.anomalies = reg.counter(
+            "shellac_train_anomalies_total",
+            "Training anomalies by kind and resolved action",
+            labels=("kind", "action"),
+        )
+        self.rollbacks = reg.counter(
+            "shellac_train_rollbacks_total",
+            "Checkpoint rollbacks performed by the training loop",
+        )
+        self.quarantined = reg.counter(
+            "shellac_train_ckpt_quarantined_total",
+            "Checkpoint steps renamed *.corrupt after failing "
+            "verification or restore",
+        )
+        self.fallback_restores = reg.counter(
+            "shellac_train_ckpt_fallback_restores_total",
+            "Restores that had to walk past the newest step to an "
+            "older intact one",
+        )
+        self.last_good_step = reg.gauge(
+            "shellac_train_last_good_step",
+            "Newest checkpoint step believed intact (set on save and "
+            "on every restore)",
+        )
+
+
+def train_interval_histogram(registry=None):
+    """Step-interval wall-time distribution in the shared registry, so
+    training pace is scrapable alongside serving latency (one series
+    per process; registration is idempotent)."""
+    reg = registry if registry is not None else get_registry()
+    return reg.histogram(
+        "shellac_train_log_interval_seconds",
+        "Wall time between metric log boundaries (log_every steps)",
+        buckets=log_buckets(0.001, 600.0),
+    )

@@ -27,7 +27,7 @@ from typing import Callable, Iterator, Optional
 import jax
 
 from shellac_tpu.config import ModelConfig, TrainConfig
-from shellac_tpu.obs import get_registry, log_buckets
+from shellac_tpu.obs.train import train_interval_histogram
 from shellac_tpu.training.resilience import ACTIONS, AnomalySentinel
 from shellac_tpu.training.trainer import init_train_state, make_train_step
 from shellac_tpu.utils.failure import Heartbeat, RestartBudget
@@ -35,15 +35,9 @@ from shellac_tpu.utils.metrics import MetricsLogger
 from shellac_tpu.utils.tracing import StepTimer
 
 
-def _interval_histogram():
-    """Step-interval wall-time distribution in the shared registry, so
-    training pace is scrapable alongside serving latency (one series
-    per process; registration is idempotent)."""
-    return get_registry().histogram(
-        "shellac_train_log_interval_seconds",
-        "Wall time between metric log boundaries (log_every steps)",
-        buckets=log_buckets(0.001, 600.0),
-    )
+# Declared in the obs bundle layer (obs/train.py), which owns the
+# shellac_* namespace; aliased here for the two fit loops below.
+_interval_histogram = train_interval_histogram
 
 
 def fit(

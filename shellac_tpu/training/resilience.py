@@ -44,7 +44,7 @@ import dataclasses
 import math
 from typing import Optional, Tuple
 
-from shellac_tpu.obs import get_registry
+from shellac_tpu.obs.train import ResilienceMetrics  # noqa: F401 — re-export
 from shellac_tpu.utils.failure import RestartBudget
 
 ACTIONS = ("warn", "skip", "rollback", "fatal")
@@ -61,39 +61,6 @@ class Anomaly:
 
     def __str__(self) -> str:
         return f"{self.kind} at step {self.step} ({self.detail})"
-
-
-class ResilienceMetrics:
-    """The `shellac_train_*` resilience series, registered once
-    (idempotently) against the shared registry so the fit loop, the
-    checkpointer, and tests all deposit into the same instruments."""
-
-    def __init__(self, registry=None):
-        reg = registry if registry is not None else get_registry()
-        self.anomalies = reg.counter(
-            "shellac_train_anomalies_total",
-            "Training anomalies by kind and resolved action",
-            labels=("kind", "action"),
-        )
-        self.rollbacks = reg.counter(
-            "shellac_train_rollbacks_total",
-            "Checkpoint rollbacks performed by the training loop",
-        )
-        self.quarantined = reg.counter(
-            "shellac_train_ckpt_quarantined_total",
-            "Checkpoint steps renamed *.corrupt after failing "
-            "verification or restore",
-        )
-        self.fallback_restores = reg.counter(
-            "shellac_train_ckpt_fallback_restores_total",
-            "Restores that had to walk past the newest step to an "
-            "older intact one",
-        )
-        self.last_good_step = reg.gauge(
-            "shellac_train_last_good_step",
-            "Newest checkpoint step believed intact (set on save and "
-            "on every restore)",
-        )
 
 
 def _nonfinite(x: float) -> bool:

@@ -23,6 +23,8 @@ except AttributeError:
         + " --xla_force_host_platform_device_count=8"
     )
 
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -52,6 +54,35 @@ def pytest_runtest_setup(item):
     if _last_module[0] is not None and name != _last_module[0]:
         jax.clear_caches()
     _last_module[0] = name
+
+
+@pytest.fixture(autouse=True)
+def no_thread_leaks():
+    """Fail any test that leaks a non-daemon thread (SH014's runtime
+    twin): a scheduler/poller/push-worker thread that outlives its test
+    would hang the interpreter at exit and, in a full serial run, bleed
+    state into every later test. Daemon threads are exempt — they are
+    the explicitly fire-and-forget class — as are threads that predate
+    the test (pytest plugins, jax's internals)."""
+    before = set(threading.enumerate())
+    yield
+    leaked = [
+        t for t in threading.enumerate()
+        if t not in before and not t.daemon and t.is_alive()
+    ]
+    if not leaked:
+        return
+    # Close paths signal first and join second; give a shutting-down
+    # thread one grace period before calling it a leak.
+    deadline = 5.0 / max(1, len(leaked))
+    for t in leaked:
+        t.join(timeout=deadline)
+    leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, (
+        "test leaked non-daemon thread(s): "
+        + ", ".join(sorted(t.name for t in leaked))
+        + " — join them on the owning object's close() path"
+    )
 
 
 @pytest.fixture(scope="session")
