@@ -437,6 +437,103 @@ def prefix_bench(cfg, params, *, n_slots, ctx, max_len, rng):
     return out
 
 
+def fabric_churn(cfg, params, *, n_slots, ctx, max_len, rng, fabric,
+                 prefill_token_s, n_prefix=4, sessions=3, tail=16,
+                 gen_budget=8, registry=None):
+    """Shared-prefix churn onto a COLD replica: fabric seeding on/off.
+
+    The fleet-fabric scenario the KV directory + hot-prefix push exist
+    for: a replica joins (or respawns) mid-load while the fleet is
+    serving sessions over a few hot shared prefixes. n_prefix hot
+    ~ctx-token prefixes x `sessions` waves of requests with distinct
+    short tails drain through a freshly built engine. With fabric on,
+    the hot chains are seeded from a warm peer before the drain (the
+    bench calls export_chain/seed_chain directly — the same functions
+    the /kv/push -> /kv/seed HTTP legs run); with it off the cold
+    engine pays one full prefill per hot prefix before its LOCAL
+    prefix cache takes over. SimulatedHostLatency(prefill_token_s=..)
+    charges each prefill per token it actually computes (prompt minus
+    the backend's prefix-cache offset), so the avoided recompute shows
+    up in wall clock the way it does on hardware. Greedy outputs must
+    be bit-identical on vs off — seeded KV is the same KV.
+
+    Returns {"tokens_s", "drain_s", "hit_tokens", "seeded_blocks",
+    "results"}."""
+    from shellac_tpu.inference import fabric as fabric_mod
+    from shellac_tpu.inference import prefix as prefix_mod
+    from shellac_tpu.inference.autotune import SimulatedHostLatency
+    from shellac_tpu.inference.batching import PagedBatchingEngine
+
+    bs = 64
+    if ctx % bs:
+        raise SystemExit(f"fabric_churn: --ctx must be a multiple of "
+                         f"the {bs}-token block size")
+
+    def mk():
+        return PagedBatchingEngine(
+            cfg, params, n_slots=n_slots, max_len=max_len,
+            block_size=bs, pool_tokens=2 * n_slots * max_len,
+            temperature=0.0, prefix_cache=True, registry=registry,
+        )
+
+    # All randomness is drawn up front so the on/off arms (fresh rng,
+    # same seed) see byte-identical requests.
+    prefixes = [rng.integers(0, cfg.vocab_size, size=ctx, dtype=np.int64)
+                for _ in range(n_prefix)]
+    waves = []
+    for s in range(sessions):
+        wave = []
+        for p in range(n_prefix):
+            t = rng.integers(0, cfg.vocab_size, size=tail, dtype=np.int64)
+            wave.append(((p, s), np.concatenate([prefixes[p], t]),
+                         gen_budget))
+        waves.append(wave)
+    warm_prefix = rng.integers(0, cfg.vocab_size, size=ctx, dtype=np.int64)
+    warm_tail = rng.integers(0, cfg.vocab_size, size=tail, dtype=np.int64)
+
+    cold = mk()
+    # Warm the compile caches outside the timed region with a DISJOINT
+    # prefix — twice, so the prefix-hit continuation program (tail-only
+    # prefill) compiles here too. Identical treatment on both arms.
+    warm_prompt = np.concatenate([warm_prefix, warm_tail])
+    cold.run([("warm", warm_prompt, 2)])
+    cold.run([("warm2", warm_prompt, 2)])
+    warm_hits = cold.stats.get("prefix_hit_tokens", 0)
+
+    if fabric:
+        # A warm peer that already served the hot prefixes; ship each
+        # chain with the function-level halves of /kv/push -> /kv/seed.
+        warm_eng = mk()
+        warm_eng.run([(("seed", p), prefixes[p], 2)
+                      for p in range(n_prefix)])
+        for p in range(n_prefix):
+            tip = prefix_mod.chain_hashes(prefixes[p], bs)[-1]
+            blob = fabric_mod.export_chain(warm_eng, tip)
+            fabric_mod.seed_chain(cold, blob)
+
+    shim = SimulatedHostLatency(cold, prefill_token_s=prefill_token_s)
+    results = {}
+    t0 = time.perf_counter()
+    for wave in waves:
+        for rid, prompt, max_new in wave:
+            cold.submit(rid, prompt, max_new)
+        while cold.pending:
+            for rid, out in cold.step():
+                results[rid] = out
+    dt = time.perf_counter() - t0
+    shim.uninstall()
+    assert len(results) == n_prefix * sessions
+    total = sum(len(v) for v in results.values())
+    return {
+        "tokens_s": total / dt,
+        "drain_s": dt,
+        "hit_tokens": int(cold.stats.get("prefix_hit_tokens", 0)
+                          - warm_hits),
+        "seeded_blocks": int(cold.stats.get("prefix_seeded_blocks", 0)),
+        "results": results,
+    }
+
+
 def beam_bench(cfg, params, *, ctx, max_len, rng, num_beams=4,
                steps=32):
     """Dense row-gather beams vs paged CoW beams on ONE long prompt.
@@ -641,6 +738,25 @@ def gate(cfg, params, args, backend):
         )
     prefill_speedup = mixed[True] / max(mixed[False], 1e-9)
 
+    # Shared-prefix churn onto a cold replica: fabric seeding on vs
+    # off in the SAME invocation. The on-arm honors --no-fabric so CI
+    # can prove this gate row fails when seeding is disabled (the
+    # --decode-ticks 1 / --no-overlap-prefill self-tests' triplet).
+    # Per-token prefill charging makes the avoided recompute a wall-
+    # clock quantity a CPU box reproduces; real tiny-model compute is
+    # the small additive term, same transferability argument as above.
+    fab = {}
+    for on in (True, False):
+        rng = np.random.default_rng(3)
+        fab[on] = fabric_churn(
+            cfg, params, n_slots=args.slots, ctx=args.ctx,
+            max_len=max_len, rng=rng, fabric=on and args.fabric,
+            prefill_token_s=args.fabric_prefill_token_ms / 1e3,
+        )
+    fabric_speedup = (fab[True]["tokens_s"]
+                      / max(fab[False]["tokens_s"], 1e-9))
+    fabric_identical = fab[True]["results"] == fab[False]["results"]
+
     def _prefill_share(digest):
         """prefill_dispatch + prefill_settle share of the attributed
         step time — the admission-side cost the pipeline exists to
@@ -661,6 +777,12 @@ def gate(cfg, params, args, backend):
             _prefill_share(phase_digests["mixed_prefill"]), 3),
         "prefill_share_serial": round(
             _prefill_share(phase_digests["mixed_prefill_serial"]), 3),
+        "fabric_tokens_s": round(fab[True]["tokens_s"], 1),
+        "fabric_off_tokens_s": round(fab[False]["tokens_s"], 1),
+        "fabric_speedup": round(fabric_speedup, 3),
+        "fabric_hit_tokens": fab[True]["hit_tokens"],
+        "fabric_seeded_blocks": fab[True]["seeded_blocks"],
+        "fabric_outputs_identical": fabric_identical,
         "decode_ticks": ticks,
         "autotune": tuned,
         "step_phases": phase_digests,
@@ -669,6 +791,7 @@ def gate(cfg, params, args, backend):
             "device_latency_ms": args.device_latency_ms,
             "host_latency_ms": args.host_latency_ms,
             "prefill_latency_ms": args.prefill_latency_ms,
+            "fabric_prefill_token_ms": args.fabric_prefill_token_ms,
         },
     }
 
@@ -679,6 +802,8 @@ def gate(cfg, params, args, backend):
             "spec_paged_tokens_s": summary["spec_paged_tokens_s"],
             "mixed_prefill_tokens_s": summary["mixed_prefill_tokens_s"],
             "prefill_overlap_speedup_floor": 1.3,
+            "fabric_tokens_s": summary["fabric_tokens_s"],
+            "fabric_speedup_floor": 1.3,
             "tolerance": 0.15,
             "params": summary["params"],
         }
@@ -745,6 +870,30 @@ def gate(cfg, params, args, backend):
                 f"overlap ({summary['prefill_share_overlap']} >= "
                 f"{summary['prefill_share_serial']})"
             )
+    fab_base = baseline.get("fabric_tokens_s")
+    if fab_base is not None:
+        ffloor = float(baseline.get("fabric_speedup_floor", 1.3))
+        if fab[True]["tokens_s"] < fab_base * (1.0 - tol):
+            failures.append(
+                f"fabric cold-replica churn tokens/s "
+                f"{fab[True]['tokens_s']:.1f} < "
+                f"{fab_base * (1.0 - tol):.1f} "
+                f"(baseline {fab_base} - {tol:.0%})"
+            )
+        if fabric_speedup < ffloor:
+            failures.append(
+                f"fabric seeding speedup {fabric_speedup:.2f}x < "
+                f"required {ffloor}x"
+            )
+        if not fabric_identical:
+            failures.append(
+                "fabric on/off greedy outputs diverged — seeded KV "
+                "changed the math"
+            )
+        if args.fabric and not fab[True]["seeded_blocks"]:
+            failures.append("fabric on-arm seeded 0 blocks")
+        if args.fabric and fab[True]["hit_tokens"] <= 0:
+            failures.append("fabric on-arm saw no prefix hit tokens")
     summary["gate"] = "fail" if failures else "pass"
     if failures:
         summary["failures"] = failures
@@ -769,7 +918,8 @@ def main():
                          "'auto', its default, to run the startup "
                          "sweep)")
     ap.add_argument("--mode", default="engine",
-                    choices=["engine", "kernel", "prefix", "beam"])
+                    choices=["engine", "kernel", "prefix", "beam",
+                             "fabric"])
     ap.add_argument("--overlap", action="store_true",
                     help="engine mode: overlapped window dispatch")
     ap.add_argument("--device-latency-ms", type=float, default=0.0,
@@ -792,6 +942,19 @@ def main():
                          "(--no-overlap-prefill pins it off — the CI "
                          "self-test proving the prefill gate rows can "
                          "fail)")
+    ap.add_argument("--fabric", dest="fabric",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="gate/fabric mode: seed the cold replica's "
+                         "prefix chains from a warm peer before the "
+                         "shared-prefix drain (--no-fabric pins "
+                         "seeding off — the CI self-test proving the "
+                         "fabric gate row can fail)")
+    ap.add_argument("--fabric-prefill-token-ms", type=float,
+                    default=0.0, dest="fabric_prefill_token_ms",
+                    help="simulated per-COMPUTED-prefill-token cost "
+                         "for the fabric rows (gate default 4; prefix "
+                         "hits skip their tokens, so avoided recompute "
+                         "becomes wall clock)")
     ap.add_argument("--gate", action="store_true",
                     help="CI perf regression gate: overlapped churn "
                          "under the simulated-latency harness vs the "
@@ -875,6 +1038,13 @@ def main():
             args.device_latency_ms = 400.0
         if not args.host_latency_ms:
             args.host_latency_ms = 250.0
+        if not args.fabric_prefill_token_ms:
+            # Per-token so the ratio tracks tokens AVOIDED, not a
+            # fixed per-flight cost both arms pay equally. 4 ms/token
+            # x 64-token prefix dwarfs real tiny-model prefill
+            # compute, same transferability argument as the fixed
+            # latencies above.
+            args.fabric_prefill_token_ms = 4.0
         if not args.prefill_latency_ms:
             # Large against real tiny-model prefill compute, but at
             # most the hiding capacity of one step boundary (the host
@@ -922,6 +1092,35 @@ def main():
                 "drain_s_off": round(dt_off, 3),
                 "drain_s_on": round(dt_on, 3),
                 "prefix_hit_tokens": int(hits),
+            },
+        }), flush=True)
+        return
+
+    if args.mode == "fabric":
+        fab = {}
+        for on in (True, False):
+            rng = np.random.default_rng(3)
+            fab[on] = fabric_churn(
+                cfg, params, n_slots=args.slots, ctx=args.ctx,
+                max_len=max_len, rng=rng, fabric=on and args.fabric,
+                prefill_token_s=args.fabric_prefill_token_ms / 1e3,
+            )
+        assert fab[True]["results"] == fab[False]["results"], \
+            "fabric on/off greedy outputs diverged"
+        print(json.dumps({
+            "metric": f"fabric_cold_replica_{args.model}_ctx{args.ctx}_"
+                      f"{backend}",
+            "value": round(fab[True]["tokens_s"]
+                           / max(fab[False]["tokens_s"], 1e-9), 3),
+            "unit": "x speedup (cold-replica shared-prefix drain, "
+                    "seeded/unseeded)",
+            "detail": {
+                "tokens_s_seeded": round(fab[True]["tokens_s"], 1),
+                "tokens_s_cold": round(fab[False]["tokens_s"], 1),
+                "seeded_blocks": fab[True]["seeded_blocks"],
+                "hit_tokens_seeded": fab[True]["hit_tokens"],
+                "hit_tokens_cold": fab[False]["hit_tokens"],
+                "prefill_token_ms": args.fabric_prefill_token_ms,
             },
         }), flush=True)
         return

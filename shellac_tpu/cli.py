@@ -1103,6 +1103,8 @@ def cmd_serve(args):
         incident_window=args.incident_window,
         incident_retention=args.incident_retention,
         incident_capture_seconds=args.incident_capture_seconds,
+        park_dir=args.park_dir,
+        park_max_bytes=args.park_max_bytes,
     )
     return 0
 
@@ -1165,6 +1167,9 @@ def cmd_serve_tier(args):
         disagg=args.disagg,
         kv_bandwidth=args.kv_bandwidth,
         disagg_min_prompt=args.disagg_min_prompt,
+        fabric=args.fabric,
+        fabric_hot_hits=args.fabric_hot_hits,
+        fabric_max_push=args.fabric_max_push,
         spool_dir=args.spool_dir,
         spool_max_bytes=args.spool_max_bytes,
         incident_dir=args.incident_dir,
@@ -1658,6 +1663,20 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="spool_max_bytes",
                    help="on-disk footprint cap for the event spool "
                         "(active + one rotated file; default 8 MiB)")
+    s.add_argument("--park-dir", default=None, dest="park_dir",
+                   help="KV park spool: {\"prefill_only\": true, "
+                        "\"park\": true} requests export their frozen "
+                        "slot as a crc-checked SHLKV1 blob here "
+                        "(atomic writes, size-capped LRU), and any "
+                        "replica that mounts the same directory can "
+                        "{\"resume\": <park_id>} the session — so a "
+                        "parked session survives this replica's death "
+                        "(unset = park/resume answer 400)")
+    s.add_argument("--park-max-bytes", type=int, default=256 << 20,
+                   dest="park_max_bytes",
+                   help="on-disk footprint cap for the park spool "
+                        "(oldest-parked blobs trimmed first; default "
+                        "256 MiB)")
     s.add_argument("--incident-dir", default=None, dest="incident_dir",
                    help="incident black box: supervisor wedge/rebuild, "
                         "restart-budget exhaustion, and POST "
@@ -1825,6 +1844,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="prompts estimated shorter than this many "
                          "tokens always serve monolithically (their "
                          "prefill is cheaper than any migration)")
+    st.add_argument("--fabric", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="fleet-wide KV fabric: poll each replica's "
+                         "GET /kv/prefixes into a prefix directory, "
+                         "route by directory-measured chain overlap "
+                         "(a measured hit replaces the discounted "
+                         "affinity guess), and proactively replicate "
+                         "hot prefix chains to replicas that lack "
+                         "them (docs/serving_tier.md#kv-fabric)")
+    st.add_argument("--fabric-hot-hits", type=int, default=4,
+                    dest="fabric_hot_hits",
+                    help="fleet-wide hit count above which a prefix "
+                         "chain is hot enough to replicate")
+    st.add_argument("--fabric-max-push", type=int, default=2,
+                    dest="fabric_max_push",
+                    help="replication pushes ordered per health sweep "
+                         "(0 keeps the directory routing but never "
+                         "pushes)")
     st.add_argument("--spool-dir", default=None, dest="spool_dir",
                     help="durable event spool for the tier's attempt "
                          "log (rotating size-capped JSONL; the "

@@ -92,14 +92,22 @@ class SimulatedHostLatency:
     """
 
     def __init__(self, engine, *, device_s: float = 0.0,
-                 dispatch_s: float = 0.0, prefill_s: float = 0.0):
+                 dispatch_s: float = 0.0, prefill_s: float = 0.0,
+                 prefill_token_s: float = 0.0):
         self.engine = engine
         self.device_s = float(device_s)
         self.dispatch_s = float(dispatch_s)
         self.prefill_s = float(prefill_s)
+        # Per-TOKEN prefill cost on top of the fixed per-flight
+        # prefill_s, charged only for tokens the prefill actually
+        # computes (prompt length minus the backend's prefix-cache
+        # offset) — the knob that lets a CPU bench show prefix-cache
+        # and fabric-seed savings as wall-clock, the way a real device
+        # would.
+        self.prefill_token_s = float(prefill_token_s)
         self._ready: Dict[int, float] = {}
         engine._window_hooks = self
-        if self.prefill_s:
+        if self.prefill_s or self.prefill_token_s:
             engine._prefill_hooks = self
 
     def on_dispatch(self, window) -> None:
@@ -117,7 +125,16 @@ class SimulatedHostLatency:
     def on_prefill_dispatch(self, flight) -> None:
         if self.dispatch_s:
             time.sleep(self.dispatch_s)
-        self._ready[id(flight)] = time.monotonic() + self.prefill_s
+        cost = self.prefill_s
+        if self.prefill_token_s:
+            computed = flight.req.tokens.size
+            try:
+                computed -= self.engine.cache_backend.prefill_offset(
+                    flight.slot)
+            except Exception:  # noqa: BLE001 — backends without the
+                pass          # hook charge the full prompt
+            cost += self.prefill_token_s * max(0, computed)
+        self._ready[id(flight)] = time.monotonic() + cost
 
     def before_prefill_sync(self, flights) -> None:
         # The batched settle becomes available when the LAST of its

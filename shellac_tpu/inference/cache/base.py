@@ -129,6 +129,13 @@ class CacheBackend:
         construction (paged prefix caching adds its hit counters)."""
         return {}
 
+    def prefix_manifest(self, since: int = -1, **_: Any) -> Dict[str, Any]:
+        """Directory feed for GET /kv/prefixes. Backends without a
+        prefix-cache registry answer {"supported": false} — an honest
+        refusal the tier's directory treats as "never route here for
+        cache contents", never an error."""
+        return {"supported": False}
+
     # ---- accounting --------------------------------------------------
 
     def utilization(self) -> float:

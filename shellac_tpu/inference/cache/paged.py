@@ -23,7 +23,7 @@ covers both and nothing here changes.
 
 from __future__ import annotations
 
-import hashlib
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from shellac_tpu.config import ModelConfig
+from shellac_tpu.inference import prefix as prefix_mod
 from shellac_tpu.inference.cache.base import CacheBackend, PoolExhausted
 from shellac_tpu.inference.cache.layout import (
     init_cache_for,
@@ -82,8 +83,21 @@ class PagedBackend(CacheBackend):
         self._block_ref: Dict[int, int] = {}
         self._slot_prefix_len: List[int] = [0] * n_slots
         # Registrations deferred until the slot's prefill completes
-        # (the blocks hold garbage until then): slot -> [(idx, hash)].
+        # (the blocks hold garbage until then):
+        # slot -> [(idx, hash, parent_hash)].
         self._pending_reg: Dict[int, List] = {}
+        # Fabric/directory state (host-side, prefix_cache only): chain
+        # links child -> parent (b"" roots a chain), per-hash chain
+        # depth and last-touch stamps, per-hash attach hit counters
+        # keyed by the LAST MATCHED hash of each attach (for the
+        # shared-system-prompt shape that is exactly the hot shared
+        # prefix's tip), and a monotonic version the /kv/prefixes
+        # delta-poll compares against.
+        self._hash_parent: Dict[bytes, bytes] = {}
+        self._hash_depth: Dict[bytes, int] = {}
+        self._hash_touch: Dict[bytes, float] = {}
+        self._prefix_hits: Dict[bytes, int] = {}
+        self._prefix_version = 0
 
     # ---- device cache construction ----------------------------------
 
@@ -113,6 +127,7 @@ class PagedBackend(CacheBackend):
             "prefix_hit_tokens": 0,
             "prefix_query_tokens": 0,
             "prefix_evictions": 0,
+            "prefix_seeded_blocks": 0,
         }
 
     def evictable(self) -> int:
@@ -127,9 +142,23 @@ class PagedBackend(CacheBackend):
             if self._block_ref[blk] == 0:
                 del self._hash_to_block[h]
                 del self._block_ref[blk]
+                self._prune_hash(h)
                 self.engine.stats["prefix_evictions"] += 1
                 return blk
         raise RuntimeError("alloc_block called with no capacity")
+
+    def _prune_hash(self, h: bytes) -> None:
+        """Drop fabric sidecar state for an evicted hash. The parent
+        LINK of surviving children is left in place on purpose: a
+        child whose ancestor was evicted is unreachable through
+        _match_prefix (the walk starts at the root), and chain_blocks
+        refuses it loudly — pruning links would instead silently
+        re-root a mid-chain block at the wrong position."""
+        self._hash_parent.pop(h, None)
+        self._hash_depth.pop(h, None)
+        self._hash_touch.pop(h, None)
+        self._prefix_hits.pop(h, None)
+        self._prefix_version += 1
 
     def ensure_blocks(self, slot: int, total_tokens: int) -> bool:
         """Grow slot's table to cover total_tokens; False if pool
@@ -153,19 +182,11 @@ class PagedBackend(CacheBackend):
     # ---- prefix cache ------------------------------------------------
 
     def chain_hashes(self, tokens: np.ndarray) -> List[bytes]:
-        """Position-dependent content hashes of the full token blocks:
-        h_j = H(h_{j-1} || block_j), so a block only matches when its
-        entire prefix matches too (and therefore occupies the same
-        absolute positions — required for RoPE'd cached K)."""
-        bs = self.block_size
-        out: List[bytes] = []
-        h = b""
-        for j in range(tokens.size // bs):
-            h = hashlib.blake2b(
-                h + tokens[j * bs:(j + 1) * bs].tobytes(), digest_size=16
-            ).digest()
-            out.append(h)
-        return out
+        """Position-dependent content hashes of the full token blocks
+        (see shellac_tpu.inference.prefix.chain_hashes — shared with
+        the tier's directory matcher so routing and cache contents key
+        identically by construction)."""
+        return prefix_mod.chain_hashes(tokens, self.block_size)
 
     def _match_prefix(self, tokens: np.ndarray) -> Tuple[List[bytes], int]:
         """Longest cached block chain covering a strict prompt prefix
@@ -190,9 +211,18 @@ class PagedBackend(CacheBackend):
         the attach protocol cannot drift between them."""
         hashes, m = self._match_prefix(tokens)
         matched = [self._hash_to_block[h] for h in hashes[:m]]
+        now = time.time()
         for h, blk in zip(hashes[:m], matched):
             self._block_ref[blk] += 1
             self._hash_to_block.move_to_end(h)  # LRU touch
+            self._hash_touch[h] = now
+        if m:
+            # Hit counters key on the last matched hash: under the
+            # shared-system-prompt shape that is the tip of the shared
+            # prefix, which is exactly the chain replication ships.
+            tip = hashes[m - 1]
+            self._prefix_hits[tip] = self._prefix_hits.get(tip, 0) + 1
+            self._prefix_version += 1
         return hashes, matched
 
     def detach_prefix(self, matched) -> None:
@@ -234,7 +264,7 @@ class PagedBackend(CacheBackend):
         # concurrent same-prefix admission attend over unwritten KV.
         # Stash the registrations; on_prefill_complete flushes them.
         self._pending_reg[slot] = [
-            (j, hashes[j])
+            (j, hashes[j], hashes[j - 1] if j else b"")
             for j in range(m, req.tokens.size // self.block_size)
         ]
         self._slot_prefix_len[slot] = m * self.block_size
@@ -243,12 +273,20 @@ class PagedBackend(CacheBackend):
 
     def on_prefill_complete(self, slot: int) -> None:
         # The prompt blocks now hold real KV: make them matchable.
-        for j, h in self._pending_reg.pop(slot, ()):
+        registered = False
+        now = time.time()
+        for j, h, parent in self._pending_reg.pop(slot, ()):
             if h in self._hash_to_block:
                 continue  # identical chain cached by an earlier finisher
             blk = self._slot_blocks[slot][j]
             self._hash_to_block[h] = blk
             self._block_ref[blk] = 1
+            self._hash_parent[h] = parent
+            self._hash_depth[h] = j + 1
+            self._hash_touch[h] = now
+            registered = True
+        if registered:
+            self._prefix_version += 1
 
     def release_slot(self, slot: int) -> None:
         eng = self.engine
@@ -311,9 +349,107 @@ class PagedBackend(CacheBackend):
         self._hash_to_block.clear()
         self._block_ref.clear()
         self._pending_reg.clear()
+        self._hash_parent.clear()
+        self._hash_depth.clear()
+        self._hash_touch.clear()
+        self._prefix_hits.clear()
+        self._prefix_version += 1
         self._free = list(range(self.n_blocks - 1, 0, -1))
         self._slot_blocks = [[] for _ in range(self.n_slots)]
         self._slot_prefix_len = [0] * self.n_slots
+
+    # ---- fabric: directory manifest + chain export/seed -------------
+
+    def prefix_manifest(self, since: int = -1, *, max_blocks: int = 512,
+                        max_hot: int = 32) -> Dict[str, Any]:
+        """Directory feed for GET /kv/prefixes: the registered block
+        hashes (most-recent-first, capped at max_blocks so the payload
+        stays bounded) plus the hottest matched hashes with
+        depth/hits/age for replication planning. `since` is the
+        version a prior poll returned; when nothing changed the reply
+        collapses to {"unchanged": true}, keeping the health-sweep
+        cadence cheap on an idle fleet. The manifest is possibly stale
+        the instant it is serialized — every consumer treats entries
+        as hints (a stale hit costs one prefix miss, never an
+        error)."""
+        if not self.prefix_cache:
+            return {"supported": False}
+        if since == self._prefix_version:
+            return {"supported": True, "version": self._prefix_version,
+                    "unchanged": True}
+        now = time.time()
+        blocks = [
+            h.hex()
+            for h in list(reversed(self._hash_to_block))[:max_blocks]
+        ]
+        hot = sorted(self._prefix_hits.items(), key=lambda kv: kv[1],
+                     reverse=True)[:max_hot]
+        return {
+            "supported": True,
+            "version": self._prefix_version,
+            "block_size": self.block_size,
+            "blocks": blocks,
+            "blocks_total": len(self._hash_to_block),
+            "hot": [
+                {"h": h.hex(), "hits": n,
+                 "depth": self._hash_depth.get(h, 0),
+                 "age_s": round(now - self._hash_touch.get(h, now), 3)}
+                for h, n in hot if h in self._hash_to_block
+            ],
+        }
+
+    def chain_blocks(self, tip: bytes) -> Tuple[List[bytes], List[int]]:
+        """Root-first (hashes, pool block ids) of the chain ending at
+        `tip`. ValueError when the tip or any ancestor is no longer
+        registered — a chain with an evicted link cannot be exported
+        (the matcher walks from the root, so a torn chain would never
+        be hit; shipping one would seed unreachable blocks)."""
+        chain: List[bytes] = []
+        h = tip
+        while h != b"":
+            if h not in self._hash_to_block:
+                raise ValueError(
+                    f"prefix chain broken at {h.hex()[:12]}…: link "
+                    "evicted from the registry"
+                )
+            chain.append(h)
+            h = self._hash_parent.get(h, b"")
+        chain.reverse()
+        return chain, [self._hash_to_block[h] for h in chain]
+
+    def seed_blocks(self, n: int) -> List[int]:
+        """Phase 1 of seeding KV pushed by a peer: allocate n pool
+        blocks from the FREE LIST only — seeding is speculative, so
+        it never evicts cached blocks, and a full slot's worth of
+        headroom stays free so a seed can never starve the next
+        admission. Raises PoolExhausted (the retryable class) when the
+        pool is too tight."""
+        if n > len(self._free) - self.max_blocks_per_slot:
+            raise PoolExhausted()
+        return [self._free.pop() for _ in range(n)]
+
+    def abort_seed(self, blocks: List[int]) -> None:
+        """Return phase-1 blocks to the free list with the registry
+        untouched (the device write never happened)."""
+        self._free.extend(reversed(blocks))
+
+    def commit_seed(self, entries: List[Tuple[bytes, bytes, int]]) -> None:
+        """Phase 2: the device arrays are written — register
+        (hash, parent_hash, block) rows at refcount 0, i.e.
+        LRU-evictable and never pinned: a seed the local workload
+        never hits simply ages out of the pool."""
+        now = time.time()
+        for h, parent, blk in entries:
+            self._hash_to_block[h] = blk
+            self._block_ref[blk] = 0
+            self._hash_parent[h] = parent
+            self._hash_depth[h] = (
+                self._hash_depth.get(parent, 0) + 1 if parent else 1
+            )
+            self._hash_touch[h] = now
+        if entries:
+            self._prefix_version += 1
+            self.engine.stats["prefix_seeded_blocks"] += len(entries)
 
     # ---- accounting --------------------------------------------------
 

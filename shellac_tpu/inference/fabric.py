@@ -1,0 +1,469 @@
+"""Fleet-wide KV fabric: the per-replica prefix cache, federated.
+
+Three compounding pieces turn PR 12's one-shot KV migration into one
+fleet memory hierarchy:
+
+1. **Prefix directory** (tier-side `PrefixDirectory`): the router
+   learns which replica holds which prefix hash chains from each
+   replica's `GET /kv/prefixes` manifest (delta-polled on the
+   health-sweep cadence, `forget()`-cleared on respawn like
+   `FleetCollector`), and affinity routing scores a candidate by
+   directory-measured chain overlap instead of PR 6's 4×-discounted
+   guess. Tier and engine compute chain hashes with ONE shared helper
+   (`shellac_tpu.inference.prefix`), so routing and cache contents key
+   identically by construction. Every directory entry is a HINT: a
+   stale entry (replica died since the last sweep) costs one prefix
+   miss on the fallback replica, never an error.
+
+2. **Hot-prefix replication** (`export_chain`/`seed_chain` + the
+   tier's push planner): chains hot on one replica but absent on
+   routable peers ship as `SHLKV1` blobs (`kind: "prefix-seed"` — pure
+   KV, no request state) to `POST /kv/seed`, which registers the
+   blocks refcount-0 in the receiver's prefix registry: LRU-evictable,
+   never displacing live slots, allocated from free-list headroom
+   only. Pushes are gated by PR 12's measured cost rule — transfer
+   cost (bytes × measured bandwidth) must beat expected recompute
+   (hit rate × measured `prefill_dispatch` phase cost).
+
+3. **KV park/resume** (`KVParkStore`): `export_slot` of a frozen slot
+   lands in a host-RAM/disk spool with the event spool's durability
+   discipline — atomic tmp+rename write, crc32 verified at read-back
+   (the `SHLKV1` chunk crcs), size-capped LRU — so a parked session
+   survives replica death and resumes on ANY replica that can reach
+   the spool directory.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shellac_tpu.inference import prefix as prefix_mod
+from shellac_tpu.inference.cache import PoolExhausted
+from shellac_tpu.inference.disagg import (
+    MigrationBlob,
+    _check_exportable,
+    model_fingerprint,
+)
+from shellac_tpu.inference.kvcache import kv_field_names
+
+#: Header `kind` distinguishing a prefix-seed blob (pure KV, no
+#: request state) from a slot-migration blob on the same wire format.
+SEED_KIND = "prefix-seed"
+
+
+def _check_fabric_engine(engine) -> None:
+    _check_exportable(engine)
+    backend = engine.cache_backend
+    if not (backend.is_paged and backend.prefix_cache):
+        raise ValueError(
+            "prefix-seed export/import needs a paged backend with "
+            f"prefix_cache=True (this engine runs {backend.name!r} "
+            "without a prefix registry)"
+        )
+
+
+# ---------------------------------------------------------------------
+# Chain export / seed (engine-owning thread on both sides)
+# ---------------------------------------------------------------------
+
+
+def export_chain(engine, tip: bytes,
+                 trace_id: Optional[str] = None) -> MigrationBlob:
+    """Serialize the cached prefix chain ending at `tip` as a
+    prefix-seed blob (caller must be the engine-owning thread).
+    Unlike `export_slot` this ships NO request state — just the chain
+    hashes and their pool blocks, root-first — so the receiver
+    registers pure cache contents. ValueError when the chain has an
+    evicted link (a torn chain would seed unreachable blocks)."""
+    _check_fabric_engine(engine)
+    backend = engine.cache_backend
+    chain, blocks = backend.chain_blocks(tip)
+    header: Dict[str, Any] = {
+        "kind": SEED_KIND,
+        "backend": backend.name,
+        "kv_quant": engine.kv_quant,
+        "model": model_fingerprint(engine),
+        "block_size": backend.block_size,
+        "chain": [h.hex() for h in chain],
+        "trace_id": trace_id,
+    }
+    fields = kv_field_names(engine.kv_quant)
+    cache = engine._cache
+    idx = jnp.asarray(blocks, jnp.int32)
+    pulls = {f: getattr(cache, f)[:, idx] for f in fields}
+    # ONE blocking pull for the whole chain: replication runs on the
+    # admission path's margins, never the decode hot loop.
+    host = jax.device_get(pulls)  # shellac: ignore[SH002] — the seed export's single batched pull; the KV must reach the host to go on the wire
+    return MigrationBlob(header, {f: np.asarray(a)
+                                  for f, a in host.items()})
+
+
+def seed_chain(engine, blob: MigrationBlob) -> int:
+    """Adopt a prefix-seed blob into this engine's prefix registry
+    (caller must be the engine-owning thread). Returns the number of
+    blocks actually seeded (already-registered chain links are
+    skipped). Raises ValueError for a blob this engine must refuse
+    (wrong kind/backend/geometry/block_size — registry untouched) and
+    PoolExhausted when free-list headroom is too tight (retryable;
+    seeding never evicts to make room)."""
+    _check_fabric_engine(engine)
+    backend = engine.cache_backend
+    header = blob.header
+    if header.get("kind") != SEED_KIND:
+        raise ValueError(
+            f"blob kind {header.get('kind')!r} is not a prefix seed"
+        )
+    if header.get("backend") != backend.name:
+        raise ValueError(
+            f"prefix-seed blob is for backend "
+            f"{header.get('backend')!r}; this engine runs "
+            f"{backend.name!r}"
+        )
+    fp = model_fingerprint(engine)
+    if header.get("model") != fp:
+        raise ValueError(
+            f"prefix-seed blob model geometry {header.get('model')} "
+            f"does not match this engine's {fp}"
+        )
+    if header.get("block_size") != backend.block_size:
+        raise ValueError(
+            f"prefix-seed blob pages are {header.get('block_size')} "
+            f"tokens; this pool uses {backend.block_size}"
+        )
+    try:
+        chain = [bytes.fromhex(h) for h in header["chain"]]
+    except (KeyError, ValueError, TypeError):
+        raise ValueError("prefix-seed blob carries a malformed chain")
+    if not chain:
+        raise ValueError("prefix-seed blob carries an empty chain")
+    fields = kv_field_names(engine.kv_quant)
+    for f in fields:
+        arr = blob.arrays.get(f)
+        if arr is None or arr.shape[1] != len(chain):
+            raise ValueError(
+                f"prefix-seed blob array {f!r} does not cover its "
+                f"{len(chain)}-block chain"
+            )
+
+    # Seed only the missing links. Registration is root-first, so the
+    # registered part of a chain is always a prefix of it; new links
+    # chain onto either b"" or an already-registered parent, keeping
+    # every seeded block reachable from the root at the right
+    # absolute positions.
+    todo = [j for j, h in enumerate(chain)
+            if h not in backend._hash_to_block]
+    if not todo:
+        return 0
+    new_blocks = backend.seed_blocks(len(todo))  # may raise PoolExhausted
+    try:
+        sel = np.asarray(todo, np.int64)
+        idx = jnp.asarray(new_blocks, jnp.int32)
+        cache = engine._cache
+        new = {
+            f: getattr(cache, f).at[:, idx].set(
+                jnp.asarray(blob.arrays[f][:, sel])
+            )
+            for f in fields
+        }
+    except Exception:
+        backend.abort_seed(new_blocks)
+        raise
+    engine._cache = cache.replace(**new)
+    backend.commit_seed([
+        (chain[j], chain[j - 1] if j else b"", blk)
+        for j, blk in zip(todo, new_blocks)
+    ])
+    return len(todo)
+
+
+# ---------------------------------------------------------------------
+# Prefix directory (tier-side)
+# ---------------------------------------------------------------------
+
+
+class _DirEntry:
+    __slots__ = ("supported", "version", "block_size", "blocks", "hot",
+                 "hit_delta", "stamp")
+
+    def __init__(self):
+        self.supported: Optional[bool] = None  # None = never answered
+        self.version = -1
+        self.block_size = 0
+        self.blocks: set = set()        # hex block hashes
+        self.hot: List[Dict[str, Any]] = []
+        self.hit_delta: Dict[str, int] = {}  # hex -> hits since prior poll
+        self.stamp = 0.0
+
+
+class PrefixDirectory:
+    """Which replica holds which prefix chains — the tier's view of
+    fleet cache contents, fed by `GET /kv/prefixes` manifests on the
+    health-sweep cadence. Same lifecycle discipline as FleetCollector:
+    one lock, `forget()` on respawn (the successor starts cold), and
+    every entry treated as possibly stale — the directory ROUTES, it
+    never gates correctness, so the worst a stale entry costs is one
+    prefix miss."""
+
+    #: Don't hash more prompt than the spill decision can value — the
+    #: affinity value saturates at 256 tokens, so walking further buys
+    #: routing nothing.
+    OVERLAP_CAP_TOKENS = 512
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_url: Dict[str, _DirEntry] = {}
+
+    def since(self, url: str) -> int:
+        """Version to send as ?since= on the next poll of `url`."""
+        with self._lock:
+            ent = self._by_url.get(url)
+            return ent.version if ent is not None else -1
+
+    def observe(self, url: str, doc: Dict[str, Any]) -> None:
+        """Fold one /kv/prefixes reply into the directory."""
+        if not isinstance(doc, dict):
+            return
+        with self._lock:
+            ent = self._by_url.setdefault(url, _DirEntry())
+            ent.stamp = time.time()
+            if not doc.get("supported"):
+                ent.supported = False
+                ent.blocks = set()
+                ent.hot = []
+                ent.hit_delta = {}
+                return
+            ent.supported = True
+            if doc.get("unchanged"):
+                return
+            prev_hits = {h["h"]: int(h.get("hits", 0)) for h in ent.hot}
+            ent.version = int(doc.get("version", -1))
+            ent.block_size = int(doc.get("block_size", 0))
+            ent.blocks = set(doc.get("blocks", ()))
+            ent.hot = [h for h in doc.get("hot", ())
+                       if isinstance(h, dict) and "h" in h]
+            ent.hit_delta = {
+                h["h"]: max(0, int(h.get("hits", 0))
+                            - prev_hits.get(h["h"], 0))
+                for h in ent.hot
+            }
+
+    def forget(self, url: str) -> None:
+        """Respawned replica: the successor's cache starts cold, so
+        the predecessor's advertised contents must stop routing."""
+        with self._lock:
+            self._by_url.pop(url, None)
+
+    def overlap(self, url: str, tokens: Any) -> int:
+        """Directory-measured shared-prefix tokens between a prompt's
+        token list and `url`'s advertised cache contents: chain-hash
+        the prompt head with the replica's own block size and walk
+        until a link the replica does not hold. 0 when the replica
+        never answered, does not support manifests, or holds
+        nothing."""
+        with self._lock:
+            ent = self._by_url.get(url)
+            if (ent is None or not ent.supported or not ent.blocks
+                    or ent.block_size <= 0):
+                return 0
+            bs = ent.block_size
+            blocks = ent.blocks
+        head = np.asarray(tokens[:self.OVERLAP_CAP_TOKENS], np.int32)
+        m = 0
+        for h in prefix_mod.chain_hashes(head, bs):
+            if h.hex() not in blocks:
+                break
+            m += 1
+        return m * bs
+
+    def hot_chains(self) -> Dict[str, Dict[str, Any]]:
+        """Fleet-wide aggregation for the replication planner:
+        tip-hash hex -> {hits, delta, depth, block_size, holders}."""
+        agg: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for url, ent in self._by_url.items():
+                if not ent.supported:
+                    continue
+                for h in ent.hot:
+                    hh = h["h"]
+                    row = agg.setdefault(hh, {
+                        "hits": 0, "delta": 0, "depth": 0,
+                        "block_size": ent.block_size, "holders": [],
+                    })
+                    row["hits"] += int(h.get("hits", 0))
+                    row["delta"] += ent.hit_delta.get(hh, 0)
+                    row["depth"] = max(row["depth"],
+                                       int(h.get("depth", 0)))
+                    row["holders"].append(url)
+        return agg
+
+    def holds(self, url: str, tip_hex: str) -> bool:
+        with self._lock:
+            ent = self._by_url.get(url)
+            return (ent is not None and bool(ent.supported)
+                    and tip_hex in ent.blocks)
+
+    def supported(self, url: str) -> bool:
+        """True only for a replica that has POSITIVELY advertised a
+        prefix registry — a never-answered peer is not a push target."""
+        with self._lock:
+            ent = self._by_url.get(url)
+            return ent is not None and bool(ent.supported)
+
+    def distinct_blocks(self) -> int:
+        """Distinct block hashes known fleet-wide (the directory-size
+        gauge)."""
+        with self._lock:
+            seen: set = set()
+            for ent in self._by_url.values():
+                seen |= ent.blocks
+            return len(seen)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                url: {
+                    "supported": ent.supported,
+                    "version": ent.version,
+                    "blocks": len(ent.blocks),
+                    "hot": len(ent.hot),
+                    "age_s": round(time.time() - ent.stamp, 3),
+                }
+                for url, ent in self._by_url.items()
+            }
+
+
+# ---------------------------------------------------------------------
+# KV park spool (replica-side; directory shared across the fleet)
+# ---------------------------------------------------------------------
+
+
+class KVParkStore:
+    """Durable spool for parked KV sessions: serialized `SHLKV1` blobs
+    under one directory (shared across replicas, e.g. NFS or a local
+    disk both processes mount), with the event spool's durability
+    discipline — atomic tmp+rename writes so a crash mid-park leaves
+    no half blob under a final name, crc verification at read-back
+    (the blob's own chunk crc32s via `MigrationBlob.deserialize`), and
+    a size-capped LRU that trims oldest-parked first."""
+
+    SUFFIX = ".shlkv"
+
+    def __init__(self, park_dir: str, max_bytes: int = 256 << 20):
+        self.park_dir = park_dir
+        self.max_bytes = max_bytes
+        self.write_errors = 0
+        self.torn_reads = 0
+        self._lock = threading.Lock()
+        os.makedirs(park_dir, exist_ok=True)
+
+    def _path(self, park_id: str) -> str:
+        if not park_id or not all(
+                c.isalnum() or c in "-_" for c in park_id):
+            raise ValueError(f"bad park id {park_id!r}")
+        return os.path.join(self.park_dir, park_id + self.SUFFIX)
+
+    def put(self, park_id: str, data: bytes) -> str:
+        """Atomically spool one serialized blob; trims LRU past the
+        size cap AFTER the write so the new park is never the victim
+        of its own admission. OSError propagates — a park that did not
+        land durably must fail loudly, not report success."""
+        path = self._path(park_id)
+        tmp = path + ".tmp"
+        with self._lock:
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            except OSError:
+                self.write_errors += 1
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            self._trim(keep=path)
+        return path
+
+    def get(self, park_id: str) -> MigrationBlob:
+        """Read + integrity-check one parked blob. KeyError when the
+        id is unknown; ValueError when the file is torn or corrupt
+        (counted, and the file is quarantined out of the spool so a
+        bad disk sector cannot wedge every resume retry)."""
+        path = self._path(park_id)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            raise KeyError(park_id)
+        try:
+            return MigrationBlob.deserialize(data)
+        except ValueError:
+            with self._lock:
+                self.torn_reads += 1
+            try:
+                os.replace(path, path + ".torn")
+            except OSError:
+                pass
+            raise
+
+    def delete(self, park_id: str) -> None:
+        try:
+            os.unlink(self._path(park_id))
+        except FileNotFoundError:
+            pass
+
+    def list(self) -> List[Dict[str, Any]]:
+        out = []
+        try:
+            names = os.listdir(self.park_dir)
+        except OSError:
+            return out
+        for name in sorted(names):
+            if not name.endswith(self.SUFFIX):
+                continue
+            p = os.path.join(self.park_dir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            out.append({"park_id": name[:-len(self.SUFFIX)],
+                        "bytes": st.st_size, "mtime": st.st_mtime})
+        return out
+
+    def _trim(self, keep: Optional[str] = None) -> None:
+        entries: List[Tuple[float, int, str]] = []
+        try:
+            names = os.listdir(self.park_dir)
+        except OSError:
+            return
+        total = 0
+        for name in names:
+            if not name.endswith(self.SUFFIX):
+                continue
+            p = os.path.join(self.park_dir, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            total += st.st_size
+            entries.append((st.st_mtime, st.st_size, p))
+        entries.sort()  # oldest first
+        for mtime, size, p in entries:
+            if total <= self.max_bytes:
+                break
+            if p == keep:
+                continue
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+            total -= size

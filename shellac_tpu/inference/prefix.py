@@ -1,0 +1,74 @@
+"""Shared prompt-prefix hashing: the one helper both layers key by.
+
+The tier's affinity router and the engine's paged prefix cache both
+derive identity from the leading prompt content, and before the KV
+fabric each carried a private copy (tier.py hashed the leading 64
+tokens / 256 chars into an affinity key; PagedBackend chained
+per-block content digests) — close enough to collude, far enough to
+drift. The fabric's prefix directory requires them to key IDENTICALLY:
+the tier matches a prompt's chain hashes against block hashes reported
+by replicas over `GET /kv/prefixes`, so a digest computed tier-side
+must be byte-equal to the digest the replica registered for the same
+tokens. This module is that single source of truth; `tier.py` and
+`cache/paged.py` import it instead of carrying copies that could
+disagree.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, List, Tuple
+
+import numpy as np
+
+#: Affinity keys hash a bounded prompt head so unbounded prompts cost
+#: O(1): leading tokens for token payloads, leading characters for
+#: text payloads (~4 chars/token heuristic for the estimate).
+AFFINITY_HEAD_TOKENS = 64
+AFFINITY_HEAD_CHARS = 256
+
+
+def chain_hashes(tokens: Any, block_size: int) -> List[bytes]:
+    """Position-dependent content hashes of the full token blocks:
+    h_j = H(h_{j-1} || block_j), so a block only matches when its
+    entire prefix matches too (and therefore occupies the same
+    absolute positions — required for RoPE'd cached K).
+
+    Tokens are canonicalized to contiguous int32 before hashing: the
+    tier hashes Python lists straight off a JSON payload while the
+    engine hashes its admission-time arrays, and the digests must be
+    byte-equal across that representation gap.
+    """
+    arr = np.ascontiguousarray(np.asarray(tokens, dtype=np.int32))
+    out: List[bytes] = []
+    h = b""
+    for j in range(arr.size // block_size):
+        h = hashlib.blake2b(
+            h + arr[j * block_size:(j + 1) * block_size].tobytes(),
+            digest_size=16,
+        ).digest()
+        out.append(h)
+    return out
+
+
+def affinity_head(prefix: Any) -> Tuple[str, int]:
+    """(bounded head string, estimated prefix tokens) for a prompt —
+    a list of token ids or a text string. The head is what the
+    affinity key hashes; the estimate scales how much load imbalance
+    an affinity hit is worth in the router's spill decision."""
+    if isinstance(prefix, list):
+        return (
+            ",".join(str(t) for t in prefix[:AFFINITY_HEAD_TOKENS]),
+            len(prefix),
+        )
+    s = str(prefix)
+    return s[:AFFINITY_HEAD_CHARS], max(1, len(s) // 4)
+
+
+def affinity_hash(head: str) -> str:
+    """Stable 8-byte digest of an affinity head, prefixed so key
+    provenance ('p:' prompt-derived vs 's:' session-pinned) survives
+    into logs and the rendezvous ring."""
+    return "p:" + hashlib.blake2b(
+        head.encode(), digest_size=8
+    ).hexdigest()

@@ -86,7 +86,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import numpy as np
 
 from shellac_tpu.config import ModelConfig
-from shellac_tpu.inference import disagg
+from shellac_tpu.inference import disagg, fabric
 from shellac_tpu.inference.batching import BatchingEngine
 from shellac_tpu.inference.cache import PoolExhausted
 from shellac_tpu.obs import (
@@ -281,6 +281,8 @@ class InferenceServer:
         incident_window: float = 600.0,
         incident_retention: int = 24,
         incident_capture_seconds: float = 0.0,
+        park_dir: Optional[str] = None,
+        park_max_bytes: int = 256 << 20,
         **engine_kw,
     ):
         if role not in ROLES:
@@ -439,6 +441,11 @@ class InferenceServer:
         self._migrate_targets: Dict[int, str] = {}
         self._adoptions: Dict[str, Tuple[_Pending, float]] = {}
         self._adopt_ttl = float(adopt_ttl)
+        # KV park spool (serve --park-dir): frozen slots exported to a
+        # durable directory so a parked session survives this replica
+        # and resumes on any replica that mounts the same spool.
+        self._park = (fabric.KVParkStore(park_dir, park_max_bytes)
+                      if park_dir else None)
         self._push_pool: Optional[
             concurrent.futures.ThreadPoolExecutor] = None
         # Startup auto-tune (serve --decode-ticks auto, the CLI
@@ -1157,6 +1164,17 @@ class InferenceServer:
             # thread — the only thread allowed to touch the engine.
             self._import_item(g, rid, *samp["_kv_import"])
             return
+        if samp and "_kv_seed" in samp:
+            # Prefix-seed adoption (fabric replication): registers
+            # pure cache contents — no pending, no request.
+            self._seed_item(g, *samp["_kv_seed"])
+            return
+        if samp and "_kv_export_chain" in samp:
+            # Prefix-chain export (fabric replication, holder side):
+            # the handler thread ships the blob; only the device pull
+            # runs here.
+            self._export_chain_item(g, *samp["_kv_export_chain"])
+            return
         extra = {}
         if samp and "_migrate" in samp:
             # Prefill-only admission (prefill replica): the engine
@@ -1282,6 +1300,56 @@ class InferenceServer:
             p.finish()
         ack.fail(msg, retryable)
 
+    def _seed_item(self, g: _Generation, blob, ack, tid) -> None:
+        """Adopt one prefix-seed blob (scheduler thread). Unlike
+        _import_item there is no pending and no slot — a seed is pure
+        cache contents — so failures settle only the handler's ack.
+        PoolExhausted is the retryable class; a refused blob (wrong
+        kind/backend/geometry) is a 400 with the registry untouched."""
+        try:
+            n = fabric.seed_chain(g.engine, blob)
+        except PoolExhausted:
+            self._m.fabric_seed_rejects.labels(reason="exhausted").inc()
+            ack.fail(
+                "no free-list headroom for the seed (seeding never "
+                "evicts to make room); retry after load falls",
+                retryable=True,
+            )
+            return
+        except (ValueError, TypeError) as e:
+            self._m.fabric_seed_rejects.labels(reason="mismatch").inc()
+            ack.fail(str(e), False)
+            return
+        except Exception as e:  # noqa: BLE001 — request-scoped fault
+            self._m.fabric_seed_rejects.labels(reason="fault").inc()
+            ack.fail(f"kv seed failed: {type(e).__name__}: {e}", True)
+            return
+        self._m.fabric_seeded.inc(n)
+        if self._recorder is not None:
+            self._recorder.record(
+                tid, "kv-seed", blocks=n,
+                chain=len(blob.header.get("chain") or ()), src="server",
+            )
+        ack.ok(n)
+
+    def _export_chain_item(self, g: _Generation, tip: bytes, ack,
+                           tid) -> None:
+        """Export one cached prefix chain (scheduler thread) and hand
+        the blob back through the ack; the handler thread owns the
+        HTTP leg. An evicted link is a 400 — the tier's directory is
+        stale, and re-planning beats retrying a chain that is gone."""
+        try:
+            blob = fabric.export_chain(g.engine, tip, trace_id=tid)
+        except (ValueError, TypeError) as e:
+            ack.fail(str(e), False)
+            return
+        except Exception as e:  # noqa: BLE001 — request-scoped fault
+            ack.fail(
+                f"chain export failed: {type(e).__name__}: {e}", True,
+            )
+            return
+        ack.ok(blob)
+
     def _service_frozen(self, g: _Generation) -> None:
         """Prefill-side migration driver, run on the scheduler thread
         after each step: export every newly frozen prefill-only slot
@@ -1329,10 +1397,17 @@ class InferenceServer:
                 self._push_pool = concurrent.futures.ThreadPoolExecutor(
                     max_workers=4, thread_name_prefix="shellac-kv-push",
                 )
-            self._push_pool.submit(
-                self._push_migration, rid, blob, target,
-                p.deadline if p is not None else None,
-            )
+            if target.startswith("park:"):
+                # Park leg: the blob goes to the durable spool, not a
+                # decode replica — same worker pool, different sink.
+                self._push_pool.submit(
+                    self._park_blob, rid, blob, target[len("park:"):],
+                )
+            else:
+                self._push_pool.submit(
+                    self._push_migration, rid, blob, target,
+                    p.deadline if p is not None else None,
+                )
 
     def _push_migration(self, rid, blob, target: str,
                         deadline: Optional[float]) -> None:
@@ -1389,6 +1464,52 @@ class InferenceServer:
             "transfer_s": round(dt, 6),
             "tokens_out": n_out,
             "prompt_tokens": int(blob.header["length"]),
+        }
+        if pp.trace is not None:
+            pp.trace.finish(n_out)
+        pp.finish()
+
+    def _park_blob(self, rid, blob, park_id: str) -> None:
+        """Push worker: spool one exported slot durably and settle the
+        parking client's pending with the park receipt. A park that
+        did not land durably fails loudly — a receipt for a lost blob
+        would strand the session."""
+        p = self._pending.get(rid)
+        tid = (p.trace.trace_id
+               if p is not None and p.trace is not None else None)
+        data = blob.serialize()
+        try:
+            self._park.put(park_id, data)
+        except OSError as e:
+            pp = self._pending.pop(rid, None)
+            if pp is not None:
+                pp.error = (f"kv park failed: could not spool "
+                            f"{park_id!r}: {type(e).__name__}: {e}")
+                pp.kind = "fault"
+                if pp.trace is not None:
+                    pp.trace.abort("fault")
+                pp.finish()
+            return
+        self._m.fabric_parked.inc()
+        self._m.fabric_park_bytes.set(
+            float(sum(e["bytes"] for e in self._park.list()))
+        )
+        if self._recorder is not None:
+            self._recorder.record(
+                tid, "fabric-park", park_id=park_id, bytes=len(data),
+                complete=bool(blob.header["complete"]),
+            )
+        pp = self._pending.pop(rid, None)
+        if pp is None:
+            return  # cancelled or swept while spooling
+        n_out = len(blob.header["request"]["out"])
+        pp.result = {
+            "parked": True,
+            "park_id": park_id,
+            "bytes": len(data),
+            "complete": bool(blob.header["complete"]),
+            "prompt_tokens": int(blob.header["length"]),
+            "tokens_out": n_out,
         }
         if pp.trace is not None:
             pp.trace.finish(n_out)
@@ -1920,6 +2041,13 @@ class InferenceServer:
         tid = blob.header.get("trace_id") or (
             trace_ctx[0] if trace_ctx is not None else new_trace_id()
         )
+        return self._import_blob(blob, tid)
+
+    def _import_blob(self, blob, tid: str) -> Dict[str, Any]:
+        """Admit one already-deserialized migration blob under
+        migration id `tid` — the shared tail of POST /kv/import and
+        park-resume (which reads its blob from the durable spool
+        instead of the wire)."""
         r = blob.header.get("request") or {}
         with self._lock:
             if self._fatal is not None:
@@ -2008,6 +2136,198 @@ class InferenceServer:
         return {"imported": True, "migration_id": tid,
                 "slot": ack.slot, "complete": False, "trace_id": tid}
 
+    # ---- KV fabric surface (directory feed / seed / push / park) ----
+
+    def prefix_manifest(self, since: int = -1) -> Dict[str, Any]:
+        """GET /kv/prefixes: the backend's prefix-cache manifest for
+        the tier's directory. Read handler-side while the scheduler
+        mutates the registry, so a torn iteration (RuntimeError from a
+        resized dict) just retries; after a few collisions it reports
+        "unchanged" — the directory is a routing hint fed on every
+        sweep, not a ledger, so the next poll catches up."""
+        for _ in range(3):
+            try:
+                return self.engine.cache_backend.prefix_manifest(since)
+            except RuntimeError:
+                continue
+        return {"supported": True, "version": since, "unchanged": True}
+
+    def seed_kv(self, body: bytes,
+                trace_ctx: Optional[Tuple[str, int]] = None
+                ) -> Dict[str, Any]:
+        """POST /kv/seed: adopt a prefix-seed blob into the prefix
+        registry. Integrity failures (crc, truncation) refuse at
+        deserialize with the registry untouched; the seed itself runs
+        on the scheduler thread and never evicts live state."""
+        try:
+            blob = disagg.MigrationBlob.deserialize(bytes(body))
+        except ValueError:
+            self._m.fabric_seed_rejects.labels(reason="corrupt").inc()
+            raise
+        tid = blob.header.get("trace_id") or (
+            trace_ctx[0] if trace_ctx is not None else new_trace_id()
+        )
+        with self._lock:
+            if self._fatal is not None:
+                raise RuntimeError(self._fatal)
+            if self._closed.is_set():
+                raise RuntimeError("server closed")
+            g = self._g
+            if self._recovering or g.dead:
+                raise ServerUnavailable(
+                    "server recovering from an engine fault; retry",
+                    http_status=503, retry_after=retry_after(3.0, 8.0),
+                )
+            if self._draining:
+                raise ServerUnavailable(
+                    "server draining: not adopting seeds",
+                    http_status=503, retry_after=retry_after(1.0, 4.0),
+                )
+            ack = _ImportAck()
+            g.submit_q.put((
+                next(self._ids), np.zeros(0, np.int32), 0, None,
+                {"_kv_seed": (blob, ack, tid)}, None,
+            ))
+        if not ack.event.wait(timeout=60.0):
+            raise ServerUnavailable(
+                "kv seed not processed in time",
+                http_status=503, retry_after=retry_after(1.0, 3.0),
+            )
+        if ack.error is not None:
+            if ack.retryable:
+                raise ServerUnavailable(
+                    ack.error, http_status=503,
+                    retry_after=retry_after(1.0, 3.0),
+                )
+            raise ValueError(ack.error)
+        return {"seeded": ack.slot, "trace_id": tid}
+
+    def push_chain(self, payload: dict,
+                   trace_ctx: Optional[Tuple[str, int]] = None
+                   ) -> Dict[str, Any]:
+        """POST /kv/push {"chain": <tip hex>, "target": <url>}: export
+        the cached chain ending at `chain` and ship it to `target`'s
+        /kv/seed — the leg the tier's replication planner drives
+        against a holder replica. The scheduler thread only pays the
+        device pull; this handler thread owns serialize + HTTP."""
+        tid = (trace_ctx[0] if trace_ctx is not None
+               else new_trace_id())
+        tip_hex = payload.get("chain")
+        target = payload.get("target")
+        if not isinstance(tip_hex, str) or not tip_hex:
+            raise ValueError(
+                'kv push needs "chain": the chain tip hash (hex)'
+            )
+        if not isinstance(target, str) or "://" not in target:
+            raise ValueError(
+                'kv push needs "target": the receiving replica base URL'
+            )
+        try:
+            tip = bytes.fromhex(tip_hex)
+        except ValueError:
+            raise ValueError(f"bad chain hash {tip_hex!r}")
+        with self._lock:
+            if self._fatal is not None:
+                raise RuntimeError(self._fatal)
+            if self._closed.is_set():
+                raise RuntimeError("server closed")
+            g = self._g
+            if self._recovering or g.dead:
+                raise ServerUnavailable(
+                    "server recovering from an engine fault; retry",
+                    http_status=503, retry_after=retry_after(3.0, 8.0),
+                )
+            ack = _ImportAck()
+            g.submit_q.put((
+                next(self._ids), np.zeros(0, np.int32), 0, None,
+                {"_kv_export_chain": (tip, ack, tid)}, None,
+            ))
+        if not ack.event.wait(timeout=60.0):
+            raise ServerUnavailable(
+                "chain export not processed in time",
+                http_status=503, retry_after=retry_after(1.0, 3.0),
+            )
+        if ack.error is not None:
+            if ack.retryable:
+                raise ServerUnavailable(
+                    ack.error, http_status=503,
+                    retry_after=retry_after(1.0, 3.0),
+                )
+            raise ValueError(ack.error)
+        blob = ack.slot  # the export ack carries the blob
+        data = blob.serialize()
+        headers = {"Content-Type": "application/octet-stream",
+                   TRACE_HEADER: format_trace_header(tid, 0)}
+        t0 = time.monotonic()
+        try:
+            req = urllib.request.Request(
+                target.rstrip("/") + "/kv/seed", data=data,
+                headers=headers,
+            )
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                body = json.loads(resp.read() or b"{}")
+        except Exception as e:  # noqa: BLE001 — one retryable leg
+            raise ServerUnavailable(
+                f"could not deliver seed to {target}: "
+                f"{type(e).__name__}: {e}",
+                http_status=503, retry_after=retry_after(1.0, 3.0),
+            )
+        dt = time.monotonic() - t0
+        self._m.kv_transfer_seconds.observe(dt, exemplar=tid)
+        self._m.kv_transfer_bytes.observe(float(len(data)),
+                                          exemplar=tid)
+        if self._recorder is not None:
+            self._recorder.record(
+                tid, "kv-push", chain=tip_hex[:12], target=target,
+                bytes=len(data), seeded=body.get("seeded"),
+                transfer_s=round(dt, 6),
+            )
+        return {"pushed": True, "bytes": len(data),
+                "seeded": body.get("seeded"),
+                "transfer_s": round(dt, 6), "trace_id": tid}
+
+    def _handle_resume(self, payload: dict,
+                       trace_ctx: Optional[Tuple[str, int]] = None
+                       ) -> dict:
+        """Native resume request ({"resume": <park id>}): read the
+        parked blob back from the durable spool (crc-verified), import
+        it like a migration, and attach exactly like an adopt — so a
+        parked session continues on ANY replica that mounts the park
+        directory, byte-identical to never having been parked."""
+        if self._park is None:
+            raise ValueError(
+                '"resume" needs serve --park-dir on this replica'
+            )
+        park_id = str(payload.get("resume"))
+        try:
+            blob = self._park.get(park_id)
+        except KeyError:
+            self._m.fabric_resumed.labels(outcome="missing").inc()
+            raise ValueError(
+                f"unknown park id {park_id!r} (never parked, trimmed "
+                "by the size cap, or quarantined)"
+            )
+        except ValueError as e:
+            # Torn/corrupt spool file: quarantined by the store so the
+            # next retry does not re-read the same bad sectors. Loud —
+            # a server fault, not a bad request.
+            self._m.fabric_resumed.labels(outcome="torn").inc()
+            raise RuntimeError(
+                f"parked blob {park_id!r} failed integrity read-back "
+                f"and was quarantined: {e}"
+            )
+        self._import_blob(blob, park_id)
+        self._m.fabric_resumed.labels(outcome="ok").inc()
+        if self._recorder is not None:
+            self._recorder.record(
+                trace_ctx[0] if trace_ctx is not None else None,
+                "fabric-resume", park_id=park_id,
+                complete=bool(blob.header.get("complete")),
+            )
+        sub = {k: v for k, v in payload.items() if k != "resume"}
+        sub["adopt"] = park_id
+        return self._handle_adopt(sub, trace_ctx=trace_ctx)
+
     def _handle_migrate(self, payload: dict,
                         trace_ctx: Optional[Tuple[str, int]] = None
                         ) -> dict:
@@ -2016,10 +2336,23 @@ class InferenceServer:
         answers with the migration ack once the decode replica holds
         the KV. The tier's disaggregated path drives this as leg 1."""
         target = payload.get("migrate_to")
-        if not isinstance(target, str) or "://" not in target:
+        if payload.get("park"):
+            # Park leg: same prefill/freeze/export path, but the blob
+            # lands in the durable spool instead of a decode replica.
+            if target is not None:
+                raise ValueError(
+                    "park and migrate_to are mutually exclusive (a "
+                    "parked blob has no decode target yet)"
+                )
+            if self._park is None:
+                raise ValueError(
+                    '"park" needs serve --park-dir on this replica'
+                )
+            target = "park:" + new_trace_id()
+        elif not isinstance(target, str) or "://" not in target:
             raise ValueError(
                 'prefill_only needs "migrate_to": the decode replica '
-                "base URL"
+                'base URL (or "park": true with serve --park-dir)'
             )
         for key in ("stream", "num_beams", "adopt"):
             if payload.get(key):
@@ -2177,6 +2510,10 @@ class InferenceServer:
         if trace_ctx is None:
             trace_ctx = (new_trace_id(), 0)
         tool_ctx = self._tool_context(payload)
+        if payload.get("resume") is not None:
+            if tool_ctx is not None:
+                raise ValueError("tools do not compose with resume")
+            return self._handle_resume(payload, trace_ctx=trace_ctx)
         if payload.get("prefill_only"):
             if tool_ctx is not None:
                 raise ValueError(
@@ -2661,6 +2998,20 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.startswith("/kv/prefixes"):
+                # KV-fabric directory feed: what this replica's prefix
+                # cache holds (delta-polled — ?since=<version> answers
+                # "unchanged" when nothing moved).
+                qs = urllib.parse.urlsplit(self.path).query
+                try:
+                    since = int(urllib.parse.parse_qs(qs).get(
+                        "since", ["-1"])[0])
+                except ValueError:
+                    self._send(400, {"error": "bad since value"},
+                               headers=rid_hdr)
+                    return
+                self._send(200, server.prefix_manifest(since),
+                           headers=rid_hdr)
             elif self.path.startswith("/debug/"):
                 # Introspection surface: the in-flight table and per-
                 # trace timelines. 404 wholesale under --no-debug (the
@@ -2926,6 +3277,41 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     n = int(self.headers.get("Content-Length", 0))
                     out = server.import_kv(self.rfile.read(n),
                                            trace_ctx=tctx)
+                    self._send(200, out, headers=rid_hdr)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)}, headers=rid_hdr)
+                except ServerUnavailable as e:
+                    self._send_unavailable(e, trace_id=tctx[0])
+                except RuntimeError as e:
+                    self._send(500, {"error": str(e)}, headers=rid_hdr)
+                return
+            if self.path == "/kv/seed":
+                # Binary prefix-seed blob (fabric replication) —
+                # binary like /kv/import, handled before the JSON
+                # parse below.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    out = server.seed_kv(self.rfile.read(n),
+                                         trace_ctx=tctx)
+                    self._send(200, out, headers=rid_hdr)
+                except ValueError as e:
+                    self._send(400, {"error": str(e)}, headers=rid_hdr)
+                except ServerUnavailable as e:
+                    self._send_unavailable(e, trace_id=tctx[0])
+                except RuntimeError as e:
+                    self._send(500, {"error": str(e)}, headers=rid_hdr)
+                return
+            if self.path == "/kv/push":
+                # Replication order from the tier: export one cached
+                # chain and ship it to a peer's /kv/seed.
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(payload, dict):
+                        raise ValueError(
+                            "kv push payload must be a JSON object"
+                        )
+                    out = server.push_chain(payload, trace_ctx=tctx)
                     self._send(200, out, headers=rid_hdr)
                 except ValueError as e:
                     self._send(400, {"error": str(e)}, headers=rid_hdr)

@@ -98,6 +98,8 @@ from shellac_tpu.obs import (
     parse_slo_specs,
     spool_path,
 )
+from shellac_tpu.inference import prefix as prefix_mod
+from shellac_tpu.inference.fabric import PrefixDirectory
 from shellac_tpu.utils.failure import CircuitBreaker
 
 #: Parsed-metrics keys the load score reads (PR 3 gauge names).
@@ -226,6 +228,9 @@ class TierRouter:
         kv_bandwidth: float = 1e9,
         disagg_min_prompt: int = 64,
         disagg_attempts: int = 2,
+        fabric: bool = True,
+        fabric_hot_hits: int = 4,
+        fabric_max_push: int = 2,
         spool_dir: Optional[str] = None,
         spool_max_bytes: int = 8 << 20,
         incident_dir: Optional[str] = None,
@@ -332,6 +337,43 @@ class TierRouter:
         self.kv_bandwidth = float(kv_bandwidth)
         self.disagg_min_prompt = int(disagg_min_prompt)
         self.disagg_attempts = int(disagg_attempts)
+        # KV fabric: the prefix directory (delta-polled on the health
+        # sweep) makes routing cache-contents-aware, and the
+        # replication planner pushes chains hot above fabric_hot_hits
+        # fleet-wide hits to routable peers that lack them — at most
+        # fabric_max_push pushes per sweep, each gated by the same
+        # transfer-vs-recompute cost rule as migration.
+        if fabric_hot_hits < 1:
+            raise ValueError("fabric_hot_hits must be >= 1")
+        if fabric_max_push < 0:
+            raise ValueError("fabric_max_push must be >= 0")
+        self.fabric = bool(fabric)
+        self.fabric_hot_hits = int(fabric_hot_hits)
+        self.fabric_max_push = int(fabric_max_push)
+        self._directory: Optional[PrefixDirectory] = (
+            PrefixDirectory() if self.fabric else None
+        )
+        # (tip hex, target url) -> monotonic stamp of the last push
+        # order, so a still-hot chain is not re-pushed every sweep
+        # while the receiver's manifest catches up. Poller thread only.
+        self._pushed: Dict[Tuple[str, str], float] = {}
+        # Built eagerly with the poll pool (not at first push): every
+        # worker thread the router owns starts at construction and
+        # stops in close(), so nothing spawned mid-flight outlives the
+        # router unnoticed.
+        self._fabric_pool: Optional[
+            concurrent.futures.ThreadPoolExecutor] = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=2,
+                thread_name_prefix="shellac-fabric-push",
+            ) if self.fabric else None
+        )
+        if self._fabric_pool is not None:
+            # The executor spawns workers lazily on submit; force them
+            # up front so the first hot chain does not pay a thread
+            # spawn and the full worker set exists from construction.
+            for _ in range(2):
+                self._fabric_pool.submit(lambda: None)
         self._factory = replica_factory
         self._breaker_cfg = (breaker_failures, breaker_window,
                              breaker_cooldown)
@@ -397,6 +439,16 @@ class TierRouter:
             self._m.replica_state.labels(replica=rep.url).set(
                 1 if rep.routable else 0
             )
+        if self._directory is not None:
+            self._m.fabric_directory_chains.set(
+                self._directory.distinct_blocks()
+            )
+            try:
+                self._plan_replication()
+            except Exception:  # noqa: BLE001 — replication is an
+                # optimization; a planner bug must not stop health
+                # sweeps from ejecting and readmitting replicas.
+                pass
         if self._slo is not None:
             self._slo.tick(self._slo_counts())
 
@@ -500,6 +552,25 @@ class TierRouter:
         except (OSError, ValueError, http.client.HTTPException):
             if self._fleet is not None:
                 self._fleet.mark_unreachable(rep.url)
+        if self._directory is not None:
+            # Directory feed rides the same sweep: delta-polled (the
+            # replica answers "unchanged" when its registry version
+            # did not move), and best-effort — a missed poll costs one
+            # sweep of staleness, which the directory tolerates by
+            # design.
+            try:
+                status, body = self._get(
+                    rep.url,
+                    "/kv/prefixes?since="
+                    f"{self._directory.since(rep.url)}",
+                    self.health_timeout,
+                )
+                if status == 200:
+                    self._directory.observe(
+                        rep.url, json.loads(body or b"{}")
+                    )
+            except (OSError, ValueError, http.client.HTTPException):
+                pass
         load["score"] = self._score(rep, load)
         with rep.lock:
             rep.load = load
@@ -542,6 +613,124 @@ class TierRouter:
                         # last-known-good series stop being served
                         # (the successor starts fresh ones).
                         self._fleet.forget(rep.url)
+                    if self._directory is not None:
+                        # The successor's cache starts cold — the
+                        # predecessor's advertised contents must stop
+                        # attracting traffic.
+                        self._directory.forget(rep.url)
+
+    # ---- KV fabric: hot-prefix replication planner ------------------
+
+    def _plan_replication(self) -> None:
+        """One replication-planning pass (poller thread, after each
+        sweep): chains whose fleet-wide hit count crossed
+        fabric_hot_hits, held by a routable replica but absent on a
+        routable supported peer, are ordered pushed holder → peer via
+        POST /kv/push — a PLANNED movement schedule (TACCL's
+        discipline), not whatever request order produces. Each push is
+        gated by the migration cost rule: estimated transfer seconds
+        (chain bytes / kv_bandwidth) must not exceed the recompute the
+        replica-local hits of the last sweep would pay (hit delta ×
+        measured prefill_dispatch phase cost). Unknowns lean toward
+        pushing — the first digests arrive within a poll or two."""
+        agg = self._directory.hot_chains()
+        rows = sorted(
+            ((tip, row) for tip, row in agg.items()
+             if row["hits"] >= self.fabric_hot_hits),
+            key=lambda kv: kv[1]["hits"], reverse=True,
+        )
+        if not rows:
+            return
+        now = time.monotonic()
+        self._pushed = {k: t for k, t in self._pushed.items()
+                        if now - t < 30.0}
+        budget = self.fabric_max_push
+        recompute = self._phase_mean_s("prefill_dispatch")
+        for tip, row in rows:
+            if budget <= 0:
+                break
+            routable = {r.url for r in self._replicas if r.routable}
+            holders = [u for u in row["holders"] if u in routable]
+            if not holders:
+                continue
+            holder = holders[0]
+            targets = [
+                r for r in self._replicas
+                if r.routable
+                and self._directory.supported(r.url)
+                and not self._directory.holds(r.url, tip)
+                and (tip, r.url) not in self._pushed
+            ]
+            if not targets:
+                continue
+            bs, depth = row["block_size"], row["depth"]
+            if recompute is not None and recompute > 0 \
+                    and bs > 0 and depth > 0:
+                bpt = None
+                for r in self._replicas:
+                    if r.url == holder:
+                        with r.lock:
+                            v = r.load.get(_KVBPT_GAUGE)
+                        if v:
+                            bpt = float(v)
+                if bpt:
+                    transfer_s = (depth * bs * bpt
+                                  / self.kv_bandwidth + 0.002)
+                    saved_s = max(1, row["delta"]) * recompute
+                    if transfer_s > saved_s:
+                        self._m.fabric_pushes.labels(
+                            outcome="skipped_cost").inc()
+                        # Stamp the skip so a chain the cost rule
+                        # rejects is not re-priced (and re-counted)
+                        # every sweep while its hits stay flat.
+                        for r in targets:
+                            self._pushed[(tip, r.url)] = now
+                        continue
+            # Seed the least-loaded lacking peer first; one peer per
+            # chain per sweep — the next sweep sees the updated
+            # manifest and fans out further only if still hot.
+            def score(r: Replica) -> float:
+                with r.lock:
+                    s = r.load.get("score")
+                return s if s is not None else float(r.pending)
+
+            target = min(targets, key=score)
+            self._pushed[(tip, target.url)] = now
+            budget -= 1
+            self._fabric_pool.submit(
+                self._fabric_push_leg, holder, tip, target.url
+            )
+
+    def _fabric_push_leg(self, holder: str, tip: str,
+                         target: str) -> None:
+        """Push worker: order `holder` to ship chain `tip` to
+        `target`'s /kv/seed. Failures count and record — never raise:
+        a lost push costs one more sweep of prefix misses, nothing
+        else."""
+        tid = new_trace_id()
+        body = json.dumps({"chain": tip, "target": target}).encode()
+        req = urllib.request.Request(
+            holder + "/kv/push", data=body,
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: format_trace_header(tid, 0)},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                out = json.loads(resp.read() or b"{}")
+        except Exception as e:  # noqa: BLE001 — one best-effort leg
+            self._m.fabric_pushes.labels(outcome="failed").inc()
+            self._recorder.record(
+                tid, "fabric-push", src="tier", holder=holder,
+                target=target, chain=tip[:12],
+                error=f"{type(e).__name__}: {e}",
+            )
+            return
+        self._m.fabric_pushes.labels(outcome="ok").inc()
+        self._recorder.record(
+            tid, "fabric-push", src="tier", holder=holder,
+            target=target, chain=tip[:12],
+            seeded=out.get("seeded"), bytes=out.get("bytes"),
+        )
 
     # ---- routing policy ---------------------------------------------
 
@@ -572,16 +761,11 @@ class TierRouter:
                       if isinstance(first, dict) else "")
         if prefix is None:
             return None, 0
-        if isinstance(prefix, list):
-            est = len(prefix)
-            head = ",".join(str(t) for t in prefix[:64])
-        else:
-            s = str(prefix)
-            est = max(1, len(s) // 4)  # ~4 chars/token heuristic
-            head = s[:256]
-        return "p:" + hashlib.blake2b(
-            head.encode(), digest_size=8
-        ).hexdigest(), est
+        # The shared helper (inference.prefix) is the same one the
+        # paged backend's chain hashes build on — routing and cache
+        # contents key identically by construction.
+        head, est = prefix_mod.affinity_head(prefix)
+        return prefix_mod.affinity_hash(head), est
 
     @staticmethod
     def _rendezvous(key: str, url: str) -> int:
@@ -591,10 +775,17 @@ class TierRouter:
         )
 
     def _pick(self, key: Optional[str], prefix_tokens: int,
-              exclude: set) -> Tuple[Optional[Replica], str]:
-        """Choose a replica: affinity target unless it is ejected,
-        draining, excluded (already failed this request), or hotter
-        than the least-loaded by more than the hit-value-scaled
+              exclude: set, tokens: Optional[List[int]] = None
+              ) -> Tuple[Optional[Replica], str]:
+        """Choose a replica. The directory check runs FIRST: when the
+        fabric directory has MEASURED that some candidate already
+        holds this prompt's prefix KV (chain-hash overlap against its
+        advertised block registry), that replica wins unless it is
+        hotter than the least-loaded by more than the overlap-scaled
+        tolerance — a measured hit needs no 4× discount. Otherwise the
+        PR 6 heuristic: rendezvous affinity target unless it is
+        ejected, draining, excluded (already failed this request), or
+        hotter than the least-loaded by more than the hit-value-scaled
         tolerance — then least-loaded. Returns (None, reason) when
         nothing is routable."""
         routable = [r for r in self._replicas if r.routable]
@@ -613,6 +804,17 @@ class TierRouter:
             return s if s is not None else float(r.pending)
 
         best = min(cands, key=score)
+        if self._directory is not None and tokens:
+            ovl = {r.url: self._directory.overlap(r.url, tokens)
+                   for r in cands}
+            dir_rep = max(cands,
+                          key=lambda r: (ovl[r.url], -score(r)))
+            o = ovl[dir_rep.url]
+            if o > 0 and (score(dir_rep) - score(best)
+                          <= self.affinity_tolerance
+                          * min(1.0, o / 256.0)):
+                self._m.fabric_directory_hits.inc()
+                return dir_rep, "directory"
         if key is None:
             return best, "least_loaded"
         aff = max(cands, key=lambda r: self._rendezvous(key, r.url))
@@ -736,6 +938,12 @@ class TierRouter:
         longer fits the remaining budget ends the loop with up to
         backoff_cap seconds still on it."""
         key, prefix_tokens = self.affinity_key(path, payload)
+        # Token payloads get the directory's measured-overlap routing;
+        # text payloads fall back to the affinity heuristic (chain
+        # hashes are defined over token ids — the tier has no
+        # tokenizer, so it cannot hash what it cannot tokenize).
+        tokens = (payload.get("tokens")
+                  if isinstance(payload.get("tokens"), list) else None)
         tried: set = set()
         stop["why"] = "attempts"
         # Attempt legs actually SENT — distinct from the loop index,
@@ -758,7 +966,8 @@ class TierRouter:
                 if remaining <= 0:
                     stop["why"] = "deadline"
                     return
-            rep, reason = self._pick(key, prefix_tokens, tried)
+            rep, reason = self._pick(key, prefix_tokens, tried,
+                                     tokens=tokens)
             if rep is not None and legs > 0:
                 # Relabel so the routed series distinguishes retry
                 # traffic from first attempts (the reason the metric
@@ -1488,6 +1697,22 @@ class TierRouter:
                           outcome=f"fallback_{r}") or 0
                 for r in ("no_pair", "cost", "feature", "failed")
             )),
+            # KV fabric: per-replica directory view + push/hit tallies
+            # (null when serve-tier ran with --no-fabric).
+            "fabric": None if self._directory is None else {
+                "directory": self._directory.stats(),
+                "directory_chains": self._directory.distinct_blocks(),
+                "directory_hits": total(
+                    "shellac_fabric_directory_hits_total"),
+                "pushes_ok": int(reg.value(
+                    "shellac_fabric_pushes_total", outcome="ok") or 0),
+                "pushes_failed": int(reg.value(
+                    "shellac_fabric_pushes_total",
+                    outcome="failed") or 0),
+                "pushes_skipped_cost": int(reg.value(
+                    "shellac_fabric_pushes_total",
+                    outcome="skipped_cost") or 0),
+            },
         }
 
     # ---- SLO engine wiring ------------------------------------------
@@ -1736,6 +1961,8 @@ class TierRouter:
         self._closed.set()
         self._poller.join(timeout=5)
         self._poll_pool.shutdown(wait=False)
+        if self._fabric_pool is not None:
+            self._fabric_pool.shutdown(wait=False)
         if self._spool is not None:
             self._spool.close()
 

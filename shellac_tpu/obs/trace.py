@@ -178,6 +178,34 @@ class ServeMetrics:
             "chunked device-block payload)",
             buckets=log_buckets(1e3, 1e9, per_decade=2),
         )
+        self.fabric_seeded = c(
+            "shellac_fabric_seeded_blocks_total",
+            "Prefix-cache blocks registered from fleet seed pushes "
+            "(POST /kv/seed) — KV this replica now serves without "
+            "ever having prefilled it",
+        )
+        self.fabric_seed_rejects = c(
+            "shellac_fabric_seed_rejects_total",
+            "Seed blobs refused at the door with the registry "
+            "untouched, by reason (corrupt|mismatch|exhausted|fault)",
+            labels=("reason",),
+        )
+        self.fabric_parked = c(
+            "shellac_fabric_parked_total",
+            "Frozen sessions exported to the KV park spool",
+        )
+        self.fabric_resumed = c(
+            "shellac_fabric_resumed_total",
+            "Park-spool resume attempts, by outcome (ok: imported and "
+            "adopted; missing: unknown park id; torn: blob failed "
+            "integrity read-back and was quarantined)",
+            labels=("outcome",),
+        )
+        self.fabric_park_bytes = g(
+            "shellac_fabric_park_bytes",
+            "Bytes currently resident in this replica's KV park spool "
+            "(size-capped; LRU-trimmed on write)",
+        )
         self._engine_stats: Dict[str, object] = {}
 
     def trace(self, trace_id: Optional[str] = None,
@@ -309,7 +337,8 @@ class TierMetrics:
         self.routed = c(
             "shellac_tier_routed_total",
             "Request attempts forwarded, by replica and routing reason "
-            "(affinity|least_loaded|retry)",
+            "(affinity|least_loaded|directory|retry|disagg_prefill|"
+            "disagg_decode)",
             labels=("replica", "reason"),
         )
         self.outcomes = c(
@@ -390,6 +419,23 @@ class TierMetrics:
             "Tier-side: ok (full disaggregated path served), "
             "fallback_* (served monolithically: no_pair | cost | "
             "feature | failed)",
+            labels=("outcome",),
+        )
+        self.fabric_directory_chains = g(
+            "shellac_fabric_directory_chains",
+            "Distinct prefix-cache blocks the tier's directory "
+            "currently knows across all routable replicas",
+        )
+        self.fabric_directory_hits = c(
+            "shellac_fabric_directory_hits_total",
+            "Routing decisions won by directory-measured chain "
+            "overlap (the replica was chosen because the directory "
+            "says it already holds the prompt's prefix KV)",
+        )
+        self.fabric_pushes = c(
+            "shellac_fabric_pushes_total",
+            "Hot-prefix replication pushes planned by the tier, by "
+            "outcome (ok|failed|skipped_cost)",
             labels=("outcome",),
         )
 

@@ -1,0 +1,810 @@
+"""Fleet-wide KV fabric (inference/fabric.py + docs/serving_tier.md
+§KV fabric).
+
+  - TestPrefixHelper — the ONE shared prompt-hashing helper: chain
+    hashes are deterministic, canonicalized across input types, and
+    prefix-monotone; affinity head/hash match the tier's routing key
+    semantics; the tier-computed chain tip is exactly the hash the
+    paged backend registers (the identity the directory depends on).
+  - TestPrefixDirectory — pure directory units: manifest folding,
+    unchanged/unsupported replies, overlap measured in tokens,
+    per-chain hit deltas, fleet aggregation, forget-on-respawn.
+  - TestKVParkStore — spool durability: atomic writes, torn-file
+    quarantine at crc read-back, LRU trim, id validation.
+  - TestChainSeedEngine — export_chain -> wire -> seed_chain onto a
+    fresh engine gives bit-identical greedy continuations with the
+    prefix served from seeded blocks; the refusal matrix leaves the
+    registry untouched; seeding never evicts live slots (PoolExhausted
+    at the headroom check); a torn chain refuses to export.
+  - TestFabricHTTP — GET /kv/prefixes (manifest + delta), POST
+    /kv/push -> /kv/seed between two live replicas, corrupt-seed
+    refusal at the door.
+  - TestParkResumeHTTP — park receipt, resume on a DIFFERENT replica
+    sharing the spool, unknown-id 400, torn-spool 500 + quarantine,
+    park/resume input validation.
+  - TestTierFabric — the routing acceptance: the tier's directory
+    learns replica cache contents, routes by measured overlap, and
+    the replication planner pushes a hot chain to the peer, which then
+    serves the hot prefix without re-prefilling (seeded blocks + hit
+    tokens asserted via /metrics); a stale directory entry after a
+    replica death costs a miss, never an error.
+  - TestParkResumeChaos — THE park acceptance: freeze + park on one
+    real replica process, SIGKILL it, resume on a survivor — the
+    continuation is token-identical to an uninterrupted run.
+
+Everything that builds an engine is marked `slow` (test_fabric.py is
+an early-alphabet file; the dedicated `kv-fabric` CI job runs the
+module unfiltered — the disagg precedent).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from shellac_tpu import get_model_config
+from shellac_tpu.inference import disagg, fabric
+from shellac_tpu.inference import prefix as prefix_mod
+from shellac_tpu.inference.cache import PoolExhausted, engine_class
+from shellac_tpu.inference.server import InferenceServer, make_http_server
+from shellac_tpu.models import transformer
+from shellac_tpu.obs import Registry
+from shellac_tpu.training.tokenizer import ByteTokenizer
+
+BLOCK = 16
+#: 64 tokens = 4 full blocks AND the whole PR 6 affinity head, so every
+#: request sharing it routes to the same replica by affinity.
+PREFIX = [(i * 7 + 3) % 200 + 1 for i in range(64)]
+
+
+def _tail(seed, n=4):
+    return [(seed * 13 + j * 5) % 200 + 1 for j in range(n)]
+
+
+def _tiny():
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = _tiny()
+    return cfg, transformer.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _paged_engine(cfg, params, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("max_len", 96)
+    kw.setdefault("block_size", BLOCK)
+    kw.setdefault("pool_tokens", 4 * 96)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("prefix_cache", True)
+    return engine_class("paged")(cfg, params, cache_backend="paged", **kw)
+
+
+def _drain(eng):
+    out = {}
+    while eng.pending:
+        out.update(eng.step())
+    return out
+
+
+def _post(base, path, payload, headers=None, timeout=120):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _get_json(base, path, timeout=30):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _metric(base, prefix, timeout=30):
+    """First sample whose exposition line starts with `prefix` (pass
+    the full `name{label="v"}` form for labeled series), or None."""
+    with urllib.request.urlopen(base + "/metrics", timeout=timeout) as r:
+        text = r.read().decode()
+    for ln in text.splitlines():
+        if ln.startswith(prefix + " "):
+            return float(ln.rsplit(" ", 1)[1])
+    return None
+
+
+# ---------------------------------------------------------------------
+# The shared prefix-hashing helper (fast: no engines)
+# ---------------------------------------------------------------------
+
+
+class TestPrefixHelper:
+    def test_chain_hashes_deterministic_and_canonical(self):
+        toks = list(range(64))
+        h = prefix_mod.chain_hashes(toks, 16)
+        assert len(h) == 4
+        assert all(isinstance(x, bytes) and len(x) == 16 for x in h)
+        # Canonicalization: list, int64 array, int32 array all agree.
+        assert prefix_mod.chain_hashes(np.asarray(toks, np.int64), 16) == h
+        assert prefix_mod.chain_hashes(np.asarray(toks, np.int32), 16) == h
+
+    def test_chain_is_prefix_monotone(self):
+        toks = list(range(64))
+        h = prefix_mod.chain_hashes(toks, 16)
+        # A shorter prompt's chain is a prefix of the longer one's.
+        assert prefix_mod.chain_hashes(toks[:32], 16) == h[:2]
+        # A trailing partial block contributes nothing.
+        assert prefix_mod.chain_hashes(toks + [7, 7], 16) == h
+        # Chaining: same last block after a different first block gives
+        # a different tip (position-bound, not content-addressed alone).
+        other = [99] + toks[1:]
+        assert prefix_mod.chain_hashes(other, 16)[-1] != h[-1]
+
+    def test_affinity_head_token_and_text(self):
+        head, est = prefix_mod.affinity_head(list(range(100)))
+        assert est == 100
+        # Only the first 64 tokens key the route.
+        head2, _ = prefix_mod.affinity_head(list(range(64)) + [999])
+        assert head2 == head
+        key = prefix_mod.affinity_hash(head)
+        assert key.startswith("p:") and len(key) == 18
+        shead, sest = prefix_mod.affinity_head("x" * 600)
+        assert len(shead) == 256 and sest == 150
+        assert prefix_mod.affinity_hash(shead) != key
+
+    @pytest.mark.slow
+    def test_helper_matches_backend_registry(self, tiny_model):
+        """The identity the directory depends on: the tier-computed
+        chain tip for a prompt is byte-for-byte the hash the paged
+        backend registered when it served that prompt."""
+        cfg, params = tiny_model
+        eng = _paged_engine(cfg, params)
+        eng.run([("r", PREFIX + _tail(1), 2)])
+        chain = prefix_mod.chain_hashes(PREFIX, BLOCK)
+        backend = eng.cache_backend
+        for h in chain:
+            assert h in backend._hash_to_block
+        assert backend._hash_depth[chain[-1]] == 4
+
+
+# ---------------------------------------------------------------------
+# Prefix directory (fast: pure, fed synthetic manifests)
+# ---------------------------------------------------------------------
+
+
+def _doc(hashes, hot=(), version=1, bs=BLOCK):
+    return {"supported": True, "version": version, "block_size": bs,
+            "blocks": [h.hex() for h in hashes],
+            "blocks_total": len(hashes), "hot": list(hot)}
+
+
+class TestPrefixDirectory:
+    def test_overlap_measured_in_tokens(self):
+        d = fabric.PrefixDirectory()
+        chain = prefix_mod.chain_hashes(PREFIX, BLOCK)
+        d.observe("u", _doc(chain))
+        assert d.overlap("u", PREFIX + _tail(1)) == 64
+        # Partial hold: only the first half of the chain walks.
+        d.observe("u", _doc(chain[:2], version=2))
+        assert d.overlap("u", PREFIX + _tail(1)) == 32
+        # Foreign prompt shares nothing.
+        assert d.overlap("u", list(range(64))) == 0
+        # Unknown replica / no answer yet.
+        assert d.overlap("nope", PREFIX) == 0
+
+    def test_unsupported_and_unchanged(self):
+        d = fabric.PrefixDirectory()
+        chain = prefix_mod.chain_hashes(PREFIX, BLOCK)
+        d.observe("u", {"supported": False})
+        assert d.supported("u") is False
+        assert d.overlap("u", PREFIX) == 0
+        d.observe("u", _doc(chain, version=5))
+        assert d.supported("u") and d.since("u") == 5
+        # An unchanged delta reply keeps the held contents.
+        d.observe("u", {"supported": True, "version": 5,
+                        "unchanged": True})
+        assert d.overlap("u", PREFIX) == 64
+
+    def test_hit_deltas_and_fleet_aggregation(self):
+        d = fabric.PrefixDirectory()
+        chain = prefix_mod.chain_hashes(PREFIX, BLOCK)
+        tip = chain[-1].hex()
+        hot = [{"h": tip, "hits": 5, "depth": 4, "age_s": 0.1}]
+        d.observe("a", _doc(chain, hot=hot, version=1))
+        agg = d.hot_chains()
+        assert agg[tip]["hits"] == 5 and agg[tip]["delta"] == 5
+        assert agg[tip]["holders"] == ["a"]
+        # Next poll: 3 more hits since.
+        hot2 = [{"h": tip, "hits": 8, "depth": 4, "age_s": 0.1}]
+        d.observe("a", _doc(chain, hot=hot2, version=2))
+        agg = d.hot_chains()
+        assert agg[tip]["hits"] == 8 and agg[tip]["delta"] == 3
+        # A second holder aggregates.
+        d.observe("b", _doc(chain, hot=hot, version=1))
+        agg = d.hot_chains()
+        assert sorted(agg[tip]["holders"]) == ["a", "b"]
+        assert d.holds("a", tip) and d.holds("b", tip)
+        assert d.distinct_blocks() == len(chain)
+
+    def test_forget_on_respawn(self):
+        d = fabric.PrefixDirectory()
+        chain = prefix_mod.chain_hashes(PREFIX, BLOCK)
+        d.observe("u", _doc(chain))
+        assert d.overlap("u", PREFIX) == 64
+        d.forget("u")
+        assert d.overlap("u", PREFIX) == 0
+        assert d.supported("u") is False
+        assert d.since("u") == -1
+        assert d.stats() == {}
+
+
+# ---------------------------------------------------------------------
+# Park spool durability (fast: no engines)
+# ---------------------------------------------------------------------
+
+
+def _blob(n=64):
+    return disagg.MigrationBlob(
+        {"backend": "paged", "length": 8, "complete": False,
+         "request": {"out": [1]}},
+        {"k": np.arange(n, dtype=np.float32)},
+    )
+
+
+class TestKVParkStore:
+    def test_round_trip_and_listing(self, tmp_path):
+        store = fabric.KVParkStore(str(tmp_path))
+        data = _blob().serialize()
+        path = store.put("park-1", data)
+        assert os.path.exists(path)
+        back = store.get("park-1")
+        np.testing.assert_array_equal(back.arrays["k"],
+                                      _blob().arrays["k"])
+        assert [e["park_id"] for e in store.list()] == ["park-1"]
+        # Atomic write discipline: no tmp litter under any outcome.
+        assert not [f for f in os.listdir(tmp_path)
+                    if f.endswith(".tmp")]
+        store.delete("park-1")
+        assert store.list() == []
+        store.delete("park-1")  # idempotent
+
+    def test_bad_park_id_refused(self, tmp_path):
+        store = fabric.KVParkStore(str(tmp_path))
+        for bad in ("", "a/b", "../x", "a b"):
+            with pytest.raises(ValueError, match="park id"):
+                store.put(bad, b"x")
+
+    def test_unknown_id_is_keyerror(self, tmp_path):
+        with pytest.raises(KeyError):
+            fabric.KVParkStore(str(tmp_path)).get("ghost")
+
+    def test_torn_file_quarantined(self, tmp_path):
+        store = fabric.KVParkStore(str(tmp_path))
+        store.put("p", _blob().serialize())
+        path = store._path("p")
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 5)
+        with pytest.raises(ValueError):
+            store.get("p")
+        assert store.torn_reads == 1
+        # Quarantined out of the spool: the retry sees a MISSING park,
+        # not the same bad sectors again.
+        assert os.path.exists(path + ".torn")
+        with pytest.raises(KeyError):
+            store.get("p")
+        assert store.list() == []
+
+    def test_lru_trim_never_evicts_the_new_park(self, tmp_path):
+        data = _blob().serialize()
+        store = fabric.KVParkStore(str(tmp_path),
+                                   max_bytes=2 * len(data))
+        store.put("old", data)
+        os.utime(store._path("old"), (1.0, 1.0))
+        store.put("mid", data)
+        os.utime(store._path("mid"), (2.0, 2.0))
+        store.put("new", data)
+        ids = {e["park_id"] for e in store.list()}
+        assert "new" in ids and "old" not in ids
+        # A cap smaller than one blob still admits the newest park.
+        tight = fabric.KVParkStore(str(tmp_path / "tight"),
+                                   max_bytes=1)
+        tight.put("only", data)
+        assert [e["park_id"] for e in tight.list()] == ["only"]
+
+
+# ---------------------------------------------------------------------
+# Engine-level chain export / seed
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestChainSeedEngine:
+    def _seed_blob(self, cfg, params, wire=True):
+        warm = _paged_engine(cfg, params)
+        warm.run([("w", PREFIX + _tail(1), 2)])
+        tip = prefix_mod.chain_hashes(PREFIX, BLOCK)[-1]
+        blob = fabric.export_chain(warm, tip, trace_id="t-1")
+        if wire:
+            blob = disagg.MigrationBlob.deserialize(blob.serialize())
+        return blob
+
+    def test_seed_round_trip_token_identity(self, tiny_model):
+        cfg, params = tiny_model
+        probe = (PREFIX + _tail(9), 6)
+        ctrl = _paged_engine(cfg, params)
+        ctrl.run([("warmup", PREFIX + _tail(8), 2)])
+        expected = ctrl.run([("c", probe[0], probe[1])])["c"]
+
+        blob = self._seed_blob(cfg, params)
+        assert blob.header["kind"] == fabric.SEED_KIND
+        assert len(blob.header["chain"]) == 4
+        cold = _paged_engine(cfg, params)
+        assert fabric.seed_chain(cold, blob) == 4
+        assert cold.stats["prefix_seeded_blocks"] == 4
+        # Re-seeding the same chain is a no-op, not an error.
+        assert fabric.seed_chain(cold, blob) == 0
+        got = cold.run([("r", probe[0], probe[1])])["r"]
+        assert got == expected
+        # The prefix was SERVED from seeded blocks, not re-prefilled.
+        assert cold.stats["prefix_hit_tokens"] >= 64
+
+    def test_refusal_matrix_leaves_registry_untouched(self, tiny_model):
+        cfg, params = tiny_model
+        blob = self._seed_blob(cfg, params)
+        cold = _paged_engine(cfg, params)
+        backend = cold.cache_backend
+
+        def refused(mutate, match):
+            b = disagg.MigrationBlob.deserialize(blob.serialize())
+            mutate(b)
+            before = (dict(backend._hash_to_block),
+                      backend._prefix_version)
+            with pytest.raises(ValueError, match=match):
+                fabric.seed_chain(cold, b)
+            assert (dict(backend._hash_to_block),
+                    backend._prefix_version) == before
+
+        refused(lambda b: b.header.update(kind="migration"),
+                "not a prefix seed")
+        refused(lambda b: b.header.update(backend="dense"),
+                "backend")
+        refused(lambda b: b.header["model"].update(n_layers=99),
+                "geometry")
+        refused(lambda b: b.header.update(block_size=32), "pages are")
+        refused(lambda b: b.header.update(chain=["zz"]), "malformed")
+        refused(lambda b: b.header.update(chain=[]), "empty chain")
+        refused(lambda b: b.arrays.update(
+            k=b.arrays["k"][:, :2]), "does not cover")
+        # Corruption refuses at the wire, before seed_chain ever runs.
+        data = bytearray(blob.serialize())
+        data[-2] ^= 0xFF
+        with pytest.raises(ValueError, match="crc32"):
+            disagg.MigrationBlob.deserialize(bytes(data))
+
+    def test_seed_never_evicts_live_slots(self, tiny_model):
+        """Headroom rule: with live slots holding the pool, seeding
+        raises PoolExhausted instead of evicting — and the live
+        requests finish unharmed."""
+        cfg, params = tiny_model
+        blob = self._seed_blob(cfg, params)
+        # 10-block pool: two live 68-token prompts pin 6 blocks (the
+        # shared prefix is refcounted), leaving less than one slot's
+        # worth of headroom.
+        cold = _paged_engine(cfg, params, pool_tokens=160)
+        other = [(i * 11 + 2) % 200 + 1 for i in range(64)]
+        cold.submit("a", other + _tail(1), 4)
+        cold.submit("b", other + _tail(2), 4)
+        cold.step()
+        before = len(cold.cache_backend._hash_to_block)
+        with pytest.raises(PoolExhausted):
+            fabric.seed_chain(cold, blob)
+        assert len(cold.cache_backend._hash_to_block) == before
+        done = _drain(cold)
+        assert len(done["a"]) == 4 and len(done["b"]) == 4
+
+    def test_torn_chain_refuses_export(self, tiny_model):
+        cfg, params = tiny_model
+        warm = _paged_engine(cfg, params)
+        warm.run([("w", PREFIX + _tail(1), 2)])
+        chain = prefix_mod.chain_hashes(PREFIX, BLOCK)
+        # Evict a middle link the way LRU pressure would.
+        warm.cache_backend._hash_to_block.pop(chain[1])
+        with pytest.raises(ValueError, match="link evicted"):
+            fabric.export_chain(warm, chain[-1])
+
+
+# ---------------------------------------------------------------------
+# Replica HTTP surfaces: /kv/prefixes, /kv/push -> /kv/seed
+# ---------------------------------------------------------------------
+
+
+def _mk_server(cfg, params, *, paged=True, **srv_kw):
+    reg = Registry()
+    if paged:
+        eng = _paged_engine(cfg, params, registry=reg)
+    else:
+        eng = engine_class("dense")(cfg, params, n_slots=2, max_len=96,
+                                    cache_backend="dense",
+                                    temperature=0.0, registry=reg)
+    srv = InferenceServer(cfg, params, tokenizer=ByteTokenizer(),
+                          registry=reg, engine=eng, **srv_kw)
+    httpd = make_http_server(srv)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return srv, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+@pytest.mark.slow
+class TestFabricHTTP:
+    @pytest.fixture(scope="class")
+    def pair(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        servers = [_mk_server(cfg, params) for _ in range(2)]
+        yield servers
+        for srv, httpd, _ in servers:
+            httpd.shutdown()
+            srv.close()
+
+    def test_manifest_and_delta(self, pair):
+        warm_u = pair[0][2]
+        payload = {"tokens": PREFIX + _tail(1), "max_new": 2,
+                   "temperature": 0.0, "timeout": 120}
+        st, _ = _post(warm_u, "/generate", payload)
+        assert st == 200
+        doc = _get_json(warm_u, "/kv/prefixes")
+        assert doc["supported"] and doc["block_size"] == BLOCK
+        chain = prefix_mod.chain_hashes(PREFIX, BLOCK)
+        for h in chain:
+            assert h.hex() in doc["blocks"]
+        # Delta poll: same version collapses to unchanged.
+        again = _get_json(warm_u,
+                          f"/kv/prefixes?since={doc['version']}")
+        assert again.get("unchanged") is True
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get_json(warm_u, "/kv/prefixes?since=banana")
+        assert e.value.code == 400
+
+    def test_dense_replica_reports_unsupported(self):
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        srv, httpd, url = _mk_server(cfg, params, paged=False)
+        try:
+            assert _get_json(url, "/kv/prefixes") == {"supported": False}
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+    def test_push_seeds_peer_which_serves_without_prefill(self, pair):
+        warm_u, cold_u = pair[0][2], pair[1][2]
+        tip = prefix_mod.chain_hashes(PREFIX, BLOCK)[-1]
+        st, body = _post(warm_u, "/kv/push",
+                         {"chain": tip.hex(), "target": cold_u})
+        assert st == 200
+        rep = json.loads(body)
+        assert rep["pushed"] and rep["seeded"] == 4 and rep["bytes"] > 0
+        assert _metric(cold_u, "shellac_fabric_seeded_blocks_total") == 4
+        assert _metric(cold_u, "shellac_engine_prefix_seeded_blocks") == 4
+        # The seeded replica serves the hot prefix WITHOUT
+        # re-prefilling it, token-identically to the holder.
+        payload = {"tokens": PREFIX + _tail(2), "max_new": 4,
+                   "temperature": 0.0, "timeout": 120}
+        _, warm_body = _post(warm_u, "/generate", payload)
+        _, cold_body = _post(cold_u, "/generate", payload)
+        assert (json.loads(cold_body)["tokens"]
+                == json.loads(warm_body)["tokens"])
+        assert _metric(cold_u, "shellac_engine_prefix_hit_tokens") >= 64
+        # Re-pushing the held chain is a cheap no-op.
+        st, body = _post(warm_u, "/kv/push",
+                         {"chain": tip.hex(), "target": cold_u})
+        assert json.loads(body)["seeded"] == 0
+
+    def test_corrupt_seed_refused_at_the_door(self, pair):
+        cold_u = pair[1][2]
+        before = _get_json(cold_u, "/kv/prefixes")
+        req = urllib.request.Request(
+            cold_u + "/kv/seed", data=b"garbage-not-a-blob",
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 400
+        assert _metric(
+            cold_u,
+            'shellac_fabric_seed_rejects_total{reason="corrupt"}') >= 1
+        # Registry untouched: same version, same contents.
+        after = _get_json(cold_u, "/kv/prefixes")
+        assert after["version"] == before["version"]
+
+    def test_push_input_validation(self, pair):
+        warm_u, cold_u = pair[0][2], pair[1][2]
+        for bad in ({"target": cold_u},
+                    {"chain": "zz", "target": cold_u},
+                    {"chain": "ab" * 16, "target": "no-scheme"}):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(warm_u, "/kv/push", bad)
+            assert e.value.code == 400
+        # A chain this replica does not hold is a 400, not a crash.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(warm_u, "/kv/push",
+                  {"chain": "ab" * 16, "target": cold_u})
+        assert e.value.code == 400
+
+
+# ---------------------------------------------------------------------
+# Park / resume over HTTP (shared spool, two replicas)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestParkResumeHTTP:
+    @pytest.fixture(scope="class")
+    def duo(self, tmp_path_factory):
+        spool = str(tmp_path_factory.mktemp("park"))
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        servers = [_mk_server(cfg, params, park_dir=spool)
+                   for _ in range(2)]
+        yield servers, spool
+        for srv, httpd, _ in servers:
+            httpd.shutdown()
+            srv.close()
+
+    PAYLOAD = {"tokens": PREFIX[:12], "max_new": 6,
+               "temperature": 0.0, "timeout": 120}
+
+    def _park(self, url, payload=None):
+        st, body = _post(url, "/generate",
+                         {**(payload or self.PAYLOAD),
+                          "prefill_only": True, "park": True})
+        assert st == 200
+        receipt = json.loads(body)
+        assert receipt["parked"] is True and receipt["bytes"] > 0
+        return receipt
+
+    def test_park_resume_on_other_replica_identity(self, duo):
+        (a, b), _ = duo
+        a_u, b_u = a[2], b[2]
+        _, ctrl = _post(b_u, "/generate", self.PAYLOAD)
+        ctrl_tokens = json.loads(ctrl)["tokens"]
+        receipt = self._park(a_u)
+        assert _metric(a_u, "shellac_fabric_parked_total") >= 1
+        assert _metric(a_u, "shellac_fabric_park_bytes") > 0
+        st, body = _post(b_u, "/generate",
+                         {**self.PAYLOAD, "resume": receipt["park_id"]})
+        assert st == 200
+        assert json.loads(body)["tokens"] == ctrl_tokens
+        assert _metric(
+            b_u, 'shellac_fabric_resumed_total{outcome="ok"}') >= 1
+
+    def test_unknown_park_id_400(self, duo):
+        (_, b), _ = duo
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(b[2], "/generate",
+                  {**self.PAYLOAD, "resume": "never-parked"})
+        assert e.value.code == 400
+        assert _metric(
+            b[2],
+            'shellac_fabric_resumed_total{outcome="missing"}') >= 1
+
+    def test_torn_spool_file_is_loud_and_quarantined(self, duo):
+        (a, b), spool = duo
+        receipt = self._park(a[2])
+        path = os.path.join(spool, receipt["park_id"] + ".shlkv")
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 5)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(b[2], "/generate",
+                  {**self.PAYLOAD, "resume": receipt["park_id"]})
+        assert e.value.code == 500
+        assert _metric(
+            b[2], 'shellac_fabric_resumed_total{outcome="torn"}') >= 1
+        assert os.path.exists(path + ".torn")
+        # The quarantine means the retry sees a missing park (400),
+        # not the same torn bytes wedging every attempt.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(b[2], "/generate",
+                  {**self.PAYLOAD, "resume": receipt["park_id"]})
+        assert e.value.code == 400
+
+    def test_park_validation(self, duo, tiny_model):
+        (a, _), _ = duo
+        # park + migrate_to are mutually exclusive.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(a[2], "/generate",
+                  {**self.PAYLOAD, "prefill_only": True, "park": True,
+                   "migrate_to": "http://127.0.0.1:1"})
+        assert e.value.code == 400
+        # A replica without --park-dir refuses park AND resume.
+        cfg, params = tiny_model
+        srv, httpd, url = _mk_server(cfg, params)
+        try:
+            for payload in (
+                    {**self.PAYLOAD, "prefill_only": True, "park": True},
+                    {**self.PAYLOAD, "resume": "x"}):
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    _post(url, "/generate", payload)
+                assert e.value.code == 400
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+
+# ---------------------------------------------------------------------
+# Tier: directory routing + hot-prefix replication (the acceptance)
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestTierFabric:
+    @pytest.fixture(scope="class")
+    def tier(self):
+        from shellac_tpu.inference.tier import (
+            TierRouter,
+            make_tier_http_server,
+        )
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        servers = [_mk_server(cfg, params) for _ in range(2)]
+        reg = Registry()
+        router = TierRouter(
+            [u for _, _, u in servers], registry=reg,
+            health_interval=0.2, default_timeout=120.0,
+            fabric_hot_hits=1,
+        )
+        httpd = make_tier_http_server(router)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            router.poll_once()
+            if all(r.routable for r in router.replicas):
+                break
+            time.sleep(0.1)
+        yield router, reg, base, servers
+        httpd.shutdown()
+        router.close()
+        for srv, h, _ in servers:
+            h.shutdown()
+            srv.close()
+
+    def _gen(self, base, tail_seed, max_new=4):
+        st, body = _post(base, "/generate",
+                         {"tokens": PREFIX + _tail(tail_seed),
+                          "max_new": max_new, "temperature": 0.0,
+                          "timeout": 120})
+        assert st == 200
+        return json.loads(body)["tokens"]
+
+    def test_directory_learns_and_routes_by_overlap(self, tier):
+        router, reg, base, _ = tier
+        # Two same-prefix sessions warm the fleet; the health sweeps
+        # in between feed the directory their registered chains.
+        self._gen(base, 1)
+        self._gen(base, 2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            router.poll_once()
+            if (router.stats()["fabric"] or {}).get("directory_chains"):
+                break
+            time.sleep(0.1)
+        fab = router.stats()["fabric"]
+        assert fab["directory_chains"] >= 4
+        # With the directory populated, the next same-prefix request
+        # routes on MEASURED overlap, not the affinity guess.
+        before = reg.value("shellac_fabric_directory_hits_total") or 0
+        self._gen(base, 3)
+        assert (reg.value("shellac_fabric_directory_hits_total")
+                or 0) > before
+
+    def test_hot_chain_replicates_to_cold_peer(self, tier):
+        """The fleet acceptance: a replica that never saw the hot
+        prefix gets its chain pushed by the planner and then serves it
+        without re-prefilling — seeded blocks + hit tokens asserted
+        via /metrics, outputs identical to the original holder.
+
+        The hot prefix is warmed DIRECTLY on one replica (tier routing
+        may legitimately warm both replicas of a 2-wide fleet, leaving
+        the planner nothing to do), so exactly one holder advertises
+        it and the peer genuinely lacks it."""
+        router, reg, base, servers = tier
+        warm_u, cold_u = servers[0][2], servers[1][2]
+        hot = [(i * 17 + 5) % 200 + 1 for i in range(64)]
+        for seed in (21, 22):  # second request HITS -> chain goes hot
+            st, _ = _post(warm_u, "/generate",
+                          {"tokens": hot + _tail(seed), "max_new": 4,
+                           "temperature": 0.0, "timeout": 120})
+            assert st == 200
+        seeded0 = _metric(cold_u, "shellac_fabric_seeded_blocks_total") or 0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            router.poll_once()
+            if (reg.value("shellac_fabric_pushes_total",
+                          outcome="ok") or 0) >= 1:
+                break
+            time.sleep(0.2)
+        assert (reg.value("shellac_fabric_pushes_total",
+                          outcome="ok") or 0) >= 1, \
+            "replication planner never pushed the hot chain"
+        assert (_metric(cold_u, "shellac_fabric_seeded_blocks_total")
+                or 0) >= seeded0 + 4
+        payload = {"tokens": hot + _tail(23), "max_new": 4,
+                   "temperature": 0.0, "timeout": 120}
+        hits0 = _metric(cold_u, "shellac_engine_prefix_hit_tokens") or 0
+        _, warm_body = _post(warm_u, "/generate", payload)
+        _, cold_body = _post(cold_u, "/generate", payload)
+        assert (json.loads(cold_body)["tokens"]
+                == json.loads(warm_body)["tokens"])
+        assert (_metric(cold_u, "shellac_engine_prefix_hit_tokens")
+                >= hits0 + 64)
+
+    def test_stale_directory_entry_is_a_miss_not_an_error(self, tier):
+        """Kill a replica the directory still advertises: requests
+        keep succeeding on the survivor — the stale entry costs at
+        most one prefix miss, never a client error."""
+        router, reg, base, servers = tier
+        srv, httpd, dead_u = servers[0]
+        httpd.shutdown()
+        srv.close()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            router.poll_once()
+            rep = next(r for r in router.replicas if r.url == dead_u)
+            if not rep.routable:
+                break
+            time.sleep(0.1)
+        toks = self._gen(base, 11)
+        assert len(toks) == 4
+
+
+# ---------------------------------------------------------------------
+# Chaos acceptance: park, SIGKILL the parker, resume on a survivor
+# ---------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestParkResumeChaos:
+    def test_sigkill_parker_resume_identity(self, tmp_path):
+        """THE park acceptance scenario: freeze + park a session on
+        replica A, SIGKILL A (a true process death), resume on B —
+        the continuation is token-identical to an uninterrupted run.
+        Real `serve` subprocesses via the chaos harness, sharing one
+        spool directory."""
+        from shellac_tpu.inference.chaos import ReplicaProc
+
+        spool = str(tmp_path / "park")
+        procs = []
+        try:
+            procs = [
+                ReplicaProc(extra_args=["--park-dir", spool],
+                            max_len=96)
+                for _ in range(2)
+            ]
+            for p in procs:
+                p.wait_ready()
+            a, b = procs
+            payload = {"tokens": PREFIX[:12], "max_new": 6,
+                       "temperature": 0.0, "timeout": 60}
+            _, ctrl = _post(b.url, "/generate", payload)
+            ctrl_tokens = json.loads(ctrl)["tokens"]
+            st, body = _post(a.url, "/generate",
+                             {**payload, "prefill_only": True,
+                              "park": True})
+            assert st == 200
+            receipt = json.loads(body)
+            assert receipt["parked"] is True
+            a.kill()  # SIGKILL: the replica that parked is GONE.
+            st, body = _post(b.url, "/generate",
+                             {**payload, "resume": receipt["park_id"]})
+            assert st == 200
+            assert json.loads(body)["tokens"] == ctrl_tokens
+        finally:
+            for p in procs:
+                p.terminate()

@@ -361,15 +361,26 @@ class TestTopLogprobs:
         assert tl[0][0][0] == done["r"][0]  # top-1 == greedy first token
 
     def test_stop_truncation_lockstep(self):
+        """Stop-sequence truncation must shorten the alternatives list
+        in lockstep with the token stream.  The stream is pinned to a
+        constant token via logit_bias so the test does not depend on
+        what the random init happens to emit: a stop of two pinned
+        tokens suffix-matches at the earliest opportunity and consumes
+        the whole output, so both lists must come back empty."""
         cfg, params, eng = self._engine()
-        ref = eng.run([("probe", [4, 4, 4], 8)])["probe"]
+        pin = {7: 100.0}
+        eng.submit("probe", [4, 4, 4], 8, logit_bias=pin)
+        ref = {}
+        while eng.pending:
+            ref.update(eng.step())
+        assert ref["probe"] == [7] * 8
         eng.finished_top_logprobs.clear()
-        eng.submit("r", [4, 4, 4], 8, stop=[ref[2:4]])
+        eng.submit("r", [4, 4, 4], 8, stop=[[7, 7]], logit_bias=pin)
         done = {}
         while eng.pending:
             done.update(eng.step())
-        assert done["r"] == ref[:2]
-        assert len(eng.finished_top_logprobs.pop("r")) == 2
+        assert done["r"] == []
+        assert len(eng.finished_top_logprobs.pop("r")) == 0
 
     def test_guards(self):
         from shellac_tpu.inference.batching import BatchingEngine
