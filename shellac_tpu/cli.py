@@ -1105,8 +1105,23 @@ def cmd_serve(args):
         incident_capture_seconds=args.incident_capture_seconds,
         park_dir=args.park_dir,
         park_max_bytes=args.park_max_bytes,
+        tenant_config=_load_tenant_config(args.tenant_config),
+        preempt_after=args.preempt_after,
     )
     return 0
+
+
+def _load_tenant_config(value):
+    """--tenant-config accepts inline JSON ('{...}') or a file path.
+    Returned as raw text either way — TenantPolicy.parse owns the
+    actual validation, so a typo dies at startup with its real
+    error, not a CLI-side guess at one."""
+    if value is None:
+        return None
+    if value.lstrip().startswith("{"):
+        return value
+    with open(value) as f:
+        return f.read()
 
 
 def _load_slos(args):
@@ -1148,6 +1163,16 @@ def cmd_serve_tier(args):
         from shellac_tpu.obs import get_registry
 
         get_registry().disable()
+    autoscale = None
+    if args.autoscale:
+        from shellac_tpu.inference.autoscale import AutoscalePolicy
+
+        autoscale = AutoscalePolicy(
+            min_replicas=args.autoscale_min,
+            max_replicas=args.autoscale_max,
+            cooldown_s=args.autoscale_cooldown,
+            idle_after_s=args.autoscale_idle_after,
+        )
     router = TierRouter(
         args.replica,
         health_interval=args.health_interval,
@@ -1176,6 +1201,8 @@ def cmd_serve_tier(args):
         incident_rate=args.incident_rate,
         incident_window=args.incident_window,
         incident_retention=args.incident_retention,
+        tenant_config=_load_tenant_config(args.tenant_config),
+        autoscale=autoscale,
     )
     serve_tier(router, host=args.host, port=args.port)
     return 0
@@ -1677,6 +1704,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="on-disk footprint cap for the park spool "
                         "(oldest-parked blobs trimmed first; default "
                         "256 MiB)")
+    s.add_argument("--tenant-config", default=None, dest="tenant_config",
+                   metavar="JSON_OR_PATH",
+                   help="per-tenant QoS policy (inline JSON or a file "
+                        'path): {"tenants": {name: {rate, burst, '
+                        "max_concurrency, priority, weight}}} with an "
+                        'optional "default" entry for unlisted '
+                        "tenants. Enables per-tenant token-bucket + "
+                        "concurrency admission (429 + Retry-After "
+                        "over quota) and weighted-fair slot "
+                        "scheduling by priority class "
+                        "(docs/serving_tier.md#multi-tenancy)")
+    s.add_argument("--preempt-after", type=float, default=None,
+                   dest="preempt_after",
+                   help="seconds a higher-priority request may wait "
+                        "with no free slot before the cheapest lower-"
+                        "class decode is preempted: frozen mid-"
+                        "window, its KV parked, auto-resumed when a "
+                        "slot frees — token-identical to an "
+                        "unpreempted run, invisible to the victim's "
+                        "client except latency (unset = never "
+                        "preempt)")
     s.add_argument("--incident-dir", default=None, dest="incident_dir",
                    help="incident black box: supervisor wedge/rebuild, "
                         "restart-budget exhaustion, and POST "
@@ -1888,6 +1936,45 @@ def build_parser() -> argparse.ArgumentParser:
                     dest="incident_retention",
                     help="bundles kept on disk; oldest deleted beyond "
                          "this")
+    st.add_argument("--tenant-config", default=None,
+                    dest="tenant_config", metavar="JSON_OR_PATH",
+                    help="per-tenant QoS policy enforced at the tier "
+                         "edge (same JSON language as serve "
+                         "--tenant-config): over-quota tenants get "
+                         "429 + Retry-After before their traffic "
+                         "reaches any replica, and the tenant id "
+                         "rides every forwarded attempt as the "
+                         "x-shellac-tenant header")
+    st.add_argument("--autoscale",
+                    action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="SLO-actuated autoscaler: a fast-burn SLO "
+                         "page scales out through the replica "
+                         "factory; sustained fleet idle drains the "
+                         "least-loaded replica — within the "
+                         "min/max envelope, one action per cooldown, "
+                         "every decision a recorder event + incident "
+                         "trigger. Scale-out needs a replica factory "
+                         "(programmatic embedders); without one the "
+                         "attempt is counted as failed. Default off: "
+                         "--no-autoscale tiers are bit-identical to "
+                         "pre-autoscaler builds")
+    st.add_argument("--autoscale-min", type=int, default=1,
+                    dest="autoscale_min",
+                    help="replica floor: idle never drains below this")
+    st.add_argument("--autoscale-max", type=int, default=4,
+                    dest="autoscale_max",
+                    help="replica ceiling: pages at the ceiling "
+                         "refuse (and keep paging) rather than grow")
+    st.add_argument("--autoscale-cooldown", type=float, default=60.0,
+                    dest="autoscale_cooldown",
+                    help="seconds after ANY action (or failed "
+                         "attempt) before the next; absorbs the "
+                         "previous action's effect before re-judging")
+    st.add_argument("--autoscale-idle-after", type=float,
+                    default=300.0, dest="autoscale_idle_after",
+                    help="continuous seconds of near-zero per-replica "
+                         "load before a scale-down drain")
     st.set_defaults(fn=cmd_serve_tier)
 
     tp = sub.add_parser(

@@ -1,0 +1,257 @@
+"""SLO-actuated autoscaler for the serving tier.
+
+The tier already owns every signal an autoscaler needs — the SLO
+burn-rate engine pages on fast error budget burn, the health sweep
+scrapes a per-replica load score, and `replica_factory` can mint
+capacity on demand (PR 8 wired it for crash respawn). What is missing
+is the POLICY that closes the loop: when a page lands, add a replica;
+when the fleet sits idle, drain one. This module is that policy and
+nothing else — it holds no sockets, spawns no threads, and reads no
+clocks it was not handed, so tests drive it tick-by-tick with a fake
+clock and fake actuators.
+
+Design rules (each one is a production scar):
+
+  hysteresis — load must stay above/below its threshold for
+    `hysteresis` CONSECUTIVE ticks before it counts. A single noisy
+    scrape (one replica answering /metrics late) must not buy a TPU.
+
+  cooldown — after any action, no further action for `cooldown_s`.
+    A scale-out takes time to absorb load (the new replica's cache is
+    cold); acting again before the last action's effect is visible
+    oscillates: out, still paging, out, out, recovered, drain, drain.
+
+  envelope — `min_replicas` and `max_replicas` bound the fleet
+    absolutely. A paging SLO at max does NOT scale out (the page keeps
+    firing — that is the operator's signal that the envelope is the
+    bottleneck); idle at min does not drain.
+
+  evidence — every decision (including refusals: at-max, in-cooldown)
+    is a flight-recorder event, and every ACTION additionally bumps
+    `shellac_autoscale_actions_total` and fires an incident trigger —
+    capacity changes are exactly the moments an incident review wants
+    the whole evidence surface frozen.
+
+The tier calls `on_slo_transition` from its SLOEngine hook and
+`tick()` from the health-poll cadence. `--no-autoscale` (the default)
+constructs nothing, so a tier without the flag is bit-identical to one
+predating this module.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass(frozen=True)
+class AutoscalePolicy:
+    """The operator-tunable envelope. Validated eagerly: a bad flag
+    must fail `serve-tier` startup, not the first page at 3am."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    cooldown_s: float = 60.0
+    # Sustained-idle drain: per-routable-replica load must stay at or
+    # under `idle_load` for `idle_after_s` continuous seconds.
+    idle_after_s: float = 300.0
+    idle_load: float = 0.5
+    # Load-pressure scale-out (the per-tenant gauges feed the tier's
+    # score): per-routable load must exceed `high_load` for
+    # `hysteresis` consecutive ticks. Pages bypass hysteresis — the
+    # burn-rate engine already smoothed them.
+    high_load: float = 16.0
+    hysteresis: int = 3
+
+    def __post_init__(self) -> None:
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.cooldown_s < 0:
+            raise ValueError("cooldown_s must be >= 0")
+        if self.idle_after_s <= 0:
+            raise ValueError("idle_after_s must be > 0")
+        if self.hysteresis < 1:
+            raise ValueError("hysteresis must be >= 1")
+        if self.high_load <= self.idle_load:
+            raise ValueError("high_load must exceed idle_load "
+                             "(the hysteresis band would be empty)")
+
+
+class Autoscaler:
+    """Policy engine: consumes SLO transitions + load observations,
+    emits at most one scale action per tick through injected
+    actuators.
+
+    `scale_out()` must add one replica and return its URL (or None if
+    the attempt failed — counted, retried next tick after cooldown).
+    `scale_down()` must pick and drain one replica and return its URL
+    (or None). `observe()` returns (routable_replicas, total_replicas,
+    aggregate_load_score) — the tier sums its per-replica scores.
+
+    NOT thread-safe by design: the tier calls every method from its
+    poller thread (`on_slo_transition` fires inside `slo.tick()`,
+    which the poller runs). Single-writer means no lock and no
+    lock-ordering story with the router's own locks.
+    """
+
+    def __init__(
+        self,
+        policy: AutoscalePolicy,
+        *,
+        scale_out: Callable[[], Optional[str]],
+        scale_down: Callable[[], Optional[str]],
+        observe: Callable[[], Any],
+        on_action: Optional[Callable[..., None]] = None,
+        now: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.policy = policy
+        self._scale_out = scale_out
+        self._scale_down = scale_down
+        self._observe = observe
+        self._on_action = on_action
+        self._now = now
+        t = now()
+        # Start IN cooldown: a tier that boots under load should let
+        # the fleet it was configured with serve for one cooldown
+        # before concluding it is undersized.
+        self._last_action_t: float = t
+        self._last_action: Optional[str] = None
+        self._last_url: Optional[str] = None
+        self._page_pending: Optional[str] = None  # paging SLO name
+        self._idle_since: Optional[float] = None
+        self._hot_ticks: int = 0
+        self._actions: int = 0
+        self._failures: int = 0
+
+    # ---- inputs ------------------------------------------------------
+
+    def on_slo_transition(self, name: str, old: str, new: str) -> None:
+        """SLOEngine hook. A page arms a scale-out (consumed by the
+        next tick outside cooldown); a recovery to ok disarms it —
+        paging five minutes ago is not a reason to buy capacity that
+        the budget burn already stopped needing."""
+        del old
+        if new == "page":
+            self._page_pending = name
+        elif new == "ok" and self._page_pending == name:
+            self._page_pending = None
+
+    # ---- the loop ----------------------------------------------------
+
+    def tick(self) -> Optional[str]:
+        """One policy evaluation. Returns the action taken
+        ("scale_out" | "scale_down") or None. At most one action per
+        tick; all the guard state (hysteresis, idle timer) still
+        advances on ticks that act or refuse."""
+        now = self._now()
+        routable, total, load = self._observe()
+        per = load / max(routable, 1)
+
+        # Advance the continuous-signal trackers every tick, even in
+        # cooldown — a cooldown must delay the ACTION, not reset the
+        # evidence that one is needed.
+        if per > self.policy.high_load:
+            self._hot_ticks += 1
+        else:
+            self._hot_ticks = 0
+        if per <= self.policy.idle_load and routable > 0:
+            if self._idle_since is None:
+                self._idle_since = now
+        else:
+            self._idle_since = None
+
+        if now - self._last_action_t < self.policy.cooldown_s:
+            return None
+
+        want_out = (self._page_pending is not None
+                    or self._hot_ticks >= self.policy.hysteresis)
+        if want_out:
+            if total >= self.policy.max_replicas:
+                self._emit("refused_at_max", None,
+                           reason=self._reason(), replicas=total)
+                # Consume the page: re-paging re-arms. Otherwise a
+                # fleet pinned at max re-logs the refusal every tick
+                # forever.
+                self._page_pending = None
+                self._hot_ticks = 0
+                return None
+            return self._act("scale_out", self._scale_out,
+                             now, total)
+
+        idle_for = (now - self._idle_since
+                    if self._idle_since is not None else 0.0)
+        if (self._idle_since is not None
+                and idle_for >= self.policy.idle_after_s
+                and routable > self.policy.min_replicas):
+            return self._act("scale_down", self._scale_down,
+                             now, total)
+        return None
+
+    def _act(self, action: str, fn: Callable[[], Optional[str]],
+             now: float, total: int) -> Optional[str]:
+        reason = self._reason() if action == "scale_out" else "idle"
+        url = None
+        try:
+            url = fn()
+        except Exception:  # noqa: BLE001 — an actuator fault (factory
+            # raised, drain POST refused) must not kill the poller;
+            # counted and retried after the cooldown.
+            url = None
+        if url is None:
+            self._failures += 1
+            self._emit(f"{action}_failed", None, reason=reason,
+                       replicas=total)
+            # Failed actions still start the cooldown: a broken
+            # factory hammered every tick is a respawn storm.
+            self._last_action_t = now
+            return None
+        self._actions += 1
+        self._last_action_t = now
+        self._last_action = action
+        self._last_url = url
+        if action == "scale_out":
+            self._page_pending = None
+            self._hot_ticks = 0
+        else:
+            self._idle_since = None
+        self._emit(action, url, reason=reason, replicas=total)
+        return action
+
+    def _reason(self) -> str:
+        if self._page_pending is not None:
+            return f"slo-page:{self._page_pending}"
+        return "load"
+
+    def _emit(self, action: str, url: Optional[str],
+              **detail: Any) -> None:
+        if self._on_action is not None:
+            try:
+                self._on_action(action, url, **detail)
+            except Exception:  # noqa: BLE001 — evidence emission is
+                pass           # best-effort; the decision already ran
+
+    # ---- introspection ----------------------------------------------
+
+    def status(self) -> Dict[str, Any]:
+        """The /stats + `top` payload. Pure reads, poller-thread
+        values — possibly one tick stale, never torn."""
+        now = self._now()
+        cooldown_left = max(
+            0.0, self.policy.cooldown_s - (now - self._last_action_t)
+        )
+        return {
+            "min_replicas": self.policy.min_replicas,
+            "max_replicas": self.policy.max_replicas,
+            "cooldown_s": self.policy.cooldown_s,
+            "cooldown_remaining_s": round(cooldown_left, 3),
+            "last_action": self._last_action,
+            "last_action_replica": self._last_url,
+            "page_pending": self._page_pending,
+            "hot_ticks": self._hot_ticks,
+            "idle_for_s": (round(now - self._idle_since, 3)
+                           if self._idle_since is not None else 0.0),
+            "actions": self._actions,
+            "failures": self._failures,
+        }

@@ -48,6 +48,7 @@ from shellac_tpu.inference.kvcache import (
     scatter_slot,
     slot_view,
 )
+from shellac_tpu.inference.qos import WeightedFairQueue
 from shellac_tpu.models import transformer
 from shellac_tpu.obs import EngineMetrics, get_registry
 from shellac_tpu.ops.sampling import NEG_INF, sample_batched
@@ -103,6 +104,21 @@ class _Request:
     # single-request Engine. Populated only when the engine was built
     # with logprobs=True; kept in lockstep with `out`.
     lps: List[float] = field(default_factory=list)
+    # Multi-tenant QoS: owning tenant id (None = untagged), priority
+    # class (inference/qos.py PRIORITY_CLASSES; lower = better) and
+    # DRR weight steering the weighted-fair pending queue, and the
+    # monotonic enqueue time the preemption driver reads wait ages
+    # from.
+    tenant: Optional[str] = None
+    qos_class: int = 1
+    qos_weight: float = 4.0
+    t_queued: float = 0.0
+    # Preempt-and-park: True while this mid-decode request is frozen
+    # in its slot awaiting export (frozen_decodes). Frozen slots never
+    # join decode windows and never settle through _finish_check —
+    # they leave through export_slot -> release_frozen, exactly like
+    # prefill_only freezes.
+    frozen: bool = False
 
     def hit_stop(self) -> Optional[int]:
         """Length of the matched stop suffix of `out`, or None."""
@@ -511,12 +527,22 @@ class BatchingEngine:
         # never branches on the kind).
         self._cache = self.cache_backend.init_cache()
         self._cur = jnp.zeros((n_slots,), jnp.int32)  # next input token
-        self._queue: deque[_Request] = deque()
+        # The pending queue is a weighted-fair queue over priority
+        # classes (deficit round robin on token costs). With a single
+        # class in play — every engine that never tags qos_class —
+        # it is FIFO-identical to the deque it replaced.
+        self._queue: WeightedFairQueue = WeightedFairQueue()
         self._slots: List[Optional[_Request]] = [None] * n_slots
         # Prefill-only requests whose prompt KV is resident and frozen,
         # awaiting export (rid -> slot). The serving scheduler drains
         # this after each step: export_slot -> release_frozen.
         self.frozen_prefills: Dict[Any, int] = {}
+        # Preempted mid-decode requests frozen in place awaiting
+        # export (rid -> slot). A SEPARATE table from frozen_prefills:
+        # the scheduler's export policies differ (prefill_only slots
+        # settle their client with a migration/park receipt; preempted
+        # slots keep their client attached across park -> resume).
+        self.frozen_decodes: Dict[Any, int] = {}
         self._prefill_jit: Dict[int, Any] = {}  # bucketed by padded S
         # Lazily built single-request Engine sharing these params:
         # the dense beam_search() entry point (the paged subclass
@@ -566,6 +592,9 @@ class BatchingEngine:
             "kv_exports": 0,
             "kv_imports": 0,
             "kv_bytes_per_token": self.cache_backend.bytes_per_token(),
+            # Multi-tenant QoS: mid-decode freezes ordered by the
+            # serving scheduler's preempt-and-park driver.
+            "preemptions": 0,
         }
         self.stats.update(self.cache_backend.initial_stats())
         # How decode_ticks was chosen: "fixed" (explicit int) or
@@ -1093,7 +1122,8 @@ class BatchingEngine:
                presence_penalty=None, frequency_penalty=None,
                prompt_logprobs=False, seed=None,
                constraint=None, trace=None,
-               prefill_only: bool = False) -> None:
+               prefill_only: bool = False,
+               tenant=None, qos_class=None, qos_weight=None) -> None:
         """Queue a request. `stop`: optional list of token-id sequences;
         generation ends when the output ends with any of them, and the
         matched sequence is removed from the returned tokens.
@@ -1208,13 +1238,29 @@ class BatchingEngine:
                 f"request {rid!r}: prefill_only does not compose with "
                 "constraint (the DFA table does not migrate)"
             )
+        if qos_class is not None:
+            qos_class = int(qos_class)
+            if qos_class < 0:
+                raise ValueError(
+                    f"request {rid!r}: qos_class must be >= 0"
+                )
+        if qos_weight is not None:
+            qos_weight = float(qos_weight)
+            if qos_weight <= 0:
+                raise ValueError(
+                    f"request {rid!r}: qos_weight must be > 0"
+                )
         self._queue.append(_Request(
             rid, tokens, max_new, stop=stop, min_tokens=min_tokens,
             logit_bias=logit_bias, presence_penalty=pres,
             frequency_penalty=freq,
             prompt_logprobs=bool(prompt_logprobs), seed=seed,
             constraint=constraint, trace=trace,
-            prefill_only=bool(prefill_only), **samp,
+            prefill_only=bool(prefill_only),
+            tenant=tenant if tenant is None else str(tenant),
+            qos_class=qos_class if qos_class is not None else 1,
+            qos_weight=qos_weight if qos_weight is not None else 4.0,
+            t_queued=time.monotonic(), **samp,
         ))
         if trace is not None:
             # Flight-recorder timeline: the request entered the
@@ -1714,11 +1760,13 @@ class BatchingEngine:
 
     def _finish_check(self, finished):
         for i, req in enumerate(self._slots):
-            if req is None or not req.out or req.prefill_only:
+            if req is None or not req.out or req.prefill_only \
+                    or req.frozen:
                 # Slots mid-chunked-prefill have no output yet; frozen
                 # prefill-only slots settle through the export path
                 # (even when the prefill token alone completes them —
-                # the blob carries the completion).
+                # the blob carries the completion); preempted frozen
+                # decodes leave through export_slot -> release_frozen.
                 continue
             last = req.out[-1]
             nstop = req.hit_stop()
@@ -1934,6 +1982,7 @@ class BatchingEngine:
         return [
             r is not None and i not in self._prefilling
             and i not in pending and not r.prefill_only
+            and not r.frozen
             for i, r in enumerate(self._slots)
         ]
 
@@ -2149,18 +2198,83 @@ class BatchingEngine:
                                       self._window_write_span())
 
     def release_frozen(self, rid) -> Optional[_Request]:
-        """Release a frozen prefill-only slot after its export (caller
-        must be the engine-owning thread — the same thread that froze
-        it). Returns the request, or None for an unknown rid. Device
-        rows need no repair: stale rows are self-healing, exactly as
-        on cancel."""
+        """Release a frozen slot (prefill-only OR preempted decode)
+        after its export (caller must be the engine-owning thread —
+        the same thread that froze it). Returns the request, or None
+        for an unknown rid. Device rows need no repair: stale rows are
+        self-healing, exactly as on cancel."""
         slot = self.frozen_prefills.pop(rid, None)
+        if slot is None:
+            slot = self.frozen_decodes.pop(rid, None)
         if slot is None:
             return None
         req = self._slots[slot]
         self._slots[slot] = None
         self._release_slot(slot)
         return req
+
+    def preemptable(self) -> List[Tuple[Any, int, int, int]]:
+        """(rid, slot, qos_class, resident_tokens) for every slot a
+        preemption could evict right now: occupied, actively decoding
+        (not frozen, not prefill-only, not mid-prefill), and carrying
+        only state the migration wire format can ship (no compiled
+        constraint). resident_tokens is the slot's physical KV
+        residency — multiply by the backend's bytes_per_token() for
+        the park-bytes cost the victim rule ranks on."""
+        pending = (self._pending_prefill_slots() if self._pflights
+                   else ())
+        out = []
+        for i, req in enumerate(self._slots):
+            if (req is None or req.prefill_only or req.frozen
+                    or i in self._prefilling or i in pending
+                    or req.constraint is not None or not req.out):
+                continue
+            resident = int(req.tokens.size) + max(len(req.out) - 1, 0)
+            out.append((req.rid, i, int(req.qos_class), resident))
+        return out
+
+    def preempt(self, rid) -> List[Tuple[Any, List[int]]]:
+        """Freeze an actively-decoding request in place so the caller
+        can export -> park -> release its slot (caller must be the
+        engine-owning thread). Mirrors the prefill_only freeze: the
+        device row gets its sticky done flag, the host excludes the
+        slot from decode windows and _finish_check, and the rid lands
+        in frozen_decodes.
+
+        In-flight pipelines (overlapped prefills and decode windows)
+        are settled FIRST so the host's `out` and the device KV agree
+        at the freeze point — anything that finished while draining is
+        returned exactly as step() results, for normal delivery. If
+        the target itself finished during the drain, nothing freezes
+        and the finished list carries its settlement."""
+        finished: List[Tuple[Any, List[int]]] = []
+        slot = next((i for i, r in enumerate(self._slots)
+                     if r is not None and r.rid == rid), None)
+        if slot is None:
+            raise ValueError(f"preempt: rid {rid!r} holds no slot")
+        req = self._slots[slot]
+        if req.prefill_only or req.frozen:
+            raise ValueError(f"preempt: rid {rid!r} is already frozen")
+        if slot in self._prefilling or (
+            self._pflights and slot in self._pending_prefill_slots()
+        ):
+            raise ValueError(f"preempt: rid {rid!r} is mid-prefill")
+        if self._pflights:
+            self._settle_prefills()
+            self._finish_check(finished)
+        while self._windows:
+            self._settle_window(finished)
+        if self._slots[slot] is not req:
+            return finished
+        self._sdone = self._sdone.at[slot].set(True)
+        req.frozen = True
+        self.frozen_decodes[rid] = slot
+        self.stats["preemptions"] += 1
+        if req.trace is not None:
+            req.trace.record("preempt", src="engine", rid=rid,
+                             slot=slot, n_out=len(req.out),
+                             qos_class=int(req.qos_class))
+        return finished
 
     def cancel(self, rid) -> bool:
         """Drop a queued or in-flight request (caller must be the
@@ -2171,6 +2285,7 @@ class BatchingEngine:
                 self._slots[i] = None
                 self._prefilling.pop(i, None)
                 self.frozen_prefills.pop(rid, None)
+                self.frozen_decodes.pop(rid, None)
                 self._release_slot(i)
                 self.finished_logprobs.pop(rid, None)
                 self.finished_prompt_logprobs.pop(rid, None)
@@ -2225,6 +2340,7 @@ class BatchingEngine:
             self._release_slot(i)
         self._prefilling.clear()
         self.frozen_prefills.clear()
+        self.frozen_decodes.clear()
         self.finished_logprobs.clear()
         self.finished_prompt_logprobs.clear()
         self.finished_top_logprobs.clear()

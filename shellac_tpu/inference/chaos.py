@@ -22,7 +22,15 @@ the failure classes a multi-replica tier actually meets:
   - `LoadGenerator`: sustained closed-loop non-streaming traffic with
     per-request deadlines, counting outcomes — the background load the
     acceptance scenarios (kill under load, drain under load) assert
-    "zero failures" against.
+    "zero failures" against. Payloads may carry a reserved `tenant`
+    key (sent as the x-shellac-tenant header, never in the body), and
+    the tally splits per tenant — the starvation scenarios assert
+    "the interactive tenant saw zero rejections" directly against it.
+    The shape helpers (`zipf_tenant_mix`, `abusive_burst_mix`,
+    `interactive_batch_mix`) build multi-tenant payload lists with the
+    traffic skews real fleets meet: Zipf tenant popularity, one
+    abusive tenant at N× everyone else, and an interactive-vs-batch
+    class split.
 
 Injectors never reach into `TierRouter` or `InferenceServer`
 internals; docs/serving_tier.md documents the contract they exercise.
@@ -40,9 +48,12 @@ import subprocess
 import sys
 import threading
 import time
+import random
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
+
+from shellac_tpu.inference.qos import TENANT_HEADER
 
 
 class ChaosProxy:
@@ -347,21 +358,31 @@ class LoadGenerator:
         self.concurrency = concurrency
         self.timeout = timeout
         self.counts: Dict[str, int] = {}
+        # Per-tenant outcome split (only for payloads that carried a
+        # `tenant` key): {tenant: {outcome: count}}.
+        self.by_tenant: Dict[str, Dict[str, int]] = {}
         self.errors: List[str] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
-    def _tally(self, key: str, detail: str = "") -> None:
+    def _tally(self, key: str, detail: str = "",
+               tenant: Optional[str] = None) -> None:
         with self._lock:
             self.counts[key] = self.counts.get(key, 0) + 1
+            if tenant is not None:
+                per = self.by_tenant.setdefault(tenant, {})
+                per[key] = per.get(key, 0) + 1
             if detail and len(self.errors) < 50:
                 self.errors.append(detail)
 
-    def _one(self, body: bytes) -> None:
+    def _one(self, body: bytes,
+             tenant: Optional[str] = None) -> None:
+        headers = {"Content-Type": "application/json"}
+        if tenant is not None:
+            headers[TENANT_HEADER] = tenant
         req = urllib.request.Request(
-            self.base_url + self.path, data=body,
-            headers={"Content-Type": "application/json"},
+            self.base_url + self.path, data=body, headers=headers,
         )
         try:
             # Read timeout sits above the request deadline so the TIER
@@ -369,22 +390,27 @@ class LoadGenerator:
             with urllib.request.urlopen(req,
                                         timeout=self.timeout + 15) as r:
                 r.read()
-                self._tally("ok" if r.status == 200 else f"http_{r.status}")
+                self._tally("ok" if r.status == 200
+                            else f"http_{r.status}", tenant=tenant)
         except urllib.error.HTTPError as e:
             detail = ""
             try:
                 detail = e.read().decode(errors="replace")[:200]
             except OSError:
                 pass
-            self._tally(f"http_{e.code}", f"{e.code}: {detail}")
+            self._tally(f"http_{e.code}", f"{e.code}: {detail}",
+                        tenant=tenant)
         except (OSError, urllib.error.URLError) as e:
-            self._tally("connect_error", repr(e))
+            self._tally("connect_error", repr(e), tenant=tenant)
 
     def _loop(self, idx: int) -> None:
-        payload = self.payloads[idx % len(self.payloads)]
+        payload = dict(self.payloads[idx % len(self.payloads)])
+        # Reserved key, not a sampling knob: rides as the tenant
+        # header, never in the replica-bound JSON body.
+        tenant = payload.pop("tenant", None)
         body = json.dumps({**payload, "timeout": self.timeout}).encode()
         while not self._stop.is_set():
-            self._one(body)
+            self._one(body, tenant=tenant)
 
     def start(self) -> "LoadGenerator":
         for i in range(self.concurrency):
@@ -406,3 +432,65 @@ class LoadGenerator:
     def total(self) -> int:
         with self._lock:
             return sum(self.counts.values())
+
+
+# ---- multi-tenant traffic shapes ------------------------------------
+# Payload-list builders for LoadGenerator(payloads=...): each entry is
+# one worker's steady request, with the reserved `tenant` key naming
+# who it bills to. Deterministic (seeded) so a chaos run's tenant mix
+# is reproducible run-to-run.
+
+def zipf_tenant_mix(tenants: List[str], concurrency: int,
+                    s: float = 1.2, seed: int = 7,
+                    max_new: int = 4) -> List[dict]:
+    """Zipf tenant popularity: worker i's tenant is drawn with
+    P(rank r) ∝ 1/r^s over `tenants` (list order = popularity rank) —
+    the heavy-head/long-tail skew real multi-tenant fleets see, where
+    one tenant dominates and most barely show up."""
+    if not tenants:
+        raise ValueError("zipf_tenant_mix needs at least one tenant")
+    rng = random.Random(seed)
+    weights = [1.0 / (r + 1) ** s for r in range(len(tenants))]
+    out = []
+    for i in range(max(1, concurrency)):
+        t = rng.choices(tenants, weights=weights)[0]
+        out.append({"tokens": [1 + i, 2 + i, 3 + i],
+                    "max_new": max_new, "tenant": t})
+    return out
+
+
+def abusive_burst_mix(victim: str, abuser: str, concurrency: int,
+                      abuse_ratio: int = 10,
+                      max_new: int = 4) -> List[dict]:
+    """One well-behaved tenant vs one abusive tenant flooding at
+    ~abuse_ratio× its worker share — the starvation scenario: the
+    assertion is that `victim`'s tally stays clean (zero rejections,
+    p99 within SLO) while `abuser` eats 429s."""
+    if concurrency < abuse_ratio + 1:
+        concurrency = abuse_ratio + 1
+    out = []
+    for i in range(concurrency):
+        t = victim if i % (abuse_ratio + 1) == 0 else abuser
+        out.append({"tokens": [1 + i, 2 + i, 3 + i],
+                    "max_new": max_new, "tenant": t})
+    return out
+
+
+def interactive_batch_mix(interactive: str, batch: str,
+                          concurrency: int,
+                          batch_max_new: int = 32) -> List[dict]:
+    """Interactive-vs-batch class split: short interactive requests
+    interleaved with long-decode batch requests — the mix where
+    weighted-fair scheduling and preempt-and-park earn their keep
+    (without them, one batch tenant's long decodes monopolize the
+    slots and interactive TTFT collapses)."""
+    out = []
+    for i in range(max(2, concurrency)):
+        if i % 2 == 0:
+            out.append({"tokens": [1 + i, 2 + i, 3 + i],
+                        "max_new": 2, "tenant": interactive})
+        else:
+            out.append({"tokens": [1 + i, 2 + i, 3 + i, 4 + i,
+                                   5 + i, 6 + i],
+                        "max_new": batch_max_new, "tenant": batch})
+    return out

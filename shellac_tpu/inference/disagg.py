@@ -299,7 +299,13 @@ def export_slot(engine, slot: int, req,
     _check_exportable(engine)
     backend = engine.cache_backend
     state, complete = _request_state(req, engine.eos_id)
-    length = int(req.tokens.size)
+    # Physical KV residency: after n_out emitted tokens the slot holds
+    # the prompt plus (n_out - 1) generated positions — the latest
+    # token lives in _cur and writes its KV on the NEXT decode tick.
+    # At the prefill_only freeze (n_out == 1) this is exactly the old
+    # prompt-length export; a mid-decode preemption export ships the
+    # decoded positions too.
+    length = int(req.tokens.size) + max(len(req.out) - 1, 0)
     header: Dict[str, Any] = {
         "backend": backend.name,
         "kv_quant": engine.kv_quant,

@@ -1,0 +1,494 @@
+"""Multi-tenant QoS primitives: tenant identity, admission quotas, and
+weighted-fair queueing.
+
+Three layers, one module, zero jax:
+
+- **Tenant identity.** A request's tenant id arrives as the
+  ``x-shellac-tenant`` header (or the OpenAI ``user`` field) and
+  defaults to ``anonymous``. `TenantPolicy` maps tenant ids to
+  `TenantSpec`s (rate, burst, max_concurrency, priority class, weight)
+  parsed from ``--tenant-config`` JSON — unknown tenants fall to the
+  ``default`` spec, so one flooding client can never consume another
+  tenant's admission budget.
+
+- **Admission.** `AdmissionController` enforces each tenant's token
+  bucket (rate/burst over estimated tokens = prompt + max_new) and
+  concurrency quota. Over-quota answers are (reason, retry_after)
+  pairs the server turns into 429 + jittered Retry-After; admitted
+  requests hold a concurrency lease the caller releases at settle.
+
+- **Scheduling.** `WeightedFairQueue` is a drop-in replacement for the
+  engine's FIFO pending deque: deficit-round-robin over priority-class
+  lanes, cost measured in tokens, each lane's quantum scaled by the
+  waiting request's weight. With a single class in play it degenerates
+  to FIFO exactly — the pre-QoS engine order, bit for bit.
+
+The cost model follows the characterize-don't-guess discipline: the
+bucket charges measured token counts and the preemption victim rule
+(server-side) ranks by `bytes_per_token()`-measured resident bytes,
+never by guessed request "sizes".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+#: HTTP header carrying the tenant id (the `x-shellac-trace` twin —
+#: forwarded by the tier on every retry attempt).
+TENANT_HEADER = "x-shellac-tenant"
+
+#: The tenant id of requests that declare none.
+ANONYMOUS = "anonymous"
+
+#: Priority classes, best-first. Lower value = scheduled sooner and
+#: never preempted by a lower class.
+PRIORITY_CLASSES = {"interactive": 0, "standard": 1, "batch": 2}
+CLASS_NAMES = {v: k for k, v in PRIORITY_CLASSES.items()}
+
+#: Default DRR weight per class (token-share ratio 8:4:1).
+DEFAULT_WEIGHTS = {"interactive": 8.0, "standard": 4.0, "batch": 1.0}
+
+DEFAULT_CLASS = "standard"
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's quota + scheduling contract. `None` rate or
+    max_concurrency means unlimited (the seed behavior)."""
+
+    name: str
+    rate: Optional[float] = None  # tokens/second refill
+    burst: Optional[float] = None  # bucket depth, tokens
+    max_concurrency: Optional[int] = None
+    priority: str = DEFAULT_CLASS
+    weight: Optional[float] = None  # DRR weight; None = class default
+
+    @property
+    def qos_class(self) -> int:
+        return PRIORITY_CLASSES[self.priority]
+
+    @property
+    def qos_weight(self) -> float:
+        if self.weight is not None:
+            return float(self.weight)
+        return DEFAULT_WEIGHTS[self.priority]
+
+
+def _parse_spec(name: str, raw: Any) -> TenantSpec:
+    if not isinstance(raw, dict):
+        raise ValueError(
+            f"tenant-config[{name!r}]: expected an object, got "
+            f"{type(raw).__name__}"
+        )
+    unknown = set(raw) - {"rate", "burst", "max_concurrency",
+                          "priority", "weight"}
+    if unknown:
+        raise ValueError(
+            f"tenant-config[{name!r}]: unknown keys {sorted(unknown)} "
+            "(allowed: rate, burst, max_concurrency, priority, weight)"
+        )
+    rate = raw.get("rate")
+    burst = raw.get("burst")
+    maxc = raw.get("max_concurrency")
+    prio = raw.get("priority", DEFAULT_CLASS)
+    weight = raw.get("weight")
+    if rate is not None:
+        rate = float(rate)
+        if rate <= 0:
+            raise ValueError(
+                f"tenant-config[{name!r}]: rate must be > 0 tokens/s "
+                "(omit it for unlimited)"
+            )
+    if burst is not None:
+        burst = float(burst)
+        if burst <= 0:
+            raise ValueError(
+                f"tenant-config[{name!r}]: burst must be > 0 tokens"
+            )
+    if rate is not None and burst is None:
+        # A rate with no declared depth gets one second of headroom —
+        # enough to admit a request at the steady rate.
+        burst = rate
+    if burst is not None and rate is None:
+        raise ValueError(
+            f"tenant-config[{name!r}]: burst without rate is "
+            "meaningless (the bucket would never refill)"
+        )
+    if maxc is not None:
+        maxc = int(maxc)
+        if maxc < 1:
+            raise ValueError(
+                f"tenant-config[{name!r}]: max_concurrency must be "
+                ">= 1 (omit it for unlimited)"
+            )
+    if prio not in PRIORITY_CLASSES:
+        raise ValueError(
+            f"tenant-config[{name!r}]: unknown priority {prio!r} "
+            f"(one of {sorted(PRIORITY_CLASSES)})"
+        )
+    if weight is not None:
+        weight = float(weight)
+        if weight <= 0:
+            raise ValueError(
+                f"tenant-config[{name!r}]: weight must be > 0"
+            )
+    return TenantSpec(name, rate=rate, burst=burst,
+                      max_concurrency=maxc, priority=prio,
+                      weight=weight)
+
+
+class TenantPolicy:
+    """The parsed ``--tenant-config``: named tenant specs plus the
+    ``default`` spec unknown tenants inherit (quota-free standard
+    class when the config names none)."""
+
+    def __init__(self, specs: Dict[str, TenantSpec],
+                 default: Optional[TenantSpec] = None):
+        self.specs = dict(specs)
+        self.default = default or TenantSpec("default")
+
+    @classmethod
+    def parse(cls, raw: Any) -> "TenantPolicy":
+        """Build from the ``--tenant-config`` JSON: an object mapping
+        tenant id -> spec object. The id ``default`` configures the
+        fallback for unnamed tenants. Raises ValueError on any
+        malformed entry — admission never guesses at a quota."""
+        if isinstance(raw, (str, bytes)):
+            try:
+                raw = json.loads(raw)
+            except ValueError as e:
+                raise ValueError(f"tenant-config: bad JSON: {e}")
+        if not isinstance(raw, dict):
+            raise ValueError(
+                "tenant-config: expected a JSON object mapping tenant "
+                "id -> {rate, burst, max_concurrency, priority, weight}"
+            )
+        if "tenants" in raw and isinstance(raw["tenants"], dict):
+            raw = raw["tenants"]
+        specs: Dict[str, TenantSpec] = {}
+        default = None
+        for name, entry in raw.items():
+            if not isinstance(name, str) or not name:
+                raise ValueError("tenant-config: tenant ids must be "
+                                 "non-empty strings")
+            spec = _parse_spec(name, entry)
+            if name == "default":
+                default = spec
+            else:
+                specs[name] = spec
+        return cls(specs, default)
+
+    def spec(self, tenant: Optional[str]) -> TenantSpec:
+        t = tenant or ANONYMOUS
+        got = self.specs.get(t)
+        if got is not None:
+            return got
+        d = self.default
+        # The fallback keeps each unknown tenant's OWN bucket (keyed
+        # by its id) but the default's limits.
+        return TenantSpec(t, rate=d.rate, burst=d.burst,
+                          max_concurrency=d.max_concurrency,
+                          priority=d.priority, weight=d.weight)
+
+
+class TokenBucket:
+    """A monotonic-clock token bucket. Not thread-safe on its own —
+    the AdmissionController serializes access."""
+
+    __slots__ = ("rate", "burst", "tokens", "_t")
+
+    def __init__(self, rate: float, burst: float,
+                 now: Optional[float] = None):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self._t = time.monotonic() if now is None else now
+
+    def _refill(self, now: float) -> None:
+        if now > self._t:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._t) * self.rate)
+        self._t = now
+
+    def try_take(self, cost: float,
+                 now: Optional[float] = None) -> Tuple[bool, float]:
+        """(admitted?, seconds until `cost` tokens WILL be available).
+        The retry hint is exact for this bucket alone; callers jitter
+        it before putting it on the wire."""
+        now = time.monotonic() if now is None else now
+        self._refill(now)
+        if self.tokens >= cost:
+            self.tokens -= cost
+            return True, 0.0
+        need = min(cost, self.burst) - self.tokens
+        return False, need / self.rate
+
+
+class AdmissionController:
+    """Per-tenant token buckets + concurrency leases, shared by the
+    server's admission path and the tier's edge check. Thread-safe."""
+
+    def __init__(self, policy: TenantPolicy):
+        self.policy = policy
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._inflight: Dict[str, int] = {}
+        # Rolling per-tenant counters for /stats and `top` (admission
+        # totals live in the metrics registry; these are the cheap
+        # always-on snapshot).
+        self.admitted: Dict[str, int] = {}
+        self.throttled: Dict[str, int] = {}
+
+    def admit(self, tenant: Optional[str], cost: float,
+              now: Optional[float] = None
+              ) -> Tuple[bool, Optional[str], float]:
+        """(admitted?, throttle reason, retry_after seconds). On
+        admission the tenant holds one concurrency lease — release()
+        it at settle, NOT at response write (streamed bodies outlive
+        the handler)."""
+        spec = self.policy.spec(tenant)
+        t = spec.name
+        with self._lock:
+            inflight = self._inflight.get(t, 0)
+            if (spec.max_concurrency is not None
+                    and inflight >= spec.max_concurrency):
+                self.throttled[t] = self.throttled.get(t, 0) + 1
+                return False, "concurrency", 1.0
+            if spec.rate is not None:
+                bucket = self._buckets.get(t)
+                if bucket is None or bucket.rate != spec.rate \
+                        or bucket.burst != spec.burst:
+                    bucket = TokenBucket(spec.rate, spec.burst, now=now)
+                    self._buckets[t] = bucket
+                ok, wait = bucket.try_take(cost, now=now)
+                if not ok:
+                    self.throttled[t] = self.throttled.get(t, 0) + 1
+                    return False, "rate", wait
+            self._inflight[t] = inflight + 1
+            self.admitted[t] = self.admitted.get(t, 0) + 1
+            return True, None, 0.0
+
+    def release(self, tenant: Optional[str]) -> None:
+        t = self.policy.spec(tenant).name
+        with self._lock:
+            n = self._inflight.get(t, 0) - 1
+            if n > 0:
+                self._inflight[t] = n
+            else:
+                self._inflight.pop(t, None)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant view for /stats and the `top` tenants panel."""
+        with self._lock:
+            tenants = (set(self._inflight) | set(self.admitted)
+                       | set(self.throttled))
+            out = {}
+            for t in sorted(tenants):
+                spec = self.policy.spec(t)
+                out[t] = {
+                    "inflight": self._inflight.get(t, 0),
+                    "admitted": self.admitted.get(t, 0),
+                    "throttled": self.throttled.get(t, 0),
+                    "priority": spec.priority,
+                    "weight": spec.qos_weight,
+                }
+            return out
+
+
+# ---------------------------------------------------------------------
+# Weighted-fair queue (deficit round robin over priority-class lanes)
+# ---------------------------------------------------------------------
+
+
+def _default_classify(item: Any) -> int:
+    return int(getattr(item, "qos_class", PRIORITY_CLASSES[DEFAULT_CLASS]))
+
+
+def _default_weight(item: Any) -> float:
+    return float(getattr(item, "qos_weight",
+                         DEFAULT_WEIGHTS[DEFAULT_CLASS]))
+
+
+def _default_cost(item: Any) -> float:
+    tokens = getattr(item, "tokens", None)
+    size = getattr(tokens, "size", None)
+    if size is None:
+        size = len(tokens) if tokens is not None else 0
+    return float(size) + float(getattr(item, "max_new", 0))
+
+
+class WeightedFairQueue:
+    """Deficit-round-robin pending queue, API-compatible with the
+    deque the engine used (append/appendleft/popleft/pop/remove/clear/
+    len/iter/bool), so every existing caller — admission fill, cancel,
+    abort_all, the migration importer's submit-then-pop — works
+    unmodified.
+
+    Lanes are priority classes (lower class drains first when deficits
+    tie by construction: the rotation starts each round at the best
+    class). Each lane visit adds `quantum x head-item weight` to the
+    lane's deficit; a head whose token cost fits the deficit is served
+    and the pointer stays on the lane. One lane in play = plain FIFO.
+
+    `appendleft` is the admission path's put-back (PoolExhausted):
+    returned items are handed back before any DRR decision, preserving
+    the engine's exact retry-first contract. `pop` removes the most
+    recently appended item — the migration importer's contract."""
+
+    def __init__(self, quantum: float = 256.0,
+                 classify: Callable[[Any], int] = _default_classify,
+                 weight: Callable[[Any], float] = _default_weight,
+                 cost: Callable[[Any], float] = _default_cost):
+        self.quantum = float(quantum)
+        self._classify = classify
+        self._weight = weight
+        self._cost = cost
+        self._lanes: Dict[int, List[Tuple[int, Any]]] = {}
+        self._deficit: Dict[int, float] = {}
+        self._returned: List[Tuple[int, Any]] = []
+        self._seq = 0
+        self._cursor: Optional[int] = None
+
+    # ---- deque API ---------------------------------------------------
+
+    def append(self, item: Any) -> None:
+        self._seq += 1
+        self._lanes.setdefault(self._classify(item), []).append(
+            (self._seq, item)
+        )
+
+    def appendleft(self, item: Any) -> None:
+        # Put-backs re-dispense FIFO among themselves (oldest first):
+        # the engine only ever puts back the single item it just
+        # popped, so insert at the front.
+        self._seq += 1
+        self._returned.insert(0, (self._seq, item))
+
+    def popleft(self) -> Any:
+        if self._returned:
+            return self._returned.pop(0)[1]
+        lanes = sorted(k for k, v in self._lanes.items() if v)
+        if not lanes:
+            raise IndexError("pop from an empty WeightedFairQueue")
+        if len(lanes) == 1:
+            # FIFO degeneracy: no competition, no deficit accounting.
+            k = lanes[0]
+            entry = self._lanes[k].pop(0)
+            self._postpop(k)
+            return entry[1]
+        # DRR: resume at the cursor lane if it still has deficit
+        # standing, else rotate, topping deficits up per visit. Each
+        # full rotation adds at least one quantum to every nonempty
+        # lane, so the loop always terminates with a serve.
+        if self._cursor not in lanes:
+            self._cursor = lanes[0]
+        start = lanes.index(self._cursor)
+        i = start
+        while True:
+            k = lanes[i % len(lanes)]
+            head = self._lanes[k][0][1]
+            c = self._cost(head)
+            if self._deficit.get(k, 0.0) >= c:
+                entry = self._lanes[k].pop(0)
+                self._deficit[k] = self._deficit.get(k, 0.0) - c
+                self._cursor = k
+                self._postpop(k)
+                return entry[1]
+            # Not enough deficit: top this lane up and move on. The
+            # top-up happens on the visit (classic DRR), scaled by the
+            # head's weight so heavier tenants accumulate service
+            # credit faster.
+            self._deficit[k] = (self._deficit.get(k, 0.0)
+                                + self.quantum * self._weight(head))
+            i += 1
+
+    def pop(self) -> Any:
+        """Remove and return the MOST RECENTLY APPENDED item (the
+        importer's submit-then-pop contract)."""
+        best_k, best_seq = None, -1
+        for k, lane in self._lanes.items():
+            if lane and lane[-1][0] > best_seq:
+                best_k, best_seq = k, lane[-1][0]
+        if self._returned and self._returned[-1][0] > best_seq:
+            return self._returned.pop()[1]
+        if best_k is None:
+            raise IndexError("pop from an empty WeightedFairQueue")
+        entry = self._lanes[best_k].pop()
+        self._postpop(best_k)
+        return entry[1]
+
+    def remove(self, item: Any) -> None:
+        for lane in ([self._returned]
+                     + [self._lanes[k] for k in list(self._lanes)]):
+            for i, (_, it) in enumerate(lane):
+                if it is item or it == item:
+                    del lane[i]
+                    self._prune()
+                    return
+        raise ValueError("WeightedFairQueue.remove(x): x not in queue")
+
+    def clear(self) -> None:
+        self._lanes.clear()
+        self._deficit.clear()
+        self._returned.clear()
+        self._cursor = None
+
+    def __len__(self) -> int:
+        return (len(self._returned)
+                + sum(len(v) for v in self._lanes.values()))
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def __iter__(self) -> Iterator[Any]:
+        for _, item in list(self._returned):
+            yield item
+        for k in sorted(self._lanes):
+            for _, item in list(self._lanes[k]):
+                yield item
+
+    # ---- QoS extras --------------------------------------------------
+
+    def _postpop(self, k: int) -> None:
+        if not self._lanes.get(k):
+            # Standard DRR: an emptied lane forfeits its deficit (an
+            # idle class must not bank credit against future rounds).
+            self._deficit.pop(k, None)
+            self._prune()
+
+    def _prune(self) -> None:
+        for k in [k for k, v in self._lanes.items() if not v]:
+            del self._lanes[k]
+            self._deficit.pop(k, None)
+        if self._cursor is not None and self._cursor not in self._lanes:
+            self._cursor = None
+
+    def best_waiting(self) -> Optional[Tuple[int, Any]]:
+        """(class, head item) of the best-priority nonempty lane —
+        the preemption driver's 'who is being starved' probe. Put-back
+        items count as their own class."""
+        best: Optional[Tuple[int, Any]] = None
+        if self._returned:
+            item = self._returned[0][1]
+            best = (self._classify(item), item)
+        for k in sorted(self._lanes):
+            if self._lanes[k] and (best is None or k < best[0]):
+                best = (k, self._lanes[k][0][1])
+                break
+        return best
+
+    def depths(self) -> Dict[int, int]:
+        """Waiting count per class (put-backs attributed to their own
+        class) — the /stats scheduling snapshot."""
+        d: Dict[int, int] = {}
+        for _, item in self._returned:
+            k = self._classify(item)
+            d[k] = d.get(k, 0) + 1
+        for k, lane in self._lanes.items():
+            if lane:
+                d[k] = d.get(k, 0) + len(lane)
+        return d

@@ -89,6 +89,13 @@ from shellac_tpu.config import ModelConfig
 from shellac_tpu.inference import disagg, fabric
 from shellac_tpu.inference.batching import BatchingEngine
 from shellac_tpu.inference.cache import PoolExhausted
+from shellac_tpu.inference.qos import (
+    ANONYMOUS,
+    CLASS_NAMES,
+    TENANT_HEADER,
+    AdmissionController,
+    TenantPolicy,
+)
 from shellac_tpu.obs import (
     REQUEST_ID_HEADER,
     TRACE_HEADER,
@@ -209,11 +216,22 @@ class _ImportAck:
 
 class _Pending:
     __slots__ = ("event", "result", "error", "chunks", "emitted", "holdback",
-                 "lps", "plp", "tlp", "rid", "deadline", "kind", "trace")
+                 "lps", "plp", "tlp", "rid", "deadline", "kind", "trace",
+                 "tenant", "on_finish")
 
     def __init__(self, rid, stream: bool = False, holdback: int = 0,
-                 deadline: Optional[float] = None, trace=None):
+                 deadline: Optional[float] = None, trace=None,
+                 tenant: Optional[str] = None):
         self.rid = rid
+        # Tenant id the request carried (None when untenanted):
+        # surfaces in /debug/requests and labels the QoS counters.
+        self.tenant = tenant
+        # Settlement hook, invoked exactly once by finish() — the one
+        # choke point every settle path (finish/shed/cancel/fault/
+        # sweep) already goes through. Releases the tenant's
+        # concurrency lease, so a request that dies on ANY path frees
+        # its admission slot.
+        self.on_finish: Optional[Callable[[], None]] = None
         # Observability span (obs.RequestTrace): created at admission,
         # handed to the engine for the prefill/first-token marks, and
         # settled wherever the request settles (finish/shed/abort).
@@ -245,6 +263,9 @@ class _Pending:
         self.tlp = None  # per-token top-K alternatives ((ids, lps) pairs)
 
     def finish(self):
+        cb, self.on_finish = self.on_finish, None
+        if cb is not None:
+            cb()
         if self.chunks is not None:
             self.chunks.put(None)
         self.event.set()
@@ -283,6 +304,8 @@ class InferenceServer:
         incident_capture_seconds: float = 0.0,
         park_dir: Optional[str] = None,
         park_max_bytes: int = 256 << 20,
+        tenant_config: Optional[Any] = None,
+        preempt_after: Optional[float] = None,
         **engine_kw,
     ):
         if role not in ROLES:
@@ -448,6 +471,32 @@ class InferenceServer:
                       if park_dir else None)
         self._push_pool: Optional[
             concurrent.futures.ThreadPoolExecutor] = None
+        # Multi-tenant QoS (serve --tenant-config / --preempt-after).
+        # The policy parses BEFORE the scheduler thread starts, so a
+        # malformed config is a construction-time ValueError, not a
+        # mystery 500 later. Without a config there is no admission
+        # controller and no per-tenant gating — tenant ids still ride
+        # traces/metrics, but behavior is bit-identical to before.
+        self._tenant_policy = (TenantPolicy.parse(tenant_config)
+                               if tenant_config is not None else None)
+        self._qos_admission = (AdmissionController(self._tenant_policy)
+                               if self._tenant_policy is not None
+                               else None)
+        if preempt_after is not None and preempt_after <= 0:
+            raise ValueError("preempt_after must be > 0 seconds")
+        self._preempt_after = preempt_after
+        # Preempted victims awaiting resume: rid -> (MigrationBlob,
+        # tenant, trace_id). Scheduler-thread-only. The blob stays
+        # in-memory (resume is same-replica and latency-sensitive); a
+        # safety copy goes to the park spool asynchronously when one
+        # is configured, so a SIGKILL mid-park still leaves the fleet
+        # a resumable artifact.
+        self._preempted: "OrderedDict[int, Tuple[Any, Optional[str], str, int]]" = (
+            OrderedDict()
+        )
+        # Parked preempted bytes by resolved tenant (scheduler-thread
+        # bookkeeping behind the shellac_tenant_parked_bytes gauge).
+        self._parked_tenant_bytes: Dict[str, int] = {}
         # Startup auto-tune (serve --decode-ticks auto, the CLI
         # default): sweep decode_ticks against the live engine BEFORE
         # the scheduler thread exists (the engine is single-owner
@@ -595,6 +644,26 @@ class InferenceServer:
         m.pending.set(len(self._pending))
         return self._registry.render()
 
+    def qos_snapshot(self) -> Dict[str, Any]:
+        """Multi-tenant QoS state for /stats and `top`: per-tenant
+        admission counters, the weighted-fair queue's per-class
+        depths, and parked preemption state. Cheap host reads only —
+        possibly stale, never torn, never a device sync."""
+        out: Dict[str, Any] = {}
+        if self._qos_admission is not None:
+            out["tenants"] = self._qos_admission.snapshot()
+        q = getattr(self._g.engine, "_queue", None)
+        if hasattr(q, "depths"):
+            out["queue_depths"] = {
+                CLASS_NAMES.get(k, str(k)): v
+                for k, v in q.depths().items()
+            }
+        if self._preempt_after is not None:
+            out["preempt_after_s"] = self._preempt_after
+            out["parked_victims"] = len(self._preempted)
+            out["parked_bytes"] = dict(self._parked_tenant_bytes)
+        return out
+
     def latency_summary(self) -> Dict[str, Any]:
         """p50/p90/p99 digests (seconds) of the request-span histograms
         for /stats — derived from the same series /metrics exposes, so
@@ -638,9 +707,11 @@ class InferenceServer:
                 "rid": rid,
                 "trace_id": getattr(t, "trace_id", None),
                 "slot": slot,
-                "state": ("queued" if slot is None
+                "state": ("parked" if rid in self._preempted
+                          else "queued" if slot is None
                           else "prefilling" if slot in prefilling
                           else "decoding"),
+                "tenant": p.tenant,
                 "stream": p.chunks is not None,
                 "age_s": (round(now - t.t_submit, 3)
                           if t is not None else None),
@@ -1133,6 +1204,13 @@ class InferenceServer:
 
     def _process_item(self, g: _Generation, item) -> None:
         rid, tokens, max_new, stop, samp, deadline = item
+        qos = None
+        if samp and "_qos" in samp:
+            # Tenant identity + scheduling class resolved at admission;
+            # popped here so the engine's sampling-kwargs whitelist
+            # never sees the marker.
+            samp = dict(samp)
+            qos = samp.pop("_qos")
         if tokens is None:
             # Cancellation marker: drop queued/in-flight work for an
             # abandoned client request.
@@ -1176,6 +1254,14 @@ class InferenceServer:
             self._export_chain_item(g, *samp["_kv_export_chain"])
             return
         extra = {}
+        if qos is not None:
+            tenant, qcls, qweight = qos
+            if tenant is not None:
+                extra["tenant"] = tenant
+            if qcls is not None:
+                extra["qos_class"] = qcls
+            if qweight is not None:
+                extra["qos_weight"] = qweight
         if samp and "_migrate" in samp:
             # Prefill-only admission (prefill replica): the engine
             # freezes the slot at prefill; _service_frozen exports it
@@ -1537,6 +1623,180 @@ class InferenceServer:
                         pp.trace.abort("cancelled")
                     pp.finish()
 
+    # ---- preempt-and-park (multi-tenant QoS) ------------------------
+
+    @staticmethod
+    def _free_slot_available(engine) -> bool:
+        return any(
+            r is None and i not in engine._prefilling
+            for i, r in enumerate(engine._slots)
+        )
+
+    def _maybe_preempt(self, g: _Generation) -> None:
+        """Preempt-and-park: when the best-priority waiting request has
+        waited past --preempt-after and every slot is busy, freeze the
+        cheapest strictly-lower-class victim mid-decode, export its KV,
+        and free the slot so the step that follows seats the waiter.
+        The victim's client stays attached — _resume_preempted() later
+        re-places the KV in a free slot and decoding continues
+        token-identical (greedy and seeded sampling both derive their
+        keys from position, not a shared stream)."""
+        if self._preempt_after is None:
+            return
+        engine = g.engine
+        q = getattr(engine, "_queue", None)
+        best = q.best_waiting() if hasattr(q, "best_waiting") else None
+        if best is None:
+            return
+        wcls, head = best
+        waited = time.monotonic() - getattr(head, "t_queued", 0.0)
+        if waited < self._preempt_after:
+            return
+        if self._free_slot_available(engine):
+            return  # the next step seats the waiter without violence
+        victims = [v for v in engine.preemptable() if v[2] > wcls]
+        if not victims:
+            return
+        # Cheapest victim: lowest priority class first, then fewest
+        # MEASURED resident KV bytes (bytes_per_token tracks the cache
+        # backend, so int8 halves a victim's cost instead of the rule
+        # guessing from token counts alone).
+        bpt = engine.cache_backend.bytes_per_token()
+        vrid, vslot, vcls, vtokens = max(
+            victims, key=lambda v: (v[2], -v[3]))
+        req = engine._slots[vslot]
+        tenant = getattr(req, "tenant", None)
+        p = self._pending.get(vrid)
+        tid = (p.trace.trace_id
+               if p is not None and p.trace is not None else None)
+        if tid is None:
+            tid = new_trace_id()
+        try:
+            finished = engine.preempt(vrid)
+        except ValueError:
+            return  # raced a finish/cancel; nothing to do
+        self._deliver_finished(g, finished)
+        if vrid not in engine.frozen_decodes:
+            return  # the victim finished while the windows drained
+        try:
+            blob = disagg.export_slot(engine, vslot, req, trace_id=tid)
+        except Exception as e:  # noqa: BLE001 — keep the victim alive
+            # Export failed: thaw in place. The slot still holds the
+            # request, so clearing the freeze resumes decoding exactly
+            # where the drain left it — worse fairness beats a lost
+            # request.
+            engine.frozen_decodes.pop(vrid, None)
+            req.frozen = False
+            engine._sdone = engine._sdone.at[vslot].set(False)
+            if p is not None and p.trace is not None:
+                p.trace.record("preempt-failed", src="server", rid=vrid,
+                               error=f"{type(e).__name__}: {e}")
+            return
+        engine.release_frozen(vrid)
+        name = tenant or ANONYMOUS
+        nbytes = int(vtokens) * int(bpt)
+        self._preempted[vrid] = (blob, tenant, tid, nbytes)
+        self._m.tenant_preemptions.labels(tenant=name).inc()
+        self._parked_tenant_bytes[name] = (
+            self._parked_tenant_bytes.get(name, 0) + nbytes)
+        self._m.tenant_parked_bytes.labels(tenant=name).set(
+            float(self._parked_tenant_bytes[name]))
+        if p is not None and p.trace is not None:
+            p.trace.record(
+                "preempt-park", src="server", rid=vrid, slot=vslot,
+                victim_class=int(vcls), waiter_class=int(wcls),
+                resident_tokens=int(vtokens), bytes=nbytes,
+                tenant=name,
+            )
+        # Durable safety copy, fire-and-forget: a SIGKILL before the
+        # resume still leaves the fleet a crc-checked artifact in the
+        # shared park spool. The client's pending is NOT settled here
+        # — unlike the `park:` migrate leg, preemption keeps the
+        # client attached and invisible except as latency.
+        if self._park is not None:
+            if self._push_pool is None:
+                self._push_pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=4, thread_name_prefix="shellac-kv-push",
+                )
+            self._push_pool.submit(
+                self._park_safety_copy, blob, "preempt-" + tid)
+
+    def _park_safety_copy(self, blob, park_id: str) -> None:
+        """Push worker: best-effort durable copy of a preempted blob.
+        The authoritative copy is in-memory and resume does not block
+        on the spool, so a failed write costs only the error counter
+        the store already keeps."""
+        try:
+            data = blob.serialize()
+            self._park.put(park_id, data)
+        except (OSError, ValueError):
+            return
+        self._m.fabric_parked.inc()
+        self._m.fabric_park_bytes.set(
+            float(sum(e["bytes"] for e in self._park.list()))
+        )
+
+    def _drop_preempted(self, vrid) -> None:
+        """Forget one parked victim and settle its parked-bytes
+        accounting (resume landed, client vanished, or resume failed
+        terminally)."""
+        blob, tenant, tid, nbytes = self._preempted.pop(vrid)
+        name = tenant or ANONYMOUS
+        left = self._parked_tenant_bytes.get(name, 0) - nbytes
+        if left > 0:
+            self._parked_tenant_bytes[name] = left
+        else:
+            self._parked_tenant_bytes.pop(name, None)
+            left = 0
+        self._m.tenant_parked_bytes.labels(tenant=name).set(float(left))
+
+    def _resume_preempted(self, g: _Generation) -> None:
+        """Re-place preempted KV into free slots, oldest victim first.
+        Runs AFTER the step, so the preempting waiter seats before its
+        victim competes for the slot it vacated."""
+        if not self._preempted:
+            return
+        engine = g.engine
+        for vrid in list(self._preempted):
+            if not self._free_slot_available(engine):
+                return
+            blob, tenant, tid, _ = self._preempted[vrid]
+            p = self._pending.get(vrid)
+            if p is None or p.event.is_set():
+                # Client gone (cancelled or swept): drop the blob.
+                self._drop_preempted(vrid)
+                continue
+            try:
+                slot = disagg.import_blob(engine, blob, vrid, p.trace)
+            except PoolExhausted:
+                return  # capacity, not corruption: retry next loop
+            except Exception as e:  # noqa: BLE001 — request-scoped
+                self._drop_preempted(vrid)
+                pp = self._pending.pop(vrid, None)
+                if pp is not None:
+                    pp.error = (f"preempt resume failed: "
+                                f"{type(e).__name__}: {e}")
+                    pp.kind = "unavailable"
+                    if pp.trace is not None:
+                        pp.trace.abort("fault")
+                    pp.finish()
+                continue
+            self._drop_preempted(vrid)
+            name = tenant or ANONYMOUS
+            # The import rebuilds the request with default QoS fields;
+            # restore identity so a resumed victim can be preempted
+            # (or scheduled) under its own contract again.
+            r2 = engine._slots[slot]
+            if r2 is not None:
+                r2.tenant = tenant
+                if self._tenant_policy is not None:
+                    spec = self._tenant_policy.spec(name)
+                    r2.qos_class = spec.qos_class
+                    r2.qos_weight = spec.qos_weight
+            if p.trace is not None:
+                p.trace.record("preempt-resume", src="server",
+                               rid=vrid, slot=slot, tenant=name)
+
     def _run(self, g: _Generation) -> None:
         engine = g.engine
         # Multi-host engines need a step per loop iteration even when
@@ -1557,6 +1817,9 @@ class InferenceServer:
             self._sweep_adoptions(g)
             self._beat(g)
             if engine.pending or idle_steps:
+                # QoS: freeing a victim's slot BEFORE the step lets
+                # this very step seat the starved waiter.
+                self._maybe_preempt(g)
                 g.step_started = time.monotonic()
                 try:
                     finished = engine.step() or []
@@ -1588,32 +1851,13 @@ class InferenceServer:
                     if upto > p.emitted:
                         p.chunks.put(list(req.out[p.emitted:upto]))
                         p.emitted = upto
-                lp_store = getattr(engine, "finished_logprobs", {})
-                plp_store = getattr(
-                    engine, "finished_prompt_logprobs", {}
-                )
-                tl_store = getattr(
-                    engine, "finished_top_logprobs", {}
-                )
-                for rid, out in finished:
-                    p = self._pending.pop(rid, None)
-                    if p is not None:
-                        p.result = out
-                        if p.trace is not None:
-                            p.trace.finish(len(out))
-                        p.lps = lp_store.pop(rid, None)
-                        p.plp = plp_store.pop(rid, None)
-                        p.tlp = tl_store.pop(rid, None)
-                        if p.chunks is not None and len(out) > p.emitted:
-                            p.chunks.put(list(out[p.emitted:]))
-                        p.finish()
-                    else:
-                        lp_store.pop(rid, None)
-                        plp_store.pop(rid, None)
-                        tl_store.pop(rid, None)
+                self._deliver_finished(g, finished)
                 # Disaggregated prefill replica: export + ship every
                 # slot this step froze (no-op otherwise).
                 self._service_frozen(g)
+                # Preempted victims re-enter free slots only after the
+                # step (the waiter they yielded to seats first).
+                self._resume_preempted(g)
                 if idle_steps and not drained and not engine.pending:
                     # Idle heartbeat tick: pace the broadcast instead of
                     # spinning the interconnect at full rate.
@@ -1627,24 +1871,58 @@ class InferenceServer:
                 except queue.Empty:
                     pass
 
+    def _deliver_finished(self, g: _Generation, finished) -> None:
+        """Settle every (rid, out) the engine finished this step —
+        shared by the step loop and the preemption drain, so the
+        logprob-store handoff and pending settlement cannot drift."""
+        engine = g.engine
+        lp_store = getattr(engine, "finished_logprobs", {})
+        plp_store = getattr(engine, "finished_prompt_logprobs", {})
+        tl_store = getattr(engine, "finished_top_logprobs", {})
+        for rid, out in finished:
+            p = self._pending.pop(rid, None)
+            if p is not None:
+                p.result = out
+                if p.trace is not None:
+                    p.trace.finish(len(out))
+                p.lps = lp_store.pop(rid, None)
+                p.plp = plp_store.pop(rid, None)
+                p.tlp = tl_store.pop(rid, None)
+                if p.chunks is not None and len(out) > p.emitted:
+                    p.chunks.put(list(out[p.emitted:]))
+                p.finish()
+            else:
+                lp_store.pop(rid, None)
+                plp_store.pop(rid, None)
+                tl_store.pop(rid, None)
+
     # ---- client surface ---------------------------------------------
 
     def _submit(self, tokens, max_new: int, stop, samp, *, stream: bool,
                 deadline: Optional[float] = None,
-                trace_ctx: Optional[Tuple[str, int]] = None) -> _Pending:
+                trace_ctx: Optional[Tuple[str, int]] = None,
+                tenant: Optional[str] = None) -> _Pending:
         # Distributed-trace identity: adopt the (trace_id, attempt) the
         # HTTP layer pulled off x-shellac-trace, minting a fresh id for
         # direct library callers — every admitted request has exactly
         # one id, whoever it came from.
         tid, attempt = (trace_ctx if trace_ctx is not None
                         else (new_trace_id(), 0))
+        tenant = str(tenant) if tenant else None
         # The span clock starts at admission, before any copying or
         # queueing, so queue-wait covers everything the client waits
         # through server-side.
-        trace = self._m.trace(trace_id=tid, recorder=self._recorder)
+        trace = self._m.trace(trace_id=tid, recorder=self._recorder,
+                              tenant=tenant)
         # Convert the prompt BEFORE taking the lock: the copy is O(S)
         # and the lock serializes every admission and the supervisor.
         tokens = np.asarray(tokens, np.int32)
+        # QoS identity resolved outside the lock too. The priority
+        # class/weight come from the tenant policy when one is
+        # configured; untenanted servers leave them None and the
+        # engine's defaults apply (FIFO-identical scheduling).
+        spec = (self._tenant_policy.spec(tenant or ANONYMOUS)
+                if self._tenant_policy is not None else None)
         # Admit-event fields built outside the lock too (the optional
         # text decode is O(prompt)); text rides the event only under
         # --debug-include-text.
@@ -1653,6 +1931,10 @@ class InferenceServer:
             "prompt_len": int(tokens.size), "max_new": int(max_new),
             "stream": stream,
         }
+        if tenant is not None:
+            admit_fields["tenant"] = tenant
+        if spec is not None:
+            admit_fields["qos_class"] = spec.priority
         if self._debug_text and self.tokenizer is not None:
             admit_fields["prompt_text"] = self.tokenizer.decode(
                 [int(t) for t in tokens[:256]]
@@ -1669,13 +1951,15 @@ class InferenceServer:
                 raise RuntimeError("server closed")
             g = self._g
             if self._recovering or g.dead:
-                self._m.rejects.labels(reason="recovering").inc()
+                self._m.rejects.labels(reason="recovering",
+                                       tenant=tenant or "").inc()
                 raise ServerUnavailable(
                     "server recovering from an engine fault; retry",
                     http_status=503, retry_after=retry_after(3.0, 8.0),
                 )
             if self._draining:
-                self._m.rejects.labels(reason="draining").inc()
+                self._m.rejects.labels(reason="draining",
+                                       tenant=tenant or "").inc()
                 raise ServerUnavailable(
                     "server draining: not admitting new requests "
                     "(in-flight work is completing); retry elsewhere",
@@ -1683,12 +1967,40 @@ class InferenceServer:
                 )
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
-                self._m.rejects.labels(reason="overloaded").inc()
+                self._m.rejects.labels(reason="overloaded",
+                                       tenant=tenant or "").inc()
                 raise ServerUnavailable(
                     f"server overloaded: {len(self._pending)} requests "
                     f"pending (max_pending={self.max_pending})",
                     http_status=429, retry_after=retry_after(1.0, 3.0),
                 )
+            released: Optional[Callable[[], None]] = None
+            if self._qos_admission is not None:
+                # Per-tenant quotas AFTER the global gates: a global
+                # overload answer must not charge a tenant's bucket.
+                # Cost = prompt + budgeted max_new — the same token
+                # count the engine will reserve, so the bucket meters
+                # work, not request count.
+                name = tenant or ANONYMOUS
+                cost = int(tokens.size) + int(max_new)
+                ok, why, wait = self._qos_admission.admit(name, cost)
+                if not ok:
+                    self._m.rejects.labels(reason="throttled",
+                                           tenant=tenant or "").inc()
+                    self._m.tenant_throttles.labels(
+                        tenant=name, reason=why).inc()
+                    # Jittered Retry-After on top of the bucket's
+                    # deterministic refill estimate: synchronized
+                    # over-quota clients must not return in lockstep.
+                    raise ServerUnavailable(
+                        f"tenant {name!r} over its {why} quota",
+                        http_status=429,
+                        retry_after=retry_after(
+                            max(wait, 0.5), max(wait, 0.5) + 2.0),
+                    )
+                self._m.tenant_tokens.labels(tenant=name).inc(cost)
+                released = functools.partial(
+                    self._qos_admission.release, name)
             rid = next(self._ids)
             holdback = max((len(s) for s in stop), default=0) if stop else 0
             if deadline is not None:
@@ -1698,8 +2010,19 @@ class InferenceServer:
                 # loop iteration.
                 self._saw_deadline = True  # shellac: ignore[SH010]
             p = _Pending(rid, stream=stream, holdback=holdback,
-                         deadline=deadline, trace=trace)
+                         deadline=deadline, trace=trace, tenant=tenant)
+            p.on_finish = released
             self._pending[rid] = p
+            samp = dict(samp or {})
+            if spec is not None or tenant is not None:
+                # Rides the submit tuple to the scheduler thread, which
+                # pops it into engine.submit(tenant=, qos_class=,
+                # qos_weight=) — the weighted-fair queue's inputs.
+                samp["_qos"] = (
+                    tenant,
+                    spec.qos_class if spec is not None else None,
+                    spec.qos_weight if spec is not None else None,
+                )
             # Recorded BEFORE the scheduler can see the request: the
             # enqueue below hands it to the engine thread, which
             # records queue/prefill next — admit must already hold the
@@ -1707,7 +2030,7 @@ class InferenceServer:
             trace.record("admit", rid=rid, pending=len(self._pending),
                          **admit_fields)
             g.submit_q.put(
-                (rid, tokens, max_new, stop, samp or {}, deadline)
+                (rid, tokens, max_new, stop, samp, deadline)
             )
         return p
 
@@ -1752,13 +2075,15 @@ class InferenceServer:
 
     def generate(self, tokens, max_new: int, timeout: Optional[float] = None,
                  stop=None, return_logprobs: bool = False,
-                 trace_ctx: Optional[Tuple[str, int]] = None, **samp):
+                 trace_ctx: Optional[Tuple[str, int]] = None,
+                 tenant: Optional[str] = None, **samp):
         # The timeout doubles as the request's deadline: it rides the
         # submit tuple so the scheduler can shed the request if it
         # expires before prefill ever runs.
         deadline = self._deadline(timeout)
         p = self._submit(tokens, max_new, stop, samp, stream=False,
-                         deadline=deadline, trace_ctx=trace_ctx)
+                         deadline=deadline, trace_ctx=trace_ctx,
+                         tenant=tenant)
         try:
             self._await(p, deadline)
         except TimeoutError:
@@ -1773,7 +2098,7 @@ class InferenceServer:
                         timeout: Optional[float] = None, stop=None,
                         return_logprobs: bool = False,
                         trace_ctx: Optional[Tuple[str, int]] = None,
-                        **samp):
+                        tenant: Optional[str] = None, **samp):
         """Yield ("delta", [token ids]) as generation progresses, then
         ("done", full output) — or ("done", (output, logprobs)) with
         return_logprobs=True. `timeout` bounds the wait per chunk (and
@@ -1781,7 +2106,7 @@ class InferenceServer:
         before it elapses is shed instead of prefilled)."""
         p = self._submit(tokens, max_new, stop, samp, stream=True,
                          deadline=self._deadline(timeout),
-                         trace_ctx=trace_ctx)
+                         trace_ctx=trace_ctx, tenant=tenant)
         finished = False
         try:
             while True:
@@ -1980,7 +2305,8 @@ class InferenceServer:
     }
 
     def _handle_beam(self, payload: dict,
-                     trace_ctx: Optional[Tuple[str, int]] = None) -> dict:
+                     trace_ctx: Optional[Tuple[str, int]] = None,
+                     tenant: Optional[str] = None) -> dict:
         """Native beam-search request: `num_beams` (+ optional
         `length_penalty`, `constraint`) returns the ranked beams as
         {"choices": [{"tokens", "beam_score", "text"?}]}."""
@@ -2010,6 +2336,7 @@ class InferenceServer:
             {"_beam": {"num_beams": nb, "length_penalty": lp,
                        "constraint": samp.get("constraint")}},
             stream=False, deadline=deadline, trace_ctx=trace_ctx,
+            tenant=tenant,
         )
         try:
             self._await(p, deadline)
@@ -2056,13 +2383,15 @@ class InferenceServer:
                 raise RuntimeError("server closed")
             g = self._g
             if self._recovering or g.dead:
-                self._m.rejects.labels(reason="recovering").inc()
+                self._m.rejects.labels(reason="recovering",
+                                       tenant="").inc()
                 raise ServerUnavailable(
                     "server recovering from an engine fault; retry",
                     http_status=503, retry_after=retry_after(3.0, 8.0),
                 )
             if self._draining:
-                self._m.rejects.labels(reason="draining").inc()
+                self._m.rejects.labels(reason="draining",
+                                       tenant="").inc()
                 raise ServerUnavailable(
                     "server draining: not admitting migrations; retry "
                     "elsewhere",
@@ -2070,7 +2399,8 @@ class InferenceServer:
                 )
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
-                self._m.rejects.labels(reason="overloaded").inc()
+                self._m.rejects.labels(reason="overloaded",
+                                       tenant="").inc()
                 raise ServerUnavailable(
                     f"server overloaded: {len(self._pending)} requests "
                     f"pending (max_pending={self.max_pending})",
@@ -2504,7 +2834,8 @@ class InferenceServer:
         self._m.tool_requests.labels(outcome=outcome).inc()
 
     def handle(self, payload: dict,
-               trace_ctx: Optional[Tuple[str, int]] = None) -> dict:
+               trace_ctx: Optional[Tuple[str, int]] = None,
+               tenant: Optional[str] = None) -> dict:
         # One trace id for the whole request, fan-out included: resolve
         # it here so every sub-submit (and the response echo) agrees.
         if trace_ctx is None:
@@ -2533,7 +2864,8 @@ class InferenceServer:
                     "tools do not compose with num_beams (a beam is a "
                     "ranked whole sequence, not an assistant turn)"
                 )
-            result = self._handle_beam(payload, trace_ctx=trace_ctx)
+            result = self._handle_beam(payload, trace_ctx=trace_ctx,
+                                       tenant=tenant)
             result["trace_id"] = trace_ctx[0]
             return result
         tokens, max_new, stop, samp = self._parse(payload)
@@ -2544,7 +2876,8 @@ class InferenceServer:
         if n == 1 and best_of == 1:
             out, lps, plp, tlp = self.generate(
                 tokens, max_new, timeout=payload.get("timeout"), stop=stop,
-                return_logprobs=True, trace_ctx=trace_ctx, **samp,
+                return_logprobs=True, trace_ctx=trace_ctx, tenant=tenant,
+                **samp,
             )
             result = self._format_completion(
                 out, lps, want_lps, plp=plp, tlp=tlp, tlk=tlk,
@@ -2570,6 +2903,7 @@ class InferenceServer:
                     tokens, max_new, stop,
                     samp if i == 0 else rest_samp, stream=False,
                     deadline=deadline, trace_ctx=trace_ctx,
+                    tenant=tenant,
                 ))
         except RuntimeError:
             # Admission cap (or a fault) hit mid-fan-out: release the
@@ -2672,7 +3006,8 @@ class InferenceServer:
         return n, best_of
 
     def handle_stream(self, payload: dict,
-                      trace_ctx: Optional[Tuple[str, int]] = None):
+                      trace_ctx: Optional[Tuple[str, int]] = None,
+                      tenant: Optional[str] = None):
         """Yield response dicts for a streaming request: delta lines
         {"tokens": [...]}, then {"done": true, "tokens", "text"?,
         "logprobs"?}. Every record carries the request's `trace_id`,
@@ -2723,7 +3058,8 @@ class InferenceServer:
             scanner = ToolCallStreamParser(tool_ctx.mode)
         stream = self.generate_stream(
             tokens, max_new, timeout=payload.get("timeout"), stop=stop,
-            return_logprobs=True, trace_ctx=trace_ctx, **samp,
+            return_logprobs=True, trace_ctx=trace_ctx, tenant=tenant,
+            **samp,
         )
         tid = trace_ctx[0]
         for kind, val in stream:
@@ -2776,7 +3112,8 @@ class InferenceServer:
     # ---- OpenAI-compatible façade -----------------------------------
 
     def handle_openai(self, payload: dict, chat: bool,
-                      trace_ctx: Optional[Tuple[str, int]] = None) -> dict:
+                      trace_ctx: Optional[Tuple[str, int]] = None,
+                      tenant: Optional[str] = None) -> dict:
         from shellac_tpu.inference.openai_api import (
             chat_to_native,
             completion_response,
@@ -2785,6 +3122,10 @@ class InferenceServer:
 
         # trace_ctx passes straight through to handle(), which mints
         # on None — no need to duplicate the fallback here.
+        # OpenAI requests may carry the tenant as the `user` field;
+        # an explicit x-shellac-tenant header wins.
+        if tenant is None and payload.get("user"):
+            tenant = str(payload["user"])
         native = (chat_to_native(payload, self.tokenizer) if chat
                   else completion_to_native(payload, self.tokenizer))
         echo = bool(native.pop("echo", False))
@@ -2800,7 +3141,8 @@ class InferenceServer:
         native["tokens"] = [int(t) for t in tokens]
         prompt_tokens = len(tokens)
         max_new = int(native.get("max_new", 32))
-        result = self.handle(native, trace_ctx=trace_ctx)
+        result = self.handle(native, trace_ctx=trace_ctx,
+                             tenant=tenant)
         return completion_response(
             result, model=self.model_name, prompt_tokens=prompt_tokens,
             max_new=max_new, tokenizer=self.tokenizer, chat=chat,
@@ -2808,7 +3150,8 @@ class InferenceServer:
         )
 
     def handle_openai_stream(self, payload: dict, chat: bool,
-                             trace_ctx: Optional[Tuple[str, int]] = None):
+                             trace_ctx: Optional[Tuple[str, int]] = None,
+                             tenant: Optional[str] = None):
         """Yield OpenAI SSE chunk objects (the HTTP layer frames them
         as `data:` lines and appends [DONE]). Each chunk carries the
         request's `trace_id` alongside the OpenAI fields — unknown
@@ -2822,7 +3165,8 @@ class InferenceServer:
 
         if trace_ctx is None:
             trace_ctx = (new_trace_id(), 0)
-
+        if tenant is None and payload.get("user"):
+            tenant = str(payload["user"])
         native = (chat_to_native(payload, self.tokenizer) if chat
                   else completion_to_native(payload, self.tokenizer))
         if native.pop("echo", False):
@@ -2840,7 +3184,8 @@ class InferenceServer:
             tool_mode=bool(native.get("tools"))
             and native.get("tool_choice") != "none",
         )
-        for record in self.handle_stream(native, trace_ctx=trace_ctx):
+        for record in self.handle_stream(native, trace_ctx=trace_ctx,
+                                         tenant=tenant):
             for chunk in translator.feed(record, max_new):
                 chunk["trace_id"] = trace_ctx[0]
                 yield chunk
@@ -2977,6 +3322,10 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     "generation": server._g.gen,
                     "shed": server.shed,
                     "uptime_s": round(server.uptime_s, 3),
+                    # Multi-tenant QoS: per-tenant admission counters,
+                    # per-class queue depths, parked preemption state
+                    # (empty object when untenanted).
+                    "qos": server.qos_snapshot(),
                     # p50/p90/p99 latency digests from the obs
                     # histograms (null until requests have completed).
                     **server.latency_summary(),
@@ -3166,11 +3515,13 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     "manifest"),
             }, headers=rid_hdr)
 
-        def _stream(self, payload: dict, tctx: Tuple[str, int]):
+        def _stream(self, payload: dict, tctx: Tuple[str, int],
+                    tenant: Optional[str] = None):
             # Newline-delimited JSON, no Content-Length: the connection
             # closes at the end of the stream (HTTP/1.0 semantics of
             # BaseHTTPRequestHandler — no keep-alive to preserve).
-            lines = server.handle_stream(payload, trace_ctx=tctx)
+            lines = server.handle_stream(payload, trace_ctx=tctx,
+                                         tenant=tenant)
             try:
                 first = next(lines)  # parse errors surface before 200
             except StopIteration:
@@ -3206,11 +3557,13 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                     pass
 
         def _stream_sse(self, payload: dict, chat: bool,
-                        tctx: Tuple[str, int]):
+                        tctx: Tuple[str, int],
+                        tenant: Optional[str] = None):
             # OpenAI Server-Sent Events framing: one `data: <json>` line
             # per chunk, blank-line separated, closed by `data: [DONE]`.
             chunks = server.handle_openai_stream(payload, chat,
-                                                 trace_ctx=tctx)
+                                                 trace_ctx=tctx,
+                                                 tenant=tenant)
             try:
                 first = next(chunks, None)  # errors surface before 200
             except (ValueError, TimeoutError) as e:
@@ -3261,6 +3614,10 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
             # Every response echoes it as x-request-id.
             tctx = adopt_trace(self.headers.get(TRACE_HEADER))
             rid_hdr = {REQUEST_ID_HEADER: tctx[0]}
+            # Tenant identity: the explicit header wins everywhere;
+            # OpenAI routes additionally fall back to the `user`
+            # payload field inside the facade.
+            tenant = (self.headers.get(TENANT_HEADER) or "").strip() or None
             if self.path.startswith("/debug/profile"):
                 self._handle_profile(rid_hdr)
                 return
@@ -3351,16 +3708,20 @@ def make_http_server(server: InferenceServer, host: str = "127.0.0.1",
                 if self.path in openai_routes:
                     chat = openai_routes[self.path]
                     if payload.get("stream"):
-                        self._stream_sse(payload, chat, tctx)
+                        self._stream_sse(payload, chat, tctx,
+                                         tenant=tenant)
                     else:
                         self._send(200,
                                    server.handle_openai(
-                                       payload, chat, trace_ctx=tctx),
+                                       payload, chat, trace_ctx=tctx,
+                                       tenant=tenant),
                                    headers=rid_hdr)
                 elif payload.get("stream"):
-                    self._stream(payload, tctx)
+                    self._stream(payload, tctx, tenant=tenant)
                 else:
-                    self._send(200, server.handle(payload, trace_ctx=tctx),
+                    self._send(200,
+                               server.handle(payload, trace_ctx=tctx,
+                                             tenant=tenant),
                                headers=rid_hdr)
             except (ValueError, TimeoutError) as e:
                 err = {"error": str(e)}

@@ -99,7 +99,14 @@ from shellac_tpu.obs import (
     spool_path,
 )
 from shellac_tpu.inference import prefix as prefix_mod
+from shellac_tpu.inference.autoscale import Autoscaler, AutoscalePolicy
 from shellac_tpu.inference.fabric import PrefixDirectory
+from shellac_tpu.inference.qos import (
+    ANONYMOUS,
+    TENANT_HEADER,
+    AdmissionController,
+    TenantPolicy,
+)
 from shellac_tpu.utils.failure import CircuitBreaker
 
 #: Parsed-metrics keys the load score reads (PR 3 gauge names).
@@ -237,6 +244,8 @@ class TierRouter:
         incident_rate: int = 6,
         incident_window: float = 600.0,
         incident_retention: int = 24,
+        tenant_config: Optional[Any] = None,
+        autoscale: Optional[AutoscalePolicy] = None,
     ):
         if not replicas:
             raise ValueError("a tier needs at least one replica URL")
@@ -311,6 +320,35 @@ class TierRouter:
                 exemplar_fn=self._slo_exemplar,
                 on_transition=self._slo_transitioned,
                 page_burn=slo_page_burn, warn_burn=slo_warn_burn,
+            )
+        # Multi-tenant QoS at the tier edge (serve-tier
+        # --tenant-config): the SAME policy language as the replicas,
+        # enforced here first so an over-quota tenant's traffic never
+        # even reaches a replica's queue. ValueError on a malformed
+        # config fails startup loudly.
+        self._tenant_policy: Optional[TenantPolicy] = (
+            TenantPolicy.parse(tenant_config)
+            if tenant_config is not None else None
+        )
+        self._admission: Optional[AdmissionController] = (
+            AdmissionController(self._tenant_policy)
+            if self._tenant_policy is not None else None
+        )
+        # SLO-actuated autoscaler (serve-tier --autoscale): pure
+        # policy — its actuators are this router's replica_factory
+        # (scale-out) and drain forwarding (scale-down), its inputs
+        # the SLO transitions + the health sweep's load scores, its
+        # cadence poll_once. None (the default) constructs NOTHING,
+        # so an autoscale-less tier is bit-identical to one predating
+        # the feature.
+        self._autoscaler: Optional[Autoscaler] = None
+        if autoscale is not None:
+            self._autoscaler = Autoscaler(
+                autoscale,
+                scale_out=self._scale_out_replica,
+                scale_down=self._scale_down_replica,
+                observe=self._fleet_load,
+                on_action=self._autoscale_acted,
             )
         self._t0 = time.monotonic()
         self.health_interval = health_interval
@@ -451,6 +489,15 @@ class TierRouter:
                 pass
         if self._slo is not None:
             self._slo.tick(self._slo_counts())
+        if self._autoscaler is not None:
+            # Gauge tracks ROUTABLE capacity (what traffic can use),
+            # not membership — a draining scale-down shows up here the
+            # sweep it takes effect, not when the replica exits.
+            self._m.autoscale_replicas.set(healthy)
+            try:
+                self._autoscaler.tick()
+            except Exception:  # noqa: BLE001 — policy bugs must not
+                pass           # stop health sweeps
 
     def _poll_replica(self, rep: Replica) -> None:
         with rep.lock:
@@ -618,6 +665,87 @@ class TierRouter:
                         # predecessor's advertised contents must stop
                         # attracting traffic.
                         self._directory.forget(rep.url)
+
+    # ---- autoscaler actuators ---------------------------------------
+
+    def _fleet_load(self) -> Tuple[int, int, float]:
+        """(routable, total, aggregate load score) — the autoscaler's
+        observation. The per-replica score is the routing score the
+        health sweep already computes (queue + pending + KV pressure +
+        latency), so the autoscaler and the router agree on what
+        'loaded' means by construction."""
+        routable = 0
+        load = 0.0
+        reps = self._replicas
+        for rep in reps:
+            if not rep.routable:
+                continue
+            routable += 1
+            with rep.lock:
+                s = rep.load.get("score")
+            load += float(s) if s is not None else float(rep.pending)
+        return routable, len(reps), load
+
+    def _scale_out_replica(self) -> Optional[str]:
+        """Autoscaler scale-out actuator: mint one replica via
+        replica_factory (seeded with a routable member's URL as the
+        template, the same contract _respawn_dead uses) and append it
+        to membership. Returns the new URL, or None when there is no
+        factory or it produced a duplicate — the autoscaler counts
+        that as a failed action and cools down."""
+        if self._factory is None:
+            return None
+        reps = self._replicas
+        template = next((r.url for r in reps if r.routable),
+                        reps[0].url if reps else None)
+        if template is None:
+            return None
+        new_url = self._factory(template)
+        with self._lock:
+            if any(r.url == new_url for r in self._replicas):
+                return None
+            # Replaced wholesale, never mutated (the membership
+            # contract): readers hold a consistent snapshot.
+            self._replicas = self._replicas + [  # shellac: ignore[SH010] — copy-on-write membership: the binding is replaced atomically under _lock (writer-writer serialization); lock-free readers snapshot the old or the new list, both consistent
+                Replica(new_url, CircuitBreaker(*self._breaker_cfg))
+            ]
+        return new_url
+
+    def _scale_down_replica(self) -> Optional[str]:
+        """Autoscaler scale-down actuator: drain the least-loaded
+        HEALTHY replica (graceful — it finishes in-flight work and
+        parks its cache; PR 16's park/adopt recovers anything
+        non-streaming it still holds). The autoscaler already
+        enforced the min-replica floor before calling."""
+        candidates = [r for r in self._replicas if r.state == "healthy"]
+        if len(candidates) <= 1:
+            # Never drain the last healthy member, whatever the
+            # policy floor says — an all-draining fleet serves nobody.
+            return None
+
+        def score(rep: Replica) -> float:
+            with rep.lock:
+                s = rep.load.get("score")
+            return float(s) if s is not None else float(rep.pending)
+
+        victim = min(candidates, key=score)
+        self.drain_replica(victim.url)  # OSError → autoscaler counts
+        return victim.url               # the failure, cools down
+
+    def _autoscale_acted(self, action: str, url: Optional[str],
+                         **detail: Any) -> None:
+        """Autoscaler evidence hook: every decision (actions AND
+        refusals) is a fleet-timeline recorder event; actual capacity
+        changes additionally bump the actions counter and freeze an
+        incident bundle — a fleet that changed size is exactly the
+        moment a reviewer wants the whole evidence surface."""
+        self._recorder.record(None, "autoscale", src="tier",
+                              action=action, replica=url, **detail)
+        if action in ("scale_out", "scale_down"):
+            self._m.autoscale_actions.labels(action=action).inc()
+            self._incident("autoscale",
+                           detail={"action": action, "replica": url,
+                                   **detail})
 
     # ---- KV fabric: hot-prefix replication planner ------------------
 
@@ -871,10 +999,18 @@ class TierRouter:
         x-shellac-trace header, so the replica's span, its flight
         recorder, and the tier's attempt log all quote one id — and a
         replica can tell a first attempt from a retry leg."""
+        tenant = payload.pop("_tenant", None)
         data = json.dumps(payload).encode()
         headers = {"Content-Type": "application/json"}
         if trace_id is not None:
             headers[TRACE_HEADER] = format_trace_header(trace_id, attempt)
+        if tenant:
+            # The tenant id rides EVERY attempt (retry legs, disagg
+            # prefill/adopt legs) the way the trace id does, so the
+            # replica's per-tenant accounting and debug rows stay
+            # correct whichever attempt lands. It travels as the
+            # header, never in the replica-bound JSON body.
+            headers[TENANT_HEADER] = str(tenant)
         req = urllib.request.Request(
             rep.url + path, data=data, headers=headers,
         )
@@ -992,6 +1128,22 @@ class TierRouter:
             legs += 1
 
     # ---- disaggregated prefill/decode routing -----------------------
+
+    @staticmethod
+    def _admission_cost(payload: dict) -> int:
+        """Token-bucket cost of one request at the tier edge: the
+        prompt-size estimate plus the decode budget. The tier has no
+        tokenizer, so this is deliberately the same coarse estimate
+        routing uses — the replica's own admission re-prices exactly
+        on adoption."""
+        mx = payload.get("max_tokens")
+        if mx is None:
+            mx = payload.get("max_new_tokens")
+        try:
+            mx = int(mx)
+        except (TypeError, ValueError):
+            mx = 16
+        return TierRouter._prompt_tokens_est(payload) + max(mx, 1)
 
     @staticmethod
     def _prompt_tokens_est(payload: dict) -> int:
@@ -1697,6 +1849,13 @@ class TierRouter:
                           outcome=f"fallback_{r}") or 0
                 for r in ("no_pair", "cost", "feature", "failed")
             )),
+            # Multi-tenant QoS: per-tenant admission counters (null
+            # without --tenant-config) and autoscaler status (null
+            # without --autoscale).
+            "tenants": (self._admission.snapshot()
+                        if self._admission is not None else None),
+            "autoscale": (self._autoscaler.status()
+                          if self._autoscaler is not None else None),
             # KV fabric: per-replica directory view + push/hit tallies
             # (null when serve-tier ran with --no-fabric).
             "fabric": None if self._directory is None else {
@@ -1857,6 +2016,10 @@ class TierRouter:
         trace-id exemplar — the committed counterpart of the pager
         firing. Warnings and recoveries only alert; evidence is for
         pages."""
+        if self._autoscaler is not None:
+            # Every transition, not just pages: a recovery to ok
+            # DISARMS a pending scale-out (see Autoscaler docs).
+            self._autoscaler.on_slo_transition(spec.name, old, new)
         if new != "page":
             return
         self._incident(
@@ -1976,7 +2139,8 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
             pass
 
         def _send(self, code: int, obj,
-                  trace_id: Optional[str] = None) -> None:
+                  trace_id: Optional[str] = None,
+                  retry_after_s: Optional[float] = None) -> None:
             if isinstance(obj, tuple):  # (status, body, content_type)
                 code, body, ct = obj
             else:
@@ -1986,7 +2150,13 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
             self.send_header("Content-Length", str(len(body)))
             if trace_id is not None:
                 self.send_header(REQUEST_ID_HEADER, trace_id)
-            if code in (429, 502, 503, 504):
+            if retry_after_s is not None:
+                # Informed hint (a tenant throttle knows its bucket's
+                # refill horizon) — still jitter-widened by the caller
+                # so one tenant's clients don't re-arrive in a spike.
+                self.send_header("Retry-After",
+                                 str(max(1, int(round(retry_after_s)))))
+            elif code in (429, 502, 503, 504):
                 from shellac_tpu.inference.server import retry_after
 
                 self.send_header(
@@ -2271,12 +2441,60 @@ def make_tier_http_server(router: TierRouter, host: str = "127.0.0.1",
             if self.path not in route_paths:
                 self._send(404, {"error": "not found"}, trace_id=tid)
                 return
-            if payload.get("stream"):
-                self._relay_stream(self.path, payload, tid)
-            else:
-                self._send(0, router.forward_json(self.path, payload,
-                                                  trace_id=tid),
-                           trace_id=tid)
+            # Tenant identity: the explicit header wins; the OpenAI
+            # `user` field is adopted on the OpenAI surfaces (the same
+            # precedence the replicas apply); otherwise anonymous.
+            tenant = (self.headers.get(TENANT_HEADER) or "").strip() \
+                or None
+            if (tenant is None and self.path != "/generate"
+                    and isinstance(payload.get("user"), str)
+                    and payload["user"]):
+                tenant = payload["user"]
+            release = None
+            if router._admission is not None:
+                name = tenant or ANONYMOUS
+                ok, why, wait = router._admission.admit(
+                    name, TierRouter._admission_cost(payload)
+                )
+                if not ok:
+                    router._m.tenant_throttles.labels(
+                        tenant=name, reason=why).inc()
+                    router._recorder.record(
+                        tid, "tenant-throttle", src="tier",
+                        tenant=name, reason=why,
+                    )
+                    from shellac_tpu.inference.server import \
+                        retry_after
+
+                    lo = max(wait, 0.5)
+                    self._send(
+                        429,
+                        {"error": "tenant over quota",
+                         "reason": why, "tenant": name,
+                         "retry_after_s": round(lo, 3)},
+                        trace_id=tid,
+                        retry_after_s=retry_after(lo, lo + 2.0),
+                    )
+                    return
+                release = name
+            if tenant:
+                # Rides to the replica as x-shellac-tenant on every
+                # attempt (popped back out of the payload in _post).
+                payload["_tenant"] = tenant
+            try:
+                if payload.get("stream"):
+                    self._relay_stream(self.path, payload, tid)
+                else:
+                    self._send(0, router.forward_json(self.path,
+                                                      payload,
+                                                      trace_id=tid),
+                               trace_id=tid)
+            finally:
+                if release is not None:
+                    # The tier's concurrency lease spans the WHOLE
+                    # relay (streams included): settled exactly once,
+                    # whatever the forward did.
+                    router._admission.release(release)
 
     return ThreadingHTTPServer((host, port), Handler)
 

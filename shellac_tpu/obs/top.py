@@ -266,6 +266,63 @@ def render(snapshot: Dict[str, Any], width: int = 100) -> str:
                 out.append(f"  {_short(r['url'], 30):<32}"
                            + "  ".join(parts))
 
+    # -- tenants panel -------------------------------------------------
+    # Per-tenant QoS view (serve-tier --tenant-config): the tier's
+    # admission snapshot joined with the federated per-tenant series
+    # (preemptions and parked bytes live on the replicas).
+    tenants = stats.get("tenants") if isinstance(stats, dict) else None
+    if tenants:
+        preempts: Dict[str, float] = {}
+        parked: Dict[str, float] = {}
+        if parsed is not None:
+            for fam, acc in (
+                    ("shellac_tenant_preemptions_total", preempts),
+                    ("shellac_tenant_parked_bytes", parked)):
+                for ls, v in parsed.series(fam):
+                    t = ls.get("tenant")
+                    if t:
+                        acc[t] = acc.get(t, 0.0) + v
+        total_adm = sum(row.get("admitted", 0)
+                        for row in tenants.values()) or 1
+        out.append("")
+        out.append(
+            f"{'tenant':<22}{'class':<13}{'wt':>5}{'infl':>6}"
+            f"{'share':>7}{'thr%':>7}{'preempt':>9}{'parked':>9}"
+        )
+        for name in sorted(tenants):
+            row = tenants[name]
+            adm = row.get("admitted", 0)
+            thr = row.get("throttled", 0)
+            rate = 100.0 * thr / max(adm + thr, 1)
+            pk = parked.get(name)
+            out.append(
+                f"{name:<22.22}{str(row.get('priority', '-')):<13}"
+                f"{row.get('weight', 0):>5.1f}"
+                f"{row.get('inflight', 0):>6}"
+                f"{100.0 * adm / total_adm:>6.1f}%"
+                f"{rate:>6.1f}%"
+                f"{int(preempts.get(name, 0)):>9}"
+                f"{(f'{pk / 1024:.0f}K' if pk else '-'):>9}"
+            )
+
+    # -- autoscaler status ---------------------------------------------
+    scale = stats.get("autoscale") if isinstance(stats, dict) else None
+    if scale:
+        last = scale.get("last_action")
+        out.append("")
+        out.append(
+            f"autoscaler: replicas "
+            f"{stats.get('replicas_healthy', '?')} routable "
+            f"(min {scale.get('min_replicas')} / "
+            f"max {scale.get('max_replicas')}) · "
+            f"last {last or 'none'}"
+            + (f" → {_short(str(scale.get('last_action_replica')), 24)}"
+               if last and scale.get("last_action_replica") else "")
+            + f" · cooldown {scale.get('cooldown_remaining_s', 0):.0f}s"
+            + (f" · page pending: {scale['page_pending']}"
+               if scale.get("page_pending") else "")
+        )
+
     # -- last incident -------------------------------------------------
     # One line, always near the bottom: the most recent evidence
     # bundle (tier-side --incident-dir), so "did the black box fire"

@@ -89,10 +89,14 @@ class ServeMetrics:
             "End-to-end request latency (submit to completion)",
             buckets=LATENCY_BUCKETS,
         )
+        # The tenant label is "" for traffic that carried no tenant id
+        # (matching Registry.get's empty-string default, so untenanted
+        # deployments keep their exact-key lookups and dashboards).
         self.requests = c(
             "shellac_requests_total",
-            "Requests settled, by outcome (ok|shed|cancelled|error|fault)",
-            labels=("outcome",),
+            "Requests settled, by outcome (ok|shed|cancelled|error|"
+            "fault) and tenant (empty for untenanted traffic)",
+            labels=("outcome", "tenant"),
         )
         self.sheds = c(
             "shellac_requests_shed_total",
@@ -101,8 +105,9 @@ class ServeMetrics:
         self.rejects = c(
             "shellac_admission_rejects_total",
             "Submissions refused at admission, by reason "
-            "(overloaded|recovering)",
-            labels=("reason",),
+            "(overloaded|recovering|draining|throttled) and tenant "
+            "(empty for untenanted traffic)",
+            labels=("reason", "tenant"),
         )
         self.restarts = c(
             "shellac_supervisor_restarts_total",
@@ -206,16 +211,55 @@ class ServeMetrics:
             "Bytes currently resident in this replica's KV park spool "
             "(size-capped; LRU-trimmed on write)",
         )
+        # Per-tenant QoS series. Unlike the widened request/reject
+        # counters above, these key the RESOLVED tenant ("anonymous"
+        # when no id rode the request), so a tenants dashboard always
+        # accounts for every token served.
+        self.tenant_tokens = c(
+            "shellac_tenant_tokens_admitted_total",
+            "Tokens admitted past per-tenant quota (prompt + budgeted "
+            "max_new, the same cost the token bucket charges), by "
+            "resolved tenant",
+            labels=("tenant",),
+        )
+        self.tenant_throttles = c(
+            "shellac_tenant_throttles_total",
+            "Per-tenant quota rejections (HTTP 429 + Retry-After), by "
+            "tenant and exhausted budget (rate|concurrency)",
+            labels=("tenant", "reason"),
+        )
+        self.tenant_preemptions = c(
+            "shellac_tenant_preemptions_total",
+            "Requests frozen mid-decode and parked so a higher-"
+            "priority class could take the slot, by victim tenant",
+            labels=("tenant",),
+        )
+        self.tenant_parked_bytes = g(
+            "shellac_tenant_parked_bytes",
+            "Bytes of preempted KV currently parked awaiting resume, "
+            "by victim tenant (measured blob size, the preemption "
+            "cost model's input)",
+            labels=("tenant",),
+        )
+        self.tenant_sheds = c(
+            "shellac_tenant_sheds_total",
+            "Deadline sheds by resolved tenant (the unlabeled "
+            "shellac_requests_shed_total keeps the fleet total)",
+            labels=("tenant",),
+        )
         self._engine_stats: Dict[str, object] = {}
 
     def trace(self, trace_id: Optional[str] = None,
-              recorder=None) -> "RequestTrace":
+              recorder=None, tenant: Optional[str] = None
+              ) -> "RequestTrace":
         """A span for one request. `trace_id` links the span to the
         distributed trace (the tier/header id); `recorder` is the
         server's FlightRecorder — when both are set the span's event
         methods also deposit timeline events, and the latency
-        histograms retain the id as a per-bucket exemplar."""
-        return RequestTrace(self, trace_id=trace_id, recorder=recorder)
+        histograms retain the id as a per-bucket exemplar. `tenant`
+        (None for untenanted traffic) labels the settlement counters."""
+        return RequestTrace(self, trace_id=trace_id, recorder=recorder,
+                            tenant=tenant)
 
     def engine_stat(self, key: str):
         """Scrape-time gauge mirroring one engine `stats` counter as
@@ -238,10 +282,11 @@ class RequestTrace:
     pop-arbitrated settlement."""
 
     __slots__ = ("_m", "t_submit", "t_prefill", "t_first", "t_done",
-                 "n_tokens", "outcome", "trace_id", "recorder")
+                 "n_tokens", "outcome", "trace_id", "recorder", "tenant")
 
     def __init__(self, metrics: ServeMetrics,
-                 trace_id: Optional[str] = None, recorder=None):
+                 trace_id: Optional[str] = None, recorder=None,
+                 tenant: Optional[str] = None):
         self._m = metrics
         # Distributed-trace identity (obs.events.new_trace_id shape) and
         # the flight recorder the span's events feed. Both optional:
@@ -249,6 +294,9 @@ class RequestTrace:
         # pre-tracing behavior.
         self.trace_id = trace_id
         self.recorder = recorder
+        # Tenant id the request carried (None when untenanted): labels
+        # the settlement counters and surfaces in /debug/requests.
+        self.tenant = tenant
         self.t_submit = time.monotonic()
         self.t_prefill: Optional[float] = None
         self.t_first: Optional[float] = None
@@ -292,7 +340,8 @@ class RequestTrace:
             return False
         self.outcome = outcome
         self.t_done = time.monotonic()
-        self._m.requests.labels(outcome=outcome).inc()
+        self._m.requests.labels(outcome=outcome,
+                                tenant=self.tenant or "").inc()
         return True
 
     def finish(self, n_tokens: int) -> None:
@@ -314,6 +363,8 @@ class RequestTrace:
         """Deadline expired before prefill; the scheduler dropped it."""
         if self._settle("shed"):
             self._m.sheds.inc()
+            if self.tenant:
+                self._m.tenant_sheds.labels(tenant=self.tenant).inc()
             self.record("shed", src="server")
 
     def abort(self, outcome: str = "cancelled") -> None:
@@ -437,6 +488,27 @@ class TierMetrics:
             "Hot-prefix replication pushes planned by the tier, by "
             "outcome (ok|failed|skipped_cost)",
             labels=("outcome",),
+        )
+        # Tier-side tenant admission shares the replica family name
+        # (registration is idempotent) so one catalog entry covers
+        # both enforcement points.
+        self.tenant_throttles = c(
+            "shellac_tenant_throttles_total",
+            "Per-tenant quota rejections (HTTP 429 + Retry-After), by "
+            "tenant and exhausted budget (rate|concurrency)",
+            labels=("tenant", "reason"),
+        )
+        self.autoscale_actions = c(
+            "shellac_autoscale_actions_total",
+            "Autoscaler decisions actually executed, by action "
+            "(scale_out: replica spawned via the factory; scale_down: "
+            "/drain posted to the least-loaded replica)",
+            labels=("action",),
+        )
+        self.autoscale_replicas = g(
+            "shellac_autoscale_replicas",
+            "Replica count the autoscaler last observed (its min/max "
+            "envelope input; present only when autoscaling is on)",
         )
 
 

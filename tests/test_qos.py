@@ -1,0 +1,899 @@
+"""Multi-tenant QoS conformance (ISSUE 18).
+
+Three layers, mirroring the implementation:
+
+  - pure-unit: token-bucket math, tenant-config validation (every
+    malformed shape dies a ValueError, never a guessed quota),
+    admission controller leases, the weighted-fair queue's deque
+    contract + DRR share math, and the autoscaler policy engine
+    driven tick-by-tick on a fake clock;
+  - tier-edge (stub replicas, no jax): the 429 + Retry-After throttle
+    answer, tenant-header forwarding on routed attempts, and the
+    autoscaler actuating a real router's membership (scale-out
+    through the factory, idle scale-down through drain);
+  - engine-level (tiny real engine, slow-marked like the disagg
+    precedent, run unfiltered in the qos CI job): per-tenant server
+    admission over HTTP, and the preempt -> park -> resume
+    acceptance — the preempted request's tokens are IDENTICAL to an
+    unpreempted run, dense and paged, greedy and seeded, with the
+    victim chosen by measured resident bytes.
+"""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from shellac_tpu.inference.autoscale import Autoscaler, AutoscalePolicy
+from shellac_tpu.inference.qos import (
+    ANONYMOUS,
+    TENANT_HEADER,
+    AdmissionController,
+    TenantPolicy,
+    TokenBucket,
+    WeightedFairQueue,
+)
+from shellac_tpu.obs import Registry
+
+
+def wait_until(cond, timeout=30.0, interval=0.02, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------
+# Token bucket
+# ---------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_burst_then_refill(self):
+        b = TokenBucket(rate=10.0, burst=20.0, now=0.0)
+        ok, wait = b.try_take(20.0, now=0.0)
+        assert ok and wait == 0.0
+        ok, wait = b.try_take(10.0, now=0.0)
+        assert not ok
+        assert wait == pytest.approx(1.0)  # 10 tokens at 10/s
+        ok, _ = b.try_take(10.0, now=1.0)
+        assert ok
+
+    def test_never_exceeds_burst(self):
+        b = TokenBucket(rate=100.0, burst=5.0, now=0.0)
+        assert b.try_take(5.0, now=1000.0)[0]
+        ok, _ = b.try_take(5.0, now=1000.0)
+        assert not ok
+
+    def test_cost_above_burst_hint_is_finite(self):
+        # A request bigger than the bucket can EVER hold still gets a
+        # finite retry hint (time to refill the full burst).
+        b = TokenBucket(rate=10.0, burst=10.0, now=0.0)
+        b.try_take(10.0, now=0.0)
+        ok, wait = b.try_take(50.0, now=0.0)
+        assert not ok
+        assert wait == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------
+# Tenant policy parsing — admission never guesses at a quota
+# ---------------------------------------------------------------------
+
+
+class TestTenantPolicy:
+    def test_parse_full_config(self):
+        pol = TenantPolicy.parse(json.dumps({
+            "alice": {"rate": 100, "burst": 500, "max_concurrency": 4,
+                      "priority": "interactive", "weight": 9},
+            "default": {"rate": 10, "priority": "batch"},
+        }))
+        a = pol.spec("alice")
+        assert a.rate == 100.0 and a.burst == 500.0
+        assert a.max_concurrency == 4
+        assert a.qos_class == 0 and a.qos_weight == 9.0
+
+    def test_tenants_wrapper_accepted(self):
+        pol = TenantPolicy.parse({"tenants": {"bob": {"rate": 5}}})
+        assert pol.spec("bob").rate == 5.0
+
+    def test_unknown_tenant_inherits_default_with_own_name(self):
+        pol = TenantPolicy.parse({"default": {"rate": 7,
+                                              "priority": "batch"}})
+        s = pol.spec("stranger")
+        assert s.name == "stranger"  # own bucket, default's limits
+        assert s.rate == 7.0 and s.priority == "batch"
+
+    def test_rate_without_burst_gets_one_second_depth(self):
+        pol = TenantPolicy.parse({"t": {"rate": 30}})
+        assert pol.spec("t").burst == 30.0
+
+    @pytest.mark.parametrize("raw", [
+        "not json {",
+        "[1, 2]",
+        {"t": 5},
+        {"t": {"tokens_per_s": 5}},          # unknown key
+        {"t": {"rate": 0}},
+        {"t": {"rate": -3}},
+        {"t": {"burst": 100}},               # burst without rate
+        {"t": {"rate": 5, "burst": -1}},
+        {"t": {"max_concurrency": 0}},
+        {"t": {"priority": "platinum"}},
+        {"t": {"rate": 5, "weight": 0}},
+        {"": {"rate": 5}},
+    ])
+    def test_malformed_config_raises(self, raw):
+        with pytest.raises(ValueError):
+            TenantPolicy.parse(raw)
+
+
+# ---------------------------------------------------------------------
+# Admission controller
+# ---------------------------------------------------------------------
+
+
+class TestAdmissionController:
+    def test_concurrency_quota_and_release(self):
+        ctl = AdmissionController(TenantPolicy.parse(
+            {"t": {"max_concurrency": 2}}))
+        assert ctl.admit("t", 1)[0]
+        assert ctl.admit("t", 1)[0]
+        ok, why, wait = ctl.admit("t", 1)
+        assert not ok and why == "concurrency" and wait > 0
+        ctl.release("t")
+        assert ctl.admit("t", 1)[0]
+
+    def test_rate_throttle_reason_and_hint(self):
+        ctl = AdmissionController(TenantPolicy.parse(
+            {"t": {"rate": 10, "burst": 10}}))
+        assert ctl.admit("t", 10, now=0.0)[0]
+        ok, why, wait = ctl.admit("t", 10, now=0.0)
+        assert not ok and why == "rate"
+        assert wait == pytest.approx(1.0)
+
+    def test_tenants_do_not_share_buckets(self):
+        ctl = AdmissionController(TenantPolicy.parse(
+            {"default": {"rate": 10, "burst": 10}}))
+        assert ctl.admit("a", 10, now=0.0)[0]
+        # b has its OWN bucket under the default limits: a's flood
+        # never consumes b's budget.
+        assert ctl.admit("b", 10, now=0.0)[0]
+        assert not ctl.admit("a", 1, now=0.0)[0]
+
+    def test_anonymous_maps_to_default(self):
+        ctl = AdmissionController(TenantPolicy.parse(
+            {"default": {"max_concurrency": 1}}))
+        assert ctl.admit(None, 1)[0]
+        ok, why, _ = ctl.admit(None, 1)
+        assert not ok and why == "concurrency"
+        assert ANONYMOUS in ctl.snapshot()
+
+    def test_snapshot_shape(self):
+        ctl = AdmissionController(TenantPolicy.parse(
+            {"t": {"rate": 1, "burst": 5, "priority": "interactive"}}))
+        ctl.admit("t", 5, now=0.0)
+        ctl.admit("t", 5, now=0.0)
+        snap = ctl.snapshot()["t"]
+        assert snap["inflight"] == 1
+        assert snap["admitted"] == 1 and snap["throttled"] == 1
+        assert snap["priority"] == "interactive"
+
+
+# ---------------------------------------------------------------------
+# Weighted-fair queue
+# ---------------------------------------------------------------------
+
+
+def _req(rid, n=4, max_new=4, cls=1, weight=4.0):
+    return types.SimpleNamespace(rid=rid, tokens=[0] * n,
+                                 max_new=max_new, qos_class=cls,
+                                 qos_weight=weight)
+
+
+class TestWeightedFairQueue:
+    def test_single_class_is_fifo(self):
+        q = WeightedFairQueue()
+        items = [_req(i) for i in range(8)]
+        for it in items:
+            q.append(it)
+        assert [q.popleft().rid for _ in range(8)] == list(range(8))
+        assert len(q) == 0 and not q
+
+    def test_appendleft_putback_pops_first(self):
+        q = WeightedFairQueue()
+        q.append(_req("a", cls=0))       # better class waiting...
+        back = _req("b", cls=2)
+        q.appendleft(back)               # ...but the put-back wins:
+        assert q.popleft() is back       # the engine's retry-first rule
+        assert q.popleft().rid == "a"
+
+    def test_pop_removes_most_recently_appended(self):
+        q = WeightedFairQueue()
+        q.append(_req("a", cls=0))
+        q.append(_req("b", cls=2))
+        assert q.pop().rid == "b"        # the importer's contract
+        assert q.pop().rid == "a"
+        with pytest.raises(IndexError):
+            q.pop()
+
+    def test_remove_and_iter(self):
+        q = WeightedFairQueue()
+        a, b, c = _req("a", cls=0), _req("b", cls=1), _req("c", cls=2)
+        for it in (a, b, c):
+            q.append(it)
+        q.remove(b)
+        assert [it.rid for it in q] == ["a", "c"]
+        with pytest.raises(ValueError):
+            q.remove(b)
+
+    def test_drr_share_tracks_weights(self):
+        # Equal-cost items, weight 8 vs 1, small quantum so several
+        # rotations happen: the interactive lane's serve share must
+        # track the 8:1 weight ratio, not starve batch entirely.
+        q = WeightedFairQueue(quantum=8.0)
+        for i in range(100):
+            q.append(_req(f"i{i}", n=4, max_new=4, cls=0, weight=8.0))
+            q.append(_req(f"b{i}", n=4, max_new=4, cls=2, weight=1.0))
+        first = [q.popleft().rid[0] for _ in range(90)]
+        i_served = first.count("i")
+        b_served = first.count("b")
+        assert b_served > 0              # no starvation
+        assert 5.0 <= i_served / b_served <= 12.0
+
+    def test_best_waiting_and_depths(self):
+        q = WeightedFairQueue()
+        assert q.best_waiting() is None
+        q.append(_req("b", cls=2))
+        q.append(_req("a", cls=0))
+        cls, head = q.best_waiting()
+        assert cls == 0 and head.rid == "a"
+        assert q.depths() == {0: 1, 2: 1}
+        q.clear()
+        assert q.depths() == {} and q.best_waiting() is None
+
+    def test_emptied_lane_forfeits_deficit(self):
+        # Standard DRR: an idle class must not bank credit. Drain a
+        # lane, refill it, and check service still interleaves (a
+        # banked deficit would let it monopolize).
+        q = WeightedFairQueue(quantum=8.0)
+        for i in range(4):
+            q.append(_req(f"x{i}", cls=0, weight=8.0))
+        while q:
+            q.popleft()
+        assert q._deficit == {}
+
+
+# ---------------------------------------------------------------------
+# Autoscaler policy engine (fake clock, fake actuators)
+# ---------------------------------------------------------------------
+
+
+class _Harness:
+    def __init__(self, policy=None, routable=2, total=2, load=0.0):
+        self.clock = 0.0
+        self.routable, self.total, self.load = routable, total, load
+        self.out_calls = 0
+        self.down_calls = 0
+        self.out_result = "http://new"
+        self.down_result = "http://victim"
+        self.events = []
+        self.scaler = Autoscaler(
+            policy or AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                      cooldown_s=10.0,
+                                      idle_after_s=30.0),
+            scale_out=self._out, scale_down=self._down,
+            observe=lambda: (self.routable, self.total, self.load),
+            on_action=lambda a, u, **d: self.events.append((a, u, d)),
+            now=lambda: self.clock,
+        )
+
+    def _out(self):
+        self.out_calls += 1
+        return self.out_result
+
+    def _down(self):
+        self.down_calls += 1
+        return self.down_result
+
+
+class TestAutoscalePolicy:
+    def test_envelope_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(high_load=0.1, idle_load=0.5)
+
+    def test_page_scales_out_after_boot_cooldown(self):
+        h = _Harness()
+        h.scaler.on_slo_transition("ttft", "ok", "page")
+        h.clock = 5.0
+        assert h.scaler.tick() is None          # still in boot cooldown
+        h.clock = 11.0
+        assert h.scaler.tick() == "scale_out"
+        assert h.out_calls == 1
+        assert h.events[-1][0] == "scale_out"
+        assert "slo-page:ttft" in h.events[-1][2]["reason"]
+
+    def test_recovery_disarms_pending_page(self):
+        h = _Harness()
+        h.scaler.on_slo_transition("ttft", "ok", "page")
+        h.scaler.on_slo_transition("ttft", "page", "ok")
+        h.clock = 60.0
+        assert h.scaler.tick() is None
+        assert h.out_calls == 0
+
+    def test_at_max_refuses_and_consumes_page(self):
+        h = _Harness(total=4)
+        h.scaler.on_slo_transition("ttft", "ok", "page")
+        h.clock = 11.0
+        assert h.scaler.tick() is None
+        assert h.out_calls == 0
+        assert h.events[-1][0] == "refused_at_max"
+        h.clock = 12.0
+        assert h.scaler.tick() is None          # consumed, no re-log
+        assert h.events[-1][0] == "refused_at_max"
+        assert len(h.events) == 1
+
+    def test_load_needs_consecutive_hot_ticks(self):
+        h = _Harness(routable=1, load=100.0)     # per-replica 100 > 16
+        h.clock = 11.0
+        assert h.scaler.tick() is None           # hot tick 1
+        h.clock = 12.0
+        assert h.scaler.tick() is None           # hot tick 2
+        h.clock = 13.0
+        assert h.scaler.tick() == "scale_out"    # hysteresis = 3
+        # One cold tick resets the streak.
+        h2 = _Harness(routable=1, load=100.0)
+        h2.clock = 11.0
+        h2.scaler.tick()
+        h2.load = 0.0
+        h2.clock = 12.0
+        h2.scaler.tick()
+        h2.load = 100.0
+        h2.clock = 13.0
+        h2.clock = 14.0
+        assert h2.scaler.tick() is None
+
+    def test_sustained_idle_drains_above_floor(self):
+        h = _Harness(routable=2, total=2, load=0.0)
+        h.clock = 11.0
+        assert h.scaler.tick() is None           # idle clock starts
+        h.clock = 40.0
+        assert h.scaler.tick() is None           # 29s < idle_after 30
+        h.clock = 42.0
+        assert h.scaler.tick() == "scale_down"
+        assert h.down_calls == 1
+
+    def test_idle_never_drains_below_floor(self):
+        h = _Harness(routable=1, total=1, load=0.0)
+        h.clock = 11.0
+        h.scaler.tick()
+        h.clock = 100.0
+        assert h.scaler.tick() is None
+        assert h.down_calls == 0
+
+    def test_cooldown_spans_actions_and_failures(self):
+        h = _Harness()
+        h.out_result = None                      # broken factory
+        h.scaler.on_slo_transition("ttft", "ok", "page")
+        h.clock = 11.0
+        assert h.scaler.tick() is None
+        assert h.events[-1][0] == "scale_out_failed"
+        h.clock = 12.0
+        assert h.scaler.tick() is None           # cooling down the retry
+        assert h.out_calls == 1
+        h.out_result = "http://new"
+        h.clock = 22.0
+        assert h.scaler.tick() == "scale_out"    # retried after cooldown
+        assert h.scaler.status()["failures"] == 1
+
+    def test_status_shape(self):
+        h = _Harness()
+        st = h.scaler.status()
+        assert st["min_replicas"] == 1 and st["max_replicas"] == 4
+        assert st["cooldown_remaining_s"] == pytest.approx(10.0)
+        assert st["last_action"] is None and st["actions"] == 0
+
+
+# ---------------------------------------------------------------------
+# Tier edge: stub replicas, real router, no jax
+# ---------------------------------------------------------------------
+
+
+class _Stub:
+    """Minimal scriptable replica: healthy /health, empty /metrics,
+    a /generate that records request headers, a /drain that flips
+    draining state."""
+
+    def __init__(self):
+        self.mode = "ok"
+        self.seen_headers = []
+        self.lock = threading.Lock()
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    if stub.mode == "draining":
+                        self._send(503, {"status": "draining",
+                                         "ok": False, "pending": 0})
+                    else:
+                        self._send(200, {"status": "ok", "ok": True,
+                                         "pending": 0,
+                                         "role": "monolith"})
+                elif self.path == "/metrics":
+                    self.send_response(200)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                else:
+                    self._send(404, {"error": "nope"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                if self.path == "/drain":
+                    payload = {}
+                    stub.mode = "draining"
+                    self._send(200, {"status": "draining",
+                                     "draining": True, "pending": 0})
+                    return
+                with stub.lock:
+                    stub.seen_headers.append(dict(self.headers))
+                self._send(200, {"tokens": [1], "text": "x"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def _router_over(urls, **kw):
+    from shellac_tpu.inference.tier import TierRouter
+
+    kw.setdefault("registry", Registry())
+    kw.setdefault("health_interval", 0.1)
+    kw.setdefault("backoff_base", 0.02)
+    r = TierRouter(list(urls), **kw)
+    wait_until(lambda: all(x.state == "healthy" for x in r.replicas),
+               timeout=15, msg="replicas healthy")
+    return r
+
+
+class TestTierEdgeAdmission:
+    def test_tenant_header_forwarded_and_throttled(self):
+        from shellac_tpu.inference.tier import make_tier_http_server
+
+        stub = _Stub()
+        router = _router_over([stub.url], tenant_config={
+            "miser": {"rate": 1, "burst": 40},
+        })
+        httpd = make_tier_http_server(router)
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            body = json.dumps({"tokens": [1, 2, 3],
+                               "max_new": 16}).encode()
+
+            def post(tenant):
+                req = urllib.request.Request(
+                    base + "/generate", data=body,
+                    headers={"Content-Type": "application/json",
+                             TENANT_HEADER: tenant},
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status
+
+            # cost = 3 prompt + 16 decode = 19; burst 40 admits two.
+            assert post("miser") == 200
+            assert post("miser") == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post("miser")
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            err = json.loads(ei.value.read())
+            assert err["reason"] == "rate"
+            # The admitted attempts carried the tenant header to the
+            # replica (the trace-header twin).
+            with stub.lock:
+                tenants = [h.get(TENANT_HEADER)
+                           or h.get(TENANT_HEADER.title())
+                           for h in stub.seen_headers]
+            assert tenants.count("miser") == 2
+            # Throttle counted per tenant on the tier's exposition.
+            text = router.metrics_text()
+            assert "shellac_tenant_throttles_total" in text
+            assert 'tenant="miser"' in text
+            # /stats carries the per-tenant snapshot.
+            snap = router.stats()["tenants"]
+            assert snap["miser"]["admitted"] == 2
+            assert snap["miser"]["throttled"] == 1
+            assert router.stats()["autoscale"] is None  # flag off
+        finally:
+            httpd.shutdown()
+            router.close()
+            stub.close()
+
+    def test_anonymous_traffic_untouched_without_config(self):
+        stub = _Stub()
+        router = _router_over([stub.url])
+        try:
+            status, body, _ = router.forward_json(
+                "/generate", {"tokens": [1], "max_new": 2})
+            assert status == 200
+            assert router.stats()["tenants"] is None
+        finally:
+            router.close()
+            stub.close()
+
+
+class TestTierAutoscaleActuation:
+    def test_page_scale_out_then_idle_drain(self):
+        spawned = []
+
+        def factory(template_url):
+            s = _Stub()
+            spawned.append(s)
+            return s.url
+
+        stub = _Stub()
+        reg = Registry()
+        router = _router_over(
+            [stub.url],
+            registry=reg,
+            replica_factory=factory,
+            autoscale=AutoscalePolicy(
+                min_replicas=1, max_replicas=2, cooldown_s=0.3,
+                idle_after_s=0.5, idle_load=0.5,
+            ),
+        )
+        try:
+            time.sleep(0.35)                     # boot cooldown
+            router._autoscaler.on_slo_transition("ttft", "ok", "page")
+            wait_until(lambda: len(router.replicas) == 2, timeout=10,
+                       msg="scale-out appended a replica")
+            assert len(spawned) == 1
+            assert reg.value("shellac_autoscale_actions_total",
+                             action="scale_out") == 1
+            st = router.stats()["autoscale"]
+            assert st["last_action"] == "scale_out"
+            # The decision is on the fleet timeline.
+            events = [e for e in router.recorder.tail(64)
+                      if e.get("event") == "autoscale"]
+            assert any(e.get("action") == "scale_out" for e in events)
+
+            # Now sustained idle (stub load is zero): the autoscaler
+            # drains the least-loaded replica — but never below min.
+            wait_until(
+                lambda: reg.value("shellac_autoscale_actions_total",
+                                  action="scale_down") == 1,
+                timeout=15, msg="idle scale-down",
+            )
+            wait_until(
+                lambda: any(r.state == "draining"
+                            for r in router.replicas),
+                timeout=10, msg="victim draining",
+            )
+            # Floor holds: one routable replica remains and no second
+            # drain fires.
+            time.sleep(1.0)
+            assert reg.value("shellac_autoscale_actions_total",
+                             action="scale_down") == 1
+        finally:
+            router.close()
+            stub.close()
+            for s in spawned:
+                s.close()
+
+    def test_no_autoscale_constructs_nothing(self):
+        stub = _Stub()
+        router = _router_over([stub.url])
+        try:
+            assert router._autoscaler is None
+            assert router.stats()["autoscale"] is None
+        finally:
+            router.close()
+            stub.close()
+
+
+# ---------------------------------------------------------------------
+# Engine-level: per-tenant server admission + preempt/park/resume
+# (slow-marked; run unfiltered in the qos CI job)
+# ---------------------------------------------------------------------
+
+
+TENANTS = {
+    "free": {"rate": 1, "burst": 40},
+    "batch-t": {"priority": "batch"},
+    "inter-t": {"priority": "interactive"},
+}
+
+
+def _tiny():
+    from shellac_tpu import get_model_config
+
+    return get_model_config("tiny").replace(dtype="float32")
+
+
+def _mk_server(tmp_path=None, **kw):
+    import jax
+
+    from shellac_tpu.inference.cache import engine_class
+    from shellac_tpu.inference.server import (
+        InferenceServer,
+        make_http_server,
+    )
+    from shellac_tpu.models import transformer
+
+    cfg = _tiny()
+    params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+    reg = Registry()
+    backend = kw.pop("cache_backend", "dense")
+    eng = engine_class(backend)(
+        cfg, params, n_slots=kw.pop("n_slots", 1),
+        max_len=kw.pop("max_len", 64),
+        temperature=kw.pop("temperature", 0.0),
+        cache_backend=backend,
+    )
+    srv = InferenceServer(cfg, params, registry=reg, engine=eng, **kw)
+    httpd = make_http_server(srv)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    return srv, httpd, base, cfg, params, reg
+
+
+def _post(base, payload, tenant=None, timeout=300):
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers[TENANT_HEADER] = tenant
+    req = urllib.request.Request(
+        f"{base}/generate", data=json.dumps(payload).encode(),
+        headers=headers,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.slow
+class TestServerTenantAdmission:
+    def test_throttle_429_metrics_and_stats(self):
+        srv, httpd, base, _, _, reg = _mk_server(tenant_config=TENANTS)
+        try:
+            # cost = 3 prompt + 16 decode = 19; burst 40, rate 1/s.
+            _post(base, {"tokens": [1, 2, 3], "max_new": 16},
+                  tenant="free")
+            _post(base, {"tokens": [1, 2, 3], "max_new": 16},
+                  tenant="free")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(base, {"tokens": [1, 2, 3], "max_new": 16},
+                      tenant="free")
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            # Anonymous traffic rides free: no quota configured for it.
+            _post(base, {"tokens": [9], "max_new": 2})
+            assert reg.value("shellac_tenant_throttles_total",
+                             tenant="free", reason="rate") == 1
+            assert reg.value("shellac_admission_rejects_total",
+                             reason="throttled", tenant="free") == 1
+            # Both admitted requests charged prompt + budget = 19 each.
+            assert reg.value("shellac_tenant_tokens_admitted_total",
+                             tenant="free") == 38
+            # /stats carries the QoS block.
+            with urllib.request.urlopen(f"{base}/stats",
+                                        timeout=30) as r:
+                stats = json.loads(r.read())
+            qos = stats["qos"]
+            assert qos["tenants"]["free"]["throttled"] == 1
+            assert "queue_depths" in qos
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+    def test_malformed_config_fails_construction(self):
+        import jax
+
+        from shellac_tpu.inference.server import InferenceServer
+        from shellac_tpu.models import transformer
+
+        cfg = _tiny()
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError):
+            InferenceServer(cfg, params, registry=Registry(),
+                            tenant_config={"t": {"rate": -1}})
+        with pytest.raises(ValueError):
+            InferenceServer(cfg, params, registry=Registry(),
+                            preempt_after=0.0)
+
+    def test_debug_requests_show_tenant(self):
+        srv, httpd, base, _, _, _ = _mk_server(tenant_config=TENANTS)
+        try:
+            seen = {}
+
+            def long_req():
+                _post(base, {"tokens": [2, 3], "max_new": 30},
+                      tenant="batch-t")
+
+            t = threading.Thread(target=long_req, daemon=True)
+            t.start()
+
+            def has_tenant_row():
+                with urllib.request.urlopen(f"{base}/debug/requests",
+                                            timeout=30) as r:
+                    rows = json.loads(r.read()).get("in_flight", [])
+                for row in rows:
+                    if row.get("tenant") == "batch-t":
+                        seen.update(row)
+                        return True
+                return False
+
+            wait_until(has_tenant_row, timeout=120,
+                       msg="tenant on a debug row")
+            assert seen["state"] in ("queued", "prefilling",
+                                     "decoding", "parked")
+            t.join(timeout=300)
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+
+@pytest.mark.slow
+class TestPreemptParkResume:
+    """The acceptance: preemption is invisible to the victim's client
+    except latency — its token stream is IDENTICAL to an unpreempted
+    run."""
+
+    @pytest.mark.parametrize("backend", ["dense", "paged"])
+    def test_token_identity_greedy(self, backend, tmp_path):
+        import jax
+        import numpy as np
+
+        from shellac_tpu.inference.engine import Engine
+
+        srv, httpd, base, cfg, params, _ = _mk_server(
+            tenant_config=TENANTS, preempt_after=0.05, max_len=128,
+            cache_backend=backend, park_dir=str(tmp_path),
+        )
+        try:
+            # Warm the compile caches so the chaos clock below starts
+            # on a hot engine.
+            _post(base, {"tokens": [1, 2, 3], "max_new": 2})
+
+            prompt = [5, 6, 7]
+            ref = Engine(cfg, params, temperature=0.0,
+                         max_len=128).generate(
+                np.asarray([prompt], np.int32), max_new_tokens=100)
+            want = np.asarray(ref.tokens)[0].tolist()
+
+            out = {}
+
+            def victim():
+                out["got"] = _post(
+                    base, {"tokens": prompt, "max_new": 100},
+                    tenant="batch-t")["tokens"]
+
+            t = threading.Thread(target=victim, daemon=True)
+            t.start()
+            eng = srv._g.engine
+            wait_until(lambda: len(eng.preemptable()) == 1,
+                       timeout=120, msg="victim decoding")
+            # The interactive request finds no free slot; past
+            # preempt_after the batch victim is frozen, parked, and
+            # later resumed — mid-window, token-exact.
+            quick = _post(base, {"tokens": [9, 9], "max_new": 2},
+                          tenant="inter-t")
+            assert len(quick["tokens"]) == 2
+            t.join(timeout=300)
+            assert not t.is_alive()
+            assert out["got"] == want
+            assert eng.stats["preemptions"] >= 1
+            # The park-spool safety copy landed (fire-and-forget,
+            # allow it a moment).
+            from shellac_tpu.inference.fabric import KVParkStore
+
+            def parked():
+                return any(e["park_id"].startswith("preempt-")
+                           for e in KVParkStore(str(tmp_path)).list())
+
+            wait_until(parked, timeout=30, msg="park safety copy")
+            # The flight recorder tells the story end to end.
+            kinds = [e.get("event") for e in srv.recorder.tail(srv.recorder.capacity)]
+            assert "preempt" in kinds
+            assert "preempt-park" in kinds
+            assert "preempt-resume" in kinds
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+    def test_token_identity_seeded(self):
+        srv, httpd, base, cfg, params, _ = _mk_server(
+            tenant_config=TENANTS, preempt_after=0.05, max_len=128)
+        try:
+            _post(base, {"tokens": [1, 2, 3], "max_new": 2})
+            prompt = [4, 5, 6]
+            samp = {"temperature": 1.0, "seed": 11}
+            # Reference: the same server, uncontended (no waiter, so
+            # nothing preempts) — seeded sampling is deterministic.
+            want = _post(base, {"tokens": prompt, "max_new": 100,
+                                **samp}, tenant="batch-t")["tokens"]
+
+            out = {}
+
+            def victim():
+                out["got"] = _post(
+                    base, {"tokens": prompt, "max_new": 100, **samp},
+                    tenant="batch-t")["tokens"]
+
+            t = threading.Thread(target=victim, daemon=True)
+            t.start()
+            eng = srv._g.engine
+            wait_until(lambda: len(eng.preemptable()) == 1,
+                       timeout=120, msg="victim decoding")
+            _post(base, {"tokens": [8], "max_new": 2},
+                  tenant="inter-t")
+            t.join(timeout=300)
+            assert out["got"] == want
+            assert eng.stats["preemptions"] >= 1
+        finally:
+            httpd.shutdown()
+            srv.close()
+
+    def test_victim_is_cheapest_resident(self):
+        # Two batch decodes, asymmetric prompt lengths: the rule says
+        # preempt the FEWEST parked bytes — the short-prompt slot.
+        srv, httpd, base, _, _, _ = _mk_server(
+            tenant_config=TENANTS, preempt_after=0.05, n_slots=2,
+            max_len=128)
+        try:
+            _post(base, {"tokens": [1, 2, 3], "max_new": 2})
+            done = []
+
+            def run(tokens, n):
+                done.append(_post(base, {"tokens": tokens,
+                                         "max_new": n},
+                                  tenant="batch-t"))
+
+            big = threading.Thread(
+                target=run, args=([11] * 12, 100), daemon=True)
+            small = threading.Thread(
+                target=run, args=([7, 8], 100), daemon=True)
+            big.start()
+            small.start()
+            eng = srv._g.engine
+            wait_until(lambda: len(eng.preemptable()) == 2,
+                       timeout=120, msg="both victims decoding")
+            _post(base, {"tokens": [3], "max_new": 2},
+                  tenant="inter-t")
+            big.join(timeout=300)
+            small.join(timeout=300)
+            assert len(done) == 2
+            parks = [e for e in srv.recorder.tail(srv.recorder.capacity)
+                     if e.get("event") == "preempt-park"]
+            assert parks
+            # Fewest resident tokens won the victim election.
+            assert min(p["resident_tokens"] for p in parks) \
+                == parks[0]["resident_tokens"]
+        finally:
+            httpd.shutdown()
+            srv.close()
