@@ -1256,6 +1256,15 @@ def cmd_trace_report(args):
         raise SystemExit(f"trace-report: {e}")
 
 
+def cmd_scenarios(args):
+    """Scenario-matrix SLO gate: workload-model traffic x chaos x
+    per-scenario SLO assertions, verdicts folded into
+    SCENARIO_LEDGER.json (docs/scenarios.md)."""
+    from shellac_tpu.inference import scenarios
+
+    return scenarios.cli_run(args)
+
+
 def cmd_convert(args):
     """HF checkpoint directory -> native orbax params + config JSON."""
     import dataclasses as dc
@@ -2041,6 +2050,62 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--json", action="store_true",
                     help="print the report/diff as JSON")
     tr.set_defaults(fn=cmd_trace_report)
+
+    sc = sub.add_parser(
+        "scenarios",
+        help="scenario-matrix SLO gate: run workload-model traffic "
+             "against a replica, assert per-scenario SLOs, fold "
+             "verdicts into SCENARIO_LEDGER.json",
+    )
+    sc.add_argument("--list", action="store_true",
+                    help="print the scenario catalog and exit")
+    sc.add_argument("--gate", action="store_true",
+                    help="run the fast gate subset and compare the "
+                         "stable verdict rows against --ledger "
+                         "(exit 1 SLO failure, 2 schema drift, "
+                         "3 stale ledger)")
+    sc.add_argument("--check", action="store_true",
+                    help="no traffic: schema-check the committed "
+                         "ledger and diff its statically-recomputable "
+                         "fields (exit 2 drift, 3 stale)")
+    sc.add_argument("--update-ledger", action="store_true",
+                    dest="update_ledger",
+                    help="run the gate set and rewrite --ledger")
+    sc.add_argument("--ledger", default="SCENARIO_LEDGER.json",
+                    help="committed baseline path "
+                         "(default SCENARIO_LEDGER.json)")
+    sc.add_argument("--target", default=None,
+                    help="base URL of a live replica/tier to drive; "
+                         "default self-hosts tiny in-process replicas")
+    sc.add_argument("--scenario", action="append", default=None,
+                    help="run only this scenario (repeatable)")
+    sc.add_argument("--all", action="store_true",
+                    help="include gate=False scenarios (subprocess "
+                         "chaos) in the default selection")
+    sc.add_argument("--seed", type=int, default=None,
+                    help="override every workload seed (changes "
+                         "fingerprints: not valid with "
+                         "--update-ledger)")
+    sc.add_argument("--duration-scale", type=float, default=1.0,
+                    dest="duration_scale",
+                    help="scale workload durations (burst offsets "
+                         "and ramps scale with them)")
+    sc.add_argument("--timeout", type=float, default=30.0,
+                    help="per-request deadline handed to the server")
+    sc.add_argument("--incident-dir", default=None,
+                    dest="incident_dir",
+                    help="incident bundle directory for self-hosted "
+                         "replicas (an SLO breach fires "
+                         "POST /debug/incident)")
+    sc.add_argument("--induce-violation", action="store_true",
+                    dest="induce_violation",
+                    help="self-test: swap every assertion for an "
+                         "impossible SLO so the gate MUST fail "
+                         "(proves a green gate means something)")
+    sc.add_argument("--out", default=None,
+                    help="write full (non-stable) verdict rows to "
+                         "this JSON file")
+    sc.set_defaults(fn=cmd_scenarios)
 
     k = sub.add_parser("tokenize", help="encode text files into a token shard")
     k.add_argument("--input", nargs="+", required=True, help="text files")

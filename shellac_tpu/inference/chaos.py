@@ -19,18 +19,28 @@ the failure classes a multi-replica tier actually meets:
     (the CLI path operators run), so a SIGKILL is a true process
     death: sockets reset, no goodbye, exactly what a preempted node
     looks like to the tier.
-  - `LoadGenerator`: sustained closed-loop non-streaming traffic with
-    per-request deadlines, counting outcomes — the background load the
-    acceptance scenarios (kill under load, drain under load) assert
-    "zero failures" against. Payloads may carry a reserved `tenant`
-    key (sent as the x-shellac-tenant header, never in the body), and
-    the tally splits per tenant — the starvation scenarios assert
-    "the interactive tenant saw zero rejections" directly against it.
-    The shape helpers (`zipf_tenant_mix`, `abusive_burst_mix`,
-    `interactive_batch_mix`) build multi-tenant payload lists with the
-    traffic skews real fleets meet: Zipf tenant popularity, one
-    abusive tenant at N× everyone else, and an interactive-vs-batch
-    class split.
+  - `LoadGenerator`: sustained traffic with per-request deadlines,
+    counting outcomes — the background load the acceptance scenarios
+    (kill under load, drain under load) assert "zero failures"
+    against. Two drive modes: the original CLOSED loop (`concurrency`
+    workers back-to-back — throughput-coupled, the server slowing
+    down slows the offered load) and an OPEN loop (`schedule=` or
+    `rate=` — arrival-driven, the production shape where traffic does
+    not care that the server is slow; `run()` plays a deterministic
+    (arrival_s, payload) schedule, e.g. from
+    `workload.WorkloadModel.payload_schedule()`). Payloads may carry
+    reserved client-side keys — `tenant` (sent as the
+    x-shellac-tenant header, never in the body), `kind` (a label for
+    the tally), `stream` + `cancel_after_deltas` (read the NDJSON
+    stream and optionally sever it mid-flight: the client-cancel
+    path) — and the tally splits per tenant; with `capture=True`
+    every request also leaves a result row (latency, TTFT, outcome,
+    trace id) the scenario gate computes SLIs from. `seed=` makes
+    closed-loop payload draws deterministic. The shape helpers
+    (`zipf_tenant_mix`, `abusive_burst_mix`, `interactive_batch_mix`)
+    build multi-tenant payload lists with the traffic skews real
+    fleets meet: Zipf tenant popularity, one abusive tenant at N×
+    everyone else, and an interactive-vs-batch class split.
 
 Injectors never reach into `TierRouter` or `InferenceServer`
 internals; docs/serving_tier.md documents the contract they exercise.
@@ -337,15 +347,48 @@ class ReplicaProc:
 
 
 class LoadGenerator:
-    """Closed-loop background load through the tier: `concurrency`
-    threads each issue non-streaming POSTs back-to-back until stopped,
-    tallying outcomes. The chaos scenarios run their injections under
-    this and then assert the tally (e.g. zero non-ok outcomes while a
-    replica was killed)."""
+    """Background load through the tier, in two drive modes.
+
+    CLOSED (the default, the original behavior): `concurrency`
+    threads each issue POSTs back-to-back until stopped — offered
+    load couples to server throughput, which is what the chaos
+    acceptance scenarios want ("zero failures while a replica was
+    killed"). `seed=` makes each worker draw its payload sequence
+    from a seeded rng instead of cycling by index, so a multi-shape
+    closed run is reproducible.
+
+    OPEN (`schedule=` a sorted [(arrival_s, payload), ...] list, or
+    `rate=` + `duration=` for seeded Poisson arrivals over
+    `payloads`): a dispatcher fires each request at its arrival
+    offset regardless of how the server is doing — the production
+    shape an SLO gate must measure under, because a slow server and
+    open-loop arrivals is exactly how queues actually build. Arrivals
+    never block on in-flight work; past `max_in_flight` the request
+    is counted `client_saturated` (the load generator ran out of
+    client capacity — loud, never silently re-timed). `run()` plays
+    the whole schedule and returns the tally.
+
+    Payloads may carry reserved client-side keys: `tenant` (the
+    x-shellac-tenant header), `kind` (tally label only), `stream`
+    (read the NDJSON stream; `stream` DOES go to the wire) and
+    `cancel_after_deltas` (sever the stream after N delta lines — the
+    client-cancel path; tallied `cancelled`). A stream that ends
+    without its `{"done": ...}` line is `stream_severed`. With
+    `capture=True` each request appends a result row to `.results`:
+    arrival/latency/TTFT seconds, outcome, tenant, kind, and the
+    trace id from the response's x-request-id header — the raw
+    material the scenario gate computes SLIs and violating-trace
+    exemplars from."""
 
     def __init__(self, base_url: str, *, path: str = "/generate",
                  payloads: Optional[List[dict]] = None,
-                 concurrency: int = 4, timeout: float = 30.0):
+                 concurrency: int = 4, timeout: float = 30.0,
+                 schedule: Optional[List] = None,
+                 rate: Optional[float] = None,
+                 duration: Optional[float] = None,
+                 seed: Optional[int] = None,
+                 max_in_flight: int = 64,
+                 capture: bool = False):
         self.base_url = base_url.rstrip("/")
         self.path = path
         # One payload per worker (cycled): distinct prompts give the
@@ -357,14 +400,40 @@ class LoadGenerator:
         ]
         self.concurrency = concurrency
         self.timeout = timeout
+        self.seed = seed
+        self.capture = bool(capture)
+        self.max_in_flight = int(max_in_flight)
+        if rate is not None and rate <= 0:
+            raise ValueError(f"rate must be > 0 (got {rate})")
+        if rate is not None and duration is None and schedule is None:
+            raise ValueError("open-loop rate= needs duration=")
+        if schedule is not None:
+            self.schedule = [(float(t), dict(p)) for t, p in schedule]
+            self.schedule.sort(key=lambda tp: tp[0])
+        elif rate is not None:
+            # Seeded Poisson arrivals over the payload list, cycled.
+            rng = random.Random(seed if seed is not None else 0)
+            self.schedule = []
+            t, i = 0.0, 0
+            while True:
+                t += rng.expovariate(rate)
+                if t >= duration:
+                    break
+                self.schedule.append(
+                    (t, dict(self.payloads[i % len(self.payloads)])))
+                i += 1
+        else:
+            self.schedule = None  # closed loop
         self.counts: Dict[str, int] = {}
         # Per-tenant outcome split (only for payloads that carried a
         # `tenant` key): {tenant: {outcome: count}}.
         self.by_tenant: Dict[str, Dict[str, int]] = {}
         self.errors: List[str] = []
+        self.results: List[dict] = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._in_flight = threading.Semaphore(self.max_in_flight)
 
     def _tally(self, key: str, detail: str = "",
                tenant: Optional[str] = None) -> None:
@@ -376,43 +445,113 @@ class LoadGenerator:
             if detail and len(self.errors) < 50:
                 self.errors.append(detail)
 
-    def _one(self, body: bytes,
-             tenant: Optional[str] = None) -> None:
+    def _record(self, row: dict) -> None:
+        if not self.capture:
+            return
+        with self._lock:
+            self.results.append(row)
+
+    def _one(self, payload: dict, arrival_s: Optional[float] = None
+             ) -> None:
+        """Issue one request (streaming or not), tally the outcome,
+        and capture a result row. `payload` still carries its
+        reserved keys; they are stripped here."""
+        p = dict(payload)
+        tenant = p.pop("tenant", None)
+        kind = p.pop("kind", None)
+        cancel_after = p.pop("cancel_after_deltas", None)
+        stream = bool(p.get("stream"))
+        p.setdefault("timeout", self.timeout)
+        body = json.dumps(p).encode()
         headers = {"Content-Type": "application/json"}
         if tenant is not None:
             headers[TENANT_HEADER] = tenant
         req = urllib.request.Request(
             self.base_url + self.path, data=body, headers=headers,
         )
+        t0 = time.monotonic()
+        row = {"arrival_s": arrival_s, "tenant": tenant, "kind": kind,
+               "stream": stream, "trace_id": None, "ttft_s": None,
+               "latency_s": None, "status": None, "outcome": None}
+
+        def settle(outcome: str, detail: str = "") -> None:
+            row["latency_s"] = time.monotonic() - t0
+            row["outcome"] = outcome
+            self._tally(outcome, detail, tenant=tenant)
+            self._record(row)
+
         try:
             # Read timeout sits above the request deadline so the TIER
             # classifies a blown deadline (504), not the client socket.
             with urllib.request.urlopen(req,
                                         timeout=self.timeout + 15) as r:
-                r.read()
-                self._tally("ok" if r.status == 200
-                            else f"http_{r.status}", tenant=tenant)
+                row["status"] = r.status
+                row["trace_id"] = r.headers.get("x-request-id")
+                if not stream:
+                    r.read()
+                    settle("ok" if r.status == 200
+                           else f"http_{r.status}")
+                    return
+                # NDJSON stream: each line is a delta until the
+                # {"done": ...} record. TTFT = first delta line.
+                deltas = 0
+                done = False
+                for raw in r:
+                    if not raw.strip():
+                        continue
+                    try:
+                        obj = json.loads(raw)
+                    except ValueError:
+                        settle("stream_garbled", raw[:120].decode(
+                            errors="replace"))
+                        return
+                    if obj.get("error"):
+                        settle("stream_error", str(obj)[:200])
+                        return
+                    if obj.get("done"):
+                        done = True
+                        break
+                    deltas += 1
+                    if row["ttft_s"] is None:
+                        row["ttft_s"] = time.monotonic() - t0
+                    if (cancel_after is not None
+                            and deltas >= cancel_after):
+                        # Client cancel: just stop reading and close
+                        # the socket (the `with` does) — the server
+                        # sees the hangup and settles `cancelled`.
+                        settle("cancelled")
+                        return
+                settle("ok" if done else "stream_severed")
         except urllib.error.HTTPError as e:
+            row["status"] = e.code
+            row["trace_id"] = e.headers.get("x-request-id")
             detail = ""
             try:
                 detail = e.read().decode(errors="replace")[:200]
             except OSError:
                 pass
-            self._tally(f"http_{e.code}", f"{e.code}: {detail}",
-                        tenant=tenant)
+            settle(f"http_{e.code}", f"{e.code}: {detail}")
         except (OSError, urllib.error.URLError) as e:
-            self._tally("connect_error", repr(e), tenant=tenant)
+            settle("connect_error", repr(e))
+
+    # ---- closed loop -------------------------------------------------
 
     def _loop(self, idx: int) -> None:
-        payload = dict(self.payloads[idx % len(self.payloads)])
-        # Reserved key, not a sampling knob: rides as the tenant
-        # header, never in the replica-bound JSON body.
-        tenant = payload.pop("tenant", None)
-        body = json.dumps({**payload, "timeout": self.timeout}).encode()
+        rng = (random.Random(f"{self.seed}:{idx}")
+               if self.seed is not None else None)
         while not self._stop.is_set():
-            self._one(body, tenant=tenant)
+            if rng is not None:
+                payload = rng.choice(self.payloads)
+            else:
+                payload = self.payloads[idx % len(self.payloads)]
+            self._one(payload)
 
     def start(self) -> "LoadGenerator":
+        if self.schedule is not None:
+            t = threading.Thread(target=self._dispatch, daemon=True)
+            t.start()
+            self._threads.append(t)
+            return self
         for i in range(self.concurrency):
             t = threading.Thread(target=self._loop, args=(i,), daemon=True)
             t.start()
@@ -425,6 +564,60 @@ class LoadGenerator:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=self.timeout + 30)
+        with self._lock:
+            return dict(self.counts)
+
+    # ---- open loop ---------------------------------------------------
+
+    def _dispatch(self) -> None:
+        """Play the schedule: sleep to each arrival offset, fire the
+        request on its own thread. Firing never waits on in-flight
+        work — that is the open-loop contract."""
+        fired: List[threading.Thread] = []
+        t0 = time.monotonic()
+        for arrival_s, payload in self.schedule:
+            if self._stop.is_set():
+                break
+            delay = t0 + arrival_s - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                break
+            if not self._in_flight.acquire(blocking=False):
+                self._tally("client_saturated",
+                            tenant=payload.get("tenant"))
+                self._record({
+                    "arrival_s": arrival_s,
+                    "tenant": payload.get("tenant"),
+                    "kind": payload.get("kind"),
+                    "stream": bool(payload.get("stream")),
+                    "trace_id": None, "ttft_s": None,
+                    "latency_s": None, "status": None,
+                    "outcome": "client_saturated",
+                })
+                continue
+
+            def fire(p=payload, a=arrival_s):
+                try:
+                    self._one(p, arrival_s=a)
+                finally:
+                    self._in_flight.release()
+
+            th = threading.Thread(target=fire, daemon=True)
+            th.start()
+            fired.append(th)
+        for th in fired:
+            th.join(timeout=self.timeout + 30)
+
+    def run(self) -> Dict[str, int]:
+        """Open-loop only: play the whole schedule to completion
+        (blocking) and return the tally."""
+        if self.schedule is None:
+            raise RuntimeError(
+                "run() needs an open-loop schedule (schedule= or "
+                "rate=+duration=); use start()/stop() for closed loop"
+            )
+        self.start()
+        for t in self._threads:
+            t.join()
         with self._lock:
             return dict(self.counts)
 

@@ -1,0 +1,1149 @@
+"""Scenario-matrix SLO gate: workload × chaos × SLO assertions.
+
+`bench_decode.py --gate` answers "is the engine still fast";
+`python -m shellac_tpu scenarios --gate` answers "does the fleet
+still meet its SLOs under realistic load". Each `Scenario` is one
+cell of the matrix:
+
+    workload model (inference/workload.py — seeded, deterministic)
+  × optional chaos injection (inference/chaos.py — proxy faults,
+    replica SIGKILL)
+  × per-scenario SLO assertions (obs/slo.py spec grammar, e.g.
+    `availability@80`, `e2e<25s@80`, `ttft_p95<20s@80`)
+
+run against a live replica (`--target URL`) or a self-hosted tiny
+in-process server (the CI path), producing a schema-checked verdict
+row per scenario:
+
+  - `pass` — every SLO's final good fraction met its objective
+  - `fail` — an SLO finished below objective; the runner fires a
+    PR 13 incident bundle (POST /debug/incident) whose manifest
+    names a violating request's trace id, resolvable via
+    `/debug/request/<id>`
+  - `skip` — the target cannot run the scenario for a NAMED reason:
+    spec engines refuse features in `spec_batching.EXCLUSIONS`
+    (`excluded: overlap_decode`), or a live target has a required
+    flag off (`disabled: overlap_prefill`). Exclusion-matrix
+    fallbacks are verdicts, never silent passes — ROADMAP item 5's
+    spec-pipeline hole stays visible in the ledger.
+
+The stable projection of the rows (names, verdicts, skip reasons,
+SLO spec strings, seeds, workload fingerprints — nothing timed) is
+committed to `SCENARIO_LEDGER.json` exactly like BENCH_LEDGER.json:
+`--check` detects schema drift (exit 2) and staleness (exit 3)
+without running anything, `--gate` runs the fast subset and compares
+(exit 1 on any SLO failure), `--update-ledger` rewrites the baseline.
+`--induce-violation` swaps every assertion for an impossible one —
+the CI self-test that proves the gate can actually fail.
+
+SLIs are measured CLIENT-side from the load generator's captured
+result rows (TTFT = first NDJSON delta, e2e = settled wall time,
+availability = non-error outcomes; a client cancel counts good — the
+user hung up, the fleet did not fail). SLO assertions are restricted
+to client-measurable SLIs (`ttft`, `e2e`, `availability`) and a
+config using anything else dies at registry build, not mid-run.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+from shellac_tpu.inference.chaos import ChaosProxy, LoadGenerator, ReplicaProc
+from shellac_tpu.inference.spec_batching import EXCLUSIONS
+from shellac_tpu.inference.workload import (
+    Burst,
+    Diurnal,
+    WorkloadConfig,
+    WorkloadModel,
+)
+from shellac_tpu.obs import (
+    TRACE_HEADER,
+    FlightRecorder,
+    Registry,
+    ScenarioMetrics,
+    SLOEngine,
+    format_trace_header,
+    parse_slo_specs,
+)
+
+LEDGER_SCHEMA = 1
+DEFAULT_LEDGER = "SCENARIO_LEDGER.json"
+
+#: SLIs the client-side gate can measure from captured result rows.
+#: `tpot` and `queue_wait` are server-internal; asserting them here
+#: would silently measure nothing, so the registry refuses them.
+GATE_SLIS = ("ttft", "e2e", "availability")
+
+#: Client outcomes that count GOOD for availability: the request was
+#: served, or the CLIENT chose to hang up mid-stream.
+_GOOD_OUTCOMES = ("ok", "cancelled")
+
+#: The impossible assertion `--induce-violation` swaps in: every
+#: served request takes longer than 1us, so the gate MUST fail — the
+#: self-test that proves a green gate means something.
+INDUCED_SLO = "e2e<1us@99.9"
+
+VERDICTS = ("pass", "fail", "skip")
+
+CHAOS_KINDS = ("unavailable_mid_run", "kill_replica")
+
+#: Self-hosted server profiles (in-process tiny model, the CI path).
+#: `long` raises max_len and chunks prefill so the long-tail scenario
+#: actually exercises the chunked-prefill admission path.
+PROFILES: Dict[str, Dict[str, object]] = {
+    "default": {"n_slots": 4, "max_len": 192},
+    "long": {"n_slots": 2, "max_len": 640, "prefill_chunk": 64},
+}
+
+
+class SchemaDrift(RuntimeError):
+    """The committed ledger no longer matches the verdict-row schema
+    this code writes (mirrors scripts/bench_ledger.py)."""
+
+
+# ---------------------------------------------------------------------
+# Scenario definition
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One matrix cell. `validate()` runs at registry build so a bad
+    workload config or an unparseable SLO spec fails the import of
+    the registry, loudly, before any traffic moves."""
+
+    name: str
+    description: str
+    workload: WorkloadConfig
+    slos: Tuple[str, ...]
+    #: Engine features the scenario needs. Names come from the spec
+    #: exclusion matrix (`spec_batching.EXCLUSIONS`) plus the overlap
+    #: flags /stats exposes — the skip decision is made against them.
+    requires: Tuple[str, ...] = ()
+    #: Engine profile the scenario runs on: "dense" (the default
+    #: overlapped engine) or "spec" (speculative — every `requires`
+    #: hit in EXCLUSIONS becomes a named skip).
+    engine: str = "dense"
+    profile: str = "default"
+    chaos: Optional[str] = None
+    #: In the fast CI gate subset. gate=False scenarios (subprocess
+    #: chaos) run only with --all or an explicit --scenario.
+    gate: bool = True
+
+    def validate(self) -> None:
+        if not self.name or not self.name.replace("_", "").isalnum():
+            raise ValueError(f"bad scenario name {self.name!r}")
+        self.workload.validate()
+        specs = parse_slo_specs(self.slos)
+        if not specs:
+            raise ValueError(
+                f"scenario {self.name!r} asserts no SLOs — a scenario "
+                "without assertions is not a gate"
+            )
+        for s in specs:
+            if s.sli not in GATE_SLIS:
+                raise ValueError(
+                    f"scenario {self.name!r} SLO {s.name!r}: SLI "
+                    f"{s.sli!r} is not client-measurable "
+                    f"(gate SLIs: {', '.join(GATE_SLIS)})"
+                )
+        if self.engine not in ("dense", "spec"):
+            raise ValueError(
+                f"scenario {self.name!r}: unknown engine "
+                f"{self.engine!r} (dense|spec)"
+            )
+        if self.profile not in PROFILES:
+            raise ValueError(
+                f"scenario {self.name!r}: unknown profile "
+                f"{self.profile!r} (known: {', '.join(PROFILES)})"
+            )
+        if self.chaos is not None and self.chaos not in CHAOS_KINDS:
+            raise ValueError(
+                f"scenario {self.name!r}: unknown chaos "
+                f"{self.chaos!r} (known: {', '.join(CHAOS_KINDS)})"
+            )
+        known = set(EXCLUSIONS) | {"overlap_decode", "overlap_prefill"}
+        for r in self.requires:
+            if r not in known:
+                raise ValueError(
+                    f"scenario {self.name!r}: unknown required "
+                    f"feature {r!r} (known: {', '.join(sorted(known))})"
+                )
+
+    def skip_reason(self, stats: Optional[dict] = None
+                    ) -> Optional[str]:
+        """A NAMED reason this scenario cannot run, or None.
+
+        Static half: a `spec` engine profile refuses every feature in
+        the exclusion matrix — `excluded: <key>` (the matrix is the
+        contract; tests meta-check it). Live half: a `--target`'s
+        /stats engine block showing a speculative class, or a
+        required overlap flag off, skips the same way. Never returns
+        an empty string: a skip without a name would be a silent
+        pass."""
+        if self.engine == "spec":
+            for r in self.requires:
+                if r in EXCLUSIONS:
+                    return f"excluded: {r}"
+        if stats:
+            eng = stats.get("engine") or {}
+            if "Speculative" in str(eng.get("class", "")):
+                for r in self.requires:
+                    if r in EXCLUSIONS:
+                        return f"excluded: {r}"
+            for r in self.requires:
+                if (r in ("overlap_decode", "overlap_prefill")
+                        and r in eng and not eng.get(r)):
+                    return f"disabled: {r}"
+        return None
+
+
+def _build_scenarios() -> Dict[str, Scenario]:
+    """The catalog. Workload configs are CI-scale (seconds of traffic
+    against the tiny model); the production-scale shape lives in
+    `WorkloadConfig`'s defaults and `docs/scenarios.md`. Objectives
+    are deliberately generous — the gate asserts 'the fleet serves
+    its traffic', and a flaky gate teaches operators to ignore it."""
+
+    small = dict(
+        tenants=("acme", "globex", "initech", "umbrella"),
+        prompt_buckets=((4, 16, 0.6), (16, 48, 0.3), (48, 96, 0.1)),
+        tail_p=0.0, max_new=(2, 6), diurnal=None, vocab=200,
+    )
+    scns = [
+        Scenario(
+            name="steady_mixed",
+            description="the full request-type mix at a steady "
+                        "open-loop rate — the baseline cell",
+            workload=WorkloadConfig(
+                seed=11, duration_s=4.0, base_rate=5.0,
+                mix={"chat": 0.3, "stream": 0.25, "stream_cancel": 0.1,
+                     "tool": 0.15, "prefill_heavy": 0.05,
+                     "shared_prefix": 0.15},
+                shared_prefix_len=24, **small,
+            ),
+            slos=("availability@80", "e2e<25s@80"),
+            requires=("constraint",),
+        ),
+        Scenario(
+            name="burst_ramp",
+            description="a 3x burst riding a diurnal triangle ramp — "
+                        "open-loop arrivals do not slow down because "
+                        "the server did",
+            workload=WorkloadConfig(
+                seed=12, duration_s=4.0, base_rate=4.0,
+                bursts=(Burst(start_s=1.0, duration_s=1.0,
+                              multiplier=3.0),),
+                mix={"chat": 0.6, "stream": 0.4},
+                **{**small, "diurnal": Diurnal(amplitude=0.5,
+                                               period_s=4.0)},
+            ),
+            slos=("availability@80", "e2e<25s@80"),
+        ),
+        Scenario(
+            name="long_tail_prefill",
+            description="prompt-length long tail against chunked "
+                        "prefill (tail scaled to CI; production tail "
+                        "is 32k+)",
+            workload=WorkloadConfig(
+                seed=13, duration_s=4.0, base_rate=1.5,
+                tenants=("acme", "globex"),
+                mix={"prefill_heavy": 0.7, "chat": 0.3},
+                prompt_buckets=((16, 64, 0.7), (64, 256, 0.3)),
+                tail_p=0.2, tail_len=512, max_new=(1, 2),
+                diurnal=None, vocab=200,
+            ),
+            slos=("availability@70", "e2e<30s@70"),
+            profile="long",
+        ),
+        Scenario(
+            name="shared_prefix_reuse",
+            description="shared-system-prompt traffic — identical "
+                        "prefix hash chains the KV fabric dedups",
+            workload=WorkloadConfig(
+                seed=14, duration_s=4.0, base_rate=4.0,
+                mix={"shared_prefix": 0.8, "chat": 0.2},
+                shared_prefix_len=24, **small,
+            ),
+            slos=("availability@80", "e2e<25s@80"),
+        ),
+        Scenario(
+            name="streaming_cancel",
+            description="streaming chats with mid-flight client "
+                        "cancellations — hangups are good events, "
+                        "not failures",
+            workload=WorkloadConfig(
+                seed=15, duration_s=4.0, base_rate=4.0,
+                mix={"stream": 0.5, "stream_cancel": 0.5},
+                **small,
+            ),
+            slos=("availability@80", "ttft_p95<20s@80"),
+        ),
+        Scenario(
+            name="multi_tenant_zipf",
+            description="eight tenants, Zipf popularity — the heavy "
+                        "head and the long tail on one engine",
+            workload=WorkloadConfig(
+                seed=16, duration_s=4.0, base_rate=5.0,
+                tenants=("acme", "globex", "initech", "umbrella",
+                         "hooli", "wonka", "stark", "tyrell"),
+                zipf_s=1.4,
+                mix={"chat": 0.5, "stream": 0.5},
+                **{k: v for k, v in small.items() if k != "tenants"},
+            ),
+            slos=("availability@80", "e2e<25s@80"),
+        ),
+        Scenario(
+            name="chaos_unavailable",
+            description="the wire goes 503 for the middle third of "
+                        "the run (ChaosProxy) — availability degrades "
+                        "but must not collapse",
+            workload=WorkloadConfig(
+                seed=17, duration_s=4.5, base_rate=5.0,
+                mix={"chat": 1.0}, **small,
+            ),
+            slos=("availability@40",),
+            chaos="unavailable_mid_run",
+        ),
+        Scenario(
+            name="replica_kill",
+            description="SIGKILL a real serve subprocess mid-run — "
+                        "the unplanned death under open-loop load "
+                        "(subprocess startup: excluded from the fast "
+                        "gate)",
+            workload=WorkloadConfig(
+                seed=18, duration_s=6.0, base_rate=3.0,
+                mix={"chat": 1.0}, **small,
+            ),
+            slos=("availability@20",),
+            chaos="kill_replica",
+            gate=False,
+        ),
+        Scenario(
+            name="spec_overlap_decode",
+            description="mixed load on a speculative engine with the "
+                        "decode flight queue — refused by the "
+                        "exclusion matrix, recorded as a named skip",
+            workload=WorkloadConfig(
+                seed=19, duration_s=4.0, base_rate=4.0,
+                mix={"chat": 1.0}, **small,
+            ),
+            slos=("availability@80",),
+            engine="spec",
+            requires=("overlap_decode",),
+        ),
+        Scenario(
+            name="spec_overlap_prefill",
+            description="speculative engine with chunked-prefill "
+                        "admission overlap — the other excluded "
+                        "pipeline, also a named skip",
+            workload=WorkloadConfig(
+                seed=20, duration_s=4.0, base_rate=4.0,
+                mix={"chat": 1.0}, **small,
+            ),
+            slos=("availability@80",),
+            engine="spec",
+            requires=("overlap_prefill",),
+        ),
+        Scenario(
+            name="spec_constrained_tools",
+            description="tool/constrained mix on a speculative "
+                        "engine — drafts propose unconstrained "
+                        "tokens, so the matrix refuses it",
+            workload=WorkloadConfig(
+                seed=21, duration_s=4.0, base_rate=4.0,
+                mix={"tool": 1.0}, **small,
+            ),
+            slos=("availability@80",),
+            engine="spec",
+            requires=("constraint",),
+        ),
+    ]
+    out: Dict[str, Scenario] = {}
+    for s in scns:
+        s.validate()
+        if s.name in out:
+            raise ValueError(f"duplicate scenario name {s.name!r}")
+        out[s.name] = s
+    return out
+
+
+SCENARIOS: Dict[str, Scenario] = _build_scenarios()
+
+
+# ---------------------------------------------------------------------
+# Client-side SLI evaluation
+
+
+def _measurement(sli: str, row: Mapping) -> Optional[float]:
+    """The SLI value one captured result row contributes, or None if
+    the row does not participate (e.g. TTFT of a non-streaming
+    request, e2e of a request that never completed)."""
+    if sli == "ttft":
+        return row.get("ttft_s") if row.get("stream") else None
+    if sli == "e2e":
+        return (row.get("latency_s")
+                if row.get("outcome") == "ok" else None)
+    return None
+
+
+def evaluate_slos(specs, results: Sequence[Mapping]
+                  ) -> List[Dict[str, object]]:
+    """Fold captured result rows into per-SLO verdict entries:
+    good/total counts, final good fraction, ok flag, and the trace id
+    of the FIRST violating request (the incident exemplar). An SLO
+    that measured zero events is a failure — asserting against no
+    data must be loud, never a vacuous pass."""
+    out = []
+    for spec in specs:
+        good = total = 0
+        violating: Optional[str] = None
+        for row in results:
+            if spec.sli == "availability":
+                if row.get("outcome") == "client_saturated":
+                    # The CLIENT ran out of capacity; counted in the
+                    # outcome tally, excluded from the server's SLI.
+                    continue
+                total += 1
+                if row.get("outcome") in _GOOD_OUTCOMES:
+                    good += 1
+                elif violating is None:
+                    violating = row.get("trace_id")
+            else:
+                v = _measurement(spec.sli, row)
+                if v is None:
+                    continue
+                total += 1
+                if v <= spec.threshold_s:
+                    good += 1
+                elif violating is None:
+                    violating = row.get("trace_id")
+        frac = (good / total) if total else None
+        ok = total > 0 and frac >= spec.objective
+        out.append({
+            "slo": spec.name,
+            "objective": spec.objective,
+            "good": good,
+            "total": total,
+            "good_fraction": (round(frac, 6)
+                              if frac is not None else None),
+            "ok": bool(ok),
+            "violating_trace": None if ok else violating,
+        })
+    return out
+
+
+# ---------------------------------------------------------------------
+# Verdict rows + ledger
+
+_ROW_KEYS = ("schema", "scenario", "description", "verdict",
+             "skip_reason", "engine", "chaos", "requires", "slos",
+             "seed", "workload_fingerprint", "gate")
+
+
+def stable_row(row: Mapping) -> Dict[str, object]:
+    """The run-stable projection committed to the ledger: no counts,
+    no latencies, no trace ids — only what a config change or a
+    verdict flip would move."""
+    slos = row["slos"]
+    if slos and isinstance(slos[0], Mapping):
+        slos = [e["slo"] for e in slos]
+    return {
+        "schema": row["schema"],
+        "scenario": row["scenario"],
+        "description": row["description"],
+        "verdict": row["verdict"],
+        "skip_reason": row["skip_reason"],
+        "engine": row["engine"],
+        "chaos": row["chaos"],
+        "requires": list(row["requires"]),
+        "slos": list(slos),
+        "seed": row["seed"],
+        "workload_fingerprint": row["workload_fingerprint"],
+        "gate": row["gate"],
+    }
+
+
+def check_row(row: Mapping, committed: bool = True) -> None:
+    """Schema-check one verdict row; raises SchemaDrift naming every
+    problem (unknown shapes must fail loudly, not flow onward).
+    `committed=True` additionally refuses a 'fail' verdict — a
+    committed baseline that fails is not a baseline; live runner
+    output (committed=False) may of course fail."""
+    problems = []
+    for k in _ROW_KEYS:
+        if k not in row:
+            problems.append(f"missing key {k!r}")
+    if problems:
+        raise SchemaDrift(
+            f"ledger row {row.get('scenario', '?')!r}: "
+            + "; ".join(problems)
+        )
+    if row["schema"] != LEDGER_SCHEMA:
+        problems.append(
+            f"schema {row['schema']!r} != {LEDGER_SCHEMA}")
+    if row["verdict"] not in VERDICTS:
+        problems.append(f"verdict {row['verdict']!r} not in {VERDICTS}")
+    if (row["verdict"] == "skip") != bool(row["skip_reason"]):
+        problems.append(
+            "skip_reason must be set exactly when verdict == 'skip' "
+            "(a skip without a name is a silent pass)"
+        )
+    if committed and row["verdict"] == "fail":
+        problems.append(
+            "committed ledger carries verdict 'fail' — a baseline "
+            "that fails is not a baseline"
+        )
+    if not isinstance(row["slos"], list) or not row["slos"]:
+        problems.append("slos must be a non-empty list")
+    else:
+        for e in row["slos"]:
+            name = e["slo"] if isinstance(e, Mapping) else e
+            if not isinstance(name, str) or "@" not in name:
+                problems.append(f"bad SLO entry {e!r}")
+    if not isinstance(row["workload_fingerprint"], str) \
+            or len(row["workload_fingerprint"]) != 64:
+        problems.append("workload_fingerprint must be a sha256 hex")
+    if problems:
+        raise SchemaDrift(
+            f"ledger row {row['scenario']!r}: " + "; ".join(problems))
+
+
+def check_ledger(doc: Mapping) -> None:
+    if not isinstance(doc, Mapping):
+        raise SchemaDrift("ledger is not a JSON object")
+    if doc.get("schema") != LEDGER_SCHEMA:
+        raise SchemaDrift(
+            f"ledger schema {doc.get('schema')!r} != {LEDGER_SCHEMA}")
+    rows = doc.get("scenarios")
+    if not isinstance(rows, list) or not rows:
+        raise SchemaDrift("ledger has no scenarios list")
+    seen = set()
+    for row in rows:
+        check_row(row)
+        if row["scenario"] in seen:
+            raise SchemaDrift(
+                f"duplicate ledger row {row['scenario']!r}")
+        seen.add(row["scenario"])
+
+
+def expected_static_rows(scenarios: Sequence[Scenario]
+                         ) -> List[Dict[str, object]]:
+    """What the ledger MUST contain, computable without running
+    anything: every field but the verdict is a pure function of the
+    scenario config (the workload fingerprint hashes the generated
+    schedule, no server needed), and skip verdicts are statically
+    known from the exclusion matrix."""
+    out = []
+    for s in scenarios:
+        skip = s.skip_reason()
+        out.append({
+            "schema": LEDGER_SCHEMA,
+            "scenario": s.name,
+            "description": s.description,
+            "verdict": "skip" if skip else None,  # None: needs a run
+            "skip_reason": skip,
+            "engine": s.engine,
+            "chaos": s.chaos,
+            "requires": list(s.requires),
+            "slos": list(s.slos),
+            "seed": s.workload.seed,
+            "workload_fingerprint": WorkloadModel(
+                s.workload).fingerprint(),
+            "gate": s.gate,
+        })
+    return out
+
+
+def compare_to_ledger(rows: Sequence[Mapping], doc: Mapping,
+                      verdict_known: bool) -> List[str]:
+    """Diff run/static rows against the committed ledger; returns
+    human-readable mismatch lines (empty = in sync). With
+    verdict_known=False (the no-run --check path) verdicts are only
+    compared for statically-known skips."""
+    committed = {r["scenario"]: r for r in doc.get("scenarios", [])}
+    fresh = {r["scenario"]: r for r in rows}
+    lines = []
+    for name in sorted(set(committed) | set(fresh)):
+        if name not in committed:
+            lines.append(f"{name}: missing from committed ledger")
+            continue
+        if name not in fresh:
+            lines.append(f"{name}: committed but no longer in the "
+                         "gate set")
+            continue
+        a, b = fresh[name], committed[name]
+        for k in _ROW_KEYS:
+            if k == "verdict" and not verdict_known \
+                    and a.get("verdict") is None:
+                continue
+            av = a.get(k)
+            bv = b.get(k)
+            if isinstance(av, tuple):
+                av = list(av)
+            if av != bv:
+                lines.append(f"{name}: {k} changed "
+                             f"(ran={av!r} committed={bv!r})")
+    return lines
+
+
+def write_ledger(path: str, rows: Sequence[Mapping]) -> None:
+    doc = {
+        "schema": LEDGER_SCHEMA,
+        "note": "committed scenario-gate baseline; regenerate with "
+                "`python -m shellac_tpu scenarios --update-ledger`",
+        "scenarios": [stable_row(r) for r in
+                      sorted(rows, key=lambda r: r["scenario"])],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_ledger(path: str) -> Mapping:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except OSError as e:
+        raise SchemaDrift(f"cannot read ledger {path}: {e}")
+    except ValueError as e:
+        raise SchemaDrift(f"ledger {path} is not valid JSON: {e}")
+
+
+# ---------------------------------------------------------------------
+# The runner
+
+
+class _Hosted:
+    """One self-hosted in-process replica (profile-keyed)."""
+
+    def __init__(self, profile: str, registry, recorder,
+                 incident_dir: Optional[str]):
+        import jax
+
+        from shellac_tpu import get_model_config
+        from shellac_tpu.inference.server import (
+            InferenceServer,
+            make_http_server,
+        )
+        from shellac_tpu.models import transformer
+        from shellac_tpu.training.tokenizer import ByteTokenizer
+
+        # vocab_size 259 covers the ByteTokenizer specials so the
+        # constrained-decode (tool) kind has a real eos_id to stop at.
+        cfg = get_model_config("tiny").replace(dtype="float32",
+                                               vocab_size=259)
+        params = transformer.init_params(cfg, jax.random.PRNGKey(0))
+        self.server = InferenceServer(
+            cfg, params, tokenizer=ByteTokenizer(), temperature=0.0,
+            registry=registry, recorder=recorder,
+            incident_dir=incident_dir, eos_id=ByteTokenizer.EOS,
+            **PROFILES[profile],
+        )
+        self.httpd = make_http_server(self.server)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        self.warmed_len = 0
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.server.close()
+
+
+def _http_json(url: str, payload: Optional[dict] = None,
+               headers: Optional[dict] = None,
+               timeout: float = 30.0) -> Tuple[int, dict]:
+    hdrs = {"Content-Type": "application/json", **(headers or {})}
+    req = urllib.request.Request(
+        url, data=(json.dumps(payload).encode()
+                   if payload is not None else None),
+        headers=hdrs,
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            body = r.read()
+            try:
+                return r.status, json.loads(body or b"{}")
+            except ValueError:
+                # NDJSON (a drained warmup stream) or non-JSON body.
+                return r.status, {}
+    except urllib.error.HTTPError as e:
+        try:
+            body = json.loads(e.read() or b"{}")
+        except ValueError:
+            body = {}
+        return e.code, body
+    except (OSError, urllib.error.URLError) as e:
+        return 0, {"error": repr(e)}
+
+
+class ScenarioRunner:
+    """Run scenarios against `--target URL` or self-hosted tiny
+    replicas, producing full verdict rows. Owns one registry +
+    flight recorder — scenario lifecycle events and (when
+    self-hosting) the replica's own events land in ONE timeline, so
+    `/debug/request/<violating-trace>` resolves against the same
+    recorder the incident bundle snapshots."""
+
+    def __init__(self, *, target: Optional[str] = None,
+                 incident_dir: Optional[str] = None,
+                 registry: Optional[Registry] = None,
+                 recorder: Optional[FlightRecorder] = None,
+                 timeout: float = 30.0,
+                 duration_scale: float = 1.0,
+                 seed: Optional[int] = None,
+                 induce_violation: bool = False,
+                 max_in_flight: int = 64,
+                 log=print):
+        self.target = target.rstrip("/") if target else None
+        self.incident_dir = incident_dir
+        self.registry = registry if registry is not None else Registry()
+        self.recorder = (recorder if recorder is not None
+                         else FlightRecorder(registry=self.registry))
+        self.metrics = ScenarioMetrics(self.registry)
+        self.timeout = timeout
+        self.duration_scale = duration_scale
+        self.seed = seed
+        self.induce = induce_violation
+        self.max_in_flight = max_in_flight
+        self.log = log
+        self._hosted: Dict[str, _Hosted] = {}
+        self._target_stats: Optional[dict] = None
+
+    # ---- targets ----------------------------------------------------
+
+    def close(self) -> None:
+        for h in self._hosted.values():
+            h.close()
+        self._hosted.clear()
+
+    def _stats_for(self, url: str) -> dict:
+        status, body = _http_json(url + "/stats", timeout=10.0)
+        return body if status == 200 else {}
+
+    def _resolve_target(self, scenario: Scenario) -> Tuple[str, dict]:
+        """(base_url, /stats body) for this scenario's traffic."""
+        if self.target is not None:
+            if self._target_stats is None:
+                self._target_stats = self._stats_for(self.target)
+            return self.target, self._target_stats
+        if scenario.profile not in self._hosted:
+            self.log(f"# hosting in-process replica "
+                     f"(profile={scenario.profile})")
+            self._hosted[scenario.profile] = _Hosted(
+                scenario.profile, self.registry, self.recorder,
+                self.incident_dir,
+            )
+        h = self._hosted[scenario.profile]
+        self._warmup(h, scenario)
+        return h.url, self._stats_for(h.url)
+
+    def _warmup(self, hosted: _Hosted, scenario: Scenario) -> None:
+        """Pay JIT compiles before the clock starts: one request at
+        the scenario's longest prompt length (prefill shapes), one
+        streaming, one constrained if the mix uses tools. Warmups
+        are not counted anywhere."""
+        model = WorkloadModel(self._workload_for(scenario))
+        longest = max((len(s.tokens) for s in model.schedule()),
+                      default=4)
+        if longest <= hosted.warmed_len:
+            return
+        hosted.warmed_len = longest
+        base = hosted.url + "/generate"
+        _http_json(base, {"tokens": list(range(2, 2 + longest)),
+                          "max_new": 2, "timeout": 120},
+                   timeout=180.0)
+        _http_json(base, {"tokens": [5, 6, 7], "max_new": 2,
+                          "stream": True, "timeout": 120},
+                   timeout=180.0)
+        if "tool" in scenario.workload.mix:
+            _http_json(base, {"tokens": [5, 6, 7], "max_new": 2,
+                              "constraint": {
+                                  "regex":
+                                  scenario.workload.tool_regex},
+                              "timeout": 120},
+                       timeout=180.0)
+
+    def _workload_for(self, scenario: Scenario) -> WorkloadConfig:
+        wl = scenario.workload
+        if self.seed is not None:
+            from dataclasses import replace
+            wl = replace(wl, seed=self.seed)
+        if self.duration_scale != 1.0:
+            wl = wl.scaled(self.duration_scale)
+        return wl
+
+    # ---- chaos ------------------------------------------------------
+
+    def _with_chaos(self, scenario: Scenario, url: str,
+                    duration_s: float):
+        """Returns (traffic_url, arm_fn, teardown_fn). Control-plane
+        calls (incident POST, trace resolution) keep the DIRECT url —
+        chaos lives on the workload's wire only."""
+        if scenario.chaos is None:
+            return url, lambda: None, lambda: None
+        if scenario.chaos == "unavailable_mid_run":
+            parsed = urllib.parse.urlsplit(url)
+            proxy = ChaosProxy(parsed.hostname, parsed.port)
+            timers = [
+                threading.Timer(duration_s / 3.0, proxy.unavailable),
+                threading.Timer(2.0 * duration_s / 3.0,
+                                proxy.pass_through),
+            ]
+
+            def arm():
+                for t in timers:
+                    t.daemon = True
+                    t.start()
+
+            def teardown():
+                for t in timers:
+                    t.cancel()
+                proxy.close()
+
+            return proxy.url, arm, teardown
+        # kill_replica: a REAL serve subprocess, SIGKILLed mid-run.
+        # The replica IS the scenario's target (run_scenario skips
+        # self-hosting for this chaos kind). Warm with the schedule's
+        # LONGEST payload so the compile for the real request shapes
+        # is paid before the clock starts — a token-[1,2,3] warmup
+        # leaves the first real batch stalled ~2.5s on compile, which
+        # the kill timer then wrongly counts against availability.
+        replica = ReplicaProc(model="tiny", slots=2, max_len=96)
+        replica.wait_ready()
+        wl = self._workload_for(scenario)
+        longest = max(
+            (p for _, p in WorkloadModel(wl).payload_schedule(
+                timeout=120.0)),
+            key=lambda p: len(p["tokens"]),
+        )
+        warm = {k: v for k, v in longest.items()
+                if k not in ("tenant", "kind", "cancel_after_deltas",
+                             "stream")}
+        warm["timeout"] = 120
+        _http_json(replica.url + "/generate", warm, timeout=180.0)
+        # 3/4 in, not 1/2: the front of the window must land cleanly
+        # so the verdict measures the death, not the ramp.
+        timer = threading.Timer(0.75 * duration_s, replica.kill)
+
+        def arm():
+            timer.daemon = True
+            timer.start()
+
+        def teardown():
+            timer.cancel()
+            replica.kill()
+
+        return replica.url, arm, teardown
+
+    # ---- one scenario -----------------------------------------------
+
+    def run_scenario(self, scenario: Scenario) -> Dict[str, object]:
+        t0 = time.monotonic()
+        wl = self._workload_for(scenario)
+        model = WorkloadModel(wl)
+        fingerprint = model.fingerprint()
+        slo_strings = ((INDUCED_SLO,) if self.induce
+                       and scenario.engine == "dense"
+                       else scenario.slos)
+        specs = parse_slo_specs(slo_strings)
+
+        def row_base(verdict: str, skip: Optional[str],
+                     slo_rows) -> Dict[str, object]:
+            return {
+                "schema": LEDGER_SCHEMA,
+                "scenario": scenario.name,
+                "description": scenario.description,
+                "verdict": verdict,
+                "skip_reason": skip,
+                "engine": scenario.engine,
+                "chaos": scenario.chaos,
+                "requires": list(scenario.requires),
+                "slos": slo_rows,
+                "seed": wl.seed,
+                "workload_fingerprint": fingerprint,
+                "gate": scenario.gate,
+            }
+
+        self.recorder.record(
+            None, "scenario-start", src="scenario",
+            scenario=scenario.name, seed=wl.seed,
+            requests=len(model.schedule()), chaos=scenario.chaos,
+        )
+
+        # Skips are decided BEFORE any target spins up: first the
+        # static exclusion matrix, then the live target's /stats.
+        skip = scenario.skip_reason()
+        if skip is None and self.target is not None:
+            if self._target_stats is None:
+                self._target_stats = self._stats_for(self.target)
+            skip = scenario.skip_reason(self._target_stats)
+        if skip is not None:
+            self.metrics.runs.labels(scenario=scenario.name,
+                                     verdict="skip").inc()
+            self.metrics.duration.observe(time.monotonic() - t0)
+            self.recorder.record(
+                None, "scenario-skip", src="scenario",
+                scenario=scenario.name, reason=skip,
+            )
+            self.log(f"SKIP {scenario.name} ({skip})")
+            return row_base("skip", skip, list(slo_strings))
+
+        if scenario.chaos == "kill_replica" and self.target is None:
+            # The chaos replica IS the target: no in-process host.
+            url = None
+        else:
+            url, _stats = self._resolve_target(scenario)
+        traffic_url, arm_chaos, teardown_chaos = self._with_chaos(
+            scenario, url, wl.duration_s)
+        if url is None:
+            url = traffic_url
+        try:
+            gen = LoadGenerator(
+                traffic_url,
+                schedule=model.payload_schedule(timeout=self.timeout),
+                timeout=self.timeout, capture=True,
+                max_in_flight=self.max_in_flight,
+            )
+            arm_chaos()
+            counts = gen.run()
+        finally:
+            teardown_chaos()
+
+        for outcome, n in sorted(counts.items()):
+            self.metrics.requests.labels(
+                scenario=scenario.name, outcome=outcome).inc(n)
+
+        slo_rows = evaluate_slos(specs, gen.results)
+        violating = {r["slo"]: r["violating_trace"] for r in slo_rows}
+
+        # Feed the cumulative counts through the real SLO engine:
+        # gauges, burn rates, and — on a breach — a recorded
+        # slo-transition carrying the violating-trace exemplar.
+        engine = SLOEngine(
+            specs, registry=self.registry, recorder=self.recorder,
+            exemplar_fn=lambda spec: violating.get(spec.name),
+        )
+        base_now = time.monotonic()
+        engine.tick({s.name: (0.0, 0.0) for s in specs}, now=base_now)
+        engine.tick(
+            {r["slo"]: (float(r["good"]), float(r["total"]))
+             for r in slo_rows},
+            now=base_now + max(wl.duration_s, 1.0),
+        )
+
+        verdict = "pass"
+        for r in slo_rows:
+            self.metrics.good_fraction.labels(
+                scenario=scenario.name, slo=r["slo"]).set(
+                r["good_fraction"] if r["good_fraction"] is not None
+                else 0.0)
+            if r["ok"]:
+                continue
+            verdict = "fail"
+            self.metrics.breaches.labels(
+                scenario=scenario.name, slo=r["slo"]).inc()
+            tid = r["violating_trace"]
+            incident, manifest_trace = self._fire_incident(
+                url, scenario, r, tid)
+            r["incident"] = incident
+            r["incident_trace"] = manifest_trace
+            r["trace_resolved"] = (self._trace_resolves(url, tid)
+                                   if tid else False)
+            self.recorder.record(
+                tid, "scenario-slo-breach", src="scenario",
+                scenario=scenario.name, slo=r["slo"],
+                good_fraction=r["good_fraction"],
+                objective=r["objective"], incident=incident,
+            )
+
+        self.metrics.runs.labels(scenario=scenario.name,
+                                 verdict=verdict).inc()
+        self.metrics.duration.observe(time.monotonic() - t0)
+        self.recorder.record(
+            None, "scenario-verdict", src="scenario",
+            scenario=scenario.name, verdict=verdict,
+            slos={r["slo"]: r["good_fraction"] for r in slo_rows},
+        )
+        row = row_base(verdict, None, slo_rows)
+        row["counts"] = counts
+        self.log(f"{verdict.upper():4s} {scenario.name} "
+                 + " ".join(f"{r['slo']}={r['good_fraction']}"
+                            for r in slo_rows))
+        return row
+
+    # ---- incidents --------------------------------------------------
+
+    def _fire_incident(self, url: str, scenario: Scenario,
+                       slo_row: Mapping, tid: Optional[str]
+                       ) -> Tuple[Optional[str], Optional[str]]:
+        """POST /debug/incident at the target so the PR 13 bundle
+        machinery (rate limits, sections, retention) does the work;
+        the x-shellac-trace header carries the violating trace id
+        into the bundle manifest. Returns (bundle id, the manifest's
+        trace id) — (None, None) when the target has no incident dir
+        or the write was refused (reported, never raised)."""
+        headers = {}
+        if tid:
+            headers[TRACE_HEADER] = format_trace_header(tid, 0)
+        note = (f"scenario {scenario.name!r} SLO breach: "
+                f"{slo_row['slo']} good_fraction="
+                f"{slo_row['good_fraction']} < objective="
+                f"{slo_row['objective']}")
+        status, body = _http_json(
+            url + "/debug/incident", {"note": note}, headers=headers,
+            timeout=30.0,
+        )
+        if status != 200:
+            self.log(f"# incident POST failed ({status}): "
+                     f"{body.get('error', body)}")
+            return None, None
+        manifest = body.get("manifest") or {}
+        return body.get("incident"), manifest.get("trace_id")
+
+    def _trace_resolves(self, url: str, tid: str) -> bool:
+        status, _ = _http_json(url + f"/debug/request/{tid}",
+                               timeout=10.0)
+        return status == 200
+
+    # ---- many scenarios ---------------------------------------------
+
+    def run(self, scenarios: Sequence[Scenario]
+            ) -> List[Dict[str, object]]:
+        rows = []
+        for s in scenarios:
+            rows.append(self.run_scenario(s))
+        return rows
+
+
+# ---------------------------------------------------------------------
+# CLI entry (python -m shellac_tpu scenarios)
+
+
+def select_scenarios(names: Optional[Sequence[str]],
+                     include_all: bool) -> List[Scenario]:
+    if names:
+        unknown = [n for n in names if n not in SCENARIOS]
+        if unknown:
+            raise SystemExit(
+                f"unknown scenario(s): {', '.join(unknown)} "
+                f"(known: {', '.join(SCENARIOS)})"
+            )
+        return [SCENARIOS[n] for n in names]
+    return [s for s in SCENARIOS.values() if s.gate or include_all]
+
+
+def cli_run(args) -> int:
+    if args.list:
+        for s in SCENARIOS.values():
+            skip = s.skip_reason()
+            mark = ("skip: " + skip if skip
+                    else ("gate" if s.gate else "full"))
+            print(f"{s.name:24s} [{mark}] {s.description}")
+        return 0
+
+    selected = select_scenarios(args.scenario, args.all)
+
+    if args.check:
+        # No traffic: schema-check the committed ledger and diff it
+        # against the statically-recomputable projection.
+        try:
+            doc = load_ledger(args.ledger)
+            check_ledger(doc)
+        except SchemaDrift as e:
+            print(f"SCHEMA DRIFT: {e}")
+            return 2
+        gate_scns = [s for s in SCENARIOS.values() if s.gate]
+        diff = compare_to_ledger(expected_static_rows(gate_scns),
+                                 doc, verdict_known=False)
+        if diff:
+            print("STALE LEDGER (run `python -m shellac_tpu "
+                  "scenarios --update-ledger`):")
+            for line in diff:
+                print(f"  {line}")
+            return 3
+        print(f"ledger {args.ledger} ok "
+              f"({len(doc['scenarios'])} scenarios)")
+        return 0
+
+    runner = ScenarioRunner(
+        target=args.target,
+        incident_dir=args.incident_dir,
+        timeout=args.timeout,
+        duration_scale=args.duration_scale,
+        seed=args.seed,
+        induce_violation=args.induce_violation,
+    )
+    try:
+        rows = runner.run(selected)
+    finally:
+        runner.close()
+
+    for row in rows:
+        check_row(row, committed=False)  # honor our own schema
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"schema": LEDGER_SCHEMA, "rows": rows}, f,
+                      indent=1, sort_keys=True, default=str)
+            f.write("\n")
+
+    n_fail = sum(1 for r in rows if r["verdict"] == "fail")
+    n_skip = sum(1 for r in rows if r["verdict"] == "skip")
+    print(f"\n{len(rows)} scenarios: "
+          f"{len(rows) - n_fail - n_skip} pass, {n_fail} fail, "
+          f"{n_skip} skip")
+    for r in rows:
+        if r["verdict"] != "fail":
+            continue
+        for e in r["slos"]:
+            if isinstance(e, Mapping) and not e.get("ok", True):
+                print(f"  FAIL {r['scenario']} {e['slo']}: "
+                      f"good_fraction={e['good_fraction']} "
+                      f"incident={e.get('incident')} "
+                      f"trace={e.get('violating_trace')}")
+
+    if args.update_ledger:
+        if args.scenario or args.seed is not None \
+                or args.duration_scale != 1.0 or args.induce_violation:
+            raise SystemExit(
+                "--update-ledger must run the unmodified gate set "
+                "(no --scenario/--seed/--duration-scale/"
+                "--induce-violation)"
+            )
+        write_ledger(args.ledger, [r for r in rows if r["gate"]])
+        print(f"wrote {args.ledger}")
+        return 1 if n_fail else 0
+
+    if args.gate and not args.induce_violation:
+        try:
+            doc = load_ledger(args.ledger)
+            check_ledger(doc)
+        except SchemaDrift as e:
+            print(f"SCHEMA DRIFT: {e}")
+            return 2
+        gate_rows = [stable_row(r) for r in rows if r["gate"]]
+        if not args.scenario:
+            diff = compare_to_ledger(gate_rows, doc,
+                                     verdict_known=True)
+        else:
+            # A filtered gate run compares only the selected rows.
+            names = {r["scenario"] for r in gate_rows}
+            sub = {"scenarios": [r for r in doc["scenarios"]
+                                 if r["scenario"] in names]}
+            diff = compare_to_ledger(gate_rows, sub,
+                                     verdict_known=True)
+        if diff:
+            print("STALE LEDGER (run `python -m shellac_tpu "
+                  "scenarios --update-ledger`):")
+            for line in diff:
+                print(f"  {line}")
+            return 3 if not n_fail else 1
+
+    return 1 if n_fail else 0

@@ -1,0 +1,445 @@
+"""Seeded, deterministic production-traffic model.
+
+Every load test in this repo so far drove ONE synthetic shape at a
+time (the chaos scenarios' uniform closed loops, the bench gate's
+fixed churn). Real fleets are a superposition: a heavy-head/long-tail
+tenant population, open-loop arrivals that do not slow down because
+the server did, traffic bursts and diurnal ramps, prompt lengths with
+a 32k+ tail that lands on chunked prefill, and a request-type mix —
+streaming chats that get cancelled mid-flight, tool/constrained
+calls, prefill-heavy summarization, and shared-system-prompt traffic
+whose prefix the KV fabric should be deduplicating.
+
+`WorkloadConfig` declares that superposition; `WorkloadModel` turns
+it into a concrete, fully deterministic *schedule* — a list of
+`RequestSpec`s with absolute arrival offsets — using one
+`random.Random(seed)` stream. Determinism is a contract, not an
+accident: the scenario gate commits a fingerprint of the schedule
+(`WorkloadModel.fingerprint()`) to `SCENARIO_LEDGER.json`, so a
+config edit that changes the traffic a scenario asserts its SLOs
+under shows up as ledger drift in CI, never silently.
+
+Two deliberate modeling choices keep the fingerprint portable:
+
+- Arrivals are an inhomogeneous Poisson process sampled by Lewis &
+  Shedler thinning — candidate points at the peak rate, each kept
+  with probability rate(t)/peak — so the schedule is exact for any
+  rate curve and needs only `Random.expovariate`/`random`.
+- The diurnal ramp is a triangle wave, not a sine: pure arithmetic,
+  so the schedule never depends on the platform's libm and the
+  committed fingerprint is stable across machines.
+
+The DEFAULT config is the production shape (32k tail and all); CI
+scenarios (`inference/scenarios.py`) override it down to seconds of
+traffic against the tiny model. Scaling the config down scales the
+schedule, not the model.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Mapping, Optional, Tuple
+
+#: The request kinds a schedule can mix. Each maps to a concrete
+#: /generate payload shape in `RequestSpec.payload()`:
+#:   chat          — non-streaming completion
+#:   stream        — NDJSON streaming completion, read to the end
+#:   stream_cancel — streaming, client severs after a few deltas
+#:   tool          — constrained decode (PR 8's DFA path)
+#:   prefill_heavy — long prompt, tiny completion (summarization)
+#:   shared_prefix — shared system prompt + short user suffix (the
+#:                   prefix-reuse traffic the KV fabric dedups)
+REQUEST_KINDS = ("chat", "stream", "stream_cancel", "tool",
+                 "prefill_heavy", "shared_prefix")
+
+
+@dataclass(frozen=True)
+class Burst:
+    """One traffic burst: rate multiplied by `multiplier` for
+    `duration_s` starting at `start_s` (offsets from run start)."""
+
+    start_s: float
+    duration_s: float
+    multiplier: float
+
+    def validate(self) -> None:
+        if self.start_s < 0 or self.duration_s <= 0:
+            raise ValueError(
+                f"burst needs start_s >= 0 and duration_s > 0 "
+                f"(got {self.start_s}, {self.duration_s})"
+            )
+        if self.multiplier <= 0:
+            raise ValueError(
+                f"burst multiplier must be > 0 (got {self.multiplier})"
+            )
+
+
+@dataclass(frozen=True)
+class Diurnal:
+    """Triangle-wave rate modulation: factor ranges over
+    [1-amplitude, 1+amplitude] with period `period_s`, peaking at
+    `period_s/2` past each period start. A triangle (not a sine) so
+    the schedule stays libm-free and bit-stable across platforms."""
+
+    amplitude: float
+    period_s: float
+
+    def validate(self) -> None:
+        if not 0.0 <= self.amplitude < 1.0:
+            raise ValueError(
+                f"diurnal amplitude must be in [0, 1) "
+                f"(got {self.amplitude})"
+            )
+        if self.period_s <= 0:
+            raise ValueError(
+                f"diurnal period_s must be > 0 (got {self.period_s})"
+            )
+
+    def factor(self, t: float) -> float:
+        # Triangle wave in [-1, 1]: -1 at period start, +1 at half
+        # period. Pure arithmetic on purpose.
+        x = (t % self.period_s) / self.period_s          # [0, 1)
+        tri = 1.0 - 4.0 * abs(x - 0.5)                   # [-1, 1]
+        return 1.0 + self.amplitude * tri
+
+
+@dataclass(frozen=True)
+class WorkloadConfig:
+    """Declarative traffic model. Defaults describe the production
+    shape; scenarios override them down to CI scale. `validate()`
+    runs eagerly in `WorkloadModel` so a bad config dies at registry
+    build, not mid-run."""
+
+    seed: int = 0
+    duration_s: float = 3600.0
+    base_rate: float = 50.0                 # mean arrivals/second
+    #: Tenant population, list order = popularity rank (Zipf head
+    #: first). PR 18's tenant identity rides the x-shellac-tenant
+    #: header on every request.
+    tenants: Tuple[str, ...] = ("acme", "globex", "initech",
+                                "umbrella", "hooli", "wonka",
+                                "stark", "tyrell")
+    zipf_s: float = 1.2
+    bursts: Tuple[Burst, ...] = ()
+    diurnal: Optional[Diurnal] = Diurnal(amplitude=0.5,
+                                         period_s=86400.0)
+    #: Request-type mix, kind -> weight (normalized internally).
+    mix: Mapping[str, float] = field(default_factory=lambda: {
+        "chat": 0.30, "stream": 0.25, "stream_cancel": 0.05,
+        "tool": 0.15, "prefill_heavy": 0.10, "shared_prefix": 0.15,
+    })
+    #: Prompt-length buckets: (lo, hi, weight) in tokens, sampled
+    #: uniformly inside the chosen bucket.
+    prompt_buckets: Tuple[Tuple[int, int, float], ...] = (
+        (8, 64, 0.55), (64, 512, 0.30), (512, 4096, 0.15),
+    )
+    #: The long tail: with probability tail_p the prompt is
+    #: tail_len tokens — the 32k+ case chunked prefill exists for.
+    tail_p: float = 0.02
+    tail_len: int = 32768
+    max_new: Tuple[int, int] = (4, 64)      # uniform, inclusive
+    #: prefill_heavy caps its completion here (long in, short out).
+    prefill_heavy_max_new: int = 4
+    #: stream_cancel severs after this many delta lines (uniform).
+    cancel_after_deltas: Tuple[int, int] = (1, 3)
+    shared_prefix_len: int = 64
+    #: Token-id range for synthetic prompts (byte tokenizer safe).
+    vocab: int = 200
+    #: Regex the tool kind constrains decode to (tiny on purpose:
+    #: the DFA compile walks the vocab once, then caches).
+    tool_regex: str = "(yes|no|maybe)"
+
+    def validate(self) -> None:
+        if self.duration_s <= 0:
+            raise ValueError(
+                f"duration_s must be > 0 (got {self.duration_s})")
+        if self.base_rate <= 0:
+            raise ValueError(
+                f"base_rate must be > 0 (got {self.base_rate})")
+        if not self.tenants:
+            raise ValueError("tenants must be non-empty")
+        if self.zipf_s < 0:
+            raise ValueError(f"zipf_s must be >= 0 (got {self.zipf_s})")
+        for b in self.bursts:
+            b.validate()
+        if self.diurnal is not None:
+            self.diurnal.validate()
+        if not self.mix:
+            raise ValueError("mix must be non-empty")
+        for kind, w in self.mix.items():
+            if kind not in REQUEST_KINDS:
+                raise ValueError(
+                    f"unknown request kind {kind!r} in mix "
+                    f"(known: {', '.join(REQUEST_KINDS)})"
+                )
+            if w < 0:
+                raise ValueError(
+                    f"mix weight for {kind!r} must be >= 0 (got {w})")
+        if sum(self.mix.values()) <= 0:
+            raise ValueError("mix weights must sum > 0")
+        if not self.prompt_buckets:
+            raise ValueError("prompt_buckets must be non-empty")
+        for lo, hi, w in self.prompt_buckets:
+            if not (1 <= lo <= hi):
+                raise ValueError(
+                    f"prompt bucket needs 1 <= lo <= hi (got {lo}, {hi})")
+            if w < 0:
+                raise ValueError(
+                    f"prompt bucket weight must be >= 0 (got {w})")
+        if sum(w for _, _, w in self.prompt_buckets) <= 0:
+            raise ValueError("prompt bucket weights must sum > 0")
+        if not 0.0 <= self.tail_p <= 1.0:
+            raise ValueError(
+                f"tail_p must be in [0, 1] (got {self.tail_p})")
+        if self.tail_len < 1:
+            raise ValueError(
+                f"tail_len must be >= 1 (got {self.tail_len})")
+        lo, hi = self.max_new
+        if not (1 <= lo <= hi):
+            raise ValueError(
+                f"max_new needs 1 <= lo <= hi (got {self.max_new})")
+        lo, hi = self.cancel_after_deltas
+        if not (1 <= lo <= hi):
+            raise ValueError(
+                "cancel_after_deltas needs 1 <= lo <= hi "
+                f"(got {self.cancel_after_deltas})"
+            )
+        if self.shared_prefix_len < 1:
+            raise ValueError(
+                f"shared_prefix_len must be >= 1 "
+                f"(got {self.shared_prefix_len})"
+            )
+        if self.vocab < 2:
+            raise ValueError(f"vocab must be >= 2 (got {self.vocab})")
+        if self.prefill_heavy_max_new < 1:
+            raise ValueError(
+                "prefill_heavy_max_new must be >= 1 "
+                f"(got {self.prefill_heavy_max_new})"
+            )
+
+    def scaled(self, factor: float) -> "WorkloadConfig":
+        """A copy with duration scaled by `factor` (burst offsets and
+        diurnal period scale with it so the shape is preserved)."""
+        if factor <= 0:
+            raise ValueError(f"scale factor must be > 0 (got {factor})")
+        bursts = tuple(
+            Burst(b.start_s * factor, b.duration_s * factor,
+                  b.multiplier)
+            for b in self.bursts
+        )
+        diurnal = (Diurnal(self.diurnal.amplitude,
+                           self.diurnal.period_s * factor)
+                   if self.diurnal is not None else None)
+        return replace(self, duration_s=self.duration_s * factor,
+                       bursts=bursts, diurnal=diurnal)
+
+
+@dataclass(frozen=True)
+class RequestSpec:
+    """One concrete request in a schedule. `payload()` renders the
+    LoadGenerator-ready dict: the native /generate body plus the
+    reserved client-side keys (`tenant`, `kind`,
+    `cancel_after_deltas`) the generator strips before the wire."""
+
+    arrival_s: float
+    tenant: str
+    kind: str
+    tokens: Tuple[int, ...]
+    max_new: int
+    stream: bool
+    cancel_after: Optional[int] = None
+    constraint_regex: Optional[str] = None
+
+    def payload(self, timeout: Optional[float] = None) -> Dict[str, object]:
+        p: Dict[str, object] = {
+            "tokens": list(self.tokens),
+            "max_new": self.max_new,
+            "tenant": self.tenant,
+            "kind": self.kind,
+        }
+        if self.stream:
+            p["stream"] = True
+        if self.cancel_after is not None:
+            p["cancel_after_deltas"] = self.cancel_after
+        if self.constraint_regex is not None:
+            p["constraint"] = {"regex": self.constraint_regex}
+        if timeout is not None:
+            p["timeout"] = timeout
+        return p
+
+    def row(self) -> Dict[str, object]:
+        """Canonical projection for fingerprinting: every field that
+        defines the request, floats rounded so the hash never hinges
+        on sub-microsecond float formatting."""
+        return {
+            "arrival_s": round(self.arrival_s, 6),
+            "tenant": self.tenant,
+            "kind": self.kind,
+            "tokens": list(self.tokens),
+            "max_new": self.max_new,
+            "stream": self.stream,
+            "cancel_after": self.cancel_after,
+            "constraint_regex": self.constraint_regex,
+        }
+
+
+class WorkloadModel:
+    """Turn a `WorkloadConfig` into a deterministic schedule.
+
+    One `random.Random(seed)` stream drives everything — arrivals,
+    tenant draws, kind draws, prompt lengths, token ids — so the
+    whole schedule is a pure function of the config. `schedule()` is
+    computed once and cached; `fingerprint()` hashes its canonical
+    JSON projection."""
+
+    def __init__(self, config: WorkloadConfig):
+        config.validate()
+        self.config = config
+        self._schedule: Optional[List[RequestSpec]] = None
+        # The shared system prompt: fixed tokens derived from the
+        # seed (NOT drawn from the arrival stream, so every
+        # shared_prefix request in one schedule shares it exactly).
+        prng = random.Random(f"{config.seed}:shared-prefix")
+        self._shared_prefix = tuple(
+            prng.randrange(config.vocab)
+            for _ in range(config.shared_prefix_len)
+        )
+
+    # ---- rate curve --------------------------------------------------
+
+    def rate_at(self, t: float) -> float:
+        """Instantaneous arrival rate (req/s) at offset `t`."""
+        cfg = self.config
+        rate = cfg.base_rate
+        if cfg.diurnal is not None:
+            rate *= cfg.diurnal.factor(t)
+        for b in cfg.bursts:
+            if b.start_s <= t < b.start_s + b.duration_s:
+                rate *= b.multiplier
+        return rate
+
+    def peak_rate(self) -> float:
+        """Upper bound on rate_at over the run — the thinning
+        envelope. Bursts may overlap, so multipliers compound."""
+        cfg = self.config
+        peak = cfg.base_rate
+        if cfg.diurnal is not None:
+            peak *= 1.0 + cfg.diurnal.amplitude
+        for b in cfg.bursts:
+            if b.multiplier > 1.0 and b.start_s < cfg.duration_s:
+                peak *= b.multiplier
+        return peak
+
+    # ---- sampling ----------------------------------------------------
+
+    def _draw_tenant(self, rng: random.Random) -> str:
+        cfg = self.config
+        weights = [1.0 / (r + 1) ** cfg.zipf_s
+                   for r in range(len(cfg.tenants))]
+        return rng.choices(cfg.tenants, weights=weights)[0]
+
+    def _draw_kind(self, rng: random.Random) -> str:
+        kinds = list(self.config.mix.keys())
+        weights = [self.config.mix[k] for k in kinds]
+        return rng.choices(kinds, weights=weights)[0]
+
+    def _draw_prompt_len(self, rng: random.Random) -> int:
+        cfg = self.config
+        if cfg.tail_p > 0 and rng.random() < cfg.tail_p:
+            return cfg.tail_len
+        buckets = list(cfg.prompt_buckets)
+        weights = [w for _, _, w in buckets]
+        lo, hi, _ = rng.choices(buckets, weights=weights)[0]
+        return rng.randint(lo, hi)
+
+    def _make_spec(self, rng: random.Random, t: float) -> RequestSpec:
+        cfg = self.config
+        tenant = self._draw_tenant(rng)
+        kind = self._draw_kind(rng)
+        max_new = rng.randint(*cfg.max_new)
+        cancel_after = None
+        constraint = None
+        stream = False
+        if kind == "shared_prefix":
+            # Shared system prompt + a short per-request suffix: the
+            # prefix hash chain is identical across requests, which
+            # is exactly what the fabric's dedup should catch.
+            suffix_len = max(1, rng.randint(1, 8))
+            tokens = self._shared_prefix + tuple(
+                rng.randrange(cfg.vocab) for _ in range(suffix_len))
+        else:
+            n = self._draw_prompt_len(rng)
+            if kind == "prefill_heavy":
+                # Bias to the top of the distribution: long in,
+                # short out.
+                top_lo = max(lo for lo, _, _ in cfg.prompt_buckets)
+                n = max(n, top_lo)
+                max_new = min(max_new, cfg.prefill_heavy_max_new)
+            tokens = tuple(rng.randrange(cfg.vocab) for _ in range(n))
+        if kind in ("stream", "stream_cancel"):
+            stream = True
+        if kind == "stream_cancel":
+            cancel_after = rng.randint(*cfg.cancel_after_deltas)
+        if kind == "tool":
+            constraint = cfg.tool_regex
+        return RequestSpec(
+            arrival_s=t, tenant=tenant, kind=kind, tokens=tokens,
+            max_new=max_new, stream=stream, cancel_after=cancel_after,
+            constraint_regex=constraint,
+        )
+
+    # ---- the schedule ------------------------------------------------
+
+    def schedule(self) -> List[RequestSpec]:
+        """The full deterministic schedule, sorted by arrival. Lewis-
+        Shedler thinning: candidates at the peak rate, each kept with
+        probability rate(t)/peak — exact for any rate curve."""
+        if self._schedule is not None:
+            return self._schedule
+        cfg = self.config
+        rng = random.Random(cfg.seed)
+        peak = self.peak_rate()
+        out: List[RequestSpec] = []
+        t = 0.0
+        while True:
+            t += rng.expovariate(peak)
+            if t >= cfg.duration_s:
+                break
+            # One uniform draw per candidate, accepted or not, keeps
+            # the stream aligned however the rate curve changes.
+            keep = rng.random() <= self.rate_at(t) / peak
+            if keep:
+                out.append(self._make_spec(rng, t))
+        self._schedule = out
+        return out
+
+    def fingerprint(self) -> str:
+        """sha256 of the schedule's canonical JSON — the ledger's
+        drift detector for 'the traffic this scenario asserts its
+        SLOs under changed'."""
+        rows = [s.row() for s in self.schedule()]
+        blob = json.dumps(rows, sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def tenant_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for s in self.schedule():
+            out[s.tenant] = out.get(s.tenant, 0) + 1
+        return out
+
+    def kind_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for s in self.schedule():
+            out[s.kind] = out.get(s.kind, 0) + 1
+        return out
+
+    def payload_schedule(self, timeout: Optional[float] = None
+                         ) -> List[Tuple[float, Dict[str, object]]]:
+        """(arrival_s, payload) pairs — LoadGenerator's open-loop
+        input format."""
+        return [(s.arrival_s, s.payload(timeout=timeout))
+                for s in self.schedule()]

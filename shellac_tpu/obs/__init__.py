@@ -69,6 +69,9 @@ from shellac_tpu.obs.promtext import (
     merge_buckets,
     parse_prometheus_text,
 )
+from shellac_tpu.obs.scenario import (
+    ScenarioMetrics,
+)
 from shellac_tpu.obs.slo import (
     SLOEngine,
     SLOSpec,
@@ -125,6 +128,7 @@ __all__ = [
     "SLOEngine",
     "SLOSpec",
     "parse_slo_specs",
+    "ScenarioMetrics",
     "IncidentManager",
     "TRIGGERS",
     "EventSpool",

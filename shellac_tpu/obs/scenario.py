@@ -1,0 +1,54 @@
+"""The `shellac_scenario_*` metric bundle, owned by the obs layer.
+
+The scenario gate (`inference/scenarios.py`, `python -m shellac_tpu
+scenarios`) runs workload-model traffic against a replica and turns
+per-scenario SLO assertions into verdicts. Its metric families are
+declared here — next to the serving bundles in `trace.py` and the
+training bundle in `train.py` — so the `shellac_*` namespace stays
+owned by obs (SH015) and `docs/observability.md` and the code share
+one source of truth. Registration is idempotent against the shared
+registry, so the CLI runner, tests, and any embedding caller deposit
+into the same instruments.
+"""
+
+from __future__ import annotations
+
+from shellac_tpu.obs.metrics import get_registry, log_buckets
+
+
+class ScenarioMetrics:
+    """The scenario-gate series: one bundle per runner process."""
+
+    def __init__(self, registry=None):
+        reg = registry if registry is not None else get_registry()
+        self.runs = reg.counter(
+            "shellac_scenario_runs_total",
+            "Scenario executions by final verdict (pass|fail|skip)",
+            labels=("scenario", "verdict"),
+        )
+        self.requests = reg.counter(
+            "shellac_scenario_requests_total",
+            "Workload requests issued by the scenario runner, by "
+            "client-side outcome (ok, cancelled, http_NNN, "
+            "connect_error, stream_severed, client_saturated, ...)",
+            labels=("scenario", "outcome"),
+        )
+        self.good_fraction = reg.gauge(
+            "shellac_scenario_slo_good_fraction",
+            "Final good-event fraction per scenario and SLO assertion "
+            "(compare against the SLO's objective)",
+            labels=("scenario", "slo"),
+        )
+        self.breaches = reg.counter(
+            "shellac_scenario_slo_breaches_total",
+            "SLO assertions that finished below objective — each one "
+            "fails the scenario and fires an incident bundle naming a "
+            "violating trace id",
+            labels=("scenario", "slo"),
+        )
+        self.duration = reg.histogram(
+            "shellac_scenario_duration_seconds",
+            "Wall time to run one scenario (workload playback plus "
+            "verdict evaluation; skips observe ~0)",
+            buckets=log_buckets(0.1, 600.0),
+        )

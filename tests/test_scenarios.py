@@ -1,0 +1,665 @@
+"""Workload model + scenario gate: determinism, registry validation,
+SLO evaluation semantics, ledger schema, and the open-loop load
+generator against a stub NDJSON server.
+
+Everything here is tier-1: no model, no jax beyond conftest, no
+subprocesses. The committed SCENARIO_LEDGER.json is checked against
+the statically-recomputable projection, so editing a scenario's
+workload without regenerating the ledger fails HERE, not just in the
+CI gate job.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from shellac_tpu.inference.chaos import LoadGenerator
+from shellac_tpu.inference.scenarios import (
+    DEFAULT_LEDGER,
+    GATE_SLIS,
+    LEDGER_SCHEMA,
+    SCENARIOS,
+    Scenario,
+    SchemaDrift,
+    check_ledger,
+    check_row,
+    compare_to_ledger,
+    evaluate_slos,
+    expected_static_rows,
+    load_ledger,
+    select_scenarios,
+    stable_row,
+    write_ledger,
+)
+from shellac_tpu.inference.spec_batching import EXCLUSIONS
+from shellac_tpu.inference.workload import (
+    Burst,
+    Diurnal,
+    RequestSpec,
+    WorkloadConfig,
+    WorkloadModel,
+)
+from shellac_tpu.obs import parse_slo_specs
+
+# ---------------------------------------------------------------------
+# Workload model: determinism
+
+
+def small_config(**kw):
+    base = dict(
+        seed=7, duration_s=20.0, base_rate=4.0,
+        tenants=("a", "b", "c", "d"),
+        prompt_buckets=((4, 16, 0.7), (16, 64, 0.3)),
+        tail_p=0.0, max_new=(2, 6), diurnal=None, vocab=100,
+    )
+    base.update(kw)
+    return WorkloadConfig(**base)
+
+
+class TestWorkloadDeterminism:
+    def test_same_config_same_schedule(self):
+        cfg = small_config()
+        a = WorkloadModel(cfg)
+        b = WorkloadModel(WorkloadConfig(**{**cfg.__dict__}))
+        assert [s.row() for s in a.schedule()] \
+            == [s.row() for s in b.schedule()]
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_fingerprint_is_sha256_hex(self):
+        fp = WorkloadModel(small_config()).fingerprint()
+        assert len(fp) == 64
+        int(fp, 16)
+
+    def test_seed_changes_fingerprint(self):
+        a = WorkloadModel(small_config(seed=1)).fingerprint()
+        b = WorkloadModel(small_config(seed=2)).fingerprint()
+        assert a != b
+
+    def test_rate_change_changes_fingerprint(self):
+        a = WorkloadModel(small_config()).fingerprint()
+        b = WorkloadModel(small_config(base_rate=5.0)).fingerprint()
+        assert a != b
+
+    def test_schedule_sorted_and_bounded(self):
+        cfg = small_config(bursts=(Burst(5.0, 3.0, 4.0),),
+                           diurnal=Diurnal(0.5, 10.0))
+        sched = WorkloadModel(cfg).schedule()
+        arrivals = [s.arrival_s for s in sched]
+        assert arrivals == sorted(arrivals)
+        assert all(0.0 <= t < cfg.duration_s for t in arrivals)
+        assert len(sched) > 10
+
+    def test_schedule_cached(self):
+        m = WorkloadModel(small_config())
+        assert m.schedule() is m.schedule()
+
+    def test_payload_schedule_mirrors_schedule(self):
+        m = WorkloadModel(small_config())
+        pairs = m.payload_schedule(timeout=9.0)
+        assert len(pairs) == len(m.schedule())
+        for (t, p), s in zip(pairs, m.schedule()):
+            assert t == s.arrival_s
+            assert p["tokens"] == list(s.tokens)
+            assert p["timeout"] == 9.0
+
+
+class TestRateCurve:
+    def test_burst_multiplies_rate(self):
+        m = WorkloadModel(small_config(bursts=(Burst(5.0, 2.0, 3.0),)))
+        assert m.rate_at(6.0) == pytest.approx(12.0)
+        assert m.rate_at(4.9) == pytest.approx(4.0)
+        assert m.rate_at(7.0) == pytest.approx(4.0)  # end-exclusive
+
+    def test_diurnal_triangle_bounds(self):
+        d = Diurnal(amplitude=0.5, period_s=10.0)
+        assert d.factor(0.0) == pytest.approx(0.5)   # trough
+        assert d.factor(5.0) == pytest.approx(1.5)   # peak
+        for t in range(0, 30):
+            assert 0.5 <= d.factor(t * 0.37) <= 1.5
+
+    def test_peak_rate_is_envelope(self):
+        cfg = small_config(bursts=(Burst(2.0, 2.0, 3.0),
+                                   Burst(3.0, 2.0, 2.0)),
+                           diurnal=Diurnal(0.4, 8.0))
+        m = WorkloadModel(cfg)
+        peak = m.peak_rate()
+        for i in range(200):
+            assert m.rate_at(i * cfg.duration_s / 200.0) <= peak + 1e-9
+
+    def test_scaled_preserves_shape(self):
+        cfg = small_config(bursts=(Burst(5.0, 3.0, 4.0),),
+                           diurnal=Diurnal(0.5, 10.0))
+        s = cfg.scaled(0.5)
+        assert s.duration_s == pytest.approx(10.0)
+        assert s.bursts[0].start_s == pytest.approx(2.5)
+        assert s.bursts[0].duration_s == pytest.approx(1.5)
+        assert s.bursts[0].multiplier == pytest.approx(4.0)
+        assert s.diurnal.period_s == pytest.approx(5.0)
+        assert s.diurnal.amplitude == pytest.approx(0.5)
+
+
+class TestDraws:
+    def test_zipf_head_dominates(self):
+        cfg = small_config(duration_s=200.0, zipf_s=1.4)
+        counts = WorkloadModel(cfg).tenant_counts()
+        assert counts["a"] > counts["d"]
+        assert counts["a"] == max(counts.values())
+
+    def test_kind_invariants(self):
+        cfg = small_config(
+            duration_s=120.0,
+            mix={"chat": 0.2, "stream": 0.2, "stream_cancel": 0.2,
+                 "tool": 0.2, "prefill_heavy": 0.1,
+                 "shared_prefix": 0.1},
+            shared_prefix_len=12,
+        )
+        m = WorkloadModel(cfg)
+        kinds = m.kind_counts()
+        assert set(kinds) == set(cfg.mix)
+        prefix = None
+        for s in m.schedule():
+            assert s.stream == (s.kind in ("stream", "stream_cancel"))
+            if s.kind == "stream_cancel":
+                assert 1 <= s.cancel_after <= 3
+            else:
+                assert s.cancel_after is None
+            if s.kind == "tool":
+                assert s.constraint_regex == cfg.tool_regex
+            else:
+                assert s.constraint_regex is None
+            if s.kind == "prefill_heavy":
+                assert s.max_new <= cfg.prefill_heavy_max_new
+            if s.kind == "shared_prefix":
+                head = s.tokens[:cfg.shared_prefix_len]
+                if prefix is None:
+                    prefix = head
+                assert head == prefix
+                assert len(s.tokens) > cfg.shared_prefix_len
+
+    def test_long_tail(self):
+        cfg = small_config(duration_s=60.0, tail_p=1.0, tail_len=512)
+        for s in WorkloadModel(cfg).schedule():
+            if s.kind != "shared_prefix":
+                assert len(s.tokens) == 512
+
+    def test_payload_reserved_keys(self):
+        spec = RequestSpec(
+            arrival_s=1.0, tenant="acme", kind="stream_cancel",
+            tokens=(1, 2, 3), max_new=4, stream=True, cancel_after=2,
+        )
+        p = spec.payload(timeout=5.0)
+        assert p["tenant"] == "acme"
+        assert p["kind"] == "stream_cancel"
+        assert p["cancel_after_deltas"] == 2
+        assert p["stream"] is True
+        assert p["timeout"] == 5.0
+
+
+class TestConfigValidation:
+    @pytest.mark.parametrize("kw", [
+        dict(duration_s=0.0),
+        dict(base_rate=-1.0),
+        dict(tenants=()),
+        dict(zipf_s=-0.1),
+        dict(mix={}),
+        dict(mix={"nope": 1.0}),
+        dict(mix={"chat": -1.0}),
+        dict(mix={"chat": 0.0}),
+        dict(prompt_buckets=()),
+        dict(prompt_buckets=((0, 4, 1.0),)),
+        dict(prompt_buckets=((8, 4, 1.0),)),
+        dict(prompt_buckets=((4, 8, 0.0),)),
+        dict(tail_p=1.5),
+        dict(tail_len=0),
+        dict(max_new=(0, 4)),
+        dict(max_new=(6, 4)),
+        dict(cancel_after_deltas=(0, 2)),
+        dict(shared_prefix_len=0),
+        dict(vocab=1),
+        dict(prefill_heavy_max_new=0),
+        dict(bursts=(Burst(-1.0, 2.0, 2.0),)),
+        dict(bursts=(Burst(1.0, 0.0, 2.0),)),
+        dict(bursts=(Burst(1.0, 2.0, 0.0),)),
+        dict(diurnal=Diurnal(1.5, 10.0)),
+        dict(diurnal=Diurnal(0.5, 0.0)),
+    ])
+    def test_bad_config_raises(self, kw):
+        with pytest.raises(ValueError):
+            WorkloadModel(small_config(**kw))
+
+    def test_bad_scale_factor(self):
+        with pytest.raises(ValueError):
+            small_config().scaled(0.0)
+
+
+# ---------------------------------------------------------------------
+# Scenario registry
+
+
+class TestScenarioRegistry:
+    def test_catalog_validates(self):
+        assert len(SCENARIOS) >= 10
+        for s in SCENARIOS.values():
+            s.validate()
+
+    def test_gate_subset_selection(self):
+        gate = select_scenarios(None, include_all=False)
+        everything = select_scenarios(None, include_all=True)
+        assert {s.name for s in everything} == set(SCENARIOS)
+        assert all(s.gate for s in gate)
+        assert len(gate) < len(everything)
+
+    def test_unknown_scenario_name_dies(self):
+        with pytest.raises(SystemExit):
+            select_scenarios(["no_such_scenario"], include_all=False)
+
+    def _scn(self, **kw):
+        base = dict(
+            name="t", description="d", workload=small_config(),
+            slos=("availability@80",),
+        )
+        base.update(kw)
+        return Scenario(**base)
+
+    def test_no_slos_refused(self):
+        with pytest.raises(ValueError, match="asserts no SLOs"):
+            self._scn(slos=()).validate()
+
+    def test_unparseable_slo_loud(self):
+        with pytest.raises(ValueError):
+            self._scn(slos=("not an slo",)).validate()
+
+    def test_non_client_sli_refused(self):
+        # tpot/queue_wait parse fine in obs/slo.py but the gate cannot
+        # measure them client-side — refusing them is the loud path.
+        assert parse_slo_specs(("tpot_p95<10ms@99",))
+        with pytest.raises(ValueError, match="not client-measurable"):
+            self._scn(slos=("tpot_p95<10ms@99",)).validate()
+
+    @pytest.mark.parametrize("kw,msg", [
+        (dict(engine="warp"), "unknown engine"),
+        (dict(profile="gpu"), "unknown profile"),
+        (dict(chaos="earthquake"), "unknown chaos"),
+        (dict(requires=("time_travel",)), "unknown required"),
+        (dict(name="no spaces!"), "bad scenario name"),
+    ])
+    def test_bad_fields_refused(self, kw, msg):
+        with pytest.raises(ValueError, match=msg):
+            self._scn(**kw).validate()
+
+
+class TestSkipReasons:
+    def test_spec_engine_static_skip_is_named(self):
+        skips = {s.name: s.skip_reason() for s in SCENARIOS.values()}
+        spec = {n: r for n, r in skips.items()
+                if SCENARIOS[n].engine == "spec"}
+        assert spec, "the catalog must keep spec scenarios visible"
+        for name, reason in spec.items():
+            assert reason is not None, f"{name} silently passes"
+            assert reason.startswith("excluded: ")
+            assert reason.split(": ", 1)[1] in EXCLUSIONS
+
+    def test_dense_scenarios_do_not_skip_statically(self):
+        for s in SCENARIOS.values():
+            if s.engine == "dense":
+                assert s.skip_reason() is None
+
+    def test_live_speculative_target_skips(self):
+        s = next(s for s in SCENARIOS.values() if s.engine == "spec")
+        stats = {"engine": {"class": "SpeculativeBatchingEngine"}}
+        reason = s.skip_reason(stats)
+        assert reason and reason.startswith("excluded: ")
+
+    def test_live_disabled_overlap_flag_skips(self):
+        s = Scenario(name="t", description="d",
+                     workload=small_config(),
+                     slos=("availability@80",),
+                     requires=("overlap_decode",))
+        assert s.skip_reason() is None
+        on = {"engine": {"class": "Engine", "overlap_decode": True}}
+        off = {"engine": {"class": "Engine", "overlap_decode": False}}
+        assert s.skip_reason(on) is None
+        assert s.skip_reason(off) == "disabled: overlap_decode"
+
+
+# ---------------------------------------------------------------------
+# SLO evaluation semantics
+
+
+def _row(outcome="ok", latency=1.0, ttft=None, stream=False,
+         trace="t-1"):
+    return {"outcome": outcome, "latency_s": latency, "ttft_s": ttft,
+            "stream": stream, "trace_id": trace}
+
+
+class TestEvaluateSlos:
+    def test_availability_counts_cancel_good(self):
+        specs = parse_slo_specs(("availability@50",))
+        rows = [_row("ok"), _row("cancelled"), _row("http_500",
+                                                    trace="t-bad")]
+        [e] = evaluate_slos(specs, rows)
+        assert (e["good"], e["total"]) == (2, 3)
+        assert e["ok"] is True
+        assert e["violating_trace"] is None
+
+    def test_availability_excludes_client_saturated(self):
+        specs = parse_slo_specs(("availability@99",))
+        rows = [_row("ok"), _row("client_saturated", trace=None)]
+        [e] = evaluate_slos(specs, rows)
+        assert e["total"] == 1
+        assert e["ok"] is True
+
+    def test_violating_trace_is_first_violator(self):
+        specs = parse_slo_specs(("availability@99",))
+        rows = [_row("ok"), _row("connect_error", trace="t-first"),
+                _row("http_503", trace="t-second")]
+        [e] = evaluate_slos(specs, rows)
+        assert e["ok"] is False
+        assert e["violating_trace"] == "t-first"
+
+    def test_zero_events_fails_loudly(self):
+        specs = parse_slo_specs(("ttft_p95<100ms@90",))
+        [e] = evaluate_slos(specs, [_row("ok", stream=False)])
+        assert e["total"] == 0
+        assert e["good_fraction"] is None
+        assert e["ok"] is False
+
+    def test_ttft_only_measured_on_streams(self):
+        specs = parse_slo_specs(("ttft_p95<1s@90",))
+        rows = [_row("ok", stream=True, ttft=0.5, trace="fast"),
+                _row("ok", stream=True, ttft=2.0, trace="slow"),
+                _row("ok", stream=False, ttft=None)]
+        [e] = evaluate_slos(specs, rows)
+        assert e["total"] == 2
+        assert e["good"] == 1
+        assert e["ok"] is False
+        assert e["violating_trace"] == "slow"
+
+    def test_e2e_only_measured_on_ok(self):
+        specs = parse_slo_specs(("e2e<2s@90",))
+        rows = [_row("ok", latency=1.0),
+                _row("http_500", latency=30.0)]
+        [e] = evaluate_slos(specs, rows)
+        assert e["total"] == 1
+        assert e["ok"] is True
+
+
+# ---------------------------------------------------------------------
+# Ledger schema + the committed baseline
+
+
+def _good_row(**kw):
+    base = {
+        "schema": LEDGER_SCHEMA, "scenario": "s",
+        "description": "d", "verdict": "pass", "skip_reason": None,
+        "engine": "dense", "chaos": None, "requires": [],
+        "slos": ["availability@80"], "seed": 1,
+        "workload_fingerprint": "0" * 64, "gate": True,
+    }
+    base.update(kw)
+    return base
+
+
+class TestLedgerSchema:
+    def test_good_row_passes(self):
+        check_row(_good_row())
+
+    @pytest.mark.parametrize("mutate", [
+        lambda r: r.pop("verdict"),
+        lambda r: r.update(schema=99),
+        lambda r: r.update(verdict="maybe"),
+        lambda r: r.update(verdict="skip"),           # no reason
+        lambda r: r.update(skip_reason="x"),          # not a skip
+        lambda r: r.update(slos=[]),
+        lambda r: r.update(slos=["no-objective"]),
+        lambda r: r.update(workload_fingerprint="abc"),
+    ])
+    def test_bad_rows_drift(self, mutate):
+        row = _good_row()
+        mutate(row)
+        with pytest.raises(SchemaDrift):
+            check_row(row)
+
+    def test_committed_fail_refused_but_live_allowed(self):
+        row = _good_row(verdict="fail")
+        with pytest.raises(SchemaDrift, match="not a baseline"):
+            check_row(row)
+        check_row(row, committed=False)
+
+    def test_duplicate_scenarios_drift(self):
+        doc = {"schema": LEDGER_SCHEMA,
+               "scenarios": [_good_row(), _good_row()]}
+        with pytest.raises(SchemaDrift, match="duplicate"):
+            check_ledger(doc)
+
+    def test_write_then_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "ledger.json")
+        write_ledger(path, [_good_row(scenario="b"),
+                            _good_row(scenario="a")])
+        doc = load_ledger(path)
+        check_ledger(doc)
+        names = [r["scenario"] for r in doc["scenarios"]]
+        assert names == ["a", "b"]  # sorted, stable diffs
+
+    def test_stable_row_drops_run_noise(self):
+        row = _good_row()
+        row["counts"] = {"ok": 10}
+        row["slos"] = [{"slo": "availability@80", "good": 9,
+                        "total": 10, "good_fraction": 0.9,
+                        "objective": 0.8, "ok": True,
+                        "violating_trace": None}]
+        s = stable_row(row)
+        assert "counts" not in s
+        assert s["slos"] == ["availability@80"]
+
+    def test_unreadable_ledger_is_drift(self, tmp_path):
+        with pytest.raises(SchemaDrift):
+            load_ledger(str(tmp_path / "missing.json"))
+        bad = tmp_path / "bad.json"
+        bad.write_text("{nope")
+        with pytest.raises(SchemaDrift):
+            load_ledger(str(bad))
+
+
+class TestCommittedLedger:
+    """The repo's own SCENARIO_LEDGER.json must stay fresh: schema
+    clean and matching the statically-recomputable projection of the
+    current catalog (fingerprints included). This is `--check` as a
+    tier-1 test."""
+
+    def test_committed_ledger_fresh(self):
+        import shellac_tpu
+        import os
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(shellac_tpu.__file__)))
+        path = os.path.join(root, DEFAULT_LEDGER)
+        doc = load_ledger(path)
+        check_ledger(doc)
+        gate = [s for s in SCENARIOS.values() if s.gate]
+        diff = compare_to_ledger(expected_static_rows(gate), doc,
+                                 verdict_known=False)
+        assert diff == [], (
+            "SCENARIO_LEDGER.json is stale — regenerate with "
+            "`python -m shellac_tpu scenarios --update-ledger`"
+        )
+
+    def test_expected_static_rows_know_skips(self):
+        rows = expected_static_rows(list(SCENARIOS.values()))
+        by_name = {r["scenario"]: r for r in rows}
+        for s in SCENARIOS.values():
+            r = by_name[s.name]
+            if s.engine == "spec":
+                assert r["verdict"] == "skip"
+                assert r["skip_reason"].startswith("excluded: ")
+            else:
+                assert r["verdict"] is None  # needs a run
+
+    def test_compare_detects_fingerprint_drift(self):
+        gate = [s for s in SCENARIOS.values() if s.gate]
+        rows = expected_static_rows(gate)
+        doc = {"schema": LEDGER_SCHEMA,
+               "scenarios": [dict(r) for r in rows]}
+        tampered = [dict(r) for r in rows]
+        tampered[0]["workload_fingerprint"] = "f" * 64
+        diff = compare_to_ledger(tampered, doc, verdict_known=False)
+        assert len(diff) == 1
+        assert "workload_fingerprint" in diff[0]
+
+
+# ---------------------------------------------------------------------
+# Open-loop LoadGenerator against a stub NDJSON server
+
+
+class _StubServer:
+    """Tiny /generate stub: x-request-id on every response, NDJSON
+    when `stream` is set, optional per-request latency via a
+    `_sleep_s` payload key (client-side reserved keys are already
+    stripped by the generator, so this one rides the wire)."""
+
+    def __init__(self):
+        outer = self
+        self.seen = []
+        self.lock = threading.Lock()
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                with outer.lock:
+                    outer.seen.append(
+                        (body, {k.lower(): v for k, v
+                                in self.headers.items()}))
+                    rid = f"stub-{len(outer.seen)}"
+                time.sleep(float(body.get("_sleep_s", 0.0)))
+                self.send_response(200)
+                self.send_header("x-request-id", rid)
+                ctype = ("application/x-ndjson"
+                         if body.get("stream") else "application/json")
+                self.send_header("Content-Type", ctype)
+                self.end_headers()
+                if not body.get("stream"):
+                    self.wfile.write(json.dumps(
+                        {"tokens": [1, 2], "trace_id": rid}).encode())
+                    return
+                try:
+                    for i in range(body.get("max_new", 4)):
+                        self.wfile.write(json.dumps(
+                            {"tokens": [i], "trace_id": rid}
+                        ).encode() + b"\n")
+                        self.wfile.flush()
+                        time.sleep(0.02)
+                    self.wfile.write(json.dumps(
+                        {"done": True, "trace_id": rid}).encode()
+                        + b"\n")
+                except BrokenPipeError:
+                    pass  # client cancelled mid-stream
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture()
+def stub():
+    s = _StubServer()
+    yield s
+    s.close()
+
+
+class TestLoadGeneratorOpenLoop:
+    def test_plays_schedule_and_captures(self, stub):
+        sched = [(0.0, {"tokens": [1], "max_new": 2,
+                        "tenant": "acme", "kind": "chat"}),
+                 (0.05, {"tokens": [2], "max_new": 2,
+                         "tenant": "globex", "kind": "chat"})]
+        gen = LoadGenerator(stub.url, schedule=sched, timeout=5,
+                            capture=True)
+        counts = gen.run()
+        assert counts == {"ok": 2}
+        assert len(gen.results) == 2
+        for row in gen.results:
+            assert row["trace_id"].startswith("stub-")
+            assert row["outcome"] == "ok"
+            assert row["latency_s"] is not None
+        # Reserved keys never hit the wire; tenant rides the header.
+        for body, headers in stub.seen:
+            assert "tenant" not in body and "kind" not in body
+            assert headers.get("x-shellac-tenant") in ("acme",
+                                                       "globex")
+
+    def test_streaming_ttft_and_done(self, stub):
+        gen = LoadGenerator(stub.url, schedule=[
+            (0.0, {"tokens": [1], "max_new": 3, "stream": True}),
+        ], timeout=5, capture=True)
+        assert gen.run() == {"ok": 1}
+        [row] = gen.results
+        assert row["ttft_s"] is not None
+        assert row["ttft_s"] <= row["latency_s"]
+
+    def test_mid_flight_cancellation(self, stub):
+        gen = LoadGenerator(stub.url, schedule=[
+            (0.0, {"tokens": [1], "max_new": 50, "stream": True,
+                   "cancel_after_deltas": 2}),
+        ], timeout=5, capture=True)
+        assert gen.run() == {"cancelled": 1}
+        [row] = gen.results
+        assert row["outcome"] == "cancelled"
+        assert row["ttft_s"] is not None
+
+    def test_client_saturated_is_loud(self, stub):
+        sched = [(0.0, {"tokens": [1], "_sleep_s": 0.8}),
+                 (0.05, {"tokens": [2], "kind": "chat"}),
+                 (0.1, {"tokens": [3], "kind": "chat"})]
+        gen = LoadGenerator(stub.url, schedule=sched, timeout=5,
+                            max_in_flight=1, capture=True)
+        counts = gen.run()
+        assert counts.get("client_saturated", 0) >= 1
+        assert counts.get("ok", 0) >= 1
+        saturated = [r for r in gen.results
+                     if r["outcome"] == "client_saturated"]
+        assert saturated
+        assert saturated[0]["trace_id"] is None
+
+    def test_connect_error_outcome(self):
+        gen = LoadGenerator("http://127.0.0.1:9", schedule=[
+            (0.0, {"tokens": [1]})], timeout=2, capture=True)
+        counts = gen.run()
+        assert counts == {"connect_error": 1}
+
+    def test_seeded_rate_mode_reproducible(self):
+        a = LoadGenerator("http://127.0.0.1:9", rate=5.0,
+                          duration=10.0, seed=3,
+                          payloads=[{"tokens": [1]}, {"tokens": [2]}])
+        b = LoadGenerator("http://127.0.0.1:9", rate=5.0,
+                          duration=10.0, seed=3,
+                          payloads=[{"tokens": [1]}, {"tokens": [2]}])
+        assert a.schedule == b.schedule
+        assert len(a.schedule) > 10
+        assert all(t < 10.0 for t, _ in a.schedule)
+
+    def test_rate_needs_duration(self):
+        with pytest.raises(ValueError):
+            LoadGenerator("http://x", rate=5.0)
+
+    def test_run_refuses_closed_loop(self):
+        gen = LoadGenerator("http://127.0.0.1:9")
+        with pytest.raises(RuntimeError):
+            gen.run()
+
+
+class TestGateSlis:
+    def test_gate_slis_are_client_measurable_only(self):
+        assert set(GATE_SLIS) == {"ttft", "e2e", "availability"}
+        for s in SCENARIOS.values():
+            for spec in parse_slo_specs(s.slos):
+                assert spec.sli in GATE_SLIS
